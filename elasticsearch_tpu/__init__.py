@@ -1,0 +1,33 @@
+"""elasticsearch_tpu — a TPU-native distributed search & analytics engine.
+
+A from-scratch rebuild of the capabilities of Elasticsearch (reference:
+org.elasticsearch, ES 2.0 / Lucene 5.2) designed for TPU hardware:
+
+- Immutable, device-resident columnar segments (padded CSR postings, doc
+  values, dense-vector slabs) instead of Lucene's on-disk codecs.
+- Queries compile to whole-segment dense scoring programs executed under
+  ``jax.jit`` (segment-at-a-time, impact-style BM25), instead of Lucene's
+  doc-at-a-time Weight/Scorer iterator trees.
+- kNN vector search as bf16 matmuls on the MXU.
+- Shards laid out across a ``jax.sharding.Mesh``; per-shard top-k merged
+  with XLA collectives instead of transport-layer scatter/gather.
+
+Public entry points:
+    from elasticsearch_tpu import Node, Client
+"""
+
+__version__ = "0.1.0"
+
+__all__ = ["Node", "Client", "__version__"]
+
+
+def __getattr__(name):  # lazy: submodules pull in jax; keep import light
+    if name == "Node":
+        from elasticsearch_tpu.node import Node
+
+        return Node
+    if name == "Client":
+        from elasticsearch_tpu.client import Client
+
+        return Client
+    raise AttributeError(name)

@@ -1,0 +1,4 @@
+from elasticsearch_tpu.analysis.analyzer import Analyzer, get_analyzer, build_custom_analyzer
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+
+__all__ = ["Analyzer", "get_analyzer", "build_custom_analyzer", "AnalysisRegistry"]

@@ -1,0 +1,351 @@
+"""Token filters.
+
+Reference: org/elasticsearch/index/analysis/*TokenFilterFactory.java
+(LowerCaseTokenFilterFactory, StopTokenFilterFactory, StemmerTokenFilterFactory,
+ASCIIFoldingTokenFilterFactory, LengthTokenFilterFactory, TrimTokenFilterFactory,
+TruncateTokenFilterFactory, UniqueTokenFilterFactory, ReverseTokenFilterFactory,
+ShingleTokenFilterFactory, NGramTokenFilterFactory, EdgeNGramTokenFilterFactory,
+SynonymTokenFilterFactory, SnowballTokenFilterFactory, KeywordMarkerTokenFilterFactory).
+
+A filter maps List[(token, position)] -> List[(token, position)]. A dropped
+stopword leaves a position gap (ES `enable_position_increments` semantics) so
+phrase queries behave like Lucene's.
+"""
+from __future__ import annotations
+
+import functools
+import re
+import unicodedata
+from typing import Callable, List, Tuple
+
+Token = Tuple[str, int]
+
+# Lucene's EnglishAnalyzer default stopword set (ENGLISH_STOP_WORDS_SET).
+ENGLISH_STOP_WORDS = frozenset(
+    """a an and are as at be but by for if in into is it no not of on or such
+    that the their then there these they this to was will with""".split()
+)
+
+
+def lowercase_filter(tokens: List[Token]) -> List[Token]:
+    return [(t.lower(), p) for t, p in tokens]
+
+
+def uppercase_filter(tokens: List[Token]) -> List[Token]:
+    return [(t.upper(), p) for t, p in tokens]
+
+
+def stop_filter(tokens: List[Token], stopwords=ENGLISH_STOP_WORDS) -> List[Token]:
+    if stopwords == "_english_":
+        stopwords = ENGLISH_STOP_WORDS
+    elif stopwords == "_none_":
+        return list(tokens)
+    sw = {w.lower() for w in stopwords}
+    return [(t, p) for t, p in tokens if t.lower() not in sw]
+
+
+def asciifolding_filter(tokens: List[Token]) -> List[Token]:
+    def fold(s: str) -> str:
+        return unicodedata.normalize("NFKD", s).encode("ascii", "ignore").decode("ascii") or s
+
+    return [(fold(t), p) for t, p in tokens]
+
+
+def length_filter(tokens: List[Token], min: int = 0, max: int = 2**31 - 1) -> List[Token]:
+    return [(t, p) for t, p in tokens if min <= len(t) <= max]
+
+
+def trim_filter(tokens: List[Token]) -> List[Token]:
+    return [(t.strip(), p) for t, p in tokens]
+
+
+def truncate_filter(tokens: List[Token], length: int = 10) -> List[Token]:
+    return [(t[:length], p) for t, p in tokens]
+
+
+def unique_filter(tokens: List[Token], only_on_same_position: bool = False) -> List[Token]:
+    seen = set()
+    out = []
+    for t, p in tokens:
+        key = (t, p) if only_on_same_position else t
+        if key not in seen:
+            seen.add(key)
+            out.append((t, p))
+    return out
+
+
+def reverse_filter(tokens: List[Token]) -> List[Token]:
+    return [(t[::-1], p) for t, p in tokens]
+
+
+def shingle_filter(
+    tokens: List[Token],
+    min_shingle_size: int = 2,
+    max_shingle_size: int = 2,
+    output_unigrams: bool = True,
+    token_separator: str = " ",
+) -> List[Token]:
+    out: List[Token] = []
+    texts = [t for t, _ in tokens]
+    for i, (t, p) in enumerate(tokens):
+        if output_unigrams:
+            out.append((t, p))
+        for n in range(min_shingle_size, max_shingle_size + 1):
+            if i + n <= len(texts):
+                out.append((token_separator.join(texts[i : i + n]), p))
+    return out
+
+
+def ngram_filter(tokens: List[Token], min_gram: int = 1, max_gram: int = 2) -> List[Token]:
+    out: List[Token] = []
+    for t, p in tokens:
+        for n in range(min_gram, max_gram + 1):
+            for i in range(0, max(0, len(t) - n + 1)):
+                out.append((t[i : i + n], p))
+    return out
+
+
+def edge_ngram_filter(tokens: List[Token], min_gram: int = 1, max_gram: int = 2) -> List[Token]:
+    out: List[Token] = []
+    for t, p in tokens:
+        for n in range(min_gram, min(max_gram, len(t)) + 1):
+            out.append((t[:n], p))
+    return out
+
+
+def synonym_filter(tokens: List[Token], synonyms: List[str] = ()) -> List[Token]:
+    """Solr-format synonym rules: "a, b => c" (replace) or "a, b, c" (expand).
+
+    Multi-word inputs ("united states => usa") match token *sequences* in the
+    stream, like Lucene's SynonymFilter: rules are keyed by first token and
+    matched greedily longest-first.
+    """
+    # first token -> list of (input_seq: tuple, outputs: list)
+    rules: dict = {}
+
+    def add_rule(seq_words: str, outputs: List[str]):
+        seq = tuple(seq_words.split())
+        if seq:
+            rules.setdefault(seq[0], []).append((seq, outputs))
+
+    for rule in synonyms:
+        if "=>" in rule:
+            lhs, rhs = rule.split("=>")
+            targets = [w.strip() for w in rhs.split(",") if w.strip()]
+            for w in (w.strip() for w in lhs.split(",")):
+                if w:
+                    add_rule(w, targets)
+        else:
+            group = [w.strip() for w in rule.split(",") if w.strip()]
+            for w in group:
+                add_rule(w, group)
+    for cands in rules.values():
+        cands.sort(key=lambda c: -len(c[0]))  # longest match first
+
+    out: List[Token] = []
+    i = 0
+    n = len(tokens)
+    while i < n:
+        t, p = tokens[i]
+        matched = False
+        for seq, outputs in rules.get(t, ()):
+            if i + len(seq) <= n and all(tokens[i + j][0] == seq[j] for j in range(len(seq))):
+                # multi-word outputs emit one token per word at consecutive
+                # positions (SynonymFilter graph flattened)
+                for o in outputs:
+                    for j, word in enumerate(o.split()):
+                        out.append((word, p + j))
+                i += len(seq)
+                matched = True
+                break
+        if not matched:
+            out.append((t, p))
+            i += 1
+    return out
+
+
+# ---- Porter stemmer (classic algorithm; Lucene PorterStemFilter parity) ------
+
+_V = "aeiou"
+
+
+def _cons(w: str, i: int) -> bool:
+    c = w[i]
+    if c in _V:
+        return False
+    if c == "y":
+        return i == 0 or not _cons(w, i - 1)
+    return True
+
+
+def _measure(stem: str) -> int:
+    # count VC sequences
+    m = 0
+    i = 0
+    n = len(stem)
+    while i < n and _cons(stem, i):
+        i += 1
+    while i < n:
+        while i < n and not _cons(stem, i):
+            i += 1
+        if i >= n:
+            break
+        m += 1
+        while i < n and _cons(stem, i):
+            i += 1
+    return m
+
+
+def _has_vowel(stem: str) -> bool:
+    return any(not _cons(stem, i) for i in range(len(stem)))
+
+
+def _ends_double_cons(w: str) -> bool:
+    return len(w) >= 2 and w[-1] == w[-2] and _cons(w, len(w) - 1)
+
+
+def _cvc(w: str) -> bool:
+    if len(w) < 3:
+        return False
+    return (
+        _cons(w, len(w) - 3)
+        and not _cons(w, len(w) - 2)
+        and _cons(w, len(w) - 1)
+        and w[-1] not in "wxy"
+    )
+
+
+def porter_stem(w: str) -> str:
+    if len(w) <= 2:
+        return w
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif w.endswith("ss"):
+        pass
+    elif w.endswith("s"):
+        w = w[:-1]
+    # step 1b
+    flag = False
+    if w.endswith("eed"):
+        if _measure(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed"):
+        if _has_vowel(w[:-2]):
+            w = w[:-2]
+            flag = True
+    elif w.endswith("ing"):
+        if _has_vowel(w[:-3]):
+            w = w[:-3]
+            flag = True
+    if flag:
+        if w.endswith(("at", "bl", "iz")):
+            w += "e"
+        elif _ends_double_cons(w) and w[-1] not in "lsz":
+            w = w[:-1]
+        elif _measure(w) == 1 and _cvc(w):
+            w += "e"
+    # step 1c
+    if w.endswith("y") and _has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    # step 2
+    for suf, rep in (
+        ("ational", "ate"), ("tional", "tion"), ("enci", "ence"), ("anci", "ance"),
+        ("izer", "ize"), ("abli", "able"), ("alli", "al"), ("entli", "ent"),
+        ("eli", "e"), ("ousli", "ous"), ("ization", "ize"), ("ation", "ate"),
+        ("ator", "ate"), ("alism", "al"), ("iveness", "ive"), ("fulness", "ful"),
+        ("ousness", "ous"), ("aliti", "al"), ("iviti", "ive"), ("biliti", "ble"),
+    ):
+        if w.endswith(suf):
+            if _measure(w[: -len(suf)]) > 0:
+                w = w[: -len(suf)] + rep
+            break
+    # step 3
+    for suf, rep in (
+        ("icate", "ic"), ("ative", ""), ("alize", "al"), ("iciti", "ic"),
+        ("ical", "ic"), ("ful", ""), ("ness", ""),
+    ):
+        if w.endswith(suf):
+            if _measure(w[: -len(suf)]) > 0:
+                w = w[: -len(suf)] + rep
+            break
+    # step 4
+    for suf in (
+        "al", "ance", "ence", "er", "ic", "able", "ible", "ant", "ement",
+        "ment", "ent", "ion", "ou", "ism", "ate", "iti", "ous", "ive", "ize",
+    ):
+        if w.endswith(suf):
+            stem = w[: -len(suf)]
+            if _measure(stem) > 1:
+                if suf == "ion" and not stem.endswith(("s", "t")):
+                    break
+                w = stem
+            break
+    # step 5a
+    if w.endswith("e"):
+        stem = w[:-1]
+        m = _measure(stem)
+        if m > 1 or (m == 1 and not _cvc(stem)):
+            w = stem
+    # step 5b
+    if _measure(w) > 1 and _ends_double_cons(w) and w.endswith("l"):
+        w = w[:-1]
+    return w
+
+
+def porter_stem_filter(tokens: List[Token]) -> List[Token]:
+    return [(porter_stem(t), p) for t, p in tokens]
+
+
+def stemmer_filter(tokens: List[Token], language: str = "english") -> List[Token]:
+    if language in ("english", "porter", "porter2", "light_english"):
+        return porter_stem_filter(tokens)
+    # other languages degrade to identity (documented stub; snowball langs in R3)
+    return list(tokens)
+
+
+def keyword_marker_filter(tokens: List[Token], keywords=()) -> List[Token]:
+    # marker semantics matter only in combination with stemming; our pipeline
+    # applies it by pre-filtering stemming candidates in Analyzer.apply
+    return list(tokens)
+
+
+FILTERS: dict = {
+    "lowercase": lowercase_filter,
+    "uppercase": uppercase_filter,
+    "stop": stop_filter,
+    "asciifolding": asciifolding_filter,
+    "length": length_filter,
+    "trim": trim_filter,
+    "truncate": truncate_filter,
+    "unique": unique_filter,
+    "reverse": reverse_filter,
+    "shingle": shingle_filter,
+    "ngram": ngram_filter,
+    "nGram": ngram_filter,
+    "edge_ngram": edge_ngram_filter,
+    "edgeNGram": edge_ngram_filter,
+    "synonym": synonym_filter,
+    "porter_stem": porter_stem_filter,
+    "stemmer": stemmer_filter,
+    "snowball": stemmer_filter,
+    "keyword_marker": keyword_marker_filter,
+}
+
+
+def get_filter(name: str, **params) -> Callable[[List[Token]], List[Token]]:
+    try:
+        fn = FILTERS[name]
+    except KeyError:
+        raise ValueError(f"unknown token filter [{name}]")
+    if name == "stop" and "stopwords" in params:
+        sw = params["stopwords"]
+        return functools.partial(stop_filter, stopwords=sw)
+    if params:
+        # map ES param names onto python kwargs where they coincide
+        sig_params = {k: v for k, v in params.items() if k not in ("type", "version")}
+        if sig_params:
+            return functools.partial(fn, **sig_params)
+    return fn

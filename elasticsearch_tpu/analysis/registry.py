@@ -1,0 +1,49 @@
+"""Per-index analysis registry.
+
+Reference: org/elasticsearch/index/analysis/AnalysisService.java — resolves
+named analyzers from index settings (`settings.analysis.*`), falling back to
+built-ins; fields then bind `analyzer` / `search_analyzer` by name.
+"""
+from __future__ import annotations
+
+from elasticsearch_tpu.analysis.analyzer import (
+    Analyzer,
+    BUILTIN_ANALYZERS,
+    build_custom_analyzer,
+    get_analyzer,
+)
+
+
+class AnalysisRegistry:
+    def __init__(self, index_settings: dict | None = None):
+        self._cache: dict[str, Analyzer] = {}
+        analysis = (index_settings or {}).get("analysis", {})
+        self._shared = {
+            "tokenizer": analysis.get("tokenizer", {}),
+            "filter": analysis.get("filter", {}),
+            "char_filter": analysis.get("char_filter", {}),
+        }
+        self._custom = analysis.get("analyzer", {})
+
+    def get(self, name: str) -> Analyzer:
+        if name in self._cache:
+            return self._cache[name]
+        if name in self._custom:
+            cfg = dict(self._custom[name])
+            typ = cfg.pop("type", "custom")
+            if typ == "custom":
+                an = build_custom_analyzer(name, cfg, self._shared)
+            else:
+                an = get_analyzer(typ)
+        elif name in BUILTIN_ANALYZERS:
+            an = get_analyzer(name)
+        else:
+            raise ValueError(f"unknown analyzer [{name}]")
+        self._cache[name] = an
+        return an
+
+    @property
+    def default(self) -> Analyzer:
+        if "default" in self._custom:
+            return self.get("default")
+        return self.get("standard")

@@ -1,0 +1,117 @@
+"""Document parsing: JSON source → ParsedDocument.
+
+Reference: org/elasticsearch/index/mapper/DocumentMapper.java +
+DocumentParser-era logic inside FieldMapper.parse — walks the JSON tree,
+flattens objects to dotted paths, applies analyzers for analyzed fields,
+collects doc values, handles arrays (multi-values), copy_to, and dynamic
+mapping of unseen fields.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.mappings import FieldMapping, Mappings
+from elasticsearch_tpu.utils.errors import MapperParsingException
+
+Token = Tuple[str, int]
+
+
+@dataclass
+class ParsedDocument:
+    doc_id: str
+    source: dict
+    # text field -> list of (term, position)
+    text_tokens: Dict[str, List[Token]] = field(default_factory=dict)
+    # keyword/numeric/bool/date/ip field -> list of values (multi-valued)
+    doc_values: Dict[str, List[Any]] = field(default_factory=dict)
+    # dense_vector field -> vector
+    vectors: Dict[str, List[float]] = field(default_factory=dict)
+    # field -> raw values for stored fields
+    stored: Dict[str, List[Any]] = field(default_factory=dict)
+    routing: Optional[str] = None
+
+    def field_length(self, fname: str) -> int:
+        return len(self.text_tokens.get(fname, ()))
+
+
+class DocumentParser:
+    def __init__(self, mappings: Mappings, analysis: AnalysisRegistry):
+        self.mappings = mappings
+        self.analysis = analysis
+
+    def parse(self, doc_id: str, source: dict, routing: Optional[str] = None) -> ParsedDocument:
+        if not isinstance(source, dict):
+            raise MapperParsingException("document source must be a JSON object")
+        parsed = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
+        self._walk(source, "", parsed)
+        return parsed
+
+    def _walk(self, obj: dict, prefix: str, parsed: ParsedDocument):
+        for key, value in obj.items():
+            full = f"{prefix}{key}"
+            if isinstance(value, dict):
+                fm = self.mappings.get(full)
+                if fm is None or fm.type in ("object", "nested", "geo_point"):
+                    if fm is not None and fm.type == "geo_point":
+                        self._index_value(fm, value, parsed)
+                    else:
+                        self._walk(value, f"{full}.", parsed)
+                    continue
+                self._index_value(fm, value, parsed)
+                continue
+            if isinstance(value, list) and value and isinstance(value[0], dict):
+                # array of objects: flatten each (nested semantics refined in R2)
+                for item in value:
+                    self._walk(item, f"{full}.", parsed)
+                continue
+            fm = self.mappings.get(full)
+            if fm is None:
+                fm = self.mappings.dynamic_map(full, value)
+                if fm is None:
+                    continue
+            self._index_value(fm, value, parsed)
+            for sub in fm.fields.values():
+                self._index_value(sub, value, parsed)
+            for target in fm.copy_to:
+                tfm = self.mappings.get(target) or self.mappings.dynamic_map(target, value)
+                if tfm is not None:
+                    self._index_value(tfm, value, parsed)
+
+    def _index_value(self, fm: FieldMapping, value: Any, parsed: ParsedDocument):
+        values = value if isinstance(value, list) and not fm.is_vector else [value]
+        if fm.store:
+            parsed.stored.setdefault(fm.name, []).extend(values)
+        if fm.is_vector:
+            norm = self.mappings.normalize_value(fm, value)
+            if norm is not None:
+                parsed.vectors[fm.name] = norm
+            return
+        for v in values:
+            norm = self.mappings.normalize_value(fm, v)
+            if norm is None:
+                continue
+            if fm.is_text:
+                if not fm.index:
+                    continue
+                analyzer = self.analysis.get(fm.analyzer)
+                toks = analyzer.analyze(str(norm))
+                bucket = parsed.text_tokens.setdefault(fm.name, [])
+                # multi-valued text: position gap of 100 between values (ES
+                # position_increment_gap default) so phrases don't cross values
+                offset = (bucket[-1][1] + 100) if bucket else 0
+                bucket.extend((t, p + offset) for t, p in toks)
+            elif fm.type == "token_count":
+                analyzer = self.analysis.get(fm.analyzer)
+                parsed.doc_values.setdefault(fm.name, []).append(len(analyzer.analyze(str(v))))
+            else:
+                if fm.is_keyword and fm.ignore_above and len(str(norm)) > fm.ignore_above:
+                    continue
+                if fm.type == "boolean":
+                    norm = 1 if norm else 0
+                if fm.type == "geo_point":
+                    parsed.doc_values.setdefault(fm.name + ".lat", []).append(norm[0])
+                    parsed.doc_values.setdefault(fm.name + ".lon", []).append(norm[1])
+                    continue
+                parsed.doc_values.setdefault(fm.name, []).append(norm)

@@ -1,0 +1,354 @@
+"""Field mappings.
+
+Reference: org/elasticsearch/index/mapper/ — MapperService.java,
+DocumentMapper.java, and core field mappers (core/StringFieldMapper.java,
+LongFieldMapper.java, IntegerFieldMapper.java, ShortFieldMapper.java,
+ByteFieldMapper.java, DoubleFieldMapper.java, FloatFieldMapper.java,
+BooleanFieldMapper.java, DateFieldMapper.java, BinaryFieldMapper.java,
+TokenCountFieldMapper.java, Murmur3FieldMapper.java), geo/GeoPointFieldMapper.java,
+ip/IpFieldMapper.java, object/ObjectMapper.java.
+
+ES 2.0 uses `string` with `index: analyzed|not_analyzed`; we support both that
+legacy form and the modern `text`/`keyword` split, plus `dense_vector` (the
+north-star addition). Object fields flatten to dotted paths like ES's
+ObjectMapper; `nested` is tracked for block-join semantics.
+"""
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.utils.errors import MapperParsingException
+from elasticsearch_tpu.utils.dates import parse_date
+
+# canonical families
+TEXT_TYPES = {"text", "string_analyzed"}
+KEYWORD_TYPES = {"keyword", "string_not_analyzed"}
+NUMERIC_TYPES = {"long", "integer", "short", "byte", "double", "float", "half_float"}
+INT_TYPES = {"long", "integer", "short", "byte", "token_count", "murmur3"}
+
+
+@dataclass
+class FieldMapping:
+    name: str  # full dotted path
+    type: str  # canonical type
+    analyzer: str = "standard"
+    search_analyzer: Optional[str] = None
+    index: bool = True  # indexed (searchable)
+    doc_values: bool = True  # column store for agg/sort
+    store: bool = False
+    boost: float = 1.0
+    null_value: Any = None
+    fmt: str = "strict_date_optional_time||epoch_millis"  # date format
+    dims: int = 0  # dense_vector
+    similarity: str = "cosine"  # dense_vector: cosine|dot_product|l2_norm
+    copy_to: List[str] = field(default_factory=list)
+    fields: Dict[str, "FieldMapping"] = field(default_factory=dict)  # multi-fields
+    nested: bool = False  # direct child of a nested object
+    nested_path: Optional[str] = None
+    ignore_above: int = 0  # keyword: ignore long values
+    scaling_factor: float = 1.0  # scaled_float
+
+    @property
+    def is_text(self) -> bool:
+        return self.type == "text"
+
+    @property
+    def is_keyword(self) -> bool:
+        return self.type == "keyword"
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.type in NUMERIC_TYPES or self.type in ("date", "token_count", "murmur3", "scaled_float")
+
+    @property
+    def is_vector(self) -> bool:
+        return self.type == "dense_vector"
+
+
+def _canonical_type(props: dict) -> str:
+    t = props.get("type", "object")
+    if t == "string":  # ES 2.0 legacy
+        if props.get("index") == "not_analyzed":
+            return "keyword"
+        return "text"
+    return t
+
+
+class Mappings:
+    """Parsed mapping for one index (single-type, like ES ≥6 semantics; the
+    reference's multi-type `_type` is carried as a meta field)."""
+
+    def __init__(self, mapping_json: dict | None = None, default_analyzer: str = "standard"):
+        self.fields: Dict[str, FieldMapping] = {}
+        self.dynamic: Any = True  # True | False | "strict"
+        self.default_analyzer = default_analyzer
+        self.nested_paths: List[str] = []
+        self._source_enabled = True
+        self._all_enabled = False
+        self.dynamic_templates: List[dict] = []
+        self.meta: dict = {}
+        if mapping_json:
+            self.merge(mapping_json)
+
+    # -- parsing ---------------------------------------------------------------
+
+    def merge(self, mapping_json: dict):
+        """Merge a mapping JSON body ({"properties": {...}} or {"<type>": {...}})."""
+        body = mapping_json
+        if "properties" not in body and len(body) == 1:
+            # {"my_type": {"properties": ...}} form
+            only = next(iter(body.values()))
+            if isinstance(only, dict) and ("properties" in only or "dynamic" in only):
+                body = only
+        if "dynamic" in body:
+            self.dynamic = body["dynamic"]
+        if "_source" in body:
+            self._source_enabled = body["_source"].get("enabled", True)
+        if "_all" in body:
+            self._all_enabled = body["_all"].get("enabled", False)
+        if "_meta" in body:
+            self.meta = body["_meta"]
+        if "dynamic_templates" in body:
+            self.dynamic_templates = list(body["dynamic_templates"])
+        self._parse_properties(body.get("properties", {}), prefix="", nested_path=None)
+
+    def _parse_properties(self, props: dict, prefix: str, nested_path: Optional[str]):
+        for name, p in props.items():
+            if not isinstance(p, dict):
+                raise MapperParsingException(f"invalid mapping for field [{name}]")
+            full = f"{prefix}{name}"
+            t = _canonical_type(p)
+            if t in ("object", "nested") or ("properties" in p and "type" not in p):
+                np = nested_path
+                if t == "nested":
+                    np = full
+                    if full not in self.nested_paths:
+                        self.nested_paths.append(full)
+                self._parse_properties(p.get("properties", {}), prefix=f"{full}.", nested_path=np)
+                continue
+            self.fields[full] = self._parse_field(full, t, p, nested_path)
+
+    def _parse_field(self, full: str, t: str, p: dict, nested_path: Optional[str]) -> FieldMapping:
+        fm = FieldMapping(
+            name=full,
+            type=t,
+            analyzer=p.get("analyzer", self.default_analyzer),
+            search_analyzer=p.get("search_analyzer"),
+            index=p.get("index", True) not in (False, "no", "false"),
+            doc_values=p.get("doc_values", t != "text"),
+            store=p.get("store", False) in (True, "yes", "true"),
+            boost=float(p.get("boost", 1.0)),
+            null_value=p.get("null_value"),
+            fmt=p.get("format", "strict_date_optional_time||epoch_millis"),
+            dims=int(p.get("dims", p.get("dimension", 0) or 0)),
+            similarity=p.get("similarity", "cosine"),
+            copy_to=list(p.get("copy_to", []) if isinstance(p.get("copy_to", []), list) else [p["copy_to"]]),
+            nested=nested_path is not None,
+            nested_path=nested_path,
+            ignore_above=int(p.get("ignore_above", 0)),
+            scaling_factor=float(p.get("scaling_factor", 1.0)),
+        )
+        if t == "dense_vector" and fm.dims <= 0:
+            raise MapperParsingException(f"dense_vector field [{full}] requires [dims]")
+        for sub, subp in p.get("fields", {}).items():
+            st = _canonical_type(subp)
+            fm.fields[sub] = self._parse_field(f"{full}.{sub}", st, subp, nested_path)
+        return fm
+
+    # -- dynamic mapping -------------------------------------------------------
+
+    def dynamic_map(self, name: str, value: Any) -> Optional[FieldMapping]:
+        """Infer a mapping for an unseen field (DocumentMapper dynamic mapping)."""
+        if self.dynamic == "strict":
+            raise MapperParsingException(f"mapping set to strict, dynamic introduction of [{name}] not allowed")
+        if self.dynamic in (False, "false"):
+            return None
+        for tmpl in self.dynamic_templates:
+            ((_, spec),) = tmpl.items()
+            match = spec.get("match", "*")
+            mm = spec.get("match_mapping_type")
+            import fnmatch
+
+            if fnmatch.fnmatch(name.split(".")[-1], match) and (
+                mm is None or mm == _json_type(value) or mm == "*"
+            ):
+                p = dict(spec.get("mapping", {}))
+                t = _canonical_type(p) if "type" in p else _infer_type(value)
+                fm = self._parse_field(name, t, p, None)
+                self.fields[name] = fm
+                return fm
+        t = _infer_type(value)
+        if t is None:
+            return None
+        fm = self._parse_field(name, t, {}, None)
+        if t == "text":
+            # ES dynamic strings get a `.keyword` sub-field (modern default)
+            fm.fields["keyword"] = self._parse_field(f"{name}.keyword", "keyword", {"ignore_above": 256}, None)
+        self.fields[name] = fm
+        return fm
+
+    def get(self, name: str) -> Optional[FieldMapping]:
+        fm = self.fields.get(name)
+        if fm is not None:
+            return fm
+        # multi-field lookup: "title.keyword"
+        if "." in name:
+            parent, _, sub = name.rpartition(".")
+            pf = self.fields.get(parent)
+            if pf and sub in pf.fields:
+                return pf.fields[sub]
+        return None
+
+    def all_fields(self) -> List[FieldMapping]:
+        out = []
+        for fm in self.fields.values():
+            out.append(fm)
+            out.extend(fm.fields.values())
+        return out
+
+    # -- value normalization ---------------------------------------------------
+
+    def normalize_value(self, fm: FieldMapping, value: Any):
+        """Normalize a JSON value for indexing/doc-values per field type."""
+        if value is None:
+            value = fm.null_value
+            if value is None:
+                return None
+        t = fm.type
+        try:
+            if t == "token_count":
+                return value  # counted against the analyzer in DocumentParser
+            if t in ("long", "integer", "short", "byte"):
+                return int(value)
+            if t in ("double", "float", "half_float"):
+                return float(value)
+            if t == "scaled_float":
+                return float(value)
+            if t == "boolean":
+                if isinstance(value, str):
+                    return value in ("true", "True", "1", "on", "yes")
+                return bool(value)
+            if t == "date":
+                return parse_date(value, fm.fmt)
+            if t == "ip":
+                addr = ipaddress.ip_address(value)
+                if addr.version != 4:
+                    # ES 2.0's ip type is IPv4-only (IpFieldMapper stores a long)
+                    raise ValueError("ip fields accept IPv4 only")
+                return int(addr)
+            if t == "murmur3":
+                return _murmur3(str(value))
+            if t == "geo_point":
+                return _parse_geo_point(value)
+            if t == "dense_vector":
+                vec = [float(x) for x in value]
+                if len(vec) != fm.dims:
+                    raise MapperParsingException(
+                        f"dense_vector [{fm.name}] has {len(vec)} dims, mapping says {fm.dims}"
+                    )
+                return vec
+            return value
+        except (ValueError, TypeError) as e:
+            raise MapperParsingException(f"failed to parse field [{fm.name}] of type [{t}]: {e}")
+
+    def to_json(self) -> dict:
+        props: dict = {}
+        for fm in self.fields.values():
+            props[fm.name] = _field_to_json(fm)
+        return {"properties": props, "dynamic": self.dynamic}
+
+
+def _field_to_json(fm: FieldMapping) -> dict:
+    out: dict = {"type": fm.type}
+    if fm.is_text and fm.analyzer != "standard":
+        out["analyzer"] = fm.analyzer
+    if fm.type == "date":
+        out["format"] = fm.fmt
+    if fm.type == "dense_vector":
+        out["dims"] = fm.dims
+        out["similarity"] = fm.similarity
+    if fm.fields:
+        out["fields"] = {sub.rpartition(".")[2] if "." in sub else sub: _field_to_json(sf)
+                         for sub, sf in fm.fields.items()}
+    return out
+
+
+def _json_type(value: Any) -> str:
+    if isinstance(value, bool):
+        return "boolean"
+    if isinstance(value, int):
+        return "long"
+    if isinstance(value, float):
+        return "double"
+    if isinstance(value, str):
+        return "string"
+    return "object"
+
+
+def _infer_type(value: Any):
+    if isinstance(value, bool):
+        return "boolean"
+    if isinstance(value, int):
+        return "long"
+    if isinstance(value, float):
+        return "double"
+    if isinstance(value, str):
+        # date detection like DocumentMapper.dateDetection
+        try:
+            parse_date(value, "strict_date_optional_time")
+            return "date"
+        except ValueError:
+            return "text"
+    if isinstance(value, list):
+        return _infer_type(value[0]) if value else None
+    return None
+
+
+def _murmur3(s: str) -> int:
+    """murmur3 x86 32-bit over utf-8 (Murmur3FieldMapper stores the hash)."""
+    data = s.encode("utf-8")
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = 0
+    n = len(data) // 4 * 4
+    for i in range(0, n, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[n:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def _parse_geo_point(value: Any):
+    """Accept {"lat":..,"lon":..}, "lat,lon", [lon, lat] (GeoJSON order)."""
+    if isinstance(value, dict):
+        return (float(value["lat"]), float(value["lon"]))
+    if isinstance(value, str):
+        lat, lon = value.split(",")
+        return (float(lat), float(lon))
+    if isinstance(value, (list, tuple)):
+        lon, lat = value[0], value[1]
+        return (float(lat), float(lon))
+    raise ValueError(f"cannot parse geo_point [{value}]")

@@ -1,0 +1,20 @@
+from elasticsearch_tpu.ops.scoring import (
+    bm25_score_segment,
+    bm25_score_batch,
+    term_mask,
+    topk_with_mask,
+    range_mask_f32,
+    range_mask_i64pair,
+)
+from elasticsearch_tpu.ops.knn import knn_scores, knn_topk
+
+__all__ = [
+    "bm25_score_segment",
+    "bm25_score_batch",
+    "term_mask",
+    "topk_with_mask",
+    "range_mask_f32",
+    "range_mask_i64pair",
+    "knn_scores",
+    "knn_topk",
+]

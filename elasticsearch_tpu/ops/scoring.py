@@ -1,0 +1,186 @@
+"""Core TPU scoring programs.
+
+These replace Lucene's Weight/Scorer doc-at-a-time iterator trees
+(reference: Lucene BM25Similarity via org/elasticsearch/index/similarity/
+BM25SimilarityProvider.java, and the per-segment search loop in
+org/elasticsearch/search/query/QueryPhase.java) with whole-segment dense
+programs:
+
+- ``bm25_score_segment``: T query terms × P-wide postings slices →
+  scatter-add into an f32[D] score vector. P and T are power-of-two
+  buckets; terms with longer postings runs are pre-split into multiple
+  (start, len) chunks by the executor, so one compiled program serves all
+  queries in a shape class. Weights fold idf × boost; tf-normalization is
+  precomputed per posting at index time (impact-style eager scoring).
+- ``term_mask``: same slicing, but produces a bool[D] filter mask.
+- ``topk_with_mask``: masked top-k (scores → (values, doc_ids)).
+- range masks over numeric doc-value columns, including exact 64-bit
+  comparison via (hi, lo) int32 pairs.
+
+All functions are jitted with static shape arguments; callers bucket their
+inputs (see utils.shapes.pow2_bucket).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = jnp.float32(-jnp.inf)
+
+
+# ---------------------------------------------------------------------------
+# postings slicing
+# ---------------------------------------------------------------------------
+
+def _slice_postings(doc_ids, payload, start, length, P: int):
+    """Slice a P-wide window of a term's postings run, handling the edge
+    clamp: lax.dynamic_slice clamps start to nnz_pad - P, so compute the
+    in-window shift and mask accordingly. Returns (docs[P], payload[P], valid[P]).
+    """
+    nnz_pad = doc_ids.shape[0]
+    clamped = jnp.minimum(start, nnz_pad - P)
+    shift = start - clamped
+    docs = lax.dynamic_slice(doc_ids, (clamped,), (P,))
+    pay = lax.dynamic_slice(payload, (clamped,), (P,))
+    idx = jnp.arange(P, dtype=jnp.int32)
+    valid = (idx >= shift) & (idx < shift + length)
+    return docs, pay, valid
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def bm25_score_segment(doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int):
+    """BM25 score vector for one segment.
+
+    doc_ids: i32[nnz_pad] — postings doc ids (padded entries point at D
+        sentinel and carry tfnorm 0, so they contribute nothing).
+    tfnorm:  f32[nnz_pad] — precomputed tf*(k1+1)/(tf+k1*(1-b+b*dl/avg)).
+    starts, lens: i32[T] — per-chunk postings runs (host-computed, bucketed).
+    weights: f32[T] — idf * query boost per chunk.
+    Returns f32[D] scores (0 for non-matching docs).
+    """
+
+    def per_chunk(start, length, w):
+        docs, tfn, valid = _slice_postings(doc_ids, tfnorm, start, length, P)
+        return docs, jnp.where(valid, tfn * w, 0.0)
+
+    docs, contrib = jax.vmap(per_chunk)(starts, lens, weights)  # [T, P]
+    scores = jnp.zeros(D, dtype=jnp.float32)
+    scores = scores.at[docs.reshape(-1)].add(
+        contrib.reshape(-1), mode="drop", indices_are_sorted=False
+    )
+    return scores
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int):
+    """Batched queries: starts/lens/weights are [Q, T] → f32[Q, D]."""
+    f = partial(bm25_score_segment, P=P, D=D)
+    return jax.vmap(lambda s, l, w: f(doc_ids, tfnorm, s, l, w))(starts, lens, weights)
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def match_count_segment(doc_ids, starts, lens, *, P: int, D: int):
+    """Count of matching query *terms* per doc. Each doc id occurs at most
+    once in a term's postings run, so even when a term is split into several
+    (start, len) chunks a matching doc is counted exactly once for that term
+    — the result equals the number of distinct matched terms. Executors
+    compare against the number of distinct query terms (operator:and /
+    minimum_should_match), NOT against T (the chunk count). Returns i32[D]."""
+    ones = jnp.ones_like(starts, dtype=jnp.float32)
+
+    def per_chunk(start, length, w):
+        docs, _, valid = _slice_postings(doc_ids, doc_ids.astype(jnp.float32), start, length, P)
+        return docs, jnp.where(valid, w, 0.0)
+
+    docs, contrib = jax.vmap(per_chunk)(starts, lens, ones)
+    counts = jnp.zeros(D, dtype=jnp.float32)
+    counts = counts.at[docs.reshape(-1)].add(contrib.reshape(-1), mode="drop")
+    return counts.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def term_mask(doc_ids, starts, lens, *, P: int, D: int):
+    """bool[D] mask of docs containing ANY of the T postings chunks
+    (a terms filter; a single term is T=1)."""
+
+    def per_chunk(start, length):
+        docs, _, valid = _slice_postings(doc_ids, doc_ids.astype(jnp.float32), start, length, P)
+        return docs, valid
+
+    docs, valid = jax.vmap(per_chunk)(starts, lens)
+    mask = jnp.zeros(D, dtype=bool)
+    mask = mask.at[docs.reshape(-1)].max(valid.reshape(-1), mode="drop")
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# doc-value masks
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def range_mask_f32(values, exists, lo, hi, include_lo, include_hi):
+    """Range filter over an f32 column. lo/hi are f32 scalars (±inf for open)."""
+    ge = jnp.where(include_lo, values >= lo, values > lo)
+    le = jnp.where(include_hi, values <= hi, values < hi)
+    return ge & le & exists
+
+
+@jax.jit
+def range_mask_i64pair(hi_col, lo_col, exists, lo_hi, lo_lo, hi_hi, hi_lo, include_lo, include_hi):
+    """Exact 64-bit range over (hi, lo) int32 pair columns (lexicographic)."""
+    def ge_pair(ah, al, bh, bl):
+        return (ah > bh) | ((ah == bh) & (al >= bl))
+
+    def gt_pair(ah, al, bh, bl):
+        return (ah > bh) | ((ah == bh) & (al > bl))
+
+    ge = jnp.where(include_lo, ge_pair(hi_col, lo_col, lo_hi, lo_lo), gt_pair(hi_col, lo_col, lo_hi, lo_lo))
+    le = jnp.where(include_hi, ge_pair(hi_hi, hi_lo, hi_col, lo_col), gt_pair(hi_hi, hi_lo, hi_col, lo_col))
+    return ge & le & exists
+
+
+# ---------------------------------------------------------------------------
+# top-k
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("k",))
+def topk_with_mask(scores, mask, *, k: int):
+    """(values f32[k], indices i32[k]) of the top-k masked scores.
+    Masked-out docs get -inf; callers treat -inf as 'no hit'."""
+    masked = jnp.where(mask, scores, NEG_INF)
+    vals, idx = lax.top_k(masked, k)
+    return vals, idx.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def topk_batch(scores, mask, *, k: int):
+    """Batched: scores [Q, D], mask [D] or [Q, D] → ([Q,k], [Q,k])."""
+    masked = jnp.where(mask, scores, NEG_INF)
+    vals, idx = lax.top_k(masked, k)
+    return vals, idx.astype(jnp.int32)
+
+
+@jax.jit
+def count_mask(mask):
+    return jnp.sum(mask.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# per-field segment reductions (aggregation building blocks)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def bucket_sum(values, bucket_ids, weights, *, num_buckets: int):
+    """segment-sum of values*weights into num_buckets (ordinal reductions)."""
+    contrib = values * weights
+    out = jnp.zeros(num_buckets, dtype=jnp.float32)
+    return out.at[bucket_ids].add(contrib, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def bucket_count(bucket_ids, weights, *, num_buckets: int):
+    out = jnp.zeros(num_buckets, dtype=jnp.float32)
+    return out.at[bucket_ids].add(weights, mode="drop")

@@ -1,0 +1,24 @@
+from elasticsearch_tpu.utils.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    IndexNotFoundException,
+    DocumentMissingException,
+    VersionConflictException,
+    MapperParsingException,
+    QueryParsingException,
+    SearchParseException,
+)
+from elasticsearch_tpu.utils.shapes import pow2_bucket, pad_to
+
+__all__ = [
+    "ElasticsearchTpuException",
+    "IllegalArgumentException",
+    "IndexNotFoundException",
+    "DocumentMissingException",
+    "VersionConflictException",
+    "MapperParsingException",
+    "QueryParsingException",
+    "SearchParseException",
+    "pow2_bucket",
+    "pad_to",
+]

@@ -1,0 +1,81 @@
+"""Exception hierarchy mirroring the reference's.
+
+Reference: org/elasticsearch/ElasticsearchException.java and subclasses
+(ElasticsearchIllegalArgumentException.java, index/engine/
+VersionConflictEngineException.java, index/mapper/MapperParsingException.java,
+index/query/QueryParsingException.java, search/SearchParseException.java).
+Each carries an HTTP status so the REST layer can map errors the same way
+ES's RestStatus does.
+"""
+
+
+class ElasticsearchTpuException(Exception):
+    status = 500
+
+    @property
+    def error_type(self) -> str:
+        # e.g. VersionConflictException -> version_conflict_exception
+        name = type(self).__name__
+        out = []
+        for i, ch in enumerate(name):
+            if ch.isupper() and i > 0:
+                out.append("_")
+            out.append(ch.lower())
+        return "".join(out)
+
+
+class IllegalArgumentException(ElasticsearchTpuException):
+    status = 400
+
+
+class IndexNotFoundException(ElasticsearchTpuException):
+    status = 404
+
+    def __init__(self, index: str):
+        super().__init__(f"no such index [{index}]")
+        self.index = index
+
+
+class IndexAlreadyExistsException(ElasticsearchTpuException):
+    status = 400
+
+    def __init__(self, index: str):
+        super().__init__(f"index [{index}] already exists")
+        self.index = index
+
+
+class DocumentMissingException(ElasticsearchTpuException):
+    status = 404
+
+    def __init__(self, index: str, doc_id: str):
+        super().__init__(f"[{index}][{doc_id}]: document missing")
+        self.index = index
+        self.doc_id = doc_id
+
+
+class VersionConflictException(ElasticsearchTpuException):
+    status = 409
+
+    def __init__(self, index: str, doc_id: str, current: int, expected: int):
+        super().__init__(
+            f"[{index}][{doc_id}]: version conflict, current version [{current}] "
+            f"is different than the one provided [{expected}]"
+        )
+        self.current = current
+        self.expected = expected
+
+
+class MapperParsingException(ElasticsearchTpuException):
+    status = 400
+
+
+class QueryParsingException(ElasticsearchTpuException):
+    status = 400
+
+
+class SearchParseException(ElasticsearchTpuException):
+    status = 400
+
+
+class ScriptException(ElasticsearchTpuException):
+    status = 400

@@ -1,0 +1,87 @@
+from elasticsearch_tpu.analysis.analyzer import get_analyzer, build_custom_analyzer
+from elasticsearch_tpu.analysis.filters import porter_stem, shingle_filter
+from elasticsearch_tpu.analysis.tokenizers import (
+    standard_tokenizer,
+    path_hierarchy_tokenizer,
+    edge_ngram_tokenizer,
+)
+from elasticsearch_tpu.analysis.char_filters import html_strip
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+
+
+def test_standard_analyzer():
+    an = get_analyzer("standard")
+    assert an.tokens("The Quick-Brown Fox, jumped!") == ["the", "quick", "brown", "fox", "jumped"]
+
+
+def test_standard_positions_and_gaps():
+    an = get_analyzer("english")
+    toks = an.analyze("the quick fox")  # "the" is a stopword -> position gap
+    assert toks == [("quick", 1), ("fox", 2)]
+
+
+def test_english_stemming():
+    an = get_analyzer("english")
+    assert an.tokens("running runs runner") == ["run", "run", "runner"]
+
+
+def test_porter_classic_vectors():
+    vectors = {
+        "caresses": "caress", "ponies": "poni", "ties": "ti", "caress": "caress",
+        "cats": "cat", "feed": "feed", "agreed": "agre", "plastered": "plaster",
+        "motoring": "motor", "sing": "sing", "conflated": "conflat",
+        "troubled": "troubl", "sized": "size", "hopping": "hop", "falling": "fall",
+        "happy": "happi", "relational": "relat", "conditional": "condit",
+        "vietnamization": "vietnam", "predication": "predic",
+        "triplicate": "triplic", "formative": "form", "electrical": "electr",
+        "hopefulness": "hope", "goodness": "good", "revival": "reviv",
+        "allowance": "allow", "inference": "infer", "adjustable": "adjust",
+        "defensible": "defens", "effective": "effect", "probate": "probat",
+        "rate": "rate", "cease": "ceas", "controll": "control", "roll": "roll",
+    }
+    for w, want in vectors.items():
+        assert porter_stem(w) == want, (w, porter_stem(w), want)
+
+
+def test_keyword_whitespace_simple():
+    assert get_analyzer("keyword").tokens("New York") == ["New York"]
+    assert get_analyzer("whitespace").tokens("a-b c") == ["a-b", "c"]
+    assert get_analyzer("simple").tokens("a1 b2-c") == ["a", "b", "c"]
+
+
+def test_html_strip():
+    assert html_strip("<p>Hello &amp; <b>world</b></p>").split() == ["Hello", "&", "world"]
+
+
+def test_custom_analyzer_with_shared_filters():
+    reg = AnalysisRegistry(
+        {
+            "analysis": {
+                "filter": {"my_stop": {"type": "stop", "stopwords": ["foo"]}},
+                "analyzer": {
+                    "my_an": {"tokenizer": "standard", "filter": ["lowercase", "my_stop"]}
+                },
+            }
+        }
+    )
+    assert reg.get("my_an").tokens("Foo BAR baz") == ["bar", "baz"]
+
+
+def test_shingles():
+    toks = [("quick", 0), ("brown", 1), ("fox", 2)]
+    out = [t for t, _ in shingle_filter(toks)]
+    assert out == ["quick", "quick brown", "brown", "brown fox", "fox"]
+
+
+def test_edge_ngram_and_path_hierarchy():
+    assert [t for t, _ in edge_ngram_tokenizer("quick", 1, 3)] == ["q", "qu", "qui"]
+    assert [t for t, _ in path_hierarchy_tokenizer("/a/b/c")] == ["/a", "/a/b", "/a/b/c"]
+
+
+def test_synonyms():
+    an = build_custom_analyzer(
+        "syn",
+        {"tokenizer": "whitespace", "filter": ["lowercase", "my_syn"]},
+        {"filter": {"my_syn": {"type": "synonym", "synonyms": ["usa, united states => america"]}}},
+    )
+    assert an.tokens("USA rules") == ["america", "rules"]

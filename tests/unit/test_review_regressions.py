@@ -1,0 +1,86 @@
+"""Regression tests for code-review findings."""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.char_filters import html_strip
+from elasticsearch_tpu.analysis.analyzer import build_custom_analyzer
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.doc_parser import DocumentParser
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.utils.dates import parse_date
+from elasticsearch_tpu.utils.errors import MapperParsingException
+
+
+def test_token_count_counts_tokens():
+    m = Mappings({"properties": {"nc": {"type": "token_count", "analyzer": "standard"}}})
+    parser = DocumentParser(m, AnalysisRegistry())
+    p = parser.parse("1", {"nc": "New York City"})
+    assert p.doc_values["nc"] == [3]
+
+
+def test_ipv6_rejected_cleanly():
+    m = Mappings({"properties": {"addr": {"type": "ip"}}})
+    parser = DocumentParser(m, AnalysisRegistry())
+    with pytest.raises(MapperParsingException):
+        parser.parse("1", {"addr": "2001:db8::1"})
+    p = parser.parse("2", {"addr": "192.168.0.1"})
+    assert p.doc_values["addr"] == [(192 << 24) + (168 << 16) + 1]
+
+
+def test_multiword_synonym():
+    an = build_custom_analyzer(
+        "syn",
+        {"tokenizer": "whitespace", "filter": ["lowercase", "s"]},
+        {"filter": {"s": {"type": "synonym", "synonyms": ["united states, usa => america"]}}},
+    )
+    assert an.tokens("the united states rules") == ["the", "america", "rules"]
+    assert an.tokens("usa rules") == ["america", "rules"]
+    assert an.tokens("united kingdom") == ["united", "kingdom"]
+
+
+def test_multiword_synonym_output_splits_tokens():
+    an = build_custom_analyzer(
+        "syn",
+        {"tokenizer": "whitespace", "filter": ["lowercase", "s"]},
+        {"filter": {"s": {"type": "synonym", "synonyms": ["nyc => new york"]}}},
+    )
+    assert an.analyze("nyc rules") == [("new", 0), ("york", 1), ("rules", 1)]
+
+
+def test_html_strip_no_double_decode():
+    assert html_strip("&amp;lt;b&amp;gt;") == "&lt;b&gt;"
+
+
+def test_date_hour_only():
+    assert parse_date("2015-01-01T12") == parse_date("2015-01-01") + 12 * 3600 * 1000
+
+
+def test_date_column_offset_precision():
+    m = Mappings({"properties": {"ts": {"type": "date"}}})
+    parser = DocumentParser(m, AnalysisRegistry())
+    b = SegmentBuilder(m)
+    base = parse_date("2026-07-29T00:00:00Z")
+    for i in range(4):
+        b.add(parser.parse(str(i), {"ts": base + i * 1000}))  # 1s apart
+    seg = b.freeze()
+    col = seg.numerics["ts"]
+    # f32 channel must resolve 1s differences (raw millis f32 could not);
+    # consumers add offset back in f64 space
+    rel = np.asarray(col.values)[:4].astype(np.float64)
+    assert np.diff(rel).tolist() == [1000.0, 1000.0, 1000.0]
+    assert rel[2] + col.offset == base + 2000
+    assert col.exact[2] == base + 2000
+
+
+def test_lazy_live_mask_refresh():
+    m = Mappings({"properties": {"t": {"type": "text"}}})
+    parser = DocumentParser(m, AnalysisRegistry())
+    b = SegmentBuilder(m)
+    for i in range(3):
+        b.add(parser.parse(str(i), {"t": "x"}))
+    seg = b.freeze()
+    seg.delete_local(0)
+    seg.delete_local(2)
+    live = np.asarray(seg.live)
+    assert live[:3].tolist() == [False, True, False]

@@ -1,0 +1,219 @@
+import math
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.doc_parser import DocumentParser
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder, K1, B, split_i64
+from elasticsearch_tpu.utils.shapes import pow2_bucket
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "quick brown foxes leap over lazy dogs in summer",
+    "the rain in spain stays mainly in the plain",
+    "quick wit beats slow brawn",
+    "dogs and cats living together",
+]
+
+
+def build_segment(docs=DOCS, analyzer="standard"):
+    mappings = Mappings({"properties": {"body": {"type": "text", "analyzer": analyzer}}})
+    reg = AnalysisRegistry()
+    parser = DocumentParser(mappings, reg)
+    builder = SegmentBuilder(mappings)
+    for i, text in enumerate(docs):
+        builder.add(parser.parse(str(i), {"body": text}))
+    return builder.freeze(), reg
+
+
+def bm25_oracle(docs, query_terms, analyzer_tokens):
+    """Independent BM25 (Lucene 5 formula) in pure python."""
+    toks = [analyzer_tokens(d) for d in docs]
+    N = len(docs)
+    avg = sum(len(t) for t in toks) / N
+    scores = [0.0] * N
+    for term in query_terms:
+        df = sum(1 for t in toks if term in t)
+        if df == 0:
+            continue
+        idf = math.log(1 + (N - df + 0.5) / (df + 0.5))
+        for i, t in enumerate(toks):
+            tf = t.count(term)
+            if tf == 0:
+                continue
+            tfn = tf * (K1 + 1) / (tf + K1 * (1 - B + B * len(t) / avg))
+            scores[i] += idf * tfn
+    return scores
+
+
+def test_segment_structure():
+    seg, _ = build_segment()
+    assert seg.num_docs == 5
+    assert seg.max_docs == 64
+    inv = seg.inverted["body"]
+    assert inv.vocab["quick"] >= 0
+    assert int(inv.df[inv.vocab["quick"]]) == 3
+    assert int(inv.df[inv.vocab["the"]]) == 2
+    start, ln = inv.term_slice("quick")
+    docs = np.asarray(inv.doc_ids)[start : start + ln]
+    assert sorted(docs.tolist()) == [0, 1, 3]
+
+
+def test_bm25_matches_oracle():
+    from elasticsearch_tpu.ops.scoring import bm25_score_segment
+
+    seg, reg = build_segment()
+    inv = seg.inverted["body"]
+    an = reg.get("standard")
+    qterms = ["quick", "dogs"]
+    starts, lens, weights = [], [], []
+    for t in qterms:
+        s, ln = inv.term_slice(t)
+        starts.append(s)
+        lens.append(ln)
+        weights.append(inv.idf(t))
+    P = pow2_bucket(max(lens))
+    scores = bm25_score_segment(
+        inv.doc_ids,
+        inv.tfnorm,
+        np.array(starts, np.int32),
+        np.array(lens, np.int32),
+        np.array(weights, np.float32),
+        P=P,
+        D=seg.max_docs,
+    )
+    got = np.asarray(scores)[: seg.num_docs]
+    want = bm25_oracle(DOCS, qterms, lambda d: an.tokens(d))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_bm25_chunk_splitting_equivalence():
+    """A term split into 2 chunks must score identically to 1 chunk."""
+    from elasticsearch_tpu.ops.scoring import bm25_score_segment
+
+    seg, _ = build_segment()
+    inv = seg.inverted["body"]
+    s, ln = inv.term_slice("quick")
+    assert ln == 3
+    w = inv.idf("quick")
+    one = bm25_score_segment(
+        inv.doc_ids, inv.tfnorm,
+        np.array([s], np.int32), np.array([ln], np.int32), np.array([w], np.float32),
+        P=4, D=seg.max_docs,
+    )
+    two = bm25_score_segment(
+        inv.doc_ids, inv.tfnorm,
+        np.array([s, s + 2], np.int32), np.array([2, 1], np.int32),
+        np.array([w, w], np.float32),
+        P=2, D=seg.max_docs,
+    )
+    np.testing.assert_allclose(np.asarray(one), np.asarray(two), rtol=1e-6)
+
+
+def test_term_mask_and_topk():
+    from elasticsearch_tpu.ops.scoring import term_mask, topk_with_mask, bm25_score_segment
+
+    seg, _ = build_segment()
+    inv = seg.inverted["body"]
+    s, ln = inv.term_slice("dogs")
+    mask = term_mask(
+        inv.doc_ids, np.array([s], np.int32), np.array([ln], np.int32), P=8, D=seg.max_docs
+    )
+    m = np.asarray(mask)
+    assert m[[1, 4]].all() and m.sum() == 2
+
+    s2, l2 = inv.term_slice("quick")
+    scores = bm25_score_segment(
+        inv.doc_ids, inv.tfnorm,
+        np.array([s2], np.int32), np.array([l2], np.int32),
+        np.array([1.0], np.float32), P=8, D=seg.max_docs,
+    )
+    vals, idx = topk_with_mask(scores, mask & seg.live, k=3)
+    vals, idx = np.asarray(vals), np.asarray(idx)
+    assert idx[0] == 1 and vals[0] > 0
+    assert vals[1] == 0.0 and idx[1] == 4  # filter-only match scores 0
+    assert not np.isfinite(vals[2])  # no third match
+
+
+def test_delete_updates_live_mask():
+    seg, _ = build_segment()
+    assert seg.delete_local(1)
+    assert not seg.delete_local(1)
+    assert seg.live_docs == 4
+    assert not np.asarray(seg.live)[1]
+
+
+def test_split_i64_order():
+    vals = np.array([-(2**62), -1, 0, 1, 2**31, 2**62], dtype=np.int64)
+    hi, lo = split_i64(vals)
+    packed = list(zip(hi.tolist(), lo.tolist()))
+    assert packed == sorted(packed)
+
+
+def test_keyword_and_numeric_columns():
+    mappings = Mappings(
+        {
+            "properties": {
+                "tag": {"type": "keyword"},
+                "n": {"type": "long"},
+                "price": {"type": "double"},
+            }
+        }
+    )
+    reg = AnalysisRegistry()
+    parser = DocumentParser(mappings, reg)
+    b = SegmentBuilder(mappings)
+    rows = [
+        {"tag": "red", "n": 10, "price": 1.5},
+        {"tag": "blue", "n": 2**40, "price": 2.5},
+        {"tag": ["red", "green"], "n": -5},
+    ]
+    for i, r in enumerate(rows):
+        b.add(parser.parse(str(i), r))
+    seg = b.freeze()
+    kw = seg.keywords["tag"]
+    inv = seg.inverted["tag"]
+    assert inv.terms == ["blue", "green", "red"]
+    s, ln = inv.term_slice("red")
+    assert sorted(np.asarray(inv.doc_ids)[s : s + ln].tolist()) == [0, 2]
+    assert np.asarray(kw.ords)[1] == 0  # "blue"
+    col = seg.numerics["n"]
+    assert col.exact[1] == 2**40
+    assert col.hi is not None
+    pr = seg.numerics["price"]
+    assert np.asarray(pr.exists)[:3].tolist() == [True, True, False]
+
+
+def test_knn_ops_match_numpy():
+    from elasticsearch_tpu.ops.knn import knn_topk, knn_topk_chunked
+
+    rng = np.random.default_rng(0)
+    D, dims, Q, k = 256, 32, 4, 5
+    vecs = rng.standard_normal((D, dims)).astype(np.float32)
+    queries = rng.standard_normal((Q, dims)).astype(np.float32)
+    mask = np.ones(D, dtype=bool)
+
+    vals, idx = knn_topk(queries, vecs, mask, k=k, metric="cosine", use_bf16=False)
+    qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+    sim = (1 + qn @ vn.T) / 2
+    want_idx = np.argsort(-sim, axis=1)[:, :k]
+    assert (np.asarray(idx) == want_idx).mean() > 0.95  # ties may reorder
+
+    cvals, cidx = knn_topk_chunked(queries, vecs, mask, k=k, metric="cosine", chunk=64, use_bf16=False)
+    np.testing.assert_allclose(np.sort(np.asarray(cvals)), np.sort(np.asarray(vals)), rtol=1e-5)
+
+
+def test_knn_l2_and_dot():
+    from elasticsearch_tpu.ops.knn import knn_scores
+
+    rng = np.random.default_rng(1)
+    vecs = rng.standard_normal((16, 8)).astype(np.float32)
+    q = rng.standard_normal((2, 8)).astype(np.float32)
+    s = np.asarray(knn_scores(q, vecs, metric="l2_norm", use_bf16=False))
+    d2 = ((q[:, None, :] - vecs[None, :, :]) ** 2).sum(-1)
+    np.testing.assert_allclose(s, 1 / (1 + d2), rtol=2e-3, atol=1e-4)
+    sd = np.asarray(knn_scores(q, vecs, metric="dot_product", use_bf16=False))
+    np.testing.assert_allclose(sd, (1 + q @ vecs.T) / 2, rtol=1e-4)
