@@ -91,6 +91,10 @@ class InvertedField:
     # positions: host CSR aligned with postings order (for phrase/span)
     pos_offsets: Optional[np.ndarray] = None  # int64[nnz+1]
     positions: Optional[np.ndarray] = None  # int32[total_positions]
+    # host mirror of unpadded doc_ids (phrase verification, merges)
+    doc_ids_host: Optional[np.ndarray] = None
+    # lazy cache: sorted terms for prefix/wildcard expansion
+    _sorted_terms: Any = None
     # device positional CSR (padded) — built lazily for phrase programs
     _pos_dev: Any = None
 
@@ -416,6 +420,7 @@ class SegmentBuilder:
             avg_len=avg_len,
             pos_offsets=pos_offsets,
             positions=np.array(positions_flat, dtype=np.int32),
+            doc_ids_host=doc_ids,
         )
 
     def _build_keyword(self, fname: str, n: int, max_docs: int):
@@ -483,6 +488,7 @@ class SegmentBuilder:
             num_docs=int(exists.sum()),
             total_terms=nnz,
             avg_len=1.0,
+            doc_ids_host=doc_ids,
         )
         kwcol = KeywordColumn(
             name=fname,

@@ -1,0 +1,3 @@
+from elasticsearch_tpu.search.aggregations.base import parse_aggs, run_aggs, reduce_aggs
+
+__all__ = ["parse_aggs", "run_aggs", "reduce_aggs"]

@@ -1,0 +1,132 @@
+"""Aggregation framework.
+
+Reference: org/elasticsearch/search/aggregations/ — AggregatorFactories.java
+parse tree, Aggregator.java collect model, InternalAggregation.java reduce
+phase. Execution model here:
+
+1. ``parse_aggs(dsl)`` builds a tree of Aggregator objects.
+2. Per segment, ``agg.collect(ctx, mask)`` computes a *partial* — numeric
+   reductions happen on device (masked sums / segment_sum over ordinals),
+   then come to host as small arrays (bucket counts, sums — never per-doc).
+3. ``agg.reduce(partials)`` merges partials across segments/shards into the
+   ES-shaped JSON response. Partials are designed to be mergeable (sum-able
+   counters, HLL registers max, min/max, sample lists), matching the role of
+   ES's InternalAggregation.reduce.
+
+Bucket aggregators compute sub-aggregations by narrowing the doc mask to
+each selected bucket (shard_size-style top buckets per shard), mirroring
+BucketsAggregator's per-bucket doc collection.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.utils.errors import SearchParseException
+
+# registry: agg type name -> factory(name, body, sub_factories)
+_REGISTRY: Dict[str, Any] = {}
+
+
+def register(name):
+    def deco(cls):
+        _REGISTRY[name] = cls
+        return cls
+
+    return deco
+
+
+class Aggregator:
+    """Base aggregator: one node of the agg tree."""
+
+    def __init__(self, name: str, body: dict, subs: Optional[List["Aggregator"]] = None):
+        self.name = name
+        self.body = body
+        self.subs = subs or []
+
+    def collect(self, ctx, mask) -> Any:
+        """Compute this segment's partial for docs selected by ``mask``."""
+        raise NotImplementedError
+
+    def reduce(self, partials: List[Any]) -> dict:
+        """Merge partials from all segments/shards into response JSON."""
+        raise NotImplementedError
+
+    # helper for bucket aggs
+    def collect_subs(self, ctx, mask) -> Dict[str, Any]:
+        return {s.name: s.collect(ctx, mask) for s in self.subs}
+
+    def reduce_subs(self, partial_dicts: List[Dict[str, Any]]) -> Dict[str, Any]:
+        out = {}
+        for s in self.subs:
+            out[s.name] = s.reduce([p[s.name] for p in partial_dicts if p is not None])
+        return out
+
+
+def parse_aggs(dsl: Optional[dict]) -> List[Aggregator]:
+    """Parse {"name": {"<type>": {...}, "aggs": {...}}, ...} into a tree."""
+    # imports register the factories
+    from elasticsearch_tpu.search.aggregations import metrics as _m  # noqa: F401
+    from elasticsearch_tpu.search.aggregations import bucket as _b  # noqa: F401
+
+    if not dsl:
+        return []
+    out = []
+    for name, spec in dsl.items():
+        sub_spec = spec.get("aggs", spec.get("aggregations"))
+        subs = parse_aggs(sub_spec)
+        found = None
+        for key, body in spec.items():
+            if key in ("aggs", "aggregations", "meta"):
+                continue
+            cls = _REGISTRY.get(key)
+            if cls is None:
+                raise SearchParseException(f"unknown aggregation type [{key}]")
+            found = cls(name, body or {}, subs)
+            break
+        if found is None:
+            raise SearchParseException(f"aggregation [{name}] has no type")
+        out.append(found)
+    return out
+
+
+def run_aggs(aggs: List[Aggregator], ctx, mask) -> Dict[str, Any]:
+    return {a.name: a.collect(ctx, mask) for a in aggs}
+
+
+def reduce_aggs(aggs: List[Aggregator], partial_dicts: List[Dict[str, Any]]) -> Dict[str, Any]:
+    out = {}
+    for a in aggs:
+        out[a.name] = a.reduce([p[a.name] for p in partial_dicts if p is not None and a.name in p])
+    return out
+
+
+def resolve_values(ctx, body: dict):
+    """Resolve the value source for an agg body: field doc values or script.
+
+    Returns (values f32[D] device incl. offset handling deferred, exists
+    bool[D], offset float, col-or-None). Script sources evaluate vectorized.
+    """
+    import jax.numpy as jnp
+
+    script = body.get("script")
+    if script is not None:
+        from elasticsearch_tpu.search.function_score import doc_resolver
+        from elasticsearch_tpu.search.scripting import compile_script
+
+        src = script if isinstance(script, str) else script.get("inline", script.get("source", ""))
+        params = {} if isinstance(script, str) else script.get("params", {})
+        cs = compile_script(src)
+        vals = cs.run(doc_resolver(ctx), params=params)
+        if not hasattr(vals, "astype"):
+            vals = jnp.full(ctx.D, jnp.float32(vals))
+        return vals.astype(jnp.float32), jnp.ones(ctx.D, dtype=bool), 0.0, None
+    field = body.get("field")
+    if field is None:
+        raise SearchParseException("aggregation requires [field] or [script]")
+    col = ctx.col(field)
+    if col is not None:
+        return col.values, col.exists, col.offset, col
+    kw = ctx.segment.keywords.get(field)
+    if kw is not None:
+        return kw.ords.astype(jnp.float32), kw.exists, 0.0, None
+    return jnp.zeros(ctx.D, dtype=jnp.float32), jnp.zeros(ctx.D, dtype=bool), 0.0, None

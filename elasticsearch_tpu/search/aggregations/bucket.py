@@ -1,0 +1,493 @@
+"""Bucket aggregations.
+
+Reference: org/elasticsearch/search/aggregations/bucket/ — terms/
+(GlobalOrdinalsStringTermsAggregator.java), histogram/HistogramAggregator.java,
+histogram/DateHistogramParser.java, range/RangeAggregator.java, filter/,
+filters/, global/, missing/, significant/ (JLH heuristics), sampler/.
+
+TPU execution: a bucket agg computes per-segment bucket *counts* with one
+``segment_sum`` over ordinals (keyword terms ride the postings term_ids
+array, so multi-valued fields count correctly), then narrows the doc mask
+per selected bucket to run sub-aggregations — the shard_size pattern of
+the reference's deferred collection.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.ops.scoring import bucket_count
+from elasticsearch_tpu.search.aggregations.base import (
+    Aggregator,
+    register,
+    resolve_values,
+)
+from elasticsearch_tpu.utils.dates import format_date, interval_to_millis, parse_date
+from elasticsearch_tpu.utils.errors import SearchParseException
+
+DEFAULT_SIZE = 10
+SHARD_SIZE_MULT = 3
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# terms
+# ---------------------------------------------------------------------------
+
+@register("terms")
+class TermsAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        field = self.body.get("field")
+        if field is None:
+            raise SearchParseException("terms aggregation requires [field]")
+        jnp = _jnp()
+        inv = ctx.inv(field)
+        if inv is not None and field in ctx.segment.keywords:
+            # keyword: postings-based count (multi-value correct)
+            V = inv.vocab_size
+            if V == 0:
+                return {"buckets": {}, "doc_count_error_upper_bound": 0, "sum_other_doc_count": 0}
+            w = mask[inv.doc_ids.clip(0, ctx.D - 1)] & (inv.term_ids < V)
+            counts = bucket_count(inv.term_ids, w.astype(jnp.float32), num_buckets=V + 1)
+            counts = np.asarray(counts[:V]).astype(np.int64)
+            keys = inv.terms
+            key_of = lambda i: keys[i]
+        else:
+            col = ctx.col(field)
+            if col is None:
+                return {"buckets": {}, "doc_count_error_upper_bound": 0, "sum_other_doc_count": 0}
+            # numeric terms: host unique over exact values of selected docs
+            sel = np.asarray(mask & col.exists)
+            vals = col.exact[np.nonzero(sel)[0]]
+            uniq, cnt = np.unique(vals, return_counts=True)
+            keys = uniq.tolist()
+            counts = cnt.astype(np.int64)
+            key_of = lambda i: keys[i]
+
+        size = int(self.body.get("size", DEFAULT_SIZE)) or 2**31
+        shard_size = int(self.body.get("shard_size", size * SHARD_SIZE_MULT))
+        min_dc = int(self.body.get("min_doc_count", 1))
+        order = self.body.get("order", {"_count": "desc"})
+
+        nz = np.nonzero(counts >= max(min_dc, 1))[0]
+        # select top shard_size buckets for sub-agg collection
+        if len(nz) > shard_size:
+            top = nz[np.argsort(-counts[nz], kind="stable")][:shard_size]
+        else:
+            top = nz
+        buckets: Dict[Any, dict] = {}
+        total = int(counts.sum())
+        kept = 0
+        for i in top:
+            key = key_of(int(i))
+            b = {"doc_count": int(counts[i])}
+            kept += b["doc_count"]
+            if self.subs:
+                bmask = self._bucket_mask(ctx, field, key, mask)
+                b["subs"] = self.collect_subs(ctx, bmask)
+            buckets[key] = b
+        return {
+            "buckets": buckets,
+            "sum_other_doc_count": total - kept,
+            "order": order,
+            "doc_count_error_upper_bound": 0,
+        }
+
+    def _bucket_mask(self, ctx, field, key, mask):
+        jnp = _jnp()
+        inv = ctx.inv(field)
+        if inv is not None and field in ctx.segment.keywords:
+            from elasticsearch_tpu.search.queries import _terms_filter_mask
+
+            return mask & _terms_filter_mask(ctx, field, [str(key)])
+        col = ctx.col(field)
+        tgt = jnp.float32(float(key) - col.offset)
+        return mask & col.exists & (col.values == tgt)
+
+    def reduce(self, partials):
+        merged: Dict[Any, dict] = {}
+        other = 0
+        sub_partials: Dict[Any, list] = {}
+        for p in partials:
+            other += p.get("sum_other_doc_count", 0)
+            for key, b in p["buckets"].items():
+                if key in merged:
+                    merged[key]["doc_count"] += b["doc_count"]
+                else:
+                    merged[key] = {"doc_count": b["doc_count"]}
+                if "subs" in b:
+                    sub_partials.setdefault(key, []).append(b["subs"])
+        size = int(self.body.get("size", DEFAULT_SIZE)) or 2**31
+        min_dc = int(self.body.get("min_doc_count", 1))
+        order = self.body.get("order", {"_count": "desc"})
+        (okey, odir), = order.items() if isinstance(order, dict) else [("_count", "desc")]
+        reverse = odir == "desc"
+        items = [(k, v) for k, v in merged.items() if v["doc_count"] >= min_dc]
+        if okey == "_term" or okey == "_key":
+            items.sort(key=lambda kv: kv[0], reverse=reverse)
+        else:
+            items.sort(key=lambda kv: (kv[1]["doc_count"], str(kv[0])), reverse=reverse)
+        dropped = items[size:]
+        other += sum(v["doc_count"] for _, v in dropped)
+        out_buckets = []
+        for k, v in items[:size]:
+            b = {"key": k, "doc_count": v["doc_count"]}
+            if isinstance(k, (int, np.integer, float)):
+                b["key"] = int(k) if float(k).is_integer() else float(k)
+            if k in sub_partials:
+                b.update(self.reduce_subs(sub_partials[k]))
+            out_buckets.append(b)
+        return {
+            "doc_count_error_upper_bound": 0,
+            "sum_other_doc_count": int(other),
+            "buckets": out_buckets,
+        }
+
+
+# ---------------------------------------------------------------------------
+# histogram / date_histogram
+# ---------------------------------------------------------------------------
+
+@register("histogram")
+class HistogramAggregator(Aggregator):
+    date = False
+
+    def _interval(self):
+        iv = self.body.get("interval")
+        if iv is None:
+            raise SearchParseException("histogram requires [interval]")
+        return float(iv)
+
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        vals, exists, offset, col = resolve_values(ctx, self.body)
+        interval = self._interval()
+        sel = exists & mask
+        n_sel = int(jnp.sum(sel.astype(jnp.int32)))
+        if n_sel == 0:
+            return {"buckets": {}}
+        # bucket key = floor(v / interval) — computed in f64-ish host space
+        # for the offset, device f32 for the relative part
+        if col is not None and col.exact is not None:
+            host_sel = np.asarray(sel)
+            keys_exact = np.floor_divide(col.exact[np.nonzero(host_sel)[0]], int(interval)) if float(interval).is_integer() else np.floor(col.exact[np.nonzero(host_sel)[0]] / interval)
+            uniq, cnt = np.unique(keys_exact, return_counts=True)
+            buckets: Dict[float, dict] = {}
+            for k, c in zip(uniq.tolist(), cnt.tolist()):
+                key = float(k) * interval
+                b = {"doc_count": int(c)}
+                if self.subs:
+                    bmask = self._key_mask(ctx, col, vals, exists, key, interval) & mask
+                    b["subs"] = self.collect_subs(ctx, bmask)
+                buckets[key] = b
+            return {"buckets": buckets}
+        # script/float source: device bucketing
+        rel = jnp.floor((vals + jnp.float32(offset)) / jnp.float32(interval))
+        host = np.asarray(jnp.where(sel, rel, jnp.float32(jnp.nan)))
+        host = host[~np.isnan(host)]
+        uniq, cnt = np.unique(host, return_counts=True)
+        buckets = {}
+        for k, c in zip(uniq.tolist(), cnt.tolist()):
+            key = float(k) * interval
+            b = {"doc_count": int(c)}
+            if self.subs:
+                bmask = (rel == jnp.float32(k)) & sel
+                b["subs"] = self.collect_subs(ctx, bmask)
+            buckets[key] = b
+        return {"buckets": buckets}
+
+    def _key_mask(self, ctx, col, vals, exists, key, interval):
+        jnp = _jnp()
+        lo = key - col.offset
+        hi = key + interval - col.offset
+        return exists & (vals >= jnp.float32(lo)) & (vals < jnp.float32(hi))
+
+    def _format_key(self, key):
+        return key
+
+    def reduce(self, partials):
+        merged: Dict[float, int] = {}
+        sub_partials: Dict[float, list] = {}
+        for p in partials:
+            for k, b in p["buckets"].items():
+                merged[k] = merged.get(k, 0) + b["doc_count"]
+                if "subs" in b:
+                    sub_partials.setdefault(k, []).append(b["subs"])
+        min_dc = int(self.body.get("min_doc_count", 0))
+        keys = sorted(merged)
+        out = []
+        interval = self._interval()
+        if keys and min_dc == 0:
+            # ES fills empty buckets between the min and max keys
+            full = []
+            k = keys[0]
+            while k <= keys[-1] + 1e-9:
+                full.append(round(k / interval) * interval if interval else k)
+                k += interval
+            keys = full
+        for k in keys:
+            dc = merged.get(k, 0)
+            if dc < min_dc:
+                continue
+            b = {"key": self._format_key(k), "doc_count": dc}
+            if self.date:
+                b["key_as_string"] = format_date(int(k))
+                b["key"] = int(k)
+            if k in sub_partials:
+                b.update(self.reduce_subs(sub_partials[k]))
+            out.append(b)
+        return {"buckets": out}
+
+
+@register("date_histogram")
+class DateHistogramAggregator(HistogramAggregator):
+    date = True
+
+    def _interval(self):
+        iv = self.body.get("interval") or self.body.get("calendar_interval") or self.body.get("fixed_interval")
+        if iv is None:
+            raise SearchParseException("date_histogram requires [interval]")
+        ms = interval_to_millis(iv)
+        if ms is None:
+            # calendar months/quarters/years handled by month bucketing:
+            # collect() uses exact host millis, so divide by mean month len;
+            # exact calendar boundaries land in R2 (documented deviation)
+            months = {"month": 1, "1M": 1, "M": 1, "quarter": 3, "1q": 3, "q": 3,
+                      "year": 12, "1y": 12, "y": 12}[str(iv)]
+            return months * 2_629_746_000.0  # mean Gregorian month
+        return float(ms)
+
+
+# ---------------------------------------------------------------------------
+# range family
+# ---------------------------------------------------------------------------
+
+@register("range")
+class RangeAggregator(Aggregator):
+    date = False
+
+    def _parse_bound(self, v, fm):
+        if v is None:
+            return None
+        if self.date and isinstance(v, str):
+            return parse_date(v, fm.fmt if fm else "strict_date_optional_time||epoch_millis")
+        return float(v)
+
+    def collect(self, ctx, mask):
+        from elasticsearch_tpu.search.queries import RangeQuery
+
+        field = self.body.get("field")
+        fm = ctx.mappings.get(field) if field else None
+        out: Dict[str, dict] = {}
+        for r in self.body.get("ranges", []):
+            frm = self._parse_bound(r.get("from"), fm)
+            to = self._parse_bound(r.get("to"), fm)
+            key = r.get("key") or f"{r.get('from', '*')}-{r.get('to', '*')}"
+            rq = RangeQuery(field, gte=frm, lt=to)
+            _, rmask = rq.execute(ctx)
+            jnp = _jnp()
+            bmask = mask & rmask
+            b = {"doc_count": int(jnp.sum(bmask.astype(jnp.int32))),
+                 "from": frm, "to": to}
+            if self.subs:
+                b["subs"] = self.collect_subs(ctx, bmask)
+            out[key] = b
+        return {"buckets": out}
+
+    def reduce(self, partials):
+        merged: Dict[str, dict] = {}
+        sub_partials: Dict[str, list] = {}
+        for p in partials:
+            for k, b in p["buckets"].items():
+                if k in merged:
+                    merged[k]["doc_count"] += b["doc_count"]
+                else:
+                    merged[k] = {"doc_count": b["doc_count"], "from": b["from"], "to": b["to"]}
+                if "subs" in b:
+                    sub_partials.setdefault(k, []).append(b["subs"])
+        out = []
+        for k, v in merged.items():
+            b = {"key": k, "doc_count": v["doc_count"]}
+            if v["from"] is not None:
+                b["from"] = v["from"]
+            if v["to"] is not None:
+                b["to"] = v["to"]
+            if k in sub_partials:
+                b.update(self.reduce_subs(sub_partials[k]))
+            out.append(b)
+        return {"buckets": out}
+
+
+@register("date_range")
+class DateRangeAggregator(RangeAggregator):
+    date = True
+
+
+@register("ip_range")
+class IpRangeAggregator(RangeAggregator):
+    def _parse_bound(self, v, fm):
+        if v is None:
+            return None
+        import ipaddress
+
+        return float(int(ipaddress.ip_address(v)))
+
+
+# ---------------------------------------------------------------------------
+# filter / filters / global / missing / sampler / significant_terms
+# ---------------------------------------------------------------------------
+
+@register("filter")
+class FilterAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        from elasticsearch_tpu.search.queries import parse_query
+
+        jnp = _jnp()
+        _, fmask = parse_query(self.body).execute(ctx)
+        bmask = mask & fmask
+        out = {"doc_count": int(jnp.sum(bmask.astype(jnp.int32)))}
+        if self.subs:
+            out["subs"] = self.collect_subs(ctx, bmask)
+        return out
+
+    def reduce(self, partials):
+        out = {"doc_count": sum(p["doc_count"] for p in partials)}
+        subs = [p["subs"] for p in partials if "subs" in p]
+        if subs:
+            out.update(self.reduce_subs(subs))
+        return out
+
+
+@register("filters")
+class FiltersAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        from elasticsearch_tpu.search.queries import parse_query
+
+        jnp = _jnp()
+        specs = self.body.get("filters", {})
+        out = {}
+        items = specs.items() if isinstance(specs, dict) else enumerate(specs)
+        for key, q in items:
+            _, fmask = parse_query(q).execute(ctx)
+            bmask = mask & fmask
+            b = {"doc_count": int(jnp.sum(bmask.astype(jnp.int32)))}
+            if self.subs:
+                b["subs"] = self.collect_subs(ctx, bmask)
+            out[str(key)] = b
+        return {"buckets": out}
+
+    def reduce(self, partials):
+        merged: Dict[str, int] = {}
+        sub_partials: Dict[str, list] = {}
+        for p in partials:
+            for k, b in p["buckets"].items():
+                merged[k] = merged.get(k, 0) + b["doc_count"]
+                if "subs" in b:
+                    sub_partials.setdefault(k, []).append(b["subs"])
+        buckets = {}
+        for k, dc in merged.items():
+            b = {"doc_count": dc}
+            if k in sub_partials:
+                b.update(self.reduce_subs(sub_partials[k]))
+            buckets[k] = b
+        return {"buckets": buckets}
+
+
+@register("global")
+class GlobalAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        gmask = (jnp.arange(ctx.D) < ctx.segment.num_docs) & ctx.segment.live
+        out = {"doc_count": int(jnp.sum(gmask.astype(jnp.int32)))}
+        if self.subs:
+            out["subs"] = self.collect_subs(ctx, gmask)
+        return out
+
+    reduce = FilterAggregator.reduce
+
+
+@register("missing")
+class MissingAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        from elasticsearch_tpu.search.queries import ExistsQuery
+
+        jnp = _jnp()
+        _, em = ExistsQuery(self.body["field"]).execute(ctx)
+        bmask = mask & ~em
+        out = {"doc_count": int(jnp.sum(bmask.astype(jnp.int32)))}
+        if self.subs:
+            out["subs"] = self.collect_subs(ctx, bmask)
+        return out
+
+    reduce = FilterAggregator.reduce
+
+
+@register("sampler")
+class SamplerAggregator(Aggregator):
+    """best-docs sampler: keeps the first shard_size masked docs (score
+    ordering requires the query scores; R2 wires them through)."""
+
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        shard_size = int(self.body.get("shard_size", 100))
+        m = np.asarray(mask)
+        locs = np.nonzero(m)[0][:shard_size]
+        sm = np.zeros_like(m)
+        sm[locs] = True
+        bmask = jnp.asarray(sm)
+        out = {"doc_count": int(len(locs))}
+        if self.subs:
+            out["subs"] = self.collect_subs(ctx, bmask)
+        return out
+
+    reduce = FilterAggregator.reduce
+
+
+@register("significant_terms")
+class SignificantTermsAggregator(TermsAggregator):
+    """JLH-scored foreground vs background terms (significant/heuristics/
+    JLHScore.java)."""
+
+    def collect(self, ctx, mask):
+        fg = super().collect(ctx, mask)
+        inv = ctx.inv(self.body.get("field"))
+        bg = {}
+        if inv is not None:
+            bg = {t: int(inv.df[i]) for t, i in inv.vocab.items()}
+        jnp = _jnp()
+        fg["fg_total"] = int(jnp.sum(mask.astype(jnp.int32)))
+        fg["bg"] = bg
+        fg["bg_total"] = ctx.segment.live_docs
+        return fg
+
+    def reduce(self, partials):
+        fg_total = sum(p["fg_total"] for p in partials)
+        bg_total = sum(p["bg_total"] for p in partials)
+        bg: Dict[str, int] = {}
+        merged: Dict[str, int] = {}
+        for p in partials:
+            for t, c in p["bg"].items():
+                bg[t] = bg.get(t, 0) + c
+            for k, b in p["buckets"].items():
+                merged[k] = merged.get(k, 0) + b["doc_count"]
+        size = int(self.body.get("size", DEFAULT_SIZE))
+        out = []
+        for t, fg_count in merged.items():
+            bg_count = bg.get(t, fg_count)
+            if not fg_total or not bg_total:
+                continue
+            fg_pct = fg_count / fg_total
+            bg_pct = bg_count / bg_total
+            if fg_pct <= bg_pct:
+                continue
+            score = (fg_pct - bg_pct) * (fg_pct / max(bg_pct, 1e-12))  # JLH
+            out.append({"key": t, "doc_count": fg_count, "score": score,
+                        "bg_count": bg_count})
+        out.sort(key=lambda b: -b["score"])
+        return {"doc_count": fg_total, "buckets": out[:size]}

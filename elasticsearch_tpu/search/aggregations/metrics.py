@@ -1,0 +1,362 @@
+"""Metrics aggregations.
+
+Reference: org/elasticsearch/search/aggregations/metrics/ — avg/AvgAggregator.java,
+sum/, min/, max/, stats/, stats/extended/, valuecount/, cardinality/
+(HyperLogLogPlusPlus.java), percentiles/ (t-digest), tophits/, geobounds/,
+scripted/. Each partial is a small mergeable host object; per-doc math stays
+on device (masked reductions, fused by XLA with the query program).
+
+Parity deviations (documented): percentiles samples up to 64k masked values
+per segment and computes exact quantiles on the merged sample instead of
+t-digest sketches (R3 replaces with a device t-digest); cardinality uses a
+dense 2^12-register HLL without the ++ sparse encoding or bias tables.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, List
+
+import numpy as np
+
+from elasticsearch_tpu.search.aggregations.base import Aggregator, register, resolve_values
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _masked(vals, exists, mask):
+    jnp = _jnp()
+    sel = exists & mask
+    return jnp.where(sel, vals, 0.0), sel
+
+
+@register("value_count")
+class ValueCountAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        _, exists, _, _ = resolve_values(ctx, self.body)
+        return int(jnp.sum((exists & mask).astype(jnp.int32)))
+
+    def reduce(self, partials):
+        return {"value": int(sum(partials))}
+
+
+@register("sum")
+class SumAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        vals, exists, offset, _ = resolve_values(ctx, self.body)
+        v, sel = _masked(vals, exists, mask)
+        s = float(jnp.sum(v))
+        n = int(jnp.sum(sel.astype(jnp.int32)))
+        return s + offset * n
+
+    def reduce(self, partials):
+        return {"value": float(sum(partials))}
+
+
+@register("avg")
+class AvgAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        vals, exists, offset, _ = resolve_values(ctx, self.body)
+        v, sel = _masked(vals, exists, mask)
+        n = int(jnp.sum(sel.astype(jnp.int32)))
+        return (float(jnp.sum(v)) + offset * n, n)
+
+    def reduce(self, partials):
+        total = sum(p[0] for p in partials)
+        n = sum(p[1] for p in partials)
+        return {"value": (total / n) if n else None}
+
+
+@register("min")
+class MinAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        vals, exists, offset, _ = resolve_values(ctx, self.body)
+        sel = exists & mask
+        v = jnp.where(sel, vals, jnp.float32(jnp.inf))
+        m = float(jnp.min(v))
+        return m + offset if math.isfinite(m) else None
+
+    def reduce(self, partials):
+        vals = [p for p in partials if p is not None]
+        return {"value": min(vals) if vals else None}
+
+
+@register("max")
+class MaxAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        vals, exists, offset, _ = resolve_values(ctx, self.body)
+        sel = exists & mask
+        v = jnp.where(sel, vals, jnp.float32(-jnp.inf))
+        m = float(jnp.max(v))
+        return m + offset if math.isfinite(m) else None
+
+    def reduce(self, partials):
+        vals = [p for p in partials if p is not None]
+        return {"value": max(vals) if vals else None}
+
+
+class _StatsMixin:
+    def _collect_stats(self, ctx, mask, want_sq=False):
+        jnp = _jnp()
+        vals, exists, offset, _ = resolve_values(ctx, self.body)
+        sel = exists & mask
+        v = jnp.where(sel, vals, 0.0)
+        n = int(jnp.sum(sel.astype(jnp.int32)))
+        s = float(jnp.sum(v))
+        mn = float(jnp.min(jnp.where(sel, vals, jnp.float32(jnp.inf))))
+        mx = float(jnp.max(jnp.where(sel, vals, jnp.float32(-jnp.inf))))
+        out = {
+            "count": n,
+            "sum": s + offset * n,
+            "min": (mn + offset) if n else None,
+            "max": (mx + offset) if n else None,
+        }
+        if want_sq:
+            # E[(x+off)^2] = E[x^2] + 2 off E[x] + off^2
+            sq = float(jnp.sum(v * v))
+            out["sum_sq"] = sq + 2 * offset * s + offset * offset * n
+        return out
+
+    @staticmethod
+    def _merge_stats(partials):
+        n = sum(p["count"] for p in partials)
+        s = sum(p["sum"] for p in partials)
+        mns = [p["min"] for p in partials if p["min"] is not None]
+        mxs = [p["max"] for p in partials if p["max"] is not None]
+        return {
+            "count": n,
+            "sum": s,
+            "min": min(mns) if mns else None,
+            "max": max(mxs) if mxs else None,
+            "avg": (s / n) if n else None,
+        }
+
+
+@register("stats")
+class StatsAggregator(Aggregator, _StatsMixin):
+    def collect(self, ctx, mask):
+        return self._collect_stats(ctx, mask)
+
+    def reduce(self, partials):
+        return self._merge_stats(partials)
+
+
+@register("extended_stats")
+class ExtendedStatsAggregator(Aggregator, _StatsMixin):
+    def collect(self, ctx, mask):
+        return self._collect_stats(ctx, mask, want_sq=True)
+
+    def reduce(self, partials):
+        out = self._merge_stats(partials)
+        sq = sum(p["sum_sq"] for p in partials)
+        n = out["count"]
+        out["sum_of_squares"] = sq
+        if n:
+            var = max(sq / n - (out["sum"] / n) ** 2, 0.0)
+            out["variance"] = var
+            out["std_deviation"] = math.sqrt(var)
+            sigma = float(self.body.get("sigma", 2.0))
+            out["std_deviation_bounds"] = {
+                "upper": out["avg"] + sigma * out["std_deviation"],
+                "lower": out["avg"] - sigma * out["std_deviation"],
+            }
+        else:
+            out["sum_of_squares"] = 0.0
+            out["variance"] = None
+            out["std_deviation"] = None
+        return out
+
+
+from elasticsearch_tpu.utils.hashing import HLL_BITS, HLL_M  # noqa: E402
+
+
+@register("cardinality")
+class CardinalityAggregator(Aggregator):
+    """HyperLogLog. Hashes must be *value*-consistent across segments (the
+    partials merge by register max), so keyword fields hash term strings
+    (murmur3, like ES's BytesRef hashing) — never segment-local ordinals —
+    and numeric fields hash exact 64-bit value bits."""
+
+    def collect(self, ctx, mask):
+        from elasticsearch_tpu.ops.scoring import bucket_count
+        from elasticsearch_tpu.utils.hashing import hash32_device, hll_update_host, murmur3_32
+
+        jnp = _jnp()
+        field = self.body.get("field")
+        kw = ctx.segment.keywords.get(field) if field else None
+        regs_host = np.zeros(HLL_M, dtype=np.int32)
+        if kw is not None:
+            # terms present among masked docs, via postings (multi-value correct)
+            inv = ctx.inv(field)
+            V = inv.vocab_size
+            if V == 0:
+                return regs_host
+            w = mask[inv.doc_ids.clip(0, ctx.D - 1)] & (inv.term_ids < V)
+            counts = np.asarray(bucket_count(inv.term_ids, w.astype(jnp.float32), num_buckets=V + 1))[:V]
+            present = np.nonzero(counts > 0)[0]
+            hashes = np.array([murmur3_32(inv.terms[int(t)]) for t in present], dtype=np.uint32)
+            return hll_update_host(regs_host, hashes)
+        vals, exists, offset, col = resolve_values(ctx, self.body)
+        sel = exists & mask
+        if col is not None and col.exact is not None and col.exact.dtype.kind == "i":
+            x = jnp.asarray((col.exact & 0xFFFFFFFF).astype(np.int64).astype(np.uint32)
+                            ^ ((col.exact >> 32) & 0xFFFFFFFF).astype(np.int64).astype(np.uint32))
+        elif col is not None and col.exact is not None:
+            # float doc values: hash the f64 bit pattern folded to 32 bits
+            bits = col.exact.view(np.int64)
+            x = jnp.asarray(((bits & 0xFFFFFFFF) ^ ((bits >> 32) & 0xFFFFFFFF)).astype(np.int64).astype(np.uint32))
+        else:
+            x = vals.view(jnp.int32)
+        h = hash32_device(x)
+        reg = (h >> (32 - HLL_BITS)).astype(jnp.int32)
+        rest = h << HLL_BITS
+        # rank = count-leading-zeros(rest) + 1, capped; clz via floor(log2)
+        # (f32 rounding at powers of two gives a rare off-by-one — negligible
+        # for an approximate sketch)
+        lz = jnp.where(
+            rest > 0,
+            31 - jnp.floor(jnp.log2(rest.astype(jnp.float32))).astype(jnp.int32),
+            jnp.int32(32),
+        )
+        rank = jnp.clip(lz + 1, 1, 32 - HLL_BITS + 1)
+        regs = jnp.zeros(HLL_M, dtype=jnp.int32)
+        regs = regs.at[jnp.where(sel, reg, HLL_M)].max(
+            jnp.where(sel, rank, 0), mode="drop"
+        )
+        return np.asarray(regs)
+
+    def reduce(self, partials):
+        regs = np.zeros(HLL_M, dtype=np.int32)
+        for p in partials:
+            regs = np.maximum(regs, p)
+        m = HLL_M
+        alpha = 0.7213 / (1 + 1.079 / m)
+        est = alpha * m * m / np.sum(np.exp2(-regs.astype(np.float64)))
+        zeros = int(np.sum(regs == 0))
+        if est <= 2.5 * m and zeros:
+            est = m * math.log(m / zeros)  # linear counting for small cardinalities
+        return {"value": int(round(est))}
+
+
+@register("percentiles")
+class PercentilesAggregator(Aggregator):
+    SAMPLE_CAP = 1 << 16
+
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        vals, exists, offset, col = resolve_values(ctx, self.body)
+        sel = np.asarray(exists & mask)
+        if col is not None and col.exact is not None:
+            sample = col.exact[np.nonzero(sel)[0]].astype(np.float64)
+        else:
+            sample = np.asarray(vals)[np.nonzero(sel)[0]].astype(np.float64) + offset
+        if sample.size > self.SAMPLE_CAP:
+            rng = np.random.default_rng(17)
+            sample = rng.choice(sample, self.SAMPLE_CAP, replace=False)
+        return sample
+
+    def reduce(self, partials):
+        pcts = self.body.get("percents", [1, 5, 25, 50, 75, 95, 99])
+        allv = np.concatenate([p for p in partials]) if partials else np.array([])
+        values = {}
+        for p in pcts:
+            values[f"{float(p)}"] = float(np.percentile(allv, p)) if allv.size else None
+        return {"values": values}
+
+
+@register("percentile_ranks")
+class PercentileRanksAggregator(PercentilesAggregator):
+    def reduce(self, partials):
+        targets = self.body.get("values", [])
+        allv = np.concatenate([p for p in partials]) if partials else np.array([])
+        values = {}
+        for t in targets:
+            if allv.size:
+                values[f"{float(t)}"] = float((allv <= t).mean() * 100.0)
+            else:
+                values[f"{float(t)}"] = None
+        return {"values": values}
+
+
+@register("top_hits")
+class TopHitsAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        size = int(self.body.get("size", 3))
+        m = np.asarray(mask)[: ctx.segment.num_docs]
+        locs = np.nonzero(m)[0][:size]
+        hits = []
+        for loc in locs:
+            hits.append({
+                "_id": ctx.segment.ids[int(loc)],
+                "_score": 1.0,
+                "_source": ctx.segment.sources[int(loc)],
+            })
+        return {"hits": hits, "total": int(m.sum())}
+
+    def reduce(self, partials):
+        size = int(self.body.get("size", 3))
+        hits = [h for p in partials for h in p["hits"]][:size]
+        total = sum(p["total"] for p in partials)
+        return {"hits": {"total": total, "hits": hits}}
+
+
+@register("geo_bounds")
+class GeoBoundsAggregator(Aggregator):
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        field = self.body["field"]
+        lat = ctx.col(f"{field}.lat")
+        lon = ctx.col(f"{field}.lon")
+        if lat is None:
+            return None
+        sel = lat.exists & mask
+        if not bool(jnp.any(sel)):
+            return None
+        return {
+            "top": float(jnp.max(jnp.where(sel, lat.values, -jnp.inf))),
+            "bottom": float(jnp.min(jnp.where(sel, lat.values, jnp.inf))),
+            "left": float(jnp.min(jnp.where(sel, lon.values, jnp.inf))),
+            "right": float(jnp.max(jnp.where(sel, lon.values, -jnp.inf))),
+        }
+
+    def reduce(self, partials):
+        ps = [p for p in partials if p]
+        if not ps:
+            return {"bounds": None}
+        return {
+            "bounds": {
+                "top_left": {"lat": max(p["top"] for p in ps), "lon": min(p["left"] for p in ps)},
+                "bottom_right": {"lat": min(p["bottom"] for p in ps), "lon": max(p["right"] for p in ps)},
+            }
+        }
+
+
+@register("scripted_metric")
+class ScriptedMetricAggregator(Aggregator):
+    """Simplified: map script produces a per-doc value; partials are summed.
+    (Reference scripted/ScriptedMetricAggregator.java runs init/map/combine/
+    reduce scripts; our map script result is combined by sum.)"""
+
+    def collect(self, ctx, mask):
+        jnp = _jnp()
+        from elasticsearch_tpu.search.function_score import doc_resolver
+        from elasticsearch_tpu.search.scripting import compile_script
+
+        spec = self.body.get("map_script", "1")
+        src = spec if isinstance(spec, str) else spec.get("inline", spec.get("source", ""))
+        cs = compile_script(src)
+        vals = cs.run(doc_resolver(ctx), params=self.body.get("params", {}))
+        if not hasattr(vals, "astype"):
+            vals = jnp.full(ctx.D, jnp.float32(vals))
+        return float(jnp.sum(jnp.where(mask, vals.astype(jnp.float32), 0.0)))
+
+    def reduce(self, partials):
+        return {"value": float(sum(partials))}

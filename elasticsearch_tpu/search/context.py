@@ -1,0 +1,108 @@
+"""Per-segment execution context for query programs.
+
+Mirrors the role of org/elasticsearch/search/internal/SearchContext.java +
+Lucene's LeafReaderContext: one segment's arrays plus index-level services
+(mappings, analysis) and optional global term statistics (dfs_query_then_fetch,
+reference: org/elasticsearch/search/dfs/DfsSearchResult.java).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.segment import InvertedField, NumericColumn, TpuSegment
+from elasticsearch_tpu.utils.shapes import pow2_bucket
+
+# cap on a single postings slice width; longer term runs are split into
+# multiple chunks (keeps the [T, P] intermediate bounded)
+P_MAX = 1 << 15
+
+
+@dataclass
+class GlobalStats:
+    """Cross-shard term statistics for consistent idf (dfs phase)."""
+
+    num_docs: Dict[str, int]  # field -> total docs with field
+    df: Dict[Tuple[str, str], int]  # (field, term) -> doc freq
+
+
+class SegmentContext:
+    def __init__(
+        self,
+        segment: TpuSegment,
+        mappings: Mappings,
+        analysis: AnalysisRegistry,
+        global_stats: Optional[GlobalStats] = None,
+    ):
+        self.segment = segment
+        self.mappings = mappings
+        self.analysis = analysis
+        self.global_stats = global_stats
+
+    @property
+    def D(self) -> int:
+        return self.segment.max_docs
+
+    def inv(self, field: str) -> Optional[InvertedField]:
+        return self.segment.inverted.get(field)
+
+    def col(self, field: str) -> Optional[NumericColumn]:
+        return self.segment.numerics.get(field)
+
+    def idf(self, field: str, term: str) -> float:
+        inv = self.inv(field)
+        if self.global_stats is not None:
+            n = self.global_stats.num_docs.get(field, inv.num_docs if inv else 0)
+            df = self.global_stats.df.get((field, term), 0)
+            return float(np.log(1.0 + (n - df + 0.5) / (df + 0.5)))
+        if inv is None:
+            return 0.0
+        return inv.idf(term)
+
+    def search_analyzer(self, field: str):
+        fm = self.mappings.get(field)
+        if fm is None or not fm.is_text:
+            return None
+        return self.analysis.get(fm.search_analyzer or fm.analyzer)
+
+    def chunked_slices(self, inv: InvertedField, terms, weights):
+        """Split (term -> postings run) into P-bucketed chunks.
+
+        Returns (starts i32[Tb], lens i32[Tb], w f32[Tb], P, n_real_terms)
+        where Tb is a pow2 bucket. Terms absent from the segment contribute
+        (0, 0) chunks. n_real_terms counts distinct terms present.
+        """
+        starts, lens, ws = [], [], []
+        n_present = 0
+        max_len = 1
+        for term, w in zip(terms, weights):
+            s, ln = inv.term_slice(term)
+            if ln > 0:
+                n_present += 1
+            while ln > P_MAX:
+                starts.append(s)
+                lens.append(P_MAX)
+                ws.append(w)
+                s += P_MAX
+                ln -= P_MAX
+                max_len = P_MAX  # P must cover the full-width chunks, not just the tail
+            starts.append(s)
+            lens.append(ln)
+            ws.append(w)
+            max_len = max(max_len, ln)
+        P = pow2_bucket(max_len)
+        Tb = pow2_bucket(len(starts), minimum=1)
+        starts += [0] * (Tb - len(starts))
+        lens += [0] * (Tb - len(lens))
+        ws += [0.0] * (Tb - len(ws))
+        return (
+            np.asarray(starts, np.int32),
+            np.asarray(lens, np.int32),
+            np.asarray(ws, np.float32),
+            P,
+            n_present,
+        )

@@ -1,0 +1,336 @@
+"""function_score query — score rewriting functions on device.
+
+Reference: org/elasticsearch/index/query/functionscore/ —
+FunctionScoreQueryBuilder.java, weight/, fieldvaluefactor/
+(FieldValueFactorFunctionBuilder.java), script/ (ScriptScoreFunctionBuilder.java),
+random/ (RandomScoreFunctionBuilder.java), gauss/exp/lin decay
+(DecayFunctionBuilder.java). All functions evaluate as dense f32[D]
+vectors over doc-value columns and combine per score_mode/boost_mode.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.search.scripting import compile_script
+from elasticsearch_tpu.utils.dates import parse_date, interval_to_millis
+from elasticsearch_tpu.utils.errors import QueryParsingException
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def doc_resolver(ctx):
+    """Resolve doc['field'] for scripts: returns a _DocField of device columns.
+
+    Numeric columns hand back offset-corrected values in f32 (offset spans
+    cancel in most script arithmetic; exact i64 stays host-side)."""
+    from elasticsearch_tpu.search.scripting import _DocField
+
+    def resolve(field: str):
+        col = ctx.col(field)
+        jnp = _jnp()
+        if col is not None:
+            vals = col.values
+            if col.offset:
+                vals = vals.astype(jnp.float32) + jnp.float32(col.offset)
+            return _DocField(vals, col.exists)
+        kw = ctx.segment.keywords.get(field)
+        if kw is not None:
+            return _DocField(kw.ords.astype(jnp.float32), kw.exists)
+        fl = ctx.segment.field_lengths.get(field)
+        if fl is not None:
+            return _DocField(fl, fl > 0)
+        return _DocField(jnp.zeros(ctx.D, dtype=jnp.float32), jnp.zeros(ctx.D, dtype=bool))
+
+    return resolve
+
+
+class ScoreFunction:
+    weight: float = 1.0
+    filter = None
+
+    def value(self, ctx, scores):
+        raise NotImplementedError
+
+    def weighted(self, ctx, scores):
+        """Returns (value f32[D], match bool[D]); docs where the function's
+        filter doesn't match are EXCLUDED from combination (FiltersFunction-
+        ScoreQuery semantics) — the caller applies per-mode neutrals."""
+        jnp = _jnp()
+        v = self.value(ctx, scores) * self.weight
+        if self.filter is not None:
+            _, fm = self.filter.execute(ctx)
+            return v, fm
+        return v, jnp.ones(ctx.D, dtype=bool)
+
+
+class WeightFunction(ScoreFunction):
+    def __init__(self, weight: float):
+        self.weight = weight
+
+    def value(self, ctx, scores):
+        return _jnp().ones(ctx.D, dtype=_jnp().float32)
+
+
+class FieldValueFactorFunction(ScoreFunction):
+    def __init__(self, field: str, factor: float = 1.0, modifier: str = "none",
+                 missing: Optional[float] = None):
+        self.field = field
+        self.factor = factor
+        self.modifier = modifier
+        self.missing = missing
+
+    def value(self, ctx, scores):
+        jnp = _jnp()
+        col = ctx.col(self.field)
+        if col is None:
+            if self.missing is None:
+                raise QueryParsingException(
+                    f"field_value_factor field [{self.field}] has no doc values and no [missing]"
+                )
+            v = jnp.full(ctx.D, jnp.float32(self.missing))
+            exists = jnp.ones(ctx.D, dtype=bool)
+        else:
+            v = col.values.astype(jnp.float32) + jnp.float32(col.offset)
+            exists = col.exists
+            v = jnp.where(exists, v, jnp.float32(self.missing if self.missing is not None else 0.0))
+        v = v * self.factor
+        m = self.modifier
+        if m in ("none", None):
+            out = v
+        elif m == "log":
+            out = jnp.log10(jnp.maximum(v, 1e-9))
+        elif m == "log1p":
+            out = jnp.log10(v + 1.0)
+        elif m == "log2p":
+            out = jnp.log10(v + 2.0)
+        elif m == "ln":
+            out = jnp.log(jnp.maximum(v, 1e-9))
+        elif m == "ln1p":
+            out = jnp.log1p(v)
+        elif m == "ln2p":
+            out = jnp.log(v + 2.0)
+        elif m == "square":
+            out = v * v
+        elif m == "sqrt":
+            out = jnp.sqrt(jnp.maximum(v, 0.0))
+        elif m == "reciprocal":
+            out = 1.0 / jnp.maximum(v, 1e-9)
+        else:
+            raise QueryParsingException(f"unknown field_value_factor modifier [{m}]")
+        return out
+
+
+class ScriptScoreFunction(ScoreFunction):
+    def __init__(self, source: str, params: Optional[dict] = None):
+        self.script = compile_script(source)
+        self.params = params or {}
+
+    def value(self, ctx, scores):
+        out = self.script.run(doc_resolver(ctx), score=scores, params=self.params)
+        jnp = _jnp()
+        if not hasattr(out, "astype"):
+            out = jnp.full(ctx.D, jnp.float32(out))
+        return out.astype(jnp.float32)
+
+
+class RandomScoreFunction(ScoreFunction):
+    """Deterministic per-doc hash in [0, 1) seeded like RandomScoreFunctionBuilder."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+
+    def value(self, ctx, scores):
+        from elasticsearch_tpu.utils.hashing import hash32_device
+
+        jnp = _jnp()
+        x = hash32_device(jnp.arange(ctx.D, dtype=jnp.uint32) + jnp.uint32(self.seed))
+        return (x.astype(jnp.float32) / jnp.float32(2**32)).astype(jnp.float32)
+
+
+class DecayFunction(ScoreFunction):
+    def __init__(self, kind: str, field: str, origin, scale, offset=0, decay: float = 0.5):
+        self.kind = kind
+        self.field = field
+        self.origin = origin
+        self.scale = scale
+        self.offset = offset
+        self.decay = decay
+
+    def value(self, ctx, scores):
+        jnp = _jnp()
+        col = ctx.col(self.field)
+        if col is None:
+            return jnp.ones(ctx.D, dtype=jnp.float32)
+        fm = ctx.mappings.get(self.field)
+        if fm is not None and fm.type == "date":
+            origin = parse_date(self.origin, fm.fmt) if self.origin not in (None, "now") else None
+            scale = interval_to_millis(self.scale) if isinstance(self.scale, str) else float(self.scale)
+            offset = interval_to_millis(self.offset) if isinstance(self.offset, str) else float(self.offset)
+            if origin is None:
+                origin = float(np.max(col.exact)) if col.exact is not None else 0.0
+        else:
+            origin = float(self.origin)
+            scale = float(self.scale)
+            offset = float(self.offset or 0)
+        v = col.values.astype(jnp.float32) + jnp.float32(col.offset)
+        dist = jnp.maximum(jnp.abs(v - jnp.float32(origin)) - jnp.float32(offset), 0.0)
+        decay = jnp.float32(self.decay)
+        scale_f = jnp.float32(scale)
+        if self.kind == "gauss":
+            sigma2 = -(scale_f ** 2) / (2.0 * jnp.log(decay))
+            out = jnp.exp(-(dist ** 2) / (2.0 * sigma2))
+        elif self.kind == "exp":
+            lam = jnp.log(decay) / scale_f
+            out = jnp.exp(lam * dist)
+        elif self.kind == "linear":
+            s = scale_f / (1.0 - decay)
+            out = jnp.maximum((s - dist) / s, 0.0)
+        else:
+            raise QueryParsingException(f"unknown decay [{self.kind}]")
+        return jnp.where(col.exists, out, jnp.float32(1.0))
+
+
+class FunctionScoreQuery:
+    """Combines inner query scores with function values."""
+
+    boost = 1.0
+
+    def __init__(self, inner, functions: List[ScoreFunction], score_mode: str = "multiply",
+                 boost_mode: str = "multiply", max_boost: Optional[float] = None,
+                 min_score: Optional[float] = None, boost: float = 1.0):
+        self.inner = inner
+        self.functions = functions
+        self.score_mode = score_mode
+        self.boost_mode = boost_mode
+        self.max_boost = max_boost
+        self.min_score = min_score
+        self.boost = boost
+
+    def score_or_mask(self, ctx):
+        return self.execute(ctx)
+
+    def execute(self, ctx):
+        jnp = _jnp()
+        scores, mask = self.inner.score_or_mask(ctx)
+        if not self.functions:
+            return scores * self.boost, mask
+        pairs = [f.weighted(ctx, scores) for f in self.functions]
+        sm = self.score_mode
+        any_match = pairs[0][1]
+        for _, m in pairs[1:]:
+            any_match = any_match | m
+        if sm == "multiply":
+            fv = jnp.ones(ctx.D, dtype=jnp.float32)
+            for v, m in pairs:
+                fv = fv * jnp.where(m, v, 1.0)
+        elif sm in ("sum", "avg"):
+            fv = jnp.zeros(ctx.D, dtype=jnp.float32)
+            nm = jnp.zeros(ctx.D, dtype=jnp.float32)
+            for v, m in pairs:
+                fv = fv + jnp.where(m, v, 0.0)
+                nm = nm + m.astype(jnp.float32)
+            if sm == "avg":
+                fv = fv / jnp.maximum(nm, 1.0)
+        elif sm == "max":
+            fv = jnp.full(ctx.D, -jnp.inf, dtype=jnp.float32)
+            for v, m in pairs:
+                fv = jnp.maximum(fv, jnp.where(m, v, -jnp.inf))
+        elif sm == "min":
+            fv = jnp.full(ctx.D, jnp.inf, dtype=jnp.float32)
+            for v, m in pairs:
+                fv = jnp.minimum(fv, jnp.where(m, v, jnp.inf))
+        elif sm == "first":
+            fv = jnp.ones(ctx.D, dtype=jnp.float32)
+            taken = jnp.zeros(ctx.D, dtype=bool)
+            for v, m in pairs:
+                use = m & ~taken
+                fv = jnp.where(use, v, fv)
+                taken = taken | m
+        else:
+            raise QueryParsingException(f"unknown score_mode [{sm}]")
+        # docs matching no function: neutral factor 1 (reference behavior)
+        fv = jnp.where(any_match, fv, jnp.float32(1.0))
+        if self.max_boost is not None:
+            fv = jnp.minimum(fv, jnp.float32(self.max_boost))
+        bm = self.boost_mode
+        if bm == "multiply":
+            out = scores * fv
+        elif bm == "replace":
+            out = fv
+        elif bm == "sum":
+            out = scores + fv
+        elif bm == "avg":
+            out = (scores + fv) / 2.0
+        elif bm == "max":
+            out = jnp.maximum(scores, fv)
+        elif bm == "min":
+            out = jnp.minimum(scores, fv)
+        else:
+            raise QueryParsingException(f"unknown boost_mode [{bm}]")
+        out = out * self.boost
+        if self.min_score is not None:
+            mask = mask & (out >= self.min_score)
+        return out * mask, mask
+
+
+_DECAYS = ("gauss", "exp", "linear")
+
+
+def _parse_one_function(spec: dict) -> ScoreFunction:
+    from elasticsearch_tpu.search.queries import parse_query
+
+    fn: Optional[ScoreFunction] = None
+    if "field_value_factor" in spec:
+        c = spec["field_value_factor"]
+        fn = FieldValueFactorFunction(
+            c["field"], factor=float(c.get("factor", 1.0)),
+            modifier=c.get("modifier", "none"), missing=c.get("missing"),
+        )
+    elif "script_score" in spec:
+        s = spec["script_score"]["script"]
+        if isinstance(s, dict):
+            fn = ScriptScoreFunction(s.get("inline", s.get("source", "")), s.get("params"))
+        else:
+            fn = ScriptScoreFunction(s)
+    elif "random_score" in spec:
+        fn = RandomScoreFunction(seed=spec["random_score"].get("seed", 0))
+    else:
+        for d in _DECAYS:
+            if d in spec:
+                (field, c), = spec[d].items()
+                fn = DecayFunction(d, field, c.get("origin"), c.get("scale"),
+                                  offset=c.get("offset", 0), decay=float(c.get("decay", 0.5)))
+                break
+    if fn is None:
+        fn = WeightFunction(float(spec.get("weight", 1.0)))
+    elif "weight" in spec:
+        fn.weight = float(spec["weight"])
+    if "filter" in spec:
+        fn.filter = parse_query(spec["filter"])
+    return fn
+
+
+def parse_function_score(body: dict) -> FunctionScoreQuery:
+    from elasticsearch_tpu.search.queries import parse_query, MatchAllQuery
+
+    inner = parse_query(body["query"]) if "query" in body else MatchAllQuery()
+    if "functions" in body:
+        functions = [_parse_one_function(s) for s in body["functions"]]
+    else:
+        functions = [_parse_one_function(body)] if any(
+            k in body for k in ("field_value_factor", "script_score", "random_score", "weight") + _DECAYS
+        ) else []
+    return FunctionScoreQuery(
+        inner, functions,
+        score_mode=body.get("score_mode", "multiply"),
+        boost_mode=body.get("boost_mode", "multiply"),
+        max_boost=body.get("max_boost"),
+        min_score=body.get("min_score"),
+        boost=float(body.get("boost", 1.0)),
+    )

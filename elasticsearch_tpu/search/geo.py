@@ -1,0 +1,146 @@
+"""Geo queries over lat/lon doc-value columns.
+
+Reference: org/elasticsearch/index/query/GeoDistanceQueryBuilder.java,
+GeoBoundingBoxQueryBuilder.java, GeoPolygonQueryBuilder.java; distance math
+from org/elasticsearch/common/geo/GeoDistance.java (haversine/arc).
+geo_point fields index as two numeric columns `<field>.lat` / `<field>.lon`,
+so every geo predicate is dense vectorized math on device.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.index.mappings import _parse_geo_point
+from elasticsearch_tpu.search.queries import Query, _empty
+from elasticsearch_tpu.utils.errors import QueryParsingException
+
+EARTH_RADIUS_M = 6371008.8
+
+_DIST_RE = re.compile(r"^([\d.]+)\s*(mm|cm|m|km|mi|miles|yd|ft|in|nmi|NM)?$")
+_UNIT_M = {
+    None: 1.0, "m": 1.0, "mm": 0.001, "cm": 0.01, "km": 1000.0,
+    "mi": 1609.344, "miles": 1609.344, "yd": 0.9144, "ft": 0.3048,
+    "in": 0.0254, "nmi": 1852.0, "NM": 1852.0,
+}
+
+
+def parse_distance(s) -> float:
+    """Distance string → meters ("1km", "500m", 2.5 → meters)."""
+    if isinstance(s, (int, float)):
+        return float(s)
+    m = _DIST_RE.match(str(s).strip())
+    if not m:
+        raise QueryParsingException(f"cannot parse distance [{s}]")
+    return float(m.group(1)) * _UNIT_M[m.group(2)]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def _latlon(ctx, field: str):
+    lat = ctx.col(f"{field}.lat")
+    lon = ctx.col(f"{field}.lon")
+    if lat is None or lon is None:
+        return None
+    return lat, lon
+
+
+class GeoDistanceQuery(Query):
+    def __init__(self, field: str, center: Tuple[float, float], distance_m: float):
+        self.field = field
+        self.center = center
+        self.distance_m = distance_m
+
+    def execute(self, ctx):
+        jnp = _jnp()
+        cols = _latlon(ctx, self.field)
+        if cols is None:
+            return _empty(ctx)
+        latc, lonc = cols
+        lat = jnp.deg2rad(latc.values)
+        lon = jnp.deg2rad(lonc.values)
+        lat0 = jnp.deg2rad(jnp.float32(self.center[0]))
+        lon0 = jnp.deg2rad(jnp.float32(self.center[1]))
+        # haversine
+        dlat = lat - lat0
+        dlon = lon - lon0
+        a = jnp.sin(dlat / 2) ** 2 + jnp.cos(lat) * jnp.cos(lat0) * jnp.sin(dlon / 2) ** 2
+        d = 2.0 * EARTH_RADIUS_M * jnp.arcsin(jnp.sqrt(jnp.clip(a, 0.0, 1.0)))
+        return None, (d <= self.distance_m) & latc.exists
+
+
+class GeoBoundingBoxQuery(Query):
+    def __init__(self, field: str, top: float, left: float, bottom: float, right: float):
+        self.field = field
+        self.top, self.left, self.bottom, self.right = top, left, bottom, right
+
+    def execute(self, ctx):
+        cols = _latlon(ctx, self.field)
+        if cols is None:
+            return _empty(ctx)
+        latc, lonc = cols
+        lat, lon = latc.values, lonc.values
+        m = (lat <= self.top) & (lat >= self.bottom) & latc.exists
+        if self.left <= self.right:
+            m = m & (lon >= self.left) & (lon <= self.right)
+        else:  # box crossing the antimeridian
+            m = m & ((lon >= self.left) | (lon <= self.right))
+        return None, m
+
+
+class GeoPolygonQuery(Query):
+    def __init__(self, field: str, points: List[Tuple[float, float]]):
+        self.field = field
+        self.points = points
+
+    def execute(self, ctx):
+        jnp = _jnp()
+        cols = _latlon(ctx, self.field)
+        if cols is None:
+            return _empty(ctx)
+        latc, lonc = cols
+        y, x = latc.values, lonc.values
+        inside = jnp.zeros_like(y, dtype=bool)
+        n = len(self.points)
+        # even-odd ray casting, vectorized over docs
+        for i in range(n):
+            y1, x1 = self.points[i]
+            y2, x2 = self.points[(i + 1) % n]
+            cond = ((y1 > y) != (y2 > y)) & (
+                x < (x2 - x1) * (y - y1) / jnp.float32((y2 - y1) if y2 != y1 else 1e-12) + x1
+            )
+            inside = inside ^ cond
+        return None, inside & latc.exists
+
+
+def parse_geo_query(qtype: str, body: dict) -> Query:
+    body = dict(body)
+    if qtype == "geo_distance":
+        distance = parse_distance(body.pop("distance"))
+        body.pop("distance_type", None)
+        body.pop("validation_method", None)
+        (field, point), = body.items()
+        lat, lon = _parse_geo_point(point)
+        return GeoDistanceQuery(field, (lat, lon), distance)
+    if qtype == "geo_bounding_box":
+        body.pop("validation_method", None)
+        body.pop("type", None)
+        (field, box), = body.items()
+        if "top_left" in box:
+            top_lat, left_lon = _parse_geo_point(box["top_left"])
+            bot_lat, right_lon = _parse_geo_point(box["bottom_right"])
+        else:
+            top_lat, left_lon = box["top"], box["left"]
+            bot_lat, right_lon = box["bottom"], box["right"]
+        return GeoBoundingBoxQuery(field, top_lat, left_lon, bot_lat, right_lon)
+    if qtype == "geo_polygon":
+        (field, spec), = body.items()
+        pts = [_parse_geo_point(p) for p in spec["points"]]
+        return GeoPolygonQuery(field, pts)
+    raise QueryParsingException(f"[{qtype}] is not implemented yet (geo_shape lands in R3)")

@@ -1,0 +1,74 @@
+"""Shared hashing utilities.
+
+- ``murmur3_32``: murmur3 x86 32-bit over utf-8 (Lucene/ES Murmur3 parity;
+  used by the murmur3 field mapper, routing, and keyword cardinality).
+- ``hash32_device``: cheap 32-bit integer mix for device arrays (HLL over
+  numeric values, random_score). One definition so callers can't diverge.
+- ``hll_update_host``: fold 32-bit hashes into HyperLogLog registers host-side.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+HLL_BITS = 12
+HLL_M = 1 << HLL_BITS
+
+
+def murmur3_32(s: str, seed: int = 0) -> int:
+    data = s.encode("utf-8")
+    c1, c2 = 0xCC9E2D51, 0x1B873593
+    h = seed & 0xFFFFFFFF
+    n = len(data) // 4 * 4
+    for i in range(0, n, 4):
+        k = int.from_bytes(data[i : i + 4], "little")
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+        h = ((h << 13) | (h >> 19)) & 0xFFFFFFFF
+        h = (h * 5 + 0xE6546B64) & 0xFFFFFFFF
+    k = 0
+    tail = data[n:]
+    if len(tail) >= 3:
+        k ^= tail[2] << 16
+    if len(tail) >= 2:
+        k ^= tail[1] << 8
+    if len(tail) >= 1:
+        k ^= tail[0]
+        k = (k * c1) & 0xFFFFFFFF
+        k = ((k << 15) | (k >> 17)) & 0xFFFFFFFF
+        k = (k * c2) & 0xFFFFFFFF
+        h ^= k
+    h ^= len(data)
+    h ^= h >> 16
+    h = (h * 0x85EBCA6B) & 0xFFFFFFFF
+    h ^= h >> 13
+    h = (h * 0xC2B2AE35) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h
+
+
+def hash32_device(x):
+    """32-bit integer mix on a device array (jax). Input any int dtype."""
+    import jax.numpy as jnp
+
+    h = x.astype(jnp.uint32)
+    h = h * jnp.uint32(2654435761)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x45D9F3B)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hll_update_host(registers: np.ndarray, hashes: np.ndarray) -> np.ndarray:
+    """Fold uint32 hashes into HLL registers (numpy, vectorized)."""
+    if hashes.size == 0:
+        return registers
+    h = hashes.astype(np.uint32)
+    reg = (h >> (32 - HLL_BITS)).astype(np.int64)
+    rest = (h << HLL_BITS).astype(np.uint32)
+    with np.errstate(divide="ignore"):
+        lz = np.where(rest > 0, 31 - np.floor(np.log2(rest.astype(np.float64))).astype(np.int64), 32)
+    rank = np.clip(lz + 1, 1, 32 - HLL_BITS + 1)
+    np.maximum.at(registers, reg, rank.astype(registers.dtype))
+    return registers

@@ -9,6 +9,24 @@ import os
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# If a TPU-tunnel plugin (axon) was registered by sitecustomize, deregister
+# it: its get_backend hook initializes the tunnel client even under
+# JAX_PLATFORMS=cpu and blocks forever when the tunnel is down. Tests are
+# CPU-only by design, so dropping the factory is always safe here.
+try:  # pragma: no cover - environment-specific
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    for _alias, _plats in list(getattr(_xb, "_alias_to_platforms", {}).items()):
+        if "axon" in _plats:
+            _plats.remove("axon")
+    # the plugin may have pinned jax_platforms=axon via the config API,
+    # which overrides the env var — force cpu back
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,179 @@
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.doc_parser import DocumentParser
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.context import SegmentContext
+from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggs, reduce_aggs
+
+DOCS = [
+    {"tag": "red", "n": 10, "price": 1.0, "ts": "2026-01-05"},
+    {"tag": "blue", "n": 20, "price": 2.0, "ts": "2026-01-15"},
+    {"tag": "red", "n": 30, "price": 3.0, "ts": "2026-02-05"},
+    {"tag": ["red", "green"], "n": 40, "price": 4.0, "ts": "2026-02-20"},
+    {"tag": "blue", "n": 50, "price": 5.0, "ts": "2026-03-01"},
+    {"n": 60, "price": 6.0, "ts": "2026-03-15"},
+]
+
+MAPPING = {
+    "properties": {
+        "tag": {"type": "keyword"},
+        "n": {"type": "long"},
+        "price": {"type": "double"},
+        "ts": {"type": "date"},
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    m = Mappings(MAPPING)
+    reg = AnalysisRegistry()
+    parser = DocumentParser(m, reg)
+    b = SegmentBuilder(m)
+    for i, d in enumerate(DOCS):
+        b.add(parser.parse(str(i), d))
+    seg = b.freeze()
+    return SegmentContext(seg, m, reg)
+
+
+def run_one(ctx, dsl, mask=None):
+    import jax.numpy as jnp
+
+    aggs = parse_aggs(dsl)
+    if mask is None:
+        mask = (jnp.arange(ctx.D) < ctx.segment.num_docs) & ctx.segment.live
+    partials = run_aggs(aggs, ctx, mask)
+    return reduce_aggs(aggs, [partials])
+
+
+def test_metrics_basic(ctx):
+    out = run_one(ctx, {
+        "s": {"sum": {"field": "n"}},
+        "a": {"avg": {"field": "n"}},
+        "mn": {"min": {"field": "n"}},
+        "mx": {"max": {"field": "n"}},
+        "vc": {"value_count": {"field": "tag"}},
+    })
+    assert out["s"]["value"] == 210
+    assert out["a"]["value"] == 35
+    assert out["mn"]["value"] == 10
+    assert out["mx"]["value"] == 60
+    assert out["vc"]["value"] == 5  # docs with tag
+
+
+def test_stats_extended(ctx):
+    out = run_one(ctx, {"st": {"extended_stats": {"field": "price"}}})
+    st = out["st"]
+    assert st["count"] == 6 and st["sum"] == 21 and st["min"] == 1 and st["max"] == 6
+    assert st["avg"] == pytest.approx(3.5)
+    assert st["variance"] == pytest.approx(np.var([1, 2, 3, 4, 5, 6]), rel=1e-5)
+
+
+def test_terms_keyword_multivalue(ctx):
+    out = run_one(ctx, {"t": {"terms": {"field": "tag"}}})
+    buckets = {b["key"]: b["doc_count"] for b in out["t"]["buckets"]}
+    assert buckets == {"red": 3, "blue": 2, "green": 1}
+    # default order: count desc
+    assert out["t"]["buckets"][0]["key"] == "red"
+
+
+def test_terms_numeric(ctx):
+    out = run_one(ctx, {"t": {"terms": {"field": "n", "size": 3}}})
+    assert len(out["t"]["buckets"]) == 3
+    assert all(b["doc_count"] == 1 for b in out["t"]["buckets"])
+
+
+def test_terms_with_sub_avg(ctx):
+    out = run_one(ctx, {
+        "t": {"terms": {"field": "tag"}, "aggs": {"ap": {"avg": {"field": "price"}}}}
+    })
+    by_key = {b["key"]: b for b in out["t"]["buckets"]}
+    assert by_key["red"]["ap"]["value"] == pytest.approx((1 + 3 + 4) / 3)
+    assert by_key["blue"]["ap"]["value"] == pytest.approx((2 + 5) / 2)
+
+
+def test_histogram(ctx):
+    out = run_one(ctx, {"h": {"histogram": {"field": "n", "interval": 25}}})
+    assert [(b["key"], b["doc_count"]) for b in out["h"]["buckets"]] == [
+        (0.0, 2), (25.0, 2), (50.0, 2)]
+
+
+def test_date_histogram_month(ctx):
+    out = run_one(ctx, {"h": {"date_histogram": {"field": "ts", "interval": "month"}}})
+    counts = [b["doc_count"] for b in out["h"]["buckets"]]
+    assert sum(counts) == 6
+    assert len(counts) == 3  # Jan, Feb, Mar
+
+
+def test_range_agg_with_subs(ctx):
+    out = run_one(ctx, {
+        "r": {"range": {"field": "n", "ranges": [
+            {"to": 25}, {"from": 25, "to": 45}, {"from": 45}]},
+            "aggs": {"s": {"sum": {"field": "price"}}}}
+    })
+    b = out["r"]["buckets"]
+    assert [x["doc_count"] for x in b] == [2, 2, 2]
+    assert b[0]["s"]["value"] == pytest.approx(3.0)  # price 1+2
+    assert b[2]["s"]["value"] == pytest.approx(11.0)  # price 5+6
+
+
+def test_filter_filters_global_missing(ctx):
+    import jax.numpy as jnp
+
+    # narrow query mask to n >= 30 (docs 2..5)
+    qmask = (jnp.arange(ctx.D) < ctx.segment.num_docs) & ctx.segment.live
+    from elasticsearch_tpu.search.queries import parse_query
+
+    _, qm = parse_query({"range": {"n": {"gte": 30}}}).execute(ctx)
+    qmask = qmask & qm
+    aggs = parse_aggs({
+        "f": {"filter": {"term": {"tag": "red"}}},
+        "fs": {"filters": {"filters": {"r": {"term": {"tag": "red"}}, "b": {"term": {"tag": "blue"}}}}},
+        "g": {"global": {}, "aggs": {"s": {"sum": {"field": "n"}}}},
+        "m": {"missing": {"field": "tag"}},
+    })
+    partials = run_aggs(aggs, ctx, qmask)
+    out = reduce_aggs(aggs, [partials])
+    assert out["f"]["doc_count"] == 2  # docs 2,3 red with n>=30
+    assert out["fs"]["buckets"]["r"]["doc_count"] == 2
+    assert out["fs"]["buckets"]["b"]["doc_count"] == 1  # doc 4
+    assert out["g"]["doc_count"] == 6  # global ignores query
+    assert out["g"]["s"]["value"] == 210
+    assert out["m"]["doc_count"] == 1  # doc 5
+
+
+def test_cardinality(ctx):
+    out = run_one(ctx, {"c": {"cardinality": {"field": "tag"}}})
+    assert out["c"]["value"] == 3
+    out = run_one(ctx, {"c": {"cardinality": {"field": "n"}}})
+    assert out["c"]["value"] == 6
+
+
+def test_percentiles(ctx):
+    out = run_one(ctx, {"p": {"percentiles": {"field": "n", "percents": [50]}}})
+    assert out["p"]["values"]["50.0"] == pytest.approx(35.0)
+
+
+def test_two_level_bucket_nesting(ctx):
+    out = run_one(ctx, {
+        "t": {"terms": {"field": "tag"},
+              "aggs": {"h": {"histogram": {"field": "n", "interval": 25}}}}
+    })
+    red = [b for b in out["t"]["buckets"] if b["key"] == "red"][0]
+    hist = {b["key"]: b["doc_count"] for b in red["h"]["buckets"]}
+    assert hist == {0.0: 1, 25.0: 2}  # n=10 | n=30,40
+
+
+def test_significant_terms(ctx):
+    import jax.numpy as jnp
+    from elasticsearch_tpu.search.queries import parse_query
+
+    _, qm = parse_query({"range": {"n": {"lte": 20}}}).execute(ctx)
+    aggs = parse_aggs({"sig": {"significant_terms": {"field": "tag"}}})
+    partials = run_aggs(aggs, ctx, qm)
+    out = reduce_aggs(aggs, [partials])
+    keys = [b["key"] for b in out["sig"]["buckets"]]
+    assert "blue" in keys or "red" in keys

@@ -1,0 +1,249 @@
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.doc_parser import DocumentParser
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.context import SegmentContext
+from elasticsearch_tpu.search.queries import parse_query
+from elasticsearch_tpu.utils.errors import QueryParsingException
+
+DOCS = [
+    {"title": "quick brown fox", "body": "the quick brown fox jumps over the lazy dog",
+     "tag": "animal", "price": 10, "ts": "2026-01-01", "loc": {"lat": 48.85, "lon": 2.35}},
+    {"title": "lazy dog sleeps", "body": "a lazy dog sleeps all day long",
+     "tag": "animal", "price": 25, "ts": "2026-02-01", "loc": {"lat": 40.71, "lon": -74.0}},
+    {"title": "fast cars", "body": "quick fast cars drive on roads",
+     "tag": "vehicle", "price": 5000, "ts": "2026-03-01", "loc": {"lat": 51.5, "lon": -0.12}},
+    {"title": "slow trains", "body": "trains are never quick but always on rails",
+     "tag": "vehicle", "price": 120, "ts": "2026-04-15"},
+    {"title": "brown bears", "body": "brown bears fish in quick rivers",
+     "tag": "animal", "price": 0, "ts": "2026-05-20"},
+]
+
+MAPPING = {
+    "properties": {
+        "title": {"type": "text"},
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "price": {"type": "long"},
+        "ts": {"type": "date"},
+        "loc": {"type": "geo_point"},
+    }
+}
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    m = Mappings(MAPPING)
+    reg = AnalysisRegistry()
+    parser = DocumentParser(m, reg)
+    b = SegmentBuilder(m)
+    for i, d in enumerate(DOCS):
+        b.add(parser.parse(str(i), d))
+    seg = b.freeze()
+    return SegmentContext(seg, m, reg)
+
+
+def run(ctx, dsl):
+    q = parse_query(dsl)
+    scores, mask = q.execute(ctx)
+    m = np.asarray(mask)[: ctx.segment.num_docs]
+    s = None if scores is None else np.asarray(scores)[: ctx.segment.num_docs]
+    return s, m
+
+
+def hits(ctx, dsl):
+    _, m = run(ctx, dsl)
+    return sorted(np.nonzero(m)[0].tolist())
+
+
+def test_match_all_and_none(ctx):
+    assert hits(ctx, {"match_all": {}}) == [0, 1, 2, 3, 4]
+    assert hits(ctx, {"match_none": {}}) == []
+
+
+def test_match_or_and(ctx):
+    assert hits(ctx, {"match": {"body": "quick dog"}}) == [0, 1, 2, 3, 4]
+    assert hits(ctx, {"match": {"body": {"query": "quick dog", "operator": "and"}}}) == [0]
+
+
+def test_match_scores_ranked(ctx):
+    s, m = run(ctx, {"match": {"body": "quick dog"}})
+    assert s[0] == max(s[m])  # doc 0 has both terms
+
+
+def test_minimum_should_match(ctx):
+    assert hits(ctx, {"match": {"body": {"query": "quick dog rails", "minimum_should_match": 2}}}) == [0, 3]
+
+
+def test_term_keyword_and_numeric(ctx):
+    assert hits(ctx, {"term": {"tag": "animal"}}) == [0, 1, 4]
+    assert hits(ctx, {"term": {"price": 120}}) == [3]
+    assert hits(ctx, {"terms": {"tag": ["animal", "vehicle"]}}) == [0, 1, 2, 3, 4]
+
+
+def test_range_numeric_and_date(ctx):
+    assert hits(ctx, {"range": {"price": {"gte": 25, "lt": 5000}}}) == [1, 3]
+    assert hits(ctx, {"range": {"ts": {"gte": "2026-02-01", "lte": "2026-04-15"}}}) == [1, 2, 3]
+    assert hits(ctx, {"range": {"price": {"gt": 0}}}) == [0, 1, 2, 3]
+
+
+def test_range_keyword(ctx):
+    assert hits(ctx, {"range": {"tag": {"gte": "animal", "lt": "vehicle"}}}) == [0, 1, 4]
+
+
+def test_bool_combinations(ctx):
+    dsl = {
+        "bool": {
+            "must": [{"match": {"body": "quick"}}],
+            "filter": [{"term": {"tag": "animal"}}],
+            "must_not": [{"match": {"title": "lazy"}}],
+        }
+    }
+    assert hits(ctx, dsl) == [0, 4]
+
+
+def test_bool_should_msm(ctx):
+    dsl = {
+        "bool": {
+            "should": [
+                {"term": {"tag": "animal"}},
+                {"range": {"price": {"gte": 100}}},
+                {"match": {"title": "fox"}},
+            ],
+            "minimum_should_match": 2,
+        }
+    }
+    assert hits(ctx, dsl) == [0]  # only doc 0 matches two clauses (animal + fox)
+
+
+def test_exists_missing(ctx):
+    assert hits(ctx, {"exists": {"field": "loc.lat"}}) == [0, 1, 2]
+    assert hits(ctx, {"missing": {"field": "loc.lat"}}) == [3, 4]
+
+
+def test_ids(ctx):
+    assert hits(ctx, {"ids": {"values": ["1", "3", "99"]}}) == [1, 3]
+
+
+def test_prefix_wildcard_regexp_fuzzy(ctx):
+    assert hits(ctx, {"prefix": {"body": "rail"}}) == [3]
+    assert hits(ctx, {"wildcard": {"body": "r*s"}}) == [2, 3, 4]  # roads, rails, rivers
+    assert hits(ctx, {"regexp": {"body": "qu.ck"}}) == [0, 2, 3, 4]
+    assert hits(ctx, {"fuzzy": {"body": "quik"}}) == [0, 2, 3, 4]
+
+
+def test_match_phrase(ctx):
+    assert hits(ctx, {"match_phrase": {"body": "quick brown fox"}}) == [0]
+    assert hits(ctx, {"match_phrase": {"body": "brown quick"}}) == []
+    assert hits(ctx, {"match_phrase": {"body": {"query": "quick fox", "slop": 1}}}) == [0]
+
+
+def test_match_phrase_stopword_gap(ctx):
+    # "jumps over the lazy" — "the" is NOT a stopword for standard analyzer,
+    # so exact consecutive positions required
+    assert hits(ctx, {"match_phrase": {"body": "jumps over the lazy dog"}}) == [0]
+
+
+def test_constant_score_and_boost(ctx):
+    s, m = run(ctx, {"constant_score": {"filter": {"term": {"tag": "animal"}}, "boost": 3.5}})
+    assert sorted(np.nonzero(m)[0].tolist()) == [0, 1, 4]
+    assert np.allclose(s[m], 3.5)
+
+
+def test_dis_max(ctx):
+    s, m = run(ctx, {"dis_max": {"queries": [
+        {"match": {"title": "fox"}}, {"match": {"body": "fox"}}]}})
+    assert sorted(np.nonzero(m)[0].tolist()) == [0]
+
+
+def test_filtered_legacy(ctx):
+    dsl = {"filtered": {"query": {"match": {"body": "quick"}}, "filter": {"term": {"tag": "vehicle"}}}}
+    assert hits(ctx, dsl) == [2, 3]
+
+
+def test_multi_match(ctx):
+    assert hits(ctx, {"multi_match": {"query": "fox sleeps", "fields": ["title", "body"]}}) == [0, 1]
+
+
+def test_query_string(ctx):
+    assert hits(ctx, {"query_string": {"query": "tag:animal AND body:quick"}}) == [0, 4]
+    assert hits(ctx, {"query_string": {"query": "quick -dog", "default_field": "body"}}) == [2, 3, 4]
+    assert hits(ctx, {"query_string": {"query": 'body:"quick brown fox"'}}) == [0]
+
+
+def test_function_score_field_value_factor(ctx):
+    dsl = {
+        "function_score": {
+            "query": {"match": {"body": "quick"}},
+            "field_value_factor": {"field": "price", "modifier": "log1p", "factor": 1.0},
+            "boost_mode": "replace",
+        }
+    }
+    s, m = run(ctx, dsl)
+    assert np.argmax(np.where(m, s, -np.inf)) == 2  # price 5000 dominates
+
+
+def test_function_score_script(ctx):
+    dsl = {
+        "function_score": {
+            "query": {"match_all": {}},
+            "script_score": {"script": "doc['price'].value * 2 + 1"},
+            "boost_mode": "replace",
+        }
+    }
+    s, m = run(ctx, dsl)
+    assert np.allclose(s[m], [21, 51, 10001, 241, 1])
+
+
+def test_script_query_filter(ctx):
+    assert hits(ctx, {"script": {"script": "doc['price'].value > 100"}}) == [2, 3]
+
+
+def test_decay_gauss(ctx):
+    dsl = {
+        "function_score": {
+            "functions": [{"gauss": {"price": {"origin": 0, "scale": 100}}}],
+            "boost_mode": "replace",
+        }
+    }
+    s, m = run(ctx, dsl)
+    assert s[4] == pytest.approx(1.0)  # price 0 at origin
+    assert s[2] < 0.01  # price 5000 decayed away
+
+
+def test_geo_distance(ctx):
+    # within 500km of Paris: only doc 0 (Paris itself); London is ~344km!
+    assert hits(ctx, {"geo_distance": {"distance": "100km", "loc": {"lat": 48.85, "lon": 2.35}}}) == [0]
+    assert hits(ctx, {"geo_distance": {"distance": "400km", "loc": {"lat": 48.85, "lon": 2.35}}}) == [0, 2]
+
+
+def test_geo_bounding_box(ctx):
+    dsl = {"geo_bounding_box": {"loc": {"top_left": {"lat": 52, "lon": -1},
+                                        "bottom_right": {"lat": 51, "lon": 1}}}}
+    assert hits(ctx, dsl) == [2]
+
+
+def test_more_like_this(ctx):
+    dsl = {"more_like_this": {"fields": ["body"], "like": ["quick brown fox dog"],
+                              "min_term_freq": 1, "min_doc_freq": 1}}
+    s, m = run(ctx, dsl)
+    assert np.argmax(np.where(m, s, -np.inf)) == 0
+
+
+def test_unknown_query_raises(ctx):
+    with pytest.raises(QueryParsingException):
+        parse_query({"frobnicate": {}})
+    with pytest.raises(QueryParsingException):
+        parse_query({"span_term": {"body": "x"}})
+
+
+def test_boosting_query(ctx):
+    dsl = {"boosting": {"positive": {"match": {"body": "quick"}},
+                        "negative": {"term": {"tag": "vehicle"}},
+                        "negative_boost": 0.1}}
+    s, m = run(ctx, dsl)
+    assert m.sum() == 4  # docs containing "quick"
+    assert s[2] < s[0]

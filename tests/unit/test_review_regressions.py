@@ -84,3 +84,88 @@ def test_lazy_live_mask_refresh():
     seg.delete_local(2)
     live = np.asarray(seg.live)
     assert live[:3].tolist() == [False, True, False]
+
+
+def _mini_ctx(docs, mapping):
+    from elasticsearch_tpu.search.context import SegmentContext
+
+    m = Mappings(mapping)
+    reg = AnalysisRegistry()
+    parser = DocumentParser(m, reg)
+    b = SegmentBuilder(m)
+    for i, d in enumerate(docs):
+        b.add(parser.parse(str(i), d))
+    return SegmentContext(b.freeze(), m, reg)
+
+
+def test_chunked_slices_p_covers_full_chunks(monkeypatch):
+    import elasticsearch_tpu.search.context as C
+
+    monkeypatch.setattr(C, "P_MAX", 4)
+    docs = [{"t": "x"} for _ in range(10)]  # term "x" in 10 docs -> runs of 4,4,2
+    ctx = _mini_ctx(docs, {"properties": {"t": {"type": "text"}}})
+    inv = ctx.inv("t")
+    starts, lens, ws, P, n = ctx.chunked_slices(inv, ["x"], [1.0])
+    assert P >= 4  # must cover the full-width chunks, not just the tail of 2
+    from elasticsearch_tpu.ops.scoring import match_count_segment
+
+    counts = np.asarray(match_count_segment(inv.doc_ids, starts, lens, P=P, D=ctx.D))
+    assert counts[:10].tolist() == [1] * 10
+
+
+def test_match_phrase_prefix_mixed_empty_expansion():
+    ctx = _mini_ctx(
+        [{"t": "quick broke it"}, {"t": "brown alone"}],
+        {"properties": {"t": {"type": "text"}}},
+    )
+    from elasticsearch_tpu.search.queries import parse_query
+
+    s, m = parse_query({"match_phrase_prefix": {"t": "quick bro"}}).execute(ctx)
+    assert np.nonzero(np.asarray(m)[:2])[0].tolist() == [0]
+
+
+def test_cardinality_double_field():
+    from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggs, reduce_aggs
+    import jax.numpy as jnp
+
+    ctx = _mini_ctx(
+        [{"p": 1.5}, {"p": 2.5}, {"p": 1.5}],
+        {"properties": {"p": {"type": "double"}}},
+    )
+    aggs = parse_aggs({"c": {"cardinality": {"field": "p"}}})
+    mask = (jnp.arange(ctx.D) < ctx.segment.num_docs)
+    out = reduce_aggs(aggs, [run_aggs(aggs, ctx, mask)])
+    assert out["c"]["value"] == 2
+
+
+def test_cardinality_multivalued_keyword_and_cross_segment_merge():
+    from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggs, reduce_aggs
+    import jax.numpy as jnp
+
+    mapping = {"properties": {"tag": {"type": "keyword"}}}
+    ctx1 = _mini_ctx([{"tag": ["a", "b"]}, {"tag": ["c", "d"]}], mapping)
+    ctx2 = _mini_ctx([{"tag": ["c", "e"]}], mapping)  # c overlaps segment 1
+    aggs = parse_aggs({"c": {"cardinality": {"field": "tag"}}})
+    p1 = run_aggs(aggs, ctx1, jnp.arange(ctx1.D) < ctx1.segment.num_docs)
+    p2 = run_aggs(aggs, ctx2, jnp.arange(ctx2.D) < ctx2.segment.num_docs)
+    out = reduce_aggs(aggs, [p1, p2])
+    assert out["c"]["value"] == 5  # a b c d e — ords would double-count c
+
+
+def test_function_score_sum_with_filtered_function():
+    from elasticsearch_tpu.search.queries import parse_query
+
+    ctx = _mini_ctx(
+        [{"t": "hit", "p": 1.0}, {"t": "hit", "p": 2.0}],
+        {"properties": {"t": {"type": "text"}, "p": {"type": "double"}}},
+    )
+    dsl = {"function_score": {
+        "query": {"match": {"t": "hit"}},
+        "functions": [
+            {"filter": {"range": {"p": {"gte": 2}}}, "weight": 10},
+        ],
+        "score_mode": "sum", "boost_mode": "replace"}}
+    s, m = parse_query(dsl).execute(ctx)
+    s = np.asarray(s)
+    assert s[1] == 10.0  # matches filter -> weight
+    assert s[0] == 1.0  # matches NO function -> neutral factor 1, not 0/1-inflated
