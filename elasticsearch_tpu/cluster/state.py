@@ -1,0 +1,139 @@
+"""Cluster state: nodes, index metadata, routing table, blocks.
+
+Reference: org/elasticsearch/cluster/ClusterState.java, metadata/MetaData.java,
+routing/RoutingTable.java, node/DiscoveryNodes.java. Single-node now; the
+state object is already shaped for the multi-host design (parallel/ docs):
+a master (process rank 0 under jax.distributed) publishes versioned states,
+and shard routing maps (index, shard, primary?) → node + mesh device.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class DiscoveryNode:
+    node_id: str
+    name: str
+    transport_address: str = "local"
+    roles: tuple = ("master", "data", "ingest")
+    attributes: dict = field(default_factory=dict)
+
+
+@dataclass
+class ShardRouting:
+    index: str
+    shard_id: int
+    node_id: str
+    primary: bool = True
+    state: str = "STARTED"  # INITIALIZING|RELOCATING|STARTED|UNASSIGNED
+    device_ord: int = 0  # mesh device carrying this shard's segments
+
+
+@dataclass
+class IndexMetadata:
+    name: str
+    settings: dict
+    mappings: dict
+    aliases: Dict[str, dict] = field(default_factory=dict)
+    state: str = "open"
+    creation_date: int = field(default_factory=lambda: int(time.time() * 1000))
+    uuid: str = field(default_factory=lambda: uuid.uuid4().hex)
+
+
+class ClusterState:
+    def __init__(self, cluster_name: str = "elasticsearch_tpu"):
+        self.cluster_name = cluster_name
+        self.version = 0
+        self.state_uuid = uuid.uuid4().hex
+        self.nodes: Dict[str, DiscoveryNode] = {}
+        self.master_node_id: Optional[str] = None
+        self.indices: Dict[str, IndexMetadata] = {}
+        self.routing: List[ShardRouting] = []
+        self.templates: Dict[str, dict] = {}
+        self.blocks: Dict[str, list] = {}
+
+    def next_version(self):
+        self.version += 1
+        self.state_uuid = uuid.uuid4().hex
+
+    def add_node(self, node: DiscoveryNode, master: bool = False):
+        self.nodes[node.node_id] = node
+        if master or self.master_node_id is None:
+            self.master_node_id = node.node_id
+        self.next_version()
+
+    def add_index(self, meta: IndexMetadata, num_shards: int, node_id: str, n_devices: int = 1):
+        self.indices[meta.name] = meta
+        for sid in range(num_shards):
+            self.routing.append(
+                ShardRouting(meta.name, sid, node_id, device_ord=sid % max(n_devices, 1))
+            )
+        self.next_version()
+
+    def remove_index(self, name: str):
+        self.indices.pop(name, None)
+        self.routing = [r for r in self.routing if r.index != name]
+        self.next_version()
+
+    def health(self) -> dict:
+        unassigned = sum(1 for r in self.routing if r.state == "UNASSIGNED")
+        initializing = sum(1 for r in self.routing if r.state == "INITIALIZING")
+        active = sum(1 for r in self.routing if r.state == "STARTED")
+        status = "green"
+        if unassigned or initializing:
+            status = "yellow" if active else "red"
+        return {
+            "cluster_name": self.cluster_name,
+            "status": status,
+            "timed_out": False,
+            "number_of_nodes": len(self.nodes),
+            "number_of_data_nodes": sum(1 for n in self.nodes.values() if "data" in n.roles),
+            "active_primary_shards": sum(1 for r in self.routing if r.primary and r.state == "STARTED"),
+            "active_shards": active,
+            "relocating_shards": sum(1 for r in self.routing if r.state == "RELOCATING"),
+            "initializing_shards": initializing,
+            "unassigned_shards": unassigned,
+        }
+
+    def to_json(self) -> dict:
+        return {
+            "cluster_name": self.cluster_name,
+            "version": self.version,
+            "state_uuid": self.state_uuid,
+            "master_node": self.master_node_id,
+            "nodes": {
+                nid: {"name": n.name, "transport_address": n.transport_address,
+                      "roles": list(n.roles)}
+                for nid, n in self.nodes.items()
+            },
+            "metadata": {
+                "templates": self.templates,
+                "indices": {
+                    name: {
+                        "state": m.state,
+                        "settings": m.settings,
+                        "mappings": m.mappings,
+                        "aliases": list(m.aliases),
+                    }
+                    for name, m in self.indices.items()
+                },
+            },
+            "routing_table": {
+                "indices": {
+                    name: {
+                        "shards": {
+                            str(r.shard_id): [{
+                                "state": r.state, "primary": r.primary,
+                                "node": r.node_id, "shard": r.shard_id, "index": r.index,
+                            }]
+                            for r in self.routing if r.index == name
+                        }
+                    }
+                    for name in self.indices
+                }
+            },
+        }

@@ -1,0 +1,339 @@
+"""Index engine: the write path.
+
+Reference: org/elasticsearch/index/engine/InternalEngine.java — in-memory
+indexing buffer, near-real-time refresh, flush (durability handoff to
+segments), versioned CRUD with optimistic concurrency, realtime GET served
+from the not-yet-refreshed buffer, tombstone deletes, and merge scheduling.
+
+TPU adaptation: "refresh" freezes the RAM buffer into an immutable
+device-resident TpuSegment (instead of a Lucene flush-to-codec); deletes
+flip bits in per-segment live masks; merge re-indexes live docs' _source
+through the analysis chain into one new segment (equivalent output to a
+postings-level merge because segments are derived purely from source+
+mappings; noted deviation from Lucene's codec-level merge).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.doc_parser import DocumentParser, ParsedDocument
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.segment import SegmentBuilder, TpuSegment
+from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.utils.errors import (
+    DocumentMissingException,
+    VersionConflictException,
+)
+
+
+@dataclass
+class DocLocation:
+    version: int
+    deleted: bool = False
+    # "buffer" or a segment id; buffer docs re-resolve on refresh
+    where: Any = "buffer"
+    local_id: int = -1
+    source: Optional[dict] = None  # for realtime get of buffered docs
+
+
+@dataclass
+class EngineStats:
+    index_total: int = 0
+    delete_total: int = 0
+    get_total: int = 0
+    refresh_total: int = 0
+    flush_total: int = 0
+    merge_total: int = 0
+    index_time_ms: float = 0.0
+
+
+class Engine:
+    def __init__(
+        self,
+        mappings: Mappings,
+        analysis: AnalysisRegistry,
+        translog_path: Optional[str] = None,
+        refresh_interval_docs: int = 0,
+        merge_segment_count: int = 8,
+    ):
+        self.mappings = mappings
+        self.analysis = analysis
+        self.parser = DocumentParser(mappings, analysis)
+        self.translog = Translog(translog_path)
+        self.buffer = SegmentBuilder(mappings)
+        self.segments: List[TpuSegment] = []
+        self._locations: Dict[str, DocLocation] = {}
+        self._buffer_ids: Dict[str, int] = {}
+        self._lock = threading.RLock()
+        self.stats = EngineStats()
+        self.merge_segment_count = merge_segment_count
+        self._auto_id = 0
+
+    # -- write path ------------------------------------------------------------
+
+    def index(
+        self,
+        doc_id: Optional[str],
+        source: dict,
+        version: Optional[int] = None,
+        version_type: str = "internal",
+        op_type: str = "index",
+        routing: Optional[str] = None,
+        _replay: bool = False,
+    ) -> Tuple[str, int, bool]:
+        """Index/create a document. Returns (id, new_version, created).
+
+        Version semantics mirror InternalEngine.index: internal versioning
+        requires the provided version to equal the current one; external
+        requires it to be strictly greater. op_type=create fails if the doc
+        exists (DocWriteRequest.OpType.CREATE).
+        """
+        t0 = time.perf_counter()
+        with self._lock:
+            if doc_id is None:
+                self._auto_id += 1
+                doc_id = f"auto_{self._auto_id}_{int(time.time() * 1000)}"
+            doc_id = str(doc_id)
+            loc = self._locations.get(doc_id)
+            current = loc.version if (loc and not loc.deleted) else 0
+            exists = loc is not None and not loc.deleted
+            if op_type == "create" and exists:
+                raise VersionConflictException(self.mappings.meta.get("index", ""), doc_id, current, 0)
+            if version is not None:
+                if version_type == "external":
+                    if loc is not None and version <= loc.version:
+                        raise VersionConflictException("", doc_id, loc.version, version)
+                    new_version = version
+                else:
+                    if current != version:
+                        raise VersionConflictException("", doc_id, current, version)
+                    new_version = current + 1
+            else:
+                new_version = (loc.version if loc else 0) + 1
+
+            parsed = self.parser.parse(doc_id, source, routing=routing)
+            self._remove_existing(doc_id)
+            local = self.buffer.add(parsed)
+            self._buffer_ids[doc_id] = local
+            self._locations[doc_id] = DocLocation(
+                version=new_version, deleted=False, where="buffer", local_id=local, source=source
+            )
+            if not _replay:
+                self.translog.append(
+                    {"op": "index", "id": doc_id, "source": source, "version": new_version,
+                     "routing": routing}
+                )
+            self.stats.index_total += 1
+            self.stats.index_time_ms += (time.perf_counter() - t0) * 1000
+            return doc_id, new_version, not exists
+
+    def delete(self, doc_id: str, version: Optional[int] = None,
+               version_type: str = "internal", _replay: bool = False) -> int:
+        with self._lock:
+            doc_id = str(doc_id)
+            loc = self._locations.get(doc_id)
+            if loc is None or loc.deleted:
+                raise DocumentMissingException("", doc_id)
+            if version is not None and version_type == "internal" and loc.version != version:
+                raise VersionConflictException("", doc_id, loc.version, version)
+            self._remove_existing(doc_id)
+            new_version = loc.version + 1
+            self._locations[doc_id] = DocLocation(version=new_version, deleted=True, where=None)
+            if not _replay:
+                self.translog.append({"op": "delete", "id": doc_id, "version": new_version})
+            self.stats.delete_total += 1
+            return new_version
+
+    def update(self, doc_id: str, partial: Optional[dict] = None,
+               script: Optional[str] = None, script_params: Optional[dict] = None,
+               upsert: Optional[dict] = None, doc_as_upsert: bool = False) -> Tuple[int, bool]:
+        """Partial update (RestUpdateAction semantics): merge `partial` into
+        the current source, or create from `upsert` when missing."""
+        with self._lock:
+            doc_id = str(doc_id)
+            got = self.get(doc_id)
+            if got is None:
+                if upsert is not None:
+                    _, v, _ = self.index(doc_id, upsert)
+                    return v, True
+                if doc_as_upsert and partial is not None:
+                    _, v, _ = self.index(doc_id, partial)
+                    return v, True
+                raise DocumentMissingException("", doc_id)
+            source = dict(got["_source"])
+            if script is not None:
+                source = self._run_update_script(script, script_params or {}, source)
+            elif partial is not None:
+                _deep_merge(source, partial)
+            _, v, _ = self.index(doc_id, source)
+            return v, False
+
+    def _run_update_script(self, script: str, params: dict, source: dict) -> dict:
+        """Update scripts mutate ctx._source; painless-lite is expression-only,
+        so we support the common `ctx._source.<field> = <expr>` statement list."""
+        from elasticsearch_tpu.search.scripting import compile_script
+        from elasticsearch_tpu.utils.errors import ScriptException
+
+        for stmt in script.split(";"):
+            stmt = stmt.strip()
+            if not stmt:
+                continue
+            if "=" in stmt and "==" not in stmt.split("=", 1)[0]:
+                lhs, _, rhs = stmt.partition("=")
+                lhs = lhs.strip()
+                prefix = "ctx._source."
+                if not lhs.startswith(prefix):
+                    raise ScriptException(f"update script must assign ctx._source.*: [{stmt}]")
+                field = lhs[len(prefix):]
+                rhs = rhs.strip()
+                for fname, fval in source.items():
+                    rhs = rhs.replace(f"ctx._source.{fname}", repr(fval))
+                cs = compile_script(rhs)
+                val = cs.run(lambda f: None, params=params)
+                if hasattr(val, "item"):
+                    val = val.item()
+                source[field] = val
+            else:
+                raise ScriptException(f"unsupported update script statement [{stmt}]")
+        return source
+
+    def _remove_existing(self, doc_id: str):
+        loc = self._locations.get(doc_id)
+        if loc is None or loc.deleted:
+            return
+        if loc.where == "buffer":
+            # mark the buffered doc dead; freeze() skips tombstoned entries
+            idx = self._buffer_ids.pop(doc_id, None)
+            if idx is not None:
+                self.buffer.docs[idx] = None  # type: ignore[assignment]
+        else:
+            for seg in self.segments:
+                if seg.seg_id == loc.where:
+                    seg.delete_local(loc.local_id)
+                    break
+
+    # -- read path -------------------------------------------------------------
+
+    def get(self, doc_id: str, realtime: bool = True) -> Optional[dict]:
+        """Realtime get: buffered docs are visible before refresh (ES serves
+        these from the translog; we keep the source on the DocLocation)."""
+        with self._lock:
+            self.stats.get_total += 1
+            doc_id = str(doc_id)
+            loc = self._locations.get(doc_id)
+            if loc is None or loc.deleted:
+                return None
+            if loc.where == "buffer":
+                if not realtime:
+                    return None
+                return {"_id": doc_id, "_version": loc.version, "_source": loc.source, "found": True}
+            for seg in self.segments:
+                if seg.seg_id == loc.where:
+                    return {
+                        "_id": doc_id,
+                        "_version": loc.version,
+                        "_source": seg.sources[loc.local_id],
+                        "found": True,
+                    }
+            return None
+
+    def exists(self, doc_id: str) -> bool:
+        loc = self._locations.get(str(doc_id))
+        return loc is not None and not loc.deleted
+
+    @property
+    def num_docs(self) -> int:
+        with self._lock:
+            return sum(1 for l in self._locations.values() if not l.deleted)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def refresh(self) -> bool:
+        """Freeze the buffer into a new searchable segment (NRT refresh)."""
+        with self._lock:
+            live_docs = [d for d in self.buffer.docs if d is not None]
+            if not live_docs:
+                return False
+            fresh = SegmentBuilder(self.mappings)
+            for d in live_docs:
+                fresh.add(d)
+            seg = fresh.freeze()
+            self.segments.append(seg)
+            for doc_id, local in list(seg.id_map.items()):
+                loc = self._locations.get(doc_id)
+                if loc is not None and loc.where == "buffer":
+                    loc.where = seg.seg_id
+                    loc.local_id = local
+                    loc.source = None
+            self.buffer = SegmentBuilder(self.mappings)
+            self._buffer_ids.clear()
+            self.stats.refresh_total += 1
+            if len(self.segments) > self.merge_segment_count:
+                self.merge()
+            return True
+
+    def flush(self):
+        """refresh + translog commit (durability handed to segments).
+
+        NOTE: segments live in device/host memory; true on-disk segment
+        persistence is the snapshot API's job (index/snapshots.py). Flush
+        semantics here = translog generation rollover after refresh, same
+        contract as InternalEngine.flush."""
+        with self._lock:
+            self.refresh()
+            self.translog.commit()
+            self.stats.flush_total += 1
+
+    def merge(self, max_segments: Optional[int] = None):
+        """Merge all segments into one (optimize/force-merge) by re-indexing
+        live docs' source through the parser."""
+        with self._lock:
+            if len(self.segments) <= (max_segments or 1):
+                return
+            builder = SegmentBuilder(self.mappings)
+            id_order: List[str] = []
+            for seg in self.segments:
+                live = seg.live_host
+                for local, doc_id in enumerate(seg.ids):
+                    if live[local]:
+                        builder.add(self.parser.parse(doc_id, seg.sources[local]))
+                        id_order.append(doc_id)
+            merged = builder.freeze()
+            if merged is None:
+                self.segments[:] = []  # in place: searchers share this list
+                return
+            for doc_id, local in merged.id_map.items():
+                loc = self._locations.get(doc_id)
+                if loc is not None and not loc.deleted:
+                    loc.where = merged.seg_id
+                    loc.local_id = local
+            self.segments[:] = [merged]  # in place: searchers share this list
+            self.stats.merge_total += 1
+
+    def recover_from_translog(self):
+        """Replay the translog (crash recovery / shard recovery)."""
+        with self._lock:
+            for op in self.translog.replay():
+                if op["op"] == "index":
+                    self.index(op["id"], op["source"], routing=op.get("routing"), _replay=True)
+                    self._locations[op["id"]].version = op["version"]
+                elif op["op"] == "delete":
+                    try:
+                        self.delete(op["id"], _replay=True)
+                    except DocumentMissingException:
+                        pass
+
+    def close(self):
+        self.translog.close()
+
+
+def _deep_merge(dst: dict, src: dict):
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v

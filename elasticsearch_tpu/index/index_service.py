@@ -1,0 +1,181 @@
+"""IndexService: one index = mappings + analysis + N shards + routing.
+
+Reference: org/elasticsearch/index/IndexService.java plus the doc-routing
+math of org/elasticsearch/cluster/routing/OperationRouting.java
+(shard = murmur3(routing ?: id) % number_of_shards).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.shard import IndexShard
+from elasticsearch_tpu.search.context import GlobalStats
+from elasticsearch_tpu.search.service import search_shards
+from elasticsearch_tpu.utils.errors import DocumentMissingException
+from elasticsearch_tpu.utils.hashing import murmur3_32
+
+
+class IndexService:
+    def __init__(
+        self,
+        name: str,
+        settings: Optional[dict] = None,
+        mappings_json: Optional[dict] = None,
+        data_path: Optional[str] = None,
+    ):
+        self.name = name
+        self.settings = settings or {}
+        idx_settings = self.settings.get("index", self.settings)
+        self.num_shards = int(idx_settings.get("number_of_shards", 1))
+        self.num_replicas = int(idx_settings.get("number_of_replicas", 0))
+        self.analysis = AnalysisRegistry(self.settings)
+        self.mappings = Mappings(mappings_json or {})
+        self.aliases: Dict[str, dict] = {}
+        self.data_path = data_path
+        self.shards: List[IndexShard] = [
+            IndexShard(name, i, self.mappings, self.analysis, data_path)
+            for i in range(self.num_shards)
+        ]
+        self.closed = False
+
+    # -- routing ---------------------------------------------------------------
+
+    def route(self, doc_id: str, routing: Optional[str] = None) -> IndexShard:
+        key = routing if routing is not None else str(doc_id)
+        return self.shards[murmur3_32(key) % self.num_shards]
+
+    # -- document ops ----------------------------------------------------------
+
+    def index_doc(self, doc_id: Optional[str], source: dict, routing: Optional[str] = None,
+                  **kw) -> dict:
+        if doc_id is None:
+            # auto-id: route after generation
+            import uuid
+
+            doc_id = uuid.uuid4().hex[:20]
+        shard = self.route(doc_id, routing)
+        rid, version, created = shard.engine.index(doc_id, source, routing=routing, **kw)
+        return {
+            "_index": self.name,
+            "_id": rid,
+            "_version": version,
+            "result": "created" if created else "updated",
+            "created": created,
+            "_shards": {"total": 1 + self.num_replicas, "successful": 1, "failed": 0},
+        }
+
+    def get_doc(self, doc_id: str, routing: Optional[str] = None) -> dict:
+        shard = self.route(doc_id, routing)
+        got = shard.engine.get(doc_id)
+        if got is None:
+            return {"_index": self.name, "_id": doc_id, "found": False}
+        got["_index"] = self.name
+        return got
+
+    def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kw) -> dict:
+        shard = self.route(doc_id, routing)
+        version = shard.engine.delete(doc_id, **kw)
+        return {
+            "_index": self.name,
+            "_id": doc_id,
+            "_version": version,
+            "result": "deleted",
+            "found": True,
+        }
+
+    def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None) -> dict:
+        shard = self.route(doc_id, routing)
+        script = body.get("script")
+        script_src, params = None, None
+        if script is not None:
+            if isinstance(script, dict):
+                script_src = script.get("inline", script.get("source", ""))
+                params = script.get("params")
+            else:
+                script_src = script
+        version, created = shard.engine.update(
+            doc_id,
+            partial=body.get("doc"),
+            script=script_src,
+            script_params=params,
+            upsert=body.get("upsert"),
+            doc_as_upsert=bool(body.get("doc_as_upsert", False)),
+        )
+        return {
+            "_index": self.name,
+            "_id": doc_id,
+            "_version": version,
+            "result": "created" if created else "updated",
+        }
+
+    def mget(self, ids: List[str]) -> dict:
+        return {"docs": [self.get_doc(i) for i in ids]}
+
+    # -- search ----------------------------------------------------------------
+
+    def refresh(self):
+        for s in self.shards:
+            s.refresh()
+
+    def flush(self):
+        for s in self.shards:
+            s.engine.flush()
+
+    def force_merge(self, max_num_segments: int = 1):
+        for s in self.shards:
+            s.engine.merge(max_segments=max_num_segments)
+
+    def search(self, body: dict, dfs: bool = False) -> dict:
+        body = body or {}
+        global_stats = self.global_stats(body) if dfs else None
+        return search_shards(
+            [s.searcher for s in self.shards], body, index_name=self.name,
+            global_stats=global_stats,
+        )
+
+    def count(self, body: dict) -> dict:
+        total = sum(s.searcher.count(body or {}) for s in self.shards)
+        return {"count": total, "_shards": {"total": self.num_shards,
+                                            "successful": self.num_shards, "failed": 0}}
+
+    def global_stats(self, body: dict) -> GlobalStats:
+        """dfs phase: collect cross-shard df/num_docs for consistent idf
+        (reference: search/dfs/DfsPhase.java)."""
+        num_docs: Dict[str, int] = {}
+        df: Dict[Any, int] = {}
+        for shard in self.shards:
+            for seg in shard.segments:
+                for fname, inv in seg.inverted.items():
+                    num_docs[fname] = num_docs.get(fname, 0) + inv.num_docs
+                    for term, tid in inv.vocab.items():
+                        key = (fname, term)
+                        df[key] = df.get(key, 0) + int(inv.df[tid])
+        return GlobalStats(num_docs=num_docs, df=df)
+
+    def stats(self) -> dict:
+        shard_stats = [s.stats() for s in self.shards]
+        total_docs = sum(st["docs"]["count"] for st in shard_stats)
+        return {
+            "primaries": {
+                "docs": {"count": total_docs},
+                "indexing": {
+                    "index_total": sum(st["indexing"]["index_total"] for st in shard_stats)
+                },
+                "segments": {
+                    "count": sum(st["segments"]["count"] for st in shard_stats),
+                    "memory_in_bytes": sum(st["segments"]["memory_in_bytes"] for st in shard_stats),
+                },
+            },
+            "shards": {str(i): st for i, st in enumerate(shard_stats)},
+        }
+
+    @property
+    def num_docs(self) -> int:
+        return sum(s.engine.num_docs for s in self.shards)
+
+    def close(self):
+        for s in self.shards:
+            s.close()
+        self.closed = True

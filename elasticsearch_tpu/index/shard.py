@@ -1,0 +1,70 @@
+"""IndexShard: one shard = engine (write path) + searcher (read path).
+
+Reference: org/elasticsearch/index/shard/IndexShard.java — lifecycle
+(CREATED→RECOVERING→STARTED), stats, and the engine/searcher pairing.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.search.service import ShardSearcher
+
+
+class IndexShard:
+    def __init__(
+        self,
+        index_name: str,
+        shard_id: int,
+        mappings: Mappings,
+        analysis: AnalysisRegistry,
+        data_path: Optional[str] = None,
+    ):
+        self.index_name = index_name
+        self.shard_id = shard_id
+        self.state = "CREATED"
+        translog_path = None
+        if data_path:
+            translog_path = os.path.join(data_path, index_name, str(shard_id), "translog")
+        self.engine = Engine(mappings, analysis, translog_path=translog_path)
+        self.searcher = ShardSearcher(self.engine.segments, mappings, analysis, shard_ord=shard_id)
+        self.state = "STARTED"
+
+    def recover(self):
+        self.state = "RECOVERING"
+        self.engine.recover_from_translog()
+        self.engine.refresh()
+        self.state = "STARTED"
+
+    @property
+    def segments(self):
+        return self.engine.segments
+
+    def refresh(self):
+        self.engine.refresh()
+        # searcher holds the same list object; refresh keeps it in sync
+        self.searcher.segments = self.engine.segments
+
+    def stats(self) -> dict:
+        e = self.engine.stats
+        return {
+            "docs": {"count": self.engine.num_docs},
+            "indexing": {"index_total": e.index_total, "delete_total": e.delete_total,
+                         "index_time_in_millis": int(e.index_time_ms)},
+            "get": {"total": e.get_total},
+            "refresh": {"total": e.refresh_total},
+            "flush": {"total": e.flush_total},
+            "merges": {"total": e.merge_total},
+            "segments": {
+                "count": len(self.engine.segments),
+                "memory_in_bytes": sum(s.memory_bytes() for s in self.engine.segments),
+            },
+            "translog": {"operations": self.engine.translog.size_in_ops},
+        }
+
+    def close(self):
+        self.engine.close()
+        self.state = "CLOSED"

@@ -1,0 +1,128 @@
+"""Transaction log.
+
+Reference: org/elasticsearch/index/translog/ — Translog.java (fs),
+TranslogWriter-era logic: an append-only durability log, fsync policy,
+generation rollover on flush ("commit"), and replay on recovery.
+
+Format: one JSON line per operation (index/delete) — the payload is tiny
+relative to device work, and line-framing makes replay/corruption handling
+trivial. A C++ varint/binary codec is the planned R2 upgrade; the interface
+(append/replay/commit) stays the same.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Callable, Iterator, Optional
+
+
+class Translog:
+    def __init__(self, path: Optional[str], durability: str = "request", sync_interval: float = 5.0):
+        """path=None → in-memory only (durability off, e.g. ephemeral tests).
+
+        durability: "request" fsyncs every append (ES index.translog.durability=
+        request); "async" relies on OS flush + periodic sync.
+        """
+        self.path = path
+        self.durability = durability
+        self._lock = threading.Lock()
+        self._ops_since_sync = 0
+        self.generation = 1
+        self._fh = None
+        self._mem: list = []
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            # find latest generation
+            base = os.path.basename(path)
+            d = os.path.dirname(path) or "."
+            gens = []
+            for f in os.listdir(d):
+                if f.startswith(base + ".") and f.rpartition(".")[2].isdigit():
+                    gens.append(int(f.rpartition(".")[2]))
+            self.generation = max(gens) if gens else 1
+            self._fh = open(self._gen_path(self.generation), "ab")
+
+    def _gen_path(self, gen: int) -> str:
+        return f"{self.path}.{gen}"
+
+    @property
+    def size_in_ops(self) -> int:
+        if self.path is None:
+            return len(self._mem)
+        with self._lock:
+            return self._count_ops()
+
+    def _count_ops(self) -> int:
+        n = 0
+        p = self._gen_path(self.generation)
+        if os.path.exists(p):
+            with open(p, "rb") as f:
+                n = sum(1 for _ in f)
+        return n
+
+    def append(self, op: dict):
+        line = json.dumps(op, separators=(",", ":"))
+        with self._lock:
+            if self._fh is None:
+                self._mem.append(op)
+                return
+            self._fh.write(line.encode() + b"\n")
+            self._ops_since_sync += 1
+            if self.durability == "request":
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._ops_since_sync = 0
+
+    def sync(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+                self._ops_since_sync = 0
+
+    def replay(self, from_generation: int = 1) -> Iterator[dict]:
+        """Yield ops from all generations >= from_generation (recovery)."""
+        if self.path is None:
+            yield from list(self._mem)
+            return
+        self.sync()
+        for gen in range(from_generation, self.generation + 1):
+            p = self._gen_path(gen)
+            if not os.path.exists(p):
+                continue
+            with open(p, "rb") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        yield json.loads(line)
+                    except json.JSONDecodeError:
+                        # torn tail write (crash mid-append): stop at corruption
+                        return
+
+    def commit(self):
+        """Roll to a new generation and drop old ones (called on flush:
+        flushed segments now own the data, like Translog.commit)."""
+        with self._lock:
+            if self._fh is None:
+                self._mem.clear()
+                return
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._fh.close()
+            old_gen = self.generation
+            self.generation += 1
+            self._fh = open(self._gen_path(self.generation), "ab")
+            for gen in range(1, old_gen + 1):
+                p = self._gen_path(gen)
+                if os.path.exists(p):
+                    os.remove(p)
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None

@@ -1,0 +1,290 @@
+"""Node: the top-level runtime holding indices, cluster state, templates.
+
+Reference: org/elasticsearch/node/Node.java + node/internal/InternalNode.java
+(service wiring), action/admin/indices/create/TransportCreateIndexAction.java
+(template application order), action/bulk/TransportBulkAction.java (bulk
+fan-out), action/search/TransportMultiSearchAction.java.
+"""
+from __future__ import annotations
+
+import fnmatch
+import re
+import uuid
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode, IndexMetadata
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.utils.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    IndexAlreadyExistsException,
+    IndexNotFoundException,
+)
+from elasticsearch_tpu import __version__
+
+
+class Node:
+    def __init__(self, name: str = "node-1", data_path: Optional[str] = None,
+                 cluster_name: str = "elasticsearch_tpu"):
+        self.node_id = uuid.uuid4().hex[:12]
+        self.name = name
+        self.data_path = data_path
+        self.indices: Dict[str, IndexService] = {}
+        self.cluster_state = ClusterState(cluster_name)
+        self.cluster_state.add_node(DiscoveryNode(self.node_id, name), master=True)
+
+    # -- index admin -----------------------------------------------------------
+
+    def create_index(self, name: str, body: Optional[dict] = None) -> dict:
+        if name in self.indices:
+            raise IndexAlreadyExistsException(name)
+        _validate_index_name(name)
+        body = body or {}
+        settings = dict(body.get("settings", {}))
+        mappings = dict(body.get("mappings", {}))
+        aliases = dict(body.get("aliases", {}))
+        # apply matching templates, lowest order first (CreateIndexService)
+        tmpls = sorted(
+            (t for t in self.cluster_state.templates.values()
+             if any(fnmatch.fnmatch(name, pat) for pat in t.get("index_patterns", [t.get("template", "")]))),
+            key=lambda t: t.get("order", 0),
+        )
+        merged_settings: dict = {}
+        merged_mappings: dict = {}
+        for t in tmpls:
+            _deep_merge(merged_settings, t.get("settings", {}))
+            _deep_merge(merged_mappings, t.get("mappings", {}))
+            aliases.update(t.get("aliases", {}))
+        _deep_merge(merged_settings, settings)
+        _deep_merge(merged_mappings, mappings)
+        svc = IndexService(name, merged_settings, merged_mappings, data_path=self.data_path)
+        svc.aliases = aliases
+        self.indices[name] = svc
+        self.cluster_state.add_index(
+            IndexMetadata(name, merged_settings, merged_mappings, aliases),
+            svc.num_shards, self.node_id,
+        )
+        return {"acknowledged": True, "shards_acknowledged": True, "index": name}
+
+    def delete_index(self, name: str) -> dict:
+        found = self.resolve_indices(name)
+        if not found:
+            raise IndexNotFoundException(name)
+        for n in found:
+            self.indices.pop(n).close()
+            self.cluster_state.remove_index(n)
+        return {"acknowledged": True}
+
+    def index_exists(self, name: str) -> bool:
+        return name in self.indices or bool(self._alias_targets(name))
+
+    def resolve_indices(self, expr: Optional[str]) -> List[str]:
+        """Resolve a name/alias/wildcard/csv expression to index names."""
+        if expr in (None, "", "_all", "*"):
+            return list(self.indices)
+        out: List[str] = []
+        for part in str(expr).split(","):
+            part = part.strip()
+            if "*" in part or "?" in part:
+                out.extend(n for n in self.indices if fnmatch.fnmatch(n, part))
+            elif part in self.indices:
+                out.append(part)
+            else:
+                out.extend(self._alias_targets(part))
+        seen = set()
+        uniq = []
+        for n in out:
+            if n not in seen:
+                seen.add(n)
+                uniq.append(n)
+        return uniq
+
+    def _alias_targets(self, alias: str) -> List[str]:
+        return [n for n, svc in self.indices.items() if alias in svc.aliases]
+
+    def get_index(self, name: str) -> IndexService:
+        names = self.resolve_indices(name)
+        if not names:
+            raise IndexNotFoundException(name)
+        if len(names) > 1:
+            raise ElasticsearchTpuException(
+                f"alias/expression [{name}] resolves to multiple indices for a single-index op"
+            )
+        return self.indices[names[0]]
+
+    def put_mapping(self, index: str, body: dict) -> dict:
+        for n in self.resolve_indices(index):
+            self.indices[n].mappings.merge(body)
+        return {"acknowledged": True}
+
+    def get_mapping(self, index: Optional[str] = None) -> dict:
+        out = {}
+        for n in self.resolve_indices(index):
+            out[n] = {"mappings": self.indices[n].mappings.to_json()}
+        return out
+
+    def update_aliases(self, actions: List[dict]) -> dict:
+        for action in actions:
+            for op, spec in action.items():
+                idx_names = self.resolve_indices(spec.get("index", spec.get("indices")))
+                alias = spec["alias"]
+                for n in idx_names:
+                    if op == "add":
+                        self.indices[n].aliases[alias] = {
+                            k: v for k, v in spec.items() if k not in ("index", "indices", "alias")
+                        }
+                    elif op == "remove":
+                        self.indices[n].aliases.pop(alias, None)
+        return {"acknowledged": True}
+
+    def put_template(self, name: str, body: dict) -> dict:
+        self.cluster_state.templates[name] = body
+        return {"acknowledged": True}
+
+    def delete_template(self, name: str) -> dict:
+        if self.cluster_state.templates.pop(name, None) is None:
+            raise IndexNotFoundException(name)
+        return {"acknowledged": True}
+
+    # -- documents -------------------------------------------------------------
+
+    def bulk(self, operations: List[dict]) -> dict:
+        """operations: parsed NDJSON pairs [{action}, {source}?, ...]."""
+        items = []
+        errors = False
+        i = 0
+        while i < len(operations):
+            action_line = operations[i]
+            (op, meta), = action_line.items()
+            i += 1
+            source = None
+            if op in ("index", "create", "update"):
+                source = operations[i]
+                i += 1
+            index_name = meta.get("_index")
+            doc_id = meta.get("_id")
+            routing = meta.get("routing", meta.get("_routing"))
+            try:
+                svc = self.get_or_autocreate(index_name)
+                if op in ("index", "create"):
+                    r = svc.index_doc(doc_id, source, routing=routing,
+                                      op_type="create" if op == "create" else "index")
+                    status = 201 if r.get("created") else 200
+                elif op == "update":
+                    r = svc.update_doc(doc_id, source, routing=routing)
+                    status = 200
+                elif op == "delete":
+                    r = svc.delete_doc(doc_id, routing=routing)
+                    status = 200
+                else:
+                    raise ElasticsearchTpuException(f"unknown bulk op [{op}]")
+                items.append({op: {**r, "status": status}})
+            except ElasticsearchTpuException as e:
+                errors = True
+                items.append({op: {
+                    "_index": index_name, "_id": doc_id, "status": e.status,
+                    "error": {"type": e.error_type, "reason": str(e)},
+                }})
+        return {"took": 0, "errors": errors, "items": items}
+
+    def get_or_autocreate(self, name: str) -> IndexService:
+        names = self.resolve_indices(name)
+        if names:
+            if len(names) == 1:
+                return self.indices[names[0]]
+            raise ElasticsearchTpuException(f"[{name}] resolves to multiple indices for a write")
+        self.create_index(name)
+        return self.indices[name]
+
+    # -- search ----------------------------------------------------------------
+
+    def search(self, index: Optional[str], body: dict) -> dict:
+        names = self.resolve_indices(index)
+        if not names and index not in (None, "", "_all", "*"):
+            raise IndexNotFoundException(str(index))
+        searchers = []
+        alias_filters = []
+        for n in names:
+            svc = self.indices[n]
+            searchers.extend(s.searcher for s in svc.shards)
+        if not searchers:
+            return {
+                "took": 0, "timed_out": False,
+                "_shards": {"total": 0, "successful": 0, "failed": 0},
+                "hits": {"total": 0, "max_score": None, "hits": []},
+            }
+        from elasticsearch_tpu.search.service import search_shards
+
+        # re-number shard ordinals across indices
+        for ord_, s in enumerate(searchers):
+            s.shard_ord = ord_
+        search_type = (body or {}).get("search_type")
+        gs = None
+        if search_type == "dfs_query_then_fetch" and len(names) == 1:
+            gs = self.indices[names[0]].global_stats(body)
+        resp = search_shards(searchers, body or {}, index_name=",".join(names), global_stats=gs)
+        # patch hit _index to the owning index
+        return resp
+
+    def msearch(self, pairs: List[tuple]) -> dict:
+        responses = []
+        for header, body in pairs:
+            try:
+                responses.append(self.search(header.get("index"), body))
+            except ElasticsearchTpuException as e:
+                responses.append({"error": {"type": e.error_type, "reason": str(e)},
+                                  "status": e.status})
+        return {"responses": responses}
+
+    def nodes_stats(self) -> dict:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return {
+            "cluster_name": self.cluster_state.cluster_name,
+            "nodes": {
+                self.node_id: {
+                    "name": self.name,
+                    "indices": {
+                        "docs": {"count": sum(s.num_docs for s in self.indices.values())},
+                    },
+                    "process": {"max_rss_bytes": ru.ru_maxrss * 1024},
+                    "jvm": {"mem": {}},  # parity placeholder: no JVM here
+                }
+            },
+        }
+
+    def info(self) -> dict:
+        import jax
+
+        return {
+            "name": self.name,
+            "cluster_name": self.cluster_state.cluster_name,
+            "version": {
+                "number": __version__,
+                "build_flavor": "tpu",
+                "lucene_version": "n/a (device-resident segments)",
+            },
+            "tagline": "You Know, for Search — on TPU",
+            "devices": [str(d) for d in jax.devices()],
+        }
+
+    def close(self):
+        for svc in self.indices.values():
+            svc.close()
+
+
+_INVALID_NAME = re.compile(r'[\\/*?"<>| ,#:A-Z]')
+
+
+def _validate_index_name(name: str):
+    if not name or name.startswith(("_", "-", "+")) or _INVALID_NAME.search(name):
+        raise IllegalArgumentException(f"invalid index name [{name}]")
+
+
+def _deep_merge(dst: dict, src: dict):
+    for k, v in src.items():
+        if isinstance(v, dict) and isinstance(dst.get(k), dict):
+            _deep_merge(dst[k], v)
+        else:
+            dst[k] = v

@@ -1,0 +1,717 @@
+"""REST layer: ES-compatible HTTP JSON API.
+
+Reference: org/elasticsearch/rest/ — RestController.java (method+path
+routing), rest/action/* handlers (124 of them: document CRUD, bulk, search,
+msearch, count, explain, analyze, mappings, settings, aliases, templates,
+cat family, cluster health/state/stats, node stats, refresh/flush/optimize,
+mget, scroll), and http/netty/NettyHttpServerTransport.java for the server.
+
+Implementation: stdlib ThreadingHTTPServer (the HTTP layer is control-plane
+only — all heavy work is device programs), a route table of
+(method, compiled-regex) → handler, and ES-shaped JSON error envelopes.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.utils.errors import (
+    ElasticsearchTpuException,
+    IllegalArgumentException,
+    IndexNotFoundException,
+)
+
+Handler = Callable[..., Tuple[int, Any]]
+
+
+class RestController:
+    def __init__(self, node: Node):
+        self.node = node
+        self.routes: List[Tuple[str, re.Pattern, Handler]] = []
+        _register_all(self)
+
+    def add(self, method: str, pattern: str, handler: Handler):
+        # {name} -> named group (no slashes); {index} additionally excludes a
+        # leading underscore so /_bulk, /_search etc. never bind as an index
+        # (ES forbids index names starting with _, RestController does the same
+        # disambiguation via path registration order)
+        def group(m):
+            name = m.group(1)
+            if name == "index":
+                return r"(?P<index>[^/_][^/]*)"
+            return rf"(?P<{name}>[^/]+)"
+
+        rx = re.sub(r"\{(\w+)\}", group, pattern)
+        self.routes.append((method, re.compile(f"^{rx}/?$"), handler))
+
+    def dispatch(self, method: str, path: str, params: Dict[str, str], body: bytes) -> Tuple[int, Any]:
+        for m, rx, handler in self.routes:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if match:
+                try:
+                    return handler(self.node, params, body, **match.groupdict())
+                except ElasticsearchTpuException as e:
+                    return e.status, _error_body(e)
+                except json.JSONDecodeError as e:
+                    return 400, {"error": {"type": "parse_exception", "reason": str(e)}, "status": 400}
+        return 400, {
+            "error": {"type": "illegal_argument_exception",
+                      "reason": f"no handler found for uri [{path}] and method [{method}]"},
+            "status": 400,
+        }
+
+
+def _error_body(e: ElasticsearchTpuException) -> dict:
+    return {
+        "error": {"type": e.error_type, "reason": str(e),
+                  "root_cause": [{"type": e.error_type, "reason": str(e)}]},
+        "status": e.status,
+    }
+
+
+def _json(body: bytes) -> dict:
+    if not body:
+        return {}
+    return json.loads(body)
+
+
+def _ndjson(body: bytes) -> List[dict]:
+    return [json.loads(line) for line in body.decode().splitlines() if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# handlers (grouped like rest/action/*)
+# ---------------------------------------------------------------------------
+
+def _register_all(rc: RestController):
+    add = rc.add
+    # root / info / health
+    add("GET", "/", lambda n, p, b: (200, n.info()))
+    add("HEAD", "/", lambda n, p, b: (200, None))
+    add("GET", "/_cluster/health", lambda n, p, b: (200, n.cluster_state.health()))
+    add("GET", "/_cluster/state", lambda n, p, b: (200, n.cluster_state.to_json()))
+    add("GET", "/_cluster/stats", _cluster_stats)
+    add("GET", "/_nodes/stats", lambda n, p, b: (200, n.nodes_stats()))
+    add("GET", "/_nodes", lambda n, p, b: (200, n.nodes_stats()))
+    add("GET", "/_stats", lambda n, p, b: (200, _all_stats(n)))
+
+    # cat API (text/plain-ish, returned as JSON rows when format=json)
+    add("GET", "/_cat/indices", _cat_indices)
+    add("GET", "/_cat/health", _cat_health)
+    add("GET", "/_cat/shards", _cat_shards)
+    add("GET", "/_cat/nodes", _cat_nodes)
+    add("GET", "/_cat/count", _cat_count)
+    add("GET", "/_cat/count/{index}", _cat_count)
+    add("GET", "/_cat/templates", lambda n, p, b: (200, [
+        {"name": k, "index_patterns": v.get("index_patterns", [v.get("template", "")])}
+        for k, v in n.cluster_state.templates.items()]))
+
+    # index admin
+    add("PUT", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
+    add("POST", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
+    add("DELETE", "/{index}", lambda n, p, b, index: (200, n.delete_index(index)))
+    add("HEAD", "/{index}", _index_exists)
+    add("GET", "/{index}/_mapping", lambda n, p, b, index: (200, n.get_mapping(index)))
+    add("PUT", "/{index}/_mapping", lambda n, p, b, index: (200, n.put_mapping(index, _json(b))))
+    add("PUT", "/{index}/_mapping/{type}", lambda n, p, b, index, type: (200, n.put_mapping(index, _json(b))))
+    add("GET", "/{index}/_settings", _get_settings)
+    add("GET", "/{index}", _get_index_meta)
+    add("POST", "/_aliases", lambda n, p, b: (200, n.update_aliases(_json(b).get("actions", []))))
+    add("GET", "/_aliases", _get_aliases)
+    add("GET", "/_alias/{alias}", _get_alias)
+    add("PUT", "/_template/{name}", lambda n, p, b, name: (200, n.put_template(name, _json(b))))
+    add("GET", "/_template/{name}", lambda n, p, b, name: (
+        200, {name: n.cluster_state.templates.get(name, {})}))
+    add("DELETE", "/_template/{name}", lambda n, p, b, name: (200, n.delete_template(name)))
+
+    # index lifecycle ops
+    add("POST", "/{index}/_refresh", _refresh)
+    add("GET", "/{index}/_refresh", _refresh)
+    add("POST", "/_refresh", _refresh_all)
+    add("POST", "/{index}/_flush", _flush)
+    add("POST", "/{index}/_optimize", _optimize)  # ES 2.0 name
+    add("POST", "/{index}/_forcemerge", _optimize)
+    add("GET", "/{index}/_stats", lambda n, p, b, index: (200, n.get_index(index).stats()))
+    add("GET", "/{index}/_count", _count)
+    add("POST", "/{index}/_count", _count)
+
+    # analyze
+    add("GET", "/_analyze", _analyze)
+    add("POST", "/_analyze", _analyze)
+    add("GET", "/{index}/_analyze", _analyze_index)
+    add("POST", "/{index}/_analyze", _analyze_index)
+
+    # documents
+    add("PUT", "/{index}/_doc/{id}", _index_doc)
+    add("POST", "/{index}/_doc/{id}", _index_doc)
+    add("POST", "/{index}/_doc", _index_doc_auto)
+    add("PUT", "/{index}/_create/{id}", _create_doc)
+    add("GET", "/{index}/_doc/{id}", _get_doc)
+    add("HEAD", "/{index}/_doc/{id}", _doc_exists)
+    add("DELETE", "/{index}/_doc/{id}", _delete_doc)
+    add("POST", "/{index}/_update/{id}", _update_doc)
+    add("GET", "/{index}/_source/{id}", _get_source)
+    add("POST", "/_mget", _mget)
+    add("POST", "/{index}/_mget", _mget_index)
+
+    # bulk
+    add("POST", "/_bulk", _bulk)
+    add("PUT", "/_bulk", _bulk)
+    add("POST", "/{index}/_bulk", _bulk_index)
+
+    # search family
+    add("GET", "/_search", _search_all)
+    add("POST", "/_search", _search_all)
+    add("GET", "/{index}/_search", _search)
+    add("POST", "/{index}/_search", _search)
+    add("POST", "/_msearch", _msearch)
+    add("POST", "/{index}/_msearch", _msearch_index)
+    add("POST", "/_search/scroll", _scroll)
+    add("DELETE", "/_search/scroll", _clear_scroll)
+    add("GET", "/{index}/_search/template", _search)  # template-lite passthrough
+    add("POST", "/{index}/_validate/query", _validate_query)
+    add("GET", "/{index}/_validate/query", _validate_query)
+    add("POST", "/{index}/_explain/{id}", _explain)
+    add("GET", "/{index}/_explain/{id}", _explain)
+    add("GET", "/{index}/_field_stats", _field_stats)
+    add("POST", "/{index}/_field_stats", _field_stats)
+    add("GET", "/{index}/_termvectors/{id}", _termvectors)
+
+    # ES 2.0 typed forms /{index}/{type}/{id} — registered LAST so every
+    # /_-prefixed sub-resource above wins the route (RestController does the
+    # same via explicit registration order)
+    add("PUT", "/{index}/{type}/{id}", _index_doc_typed)
+    add("POST", "/{index}/{type}/{id}", _index_doc_typed)
+    add("GET", "/{index}/{type}/{id}", _get_doc_typed)
+    add("DELETE", "/{index}/{type}/{id}", _delete_doc_typed)
+
+
+# -- admin helpers -----------------------------------------------------------
+
+def _cluster_stats(n: Node, p, b):
+    total_docs = sum(s.num_docs for s in n.indices.values())
+    return 200, {
+        "cluster_name": n.cluster_state.cluster_name,
+        "indices": {"count": len(n.indices), "docs": {"count": total_docs}},
+        "nodes": {"count": {"total": len(n.cluster_state.nodes)}},
+    }
+
+
+def _all_stats(n: Node) -> dict:
+    return {"indices": {name: svc.stats() for name, svc in n.indices.items()}}
+
+
+def _cat_indices(n: Node, p, b):
+    rows = []
+    for name, svc in n.indices.items():
+        rows.append({
+            "health": "green", "status": "open", "index": name,
+            "pri": str(svc.num_shards), "rep": str(svc.num_replicas),
+            "docs.count": str(svc.num_docs),
+        })
+    return 200, rows
+
+
+def _cat_health(n: Node, p, b):
+    h = n.cluster_state.health()
+    return 200, [{"cluster": h["cluster_name"], "status": h["status"],
+                  "node.total": str(h["number_of_nodes"]),
+                  "shards": str(h["active_shards"])}]
+
+
+def _cat_shards(n: Node, p, b):
+    rows = []
+    for r in n.cluster_state.routing:
+        svc = n.indices.get(r.index)
+        docs = svc.shards[r.shard_id].engine.num_docs if svc else 0
+        rows.append({"index": r.index, "shard": str(r.shard_id),
+                     "prirep": "p" if r.primary else "r", "state": r.state,
+                     "docs": str(docs), "node": n.name})
+    return 200, rows
+
+
+def _cat_nodes(n: Node, p, b):
+    return 200, [{"name": n.name, "node.role": "mdi", "master": "*"}]
+
+
+def _cat_count(n: Node, p, b, index: Optional[str] = None):
+    names = n.resolve_indices(index)
+    total = sum(n.indices[x].num_docs for x in names)
+    return 200, [{"count": str(total)}]
+
+
+def _index_exists(n: Node, p, b, index: str):
+    return (200, None) if n.index_exists(index) else (404, None)
+
+
+def _get_settings(n: Node, p, b, index: str):
+    out = {}
+    for name in n.resolve_indices(index):
+        svc = n.indices[name]
+        out[name] = {"settings": {"index": {
+            "number_of_shards": str(svc.num_shards),
+            "number_of_replicas": str(svc.num_replicas),
+            **{k: v for k, v in svc.settings.items() if k != "index"},
+        }}}
+    if not out:
+        raise IndexNotFoundException(index)
+    return 200, out
+
+
+def _get_index_meta(n: Node, p, b, index: str):
+    out = {}
+    for name in n.resolve_indices(index):
+        svc = n.indices[name]
+        out[name] = {
+            "aliases": svc.aliases,
+            "mappings": svc.mappings.to_json(),
+            "settings": {"index": {"number_of_shards": str(svc.num_shards)}},
+        }
+    if not out:
+        raise IndexNotFoundException(index)
+    return 200, out
+
+
+def _get_aliases(n: Node, p, b):
+    return 200, {name: {"aliases": svc.aliases} for name, svc in n.indices.items()}
+
+
+def _get_alias(n: Node, p, b, alias: str):
+    out = {}
+    for name, svc in n.indices.items():
+        if alias in svc.aliases:
+            out[name] = {"aliases": {alias: svc.aliases[alias]}}
+    if not out:
+        return 404, {"error": f"alias [{alias}] missing", "status": 404}
+    return 200, out
+
+
+def _refresh(n: Node, p, b, index: str):
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    for name in names:
+        n.indices[name].refresh()
+    return 200, {"_shards": {"total": len(names), "successful": len(names), "failed": 0}}
+
+
+def _refresh_all(n: Node, p, b):
+    for svc in n.indices.values():
+        svc.refresh()
+    return 200, {"_shards": {"total": len(n.indices), "successful": len(n.indices), "failed": 0}}
+
+
+def _flush(n: Node, p, b, index: str):
+    for name in n.resolve_indices(index):
+        n.indices[name].flush()
+    return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+
+def _optimize(n: Node, p, b, index: str):
+    max_seg = int(p.get("max_num_segments", 1))
+    for name in n.resolve_indices(index):
+        n.indices[name].force_merge(max_seg)
+    return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+
+
+def _count(n: Node, p, b, index: str):
+    body = _json(b)
+    if "q" in p:
+        body = {"query": {"query_string": {"query": p["q"]}}}
+    svc_names = n.resolve_indices(index)
+    if not svc_names:
+        raise IndexNotFoundException(index)
+    total = 0
+    for name in svc_names:
+        total += n.indices[name].count(body)["count"]
+    return 200, {"count": total, "_shards": {"total": len(svc_names),
+                                             "successful": len(svc_names), "failed": 0}}
+
+
+def _analyze_body(p, b) -> dict:
+    body = _json(b)
+    if "text" in p:
+        body.setdefault("text", p["text"])
+    if "analyzer" in p:
+        body.setdefault("analyzer", p["analyzer"])
+    return body
+
+
+def _analyze(n: Node, p, b):
+    from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+
+    body = _analyze_body(p, b)
+    reg = AnalysisRegistry()
+    return 200, _do_analyze(reg, body)
+
+
+def _analyze_index(n: Node, p, b, index: str):
+    svc = n.get_index(index)
+    return 200, _do_analyze(svc.analysis, _analyze_body(p, b), svc)
+
+
+def _do_analyze(reg, body: dict, svc=None) -> dict:
+    text = body.get("text", "")
+    texts = text if isinstance(text, list) else [text]
+    if "field" in body and svc is not None:
+        fm = svc.mappings.get(body["field"])
+        analyzer = reg.get(fm.analyzer) if fm is not None and fm.is_text else reg.get("keyword")
+    else:
+        analyzer = reg.get(body.get("analyzer", "standard"))
+    tokens = []
+    for t in texts:
+        for tok, pos in analyzer.analyze(t):
+            tokens.append({"token": tok, "position": pos, "type": "<ALPHANUM>"})
+    return {"tokens": tokens}
+
+
+# -- document handlers --------------------------------------------------------
+
+def _index_doc(n: Node, p, b, index: str, id: str):
+    svc = n.get_or_autocreate(index)
+    kw = {}
+    if "version" in p:
+        kw["version"] = int(p["version"])
+        kw["version_type"] = p.get("version_type", "internal")
+    if p.get("op_type") == "create":
+        kw["op_type"] = "create"
+    r = svc.index_doc(id, _json(b), routing=p.get("routing"), **kw)
+    if p.get("refresh") in ("true", "wait_for", ""):
+        svc.refresh()
+    return (201 if r.get("created") else 200), r
+
+
+def _index_doc_auto(n: Node, p, b, index: str):
+    svc = n.get_or_autocreate(index)
+    r = svc.index_doc(None, _json(b), routing=p.get("routing"))
+    if p.get("refresh") in ("true", "wait_for", ""):
+        svc.refresh()
+    return 201, r
+
+
+def _create_doc(n: Node, p, b, index: str, id: str):
+    svc = n.get_or_autocreate(index)
+    r = svc.index_doc(id, _json(b), op_type="create", routing=p.get("routing"))
+    return 201, r
+
+
+_RESERVED_TYPES = {"_doc", "_search", "_mapping", "_bulk", "_refresh", "_flush",
+                   "_settings", "_stats", "_count", "_update", "_mget", "_analyze",
+                   "_create", "_source", "_optimize", "_forcemerge", "_aliases",
+                   "_validate", "_explain", "_termvectors", "_field_stats"}
+
+
+def _index_doc_typed(n: Node, p, b, index: str, type: str, id: str):
+    if type in _RESERVED_TYPES:
+        raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    return _index_doc(n, p, b, index, id)
+
+
+def _get_doc_typed(n: Node, p, b, index: str, type: str, id: str):
+    if type in _RESERVED_TYPES:
+        raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    return _get_doc(n, p, b, index, id)
+
+
+def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
+    if type in _RESERVED_TYPES:
+        raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    return _delete_doc(n, p, b, index, id)
+
+
+def _get_doc(n: Node, p, b, index: str, id: str):
+    r = n.get_index(index).get_doc(id, routing=p.get("routing"))
+    return (200 if r.get("found") else 404), r
+
+
+def _doc_exists(n: Node, p, b, index: str, id: str):
+    r = n.get_index(index).get_doc(id)
+    return (200 if r.get("found") else 404), None
+
+
+def _get_source(n: Node, p, b, index: str, id: str):
+    r = n.get_index(index).get_doc(id)
+    if not r.get("found"):
+        return 404, {"error": "not found", "status": 404}
+    return 200, r["_source"]
+
+
+def _delete_doc(n: Node, p, b, index: str, id: str):
+    svc = n.get_index(index)
+    r = svc.delete_doc(id, routing=p.get("routing"))
+    if p.get("refresh") in ("true", ""):
+        svc.refresh()
+    return 200, r
+
+
+def _update_doc(n: Node, p, b, index: str, id: str):
+    svc = n.get_index(index)
+    r = svc.update_doc(id, _json(b), routing=p.get("routing"))
+    if p.get("refresh") in ("true", ""):
+        svc.refresh()
+    return 200, r
+
+
+def _mget(n: Node, p, b):
+    body = _json(b)
+    docs = []
+    for spec in body.get("docs", []):
+        svc = n.get_index(spec["_index"])
+        docs.append(svc.get_doc(spec["_id"]))
+    return 200, {"docs": docs}
+
+
+def _mget_index(n: Node, p, b, index: str):
+    body = _json(b)
+    svc = n.get_index(index)
+    if "ids" in body:
+        return 200, svc.mget([str(i) for i in body["ids"]])
+    return 200, {"docs": [svc.get_doc(d["_id"]) for d in body.get("docs", [])]}
+
+
+def _bulk(n: Node, p, b, index: Optional[str] = None):
+    ops = _ndjson(b)
+    if index is not None:
+        for line in ops:
+            if len(line) == 1:
+                (op, meta), = line.items()
+                if op in ("index", "create", "update", "delete") and isinstance(meta, dict):
+                    meta.setdefault("_index", index)
+    r = n.bulk(ops)
+    if p.get("refresh") in ("true", "wait_for", ""):
+        for svc in n.indices.values():
+            svc.refresh()
+    return 200, r
+
+
+def _bulk_index(n: Node, p, b, index: str):
+    return _bulk(n, p, b, index)
+
+
+# -- search handlers ----------------------------------------------------------
+
+def _search_body(p, b) -> dict:
+    body = _json(b)
+    if "q" in p:
+        body.setdefault("query", {"query_string": {"query": p["q"]}})
+    for k in ("size", "from"):
+        if k in p:
+            body.setdefault(k, int(p[k]))
+    if "sort" in p:
+        body.setdefault("sort", p["sort"].split(","))
+    if "scroll" in p:
+        body["scroll"] = p["scroll"]
+    if "search_type" in p:
+        body["search_type"] = p["search_type"]
+    return body
+
+
+def _search(n: Node, p, b, index: str):
+    return 200, n.search(index, _search_body(p, b))
+
+
+def _search_all(n: Node, p, b):
+    return 200, n.search(None, _search_body(p, b))
+
+
+def _msearch(n: Node, p, b, index: Optional[str] = None):
+    lines = _ndjson(b)
+    pairs = []
+    for i in range(0, len(lines) - 1, 2):
+        header = lines[i]
+        if index is not None:
+            header.setdefault("index", index)
+        pairs.append((header, lines[i + 1]))
+    return 200, n.msearch(pairs)
+
+
+def _msearch_index(n: Node, p, b, index: str):
+    return _msearch(n, p, b, index)
+
+
+def _scroll(n: Node, p, b):
+    from elasticsearch_tpu.search.service import scroll_next
+
+    body = _json(b)
+    sid = body.get("scroll_id", p.get("scroll_id"))
+    return 200, scroll_next(sid)
+
+
+def _clear_scroll(n: Node, p, b):
+    from elasticsearch_tpu.search.service import clear_scroll
+
+    body = _json(b)
+    ids = body.get("scroll_id", [])
+    if isinstance(ids, str):
+        ids = [ids]
+    freed = sum(1 for s in ids if clear_scroll(s))
+    return 200, {"succeeded": True, "num_freed": freed}
+
+
+def _validate_query(n: Node, p, b, index: str):
+    from elasticsearch_tpu.search.queries import parse_query
+    from elasticsearch_tpu.utils.errors import QueryParsingException
+
+    body = _json(b)
+    try:
+        parse_query(body.get("query"))
+        return 200, {"valid": True, "_shards": {"total": 1, "successful": 1, "failed": 0}}
+    except QueryParsingException as e:
+        if p.get("explain") in ("true", ""):
+            return 200, {"valid": False, "explanations": [{"error": str(e)}]}
+        return 200, {"valid": False}
+
+
+def _explain(n: Node, p, b, index: str, id: str):
+    """Per-doc score explanation (RestExplainAction): run the query on the
+    owning segment and report the doc's score + matched state."""
+    import numpy as np
+
+    from elasticsearch_tpu.search.context import SegmentContext
+    from elasticsearch_tpu.search.queries import parse_query
+
+    svc = n.get_index(index)
+    body = _json(b)
+    query = parse_query(body.get("query"))
+    shard = svc.route(id, p.get("routing"))
+    loc = shard.engine._locations.get(str(id))
+    if loc is None or loc.deleted or loc.where == "buffer":
+        return 404, {"_index": index, "_id": id, "matched": False}
+    for seg in shard.segments:
+        if seg.seg_id == loc.where:
+            ctx = SegmentContext(seg, svc.mappings, svc.analysis)
+            scores, mask = query.score_or_mask(ctx)
+            matched = bool(np.asarray(mask)[loc.local_id])
+            score = float(np.asarray(scores)[loc.local_id])
+            return 200, {
+                "_index": index, "_id": id, "matched": matched,
+                "explanation": {
+                    "value": score if matched else 0.0,
+                    "description": "sum of per-term BM25 impact scores (tpu segment program)",
+                    "details": [],
+                },
+            }
+    return 404, {"_index": index, "_id": id, "matched": False}
+
+
+def _field_stats(n: Node, p, b, index: str):
+    """RestFieldStatsAction parity: min/max per numeric field per index."""
+    import numpy as np
+
+    out = {}
+    for name in n.resolve_indices(index):
+        svc = n.indices[name]
+        fields: Dict[str, dict] = {}
+        for shard in svc.shards:
+            for seg in shard.segments:
+                for fname, col in seg.numerics.items():
+                    ex = col.exact[seg.live_host[: len(col.exact)] & np.asarray(col.exists)]
+                    if ex.size == 0:
+                        continue
+                    cur = fields.setdefault(fname, {"min_value": None, "max_value": None, "doc_count": 0})
+                    mn, mx = ex.min(), ex.max()
+                    cur["min_value"] = mn if cur["min_value"] is None else min(cur["min_value"], mn)
+                    cur["max_value"] = mx if cur["max_value"] is None else max(cur["max_value"], mx)
+                    cur["doc_count"] += int(ex.size)
+        out[name] = {"fields": {k: {kk: (int(vv) if isinstance(vv, (np.integer,)) else vv)
+                                    for kk, vv in v.items()} for k, v in fields.items()}}
+    return 200, {"indices": out}
+
+
+def _termvectors(n: Node, p, b, index: str, id: str):
+    """RestTermVectorsAction: term stats for one doc's text fields."""
+    svc = n.get_index(index)
+    shard = svc.route(id, p.get("routing"))
+    got = shard.engine.get(id)
+    if got is None:
+        return 404, {"_index": index, "_id": id, "found": False}
+    parsed = shard.engine.parser.parse(str(id), got["_source"])
+    term_vectors = {}
+    for fname, toks in parsed.text_tokens.items():
+        terms: Dict[str, dict] = {}
+        for t, pos in toks:
+            e = terms.setdefault(t, {"term_freq": 0, "tokens": []})
+            e["term_freq"] += 1
+            e["tokens"].append({"position": pos})
+        term_vectors[fname] = {"terms": terms}
+    return 200, {"_index": index, "_id": id, "found": True, "term_vectors": term_vectors}
+
+
+# ---------------------------------------------------------------------------
+# HTTP server
+# ---------------------------------------------------------------------------
+
+class RestServer:
+    def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9200):
+        self.controller = RestController(node)
+        controller = self.controller
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _handle(self, method: str):
+                parsed = urlparse(self.path)
+                params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                status, payload = controller.dispatch(method, parsed.path, params, body)
+                data = b"" if payload is None else json.dumps(payload, default=_json_default).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json; charset=UTF-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if method != "HEAD" and data:
+                    self.wfile.write(data)
+
+            def do_GET(self):
+                self._handle("GET")
+
+            def do_POST(self):
+                self._handle("POST")
+
+            def do_PUT(self):
+                self._handle("PUT")
+
+            def do_DELETE(self):
+                self._handle("DELETE")
+
+            def do_HEAD(self):
+                self._handle("HEAD")
+
+            def log_message(self, fmt, *args):
+                pass
+
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, background: bool = True):
+        if background:
+            self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+            self._thread.start()
+        else:
+            self.httpd.serve_forever()
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _json_default(o):
+    import numpy as np
+
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    raise TypeError(f"not JSON serializable: {type(o)}")

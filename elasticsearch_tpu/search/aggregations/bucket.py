@@ -129,8 +129,25 @@ class TermsAggregator(Aggregator):
         (okey, odir), = order.items() if isinstance(order, dict) else [("_count", "desc")]
         reverse = odir == "desc"
         items = [(k, v) for k, v in merged.items() if v["doc_count"] >= min_dc]
-        if okey == "_term" or okey == "_key":
+        # materialize sub-agg reductions first: ordering may reference one
+        sub_reduced: Dict[Any, dict] = {
+            k: self.reduce_subs(sub_partials[k]) for k in sub_partials
+        }
+        sub_names = {s.name for s in self.subs}
+        agg_path = okey.split(".")[0] if okey not in ("_count", "_term", "_key") else None
+        if okey in ("_term", "_key"):
             items.sort(key=lambda kv: kv[0], reverse=reverse)
+        elif agg_path is not None and agg_path in sub_names:
+            # order by sub-aggregation metric, e.g. {"max_price": "asc"} or
+            # {"the_stats.avg": "desc"} (terms/InternalOrder.Aggregation)
+            metric = okey.split(".")[1] if "." in okey else "value"
+
+            def agg_val(kv):
+                r = sub_reduced.get(kv[0], {}).get(agg_path, {})
+                v = r.get(metric)
+                return v if v is not None else float("-inf")
+
+            items.sort(key=lambda kv: (agg_val(kv), str(kv[0])), reverse=reverse)
         else:
             items.sort(key=lambda kv: (kv[1]["doc_count"], str(kv[0])), reverse=reverse)
         dropped = items[size:]
@@ -140,8 +157,8 @@ class TermsAggregator(Aggregator):
             b = {"key": k, "doc_count": v["doc_count"]}
             if isinstance(k, (int, np.integer, float)):
                 b["key"] = int(k) if float(k).is_integer() else float(k)
-            if k in sub_partials:
-                b.update(self.reduce_subs(sub_partials[k]))
+            if k in sub_reduced:
+                b.update(sub_reduced[k])
             out_buckets.append(b)
         return {
             "doc_count_error_upper_bound": 0,
@@ -162,7 +179,10 @@ class HistogramAggregator(Aggregator):
         iv = self.body.get("interval")
         if iv is None:
             raise SearchParseException("histogram requires [interval]")
-        return float(iv)
+        iv = float(iv)
+        if iv <= 0:
+            raise SearchParseException(f"[interval] must be > 0, got [{iv}]")
+        return iv
 
     def collect(self, ctx, mask):
         jnp = _jnp()

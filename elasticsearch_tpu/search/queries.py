@@ -272,26 +272,35 @@ class MatchQuery(Query):
         if inv is None:
             return _empty(ctx)
         if self.fuzziness is not None:
-            expanded: List[str] = []
+            # each source term expands to an OR-group of fuzzy candidates;
+            # counting must stay per source term (FuzzyQuery rewrite sem.)
+            groups: List[List[str]] = []
             for t in terms:
                 k = _fuzziness_to_edits(self.fuzziness, t)
                 if k == 0 or t in inv.vocab:
-                    expanded.append(t)
+                    groups.append([t])
                     continue
                 cands = [c for c in inv.terms if _edit_distance_le(t, c, k)]
-                expanded.extend(cands[: self.max_expansions] or [t])
-            terms = expanded
-        scores, counts, n_present = _score_term_group(ctx, self.field, terms, self.boost)
-        n_terms = len(set(terms))
+                groups.append(cands[: self.max_expansions] or [t])
+            flat = [t for g in groups for t in g]
+            scores, _, _ = _score_term_group(ctx, self.field, flat, self.boost)
+            group_count = jnp.zeros(ctx.D, dtype=jnp.int32)
+            for g in groups:
+                _, gcounts, _ = _score_term_group(ctx, self.field, g, 1.0)
+                group_count = group_count + (gcounts > 0).astype(jnp.int32)
+            counts = group_count
+            n_terms = len(groups)
+        else:
+            scores, counts, n_present = _score_term_group(ctx, self.field, terms, self.boost)
+            n_terms = len(set(terms))
         if self.operator == "and":
-            if n_present < n_terms:
-                return _empty(ctx)
-            mask = counts >= n_present
+            # absent terms can never match: all-term conjunction (ES sem.)
+            mask = counts >= n_terms
         else:
             need = _min_should_match(self.msm, n_terms) if self.msm is not None else 1
-            mask = counts >= min(need, max(n_present, 1))
-            if n_present == 0:
-                mask = jnp.zeros(ctx.D, dtype=bool)
+            # do NOT cap at terms-present-in-segment: an absent term is an
+            # optional clause that can never match (Lucene msm semantics)
+            mask = counts >= max(need, 1)
         return scores, mask
 
 
@@ -787,7 +796,7 @@ class ScriptQuery(Query):
 # query_string / simple_query_string (subset grammar)
 # ---------------------------------------------------------------------------
 
-_QS_TOKEN = re.compile(r'(?:([+\-]?)([\w.]+):)?"([^"]*)"|(\S+)')
+_QS_TOKEN = re.compile(r'([+\-]?)(?:([\w.]+):)?"([^"]*)"|(\S+)')
 
 
 class QueryStringQuery(Query):

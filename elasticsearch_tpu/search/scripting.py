@@ -90,7 +90,48 @@ class _Params:
         return self._d.get(name, default)
 
 
-_TERNARY_RE = re.compile(r"([^?]+)\?([^:]+):(.+)")
+def _split_ternary(s: str):
+    """Find the first top-level `?` and its matching `:` (Java ternaries are
+    right-associative; nested ternaries in the then/else branches handled by
+    recursion). Returns (cond, then, else) or None."""
+    depth = 0
+    q_at = -1
+    for i, ch in enumerate(s):
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "?" and depth == 0:
+            q_at = i
+            break
+    if q_at < 0:
+        return None
+    nested = 0
+    depth = 0
+    for j in range(q_at + 1, len(s)):
+        ch = s[j]
+        if ch in "([":
+            depth += 1
+        elif ch in ")]":
+            depth -= 1
+        elif ch == "?" and depth == 0:
+            nested += 1
+        elif ch == ":" and depth == 0:
+            if nested == 0:
+                return s[:q_at], s[q_at + 1 : j], s[j + 1 :]
+            nested -= 1
+    return None
+
+
+def _rewrite_ternaries(s: str) -> str:
+    parts = _split_ternary(s)
+    if parts is None:
+        return s
+    cond, then, other = parts
+    return (
+        f"(({_rewrite_ternaries(then.strip())}) if ({cond.strip()}) "
+        f"else ({_rewrite_ternaries(other.strip())}))"
+    )
 
 
 def _translate(source: str) -> str:
@@ -99,13 +140,7 @@ def _translate(source: str) -> str:
     s = s.replace("&&", " and ").replace("||", " or ")
     s = re.sub(r"!(?!=)", " not ", s)
     s = s.replace('"', "'")
-    # ternary a ? b : c  ->  (b) if (a) else (c); applied repeatedly for nesting
-    while True:
-        m = _TERNARY_RE.fullmatch(s)
-        if not m:
-            break
-        cond, then, other = m.group(1), m.group(2), m.group(3)
-        s = f"({then.strip()}) if ({cond.strip()}) else ({other.strip()})"
+    s = _rewrite_ternaries(s)
     s = re.sub(r"\btrue\b", "True", s)
     s = re.sub(r"\bfalse\b", "False", s)
     s = re.sub(r"\bnull\b", "None", s)

@@ -1,0 +1,473 @@
+"""Search service: query-then-fetch over a shard's segments.
+
+Reference: org/elasticsearch/search/SearchService.java (executeQueryPhase /
+executeFetchPhase), search/query/QueryPhase.java, search/fetch/FetchPhase.java,
+action/search/type/TransportSearchQueryThenFetchAction.java (the two-phase
+scatter/gather contract), search/sort/SortParseElement.java.
+
+Per shard: every segment executes the compiled query program → (scores,
+mask); top-k (possibly sort-keyed) candidates come back as (segment, local,
+score, sort_values); shard results merge on the coordinating side
+(cluster/search coordinator or parallel/executor for the mesh path);
+the fetch phase materializes _source/highlight for the final page only.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.ops.scoring import topk_with_mask
+from elasticsearch_tpu.search.aggregations import parse_aggs, reduce_aggs, run_aggs
+from elasticsearch_tpu.search.context import GlobalStats, SegmentContext
+from elasticsearch_tpu.search.highlight import extract_query_terms, highlight_field
+from elasticsearch_tpu.search.queries import parse_query
+from elasticsearch_tpu.utils.errors import SearchParseException
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+@dataclass
+class ShardDoc:
+    """One candidate doc from the query phase (pre-fetch)."""
+
+    shard_ord: int
+    seg: Any  # TpuSegment
+    local_id: int
+    score: float
+    sort_values: Tuple = ()
+
+
+@dataclass
+class QueryPhaseResult:
+    docs: List[ShardDoc]
+    total_hits: int
+    max_score: float
+    agg_partials: Optional[dict] = None
+
+
+# in-memory scroll registry: scroll_id -> (snapshot state)
+_SCROLLS: Dict[str, dict] = {}
+
+
+class ShardSearcher:
+    """Executes search phases against one shard (list of segments)."""
+
+    def __init__(self, segments, mappings, analysis, shard_ord: int = 0):
+        self.segments = segments
+        self.mappings = mappings
+        self.analysis = analysis
+        self.shard_ord = shard_ord
+
+    # -- query phase -----------------------------------------------------------
+
+    def query_phase(self, body: dict, global_stats: Optional[GlobalStats] = None,
+                    extra_k: int = 0) -> QueryPhaseResult:
+        jnp = _jnp()
+        query = parse_query(body.get("query"))
+        aggs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        size = int(body.get("size", 10))
+        frm = int(body.get("from", 0))
+        k = min(max(size + frm + extra_k, 1), 10_000)
+        min_score = body.get("min_score")
+        sort_spec = _parse_sort(body.get("sort"))
+        search_after = body.get("search_after")
+
+        docs: List[ShardDoc] = []
+        total = 0
+        max_score = float("-inf")
+        agg_partials: List[dict] = []
+        for seg in self.segments:
+            ctx = SegmentContext(seg, self.mappings, self.analysis, global_stats)
+            scores, mask = query.score_or_mask(ctx)
+            mask = mask & seg.live
+            if min_score is not None:
+                mask = mask & (scores >= float(min_score))
+            total += int(jnp.sum(mask.astype(jnp.int32)))
+            if aggs:
+                agg_partials.append(run_aggs(aggs, ctx, mask))
+            if sort_spec:
+                seg_docs = self._sorted_candidates(ctx, scores, mask, sort_spec, k, search_after)
+            else:
+                kk = min(k, seg.max_docs)
+                vals, idx = topk_with_mask(scores, mask, k=kk)
+                vals = np.asarray(vals)
+                idx = np.asarray(idx)
+                seg_docs = [
+                    ShardDoc(self.shard_ord, seg, int(i), float(v))
+                    for v, i in zip(vals, idx)
+                    if np.isfinite(v)
+                ]
+            for d in seg_docs:
+                if np.isfinite(d.score):
+                    max_score = max(max_score, d.score)
+            docs.extend(seg_docs)
+
+        # merge segment candidates
+        if sort_spec:
+            docs.sort(key=lambda d: _sort_key(d.sort_values, sort_spec))
+        else:
+            docs.sort(key=lambda d: (-d.score, d.seg.seg_id, d.local_id))
+        docs = docs[:k]
+        merged_aggs = agg_partials if aggs else None
+        return QueryPhaseResult(
+            docs=docs,
+            total_hits=total,
+            max_score=max_score if docs and max_score != float("-inf") else float("nan"),
+            agg_partials={"_list": merged_aggs, "_aggs": aggs} if aggs else None,
+        )
+
+    def _sorted_candidates(self, ctx, scores, mask, sort_spec, k, search_after):
+        """Sort by field(s): oversampled device top-k on the primary key,
+        exact host ordering on the full key tuple."""
+        jnp = _jnp()
+        primary = sort_spec[0]
+        key_vec, _ = _sort_key_vector(ctx, primary, scores)
+        sel = mask
+        if search_after is not None:
+            sa = float(search_after[0]) if not isinstance(search_after[0], str) else search_after[0]
+            if isinstance(sa, float):
+                if primary["order"] == "desc":
+                    sel = sel & (key_vec < (sa - (primary.get("_offset") or 0.0)))
+                else:
+                    sel = sel & (key_vec > (sa - (primary.get("_offset") or 0.0)))
+        oversample = min(max(k * 4, 128), ctx.segment.max_docs)
+        dirn = 1.0 if primary["order"] == "desc" else -1.0
+        vals, idx = topk_with_mask(key_vec * dirn, sel, k=oversample)
+        vals = np.asarray(vals)
+        idx = np.asarray(idx)
+        cand = [int(i) for v, i in zip(vals, idx) if np.isfinite(v)]
+        np_scores = np.asarray(scores)
+        out = []
+        for local in cand:
+            sv = tuple(_sort_value(ctx, s, local, np_scores) for s in sort_spec)
+            out.append(ShardDoc(self.shard_ord, ctx.segment, local, float(np_scores[local]), sv))
+        out.sort(key=lambda d: _sort_key(d.sort_values, sort_spec))
+        return out[:k]
+
+    # -- fetch phase -----------------------------------------------------------
+
+    def fetch_phase(self, docs: List[ShardDoc], body: dict, index_name: str = "") -> List[dict]:
+        query = parse_query(body.get("query"))
+        src_filter = body.get("_source", True)
+        hl = body.get("highlight")
+        want_version = bool(body.get("version", False))
+        script_fields = body.get("script_fields")
+        stored_fields = body.get("stored_fields", body.get("fields"))
+        hits = []
+        for d in docs:
+            hit: Dict[str, Any] = {
+                "_index": index_name,
+                "_id": d.seg.ids[d.local_id],
+                "_score": None if d.sort_values else d.score,
+            }
+            if d.sort_values:
+                hit["sort"] = [v if not isinstance(v, tuple) else list(v) for v in d.sort_values]
+                hit["_score"] = None
+            src = d.seg.sources[d.local_id]
+            filtered = _filter_source(src, src_filter)
+            if filtered is not None:
+                hit["_source"] = filtered
+            if stored_fields:
+                flds = {}
+                for f in stored_fields:
+                    sv = d.seg.stored[d.local_id].get(f) if d.seg.stored[d.local_id] else None
+                    if sv is None and src and f in src:
+                        sv = src[f] if isinstance(src[f], list) else [src[f]]
+                    if sv is not None:
+                        flds[f] = sv
+                if flds:
+                    hit["fields"] = flds
+            if script_fields:
+                hit.setdefault("fields", {})
+                for fname, spec in script_fields.items():
+                    hit["fields"][fname] = [self._script_field(d, spec)]
+            if hl:
+                ctx = SegmentContext(d.seg, self.mappings, self.analysis)
+                hit["highlight"] = self._highlight(ctx, query, src, hl)
+            hits.append(hit)
+        return hits
+
+    def _script_field(self, d: ShardDoc, spec):
+        from elasticsearch_tpu.search.function_score import doc_resolver
+        from elasticsearch_tpu.search.scripting import compile_script
+
+        s = spec.get("script", spec) if isinstance(spec, dict) else spec
+        src = s if isinstance(s, str) else s.get("inline", s.get("source", ""))
+        params = {} if isinstance(s, str) else s.get("params", {})
+        ctx = SegmentContext(d.seg, self.mappings, self.analysis)
+        vals = compile_script(src).run(doc_resolver(ctx), params=params)
+        if hasattr(vals, "shape") and getattr(vals, "shape", ()) != ():
+            return float(np.asarray(vals)[d.local_id])
+        return float(vals) if hasattr(vals, "item") or isinstance(vals, (int, float)) else vals
+
+    def _highlight(self, ctx, query, src, hl_spec) -> Dict[str, List[str]]:
+        out = {}
+        pre = (hl_spec.get("pre_tags") or ["<em>"])[0]
+        post = (hl_spec.get("post_tags") or ["</em>"])[0]
+        for fname, fspec in hl_spec.get("fields", {}).items():
+            fm = self.mappings.get(fname)
+            if fm is None or src is None:
+                continue
+            raw = src.get(fname)
+            if not isinstance(raw, str):
+                continue
+            terms = extract_query_terms(query, fname, ctx)
+            analyzer = ctx.search_analyzer(fname)
+            frags = highlight_field(
+                raw, terms, analyzer,
+                pre_tag=pre, post_tag=post,
+                fragment_size=int(fspec.get("fragment_size", 100)),
+                number_of_fragments=int(fspec.get("number_of_fragments", 5)),
+            )
+            if frags:
+                out[fname] = frags
+        return out
+
+    def count(self, body: dict) -> int:
+        jnp = _jnp()
+        query = parse_query(body.get("query"))
+        total = 0
+        for seg in self.segments:
+            ctx = SegmentContext(seg, self.mappings, self.analysis)
+            _, mask = query.execute(ctx)
+            total += int(jnp.sum((mask & seg.live).astype(jnp.int32)))
+        return total
+
+
+# ---------------------------------------------------------------------------
+# coordinating search across shards (single node)
+# ---------------------------------------------------------------------------
+
+def search_shards(
+    searchers: List[ShardSearcher],
+    body: dict,
+    index_name: str = "",
+    global_stats: Optional[GlobalStats] = None,
+) -> dict:
+    """Query-then-fetch across shards, ES response shape."""
+    t0 = time.perf_counter()
+    size = int(body.get("size", 10))
+    frm = int(body.get("from", 0))
+    sort_spec = _parse_sort(body.get("sort"))
+
+    # scroll keeps the whole result window (up to the 10k cap per shard) in
+    # the snapshot so subsequent pages don't re-run the query phase
+    extra_k = 10_000 if body.get("scroll") else 0
+    results = [s.query_phase(body, global_stats, extra_k=extra_k) for s in searchers]
+    all_docs: List[ShardDoc] = []
+    total = 0
+    max_score = float("-inf")
+    for r in results:
+        all_docs.extend(r.docs)
+        total += r.total_hits
+        if r.docs and not np.isnan(r.max_score):
+            max_score = max(max_score, r.max_score)
+    if sort_spec:
+        all_docs.sort(key=lambda d: _sort_key(d.sort_values, sort_spec))
+    else:
+        all_docs.sort(key=lambda d: (-d.score, d.shard_ord, d.local_id))
+    page = all_docs[frm : frm + size]
+
+    by_shard: Dict[int, List[ShardDoc]] = {}
+    for d in page:
+        by_shard.setdefault(d.shard_ord, []).append(d)
+    hits: List[dict] = []
+    for shard_ord, docs in by_shard.items():
+        hits.extend(searchers[shard_ord].fetch_phase(docs, body, index_name))
+    # restore global order after per-shard fetch
+    order = {(d.shard_ord, id(d.seg), d.local_id): i for i, d in enumerate(page)}
+    hits_docs = list(zip(hits, [d for docs in by_shard.values() for d in docs]))
+    hits_docs.sort(key=lambda hd: order[(hd[1].shard_ord, id(hd[1].seg), hd[1].local_id)])
+    hits = [h for h, _ in hits_docs]
+
+    response: Dict[str, Any] = {
+        "took": int((time.perf_counter() - t0) * 1000),
+        "timed_out": False,
+        "_shards": {"total": len(searchers), "successful": len(searchers), "failed": 0},
+        "hits": {
+            "total": total,
+            "max_score": None if (max_score == float("-inf") or sort_spec) else max_score,
+            "hits": hits,
+        },
+    }
+    aggs_present = [r.agg_partials for r in results if r.agg_partials]
+    if aggs_present:
+        aggs = aggs_present[0]["_aggs"]
+        partial_lists = [p for r in aggs_present for p in r["_list"]]
+        response["aggregations"] = reduce_aggs(aggs, partial_lists)
+    if body.get("scroll"):
+        scroll_id = uuid.uuid4().hex
+        _SCROLLS[scroll_id] = {
+            "docs": all_docs,
+            "pos": frm + size,
+            "body": body,
+            "searchers": searchers,
+            "index_name": index_name,
+            "total": total,
+        }
+        response["_scroll_id"] = scroll_id
+    return response
+
+
+def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
+    state = _SCROLLS.get(scroll_id)
+    if state is None:
+        raise SearchParseException(f"no search context found for id [{scroll_id}]")
+    body = state["body"]
+    sz = size or int(body.get("size", 10))
+    page = state["docs"][state["pos"] : state["pos"] + sz]
+    state["pos"] += sz
+    by_shard: Dict[int, List[ShardDoc]] = {}
+    for d in page:
+        by_shard.setdefault(d.shard_ord, []).append(d)
+    hits = []
+    for shard_ord, docs in by_shard.items():
+        hits.extend(state["searchers"][shard_ord].fetch_phase(docs, body, state["index_name"]))
+    return {
+        "took": 0,
+        "timed_out": False,
+        "_scroll_id": scroll_id,
+        "hits": {"total": state["total"], "max_score": None, "hits": hits},
+    }
+
+
+def clear_scroll(scroll_id: str) -> bool:
+    return _SCROLLS.pop(scroll_id, None) is not None
+
+
+# ---------------------------------------------------------------------------
+# source filtering (fetch/source/FetchSourceSubPhase semantics)
+# ---------------------------------------------------------------------------
+
+def _filter_source(src: Optional[dict], spec) -> Optional[dict]:
+    import fnmatch
+
+    if src is None or spec is False:
+        return None
+    if spec is True or spec is None:
+        return src
+    if isinstance(spec, str):
+        spec = [spec]
+    if isinstance(spec, list):
+        includes, excludes = spec, []
+    else:
+        includes = spec.get("includes", spec.get("include", []))
+        excludes = spec.get("excludes", spec.get("exclude", []))
+        if isinstance(includes, str):
+            includes = [includes]
+        if isinstance(excludes, str):
+            excludes = [excludes]
+
+    def keep(key: str) -> bool:
+        if includes and not any(fnmatch.fnmatch(key, pat) for pat in includes):
+            return False
+        if excludes and any(fnmatch.fnmatch(key, pat) for pat in excludes):
+            return False
+        return True
+
+    return {k: v for k, v in src.items() if keep(k)}
+
+
+# ---------------------------------------------------------------------------
+# sort helpers
+# ---------------------------------------------------------------------------
+
+def _parse_sort(spec) -> List[dict]:
+    if not spec:
+        return []
+    if isinstance(spec, (str, dict)):
+        spec = [spec]
+    out = []
+    for item in spec:
+        if isinstance(item, str):
+            if item in ("_score",):
+                out.append({"field": "_score", "order": "desc"})
+            else:
+                out.append({"field": item, "order": "asc"})
+        else:
+            (fieldname, cfg), = item.items()
+            if isinstance(cfg, str):
+                out.append({"field": fieldname, "order": cfg})
+            else:
+                out.append({
+                    "field": fieldname,
+                    "order": cfg.get("order", "desc" if fieldname == "_score" else "asc"),
+                    "missing": cfg.get("missing", "_last"),
+                })
+    # drop trailing pure-score sort into score path
+    if len(out) == 1 and out[0]["field"] == "_score" and out[0]["order"] == "desc":
+        return []
+    return out
+
+
+def _sort_key_vector(ctx, s, scores):
+    """Device vector used for primary-key top-k preselection."""
+    jnp = _jnp()
+    if s["field"] == "_score":
+        return scores, 0.0
+    col = ctx.col(s["field"])
+    if col is not None:
+        missing_val = jnp.float32(-jnp.inf if s["order"] == "desc" else jnp.inf)
+        if str(s.get("missing", "_last")) == "_first":
+            missing_val = -missing_val
+        s["_offset"] = col.offset
+        return jnp.where(col.exists, col.values, missing_val), col.offset
+    kw = ctx.segment.keywords.get(s["field"])
+    if kw is not None:
+        return kw.ords.astype(jnp.float32), 0.0
+    return jnp.zeros(ctx.D, dtype=jnp.float32), 0.0
+
+
+def _sort_value(ctx, s, local: int, np_scores):
+    if s["field"] == "_score":
+        return float(np_scores[local])
+    col = ctx.col(s["field"])
+    if col is not None:
+        if not bool(np.asarray(col.exists)[local]):
+            return None
+        ex = col.exact[local]
+        return int(ex) if col.exact.dtype.kind == "i" else float(ex)
+    kw = ctx.segment.keywords.get(s["field"])
+    if kw is not None and kw.host_values[local]:
+        return kw.host_values[local][0]
+    return None
+
+
+_MISSING_LAST = object()
+
+
+def _sort_key(sort_values: Tuple, sort_spec: List[dict]):
+    key = []
+    for v, s in zip(sort_values, sort_spec):
+        desc = s["order"] == "desc"
+        missing_first = str(s.get("missing", "_last")) == "_first"
+        if v is None:
+            rank = 0 if missing_first else 2
+            key.append((rank, 0))
+        elif isinstance(v, str):
+            key.append((1, _StrKey(v, desc)))
+        else:
+            key.append((1, -v if desc else v))
+    return tuple(key)
+
+
+class _StrKey:
+    __slots__ = ("v", "desc")
+
+    def __init__(self, v, desc):
+        self.v = v
+        self.desc = desc
+
+    def __lt__(self, other):
+        return (self.v > other.v) if self.desc else (self.v < other.v)
+
+    def __eq__(self, other):
+        return self.v == other.v

@@ -1,0 +1,40 @@
+"""Launcher: ``python -m elasticsearch_tpu.server`` ≈ ``bin/elasticsearch``.
+
+Reference: org/elasticsearch/bootstrap/Bootstrap.java + bin/elasticsearch.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="elasticsearch_tpu")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9200)
+    ap.add_argument("--name", default="node-1")
+    ap.add_argument("--cluster-name", default="elasticsearch_tpu")
+    ap.add_argument("--data-path", default=None, help="directory for translog durability")
+    args = ap.parse_args(argv)
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.server import RestServer
+
+    node = Node(name=args.name, data_path=args.data_path, cluster_name=args.cluster_name)
+    server = RestServer(node, host=args.host, port=args.port)
+    print(f"[{args.name}] listening on http://{server.host}:{server.port}", flush=True)
+
+    def _stop(*_):
+        print("shutting down", flush=True)
+        server.stop()
+        node.close()
+        sys.exit(0)
+
+    signal.signal(signal.SIGINT, _stop)
+    signal.signal(signal.SIGTERM, _stop)
+    server.start(background=False)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,122 @@
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.utils.errors import DocumentMissingException, VersionConflictException
+
+MAPPING = {"properties": {"t": {"type": "text"}, "n": {"type": "long"}}}
+
+
+def make_engine(tmp_path=None):
+    translog = str(tmp_path / "translog") if tmp_path else None
+    return Engine(Mappings(MAPPING), AnalysisRegistry(), translog_path=translog)
+
+
+def test_index_get_delete_versioning():
+    e = make_engine()
+    _, v1, created = e.index("1", {"t": "hello world", "n": 1})
+    assert v1 == 1 and created
+    _, v2, created = e.index("1", {"t": "hello again", "n": 2})
+    assert v2 == 2 and not created
+    got = e.get("1")
+    assert got["_source"]["n"] == 2 and got["_version"] == 2  # realtime, pre-refresh
+    with pytest.raises(VersionConflictException):
+        e.index("1", {"t": "x"}, version=1)
+    _, v3, _ = e.index("1", {"t": "x"}, version=2)
+    assert v3 == 3
+    assert e.delete("1") == 4
+    assert e.get("1") is None
+    with pytest.raises(DocumentMissingException):
+        e.delete("1")
+
+
+def test_external_versioning():
+    e = make_engine()
+    e.index("1", {"t": "a"}, version=10, version_type="external")
+    with pytest.raises(VersionConflictException):
+        e.index("1", {"t": "b"}, version=9, version_type="external")
+    _, v, _ = e.index("1", {"t": "b"}, version=42, version_type="external")
+    assert v == 42
+
+
+def test_create_op_type():
+    e = make_engine()
+    e.index("1", {"t": "a"}, op_type="create")
+    with pytest.raises(VersionConflictException):
+        e.index("1", {"t": "b"}, op_type="create")
+
+
+def test_refresh_makes_docs_searchable():
+    e = make_engine()
+    e.index("1", {"t": "findable text"})
+    assert len(e.segments) == 0
+    assert e.refresh()
+    assert len(e.segments) == 1
+    assert e.segments[0].id_map["1"] == 0
+    got = e.get("1")
+    assert got["_source"]["t"] == "findable text"
+
+
+def test_update_partial_script_upsert():
+    e = make_engine()
+    e.index("1", {"t": "x", "n": 5})
+    v, created = e.update("1", partial={"n": 7})
+    assert not created and e.get("1")["_source"] == {"t": "x", "n": 7}
+    v, created = e.update("1", script="ctx._source.n = ctx._source.n + 10")
+    assert e.get("1")["_source"]["n"] == 17
+    v, created = e.update("2", partial={"n": 1}, upsert={"t": "new", "n": 0})
+    assert created and e.get("2")["_source"] == {"t": "new", "n": 0}
+
+
+def test_delete_buffered_doc_never_searchable():
+    e = make_engine()
+    e.index("1", {"t": "ghost"})
+    e.delete("1")
+    e.refresh()
+    assert all(seg.id_map.get("1") is None for seg in e.segments)
+
+
+def test_merge_compacts_segments():
+    e = make_engine()
+    for i in range(6):
+        e.index(str(i), {"t": f"doc {i}", "n": i})
+        e.refresh()
+    assert len(e.segments) == 6
+    e.delete("3")
+    e.merge()
+    assert len(e.segments) == 1
+    assert e.segments[0].num_docs == 5
+    assert "3" not in e.segments[0].id_map
+    assert e.get("4")["_source"]["n"] == 4
+
+
+def test_translog_replay_recovery(tmp_path):
+    e = make_engine(tmp_path)
+    e.index("1", {"t": "persisted", "n": 1})
+    e.index("2", {"t": "deleted later", "n": 2})
+    e.delete("2")
+    e.index("3", {"t": "third", "n": 3})
+    e.close()
+
+    e2 = make_engine(tmp_path)
+    e2.recover_from_translog()
+    assert e2.get("1")["_source"]["t"] == "persisted"
+    assert e2.get("2") is None
+    assert e2.get("3")["_source"]["n"] == 3
+    assert e2.num_docs == 2
+
+
+def test_flush_truncates_translog(tmp_path):
+    e = make_engine(tmp_path)
+    for i in range(5):
+        e.index(str(i), {"t": "x"})
+    assert e.translog.size_in_ops == 5
+    e.flush()
+    assert e.translog.size_in_ops == 0
+    # data survives in segments
+    assert e.num_docs == 5
+    e.close()

@@ -1,0 +1,222 @@
+"""End-to-end REST API tests over a real socket (mirrors rest-api-spec tests)."""
+import json
+import urllib.request
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    node = Node(name="test-node")
+    srv = RestServer(node, host="127.0.0.1", port=0)
+    srv.start(background=True)
+    yield srv
+    srv.stop()
+    node.close()
+
+
+def req(server, method, path, body=None, ndjson=None):
+    url = f"http://127.0.0.1:{server.port}{path}"
+    data = None
+    headers = {"Content-Type": "application/json"}
+    if ndjson is not None:
+        data = ndjson.encode()
+    elif body is not None:
+        data = json.dumps(body).encode()
+    r = urllib.request.Request(url, data=data, method=method, headers=headers)
+    try:
+        with urllib.request.urlopen(r) as resp:
+            payload = resp.read()
+            return resp.status, json.loads(payload) if payload else None
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        return e.code, json.loads(payload) if payload else None
+
+
+def test_root_info(server):
+    status, body = req(server, "GET", "/")
+    assert status == 200
+    assert body["tagline"].startswith("You Know, for Search")
+    assert body["version"]["build_flavor"] == "tpu"
+
+
+def test_full_document_lifecycle(server):
+    status, body = req(server, "PUT", "/books", {
+        "settings": {"index": {"number_of_shards": 2}},
+        "mappings": {"properties": {
+            "title": {"type": "text"},
+            "author": {"type": "keyword"},
+            "year": {"type": "integer"},
+        }},
+    })
+    assert status == 200 and body["acknowledged"]
+
+    status, body = req(server, "PUT", "/books/_doc/1",
+                       {"title": "The Left Hand of Darkness", "author": "le guin", "year": 1969})
+    assert status == 201 and body["_version"] == 1
+
+    req(server, "PUT", "/books/_doc/2",
+        {"title": "The Dispossessed", "author": "le guin", "year": 1974})
+    req(server, "PUT", "/books/_doc/3",
+        {"title": "Neuromancer", "author": "gibson", "year": 1984})
+
+    status, body = req(server, "GET", "/books/_doc/1")
+    assert status == 200 and body["found"] and body["_source"]["year"] == 1969
+
+    status, _ = req(server, "POST", "/books/_refresh")
+    assert status == 200
+
+    status, body = req(server, "POST", "/books/_search", {
+        "query": {"match": {"title": "darkness"}}})
+    assert status == 200
+    assert body["hits"]["total"] == 1
+    assert body["hits"]["hits"][0]["_id"] == "1"
+
+    status, body = req(server, "POST", "/books/_search", {
+        "query": {"term": {"author": "le guin"}},
+        "sort": [{"year": {"order": "desc"}}],
+    })
+    assert [h["_id"] for h in body["hits"]["hits"]] == ["2", "1"]
+    assert body["hits"]["hits"][0]["sort"] == [1974]
+
+    status, body = req(server, "POST", "/books/_search", {
+        "query": {"match_all": {}},
+        "aggs": {"authors": {"terms": {"field": "author"}},
+                 "avg_year": {"avg": {"field": "year"}}},
+        "size": 0,
+    })
+    buckets = {b["key"]: b["doc_count"] for b in body["aggregations"]["authors"]["buckets"]}
+    assert buckets == {"le guin": 2, "gibson": 1}
+    assert round(body["aggregations"]["avg_year"]["value"]) == 1976
+
+    status, body = req(server, "POST", "/books/_update/1?refresh=true", {"doc": {"year": 1970}})
+    assert status == 200 and body["_version"] == 2
+    status, body = req(server, "GET", "/books/_doc/1")
+    assert body["_source"]["year"] == 1970
+
+    status, body = req(server, "DELETE", "/books/_doc/3")
+    assert status == 200
+    status, body = req(server, "GET", "/books/_doc/3")
+    assert status == 404 and not body["found"]
+
+    status, body = req(server, "GET", "/books/_count")
+    assert body["count"] == 2
+
+
+def test_bulk_and_msearch(server):
+    nd = "\n".join([
+        json.dumps({"index": {"_index": "bulk-idx", "_id": "a"}}),
+        json.dumps({"msg": "alpha one", "k": 1}),
+        json.dumps({"index": {"_index": "bulk-idx", "_id": "b"}}),
+        json.dumps({"msg": "beta two", "k": 2}),
+        json.dumps({"delete": {"_index": "bulk-idx", "_id": "zz"}}),
+    ]) + "\n"
+    status, body = req(server, "POST", "/_bulk?refresh=true", ndjson=nd)
+    assert status == 200
+    assert body["errors"] is True  # the delete of a missing doc
+    assert body["items"][0]["index"]["status"] == 201
+    assert body["items"][2]["delete"]["status"] == 404
+
+    nd = "\n".join([
+        json.dumps({"index": "bulk-idx"}),
+        json.dumps({"query": {"match": {"msg": "alpha"}}}),
+        json.dumps({"index": "bulk-idx"}),
+        json.dumps({"query": {"match_all": {}}}),
+    ]) + "\n"
+    status, body = req(server, "POST", "/_msearch", ndjson=nd)
+    assert status == 200
+    assert body["responses"][0]["hits"]["total"] == 1
+    assert body["responses"][1]["hits"]["total"] == 2
+
+
+def test_error_shapes(server):
+    status, body = req(server, "GET", "/no-such-index/_search", {})
+    assert status == 404
+    assert body["error"]["type"] == "index_not_found_exception"
+
+    status, body = req(server, "PUT", "/Invalid*Name", {})
+    assert status == 400
+
+    status, body = req(server, "POST", "/books/_search", {"query": {"bogus": {}}})
+    assert status == 400
+    assert "bogus" in body["error"]["reason"]
+
+
+def test_analyze_endpoint(server):
+    status, body = req(server, "POST", "/_analyze",
+                       {"analyzer": "standard", "text": "The Quick Fox"})
+    assert [t["token"] for t in body["tokens"]] == ["the", "quick", "fox"]
+
+
+def test_cat_and_cluster(server):
+    status, body = req(server, "GET", "/_cluster/health")
+    assert status == 200 and body["status"] in ("green", "yellow")
+    status, body = req(server, "GET", "/_cat/indices")
+    assert any(row["index"] == "books" for row in body)
+    status, body = req(server, "GET", "/_cluster/state")
+    assert "books" in body["metadata"]["indices"]
+
+
+def test_highlight_and_source_filtering(server):
+    req(server, "PUT", "/hl", {"mappings": {"properties": {"body": {"type": "text"}}}})
+    req(server, "PUT", "/hl/_doc/1?refresh=true",
+        {"body": "the quick brown fox jumps over the lazy dog", "extra": "hidden"})
+    status, body = req(server, "POST", "/hl/_search", {
+        "query": {"match": {"body": "fox"}},
+        "_source": ["body"],
+        "highlight": {"fields": {"body": {}}},
+    })
+    hit = body["hits"]["hits"][0]
+    assert "extra" not in hit["_source"]
+    assert "<em>fox</em>" in hit["highlight"]["body"][0]
+
+
+def test_scroll(server):
+    req(server, "PUT", "/scr", {})
+    nd = []
+    for i in range(25):
+        nd.append(json.dumps({"index": {"_index": "scr", "_id": str(i)}}))
+        nd.append(json.dumps({"x": i}))
+    req(server, "POST", "/_bulk?refresh=true", ndjson="\n".join(nd) + "\n")
+    status, body = req(server, "POST", "/scr/_search?scroll=1m",
+                       {"query": {"match_all": {}}, "size": 10})
+    assert len(body["hits"]["hits"]) == 10
+    sid = body["_scroll_id"]
+    status, body = req(server, "POST", "/_search/scroll", {"scroll_id": sid})
+    assert len(body["hits"]["hits"]) == 10
+    status, body = req(server, "POST", "/_search/scroll", {"scroll_id": sid})
+    assert len(body["hits"]["hits"]) == 5
+    status, body = req(server, "DELETE", "/_search/scroll", {"scroll_id": sid})
+    assert body["num_freed"] == 1
+
+
+def test_aliases_and_templates(server):
+    req(server, "PUT", "/_template/logs-tmpl", {
+        "template": "logs-*",
+        "settings": {"index": {"number_of_shards": 1}},
+        "mappings": {"properties": {"level": {"type": "keyword"}}},
+    })
+    req(server, "PUT", "/logs-2026.07", {})
+    status, body = req(server, "GET", "/logs-2026.07/_mapping")
+    assert body["logs-2026.07"]["mappings"]["properties"]["level"]["type"] == "keyword"
+
+    req(server, "POST", "/_aliases", {"actions": [
+        {"add": {"index": "logs-2026.07", "alias": "logs-current"}}]})
+    req(server, "PUT", "/logs-2026.07/_doc/1?refresh=true", {"level": "error", "msg": "boom"})
+    status, body = req(server, "POST", "/logs-current/_search",
+                       {"query": {"term": {"level": "error"}}})
+    assert body["hits"]["total"] == 1
+
+
+def test_explain_and_termvectors(server):
+    status, body = req(server, "POST", "/books/_explain/1",
+                       {"query": {"match": {"title": "darkness"}}})
+    assert status == 200 and body["matched"] is True
+    assert body["explanation"]["value"] > 0
+
+    status, body = req(server, "GET", "/books/_termvectors/1")
+    assert status == 200
+    assert "darkness" in body["term_vectors"]["title"]["terms"]

@@ -169,3 +169,80 @@ def test_function_score_sum_with_filtered_function():
     s = np.asarray(s)
     assert s[1] == 10.0  # matches filter -> weight
     assert s[0] == 1.0  # matches NO function -> neutral factor 1, not 0/1-inflated
+
+
+def test_fuzzy_and_operator_groups_expansions():
+    ctx = _mini_ctx(
+        [{"t": "quick dog"}, {"t": "quirk dog"}, {"t": "slow cat"}],
+        {"properties": {"t": {"type": "text"}}},
+    )
+    from elasticsearch_tpu.search.queries import parse_query
+
+    dsl = {"match": {"t": {"query": "quik dog", "operator": "and", "fuzziness": "AUTO"}}}
+    _, m = parse_query(dsl).execute(ctx)
+    # 'quik' expands to {quick, quirk}: both docs 0 and 1 must match (OR within group)
+    assert np.nonzero(np.asarray(m)[:3])[0].tolist() == [0, 1]
+
+
+def test_msm_not_capped_by_absent_terms():
+    ctx = _mini_ctx(
+        [{"t": "quick fox"}, {"t": "quick dog"}],
+        {"properties": {"t": {"type": "text"}}},
+    )
+    from elasticsearch_tpu.search.queries import parse_query
+
+    dsl = {"match": {"t": {"query": "quick zzzz", "minimum_should_match": 2}}}
+    _, m = parse_query(dsl).execute(ctx)
+    assert int(np.asarray(m).sum()) == 0  # absent term can never satisfy msm=2
+
+
+def test_histogram_zero_interval_rejected():
+    from elasticsearch_tpu.search.aggregations import parse_aggs
+    from elasticsearch_tpu.utils.errors import SearchParseException
+
+    aggs = parse_aggs({"h": {"histogram": {"field": "p", "interval": 0}}})
+    ctx = _mini_ctx([{"p": 1.0}], {"properties": {"p": {"type": "double"}}})
+    import jax.numpy as jnp
+
+    with pytest.raises(SearchParseException):
+        aggs[0].collect(ctx, jnp.ones(ctx.D, dtype=bool))
+
+
+def test_nested_ternary_script():
+    from elasticsearch_tpu.search.scripting import compile_script
+    import jax.numpy as jnp
+
+    cs = compile_script("doc['p'].value > 10 ? 2.0 : doc['p'].value > 5 ? 1.0 : 0.5")
+    from elasticsearch_tpu.search.scripting import _DocField
+
+    vals = jnp.asarray(np.array([20.0, 7.0, 1.0], np.float32))
+    out = cs.run(lambda f: _DocField(vals, jnp.ones(3, bool)))
+    assert np.asarray(out).tolist() == [2.0, 1.0, 0.5]
+
+
+def test_query_string_negated_phrase():
+    ctx = _mini_ctx(
+        [{"t": "quick brown fox"}, {"t": "brown bear"}, {"t": "red fish"}],
+        {"properties": {"t": {"type": "text"}}},
+    )
+    from elasticsearch_tpu.search.queries import parse_query
+
+    dsl = {"query_string": {"query": '-"quick brown" bear', "default_field": "t"}}
+    _, m = parse_query(dsl).execute(ctx)
+    # doc 0 excluded by the negated phrase; doc 1 matches 'bear'
+    assert np.nonzero(np.asarray(m)[:3])[0].tolist() == [1]
+
+
+def test_terms_order_by_subagg():
+    from elasticsearch_tpu.search.aggregations import parse_aggs, run_aggs, reduce_aggs
+    import jax.numpy as jnp
+
+    ctx = _mini_ctx(
+        [{"tag": "a", "p": 1.0}, {"tag": "b", "p": 9.0}, {"tag": "c", "p": 5.0}],
+        {"properties": {"tag": {"type": "keyword"}, "p": {"type": "double"}}},
+    )
+    aggs = parse_aggs({"t": {"terms": {"field": "tag", "order": {"mp": "desc"}},
+                             "aggs": {"mp": {"max": {"field": "p"}}}}})
+    mask = jnp.arange(ctx.D) < ctx.segment.num_docs
+    out = reduce_aggs(aggs, [run_aggs(aggs, ctx, mask)])
+    assert [b["key"] for b in out["t"]["buckets"]] == ["b", "c", "a"]
