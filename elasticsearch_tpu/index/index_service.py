@@ -32,6 +32,7 @@ class IndexService:
         self.num_replicas = int(idx_settings.get("number_of_replicas", 0))
         self.analysis = AnalysisRegistry(self.settings)
         self.mappings = Mappings(mappings_json or {})
+        self._validate_analyzers(self.mappings)
         self.aliases: Dict[str, dict] = {}
         self.data_path = data_path
         self.shards: List[IndexShard] = [
@@ -39,6 +40,23 @@ class IndexService:
             for i in range(self.num_shards)
         ]
         self.closed = False
+
+    def _validate_analyzers(self, mappings: Mappings):
+        """Reject mappings naming analyzers the registry can't build —
+        reference: MapperService fails index creation on unknown analyzers."""
+        from elasticsearch_tpu.utils.errors import MapperParsingException
+
+        for name, fm in mappings.fields.items():
+            if not getattr(fm, "is_text", False):
+                continue
+            for an in (fm.analyzer, fm.search_analyzer):
+                if an is None:
+                    continue
+                try:
+                    self.analysis.get(an)
+                except ValueError as e:
+                    raise MapperParsingException(
+                        f"analyzer [{an}] not found for field [{name}]") from e
 
     # -- routing ---------------------------------------------------------------
 

@@ -113,7 +113,18 @@ class Node:
         return self.indices[names[0]]
 
     def put_mapping(self, index: str, body: dict) -> dict:
-        for n in self.resolve_indices(index):
+        import copy
+
+        names = self.resolve_indices(index)
+        # validate the merged result on copies first: a rejected update must
+        # leave every index untouched (all-or-nothing, like the reference's
+        # MetaDataMappingService cluster-state update)
+        for n in names:
+            svc = self.indices[n]
+            trial = copy.deepcopy(svc.mappings)
+            trial.merge(body)
+            svc._validate_analyzers(trial)
+        for n in names:
             self.indices[n].mappings.merge(body)
         return {"acknowledged": True}
 

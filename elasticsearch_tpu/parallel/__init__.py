@@ -1,0 +1,14 @@
+"""Distributed execution: shard Mesh, shard_map search programs, placement.
+
+Replaces the reference's scatter/gather transport layer
+(org/elasticsearch/action/search/type/*.java over netty) with XLA
+collectives over a `jax.sharding.Mesh` — see executor.py.
+"""
+from elasticsearch_tpu.parallel.mesh import shard_mesh, training_mesh, mesh_size
+from elasticsearch_tpu.parallel.executor import MeshSearchExecutor
+from elasticsearch_tpu.parallel.placement import allocate, placement_table
+
+__all__ = [
+    "shard_mesh", "training_mesh", "mesh_size",
+    "MeshSearchExecutor", "allocate", "placement_table",
+]

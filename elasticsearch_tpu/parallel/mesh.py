@@ -1,0 +1,86 @@
+"""Device-mesh construction for the distributed layers.
+
+Reference counterpart: none — this replaces the *deployment topology* of
+org/elasticsearch/cluster/routing/ (shards spread over nodes connected by
+netty transport) with a `jax.sharding.Mesh`. Shards map to devices along a
+``shard`` axis; search collectives (all_gather of per-shard top-k, psum of
+agg partials / term stats) ride ICI instead of the transport layer.
+
+Two mesh flavors:
+
+- ``shard_mesh(n)``: 1-D ('shard',) mesh for search/indexing data placement.
+- ``training_mesh(n)``: 2-D ('dp', 'tp') mesh for the dual-encoder model
+  (models/dual_encoder.py) — batch data-parallel × tensor-parallel, the
+  standard TPU layout where tp collectives stay on the fastest ICI axis.
+
+Both accept fewer devices than requested shards by wrapping (multiple
+shards per device), mirroring ES packing multiple shards per node.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def shard_mesh(n_shards: Optional[int] = None, devices: Optional[Sequence] = None):
+    """1-D Mesh over ('shard',). Uses min(n_shards, n_devices) devices."""
+    jax = _jax()
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = len(devs) if n_shards is None else min(n_shards, len(devs))
+    return Mesh(np.asarray(devs[:n]), ("shard",))
+
+
+def training_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] = None,
+                  tp: Optional[int] = None):
+    """2-D Mesh over ('dp', 'tp').
+
+    tp defaults to the largest power of two ≤ min(n, 4) that divides n —
+    keeps tensor-parallel groups small (tp collectives are latency-bound)
+    while giving data parallelism the rest.
+    """
+    jax = _jax()
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    n = n_devices if n_devices is not None else len(devs)
+    devs = devs[:n]
+    if tp is None:
+        tp = 1
+        while tp * 2 <= min(n, 4) and n % (tp * 2) == 0:
+            tp *= 2
+    assert n % tp == 0, f"tp={tp} must divide n={n}"
+    return Mesh(np.asarray(devs).reshape(n // tp, tp), ("dp", "tp"))
+
+
+def mesh_size(mesh) -> int:
+    return int(np.prod(list(mesh.shape.values())))
+
+
+def get_shard_map():
+    """Version-agnostic shard_map: jax.shard_map (≥0.8, check_vma kwarg) or
+    jax.experimental.shard_map (older, check_rep kwarg)."""
+    jax = _jax()
+
+    def wrapper(f, *, mesh, in_specs, out_specs, check_rep=False):
+        sm = getattr(jax, "shard_map", None)
+        if sm is not None:
+            try:
+                return sm(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
+            except TypeError:
+                return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+        from jax.experimental.shard_map import shard_map as esm
+
+        return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_rep)
+
+    return wrapper

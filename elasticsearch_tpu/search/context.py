@@ -22,6 +22,30 @@ from elasticsearch_tpu.utils.shapes import pow2_bucket
 P_MAX = 1 << 15
 
 
+def split_runs(runs):
+    """P_MAX-split raw (start, len, weight) postings runs.
+
+    Returns (starts, lens, ws, max_len); max_len is the window width P the
+    score program needs — a run split into full-width chunks forces P_MAX,
+    not just its tail length.
+    """
+    starts, lens, ws = [], [], []
+    max_len = 1
+    for s, ln, w in runs:
+        while ln > P_MAX:
+            starts.append(s)
+            lens.append(P_MAX)
+            ws.append(w)
+            s += P_MAX
+            ln -= P_MAX
+            max_len = P_MAX
+        starts.append(s)
+        lens.append(ln)
+        ws.append(w)
+        max_len = max(max_len, ln)
+    return starts, lens, ws, max_len
+
+
 @dataclass
 class GlobalStats:
     """Cross-shard term statistics for consistent idf (dfs phase)."""
@@ -76,24 +100,14 @@ class SegmentContext:
         where Tb is a pow2 bucket. Terms absent from the segment contribute
         (0, 0) chunks. n_real_terms counts distinct terms present.
         """
-        starts, lens, ws = [], [], []
+        runs = []
         n_present = 0
-        max_len = 1
         for term, w in zip(terms, weights):
             s, ln = inv.term_slice(term)
             if ln > 0:
                 n_present += 1
-            while ln > P_MAX:
-                starts.append(s)
-                lens.append(P_MAX)
-                ws.append(w)
-                s += P_MAX
-                ln -= P_MAX
-                max_len = P_MAX  # P must cover the full-width chunks, not just the tail
-            starts.append(s)
-            lens.append(ln)
-            ws.append(w)
-            max_len = max(max_len, ln)
+            runs.append((s, ln, w))
+        starts, lens, ws, max_len = split_runs(runs)
         P = pow2_bucket(max_len)
         Tb = pow2_bucket(len(starts), minimum=1)
         starts += [0] * (Tb - len(starts))

@@ -18,6 +18,10 @@ def main(argv=None):
     ap.add_argument("--data-path", default=None, help="directory for translog durability")
     args = ap.parse_args(argv)
 
+    from elasticsearch_tpu.utils.platform import ensure_cpu_if_requested
+
+    ensure_cpu_if_requested()
+
     from elasticsearch_tpu.node import Node
     from elasticsearch_tpu.rest.server import RestServer
 

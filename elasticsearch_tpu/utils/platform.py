@@ -1,0 +1,28 @@
+"""Platform guard for environments with a TPU-tunnel jax plugin.
+
+When ``JAX_PLATFORMS=cpu`` is requested, a registered tunnel backend
+("axon") can still initialize its client on first jax backend lookup and
+block indefinitely if the tunnel is down. Deregistering the factory before
+first device use makes CPU-only runs (tests, local REST server, bench CPU
+baselines) reliable. No-op when the plugin is absent or another platform is
+requested.
+"""
+from __future__ import annotations
+
+import os
+
+
+def ensure_cpu_if_requested() -> None:
+    if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
+        return
+    try:  # pragma: no cover - environment-specific
+        import jax
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        for _alias, _plats in list(getattr(_xb, "_alias_to_platforms", {}).items()):
+            if "axon" in _plats:
+                _plats.remove("axon")
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
