@@ -1,0 +1,17 @@
+"""Models: flax dual encoder for dense-retrieval embeddings (SURVEY §2.12)."""
+from elasticsearch_tpu.models.dual_encoder import (
+    DualEncoderConfig,
+    SimpleTokenizer,
+    build_model,
+    init_params,
+    make_train_step,
+    param_shardings,
+    batch_sharding,
+    contrastive_loss,
+)
+
+__all__ = [
+    "DualEncoderConfig", "SimpleTokenizer", "build_model", "init_params",
+    "make_train_step", "param_shardings", "batch_sharding",
+    "contrastive_loss",
+]

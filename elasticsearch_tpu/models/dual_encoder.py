@@ -1,0 +1,258 @@
+"""SBERT-style dual encoder for dense retrieval (flagship model).
+
+Role in the framework: generates `dense_vector` embeddings for hybrid
+BM25 + kNN search (SURVEY.md §2.12). The reference (ES 2.0) has no model —
+this is the north-star addition that makes the kNN path end-to-end: encode
+passages at index time into the segment's vector slab, encode queries at
+search time, brute-force bf16 matmul on the MXU.
+
+TPU-first design:
+- One shared transformer tower (bf16 activations, f32 params), mean-pool
+  over the attention mask, L2-normalized projection — cosine similarity is
+  then a pure matmul.
+- In-batch contrastive training (InfoNCE, symmetric) — the standard dual
+  encoder recipe; every (query, positive) pair uses the rest of the batch
+  as negatives, so the loss itself is one [B, B] matmul.
+- Sharding: data-parallel over 'dp', tensor-parallel over 'tp' (attention
+  heads + MLP hidden sharded; GSPMD inserts the all_reduces on the 'tp'
+  axis). `shard_params` / `batch_sharding` produce NamedShardings from
+  logical rules; `make_train_step` jits the full update under a Mesh.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass
+class DualEncoderConfig:
+    vocab_size: int = 8192
+    max_len: int = 128
+    d_model: int = 256
+    n_heads: int = 4
+    n_layers: int = 4
+    d_ff: int = 1024
+    embed_dim: int = 128
+    dtype: Any = None  # default bfloat16, set lazily
+
+
+def _flax():
+    import flax.linen as nn
+    import jax
+    import jax.numpy as jnp
+
+    return nn, jax, jnp
+
+
+def build_model(cfg: DualEncoderConfig):
+    nn, jax, jnp = _flax()
+    dtype = cfg.dtype or jnp.bfloat16
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x, mask):
+            # pre-LN attention; attn mask [B, 1, L, L]
+            h = nn.LayerNorm(dtype=dtype, name="ln1")(x)
+            h = nn.MultiHeadDotProductAttention(
+                num_heads=cfg.n_heads, qkv_features=cfg.d_model,
+                dtype=dtype, name="attn")(h, h, mask=mask)
+            x = x + h
+            h = nn.LayerNorm(dtype=dtype, name="ln2")(x)
+            h = nn.Dense(cfg.d_ff, dtype=dtype, name="wi")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(cfg.d_model, dtype=dtype, name="wo")(h)
+            return x + h
+
+    class Encoder(nn.Module):
+        @nn.compact
+        def __call__(self, token_ids, attn_mask):
+            # token_ids i32[B, L], attn_mask bool/f32[B, L]
+            B, L = token_ids.shape
+            x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=dtype,
+                         name="tok_emb")(token_ids)
+            pos = nn.Embed(cfg.max_len, cfg.d_model, dtype=dtype,
+                           name="pos_emb")(jnp.arange(L)[None, :])
+            x = x + pos
+            m = attn_mask.astype(jnp.float32)
+            sa_mask = (m[:, None, None, :] * m[:, None, :, None]) > 0
+            for i in range(cfg.n_layers):
+                x = Block(name=f"block_{i}")(x, sa_mask)
+            x = nn.LayerNorm(dtype=dtype, name="ln_f")(x)
+            # masked mean pool → projection → L2 normalize (f32 output)
+            denom = jnp.maximum(jnp.sum(m, axis=1, keepdims=True), 1.0)
+            pooled = jnp.sum(x * m[:, :, None].astype(x.dtype), axis=1) / \
+                denom.astype(x.dtype)
+            z = nn.Dense(cfg.embed_dim, dtype=dtype, name="proj")(pooled)
+            z = z.astype(jnp.float32)
+            return z / jnp.maximum(jnp.linalg.norm(z, axis=-1, keepdims=True),
+                                   1e-6)
+
+    return Encoder()
+
+
+def init_params(cfg: DualEncoderConfig, seed: int = 0):
+    nn, jax, jnp = _flax()
+    model = build_model(cfg)
+    ids = jnp.zeros((2, cfg.max_len), jnp.int32)
+    mask = jnp.ones((2, cfg.max_len), jnp.float32)
+    return model.init(jax.random.PRNGKey(seed), ids, mask)
+
+
+# ---------------------------------------------------------------------------
+# sharding rules (dp × tp)
+# ---------------------------------------------------------------------------
+
+# path-regex → PartitionSpec axes for the kernel's dims. Column-parallel
+# (output dim on 'tp'): qkv projections, mlp wi, embeddings' model dim.
+# Row-parallel (input dim on 'tp'): attention out, mlp wo — GSPMD inserts
+# the psum where row-parallel outputs rejoin.
+_RULES = [
+    (r"tok_emb.*embedding$", (None, "tp")),
+    (r"pos_emb.*embedding$", (None, "tp")),
+    (r"attn/(query|key|value).*kernel$", (None, "tp")),
+    (r"attn/out.*kernel$", ("tp", None)),
+    (r"wi/kernel$", (None, "tp")),
+    (r"wo/kernel$", ("tp", None)),
+    (r"proj/kernel$", (None, None)),
+]
+
+
+def _spec_for(path: str, ndim: int):
+    from jax.sharding import PartitionSpec as PS
+
+    for pat, axes in _RULES:
+        if re.search(pat, path):
+            if len(axes) == ndim:
+                return PS(*axes)
+            if ndim > len(axes):
+                # attn kernels are [d_model, heads, head_dim] — 'tp' goes on
+                # the heads dim (column-parallel) or the leading dim
+                # (row-parallel out projection), rest replicated
+                if axes == (None, "tp"):
+                    return PS(*([None] * (ndim - 2) + ["tp", None]))
+                if axes == ("tp", None):
+                    return PS(*(["tp"] + [None] * (ndim - 1)))
+            return PS(*([None] * ndim))
+    return PS(*([None] * ndim))
+
+
+def param_shardings(mesh, params):
+    """PyTree of NamedShardings matching `params` under `mesh`.
+
+    A dim whose size isn't divisible by the mesh axis falls back to
+    replication for that dim (small models on big tp groups still compile).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    def path_str(kp):
+        return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                        for k in kp)
+
+    def to_sharding(kp, v):
+        spec = _spec_for(path_str(kp), v.ndim)
+        axes = []
+        for dim, ax in enumerate(spec):
+            if ax is not None and v.shape[dim] % mesh.shape[ax] != 0:
+                ax = None
+            axes.append(ax)
+        return NamedSharding(mesh, PS(*axes))
+
+    return jax.tree_util.tree_map_with_path(to_sharding, params)
+
+
+def batch_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    return NamedSharding(mesh, PS("dp"))
+
+
+# ---------------------------------------------------------------------------
+# training
+# ---------------------------------------------------------------------------
+
+def contrastive_loss(q_emb, d_emb, scale: float = 20.0):
+    """Symmetric in-batch InfoNCE over L2-normalized embeddings."""
+    import jax.numpy as jnp
+
+    logits = q_emb @ d_emb.T * scale  # [B, B]
+    labels = jnp.arange(logits.shape[0])
+    lq = _xent(logits, labels)
+    ld = _xent(logits.T, labels)
+    return 0.5 * (lq + ld)
+
+
+def _xent(logits, labels):
+    import jax.numpy as jnp
+
+    logz = jnp.log(jnp.sum(jnp.exp(logits - logits.max(-1, keepdims=True)),
+                           axis=-1)) + logits.max(-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
+    return jnp.mean(logz - gold)
+
+
+def make_optimizer(lr: float = 1e-3):
+    import optax
+
+    return optax.adamw(lr, weight_decay=0.01)
+
+
+def make_train_step(cfg: DualEncoderConfig, lr: float = 1e-3):
+    """Jitted (params, opt_state, batch) -> (params, opt_state, loss).
+
+    batch = (q_ids, q_mask, d_ids, d_mask). Sharding is data-driven: put
+    params with `param_shardings(mesh, ...)` (tp rules) and batch arrays
+    with `batch_sharding(mesh)` ('dp' on the leading dim); jit then compiles
+    one SPMD program over the mesh and GSPMD inserts the tp all_reduces and
+    the dp gradient psum. Donates params/opt_state (in-place device update).
+    """
+    nn, jax, jnp = _flax()
+    model = build_model(cfg)
+    tx = make_optimizer(lr)
+
+    def loss_fn(params, batch):
+        q_ids, q_mask, d_ids, d_mask = batch
+        q = model.apply(params, q_ids, q_mask)
+        d = model.apply(params, d_ids, d_mask)
+        return contrastive_loss(q, d)
+
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(step, donate_argnums=(0, 1)), tx
+
+
+def encode(model, params, token_ids, attn_mask):
+    """Jit-friendly encode: f32[B, embed_dim], unit-norm."""
+    return model.apply(params, token_ids, attn_mask)
+
+
+class SimpleTokenizer:
+    """Hash-vocabulary tokenizer for the dual encoder (no external vocab
+    files). Bucket ids come from crc32 — stable across processes, so
+    passages indexed by one server encode identically after a restart
+    (Python's builtin hash() is salted per process and must not be used)."""
+
+    def __init__(self, cfg: DualEncoderConfig):
+        self.cfg = cfg
+
+    def __call__(self, texts, max_len: Optional[int] = None):
+        import zlib
+
+        L = max_len or self.cfg.max_len
+        ids = np.zeros((len(texts), L), np.int32)
+        mask = np.zeros((len(texts), L), np.float32)
+        for i, t in enumerate(texts):
+            toks = t.lower().split()[:L]
+            for j, tok in enumerate(toks):
+                ids[i, j] = (zlib.crc32(tok.encode("utf-8"))
+                             % (self.cfg.vocab_size - 1)) + 1
+            mask[i, : len(toks)] = 1.0
+        return ids, mask

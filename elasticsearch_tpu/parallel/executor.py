@@ -181,11 +181,15 @@ class MeshSearchExecutor:
 
         self.mesh = mesh
         self.S = mesh_size(mesh)
-        # each slot: IndexShard | list[TpuSegment] | TpuSegment
+        # each entry: IndexShard | list[TpuSegment] | TpuSegment. More
+        # shards than mesh slots wrap round-robin (shard i → slot i % S,
+        # its segments joining that slot's rounds) — ES packs multiple
+        # shards per node the same way.
         self.shards = list(shards)
-        if len(shards) != self.S:
+        if len(shards) < self.S:
             raise ValueError(
-                f"mesh has {self.S} shard slots but got {len(shards)} shards")
+                f"mesh has {self.S} shard slots but got only {len(shards)} "
+                f"shards; build the mesh with shard_mesh(n_shards)")
         # compiled programs die with the executor (and thus the mesh)
         self._programs: Dict[Tuple, Any] = {}
         # sharded device arrays per segment round — postings and vector slabs
@@ -193,12 +197,15 @@ class MeshSearchExecutor:
         # (small) live mask is re-uploaded every call. LRU-bounded.
         self._data: "OrderedDict[Tuple, Any]" = OrderedDict()
 
-    def _cached_data(self, key, build):
+    def _cached_data(self, key, build, refs):
+        """Cache device arrays keyed by segment ids. `refs` (the segments
+        themselves) are stored alongside so a cached id() can never be
+        recycled by a new object while its entry is alive."""
         if key in self._data:
             self._data.move_to_end(key)
-            return self._data[key]
+            return self._data[key][0]
         val = build()
-        self._data[key] = val
+        self._data[key] = (val, list(refs))
         if len(self._data) > _DATA_CACHE_CAP:
             self._data.popitem(last=False)
         return val
@@ -208,27 +215,41 @@ class MeshSearchExecutor:
     def search_terms(self, field: str, query_terms: List[List[Tuple[str, float]]],
                      k: int = 10):
         """query_terms: per query, list of (term, boost). Returns
-        (vals [Q,k], shard [Q,k], local_in_round [Q,k], round [Q,k], totals[Q])
-        merged across every segment round."""
-        jax = _jax()
-        from jax.sharding import NamedSharding, PartitionSpec as PS
-
+        (vals [Q,k], shard [Q,k], local [Q,k], seg_ord [Q,k], totals [Q])
+        merged across every segment round; (shard, seg_ord, local) addresses
+        a doc as (originating shard, segment ordinal within it, local id)."""
         merged = None
-        for rno, seg_row in enumerate(self._segment_rounds()):
-            out = self._search_round(field, query_terms, seg_row, k, rno)
+        for row in self._segment_rounds():
+            out = self._search_round(field, query_terms, row, k)
             merged = out if merged is None else _merge_rounds(merged, out, k)
         return merged
 
     def _segment_rounds(self):
-        cols = [_segments_of(s) for s in self.shards]
+        """Rows of (orig_shard_index, seg_ordinal, segment)|None per round.
+
+        Slot s holds the concatenated segments of shards s, s+S, s+2S, …
+        (round-robin wrap); `shard_index` on results maps a slot back to the
+        originating shard via the stored pairs.
+        """
+        cols = [[] for _ in range(self.S)]
+        for i, s in enumerate(self.shards):
+            cols[i % self.S].extend(
+                (i, ordinal, seg)
+                for ordinal, seg in enumerate(_segments_of(s)))
         max_rounds = max((len(c) for c in cols), default=0) or 1
         return [[c[r] if r < len(c) else None for c in cols]
                 for r in range(max_rounds)]
 
-    def _search_round(self, field, query_terms, seg_row, k, round_no=0):
+    def _search_round(self, field, query_terms, row, k):
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as PS
         jax = _jax()
+
+        seg_row = [e[2] if e is not None else None for e in row]
+        lut_shard = np.asarray([e[0] if e is not None else -1 for e in row],
+                               np.int32)
+        lut_ord = np.asarray([e[1] if e is not None else 0 for e in row],
+                             np.int32)
 
         # shape buckets common across shards
         D = pow2_bucket(max((s.max_docs if s is not None else 1) for s in seg_row))
@@ -275,7 +296,7 @@ class MeshSearchExecutor:
             return put(h_doc), put(h_tfn)
 
         data_key = ("bm25", field, tuple(id(s) for s in seg_row), nnz, D)
-        d_doc, d_tfn = self._cached_data(data_key, build_postings)
+        d_doc, d_tfn = self._cached_data(data_key, build_postings, seg_row)
 
         h_live = np.zeros((self.S, D), bool)
         h_starts = np.zeros((self.S, Q, T), np.int32)
@@ -292,11 +313,12 @@ class MeshSearchExecutor:
 
         prog = _bm25_program(self.mesh, self._programs,
                              Q=Q, T=T, P=Pmax, D=D, k=min(k, D))
-        vals, shard, local, totals = prog(
+        vals, slot, local, totals = prog(
             d_doc, d_tfn, put(h_starts), put(h_lens), put(h_ws), put(h_live))
-        rnd = np.full_like(np.asarray(shard), round_no)
-        return (np.asarray(vals), np.asarray(shard), np.asarray(local),
-                rnd, np.asarray(totals))
+        slot = np.asarray(slot)
+        # slot index → originating shard + its segment ordinal (wrap-aware)
+        return (np.asarray(vals), lut_shard[slot], np.asarray(local),
+                lut_ord[slot], np.asarray(totals))
 
     # -- kNN ----------------------------------------------------------------
 
@@ -308,7 +330,12 @@ class MeshSearchExecutor:
 
         Q, dims = queries.shape
         merged = None
-        for rno, seg_row in enumerate(self._segment_rounds()):
+        for rno, row in enumerate(self._segment_rounds()):
+            seg_row = [e[2] if e is not None else None for e in row]
+            lut_shard = np.asarray(
+                [e[0] if e is not None else -1 for e in row], np.int32)
+            lut_ord = np.asarray(
+                [e[1] if e is not None else 0 for e in row], np.int32)
             D = pow2_bucket(max((s.max_docs if s is not None else 1)
                                 for s in seg_row))
             sh = NamedSharding(self.mesh, PS("shard"))
@@ -323,7 +350,7 @@ class MeshSearchExecutor:
                 return jax.device_put(h_vecs, sh)
 
             data_key = ("knn", field, tuple(id(s) for s in seg_row), D, dims)
-            d_vecs = self._cached_data(data_key, build_vecs)
+            d_vecs = self._cached_data(data_key, build_vecs, seg_row)
 
             h_live = np.zeros((self.S, D), bool)
             for si, seg in enumerate(seg_row):
@@ -335,11 +362,12 @@ class MeshSearchExecutor:
                     h_live[si, : lv.shape[0]] = lv & np.asarray(vc.exists)
             prog = _knn_program(self.mesh, self._programs, Q=Q, dims=dims,
                                 D=D, k=min(k, D), metric=metric)
-            vals, shard, local = prog(
+            vals, slot, local = prog(
                 jax.device_put(np.asarray(queries, np.float32)),
                 d_vecs, jax.device_put(h_live, sh))
-            out = (np.asarray(vals), np.asarray(shard), np.asarray(local),
-                   np.full_like(np.asarray(shard), rno), None)
+            slot = np.asarray(slot)
+            out = (np.asarray(vals), lut_shard[slot], np.asarray(local),
+                   lut_ord[slot], None)
             merged = out if merged is None else _merge_rounds(merged, out, k)
         return merged
 
@@ -381,8 +409,6 @@ def _chunk_table(seg, field, terms):
             if ln > 0:
                 runs.append((s, ln, inv.idf(term) * boost))
     starts, lens, ws, max_len = split_runs(runs)
-    if not runs:  # split_runs emits nothing for an empty run list
-        starts, lens, ws = [], [], []
     return starts, lens, ws, pow2_bucket(max_len)
 
 

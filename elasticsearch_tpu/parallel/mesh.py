@@ -51,7 +51,7 @@ def training_mesh(n_devices: Optional[int] = None, devices: Optional[Sequence] =
     from jax.sharding import Mesh
 
     devs = list(devices) if devices is not None else jax.devices()
-    n = n_devices if n_devices is not None else len(devs)
+    n = min(n_devices, len(devs)) if n_devices is not None else len(devs)
     devs = devs[:n]
     if tp is None:
         tp = 1
@@ -76,6 +76,11 @@ def get_shard_map():
             try:
                 return sm(f, mesh=mesh, in_specs=in_specs,
                           out_specs=out_specs, check_vma=check_rep)
+            except TypeError:
+                pass
+            try:  # older top-level signature spelled the flag check_rep
+                return sm(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_rep)
             except TypeError:
                 return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
         from jax.experimental.shard_map import shard_map as esm

@@ -145,6 +145,26 @@ def test_multi_segment_rounds(eight_devices):
     assert set(np.asarray(rnd[0][vals[0] > -np.inf]).tolist()) <= {0, 1}
 
 
+def test_shard_wrap_more_shards_than_devices(eight_devices):
+    """16 shards on an 8-slot mesh: round-robin wrap, results still address
+    the originating shard."""
+    mappings = Mappings({"properties": {"body": {"type": "text"}}})
+    reg = AnalysisRegistry()
+    docs = make_docs(160)
+    shards = [build_seg(docs[i::16], mappings, reg) for i in range(16)]
+    ex = MeshSearchExecutor(shard_mesh(8), shards)
+    vals, shard, local, seg_ord, totals = ex.search_terms(
+        "body", [[("w1", 1.0)]], k=20)
+    hits = vals[0] > -np.inf
+    assert hits.any()
+    assert shard[0][hits].max() >= 8  # wrapped shards are reachable
+    # every hit's score matches the originating shard's oracle
+    for j in np.nonzero(hits)[0]:
+        si, li = int(shard[0, j]), int(local[0, j])
+        sc = shard_local_oracle(docs[si::16], ["w1"], reg)
+        assert abs(sc[li] - vals[0, j]) < 1e-3
+
+
 def test_allocation_same_shard_decider():
     allocs = allocate("idx", n_shards=4, n_replicas=1, n_devices=8)
     assert len(allocs) == 8
