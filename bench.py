@@ -99,6 +99,44 @@ def chunk_tables(queries, offsets, idf):
     return starts, lens, ws, P, T
 
 
+def hybrid_tables(queries, offsets, idf, dense_rows, F):
+    """Per-query dense-row weight matrix qw[Q, F] + CSR tail chunk tables —
+    the product path's hybrid split (search/context.py hybrid_slices)."""
+    from elasticsearch_tpu.search.context import split_runs
+
+    Q = len(queries)
+    qw = np.zeros((Q, F), np.float32)
+    tabs = []
+    maxlen, maxT = 1, 1
+    for i, q in enumerate(queries):
+        runs = []
+        for t in q:
+            row = dense_rows[t]
+            if row >= 0:
+                qw[i, row] += idf[t]
+            else:
+                runs.append((int(offsets[t]), int(offsets[t + 1] - offsets[t]),
+                             float(idf[t])))
+        st, ln, ws, ml = split_runs(runs) if runs else ([], [], [], 1)
+        maxlen = max(maxlen, ml)
+        maxT = max(maxT, len(st))
+        tabs.append((st, ln, ws))
+    P = 1
+    while P < maxlen:
+        P *= 2
+    T = 1
+    while T < max(maxT, 1):
+        T *= 2
+    starts = np.zeros((Q, T), np.int32)
+    lens = np.zeros((Q, T), np.int32)
+    ws = np.zeros((Q, T), np.float32)
+    for i, (s, l, w) in enumerate(tabs):
+        starts[i, : len(s)] = s
+        lens[i, : len(l)] = l
+        ws[i, : len(w)] = w
+    return qw, starts, lens, ws, P, T
+
+
 def cpu_reference(u_doc, tfn, tabs, n_docs, k):
     """Vectorized numpy term-at-a-time BM25 + argpartition top-k."""
     starts, lens, ws = tabs
@@ -118,13 +156,17 @@ def cpu_reference(u_doc, tfn, tabs, n_docs, k):
     return time.perf_counter() - t0, out
 
 
-def tpu_path(u_doc, tfn, tabs, n_docs, k, qbatch):
+def tpu_path(u_doc, tfn, offsets, df, idf, queries, n_docs, k, qbatch):
+    """Hybrid dense/sparse scoring: frequent terms via ONE MXU matmul
+    (qw[Q,F] @ impact[F,D]), short tail via scatter — the product path's
+    layout (index/segment.py build_dense_impact + ops bm25_score_hybrid_batch).
+    """
     import jax
-    import jax.numpy as jnp
 
-    from elasticsearch_tpu.ops.scoring import bm25_score_batch, topk_batch
+    from elasticsearch_tpu.index.segment import build_dense_impact
+    from elasticsearch_tpu.ops.scoring import (
+        bm25_score_batch, bm25_score_hybrid_batch, topk_batch)
 
-    starts, lens, ws, P, T = tabs
     D = 1
     while D < n_docs:
         D *= 2
@@ -140,36 +182,63 @@ def tpu_path(u_doc, tfn, tabs, n_docs, k, qbatch):
     dev_tfn = jax.device_put(d_tfn)
     mask = jax.device_put(np.ones(D, bool))
 
-    def run_batch(s, l, w):
-        scores = bm25_score_batch(dev_doc, dev_tfn, s, l, w, P=P, D=D)
-        return topk_batch(scores, mask, k=k)
+    block = build_dense_impact(u_doc, tfn, offsets, df, D)
+    if block is not None:
+        dense_rows, impact_np = block
+        impact = jax.device_put(impact_np)
+        F = impact_np.shape[0]
+        log(f"dense block: F={F} rows ({impact_np.nbytes >> 20} MB)")
+        qw, starts, lens, ws, P, T = hybrid_tables(
+            queries, offsets, idf, dense_rows, F)
+        log(f"hybrid tail: T={T} P={P}")
 
-    nq = starts.shape[0]
-    # warmup / compile on first batch shape
-    sb = jax.device_put(starts[:qbatch])
-    lb = jax.device_put(lens[:qbatch])
-    wb = jax.device_put(ws[:qbatch])
-    v, i = run_batch(sb, lb, wb)
+        def run_batch(q, s, l, w):
+            scores = bm25_score_hybrid_batch(
+                impact, q, dev_doc, dev_tfn, s, l, w, P=P, D=D)
+            return topk_batch(scores, mask, k=k)
+    else:
+        qw = None
+        starts, lens, ws, P, T = chunk_tables(queries, offsets, idf)
+        log(f"chunk tables: T={T} P={P}")
+
+        def run_batch(q, s, l, w):
+            scores = bm25_score_batch(dev_doc, dev_tfn, s, l, w, P=P, D=D)
+            return topk_batch(scores, mask, k=k)
+
+    nq = len(queries)
+
+    def pad_rows(a):
+        """Pad Q to a qbatch multiple so every timed dispatch reuses the one
+        compiled [qbatch, ...] program."""
+        rem = (-a.shape[0]) % qbatch
+        if rem:
+            a = np.concatenate([a, np.zeros((rem,) + a.shape[1:], a.dtype)])
+        return a
+
+    starts, lens, ws = pad_rows(starts), pad_rows(lens), pad_rows(ws)
+    d_s = jax.device_put(starts)
+    d_l = jax.device_put(lens)
+    d_w = jax.device_put(ws)
+    d_q = jax.device_put(pad_rows(qw)) if qw is not None else None
+
+    def batches():
+        for q0 in range(0, starts.shape[0], qbatch):
+            sl = slice(q0, q0 + qbatch)
+            yield (d_q[sl] if d_q is not None else None,
+                   d_s[sl], d_l[sl], d_w[sl])
+
+    # warmup / compile
+    v, i = run_batch(*next(iter(batches())))
     v.block_until_ready()
-
-    def batch_slice(a, q0):
-        """Fixed [qbatch, T] slice; a short tail pads with zero rows so the
-        compiled shape never changes inside the timed loop."""
-        b = a[q0:q0 + qbatch]
-        if b.shape[0] < qbatch:
-            b = np.concatenate(
-                [b, np.zeros((qbatch - b.shape[0], b.shape[1]), b.dtype)])
-        return jax.device_put(b)
 
     out = []
     t0 = time.perf_counter()
-    for q0 in range(0, nq, qbatch):
-        v, idx = run_batch(batch_slice(starts, q0), batch_slice(lens, q0),
-                           batch_slice(ws, q0))
-        out.append(np.asarray(idx))
-    jax.block_until_ready(v)
+    for qb, sb, lb, wb in batches():
+        v, idx = run_batch(qb, sb, lb, wb)
+        out.append(idx)  # device array — no host sync inside the timed loop
+    jax.block_until_ready(out)
     dt = time.perf_counter() - t0
-    return dt, np.concatenate(out, axis=0)[:nq]
+    return dt, np.concatenate([np.asarray(o) for o in out], axis=0)[:nq]
 
 
 def knn_bench(n_vecs: int, dims: int, n_q: int, k: int, seed: int):
@@ -205,8 +274,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--docs", type=int, default=1 << 16)
     ap.add_argument("--vocab", type=int, default=30000)
-    ap.add_argument("--queries", type=int, default=256)
-    ap.add_argument("--qbatch", type=int, default=32)
+    ap.add_argument("--queries", type=int, default=2048)
+    ap.add_argument("--qbatch", type=int, default=256)
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--skip-knn", action="store_true")
@@ -222,11 +291,10 @@ def main():
     u_doc, tfn, offsets, df, idf = build_corpus(args.docs, args.vocab, args.seed)
     log(f"postings nnz: {u_doc.shape[0]}")
     queries = make_queries(args.queries, args.vocab, df, args.seed)
-    starts, lens, ws, P, T = chunk_tables(queries, offsets, idf)
-    log(f"chunk tables: T={T} P={P}")
 
-    tpu_dt, tpu_top = tpu_path(u_doc, tfn, (starts, lens, ws, P, T),
+    tpu_dt, tpu_top = tpu_path(u_doc, tfn, offsets, df, idf, queries,
                                args.docs, args.k, args.qbatch)
+    starts, lens, ws, P, T = chunk_tables(queries, offsets, idf)
     cpu_dt, cpu_top = cpu_reference(u_doc, tfn, (starts, lens, ws),
                                     args.docs, args.k)
 
@@ -242,7 +310,7 @@ def main():
 
     if not args.skip_knn:
         try:
-            t_tpu, t_cpu, recall = knn_bench(1 << 16, 128, 128, 10, args.seed)
+            t_tpu, t_cpu, recall = knn_bench(1 << 16, 128, 1024, 10, args.seed)
             log(f"knn 65536x128: tpu {t_tpu*1000:.1f} ms, cpu {t_cpu*1000:.1f} ms, "
                 f"recall@10 {recall:.3f}, speedup {t_cpu/t_tpu:.1f}x")
         except Exception as e:  # diagnostics only — never break the headline

@@ -29,6 +29,7 @@ segment.
 """
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field as dfield
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -69,6 +70,90 @@ def split_i64(v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return hi, lo
 
 
+class HbmBudget:
+    """Byte-budget circuit breaker for device-resident acceleration caches
+    (reference: org/elasticsearch/common/breaker/ — fielddata/request circuit
+    breakers). Dense impact blocks are an optimisation, so when the budget is
+    exhausted a field simply stays on the pure-scatter path instead of
+    erroring (unlike ES's breaker, which fails the request). Thread-safe:
+    searches run concurrently under the threading REST server."""
+
+    def __init__(self, total_bytes: int = 2 << 30):
+        self.total = total_bytes
+        self.used = 0
+        self._lock = threading.Lock()
+
+    def remaining(self) -> int:
+        with self._lock:
+            return max(0, self.total - self.used)
+
+    def reserve(self, n: int) -> bool:
+        with self._lock:
+            if self.used + n > self.total:
+                return False
+            self.used += n
+            return True
+
+    def release(self, n: int) -> None:
+        with self._lock:
+            self.used = max(0, self.used - n)
+
+
+# global budget shared by every segment's lazily-built dense blocks
+DENSE_IMPACT_BUDGET = HbmBudget()
+
+
+def build_dense_impact(
+    doc_ids_host: np.ndarray,
+    tfnorm_host: np.ndarray,
+    offsets: np.ndarray,
+    df: np.ndarray,
+    max_docs: int,
+    *,
+    df_threshold: Optional[int] = None,
+    budget_bytes: int = 1 << 30,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Dense impact block for frequent terms (hybrid dense/sparse scoring).
+
+    Terms whose postings run is long (df >= threshold) dominate scatter cost
+    on TPU; we densify exactly those into rows of an ``impact[F_pad, D]``
+    matrix so a query batch scores them with ONE MXU matmul
+    (``qw[Q, F] @ impact[F, D]``), while the short tail stays CSR (cheap
+    scatter). This is the BM25S eager-impact idea restructured for the MXU.
+
+    Returns (dense_rows int32[V] with -1 for sparse terms, impact f32[F_pad, D])
+    or None when no term qualifies.
+    """
+    V = df.shape[0]
+    if V == 0:
+        return None
+    if df_threshold is None:
+        # empirical sweet spot on TPU v5e: densify runs longer than D/256
+        # (tail scatter windows stay <=256 wide; F stays within budget)
+        df_threshold = max(128, max_docs // 256)
+    cand = np.nonzero(df >= df_threshold)[0]
+    if cand.size == 0:
+        return None
+    # cap by HBM budget on the PADDED row count (F_pad x D x 4 is what gets
+    # allocated): round the cap down to a power of two, keep the highest-df
+    # terms (longest runs = biggest win)
+    max_rows = int(budget_bytes // (4 * max_docs))
+    if max_rows < 8:  # F_pad minimum is 8
+        return None
+    max_rows = 1 << (max_rows.bit_length() - 1)
+    if cand.size > max_rows:
+        cand = cand[np.argsort(-df[cand], kind="stable")[:max_rows]]
+        cand.sort()
+    F_pad = pow2_bucket(cand.size, minimum=8)
+    dense_rows = np.full(V, -1, dtype=np.int32)
+    dense_rows[cand] = np.arange(cand.size, dtype=np.int32)
+    impact = np.zeros((F_pad, max_docs), dtype=np.float32)
+    for row, tid in enumerate(cand):
+        s, e = int(offsets[tid]), int(offsets[tid + 1])
+        impact[row, doc_ids_host[s:e]] = tfnorm_host[s:e]
+    return dense_rows, impact
+
+
 @dataclass
 class InvertedField:
     """Frozen inverted index for one field (text or keyword)."""
@@ -93,10 +178,70 @@ class InvertedField:
     positions: Optional[np.ndarray] = None  # int32[total_positions]
     # host mirror of unpadded doc_ids (phrase verification, merges)
     doc_ids_host: Optional[np.ndarray] = None
+    # host mirror of tfnorm (dense-impact build, merges)
+    tfnorm_host: Optional[np.ndarray] = None
     # lazy cache: sorted terms for prefix/wildcard expansion
     _sorted_terms: Any = None
     # device positional CSR (padded) — built lazily for phrase programs
     _pos_dev: Any = None
+    # lazy hybrid dense-impact block: False = checked & permanently absent
+    # (no qualifying terms); (dense_rows np.i32[V], impact dev f32[F_pad, D])
+    # when present; None = not built yet (incl. transient budget denial)
+    _dense: Any = None
+    _dense_bytes: int = 0
+    _dense_lock: Any = dfield(default_factory=threading.Lock)
+    max_docs: int = 0
+
+    def dense_block(self):
+        """Lazy (dense_rows, device impact) for hybrid scoring, or None.
+
+        Frequent terms (long postings runs) score via one MXU matmul instead
+        of scatter-adds; see build_dense_impact. Built on first search that
+        touches this field; small segments have no qualifying terms and pay
+        nothing. Charged against the global DENSE_IMPACT_BUDGET circuit
+        breaker — when HBM is tight the field stays on the scatter path and
+        retries once budget frees up (only 'no qualifying terms' is cached
+        as a permanent no).
+        """
+        d = self._dense
+        if d is False:
+            return None
+        if d is not None:
+            return d
+        with self._dense_lock:
+            if self._dense is False:
+                return None
+            if self._dense is not None:
+                return self._dense
+            if self.doc_ids_host is None or not self.max_docs:
+                self._dense = False
+                return None
+            # budget check BEFORE the (expensive) host-side build; a denial
+            # is transient — leave _dense = None so a later query retries
+            min_bytes = 8 * 4 * self.max_docs
+            granted = min(1 << 30, DENSE_IMPACT_BUDGET.remaining())
+            if granted < min_bytes:
+                return None
+            tfn = self.tfnorm_host
+            if tfn is None:
+                tfn = np.ones(self.nnz, dtype=np.float32)
+            built = build_dense_impact(
+                self.doc_ids_host, tfn, self.offsets, self.df, self.max_docs,
+                budget_bytes=granted,
+            )
+            if built is None:
+                self._dense = False  # no qualifying terms: permanent
+                return None
+            rows, impact = built
+            if not DENSE_IMPACT_BUDGET.reserve(impact.nbytes):
+                return None  # lost a race for the budget: retry later
+            self._dense_bytes = impact.nbytes
+            self._dense = (rows, _device_put(impact))
+            return self._dense
+
+    def __del__(self):
+        if getattr(self, "_dense_bytes", 0):
+            DENSE_IMPACT_BUDGET.release(self._dense_bytes)
 
     @property
     def vocab_size(self) -> int:
@@ -421,6 +566,8 @@ class SegmentBuilder:
             pos_offsets=pos_offsets,
             positions=np.array(positions_flat, dtype=np.int32),
             doc_ids_host=doc_ids,
+            tfnorm_host=tfnorm.astype(np.float32),
+            max_docs=max_docs,
         )
 
     def _build_keyword(self, fname: str, n: int, max_docs: int):
@@ -489,6 +636,8 @@ class SegmentBuilder:
             total_terms=nnz,
             avg_len=1.0,
             doc_ids_host=doc_ids,
+            tfnorm_host=ones,
+            max_docs=max_docs,
         )
         kwcol = KeywordColumn(
             name=fname,

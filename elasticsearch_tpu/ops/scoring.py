@@ -81,6 +81,57 @@ def bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int):
     return jax.vmap(lambda s, l, w: f(doc_ids, tfnorm, s, l, w))(starts, lens, weights)
 
 
+# ---------------------------------------------------------------------------
+# hybrid dense/sparse scoring (frequent terms on the MXU, tail via scatter)
+#
+# Each hybrid op = one dense contribution (a matmul against the segment's
+# impact[F, D] block, see index.segment.build_dense_impact) composed with the
+# corresponding pure-scatter kernel for the short CSR tail. The scatter logic
+# lives ONLY in the base kernels; hybrids never re-implement it.
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def bm25_score_hybrid(
+    dense_impact, qw, doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int
+):
+    """Single-query hybrid BM25: qw f32[F] (idf*boost per dense term) scores
+    frequent terms via one matvec; starts/lens/weights i32/f32[T] are the
+    short-run tail. Returns f32[D]."""
+    dense = jnp.dot(qw, dense_impact, precision=lax.Precision.HIGHEST)
+    return dense + bm25_score_segment(doc_ids, tfnorm, starts, lens, weights, P=P, D=D)
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def bm25_score_hybrid_batch(
+    dense_impact, qw, doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int
+):
+    """Batched hybrid BM25: ONE MXU matmul ``qw[Q, F] @ impact[F, D]`` for
+    frequent terms (replacing what would be millions of scatter-adds for long
+    postings runs) + the scatter kernel on the [Q, T] tail. Returns f32[Q, D]."""
+    dense = jnp.dot(qw, dense_impact, precision=lax.Precision.HIGHEST)
+    return dense + bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, P=P, D=D)
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def match_count_hybrid(dense_impact, qind, doc_ids, starts, lens, *, P: int, D: int):
+    """Matched-term count: qind f32[F] is the 1.0 indicator of dense query
+    terms; dense count = qind @ (impact != 0). Only conjunctive queries
+    (operator:and / minimum_should_match) pay for this second pass over the
+    impact block — disjunctions derive their mask from scores directly."""
+    present = (dense_impact != 0).astype(jnp.float32)
+    dcount = jnp.dot(qind, present, precision=lax.Precision.HIGHEST)
+    tail = match_count_segment(doc_ids, starts, lens, P=P, D=D)
+    return jnp.rint(dcount).astype(jnp.int32) + tail
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def term_mask_hybrid(dense_impact, qind, doc_ids, starts, lens, *, P: int, D: int):
+    """bool[D] any-of mask across dense rows (qind indicator) + CSR tail."""
+    present = (dense_impact != 0).astype(jnp.float32)
+    dmask = jnp.dot(qind, present, precision=lax.Precision.DEFAULT) > 0
+    return dmask | term_mask(doc_ids, starts, lens, P=P, D=D)
+
+
 @partial(jax.jit, static_argnames=("P", "D"))
 def match_count_segment(doc_ids, starts, lens, *, P: int, D: int):
     """Count of matching query *terms* per doc. Each doc id occurs at most

@@ -61,6 +61,14 @@ class RestController:
                     return e.status, _error_body(e)
                 except json.JSONDecodeError as e:
                     return 400, {"error": {"type": "parse_exception", "reason": str(e)}, "status": 400}
+                except Exception as e:  # noqa: BLE001 — a handler bug must
+                    # surface as an ES-style 500 envelope, never a dropped
+                    # connection (mirrors ES catching Throwable per request)
+                    return 500, {
+                        "error": {"type": "internal_server_error",
+                                  "reason": f"{type(e).__name__}: {e}"},
+                        "status": 500,
+                    }
         return 400, {
             "error": {"type": "illegal_argument_exception",
                       "reason": f"no handler found for uri [{path}] and method [{method}]"},

@@ -120,3 +120,57 @@ class SegmentContext:
             P,
             n_present,
         )
+
+    def hybrid_slices(self, inv: InvertedField, terms, weights):
+        """Split query terms between the dense impact block and the CSR tail.
+
+        Returns None when the field has no dense block OR no query term maps
+        to a dense row (the caller uses the pure scatter path — paying an
+        [F, D] matmul of zeros for an all-rare-term query would be far slower
+        than scattering its short runs). Else returns (impact, qw f32[F],
+        qind f32[F], starts, lens, ws, P, n_present): frequent terms fold
+        idf*boost into ``qw`` rows (scored by one matmul against
+        impact[F, D]); the rest become short (start, len) chunks. ``qind`` is
+        the 1.0 indicator of dense query terms, used for match counts/masks.
+        """
+        block = inv.dense_block()
+        if block is None:
+            return None
+        dense_rows, impact = block
+        F = impact.shape[0]
+        qw = np.zeros(F, np.float32)
+        qind = np.zeros(F, np.float32)
+        runs = []
+        n_present = 0
+        any_dense = False
+        for term, w in zip(terms, weights):
+            tid = inv.term_id(term)
+            if tid < 0:
+                continue
+            n_present += 1
+            row = int(dense_rows[tid])
+            if row >= 0:
+                qw[row] += w
+                qind[row] = 1.0
+                any_dense = True
+            else:
+                runs.append((int(inv.offsets[tid]),
+                             int(inv.offsets[tid + 1] - inv.offsets[tid]), w))
+        if not any_dense:
+            return None
+        starts, lens, ws, max_len = split_runs(runs) if runs else ([], [], [], 1)
+        P = pow2_bucket(max_len)
+        Tb = pow2_bucket(max(len(starts), 1), minimum=1)
+        starts += [0] * (Tb - len(starts))
+        lens += [0] * (Tb - len(lens))
+        ws += [0.0] * (Tb - len(ws))
+        return (
+            impact,
+            qw,
+            qind,
+            np.asarray(starts, np.int32),
+            np.asarray(lens, np.int32),
+            np.asarray(ws, np.float32),
+            P,
+            n_present,
+        )

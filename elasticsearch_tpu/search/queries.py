@@ -31,11 +31,14 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from elasticsearch_tpu.ops.scoring import (
+    bm25_score_hybrid,
     bm25_score_segment,
+    match_count_hybrid,
     match_count_segment,
     range_mask_f32,
     range_mask_i64pair,
     term_mask,
+    term_mask_hybrid,
 )
 from elasticsearch_tpu.ops.knn import knn_scores
 from elasticsearch_tpu.search.context import SegmentContext
@@ -76,18 +79,58 @@ def _empty(ctx: SegmentContext) -> ExecResult:
     return None, jnp.zeros(ctx.D, dtype=bool)
 
 
-def _score_term_group(ctx, field, terms, boost=1.0) -> Tuple[Any, Any, int]:
-    """(scores, count i32[D], n_present) for a group of terms on one field."""
+def _dedupe_terms(terms, boost, idf_fn):
+    """Merge duplicate query terms by summing their weights (BM25 scores a
+    repeated query term additively, so 'w + w' == scoring it twice), so the
+    count/mask paths see each distinct term exactly once."""
+    merged: Dict[str, float] = {}
+    for t in terms:
+        w = idf_fn(t) * boost
+        merged[t] = merged.get(t, 0.0) + w
+    return list(merged.keys()), list(merged.values())
+
+
+def _score_term_group(ctx, field, terms, boost=1.0, with_counts=False) -> Tuple[Any, Any, int]:
+    """(scores f32[D], matched, n_present) for a group of terms on one field.
+
+    ``matched`` is i32[D] distinct-matched-term counts when with_counts=True
+    (conjunctions: operator:and / minimum_should_match), else a bool[D] mask.
+    Disjunctions take the mask form because it is usually free: with all-
+    positive weights, scores > 0 IS the match mask — no extra pass over the
+    postings or the dense impact block.
+    """
     jnp = _jnp()
     inv = ctx.inv(field)
     if inv is None or not terms:
         z = jnp.zeros(ctx.D, dtype=jnp.float32)
-        return z, jnp.zeros(ctx.D, dtype=jnp.int32), 0
-    weights = [ctx.idf(field, t) * boost for t in terms]
+        matched = (jnp.zeros(ctx.D, dtype=jnp.int32) if with_counts
+                   else jnp.zeros(ctx.D, dtype=bool))
+        return z, matched, 0
+    terms, weights = _dedupe_terms(terms, boost, lambda t: ctx.idf(field, t))
+    all_positive = all(w > 0 for w in weights)
+    hyb = ctx.hybrid_slices(inv, terms, weights)
+    if hyb is not None:
+        impact, qw, qind, starts, lens, ws, P, n_present = hyb
+        scores = bm25_score_hybrid(
+            impact, qw, inv.doc_ids, inv.tfnorm, starts, lens, ws, P=P, D=ctx.D)
+        if with_counts:
+            matched = match_count_hybrid(
+                impact, qind, inv.doc_ids, starts, lens, P=P, D=ctx.D)
+        elif all_positive:
+            matched = scores > 0
+        else:
+            matched = term_mask_hybrid(
+                impact, qind, inv.doc_ids, starts, lens, P=P, D=ctx.D)
+        return scores, matched, n_present
     starts, lens, ws, P, n_present = ctx.chunked_slices(inv, terms, weights)
     scores = bm25_score_segment(inv.doc_ids, inv.tfnorm, starts, lens, ws, P=P, D=ctx.D)
-    counts = match_count_segment(inv.doc_ids, starts, lens, P=P, D=ctx.D)
-    return scores, counts, n_present
+    if with_counts:
+        matched = match_count_segment(inv.doc_ids, starts, lens, P=P, D=ctx.D)
+    elif all_positive:
+        matched = scores > 0
+    else:
+        matched = term_mask(inv.doc_ids, starts, lens, P=P, D=ctx.D)
+    return scores, matched, n_present
 
 
 def _terms_filter_mask(ctx, field, terms):
@@ -95,6 +138,13 @@ def _terms_filter_mask(ctx, field, terms):
     inv = ctx.inv(field)
     if inv is None or not terms:
         return jnp.zeros(ctx.D, dtype=bool)
+    terms = list(dict.fromkeys(terms))  # dedupe, order-preserving
+    hyb = ctx.hybrid_slices(inv, terms, [1.0] * len(terms))
+    if hyb is not None:
+        impact, _, qind, starts, lens, _, P, n_present = hyb
+        if n_present == 0:
+            return jnp.zeros(ctx.D, dtype=bool)
+        return term_mask_hybrid(impact, qind, inv.doc_ids, starts, lens, P=P, D=ctx.D)
     starts, lens, _, P, n_present = ctx.chunked_slices(inv, terms, [1.0] * len(terms))
     if n_present == 0:
         return jnp.zeros(ctx.D, dtype=bool)
@@ -215,10 +265,10 @@ class TermQuery(Query):
             # term query on a numeric field = exact-value range
             return RangeQuery(self.field, gte=self.value, lte=self.value, boost=self.boost).execute(ctx)
         term = self._term_str(ctx)
-        scores, counts, n = _score_term_group(ctx, self.field, [term], self.boost)
+        scores, matched, n = _score_term_group(ctx, self.field, [term], self.boost)
         if n == 0:
             return _empty(ctx)
-        return scores, counts > 0
+        return scores, matched
 
 
 class TermsQuery(Query):
@@ -286,21 +336,29 @@ class MatchQuery(Query):
             scores, _, _ = _score_term_group(ctx, self.field, flat, self.boost)
             group_count = jnp.zeros(ctx.D, dtype=jnp.int32)
             for g in groups:
-                _, gcounts, _ = _score_term_group(ctx, self.field, g, 1.0)
-                group_count = group_count + (gcounts > 0).astype(jnp.int32)
+                _, gmask, _ = _score_term_group(ctx, self.field, g, 1.0)
+                group_count = group_count + gmask.astype(jnp.int32)
             counts = group_count
             n_terms = len(groups)
+            need_counts = True
         else:
-            scores, counts, n_present = _score_term_group(ctx, self.field, terms, self.boost)
+            # conjunctions need distinct-matched-term counts; a plain OR only
+            # needs the match mask (free: scores > 0)
+            need_counts = self.operator == "and" or self.msm is not None
+            scores, matched, n_present = _score_term_group(
+                ctx, self.field, terms, self.boost, with_counts=need_counts)
+            counts = matched
             n_terms = len(set(terms))
         if self.operator == "and":
             # absent terms can never match: all-term conjunction (ES sem.)
             mask = counts >= n_terms
-        else:
+        elif need_counts:
             need = _min_should_match(self.msm, n_terms) if self.msm is not None else 1
             # do NOT cap at terms-present-in-segment: an absent term is an
             # optional clause that can never match (Lucene msm semantics)
             mask = counts >= max(need, 1)
+        else:
+            mask = counts  # already a bool match mask
         return scores, mask
 
 
@@ -370,7 +428,8 @@ class MatchPhraseQuery(Query):
         for t in terms:
             if t not in inv.vocab:
                 return _empty(ctx)
-        scores, counts, n_present = _score_term_group(ctx, self.field, terms, self.boost)
+        scores, counts, n_present = _score_term_group(
+            ctx, self.field, terms, self.boost, with_counts=True)
         cand = np.nonzero(np.asarray(counts) >= len(set(terms)))[0]
         if cand.size == 0:
             return _empty(ctx)
@@ -638,8 +697,8 @@ class FuzzyQuery(Query):
         terms = [c for c in inv.terms if _edit_distance_le(t, c, k)][: self.max_expansions]
         if not terms:
             return _empty(ctx)
-        scores, counts, n = _score_term_group(ctx, self.field, terms, self.boost)
-        return scores, counts > 0
+        scores, matched, n = _score_term_group(ctx, self.field, terms, self.boost)
+        return scores, matched
 
 
 class KnnQuery(Query):
@@ -662,6 +721,10 @@ class KnnQuery(Query):
         vc = ctx.segment.vectors.get(self.field)
         if vc is None:
             return _empty(ctx)
+        if len(self.vector) != vc.dims:
+            raise QueryParsingException(
+                f"knn query vector has {len(self.vector)} dims but field "
+                f"[{self.field}] is mapped with {vc.dims}")
         q = jnp.asarray(np.asarray(self.vector, np.float32)[None, :])
         scores = knn_scores(q, vc.vecs, metric=vc.similarity)[0] * self.boost
         mask = vc.exists
@@ -929,9 +992,9 @@ class MoreLikeThisQuery(Query):
             sel = [t for _, t in scored[: self.max_query_terms]]
             if not sel:
                 continue
-            s, counts, _ = _score_term_group(ctx, field, sel, self.boost)
+            s, matched, _ = _score_term_group(ctx, field, sel, self.boost)
             out_s = out_s + s
-            out_m = out_m | (counts > 0)
+            out_m = out_m | matched
         return out_s, out_m
 
 

@@ -247,3 +247,28 @@ def test_boosting_query(ctx):
     s, m = run(ctx, dsl)
     assert m.sum() == 4  # docs containing "quick"
     assert s[2] < s[0]
+
+
+def test_match_operator_and_duplicate_query_terms():
+    """Duplicated query terms must be merged (weight-summed), so a doc
+    containing only the duplicated term does NOT satisfy operator:and for a
+    two-distinct-term query — regardless of hybrid vs scatter path."""
+    m = Mappings({"properties": {"body": {"type": "text"}}})
+    reg = AnalysisRegistry()
+    parser = DocumentParser(m, reg)
+    b = SegmentBuilder(m)
+    for i, d in enumerate([
+        {"body": "the the the end"},       # only "the"
+        {"body": "the cat sat"},           # both terms
+        {"body": "cat nap"},               # only "cat"
+    ]):
+        b.add(parser.parse(str(i), d))
+    c = SegmentContext(b.freeze(), m, reg)
+    q = parse_query({"match": {"body": {"query": "the the cat", "operator": "and"}}})
+    scores, mask = q.execute(c)
+    assert np.nonzero(np.asarray(mask)[:3])[0].tolist() == [1]
+    # disjunction over duplicates: all three docs match, scores unchanged by
+    # the dedupe (weight-summed)
+    q2 = parse_query({"match": {"body": "the the cat"}})
+    s2, m2 = q2.execute(c)
+    assert np.nonzero(np.asarray(m2)[:3])[0].tolist() == [0, 1, 2]

@@ -217,3 +217,110 @@ def test_knn_l2_and_dot():
     np.testing.assert_allclose(s, 1 / (1 + d2), rtol=2e-3, atol=1e-4)
     sd = np.asarray(knn_scores(q, vecs, metric="dot_product", use_bf16=False))
     np.testing.assert_allclose(sd, (1 + q @ vecs.T) / 2, rtol=1e-4)
+
+
+def test_hybrid_dense_sparse_matches_pure_scatter():
+    """Hybrid (dense matmul + scatter tail) == pure scatter == numpy oracle
+    on a synthetic corpus large enough to produce dense rows."""
+    from elasticsearch_tpu.index.segment import build_dense_impact
+    from elasticsearch_tpu.ops.scoring import (
+        bm25_score_hybrid,
+        bm25_score_hybrid_batch,
+        bm25_score_segment,
+        match_count_hybrid,
+        term_mask,
+        term_mask_hybrid,
+    )
+
+    rng = np.random.default_rng(7)
+    n_docs, vocab = 512, 64
+    D = pow2_bucket(n_docs)
+    # zipf-ish postings: term t appears in ~n_docs/(t+1) docs
+    doc_lists = [
+        np.sort(rng.choice(n_docs, size=max(1, n_docs // (t + 1)), replace=False))
+        for t in range(vocab)
+    ]
+    df = np.array([len(d) for d in doc_lists], np.int32)
+    offsets = np.zeros(vocab + 1, np.int64)
+    offsets[1:] = np.cumsum(df)
+    nnz = int(df.sum())
+    u_doc = np.concatenate(doc_lists).astype(np.int32)
+    tfn = rng.random(nnz).astype(np.float32) + 0.5
+
+    block = build_dense_impact(u_doc, tfn, offsets, df, D, df_threshold=64)
+    assert block is not None
+    dense_rows, impact = block
+    assert (dense_rows >= 0).sum() > 0 and (dense_rows < 0).sum() > 0
+
+    nnz_pad = pow2_bucket(nnz)
+    d_doc = np.full(nnz_pad, D, np.int32)
+    d_doc[:nnz] = u_doc
+    d_tfn = np.zeros(nnz_pad, np.float32)
+    d_tfn[:nnz] = tfn
+
+    qterms = [0, 1, 40, 63]  # mix of dense (frequent) + sparse (rare) terms
+    weights = [1.5, 0.7, 2.0, 1.1]
+    F = impact.shape[0]
+    qw = np.zeros(F, np.float32)
+    qind = np.zeros(F, np.float32)
+    runs = []
+    for t, w in zip(qterms, weights):
+        row = int(dense_rows[t])
+        if row >= 0:
+            qw[row] += w
+            qind[row] = 1.0
+        else:
+            runs.append((int(offsets[t]), int(df[t]), w))
+    P = pow2_bucket(max((ln for _, ln, _ in runs), default=1))
+    T = pow2_bucket(max(len(runs), 1))
+    starts = np.zeros(T, np.int32)
+    lens = np.zeros(T, np.int32)
+    ws = np.zeros(T, np.float32)
+    for i, (s, ln, w) in enumerate(runs):
+        starts[i], lens[i], ws[i] = s, ln, w
+
+    # oracle
+    want = np.zeros(D, np.float32)
+    for t, w in zip(qterms, weights):
+        s, e = int(offsets[t]), int(offsets[t + 1])
+        want[u_doc[s:e]] += w * tfn[s:e]
+
+    got_h = bm25_score_hybrid(
+        impact, qw, d_doc, d_tfn, starts, lens, ws, P=P, D=D)
+    counts = match_count_hybrid(impact, qind, d_doc, starts, lens, P=P, D=D)
+    np.testing.assert_allclose(np.asarray(got_h), want, rtol=1e-5, atol=1e-5)
+
+    got_b = bm25_score_hybrid_batch(
+        impact, qw[None], d_doc, d_tfn, starts[None], lens[None], ws[None], P=P, D=D)
+    np.testing.assert_allclose(np.asarray(got_b)[0], want, rtol=1e-5, atol=1e-5)
+
+    # pure scatter path on the same query (all terms as runs)
+    all_runs = [(int(offsets[t]), int(df[t]), w) for t, w in zip(qterms, weights)]
+    P2 = pow2_bucket(max(ln for _, ln, _ in all_runs))
+    st2 = np.array([r[0] for r in all_runs], np.int32)
+    ln2 = np.array([r[1] for r in all_runs], np.int32)
+    ws2 = np.array([r[2] for r in all_runs], np.float32)
+    got_s = bm25_score_segment(d_doc, d_tfn, st2, ln2, ws2, P=P2, D=D)
+    np.testing.assert_allclose(np.asarray(got_s), want, rtol=1e-5, atol=1e-5)
+
+    # matched-term counts
+    want_counts = np.zeros(D, np.int64)
+    for t in qterms:
+        s, e = int(offsets[t]), int(offsets[t + 1])
+        want_counts[u_doc[s:e]] += 1
+    np.testing.assert_array_equal(np.asarray(counts), want_counts)
+
+    # any-of mask
+    got_m = term_mask_hybrid(impact, qind, d_doc, starts, lens, P=P, D=D)
+    np.testing.assert_array_equal(np.asarray(got_m), want_counts > 0)
+    got_m2 = term_mask(d_doc, st2, ln2, P=P2, D=D)
+    np.testing.assert_array_equal(np.asarray(got_m2), want_counts > 0)
+
+
+def test_segment_dense_block_lazy():
+    """Small segments have no qualifying terms -> dense_block() is None and
+    cached as absent; query path falls back to pure scatter."""
+    seg, _ = build_segment()
+    inv = seg.inverted["body"]
+    assert inv.dense_block() is None
+    assert inv._dense is False
