@@ -62,6 +62,10 @@ class DocumentParser:
                 self._index_value(fm, value, parsed)
                 continue
             if isinstance(value, list) and value and isinstance(value[0], dict):
+                fm = self.mappings.get(full)
+                if fm is not None and fm.type == "completion":
+                    self._index_value(fm, value, parsed)
+                    continue
                 # array of objects: flatten each (nested semantics refined in R2)
                 for item in value:
                     self._walk(item, f"{full}.", parsed)
@@ -81,6 +85,12 @@ class DocumentParser:
 
     def _index_value(self, fm: FieldMapping, value: Any, parsed: ParsedDocument):
         values = value if isinstance(value, list) and not fm.is_vector else [value]
+        if fm.type == "completion":
+            # completion entries ({input, output, weight, payload} or plain
+            # strings) are kept verbatim on host; the suggester builds its
+            # per-segment sorted prefix array from them (search/suggest.py)
+            parsed.stored.setdefault(fm.name, []).extend(values)
+            return
         if fm.store:
             parsed.stored.setdefault(fm.name, []).extend(values)
         if fm.is_vector:

@@ -148,10 +148,20 @@ class IndexService:
     def search(self, body: dict, dfs: bool = False) -> dict:
         body = body or {}
         global_stats = self.global_stats(body) if dfs else None
-        return search_shards(
+        resp = search_shards(
             [s.searcher for s in self.shards], body, index_name=self.name,
             global_stats=global_stats,
         )
+        if body.get("suggest"):
+            resp["suggest"] = self.suggest(body["suggest"])
+        return resp
+
+    def suggest(self, body: dict) -> dict:
+        """Standalone suggest (reference: action/suggest/TransportSuggestAction
+        + search-embedded SuggestPhase)."""
+        from elasticsearch_tpu.search.suggest import execute_suggest
+
+        return execute_suggest(self.shards, body or {}, self.analysis)
 
     def count(self, body: dict) -> dict:
         total = sum(s.searcher.count(body or {}) for s in self.shards)

@@ -191,6 +191,10 @@ def _register_all(rc: RestController):
     add("GET", "/{index}/_field_stats", _field_stats)
     add("POST", "/{index}/_field_stats", _field_stats)
     add("GET", "/{index}/_termvectors/{id}", _termvectors)
+    add("POST", "/_suggest", _suggest_all)
+    add("GET", "/_suggest", _suggest_all)
+    add("POST", "/{index}/_suggest", _suggest)
+    add("GET", "/{index}/_suggest", _suggest)
 
     # ES 2.0 typed forms /{index}/{type}/{id} — registered LAST so every
     # /_-prefixed sub-resource above wins the route (RestController does the
@@ -607,6 +611,26 @@ def _explain(n: Node, p, b, index: str, id: str):
                 },
             }
     return 404, {"_index": index, "_id": id, "matched": False}
+
+
+def _suggest(n: Node, p, b, index: str):
+    svc = n.get_index(index)
+    res = svc.suggest(_json(b))
+    res["_shards"] = {"total": svc.num_shards, "successful": svc.num_shards, "failed": 0}
+    return 200, res
+
+
+def _suggest_all(n: Node, p, b):
+    """Reference: RestSuggestAction with no index = all indices; each index
+    runs under its own analysis registry, merged per entry."""
+    from elasticsearch_tpu.search.suggest import execute_suggest_multi
+
+    body = _json(b)
+    groups = [(svc.shards, svc.analysis) for svc in n.indices.values()]
+    res = execute_suggest_multi(groups, body)
+    total = sum(len(svc.shards) for svc in n.indices.values())
+    res["_shards"] = {"total": total, "successful": total, "failed": 0}
+    return 200, res
 
 
 def _field_stats(n: Node, p, b, index: str):

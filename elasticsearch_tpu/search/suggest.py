@@ -1,0 +1,534 @@
+"""Suggesters: term, phrase, completion.
+
+Reference: org/elasticsearch/search/suggest/ — SuggestPhase.java dispatches
+to TermSuggester.java (Lucene DirectSpellChecker edit-distance candidates),
+phrase/PhraseSuggester.java (candidate generation + n-gram language-model
+re-ranking with stupid-backoff / laplace smoothing), and
+completion/CompletionSuggester.java (in-memory FST prefix lookup built at
+index time by Completion090PostingsFormat).
+
+TPU-native reshape: candidate generation is a *batched* Levenshtein DP —
+the whole segment vocabulary is packed into one padded uint8 matrix and the
+DP advances one query character per step across every candidate term at
+once (vectorized numpy on host; vocab-sized, not doc-sized, so it never
+touches the postings). The phrase LM is built once per segment from the
+positional CSR (the same positions that power match_phrase) and cached.
+Completion entries are kept as a sorted array + binary-searched prefix
+ranges — the array-backed equivalent of Lucene's FST, and like the
+reference it is rebuilt per frozen segment, never mutated.
+"""
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+
+# ---------------------------------------------------------------------------
+# batched edit distance
+# ---------------------------------------------------------------------------
+
+def pack_terms(terms: List[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack unicode terms into a padded uint32-codepoint matrix [N, Lmax]."""
+    n = len(terms)
+    if n == 0:
+        return np.zeros((0, 1), dtype=np.uint32), np.zeros(0, dtype=np.int32)
+    lens = np.array([len(t) for t in terms], dtype=np.int32)
+    L = max(1, int(lens.max()))
+    mat = np.zeros((n, L), dtype=np.uint32)
+    for i, t in enumerate(terms):
+        codes = np.frombuffer(t.encode("utf-32-le"), dtype=np.uint32)
+        mat[i, : len(codes)] = codes
+    return mat, lens
+
+
+def batched_edit_distance(query: str, mat: np.ndarray, lens: np.ndarray,
+                          max_dist: int = 2) -> np.ndarray:
+    """Levenshtein distance from ``query`` to every packed term at once.
+
+    One DP where the row dimension is vectorized over ALL candidate terms:
+    prev/curr are [N, L+1] matrices and we scan the query characters with a
+    cumulative-min pass for the insertion channel. Distances are exact
+    (early rows are not banded; vocab DP cost is negligible vs scoring).
+    """
+    n, L = mat.shape
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
+    q = np.frombuffer(query.encode("utf-32-le"), dtype=np.uint32)
+    prev = np.broadcast_to(np.arange(L + 1, dtype=np.int32), (n, L + 1)).copy()
+    for i, qc in enumerate(q, start=1):
+        sub = prev[:, :-1] + (mat != qc)  # substitution / match
+        dele = prev[:, 1:] + 1  # deletion (skip a query char)
+        curr = np.empty_like(prev)
+        curr[:, 0] = i
+        curr[:, 1:] = np.minimum(sub, dele)
+        # insertion channel: carry minima left→right (cummin of curr[:,j-1]+1)
+        np.minimum.accumulate(
+            curr + np.arange(L, -1, -1, dtype=np.int32), axis=1, out=curr)
+        curr -= np.arange(L, -1, -1, dtype=np.int32)
+        prev = curr
+    return prev[np.arange(n), lens].astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# vocabulary stats gathered across shards/segments
+# ---------------------------------------------------------------------------
+
+class FieldVocab:
+    """Merged (term → df, cf) view of one field across every live segment."""
+
+    def __init__(self, field: str):
+        self.field = field
+        self.df: Dict[str, int] = {}
+        self.cf: Dict[str, int] = {}
+        self.total_terms = 0
+        self.num_docs = 0
+
+    def add_segment(self, inv) -> None:
+        for term, tid in inv.vocab.items():
+            self.df[term] = self.df.get(term, 0) + int(inv.df[tid])
+            self.cf[term] = self.cf.get(term, 0) + int(inv.cf[tid])
+        self.total_terms += inv.total_terms
+        self.num_docs += inv.num_docs
+
+    _packed: Optional[Tuple[List[str], np.ndarray, np.ndarray]] = None
+
+    def packed(self):
+        if self._packed is None:
+            terms = list(self.df.keys())
+            mat, lens = pack_terms(terms)
+            self._packed = (terms, mat, lens)
+        return self._packed
+
+
+_VOCAB_CACHE: "OrderedDict[Tuple, FieldVocab]" = None  # type: ignore[assignment]
+
+
+def field_vocab(shards, field: str) -> FieldVocab:
+    """Merged vocab, cached by (field, exact segment-id set) — segments are
+    immutable, so the merge is valid until the segment set changes (refresh,
+    merge); a tiny LRU bounds memory."""
+    global _VOCAB_CACHE
+    if _VOCAB_CACHE is None:
+        from collections import OrderedDict
+
+        _VOCAB_CACHE = OrderedDict()
+    key = (field, tuple(seg.seg_id for sh in shards for seg in sh.segments))
+    fv = _VOCAB_CACHE.get(key)
+    if fv is not None:
+        _VOCAB_CACHE.move_to_end(key)
+        return fv
+    fv = FieldVocab(field)
+    for sh in shards:
+        for seg in sh.segments:
+            inv = seg.inverted.get(field)
+            if inv is not None:
+                fv.add_segment(inv)
+    _VOCAB_CACHE[key] = fv
+    while len(_VOCAB_CACHE) > 16:
+        _VOCAB_CACHE.popitem(last=False)
+    return fv
+
+
+# ---------------------------------------------------------------------------
+# term suggester
+# ---------------------------------------------------------------------------
+
+def _term_candidates(token: str, fv: FieldVocab, opts: dict) -> List[dict]:
+    max_edits = int(opts.get("max_edits", 2))
+    prefix_length = int(opts.get("prefix_length", opts.get("prefix_len", 1)))
+    min_word_length = int(opts.get("min_word_length", opts.get("min_word_len", 4)))
+    min_doc_freq = float(opts.get("min_doc_freq", 0.0))
+    max_term_freq = float(opts.get("max_term_freq", 0.01))
+    mode = opts.get("suggest_mode", "missing")
+    size = int(opts.get("size", 5))
+    sort = opts.get("sort", "score")
+
+    token_df = fv.df.get(token, 0)
+    if mode == "missing" and token_df > 0:
+        return []
+    # max_term_freq: tokens frequent in the index are assumed correctly
+    # spelled and skipped (fractional = ratio of num_docs, like the reference)
+    if token_df:
+        thresh = max_term_freq * fv.num_docs if max_term_freq < 1.0 else max_term_freq
+        if token_df > thresh and mode != "always":
+            return []
+    if len(token) < min_word_length:
+        return []
+
+    terms, mat, lens = fv.packed()
+    if not terms:
+        return []
+    dist = batched_edit_distance(token, mat, lens, max_dist=max_edits)
+    cand_idx = np.nonzero((dist <= max_edits) & (dist > 0))[0]
+    out = []
+    min_df = min_doc_freq * fv.num_docs if 0 < min_doc_freq < 1.0 else min_doc_freq
+    for i in cand_idx:
+        t = terms[i]
+        if prefix_length and t[:prefix_length] != token[:prefix_length]:
+            continue
+        df = fv.df[t]
+        if df < min_df:
+            continue
+        if mode == "popular" and df <= token_df:
+            continue
+        d = int(dist[i])
+        score = 1.0 - d / max(1, min(len(t), len(token)))
+        out.append({"text": t, "score": round(score, 6), "freq": df})
+    if sort == "frequency":
+        out.sort(key=lambda o: (-o["freq"], -o["score"], o["text"]))
+    else:
+        out.sort(key=lambda o: (-o["score"], -o["freq"], o["text"]))
+    return out[:size]
+
+
+def _analyze_tokens(text: str, analyzer) -> List[Tuple[str, int, int]]:
+    """(token, offset, length) triples. Offsets are best-effort recovered by
+    scanning the source text left→right (the analysis chain does not carry
+    char offsets yet; R3 threads them through)."""
+    toks = [t for t, _ in analyzer.analyze(text)]
+    out = []
+    cursor = 0
+    lower = text.lower()
+    for t in toks:
+        at = lower.find(t.lower(), cursor)
+        if at < 0:
+            at, ln = cursor, len(t)
+        else:
+            ln = len(t)
+            cursor = at + ln
+        out.append((t, at, ln))
+    return out
+
+
+def term_suggest(shards, text: str, opts: dict, analysis) -> List[dict]:
+    field = opts.get("field")
+    if not field:
+        raise ElasticsearchTpuException("suggester [term] requires a [field]")
+    analyzer = _suggest_analyzer(shards, opts, field, analysis)
+    fv = field_vocab(shards, field)
+    entries = []
+    for token, off, ln in _analyze_tokens(text, analyzer):
+        entries.append({
+            "text": token,
+            "offset": off,
+            "length": ln,
+            "options": _term_candidates(token, fv, opts),
+        })
+    return entries
+
+
+def _suggest_analyzer(shards, opts: dict, field: str, analysis):
+    name = opts.get("analyzer")
+    if name:
+        return analysis.get(name)
+    for sh in shards:
+        an = sh.searcher.mappings.get(field) if hasattr(sh, "searcher") else None
+        if an is not None and an.search_analyzer:
+            return analysis.get(an.search_analyzer)
+        if an is not None and an.analyzer:
+            return analysis.get(an.analyzer)
+    return analysis.get("standard")
+
+
+# ---------------------------------------------------------------------------
+# phrase suggester
+# ---------------------------------------------------------------------------
+
+def _segment_bigrams(seg, field: str) -> Dict[Tuple[str, str], int]:
+    """Bigram counts reconstructed from the positional CSR, cached on the
+    segment. Reference phrase suggester reads a shingle sub-field instead;
+    we already store positions for phrase queries, so the LM comes for free
+    without a second indexed field."""
+    cache = getattr(seg, "_bigram_cache", None)
+    if cache is None:
+        cache = seg._bigram_cache = {}
+    if field in cache:
+        return cache[field]
+    inv = seg.inverted.get(field)
+    counts: Dict[Tuple[str, str], int] = {}
+    if inv is not None and inv.positions is not None and inv.doc_ids_host is not None:
+        # doc -> [(pos, term)] from the flat postings+positions arrays; term
+        # ids recovered from the CSR offsets in one vectorized repeat
+        per_doc: Dict[int, List[Tuple[int, int]]] = {}
+        po = inv.pos_offsets
+        tids = np.repeat(np.arange(len(inv.terms), dtype=np.int64),
+                         np.diff(inv.offsets).astype(np.int64))
+        for k in range(inv.nnz):
+            doc = int(inv.doc_ids_host[k])
+            tid = int(tids[k])
+            for p in inv.positions[int(po[k]): int(po[k + 1])]:
+                per_doc.setdefault(doc, []).append((int(p), tid))
+        for doc, pairs in per_doc.items():
+            pairs.sort()
+            for (p1, t1), (p2, t2) in zip(pairs, pairs[1:]):
+                if p2 == p1 + 1:
+                    key = (inv.terms[t1], inv.terms[t2])
+                    counts[key] = counts.get(key, 0) + 1
+    cache[field] = counts
+    return counts
+
+
+class PhraseLM:
+    """Stupid-backoff bigram LM over a field (Brants et al. 2007), the same
+    default smoothing as the reference's StupidBackoffScorer.java."""
+
+    BACKOFF = 0.4
+
+    def __init__(self, shards, field: str):
+        self.fv = field_vocab(shards, field)
+        self.bigrams: Dict[Tuple[str, str], int] = {}
+        for sh in shards:
+            for seg in sh.segments:
+                for k, v in _segment_bigrams(seg, field).items():
+                    self.bigrams[k] = self.bigrams.get(k, 0) + v
+
+    def logp(self, prev: Optional[str], word: str) -> float:
+        total = max(1, self.fv.total_terms)
+        uni = self.fv.cf.get(word, 0)
+        if prev is not None:
+            bi = self.bigrams.get((prev, word), 0)
+            cprev = self.fv.cf.get(prev, 0)
+            if bi > 0 and cprev > 0:
+                return float(np.log(bi / cprev))
+            return float(np.log(self.BACKOFF * max(uni, 0.5) / total))
+        return float(np.log(max(uni, 0.5) / total))
+
+    def score(self, tokens: List[str]) -> float:
+        lp = 0.0
+        prev = None
+        for t in tokens:
+            lp += self.logp(prev, t)
+            prev = t
+        return lp / max(1, len(tokens))
+
+
+def phrase_suggest(shards, text: str, opts: dict, analysis) -> List[dict]:
+    field = opts.get("field")
+    if not field:
+        raise ElasticsearchTpuException("suggester [phrase] requires a [field]")
+    size = int(opts.get("size", 5))
+    max_errors = float(opts.get("max_errors", 1.0))
+    confidence = float(opts.get("confidence", 1.0))
+    rwel = float(opts.get("real_word_error_likelihood", 0.95))
+    analyzer = _suggest_analyzer(shards, opts, field, analysis)
+    gen_opts = dict(opts)
+    for g in opts.get("direct_generator", [])[:1]:
+        gen_opts.update(g)
+    gen_opts.setdefault("suggest_mode", "always")
+    gen_opts.setdefault("max_term_freq", 1e18)
+    gen_opts.setdefault("min_word_length", 2)
+    gen_opts.setdefault("size", 5)
+
+    toks = [t for t, _, _ in _analyze_tokens(text, analyzer)]
+    if not toks:
+        return [{"text": text, "offset": 0, "length": len(text), "options": []}]
+    lm = PhraseLM(shards, field)
+    fv = lm.fv
+
+    # candidate sets per position: original token + top edit-distance cands
+    cand_sets: List[List[Tuple[str, float]]] = []
+    for t in toks:
+        cands = [(t, 0.0 if fv.df.get(t, 0) else -1.0)]
+        for c in _term_candidates(t, fv, gen_opts):
+            cands.append((c["text"], c["score"]))
+        cand_sets.append(cands[: max(2, int(gen_opts["size"]))])
+
+    max_changes = int(max_errors) if max_errors >= 1 else max(
+        1, int(round(max_errors * len(toks))))
+
+    # beam over token positions with a channel-model penalty (reference:
+    # WordScorer — LM probability times an error-channel prior): keeping a
+    # token costs log(rwel) ("a real word is still misspelled with prob
+    # 1-rwel"), substituting costs log(1-rwel), so corrections only win when
+    # the LM evidence outweighs the channel prior.
+    log_keep = float(np.log(rwel))
+    log_change = float(np.log(max(1e-9, 1.0 - rwel)))
+    beams: List[Tuple[float, List[str], int]] = [(0.0, [], 0)]
+    for pos, cands in enumerate(cand_sets):
+        nxt: List[Tuple[float, List[str], int]] = []
+        for lp, seq, nch in beams:
+            prev = seq[-1] if seq else None
+            for word, _cs in cands:
+                changed = word != toks[pos]
+                if changed and nch >= max_changes:
+                    continue
+                pen = log_change if changed else log_keep
+                nxt.append((lp + lm.logp(prev, word) + pen, seq + [word],
+                            nch + (1 if changed else 0)))
+        nxt.sort(key=lambda b: -b[0])
+        beams = nxt[:32]
+
+    # the unchanged phrase scores base*rwel^n under the same channel model;
+    # a candidate survives only if it beats confidence * that score
+    base = lm.score(toks) + log_keep
+    seen = set()
+    options = []
+    pre, post = None, None
+    hl = opts.get("highlight")
+    if hl:
+        pre, post = hl.get("pre_tag", "<em>"), hl.get("post_tag", "</em>")
+    for lp, seq, nch in beams:
+        phrase = " ".join(seq)
+        if phrase in seen:
+            continue
+        seen.add(phrase)
+        score = lp / max(1, len(seq))
+        if seq == toks:
+            continue
+        if confidence > 0 and np.exp(score) <= confidence * np.exp(base):
+            continue
+        opt = {"text": phrase, "score": round(float(np.exp(score)), 8)}
+        if hl:
+            opt["highlighted"] = " ".join(
+                f"{pre}{w}{post}" if w != t else w for w, t in zip(seq, toks))
+        options.append(opt)
+        if len(options) >= size:
+            break
+    return [{"text": text, "offset": 0, "length": len(text), "options": options}]
+
+
+# ---------------------------------------------------------------------------
+# completion suggester
+# ---------------------------------------------------------------------------
+
+def _segment_completions(seg, field: str) -> Tuple[List[str], List[Tuple[int, float, str, Any]]]:
+    """Sorted (input strings, aligned (doc, weight, output, payload)) for one
+    segment, cached. The sorted-array + bisect pair is our FST: prefix lookup
+    is a binary search for the [prefix, prefix+\\uffff) range."""
+    cache = getattr(seg, "_completion_cache", None)
+    if cache is None:
+        cache = seg._completion_cache = {}
+    if field in cache:
+        return cache[field]
+    inputs: List[str] = []
+    meta: List[Tuple[int, float, str, Any]] = []
+    for doc in range(seg.num_docs):
+        stored = seg.stored[doc] if doc < len(seg.stored) else None
+        if not stored or field not in stored:
+            continue
+        for entry in stored[field]:
+            if isinstance(entry, str):
+                entry = {"input": [entry]}
+            ins = entry.get("input", [])
+            if isinstance(ins, str):
+                ins = [ins]
+            output = entry.get("output") or (ins[0] if ins else "")
+            weight = float(entry.get("weight", 1))
+            payload = entry.get("payload")
+            for s in ins:
+                inputs.append(s.lower())
+                meta.append((doc, weight, output, payload))
+    order = sorted(range(len(inputs)), key=lambda i: inputs[i])
+    inputs = [inputs[i] for i in order]
+    meta = [meta[i] for i in order]
+    cache[field] = (inputs, meta)
+    return inputs, meta
+
+
+def completion_suggest(shards, prefix: str, opts: dict) -> List[dict]:
+    field = opts.get("field")
+    if not field:
+        raise ElasticsearchTpuException("suggester [completion] requires a [field]")
+    size = int(opts.get("size", 5))
+    fuzzy = opts.get("fuzzy")
+    p = prefix.lower()
+    collected: Dict[str, dict] = {}
+    for sh in shards:
+        for seg in sh.segments:
+            inputs, meta = _segment_completions(seg, field)
+            if fuzzy:
+                fz = int(fuzzy.get("fuzziness", 1)) if isinstance(fuzzy, dict) else 1
+                plen = len(p)
+                cut = [s[:plen] for s in inputs]
+                mat, lens = pack_terms(cut)
+                dist = batched_edit_distance(p, mat, lens, max_dist=fz)
+                idx = np.nonzero(dist <= fz)[0]
+            else:
+                # exact prefix range: bisect to the first candidate, then
+                # extend while the prefix holds (no sentinel-character upper
+                # bound — astral-plane inputs sort above U+FFFF)
+                lo = bisect_left(inputs, p)
+                hi = lo
+                while hi < len(inputs) and inputs[hi].startswith(p):
+                    hi += 1
+                idx = range(lo, hi)
+            for i in idx:
+                doc, weight, output, payload = meta[i]
+                if not seg.live_host[doc]:
+                    continue
+                cur = collected.get(output)
+                if cur is None or weight > cur["score"]:
+                    opt = {"text": output, "score": weight}
+                    if payload is not None:
+                        opt["payload"] = payload
+                    collected[output] = opt
+    options = sorted(collected.values(), key=lambda o: (-o["score"], o["text"]))[:size]
+    return [{"text": prefix, "offset": 0, "length": len(prefix), "options": options}]
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+SUGGEST_KINDS = ("term", "phrase", "completion")
+
+
+def execute_suggest(shards, body: dict, analysis) -> dict:
+    """Run a suggest body (reference: SuggestPhase.java execute()).
+
+    ``shards`` are IndexShard-likes exposing .segments and .searcher.
+    """
+    out: Dict[str, Any] = {}
+    global_text = body.get("text")
+    for name, spec in body.items():
+        if name == "text":
+            continue
+        if not isinstance(spec, dict):
+            raise ElasticsearchTpuException(f"suggester [{name}] malformed body")
+        text = spec.get("text", spec.get("prefix", global_text))
+        if text is None:
+            raise ElasticsearchTpuException(f"suggester [{name}] requires [text]")
+        kind = next((k for k in SUGGEST_KINDS if k in spec), None)
+        if kind is None:
+            raise ElasticsearchTpuException(
+                f"suggester [{name}] requires one of {SUGGEST_KINDS}")
+        opts = spec[kind] or {}
+        if kind == "term":
+            out[name] = term_suggest(shards, text, opts, analysis)
+        elif kind == "phrase":
+            out[name] = phrase_suggest(shards, text, opts, analysis)
+        else:
+            out[name] = completion_suggest(shards, text, opts)
+    return out
+
+
+def execute_suggest_multi(groups, body: dict) -> dict:
+    """Suggest across several indices: each index runs with ITS OWN analysis
+    registry (custom analyzers are per-index), then entries with the same
+    (text, offset) are merged and their options re-ranked — the same shape
+    of merge the reference does across shard responses in SuggestPhase.
+
+    ``groups`` is an iterable of (shards, analysis) pairs.
+    """
+    merged: Dict[str, List[dict]] = {}
+    for shards, analysis in groups:
+        res = execute_suggest(shards, body, analysis)
+        for name, entries in res.items():
+            if name not in merged:
+                merged[name] = entries
+                continue
+            by_key = {(e["text"], e["offset"]): e for e in merged[name]}
+            for e in entries:
+                cur = by_key.get((e["text"], e["offset"]))
+                if cur is None:
+                    merged[name].append(e)
+                    continue
+                seen = {o["text"] for o in cur["options"]}
+                cur["options"].extend(
+                    o for o in e["options"] if o["text"] not in seen)
+                cur["options"].sort(key=lambda o: (-o["score"], o["text"]))
+    return merged

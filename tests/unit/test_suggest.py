@@ -1,0 +1,120 @@
+"""Suggester tests (reference: search/suggest/* and rest-api-spec/test/suggest).
+
+Covers the batched edit-distance kernel against a scalar oracle, and the
+three suggesters end-to-end through IndexService.
+"""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.search.suggest import batched_edit_distance, pack_terms
+
+
+def _lev(a: str, b: str) -> int:
+    """Scalar Levenshtein oracle."""
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        curr = [i]
+        for j, cb in enumerate(b, 1):
+            curr.append(min(prev[j] + 1, curr[-1] + 1, prev[j - 1] + (ca != cb)))
+        prev = curr
+    return prev[-1]
+
+
+def test_batched_edit_distance_matches_oracle():
+    rng = np.random.default_rng(7)
+    alpha = "abcde"
+    terms = ["".join(rng.choice(list(alpha), size=rng.integers(1, 9)))
+             for _ in range(200)]
+    mat, lens = pack_terms(terms)
+    for q in ["abc", "edcba", "aa", "abcdeabc", "x"]:
+        got = batched_edit_distance(q, mat, lens)
+        want = np.array([_lev(q, t) for t in terms])
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.fixture()
+def svc():
+    s = IndexService("books", mappings_json={"properties": {
+        "title": {"type": "text"},
+        "sug": {"type": "completion"},
+    }})
+    docs = [
+        {"title": "the quick brown fox jumps", "sug": {"input": ["quick fox"], "weight": 10}},
+        {"title": "quick brown foxes leap over lazy dogs",
+         "sug": {"input": ["quick brown", "fast brown"], "output": "Quick Brown", "weight": 5,
+                 "payload": {"id": 2}}},
+        {"title": "the brown cow is quick", "sug": "cow tales"},
+        {"title": "brown bears and brown foxes", "sug": {"input": "bear necessities", "weight": 7}},
+    ]
+    for i, d in enumerate(docs):
+        s.index_doc(str(i), d)
+    for sh in s.shards:
+        sh.refresh()
+    yield s
+    s.close()
+
+
+def test_term_suggester_corrects_typo(svc):
+    res = svc.suggest({"fix": {"text": "quck browm", "term": {"field": "title", "min_word_length": 3}}})
+    entries = res["fix"]
+    assert [e["text"] for e in entries] == ["quck", "browm"]
+    assert entries[0]["options"][0]["text"] == "quick"
+    assert entries[1]["options"][0]["text"] == "brown"
+    # options carry freq (df) and score in (0,1]
+    opt = entries[0]["options"][0]
+    assert opt["freq"] >= 3 and 0 < opt["score"] <= 1
+
+
+def test_term_suggester_suggest_mode_missing_skips_known_terms(svc):
+    res = svc.suggest({"s": {"text": "quick", "term": {"field": "title", "min_word_length": 3}}})
+    assert res["s"][0]["options"] == []  # present in index -> no suggestions
+    res = svc.suggest({"s": {"text": "quick", "term": {
+        "field": "title", "suggest_mode": "always", "max_term_freq": 100, "min_word_length": 3}}})
+    assert any(o["text"] == "quck" for o in res["s"][0]["options"]) is False  # quck not in index
+
+
+def test_phrase_suggester_rewrites_phrase(svc):
+    res = svc.suggest({"p": {"text": "quick browm fox", "phrase": {
+        "field": "title", "highlight": {"pre_tag": "<em>", "post_tag": "</em>"}}}})
+    entry = res["p"][0]
+    assert entry["text"] == "quick browm fox"
+    assert entry["options"], "expected at least one phrase correction"
+    top = entry["options"][0]
+    assert "brown" in top["text"]
+    assert "<em>brown</em>" in top["highlighted"]
+    assert "quick" in top["text"]  # unchanged tokens survive
+
+
+def test_completion_suggester_prefix_weight_payload(svc):
+    res = svc.suggest({"c": {"prefix": "qui", "completion": {"field": "sug"}}})
+    opts = res["c"][0]["options"]
+    texts = [o["text"] for o in opts]
+    # weight 10 entry ranks first; output overrides input text
+    assert texts[0] == "quick fox"
+    assert "Quick Brown" in texts
+    payload = next(o for o in opts if o["text"] == "Quick Brown")["payload"]
+    assert payload == {"id": 2}
+
+
+def test_completion_suggester_fuzzy(svc):
+    res = svc.suggest({"c": {"prefix": "quik", "completion": {
+        "field": "sug", "fuzzy": {"fuzziness": 1}}}})
+    texts = [o["text"] for o in res["c"][0]["options"]]
+    assert "quick fox" in texts
+
+
+def test_completion_excludes_deleted_docs(svc):
+    svc.delete_doc("0")
+    for sh in svc.shards:
+        sh.refresh()
+    res = svc.suggest({"c": {"prefix": "quick", "completion": {"field": "sug"}}})
+    texts = [o["text"] for o in res["c"][0]["options"]]
+    assert "quick fox" not in texts
+
+
+def test_suggest_embedded_in_search_body(svc):
+    resp = svc.search({"query": {"match_all": {}}, "suggest": {
+        "my": {"text": "quck", "term": {"field": "title", "min_word_length": 3}}}})
+    assert resp["suggest"]["my"][0]["options"][0]["text"] == "quick"
+    assert resp["hits"]["total"]
