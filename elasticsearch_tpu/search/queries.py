@@ -1230,9 +1230,9 @@ def parse_query(dsl: Optional[dict]) -> Query:
 
     if qtype in ("span_term", "span_first", "span_near", "span_not", "span_or",
                  "span_multi", "field_masking_span"):
-        raise QueryParsingException(
-            f"[{qtype}] is not implemented yet (positional span programs land in R2)"
-        )
+        from elasticsearch_tpu.search.spans import parse_span_query
+
+        return parse_span_query(qtype, body)
     if qtype in ("nested", "has_child", "has_parent", "top_children"):
         raise QueryParsingException(
             f"[{qtype}] is not implemented yet (block-join over doc ranges lands in R2)"

@@ -1,0 +1,447 @@
+"""Span queries: positional interval algebra.
+
+Reference: org/elasticsearch/index/query/Span*QueryBuilder.java +
+FieldMaskingSpanQueryBuilder.java, backed by Lucene's SpanQuery family
+(SpanTermQuery, SpanNearQuery/NearSpansOrdered/Unordered, SpanNotQuery,
+SpanOrQuery, SpanFirstQuery, SpanMultiTermQueryWrapper).
+
+Execution model mirrors MatchPhraseQuery's documented R1 deviation: the
+*candidate doc set* is computed from the host CSR postings (set algebra on
+sorted doc-id runs — the same arrays the device scores from), and position
+intervals are verified host-side from the positional CSR. Scoring follows
+our phrase convention: a matching doc scores the sum of unigram BM25
+contributions of every term the span tree touches (Lucene scores sloppy
+phrase freq instead; device positional programs are an R2 item).
+
+A span node yields, per doc, a sorted list of half-open intervals
+(start, end) over token positions.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.utils.errors import QueryParsingException
+
+Interval = Tuple[int, int]
+
+# cap per-clause spans considered in near-combination search (guards the
+# combinatorial walk on pathological docs; Lucene bounds work similarly via
+# iterator advancement)
+MAX_SPANS_PER_CLAUSE = 128
+
+
+def _positions_for(inv, term: str, doc: int) -> Optional[np.ndarray]:
+    s, ln = inv.term_slice(term)
+    if ln == 0 or inv.doc_ids_host is None:
+        return None
+    run = inv.doc_ids_host[s : s + ln]
+    k = int(np.searchsorted(run, doc))
+    if k >= ln or run[k] != doc:
+        return None
+    e = s + k
+    return inv.positions[int(inv.pos_offsets[e]) : int(inv.pos_offsets[e + 1])]
+
+
+class SpanNode:
+    """Base: a compiled span expression bound to one field."""
+
+    field: str
+
+    def candidate_docs(self, ctx) -> np.ndarray:
+        """Sorted int32 doc ids that *may* contain a span (superset)."""
+        raise NotImplementedError
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        raise NotImplementedError
+
+    def terms(self) -> List[Tuple[str, str]]:
+        """(field, term) leaves — used for BM25 scoring of matched docs."""
+        raise NotImplementedError
+
+
+class SpanTermNode(SpanNode):
+    def __init__(self, field: str, term: str):
+        self.field = field
+        self.term = term
+
+    def candidate_docs(self, ctx) -> np.ndarray:
+        inv = ctx.inv(self.field)
+        if inv is None or inv.doc_ids_host is None:
+            return np.zeros(0, dtype=np.int32)
+        s, ln = inv.term_slice(self.term)
+        return inv.doc_ids_host[s : s + ln]
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        inv = ctx.inv(self.field)
+        if inv is None or inv.positions is None:
+            return []
+        p = _positions_for(inv, self.term, doc)
+        if p is None:
+            return []
+        return [(int(x), int(x) + 1) for x in p]
+
+    def terms(self):
+        return [(self.field, self.term)]
+
+
+class SpanMultiNode(SpanNode):
+    """span_multi: wildcard/prefix/fuzzy/regexp expanded to a term union
+    (Lucene SpanMultiTermQueryWrapper)."""
+
+    def __init__(self, field: str, expand_fn, label: str):
+        self.field = field
+        self._expand = expand_fn  # ctx -> List[str]
+        self.label = label
+        # per-SEGMENT expansion cache: term dictionaries differ per segment,
+        # and the parsed query tree is reused across every segment of a shard
+        self._expanded: dict = {}
+
+    def _exp(self, ctx) -> List[str]:
+        key = ctx.segment.seg_id
+        got = self._expanded.get(key)
+        if got is None:
+            got = self._expanded[key] = list(self._expand(ctx))
+        return got
+
+    def candidate_docs(self, ctx) -> np.ndarray:
+        inv = ctx.inv(self.field)
+        if inv is None or inv.doc_ids_host is None:
+            return np.zeros(0, dtype=np.int32)
+        runs = []
+        for t in self._exp(ctx):
+            s, ln = inv.term_slice(t)
+            if ln:
+                runs.append(inv.doc_ids_host[s : s + ln])
+        if not runs:
+            return np.zeros(0, dtype=np.int32)
+        return np.unique(np.concatenate(runs))
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        inv = ctx.inv(self.field)
+        if inv is None or inv.positions is None:
+            return []
+        out: List[Interval] = []
+        for t in self._exp(ctx):
+            p = _positions_for(inv, t, doc)
+            if p is not None:
+                out.extend((int(x), int(x) + 1) for x in p)
+        out.sort()
+        return out
+
+    def terms(self):
+        # scoring uses the expansion only when a ctx is available; leaves are
+        # resolved in SpanQueryWrapper.execute via expanded_terms
+        return []
+
+    def expanded_terms(self, ctx):
+        return [(self.field, t) for t in self._exp(ctx)]
+
+
+class SpanOrNode(SpanNode):
+    def __init__(self, clauses: Sequence[SpanNode]):
+        if not clauses:
+            raise QueryParsingException("span_or requires [clauses]")
+        self.clauses = list(clauses)
+        self.field = clauses[0].field
+
+    def candidate_docs(self, ctx) -> np.ndarray:
+        runs = [c.candidate_docs(ctx) for c in self.clauses]
+        runs = [r for r in runs if r.size]
+        if not runs:
+            return np.zeros(0, dtype=np.int32)
+        return np.unique(np.concatenate(runs))
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        out: List[Interval] = []
+        for c in self.clauses:
+            out.extend(c.spans(ctx, doc))
+        return sorted(set(out))
+
+    def terms(self):
+        return [t for c in self.clauses for t in c.terms()]
+
+
+class SpanNearNode(SpanNode):
+    """Lucene SpanNearQuery: every clause matches, combined width minus the
+    sum of clause lengths ≤ slop; in_order additionally requires clause
+    spans to appear in clause order without overlap."""
+
+    def __init__(self, clauses: Sequence[SpanNode], slop: int = 0, in_order: bool = True):
+        if not clauses:
+            raise QueryParsingException("span_near requires [clauses]")
+        self.clauses = list(clauses)
+        self.slop = slop
+        self.in_order = in_order
+        self.field = clauses[0].field
+
+    def candidate_docs(self, ctx) -> np.ndarray:
+        doc_sets = [c.candidate_docs(ctx) for c in self.clauses]
+        out = doc_sets[0]
+        for ds in doc_sets[1:]:
+            out = np.intersect1d(out, ds, assume_unique=False)
+            if out.size == 0:
+                break
+        return out
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        per = [c.spans(ctx, doc)[:MAX_SPANS_PER_CLAUSE] for c in self.clauses]
+        if any(not p for p in per):
+            return []
+        found: List[Interval] = []
+
+        def rec(i: int, chosen: List[Interval]):
+            if i == len(per):
+                lo = min(s for s, _ in chosen)
+                hi = max(e for _, e in chosen)
+                tl = sum(e - s for s, e in chosen)
+                if (hi - lo) - tl <= self.slop:
+                    found.append((lo, hi))
+                return
+            for sp in per[i]:
+                if self.in_order and chosen and sp[0] < chosen[-1][1]:
+                    continue
+                rec(i + 1, chosen + [sp])
+
+        rec(0, [])
+        return sorted(set(found))
+
+    def terms(self):
+        return [t for c in self.clauses for t in c.terms()]
+
+
+class SpanNotNode(SpanNode):
+    def __init__(self, include: SpanNode, exclude: SpanNode, pre: int = 0, post: int = 0):
+        self.include = include
+        self.exclude = exclude
+        self.pre = pre
+        self.post = post
+        self.field = include.field
+
+    def candidate_docs(self, ctx) -> np.ndarray:
+        return self.include.candidate_docs(ctx)
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        inc = self.include.spans(ctx, doc)
+        if not inc:
+            return []
+        exc = self.exclude.spans(ctx, doc)
+        if not exc:
+            return inc
+        out = []
+        for s, e in inc:
+            lo, hi = s - self.pre, e + self.post
+            if not any(xs < hi and xe > lo for xs, xe in exc):
+                out.append((s, e))
+        return out
+
+    def terms(self):
+        return self.include.terms()  # exclusion terms don't contribute score
+
+
+class SpanFirstNode(SpanNode):
+    def __init__(self, match: SpanNode, end: int):
+        self.match = match
+        self.end = end
+        self.field = match.field
+
+    def candidate_docs(self, ctx) -> np.ndarray:
+        return self.match.candidate_docs(ctx)
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        return [(s, e) for s, e in self.match.spans(ctx, doc) if e <= self.end]
+
+    def terms(self):
+        return self.match.terms()
+
+
+class FieldMaskingSpanNode(SpanNode):
+    """Reports the inner spans under a different field name so they can join
+    a SpanNear/Or across fields that share position semantics (Lucene
+    FieldMaskingSpanQuery)."""
+
+    def __init__(self, inner: SpanNode, field: str):
+        self.inner = inner
+        self.field = field
+
+    def candidate_docs(self, ctx) -> np.ndarray:
+        return self.inner.candidate_docs(ctx)
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        return self.inner.spans(ctx, doc)
+
+    def terms(self):
+        return self.inner.terms()
+
+
+# ---------------------------------------------------------------------------
+# Query-tree integration
+# ---------------------------------------------------------------------------
+
+
+from elasticsearch_tpu.search.queries import Query  # noqa: E402  (queries does not import spans at module level, so no cycle)
+
+
+class SpanQueryWrapper(Query):
+    """Adapts a SpanNode to the (scores, mask) query protocol: execute()
+    computes the candidate set host-side, verifies spans per doc, and scores
+    matched docs with summed unigram BM25 over the span tree's terms via the
+    device scorer."""
+
+    def __init__(self, node: SpanNode, boost: float = 1.0):
+        self.node = node
+        self.boost = boost
+
+    def execute(self, ctx):
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.search.queries import _score_term_group
+
+        cand = self.node.candidate_docs(ctx)
+        ok = np.zeros(ctx.D, dtype=bool)
+        for d in np.unique(cand):
+            if self.node.spans(ctx, int(d)):
+                ok[d] = True
+        mask = jnp.asarray(ok)
+        if not ok.any():
+            return None, mask
+        # score: group leaf terms by field, sum BM25 over each group
+        leaves = self.node.terms()
+        for n in _walk_multis(self.node):
+            leaves.extend(n.expanded_terms(ctx))
+        by_field = {}
+        for f, t in leaves:
+            by_field.setdefault(f, []).append(t)
+        scores = None
+        for f, ts in by_field.items():
+            s, _, _ = _score_term_group(ctx, f, ts, self.boost)
+            scores = s if scores is None else scores + s
+        if scores is None:
+            scores = mask.astype(jnp.float32) * self.boost
+        return scores * mask, mask
+
+def _walk_multis(node: SpanNode):
+    if isinstance(node, SpanMultiNode):
+        yield node
+    for attr in ("clauses",):
+        for c in getattr(node, attr, []) or []:
+            yield from _walk_multis(c)
+    for attr in ("include", "match", "inner"):
+        c = getattr(node, attr, None)
+        if isinstance(c, SpanNode):
+            yield from _walk_multis(c)
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+SPAN_TYPES = ("span_term", "span_near", "span_or", "span_not", "span_first",
+              "span_multi", "field_masking_span")
+
+
+def parse_span_node(body: dict) -> SpanNode:
+    if not isinstance(body, dict) or len(body) != 1:
+        raise QueryParsingException("span clause must be a single-key object")
+    qtype, spec = next(iter(body.items()))
+
+    if qtype == "span_term":
+        field, v = next(iter(spec.items()))
+        if isinstance(v, dict):
+            v = v.get("value", v.get("term"))
+            if v is None:
+                raise QueryParsingException(
+                    f"span_term on [{field}] requires a [value]")
+        return SpanTermNode(field, str(v))
+
+    if qtype == "span_near":
+        return SpanNearNode(
+            [parse_span_node(c) for c in spec.get("clauses", [])],
+            slop=int(spec.get("slop", 0)),
+            in_order=bool(spec.get("in_order", True)),
+        )
+
+    if qtype == "span_or":
+        return SpanOrNode([parse_span_node(c) for c in spec.get("clauses", [])])
+
+    if qtype == "span_not":
+        return SpanNotNode(
+            parse_span_node(spec["include"]),
+            parse_span_node(spec["exclude"]),
+            pre=int(spec.get("pre", spec.get("dist", 0))),
+            post=int(spec.get("post", spec.get("dist", 0))),
+        )
+
+    if qtype == "span_first":
+        return SpanFirstNode(parse_span_node(spec["match"]), end=int(spec.get("end", 1)))
+
+    if qtype == "field_masking_span":
+        return FieldMaskingSpanNode(parse_span_node(spec["query"]), field=spec["field"])
+
+    if qtype == "span_multi":
+        return _parse_span_multi(spec)
+
+    raise QueryParsingException(f"unknown span query type [{qtype}]")
+
+
+def _expand_multi(ctx, field: str, mtype: str, value: str, fuzziness,
+                  max_expansions: int = 50) -> List[str]:
+    """Expand a multi-term leaf against the segment term dictionary — same
+    capped-scan approach as the standalone wildcard/regexp/fuzzy queries."""
+    import fnmatch
+    import re
+
+    from elasticsearch_tpu.search.queries import _edit_distance_le, _expand_prefix
+
+    inv = ctx.inv(field)
+    if inv is None:
+        return []
+    if mtype == "prefix":
+        return _expand_prefix(inv, value, max_expansions)
+    if mtype == "wildcard":
+        # literal prefix ends at the first metacharacter, including character
+        # classes — same rule as the standalone WildcardQuery
+        i = min((value.find(c) for c in "*?[]" if c in value), default=len(value))
+        cands = _expand_prefix(inv, value[:i], 1 << 30) if i else inv.terms
+        rx = re.compile(fnmatch.translate(value))
+        return [t for t in cands if rx.match(t)][:max_expansions]
+    if mtype == "regexp":
+        try:
+            rx = re.compile(value)
+        except re.error as e:
+            raise QueryParsingException(f"invalid regexp [{value}]: {e}")
+        return [t for t in inv.terms if rx.fullmatch(t)][:max_expansions]
+    if mtype == "fuzzy":
+        k = fuzziness
+        if k in (None, "AUTO", "auto"):
+            k = 0 if len(value) < 3 else (1 if len(value) < 6 else 2)
+        k = int(k)
+        return [c for c in inv.terms if _edit_distance_le(value, c, k)][:max_expansions]
+    raise QueryParsingException(f"span_multi does not support [{mtype}]")
+
+
+def _parse_span_multi(spec: dict) -> SpanMultiNode:
+    match = spec.get("match")
+    if not isinstance(match, dict) or len(match) != 1:
+        raise QueryParsingException("span_multi requires a [match] multi-term query")
+    mtype, mspec = next(iter(match.items()))
+    field, v = next(iter(mspec.items()))
+    fz = None
+    if isinstance(v, dict):
+        fz = v.get("fuzziness")
+        value = v.get("value", v.get(mtype, v.get("prefix")))
+        if value is None:
+            raise QueryParsingException(
+                f"span_multi [{mtype}] on [{field}] requires a [value]")
+    else:
+        value = v
+    value = str(value)
+    expand = lambda ctx, f=field, m=mtype, p=value, z=fz: _expand_multi(ctx, f, m, p, z)
+    return SpanMultiNode(field, expand, label=f"{mtype}:{value}")
+
+
+def parse_span_query(qtype: str, spec: dict, boost: float = 1.0):
+    node = parse_span_node({qtype: spec})
+    return SpanQueryWrapper(node, boost=float(spec.get("boost", boost))
+                            if isinstance(spec, dict) else boost)

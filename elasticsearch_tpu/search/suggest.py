@@ -435,6 +435,9 @@ def completion_suggest(shards, prefix: str, opts: dict) -> List[dict]:
         raise ElasticsearchTpuException("suggester [completion] requires a [field]")
     size = int(opts.get("size", 5))
     fuzzy = opts.get("fuzzy")
+    # "fuzzy": {} and "fuzzy": true are both valid request-default forms
+    if fuzzy is True or fuzzy == {}:
+        fuzzy = {"fuzziness": 1}
     p = prefix.lower()
     collected: Dict[str, dict] = {}
     for sh in shards:
@@ -530,5 +533,16 @@ def execute_suggest_multi(groups, body: dict) -> dict:
                 seen = {o["text"] for o in cur["options"]}
                 cur["options"].extend(
                     o for o in e["options"] if o["text"] not in seen)
-                cur["options"].sort(key=lambda o: (-o["score"], o["text"]))
+    # re-rank and truncate per the suggester's own size/sort options
+    for name, entries in merged.items():
+        spec = body.get(name, {})
+        kind = next((k for k in SUGGEST_KINDS if k in spec), None)
+        opts = spec.get(kind) or {} if kind else {}
+        size = int(opts.get("size", 5))
+        if kind == "term" and opts.get("sort") == "frequency":
+            keyf = lambda o: (-o.get("freq", 0), -o["score"], o["text"])
+        else:
+            keyf = lambda o: (-o["score"], o["text"])
+        for e in entries:
+            e["options"] = sorted(e["options"], key=keyf)[:size]
     return merged

@@ -237,7 +237,7 @@ def test_unknown_query_raises(ctx):
     with pytest.raises(QueryParsingException):
         parse_query({"frobnicate": {}})
     with pytest.raises(QueryParsingException):
-        parse_query({"span_term": {"body": "x"}})
+        parse_query({"span_near": {"clauses": []}})  # malformed span
 
 
 def test_boosting_query(ctx):

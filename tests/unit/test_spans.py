@@ -1,0 +1,155 @@
+"""Span query tests (reference: Span*QueryBuilder + Lucene SpanQuery tests).
+
+Positions are deterministic: docs are simple whitespace phrases, so the
+expected interval algebra can be stated by hand.
+"""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture()
+def svc():
+    s = IndexService("spans", mappings_json={"properties": {
+        "body": {"type": "text", "analyzer": "whitespace"},
+        "alt": {"type": "text", "analyzer": "whitespace"},
+    }})
+    docs = [
+        "the quick brown fox",             # 0: quick@1 brown@2 fox@3
+        "quick red fox",                   # 1: quick@0 fox@2
+        "fox quick",                       # 2: reversed order
+        "quick a b c d e fox",             # 3: far apart (gap 5)
+        "the lazy dog",                    # 4: no match
+        "quick brown quick fox",           # 5: multiple occurrences
+    ]
+    for i, t in enumerate(docs):
+        s.index_doc(str(i), {"body": t, "alt": "fox sleeps"})
+    for sh in s.shards:
+        sh.refresh()
+    yield s
+    s.close()
+
+
+def hits(svc, query):
+    resp = svc.search({"query": query, "size": 20})
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+def test_span_term(svc):
+    assert hits(svc, {"span_term": {"body": "quick"}}) == ["0", "1", "2", "3", "5"]
+    assert hits(svc, {"span_term": {"body": {"value": "dog"}}}) == ["4"]
+
+
+def test_span_near_in_order_slop0(svc):
+    q = {"span_near": {"clauses": [
+        {"span_term": {"body": "quick"}},
+        {"span_term": {"body": "fox"}}], "slop": 0, "in_order": True}}
+    # adjacent in-order only: doc 5 (quick@2 fox@3); doc 0 has brown between
+    assert hits(svc, q) == ["5"]
+
+
+def test_span_near_slop(svc):
+    q = {"span_near": {"clauses": [
+        {"span_term": {"body": "quick"}},
+        {"span_term": {"body": "fox"}}], "slop": 1, "in_order": True}}
+    # gap of one token allowed: docs 0 (brown), 1 (red), 5
+    assert hits(svc, q) == ["0", "1", "5"]
+    q["span_near"]["slop"] = 5
+    assert hits(svc, q) == ["0", "1", "3", "5"]
+
+
+def test_span_near_unordered(svc):
+    q = {"span_near": {"clauses": [
+        {"span_term": {"body": "quick"}},
+        {"span_term": {"body": "fox"}}], "slop": 0, "in_order": False}}
+    # doc 2 "fox quick" qualifies unordered at slop 0 (adjacent)
+    assert hits(svc, q) == ["2", "5"]
+
+
+def test_span_first(svc):
+    # fox within first 3 positions: doc 1 (fox@2) and doc 2 (fox@0)
+    q = {"span_first": {"match": {"span_term": {"body": "fox"}}, "end": 3}}
+    assert hits(svc, q) == ["1", "2"]
+
+
+def test_span_or(svc):
+    q = {"span_or": {"clauses": [
+        {"span_term": {"body": "dog"}}, {"span_term": {"body": "red"}}]}}
+    assert hits(svc, q) == ["1", "4"]
+
+
+def test_span_not(svc):
+    # quick spans NOT immediately followed by brown (post=1):
+    # doc0 quick@1 brown@2 excluded; doc5 has quick@2 (brown@1 before it) ok
+    q = {"span_not": {
+        "include": {"span_term": {"body": "quick"}},
+        "exclude": {"span_term": {"body": "brown"}},
+        "post": 1}}
+    got = hits(svc, q)
+    assert "1" in got and "2" in got and "3" in got and "5" in got
+    assert "0" not in got
+
+
+def test_span_multi_prefix(svc):
+    q = {"span_near": {"clauses": [
+        {"span_multi": {"match": {"prefix": {"body": "qui"}}}},
+        {"span_term": {"body": "fox"}}], "slop": 1, "in_order": True}}
+    assert hits(svc, q) == ["0", "1", "5"]
+
+
+def test_span_multi_wildcard_and_fuzzy(svc):
+    assert hits(svc, {"span_multi": {"match": {"wildcard": {"body": "d*g"}}}}) == ["4"]
+    assert hits(svc, {"span_multi": {"match": {
+        "fuzzy": {"body": {"value": "quickk", "fuzziness": 1}}}}}) == ["0", "1", "2", "3", "5"]
+
+
+def test_field_masking_span(svc):
+    # alt:"fox sleeps" -> fox@0; mask alt's fox as body and require it right
+    # before body's quick: doc2 has body quick@1 and masked fox@0
+    q = {"span_near": {"clauses": [
+        {"field_masking_span": {"query": {"span_term": {"alt": "fox"}}, "field": "body"}},
+        {"span_term": {"body": "quick"}}], "slop": 0, "in_order": True}}
+    # masked fox@0 then quick@1 adjacent in-order: doc0 (quick@1) and doc2
+    # (quick@1); doc1/doc3/doc5 have quick@0 which overlaps the masked span
+    assert hits(svc, q) == ["0", "2"]
+
+
+def test_span_scores_positive_and_deterministic(svc):
+    resp = svc.search({"query": {"span_term": {"body": "fox"}}})
+    scores = [h["_score"] for h in resp["hits"]["hits"]]
+    assert all(s > 0 for s in scores)
+    resp2 = svc.search({"query": {"span_term": {"body": "fox"}}})
+    assert scores == [h["_score"] for h in resp2["hits"]["hits"]]
+
+
+def test_span_multi_expands_per_segment():
+    # regression: expansion must be recomputed per segment — terms present
+    # only in a later segment were missed when the cache was query-global
+    s = IndexService("seg2", mappings_json={"properties": {
+        "body": {"type": "text", "analyzer": "whitespace"}}})
+    s.index_doc("0", {"body": "alpha beta"})
+    for sh in s.shards:
+        sh.refresh()
+    s.index_doc("1", {"body": "dog gamma"})
+    for sh in s.shards:
+        sh.refresh()
+    assert hits(s, {"span_multi": {"match": {"prefix": {"body": "do"}}}}) == ["1"]
+    # wildcard char-class metacharacters terminate the literal prefix
+    assert hits(s, {"span_multi": {"match": {"wildcard": {"body": "d[ou]g"}}}}) == ["1"]
+    s.close()
+
+
+def test_span_term_missing_value_raises():
+    from elasticsearch_tpu.search.queries import parse_query
+    from elasticsearch_tpu.utils.errors import QueryParsingException
+
+    with pytest.raises(QueryParsingException):
+        parse_query({"span_term": {"body": {"boost": 2.0}}})
+
+
+def test_span_in_bool_filter_context(svc):
+    q = {"bool": {"filter": [{"span_near": {"clauses": [
+        {"span_term": {"body": "quick"}},
+        {"span_term": {"body": "fox"}}], "slop": 0, "in_order": True}}]}}
+    assert hits(svc, q) == ["5"]
