@@ -31,6 +31,13 @@ class ParsedDocument:
     # field -> raw values for stored fields
     stored: Dict[str, List[Any]] = field(default_factory=dict)
     routing: Optional[str] = None
+    # block-join (reference: mapper/object/ObjectMapper nested=true → Lucene
+    # block indexing): nested sub-docs indexed immediately before their root
+    children: List["ParsedDocument"] = field(default_factory=list)
+    nested_path: Optional[str] = None  # set on child docs
+    nested_ord: int = -1  # index within the parent's array at nested_path
+    # _type / _parent meta (parent-child joins) + anything merge must replay
+    meta: Dict[str, Any] = field(default_factory=dict)
 
     def field_length(self, fname: str) -> int:
         return len(self.text_tokens.get(fname, ()))
@@ -41,18 +48,46 @@ class DocumentParser:
         self.mappings = mappings
         self.analysis = analysis
 
-    def parse(self, doc_id: str, source: dict, routing: Optional[str] = None) -> ParsedDocument:
+    def parse(self, doc_id: str, source: dict, routing: Optional[str] = None,
+              doc_type: Optional[str] = None, parent: Optional[str] = None) -> ParsedDocument:
         if not isinstance(source, dict):
             raise MapperParsingException("document source must be a JSON object")
         parsed = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
+        if doc_type:
+            # _type/_parent as ordinary keyword doc-value columns (reference:
+            # mapper/internal/TypeFieldMapper, ParentFieldMapper) — the
+            # has_child/has_parent join reads them back from the segment
+            parsed.doc_values["_type"] = [str(doc_type)]
+            parsed.meta["_type"] = str(doc_type)
+        if parent:
+            parsed.doc_values["_parent"] = [str(parent)]
+            parsed.meta["_parent"] = str(parent)
         self._walk(source, "", parsed)
         return parsed
+
+    def _nested_children(self, full: str, items: List[dict], parsed: ParsedDocument):
+        """Each object under a nested path becomes its own block doc with
+        fields at the full dotted path; searched via NestedQuery's
+        child→parent scatter join."""
+        for i, item in enumerate(items):
+            child = ParsedDocument(
+                doc_id=f"{parsed.doc_id}|{full}|{i}",
+                source=None,  # child _source lives inside the root's _source
+                nested_path=full,
+                nested_ord=i,
+            )
+            if isinstance(item, dict):
+                self._walk(item, f"{full}.", child)
+            parsed.children.append(child)
 
     def _walk(self, obj: dict, prefix: str, parsed: ParsedDocument):
         for key, value in obj.items():
             full = f"{prefix}{key}"
             if isinstance(value, dict):
                 fm = self.mappings.get(full)
+                if full in self.mappings.nested_paths:
+                    self._nested_children(full, [value], parsed)
+                    continue
                 if fm is None or fm.type in ("object", "nested", "geo_point"):
                     if fm is not None and fm.type == "geo_point":
                         self._index_value(fm, value, parsed)
@@ -66,7 +101,12 @@ class DocumentParser:
                 if fm is not None and fm.type == "completion":
                     self._index_value(fm, value, parsed)
                     continue
-                # array of objects: flatten each (nested semantics refined in R2)
+                if full in self.mappings.nested_paths:
+                    self._nested_children(full, value, parsed)
+                    continue
+                # array of objects (non-nested): flatten each — values from
+                # different objects mingle, the documented ES object-array
+                # semantics that nested mappings exist to avoid
                 for item in value:
                     self._walk(item, f"{full}.", parsed)
                 continue

@@ -38,6 +38,10 @@ class DocLocation:
     where: Any = "buffer"
     local_id: int = -1
     source: Optional[dict] = None  # for realtime get of buffered docs
+    # _type/_parent meta preserved across partial updates & re-index
+    doc_type: Optional[str] = None
+    parent: Optional[str] = None
+    routing: Optional[str] = None
 
 
 @dataclass
@@ -83,6 +87,8 @@ class Engine:
         version_type: str = "internal",
         op_type: str = "index",
         routing: Optional[str] = None,
+        doc_type: Optional[str] = None,
+        parent: Optional[str] = None,
         _replay: bool = False,
     ) -> Tuple[str, int, bool]:
         """Index/create a document. Returns (id, new_version, created).
@@ -115,18 +121,23 @@ class Engine:
             else:
                 new_version = (loc.version if loc else 0) + 1
 
-            parsed = self.parser.parse(doc_id, source, routing=routing)
+            parsed = self.parser.parse(doc_id, source, routing=routing,
+                                       doc_type=doc_type, parent=parent)
             self._remove_existing(doc_id)
             local = self.buffer.add(parsed)
             self._buffer_ids[doc_id] = local
             self._locations[doc_id] = DocLocation(
-                version=new_version, deleted=False, where="buffer", local_id=local, source=source
+                version=new_version, deleted=False, where="buffer", local_id=local,
+                source=source, doc_type=doc_type, parent=parent, routing=routing,
             )
             if not _replay:
-                self.translog.append(
-                    {"op": "index", "id": doc_id, "source": source, "version": new_version,
-                     "routing": routing}
-                )
+                entry = {"op": "index", "id": doc_id, "source": source,
+                         "version": new_version, "routing": routing}
+                if doc_type:
+                    entry["doc_type"] = doc_type
+                if parent:
+                    entry["parent"] = parent
+                self.translog.append(entry)
             self.stats.index_total += 1
             self.stats.index_time_ms += (time.perf_counter() - t0) * 1000
             return doc_id, new_version, not exists
@@ -169,7 +180,15 @@ class Engine:
                 source = self._run_update_script(script, script_params or {}, source)
             elif partial is not None:
                 _deep_merge(source, partial)
-            _, v, _ = self.index(doc_id, source)
+            # carry _type/_parent/routing through the re-index, else a
+            # partial update would sever the parent-child join
+            loc = self._locations.get(doc_id)
+            _, v, _ = self.index(
+                doc_id, source,
+                routing=loc.routing if loc else None,
+                doc_type=loc.doc_type if loc else None,
+                parent=loc.parent if loc else None,
+            )
             return v, False
 
     def _run_update_script(self, script: str, params: dict, source: dict) -> dict:
@@ -255,7 +274,10 @@ class Engine:
     def refresh(self) -> bool:
         """Freeze the buffer into a new searchable segment (NRT refresh)."""
         with self._lock:
-            live_docs = [d for d in self.buffer.docs if d is not None]
+            # roots only: tombstoned roots leave orphan children in the
+            # buffer arrays; re-adding a root re-emits its block
+            live_docs = [d for d, p in zip(self.buffer.docs, self.buffer.parent_of)
+                         if d is not None and p == -1]
             if not live_docs:
                 return False
             fresh = SegmentBuilder(self.mappings)
@@ -298,9 +320,13 @@ class Engine:
             id_order: List[str] = []
             for seg in self.segments:
                 live = seg.live_host
+                roots = seg.roots_host
                 for local, doc_id in enumerate(seg.ids):
-                    if live[local]:
-                        builder.add(self.parser.parse(doc_id, seg.sources[local]))
+                    if live[local] and (roots is None or roots[local]):
+                        meta = seg.metas[local] if local < len(seg.metas) else {}
+                        builder.add(self.parser.parse(
+                            doc_id, seg.sources[local],
+                            doc_type=meta.get("_type"), parent=meta.get("_parent")))
                         id_order.append(doc_id)
             merged = builder.freeze()
             if merged is None:
@@ -319,7 +345,9 @@ class Engine:
         with self._lock:
             for op in self.translog.replay():
                 if op["op"] == "index":
-                    self.index(op["id"], op["source"], routing=op.get("routing"), _replay=True)
+                    self.index(op["id"], op["source"], routing=op.get("routing"),
+                               doc_type=op.get("doc_type"), parent=op.get("parent"),
+                               _replay=True)
                     self._locations[op["id"]].version = op["version"]
                 elif op["op"] == "delete":
                     try:

@@ -344,6 +344,25 @@ class TpuSegment:
         self._live_dev = _device_put(self._live_host)
         self._live_dirty = False
         self.deleted_count = 0
+        # block-join (set by SegmentBuilder.freeze when the segment holds
+        # nested child docs; None = every doc is a root)
+        self.metas: List[dict] = []
+        self.parent_id_host: Optional[np.ndarray] = None
+        self.nested_code_host: Optional[np.ndarray] = None
+        self.nested_ord_host: Optional[np.ndarray] = None
+        self.nested_paths: Dict[str, int] = {}
+        self.roots_host: Optional[np.ndarray] = None
+        self.parent_id_dev: Any = None
+        self.nested_code_dev: Any = None
+        self.roots_dev: Any = None
+        self.root_id_host: Optional[np.ndarray] = None
+        self.ancestors_host: Dict[int, np.ndarray] = {}
+        self.root_id_dev: Any = None
+        self.ancestors_dev: Dict[int, Any] = {}
+
+    @property
+    def has_nested(self) -> bool:
+        return self.parent_id_dev is not None
 
     # -- deletes ---------------------------------------------------------------
 
@@ -352,6 +371,17 @@ class TpuSegment:
             self._live_host[local_id] = False
             self._live_dirty = True  # device copy refreshed lazily on next read
             self.deleted_count += 1
+            # cascade to the whole block: nested children die with the root
+            if self.parent_id_host is not None:
+                stack = [local_id]
+                while stack:
+                    p = stack.pop()
+                    kids = np.nonzero(self.parent_id_host[: self.num_docs] == p)[0]
+                    for k in kids:
+                        if self._live_host[k]:
+                            self._live_host[k] = False
+                            self.deleted_count += 1
+                            stack.append(int(k))
             return True
         return False
 
@@ -398,10 +428,23 @@ class SegmentBuilder:
     def __init__(self, mappings: Mappings):
         self.mappings = mappings
         self.docs: List[ParsedDocument] = []
+        # block-join metadata aligned with docs: immediate parent local id
+        # (-1 for root docs) — children are emitted BEFORE their parent, the
+        # Lucene block order (reference: nested docs in ParsedDocument.docs())
+        self.parent_of: List[int] = []
 
     def add(self, parsed: ParsedDocument) -> int:
+        """Append a doc block (descendants first, root last); returns the
+        ROOT's local id."""
+        child_locals: List[int] = []
+        for child in parsed.children:
+            child_locals.append(self.add(child))
+        my_local = len(self.docs)
         self.docs.append(parsed)
-        return len(self.docs) - 1
+        self.parent_of.append(-1)
+        for cl in child_locals:
+            self.parent_of[cl] = my_local
+        return my_local
 
     def __len__(self) -> int:
         return len(self.docs)
@@ -477,7 +520,7 @@ class SegmentBuilder:
             )
 
         ids = [d.doc_id for d in self.docs]
-        return TpuSegment(
+        seg = TpuSegment(
             num_docs=n,
             max_docs=max_docs,
             inverted=inverted,
@@ -490,6 +533,52 @@ class SegmentBuilder:
             id_map={doc_id: i for i, doc_id in enumerate(ids)},
             field_lengths=field_lengths,
         )
+        seg.metas = [d.meta for d in self.docs]
+        # block-join arrays (all-root fast path: leave device arrays None)
+        if any(p >= 0 for p in self.parent_of):
+            parent_id = np.full(max_docs, -1, dtype=np.int32)
+            parent_id[:n] = np.asarray(self.parent_of, dtype=np.int32)
+            nested_code = np.full(max_docs, -1, dtype=np.int32)
+            nested_ord = np.full(max_docs, -1, dtype=np.int32)
+            paths: Dict[str, int] = {}
+            for i, d in enumerate(self.docs):
+                if d.nested_path is not None:
+                    code = paths.setdefault(d.nested_path, len(paths))
+                    nested_code[i] = code
+                    nested_ord[i] = d.nested_ord
+            seg.parent_id_host = parent_id
+            seg.nested_code_host = nested_code
+            seg.nested_ord_host = nested_ord
+            seg.nested_paths = paths
+            roots = np.zeros(max_docs, dtype=bool)
+            roots[:n] = parent_id[:n] < 0
+            seg.roots_host = roots
+            # transitive ancestors: root_id[d] = the block's root doc, and
+            # per nested level L: ancestor_at[L][d] = d's ancestor whose
+            # nested_code == L (-1 if none). Join targets for nested query /
+            # reverse_nested at any depth, resolved by one device gather.
+            root_id = np.arange(max_docs, dtype=np.int32)
+            anc: Dict[int, np.ndarray] = {c: np.full(max_docs, -1, dtype=np.int32)
+                                          for c in paths.values()}
+            for i in range(n):
+                # children precede parents, so walking up terminates fast
+                j = i
+                while parent_id[j] >= 0:
+                    j = parent_id[j]
+                    if nested_code[j] >= 0:
+                        if anc[nested_code[j]][i] < 0:
+                            anc[nested_code[j]][i] = j
+                root_id[i] = j
+                if nested_code[i] >= 0:
+                    anc[nested_code[i]][i] = i  # a doc is its own level-ancestor
+            seg.root_id_host = root_id
+            seg.ancestors_host = anc
+            seg.parent_id_dev = _device_put(parent_id)
+            seg.nested_code_dev = _device_put(nested_code)
+            seg.roots_dev = _device_put(roots)
+            seg.root_id_dev = _device_put(root_id)
+            seg.ancestors_dev = {c: _device_put(a) for c, a in anc.items()}
+        return seg
 
     # -- builders --------------------------------------------------------------
 

@@ -174,12 +174,20 @@ class Node:
                 i += 1
             index_name = meta.get("_index")
             doc_id = meta.get("_id")
-            routing = meta.get("routing", meta.get("_routing"))
+            parent = meta.get("parent", meta.get("_parent"))
+            routing = meta.get("routing", meta.get("_routing")) or parent
+            doc_type = meta.get("_type")
             try:
                 svc = self.get_or_autocreate(index_name)
                 if op in ("index", "create"):
+                    kw = {}
+                    if doc_type and doc_type != "_doc":
+                        kw["doc_type"] = doc_type
+                    if parent:
+                        kw["parent"] = parent
                     r = svc.index_doc(doc_id, source, routing=routing,
-                                      op_type="create" if op == "create" else "index")
+                                      op_type="create" if op == "create" else "index",
+                                      **kw)
                     status = 201 if r.get("created") else 200
                 elif op == "update":
                     r = svc.update_doc(doc_id, source, routing=routing)

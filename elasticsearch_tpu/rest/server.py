@@ -386,7 +386,7 @@ def _do_analyze(reg, body: dict, svc=None) -> dict:
 
 # -- document handlers --------------------------------------------------------
 
-def _index_doc(n: Node, p, b, index: str, id: str):
+def _index_doc(n: Node, p, b, index: str, id: str, doc_type: Optional[str] = None):
     svc = n.get_or_autocreate(index)
     kw = {}
     if "version" in p:
@@ -394,7 +394,13 @@ def _index_doc(n: Node, p, b, index: str, id: str):
         kw["version_type"] = p.get("version_type", "internal")
     if p.get("op_type") == "create":
         kw["op_type"] = "create"
-    r = svc.index_doc(id, _json(b), routing=p.get("routing"), **kw)
+    if doc_type:
+        kw["doc_type"] = doc_type
+    if p.get("parent"):
+        # parent id doubles as the routing key so parent and child land on
+        # the same shard (reference: ParentFieldMapper + routing resolution)
+        kw["parent"] = p["parent"]
+    r = svc.index_doc(id, _json(b), routing=p.get("routing") or p.get("parent"), **kw)
     if p.get("refresh") in ("true", "wait_for", ""):
         svc.refresh()
     return (201 if r.get("created") else 200), r
@@ -423,7 +429,7 @@ _RESERVED_TYPES = {"_doc", "_search", "_mapping", "_bulk", "_refresh", "_flush",
 def _index_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     if type in _RESERVED_TYPES:
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
-    return _index_doc(n, p, b, index, id)
+    return _index_doc(n, p, b, index, id, doc_type=type)
 
 
 def _get_doc_typed(n: Node, p, b, index: str, type: str, id: str):
@@ -439,7 +445,7 @@ def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
 
 
 def _get_doc(n: Node, p, b, index: str, id: str):
-    r = n.get_index(index).get_doc(id, routing=p.get("routing"))
+    r = n.get_index(index).get_doc(id, routing=p.get("routing") or p.get("parent"))
     return (200 if r.get("found") else 404), r
 
 
@@ -457,7 +463,7 @@ def _get_source(n: Node, p, b, index: str, id: str):
 
 def _delete_doc(n: Node, p, b, index: str, id: str):
     svc = n.get_index(index)
-    r = svc.delete_doc(id, routing=p.get("routing"))
+    r = svc.delete_doc(id, routing=p.get("routing") or p.get("parent"))
     if p.get("refresh") in ("true", ""):
         svc.refresh()
     return 200, r
@@ -593,6 +599,9 @@ def _explain(n: Node, p, b, index: str, id: str):
     body = _json(b)
     query = parse_query(body.get("query"))
     shard = svc.route(id, p.get("routing"))
+    from elasticsearch_tpu.search.joins import prepare_tree
+
+    prepare_tree(query, shard.segments, svc.mappings, svc.analysis)
     loc = shard.engine._locations.get(str(id))
     if loc is None or loc.deleted or loc.where == "buffer":
         return 404, {"_index": index, "_id": id, "matched": False}

@@ -61,11 +61,15 @@ class SegmentContext:
         mappings: Mappings,
         analysis: AnalysisRegistry,
         global_stats: Optional[GlobalStats] = None,
+        all_segments: Optional[list] = None,
     ):
         self.segment = segment
         self.mappings = mappings
         self.analysis = analysis
         self.global_stats = global_stats
+        # every segment of the owning shard — join queries inside aggs use
+        # this for their shard-wide prepare pass
+        self.all_segments = all_segments if all_segments is not None else [segment]
 
     @property
     def D(self) -> int:

@@ -1,0 +1,343 @@
+"""Join queries: nested (block-join) and has_child / has_parent.
+
+Reference: org/elasticsearch/index/query/NestedQueryBuilder/Parser.java
+(Lucene ToParentBlockJoinQuery), HasChildQueryBuilder/Parser.java and
+HasParentQueryBuilder/Parser.java (parent/child via ParentFieldMapper +
+global-ordinal joins), TopChildrenQueryBuilder (2.0 legacy alias here).
+
+TPU-native reshape:
+- The nested child→parent join is a *device scatter*: children of a block
+  sit at known local ids with a ``parent_id`` int32 column, so joining is
+  ``zeros.at[parent_id].add/max(child_scores)`` — one segment_sum-style
+  scatter on device, no iterator machinery (vs Lucene's
+  ToParentBlockJoinQuery walking child/parent bitsets doc-at-a-time).
+- parent/child spans *segments* (a child may be refreshed into a different
+  segment than its parent), so it cannot be a per-segment program: the
+  query exposes ``prepare(segments, ...)`` — ShardSearcher runs it once per
+  request; it executes the inner query per segment (device), then joins
+  matched ids on host via the ``_parent`` keyword column and the id map.
+  R1 deviation (documented): the id-join itself is host-side; a device
+  global-ordinal join is an R3 item.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.search.queries import Query, _empty
+from elasticsearch_tpu.utils.errors import QueryParsingException
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+SCORE_MODES = ("avg", "sum", "max", "min", "none")
+
+
+class NestedQuery(Query):
+    def __init__(self, path: str, inner: Query, score_mode: str = "avg",
+                 boost: float = 1.0, inner_hits: Optional[dict] = None,
+                 parent_path: Optional[str] = None):
+        if score_mode not in SCORE_MODES:
+            raise QueryParsingException(f"nested score_mode [{score_mode}] invalid")
+        self.path = path
+        self.inner = inner
+        self.score_mode = score_mode
+        self.boost = boost
+        self.inner_hits = inner_hits
+        # enclosing nested scope at parse time: None = top level (join goes
+        # straight to ROOT docs, like ES's nonNestedDocsFilter parent filter);
+        # else the enclosing path's level (nested-inside-nested composition)
+        self.parent_path = parent_path
+
+    def _join_target(self, ctx):
+        seg = ctx.segment
+        if self.parent_path is None:
+            return seg.root_id_dev
+        code = seg.nested_paths.get(self.parent_path)
+        if code is None:
+            return seg.root_id_dev
+        return seg.ancestors_dev[code]
+
+    def execute(self, ctx):
+        jnp = _jnp()
+        seg = ctx.segment
+        if not seg.has_nested or self.path not in seg.nested_paths:
+            return _empty(ctx)
+        sel, child_scores = self.child_selection(ctx)
+        D = ctx.D
+        # scatter-join up to the enclosing level (root by default);
+        # non-selected docs route to drop row D
+        target = self._join_target(ctx)
+        tgt = jnp.where(sel & (target >= 0), target, D)
+        selF = sel.astype(jnp.float32)
+        counts = jnp.zeros(D + 1, dtype=jnp.float32).at[tgt].add(selF)[:D]
+        parent_mask = counts > 0
+        if self.score_mode == "none":
+            return None, parent_mask
+        if self.score_mode in ("avg", "sum"):
+            sums = jnp.zeros(D + 1, dtype=jnp.float32).at[tgt].add(child_scores * selF)[:D]
+            s = sums / jnp.maximum(counts, 1.0) if self.score_mode == "avg" else sums
+        elif self.score_mode == "max":
+            s = jnp.full(D + 1, -jnp.inf, dtype=jnp.float32).at[tgt].max(
+                jnp.where(sel, child_scores, -jnp.inf))[:D]
+        else:  # min
+            s = jnp.full(D + 1, jnp.inf, dtype=jnp.float32).at[tgt].min(
+                jnp.where(sel, child_scores, jnp.inf))[:D]
+        s = jnp.where(parent_mask, s, 0.0) * self.boost
+        return s, parent_mask
+
+    def child_selection(self, ctx):
+        """(sel bool[D], child_scores f32[D]) for this path's matching
+        children — shared by execute() and the inner_hits fetch."""
+        jnp = _jnp()
+        seg = ctx.segment
+        code = seg.nested_paths[self.path]
+        child_scores, child_mask = self.inner.score_or_mask(ctx)
+        sel = child_mask & (seg.nested_code_dev == code) & seg.live
+        return sel, child_scores
+
+
+class HasChildQuery(Query):
+    """Parents having >= min_children (<= max_children) children of
+    ``child_type`` matching the inner query."""
+
+    def __init__(self, child_type: str, inner: Query, score_mode: str = "none",
+                 min_children: int = 1, max_children: int = 0, boost: float = 1.0):
+        self.child_type = child_type
+        self.inner = inner
+        self.score_mode = score_mode if score_mode != "score" else "max"
+        self.min_children = max(1, min_children)
+        self.max_children = max_children
+        self.boost = boost
+        self._stats: Optional[Dict[str, List[float]]] = None
+
+    def prepare(self, segments, mappings, analysis, global_stats=None):
+        from elasticsearch_tpu.search.context import SegmentContext
+
+        stats: Dict[str, List[float]] = {}  # parent _id -> [n, sum, max, min]
+        for seg in segments:
+            ctx = SegmentContext(seg, mappings, analysis, global_stats)
+            scores, mask = self.inner.score_or_mask(ctx)
+            m = np.asarray(mask) & seg.live_host
+            if seg.roots_host is not None:
+                m = m & seg.roots_host
+            m = m & _type_mask(seg, self.child_type)
+            locs = np.nonzero(m)[0]
+            if locs.size == 0:
+                continue
+            sc = np.asarray(scores)
+            pcol = seg.keywords.get("_parent")
+            for l in locs:
+                vals = pcol.host_values[l] if (pcol and l < len(pcol.host_values)) else None
+                if not vals:
+                    continue
+                st = stats.setdefault(vals[0], [0.0, 0.0, -np.inf, np.inf])
+                v = float(sc[l])
+                st[0] += 1
+                st[1] += v
+                st[2] = max(st[2], v)
+                st[3] = min(st[3], v)
+        self._stats = stats
+
+    def execute(self, ctx):
+        jnp = _jnp()
+        if not self._stats:
+            return _empty(ctx)
+        seg = ctx.segment
+        mask = np.zeros(ctx.D, dtype=bool)
+        score = np.zeros(ctx.D, dtype=np.float32)
+        for pid, (n, s, mx, mn) in self._stats.items():
+            if n < self.min_children or (self.max_children and n > self.max_children):
+                continue
+            local = seg.id_map.get(pid)
+            if local is None or not seg.live_host[local]:
+                continue
+            mask[local] = True
+            if self.score_mode == "sum":
+                score[local] = s
+            elif self.score_mode == "avg":
+                score[local] = s / n
+            elif self.score_mode == "max":
+                score[local] = mx
+            elif self.score_mode == "min":
+                score[local] = mn
+        dm = jnp.asarray(mask)
+        if self.score_mode == "none":
+            return None, dm
+        return jnp.asarray(score * self.boost), dm
+
+
+class HasParentQuery(Query):
+    """Children whose parent (of ``parent_type``) matches the inner query."""
+
+    def __init__(self, parent_type: str, inner: Query, score_mode: str = "none",
+                 boost: float = 1.0):
+        self.parent_type = parent_type
+        self.inner = inner
+        self.score_mode = score_mode  # none | score
+        self.boost = boost
+        self._parent_scores: Optional[Dict[str, float]] = None
+
+    def prepare(self, segments, mappings, analysis, global_stats=None):
+        from elasticsearch_tpu.search.context import SegmentContext
+
+        found: Dict[str, float] = {}
+        for seg in segments:
+            ctx = SegmentContext(seg, mappings, analysis, global_stats)
+            scores, mask = self.inner.score_or_mask(ctx)
+            m = np.asarray(mask) & seg.live_host
+            if seg.roots_host is not None:
+                m = m & seg.roots_host
+            tm = _type_mask(seg, self.parent_type, default_all=True)
+            m = m & tm
+            sc = np.asarray(scores)
+            for l in np.nonzero(m)[0]:
+                found[seg.ids[l]] = float(sc[l])
+        self._parent_scores = found
+
+    def execute(self, ctx):
+        jnp = _jnp()
+        if not self._parent_scores:
+            return _empty(ctx)
+        seg = ctx.segment
+        pcol = seg.keywords.get("_parent")
+        if pcol is None:
+            return _empty(ctx)
+        mask = np.zeros(ctx.D, dtype=bool)
+        score = np.zeros(ctx.D, dtype=np.float32)
+        for l in range(seg.num_docs):
+            if not seg.live_host[l]:
+                continue
+            vals = pcol.host_values[l] if l < len(pcol.host_values) else None
+            if not vals:
+                continue
+            sv = self._parent_scores.get(vals[0])
+            if sv is not None:
+                mask[l] = True
+                score[l] = sv
+        dm = jnp.asarray(mask)
+        if self.score_mode == "none":
+            return None, dm
+        return jnp.asarray(score * self.boost), dm
+
+
+def _type_mask(seg, type_name: str, default_all: bool = False) -> np.ndarray:
+    """bool[max_docs] of docs whose _type == type_name (host postings run).
+
+    default_all: docs indexed without any _type (single-type indices) match
+    every type filter — has_parent on untyped corpora still works."""
+    inv = seg.inverted.get("_type")
+    if inv is None:
+        return np.ones(seg.max_docs, dtype=bool) if default_all \
+            else np.zeros(seg.max_docs, dtype=bool)
+    s, ln = inv.term_slice(type_name)
+    m = np.zeros(seg.max_docs, dtype=bool)
+    if ln:
+        m[inv.doc_ids_host[s : s + ln]] = True
+    return m
+
+
+# ---------------------------------------------------------------------------
+# shard-level preparation pass
+# ---------------------------------------------------------------------------
+
+def prepare_tree(q: Any, segments, mappings, analysis, global_stats=None) -> None:
+    """Walk the parsed query tree and run prepare() on nodes that need a
+    shard-wide pre-pass (has_child / has_parent). Generic attribute walk —
+    any Query-valued attribute or list of them is recursed into.
+
+    POST-order: children prepare first, so a join query nested inside
+    another join's inner query is ready before the outer prepare executes
+    that inner query."""
+    if q is None:
+        return
+    d = getattr(q, "__dict__", None)
+    if d:
+        for v in d.values():
+            if isinstance(v, Query):
+                prepare_tree(v, segments, mappings, analysis, global_stats)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if isinstance(item, Query):
+                        prepare_tree(item, segments, mappings, analysis, global_stats)
+    if hasattr(q, "prepare"):
+        q.prepare(segments, mappings, analysis, global_stats)
+
+
+def collect_nested_inner_hits(q: Any, out: Optional[List[NestedQuery]] = None) -> List[NestedQuery]:
+    """All NestedQuery nodes carrying an inner_hits spec, in tree order."""
+    if out is None:
+        out = []
+    if isinstance(q, NestedQuery) and q.inner_hits is not None:
+        out.append(q)
+    d = getattr(q, "__dict__", None)
+    if d:
+        for v in d.values():
+            if isinstance(v, Query):
+                collect_nested_inner_hits(v, out)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if isinstance(item, Query):
+                        collect_nested_inner_hits(item, out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# parsing
+# ---------------------------------------------------------------------------
+
+import threading as _threading
+
+_SCOPE = _threading.local()  # per-thread nested-scope stack during parsing
+
+
+def parse_join_query(qtype: str, body: dict):
+    from elasticsearch_tpu.search.queries import parse_query
+
+    if qtype == "nested":
+        if "path" not in body or "query" not in body:
+            raise QueryParsingException("nested requires [path] and [query]")
+        stack = getattr(_SCOPE, "stack", None)
+        if stack is None:
+            stack = _SCOPE.stack = []
+        parent_path = stack[-1] if stack else None
+        stack.append(body["path"])
+        try:
+            inner = parse_query(body["query"])
+        finally:
+            stack.pop()
+        return NestedQuery(
+            body["path"],
+            inner,
+            score_mode=body.get("score_mode", "avg"),
+            boost=float(body.get("boost", 1.0)),
+            inner_hits=body.get("inner_hits"),
+            parent_path=parent_path,
+        )
+    if qtype in ("has_child", "top_children"):
+        if "type" not in body or "query" not in body:
+            raise QueryParsingException(f"{qtype} requires [type] and [query]")
+        return HasChildQuery(
+            body["type"],
+            parse_query(body["query"]),
+            score_mode=body.get("score_mode", body.get("score_type", "none")),
+            min_children=int(body.get("min_children", 1)),
+            max_children=int(body.get("max_children", 0)),
+            boost=float(body.get("boost", 1.0)),
+        )
+    if qtype == "has_parent":
+        ptype = body.get("parent_type", body.get("type"))
+        if ptype is None or "query" not in body:
+            raise QueryParsingException("has_parent requires [parent_type] and [query]")
+        return HasParentQuery(
+            ptype,
+            parse_query(body["query"]),
+            score_mode=body.get("score_mode", body.get("score_type", "none")),
+            boost=float(body.get("boost", 1.0)),
+        )
+    raise QueryParsingException(f"unknown join query [{qtype}]")

@@ -1234,9 +1234,9 @@ def parse_query(dsl: Optional[dict]) -> Query:
 
         return parse_span_query(qtype, body)
     if qtype in ("nested", "has_child", "has_parent", "top_children"):
-        raise QueryParsingException(
-            f"[{qtype}] is not implemented yet (block-join over doc ranges lands in R2)"
-        )
+        from elasticsearch_tpu.search.joins import parse_join_query
+
+        return parse_join_query(qtype, body)
     if qtype in ("geo_distance", "geo_bounding_box", "geo_polygon", "geo_shape"):
         from elasticsearch_tpu.search.geo import parse_geo_query
 

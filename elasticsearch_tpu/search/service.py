@@ -72,6 +72,9 @@ class ShardSearcher:
                     extra_k: int = 0) -> QueryPhaseResult:
         jnp = _jnp()
         query = parse_query(body.get("query"))
+        from elasticsearch_tpu.search.joins import prepare_tree
+
+        prepare_tree(query, self.segments, self.mappings, self.analysis, global_stats)
         aggs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         size = int(body.get("size", 10))
         frm = int(body.get("from", 0))
@@ -85,9 +88,15 @@ class ShardSearcher:
         max_score = float("-inf")
         agg_partials: List[dict] = []
         for seg in self.segments:
-            ctx = SegmentContext(seg, self.mappings, self.analysis, global_stats)
+            ctx = SegmentContext(seg, self.mappings, self.analysis, global_stats,
+                                 all_segments=self.segments)
             scores, mask = query.score_or_mask(ctx)
             mask = mask & seg.live
+            if seg.has_nested:
+                # top-level hits are root docs only; nested children are
+                # reachable solely through nested queries/aggs (reference:
+                # Lucene block-join — nested docs hidden from root searches)
+                mask = mask & seg.roots_dev
             if min_score is not None:
                 mask = mask & (scores >= float(min_score))
             total += int(jnp.sum(mask.astype(jnp.int32)))
@@ -193,7 +202,61 @@ class ShardSearcher:
                 ctx = SegmentContext(d.seg, self.mappings, self.analysis)
                 hit["highlight"] = self._highlight(ctx, query, src, hl)
             hits.append(hit)
+        self._attach_inner_hits(query, docs, hits, index_name)
         return hits
+
+    def _attach_inner_hits(self, query, docs: List[ShardDoc], hits: List[dict],
+                           index_name: str) -> None:
+        """inner_hits for nested queries (reference: search/fetch/innerhits/
+        InnerHitsFetchSubPhase.java): per root hit, the matching children of
+        the nested path, their _source extracted from the root's source."""
+        from elasticsearch_tpu.search.joins import collect_nested_inner_hits
+
+        nq_list = collect_nested_inner_hits(query)
+        if not nq_list:
+            return
+        sel_cache: Dict[Tuple[int, int], np.ndarray] = {}
+        for nq_i, nq in enumerate(nq_list):
+            name = nq.inner_hits.get("name", nq.path)
+            ih_size = int(nq.inner_hits.get("size", 3))
+            ih_from = int(nq.inner_hits.get("from", 0))
+            for d, hit in zip(docs, hits):
+                seg = d.seg
+                if not seg.has_nested or nq.path not in seg.nested_paths:
+                    continue
+                key = (nq_i, seg.seg_id)
+                cached = sel_cache.get(key)
+                if cached is None:
+                    ctx = SegmentContext(seg, self.mappings, self.analysis)
+                    sel, child_scores = nq.child_selection(ctx)
+                    cached = (np.asarray(sel), np.asarray(child_scores))
+                    sel_cache[key] = cached
+                sel_np, scores_np = cached
+                kids = np.nonzero(sel_np[: seg.num_docs]
+                                  & (seg.root_id_host[: seg.num_docs] == d.local_id))[0]
+                if kids.size == 0:
+                    continue
+                order = kids[np.argsort(-scores_np[kids], kind="stable")]
+                window = order[ih_from : ih_from + ih_size]
+                root_src = seg.sources[d.local_id] or {}
+                child_hits = []
+                for k in window:
+                    ordn = int(seg.nested_ord_host[k])
+                    sub = _nested_sub_source(root_src, nq.path, ordn)
+                    child_hits.append({
+                        "_index": index_name,
+                        "_id": hit["_id"],
+                        "_nested": {"field": nq.path, "offset": ordn},
+                        "_score": float(scores_np[k]),
+                        "_source": sub,
+                    })
+                hit.setdefault("inner_hits", {})[name] = {
+                    "hits": {
+                        "total": int(kids.size),
+                        "max_score": float(scores_np[order[0]]),
+                        "hits": child_hits,
+                    }
+                }
 
     def _script_field(self, d: ShardDoc, spec):
         from elasticsearch_tpu.search.function_score import doc_resolver
@@ -234,11 +297,17 @@ class ShardSearcher:
     def count(self, body: dict) -> int:
         jnp = _jnp()
         query = parse_query(body.get("query"))
+        from elasticsearch_tpu.search.joins import prepare_tree
+
+        prepare_tree(query, self.segments, self.mappings, self.analysis)
         total = 0
         for seg in self.segments:
             ctx = SegmentContext(seg, self.mappings, self.analysis)
             _, mask = query.execute(ctx)
-            total += int(jnp.sum((mask & seg.live).astype(jnp.int32)))
+            mask = mask & seg.live
+            if seg.has_nested:
+                mask = mask & seg.roots_dev
+            total += int(jnp.sum(mask.astype(jnp.int32)))
         return total
 
 
@@ -346,6 +415,19 @@ def clear_scroll(scroll_id: str) -> bool:
 # ---------------------------------------------------------------------------
 # source filtering (fetch/source/FetchSourceSubPhase semantics)
 # ---------------------------------------------------------------------------
+
+def _nested_sub_source(root_src: dict, path: str, ordn: int):
+    """Extract the ordn-th object under a (possibly dotted) nested path from
+    the root document's _source."""
+    cur: Any = root_src
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, list):
+        return cur[ordn] if 0 <= ordn < len(cur) else None
+    return cur if ordn == 0 else None
+
 
 def _filter_source(src: Optional[dict], spec) -> Optional[dict]:
     import fnmatch

@@ -1,0 +1,236 @@
+"""Nested (block-join) and parent/child join tests.
+
+Reference behaviors: NestedQueryBuilder (per-object match semantics — the
+whole point of nested vs object arrays), inner_hits, nested/reverse_nested
+aggregations, HasChild/HasParentQueryBuilder with score modes.
+"""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture()
+def nested_svc():
+    s = IndexService("posts", mappings_json={"properties": {
+        "title": {"type": "text"},
+        "comments": {"type": "nested", "properties": {
+            "author": {"type": "keyword"},
+            "stars": {"type": "integer"},
+            "text": {"type": "text"},
+        }},
+    }})
+    s.index_doc("1", {"title": "post one", "comments": [
+        {"author": "alice", "stars": 5, "text": "great stuff"},
+        {"author": "bob", "stars": 1, "text": "terrible"},
+    ]})
+    s.index_doc("2", {"title": "post two", "comments": [
+        {"author": "alice", "stars": 1, "text": "meh"},
+        {"author": "carol", "stars": 5, "text": "wonderful"},
+    ]})
+    s.index_doc("3", {"title": "post three no comments"})
+    for sh in s.shards:
+        sh.refresh()
+    yield s
+    s.close()
+
+
+def ids(resp):
+    return sorted(h["_id"] for h in resp["hits"]["hits"])
+
+
+def test_nested_per_object_semantics(nested_svc):
+    # alice AND stars=5 must match within the SAME comment: only doc 1.
+    # (A flattened object mapping would also wrongly match doc 2.)
+    q = {"nested": {"path": "comments", "query": {"bool": {"must": [
+        {"term": {"comments.author": "alice"}},
+        {"term": {"comments.stars": 5}},
+    ]}}}}
+    assert ids(nested_svc.search({"query": q})) == ["1"]
+
+
+def test_nested_children_hidden_from_toplevel(nested_svc):
+    resp = nested_svc.search({"query": {"match_all": {}}, "size": 50})
+    assert ids(resp) == ["1", "2", "3"]
+    assert resp["hits"]["total"] == 3
+    assert nested_svc.count({"query": {"match_all": {}}})["count"] == 3
+
+
+def test_nested_score_modes(nested_svc):
+    base = {"path": "comments", "query": {"match": {"comments.text": "great wonderful"}}}
+    for mode in ("avg", "sum", "max", "min", "none"):
+        q = {"nested": dict(base, score_mode=mode)}
+        resp = nested_svc.search({"query": q})
+        assert resp["hits"]["total"] == 2
+        if mode == "none":
+            # filter semantics: constant score = boost (1.0), like ES's
+            # ToParentBlockJoinQuery under ScoreMode.None
+            assert all(h["_score"] == 1.0 for h in resp["hits"]["hits"])
+        else:
+            assert all(h["_score"] > 0 for h in resp["hits"]["hits"])
+
+
+def test_nested_inner_hits(nested_svc):
+    q = {"nested": {"path": "comments",
+                    "query": {"term": {"comments.author": "alice"}},
+                    "inner_hits": {}}}
+    resp = nested_svc.search({"query": q})
+    assert resp["hits"]["total"] == 2
+    for h in resp["hits"]["hits"]:
+        ih = h["inner_hits"]["comments"]["hits"]
+        assert ih["total"] == 1
+        inner = ih["hits"][0]
+        assert inner["_source"]["author"] == "alice"
+        assert inner["_nested"]["field"] == "comments"
+    doc1 = next(h for h in resp["hits"]["hits"] if h["_id"] == "1")
+    assert doc1["inner_hits"]["comments"]["hits"]["hits"][0]["_nested"]["offset"] == 0
+
+
+def test_nested_agg_and_reverse(nested_svc):
+    body = {"size": 0, "aggs": {"c": {"nested": {"path": "comments"}, "aggs": {
+        "by_author": {"terms": {"field": "comments.author"}, "aggs": {
+            "back": {"reverse_nested": {}}}},
+        "avg_stars": {"avg": {"field": "comments.stars"}},
+    }}}}
+    resp = nested_svc.search(body)
+    agg = resp["aggregations"]["c"]
+    assert agg["doc_count"] == 4  # 4 comments across live roots
+    assert agg["avg_stars"]["value"] == pytest.approx(3.0)
+    buckets = {b["key"]: b for b in agg["by_author"]["buckets"]}
+    assert buckets["alice"]["doc_count"] == 2
+    assert buckets["alice"]["back"]["doc_count"] == 2  # two distinct posts
+
+
+def test_nested_delete_cascades(nested_svc):
+    nested_svc.delete_doc("1")
+    for sh in nested_svc.shards:
+        sh.refresh()
+    q = {"nested": {"path": "comments", "query": {"term": {"comments.author": "bob"}}}}
+    assert ids(nested_svc.search({"query": q})) == []
+    # agg no longer counts doc1's comments
+    body = {"size": 0, "aggs": {"c": {"nested": {"path": "comments"}}}}
+    assert nested_svc.search(body)["aggregations"]["c"]["doc_count"] == 2
+
+
+def test_nested_survives_merge(nested_svc):
+    for sh in nested_svc.shards:
+        sh.engine.merge()
+    q = {"nested": {"path": "comments", "query": {"bool": {"must": [
+        {"term": {"comments.author": "alice"}}, {"term": {"comments.stars": 5}}]}}}}
+    assert ids(nested_svc.search({"query": q})) == ["1"]
+
+
+def test_multilevel_nested_path_joins_to_root():
+    s = IndexService("deep", mappings_json={"properties": {
+        "a": {"type": "nested", "properties": {
+            "name": {"type": "keyword"},
+            "b": {"type": "nested", "properties": {"v": {"type": "integer"}}},
+        }},
+    }})
+    s.index_doc("1", {"a": [{"name": "x", "b": [{"v": 1}, {"v": 2}]},
+                            {"name": "y", "b": [{"v": 3}]}]})
+    s.index_doc("2", {"a": [{"name": "z", "b": [{"v": 9}]}]})
+    for sh in s.shards:
+        sh.refresh()
+    # direct deep path at top level joins straight to the ROOT doc
+    q = {"nested": {"path": "a.b", "query": {"term": {"a.b.v": 3}}}}
+    assert ids(s.search({"query": q})) == ["1"]
+    # nested-inside-nested: same-object semantics at the intermediate level
+    q = {"nested": {"path": "a", "query": {"bool": {"must": [
+        {"term": {"a.name": "x"}},
+        {"nested": {"path": "a.b", "query": {"term": {"a.b.v": 2}}}}]}}}}
+    assert ids(s.search({"query": q})) == ["1"]
+    q = {"nested": {"path": "a", "query": {"bool": {"must": [
+        {"term": {"a.name": "y"}},
+        {"nested": {"path": "a.b", "query": {"term": {"a.b.v": 2}}}}]}}}}
+    assert ids(s.search({"query": q})) == []  # v=2 lives under x, not y
+    # chained nested aggs + reverse_nested back to root
+    body = {"size": 0, "aggs": {"l1": {"nested": {"path": "a"}, "aggs": {
+        "l2": {"nested": {"path": "a.b"}, "aggs": {
+            "back": {"reverse_nested": {}}}}}}}}
+    agg = s.search(body)["aggregations"]["l1"]
+    assert agg["doc_count"] == 3
+    assert agg["l2"]["doc_count"] == 4
+    assert agg["l2"]["back"]["doc_count"] == 2
+    s.close()
+
+
+def test_bulk_preserves_parent_and_update_preserves_join():
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    n.indices["shop2"] = IndexService("shop2")
+    n.bulk([
+        {"index": {"_index": "shop2", "_type": "store", "_id": "p1"}},
+        {"name": "main store"},
+        {"index": {"_index": "shop2", "_type": "product", "_id": "c1", "parent": "p1"}},
+        {"item": "green shoe"},
+    ])
+    svc = n.indices["shop2"]
+    for sh in svc.shards:
+        sh.refresh()
+    q = {"has_child": {"type": "product", "query": {"match": {"item": "green"}}}}
+    assert ids(svc.search({"query": q})) == ["p1"]
+    # partial update must not sever the parent link
+    svc.update_doc("c1", {"doc": {"price": 10}}, routing="p1")
+    for sh in svc.shards:
+        sh.refresh()
+    q = {"has_child": {"type": "product", "query": {"term": {"price": 10}}}}
+    assert ids(svc.search({"query": q})) == ["p1"]
+    svc.close()
+
+
+def test_has_child_inside_filter_agg(pc_svc):
+    body = {"size": 0, "aggs": {"f": {"filter": {
+        "has_child": {"type": "product", "query": {"match": {"item": "shoe"}}}}}}}
+    resp = pc_svc.search(body)
+    assert resp["aggregations"]["f"]["doc_count"] == 1  # p1
+
+
+@pytest.fixture()
+def pc_svc():
+    s = IndexService("shop", settings={"index": {"number_of_shards": 2}})
+    s.index_doc("p1", {"name": "store one"}, doc_type="store")
+    s.index_doc("p2", {"name": "store two"}, doc_type="store")
+    # children routed to the parent's shard via routing=parent
+    s.index_doc("c1", {"item": "red shoe"}, doc_type="product", parent="p1", routing="p1")
+    s.index_doc("c2", {"item": "blue shoe"}, doc_type="product", parent="p1", routing="p1")
+    s.index_doc("c3", {"item": "red hat"}, doc_type="product", parent="p2", routing="p2")
+    for sh in s.shards:
+        sh.refresh()
+    yield s
+    s.close()
+
+
+def test_has_child(pc_svc):
+    q = {"has_child": {"type": "product", "query": {"match": {"item": "red"}}}}
+    assert ids(pc_svc.search({"query": q})) == ["p1", "p2"]
+    q = {"has_child": {"type": "product", "query": {"match": {"item": "blue"}}}}
+    assert ids(pc_svc.search({"query": q})) == ["p1"]
+
+
+def test_has_child_min_children(pc_svc):
+    q = {"has_child": {"type": "product", "query": {"match": {"item": "shoe"}},
+                       "min_children": 2}}
+    assert ids(pc_svc.search({"query": q})) == ["p1"]
+
+
+def test_has_child_score_mode_sum(pc_svc):
+    q = {"has_child": {"type": "product", "query": {"match": {"item": "shoe"}},
+                       "score_mode": "sum"}}
+    resp = pc_svc.search({"query": q})
+    assert [h["_id"] for h in resp["hits"]["hits"]] == ["p1"]
+    assert resp["hits"]["hits"][0]["_score"] > 0
+
+
+def test_has_parent(pc_svc):
+    q = {"has_parent": {"parent_type": "store", "query": {"match": {"name": "one"}}}}
+    assert ids(pc_svc.search({"query": q})) == ["c1", "c2"]
+
+
+def test_children_agg(pc_svc):
+    body = {"size": 0,
+            "query": {"term": {"_type": "store"}},
+            "aggs": {"kids": {"children": {"type": "product"}}}}
+    resp = pc_svc.search(body)
+    assert resp["aggregations"]["kids"]["doc_count"] == 3
