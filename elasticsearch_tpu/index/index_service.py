@@ -40,6 +40,30 @@ class IndexService:
             for i in range(self.num_shards)
         ]
         self.closed = False
+        self._percolator = None
+        self.warmers: Dict[str, dict] = {}
+        if data_path:
+            # gateway recovery (reference: gateway/GatewayService +
+            # IndexShardGateway): replay any existing translog on open
+            self.recover()
+
+    def recover(self):
+        from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
+
+        for shard in self.shards:
+            shard.recover()
+            # rebuild the in-memory percolator registry from recovered docs
+            for doc_id, loc in shard.engine._locations.items():
+                if loc.deleted or loc.doc_type != PERCOLATOR_TYPE:
+                    continue
+                got = shard.engine.get(doc_id)
+                if got and got.get("_source"):
+                    try:
+                        self.percolator.register(doc_id, got["_source"])
+                    except Exception:
+                        # a legacy/corrupt percolator doc must not brick the
+                        # whole index on open; it just doesn't participate
+                        pass
 
     def _validate_analyzers(self, mappings: Mappings):
         """Reject mappings naming analyzers the registry can't build —
@@ -74,7 +98,16 @@ class IndexService:
 
             doc_id = uuid.uuid4().hex[:20]
         shard = self.route(doc_id, routing)
+        from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
+
+        is_perc = kw.get("doc_type") == PERCOLATOR_TYPE
+        if is_perc:
+            # validate BEFORE persisting: an unparseable percolator query
+            # must never reach the translog (it would poison recovery)
+            self.percolator.validate(source)
         rid, version, created = shard.engine.index(doc_id, source, routing=routing, **kw)
+        if is_perc:
+            self.percolator.register(rid, source)
         return {
             "_index": self.name,
             "_id": rid,
@@ -95,6 +128,8 @@ class IndexService:
     def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kw) -> dict:
         shard = self.route(doc_id, routing)
         version = shard.engine.delete(doc_id, **kw)
+        if self._percolator is not None:
+            self._percolator.unregister(str(doc_id))
         return {
             "_index": self.name,
             "_id": doc_id,
@@ -136,6 +171,18 @@ class IndexService:
     def refresh(self):
         for s in self.shards:
             s.refresh()
+        self._run_warmers()
+
+    def _run_warmers(self):
+        """Execute registered warmers against the fresh segments (reference:
+        search/warmer + IndicesWarmer: warm new searchers on refresh). For a
+        TPU segment 'warming' = triggering the XLA compile + building lazy
+        acceleration structures (dense impact blocks) before user traffic."""
+        for name, body in list(getattr(self, "warmers", {}).items()):
+            try:
+                self.search(body or {"query": {"match_all": {}}})
+            except Exception:
+                pass  # a broken warmer must never fail the refresh
 
     def flush(self):
         for s in self.shards:
@@ -162,6 +209,36 @@ class IndexService:
         from elasticsearch_tpu.search.suggest import execute_suggest
 
         return execute_suggest(self.shards, body or {}, self.analysis)
+
+    # -- percolator ------------------------------------------------------------
+
+    @property
+    def percolator(self):
+        from elasticsearch_tpu.search.percolator import PercolatorRegistry
+
+        if self._percolator is None:
+            self._percolator = PercolatorRegistry()
+        return self._percolator
+
+    def percolate(self, body: dict) -> dict:
+        """Percolate a doc (reference: rest/action/percolate/RestPercolateAction
+        → PercolatorService.percolate)."""
+        from elasticsearch_tpu.search.percolator import percolate as _perc
+
+        doc = (body or {}).get("doc")
+        if doc is None:
+            raise DocumentMissingException(self.name, "_percolate requires [doc]")
+        matches, _total = _perc(self.percolator, [doc], self.mappings, self.analysis)
+        full = matches[0]
+        size = (body or {}).get("size")
+        listed = full if size is None else full[: int(size)]
+        return {
+            "took": 0,
+            "_shards": {"total": self.num_shards, "successful": self.num_shards,
+                        "failed": 0},
+            "total": len(full),  # total matched, even when size truncates
+            "matches": [{"_index": self.name, "_id": qid} for qid in listed],
+        }
 
     def count(self, body: dict) -> dict:
         total = sum(s.searcher.count(body or {}) for s in self.shards)

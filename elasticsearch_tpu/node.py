@@ -30,6 +30,9 @@ class Node:
         self.name = name
         self.data_path = data_path
         self.indices: Dict[str, IndexService] = {}
+        # stored search templates (reference keeps these in the .scripts
+        # index; node-local registry here)
+        self.search_templates: Dict[str, Any] = {}
         self.cluster_state = ClusterState(cluster_name)
         self.cluster_state.add_node(DiscoveryNode(self.node_id, name), master=True)
 

@@ -183,7 +183,17 @@ def _register_all(rc: RestController):
     add("POST", "/{index}/_msearch", _msearch_index)
     add("POST", "/_search/scroll", _scroll)
     add("DELETE", "/_search/scroll", _clear_scroll)
-    add("GET", "/{index}/_search/template", _search)  # template-lite passthrough
+    add("GET", "/{index}/_search/template", _search_template)
+    add("POST", "/{index}/_search/template", _search_template)
+    add("POST", "/_render/template", _render_template_ep)
+    add("PUT", "/_search/template/{id}", _put_search_template)
+    add("GET", "/_search/template/{id}", _get_search_template)
+    add("DELETE", "/_search/template/{id}", _delete_search_template)
+    add("PUT", "/{index}/_warmer/{name}", _put_warmer)
+    add("PUT", "/{index}/_warmers/{name}", _put_warmer)
+    add("GET", "/{index}/_warmer", _get_warmers)
+    add("GET", "/{index}/_warmer/{name}", _get_warmer)
+    add("DELETE", "/{index}/_warmer/{name}", _delete_warmer)
     add("POST", "/{index}/_validate/query", _validate_query)
     add("GET", "/{index}/_validate/query", _validate_query)
     add("POST", "/{index}/_explain/{id}", _explain)
@@ -191,6 +201,10 @@ def _register_all(rc: RestController):
     add("GET", "/{index}/_field_stats", _field_stats)
     add("POST", "/{index}/_field_stats", _field_stats)
     add("GET", "/{index}/_termvectors/{id}", _termvectors)
+    add("GET", "/{index}/{type}/_percolate", _percolate)
+    add("POST", "/{index}/{type}/_percolate", _percolate)
+    add("GET", "/{index}/{type}/{id}/_percolate", _percolate_existing)
+    add("POST", "/{index}/{type}/{id}/_percolate", _percolate_existing)
     add("POST", "/_suggest", _suggest_all)
     add("GET", "/_suggest", _suggest_all)
     add("POST", "/{index}/_suggest", _suggest)
@@ -620,6 +634,99 @@ def _explain(n: Node, p, b, index: str, id: str):
                 },
             }
     return 404, {"_index": index, "_id": id, "matched": False}
+
+
+def _resolve_template(n: Node, body: dict):
+    from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+    tmpl = body.get("inline", body.get("template"))
+    if isinstance(tmpl, dict) and ("inline" in tmpl or "id" in tmpl):
+        body = {**body, **tmpl}
+        tmpl = tmpl.get("inline")
+    if tmpl is None and "id" in body:
+        tmpl = n.search_templates.get(body["id"])
+        if tmpl is None:
+            raise ElasticsearchTpuException(f"search template [{body['id']}] not found")
+    if tmpl is None:
+        raise ElasticsearchTpuException("search template requires [inline] or [id]")
+    return tmpl, body.get("params")
+
+
+def _search_template(n: Node, p, b, index: str):
+    from elasticsearch_tpu.search.templates import render_template
+
+    body = _json(b)
+    tmpl, params = _resolve_template(n, body)
+    rendered = render_template(tmpl, params)
+    return _search(n, p, json.dumps(rendered).encode(), index)
+
+
+def _render_template_ep(n: Node, p, b):
+    from elasticsearch_tpu.search.templates import render_template
+
+    body = _json(b)
+    tmpl, params = _resolve_template(n, body)
+    return 200, {"template_output": render_template(tmpl, params)}
+
+
+def _put_search_template(n: Node, p, b, id: str):
+    body = _json(b)
+    n.search_templates[id] = body.get("template", body)
+    return 200, {"acknowledged": True, "_id": id}
+
+
+def _get_search_template(n: Node, p, b, id: str):
+    t = n.search_templates.get(id)
+    if t is None:
+        return 404, {"_id": id, "found": False}
+    return 200, {"_id": id, "found": True, "template": t}
+
+
+def _delete_search_template(n: Node, p, b, id: str):
+    found = n.search_templates.pop(id, None) is not None
+    return (200 if found else 404), {"_id": id, "found": found}
+
+
+def _put_warmer(n: Node, p, b, index: str, name: str):
+    svc = n.get_index(index)
+    svc.warmers[name] = _json(b)
+    return 200, {"acknowledged": True}
+
+
+def _get_warmers(n: Node, p, b, index: str):
+    svc = n.get_index(index)
+    return 200, {index: {"warmers": {
+        k: {"source": v} for k, v in svc.warmers.items()}}}
+
+
+def _get_warmer(n: Node, p, b, index: str, name: str):
+    svc = n.get_index(index)
+    if name not in svc.warmers:
+        return 404, {}
+    return 200, {index: {"warmers": {name: {"source": svc.warmers[name]}}}}
+
+
+def _delete_warmer(n: Node, p, b, index: str, name: str):
+    svc = n.get_index(index)
+    found = svc.warmers.pop(name, None) is not None
+    return (200 if found else 404), {"acknowledged": found}
+
+
+def _percolate(n: Node, p, b, index: str, type: str):
+    svc = n.get_index(index)
+    return 200, svc.percolate(_json(b))
+
+
+def _percolate_existing(n: Node, p, b, index: str, type: str, id: str):
+    """Percolate an already-indexed doc (RestPercolateAction existing-doc
+    form: GET /{index}/{type}/{id}/_percolate)."""
+    svc = n.get_index(index)
+    got = svc.get_doc(id, routing=p.get("routing"))
+    if not got.get("found"):
+        return 404, {"_index": index, "_id": id, "found": False}
+    body = _json(b)
+    body["doc"] = got["_source"]
+    return 200, svc.percolate(body)
 
 
 def _suggest(n: Node, p, b, index: str):

@@ -1,0 +1,99 @@
+"""Percolator: reverse search — match a document against registered queries.
+
+Reference: org/elasticsearch/percolator/PercolatorService.java — queries are
+registered by indexing docs of type ``.percolator`` whose source carries a
+"query" field; percolating a doc builds a single-doc in-memory Lucene index
+(SingleDocumentPercolatorIndex / MemoryIndex) and runs every registered
+query against it, collecting the ids of those that match (QueryCollector).
+
+TPU-native reshape: the candidate doc is parsed through the same analysis
+chain and frozen into a minimal TpuSegment (the device-array analogue of
+MemoryIndex), then each registered query executes as the usual whole-segment
+program and we read bit 0 of the mask. Multiple docs percolate as ONE
+segment (MultiDocumentPercolatorIndex equivalent) so every query runs once
+per batch, not once per doc — the batched form is the TPU-friendly one.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.index.doc_parser import DocumentParser
+from elasticsearch_tpu.index.segment import SegmentBuilder
+from elasticsearch_tpu.search.context import SegmentContext
+from elasticsearch_tpu.search.queries import parse_query
+from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+PERCOLATOR_TYPE = ".percolator"
+
+
+class PercolatorRegistry:
+    """Registered queries of one index (reference: PercolatorQueriesRegistry).
+
+    Queries live as ordinary docs of type .percolator; we keep a parsed-query
+    cache keyed by doc id, invalidated on re-registration."""
+
+    def __init__(self):
+        self._queries: Dict[str, Any] = {}  # id -> (raw dsl, parsed Query)
+
+    @staticmethod
+    def validate(source: dict):
+        """Parse the query WITHOUT registering — called before the doc is
+        persisted so an invalid percolator doc never reaches the translog."""
+        if not isinstance(source, dict) or "query" not in source:
+            raise ElasticsearchTpuException(
+                "percolator document requires a [query] field")
+        return parse_query(source["query"])
+
+    def register(self, doc_id: str, source: dict) -> None:
+        self._queries[doc_id] = (source["query"], self.validate(source))
+
+    def unregister(self, doc_id: str) -> None:
+        self._queries.pop(doc_id, None)
+
+    def __len__(self) -> int:
+        return len(self._queries)
+
+    def items(self):
+        return self._queries.items()
+
+
+def percolate(
+    registry: PercolatorRegistry,
+    docs: List[dict],
+    mappings,
+    analysis,
+) -> Tuple[List[List[str]], int]:
+    """Match each doc against every registered query.
+
+    Returns (matches_per_doc — FULL sorted lists, callers truncate for their
+    size param, total_queries_evaluated). All docs are frozen into one
+    segment; each registered query executes once over the batch.
+    """
+    if not len(registry):
+        return [[] for _ in docs], 0
+    parser = DocumentParser(mappings, analysis)
+    builder = SegmentBuilder(mappings)
+    for i, d in enumerate(docs):
+        builder.add(parser.parse(f"_percolate_{i}", d))
+    seg = builder.freeze()
+    if seg is None:
+        return [[] for _ in docs], 0
+    ctx = SegmentContext(seg, mappings, analysis)
+    n = len(docs)
+    # doc i landed at the local id of its ROOT doc (children precede roots)
+    locals_ = [seg.id_map[f"_percolate_{i}"] for i in range(n)]
+    matches: List[List[str]] = [[] for _ in range(n)]
+    for qid, (_raw, q) in registry.items():
+        try:
+            _, mask = q.execute(ctx)
+        except ElasticsearchTpuException:
+            continue  # a query referencing unmapped context never matches
+        m = np.asarray(mask)
+        for i, local in enumerate(locals_):
+            if m[local]:
+                matches[i].append(qid)
+    for row in matches:
+        row.sort()
+    return matches, len(registry)

@@ -1,0 +1,99 @@
+"""Rescore: re-rank the top-k window of query-phase results.
+
+Reference: org/elasticsearch/search/rescore/ — RescorePhase.java +
+QueryRescorer.java: after the query phase collects window_size top docs,
+the rescore query runs over just those docs and the final score combines
+original and rescore scores via score_mode (total/multiply/avg/max/min)
+weighted by query_weight / rescore_query_weight.
+
+TPU execution: the rescore query compiles to the same whole-segment program
+as any query; we execute it per segment and gather the window docs' scores
+from the dense score vector (no special doc-at-a-time path needed). Cost is
+one extra program per segment that has window docs — the window gather is
+free compared to the scoring itself.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.search.queries import parse_query
+from elasticsearch_tpu.utils.errors import SearchParseException
+
+
+def parse_rescore(spec) -> List[dict]:
+    """Normalize the rescore body: a dict or list of
+    {"window_size": N, "query": {"rescore_query": {...}, ...}}."""
+    if spec is None:
+        return []
+    specs = spec if isinstance(spec, list) else [spec]
+    out = []
+    for s in specs:
+        q = s.get("query")
+        if not isinstance(q, dict) or "rescore_query" not in q:
+            raise SearchParseException("rescore requires [query][rescore_query]")
+        out.append({
+            "window_size": int(s.get("window_size", 10)),
+            "rescore_query": q["rescore_query"],
+            "query_weight": float(q.get("query_weight", 1.0)),
+            "rescore_query_weight": float(q.get("rescore_query_weight", 1.0)),
+            "score_mode": q.get("score_mode", "total"),
+        })
+    return out
+
+
+def _combine(orig: float, resc: float, matched: bool, spec: dict) -> float:
+    qw, rw = spec["query_weight"], spec["rescore_query_weight"]
+    if not matched:
+        # docs not matching the rescore query keep their weighted original
+        # score (QueryRescorer behavior)
+        return orig * qw
+    mode = spec["score_mode"]
+    a, b = orig * qw, resc * rw
+    if mode == "total":
+        return a + b
+    if mode == "multiply":
+        return a * b  # (orig*query_weight) * (rescore*rescore_query_weight)
+    if mode == "avg":
+        return (a + b) / 2.0
+    if mode == "max":
+        return max(a, b)
+    if mode == "min":
+        return min(a, b)
+    raise SearchParseException(f"rescore score_mode [{mode}] invalid")
+
+
+def apply_rescore(docs, rescore_specs: List[dict], mappings, analysis,
+                  segments=None) -> None:
+    """Mutate ShardDoc list in place: re-rank the top window per spec.
+
+    ``docs`` must be sorted by current score descending (query-phase order).
+    Chained rescorers apply in sequence over the (possibly re-ranked)
+    window, same as RescorePhase iterating rescore contexts.
+    """
+    from elasticsearch_tpu.search.context import SegmentContext
+    from elasticsearch_tpu.search.joins import prepare_tree
+
+    for spec in rescore_specs:
+        window = docs[: spec["window_size"]]
+        if not window:
+            continue
+        q = parse_query(spec["rescore_query"])
+        if segments is not None:
+            prepare_tree(q, segments, mappings, analysis)
+        # group window docs by segment: one program execution per segment
+        by_seg: Dict[int, List] = {}
+        for d in window:
+            by_seg.setdefault(d.seg.seg_id, []).append(d)
+        for seg_docs in by_seg.values():
+            seg = seg_docs[0].seg
+            ctx = SegmentContext(seg, mappings, analysis)
+            scores, mask = q.score_or_mask(ctx)
+            sc = np.asarray(scores)
+            mk = np.asarray(mask)
+            for d in seg_docs:
+                d.score = _combine(d.score, float(sc[d.local_id]),
+                                   bool(mk[d.local_id]), spec)
+        window.sort(key=lambda d: (-d.score, d.seg.seg_id, d.local_id))
+        docs[: spec["window_size"]] = window

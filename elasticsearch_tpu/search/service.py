@@ -82,6 +82,14 @@ class ShardSearcher:
         min_score = body.get("min_score")
         sort_spec = _parse_sort(body.get("sort"))
         search_after = body.get("search_after")
+        rescore_specs = []
+        if body.get("rescore") and not sort_spec:
+            from elasticsearch_tpu.search.rescore import parse_rescore
+
+            rescore_specs = parse_rescore(body["rescore"])
+            # candidate pool must cover the largest rescore window
+            # (reference: query phase collects max(window_size, from+size))
+            k = min(max([k] + [s["window_size"] for s in rescore_specs]), 10_000)
 
         docs: List[ShardDoc] = []
         total = 0
@@ -125,6 +133,13 @@ class ShardSearcher:
         else:
             docs.sort(key=lambda d: (-d.score, d.seg.seg_id, d.local_id))
         docs = docs[:k]
+        if rescore_specs:
+            from elasticsearch_tpu.search.rescore import apply_rescore
+
+            apply_rescore(docs, rescore_specs, self.mappings, self.analysis,
+                          segments=self.segments)
+            docs = docs[: min(max(size + frm + extra_k, 1), 10_000)]
+            max_score = max((d.score for d in docs), default=float("-inf"))
         merged_aggs = agg_partials if aggs else None
         return QueryPhaseResult(
             docs=docs,

@@ -1,0 +1,74 @@
+"""Percolator tests (reference: percolator/PercolatorService + rest-api-spec
+percolate tests)."""
+import pytest
+
+from elasticsearch_tpu.index.index_service import IndexService
+
+
+@pytest.fixture()
+def svc():
+    s = IndexService("alerts", mappings_json={"properties": {
+        "message": {"type": "text"},
+        "level": {"type": "keyword"},
+        "value": {"type": "long"},
+    }})
+    s.index_doc("q-error", {"query": {"match": {"message": "error"}}},
+                doc_type=".percolator")
+    s.index_doc("q-critical", {"query": {"bool": {"must": [
+        {"match": {"message": "error"}},
+        {"term": {"level": "critical"}}]}}}, doc_type=".percolator")
+    s.index_doc("q-range", {"query": {"range": {"value": {"gte": 100}}}},
+                doc_type=".percolator")
+    yield s
+    s.close()
+
+
+def test_percolate_matches_subset(svc):
+    r = svc.percolate({"doc": {"message": "an error occurred", "level": "info"}})
+    assert r["total"] == 1
+    assert [m["_id"] for m in r["matches"]] == ["q-error"]
+
+    r = svc.percolate({"doc": {"message": "error!", "level": "critical", "value": 250}})
+    assert sorted(m["_id"] for m in r["matches"]) == ["q-critical", "q-error", "q-range"]
+
+
+def test_percolate_no_match(svc):
+    r = svc.percolate({"doc": {"message": "all fine", "level": "info"}})
+    assert r["total"] == 0 and r["matches"] == []
+
+
+def test_percolator_unregister_on_delete(svc):
+    svc.delete_doc("q-error")
+    r = svc.percolate({"doc": {"message": "error"}})
+    assert [m["_id"] for m in r["matches"]] == []
+
+
+def test_percolator_reregister_overwrites(svc):
+    svc.index_doc("q-error", {"query": {"match": {"message": "failure"}}},
+                  doc_type=".percolator")
+    r = svc.percolate({"doc": {"message": "error"}})
+    assert r["total"] == 0
+    r = svc.percolate({"doc": {"message": "failure"}})
+    assert [m["_id"] for m in r["matches"]] == ["q-error"]
+
+
+def test_percolate_batch_multiple_docs(svc):
+    from elasticsearch_tpu.search.percolator import percolate
+
+    docs = [{"message": "error"}, {"message": "ok"}, {"value": 500}]
+    matches, total = percolate(svc.percolator, docs, svc.mappings, svc.analysis)
+    assert total == 3
+    assert matches[0] == ["q-error"]
+    assert matches[1] == []
+    assert matches[2] == ["q-range"]
+
+
+def test_percolator_recovers_from_translog(tmp_path):
+    s = IndexService("recov", data_path=str(tmp_path))
+    s.index_doc("q1", {"query": {"match": {"msg": "boom"}}}, doc_type=".percolator")
+    s.index_doc("d1", {"msg": "hello"})
+    s.close()
+    s2 = IndexService("recov", data_path=str(tmp_path))
+    r = s2.percolate({"doc": {"msg": "boom town"}})
+    assert [m["_id"] for m in r["matches"]] == ["q1"]
+    s2.close()

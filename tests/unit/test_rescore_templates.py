@@ -1,0 +1,148 @@
+"""Rescore, search template, and warmer tests (reference:
+search/rescore/QueryRescorer, script/mustache, search/warmer)."""
+import pytest
+
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.search.templates import render_template
+from elasticsearch_tpu.utils.errors import SearchParseException
+
+
+@pytest.fixture()
+def svc():
+    s = IndexService("r", mappings_json={"properties": {
+        "body": {"type": "text"},
+        "tag": {"type": "keyword"},
+        "rank": {"type": "long"},
+    }})
+    s.index_doc("1", {"body": "quick fox", "tag": "a", "rank": 1})
+    s.index_doc("2", {"body": "quick quick fox", "tag": "b", "rank": 2})
+    s.index_doc("3", {"body": "quick brown wolf", "tag": "a", "rank": 3})
+    for sh in s.shards:
+        sh.refresh()
+    yield s
+    s.close()
+
+
+def test_rescore_total_reorders_window(svc):
+    base = {"query": {"match": {"body": "quick"}}, "rescore": {
+        "window_size": 10,
+        "query": {
+            "rescore_query": {"term": {"tag": "a"}},
+            "query_weight": 0.0,
+            "rescore_query_weight": 10.0,
+        },
+    }}
+    resp = svc.search(base)
+    top2 = {h["_id"] for h in resp["hits"]["hits"][:2]}
+    assert top2 == {"1", "3"}  # tag:a docs boosted above the bm25 winner
+
+
+def test_rescore_multiply_keeps_nonmatching_scores(svc):
+    resp0 = svc.search({"query": {"match": {"body": "quick"}}})
+    orig = {h["_id"]: h["_score"] for h in resp0["hits"]["hits"]}
+    resp = svc.search({"query": {"match": {"body": "quick"}}, "rescore": {
+        "window_size": 10,
+        "query": {"rescore_query": {"term": {"tag": "b"}},
+                  "score_mode": "multiply"}}})
+    got = {h["_id"]: h["_score"] for h in resp["hits"]["hits"]}
+    # loose tolerance: the lazy dense-impact block may flip the BM25 path
+    # from scatter to matmul between searches (different fp rounding)
+    assert got["1"] == pytest.approx(orig["1"], rel=5e-2)  # non-matching unchanged
+    assert got["2"] == pytest.approx(orig["2"] * 1.0, rel=5e-2)  # term filter scores 1.0
+
+
+def test_rescore_window_limits_scope(svc):
+    # window of 1: only the top doc is rescored; others keep their order
+    resp = svc.search({"query": {"match": {"body": "quick"}}, "rescore": {
+        "window_size": 1,
+        "query": {"rescore_query": {"term": {"tag": "a"}},
+                  "query_weight": 0.0, "rescore_query_weight": 5.0}}})
+    assert len(resp["hits"]["hits"]) == 3
+
+
+def test_render_template_scalars_and_tojson():
+    out = render_template(
+        {"query": {"match": {"{{field}}": "{{value}}"}}, "size": "{{size}}"},
+        {"field": "body", "value": "quick fox", "size": 5})
+    assert out == {"query": {"match": {"body": "quick fox"}}, "size": 5}
+
+    out = render_template(
+        '{"query": {"terms": {"tag": "{{#toJson}}tags{{/toJson}}"}}}',
+        {"tags": ["a", "b"]})
+    assert out == {"query": {"terms": {"tag": ["a", "b"]}}}
+
+
+def test_render_template_missing_param_raises():
+    with pytest.raises(SearchParseException):
+        render_template({"q": "{{nope}}"}, {})
+
+
+def test_template_search_end_to_end(svc):
+    body = render_template(
+        {"query": {"match": {"body": "{{q}}"}}}, {"q": "wolf"})
+    resp = svc.search(body)
+    assert [h["_id"] for h in resp["hits"]["hits"]] == ["3"]
+
+
+def test_rescore_window_wider_than_size():
+    s = IndexService("w")
+    for i in range(20):
+        s.index_doc(str(i), {"body": "common term", "rank": i})
+    s.index_doc("special", {"body": "common term", "rank": 99, "tag": "boost"})
+    for sh in s.shards:
+        sh.refresh()
+    # size=2 but window 50: the boosted doc must be promoted into the top 2
+    resp = s.search({"query": {"match": {"body": "common"}}, "size": 2,
+                     "rescore": {"window_size": 50, "query": {
+                         "rescore_query": {"term": {"tag": "boost"}},
+                         "query_weight": 1.0, "rescore_query_weight": 100.0}}})
+    assert len(resp["hits"]["hits"]) == 2
+    assert resp["hits"]["hits"][0]["_id"] == "special"
+    s.close()
+
+
+def test_render_template_literal_mustache_in_param():
+    # a param VALUE containing {{...}} is data, not a placeholder
+    out = render_template({"query": {"match": {"f": "{{q}}"}}},
+                          {"q": "literal {{x}} text"})
+    assert out == {"query": {"match": {"f": "literal {{x}} text"}}}
+
+
+def test_percolate_total_not_truncated_by_size():
+    s = IndexService("p")
+    for i in range(5):
+        s.index_doc(f"q{i}", {"query": {"match": {"m": "hit"}}},
+                    doc_type=".percolator")
+    r = s.percolate({"doc": {"m": "hit"}, "size": 2})
+    assert r["total"] == 5 and len(r["matches"]) == 2
+    s.close()
+
+
+def test_invalid_percolator_doc_rejected_before_persist(tmp_path):
+    import pytest as _pytest
+
+    from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+    s = IndexService("pp", data_path=str(tmp_path))
+    with _pytest.raises(ElasticsearchTpuException):
+        s.index_doc("bad", {"no_query": True}, doc_type=".percolator")
+    with _pytest.raises(ElasticsearchTpuException):
+        s.index_doc("bad2", {"query": {"frobnicate": {}}}, doc_type=".percolator")
+    s.close()
+    # recovery must come up clean — nothing bad was persisted
+    s2 = IndexService("pp", data_path=str(tmp_path))
+    assert len(s2.percolator) == 0
+    assert s2.num_docs == 0
+    s2.close()
+
+
+def test_warmers_run_on_refresh(svc):
+    svc.warmers["w1"] = {"query": {"match": {"body": "quick"}}}
+    svc.index_doc("4", {"body": "quick badger", "tag": "c", "rank": 4})
+    svc.refresh()  # must not raise; warmer pre-compiles the program
+    resp = svc.search({"query": {"match": {"body": "badger"}}})
+    assert resp["hits"]["total"] == 1
+    # broken warmer never fails refresh
+    svc.warmers["bad"] = {"query": {"frobnicate": {}}}
+    svc.index_doc("5", {"body": "more text"})
+    svc.refresh()
