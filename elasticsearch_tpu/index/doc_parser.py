@@ -62,6 +62,8 @@ class DocumentParser:
         if parent:
             parsed.doc_values["_parent"] = [str(parent)]
             parsed.meta["_parent"] = str(parent)
+        if routing:
+            parsed.meta["routing"] = str(routing)
         self._walk(source, "", parsed)
         return parsed
 

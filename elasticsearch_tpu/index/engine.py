@@ -75,6 +75,10 @@ class Engine:
         self._lock = threading.RLock()
         self.stats = EngineStats()
         self.merge_segment_count = merge_segment_count
+        from elasticsearch_tpu.index.merge import TieredMergePolicy
+
+        self.merge_policy = TieredMergePolicy(
+            segments_per_tier=merge_segment_count)
         self._auto_id = 0
 
     # -- write path ------------------------------------------------------------
@@ -110,8 +114,10 @@ class Engine:
             if op_type == "create" and exists:
                 raise VersionConflictException(self.mappings.meta.get("index", ""), doc_id, current, 0)
             if version is not None:
-                if version_type == "external":
-                    if loc is not None and version <= loc.version:
+                if version_type in ("external", "external_gt", "external_gte"):
+                    ok = (loc is None or version > loc.version
+                          or (version_type == "external_gte" and version >= loc.version))
+                    if not ok:
                         raise VersionConflictException("", doc_id, loc.version, version)
                     new_version = version
                 else:
@@ -294,8 +300,7 @@ class Engine:
             self.buffer = SegmentBuilder(self.mappings)
             self._buffer_ids.clear()
             self.stats.refresh_total += 1
-            if len(self.segments) > self.merge_segment_count:
-                self.merge()
+            self.maybe_merge()
             return True
 
     def flush(self):
@@ -310,15 +315,18 @@ class Engine:
             self.translog.commit()
             self.stats.flush_total += 1
 
-    def merge(self, max_segments: Optional[int] = None):
-        """Merge all segments into one (optimize/force-merge) by re-indexing
-        live docs' source through the parser."""
+    def merge(self, max_segments: Optional[int] = None,
+              subset: Optional[List[TpuSegment]] = None):
+        """Merge segments by re-indexing live docs' source through the
+        parser. With ``subset``: a policy-selected partial merge (tiered);
+        without: force-merge everything down to one segment (optimize)."""
         with self._lock:
-            if len(self.segments) <= (max_segments or 1):
+            if subset is None and len(self.segments) <= (max_segments or 1):
                 return
+            targets = subset if subset is not None else list(self.segments)
+            target_ids = {s.seg_id for s in targets}
             builder = SegmentBuilder(self.mappings)
-            id_order: List[str] = []
-            for seg in self.segments:
+            for seg in targets:
                 live = seg.live_host
                 roots = seg.roots_host
                 for local, doc_id in enumerate(seg.ids):
@@ -326,19 +334,27 @@ class Engine:
                         meta = seg.metas[local] if local < len(seg.metas) else {}
                         builder.add(self.parser.parse(
                             doc_id, seg.sources[local],
+                            routing=meta.get("routing"),
                             doc_type=meta.get("_type"), parent=meta.get("_parent")))
-                        id_order.append(doc_id)
             merged = builder.freeze()
-            if merged is None:
-                self.segments[:] = []  # in place: searchers share this list
-                return
-            for doc_id, local in merged.id_map.items():
-                loc = self._locations.get(doc_id)
-                if loc is not None and not loc.deleted:
-                    loc.where = merged.seg_id
-                    loc.local_id = local
-            self.segments[:] = [merged]  # in place: searchers share this list
+            keep = [s for s in self.segments if s.seg_id not in target_ids]
+            if merged is not None:
+                keep.append(merged)
+                for doc_id, local in merged.id_map.items():
+                    loc = self._locations.get(doc_id)
+                    if loc is not None and not loc.deleted:
+                        loc.where = merged.seg_id
+                        loc.local_id = local
+            self.segments[:] = keep  # in place: searchers share this list
             self.stats.merge_total += 1
+
+    def maybe_merge(self):
+        """Background-style merge check (reference: InternalEngine's
+        maybeMerge via EsConcurrentMergeScheduler — synchronous here)."""
+        with self._lock:
+            found = self.merge_policy.find_merge(self.segments)
+            if found and len(found) >= 1:
+                self.merge(subset=found)
 
     def recover_from_translog(self):
         """Replay the translog (crash recovery / shard recovery)."""

@@ -140,6 +140,25 @@ class IndexService:
 
     def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None) -> dict:
         shard = self.route(doc_id, routing)
+        # percolator docs: validate the would-be merged query BEFORE the
+        # engine persists anything, and re-register after (the plain index
+        # path does the same; updates must not bypass it)
+        from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
+
+        loc = shard.engine._locations.get(str(doc_id))
+        is_perc = loc is not None and not loc.deleted and loc.doc_type == PERCOLATOR_TYPE
+        if is_perc:
+            if body.get("script") is not None:
+                from elasticsearch_tpu.utils.errors import IllegalArgumentException
+
+                raise IllegalArgumentException(
+                    "percolator documents cannot be script-updated")
+            from elasticsearch_tpu.index.engine import _deep_merge
+
+            cur = shard.engine.get(str(doc_id))
+            merged = dict(cur["_source"]) if cur else {}
+            _deep_merge(merged, body.get("doc") or {})
+            self.percolator.validate(merged)
         script = body.get("script")
         script_src, params = None, None
         if script is not None:
@@ -156,6 +175,10 @@ class IndexService:
             upsert=body.get("upsert"),
             doc_as_upsert=bool(body.get("doc_as_upsert", False)),
         )
+        if is_perc:
+            got = shard.engine.get(str(doc_id))
+            if got and got.get("_source"):
+                self.percolator.register(str(doc_id), got["_source"])
         return {
             "_index": self.name,
             "_id": doc_id,

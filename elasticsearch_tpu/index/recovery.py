@@ -1,0 +1,56 @@
+"""Shard recovery: local (translog replay) and peer (primary → replica).
+
+Reference: org/elasticsearch/indices/recovery/RecoverySourceHandler.java /
+RecoveryTarget.java — peer recovery phase 1 copies segment files, phase 2
+replays the translog operations that arrived during the copy; local
+recovery (gateway) replays the on-disk translog into a fresh engine.
+
+TPU adaptation: segments are derived from sources, so "copying segment
+files" = shipping each live root doc (id, source, version, _type/_parent/
+routing meta) and re-indexing it on the target with external_gte
+versioning — the target's SegmentBuilder regenerates identical device
+arrays. Phase 2 falls out for free: ops indexed on the primary during the
+copy simply win the version comparison on the target.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from elasticsearch_tpu.utils.errors import VersionConflictException
+
+
+def recover_peer(source_engine, target_engine) -> dict:
+    """Copy the source engine's live docs into the target (phase 1 + 2).
+
+    Returns recovery stats (docs copied / skipped)."""
+    copied = skipped = 0
+    # snapshot the id list first: concurrent writes during recovery are
+    # handled by versioning, not by locking the whole copy
+    with source_engine._lock:
+        ids = [(doc_id, loc.version, loc.doc_type, loc.parent, loc.routing)
+               for doc_id, loc in source_engine._locations.items()
+               if not loc.deleted]
+    for doc_id, version, doc_type, parent, routing in ids:
+        got = source_engine.get(doc_id)
+        if got is None:  # deleted mid-recovery; phase-2 op will handle it
+            skipped += 1
+            continue
+        try:
+            target_engine.index(
+                doc_id, got["_source"], version=version,
+                version_type="external_gte",
+                doc_type=doc_type, parent=parent, routing=routing,
+                _replay=True,
+            )
+            copied += 1
+        except VersionConflictException:
+            skipped += 1  # target already has a newer op
+    target_engine.refresh()
+    return {"copied": copied, "skipped": skipped}
+
+
+def recover_local(shard) -> None:
+    """Gateway recovery: replay the shard's own translog (wraps
+    IndexShard.recover for symmetry with the reference's
+    IndexShardGateway.recover)."""
+    shard.recover()

@@ -1,0 +1,274 @@
+"""Snapshot / restore to a filesystem repository.
+
+Reference: org/elasticsearch/snapshots/SnapshotsService.java,
+repositories/fs/FsRepository.java, repositories/blobstore/
+BlobStoreRepository.java — snapshots are incremental at the file level:
+unchanged segment files are referenced, not re-copied.
+
+TPU adaptation: device-resident segment arrays are *derived* state
+(rebuilt deterministically from _source + mappings by SegmentBuilder), so
+the durable unit is the segment's doc block: ids + sources + meta
+(_type/_parent/routing) + versions + tombstones. Incrementality matches
+the reference's: each frozen segment serializes to a content-addressed
+blob (sha256 of its canonical JSON); re-snapshotting an index only writes
+blobs for segments that changed since the last snapshot. Restore replays
+blobs through the ordinary write path, which regenerates identical device
+arrays (same inversion Lucene gets by copying codec files).
+
+Layout under the repository root:
+    blobs/<sha256>.json.gz      one frozen segment's doc block
+    snapshots/<name>.json       snapshot manifest (indices, blob refs)
+    index.json                  repository catalog (snapshot list)
+"""
+from __future__ import annotations
+
+import gzip
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+
+class SnapshotMissingException(ElasticsearchTpuException):
+    status = 404
+    error_type = "snapshot_missing_exception"
+
+
+class SnapshotException(ElasticsearchTpuException):
+    status = 400
+    error_type = "snapshot_exception"
+
+
+class FsRepository:
+    """Content-addressed blob store on the local filesystem."""
+
+    def __init__(self, name: str, location: str, compress: bool = True):
+        self.name = name
+        self.location = location
+        self.compress = compress
+        os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
+        os.makedirs(os.path.join(location, "snapshots"), exist_ok=True)
+
+    # -- blobs -----------------------------------------------------------------
+
+    def put_blob(self, payload: dict) -> str:
+        raw = json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+        sha = hashlib.sha256(raw).hexdigest()
+        path = os.path.join(self.location, "blobs", f"{sha}.json.gz")
+        if not os.path.exists(path):  # incremental: content-addressed
+            tmp = path + ".tmp"
+            with gzip.open(tmp, "wb") as f:
+                f.write(raw)
+            os.replace(tmp, path)
+        return sha
+
+    def get_blob(self, sha: str) -> dict:
+        path = os.path.join(self.location, "blobs", f"{sha}.json.gz")
+        if not os.path.exists(path):
+            raise SnapshotException(f"missing blob [{sha}] in repository [{self.name}]")
+        with gzip.open(path, "rb") as f:
+            return json.loads(f.read())
+
+    # -- manifests -------------------------------------------------------------
+
+    def _catalog_path(self) -> str:
+        return os.path.join(self.location, "index.json")
+
+    def catalog(self) -> List[str]:
+        p = self._catalog_path()
+        if not os.path.exists(p):
+            return []
+        with open(p) as f:
+            return json.load(f).get("snapshots", [])
+
+    def _write_catalog(self, names: List[str]):
+        tmp = self._catalog_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"snapshots": sorted(names)}, f)
+        os.replace(tmp, self._catalog_path())
+
+    def put_manifest(self, name: str, manifest: dict):
+        path = os.path.join(self.location, "snapshots", f"{name}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+        cat = self.catalog()
+        if name not in cat:
+            cat.append(name)
+            self._write_catalog(cat)
+
+    def get_manifest(self, name: str) -> dict:
+        path = os.path.join(self.location, "snapshots", f"{name}.json")
+        if not os.path.exists(path):
+            raise SnapshotMissingException(
+                f"[{self.name}:{name}] is missing")
+        with open(path) as f:
+            return json.load(f)
+
+    def delete_snapshot(self, name: str):
+        path = os.path.join(self.location, "snapshots", f"{name}.json")
+        if not os.path.exists(path):
+            raise SnapshotMissingException(f"[{self.name}:{name}] is missing")
+        os.remove(path)
+        self._write_catalog([n for n in self.catalog() if n != name])
+        self._gc_blobs()
+
+    def _gc_blobs(self):
+        """Drop blobs referenced by no remaining snapshot (reference:
+        BlobStoreRepository cleanup after delete)."""
+        live = set()
+        for name in self.catalog():
+            m = self.get_manifest(name)
+            for idx in m["indices"].values():
+                for shard in idx["shards"]:
+                    live.update(shard["blobs"])
+        blob_dir = os.path.join(self.location, "blobs")
+        for fn in os.listdir(blob_dir):
+            sha = fn.split(".", 1)[0]
+            if sha not in live:
+                os.remove(os.path.join(blob_dir, fn))
+
+
+# ---------------------------------------------------------------------------
+# snapshot / restore over a Node
+# ---------------------------------------------------------------------------
+
+def _segment_payload(seg) -> dict:
+    """Canonical doc block of one frozen segment (roots only — children are
+    re-derived from the root source on restore)."""
+    docs = []
+    roots = seg.roots_host
+    for local, doc_id in enumerate(seg.ids):
+        if not seg.live_host[local]:
+            continue
+        if roots is not None and not roots[local]:
+            continue
+        meta = seg.metas[local] if local < len(seg.metas) else {}
+        docs.append({
+            "id": doc_id,
+            "source": seg.sources[local],
+            "meta": meta,
+        })
+    return {"docs": docs}
+
+
+def create_snapshot(node, repo: FsRepository, snap_name: str,
+                    indices: Optional[List[str]] = None,
+                    include_global_state: bool = True) -> dict:
+    if snap_name in repo.catalog():
+        raise SnapshotException(
+            f"snapshot [{repo.name}:{snap_name}] already exists")
+    # None = all indices; an explicit (even empty) list is taken literally —
+    # a non-matching pattern must NOT silently widen to the whole cluster
+    names = sorted(node.indices) if indices is None else indices
+    if not names:
+        raise SnapshotException("no indices matched the snapshot request")
+    manifest: dict = {
+        "snapshot": snap_name,
+        "state": "SUCCESS",
+        "start_time_ms": int(time.time() * 1000),
+        "indices": {},
+    }
+    for iname in names:
+        svc = node.indices.get(iname)
+        if svc is None:
+            raise SnapshotException(f"index [{iname}] not found")
+        # freeze the buffer so the snapshot is a refresh-consistent view
+        svc.refresh()
+        shards_meta = []
+        for shard in svc.shards:
+            blobs = []
+            versions: Dict[str, int] = {}
+            for seg in shard.segments:
+                blobs.append(repo.put_blob(_segment_payload(seg)))
+            for doc_id, loc in shard.engine._locations.items():
+                if not loc.deleted:
+                    versions[doc_id] = loc.version
+            shards_meta.append({"blobs": blobs, "versions": versions})
+        manifest["indices"][iname] = {
+            "settings": svc.settings,
+            "mappings": svc.mappings.to_json(),
+            "aliases": svc.aliases,
+            "shards": shards_meta,
+        }
+    if include_global_state:
+        manifest["global_state"] = {
+            "templates": dict(node.cluster_state.templates),
+            "search_templates": dict(getattr(node, "search_templates", {})),
+        }
+    manifest["end_time_ms"] = int(time.time() * 1000)
+    repo.put_manifest(snap_name, manifest)
+    return {"snapshot": {
+        "snapshot": snap_name, "state": "SUCCESS",
+        "indices": list(manifest["indices"]),
+        "shards": {"total": sum(len(i["shards"]) for i in manifest["indices"].values()),
+                   "failed": 0,
+                   "successful": sum(len(i["shards"]) for i in manifest["indices"].values())},
+    }}
+
+
+def restore_snapshot(node, repo: FsRepository, snap_name: str,
+                     indices: Optional[List[str]] = None,
+                     rename_pattern: Optional[str] = None,
+                     rename_replacement: Optional[str] = None) -> dict:
+    import fnmatch as _fn
+    import re as _re
+
+    manifest = repo.get_manifest(snap_name)
+    restored = []
+    for iname, imeta in manifest["indices"].items():
+        # patterns match against MANIFEST names (the indices being restored
+        # don't exist on the node, so node-side resolution can't apply)
+        if indices and not any(_fn.fnmatch(iname, pat) for pat in indices):
+            continue
+        target = iname
+        if rename_pattern and rename_replacement is not None:
+            target = _re.sub(rename_pattern, rename_replacement, iname)
+        if target in node.indices:
+            raise SnapshotException(
+                f"cannot restore index [{target}]: an open index with that "
+                f"name already exists (close or delete it first)")
+        node.create_index(target, {
+            "settings": imeta["settings"],
+            "mappings": imeta["mappings"],
+        })
+        svc = node.indices[target]
+        svc.aliases.update(imeta.get("aliases", {}))
+        for shard_meta in imeta["shards"]:
+            versions = shard_meta.get("versions", {})
+            for sha in shard_meta["blobs"]:
+                payload = repo.get_blob(sha)
+                for doc in payload["docs"]:
+                    meta = doc.get("meta", {})
+                    svc.index_doc(
+                        doc["id"], doc["source"],
+                        routing=meta.get("routing") or meta.get("_parent"),
+                        doc_type=meta.get("_type"),
+                        parent=meta.get("_parent"),
+                        version=versions.get(doc["id"]),
+                        version_type="external",
+                    )
+        svc.refresh()
+        restored.append(target)
+    if "global_state" in manifest and not indices:
+        node.cluster_state.templates.update(manifest["global_state"].get("templates", {}))
+        if hasattr(node, "search_templates"):
+            node.search_templates.update(
+                manifest["global_state"].get("search_templates", {}))
+    return {"snapshot": {"snapshot": snap_name, "indices": restored,
+                         "shards": {"failed": 0}}}
+
+
+def snapshot_info(repo: FsRepository, snap_name: str) -> dict:
+    m = repo.get_manifest(snap_name)
+    return {
+        "snapshot": snap_name,
+        "state": m.get("state", "SUCCESS"),
+        "indices": list(m.get("indices", {})),
+        "start_time_in_millis": m.get("start_time_ms", 0),
+        "end_time_in_millis": m.get("end_time_ms", 0),
+    }

@@ -33,6 +33,8 @@ class Node:
         # stored search templates (reference keeps these in the .scripts
         # index; node-local registry here)
         self.search_templates: Dict[str, Any] = {}
+        # snapshot repositories (reference: RepositoriesService)
+        self.repositories: Dict[str, Any] = {}
         self.cluster_state = ClusterState(cluster_name)
         self.cluster_state.add_node(DiscoveryNode(self.node_id, name), master=True)
 

@@ -121,6 +121,17 @@ def _register_all(rc: RestController):
         {"name": k, "index_patterns": v.get("index_patterns", [v.get("template", "")])}
         for k, v in n.cluster_state.templates.items()]))
 
+    # snapshot API (before /{index} patterns so the literal prefix wins)
+    add("PUT", "/_snapshot/{repo}", _put_repo)
+    add("POST", "/_snapshot/{repo}", _put_repo)
+    add("GET", "/_snapshot", _get_repos)
+    add("GET", "/_snapshot/{repo}", _get_repo)
+    add("DELETE", "/_snapshot/{repo}", _delete_repo)
+    add("PUT", "/_snapshot/{repo}/{snap}", _put_snapshot)
+    add("GET", "/_snapshot/{repo}/{snap}", _get_snapshot)
+    add("DELETE", "/_snapshot/{repo}/{snap}", _delete_snapshot)
+    add("POST", "/_snapshot/{repo}/{snap}/_restore", _restore_snapshot)
+
     # index admin
     add("PUT", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
     add("POST", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
@@ -217,6 +228,89 @@ def _register_all(rc: RestController):
     add("POST", "/{index}/{type}/{id}", _index_doc_typed)
     add("GET", "/{index}/{type}/{id}", _get_doc_typed)
     add("DELETE", "/{index}/{type}/{id}", _delete_doc_typed)
+
+
+# -- snapshot helpers --------------------------------------------------------
+
+def _put_repo(n: Node, p, b, repo: str):
+    from elasticsearch_tpu.index.snapshots import FsRepository
+
+    body = _json(b)
+    if body.get("type") != "fs":
+        raise IllegalArgumentException(f"repository type [{body.get('type')}] not supported (fs only)")
+    settings = body.get("settings", {})
+    loc = settings.get("location")
+    if not loc:
+        raise IllegalArgumentException("fs repository requires [settings.location]")
+    n.repositories[repo] = FsRepository(repo, loc,
+                                        compress=bool(settings.get("compress", True)))
+    return 200, {"acknowledged": True}
+
+
+def _repo_or_404(n: Node, repo: str):
+    from elasticsearch_tpu.index.snapshots import SnapshotMissingException
+
+    r = n.repositories.get(repo)
+    if r is None:
+        raise SnapshotMissingException(f"[{repo}] missing")
+    return r
+
+
+def _get_repos(n: Node, p, b):
+    return 200, {name: {"type": "fs", "settings": {"location": r.location}}
+                 for name, r in n.repositories.items()}
+
+
+def _get_repo(n: Node, p, b, repo: str):
+    r = _repo_or_404(n, repo)
+    return 200, {repo: {"type": "fs", "settings": {"location": r.location}}}
+
+
+def _delete_repo(n: Node, p, b, repo: str):
+    _repo_or_404(n, repo)
+    del n.repositories[repo]
+    return 200, {"acknowledged": True}
+
+
+def _put_snapshot(n: Node, p, b, repo: str, snap: str):
+    from elasticsearch_tpu.index.snapshots import create_snapshot
+
+    body = _json(b)
+    indices = body.get("indices")
+    if isinstance(indices, str):
+        indices = [i for part in indices.split(",") if (i := part.strip())]
+    if indices:
+        indices = [name for pat in indices for name in n.resolve_indices(pat)]
+    return 200, create_snapshot(
+        n, _repo_or_404(n, repo), snap, indices=indices,
+        include_global_state=body.get("include_global_state", True))
+
+
+def _get_snapshot(n: Node, p, b, repo: str, snap: str):
+    from elasticsearch_tpu.index.snapshots import snapshot_info
+
+    r = _repo_or_404(n, repo)
+    if snap == "_all":
+        return 200, {"snapshots": [snapshot_info(r, s) for s in r.catalog()]}
+    return 200, {"snapshots": [snapshot_info(r, snap)]}
+
+
+def _delete_snapshot(n: Node, p, b, repo: str, snap: str):
+    _repo_or_404(n, repo).delete_snapshot(snap)
+    return 200, {"acknowledged": True}
+
+
+def _restore_snapshot(n: Node, p, b, repo: str, snap: str):
+    from elasticsearch_tpu.index.snapshots import restore_snapshot
+
+    body = _json(b)
+    indices = body.get("indices")
+    if isinstance(indices, str):
+        indices = [i for part in indices.split(",") if (i := part.strip())]
+    return 200, restore_snapshot(
+        n, _repo_or_404(n, repo), snap, indices=indices,
+        rename_pattern=body.get("rename_pattern"),
+        rename_replacement=body.get("rename_replacement"))
 
 
 # -- admin helpers -----------------------------------------------------------

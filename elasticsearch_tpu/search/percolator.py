@@ -35,7 +35,10 @@ class PercolatorRegistry:
     cache keyed by doc id, invalidated on re-registration."""
 
     def __init__(self):
+        import threading
+
         self._queries: Dict[str, Any] = {}  # id -> (raw dsl, parsed Query)
+        self._lock = threading.Lock()  # REST server is threaded
 
     @staticmethod
     def validate(source: dict):
@@ -47,16 +50,20 @@ class PercolatorRegistry:
         return parse_query(source["query"])
 
     def register(self, doc_id: str, source: dict) -> None:
-        self._queries[doc_id] = (source["query"], self.validate(source))
+        parsed = self.validate(source)
+        with self._lock:
+            self._queries[doc_id] = (source["query"], parsed)
 
     def unregister(self, doc_id: str) -> None:
-        self._queries.pop(doc_id, None)
+        with self._lock:
+            self._queries.pop(doc_id, None)
 
     def __len__(self) -> int:
         return len(self._queries)
 
     def items(self):
-        return self._queries.items()
+        with self._lock:  # snapshot: percolation iterates while writers mutate
+            return list(self._queries.items())
 
 
 def percolate(

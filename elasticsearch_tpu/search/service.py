@@ -83,7 +83,10 @@ class ShardSearcher:
         sort_spec = _parse_sort(body.get("sort"))
         search_after = body.get("search_after")
         rescore_specs = []
-        if body.get("rescore") and not sort_spec:
+        if body.get("rescore") and sort_spec:
+            raise SearchParseException(
+                "cannot use [rescore] in combination with [sort]")
+        if body.get("rescore"):
             from elasticsearch_tpu.search.rescore import parse_rescore
 
             rescore_specs = parse_rescore(body["rescore"])

@@ -1,0 +1,194 @@
+"""Snapshot/restore, tiered merge policy, and peer recovery tests.
+
+Reference: snapshots/SnapshotsService, index/merge/policy/
+TieredMergePolicyProvider, indices/recovery/RecoverySourceHandler.
+"""
+import os
+
+import pytest
+
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.index.merge import TieredMergePolicy
+from elasticsearch_tpu.index.recovery import recover_peer
+from elasticsearch_tpu.index.snapshots import (
+    FsRepository,
+    SnapshotException,
+    SnapshotMissingException,
+    create_snapshot,
+    restore_snapshot,
+    snapshot_info,
+)
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.create_index("books", {"mappings": {"properties": {
+        "title": {"type": "text"}, "price": {"type": "long"}}}})
+    svc = n.indices["books"]
+    for i in range(10):
+        svc.index_doc(str(i), {"title": f"book number {i}", "price": i * 10})
+    svc.delete_doc("9")
+    svc.refresh()
+    yield n
+    for s in n.indices.values():
+        s.close()
+
+
+def test_snapshot_restore_roundtrip(node, tmp_path):
+    repo = FsRepository("r1", str(tmp_path))
+    create_snapshot(node, repo, "snap1", ["books"])
+    assert "snap1" in repo.catalog()
+    info = snapshot_info(repo, "snap1")
+    assert info["state"] == "SUCCESS" and info["indices"] == ["books"]
+
+    restored = restore_snapshot(node, repo, "snap1", indices=["books"],
+                                rename_pattern="books", rename_replacement="books2")
+    assert restored["snapshot"]["indices"] == ["books2"]
+    svc2 = node.indices["books2"]
+    assert svc2.num_docs == 9  # tombstoned doc 9 not restored
+    r = svc2.search({"query": {"match": {"title": "number"}}, "size": 20})
+    assert r["hits"]["total"] == 9
+    # versions preserved
+    got = svc2.get_doc("0")
+    assert got["_version"] == node.indices["books"].get_doc("0")["_version"]
+
+
+def test_snapshot_incremental_blobs(node, tmp_path):
+    repo = FsRepository("r1", str(tmp_path))
+    create_snapshot(node, repo, "s1", ["books"])
+    blobs_before = set(os.listdir(os.path.join(str(tmp_path), "blobs")))
+    # no changes: second snapshot adds no blobs
+    create_snapshot(node, repo, "s2", ["books"])
+    blobs_after = set(os.listdir(os.path.join(str(tmp_path), "blobs")))
+    assert blobs_before == blobs_after
+    # duplicate name rejected
+    with pytest.raises(SnapshotException):
+        create_snapshot(node, repo, "s1", ["books"])
+
+
+def test_snapshot_delete_gcs_blobs(node, tmp_path):
+    repo = FsRepository("r1", str(tmp_path))
+    create_snapshot(node, repo, "s1", ["books"])
+    repo.delete_snapshot("s1")
+    assert repo.catalog() == []
+    assert os.listdir(os.path.join(str(tmp_path), "blobs")) == []
+    with pytest.raises(SnapshotMissingException):
+        repo.get_manifest("s1")
+
+
+def test_restore_refuses_open_index(node, tmp_path):
+    repo = FsRepository("r1", str(tmp_path))
+    create_snapshot(node, repo, "s1", ["books"])
+    with pytest.raises(SnapshotException):
+        restore_snapshot(node, repo, "s1", indices=["books"])
+
+
+def test_snapshot_empty_pattern_errors_not_widens(node, tmp_path):
+    repo = FsRepository("r1", str(tmp_path))
+    with pytest.raises(SnapshotException):
+        create_snapshot(node, repo, "s1", indices=[])  # resolved-empty pattern
+    assert repo.catalog() == []
+
+
+def test_restore_matches_patterns_against_manifest(node, tmp_path):
+    repo = FsRepository("r1", str(tmp_path))
+    create_snapshot(node, repo, "s1", ["books"])
+    out = restore_snapshot(node, repo, "s1", indices=["boo*"],
+                           rename_pattern="^", rename_replacement="re_")
+    assert out["snapshot"]["indices"] == ["re_books"]
+
+
+def test_rescore_with_sort_rejected():
+    from elasticsearch_tpu.utils.errors import SearchParseException
+
+    svc = IndexService("rs")
+    svc.index_doc("1", {"v": 1})
+    svc.refresh()
+    with pytest.raises(SearchParseException):
+        svc.search({"query": {"match_all": {}}, "sort": [{"v": "desc"}],
+                    "rescore": {"query": {"rescore_query": {"match_all": {}}}}})
+    svc.close()
+
+
+def test_percolator_update_revalidates_and_reregisters():
+    from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+    svc = IndexService("pu")
+    svc.index_doc("q1", {"query": {"match": {"m": "aaa"}}}, doc_type=".percolator")
+    assert svc.percolate({"doc": {"m": "aaa"}})["total"] == 1
+    # live update swaps the active query
+    svc.update_doc("q1", {"doc": {"query": {"match": {"m": "bbb"}}}})
+    assert svc.percolate({"doc": {"m": "aaa"}})["total"] == 0
+    assert svc.percolate({"doc": {"m": "bbb"}})["total"] == 1
+    # invalid update rejected before persisting
+    with pytest.raises(ElasticsearchTpuException):
+        svc.update_doc("q1", {"doc": {"query": {"frobnicate": {}}}})
+    assert svc.percolate({"doc": {"m": "bbb"}})["total"] == 1
+    svc.close()
+
+
+def test_tiered_merge_policy_tier_overflow():
+    class FakeSeg:
+        _n = 0
+
+        def __init__(self, live, deleted=0):
+            FakeSeg._n += 1
+            self.seg_id = FakeSeg._n
+            self.live_docs = live
+            self.num_docs = live + deleted
+            self.deleted_count = deleted
+
+    pol = TieredMergePolicy(segments_per_tier=4, max_merge_at_once=4)
+    # 3 same-tier segments: no merge
+    assert pol.find_merge([FakeSeg(10), FakeSeg(12), FakeSeg(11)]) is None
+    # 4 same-tier segments: merge all 4, smallest first
+    segs = [FakeSeg(10), FakeSeg(12), FakeSeg(11), FakeSeg(13)]
+    found = pol.find_merge(segs)
+    assert found is not None and len(found) == 4
+    # deletes pressure: one heavily-deleted segment merges
+    hot = FakeSeg(10, deleted=8)
+    found = pol.find_merge([hot, FakeSeg(1000)])
+    assert found is not None and hot in found
+
+
+def test_engine_partial_merge_keeps_other_segments():
+    svc = IndexService("m")
+    eng = svc.shards[0].engine
+    eng.merge_policy = TieredMergePolicy(segments_per_tier=3, max_merge_at_once=3)
+    # 2 small segments + 1 big one; small tier does not overflow yet
+    for i in range(2):
+        svc.index_doc(f"a{i}", {"v": i})
+        eng.refresh()
+    for i in range(300):
+        svc.index_doc(f"big{i}", {"v": i})
+    eng.refresh()
+    n_before = len(eng.segments)
+    svc.index_doc("a2", {"v": 2})
+    eng.refresh()  # 3 small segments now -> tier overflow -> partial merge
+    small = [s for s in eng.segments if s.live_docs < 10]
+    big = [s for s in eng.segments if s.live_docs >= 300]
+    assert len(small) == 1 and len(big) == 1  # smalls merged, big untouched
+    assert svc.num_docs == 303
+    r = svc.search({"query": {"match_all": {}}, "size": 0})
+    assert r["hits"]["total"] == 303
+    svc.close()
+
+
+def test_peer_recovery_copies_docs():
+    src = IndexService("src")
+    for i in range(5):
+        src.index_doc(str(i), {"v": i}, doc_type="t")
+    src.delete_doc("4")
+    src.refresh()
+    dst = IndexService("dst")
+    stats = recover_peer(src.shards[0].engine, dst.shards[0].engine)
+    assert stats["copied"] == 4
+    assert dst.num_docs == 4
+    # versions carried over: re-recovery is a no-op (external_gte idempotent)
+    stats2 = recover_peer(src.shards[0].engine, dst.shards[0].engine)
+    assert stats2["copied"] == 4  # equal versions accepted (gte), no dupes
+    assert dst.num_docs == 4
+    src.close()
+    dst.close()
