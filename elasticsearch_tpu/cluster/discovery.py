@@ -1,0 +1,116 @@
+"""Discovery: membership, master election, failure detection.
+
+Reference: org/elasticsearch/discovery/zen/ — ZenDiscovery.java
+(join/leave + publish), ElectMasterService.java (lowest-sorted
+master-eligible node wins, minimum_master_nodes quorum),
+fd/NodesFaultDetection.java + MasterFaultDetection.java (periodic pings,
+N consecutive failures → node removed / master re-elected).
+
+Multi-host mapping (design, exercised single-process here): each host runs
+one process in the jax.distributed world; process 0's coordinator address
+doubles as the seed host list, election runs over the control plane
+(cluster/transport.py TCP framing), and the DATA plane never touches this
+path — collectives ride ICI/DCN via XLA. Fault detection pings use the
+same transport; a dead host's shards reroute via cluster/routing.py and
+replicas promote via cluster/replication.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode
+
+
+class ElectMasterService:
+    """Reference: ElectMasterService — sort master-eligible nodes by id,
+    lowest wins; refuse election without quorum."""
+
+    def __init__(self, minimum_master_nodes: int = 1):
+        self.minimum_master_nodes = minimum_master_nodes
+
+    def elect(self, nodes: List[DiscoveryNode]) -> Optional[DiscoveryNode]:
+        eligible = sorted((n for n in nodes if "master" in n.roles),
+                          key=lambda n: n.node_id)
+        if len(eligible) < self.minimum_master_nodes:
+            return None  # no quorum -> no master (reference: null master, red)
+        return eligible[0] if eligible else None
+
+
+class FaultDetector:
+    """Ping-based failure detection (reference: fd/NodesFaultDetection).
+
+    ``ping_fn(node) -> bool`` is injected so tests (and the future TCP
+    transport) supply the real ping; ``ping_retries`` consecutive failures
+    mark the node dead and fire ``on_failure``."""
+
+    def __init__(self, ping_fn: Callable[[DiscoveryNode], bool],
+                 on_failure: Callable[[DiscoveryNode], None],
+                 ping_retries: int = 3):
+        self.ping_fn = ping_fn
+        self.on_failure = on_failure
+        self.ping_retries = ping_retries
+        self._fail_counts: Dict[str, int] = {}
+
+    def check(self, nodes: List[DiscoveryNode]) -> List[DiscoveryNode]:
+        """One detection round; returns nodes declared failed this round."""
+        failed = []
+        for node in nodes:
+            if self.ping_fn(node):
+                self._fail_counts.pop(node.node_id, None)
+                continue
+            c = self._fail_counts.get(node.node_id, 0) + 1
+            self._fail_counts[node.node_id] = c
+            if c >= self.ping_retries:
+                failed.append(node)
+                self._fail_counts.pop(node.node_id, None)
+                self.on_failure(node)
+        return failed
+
+
+class ZenDiscovery:
+    """Single-process-capable zen-style discovery over a shared ClusterState."""
+
+    def __init__(self, state: ClusterState, local: DiscoveryNode,
+                 minimum_master_nodes: int = 1):
+        self.state = state
+        self.local = local
+        self.elect_service = ElectMasterService(minimum_master_nodes)
+        self._lock = threading.Lock()
+        if local.node_id not in state.nodes:
+            state.add_node(local)
+        self._reelect()
+
+    def join(self, node: DiscoveryNode) -> None:
+        with self._lock:
+            self.state.nodes[node.node_id] = node
+            self.state.next_version()
+            self._reelect()
+
+    def leave(self, node_id: str) -> None:
+        with self._lock:
+            self.state.nodes.pop(node_id, None)
+            # shards on the departed node become unassigned (reroute input)
+            for r in self.state.routing:
+                if r.node_id == node_id:
+                    r.state = "UNASSIGNED"
+                    r.node_id = ""
+            self.state.next_version()
+            self._reelect()
+
+    def _reelect(self) -> None:
+        winner = self.elect_service.elect(list(self.state.nodes.values()))
+        self.state.master_node_id = winner.node_id if winner else None
+
+    @property
+    def is_master(self) -> bool:
+        return self.state.master_node_id == self.local.node_id
+
+    def make_fault_detector(self, ping_fn: Callable[[DiscoveryNode], bool],
+                            ping_retries: int = 3) -> FaultDetector:
+        return FaultDetector(
+            ping_fn=ping_fn,
+            on_failure=lambda n: self.leave(n.node_id),
+            ping_retries=ping_retries,
+        )

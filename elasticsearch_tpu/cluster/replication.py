@@ -1,0 +1,133 @@
+"""Write replication: primary → replica fanout and failover promotion.
+
+Reference: org/elasticsearch/action/support/replication/
+TransportShardReplicationOperationAction.java — a write executes on the
+primary, then fans out synchronously to every assigned replica; a replica
+that fails the op is failed-and-rerouted rather than failing the client
+write. Primary failure promotes an in-sync replica
+(cluster/routing/allocation — PRIMARY promotion on reroute).
+
+TPU adaptation: replicas are full IndexShards (engine + searcher) holding
+their own device-resident segments. Replication replays the logical op with
+the PRIMARY's assigned version under external_gte, which makes fanout
+idempotent and keeps replicas convergent (same trick the reference uses
+with sequence numbers in later versions; ES 2.0 ships the version the same
+way). Search can read any in-sync copy (preference _primary / _replica /
+round-robin), mirroring query-then-fetch shard selection.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional
+
+from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+
+class ReplicationGroup:
+    """One shard's copies: a primary plus N replicas."""
+
+    def __init__(self, shard_id: int, primary, replicas: Optional[list] = None,
+                 on_replica_failure: Optional[Callable] = None):
+        self.shard_id = shard_id
+        self.primary = primary
+        self.replicas: List[Any] = list(replicas or [])
+        self.failed_replicas: List[Any] = []
+        self.on_replica_failure = on_replica_failure
+        self._lock = threading.RLock()
+        self._read_rr = 0
+
+    # -- writes ----------------------------------------------------------------
+
+    def index(self, doc_id, source, **kw):
+        """Execute on primary, then fan out with the primary's version.
+
+        Returns (id, version, created, replicas_failed_this_write)."""
+        with self._lock:
+            rid, version, created = self.primary.engine.index(doc_id, source, **kw)
+            failed = self._fanout("index", rid, source=source, version=version, kw=kw)
+            return rid, version, created, failed
+
+    def delete(self, doc_id, **kw):
+        with self._lock:
+            version = self.primary.engine.delete(doc_id, **kw)
+            failed = self._fanout("delete", doc_id, version=version, kw=kw)
+            return version, failed
+
+    def _fanout(self, op: str, doc_id, source=None, version=None, kw=None) -> int:
+        """Returns how many replicas failed (and were dropped) on this op."""
+        kw = dict(kw or {})
+        kw.pop("version", None)
+        kw.pop("version_type", None)
+        kw.pop("op_type", None)
+        failed = 0
+        for replica in list(self.replicas):
+            try:
+                if op == "index":
+                    replica.engine.index(doc_id, source, version=version,
+                                         version_type="external_gte", **kw)
+                else:
+                    try:
+                        replica.engine.delete(doc_id)
+                    except ElasticsearchTpuException:
+                        pass  # already absent on the replica
+            except Exception:
+                # reference behavior: a failing replica is failed out of the
+                # group (and reported to the master for reroute), the client
+                # write still succeeds — but the _shards section reports it
+                if replica in self.replicas:
+                    self.replicas.remove(replica)
+                    self.failed_replicas.append(replica)
+                failed += 1
+                if self.on_replica_failure:
+                    self.on_replica_failure(self.shard_id, replica)
+        return failed
+
+    def replicate_current(self, doc_id: str):
+        """Fan out the primary's CURRENT state of doc_id (used after partial
+        updates, where the merged source only exists on the primary)."""
+        with self._lock:
+            eng = self.primary.engine
+            loc = eng._locations.get(str(doc_id))
+            if loc is None or loc.deleted:
+                self._fanout("delete", doc_id)
+                return
+            got = eng.get(str(doc_id))
+            self._fanout("index", str(doc_id), source=got["_source"],
+                         version=loc.version,
+                         kw={"routing": loc.routing, "doc_type": loc.doc_type,
+                             "parent": loc.parent})
+
+    # -- failover --------------------------------------------------------------
+
+    def fail_primary(self):
+        """Promote the first in-sync replica (reference: primary failure →
+        allocation promotes an active replica copy)."""
+        with self._lock:
+            if not self.replicas:
+                raise ElasticsearchTpuException(
+                    f"shard [{self.shard_id}]: no replica to promote")
+            old = self.primary
+            self.primary = self.replicas.pop(0)
+            self.failed_replicas.append(old)
+            return self.primary
+
+    # -- reads -----------------------------------------------------------------
+
+    def reader(self, preference: Optional[str] = None):
+        """Pick the copy a search should read (query-then-fetch shard pick)."""
+        with self._lock:
+            if preference == "_primary" or not self.replicas:
+                return self.primary
+            if preference == "_replica":
+                return self.replicas[0]
+            copies = [self.primary] + self.replicas
+            self._read_rr = (self._read_rr + 1) % len(copies)
+            return copies[self._read_rr]
+
+    @property
+    def copies(self) -> list:
+        return [self.primary] + list(self.replicas)
+
+    def refresh(self):
+        for c in self.copies:
+            c.refresh()

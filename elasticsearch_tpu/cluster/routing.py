@@ -1,0 +1,174 @@
+"""Shard routing & allocation: which node (and mesh device) owns each copy.
+
+Reference: org/elasticsearch/cluster/routing/OperationRouting.java (doc →
+shard hash), routing/allocation/AllocationService.java and the decider
+chain under routing/allocation/decider/ (SameShardAllocationDecider,
+FilterAllocationDecider, ThrottlingAllocationDecider, …), plus
+BalancedShardsAllocator for even spread.
+
+TPU adaptation: a node here is a host process; within it, shard → device
+placement on the jax Mesh is handled by parallel/placement.py. Allocation
+across nodes follows the same decider pattern as the reference so the
+multi-host design (jax.distributed, one process per host) drops in without
+changing the algorithm.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from elasticsearch_tpu.cluster.state import DiscoveryNode, ShardRouting
+from elasticsearch_tpu.utils.hashing import murmur3_32
+
+
+# -- operation routing ---------------------------------------------------------
+
+def shard_id_for(doc_id: str, num_shards: int, routing: Optional[str] = None) -> int:
+    """OperationRouting.generateShardId: murmur3(routing ?: id) % shards."""
+    key = routing if routing is not None else str(doc_id)
+    return murmur3_32(key) % num_shards
+
+
+# -- allocation deciders -------------------------------------------------------
+
+ALWAYS, THROTTLE, NO = "YES", "THROTTLE", "NO"
+
+
+class Decider:
+    name = "base"
+
+    def can_allocate(self, shard: ShardRouting, node: DiscoveryNode,
+                     allocation: "Allocation") -> str:
+        return ALWAYS
+
+
+class SameShardDecider(Decider):
+    """A node must not hold two copies of the same shard (reference:
+    SameShardAllocationDecider)."""
+
+    name = "same_shard"
+
+    def can_allocate(self, shard, node, allocation):
+        for existing in allocation.assigned:
+            if (existing.index == shard.index and existing.shard_id == shard.shard_id
+                    and existing.node_id == node.node_id):
+                return NO
+        return ALWAYS
+
+
+class FilterDecider(Decider):
+    """index.routing.allocation.{include,exclude,require}.<attr> settings
+    (reference: FilterAllocationDecider)."""
+
+    name = "filter"
+
+    def __init__(self, index_settings: Optional[dict] = None):
+        s = (index_settings or {}).get("index", index_settings or {})
+        alloc = s.get("routing", {}).get("allocation", {})
+        self.include = alloc.get("include", {})
+        self.exclude = alloc.get("exclude", {})
+        self.require = alloc.get("require", {})
+
+    @staticmethod
+    def _matches(rule_val: str, node_val: Optional[str]) -> bool:
+        return node_val is not None and node_val in [v.strip() for v in str(rule_val).split(",")]
+
+    def can_allocate(self, shard, node, allocation):
+        attrs = dict(node.attributes)
+        attrs.setdefault("_name", node.name)
+        attrs.setdefault("_id", node.node_id)
+        for k, v in self.require.items():
+            if not self._matches(v, attrs.get(k)):
+                return NO
+        for k, v in self.exclude.items():
+            if self._matches(v, attrs.get(k)):
+                return NO
+        if self.include:
+            if not any(self._matches(v, attrs.get(k)) for k, v in self.include.items()):
+                return NO
+        return ALWAYS
+
+
+class ThrottlingDecider(Decider):
+    """Cap concurrent incoming recoveries per node (reference:
+    ThrottlingAllocationDecider, node_concurrent_recoveries)."""
+
+    name = "throttling"
+
+    def __init__(self, concurrent_recoveries: int = 2):
+        self.concurrent = concurrent_recoveries
+
+    def can_allocate(self, shard, node, allocation):
+        initializing = sum(1 for r in allocation.assigned
+                           if r.node_id == node.node_id and r.state == "INITIALIZING")
+        return THROTTLE if initializing >= self.concurrent else ALWAYS
+
+
+@dataclass
+class Allocation:
+    """Mutable allocation round state."""
+
+    nodes: List[DiscoveryNode]
+    assigned: List[ShardRouting] = field(default_factory=list)
+
+
+class ShardAllocator:
+    """Balanced allocation with a decider chain (reference:
+    AllocationService.reroute + BalancedShardsAllocator: pick the eligible
+    node with the fewest shards)."""
+
+    def __init__(self, deciders: Optional[List[Decider]] = None):
+        self.deciders = deciders if deciders is not None else [
+            SameShardDecider(), ThrottlingDecider()]
+
+    def decide(self, shard: ShardRouting, node: DiscoveryNode,
+               allocation: Allocation) -> str:
+        verdict = ALWAYS
+        for d in self.deciders:
+            v = d.can_allocate(shard, node, allocation)
+            if v == NO:
+                return NO
+            if v == THROTTLE:
+                verdict = THROTTLE
+        return verdict
+
+    def allocate_index(self, index: str, num_shards: int, num_replicas: int,
+                       nodes: List[DiscoveryNode],
+                       index_settings: Optional[dict] = None,
+                       state: str = "STARTED") -> List[ShardRouting]:
+        """Assign every copy of every shard; unassignable copies come back
+        with state UNASSIGNED (=> yellow/red health, like the reference)."""
+        deciders = list(self.deciders)
+        if index_settings:
+            deciders = deciders + [FilterDecider(index_settings)]
+        alloc = Allocation(nodes=nodes)
+        out: List[ShardRouting] = []
+        for sid in range(num_shards):
+            for copy in range(1 + num_replicas):
+                shard = ShardRouting(index, sid, node_id="", primary=(copy == 0),
+                                     state="UNASSIGNED")
+                # fewest-shards-first among eligible nodes
+                counts: Dict[str, int] = {n.node_id: 0 for n in nodes}
+                for r in alloc.assigned:
+                    counts[r.node_id] = counts.get(r.node_id, 0) + 1
+                best = None
+                for node in sorted(nodes, key=lambda n: counts.get(n.node_id, 0)):
+                    v = ALWAYS
+                    for d in deciders:
+                        dv = d.can_allocate(shard, node, alloc)
+                        if dv == NO:
+                            v = NO
+                            break
+                        if dv == THROTTLE:
+                            v = THROTTLE
+                    if v == ALWAYS:
+                        best = node
+                        break
+                    if v == THROTTLE and best is None:
+                        best = node  # throttled target still wins over none
+                if best is not None:
+                    shard.node_id = best.node_id
+                    shard.state = state
+                alloc.assigned.append(shard)
+                out.append(shard)
+        return out

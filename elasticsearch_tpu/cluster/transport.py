@@ -1,0 +1,144 @@
+"""Transport: action dispatch, in-process and over TCP JSON framing.
+
+Reference: org/elasticsearch/transport/ — TransportService.java (register
+handlers by action name, sendRequest), netty/NettyTransport.java (the wire).
+The reference's data AND control plane both ride this; for us it is the
+CONTROL plane only (cluster state publish, pings, shard commands): the TPU
+data plane is XLA collectives over ICI/DCN issued inside jit programs
+(parallel/), never hand-rolled sockets.
+
+Wire format: 4-byte big-endian length prefix + UTF-8 JSON
+{"action": str, "payload": {...}} → {"ok": bool, "result"|"error": ...}.
+One request per connection round; connections are short-lived (control
+traffic is low-rate, so simplicity beats pooling here).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+
+class TransportError(ElasticsearchTpuException):
+    status = 500
+    error_type = "transport_error"
+
+
+Handler = Callable[[dict], Any]
+
+
+def _send_frame(sock: socket.socket, obj: dict) -> None:
+    raw = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(raw)) + raw)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
+    (n,) = struct.unpack(">I", header)
+    if n > 64 << 20:
+        raise TransportError(f"frame of {n} bytes exceeds the 64MB cap")
+    body = _recv_exact(sock, n)
+    if body is None:
+        return None
+    return json.loads(body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class TransportService:
+    """Action registry + local/remote dispatch."""
+
+    def __init__(self, local_node_id: str = "local"):
+        self.local_node_id = local_node_id
+        self._handlers: Dict[str, Handler] = {}
+        self._server: Optional["TcpTransportServer"] = None
+
+    def register(self, action: str, handler: Handler) -> None:
+        self._handlers[action] = handler
+
+    def handle(self, action: str, payload: dict) -> Any:
+        h = self._handlers.get(action)
+        if h is None:
+            raise TransportError(f"no handler for action [{action}]")
+        return h(payload)
+
+    # -- local -----------------------------------------------------------------
+
+    def send_local(self, action: str, payload: dict) -> Any:
+        return self.handle(action, payload)
+
+    # -- TCP -------------------------------------------------------------------
+
+    def bind(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        """Start the TCP endpoint; returns the bound (host, port)."""
+        self._server = TcpTransportServer(self, host, port)
+        return self._server.address
+
+    def send_remote(self, address: Tuple[str, int], action: str,
+                    payload: dict, timeout: float = 5.0) -> Any:
+        with socket.create_connection(address, timeout=timeout) as sock:
+            _send_frame(sock, {"action": action, "payload": payload})
+            resp = _recv_frame(sock)
+        if resp is None:
+            raise TransportError(f"connection closed by {address}")
+        if not resp.get("ok"):
+            raise TransportError(resp.get("error", "remote failure"))
+        return resp.get("result")
+
+    def ping(self, address: Tuple[str, int], timeout: float = 1.0) -> bool:
+        try:
+            return self.send_remote(address, "internal:ping", {}, timeout) == "pong"
+        except Exception:
+            return False
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+
+class TcpTransportServer:
+    def __init__(self, service: TransportService, host: str, port: int):
+        service.register("internal:ping", lambda payload: "pong")
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):  # noqa: N802 (socketserver API)
+                try:
+                    req = _recv_frame(self.request)
+                    if req is None:
+                        return
+                    try:
+                        result = service.handle(req.get("action", ""),
+                                                req.get("payload", {}))
+                        _send_frame(self.request, {"ok": True, "result": result})
+                    except Exception as e:  # handler errors go back as frames
+                        _send_frame(self.request, {"ok": False, "error": str(e)})
+                except Exception:
+                    pass  # broken pipe / malformed frame: drop the connection
+
+        self._srv = socketserver.ThreadingTCPServer((host, port), _Handler,
+                                                    bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="tpu-transport", daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        self._srv.shutdown()
+        self._srv.server_close()

@@ -14,7 +14,6 @@ from elasticsearch_tpu.index.shard import IndexShard
 from elasticsearch_tpu.search.context import GlobalStats
 from elasticsearch_tpu.search.service import search_shards
 from elasticsearch_tpu.utils.errors import DocumentMissingException
-from elasticsearch_tpu.utils.hashing import murmur3_32
 
 
 class IndexService:
@@ -39,6 +38,17 @@ class IndexService:
             IndexShard(name, i, self.mappings, self.analysis, data_path)
             for i in range(self.num_shards)
         ]
+        # replica copies + replication groups (reference: primary→replica
+        # sync fanout in TransportShardReplicationOperationAction). Replicas
+        # carry no translog — they re-sync from the primary via peer
+        # recovery on open (recovery.recover_peer).
+        from elasticsearch_tpu.cluster.replication import ReplicationGroup
+
+        self.groups: List[ReplicationGroup] = []
+        for i, primary in enumerate(self.shards):
+            replicas = [IndexShard(name, i, self.mappings, self.analysis, None)
+                        for _ in range(self.num_replicas)]
+            self.groups.append(ReplicationGroup(i, primary, replicas))
         self.closed = False
         self._percolator = None
         self.warmers: Dict[str, dict] = {}
@@ -47,11 +57,26 @@ class IndexService:
             # IndexShardGateway): replay any existing translog on open
             self.recover()
 
+    def fail_shard(self, shard_id: int):
+        """Primary failure → promote a replica (reference: shard failed →
+        allocation promotes an in-sync copy; exposed for failure-injection
+        tests and the future multi-host fault detector)."""
+        group = self.groups[shard_id]
+        new_primary = group.fail_primary()
+        self.shards[shard_id] = new_primary
+        return new_primary
+
     def recover(self):
+        from elasticsearch_tpu.index.recovery import recover_peer
         from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
 
         for shard in self.shards:
             shard.recover()
+        # replicas re-sync from the recovered primary (peer recovery)
+        for group in self.groups:
+            for replica in group.replicas:
+                recover_peer(group.primary.engine, replica.engine)
+        for shard in self.shards:
             # rebuild the in-memory percolator registry from recovered docs
             for doc_id, loc in shard.engine._locations.items():
                 if loc.deleted or loc.doc_type != PERCOLATOR_TYPE:
@@ -85,8 +110,14 @@ class IndexService:
     # -- routing ---------------------------------------------------------------
 
     def route(self, doc_id: str, routing: Optional[str] = None) -> IndexShard:
-        key = routing if routing is not None else str(doc_id)
-        return self.shards[murmur3_32(key) % self.num_shards]
+        from elasticsearch_tpu.cluster.routing import shard_id_for
+
+        return self.shards[shard_id_for(doc_id, self.num_shards, routing)]
+
+    def group_for(self, doc_id: str, routing: Optional[str] = None):
+        from elasticsearch_tpu.cluster.routing import shard_id_for
+
+        return self.groups[shard_id_for(doc_id, self.num_shards, routing)]
 
     # -- document ops ----------------------------------------------------------
 
@@ -97,7 +128,10 @@ class IndexService:
             import uuid
 
             doc_id = uuid.uuid4().hex[:20]
-        shard = self.route(doc_id, routing)
+        from elasticsearch_tpu.cluster.metadata import check_open
+
+        check_open(self)
+        group = self.group_for(doc_id, routing)
         from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
 
         is_perc = kw.get("doc_type") == PERCOLATOR_TYPE
@@ -105,7 +139,7 @@ class IndexService:
             # validate BEFORE persisting: an unparseable percolator query
             # must never reach the translog (it would poison recovery)
             self.percolator.validate(source)
-        rid, version, created = shard.engine.index(doc_id, source, routing=routing, **kw)
+        rid, version, created, failed = group.index(doc_id, source, routing=routing, **kw)
         if is_perc:
             self.percolator.register(rid, source)
         return {
@@ -114,7 +148,9 @@ class IndexService:
             "_version": version,
             "result": "created" if created else "updated",
             "created": created,
-            "_shards": {"total": 1 + self.num_replicas, "successful": 1, "failed": 0},
+            "_shards": {"total": 1 + self.num_replicas,
+                        "successful": 1 + len(group.replicas),
+                        "failed": failed},
         }
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None) -> dict:
@@ -126,8 +162,11 @@ class IndexService:
         return got
 
     def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kw) -> dict:
-        shard = self.route(doc_id, routing)
-        version = shard.engine.delete(doc_id, **kw)
+        from elasticsearch_tpu.cluster.metadata import check_open
+
+        check_open(self)
+        group = self.group_for(doc_id, routing)
+        version, _failed = group.delete(doc_id, **kw)
         if self._percolator is not None:
             self._percolator.unregister(str(doc_id))
         return {
@@ -139,6 +178,9 @@ class IndexService:
         }
 
     def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None) -> dict:
+        from elasticsearch_tpu.cluster.metadata import check_open
+
+        check_open(self)
         shard = self.route(doc_id, routing)
         # percolator docs: validate the would-be merged query BEFORE the
         # engine persists anything, and re-register after (the plain index
@@ -175,6 +217,7 @@ class IndexService:
             upsert=body.get("upsert"),
             doc_as_upsert=bool(body.get("doc_as_upsert", False)),
         )
+        self.group_for(doc_id, routing).replicate_current(str(doc_id))
         if is_perc:
             got = shard.engine.get(str(doc_id))
             if got and got.get("_source"):
@@ -192,8 +235,8 @@ class IndexService:
     # -- search ----------------------------------------------------------------
 
     def refresh(self):
-        for s in self.shards:
-            s.refresh()
+        for g in self.groups:
+            g.refresh()
         self._run_warmers()
 
     def _run_warmers(self):
@@ -215,11 +258,18 @@ class IndexService:
         for s in self.shards:
             s.engine.merge(max_segments=max_num_segments)
 
-    def search(self, body: dict, dfs: bool = False) -> dict:
+    def search(self, body: dict, dfs: bool = False,
+               preference: Optional[str] = None) -> dict:
+        from elasticsearch_tpu.cluster.metadata import check_open
+
+        check_open(self, op="read")
         body = body or {}
         global_stats = self.global_stats(body) if dfs else None
+        # pick one in-sync copy per shard (preference: _primary | _replica |
+        # default round-robin, reference: OperationRouting preference)
+        readers = [g.reader(preference) for g in self.groups]
         resp = search_shards(
-            [s.searcher for s in self.shards], body, index_name=self.name,
+            [s.searcher for s in readers], body, index_name=self.name,
             global_stats=global_stats,
         )
         if body.get("suggest"):
@@ -304,6 +354,7 @@ class IndexService:
         return sum(s.engine.num_docs for s in self.shards)
 
     def close(self):
-        for s in self.shards:
-            s.close()
+        for g in self.groups:
+            for c in g.copies + g.failed_replicas:
+                c.close()
         self.closed = True

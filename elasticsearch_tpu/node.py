@@ -222,15 +222,26 @@ class Node:
 
     # -- search ----------------------------------------------------------------
 
-    def search(self, index: Optional[str], body: dict) -> dict:
+    def search(self, index: Optional[str], body: dict,
+               preference: Optional[str] = None) -> dict:
         names = self.resolve_indices(index)
         if not names and index not in (None, "", "_all", "*"):
             raise IndexNotFoundException(str(index))
         searchers = []
         alias_filters = []
+        from elasticsearch_tpu.cluster.metadata import check_open
+
+        # wildcard/_all expansion SKIPS closed indices; an explicitly named
+        # closed index is an error (reference: IndicesOptions wildcard
+        # expansion defaults to open-only)
+        explicit = {part.strip() for part in str(index or "").split(",")
+                    if part and not any(c in part for c in "*?")}
         for n in names:
             svc = self.indices[n]
-            searchers.extend(s.searcher for s in svc.shards)
+            if svc.closed and n not in explicit:
+                continue
+            check_open(svc, op="read")
+            searchers.extend(g.reader(preference).searcher for g in svc.groups)
         if not searchers:
             return {
                 "took": 0, "timed_out": False,

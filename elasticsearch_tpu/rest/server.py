@@ -141,6 +141,9 @@ def _register_all(rc: RestController):
     add("PUT", "/{index}/_mapping", lambda n, p, b, index: (200, n.put_mapping(index, _json(b))))
     add("PUT", "/{index}/_mapping/{type}", lambda n, p, b, index, type: (200, n.put_mapping(index, _json(b))))
     add("GET", "/{index}/_settings", _get_settings)
+    add("PUT", "/{index}/_settings", _put_settings)
+    add("POST", "/{index}/_close", _close_index)
+    add("POST", "/{index}/_open", _open_index)
     add("GET", "/{index}", _get_index_meta)
     add("POST", "/_aliases", lambda n, p, b: (200, n.update_aliases(_json(b).get("actions", []))))
     add("GET", "/_aliases", _get_aliases)
@@ -383,6 +386,24 @@ def _get_settings(n: Node, p, b, index: str):
     if not out:
         raise IndexNotFoundException(index)
     return 200, out
+
+
+def _put_settings(n: Node, p, b, index: str):
+    from elasticsearch_tpu.cluster.metadata import update_index_settings
+
+    return 200, update_index_settings(n.get_index(index), _json(b))
+
+
+def _close_index(n: Node, p, b, index: str):
+    from elasticsearch_tpu.cluster.metadata import close_index
+
+    return 200, close_index(n, index)
+
+
+def _open_index(n: Node, p, b, index: str):
+    from elasticsearch_tpu.cluster.metadata import open_index
+
+    return 200, open_index(n, index)
 
 
 def _get_index_meta(n: Node, p, b, index: str):
@@ -640,11 +661,11 @@ def _search_body(p, b) -> dict:
 
 
 def _search(n: Node, p, b, index: str):
-    return 200, n.search(index, _search_body(p, b))
+    return 200, n.search(index, _search_body(p, b), preference=p.get("preference"))
 
 
 def _search_all(n: Node, p, b):
-    return 200, n.search(None, _search_body(p, b))
+    return 200, n.search(None, _search_body(p, b), preference=p.get("preference"))
 
 
 def _msearch(n: Node, p, b, index: Optional[str] = None):

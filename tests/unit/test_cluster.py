@@ -1,0 +1,274 @@
+"""Cluster subsystem tests: replication, allocation, discovery, transport,
+metadata (reference: action/support/replication, routing/allocation,
+discovery/zen, transport, cluster/metadata)."""
+import pytest
+
+from elasticsearch_tpu.cluster.discovery import FaultDetector, ZenDiscovery
+from elasticsearch_tpu.cluster.metadata import (
+    IndexClosedException,
+    close_index,
+    open_index,
+    update_index_settings,
+)
+from elasticsearch_tpu.cluster.routing import (
+    FilterDecider,
+    ShardAllocator,
+    shard_id_for,
+)
+from elasticsearch_tpu.cluster.state import ClusterState, DiscoveryNode
+from elasticsearch_tpu.cluster.transport import TransportError, TransportService
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.utils.errors import IllegalArgumentException
+
+
+# -- replication ---------------------------------------------------------------
+
+@pytest.fixture()
+def replicated():
+    s = IndexService("rep", settings={"index": {"number_of_shards": 2,
+                                                "number_of_replicas": 1}})
+    for i in range(20):
+        s.index_doc(str(i), {"v": i, "body": f"doc number {i}"})
+    s.refresh()
+    yield s
+    s.close()
+
+
+def test_writes_fan_out_to_replicas(replicated):
+    for g in replicated.groups:
+        assert len(g.replicas) == 1
+        p_ids = set(g.primary.engine._locations)
+        r_ids = set(g.replicas[0].engine._locations)
+        assert p_ids == r_ids
+
+
+def test_search_replica_preference_consistent(replicated):
+    r_primary = replicated.search({"query": {"match_all": {}}, "size": 0},
+                                  preference="_primary")
+    r_replica = replicated.search({"query": {"match_all": {}}, "size": 0},
+                                  preference="_replica")
+    assert r_primary["hits"]["total"] == r_replica["hits"]["total"] == 20
+
+
+def test_primary_failover_promotes_replica(replicated):
+    replicated.fail_shard(0)
+    # all docs still reachable after promotion
+    r = replicated.search({"query": {"match_all": {}}, "size": 0},
+                          preference="_primary")
+    assert r["hits"]["total"] == 20
+    # writes continue against the promoted primary
+    replicated.index_doc("new", {"v": 100})
+    replicated.refresh()
+    assert replicated.search({"query": {"match_all": {}},
+                              "size": 0})["hits"]["total"] == 21
+
+
+def test_update_replicates_merged_doc(replicated):
+    replicated.update_doc("3", {"doc": {"extra": "yes"}})
+    g = replicated.group_for("3")
+    got = g.replicas[0].engine.get("3")
+    assert got["_source"]["extra"] == "yes"
+
+
+def test_scale_replicas_dynamic(replicated):
+    update_index_settings(replicated, {"index": {"number_of_replicas": 2}})
+    for g in replicated.groups:
+        assert len(g.replicas) == 2
+        assert set(g.replicas[1].engine._locations) == set(g.primary.engine._locations)
+    update_index_settings(replicated, {"number_of_replicas": 0})
+    assert all(not g.replicas for g in replicated.groups)
+    with pytest.raises(IllegalArgumentException):
+        update_index_settings(replicated, {"index": {"number_of_shards": 9}})
+
+
+# -- allocation ----------------------------------------------------------------
+
+def _nodes(n, **attrs):
+    return [DiscoveryNode(f"n{i:02d}", f"node-{i}", attributes=dict(attrs))
+            for i in range(n)]
+
+
+def test_allocator_spreads_and_separates_copies():
+    alloc = ShardAllocator()
+    routing = alloc.allocate_index("idx", num_shards=3, num_replicas=1,
+                                   nodes=_nodes(3))
+    assert all(r.state == "STARTED" for r in routing)
+    for sid in range(3):
+        copies = [r for r in routing if r.shard_id == sid]
+        assert len({r.node_id for r in copies}) == 2  # never co-located
+    counts = {}
+    for r in routing:
+        counts[r.node_id] = counts.get(r.node_id, 0) + 1
+    assert max(counts.values()) - min(counts.values()) <= 1  # balanced
+
+
+def test_allocator_single_node_leaves_replica_unassigned():
+    routing = ShardAllocator().allocate_index("idx", 1, 1, nodes=_nodes(1))
+    primary = next(r for r in routing if r.primary)
+    replica = next(r for r in routing if not r.primary)
+    assert primary.state == "STARTED"
+    assert replica.state == "UNASSIGNED"  # same-shard decider blocks it
+
+
+def test_filter_decider_require_and_exclude():
+    nodes = [DiscoveryNode("a", "hot-node", attributes={"temp": "hot"}),
+             DiscoveryNode("b", "cold-node", attributes={"temp": "cold"})]
+    settings = {"index": {"routing": {"allocation": {"require": {"temp": "hot"}}}}}
+    routing = ShardAllocator().allocate_index("idx", 2, 0, nodes,
+                                              index_settings=settings)
+    assert all(r.node_id == "a" for r in routing)
+    settings = {"index": {"routing": {"allocation": {"exclude": {"temp": "hot"}}}}}
+    routing = ShardAllocator().allocate_index("idx", 2, 0, nodes,
+                                              index_settings=settings)
+    assert all(r.node_id == "b" for r in routing)
+
+
+# -- discovery -----------------------------------------------------------------
+
+def test_zen_election_lowest_id_wins_and_reelects():
+    state = ClusterState()
+    n1 = DiscoveryNode("bbb", "two")
+    zen = ZenDiscovery(state, n1)
+    assert state.master_node_id == "bbb"
+    zen.join(DiscoveryNode("aaa", "one"))
+    assert state.master_node_id == "aaa"  # lower id wins
+    zen.leave("aaa")
+    assert state.master_node_id == "bbb"
+    assert zen.is_master
+
+
+def test_zen_quorum_blocks_election():
+    state = ClusterState()
+    zen = ZenDiscovery(state, DiscoveryNode("aaa", "one"), minimum_master_nodes=2)
+    assert state.master_node_id is None
+    zen.join(DiscoveryNode("bbb", "two"))
+    assert state.master_node_id == "aaa"
+
+
+def test_fault_detector_requires_consecutive_failures():
+    state = ClusterState()
+    zen = ZenDiscovery(state, DiscoveryNode("aaa", "one"))
+    dead = DiscoveryNode("bbb", "two")
+    zen.join(dead)
+    alive = {"bbb": False}
+    fd = zen.make_fault_detector(lambda n: alive.get(n.node_id, True),
+                                 ping_retries=3)
+    others = [dead]
+    assert fd.check(others) == []
+    assert fd.check(others) == []
+    assert fd.check(others) == [dead]  # third consecutive failure
+    assert "bbb" not in state.nodes
+    # a recovering node resets its failure count
+    zen.join(DiscoveryNode("ccc", "three"))
+    alive["ccc"] = False
+    fd.check([state.nodes["ccc"]])
+    alive["ccc"] = True
+    fd.check([state.nodes["ccc"]])
+    alive["ccc"] = False
+    assert fd.check([state.nodes["ccc"]]) == []  # count restarted
+
+
+# -- transport -----------------------------------------------------------------
+
+def test_transport_local_and_tcp_roundtrip():
+    ts = TransportService("n1")
+    ts.register("cluster:state", lambda payload: {"version": 7, "echo": payload})
+    assert ts.send_local("cluster:state", {"x": 1}) == {"version": 7, "echo": {"x": 1}}
+    addr = ts.bind()
+    try:
+        out = ts.send_remote(addr, "cluster:state", {"y": 2})
+        assert out == {"version": 7, "echo": {"y": 2}}
+        assert ts.ping(addr)
+        with pytest.raises(TransportError):
+            ts.send_remote(addr, "no:such:action", {})
+        assert not ts.ping(("127.0.0.1", 1))  # nothing listening
+    finally:
+        ts.close()
+
+
+# -- open/close ----------------------------------------------------------------
+
+def test_close_open_index_blocks_ops():
+    n = Node()
+    n.create_index("c1")
+    n.indices["c1"].index_doc("1", {"v": 1})
+    n.indices["c1"].refresh()
+    close_index(n, "c1")
+    assert n.cluster_state.indices["c1"].state == "close"
+    with pytest.raises(IndexClosedException):
+        n.indices["c1"].index_doc("2", {"v": 2})
+    with pytest.raises(IndexClosedException):
+        n.search("c1", {"query": {"match_all": {}}})
+    open_index(n, "c1")
+    assert n.search("c1", {"query": {"match_all": {}}})["hits"]["total"] == 1
+    for s in n.indices.values():
+        s.close()
+
+
+def test_wildcard_search_skips_closed_index():
+    n = Node()
+    n.create_index("w1")
+    n.create_index("w2")
+    n.indices["w1"].index_doc("1", {"v": 1})
+    n.indices["w2"].index_doc("2", {"v": 2})
+    for s in n.indices.values():
+        s.refresh()
+    close_index(n, "w2")
+    # wildcard/all skips the closed index
+    assert n.search(None, {"size": 0})["hits"]["total"] == 1
+    assert n.search("w*", {"size": 0})["hits"]["total"] == 1
+    # explicit name still errors
+    with pytest.raises(IndexClosedException):
+        n.search("w2", {"size": 0})
+    for s in n.indices.values():
+        s.close()
+
+
+def test_blocks_settings_enforced():
+    from elasticsearch_tpu.cluster.metadata import IndexBlockedException
+
+    svc = IndexService("blk")
+    svc.index_doc("1", {"v": 1})
+    svc.refresh()
+    update_index_settings(svc, {"index": {"blocks.write": True}})
+    with pytest.raises(IndexBlockedException):
+        svc.index_doc("2", {"v": 2})
+    assert svc.search({"size": 0})["hits"]["total"] == 1  # reads still fine
+    update_index_settings(svc, {"index": {"blocks.write": False,
+                                          "blocks.read": True}})
+    with pytest.raises(IndexBlockedException):
+        svc.search({"size": 0})
+    svc.index_doc("2", {"v": 2})  # writes allowed again
+    update_index_settings(svc, {"index": {"blocks.read": False}})
+    svc.close()
+
+
+def test_update_blocked_on_closed_index():
+    n = Node()
+    n.create_index("cu")
+    n.indices["cu"].index_doc("1", {"v": 1})
+    close_index(n, "cu")
+    with pytest.raises(IndexClosedException):
+        n.indices["cu"].update_doc("1", {"doc": {"v": 2}})
+    for s in n.indices.values():
+        s.close()
+
+
+def test_replica_failure_reported_in_shards():
+    svc = IndexService("rf", settings={"index": {"number_of_replicas": 1}})
+    group = svc.groups[0]
+    group.replicas[0].engine.close()
+    # poison the replica so its next index op raises
+    group.replicas[0].engine.index = None  # type: ignore[assignment]
+    r = svc.index_doc("1", {"v": 1})
+    assert r["_shards"]["failed"] == 1
+    assert r["_shards"]["successful"] == 1  # primary only now
+    assert not group.replicas
+    svc.close()
+
+
+def test_shard_id_for_routing_stable():
+    a = shard_id_for("doc1", 5)
+    assert a == shard_id_for("doc1", 5)
+    assert shard_id_for("doc1", 5, routing="user9") == shard_id_for("x", 5, routing="user9")
