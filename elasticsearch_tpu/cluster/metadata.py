@@ -87,6 +87,7 @@ def close_index(node, name: str) -> dict:
     if meta is not None:
         meta.state = "close"
     node.cluster_state.next_version()
+    node._persist_index_meta(svc.name)
     return {"acknowledged": True}
 
 
@@ -97,6 +98,7 @@ def open_index(node, name: str) -> dict:
     if meta is not None:
         meta.state = "open"
     node.cluster_state.next_version()
+    node._persist_index_meta(svc.name)
     return {"acknowledged": True}
 
 

@@ -62,12 +62,17 @@ class ReplicationGroup:
         failed = 0
         for replica in list(self.replicas):
             try:
+                # _replay=True: replicas keep no translog of their own —
+                # durability lives on the primary; a replica re-syncs via
+                # peer recovery, so logging each op here would only grow an
+                # in-memory log without bound
                 if op == "index":
                     replica.engine.index(doc_id, source, version=version,
-                                         version_type="external_gte", **kw)
+                                         version_type="external_gte",
+                                         _replay=True, **kw)
                 else:
                     try:
-                        replica.engine.delete(doc_id)
+                        replica.engine.delete(doc_id, _replay=True)
                     except ElasticsearchTpuException:
                         pass  # already absent on the replica
             except Exception:

@@ -138,9 +138,9 @@ class ShardAllocator:
                        state: str = "STARTED") -> List[ShardRouting]:
         """Assign every copy of every shard; unassignable copies come back
         with state UNASSIGNED (=> yellow/red health, like the reference)."""
-        deciders = list(self.deciders)
+        chain = self
         if index_settings:
-            deciders = deciders + [FilterDecider(index_settings)]
+            chain = ShardAllocator(self.deciders + [FilterDecider(index_settings)])
         alloc = Allocation(nodes=nodes)
         out: List[ShardRouting] = []
         for sid in range(num_shards):
@@ -153,14 +153,7 @@ class ShardAllocator:
                     counts[r.node_id] = counts.get(r.node_id, 0) + 1
                 best = None
                 for node in sorted(nodes, key=lambda n: counts.get(n.node_id, 0)):
-                    v = ALWAYS
-                    for d in deciders:
-                        dv = d.can_allocate(shard, node, alloc)
-                        if dv == NO:
-                            v = NO
-                            break
-                        if dv == THROTTLE:
-                            v = THROTTLE
+                    v = chain.decide(shard, node, alloc)
                     if v == ALWAYS:
                         best = node
                         break
@@ -168,6 +161,9 @@ class ShardAllocator:
                         best = node  # throttled target still wins over none
                 if best is not None:
                     shard.node_id = best.node_id
+                    # NOTE: pass state="INITIALIZING" for recovery-time
+                    # allocation so ThrottlingDecider's cap is live; the
+                    # default STARTED models already-recovered placement
                     shard.state = state
                 alloc.assigned.append(shard)
                 out.append(shard)

@@ -154,6 +154,9 @@ class IndexService:
         }
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None) -> dict:
+        from elasticsearch_tpu.cluster.metadata import check_open
+
+        check_open(self, op="read")
         shard = self.route(doc_id, routing)
         got = shard.engine.get(doc_id)
         if got is None:

@@ -180,6 +180,8 @@ class InvertedField:
     doc_ids_host: Optional[np.ndarray] = None
     # host mirror of tfnorm (dense-impact build, merges)
     tfnorm_host: Optional[np.ndarray] = None
+    # host mirror of raw tf (on-disk codec, index/store.py)
+    tf_host: Optional[np.ndarray] = None
     # lazy cache: sorted terms for prefix/wildcard expansion
     _sorted_terms: Any = None
     # device positional CSR (padded) — built lazily for phrase programs
@@ -656,6 +658,7 @@ class SegmentBuilder:
             positions=np.array(positions_flat, dtype=np.int32),
             doc_ids_host=doc_ids,
             tfnorm_host=tfnorm.astype(np.float32),
+            tf_host=tf_arr,
             max_docs=max_docs,
         )
 

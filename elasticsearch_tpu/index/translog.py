@@ -4,17 +4,28 @@ Reference: org/elasticsearch/index/translog/ — Translog.java (fs),
 TranslogWriter-era logic: an append-only durability log, fsync policy,
 generation rollover on flush ("commit"), and replay on recovery.
 
-Format: one JSON line per operation (index/delete) — the payload is tiny
-relative to device work, and line-framing makes replay/corruption handling
-trivial. A C++ varint/binary codec is the planned R2 upgrade; the interface
-(append/replay/commit) stays the same.
+On-disk format (v2): binary frames
+    [0xE5][u8 version][u32be len][u32be crc32(payload)][payload JSON bytes]
+with the CRC computed by the native C++ codec (elasticsearch_tpu.native,
+native/codec.cpp) — the same role as the reference's
+BufferedChecksumStreamOutput (java.util.zip.CRC32): a torn or bit-rotted
+tail is DETECTED, not silently half-parsed. Replay verifies every frame
+and stops at the first bad one. Legacy v1 JSON-lines generations are still
+readable (format auto-detected per file).
 """
 from __future__ import annotations
 
 import json
 import os
+import struct
 import threading
 from typing import Callable, Iterator, Optional
+
+from elasticsearch_tpu.native import crc32
+
+_MAGIC = 0xE5
+_VERSION = 2
+_HEADER = struct.Struct(">BBII")  # magic, version, len, crc
 
 
 class Translog:
@@ -41,6 +52,15 @@ class Translog:
                 if f.startswith(base + ".") and f.rpartition(".")[2].isdigit():
                     gens.append(int(f.rpartition(".")[2]))
             self.generation = max(gens) if gens else 1
+            # never append v2 frames to a legacy v1 (JSON-lines) generation:
+            # the per-file format sniff is first-byte based, so mixing would
+            # make replay silently drop the v2 tail. Roll to a fresh
+            # generation instead; the old one stays readable for replay.
+            gp = self._gen_path(self.generation)
+            if os.path.exists(gp) and os.path.getsize(gp) > 0:
+                with open(gp, "rb") as f:
+                    if f.read(1)[0] != _MAGIC:
+                        self.generation += 1
             self._fh = open(self._gen_path(self.generation), "ab")
 
     def _gen_path(self, gen: int) -> str:
@@ -54,20 +74,17 @@ class Translog:
             return self._count_ops()
 
     def _count_ops(self) -> int:
-        n = 0
-        p = self._gen_path(self.generation)
-        if os.path.exists(p):
-            with open(p, "rb") as f:
-                n = sum(1 for _ in f)
-        return n
+        return sum(1 for _ in self._iter_file(self._gen_path(self.generation)))
 
     def append(self, op: dict):
-        line = json.dumps(op, separators=(",", ":"))
+        payload = json.dumps(op, separators=(",", ":")).encode()
         with self._lock:
             if self._fh is None:
                 self._mem.append(op)
                 return
-            self._fh.write(line.encode() + b"\n")
+            self._fh.write(_HEADER.pack(_MAGIC, _VERSION, len(payload),
+                                        crc32(payload)))
+            self._fh.write(payload)
             self._ops_since_sync += 1
             if self.durability == "request":
                 self._fh.flush()
@@ -88,10 +105,18 @@ class Translog:
             return
         self.sync()
         for gen in range(from_generation, self.generation + 1):
-            p = self._gen_path(gen)
-            if not os.path.exists(p):
-                continue
-            with open(p, "rb") as f:
+            yield from self._iter_file(self._gen_path(gen))
+
+    @staticmethod
+    def _iter_file(p: str) -> Iterator[dict]:
+        """Parse one generation file; CRC-verified frames (v2) or legacy
+        JSON lines (v1). Stops cleanly at the first torn/corrupt record."""
+        if not os.path.exists(p):
+            return
+        with open(p, "rb") as f:
+            first = f.read(1)
+            f.seek(0)
+            if first and first[0] != _MAGIC:  # legacy v1 JSON lines
                 for line in f:
                     line = line.strip()
                     if not line:
@@ -99,8 +124,22 @@ class Translog:
                     try:
                         yield json.loads(line)
                     except json.JSONDecodeError:
-                        # torn tail write (crash mid-append): stop at corruption
-                        return
+                        return  # torn tail write: stop at corruption
+                return
+            while True:
+                header = f.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    return  # clean EOF or torn header
+                magic, version, n, crc = _HEADER.unpack(header)
+                if magic != _MAGIC or version != _VERSION:
+                    return
+                payload = f.read(n)
+                if len(payload) < n or crc32(payload) != crc:
+                    return  # torn or corrupted frame: recovery stops here
+                try:
+                    yield json.loads(payload)
+                except json.JSONDecodeError:
+                    return
 
     def commit(self):
         """Roll to a new generation and drop old ones (called on flush:

@@ -8,6 +8,8 @@ fan-out), action/search/TransportMultiSearchAction.java.
 from __future__ import annotations
 
 import fnmatch
+import json
+import os
 import re
 import uuid
 from typing import Any, Dict, List, Optional
@@ -37,6 +39,63 @@ class Node:
         self.repositories: Dict[str, Any] = {}
         self.cluster_state = ClusterState(cluster_name)
         self.cluster_state.add_node(DiscoveryNode(self.node_id, name), master=True)
+        if data_path:
+            self._gateway_recover()
+
+    # -- gateway ---------------------------------------------------------------
+
+    def _index_meta_path(self, name: str) -> str:
+        return os.path.join(self.data_path, name, "_meta.json")
+
+    def _persist_index_meta(self, name: str) -> None:
+        """Durable index metadata (reference: gateway stores the cluster
+        MetaData on disk — without it, translogs are orphans on restart)."""
+        if not self.data_path or name not in self.indices:
+            return
+        svc = self.indices[name]
+        path = self._index_meta_path(name)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"settings": svc.settings,
+                       "mappings": svc.mappings.to_json(),
+                       "aliases": svc.aliases,
+                       "closed": bool(svc.closed)}, f)
+        os.replace(tmp, path)
+
+    def _gateway_recover(self) -> None:
+        """Re-open every index found under data_path (reference:
+        GatewayService + LocalGatewayMetaState on node start); each
+        IndexService then replays its shards' translogs."""
+        if not os.path.isdir(self.data_path):
+            return
+        for name in sorted(os.listdir(self.data_path)):
+            meta_path = self._index_meta_path(name)
+            if not os.path.isfile(meta_path):
+                continue
+            try:
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                svc = IndexService(
+                    name, meta.get("settings"),
+                    {"properties": {}} if not meta.get("mappings") else meta["mappings"],
+                    data_path=self.data_path)
+            except Exception:
+                # one unrecoverable index (bad meta, failing replay) must
+                # not stop the node from booting — it just stays absent
+                # (red), reference: per-index recovery failures
+                continue
+            svc.aliases = dict(meta.get("aliases", {}))
+            svc.closed = bool(meta.get("closed", False))
+            self.indices[name] = svc
+            self.cluster_state.add_index(
+                IndexMetadata(name, svc.settings, meta.get("mappings", {}),
+                              svc.aliases),
+                svc.num_shards, self.node_id)
+            if svc.closed:
+                m = self.cluster_state.indices.get(name)
+                if m is not None:
+                    m.state = "close"
 
     # -- index admin -----------------------------------------------------------
 
@@ -69,6 +128,7 @@ class Node:
             IndexMetadata(name, merged_settings, merged_mappings, aliases),
             svc.num_shards, self.node_id,
         )
+        self._persist_index_meta(name)
         return {"acknowledged": True, "shards_acknowledged": True, "index": name}
 
     def delete_index(self, name: str) -> dict:
@@ -78,6 +138,10 @@ class Node:
         for n in found:
             self.indices.pop(n).close()
             self.cluster_state.remove_index(n)
+            if self.data_path:
+                import shutil
+
+                shutil.rmtree(os.path.join(self.data_path, n), ignore_errors=True)
         return {"acknowledged": True}
 
     def index_exists(self, name: str) -> bool:
@@ -131,6 +195,7 @@ class Node:
             svc._validate_analyzers(trial)
         for n in names:
             self.indices[n].mappings.merge(body)
+            self._persist_index_meta(n)
         return {"acknowledged": True}
 
     def get_mapping(self, index: Optional[str] = None) -> dict:
@@ -151,6 +216,7 @@ class Node:
                         }
                     elif op == "remove":
                         self.indices[n].aliases.pop(alias, None)
+                    self._persist_index_meta(n)
         return {"acknowledged": True}
 
     def put_template(self, name: str, body: dict) -> dict:
@@ -232,10 +298,13 @@ class Node:
         from elasticsearch_tpu.cluster.metadata import check_open
 
         # wildcard/_all expansion SKIPS closed indices; an explicitly named
-        # closed index is an error (reference: IndicesOptions wildcard
-        # expansion defaults to open-only)
-        explicit = {part.strip() for part in str(index or "").split(",")
-                    if part and not any(c in part for c in "*?")}
+        # closed index (directly or via an alias) is an error (reference:
+        # IndicesOptions wildcard expansion defaults to open-only)
+        explicit = set()
+        for part in str(index or "").split(","):
+            part = part.strip()
+            if part and not any(c in part for c in "*?") and part not in ("_all",):
+                explicit.update(self.resolve_indices(part) or [part])
         for n in names:
             svc = self.indices[n]
             if svc.closed and n not in explicit:

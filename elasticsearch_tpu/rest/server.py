@@ -391,7 +391,10 @@ def _get_settings(n: Node, p, b, index: str):
 def _put_settings(n: Node, p, b, index: str):
     from elasticsearch_tpu.cluster.metadata import update_index_settings
 
-    return 200, update_index_settings(n.get_index(index), _json(b))
+    svc = n.get_index(index)
+    out = update_index_settings(svc, _json(b))
+    n._persist_index_meta(svc.name)  # dynamic settings survive restarts
+    return 200, out
 
 
 def _close_index(n: Node, p, b, index: str):

@@ -1,0 +1,235 @@
+"""Native codec + translog CRC framing + postings store tests.
+
+Reference: Lucene vInt/PForDelta codecs, translog checksum
+(BufferedChecksumStreamOutput / CRC32).
+"""
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index.index_service import IndexService
+from elasticsearch_tpu.index.store import (
+    CorruptStoreException,
+    read_postings,
+    write_postings,
+)
+from elasticsearch_tpu.index.translog import Translog
+from elasticsearch_tpu.native import (
+    crc32,
+    delta_decode,
+    delta_encode,
+    native_available,
+    vbyte_decode,
+    vbyte_encode,
+)
+
+
+def test_native_lib_builds():
+    # g++ is baked into the image; the native path must actually be active
+    assert native_available()
+
+
+def test_vbyte_roundtrip_matches_and_compresses():
+    rng = np.random.default_rng(1)
+    a = rng.integers(-(10**15), 10**15, 5000)
+    enc = vbyte_encode(a)
+    np.testing.assert_array_equal(vbyte_decode(enc, a.size), a)
+    small = rng.integers(0, 64, 5000)
+    assert len(vbyte_encode(small)) == 5000  # 1 byte per value in [-64, 63]
+
+
+def test_delta_roundtrip_sorted_ids():
+    rng = np.random.default_rng(2)
+    ids = np.sort(rng.choice(10**8, size=4000, replace=False))
+    enc = delta_encode(ids)
+    np.testing.assert_array_equal(delta_decode(enc, ids.size), ids)
+    assert len(enc) < len(vbyte_encode(ids))  # gaps beat absolutes
+
+
+def test_crc32_matches_zlib():
+    for data in (b"", b"x", os.urandom(10_000)):
+        assert crc32(data) == zlib.crc32(data) & 0xFFFFFFFF
+
+
+def test_truncated_input_safe():
+    a = np.arange(1000, dtype=np.int64) * 1000
+    enc = vbyte_encode(a)
+    out = vbyte_decode(enc[: len(enc) // 2], 1000)
+    assert 0 < len(out) < 1000
+
+
+def test_translog_v2_roundtrip_and_torn_tail(tmp_path):
+    p = str(tmp_path / "tl" / "translog")
+    t = Translog(p)
+    ops = [{"op": "index", "id": str(i), "source": {"v": i}} for i in range(50)]
+    for op in ops:
+        t.append(op)
+    t.close()
+    t2 = Translog(p)
+    assert list(t2.replay()) == ops
+    t2.close()
+    # torn tail: truncate mid-frame — replay stops cleanly at the tear
+    gen_file = p + ".1"
+    size = os.path.getsize(gen_file)
+    with open(gen_file, "r+b") as f:
+        f.truncate(size - 7)
+    t3 = Translog(p)
+    replayed = list(t3.replay())
+    assert replayed == ops[:-1]
+    t3.close()
+
+
+def test_translog_v2_detects_bitrot(tmp_path):
+    p = str(tmp_path / "tl" / "translog")
+    t = Translog(p)
+    for i in range(10):
+        t.append({"op": "index", "id": str(i), "source": {"v": i}})
+    t.close()
+    gen_file = p + ".1"
+    with open(gen_file, "r+b") as f:
+        f.seek(os.path.getsize(gen_file) - 3)
+        f.write(b"\xff")  # corrupt the last frame's payload
+    t2 = Translog(p)
+    assert len(list(t2.replay())) == 9  # CRC catches the corrupt frame
+    t2.close()
+
+
+def test_translog_legacy_v1_still_readable(tmp_path):
+    import json
+
+    p = str(tmp_path / "tl" / "translog")
+    os.makedirs(os.path.dirname(p))
+    with open(p + ".1", "wb") as f:
+        for i in range(5):
+            f.write(json.dumps({"op": "index", "id": str(i), "source": {}}).encode() + b"\n")
+    t = Translog(p)
+    assert len(list(t.replay())) == 5
+    t.close()
+
+
+def test_postings_store_roundtrip():
+    svc = IndexService("st")
+    docs = ["quick brown fox", "quick dog", "lazy fox jumps high",
+            "the quick quick fox"]
+    for i, b in enumerate(docs):
+        svc.index_doc(str(i), {"body": b})
+    svc.refresh()
+    inv = svc.shards[0].segments[0].inverted["body"]
+    blob = write_postings(inv)
+    out = read_postings(blob)
+    assert out["terms"] == inv.terms
+    np.testing.assert_array_equal(out["offsets"], inv.offsets)
+    np.testing.assert_array_equal(out["doc_ids"], inv.doc_ids_host[: inv.nnz])
+    np.testing.assert_array_equal(out["df"], inv.df)
+    np.testing.assert_array_equal(out["tf"], inv.tf_host[: inv.nnz].astype(np.int64))
+    np.testing.assert_array_equal(out["positions"], inv.positions)
+    # corruption detected
+    bad = bytearray(blob)
+    bad[-2] ^= 0xFF
+    with pytest.raises(CorruptStoreException):
+        read_postings(bytes(bad))
+    svc.close()
+
+
+def test_node_gateway_recovers_indices_and_mappings(tmp_path):
+    from elasticsearch_tpu.node import Node
+
+    n = Node(data_path=str(tmp_path))
+    n.create_index("g1", {"mappings": {"properties": {
+        "m": {"type": "text", "analyzer": "english"}}},
+        "aliases": {"ga": {}}})
+    n.indices["g1"].index_doc("1", {"m": "running fast"})
+    for s in n.indices.values():
+        s.close()
+    n2 = Node(data_path=str(tmp_path))
+    assert "g1" in n2.indices
+    assert n2.indices["g1"].aliases.get("ga") is not None
+    n2.indices["g1"].refresh()
+    # analyzer survived: stemmed query matches
+    r = n2.search("g1", {"query": {"match": {"m": "run"}}})
+    assert r["hits"]["total"] == 1
+    # alias resolution survived
+    r = n2.search("ga", {"query": {"match_all": {}}})
+    assert r["hits"]["total"] == 1
+    # delete removes on-disk state: next boot has nothing
+    n2.delete_index("g1")
+    n3 = Node(data_path=str(tmp_path))
+    assert "g1" not in n3.indices
+
+
+def test_translog_v1_file_not_mixed_with_v2(tmp_path):
+    import json
+
+    p = str(tmp_path / "tl" / "translog")
+    os.makedirs(os.path.dirname(p))
+    v1_ops = [{"op": "index", "id": str(i), "source": {}} for i in range(3)]
+    with open(p + ".1", "wb") as f:
+        for op in v1_ops:
+            f.write(json.dumps(op).encode() + b"\n")
+    t = Translog(p)
+    assert t.generation == 2  # rolled: never append v2 frames to a v1 file
+    t.append({"op": "index", "id": "new", "source": {}})
+    t.close()
+    t2 = Translog(p)
+    replayed = list(t2.replay())
+    assert replayed == v1_ops + [{"op": "index", "id": "new", "source": {}}]
+    t2.close()
+
+
+def test_gateway_persists_closed_state_and_dynamic_settings(tmp_path):
+    from elasticsearch_tpu.cluster.metadata import (
+        IndexClosedException,
+        close_index,
+        update_index_settings,
+    )
+    from elasticsearch_tpu.node import Node
+
+    n = Node(data_path=str(tmp_path))
+    n.create_index("cs")
+    update_index_settings(n.indices["cs"], {"index": {"number_of_replicas": 1}})
+    n._persist_index_meta("cs")
+    close_index(n, "cs")
+    for s in n.indices.values():
+        s.close()
+    n2 = Node(data_path=str(tmp_path))
+    assert n2.indices["cs"].closed
+    assert n2.indices["cs"].num_replicas == 1
+    with pytest.raises(IndexClosedException):
+        n2.indices["cs"].index_doc("1", {"v": 1})
+
+
+def test_closed_index_via_alias_raises():
+    from elasticsearch_tpu.cluster.metadata import IndexClosedException, close_index
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    n.create_index("al1", {"aliases": {"myalias": {}}})
+    close_index(n, "al1")
+    with pytest.raises(IndexClosedException):
+        n.search("myalias", {"size": 0})
+    for s in n.indices.values():
+        s.close()
+
+
+def test_replica_translog_does_not_accumulate():
+    svc = IndexService("notl", settings={"index": {"number_of_replicas": 1}})
+    for i in range(30):
+        svc.index_doc(str(i), {"v": i})
+    replica = svc.groups[0].replicas[0]
+    assert replica.engine.translog.size_in_ops == 0  # no per-op log on replicas
+    svc.close()
+
+
+def test_engine_recovery_through_v2_translog(tmp_path):
+    s = IndexService("rec2", data_path=str(tmp_path))
+    for i in range(20):
+        s.index_doc(str(i), {"v": i})
+    s.delete_doc("5")
+    s.close()
+    s2 = IndexService("rec2", data_path=str(tmp_path))
+    assert s2.num_docs == 19
+    assert s2.get_doc("7")["found"]
+    assert not s2.get_doc("5")["found"]
+    s2.close()
