@@ -41,8 +41,11 @@ def _flatten(settings: dict, prefix: str = "") -> Dict[str, object]:
     return out
 
 
-def update_index_settings(svc, body: dict) -> dict:
-    """PUT /{index}/_settings — dynamic settings only on an open index."""
+def update_index_settings(svc, body: dict, node=None) -> dict:
+    """PUT /{index}/_settings — dynamic settings only on an open index.
+
+    Persistence happens HERE (given a node), not in transport handlers, so
+    every entry point that changes settings also survives restarts."""
     flat = _flatten(body.get("settings", body))
     flat = {k[len("index."):] if k.startswith("index.") else k: v
             for k, v in flat.items()}
@@ -55,6 +58,8 @@ def update_index_settings(svc, body: dict) -> dict:
     idx = svc.settings.setdefault("index", {})
     for k, v in flat.items():
         idx[k] = v
+    if node is not None:
+        node._persist_index_meta(svc.name)
     return {"acknowledged": True}
 
 

@@ -102,7 +102,12 @@ def read_postings(data: bytes) -> Dict[str, Any]:
     if len(data) < 4:
         raise CorruptStoreException("postings blob truncated")
     (hlen,) = _U32.unpack(data[:4])
-    header = json.loads(data[4 : 4 + hlen])
+    if 4 + hlen > len(data):
+        raise CorruptStoreException("postings header exceeds blob size")
+    try:
+        header = json.loads(data[4 : 4 + hlen])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CorruptStoreException(f"postings header unreadable: {e}")
     cursor = 4 + hlen
     arrays: Dict[str, np.ndarray] = {}
     for sec in header["sections"]:

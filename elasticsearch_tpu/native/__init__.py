@@ -40,7 +40,9 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
             so_path = os.path.join(_BUILD_DIR, f"codec_{tag}.so")
             if not os.path.exists(so_path):
                 os.makedirs(_BUILD_DIR, exist_ok=True)
-                tmp = so_path + ".tmp.so"
+                # pid-unique tmp: concurrent first-builds (multiple procs)
+                # must not interleave into one file; os.replace is atomic
+                tmp = f"{so_path}.{os.getpid()}.tmp.so"
                 subprocess.run(
                     ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
                      "-o", tmp, _SRC],

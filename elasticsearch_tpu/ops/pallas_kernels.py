@@ -1,0 +1,158 @@
+"""Pallas TPU kernels for the kNN hot path.
+
+The XLA path (ops/knn.py) materializes the full [Q, D] similarity matrix in
+HBM before top-k — at SIFT scale (D=1M, Q=64) that is a 256 MB round trip
+per batch. This kernel streams corpus tiles HBM→VMEM, runs the MXU matmul
+per tile, applies the metric transform + live-doc mask on the VPU, and
+maintains the running top-k in the output block across sequential grid
+steps — the [Q, D] intermediate never exists.
+
+Top-k merge strategy: k is small (ES size/num_candidates, ≤64 here) so each
+tile does k iterations of (row-max, argmax, knock-out) over the fused
+[Q, TILE+K] candidate block — pure VPU reductions, no sort network needed.
+
+Falls back to interpret mode on CPU (tests) and to the XLA path for shapes
+the kernel doesn't cover; both produce identical results (modulo fp
+reduction order), asserted in tests/unit/test_pallas_kernels.py.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = float("-inf")  # python scalar: jnp constants would be captured consts in pallas
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+@partial(jax.jit, static_argnames=("k", "metric", "tile", "interpret"))
+def knn_topk_pallas(queries, vecs, mask, *, k: int, metric: str = "cosine",
+                    tile: int = 2048, interpret: bool = False):
+    """Fused scores + mask + running top-k over corpus tiles.
+
+    queries: f32[Q, dims] (Q, dims small enough for VMEM residency)
+    vecs:    f32[D, dims], D % tile == 0 (caller pads; padded rows masked)
+    mask:    bool[D] live-doc mask
+    Returns ([Q, k] scores, [Q, k] int32 doc ids), same contract as
+    ops.knn.knn_topk.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    Q, dims = queries.shape
+    D = vecs.shape[0]
+    assert D % tile == 0, "corpus must be padded to a tile multiple"
+    n_tiles = D // tile
+
+    if metric == "cosine":
+        qn = queries / jnp.maximum(
+            jnp.linalg.norm(queries, axis=-1, keepdims=True), 1e-12)
+    else:
+        qn = queries
+    qh = qn.astype(jnp.bfloat16)
+
+    def kernel(q_ref, v_ref, m_ref, out_v_ref, out_i_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            out_v_ref[:] = jnp.full((Q, k), NEG_INF, dtype=jnp.float32)
+            out_i_ref[:] = jnp.zeros((Q, k), dtype=jnp.int32)
+
+        v = v_ref[:]  # [tile, dims] f32
+        if metric == "cosine":
+            norm = jnp.sqrt(jnp.sum(v * v, axis=-1, keepdims=True))
+            v = v / jnp.maximum(norm, 1e-12)
+        s = jax.lax.dot_general(
+            q_ref[:], v.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Q, tile]
+        if metric in ("cosine", "dot_product", "dot"):
+            s = (1.0 + s) * 0.5
+        else:  # l2_norm via norm expansion
+            q2 = jnp.sum(q_ref[:].astype(jnp.float32) ** 2, axis=-1,
+                         keepdims=True)
+            v2 = jnp.sum(v.astype(jnp.float32) ** 2, axis=-1)[None, :]
+            s = 1.0 / (1.0 + jnp.maximum(q2 - 2.0 * s + v2, 0.0))
+        s = jnp.where(m_ref[:][None, :], s, NEG_INF)
+
+        base = step * tile
+        tile_ids = base + jax.lax.broadcasted_iota(jnp.int32, (Q, tile), 1)
+
+        # fused candidates: previous best (k) + this tile
+        cand_v = jnp.concatenate([out_v_ref[:], s], axis=1)  # [Q, k+tile]
+        cand_i = jnp.concatenate([out_i_ref[:], tile_ids], axis=1)
+
+        # k iterations of extract-max (VPU row reductions). No gathers —
+        # Mosaic lowers mask-reduce, not take_along_axis: the picked id is
+        # recovered by masking the id matrix with the argmax column.
+        def extract(j, carry):
+            cv, ci, bv, bi = carry
+            m = jnp.max(cv, axis=1)  # [Q]
+            am = jnp.argmax(cv, axis=1)  # [Q]
+            width = cv.shape[1]
+            knock = jax.lax.broadcasted_iota(jnp.int32, (Q, width), 1) == am[:, None]
+            picked_i = jnp.max(jnp.where(knock, ci, jnp.int32(-1)), axis=1)
+            # column-j store via iota mask (dynamic .at[] would be a scatter)
+            col_j = jax.lax.broadcasted_iota(jnp.int32, (Q, k), 1) == j
+            bv = jnp.where(col_j, m[:, None], bv)
+            bi = jnp.where(col_j, picked_i[:, None], bi)
+            cv = jnp.where(knock, NEG_INF, cv)  # knock out the chosen column
+            return cv, ci, bv, bi
+
+        bv0 = jnp.full((Q, k), NEG_INF, dtype=jnp.float32)
+        bi0 = jnp.zeros((Q, k), dtype=jnp.int32)
+        _, _, bv, bi = jax.lax.fori_loop(
+            0, k, extract, (cand_v, cand_i, bv0, bi0))
+        out_v_ref[:] = bv
+        out_i_ref[:] = bi
+
+    out_v, out_i = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((Q, dims), lambda i: (0, 0)),          # queries: resident
+            pl.BlockSpec((tile, dims), lambda i: (i, 0)),       # corpus tile
+            pl.BlockSpec((tile,), lambda i: (i,)),              # mask tile
+        ],
+        out_specs=[
+            pl.BlockSpec((Q, k), lambda i: (0, 0)),             # running top-k
+            pl.BlockSpec((Q, k), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Q, k), jnp.float32),
+            jax.ShapeDtypeStruct((Q, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(qh, vecs, mask)
+    return out_v, out_i
+
+
+def knn_topk_auto(queries, vecs, mask, *, k: int, metric: str = "cosine"):
+    """Dispatch: Pallas fused kernel on TPU when shapes fit, XLA otherwise.
+
+    Dispatch is decided purely from STATIC shape gates — no try/except:
+    this is routinely called inside an outer jit/shard_map trace, where
+    Mosaic lowering errors surface at outer-compile time (after any except
+    block here has exited), so a runtime fallback would be an illusion.
+    The gates mirror what the kernel is validated for on hardware: Q a
+    sublane multiple, lane-aligned dims, small k, tile-divisible corpus."""
+    from elasticsearch_tpu.ops.knn import knn_topk
+
+    Q, dims = queries.shape
+    D = vecs.shape[0]
+    tile = 8192 if D % 8192 == 0 else 2048
+    if (_on_tpu() and k <= 64 and Q % 8 == 0 and dims % 128 == 0
+            and D % tile == 0 and D >= 2 * tile):
+        return knn_topk_pallas(queries, vecs, mask, k=k, metric=metric,
+                               tile=tile)
+    return knn_topk(queries, vecs, mask, k=k, metric=metric)

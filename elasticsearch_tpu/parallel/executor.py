@@ -115,12 +115,12 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
     from elasticsearch_tpu.parallel.mesh import get_shard_map as _gsm; shard_map = _gsm()
     from jax.sharding import PartitionSpec as PS
 
-    from elasticsearch_tpu.ops.knn import knn_scores
+    from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
 
     def body(queries, vecs, live):
-        scores = knn_scores(queries, vecs[0], metric=metric)  # [Q, D]
-        masked = jnp.where(live[0][None, :], scores, -jnp.inf)
-        vals, idx = lax.top_k(masked, k)
+        # per-shard fused scores+mask+topk: the Pallas streaming kernel on
+        # TPU (no [Q, D] HBM intermediate), the XLA path elsewhere
+        vals, idx = knn_topk_auto(queries, vecs[0], live[0], k=k, metric=metric)
         av = lax.all_gather(vals, "shard")
         ai = lax.all_gather(idx, "shard")
         S = av.shape[0]

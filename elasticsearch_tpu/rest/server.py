@@ -391,10 +391,7 @@ def _get_settings(n: Node, p, b, index: str):
 def _put_settings(n: Node, p, b, index: str):
     from elasticsearch_tpu.cluster.metadata import update_index_settings
 
-    svc = n.get_index(index)
-    out = update_index_settings(svc, _json(b))
-    n._persist_index_meta(svc.name)  # dynamic settings survive restarts
-    return 200, out
+    return 200, update_index_settings(n.get_index(index), _json(b), node=n)
 
 
 def _close_index(n: Node, p, b, index: str):

@@ -188,8 +188,8 @@ def test_gateway_persists_closed_state_and_dynamic_settings(tmp_path):
 
     n = Node(data_path=str(tmp_path))
     n.create_index("cs")
-    update_index_settings(n.indices["cs"], {"index": {"number_of_replicas": 1}})
-    n._persist_index_meta("cs")
+    update_index_settings(n.indices["cs"], {"index": {"number_of_replicas": 1}},
+                          node=n)
     close_index(n, "cs")
     for s in n.indices.values():
         s.close()
