@@ -284,6 +284,8 @@ class IndexService:
         + search-embedded SuggestPhase)."""
         from elasticsearch_tpu.search.suggest import execute_suggest
 
+        for sh in self.shards:
+            sh.searcher.stats.on_suggest()
         return execute_suggest(self.shards, body or {}, self.analysis)
 
     # -- percolator ------------------------------------------------------------

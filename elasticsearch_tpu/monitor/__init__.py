@@ -1,0 +1,3 @@
+from elasticsearch_tpu.monitor.stats import SearchStats, os_stats, process_stats
+
+__all__ = ["SearchStats", "os_stats", "process_stats"]

@@ -1,0 +1,128 @@
+"""Monitoring: process/OS/device stats and search-phase counters.
+
+Reference: org/elasticsearch/monitor/ — process/ProcessService.java,
+os/OsService.java, jvm/JvmService.java feeding _nodes/stats, and
+index/search/stats/SearchStats.java (query/fetch counts + cumulative
+times per shard).
+
+TPU adaptation: the "jvm" section maps to the Python process + the jax
+device (HBM bytes in use via device memory stats when the backend exposes
+them); search stats count compiled-program executions rather than Lucene
+collector invocations, but the response shape matches the reference so
+dashboards keep working.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+
+class SearchStats:
+    """Per-shard-ish search counters (reference: SearchStats.Stats)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.query_total = 0
+        self.query_time_ms = 0.0
+        self.fetch_total = 0
+        self.fetch_time_ms = 0.0
+        self.suggest_total = 0
+        self.scroll_total = 0
+
+    def on_query(self, ms: float):
+        with self._lock:
+            self.query_total += 1
+            self.query_time_ms += ms
+
+    def on_fetch(self, ms: float):
+        with self._lock:
+            self.fetch_total += 1
+            self.fetch_time_ms += ms
+
+    def on_suggest(self):
+        with self._lock:
+            self.suggest_total += 1
+
+    def on_scroll(self):
+        with self._lock:
+            self.scroll_total += 1
+
+    def to_json(self) -> dict:
+        return {
+            "query_total": self.query_total,
+            "query_time_in_millis": int(self.query_time_ms),
+            "fetch_total": self.fetch_total,
+            "fetch_time_in_millis": int(self.fetch_time_ms),
+            "suggest_total": self.suggest_total,
+            "scroll_total": self.scroll_total,
+        }
+
+
+def process_stats() -> dict:
+    """Process-level stats (reference: ProcessService → _nodes/stats.process)."""
+    import resource
+
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    out: Dict[str, Any] = {
+        "timestamp": int(time.time() * 1000),
+        "open_file_descriptors": _count_fds(),
+        "cpu": {"total_in_millis": int((ru.ru_utime + ru.ru_stime) * 1000)},
+        "mem": {"resident_in_bytes": ru.ru_maxrss * 1024},
+    }
+    return out
+
+
+def _count_fds() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1
+
+
+def os_stats() -> dict:
+    """Host stats (reference: OsService → _nodes/stats.os)."""
+    out: Dict[str, Any] = {"timestamp": int(time.time() * 1000)}
+    try:
+        load1, load5, load15 = os.getloadavg()
+        out["cpu"] = {"load_average": {"1m": load1, "5m": load5, "15m": load15}}
+    except OSError:
+        pass
+    try:
+        with open("/proc/meminfo") as f:
+            mem = {}
+            for line in f:
+                parts = line.split()
+                if parts[0] in ("MemTotal:", "MemFree:", "MemAvailable:"):
+                    mem[parts[0][:-1]] = int(parts[1]) * 1024
+        out["mem"] = {
+            "total_in_bytes": mem.get("MemTotal", 0),
+            "free_in_bytes": mem.get("MemFree", 0),
+            "available_in_bytes": mem.get("MemAvailable", 0),
+        }
+    except OSError:
+        pass
+    return out
+
+
+def device_stats() -> dict:
+    """Accelerator stats — the TPU-native analogue of the reference's JVM
+    heap section: device kind + HBM usage when the backend exposes it."""
+    out: Dict[str, Any] = {}
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        out["platform"] = dev.platform
+        out["device_kind"] = getattr(dev, "device_kind", "unknown")
+        ms = getattr(dev, "memory_stats", None)
+        if callable(ms):
+            stats = ms() or {}
+            out["hbm"] = {
+                "bytes_in_use": stats.get("bytes_in_use", 0),
+                "bytes_limit": stats.get("bytes_limit", 0),
+            }
+    except Exception:
+        out["platform"] = "unavailable"
+    return out

@@ -47,6 +47,8 @@ def knn_topk_pallas(queries, vecs, mask, *, k: int, metric: str = "cosine",
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if metric not in ("cosine", "dot_product", "dot", "l2_norm", "l2"):
+        raise ValueError(f"unknown knn metric [{metric}]")  # match ops.knn
     Q, dims = queries.shape
     D = vecs.shape[0]
     assert D % tile == 0, "corpus must be padded to a tile multiple"

@@ -120,6 +120,21 @@ def _register_all(rc: RestController):
     add("GET", "/_cat/templates", lambda n, p, b: (200, [
         {"name": k, "index_patterns": v.get("index_patterns", [v.get("template", "")])}
         for k, v in n.cluster_state.templates.items()]))
+    add("GET", "/_cat/master", lambda n, p, b: (200, [{
+        "id": n.cluster_state.master_node_id, "node": n.name}]))
+    add("GET", "/_cat/aliases", _cat_aliases)
+    add("GET", "/_cat/allocation", _cat_allocation)
+    add("GET", "/_cat/segments", _cat_segments)
+    add("GET", "/_cat/recovery", _cat_recovery)
+    add("GET", "/_cat/plugins", lambda n, p, b: (200, []))
+    add("GET", "/_cat/pending_tasks", lambda n, p, b: (200, []))
+    add("GET", "/_cat/thread_pool", lambda n, p, b: (200, [
+        {"node_name": n.name, "name": pool, "active": 0, "queue": 0, "rejected": 0}
+        for pool in ("search", "index", "bulk", "get")]))
+    add("GET", "/_cat/fielddata", lambda n, p, b: (200, []))
+    add("GET", "/_cat/repositories", lambda n, p, b: (200, [
+        {"id": name, "type": "fs"} for name in n.repositories]))
+    add("GET", "/_cat/snapshots/{repo}", _cat_snapshots)
 
     # snapshot API (before /{index} patterns so the literal prefix wins)
     add("PUT", "/_snapshot/{repo}", _put_repo)
@@ -362,6 +377,56 @@ def _cat_shards(n: Node, p, b):
 
 def _cat_nodes(n: Node, p, b):
     return 200, [{"name": n.name, "node.role": "mdi", "master": "*"}]
+
+
+def _cat_aliases(n: Node, p, b):
+    rows = []
+    for iname, svc in n.indices.items():
+        for alias, spec in svc.aliases.items():
+            rows.append({"alias": alias, "index": iname,
+                         "filter": "*" if spec.get("filter") else "-"})
+    return 200, rows
+
+
+def _cat_allocation(n: Node, p, b):
+    shards = disk = 0
+    for svc in n.indices.values():
+        for g in svc.groups:
+            for sh in g.copies:  # primaries AND replicas, same basis for both
+                shards += 1
+                disk += sum(seg.memory_bytes() for seg in sh.segments)
+    return 200, [{"node": n.name, "shards": shards, "disk.indices": disk}]
+
+
+def _cat_segments(n: Node, p, b):
+    rows = []
+    for iname, svc in n.indices.items():
+        for sh in svc.shards:
+            for seg in sh.segments:
+                rows.append({
+                    "index": iname, "shard": sh.shard_id, "prirep": "p",
+                    "segment": f"_{seg.seg_id}", "docs.count": seg.live_docs,
+                    "docs.deleted": seg.deleted_count,
+                    "size.memory": seg.memory_bytes(),
+                })
+    return 200, rows
+
+
+def _cat_recovery(n: Node, p, b):
+    rows = []
+    for iname, svc in n.indices.items():
+        for sh in svc.shards:
+            rows.append({"index": iname, "shard": sh.shard_id,
+                         "type": "gateway" if svc.data_path else "empty_store",
+                         "stage": "done" if sh.state == "STARTED" else sh.state.lower()})
+    return 200, rows
+
+
+def _cat_snapshots(n: Node, p, b, repo: str):
+    from elasticsearch_tpu.index.snapshots import snapshot_info
+
+    r = _repo_or_404(n, repo)
+    return 200, [snapshot_info(r, s) for s in r.catalog()]
 
 
 def _cat_count(n: Node, p, b, index: Optional[str] = None):

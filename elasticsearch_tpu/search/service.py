@@ -61,10 +61,13 @@ class ShardSearcher:
     """Executes search phases against one shard (list of segments)."""
 
     def __init__(self, segments, mappings, analysis, shard_ord: int = 0):
+        from elasticsearch_tpu.monitor.stats import SearchStats
+
         self.segments = segments
         self.mappings = mappings
         self.analysis = analysis
         self.shard_ord = shard_ord
+        self.stats = SearchStats()
 
     # -- query phase -----------------------------------------------------------
 
@@ -348,7 +351,24 @@ def search_shards(
     # scroll keeps the whole result window (up to the 10k cap per shard) in
     # the snapshot so subsequent pages don't re-run the query phase
     extra_k = 10_000 if body.get("scroll") else 0
-    results = [s.query_phase(body, global_stats, extra_k=extra_k) for s in searchers]
+    profile = bool(body.get("profile"))
+    shard_profiles: List[dict] = []
+    results = []
+    for s in searchers:
+        tq = time.perf_counter()
+        r = s.query_phase(body, global_stats, extra_k=extra_k)
+        q_ms = (time.perf_counter() - tq) * 1000
+        s.stats.on_query(q_ms)
+        results.append(r)
+        if profile:
+            shard_profiles.append({
+                "id": f"[shard][{s.shard_ord}]",
+                "searches": [{"query": [{
+                    "type": "CompiledSegmentProgram",
+                    "description": "whole-segment score/mask program",
+                    "time_in_nanos": int(q_ms * 1e6),
+                }]}],
+            })
     all_docs: List[ShardDoc] = []
     total = 0
     max_score = float("-inf")
@@ -368,7 +388,12 @@ def search_shards(
         by_shard.setdefault(d.shard_ord, []).append(d)
     hits: List[dict] = []
     for shard_ord, docs in by_shard.items():
+        tf = time.perf_counter()
         hits.extend(searchers[shard_ord].fetch_phase(docs, body, index_name))
+        f_ms = (time.perf_counter() - tf) * 1000
+        searchers[shard_ord].stats.on_fetch(f_ms)
+        if profile and shard_ord < len(shard_profiles):
+            shard_profiles[shard_ord]["fetch"] = {"time_in_nanos": int(f_ms * 1e6)}
     # restore global order after per-shard fetch
     order = {(d.shard_ord, id(d.seg), d.local_id): i for i, d in enumerate(page)}
     hits_docs = list(zip(hits, [d for docs in by_shard.values() for d in docs]))
@@ -390,6 +415,8 @@ def search_shards(
         aggs = aggs_present[0]["_aggs"]
         partial_lists = [p for r in aggs_present for p in r["_list"]]
         response["aggregations"] = reduce_aggs(aggs, partial_lists)
+    if profile:
+        response["profile"] = {"shards": shard_profiles}
     if body.get("scroll"):
         scroll_id = uuid.uuid4().hex
         _SCROLLS[scroll_id] = {
@@ -408,6 +435,8 @@ def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
     state = _SCROLLS.get(scroll_id)
     if state is None:
         raise SearchParseException(f"no search context found for id [{scroll_id}]")
+    for s in state["searchers"]:
+        s.stats.on_scroll()
     body = state["body"]
     sz = size or int(body.get("size", 10))
     page = state["docs"][state["pos"] : state["pos"] + sz]

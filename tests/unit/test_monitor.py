@@ -1,0 +1,86 @@
+"""Monitoring, profile API, and _cat family tests (reference: monitor/*,
+search profile, rest/action/cat/*)."""
+import pytest
+
+from elasticsearch_tpu.monitor.stats import SearchStats, os_stats, process_stats
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.create_index("m1", {"mappings": {"properties": {"t": {"type": "text"}}}})
+    svc = n.indices["m1"]
+    for i in range(5):
+        svc.index_doc(str(i), {"t": f"hello world {i}"})
+    svc.refresh()
+    yield n
+    for s in n.indices.values():
+        s.close()
+
+
+def test_search_stats_counters(node):
+    svc = node.indices["m1"]
+    for _ in range(3):
+        svc.search({"query": {"match": {"t": "hello"}}})
+    stats = svc.shards[0].searcher.stats.to_json()
+    assert stats["query_total"] >= 3
+    assert stats["fetch_total"] >= 3
+    assert stats["query_time_in_millis"] >= 0
+
+
+def test_nodes_stats_shape(node):
+    node.indices["m1"].search({"query": {"match_all": {}}})
+    stats = node.nodes_stats()
+    nstats = stats["nodes"][node.node_id]
+    assert nstats["indices"]["docs"]["count"] == 5
+    assert nstats["indices"]["search"]["query_total"] >= 1
+    assert nstats["indices"]["indexing"]["index_total"] == 5
+    assert nstats["indices"]["segments"]["count"] >= 1
+    assert nstats["process"]["mem"]["resident_in_bytes"] > 0
+    assert "accelerator" in nstats
+
+
+def test_profile_api(node):
+    resp = node.indices["m1"].search({"query": {"match": {"t": "hello"}},
+                                      "profile": True})
+    prof = resp["profile"]["shards"]
+    assert len(prof) == 1
+    q = prof[0]["searches"][0]["query"][0]
+    assert q["time_in_nanos"] >= 0
+    assert "fetch" in prof[0]
+
+
+def test_suggest_scroll_counters_and_jvm_parity(node):
+    svc = node.indices["m1"]
+    svc.suggest({"s": {"text": "helo", "term": {"field": "t", "min_word_length": 3}}})
+    r = svc.search({"query": {"match_all": {}}, "scroll": "1m", "size": 2})
+    from elasticsearch_tpu.search.service import scroll_next
+
+    scroll_next(r["_scroll_id"])
+    stats = node.nodes_stats()["nodes"][node.node_id]
+    assert stats["indices"]["search"]["suggest_total"] >= 1
+    assert stats["indices"]["search"]["scroll_total"] >= 1
+    # ES-2.0 dashboards read jvm.mem — the key must exist
+    assert stats["jvm"]["mem"]["heap_used_in_bytes"] > 0
+
+
+def test_process_and_os_stats_standalone():
+    p = process_stats()
+    assert p["mem"]["resident_in_bytes"] > 0
+    assert p["open_file_descriptors"] != 0
+    o = os_stats()
+    assert "timestamp" in o
+
+
+def test_cat_endpoints(node):
+    from elasticsearch_tpu.rest.server import RestController
+
+    rc = RestController(node)
+    for path in ("/_cat/segments", "/_cat/allocation", "/_cat/master",
+                 "/_cat/aliases", "/_cat/recovery", "/_cat/thread_pool",
+                 "/_cat/repositories", "/_cat/plugins"):
+        status, out = rc.dispatch("GET", path, {}, b"")
+        assert status == 200, path
+    status, segs = rc.dispatch("GET", "/_cat/segments", {}, b"")
+    assert segs and segs[0]["docs.count"] == 5
