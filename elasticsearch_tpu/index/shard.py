@@ -30,7 +30,8 @@ class IndexShard:
         if data_path:
             translog_path = os.path.join(data_path, index_name, str(shard_id), "translog")
         self.engine = Engine(mappings, analysis, translog_path=translog_path)
-        self.searcher = ShardSearcher(self.engine.segments, mappings, analysis, shard_ord=shard_id)
+        self.searcher = ShardSearcher(self.engine.segments, mappings, analysis,
+                                      shard_ord=shard_id, index_name=index_name)
         self.state = "STARTED"
 
     def recover(self):

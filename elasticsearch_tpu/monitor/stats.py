@@ -69,9 +69,25 @@ def process_stats() -> dict:
         "timestamp": int(time.time() * 1000),
         "open_file_descriptors": _count_fds(),
         "cpu": {"total_in_millis": int((ru.ru_utime + ru.ru_stime) * 1000)},
-        "mem": {"resident_in_bytes": ru.ru_maxrss * 1024},
+        "mem": {
+            # CURRENT resident set (dashboards treat this as live memory);
+            # peak kept under its honest name
+            "resident_in_bytes": _current_rss() or ru.ru_maxrss * 1024,
+            "peak_resident_in_bytes": ru.ru_maxrss * 1024,
+        },
     }
     return out
+
+
+def _current_rss() -> int:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
 
 
 def _count_fds() -> int:

@@ -343,9 +343,10 @@ class Node:
     def nodes_stats(self) -> dict:
         from elasticsearch_tpu.monitor.stats import device_stats, os_stats, process_stats
 
-        search = {"query_total": 0, "query_time_in_millis": 0,
-                  "fetch_total": 0, "fetch_time_in_millis": 0,
-                  "suggest_total": 0, "scroll_total": 0}
+        from elasticsearch_tpu.monitor.stats import SearchStats
+
+        # seed keys from SearchStats itself: one source of truth
+        search = {k: 0 for k in SearchStats().to_json()}
         indexing = {"index_total": 0, "delete_total": 0, "index_time_in_millis": 0}
         seg_count = seg_mem = 0
         for svc in self.indices.values():

@@ -194,6 +194,9 @@ def _register_all(rc: RestController):
     add("HEAD", "/{index}/_doc/{id}", _doc_exists)
     add("DELETE", "/{index}/_doc/{id}", _delete_doc)
     add("POST", "/{index}/_update/{id}", _update_doc)
+    add("POST", "/{index}/_delete_by_query", _delete_by_query)
+    add("DELETE", "/{index}/_query", _delete_by_query)  # ES 2.0 plugin path
+    add("POST", "/{index}/_update_by_query", _update_by_query)
     add("GET", "/{index}/_source/{id}", _get_source)
     add("POST", "/_mget", _mget)
     add("POST", "/{index}/_mget", _mget_index)
@@ -401,24 +404,28 @@ def _cat_allocation(n: Node, p, b):
 def _cat_segments(n: Node, p, b):
     rows = []
     for iname, svc in n.indices.items():
-        for sh in svc.shards:
-            for seg in sh.segments:
-                rows.append({
-                    "index": iname, "shard": sh.shard_id, "prirep": "p",
-                    "segment": f"_{seg.seg_id}", "docs.count": seg.live_docs,
-                    "docs.deleted": seg.deleted_count,
-                    "size.memory": seg.memory_bytes(),
-                })
+        for g in svc.groups:
+            for sh in g.copies:  # primaries and replicas, like _cat_shards
+                prirep = "p" if sh is g.primary else "r"
+                for seg in sh.segments:
+                    rows.append({
+                        "index": iname, "shard": sh.shard_id, "prirep": prirep,
+                        "segment": f"_{seg.seg_id}", "docs.count": seg.live_docs,
+                        "docs.deleted": seg.deleted_count,
+                        "size.memory": seg.memory_bytes(),
+                    })
     return 200, rows
 
 
 def _cat_recovery(n: Node, p, b):
     rows = []
     for iname, svc in n.indices.items():
-        for sh in svc.shards:
-            rows.append({"index": iname, "shard": sh.shard_id,
-                         "type": "gateway" if svc.data_path else "empty_store",
-                         "stage": "done" if sh.state == "STARTED" else sh.state.lower()})
+        for g in svc.groups:
+            for sh in g.copies:
+                rtype = ("gateway" if (sh is g.primary and svc.data_path)
+                         else "replica" if sh is not g.primary else "empty_store")
+                rows.append({"index": iname, "shard": sh.shard_id, "type": rtype,
+                             "stage": "done" if sh.state == "STARTED" else sh.state.lower()})
     return 200, rows
 
 
@@ -669,6 +676,67 @@ def _update_doc(n: Node, p, b, index: str, id: str):
     if p.get("refresh") in ("true", ""):
         svc.refresh()
     return 200, r
+
+
+def _scan_ids(svc, body: dict, seen: set):
+    """One scan round of unseen matches — the by-query actions loop this
+    until exhausted (reference: AbstractAsyncBulkByScrollAction's
+    scroll-driven scan; we rescan because deletes/updates shift results)."""
+    resp = svc.search({"query": body.get("query", {"match_all": {}}),
+                       "size": 10_000, "_source": False})
+    return [h["_id"] for h in resp["hits"]["hits"] if h["_id"] not in seen]
+
+
+def _delete_by_query(n: Node, p, b, index: str):
+    svc = n.get_index(index)
+    svc.refresh()
+    body = _json(b)
+    seen: set = set()
+    deleted = 0
+    while True:
+        ids = _scan_ids(svc, body, seen)
+        if not ids:
+            break
+        seen.update(ids)
+        for doc_id in ids:
+            try:
+                svc.delete_doc(doc_id)
+                deleted += 1
+            except ElasticsearchTpuException:
+                pass  # concurrent delete
+        svc.refresh()
+    return 200, {"took": 0, "deleted": deleted, "total": len(seen),
+                 "failures": [], "timed_out": False}
+
+
+def _update_by_query(n: Node, p, b, index: str):
+    svc = n.get_index(index)
+    svc.refresh()
+    body = _json(b)
+    script = body.get("script")
+    seen: set = set()
+    updated = 0
+    while True:
+        ids = _scan_ids(svc, body, seen)
+        if not ids:
+            break
+        seen.update(ids)
+        for doc_id in ids:
+            try:
+                if script is not None:
+                    svc.update_doc(doc_id, {"script": script})
+                    updated += 1
+                else:
+                    # no script: a re-index touch (picks up mapping changes)
+                    got = svc.get_doc(doc_id)
+                    if got.get("found"):
+                        svc.index_doc(doc_id, got["_source"])
+                        updated += 1
+            except ElasticsearchTpuException:
+                pass
+        svc.refresh()
+    return 200, {"took": 0, "updated": updated, "total": len(seen),
+                 "failures": [], "timed_out": False}
 
 
 def _mget(n: Node, p, b):

@@ -62,11 +62,13 @@ class SegmentContext:
         analysis: AnalysisRegistry,
         global_stats: Optional[GlobalStats] = None,
         all_segments: Optional[list] = None,
+        index_name: str = "",
     ):
         self.segment = segment
         self.mappings = mappings
         self.analysis = analysis
         self.global_stats = global_stats
+        self.index_name = index_name  # owning index (indices query)
         # every segment of the owning shard — join queries inside aggs use
         # this for their shard-wide prepare pass
         self.all_segments = all_segments if all_segments is not None else [segment]

@@ -795,6 +795,26 @@ class ConstantScoreQuery(Query):
         return mask.astype(jnp.float32) * self.boost, mask
 
 
+class IndicesQuery(Query):
+    """index/query/IndicesQueryBuilder.java — apply ``query`` on the named
+    indices, ``no_match_query`` elsewhere. Resolution happens per segment
+    via the ctx's owning index name (aliases resolve before search)."""
+
+    def __init__(self, indices: List[str], inner: Query,
+                 no_match: Optional[Query]):
+        self.indices = [str(i) for i in indices]
+        self.inner = inner
+        self.no_match = no_match
+
+    def execute(self, ctx) -> ExecResult:
+        match = any(fnmatch.fnmatch(ctx.index_name, pat) for pat in self.indices)
+        if match:
+            return self.inner.execute(ctx)
+        if self.no_match is None:
+            return _empty(ctx)
+        return self.no_match.execute(ctx)
+
+
 class DisMaxQuery(Query):
     """index/query/DisMaxQueryBuilder.java"""
 
@@ -1220,6 +1240,26 @@ def parse_query(dsl: Optional[dict]) -> Query:
             min_term_freq=int(body.get("min_term_freq", 1)),
             min_doc_freq=int(body.get("min_doc_freq", 1)),
         )
+
+    if qtype == "indices":
+        # reference: IndicesQueryBuilder — route by the OWNING index name
+        names = body.get("indices", [body.get("index")] if body.get("index") else [])
+        q = parse_query(body["query"])
+        nm = body.get("no_match_query", "all")
+        if nm == "none":
+            no_match: Optional[Query] = None
+        elif nm == "all":
+            no_match = MatchAllQuery()
+        else:
+            no_match = parse_query(nm)
+        return IndicesQuery(names, q, no_match)
+
+    if qtype == "template":
+        from elasticsearch_tpu.search.templates import render_template
+
+        spec = body.get("query", body.get("inline", body))
+        rendered = render_template(spec, body.get("params"))
+        return parse_query(rendered)
 
     if qtype == "wrapper":
         import base64

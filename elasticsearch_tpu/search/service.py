@@ -60,13 +60,15 @@ _SCROLLS: Dict[str, dict] = {}
 class ShardSearcher:
     """Executes search phases against one shard (list of segments)."""
 
-    def __init__(self, segments, mappings, analysis, shard_ord: int = 0):
+    def __init__(self, segments, mappings, analysis, shard_ord: int = 0,
+                 index_name: str = ""):
         from elasticsearch_tpu.monitor.stats import SearchStats
 
         self.segments = segments
         self.mappings = mappings
         self.analysis = analysis
         self.shard_ord = shard_ord
+        self.index_name = index_name
         self.stats = SearchStats()
 
     # -- query phase -----------------------------------------------------------
@@ -103,7 +105,8 @@ class ShardSearcher:
         agg_partials: List[dict] = []
         for seg in self.segments:
             ctx = SegmentContext(seg, self.mappings, self.analysis, global_stats,
-                                 all_segments=self.segments)
+                                 all_segments=self.segments,
+                                 index_name=self.index_name)
             scores, mask = query.score_or_mask(ctx)
             mask = mask & seg.live
             if seg.has_nested:
@@ -194,7 +197,9 @@ class ShardSearcher:
         hits = []
         for d in docs:
             hit: Dict[str, Any] = {
-                "_index": index_name,
+                # the owning index, not the (possibly comma-joined) request
+                # expression — multi-index searches report per-hit provenance
+                "_index": self.index_name or index_name,
                 "_id": d.seg.ids[d.local_id],
                 "_score": None if d.sort_values else d.score,
             }
@@ -265,7 +270,7 @@ class ShardSearcher:
                     ordn = int(seg.nested_ord_host[k])
                     sub = _nested_sub_source(root_src, nq.path, ordn)
                     child_hits.append({
-                        "_index": index_name,
+                        "_index": self.index_name or index_name,
                         "_id": hit["_id"],
                         "_nested": {"field": nq.path, "offset": ordn},
                         "_score": float(scores_np[k]),
@@ -418,6 +423,10 @@ def search_shards(
     if profile:
         response["profile"] = {"shards": shard_profiles}
     if body.get("scroll"):
+        # one scroll CONTEXT per shard (reference SearchStats semantics:
+        # counts contexts, not pages)
+        for s in searchers:
+            s.stats.on_scroll()
         scroll_id = uuid.uuid4().hex
         _SCROLLS[scroll_id] = {
             "docs": all_docs,
@@ -435,8 +444,6 @@ def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
     state = _SCROLLS.get(scroll_id)
     if state is None:
         raise SearchParseException(f"no search context found for id [{scroll_id}]")
-    for s in state["searchers"]:
-        s.stats.on_scroll()
     body = state["body"]
     sz = size or int(body.get("size", 10))
     page = state["docs"][state["pos"] : state["pos"] + sz]

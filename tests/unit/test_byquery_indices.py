@@ -1,0 +1,95 @@
+"""delete-by-query / update-by-query, indices query, template query tests.
+
+Reference: org.elasticsearch delete-by-query (2.0 plugin semantics),
+IndicesQueryBuilder, TemplateQueryBuilder.
+"""
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestController
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.create_index("a1", {"mappings": {"properties": {
+        "tag": {"type": "keyword"}, "v": {"type": "long"}}}})
+    n.create_index("b1", {"mappings": {"properties": {
+        "tag": {"type": "keyword"}, "v": {"type": "long"}}}})
+    for i in range(10):
+        n.indices["a1"].index_doc(str(i), {"tag": "even" if i % 2 == 0 else "odd", "v": i})
+        n.indices["b1"].index_doc(str(i), {"tag": "bee", "v": i})
+    for s in n.indices.values():
+        s.refresh()
+    yield n
+    for s in n.indices.values():
+        s.close()
+
+
+def test_delete_by_query(node):
+    rc = RestController(node)
+    status, out = rc.dispatch("POST", "/a1/_delete_by_query", {},
+                              b'{"query": {"term": {"tag": "odd"}}}')
+    assert status == 200 and out["deleted"] == 5
+    assert node.indices["a1"].num_docs == 5
+    r = node.search("a1", {"query": {"term": {"tag": "odd"}}})
+    assert r["hits"]["total"] == 0
+
+
+def test_update_by_query_with_script(node):
+    rc = RestController(node)
+    status, out = rc.dispatch(
+        "POST", "/a1/_update_by_query", {},
+        b'{"query": {"term": {"tag": "even"}},'
+        b' "script": "ctx._source.v = ctx._source.v + 100"}')
+    assert status == 200 and out["updated"] == 5
+    node.indices["a1"].refresh()
+    r = node.search("a1", {"query": {"range": {"v": {"gte": 100}}}, "size": 20})
+    assert r["hits"]["total"] == 5
+
+
+def test_delete_by_query_beyond_scan_window(node):
+    # regression: >10k matches must loop until exhausted, not truncate
+    import elasticsearch_tpu.rest.server as srv
+
+    rc = RestController(node)
+    orig = srv._scan_ids
+    calls = {"n": 0}
+
+    def tiny_scan(svc, body, seen):
+        calls["n"] += 1
+        resp = svc.search({"query": body.get("query", {"match_all": {}}),
+                           "size": 3, "_source": False})
+        return [h["_id"] for h in resp["hits"]["hits"] if h["_id"] not in seen]
+
+    srv._scan_ids = tiny_scan
+    try:
+        status, out = rc.dispatch("POST", "/a1/_delete_by_query", {},
+                                  b'{"query": {"match_all": {}}}')
+    finally:
+        srv._scan_ids = orig
+    assert out["deleted"] == 10 and calls["n"] >= 4  # looped past the window
+    assert node.indices["a1"].num_docs == 0
+
+
+def test_indices_query_routes_by_owning_index(node):
+    q = {"indices": {"indices": ["a1"],
+                     "query": {"term": {"tag": "even"}},
+                     "no_match_query": {"term": {"tag": "bee"}}}}
+    r = node.search("a1,b1", {"query": q, "size": 50})
+    by_index = {}
+    for h in r["hits"]["hits"]:
+        by_index.setdefault(h["_index"], []).append(h["_id"])
+    assert len(by_index.get("a1", [])) == 5  # even docs in a1
+    assert len(by_index.get("b1", [])) == 10  # bee docs via no_match_query
+    # no_match_query: "none" drops other indices entirely
+    q["indices"]["no_match_query"] = "none"
+    r = node.search("a1,b1", {"query": q, "size": 50})
+    assert all(h["_index"] == "a1" for h in r["hits"]["hits"])
+
+
+def test_template_query(node):
+    q = {"template": {"query": {"term": {"tag": "{{t}}"}},
+                      "params": {"t": "even"}}}
+    r = node.search("a1", {"query": q, "size": 20})
+    assert r["hits"]["total"] == 5
