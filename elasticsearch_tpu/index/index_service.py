@@ -235,6 +235,28 @@ class IndexService:
     def mget(self, ids: List[str]) -> dict:
         return {"docs": [self.get_doc(i) for i in ids]}
 
+    def find_doc_location(self, doc_id: str):
+        """Locate a live doc's DocLocation without knowing its routing.
+
+        By-query actions (delete/update-by-query) get ids back from search
+        but not the custom routing the doc was indexed with; id-based
+        routing would then target the wrong shard. Scan every shard's
+        location table instead (reference: AbstractAsyncBulkByScrollAction
+        carries each hit's routing through the scroll)."""
+        locs = self.find_doc_locations(doc_id)
+        return locs[0] if locs else None
+
+    def find_doc_locations(self, doc_id: str) -> list:
+        """All live copies of an id across shards — custom routing can place
+        the same _id on several shards, and by-query actions must touch
+        every copy, each with its own stored routing."""
+        out = []
+        for shard in self.shards:
+            loc = shard.engine._locations.get(str(doc_id))
+            if loc is not None and not loc.deleted:
+                out.append(loc)
+        return out
+
     # -- search ----------------------------------------------------------------
 
     def refresh(self):

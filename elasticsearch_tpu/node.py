@@ -305,11 +305,13 @@ class Node:
             part = part.strip()
             if part and not any(c in part for c in "*?") and part not in ("_all",):
                 explicit.update(self.resolve_indices(part) or [part])
+        searched_names: List[str] = []
         for n in names:
             svc = self.indices[n]
             if svc.closed and n not in explicit:
                 continue
             check_open(svc, op="read")
+            searched_names.append(n)
             searchers.extend(g.reader(preference).searcher for g in svc.groups)
         if not searchers:
             return {
@@ -319,15 +321,29 @@ class Node:
             }
         from elasticsearch_tpu.search.service import search_shards
 
-        # re-number shard ordinals across indices
-        for ord_, s in enumerate(searchers):
-            s.shard_ord = ord_
+        # NOTE: searcher.shard_ord is NOT renumbered here — search_shards
+        # stamps candidates with positional ordinals itself, so persistent
+        # searcher state stays untouched across multi-index searches
         search_type = (body or {}).get("search_type")
         gs = None
-        if search_type == "dfs_query_then_fetch" and len(names) == 1:
-            gs = self.indices[names[0]].global_stats(body)
+        if search_type == "dfs_query_then_fetch":
+            # merge per-index dfs term stats so idf is consistent across
+            # EVERY searched index (reference: search/dfs/DfsPhase collects
+            # over all participating shards, not one index)
+            from elasticsearch_tpu.search.context import GlobalStats
+
+            num_docs: Dict[str, int] = {}
+            df: Dict[Any, int] = {}
+            for n2 in searched_names:
+                g2 = self.indices[n2].global_stats(body)
+                for k2, v2 in g2.num_docs.items():
+                    num_docs[k2] = num_docs.get(k2, 0) + v2
+                for k2, v2 in g2.df.items():
+                    df[k2] = df.get(k2, 0) + v2
+            gs = GlobalStats(num_docs=num_docs, df=df)
         resp = search_shards(searchers, body or {}, index_name=",".join(names), global_stats=gs)
-        # patch hit _index to the owning index
+        # hits already carry per-hit owning index (fetch_phase uses the
+        # searcher's own index_name)
         return resp
 
     def msearch(self, pairs: List[tuple]) -> dict:

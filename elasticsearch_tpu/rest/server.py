@@ -542,10 +542,12 @@ def _count(n: Node, p, b, index: str):
     if not svc_names:
         raise IndexNotFoundException(index)
     total = 0
+    nshards = 0
     for name in svc_names:
         total += n.indices[name].count(body)["count"]
-    return 200, {"count": total, "_shards": {"total": len(svc_names),
-                                             "successful": len(svc_names), "failed": 0}}
+        nshards += n.indices[name].num_shards
+    return 200, {"count": total, "_shards": {"total": nshards,
+                                             "successful": nshards, "failed": 0}}
 
 
 def _analyze_body(p, b) -> dict:
@@ -684,7 +686,12 @@ def _scan_ids(svc, body: dict, seen: set):
     scroll-driven scan; we rescan because deletes/updates shift results)."""
     resp = svc.search({"query": body.get("query", {"match_all": {}}),
                        "size": 10_000, "_source": False})
-    return [h["_id"] for h in resp["hits"]["hits"] if h["_id"] not in seen]
+    out, new = [], set()
+    for h in resp["hits"]["hits"]:
+        if h["_id"] not in seen and h["_id"] not in new:
+            new.add(h["_id"])
+            out.append(h["_id"])
+    return out
 
 
 def _delete_by_query(n: Node, p, b, index: str):
@@ -693,20 +700,29 @@ def _delete_by_query(n: Node, p, b, index: str):
     body = _json(b)
     seen: set = set()
     deleted = 0
+    failures: list = []
     while True:
         ids = _scan_ids(svc, body, seen)
         if not ids:
             break
         seen.update(ids)
         for doc_id in ids:
-            try:
-                svc.delete_doc(doc_id)
-                deleted += 1
-            except ElasticsearchTpuException:
-                pass  # concurrent delete
+            # docs indexed with routing/parent don't route by id — read the
+            # stored routing off the owning shard's location table, and
+            # delete EVERY live copy (the same id can live on several
+            # shards under different routings)
+            locs = svc.find_doc_locations(doc_id) or [None]
+            for loc in locs:
+                try:
+                    svc.delete_doc(doc_id, routing=loc.routing if loc else None)
+                    deleted += 1
+                except ElasticsearchTpuException as e:
+                    failures.append({"index": svc.name, "id": doc_id,
+                                     "status": e.status,
+                                     "cause": {"type": e.error_type, "reason": str(e)}})
         svc.refresh()
     return 200, {"took": 0, "deleted": deleted, "total": len(seen),
-                 "failures": [], "timed_out": False}
+                 "failures": failures, "timed_out": False}
 
 
 def _update_by_query(n: Node, p, b, index: str):
@@ -716,27 +732,50 @@ def _update_by_query(n: Node, p, b, index: str):
     script = body.get("script")
     seen: set = set()
     updated = 0
+    noops = 0
+    failures: list = []
     while True:
         ids = _scan_ids(svc, body, seen)
         if not ids:
             break
         seen.update(ids)
         for doc_id in ids:
-            try:
-                if script is not None:
-                    svc.update_doc(doc_id, {"script": script})
-                    updated += 1
-                else:
-                    # no script: a re-index touch (picks up mapping changes)
-                    got = svc.get_doc(doc_id)
-                    if got.get("found"):
-                        svc.index_doc(doc_id, got["_source"])
+            # touch EVERY live copy of the id (custom routing can place the
+            # same _id on several shards), each with its stored routing
+            locs = svc.find_doc_locations(doc_id) or [None]
+            for loc in locs:
+                routing = loc.routing if loc else None
+                try:
+                    if script is not None:
+                        svc.update_doc(doc_id, {"script": script}, routing=routing)
                         updated += 1
-            except ElasticsearchTpuException:
-                pass
+                    else:
+                        # no script: a re-index touch (picks up mapping
+                        # changes). Carry the doc's _type/_parent/routing
+                        # meta through the re-index or a routed /
+                        # parent-child doc would land on a different shard
+                        # and sever its joins (Engine.update carries meta
+                        # unconditionally — mirror that).
+                        got = svc.get_doc(doc_id, routing=routing)
+                        if got.get("found"):
+                            kw = {}
+                            if loc is not None and loc.doc_type:
+                                kw["doc_type"] = loc.doc_type
+                            if loc is not None and loc.parent:
+                                kw["parent"] = loc.parent
+                            svc.index_doc(doc_id, got["_source"], routing=routing, **kw)
+                            updated += 1
+                        else:
+                            # deleted between scan and get: account for it
+                            # (ES reports these as noops, never silently)
+                            noops += 1
+                except ElasticsearchTpuException as e:
+                    failures.append({"index": svc.name, "id": doc_id,
+                                     "status": e.status,
+                                     "cause": {"type": e.error_type, "reason": str(e)}})
         svc.refresh()
     return 200, {"took": 0, "updated": updated, "total": len(seen),
-                 "failures": [], "timed_out": False}
+                 "noops": noops, "failures": failures, "timed_out": False}
 
 
 def _mget(n: Node, p, b):

@@ -359,15 +359,21 @@ def search_shards(
     profile = bool(body.get("profile"))
     shard_profiles: List[dict] = []
     results = []
-    for s in searchers:
+    for pos, s in enumerate(searchers):
         tq = time.perf_counter()
         r = s.query_phase(body, global_stats, extra_k=extra_k)
+        # fetch resolves searchers positionally in THIS list — stamp each
+        # candidate with its searcher's list position rather than trusting
+        # the searcher's own shard_ord (shared, and multi-index searches
+        # would otherwise have to renumber persistent searcher state)
+        for d in r.docs:
+            d.shard_ord = pos
         q_ms = (time.perf_counter() - tq) * 1000
         s.stats.on_query(q_ms)
         results.append(r)
         if profile:
             shard_profiles.append({
-                "id": f"[shard][{s.shard_ord}]",
+                "id": f"[shard][{pos}]",
                 "searches": [{"query": [{
                     "type": "CompiledSegmentProgram",
                     "description": "whole-segment score/mask program",

@@ -1,0 +1,127 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md).
+
+1. Node.search must not mutate persistent searcher.shard_ord: a multi-index
+   search followed by a single-index search on a later index used to raise
+   IndexError inside fetch.
+2/3. delete-by-query / update-by-query must honor custom routing and
+   preserve _type/_parent meta, and surface per-doc failures.
+"""
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestController
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    yield n
+    n.close()
+
+
+def test_multi_index_search_then_single_index_search(node):
+    """ADVICE high: global re-numbering of shard_ord corrupted later
+    single-index searches (searcher list positions no longer matched)."""
+    node.create_index("aa", {"settings": {"number_of_shards": 2}})
+    node.create_index("bb", {"settings": {"number_of_shards": 2}})
+    for i in range(8):
+        node.indices["aa"].index_doc(str(i), {"t": f"alpha {i}"})
+        node.indices["bb"].index_doc(str(i), {"t": f"beta {i}"})
+    for s in node.indices.values():
+        s.refresh()
+    # multi-index search first (this used to renumber bb's searchers 2..3)
+    r = node.search("aa,bb", {"query": {"match_all": {}}, "size": 20})
+    assert r["hits"]["total"] == 16
+    # single-index search on the LATER index must still fetch correctly
+    r2 = node.search("bb", {"query": {"match_all": {}}, "size": 20})
+    assert r2["hits"]["total"] == 8
+    assert all(h["_index"] == "bb" for h in r2["hits"]["hits"])
+    # and the per-index service path too (delete-by-query scans use it)
+    r3 = node.indices["bb"].search({"query": {"match_all": {}}, "size": 20})
+    assert r3["hits"]["total"] == 8
+
+
+def test_multi_index_search_leaves_scroll_intact(node):
+    node.create_index("sa")
+    node.create_index("sb")
+    for i in range(6):
+        node.indices["sa"].index_doc(str(i), {"v": i})
+        node.indices["sb"].index_doc(str(i), {"v": i})
+    for s in node.indices.values():
+        s.refresh()
+    from elasticsearch_tpu.search.service import clear_scroll, scroll_next
+
+    r = node.search("sb", {"query": {"match_all": {}}, "size": 2, "scroll": "1m"})
+    sid = r["_scroll_id"]
+    # an interleaved multi-index search must not corrupt the scroll context
+    node.search("sa,sb", {"query": {"match_all": {}}})
+    page2 = scroll_next(sid)
+    assert len(page2["hits"]["hits"]) == 2
+    assert all(h["_index"] == "sb" for h in page2["hits"]["hits"])
+    clear_scroll(sid)
+
+
+def test_delete_by_query_with_routing(node):
+    """ADVICE medium: routed docs must actually be deleted, not silently
+    survive with deleted=0."""
+    node.create_index("r1", {"settings": {"number_of_shards": 4},
+                             "mappings": {"properties": {"tag": {"type": "keyword"}}}})
+    svc = node.indices["r1"]
+    for i in range(8):
+        svc.index_doc(f"d{i}", {"tag": "kill"}, routing="custom-route")
+    svc.refresh()
+    rc = RestController(node)
+    status, out = rc.dispatch("POST", "/r1/_delete_by_query", {},
+                              b'{"query": {"term": {"tag": "kill"}}}')
+    assert status == 200
+    assert out["deleted"] == 8, out
+    assert out["failures"] == []
+    assert svc.num_docs == 0
+
+
+def test_update_by_query_preserves_routing_and_meta(node):
+    """ADVICE medium: the no-script re-index touch must keep the doc on its
+    routed shard and keep _type meta (no duplicates, no severed joins)."""
+    node.create_index("r2", {"settings": {"number_of_shards": 4},
+                             "mappings": {"properties": {"tag": {"type": "keyword"}}}})
+    svc = node.indices["r2"]
+    for i in range(6):
+        svc.index_doc(f"u{i}", {"tag": "touch"}, routing="rr", doc_type="custom")
+    svc.refresh()
+    # remember which shard each doc lives on
+    before = {}
+    for sh in svc.shards:
+        for did, loc in sh.engine._locations.items():
+            if not loc.deleted:
+                before[did] = (sh.shard_id, loc.doc_type, loc.routing)
+    rc = RestController(node)
+    status, out = rc.dispatch("POST", "/r2/_update_by_query", {},
+                              b'{"query": {"term": {"tag": "touch"}}}')
+    assert status == 200 and out["updated"] == 6, out
+    assert out["failures"] == []
+    # no duplicates: still exactly 6 docs
+    assert svc.num_docs == 6
+    after = {}
+    for sh in svc.shards:
+        for did, loc in sh.engine._locations.items():
+            if not loc.deleted:
+                after[did] = (sh.shard_id, loc.doc_type, loc.routing)
+    assert after == before
+
+
+def test_update_by_query_script_with_routing(node):
+    node.create_index("r3", {"settings": {"number_of_shards": 4},
+                             "mappings": {"properties": {"v": {"type": "long"}}}})
+    svc = node.indices["r3"]
+    for i in range(4):
+        svc.index_doc(f"s{i}", {"v": i}, routing="zz")
+    svc.refresh()
+    rc = RestController(node)
+    status, out = rc.dispatch(
+        "POST", "/r3/_update_by_query", {},
+        b'{"query": {"match_all": {}}, "script": "ctx._source.v = ctx._source.v + 10"}')
+    assert status == 200 and out["updated"] == 4, out
+    svc.refresh()
+    r = node.search("r3", {"query": {"range": {"v": {"gte": 10}}}, "size": 10})
+    assert r["hits"]["total"] == 4
+    assert svc.num_docs == 4
