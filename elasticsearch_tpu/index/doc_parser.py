@@ -12,7 +12,8 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.analysis.registry import AnalysisRegistry
-from elasticsearch_tpu.index.mappings import FieldMapping, Mappings
+from elasticsearch_tpu.index.mappings import (
+    KEYWORD_TYPES, NUMERIC_TYPES, TEXT_TYPES, FieldMapping, Mappings)
 from elasticsearch_tpu.utils.errors import MapperParsingException
 
 Token = Tuple[str, int]
@@ -118,15 +119,40 @@ class DocumentParser:
                 if fm is None:
                     continue
             self._index_value(fm, value, parsed)
+            # multi-fields/copy_to re-index the same value — the _all stream
+            # gets it once, from the root field only
             for sub in fm.fields.values():
-                self._index_value(sub, value, parsed)
+                self._index_value(sub, value, parsed, to_all=False)
             for target in fm.copy_to:
                 tfm = self.mappings.get(target) or self.mappings.dynamic_map(target, value)
                 if tfm is not None:
-                    self._index_value(tfm, value, parsed)
+                    self._index_value(tfm, value, parsed, to_all=False)
 
-    def _index_value(self, fm: FieldMapping, value: Any, parsed: ParsedDocument):
+    _ALL_TYPES = TEXT_TYPES | KEYWORD_TYPES | NUMERIC_TYPES | {
+        "date", "boolean", "ip", "text", "keyword"}
+
+    def _append_to_all(self, parsed: ParsedDocument, raw: Any):
+        """Feed one value into the _all token stream (reference:
+        mapper/internal/AllFieldMapper.java — every included field's value
+        re-analyzed with the index default analyzer, values separated by a
+        position gap so phrases don't cross field boundaries)."""
+        analyzer = self.analysis.get(self.mappings.default_analyzer)
+        toks = analyzer.analyze(str(raw))
+        if not toks:
+            return
+        bucket = parsed.text_tokens.setdefault("_all", [])
+        offset = (bucket[-1][1] + 100) if bucket else 0
+        bucket.extend((t, p + offset) for t, p in toks)
+
+    def _index_value(self, fm: FieldMapping, value: Any, parsed: ParsedDocument,
+                     to_all: bool = True):
         values = value if isinstance(value, list) and not fm.is_vector else [value]
+        if (to_all and self.mappings._all_enabled and fm.include_in_all is not False
+                and fm.index and not fm.name.startswith("_")
+                and fm.type in self._ALL_TYPES):
+            for v in values:
+                if v is not None:
+                    self._append_to_all(parsed, v)
         if fm.type == "completion":
             # completion entries ({input, output, weight, payload} or plain
             # strings) are kept verbatim on host; the suggester builds its

@@ -49,6 +49,8 @@ class FieldMapping:
     nested_path: Optional[str] = None
     ignore_above: int = 0  # keyword: ignore long values
     scaling_factor: float = 1.0  # scaled_float
+    # None = inherit the _all default (include); False = excluded
+    include_in_all: Optional[bool] = None
 
     @property
     def is_text(self) -> bool:
@@ -86,7 +88,11 @@ class Mappings:
         self.default_analyzer = default_analyzer
         self.nested_paths: List[str] = []
         self._source_enabled = True
-        self._all_enabled = False
+        # _all is ON by default (reference: mapper/internal/AllFieldMapper.java
+        # — `enabled` defaults true in ES 2.0; query_string with no default
+        # field searches it)
+        self._all_enabled = True
+        self._all_fm: Optional[FieldMapping] = None
         self.dynamic_templates: List[dict] = []
         self.meta: dict = {}
         if mapping_json:
@@ -107,7 +113,7 @@ class Mappings:
         if "_source" in body:
             self._source_enabled = body["_source"].get("enabled", True)
         if "_all" in body:
-            self._all_enabled = body["_all"].get("enabled", False)
+            self._all_enabled = body["_all"].get("enabled", True)
         if "_meta" in body:
             self.meta = body["_meta"]
         if "dynamic_templates" in body:
@@ -149,6 +155,7 @@ class Mappings:
             nested_path=nested_path,
             ignore_above=int(p.get("ignore_above", 0)),
             scaling_factor=float(p.get("scaling_factor", 1.0)),
+            include_in_all=p.get("include_in_all"),
         )
         if t == "dense_vector" and fm.dims <= 0:
             raise MapperParsingException(f"dense_vector field [{full}] requires [dims]")
@@ -190,6 +197,17 @@ class Mappings:
         return fm
 
     def get(self, name: str) -> Optional[FieldMapping]:
+        if name == "_all":
+            # synthetic mapping (kept out of `fields` so it never leaks into
+            # to_json/wildcard field expansion); analyzed with the index
+            # default analyzer like AllFieldMapper
+            if not self._all_enabled:
+                return None
+            if self._all_fm is None:
+                self._all_fm = FieldMapping(
+                    name="_all", type="text",
+                    analyzer=self.default_analyzer, doc_values=False)
+            return self._all_fm
         fm = self.fields.get(name)
         if fm is not None:
             return fm
@@ -257,7 +275,10 @@ class Mappings:
         props: dict = {}
         for fm in self.fields.values():
             props[fm.name] = _field_to_json(fm)
-        return {"properties": props, "dynamic": self.dynamic}
+        out = {"properties": props, "dynamic": self.dynamic}
+        if not self._all_enabled:
+            out["_all"] = {"enabled": False}
+        return out
 
 
 def _field_to_json(fm: FieldMapping) -> dict:
