@@ -304,17 +304,24 @@ class RangeAggregator(Aggregator):
 
         field = self.body.get("field")
         fm = ctx.mappings.get(field) if field else None
-        out: Dict[str, dict] = {}
+        jnp = _jnp()
+        specs, bmasks = [], []
         for r in self.body.get("ranges", []):
             frm = self._parse_bound(r.get("from"), fm)
             to = self._parse_bound(r.get("to"), fm)
             key = r.get("key") or f"{r.get('from', '*')}-{r.get('to', '*')}"
             rq = RangeQuery(field, gte=frm, lt=to)
             _, rmask = rq.execute(ctx)
-            jnp = _jnp()
-            bmask = mask & rmask
-            b = {"doc_count": int(jnp.sum(bmask.astype(jnp.int32))),
-                 "from": frm, "to": to}
+            specs.append((key, frm, to))
+            bmasks.append(mask & rmask)
+        if not specs:
+            return {"buckets": {}}
+        # one device reduction + ONE host transfer for all buckets (not a
+        # sync per bucket per segment)
+        counts = np.asarray(jnp.stack([jnp.sum(m.astype(jnp.int32)) for m in bmasks]))
+        out: Dict[str, dict] = {}
+        for (key, frm, to), cnt, bmask in zip(specs, counts, bmasks):
+            b = {"doc_count": int(cnt), "from": frm, "to": to}
             if self.subs:
                 b["subs"] = self.collect_subs(ctx, bmask)
             out[key] = b
@@ -392,21 +399,28 @@ class FiltersAggregator(Aggregator):
     def collect(self, ctx, mask):
         from elasticsearch_tpu.search.queries import parse_query
 
+        from elasticsearch_tpu.search.joins import prepare_tree
+
         jnp = _jnp()
         specs = self.body.get("filters", {})
-        out = {}
-        items = specs.items() if isinstance(specs, dict) else enumerate(specs)
+        items = list(specs.items() if isinstance(specs, dict) else enumerate(specs))
+        keys, bmasks = [], []
         for key, q in items:
-            from elasticsearch_tpu.search.joins import prepare_tree
-
             pq = parse_query(q)
             prepare_tree(pq, ctx.all_segments, ctx.mappings, ctx.analysis)
             _, fmask = pq.execute(ctx)
-            bmask = mask & fmask
-            b = {"doc_count": int(jnp.sum(bmask.astype(jnp.int32)))}
+            keys.append(str(key))
+            bmasks.append(mask & fmask)
+        if not keys:
+            return {"buckets": {}}
+        # batched: one transfer for every filter bucket's count
+        counts = np.asarray(jnp.stack([jnp.sum(m.astype(jnp.int32)) for m in bmasks]))
+        out = {}
+        for key, cnt, bmask in zip(keys, counts, bmasks):
+            b = {"doc_count": int(cnt)}
             if self.subs:
                 b["subs"] = self.collect_subs(ctx, bmask)
-            out[str(key)] = b
+            out[key] = b
         return {"buckets": out}
 
     def reduce(self, partials):

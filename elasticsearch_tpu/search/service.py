@@ -51,6 +51,9 @@ class QueryPhaseResult:
     total_hits: int
     max_score: float
     agg_partials: Optional[dict] = None
+    # scroll snapshot (score-ordered scrolls): complete per-segment orders as
+    # compact numpy arrays — (segment, int32 order of ALL matches, f32 scores)
+    full: Optional[List[Tuple[Any, np.ndarray, np.ndarray]]] = None
 
 
 # in-memory scroll registry: scroll_id -> (snapshot state)
@@ -74,7 +77,7 @@ class ShardSearcher:
     # -- query phase -----------------------------------------------------------
 
     def query_phase(self, body: dict, global_stats: Optional[GlobalStats] = None,
-                    extra_k: int = 0) -> QueryPhaseResult:
+                    collect_full: bool = False) -> QueryPhaseResult:
         jnp = _jnp()
         query = parse_query(body.get("query"))
         from elasticsearch_tpu.search.joins import prepare_tree
@@ -83,14 +86,30 @@ class ShardSearcher:
         aggs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         size = int(body.get("size", 10))
         frm = int(body.get("from", 0))
-        k = min(max(size + frm + extra_k, 1), 10_000)
+        if not collect_full and frm + size > 10_000:
+            # explicit, like ES's index.max_result_window — never a silent cap
+            raise SearchParseException(
+                f"Result window is too large, from + size must be less than "
+                f"or equal to: [10000] but was [{frm + size}]. Use scroll or "
+                f"search_after for deep pagination.")
+        k = min(max(size + frm, 1), 10_000)
         min_score = body.get("min_score")
         sort_spec = _parse_sort(body.get("sort"))
         search_after = body.get("search_after")
+        if search_after is not None and not sort_spec:
+            raise SearchParseException(
+                "Sort must contain at least one field when using [search_after]")
+        if search_after is not None and len(search_after) != len(sort_spec):
+            raise SearchParseException(
+                f"search_after has {len(search_after)} value(s) but sort has "
+                f"{len(sort_spec)}")
         rescore_specs = []
         if body.get("rescore") and sort_spec:
             raise SearchParseException(
                 "cannot use [rescore] in combination with [sort]")
+        if body.get("rescore") and collect_full:
+            raise SearchParseException(
+                "cannot use [rescore] in combination with [scroll]")
         if body.get("rescore"):
             from elasticsearch_tpu.search.rescore import parse_rescore
 
@@ -103,6 +122,10 @@ class ShardSearcher:
         total = 0
         max_score = float("-inf")
         agg_partials: List[dict] = []
+        # score-ordered scrolls snapshot EVERY match as compact arrays (no
+        # 10k cap, no re-read of live state between pages); sorted scrolls
+        # materialize the complete candidate list instead
+        full_snap = [] if (collect_full and not sort_spec) else None
         for seg in self.segments:
             ctx = SegmentContext(seg, self.mappings, self.analysis, global_stats,
                                  all_segments=self.segments,
@@ -120,7 +143,20 @@ class ShardSearcher:
             if aggs:
                 agg_partials.append(run_aggs(aggs, ctx, mask))
             if sort_spec:
-                seg_docs = self._sorted_candidates(ctx, scores, mask, sort_spec, k, search_after)
+                seg_k = seg.max_docs if collect_full else k
+                seg_docs = self._sorted_candidates(ctx, scores, mask, sort_spec,
+                                                   seg_k, search_after)
+            elif full_snap is not None:
+                sc = np.asarray(scores)
+                mk = np.asarray(mask)
+                n_match = int(mk[: seg.num_docs].sum())
+                eff = np.where(mk, sc, -np.inf)
+                order = np.argsort(-eff, kind="stable")[:n_match].astype(np.int32)
+                full_snap.append((seg, order, sc))
+                seg_docs = [
+                    ShardDoc(self.shard_ord, seg, int(i), float(sc[i]))
+                    for i in order[: min(k, order.size)]
+                ]
             else:
                 kk = min(k, seg.max_docs)
                 vals, idx = topk_with_mask(scores, mask, k=kk)
@@ -141,13 +177,14 @@ class ShardSearcher:
             docs.sort(key=lambda d: _sort_key(d.sort_values, sort_spec))
         else:
             docs.sort(key=lambda d: (-d.score, d.seg.seg_id, d.local_id))
-        docs = docs[:k]
+        if not (collect_full and sort_spec):
+            docs = docs[:k]
         if rescore_specs:
             from elasticsearch_tpu.search.rescore import apply_rescore
 
             apply_rescore(docs, rescore_specs, self.mappings, self.analysis,
                           segments=self.segments)
-            docs = docs[: min(max(size + frm + extra_k, 1), 10_000)]
+            docs = docs[: min(max(size + frm, 1), 10_000)]
             max_score = max((d.score for d in docs), default=float("-inf"))
         merged_aggs = agg_partials if aggs else None
         return QueryPhaseResult(
@@ -155,6 +192,7 @@ class ShardSearcher:
             total_hits=total,
             max_score=max_score if docs and max_score != float("-inf") else float("nan"),
             agg_partials={"_list": merged_aggs, "_aggs": aggs} if aggs else None,
+            full=full_snap,
         )
 
     def _sorted_candidates(self, ctx, scores, mask, sort_spec, k, search_after):
@@ -165,12 +203,17 @@ class ShardSearcher:
         key_vec, _ = _sort_key_vector(ctx, primary, scores)
         sel = mask
         if search_after is not None:
-            sa = float(search_after[0]) if not isinstance(search_after[0], str) else search_after[0]
-            if isinstance(sa, float):
+            sa = search_after[0]
+            if isinstance(sa, (int, float)) and not isinstance(sa, bool):
+                # device prefilter on the primary key — NON-strict so docs
+                # tied on key[0] survive; the exact full-tuple cursor
+                # comparison happens on host below (reference: ES compares
+                # the whole sort tuple, FieldDoc searchAfter semantics)
+                sa_f = float(sa) - (primary.get("_offset") or 0.0)
                 if primary["order"] == "desc":
-                    sel = sel & (key_vec < (sa - (primary.get("_offset") or 0.0)))
+                    sel = sel & (key_vec <= sa_f)
                 else:
-                    sel = sel & (key_vec > (sa - (primary.get("_offset") or 0.0)))
+                    sel = sel & (key_vec >= sa_f)
         oversample = min(max(k * 4, 128), ctx.segment.max_docs)
         dirn = 1.0 if primary["order"] == "desc" else -1.0
         vals, idx = topk_with_mask(key_vec * dirn, sel, k=oversample)
@@ -181,6 +224,8 @@ class ShardSearcher:
         out = []
         for local in cand:
             sv = tuple(_sort_value(ctx, s, local, np_scores) for s in sort_spec)
+            if search_after is not None and not _after_cursor(sv, search_after, sort_spec):
+                continue
             out.append(ShardDoc(self.shard_ord, ctx.segment, local, float(np_scores[local]), sv))
         out.sort(key=lambda d: _sort_key(d.sort_values, sort_spec))
         return out[:k]
@@ -353,15 +398,17 @@ def search_shards(
     frm = int(body.get("from", 0))
     sort_spec = _parse_sort(body.get("sort"))
 
-    # scroll keeps the whole result window (up to the 10k cap per shard) in
-    # the snapshot so subsequent pages don't re-run the query phase
-    extra_k = 10_000 if body.get("scroll") else 0
+    # scroll snapshots the COMPLETE match set (point-in-time: segment object
+    # refs pin the frozen segments; merges/deletes between pages can't
+    # corrupt fetches) — score-ordered scrolls as compact numpy arrays,
+    # sorted scrolls as full candidate lists
+    scroll = bool(body.get("scroll"))
     profile = bool(body.get("profile"))
     shard_profiles: List[dict] = []
     results = []
     for pos, s in enumerate(searchers):
         tq = time.perf_counter()
-        r = s.query_phase(body, global_stats, extra_k=extra_k)
+        r = s.query_phase(body, global_stats, collect_full=scroll)
         # fetch resolves searchers positionally in THIS list — stamp each
         # candidate with its searcher's list position rather than trusting
         # the searcher's own shard_ord (shared, and multi-index searches
@@ -428,20 +475,46 @@ def search_shards(
         response["aggregations"] = reduce_aggs(aggs, partial_lists)
     if profile:
         response["profile"] = {"shards": shard_profiles}
-    if body.get("scroll"):
+    if scroll:
         # one scroll CONTEXT per shard (reference SearchStats semantics:
         # counts contexts, not pages)
         for s in searchers:
             s.stats.on_scroll()
         scroll_id = uuid.uuid4().hex
-        _SCROLLS[scroll_id] = {
-            "docs": all_docs,
+        state: Dict[str, Any] = {
             "pos": frm + size,
             "body": body,
             "searchers": searchers,
             "index_name": index_name,
             "total": total,
         }
+        if not sort_spec:
+            # compact array snapshot: one global order over every match
+            segs: List[Tuple[int, Any]] = []
+            seg_of_parts, local_parts, score_parts = [], [], []
+            for pos, r in enumerate(results):
+                for seg, order, sc in (r.full or []):
+                    si = len(segs)
+                    segs.append((pos, seg))
+                    seg_of_parts.append(np.full(order.size, si, dtype=np.int32))
+                    local_parts.append(order)
+                    score_parts.append(sc[order].astype(np.float32))
+            if segs:
+                seg_of = np.concatenate(seg_of_parts)
+                local = np.concatenate(local_parts)
+                score = np.concatenate(score_parts)
+                glob = np.lexsort((local, seg_of, -score))
+                state.update(mode="arrays", segs=segs, seg_of=seg_of[glob],
+                             local=local[glob], score=score[glob])
+            else:
+                state.update(mode="arrays", segs=[],
+                             seg_of=np.empty(0, np.int32),
+                             local=np.empty(0, np.int32),
+                             score=np.empty(0, np.float32))
+        else:
+            # sorted scroll: complete candidate list (already merged)
+            state.update(mode="docs", docs=all_docs)
+        _SCROLLS[scroll_id] = state
         response["_scroll_id"] = scroll_id
     return response
 
@@ -452,19 +525,34 @@ def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
         raise SearchParseException(f"no search context found for id [{scroll_id}]")
     body = state["body"]
     sz = size or int(body.get("size", 10))
-    page = state["docs"][state["pos"] : state["pos"] + sz]
+    lo = state["pos"]
     state["pos"] += sz
+    if state.get("mode") == "arrays":
+        segs = state["segs"]
+        page = [
+            ShardDoc(segs[si][0], segs[si][1], int(li), float(sc))
+            for si, li, sc in zip(state["seg_of"][lo : lo + sz],
+                                  state["local"][lo : lo + sz],
+                                  state["score"][lo : lo + sz])
+        ]
+    else:
+        page = state["docs"][lo : lo + sz]
     by_shard: Dict[int, List[ShardDoc]] = {}
     for d in page:
         by_shard.setdefault(d.shard_ord, []).append(d)
     hits = []
     for shard_ord, docs in by_shard.items():
         hits.extend(state["searchers"][shard_ord].fetch_phase(docs, body, state["index_name"]))
+    # restore global page order after per-shard fetch
+    order = {(d.shard_ord, id(d.seg), d.local_id): i for i, d in enumerate(page)}
+    hd = list(zip(hits, [d for docs in by_shard.values() for d in docs]))
+    hd.sort(key=lambda x: order[(x[1].shard_ord, id(x[1].seg), x[1].local_id)])
     return {
         "took": 0,
         "timed_out": False,
         "_scroll_id": scroll_id,
-        "hits": {"total": state["total"], "max_score": None, "hits": hits},
+        "hits": {"total": state["total"], "max_score": None,
+                 "hits": [h for h, _ in hd]},
     }
 
 
@@ -584,6 +672,32 @@ def _sort_value(ctx, s, local: int, np_scores):
 
 
 _MISSING_LAST = object()
+
+
+def _after_cursor(sort_values: Tuple, cursor, sort_spec: List[dict]) -> bool:
+    """True iff a doc's full sort tuple strictly follows the search_after
+    cursor in sort order (ES compares every key, not just the primary)."""
+    for v, c, s in zip(sort_values, cursor, sort_spec):
+        desc = s["order"] == "desc"
+        missing_first = str(s.get("missing", "_last")) == "_first"
+        if v is None and c is None:
+            continue
+        if v is None:
+            # doc missing on this key: _last ranks after every concrete
+            # value, _first before
+            return not missing_first
+        if c is None:
+            return missing_first
+        if isinstance(v, str) != isinstance(c, str):
+            v, c = str(v), str(c)
+        if isinstance(v, bool):
+            v = int(v)
+        if isinstance(c, bool):
+            c = int(c)
+        if v == c:
+            continue
+        return (v > c) != desc
+    return False  # tuple equal to cursor → exclusive, not after
 
 
 def _sort_key(sort_values: Tuple, sort_spec: List[dict]):

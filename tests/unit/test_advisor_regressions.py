@@ -184,3 +184,111 @@ def test_all_not_duplicated_by_multifields(node):
     assert inv.total_terms == 2
     r = node.search("qm", {"query": {"match_phrase": {"_all": "one two"}}})
     assert r["hits"]["total"] == 1
+
+
+# -- silent-wrong-results tail (VERDICT round-1 item 8) -----------------------
+
+def test_search_after_breaks_ties_on_secondary_key(node):
+    """search_after must compare the FULL sort tuple: docs equal on the
+    primary key but after the cursor on the secondary key must be served
+    exactly once."""
+    node.create_index("sa1", {"mappings": {"properties": {
+        "g": {"type": "long"}, "n": {"type": "long"}}}})
+    svc = node.indices["sa1"]
+    rows = [("a", 1, 1), ("b", 1, 2), ("c", 1, 3), ("d", 2, 1), ("e", 2, 2)]
+    for did, g, nn in rows:
+        svc.index_doc(did, {"g": g, "n": nn})
+    svc.refresh()
+    sort = [{"g": "asc"}, {"n": "asc"}]
+    seen = []
+    cursor = None
+    while True:
+        body = {"query": {"match_all": {}}, "size": 2, "sort": sort}
+        if cursor is not None:
+            body["search_after"] = cursor
+        r = node.search("sa1", body)
+        hits = r["hits"]["hits"]
+        if not hits:
+            break
+        seen.extend(h["_id"] for h in hits)
+        cursor = hits[-1]["sort"]
+    assert seen == ["a", "b", "c", "d", "e"]
+
+
+def test_search_after_requires_sort(node):
+    node.create_index("sa2")
+    node.indices["sa2"].index_doc("1", {"v": 1})
+    node.indices["sa2"].refresh()
+    from elasticsearch_tpu.utils.errors import SearchParseException
+    with pytest.raises(SearchParseException):
+        node.search("sa2", {"query": {"match_all": {}}, "search_after": [1]})
+
+
+def test_search_after_string_keys(node):
+    node.create_index("sa3", {"mappings": {"properties": {"k": {"type": "keyword"}}}})
+    svc = node.indices["sa3"]
+    for did, k in [("1", "apple"), ("2", "banana"), ("3", "cherry")]:
+        svc.index_doc(did, {"k": k})
+    svc.refresh()
+    r = node.search("sa3", {"query": {"match_all": {}}, "size": 10,
+                            "sort": [{"k": "asc"}], "search_after": ["apple"]})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["2", "3"]
+
+
+def test_result_window_cap_is_explicit(node):
+    node.create_index("win")
+    node.indices["win"].index_doc("1", {"v": 1})
+    node.indices["win"].refresh()
+    from elasticsearch_tpu.utils.errors import SearchParseException
+    with pytest.raises(SearchParseException):
+        node.search("win", {"query": {"match_all": {}}, "from": 9995, "size": 10})
+
+
+def test_scroll_survives_merge_and_covers_all_docs(node):
+    """Scroll is a point-in-time snapshot: a force-merge between pages must
+    not corrupt later fetches, and every doc must be served exactly once."""
+    node.create_index("scr")
+    svc = node.indices["scr"]
+    for i in range(25):
+        svc.index_doc(f"d{i}", {"v": i})
+        if i % 10 == 9:
+            svc.refresh()  # several segments
+    svc.refresh()
+    from elasticsearch_tpu.search.service import clear_scroll, scroll_next
+
+    r = svc.search({"query": {"match_all": {}}, "size": 7, "scroll": "1m"})
+    sid = r["_scroll_id"]
+    got = [h["_id"] for h in r["hits"]["hits"]]
+    svc.force_merge(1)  # rewrite segments mid-scroll
+    svc.index_doc("new-doc", {"v": 99})  # and add a doc (must NOT appear)
+    svc.refresh()
+    while True:
+        page = scroll_next(sid)
+        hits = page["hits"]["hits"]
+        if not hits:
+            break
+        got.extend(h["_id"] for h in hits)
+    clear_scroll(sid)
+    assert sorted(got) == sorted(f"d{i}" for i in range(25))
+    assert len(got) == 25
+
+
+def test_scroll_with_sort_complete(node):
+    node.create_index("scs", {"mappings": {"properties": {"v": {"type": "long"}}}})
+    svc = node.indices["scs"]
+    for i in range(23):
+        svc.index_doc(f"d{i}", {"v": i})
+    svc.refresh()
+    from elasticsearch_tpu.search.service import clear_scroll, scroll_next
+
+    r = svc.search({"query": {"match_all": {}}, "size": 5,
+                    "sort": [{"v": "desc"}], "scroll": "1m"})
+    sid = r["_scroll_id"]
+    vals = [h["sort"][0] for h in r["hits"]["hits"]]
+    while True:
+        page = scroll_next(sid)
+        if not page["hits"]["hits"]:
+            break
+        vals.extend(h["sort"][0] for h in page["hits"]["hits"])
+    clear_scroll(sid)
+    assert vals == sorted(range(23), reverse=True)
