@@ -51,6 +51,7 @@ class IndexService:
             self.groups.append(ReplicationGroup(i, primary, replicas))
         self.closed = False
         self._percolator = None
+        self._mesh_executor = None
         self.warmers: Dict[str, dict] = {}
         if data_path:
             # gateway recovery (reference: gateway/GatewayService +
@@ -283,6 +284,31 @@ class IndexService:
         for s in self.shards:
             s.engine.merge(max_segments=max_num_segments)
 
+    def mesh_executor(self):
+        """Lazy per-index MeshSearchExecutor: one ('shard',) mesh over
+        min(num_shards, available devices); its device-array caches live as
+        long as the index. None when the mesh can't be built."""
+        if self._mesh_executor is None:
+            try:
+                from elasticsearch_tpu.parallel.executor import MeshSearchExecutor
+                from elasticsearch_tpu.parallel.mesh import shard_mesh
+
+                mesh = shard_mesh(self.num_shards)
+                # pass the live IndexShard objects, NOT a segment snapshot —
+                # the executor must never pin merged-away segments in memory
+                self._mesh_executor = MeshSearchExecutor(mesh, self.shards)
+            except Exception:
+                self._mesh_executor = False
+        return self._mesh_executor or None
+
+    def _mesh_enabled(self) -> bool:
+        import os
+
+        if os.environ.get("ESTPU_DISABLE_MESH"):
+            return False
+        idx = self.settings.get("index", self.settings)
+        return str(idx.get("search", {}).get("mesh", True)).lower() != "false"
+
     def search(self, body: dict, dfs: bool = False,
                preference: Optional[str] = None) -> dict:
         from elasticsearch_tpu.cluster.metadata import check_open
@@ -293,10 +319,20 @@ class IndexService:
         # pick one in-sync copy per shard (preference: _primary | _replica |
         # default round-robin, reference: OperationRouting preference)
         readers = [g.reader(preference) for g in self.groups]
-        resp = search_shards(
-            [s.searcher for s in readers], body, index_name=self.name,
-            global_stats=global_stats,
-        )
+        searchers = [s.searcher for s in readers]
+        resp = None
+        if self._mesh_enabled():
+            # DEFAULT path: the whole scatter/score/merge as one XLA program
+            # over the shard mesh (SURVEY §3); host loop only for features
+            # the compiler can't express
+            from elasticsearch_tpu.parallel.mesh_service import try_mesh_search
+
+            resp = try_mesh_search(self, searchers, body, global_stats)
+        if resp is None:
+            resp = search_shards(
+                searchers, body, index_name=self.name,
+                global_stats=global_stats,
+            )
         if body.get("suggest"):
             resp["suggest"] = self.suggest(body["suggest"])
         return resp

@@ -312,6 +312,17 @@ class Node:
                 continue
             check_open(svc, op="read")
             searched_names.append(n)
+        search_type = (body or {}).get("search_type")
+        if len(searched_names) == 1:
+            # single-index: delegate to the index service BEFORE building
+            # searchers (reader() advances replica round-robin; calling it
+            # twice per request would defeat replica rotation). The service
+            # runs the mesh executor as the default product path.
+            return self.indices[searched_names[0]].search(
+                body or {}, dfs=(search_type == "dfs_query_then_fetch"),
+                preference=preference)
+        for n in searched_names:
+            svc = self.indices[n]
             searchers.extend(g.reader(preference).searcher for g in svc.groups)
         if not searchers:
             return {
@@ -324,7 +335,6 @@ class Node:
         # NOTE: searcher.shard_ord is NOT renumbered here — search_shards
         # stamps candidates with positional ordinals itself, so persistent
         # searcher state stays untouched across multi-index searches
-        search_type = (body or {}).get("search_type")
         gs = None
         if search_type == "dfs_query_then_fetch":
             # merge per-index dfs term stats so idf is consistent across

@@ -1,0 +1,854 @@
+"""Mesh query compiler: parsed query DSL tree → one shard_map program.
+
+Reference: org/elasticsearch/action/search/type/
+TransportSearchQueryThenFetchAction.java:1-148 — ES scatters the query to
+every shard and merges per-shard top-k on the coordinating node. Here the
+whole scatter/score/merge IS one XLA program over the ('shard',) mesh: this
+module splits a parsed query tree into
+
+  * a STATIC structure (the emit tree) — identical on every shard, baked
+    into the traced shard_map body and cached per structure, and
+  * per-shard DATA tables (postings chunk tables, column slabs, bound
+    scalars, id bitmaps) — uploaded as [S, ...] arrays sharded over 'shard'.
+
+Per-shard variability (shard-local vocabularies, idf, term-dict expansions,
+column offsets) is *data*, never control flow, so a single trace serves all
+shards. Queries outside the supported subset raise MeshCompileError and the
+caller falls back to the host per-shard loop (mirroring how ES falls back
+from query-then-fetch optimizations).
+
+Supported: match_all/none, term, terms, match (or/and/minimum_should_match),
+range (numeric i64-exact + f32, date, keyword via term expansion), exists,
+ids, prefix, wildcard, regexp, fuzzy, bool, constant_score, filtered.
+Everything else (phrase/span positional programs, joins, function_score,
+scripts, geo, knn-in-query) → host loop.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.utils.shapes import pow2_bucket
+
+
+class MeshCompileError(Exception):
+    """Query/feature not expressible as a mesh program — host-loop fallback."""
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# data primitives: per-shard host arrays, stacked [S, ...] over the mesh
+# ---------------------------------------------------------------------------
+
+class DataPrim:
+    """One device-input group. build() returns (arrays, static) where
+    `arrays` is a list of np arrays with leading dim S and `static` is a
+    hashable tuple of trace-affecting parameters (chunk window P, Vmax, …).
+    Big immutable arrays go through `cache(key, fn)` keyed by segment ids."""
+
+    n_arrays = 1
+
+    def build(self, seg_row, ctxs, D: int, S: int, cache) -> Tuple[list, tuple]:
+        raise NotImplementedError
+
+
+class LivePrim(DataPrim):
+    n_arrays = 1
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        h = np.zeros((S, D), bool)
+        for si, seg in enumerate(seg_row):
+            if seg is not None:
+                lv = np.asarray(seg.live_host)
+                h[si, : lv.shape[0]] = lv
+        return [h], ()
+
+
+class NumDocsPrim(DataPrim):
+    n_arrays = 1
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        h = np.asarray([(s.num_docs if s is not None else 0) for s in seg_row],
+                       np.int32)
+        return [h], ()
+
+
+class PostingsPrim(DataPrim):
+    """Stacked postings of one field: doc_ids [S, nnz] (pad → D sentinel),
+    tfnorm [S, nnz]."""
+
+    n_arrays = 2
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        nnz = 1
+        for seg in seg_row:
+            inv = seg.inverted.get(self.field) if seg is not None else None
+            if inv is not None:
+                nnz = max(nnz, int(inv.doc_ids.shape[0]))
+        nnz = pow2_bucket(nnz)
+
+        def fill():
+            h_doc = np.full((S, nnz), D, np.int32)
+            h_tfn = np.zeros((S, nnz), np.float32)
+            for si, seg in enumerate(seg_row):
+                inv = seg.inverted.get(self.field) if seg is not None else None
+                if inv is not None:
+                    d = np.asarray(inv.doc_ids)
+                    h_doc[si, : d.shape[0]] = np.where(d >= seg.max_docs, D, d)
+                    h_tfn[si, : d.shape[0]] = np.asarray(inv.tfnorm)
+            return [h_doc, h_tfn]
+
+        key = ("postings", self.field,
+               tuple(id(s) for s in seg_row), nnz, D)
+        return cache(key, fill), ()
+
+
+class TGroupPrim(DataPrim):
+    """Chunk tables for one term group: starts/lens/ws [S, T]. terms_fn(ctx)
+    yields the (terms, weights) lists for that shard — per-shard idf and
+    term-dict expansions resolve here, on host, as data."""
+
+    n_arrays = 3
+
+    def __init__(self, field: str, terms_fn: Callable):
+        self.field = field
+        self.terms_fn = terms_fn
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        from elasticsearch_tpu.search.context import split_runs
+
+        per_shard = []
+        Pmax, Tmax = 1, 1
+        for seg, ctx in zip(seg_row, ctxs):
+            inv = seg.inverted.get(self.field) if seg is not None else None
+            runs = []
+            if inv is not None and ctx is not None:
+                terms, weights = self.terms_fn(ctx)
+                for t, w in zip(terms, weights):
+                    s, ln = inv.term_slice(t)
+                    runs.append((s, ln, w))
+            starts, lens, ws, max_len = split_runs(runs) if runs else ([], [], [], 1)
+            Pmax = max(Pmax, pow2_bucket(max_len))
+            Tmax = max(Tmax, len(starts))
+            per_shard.append((starts, lens, ws))
+        T = pow2_bucket(Tmax, minimum=1) if Tmax else 1
+        h_starts = np.zeros((S, T), np.int32)
+        h_lens = np.zeros((S, T), np.int32)
+        h_ws = np.zeros((S, T), np.float32)
+        for si, (st, ln, ws) in enumerate(per_shard):
+            h_starts[si, : len(st)] = st
+            h_lens[si, : len(ln)] = ln
+            h_ws[si, : len(ws)] = ws
+        return [h_starts, h_lens, h_ws], (Pmax,)
+
+
+class RangePrim(DataPrim):
+    """Numeric/date range: column slab + bounds. Emits the exact-i64 pair
+    form when the column carries (hi, lo) int32 pairs and the bounds are
+    integral (mirror of RangeQuery.execute), else the f32 form with
+    per-shard offset-adjusted bounds."""
+
+    def __init__(self, field: str, lo, hi, use_int: bool):
+        self.field = field
+        self.lo = lo
+        self.hi = hi
+        self.use_int = use_int
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        cols = [(s.numerics.get(self.field) if s is not None else None)
+                for s in seg_row]
+        has_pair = any(c is not None and c.hi is not None for c in cols)
+        pair = has_pair and self.use_int
+        if pair:
+            def fill():
+                h_hi = np.zeros((S, D), np.int32)
+                h_lo = np.zeros((S, D), np.int32)
+                h_ex = np.zeros((S, D), bool)
+                for si, c in enumerate(cols):
+                    if c is not None and c.hi is not None:
+                        hi = np.asarray(c.hi)
+                        h_hi[si, : hi.shape[0]] = hi
+                        h_lo[si, : hi.shape[0]] = np.asarray(c.lo)
+                        h_ex[si, : hi.shape[0]] = np.asarray(c.exists)
+                return [h_hi, h_lo, h_ex]
+
+            key = ("colpair", self.field, tuple(id(s) for s in seg_row), D)
+            arrays = list(cache(key, fill))
+            from elasticsearch_tpu.index.segment import split_i64
+
+            lo_v = int(self.lo) if self.lo is not None else -(2 ** 63)
+            hi_v = int(self.hi) if self.hi is not None else 2 ** 63 - 1
+            (lhi,), (llo,) = split_i64(np.array([lo_v]))
+            (hhi,), (hlo,) = split_i64(np.array([hi_v]))
+            bounds = np.broadcast_to(
+                np.asarray([lhi, llo, hhi, hlo], np.int32), (S, 4)).copy()
+            arrays.append(bounds)
+            return arrays, ("pair",)
+
+        def fill():
+            h_val = np.zeros((S, D), np.float32)
+            h_ex = np.zeros((S, D), bool)
+            for si, c in enumerate(cols):
+                if c is not None:
+                    v = np.asarray(c.values)
+                    h_val[si, : v.shape[0]] = v
+                    h_ex[si, : v.shape[0]] = np.asarray(c.exists)
+            return [h_val, h_ex]
+
+        key = ("colf32", self.field, tuple(id(s) for s in seg_row), D)
+        arrays = list(cache(key, fill))
+        bounds = np.zeros((S, 2), np.float32)
+        for si, c in enumerate(cols):
+            off = c.offset if c is not None else 0.0
+            bounds[si, 0] = (float(self.lo) - off) if self.lo is not None else -np.inf
+            bounds[si, 1] = (float(self.hi) - off) if self.hi is not None else np.inf
+        arrays.append(bounds)
+        return arrays, ("f32",)
+
+
+class SortColPrim(DataPrim):
+    """Sort-key column: values [S, D] f32 + exists [S, D] bool.
+
+    Column values are stored offset-relative PER SEGMENT (offset = segment
+    min, for f32 precision); ranking across shards needs one common scale,
+    so each slot is rebased to the minimum offset of the row — magnitudes
+    stay as small as the spread between segments allows."""
+
+    n_arrays = 2
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        cols = [(s.numerics.get(self.field) if s is not None else None)
+                for s in seg_row]
+        base = min((c.offset for c in cols if c is not None), default=0.0)
+
+        def fill():
+            h_val = np.zeros((S, D), np.float32)
+            h_ex = np.zeros((S, D), bool)
+            for si, c in enumerate(cols):
+                if c is not None:
+                    v = np.asarray(c.values) + np.float32(c.offset - base)
+                    h_val[si, : v.shape[0]] = v
+                    h_ex[si, : v.shape[0]] = np.asarray(c.exists)
+            return [h_val, h_ex]
+
+        key = ("sortcol", self.field, tuple(id(s) for s in seg_row), D)
+        return cache(key, fill), ()
+
+
+class ExistsPrim(DataPrim):
+    n_arrays = 1
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        f = self.field
+
+        def fill():
+            h = np.zeros((S, D), bool)
+            for si, seg in enumerate(seg_row):
+                if seg is None:
+                    continue
+                # mirror ExistsQuery.execute resolution order
+                if f in seg.numerics:
+                    ex = np.asarray(seg.numerics[f].exists)
+                elif f in seg.keywords:
+                    ex = np.asarray(seg.keywords[f].exists)
+                elif f in seg.vectors:
+                    ex = np.asarray(seg.vectors[f].exists)
+                elif f in seg.field_lengths:
+                    ex = np.asarray(seg.field_lengths[f]) > 0
+                else:
+                    continue
+                h[si, : ex.shape[0]] = ex
+            return [h]
+
+        key = ("exists", f, tuple(id(s) for s in seg_row), D)
+        return cache(key, fill), ()
+
+
+class IdsPrim(DataPrim):
+    n_arrays = 1
+
+    def __init__(self, values: List[str]):
+        self.values = [str(v) for v in values]
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        h = np.zeros((S, D), bool)
+        for si, seg in enumerate(seg_row):
+            if seg is None:
+                continue
+            for doc_id in self.values:
+                loc = seg.id_map.get(doc_id)
+                if loc is not None:
+                    h[si, loc] = True
+        return [h], ()
+
+
+class AggTermsPrim(DataPrim):
+    """Keyword terms-agg inputs: postings doc_ids/term_ids + per-shard real
+    vocab size (mirrors TermsAggregator's postings-based multi-value-correct
+    count)."""
+
+    n_arrays = 3
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        nnz, vmax = 1, 1
+        for seg in seg_row:
+            inv = seg.inverted.get(self.field) if seg is not None else None
+            if inv is not None:
+                nnz = max(nnz, int(inv.doc_ids.shape[0]))
+                vmax = max(vmax, inv.vocab_size)
+        nnz = pow2_bucket(nnz)
+        vmax = pow2_bucket(vmax)
+
+        def fill():
+            h_doc = np.zeros((S, nnz), np.int32)
+            h_tid = np.full((S, nnz), vmax, np.int32)
+            for si, seg in enumerate(seg_row):
+                inv = seg.inverted.get(self.field) if seg is not None else None
+                if inv is not None:
+                    d = np.asarray(inv.doc_ids)
+                    h_doc[si, : d.shape[0]] = np.clip(d, 0, D - 1)
+                    t = np.asarray(inv.term_ids)
+                    # padded/absent term ids map to the vmax sentinel bucket
+                    h_tid[si, : t.shape[0]] = np.where(t >= inv.vocab_size, vmax, t)
+            return [h_doc, h_tid]
+
+        key = ("aggterms", self.field, tuple(id(s) for s in seg_row), nnz, D, vmax)
+        arrays = list(cache(key, fill))
+        vreal = np.asarray(
+            [(s.inverted[self.field].vocab_size
+              if s is not None and self.field in s.inverted else 0)
+             for s in seg_row], np.int32)
+        arrays.append(vreal)
+        return arrays, (vmax,)
+
+
+# ---------------------------------------------------------------------------
+# emit tree: static structure, traced once per structure+shape class
+# ---------------------------------------------------------------------------
+
+class Emit:
+    boost: float = 1.0
+
+    def key(self) -> tuple:
+        raise NotImplementedError
+
+    def ex(self, env, meta):
+        """-> (scores f32[D] | None, mask bool[D]); mirrors Query.execute."""
+        raise NotImplementedError
+
+    def sm(self, env, meta):
+        """mirrors Query.score_or_mask (filter-as-boost semantics)."""
+        s, m = self.ex(env, meta)
+        if s is None:
+            s = m.astype(_jnp().float32) * self.boost
+        return s, m
+
+
+class EMatchAll(Emit):
+    def __init__(self, boost: float, nd: int, D: int):
+        self.boost = boost
+        self.nd = nd
+        self.D = D
+
+    def key(self):
+        return ("all", self.boost)
+
+    def ex(self, env, meta):
+        jnp = _jnp()
+        mask = jnp.arange(self.D) < env[self.nd][0]
+        return jnp.full(self.D, self.boost, jnp.float32) * mask, mask
+
+
+class ENone(Emit):
+    def __init__(self, D: int):
+        self.D = D
+
+    def key(self):
+        return ("none",)
+
+    def ex(self, env, meta):
+        jnp = _jnp()
+        return None, jnp.zeros(self.D, bool)
+
+
+class ETermGroup(Emit):
+    """mode 'scores': BM25 scores, mask = scores > 0 (all-positive weights).
+    mode 'count_ge': conjunction — distinct matched terms >= n.
+    mode 'mask': presence only (terms filter / expansions)."""
+
+    def __init__(self, prim: int, post: int, mode: str, n: int, boost: float,
+                 D: int):
+        self.prim = prim
+        self.post = post
+        self.mode = mode
+        self.n = n
+        self.boost = boost
+        self.D = D
+
+    def key(self):
+        return ("tg", self.mode, self.n, self.boost)
+
+    def ex(self, env, meta):
+        from elasticsearch_tpu.ops.scoring import (
+            bm25_score_segment, match_count_segment, term_mask)
+
+        doc_ids, tfnorm = env[self.post]
+        starts, lens, ws = env[self.prim]
+        (P,) = meta[self.prim]
+        if self.mode == "mask":
+            return None, term_mask(doc_ids, starts, lens, P=P, D=self.D)
+        scores = bm25_score_segment(doc_ids, tfnorm, starts, lens, ws,
+                                    P=P, D=self.D)
+        if self.mode == "count_ge":
+            counts = match_count_segment(doc_ids, starts, lens, P=P, D=self.D)
+            return scores, counts >= self.n
+        return scores, scores > 0
+
+
+class ERange(Emit):
+    def __init__(self, prim: int, ilo: bool, ihi: bool):
+        self.prim = prim
+        self.ilo = ilo
+        self.ihi = ihi
+
+    def key(self):
+        return ("range", self.ilo, self.ihi, self.boost)
+
+    def ex(self, env, meta):
+        from elasticsearch_tpu.ops.scoring import range_mask_f32, range_mask_i64pair
+
+        jnp = _jnp()
+        (form,) = meta[self.prim]
+        if form == "pair":
+            hi_col, lo_col, exists, b = env[self.prim]
+            mask = range_mask_i64pair(
+                hi_col, lo_col, exists, b[0], b[1], b[2], b[3],
+                jnp.bool_(self.ilo), jnp.bool_(self.ihi))
+        else:
+            values, exists, b = env[self.prim]
+            mask = range_mask_f32(values, exists, b[0], b[1],
+                                  jnp.bool_(self.ilo), jnp.bool_(self.ihi))
+        return None, mask
+
+
+class EMaskData(Emit):
+    """Mask handed over as data (exists / ids)."""
+
+    def __init__(self, prim: int, tag: str):
+        self.prim = prim
+        self.tag = tag
+
+    def key(self):
+        return (self.tag, self.boost)
+
+    def ex(self, env, meta):
+        return None, env[self.prim][0]
+
+
+class EOr(Emit):
+    """OR of child masks (numeric terms query)."""
+
+    def __init__(self, children: List[Emit], D: int):
+        self.children = children
+        self.D = D
+
+    def key(self):
+        return ("or", self.boost) + tuple(c.key() for c in self.children)
+
+    def ex(self, env, meta):
+        jnp = _jnp()
+        mask = jnp.zeros(self.D, bool)
+        for c in self.children:
+            _, m = c.ex(env, meta)
+            mask = mask | m
+        return None, mask
+
+
+class EConstScore(Emit):
+    def __init__(self, child: Emit, boost: float):
+        self.child = child
+        self.boost = boost
+
+    def key(self):
+        return ("const", self.boost, self.child.key())
+
+    def ex(self, env, meta):
+        jnp = _jnp()
+        _, mask = self.child.ex(env, meta)
+        return mask.astype(jnp.float32) * self.boost, mask
+
+
+class EBool(Emit):
+    def __init__(self, must, should, must_not, filter_, need: int,
+                 boost: float, nd: int, D: int):
+        self.must = must
+        self.should = should
+        self.must_not = must_not
+        self.filter = filter_
+        self.need = need
+        self.boost = boost
+        self.nd = nd
+        self.D = D
+
+    def key(self):
+        return ("bool", self.need, self.boost,
+                tuple(c.key() for c in self.must),
+                tuple(c.key() for c in self.should),
+                tuple(c.key() for c in self.must_not),
+                tuple(c.key() for c in self.filter))
+
+    def ex(self, env, meta):
+        jnp = _jnp()
+        all_live = jnp.arange(self.D) < env[self.nd][0]
+        mask = all_live
+        scores = jnp.zeros(self.D, jnp.float32)
+        for c in self.must:
+            s, m = c.sm(env, meta)
+            scores = scores + s
+            mask = mask & m
+        for c in self.filter:
+            _, m = c.ex(env, meta)
+            mask = mask & m
+        for c in self.must_not:
+            _, m = c.ex(env, meta)
+            mask = mask & ~m
+        if self.should:
+            should_count = jnp.zeros(self.D, jnp.int32)
+            for c in self.should:
+                s, m = c.sm(env, meta)
+                scores = scores + jnp.where(m, s, 0.0)
+                should_count = should_count + m.astype(jnp.int32)
+            if self.need > 0:
+                mask = mask & (should_count >= self.need)
+        if not (self.must or self.should or self.filter or self.must_not):
+            return None, jnp.zeros(self.D, bool)
+        if self.boost != 1.0:
+            scores = scores * self.boost
+        return scores * mask, mask
+
+
+# ---------------------------------------------------------------------------
+# compiler
+# ---------------------------------------------------------------------------
+
+class CompiledMeshQuery:
+    """Result of compile_mesh_query: emit tree + data primitives. One
+    instance per request; program caching happens in the executor keyed by
+    (struct_key, static/shape tuple)."""
+
+    def __init__(self, root: Emit, prims: List[DataPrim], live: int, nd: int,
+                 D: int, sort_prim: Optional[int], sort_cfg: Optional[tuple],
+                 agg_prims: List[Tuple[str, int]]):
+        self.root = root
+        self.prims = prims
+        self.live = live
+        self.nd = nd
+        self.D = D
+        self.sort_prim = sort_prim
+        self.sort_cfg = sort_cfg  # (desc, missing_first) or None
+        self.agg_prims = agg_prims  # [(agg_name, prim_idx)]
+
+    def struct_key(self):
+        return (self.root.key(), self.D, self.sort_prim is not None,
+                self.sort_cfg, tuple(name for name, _ in self.agg_prims))
+
+
+class MeshQueryCompiler:
+    def __init__(self, mappings, analysis, global_stats=None, D: int = 0):
+        self.mappings = mappings
+        self.analysis = analysis
+        self.gs = global_stats
+        self.D = D
+        self.prims: List[DataPrim] = []
+        self._postings: Dict[str, int] = {}
+
+    def _add(self, prim: DataPrim) -> int:
+        self.prims.append(prim)
+        return len(self.prims) - 1
+
+    def _postings_for(self, field: str) -> int:
+        if field not in self._postings:
+            self._postings[field] = self._add(PostingsPrim(field))
+        return self._postings[field]
+
+    def compile(self, query, sort_spec: Optional[list],
+                agg_specs: Optional[list]) -> CompiledMeshQuery:
+        live = self._add(LivePrim())
+        nd = self._add(NumDocsPrim())
+        self._nd = nd
+        root = self._c(query)
+        sort_prim = None
+        sort_cfg = None
+        if sort_spec:
+            if len(sort_spec) != 1:
+                raise MeshCompileError("multi-key sort")
+            s = sort_spec[0]
+            if s["field"] == "_score":
+                raise MeshCompileError("explicit _score sort")
+            fm = self.mappings.get(s["field"])
+            if fm is None or not fm.is_numeric:
+                raise MeshCompileError("non-numeric sort field")
+            sort_prim = self._add(SortColPrim(s["field"]))
+            sort_cfg = (s["order"] == "desc",
+                        str(s.get("missing", "_last")) == "_first")
+        agg_prims: List[Tuple[str, int]] = []
+        for name, field in (agg_specs or []):
+            agg_prims.append((name, self._add(AggTermsPrim(field))))
+        return CompiledMeshQuery(root, self.prims, live, nd, self.D,
+                                 sort_prim, sort_cfg, agg_prims)
+
+    # -- tree walk (mirrors search/queries.py execute semantics) -------------
+
+    def _c(self, q) -> Emit:
+        from elasticsearch_tpu.search import queries as Q
+
+        D = self.D
+        if q is None or isinstance(q, Q.MatchAllQuery):
+            boost = getattr(q, "boost", 1.0)
+            return EMatchAll(boost, self._nd, D)
+        if isinstance(q, Q.MatchNoneQuery):
+            return ENone(D)
+        if isinstance(q, Q.TermQuery):
+            fm = self.mappings.get(q.field)
+            if fm is not None and fm.is_numeric:
+                return self._range(Q.RangeQuery(q.field, gte=q.value,
+                                                lte=q.value, boost=q.boost))
+            return self._tgroup_scores(
+                q.field, q.boost,
+                lambda ctx, q=q: ([q._term_str(ctx)], None))
+        if isinstance(q, Q.TermsQuery):
+            fm = self.mappings.get(q.field)
+            if fm is not None and fm.is_numeric:
+                kids = [self._range(Q.RangeQuery(q.field, gte=v, lte=v))
+                        for v in q.values]
+                node = EOr(kids, D)
+                node.boost = q.boost
+                return node
+            terms = [str(v) for v in q.values]
+            return self._tgroup_mask(q.field, q.boost,
+                                     lambda ctx, t=terms: list(dict.fromkeys(t)))
+        if isinstance(q, Q.MatchQuery):
+            if q.fuzziness is not None:
+                raise MeshCompileError("fuzzy match")
+            return self._match(q)
+        if isinstance(q, Q.RangeQuery):
+            return self._range(q)
+        if isinstance(q, Q.ExistsQuery):
+            node = EMaskData(self._add(ExistsPrim(q.field)), "exists")
+            node.boost = q.boost
+            return node
+        if isinstance(q, Q.IdsQuery):
+            node = EMaskData(self._add(IdsPrim(q.values)), "ids")
+            node.boost = q.boost
+            return node
+        if isinstance(q, Q.PrefixQuery):
+            return self._tgroup_mask(
+                q.field, q.boost,
+                lambda ctx, q=q: Q._expand_prefix(
+                    ctx.inv(q.field), str(q.value), q.max_expansions)
+                if ctx.inv(q.field) is not None else [])
+        if isinstance(q, Q.WildcardQuery):
+            return self._tgroup_mask(
+                q.field, q.boost, lambda ctx, q=q: _wildcard_terms(ctx, q))
+        if isinstance(q, Q.RegexpQuery):
+            return self._tgroup_mask(
+                q.field, q.boost, lambda ctx, q=q: _regexp_terms(ctx, q))
+        if isinstance(q, Q.FuzzyQuery):
+            return self._tgroup_scores(
+                q.field, q.boost, lambda ctx, q=q: (_fuzzy_terms(ctx, q), None))
+        if isinstance(q, Q.BoolQuery):
+            must = [self._c(c) for c in q.must]
+            should = [self._c(c) for c in q.should]
+            must_not = [self._c(c) for c in q.must_not]
+            filt = [self._c(c) for c in q.filter]
+            default_msm = 0 if (q.must or q.filter) else 1
+            need = (Q._min_should_match(q.msm, len(q.should))
+                    if q.msm is not None else default_msm) if q.should else 0
+            return EBool(must, should, must_not, filt, need, q.boost,
+                         self._nd, D)
+        if isinstance(q, Q.ConstantScoreQuery):
+            return EConstScore(self._c(q.inner), q.boost)
+        raise MeshCompileError(f"unsupported query type {type(q).__name__}")
+
+    def _tgroup_scores(self, field: str, boost: float, base_terms_fn) -> Emit:
+        """Scoring term group (mask = scores > 0): weights = idf*boost,
+        duplicate terms summed (mirror _score_term_group/_dedupe_terms)."""
+        from elasticsearch_tpu.search.queries import _dedupe_terms
+
+        def terms_fn(ctx):
+            terms, _ = base_terms_fn(ctx)
+            if not terms:
+                return [], []
+            return _dedupe_terms(terms, boost,
+                                 lambda t: ctx.idf(field, t))
+
+        prim = TGroupPrim(field, terms_fn)
+        post = self._postings_for(field)
+        idx = self._add(prim)
+        return ETermGroup(idx, post, "scores", 0, boost, self.D)
+
+    def _tgroup_mask(self, field: str, boost: float, expand_fn) -> Emit:
+        def terms_fn(ctx):
+            terms = list(dict.fromkeys(expand_fn(ctx)))
+            return terms, [1.0] * len(terms)
+
+        prim = TGroupPrim(field, terms_fn)
+        post = self._postings_for(field)
+        idx = self._add(prim)
+        node = ETermGroup(idx, post, "mask", 0, boost, self.D)
+        node.boost = boost
+        return node
+
+    def _match(self, q) -> Emit:
+        from elasticsearch_tpu.search.queries import (_dedupe_terms,
+                                                      _min_should_match)
+
+        field, boost = q.field, q.boost
+
+        def analyze(ctx):
+            an = ctx.search_analyzer(field)
+            if an is None:
+                return [str(q.text)]
+            return [t for t, _ in an.analyze(str(q.text))]
+
+        def terms_fn(ctx):
+            return _dedupe_terms(analyze(ctx), boost,
+                                 lambda t: ctx.idf(field, t))
+
+        prim = TGroupPrim(field, terms_fn)
+        post = self._postings_for(field)
+        idx = self._add(prim)
+        # the analyzer output is query-side — identical on every shard, so
+        # n_terms/msm thresholds are static (resolve once with the analyzer)
+        an = self.analysis.get(
+            (self.mappings.get(field).search_analyzer
+             or self.mappings.get(field).analyzer)
+            if self.mappings.get(field) is not None
+            and self.mappings.get(field).is_text else None) \
+            if self.mappings.get(field) is not None and self.mappings.get(field).is_text else None
+        toks = ([t for t, _ in an.analyze(str(q.text))] if an is not None
+                else [str(q.text)])
+        n_terms = len(set(toks))
+        if q.operator == "and":
+            return ETermGroup(idx, post, "count_ge", max(n_terms, 1), boost,
+                              self.D)
+        if q.msm is not None:
+            need = max(_min_should_match(q.msm, n_terms), 1)
+            return ETermGroup(idx, post, "count_ge", need, boost, self.D)
+        return ETermGroup(idx, post, "scores", 0, boost, self.D)
+
+    def _range(self, q) -> Emit:
+        from elasticsearch_tpu.search import queries as Q
+
+        fm = self.mappings.get(q.field)
+        if fm is not None and (fm.is_text or fm.is_keyword):
+            # keyword range: per-shard sorted-term-dict expansion (mirror of
+            # RangeQuery keyword branch)
+            def expand(ctx, q=q):
+                inv = ctx.inv(q.field)
+                if inv is None:
+                    return []
+                from bisect import bisect_left
+                lo, ilo, hi, ihi = q._bounds(ctx)
+                terms, _ = Q._sorted_terms(inv)
+                i0 = bisect_left(terms, str(lo)) if lo is not None else 0
+                if lo is not None and not ilo and i0 < len(terms) and terms[i0] == str(lo):
+                    i0 += 1
+                i1 = bisect_left(terms, str(hi)) if hi is not None else len(terms)
+                if hi is not None and ihi and i1 < len(terms) and terms[i1] == str(hi):
+                    i1 += 1
+                return terms[i0:i1]
+
+            return self._tgroup_mask(q.field, q.boost, expand)
+        if fm is None:
+            raise MeshCompileError(f"range on unmapped field [{q.field}]")
+        # numeric/date: bounds are query-side constants; date parsing uses
+        # the mapping format (identical across shards)
+        lo, include_lo = (q.gte, True) if q.gte is not None else (q.gt, False)
+        hi, include_hi = (q.lte, True) if q.lte is not None else (q.lt, False)
+        if fm.type == "date":
+            from elasticsearch_tpu.utils.dates import parse_date
+
+            fmt = q.fmt or fm.fmt
+            lo = parse_date(lo, fmt) if lo is not None else None
+            hi = parse_date(hi, fmt) if hi is not None else None
+
+        def as_int(v):
+            if v is None:
+                return None
+            try:
+                f = float(v)
+            except (TypeError, ValueError):
+                return None
+            i = int(f)
+            return i if f == i else None
+
+        use_int = ((lo is None or as_int(lo) is not None)
+                   and (hi is None or as_int(hi) is not None))
+        prim = RangePrim(q.field, lo, hi, use_int)
+        idx = self._add(prim)
+        node = ERange(idx, include_lo if lo is not None else True,
+                      include_hi if hi is not None else True)
+        node.boost = q.boost
+        return node
+
+
+def _wildcard_terms(ctx, q):
+    import fnmatch
+    import re
+
+    inv = ctx.inv(q.field)
+    if inv is None:
+        return []
+    from elasticsearch_tpu.search.queries import _expand_prefix
+
+    pat = str(q.value)
+    prefix = re.match(r"^[^*?\[\]]*", pat).group(0)
+    cands = _expand_prefix(inv, prefix, 1 << 30) if prefix else inv.terms
+    rx = re.compile(fnmatch.translate(pat))
+    return [t for t in cands if rx.match(t)][: q.max_expansions]
+
+
+def _regexp_terms(ctx, q):
+    import re
+
+    inv = ctx.inv(q.field)
+    if inv is None:
+        return []
+    from elasticsearch_tpu.utils.errors import QueryParsingException
+
+    try:
+        rx = re.compile(str(q.value))
+    except re.error as e:
+        raise QueryParsingException(f"invalid regexp [{q.value}]: {e}")
+    return [t for t in inv.terms if rx.fullmatch(t)][: q.max_expansions]
+
+
+def _fuzzy_terms(ctx, q):
+    from elasticsearch_tpu.search.queries import (_edit_distance_le,
+                                                  _fuzziness_to_edits)
+
+    inv = ctx.inv(q.field)
+    if inv is None:
+        return []
+    t = str(q.value)
+    k = _fuzziness_to_edits(q.fuzziness, t)
+    return [c for c in inv.terms if _edit_distance_le(t, c, k)][: q.max_expansions]

@@ -142,6 +142,67 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
     return fn
 
 
+def _dsl_program(mesh, compiled, counts, statics, k: int):
+    """Build the shard_map program for one compiled DSL structure: emit-tree
+    score/mask → local top-k → all_gather + global top-k, exact totals via
+    psum, per-shard terms-agg count vectors."""
+    jax = _jax()
+    import jax.numpy as jnp
+    from jax import lax
+    from elasticsearch_tpu.parallel.mesh import get_shard_map as _gsm
+    shard_map = _gsm()
+    from jax.sharding import PartitionSpec as PS
+
+    meta = {i: s for i, s in enumerate(statics)}
+    n_aggs = len(compiled.agg_prims)
+
+    def body(*flat):
+        env = {}
+        pos = 0
+        for i, c in enumerate(counts):
+            env[i] = tuple(a[0] for a in flat[pos: pos + c])
+            pos += c
+        scores, mask = compiled.root.sm(env, meta)
+        live = env[compiled.live][0]
+        mask = mask & live
+        totals = lax.psum(jnp.sum(mask.astype(jnp.int32)), "shard")
+        if compiled.sort_prim is not None:
+            desc, miss_first = compiled.sort_cfg
+            values, exists = env[compiled.sort_prim]
+            missing = jnp.float32(-jnp.inf if desc else jnp.inf)
+            if miss_first:
+                missing = -missing
+            keyv = jnp.where(exists, values, missing)
+            rank = keyv * (1.0 if desc else -1.0)
+        else:
+            rank = scores
+        masked = jnp.where(mask, rank, -jnp.inf)
+        vals, idx = lax.top_k(masked, k)
+        av = lax.all_gather(vals, "shard")  # [S, k]
+        ai = lax.all_gather(idx, "shard")
+        S = av.shape[0]
+        gvals, gpos = lax.top_k(av.reshape(S * k), k)
+        gslot = (gpos // k).astype(jnp.int32)
+        glocal = ai.reshape(S * k)[gpos].astype(jnp.int32)
+        outs = [gvals, gslot, glocal, totals]
+        for _name, prim in compiled.agg_prims:
+            doc_ids, term_ids, vreal = env[prim]
+            (vmax,) = meta[prim]
+            w = mask[doc_ids] & (term_ids < vreal)
+            cnts = jnp.zeros(vmax + 1, jnp.float32).at[term_ids].add(
+                w.astype(jnp.float32), mode="drop")
+            outs.append(cnts[None, :])  # keep per-shard partials
+        return tuple(outs)
+
+    n_in = sum(counts)
+    in_specs = tuple(PS("shard") for _ in range(n_in))
+    out_specs = (PS(), PS(), PS(), PS()) + tuple(
+        PS("shard") for _ in range(n_aggs))
+    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=False)
+    return jax.jit(fn)
+
+
 def _psum_program(mesh, cache, shape):
     """Merge per-shard numeric agg partials: psum over 'shard'."""
     key = ("psum", tuple(shape))
@@ -231,14 +292,7 @@ class MeshSearchExecutor:
         (round-robin wrap); `shard_index` on results maps a slot back to the
         originating shard via the stored pairs.
         """
-        cols = [[] for _ in range(self.S)]
-        for i, s in enumerate(self.shards):
-            cols[i % self.S].extend(
-                (i, ordinal, seg)
-                for ordinal, seg in enumerate(_segments_of(s)))
-        max_rounds = max((len(c) for c in cols), default=0) or 1
-        return [[c[r] if r < len(c) else None for c in cols]
-                for r in range(max_rounds)]
+        return self._rounds_for(self.shards)
 
     def _search_round(self, field, query_terms, row, k):
         import jax.numpy as jnp
@@ -370,6 +424,115 @@ class MeshSearchExecutor:
                    lut_ord[slot], None)
             merged = out if merged is None else _merge_rounds(merged, out, k)
         return merged
+
+    # -- full DSL (compiled query trees) -------------------------------------
+
+    def search_dsl(self, body_query, mappings, analysis, k: int,
+                   sort_spec=None, agg_specs=None, global_stats=None,
+                   shards=None):
+        """Execute a compiled query DSL tree over the mesh.
+
+        Returns (cands, totals, agg_rounds) where cands is a list of
+        (val, shard, seg_ord, local) for the global top candidates
+        (k oversampled ×4 when sorting, mirroring the host path), totals is
+        the exact hit count (psum), and agg_rounds maps agg name → list of
+        (shard, seg_ord, segment, counts np[V]) per segment for the host
+        reduce phase. Raises MeshCompileError for unsupported queries.
+        """
+        from elasticsearch_tpu.parallel.compiler import MeshQueryCompiler
+        from elasticsearch_tpu.search.context import SegmentContext
+
+        jax = _jax()
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        shard_list = self.shards if shards is None else list(shards)
+        rows = self._rounds_for(shard_list)
+        merged: List[tuple] = []
+        totals = 0
+        agg_rounds: Dict[str, list] = {}
+        k_dev = k if not sort_spec else min(max(k * 4, 128), 1 << 20)
+        for row in rows:
+            seg_row = [e[2] if e is not None else None for e in row]
+            lut_shard = [e[0] if e is not None else -1 for e in row]
+            lut_ord = [e[1] if e is not None else 0 for e in row]
+            D = pow2_bucket(max((s.max_docs if s is not None else 1)
+                                for s in seg_row))
+            ctxs = [SegmentContext(s, mappings, analysis, global_stats)
+                    if s is not None else None for s in seg_row]
+            comp = MeshQueryCompiler(mappings, analysis, global_stats, D=D)
+            compiled = comp.compile(body_query, sort_spec, agg_specs)
+
+            # build per-prim data + statics; cacheable groups are device-put
+            # once and reused across queries (postings, columns)
+            sh = NamedSharding(self.mesh, PS("shard"))
+
+            def cache_fn(key, fn):
+                return self._cached_data(
+                    key, lambda: [jax.device_put(a, sh) for a in fn()],
+                    seg_row)
+
+            arrays: List[Any] = []
+            counts: List[int] = []
+            statics: List[tuple] = []
+            for prim in compiled.prims:
+                arrs, static = prim.build(seg_row, ctxs, D, self.S, cache_fn)
+                arrays.extend(arrs)
+                counts.append(len(arrs))
+                statics.append(static)
+            kk = min(k_dev, D)
+            prog_key = ("dsl", compiled.struct_key(), tuple(statics),
+                        tuple(tuple(a.shape) + (str(a.dtype),) for a in arrays),
+                        kk)
+            prog = self._programs.get(prog_key)
+            if prog is None:
+                prog = _dsl_program(self.mesh, compiled, counts, statics, kk)
+                self._programs[prog_key] = prog
+            dev = [a if hasattr(a, "sharding") else jax.device_put(a, sh)
+                   for a in arrays]
+            out = prog(*dev)
+            gvals, gslot, glocal, tot = (np.asarray(out[0]), np.asarray(out[1]),
+                                         np.asarray(out[2]), int(out[3]))
+            totals += tot
+            for v, sl, lc in zip(gvals, gslot, glocal):
+                if np.isfinite(v):
+                    merged.append((float(v), lut_shard[int(sl)],
+                                   lut_ord[int(sl)], int(lc)))
+            for (name, _prim), acounts in zip(compiled.agg_prims, out[4:]):
+                ac = np.asarray(acounts)  # [S, Vmax+1]
+                for si, seg in enumerate(seg_row):
+                    if seg is None:
+                        continue
+                    agg_rounds.setdefault(name, []).append(
+                        (lut_shard[si], lut_ord[si], seg, ac[si]))
+        if sort_spec:
+            # field-sorted: the exact ordering happens on host over the full
+            # value tuples (mesh_service); rank order here is the preselect
+            merged.sort(key=lambda t: (-t[0], t[1], t[2], t[3]))
+            return merged[:k_dev], totals, agg_rounds
+        # mirror the host loop exactly: per-shard candidates merge in
+        # (-score, seg, local) order and truncate at k (query_phase), THEN
+        # the global merge orders by (-score, shard, local) with the
+        # per-shard (seg, local) order as the stable fallback (search_shards)
+        by_shard: Dict[int, list] = {}
+        for t in merged:
+            by_shard.setdefault(t[1], []).append(t)
+        out: List[tuple] = []
+        for sh in sorted(by_shard):
+            lst = by_shard[sh]
+            lst.sort(key=lambda t: (-t[0], t[2], t[3]))
+            out.extend(lst[:k])
+        out.sort(key=lambda t: (-t[0], t[1], t[3]))  # stable: seg order kept
+        return out[:k_dev], totals, agg_rounds
+
+    def _rounds_for(self, shard_list):
+        cols = [[] for _ in range(self.S)]
+        for i, s in enumerate(shard_list):
+            cols[i % self.S].extend(
+                (i, ordinal, seg)
+                for ordinal, seg in enumerate(_segments_of(s)))
+        max_rounds = max((len(c) for c in cols), default=0) or 1
+        return [[c[r] if r < len(c) else None for c in cols]
+                for r in range(max_rounds)]
 
     # -- aggs ---------------------------------------------------------------
 

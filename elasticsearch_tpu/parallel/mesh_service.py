@@ -1,0 +1,162 @@
+"""Product search path over the shard mesh.
+
+Reference: org/elasticsearch/action/search/type/
+TransportSearchQueryThenFetchAction.java:1-148. `/index/_search` lands here
+first: the parsed query compiles (parallel/compiler.py) into ONE shard_map
+program per segment round — per-shard scoring, local top-k, all_gather +
+global top-k, psum totals, terms-agg partials — and only the fetch phase
+(_source, highlight) stays on host. Anything the compiler can't express
+returns None and the caller falls back to the host per-shard loop in
+search/service.py (same result, sequential execution).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.parallel.compiler import MeshCompileError
+
+
+# host-loop-only request features: their presence skips the mesh path
+_UNSUPPORTED_KEYS = ("rescore", "search_after", "min_score", "scroll",
+                     "profile", "highlight")
+
+
+def try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[dict]:
+    """Mesh-execute a search request; None → caller uses the host loop."""
+    body = body or {}
+    for key in _UNSUPPORTED_KEYS:
+        if body.get(key):
+            return None
+    size = int(body.get("size", 10))
+    frm = int(body.get("from", 0))
+    if frm + size > 10_000:
+        return None  # host loop raises the max_result_window error
+    from elasticsearch_tpu.search.aggregations import parse_aggs, reduce_aggs
+    from elasticsearch_tpu.search.queries import parse_query
+    from elasticsearch_tpu.search.service import _parse_sort
+
+    # any nested segment → block-join masks the program doesn't carry
+    shard_segs = [list(s.segments) for s in searchers]
+    for segs in shard_segs:
+        for seg in segs:
+            if seg.has_nested:
+                return None
+    aggs = parse_aggs(body.get("aggs") or body.get("aggregations"))
+    agg_specs = []
+    for a in aggs or []:
+        if not _terms_agg_eligible(a, svc.mappings):
+            return None
+        agg_specs.append((a.name, a.body.get("field")))
+    sort_spec = _parse_sort(body.get("sort"))
+    query = parse_query(body.get("query"))
+    t0 = time.perf_counter()
+    executor = svc.mesh_executor()
+    if executor is None:
+        return None
+    k = max(frm + size, 1)
+    try:
+        cands, totals, agg_rounds = executor.search_dsl(
+            query, svc.mappings, svc.analysis, k,
+            sort_spec=sort_spec or None, agg_specs=agg_specs or None,
+            global_stats=global_stats, shards=shard_segs)
+    except MeshCompileError:
+        return None
+    q_ms = (time.perf_counter() - t0) * 1000
+    for s in searchers:
+        s.stats.on_query(q_ms / max(len(searchers), 1))
+
+    from elasticsearch_tpu.search.context import SegmentContext
+    from elasticsearch_tpu.search.service import ShardDoc, _sort_key, _sort_value
+
+    # candidates → ShardDocs (resolve segment objects from the snapshot)
+    docs: List[ShardDoc] = []
+    ctx_cache: Dict[tuple, Any] = {}
+    for val, sh, seg_ord, local in cands:
+        seg = shard_segs[sh][seg_ord]
+        if sort_spec:
+            key2 = (sh, seg_ord)
+            ctx = ctx_cache.get(key2)
+            if ctx is None:
+                ctx = SegmentContext(seg, svc.mappings, svc.analysis)
+                ctx_cache[key2] = ctx
+            sv = tuple(_sort_value(ctx, s, local, None) for s in sort_spec)
+            d = ShardDoc(sh, seg, local, float("nan"), sv)
+        else:
+            d = ShardDoc(sh, seg, local, val)
+        d._seg_ord = seg_ord
+        docs.append(d)
+    if sort_spec:
+        # exact host ordering on the full value tuple (device rank is the
+        # f32 preselect, like the host loop's _sorted_candidates)
+        docs.sort(key=lambda d: (_sort_key(d.sort_values, sort_spec),
+                                 d.shard_ord, d._seg_ord, d.local_id))
+    page = docs[frm: frm + size]
+    max_score = None
+    if not sort_spec and cands:
+        max_score = max(v for v, *_ in cands)
+
+    # fetch phase per shard, then restore global order
+    by_shard: Dict[int, List[ShardDoc]] = {}
+    for d in page:
+        by_shard.setdefault(d.shard_ord, []).append(d)
+    hits: List[dict] = []
+    fetched_docs: List[ShardDoc] = []
+    for sh, ds in by_shard.items():
+        tf = time.perf_counter()
+        hits.extend(searchers[sh].fetch_phase(ds, body, svc.name))
+        searchers[sh].stats.on_fetch((time.perf_counter() - tf) * 1000)
+        fetched_docs.extend(ds)
+    order = {id(d): i for i, d in enumerate(page)}
+    hd = sorted(zip(hits, fetched_docs), key=lambda x: order[id(x[1])])
+    hits = [h for h, _ in hd]
+
+    response: Dict[str, Any] = {
+        "took": int((time.perf_counter() - t0) * 1000),
+        "timed_out": False,
+        "_shards": {"total": len(searchers), "successful": len(searchers),
+                    "failed": 0},
+        "hits": {
+            "total": totals,
+            "max_score": None if (sort_spec or max_score is None) else max_score,
+            "hits": hits,
+        },
+    }
+    if aggs:
+        partial_lists = _agg_partials(aggs, agg_rounds, shard_segs)
+        response["aggregations"] = reduce_aggs(aggs, partial_lists)
+    return response
+
+
+def _terms_agg_eligible(agg, mappings) -> bool:
+    from elasticsearch_tpu.search.aggregations.bucket import TermsAggregator
+
+    if type(agg) is not TermsAggregator or agg.subs:
+        return False
+    field = agg.body.get("field")
+    if field is None:
+        return False
+    fm = mappings.get(field)
+    return fm is not None and fm.is_keyword
+
+
+def _agg_partials(aggs, agg_rounds, shard_segs) -> List[dict]:
+    """Device count vectors → per-(shard, segment) partial dicts in the same
+    shape TermsAggregator.collect produces, so the existing reduce phase
+    (and its ordering/size/min_doc_count handling) applies unchanged."""
+    by_seg: Dict[tuple, dict] = {}
+    for agg in aggs:
+        for sh, seg_ord, seg, counts in agg_rounds.get(agg.name, []):
+            inv = seg.inverted.get(agg.body.get("field"))
+            if inv is None:
+                v = 0
+                keys: List[str] = []
+            else:
+                v = inv.vocab_size
+                keys = inv.terms
+            cnt = counts[:v].astype(np.int64)
+            partial = agg.partial_from_counts(cnt, keys)
+            by_seg.setdefault((sh, seg_ord), {})[agg.name] = partial
+    return list(by_seg.values())

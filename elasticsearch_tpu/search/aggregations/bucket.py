@@ -70,6 +70,16 @@ class TermsAggregator(Aggregator):
             counts = cnt.astype(np.int64)
             key_of = lambda i: keys[i]
 
+        return self._partial(counts, key_of, ctx=ctx, field=field, mask=mask)
+
+    def partial_from_counts(self, counts, keys):
+        """Shard partial from a precomputed per-ordinal count vector — the
+        mesh program (parallel/executor.py) computes counts on device; this
+        applies the identical shard_size/min_doc_count selection."""
+        counts = np.asarray(counts, np.int64)
+        return self._partial(counts, lambda i: keys[i])
+
+    def _partial(self, counts, key_of, ctx=None, field=None, mask=None):
         size = int(self.body.get("size", DEFAULT_SIZE)) or 2**31
         shard_size = int(self.body.get("shard_size", size * SHARD_SIZE_MULT))
         min_dc = int(self.body.get("min_doc_count", 1))
@@ -88,7 +98,7 @@ class TermsAggregator(Aggregator):
             key = key_of(int(i))
             b = {"doc_count": int(counts[i])}
             kept += b["doc_count"]
-            if self.subs:
+            if self.subs and ctx is not None:
                 bmask = self._bucket_mask(ctx, field, key, mask)
                 b["subs"] = self.collect_subs(ctx, bmask)
             buckets[key] = b

@@ -439,7 +439,43 @@ def search_shards(
         all_docs.sort(key=lambda d: _sort_key(d.sort_values, sort_spec))
     else:
         all_docs.sort(key=lambda d: (-d.score, d.shard_ord, d.local_id))
-    page = all_docs[frm : frm + size]
+
+    # score-ordered scroll: one complete global snapshot in compact arrays.
+    # Page 1 is served FROM the snapshot so its tie ordering and every later
+    # page's agree exactly (keys: -score, shard, local, then segment).
+    snapshot = None
+    if scroll and not sort_spec:
+        segs: List[Tuple[int, Any]] = []
+        seg_of_parts, shard_parts, local_parts, score_parts = [], [], [], []
+        for pos, r in enumerate(results):
+            for seg, order, sc in (r.full or []):
+                si = len(segs)
+                segs.append((pos, seg))
+                seg_of_parts.append(np.full(order.size, si, dtype=np.int32))
+                shard_parts.append(np.full(order.size, pos, dtype=np.int32))
+                local_parts.append(order)
+                score_parts.append(sc[order].astype(np.float32))
+        if segs:
+            seg_of = np.concatenate(seg_of_parts)
+            shard_of = np.concatenate(shard_parts)
+            local = np.concatenate(local_parts)
+            score = np.concatenate(score_parts)
+            glob = np.lexsort((seg_of, local, shard_of, -score))
+            snapshot = {"segs": segs, "seg_of": seg_of[glob],
+                        "local": local[glob], "score": score[glob]}
+        else:
+            snapshot = {"segs": [], "seg_of": np.empty(0, np.int32),
+                        "local": np.empty(0, np.int32),
+                        "score": np.empty(0, np.float32)}
+        segs_l = snapshot["segs"]
+        page = [
+            ShardDoc(segs_l[si][0], segs_l[si][1], int(li), float(sc))
+            for si, li, sc in zip(snapshot["seg_of"][frm: frm + size],
+                                  snapshot["local"][frm: frm + size],
+                                  snapshot["score"][frm: frm + size])
+        ]
+    else:
+        page = all_docs[frm : frm + size]
 
     by_shard: Dict[int, List[ShardDoc]] = {}
     for d in page:
@@ -488,29 +524,8 @@ def search_shards(
             "index_name": index_name,
             "total": total,
         }
-        if not sort_spec:
-            # compact array snapshot: one global order over every match
-            segs: List[Tuple[int, Any]] = []
-            seg_of_parts, local_parts, score_parts = [], [], []
-            for pos, r in enumerate(results):
-                for seg, order, sc in (r.full or []):
-                    si = len(segs)
-                    segs.append((pos, seg))
-                    seg_of_parts.append(np.full(order.size, si, dtype=np.int32))
-                    local_parts.append(order)
-                    score_parts.append(sc[order].astype(np.float32))
-            if segs:
-                seg_of = np.concatenate(seg_of_parts)
-                local = np.concatenate(local_parts)
-                score = np.concatenate(score_parts)
-                glob = np.lexsort((local, seg_of, -score))
-                state.update(mode="arrays", segs=segs, seg_of=seg_of[glob],
-                             local=local[glob], score=score[glob])
-            else:
-                state.update(mode="arrays", segs=[],
-                             seg_of=np.empty(0, np.int32),
-                             local=np.empty(0, np.int32),
-                             score=np.empty(0, np.float32))
+        if snapshot is not None:
+            state.update(mode="arrays", **snapshot)
         else:
             # sorted scroll: complete candidate list (already merged)
             state.update(mode="docs", docs=all_docs)
