@@ -51,6 +51,9 @@ class FieldMapping:
     scaling_factor: float = 1.0  # scaled_float
     # None = inherit the _all default (include); False = excluded
     include_in_all: Optional[bool] = None
+    # dense_vector ANN config, e.g. {"type": "ivf"} (no ES 2.0 counterpart;
+    # north-star addition — ES 8 uses {"type": "hnsw"} the same way)
+    index_options: Optional[dict] = None
 
     @property
     def is_text(self) -> bool:
@@ -156,6 +159,7 @@ class Mappings:
             ignore_above=int(p.get("ignore_above", 0)),
             scaling_factor=float(p.get("scaling_factor", 1.0)),
             include_in_all=p.get("include_in_all"),
+            index_options=p.get("index_options") if t == "dense_vector" else None,
         )
         if t == "dense_vector" and fm.dims <= 0:
             raise MapperParsingException(f"dense_vector field [{full}] requires [dims]")

@@ -306,6 +306,19 @@ class VectorColumn:
     exists: Any  # bool[max_docs]
     dims: int
     similarity: str = "cosine"
+    # lazy IVF-flat coarse quantizer (ops/ivf.py); False = build attempted
+    # and declined (too few vectors)
+    _ivf: Any = None
+
+    def get_ivf(self, max_docs: int):
+        """Build-once IVF index over this (immutable) slab."""
+        if self._ivf is None:
+            from elasticsearch_tpu.ops.ivf import build_ivf
+
+            idx = build_ivf(np.asarray(self.vecs), np.asarray(self.exists),
+                            max_docs)
+            self._ivf = idx if idx is not None else False
+        return self._ivf or None
 
 
 class TpuSegment:

@@ -708,13 +708,22 @@ class KnnQuery(Query):
 
     def __init__(self, field: str, query_vector: List[float], k: int = 10,
                  num_candidates: Optional[int] = None, filter_: Optional[Query] = None,
-                 boost: float = 1.0):
+                 boost: float = 1.0, ann: Optional[bool] = None):
         self.field = field
         self.vector = query_vector
         self.k = k
         self.num_candidates = num_candidates or max(k * 10, 100)
         self.filter = filter_
         self.boost = boost
+        # None = follow the mapping's index_options; True/False forces
+        self.ann = ann
+
+    def _use_ann(self, ctx) -> bool:
+        if self.ann is not None:
+            return bool(self.ann)
+        fm = ctx.mappings.get(self.field)
+        opts = getattr(fm, "index_options", None) if fm is not None else None
+        return bool(opts) and opts.get("type") in ("ivf", "ivf_flat")
 
     def execute(self, ctx) -> ExecResult:
         jnp = _jnp()
@@ -725,6 +734,20 @@ class KnnQuery(Query):
             raise QueryParsingException(
                 f"knn query vector has {len(self.vector)} dims but field "
                 f"[{self.field}] is mapped with {vc.dims}")
+        if self._use_ann(ctx):
+            ivf = vc.get_ivf(ctx.segment.max_docs)
+            if ivf is not None:
+                from elasticsearch_tpu.ops.ivf import ivf_candidate_scores
+
+                scores, mask = ivf_candidate_scores(
+                    ivf, vc.vecs, np.asarray(self.vector, np.float32),
+                    self.num_candidates, vc.similarity, ctx.D)
+                mask = mask & vc.exists
+                if self.filter is not None:
+                    _, fm2 = self.filter.execute(ctx)
+                    mask = mask & fm2
+                scores = jnp.where(mask, scores, 0.0) * self.boost
+                return scores, mask
         q = jnp.asarray(np.asarray(self.vector, np.float32)[None, :])
         scores = knn_scores(q, vc.vecs, metric=vc.similarity)[0] * self.boost
         mask = vc.exists
@@ -1155,6 +1178,7 @@ def parse_query(dsl: Optional[dict]) -> Query:
             num_candidates=body.get("num_candidates"),
             filter_=filt,
             boost=float(body.get("boost", 1.0)),
+            ann=body.get("ann"),
         )
 
     if qtype == "bool":
