@@ -663,3 +663,130 @@ class ChildrenAggregator(Aggregator):
         return out
 
     reduce = NestedAggregator.reduce
+
+
+# ---------------------------------------------------------------------------
+# geo buckets
+# ---------------------------------------------------------------------------
+
+@register("geohash_grid")
+class GeohashGridAggregator(Aggregator):
+    """Reference: search/aggregations/bucket/geogrid/GeoHashGridParser.java
+    :1-167. Device computes one integer cell id per doc (two quantizations,
+    no string work); host maps the occupied cells to base32 geohashes."""
+
+    def collect(self, ctx, mask):
+        from elasticsearch_tpu.search.geo import geohash_cell_device
+
+        field = self.body.get("field")
+        if field is None:
+            raise SearchParseException("geohash_grid requires [field]")
+        precision = int(self.body.get("precision", 5))
+        if not 1 <= precision <= 12:
+            raise SearchParseException(
+                f"geohash_grid precision must be in [1, 12], got {precision}")
+        lat = ctx.col(f"{field}.lat")
+        lon = ctx.col(f"{field}.lon")
+        if lat is None or lon is None:
+            return {"cells": {}, "precision": precision}
+        from elasticsearch_tpu.search.geo import geohash_bits
+
+        jnp = _jnp()
+        lat_cell, lon_cell = geohash_cell_device(
+            lat.values + jnp.float32(lat.offset),
+            lon.values + jnp.float32(lon.offset), precision)
+        lat_bits, _ = geohash_bits(precision)
+        sel = np.asarray(mask & lat.exists)
+        # combine to int64 cell ids on host (x32 devices can't)
+        cells_np = (np.asarray(lon_cell).astype(np.int64) << lat_bits) \
+            + np.asarray(lat_cell).astype(np.int64)
+        uniq, cnt = np.unique(cells_np[sel], return_counts=True)
+        out: Dict[int, dict] = {}
+        for cell, c in zip(uniq.tolist(), cnt.tolist()):
+            b = {"doc_count": int(c)}
+            if self.subs:
+                bmask = mask & jnp.asarray(cells_np == cell) & lat.exists
+                b["subs"] = self.collect_subs(ctx, bmask)
+            out[int(cell)] = b
+        return {"cells": out, "precision": precision}
+
+    def reduce(self, partials):
+        from elasticsearch_tpu.search.geo import geohash_encode_cell
+
+        merged: Dict[int, int] = {}
+        sub_partials: Dict[int, list] = {}
+        precision = 5
+        for p in partials:
+            precision = p.get("precision", precision)
+            for cell, b in p.get("cells", {}).items():
+                merged[cell] = merged.get(cell, 0) + b["doc_count"]
+                if "subs" in b:
+                    sub_partials.setdefault(cell, []).append(b["subs"])
+        size = int(self.body.get("size", 10_000)) or 10_000
+        items = sorted(merged.items(), key=lambda kv: (-kv[1], kv[0]))[:size]
+        buckets = []
+        for cell, count in items:
+            b = {"key": geohash_encode_cell(cell, precision), "doc_count": count}
+            if cell in sub_partials:
+                b.update(self.reduce_subs(sub_partials[cell]))
+            buckets.append(b)
+        return {"buckets": buckets}
+
+
+@register("geo_distance")
+class GeoDistanceAggregator(Aggregator):
+    """Reference: search/aggregations/bucket/range/geodistance/
+    GeoDistanceParser.java — range buckets over haversine distance from an
+    origin; one device distance vector, batched bucket counts."""
+
+    def collect(self, ctx, mask):
+        from elasticsearch_tpu.index.mappings import _parse_geo_point
+        from elasticsearch_tpu.search.geo import (_UNIT_M, haversine_device,
+                                                  parse_distance)
+
+        field = self.body.get("field")
+        origin = self.body.get("origin") or self.body.get("point") or self.body.get("center")
+        if field is None or origin is None:
+            raise SearchParseException("geo_distance requires [field] and [origin]")
+        lat0, lon0 = _parse_geo_point(origin)
+        unit = self.body.get("unit", "m")
+        unit_m = _UNIT_M.get(unit)
+        if unit_m is None:
+            raise SearchParseException(f"unknown distance unit [{unit}]")
+        lat = ctx.col(f"{field}.lat")
+        lon = ctx.col(f"{field}.lon")
+        jnp = _jnp()
+        specs, bmasks = [], []
+        if lat is None or lon is None:
+            dist_u = None
+        else:
+            dist_m = haversine_device(lat.values + jnp.float32(lat.offset),
+                                      lon.values + jnp.float32(lon.offset),
+                                      lat0, lon0)
+            dist_u = dist_m / jnp.float32(unit_m)
+        for r in self.body.get("ranges", []):
+            frm = float(r["from"]) if r.get("from") is not None else None
+            to = float(r["to"]) if r.get("to") is not None else None
+            key = r.get("key") or f"{'*' if frm is None else frm}-{'*' if to is None else to}"
+            if dist_u is None:
+                bmask = jnp.zeros(ctx.D, dtype=bool)
+            else:
+                bmask = mask & lat.exists
+                if frm is not None:
+                    bmask = bmask & (dist_u >= frm)
+                if to is not None:
+                    bmask = bmask & (dist_u < to)
+            specs.append((key, frm, to))
+            bmasks.append(bmask)
+        if not specs:
+            return {"buckets": {}}
+        counts = np.asarray(jnp.stack([jnp.sum(m.astype(jnp.int32)) for m in bmasks]))
+        out: Dict[str, dict] = {}
+        for (key, frm, to), cnt, bmask in zip(specs, counts, bmasks):
+            b = {"doc_count": int(cnt), "from": frm, "to": to}
+            if self.subs:
+                b["subs"] = self.collect_subs(ctx, bmask)
+            out[key] = b
+        return {"buckets": out}
+
+    reduce = RangeAggregator.reduce

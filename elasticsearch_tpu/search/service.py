@@ -639,7 +639,23 @@ def _parse_sort(spec) -> List[dict]:
                 out.append({"field": item, "order": "asc"})
         else:
             (fieldname, cfg), = item.items()
-            if isinstance(cfg, str):
+            if fieldname == "_geo_distance":
+                # reference: search/sort/GeoDistanceSortParser.java:1-211 —
+                # {"_geo_distance": {"<field>": <point>, "order", "unit"}}
+                from elasticsearch_tpu.search.geo import _UNIT_M
+                from elasticsearch_tpu.index.mappings import _parse_geo_point
+
+                cfg = dict(cfg)
+                order = cfg.pop("order", "asc")
+                unit = cfg.pop("unit", "m")
+                cfg.pop("distance_type", None)
+                cfg.pop("mode", None)
+                (geo_field, point), = cfg.items()
+                lat0, lon0 = _parse_geo_point(point)
+                out.append({"field": "_geo_distance", "order": order,
+                            "geo_field": geo_field, "origin": (lat0, lon0),
+                            "unit_m": _UNIT_M.get(unit, 1.0)})
+            elif isinstance(cfg, str):
                 out.append({"field": fieldname, "order": cfg})
             else:
                 out.append({
@@ -658,6 +674,20 @@ def _sort_key_vector(ctx, s, scores):
     jnp = _jnp()
     if s["field"] == "_score":
         return scores, 0.0
+    if s["field"] == "_geo_distance":
+        from elasticsearch_tpu.search.geo import haversine_device
+
+        lat = ctx.col(f"{s['geo_field']}.lat")
+        lon = ctx.col(f"{s['geo_field']}.lon")
+        if lat is None or lon is None:
+            fill = jnp.float32(-jnp.inf if s["order"] == "desc" else jnp.inf)
+            return jnp.full(ctx.D, fill), 0.0
+        lat0, lon0 = s["origin"]
+        d = haversine_device(lat.values + jnp.float32(lat.offset),
+                             lon.values + jnp.float32(lon.offset),
+                             lat0, lon0) / jnp.float32(s["unit_m"])
+        missing = jnp.float32(-jnp.inf if s["order"] == "desc" else jnp.inf)
+        return jnp.where(lat.exists, d, missing), 0.0
     col = ctx.col(s["field"])
     if col is not None:
         missing_val = jnp.float32(-jnp.inf if s["order"] == "desc" else jnp.inf)
@@ -674,6 +704,17 @@ def _sort_key_vector(ctx, s, scores):
 def _sort_value(ctx, s, local: int, np_scores):
     if s["field"] == "_score":
         return float(np_scores[local])
+    if s["field"] == "_geo_distance":
+        from elasticsearch_tpu.search.geo import haversine_np
+
+        lat = ctx.col(f"{s['geo_field']}.lat")
+        lon = ctx.col(f"{s['geo_field']}.lon")
+        if lat is None or lon is None or not bool(np.asarray(lat.exists)[local]):
+            return None
+        lat0, lon0 = s["origin"]
+        d = haversine_np(float(lat.exact[local]), float(lon.exact[local]),
+                         lat0, lon0) / s["unit_m"]
+        return float(d)
     col = ctx.col(s["field"])
     if col is not None:
         if not bool(np.asarray(col.exists)[local]):

@@ -1,0 +1,156 @@
+"""Geo completion tests (round-1 verdict item 7) vs numpy haversine oracle.
+
+Reference: search/aggregations/bucket/geogrid/GeoHashGridParser.java,
+search/aggregations/bucket/range/geodistance/, search/sort/
+GeoDistanceSortParser.java, index/query/GeoShapeQueryBuilder.java.
+"""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search.geo import (geohash_decode, geohash_encode_cell,
+                                          geohash_bits, haversine_np)
+
+CITIES = {
+    "paris": (48.8566, 2.3522),
+    "london": (51.5074, -0.1278),
+    "berlin": (52.5200, 13.4050),
+    "madrid": (40.4168, -3.7038),
+    "rome": (41.9028, 12.4964),
+    "nyc": (40.7128, -74.0060),
+    "tokyo": (35.6762, 139.6503),
+}
+
+
+@pytest.fixture(scope="module")
+def node():
+    n = Node()
+    n.create_index("g", {"mappings": {"properties": {
+        "loc": {"type": "geo_point"}, "name": {"type": "keyword"}}}})
+    svc = n.indices["g"]
+    for name, (lat, lon) in CITIES.items():
+        svc.index_doc(name, {"loc": {"lat": lat, "lon": lon}, "name": name})
+    svc.index_doc("noloc", {"name": "noloc"})
+    svc.refresh()
+    yield n
+    n.close()
+
+
+def test_geohash_roundtrip():
+    for lat, lon in CITIES.values():
+        for p in (1, 3, 5, 7):
+            lat_bits, lon_bits = geohash_bits(p)
+            nlat, nlon = 1 << lat_bits, 1 << lon_bits
+            lat_cell = min(int((lat + 90.0) / 180.0 * nlat), nlat - 1)
+            lon_cell = min(int((lon + 180.0) / 360.0 * nlon), nlon - 1)
+            gh = geohash_encode_cell(lon_cell * nlat + lat_cell, p)
+            dec_lat, dec_lon = geohash_decode(gh)
+            assert abs(dec_lat - lat) <= 180.0 / nlat
+            assert abs(dec_lon - lon) <= 360.0 / nlon
+
+
+def test_known_geohash():
+    # well-known value: Paris ≈ u09t (precision 4)
+    lat, lon = CITIES["paris"]
+    lat_bits, lon_bits = geohash_bits(4)
+    nlat, nlon = 1 << lat_bits, 1 << lon_bits
+    lat_cell = min(int((lat + 90.0) / 180.0 * nlat), nlat - 1)
+    lon_cell = min(int((lon + 180.0) / 360.0 * nlon), nlon - 1)
+    assert geohash_encode_cell(lon_cell * nlat + lat_cell, 4) == "u09t"
+
+
+def test_geohash_grid_agg(node):
+    r = node.search("g", {"size": 0, "aggs": {
+        "grid": {"geohash_grid": {"field": "loc", "precision": 1}}}})
+    buckets = {b["key"]: b["doc_count"] for b in r["aggregations"]["grid"]["buckets"]}
+    # precision-1 cells: paris/london/madrid → u/g/e zone boundaries; verify
+    # against oracle encoding
+    total = sum(buckets.values())
+    assert total == len(CITIES)
+    for name, (lat, lon) in CITIES.items():
+        lat_bits, lon_bits = geohash_bits(1)
+        nlat, nlon = 1 << lat_bits, 1 << lon_bits
+        cell = (min(int((lon + 180.0) / 360.0 * nlon), nlon - 1) * nlat
+                + min(int((lat + 90.0) / 180.0 * nlat), nlat - 1))
+        gh = geohash_encode_cell(cell, 1)
+        assert gh in buckets, (name, gh, buckets)
+
+
+def test_geo_distance_agg(node):
+    origin = CITIES["paris"]
+    r = node.search("g", {"size": 0, "aggs": {
+        "rings": {"geo_distance": {
+            "field": "loc", "origin": {"lat": origin[0], "lon": origin[1]},
+            "unit": "km",
+            "ranges": [{"to": 500}, {"from": 500, "to": 1500},
+                       {"from": 1500}]}}}})
+    buckets = r["aggregations"]["rings"]["buckets"]
+    by_key = {b["key"]: b["doc_count"] for b in buckets}
+    # oracle
+    want = {"*-500.0": 0, "500.0-1500.0": 0, "1500.0-*": 0}
+    for name, (lat, lon) in CITIES.items():
+        d = haversine_np(lat, lon, origin[0], origin[1]) / 1000.0
+        if d < 500:
+            want["*-500.0"] += 1
+        elif d < 1500:
+            want["500.0-1500.0"] += 1
+        else:
+            want["1500.0-*"] += 1
+    assert by_key == want, (by_key, want)
+
+
+def test_geo_distance_sort(node):
+    origin = CITIES["paris"]
+    r = node.search("g", {"query": {"exists": {"field": "name"}},
+                          "sort": [{"_geo_distance": {
+                              "loc": {"lat": origin[0], "lon": origin[1]},
+                              "order": "asc", "unit": "km"}}],
+                          "size": 10})
+    got = [h["_id"] for h in r["hits"]["hits"]]
+    oracle = sorted(CITIES, key=lambda c: haversine_np(*CITIES[c], *origin))
+    # noloc has no geo point: dropped from the sorted candidates (matches
+    # the numeric-sort missing handling)
+    assert got == oracle, (got, oracle)
+    dists = [h["sort"][0] for h in r["hits"]["hits"]]
+    assert dists[0] == 0.0 or dists[0] < 1.0  # paris itself
+    assert dists == sorted(dists)
+    # oracle distance check (km, 0.5% tolerance)
+    for cid, d in zip(got, dists):
+        want = haversine_np(*CITIES[cid], *origin) / 1000.0
+        assert abs(d - want) <= max(0.005 * want, 0.5), (cid, d, want)
+
+
+def test_geo_shape_queries(node):
+    # envelope around western europe: [left, top], [right, bottom]
+    r = node.search("g", {"query": {"geo_shape": {"loc": {"shape": {
+        "type": "envelope", "coordinates": [[-5.0, 53.0], [15.0, 40.0]]}}}},
+        "size": 10})
+    ids = {h["_id"] for h in r["hits"]["hits"]}
+    assert ids == {"paris", "london", "berlin", "madrid", "rome"}
+    # polygon roughly around France (lon, lat rings)
+    r2 = node.search("g", {"query": {"geo_shape": {"loc": {"shape": {
+        "type": "polygon",
+        "coordinates": [[[-1.5, 43.0], [7.0, 43.0], [8.0, 49.5],
+                         [2.0, 51.0], [-4.0, 48.5], [-1.5, 43.0]]]}}}},
+        "size": 10})
+    assert {h["_id"] for h in r2["hits"]["hits"]} == {"paris"}
+    # circle: 400km around london → london + paris
+    r3 = node.search("g", {"query": {"geo_shape": {"loc": {"shape": {
+        "type": "circle", "coordinates": [-0.1278, 51.5074],
+        "radius": "400km"}}}}, "size": 10})
+    assert {h["_id"] for h in r3["hits"]["hits"]} == {"london", "paris"}
+
+
+def test_geohash_grid_high_precision(node):
+    # precision 12 needs int64 cell ids (60 bits) — must not truncate
+    r = node.search("g", {"size": 0, "aggs": {
+        "grid": {"geohash_grid": {"field": "loc", "precision": 12}}}})
+    buckets = r["aggregations"]["grid"]["buckets"]
+    assert len(buckets) == len(CITIES)  # every city its own 12-char cell
+    assert all(len(b["key"]) == 12 and b["doc_count"] == 1 for b in buckets)
+    # each key decodes back to its city within cell resolution
+    keys = {b["key"] for b in buckets}
+    for lat, lon in CITIES.values():
+        best = min(keys, key=lambda k: haversine_np(*geohash_decode(k), lat, lon))
+        dec_lat, dec_lon = geohash_decode(best)
+        assert haversine_np(dec_lat, dec_lon, lat, lon) < 5.0  # meters
