@@ -405,8 +405,13 @@ class MultiMatchQuery(Query):
 
 
 class MatchPhraseQuery(Query):
-    """index/query/MatchQueryBuilder.java type=phrase. Device conjunction +
-    host positional verify (see module docstring deviation note)."""
+    """index/query/MatchQueryBuilder.java type=phrase.
+
+    R2: fully device-side — the anchor-entry positional program
+    (ops/positional.py) computes an exact phrase-frequency vector in one
+    pass over the positional CSR (no per-doc host loops), and scoring is
+    Lucene's: idf_sum * tfNorm(phraseFreq), i.e. the phrase acts as a
+    single pseudo-term through BM25Similarity."""
 
     def __init__(self, field: str, text: str, slop: int = 0, boost: float = 1.0):
         self.field = field
@@ -423,49 +428,31 @@ class MatchPhraseQuery(Query):
         inv = ctx.inv(self.field)
         if inv is None or inv.positions is None:
             return _empty(ctx)
-        terms = [t for t, _ in toks]
-        rel_pos = [p for _, p in toks]
-        for t in terms:
+        for t, _ in toks:
             if t not in inv.vocab:
                 return _empty(ctx)
-        scores, counts, n_present = _score_term_group(
-            ctx, self.field, terms, self.boost, with_counts=True)
-        cand = np.nonzero(np.asarray(counts) >= len(set(terms)))[0]
-        if cand.size == 0:
+        if len(toks) == 1:
+            scores, matched, n = _score_term_group(
+                ctx, self.field, [toks[0][0]], self.boost)
+            return (scores, matched) if n else _empty(ctx)
+        from elasticsearch_tpu.ops.positional import (build_phrase_inputs,
+                                                      phrase_freq_program,
+                                                      phrase_score)
+
+        inputs = build_phrase_inputs(inv, toks, ctx.D)
+        if inputs is None:
             return _empty(ctx)
-        ok = np.zeros(ctx.D, dtype=bool)
-        for d in cand:
-            if self._phrase_in_doc(inv, terms, rel_pos, int(d)):
-                ok[d] = True
-        mask = jnp.asarray(ok)
-        return scores * mask, mask
-
-    def _positions_for(self, inv, term: str, doc: int) -> Optional[np.ndarray]:
-        s, ln = inv.term_slice(term)
-        run = inv.doc_ids_host[s : s + ln]
-        k = np.searchsorted(run, doc)
-        if k >= ln or run[k] != doc:
-            return None
-        e = s + k
-        return inv.positions[inv.pos_offsets[e] : inv.pos_offsets[e + 1]]
-
-    def _phrase_in_doc(self, inv, terms, rel_pos, doc: int) -> bool:
-        pos_lists = []
-        for t in terms:
-            p = self._positions_for(inv, t, doc)
-            if p is None:
-                return False
-            pos_lists.append(p)
-        base = pos_lists[0]
-        for start in base:
-            if all(
-                np.any(np.abs((pl - start) - (rp - rel_pos[0])) <= self.slop)
-                if self.slop > 0
-                else np.any(pl == start + (rp - rel_pos[0]))
-                for pl, rp in zip(pos_lists[1:], rel_pos[1:])
-            ):
-                return True
-        return False
+        freq = phrase_freq_program(*inputs, slop=int(self.slop), D=ctx.D)
+        mask = freq > 0
+        idf_sum = sum(ctx.idf(self.field, t)
+                      for t in dict.fromkeys(t for t, _ in toks))
+        lengths = ctx.segment.field_lengths.get(self.field)
+        if lengths is None:
+            lengths = jnp.zeros(ctx.D, jnp.float32)
+        scores = phrase_score(freq, lengths.astype(jnp.float32),
+                              jnp.float32(inv.avg_len),
+                              jnp.float32(idf_sum), D=ctx.D) * self.boost
+        return scores, mask
 
 
 class MatchPhrasePrefixQuery(Query):

@@ -298,6 +298,9 @@ class SpanQueryWrapper(Query):
 
         from elasticsearch_tpu.search.queries import _score_term_group
 
+        fast = self._device_near(ctx)
+        if fast is not None:
+            return fast
         cand = self.node.candidate_docs(ctx)
         ok = np.zeros(ctx.D, dtype=bool)
         for d in np.unique(cand):
@@ -320,6 +323,50 @@ class SpanQueryWrapper(Query):
         if scores is None:
             scores = mask.astype(jnp.float32) * self.boost
         return scores * mask, mask
+
+    def _device_near(self, ctx):
+        """Device fast path for the dominant span shape: span_near over
+        span_term clauses with in_order=true — Lucene NearSpansOrdered's
+        greedy leftmost chaining as one vectorized program over the
+        positional CSR (no per-doc host loops), scored with sloppy freq
+        (idf_sum * tfNorm(Σ 1/(1+matchLength)))."""
+        import jax.numpy as jnp
+
+        node = self.node
+        if not isinstance(node, SpanNearNode) or not node.in_order:
+            return None
+        if not all(isinstance(c, SpanTermNode) for c in node.clauses):
+            return None
+        if len({c.field for c in node.clauses}) != 1 or len(node.clauses) < 2:
+            return None
+        inv = ctx.inv(node.field)
+        if inv is None or inv.positions is None:
+            return None
+        terms = [c.term for c in node.clauses]
+        for t in terms:
+            if t not in inv.vocab:
+                return None, jnp.zeros(ctx.D, dtype=bool)
+        from elasticsearch_tpu.ops.positional import (build_phrase_inputs,
+                                                      phrase_freq_program,
+                                                      phrase_score)
+
+        # the ordered program ignores deltas; rest clauses chain in order
+        inputs = build_phrase_inputs(inv, [(t, i) for i, t in enumerate(terms)],
+                                     ctx.D)
+        if inputs is None:
+            return None, jnp.zeros(ctx.D, dtype=bool)
+        freq = phrase_freq_program(*inputs, slop=int(node.slop), D=ctx.D,
+                                   ordered=True)
+        mask = freq > 0
+        idf_sum = sum(ctx.idf(node.field, t) for t in dict.fromkeys(terms))
+        lengths = ctx.segment.field_lengths.get(node.field)
+        if lengths is None:
+            lengths = jnp.zeros(ctx.D, jnp.float32)
+        scores = phrase_score(freq, lengths.astype(jnp.float32),
+                              jnp.float32(inv.avg_len),
+                              jnp.float32(idf_sum), D=ctx.D) * self.boost
+        return scores, mask
+
 
 def _walk_multis(node: SpanNode):
     if isinstance(node, SpanMultiNode):

@@ -1,0 +1,173 @@
+"""Device positional program tests vs a numpy sloppy-freq oracle.
+
+Round-1 verdict item 5: phrase/span interval verification on device with
+Lucene-style scoring (phrase as a pseudo-term: idf_sum * tfNorm(freq)).
+The oracle mirrors the program's documented semantics (greedy
+nearest-to-expected window per anchor) and equals Lucene's on
+non-degenerate phrases.
+"""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+DOCS = {
+    "1": "the quick brown fox jumps over the lazy dog",
+    "2": "quick fox",                    # adjacent, no 'brown'
+    "3": "quick brown smart fox",        # fox at +3 from quick (slop 1 for 'quick fox'? dist 3→ window)
+    "4": "fox quick brown",              # reversed order
+    "5": "brown quick brown fox brown fox",  # repeats
+    "6": "the fox",
+}
+
+
+@pytest.fixture(scope="module")
+def node():
+    n = Node()
+    n.create_index("p", {"mappings": {"properties": {
+        "t": {"type": "text", "analyzer": "whitespace"}}}})
+    svc = n.indices["p"]
+    for did, text in DOCS.items():
+        svc.index_doc(did, {"t": text})
+    svc.refresh()
+    yield n
+    n.close()
+
+
+# --- numpy oracle -----------------------------------------------------------
+
+def _tokens(text):
+    return text.split()
+
+
+def oracle_phrase_freq(text, terms, slop):
+    """Greedy nearest-window per anchor occurrence of terms[0]."""
+    toks = _tokens(text)
+    pos = {t: [i for i, x in enumerate(toks) if x == t] for t in set(terms)}
+    if any(not pos.get(t) for t in terms):
+        return 0.0
+    freq = 0.0
+    for p0 in pos[terms[0]]:
+        adjs = [p0]
+        ok = True
+        for delta, t in enumerate(terms[1:], start=1):
+            expected = p0 + delta
+            q = min(pos[t], key=lambda x: abs(x - expected))
+            adjs.append(q - delta)
+            if slop == 0 and q != expected:
+                ok = False
+                break
+        if not ok:
+            continue
+        mlen = max(adjs) - min(adjs)
+        if mlen <= slop:
+            freq += 1.0 / (1.0 + mlen)
+    return freq
+
+
+def oracle_phrase_score(node, field, terms, slop, doc_id):
+    """idf_sum * tfNorm(freq) with BM25 k1=1.2, b=0.75 over the corpus."""
+    texts = DOCS
+    n_docs = len(texts)
+    k1, b = 1.2, 0.75
+    lens = {d: len(_tokens(t)) for d, t in texts.items()}
+    avg = sum(lens.values()) / n_docs
+    idf_sum = 0.0
+    for t in dict.fromkeys(terms):
+        df = sum(1 for txt in texts.values() if t in _tokens(txt))
+        idf_sum += np.log(1.0 + (n_docs - df + 0.5) / (df + 0.5))
+    f = oracle_phrase_freq(texts[doc_id], terms, slop)
+    if f == 0:
+        return 0.0
+    norm = k1 * (1 - b + b * lens[doc_id] / avg)
+    return idf_sum * f * (k1 + 1) / (f + norm)
+
+
+# --- tests ------------------------------------------------------------------
+
+def search_scores(node, body):
+    r = node.search("p", body)
+    return {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+
+
+def test_exact_phrase_matches_and_scores(node):
+    got = search_scores(node, {"query": {"match_phrase": {"t": "quick brown fox"}},
+                              "size": 10})
+    want_ids = {d for d, txt in DOCS.items()
+                if oracle_phrase_freq(txt, ["quick", "brown", "fox"], 0) > 0}
+    assert set(got) == want_ids == {"1", "5"}
+    for d, s in got.items():
+        want = oracle_phrase_score(node, "t", ["quick", "brown", "fox"], 0, d)
+        assert abs(s - want) < 1e-4, (d, s, want)
+
+
+def test_exact_phrase_two_terms(node):
+    got = search_scores(node, {"query": {"match_phrase": {"t": "quick fox"}},
+                              "size": 10})
+    assert set(got) == {"2"}
+    want = oracle_phrase_score(node, "t", ["quick", "fox"], 0, "2")
+    assert abs(got["2"] - want) < 1e-4
+
+
+def test_sloppy_phrase(node):
+    terms = ["quick", "fox"]
+    for slop in (1, 2, 3):
+        got = search_scores(node, {"query": {"match_phrase": {
+            "t": {"query": "quick fox", "slop": slop}}}, "size": 10})
+        want_ids = {d for d, txt in DOCS.items()
+                    if oracle_phrase_freq(txt, terms, slop) > 0}
+        assert set(got) == want_ids, (slop, set(got), want_ids)
+        for d, s in got.items():
+            want = oracle_phrase_score(node, "t", terms, slop, d)
+            assert abs(s - want) < 1e-4, (slop, d, s, want)
+
+
+def test_phrase_repeated_terms(node):
+    # "brown fox" in doc 5 occurs twice → freq 2 at slop 0
+    assert oracle_phrase_freq(DOCS["5"], ["brown", "fox"], 0) == 2.0
+    got = search_scores(node, {"query": {"match_phrase": {"t": "brown fox"}},
+                              "size": 10})
+    assert "5" in got
+    want = oracle_phrase_score(node, "t", ["brown", "fox"], 0, "5")
+    assert abs(got["5"] - want) < 1e-4
+
+
+def test_no_per_doc_python_loops_in_phrase(node, monkeypatch):
+    """The execute path must not walk docs on host: forbid ndarray.__iter__
+    over doc-sized arrays by asserting the old helper is gone."""
+    from elasticsearch_tpu.search.queries import MatchPhraseQuery
+
+    assert not hasattr(MatchPhraseQuery, "_phrase_in_doc")
+    assert not hasattr(MatchPhraseQuery, "_positions_for")
+
+
+def test_span_near_ordered_device(node):
+    body = {"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "quick"}},
+                    {"span_term": {"t": "fox"}}],
+        "slop": 2, "in_order": True}}, "size": 10}
+    got = search_scores(node, body)
+    # ordered chaining: quick…fox within width-2 ≤ slop
+    want = set()
+    for d, txt in DOCS.items():
+        toks = _tokens(txt)
+        qs = [i for i, x in enumerate(toks) if x == "quick"]
+        fs = [i for i, x in enumerate(toks) if x == "fox"]
+        for q in qs:
+            nxt = [f for f in fs if f > q]
+            if nxt and (min(nxt) - q + 1) - 2 <= 2:
+                want.add(d)
+    assert set(got) == want, (set(got), want)
+    # reversed order doc 4 must NOT match in_order near with slop 0
+    body0 = {"query": {"span_near": {
+        "clauses": [{"span_term": {"t": "quick"}},
+                    {"span_term": {"t": "fox"}}],
+        "slop": 0, "in_order": True}}, "size": 10}
+    got0 = search_scores(node, body0)
+    assert "4" not in got0 and "2" in got0
+
+
+def test_phrase_prefix_still_works(node):
+    got = search_scores(node, {"query": {"match_phrase_prefix": {"t": "quick bro"}},
+                              "size": 10})
+    assert "1" in got
