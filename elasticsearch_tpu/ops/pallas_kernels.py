@@ -139,6 +139,137 @@ def knn_topk_pallas(queries, vecs, mask, *, k: int, metric: str = "cosine",
     return out_v, out_i
 
 
+@partial(jax.jit, static_argnames=("k", "tile", "q_tile", "interpret"))
+def bm25_dense_topk_pallas(qw, impact, mask, *, k: int, tile: int = 2048,
+                           q_tile: int = 256, interpret: bool = False):
+    """Fused batched BM25 over the dense impact block with in-kernel top-k.
+
+    The XLA hybrid path (ops/scoring.bm25_score_hybrid_batch) materializes
+    the full [Q, D] score matrix in HBM and runs a separate top-k pass —
+    at bench scale (Q=2048, D=1M) that is an 8 GB round trip. This kernel
+    streams impact[F, tile] tiles HBM→VMEM, runs the qw @ tile matmul on
+    the MXU, applies the live mask on the VPU, and maintains the running
+    top-k in the output block across grid steps — [Q, D] never exists.
+
+    qw:     f32[Q, F]  idf*boost per dense term per query (0 = absent)
+    impact: f32[F, D]  index-time impact block (idf folded at query time
+                       via qw; rows are tfnorm impacts)
+    mask:   bool[D]    live-doc mask
+    Returns ([Q, k] scores, [Q, k] int32 doc ids) — same contract as
+    topk_batch(bm25_score_hybrid_batch(...)).
+
+    Scoring matches the XLA path modulo bf16 matmul rounding (the XLA
+    hybrid uses f32-HIGHEST; tests assert top-1 agreement).
+    """
+    from jax.experimental import pallas as pl
+
+    Q, F = qw.shape
+    D = impact.shape[1]
+    assert D % tile == 0, "impact block must be padded to a tile multiple"
+    assert Q % q_tile == 0, "queries must be padded to a q_tile multiple"
+    n_tiles = D // tile
+    n_q = Q // q_tile
+    qh = qw.astype(jnp.bfloat16)
+    QT = q_tile
+
+    def kernel(q_ref, imp_ref, m_ref, out_v_ref, out_i_ref):
+        step = pl.program_id(1)  # d-tile sweep is the inner grid axis
+
+        @pl.when(step == 0)
+        def _init():
+            out_v_ref[:] = jnp.full((QT, k), NEG_INF, dtype=jnp.float32)
+            out_i_ref[:] = jnp.zeros((QT, k), dtype=jnp.int32)
+
+        s = jax.lax.dot_general(
+            q_ref[:], imp_ref[:].astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [QT, tile]
+        s = jnp.where(m_ref[:], s, NEG_INF)  # mask block is [1, tile]
+        base = step * tile
+        tile_ids = base + jax.lax.broadcasted_iota(jnp.int32, (QT, tile), 1)
+        cand_v = jnp.concatenate([out_v_ref[:], s], axis=1)
+        cand_i = jnp.concatenate([out_i_ref[:], tile_ids], axis=1)
+
+        def extract(j, carry):
+            cv, ci, bv, bi = carry
+            m = jnp.max(cv, axis=1)
+            am = jnp.argmax(cv, axis=1)
+            width = cv.shape[1]
+            knock = jax.lax.broadcasted_iota(jnp.int32, (QT, width), 1) == am[:, None]
+            picked_i = jnp.max(jnp.where(knock, ci, jnp.int32(-1)), axis=1)
+            col_j = jax.lax.broadcasted_iota(jnp.int32, (QT, k), 1) == j
+            bv = jnp.where(col_j, m[:, None], bv)
+            bi = jnp.where(col_j, picked_i[:, None], bi)
+            cv = jnp.where(knock, NEG_INF, cv)
+            return cv, ci, bv, bi
+
+        bv0 = jnp.full((QT, k), NEG_INF, dtype=jnp.float32)
+        bi0 = jnp.zeros((QT, k), dtype=jnp.int32)
+        _, _, bv, bi = jax.lax.fori_loop(
+            0, k, extract, (cand_v, cand_i, bv0, bi0))
+        out_v_ref[:] = bv
+        out_i_ref[:] = bi
+
+    out_v, out_i = pl.pallas_call(
+        kernel,
+        grid=(n_q, n_tiles),
+        in_specs=[
+            pl.BlockSpec((QT, F), lambda qi, di: (qi, 0)),     # query block
+            pl.BlockSpec((F, tile), lambda qi, di: (0, di)),   # impact tile
+            # mask rides as [1, D] — 1-D i32 blocks can hit XLA/Mosaic
+            # layout mismatches at small tiles (T(1024) vs T(tile))
+            pl.BlockSpec((1, tile), lambda qi, di: (0, di)),
+        ],
+        out_specs=[
+            pl.BlockSpec((QT, k), lambda qi, di: (qi, 0)),
+            pl.BlockSpec((QT, k), lambda qi, di: (qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Q, k), jnp.float32),
+            jax.ShapeDtypeStruct((Q, k), jnp.int32),
+        ],
+        interpret=interpret,
+    )(qh, impact, mask[None, :])
+    return out_v, out_i
+
+
+def bm25_dense_tiles_for(Q: int, F: int, D: int):
+    """(q_tile, tile) keeping the working set under the VMEM budget:
+    qw block (bf16) + impact tile (f32) + ~3 live [q_tile, tile] f32
+    intermediates (scores + candidate copies) ≤ ~10 MB."""
+    budget = 10 * 1024 * 1024
+    for q_tile in (512, 256, 128, 64, 32, 16, 8):
+        if Q % q_tile:
+            continue
+        for tile in (4096, 2048, 1024, 512):
+            if D % tile:
+                continue
+            est = q_tile * F * 2 + F * tile * 4 + 3 * q_tile * tile * 4
+            if est <= budget:
+                return q_tile, tile
+    return 0, 0
+
+
+def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
+    """Dispatch: fused Pallas kernel on TPU when static shape gates hold,
+    XLA hybrid matmul + topk_batch otherwise (same gate discipline as
+    knn_topk_auto — no runtime fallback illusions)."""
+    Q, F = qw.shape
+    D = impact.shape[1]
+    q_tile, tile = bm25_dense_tiles_for(Q, F, D)
+    if (_on_tpu() and k <= 64 and F % 8 == 0
+            and q_tile and D >= 2 * tile):
+        return bm25_dense_topk_pallas(qw, impact, mask, k=k, tile=tile,
+                                      q_tile=q_tile)
+    from jax import lax as _lax
+
+    scores = jnp.dot(qw, impact, precision=_lax.Precision.HIGHEST)
+    masked = jnp.where(mask[None, :], scores, NEG_INF)
+    vals, idx = _lax.top_k(masked, k)
+    return vals, idx.astype(jnp.int32)
+
+
 def knn_topk_auto(queries, vecs, mask, *, k: int, metric: str = "cosine"):
     """Dispatch: Pallas fused kernel on TPU when shapes fit, XLA otherwise.
 
@@ -147,14 +278,26 @@ def knn_topk_auto(queries, vecs, mask, *, k: int, metric: str = "cosine"):
     Mosaic lowering errors surface at outer-compile time (after any except
     block here has exited), so a runtime fallback would be an illusion.
     The gates mirror what the kernel is validated for on hardware: Q a
-    sublane multiple, lane-aligned dims, small k, tile-divisible corpus."""
+    sublane multiple, lane-aligned dims, small k, tile-divisible corpus.
+
+    Q below the sublane multiple (a single REST knn query is Q=1) pads up
+    to 8 with zero queries and slices the result — round 1 sent every
+    single-query request down the XLA path that materializes the [Q, D]
+    matrix this kernel exists to avoid."""
     from elasticsearch_tpu.ops.knn import knn_topk
 
     Q, dims = queries.shape
     D = vecs.shape[0]
     tile = 8192 if D % 8192 == 0 else 2048
-    if (_on_tpu() and k <= 64 and Q % 8 == 0 and dims % 128 == 0
+    if (_on_tpu() and k <= 64 and dims % 128 == 0
             and D % tile == 0 and D >= 2 * tile):
+        if Q % 8 != 0:
+            qpad = ((Q + 7) // 8) * 8
+            queries = jnp.concatenate(
+                [queries, jnp.zeros((qpad - Q, dims), queries.dtype)], axis=0)
+            vals, idx = knn_topk_pallas(queries, vecs, mask, k=k,
+                                        metric=metric, tile=tile)
+            return vals[:Q], idx[:Q]
         return knn_topk_pallas(queries, vecs, mask, k=k, metric=metric,
                                tile=tile)
     return knn_topk(queries, vecs, mask, k=k, metric=metric)
