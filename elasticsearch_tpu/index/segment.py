@@ -316,7 +316,7 @@ class VectorColumn:
             from elasticsearch_tpu.ops.ivf import build_ivf
 
             idx = build_ivf(np.asarray(self.vecs), np.asarray(self.exists),
-                            max_docs)
+                            max_docs, metric=self.similarity)
             self._ivf = idx if idx is not None else False
         return self._ivf or None
 

@@ -38,13 +38,35 @@ def _jax():
 # k-means (device)
 # ---------------------------------------------------------------------------
 
-def kmeans(vecs_np: np.ndarray, C: int, iters: int = 8, seed: int = 1234):
+def _quantizer_affinity(jnp, vecs, cents, metric: str):
+    """[N, C] affinity used for BOTH k-means assignment and query-time
+    probing — argmax row-wise picks the nearest centroid under the field's
+    similarity. l2_norm uses the norm expansion (argmin ||v-c||^2 ==
+    argmax v.c - ||c||^2/2); cosine/dot normalize centroids (dot against a
+    unit-norm direction — standard spherical k-means for MIPS/cosine)."""
+    if metric in ("l2_norm", "l2"):
+        vc = jnp.matmul(vecs, cents.T, preferred_element_type=jnp.float32)
+        return vc - 0.5 * jnp.sum(cents * cents, axis=-1)[None, :]
+    cn = cents / jnp.maximum(
+        jnp.linalg.norm(cents, axis=-1, keepdims=True), 1e-12)
+    return jnp.matmul(vecs, cn.T, preferred_element_type=jnp.float32)
+
+
+def kmeans(vecs_np: np.ndarray, C: int, iters: int = 8, seed: int = 1234,
+           metric: str = "cosine"):
     """Train C centroids over vecs [N, dims] (host in, host out).
 
     Deterministic: init = evenly strided sample of the corpus (stable across
     runs — no RNG in the build path, mirroring how segment freezes must be
     reproducible for recovery). Empty clusters re-seed from the farthest
     vectors of the biggest cluster's assignment pass.
+
+    The assignment metric follows the field's similarity (advisor r2):
+    l2_norm fields cluster/probe by squared-l2, cosine/dot by normalized
+    dot — so the inverted lists agree with query-time probing. Returns
+    (centroids, assign) where `assign` is ONE FINAL assignment pass against
+    the FINAL centroids (not the stale pre-update assignment), keeping the
+    lists consistent with the quantizer actually probed at query time.
     """
     jax = _jax()
     import jax.numpy as jnp
@@ -54,13 +76,10 @@ def kmeans(vecs_np: np.ndarray, C: int, iters: int = 8, seed: int = 1234):
     stride = max(N // C, 1)
     cents = vecs_np[:: stride][:C].astype(np.float32).copy()
 
-    @partial(jax.jit, static_argnames=("nc",))
-    def step(vecs, cents, *, nc):
-        # assignment by max dot over normalized centroids (cosine kmeans);
+    @partial(jax.jit, static_argnames=("nc", "metric"))
+    def step(vecs, cents, *, nc, metric):
         # one [N, C] matmul on the MXU
-        cn = cents / jnp.maximum(
-            jnp.linalg.norm(cents, axis=-1, keepdims=True), 1e-12)
-        sim = jnp.matmul(vecs, cn.T, preferred_element_type=jnp.float32)
+        sim = _quantizer_affinity(jnp, vecs, cents, metric)
         assign = jnp.argmax(sim, axis=1)
         one = jnp.zeros((nc,), jnp.float32).at[assign].add(1.0)
         sums = jnp.zeros((nc, vecs.shape[1]), jnp.float32).at[assign].add(vecs)
@@ -69,11 +88,15 @@ def kmeans(vecs_np: np.ndarray, C: int, iters: int = 8, seed: int = 1234):
         new = jnp.where(one[:, None] > 0, new, cents)
         return new, assign
 
+    @partial(jax.jit, static_argnames=("metric",))
+    def assign_only(vecs, cents, *, metric):
+        return jnp.argmax(_quantizer_affinity(jnp, vecs, cents, metric), axis=1)
+
     d_vecs = jax.device_put(vecs_np.astype(np.float32))
     d_cents = jax.device_put(cents)
-    assign = None
     for _ in range(iters):
-        d_cents, assign = step(d_vecs, d_cents, nc=C)
+        d_cents, _ = step(d_vecs, d_cents, nc=C, metric=metric)
+    assign = assign_only(d_vecs, d_cents, metric=metric)
     return np.asarray(d_cents), np.asarray(assign)
 
 
@@ -90,6 +113,7 @@ class IvfIndex:
     Lmax: int
     sentinel: int  # = max_docs of the owning segment
     avg_len: float
+    metric: str = "cosine"  # quantizer metric (follows the field similarity)
 
     def nprobe_for(self, num_candidates: int) -> int:
         n = int(np.ceil(num_candidates / max(self.avg_len, 1.0)))
@@ -97,7 +121,8 @@ class IvfIndex:
 
 
 def build_ivf(vecs_np: np.ndarray, exists_np: np.ndarray, max_docs: int,
-              C: Optional[int] = None, iters: int = 8) -> Optional[IvfIndex]:
+              C: Optional[int] = None, iters: int = 8,
+              metric: str = "cosine") -> Optional[IvfIndex]:
     """Build an IVF index over the live vectors of one segment slab."""
     jax = _jax()
 
@@ -108,7 +133,7 @@ def build_ivf(vecs_np: np.ndarray, exists_np: np.ndarray, max_docs: int,
     live = vecs_np[ids]
     if C is None:
         C = int(max(8, min(4 * np.sqrt(n), n // 8)))
-    cents, assign = kmeans(live, C, iters=iters)
+    cents, assign = kmeans(live, C, iters=iters, metric=metric)
     C = cents.shape[0]
     counts = np.bincount(assign, minlength=C)
     Lmax = pow2_bucket(int(counts.max()) if counts.size else 1)
@@ -122,7 +147,7 @@ def build_ivf(vecs_np: np.ndarray, exists_np: np.ndarray, max_docs: int,
         lists=jax.device_put(lists),
         list_lens=jax.device_put(counts.astype(np.int32)),
         C=C, Lmax=Lmax, sentinel=max_docs,
-        avg_len=float(n) / C,
+        avg_len=float(n) / C, metric=metric,
     )
 
 
@@ -146,16 +171,18 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
     jax = _jax()
 
     nprobe = index.nprobe_for(num_candidates)
-    key = (index.C, index.Lmax, D, nprobe, metric)
+    key = (index.C, index.Lmax, D, nprobe, metric, index.metric)
     prog = _PROGRAMS.get(key)
     if prog is None:
-        prog = make_ivf_search(index.C, index.Lmax, D, nprobe, metric)
+        prog = make_ivf_search(index.C, index.Lmax, D, nprobe, metric,
+                               quantizer_metric=index.metric)
         _PROGRAMS[key] = prog
     q = jax.device_put(np.asarray(query_np, np.float32))
     return prog(q, index.centroids, index.lists, vecs)
 
 
-def make_ivf_search(C: int, Lmax: int, D: int, nprobe: int, metric: str):
+def make_ivf_search(C: int, Lmax: int, D: int, nprobe: int, metric: str,
+                    quantizer_metric: str = "cosine"):
     """Compiled IVF probe+score program for one shape class."""
     jax = _jax()
     import jax.numpy as jnp
@@ -165,11 +192,11 @@ def make_ivf_search(C: int, Lmax: int, D: int, nprobe: int, metric: str):
 
     @jax.jit
     def run(query, centroids, lists, vecs):
-        # 1. probe: closest nprobe centroids (cosine/dot on normalized)
-        cn = centroids / jnp.maximum(
-            jnp.linalg.norm(centroids, axis=-1, keepdims=True), 1e-12)
-        qn = query / jnp.maximum(jnp.linalg.norm(query), 1e-12)
-        csim = cn @ qn  # [C]
+        # 1. probe: closest nprobe centroids under the SAME metric the
+        # lists were clustered with (cosine/dot → normalized dot; l2 →
+        # norm-expanded squared distance), so probing agrees with build
+        csim = _quantizer_affinity(jnp, query[None, :], centroids,
+                                   quantizer_metric)[0]  # [C]
         _, probe = lax.top_k(csim, nprobe)  # [nprobe]
         # 2. candidates: padded ids of the probed lists
         cand = lists[probe].reshape(-1)  # [nprobe * Lmax], pad = D sentinel

@@ -693,6 +693,12 @@ class MeshQueryCompiler:
         duplicate terms summed (mirror _score_term_group/_dedupe_terms)."""
         from elasticsearch_tpu.search.queries import _dedupe_terms
 
+        if boost <= 0:
+            # weights are idf*boost: with boost <= 0 the host path switches
+            # to an explicit term mask (scores > 0 would invert/empty the
+            # match set) — a shape this emit node doesn't carry. Fall back.
+            raise MeshCompileError("non-positive boost on scoring term group")
+
         def terms_fn(ctx):
             terms, _ = base_terms_fn(ctx)
             if not terms:
@@ -722,6 +728,8 @@ class MeshQueryCompiler:
                                                       _min_should_match)
 
         field, boost = q.field, q.boost
+        if boost <= 0:
+            raise MeshCompileError("non-positive boost on match query")
 
         def analyze(ctx):
             an = ctx.search_analyzer(field)

@@ -726,15 +726,28 @@ class KnnQuery(Query):
             if ivf is not None:
                 from elasticsearch_tpu.ops.ivf import ivf_candidate_scores
 
+                # With a filter the intersection is POST-filtering: probed
+                # candidates are selected blind to the filter, so a selective
+                # filter can leave < k of them even when >= k matching docs
+                # exist (ES applies the kNN filter during the search). Probe
+                # wider (4x) under a filter and, if the surviving candidate
+                # count still falls below k, fall through to the brute-force
+                # path, which scores every doc and composes exactly.
+                num_cand = self.num_candidates
+                if self.filter is not None:
+                    num_cand *= 4
                 scores, mask = ivf_candidate_scores(
                     ivf, vc.vecs, np.asarray(self.vector, np.float32),
-                    self.num_candidates, vc.similarity, ctx.D)
+                    num_cand, vc.similarity, ctx.D)
                 mask = mask & vc.exists
                 if self.filter is not None:
                     _, fm2 = self.filter.execute(ctx)
                     mask = mask & fm2
-                scores = jnp.where(mask, scores, 0.0) * self.boost
-                return scores, mask
+                    if int(jnp.sum(mask)) < min(self.k, int(jnp.sum(fm2 & vc.exists))):
+                        mask = None  # recall floor broken: brute force below
+                if mask is not None:
+                    scores = jnp.where(mask, scores, 0.0) * self.boost
+                    return scores, mask
         q = jnp.asarray(np.asarray(self.vector, np.float32)[None, :])
         scores = knn_scores(q, vc.vecs, metric=vc.similarity)[0] * self.boost
         mask = vc.exists
