@@ -388,6 +388,11 @@ class Node:
                         indexing[k] += st["indexing"][k]
                     seg_count += st["segments"]["count"]
                     seg_mem += st["segments"]["memory_in_bytes"]
+        from elasticsearch_tpu.monitor import kernels
+
+        # node-wide kernel dispatch counters (which device program served
+        # each query component) + mesh-vs-host routing counts
+        search["kernels"] = kernels.snapshot()
         proc = process_stats()
         return {
             "cluster_name": self.cluster_state.cluster_name,

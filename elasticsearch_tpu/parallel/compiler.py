@@ -151,6 +151,82 @@ class TGroupPrim(DataPrim):
         return [h_starts, h_lens, h_ws], (Pmax,)
 
 
+class HybridTGroupPrim(DataPrim):
+    """Term group scored via the hybrid dense-impact path: the segment's
+    frequent terms live as rows of an impact[F, D] block (one MXU matmul per
+    query), the rare tail stays as (start, len) scatter chunks — the same
+    split the host loop's ctx.hybrid_slices makes (ops/scoring.py:94).
+
+    Arrays: impact [S, F, D] (stacked per-shard blocks, zero rows where a
+    shard has no dense block — its terms all fall to the tail), qw [S, F]
+    (idf*boost folded into dense rows), qind [S, F] (1.0 indicator),
+    starts/lens/ws [S, T] tail chunk tables. Per-shard F/dense_rows
+    variability is data; the emit tree stays identical on every shard."""
+
+    n_arrays = 6
+
+    def __init__(self, field: str, terms_fn: Callable):
+        self.field = field
+        self.terms_fn = terms_fn
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        from elasticsearch_tpu.search.context import split_runs
+
+        blocks = []
+        F = 8
+        for seg in seg_row:
+            inv = seg.inverted.get(self.field) if seg is not None else None
+            blk = inv.dense_block() if inv is not None else None
+            blocks.append((inv, blk))
+            if blk is not None:
+                F = max(F, int(blk[1].shape[0]))
+
+        def fill_impact():
+            h = np.zeros((S, F, D), np.float32)
+            for si, (_inv, blk) in enumerate(blocks):
+                if blk is not None:
+                    imp = np.asarray(blk[1])
+                    h[si, : imp.shape[0], : imp.shape[1]] = imp
+            return [h]
+
+        key = ("hyb_impact", self.field, tuple(id(s) for s in seg_row), F, D)
+        arrays = list(cache(key, fill_impact))
+
+        h_qw = np.zeros((S, F), np.float32)
+        h_qind = np.zeros((S, F), np.float32)
+        per_shard = []
+        Pmax, Tmax = 1, 1
+        for si, ((inv, blk), ctx) in enumerate(zip(blocks, ctxs)):
+            runs = []
+            if inv is not None and ctx is not None:
+                terms, weights = self.terms_fn(ctx)
+                dense_rows = blk[0] if blk is not None else None
+                for t, w in zip(terms, weights):
+                    tid = inv.term_id(t)
+                    if tid < 0:
+                        continue
+                    row = int(dense_rows[tid]) if dense_rows is not None else -1
+                    if row >= 0:
+                        h_qw[si, row] += w
+                        h_qind[si, row] = 1.0
+                    else:
+                        s0 = int(inv.offsets[tid])
+                        runs.append((s0, int(inv.offsets[tid + 1]) - s0, w))
+            starts, lens, ws, max_len = split_runs(runs) if runs else ([], [], [], 1)
+            Pmax = max(Pmax, pow2_bucket(max_len))
+            Tmax = max(Tmax, len(starts))
+            per_shard.append((starts, lens, ws))
+        T = pow2_bucket(Tmax, minimum=1)
+        h_starts = np.zeros((S, T), np.int32)
+        h_lens = np.zeros((S, T), np.int32)
+        h_ws = np.zeros((S, T), np.float32)
+        for si, (st, ln, ws) in enumerate(per_shard):
+            h_starts[si, : len(st)] = st
+            h_lens[si, : len(ln)] = ln
+            h_ws[si, : len(ws)] = ws
+        return arrays + [h_qw, h_qind, h_starts, h_lens, h_ws], (Pmax,)
+
+
 class RangePrim(DataPrim):
     """Numeric/date range: column slab + bounds. Emits the exact-i64 pair
     form when the column carries (hi, lo) int32 pairs and the bounds are
@@ -419,6 +495,42 @@ class ETermGroup(Emit):
                                     P=P, D=self.D)
         if self.mode == "count_ge":
             counts = match_count_segment(doc_ids, starts, lens, P=P, D=self.D)
+            return scores, counts >= self.n
+        return scores, scores > 0
+
+
+class ETermGroupHybrid(Emit):
+    """ETermGroup over the hybrid dense-impact path: one MXU matmul for the
+    dense rows + scatter for the tail (mirror of _score_term_group's hybrid
+    branch). Same three modes as ETermGroup."""
+
+    def __init__(self, prim: int, post: int, mode: str, n: int, boost: float,
+                 D: int):
+        self.prim = prim
+        self.post = post
+        self.mode = mode
+        self.n = n
+        self.boost = boost
+        self.D = D
+
+    def key(self):
+        return ("tgh", self.mode, self.n, self.boost)
+
+    def ex(self, env, meta):
+        from elasticsearch_tpu.ops.scoring import (
+            bm25_score_hybrid, match_count_hybrid, term_mask_hybrid)
+
+        doc_ids, tfnorm = env[self.post]
+        impact, qw, qind, starts, lens, ws = env[self.prim]
+        (P,) = meta[self.prim]
+        if self.mode == "mask":
+            return None, term_mask_hybrid(impact, qind, doc_ids, starts, lens,
+                                          P=P, D=self.D)
+        scores = bm25_score_hybrid(impact, qw, doc_ids, tfnorm, starts, lens,
+                                   ws, P=P, D=self.D)
+        if self.mode == "count_ge":
+            counts = match_count_hybrid(impact, qind, doc_ids, starts, lens,
+                                        P=P, D=self.D)
             return scores, counts >= self.n
         return scores, scores > 0
 

@@ -26,6 +26,14 @@ _UNSUPPORTED_KEYS = ("rescore", "search_after", "min_score", "scroll",
 
 def try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[dict]:
     """Mesh-execute a search request; None → caller uses the host loop."""
+    from elasticsearch_tpu.monitor import kernels
+
+    resp = _try_mesh_search(svc, searchers, body, global_stats)
+    kernels.record("mesh_search" if resp is not None else "mesh_fallback_total")
+    return resp
+
+
+def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[dict]:
     body = body or {}
     for key in _UNSUPPORTED_KEYS:
         if body.get(key):

@@ -106,9 +106,12 @@ def _score_term_group(ctx, field, terms, boost=1.0, with_counts=False) -> Tuple[
         matched = (jnp.zeros(ctx.D, dtype=jnp.int32) if with_counts
                    else jnp.zeros(ctx.D, dtype=bool))
         return z, matched, 0
+    from elasticsearch_tpu.monitor import kernels
+
     terms, weights = _dedupe_terms(terms, boost, lambda t: ctx.idf(field, t))
     all_positive = all(w > 0 for w in weights)
     hyb = ctx.hybrid_slices(inv, terms, weights)
+    kernels.record("bm25_hybrid" if hyb is not None else "bm25_scatter")
     if hyb is not None:
         impact, qw, qind, starts, lens, ws, P, n_present = hyb
         scores = bm25_score_hybrid(
@@ -713,6 +716,8 @@ class KnnQuery(Query):
         return bool(opts) and opts.get("type") in ("ivf", "ivf_flat")
 
     def execute(self, ctx) -> ExecResult:
+        from elasticsearch_tpu.monitor import kernels
+
         jnp = _jnp()
         vc = ctx.segment.vectors.get(self.field)
         if vc is None:
@@ -746,14 +751,34 @@ class KnnQuery(Query):
                     if int(jnp.sum(mask)) < min(self.k, int(jnp.sum(fm2 & vc.exists))):
                         mask = None  # recall floor broken: brute force below
                 if mask is not None:
+                    kernels.record("knn_ivf")
                     scores = jnp.where(mask, scores, 0.0) * self.boost
                     return scores, mask
         q = jnp.asarray(np.asarray(self.vector, np.float32)[None, :])
+        if self.filter is None:
+            # Filter-free brute force: fused scores+mask+topk (the Pallas
+            # streaming kernel on TPU when shapes gate in, one XLA program
+            # elsewhere) over the live vectors, scattered back into the
+            # (scores, mask) contract. Candidates beyond num_candidates are
+            # non-matches — ES knn-query semantics (k/num_candidates bound
+            # the per-shard result), vs r2's full [D] score row.
+            from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
+
+            kc = int(min(max(self.num_candidates, self.k), ctx.D))
+            lv = vc.exists & ctx.segment.live
+            vals, idx = knn_topk_auto(q, vc.vecs, lv, k=kc,
+                                      metric=vc.similarity)
+            kernels.record("knn_fused_topk")
+            valid = vals[0] > -jnp.inf
+            scores = jnp.zeros(ctx.D, jnp.float32).at[idx[0]].max(
+                jnp.where(valid, vals[0] * self.boost, 0.0), mode="drop")
+            mask = jnp.zeros(ctx.D, bool).at[idx[0]].max(valid, mode="drop")
+            return scores, mask
+        kernels.record("knn_full")
         scores = knn_scores(q, vc.vecs, metric=vc.similarity)[0] * self.boost
         mask = vc.exists
-        if self.filter is not None:
-            _, fm = self.filter.execute(ctx)
-            mask = mask & fm
+        _, fm = self.filter.execute(ctx)
+        mask = mask & fm
         return scores * mask, mask
 
 
