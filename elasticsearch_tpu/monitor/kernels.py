@@ -13,8 +13,9 @@ Names:
   bm25_scatter        pure scatter-add postings scoring (host or mesh)
   bm25_hybrid         dense-impact MXU matmul + scatter tail
   bm25_fused_topk     Pallas streaming dense top-k (no [Q, D] intermediate)
-  knn_full            brute-force scores over the whole slab ([D] row)
-  knn_fused_topk      fused scores+mask+topk (Pallas on TPU, XLA elsewhere)
+  knn_fused_topk      fused scores+mask+topk (Pallas on TPU, XLA elsewhere);
+                      subsumed the r3 `knn_full` [D]-row path in r4 (filters
+                      now fold into the fused candidate mask)
   knn_ivf             IVF-flat probe + exact candidate scoring
   mesh_search         request served by the mesh product path
   mesh_fallback_total request fell back to the host per-shard loop

@@ -254,12 +254,25 @@ def bm25_dense_tiles_for(Q: int, F: int, D: int):
 def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
     """Dispatch: fused Pallas kernel on TPU when static shape gates hold,
     XLA hybrid matmul + topk_batch otherwise (same gate discipline as
-    knn_topk_auto — no runtime fallback illusions)."""
+    knn_topk_auto — no runtime fallback illusions).
+
+    Q below the sublane multiple (a single REST query is Q=1) pads up to 8
+    with zero query rows and slices the result — without this no single
+    query could ever pass the q_tile gate and every request would fall to
+    the XLA path that materializes the [Q, D] row this kernel avoids (the
+    same regression knn_topk_auto documents from round 1)."""
     Q, F = qw.shape
     D = impact.shape[1]
-    q_tile, tile = bm25_dense_tiles_for(Q, F, D)
+    qpad = ((Q + 7) // 8) * 8
+    q_tile, tile = bm25_dense_tiles_for(qpad, F, D)
     if (_on_tpu() and k <= 64 and F % 8 == 0
             and q_tile and D >= 2 * tile):
+        if qpad != Q:
+            qw = jnp.concatenate(
+                [qw, jnp.zeros((qpad - Q, F), qw.dtype)], axis=0)
+            vals, idx = bm25_dense_topk_pallas(qw, impact, mask, k=k,
+                                               tile=tile, q_tile=q_tile)
+            return vals[:Q], idx[:Q]
         return bm25_dense_topk_pallas(qw, impact, mask, k=k, tile=tile,
                                       q_tile=q_tile)
     from jax import lax as _lax

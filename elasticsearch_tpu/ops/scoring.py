@@ -132,6 +132,17 @@ def term_mask_hybrid(dense_impact, qind, doc_ids, starts, lens, *, P: int, D: in
     return dmask | term_mask(doc_ids, starts, lens, P=P, D=D)
 
 
+@jax.jit
+def dense_presence_count(impact, qind, live):
+    """Exact hit count for a pure-dense term group: docs where ANY dense
+    query row (qind f32[1, F] indicator) has a non-zero impact, ANDed with
+    the live mask. One [1, F] @ [F, D] matvec — the fused top-k fast path
+    uses this for `hits.total` without materializing per-doc scores twice."""
+    present = (impact != 0).astype(jnp.float32)
+    m = (jnp.dot(qind, present, precision=lax.Precision.DEFAULT) > 0)[0] & live
+    return jnp.sum(m.astype(jnp.int32))
+
+
 @partial(jax.jit, static_argnames=("P", "D"))
 def match_count_segment(doc_ids, starts, lens, *, P: int, D: int):
     """Count of matching query *terms* per doc. Each doc id occurs at most

@@ -684,11 +684,17 @@ class CompiledMeshQuery:
 
 
 class MeshQueryCompiler:
-    def __init__(self, mappings, analysis, global_stats=None, D: int = 0):
+    def __init__(self, mappings, analysis, global_stats=None, D: int = 0,
+                 has_dense: Optional[Callable[[str], bool]] = None):
         self.mappings = mappings
         self.analysis = analysis
         self.gs = global_stats
         self.D = D
+        # has_dense(field) → True when any segment of the current round has a
+        # dense impact block for the field; term groups then score via the
+        # hybrid MXU-matmul + scatter-tail path (mirror of the host loop's
+        # ctx.hybrid_slices dispatch, ops/scoring.py:94)
+        self.has_dense = has_dense or (lambda field: False)
         self.prims: List[DataPrim] = []
         self._postings: Dict[str, int] = {}
 
@@ -818,20 +824,27 @@ class MeshQueryCompiler:
             return _dedupe_terms(terms, boost,
                                  lambda t: ctx.idf(field, t))
 
-        prim = TGroupPrim(field, terms_fn)
+        idx, post, hybrid = self._tgroup_prim(field, terms_fn)
+        cls = ETermGroupHybrid if hybrid else ETermGroup
+        return cls(idx, post, "scores", 0, boost, self.D)
+
+    def _tgroup_prim(self, field: str, terms_fn) -> Tuple[int, int, bool]:
+        """Add the term-group data prim for a field: the hybrid dense-impact
+        form when any segment of the round carries a dense block (frequent
+        terms ride one MXU matmul), the pure scatter form otherwise."""
+        hybrid = bool(self.has_dense(field))
+        prim = (HybridTGroupPrim if hybrid else TGroupPrim)(field, terms_fn)
         post = self._postings_for(field)
-        idx = self._add(prim)
-        return ETermGroup(idx, post, "scores", 0, boost, self.D)
+        return self._add(prim), post, hybrid
 
     def _tgroup_mask(self, field: str, boost: float, expand_fn) -> Emit:
         def terms_fn(ctx):
             terms = list(dict.fromkeys(expand_fn(ctx)))
             return terms, [1.0] * len(terms)
 
-        prim = TGroupPrim(field, terms_fn)
-        post = self._postings_for(field)
-        idx = self._add(prim)
-        node = ETermGroup(idx, post, "mask", 0, boost, self.D)
+        idx, post, hybrid = self._tgroup_prim(field, terms_fn)
+        cls = ETermGroupHybrid if hybrid else ETermGroup
+        node = cls(idx, post, "mask", 0, boost, self.D)
         node.boost = boost
         return node
 
@@ -853,9 +866,8 @@ class MeshQueryCompiler:
             return _dedupe_terms(analyze(ctx), boost,
                                  lambda t: ctx.idf(field, t))
 
-        prim = TGroupPrim(field, terms_fn)
-        post = self._postings_for(field)
-        idx = self._add(prim)
+        idx, post, hybrid = self._tgroup_prim(field, terms_fn)
+        cls = ETermGroupHybrid if hybrid else ETermGroup
         # the analyzer output is query-side — identical on every shard, so
         # n_terms/msm thresholds are static (resolve once with the analyzer)
         an = self.analysis.get(
@@ -868,12 +880,12 @@ class MeshQueryCompiler:
                 else [str(q.text)])
         n_terms = len(set(toks))
         if q.operator == "and":
-            return ETermGroup(idx, post, "count_ge", max(n_terms, 1), boost,
-                              self.D)
+            return cls(idx, post, "count_ge", max(n_terms, 1), boost,
+                       self.D)
         if q.msm is not None:
             need = max(_min_should_match(q.msm, n_terms), 1)
-            return ETermGroup(idx, post, "count_ge", need, boost, self.D)
-        return ETermGroup(idx, post, "scores", 0, boost, self.D)
+            return cls(idx, post, "count_ge", need, boost, self.D)
+        return cls(idx, post, "scores", 0, boost, self.D)
 
     def _range(self, q) -> Emit:
         from elasticsearch_tpu.search import queries as Q

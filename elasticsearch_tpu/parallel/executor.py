@@ -459,8 +459,20 @@ class MeshSearchExecutor:
                                 for s in seg_row))
             ctxs = [SegmentContext(s, mappings, analysis, global_stats)
                     if s is not None else None for s in seg_row]
-            comp = MeshQueryCompiler(mappings, analysis, global_stats, D=D)
+
+            def has_dense(field, _row=seg_row):
+                # triggers the lazy dense-impact build exactly like the host
+                # loop's ctx.hybrid_slices → inv.dense_block() does
+                for s in _row:
+                    inv = s.inverted.get(field) if s is not None else None
+                    if inv is not None and inv.dense_block() is not None:
+                        return True
+                return False
+
+            comp = MeshQueryCompiler(mappings, analysis, global_stats, D=D,
+                                     has_dense=has_dense)
             compiled = comp.compile(body_query, sort_spec, agg_specs)
+            self._record_tgroup_kernels(compiled)
 
             # build per-prim data + statics; cacheable groups are device-put
             # once and reused across queries (postings, columns)
@@ -523,6 +535,24 @@ class MeshSearchExecutor:
             out.extend(lst[:k])
         out.sort(key=lambda t: (-t[0], t[1], t[3]))  # stable: seg order kept
         return out[:k_dev], totals, agg_rounds
+
+    @staticmethod
+    def _record_tgroup_kernels(compiled) -> None:
+        """Dispatch counters for the mesh round (host-side decision point,
+        monitor/kernels.py contract): which scoring prim serves each term
+        group of this compiled structure."""
+        from elasticsearch_tpu.monitor import kernels
+        from elasticsearch_tpu.parallel.compiler import (HybridTGroupPrim,
+                                                         TGroupPrim)
+
+        n_hybrid = sum(1 for p in compiled.prims
+                       if isinstance(p, HybridTGroupPrim))
+        n_scatter = sum(1 for p in compiled.prims
+                        if type(p) is TGroupPrim)
+        if n_hybrid:
+            kernels.record("bm25_hybrid", n_hybrid)
+        if n_scatter:
+            kernels.record("bm25_scatter", n_scatter)
 
     def _rounds_for(self, shard_list):
         cols = [[] for _ in range(self.S)]

@@ -33,6 +33,7 @@ import numpy as np
 from elasticsearch_tpu.ops.scoring import (
     bm25_score_hybrid,
     bm25_score_segment,
+    dense_presence_count,
     match_count_hybrid,
     match_count_segment,
     range_mask_f32,
@@ -40,7 +41,6 @@ from elasticsearch_tpu.ops.scoring import (
     term_mask,
     term_mask_hybrid,
 )
-from elasticsearch_tpu.ops.knn import knn_scores
 from elasticsearch_tpu.search.context import SegmentContext
 from elasticsearch_tpu.search.scripting import compile_script
 from elasticsearch_tpu.utils.dates import parse_date
@@ -134,6 +134,57 @@ def _score_term_group(ctx, field, terms, boost=1.0, with_counts=False) -> Tuple[
     else:
         matched = term_mask(inv.doc_ids, starts, lens, P=P, D=ctx.D)
     return scores, matched, n_present
+
+
+def fused_bm25_topk(ctx, query, k: int):
+    """Fused dense-impact BM25 top-k fast path (the Pallas streaming kernel
+    on TPU via ops.pallas_kernels.bm25_dense_topk_auto — no [Q, D] or [D]
+    score intermediate in HBM).
+
+    Eligible when the query is a pure disjunctive term group (match with
+    operator:or / term on a text field, positive boost) whose present terms
+    ALL map to dense impact rows — then top-k comes straight off the
+    impact[F, D] matmul and `hits.total` from one presence matvec.
+    Returns (vals f32[k], ids i32[k], total int) or None to fall through to
+    the generic score/mask path. Scores match bm25_score_hybrid's dense
+    branch exactly (same matmul); non-matches carry score <= 0.
+    """
+    if isinstance(query, MatchQuery):
+        if (query.operator != "or" or query.msm is not None
+                or query.fuzziness is not None):
+            return None
+        field, boost = query.field, query.boost
+        terms = query._analyze(ctx)
+    elif isinstance(query, TermQuery):
+        fm = ctx.mappings.get(query.field)
+        if fm is not None and fm.is_numeric:
+            return None
+        field, boost = query.field, query.boost
+        terms = [query._term_str(ctx)]
+    else:
+        return None
+    if boost <= 0 or not terms:
+        return None
+    inv = ctx.inv(field)
+    if inv is None:
+        return None
+    tlist, wlist = _dedupe_terms(terms, boost, lambda t: ctx.idf(field, t))
+    hyb = ctx.hybrid_slices(inv, tlist, wlist)
+    if hyb is None:
+        return None  # no dense block / no dense query term
+    impact, qw, qind, _starts, lens, _ws, _P, n_present = hyb
+    if n_present == 0 or int(np.sum(lens)) > 0:
+        return None  # tail terms present — not a pure-dense group
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.ops.pallas_kernels import bm25_dense_topk_auto
+
+    jnp = _jnp()
+    live = ctx.segment.live
+    vals, ids = bm25_dense_topk_auto(jnp.asarray(qw[None, :]), impact, live,
+                                     k=min(k, ctx.D))
+    kernels.record("bm25_fused_topk")
+    total = int(dense_presence_count(impact, jnp.asarray(qind[None, :]), live))
+    return np.asarray(vals[0]), np.asarray(ids[0]), total
 
 
 def _terms_filter_mask(ctx, field, terms):
@@ -692,9 +743,12 @@ class FuzzyQuery(Query):
 
 
 class KnnQuery(Query):
-    """dense_vector brute-force kNN (north-star; no ES 2.0 counterpart).
-    As a query node it produces similarity scores for ALL docs with the
-    field (the executor's top-k selects k); `filter` restricts candidates."""
+    """dense_vector kNN (north-star; no ES 2.0 counterpart). As a query
+    node it produces similarity scores for the top num_candidates docs
+    (candidates beyond that are non-matches — ES knn-query semantics); the
+    executor's top-k then selects k. `filter` folds into the candidate mask
+    before selection; IVF (`index_options: {type: ivf}`) probes first and
+    falls back to brute force when a filter starves the candidate set."""
 
     def __init__(self, field: str, query_vector: List[float], k: int = 10,
                  num_candidates: Optional[int] = None, filter_: Optional[Query] = None,
@@ -737,7 +791,8 @@ class KnnQuery(Query):
                 # exist (ES applies the kNN filter during the search). Probe
                 # wider (4x) under a filter and, if the surviving candidate
                 # count still falls below k, fall through to the brute-force
-                # path, which scores every doc and composes exactly.
+                # path below, which selects its top num_candidates from ALL
+                # filtered docs (so >= k survive whenever k matches exist).
                 num_cand = self.num_candidates
                 if self.filter is not None:
                     num_cand *= 4
@@ -754,32 +809,29 @@ class KnnQuery(Query):
                     kernels.record("knn_ivf")
                     scores = jnp.where(mask, scores, 0.0) * self.boost
                     return scores, mask
-        q = jnp.asarray(np.asarray(self.vector, np.float32)[None, :])
-        if self.filter is None:
-            # Filter-free brute force: fused scores+mask+topk (the Pallas
-            # streaming kernel on TPU when shapes gate in, one XLA program
-            # elsewhere) over the live vectors, scattered back into the
-            # (scores, mask) contract. Candidates beyond num_candidates are
-            # non-matches — ES knn-query semantics (k/num_candidates bound
-            # the per-shard result), vs r2's full [D] score row.
-            from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
+        # Brute force: fused scores+mask+topk (the Pallas streaming kernel
+        # on TPU when shapes gate in, one XLA program elsewhere) over the
+        # live vectors, scattered back into the (scores, mask) contract.
+        # A filter folds into the candidate mask BEFORE top-k selection (ES
+        # applies the kNN filter during the search — no post-filter
+        # starvation), and candidates beyond num_candidates are non-matches
+        # — ES knn-query semantics (k/num_candidates bound the per-shard
+        # result), vs r2's full [D] score row.
+        from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
 
-            kc = int(min(max(self.num_candidates, self.k), ctx.D))
-            lv = vc.exists & ctx.segment.live
-            vals, idx = knn_topk_auto(q, vc.vecs, lv, k=kc,
-                                      metric=vc.similarity)
-            kernels.record("knn_fused_topk")
-            valid = vals[0] > -jnp.inf
-            scores = jnp.zeros(ctx.D, jnp.float32).at[idx[0]].max(
-                jnp.where(valid, vals[0] * self.boost, 0.0), mode="drop")
-            mask = jnp.zeros(ctx.D, bool).at[idx[0]].max(valid, mode="drop")
-            return scores, mask
-        kernels.record("knn_full")
-        scores = knn_scores(q, vc.vecs, metric=vc.similarity)[0] * self.boost
-        mask = vc.exists
-        _, fm = self.filter.execute(ctx)
-        mask = mask & fm
-        return scores * mask, mask
+        q = jnp.asarray(np.asarray(self.vector, np.float32)[None, :])
+        lv = vc.exists & ctx.segment.live
+        if self.filter is not None:
+            _, fm = self.filter.execute(ctx)
+            lv = lv & fm
+        kc = int(min(max(self.num_candidates, self.k), ctx.D))
+        vals, idx = knn_topk_auto(q, vc.vecs, lv, k=kc, metric=vc.similarity)
+        kernels.record("knn_fused_topk")
+        valid = vals[0] > -jnp.inf
+        scores = jnp.zeros(ctx.D, jnp.float32).at[idx[0]].max(
+            jnp.where(valid, vals[0] * self.boost, 0.0), mode="drop")
+        mask = jnp.zeros(ctx.D, bool).at[idx[0]].max(valid, mode="drop")
+        return scores, mask
 
 
 # ---------------------------------------------------------------------------

@@ -126,10 +126,30 @@ class ShardSearcher:
         # 10k cap, no re-read of live state between pages); sorted scrolls
         # materialize the complete candidate list instead
         full_snap = [] if (collect_full and not sort_spec) else None
+        # fused dense-impact top-k fast path: eligible request shapes skip
+        # the [D] score row entirely (queries.fused_bm25_topk)
+        fused_ok = (not aggs and not sort_spec and min_score is None
+                    and search_after is None and not rescore_specs
+                    and full_snap is None and not collect_full)
         for seg in self.segments:
             ctx = SegmentContext(seg, self.mappings, self.analysis, global_stats,
                                  all_segments=self.segments,
                                  index_name=self.index_name)
+            if fused_ok and not seg.has_nested:
+                from elasticsearch_tpu.search.queries import fused_bm25_topk
+
+                fused = fused_bm25_topk(ctx, query, min(k, seg.max_docs))
+                if fused is not None:
+                    vals, ids, seg_total = fused
+                    total += seg_total
+                    for v, i in zip(vals, ids):
+                        # matches score strictly > 0; the live mask maps
+                        # non-matches to -inf or a 0.0 dense row
+                        if np.isfinite(v) and v > 0:
+                            max_score = max(max_score, float(v))
+                            docs.append(ShardDoc(self.shard_ord, seg,
+                                                 int(i), float(v)))
+                    continue
             scores, mask = query.score_or_mask(ctx)
             mask = mask & seg.live
             if seg.has_nested:
