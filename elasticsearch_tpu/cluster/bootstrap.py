@@ -1,0 +1,200 @@
+"""Multi-host bootstrap: jax.distributed world + rank-0 master over TCP.
+
+Reference: org/elasticsearch/discovery/zen/ZenDiscovery.java:1-120 (join /
+publish / fault detection) + bootstrap/Bootstrap.java. Mapping to the TPU
+runtime (SURVEY §2.7): each host runs ONE process of the jax.distributed
+world — ``initialize_distributed`` wires the XLA coordinator so the DATA
+plane (collectives inside jit programs) rides ICI/DCN; this module is the
+CONTROL plane only, riding the TCP JSON transport (cluster/transport.py).
+
+Process rank 0 doubles as the elected master: node ids are rank-prefixed
+(``0000-…``) so ElectMasterService's lowest-id election deterministically
+picks the coordinator on every host — the zen "lowest sorted id wins" rule
+with the jax.distributed rank as the sort key. The master publishes the
+full node list on every membership change, and runs ping-based fault
+detection (fd/NodesFaultDetection.java) over the same transport; a dead
+host leaves the cluster and its routing entries unassign for reroute.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from elasticsearch_tpu.cluster.discovery import FaultDetector, ZenDiscovery
+from elasticsearch_tpu.cluster.state import DiscoveryNode
+from elasticsearch_tpu.cluster.transport import TransportService
+
+
+def initialize_distributed(coordinator: str, num_processes: int,
+                           process_id: int) -> None:
+    """jax.distributed.initialize for the multi-host world (idempotent no-op
+    when the world is already initialized). coordinator = "host:port" of
+    process 0 — the same address every process passes."""
+    import jax
+
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    except RuntimeError as e:  # already initialized (tests, re-entry)
+        msg = str(e).lower()
+        # jax wordings across versions: "already initialized",
+        # "distributed.initialize should only be called once."
+        if "already" not in msg and "once" not in msg:
+            raise
+
+
+def _node_json(n: DiscoveryNode) -> dict:
+    return {"node_id": n.node_id, "name": n.name,
+            "transport_address": n.transport_address}
+
+
+class MultiHostCluster:
+    """Control-plane membership for one process of the distributed world."""
+
+    def __init__(self, node, rank: int, world: int,
+                 bind_host: str = "127.0.0.1", transport_port: int = 9300,
+                 master_host: str = "127.0.0.1",
+                 ping_interval: float = 1.0, ping_retries: int = 3):
+        self.node = node
+        self.rank = rank
+        self.world = world
+        nid = f"{rank:04d}-{node.node_id}"
+        state = node.cluster_state
+        state.nodes.clear()  # replace the single-node bootstrap entry
+        self.transport = TransportService(nid)
+        host, port = self.transport.bind(
+            bind_host, transport_port if rank == 0 else 0)
+        self.local = DiscoveryNode(nid, node.name,
+                                   transport_address=f"{host}:{port}")
+        self.discovery = ZenDiscovery(state, self.local)
+        self.master_addr: Tuple[str, int] = (master_host, transport_port)
+        self._adopted_version = -1
+        self._stop = threading.Event()
+        self._fd_thread: Optional[threading.Thread] = None
+        self.transport.register("cluster:publish", self._on_publish)
+        if rank == 0:
+            self.transport.register("cluster:join", self._on_join)
+            self.transport.register("cluster:leave", self._on_leave)
+            self.transport.register(
+                "cluster:nodes",
+                lambda p: [_node_json(n) for n in state.nodes.values()])
+            if ping_interval > 0:
+                self._fd_thread = threading.Thread(
+                    target=self._fault_loop,
+                    args=(ping_interval, ping_retries),
+                    name="tpu-fault-detector", daemon=True)
+                self._fd_thread.start()
+        else:
+            # the master may still be binding its transport (Node() startup
+            # cost varies — translog replay, jax init); retry with backoff
+            # instead of dying on the startup race
+            got = None
+            for attempt in range(30):
+                try:
+                    got = self.transport.send_remote(
+                        self.master_addr, "cluster:join",
+                        _node_json(self.local))
+                    break
+                except Exception:
+                    if attempt == 29:
+                        raise
+                    import time
+
+                    time.sleep(min(0.2 * (attempt + 1), 2.0))
+            self._adopt(got["nodes"], got.get("version", 0))
+
+    # -- master handlers ----------------------------------------------------
+
+    def _on_join(self, payload: dict) -> dict:
+        self.discovery.join(DiscoveryNode(
+            payload["node_id"], payload.get("name", ""),
+            payload.get("transport_address", "local")))
+        self._publish()
+        return {"nodes": [_node_json(n)
+                          for n in self.node.cluster_state.nodes.values()],
+                "master": self.node.cluster_state.master_node_id,
+                "version": self.node.cluster_state.version}
+
+    def _on_leave(self, payload: dict) -> dict:
+        self.discovery.leave(payload["node_id"])
+        self._publish()
+        return {"ok": True}
+
+    def _on_publish(self, payload: dict) -> dict:
+        self._adopt(payload["nodes"], payload.get("version", 0))
+        return {"ok": True}
+
+    def _adopt(self, nodes: List[dict], version: int) -> None:
+        """Replace the local membership view with the master's publication
+        (reference: PublishClusterStateAction — full-state publish).
+        Rebuild-then-swap under the discovery lock: transport handler
+        threads and readers must never observe a half-built dict, and a
+        join reply racing a newer concurrent publish must not regress the
+        view (the master's state.version orders publications)."""
+        state = self.node.cluster_state
+        fresh = {n["node_id"]: DiscoveryNode(
+            n["node_id"], n.get("name", ""),
+            n.get("transport_address", "local")) for n in nodes}
+        fresh.setdefault(self.local.node_id, self.local)
+        with self.discovery._lock:
+            if version <= self._adopted_version:
+                return
+            self._adopted_version = version
+            state.nodes = fresh
+            state.next_version()
+            self.discovery._reelect()
+
+    def _publish(self) -> None:
+        """Master → every other node: the authoritative node list."""
+        nodes = [_node_json(n)
+                 for n in self.node.cluster_state.nodes.values()]
+        version = self.node.cluster_state.version
+        for n in list(self.node.cluster_state.nodes.values()):
+            if n.node_id == self.local.node_id or ":" not in n.transport_address:
+                continue
+            host, port = n.transport_address.rsplit(":", 1)
+            try:
+                self.transport.send_remote(
+                    (host, int(port)), "cluster:publish",
+                    {"nodes": nodes, "version": version})
+            except Exception:
+                pass  # fault detection will reap it
+
+    # -- fault detection ------------------------------------------------------
+
+    def _ping(self, n: DiscoveryNode) -> bool:
+        if ":" not in n.transport_address:
+            return True
+        host, port = n.transport_address.rsplit(":", 1)
+        return self.transport.ping((host, int(port)))
+
+    def _fault_loop(self, interval: float, retries: int) -> None:
+        fd = FaultDetector(self._ping, self._on_node_failed,
+                           ping_retries=retries)
+        while not self._stop.wait(interval):
+            others = [n for n in
+                      list(self.node.cluster_state.nodes.values())
+                      if n.node_id != self.local.node_id]
+            fd.check(others)
+
+    def _on_node_failed(self, n: DiscoveryNode) -> None:
+        self.discovery.leave(n.node_id)
+        self._publish()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @property
+    def is_master(self) -> bool:
+        return self.discovery.is_master
+
+    def close(self) -> None:
+        self._stop.set()
+        if self.rank != 0:
+            try:
+                self.transport.send_remote(
+                    self.master_addr, "cluster:leave",
+                    {"node_id": self.local.node_id}, timeout=1.0)
+            except Exception:
+                pass
+        self.transport.close()

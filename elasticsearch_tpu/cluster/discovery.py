@@ -6,13 +6,13 @@ master-eligible node wins, minimum_master_nodes quorum),
 fd/NodesFaultDetection.java + MasterFaultDetection.java (periodic pings,
 N consecutive failures → node removed / master re-elected).
 
-Multi-host mapping (design, exercised single-process here): each host runs
-one process in the jax.distributed world; process 0's coordinator address
-doubles as the seed host list, election runs over the control plane
-(cluster/transport.py TCP framing), and the DATA plane never touches this
-path — collectives ride ICI/DCN via XLA. Fault detection pings use the
-same transport; a dead host's shards reroute via cluster/routing.py and
-replicas promote via cluster/replication.py.
+Multi-host: cluster/bootstrap.py connects these pieces to a real
+jax.distributed world — ``initialize_distributed`` + ``MultiHostCluster``
+run rank-0 master election and ping fault-detection over the TCP transport
+(``python -m elasticsearch_tpu.server --coordinator host:port``); the DATA
+plane never touches this path — collectives ride ICI/DCN via XLA. A dead
+host's shards reroute via cluster/routing.py and replicas promote via
+cluster/replication.py.
 """
 from __future__ import annotations
 

@@ -31,14 +31,16 @@ class SearchStats:
         self.suggest_total = 0
         self.scroll_total = 0
 
-    def on_query(self, ms: float):
+    def on_query(self, ms: float, n: int = 1):
+        """n > 1: a batched execution serving n requests at once (msearch
+        fast path) — counters must match the sequential path's totals."""
         with self._lock:
-            self.query_total += 1
+            self.query_total += n
             self.query_time_ms += ms
 
-    def on_fetch(self, ms: float):
+    def on_fetch(self, ms: float, n: int = 1):
         with self._lock:
-            self.fetch_total += 1
+            self.fetch_total += n
             self.fetch_time_ms += ms
 
     def on_suggest(self):

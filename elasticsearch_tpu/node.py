@@ -357,6 +357,31 @@ class Node:
         return resp
 
     def msearch(self, pairs: List[tuple]) -> dict:
+        # batched fast path: a uniform batch on one concrete index executes
+        # as ONE fused kernel per segment (search/batch.py); any
+        # non-uniformity falls back to the sequential loop below
+        if len(pairs) >= 2:
+            # index may be a list (valid msearch header syntax) — those and
+            # mixed-index batches take the sequential path
+            names = {h.get("index") if isinstance(h.get("index"), str)
+                     else None for h, _ in pairs}
+            if len(names) == 1 and None not in names:
+                try:
+                    resolved = self.resolve_indices(next(iter(names)))
+                except ElasticsearchTpuException:
+                    resolved = []
+                if len(resolved) == 1:
+                    from elasticsearch_tpu.cluster.metadata import check_open
+                    from elasticsearch_tpu.search.batch import try_batched_msearch
+
+                    svc = self.indices[resolved[0]]
+                    try:
+                        check_open(svc, op="read")  # closed/blocked → sequential
+                        out = try_batched_msearch(svc, [b for _, b in pairs])
+                    except Exception:
+                        out = None  # sequential path is always correct
+                    if out is not None:
+                        return {"responses": out}
         responses = []
         for header, body in pairs:
             try:

@@ -63,6 +63,35 @@ def knn_topk(queries, vecs, mask, *, k: int, metric: str = "cosine", use_bf16: b
     return vals, idx.astype(jnp.int32)
 
 
+@partial(jax.jit, static_argnames=("metric",))
+def exact_rescore_topk(queries, vecs, vals, idx, *, metric: str = "cosine"):
+    """f32 re-rank of a bf16 candidate sweep — the FAISS-style two-stage
+    refinement. The bf16 MXU pass selects candidates fast but its ~3-digit
+    mantissa shuffles near-ties (clustered corpora: recall collapse);
+    gathering the [Q, k] winners and rescoring with Precision.HIGHEST
+    restores exact-kNN recall at the cost of one tiny gather+einsum.
+    Invalid candidates (vals == -inf) stay -inf and keep sorting last."""
+    cand = vecs[idx].astype(jnp.float32)  # [Q, k, dims]
+    q = queries.astype(jnp.float32)
+    hi = lax.Precision.HIGHEST
+    if metric == "cosine":
+        qn = q / jnp.maximum(jnp.linalg.norm(q, axis=-1, keepdims=True), 1e-12)
+        cn = cand / jnp.maximum(
+            jnp.linalg.norm(cand, axis=-1, keepdims=True), 1e-12)
+        s = (1.0 + jnp.einsum("qd,qkd->qk", qn, cn, precision=hi)) * 0.5
+    elif metric in ("dot_product", "dot"):
+        s = (1.0 + jnp.einsum("qd,qkd->qk", q, cand, precision=hi)) * 0.5
+    elif metric in ("l2_norm", "l2"):
+        d2 = jnp.sum((q[:, None, :] - cand) ** 2, axis=-1)
+        s = 1.0 / (1.0 + d2)
+    else:
+        raise ValueError(f"unknown knn metric [{metric}]")
+    s = jnp.where(vals > NEG_INF, s, NEG_INF)
+    new_v, pos = lax.top_k(s, s.shape[1])
+    new_i = jnp.take_along_axis(idx, pos, axis=1)
+    return new_v, new_i.astype(jnp.int32)
+
+
 @partial(jax.jit, static_argnames=("k", "metric", "chunk", "use_bf16"))
 def knn_topk_chunked(queries, vecs, mask, *, k: int, metric: str = "cosine",
                      chunk: int = 1 << 16, use_bf16: bool = True):

@@ -33,14 +33,19 @@ def _on_tpu() -> bool:
         return False
 
 
-@partial(jax.jit, static_argnames=("k", "metric", "tile", "interpret"))
+@partial(jax.jit, static_argnames=("k", "metric", "tile", "interpret",
+                                   "precise"))
 def knn_topk_pallas(queries, vecs, mask, *, k: int, metric: str = "cosine",
-                    tile: int = 2048, interpret: bool = False):
+                    tile: int = 2048, interpret: bool = False,
+                    precise: bool = False):
     """Fused scores + mask + running top-k over corpus tiles.
 
     queries: f32[Q, dims] (Q, dims small enough for VMEM residency)
     vecs:    f32[D, dims], D % tile == 0 (caller pads; padded rows masked)
     mask:    bool[D] live-doc mask
+    precise: score in f32 (multi-pass on the MXU, ~3x the matmul cost) —
+             for exact-kNN recall on corpora whose neighbor gaps are below
+             bf16 resolution; default bf16 for throughput.
     Returns ([Q, k] scores, [Q, k] int32 doc ids), same contract as
     ops.knn.knn_topk.
     """
@@ -59,7 +64,7 @@ def knn_topk_pallas(queries, vecs, mask, *, k: int, metric: str = "cosine",
             jnp.linalg.norm(queries, axis=-1, keepdims=True), 1e-12)
     else:
         qn = queries
-    qh = qn.astype(jnp.bfloat16)
+    qh = qn.astype(jnp.float32 if precise else jnp.bfloat16)
 
     def kernel(q_ref, v_ref, m_ref, out_v_ref, out_i_ref):
         step = pl.program_id(0)
@@ -74,9 +79,10 @@ def knn_topk_pallas(queries, vecs, mask, *, k: int, metric: str = "cosine",
             norm = jnp.sqrt(jnp.sum(v * v, axis=-1, keepdims=True))
             v = v / jnp.maximum(norm, 1e-12)
         s = jax.lax.dot_general(
-            q_ref[:], v.astype(jnp.bfloat16),
+            q_ref[:], v if precise else v.astype(jnp.bfloat16),
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST if precise else None,
         )  # [Q, tile]
         if metric in ("cosine", "dot_product", "dot"):
             s = (1.0 + s) * 0.5
@@ -277,14 +283,44 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
                                       q_tile=q_tile)
     from jax import lax as _lax
 
-    scores = jnp.dot(qw, impact, precision=_lax.Precision.HIGHEST)
-    masked = jnp.where(mask[None, :], scores, NEG_INF)
-    vals, idx = _lax.top_k(masked, k)
+    # XLA fallback, Q-chunked: one unchunked [Q, D] score matrix at msearch
+    # batch scale (Q=2048, D=1M) would be an 8 GB intermediate
+    outs = []
+    step = min(Q, 256)
+    for q0 in range(0, Q, step):
+        scores = jnp.dot(qw[q0:q0 + step], impact,
+                         precision=_lax.Precision.HIGHEST)
+        masked = jnp.where(mask[None, :], scores, NEG_INF)
+        outs.append(_lax.top_k(masked, k))
+    vals = jnp.concatenate([v for v, _ in outs], axis=0)
+    idx = jnp.concatenate([i for _, i in outs], axis=0)
     return vals, idx.astype(jnp.int32)
 
 
-def knn_topk_auto(queries, vecs, mask, *, k: int, metric: str = "cosine"):
+def _knn_tile_for(Q: int, dims: int, k: int, D: int) -> int:
+    """Largest corpus tile keeping the kernel's VMEM working set in budget:
+    query block + corpus tile + ~3 live [Q, tile+k] candidate copies. A
+    Q-blind tile (r4 regression: Q=256 x tile=8192 = 17 MB stack) OOMs
+    scoped vmem at batch sizes the executor actually sends."""
+    budget = 12 * 1024 * 1024
+    qpad = ((Q + 7) // 8) * 8
+    for tile in (8192, 4096, 2048, 1024, 512):
+        if D % tile:
+            continue
+        est = qpad * dims * 4 + tile * dims * 4 + 3 * qpad * (tile + k) * 4
+        if est <= budget:
+            return tile
+    return 0
+
+
+def knn_topk_auto(queries, vecs, mask, *, k: int, metric: str = "cosine",
+                  precise: bool = False):
     """Dispatch: Pallas fused kernel on TPU when shapes fit, XLA otherwise.
+
+    precise=True scores in f32 end to end (Pallas multi-pass / XLA
+    use_bf16=False) — exact-kNN recall parity for latency-path queries;
+    batched throughput callers keep bf16 and follow with
+    ops.knn.exact_rescore_topk on the candidates.
 
     Dispatch is decided purely from STATIC shape gates — no try/except:
     this is routinely called inside an outer jit/shard_map trace, where
@@ -301,16 +337,18 @@ def knn_topk_auto(queries, vecs, mask, *, k: int, metric: str = "cosine"):
 
     Q, dims = queries.shape
     D = vecs.shape[0]
-    tile = 8192 if D % 8192 == 0 else 2048
+    tile = _knn_tile_for(Q, dims, k, D)
     if (_on_tpu() and k <= 64 and dims % 128 == 0
-            and D % tile == 0 and D >= 2 * tile):
+            and tile and D >= 2 * tile):
         if Q % 8 != 0:
             qpad = ((Q + 7) // 8) * 8
             queries = jnp.concatenate(
                 [queries, jnp.zeros((qpad - Q, dims), queries.dtype)], axis=0)
             vals, idx = knn_topk_pallas(queries, vecs, mask, k=k,
-                                        metric=metric, tile=tile)
+                                        metric=metric, tile=tile,
+                                        precise=precise)
             return vals[:Q], idx[:Q]
         return knn_topk_pallas(queries, vecs, mask, k=k, metric=metric,
-                               tile=tile)
-    return knn_topk(queries, vecs, mask, k=k, metric=metric)
+                               tile=tile, precise=precise)
+    return knn_topk(queries, vecs, mask, k=k, metric=metric,
+                    use_bf16=not precise)

@@ -143,6 +143,25 @@ def dense_presence_count(impact, qind, live):
     return jnp.sum(m.astype(jnp.int32))
 
 
+@partial(jax.jit, static_argnames=("chunk",))
+def dense_presence_count_batch(impact, qind, live, *, chunk: int):
+    """Batched exact hit counts: i32[Q] docs where any dense query row has
+    non-zero impact, ANDed with live. Sweeps D in `chunk`-wide slices so the
+    [Q, D] presence matrix never materializes (Q=2048, D=1M would be 8 GB).
+    Caller picks chunk with D % chunk == 0."""
+    D = impact.shape[1]
+    Q = qind.shape[0]
+
+    def body(i, acc):
+        sl = lax.dynamic_slice_in_dim(impact, i * chunk, chunk, axis=1)
+        lv = lax.dynamic_slice_in_dim(live, i * chunk, chunk)
+        pres = (jnp.dot(qind, (sl != 0).astype(jnp.float32),
+                        precision=lax.Precision.DEFAULT) > 0) & lv[None, :]
+        return acc + jnp.sum(pres.astype(jnp.int32), axis=1)
+
+    return lax.fori_loop(0, D // chunk, body, jnp.zeros(Q, jnp.int32))
+
+
 @partial(jax.jit, static_argnames=("P", "D"))
 def match_count_segment(doc_ids, starts, lens, *, P: int, D: int):
     """Count of matching query *terms* per doc. Each doc id occurs at most

@@ -62,21 +62,33 @@ class LivePrim(DataPrim):
     n_arrays = 1
 
     def build(self, seg_row, ctxs, D, S, cache):
-        h = np.zeros((S, D), bool)
-        for si, seg in enumerate(seg_row):
-            if seg is not None:
-                lv = np.asarray(seg.live_host)
-                h[si, : lv.shape[0]] = lv
-        return [h], ()
+        def fill():
+            h = np.zeros((S, D), bool)
+            for si, seg in enumerate(seg_row):
+                if seg is not None:
+                    lv = np.asarray(seg.live_host)
+                    h[si, : lv.shape[0]] = lv
+            return [h]
+
+        # deletes invalidate via the deleted_count in the key — otherwise
+        # the upload (a per-query device round-trip) reuses the cached copy
+        key = ("live", tuple(id(s) for s in seg_row),
+               tuple(s.deleted_count if s is not None else 0 for s in seg_row),
+               D)
+        return cache(key, fill), ()
 
 
 class NumDocsPrim(DataPrim):
     n_arrays = 1
 
     def build(self, seg_row, ctxs, D, S, cache):
-        h = np.asarray([(s.num_docs if s is not None else 0) for s in seg_row],
-                       np.int32)
-        return [h], ()
+        def fill():
+            return [np.asarray(
+                [(s.num_docs if s is not None else 0) for s in seg_row],
+                np.int32)]
+
+        key = ("nd", tuple(id(s) for s in seg_row))
+        return cache(key, fill), ()
 
 
 class PostingsPrim(DataPrim):

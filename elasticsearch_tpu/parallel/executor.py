@@ -115,12 +115,22 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
     from elasticsearch_tpu.parallel.mesh import get_shard_map as _gsm; shard_map = _gsm()
     from jax.sharding import PartitionSpec as PS
 
+    from elasticsearch_tpu.ops.knn import exact_rescore_topk
     from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
 
     def body(queries, vecs, live):
         # per-shard fused scores+mask+topk: the Pallas streaming kernel on
-        # TPU (no [Q, D] HBM intermediate), the XLA path elsewhere
-        vals, idx = knn_topk_auto(queries, vecs[0], live[0], k=k, metric=metric)
+        # TPU (no [Q, D] HBM intermediate), the XLA path elsewhere. bf16
+        # sweep OVERSAMPLED 4x (bf16's ~3-digit mantissa can rank a true
+        # top-k neighbor just outside position k on near-tie corpora), then
+        # an f32 re-rank of the candidates cut back to k — FAISS-style
+        # two-stage refinement, so merged results keep exact recall.
+        kp = min(max(4 * k, k), D)
+        vals, idx = knn_topk_auto(queries, vecs[0], live[0], k=kp,
+                                  metric=metric)
+        vals, idx = exact_rescore_topk(queries, vecs[0], vals, idx,
+                                       metric=metric)
+        vals, idx = vals[:, :k], idx[:, :k]
         av = lax.all_gather(vals, "shard")
         ai = lax.all_gather(idx, "shard")
         S = av.shape[0]
@@ -501,9 +511,11 @@ class MeshSearchExecutor:
                 self._programs[prog_key] = prog
             dev = [a if hasattr(a, "sharding") else jax.device_put(a, sh)
                    for a in arrays]
-            out = prog(*dev)
-            gvals, gslot, glocal, tot = (np.asarray(out[0]), np.asarray(out[1]),
-                                         np.asarray(out[2]), int(out[3]))
+            # ONE host transfer for the whole result tuple — per-array
+            # np.asarray pulls would each pay a device round-trip (the
+            # dominant cost per query on tunneled/remote chips)
+            out = jax.device_get(prog(*dev))
+            gvals, gslot, glocal, tot = out[0], out[1], out[2], int(out[3])
             totals += tot
             for v, sl, lc in zip(gvals, gslot, glocal):
                 if np.isfinite(v):

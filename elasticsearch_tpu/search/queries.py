@@ -149,6 +149,38 @@ def fused_bm25_topk(ctx, query, k: int):
     the generic score/mask path. Scores match bm25_score_hybrid's dense
     branch exactly (same matmul); non-matches carry score <= 0.
     """
+    e = _fused_eligible_terms(ctx, query)
+    if e is None:
+        return None
+    field, (tlist, wlist) = e
+    inv = ctx.inv(field)
+    if inv is None:
+        return None
+    hyb = ctx.hybrid_slices(inv, tlist, wlist)
+    if hyb is None:
+        return None  # no dense block / no dense query term
+    impact, qw, qind, _starts, lens, _ws, _P, n_present = hyb
+    if n_present == 0 or int(np.sum(lens)) > 0:
+        return None  # tail terms present — not a pure-dense group
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.ops.pallas_kernels import bm25_dense_topk_auto
+
+    import jax
+
+    jnp = _jnp()
+    live = ctx.segment.live
+    vals, ids = bm25_dense_topk_auto(jnp.asarray(qw[None, :]), impact, live,
+                                     k=min(k, ctx.D))
+    kernels.record("bm25_fused_topk")
+    total = dense_presence_count(impact, jnp.asarray(qind[None, :]), live)
+    v, i, t = jax.device_get((vals[0], ids[0], total))  # one round-trip
+    return v, i, int(t)
+
+
+def _fused_eligible_terms(ctx, query):
+    """(field, deduped (terms, weights)) when `query` is a pure disjunctive
+    term group — match operator:or / term on a text field, positive boost —
+    else None. Shared gate of the fused single and batched top-k paths."""
     if isinstance(query, MatchQuery):
         if (query.operator != "or" or query.msm is not None
                 or query.fuzziness is not None):
@@ -165,26 +197,65 @@ def fused_bm25_topk(ctx, query, k: int):
         return None
     if boost <= 0 or not terms:
         return None
-    inv = ctx.inv(field)
+    return field, _dedupe_terms(terms, boost, lambda t: ctx.idf(field, t))
+
+
+def fused_bm25_topk_batch(ctx, queries: List[Query], k: int):
+    """Batched fused dense-impact BM25 top-k over ONE segment: all queries
+    must be pure-dense term groups on the same field (no scatter tail), so
+    the whole batch is one qw[Q, F] @ impact[F, D] streaming-top-k kernel
+    plus one chunked presence sweep for exact totals.
+
+    Returns (vals f32[Q, k], ids i32[Q, k], totals i32[Q]) or None when any
+    query can't batch (the caller falls back to per-query execution). This
+    is the product path behind `_msearch` batching — the per-query
+    equivalent of fused_bm25_topk, amortizing dispatch across the batch.
+    """
+    field = None
+    rows = []
+    for q in queries:
+        e = _fused_eligible_terms(ctx, q)
+        if e is None:
+            return None
+        f, (tlist, wlist) = e
+        if field is None:
+            field = f
+        elif f != field:
+            return None  # one impact block per kernel call
+        rows.append((tlist, wlist))
+    inv = ctx.inv(field) if field is not None else None
     if inv is None:
         return None
-    tlist, wlist = _dedupe_terms(terms, boost, lambda t: ctx.idf(field, t))
-    hyb = ctx.hybrid_slices(inv, tlist, wlist)
-    if hyb is None:
-        return None  # no dense block / no dense query term
-    impact, qw, qind, _starts, lens, _ws, _P, n_present = hyb
-    if n_present == 0 or int(np.sum(lens)) > 0:
-        return None  # tail terms present — not a pure-dense group
+    Q = len(queries)
+    impact = None
+    qw = qind = None
+    for qi, (tlist, wlist) in enumerate(rows):
+        # single source of truth for dense/tail folding: hybrid_slices
+        hyb = ctx.hybrid_slices(inv, tlist, wlist)
+        if hyb is None:
+            return None  # no dense block / no dense query term
+        impact, row_qw, row_qind, _st, lens, _ws, _P, n_present = hyb
+        if n_present == 0 or int(np.sum(lens)) > 0:
+            return None  # tail term / empty group — whole batch falls back
+        if qw is None:
+            qw = np.zeros((Q, row_qw.shape[0]), np.float32)
+            qind = np.zeros((Q, row_qw.shape[0]), np.float32)
+        qw[qi] = row_qw
+        qind[qi] = row_qind
     from elasticsearch_tpu.monitor import kernels
     from elasticsearch_tpu.ops.pallas_kernels import bm25_dense_topk_auto
+    from elasticsearch_tpu.ops.scoring import dense_presence_count_batch
 
     jnp = _jnp()
     live = ctx.segment.live
-    vals, ids = bm25_dense_topk_auto(jnp.asarray(qw[None, :]), impact, live,
-                                     k=min(k, ctx.D))
-    kernels.record("bm25_fused_topk")
-    total = int(dense_presence_count(impact, jnp.asarray(qind[None, :]), live))
-    return np.asarray(vals[0]), np.asarray(ids[0]), total
+    D = ctx.D
+    vals, ids = bm25_dense_topk_auto(jnp.asarray(qw), impact, live,
+                                     k=min(k, D))
+    kernels.record("bm25_fused_topk", Q)
+    chunk = D if D < (1 << 15) else (1 << 15)
+    totals = dense_presence_count_batch(impact, jnp.asarray(qind), live,
+                                        chunk=chunk)
+    return np.asarray(vals), np.asarray(ids), np.asarray(totals)
 
 
 def _terms_filter_mask(ctx, field, terms):
@@ -825,7 +896,12 @@ class KnnQuery(Query):
             _, fm = self.filter.execute(ctx)
             lv = lv & fm
         kc = int(min(max(self.num_candidates, self.k), ctx.D))
-        vals, idx = knn_topk_auto(q, vc.vecs, lv, k=kc, metric=vc.similarity)
+        # precise=True: the REST latency path promises exact-kNN recall
+        # (BASELINE north-star); f32 costs ~3x a bf16 matmul on a single
+        # query — noise next to dispatch. Batched throughput paths keep
+        # bf16 + exact_rescore_topk instead (parallel/executor.py).
+        vals, idx = knn_topk_auto(q, vc.vecs, lv, k=kc, metric=vc.similarity,
+                                  precise=True)
         kernels.record("knn_fused_topk")
         valid = vals[0] > -jnp.inf
         scores = jnp.zeros(ctx.D, jnp.float32).at[idx[0]].max(

@@ -159,14 +159,16 @@ class ShardSearcher:
                 mask = mask & seg.roots_dev
             if min_score is not None:
                 mask = mask & (scores >= float(min_score))
-            total += int(jnp.sum(mask.astype(jnp.int32)))
+            tot_dev = jnp.sum(mask.astype(jnp.int32))
             if aggs:
                 agg_partials.append(run_aggs(aggs, ctx, mask))
             if sort_spec:
+                total += int(tot_dev)
                 seg_k = seg.max_docs if collect_full else k
                 seg_docs = self._sorted_candidates(ctx, scores, mask, sort_spec,
                                                    seg_k, search_after)
             elif full_snap is not None:
+                total += int(tot_dev)
                 sc = np.asarray(scores)
                 mk = np.asarray(mask)
                 n_match = int(mk[: seg.num_docs].sum())
@@ -178,10 +180,14 @@ class ShardSearcher:
                     for i in order[: min(k, order.size)]
                 ]
             else:
+                import jax
+
                 kk = min(k, seg.max_docs)
                 vals, idx = topk_with_mask(scores, mask, k=kk)
-                vals = np.asarray(vals)
-                idx = np.asarray(idx)
+                # one host transfer for (top-k, totals) — separate pulls
+                # each pay a device round-trip
+                vals, idx, tot = jax.device_get((vals, idx, tot_dev))
+                total += int(tot)
                 seg_docs = [
                     ShardDoc(self.shard_ord, seg, int(i), float(v))
                     for v, i in zip(vals, idx)

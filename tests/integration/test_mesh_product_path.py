@@ -213,6 +213,32 @@ def test_host_fused_bm25_topk_used(dense_node):
         del os.environ["ESTPU_DISABLE_MESH"]
 
 
+def test_batched_msearch_matches_sequential(dense_node):
+    """A uniform pure-dense msearch batch executes as ONE fused kernel per
+    segment (search/batch.py) and must agree with sequential execution."""
+    from elasticsearch_tpu.monitor import kernels
+
+    pairs = [({"index": "dn"}, {"query": {"match": {"body": "common"}}, "size": 5}),
+             ({"index": "dn"}, {"query": {"term": {"body": "common"}}, "size": 3}),
+             ({"index": "dn"}, {"query": {"match": {"body": "common"}},
+                                "size": 4, "from": 2})]
+    kernels.reset()
+    r = dense_node.msearch(pairs)
+    assert kernels.snapshot().get("bm25_fused_topk", 0) >= len(pairs)
+    seq = [dense_node.search("dn", b) for _, b in pairs]
+    for got, want in zip(r["responses"], seq):
+        assert got["hits"]["total"] == want["hits"]["total"]
+        assert ([h["_id"] for h in got["hits"]["hits"]]
+                == [h["_id"] for h in want["hits"]["hits"]])
+        for hg, hw in zip(got["hits"]["hits"], want["hits"]["hits"]):
+            assert abs(hg["_score"] - hw["_score"]) < 1e-5
+    # a non-uniform batch (tail term present) falls back and still answers
+    pairs.append(({"index": "dn"}, {"query": {"match": {"body": "common emu"}}}))
+    r2 = dense_node.msearch(pairs)
+    assert len(r2["responses"]) == 4
+    assert r2["responses"][3]["hits"]["total"] == seq[0]["hits"]["total"]
+
+
 def test_mesh_sort_across_segment_offsets():
     """Review regression: per-segment column offsets must rebase to one
     scale before cross-segment ranking (values 1e6 vs 500 used to invert)."""
