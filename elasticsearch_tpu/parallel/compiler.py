@@ -18,10 +18,16 @@ caller falls back to the host per-shard loop (mirroring how ES falls back
 from query-then-fetch optimizations).
 
 Supported: match_all/none, term, terms, match (or/and/minimum_should_match),
-range (numeric i64-exact + f32, date, keyword via term expansion), exists,
-ids, prefix, wildcard, regexp, fuzzy, bool, constant_score, filtered.
-Everything else (phrase/span positional programs, joins, function_score,
-scripts, geo, knn-in-query) → host loop.
+match_phrase (device positional program), range (numeric i64-exact + f32,
+date, keyword via term expansion), exists, ids, prefix, wildcard, regexp,
+fuzzy, bool, constant_score, filtered, dis_max, boosting, knn (brute
+force), function_score (weight / field_value_factor / decay / random,
+score_mode+boost_mode algebra). Sorting: numeric or keyword primary key
+(global-ordinal preselect), multi-key via host full-tuple ordering.
+Aggregations: terms-without-subs reduce fully on device; every other agg
+tree consumes the program's match mask through the host collectors.
+Still host-loop-only: spans, joins, geo, scripts, IVF knn, more_like_this,
+query_string, fuzzy-match expansion.
 """
 from __future__ import annotations
 
@@ -335,6 +341,47 @@ class SortColPrim(DataPrim):
         return cache(key, fill), ()
 
 
+class SortOrdPrim(DataPrim):
+    """Keyword sort key: per-shard ordinals are meaningless across shards
+    (each segment's vocab is local), so the prim builds ONE global rank
+    space on host — the sorted union of every shard's terms — and uploads
+    each doc's global rank as f32. Exact string ordering still happens on
+    host over the fetched values (mesh_service); this is the device
+    preselect, exactly the role kw.ords plays in the host loop."""
+
+    n_arrays = 2
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        def fill():
+            kws = [(s.keywords.get(self.field) if s is not None else None)
+                   for s in seg_row]
+            all_terms = sorted(set().union(
+                *[set(s.inverted[self.field].terms)
+                  if s is not None and self.field in s.inverted else set()
+                  for s in seg_row]))
+            rank_of = {t: i for i, t in enumerate(all_terms)}
+            h_val = np.zeros((S, D), np.float32)
+            h_ex = np.zeros((S, D), bool)
+            for si, (seg, kw) in enumerate(zip(seg_row, kws)):
+                if seg is None or kw is None:
+                    continue
+                terms = seg.inverted[self.field].terms
+                local2global = np.asarray(
+                    [rank_of[t] for t in terms] or [0], np.float32)
+                ords = np.asarray(kw.ords)
+                h_val[si, : ords.shape[0]] = np.where(
+                    ords >= 0, local2global[np.maximum(ords, 0)], 0.0)
+                ex = np.asarray(kw.exists)
+                h_ex[si, : ex.shape[0]] = ex
+            return [h_val, h_ex]
+
+        key = ("sortord", self.field, tuple(id(s) for s in seg_row), D)
+        return cache(key, fill), ()
+
+
 class ExistsPrim(DataPrim):
     n_arrays = 1
 
@@ -383,6 +430,163 @@ class IdsPrim(DataPrim):
                 if loc is not None:
                     h[si, loc] = True
         return [h], ()
+
+
+class ColPrim(DataPrim):
+    """Absolute-value numeric column: values+offset folded to f32 [S, D]
+    (the same f32 arithmetic the host loop's function_score path does) +
+    exists [S, D]."""
+
+    n_arrays = 2
+
+    def __init__(self, field: str):
+        self.field = field
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        def fill():
+            h_val = np.zeros((S, D), np.float32)
+            h_ex = np.zeros((S, D), bool)
+            for si, seg in enumerate(seg_row):
+                c = seg.numerics.get(self.field) if seg is not None else None
+                if c is not None:
+                    v = np.asarray(c.values) + np.float32(c.offset)
+                    h_val[si, : v.shape[0]] = v
+                    h_ex[si, : v.shape[0]] = np.asarray(c.exists)
+            return [h_val, h_ex]
+
+        key = ("colabs", self.field, tuple(id(s) for s in seg_row), D)
+        return cache(key, fill), ()
+
+
+class VecsPrim(DataPrim):
+    """dense_vector slab for knn-as-query: vecs [S, D, dims] + exists
+    [S, D] (cached per segment round) + the query vector broadcast
+    [S, dims] (per-request data)."""
+
+    n_arrays = 3
+
+    def __init__(self, field: str, qvec):
+        self.field = field
+        self.qvec = np.asarray(qvec, np.float32)
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        dims = self.qvec.shape[0]
+
+        def fill():
+            h_vecs = np.zeros((S, D, dims), np.float32)
+            h_ex = np.zeros((S, D), bool)
+            for si, seg in enumerate(seg_row):
+                vc = seg.vectors.get(self.field) if seg is not None else None
+                if vc is not None:
+                    v = np.asarray(vc.vecs)
+                    h_vecs[si, : v.shape[0]] = v
+                    ex = np.asarray(vc.exists)
+                    h_ex[si, : ex.shape[0]] = ex
+            return [h_vecs, h_ex]
+
+        key = ("vecs", self.field, tuple(id(s) for s in seg_row), D, dims)
+        arrays = list(cache(key, fill))
+        arrays.append(np.broadcast_to(self.qvec, (S, dims)).copy())
+        return arrays, (dims,)
+
+
+class PhrasePrim(DataPrim):
+    """Per-shard inputs of the anchor-entry positional program
+    (ops/positional.py phrase_freq_program): anchors from the first query
+    term's positional entries, padded doc runs + positional CSR of every
+    other term, plus field lengths and (avg_len, idf_sum) scalars for
+    BM25 phrase scoring. Shards missing a term (or positions entirely)
+    contribute an all-invalid anchor block — no match, like the host
+    loop's per-segment empty result."""
+
+    n_arrays = 11
+
+    def __init__(self, field: str, toks: List[Tuple[str, int]]):
+        self.field = field
+        self.toks = toks  # [(term, position)] — query-side, analyzer output
+
+    def build(self, seg_row, ctxs, D, S, cache):
+        M = len(self.toks) - 1
+        per_shard = []
+        A = R = 8
+        NP = NE = 8
+        for seg in seg_row:
+            inv = seg.inverted.get(self.field) if seg is not None else None
+            ok = (inv is not None and inv.positions is not None
+                  and inv.doc_ids_host is not None
+                  and all(inv.term_slice(t)[1] > 0 for t, _ in self.toks))
+            per_shard.append((inv, ok))
+            if ok:
+                t0 = self.toks[0][0]
+                s0, ln0 = inv.term_slice(t0)
+                A = max(A, int(inv.pos_offsets[s0 + ln0]
+                               - inv.pos_offsets[s0]))
+                R = max(R, max(inv.term_slice(t)[1]
+                               for t, _ in self.toks[1:]))
+                NP = max(NP, int(inv.positions.shape[0]))
+                NE = max(NE, int(inv.pos_offsets.shape[0]))
+        A, R = pow2_bucket(A), pow2_bucket(R)
+        NP, NE = pow2_bucket(NP), pow2_bucket(NE)
+
+        def fill():
+            h_adoc = np.full((S, A), D, np.int32)
+            h_apos = np.zeros((S, A), np.int32)
+            h_aval = np.zeros((S, A), bool)
+            h_runs = np.full((S, M, R), D, np.int32)
+            h_rstart = np.zeros((S, M), np.int32)
+            h_rlen = np.zeros((S, M), np.int32)
+            h_delta = np.zeros((S, M), np.int32)
+            h_pos = np.zeros((S, NP), np.int32)
+            h_offs = np.zeros((S, NE), np.int32)
+            h_len = np.zeros((S, D), np.float32)
+            d0 = self.toks[0][1]
+            for si, ((inv, ok), ctx) in enumerate(zip(per_shard, ctxs)):
+                if not ok or ctx is None:
+                    continue
+                counts = np.diff(inv.pos_offsets).astype(np.int64)
+                doc_per_pos = np.repeat(
+                    inv.doc_ids_host[: counts.shape[0]], counts)
+                t0 = self.toks[0][0]
+                s0, ln0 = inv.term_slice(t0)
+                p_lo = int(inv.pos_offsets[s0])
+                p_hi = int(inv.pos_offsets[s0 + ln0])
+                n_anchor = p_hi - p_lo
+                h_apos[si, :n_anchor] = inv.positions[p_lo:p_hi]
+                h_adoc[si, :n_anchor] = doc_per_pos[p_lo:p_hi]
+                h_aval[si, :n_anchor] = True
+                for j, (t, d) in enumerate(self.toks[1:]):
+                    s, ln = inv.term_slice(t)
+                    h_runs[si, j, :ln] = inv.doc_ids_host[s: s + ln]
+                    h_rstart[si, j] = s
+                    h_rlen[si, j] = ln
+                    h_delta[si, j] = d - d0
+                npos = int(inv.positions.shape[0])
+                h_pos[si, :npos] = inv.positions
+                ne = int(inv.pos_offsets.shape[0])
+                h_offs[si, :ne] = inv.pos_offsets
+                h_offs[si, ne:] = inv.pos_offsets[-1]
+                fl = ctx.segment.field_lengths.get(self.field)
+                if fl is not None:
+                    flv = np.asarray(fl)
+                    h_len[si, : flv.shape[0]] = flv
+            return [h_adoc, h_apos, h_aval, h_runs, h_rstart, h_rlen,
+                    h_delta, h_pos, h_offs, h_len]
+
+        key = ("phrase", self.field, tuple(t for t, _ in self.toks),
+               tuple(d for _, d in self.toks),
+               tuple(id(s) for s in seg_row), A, R, NP, NE, D)
+        arrays = list(cache(key, fill))
+        # idf depends on global_stats (dfs) — per-request, never cached
+        h_stats = np.zeros((S, 2), np.float32)
+        for si, ((inv, ok), ctx) in enumerate(zip(per_shard, ctxs)):
+            if not ok or ctx is None:
+                continue
+            h_stats[si, 0] = inv.avg_len
+            h_stats[si, 1] = sum(
+                ctx.idf(self.field, t)
+                for t in dict.fromkeys(t for t, _ in self.toks))
+        arrays.append(h_stats)
+        return arrays, (M,)
 
 
 class AggTermsPrim(DataPrim):
@@ -669,6 +873,341 @@ class EBool(Emit):
         return scores * mask, mask
 
 
+class EPhrase(Emit):
+    """match_phrase via the device positional program (ops/positional.py)
+    — anchor-entry interval verification + BM25 phrase pseudo-term score,
+    identical math to MatchPhraseQuery.execute."""
+
+    def __init__(self, prim: int, slop: int, boost: float, D: int):
+        self.prim = prim
+        self.slop = slop
+        self.boost = boost
+        self.D = D
+
+    def key(self):
+        return ("phrase", self.slop, self.boost)
+
+    def ex(self, env, meta):
+        from elasticsearch_tpu.ops.positional import (phrase_freq_program,
+                                                      phrase_score)
+
+        jnp = _jnp()
+        (adoc, apos, aval, runs, rstart, rlen, delta, pos, offs,
+         lengths, stats) = env[self.prim]
+        freq = phrase_freq_program(adoc, apos, aval, runs, rstart, rlen,
+                                   delta, pos, offs, slop=self.slop,
+                                   D=self.D)
+        mask = freq > 0
+        scores = phrase_score(freq, lengths, stats[0], stats[1],
+                              D=self.D) * self.boost
+        return scores, mask
+
+
+class EKnn(Emit):
+    """knn-as-query: fused scores+mask+topk per shard (brute force; IVF
+    queries fall back to the host loop), candidates scattered back into the
+    (scores, mask) contract exactly like KnnQuery.execute."""
+
+    def __init__(self, prim: int, filt: Optional[Emit], live: int, kc: int,
+                 metric: str, boost: float, D: int):
+        self.prim = prim
+        self.filter = filt
+        self.live = live
+        self.kc = kc
+        self.metric = metric
+        self.boost = boost
+        self.D = D
+
+    def key(self):
+        return ("knn", self.kc, self.metric, self.boost,
+                self.filter.key() if self.filter is not None else None)
+
+    def ex(self, env, meta):
+        from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
+
+        jnp = _jnp()
+        vecs, exists, q = env[self.prim]
+        lv = exists & env[self.live][0]
+        if self.filter is not None:
+            _, fm = self.filter.ex(env, meta)
+            lv = lv & fm
+        vals, idx = knn_topk_auto(q[None, :], vecs, lv, k=self.kc,
+                                  metric=self.metric, precise=True)
+        valid = vals[0] > -jnp.inf
+        scores = jnp.zeros(self.D, jnp.float32).at[idx[0]].max(
+            jnp.where(valid, vals[0] * self.boost, 0.0), mode="drop")
+        mask = jnp.zeros(self.D, bool).at[idx[0]].max(valid, mode="drop")
+        return scores, mask
+
+
+class EDisMax(Emit):
+    def __init__(self, children: List[Emit], tie: float, boost: float,
+                 D: int):
+        self.children = children
+        self.tie = tie
+        self.boost = boost
+        self.D = D
+
+    def key(self):
+        return ("dismax", self.tie, self.boost,
+                tuple(c.key() for c in self.children))
+
+    def ex(self, env, meta):
+        jnp = _jnp()
+        parts = [c.sm(env, meta) for c in self.children]
+        mask = parts[0][1]
+        for _, m in parts[1:]:
+            mask = mask | m
+        stacked = jnp.stack([jnp.where(m, s, 0.0) for s, m in parts])
+        best = jnp.max(stacked, axis=0)
+        if self.tie > 0:
+            total = jnp.sum(stacked, axis=0)
+            best = best + self.tie * (total - best)
+        return best * self.boost * mask, mask
+
+
+class EBoosting(Emit):
+    def __init__(self, positive: Emit, negative: Emit, neg_boost: float,
+                 boost: float):
+        self.positive = positive
+        self.negative = negative
+        self.neg_boost = neg_boost
+        self.boost = boost
+
+    def key(self):
+        return ("boosting", self.neg_boost, self.boost,
+                self.positive.key(), self.negative.key())
+
+    def ex(self, env, meta):
+        jnp = _jnp()
+        s, mask = self.positive.sm(env, meta)
+        _, neg = self.negative.ex(env, meta)
+        s = jnp.where(neg, s * self.neg_boost, s)
+        return s * self.boost * mask, mask
+
+
+class FEmit:
+    """function_score function over env data — mirrors ScoreFunction."""
+
+    weight = 1.0
+    filter: Optional[Emit] = None
+
+    def key(self) -> tuple:
+        raise NotImplementedError
+
+    def value(self, env, meta, D):
+        raise NotImplementedError
+
+    def weighted(self, env, meta, D):
+        jnp = _jnp()
+        v = self.value(env, meta, D) * self.weight
+        if self.filter is not None:
+            _, fm = self.filter.ex(env, meta)
+            return v, fm
+        return v, jnp.ones(D, dtype=bool)
+
+    def _fkey(self):
+        return (self.weight,
+                self.filter.key() if self.filter is not None else None)
+
+
+class FWeight(FEmit):
+    def __init__(self, weight, filt):
+        self.weight = weight
+        self.filter = filt
+
+    def key(self):
+        return ("fw",) + self._fkey()
+
+    def value(self, env, meta, D):
+        jnp = _jnp()
+        return jnp.ones(D, dtype=jnp.float32)
+
+
+class FFieldValue(FEmit):
+    def __init__(self, prim, factor, modifier, missing, weight, filt):
+        self.prim = prim
+        self.factor = factor
+        self.modifier = modifier
+        self.missing = missing
+        self.weight = weight
+        self.filter = filt
+
+    def key(self):
+        return ("ffv", self.factor, self.modifier,
+                self.missing) + self._fkey()
+
+    def value(self, env, meta, D):
+        jnp = _jnp()
+        values, exists = env[self.prim]
+        v = jnp.where(exists, values,
+                      jnp.float32(self.missing if self.missing is not None
+                                  else 0.0))
+        v = v * self.factor
+        m = self.modifier
+        if m in ("none", None):
+            return v
+        if m == "log":
+            return jnp.log10(jnp.maximum(v, 1e-9))
+        if m == "log1p":
+            return jnp.log10(v + 1.0)
+        if m == "log2p":
+            return jnp.log10(v + 2.0)
+        if m == "ln":
+            return jnp.log(jnp.maximum(v, 1e-9))
+        if m == "ln1p":
+            return jnp.log1p(v)
+        if m == "ln2p":
+            return jnp.log(v + 2.0)
+        if m == "square":
+            return v * v
+        if m == "sqrt":
+            return jnp.sqrt(jnp.maximum(v, 0.0))
+        if m == "reciprocal":
+            return 1.0 / jnp.maximum(v, 1e-9)
+        raise MeshCompileError(f"field_value_factor modifier [{m}]")
+
+
+class FDecay(FEmit):
+    def __init__(self, prim, kind, origin, scale, offset, decay, weight,
+                 filt):
+        self.prim = prim
+        self.kind = kind
+        self.origin = origin
+        self.scale = scale
+        self.offset = offset
+        self.decay = decay
+        self.weight = weight
+        self.filter = filt
+
+    def key(self):
+        return ("fdecay", self.kind, self.origin, self.scale, self.offset,
+                self.decay) + self._fkey()
+
+    def value(self, env, meta, D):
+        jnp = _jnp()
+        values, exists = env[self.prim]
+        dist = jnp.maximum(
+            jnp.abs(values - jnp.float32(self.origin))
+            - jnp.float32(self.offset), 0.0)
+        decay = jnp.float32(self.decay)
+        scale_f = jnp.float32(self.scale)
+        if self.kind == "gauss":
+            sigma2 = -(scale_f ** 2) / (2.0 * jnp.log(decay))
+            out = jnp.exp(-(dist ** 2) / (2.0 * sigma2))
+        elif self.kind == "exp":
+            lam = jnp.log(decay) / scale_f
+            out = jnp.exp(lam * dist)
+        else:  # linear
+            s = scale_f / (1.0 - decay)
+            out = jnp.maximum((s - dist) / s, 0.0)
+        return jnp.where(exists, out, jnp.float32(1.0))
+
+
+class FRandom(FEmit):
+    def __init__(self, seed, weight, filt):
+        self.seed = int(seed)
+        self.weight = weight
+        self.filter = filt
+
+    def key(self):
+        return ("frand", self.seed) + self._fkey()
+
+    def value(self, env, meta, D):
+        from elasticsearch_tpu.utils.hashing import hash32_device
+
+        jnp = _jnp()
+        x = hash32_device(jnp.arange(D, dtype=jnp.uint32)
+                          + jnp.uint32(self.seed))
+        return (x.astype(jnp.float32) / jnp.float32(2 ** 32)).astype(
+            jnp.float32)
+
+
+class EFuncScore(Emit):
+    """function_score — same combination algebra as FunctionScoreQuery
+    (search/function_score.py), over env-resolved functions."""
+
+    def __init__(self, child: Emit, functions: List[FEmit], score_mode: str,
+                 boost_mode: str, max_boost, min_score, boost: float,
+                 D: int):
+        self.child = child
+        self.functions = functions
+        self.score_mode = score_mode
+        self.boost_mode = boost_mode
+        self.max_boost = max_boost
+        self.min_score = min_score
+        self.boost = boost
+        self.D = D
+
+    def key(self):
+        return ("fscore", self.score_mode, self.boost_mode, self.max_boost,
+                self.min_score, self.boost, self.child.key(),
+                tuple(f.key() for f in self.functions))
+
+    def ex(self, env, meta):
+        jnp = _jnp()
+        D = self.D
+        scores, mask = self.child.sm(env, meta)
+        if not self.functions:
+            return scores * self.boost, mask
+        pairs = [f.weighted(env, meta, D) for f in self.functions]
+        sm = self.score_mode
+        any_match = pairs[0][1]
+        for _, m in pairs[1:]:
+            any_match = any_match | m
+        if sm == "multiply":
+            fv = jnp.ones(D, dtype=jnp.float32)
+            for v, m in pairs:
+                fv = fv * jnp.where(m, v, 1.0)
+        elif sm in ("sum", "avg"):
+            fv = jnp.zeros(D, dtype=jnp.float32)
+            nm = jnp.zeros(D, dtype=jnp.float32)
+            for v, m in pairs:
+                fv = fv + jnp.where(m, v, 0.0)
+                nm = nm + m.astype(jnp.float32)
+            if sm == "avg":
+                fv = fv / jnp.maximum(nm, 1.0)
+        elif sm == "max":
+            fv = jnp.full(D, -jnp.inf, dtype=jnp.float32)
+            for v, m in pairs:
+                fv = jnp.maximum(fv, jnp.where(m, v, -jnp.inf))
+        elif sm == "min":
+            fv = jnp.full(D, jnp.inf, dtype=jnp.float32)
+            for v, m in pairs:
+                fv = jnp.minimum(fv, jnp.where(m, v, jnp.inf))
+        elif sm == "first":
+            fv = jnp.ones(D, dtype=jnp.float32)
+            taken = jnp.zeros(D, dtype=bool)
+            for v, m in pairs:
+                use = m & ~taken
+                fv = jnp.where(use, v, fv)
+                taken = taken | m
+        else:
+            raise MeshCompileError(f"score_mode [{sm}]")
+        fv = jnp.where(any_match, fv, jnp.float32(1.0))
+        if self.max_boost is not None:
+            fv = jnp.minimum(fv, jnp.float32(self.max_boost))
+        bm = self.boost_mode
+        if bm == "multiply":
+            out = scores * fv
+        elif bm == "replace":
+            out = fv
+        elif bm == "sum":
+            out = scores + fv
+        elif bm == "avg":
+            out = (scores + fv) / 2.0
+        elif bm == "max":
+            out = jnp.maximum(scores, fv)
+        elif bm == "min":
+            out = jnp.minimum(scores, fv)
+        else:
+            raise MeshCompileError(f"boost_mode [{bm}]")
+        out = out * self.boost
+        if self.min_score is not None:
+            mask = mask & (out >= self.min_score)
+        return out * mask, mask
+
+
 # ---------------------------------------------------------------------------
 # compiler
 # ---------------------------------------------------------------------------
@@ -680,7 +1219,7 @@ class CompiledMeshQuery:
 
     def __init__(self, root: Emit, prims: List[DataPrim], live: int, nd: int,
                  D: int, sort_prim: Optional[int], sort_cfg: Optional[tuple],
-                 agg_prims: List[Tuple[str, int]]):
+                 agg_prims: List[Tuple[str, int]], want_mask: bool = False):
         self.root = root
         self.prims = prims
         self.live = live
@@ -689,15 +1228,21 @@ class CompiledMeshQuery:
         self.sort_prim = sort_prim
         self.sort_cfg = sort_cfg  # (desc, missing_first) or None
         self.agg_prims = agg_prims  # [(agg_name, prim_idx)]
+        # also return the per-shard match mask [S, D] — the host-side agg
+        # collectors consume it, so any aggregation (not just device terms
+        # counts) runs off the mesh query phase without a full fallback
+        self.want_mask = want_mask
 
     def struct_key(self):
         return (self.root.key(), self.D, self.sort_prim is not None,
-                self.sort_cfg, tuple(name for name, _ in self.agg_prims))
+                self.sort_cfg, tuple(name for name, _ in self.agg_prims),
+                self.want_mask)
 
 
 class MeshQueryCompiler:
     def __init__(self, mappings, analysis, global_stats=None, D: int = 0,
-                 has_dense: Optional[Callable[[str], bool]] = None):
+                 has_dense: Optional[Callable[[str], bool]] = None,
+                 col_everywhere: Optional[Callable[[str], bool]] = None):
         self.mappings = mappings
         self.analysis = analysis
         self.gs = global_stats
@@ -707,6 +1252,11 @@ class MeshQueryCompiler:
         # hybrid MXU-matmul + scatter-tail path (mirror of the host loop's
         # ctx.hybrid_slices dispatch, ops/scoring.py:94)
         self.has_dense = has_dense or (lambda field: False)
+        # col_everywhere(field) → True when every segment of the round has
+        # the numeric column (function_score without [missing] raises on a
+        # column-less segment in the host loop — a per-shard condition the
+        # traced program can't reproduce, so such rounds fall back)
+        self.col_everywhere = col_everywhere or (lambda field: False)
         self.prims: List[DataPrim] = []
         self._postings: Dict[str, int] = {}
 
@@ -720,30 +1270,44 @@ class MeshQueryCompiler:
         return self._postings[field]
 
     def compile(self, query, sort_spec: Optional[list],
-                agg_specs: Optional[list]) -> CompiledMeshQuery:
+                agg_specs: Optional[list],
+                want_mask: bool = False) -> CompiledMeshQuery:
         live = self._add(LivePrim())
         nd = self._add(NumDocsPrim())
         self._nd = nd
+        self._live = live
         root = self._c(query)
         sort_prim = None
         sort_cfg = None
         if sort_spec:
-            if len(sort_spec) != 1:
-                raise MeshCompileError("multi-key sort")
+            # device preselect ranks on the PRIMARY key only (oversampled);
+            # the exact multi-key ordering happens on host over the full
+            # value tuples (mesh_service), mirroring the host loop's
+            # _sorted_candidates two-stage sort
             s = sort_spec[0]
-            if s["field"] == "_score":
-                raise MeshCompileError("explicit _score sort")
+            if s["field"] in ("_score", "_geo_distance"):
+                raise MeshCompileError(f"{s['field']} primary sort")
+            # _score as ANY key needs the score vector at fetch time, which
+            # sorted mesh candidates don't carry (their val is the primary
+            # rank) — host loop handles it (_geo_distance secondaries are
+            # fine: _sort_value computes them from columns)
+            if any(x["field"] == "_score" for x in sort_spec[1:]):
+                raise MeshCompileError("_score secondary sort")
             fm = self.mappings.get(s["field"])
-            if fm is None or not fm.is_numeric:
-                raise MeshCompileError("non-numeric sort field")
-            sort_prim = self._add(SortColPrim(s["field"]))
+            if fm is not None and fm.is_numeric:
+                sort_prim = self._add(SortColPrim(s["field"]))
+            elif fm is not None and fm.is_keyword:
+                sort_prim = self._add(SortOrdPrim(s["field"]))
+            else:
+                raise MeshCompileError("unsortable primary sort field")
             sort_cfg = (s["order"] == "desc",
                         str(s.get("missing", "_last")) == "_first")
         agg_prims: List[Tuple[str, int]] = []
         for name, field in (agg_specs or []):
             agg_prims.append((name, self._add(AggTermsPrim(field))))
         return CompiledMeshQuery(root, self.prims, live, nd, self.D,
-                                 sort_prim, sort_cfg, agg_prims)
+                                 sort_prim, sort_cfg, agg_prims,
+                                 want_mask=want_mask)
 
     # -- tree walk (mirrors search/queries.py execute semantics) -------------
 
@@ -816,7 +1380,120 @@ class MeshQueryCompiler:
                          self._nd, D)
         if isinstance(q, Q.ConstantScoreQuery):
             return EConstScore(self._c(q.inner), q.boost)
+        if isinstance(q, Q.MatchPhraseQuery):
+            return self._phrase(q)
+        if isinstance(q, Q.KnnQuery):
+            return self._knn(q)
+        if isinstance(q, Q.DisMaxQuery):
+            if not q.queries:
+                return ENone(D)
+            return EDisMax([self._c(c) for c in q.queries],
+                           q.tie_breaker, q.boost, D)
+        if isinstance(q, Q.BoostingQuery):
+            return EBoosting(self._c(q.positive), self._c(q.negative),
+                             q.negative_boost, q.boost)
+        from elasticsearch_tpu.search.function_score import FunctionScoreQuery
+
+        if isinstance(q, FunctionScoreQuery):
+            return self._function_score(q)
         raise MeshCompileError(f"unsupported query type {type(q).__name__}")
+
+    def _search_analyzer(self, field: str):
+        fm = self.mappings.get(field)
+        if fm is None or not fm.is_text:
+            return None
+        return self.analysis.get(fm.search_analyzer or fm.analyzer)
+
+    def _phrase(self, q) -> Emit:
+        fm = self.mappings.get(q.field)
+        if fm is None or not fm.is_text:
+            # host loop: no positions → empty; keep the conservative
+            # fallback rather than guessing keyword-field semantics
+            raise MeshCompileError("match_phrase on non-text field")
+        an = self._search_analyzer(q.field)
+        toks = an.analyze(str(q.text)) if an else [(str(q.text), 0)]
+        if not toks:
+            return ENone(self.D)
+        if len(toks) == 1:
+            t0 = toks[0][0]
+            return self._tgroup_scores(q.field, q.boost,
+                                       lambda ctx, t=t0: ([t], None))
+        prim = self._add(PhrasePrim(q.field, [(t, p) for t, p in toks]))
+        return EPhrase(prim, int(q.slop), q.boost, self.D)
+
+    def _knn(self, q) -> Emit:
+        fm = self.mappings.get(q.field)
+        use_ann = bool(q.ann) if q.ann is not None else (
+            fm is not None and bool(getattr(fm, "index_options", None))
+            and fm.index_options.get("type") in ("ivf", "ivf_flat"))
+        if use_ann:
+            raise MeshCompileError("knn via IVF")  # host loop probes IVF
+        dims = getattr(fm, "dims", None) if fm is not None else None
+        if fm is None or not dims:
+            return ENone(self.D)  # unmapped vector field: empty everywhere
+        if len(q.vector) != int(dims):
+            from elasticsearch_tpu.utils.errors import QueryParsingException
+
+            raise QueryParsingException(
+                f"knn query vector has {len(q.vector)} dims but field "
+                f"[{q.field}] is mapped with {dims}")
+        filt = self._c(q.filter) if q.filter is not None else None
+        prim = self._add(VecsPrim(q.field, q.vector))
+        kc = int(min(max(q.num_candidates, q.k), self.D))
+        metric = getattr(fm, "similarity", None) or "cosine"
+        return EKnn(prim, filt, self._live, kc, metric, q.boost, self.D)
+
+    def _function_score(self, q) -> Emit:
+        from elasticsearch_tpu.search import function_score as FS
+        from elasticsearch_tpu.utils.dates import (interval_to_millis,
+                                                   parse_date)
+
+        child = self._c(q.inner)
+        fns: List[FEmit] = []
+        for f in q.functions:
+            filt = self._c(f.filter) if f.filter is not None else None
+            if type(f) is FS.WeightFunction:
+                fns.append(FWeight(f.weight, filt))
+            elif type(f) is FS.FieldValueFactorFunction:
+                fm = self.mappings.get(f.field)
+                if fm is None or not fm.is_numeric:
+                    raise MeshCompileError("field_value_factor field")
+                if f.missing is None and not self.col_everywhere(f.field):
+                    # host loop raises on a column-less segment; a traced
+                    # program can't — fall back for exact error parity
+                    raise MeshCompileError(
+                        "field_value_factor without [missing] on a round "
+                        "with column-less segments")
+                prim = self._add(ColPrim(f.field))
+                fns.append(FFieldValue(prim, float(f.factor), f.modifier,
+                                       f.missing, f.weight, filt))
+            elif type(f) is FS.DecayFunction:
+                fm = self.mappings.get(f.field)
+                if fm is None or not fm.is_numeric:
+                    raise MeshCompileError("decay field")
+                if fm.type == "date":
+                    if f.origin in (None, "now"):
+                        raise MeshCompileError("decay origin now/None")
+                    origin = float(parse_date(f.origin, fm.fmt))
+                    scale = (interval_to_millis(f.scale)
+                             if isinstance(f.scale, str) else float(f.scale))
+                    offset = (interval_to_millis(f.offset)
+                              if isinstance(f.offset, str)
+                              else float(f.offset or 0))
+                else:
+                    origin = float(f.origin)
+                    scale = float(f.scale)
+                    offset = float(f.offset or 0)
+                prim = self._add(ColPrim(f.field))
+                fns.append(FDecay(prim, f.kind, origin, scale, offset,
+                                  float(f.decay), f.weight, filt))
+            elif type(f) is FS.RandomScoreFunction:
+                fns.append(FRandom(f.seed, f.weight, filt))
+            else:
+                raise MeshCompileError(
+                    f"function_score function {type(f).__name__}")
+        return EFuncScore(child, fns, q.score_mode, q.boost_mode,
+                          q.max_boost, q.min_score, q.boost, self.D)
 
     def _tgroup_scores(self, field: str, boost: float, base_terms_fn) -> Emit:
         """Scoring term group (mask = scores > 0): weights = idf*boost,
@@ -882,12 +1559,7 @@ class MeshQueryCompiler:
         cls = ETermGroupHybrid if hybrid else ETermGroup
         # the analyzer output is query-side — identical on every shard, so
         # n_terms/msm thresholds are static (resolve once with the analyzer)
-        an = self.analysis.get(
-            (self.mappings.get(field).search_analyzer
-             or self.mappings.get(field).analyzer)
-            if self.mappings.get(field) is not None
-            and self.mappings.get(field).is_text else None) \
-            if self.mappings.get(field) is not None and self.mappings.get(field).is_text else None
+        an = self._search_analyzer(field)
         toks = ([t for t, _ in an.analyze(str(q.text))] if an is not None
                 else [str(q.text)])
         n_terms = len(set(toks))

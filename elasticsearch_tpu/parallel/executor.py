@@ -191,7 +191,12 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
         av = lax.all_gather(vals, "shard")  # [S, k]
         ai = lax.all_gather(idx, "shard")
         S = av.shape[0]
-        gvals, gpos = lax.top_k(av.reshape(S * k), k)
+        # field-sorted queries keep EVERY per-shard candidate: the device
+        # rank is a primary-key preselect only, and a global top-k by that
+        # rank would drop tied docs the full tuple ranks higher (the host
+        # staging in mesh_service does the exact ordering)
+        kg = S * k if compiled.sort_prim is not None else k
+        gvals, gpos = lax.top_k(av.reshape(S * k), kg)
         gslot = (gpos // k).astype(jnp.int32)
         glocal = ai.reshape(S * k)[gpos].astype(jnp.int32)
         outs = [gvals, gslot, glocal, totals]
@@ -202,12 +207,14 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
             cnts = jnp.zeros(vmax + 1, jnp.float32).at[term_ids].add(
                 w.astype(jnp.float32), mode="drop")
             outs.append(cnts[None, :])  # keep per-shard partials
+        if compiled.want_mask:
+            outs.append(mask[None, :])  # [S, D] sharded, for host-side aggs
         return tuple(outs)
 
     n_in = sum(counts)
     in_specs = tuple(PS("shard") for _ in range(n_in))
     out_specs = (PS(), PS(), PS(), PS()) + tuple(
-        PS("shard") for _ in range(n_aggs))
+        PS("shard") for _ in range(n_aggs + (1 if compiled.want_mask else 0)))
     fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_rep=False)
     return jax.jit(fn)
@@ -439,15 +446,19 @@ class MeshSearchExecutor:
 
     def search_dsl(self, body_query, mappings, analysis, k: int,
                    sort_spec=None, agg_specs=None, global_stats=None,
-                   shards=None):
+                   shards=None, want_mask: bool = False):
         """Execute a compiled query DSL tree over the mesh.
 
-        Returns (cands, totals, agg_rounds) where cands is a list of
-        (val, shard, seg_ord, local) for the global top candidates
+        Returns (cands, totals, agg_rounds, mask_rounds) where cands is a
+        list of (val, shard, seg_ord, local) for the global top candidates
         (k oversampled ×4 when sorting, mirroring the host path), totals is
-        the exact hit count (psum), and agg_rounds maps agg name → list of
+        the exact hit count (psum), agg_rounds maps agg name → list of
         (shard, seg_ord, segment, counts np[V]) per segment for the host
-        reduce phase. Raises MeshCompileError for unsupported queries.
+        reduce phase, and mask_rounds (when want_mask) is a list of
+        (shard, seg_ord, segment, mask np[seg.max_docs]) — the program's
+        match mask, consumed by host-side agg collectors so arbitrary
+        aggregations run off the mesh query phase. Raises MeshCompileError
+        for unsupported queries.
         """
         from elasticsearch_tpu.parallel.compiler import MeshQueryCompiler
         from elasticsearch_tpu.search.context import SegmentContext
@@ -460,6 +471,7 @@ class MeshSearchExecutor:
         merged: List[tuple] = []
         totals = 0
         agg_rounds: Dict[str, list] = {}
+        mask_rounds: List[tuple] = []
         k_dev = k if not sort_spec else min(max(k * 4, 128), 1 << 20)
         for row in rows:
             seg_row = [e[2] if e is not None else None for e in row]
@@ -479,9 +491,14 @@ class MeshSearchExecutor:
                         return True
                 return False
 
+            def col_everywhere(field, _row=seg_row):
+                return all(s is None or field in s.numerics for s in _row)
+
             comp = MeshQueryCompiler(mappings, analysis, global_stats, D=D,
-                                     has_dense=has_dense)
-            compiled = comp.compile(body_query, sort_spec, agg_specs)
+                                     has_dense=has_dense,
+                                     col_everywhere=col_everywhere)
+            compiled = comp.compile(body_query, sort_spec, agg_specs,
+                                    want_mask=want_mask)
             self._record_tgroup_kernels(compiled)
 
             # build per-prim data + statics; cacheable groups are device-put
@@ -521,18 +538,28 @@ class MeshSearchExecutor:
                 if np.isfinite(v):
                     merged.append((float(v), lut_shard[int(sl)],
                                    lut_ord[int(sl)], int(lc)))
-            for (name, _prim), acounts in zip(compiled.agg_prims, out[4:]):
+            n_aggs = len(compiled.agg_prims)
+            for (name, _prim), acounts in zip(compiled.agg_prims,
+                                              out[4:4 + n_aggs]):
                 ac = np.asarray(acounts)  # [S, Vmax+1]
                 for si, seg in enumerate(seg_row):
                     if seg is None:
                         continue
                     agg_rounds.setdefault(name, []).append(
                         (lut_shard[si], lut_ord[si], seg, ac[si]))
+            if want_mask:
+                mk = np.asarray(out[4 + n_aggs])  # [S, D]
+                for si, seg in enumerate(seg_row):
+                    if seg is None:
+                        continue
+                    mask_rounds.append((lut_shard[si], lut_ord[si], seg,
+                                        mk[si, : seg.max_docs]))
         if sort_spec:
-            # field-sorted: the exact ordering happens on host over the full
-            # value tuples (mesh_service); rank order here is the preselect
-            merged.sort(key=lambda t: (-t[0], t[1], t[2], t[3]))
-            return merged[:k_dev], totals, agg_rounds
+            # field-sorted: every per-shard candidate goes back — the exact
+            # full-tuple ordering AND truncation happen on host
+            # (mesh_service staging); a rank-based cut here would be
+            # tie-blind on the primary key
+            return merged, totals, agg_rounds, mask_rounds
         # mirror the host loop exactly: per-shard candidates merge in
         # (-score, seg, local) order and truncate at k (query_phase), THEN
         # the global merge orders by (-score, shard, local) with the
@@ -546,7 +573,7 @@ class MeshSearchExecutor:
             lst.sort(key=lambda t: (-t[0], t[2], t[3]))
             out.extend(lst[:k])
         out.sort(key=lambda t: (-t[0], t[1], t[3]))  # stable: seg order kept
-        return out[:k_dev], totals, agg_rounds
+        return out[:k_dev], totals, agg_rounds, mask_rounds
 
     @staticmethod
     def _record_tgroup_kernels(compiled) -> None:

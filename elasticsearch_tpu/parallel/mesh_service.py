@@ -19,9 +19,11 @@ import numpy as np
 from elasticsearch_tpu.parallel.compiler import MeshCompileError
 
 
-# host-loop-only request features: their presence skips the mesh path
+# host-loop-only request features: their presence skips the mesh path.
+# highlight is NOT here: it is a fetch-phase feature and the mesh path's
+# fetch_phase handles it like the host loop does.
 _UNSUPPORTED_KEYS = ("rescore", "search_after", "min_score", "scroll",
-                     "profile", "highlight")
+                     "profile")
 
 
 def try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[dict]:
@@ -53,11 +55,14 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
             if seg.has_nested:
                 return None
     aggs = parse_aggs(body.get("aggs") or body.get("aggregations"))
-    agg_specs = []
-    for a in aggs or []:
-        if not _terms_agg_eligible(a, svc.mappings):
-            return None
-        agg_specs.append((a.name, a.body.get("field")))
+    # terms aggs without subs reduce fully on device; ANY other agg tree
+    # consumes the program's match mask through the host-side collectors —
+    # the query phase stays one mesh program either way
+    device_aggs = bool(aggs) and all(_terms_agg_eligible(a, svc.mappings)
+                                     for a in aggs)
+    agg_specs = ([(a.name, a.body.get("field")) for a in aggs]
+                 if device_aggs else None)
+    want_mask = bool(aggs) and not device_aggs
     sort_spec = _parse_sort(body.get("sort"))
     query = parse_query(body.get("query"))
     t0 = time.perf_counter()
@@ -66,10 +71,11 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
         return None
     k = max(frm + size, 1)
     try:
-        cands, totals, agg_rounds = executor.search_dsl(
+        cands, totals, agg_rounds, mask_rounds = executor.search_dsl(
             query, svc.mappings, svc.analysis, k,
             sort_spec=sort_spec or None, agg_specs=agg_specs or None,
-            global_stats=global_stats, shards=shard_segs)
+            global_stats=global_stats, shards=shard_segs,
+            want_mask=want_mask)
     except MeshCompileError:
         return None
     q_ms = (time.perf_counter() - t0) * 1000
@@ -98,7 +104,25 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
         docs.append(d)
     if sort_spec:
         # exact host ordering on the full value tuple (device rank is the
-        # f32 preselect, like the host loop's _sorted_candidates)
+        # f32 preselect, like the host loop's _sorted_candidates), staged
+        # the way the host loop stages it: per-segment full-tuple top-k,
+        # per-shard top-k, then the global merge — a global primary-rank
+        # truncation would drop tied docs the full tuple ranks higher
+        k_req = frm + size
+        by_seg: Dict[tuple, List[ShardDoc]] = {}
+        for d in docs:
+            by_seg.setdefault((d.shard_ord, d._seg_ord), []).append(d)
+        per_shard: Dict[int, List[ShardDoc]] = {}
+        for (sh, _so), ds in sorted(by_seg.items()):
+            ds.sort(key=lambda d: (_sort_key(d.sort_values, sort_spec),
+                                   d.local_id))
+            per_shard.setdefault(sh, []).extend(ds[:k_req])
+        docs = []
+        for sh in sorted(per_shard):
+            ds = per_shard[sh]
+            ds.sort(key=lambda d: (_sort_key(d.sort_values, sort_spec),
+                                   d._seg_ord, d.local_id))
+            docs.extend(ds[:k_req])
         docs.sort(key=lambda d: (_sort_key(d.sort_values, sort_spec),
                                  d.shard_ord, d._seg_ord, d.local_id))
     page = docs[frm: frm + size]
@@ -133,7 +157,23 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
         },
     }
     if aggs:
-        partial_lists = _agg_partials(aggs, agg_rounds, shard_segs)
+        if device_aggs:
+            partial_lists = _agg_partials(aggs, agg_rounds, shard_segs)
+        else:
+            # arbitrary agg trees: host collectors over the program's mask
+            # (same per-segment device reductions as the host loop — only
+            # the query scoring isn't recomputed)
+            import jax.numpy as jnp
+
+            from elasticsearch_tpu.search.aggregations import run_aggs
+
+            partial_lists = []
+            for sh, seg_ord, seg, mask in mask_rounds:
+                ctx = SegmentContext(seg, svc.mappings, svc.analysis,
+                                     global_stats,
+                                     all_segments=shard_segs[sh],
+                                     index_name=svc.name)
+                partial_lists.append(run_aggs(aggs, ctx, jnp.asarray(mask)))
         response["aggregations"] = reduce_aggs(aggs, partial_lists)
     return response
 

@@ -23,7 +23,8 @@ def node():
                              "body": {"type": "text"},
                              "tag": {"type": "keyword"},
                              "n": {"type": "long"},
-                             "d": {"type": "date"}}}})
+                             "d": {"type": "date"},
+                             "emb": {"type": "dense_vector", "dims": 8}}}})
     svc = n.indices["m"]
     rng = random.Random(3)
     words = ["alpha", "beta", "gamma", "delta", "fox", "dog", "cat"]
@@ -31,7 +32,8 @@ def node():
         svc.index_doc(str(i), {"body": " ".join(rng.choices(words, k=6)),
                                "tag": rng.choice(["red", "green", "blue"]),
                                "n": rng.randint(0, 50),
-                               "d": f"2020-01-{(i % 28) + 1:02d}"})
+                               "d": f"2020-01-{(i % 28) + 1:02d}",
+                               "emb": [rng.random() for _ in range(8)]})
     svc.refresh()
     # a second refresh round → several segments per shard (multiple rounds)
     for i in range(300, 400):
@@ -58,8 +60,22 @@ def mesh_vs_host(node, body, index="m"):
             assert hm["_score"] is None
         else:
             assert abs(hm["_score"] - hh["_score"]) < 1e-5
+        assert hm.get("highlight") == hh.get("highlight")
     assert r_mesh.get("aggregations") == r_host.get("aggregations")
     return r_mesh
+
+
+def test_mesh_fallback_near_zero(node):
+    """The r2 'done' criterion: over the whole equivalence suite the mesh
+    must serve (mesh_fallback_total == 0) — widening is real, not claimed."""
+    from elasticsearch_tpu.monitor import kernels
+
+    kernels.reset()
+    for _name, body in QUERIES:
+        node.search("m", body)
+    snap = kernels.snapshot()
+    assert snap.get("mesh_search", 0) == len(QUERIES), snap
+    assert snap.get("mesh_fallback_total", 0) == 0, snap
 
 
 QUERIES = [
@@ -97,6 +113,67 @@ QUERIES = [
                    "size": 6, "from": 3}),
     ("agg_only", {"query": {"match": {"body": "dog"}}, "size": 0,
                   "aggs": {"tags": {"terms": {"field": "tag", "size": 2}}}}),
+    # -- r4 widening: phrase / knn / function_score / dis_max / boosting ---
+    ("phrase", {"query": {"match_phrase": {"body": "fox dog"}}, "size": 6}),
+    ("phrase_slop", {"query": {"match_phrase": {
+        "body": {"query": "alpha gamma", "slop": 2}}}, "size": 6}),
+    ("knn_query", {"query": {"knn": {"field": "emb",
+                                     "query_vector": [0.5] * 8,
+                                     "k": 5, "num_candidates": 40}},
+                   "size": 5}),
+    ("knn_filtered", {"query": {"knn": {"field": "emb",
+                                        "query_vector": [0.3] * 8,
+                                        "k": 5, "num_candidates": 40,
+                                        "filter": {"term": {"tag": "red"}}}},
+                      "size": 5}),
+    ("dis_max", {"query": {"dis_max": {"tie_breaker": 0.3, "queries": [
+        {"match": {"body": "fox"}}, {"match": {"body": "cat"}}]}}}),
+    ("boosting", {"query": {"boosting": {
+        "positive": {"match": {"body": "fox"}},
+        "negative": {"term": {"tag": "blue"}}, "negative_boost": 0.4}}}),
+    ("fs_weight", {"query": {"function_score": {
+        "query": {"match": {"body": "fox"}},
+        "functions": [{"weight": 2.5, "filter": {"term": {"tag": "red"}}}]}}}),
+    ("fs_fvf", {"query": {"function_score": {
+        "query": {"match": {"body": "dog"}},
+        "field_value_factor": {"field": "n", "modifier": "log1p",
+                               "missing": 1.0}}}}),
+    ("fs_decay", {"query": {"function_score": {
+        "query": {"match": {"body": "fox"}},
+        "gauss": {"n": {"origin": 25, "scale": 10}},
+        "boost_mode": "multiply"}}}),
+    ("fs_random", {"query": {"function_score": {
+        "query": {"match": {"body": "cat"}},
+        "random_score": {"seed": 7}, "boost_mode": "replace"}}, "size": 6}),
+    # -- r4 widening: sorts -------------------------------------------------
+    ("sort_keyword", {"query": {"match_all": {}}, "sort": [{"tag": "asc"}],
+                      "size": 6}),
+    ("sort_multikey", {"query": {"match": {"body": "fox"}},
+                       "sort": [{"n": "asc"}, {"d": "desc"}], "size": 6}),
+    ("sort_kw_then_n", {"query": {"match_all": {}},
+                        "sort": [{"tag": "desc"}, {"n": "asc"}], "size": 6}),
+    # -- r4 widening: aggs via the program mask -----------------------------
+    ("agg_hist", {"query": {"match": {"body": "dog"}}, "size": 0,
+                  "aggs": {"h": {"histogram": {"field": "n",
+                                               "interval": 10}}}}),
+    ("agg_range_stats", {"query": {"match_all": {}}, "size": 0, "aggs": {
+        "r": {"range": {"field": "n",
+                        "ranges": [{"to": 20}, {"from": 20}]}},
+        "s": {"stats": {"field": "n"}}}}),
+    ("agg_filters", {"query": {"match": {"body": "fox"}}, "size": 0,
+                     "aggs": {"f": {"filters": {"filters": {
+                         "red": {"term": {"tag": "red"}},
+                         "hi": {"range": {"n": {"gte": 25}}}}}}}}),
+    ("agg_terms_sub", {"query": {"match_all": {}}, "size": 0,
+                       "aggs": {"tags": {"terms": {"field": "tag"},
+                                         "aggs": {"avg_n": {
+                                             "avg": {"field": "n"}}}}}}),
+    ("agg_date_hist", {"query": {"match": {"body": "cat"}}, "size": 0,
+                       "aggs": {"dh": {"date_histogram": {
+                           "field": "d", "interval": "week"}}}}),
+    # -- r4 widening: highlight rides the mesh fetch phase ------------------
+    ("highlight", {"query": {"match": {"body": "fox"}}, "size": 4,
+                   "highlight": {"fields": {"body": {}}}}),
 ]
 
 
@@ -122,17 +199,18 @@ def test_mesh_path_actually_used(node):
 
 def test_unsupported_features_fall_back(node):
     """Host-loop-only features still answer correctly through fallback."""
-    r = node.search("m", {"query": {"match_phrase": {"body": "fox dog"}}})
-    assert "hits" in r
-    r = node.search("m", {"query": {"function_score": {
-        "query": {"match_all": {}}, "functions": [{"weight": 2.0}]}}})
-    assert "hits" in r
     r = node.search("m", {"query": {"match_all": {}}, "min_score": 0.5})
     assert "hits" in r
-    # multi-key sort falls back
-    r = node.search("m", {"query": {"match_all": {}},
-                          "sort": [{"n": "asc"}, {"d": "desc"}], "size": 3})
-    assert len(r["hits"]["hits"]) == 3
+    # _score as a secondary sort key: candidates from the sorted mesh path
+    # carry primary ranks, not scores — must fall back, not 500
+    r = mesh_vs_host(node, {"query": {"match": {"body": "fox"}},
+                            "sort": [{"n": "asc"}, "_score"], "size": 5})
+    assert len(r["hits"]["hits"]) == 5
+    # IVF knn (ann: true without an index) falls back to the host loop
+    r = node.search("m", {"query": {"knn": {"field": "emb",
+                                            "query_vector": [0.1] * 8,
+                                            "k": 3, "ann": True}}})
+    assert "hits" in r
 
 
 @pytest.fixture(scope="module")
