@@ -290,6 +290,15 @@ class Engine:
             for d in live_docs:
                 fresh.add(d)
             seg = fresh.freeze()
+            try:
+                self._charge_segment(seg)
+            except Exception:
+                # reclaim before giving up: merging away deleted docs is the
+                # one path that frees breaker budget, and it would otherwise
+                # be unreachable (maybe_merge only runs after a SUCCESSFUL
+                # refresh) — a tripped breaker must not wedge forever
+                self.maybe_merge()
+                self._charge_segment(seg)
             self.segments.append(seg)
             for doc_id, local in list(seg.id_map.items()):
                 loc = self._locations.get(doc_id)
@@ -338,7 +347,17 @@ class Engine:
                             doc_type=meta.get("_type"), parent=meta.get("_parent")))
             merged = builder.freeze()
             keep = [s for s in self.segments if s.seg_id not in target_ids]
+            # release-then-charge: a merge nets memory DOWN, so it charges
+            # unconditionally (force) — only NEW data (refresh) can trip
+            # the breaker
+            from elasticsearch_tpu.index.segment import SEGMENT_HBM_BUDGET
+
+            for s in targets:
+                SEGMENT_HBM_BUDGET.release(getattr(s, "_hbm_charged", 0))
+                s._hbm_charged = 0
             if merged is not None:
+                merged._hbm_charged = merged.memory_bytes()
+                SEGMENT_HBM_BUDGET.force(merged._hbm_charged)
                 keep.append(merged)
                 for doc_id, local in merged.id_map.items():
                     loc = self._locations.get(doc_id)
@@ -371,7 +390,27 @@ class Engine:
                     except DocumentMissingException:
                         pass
 
+    def _charge_segment(self, seg) -> None:
+        """Charge a fresh segment against the node HBM breaker; raises
+        CircuitBreakingException (429) when the budget would be exceeded —
+        the refresh fails, buffered docs stay buffered, the node survives."""
+        from elasticsearch_tpu.index.segment import SEGMENT_HBM_BUDGET
+        from elasticsearch_tpu.utils.errors import CircuitBreakingException
+
+        n = seg.memory_bytes()
+        if not SEGMENT_HBM_BUDGET.reserve(n):
+            raise CircuitBreakingException(
+                f"[segments] data for new segment would be "
+                f"[{SEGMENT_HBM_BUDGET.used + n}/{SEGMENT_HBM_BUDGET.total}]"
+                f" bytes, which is larger than the limit")
+        seg._hbm_charged = n
+
     def close(self):
+        from elasticsearch_tpu.index.segment import SEGMENT_HBM_BUDGET
+
+        for seg in self.segments:
+            SEGMENT_HBM_BUDGET.release(getattr(seg, "_hbm_charged", 0))
+            seg._hbm_charged = 0
         self.translog.close()
 
 

@@ -94,6 +94,12 @@ class HbmBudget:
             self.used += n
             return True
 
+    def force(self, n: int) -> None:
+        """Unconditional charge — for merges, which net-release memory and
+        must never fail on transient accounting order."""
+        with self._lock:
+            self.used += n
+
     def release(self, n: int) -> None:
         with self._lock:
             self.used = max(0, self.used - n)
@@ -101,6 +107,15 @@ class HbmBudget:
 
 # global budget shared by every segment's lazily-built dense blocks
 DENSE_IMPACT_BUDGET = HbmBudget()
+
+# node-wide breaker for segment HBM: every freeze charges the segment's
+# memory_bytes() against it; exhaustion fails the REQUEST with a typed
+# CircuitBreakingException instead of device-OOMing the node (reference:
+# common/breaker/CircuitBreaker.java — the fielddata/request breakers).
+# Merges release-then-charge and never trip (they net-shrink memory).
+SEGMENT_HBM_BUDGET = HbmBudget(
+    int(__import__("os").environ.get("ESTPU_SEGMENT_BUDGET_BYTES",
+                                     8 << 30)))
 
 
 def build_dense_impact(

@@ -39,8 +39,25 @@ class Node:
         self.repositories: Dict[str, Any] = {}
         self.cluster_state = ClusterState(cluster_name)
         self.cluster_state.add_node(DiscoveryNode(self.node_id, name), master=True)
+        # lazy: pools spin worker threads, so library-embedded Nodes that
+        # never serve REST traffic don't pay for them
+        self._thread_pool = None
+        self._tp_lock = __import__("threading").Lock()
         if data_path:
             self._gateway_recover()
+
+    @property
+    def thread_pool(self):
+        """Named request pools (reference: threadpool/ThreadPool.java).
+        Double-checked under a lock — concurrent first REST requests must
+        not each spin a registry of worker threads."""
+        if self._thread_pool is None:
+            from elasticsearch_tpu.utils.threadpool import ThreadPool
+
+            with self._tp_lock:
+                if self._thread_pool is None:
+                    self._thread_pool = ThreadPool()
+        return self._thread_pool
 
     # -- gateway ---------------------------------------------------------------
 
@@ -437,10 +454,28 @@ class Node:
                     # the honest numbers are the Python process's
                     "jvm": {"mem": {"heap_used_in_bytes":
                                     proc["mem"]["resident_in_bytes"]}},
+                    # don't force pool creation just to report stats — the
+                    # section is empty until REST traffic spins the pools
+                    "thread_pool": (self._thread_pool.stats()
+                                    if self._thread_pool is not None else {}),
+                    "breakers": self._breaker_stats(),
                     # TPU-native extra: device kind + HBM usage
                     "accelerator": device_stats(),
                 }
             },
+        }
+
+    @staticmethod
+    def _breaker_stats() -> dict:
+        from elasticsearch_tpu.index.segment import (DENSE_IMPACT_BUDGET,
+                                                     SEGMENT_HBM_BUDGET)
+
+        return {
+            "segments": {"limit_size_in_bytes": SEGMENT_HBM_BUDGET.total,
+                         "estimated_size_in_bytes": SEGMENT_HBM_BUDGET.used},
+            "dense_impact": {
+                "limit_size_in_bytes": DENSE_IMPACT_BUDGET.total,
+                "estimated_size_in_bytes": DENSE_IMPACT_BUDGET.used},
         }
 
     def info(self) -> dict:
@@ -461,6 +496,9 @@ class Node:
     def close(self):
         for svc in self.indices.values():
             svc.close()
+        if self._thread_pool is not None:
+            self._thread_pool.shutdown()
+            self._thread_pool = None
 
 
 _INVALID_NAME = re.compile(r'[\\/*?"<>| ,#:A-Z]')

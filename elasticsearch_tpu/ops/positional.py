@@ -60,12 +60,13 @@ def _steps(n: int) -> int:
     return max(int(np.ceil(np.log2(max(n, 2)))) + 1, 1)
 
 
-@partial(jax.jit, static_argnames=("slop", "D", "ordered"))
+@partial(jax.jit, static_argnames=("slop", "D", "ordered", "unordered"))
 def phrase_freq_program(anchor_doc, anchor_pos, anchor_valid,
                         doc_runs, run_starts, run_lens, deltas,
                         positions, pos_offsets, *,
-                        slop: int, D: int, ordered: bool = False):
-    """Phrase/ordered-near frequency vector f32[D].
+                        slop: int, D: int, ordered: bool = False,
+                        unordered: bool = False):
+    """Phrase / ordered-near / unordered-near frequency vector f32[D].
 
     anchor_doc/pos/valid: [A] anchor positional entries (term 0).
     doc_runs:   i32[M, R] per-term postings doc ids, padded with D.
@@ -74,7 +75,12 @@ def phrase_freq_program(anchor_doc, anchor_pos, anchor_valid,
     deltas:     i32[M] expected position offset vs anchor (phrase mode).
     positions, pos_offsets: the segment's global positional CSR (device).
     ordered=True switches to span_near greedy chaining (deltas ignored
-    except as minimum widths of 1 per clause).
+    except as minimum widths of 1 per clause). unordered=True is
+    SpanNearQuery in_order=false over unit-width clauses: per anchor the
+    greedy nearest position of every other term, match when the combined
+    window minus the clause count fits the slop (NearSpansUnordered's
+    condition; like the sloppy branch this explores the nearest window per
+    anchor, not every combination — documented deviation).
     """
     A = anchor_doc.shape[0]
     M, R = doc_runs.shape
@@ -82,6 +88,40 @@ def phrase_freq_program(anchor_doc, anchor_pos, anchor_valid,
     pos_steps = _steps(int(positions.shape[0]))
 
     match = anchor_valid
+    if unordered:
+        # greedy nearest-to-anchor per clause (deltas are 0); window spread
+        # minus M unit-width clauses must fit the slop
+        adj_min = anchor_pos.astype(jnp.int32)
+        adj_max = anchor_pos.astype(jnp.int32)
+        npos = positions.shape[0]
+        for j in range(M):
+            e = _lower_bound(doc_runs[j], anchor_doc,
+                             jnp.zeros(A, jnp.int32),
+                             jnp.full(A, run_lens[j], jnp.int32), doc_steps)
+            found = (e < run_lens[j]) & (doc_runs[j][jnp.clip(e, 0, R - 1)] == anchor_doc)
+            entry = run_starts[j] + jnp.clip(e, 0, R - 1)
+            lo = pos_offsets[entry]
+            hi = pos_offsets[entry + 1]
+            idx = _lower_bound(positions, anchor_pos, lo, hi, pos_steps)
+            c1 = positions[jnp.clip(idx, 0, npos - 1)]
+            c1_ok = idx < hi
+            c0 = positions[jnp.clip(idx - 1, 0, npos - 1)]
+            c0_ok = (idx - 1) >= lo
+            d1 = jnp.where(c1_ok, jnp.abs(c1 - anchor_pos), 1 << 30)
+            d0 = jnp.where(c0_ok, jnp.abs(c0 - anchor_pos), 1 << 30)
+            q = jnp.where(d0 < d1, c0, c1)
+            found = found & (c0_ok | c1_ok)
+            adj_min = jnp.where(found, jnp.minimum(adj_min, q), adj_min)
+            adj_max = jnp.where(found, jnp.maximum(adj_max, q), adj_max)
+            match = match & found
+        mlen = (adj_max - adj_min) - M  # (width - total clause length)
+        match = match & (mlen <= slop)
+        w = jnp.where(match,
+                      1.0 / (1.0 + jnp.maximum(mlen, 0).astype(jnp.float32)),
+                      0.0)
+        freq = jnp.zeros(D, jnp.float32).at[anchor_doc].add(
+            jnp.where(match, w, 0.0), mode="drop")
+        return freq
     if slop == 0 and not ordered:
         for j in range(M):
             e = _lower_bound(doc_runs[j], anchor_doc,
@@ -164,6 +204,38 @@ def phrase_score(freq, lengths, avg_len, idf_sum, *, D: int,
     return jnp.where(freq > 0, idf_sum * tfn, 0.0)
 
 
+@partial(jax.jit, static_argnames=("D",))
+def span_not_program(anchor_doc, anchor_pos, anchor_valid,
+                     doc_runs, run_starts, run_lens,
+                     positions, pos_offsets, pre, post, *, D: int):
+    """Surviving-include-anchor count f32[D] for span_not: an include span
+    at position p survives when NO exclude-term position lies inside
+    [p - pre, p + post] (unit-width exclude spans overlap the padded
+    include window exactly on that closed range). One vectorized pass —
+    anchors are ALL include positions, exclusion via bounded lower_bound
+    into the positional CSR (SpanNotQuery semantics, no per-doc walks)."""
+    A = anchor_doc.shape[0]
+    M, R = doc_runs.shape
+    doc_steps = _steps(R)
+    pos_steps = _steps(int(positions.shape[0]))
+    npos = positions.shape[0]
+    alive = anchor_valid
+    for j in range(M):
+        e = _lower_bound(doc_runs[j], anchor_doc,
+                         jnp.zeros(A, jnp.int32),
+                         jnp.full(A, run_lens[j], jnp.int32), doc_steps)
+        found = (e < run_lens[j]) & (doc_runs[j][jnp.clip(e, 0, R - 1)] == anchor_doc)
+        entry = run_starts[j] + jnp.clip(e, 0, R - 1)
+        lo = pos_offsets[entry]
+        hi = pos_offsets[entry + 1]
+        idx = _lower_bound(positions, anchor_pos - pre, lo, hi, pos_steps)
+        has = (found & (idx < hi)
+               & (positions[jnp.clip(idx, 0, npos - 1)] <= anchor_pos + post))
+        alive = alive & ~has
+    return jnp.zeros(D, jnp.float32).at[anchor_doc].add(
+        jnp.where(alive, 1.0, 0.0), mode="drop")
+
+
 # ---------------------------------------------------------------------------
 # host-side prep
 # ---------------------------------------------------------------------------
@@ -176,7 +248,10 @@ def pow2(n: int) -> int:
 
 def positional_device(inv):
     """Cached device copies of the positional CSR + doc-per-position
-    expansion for one InvertedField (immutable once frozen)."""
+    expansion for one InvertedField (immutable once frozen). The HOST copy
+    of doc_per_pos is cached alongside (``inv._pos_host_dpp``) — anchor
+    builders slice it instead of re-running the O(total positions) repeat
+    per query."""
     cached = getattr(inv, "_pos_dev", None)
     if cached is not None:
         return cached
@@ -185,11 +260,57 @@ def positional_device(inv):
     pos = jax.device_put(np.asarray(inv.positions, np.int32))
     offs = jax.device_put(np.asarray(inv.pos_offsets, np.int32))
     counts = np.diff(inv.pos_offsets).astype(np.int64)
-    nnz = inv.doc_ids_host.shape[0] if inv.doc_ids_host is not None else counts.shape[0]
-    doc_per_pos = np.repeat(inv.doc_ids_host[:counts.shape[0]], counts)
-    dpp = jax.device_put(doc_per_pos.astype(np.int32))
+    doc_per_pos = np.repeat(inv.doc_ids_host[:counts.shape[0]],
+                            counts).astype(np.int32)
+    dpp = jax.device_put(doc_per_pos)
+    inv._pos_host_dpp = doc_per_pos
     inv._pos_dev = (pos, offs, dpp)
     return inv._pos_dev
+
+
+def build_union_anchor_inputs(inv, anchor_terms, other_terms, D: int):
+    """Anchors = UNION of the anchor_terms' positional entries (for span
+    trees whose first clause is a term disjunction) + padded run tables for
+    other_terms. Vectorized host prep only — no per-doc loops. None when
+    positions are missing or no anchor term occurs."""
+    dev = positional_device(inv)
+    if dev is None:
+        return None
+    positions, pos_offsets, _dpp = dev
+    spans = []
+    for t in anchor_terms:
+        s, ln = inv.term_slice(t)
+        if ln:
+            spans.append((int(inv.pos_offsets[s]),
+                          int(inv.pos_offsets[s + ln])))
+    n_anchor = sum(h - l for l, h in spans)
+    if n_anchor == 0:
+        return None
+    dpp = inv._pos_host_dpp  # cached by positional_device above
+    pos_np = np.asarray(inv.positions)
+    A = pow2(n_anchor)
+    adoc = np.full(A, D, np.int32)
+    apos = np.zeros(A, np.int32)
+    k = 0
+    for l, h in spans:
+        adoc[k: k + h - l] = dpp[l:h]
+        apos[k: k + h - l] = pos_np[l:h]
+        k += h - l
+    avalid = np.arange(A) < n_anchor
+    M = len(other_terms)
+    R = pow2(max((inv.term_slice(t)[1] for t in other_terms), default=1) or 1)
+    doc_runs = np.full((max(M, 1), R), D, np.int32)
+    run_starts = np.zeros(max(M, 1), np.int32)
+    run_lens = np.zeros(max(M, 1), np.int32)
+    for j, t in enumerate(other_terms):
+        s, ln = inv.term_slice(t)
+        if ln:
+            doc_runs[j, :ln] = inv.doc_ids_host[s: s + ln]
+            run_starts[j] = s
+            run_lens[j] = ln
+    return (jnp.asarray(adoc), jnp.asarray(apos), jnp.asarray(avalid),
+            jnp.asarray(doc_runs), jnp.asarray(run_starts),
+            jnp.asarray(run_lens), positions, pos_offsets)
 
 
 def build_phrase_inputs(inv, terms, D: int):

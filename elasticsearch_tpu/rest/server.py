@@ -49,6 +49,29 @@ class RestController:
         rx = re.sub(r"\{(\w+)\}", group, pattern)
         self.routes.append((method, re.compile(f"^{rx}/?$"), handler))
 
+    @staticmethod
+    def pool_for(method: str, path: str) -> str:
+        """Route → thread pool name (reference: each TransportAction names
+        its executor; here whole path SEGMENTS decide — substring matching
+        would misroute index names like `logs_search`)."""
+        parts = [p for p in path.split("/") if p]
+        seg_set = set(parts)
+        if "_bulk" in seg_set:
+            return "bulk"
+        if seg_set & {"_search", "_msearch", "_count", "_suggest",
+                      "_percolate", "_validate", "_explain", "_field_stats",
+                      "_knn_search"}:
+            return "search"
+        if "_mget" in seg_set:
+            return "get"
+        if seg_set & {"_update", "_doc", "_create"}:
+            return "get" if method in ("GET", "HEAD") else "index"
+        if len(parts) >= 2 and not parts[-1].startswith("_") \
+                and not parts[0].startswith("_"):
+            # /{index}/{type}/{id}-style document CRUD
+            return "get" if method in ("GET", "HEAD") else "index"
+        return "management"
+
     def dispatch(self, method: str, path: str, params: Dict[str, str], body: bytes) -> Tuple[int, Any]:
         for m, rx, handler in self.routes:
             if m != method:
@@ -56,7 +79,12 @@ class RestController:
             match = rx.match(path)
             if match:
                 try:
-                    return handler(self.node, params, body, **match.groupdict())
+                    # run on the route's named pool: bounded concurrency,
+                    # full queues reject with 429 (ThreadPool.java contract)
+                    return self.node.thread_pool.execute(
+                        self.pool_for(method, path),
+                        handler, self.node, params, body,
+                        **match.groupdict())
                 except ElasticsearchTpuException as e:
                     return e.status, _error_body(e)
                 except json.JSONDecodeError as e:
@@ -129,8 +157,10 @@ def _register_all(rc: RestController):
     add("GET", "/_cat/plugins", lambda n, p, b: (200, []))
     add("GET", "/_cat/pending_tasks", lambda n, p, b: (200, []))
     add("GET", "/_cat/thread_pool", lambda n, p, b: (200, [
-        {"node_name": n.name, "name": pool, "active": 0, "queue": 0, "rejected": 0}
-        for pool in ("search", "index", "bulk", "get")]))
+        {"node_name": n.name, "name": name, "active": st["active"],
+         "queue": st["queue"], "rejected": st["rejected"],
+         "threads": st["threads"], "completed": st["completed"]}
+        for name, st in n.thread_pool.stats().items()]))
     add("GET", "/_cat/fielddata", lambda n, p, b: (200, []))
     add("GET", "/_cat/repositories", lambda n, p, b: (200, [
         {"id": name, "type": "fs"} for name in n.repositories]))

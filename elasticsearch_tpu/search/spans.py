@@ -5,13 +5,27 @@ FieldMaskingSpanQueryBuilder.java, backed by Lucene's SpanQuery family
 (SpanTermQuery, SpanNearQuery/NearSpansOrdered/Unordered, SpanNotQuery,
 SpanOrQuery, SpanFirstQuery, SpanMultiTermQueryWrapper).
 
-Execution model mirrors MatchPhraseQuery's documented R1 deviation: the
-*candidate doc set* is computed from the host CSR postings (set algebra on
-sorted doc-id runs — the same arrays the device scores from), and position
-intervals are verified host-side from the positional CSR. Scoring follows
-our phrase convention: a matching doc scores the sum of unigram BM25
-contributions of every term the span tree touches (Lucene scores sloppy
-phrase freq instead; device positional programs are an R2 item).
+Execution model — device programs for the common shapes, host interval
+walks only for deep nesting:
+
+* span_near over span_term clauses (ordered AND unordered) runs as ONE
+  vectorized anchor-entry program over the positional CSR
+  (ops/positional.py phrase_freq_program ordered/unordered modes), scored
+  with Lucene's sloppy freq (idf_sum * tfNorm(Σ 1/(1+matchLength))).
+  Deviation: per anchor the program chains/choses the NEAREST window
+  (Lucene explores alternatives for repeated terms); the oracle tests
+  mirror this, and it equals Lucene on non-degenerate spans.
+* span_or over terms / a bare span_term / span_multi expansions: the
+  match mask IS the device term-union mask — every doc containing a term
+  has a span, no verification pass exists at all.
+* span_first over term-union matches: vectorized numpy over the
+  positional CSR's first-position-per-entry (no per-doc loops).
+* span_not with term-union include/exclude: the span_not_program device
+  kernel (anchors = include positions, exclusion via bounded lower_bound).
+* Anything deeper (nested near-of-near, field_masking combinations) falls
+  back to the host walk: candidate docs from CSR set algebra, per-doc
+  interval verification, scored as summed unigram BM25 over the tree's
+  terms.
 
 A span node yields, per doc, a sorted list of half-open intervals
 (start, end) over token positions.
@@ -26,9 +40,11 @@ from elasticsearch_tpu.utils.errors import QueryParsingException
 
 Interval = Tuple[int, int]
 
-# cap per-clause spans considered in near-combination search (guards the
-# combinatorial walk on pathological docs; Lucene bounds work similarly via
-# iterator advancement)
+# cap per-clause spans considered in the HOST near-combination walk (guards
+# the combinatorial search on pathological docs; Lucene bounds work
+# similarly via iterator advancement). Truncation is surfaced: the
+# `span_clause_truncated` kernel counter ticks whenever a clause exceeds
+# the cap, so silent-result suspicion is checkable in _nodes/stats.
 MAX_SPANS_PER_CLAUSE = 128
 
 
@@ -186,7 +202,12 @@ class SpanNearNode(SpanNode):
         return out
 
     def spans(self, ctx, doc: int) -> List[Interval]:
-        per = [c.spans(ctx, doc)[:MAX_SPANS_PER_CLAUSE] for c in self.clauses]
+        full = [c.spans(ctx, doc) for c in self.clauses]
+        per = [p[:MAX_SPANS_PER_CLAUSE] for p in full]
+        if any(len(f) > MAX_SPANS_PER_CLAUSE for f in full):
+            from elasticsearch_tpu.monitor import kernels
+
+            kernels.record("span_clause_truncated")
         if any(not p for p in per):
             return []
         found: List[Interval] = []
@@ -296,9 +317,7 @@ class SpanQueryWrapper(Query):
     def execute(self, ctx):
         import jax.numpy as jnp
 
-        from elasticsearch_tpu.search.queries import _score_term_group
-
-        fast = self._device_near(ctx)
+        fast = self._device_fast(ctx)
         if fast is not None:
             return fast
         cand = self.node.candidate_docs(ctx)
@@ -309,7 +328,15 @@ class SpanQueryWrapper(Query):
         mask = jnp.asarray(ok)
         if not ok.any():
             return None, mask
-        # score: group leaf terms by field, sum BM25 over each group
+        return self._score_leaves(ctx, mask)
+
+    def _score_leaves(self, ctx, mask):
+        """Summed unigram BM25 over the tree's terms × the match mask (the
+        scoring convention for every non-near span shape)."""
+        import jax.numpy as jnp
+
+        from elasticsearch_tpu.search.queries import _score_term_group
+
         leaves = self.node.terms()
         for n in _walk_multis(self.node):
             leaves.extend(n.expanded_terms(ctx))
@@ -324,17 +351,44 @@ class SpanQueryWrapper(Query):
             scores = mask.astype(jnp.float32) * self.boost
         return scores * mask, mask
 
-    def _device_near(self, ctx):
-        """Device fast path for the dominant span shape: span_near over
-        span_term clauses with in_order=true — Lucene NearSpansOrdered's
-        greedy leftmost chaining as one vectorized program over the
-        positional CSR (no per-doc host loops), scored with sloppy freq
-        (idf_sum * tfNorm(Σ 1/(1+matchLength)))."""
+    def _device_fast(self, ctx):
+        """Vectorized execution for the common span shapes (module
+        docstring); None → host interval walk."""
+        node = self.node
+        if isinstance(node, SpanNearNode):
+            return self._device_near(ctx, node)
+        if isinstance(node, (SpanTermNode, SpanOrNode, SpanMultiNode)):
+            terms = _union_terms(node, ctx)
+            if terms is None:
+                return None
+            field, ts = terms
+            import jax.numpy as jnp
+
+            from elasticsearch_tpu.search.queries import _terms_filter_mask
+
+            mask = _terms_filter_mask(ctx, field, ts)
+            return self._score_leaves(ctx, mask)
+        if isinstance(node, SpanFirstNode):
+            inner = _union_terms(node.match, ctx)
+            if inner is None:
+                return None
+            field, ts = inner
+            mask_np = _first_position_mask(ctx, field, ts, node.end)
+            if mask_np is None:
+                return None
+            import jax.numpy as jnp
+
+            return self._score_leaves(ctx, jnp.asarray(mask_np))
+        if isinstance(node, SpanNotNode):
+            return self._device_not(ctx, node)
+        return None
+
+    def _device_near(self, ctx, node):
+        """span_near over span_term clauses, ordered AND unordered — one
+        anchor-entry program over the positional CSR (no per-doc host
+        loops), scored with sloppy freq (idf_sum * tfNorm(Σ weights))."""
         import jax.numpy as jnp
 
-        node = self.node
-        if not isinstance(node, SpanNearNode) or not node.in_order:
-            return None
         if not all(isinstance(c, SpanTermNode) for c in node.clauses):
             return None
         if len({c.field for c in node.clauses}) != 1 or len(node.clauses) < 2:
@@ -350,13 +404,15 @@ class SpanQueryWrapper(Query):
                                                       phrase_freq_program,
                                                       phrase_score)
 
-        # the ordered program ignores deltas; rest clauses chain in order
+        # the near programs ignore deltas; clauses chain (ordered) or pick
+        # nearest windows (unordered)
         inputs = build_phrase_inputs(inv, [(t, i) for i, t in enumerate(terms)],
                                      ctx.D)
         if inputs is None:
             return None, jnp.zeros(ctx.D, dtype=bool)
         freq = phrase_freq_program(*inputs, slop=int(node.slop), D=ctx.D,
-                                   ordered=True)
+                                   ordered=node.in_order,
+                                   unordered=not node.in_order)
         mask = freq > 0
         idf_sum = sum(ctx.idf(node.field, t) for t in dict.fromkeys(terms))
         lengths = ctx.segment.field_lengths.get(node.field)
@@ -366,6 +422,77 @@ class SpanQueryWrapper(Query):
                               jnp.float32(inv.avg_len),
                               jnp.float32(idf_sum), D=ctx.D) * self.boost
         return scores, mask
+
+    def _device_not(self, ctx, node):
+        """span_not with term-union include AND exclude on one field: the
+        span_not_program device kernel."""
+        import jax.numpy as jnp
+
+        inc = _union_terms(node.include, ctx)
+        exc = _union_terms(node.exclude, ctx)
+        if inc is None or exc is None or inc[0] != exc[0]:
+            return None
+        field, inc_terms = inc
+        _, exc_terms = exc
+        inv = ctx.inv(field)
+        if inv is None or inv.positions is None:
+            return None
+        from elasticsearch_tpu.ops.positional import (
+            build_union_anchor_inputs, span_not_program)
+
+        inputs = build_union_anchor_inputs(inv, inc_terms, exc_terms, ctx.D)
+        if inputs is None:
+            return None, jnp.zeros(ctx.D, dtype=bool)
+        freq = span_not_program(*inputs, jnp.int32(node.pre),
+                                jnp.int32(node.post), D=ctx.D)
+        return self._score_leaves(ctx, freq > 0)
+
+
+def _union_terms(node: SpanNode, ctx) -> Optional[Tuple[str, List[str]]]:
+    """(field, terms) when `node` is a term / or-of-terms / multi-term
+    expansion on ONE field — the shapes whose span set is exactly the
+    term-position union; None for anything deeper."""
+    if isinstance(node, SpanTermNode):
+        return node.field, [node.term]
+    if isinstance(node, SpanMultiNode):
+        return node.field, list(node._exp(ctx))
+    if isinstance(node, SpanOrNode):
+        field: Optional[str] = None
+        terms: List[str] = []
+        for c in node.clauses:
+            got = _union_terms(c, ctx)
+            if got is None:
+                return None
+            f, ts = got
+            if field is None:
+                field = f
+            elif f != field:
+                return None
+            terms.extend(ts)
+        return field, list(dict.fromkeys(terms))
+    return None
+
+
+def _first_position_mask(ctx, field: str, terms: List[str], end: int):
+    """bool[D] docs whose earliest occurrence of any term ends at or before
+    `end` (span_first) — vectorized numpy over the positional CSR, no
+    per-doc loops. None when positional data is missing (caller falls back
+    to the host walk)."""
+    inv = ctx.inv(field)
+    if inv is None or inv.positions is None or inv.doc_ids_host is None:
+        return None
+    mask = np.zeros(ctx.D, dtype=bool)
+    pos_np = np.asarray(inv.positions)
+    for t in terms:
+        s, ln = inv.term_slice(t)
+        if ln == 0:
+            continue
+        # positions are sorted per entry: the entry's first position is the
+        # minimum, and (x, x+1) fits iff x + 1 <= end
+        firsts = pos_np[inv.pos_offsets[s: s + ln]]
+        docs = inv.doc_ids_host[s: s + ln]
+        mask[docs[firsts < end]] = True
+    return mask
 
 
 def _walk_multis(node: SpanNode):

@@ -79,3 +79,11 @@ class SearchParseException(ElasticsearchTpuException):
 
 class ScriptException(ElasticsearchTpuException):
     status = 400
+
+
+class CircuitBreakingException(ElasticsearchTpuException):
+    """Reference: org/elasticsearch/common/breaker/CircuitBreaker.java —
+    a memory budget would be exceeded; the REQUEST fails (429-style), the
+    node survives."""
+
+    status = 429
