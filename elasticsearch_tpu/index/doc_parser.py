@@ -44,13 +44,35 @@ class ParsedDocument:
         return len(self.text_tokens.get(fname, ()))
 
 
+def _ttl_to_millis(t) -> int:
+    """_ttl value → millis: bare numbers (REST delivers them as strings)
+    are millis; unit strings go through interval parsing; anything else is
+    a 400 mapper error, never a raw ValueError."""
+    from elasticsearch_tpu.utils.dates import interval_to_millis
+
+    if isinstance(t, (int, float)):
+        return int(t)
+    s = str(t).strip()
+    if s.replace(".", "", 1).isdigit():
+        return int(float(s))
+    try:
+        ms = interval_to_millis(s)
+    except ValueError:
+        ms = None
+    if ms is None:
+        raise MapperParsingException(f"failed to parse ttl value [{t}]")
+    return int(ms)
+
+
 class DocumentParser:
     def __init__(self, mappings: Mappings, analysis: AnalysisRegistry):
         self.mappings = mappings
         self.analysis = analysis
 
     def parse(self, doc_id: str, source: dict, routing: Optional[str] = None,
-              doc_type: Optional[str] = None, parent: Optional[str] = None) -> ParsedDocument:
+              doc_type: Optional[str] = None, parent: Optional[str] = None,
+              timestamp: Optional[Any] = None, ttl: Optional[Any] = None,
+              ttl_expiry: Optional[int] = None) -> ParsedDocument:
         if not isinstance(source, dict):
             raise MapperParsingException("document source must be a JSON object")
         parsed = ParsedDocument(doc_id=doc_id, source=source, routing=routing)
@@ -66,7 +88,58 @@ class DocumentParser:
         if routing:
             parsed.meta["routing"] = str(routing)
         self._walk(source, "", parsed)
+        self._index_meta_fields(parsed, source, timestamp, ttl, ttl_expiry)
         return parsed
+
+    def _index_meta_fields(self, parsed: ParsedDocument, source: dict,
+                           timestamp, ttl, ttl_expiry) -> None:
+        """Opt-in meta fields (reference: mapper/internal/
+        TimestampFieldMapper.java:1-336, TTLFieldMapper.java:1-228,
+        SizeFieldMapper, FieldNamesFieldMapper). Resolved values land in
+        parsed.meta so merges and translog replay reproduce them exactly."""
+        import json as _json
+        import time as _time
+
+        from elasticsearch_tpu.utils.dates import parse_date
+
+        m = self.mappings
+        now_ms = int(_time.time() * 1000)
+        if m._timestamp_enabled:
+            if timestamp is not None:
+                ts = (int(timestamp) if isinstance(timestamp, (int, float))
+                      else int(parse_date(
+                          timestamp, "strict_date_optional_time||epoch_millis")))
+            elif m._timestamp_default not in (None, "now"):
+                ts = int(parse_date(
+                    m._timestamp_default,
+                    "strict_date_optional_time||epoch_millis"))
+            else:
+                ts = now_ms
+            parsed.doc_values["_timestamp"] = [ts]
+            parsed.meta["timestamp"] = ts
+        if m._ttl_enabled:
+            if ttl_expiry is not None:
+                expiry = int(ttl_expiry)
+            else:
+                t = ttl if ttl is not None else m._ttl_default
+                if t is None:
+                    expiry = None
+                else:
+                    ttl_ms = _ttl_to_millis(t)
+                    base = parsed.meta.get("timestamp", now_ms)
+                    expiry = int(base + ttl_ms)
+            if expiry is not None:
+                parsed.doc_values["_ttl"] = [expiry]
+                parsed.meta["ttl_expiry"] = expiry
+        if m._size_enabled:
+            parsed.doc_values["_size"] = [
+                len(_json.dumps(source, separators=(",", ":")))]
+        if m._field_names_enabled:
+            names = (set(parsed.text_tokens) | set(parsed.doc_values)
+                     | set(parsed.vectors))
+            names -= {"_all", "_timestamp", "_ttl", "_size"}
+            if names:
+                parsed.doc_values["_field_names"] = sorted(names)
 
     def _nested_children(self, full: str, items: List[dict], parsed: ParsedDocument):
         """Each object under a nested path becomes its own block doc with

@@ -93,6 +93,9 @@ class Engine:
         routing: Optional[str] = None,
         doc_type: Optional[str] = None,
         parent: Optional[str] = None,
+        timestamp: Optional[object] = None,
+        ttl: Optional[object] = None,
+        ttl_expiry: Optional[int] = None,
         _replay: bool = False,
     ) -> Tuple[str, int, bool]:
         """Index/create a document. Returns (id, new_version, created).
@@ -128,7 +131,9 @@ class Engine:
                 new_version = (loc.version if loc else 0) + 1
 
             parsed = self.parser.parse(doc_id, source, routing=routing,
-                                       doc_type=doc_type, parent=parent)
+                                       doc_type=doc_type, parent=parent,
+                                       timestamp=timestamp, ttl=ttl,
+                                       ttl_expiry=ttl_expiry)
             self._remove_existing(doc_id)
             local = self.buffer.add(parsed)
             self._buffer_ids[doc_id] = local
@@ -143,6 +148,12 @@ class Engine:
                     entry["doc_type"] = doc_type
                 if parent:
                     entry["parent"] = parent
+                # resolved meta-field values: replay must reproduce them
+                # exactly (re-resolving "now" later would drift)
+                if "timestamp" in parsed.meta:
+                    entry["timestamp"] = parsed.meta["timestamp"]
+                if "ttl_expiry" in parsed.meta:
+                    entry["ttl_expiry"] = parsed.meta["ttl_expiry"]
                 self.translog.append(entry)
             self.stats.index_total += 1
             self.stats.index_time_ms += (time.perf_counter() - t0) * 1000
@@ -277,9 +288,42 @@ class Engine:
 
     # -- lifecycle -------------------------------------------------------------
 
+    def purge_expired(self) -> int:
+        """Delete docs whose _ttl expiry has passed (reference: indices/ttl/
+        IndicesTTLService.java — the TTL purger; here it runs on refresh and
+        merge). Expiry columns scan vectorized; deletes go through the
+        normal tombstone path so versions/translog stay consistent."""
+        if not getattr(self.mappings, "_ttl_enabled", False):
+            return 0
+        import numpy as np
+
+        now = int(time.time() * 1000)
+        expired: List[str] = []
+        with self._lock:
+            for seg in self.segments:
+                col = seg.numerics.get("_ttl")
+                if col is None or col.exact is None:
+                    continue
+                n = seg.num_docs
+                hit = np.nonzero(seg.live_host[:n]
+                                 & np.asarray(col.exists)[:n]
+                                 & (col.exact[:n] < now))[0]
+                expired.extend(seg.ids[int(i)] for i in hit)
+            for d in self.buffer.docs:
+                if (d is not None and d.doc_values.get("_ttl")
+                        and d.doc_values["_ttl"][0] < now):
+                    expired.append(d.doc_id)
+            for doc_id in expired:
+                try:
+                    self.delete(doc_id)
+                except DocumentMissingException:
+                    pass
+        return len(expired)
+
     def refresh(self) -> bool:
         """Freeze the buffer into a new searchable segment (NRT refresh)."""
         with self._lock:
+            self.purge_expired()
             # roots only: tombstoned roots leave orphan children in the
             # buffer arrays; re-adding a root re-emits its block
             live_docs = [d for d, p in zip(self.buffer.docs, self.buffer.parent_of)
@@ -330,6 +374,7 @@ class Engine:
         parser. With ``subset``: a policy-selected partial merge (tiered);
         without: force-merge everything down to one segment (optimize)."""
         with self._lock:
+            self.purge_expired()
             if subset is None and len(self.segments) <= (max_segments or 1):
                 return
             targets = subset if subset is not None else list(self.segments)
@@ -344,7 +389,9 @@ class Engine:
                         builder.add(self.parser.parse(
                             doc_id, seg.sources[local],
                             routing=meta.get("routing"),
-                            doc_type=meta.get("_type"), parent=meta.get("_parent")))
+                            doc_type=meta.get("_type"), parent=meta.get("_parent"),
+                            timestamp=meta.get("timestamp"),
+                            ttl_expiry=meta.get("ttl_expiry")))
             merged = builder.freeze()
             keep = [s for s in self.segments if s.seg_id not in target_ids]
             # release-then-charge: a merge nets memory DOWN, so it charges
@@ -382,6 +429,8 @@ class Engine:
                 if op["op"] == "index":
                     self.index(op["id"], op["source"], routing=op.get("routing"),
                                doc_type=op.get("doc_type"), parent=op.get("parent"),
+                               timestamp=op.get("timestamp"),
+                               ttl_expiry=op.get("ttl_expiry"),
                                _replay=True)
                     self._locations[op["id"]].version = op["version"]
                 elif op["op"] == "delete":

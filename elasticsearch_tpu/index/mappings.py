@@ -96,6 +96,16 @@ class Mappings:
         # field searches it)
         self._all_enabled = True
         self._all_fm: Optional[FieldMapping] = None
+        # meta-field toggles (reference: mapper/internal/ —
+        # TimestampFieldMapper.java, TTLFieldMapper.java, SizeFieldMapper,
+        # FieldNamesFieldMapper). _field_names is on by default like the
+        # reference; the others are opt-in.
+        self._timestamp_enabled = False
+        self._timestamp_default: Any = None  # "now" | fixed value
+        self._ttl_enabled = False
+        self._ttl_default: Any = None  # e.g. "5m"
+        self._size_enabled = False
+        self._field_names_enabled = True
         self.dynamic_templates: List[dict] = []
         self.meta: dict = {}
         if mapping_json:
@@ -119,6 +129,16 @@ class Mappings:
             self._all_enabled = body["_all"].get("enabled", True)
         if "_meta" in body:
             self.meta = body["_meta"]
+        if "_timestamp" in body:
+            self._timestamp_enabled = body["_timestamp"].get("enabled", False)
+            self._timestamp_default = body["_timestamp"].get("default", "now")
+        if "_ttl" in body:
+            self._ttl_enabled = body["_ttl"].get("enabled", False)
+            self._ttl_default = body["_ttl"].get("default")
+        if "_size" in body:
+            self._size_enabled = body["_size"].get("enabled", False)
+        if "_field_names" in body:
+            self._field_names_enabled = body["_field_names"].get("enabled", True)
         if "dynamic_templates" in body:
             self.dynamic_templates = list(body["dynamic_templates"])
         self._parse_properties(body.get("properties", {}), prefix="", nested_path=None)
@@ -200,7 +220,18 @@ class Mappings:
         self.fields[name] = fm
         return fm
 
+    _META_SYNTHETIC = {"_timestamp": "date", "_ttl": "long",
+                       "_size": "integer", "_field_names": "keyword"}
+
     def get(self, name: str) -> Optional[FieldMapping]:
+        if name in self._META_SYNTHETIC:
+            enabled = {"_timestamp": self._timestamp_enabled,
+                       "_ttl": self._ttl_enabled,
+                       "_size": self._size_enabled,
+                       "_field_names": self._field_names_enabled}[name]
+            if not enabled:
+                return None
+            return FieldMapping(name=name, type=self._META_SYNTHETIC[name])
         if name == "_all":
             # synthetic mapping (kept out of `fields` so it never leaks into
             # to_json/wildcard field expansion); analyzed with the index
@@ -282,6 +313,20 @@ class Mappings:
         out = {"properties": props, "dynamic": self.dynamic}
         if not self._all_enabled:
             out["_all"] = {"enabled": False}
+        # meta-field toggles must round-trip: the gateway re-parses this on
+        # restart, and translog replay re-resolves _timestamp/_ttl from it
+        if self._timestamp_enabled:
+            out["_timestamp"] = {"enabled": True}
+            if self._timestamp_default not in (None, "now"):
+                out["_timestamp"]["default"] = self._timestamp_default
+        if self._ttl_enabled:
+            out["_ttl"] = {"enabled": True}
+            if self._ttl_default is not None:
+                out["_ttl"]["default"] = self._ttl_default
+        if self._size_enabled:
+            out["_size"] = {"enabled": True}
+        if not self._field_names_enabled:
+            out["_field_names"] = {"enabled": False}
         return out
 
 

@@ -21,9 +21,11 @@ from elasticsearch_tpu.parallel.compiler import MeshCompileError
 
 # host-loop-only request features: their presence skips the mesh path.
 # highlight is NOT here: it is a fetch-phase feature and the mesh path's
-# fetch_phase handles it like the host loop does.
+# fetch_phase handles it like the host loop does (matched_queries too —
+# the fetch phase attaches them on either path).
 _UNSUPPORTED_KEYS = ("rescore", "search_after", "min_score", "scroll",
-                     "profile")
+                     "profile", "terminate_after", "timeout",
+                     "indices_boost")
 
 
 def try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[dict]:

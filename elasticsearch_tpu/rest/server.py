@@ -633,6 +633,10 @@ def _index_doc(n: Node, p, b, index: str, id: str, doc_type: Optional[str] = Non
         # parent id doubles as the routing key so parent and child land on
         # the same shard (reference: ParentFieldMapper + routing resolution)
         kw["parent"] = p["parent"]
+    if p.get("timestamp"):  # _timestamp meta field (TimestampFieldMapper)
+        kw["timestamp"] = p["timestamp"]
+    if p.get("ttl"):  # _ttl meta field (TTLFieldMapper)
+        kw["ttl"] = p["ttl"]
     r = svc.index_doc(id, _json(b), routing=p.get("routing") or p.get("parent"), **kw)
     if p.get("refresh") in ("true", "wait_for", ""):
         svc.refresh()
@@ -1091,22 +1095,77 @@ def _field_stats(n: Node, p, b, index: str):
 
 
 def _termvectors(n: Node, p, b, index: str, id: str):
-    """RestTermVectorsAction: term stats for one doc's text fields."""
+    """RestTermVectorsAction (reference: action/termvectors/
+    TermVectorsRequest.java): per-field term vectors with positions,
+    offsets, term_statistics (doc_freq, ttf) and field_statistics
+    (sum_doc_freq, doc_count, sum_ttf). Statistics come from the doc's
+    frozen segment; a doc still in the indexing buffer reports vectors
+    only (ES reads stats from the shard's live reader the same way).
+    Offsets are recovered by cursor-scanning the source text for each
+    token (the index stores positions, not offsets); stemmed tokens whose
+    surface form can't be located omit offsets."""
+    body = _json(b)
+    opts = {}
+    for k, default in (("positions", True), ("offsets", True),
+                       ("term_statistics", False), ("field_statistics", True)):
+        v = body.get(k, p.get(k, default))
+        opts[k] = str(v).lower() != "false"
     svc = n.get_index(index)
     shard = svc.route(id, p.get("routing"))
     got = shard.engine.get(id)
     if got is None:
         return 404, {"_index": index, "_id": id, "found": False}
     parsed = shard.engine.parser.parse(str(id), got["_source"])
+    loc = shard.engine._locations.get(str(id))
+    seg = None
+    if loc is not None and loc.where != "buffer":
+        seg = next((s for s in shard.engine.segments
+                    if s.seg_id == loc.where), None)
+    sel = body.get("fields", p.get("fields"))
+    if isinstance(sel, str):
+        sel = [f.strip() for f in sel.split(",")]
     term_vectors = {}
     for fname, toks in parsed.text_tokens.items():
+        if sel and fname not in sel:
+            continue
+        inv = seg.inverted.get(fname) if seg is not None else None
+        src_text = got["_source"].get(fname)
+        src_low = src_text.lower() if isinstance(src_text, str) else None
         terms: Dict[str, dict] = {}
+        cursor = 0
         for t, pos in toks:
             e = terms.setdefault(t, {"term_freq": 0, "tokens": []})
             e["term_freq"] += 1
-            e["tokens"].append({"position": pos})
-        term_vectors[fname] = {"terms": terms}
-    return 200, {"_index": index, "_id": id, "found": True, "term_vectors": term_vectors}
+            tok: Dict[str, Any] = {}
+            if opts["positions"]:
+                tok["position"] = pos
+            if opts["offsets"] and src_low is not None:
+                at = src_low.find(t, cursor)
+                if at < 0:  # stemmed form: try the token as a prefix match
+                    at = src_low.find(t[:4], cursor) if len(t) >= 4 else -1
+                if at >= 0:
+                    end = at + len(t)
+                    tok["start_offset"] = at
+                    tok["end_offset"] = end
+                    cursor = end
+            if tok:
+                e["tokens"].append(tok)
+        if opts["term_statistics"] and inv is not None:
+            for t, e in terms.items():
+                tid = inv.term_id(t)
+                if tid >= 0:
+                    e["doc_freq"] = int(inv.df[tid])
+                    e["ttf"] = int(inv.cf[tid])
+        fv: Dict[str, Any] = {"terms": terms}
+        if opts["field_statistics"] and inv is not None:
+            fv["field_statistics"] = {
+                "sum_doc_freq": int(inv.df.sum()),
+                "doc_count": int(inv.num_docs),
+                "sum_ttf": int(inv.cf.sum()),
+            }
+        term_vectors[fname] = fv
+    return 200, {"_index": index, "_id": id, "found": True,
+                 "term_vectors": term_vectors}
 
 
 # ---------------------------------------------------------------------------

@@ -487,6 +487,71 @@ class MatchQuery(Query):
         return scores, mask
 
 
+class CommonTermsQuery(Query):
+    """index/query/CommonTermsQueryBuilder.java — terms split by document
+    frequency at ``cutoff_frequency``: low-freq terms form the primary
+    (selecting) group scored like a match query under ``low_freq_operator``
+    / ``minimum_should_match``; high-freq terms add score to docs the
+    primary group already matched but never select on their own. When EVERY
+    term is high-freq they become the primary group under
+    ``high_freq_operator`` (the reference's degenerate case)."""
+
+    def __init__(self, field: str, text: Any, cutoff_frequency: float = 0.01,
+                 low_freq_operator: str = "or", high_freq_operator: str = "or",
+                 minimum_should_match=None, boost: float = 1.0):
+        self.field = field
+        self.text = text
+        self.cutoff = float(cutoff_frequency)
+        self.low_op = low_freq_operator.lower()
+        self.high_op = high_freq_operator.lower()
+        self.msm = minimum_should_match
+        self.boost = boost
+
+    def _msm_for(self, group: str):
+        if isinstance(self.msm, dict):
+            return self.msm.get(group)
+        return self.msm if group == "low_freq" else None
+
+    def _group_mask(self, ctx, terms, op, msm):
+        need_counts = op == "and" or msm is not None
+        scores, matched, _ = _score_term_group(
+            ctx, self.field, terms, self.boost, with_counts=need_counts)
+        n_terms = len(set(terms))
+        if op == "and":
+            mask = matched >= n_terms
+        elif msm is not None:
+            mask = matched >= max(_min_should_match(msm, n_terms), 1)
+        else:
+            mask = matched  # bool match mask
+        return scores, mask
+
+    def execute(self, ctx) -> ExecResult:
+        an = ctx.search_analyzer(self.field)
+        terms = ([t for t, _ in an.analyze(str(self.text))] if an
+                 else [str(self.text)])
+        inv = ctx.inv(self.field)
+        if not terms or inv is None:
+            return _empty(ctx)
+        maxdoc = max(inv.num_docs, 1)
+        abs_cutoff = self.cutoff if self.cutoff >= 1.0 else self.cutoff * maxdoc
+        low, high = [], []
+        for t in dict.fromkeys(terms):
+            tid = inv.term_id(t)
+            df = int(inv.df[tid]) if tid >= 0 else 0
+            (high if df > abs_cutoff else low).append(t)
+        if low:
+            scores, mask = self._group_mask(ctx, low, self.low_op,
+                                            self._msm_for("low_freq"))
+            if high:
+                jnp = _jnp()
+                s_high, _, _ = _score_term_group(ctx, self.field, high,
+                                                 self.boost)
+                scores = scores + jnp.where(mask, s_high, 0.0)
+            return scores, mask
+        return self._group_mask(ctx, high, self.high_op,
+                                self._msm_for("high_freq"))
+
+
 class MultiMatchQuery(Query):
     """index/query/MultiMatchQueryBuilder.java — best_fields/most_fields."""
 
@@ -1205,7 +1270,52 @@ def _parse_clauses(v) -> List[Query]:
 
 
 def parse_query(dsl: Optional[dict]) -> Query:
-    """Parse an ES query DSL dict into a Query tree."""
+    """Parse an ES query DSL dict into a Query tree. A ``_name`` key (on
+    the query body or a single-field spec) names the node for
+    ``matched_queries`` (reference: search/fetch/matchedqueries/
+    MatchedQueriesFetchSubPhase.java)."""
+    name = None
+    if isinstance(dsl, dict) and len(dsl) == 1:
+        (qtype, qbody), = dsl.items()
+        if isinstance(qbody, dict):
+            body2 = dict(qbody)
+            name = body2.pop("_name", None)
+            if name is None and len(body2) == 1:
+                (f, spec), = body2.items()
+                if isinstance(spec, dict) and "_name" in spec:
+                    spec = dict(spec)
+                    name = spec.pop("_name")
+                    body2 = {f: spec}
+            if name is not None:
+                dsl = {qtype: body2}
+    q = _parse_query_inner(dsl)
+    if name is not None:
+        q._name = str(name)
+    return q
+
+
+def collect_named(q: Query, out: Optional[List[Tuple[str, Query]]] = None
+                  ) -> List[Tuple[str, Query]]:
+    """All (_name, node) pairs in a query tree (matched_queries)."""
+    if out is None:
+        out = []
+    nm = getattr(q, "_name", None)
+    if nm is not None:
+        out.append((nm, q))
+    for attr in ("must", "should", "must_not", "filter", "queries"):
+        v = getattr(q, attr, None)
+        if isinstance(v, (list, tuple)):
+            for c in v:
+                if isinstance(c, Query):
+                    collect_named(c, out)
+    for attr in ("inner", "positive", "negative", "no_match", "filter"):
+        c = getattr(q, attr, None)
+        if isinstance(c, Query):
+            collect_named(c, out)
+    return out
+
+
+def _parse_query_inner(dsl: Optional[dict]) -> Query:
     if dsl is None or dsl == {}:
         return MatchAllQuery()
     if not isinstance(dsl, dict) or len(dsl) != 1:
@@ -1255,10 +1365,18 @@ def parse_query(dsl: Optional[dict]) -> Query:
             boost=float(body.get("boost", 1.0)),
         )
 
-    if qtype == "common":  # common_terms degrades to match (scoring parity note)
+    if qtype == "common":
         (field, spec), = body.items()
-        text = spec.get("query") if isinstance(spec, dict) else spec
-        return MatchQuery(field, text)
+        if isinstance(spec, dict):
+            return CommonTermsQuery(
+                field, spec.get("query"),
+                cutoff_frequency=float(spec.get("cutoff_frequency", 0.01)),
+                low_freq_operator=spec.get("low_freq_operator", "or"),
+                high_freq_operator=spec.get("high_freq_operator", "or"),
+                minimum_should_match=spec.get("minimum_should_match"),
+                boost=float(spec.get("boost", 1.0)),
+            )
+        return CommonTermsQuery(field, spec)
 
     if qtype == "term":
         (field, spec), = body.items()

@@ -54,6 +54,22 @@ class QueryPhaseResult:
     # scroll snapshot (score-ordered scrolls): complete per-segment orders as
     # compact numpy arrays — (segment, int32 order of ALL matches, f32 scores)
     full: Optional[List[Tuple[Any, np.ndarray, np.ndarray]]] = None
+    terminated_early: bool = False
+    timed_out: bool = False
+
+
+def _parse_timeout(v) -> Optional[float]:
+    """Request timeout → seconds ("10ms", "1s", "2m", or numeric millis)."""
+    if v in (None, -1, "-1"):
+        return None
+    s = str(v).strip().lower()
+    for suf, mul in (("ms", 1e-3), ("s", 1.0), ("m", 60.0), ("h", 3600.0)):
+        if s.endswith(suf) and s[: -len(suf)].replace(".", "", 1).isdigit():
+            return float(s[: -len(suf)]) * mul
+    try:
+        return float(s) * 1e-3  # bare number = millis (ES convention)
+    except ValueError:
+        raise SearchParseException(f"failed to parse timeout value [{v}]")
 
 
 # in-memory scroll registry: scroll_id -> (snapshot state)
@@ -95,6 +111,8 @@ class ShardSearcher:
         k = min(max(size + frm, 1), 10_000)
         min_score = body.get("min_score")
         sort_spec = _parse_sort(body.get("sort"))
+        if collect_full and body.get("search_type") == "scan":
+            sort_spec = []  # scan ignores sort entirely (ScanContext)
         search_after = body.get("search_after")
         if search_after is not None and not sort_spec:
             raise SearchParseException(
@@ -126,12 +144,29 @@ class ShardSearcher:
         # 10k cap, no re-read of live state between pages); sorted scrolls
         # materialize the complete candidate list instead
         full_snap = [] if (collect_full and not sort_spec) else None
+        scan = collect_full and body.get("search_type") == "scan"
+        # terminate_after caps the per-shard COLLECTED count; timeout stops
+        # between segments (whole-segment programs aren't interruptible —
+        # the boundary is the segment, like Lucene's per-leaf cancellation)
+        terminate_after = body.get("terminate_after")
+        terminate_after = int(terminate_after) if terminate_after else None
+        timeout_s = _parse_timeout(body.get("timeout"))
+        t_begin = time.perf_counter()
+        terminated_early = False
+        timed_out = False
         # fused dense-impact top-k fast path: eligible request shapes skip
         # the [D] score row entirely (queries.fused_bm25_topk)
         fused_ok = (not aggs and not sort_spec and min_score is None
                     and search_after is None and not rescore_specs
                     and full_snap is None and not collect_full)
         for seg in self.segments:
+            if timeout_s is not None and (time.perf_counter() - t_begin
+                                          > timeout_s):
+                timed_out = True
+                break
+            if terminate_after is not None and total >= terminate_after:
+                terminated_early = True
+                break
             ctx = SegmentContext(seg, self.mappings, self.analysis, global_stats,
                                  all_segments=self.segments,
                                  index_name=self.index_name)
@@ -171,14 +206,22 @@ class ShardSearcher:
                 total += int(tot_dev)
                 sc = np.asarray(scores)
                 mk = np.asarray(mask)
-                n_match = int(mk[: seg.num_docs].sum())
-                eff = np.where(mk, sc, -np.inf)
-                order = np.argsort(-eff, kind="stable")[:n_match].astype(np.int32)
-                full_snap.append((seg, order, sc))
-                seg_docs = [
-                    ShardDoc(self.shard_ord, seg, int(i), float(sc[i]))
-                    for i in order[: min(k, order.size)]
-                ]
+                if scan:
+                    # scan search_type: index order, no ranking (reference:
+                    # search/scan/ScanContext.java — docs stream in doc-id
+                    # order; the initial page returns no hits)
+                    order = np.nonzero(mk[: seg.num_docs])[0].astype(np.int32)
+                    full_snap.append((seg, order, sc))
+                    seg_docs = []
+                else:
+                    n_match = int(mk[: seg.num_docs].sum())
+                    eff = np.where(mk, sc, -np.inf)
+                    order = np.argsort(-eff, kind="stable")[:n_match].astype(np.int32)
+                    full_snap.append((seg, order, sc))
+                    seg_docs = [
+                        ShardDoc(self.shard_ord, seg, int(i), float(sc[i]))
+                        for i in order[: min(k, order.size)]
+                    ]
             else:
                 import jax
 
@@ -212,6 +255,9 @@ class ShardSearcher:
                           segments=self.segments)
             docs = docs[: min(max(size + frm, 1), 10_000)]
             max_score = max((d.score for d in docs), default=float("-inf"))
+        if terminate_after is not None and total >= terminate_after:
+            terminated_early = True
+            total = min(total, terminate_after)
         merged_aggs = agg_partials if aggs else None
         return QueryPhaseResult(
             docs=docs,
@@ -219,6 +265,8 @@ class ShardSearcher:
             max_score=max_score if docs and max_score != float("-inf") else float("nan"),
             agg_partials={"_list": merged_aggs, "_aggs": aggs} if aggs else None,
             full=full_snap,
+            terminated_early=terminated_early,
+            timed_out=timed_out,
         )
 
     def _sorted_candidates(self, ctx, scores, mask, sort_spec, k, search_after):
@@ -299,8 +347,39 @@ class ShardSearcher:
                 ctx = SegmentContext(d.seg, self.mappings, self.analysis)
                 hit["highlight"] = self._highlight(ctx, query, src, hl)
             hits.append(hit)
+        self._attach_matched_queries(query, docs, hits)
         self._attach_inner_hits(query, docs, hits, index_name)
         return hits
+
+    def _attach_matched_queries(self, query, docs: List[ShardDoc],
+                                hits: List[dict]) -> None:
+        """matched_queries (reference: search/fetch/matchedqueries/
+        MatchedQueriesFetchSubPhase.java:1-95): for each _name'd node in
+        the query tree, report which page hits its mask matches — one mask
+        evaluation per (segment, name), never per doc."""
+        from elasticsearch_tpu.search.queries import collect_named
+
+        named = collect_named(query)
+        if not named:
+            return
+        cache: Dict[tuple, Optional[np.ndarray]] = {}
+        for d, hit in zip(docs, hits):
+            names = []
+            for nm, node in named:
+                key = (nm, id(d.seg))
+                mk = cache.get(key, False)
+                if mk is False:
+                    try:
+                        ctx = SegmentContext(d.seg, self.mappings,
+                                             self.analysis)
+                        mk = np.asarray(node.execute(ctx)[1])
+                    except Exception:
+                        mk = None  # e.g. join nodes needing prepare_tree
+                    cache[key] = mk
+                if mk is not None and mk[d.local_id]:
+                    names.append(nm)
+            if names:
+                hit["matched_queries"] = names
 
     def _attach_inner_hits(self, query, docs: List[ShardDoc], hits: List[dict],
                            index_name: str) -> None:
@@ -423,6 +502,8 @@ def search_shards(
     size = int(body.get("size", 10))
     frm = int(body.get("from", 0))
     sort_spec = _parse_sort(body.get("sort"))
+    if body.get("scroll") and body.get("search_type") == "scan":
+        sort_spec = []  # scan ignores sort entirely (ScanContext)
 
     # scroll snapshots the COMPLETE match set (point-in-time: segment object
     # refs pin the frozen segments; merges/deletes between pages can't
@@ -453,6 +534,29 @@ def search_shards(
                     "time_in_nanos": int(q_ms * 1e6),
                 }]}],
             })
+    # indices_boost: per-index score multipliers applied BEFORE the global
+    # merge (reference: SearchRequest.indicesBoost / query-phase boost)
+    ib = body.get("indices_boost")
+    if ib:
+        import fnmatch as _fn
+
+        items = (ib.items() if isinstance(ib, dict)
+                 else [(k, v) for d in ib for k, v in d.items()])
+        boosts = [(pat, float(v)) for pat, v in items]
+        for s, r in zip(searchers, results):
+            b = next((v for pat, v in boosts
+                      if _fn.fnmatch(s.index_name, pat)), None)
+            if b is None or b == 1.0:
+                continue
+            for d in r.docs:
+                if np.isfinite(d.score):
+                    d.score *= b
+            if not np.isnan(r.max_score):
+                r.max_score *= b
+            if r.full:
+                # snapshot scores may be read-only views of device arrays —
+                # rebuild rather than multiply in place
+                r.full = [(seg, order, sc * b) for seg, order, sc in r.full]
     all_docs: List[ShardDoc] = []
     total = 0
     max_score = float("-inf")
@@ -470,6 +574,7 @@ def search_shards(
     # Page 1 is served FROM the snapshot so its tie ordering and every later
     # page's agree exactly (keys: -score, shard, local, then segment).
     snapshot = None
+    scan = scroll and body.get("search_type") == "scan"
     if scroll and not sort_spec:
         segs: List[Tuple[int, Any]] = []
         seg_of_parts, shard_parts, local_parts, score_parts = [], [], [], []
@@ -486,7 +591,11 @@ def search_shards(
             shard_of = np.concatenate(shard_parts)
             local = np.concatenate(local_parts)
             score = np.concatenate(score_parts)
-            glob = np.lexsort((seg_of, local, shard_of, -score))
+            if scan:
+                # scan: stream in (shard, segment, doc-id) order, unranked
+                glob = np.lexsort((local, seg_of, shard_of))
+            else:
+                glob = np.lexsort((seg_of, local, shard_of, -score))
             snapshot = {"segs": segs, "seg_of": seg_of[glob],
                         "local": local[glob], "score": score[glob]}
         else:
@@ -494,12 +603,16 @@ def search_shards(
                         "local": np.empty(0, np.int32),
                         "score": np.empty(0, np.float32)}
         segs_l = snapshot["segs"]
-        page = [
-            ShardDoc(segs_l[si][0], segs_l[si][1], int(li), float(sc))
-            for si, li, sc in zip(snapshot["seg_of"][frm: frm + size],
-                                  snapshot["local"][frm: frm + size],
-                                  snapshot["score"][frm: frm + size])
-        ]
+        if scan:
+            page = []  # scan's first response carries no hits — only the
+            # scroll id and total (reference: ScanContext)
+        else:
+            page = [
+                ShardDoc(segs_l[si][0], segs_l[si][1], int(li), float(sc))
+                for si, li, sc in zip(snapshot["seg_of"][frm: frm + size],
+                                      snapshot["local"][frm: frm + size],
+                                      snapshot["score"][frm: frm + size])
+            ]
     else:
         page = all_docs[frm : frm + size]
 
@@ -522,7 +635,7 @@ def search_shards(
 
     response: Dict[str, Any] = {
         "took": int((time.perf_counter() - t0) * 1000),
-        "timed_out": False,
+        "timed_out": any(r.timed_out for r in results),
         "_shards": {"total": len(searchers), "successful": len(searchers), "failed": 0},
         "hits": {
             "total": total,
@@ -530,6 +643,8 @@ def search_shards(
             "hits": hits,
         },
     }
+    if any(r.terminated_early for r in results):
+        response["terminated_early"] = True
     aggs_present = [r.agg_partials for r in results if r.agg_partials]
     if aggs_present:
         aggs = aggs_present[0]["_aggs"]
@@ -544,7 +659,8 @@ def search_shards(
             s.stats.on_scroll()
         scroll_id = uuid.uuid4().hex
         state: Dict[str, Any] = {
-            "pos": frm + size,
+            # scan serves every doc via scrolling — page 1 consumed nothing
+            "pos": 0 if scan else frm + size,
             "body": body,
             "searchers": searchers,
             "index_name": index_name,

@@ -1,0 +1,150 @@
+"""Meta-field batch (round-3 verdict task 8): _timestamp, _ttl,
+_field_names, _size — mapping + index + query round trips.
+
+Reference: mapper/internal/TimestampFieldMapper.java:1-336,
+TTLFieldMapper.java:1-228, SizeFieldMapper.java, FieldNamesFieldMapper.java.
+"""
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+def test_timestamp_indexed_and_range_queryable():
+    n = Node()
+    n.create_index("ts", {"mappings": {
+        "_timestamp": {"enabled": True},
+        "properties": {"t": {"type": "text"}}}})
+    svc = n.indices["ts"]
+    svc.index_doc("old", {"t": "x"}, timestamp="2020-01-01")
+    svc.index_doc("new", {"t": "x"}, timestamp="2023-06-15")
+    svc.index_doc("auto", {"t": "x"})  # default: now
+    svc.refresh()
+    r = n.search("ts", {"query": {"range": {"_timestamp": {
+        "gte": "2022-01-01", "lte": "2024-01-01"}}}})
+    assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["new"]
+    r2 = n.search("ts", {"query": {"range": {"_timestamp": {
+        "lte": int(time.time() * 1000) + 1000}}}})
+    assert r2["hits"]["total"] == 3
+    # sortable like any date column
+    r3 = n.search("ts", {"query": {"match_all": {}},
+                         "sort": [{"_timestamp": "asc"}], "size": 3})
+    assert [h["_id"] for h in r3["hits"]["hits"]][:2] == ["old", "new"]
+    n.close()
+
+
+def test_ttl_purges_on_refresh_and_merge():
+    n = Node()
+    n.create_index("tt", {"mappings": {
+        "_timestamp": {"enabled": True},
+        "_ttl": {"enabled": True},
+        "properties": {"t": {"type": "text"}}}})
+    svc = n.indices["tt"]
+    svc.index_doc("dead", {"t": "x"}, ttl=1)  # expires ~immediately
+    svc.index_doc("alive", {"t": "x"}, ttl="1h")
+    svc.index_doc("forever", {"t": "x"})  # no ttl
+    time.sleep(0.01)
+    svc.refresh()  # purge runs before freeze
+    r = n.search("tt", {"query": {"match_all": {}}})
+    assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["alive", "forever"]
+    assert not svc.get_doc("dead")["found"]
+    # expiry survives a merge (meta carries the resolved value)
+    svc.index_doc("dead2", {"t": "x"}, ttl=1)
+    svc.refresh()
+    time.sleep(0.01)
+    svc.force_merge(1)
+    svc.refresh()
+    r2 = n.search("tt", {"query": {"match_all": {}}})
+    assert sorted(h["_id"] for h in r2["hits"]["hits"]) == ["alive", "forever"]
+    n.close()
+
+
+def test_field_names_backs_exists_queries():
+    n = Node()
+    n.create_index("fn", {"mappings": {"properties": {
+        "a": {"type": "text"}, "b": {"type": "long"}}}})
+    svc = n.indices["fn"]
+    svc.index_doc("1", {"a": "hello"})
+    svc.index_doc("2", {"b": 7})
+    svc.index_doc("3", {"a": "world", "b": 9})
+    svc.refresh()
+    r = n.search("fn", {"query": {"term": {"_field_names": "a"}}})
+    assert sorted(h["_id"] for h in r["hits"]["hits"]) == ["1", "3"]
+    r2 = n.search("fn", {"query": {"term": {"_field_names": "b"}}})
+    assert sorted(h["_id"] for h in r2["hits"]["hits"]) == ["2", "3"]
+    # missing = NOT _field_names (the reference implements missing this way)
+    r3 = n.search("fn", {"query": {"bool": {"must_not": [
+        {"term": {"_field_names": "b"}}]}}})
+    assert sorted(h["_id"] for h in r3["hits"]["hits"]) == ["1"]
+    n.close()
+
+
+def test_field_names_can_be_disabled():
+    n = Node()
+    n.create_index("fnoff", {"mappings": {
+        "_field_names": {"enabled": False},
+        "properties": {"a": {"type": "text"}}}})
+    svc = n.indices["fnoff"]
+    svc.index_doc("1", {"a": "x"})
+    svc.refresh()
+    seg = svc.shards[0].segments[0]
+    assert "_field_names" not in seg.keywords
+    n.close()
+
+
+def test_size_meta_field():
+    n = Node()
+    n.create_index("sz", {"mappings": {
+        "_size": {"enabled": True},
+        "properties": {"t": {"type": "text"}}}})
+    svc = n.indices["sz"]
+    svc.index_doc("small", {"t": "x"})
+    svc.index_doc("big", {"t": "x " * 200})
+    svc.refresh()
+    r = n.search("sz", {"query": {"range": {"_size": {"gt": 100}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["big"]
+    r2 = n.search("sz", {"query": {"match_all": {}},
+                         "sort": [{"_size": "desc"}], "size": 2})
+    assert [h["_id"] for h in r2["hits"]["hits"]] == ["big", "small"]
+    n.close()
+
+
+def test_timestamp_ttl_survive_translog_replay(tmp_path):
+    n = Node(data_path=str(tmp_path))
+    n.create_index("dur", {"mappings": {
+        "_timestamp": {"enabled": True}, "_ttl": {"enabled": True},
+        "properties": {"t": {"type": "text"}}}})
+    svc = n.indices["dur"]
+    # ttl is RELATIVE TO _timestamp (TTLFieldMapper: expiry = ts + ttl), so
+    # doc 1 pins the timestamp only; doc 2 gets a now-based ttl
+    svc.index_doc("1", {"t": "x"}, timestamp="2022-03-04")
+    svc.index_doc("2", {"t": "x"}, ttl="10h")
+    n.close()  # no flush: docs ride the translog to the next open
+
+    n2 = Node(data_path=str(tmp_path))
+    svc2 = n2.indices["dur"]
+    svc2.refresh()
+    seg = svc2.shards[0].segments[0]
+    ts = int(seg.numerics["_timestamp"].exact[seg.id_map["1"]])
+    from elasticsearch_tpu.utils.dates import parse_date
+
+    assert ts == parse_date("2022-03-04",
+                            "strict_date_optional_time||epoch_millis")
+    now = int(time.time() * 1000)
+    exp = int(seg.numerics["_ttl"].exact[seg.id_map["2"]])
+    assert now + 9 * 3600 * 1000 < exp <= now + 10 * 3600 * 1000
+    n2.close()
+
+
+def test_ttl_numeric_and_bad_values():
+    from elasticsearch_tpu.index.doc_parser import _ttl_to_millis
+    from elasticsearch_tpu.utils.errors import MapperParsingException
+
+    assert _ttl_to_millis("60000") == 60000  # REST delivers params as str
+    assert _ttl_to_millis(5000) == 5000
+    assert _ttl_to_millis("2h") == 2 * 3600 * 1000
+    import pytest as _pytest
+
+    with _pytest.raises(MapperParsingException):
+        _ttl_to_millis("soon")

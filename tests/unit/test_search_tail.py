@@ -1,0 +1,172 @@
+"""Search-feature tail parity (round-3 verdict task 7): matched_queries,
+terminate_after, timeout, indices_boost, scan search_type, real
+common_terms scoring, termvectors statistics."""
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture()
+def node():
+    n = Node()
+    n.create_index("a", {"mappings": {"properties": {
+        "body": {"type": "text", "analyzer": "whitespace"},
+        "tag": {"type": "keyword"}, "v": {"type": "long"}}}})
+    svc = n.indices["a"]
+    texts = ["the quick fox", "the lazy dog", "the dog and the fox",
+             "the the the", "quick dog"]
+    for i, t in enumerate(texts):
+        svc.index_doc(str(i), {"body": t, "tag": "even" if i % 2 == 0 else "odd",
+                               "v": i})
+    svc.refresh()
+    yield n
+    n.close()
+
+
+def test_matched_queries(node):
+    """MatchedQueriesFetchSubPhase.java: _name'd clauses report per hit."""
+    r = node.search("a", {"query": {"bool": {
+        "must": [{"match": {"body": {"query": "dog", "_name": "has_dog"}}}],
+        "should": [{"term": {"tag": {"value": "even", "_name": "is_even"}}},
+                   {"match": {"body": {"query": "quick", "_name": "is_quick"}}}],
+    }}, "size": 10})
+    by_id = {h["_id"]: sorted(h.get("matched_queries", [])) for h in r["hits"]["hits"]}
+    assert by_id["1"] == ["has_dog"]                       # odd, no quick
+    assert by_id["2"] == ["has_dog", "is_even"]
+    assert by_id["4"] == ["has_dog", "is_even", "is_quick"]
+
+
+def test_terminate_after(node):
+    """SearchContext terminateAfter: collected count capped per shard."""
+    r = node.search("a", {"query": {"match": {"body": "the"}},
+                          "terminate_after": 2})
+    assert r["hits"]["total"] == 2
+    assert r["terminated_early"] is True
+    r2 = node.search("a", {"query": {"match": {"body": "the"}}})
+    assert r2["hits"]["total"] == 4
+    assert "terminated_early" not in r2
+
+
+def test_timeout_partial_results(node):
+    """A 0ms budget times out before any segment executes — partial result
+    with timed_out: true, never an error."""
+    r = node.search("a", {"query": {"match": {"body": "the"}},
+                          "timeout": "0ms"})
+    assert r["timed_out"] is True
+    r2 = node.search("a", {"query": {"match": {"body": "the"}},
+                           "timeout": "30s"})
+    assert r2["timed_out"] is False and r2["hits"]["total"] == 4
+
+
+def test_indices_boost(node):
+    node.create_index("b", {"mappings": {"properties": {
+        "body": {"type": "text", "analyzer": "whitespace"}}}})
+    node.indices["b"].index_doc("b1", {"body": "the quick fox"})
+    node.indices["b"].refresh()
+    r = node.search("a,b", {"query": {"match": {"body": "fox"}}, "size": 10})
+    base = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    r2 = node.search("a,b", {"query": {"match": {"body": "fox"}}, "size": 10,
+                             "indices_boost": {"b": 10.0}})
+    boosted = {h["_id"]: h["_score"] for h in r2["hits"]["hits"]}
+    assert boosted["b1"] == pytest.approx(base["b1"] * 10.0, rel=1e-5)
+    assert boosted["0"] == pytest.approx(base["0"], rel=1e-5)
+    assert r2["hits"]["hits"][0]["_id"] == "b1"  # boost reorders the merge
+
+
+def test_scan_search_type(node):
+    """ScanContext.java: first response has no hits, scrolling streams every
+    match in doc order."""
+    from elasticsearch_tpu.search.service import clear_scroll, scroll_next
+
+    r = node.search("a", {"query": {"match": {"body": "the"}},
+                          "scroll": "1m", "search_type": "scan", "size": 2})
+    assert r["hits"]["total"] == 4 and r["hits"]["hits"] == []
+    sid = r["_scroll_id"]
+    got = []
+    while True:
+        page = scroll_next(sid)
+        if not page["hits"]["hits"]:
+            break
+        got.extend(h["_id"] for h in page["hits"]["hits"])
+    clear_scroll(sid)
+    assert got == ["0", "1", "2", "3"]  # doc order, not score order
+
+
+def test_timeout_bad_value_is_400(node):
+    from elasticsearch_tpu.utils.errors import SearchParseException
+
+    with pytest.raises(SearchParseException):
+        node.search("a", {"query": {"match_all": {}}, "timeout": "10minutes"})
+
+
+def test_scan_ignores_sort_and_scroll_boost_works(node):
+    from elasticsearch_tpu.search.service import clear_scroll, scroll_next
+
+    r = node.search("a", {"query": {"match": {"body": "the"}},
+                          "scroll": "1m", "search_type": "scan",
+                          "sort": [{"v": "desc"}], "size": 2})
+    assert r["hits"]["hits"] == []  # sort ignored: still a scan
+    got = []
+    sid = r["_scroll_id"]
+    while True:
+        page = scroll_next(sid)
+        if not page["hits"]["hits"]:
+            break
+        got.extend(h["_id"] for h in page["hits"]["hits"])
+    clear_scroll(sid)
+    assert got == ["0", "1", "2", "3"]  # doc order, no duplicates
+    # indices_boost composes with scroll snapshots (read-only-view crash)
+    r2 = node.search("a", {"query": {"match": {"body": "the"}},
+                           "scroll": "1m", "indices_boost": {"a": 2.0},
+                           "size": 2})
+    assert len(r2["hits"]["hits"]) == 2
+    clear_scroll(r2["_scroll_id"])
+
+
+def test_common_terms_cutoff_scoring(node):
+    """CommonTermsQueryBuilder.java: high-freq terms ('the', df 4/5) never
+    select on their own — only docs matching the low-freq group match."""
+    q = {"common": {"body": {"query": "the fox",
+                             "cutoff_frequency": 0.5}}}
+    r = node.search("a", {"query": q, "size": 10})
+    ids = sorted(h["_id"] for h in r["hits"]["hits"])
+    assert ids == ["0", "2"]  # docs with 'fox'; 1/3 have only 'the'
+    # high-freq group still contributes score: doc 2 has 'the' twice
+    plain = node.search("a", {"query": {"term": {"body": "fox"}}, "size": 10})
+    plain_scores = {h["_id"]: h["_score"] for h in plain["hits"]["hits"]}
+    common_scores = {h["_id"]: h["_score"] for h in r["hits"]["hits"]}
+    assert common_scores["2"] > plain_scores["2"]
+    # all-high-freq query degenerates to the high_freq_operator group
+    r2 = node.search("a", {"query": {"common": {"body": {
+        "query": "the", "cutoff_frequency": 0.5}}}})
+    assert r2["hits"]["total"] == 4
+
+
+def test_termvectors_statistics(node):
+    """TermVectorsRequest.java options: offsets + term/field statistics."""
+    from elasticsearch_tpu.rest.server import _termvectors
+
+    st, r = _termvectors(node, {"term_statistics": "true"}, b"", "a", "2")
+    assert st == 200 and r["found"]
+    tv = r["term_vectors"]["body"]
+    assert tv["field_statistics"]["doc_count"] == 5
+    assert tv["field_statistics"]["sum_ttf"] == sum(
+        len(t.split()) for t in ["the quick fox", "the lazy dog",
+                                 "the dog and the fox", "the the the",
+                                 "quick dog"])
+    the = tv["terms"]["the"]
+    assert the["term_freq"] == 2 and the["doc_freq"] == 4 and the["ttf"] == 7
+    tok = the["tokens"][0]
+    assert tok["position"] == 0
+    assert tok["start_offset"] == 0 and tok["end_offset"] == 3
+    fox = tv["terms"]["fox"]
+    assert fox["doc_freq"] == 2
+    # offsets point into the source text
+    src = "the dog and the fox"
+    t1 = fox["tokens"][0]
+    assert src[t1["start_offset"]:t1["end_offset"]] == "fox"
+    # options off: no stats section
+    st, r2 = _termvectors(node, {"field_statistics": "false",
+                                 "offsets": "false"}, b"", "a", "2")
+    assert "field_statistics" not in r2["term_vectors"]["body"]
+    assert "start_offset" not in r2["term_vectors"]["body"]["terms"]["the"]["tokens"][0]
