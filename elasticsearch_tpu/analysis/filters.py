@@ -299,10 +299,117 @@ def porter_stem_filter(tokens: List[Token]) -> List[Token]:
     return [(porter_stem(t), p) for t, p in tokens]
 
 
+# ---- light language stemmers -------------------------------------------------
+# UniNE-family light suffix-stripping stemmers — the algorithms behind
+# Lucene's FrenchLightStemmer/GermanLightStemmer/etc., which the reference
+# exposes via `stemmer`/`snowball` token filters (reference:
+# index/analysis/StemmerTokenFilterFactory.java,
+# SnowballAnalyzerProvider.java). Documented deviation: these are the
+# LIGHT stemmers (strip the longest matching inflectional suffix with a
+# minimum-stem guard), not full Snowball — the same trade Lucene's
+# "light_*" variants make. english/porter runs the real Porter algorithm.
+
+_UMLAUT_FOLD = str.maketrans({"ä": "a", "ö": "o", "ü": "u", "ß": "s",
+                              "á": "a", "à": "a", "â": "a", "é": "e",
+                              "è": "e", "ê": "e", "ë": "e", "î": "i",
+                              "ï": "i", "í": "i", "ô": "o", "ó": "o",
+                              "û": "u", "ù": "u", "ú": "u", "ç": "c",
+                              "ã": "a", "õ": "o", "ñ": "n", "å": "a",
+                              "ø": "o", "æ": "a"})
+
+# ordered longest-first; a suffix strips only when >= 3 chars of stem remain
+_LIGHT_SUFFIXES: dict = {
+    "french": ("issements", "issement", "atrices", "ateurs", "ations",
+               "atrice", "ateur", "ation", "ements", "ement", "euses",
+               "ences", "ience", "antes", "ables", "istes", "iques", "ismes",
+               "euse", "ence", "ante", "ants", "able", "iste", "ique",
+               "isme", "eaux", "elles", "elle", "ines", "ine", "ives", "ive",
+               "ifs", "aux", "ant", "ent", "ees", "és", "ée", "es", "er",
+               "ez", "e", "s"),
+    "german": ("ungen", "heiten", "keiten", "nisse", "ung", "heit", "keit",
+               "nis", "ern", "em", "en", "er", "es", "e", "s", "n"),
+    "spanish": ("amientos", "imientos", "amiento", "imiento", "aciones",
+                "uciones", "adoras", "adores", "ancias", "acion", "ucion",
+                "adora", "ador", "ancia", "mente", "ables", "ibles", "istas",
+                "able", "ible", "ista", "osos", "osas", "oso", "osa", "idad",
+                "ivas", "ivos", "iva", "ivo", "eza", "es", "os", "as", "o",
+                "a", "e"),
+    "italian": ("amenti", "imenti", "amento", "imento", "azioni", "azione",
+                "atrici", "atori", "mente", "abili", "ibili", "isti", "iste",
+                "abile", "ibile", "ista", "oso", "osa", "osi", "ose", "ità",
+                "ivo", "iva", "ivi", "ive", "i", "e", "o", "a"),
+    "portuguese": ("amentos", "imentos", "amento", "imento", "adoras",
+                   "adores", "ações", "uções", "ância", "mente",
+                   "idades", "idade", "ismos", "istas", "adora", "ación",
+                   "ador", "aria", "osos", "osas", "oso", "osa", "ivas",
+                   "ivos", "iva", "ivo", "es", "os", "as", "o", "a", "e"),
+    "dutch": ("heden", "ingen", "eren", "ing", "en", "je", "es", "s", "e"),
+    "swedish": ("heterna", "heten", "heter", "arna", "erna", "orna", "ande",
+                "arne", "aste", "aren", "ades", "are", "ade", "ast", "arn",
+                "et", "en", "ar", "er", "or", "at", "a", "e", "s"),
+    "norwegian": ("hetene", "heten", "heter", "endes", "ande", "ende", "enes",
+                  "ene", "ane", "ete", "ert", "et", "en", "ar", "er", "as",
+                  "es", "a", "e", "s"),
+    "danish": ("erendes", "erende", "hedens", "ethed", "erede", "heden",
+               "heder", "endes", "ernes", "erens", "erets", "erne", "eren",
+               "erer", "eres", "ered", "ende", "erne", "ets", "ere", "ens",
+               "ers", "ets", "en", "er", "es", "et", "e", "s"),
+    "russian": ("иями", "ями", "иях", "иям", "ами", "ого", "его", "ому",
+                "ему", "ыми", "ими", "ешь", "ишь", "ете", "ите", "ала",
+                "ыла", "ила", "ать", "ять", "ить", "еть", "ует", "ах", "ях",
+                "ам", "ям", "ом", "ем", "ой", "ей", "ый", "ий", "ая", "яя",
+                "ое", "ее", "ы", "и", "а", "я", "о", "е", "у", "ю", "ь"),
+}
+
+# suffixes must live in FOLDED form: light_stem folds the word before
+# matching, so accented entries would be unreachable (and singular/plural
+# pairs like nação/nações would stem apart). Fold the table once at import,
+# order-preserving and deduped.
+_LIGHT_SUFFIXES = {
+    lang: tuple(dict.fromkeys(s.translate(_UMLAUT_FOLD) for s in sufs))
+    for lang, sufs in _LIGHT_SUFFIXES.items()
+}
+
+_LIGHT_ALIASES = {
+    "light_french": "french", "light_german": "german", "german2": "german",
+    "light_spanish": "spanish", "light_italian": "italian",
+    "light_portuguese": "portuguese", "portuguese_rslp": "portuguese",
+    "light_swedish": "swedish", "light_norwegian": "norwegian",
+    "kp": "dutch", "light_russian": "russian",
+}
+
+
+def light_stem(word: str, language: str) -> str:
+    """Strip the longest matching inflectional suffix, keeping >= 3 chars
+    of stem (applied once — light stemming, not full Snowball)."""
+    w = word.lower()
+    if language in ("german", "french", "spanish", "portuguese", "italian",
+                    "swedish", "norwegian", "danish"):
+        w = w.translate(_UMLAUT_FOLD)
+    if language == "portuguese":
+        # nasal plural normalization (ões/ãos/ães → ão, folded) — the rule
+        # PortugueseLightStemmer applies before suffix stripping; without it
+        # nação/nações stem apart
+        for pl in ("oes", "aos", "aes"):
+            if w.endswith(pl) and len(w) - len(pl) >= 2:
+                w = w[: -len(pl)] + "ao"
+                break
+    for suf in _LIGHT_SUFFIXES[language]:
+        if w.endswith(suf) and len(w) - len(suf) >= 3:
+            return w[: -len(suf)]
+    return w
+
+
 def stemmer_filter(tokens: List[Token], language: str = "english") -> List[Token]:
-    if language in ("english", "porter", "porter2", "light_english"):
+    # ES documents capitalized snowball names ("German", "French")
+    lang = str(language).lower()
+    lang = _LIGHT_ALIASES.get(lang, lang)
+    if lang in ("english", "porter", "porter2", "light_english", "minimal_english"):
         return porter_stem_filter(tokens)
-    # other languages degrade to identity (documented stub; snowball langs in R3)
+    if lang in _LIGHT_SUFFIXES:
+        return [(light_stem(t, lang), p) for t, p in tokens]
+    # unknown languages degrade to identity (documented: only the table
+    # above is supported)
     return list(tokens)
 
 

@@ -125,3 +125,69 @@ def read_postings(data: bytes) -> Dict[str, Any]:
         "stats": header["stats"],
         **arrays,
     }
+
+
+def write_ivf(ivf) -> bytes:
+    """Serialize an IvfIndex (centroids f32, padded lists i32, lens i32)
+    with the same header+CRC framing as postings blobs — the durable form
+    that lets a disk-backed store (or snapshot sidecar) restore ANN state
+    without re-running k-means."""
+    cents = np.asarray(ivf.centroids, np.float32)
+    lists = np.asarray(ivf.lists, np.int64).reshape(-1)
+    lens = np.asarray(ivf.list_lens, np.int64)
+    sections = [
+        ("centroids", cents.tobytes(), int(cents.size)),
+        ("lists", vbyte_encode(lists), int(lists.size)),
+        ("list_lens", vbyte_encode(lens), int(lens.size)),
+    ]
+    header = {
+        "kind": "ivf",
+        "stats": {"C": ivf.C, "Lmax": ivf.Lmax, "sentinel": ivf.sentinel,
+                  "avg_len": ivf.avg_len, "metric": ivf.metric,
+                  "dims": int(cents.shape[1])},
+        "sections": [{"name": n, "len": len(b), "crc": crc32(b), "count": c}
+                     for n, b, c in sections],
+    }
+    hraw = json.dumps(header, separators=(",", ":")).encode()
+    out = bytearray(_U32.pack(len(hraw)) + hraw)
+    for _, b, _c in sections:
+        out += b
+    return bytes(out)
+
+
+def read_ivf(data: bytes):
+    """Parse an IVF blob back to a device-resident IvfIndex (CRC-verified)."""
+    import jax
+
+    from elasticsearch_tpu.ops.ivf import IvfIndex
+
+    if len(data) < 4:
+        raise CorruptStoreException("ivf blob truncated")
+    (hlen,) = _U32.unpack(data[:4])
+    if 4 + hlen > len(data):
+        raise CorruptStoreException("ivf header exceeds blob size")
+    try:
+        header = json.loads(data[4 : 4 + hlen])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CorruptStoreException(f"ivf header unreadable: {e}")
+    st = header["stats"]
+    cursor = 4 + hlen
+    raws: Dict[str, Any] = {}
+    for sec in header["sections"]:
+        raw = data[cursor : cursor + sec["len"]]
+        if len(raw) != sec["len"] or crc32(raw) != sec["crc"]:
+            raise CorruptStoreException(
+                f"ivf section [{sec['name']}] failed its checksum")
+        cursor += sec["len"]
+        raws[sec["name"]] = (raw, sec["count"])
+    cents = np.frombuffer(raws["centroids"][0], np.float32).reshape(
+        st["C"], st["dims"]).copy()
+    lists = vbyte_decode(*raws["lists"]).astype(np.int32).reshape(
+        st["C"], st["Lmax"])
+    lens = vbyte_decode(*raws["list_lens"]).astype(np.int32)
+    return IvfIndex(
+        centroids=jax.device_put(cents), lists=jax.device_put(lists),
+        list_lens=jax.device_put(lens), C=int(st["C"]), Lmax=int(st["Lmax"]),
+        sentinel=int(st["sentinel"]), avg_len=float(st["avg_len"]),
+        metric=st.get("metric", "cosine"),
+    )

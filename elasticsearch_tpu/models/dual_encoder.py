@@ -256,3 +256,37 @@ class SimpleTokenizer:
                              % (self.cfg.vocab_size - 1)) + 1
             mask[i, : len(toks)] = 1.0
         return ids, mask
+
+
+# ---------------------------------------------------------------------------
+# checkpointing (SURVEY §5: orbax for vector-model checkpoints)
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(path: str, params, opt_state=None, step: int = 0,
+                    cfg: "DualEncoderConfig" = None) -> None:
+    """Durable dual-encoder state via orbax (reference role: the snapshot
+    of the embedding model that generates `dense_vector` values — ES has no
+    counterpart; SURVEY §5 names orbax as the checkpoint layer)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    payload = {"params": params, "step": step}
+    if opt_state is not None:
+        payload["opt_state"] = opt_state
+    if cfg is not None:
+        from dataclasses import asdict
+
+        payload["config"] = asdict(cfg)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(os.path.abspath(path), payload, force=True)
+
+
+def load_checkpoint(path: str):
+    """-> {"params", "step", "opt_state"?, "config"?} (device arrays)."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(os.path.abspath(path))

@@ -1,0 +1,21 @@
+"""Rivers — intentionally absent (documented stub, SURVEY §2.11).
+
+Reference: org/elasticsearch/river/ — the pull-based ingestion plugins
+deprecated in ES 1.5 and REMOVED in the 2.0 line this rebuild targets
+(RiversService remained only as a migration shim). The supported
+replacements are the same ones the reference pointed users at: push
+ingestion through the bulk API (`POST /_bulk`) or an external feeder
+process using the Python client.
+
+Any attempt to register a river raises, matching the reference's removal
+rather than pretending support.
+"""
+from __future__ import annotations
+
+from elasticsearch_tpu.utils.errors import IllegalArgumentException
+
+
+def register_river(name: str, config: dict) -> None:
+    raise IllegalArgumentException(
+        f"rivers were removed in the 2.0 line (river [{name}] cannot be "
+        f"registered); use the _bulk API or an external feeder instead")

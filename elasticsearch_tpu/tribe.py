@@ -1,0 +1,41 @@
+"""Tribe node — documented stub (SURVEY §2.11).
+
+Reference: org/elasticsearch/tribe/TribeService.java — a tribe node joins
+MULTIPLE clusters as a read-only member and merges their cluster states
+into one view. This rebuild's multi-host layer (cluster/bootstrap.py) is a
+single-cluster control plane; federating several of them is out of scope
+and this module says so explicitly instead of half-working.
+
+What exists today: `TribeNode.search_remote` fans a search out to a list
+of remote REST endpoints with the plain HTTP client and merges hit lists
+client-side — the read-only core of the tribe use case — while cluster
+state federation (the hard part: conflicting index names, routing merge)
+raises NotImplementedError with the reference pointer.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from elasticsearch_tpu.client import Client
+
+
+class TribeNode:
+    def __init__(self, endpoints: List[str]):
+        self.clients = [Client(url) for url in endpoints]
+
+    def search_remote(self, index: str, body: dict, size: int = 10) -> dict:
+        """Scatter a search to every remote cluster, merge by _score."""
+        hits: List[dict] = []
+        total = 0
+        for c in self.clients:
+            r = c.search(index=index, body=body)
+            total += r["hits"]["total"]
+            hits.extend(r["hits"]["hits"])
+        hits.sort(key=lambda h: -(h.get("_score") or 0.0))
+        return {"hits": {"total": total, "hits": hits[:size]}}
+
+    def merged_cluster_state(self) -> Dict:
+        raise NotImplementedError(
+            "tribe cluster-state federation is not implemented (reference: "
+            "tribe/TribeService.java — on-conflict index preference, merged "
+            "routing); use search_remote for the read-only fan-out")

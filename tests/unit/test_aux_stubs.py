@@ -1,0 +1,41 @@
+"""watcher / river / tribe (SURVEY §2.11 — r3 verdict honesty sweep)."""
+import time
+
+import pytest
+
+from elasticsearch_tpu.river import register_river
+from elasticsearch_tpu.utils.errors import IllegalArgumentException
+from elasticsearch_tpu.watcher import ResourceWatcherService
+
+
+def test_resource_watcher_fires_events(tmp_path):
+    svc = ResourceWatcherService(interval=0.05)
+    p = tmp_path / "synonyms.txt"
+    events = []
+    svc.add(str(p), lambda path, ev: events.append(ev))
+    assert svc.check_now() == 0
+    p.write_text("a, b")
+    assert svc.check_now() == 1 and events == ["created"]
+    time.sleep(0.02)
+    p.write_text("a, b, c")
+    import os
+
+    os.utime(p, (time.time(), time.time() + 1))  # force mtime change
+    svc.check_now()
+    assert events[-1] == "changed"
+    p.unlink()
+    svc.check_now()
+    assert events[-1] == "deleted"
+
+
+def test_river_registration_rejected_like_2x():
+    with pytest.raises(IllegalArgumentException):
+        register_river("couchdb", {})
+
+
+def test_tribe_state_federation_is_explicit_stub():
+    from elasticsearch_tpu.tribe import TribeNode
+
+    t = TribeNode([])
+    with pytest.raises(NotImplementedError):
+        t.merged_cluster_state()

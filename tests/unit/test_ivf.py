@@ -103,3 +103,72 @@ def test_knn_ann_through_engine():
                         "size": 5})
     assert all(int(h["_id"]) % 4 == 2 for h in r3["hits"]["hits"])
     n.close()
+
+
+def test_ivf_built_eagerly_at_freeze():
+    """r3 verdict weak #9: IVF must be built at freeze (index time), not
+    lazily on the first query after restart/merge."""
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    n.create_index("eager", {"mappings": {"properties": {
+        "emb": {"type": "dense_vector", "dims": 8,
+                "index_options": {"type": "ivf"}}}}})
+    svc = n.indices["eager"]
+    rng = np.random.default_rng(5)
+    for i in range(128):
+        svc.index_doc(str(i), {"emb": [float(x) for x in rng.random(8)]})
+    svc.refresh()
+    seg = svc.shards[0].segments[0]
+    assert seg.vectors["emb"]._ivf not in (None, False)  # no query ran yet
+    # merges rebuild eagerly too (merge -> freeze path)
+    for i in range(128, 160):
+        svc.index_doc(str(i), {"emb": [float(x) for x in rng.random(8)]})
+    svc.refresh()
+    svc.force_merge(1)
+    seg2 = svc.shards[0].segments[0]
+    assert seg2.vectors["emb"]._ivf not in (None, False)
+    n.close()
+
+
+def test_ivf_codec_roundtrip():
+    """write_ivf/read_ivf: the durable ANN form restores an equivalent
+    index (same probes, same candidates) without re-running k-means."""
+    from elasticsearch_tpu.index.store import read_ivf, write_ivf
+    from elasticsearch_tpu.ops.ivf import build_ivf, ivf_candidate_scores
+
+    rng = np.random.default_rng(9)
+    vecs = rng.standard_normal((512, 16)).astype(np.float32)
+    exists = np.ones(512, bool)
+    ivf = build_ivf(vecs, exists, 512, metric="cosine")
+    blob = write_ivf(ivf)
+    back = read_ivf(blob)
+    assert back.C == ivf.C and back.Lmax == ivf.Lmax
+    assert back.metric == ivf.metric and back.sentinel == ivf.sentinel
+    np.testing.assert_array_equal(np.asarray(back.lists),
+                                  np.asarray(ivf.lists))
+    np.testing.assert_allclose(np.asarray(back.centroids),
+                               np.asarray(ivf.centroids), rtol=1e-6)
+    import jax
+
+    q = rng.standard_normal(16).astype(np.float32)
+    dv = jax.device_put(vecs)
+    s1, m1 = ivf_candidate_scores(ivf, dv, q, 64, "cosine", 512)
+    s2, m2 = ivf_candidate_scores(back, dv, q, 64, "cosine", 512)
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+    np.testing.assert_allclose(np.asarray(s1)[np.asarray(m1)],
+                               np.asarray(s2)[np.asarray(m2)], rtol=1e-6)
+
+
+def test_ivf_codec_detects_corruption():
+    from elasticsearch_tpu.index.store import (CorruptStoreException,
+                                               read_ivf, write_ivf)
+    from elasticsearch_tpu.ops.ivf import build_ivf
+
+    rng = np.random.default_rng(11)
+    vecs = rng.standard_normal((128, 8)).astype(np.float32)
+    ivf = build_ivf(vecs, np.ones(128, bool), 128)
+    blob = bytearray(write_ivf(ivf))
+    blob[-3] ^= 0xFF  # flip a payload byte
+    with pytest.raises(CorruptStoreException):
+        read_ivf(bytes(blob))
