@@ -129,9 +129,12 @@ def read_postings(data: bytes) -> Dict[str, Any]:
 
 def write_ivf(ivf) -> bytes:
     """Serialize an IvfIndex (centroids f32, padded lists i32, lens i32)
-    with the same header+CRC framing as postings blobs — the durable form
-    that lets a disk-backed store (or snapshot sidecar) restore ANN state
-    without re-running k-means."""
+    with the same header+CRC framing as postings blobs. This is the durable
+    FORMAT for a disk-backed segment store; today's snapshot/restore path
+    re-indexes _source and rebuilds IVF eagerly at freeze instead (restore
+    segments don't correspond 1:1 with snapshot segments), so the codec's
+    consumers are the format tests until the disk store lands — stated
+    plainly, same as the postings codec above."""
     cents = np.asarray(ivf.centroids, np.float32)
     lists = np.asarray(ivf.lists, np.int64).reshape(-1)
     lens = np.asarray(ivf.list_lens, np.int64)

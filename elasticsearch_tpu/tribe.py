@@ -21,14 +21,17 @@ from elasticsearch_tpu.client import Client
 
 class TribeNode:
     def __init__(self, endpoints: List[str]):
-        self.clients = [Client(url) for url in endpoints]
+        self.clients = [Client(url=url) for url in endpoints]
 
     def search_remote(self, index: str, body: dict, size: int = 10) -> dict:
-        """Scatter a search to every remote cluster, merge by _score."""
+        """Scatter a search to every remote cluster, merge by _score. Each
+        remote is asked for the full merged window — a cluster's 11th-best
+        hit may be the tribe's 3rd."""
         hits: List[dict] = []
         total = 0
+        remote_body = {**body, "size": max(size, int(body.get("size", 10)))}
         for c in self.clients:
-            r = c.search(index=index, body=body)
+            r = c.search(index=index, body=remote_body)
             total += r["hits"]["total"]
             hits.extend(r["hits"]["hits"])
         hits.sort(key=lambda h: -(h.get("_score") or 0.0))

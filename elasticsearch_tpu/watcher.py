@@ -81,4 +81,8 @@ class ResourceWatcherService:
 
     def stop(self) -> None:
         self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.interval + 1.0)  # a stop→start pair must
+            # never leave two pollers racing on the same watch map
         self._thread = None

@@ -39,3 +39,36 @@ def test_tribe_state_federation_is_explicit_stub():
     t = TribeNode([])
     with pytest.raises(NotImplementedError):
         t.merged_cluster_state()
+
+
+def test_tribe_search_fans_out_over_http():
+    """The advertised read-only fan-out must work against real endpoints
+    (review regression: Client was constructed with the wrong parameter)."""
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.server import RestServer
+    from elasticsearch_tpu.tribe import TribeNode
+
+    nodes, servers, urls = [], [], []
+    for i in range(2):
+        n = Node(name=f"trib{i}")
+        srv = RestServer(n, host="127.0.0.1", port=0)
+        srv.start(background=True)
+        nodes.append(n)
+        servers.append(srv)
+        urls.append(f"http://127.0.0.1:{srv.port}")
+        n.create_index("logs", {})
+        svc = n.indices["logs"]
+        for j in range(12):
+            svc.index_doc(f"c{i}-{j}", {"msg": "error in module"})
+        svc.refresh()
+    try:
+        t = TribeNode(urls)
+        r = t.search_remote("logs", {"query": {"match": {"msg": "error"}}},
+                            size=15)
+        assert r["hits"]["total"] == 24
+        # size forwarded to remotes: > 10 hits can come from one cluster
+        assert len(r["hits"]["hits"]) == 15
+    finally:
+        for srv, n in zip(servers, nodes):
+            srv.stop()
+            n.close()
