@@ -56,7 +56,30 @@ BUILTIN_ANALYZERS = {
 }
 
 
-def get_analyzer(name: str) -> Analyzer:
+# per-language analyzers (reference: index/analysis/*AnalyzerProvider for
+# GermanAnalyzer, FrenchAnalyzer, … and SnowballAnalyzerProvider.java):
+# standard tokenizer → lowercase → language stemmer. Stopword lists are the
+# english one only (documented deviation: non-english stop lists are not
+# bundled; configure a custom `stop` filter for them).
+_LANGUAGE_ANALYZERS = ("french", "german", "spanish", "italian",
+                       "portuguese", "dutch", "swedish", "norwegian",
+                       "danish", "russian")
+
+
+def _language_analyzer(lang: str) -> Analyzer:
+    stem = lambda toks, _l=lang: F.stemmer_filter(toks, language=_l)
+    # same family as the `english` builtin: lowercase → stop → stem (the
+    # stop list is the bundled english one for every language — deviation
+    # documented above)
+    return Analyzer(lang, T.standard_tokenizer,
+                    [F.lowercase_filter, F.stop_filter, stem])
+
+
+def get_analyzer(name: str, language: str | None = None) -> Analyzer:
+    if name == "snowball":  # {"type": "snowball", "language": "German"}
+        return _language_analyzer((language or "english").lower())
+    if name in _LANGUAGE_ANALYZERS:
+        return _language_analyzer(name)
     try:
         return BUILTIN_ANALYZERS[name]()
     except KeyError:

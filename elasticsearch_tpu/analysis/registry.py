@@ -8,7 +8,6 @@ from __future__ import annotations
 
 from elasticsearch_tpu.analysis.analyzer import (
     Analyzer,
-    BUILTIN_ANALYZERS,
     build_custom_analyzer,
     get_analyzer,
 )
@@ -34,11 +33,12 @@ class AnalysisRegistry:
             if typ == "custom":
                 an = build_custom_analyzer(name, cfg, self._shared)
             else:
-                an = get_analyzer(typ)
-        elif name in BUILTIN_ANALYZERS:
-            an = get_analyzer(name)
+                # e.g. {"type": "snowball", "language": "German"}
+                an = get_analyzer(typ, language=cfg.get("language"))
         else:
-            raise ValueError(f"unknown analyzer [{name}]")
+            # builtins + per-language analyzers ('german', 'french', …);
+            # raises ValueError for unknown names
+            an = get_analyzer(name)
         self._cache[name] = an
         return an
 

@@ -29,7 +29,10 @@ class TribeNode:
         hit may be the tribe's 3rd."""
         hits: List[dict] = []
         total = 0
-        remote_body = {**body, "size": max(size, int(body.get("size", 10)))}
+        # one window everywhere: what we ask each remote for is what the
+        # caller gets back (size param or body size, whichever is larger)
+        size = max(size, int(body.get("size", 10)))
+        remote_body = {**body, "size": size}
         for c in self.clients:
             r = c.search(index=index, body=remote_body)
             total += r["hits"]["total"]

@@ -70,19 +70,22 @@ class ResourceWatcherService:
     def start(self) -> None:
         if self._thread is not None:
             return
-        self._stop.clear()  # a start() after stop() must actually poll
-        self._thread = threading.Thread(target=self._loop,
+        # per-start stop event: an old poller that outlived a timed-out
+        # join keeps ITS event (forever set) and exits at its next wait —
+        # clearing a shared event could revive it alongside the new poller
+        stop = threading.Event()
+        self._stop = stop
+        self._thread = threading.Thread(target=self._loop, args=(stop,),
                                         name="resource-watcher", daemon=True)
         self._thread.start()
 
-    def _loop(self) -> None:
-        while not self._stop.wait(self.interval):
+    def _loop(self, stop: threading.Event) -> None:
+        while not stop.wait(self.interval):
             self.check_now()
 
     def stop(self) -> None:
         self._stop.set()
         t = self._thread
         if t is not None:
-            t.join(timeout=self.interval + 1.0)  # a stop→start pair must
-            # never leave two pollers racing on the same watch map
+            t.join(timeout=self.interval + 1.0)
         self._thread = None

@@ -138,3 +138,26 @@ def test_stemmer_folded_suffixes_and_capitalized_names():
     assert light_stem("nação", "portuguese") == light_stem("nações", "portuguese")
     assert stemmer_filter([("Kindern", 0)], language="German") == [("kindern", 0)] or \
         stemmer_filter([("kindern", 0)], language="German") == [("kind", 0)]
+
+
+def test_language_and_snowball_analyzers():
+    """SnowballAnalyzerProvider + per-language analyzer providers: analyzer
+    names like 'german' and {type: snowball, language: X} resolve."""
+    an = get_analyzer("german")
+    assert an.tokens("Die Kindern spielen") == ["die", "kind", "spiel"]
+    reg = AnalysisRegistry({"analysis": {"analyzer": {
+        "sb": {"type": "snowball", "language": "French"}}}})
+    assert reg.get("sb").tokens("les chanteuses nationales") == [
+        "les", "chant", "national"]
+    # mappable on fields end to end
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    n.create_index("fr", {"mappings": {"properties": {
+        "t": {"type": "text", "analyzer": "french"}}}})
+    svc = n.indices["fr"]
+    svc.index_doc("1", {"t": "les chanteuses"})
+    svc.refresh()
+    r = n.search("fr", {"query": {"match": {"t": "chanteuse"}}})
+    assert r["hits"]["total"] == 1
+    n.close()
