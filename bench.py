@@ -152,6 +152,7 @@ def make_sift_node(n_vecs: int, dims: int, seed: int):
     exists[:n_vecs] = True
     vc = VectorColumn(name="emb", vecs=jax.device_put(vpad),
                       exists=jax.device_put(exists), dims=dims,
+                      vecs_host=vpad, exists_host=exists,
                       similarity="cosine")
     seg = TpuSegment(
         num_docs=n_vecs, max_docs=D,
@@ -461,6 +462,18 @@ def main():
                 f"p50 {percentile_ms(times, 50):.2f} ms")
         knn["ivf_recall_curve"] = curve
 
+    # steady-state floor: the same trivial call AFTER the workload ran —
+    # some host-device links (tunneled chips) settle into a slower
+    # synchronized mode once large transfers have occurred; p50 should be
+    # read against THIS floor, not the pristine-session one
+    floors = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        tiny(1.0).block_until_ready()
+        floors.append(time.perf_counter() - t0)
+    floor_steady_ms = float(np.percentile(np.asarray(floors) * 1000, 50))
+    log(f"steady-state dispatch floor: {floor_steady_ms:.2f} ms "
+        f"(pristine was {dispatch_floor_ms:.2f} ms)")
     log(f"total bench wall time: {time.perf_counter() - t_start:.0f}s")
     # headline: batched product-path throughput vs the CPU reference's
     # sequential throughput (1000/cpu_p50). Single-query p50 and the
@@ -478,6 +491,7 @@ def main():
         "cpu_p50_ms": round(cpu_p50, 3),
         "p50_speedup_vs_cpu": round(vs, 2),
         "dispatch_floor_ms": round(dispatch_floor_ms, 3),
+        "dispatch_floor_steady_ms": round(floor_steady_ms, 3),
         "batched_qps": round(batched_qps, 1),
         "mfu": round(mfu, 4),
         "bm25_batched_mfu": round(bm25_mfu, 4),

@@ -201,6 +201,8 @@ class InvertedField:
     _sorted_terms: Any = None
     # device positional CSR (padded) — built lazily for phrase programs
     _pos_dev: Any = None
+    # host mirror of the dense impact block (set when _dense is built)
+    _dense_host: Any = None
     # lazy hybrid dense-impact block: False = checked & permanently absent
     # (no qualifying terms); (dense_rows np.i32[V], impact dev f32[F_pad, D])
     # when present; None = not built yet (incl. transient budget denial)
@@ -253,6 +255,10 @@ class InvertedField:
             if not DENSE_IMPACT_BUDGET.reserve(impact.nbytes):
                 return None  # lost a race for the budget: retry later
             self._dense_bytes = impact.nbytes
+            # host mirror: mesh prims restack [S, F, D] from it — pulling
+            # the device copy back would be a huge d2h transfer (and on
+            # network-attached chips big d2h pulls degrade the session)
+            self._dense_host = impact
             self._dense = (rows, _device_put(impact))
             return self._dense
 
@@ -292,6 +298,7 @@ class NumericColumn:
     hi: Any = None  # int32[max_docs] exact pair (device) for 64-bit types
     lo: Any = None
     exact: Optional[np.ndarray] = None  # host i64/f64 mirror for fetch/sort
+    exists_host: Optional[np.ndarray] = None  # host mirror (no d2h pulls)
     kind: str = "double"  # long|integer|double|float|date|boolean|ip|...
     # 64-bit kinds (dates = epoch millis ~1.7e12) overflow f32 precision, so
     # the arithmetic channel stores segment-relative values: f32 = exact -
@@ -312,6 +319,8 @@ class KeywordColumn:
     ords: Any  # int32[max_docs] (device), -1 = missing
     exists: Any  # bool[max_docs]
     host_values: List[Optional[List[str]]] = dfield(default_factory=list)
+    ords_host: Optional[np.ndarray] = None
+    exists_host: Optional[np.ndarray] = None
 
 
 @dataclass
@@ -320,6 +329,8 @@ class VectorColumn:
     vecs: Any  # f32[max_docs, dims] (device)
     exists: Any  # bool[max_docs]
     dims: int
+    vecs_host: Any = None  # host mirror (mesh stacking, IVF build)
+    exists_host: Any = None
     similarity: str = "cosine"
     # lazy IVF-flat coarse quantizer (ops/ivf.py); False = build attempted
     # and declined (too few vectors)
@@ -330,8 +341,11 @@ class VectorColumn:
         if self._ivf is None:
             from elasticsearch_tpu.ops.ivf import build_ivf
 
-            idx = build_ivf(np.asarray(self.vecs), np.asarray(self.exists),
-                            max_docs, metric=self.similarity)
+            vh = (self.vecs_host if self.vecs_host is not None
+                  else np.asarray(self.vecs))
+            eh = (self.exists_host if self.exists_host is not None
+                  else np.asarray(self.exists))
+            idx = build_ivf(vh, eh, max_docs, metric=self.similarity)
             self._ivf = idx if idx is not None else False
         return self._ivf or None
 
@@ -546,7 +560,7 @@ class SegmentBuilder:
                     exists[i] = True
             vc = VectorColumn(
                 name=fname, vecs=_device_put(mat), exists=_device_put(exists),
-                dims=dims, similarity=sim,
+                dims=dims, vecs_host=mat, exists_host=exists, similarity=sim,
             )
             fm = self.mappings.get(fname)
             opts = getattr(fm, "index_options", None) if fm is not None else None
@@ -772,6 +786,8 @@ class SegmentBuilder:
             ords=_device_put(ords_re),
             exists=_device_put(exists),
             host_values=host_values,
+            ords_host=ords_re,
+            exists_host=exists,
         )
         return inv, kwcol
 
@@ -794,6 +810,7 @@ class SegmentBuilder:
             values=_device_put(values.astype(np.float32)),
             exists=_device_put(exists),
             exact=exact,
+            exists_host=exists,
             kind=kind,
             offset=offset,
         )

@@ -249,6 +249,30 @@ def count_mask(mask):
     return jnp.sum(mask.astype(jnp.int32))
 
 
+@jax.jit
+def pack_topk_result(vals, idx, total):
+    """Pack (vals f32[k], idx i32[k], total i32) into ONE i32[2k+1] array.
+
+    Device→host pulls pay a fixed per-ARRAY latency (network-attached
+    chips: ~5-20 ms each); fetching three tiny arrays costs three round
+    trips. Bitcasting the f32 scores into the i32 payload makes the whole
+    query result one transfer; hosts un-bitcast with np.view (exact)."""
+    return jnp.concatenate([
+        lax.bitcast_convert_type(vals, jnp.int32),
+        idx.astype(jnp.int32),
+        jnp.asarray(total, jnp.int32)[None],
+    ])
+
+
+def unpack_topk_result(packed_np, k: int):
+    """np i32[2k+1] → (vals f32[k], idx i32[k], total int)."""
+    import numpy as np
+
+    vals = packed_np[:k].view(np.float32)
+    idx = packed_np[k: 2 * k]
+    return vals, idx, int(packed_np[-1])
+
+
 # ---------------------------------------------------------------------------
 # per-field segment reductions (aggregation building blocks)
 # ---------------------------------------------------------------------------

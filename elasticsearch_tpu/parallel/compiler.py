@@ -120,9 +120,14 @@ class PostingsPrim(DataPrim):
             for si, seg in enumerate(seg_row):
                 inv = seg.inverted.get(self.field) if seg is not None else None
                 if inv is not None:
-                    d = np.asarray(inv.doc_ids)
+                    # host mirrors (never np.asarray(device): big d2h pulls
+                    # degrade network-attached sessions)
+                    d = (inv.doc_ids_host if inv.doc_ids_host is not None
+                         else np.asarray(inv.doc_ids)[: inv.nnz])
                     h_doc[si, : d.shape[0]] = np.where(d >= seg.max_docs, D, d)
-                    h_tfn[si, : d.shape[0]] = np.asarray(inv.tfnorm)
+                    t = (inv.tfnorm_host if inv.tfnorm_host is not None
+                         else np.asarray(inv.tfnorm)[: inv.nnz])
+                    h_tfn[si, : t.shape[0]] = t
             return [h_doc, h_tfn]
 
         key = ("postings", self.field,
@@ -201,9 +206,10 @@ class HybridTGroupPrim(DataPrim):
 
         def fill_impact():
             h = np.zeros((S, F, D), np.float32)
-            for si, (_inv, blk) in enumerate(blocks):
+            for si, (inv_i, blk) in enumerate(blocks):
                 if blk is not None:
-                    imp = np.asarray(blk[1])
+                    imp = (inv_i._dense_host if inv_i._dense_host is not None
+                           else np.asarray(blk[1]))
                     h[si, : imp.shape[0], : imp.shape[1]] = imp
             return [h]
 
@@ -267,12 +273,16 @@ class RangePrim(DataPrim):
                 h_hi = np.zeros((S, D), np.int32)
                 h_lo = np.zeros((S, D), np.int32)
                 h_ex = np.zeros((S, D), bool)
+                from elasticsearch_tpu.index.segment import split_i64
+
                 for si, c in enumerate(cols):
                     if c is not None and c.hi is not None:
-                        hi = np.asarray(c.hi)
+                        hi, lo = split_i64(c.exact)  # host, no d2h
                         h_hi[si, : hi.shape[0]] = hi
-                        h_lo[si, : hi.shape[0]] = np.asarray(c.lo)
-                        h_ex[si, : hi.shape[0]] = np.asarray(c.exists)
+                        h_lo[si, : lo.shape[0]] = lo
+                        ex = (c.exists_host if c.exists_host is not None
+                              else np.asarray(c.exists))
+                        h_ex[si, : ex.shape[0]] = ex
                 return [h_hi, h_lo, h_ex]
 
             key = ("colpair", self.field, tuple(id(s) for s in seg_row), D)
@@ -293,9 +303,12 @@ class RangePrim(DataPrim):
             h_ex = np.zeros((S, D), bool)
             for si, c in enumerate(cols):
                 if c is not None:
-                    v = np.asarray(c.values)
+                    v = ((c.exact - c.offset).astype(np.float32)
+                         if c.exact is not None else np.asarray(c.values))
                     h_val[si, : v.shape[0]] = v
-                    h_ex[si, : v.shape[0]] = np.asarray(c.exists)
+                    ex = (c.exists_host if c.exists_host is not None
+                          else np.asarray(c.exists))
+                    h_ex[si, : ex.shape[0]] = ex
             return [h_val, h_ex]
 
         key = ("colf32", self.field, tuple(id(s) for s in seg_row), D)
@@ -332,9 +345,13 @@ class SortColPrim(DataPrim):
             h_ex = np.zeros((S, D), bool)
             for si, c in enumerate(cols):
                 if c is not None:
-                    v = np.asarray(c.values) + np.float32(c.offset - base)
+                    v = ((c.exact - c.offset).astype(np.float32)
+                         if c.exact is not None
+                         else np.asarray(c.values)) + np.float32(c.offset - base)
                     h_val[si, : v.shape[0]] = v
-                    h_ex[si, : v.shape[0]] = np.asarray(c.exists)
+                    ex = (c.exists_host if c.exists_host is not None
+                          else np.asarray(c.exists))
+                    h_ex[si, : ex.shape[0]] = ex
             return [h_val, h_ex]
 
         key = ("sortcol", self.field, tuple(id(s) for s in seg_row), D)
@@ -371,10 +388,12 @@ class SortOrdPrim(DataPrim):
                 terms = seg.inverted[self.field].terms
                 local2global = np.asarray(
                     [rank_of[t] for t in terms] or [0], np.float32)
-                ords = np.asarray(kw.ords)
+                ords = (kw.ords_host if kw.ords_host is not None
+                        else np.asarray(kw.ords))
                 h_val[si, : ords.shape[0]] = np.where(
                     ords >= 0, local2global[np.maximum(ords, 0)], 0.0)
-                ex = np.asarray(kw.exists)
+                ex = (kw.exists_host if kw.exists_host is not None
+                      else np.asarray(kw.exists))
                 h_ex[si, : ex.shape[0]] = ex
             return [h_val, h_ex]
 
@@ -398,11 +417,17 @@ class ExistsPrim(DataPrim):
                     continue
                 # mirror ExistsQuery.execute resolution order
                 if f in seg.numerics:
-                    ex = np.asarray(seg.numerics[f].exists)
+                    c = seg.numerics[f]
+                    ex = (c.exists_host if c.exists_host is not None
+                          else np.asarray(c.exists))
                 elif f in seg.keywords:
-                    ex = np.asarray(seg.keywords[f].exists)
+                    kw = seg.keywords[f]
+                    ex = (kw.exists_host if kw.exists_host is not None
+                          else np.asarray(kw.exists))
                 elif f in seg.vectors:
-                    ex = np.asarray(seg.vectors[f].exists)
+                    vc = seg.vectors[f]
+                    ex = (vc.exists_host if vc.exists_host is not None
+                          else np.asarray(vc.exists))
                 elif f in seg.field_lengths:
                     ex = np.asarray(seg.field_lengths[f]) > 0
                 else:
@@ -449,9 +474,12 @@ class ColPrim(DataPrim):
             for si, seg in enumerate(seg_row):
                 c = seg.numerics.get(self.field) if seg is not None else None
                 if c is not None:
-                    v = np.asarray(c.values) + np.float32(c.offset)
+                    v = (c.exact.astype(np.float32) if c.exact is not None
+                         else np.asarray(c.values) + np.float32(c.offset))
                     h_val[si, : v.shape[0]] = v
-                    h_ex[si, : v.shape[0]] = np.asarray(c.exists)
+                    ex = (c.exists_host if c.exists_host is not None
+                          else np.asarray(c.exists))
+                    h_ex[si, : ex.shape[0]] = ex
             return [h_val, h_ex]
 
         key = ("colabs", self.field, tuple(id(s) for s in seg_row), D)
@@ -478,9 +506,11 @@ class VecsPrim(DataPrim):
             for si, seg in enumerate(seg_row):
                 vc = seg.vectors.get(self.field) if seg is not None else None
                 if vc is not None:
-                    v = np.asarray(vc.vecs)
+                    v = (vc.vecs_host if vc.vecs_host is not None
+                         else np.asarray(vc.vecs))
                     h_vecs[si, : v.shape[0]] = v
-                    ex = np.asarray(vc.exists)
+                    ex = (vc.exists_host if vc.exists_host is not None
+                          else np.asarray(vc.exists))
                     h_ex[si, : ex.shape[0]] = ex
             return [h_vecs, h_ex]
 
@@ -615,11 +645,14 @@ class AggTermsPrim(DataPrim):
             for si, seg in enumerate(seg_row):
                 inv = seg.inverted.get(self.field) if seg is not None else None
                 if inv is not None:
-                    d = np.asarray(inv.doc_ids)
+                    d = (inv.doc_ids_host if inv.doc_ids_host is not None
+                         else np.asarray(inv.doc_ids)[: inv.nnz])
                     h_doc[si, : d.shape[0]] = np.clip(d, 0, D - 1)
-                    t = np.asarray(inv.term_ids)
-                    # padded/absent term ids map to the vmax sentinel bucket
-                    h_tid[si, : t.shape[0]] = np.where(t >= inv.vocab_size, vmax, t)
+                    # term ids reconstruct from the CSR df (postings are
+                    # term-major) — no device pull
+                    t = np.repeat(np.arange(inv.vocab_size, dtype=np.int32),
+                                  inv.df)
+                    h_tid[si, : t.shape[0]] = t
             return [h_doc, h_tid]
 
         key = ("aggterms", self.field, tuple(id(s) for s in seg_row), nnz, D, vmax)

@@ -199,7 +199,13 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
         gvals, gpos = lax.top_k(av.reshape(S * k), kg)
         gslot = (gpos // k).astype(jnp.int32)
         glocal = ai.reshape(S * k)[gpos].astype(jnp.int32)
-        outs = [gvals, gslot, glocal, totals]
+        # ONE packed result array: each device→host array pull pays a fixed
+        # round-trip latency (network-attached chips: ~5-20 ms), so four
+        # tiny outputs would quadruple per-query latency
+        packed = jnp.concatenate([
+            lax.bitcast_convert_type(gvals, jnp.int32), gslot, glocal,
+            jnp.asarray(totals, jnp.int32)[None]])
+        outs = [packed]
         for _name, prim in compiled.agg_prims:
             doc_ids, term_ids, vreal = env[prim]
             (vmax,) = meta[prim]
@@ -213,7 +219,7 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
 
     n_in = sum(counts)
     in_specs = tuple(PS("shard") for _ in range(n_in))
-    out_specs = (PS(), PS(), PS(), PS()) + tuple(
+    out_specs = (PS(),) + tuple(
         PS("shard") for _ in range(n_aggs + (1 if compiled.want_mask else 0)))
     fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                    check_rep=False)
@@ -361,9 +367,12 @@ class MeshSearchExecutor:
                     continue
                 inv = seg.inverted.get(field)
                 if inv is not None:
-                    d = np.asarray(inv.doc_ids)
+                    d = (inv.doc_ids_host if inv.doc_ids_host is not None
+                         else np.asarray(inv.doc_ids)[: inv.nnz])
                     h_doc[si, : d.shape[0]] = np.where(d >= seg.max_docs, D, d)
-                    h_tfn[si, : d.shape[0]] = np.asarray(inv.tfnorm)
+                    t = (inv.tfnorm_host if inv.tfnorm_host is not None
+                         else np.asarray(inv.tfnorm)[: inv.nnz])
+                    h_tfn[si, : t.shape[0]] = t
             return put(h_doc), put(h_tfn)
 
         data_key = ("bm25", field, tuple(id(s) for s in seg_row), nnz, D)
@@ -416,7 +425,8 @@ class MeshSearchExecutor:
                 for si, seg in enumerate(seg_row):
                     vc = seg.vectors.get(field) if seg is not None else None
                     if vc is not None:
-                        v = np.asarray(vc.vecs)
+                        v = (vc.vecs_host if vc.vecs_host is not None
+                             else np.asarray(vc.vecs))
                         h_vecs[si, : v.shape[0]] = v
                 return jax.device_put(h_vecs, sh)
 
@@ -430,7 +440,9 @@ class MeshSearchExecutor:
                 vc = seg.vectors.get(field)
                 if vc is not None:
                     lv = np.asarray(seg.live_host)
-                    h_live[si, : lv.shape[0]] = lv & np.asarray(vc.exists)
+                    ex = (vc.exists_host if vc.exists_host is not None
+                          else np.asarray(vc.exists))
+                    h_live[si, : lv.shape[0]] = lv & ex
             prog = _knn_program(self.mesh, self._programs, Q=Q, dims=dims,
                                 D=D, k=min(k, D), metric=metric)
             vals, slot, local = prog(
@@ -528,11 +540,15 @@ class MeshSearchExecutor:
                 self._programs[prog_key] = prog
             dev = [a if hasattr(a, "sharding") else jax.device_put(a, sh)
                    for a in arrays]
-            # ONE host transfer for the whole result tuple — per-array
-            # np.asarray pulls would each pay a device round-trip (the
-            # dominant cost per query on tunneled/remote chips)
+            # ONE host transfer for the packed result — per-array pulls
+            # each pay a fixed device round-trip (the dominant per-query
+            # cost on network-attached chips)
             out = jax.device_get(prog(*dev))
-            gvals, gslot, glocal, tot = out[0], out[1], out[2], int(out[3])
+            packed = out[0]
+            kg = self.S * kk if sort_spec else kk  # mirrors the program
+            gvals = packed[:kg].view(np.float32)
+            gslot, glocal = packed[kg: 2 * kg], packed[2 * kg: 3 * kg]
+            tot = int(packed[-1])
             totals += tot
             for v, sl, lc in zip(gvals, gslot, glocal):
                 if np.isfinite(v):
@@ -540,7 +556,7 @@ class MeshSearchExecutor:
                                    lut_ord[int(sl)], int(lc)))
             n_aggs = len(compiled.agg_prims)
             for (name, _prim), acounts in zip(compiled.agg_prims,
-                                              out[4:4 + n_aggs]):
+                                              out[1:1 + n_aggs]):
                 ac = np.asarray(acounts)  # [S, Vmax+1]
                 for si, seg in enumerate(seg_row):
                     if seg is None:
@@ -548,7 +564,7 @@ class MeshSearchExecutor:
                     agg_rounds.setdefault(name, []).append(
                         (lut_shard[si], lut_ord[si], seg, ac[si]))
             if want_mask:
-                mk = np.asarray(out[4 + n_aggs])  # [S, D]
+                mk = np.asarray(out[1 + n_aggs])  # [S, D]
                 for si, seg in enumerate(seg_row):
                     if seg is None:
                         continue

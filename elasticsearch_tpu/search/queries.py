@@ -165,16 +165,20 @@ def fused_bm25_topk(ctx, query, k: int):
     from elasticsearch_tpu.monitor import kernels
     from elasticsearch_tpu.ops.pallas_kernels import bm25_dense_topk_auto
 
-    import jax
+    from elasticsearch_tpu.ops.scoring import (pack_topk_result,
+                                               unpack_topk_result)
 
     jnp = _jnp()
     live = ctx.segment.live
+    kk = min(k, ctx.D)
     vals, ids = bm25_dense_topk_auto(jnp.asarray(qw[None, :]), impact, live,
-                                     k=min(k, ctx.D))
+                                     k=kk)
     kernels.record("bm25_fused_topk")
     total = dense_presence_count(impact, jnp.asarray(qind[None, :]), live)
-    v, i, t = jax.device_get((vals[0], ids[0], total))  # one round-trip
-    return v, i, int(t)
+    # ONE packed pull — three tiny arrays would cost three device
+    # round-trips (network-attached chips: ~5-20 ms each)
+    packed = np.asarray(pack_topk_result(vals[0], ids[0], total))
+    return unpack_topk_result(packed, kk)
 
 
 def _fused_eligible_terms(ctx, query):

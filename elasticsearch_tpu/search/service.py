@@ -223,14 +223,16 @@ class ShardSearcher:
                         for i in order[: min(k, order.size)]
                     ]
             else:
-                import jax
+                from elasticsearch_tpu.ops.scoring import (
+                    pack_topk_result, unpack_topk_result)
 
                 kk = min(k, seg.max_docs)
                 vals, idx = topk_with_mask(scores, mask, k=kk)
-                # one host transfer for (top-k, totals) — separate pulls
-                # each pay a device round-trip
-                vals, idx, tot = jax.device_get((vals, idx, tot_dev))
-                total += int(tot)
+                # ONE host transfer: per-array pulls each pay a fixed
+                # device round-trip (network-attached chips: ~5-20 ms)
+                packed = np.asarray(pack_topk_result(vals, idx, tot_dev))
+                vals, idx, tot = unpack_topk_result(packed, kk)
+                total += tot
                 seg_docs = [
                     ShardDoc(self.shard_ord, seg, int(i), float(v))
                     for v, i in zip(vals, idx)
