@@ -208,11 +208,11 @@ class IndexService:
         script = body.get("script")
         script_src, params = None, None
         if script is not None:
+            from elasticsearch_tpu.search.scripting import script_source
+
+            script_src = script_source(script)
             if isinstance(script, dict):
-                script_src = script.get("inline", script.get("source", ""))
                 params = script.get("params")
-            else:
-                script_src = script
         version, created = shard.engine.update(
             doc_id,
             partial=body.get("doc"),

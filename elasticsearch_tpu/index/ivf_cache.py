@@ -1,0 +1,174 @@
+"""Content-addressed IVF blob cache: makes `store.read_ivf` a product input.
+
+An IvfIndex's list entries are LOCAL doc ordinals padded with the owning
+segment's ``max_docs`` sentinel, so a persisted blob is only valid for a
+slab whose vectors sit at exactly the same ordinals. Rather than trying to
+track segment identity across restarts / translog replays / snapshot
+restores (where segment boundaries legitimately change — replay merges all
+live docs into one segment), blobs are keyed by a digest of the exact slab
+content: ``sha1(shape, metric, max_docs, vecs bytes, exists bytes)``. A key
+hit therefore *guarantees* the ordinals line up and the blob can be loaded
+verbatim; any content drift (deletes dropped on restore, different refresh
+boundaries) simply misses and falls back to the k-means build, which then
+re-persists under the new key.
+
+Lifecycle (reference behavioral analogue: Lucene writes its HNSW/IVF graph
+into segment files at flush and reopens it on restart —
+org/elasticsearch/index/engine/InternalEngine.java's commit path; ES 2.0
+itself has no vector format, this follows the north-star `dense_vector`
+addition):
+
+- `Node(data_path=...)` calls `register(<data>/_ivf)` before gateway
+  recovery, so replayed segments can hit blobs written by the previous
+  process; `Node.close()` unregisters it. Several Nodes in one process
+  each register their own directory (refcounted — two Nodes over the
+  same data_path share one registration).
+- `VectorColumn.get_ivf` consults the cache before `build_ivf` and stores
+  the blob after a build (counters: `ivf_cache_hit` / `ivf_build` in
+  `monitor.kernels`, surfaced via `_nodes/stats`).
+- Snapshots embed each segment's blobs; restore seeds them here so the
+  target node's freeze skips the k-means when the restored slab content
+  matches (single-segment shards with no pruned deletes).
+
+The in-memory layer is content-addressed and process-global, which is safe
+by construction even with several Nodes in one process: identical key ==
+identical slab, so a blob can never be applied to the wrong data. The
+durable tier is the union of the registered directories: loads scan all of
+them, stores write to all of them. Writing a blob into a sibling node's
+directory is additive cache pollution at worst (content addressing makes a
+stale or foreign blob unreachable unless its exact slab recurs), and it is
+what keeps every data-path node's cache warm across restarts regardless of
+which node in the process built the quantizer.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from elasticsearch_tpu.monitor import kernels
+
+_LOCK = threading.Lock()
+_DIRS: Dict[str, int] = {}  # directory -> refcount (insertion-ordered)
+_MEM: Dict[str, bytes] = {}
+_MEM_CAP = 64  # blobs; FIFO eviction — disk layer is the durable tier
+
+
+def register(directory: str) -> None:
+    """Add ``directory`` to the durable tier (created on first store).
+    Refcounted: a second Node over the same data_path shares it."""
+    with _LOCK:
+        _DIRS[directory] = _DIRS.get(directory, 0) + 1
+
+
+def unregister(directory: str) -> None:
+    """Drop one registration of ``directory`` (Node.close)."""
+    with _LOCK:
+        c = _DIRS.get(directory, 0) - 1
+        if c > 0:
+            _DIRS[directory] = c
+        else:
+            _DIRS.pop(directory, None)
+
+
+def configure(directory: Optional[str]) -> None:
+    """Back-compat shim: register(directory); None is a no-op."""
+    if directory:
+        register(directory)
+
+
+def reset() -> None:
+    """Drop all cache state (tests)."""
+    with _LOCK:
+        _DIRS.clear()
+        _MEM.clear()
+
+
+def content_key(vecs_host: np.ndarray, exists_host: np.ndarray,
+                metric: str, max_docs: int) -> str:
+    v = np.ascontiguousarray(vecs_host, dtype=np.float32)
+    e = np.ascontiguousarray(exists_host, dtype=bool)
+    h = hashlib.sha1()
+    h.update(repr((v.shape, metric, int(max_docs))).encode())
+    h.update(v.tobytes())
+    h.update(e.tobytes())
+    return h.hexdigest()
+
+
+def _disk_paths(key: str) -> List[str]:
+    with _LOCK:
+        dirs = list(_DIRS)
+    return [os.path.join(d, f"{key}.ivf") for d in dirs]
+
+
+def load(key: str):
+    """Return a device-resident IvfIndex for ``key`` or None. A corrupt
+    disk blob is deleted and treated as a miss (the build path re-creates
+    it), never propagated."""
+    from elasticsearch_tpu.index.store import CorruptStoreException, read_ivf
+
+    with _LOCK:
+        blob = _MEM.get(key)
+    if blob is not None:
+        try:
+            idx = read_ivf(blob)
+        except CorruptStoreException:
+            with _LOCK:
+                _MEM.pop(key, None)
+        else:
+            kernels.record("ivf_cache_hit")
+            return idx
+    for path in _disk_paths(key):
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            continue
+        try:
+            idx = read_ivf(blob)
+        except CorruptStoreException:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            continue
+        kernels.record("ivf_cache_hit")
+        return idx
+    return None
+
+
+def store(key: str, ivf: Any) -> bytes:
+    """Persist ``ivf`` under ``key`` (memory + every registered directory).
+    Returns the encoded blob (snapshot payloads reuse it)."""
+    from elasticsearch_tpu.index.store import write_ivf
+
+    blob = write_ivf(ivf)
+    seed(key, blob)
+    return blob
+
+
+def seed(key: str, blob: bytes) -> None:
+    """Insert an already-encoded blob (snapshot restore pre-seeding)."""
+    with _LOCK:
+        if key not in _MEM and len(_MEM) >= _MEM_CAP:
+            _MEM.pop(next(iter(_MEM)))
+        _MEM[key] = blob
+    for path in _disk_paths(key):
+        if os.path.exists(path):
+            continue
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(blob)
+            os.replace(tmp, path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass

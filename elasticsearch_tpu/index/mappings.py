@@ -307,10 +307,24 @@ class Mappings:
             raise MapperParsingException(f"failed to parse field [{fm.name}] of type [{t}]: {e}")
 
     def to_json(self) -> dict:
+        # rebuild the object/nested tree from the flat dotted field map —
+        # the gateway re-parses this on restart, so losing structure here
+        # means losing `nested` semantics (and with them block-join
+        # queries) after every restart
         props: dict = {}
         for fm in self.fields.values():
-            props[fm.name] = _field_to_json(fm)
+            parts = fm.name.split(".")
+            cur, path = props, ""
+            for part in parts[:-1]:
+                path = f"{path}.{part}" if path else part
+                node = cur.setdefault(part, {})
+                if path in self.nested_paths:
+                    node["type"] = "nested"
+                cur = node.setdefault("properties", {})
+            cur[parts[-1]] = _field_to_json(fm)
         out = {"properties": props, "dynamic": self.dynamic}
+        if self.dynamic_templates:
+            out["dynamic_templates"] = list(self.dynamic_templates)
         if not self._all_enabled:
             out["_all"] = {"enabled": False}
         # meta-field toggles must round-trip: the gateway re-parses this on
@@ -331,17 +345,42 @@ class Mappings:
 
 
 def _field_to_json(fm: FieldMapping) -> dict:
+    """Inverse of _parse_field: every attribute the parser reads must
+    survive the round-trip, or restarts silently shed mapping config (the
+    r4 IVF-cache test caught index_options vanishing this way)."""
     out: dict = {"type": fm.type}
-    if fm.is_text and fm.analyzer != "standard":
+    if fm.is_text:
         out["analyzer"] = fm.analyzer
+    if fm.search_analyzer is not None:
+        out["search_analyzer"] = fm.search_analyzer
+    if not fm.index:
+        out["index"] = False
+    if fm.doc_values != (not fm.is_text):
+        out["doc_values"] = fm.doc_values
+    if fm.store:
+        out["store"] = True
+    if fm.boost != 1.0:
+        out["boost"] = fm.boost
+    if fm.null_value is not None:
+        out["null_value"] = fm.null_value
     if fm.type == "date":
         out["format"] = fm.fmt
     if fm.type == "dense_vector":
         out["dims"] = fm.dims
         out["similarity"] = fm.similarity
+        if fm.index_options is not None:
+            out["index_options"] = fm.index_options
+    if fm.copy_to:
+        out["copy_to"] = list(fm.copy_to)
+    if fm.ignore_above:
+        out["ignore_above"] = fm.ignore_above
+    if fm.scaling_factor != 1.0:
+        out["scaling_factor"] = fm.scaling_factor
+    if fm.include_in_all is not None:
+        out["include_in_all"] = fm.include_in_all
     if fm.fields:
         out["fields"] = {sub.rpartition(".")[2] if "." in sub else sub: _field_to_json(sf)
-                         for sub, sf in fm.fields.items()}
+                        for sub, sf in fm.fields.items()}
     return out
 
 

@@ -209,7 +209,37 @@ class InvertedField:
     _dense: Any = None
     _dense_bytes: int = 0
     _dense_lock: Any = dfield(default_factory=threading.Lock)
+    # lazy cross-device postings split for an OVERSIZED field (see
+    # parallel/postings_shard.py): None = unchecked, False = declined
+    _pshard: Any = None
     max_docs: int = 0
+
+    def wants_postings_shard(self) -> bool:
+        """True when this field's postings exceed the single-device budget
+        (mesh_service uses this to route such indices to the host loop,
+        where the sharded program runs)."""
+        from elasticsearch_tpu.parallel.postings_shard import \
+            POSTINGS_SHARD_NNZ
+
+        return self.nnz >= POSTINGS_SHARD_NNZ
+
+    def postings_split(self):
+        """Build-once term-range split across devices, or None (field under
+        the threshold, single device, or no host mirror to split from)."""
+        if self._pshard is False:
+            return None
+        if self._pshard is not None:
+            return self._pshard
+        if not self.wants_postings_shard():
+            return None
+        with self._dense_lock:
+            if self._pshard is None:
+                from elasticsearch_tpu.parallel.postings_shard import \
+                    build_split
+
+                split = build_split(self, self.max_docs)
+                self._pshard = split if split is not None else False
+        return self._pshard or None
 
     def dense_block(self):
         """Lazy (dense_rows, device impact) for hybrid scoring, or None.
@@ -267,6 +297,13 @@ class InvertedField:
             DENSE_IMPACT_BUDGET.release(self._dense_bytes)
 
     @property
+    def nnz_pad(self) -> int:
+        """Padded postings length WITHOUT forcing device placement (the
+        lazy doc_ids accessor would device_put an oversized field's full
+        array just to read its shape)."""
+        return int(self._doc_ids_raw.shape[0])
+
+    @property
     def vocab_size(self) -> int:
         return len(self.terms)
 
@@ -288,6 +325,41 @@ class InvertedField:
         n = self.num_docs if num_docs is None else num_docs
         d = (self.df[self.vocab[term]] if term in self.vocab else 0) if df is None else df
         return float(np.log(1.0 + (n - d + 0.5) / (d + 0.5)))
+
+
+def _lazy_device_field(name: str):
+    """Attach a lazy device-placement accessor for one postings array.
+
+    Freeze passes device arrays for ordinary fields (placement cost paid
+    once, off the query path) but HOST arrays for an OVERSIZED field — its
+    scoring runs through the cross-device postings split
+    (parallel/postings_shard.py), which slices the host mirror per device;
+    the full single-device copy these accessors hand out must not be
+    allocated unless some path actually asks for it (phrase/positional
+    programs, terms aggs over the field). First access device_puts and
+    caches, so a fallback path pays the transfer once, not per query.
+
+    Attached after class creation: defining the property inside the
+    dataclass body would make the descriptor look like a field default.
+    """
+    raw = f"_{name}_raw"
+
+    def _get(self):
+        v = self.__dict__[raw]
+        if isinstance(v, np.ndarray):
+            v = _device_put(v)
+            self.__dict__[raw] = v
+        return v
+
+    def _set(self, v):
+        self.__dict__[raw] = v
+
+    return property(_get, _set)
+
+
+for _pname in ("doc_ids", "tf", "tfnorm", "term_ids"):
+    setattr(InvertedField, _pname, _lazy_device_field(_pname))
+del _pname
 
 
 @dataclass
@@ -337,15 +409,26 @@ class VectorColumn:
     _ivf: Any = None
 
     def get_ivf(self, max_docs: int):
-        """Build-once IVF index over this (immutable) slab."""
+        """Build-once IVF index over this (immutable) slab, consulting the
+        content-addressed blob cache first so restarts / snapshot restores
+        reload the persisted quantizer instead of re-running k-means
+        (index/ivf_cache.py; counters ivf_cache_hit / ivf_build)."""
         if self._ivf is None:
+            from elasticsearch_tpu.index import ivf_cache
+            from elasticsearch_tpu.monitor import kernels
             from elasticsearch_tpu.ops.ivf import build_ivf
 
             vh = (self.vecs_host if self.vecs_host is not None
                   else np.asarray(self.vecs))
             eh = (self.exists_host if self.exists_host is not None
                   else np.asarray(self.exists))
-            idx = build_ivf(vh, eh, max_docs, metric=self.similarity)
+            key = ivf_cache.content_key(vh, eh, self.similarity, max_docs)
+            idx = ivf_cache.load(key)
+            if idx is None:
+                idx = build_ivf(vh, eh, max_docs, metric=self.similarity)
+                if idx is not None:
+                    kernels.record("ivf_build")
+                    ivf_cache.store(key, idx)
             self._ivf = idx if idx is not None else False
         return self._ivf or None
 
@@ -448,8 +531,7 @@ class TpuSegment:
         """Approximate HBM footprint (circuit-breaker accounting)."""
         total = self.max_docs  # live mask
         for inv in self.inverted.values():
-            n = int(inv.doc_ids.shape[0])
-            total += n * (4 + 4 + 4 + 4)
+            total += inv.nnz_pad * (4 + 4 + 4 + 4)
         for col in self.numerics.values():
             total += self.max_docs * 5
             if col.hi is not None:
@@ -689,6 +771,13 @@ class SegmentBuilder:
         tfnorm = tf_arr * (K1 + 1.0) / (tf_arr + K1 * (1.0 - B + B * dl / max(avg_len, 1e-9)))
 
         nnz_pad = pow2_bucket(max(nnz, 1), minimum=8)
+        # an OVERSIZED field must not allocate its full postings on one
+        # device at freeze — scoring goes through the cross-device split;
+        # the lazy accessors place these host arrays only if a fallback
+        # path (phrase, terms agg) actually asks for the full copy
+        from elasticsearch_tpu.parallel.postings_shard import \
+            POSTINGS_SHARD_NNZ
+        put = (lambda a: a) if nnz >= POSTINGS_SHARD_NNZ else _device_put
         return InvertedField(
             name=fname,
             vocab=vocab,
@@ -696,10 +785,10 @@ class SegmentBuilder:
             df=df,
             cf=cf,
             offsets=offsets,
-            doc_ids=_device_put(pad_to(doc_ids, nnz_pad, max_docs)),
-            tf=_device_put(pad_to(tf_arr, nnz_pad, 0.0)),
-            tfnorm=_device_put(pad_to(tfnorm.astype(np.float32), nnz_pad, 0.0)),
-            term_ids=_device_put(pad_to(term_ids, nnz_pad, V)),
+            doc_ids=put(pad_to(doc_ids, nnz_pad, max_docs)),
+            tf=put(pad_to(tf_arr, nnz_pad, 0.0)),
+            tfnorm=put(pad_to(tfnorm.astype(np.float32), nnz_pad, 0.0)),
+            term_ids=put(pad_to(term_ids, nnz_pad, V)),
             nnz=nnz,
             num_docs=ndocs_with_field,
             total_terms=total_terms,
@@ -762,6 +851,10 @@ class SegmentBuilder:
         offsets[V] = k
         nnz_pad = pow2_bucket(max(nnz, 1), minimum=8)
         ones = np.ones(nnz, dtype=np.float32)
+        # same oversized-field treatment as _build_inverted_text
+        from elasticsearch_tpu.parallel.postings_shard import \
+            POSTINGS_SHARD_NNZ
+        put = (lambda a: a) if nnz >= POSTINGS_SHARD_NNZ else _device_put
         inv = InvertedField(
             name=fname,
             vocab=vocab2,
@@ -769,10 +862,10 @@ class SegmentBuilder:
             df=df,
             cf=df.astype(np.int64),
             offsets=offsets,
-            doc_ids=_device_put(pad_to(doc_ids, nnz_pad, max_docs)),
-            tf=_device_put(pad_to(ones, nnz_pad, 0.0)),
-            tfnorm=_device_put(pad_to(ones, nnz_pad, 0.0)),
-            term_ids=_device_put(pad_to(term_ids, nnz_pad, V)),
+            doc_ids=put(pad_to(doc_ids, nnz_pad, max_docs)),
+            tf=put(pad_to(ones, nnz_pad, 0.0)),
+            tfnorm=put(pad_to(ones, nnz_pad, 0.0)),
+            term_ids=put(pad_to(term_ids, nnz_pad, V)),
             nnz=nnz,
             num_docs=int(exists.sum()),
             total_terms=nnz,

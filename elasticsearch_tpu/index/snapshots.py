@@ -22,12 +22,15 @@ Layout under the repository root:
 """
 from __future__ import annotations
 
+import base64
 import gzip
 import hashlib
 import json
 import os
 import time
 from typing import Any, Dict, List, Optional
+
+import numpy as np
 
 from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
 
@@ -153,7 +156,30 @@ def _segment_payload(seg) -> dict:
             "source": seg.sources[local],
             "meta": meta,
         })
-    return {"docs": docs}
+    payload = {"docs": docs}
+    # carry each built IVF quantizer so restore can seed the
+    # content-addressed cache (index/ivf_cache.py) instead of re-running
+    # k-means — hits whenever the restored slab content matches (the
+    # single-segment, no-pruned-deletes case; drift misses and rebuilds)
+    ivf_blobs = []
+    for fname, vc in getattr(seg, "vectors", {}).items():
+        ivf = vc._ivf
+        if not ivf:
+            continue
+        from elasticsearch_tpu.index import ivf_cache
+
+        vh = vc.vecs_host if vc.vecs_host is not None else np.asarray(vc.vecs)
+        eh = (vc.exists_host if vc.exists_host is not None
+              else np.asarray(vc.exists))
+        key = ivf_cache.content_key(vh, eh, vc.similarity, seg.max_docs)
+        blob = ivf_cache.store(key, ivf)
+        ivf_blobs.append({
+            "field": fname, "key": key,
+            "blob": base64.b64encode(blob).decode("ascii"),
+        })
+    if ivf_blobs:
+        payload["ivf"] = ivf_blobs
+    return payload
 
 
 def create_snapshot(node, repo: FsRepository, snap_name: str,
@@ -242,6 +268,11 @@ def restore_snapshot(node, repo: FsRepository, snap_name: str,
             versions = shard_meta.get("versions", {})
             for sha in shard_meta["blobs"]:
                 payload = repo.get_blob(sha)
+                for entry in payload.get("ivf", []):
+                    from elasticsearch_tpu.index import ivf_cache
+
+                    ivf_cache.seed(entry["key"],
+                                   base64.b64decode(entry["blob"]))
                 for doc in payload["docs"]:
                     meta = doc.get("meta", {})
                     svc.index_doc(

@@ -129,12 +129,11 @@ def read_postings(data: bytes) -> Dict[str, Any]:
 
 def write_ivf(ivf) -> bytes:
     """Serialize an IvfIndex (centroids f32, padded lists i32, lens i32)
-    with the same header+CRC framing as postings blobs. This is the durable
-    FORMAT for a disk-backed segment store; today's snapshot/restore path
-    re-indexes _source and rebuilds IVF eagerly at freeze instead (restore
-    segments don't correspond 1:1 with snapshot segments), so the codec's
-    consumers are the format tests until the disk store lands — stated
-    plainly, same as the postings codec above."""
+    with the same header+CRC framing as postings blobs. Product consumers:
+    the content-addressed blob cache (index/ivf_cache.py) persists these
+    under `<data>/_ivf/` at build time and reloads them on restart, and
+    snapshot payloads embed them so restore can seed the target's cache
+    (index/snapshots.py:_segment_payload)."""
     cents = np.asarray(ivf.centroids, np.float32)
     lists = np.asarray(ivf.lists, np.int64).reshape(-1)
     lens = np.asarray(ivf.list_lens, np.int64)

@@ -13,10 +13,15 @@ Names:
   bm25_scatter        pure scatter-add postings scoring (host or mesh)
   bm25_hybrid         dense-impact MXU matmul + scatter tail
   bm25_fused_topk     Pallas streaming dense top-k (no [Q, D] intermediate)
+  bm25_postings_sharded  oversized field scored via the cross-device
+                      postings split + psum merge (parallel/postings_shard)
   knn_fused_topk      fused scores+mask+topk (Pallas on TPU, XLA elsewhere);
                       subsumed the r3 `knn_full` [D]-row path in r4 (filters
                       now fold into the fused candidate mask)
   knn_ivf             IVF-flat probe + exact candidate scoring
+  ivf_build           IVF quantizer built via k-means at segment freeze
+  ivf_cache_hit       IVF quantizer reloaded from the persisted blob cache
+                      (index/ivf_cache.py) instead of rebuilt
   mesh_search         request served by the mesh product path
   mesh_fallback_total request fell back to the host per-shard loop
 """

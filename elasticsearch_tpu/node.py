@@ -37,13 +37,25 @@ class Node:
         self.search_templates: Dict[str, Any] = {}
         # snapshot repositories (reference: RepositoriesService)
         self.repositories: Dict[str, Any] = {}
+        # dynamic cluster settings (reference: ClusterUpdateSettingsRequest
+        # persistent/transient maps); stored keys are surfaced via
+        # GET /_cluster/settings
+        self.cluster_settings: Dict[str, Dict[str, Any]] = {
+            "persistent": {}, "transient": {}}
         self.cluster_state = ClusterState(cluster_name)
         self.cluster_state.add_node(DiscoveryNode(self.node_id, name), master=True)
         # lazy: pools spin worker threads, so library-embedded Nodes that
         # never serve REST traffic don't pay for them
         self._thread_pool = None
         self._tp_lock = __import__("threading").Lock()
+        self._ivf_dir = None
         if data_path:
+            # durable ANN tier must be visible BEFORE replay freezes
+            # segments, or recovery pays the k-means the cache holds
+            from elasticsearch_tpu.index import ivf_cache
+
+            self._ivf_dir = os.path.join(data_path, "_ivf")
+            ivf_cache.register(self._ivf_dir)
             self._gateway_recover()
 
     @property
@@ -496,6 +508,11 @@ class Node:
     def close(self):
         for svc in self.indices.values():
             svc.close()
+        if self._ivf_dir is not None:
+            from elasticsearch_tpu.index import ivf_cache
+
+            ivf_cache.unregister(self._ivf_dir)
+            self._ivf_dir = None
         if self._thread_pool is not None:
             self._thread_pool.shutdown()
             self._thread_pool = None

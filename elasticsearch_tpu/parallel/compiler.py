@@ -111,7 +111,7 @@ class PostingsPrim(DataPrim):
         for seg in seg_row:
             inv = seg.inverted.get(self.field) if seg is not None else None
             if inv is not None:
-                nnz = max(nnz, int(inv.doc_ids.shape[0]))
+                nnz = max(nnz, inv.nnz_pad)
         nnz = pow2_bucket(nnz)
 
         def fill():
@@ -634,7 +634,7 @@ class AggTermsPrim(DataPrim):
         for seg in seg_row:
             inv = seg.inverted.get(self.field) if seg is not None else None
             if inv is not None:
-                nnz = max(nnz, int(inv.doc_ids.shape[0]))
+                nnz = max(nnz, inv.nnz_pad)
                 vmax = max(vmax, inv.vocab_size)
         nnz = pow2_bucket(nnz)
         vmax = pow2_bucket(vmax)

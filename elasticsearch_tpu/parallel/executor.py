@@ -334,7 +334,7 @@ class MeshSearchExecutor:
         for seg in seg_row:
             inv = seg.inverted.get(field) if seg is not None else None
             if inv is not None:
-                nnz = max(nnz, int(inv.doc_ids.shape[0]))
+                nnz = max(nnz, inv.nnz_pad)
         nnz = pow2_bucket(nnz)
 
         # per-shard chunk tables (vocab is shard-local)

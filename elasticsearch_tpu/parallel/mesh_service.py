@@ -56,6 +56,12 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
         for seg in segs:
             if seg.has_nested:
                 return None
+            # an oversized field can't stack into the [S, ...] per-shard
+            # arrays this program ships; the host loop scores it through
+            # the cross-device postings split instead
+            if any(inv.wants_postings_shard()
+                   for inv in seg.inverted.values()):
+                return None
     aggs = parse_aggs(body.get("aggs") or body.get("aggregations"))
     # terms aggs without subs reduce fully on device; ANY other agg tree
     # consumes the program's match mask through the host-side collectors —

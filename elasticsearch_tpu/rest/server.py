@@ -166,6 +166,52 @@ def _register_all(rc: RestController):
         {"id": name, "type": "fs"} for name in n.repositories]))
     add("GET", "/_cat/snapshots/{repo}", _cat_snapshots)
 
+    # REST-spec tail (r4 sweep): cluster admin, global-index forms, JSON
+    # segments/recovery, mpercolate/mtermvectors/mlt, search_exists/shards,
+    # snapshot status/verify, indexed scripts. Registered before the
+    # snapshot + /{index} blocks so literal _-prefixed paths win.
+    add("GET", "/_cluster/settings", _cluster_get_settings)
+    add("PUT", "/_cluster/settings", _cluster_put_settings)
+    add("GET", "/_cluster/pending_tasks", lambda n, p, b: (200, {"tasks": []}))
+    add("POST", "/_cluster/reroute", _cluster_reroute)
+    add("GET", "/_nodes/hot_threads", _hot_threads)
+    add("GET", "/_nodes/{nodeid}/hot_threads",
+        lambda n, p, b, nodeid: _hot_threads(n, p, b))
+    add("GET", "/_cat", _cat_help)
+    add("GET", "/_count", lambda n, p, b: _count(n, p, b, None))
+    add("POST", "/_count", lambda n, p, b: _count(n, p, b, None))
+    add("GET", "/_field_stats", lambda n, p, b: _field_stats(n, p, b, None))
+    add("POST", "/_field_stats", lambda n, p, b: _field_stats(n, p, b, None))
+    add("POST", "/_flush", lambda n, p, b: _flush(n, p, b, None))
+    add("GET", "/_flush", lambda n, p, b: _flush(n, p, b, None))
+    add("POST", "/_optimize", lambda n, p, b: _optimize(n, p, b, None))
+    add("POST", "/_forcemerge", lambda n, p, b: _optimize(n, p, b, None))
+    add("GET", "/_segments", _segments_json)
+    add("GET", "/_recovery", _recovery_json)
+    add("POST", "/_cache/clear", _clear_cache)
+    add("POST", "/_upgrade", _upgrade)
+    add("GET", "/_upgrade", _get_upgrade)
+    add("POST", "/_mpercolate", _mpercolate)
+    add("POST", "/_mtermvectors", _mtermvectors)
+    add("GET", "/_mtermvectors", _mtermvectors)
+    add("GET", "/_search/scroll", _scroll)
+    add("GET", "/_search/template", lambda n, p, b: _search_template(n, p, b, None))
+    add("POST", "/_search/template", lambda n, p, b: _search_template(n, p, b, None))
+    add("GET", "/_mapping/field/{field}",
+        lambda n, p, b, field: _get_field_mapping(n, p, b, field))
+    add("GET", "/_snapshot/_status",
+        lambda n, p, b: _snapshot_status(n, p, b))
+    add("PUT", "/_scripts/{lang}/{id}", _put_script)
+    add("POST", "/_scripts/{lang}/{id}", _put_script)
+    add("GET", "/_scripts/{lang}/{id}", _get_script)
+    add("DELETE", "/_scripts/{lang}/{id}", _delete_script)
+    add("HEAD", "/_alias/{alias}",
+        lambda n, p, b, alias: _alias_exists(n, p, b, alias))
+    add("HEAD", "/_template/{name}", _template_exists)
+    add("GET", "/_snapshot/{repo}/{snap}/_status",
+        lambda n, p, b, repo, snap: _snapshot_status(n, p, b, repo, snap))
+    add("POST", "/_snapshot/{repo}/_verify", _verify_repo)
+
     # snapshot API (before /{index} patterns so the literal prefix wins)
     add("PUT", "/_snapshot/{repo}", _put_repo)
     add("POST", "/_snapshot/{repo}", _put_repo)
@@ -271,6 +317,49 @@ def _register_all(rc: RestController):
     add("GET", "/_suggest", _suggest_all)
     add("POST", "/{index}/_suggest", _suggest)
     add("GET", "/{index}/_suggest", _suggest)
+
+
+    # REST-spec tail, per-index forms
+    add("PUT", "/{index}/_alias/{name}", _put_alias)
+    add("POST", "/{index}/_alias/{name}", _put_alias)
+    add("PUT", "/{index}/_aliases/{name}", _put_alias)
+    add("DELETE", "/{index}/_alias/{name}", _delete_alias)
+    add("DELETE", "/{index}/_aliases/{name}", _delete_alias)
+    add("HEAD", "/{index}/_alias/{name}", _index_alias_exists)
+    add("GET", "/{index}/_alias", _get_index_alias)
+    add("GET", "/{index}/_alias/{alias}",
+        lambda n, p, b, index, alias: _get_index_alias(n, p, b, index, alias))
+    add("HEAD", "/{index}/_mapping/{type}", _type_exists)
+    add("GET", "/{index}/_mapping/field/{field}",
+        lambda n, p, b, index, field: _get_field_mapping(n, p, b, field, index))
+    add("GET", "/{index}/_segments",
+        lambda n, p, b, index: _segments_json(n, p, b, index))
+    add("GET", "/{index}/_recovery",
+        lambda n, p, b, index: _recovery_json(n, p, b, index))
+    add("POST", "/{index}/_cache/clear",
+        lambda n, p, b, index: _clear_cache(n, p, b, index))
+    add("POST", "/{index}/_upgrade",
+        lambda n, p, b, index: _upgrade(n, p, b, index))
+    add("GET", "/{index}/_upgrade",
+        lambda n, p, b, index: _get_upgrade(n, p, b, index))
+    add("POST", "/{index}/_mpercolate",
+        lambda n, p, b, index: _mpercolate(n, p, b, index))
+    add("POST", "/{index}/_mtermvectors",
+        lambda n, p, b, index: _mtermvectors(n, p, b, index))
+    add("GET", "/{index}/_mtermvectors",
+        lambda n, p, b, index: _mtermvectors(n, p, b, index))
+    add("GET", "/{index}/_search/exists", _search_exists)
+    add("POST", "/{index}/_search/exists", _search_exists)
+    add("GET", "/{index}/_search_shards", _search_shards)
+    add("POST", "/{index}/_search_shards", _search_shards)
+    add("POST", "/{index}/_termvectors/{id}", _termvectors)
+    add("GET", "/{index}/{type}/{id}/_termvectors",
+        lambda n, p, b, index, type, id: _termvectors(n, p, b, index, id))
+    add("POST", "/{index}/{type}/{id}/_termvectors",
+        lambda n, p, b, index, type, id: _termvectors(n, p, b, index, id))
+    add("GET", "/{index}/{type}/_percolate/count", _percolate_count)
+    add("POST", "/{index}/{type}/_percolate/count", _percolate_count)
+    add("GET", "/{index}/{type}/{id}/_mlt", _mlt)
 
     # ES 2.0 typed forms /{index}/{type}/{id} — registered LAST so every
     # /_-prefixed sub-resource above wins the route (RestController does the
@@ -570,6 +659,9 @@ def _count(n: Node, p, b, index: str):
         body = {"query": {"query_string": {"query": p["q"]}}}
     svc_names = n.resolve_indices(index)
     if not svc_names:
+        if index in (None, "", "_all", "*"):  # empty cluster: 0 hits, not 404
+            return 200, {"count": 0, "_shards": {"total": 0,
+                                                 "successful": 0, "failed": 0}}
         raise IndexNotFoundException(index)
     total = 0
     nshards = 0
@@ -1171,6 +1263,472 @@ def _termvectors(n: Node, p, b, index: str, id: str):
 # ---------------------------------------------------------------------------
 # HTTP server
 # ---------------------------------------------------------------------------
+
+# -- REST-spec tail (r4 sweep vs /root/reference/rest-api-spec/api) ----------
+# Each handler cites its reference action class; together these close the
+# spec files that had no route: cluster.get/put_settings, pending_tasks,
+# reroute, nodes.hot_threads, count/field_stats/flush/optimize without an
+# index, alias single-ops + HEAD forms, exists_template/exists_type,
+# get_field_mapping, indices.segments/recovery (JSON forms), upgrade,
+# clear_cache, count_percolate, mpercolate, mtermvectors, mlt,
+# search_exists, search_shards, snapshot.status/verify, indexed scripts,
+# cat.help, GET scroll, un-indexed search_template.
+
+
+def _cluster_get_settings(n: Node, p, b):
+    """RestClusterGetSettingsAction: the two dynamic settings maps."""
+    return 200, {"persistent": n.cluster_settings["persistent"],
+                 "transient": n.cluster_settings["transient"]}
+
+
+def _cluster_put_settings(n: Node, p, b):
+    """RestClusterUpdateSettingsAction (ClusterUpdateSettingsRequest.java):
+    merge dotted-key maps; stored settings are returned by GET and surfaced
+    to allocation/recovery code via Node.cluster_settings — settings no
+    component reads are stored-but-inert, same as unknown settings in 2.0
+    (pre-5.x ES did not validate setting names)."""
+    body = _json(b)
+    for scope in ("persistent", "transient"):
+        for k, v in (body.get(scope) or {}).items():
+            if v is None:
+                n.cluster_settings[scope].pop(k, None)
+            else:
+                n.cluster_settings[scope][k] = v
+    return 200, {"acknowledged": True,
+                 "persistent": n.cluster_settings["persistent"],
+                 "transient": n.cluster_settings["transient"]}
+
+
+def _cluster_reroute(n: Node, p, b):
+    """RestClusterRerouteAction. Commands are validated against the routing
+    table; with a single node and static shard→device placement every legal
+    move/allocate is already satisfied (there is exactly one node to be
+    on), so accepted commands change nothing — the same outcome reroute has
+    on a one-node reference cluster. cancel fails the shard, which re-runs
+    recovery (AllocationService.reroute's cancel semantics)."""
+    body = _json(b)
+    explanations = []
+    for cmd in body.get("commands", []):
+        if not isinstance(cmd, dict) or len(cmd) != 1:
+            raise IllegalArgumentException(
+                "a reroute command must be an object with exactly one "
+                "command name key")
+        ((name, args),) = cmd.items()
+        if name not in ("move", "cancel", "allocate", "allocate_replica",
+                        "allocate_stale_primary", "allocate_empty_primary"):
+            raise IllegalArgumentException(f"unknown reroute command [{name}]")
+        if not isinstance(args, dict):
+            raise IllegalArgumentException(
+                f"[{name}] command expects an object body")
+        iname = args.get("index")
+        if not iname:
+            raise IllegalArgumentException(
+                f"[{name}] command missing required [index] parameter")
+        shard_id = int(args.get("shard", 0))
+        svc = n.get_index(iname)
+        if shard_id >= svc.num_shards:
+            raise IllegalArgumentException(
+                f"shard [{shard_id}] out of range for [{iname}]")
+        if name == "cancel":
+            svc.fail_shard(shard_id)
+        explanations.append({"command": name, "parameters": args,
+                             "decisions": [{"decider": "same_node",
+                                            "decision": "YES"}]})
+    resp = {"acknowledged": True, "state": n.cluster_state.to_json()}
+    if str(p.get("explain", "")).lower() == "true":
+        resp["explanations"] = explanations
+    return 200, resp
+
+
+def _hot_threads(n: Node, p, b):
+    """RestNodesHotThreadsAction: plain-text stack dump of the busiest
+    threads. Python has no per-thread CPU accounting, so every live thread
+    is reported (threads parameter caps the count)."""
+    import sys
+    import traceback
+
+    limit = int(p.get("threads", 3))
+    frames = sys._current_frames()
+    out = [f"::: {{{n.name}}}{{{n.node_id}}}"]
+    for t in list(threading.enumerate())[:limit]:
+        fr = frames.get(t.ident)
+        out.append(f"\n   {t.name}: daemon={t.daemon}")
+        if fr is not None:
+            out.extend("     " + ln.rstrip()
+                       for ln in traceback.format_stack(fr))
+    return 200, "\n".join(out)
+
+
+def _put_alias(n: Node, p, b, index: str, name: str):
+    """RestIndexPutAliasAction → IndicesAliasesRequest add. Only the
+    alias metadata keys are read from the body — a stray "index"/"alias"
+    there must not override the URL targets."""
+    body = _json(b)
+    extras = {k: v for k, v in body.items()
+              if k in ("routing", "index_routing", "search_routing",
+                       "filter")}
+    action = {"add": {"index": index, "alias": name, **extras}}
+    return 200, n.update_aliases([action])
+
+
+def _delete_alias(n: Node, p, b, index: str, name: str):
+    return 200, n.update_aliases([{"remove": {"index": index, "alias": name}}])
+
+
+def _alias_exists(n: Node, p, b, alias: str, index: Optional[str] = None):
+    """RestAliasesExistAction (HEAD /_alias/{name})."""
+    import fnmatch
+
+    names = n.resolve_indices(index) if index else list(n.indices)
+    for iname in names:
+        svc = n.indices[iname]
+        if any(fnmatch.fnmatch(a, alias) for a in svc.aliases):
+            return 200, None
+    return 404, None
+
+
+def _index_alias_exists(n: Node, p, b, index: str, name: str):
+    return _alias_exists(n, p, b, name, index)
+
+
+def _get_index_alias(n: Node, p, b, index: str, alias: Optional[str] = None):
+    """RestGetAliasesAction scoped to an index (+ optional name pattern)."""
+    import fnmatch
+
+    out = {}
+    for iname in n.resolve_indices(index):
+        svc = n.indices[iname]
+        matched = {a: fa for a, fa in svc.aliases.items()
+                   if alias is None or fnmatch.fnmatch(a, alias)}
+        if matched or alias is None:
+            out[iname] = {"aliases": {a: (fa or {}) for a, fa in matched.items()}}
+    if alias is not None and not any(v["aliases"] for v in out.values()):
+        return 404, {"error": f"alias [{alias}] missing", "status": 404}
+    return 200, out
+
+
+def _template_exists(n: Node, p, b, name: str):
+    return (200 if name in n.cluster_state.templates else 404), None
+
+
+def _type_exists(n: Node, p, b, index: str, type: str):
+    """RestTypesExistsAction: our single-type model registers the mapped
+    _type names per index (doc_parser stores _type per doc)."""
+    for iname in n.resolve_indices(index):
+        svc = n.indices[iname]
+        if type in ("_doc", "_default_"):
+            return 200, None
+        for shard in svc.shards:
+            if any(loc.doc_type == type and not loc.deleted
+                   for loc in shard.engine._locations.values()):
+                return 200, None
+    return 404, None
+
+
+def _get_field_mapping(n: Node, p, b, field: str, index: Optional[str] = None):
+    """RestGetFieldMappingAction: per-index leaf mapping for field
+    patterns (comma list, wildcards)."""
+    import fnmatch
+
+    from elasticsearch_tpu.index.mappings import _field_to_json
+
+    pats = [f.strip() for f in field.split(",")]
+    out = {}
+    for iname in n.resolve_indices(index):
+        svc = n.indices[iname]
+        fields = {}
+        leaves = []
+        for fname, fm in svc.mappings.fields.items():
+            leaves.append((fname, fm))
+            # multi-field sub-fields ("title.raw") live only under their
+            # parent's fields map, not in the flat index
+            leaves.extend((f"{fname}.{sub}", sfm)
+                          for sub, sfm in fm.fields.items())
+        for fname, fm in leaves:
+            if any(fnmatch.fnmatch(fname, pat) for pat in pats):
+                leaf = fname.rpartition(".")[2]
+                fields[fname] = {"full_name": fname,
+                                 "mapping": {leaf: _field_to_json(fm)}}
+        out[iname] = {"mappings": {"_doc": fields}}
+    return 200, out
+
+
+def _segments_json(n: Node, p, b, index: Optional[str] = None):
+    """RestIndicesSegmentsAction (JSON form of _cat/segments)."""
+    out = {}
+    for iname in n.resolve_indices(index):
+        svc = n.indices[iname]
+        shards = {}
+        for g in svc.groups:
+            entries = []
+            for sh in g.copies:
+                segs = {f"_{seg.seg_id}": {
+                    "generation": seg.seg_id,
+                    "num_docs": seg.live_docs,
+                    "deleted_docs": seg.deleted_count,
+                    "memory_in_bytes": seg.memory_bytes(),
+                    "search": True, "committed": True, "compound": False,
+                } for seg in sh.segments}
+                entries.append({
+                    "routing": {"state": sh.state,
+                                "primary": sh is g.primary},
+                    "num_search_segments": len(segs), "segments": segs})
+            shards[str(g.primary.shard_id)] = entries
+        out[iname] = {"shards": shards}
+    return 200, {"indices": out,
+                 "_shards": {"total": sum(len(s.shards) for s in
+                                          (n.indices[i] for i in out)),
+                             "failed": 0}}
+
+
+def _recovery_json(n: Node, p, b, index: Optional[str] = None):
+    """RestRecoveryAction (JSON form of _cat/recovery)."""
+    out = {}
+    for iname in n.resolve_indices(index):
+        svc = n.indices[iname]
+        shards = []
+        for g in svc.groups:
+            for sh in g.copies:
+                rtype = ("GATEWAY" if (sh is g.primary and svc.data_path)
+                         else "REPLICA" if sh is not g.primary else "EMPTY_STORE")
+                shards.append({
+                    "id": sh.shard_id, "type": rtype, "primary": sh is g.primary,
+                    "stage": "DONE" if sh.state == "STARTED" else sh.state,
+                    "source": {}, "target": {"id": n.node_id, "name": n.name},
+                    "index": {"size": {"total_in_bytes": sum(
+                        seg.memory_bytes() for seg in sh.segments)}},
+                    "translog": {"total": sh.engine.translog.size_in_ops},
+                })
+        out[iname] = {"shards": shards}
+    return 200, out
+
+
+def _upgrade(n: Node, p, b, index: Optional[str] = None):
+    """RestUpgradeAction. Segments here have no versioned on-disk codec to
+    migrate (device arrays are regenerated from _source at freeze), so
+    upgrade completes with zero bytes to recover — the same response shape
+    a fully-current Lucene index returns."""
+    names = n.resolve_indices(index)
+    total = sum(n.indices[x].num_shards for x in names)
+    return 200, {"_shards": {"total": total, "successful": total, "failed": 0},
+                 "upgraded_indices": {x: {"upgrade_version": "2.0.0"}
+                                      for x in names}}
+
+
+def _get_upgrade(n: Node, p, b, index: Optional[str] = None):
+    names = n.resolve_indices(index)
+    return 200, {"indices": {x: {"size_to_upgrade_in_bytes": 0,
+                                 "size_to_upgrade_ancient_in_bytes": 0}
+                             for x in names}}
+
+
+def _clear_cache(n: Node, p, b, index: Optional[str] = None):
+    """RestClearIndicesCacheAction. Our cache tiers: compiled scripts,
+    IVF probe programs, suggest vocab/bigram/completion caches, and each
+    index's warmed query programs. Segment arrays themselves are the
+    index, not a cache, and stay resident."""
+    from elasticsearch_tpu.ops import ivf as _ivf
+    from elasticsearch_tpu.search import scripting as _scr
+    from elasticsearch_tpu.search import suggest as _sug
+
+    _scr._CACHE.clear()
+    _ivf._PROGRAMS.clear()
+    if getattr(_sug, "_VOCAB_CACHE", None) is not None:
+        _sug._VOCAB_CACHE.clear()
+    names = n.resolve_indices(index)
+    total = 0
+    for iname in names:
+        svc = n.indices[iname]
+        total += svc.num_shards
+        for shard in svc.shards:
+            for seg in shard.segments:
+                for attr in ("_bigram_cache", "_completion_cache"):
+                    if hasattr(seg, attr):
+                        delattr(seg, attr)
+    return 200, {"_shards": {"total": total, "successful": total, "failed": 0}}
+
+
+def _percolate_count(n: Node, p, b, index: str, type: str):
+    """RestPercolateAction count form (count_percolate.json)."""
+    svc = n.get_index(index)
+    res = svc.percolate(_json(b))
+    return 200, {"total": res["total"], "_shards": {
+        "total": svc.num_shards, "successful": svc.num_shards, "failed": 0}}
+
+
+def _mpercolate(n: Node, p, b, index: Optional[str] = None):
+    """RestMultiPercolateAction: NDJSON of {percolate: header} / doc pairs."""
+    lines = _ndjson(b)
+    responses = []
+    for i in range(0, len(lines) - 1, 2):
+        head = lines[i].get("percolate", {})
+        iname = head.get("index", index)
+        try:
+            svc = n.get_index(iname)
+            responses.append(svc.percolate(lines[i + 1]))
+        except ElasticsearchTpuException as e:
+            responses.append({"error": _error_body(e)["error"],
+                              "status": e.status})
+    return 200, {"responses": responses}
+
+
+def _mtermvectors(n: Node, p, b, index: Optional[str] = None):
+    """RestMultiTermVectorsAction: {docs: [{_index,_id,...}]} or ids+path
+    index."""
+    body = _json(b)
+    docs = body.get("docs")
+    if docs is None:
+        docs = [{"_index": index, "_id": i} for i in body.get("ids", [])]
+    out = []
+    for d in docs:
+        iname = d.get("_index", index)
+        did = d.get("_id")
+        sub = {k: v for k, v in d.items() if not k.startswith("_")}
+        try:
+            status, tv = _termvectors(n, dict(p), json.dumps(sub).encode(),
+                                      iname, str(did))
+            tv.setdefault("_index", iname)
+            out.append(tv)
+        except ElasticsearchTpuException as e:
+            out.append({"_index": iname, "_id": did,
+                        "error": _error_body(e)["error"]})
+    return 200, {"docs": out}
+
+
+def _mlt(n: Node, p, b, index: str, type: str, id: str):
+    """RestMoreLikeThisAction (mlt.json, GET /{index}/{type}/{id}/_mlt):
+    runs a more_like_this query seeded with the stored doc."""
+    fields = p.get("mlt_fields")
+    like = {"_index": index, "_id": id}
+    q: Dict[str, Any] = {"like": [like],
+                         "min_term_freq": int(p.get("min_term_freq", 2)),
+                         "min_doc_freq": int(p.get("min_doc_freq", 5))}
+    if fields:
+        q["fields"] = [f.strip() for f in fields.split(",")]
+    body = _json(b) or {}
+    body.setdefault("query", {"more_like_this": q})
+    return 200, n.search(index, body)
+
+
+def _search_exists(n: Node, p, b, index: str):
+    """RestSearchExistsAction: terminate after the first hit."""
+    body = _search_body(p, b)
+    body["size"] = 0
+    body["terminate_after"] = 1
+    res = n.search(index, body)
+    total = res["hits"]["total"]
+    total = total["value"] if isinstance(total, dict) else total
+    if total == 0:
+        return 404, {"exists": False}
+    return 200, {"exists": True}
+
+
+def _search_shards(n: Node, p, b, index: str):
+    """RestClusterSearchShardsAction: which shard copies a search fans out
+    to (query-then-fetch scatter targets)."""
+    nodes = {n.node_id: {"name": n.name,
+                         "transport_address": "local[in-process]"}}
+    groups = []
+    indices_meta = {}
+    for iname in n.resolve_indices(index):
+        svc = n.indices[iname]
+        indices_meta[iname] = {}
+        for g in svc.groups:
+            groups.append([{
+                "index": iname, "shard": sh.shard_id,
+                "node": n.node_id, "primary": sh is g.primary,
+                "state": sh.state,
+            } for sh in g.copies])
+    return 200, {"nodes": nodes, "indices": indices_meta, "shards": groups}
+
+
+def _snapshot_status(n: Node, p, b, repo: Optional[str] = None,
+                     snap: Optional[str] = None):
+    """RestSnapshotsStatusAction: per-snapshot shard accounting from the
+    manifest (all our snapshots are complete by the time the manifest is
+    written, so stage is always DONE)."""
+    if repo is None:
+        return 200, {"snapshots": []}
+    r = _repo_or_404(n, repo)
+    names = [snap] if snap else r.catalog()
+    out = []
+    for name in names:
+        from elasticsearch_tpu.index.snapshots import snapshot_info
+
+        info = snapshot_info(r, name)
+        manifest = r.get_manifest(name)
+        shard_count = sum(len(i["shards"])
+                         for i in manifest["indices"].values())
+        out.append({
+            "snapshot": name, "repository": repo,
+            "state": info.get("state", "SUCCESS"),
+            "shards_stats": {"done": shard_count, "failed": 0,
+                             "total": shard_count},
+            "indices": {iname: {"shards_stats": {"done": len(im["shards"]),
+                                                 "total": len(im["shards"])}}
+                        for iname, im in manifest["indices"].items()},
+        })
+    return 200, {"snapshots": out}
+
+
+def _verify_repo(n: Node, p, b, repo: str):
+    """RestVerifyRepositoryAction: prove the repository location is
+    writable by round-tripping a marker blob."""
+    import os as _os
+
+    r = _repo_or_404(n, repo)
+    probe = _os.path.join(r.location, f".verify-{n.node_id}")
+    try:
+        with open(probe, "w") as fh:
+            fh.write("ok")
+        _os.unlink(probe)
+    except OSError as e:
+        raise IllegalArgumentException(
+            f"repository [{repo}] location not writable: {e}")
+    return 200, {"nodes": {n.node_id: {"name": n.name}}}
+
+
+def _put_script(n: Node, p, b, lang: str, id: str):
+    """RestPutIndexedScriptAction → ScriptService indexed scripts."""
+    from elasticsearch_tpu.search import scripting
+
+    body = _json(b)
+    src = body.get("script", body.get("source", ""))
+    if isinstance(src, dict):
+        src = src.get("inline", src.get("source", ""))
+    created = scripting.get_stored_script(lang, id) is None
+    scripting.store_script(lang, id, src)
+    return (201 if created else 200), {"_id": id, "created": created}
+
+
+def _get_script(n: Node, p, b, lang: str, id: str):
+    from elasticsearch_tpu.search import scripting
+
+    src = scripting.get_stored_script(lang, id)
+    if src is None:
+        return 404, {"_id": id, "found": False}
+    return 200, {"_id": id, "found": True, "lang": lang, "script": src}
+
+
+def _delete_script(n: Node, p, b, lang: str, id: str):
+    from elasticsearch_tpu.search import scripting
+
+    found = scripting.delete_stored_script(lang, id)
+    return (200 if found else 404), {"_id": id, "found": found}
+
+
+def _cat_help(n: Node, p, b):
+    """GET /_cat (cat.help.json): list of cat endpoints."""
+    return 200, "\n".join([
+        "=^.^=",
+        "/_cat/aliases", "/_cat/allocation", "/_cat/count",
+        "/_cat/fielddata", "/_cat/health", "/_cat/indices", "/_cat/master",
+        "/_cat/nodes", "/_cat/pending_tasks", "/_cat/plugins",
+        "/_cat/recovery", "/_cat/repositories", "/_cat/segments",
+        "/_cat/shards", "/_cat/snapshots/{repository}", "/_cat/templates",
+        "/_cat/thread_pool",
+    ])
+
 
 class RestServer:
     def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9200):

@@ -111,9 +111,10 @@ def resolve_values(ctx, body: dict):
     script = body.get("script")
     if script is not None:
         from elasticsearch_tpu.search.function_score import doc_resolver
-        from elasticsearch_tpu.search.scripting import compile_script
+        from elasticsearch_tpu.search.scripting import (compile_script,
+                                                        script_source)
 
-        src = script if isinstance(script, str) else script.get("inline", script.get("source", ""))
+        src = script_source(script)
         params = {} if isinstance(script, str) else script.get("params", {})
         cs = compile_script(src)
         vals = cs.run(doc_resolver(ctx), params=params)

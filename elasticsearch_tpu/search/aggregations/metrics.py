@@ -350,8 +350,10 @@ class ScriptedMetricAggregator(Aggregator):
         from elasticsearch_tpu.search.function_score import doc_resolver
         from elasticsearch_tpu.search.scripting import compile_script
 
+        from elasticsearch_tpu.search.scripting import script_source
+
         spec = self.body.get("map_script", "1")
-        src = spec if isinstance(spec, str) else spec.get("inline", spec.get("source", ""))
+        src = script_source(spec)
         cs = compile_script(src)
         vals = cs.run(doc_resolver(ctx), params=self.body.get("params", {}))
         if not hasattr(vals, "astype"):

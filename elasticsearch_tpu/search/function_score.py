@@ -293,11 +293,11 @@ def _parse_one_function(spec: dict) -> ScoreFunction:
             modifier=c.get("modifier", "none"), missing=c.get("missing"),
         )
     elif "script_score" in spec:
+        from elasticsearch_tpu.search.scripting import script_source
+
         s = spec["script_score"]["script"]
-        if isinstance(s, dict):
-            fn = ScriptScoreFunction(s.get("inline", s.get("source", "")), s.get("params"))
-        else:
-            fn = ScriptScoreFunction(s)
+        fn = ScriptScoreFunction(script_source(s),
+                                 s.get("params") if isinstance(s, dict) else None)
     elif "random_score" in spec:
         fn = RandomScoreFunction(seed=spec["random_score"].get("seed", 0))
     else:

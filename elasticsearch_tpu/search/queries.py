@@ -14,10 +14,10 @@ vector; function_score rewrites it. Everything stays on device; only query
 *preparation* (analysis, term lookup, chunk bucketing) happens on host.
 
 Deviation notes vs the reference (documented for the judge):
-- match_phrase computes candidate docs on device (conjunction) and verifies
-  positions host-side via the segment's positional CSR, then scores
-  matching docs with the sum of unigram BM25 scores (Lucene scores with
-  phrase frequency). A device positional program replaces this in R2.
+- match_phrase runs entirely on device since r2: the anchor-entry
+  positional program (ops/positional.py) yields an exact phrase-frequency
+  vector, scored like Lucene (idf_sum * tfNorm(phraseFreq) — the phrase
+  is a single pseudo-term through BM25Similarity).
 - fuzzy/wildcard/regexp expand terms by scanning the segment term dict
   (Lucene walks an FST); expansion is capped at ``max_expansions``.
 """
@@ -110,6 +110,13 @@ def _score_term_group(ctx, field, terms, boost=1.0, with_counts=False) -> Tuple[
 
     terms, weights = _dedupe_terms(terms, boost, lambda t: ctx.idf(field, t))
     all_positive = all(w > 0 for w in weights)
+    split = inv.postings_split()
+    if split is not None:
+        # oversized field: postings live across the device mesh; partial
+        # scores/counts/masks psum-merge (parallel/postings_shard.py)
+        kernels.record("bm25_postings_sharded")
+        return split.term_group(terms, weights, with_counts=with_counts,
+                                all_positive=all_positive, D=ctx.D)
     hyb = ctx.hybrid_slices(inv, terms, weights)
     kernels.record("bm25_hybrid" if hyb is not None else "bm25_scatter")
     if hyb is not None:
@@ -1238,7 +1245,13 @@ class MoreLikeThisQuery(Query):
             if loc is not None and ctx.segment.sources[loc]:
                 src = ctx.segment.sources[loc]
                 for f in self.fields:
-                    v = src.get(f)
+                    if f == "_all":
+                        # _all has no _source key; like the _all mapper it
+                        # is the concatenation of every text value
+                        v = " ".join(x for x in src.values()
+                                     if isinstance(x, str))
+                    else:
+                        v = src.get(f)
                     if isinstance(v, str):
                         texts.append(v)
         for field in self.fields:
@@ -1503,11 +1516,12 @@ def _parse_query_inner(dsl: Optional[dict]) -> Query:
         return parse_function_score(body)
 
     if qtype == "script":
+        from elasticsearch_tpu.search.scripting import script_source
+
         spec = body.get("script", body)
-        if isinstance(spec, dict):
-            return ScriptQuery(spec.get("inline", spec.get("source", "")),
-                               params=spec.get("params"))
-        return ScriptQuery(spec)
+        return ScriptQuery(script_source(spec),
+                           params=spec.get("params")
+                           if isinstance(spec, dict) else None)
 
     if qtype == "query_string":
         return QueryStringQuery(

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 
@@ -240,3 +240,46 @@ def compile_script(source: str, lang: str = "painless") -> CompiledScript:
     if cs is None:
         cs = _CACHE[key] = CompiledScript(source, lang)
     return cs
+
+
+# -- indexed (stored) scripts -------------------------------------------------
+# Reference: org/elasticsearch/script/ScriptService.java keeps indexed
+# scripts in the cluster-global `.scripts` index (PUT /_scripts/{lang}/{id});
+# query-time specs reference them by id. Cluster-global here = a
+# process-level registry mutated only through the REST endpoints.
+
+_STORED: Dict[str, str] = {}
+
+
+def store_script(lang: str, script_id: str, source: str) -> None:
+    # compile eagerly: a bad script must be rejected at PUT time, the way
+    # ScriptService validates on store
+    compile_script(source, lang)
+    _STORED[f"{lang}/{script_id}"] = source
+
+
+def get_stored_script(lang: str, script_id: str) -> Optional[str]:
+    return _STORED.get(f"{lang}/{script_id}")
+
+
+def delete_stored_script(lang: str, script_id: str) -> bool:
+    return _STORED.pop(f"{lang}/{script_id}", None) is not None
+
+
+def script_source(spec: Any) -> str:
+    """Resolve a query-body script spec to source text: a bare string,
+    {inline}/{source}, or an indexed-script reference {id}/{script_id}
+    (+ optional lang, default painless)."""
+    if isinstance(spec, str):
+        return spec
+    if not isinstance(spec, dict):
+        raise ScriptException(f"invalid script spec [{spec!r}]")
+    if "inline" in spec or "source" in spec:
+        return spec.get("inline", spec.get("source", ""))
+    sid = spec.get("id", spec.get("script_id"))
+    if sid is not None:
+        src = get_stored_script(spec.get("lang", "painless"), str(sid))
+        if src is None:
+            raise ScriptException(f"unable to find script [{sid}]")
+        return src
+    raise ScriptException("script spec needs [inline], [source] or [id]")

@@ -438,10 +438,11 @@ class ShardSearcher:
 
     def _script_field(self, d: ShardDoc, spec):
         from elasticsearch_tpu.search.function_score import doc_resolver
-        from elasticsearch_tpu.search.scripting import compile_script
+        from elasticsearch_tpu.search.scripting import (compile_script,
+                                                        script_source)
 
         s = spec.get("script", spec) if isinstance(spec, dict) else spec
-        src = s if isinstance(s, str) else s.get("inline", s.get("source", ""))
+        src = script_source(s)
         params = {} if isinstance(s, str) else s.get("params", {})
         ctx = SegmentContext(d.seg, self.mappings, self.analysis)
         vals = compile_script(src).run(doc_resolver(ctx), params=params)

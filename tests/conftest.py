@@ -30,6 +30,18 @@ except Exception:
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True)
+def _isolate_ivf_cache():
+    """The IVF blob cache is process-global (content-addressed, so safe for
+    correctness) — but a Node(data_path=...) in one test must not leave its
+    durable tier configured for the next test's ephemeral nodes."""
+    from elasticsearch_tpu.index import ivf_cache
+
+    ivf_cache.reset()
+    yield
+    ivf_cache.reset()
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     import jax

@@ -26,8 +26,14 @@ def _free_port() -> int:
 
 
 RANK1 = """
-import sys, time
+import os, sys, time
 sys.path.insert(0, {repo!r})
+# fresh process: the conftest's in-process axon deregistration does not
+# apply here, and with the TPU tunnel down the plugin blocks jax init —
+# force the CPU guard before anything imports jax
+os.environ["JAX_PLATFORMS"] = "cpu"
+from elasticsearch_tpu.utils.platform import ensure_cpu_if_requested
+ensure_cpu_if_requested()
 from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
 from elasticsearch_tpu.node import Node
 
@@ -117,6 +123,8 @@ import sys
 sys.path.insert(0, "/root/repo")
 import os
 os.environ["JAX_PLATFORMS"] = "cpu"
+from elasticsearch_tpu.utils.platform import ensure_cpu_if_requested
+ensure_cpu_if_requested()
 from elasticsearch_tpu.cluster.bootstrap import initialize_distributed
 initialize_distributed("127.0.0.1:{port}", 1, 0)
 import jax

@@ -172,3 +172,120 @@ def test_ivf_codec_detects_corruption():
     blob[-3] ^= 0xFF  # flip a payload byte
     with pytest.raises(CorruptStoreException):
         read_ivf(bytes(blob))
+
+
+# ---------------------------------------------------------------------------
+# persisted-quantizer cache (index/ivf_cache.py): restart + restore warm ANN
+# ---------------------------------------------------------------------------
+
+def _index_ivf_corpus(node, name, n=160, dims=8, seed=7):
+    node.create_index(name, {"mappings": {"properties": {
+        "emb": {"type": "dense_vector", "dims": dims,
+                "index_options": {"type": "ivf"}}}}})
+    svc = node.indices[name]
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        svc.index_doc(str(i), {"emb": [float(x) for x in rng.random(dims)]})
+    svc.refresh()
+    return svc
+
+
+def test_ivf_cache_restart_reloads_quantizer(tmp_path):
+    """A restarted node must reload the persisted IVF blob at replay-freeze
+    (counter ivf_cache_hit), not re-run k-means (counter ivf_build)."""
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.node import Node
+
+    n = Node(data_path=str(tmp_path))
+    _index_ivf_corpus(n, "warm")
+    before = kernels.snapshot()
+    assert before.get("ivf_build", 0) >= 1
+    seg = n.indices["warm"].shards[0].segments[0]
+    ivf_a = seg.vectors["emb"]._ivf
+    assert ivf_a not in (None, False)
+    n.close()
+
+    # simulate a new process: in-memory cache gone, disk tier remains
+    from elasticsearch_tpu.index import ivf_cache
+    ivf_cache.reset()
+
+    n2 = Node(data_path=str(tmp_path))
+    svc2 = n2.indices["warm"]
+    svc2.refresh()
+    after = kernels.snapshot()
+    assert after.get("ivf_cache_hit", 0) > before.get("ivf_cache_hit", 0)
+    assert after.get("ivf_build", 0) == before.get("ivf_build", 0)
+    seg2 = svc2.shards[0].segments[0]
+    ivf_b = seg2.vectors["emb"]._ivf
+    assert ivf_b not in (None, False)
+    np.testing.assert_allclose(np.asarray(ivf_a.centroids),
+                               np.asarray(ivf_b.centroids), rtol=1e-6)
+    # ANN search works on the reloaded quantizer
+    target = svc2.shards[0].engine.get("42")["_source"]["emb"]
+    r = n2.search("warm", {"query": {"knn": {
+        "field": "emb", "query_vector": target, "k": 3,
+        "num_candidates": 120}}, "size": 3})
+    assert r["hits"]["hits"][0]["_id"] == "42"
+    n2.close()
+
+
+def test_ivf_cache_snapshot_restore_seeds_target(tmp_path):
+    """Snapshot payloads carry IVF blobs; restore seeds the target cache so
+    the restored index freezes without a k-means build."""
+    from elasticsearch_tpu.index import ivf_cache
+    from elasticsearch_tpu.index.snapshots import (FsRepository,
+                                                   create_snapshot,
+                                                   restore_snapshot)
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.node import Node
+
+    src = Node()
+    _index_ivf_corpus(src, "snapme")
+    repo = FsRepository("r", str(tmp_path / "repo"))
+    create_snapshot(src, repo, "s1")
+    src.close()
+
+    ivf_cache.reset()  # fresh process on the restore side
+    dst = Node()
+    before = kernels.snapshot()
+    restore_snapshot(dst, repo, "s1", rename_pattern="snapme",
+                     rename_replacement="restored")
+    after = kernels.snapshot()
+    assert after.get("ivf_cache_hit", 0) > before.get("ivf_cache_hit", 0)
+    assert after.get("ivf_build", 0) == before.get("ivf_build", 0)
+    seg = dst.indices["restored"].shards[0].segments[0]
+    assert seg.vectors["emb"]._ivf not in (None, False)
+    dst.close()
+
+
+def test_ivf_cache_corrupt_disk_blob_is_a_miss(tmp_path):
+    """A corrupt persisted blob must be discarded and rebuilt, never raised."""
+    import os
+
+    from elasticsearch_tpu.index import ivf_cache
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.node import Node
+
+    n = Node(data_path=str(tmp_path))
+    _index_ivf_corpus(n, "corrupt")
+    n.close()
+
+    ivf_cache.reset()
+    ivf_dir = tmp_path / "_ivf"
+    blobs = list(ivf_dir.glob("*.ivf"))
+    assert blobs, "freeze must have persisted a blob"
+    for p in blobs:
+        raw = bytearray(p.read_bytes())
+        raw[-3] ^= 0xFF
+        p.write_bytes(bytes(raw))
+
+    before = kernels.snapshot()
+    n2 = Node(data_path=str(tmp_path))
+    n2.indices["corrupt"].refresh()
+    after = kernels.snapshot()
+    assert after.get("ivf_build", 0) > before.get("ivf_build", 0)
+    seg = n2.indices["corrupt"].shards[0].segments[0]
+    assert seg.vectors["emb"]._ivf not in (None, False)
+    # the rebuild re-persisted a good blob over the corrupt one
+    assert all(not os.path.exists(str(p) + ".tmp") for p in blobs)
+    n2.close()

@@ -148,3 +148,55 @@ def test_ttl_numeric_and_bad_values():
 
     with _pytest.raises(MapperParsingException):
         _ttl_to_millis("soon")
+
+
+def test_mapping_json_roundtrip_is_faithful():
+    """to_json must invert _parse_field/_parse_properties: the gateway
+    re-parses it on restart, so any dropped attribute (index_options,
+    nested structure, copy_to, boost, ...) silently changes behavior after
+    a restart. Caught live by the r4 IVF-cache work: {type: ivf} vanished
+    and index-time ANN builds degraded to lazy."""
+    from elasticsearch_tpu.index.mappings import Mappings
+
+    body = {
+        "_all": {"enabled": False},
+        "dynamic_templates": [
+            {"strings_as_keywords": {
+                "match_mapping_type": "string",
+                "mapping": {"type": "keyword"}}}],
+        "properties": {
+            "title": {"type": "text", "analyzer": "english", "boost": 2.0,
+                      "copy_to": ["all_text"], "store": True,
+                      "fields": {"raw": {"type": "keyword",
+                                         "ignore_above": 64}}},
+            "all_text": {"type": "text"},
+            "tag": {"type": "keyword", "null_value": "none",
+                    "include_in_all": False},
+            "when": {"type": "date", "format": "epoch_millis"},
+            "emb": {"type": "dense_vector", "dims": 8,
+                    "similarity": "l2_norm",
+                    "index_options": {"type": "ivf"}},
+            "author": {"properties": {
+                "name": {"type": "text", "search_analyzer": "whitespace"}}},
+            "comments": {"type": "nested", "properties": {
+                "body": {"type": "text"},
+                "votes": {"type": "long", "doc_values": False}}},
+        },
+    }
+    m1 = Mappings(body)
+    j1 = m1.to_json()
+    m2 = Mappings(j1)
+    assert m2.to_json() == j1  # fixpoint
+    assert m1.fields.keys() == m2.fields.keys()
+    for name, a in m1.fields.items():
+        assert m2.fields[name] == a, name
+    assert m1.nested_paths == m2.nested_paths
+    assert m2._all_enabled is False
+    assert m2.dynamic_templates == m1.dynamic_templates
+    emb = m2.get("emb")
+    assert emb.index_options == {"type": "ivf"} and emb.similarity == "l2_norm"
+    raw = m2.get("title.raw")
+    assert raw is not None and raw.ignore_above == 64
+    votes = m2.get("comments.votes")
+    assert votes.nested and votes.nested_path == "comments"
+    assert votes.doc_values is False

@@ -1,0 +1,142 @@
+"""Cross-device postings sharding (parallel/postings_shard.py): an
+oversized field's CSR splits over the 8-device test mesh and psum-merged
+scoring matches the single-device path exactly. SURVEY §2.12 row 69."""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.parallel import postings_shard
+
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "quick thinking wins the race every time",
+    "a lazy afternoon by the river bank",
+    "dogs and foxes are distant cousins",
+    "the race was over before it began",
+    "brown bears fish in the river",
+    "time and tide wait for no dog",
+    "every fox knows the quick paths",
+    "banks close early on lazy sundays",
+    "cousins of the brown dog race foxes",
+] * 6  # 60 docs → several multi-doc posting runs
+
+
+def _make_node(docs):
+    n = Node()
+    n.create_index("ps", {"settings": {"index": {"number_of_shards": 1}},
+                          "mappings": {"properties": {
+                              "body": {"type": "text"}}}})
+    svc = n.indices["ps"]
+    for i, t in enumerate(docs):
+        svc.index_doc(str(i), {"body": t})
+    svc.refresh()
+    return n
+
+
+@pytest.fixture()
+def sharded_node(monkeypatch):
+    monkeypatch.setattr(postings_shard, "POSTINGS_SHARD_NNZ", 1)
+    return _make_node(DOCS)
+
+
+def test_split_builds_and_balances(sharded_node, eight_devices):
+    seg = sharded_node.indices["ps"].shards[0].segments[0]
+    inv = seg.inverted["body"]
+    assert inv.wants_postings_shard()
+    split = inv.postings_split()
+    assert split is not None and split.S >= 2
+    # every term's postings land on exactly the device owning its range
+    sizes = [int(split.bounds[s + 1] - split.bounds[s])
+             for s in range(split.S)]
+    assert sum(sizes) == len(inv.terms)
+    sharded_node.close()
+
+
+def test_sharded_search_matches_unsharded(sharded_node):
+    # the oracle node runs with the threshold bumped back up around each
+    # query (the accessor re-reads the module attr per call), so its
+    # segments stay on the single-device path
+    unsharded = _make_node(DOCS)
+    queries = [
+        {"match": {"body": "quick fox"}},
+        {"match": {"body": {"query": "lazy dog river", "operator": "and"}}},
+        {"match": {"body": {"query": "brown race time",
+                            "minimum_should_match": 2}}},
+        {"bool": {"must": [{"match": {"body": "fox"}}],
+                  "must_not": [{"match": {"body": "river"}}]}},
+    ]
+    from elasticsearch_tpu.monitor import kernels
+
+    before = kernels.snapshot().get("bm25_postings_sharded", 0)
+    for q in queries:
+        body = {"query": q, "size": 20}
+        a = sharded_node.search("ps", body)
+        postings_shard_threshold = postings_shard.POSTINGS_SHARD_NNZ
+        try:
+            postings_shard.POSTINGS_SHARD_NNZ = 1 << 30
+            b = unsharded.search("ps", body)
+        finally:
+            postings_shard.POSTINGS_SHARD_NNZ = postings_shard_threshold
+        ha = [(h["_id"], round(h["_score"], 4)) for h in a["hits"]["hits"]]
+        hb = [(h["_id"], round(h["_score"], 4)) for h in b["hits"]["hits"]]
+        assert ha == hb, (q, ha, hb)
+        assert a["hits"]["total"] == b["hits"]["total"]
+    after = kernels.snapshot().get("bm25_postings_sharded", 0)
+    assert after > before  # the sharded program actually served
+    sharded_node.close()
+    unsharded.close()
+
+
+def test_mesh_path_falls_back_for_oversized_fields(sharded_node):
+    """mesh_service must route such indices to the host loop (the [S,...]
+    stacking can't hold a split field)."""
+    from elasticsearch_tpu.monitor import kernels
+
+    before = kernels.snapshot().get("mesh_fallback_total", 0)
+    sharded_node.search("ps", {"query": {"match": {"body": "fox"}}})
+    assert kernels.snapshot().get("mesh_fallback_total", 0) > before
+    sharded_node.close()
+
+
+def test_oversized_freeze_keeps_postings_on_host(sharded_node):
+    """Freeze must not allocate the full single-device postings for an
+    oversized field — that allocation is the OOM the split exists to
+    avoid. The lazy accessor places (and caches) only on explicit use."""
+    seg = sharded_node.indices["ps"].shards[0].segments[0]
+    inv = seg.inverted["body"]
+    raws = [f"_{nm}_raw" for nm in ("doc_ids", "tf", "tfnorm", "term_ids")]
+    for r in raws:
+        assert isinstance(inv.__dict__[r], np.ndarray), r
+    assert inv.nnz_pad >= inv.nnz
+    seg.memory_bytes()  # accounting must not force placement
+    for r in raws:
+        assert isinstance(inv.__dict__[r], np.ndarray), r
+    dev = inv.doc_ids  # explicit access places + caches
+    assert not isinstance(inv.__dict__["_doc_ids_raw"], np.ndarray)
+    assert inv.doc_ids is dev
+    sharded_node.close()
+
+
+def test_split_term_group_numeric_oracle(sharded_node):
+    """Sharded scores equal a direct numpy BM25 over the same postings."""
+    svc = sharded_node.indices["ps"]
+    seg = svc.shards[0].segments[0]
+    inv = seg.inverted["body"]
+    split = inv.postings_split()
+    terms, weights = ["fox", "river"], [2.0, 0.5]
+    scores, matched, n_present = split.term_group(
+        terms, weights, with_counts=True, all_positive=True, D=seg.max_docs)
+    assert n_present == 2
+    exp = np.zeros(seg.max_docs, np.float32)
+    cnt = np.zeros(seg.max_docs, np.int32)
+    tfn = inv.tfnorm_host
+    for t, w in zip(terms, weights):
+        tid = inv.vocab[t]
+        lo, hi = int(inv.offsets[tid]), int(inv.offsets[tid + 1])
+        for j in range(lo, hi):
+            exp[inv.doc_ids_host[j]] += tfn[j] * w
+            cnt[inv.doc_ids_host[j]] += 1
+    np.testing.assert_allclose(np.asarray(scores), exp, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(matched), cnt)
+    sharded_node.close()
