@@ -321,9 +321,11 @@ def main():
     ap.add_argument("--skip-knn", action="store_true")
     args = ap.parse_args()
 
-    from elasticsearch_tpu.utils.platform import ensure_cpu_if_requested
+    from elasticsearch_tpu.utils.platform import (enable_compilation_cache,
+                                                   ensure_cpu_if_requested)
 
     ensure_cpu_if_requested()
+    enable_compilation_cache()  # amortize the per-shape compile zoo
     import jax
 
     log(f"devices: {jax.devices()}")

@@ -223,6 +223,97 @@ def _register_all(rc: RestController):
     add("DELETE", "/_snapshot/{repo}/{snap}", _delete_snapshot)
     add("POST", "/_snapshot/{repo}/{snap}/_restore", _restore_snapshot)
 
+    # rest-api-spec sweep: root-scoped + alternate-spelling + GET forms
+    add("GET", "/_cat/aliases/{name}", _cat_aliases)
+    add("GET", "/_cat/allocation/{nodeid}",
+        lambda n, p, b, nodeid: _cat_allocation(n, p, b))
+    add("GET", "/_cat/fielddata/{fields}",
+        lambda n, p, b, fields: (200, []))
+    add("GET", "/_cat/indices/{index}", _cat_indices)
+    add("GET", "/_cat/recovery/{index}", _cat_recovery)
+    add("GET", "/_cat/segments/{index}", _cat_segments)
+    add("GET", "/_cat/shards/{index}", _cat_shards)
+    add("DELETE", "/_search/scroll/{scroll_id}",
+        lambda n, p, b, scroll_id: _clear_scroll(
+            n, p, json.dumps({"scroll_id": scroll_id}).encode()))
+    add("GET", "/_cluster/health/{index}",
+        lambda n, p, b, index: (200, n.cluster_state.health()))
+    add("GET", "/_cluster/state/{metric}",
+        lambda n, p, b, metric: (200, n.cluster_state.to_json()))
+    add("GET", "/_cluster/state/{metric}/{index}",
+        lambda n, p, b, metric, index: (200, n.cluster_state.to_json()))
+    add("GET", "/_cluster/stats/nodes/{nodeid}",
+        lambda n, p, b, nodeid: _cluster_stats(n, p, b))
+    add("GET", "/_mapping", _get_mapping_root)
+    add("GET", "/_mappings", _get_mapping_root)
+    add("GET", "/_mapping/{type}", _get_mapping_root)
+    add("PUT", "/_mapping/{type}", _put_mapping_root)
+    add("PUT", "/_mappings/{type}", _put_mapping_root)
+    add("POST", "/_mapping/{type}", _put_mapping_root)
+    add("POST", "/_mappings/{type}", _put_mapping_root)
+    add("GET", "/_settings", _get_settings_root)
+    add("GET", "/_settings/{name}", _get_settings_root)
+    add("PUT", "/_settings", _put_settings_root)
+    add("GET", "/_alias", _get_aliases)
+    add("GET", "/_aliases/{alias}", _get_alias)
+    add("GET", "/_template", lambda n, p, b: (
+        200, dict(n.cluster_state.templates)))
+    add("POST", "/_template/{name}", lambda n, p, b, name: (
+        200, n.put_template(name, _json(b))))
+    add("GET", "/_warmer", _get_warmers_root)
+    add("GET", "/_warmer/{name}", _get_warmers_root)
+    add("PUT", "/_warmer/{name}", _put_warmer_root)
+    add("PUT", "/_warmers/{name}", _put_warmer_root)
+    add("POST", "/_warmer/{name}", _put_warmer_root)
+    add("POST", "/_warmers/{name}", _put_warmer_root)
+    add("GET", "/_refresh", _refresh_all)
+    add("GET", "/_optimize", lambda n, p, b: _optimize(n, p, b, None))
+    add("GET", "/_cache/clear", _clear_cache)
+    add("GET", "/_mget", _mget)
+    add("GET", "/_mpercolate", _mpercolate)
+    add("GET", "/_msearch", _msearch)
+    add("GET", "/_search/scroll/{scroll_id}",
+        lambda n, p, b, scroll_id: _scroll(n, {**p, "scroll_id": scroll_id}, b))
+    add("POST", "/_search/scroll/{scroll_id}",
+        lambda n, p, b, scroll_id: _scroll(n, {**p, "scroll_id": scroll_id}, b))
+    add("GET", "/_search/exists", lambda n, p, b: _search_exists(n, p, b, None))
+    add("POST", "/_search/exists", lambda n, p, b: _search_exists(n, p, b, None))
+    add("GET", "/_search_shards", lambda n, p, b: _search_shards(n, p, b, None))
+    add("POST", "/_search_shards", lambda n, p, b: _search_shards(n, p, b, None))
+    add("GET", "/_validate/query", lambda n, p, b: _validate_query(n, p, b, None))
+    add("POST", "/_validate/query", lambda n, p, b: _validate_query(n, p, b, None))
+    add("GET", "/_stats/{metric}", lambda n, p, b, metric: (200, _all_stats(n)))
+    add("POST", "/_snapshot/{repo}/{snap}", _put_snapshot)
+    add("PUT", "/_snapshot/{repo}/{snap}/_create", _put_snapshot)
+    add("POST", "/_snapshot/{repo}/{snap}/_create", _put_snapshot)
+    add("POST", "/_search/template/{id}", _put_search_template)
+    add("GET", "/_mapping/{type}/field/{field}",
+        lambda n, p, b, type, field: _get_field_mapping(n, p, b, field, None))
+    # nodes.info / nodes.stats scoped forms (single node: node_id/metric
+    # selectors accept anything and return this node's full view)
+    add("GET", "/_nodes/hotthreads", _hot_threads)
+    add("GET", "/_nodes/{nodeid}/hotthreads",
+        lambda n, p, b, nodeid: _hot_threads(n, p, b))
+    add("GET", "/_cluster/nodes/hotthreads", _hot_threads)
+    add("GET", "/_cluster/nodes/hot_threads", _hot_threads)
+    add("GET", "/_cluster/nodes/{nodeid}/hotthreads",
+        lambda n, p, b, nodeid: _hot_threads(n, p, b))
+    add("GET", "/_cluster/nodes/{nodeid}/hot_threads",
+        lambda n, p, b, nodeid: _hot_threads(n, p, b))
+    add("GET", "/_nodes/stats/{metric}",
+        lambda n, p, b, metric: (200, n.nodes_stats()))
+    add("GET", "/_nodes/stats/{metric}/{imetric}",
+        lambda n, p, b, metric, imetric: (200, n.nodes_stats()))
+    add("GET", "/_nodes/{nodeid}/stats",
+        lambda n, p, b, nodeid: (200, n.nodes_stats()))
+    add("GET", "/_nodes/{nodeid}/stats/{metric}",
+        lambda n, p, b, nodeid, metric: (200, n.nodes_stats()))
+    add("GET", "/_nodes/{nodeid}/stats/{metric}/{imetric}",
+        lambda n, p, b, nodeid, metric, imetric: (200, n.nodes_stats()))
+    add("GET", "/_nodes/{nodeid}", lambda n, p, b, nodeid: (200, n.nodes_stats()))
+    add("GET", "/_nodes/{nodeid}/{metric}",
+        lambda n, p, b, nodeid, metric: (200, n.nodes_stats()))
+
     # index admin
     add("PUT", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
     add("POST", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
@@ -309,10 +400,10 @@ def _register_all(rc: RestController):
     add("GET", "/{index}/_field_stats", _field_stats)
     add("POST", "/{index}/_field_stats", _field_stats)
     add("GET", "/{index}/_termvectors/{id}", _termvectors)
-    add("GET", "/{index}/{type}/_percolate", _percolate)
-    add("POST", "/{index}/{type}/_percolate", _percolate)
-    add("GET", "/{index}/{type}/{id}/_percolate", _percolate_existing)
-    add("POST", "/{index}/{type}/{id}/_percolate", _percolate_existing)
+    add("GET", "/{index}/{type}/_percolate", _typed(_percolate, keep_type=True))
+    add("POST", "/{index}/{type}/_percolate", _typed(_percolate, keep_type=True))
+    add("GET", "/{index}/{type}/{id}/_percolate", _typed(_percolate_existing, keep_type=True))
+    add("POST", "/{index}/{type}/{id}/_percolate", _typed(_percolate_existing, keep_type=True))
     add("POST", "/_suggest", _suggest_all)
     add("GET", "/_suggest", _suggest_all)
     add("POST", "/{index}/_suggest", _suggest)
@@ -326,7 +417,12 @@ def _register_all(rc: RestController):
     add("DELETE", "/{index}/_alias/{name}", _delete_alias)
     add("DELETE", "/{index}/_aliases/{name}", _delete_alias)
     add("HEAD", "/{index}/_alias/{name}", _index_alias_exists)
+    add("HEAD", "/{index}/_aliases/{name}", _index_alias_exists)
+    add("HEAD", "/{index}/_alias", _index_any_alias)
     add("GET", "/{index}/_alias", _get_index_alias)
+    add("GET", "/{index}/_aliases", _get_index_alias)
+    add("GET", "/{index}/_aliases/{alias}",
+        lambda n, p, b, index, alias: _get_index_alias(n, p, b, index, alias))
     add("GET", "/{index}/_alias/{alias}",
         lambda n, p, b, index, alias: _get_index_alias(n, p, b, index, alias))
     add("HEAD", "/{index}/_mapping/{type}", _type_exists)
@@ -353,21 +449,81 @@ def _register_all(rc: RestController):
     add("GET", "/{index}/_search_shards", _search_shards)
     add("POST", "/{index}/_search_shards", _search_shards)
     add("POST", "/{index}/_termvectors/{id}", _termvectors)
-    add("GET", "/{index}/{type}/{id}/_termvectors",
-        lambda n, p, b, index, type, id: _termvectors(n, p, b, index, id))
-    add("POST", "/{index}/{type}/{id}/_termvectors",
-        lambda n, p, b, index, type, id: _termvectors(n, p, b, index, id))
-    add("GET", "/{index}/{type}/_percolate/count", _percolate_count)
-    add("POST", "/{index}/{type}/_percolate/count", _percolate_count)
-    add("GET", "/{index}/{type}/{id}/_mlt", _mlt)
+    add("GET", "/{index}/{type}/{id}/_termvectors", _typed(_termvectors))
+    add("POST", "/{index}/{type}/{id}/_termvectors", _typed(_termvectors))
+    add("GET", "/{index}/{type}/_percolate/count", _typed(_percolate_count, keep_type=True))
+    add("POST", "/{index}/{type}/_percolate/count", _typed(_percolate_count, keep_type=True))
+    add("GET", "/{index}/{type}/{id}/_mlt", _typed(_mlt, keep_type=True))
 
-    # ES 2.0 typed forms /{index}/{type}/{id} — registered LAST so every
-    # /_-prefixed sub-resource above wins the route (RestController does the
-    # same via explicit registration order)
+    # index-scoped GET/alternate forms (rest-api-spec sweep)
+    add("GET", "/{index}/_flush", _flush)
+    add("GET", "/{index}/_optimize", _optimize)
+    add("GET", "/{index}/_cache/clear",
+        lambda n, p, b, index: _clear_cache(n, p, b, index))
+    add("GET", "/{index}/_mget", _mget_index)
+    add("GET", "/{index}/_mpercolate",
+        lambda n, p, b, index: _mpercolate(n, p, b, index))
+    add("GET", "/{index}/_msearch", _msearch_index)
+    add("POST", "/{index}/_mapping", lambda n, p, b, index: (
+        200, n.put_mapping(index, _json(b))))
+    add("POST", "/{index}/_mapping/{type}", lambda n, p, b, index, type: (
+        200, n.put_mapping(index, _json(b))))
+    add("PUT", "/{index}/_mappings", lambda n, p, b, index: (
+        200, n.put_mapping(index, _json(b))))
+    add("PUT", "/{index}/_mappings/{type}", lambda n, p, b, index, type: (
+        200, n.put_mapping(index, _json(b))))
+    add("POST", "/{index}/_mappings", lambda n, p, b, index: (
+        200, n.put_mapping(index, _json(b))))
+    add("POST", "/{index}/_mappings/{type}", lambda n, p, b, index, type: (
+        200, n.put_mapping(index, _json(b))))
+    add("GET", "/{index}/_mappings", lambda n, p, b, index: (
+        200, n.get_mapping(index)))
+    add("GET", "/{index}/_mapping/{type}/field/{field}",
+        lambda n, p, b, index, type, field:
+        _get_field_mapping(n, p, b, field, index))
+    add("GET", "/{index}/_stats/{metric}",
+        lambda n, p, b, index, metric: (200, n.get_index(index).stats()))
+    add("GET", "/{index}/_warmers", _get_warmers)
+    add("GET", "/{index}/_warmers/{name}",
+        lambda n, p, b, index, name: _get_warmer(n, p, b, index, name))
+
+    # ES 2.0 typed forms — registered LAST so every /_-prefixed
+    # sub-resource above wins the route (RestController does the same via
+    # explicit registration order). {type} segments that start with an
+    # underscore are rejected by the handlers, not silently bound.
+    add("GET", "/{index}/{type}/_search/template", _typed(_search_template))
+    add("POST", "/{index}/{type}/_search/template", _typed(_search_template))
+    add("GET", "/{index}/{type}/_search/exists", _typed(_search_exists))
+    add("POST", "/{index}/{type}/_search/exists", _typed(_search_exists))
+    add("GET", "/{index}/{type}/_validate/query", _typed(_validate_query))
+    add("POST", "/{index}/{type}/_validate/query", _typed(_validate_query))
+    add("GET", "/{index}/{type}/_warmer/{name}", _typed(_get_warmer))
+    add("PUT", "/{index}/{type}/_warmer/{name}", _typed(_put_warmer))
+    add("PUT", "/{index}/{type}/_warmers/{name}", _typed(_put_warmer))
+    add("POST", "/{index}/{type}/_warmer/{name}", _typed(_put_warmer))
+    add("POST", "/{index}/{type}/_warmers/{name}", _typed(_put_warmer))
+    add("POST", "/{index}/_warmer/{name}", _put_warmer)
+    add("POST", "/{index}/_warmers/{name}", _put_warmer)
+    add("GET", "/{index}/{type}/{id}/_explain", _typed(_explain))
+    add("POST", "/{index}/{type}/{id}/_explain", _typed(_explain))
+    add("GET", "/{index}/{type}/{id}/_source", _typed(_get_source))
+    add("POST", "/{index}/{type}/{id}/_update", _typed(_update_doc))
+    add("GET", "/{index}/{type}/{id}/_percolate/count",
+        _typed(_percolate_count_existing, keep_type=True))
+    add("POST", "/{index}/{type}/{id}/_percolate/count",
+        _typed(_percolate_count_existing, keep_type=True))
+    add("POST", "/{index}/{type}/{id}/_mlt", _typed(_mlt, keep_type=True))
+    add("HEAD", "/{index}/{type}/{id}", _doc_exists_typed)
     add("PUT", "/{index}/{type}/{id}", _index_doc_typed)
     add("POST", "/{index}/{type}/{id}", _index_doc_typed)
     add("GET", "/{index}/{type}/{id}", _get_doc_typed)
     add("DELETE", "/{index}/{type}/{id}", _delete_doc_typed)
+    add("HEAD", "/{index}/{type}", _type_exists_head)
+    add("POST", "/{index}/{type}", _index_doc_auto_typed)
+    add("PUT", "/{index}/{type}", _index_doc_auto_typed)
+    # indices.get feature form — LAST of all: only segments no literal
+    # route claimed can land here, and non-feature values 400
+    add("GET", "/{index}/{feature}", _get_index_feature)
 
 
 # -- snapshot helpers --------------------------------------------------------
@@ -468,9 +624,21 @@ def _all_stats(n: Node) -> dict:
     return {"indices": {name: svc.stats() for name, svc in n.indices.items()}}
 
 
-def _cat_indices(n: Node, p, b):
+def _cat_scope(n: Node, index: Optional[str]):
+    """Index names a scoped _cat route covers. A concrete name that
+    resolves to nothing is a 404 (reference convention); wildcards and
+    _all just narrow to the empty set."""
+    names = n.resolve_indices(index)
+    if not names and index not in (None, "", "_all", "*") \
+            and "*" not in str(index) and "?" not in str(index):
+        raise IndexNotFoundException(index)
+    return names
+
+
+def _cat_indices(n: Node, p, b, index: Optional[str] = None):
     rows = []
-    for name, svc in n.indices.items():
+    for name in _cat_scope(n, index):
+        svc = n.indices[name]
         rows.append({
             "health": "green", "status": "open", "index": name,
             "pri": str(svc.num_shards), "rep": str(svc.num_replicas),
@@ -486,9 +654,12 @@ def _cat_health(n: Node, p, b):
                   "shards": str(h["active_shards"])}]
 
 
-def _cat_shards(n: Node, p, b):
+def _cat_shards(n: Node, p, b, index: Optional[str] = None):
+    scope = set(_cat_scope(n, index))
     rows = []
     for r in n.cluster_state.routing:
+        if r.index not in scope:
+            continue
         svc = n.indices.get(r.index)
         docs = svc.shards[r.shard_id].engine.num_docs if svc else 0
         rows.append({"index": r.index, "shard": str(r.shard_id),
@@ -501,10 +672,16 @@ def _cat_nodes(n: Node, p, b):
     return 200, [{"name": n.name, "node.role": "mdi", "master": "*"}]
 
 
-def _cat_aliases(n: Node, p, b):
+def _cat_aliases(n: Node, p, b, name: Optional[str] = None):
+    import fnmatch
+
     rows = []
     for iname, svc in n.indices.items():
         for alias, spec in svc.aliases.items():
+            if name is not None and not any(
+                    fnmatch.fnmatch(alias, pat.strip())
+                    for pat in name.split(",")):
+                continue
             rows.append({"alias": alias, "index": iname,
                          "filter": "*" if spec.get("filter") else "-"})
     return 200, rows
@@ -520,9 +697,10 @@ def _cat_allocation(n: Node, p, b):
     return 200, [{"node": n.name, "shards": shards, "disk.indices": disk}]
 
 
-def _cat_segments(n: Node, p, b):
+def _cat_segments(n: Node, p, b, index: Optional[str] = None):
     rows = []
-    for iname, svc in n.indices.items():
+    for iname in _cat_scope(n, index):
+        svc = n.indices[iname]
         for g in svc.groups:
             for sh in g.copies:  # primaries and replicas, like _cat_shards
                 prirep = "p" if sh is g.primary else "r"
@@ -536,9 +714,10 @@ def _cat_segments(n: Node, p, b):
     return 200, rows
 
 
-def _cat_recovery(n: Node, p, b):
+def _cat_recovery(n: Node, p, b, index: Optional[str] = None):
     rows = []
-    for iname, svc in n.indices.items():
+    for iname in _cat_scope(n, index):
+        svc = n.indices[iname]
         for g in svc.groups:
             for sh in g.copies:
                 rtype = ("gateway" if (sh is g.primary and svc.data_path)
@@ -749,26 +928,21 @@ def _create_doc(n: Node, p, b, index: str, id: str):
     return 201, r
 
 
-_RESERVED_TYPES = {"_doc", "_search", "_mapping", "_bulk", "_refresh", "_flush",
-                   "_settings", "_stats", "_count", "_update", "_mget", "_analyze",
-                   "_create", "_source", "_optimize", "_forcemerge", "_aliases",
-                   "_validate", "_explain", "_termvectors", "_field_stats"}
-
-
 def _index_doc_typed(n: Node, p, b, index: str, type: str, id: str):
-    if type in _RESERVED_TYPES:
+    # any leading-underscore segment is a mis-bound meta path, not a type
+    if type.startswith("_"):
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
     return _index_doc(n, p, b, index, id, doc_type=type)
 
 
 def _get_doc_typed(n: Node, p, b, index: str, type: str, id: str):
-    if type in _RESERVED_TYPES:
+    if type.startswith("_"):
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
     return _get_doc(n, p, b, index, id)
 
 
 def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
-    if type in _RESERVED_TYPES:
+    if type.startswith("_"):
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
     return _delete_doc(n, p, b, index, id)
 
@@ -1715,6 +1889,157 @@ def _delete_script(n: Node, p, b, lang: str, id: str):
 
     found = scripting.delete_stored_script(lang, id)
     return (200 if found else 404), {"_id": id, "found": found}
+
+
+# -- rest-api-spec sweep: root-scoped and typed route forms ------------------
+# (tests/integration/test_rest_spec_coverage.py asserts every path x method
+# of the reference's rest-api-spec/api/*.json resolves in our route table)
+
+def _get_mapping_root(n: Node, p, b, type: Optional[str] = None):
+    """GET /_mapping[/{type}] (indices.get_mapping root forms)."""
+    return 200, n.get_mapping(None)
+
+
+def _put_mapping_root(n: Node, p, b, type: Optional[str] = None):
+    """PUT/POST /_mapping/{type}: apply to every index (all-or-nothing per
+    index set, same as MetaDataMappingService over a wildcard)."""
+    return 200, n.put_mapping(None, _json(b))
+
+
+def _get_settings_root(n: Node, p, b, name: Optional[str] = None):
+    """GET /_settings[/{name}] — {name} filters setting keys (wildcard).
+    An empty cluster answers 200 {} (only a concrete missing index 404s)."""
+    import fnmatch
+
+    if not n.indices:
+        return 200, {}
+    status, out = _get_settings(n, p, b, None)
+    if name:
+        for entry in out.values():
+            idx = entry["settings"]["index"]
+            entry["settings"]["index"] = {
+                k: v for k, v in idx.items()
+                if fnmatch.fnmatch(f"index.{k}", name)
+                or fnmatch.fnmatch(k, name)}
+    return status, out
+
+
+def _put_settings_root(n: Node, p, b):
+    from elasticsearch_tpu.cluster.metadata import update_index_settings
+
+    body = _json(b)
+    for iname in n.resolve_indices(None):
+        update_index_settings(n.indices[iname], body, node=n)
+    return 200, {"acknowledged": True}
+
+
+_INDEX_FEATURES = ("_settings", "_mappings", "_aliases", "_warmers")
+
+
+def _get_index_feature(n: Node, p, b, index: str, feature: str):
+    """GET /{index}/{feature} (indices.get): feature is a comma list of
+    _settings/_mappings/_aliases/_warmers. Registered after every literal
+    /{index}/_x route, so only unclaimed segments land here."""
+    feats = [f.strip() for f in feature.split(",")]
+    bad = [f for f in feats if f not in _INDEX_FEATURES]
+    if bad:
+        raise IllegalArgumentException(f"unknown index feature [{bad[0]}]")
+    out = {}
+    for iname in n.resolve_indices(index):
+        svc = n.indices[iname]
+        entry: Dict[str, Any] = {}
+        if "_settings" in feats:
+            entry["settings"] = {"index": {
+                "number_of_shards": str(svc.num_shards),
+                "number_of_replicas": str(svc.num_replicas)}}
+        if "_mappings" in feats:
+            entry["mappings"] = svc.mappings.to_json()
+        if "_aliases" in feats:
+            entry["aliases"] = svc.aliases
+        if "_warmers" in feats:
+            entry["warmers"] = {k: {"source": v}
+                                for k, v in svc.warmers.items()}
+        out[iname] = entry
+    if not out:
+        raise IndexNotFoundException(index)
+    return 200, out
+
+
+def _get_warmers_root(n: Node, p, b, name: Optional[str] = None):
+    """GET /_warmer[/{name}] across all indices ({name} may be a pattern)."""
+    import fnmatch
+
+    out = {}
+    for iname in n.resolve_indices(None):
+        svc = n.indices[iname]
+        ws = {k: {"source": v} for k, v in svc.warmers.items()
+              if name is None or fnmatch.fnmatch(k, name)}
+        if ws:
+            out[iname] = {"warmers": ws}
+    return 200, out
+
+
+def _put_warmer_root(n: Node, p, b, name: str):
+    """PUT/POST /_warmer/{name}: register on every index."""
+    body = _json(b)
+    for iname in n.resolve_indices(None):
+        n.indices[iname].warmers[name] = body
+    return 200, {"acknowledged": True}
+
+
+def _index_any_alias(n: Node, p, b, index: str):
+    """HEAD /{index}/_alias — any alias at all on the target indices."""
+    for iname in n.resolve_indices(index):
+        if n.indices[iname].aliases:
+            return 200, None
+    return 404, None
+
+
+def _percolate_count_existing(n: Node, p, b, index: str, type: str, id: str):
+    """GET/POST /{index}/{type}/{id}/_percolate/count (count_percolate
+    existing-doc form)."""
+    status, res = _percolate_existing(n, p, b, index, type, id)
+    svc = n.get_index(index)
+    return status, {"total": res.get("total", 0), "_shards": {
+        "total": svc.num_shards, "successful": svc.num_shards, "failed": 0}}
+
+
+def _index_doc_auto_typed(n: Node, p, b, index: str, type: str):
+    """POST/PUT /{index}/{type} — auto-id index with an explicit type.
+    Registered LAST: any unclaimed /_x segment must not become a type.
+    Delegates to _index_doc so version/op_type/parent/timestamp/ttl params
+    behave identically to every other index route."""
+    if type.startswith("_"):
+        raise IllegalArgumentException(f"unsupported path [{index}/{type}]")
+    return _index_doc(n, p, b, index, None, doc_type=type)
+
+
+def _doc_exists_typed(n: Node, p, b, index: str, type: str, id: str):
+    if type.startswith("_"):
+        raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    return _doc_exists(n, p, b, index, id)
+
+
+def _type_exists_head(n: Node, p, b, index: str, type: str):
+    if type.startswith("_"):
+        raise IllegalArgumentException(f"unsupported path [{index}/{type}]")
+    return _type_exists(n, p, b, index, type)
+
+
+def _typed(handler, keep_type: bool = False):
+    """Wrap a handler for a /{index}/{type}/... route: a {type} segment
+    that starts with an underscore is a mis-bound meta path, not a type —
+    reject it instead of silently serving (the reference answers 400 'no
+    handler'). keep_type forwards the validated type to handlers that use
+    it (percolate, mlt, exists_type)."""
+    def h(n, p, b, **kw):
+        t = kw.get("type", "")
+        if t.startswith("_"):
+            raise IllegalArgumentException(f"unsupported path segment [{t}]")
+        if not keep_type:
+            kw.pop("type", None)
+        return handler(n, p, b, **kw)
+    return h
 
 
 def _cat_help(n: Node, p, b):

@@ -27,9 +27,11 @@ def main(argv=None):
                          "ranks dial the coordinator host on it)")
     args = ap.parse_args(argv)
 
-    from elasticsearch_tpu.utils.platform import ensure_cpu_if_requested
+    from elasticsearch_tpu.utils.platform import (enable_compilation_cache,
+                                                   ensure_cpu_if_requested)
 
     ensure_cpu_if_requested()
+    enable_compilation_cache()  # persistent XLA cache: warm-start restarts
 
     cluster = None
     if args.coordinator:

@@ -12,6 +12,37 @@ from __future__ import annotations
 import os
 
 
+def enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache (SURVEY §6 lever: "persistent
+    compilation cache"). First compile of each program shape costs tens of
+    seconds on a tunneled chip; caching to disk makes node restarts and
+    bench runs warm-start. Opt-out with ESTPU_XLA_CACHE=off; override the
+    directory by setting it to a path."""
+    path = os.environ.get("ESTPU_XLA_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "estpu_xla")
+    if path.lower() in ("0", "off", "none"):
+        return
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu" \
+            and not os.environ.get("ESTPU_XLA_CACHE"):
+        # XLA:CPU AOT results encode exact host machine features; reloading
+        # them on a different host risks SIGILL (observed: prefer-no-scatter
+        # mismatch warnings). The cache's real win is the tunneled TPU's
+        # 20-40s compiles, so CPU runs skip it unless explicitly pointed at
+        # a directory.
+        return
+    try:  # pragma: no cover - environment-specific
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache even fast compiles: the per-query program zoo is wide
+        # (pow2 shape buckets x query kinds) but each entry is small
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+
 def ensure_cpu_if_requested() -> None:
     if os.environ.get("JAX_PLATFORMS", "").lower() != "cpu":
         return

@@ -255,6 +255,108 @@ def test_cat_help_and_get_scroll(server):
     assert st == 200 and len(body["hits"]["hits"]) == 1
 
 
+def test_typed_routes(server):
+    """ES 2.0 typed forms: /{index}/{type}[/{id}] CRUD + sub-resources."""
+    st, body = _req(server, "POST", "/lib/book",
+                    {"title": "typed auto id", "tag": "c", "year": 2004})
+    assert st == 201 and body["created"]
+    auto_id = body["_id"]
+    st, _ = _req(server, "POST", "/lib/_refresh")
+    st, body = _req(server, "HEAD", f"/lib/book/{auto_id}")
+    assert st == 200
+    st, body = _req(server, "HEAD", "/lib/book")
+    assert st == 200  # type with live docs
+    st, body = _req(server, "HEAD", "/lib/nosuchtype")
+    assert st == 404
+    st, body = _req(server, "GET", f"/lib/book/{auto_id}/_source")
+    assert st == 200 and body["title"] == "typed auto id"
+    st, body = _req(server, "POST", f"/lib/book/{auto_id}/_update",
+                    {"doc": {"year": 2005}})
+    assert st == 200
+    _req(server, "POST", "/lib/_refresh")  # _explain searches segments
+    st, body = _req(server, "GET", f"/lib/book/{auto_id}/_explain",
+                    {"query": {"match": {"title": "typed"}}})
+    assert st == 200
+    st, body = _req(server, "DELETE", f"/lib/book/{auto_id}")
+    assert st == 200
+    _req(server, "POST", "/lib/_refresh")
+    # an unclaimed /_x segment must NOT bind as a type
+    st, body = _req(server, "POST", "/lib/_nosuch", {"title": "x"})
+    assert st == 400
+
+
+def test_root_scoped_forms(server):
+    st, body = _req(server, "GET", "/_mapping")
+    assert st == 200 and "lib" in body and "mappings" in body["lib"]
+    st, body = _req(server, "GET", "/_settings")
+    assert st == 200 and "lib" in body
+    st, body = _req(server, "GET", "/_settings/index.number_of_shards")
+    assert st == 200
+    assert list(body["lib"]["settings"]["index"]) == ["number_of_shards"]
+    st, body = _req(server, "GET", "/_alias")
+    assert st == 200 and "lib" in body
+    st, body = _req(server, "GET", "/_template")
+    assert st == 200
+    st, body = _req(server, "GET", "/_refresh")
+    assert st == 200 and body["_shards"]["failed"] == 0
+    st, body = _req(server, "GET", "/_warmer")
+    assert st == 200
+
+
+def test_index_feature_form(server):
+    """GET /{index}/{feature} (indices.get): comma list of features."""
+    st, body = _req(server, "GET", "/lib/_settings,_mappings")
+    assert st == 200
+    assert set(body["lib"]) == {"settings", "mappings"}
+    st, body = _req(server, "GET", "/lib/_aliases")
+    assert st == 200
+    st, body = _req(server, "GET", "/lib/bogusfeature")
+    assert st == 400
+
+
+def test_scoped_cat_and_cluster_forms(server):
+    st, body = _req(server, "GET", "/_cat/indices/lib")
+    assert st == 200 and len(body) == 1 and body[0]["index"] == "lib"
+    st, body = _req(server, "GET", "/_cat/indices/nomatch*")
+    assert st == 200 and body == []
+    st, body = _req(server, "GET", "/_cat/shards/lib")
+    assert st == 200 and all(r["index"] == "lib" for r in body)
+    st, body = _req(server, "GET", "/_cluster/health/lib")
+    assert st == 200 and "status" in body
+    st, body = _req(server, "GET", "/_cluster/state/metadata")
+    assert st == 200
+    st, body = _req(server, "GET", "/_nodes/stats/indices")
+    assert st == 200
+
+
+def test_scroll_path_form_and_clear(server):
+    st, body = _req(server, "POST", "/lib/_search?scroll=1m",
+                    {"query": {"match_all": {}}, "size": 1})
+    sid = body["_scroll_id"]
+    st, body = _req(server, "GET", f"/_search/scroll/{sid}")
+    assert st == 200 and len(body["hits"]["hits"]) == 1
+    st, body = _req(server, "DELETE", f"/_search/scroll/{sid}")
+    assert st == 200 and body["num_freed"] == 1
+
+
+def test_root_warmer_and_mapping_type_forms(server):
+    st, body = _req(server, "PUT", "/_warmer/w_all",
+                    {"query": {"match_all": {}}})
+    assert st == 200
+    st, body = _req(server, "GET", "/_warmer/w_all")
+    assert st == 200 and body["lib"]["warmers"]["w_all"]
+    st, body = _req(server, "GET", "/lib/book/_warmer/w_all")
+    assert st == 200
+    st, body = _req(server, "DELETE", "/lib/_warmer/w_all")
+    assert st == 200
+    # root put_mapping applies to every index
+    st, body = _req(server, "PUT", "/_mapping/doc",
+                    {"properties": {"extra_root": {"type": "keyword"}}})
+    assert st == 200 and body["acknowledged"]
+    st, body = _req(server, "GET", "/_mapping/doc")
+    assert "extra_root" in json.dumps(body)
+
+
 def test_unindexed_search_template(server):
     st, body = _req(server, "POST", "/_search/template", {
         "inline": {"query": {"term": {"tag": "{{t}}"}}},

@@ -1,0 +1,128 @@
+"""Property test: random engine op interleavings vs a model dict.
+
+SURVEY §4: "Property tests: random docs/queries, engine ops interleaving
+(index/delete/update/refresh) vs model dict." Reference behavioral frame:
+org/elasticsearch/index/engine/InternalEngine.java — realtime GET reads
+through the write buffer, search sees only refreshed state, versions are
+monotonic per id and survive deletes (tombstones).
+
+The model is two dicts: `live` (what a realtime GET must see NOW) and
+`segment_resident` (what search must see). The engine's documented TPU
+adaptation: additions become searchable at REFRESH (buffer freeze), but
+deletes — including the delete half of a re-index/update — hit the frozen
+segment's live mask IMMEDIATELY (segment.delete_local), so search loses a
+doc the moment it is deleted or updated, and regains the new copy at the
+next refresh.
+"""
+import random
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+
+OPS = ("index", "index_existing", "update", "delete", "delete_missing",
+       "refresh", "merge")
+WEIGHTS = (30, 15, 15, 12, 4, 18, 6)
+
+
+def _random_doc(rng):
+    return {
+        "title": " ".join(rng.choices(
+            ["alpha", "beta", "gamma", "delta", "fox"], k=rng.randint(1, 4))),
+        "rank": rng.randint(0, 99),
+    }
+
+
+@pytest.mark.parametrize("seed", [7, 41, 1234])
+def test_engine_ops_interleaving_matches_model(seed):
+    rng = random.Random(seed)
+    node = Node()
+    node.create_index("prop", {
+        "settings": {"index": {"number_of_shards": 1}},
+        "mappings": {"properties": {
+            "title": {"type": "text"}, "rank": {"type": "integer"}}}})
+    svc = node.indices["prop"]
+
+    live = {}              # id -> (source, version), realtime view
+    segment_resident = {}  # id -> source, what search must return
+    next_id = 0
+
+    def check_realtime(doc_id):
+        got = svc.get_doc(doc_id)
+        if doc_id in live:
+            src, ver = live[doc_id]
+            assert got["found"], (doc_id, got)
+            assert got["_source"] == src
+            assert got["_version"] == ver
+        else:
+            assert not got.get("found"), (doc_id, got)
+
+    for step in range(200):
+        op = rng.choices(OPS, weights=WEIGHTS)[0]
+        existing = sorted(live)
+        if op in ("index_existing", "update", "delete") and not existing:
+            op = "index"
+        if op == "index":
+            doc_id = f"d{next_id}"
+            next_id += 1
+            src = _random_doc(rng)
+            r = svc.index_doc(doc_id, src)
+            assert r["created"] and r["_version"] >= 1
+            live[doc_id] = (src, r["_version"])
+        elif op == "index_existing":
+            doc_id = rng.choice(existing)
+            src = _random_doc(rng)
+            r = svc.index_doc(doc_id, src)
+            assert not r["created"]
+            assert r["_version"] == live[doc_id][1] + 1  # monotonic per id
+            live[doc_id] = (src, r["_version"])
+            # re-index deletes the segment copy; new copy waits for refresh
+            segment_resident.pop(doc_id, None)
+        elif op == "update":
+            doc_id = rng.choice(existing)
+            rank = rng.randint(100, 199)
+            r = svc.update_doc(doc_id, {"doc": {"rank": rank}})
+            src = dict(live[doc_id][0], rank=rank)
+            assert r["_version"] == live[doc_id][1] + 1
+            live[doc_id] = (src, r["_version"])
+            segment_resident.pop(doc_id, None)
+        elif op == "delete":
+            doc_id = rng.choice(existing)
+            r = svc.delete_doc(doc_id)
+            assert r["found"]
+            del live[doc_id]
+            segment_resident.pop(doc_id, None)  # instant search visibility
+        elif op == "delete_missing":
+            from elasticsearch_tpu.utils.errors import \
+                DocumentMissingException
+
+            with pytest.raises(DocumentMissingException):
+                svc.delete_doc(f"missing-{step}")
+        elif op == "refresh":
+            svc.refresh()
+            segment_resident = {i: s for i, (s, _v) in live.items()}
+        elif op == "merge":
+            svc.force_merge(1)
+            # merge rewrites segments; it must not change visibility
+
+        # realtime GET reads through the buffer at every step
+        check_realtime(rng.choice(existing) if existing else "d0")
+        if live:
+            check_realtime(rng.choice(sorted(live)))
+
+        # search sees exactly the segment-resident set at every step
+        if op in ("refresh", "merge", "delete", "update") or step % 17 == 0:
+            res = node.search("prop", {"query": {"match_all": {}},
+                                       "size": 500})
+            got_ids = sorted(h["_id"] for h in res["hits"]["hits"])
+            assert got_ids == sorted(segment_resident), (step, op)
+            assert res["hits"]["total"] == len(segment_resident)
+
+    # final convergence: refresh and compare content, not just ids
+    svc.refresh()
+    res = node.search("prop", {"query": {"match_all": {}}, "size": 500})
+    assert res["hits"]["total"] == len(live)
+    for h in res["hits"]["hits"]:
+        assert h["_source"] == live[h["_id"]][0]
+    node.close()
