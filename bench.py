@@ -254,6 +254,16 @@ def batched_msearch_qps(node, queries, k):
     return len(pairs) / dt, dt
 
 
+def _msearch_top1(node, q):
+    """Top-1 doc id for one query through the product path (agreement
+    probe for the bf16-impact secondary measurement)."""
+    r = node.search("msmarco", {
+        "query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
+        "size": 1})
+    hits = r["hits"]["hits"]
+    return hits[0]["_id"] if hits else None
+
+
 def knn_product_latency(node, qvecs, k, ann=False, num_candidates=100):
     # ann is passed EXPLICITLY both ways: the mapping's index_options would
     # otherwise route "exact" queries through IVF silently
@@ -398,8 +408,40 @@ def main():
         bm25_mfu_flops = 4.0 * len(bat_q) * impact.shape[0] * seg.max_docs
         log(f"batched msearch: {len(bat_q)} pure-dense queries in "
             f"{bdt * 1000:.0f} ms -> {batched_qps:.0f} qps")
+        # secondary: bf16-quantized impact block (SURVEY §6 lever) — same
+        # batch, block rebuilt in bf16; report throughput AND top-1
+        # agreement vs the f32 path so the quantization cost is visible
+        import os as _os
+
+        inv = seg.inverted["body"]
+        sample = bat_q[:64]
+        tops32 = [_msearch_top1(node, q) for q in sample]
+        _os.environ["ESTPU_IMPACT_BF16"] = "1"
+        try:
+            from elasticsearch_tpu.index.segment import DENSE_IMPACT_BUDGET
+
+            with inv._dense_lock:
+                DENSE_IMPACT_BUDGET.release(inv._dense_bytes)
+                inv._dense_bytes = 0
+                inv._dense = None
+                inv._dense_host = None
+            blk16 = inv.dense_block()
+            if blk16 is not None:
+                batched_qps_bf16, bdt16 = batched_msearch_qps(
+                    node, bat_q, args.k)
+                tops16 = [_msearch_top1(node, q) for q in sample]
+                bf16_agree = float(np.mean([a == b for a, b in
+                                            zip(tops32, tops16)]))
+                log(f"batched msearch bf16 impacts: {bdt16 * 1000:.0f} ms "
+                    f"-> {batched_qps_bf16:.0f} qps, top-1 agreement "
+                    f"{bf16_agree:.3f}")
+            else:
+                batched_qps_bf16, bf16_agree = 0.0, 0.0
+        finally:
+            del _os.environ["ESTPU_IMPACT_BF16"]
     else:
         batched_qps, bm25_mfu_flops, bdt = 0.0, 0.0, 1.0
+        batched_qps_bf16, bf16_agree = 0.0, 0.0
         log("no dense block — batched path skipped")
 
     peak = peak_flops_bf16()
@@ -495,6 +537,8 @@ def main():
         "dispatch_floor_ms": round(dispatch_floor_ms, 3),
         "dispatch_floor_steady_ms": round(floor_steady_ms, 3),
         "batched_qps": round(batched_qps, 1),
+        "batched_qps_bf16": round(batched_qps_bf16, 1),
+        "bf16_top1_agreement": round(bf16_agree, 3),
         "mfu": round(mfu, 4),
         "bm25_batched_mfu": round(bm25_mfu, 4),
         "target_p50_speedup": 8.0,

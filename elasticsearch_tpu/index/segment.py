@@ -29,6 +29,7 @@ segment.
 """
 from __future__ import annotations
 
+import os
 import threading
 from dataclasses import dataclass, field as dfield
 from typing import Any, Dict, List, Optional, Tuple
@@ -282,14 +283,26 @@ class InvertedField:
                 self._dense = False  # no qualifying terms: permanent
                 return None
             rows, impact = built
-            if not DENSE_IMPACT_BUDGET.reserve(impact.nbytes):
+            # SURVEY §6 "quantized impacts" lever: bf16 device storage
+            # halves the block's HBM and feeds the MXU without a cast
+            # (~0.4% relative tfnorm error; bench quantifies the ranking
+            # agreement). Host mirror stays f32 for mesh restacking.
+            bf16 = os.environ.get("ESTPU_IMPACT_BF16", "").lower() in (
+                "1", "true")
+            if bf16:
+                import jax.numpy as jnp
+
+                dev = jnp.asarray(impact, dtype=jnp.bfloat16)
+            else:
+                dev = _device_put(impact)
+            if not DENSE_IMPACT_BUDGET.reserve(dev.nbytes):
                 return None  # lost a race for the budget: retry later
-            self._dense_bytes = impact.nbytes
+            self._dense_bytes = dev.nbytes
             # host mirror: mesh prims restack [S, F, D] from it — pulling
             # the device copy back would be a huge d2h transfer (and on
             # network-attached chips big d2h pulls degrade the session)
             self._dense_host = impact
-            self._dense = (rows, _device_put(impact))
+            self._dense = (rows, dev)
             return self._dense
 
     def __del__(self):

@@ -90,6 +90,18 @@ def bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int):
 # lives ONLY in the base kernels; hybrids never re-implement it.
 # ---------------------------------------------------------------------------
 
+
+def _dense_dot(qw, dense_impact):
+    """qw @ impact with dtype-aware MXU mapping: an f32 block multiplies at
+    HIGHEST precision (exactness tests rely on it); a bf16 block (segment's
+    ESTPU_IMPACT_BF16 storage) takes the native bf16 MXU path with f32
+    accumulation — no upcast copy of the block in HBM."""
+    if dense_impact.dtype == jnp.bfloat16:
+        return jnp.dot(qw.astype(jnp.bfloat16), dense_impact,
+                       preferred_element_type=jnp.float32)
+    return jnp.dot(qw, dense_impact, precision=lax.Precision.HIGHEST)
+
+
 @partial(jax.jit, static_argnames=("P", "D"))
 def bm25_score_hybrid(
     dense_impact, qw, doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int
@@ -97,7 +109,7 @@ def bm25_score_hybrid(
     """Single-query hybrid BM25: qw f32[F] (idf*boost per dense term) scores
     frequent terms via one matvec; starts/lens/weights i32/f32[T] are the
     short-run tail. Returns f32[D]."""
-    dense = jnp.dot(qw, dense_impact, precision=lax.Precision.HIGHEST)
+    dense = _dense_dot(qw, dense_impact)
     return dense + bm25_score_segment(doc_ids, tfnorm, starts, lens, weights, P=P, D=D)
 
 
@@ -108,7 +120,7 @@ def bm25_score_hybrid_batch(
     """Batched hybrid BM25: ONE MXU matmul ``qw[Q, F] @ impact[F, D]`` for
     frequent terms (replacing what would be millions of scatter-adds for long
     postings runs) + the scatter kernel on the [Q, T] tail. Returns f32[Q, D]."""
-    dense = jnp.dot(qw, dense_impact, precision=lax.Precision.HIGHEST)
+    dense = _dense_dot(qw, dense_impact)
     return dense + bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, P=P, D=D)
 
 

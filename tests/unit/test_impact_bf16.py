@@ -1,0 +1,86 @@
+"""bf16 dense-impact storage (ESTPU_IMPACT_BF16) — SURVEY §6 "quantized
+impacts" lever. The block halves its HBM and multiplies natively on the
+MXU; scores must stay within bf16 tolerance of the f32 path and preserve
+ranking on non-tied corpora."""
+import os
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+
+DOCS = [
+    " ".join(f"w{(i * 7 + j * 3) % 23}" for j in range(12))
+    for i in range(48)
+]
+
+
+def _scores(node, q):
+    r = node.search("bf", {"query": {"match": {"body": q}}, "size": 48})
+    return {h["_id"]: h["_score"] for h in r["hits"]["hits"]}, \
+        [h["_id"] for h in r["hits"]["hits"]]
+
+
+def _build(monkeypatch, bf16: bool):
+    if bf16:
+        monkeypatch.setenv("ESTPU_IMPACT_BF16", "1")
+    else:
+        monkeypatch.delenv("ESTPU_IMPACT_BF16", raising=False)
+    # compare the HOST path that consumes the device block (the mesh prims
+    # restack from the f32 host mirror and are unaffected by the flag)
+    monkeypatch.setenv("ESTPU_DISABLE_MESH", "1")
+    # the dense block qualifies terms by df >= max(128, D/256); drop the
+    # bar so the tiny corpus builds one
+    import functools
+
+    from elasticsearch_tpu.index import segment as segmod
+
+    if not hasattr(segmod, "_orig_build_dense_impact"):
+        segmod._orig_build_dense_impact = segmod.build_dense_impact
+    monkeypatch.setattr(
+        segmod, "build_dense_impact",
+        functools.partial(segmod._orig_build_dense_impact, df_threshold=2))
+    node = Node()
+    node.create_index("bf", {"mappings": {"properties": {
+        "body": {"type": "text"}}}})
+    svc = node.indices["bf"]
+    for i, t in enumerate(DOCS):
+        svc.index_doc(str(i), {"body": t})
+    svc.refresh()
+    return node
+
+
+def test_bf16_impact_scores_within_tolerance(monkeypatch):
+    node32 = _build(monkeypatch, bf16=False)
+    s32, order32 = _scores(node32, "w1 w7 w14")
+    seg = node32.indices["bf"].shards[0].segments[0]
+    blk32 = seg.inverted["body"].dense_block()
+    node16 = _build(monkeypatch, bf16=True)
+    s16, order16 = _scores(node16, "w1 w7 w14")
+    seg16 = node16.indices["bf"].shards[0].segments[0]
+    blk16 = seg16.inverted["body"].dense_block()
+    if blk32 is None or blk16 is None:
+        pytest.skip("corpus built no dense block at this threshold")
+    import jax.numpy as jnp
+
+    assert blk16[1].dtype == jnp.bfloat16
+    assert blk32[1].dtype == jnp.float32
+    assert blk16[1].nbytes * 2 == blk32[1].nbytes  # budget halves
+    assert set(s16) == set(s32)
+    for d in s32:
+        assert s16[d] == pytest.approx(s32[d], rel=2e-2, abs=1e-3), d
+    node32.close()
+    node16.close()
+
+
+def test_bf16_impact_flag_is_off_by_default(monkeypatch):
+    monkeypatch.delenv("ESTPU_IMPACT_BF16", raising=False)
+    node = _build(monkeypatch, bf16=False)
+    seg = node.indices["bf"].shards[0].segments[0]
+    blk = seg.inverted["body"].dense_block()
+    if blk is not None:
+        import jax.numpy as jnp
+
+        assert blk[1].dtype == jnp.float32
+    assert os.environ.get("ESTPU_IMPACT_BF16") is None
+    node.close()
