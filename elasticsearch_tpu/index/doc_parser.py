@@ -164,8 +164,10 @@ class DocumentParser:
                 if full in self.mappings.nested_paths:
                     self._nested_children(full, [value], parsed)
                     continue
-                if fm is None or fm.type in ("object", "nested", "geo_point"):
-                    if fm is not None and fm.type == "geo_point":
+                if fm is None or fm.type in ("object", "nested", "geo_point",
+                                             "geo_shape"):
+                    if fm is not None and fm.type in ("geo_point",
+                                                      "geo_shape"):
                         self._index_value(fm, value, parsed)
                     else:
                         self._walk(value, f"{full}.", parsed)
@@ -176,6 +178,11 @@ class DocumentParser:
                 fm = self.mappings.get(full)
                 if fm is not None and fm.type == "completion":
                     self._index_value(fm, value, parsed)
+                    continue
+                if fm is not None and fm.type == "geo_shape":
+                    # array of shapes: each indexed, not object-flattened
+                    for shape in value:
+                        self._index_value(fm, shape, parsed)
                     continue
                 if full in self.mappings.nested_paths:
                     self._nested_children(full, value, parsed)
@@ -264,5 +271,27 @@ class DocumentParser:
                 if fm.type == "geo_point":
                     parsed.doc_values.setdefault(fm.name + ".lat", []).append(norm[0])
                     parsed.doc_values.setdefault(fm.name + ".lon", []).append(norm[1])
+                    continue
+                if fm.type == "geo_shape":
+                    # covering-cell tokens under `<field>.__cells`; freeze's
+                    # field discovery auto-builds the keyword postings the
+                    # geo_shape query filters on (search/geo.py)
+                    from elasticsearch_tpu.search.geo import \
+                        shape_index_tokens
+                    from elasticsearch_tpu.utils.errors import \
+                        QueryParsingException
+
+                    if not isinstance(norm, dict):
+                        raise MapperParsingException(
+                            f"geo_shape field [{fm.name}] expects a GeoJSON "
+                            "object")
+                    try:
+                        toks = shape_index_tokens(norm)
+                    except QueryParsingException as e:
+                        # index-time parse failures are mapper errors
+                        raise MapperParsingException(
+                            f"failed to parse [{fm.name}]: {e}") from e
+                    parsed.doc_values.setdefault(
+                        fm.name + ".__cells", []).extend(toks)
                     continue
                 parsed.doc_values.setdefault(fm.name, []).append(norm)

@@ -24,6 +24,8 @@ Names:
                       (index/ivf_cache.py) instead of rebuilt
   mesh_search         request served by the mesh product path
   mesh_fallback_total request fell back to the host per-shard loop
+  span_clause_truncated  a deeply-nested span clause exceeded
+                      MAX_SPANS_PER_CLAUSE on the host walk (search/spans)
 """
 from __future__ import annotations
 

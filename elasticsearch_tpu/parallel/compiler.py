@@ -430,6 +430,14 @@ class ExistsPrim(DataPrim):
                           else np.asarray(vc.exists))
                 elif f in seg.field_lengths:
                     ex = np.asarray(seg.field_lengths[f]) > 0
+                elif f"{f}.lat" in seg.numerics:  # geo_point split columns
+                    c = seg.numerics[f"{f}.lat"]
+                    ex = (c.exists_host if c.exists_host is not None
+                          else np.asarray(c.exists))
+                elif f"{f}.__cells" in seg.keywords:  # geo_shape cell tokens
+                    kw = seg.keywords[f"{f}.__cells"]
+                    ex = (kw.exists_host if kw.exists_host is not None
+                          else np.asarray(kw.exists))
                 else:
                     continue
                 h[si, : ex.shape[0]] = ex

@@ -279,19 +279,109 @@ class HistogramAggregator(Aggregator):
 class DateHistogramAggregator(HistogramAggregator):
     date = True
 
-    def _interval(self):
+    _CAL_MONTHS = {"month": 1, "1M": 1, "M": 1, "quarter": 3, "1q": 3,
+                   "q": 3, "year": 12, "1y": 12, "y": 12}
+
+    def _iv(self):
         iv = self.body.get("interval") or self.body.get("calendar_interval") or self.body.get("fixed_interval")
         if iv is None:
             raise SearchParseException("date_histogram requires [interval]")
-        ms = interval_to_millis(iv)
+        return iv
+
+    def _cal_months(self):
+        """Months per bucket for calendar intervals, None for fixed — the
+        ONE switch collect() and reduce() both consult, so they can never
+        disagree on which keying the partials carry."""
+        iv = self._iv()
+        if interval_to_millis(iv) is not None:
+            return None
+        months = self._CAL_MONTHS.get(str(iv))
+        if months is None:
+            raise SearchParseException(f"unknown date interval [{iv}]")
+        return months
+
+    def _interval(self):
+        ms = interval_to_millis(self._iv())
         if ms is None:
-            # calendar months/quarters/years handled by month bucketing:
-            # collect() uses exact host millis, so divide by mean month len;
-            # exact calendar boundaries land in R2 (documented deviation)
-            months = {"month": 1, "1M": 1, "M": 1, "quarter": 3, "1q": 3, "q": 3,
-                      "year": 12, "1y": 12, "y": 12}[str(iv)]
-            return months * 2_629_746_000.0  # mean Gregorian month
+            # nominal width for the base class's gap-stepping; calendar
+            # intervals never reach the base reduce (reduce() overrides)
+            return self._cal_months() * 2_629_746_000.0
         return float(ms)
+
+    def collect(self, ctx, mask):
+        """Calendar intervals (month/quarter/year) bucket on EXACT calendar
+        boundaries — month indices via numpy datetime64 (leap years and
+        month lengths from the calendar, not a mean width). The exact host
+        millis column is preferred; script/f32 sources round-trip through
+        f64 host values so the KEYS are still exact month starts (value
+        precision is the source's). Fixed intervals use the base class's
+        device path. Reference: common/rounding/TimeZoneRounding.java
+        (UTC case)."""
+        months = self._cal_months()
+        if months is None:
+            return super().collect(ctx, mask)
+        vals, exists, offset, col = resolve_values(ctx, self.body)
+        jnp = _jnp()
+        sel = exists & mask
+        idx = np.nonzero(np.asarray(sel))[0]
+        if idx.size == 0:
+            return {"buckets": {}}
+        if col is not None and col.exact is not None:
+            millis = col.exact[idx].astype(np.int64)
+        else:
+            millis = (np.asarray(vals, np.float64)[idx]
+                      + float(offset)).astype(np.int64)
+        stamps = millis.astype("datetime64[ms]")
+        midx = stamps.astype("datetime64[M]").astype(np.int64)
+        bucket_m = np.floor_divide(midx, months) * months
+        keys = bucket_m.astype("datetime64[M]").astype(
+            "datetime64[ms]").astype(np.int64)
+        uniq, cnt = np.unique(keys, return_counts=True)
+        buckets: Dict[float, dict] = {}
+        for k, c in zip(uniq.tolist(), cnt.tolist()):
+            b = {"doc_count": int(c)}
+            if self.subs:
+                dmask = np.zeros(ctx.D, bool)
+                dmask[idx[keys == k]] = True
+                b["subs"] = self.collect_subs(ctx, jnp.asarray(dmask) & mask)
+            buckets[float(k)] = b
+        return {"buckets": buckets}
+
+    def reduce(self, partials):
+        """Calendar intervals gap-fill by stepping MONTHS, not a fixed
+        width — the base reduce re-grids keys at interval multiples, which
+        would clobber exact calendar keys with zero-count buckets."""
+        months = self._cal_months()
+        if months is None:
+            return super().reduce(partials)
+        merged: Dict[float, int] = {}
+        sub_partials: Dict[float, list] = {}
+        for p in partials:
+            for k, b in p["buckets"].items():
+                merged[k] = merged.get(k, 0) + b["doc_count"]
+                if "subs" in b:
+                    sub_partials.setdefault(k, []).append(b["subs"])
+        min_dc = int(self.body.get("min_doc_count", 0))
+        keys = sorted(merged)
+        if keys and min_dc == 0:
+            m0 = int(np.datetime64(int(keys[0]), "ms").astype(
+                "datetime64[M]").astype(np.int64))
+            m1 = int(np.datetime64(int(keys[-1]), "ms").astype(
+                "datetime64[M]").astype(np.int64))
+            keys = [float(np.datetime64(m, "M").astype(
+                "datetime64[ms]").astype(np.int64))
+                for m in range(m0, m1 + 1, months)]
+        out = []
+        for k in keys:
+            dc = merged.get(k, 0)
+            if dc < min_dc:
+                continue
+            b = {"key": int(k), "doc_count": dc,
+                 "key_as_string": format_date(int(k))}
+            if k in sub_partials:
+                b.update(self.reduce_subs(sub_partials[k]))
+            out.append(b)
+        return {"buckets": out}
 
 
 # ---------------------------------------------------------------------------

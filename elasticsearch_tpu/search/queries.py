@@ -777,6 +777,12 @@ class ExistsQuery(Query):
             return None, seg.vectors[self.field].exists
         if self.field in seg.field_lengths:
             return None, seg.field_lengths[self.field] > 0
+        # composite fields store under internal columns: geo_point splits
+        # into .lat/.lon numerics, geo_shape into .__cells keyword postings
+        if f"{self.field}.lat" in seg.numerics:
+            return None, seg.numerics[f"{self.field}.lat"].exists
+        if f"{self.field}.__cells" in seg.keywords:
+            return None, seg.keywords[f"{self.field}.__cells"].exists
         return _empty(ctx)
 
 

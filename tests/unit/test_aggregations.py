@@ -106,6 +106,41 @@ def test_date_histogram_month(ctx):
     counts = [b["doc_count"] for b in out["h"]["buckets"]]
     assert sum(counts) == 6
     assert len(counts) == 3  # Jan, Feb, Mar
+    # exact calendar boundaries: every key is the 1st of a month, 00:00
+    assert all(b["key_as_string"][8:10] == "01"
+               and b["key_as_string"][11:19] == "00:00:00"
+               for b in out["h"]["buckets"])
+
+
+def test_date_histogram_calendar_exact_leap_february():
+    """Calendar bucketing must use real month lengths (leap year), not a
+    mean-month width (reference: TimeZoneRounding UTC calendar units)."""
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    n.create_index("cal", {"mappings": {"properties": {
+        "ts": {"type": "date"}}}})
+    svc = n.indices["cal"]
+    stamps = ["2015-12-31T23:59:59", "2016-01-31T23:59:59",
+              "2016-02-01T00:00:00", "2016-02-29T12:00:00",
+              "2016-03-01T00:00:00"]
+    for i, ts in enumerate(stamps):
+        svc.index_doc(str(i), {"ts": ts})
+    svc.refresh()
+    r = n.search("cal", {"size": 0, "aggs": {"m": {"date_histogram": {
+        "field": "ts", "interval": "month"}}}})
+    got = [(b["key_as_string"][:10], b["doc_count"])
+           for b in r["aggregations"]["m"]["buckets"]]
+    assert got == [("2015-12-01", 1), ("2016-01-01", 1),
+                   ("2016-02-01", 2), ("2016-03-01", 1)]
+    r = n.search("cal", {"size": 0, "aggs": {"y": {"date_histogram": {
+        "field": "ts", "interval": "year"},
+        "aggs": {"mx": {"max": {"field": "ts"}}}}}})
+    yb = r["aggregations"]["y"]["buckets"]
+    assert [(b["key_as_string"][:10], b["doc_count"]) for b in yb] == [
+        ("2015-01-01", 1), ("2016-01-01", 4)]
+    assert yb[0]["mx"]["value"] is not None  # sub-agg rides the exact path
+    n.close()
 
 
 def test_range_agg_with_subs(ctx):

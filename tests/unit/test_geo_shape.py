@@ -1,0 +1,186 @@
+"""Indexed geo_shape fields: cell-grid prefix filter + exact refinement.
+
+Reference: GeoShapeQueryBuilder.java / ShapeBuilder — docs store GeoJSON
+shapes, queries test shape-vs-shape relations. Oracle: the same geometry
+predicates evaluated brute-force over every doc (no cell filter), so the
+cell layer is proven to add no false negatives.
+"""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.search import geo
+
+
+def _poly(*pts):
+    ring = [list(p) for p in pts] + [list(pts[0])]
+    return {"type": "polygon", "coordinates": [ring]}
+
+
+DOCS = {
+    # id -> GeoJSON (lon, lat)
+    "sq_origin": _poly((-1, -1), (1, -1), (1, 1), (-1, 1)),       # 2x2 at 0,0
+    "sq_far": _poly((40, 40), (42, 40), (42, 42), (40, 42)),
+    "big": _poly((-20, -20), (20, -20), (20, 20), (-20, 20)),     # contains sq_origin
+    "pt_inside": {"type": "point", "coordinates": [0.5, 0.5]},
+    "pt_outside": {"type": "point", "coordinates": [10, 10]},
+    "line_cross": {"type": "linestring", "coordinates": [[-2, 0], [2, 0]]},
+    "envelope": {"type": "envelope", "coordinates": [[3, 6], [6, 3]]},
+}
+
+
+@pytest.fixture(scope="module")
+def node():
+    n = Node()
+    n.create_index("shapes", {"mappings": {"properties": {
+        "area": {"type": "geo_shape"},
+        "name": {"type": "keyword"}}}})
+    svc = n.indices["shapes"]
+    for i, (name, shape) in enumerate(DOCS.items()):
+        svc.index_doc(str(i), {"area": shape, "name": name})
+    svc.refresh()
+    yield n
+    n.close()
+
+
+def _search(node, shape, relation="intersects"):
+    r = node.search("shapes", {"query": {"geo_shape": {
+        "area": {"shape": shape, "relation": relation}}}, "size": 20})
+    return sorted(h["_source"]["name"] for h in r["hits"]["hits"])
+
+
+def _oracle(shape, relation):
+    qp = geo._shape_prims(shape)
+    out = []
+    for name, s in DOCS.items():
+        sp = geo._shape_prims(s)
+        if relation == "intersects" and geo.shape_intersects(sp, qp):
+            out.append(name)
+        elif relation == "within" and geo.shape_within(sp, qp):
+            out.append(name)
+        elif relation == "disjoint" and not geo.shape_intersects(sp, qp):
+            out.append(name)
+    return sorted(out)
+
+
+QUERIES = [
+    _poly((-2, -2), (2, -2), (2, 2), (-2, 2)),          # around origin
+    _poly((39, 39), (43, 39), (43, 43), (39, 43)),      # around sq_far
+    {"type": "point", "coordinates": [0, 0]},
+    {"type": "envelope", "coordinates": [[-25, 25], [25, -25]]},  # huge
+    {"type": "linestring", "coordinates": [[-30, 0], [30, 0]]},
+    {"type": "circle", "coordinates": [0.5, 0.5], "radius": "10km"},
+]
+
+
+@pytest.mark.parametrize("qi", range(len(QUERIES)))
+@pytest.mark.parametrize("relation", ["intersects", "within", "disjoint"])
+def test_matches_geometry_oracle(node, qi, relation):
+    got = _search(node, QUERIES[qi], relation)
+    assert got == _oracle(QUERIES[qi], relation), (qi, relation)
+
+
+def test_cross_level_matching(node):
+    """A tiny query shape against the big indexed polygon: the two cover
+    at different grid levels; the ancestor closure must still match."""
+    tiny = _poly((-0.01, -0.01), (0.01, -0.01), (0.01, 0.01), (-0.01, 0.01))
+    got = _search(node, tiny)
+    assert "big" in got and "sq_origin" in got
+
+
+def test_index_tokens_multilevel():
+    toks = geo.shape_index_tokens(DOCS["big"])  # 40-degree-wide shape
+    levels = {t.split(":")[0] for t in toks}
+    assert "g0" in levels  # coarse ancestors always present
+    small = geo.shape_index_tokens(DOCS["pt_inside"])
+    assert any(t.startswith("g2:") for t in small)  # point covers finest
+    assert any(t.startswith("g0:") for t in small)  # plus ancestors
+
+
+def test_geo_point_path_still_works(node):
+    """geo_point-mapped fields keep the point-in-shape path."""
+    n = Node()
+    n.create_index("pts", {"mappings": {"properties": {
+        "loc": {"type": "geo_point"}}}})
+    svc = n.indices["pts"]
+    svc.index_doc("a", {"loc": {"lat": 0.5, "lon": 0.5}})
+    svc.index_doc("b", {"loc": {"lat": 30.0, "lon": 30.0}})
+    svc.refresh()
+    r = n.search("pts", {"query": {"geo_shape": {"loc": {
+        "shape": _poly((-1, -1), (1, -1), (1, 1), (-1, 1))}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["a"]
+    # disjoint needs indexed shapes
+    from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+    with pytest.raises(ElasticsearchTpuException):
+        n.search("pts", {"query": {"geo_shape": {"loc": {
+            "shape": _poly((-1, -1), (1, -1), (1, 1), (-1, 1)),
+            "relation": "disjoint"}}}})
+    n.close()
+
+
+def test_shape_array_and_segment_without_shapes(node):
+    """An array of shapes indexes each member; a segment whose docs have
+    no shape field still answers (empty), including disjoint."""
+    n = Node()
+    n.create_index("arr", {"mappings": {"properties": {
+        "area": {"type": "geo_shape"}}}})
+    svc = n.indices["arr"]
+    svc.index_doc("multi", {"area": [
+        {"type": "point", "coordinates": [1, 1]},
+        {"type": "point", "coordinates": [50, 50]}]})
+    svc.refresh()
+    svc.index_doc("noshape", {"other": "x"})
+    svc.refresh()  # second segment with no __cells field
+    q = _poly((49, 49), (51, 49), (51, 51), (49, 51))
+    r = n.search("arr", {"query": {"geo_shape": {"area": {"shape": q}}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["multi"]
+    r = n.search("arr", {"query": {"geo_shape": {"area": {
+        "shape": q, "relation": "disjoint"}}}})
+    assert r["hits"]["total"] == 0  # point (1,1) ALSO in doc -> intersects
+    n.close()
+
+
+def test_bad_shape_is_mapper_error(node):
+    from elasticsearch_tpu.utils.errors import MapperParsingException
+
+    n = Node()
+    n.create_index("bad", {"mappings": {"properties": {
+        "area": {"type": "geo_shape"}}}})
+    with pytest.raises(MapperParsingException):
+        n.indices["bad"].index_doc("1", {"area": {"type": "nope"}})
+    with pytest.raises(MapperParsingException):
+        n.indices["bad"].index_doc("2", {"area": "not-geojson"})
+    n.close()
+
+
+def test_world_spanning_shape_bounded_cover():
+    world = {"type": "envelope", "coordinates": [[-179, 89], [179, -89]]}
+    toks = geo.shape_index_tokens(world)
+    assert len(toks) < 1200  # coarse bbox covering, not an explosion
+    assert all(t.startswith("g0:") for t in toks)
+
+
+def test_exists_on_composite_geo_fields(node):
+    r = node.search("shapes", {"query": {"exists": {"field": "area"}},
+                               "size": 20})
+    assert r["hits"]["total"] == len(DOCS)
+    n = Node()
+    n.create_index("pts2", {"mappings": {"properties": {
+        "loc": {"type": "geo_point"}}}})
+    n.indices["pts2"].index_doc("a", {"loc": {"lat": 1.0, "lon": 1.0}})
+    n.indices["pts2"].index_doc("b", {"other": "x"})
+    n.indices["pts2"].refresh()
+    r = n.search("pts2", {"query": {"exists": {"field": "loc"}}})
+    assert [h["_id"] for h in r["hits"]["hits"]] == ["a"]
+    n.close()
+
+
+def test_shape_in_bool_filter(node):
+    """The indexed-shape mask composes with other clauses on device."""
+    r = node.search("shapes", {"query": {"bool": {
+        "filter": [
+            {"geo_shape": {"area": {"shape": QUERIES[0]}}},
+            {"term": {"name": "pt_inside"}},
+        ]}}})
+    assert [h["_source"]["name"] for h in r["hits"]["hits"]] == ["pt_inside"]
