@@ -359,13 +359,26 @@ class IndexService:
     def percolate(self, body: dict) -> dict:
         """Percolate a doc (reference: rest/action/percolate/RestPercolateAction
         → PercolatorService.percolate)."""
-        from elasticsearch_tpu.search.percolator import percolate as _perc
+        from elasticsearch_tpu.search.percolator import (PERCOLATOR_TYPE,
+                                                         percolate as _perc)
 
         doc = (body or {}).get("doc")
         if doc is None:
             raise DocumentMissingException(self.name, "_percolate requires [doc]")
         matches, _total = _perc(self.percolator, [doc], self.mappings, self.analysis)
         full = matches[0]
+        # percolate-request query/filter restricts WHICH registered queries
+        # participate: it runs against the .percolator docs' own metadata
+        # (reference: PercolateSourceBuilder query + PercolatorService's
+        # percolateQueries filtering)
+        restrict = (body or {}).get("query") or (body or {}).get("filter")
+        if restrict is not None:
+            r = self.search({"query": {"bool": {
+                "must": [restrict],
+                "filter": [{"term": {"_type": PERCOLATOR_TYPE}}]}},
+                "size": 10_000, "_source": False})
+            allowed = {h["_id"] for h in r["hits"]["hits"]}
+            full = [qid for qid in full if qid in allowed]
         size = (body or {}).get("size")
         listed = full if size is None else full[: int(size)]
         return {

@@ -124,6 +124,22 @@ def bm25_score_hybrid_batch(
     return dense + bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, P=P, D=D)
 
 
+@partial(jax.jit, static_argnames=("P", "D", "k"))
+def bm25_hybrid_topk_batch(dense_impact, qw, doc_ids, tfnorm, starts, lens,
+                           weights, live, *, P: int, D: int, k: int):
+    """Batched hybrid top-k: scores via bm25_score_hybrid_batch, then the
+    per-query masked top-k and exact totals in the SAME program, so the
+    [Q, D] score block never leaves the device. For all-positive
+    disjunctive term groups, score > 0 is exactly 'matched'. Returns
+    (vals f32[Q, k], idx i32[Q, k], totals i32[Q])."""
+    scores = bm25_score_hybrid_batch(dense_impact, qw, doc_ids, tfnorm,
+                                     starts, lens, weights, P=P, D=D)
+    m = (scores > 0) & live[None, :]
+    masked = jnp.where(m, scores, NEG_INF)
+    vals, idx = lax.top_k(masked, k)
+    return vals, idx.astype(jnp.int32), jnp.sum(m.astype(jnp.int32), axis=1)
+
+
 @partial(jax.jit, static_argnames=("P", "D"))
 def match_count_hybrid(dense_impact, qind, doc_ids, starts, lens, *, P: int, D: int):
     """Matched-term count: qind f32[F] is the 1.0 indicator of dense query

@@ -3,11 +3,12 @@
 Reference: org/elasticsearch/action/search/TransportMultiSearchAction.java —
 ES executes msearch items as independent parallel searches on the search
 thread pool. Here a batch that is uniformly eligible (one index, simple
-bodies whose queries are pure-dense BM25 term groups) compiles to ONE
-``qw[Q, F] @ impact[F, D]`` streaming top-k per segment
-(queries.fused_bm25_topk_batch), amortizing per-request dispatch the way
-the mesh program amortizes per-shard scatter — this is the product path
-behind the bench's batched-QPS headline.
+bodies whose queries are same-field BM25 term groups) amortizes into one
+device program per segment: pure-dense batches take the streaming top-k
+kernel (queries.fused_bm25_topk_batch); batches with scatter tails take
+the hybrid matmul + batched-scatter + on-device top-k tier
+(queries.hybrid_bm25_topk_batch). This is the product path behind the
+bench's batched-QPS headline.
 
 Anything non-uniform returns None and the caller runs the requests
 sequentially (identical results, unamortized).
@@ -20,7 +21,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from elasticsearch_tpu.search.context import SegmentContext
-from elasticsearch_tpu.search.queries import fused_bm25_topk_batch, parse_query
+from elasticsearch_tpu.search.queries import (fused_bm25_topk_batch,
+                                              hybrid_bm25_topk_batch,
+                                              parse_query)
 from elasticsearch_tpu.search.service import ShardDoc
 
 _ALLOWED_KEYS = {"query", "size", "from", "_source"}
@@ -51,6 +54,12 @@ def try_batched_msearch(svc, bodies: List[dict]) -> Optional[List[dict]]:
             ctx = SegmentContext(seg, svc.mappings, svc.analysis,
                                  index_name=svc.name)
             out = fused_bm25_topk_batch(ctx, queries, min(k, seg.max_docs))
+            if out is None:
+                # tier 2: scatter tails allowed — one matmul + batched
+                # scatter + on-device per-query top-k (queries.
+                # hybrid_bm25_topk_batch)
+                out = hybrid_bm25_topk_batch(ctx, queries,
+                                             min(k, seg.max_docs))
             if out is None:
                 return None
             vals, ids, tot = out

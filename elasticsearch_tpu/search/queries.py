@@ -269,6 +269,75 @@ def fused_bm25_topk_batch(ctx, queries: List[Query], k: int):
     return np.asarray(vals), np.asarray(ids), np.asarray(totals)
 
 
+def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
+                           chunk_q: int = 64):
+    """Tier-2 msearch batch: same-field disjunctive term groups where
+    scatter TAILS are allowed — frequent terms ride one qw[Q, F] @
+    impact[F, D] matmul, rare terms the batched scatter kernel, with
+    per-query top-k + totals fused on device (ops.scoring.
+    bm25_hybrid_topk_batch). Q sweeps in chunk_q slices so the transient
+    [chunk, D] score block stays bounded (64 x 1M docs = 256 MB).
+
+    Returns (vals [Q, k], ids [Q, k], totals [Q]) or None (caller falls
+    back to sequential execution). Counter: bm25_hybrid per query."""
+    field = None
+    rows = []
+    for q in queries:
+        e = _fused_eligible_terms(ctx, q)
+        if e is None:
+            return None
+        f, (tlist, wlist) = e
+        if field is None:
+            field = f
+        elif f != field:
+            return None
+        rows.append((tlist, wlist))
+    inv = ctx.inv(field) if field is not None else None
+    if inv is None or inv.wants_postings_shard():
+        return None
+    slices = []
+    for tlist, wlist in rows:
+        h = ctx.hybrid_slices(inv, tlist, wlist)
+        if h is None:
+            return None  # no dense block / all-rare group: sequential
+        slices.append(h)
+    impact = slices[0][0]
+    Q, F = len(queries), int(impact.shape[0])
+    # shared chunk width/table size: a wider P than a query needs is
+    # harmless (lens bound the scatter window)
+    P = max(h[6] for h in slices)
+    T = max(h[3].shape[0] for h in slices)
+    qw = np.zeros((Q, F), np.float32)
+    starts = np.zeros((Q, T), np.int32)
+    lens = np.zeros((Q, T), np.int32)
+    ws = np.zeros((Q, T), np.float32)
+    for qi, h in enumerate(slices):
+        _imp, row_qw, _qind, st, ln, w, _p, _n = h
+        qw[qi] = row_qw
+        starts[qi, : st.shape[0]] = st
+        lens[qi, : ln.shape[0]] = ln
+        ws[qi, : w.shape[0]] = w
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.ops.scoring import bm25_hybrid_topk_batch
+
+    jnp = _jnp()
+    live = ctx.segment.live
+    kk = min(k, ctx.D)
+    out_v, out_i, out_t = [], [], []
+    for q0 in range(0, Q, chunk_q):
+        q1 = min(q0 + chunk_q, Q)
+        vals, ids, tot = bm25_hybrid_topk_batch(
+            impact, jnp.asarray(qw[q0:q1]), inv.doc_ids, inv.tfnorm,
+            jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
+            jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk)
+        out_v.append(np.asarray(vals))
+        out_i.append(np.asarray(ids))
+        out_t.append(np.asarray(tot))
+    kernels.record("bm25_hybrid", Q)
+    return (np.concatenate(out_v), np.concatenate(out_i),
+            np.concatenate(out_t))
+
+
 def _terms_filter_mask(ctx, field, terms):
     jnp = _jnp()
     inv = ctx.inv(field)

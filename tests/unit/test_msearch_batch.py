@@ -1,0 +1,92 @@
+"""Batched _msearch tiers vs sequential execution.
+
+search/batch.py: tier 1 (pure-dense fused streaming top-k) and tier 2
+(hybrid matmul + batched scatter tails, queries.hybrid_bm25_topk_batch)
+must return exactly what Q independent Node.search calls return — ids,
+scores, totals — and must actually serve via the batched kernels
+(counters), not fall back.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.monitor import kernels
+from elasticsearch_tpu.node import Node
+
+VOCAB = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta",
+         "theta", "iota", "kappa"]
+
+
+@pytest.fixture(scope="module")
+def node():
+    from elasticsearch_tpu.index import segment as segmod
+
+    # drop the dense-block df bar so the small corpus builds one, making
+    # the fused/hybrid tiers reachable (same knob as test_impact_bf16)
+    orig = segmod.build_dense_impact
+    segmod.build_dense_impact = functools.partial(orig, df_threshold=8)
+    n = Node()
+    n.create_index("mx", {"settings": {"index": {"number_of_shards": 2}},
+                          "mappings": {"properties": {
+                              "body": {"type": "text"}}}})
+    svc = n.indices["mx"]
+    rng = np.random.default_rng(11)
+    for i in range(120):
+        # frequent head words + a rare per-doc tail word
+        words = list(rng.choice(VOCAB[:4], size=6)) + \
+            [VOCAB[4 + int(rng.integers(0, 6))], f"rare{i % 37}"]
+        svc.index_doc(str(i), {"body": " ".join(words)})
+    svc.refresh()
+    yield n
+    segmod.build_dense_impact = orig
+    n.close()
+
+
+def _pairs(queries):
+    return [({"index": "mx"}, {"query": {"match": {"body": q}}, "size": 10})
+            for q in queries]
+
+
+def _assert_matches_sequential(node, queries, expect_counter):
+    kernels.reset()
+    resp = node.msearch(_pairs(queries))
+    assert kernels.snapshot().get(expect_counter, 0) >= len(queries), \
+        kernels.snapshot()
+    for q, r in zip(queries, resp["responses"]):
+        seq = node.search("mx", {"query": {"match": {"body": q}},
+                                 "size": 10})
+        got = [(h["_id"], round(h["_score"], 4)) for h in r["hits"]["hits"]]
+        want = [(h["_id"], round(h["_score"], 4))
+                for h in seq["hits"]["hits"]]
+        assert got == want, (q, got, want)
+        assert r["hits"]["total"] == seq["hits"]["total"], q
+
+
+def test_pure_dense_batch_tier1(node):
+    # head words only -> every term maps to a dense impact row
+    _assert_matches_sequential(
+        node, ["alpha beta", "gamma", "beta delta", "alpha gamma delta"],
+        "bm25_fused_topk")
+
+
+def test_mixed_tail_batch_tier2(node):
+    # rare words have short postings runs -> scatter tails alongside the
+    # dense head terms; tier 1 refuses, tier 2 serves
+    _assert_matches_sequential(
+        node, ["alpha rare1", "beta rare5 rare9", "gamma rare20",
+               "delta rare3 alpha"],
+        "bm25_hybrid")
+
+
+def test_unbatchable_falls_back_sequential(node):
+    kernels.reset()
+    resp = node.msearch([
+        ({"index": "mx"}, {"query": {"match": {"body": "alpha"}},
+                           "size": 5}),
+        ({"index": "mx"}, {"query": {"match": {"body": {
+            "query": "alpha beta", "operator": "and"}}}, "size": 5}),
+    ])
+    assert len(resp["responses"]) == 2
+    for r in resp["responses"]:
+        assert r["hits"]["total"] > 0

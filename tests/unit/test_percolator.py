@@ -72,3 +72,26 @@ def test_percolator_recovers_from_translog(tmp_path):
     r = s2.percolate({"doc": {"msg": "boom town"}})
     assert [m["_id"] for m in r["matches"]] == ["q1"]
     s2.close()
+
+
+def test_percolate_restricting_query(svc):
+    """The percolate-request query/filter selects WHICH registered queries
+    participate, matched against the query docs' own metadata (reference:
+    PercolateSourceBuilder.setQueryBuilder)."""
+    s = IndexService("scoped", mappings_json={"properties": {
+        "msg": {"type": "text"}, "prio": {"type": "keyword"}}})
+    s.index_doc("hi", {"query": {"match": {"msg": "error"}}, "prio": "high"},
+                doc_type=".percolator")
+    s.index_doc("lo", {"query": {"match": {"msg": "error"}}, "prio": "low"},
+                doc_type=".percolator")
+    s.refresh()
+    r = s.percolate({"doc": {"msg": "error here"}})
+    assert sorted(m["_id"] for m in r["matches"]) == ["hi", "lo"]
+    r = s.percolate({"doc": {"msg": "error here"},
+                     "filter": {"term": {"prio": "high"}}})
+    assert [m["_id"] for m in r["matches"]] == ["hi"]
+    assert r["total"] == 1
+    r = s.percolate({"doc": {"msg": "error here"},
+                     "query": {"term": {"prio": "low"}}})
+    assert [m["_id"] for m in r["matches"]] == ["lo"]
+    s.close()
