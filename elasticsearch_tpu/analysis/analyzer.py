@@ -58,9 +58,10 @@ BUILTIN_ANALYZERS = {
 
 # per-language analyzers (reference: index/analysis/*AnalyzerProvider for
 # GermanAnalyzer, FrenchAnalyzer, … and SnowballAnalyzerProvider.java):
-# standard tokenizer → lowercase → language stemmer. Stopword lists are the
-# english one only (documented deviation: non-english stop lists are not
-# bundled; configure a custom `stop` filter for them).
+# standard tokenizer → lowercase → language stop list → language stemmer.
+# Stop lists are the high-frequency core of each snowball list
+# (filters.LANGUAGE_STOP_WORDS); stemmers are the light UniNE family
+# (documented deviations in both cases: subset list, light stemmer).
 _LANGUAGE_ANALYZERS = ("french", "german", "spanish", "italian",
                        "portuguese", "dutch", "swedish", "norwegian",
                        "danish", "russian")
@@ -68,11 +69,10 @@ _LANGUAGE_ANALYZERS = ("french", "german", "spanish", "italian",
 
 def _language_analyzer(lang: str) -> Analyzer:
     stem = lambda toks, _l=lang: F.stemmer_filter(toks, language=_l)
-    # same family as the `english` builtin: lowercase → stop → stem (the
-    # stop list is the bundled english one for every language — deviation
-    # documented above)
+    sw = F.LANGUAGE_STOP_WORDS.get(lang, F.ENGLISH_STOP_WORDS)
+    stop = lambda toks, _sw=sw: F.stop_filter(toks, stopwords=_sw)
     return Analyzer(lang, T.standard_tokenizer,
-                    [F.lowercase_filter, F.stop_filter, stem])
+                    [F.lowercase_filter, stop, stem])
 
 
 def get_analyzer(name: str, language: str | None = None) -> Analyzer:

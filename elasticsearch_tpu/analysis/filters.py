@@ -26,6 +26,99 @@ ENGLISH_STOP_WORDS = frozenset(
     that the their then there these they this to was will with""".split()
 )
 
+# Per-language stopword sets: the high-frequency function-word core of the
+# snowball lists Lucene bundles per LanguageAnalyzer (the full snowball
+# files add rarer inflections; documented deviation: subset, not the full
+# file). Used by the per-language / snowball analyzer providers.
+LANGUAGE_STOP_WORDS = {
+    "english": ENGLISH_STOP_WORDS,
+    "french": frozenset(
+        """au aux avec ce ces dans de des du elle en et eux il ils je la le
+        les leur lui ma mais me mes moi mon ne nos notre nous on ou par pas
+        pour qu que qui sa se ses son sur ta te tes toi ton tu un une vos
+        votre vous y été étée étées étés étant suis es est sommes êtes sont
+        serai sera serons serez seront serais serait serions seriez seraient
+        étais était étions étiez étaient fus fut ai as avons avez ont aurai
+        aura aurons aurez auront avais avait avions aviez avaient eut eu
+        cette cet aussi même si ces leurs""".split()),
+    "german": frozenset(
+        """aber alle allem allen aller alles als also am an andere anderen
+        auch auf aus bei bin bis bist da damit dann der den des dem die das
+        dass daß du durch ein eine einem einen einer eines er es für hatte
+        hatten hab habe haben hier hin hinter ich ihr ihre im in ist ja
+        jede jedem jeden jeder jedes kann kein keine man mein mich mir mit
+        muss nach nicht noch nun nur ob oder ohne sehr sein seine sich sie
+        sind so über um und uns unser unter vom von vor war waren was wenn
+        werde werden wie wieder will wir wird wo zu zum zur zwischen""".split()),
+    "spanish": frozenset(
+        """a al algo algunos ante antes como con contra cual cuando de del
+        desde donde durante e el ella ellas ellos en entre era eran es esa
+        esas ese eso esos esta estas este esto estos fue fueron ha han hasta
+        hay la las le les lo los me mi mis mucho muy más ni no nos nosotros
+        nuestra nuestro o os otra otros para pero poco por porque que quien
+        se sea ser si sin sobre son soy su sus también tanto te tiene tienen
+        todo todos tu tus un una uno unos vosotros y ya yo""".split()),
+    "italian": frozenset(
+        """a ad al alla alle ai agli all anche ancora aveva avevano c che
+        chi ci come con contro cui da dal dalla dalle dai degli del della
+        delle dei di dove e ed era erano essere fa fra gli ha hanno i il in
+        io l la le lei li lo loro lui ma mi mia mio ne nei nel nella nelle
+        no noi non nostra nostro o per perché più quella quelle quelli
+        quello questa queste questi questo qui se sei si sia siamo sono sta
+        su sua sue sui sul sulla suo te ti tra tu tua tuo un una uno vi voi
+        è""".split()),
+    "portuguese": frozenset(
+        """a ao aos aquela aquele as até com como da das de dela dele deles
+        depois do dos e ela elas ele eles em entre era essa esse esta este
+        eu foi for foram há isso isto já lhe lhes mais mas me mesmo meu
+        minha muito na nas nem no nos nossa nosso não o os ou para pela
+        pelo por qual quando que quem se sem ser seu sua são só também te
+        tem teu tu tua um uma você vocês""".split()),
+    "dutch": frozenset(
+        """aan al alles als altijd andere ben bij daar dan dat de der deze
+        die dit doch doen door dus een en er ge geen geweest haar had heb
+        hebben heeft hem het hier hij hoe hun iemand iets ik in is ja je
+        kan kon kunnen maar me meer men met mij mijn moet na naar niet nog
+        nu of om omdat ons ook op over reeds te tegen toch toen tot u uit
+        uw van veel voor want waren was wat we wel werd wezen wie wij wil
+        worden zal ze zei zelf zich zij zijn zo zonder zou""".split()),
+    "swedish": frozenset(
+        """alla allt att av blev bli blir blivit de dem den denna deras
+        dess dessa det detta dig din dina ditt du där då efter ej eller en
+        er era ert ett från för ha hade han hans har henne hennes hon
+        honom hur här i icke ingen inom inte jag ju kan kunde man med mellan
+        men mig min mina mitt mot mycket ni nu när någon något några och om
+        oss på samma sedan sig sin sina sitta själv skulle som så sådan till
+        under upp ut utan vad var vara varför varit varje vars vart vem vi
+        vid vilka vilken vill åt än är över""".split()),
+    "norwegian": frozenset(
+        """alle at av bare begge ble blei bli blir da de deg dei deim deira
+        den denne der dette di din disse du eg ein eit eitt eller elles en
+        enn er et ett etter for fordi fra før ha hadde han hans har hennar
+        henne hennes her hjå ho hoe honom hun hva hvem hver hvilke hvilken
+        hvis hvor hvordan hvorfor i ikke ikkje ingen ja jeg kan kom korleis
+        kva kvar kven man mange me med medan meg men mi min mine mitt mot
+        mykje nå når og også om opp oss over på s seg selv si sia sidan sin
+        sine sitt skal skulle so som store til um var vart varte ved vere
+        verte vi vil ville vore vors vort være vært å""".split()),
+    "danish": frozenset(
+        """af alle alt anden at blev blive bliver da de dem den denne der
+        deres det dette dig din disse dog du efter eller en end er et for
+        fra ham han hans har havde have hende hendes her hos hun hvad hvis
+        hvor i ikke ind jeg jer jo kunne man mange med meget men mig min
+        mine mit mod ned noget nogle nu når og også om op os over på selv
+        sig sin sine sit skal skulle som sådan thi til ud under var vi vil
+        ville vor være været""".split()),
+    "russian": frozenset(
+        """а без более бы был была были было быть в вам вас весь во вот все
+        всего всех вы где да даже для до его ее ей ею если есть еще же за
+        здесь и из или им их к как ко когда кто ли либо мне может мы на
+        надо наш не него нее нет ни них но ну о об однако он она они оно
+        от очень по под при с со так также такой там те тем то того тоже
+        той только том ты у уже хотя чего чей чем что чтобы чье чья эта
+        эти это я""".split()),
+}
+
 
 def lowercase_filter(tokens: List[Token]) -> List[Token]:
     return [(t.lower(), p) for t, p in tokens]
