@@ -266,11 +266,14 @@ class Engine:
             if loc.where == "buffer":
                 if not realtime:
                     return None
-                return {"_id": doc_id, "_version": loc.version, "_source": loc.source, "found": True}
+                return {"_id": doc_id, "_type": loc.doc_type or "_doc",
+                        "_version": loc.version, "_source": loc.source,
+                        "found": True}
             for seg in self.segments:
                 if seg.seg_id == loc.where:
                     return {
                         "_id": doc_id,
+                        "_type": loc.doc_type or "_doc",
                         "_version": loc.version,
                         "_source": seg.sources[loc.local_id],
                         "found": True,

@@ -145,6 +145,7 @@ class IndexService:
             self.percolator.register(rid, source)
         return {
             "_index": self.name,
+            "_type": kw.get("doc_type") or "_doc",
             "_id": rid,
             "_version": version,
             "result": "created" if created else "updated",
@@ -161,7 +162,8 @@ class IndexService:
         shard = self.route(doc_id, routing)
         got = shard.engine.get(doc_id)
         if got is None:
-            return {"_index": self.name, "_id": doc_id, "found": False}
+            return {"_index": self.name, "_type": "_doc", "_id": doc_id,
+                    "found": False}
         got["_index"] = self.name
         return got
 
@@ -170,11 +172,15 @@ class IndexService:
 
         check_open(self)
         group = self.group_for(doc_id, routing)
+        loc = self.route(doc_id, routing).engine._locations.get(str(doc_id))
+        dtype = (loc.doc_type if loc is not None and loc.doc_type
+                 else "_doc")
         version, _failed = group.delete(doc_id, **kw)
         if self._percolator is not None:
             self._percolator.unregister(str(doc_id))
         return {
             "_index": self.name,
+            "_type": dtype,
             "_id": doc_id,
             "_version": version,
             "result": "deleted",

@@ -108,19 +108,50 @@ class Mappings:
         self._field_names_enabled = True
         self.dynamic_templates: List[dict] = []
         self.meta: dict = {}
+        # type names seen in 2.0 typed-mapping bodies (response echo /
+        # exists_type); the field model itself stays single-type
+        self.type_names: List[str] = []
         if mapping_json:
             self.merge(mapping_json)
 
     # -- parsing ---------------------------------------------------------------
 
+    _DIRECTIVES = frozenset({
+        "properties", "dynamic", "dynamic_templates", "date_detection",
+        "numeric_detection"})
+
+    def _is_type_block(self, key: str, val: Any) -> bool:
+        """ES 2.0 typed-mapping form: {"my_type": {...}}. A block is a type
+        when its value is a dict that is empty or holds mapping directives
+        — `{"title": {"type": "text"}}` (a field shorthand) is NOT."""
+        if key in ("_doc", "_default_"):
+            return isinstance(val, dict)
+        if key.startswith("_") or key in self._DIRECTIVES:
+            return False
+        if not isinstance(val, dict):
+            return False
+        return (not val or "properties" in val or "dynamic" in val
+                or any(k.startswith("_") for k in val)
+                or bool(self._DIRECTIVES & set(val)))
+
     def merge(self, mapping_json: dict):
-        """Merge a mapping JSON body ({"properties": {...}} or {"<type>": {...}})."""
+        """Merge a mapping JSON body: {"properties": {...}} or the 2.0
+        typed form {"<type>": {...}, ...} — every type block's fields merge
+        into the single-type field map (the deliberate single-type model;
+        `_type` is a queryable meta field), and the names are remembered in
+        `self.type_names` for response echo / exists_type."""
         body = mapping_json
-        if "properties" not in body and len(body) == 1:
-            # {"my_type": {"properties": ...}} form
-            only = next(iter(body.values()))
-            if isinstance(only, dict) and ("properties" in only or "dynamic" in only):
-                body = only
+        blocks = {k: v for k, v in body.items()
+                  if self._is_type_block(k, v)}
+        if blocks and "properties" not in body:
+            for tname, tbody in blocks.items():
+                if tname not in self.type_names:
+                    self.type_names.append(tname)
+                self.merge(tbody if tbody else {"properties": {}})
+            rest = {k: v for k, v in body.items() if k not in blocks}
+            if not rest:
+                return
+            body = rest
         if "dynamic" in body:
             self.dynamic = body["dynamic"]
         if "_source" in body:
@@ -322,7 +353,14 @@ class Mappings:
                     node["type"] = "nested"
                 cur = node.setdefault("properties", {})
             cur[parts[-1]] = _field_to_json(fm)
-        out = {"properties": props, "dynamic": self.dynamic}
+        # echo parity: defaults stay implicit (an empty typed block reads
+        # back as {}, like the reference) — the gateway re-parse treats
+        # missing keys as the same defaults
+        out: dict = {}
+        if props:
+            out["properties"] = props
+        if self.dynamic is not True:
+            out["dynamic"] = self.dynamic
         if self.dynamic_templates:
             out["dynamic_templates"] = list(self.dynamic_templates)
         if not self._all_enabled:

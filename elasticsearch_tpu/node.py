@@ -151,7 +151,17 @@ class Node:
         _deep_merge(merged_settings, settings)
         _deep_merge(merged_mappings, mappings)
         svc = IndexService(name, merged_settings, merged_mappings, data_path=self.data_path)
+        # aliases with `routing` fan it into index/search routing, like
+        # IndicesAliasesRequest does
+        for spec in aliases.values():
+            if isinstance(spec, dict) and "routing" in spec:
+                r = spec.pop("routing")
+                spec.setdefault("index_routing", r)
+                spec.setdefault("search_routing", r)
         svc.aliases = aliases
+        for wname, wspec in dict(body.get("warmers", {})).items():
+            svc.warmers[wname] = (wspec.get("source", wspec)
+                                  if isinstance(wspec, dict) else wspec)
         self.indices[name] = svc
         self.cluster_state.add_index(
             IndexMetadata(name, merged_settings, merged_mappings, aliases),
@@ -240,9 +250,13 @@ class Node:
                 alias = spec["alias"]
                 for n in idx_names:
                     if op == "add":
-                        self.indices[n].aliases[alias] = {
-                            k: v for k, v in spec.items() if k not in ("index", "indices", "alias")
-                        }
+                        meta = {k: v for k, v in spec.items()
+                                if k not in ("index", "indices", "alias")}
+                        if "routing" in meta:  # fans into both routings
+                            r = meta.pop("routing")
+                            meta.setdefault("index_routing", r)
+                            meta.setdefault("search_routing", r)
+                        self.indices[n].aliases[alias] = meta
                     elif op == "remove":
                         self.indices[n].aliases.pop(alias, None)
                     self._persist_index_meta(n)

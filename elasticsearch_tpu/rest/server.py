@@ -43,7 +43,9 @@ class RestController:
         def group(m):
             name = m.group(1)
             if name == "index":
-                return r"(?P<index>[^/_][^/]*)"
+                # _all is the one _-prefixed segment that IS an index
+                # expression (reference: /_all/_mapping, /_all/_warmer/x)
+                return r"(?P<index>_all|[^/_][^/]*)"
             return rf"(?P<{name}>[^/]+)"
 
         rx = re.sub(r"\{(\w+)\}", group, pattern)
@@ -238,10 +240,9 @@ def _register_all(rc: RestController):
             n, p, json.dumps({"scroll_id": scroll_id}).encode()))
     add("GET", "/_cluster/health/{index}",
         lambda n, p, b, index: (200, n.cluster_state.health()))
-    add("GET", "/_cluster/state/{metric}",
-        lambda n, p, b, metric: (200, n.cluster_state.to_json()))
+    add("GET", "/_cluster/state/{metric}", _cluster_state_metric)
     add("GET", "/_cluster/state/{metric}/{index}",
-        lambda n, p, b, metric, index: (200, n.cluster_state.to_json()))
+        lambda n, p, b, metric, index: _cluster_state_metric(n, p, b, metric))
     add("GET", "/_cluster/stats/nodes/{nodeid}",
         lambda n, p, b, nodeid: _cluster_stats(n, p, b))
     add("GET", "/_mapping", _get_mapping_root)
@@ -320,8 +321,22 @@ def _register_all(rc: RestController):
     add("DELETE", "/{index}", lambda n, p, b, index: (200, n.delete_index(index)))
     add("HEAD", "/{index}", _index_exists)
     add("GET", "/{index}/_mapping", lambda n, p, b, index: (200, n.get_mapping(index)))
+    add("GET", "/{index}/_mapping/{type}", _get_mapping_typed)
+    add("GET", "/{index}/_mappings/{type}", _get_mapping_typed)
+    for _m in ("PUT", "POST"):
+        add(_m, "/{index}/{type}/_mapping",
+            lambda n, p, b, index, type: (
+                200, n.put_mapping(index,
+                                   _typed_mapping_body(type, _json(b)))))
+        add(_m, "/{index}/{type}/_mappings",
+            lambda n, p, b, index, type: (
+                200, n.put_mapping(index,
+                                   _typed_mapping_body(type, _json(b)))))
+    add("GET", "/{index}/_settings/{name}",
+        lambda n, p, b, index, name: _get_settings_name(n, p, b, index, name))
     add("PUT", "/{index}/_mapping", lambda n, p, b, index: (200, n.put_mapping(index, _json(b))))
-    add("PUT", "/{index}/_mapping/{type}", lambda n, p, b, index, type: (200, n.put_mapping(index, _json(b))))
+    add("PUT", "/{index}/_mapping/{type}", lambda n, p, b, index, type: (
+        200, n.put_mapping(index, _typed_mapping_body(type, _json(b)))))
     add("GET", "/{index}/_settings", _get_settings)
     add("PUT", "/{index}/_settings", _put_settings)
     add("POST", "/{index}/_close", _close_index)
@@ -342,7 +357,7 @@ def _register_all(rc: RestController):
     add("POST", "/{index}/_flush", _flush)
     add("POST", "/{index}/_optimize", _optimize)  # ES 2.0 name
     add("POST", "/{index}/_forcemerge", _optimize)
-    add("GET", "/{index}/_stats", lambda n, p, b, index: (200, n.get_index(index).stats()))
+    add("GET", "/{index}/_stats", _index_stats)
     add("GET", "/{index}/_count", _count)
     add("POST", "/{index}/_count", _count)
 
@@ -467,22 +482,22 @@ def _register_all(rc: RestController):
     add("POST", "/{index}/_mapping", lambda n, p, b, index: (
         200, n.put_mapping(index, _json(b))))
     add("POST", "/{index}/_mapping/{type}", lambda n, p, b, index, type: (
-        200, n.put_mapping(index, _json(b))))
+        200, n.put_mapping(index, _typed_mapping_body(type, _json(b)))))
     add("PUT", "/{index}/_mappings", lambda n, p, b, index: (
         200, n.put_mapping(index, _json(b))))
     add("PUT", "/{index}/_mappings/{type}", lambda n, p, b, index, type: (
-        200, n.put_mapping(index, _json(b))))
+        200, n.put_mapping(index, _typed_mapping_body(type, _json(b)))))
     add("POST", "/{index}/_mappings", lambda n, p, b, index: (
         200, n.put_mapping(index, _json(b))))
     add("POST", "/{index}/_mappings/{type}", lambda n, p, b, index, type: (
-        200, n.put_mapping(index, _json(b))))
+        200, n.put_mapping(index, _typed_mapping_body(type, _json(b)))))
     add("GET", "/{index}/_mappings", lambda n, p, b, index: (
         200, n.get_mapping(index)))
     add("GET", "/{index}/_mapping/{type}/field/{field}",
         lambda n, p, b, index, type, field:
         _get_field_mapping(n, p, b, field, index))
     add("GET", "/{index}/_stats/{metric}",
-        lambda n, p, b, index, metric: (200, n.get_index(index).stats()))
+        lambda n, p, b, index, metric: _index_stats(n, p, b, index))
     add("GET", "/{index}/_warmers", _get_warmers)
     add("GET", "/{index}/_warmers/{name}",
         lambda n, p, b, index, name: _get_warmer(n, p, b, index, name))
@@ -532,14 +547,33 @@ def _put_repo(n: Node, p, b, repo: str):
     from elasticsearch_tpu.index.snapshots import FsRepository
 
     body = _json(b)
-    if body.get("type") != "fs":
-        raise IllegalArgumentException(f"repository type [{body.get('type')}] not supported (fs only)")
+    rtype = body.get("type")
     settings = body.get("settings", {})
-    loc = settings.get("location")
-    if not loc:
-        raise IllegalArgumentException("fs repository requires [settings.location]")
-    n.repositories[repo] = FsRepository(repo, loc,
-                                        compress=bool(settings.get("compress", True)))
+    if rtype == "fs":
+        loc = settings.get("location")
+        if not loc:
+            raise IllegalArgumentException(
+                "fs repository requires [settings.location]")
+        r = FsRepository(repo, loc,
+                         compress=bool(settings.get("compress", True)))
+    elif rtype == "url":
+        # read-only repository over a file: URL (reference:
+        # repositories/uri/URLRepository.java — file scheme)
+        url = str(settings.get("url", ""))
+        if not url.startswith("file:"):
+            raise IllegalArgumentException(
+                f"url repository supports file: URLs only, got [{url}]")
+        from urllib.parse import urlparse as _up
+        from urllib.request import url2pathname
+
+        r = FsRepository(repo, url2pathname(_up(url).path), compress=True)
+        r.readonly = True
+    else:
+        raise IllegalArgumentException(
+            f"repository type [{rtype}] not supported (fs, url)")
+    r.rtype = rtype
+    r.repo_settings = dict(settings)
+    n.repositories[repo] = r
     return 200, {"acknowledged": True}
 
 
@@ -552,14 +586,31 @@ def _repo_or_404(n: Node, repo: str):
     return r
 
 
+def _repo_json(r):
+    return {"type": getattr(r, "rtype", None) or "fs",
+            "settings": getattr(r, "repo_settings", None)
+            or {"location": r.location}}
+
+
 def _get_repos(n: Node, p, b):
-    return 200, {name: {"type": "fs", "settings": {"location": r.location}}
-                 for name, r in n.repositories.items()}
+    return 200, {name: _repo_json(r) for name, r in n.repositories.items()}
 
 
 def _get_repo(n: Node, p, b, repo: str):
+    import fnmatch
+
+    if any(c in repo for c in "*,") or repo == "_all":
+        pats = [x.strip() for x in repo.split(",")]
+        out = {name: _repo_json(r) for name, r in n.repositories.items()
+               if any(fnmatch.fnmatch(name, pt) or pt == "_all"
+                      for pt in pats)}
+        if not out and not any("*" in pt or pt == "_all" for pt in pats):
+            from elasticsearch_tpu.index.snapshots import                 SnapshotMissingException
+
+            raise SnapshotMissingException(f"[{repo}] missing")
+        return 200, out
     r = _repo_or_404(n, repo)
-    return 200, {repo: {"type": "fs", "settings": {"location": r.location}}}
+    return 200, {repo: _repo_json(r)}
 
 
 def _delete_repo(n: Node, p, b, repo: str):
@@ -620,8 +671,43 @@ def _cluster_stats(n: Node, p, b):
     }
 
 
+def _sum_stats(dicts):
+    out: Dict[str, Any] = {}
+    for d in dicts:
+        for k, v in d.items():
+            if isinstance(v, dict):
+                out[k] = _sum_stats([out.get(k, {}), v])
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = out.get(k, 0) + v
+            else:
+                out.setdefault(k, v)
+    return out
+
+
+def _stats_envelope(n: Node, names) -> dict:
+    """IndicesStatsResponse shape: _shards + _all.primaries/total +
+    per-index entries (total == primaries here: replica stats mirror the
+    primary in our replication model)."""
+    per = {nm: n.indices[nm].stats() for nm in names}
+    agg = _sum_stats(per.values())
+    return {
+        "_shards": _shards_header(n, names),
+        "_all": {"primaries": agg, "total": agg},
+        "indices": {nm: {"primaries": st, "total": st, **st}
+                    for nm, st in per.items()},
+    }
+
+
 def _all_stats(n: Node) -> dict:
-    return {"indices": {name: svc.stats() for name, svc in n.indices.items()}}
+    return _stats_envelope(n, list(n.indices))
+
+
+def _index_stats(n: Node, p, b, index: str):
+    """GET /{index}/_stats with multi-index/wildcard expressions."""
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    return 200, _stats_envelope(n, names)
 
 
 def _cat_scope(n: Node, index: Optional[str]):
@@ -745,14 +831,24 @@ def _index_exists(n: Node, p, b, index: str):
 
 
 def _get_settings(n: Node, p, b, index: str):
+    """All setting values render as STRINGS (the reference's Settings is a
+    string map); ?flat_settings=true flattens to 'index.x.y' keys."""
+    flat = str(p.get("flat_settings", "false")).lower() in ("", "true")
     out = {}
     for name in n.resolve_indices(index):
         svc = n.indices[name]
-        out[name] = {"settings": {"index": {
+        idx = {
             "number_of_shards": str(svc.num_shards),
             "number_of_replicas": str(svc.num_replicas),
-            **{k: v for k, v in svc.settings.items() if k != "index"},
-        }}}
+            **{k: str(v) for k, v in svc.settings.get("index", {}).items()
+               if k not in ("number_of_shards", "number_of_replicas")},
+            **{k: str(v) for k, v in svc.settings.items() if k != "index"},
+        }
+        if flat:
+            out[name] = {"settings": {f"index.{k}": v
+                                      for k, v in idx.items()}}
+        else:
+            out[name] = {"settings": {"index": idx}}
     if not out:
         raise IndexNotFoundException(index)
     return 200, out
@@ -761,7 +857,13 @@ def _get_settings(n: Node, p, b, index: str):
 def _put_settings(n: Node, p, b, index: str):
     from elasticsearch_tpu.cluster.metadata import update_index_settings
 
-    return 200, update_index_settings(n.get_index(index), _json(b), node=n)
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    body = _json(b)
+    for nm in names:  # multi-index expressions, like the reference
+        update_index_settings(n.indices[nm], body, node=n)
+    return 200, {"acknowledged": True}
 
 
 def _close_index(n: Node, p, b, index: str):
@@ -819,17 +921,25 @@ def _refresh_all(n: Node, p, b):
     return 200, {"_shards": {"total": len(n.indices), "successful": len(n.indices), "failed": 0}}
 
 
+def _shards_header(n: Node, names) -> dict:
+    total = sum(n.indices[nm].num_shards
+                * (1 + n.indices[nm].num_replicas) for nm in names)
+    return {"total": total, "successful": total, "failed": 0}
+
+
 def _flush(n: Node, p, b, index: str):
-    for name in n.resolve_indices(index):
+    names = n.resolve_indices(index)
+    for name in names:
         n.indices[name].flush()
-    return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+    return 200, {"_shards": _shards_header(n, names)}
 
 
 def _optimize(n: Node, p, b, index: str):
     max_seg = int(p.get("max_num_segments", 1))
-    for name in n.resolve_indices(index):
+    names = n.resolve_indices(index)
+    for name in names:
         n.indices[name].force_merge(max_seg)
-    return 200, {"_shards": {"total": 1, "successful": 1, "failed": 0}}
+    return 200, {"_shards": _shards_header(n, names)}
 
 
 def _count(n: Node, p, b, index: str):
@@ -966,14 +1076,20 @@ def _get_source(n: Node, p, b, index: str, id: str):
 
 def _delete_doc(n: Node, p, b, index: str, id: str):
     svc = n.get_index(index)
-    r = svc.delete_doc(id, routing=p.get("routing") or p.get("parent"))
+    kw = {}
+    if "version" in p:  # optimistic concurrency, like the index route
+        kw["version"] = int(p["version"])
+        kw["version_type"] = p.get("version_type", "internal")
+    r = svc.delete_doc(id, routing=p.get("routing") or p.get("parent"), **kw)
     if p.get("refresh") in ("true", ""):
         svc.refresh()
     return 200, r
 
 
 def _update_doc(n: Node, p, b, index: str, id: str):
-    svc = n.get_index(index)
+    # update auto-creates the index (reference: TransportUpdateAction
+    # routes through auto-create like index does)
+    svc = n.get_or_autocreate(index)
     r = svc.update_doc(id, _json(b), routing=p.get("routing"))
     if p.get("refresh") in ("true", ""):
         svc.refresh()
@@ -1275,27 +1391,45 @@ def _delete_search_template(n: Node, p, b, id: str):
 
 
 def _put_warmer(n: Node, p, b, index: str, name: str):
-    svc = n.get_index(index)
-    svc.warmers[name] = _json(b)
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    body = _json(b)
+    for nm in names:  # multi-index expressions, like the reference
+        n.indices[nm].warmers[name] = body
     return 200, {"acknowledged": True}
 
 
 def _get_warmers(n: Node, p, b, index: str):
-    svc = n.get_index(index)
-    return 200, {index: {"warmers": {
-        k: {"source": v} for k, v in svc.warmers.items()}}}
+    out = {}
+    for nm in n.resolve_indices(index):
+        svc = n.indices[nm]
+        if svc.warmers:
+            out[nm] = {"warmers": {
+                k: {"source": v} for k, v in svc.warmers.items()}}
+    return 200, out
 
 
 def _get_warmer(n: Node, p, b, index: str, name: str):
-    svc = n.get_index(index)
-    if name not in svc.warmers:
-        return 404, {}
-    return 200, {index: {"warmers": {name: {"source": svc.warmers[name]}}}}
+    out = {}
+    for nm in n.resolve_indices(index):
+        svc = n.indices[nm]
+        ws = {k: {"source": v} for k, v in svc.warmers.items()
+              if _warmer_name_match(k, name)}
+        if ws:
+            out[nm] = {"warmers": ws}
+    if not out:
+        return (200, {}) if any(c in str(name) for c in "*,")             or name == "_all" else (404, {})
+    return 200, out
 
 
 def _delete_warmer(n: Node, p, b, index: str, name: str):
-    svc = n.get_index(index)
-    found = svc.warmers.pop(name, None) is not None
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    found = False
+    for nm in names:
+        found = (n.indices[nm].warmers.pop(name, None) is not None) or found
     return (200 if found else 404), {"acknowledged": found}
 
 
@@ -1473,6 +1607,22 @@ def _cluster_put_settings(n: Node, p, b):
                  "transient": n.cluster_settings["transient"]}
 
 
+def _cluster_state_metric(n: Node, p, b, metric: str):
+    """RestClusterStateAction metric scoping: only the requested sections
+    appear (blocks is always available and empty — no block levels here)."""
+    full = dict(n.cluster_state.to_json())
+    full.setdefault("blocks", {})
+    keep = {m.strip() for m in metric.split(",")}
+    if "_all" in keep or "*" in keep:
+        return 200, full
+    out = {"cluster_name": full["cluster_name"]}
+    for key in ("version", "state_uuid", "master_node", "nodes", "metadata",
+                "routing_table", "routing_nodes", "blocks"):
+        if key in keep and key in full:
+            out[key] = full[key]
+    return 200, out
+
+
 def _cluster_reroute(n: Node, p, b):
     """RestClusterRerouteAction. Commands are validated against the routing
     table; with a single node and static shard→device placement every legal
@@ -1550,14 +1700,18 @@ def _delete_alias(n: Node, p, b, index: str, name: str):
 
 
 def _alias_exists(n: Node, p, b, alias: str, index: Optional[str] = None):
-    """RestAliasesExistAction (HEAD /_alias/{name})."""
+    """RestAliasesExistAction (HEAD /_alias/{name}); name may be a
+    comma list / wildcard / _all."""
     import fnmatch
 
+    pats = [x.strip() for x in str(alias).split(",")]
     names = n.resolve_indices(index) if index else list(n.indices)
     for iname in names:
         svc = n.indices[iname]
-        if any(fnmatch.fnmatch(a, alias) for a in svc.aliases):
-            return 200, None
+        for a in svc.aliases:
+            if any(pt in ("_all", "*") or fnmatch.fnmatch(a, pt)
+                   for pt in pats):
+                return 200, None
     return 404, None
 
 
@@ -1591,6 +1745,8 @@ def _type_exists(n: Node, p, b, index: str, type: str):
     for iname in n.resolve_indices(index):
         svc = n.indices[iname]
         if type in ("_doc", "_default_"):
+            return 200, None
+        if type in svc.mappings.type_names:  # typed-mapping blocks
             return 200, None
         for shard in svc.shards:
             if any(loc.doc_type == type and not loc.deleted
@@ -1897,31 +2053,83 @@ def _delete_script(n: Node, p, b, lang: str, id: str):
 
 def _get_mapping_root(n: Node, p, b, type: Optional[str] = None):
     """GET /_mapping[/{type}] (indices.get_mapping root forms)."""
+    if type:
+        return _get_mapping_typed(n, p, b, None, type)
     return 200, n.get_mapping(None)
+
+
+def _type_name_matches(svc, pat: str):
+    """Type names of `svc` matching a pattern/comma/_all expression. The
+    single-type model records typed-mapping block names in
+    mappings.type_names; '_doc' stands in when none were declared."""
+    import fnmatch
+
+    known = list(svc.mappings.type_names) or ["_doc"]
+    out = []
+    for part in str(pat).split(","):
+        part = part.strip()
+        if part in ("_all", "*", ""):
+            out.extend(known)
+        else:
+            out.extend(t for t in known if fnmatch.fnmatch(t, part))
+    return sorted(dict.fromkeys(out))
+
+
+def _get_mapping_typed(n: Node, p, b, index: Optional[str], type: str):
+    """GET [/{index}]/_mapping/{type}: mappings keyed by the matched type
+    names (404 when nothing matches, like RestGetMappingAction)."""
+    out = {}
+    for iname in n.resolve_indices(index):
+        svc = n.indices[iname]
+        names = _type_name_matches(svc, type)
+        if names:
+            mj = svc.mappings.to_json()
+            out[iname] = {"mappings": {t: mj for t in names}}
+    if not out:
+        return 404, {"error": f"type[[{type}]] missing", "status": 404}
+    return 200, out
+
+
+def _typed_mapping_body(type: Optional[str], body: dict) -> dict:
+    """A path {type} wraps an untyped body so Mappings.merge records the
+    type name (response echo / exists_type)."""
+    if type and type not in body:
+        return {type: body}
+    return body
 
 
 def _put_mapping_root(n: Node, p, b, type: Optional[str] = None):
     """PUT/POST /_mapping/{type}: apply to every index (all-or-nothing per
     index set, same as MetaDataMappingService over a wildcard)."""
-    return 200, n.put_mapping(None, _json(b))
+    return 200, n.put_mapping(None, _typed_mapping_body(type, _json(b)))
 
 
-def _get_settings_root(n: Node, p, b, name: Optional[str] = None):
-    """GET /_settings[/{name}] — {name} filters setting keys (wildcard).
-    An empty cluster answers 200 {} (only a concrete missing index 404s)."""
+def _get_settings_name(n: Node, p, b, index: Optional[str], name: str):
+    """GET /{index}/_settings/{name}: filter setting keys by pattern."""
     import fnmatch
 
-    if not n.indices:
-        return 200, {}
-    status, out = _get_settings(n, p, b, None)
-    if name:
-        for entry in out.values():
+    st, out = _get_settings(n, p, b, index)
+    for entry in out.values():
+        if "index" in entry["settings"]:
             idx = entry["settings"]["index"]
             entry["settings"]["index"] = {
                 k: v for k, v in idx.items()
                 if fnmatch.fnmatch(f"index.{k}", name)
                 or fnmatch.fnmatch(k, name)}
-    return status, out
+        else:  # flat_settings form
+            entry["settings"] = {k: v for k, v in entry["settings"].items()
+                                 if fnmatch.fnmatch(k, name)}
+    return st, out
+
+
+def _get_settings_root(n: Node, p, b, name: Optional[str] = None):
+    """GET /_settings[/{name}] — {name} filters setting keys (wildcard).
+    An empty cluster answers 200 {} (only a concrete missing index 404s)."""
+    if not n.indices:
+        return 200, {}
+    if name:
+        return _get_settings_name(n, p, b, None, name)
+    return _get_settings(n, p, b, None)
 
 
 def _put_settings_root(n: Node, p, b):
@@ -1933,27 +2141,34 @@ def _put_settings_root(n: Node, p, b):
     return 200, {"acknowledged": True}
 
 
-_INDEX_FEATURES = ("_settings", "_mappings", "_aliases", "_warmers")
+_INDEX_FEATURES = {"_settings": "_settings", "_mappings": "_mappings",
+                   "_mapping": "_mappings", "_aliases": "_aliases",
+                   "_alias": "_aliases", "_warmers": "_warmers",
+                   "_warmer": "_warmers"}
 
 
 def _get_index_feature(n: Node, p, b, index: str, feature: str):
     """GET /{index}/{feature} (indices.get): feature is a comma list of
     _settings/_mappings/_aliases/_warmers. Registered after every literal
     /{index}/_x route, so only unclaimed segments land here."""
-    feats = [f.strip() for f in feature.split(",")]
-    bad = [f for f in feats if f not in _INDEX_FEATURES]
-    if bad:
-        raise IllegalArgumentException(f"unknown index feature [{bad[0]}]")
+    feats = set()
+    for f in feature.split(","):
+        f = f.strip()
+        if f not in _INDEX_FEATURES:
+            raise IllegalArgumentException(f"unknown index feature [{f}]")
+        feats.add(_INDEX_FEATURES[f])
     out = {}
+    _st, settings_out = (_get_settings(n, p, b, index)
+                         if "_settings" in feats else (200, {}))
     for iname in n.resolve_indices(index):
         svc = n.indices[iname]
         entry: Dict[str, Any] = {}
         if "_settings" in feats:
-            entry["settings"] = {"index": {
-                "number_of_shards": str(svc.num_shards),
-                "number_of_replicas": str(svc.num_replicas)}}
+            entry.update(settings_out.get(iname, {}))
         if "_mappings" in feats:
-            entry["mappings"] = svc.mappings.to_json()
+            mj = svc.mappings.to_json()
+            entry["mappings"] = ({t: mj for t in svc.mappings.type_names}
+                                 if svc.mappings.type_names else mj)
         if "_aliases" in feats:
             entry["aliases"] = svc.aliases
         if "_warmers" in feats:
@@ -1965,15 +2180,21 @@ def _get_index_feature(n: Node, p, b, index: str, feature: str):
     return 200, out
 
 
-def _get_warmers_root(n: Node, p, b, name: Optional[str] = None):
-    """GET /_warmer[/{name}] across all indices ({name} may be a pattern)."""
+def _warmer_name_match(k: str, name: Optional[str]) -> bool:
     import fnmatch
 
+    if name in (None, "", "_all", "*"):
+        return True
+    return any(fnmatch.fnmatch(k, pat.strip()) for pat in str(name).split(","))
+
+
+def _get_warmers_root(n: Node, p, b, name: Optional[str] = None):
+    """GET /_warmer[/{name}] across all indices ({name}: pattern/comma/_all)."""
     out = {}
     for iname in n.resolve_indices(None):
         svc = n.indices[iname]
         ws = {k: {"source": v} for k, v in svc.warmers.items()
-              if name is None or fnmatch.fnmatch(k, name)}
+              if _warmer_name_match(k, name)}
         if ws:
             out[iname] = {"warmers": ws}
     return 200, out
@@ -2055,6 +2276,23 @@ def _cat_help(n: Node, p, b):
     ])
 
 
+def _cat_table(rows: List[dict], params: dict) -> str:
+    """Aligned text rendering of _cat rows (RestTable): `h` selects and
+    orders columns, `v` prints the header line."""
+    if not rows:
+        return ""
+    cols = list(rows[0].keys())
+    if params.get("h"):
+        cols = [c.strip() for c in str(params["h"]).split(",") if c.strip()]
+    table = [[str(r.get(c, "")) for c in cols] for r in rows]
+    if str(params.get("v", "false")).lower() in ("", "true"):
+        table.insert(0, cols)
+    widths = [max(len(row[i]) for row in table) for i in range(len(cols))]
+    return "\n".join(
+        " ".join(v.ljust(w) for v, w in zip(row, widths)).rstrip()
+        for row in table) + "\n"
+
+
 class RestServer:
     def __init__(self, node: Node, host: str = "127.0.0.1", port: int = 9200):
         self.controller = RestController(node)
@@ -2065,13 +2303,29 @@ class RestServer:
 
             def _handle(self, method: str):
                 parsed = urlparse(self.path)
-                params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+                params = {k: v[0] for k, v in
+                          parse_qs(parsed.query,
+                                   keep_blank_values=True).items()}
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 status, payload = controller.dispatch(method, parsed.path, params, body)
-                data = b"" if payload is None else json.dumps(payload, default=_json_default).encode()
+                ctype = "application/json; charset=UTF-8"
+                if isinstance(payload, str):
+                    # text endpoints (hot_threads, _cat help): raw body
+                    data = payload.encode()
+                    ctype = "text/plain; charset=UTF-8"
+                elif (parsed.path.startswith("/_cat")
+                      and isinstance(payload, list)
+                      and params.get("format") != "json"):
+                    # _cat default form is a text table (format=json opts
+                    # into the row-object form)
+                    data = _cat_table(payload, params).encode()
+                    ctype = "text/plain; charset=UTF-8"
+                else:
+                    data = b"" if payload is None else json.dumps(
+                        payload, default=_json_default).encode()
                 self.send_response(status)
-                self.send_header("Content-Type", "application/json; charset=UTF-8")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 if method != "HEAD" and data:

@@ -317,10 +317,13 @@ class ShardSearcher:
         stored_fields = body.get("stored_fields", body.get("fields"))
         hits = []
         for d in docs:
+            tcol = d.seg.keywords.get("_type")
+            tvals = tcol.host_values[d.local_id] if tcol is not None else None
             hit: Dict[str, Any] = {
                 # the owning index, not the (possibly comma-joined) request
                 # expression — multi-index searches report per-hit provenance
                 "_index": self.index_name or index_name,
+                "_type": tvals[0] if tvals else "_doc",
                 "_id": d.seg.ids[d.local_id],
                 "_score": None if d.sort_values else d.score,
             }

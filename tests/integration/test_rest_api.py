@@ -30,10 +30,16 @@ def req(server, method, path, body=None, ndjson=None):
     try:
         with urllib.request.urlopen(r) as resp:
             payload = resp.read()
-            return resp.status, json.loads(payload) if payload else None
+            try:
+                return resp.status, json.loads(payload) if payload else None
+            except json.JSONDecodeError:  # text endpoints (_cat, hot_threads)
+                return resp.status, payload.decode()
     except urllib.error.HTTPError as e:
         payload = e.read()
-        return e.code, json.loads(payload) if payload else None
+        try:
+            return e.code, json.loads(payload) if payload else None
+        except json.JSONDecodeError:
+            return e.code, payload.decode()
 
 
 def test_root_info(server):
@@ -154,7 +160,7 @@ def test_analyze_endpoint(server):
 def test_cat_and_cluster(server):
     status, body = req(server, "GET", "/_cluster/health")
     assert status == 200 and body["status"] in ("green", "yellow")
-    status, body = req(server, "GET", "/_cat/indices")
+    status, body = req(server, "GET", "/_cat/indices?format=json")
     assert any(row["index"] == "books" for row in body)
     status, body = req(server, "GET", "/_cluster/state")
     assert "books" in body["metadata"]["indices"]

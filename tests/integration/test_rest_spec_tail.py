@@ -41,10 +41,16 @@ def _req(server, method, path, body=None, ndjson=None):
     try:
         with urllib.request.urlopen(r) as resp:
             payload = resp.read()
-            return resp.status, json.loads(payload) if payload else None
+            try:
+                return resp.status, json.loads(payload) if payload else None
+            except json.JSONDecodeError:  # text endpoints (_cat, hot_threads)
+                return resp.status, payload.decode()
     except urllib.error.HTTPError as e:
         payload = e.read()
-        return e.code, json.loads(payload) if payload else None
+        try:
+            return e.code, json.loads(payload) if payload else None
+        except json.JSONDecodeError:
+            return e.code, payload.decode()
 
 
 def test_cluster_settings_roundtrip(server):
@@ -315,11 +321,11 @@ def test_index_feature_form(server):
 
 
 def test_scoped_cat_and_cluster_forms(server):
-    st, body = _req(server, "GET", "/_cat/indices/lib")
+    st, body = _req(server, "GET", "/_cat/indices/lib?format=json")
     assert st == 200 and len(body) == 1 and body[0]["index"] == "lib"
-    st, body = _req(server, "GET", "/_cat/indices/nomatch*")
+    st, body = _req(server, "GET", "/_cat/indices/nomatch*?format=json")
     assert st == 200 and body == []
-    st, body = _req(server, "GET", "/_cat/shards/lib")
+    st, body = _req(server, "GET", "/_cat/shards/lib?format=json")
     assert st == 200 and all(r["index"] == "lib" for r in body)
     st, body = _req(server, "GET", "/_cluster/health/lib")
     assert st == 200 and "status" in body
