@@ -25,6 +25,10 @@ class AnalysisRegistry:
         self._custom = analysis.get("analyzer", {})
 
     def get(self, name: str) -> Analyzer:
+        if name == "default" and "default" not in self._custom:
+            # `analyzer: default` names the index default analyzer
+            # (reference: AnalysisService resolves "default" specially)
+            name = "standard"
         if name in self._cache:
             return self._cache[name]
         if name in self._custom:

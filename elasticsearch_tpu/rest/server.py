@@ -912,13 +912,13 @@ def _refresh(n: Node, p, b, index: str):
         raise IndexNotFoundException(index)
     for name in names:
         n.indices[name].refresh()
-    return 200, {"_shards": {"total": len(names), "successful": len(names), "failed": 0}}
+    return 200, {"_shards": _shards_header(n, names)}
 
 
 def _refresh_all(n: Node, p, b):
     for svc in n.indices.values():
         svc.refresh()
-    return 200, {"_shards": {"total": len(n.indices), "successful": len(n.indices), "failed": 0}}
+    return 200, {"_shards": _shards_header(n, list(n.indices))}
 
 
 def _shards_header(n: Node, names) -> dict:
@@ -1058,8 +1058,56 @@ def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
 
 
 def _get_doc(n: Node, p, b, index: str, id: str):
-    r = n.get_index(index).get_doc(id, routing=p.get("routing") or p.get("parent"))
-    return (200 if r.get("found") else 404), r
+    from elasticsearch_tpu.search.service import _filter_source
+
+    svc = n.get_index(index)
+    r = svc.get_doc(id, routing=p.get("routing") or p.get("parent"))
+    if not r.get("found"):
+        return 404, r
+    sf = p.get("_source")
+    if sf is not None:
+        if sf.lower() in ("true", "false"):
+            sf = sf.lower() == "true"
+        elif "," in sf:
+            sf = sf.split(",")
+        filtered = _filter_source(r.get("_source"), sf)
+        r.pop("_source", None)
+        if filtered is not None:
+            r["_source"] = filtered
+    elif "_source_include" in p or "_source_exclude" in p:
+        filtered = _filter_source(r.get("_source"), {
+            "include": (p.get("_source_include") or "").split(","),
+            "exclude": [x for x in
+                        (p.get("_source_exclude") or "").split(",") if x]})
+        r.pop("_source", None)
+        if filtered is not None:
+            r["_source"] = filtered
+    fields = p.get("fields")
+    if fields:
+        names = [f.strip() for f in fields.split(",") if f.strip()]
+        loc = svc.route(id, p.get("routing")).engine._locations.get(str(id))
+        src = r.get("_source") or {}
+        out: Dict[str, Any] = {}
+        for f in names:
+            if f == "_source":
+                continue
+            if f == "_routing":
+                if loc is not None and loc.routing is not None:
+                    out["_routing"] = loc.routing
+                continue
+            if f == "_parent":
+                if loc is not None and loc.parent is not None:
+                    out["_parent"] = loc.parent
+                continue
+            cur: Any = src
+            for part in f.split("."):
+                cur = cur.get(part) if isinstance(cur, dict) else None
+            if cur is not None:
+                out[f] = cur if isinstance(cur, list) else [cur]
+        r["fields"] = out
+        if "_source" not in names:
+            r.pop("_source", None)
+    return 200, r
 
 
 def _doc_exists(n: Node, p, b, index: str, id: str):
@@ -1194,21 +1242,46 @@ def _update_by_query(n: Node, p, b, index: str):
                  "noops": noops, "failures": failures, "timed_out": False}
 
 
-def _mget(n: Node, p, b):
+def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
+    from elasticsearch_tpu.search.service import _filter_source
+    from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+    iname = spec.get("_index", default_index)
+    try:
+        svc = n.get_index(iname)
+    except ElasticsearchTpuException as e:
+        return {"_index": iname, "_id": spec.get("_id"),
+                "error": {"type": e.error_type, "reason": str(e)}}
+    got = svc.get_doc(str(spec.get("_id")),
+                      routing=spec.get("routing") or spec.get("_routing"))
+    sf = spec.get("_source", p.get("_source"))
+    if sf is None and ("_source_include" in p or "_source_exclude" in p):
+        sf = {"include": p.get("_source_include"),
+              "exclude": p.get("_source_exclude")}
+    if isinstance(sf, str) and sf.lower() in ("true", "false"):
+        sf = sf.lower() == "true"
+    if isinstance(sf, str) and "," in sf:
+        sf = sf.split(",")
+    if got.get("found") and sf is not None:
+        filtered = _filter_source(got.get("_source"), sf)
+        got.pop("_source", None)
+        if filtered is not None:
+            got["_source"] = filtered
+    return got
+
+
+def _mget(n: Node, p, b, index: Optional[str] = None):
     body = _json(b)
-    docs = []
-    for spec in body.get("docs", []):
-        svc = n.get_index(spec["_index"])
-        docs.append(svc.get_doc(spec["_id"]))
+    if "ids" in body:
+        docs = [_mget_one(n, {"_id": i}, index, p) for i in body["ids"]]
+    else:
+        docs = [_mget_one(n, spec, index, p)
+                for spec in body.get("docs", [])]
     return 200, {"docs": docs}
 
 
 def _mget_index(n: Node, p, b, index: str):
-    body = _json(b)
-    svc = n.get_index(index)
-    if "ids" in body:
-        return 200, svc.mget([str(i) for i in body["ids"]])
-    return 200, {"docs": [svc.get_doc(d["_id"]) for d in body.get("docs", [])]}
+    return _mget(n, p, b, index)
 
 
 def _bulk(n: Node, p, b, index: Optional[str] = None):
@@ -1779,7 +1852,10 @@ def _get_field_mapping(n: Node, p, b, field: str, index: Optional[str] = None):
                 leaf = fname.rpartition(".")[2]
                 fields[fname] = {"full_name": fname,
                                  "mapping": {leaf: _field_to_json(fm)}}
-        out[iname] = {"mappings": {"_doc": fields}}
+        # response keys by declared type names (2.0 typed form) when the
+        # index has them, else the single-type default
+        tnames = svc.mappings.type_names or ["_doc"]
+        out[iname] = {"mappings": {t: fields for t in tnames}}
     return 200, out
 
 

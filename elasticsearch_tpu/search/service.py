@@ -759,14 +759,39 @@ def _filter_source(src: Optional[dict], spec) -> Optional[dict]:
         if isinstance(excludes, str):
             excludes = [excludes]
 
-    def keep(key: str) -> bool:
-        if includes and not any(fnmatch.fnmatch(key, pat) for pat in includes):
+    def _could_descend(path: str, pat: str) -> bool:
+        """True when `pat` could match somewhere strictly below `path`."""
+        psegs, segs = path.split("."), pat.split(".")
+        if len(psegs) >= len(segs):
             return False
-        if excludes and any(fnmatch.fnmatch(key, pat) for pat in excludes):
-            return False
-        return True
+        return all(fnmatch.fnmatch(ps, sg)
+                   for ps, sg in zip(psegs, segs))
 
-    return {k: v for k, v in src.items() if keep(k)}
+    def _walk(obj, prefix: str):
+        """Path-aware include/exclude (XContentMapValues.filter): a pattern
+        like 'obj.inner' keeps that nested leaf; an included ancestor keeps
+        its whole subtree (minus exclusions)."""
+        if not isinstance(obj, dict):
+            return obj
+        out = {}
+        for k, v in obj.items():
+            path = f"{prefix}{k}"
+            if excludes and any(fnmatch.fnmatch(path, pat)
+                                for pat in excludes):
+                continue
+            inc = (not includes
+                   or any(fnmatch.fnmatch(path, pat) for pat in includes))
+            if inc:
+                out[k] = (_walk(v, f"{path}.")
+                          if isinstance(v, dict) and excludes else v)
+            elif isinstance(v, dict) and any(_could_descend(path, pat)
+                                             for pat in includes):
+                sub = _walk(v, f"{path}.")
+                if sub:
+                    out[k] = sub
+        return out
+
+    return _walk(src, "")
 
 
 # ---------------------------------------------------------------------------

@@ -166,7 +166,13 @@ class Runner:
         catch = spec.pop("catch", None)
         (api, args), = spec.items()
         args = self._sub(args or {})
-        method, path, data = self._build(api, args)
+        try:
+            method, path, data = self._build(api, args)
+        except StepFailed:
+            if catch == "param":  # client-side validation error expected
+                self.status, self.response = 400, None
+                return
+            raise
         url = f"http://127.0.0.1:{self.port}{path}"
         req = urllib.request.Request(url, data=data, method=method,
                                      headers={"Content-Type":
@@ -183,6 +189,12 @@ class Runner:
             self.response = json.loads(text) if text else ""
         except json.JSONDecodeError:
             self.response = text
+        if method == "HEAD":
+            # the reference runner exposes HEAD results as boolean bodies,
+            # and a 404 is a valid false answer, not a failure
+            self.response = self.status < 300
+            if catch is None and self.status in (200, 404):
+                return
         if catch is None:
             if self.status >= 400:
                 raise StepFailed(
@@ -250,8 +262,10 @@ class Runner:
             (path, want), = spec.items()
             want = self._sub(want)
             got = self.get_path(path)
-            if isinstance(want, str) and len(want) > 1 \
-                    and want.startswith("/") and want.endswith("/"):
+            if isinstance(want, str) and len(want.strip()) > 1 \
+                    and want.strip().startswith("/") \
+                    and want.strip().endswith("/"):
+                want = want.strip()
                 if not re.search(want[1:-1], str(got), re.S | re.X):
                     raise StepFailed(f"match {path}: /regex/ miss on "
                                      f"{str(got)[:200]}")
