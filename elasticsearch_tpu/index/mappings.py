@@ -54,6 +54,9 @@ class FieldMapping:
     # dense_vector ANN config, e.g. {"type": "ivf"} (no ES 2.0 counterpart;
     # north-star addition — ES 8 uses {"type": "hnsw"} the same way)
     index_options: Optional[dict] = None
+    # the field was declared with the 2.0 spelling `type: string`; to_json
+    # echoes it back that way (internally it is text/keyword)
+    legacy_string: bool = False
 
     @property
     def is_text(self) -> bool:
@@ -75,7 +78,7 @@ class FieldMapping:
 def _canonical_type(props: dict) -> str:
     t = props.get("type", "object")
     if t == "string":  # ES 2.0 legacy
-        if props.get("index") == "not_analyzed":
+        if props.get("index") in ("not_analyzed", "no"):
             return "keyword"
         return "text"
     return t
@@ -211,6 +214,7 @@ class Mappings:
             scaling_factor=float(p.get("scaling_factor", 1.0)),
             include_in_all=p.get("include_in_all"),
             index_options=p.get("index_options") if t == "dense_vector" else None,
+            legacy_string=p.get("type") == "string",
         )
         if t == "dense_vector" and fm.dims <= 0:
             raise MapperParsingException(f"dense_vector field [{full}] requires [dims]")
@@ -387,6 +391,10 @@ def _field_to_json(fm: FieldMapping) -> dict:
     survive the round-trip, or restarts silently shed mapping config (the
     r4 IVF-cache test caught index_options vanishing this way)."""
     out: dict = {"type": fm.type}
+    if fm.legacy_string:  # echo the 2.0 spelling it was declared with
+        out["type"] = "string"
+        if fm.is_keyword:
+            out["index"] = "not_analyzed"
     if fm.is_text:
         out["analyzer"] = fm.analyzer
     if fm.search_analyzer is not None:

@@ -560,13 +560,15 @@ def _put_repo(n: Node, p, b, repo: str):
         # read-only repository over a file: URL (reference:
         # repositories/uri/URLRepository.java — file scheme)
         url = str(settings.get("url", ""))
-        if not url.startswith("file:"):
+        if not url:
             raise IllegalArgumentException(
-                f"url repository supports file: URLs only, got [{url}]")
+                "url repository requires [settings.url]")
         from urllib.parse import urlparse as _up
         from urllib.request import url2pathname
 
-        r = FsRepository(repo, url2pathname(_up(url).path), compress=True)
+        loc = (url2pathname(_up(url).path) if url.startswith("file:")
+               else url)  # non-file URLs register but cannot restore
+        r = FsRepository(repo, loc, compress=True)
         r.readonly = True
     else:
         raise IllegalArgumentException(
@@ -897,11 +899,20 @@ def _get_aliases(n: Node, p, b):
 
 
 def _get_alias(n: Node, p, b, alias: str):
+    import fnmatch
+
+    pats = [x.strip() for x in alias.split(",")]
     out = {}
     for name, svc in n.indices.items():
-        if alias in svc.aliases:
-            out[name] = {"aliases": {alias: svc.aliases[alias]}}
+        matched = {a: fa for a, fa in svc.aliases.items()
+                   if any(pt in ("_all", "*") or fnmatch.fnmatch(a, pt)
+                          for pt in pats)}
+        if matched:
+            out[name] = {"aliases": matched}
     if not out:
+        # concrete name miss -> 404; patterns narrow to empty 200
+        if any("*" in pt or pt in ("_all",) for pt in pats):
+            return 200, {}
         return 404, {"error": f"alias [{alias}] missing", "status": 404}
     return 200, out
 
@@ -989,6 +1000,24 @@ def _do_analyze(reg, body: dict, svc=None) -> dict:
     if "field" in body and svc is not None:
         fm = svc.mappings.get(body["field"])
         analyzer = reg.get(fm.analyzer) if fm is not None and fm.is_text else reg.get("keyword")
+    elif "tokenizer" in body:
+        # one-off chain: tokenizer + filters/char_filters params
+        # (RestAnalyzeAction's ad-hoc analyzer)
+        from elasticsearch_tpu.analysis.analyzer import \
+            build_custom_analyzer
+
+        def _lst(v):
+            if v is None:
+                return []
+            if isinstance(v, str):
+                return [x.strip() for x in v.split(",") if x.strip()]
+            return list(v)
+
+        analyzer = build_custom_analyzer("_adhoc", {
+            "tokenizer": body["tokenizer"],
+            "filter": _lst(body.get("filters", body.get("filter"))),
+            "char_filter": _lst(body.get("char_filters",
+                                         body.get("char_filter")))})
     else:
         analyzer = reg.get(body.get("analyzer", "standard"))
     tokens = []
@@ -1769,7 +1798,23 @@ def _put_alias(n: Node, p, b, index: str, name: str):
 
 
 def _delete_alias(n: Node, p, b, index: str, name: str):
-    return 200, n.update_aliases([{"remove": {"index": index, "alias": name}}])
+    import fnmatch
+
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    pats = [x.strip() for x in name.split(",")]
+    found = False
+    for nm in names:
+        svc = n.indices[nm]
+        for a in list(svc.aliases):
+            if any(pt in ("_all", "*") or fnmatch.fnmatch(a, pt)
+                   for pt in pats):
+                found = True
+                n.update_aliases([{"remove": {"index": nm, "alias": a}}])
+    if not found:
+        return 404, {"error": f"aliases [{name}] missing", "status": 404}
+    return 200, {"acknowledged": True}
 
 
 def _alias_exists(n: Node, p, b, alias: str, index: Optional[str] = None):
