@@ -117,7 +117,11 @@ class Engine:
             if op_type == "create" and exists:
                 raise VersionConflictException(self.mappings.meta.get("index", ""), doc_id, current, 0)
             if version is not None:
-                if version_type in ("external", "external_gt", "external_gte"):
+                if version_type == "force":
+                    # force: set the version unconditionally (reference:
+                    # VersionType.FORCE, 2.0-era repair tool semantics)
+                    new_version = version
+                elif version_type in ("external", "external_gt", "external_gte"):
                     ok = (loc is None or version > loc.version
                           or (version_type == "external_gte" and version >= loc.version))
                     if not ok:
@@ -166,10 +170,23 @@ class Engine:
             loc = self._locations.get(doc_id)
             if loc is None or loc.deleted:
                 raise DocumentMissingException("", doc_id)
-            if version is not None and version_type == "internal" and loc.version != version:
-                raise VersionConflictException("", doc_id, loc.version, version)
+            if version is not None:
+                if version_type == "internal" and loc.version != version:
+                    raise VersionConflictException("", doc_id, loc.version,
+                                                   version)
+                if version_type in ("external", "external_gt") \
+                        and version <= loc.version:
+                    raise VersionConflictException("", doc_id, loc.version,
+                                                   version)
+                if version_type == "external_gte" and version < loc.version:
+                    raise VersionConflictException("", doc_id, loc.version,
+                                                   version)
             self._remove_existing(doc_id)
-            new_version = loc.version + 1
+            if version is not None and version_type in (
+                    "external", "external_gt", "external_gte", "force"):
+                new_version = version  # external deletes stamp the version
+            else:
+                new_version = loc.version + 1
             self._locations[doc_id] = DocLocation(version=new_version, deleted=True, where=None)
             if not _replay:
                 self.translog.append({"op": "delete", "id": doc_id, "version": new_version})

@@ -240,7 +240,12 @@ class Node:
     def get_mapping(self, index: Optional[str] = None) -> dict:
         out = {}
         for n in self.resolve_indices(index):
-            out[n] = {"mappings": self.indices[n].mappings.to_json()}
+            m = self.indices[n].mappings
+            mj = m.to_json()
+            # typed-mapping echo: indices that declared 2.0 type blocks
+            # read back keyed by those names (single-type model underneath)
+            out[n] = {"mappings": ({t: mj for t in m.type_names}
+                                   if m.type_names else mj)}
         return out
 
     def update_aliases(self, actions: List[dict]) -> dict:

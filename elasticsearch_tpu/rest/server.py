@@ -506,6 +506,29 @@ def _register_all(rc: RestController):
     # sub-resource above wins the route (RestController does the same via
     # explicit registration order). {type} segments that start with an
     # underscore are rejected by the handlers, not silently bound.
+    add("GET", "/{index}/{type}/_search", _typed(_search_typed, keep_type=True))
+    add("POST", "/{index}/{type}/_search", _typed(_search_typed, keep_type=True))
+    add("GET", "/{index}/{type}/_count", _typed(_count_typed, keep_type=True))
+    add("POST", "/{index}/{type}/_count", _typed(_count_typed, keep_type=True))
+    add("POST", "/{index}/{type}/_msearch",
+        _typed(lambda n, p, b, index: _msearch(n, p, b, index)))
+    add("GET", "/{index}/{type}/_msearch",
+        _typed(lambda n, p, b, index: _msearch(n, p, b, index)))
+    add("POST", "/{index}/{type}/_mget",
+        _typed(lambda n, p, b, index: _mget(n, p, b, index)))
+    add("GET", "/{index}/{type}/_mget",
+        _typed(lambda n, p, b, index: _mget(n, p, b, index)))
+    add("POST", "/{index}/{type}/_bulk",
+        _typed(lambda n, p, b, index: _bulk_index(n, p, b, index)))
+    add("PUT", "/{index}/{type}/_bulk",
+        _typed(lambda n, p, b, index: _bulk_index(n, p, b, index)))
+    add("GET", "/{index}/{type}/_suggest",
+        _typed(lambda n, p, b, index: _suggest(n, p, b, index)))
+    add("POST", "/{index}/{type}/_suggest",
+        _typed(lambda n, p, b, index: _suggest(n, p, b, index)))
+    add("GET", "/{index}/{type}/_termvectors",
+        _typed(lambda n, p, b, index: _termvectors(
+            n, p, b, index, json.loads(b or b"{}").get("_id") or "")))
     add("GET", "/{index}/{type}/_search/template", _typed(_search_template))
     add("POST", "/{index}/{type}/_search/template", _typed(_search_template))
     add("GET", "/{index}/{type}/_search/exists", _typed(_search_exists))
@@ -881,14 +904,20 @@ def _open_index(n: Node, p, b, index: str):
 
 
 def _get_index_meta(n: Node, p, b, index: str):
+    _st, settings_out = _get_settings(n, p, b, index)
     out = {}
     for name in n.resolve_indices(index):
         svc = n.indices[name]
+        mj = svc.mappings.to_json()
         out[name] = {
             "aliases": svc.aliases,
-            "mappings": svc.mappings.to_json(),
-            "settings": {"index": {"number_of_shards": str(svc.num_shards)}},
+            "mappings": ({t: mj for t in svc.mappings.type_names}
+                         if svc.mappings.type_names else mj),
+            **settings_out.get(name, {}),
         }
+        if svc.warmers:
+            out[name]["warmers"] = {k: {"source": v}
+                                    for k, v in svc.warmers.items()}
     if not out:
         raise IndexNotFoundException(index)
     return 200, out
@@ -953,15 +982,13 @@ def _optimize(n: Node, p, b, index: str):
     return 200, {"_shards": _shards_header(n, names)}
 
 
-def _count(n: Node, p, b, index: str):
-    body = _json(b)
-    if "q" in p:
-        body = {"query": {"query_string": {"query": p["q"]}}}
+def _count_with_body(n: Node, index: Optional[str], body: dict):
     svc_names = n.resolve_indices(index)
     if not svc_names:
-        if index in (None, "", "_all", "*"):  # empty cluster: 0 hits, not 404
+        if index in (None, "", "_all", "*"):
             return 200, {"count": 0, "_shards": {"total": 0,
-                                                 "successful": 0, "failed": 0}}
+                                                 "successful": 0,
+                                                 "failed": 0}}
         raise IndexNotFoundException(index)
     total = 0
     nshards = 0
@@ -969,7 +996,15 @@ def _count(n: Node, p, b, index: str):
         total += n.indices[name].count(body)["count"]
         nshards += n.indices[name].num_shards
     return 200, {"count": total, "_shards": {"total": nshards,
-                                             "successful": nshards, "failed": 0}}
+                                             "successful": nshards,
+                                             "failed": 0}}
+
+
+def _count(n: Node, p, b, index: str):
+    body = _json(b)
+    if "q" in p:
+        body = {"query": {"query_string": {"query": p["q"]}}}
+    return _count_with_body(n, index, body)
 
 
 def _analyze_body(p, b) -> dict:
@@ -1074,15 +1109,38 @@ def _index_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     return _index_doc(n, p, b, index, id, doc_type=type)
 
 
+def _type_mismatch(n: Node, index: str, type: str, id: str) -> bool:
+    """Requested {type} filters doc reads (reference: GetRequest.type) —
+    _all/_doc match anything."""
+    if type in ("_all", "_doc"):
+        return False
+    from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+    try:
+        svc = n.get_index(index)
+        loc = svc.route(str(id)).engine._locations.get(str(id))
+    except ElasticsearchTpuException:
+        return False
+    return (loc is not None and not loc.deleted
+            and (loc.doc_type or "_doc") != type)
+
+
 def _get_doc_typed(n: Node, p, b, index: str, type: str, id: str):
-    if type.startswith("_"):
+    if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    if _type_mismatch(n, index, type, id):
+        return 404, {"_index": index, "_type": type, "_id": id,
+                     "found": False}
     return _get_doc(n, p, b, index, id)
 
 
 def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
-    if type.startswith("_"):
+    if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    if _type_mismatch(n, index, type, id):
+        from elasticsearch_tpu.utils.errors import DocumentMissingException
+
+        raise DocumentMissingException(index, id)
     return _delete_doc(n, p, b, index, id)
 
 
@@ -1276,6 +1334,7 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
     from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
 
     iname = spec.get("_index", default_index)
+    want_type = spec.get("_type")
     try:
         svc = n.get_index(iname)
     except ElasticsearchTpuException as e:
@@ -1283,6 +1342,12 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
                 "error": {"type": e.error_type, "reason": str(e)}}
     got = svc.get_doc(str(spec.get("_id")),
                       routing=spec.get("routing") or spec.get("_routing"))
+    if (got.get("found") and want_type not in (None, "_all", "_doc")
+            and got.get("_type") != want_type):
+        # requested type mismatch reads as not-found (MultiGetRequest)
+        got = {"_index": iname, "_id": spec.get("_id"), "found": False}
+    if want_type is not None and not got.get("found"):
+        got["_type"] = want_type
     sf = spec.get("_source", p.get("_source"))
     if sf is None and ("_source_include" in p or "_source_exclude" in p):
         sf = {"include": p.get("_source_include"),
@@ -1350,8 +1415,34 @@ def _search_body(p, b) -> dict:
     return body
 
 
+def _with_type_filter(body: dict, type: Optional[str]) -> dict:
+    """/{index}/{type}/_search scoping: AND a `_type` filter into the query
+    (reference: SearchRequest types -> TypeFilter)."""
+    if not type or type == "_all":
+        return body
+    body = dict(body or {})
+    q = body.get("query", {"match_all": {}})
+    types = [t.strip() for t in str(type).split(",") if t.strip()]
+    tf = ({"term": {"_type": types[0]}} if len(types) == 1
+          else {"terms": {"_type": types}})
+    body["query"] = {"bool": {"must": [q], "filter": [tf]}}
+    return body
+
+
 def _search(n: Node, p, b, index: str):
     return 200, n.search(index, _search_body(p, b), preference=p.get("preference"))
+
+
+def _search_typed(n: Node, p, b, index: str, type: str):
+    return 200, n.search(index, _with_type_filter(_search_body(p, b), type),
+                         preference=p.get("preference"))
+
+
+def _count_typed(n: Node, p, b, index: str, type: str):
+    body = _json(b)
+    if "q" in p:
+        body = {"query": {"query_string": {"query": p["q"]}}}
+    return _count_with_body(n, index, _with_type_filter(body, type))
 
 
 def _search_all(n: Node, p, b):
@@ -2351,14 +2442,16 @@ def _index_doc_auto_typed(n: Node, p, b, index: str, type: str):
     Registered LAST: any unclaimed /_x segment must not become a type.
     Delegates to _index_doc so version/op_type/parent/timestamp/ttl params
     behave identically to every other index route."""
-    if type.startswith("_"):
+    if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}]")
     return _index_doc(n, p, b, index, None, doc_type=type)
 
 
 def _doc_exists_typed(n: Node, p, b, index: str, type: str, id: str):
-    if type.startswith("_"):
+    if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    if _type_mismatch(n, index, type, id):
+        return 404, None
     return _doc_exists(n, p, b, index, id)
 
 
@@ -2376,7 +2469,7 @@ def _typed(handler, keep_type: bool = False):
     it (percolate, mlt, exists_type)."""
     def h(n, p, b, **kw):
         t = kw.get("type", "")
-        if t.startswith("_"):
+        if t.startswith("_") and t != "_all":
             raise IllegalArgumentException(f"unsupported path segment [{t}]")
         if not keep_type:
             kw.pop("type", None)
