@@ -195,7 +195,8 @@ class Engine:
 
     def update(self, doc_id: str, partial: Optional[dict] = None,
                script: Optional[str] = None, script_params: Optional[dict] = None,
-               upsert: Optional[dict] = None, doc_as_upsert: bool = False) -> Tuple[int, bool]:
+               upsert: Optional[dict] = None, doc_as_upsert: bool = False,
+               doc_type: Optional[str] = None) -> Tuple[int, bool]:
         """Partial update (RestUpdateAction semantics): merge `partial` into
         the current source, or create from `upsert` when missing."""
         with self._lock:
@@ -203,10 +204,10 @@ class Engine:
             got = self.get(doc_id)
             if got is None:
                 if upsert is not None:
-                    _, v, _ = self.index(doc_id, upsert)
+                    _, v, _ = self.index(doc_id, upsert, doc_type=doc_type)
                     return v, True
                 if doc_as_upsert and partial is not None:
-                    _, v, _ = self.index(doc_id, partial)
+                    _, v, _ = self.index(doc_id, partial, doc_type=doc_type)
                     return v, True
                 raise DocumentMissingException("", doc_id)
             source = dict(got["_source"])

@@ -187,7 +187,8 @@ class IndexService:
             "found": True,
         }
 
-    def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None) -> dict:
+    def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None,
+                   doc_type: Optional[str] = None) -> dict:
         from elasticsearch_tpu.cluster.metadata import check_open
 
         check_open(self)
@@ -226,14 +227,18 @@ class IndexService:
             script_params=params,
             upsert=body.get("upsert"),
             doc_as_upsert=bool(body.get("doc_as_upsert", False)),
+            doc_type=doc_type,
         )
         self.group_for(doc_id, routing).replicate_current(str(doc_id))
         if is_perc:
             got = shard.engine.get(str(doc_id))
             if got and got.get("_source"):
                 self.percolator.register(str(doc_id), got["_source"])
+        loc2 = shard.engine._locations.get(str(doc_id))
         return {
             "_index": self.name,
+            "_type": (loc2.doc_type if loc2 is not None and loc2.doc_type
+                      else "_doc"),
             "_id": doc_id,
             "_version": version,
             "result": "created" if created else "updated",

@@ -258,9 +258,12 @@ class Node:
                         meta = {k: v for k, v in spec.items()
                                 if k not in ("index", "indices", "alias")}
                         if "routing" in meta:  # fans into both routings
-                            r = meta.pop("routing")
+                            r = str(meta.pop("routing"))
                             meta.setdefault("index_routing", r)
                             meta.setdefault("search_routing", r)
+                        for rk in ("index_routing", "search_routing"):
+                            if rk in meta:  # Settings are string maps
+                                meta[rk] = str(meta[rk])
                         self.indices[n].aliases[alias] = meta
                     elif op == "remove":
                         self.indices[n].aliases.pop(alias, None)

@@ -545,7 +545,9 @@ def _register_all(rc: RestController):
     add("GET", "/{index}/{type}/{id}/_explain", _typed(_explain))
     add("POST", "/{index}/{type}/{id}/_explain", _typed(_explain))
     add("GET", "/{index}/{type}/{id}/_source", _typed(_get_source))
-    add("POST", "/{index}/{type}/{id}/_update", _typed(_update_doc))
+    add("POST", "/{index}/{type}/{id}/_update", _typed(
+        lambda n, p, b, index, id, type=None: _update_doc(
+            n, p, b, index, id, doc_type=type), keep_type=True))
     add("GET", "/{index}/{type}/{id}/_percolate/count",
         _typed(_percolate_count_existing, keep_type=True))
     add("POST", "/{index}/{type}/{id}/_percolate/count",
@@ -1221,11 +1223,13 @@ def _delete_doc(n: Node, p, b, index: str, id: str):
     return 200, r
 
 
-def _update_doc(n: Node, p, b, index: str, id: str):
+def _update_doc(n: Node, p, b, index: str, id: str,
+                doc_type: Optional[str] = None):
     # update auto-creates the index (reference: TransportUpdateAction
     # routes through auto-create like index does)
     svc = n.get_or_autocreate(index)
-    r = svc.update_doc(id, _json(b), routing=p.get("routing"))
+    r = svc.update_doc(id, _json(b), routing=p.get("routing"),
+                       doc_type=doc_type)
     if p.get("refresh") in ("true", ""):
         svc.refresh()
     return 200, r
