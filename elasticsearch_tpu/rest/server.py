@@ -510,25 +510,34 @@ def _register_all(rc: RestController):
     add("POST", "/{index}/{type}/_search", _typed(_search_typed, keep_type=True))
     add("GET", "/{index}/{type}/_count", _typed(_count_typed, keep_type=True))
     add("POST", "/{index}/{type}/_count", _typed(_count_typed, keep_type=True))
-    add("POST", "/{index}/{type}/_msearch",
-        _typed(lambda n, p, b, index: _msearch(n, p, b, index)))
-    add("GET", "/{index}/{type}/_msearch",
-        _typed(lambda n, p, b, index: _msearch(n, p, b, index)))
-    add("POST", "/{index}/{type}/_mget",
-        _typed(lambda n, p, b, index: _mget(n, p, b, index)))
-    add("GET", "/{index}/{type}/_mget",
-        _typed(lambda n, p, b, index: _mget(n, p, b, index)))
-    add("POST", "/{index}/{type}/_bulk",
-        _typed(lambda n, p, b, index: _bulk_index(n, p, b, index)))
-    add("PUT", "/{index}/{type}/_bulk",
-        _typed(lambda n, p, b, index: _bulk_index(n, p, b, index)))
+    add("POST", "/{index}/{type}/_msearch", _typed(
+        lambda n, p, b, index, type=None: _msearch(n, p, b, index,
+                                                   doc_type=type),
+        keep_type=True))
+    add("GET", "/{index}/{type}/_msearch", _typed(
+        lambda n, p, b, index, type=None: _msearch(n, p, b, index,
+                                                   doc_type=type),
+        keep_type=True))
+    add("POST", "/{index}/{type}/_mget", _typed(
+        lambda n, p, b, index, type=None: _mget_typed(n, p, b, index, type),
+        keep_type=True))
+    add("GET", "/{index}/{type}/_mget", _typed(
+        lambda n, p, b, index, type=None: _mget_typed(n, p, b, index, type),
+        keep_type=True))
+    add("POST", "/{index}/{type}/_bulk", _typed(
+        lambda n, p, b, index, type=None: _bulk(n, p, b, index,
+                                                doc_type=type),
+        keep_type=True))
+    add("PUT", "/{index}/{type}/_bulk", _typed(
+        lambda n, p, b, index, type=None: _bulk(n, p, b, index,
+                                                doc_type=type),
+        keep_type=True))
     add("GET", "/{index}/{type}/_suggest",
         _typed(lambda n, p, b, index: _suggest(n, p, b, index)))
     add("POST", "/{index}/{type}/_suggest",
         _typed(lambda n, p, b, index: _suggest(n, p, b, index)))
-    add("GET", "/{index}/{type}/_termvectors",
-        _typed(lambda n, p, b, index: _termvectors(
-            n, p, b, index, json.loads(b or b"{}").get("_id") or "")))
+    add("GET", "/{index}/{type}/_termvectors", _typed(_termvectors_noid))
+    add("POST", "/{index}/{type}/_termvectors", _typed(_termvectors_noid))
     add("GET", "/{index}/{type}/_search/template", _typed(_search_template))
     add("POST", "/{index}/{type}/_search/template", _typed(_search_template))
     add("GET", "/{index}/{type}/_search/exists", _typed(_search_exists))
@@ -1111,7 +1120,8 @@ def _index_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     return _index_doc(n, p, b, index, id, doc_type=type)
 
 
-def _type_mismatch(n: Node, index: str, type: str, id: str) -> bool:
+def _type_mismatch(n: Node, index: str, type: str, id: str,
+                   routing: Optional[str] = None) -> bool:
     """Requested {type} filters doc reads (reference: GetRequest.type) —
     _all/_doc match anything."""
     if type in ("_all", "_doc"):
@@ -1120,7 +1130,7 @@ def _type_mismatch(n: Node, index: str, type: str, id: str) -> bool:
 
     try:
         svc = n.get_index(index)
-        loc = svc.route(str(id)).engine._locations.get(str(id))
+        loc = svc.route(str(id), routing).engine._locations.get(str(id))
     except ElasticsearchTpuException:
         return False
     return (loc is not None and not loc.deleted
@@ -1130,7 +1140,8 @@ def _type_mismatch(n: Node, index: str, type: str, id: str) -> bool:
 def _get_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
-    if _type_mismatch(n, index, type, id):
+    if _type_mismatch(n, index, type, id,
+                      p.get("routing") or p.get("parent")):
         return 404, {"_index": index, "_type": type, "_id": id,
                      "found": False}
     return _get_doc(n, p, b, index, id)
@@ -1139,7 +1150,8 @@ def _get_doc_typed(n: Node, p, b, index: str, type: str, id: str):
 def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
-    if _type_mismatch(n, index, type, id):
+    if _type_mismatch(n, index, type, id,
+                      p.get("routing") or p.get("parent")):
         from elasticsearch_tpu.utils.errors import DocumentMissingException
 
         raise DocumentMissingException(index, id)
@@ -1382,19 +1394,44 @@ def _mget_index(n: Node, p, b, index: str):
     return _mget(n, p, b, index)
 
 
-def _bulk(n: Node, p, b, index: Optional[str] = None):
+def _bulk(n: Node, p, b, index: Optional[str] = None,
+          doc_type: Optional[str] = None):
     ops = _ndjson(b)
-    if index is not None:
+    if index is not None or doc_type is not None:
         for line in ops:
             if len(line) == 1:
                 (op, meta), = line.items()
                 if op in ("index", "create", "update", "delete") and isinstance(meta, dict):
-                    meta.setdefault("_index", index)
+                    if index is not None:
+                        meta.setdefault("_index", index)
+                    if doc_type is not None:
+                        meta.setdefault("_type", doc_type)
     r = n.bulk(ops)
     if p.get("refresh") in ("true", "wait_for", ""):
         for svc in n.indices.values():
             svc.refresh()
     return 200, r
+
+
+def _mget_typed(n: Node, p, b, index: str, type: Optional[str]):
+    """Typed mget: the path {type} becomes each doc spec's default _type
+    (then the usual type-filtered read applies)."""
+    body = _json(b)
+    if type and type != "_all":
+        for spec in body.get("docs", []):
+            if isinstance(spec, dict):
+                spec.setdefault("_type", type)
+    import json as _j
+
+    return _mget(n, p, _j.dumps(body).encode(), index)
+
+
+def _termvectors_noid(n: Node, p, b, index: str):
+    """/{index}/{type}/_termvectors — id carried in the body."""
+    body = _json(b)
+    if not isinstance(body, dict):
+        raise IllegalArgumentException("termvectors expects an object body")
+    return _termvectors(n, p, b, index, str(body.get("_id") or ""))
 
 
 def _bulk_index(n: Node, p, b, index: str):
@@ -1453,14 +1490,18 @@ def _search_all(n: Node, p, b):
     return 200, n.search(None, _search_body(p, b), preference=p.get("preference"))
 
 
-def _msearch(n: Node, p, b, index: Optional[str] = None):
+def _msearch(n: Node, p, b, index: Optional[str] = None,
+             doc_type: Optional[str] = None):
     lines = _ndjson(b)
     pairs = []
     for i in range(0, len(lines) - 1, 2):
         header = lines[i]
         if index is not None:
             header.setdefault("index", index)
-        pairs.append((header, lines[i + 1]))
+        body = lines[i + 1]
+        if doc_type is not None and "type" not in header:
+            body = _with_type_filter(body, doc_type)
+        pairs.append((header, body))
     return 200, n.msearch(pairs)
 
 
@@ -2454,7 +2495,8 @@ def _index_doc_auto_typed(n: Node, p, b, index: str, type: str):
 def _doc_exists_typed(n: Node, p, b, index: str, type: str, id: str):
     if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
-    if _type_mismatch(n, index, type, id):
+    if _type_mismatch(n, index, type, id,
+                      p.get("routing") or p.get("parent")):
         return 404, None
     return _doc_exists(n, p, b, index, id)
 
