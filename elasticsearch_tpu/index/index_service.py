@@ -155,12 +155,13 @@ class IndexService:
                         "failed": failed},
         }
 
-    def get_doc(self, doc_id: str, routing: Optional[str] = None) -> dict:
+    def get_doc(self, doc_id: str, routing: Optional[str] = None,
+                realtime: bool = True) -> dict:
         from elasticsearch_tpu.cluster.metadata import check_open
 
         check_open(self, op="read")
         shard = self.route(doc_id, routing)
-        got = shard.engine.get(doc_id)
+        got = shard.engine.get(doc_id, realtime=realtime)
         if got is None:
             return {"_index": self.name, "_type": "_doc", "_id": doc_id,
                     "found": False}

@@ -434,11 +434,15 @@ class Node:
                     if out is not None:
                         return {"responses": out}
         responses = []
+        legacy_names = {"index_not_found_exception": "IndexMissingException"}
         for header, body in pairs:
             try:
                 responses.append(self.search(header.get("index"), body))
             except ElasticsearchTpuException as e:
-                responses.append({"error": {"type": e.error_type, "reason": str(e)},
+                # 2.0 msearch reports error entries as strings like
+                # "IndexMissingException[no such index]"
+                name = legacy_names.get(e.error_type, e.error_type)
+                responses.append({"error": f"{name}[{e}]",
                                   "status": e.status})
         return {"responses": responses}
 

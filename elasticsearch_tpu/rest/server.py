@@ -237,7 +237,7 @@ def _register_all(rc: RestController):
     add("GET", "/_cat/shards/{index}", _cat_shards)
     add("DELETE", "/_search/scroll/{scroll_id}",
         lambda n, p, b, scroll_id: _clear_scroll(
-            n, p, json.dumps({"scroll_id": scroll_id}).encode()))
+            n, {**p, "scroll_id": scroll_id}, b))  # body ids win
     add("GET", "/_cluster/health/{index}",
         lambda n, p, b, index: (200, n.cluster_state.health()))
     add("GET", "/_cluster/state/{metric}", _cluster_state_metric)
@@ -1158,11 +1158,22 @@ def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     return _delete_doc(n, p, b, index, id)
 
 
+def _realtime_kw(n, p, index: str) -> dict:
+    """GET-API realtime/refresh params: realtime=false reads only
+    refreshed state; refresh=true refreshes first (GetRequest.realtime/
+    refresh)."""
+    if str(p.get("refresh", "false")).lower() in ("", "true", "1"):
+        n.get_index(index).refresh()
+    rt = str(p.get("realtime", "true")).lower() not in ("false", "0")
+    return {"realtime": rt}
+
+
 def _get_doc(n: Node, p, b, index: str, id: str):
     from elasticsearch_tpu.search.service import _filter_source
 
     svc = n.get_index(index)
-    r = svc.get_doc(id, routing=p.get("routing") or p.get("parent"))
+    r = svc.get_doc(id, routing=p.get("routing") or p.get("parent"),
+                    **_realtime_kw(n, p, index))
     if not r.get("found"):
         return 404, r
     sf = p.get("_source")
@@ -1212,15 +1223,31 @@ def _get_doc(n: Node, p, b, index: str, id: str):
 
 
 def _doc_exists(n: Node, p, b, index: str, id: str):
-    r = n.get_index(index).get_doc(id)
+    r = n.get_index(index).get_doc(id, routing=p.get("routing")
+                                   or p.get("parent"),
+                                   **_realtime_kw(n, p, index))
     return (200 if r.get("found") else 404), None
 
 
 def _get_source(n: Node, p, b, index: str, id: str):
-    r = n.get_index(index).get_doc(id)
+    from elasticsearch_tpu.search.service import _filter_source
+
+    r = n.get_index(index).get_doc(id, routing=p.get("routing")
+                                   or p.get("parent"),
+                                   **_realtime_kw(n, p, index))
     if not r.get("found"):
         return 404, {"error": "not found", "status": 404}
-    return 200, r["_source"]
+    src = r["_source"]
+    sf = p.get("_source")
+    if sf is not None and sf.lower() not in ("true", "false"):
+        src = _filter_source(src, sf.split(","))
+    elif "_source_include" in p or "_source_exclude" in p:
+        src = _filter_source(src, {
+            "include": [x for x in (p.get("_source_include") or ""
+                                    ).split(",") if x],
+            "exclude": [x for x in (p.get("_source_exclude") or ""
+                                    ).split(",") if x]})
+    return 200, src
 
 
 def _delete_doc(n: Node, p, b, index: str, id: str):
@@ -1357,7 +1384,8 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
         return {"_index": iname, "_id": spec.get("_id"),
                 "error": {"type": e.error_type, "reason": str(e)}}
     got = svc.get_doc(str(spec.get("_id")),
-                      routing=spec.get("routing") or spec.get("_routing"))
+                      routing=spec.get("routing") or spec.get("_routing"),
+                      **_realtime_kw(n, p, iname))
     if (got.get("found") and want_type not in (None, "_all", "_doc")
             and got.get("_type") != want_type):
         # requested type mismatch reads as not-found (MultiGetRequest)
@@ -1519,12 +1547,17 @@ def _scroll(n: Node, p, b):
 
 def _clear_scroll(n: Node, p, b):
     from elasticsearch_tpu.search.service import clear_scroll
+    from elasticsearch_tpu.utils.errors import \
+        SearchContextMissingException
 
     body = _json(b)
-    ids = body.get("scroll_id", [])
+    ids = body.get("scroll_id", p.get("scroll_id", []))
     if isinstance(ids, str):
-        ids = [ids]
+        ids = ids.split(",")
     freed = sum(1 for s in ids if clear_scroll(s))
+    if ids and ids != ["_all"] and freed == 0:
+        raise SearchContextMissingException(
+            f"no search context found for ids {ids}")
     return 200, {"succeeded": True, "num_freed": freed}
 
 
@@ -1642,9 +1675,8 @@ def _get_warmers(n: Node, p, b, index: str):
     out = {}
     for nm in n.resolve_indices(index):
         svc = n.indices[nm]
-        if svc.warmers:
-            out[nm] = {"warmers": {
-                k: {"source": v} for k, v in svc.warmers.items()}}
+        out[nm] = {"warmers": {
+            k: {"source": v} for k, v in svc.warmers.items()}}
     return 200, out
 
 
@@ -2446,13 +2478,15 @@ def _warmer_name_match(k: str, name: Optional[str]) -> bool:
 
 
 def _get_warmers_root(n: Node, p, b, name: Optional[str] = None):
-    """GET /_warmer[/{name}] across all indices ({name}: pattern/comma/_all)."""
+    """GET /_warmer[/{name}] across all indices ({name}: pattern/comma/
+    _all). The unnamed form lists every index (empty maps included); a
+    name only the indices carrying a match."""
     out = {}
     for iname in n.resolve_indices(None):
         svc = n.indices[iname]
         ws = {k: {"source": v} for k, v in svc.warmers.items()
               if _warmer_name_match(k, name)}
-        if ws:
+        if ws or name is None:
             out[iname] = {"warmers": ws}
     return 200, out
 

@@ -685,7 +685,11 @@ def search_shards(
 def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
     state = _SCROLLS.get(scroll_id)
     if state is None:
-        raise SearchParseException(f"no search context found for id [{scroll_id}]")
+        from elasticsearch_tpu.utils.errors import \
+            SearchContextMissingException
+
+        raise SearchContextMissingException(
+            f"No search context found for id [{scroll_id}]")
     body = state["body"]
     sz = size or int(body.get("size", 10))
     lo = state["pos"]

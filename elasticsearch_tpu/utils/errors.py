@@ -77,6 +77,13 @@ class SearchParseException(ElasticsearchTpuException):
     status = 400
 
 
+class SearchContextMissingException(ElasticsearchTpuException):
+    """Reference: search/SearchContextMissingException.java — a scroll id
+    that no longer has a live context (expired or cleared) is a 404."""
+
+    status = 404
+
+
 class ScriptException(ElasticsearchTpuException):
     status = 400
 
