@@ -1267,8 +1267,30 @@ def _update_doc(n: Node, p, b, index: str, id: str,
     # update auto-creates the index (reference: TransportUpdateAction
     # routes through auto-create like index does)
     svc = n.get_or_autocreate(index)
-    r = svc.update_doc(id, _json(b), routing=p.get("routing"),
+    body = _json(b)
+    r = svc.update_doc(id, body, routing=p.get("routing"),
                        doc_type=doc_type)
+    fields = p.get("fields") or body.get("fields")
+    if fields:
+        # UpdateResponse "get" envelope (UpdateHelper.extractGetResult)
+        names = ([f.strip() for f in fields.split(",")]
+                 if isinstance(fields, str) else list(fields))
+        got = svc.get_doc(id, routing=p.get("routing"))
+        env: Dict[str, Any] = {"found": bool(got.get("found"))}
+        src = got.get("_source") or {}
+        fl: Dict[str, Any] = {}
+        for f in names:
+            if f == "_source":
+                env["_source"] = src
+                continue
+            cur: Any = src
+            for part in f.split("."):
+                cur = cur.get(part) if isinstance(cur, dict) else None
+            if cur is not None:
+                fl[f] = cur if isinstance(cur, list) else [cur]
+        if fl:
+            env["fields"] = fl
+        r["get"] = env
     if p.get("refresh") in ("true", ""):
         svc.refresh()
     return 200, r
@@ -1383,8 +1405,9 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
     except ElasticsearchTpuException as e:
         return {"_index": iname, "_id": spec.get("_id"),
                 "error": {"type": e.error_type, "reason": str(e)}}
+    rt = spec.get("routing") or spec.get("_routing")
     got = svc.get_doc(str(spec.get("_id")),
-                      routing=spec.get("routing") or spec.get("_routing"),
+                      routing=str(rt) if rt is not None else None,
                       **_realtime_kw(n, p, iname))
     if (got.get("found") and want_type not in (None, "_all", "_doc")
             and got.get("_type") != want_type):

@@ -450,8 +450,6 @@ SKIP_TESTS = {
         "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
     ('update/75_ttl.yaml', 'TTL'):
         "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/80_fields.yaml', 'Fields'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
 }
 
 
