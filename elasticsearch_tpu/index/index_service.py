@@ -132,6 +132,8 @@ class IndexService:
         from elasticsearch_tpu.cluster.metadata import check_open
 
         check_open(self)
+        self._check_routing_required(doc_id, kw.get("doc_type"),
+                                     routing or kw.get("parent"))
         group = self.group_for(doc_id, routing)
         from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
 
@@ -154,6 +156,19 @@ class IndexService:
                         "successful": 1 + len(group.replicas),
                         "failed": failed},
         }
+
+    def _check_routing_required(self, doc_id, doc_type, routing) -> None:
+        """Reference: MappingMetaData.routing().required() +
+        `_parent` mappings make routing mandatory for that type."""
+        if routing is not None:
+            return
+        from elasticsearch_tpu.utils.errors import RoutingMissingException
+
+        if self.mappings.routing_required:
+            raise RoutingMissingException(self.name, doc_type or "_doc",
+                                          str(doc_id))
+        if doc_type and doc_type in self.mappings.parent_types:
+            raise RoutingMissingException(self.name, doc_type, str(doc_id))
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None,
                 realtime: bool = True) -> dict:

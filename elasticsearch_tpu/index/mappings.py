@@ -114,6 +114,11 @@ class Mappings:
         # type names seen in 2.0 typed-mapping bodies (response echo /
         # exists_type); the field model itself stays single-type
         self.type_names: List[str] = []
+        # child type -> parent type (from `_parent: {type: X}` blocks);
+        # writes of these types require parent/routing
+        self.parent_types: Dict[str, str] = {}
+        # `_routing: {required: true}` — ops without routing are rejected
+        self.routing_required = False
         if mapping_json:
             self.merge(mapping_json)
 
@@ -150,6 +155,10 @@ class Mappings:
             for tname, tbody in blocks.items():
                 if tname not in self.type_names:
                     self.type_names.append(tname)
+                if isinstance(tbody, dict) and "_parent" in tbody:
+                    pt = (tbody["_parent"] or {}).get("type")
+                    if pt:
+                        self.parent_types[tname] = pt
                 self.merge(tbody if tbody else {"properties": {}})
             rest = {k: v for k, v in body.items() if k not in blocks}
             if not rest:
@@ -171,6 +180,9 @@ class Mappings:
             self._ttl_default = body["_ttl"].get("default")
         if "_size" in body:
             self._size_enabled = body["_size"].get("enabled", False)
+        if "_routing" in body:
+            self.routing_required = bool(
+                (body["_routing"] or {}).get("required", False))
         if "_field_names" in body:
             self._field_names_enabled = body["_field_names"].get("enabled", True)
         if "dynamic_templates" in body:

@@ -553,10 +553,15 @@ def _register_all(rc: RestController):
     add("POST", "/{index}/_warmers/{name}", _put_warmer)
     add("GET", "/{index}/{type}/{id}/_explain", _typed(_explain))
     add("POST", "/{index}/{type}/{id}/_explain", _typed(_explain))
-    add("GET", "/{index}/{type}/{id}/_source", _typed(_get_source))
+    add("GET", "/{index}/{type}/{id}/_source", _typed(
+        lambda n, p, b, index, id, type=None: (
+            _check_read_routing(n, index, type, id, p)
+            or _get_source(n, p, b, index, id)), keep_type=True))
     add("POST", "/{index}/{type}/{id}/_update", _typed(
-        lambda n, p, b, index, id, type=None: _update_doc(
-            n, p, b, index, id, doc_type=type), keep_type=True))
+        lambda n, p, b, index, id, type=None: (
+            _check_read_routing(n, index, type, id, p)
+            or _update_doc(n, p, b, index, id, doc_type=type)),
+        keep_type=True))
     add("GET", "/{index}/{type}/{id}/_percolate/count",
         _typed(_percolate_count_existing, keep_type=True))
     add("POST", "/{index}/{type}/{id}/_percolate/count",
@@ -1120,6 +1125,24 @@ def _index_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     return _index_doc(n, p, b, index, id, doc_type=type)
 
 
+def _check_read_routing(n: Node, index: str, type: str, id: str, p) -> None:
+    """Typed reads/deletes of a parent-mapped or routing-required type
+    without routing/parent are rejected (RoutingMissingException), like
+    the reference's read-side routing resolution."""
+    from elasticsearch_tpu.utils.errors import (ElasticsearchTpuException,
+                                                RoutingMissingException)
+
+    if p.get("routing") or p.get("parent"):
+        return
+    try:
+        m = n.get_index(index).mappings
+    except ElasticsearchTpuException:
+        return
+    if m.routing_required or (type not in ("_all", "_doc")
+                              and type in m.parent_types):
+        raise RoutingMissingException(index, type, str(id))
+
+
 def _type_mismatch(n: Node, index: str, type: str, id: str,
                    routing: Optional[str] = None) -> bool:
     """Requested {type} filters doc reads (reference: GetRequest.type) —
@@ -1140,6 +1163,7 @@ def _type_mismatch(n: Node, index: str, type: str, id: str,
 def _get_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    _check_read_routing(n, index, type, id, p)
     if _type_mismatch(n, index, type, id,
                       p.get("routing") or p.get("parent")):
         return 404, {"_index": index, "_type": type, "_id": id,
@@ -1150,6 +1174,7 @@ def _get_doc_typed(n: Node, p, b, index: str, type: str, id: str):
 def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    _check_read_routing(n, index, type, id, p)
     if _type_mismatch(n, index, type, id,
                       p.get("routing") or p.get("parent")):
         from elasticsearch_tpu.utils.errors import DocumentMissingException
@@ -2552,6 +2577,7 @@ def _index_doc_auto_typed(n: Node, p, b, index: str, type: str):
 def _doc_exists_typed(n: Node, p, b, index: str, type: str, id: str):
     if type.startswith("_") and type != "_all":
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
+    _check_read_routing(n, index, type, id, p)
     if _type_mismatch(n, index, type, id,
                       p.get("routing") or p.get("parent")):
         return 404, None

@@ -77,6 +77,18 @@ class SearchParseException(ElasticsearchTpuException):
     status = 400
 
 
+class RoutingMissingException(ElasticsearchTpuException):
+    """Reference: action/RoutingMissingException.java — a type with a
+    `_parent` mapping (or `_routing required`) was written/read without
+    the routing/parent that places it on a shard."""
+
+    status = 400
+
+    def __init__(self, index: str, doc_type: str, doc_id: str):
+        super().__init__(
+            f"routing is required for [{index}]/[{doc_type}]/[{doc_id}]")
+
+
 class SearchContextMissingException(ElasticsearchTpuException):
     """Reference: search/SearchContextMissingException.java — a scroll id
     that no longer has a live context (expired or cleared) is a 404."""

@@ -148,20 +148,14 @@ SKIP_TESTS = {
         'field_stats cluster/indices level detail for text fields (min/max on analyzed terms)',
     ('get/10_basic.yaml', 'Basic'):
         'get-API tail: required-routing enforcement, realtime=false semantics, version-checked reads',
-    ('get/30_parent.yaml', 'Parent omitted'):
-        'get-API tail: required-routing enforcement, realtime=false semantics, version-checked reads',
     ('get/70_source_filtering.yaml', 'Source filtering'):
         'get-API tail: required-routing enforcement, realtime=false semantics, version-checked reads',
     ('get/90_versions.yaml', 'Versions'):
         'get-API tail: required-routing enforcement, realtime=false semantics, version-checked reads',
-    ('get_source/30_parent.yaml', 'Parent omitted'):
-        'get_source tail: same routing/realtime semantics as the get API',
     ('get_source/70_source_filtering.yaml', 'Source filtering'):
         'get_source tail: same routing/realtime semantics as the get API',
     ('index/10_with_id.yaml', 'Index with ID'):
         'index-API tail semantics (see adjacent entries)',
-    ('index/50_parent.yaml', 'Parent'):
-        'required-routing enforcement (mapping _routing required:true) not modeled',
     ('index/60_refresh.yaml', 'Refresh'):
         'refresh=wait_for/forced-refresh visibility detail',
     ('index/70_timestamp.yaml', 'Timestamp'):
