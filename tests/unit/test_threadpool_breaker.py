@@ -157,7 +157,7 @@ def test_rest_429_and_cat_thread_pool():
         st, r = req("POST", "/cb3/_refresh")
         assert st == 429, (st, r)
         assert r["error"]["type"] == "circuit_breaking_exception"
-        st, pools = req("GET", "/_cat/thread_pool?format=json")
+        st, pools = req("GET", "/_cat/thread_pool?format=json&pools=true")
         assert st == 200
         by_name = {p["name"]: p for p in pools}
         assert by_name["index"]["completed"] >= 1  # the _doc PUT
