@@ -35,6 +35,7 @@ class Node:
         # stored search templates (reference keeps these in the .scripts
         # index; node-local registry here)
         self.search_templates: Dict[str, Any] = {}
+        self.search_template_versions: Dict[str, int] = {}
         # snapshot repositories (reference: RepositoriesService)
         self.repositories: Dict[str, Any] = {}
         # dynamic cluster settings (reference: ClusterUpdateSettingsRequest
@@ -270,7 +271,19 @@ class Node:
                     self._persist_index_meta(n)
         return {"acknowledged": True}
 
-    def put_template(self, name: str, body: dict) -> dict:
+    def put_template(self, name: str, body: dict,
+                     create: bool = False) -> dict:
+        if create and name in self.cluster_state.templates:
+            raise IndexAlreadyExistsException(name)
+        body = dict(body)
+        aliases = dict(body.get("aliases") or {})
+        for spec in aliases.values():  # same routing fan-out as create
+            if isinstance(spec, dict) and "routing" in spec:
+                r = str(spec.pop("routing"))
+                spec.setdefault("index_routing", r)
+                spec.setdefault("search_routing", r)
+        if aliases:
+            body["aliases"] = aliases
         self.cluster_state.templates[name] = body
         return {"acknowledged": True}
 

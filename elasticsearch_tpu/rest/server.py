@@ -252,10 +252,11 @@ def _register_all(rc: RestController):
     add("PUT", "/_settings", _put_settings_root)
     add("GET", "/_alias", _get_aliases)
     add("GET", "/_aliases/{alias}", _get_alias)
-    add("GET", "/_template", lambda n, p, b: (
-        200, dict(n.cluster_state.templates)))
+    add("GET", "/_template",
+        lambda n, p, b: _get_template(n, p, b, None))
     add("POST", "/_template/{name}", lambda n, p, b, name: (
-        200, n.put_template(name, _json(b))))
+        200, n.put_template(name, _json(b), create=str(
+            p.get("create", "false")).lower() in ("", "true"))))
     add("GET", "/_warmer", _get_warmers_root)
     add("GET", "/_warmer/{name}", _get_warmers_root)
     add("PUT", "/_warmer/{name}", _put_warmer_root)
@@ -340,9 +341,10 @@ def _register_all(rc: RestController):
     add("POST", "/_aliases", lambda n, p, b: (200, n.update_aliases(_json(b).get("actions", []))))
     add("GET", "/_aliases", _get_aliases)
     add("GET", "/_alias/{alias}", _get_alias)
-    add("PUT", "/_template/{name}", lambda n, p, b, name: (200, n.put_template(name, _json(b))))
-    add("GET", "/_template/{name}", lambda n, p, b, name: (
-        200, {name: n.cluster_state.templates.get(name, {})}))
+    add("PUT", "/_template/{name}", lambda n, p, b, name: (
+        200, n.put_template(name, _json(b), create=str(
+            p.get("create", "false")).lower() in ("", "true"))))
+    add("GET", "/_template/{name}", _get_template)
     add("DELETE", "/_template/{name}", lambda n, p, b, name: (200, n.delete_template(name)))
 
     # index lifecycle ops
@@ -1812,7 +1814,8 @@ def _resolve_template(n: Node, body: dict):
     if tmpl is None and "id" in body:
         tmpl = n.search_templates.get(body["id"])
         if tmpl is None:
-            raise ElasticsearchTpuException(f"search template [{body['id']}] not found")
+            raise ElasticsearchTpuException(
+                f"Unable to find on disk script {body['id']}")
     if tmpl is None:
         raise ElasticsearchTpuException("search template requires [inline] or [id]")
     return tmpl, body.get("params")
@@ -1837,15 +1840,21 @@ def _render_template_ep(n: Node, p, b):
 
 def _put_search_template(n: Node, p, b, id: str):
     body = _json(b)
+    created = id not in n.search_templates
     n.search_templates[id] = body.get("template", body)
-    return 200, {"acknowledged": True, "_id": id}
+    ver = n.search_template_versions.get(id, 0) + 1
+    n.search_template_versions[id] = ver
+    return (201 if created else 200), {
+        "acknowledged": True, "_id": id, "_version": ver,
+        "created": created}
 
 
 def _get_search_template(n: Node, p, b, id: str):
     t = n.search_templates.get(id)
     if t is None:
         return 404, {"_id": id, "found": False}
-    return 200, {"_id": id, "found": True, "template": t}
+    return 200, {"_id": id, "found": True, "lang": "mustache",
+                 "template": t}
 
 
 def _delete_search_template(n: Node, p, b, id: str):
@@ -2211,6 +2220,61 @@ def _get_index_alias(n: Node, p, b, index: str, alias: Optional[str] = None):
     if alias is not None and not any(v["aliases"] for v in out.values()):
         return 404, {"error": f"alias [{alias}] missing", "status": 404}
     return 200, out
+
+
+def _template_json(body: dict, flat: bool) -> dict:
+    """GetIndexTemplatesResponse echo: order/template plus flat-string
+    settings (nested when ?flat_settings=false)."""
+    def _flatten(d, prefix=""):
+        out = {}
+        for k, v in (d or {}).items():
+            key = f"{prefix}{k}"
+            if isinstance(v, dict):
+                out.update(_flatten(v, f"{key}."))
+            else:
+                out[key] = str(v)
+        return out
+
+    raw = dict(body.get("settings") or {})
+    if raw and "index" not in raw:
+        raw = {"index": raw}
+    flat_map = _flatten(raw)
+    if flat:
+        settings = flat_map
+    else:
+        settings: dict = {}
+        for k, v in flat_map.items():
+            cur = settings
+            parts = k.split(".")
+            for part in parts[:-1]:
+                cur = cur.setdefault(part, {})
+            cur[parts[-1]] = v
+    return {
+        "order": int(body.get("order", 0)),
+        "template": body.get("template", ""),
+        "settings": settings,
+        "mappings": body.get("mappings", {}),
+        "aliases": body.get("aliases", {}),
+    }
+
+
+def _get_template(n: Node, p, b, name: Optional[str]):
+    import fnmatch
+
+    # GetIndexTemplates default is the NESTED settings form;
+    # ?flat_settings=true flattens (opposite default to index settings GET)
+    flat = str(p.get("flat_settings", "false")).lower() in ("", "true")
+    tmpls = n.cluster_state.templates
+    if name is None:
+        names = list(tmpls)
+    else:
+        pats = [x.strip() for x in name.split(",")]
+        names = [t for t in tmpls
+                 if any(pt in ("_all", "*") or fnmatch.fnmatch(t, pt)
+                        for pt in pats)]
+        if not names and not any("*" in pt or pt == "_all" for pt in pats):
+            raise IndexNotFoundException(name)
+    return 200, {t: _template_json(tmpls[t], flat) for t in names}
 
 
 def _template_exists(n: Node, p, b, name: str):

@@ -40,9 +40,11 @@ SKIP_FILES = {
 # design) or API tails below the parity bar. Every entry names its class;
 # closing one removes the entry. Everything NOT listed must pass.
 SKIP_TESTS = {
+    # FLAKY by test order, keep skipped: segment generation ids are
+    # process-global, the reference regex expects single digits
     ('cat.segments/10_basic.yaml', 'Test cat segments output'):
         'segment generation ids are process-global (monotonic across all '
-        'engines), so the single-digit _N the reference regex expects '
+        'engines); the single-digit _N the reference regex expects '
         'depends on test order',
     ('cat.count/10_basic.yaml', 'Test cat count output'):
         "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
@@ -60,10 +62,6 @@ SKIP_TESTS = {
         'health wait_for/level detail (per-index/shard health sections) beyond the single-node summary',
     ('cluster.health/10_basic.yaml', 'cluster health levels'):
         'health wait_for/level detail (per-index/shard health sections) beyond the single-node summary',
-    ('cluster.pending_tasks/10_basic.yaml', 'Test pending tasks'):
-        'pending-tasks detail: single-process cluster applies state synchronously, the queue is always empty',
-    ('cluster.pending_tasks/10_basic.yaml', 'Test pending tasks with local flag'):
-        'pending-tasks detail: single-process cluster applies state synchronously, the queue is always empty',
     ('cluster.reroute/11_explain.yaml', 'Explain API for non-existent node & shard'):
         'reroute response filtering/explain detail beyond the single-node acknowledgement',
     ('cluster.reroute/20_response_filtering.yaml', 'Do not return metadata by default'):
@@ -180,12 +178,6 @@ SKIP_TESTS = {
         'settings GET response tail (defaults/filtering variants)',
     ('indices.get_settings/10_basic.yaml', 'Get /{index}/_settings/{name,name}'):
         'settings GET response tail (defaults/filtering variants)',
-    ('indices.get_template/10_basic.yaml', 'Get template'):
-        'template GET response echo (order/settings stringification)',
-    ('indices.get_template/10_basic.yaml', 'Get template with flat settings and master timeout'):
-        'template GET response echo (order/settings stringification)',
-    ('indices.get_template/20_get_missing.yaml', 'Get missing template'):
-        'template GET response echo (order/settings stringification)',
     ('indices.get_warmer/10_basic.yaml', 'Empty response when no matching warmer'):
         'warmer GET empty/miss status edges',
     ('indices.get_warmer/10_basic.yaml', 'Throw 404 on missing index'):
@@ -202,12 +194,6 @@ SKIP_TESTS = {
         'dynamic-settings rejection detail (non-dynamic keys we accept as inert)',
     ('indices.put_settings/10_basic.yaml', 'Test indices settings ignore_unavailable'):
         'dynamic-settings rejection detail (non-dynamic keys we accept as inert)',
-    ('indices.put_template/10_basic.yaml', 'Put template'):
-        'template create/validation response detail',
-    ('indices.put_template/10_basic.yaml', 'Put template create'):
-        'template create/validation response detail',
-    ('indices.put_template/10_basic.yaml', 'Put template with aliases'):
-        'template create/validation response detail',
     ('indices.put_warmer/10_basic.yaml', 'Basic test for warmers'):
         'warmer PUT with query validation edges',
     ('indices.put_warmer/10_basic.yaml', 'Getting a non-existent warmer on an existing index should return an empty body'):
@@ -640,12 +626,15 @@ class Runner:
             if not self._eq(got, want):
                 raise StepFailed(f"match {path}: got {got!r}, want {want!r}")
         elif kind == "is_true":
+            # the reference runner: only null/false/""/0 are falsy —
+            # an EMPTY object/array is true
             got = self.get_path(spec)
-            if got in (None, False, "", 0, {}, []):
+            if got is None or got is False or got == "" or got == 0:
                 raise StepFailed(f"is_true {spec}: got {got!r}")
         elif kind == "is_false":
             got = self.get_path(spec)
-            if got not in (None, False, "", 0, {}, []):
+            if not (got is None or got is False or got == ""
+                    or got == 0):
                 raise StepFailed(f"is_false {spec}: got {got!r}")
         elif kind == "length":
             (path, want), = spec.items()
