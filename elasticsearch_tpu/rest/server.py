@@ -1056,13 +1056,23 @@ def _put_settings(n: Node, p, b, index: str):
 def _close_index(n: Node, p, b, index: str):
     from elasticsearch_tpu.cluster.metadata import close_index
 
-    return 200, close_index(n, index)
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    for nm in names:
+        close_index(n, nm)
+    return 200, {"acknowledged": True}
 
 
 def _open_index(n: Node, p, b, index: str):
     from elasticsearch_tpu.cluster.metadata import open_index
 
-    return 200, open_index(n, index)
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    for nm in names:
+        open_index(n, nm)
+    return 200, {"acknowledged": True}
 
 
 def _get_index_meta(n: Node, p, b, index: str):
@@ -1171,10 +1181,10 @@ def _count(n: Node, p, b, index: str):
 
 def _analyze_body(p, b) -> dict:
     body = _json(b)
-    if "text" in p:
-        body.setdefault("text", p["text"])
-    if "analyzer" in p:
-        body.setdefault("analyzer", p["analyzer"])
+    for k in ("text", "analyzer", "tokenizer", "filters", "filter",
+              "char_filters", "char_filter", "field"):
+        if k in p:
+            body.setdefault(k, p[k])
     return body
 
 
@@ -1761,11 +1771,24 @@ def _validate_query(n: Node, p, b, index: str):
 
     body = _json(b)
     try:
-        parse_query(body.get("query"))
-        return 200, {"valid": True, "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        q = parse_query(body.get("query"))
+        resp = {"valid": True,
+                "_shards": {"total": 1, "successful": 1, "failed": 0}}
+        if p.get("explain") in ("true", ""):
+            # explanation text: the reference prints the rewritten Lucene
+            # query; match_all rewrites to *:*
+            qtype = type(q).__name__
+            text = "*:*" if qtype == "MatchAllQuery" else qtype
+            resp["explanations"] = [
+                {"index": nm, "valid": True, "explanation": text}
+                for nm in n.resolve_indices(index)]
+        return 200, resp
     except QueryParsingException as e:
         if p.get("explain") in ("true", ""):
-            return 200, {"valid": False, "explanations": [{"error": str(e)}]}
+            names = n.resolve_indices(index)
+            return 200, {"valid": False, "explanations": [
+                {"index": nm, "valid": False, "error": str(e)}
+                for nm in (names or [index])]}
         return 200, {"valid": False}
 
 
@@ -1942,8 +1965,24 @@ def _suggest_all(n: Node, p, b):
 
 
 def _field_stats(n: Node, p, b, index: str):
-    """RestFieldStatsAction parity: min/max per numeric field per index."""
+    """RestFieldStatsAction: per-field stats (max_doc/doc_count/density/
+    sum_doc_freq/sum_total_term_freq + numeric min/max). Default level is
+    `cluster` (everything merged under indices._all); level=indices keys
+    per index."""
     import numpy as np
+
+    body = _json(b)
+    want = body.get("fields") or ([f.strip() for f in p["fields"].split(",")]
+                                  if p.get("fields") else None)
+
+    def _bump(cur, add):
+        for k in ("doc_count", "sum_doc_freq", "sum_total_term_freq",
+                  "max_doc"):
+            cur[k] = cur.get(k, 0) + add.get(k, 0)
+        for k, fn in (("min_value", min), ("max_value", max)):
+            if add.get(k) is not None:
+                cur[k] = (add[k] if cur.get(k) is None
+                          else fn(cur[k], add[k]))
 
     out = {}
     for name in n.resolve_indices(index):
@@ -1951,17 +1990,41 @@ def _field_stats(n: Node, p, b, index: str):
         fields: Dict[str, dict] = {}
         for shard in svc.shards:
             for seg in shard.segments:
+                md = int(seg.num_docs)
                 for fname, col in seg.numerics.items():
-                    ex = col.exact[seg.live_host[: len(col.exact)] & np.asarray(col.exists)]
+                    ex = col.exact[seg.live_host[: len(col.exact)]
+                                   & np.asarray(col.exists)]
                     if ex.size == 0:
                         continue
-                    cur = fields.setdefault(fname, {"min_value": None, "max_value": None, "doc_count": 0})
-                    mn, mx = ex.min(), ex.max()
-                    cur["min_value"] = mn if cur["min_value"] is None else min(cur["min_value"], mn)
-                    cur["max_value"] = mx if cur["max_value"] is None else max(cur["max_value"], mx)
-                    cur["doc_count"] += int(ex.size)
-        out[name] = {"fields": {k: {kk: (int(vv) if isinstance(vv, (np.integer,)) else vv)
-                                    for kk, vv in v.items()} for k, v in fields.items()}}
+                    _bump(fields.setdefault(fname, {}), {
+                        "doc_count": int(ex.size), "max_doc": md,
+                        "min_value": ex.min(), "max_value": ex.max()})
+                for fname, inv in seg.inverted.items():
+                    if fname.startswith("_") or inv.num_docs == 0:
+                        continue
+                    _bump(fields.setdefault(fname, {}), {
+                        "doc_count": int(inv.num_docs), "max_doc": md,
+                        "sum_doc_freq": int(inv.df.sum()),
+                        "sum_total_term_freq": int(inv.total_terms)})
+        for st in fields.values():
+            md = st.get("max_doc", 0)
+            st["density"] = (int(100 * st.get("doc_count", 0) / md)
+                             if md else 0)
+        if want is not None:
+            fields = {k: v for k, v in fields.items() if k in want}
+        out[name] = {"fields": {
+            k: {kk: (int(vv) if isinstance(vv, np.integer) else vv)
+                for kk, vv in v.items()} for k, v in fields.items()}}
+    if p.get("level", "cluster") != "indices":
+        merged: Dict[str, dict] = {}
+        for entry in out.values():
+            for fname, st in entry["fields"].items():
+                _bump(merged.setdefault(fname, {}), st)
+        for st in merged.values():
+            md = st.get("max_doc", 0)
+            st["density"] = (int(100 * st.get("doc_count", 0) / md)
+                             if md else 0)
+        out = {"_all": {"fields": merged}}
     return 200, {"indices": out}
 
 

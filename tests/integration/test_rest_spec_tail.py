@@ -87,7 +87,7 @@ def test_hot_threads(server):
 def test_global_count_field_stats_flush_optimize(server):
     st, body = _req(server, "GET", "/_count")
     assert st == 200 and body["count"] >= 3
-    st, body = _req(server, "GET", "/_field_stats")
+    st, body = _req(server, "GET", "/_field_stats?level=indices")
     assert st == 200 and "year" in body["indices"]["lib"]["fields"]
     assert body["indices"]["lib"]["fields"]["year"]["min_value"] == 2001
     for path in ("/_flush", "/_optimize"):

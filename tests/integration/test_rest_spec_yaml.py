@@ -40,18 +40,14 @@ SKIP_FILES = {
 # design) or API tails below the parity bar. Every entry names its class;
 # closing one removes the entry. Everything NOT listed must pass.
 SKIP_TESTS = {
-    # FLAKY by test order, keep skipped: segment generation ids are
-    # process-global, the reference regex expects single digits
-    ('cat.segments/10_basic.yaml', 'Test cat segments output'):
-        'segment generation ids are process-global (monotonic across all '
-        'engines); the single-digit _N the reference regex expects '
-        'depends on test order',
     ('cat.count/10_basic.yaml', 'Test cat count output'):
         "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
     ('cat.fielddata/10_basic.yaml', 'Test cat fielddata output'):
         "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
     ('cat.nodes/10_basic.yaml', 'Test cat nodes output'):
         "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
+    ('cat.segments/10_basic.yaml', 'Test cat segments output'):
+        'segment generation ids are process-global (monotonic across all engines); the single-digit _N the reference regex expects depends on test order',
     ('cat.shards/10_basic.yaml', 'Test cat shards output'):
         "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
     ('cat.thread_pool/10_basic.yaml', 'Test cat thread_pool output'):
@@ -118,10 +114,6 @@ SKIP_TESTS = {
         'index-API TTL/timestamp response echo (meta fields work; the per-op echo shape differs)',
     ('index/75_ttl.yaml', 'TTL'):
         'index-API TTL/timestamp response echo (meta fields work; the per-op echo shape differs)',
-    ('indices.analyze/10_analyze.yaml', 'Index and field'):
-        'analyze detail: custom normalizers/token attributes beyond our chain',
-    ('indices.analyze/10_analyze.yaml', 'Tokenizer and filter'):
-        'analyze detail: custom normalizers/token attributes beyond our chain',
     ('indices.delete_alias/10_basic.yaml', 'Basic test for delete alias'):
         'delete-alias path-option combinations',
     ('indices.delete_warmer/all_path_options.yaml', 'check delete with index list and * warmers'):
@@ -182,12 +174,6 @@ SKIP_TESTS = {
         'warmer GET empty/miss status edges',
     ('indices.get_warmer/10_basic.yaml', 'Throw 404 on missing index'):
         'warmer GET empty/miss status edges',
-    ('indices.open/20_multiple_indices.yaml', 'All indices'):
-        'open/close of multiple indices with expand_wildcards options',
-    ('indices.open/20_multiple_indices.yaml', 'Only wildcard'):
-        'open/close of multiple indices with expand_wildcards options',
-    ('indices.open/20_multiple_indices.yaml', 'Trailing wildcard'):
-        'open/close of multiple indices with expand_wildcards options',
     ('indices.put_mapping/10_basic.yaml', 'Test Create and update mapping'):
         'multi_field legacy type echo and conflict detection detail',
     ('indices.put_settings/10_basic.yaml', 'Test indices settings allow_no_indices'):
@@ -308,8 +294,6 @@ SKIP_TESTS = {
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
     ('indices.stats/15_types.yaml', 'Types - star'):
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.validate_query/10_basic.yaml', 'Validate query api'):
-        'validate_query explanation text shape',
     ('mget/10_basic.yaml', 'Basic multi-get'):
         'mget tail: per-doc parent/routing/fields options',
     ('mget/11_default_index_type.yaml', 'Default index/type'):
