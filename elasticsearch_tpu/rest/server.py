@@ -1075,23 +1075,46 @@ def _open_index(n: Node, p, b, index: str):
     return 200, {"acknowledged": True}
 
 
+def _expand_wildcards(n: Node, names, index_expr, p):
+    """expand_wildcards=open|closed|open,closed filtering for WILDCARD
+    index expressions (concrete names always resolve)."""
+    expr = str(index_expr or "")
+    if "*" not in expr and expr not in ("_all", ""):
+        return names
+    want = {x.strip() for x in str(p.get("expand_wildcards", "open")
+                                   ).split(",")}
+    if {"open", "closed"} <= want or "all" in want:
+        return names
+    closed_ok = "closed" in want
+    return [nm for nm in names if n.indices[nm].closed == closed_ok]
+
+
 def _get_index_meta(n: Node, p, b, index: str):
-    _st, settings_out = _get_settings(n, p, b, index)
+    names = _expand_wildcards(n, n.resolve_indices(index), index, p)
+    settings_out = _get_settings(n, p, b, index)[1] if names else {}
     out = {}
-    for name in n.resolve_indices(index):
+    for name in names:
         svc = n.indices[name]
         mj = svc.mappings.to_json()
         out[name] = {
             "aliases": svc.aliases,
             "mappings": ({t: mj for t in svc.mappings.type_names}
                          if svc.mappings.type_names else mj),
+            "warmers": {k: {"source": v} for k, v in svc.warmers.items()},
             **settings_out.get(name, {}),
         }
-        if svc.warmers:
-            out[name]["warmers"] = {k: {"source": v}
-                                    for k, v in svc.warmers.items()}
     if not out:
-        raise IndexNotFoundException(index)
+        # a wildcard that narrows to nothing (or ignore_unavailable /
+        # allow_no_indices) answers {}; only a concrete miss 404s
+        wildcard = any(c in str(index) for c in "*,")
+        allow_none = str(p.get("allow_no_indices",
+                               "true" if wildcard else "false")
+                         ).lower() in ("", "true")
+        ignore_missing = str(p.get("ignore_unavailable", "false")
+                             ).lower() in ("", "true")
+        if not ((wildcard and allow_none)
+                or (not wildcard and ignore_missing)):
+            raise IndexNotFoundException(index)
     return 200, out
 
 
@@ -2768,7 +2791,7 @@ def _get_index_feature(n: Node, p, b, index: str, feature: str):
     out = {}
     _st, settings_out = (_get_settings(n, p, b, index)
                          if "_settings" in feats else (200, {}))
-    for iname in n.resolve_indices(index):
+    for iname in _expand_wildcards(n, n.resolve_indices(index), index, p):
         svc = n.indices[iname]
         entry: Dict[str, Any] = {}
         if "_settings" in feats:

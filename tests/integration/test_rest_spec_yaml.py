@@ -122,12 +122,6 @@ SKIP_TESTS = {
         'warmer DELETE path-option combinations',
     ('indices.delete_warmer/all_path_options.yaml', 'check delete with index list and wildcard warmers'):
         'warmer DELETE path-option combinations',
-    ('indices.get/10_basic.yaml', 'Missing index should return empty object if ignore_unavailable'):
-        'indices.get expand_wildcards over closed indices',
-    ('indices.get/10_basic.yaml', 'Should return empty object if allow_no_indices'):
-        'indices.get expand_wildcards over closed indices',
-    ('indices.get/10_basic.yaml', 'Should return test_index_2 if expand_wildcards=open'):
-        'indices.get expand_wildcards over closed indices',
     ('indices.get_alias/10_basic.yaml', 'Existent and non-existent alias returns just the existing'):
         'alias GET scoping edge cases (name-only misses per index)',
     ('indices.get_alias/10_basic.yaml', 'Get aliases via /{index}/_alias/_all'):
