@@ -2293,17 +2293,29 @@ def _index_alias_exists(n: Node, p, b, index: str, name: str):
 
 
 def _get_index_alias(n: Node, p, b, index: str, alias: Optional[str] = None):
-    """RestGetAliasesAction scoped to an index (+ optional name pattern)."""
+    """RestGetAliasesAction scoped to an index; {name} supports comma
+    lists / wildcards / _all, partial matches return the existing subset
+    (a FULLY missing concrete name still 404s)."""
     import fnmatch
 
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    pats = ([x.strip() for x in alias.split(",")]
+            if alias is not None else None)
+
+    def hit(a: str) -> bool:
+        return pats is None or any(
+            pt in ("_all", "*") or fnmatch.fnmatch(a, pt) for pt in pats)
+
     out = {}
-    for iname in n.resolve_indices(index):
+    for iname in names:
         svc = n.indices[iname]
-        matched = {a: fa for a, fa in svc.aliases.items()
-                   if alias is None or fnmatch.fnmatch(a, alias)}
-        if matched or alias is None:
-            out[iname] = {"aliases": {a: (fa or {}) for a, fa in matched.items()}}
-    if alias is not None and not any(v["aliases"] for v in out.values()):
+        matched = {a: (fa or {}) for a, fa in svc.aliases.items() if hit(a)}
+        if matched or pats is None:
+            out[iname] = {"aliases": matched}
+    if pats is not None and not any(v["aliases"] for v in out.values()) \
+            and not any("*" in pt or pt == "_all" for pt in pats):
         return 404, {"error": f"alias [{alias}] missing", "status": 404}
     return 200, out
 

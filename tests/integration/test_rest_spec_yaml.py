@@ -122,20 +122,8 @@ SKIP_TESTS = {
         'warmer DELETE path-option combinations',
     ('indices.delete_warmer/all_path_options.yaml', 'check delete with index list and wildcard warmers'):
         'warmer DELETE path-option combinations',
-    ('indices.get_alias/10_basic.yaml', 'Existent and non-existent alias returns just the existing'):
-        'alias GET scoping edge cases (name-only misses per index)',
-    ('indices.get_alias/10_basic.yaml', 'Get aliases via /{index}/_alias/_all'):
-        'alias GET scoping edge cases (name-only misses per index)',
-    ('indices.get_alias/10_basic.yaml', 'Get aliases via /{index}/_alias/name,name'):
-        'alias GET scoping edge cases (name-only misses per index)',
     ('indices.get_alias/10_basic.yaml', 'Non-existent alias on an existing index returns an empty body'):
         'alias GET scoping edge cases (name-only misses per index)',
-    ('indices.get_aliases/10_basic.yaml', 'Existent and non-existent alias returns just the existing'):
-        'legacy _aliases response including empty entries',
-    ('indices.get_aliases/10_basic.yaml', 'Get aliases via /{index}/_aliases/_all'):
-        'legacy _aliases response including empty entries',
-    ('indices.get_aliases/10_basic.yaml', 'Get aliases via /{index}/_aliases/name,name'):
-        'legacy _aliases response including empty entries',
     ('indices.get_aliases/10_basic.yaml', 'Non-existent alias on an existing index returns matching indcies'):
         'legacy _aliases response including empty entries',
     ('indices.get_field_mapping/10_basic.yaml', 'Get field mapping with include_defaults'):
