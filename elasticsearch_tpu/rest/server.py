@@ -1604,21 +1604,43 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
 
     iname = spec.get("_index", default_index)
     want_type = spec.get("_type")
+    doc_id = str(spec.get("_id"))
     try:
         svc = n.get_index(iname)
     except ElasticsearchTpuException as e:
-        return {"_index": iname, "_id": spec.get("_id"),
+        return {"_index": iname, "_id": doc_id,
                 "error": {"type": e.error_type, "reason": str(e)}}
     rt = spec.get("routing") or spec.get("_routing")
-    got = svc.get_doc(str(spec.get("_id")),
-                      routing=str(rt) if rt is not None else None,
-                      **_realtime_kw(n, p, iname))
+    rt = str(rt) if rt is not None else None
+    got = svc.get_doc(doc_id, routing=rt, **_realtime_kw(n, p, iname))
+    got["_index"] = svc.name  # concrete index, even via an alias
+    got["_id"] = doc_id
     if (got.get("found") and want_type not in (None, "_all", "_doc")
             and got.get("_type") != want_type):
         # requested type mismatch reads as not-found (MultiGetRequest)
-        got = {"_index": iname, "_id": spec.get("_id"), "found": False}
+        got = {"_index": svc.name, "_id": doc_id, "found": False}
     if want_type is not None and not got.get("found"):
         got["_type"] = want_type
+    flds = spec.get("fields") or spec.get("_fields")
+    if flds and got.get("found"):
+        names = [flds] if isinstance(flds, str) else list(flds)
+        loc = svc.route(doc_id, rt).engine._locations.get(doc_id)
+        src = got.get("_source") or {}
+        fl: Dict[str, Any] = {}
+        for f in names:
+            if f == "_routing" and loc is not None \
+                    and loc.routing is not None:
+                fl["_routing"] = loc.routing
+            elif f == "_parent" and loc is not None \
+                    and loc.parent is not None:
+                fl["_parent"] = loc.parent
+            elif f not in ("_routing", "_parent"):
+                cur: Any = src
+                for part in str(f).split("."):
+                    cur = cur.get(part) if isinstance(cur, dict) else None
+                if cur is not None:
+                    fl[f] = cur if isinstance(cur, list) else [cur]
+        got["fields"] = fl
     sf = spec.get("_source", p.get("_source"))
     if sf is None and ("_source_include" in p or "_source_exclude" in p):
         sf = {"include": p.get("_source_include"),
@@ -2540,8 +2562,9 @@ def _mpercolate(n: Node, p, b, index: Optional[str] = None):
             svc = n.get_index(iname)
             responses.append(svc.percolate(lines[i + 1]))
         except ElasticsearchTpuException as e:
-            responses.append({"error": _error_body(e)["error"],
-                              "status": e.status})
+            legacy = {"index_not_found_exception": "IndexMissingException"}
+            nm = legacy.get(e.error_type, e.error_type)
+            responses.append({"error": f"{nm}[{e}]", "status": e.status})
     return 200, {"responses": responses}
 
 

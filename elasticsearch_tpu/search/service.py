@@ -771,10 +771,10 @@ def _filter_source(src: Optional[dict], spec) -> Optional[dict]:
         return all(fnmatch.fnmatch(ps, sg)
                    for ps, sg in zip(psegs, segs))
 
-    def _walk(obj, prefix: str):
+    def _walk(obj, prefix: str, in_included: bool = False):
         """Path-aware include/exclude (XContentMapValues.filter): a pattern
         like 'obj.inner' keeps that nested leaf; an included ancestor keeps
-        its whole subtree (minus exclusions)."""
+        its whole subtree (children face only the excludes)."""
         if not isinstance(obj, dict):
             return obj
         out = {}
@@ -783,10 +783,10 @@ def _filter_source(src: Optional[dict], spec) -> Optional[dict]:
             if excludes and any(fnmatch.fnmatch(path, pat)
                                 for pat in excludes):
                 continue
-            inc = (not includes
+            inc = (in_included or not includes
                    or any(fnmatch.fnmatch(path, pat) for pat in includes))
             if inc:
-                out[k] = (_walk(v, f"{path}.")
+                out[k] = (_walk(v, f"{path}.", True)
                           if isinstance(v, dict) and excludes else v)
             elif isinstance(v, dict) and any(_could_descend(path, pat)
                                              for pat in includes):

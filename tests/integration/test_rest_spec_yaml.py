@@ -104,8 +104,6 @@ SKIP_TESTS = {
         'get-API tail: required-routing enforcement, realtime=false semantics, version-checked reads',
     ('get/90_versions.yaml', 'Versions'):
         'get-API tail: required-routing enforcement, realtime=false semantics, version-checked reads',
-    ('get_source/70_source_filtering.yaml', 'Source filtering'):
-        'get_source tail: same routing/realtime semantics as the get API',
     ('index/10_with_id.yaml', 'Index with ID'):
         'index-API tail semantics (see adjacent entries)',
     ('index/60_refresh.yaml', 'Refresh'):
@@ -276,10 +274,6 @@ SKIP_TESTS = {
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
     ('indices.stats/15_types.yaml', 'Types - star'):
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('mget/10_basic.yaml', 'Basic multi-get'):
-        'mget tail: per-doc parent/routing/fields options',
-    ('mget/11_default_index_type.yaml', 'Default index/type'):
-        'mget tail: per-doc parent/routing/fields options',
     ('mget/12_non_existent_index.yaml', 'Non-existent index'):
         'mget tail: per-doc parent/routing/fields options',
     ('mget/13_missing_metadata.yaml', 'Missing metadata'):
@@ -294,18 +288,12 @@ SKIP_TESTS = {
         'mget tail: per-doc parent/routing/fields options',
     ('mget/55_parent_with_routing.yaml', 'Parent'):
         'mget tail: per-doc parent/routing/fields options',
-    ('mget/70_source_filtering.yaml', 'Source filtering -  exclude field'):
-        'exclude-only source filter keeps full subtree minus leaf (nested exclude edge)',
-    ('mget/70_source_filtering.yaml', 'Source filtering -  ids and exclude field'):
-        'exclude-only source filter keeps full subtree minus leaf (nested exclude edge)',
     ('mget/70_source_filtering.yaml', 'Source filtering -  ids and include nested field'):
         'exclude-only source filter keeps full subtree minus leaf (nested exclude edge)',
     ('mlt/20_docs.yaml', 'Basic mlt query with docs'):
         'mlt docs/ignore variants (like/unlike doc references beyond stored-doc seeds)',
     ('mlt/30_ignore.yaml', 'Basic mlt query with ignore like'):
         'mlt docs/ignore variants (like/unlike doc references beyond stored-doc seeds)',
-    ('mpercolate/10_basic.yaml', 'Basic multi-percolate'):
-        'mpercolate percolate_index/existing-doc header variants',
     ('mtermvectors/10_basic.yaml', 'Basic tests for multi termvector get'):
         'mtermvectors per-doc option variants',
     ('percolate/16_existing_doc.yaml', 'Percolate existing documents'):
