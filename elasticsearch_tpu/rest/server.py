@@ -138,7 +138,7 @@ def _register_all(rc: RestController):
     add("GET", "/_cluster/stats", _cluster_stats)
     add("GET", "/_nodes/stats", lambda n, p, b: (200, n.nodes_stats()))
     add("GET", "/_nodes", lambda n, p, b: (200, n.nodes_stats()))
-    add("GET", "/_stats", lambda n, p, b: (200, _all_stats(n)))
+    add("GET", "/_stats", lambda n, p, b: _index_stats(n, p, b, None))
 
     # cat API (text/plain-ish, returned as JSON rows when format=json)
     add("GET", "/_cat/indices", _cat_indices)
@@ -279,7 +279,8 @@ def _register_all(rc: RestController):
     add("POST", "/_search_shards", lambda n, p, b: _search_shards(n, p, b, None))
     add("GET", "/_validate/query", lambda n, p, b: _validate_query(n, p, b, None))
     add("POST", "/_validate/query", lambda n, p, b: _validate_query(n, p, b, None))
-    add("GET", "/_stats/{metric}", lambda n, p, b, metric: (200, _all_stats(n)))
+    add("GET", "/_stats/{metric}",
+        lambda n, p, b, metric: _index_stats(n, p, b, None, metric))
     add("POST", "/_snapshot/{repo}/{snap}", _put_snapshot)
     add("PUT", "/_snapshot/{repo}/{snap}/_create", _put_snapshot)
     add("POST", "/_snapshot/{repo}/{snap}/_create", _put_snapshot)
@@ -494,7 +495,7 @@ def _register_all(rc: RestController):
         lambda n, p, b, index, type, field:
         _get_field_mapping(n, p, b, field, index))
     add("GET", "/{index}/_stats/{metric}",
-        lambda n, p, b, index, metric: _index_stats(n, p, b, index))
+        lambda n, p, b, index, metric: _index_stats(n, p, b, index, metric))
     add("GET", "/{index}/_warmers", _get_warmers)
     add("GET", "/{index}/_warmers/{name}",
         lambda n, p, b, index, name: _get_warmer(n, p, b, index, name))
@@ -722,30 +723,104 @@ def _sum_stats(dicts):
     return out
 
 
-def _stats_envelope(n: Node, names) -> dict:
+# every section the IndicesStatsResponse carries; sections our runtime has
+# no meaningful numbers for report zeroed structures (they exist so metric
+# scoping and client consumers see the full 2.0 shape; fielddata stays
+# zero BY DESIGN — doc values are always device-resident)
+_STATS_SECTIONS = {
+    "docs": {"count": 0, "deleted": 0},
+    "store": {"size_in_bytes": 0, "throttle_time_in_millis": 0},
+    "indexing": {"index_total": 0, "index_time_in_millis": 0,
+                 "delete_total": 0},
+    "get": {"total": 0, "time_in_millis": 0},
+    "search": {"query_total": 0, "query_time_in_millis": 0,
+               "fetch_total": 0, "open_contexts": 0},
+    "merges": {"total": 0, "total_time_in_millis": 0},
+    "refresh": {"total": 0, "total_time_in_millis": 0},
+    "flush": {"total": 0, "total_time_in_millis": 0},
+    "warmer": {"current": 0, "total": 0, "total_time_in_millis": 0},
+    "filter_cache": {"memory_size_in_bytes": 0, "evictions": 0},
+    "id_cache": {"memory_size_in_bytes": 0},
+    "fielddata": {"memory_size_in_bytes": 0, "evictions": 0},
+    "percolate": {"total": 0, "time_in_millis": 0, "current": 0,
+                  "queries": 0},
+    "completion": {"size_in_bytes": 0},
+    "segments": {"count": 0, "memory_in_bytes": 0},
+    "translog": {"operations": 0, "size_in_bytes": 0},
+    "suggest": {"total": 0, "time_in_millis": 0, "current": 0},
+    "recovery": {"current_as_source": 0, "current_as_target": 0,
+                 "throttle_time_in_millis": 0},
+    "query_cache": {"memory_size_in_bytes": 0, "evictions": 0},
+}
+
+
+def _full_sections(st: dict) -> dict:
+    """Shard/primary stats dict -> all sections present (zero-filled)."""
+    import copy
+
+    out = copy.deepcopy(_STATS_SECTIONS)
+    for k, v in st.items():
+        if k in out and isinstance(v, dict):
+            out[k].update(v)
+    # store size: segment memory is the closest store analogue
+    if not out["store"]["size_in_bytes"]:
+        out["store"]["size_in_bytes"] = st.get("segments", {}).get(
+            "memory_in_bytes", 0)
+    return out
+
+
+def _stats_envelope(n: Node, names, metric: Optional[str] = None,
+                    level: str = "indices") -> dict:
     """IndicesStatsResponse shape: _shards + _all.primaries/total +
     per-index entries (total == primaries here: replica stats mirror the
-    primary in our replication model)."""
-    per = {nm: n.indices[nm].stats() for nm in names}
-    agg = _sum_stats(per.values())
-    return {
+    primary), every section present, metric-scoped when asked."""
+    per = {}
+    shards_per = {}
+    for nm in names:
+        raw = n.indices[nm].stats()
+        shard_stats = {sid: _full_sections(sh)
+                       for sid, sh in raw.get("shards", {}).items()}
+        total = _full_sections(_sum_stats(raw.get("shards", {}).values()))
+        per[nm] = total
+        shards_per[nm] = shard_stats
+    keep = None
+    if metric and metric not in ("_all", ""):
+        # metric name aliases the API accepts (merge -> merges section)
+        alias = {"merge": "merges", "doc": "docs", "warmers": "warmer"}
+        keep = {alias.get(m.strip(), m.strip())
+                for m in str(metric).split(",")}
+    def scope(st):
+        return ({k: v for k, v in st.items() if k in keep}
+                if keep else st)
+    agg = _full_sections(_sum_stats(per.values()))
+    out = {
         "_shards": _shards_header(n, names),
-        "_all": {"primaries": agg, "total": agg},
-        "indices": {nm: {"primaries": st, "total": st, **st}
+        "_all": {"primaries": scope(agg), "total": scope(agg)},
+        "indices": {nm: {"primaries": scope(st), "total": scope(st)}
                     for nm, st in per.items()},
     }
+    if level == "shards":
+        for nm in out["indices"]:
+            out["indices"][nm]["shards"] = {
+                sid: [scope(sh)] for sid, sh in shards_per[nm].items()}
+    elif level == "cluster":
+        out.pop("indices")  # cluster level: only the _all rollup
+    return out
 
 
 def _all_stats(n: Node) -> dict:
     return _stats_envelope(n, list(n.indices))
 
 
-def _index_stats(n: Node, p, b, index: str):
-    """GET /{index}/_stats with multi-index/wildcard expressions."""
+def _index_stats(n: Node, p, b, index: str, metric: Optional[str] = None):
+    """GET /{index}/_stats[/{metric}] with multi-index expressions and
+    level=indices|shards scoping."""
     names = n.resolve_indices(index)
-    if not names:
+    if not names and index not in (None, "", "_all", "*"):
         raise IndexNotFoundException(index)
-    return 200, _stats_envelope(n, names)
+    return 200, _stats_envelope(n, names,
+                                metric=metric or p.get("metric"),
+                                level=p.get("level", "indices"))
 
 
 

@@ -178,22 +178,6 @@ SKIP_TESTS = {
         'per-segment Lucene detail (version/compound/search flags) beyond our device-segment model',
     ('indices.stats/10_index.yaml', 'Index - star, no match'):
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/11_metric.yaml', 'Metric - _all'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/11_metric.yaml', 'Metric - blank'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/11_metric.yaml', 'Metric - multi'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/11_metric.yaml', 'Metric - one'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/11_metric.yaml', 'Metric - recovery'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/12_level.yaml', 'Level - blank'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/12_level.yaml', 'Level - cluster'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/12_level.yaml', 'Level - indices'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
     ('indices.stats/12_level.yaml', 'Level - shards'):
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
     ('indices.stats/13_fields.yaml', 'Completion - all metric'):
@@ -259,8 +243,6 @@ SKIP_TESTS = {
     ('indices.stats/14_groups.yaml', 'Groups - star'):
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
     ('indices.stats/15_types.yaml', 'Types - _all metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/15_types.yaml', 'Types - blank'):
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
     ('indices.stats/15_types.yaml', 'Types - indexing metric'):
         'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
