@@ -371,7 +371,8 @@ class IndexService:
 
         for sh in self.shards:
             sh.searcher.stats.on_suggest()
-        return execute_suggest(self.shards, body or {}, self.analysis)
+        return execute_suggest(self.shards, body or {}, self.analysis,
+                               mappings=self.mappings)
 
     # -- percolator ------------------------------------------------------------
 

@@ -57,6 +57,9 @@ class FieldMapping:
     # the field was declared with the 2.0 spelling `type: string`; to_json
     # echoes it back that way (internally it is text/keyword)
     legacy_string: bool = False
+    # completion suggester context mappings ({name: {type: category|geo,
+    # default, path, precision}}) — search/suggest.py filters on them
+    context: Optional[dict] = None
 
     @property
     def is_text(self) -> bool:
@@ -227,6 +230,7 @@ class Mappings:
             include_in_all=p.get("include_in_all"),
             index_options=p.get("index_options") if t == "dense_vector" else None,
             legacy_string=p.get("type") == "string",
+            context=p.get("context") if t == "completion" else None,
         )
         if t == "dense_vector" and fm.dims <= 0:
             raise MapperParsingException(f"dense_vector field [{full}] requires [dims]")
@@ -423,6 +427,8 @@ def _field_to_json(fm: FieldMapping) -> dict:
         out["null_value"] = fm.null_value
     if fm.type == "date":
         out["format"] = fm.fmt
+    if fm.type == "completion" and fm.context is not None:
+        out["context"] = fm.context
     if fm.type == "dense_vector":
         out["dims"] = fm.dims
         out["similarity"] = fm.similarity

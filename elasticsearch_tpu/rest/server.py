@@ -2077,7 +2077,8 @@ def _suggest_all(n: Node, p, b):
     from elasticsearch_tpu.search.suggest import execute_suggest_multi
 
     body = _json(b)
-    groups = [(svc.shards, svc.analysis) for svc in n.indices.values()]
+    groups = [(svc.shards, svc.analysis, svc.mappings)
+              for svc in n.indices.values()]
     res = execute_suggest_multi(groups, body)
     total = sum(len(svc.shards) for svc in n.indices.values())
     res["_shards"] = {"total": total, "successful": total, "failed": 0}

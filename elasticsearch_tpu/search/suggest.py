@@ -405,7 +405,7 @@ def _segment_completions(seg, field: str) -> Tuple[List[str], List[Tuple[int, fl
     if field in cache:
         return cache[field]
     inputs: List[str] = []
-    meta: List[Tuple[int, float, str, Any]] = []
+    meta: List[Tuple[int, float, str, Any, Any]] = []
     for doc in range(seg.num_docs):
         stored = seg.stored[doc] if doc < len(seg.stored) else None
         if not stored or field not in stored:
@@ -419,9 +419,10 @@ def _segment_completions(seg, field: str) -> Tuple[List[str], List[Tuple[int, fl
             output = entry.get("output") or (ins[0] if ins else "")
             weight = float(entry.get("weight", 1))
             payload = entry.get("payload")
+            ctx = entry.get("context")
             for s in ins:
                 inputs.append(s.lower())
-                meta.append((doc, weight, output, payload))
+                meta.append((doc, weight, output, payload, ctx))
     order = sorted(range(len(inputs)), key=lambda i: inputs[i])
     inputs = [inputs[i] for i in order]
     meta = [meta[i] for i in order]
@@ -429,11 +430,102 @@ def _segment_completions(seg, field: str) -> Tuple[List[str], List[Tuple[int, fl
     return inputs, meta
 
 
-def completion_suggest(shards, prefix: str, opts: dict) -> List[dict]:
+_GEOHASH32 = "0123456789bcdefghjkmnpqrstuvwxyz"
+
+
+def _geohash(lat: float, lon: float, length: int) -> str:
+    """Standard geohash (base32 interleaved bisection) — the cell scheme
+    the reference's geo context uses (GeoHashUtils)."""
+    lat_r, lon_r = [-90.0, 90.0], [-180.0, 180.0]
+    bits, bit, even = 0, 0, True
+    out = []
+    while len(out) < length:
+        if even:
+            mid = (lon_r[0] + lon_r[1]) / 2
+            if lon >= mid:
+                bits = (bits << 1) | 1
+                lon_r[0] = mid
+            else:
+                bits <<= 1
+                lon_r[1] = mid
+        else:
+            mid = (lat_r[0] + lat_r[1]) / 2
+            if lat >= mid:
+                bits = (bits << 1) | 1
+                lat_r[0] = mid
+            else:
+                bits <<= 1
+                lat_r[1] = mid
+        even = not even
+        bit += 1
+        if bit == 5:
+            out.append(_GEOHASH32[bits])
+            bits, bit = 0, 0
+    return "".join(out)
+
+
+# ES precision table: geohash length whose cell edge is <= the distance
+_GEO_PRECISION_KM = [(5000, 1), (1250, 2), (156, 3), (39.1, 4), (4.9, 5),
+                     (1.2, 6), (0.153, 7), (0.038, 8)]
+
+
+def _geo_len(precision) -> int:
+    if isinstance(precision, int):
+        return max(1, min(int(precision), 12))
+    from elasticsearch_tpu.search.geo import parse_distance
+
+    km = parse_distance(precision) / 1000.0
+    for edge, ln in reversed(_GEO_PRECISION_KM):
+        if km <= edge:
+            return ln
+    return 1
+
+
+def _ctx_point(v):
+    if isinstance(v, dict):
+        return float(v["lat"]), float(v.get("lon", v.get("lng")))
+    if isinstance(v, (list, tuple)):
+        return float(v[1]), float(v[0])  # GeoJSON order
+    raise ElasticsearchTpuException(f"cannot parse geo context [{v}]")
+
+
+def _context_match(cfgs: dict, entry_ctx, doc_src, query_ctx) -> bool:
+    """One completion entry vs the request's context values (reference:
+    context/CategoryContextMapping + GeolocationContextMapping)."""
+    for name, cfg in (cfgs or {}).items():
+        want = (query_ctx or {}).get(name)
+        if want is None:
+            continue
+        have = (entry_ctx or {}).get(name)
+        if have is None and cfg.get("path"):
+            have = (doc_src or {}).get(cfg["path"])
+        if have is None:
+            have = cfg.get("default")
+        if cfg.get("type") == "geo":
+            ln = _geo_len(cfg.get("precision", 6))
+            if have is None:
+                return False
+            wlat, wlon = _ctx_point(want)
+            hlat, hlon = _ctx_point(have)
+            if _geohash(wlat, wlon, ln) != _geohash(hlat, hlon, ln):
+                return False
+        else:  # category
+            haves = have if isinstance(have, list) else [have]
+            wants = want if isinstance(want, list) else [want]
+            if not set(map(str, wants)) & set(map(str, haves)):
+                return False
+    return True
+
+
+def completion_suggest(shards, prefix: str, opts: dict,
+                       mappings=None) -> List[dict]:
     field = opts.get("field")
     if not field:
         raise ElasticsearchTpuException("suggester [completion] requires a [field]")
     size = int(opts.get("size", 5))
+    query_ctx = opts.get("context")
+    fm = mappings.get(field) if mappings is not None else None
+    ctx_cfg = getattr(fm, "context", None) if fm is not None else None
     fuzzy = opts.get("fuzzy")
     # "fuzzy": {} and "fuzzy": true are both valid request-default forms
     if fuzzy is True or fuzzy == {}:
@@ -460,8 +552,13 @@ def completion_suggest(shards, prefix: str, opts: dict) -> List[dict]:
                     hi += 1
                 idx = range(lo, hi)
             for i in idx:
-                doc, weight, output, payload = meta[i]
+                doc, weight, output, payload, ectx = meta[i]
                 if not seg.live_host[doc]:
+                    continue
+                if query_ctx and ctx_cfg and not _context_match(
+                        ctx_cfg, ectx,
+                        seg.sources[doc] if doc < len(seg.sources) else None,
+                        query_ctx):
                     continue
                 cur = collected.get(output)
                 if cur is None or weight > cur["score"]:
@@ -480,7 +577,7 @@ def completion_suggest(shards, prefix: str, opts: dict) -> List[dict]:
 SUGGEST_KINDS = ("term", "phrase", "completion")
 
 
-def execute_suggest(shards, body: dict, analysis) -> dict:
+def execute_suggest(shards, body: dict, analysis, mappings=None) -> dict:
     """Run a suggest body (reference: SuggestPhase.java execute()).
 
     ``shards`` are IndexShard-likes exposing .segments and .searcher.
@@ -505,7 +602,8 @@ def execute_suggest(shards, body: dict, analysis) -> dict:
         elif kind == "phrase":
             out[name] = phrase_suggest(shards, text, opts, analysis)
         else:
-            out[name] = completion_suggest(shards, text, opts)
+            out[name] = completion_suggest(shards, text, opts,
+                                           mappings=mappings)
     return out
 
 
@@ -515,11 +613,13 @@ def execute_suggest_multi(groups, body: dict) -> dict:
     (text, offset) are merged and their options re-ranked — the same shape
     of merge the reference does across shard responses in SuggestPhase.
 
-    ``groups`` is an iterable of (shards, analysis) pairs.
+    ``groups`` is an iterable of (shards, analysis[, mappings]) tuples.
     """
     merged: Dict[str, List[dict]] = {}
-    for shards, analysis in groups:
-        res = execute_suggest(shards, body, analysis)
+    for group in groups:
+        shards, analysis = group[0], group[1]
+        mappings = group[2] if len(group) > 2 else None
+        res = execute_suggest(shards, body, analysis, mappings=mappings)
         for name, entries in res.items():
             if name not in merged:
                 merged[name] = entries

@@ -286,14 +286,6 @@ SKIP_TESTS = {
         'search tail: typed-search response details and significant-terms background stats',
     ('search/test_sig_terms.yaml', 'Default index'):
         'search tail: typed-search response details and significant-terms background stats',
-    ('suggest/20_context.yaml', 'Category suggest context default path should work'):
-        'context suggester (category/geo contexts) not implemented — documented suggester scope is term/phrase/completion',
-    ('suggest/20_context.yaml', 'Geo suggest should work'):
-        'context suggester (category/geo contexts) not implemented — documented suggester scope is term/phrase/completion',
-    ('suggest/20_context.yaml', 'Hardcoded category value should work'):
-        'context suggester (category/geo contexts) not implemented — documented suggester scope is term/phrase/completion',
-    ('suggest/20_context.yaml', 'Simple context suggestion should work'):
-        'context suggester (category/geo contexts) not implemented — documented suggester scope is term/phrase/completion',
     ('template/10_basic.yaml', 'Indexed template'):
         'search-template stored-template render edge (mustache sections)',
     ('template/20_search.yaml', 'Indexed Template query tests'):
