@@ -133,7 +133,7 @@ def _register_all(rc: RestController):
     # root / info / health
     add("GET", "/", lambda n, p, b: (200, n.info()))
     add("HEAD", "/", lambda n, p, b: (200, None))
-    add("GET", "/_cluster/health", lambda n, p, b: (200, n.cluster_state.health()))
+    add("GET", "/_cluster/health", _cluster_health)
     add("GET", "/_cluster/state", lambda n, p, b: (200, n.cluster_state.to_json()))
     add("GET", "/_cluster/stats", _cluster_stats)
     add("GET", "/_nodes/stats", lambda n, p, b: (200, n.nodes_stats()))
@@ -234,7 +234,7 @@ def _register_all(rc: RestController):
         lambda n, p, b, scroll_id: _clear_scroll(
             n, {**p, "scroll_id": scroll_id}, b))  # body ids win
     add("GET", "/_cluster/health/{index}",
-        lambda n, p, b, index: (200, n.cluster_state.health()))
+        lambda n, p, b, index: _cluster_health(n, p, b))
     add("GET", "/_cluster/state/{metric}", _cluster_state_metric)
     add("GET", "/_cluster/state/{metric}/{index}",
         lambda n, p, b, metric, index: _cluster_state_metric(n, p, b, metric))
@@ -1929,7 +1929,8 @@ def _explain(n: Node, p, b, index: str, id: str):
     prepare_tree(query, shard.segments, svc.mappings, svc.analysis)
     loc = shard.engine._locations.get(str(id))
     if loc is None or loc.deleted or loc.where == "buffer":
-        return 404, {"_index": index, "_id": id, "matched": False}
+        return 404, {"_index": svc.name, "_type": "_doc", "_id": id,
+                     "matched": False}
     for seg in shard.segments:
         if seg.seg_id == loc.where:
             ctx = SegmentContext(seg, svc.mappings, svc.analysis)
@@ -1937,14 +1938,17 @@ def _explain(n: Node, p, b, index: str, id: str):
             matched = bool(np.asarray(mask)[loc.local_id])
             score = float(np.asarray(scores)[loc.local_id])
             return 200, {
-                "_index": index, "_id": id, "matched": matched,
+                "_index": svc.name,
+                "_type": (loc.doc_type or "_doc"),
+                "_id": id, "matched": matched,
                 "explanation": {
                     "value": score if matched else 0.0,
                     "description": "sum of per-term BM25 impact scores (tpu segment program)",
                     "details": [],
                 },
             }
-    return 404, {"_index": index, "_id": id, "matched": False}
+    return 404, {"_index": svc.name, "_type": "_doc", "_id": id,
+                 "matched": False}
 
 
 def _resolve_template(n: Node, body: dict):
@@ -2260,6 +2264,31 @@ def _cluster_put_settings(n: Node, p, b):
     return 200, {"acknowledged": True,
                  "persistent": n.cluster_settings["persistent"],
                  "transient": n.cluster_settings["transient"]}
+
+
+def _cluster_health(n: Node, p, b):
+    """RestClusterHealthAction: the health summary + pending-task gauges;
+    level=indices adds per-index sections (our single-node health is
+    uniform, so each index reports its own shard counts)."""
+    h = dict(n.cluster_state.health())
+    h.setdefault("number_of_pending_tasks", 0)
+    h.setdefault("number_of_in_flight_fetch", 0)
+    h.setdefault("delayed_unassigned_shards", 0)
+    h.setdefault("task_max_waiting_in_queue_millis", 0)
+    if p.get("level") in ("indices", "shards"):
+        idx = {}
+        for name, svc in n.indices.items():
+            idx[name] = {
+                "status": "green", "number_of_shards": svc.num_shards,
+                "number_of_replicas": svc.num_replicas,
+                "active_primary_shards": svc.num_shards,
+                "active_shards": svc.num_shards
+                * (1 + svc.num_replicas),
+                "relocating_shards": 0, "initializing_shards": 0,
+                "unassigned_shards": 0,
+            }
+        h["indices"] = idx
+    return 200, h
 
 
 def _cluster_state_metric(n: Node, p, b, metric: str):
@@ -2819,16 +2848,21 @@ def _type_name_matches(svc, pat: str):
 
 def _get_mapping_typed(n: Node, p, b, index: Optional[str], type: str):
     """GET [/{index}]/_mapping/{type}: mappings keyed by the matched type
-    names (404 when nothing matches, like RestGetMappingAction)."""
+    names. A missing INDEX 404s; a missing type reads back {} (the
+    RestGetMappingAction distinction)."""
+    names = n.resolve_indices(index)
+    if not names and index not in (None, "", "_all", "*") \
+            and "*" not in str(index):
+        raise IndexNotFoundException(index)
     out = {}
-    for iname in n.resolve_indices(index):
+    for iname in names:
         svc = n.indices[iname]
-        names = _type_name_matches(svc, type)
-        if names:
+        tnames = _type_name_matches(svc, type)
+        if tnames:
             mj = svc.mappings.to_json()
-            out[iname] = {"mappings": {t: mj for t in names}}
+            out[iname] = {"mappings": {t: mj for t in tnames}}
     if not out:
-        return 404, {"error": f"type[[{type}]] missing", "status": 404}
+        return 200, {}  # missing types read back empty (RestGetMapping)
     return 200, out
 
 

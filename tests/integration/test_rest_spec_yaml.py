@@ -52,10 +52,6 @@ SKIP_TESTS = {
         "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
     ('cat.thread_pool/10_basic.yaml', 'Test cat thread_pool output'):
         "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
-    ('cluster.health/10_basic.yaml', 'cluster health basic test'):
-        'health wait_for/level detail (per-index/shard health sections) beyond the single-node summary',
-    ('cluster.health/10_basic.yaml', 'cluster health basic test, one index'):
-        'health wait_for/level detail (per-index/shard health sections) beyond the single-node summary',
     ('cluster.health/10_basic.yaml', 'cluster health levels'):
         'health wait_for/level detail (per-index/shard health sections) beyond the single-node summary',
     ('cluster.reroute/11_explain.yaml', 'Explain API for non-existent node & shard'):
@@ -88,10 +84,6 @@ SKIP_TESTS = {
         'delete tail: shard-header detail, refresh/missing edge semantics',
     ('delete/50_refresh.yaml', 'Refresh'):
         'delete tail: shard-header detail, refresh/missing edge semantics',
-    ('explain/10_basic.yaml', 'Basic explain'):
-        'explain response detail (description text shapes) and source filtering on explain',
-    ('explain/10_basic.yaml', 'Basic explain with alias'):
-        'explain response detail (description text shapes) and source filtering on explain',
     ('explain/20_source_filtering.yaml', 'Source filtering'):
         'explain response detail (description text shapes) and source filtering on explain',
     ('field_stats/10_basics.yaml', 'Basic field stats'):
@@ -142,8 +134,6 @@ SKIP_TESTS = {
         'field-mapping include_defaults and multi_field full_name echo',
     ('indices.get_field_mapping/50_field_wildcards.yaml', 'Get field mapping with wildcarded relative names'):
         'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_mapping/20_missing_type.yaml', "Return empty response when type doesn't exist"):
-        'typed-mapping miss/wildcard response shapes beyond the single-type echo',
     ('indices.get_mapping/50_wildcard_expansion.yaml', 'Get test-* with wildcard_expansion=none'):
         'typed-mapping miss/wildcard response shapes beyond the single-type echo',
     ('indices.get_settings/10_basic.yaml', 'Get /{index}/_settings/_all'):
