@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -48,6 +50,85 @@ K1, B = 1.2, 0.75
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+def resolve_backend(probe_timeout: float = 75.0, tries: int = 3):
+    """Decide which jax backend this run will use WITHOUT risking a hang.
+
+    The registered tunnel plugin ("axon") retries forever inside
+    ``jax.devices()`` when the TPU tunnel is down, so the r4 capture died
+    rc=1/never-returned at `jax.devices()` (VERDICT r4 weak #2). Probe the
+    backend in a SUBPROCESS with a hard timeout, retrying with backoff; on
+    persistent failure force ``JAX_PLATFORMS=cpu`` so the bench still
+    produces a parseable record (CPU sanity numbers + the failure mode)
+    instead of a bare traceback.
+
+    Returns (backend, error): backend is the platform string ("tpu",
+    "cpu", ...) or "cpu-fallback"; error is the last probe failure text.
+    """
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        return "cpu", None
+    last_err = None
+    for attempt in range(tries):
+        platform, last_err = _probe_once(probe_timeout)
+        if platform is not None:
+            return platform, None
+        log(f"backend probe {attempt + 1}/{tries} failed: {last_err}")
+        if attempt < tries - 1:
+            backoff = 15.0 * (attempt + 1)
+            log(f"retrying backend probe in {backoff:.0f}s")
+            time.sleep(backoff)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu-fallback", last_err
+
+
+def _probe_once(probe_timeout: float):
+    """One subprocess probe → (platform | None, error | None).
+
+    The probe runs in its own session with output to temp files, and on
+    timeout the whole process GROUP is killed: with pipes + subprocess.run a
+    tunnel helper grandchild holding the pipe open would block communicate()
+    past the timeout (Python gh-81605) and re-introduce the hang this exists
+    to prevent.
+    """
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out, \
+            tempfile.TemporaryFile("w+") as err:
+        try:
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+                stdout=out, stderr=err, text=True, start_new_session=True)
+        except Exception as e:  # pragma: no cover - env-specific
+            return None, f"{type(e).__name__}: {e}"
+        try:
+            rc = p.wait(timeout=probe_timeout)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except Exception:
+                p.kill()
+            p.wait()
+            return None, (f"backend probe timed out after "
+                          f"{probe_timeout:.0f}s (TPU tunnel down?)")
+        out.seek(0)
+        for line in out.read().splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1], None
+        err.seek(0)
+        return None, (err.read().strip()[-400:]
+                      or f"probe exited rc={rc} with no platform")
+
+
+def emit_record(payload: dict) -> None:
+    """The ONE stdout JSON line the driver records — always parseable."""
+    base = {"metric": "bm25_batched_qps", "value": 0.0, "unit": "qps",
+            "vs_baseline": 0.0}
+    base.update(payload)
+    print(json.dumps(base), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -330,16 +411,70 @@ def main():
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--skip-knn", action="store_true")
+    ap.add_argument("--probe-timeout", type=float, default=75.0)
     args = ap.parse_args()
+
+    backend, backend_err = resolve_backend(probe_timeout=args.probe_timeout)
+    if backend == "cpu-fallback":
+        log(f"TPU backend unreachable ({backend_err}) — CPU sanity mode "
+            f"with a reduced workload so the record still lands")
+        defaults = ap.parse_args([])
+        if args.docs == defaults.docs:
+            args.docs = 1 << 16
+        if args.vecs == defaults.vecs:
+            args.vecs = 1 << 16
+        if args.batch_queries == defaults.batch_queries:
+            args.batch_queries = 256
 
     from elasticsearch_tpu.utils.platform import (enable_compilation_cache,
                                                    ensure_cpu_if_requested)
 
     ensure_cpu_if_requested()
     enable_compilation_cache()  # amortize the per-shape compile zoo
+    import threading
+
     import jax
 
-    log(f"devices: {jax.devices()}")
+    # the tunnel can drop BETWEEN the successful probe and this process's
+    # own backend init, where jax.devices() retries forever — a watchdog
+    # thread cannot interrupt the hung call, so it emits the record and
+    # hard-exits instead of silently recurring the r4 rc=1/no-output run
+    booted = threading.Event()
+
+    def _watchdog():
+        if not booted.wait(args.probe_timeout * 2):
+            emit_record({
+                "backend": backend,
+                "backend_error": "in-process backend init hung after a "
+                                 "successful probe (tunnel dropped?)",
+                "target_met": False,
+            })
+            os._exit(1)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    log(f"backend: {backend}; devices: {jax.devices()}")
+    booted.set()
+    try:
+        payload = run_bench(args, jax)
+    except Exception:
+        import traceback
+
+        tb = traceback.format_exc()
+        log(tb)
+        emit_record({
+            "backend": backend,
+            "backend_error": backend_err,
+            "error": tb.strip().splitlines()[-1][:400],
+            "target_met": False,
+        })
+        sys.exit(1)  # stdout stays parseable; rc still signals the crash
+    payload["backend"] = backend
+    if backend_err:
+        payload["backend_error"] = backend_err
+    emit_record(payload)
+
+
+def run_bench(args, jax) -> dict:
     t_start = time.perf_counter()
     # per-call dispatch floor: the minimum round trip of ANY device call on
     # this host↔device link (tunneled chips: network RTT). Single-query
@@ -535,7 +670,7 @@ def main():
     # network-tunneled chip per-call dispatch RTT dominates single-query
     # latency (see p50_ms vs batched amortization).
     cpu_qps = 1000.0 / cpu_p50 if cpu_p50 > 0 else 1.0
-    print(json.dumps({
+    return {
         "metric": "bm25_batched_qps",
         "value": round(batched_qps, 1),
         "unit": "qps",
@@ -556,7 +691,7 @@ def main():
         "target_met": bool(vs >= 8.0),
         "docs": args.docs,
         "knn": knn,
-    }))
+    }
 
 
 if __name__ == "__main__":
