@@ -72,6 +72,21 @@ class MultiHostCluster:
         self._adopted_version = -1
         self._stop = threading.Event()
         self._fd_thread: Optional[threading.Thread] = None
+        self._indices_lock = threading.Lock()
+        # indices metadata is versioned separately from membership so a
+        # stale join reply can't roll back a newer publish (same reason
+        # _adopt guards with _adopted_version)
+        self._indices_version = 0
+        self._indices_adopted = -1
+        # distributed index metadata: name -> {body, num_shards,
+        # assignment {shard_id_str: node_id}} — master-authoritative,
+        # carried on join replies and publishes (the routing-table slice of
+        # the reference's published ClusterState)
+        self.dist_indices: dict = {}
+        from elasticsearch_tpu.cluster.search_action import \
+            DistributedDataService
+
+        self.data = DistributedDataService(self)
         self.transport.register("cluster:publish", self._on_publish)
         if rank == 0:
             self.transport.register("cluster:join", self._on_join)
@@ -103,6 +118,8 @@ class MultiHostCluster:
 
                     time.sleep(min(0.2 * (attempt + 1), 2.0))
             self._adopt(got["nodes"], got.get("version", 0))
+            self._adopt_indices(got.get("indices", {}),
+                                got.get("indices_version", 0))
 
     # -- master handlers ----------------------------------------------------
 
@@ -114,7 +131,9 @@ class MultiHostCluster:
         return {"nodes": [_node_json(n)
                           for n in self.node.cluster_state.nodes.values()],
                 "master": self.node.cluster_state.master_node_id,
-                "version": self.node.cluster_state.version}
+                "version": self.node.cluster_state.version,
+                "indices": self.dist_indices,
+                "indices_version": self._indices_version}
 
     def _on_leave(self, payload: dict) -> dict:
         self.discovery.leave(payload["node_id"])
@@ -123,7 +142,31 @@ class MultiHostCluster:
 
     def _on_publish(self, payload: dict) -> dict:
         self._adopt(payload["nodes"], payload.get("version", 0))
+        if "indices" in payload:
+            self._adopt_indices(payload["indices"],
+                                payload.get("indices_version", 0))
         return {"ok": True}
+
+    def _adopt_indices(self, meta: dict, version: int) -> None:
+        """Adopt the master's index metadata; create any index this process
+        doesn't hold yet (every process keeps the full S-shard layout so
+        shard numbering agrees with shard_id_for everywhere — only owned
+        shards ever receive documents). Locked: the join-reply path and a
+        concurrent publish handler must not both create the same index; the
+        version check stops a stale join reply regressing a newer publish."""
+        with self._indices_lock:
+            if version <= self._indices_adopted:
+                return
+            self._indices_adopted = version
+            self.dist_indices = meta
+            for name, spec in meta.items():
+                if not self.node.index_exists(name):
+                    self.node.create_index(name, spec.get("body"))
+
+    def publish_indices(self) -> None:
+        self._indices_version += 1
+        self.node.cluster_state.next_version()  # order vs membership publishes
+        self._publish()
 
     def _adopt(self, nodes: List[dict], version: int) -> None:
         """Replace the local membership view with the master's publication
@@ -157,7 +200,9 @@ class MultiHostCluster:
             try:
                 self.transport.send_remote(
                     (host, int(port)), "cluster:publish",
-                    {"nodes": nodes, "version": version})
+                    {"nodes": nodes, "version": version,
+                     "indices": self.dist_indices,
+                     "indices_version": self._indices_version})
             except Exception:
                 pass  # fault detection will reap it
 

@@ -1,0 +1,430 @@
+"""Cross-host data plane: routed writes + query-then-fetch search actions.
+
+Reference:
+- action/search/type/TransportSearchQueryThenFetchAction.java:1-140 — the
+  coordinator scatters a query phase to every shard, merges the ranked
+  candidates, then fetches ONLY the selected page by search-context id.
+- search/action/SearchServiceTransportAction.java:1-120 — the per-node
+  wire actions those phases ride.
+- action/index/TransportIndexAction.java + routing/OperationRouting —
+  writes hash-routed to the shard's owner node.
+
+TPU mapping: WITHIN a process, an index's local shards execute as the
+mesh/shard_map product path (parallel/); BETWEEN processes these JSON
+transport actions carry query/fetch/write requests the way the reference
+rides netty. Per-node query results are small (top-k ids + scores + packed
+agg partials — never per-doc columns), so a cross-host search costs one
+RTT per phase, not per document.
+
+Shard ownership lives in the master-published index metadata
+(`MultiHostCluster.dist_indices`): shard i of an S-shard index is owned by
+`sorted(node_ids)[i % world]` at creation time. Every process creates the
+full S-shard index locally (mappings and shard numbering must agree with
+`cluster/routing.py::shard_id_for` everywhere); only the owned shards ever
+hold documents.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticsearch_tpu.cluster.routing import shard_id_for
+from elasticsearch_tpu.cluster.transport import TransportError
+from elasticsearch_tpu.utils import wire
+from elasticsearch_tpu.utils.errors import (ElasticsearchTpuException,
+                                            IndexNotFoundException)
+
+ACTION_QUERY = "indices:data/read/search[phase/query]"
+ACTION_FETCH = "indices:data/read/search[phase/fetch]"
+ACTION_FREE = "indices:data/read/search[free_context]"
+ACTION_INDEX = "indices:data/write/index"
+ACTION_DELETE = "indices:data/write/delete"
+ACTION_GET = "indices:data/read/get"
+ACTION_REFRESH = "indices:admin/refresh"
+ACTION_CREATE = "indices:admin/create"
+
+_CONTEXT_TTL = 120.0
+
+
+class DistributedDataService:
+    """Per-process endpoint + coordinator for cross-host data operations."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.node = cluster.node
+        # search contexts: cid -> {"pairs": [(searcher, ShardDoc)], "born": t}
+        self._contexts: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        t = cluster.transport
+        t.register(ACTION_QUERY, self._on_query)
+        t.register(ACTION_FETCH, self._on_fetch)
+        t.register(ACTION_FREE, self._on_free)
+        t.register(ACTION_INDEX, self._on_index)
+        t.register(ACTION_DELETE, self._on_delete)
+        t.register(ACTION_GET, self._on_get)
+        t.register(ACTION_REFRESH, self._on_refresh)
+        t.register(ACTION_CREATE, self._on_create)
+
+    # -- ownership -----------------------------------------------------------
+
+    def _meta(self, index: str) -> dict:
+        meta = self.cluster.dist_indices.get(index)
+        if meta is None:
+            raise IndexNotFoundException(index)
+        return meta
+
+    def owner_of(self, index: str, shard_id: int) -> str:
+        return self._meta(index)["assignment"][str(shard_id)]
+
+    def _local_id(self) -> str:
+        return self.cluster.local.node_id
+
+    def _addr(self, node_id: str) -> Tuple[str, int]:
+        n = self.node.cluster_state.nodes.get(node_id)
+        if n is None or ":" not in n.transport_address:
+            raise TransportError(f"node [{node_id}] has no transport address")
+        host, port = n.transport_address.rsplit(":", 1)
+        return host, int(port)
+
+    def _send(self, node_id: str, action: str, payload: dict,
+              timeout: float = 30.0) -> Any:
+        return self.cluster.transport.send_remote(
+            self._addr(node_id), action, payload, timeout=timeout)
+
+    # -- admin ---------------------------------------------------------------
+
+    def create_index(self, name: str, body: Optional[dict] = None) -> dict:
+        """Create an index with shards assigned round-robin across the
+        current members (reference: MetaDataCreateIndexService + the
+        allocation pass). Master performs it; others route to the master."""
+        if not self.cluster.is_master:
+            return self.cluster.transport.send_remote(
+                self.cluster.master_addr, ACTION_CREATE,
+                {"name": name, "body": body})
+        return self._on_create({"name": name, "body": body})
+
+    def _on_create(self, payload: dict) -> dict:
+        name, body = payload["name"], payload.get("body") or {}
+        if name in self.cluster.dist_indices:
+            # re-creating would recompute the assignment over the CURRENT
+            # membership and orphan every doc routed under the old one
+            from elasticsearch_tpu.utils.errors import \
+                IndexAlreadyExistsException
+
+            raise IndexAlreadyExistsException(name)
+        nodes = sorted(self.node.cluster_state.nodes)
+        num_shards = int((body.get("settings") or {})
+                         .get("number_of_shards", 1))
+        assignment = {str(i): nodes[i % len(nodes)]
+                      for i in range(num_shards)}
+        self.cluster.dist_indices[name] = {
+            "body": body, "num_shards": num_shards, "assignment": assignment}
+        if not self.node.index_exists(name):
+            self.node.create_index(name, body)
+        self.cluster.publish_indices()
+        return {"acknowledged": True, "index": name,
+                "assignment": assignment}
+
+    def refresh(self, index: str) -> None:
+        self._meta(index)
+        self.node.indices[index].refresh()
+        for nid in self._other_nodes():
+            self._send(nid, ACTION_REFRESH, {"index": index})
+
+    def _other_nodes(self) -> List[str]:
+        me = self._local_id()
+        return [nid for nid, n in
+                sorted(self.node.cluster_state.nodes.items())
+                if nid != me and ":" in n.transport_address]
+
+    def _on_refresh(self, payload: dict) -> dict:
+        self.node.indices[payload["index"]].refresh()
+        return {"ok": True}
+
+    # -- routed writes / reads ----------------------------------------------
+
+    def index_doc(self, index: str, doc_id: Optional[str], source: dict,
+                  routing: Optional[str] = None, **kw) -> dict:
+        meta = self._meta(index)
+        if doc_id is None:
+            doc_id = uuid.uuid4().hex  # route on the final id, as the owner will
+        sid = shard_id_for(doc_id, meta["num_shards"], routing)
+        owner = meta["assignment"][str(sid)]
+        if owner == self._local_id():
+            return self.node.indices[index].index_doc(
+                doc_id, source, routing=routing, **kw)
+        return self._send(owner, ACTION_INDEX,
+                          {"index": index, "id": doc_id, "source": source,
+                           "routing": routing, "kw": kw})
+
+    def _on_index(self, payload: dict) -> dict:
+        return self.node.indices[payload["index"]].index_doc(
+            payload["id"], payload["source"], routing=payload.get("routing"),
+            **(payload.get("kw") or {}))
+
+    def delete_doc(self, index: str, doc_id: str,
+                   routing: Optional[str] = None) -> dict:
+        meta = self._meta(index)
+        sid = shard_id_for(doc_id, meta["num_shards"], routing)
+        owner = meta["assignment"][str(sid)]
+        if owner == self._local_id():
+            return self.node.indices[index].delete_doc(doc_id, routing=routing)
+        return self._send(owner, ACTION_DELETE,
+                          {"index": index, "id": doc_id, "routing": routing})
+
+    def _on_delete(self, payload: dict) -> dict:
+        return self.node.indices[payload["index"]].delete_doc(
+            payload["id"], routing=payload.get("routing"))
+
+    def get_doc(self, index: str, doc_id: str,
+                routing: Optional[str] = None) -> dict:
+        meta = self._meta(index)
+        sid = shard_id_for(doc_id, meta["num_shards"], routing)
+        owner = meta["assignment"][str(sid)]
+        if owner == self._local_id():
+            return self.node.indices[index].get_doc(doc_id, routing=routing)
+        return self._send(owner, ACTION_GET,
+                          {"index": index, "id": doc_id, "routing": routing})
+
+    def _on_get(self, payload: dict) -> dict:
+        return self.node.indices[payload["index"]].get_doc(
+            payload["id"], routing=payload.get("routing"))
+
+    # -- query phase (remote endpoint) ---------------------------------------
+
+    def _on_query(self, payload: dict) -> dict:
+        """Run the query phase on the requested LOCAL shards; park the
+        candidate docs under a context id for the fetch phase (reference:
+        SearchService.executeQueryPhase → QuerySearchResult with id)."""
+        index, body = payload["index"], payload.get("body") or {}
+        shard_ids = payload["shards"]
+        svc = self.node.indices.get(index)
+        if svc is None:
+            raise IndexNotFoundException(index)
+        self._prune_contexts()
+        pairs: List[Tuple[Any, Any]] = []
+        shards_out = []
+        agg_lists: List[dict] = []
+        for sid in shard_ids:
+            searcher = svc.groups[sid].reader().searcher
+            r = searcher.query_phase(body)
+            docs_out = []
+            for d in r.docs:
+                docs_out.append({
+                    "pos": len(pairs), "shard": sid,
+                    "score": None if np.isnan(d.score) else float(d.score),
+                    "sort": wire.pack(list(d.sort_values)),
+                })
+                pairs.append((searcher, d))
+            shards_out.append({
+                "shard": sid, "total": r.total_hits,
+                "max_score": (None if np.isnan(r.max_score)
+                              else float(r.max_score)),
+                "docs": docs_out,
+                "timed_out": r.timed_out,
+                "terminated_early": r.terminated_early,
+            })
+            if r.agg_partials:
+                agg_lists.extend(r.agg_partials["_list"])
+        cid = uuid.uuid4().hex
+        with self._lock:
+            self._contexts[cid] = {"pairs": pairs, "body": body,
+                                   "index": index, "born": time.time()}
+        return {"context_id": cid, "shards": shards_out,
+                "aggs": wire.pack(agg_lists) if agg_lists else None}
+
+    def _on_fetch(self, payload: dict) -> List[dict]:
+        """Fetch-phase endpoint: resolve context positions → hit JSON
+        (reference: SearchService.executeFetchPhase by context id).
+        The context is freed after serving — cross-host scroll keeps its
+        state on the coordinator, never here."""
+        with self._lock:
+            ctx = self._contexts.pop(payload["context_id"], None)
+        if ctx is None:
+            from elasticsearch_tpu.utils.errors import \
+                SearchContextMissingException
+
+            raise SearchContextMissingException(payload["context_id"])
+        positions: List[int] = payload["positions"]
+        hit_of = _fetch_grouped(
+            [(p,) + ctx["pairs"][p] for p in positions],
+            ctx["body"], ctx["index"])
+        return [hit_of[p] for p in positions]
+
+    def _on_free(self, payload: dict) -> dict:
+        with self._lock:
+            self._contexts.pop(payload["context_id"], None)
+        return {"ok": True}
+
+    def _prune_contexts(self) -> None:
+        now = time.time()
+        with self._lock:
+            for cid in [c for c, v in self._contexts.items()
+                        if now - v["born"] > _CONTEXT_TTL]:
+                del self._contexts[cid]
+
+    def _free_remote(self, remote_ctx: Dict[str, str]) -> None:
+        for owner, cid in remote_ctx.items():
+            try:
+                self._send(owner, ACTION_FREE, {"context_id": cid},
+                           timeout=5.0)
+            except Exception:
+                pass  # TTL pruning on the owner collects it
+
+    # -- coordinator ---------------------------------------------------------
+
+    def search(self, index: str, body: Optional[dict] = None) -> dict:
+        """Scatter the query phase over every shard owner, merge ranked
+        candidates, fetch the selected page from each owner, reduce aggs.
+        Mirrors TransportSearchQueryThenFetchAction's three steps."""
+        from elasticsearch_tpu.search.aggregations.base import (parse_aggs,
+                                                                reduce_aggs)
+        from elasticsearch_tpu.search.service import (_parse_sort, _sort_key)
+
+        body = body or {}
+        t0 = time.perf_counter()
+        meta = self._meta(index)
+        local_id = self._local_id()
+        by_owner: Dict[str, List[int]] = {}
+        for sid in range(meta["num_shards"]):
+            by_owner.setdefault(meta["assignment"][str(sid)], []).append(sid)
+        sort_spec = _parse_sort(body.get("sort"))
+        size = int(body.get("size", 10))
+        frm = int(body.get("from", 0))
+
+        entries: List[dict] = []
+        agg_lists: List[dict] = []
+        remote_ctx: Dict[str, str] = {}
+        total = 0
+        max_score = float("-inf")
+        timed_out = False
+        terminated = False
+        # per-shard failures are collected, not fatal, matching the
+        # reference's ShardSearchFailure accounting — unless EVERY shard
+        # failed, in which case the search as a whole is an error
+        failed: List[dict] = []
+        owner_order = {nid: i for i, nid in enumerate(sorted(by_owner))}
+        svc = self.node.indices.get(index)
+        try:
+            for owner, sids in sorted(by_owner.items()):
+                if owner == local_id:
+                    for sid in sids:
+                        searcher = svc.groups[sid].reader().searcher
+                        r = searcher.query_phase(body)
+                        total += r.total_hits
+                        if r.docs and not np.isnan(r.max_score):
+                            max_score = max(max_score, r.max_score)
+                        timed_out |= r.timed_out
+                        terminated |= r.terminated_early
+                        for d in r.docs:
+                            entries.append({
+                                "owner": owner, "shard": sid,
+                                "score": d.score, "sort": d.sort_values,
+                                "local": (searcher, d), "pos": -1,
+                            })
+                        if r.agg_partials:
+                            agg_lists.extend(r.agg_partials["_list"])
+                    continue
+                try:
+                    res = self._send(owner, ACTION_QUERY,
+                                     {"index": index, "body": body,
+                                      "shards": sids})
+                except Exception as e:
+                    failed.extend({"shard": sid, "node": owner,
+                                   "reason": str(e)} for sid in sids)
+                    continue
+                remote_ctx[owner] = res["context_id"]
+                for sh in res["shards"]:
+                    total += sh["total"]
+                    if sh["max_score"] is not None:
+                        max_score = max(max_score, sh["max_score"])
+                    timed_out |= sh["timed_out"]
+                    terminated |= sh["terminated_early"]
+                    for d in sh["docs"]:
+                        entries.append({
+                            "owner": owner, "shard": sh["shard"],
+                            "score": (float("nan") if d["score"] is None
+                                      else d["score"]),
+                            "sort": tuple(wire.unpack(d["sort"])),
+                            "local": None, "pos": d["pos"],
+                        })
+                if res.get("aggs") is not None:
+                    agg_lists.extend(wire.unpack(res["aggs"]))
+            if failed and len(failed) == meta["num_shards"]:
+                raise TransportError(
+                    f"all shards failed: {[f['reason'] for f in failed]}")
+
+            if sort_spec:
+                entries.sort(key=lambda e: _sort_key(e["sort"], sort_spec))
+            else:
+                entries.sort(key=lambda e: (-e["score"],
+                                            owner_order[e["owner"]],
+                                            e["shard"], e["pos"]))
+            page = entries[frm:frm + size]
+
+            # fetch phase: local directly, remote by context positions
+            hit_of: Dict[int, dict] = _fetch_grouped(
+                [(i, e["local"][0], e["local"][1])
+                 for i, e in enumerate(page) if e["local"] is not None],
+                body, index)
+            by_remote: Dict[str, List[int]] = {}
+            for i, e in enumerate(page):
+                if e["local"] is None:
+                    by_remote.setdefault(e["owner"], []).append(i)
+            for owner, idxs in by_remote.items():
+                hits = self._send(
+                    owner, ACTION_FETCH,
+                    {"context_id": remote_ctx.pop(owner),
+                     "positions": [page[i]["pos"] for i in idxs]})
+                for i, h in zip(idxs, hits):
+                    hit_of[i] = h
+        finally:
+            # owners whose contexts were never fetched (no page hits, or an
+            # error later in the scatter/fetch) must not leak parked results
+            self._free_remote(remote_ctx)
+            remote_ctx.clear()
+
+        response: Dict[str, Any] = {
+            "took": int((time.perf_counter() - t0) * 1000),
+            "timed_out": timed_out,
+            "_shards": {"total": meta["num_shards"],
+                        "successful": meta["num_shards"] - len(failed),
+                        "failed": len(failed)},
+            "hits": {
+                "total": total,
+                "max_score": (None if (max_score == float("-inf")
+                                       or sort_spec) else max_score),
+                "hits": [hit_of[i] for i in range(len(page))],
+            },
+        }
+        if failed:
+            response["_shards"]["failures"] = failed
+        if terminated:
+            response["terminated_early"] = True
+        agg_tree = parse_aggs(body.get("aggs") or body.get("aggregations"))
+        if agg_tree and agg_lists:
+            response["aggregations"] = reduce_aggs(agg_tree, agg_lists)
+        return response
+
+
+def _fetch_grouped(triples: List[Tuple[Any, Any, Any]], body: dict,
+                   index_name: str) -> Dict[Any, dict]:
+    """(key, searcher, ShardDoc) triples → {key: hit JSON}, batching the
+    fetch phase per searcher (shared by the fetch endpoint and the
+    coordinator's local-shard fetch)."""
+    by_searcher: Dict[int, List[Tuple[Any, Any]]] = {}
+    searchers: Dict[int, Any] = {}
+    for key, searcher, doc in triples:
+        searchers[id(searcher)] = searcher
+        by_searcher.setdefault(id(searcher), []).append((key, doc))
+    out: Dict[Any, dict] = {}
+    for sk, items in by_searcher.items():
+        hits = searchers[sk].fetch_phase([d for _, d in items], body,
+                                         index_name)
+        for (key, _d), h in zip(items, hits):
+            out[key] = h
+    return out

