@@ -72,7 +72,7 @@ class MultiHostCluster:
         self._adopted_version = -1
         self._stop = threading.Event()
         self._fd_thread: Optional[threading.Thread] = None
-        self._indices_lock = threading.Lock()
+        self._indices_lock = threading.RLock()
         # indices metadata is versioned separately from membership so a
         # stale join reply can't roll back a newer publish (same reason
         # _adopt guards with _adopted_version)
@@ -127,17 +127,27 @@ class MultiHostCluster:
         self.discovery.join(DiscoveryNode(
             payload["node_id"], payload.get("name", ""),
             payload.get("transport_address", "local")))
+        # allocation pass: under-replicated shards get a copy on the new
+        # node, recovered by streaming from a surviving copy
+        directives, changed = self.data.reconcile()
+        if changed:
+            self._indices_version += 1
         self._publish()
+        self.data.start_recoveries(directives)  # async internally
         return {"nodes": [_node_json(n)
                           for n in self.node.cluster_state.nodes.values()],
                 "master": self.node.cluster_state.master_node_id,
                 "version": self.node.cluster_state.version,
-                "indices": self.dist_indices,
+                "indices": self.indices_snapshot(),
                 "indices_version": self._indices_version}
 
     def _on_leave(self, payload: dict) -> dict:
         self.discovery.leave(payload["node_id"])
+        directives, changed = self.data.reconcile()
+        if changed:
+            self._indices_version += 1
         self._publish()
+        self.data.start_recoveries(directives)
         return {"ok": True}
 
     def _on_publish(self, payload: dict) -> dict:
@@ -168,6 +178,14 @@ class MultiHostCluster:
         self.node.cluster_state.next_version()  # order vs membership publishes
         self._publish()
 
+    def indices_snapshot(self) -> dict:
+        """Deep copy under the lock: publishes and join replies must not
+        serialize dist_indices while reconcile/recovery threads mutate it."""
+        import json as _json
+
+        with self._indices_lock:
+            return _json.loads(_json.dumps(self.dist_indices))
+
     def _adopt(self, nodes: List[dict], version: int) -> None:
         """Replace the local membership view with the master's publication
         (reference: PublishClusterStateAction — full-state publish).
@@ -193,6 +211,7 @@ class MultiHostCluster:
         nodes = [_node_json(n)
                  for n in self.node.cluster_state.nodes.values()]
         version = self.node.cluster_state.version
+        indices = self.indices_snapshot()
         for n in list(self.node.cluster_state.nodes.values()):
             if n.node_id == self.local.node_id or ":" not in n.transport_address:
                 continue
@@ -201,7 +220,7 @@ class MultiHostCluster:
                 self.transport.send_remote(
                     (host, int(port)), "cluster:publish",
                     {"nodes": nodes, "version": version,
-                     "indices": self.dist_indices,
+                     "indices": indices,
                      "indices_version": self._indices_version})
             except Exception:
                 pass  # fault detection will reap it
@@ -225,7 +244,13 @@ class MultiHostCluster:
 
     def _on_node_failed(self, n: DiscoveryNode) -> None:
         self.discovery.leave(n.node_id)
+        # drop the dead node from every shard's copy list (promoting the
+        # next surviving copy to primary) and re-replicate where possible
+        directives, changed = self.data.reconcile()
+        if changed:
+            self._indices_version += 1
         self._publish()
+        self.data.start_recoveries(directives)
 
     # -- lifecycle ------------------------------------------------------------
 

@@ -46,6 +46,8 @@ ACTION_DELETE = "indices:data/write/delete"
 ACTION_GET = "indices:data/read/get"
 ACTION_REFRESH = "indices:admin/refresh"
 ACTION_CREATE = "indices:admin/create"
+ACTION_RECOVER = "indices:recovery/start"
+ACTION_SHARD_SYNC = "indices:recovery/shard_sync"
 
 _CONTEXT_TTL = 120.0
 
@@ -59,6 +61,10 @@ class DistributedDataService:
         # search contexts: cid -> {"pairs": [(searcher, ShardDoc)], "born": t}
         self._contexts: Dict[str, dict] = {}
         self._lock = threading.Lock()
+        # per-(index, shard) primary write serialization: apply + replica
+        # fanout must be one atomic step, or two client threads' fanouts
+        # can reach a replica out of version order
+        self._write_locks: Dict[Tuple[str, int], threading.Lock] = {}
         t = cluster.transport
         t.register(ACTION_QUERY, self._on_query)
         t.register(ACTION_FETCH, self._on_fetch)
@@ -68,6 +74,8 @@ class DistributedDataService:
         t.register(ACTION_GET, self._on_get)
         t.register(ACTION_REFRESH, self._on_refresh)
         t.register(ACTION_CREATE, self._on_create)
+        t.register(ACTION_RECOVER, self._on_recover)
+        t.register(ACTION_SHARD_SYNC, self._on_shard_sync)
 
     # -- ownership -----------------------------------------------------------
 
@@ -78,7 +86,12 @@ class DistributedDataService:
         return meta
 
     def owner_of(self, index: str, shard_id: int) -> str:
-        return self._meta(index)["assignment"][str(shard_id)]
+        """Primary owner. assignment maps shard -> [primary, *replicas]."""
+        owners = self._meta(index)["assignment"][str(shard_id)]
+        if not owners:
+            raise TransportError(
+                f"[{index}][{shard_id}] has no active copies")
+        return owners[0]
 
     def _local_id(self) -> str:
         return self.cluster.local.node_id
@@ -109,22 +122,43 @@ class DistributedDataService:
 
     def _on_create(self, payload: dict) -> dict:
         name, body = payload["name"], payload.get("body") or {}
-        if name in self.cluster.dist_indices:
-            # re-creating would recompute the assignment over the CURRENT
-            # membership and orphan every doc routed under the old one
-            from elasticsearch_tpu.utils.errors import \
-                IndexAlreadyExistsException
+        with self.cluster._indices_lock:
+            if name in self.cluster.dist_indices:
+                # re-creating would recompute the assignment over the
+                # CURRENT membership and orphan every doc routed under the
+                # old one
+                from elasticsearch_tpu.utils.errors import \
+                    IndexAlreadyExistsException
 
-            raise IndexAlreadyExistsException(name)
-        nodes = sorted(self.node.cluster_state.nodes)
-        num_shards = int((body.get("settings") or {})
-                         .get("number_of_shards", 1))
-        assignment = {str(i): nodes[i % len(nodes)]
-                      for i in range(num_shards)}
-        self.cluster.dist_indices[name] = {
-            "body": body, "num_shards": num_shards, "assignment": assignment}
-        if not self.node.index_exists(name):
-            self.node.create_index(name, body)
+                raise IndexAlreadyExistsException(name)
+            nodes = sorted(self.node.cluster_state.nodes)
+            settings = dict(body.get("settings") or {})
+            num_shards = int(settings.get("number_of_shards", 1))
+            # number_of_replicas means CROSS-HOST copies here; the local
+            # body gets 0 so each process holds plain single-copy shards
+            # (in-process replica groups are the single-node HA mechanism,
+            # not this one)
+            replicas = int(settings.pop("number_of_replicas", 0))
+            local_body = dict(body)
+            local_body["settings"] = settings
+            assignment = {}
+            for i in range(num_shards):
+                owners = [nodes[i % len(nodes)]]
+                for r in range(1, replicas + 1):
+                    cand = nodes[(i + r) % len(nodes)]
+                    if cand not in owners:
+                        owners.append(cand)
+                assignment[str(i)] = owners
+            self.cluster.dist_indices[name] = {
+                "body": local_body, "num_shards": num_shards,
+                "replicas": replicas, "assignment": assignment,
+                # copies being recovered: visible for write fanout (they
+                # must see live writes during the copy), NOT promotable or
+                # searchable until recovery succeeds — the reference's
+                # INITIALIZING shard state
+                "initializing": {}}
+            if not self.node.index_exists(name):
+                self.node.create_index(name, local_body)
         self.cluster.publish_indices()
         return {"acknowledged": True, "index": name,
                 "assignment": assignment}
@@ -153,38 +187,104 @@ class DistributedDataService:
         if doc_id is None:
             doc_id = uuid.uuid4().hex  # route on the final id, as the owner will
         sid = shard_id_for(doc_id, meta["num_shards"], routing)
-        owner = meta["assignment"][str(sid)]
+        owner = self.owner_of(index, sid)
         if owner == self._local_id():
-            return self.node.indices[index].index_doc(
-                doc_id, source, routing=routing, **kw)
+            return self._primary_write("index", index, sid, doc_id, source,
+                                       routing, kw)
         return self._send(owner, ACTION_INDEX,
                           {"index": index, "id": doc_id, "source": source,
                            "routing": routing, "kw": kw})
 
+    def _write_lock(self, index: str, sid: int) -> threading.Lock:
+        with self._lock:
+            return self._write_locks.setdefault((index, sid),
+                                                threading.Lock())
+
+    def _primary_write(self, op: str, index: str, sid: int, doc_id: str,
+                       source: Optional[dict], routing: Optional[str],
+                       kw: dict) -> dict:
+        """Apply on the primary, then fan out to every cross-host copy —
+        committed replicas AND initializing (recovering) ones — with the
+        primary-assigned version (external_gte keeps replica replay
+        idempotent and ordered — the reference's
+        TransportShardReplicationOperationAction primary → replicas hop).
+        The per-shard lock makes apply+fanout atomic so two client
+        threads' fanouts cannot reach a replica out of version order."""
+        svc = self.node.indices[index]
+        with self._write_lock(index, sid):
+            if op == "index":
+                res = svc.index_doc(doc_id, source, routing=routing, **kw)
+            else:
+                res = svc.delete_doc(doc_id, routing=routing, **kw)
+            meta = self._meta(index)
+            rep_kw = dict(kw)
+            rep_kw.update(version=res["_version"],
+                          version_type="external_gte")
+            action = ACTION_INDEX if op == "index" else ACTION_DELETE
+            copies = (meta["assignment"][str(sid)][1:]
+                      + meta.get("initializing", {}).get(str(sid), []))
+            for rep in copies:
+                if rep == self._local_id():
+                    continue
+                try:
+                    self._send(rep, action,
+                               {"index": index, "id": doc_id,
+                                "source": source, "routing": routing,
+                                "kw": rep_kw, "replica": True})
+                except Exception:
+                    # unreachable replica: fault detection reaps the node
+                    # and reconcile() re-syncs the copy on rejoin
+                    # (external_gte replay makes the resync idempotent)
+                    pass
+        return res
+
     def _on_index(self, payload: dict) -> dict:
-        return self.node.indices[payload["index"]].index_doc(
-            payload["id"], payload["source"], routing=payload.get("routing"),
-            **(payload.get("kw") or {}))
+        index, doc_id = payload["index"], payload["id"]
+        routing = payload.get("routing")
+        if payload.get("replica"):
+            return self.node.indices[index].index_doc(
+                doc_id, payload["source"], routing=routing,
+                **(payload.get("kw") or {}))
+        sid = shard_id_for(doc_id, self._meta(index)["num_shards"], routing)
+        return self._primary_write("index", index, sid, doc_id,
+                                   payload["source"], routing,
+                                   payload.get("kw") or {})
 
     def delete_doc(self, index: str, doc_id: str,
                    routing: Optional[str] = None) -> dict:
         meta = self._meta(index)
         sid = shard_id_for(doc_id, meta["num_shards"], routing)
-        owner = meta["assignment"][str(sid)]
+        owner = self.owner_of(index, sid)
         if owner == self._local_id():
-            return self.node.indices[index].delete_doc(doc_id, routing=routing)
+            return self._primary_write("delete", index, sid, doc_id, None,
+                                       routing, {})
         return self._send(owner, ACTION_DELETE,
                           {"index": index, "id": doc_id, "routing": routing})
 
     def _on_delete(self, payload: dict) -> dict:
-        return self.node.indices[payload["index"]].delete_doc(
-            payload["id"], routing=payload.get("routing"))
+        index, doc_id = payload["index"], payload["id"]
+        routing = payload.get("routing")
+        if payload.get("replica"):
+            from elasticsearch_tpu.utils.errors import \
+                DocumentMissingException
+
+            try:
+                return self.node.indices[index].delete_doc(
+                    doc_id, routing=routing, **(payload.get("kw") or {}))
+            except DocumentMissingException:
+                # a delete for a doc this copy never saw (e.g. it raced the
+                # recovery snapshot): per-shard fanout ordering plus the
+                # tombstones shipped by _on_shard_sync make skipping safe
+                return {"found": False, "_id": doc_id}
+        sid = shard_id_for(doc_id, self._meta(index)["num_shards"], routing)
+        return self._primary_write("delete", index, sid, doc_id, None,
+                                   routing, payload.get("kw") or {})
 
     def get_doc(self, index: str, doc_id: str,
                 routing: Optional[str] = None) -> dict:
         meta = self._meta(index)
-        sid = shard_id_for(doc_id, meta["num_shards"], routing)
-        owner = meta["assignment"][str(sid)]
+        owner = self.owner_of(
+            index, shard_id_for(doc_id, meta["num_shards"], routing))
         if owner == self._local_id():
             return self.node.indices[index].get_doc(doc_id, routing=routing)
         return self._send(owner, ACTION_GET,
@@ -193,6 +293,151 @@ class DistributedDataService:
     def _on_get(self, payload: dict) -> dict:
         return self.node.indices[payload["index"]].get_doc(
             payload["id"], routing=payload.get("routing"))
+
+    # -- shard recovery / relocation -----------------------------------------
+
+    def reconcile(self):
+        """Master-side allocation pass after a membership change: drop dead
+        nodes from every copy list (which promotes the next surviving
+        COMMITTED copy to primary), then top shards back up to 1+replicas
+        copies on alive nodes. A new copy starts in `initializing` — it
+        receives live write fanout but is not promotable or searchable —
+        and graduates into `assignment` only when its recovery stream
+        succeeds (_run_recoveries), so a failed recovery can never leave a
+        promotable empty copy. Returns (directives, changed).
+        Reference: RoutingNodes promotion + INITIALIZING→STARTED shard
+        states; recovery itself mirrors RecoverySourceHandler phase 1/2 as
+        ops-based streaming (see index/recovery.py for why shipping live
+        docs IS our segment copy)."""
+        with self.cluster._indices_lock:
+            alive = set(self.node.cluster_state.nodes)
+            order = sorted(alive)
+            directives: List[dict] = []
+            changed = False
+            for name, meta in self.cluster.dist_indices.items():
+                want = 1 + int(meta.get("replicas", 0))
+                init = meta.setdefault("initializing", {})
+                for sid in range(meta["num_shards"]):
+                    owners = [o for o in meta["assignment"][str(sid)]
+                              if o in alive]
+                    if owners != meta["assignment"][str(sid)]:
+                        changed = True
+                    meta["assignment"][str(sid)] = owners
+                    pend = [t for t in init.get(str(sid), []) if t in alive]
+                    if pend != init.get(str(sid), []):
+                        changed = True
+                    init[str(sid)] = pend
+                    if not owners:
+                        continue  # lost shard: nothing to copy from
+                    for k in range(len(order)):
+                        if len(owners) + len(pend) >= want:
+                            break
+                        cand = order[(sid + k) % len(order)]
+                        if cand in owners or cand in pend:
+                            continue
+                        pend.append(cand)
+                        directives.append({
+                            "index": name, "shard": sid, "target": cand,
+                            "source": owners[0], "body": meta["body"]})
+                        changed = True
+            return directives, changed
+
+    def start_recoveries(self, directives: List[dict]) -> None:
+        """Run the recovery streams on a background thread: callers are
+        transport handlers or the fault-detector loop, and a recovery can
+        take as long as the shard is big."""
+        if not directives:
+            return
+        threading.Thread(target=self._run_recoveries, args=(directives,),
+                         name="tpu-recovery", daemon=True).start()
+
+    def _run_recoveries(self, directives: List[dict]) -> None:
+        promoted = False
+        for d in directives:
+            ok = False
+            try:
+                if d["target"] == self._local_id():
+                    self._on_recover(d)
+                else:
+                    self._send(d["target"], ACTION_RECOVER, d, timeout=120.0)
+                ok = True
+            except Exception:
+                pass
+            with self.cluster._indices_lock:
+                meta = self.cluster.dist_indices.get(d["index"])
+                if meta is None:
+                    continue
+                pend = meta.get("initializing", {}).get(str(d["shard"]), [])
+                if d["target"] in pend:
+                    pend.remove(d["target"])
+                owners = meta["assignment"].get(str(d["shard"]))
+                if ok and owners is not None and d["target"] not in owners \
+                        and d["target"] in self.node.cluster_state.nodes:
+                    owners.append(d["target"])  # INITIALIZING → STARTED
+                    promoted = True
+        if promoted:
+            self.cluster.publish_indices()
+
+    def _on_recover(self, payload: dict) -> dict:
+        """Recovery target: pull the shard's live docs from the source copy
+        and replay them with external_gte versioning (RecoveryTarget).
+        The index may not exist locally yet when recovery races the
+        metadata publish — create it from the directive's body."""
+        index, sid = payload["index"], payload["shard"]
+        with self.cluster._indices_lock:
+            if not self.node.index_exists(index):
+                self.node.create_index(index, payload.get("body"))
+        res = self._send(payload["source"], ACTION_SHARD_SYNC,
+                         {"index": index, "shard": sid}, timeout=60.0)
+        engine = self.node.indices[index].shards[sid].engine
+        copied = skipped = 0
+        from elasticsearch_tpu.utils.errors import (DocumentMissingException,
+                                                    VersionConflictException)
+
+        for d in res["docs"]:
+            try:
+                if d.get("deleted"):
+                    # tombstones ride the stream too, so a delete that
+                    # landed on the source after a racing fanout index on
+                    # this copy still wins by version
+                    engine.delete(d["id"], version=d["version"],
+                                  version_type="external_gte")
+                else:
+                    engine.index(d["id"], d["source"], version=d["version"],
+                                 version_type="external_gte",
+                                 doc_type=d.get("type"),
+                                 parent=d.get("parent"),
+                                 routing=d.get("routing"), _replay=True)
+                copied += 1
+            except (VersionConflictException, DocumentMissingException):
+                skipped += 1  # already newer here (a racing replica write)
+        engine.refresh()
+        return {"copied": copied, "skipped": skipped}
+
+    def _on_shard_sync(self, payload: dict) -> dict:
+        """Recovery source: snapshot this shard's live docs (id, source,
+        version, type/parent/routing meta) — RecoverySourceHandler's
+        phase-1 stream in ops form. Concurrent writes during the copy win
+        on the target via version comparison (phase 2 for free)."""
+        engine = self.node.indices[payload["index"]] \
+            .shards[payload["shard"]].engine
+        with engine._lock:
+            ids = [(doc_id, loc.version, loc.doc_type, loc.parent,
+                    loc.routing, loc.deleted)
+                   for doc_id, loc in engine._locations.items()]
+        docs = []
+        for doc_id, version, doc_type, parent, routing, deleted in ids:
+            if deleted:
+                docs.append({"id": doc_id, "version": version,
+                             "deleted": True})
+                continue
+            got = engine.get(doc_id)
+            if got is None:
+                continue  # deleted mid-snapshot
+            docs.append({"id": doc_id, "source": got["_source"],
+                         "version": version, "type": doc_type,
+                         "parent": parent, "routing": routing})
+        return {"docs": docs}
 
     # -- query phase (remote endpoint) ---------------------------------------
 
@@ -290,8 +535,14 @@ class DistributedDataService:
         meta = self._meta(index)
         local_id = self._local_id()
         by_owner: Dict[str, List[int]] = {}
+        unassigned: List[dict] = []
         for sid in range(meta["num_shards"]):
-            by_owner.setdefault(meta["assignment"][str(sid)], []).append(sid)
+            owners = meta["assignment"][str(sid)]
+            if not owners:
+                unassigned.append({"shard": sid,
+                                   "reason": "no active copies"})
+                continue
+            by_owner.setdefault(owners[0], []).append(sid)
         sort_spec = _parse_sort(body.get("sort"))
         size = int(body.get("size", 10))
         frm = int(body.get("from", 0))
@@ -306,7 +557,7 @@ class DistributedDataService:
         # per-shard failures are collected, not fatal, matching the
         # reference's ShardSearchFailure accounting — unless EVERY shard
         # failed, in which case the search as a whole is an error
-        failed: List[dict] = []
+        failed: List[dict] = list(unassigned)
         owner_order = {nid: i for i, nid in enumerate(sorted(by_owner))}
         svc = self.node.indices.get(index)
         try:

@@ -137,7 +137,8 @@ def test_cross_host_query_then_fetch(master):
         }
         c.data.create_index("events", idx_body)
         assig = c.dist_indices["events"]["assignment"]
-        assert len(set(assig.values())) == 2, assig  # truly split across hosts
+        # truly split across hosts (single-copy shards, one per node)
+        assert len({owners[0] for owners in assig.values()}) == 2, assig
 
         docs = {}
         for i in range(40):
@@ -192,6 +193,98 @@ def test_cross_host_query_then_fetch(master):
         assert [h["_id"] for h in got["hits"]["hits"]] == \
                [h["_id"] for h in want["hits"]["hits"]]
         oracle.close()
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_replica_promotion_survives_node_death(master):
+    """Round-4 verdict missing #4 (half 1): with number_of_replicas=1 every
+    write fans out to a cross-host copy; killing the process that owns a
+    primary promotes the survivor's copy, and search stays correct with
+    zero failed shards. Reference: TransportShardReplicationOperation-
+    Action (primary→replica hop) + RoutingNodes promotion."""
+    node, c = master
+    p = _spawn_rank1(c.master_addr[1])
+    try:
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        c.data.create_index("rep", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "n": {"type": "integer"}}}})
+        assig = c.dist_indices["rep"]["assignment"]
+        assert all(len(owners) == 2 for owners in assig.values()), assig
+        primaries = {owners[0] for owners in assig.values()}
+        assert len(primaries) == 2, assig  # each node primaries one shard
+        for i in range(40):
+            c.data.index_doc("rep", str(i), {"body": f"word tok{i}", "n": i})
+        c.data.refresh("rep")
+        r = c.data.search("rep", {"query": {"match_all": {}}, "size": 0})
+        assert r["hits"]["total"] == 40
+
+        p.kill()  # hard death of one primary's owner
+        p.wait()
+        assert _wait(lambda: len(node.cluster_state.nodes) == 1, timeout=15.0)
+        assert _wait(lambda: all(
+            len(o) == 1 and o[0] == c.local.node_id
+            for o in c.dist_indices["rep"]["assignment"].values()),
+            timeout=10.0), c.dist_indices["rep"]["assignment"]
+
+        r = c.data.search("rep", {"query": {"match_all": {}}, "size": 50})
+        assert r["hits"]["total"] == 40, r["hits"]["total"]
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+        assert {h["_id"] for h in r["hits"]["hits"]} == \
+               {str(i) for i in range(40)}
+        # the promoted copy serves routed reads too
+        g = c.data.get_doc("rep", "7")
+        assert g["found"] and g["_source"]["n"] == 7
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_join_triggers_shard_recovery_stream(master):
+    """Round-4 verdict missing #4 (half 2): a node joining an
+    under-replicated cluster pulls each assigned shard's live docs from
+    the surviving copy (ops-based RecoverySourceHandler phase 1+2) and
+    activates it. Verified by querying the NEW node's shards directly
+    over the transport."""
+    from elasticsearch_tpu.cluster.search_action import ACTION_QUERY
+
+    node, c = master
+    # alone in the cluster: replicas stay unassigned
+    c.data.create_index("solo", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    for i in range(30):
+        c.data.index_doc("solo", str(i), {"body": f"alpha tok{i}"})
+    c.data.refresh("solo")
+    assert all(len(o) == 1 for o in
+               c.dist_indices["solo"]["assignment"].values())
+
+    p = _spawn_rank1(c.master_addr[1])
+    try:
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        # reconcile assigned the new node as replica of both shards
+        assert _wait(lambda: all(
+            len(o) == 2 for o in
+            c.dist_indices["solo"]["assignment"].values()), timeout=10.0)
+        rank1 = next(nid for nid in node.cluster_state.nodes
+                     if nid != c.local.node_id)
+
+        def _rank1_docs():
+            try:
+                res = c.data._send(rank1, ACTION_QUERY, {
+                    "index": "solo", "shards": [0, 1],
+                    "body": {"query": {"match_all": {}}, "size": 0}})
+            except Exception:
+                return -1
+            return sum(sh["total"] for sh in res["shards"])
+
+        # the recovery stream runs async after the join — poll until the
+        # new node's OWN shards serve all 30 docs
+        assert _wait(lambda: _rank1_docs() == 30, timeout=20.0), \
+            _rank1_docs()
     finally:
         p.kill()
         p.wait()
