@@ -131,7 +131,7 @@ class MultiHostCluster:
         # node, recovered by streaming from a surviving copy
         directives, changed = self.data.reconcile()
         if changed:
-            self._indices_version += 1
+            self._bump_indices_version()
         self._publish()
         self.data.start_recoveries(directives)  # async internally
         return {"nodes": [_node_json(n)
@@ -145,7 +145,7 @@ class MultiHostCluster:
         self.discovery.leave(payload["node_id"])
         directives, changed = self.data.reconcile()
         if changed:
-            self._indices_version += 1
+            self._bump_indices_version()
         self._publish()
         self.data.start_recoveries(directives)
         return {"ok": True}
@@ -174,9 +174,15 @@ class MultiHostCluster:
                     self.node.create_index(name, spec.get("body"))
 
     def publish_indices(self) -> None:
-        self._indices_version += 1
+        self._bump_indices_version()
         self.node.cluster_state.next_version()  # order vs membership publishes
         self._publish()
+
+    def _bump_indices_version(self) -> None:
+        # read-modify-write under the indices lock: concurrent join/fault
+        # handlers must never publish distinct states under one version
+        with self._indices_lock:
+            self._indices_version += 1
 
     def indices_snapshot(self) -> dict:
         """Deep copy under the lock: publishes and join replies must not
@@ -211,7 +217,9 @@ class MultiHostCluster:
         nodes = [_node_json(n)
                  for n in self.node.cluster_state.nodes.values()]
         version = self.node.cluster_state.version
-        indices = self.indices_snapshot()
+        with self._indices_lock:  # (state, version) read atomically
+            indices = self.indices_snapshot()
+            indices_version = self._indices_version
         for n in list(self.node.cluster_state.nodes.values()):
             if n.node_id == self.local.node_id or ":" not in n.transport_address:
                 continue
@@ -221,7 +229,7 @@ class MultiHostCluster:
                     (host, int(port)), "cluster:publish",
                     {"nodes": nodes, "version": version,
                      "indices": indices,
-                     "indices_version": self._indices_version})
+                     "indices_version": indices_version})
             except Exception:
                 pass  # fault detection will reap it
 
@@ -248,7 +256,7 @@ class MultiHostCluster:
         # next surviving copy to primary) and re-replicate where possible
         directives, changed = self.data.reconcile()
         if changed:
-            self._indices_version += 1
+            self._bump_indices_version()
         self._publish()
         self.data.start_recoveries(directives)
 

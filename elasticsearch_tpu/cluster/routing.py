@@ -18,15 +18,17 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from elasticsearch_tpu.cluster.state import DiscoveryNode, ShardRouting
-from elasticsearch_tpu.utils.hashing import murmur3_32
+from elasticsearch_tpu.utils.hashing import routing_hash
 
 
 # -- operation routing ---------------------------------------------------------
 
 def shard_id_for(doc_id: str, num_shards: int, routing: Optional[str] = None) -> int:
-    """OperationRouting.generateShardId: murmur3(routing ?: id) % shards."""
+    """OperationRouting.generateShardId: murmur3(routing ?: id) % shards —
+    the reference's exact UTF-16LE signed murmur, so doc→shard placement
+    matches ES 2.0 byte for byte."""
     key = routing if routing is not None else str(doc_id)
-    return murmur3_32(key) % num_shards
+    return routing_hash(key) % num_shards
 
 
 # -- allocation deciders -------------------------------------------------------

@@ -48,6 +48,7 @@ ACTION_REFRESH = "indices:admin/refresh"
 ACTION_CREATE = "indices:admin/create"
 ACTION_RECOVER = "indices:recovery/start"
 ACTION_SHARD_SYNC = "indices:recovery/shard_sync"
+ACTION_SHARD_FAILED = "cluster:shard_failed"
 
 _CONTEXT_TTL = 120.0
 
@@ -76,6 +77,7 @@ class DistributedDataService:
         t.register(ACTION_CREATE, self._on_create)
         t.register(ACTION_RECOVER, self._on_recover)
         t.register(ACTION_SHARD_SYNC, self._on_shard_sync)
+        t.register(ACTION_SHARD_FAILED, self._on_shard_failed)
 
     # -- ownership -----------------------------------------------------------
 
@@ -232,11 +234,57 @@ class DistributedDataService:
                                 "source": source, "routing": routing,
                                 "kw": rep_kw, "replica": True})
                 except Exception:
-                    # unreachable replica: fault detection reaps the node
-                    # and reconcile() re-syncs the copy on rejoin
-                    # (external_gte replay makes the resync idempotent)
-                    pass
+                    # a copy that missed an acknowledged write must stop
+                    # being promotable — report it failed so the master
+                    # demotes it and re-syncs via the recovery stream
+                    # (reference: ShardStateAction.shardFailed on a failed
+                    # replication hop)
+                    self._report_copy_failed(index, sid, rep)
         return res
+
+    def _report_copy_failed(self, index: str, sid: int,
+                            node_id: str) -> None:
+        payload = {"index": index, "shard": sid, "node": node_id}
+        try:
+            if self.cluster.is_master:
+                self._on_shard_failed(payload)
+            else:
+                self.cluster.transport.send_remote(
+                    self.cluster.master_addr, ACTION_SHARD_FAILED,
+                    payload, timeout=5.0)
+        except Exception:
+            pass  # master unreachable: fault detection is already dying
+
+    def _on_shard_failed(self, payload: dict) -> dict:
+        """Master: drop a failed REPLICA copy from the promotable set and
+        schedule a re-sync (primary failure is fault detection's job)."""
+        if not self.cluster.is_master:
+            raise TransportError("shard_failed must go to the master")
+        index, sid = payload["index"], payload["shard"]
+        node_id = payload["node"]
+        directive = None
+        with self.cluster._indices_lock:
+            meta = self.cluster.dist_indices.get(index)
+            if meta is None:
+                return {"ok": False}
+            owners = meta["assignment"].get(str(sid), [])
+            if node_id not in owners or owners[0] == node_id:
+                return {"ok": False}
+            owners.remove(node_id)
+            if owners and node_id in self.node.cluster_state.nodes:
+                # back through INITIALIZING so live writes keep fanning
+                # out to it while the re-sync stream runs
+                pend = meta.setdefault("initializing", {}) \
+                    .setdefault(str(sid), [])
+                if node_id not in pend:
+                    pend.append(node_id)
+                directive = {"index": index, "shard": sid,
+                             "target": node_id, "source": owners[0],
+                             "body": meta["body"]}
+        self.cluster.publish_indices()
+        if directive:
+            self.start_recoveries([directive])
+        return {"ok": True}
 
     def _on_index(self, payload: dict) -> dict:
         index, doc_id = payload["index"], payload["id"]
@@ -407,7 +455,9 @@ class DistributedDataService:
                                  version_type="external_gte",
                                  doc_type=d.get("type"),
                                  parent=d.get("parent"),
-                                 routing=d.get("routing"), _replay=True)
+                                 routing=d.get("routing"),
+                                 ttl_expiry=d.get("ttl_expiry"),
+                                 timestamp=d.get("timestamp"), _replay=True)
                 copied += 1
             except (VersionConflictException, DocumentMissingException):
                 skipped += 1  # already newer here (a racing replica write)
@@ -434,9 +484,14 @@ class DistributedDataService:
             got = engine.get(doc_id)
             if got is None:
                 continue  # deleted mid-snapshot
+            loc = engine._locations.get(doc_id)
             docs.append({"id": doc_id, "source": got["_source"],
                          "version": version, "type": doc_type,
-                         "parent": parent, "routing": routing})
+                         "parent": parent, "routing": routing,
+                         # _timestamp/_ttl ride the stream too, or the
+                         # recovered copy would regenerate/lose them
+                         "timestamp": getattr(loc, "timestamp", None),
+                         "ttl_expiry": getattr(loc, "ttl_expiry", None)})
         return {"docs": docs}
 
     # -- query phase (remote endpoint) ---------------------------------------

@@ -126,8 +126,30 @@ class DocumentParser:
                     expiry = None
                 else:
                     ttl_ms = _ttl_to_millis(t)
-                    base = parsed.meta.get("timestamp", now_ms)
+                    # the expiry base is the op's timestamp even when the
+                    # _timestamp meta field itself is disabled (reference:
+                    # TTLFieldMapper reads the IndexRequest timestamp)
+                    base = parsed.meta.get("timestamp")
+                    if base is None and timestamp is not None:
+                        base = (int(timestamp)
+                                if isinstance(timestamp, (int, float))
+                                else int(parse_date(
+                                    timestamp,
+                                    "strict_date_optional_time"
+                                    "||epoch_millis")))
+                    if base is None:
+                        base = now_ms
                     expiry = int(base + ttl_ms)
+                    if ttl is not None and expiry <= now_ms:
+                        # an explicit ttl whose expiry (timestamp + ttl) is
+                        # already past is a request error (reference:
+                        # AlreadyExpiredException from TTLFieldMapper)
+                        from elasticsearch_tpu.utils.errors import \
+                            AlreadyExpiredException
+
+                        raise AlreadyExpiredException(
+                            parsed.doc_id if hasattr(parsed, "doc_id")
+                            else "", base, ttl_ms)
             if expiry is not None:
                 parsed.doc_values["_ttl"] = [expiry]
                 parsed.meta["ttl_expiry"] = expiry

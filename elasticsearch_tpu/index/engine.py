@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.analysis.registry import AnalysisRegistry
@@ -42,6 +42,10 @@ class DocLocation:
     doc_type: Optional[str] = None
     parent: Optional[str] = None
     routing: Optional[str] = None
+    # resolved _timestamp (epoch millis) / _ttl expiry — served by GET
+    # fields=_timestamp/_ttl without a segment lookup
+    timestamp: Optional[int] = None
+    ttl_expiry: Optional[int] = None
 
 
 @dataclass
@@ -53,6 +57,15 @@ class EngineStats:
     flush_total: int = 0
     merge_total: int = 0
     index_time_ms: float = 0.0
+    # per-doc-type indexing counters (reference: ShardIndexingService
+    # typeStats feeding IndexingStats.Stats per type — the `types` scope
+    # of _stats)
+    types: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+    def on_type(self, doc_type: Optional[str], op: str) -> None:
+        ts = self.types.setdefault(doc_type or "_doc",
+                                   {"index_total": 0, "delete_total": 0})
+        ts[op] += 1
 
 
 class Engine:
@@ -74,6 +87,11 @@ class Engine:
         self._buffer_ids: Dict[str, int] = {}
         self._lock = threading.RLock()
         self.stats = EngineStats()
+        # commit identity for the _stats shards level (reference: Lucene
+        # SegmentInfos commit id/generation in CommitStats)
+        import uuid as _uuid
+
+        self.commit_id = _uuid.uuid4().hex
         self.merge_segment_count = merge_segment_count
         from elasticsearch_tpu.index.merge import TieredMergePolicy
 
@@ -144,6 +162,8 @@ class Engine:
             self._locations[doc_id] = DocLocation(
                 version=new_version, deleted=False, where="buffer", local_id=local,
                 source=source, doc_type=doc_type, parent=parent, routing=routing,
+                timestamp=parsed.meta.get("timestamp"),
+                ttl_expiry=parsed.meta.get("ttl_expiry"),
             )
             if not _replay:
                 entry = {"op": "index", "id": doc_id, "source": source,
@@ -160,6 +180,7 @@ class Engine:
                     entry["ttl_expiry"] = parsed.meta["ttl_expiry"]
                 self.translog.append(entry)
             self.stats.index_total += 1
+            self.stats.on_type(doc_type, "index_total")
             self.stats.index_time_ms += (time.perf_counter() - t0) * 1000
             return doc_id, new_version, not exists
 
@@ -191,25 +212,50 @@ class Engine:
             if not _replay:
                 self.translog.append({"op": "delete", "id": doc_id, "version": new_version})
             self.stats.delete_total += 1
+            self.stats.on_type(loc.doc_type, "delete_total")
             return new_version
 
     def update(self, doc_id: str, partial: Optional[dict] = None,
                script: Optional[str] = None, script_params: Optional[dict] = None,
                upsert: Optional[dict] = None, doc_as_upsert: bool = False,
-               doc_type: Optional[str] = None) -> Tuple[int, bool]:
+               doc_type: Optional[str] = None, routing: Optional[str] = None,
+               parent: Optional[str] = None, version: Optional[int] = None,
+               version_type: str = "internal",
+               timestamp: Optional[object] = None,
+               ttl: Optional[object] = None) -> Tuple[int, bool]:
         """Partial update (RestUpdateAction semantics): merge `partial` into
-        the current source, or create from `upsert` when missing."""
+        the current source, or create from `upsert` when missing. Only
+        internal versioning applies (reference: UpdateRequest.validate
+        rejects external version types)."""
+        if version is not None and version_type not in ("internal",):
+            from elasticsearch_tpu.utils.errors import \
+                ActionRequestValidationException
+
+            raise ActionRequestValidationException(
+                f"version type [{version_type}] is not supported by the "
+                f"update API")
         with self._lock:
             doc_id = str(doc_id)
             got = self.get(doc_id)
             if got is None:
+                if version is not None:
+                    # versioned update on a missing doc is a conflict, even
+                    # with an upsert (TransportUpdateAction)
+                    raise VersionConflictException("", doc_id, -1, version)
                 if upsert is not None:
-                    _, v, _ = self.index(doc_id, upsert, doc_type=doc_type)
+                    _, v, _ = self.index(doc_id, upsert, doc_type=doc_type,
+                                         routing=routing, parent=parent,
+                                         timestamp=timestamp, ttl=ttl)
                     return v, True
                 if doc_as_upsert and partial is not None:
-                    _, v, _ = self.index(doc_id, partial, doc_type=doc_type)
+                    _, v, _ = self.index(doc_id, partial, doc_type=doc_type,
+                                         routing=routing, parent=parent,
+                                         timestamp=timestamp, ttl=ttl)
                     return v, True
                 raise DocumentMissingException("", doc_id)
+            if version is not None and got["_version"] != version:
+                raise VersionConflictException("", doc_id, got["_version"],
+                                               version)
             source = dict(got["_source"])
             if script is not None:
                 source = self._run_update_script(script, script_params or {}, source)
@@ -220,9 +266,10 @@ class Engine:
             loc = self._locations.get(doc_id)
             _, v, _ = self.index(
                 doc_id, source,
-                routing=loc.routing if loc else None,
-                doc_type=loc.doc_type if loc else None,
-                parent=loc.parent if loc else None,
+                routing=(loc.routing if loc and loc.routing else routing),
+                doc_type=loc.doc_type if loc else doc_type,
+                parent=(loc.parent if loc and loc.parent else parent),
+                timestamp=timestamp, ttl=ttl,
             )
             return v, False
 

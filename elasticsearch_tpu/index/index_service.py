@@ -204,7 +204,7 @@ class IndexService:
         }
 
     def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None,
-                   doc_type: Optional[str] = None) -> dict:
+                   doc_type: Optional[str] = None, **kw) -> dict:
         from elasticsearch_tpu.cluster.metadata import check_open
 
         check_open(self)
@@ -244,8 +244,11 @@ class IndexService:
             upsert=body.get("upsert"),
             doc_as_upsert=bool(body.get("doc_as_upsert", False)),
             doc_type=doc_type,
+            routing=routing,
+            **kw,
         )
-        self.group_for(doc_id, routing).replicate_current(str(doc_id))
+        group = self.group_for(doc_id, routing)
+        group.replicate_current(str(doc_id))
         if is_perc:
             got = shard.engine.get(str(doc_id))
             if got and got.get("_source"):
@@ -258,6 +261,9 @@ class IndexService:
             "_id": doc_id,
             "_version": version,
             "result": "created" if created else "updated",
+            "_shards": {"total": 1 + self.num_replicas,
+                        "successful": 1 + len(group.replicas),
+                        "failed": 0},
         }
 
     def mget(self, ids: List[str]) -> dict:
@@ -438,6 +444,14 @@ class IndexService:
 
     def stats(self) -> dict:
         shard_stats = [s.stats() for s in self.shards]
+        # searches record on the round-robin reader's copy — fold replica
+        # searcher counters into the primary's search section so _stats
+        # reports the whole group (reference: stats aggregate every copy)
+        for g, st in zip(self.groups, shard_stats):
+            for c in g.copies:
+                if c is g.primary:
+                    continue
+                _merge_counters(st["search"], c.searcher.stats.to_json())
         total_docs = sum(st["docs"]["count"] for st in shard_stats)
         return {
             "primaries": {
@@ -462,3 +476,14 @@ class IndexService:
             for c in g.copies + g.failed_replicas:
                 c.close()
         self.closed = True
+
+
+def _merge_counters(dst: dict, src: dict) -> None:
+    """Sum numeric counters recursively (non-numeric keys first-wins)."""
+    for k, v in src.items():
+        if isinstance(v, dict):
+            _merge_counters(dst.setdefault(k, {}), v)
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            dst[k] = dst.get(k, 0) + v
+        else:
+            dst.setdefault(k, v)

@@ -411,7 +411,9 @@ def _field_to_json(fm: FieldMapping) -> dict:
         out["type"] = "string"
         if fm.is_keyword:
             out["index"] = "not_analyzed"
-    if fm.is_text:
+    if fm.is_text and fm.analyzer != "standard":
+        # defaults stay implicit: GET _mapping echoes only declared
+        # analyzers (re-parse re-derives the standard default)
         out["analyzer"] = fm.analyzer
     if fm.search_analyzer is not None:
         out["search_analyzer"] = fm.search_analyzer

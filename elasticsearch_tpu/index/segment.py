@@ -555,6 +555,32 @@ class TpuSegment:
             total += self.max_docs * col.dims * 4
         return total
 
+    def fielddata_field_bytes(self) -> Dict[str, int]:
+        """Per-field doc-value memory — the `fielddata` section of _stats
+        (reference: index/fielddata/ShardFieldData.java per-field maps).
+        TPU deviation: columns are built at freeze and always
+        device-resident, so fielddata is never lazily loaded and never
+        evicted (evictions stay 0 by design); for analyzed text the
+        uninverted postings arrays play fielddata's sort/agg role."""
+        out: Dict[str, int] = {}
+
+        def add(name, b):
+            out[name] = out.get(name, 0) + b
+
+        for name, col in self.numerics.items():
+            add(name, self.max_docs * 5
+                + (self.max_docs * 8 if col.hi is not None else 0))
+        for name in self.keywords:
+            add(name, self.max_docs * 5)
+        for name, col in self.vectors.items():
+            add(name, self.max_docs * col.dims * 4)
+        for name, inv in self.inverted.items():
+            if name in self.keywords or name in self.numerics \
+                    or name.startswith("_"):
+                continue
+            add(name, inv.nnz_pad * 12)  # term_ids + doc_ids + tf
+        return out
+
 
 class SegmentBuilder:
     """Mutable in-memory indexing buffer; freeze() emits a TpuSegment.

@@ -51,20 +51,66 @@ class IndexShard:
 
     def stats(self) -> dict:
         e = self.engine.stats
+        segs = self.engine.segments
+        fd_fields: dict = {}
+        for seg in segs:
+            for fname, b in seg.fielddata_field_bytes().items():
+                fd_fields[fname] = fd_fields.get(fname, 0) + b
+        comp_fields = self._completion_sizes(segs)
+        indexing = {"index_total": e.index_total,
+                    "delete_total": e.delete_total,
+                    "index_time_in_millis": int(e.index_time_ms)}
+        if e.types:
+            indexing["types"] = {t: dict(ts) for t, ts in e.types.items()}
         return {
             "docs": {"count": self.engine.num_docs},
-            "indexing": {"index_total": e.index_total, "delete_total": e.delete_total,
-                         "index_time_in_millis": int(e.index_time_ms)},
+            "indexing": indexing,
             "get": {"total": e.get_total},
+            "search": self.searcher.stats.to_json(),
             "refresh": {"total": e.refresh_total},
             "flush": {"total": e.flush_total},
             "merges": {"total": e.merge_total},
             "segments": {
-                "count": len(self.engine.segments),
-                "memory_in_bytes": sum(s.memory_bytes() for s in self.engine.segments),
+                "count": len(segs),
+                "memory_in_bytes": sum(s.memory_bytes() for s in segs),
+            },
+            "fielddata": {
+                "memory_size_in_bytes": sum(fd_fields.values()),
+                "evictions": 0,
+                "fields": {f: {"memory_size_in_bytes": b}
+                           for f, b in fd_fields.items()},
+            },
+            "completion": {
+                "size_in_bytes": sum(comp_fields.values()),
+                "fields": {f: {"size_in_bytes": b}
+                           for f, b in comp_fields.items()},
             },
             "translog": {"operations": self.engine.translog.size_in_ops},
+            # Lucene CommitStats analogue: stable engine identity +
+            # refresh/flush generation (the `shards` level echoes it)
+            "commit": {"id": self.engine.commit_id,
+                       "generation": e.refresh_total + e.flush_total + 1},
         }
+
+    def _completion_sizes(self, segs) -> dict:
+        """Per-field bytes held by the completion suggester's sorted
+        prefix arrays (reference: CompletionStats per-field FST sizes)."""
+        comp_names = [fm.name for fm in self.searcher.mappings.all_fields()
+                      if getattr(fm, "type", None) == "completion"]
+        if not comp_names:
+            return {}
+        from elasticsearch_tpu.search.suggest import _segment_completions
+
+        out: dict = {}
+        for seg in segs:
+            for fname in comp_names:
+                inputs, meta = _segment_completions(seg, fname)
+                if not inputs:
+                    continue
+                b = sum(len(s.encode()) + 16 for s in inputs)
+                b += sum(len(str(m[2]).encode()) for m in meta)
+                out[fname] = out.get(fname, 0) + b
+        return out
 
     def close(self):
         self.engine.close()

@@ -30,18 +30,34 @@ class SearchStats:
         self.fetch_time_ms = 0.0
         self.suggest_total = 0
         self.scroll_total = 0
+        # per-group counters for requests tagged with body `stats: [...]`
+        # (reference: SearchStats groupStats / the `groups` scope of _stats)
+        self.groups: Dict[str, Dict[str, int]] = {}
 
-    def on_query(self, ms: float, n: int = 1):
+    def _group(self, g: str) -> Dict[str, int]:
+        return self.groups.setdefault(g, {
+            "query_total": 0, "query_time_in_millis": 0,
+            "fetch_total": 0, "fetch_time_in_millis": 0})
+
+    def on_query(self, ms: float, n: int = 1, groups=None):
         """n > 1: a batched execution serving n requests at once (msearch
         fast path) — counters must match the sequential path's totals."""
         with self._lock:
             self.query_total += n
             self.query_time_ms += ms
+            for g in groups or ():
+                gs = self._group(str(g))
+                gs["query_total"] += n
+                gs["query_time_in_millis"] += int(ms)
 
-    def on_fetch(self, ms: float, n: int = 1):
+    def on_fetch(self, ms: float, n: int = 1, groups=None):
         with self._lock:
             self.fetch_total += n
             self.fetch_time_ms += ms
+            for g in groups or ():
+                gs = self._group(str(g))
+                gs["fetch_total"] += n
+                gs["fetch_time_in_millis"] += int(ms)
 
     def on_suggest(self):
         with self._lock:
@@ -52,7 +68,7 @@ class SearchStats:
             self.scroll_total += 1
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "query_total": self.query_total,
             "query_time_in_millis": int(self.query_time_ms),
             "fetch_total": self.fetch_total,
@@ -60,6 +76,9 @@ class SearchStats:
             "suggest_total": self.suggest_total,
             "scroll_total": self.scroll_total,
         }
+        if self.groups:
+            out["groups"] = {g: dict(gs) for g, gs in self.groups.items()}
+        return out
 
 
 def process_stats() -> dict:

@@ -88,7 +88,8 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
         return None
     q_ms = (time.perf_counter() - t0) * 1000
     for s in searchers:
-        s.stats.on_query(q_ms / max(len(searchers), 1))
+        s.stats.on_query(q_ms / max(len(searchers), 1),
+                         groups=body.get("stats"))
 
     from elasticsearch_tpu.search.context import SegmentContext
     from elasticsearch_tpu.search.service import ShardDoc, _sort_key, _sort_value
@@ -147,7 +148,8 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
     for sh, ds in by_shard.items():
         tf = time.perf_counter()
         hits.extend(searchers[sh].fetch_phase(ds, body, svc.name))
-        searchers[sh].stats.on_fetch((time.perf_counter() - tf) * 1000)
+        searchers[sh].stats.on_fetch((time.perf_counter() - tf) * 1000,
+                                     groups=body.get("stats"))
         fetched_docs.extend(ds)
     order = {id(d): i for i, d in enumerate(page)}
     hd = sorted(zip(hits, fetched_docs), key=lambda x: order[id(x[1])])

@@ -237,7 +237,8 @@ def _register_all(rc: RestController):
         lambda n, p, b, index: _cluster_health(n, p, b))
     add("GET", "/_cluster/state/{metric}", _cluster_state_metric)
     add("GET", "/_cluster/state/{metric}/{index}",
-        lambda n, p, b, metric, index: _cluster_state_metric(n, p, b, metric))
+        lambda n, p, b, metric, index: _cluster_state_metric(
+            n, p, b, metric, index))
     add("GET", "/_cluster/stats/nodes/{nodeid}",
         lambda n, p, b, nodeid: _cluster_stats(n, p, b))
     add("GET", "/_mapping", _get_mapping_root)
@@ -286,7 +287,8 @@ def _register_all(rc: RestController):
     add("POST", "/_snapshot/{repo}/{snap}/_create", _put_snapshot)
     add("POST", "/_search/template/{id}", _put_search_template)
     add("GET", "/_mapping/{type}/field/{field}",
-        lambda n, p, b, type, field: _get_field_mapping(n, p, b, field, None))
+        lambda n, p, b, type, field: _get_field_mapping(
+            n, p, b, field, None, doc_type=type))
     # nodes.info / nodes.stats scoped forms (single node: node_id/metric
     # selectors accept anything and return this node's full view)
     add("GET", "/_nodes/hotthreads", _hot_threads)
@@ -493,7 +495,7 @@ def _register_all(rc: RestController):
         200, n.get_mapping(index)))
     add("GET", "/{index}/_mapping/{type}/field/{field}",
         lambda n, p, b, index, type, field:
-        _get_field_mapping(n, p, b, field, index))
+        _get_field_mapping(n, p, b, field, index, doc_type=type))
     add("GET", "/{index}/_stats/{metric}",
         lambda n, p, b, index, metric: _index_stats(n, p, b, index, metric))
     add("GET", "/{index}/_warmers", _get_warmers)
@@ -725,8 +727,9 @@ def _sum_stats(dicts):
 
 # every section the IndicesStatsResponse carries; sections our runtime has
 # no meaningful numbers for report zeroed structures (they exist so metric
-# scoping and client consumers see the full 2.0 shape; fielddata stays
-# zero BY DESIGN — doc values are always device-resident)
+# scoping and client consumers see the full 2.0 shape). fielddata reports
+# the always-resident device column bytes (built at freeze, never evicted
+# — see TpuSegment.fielddata_field_bytes)
 _STATS_SECTIONS = {
     "docs": {"count": 0, "deleted": 0},
     "store": {"size_in_bytes": 0, "throttle_time_in_millis": 0},
@@ -769,17 +772,58 @@ def _full_sections(st: dict) -> dict:
     return out
 
 
+def _name_filter(spec):
+    """Comma-separated name/wildcard list -> predicate (None = not asked)."""
+    if spec in (None, ""):
+        return None
+    import fnmatch
+
+    pats = [x.strip() for x in str(spec).split(",") if x.strip()]
+    return lambda nm: any(fnmatch.fnmatchcase(nm, pt) for pt in pats)
+
+
 def _stats_envelope(n: Node, names, metric: Optional[str] = None,
-                    level: str = "indices") -> dict:
+                    level: str = "indices",
+                    params: Optional[dict] = None) -> dict:
     """IndicesStatsResponse shape: _shards + _all.primaries/total +
     per-index entries (total == primaries here: replica stats mirror the
-    primary), every section present, metric-scoped when asked."""
+    primary), every section present, metric-scoped when asked. The
+    fields/fielddata_fields/completion_fields/groups/types params scope
+    the per-field / per-group / per-type breakdowns exactly like
+    CommonStatsFlags: absent param -> the breakdown key is absent."""
+    params = params or {}
+    fd_keep = _name_filter(params.get("fielddata_fields")
+                           or params.get("fields"))
+    comp_keep = _name_filter(params.get("completion_fields")
+                             or params.get("fields"))
+    grp_keep = _name_filter(params.get("groups"))
+    type_keep = _name_filter(params.get("types"))
+
+    def _scope_breakdowns(st):
+        for section, key, keep in (("fielddata", "fields", fd_keep),
+                                   ("completion", "fields", comp_keep),
+                                   ("search", "groups", grp_keep),
+                                   ("indexing", "types", type_keep)):
+            d = st.get(section)
+            if not isinstance(d, dict):
+                continue
+            if keep is None:
+                d.pop(key, None)
+            else:
+                d[key] = {k2: v2 for k2, v2 in (d.get(key) or {}).items()
+                          if keep(k2)}
+        return st
+
     per = {}
     shards_per = {}
     for nm in names:
         raw = n.indices[nm].stats()
-        shard_stats = {sid: _full_sections(sh)
-                       for sid, sh in raw.get("shards", {}).items()}
+        shard_stats = {}
+        for sid, sh in raw.get("shards", {}).items():
+            full = _full_sections(sh)
+            if "commit" in sh:  # CommitStats rides the shards level only
+                full["commit"] = sh["commit"]
+            shard_stats[sid] = full
         total = _full_sections(_sum_stats(raw.get("shards", {}).values()))
         per[nm] = total
         shards_per[nm] = shard_stats
@@ -790,8 +834,8 @@ def _stats_envelope(n: Node, names, metric: Optional[str] = None,
         keep = {alias.get(m.strip(), m.strip())
                 for m in str(metric).split(",")}
     def scope(st):
-        return ({k: v for k, v in st.items() if k in keep}
-                if keep else st)
+        return _scope_breakdowns(
+            {k: v for k, v in st.items() if k in keep} if keep else st)
     agg = _full_sections(_sum_stats(per.values()))
     out = {
         "_shards": _shards_header(n, names),
@@ -815,12 +859,11 @@ def _all_stats(n: Node) -> dict:
 def _index_stats(n: Node, p, b, index: str, metric: Optional[str] = None):
     """GET /{index}/_stats[/{metric}] with multi-index expressions and
     level=indices|shards scoping."""
-    names = n.resolve_indices(index)
-    if not names and index not in (None, "", "_all", "*"):
-        raise IndexNotFoundException(index)
+    names = _resolve_indices_options(n, index, p)
     return 200, _stats_envelope(n, names,
                                 metric=metric or p.get("metric"),
-                                level=p.get("level", "indices"))
+                                level=p.get("level", "indices"),
+                                params=p)
 
 
 
@@ -1490,6 +1533,18 @@ def _get_doc(n: Node, p, b, index: str, id: str):
                 if loc is not None and loc.parent is not None:
                     out["_parent"] = loc.parent
                 continue
+            if f == "_timestamp":
+                if loc is not None and loc.timestamp is not None:
+                    out["_timestamp"] = loc.timestamp
+                continue
+            if f == "_ttl":
+                # remaining millis, as TTLFieldMapper serves it
+                if loc is not None and loc.ttl_expiry:
+                    import time as _t
+
+                    out["_ttl"] = max(
+                        0, loc.ttl_expiry - int(_t.time() * 1000))
+                continue
             cur: Any = src
             for part in f.split("."):
                 cur = cur.get(part) if isinstance(cur, dict) else None
@@ -1547,8 +1602,19 @@ def _update_doc(n: Node, p, b, index: str, id: str,
     # routes through auto-create like index does)
     svc = n.get_or_autocreate(index)
     body = _json(b)
-    r = svc.update_doc(id, body, routing=p.get("routing"),
-                       doc_type=doc_type)
+    kw: Dict[str, Any] = {}
+    if "version" in p:
+        kw["version"] = int(p["version"])
+        kw["version_type"] = p.get("version_type", "internal")
+    if p.get("parent"):
+        kw["parent"] = p["parent"]
+    if p.get("timestamp"):
+        kw["timestamp"] = p["timestamp"]
+    if p.get("ttl"):
+        kw["ttl"] = p["ttl"]
+    r = svc.update_doc(id, body,
+                       routing=p.get("routing") or p.get("parent"),
+                       doc_type=doc_type, **kw)
     fields = p.get("fields") or body.get("fields")
     if fields:
         # UpdateResponse "get" envelope (UpdateHelper.extractGetResult)
@@ -1570,7 +1636,7 @@ def _update_doc(n: Node, p, b, index: str, id: str,
         if fl:
             env["fields"] = fl
         r["get"] = env
-    if p.get("refresh") in ("true", ""):
+    if p.get("refresh") in ("true", "", "1"):
         svc.refresh()
     return 200, r
 
@@ -1683,9 +1749,15 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
     try:
         svc = n.get_index(iname)
     except ElasticsearchTpuException as e:
-        return {"_index": iname, "_id": doc_id,
-                "error": {"type": e.error_type, "reason": str(e)}}
-    rt = spec.get("routing") or spec.get("_routing")
+        # a missing index reads as a per-doc miss with the request's
+        # coordinates echoed (MultiGetResponse keeps the failure per item)
+        out = {"_index": iname, "_id": doc_id, "found": False,
+               "error": {"type": e.error_type, "reason": str(e)}}
+        if want_type is not None:
+            out["_type"] = want_type
+        return out
+    rt = (spec.get("routing") or spec.get("_routing")
+          or spec.get("parent") or spec.get("_parent"))
     rt = str(rt) if rt is not None else None
     got = svc.get_doc(doc_id, routing=rt, **_realtime_kw(n, p, iname))
     got["_index"] = svc.name  # concrete index, even via an alias
@@ -1696,11 +1768,15 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
         got = {"_index": svc.name, "_id": doc_id, "found": False}
     if want_type is not None and not got.get("found"):
         got["_type"] = want_type
-    flds = spec.get("fields") or spec.get("_fields")
+    flds = spec.get("fields") or spec.get("_fields") or p.get("fields")
     if flds and got.get("found"):
-        names = [flds] if isinstance(flds, str) else list(flds)
+        names = (flds.split(",") if isinstance(flds, str) else list(flds))
         loc = svc.route(doc_id, rt).engine._locations.get(doc_id)
         src = got.get("_source") or {}
+        if "_source" not in names:
+            # requesting fields suppresses _source unless asked for
+            # explicitly (GetRequest.fields semantics)
+            got.pop("_source", None)
         fl: Dict[str, Any] = {}
         for f in names:
             if f == "_routing" and loc is not None \
@@ -1718,8 +1794,10 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
         got["fields"] = fl
     sf = spec.get("_source", p.get("_source"))
     if sf is None and ("_source_include" in p or "_source_exclude" in p):
-        sf = {"include": p.get("_source_include"),
-              "exclude": p.get("_source_exclude")}
+        sf = {"include": [x for x in
+                          (p.get("_source_include") or "").split(",") if x],
+              "exclude": [x for x in
+                          (p.get("_source_exclude") or "").split(",") if x]}
     if isinstance(sf, str) and sf.lower() in ("true", "false"):
         sf = sf.lower() == "true"
     if isinstance(sf, str) and "," in sf:
@@ -1732,14 +1810,32 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
     return got
 
 
-def _mget(n: Node, p, b, index: Optional[str] = None):
+def _mget(n: Node, p, b, index: Optional[str] = None,
+          doc_type: Optional[str] = None):
+    from elasticsearch_tpu.utils.errors import \
+        ActionRequestValidationException
+
     body = _json(b)
+    # body-level index/type are per-request defaults (MultiGetRequest)
+    index = index or body.get("index")
+    doc_type = doc_type or body.get("type")
     if "ids" in body:
-        docs = [_mget_one(n, {"_id": i}, index, p) for i in body["ids"]]
+        specs = [{"_id": i} for i in body["ids"]]
     else:
-        docs = [_mget_one(n, spec, index, p)
-                for spec in body.get("docs", [])]
-    return 200, {"docs": docs}
+        specs = list(body.get("docs") or [])
+    if not specs:
+        raise ActionRequestValidationException("no documents to get")
+    problems = []
+    for spec in specs:
+        if doc_type is not None and doc_type != "_all":
+            spec.setdefault("_type", doc_type)
+        if spec.get("_id") is None:
+            problems.append("id is missing")
+        if spec.get("_index", index) is None:
+            problems.append("index is missing")
+    if problems:
+        raise ActionRequestValidationException(*problems)
+    return 200, {"docs": [_mget_one(n, spec, index, p) for spec in specs]}
 
 
 def _mget_index(n: Node, p, b, index: str):
@@ -1767,15 +1863,8 @@ def _bulk(n: Node, p, b, index: Optional[str] = None,
 
 def _mget_typed(n: Node, p, b, index: str, type: Optional[str]):
     """Typed mget: the path {type} becomes each doc spec's default _type
-    (then the usual type-filtered read applies)."""
-    body = _json(b)
-    if type and type != "_all":
-        for spec in body.get("docs", []):
-            if isinstance(spec, dict):
-                spec.setdefault("_type", type)
-    import json as _j
-
-    return _mget(n, p, _j.dumps(body).encode(), index)
+    (then the usual type-filtered read applies) — ids lists included."""
+    return _mget(n, p, b, index, doc_type=type)
 
 
 def _termvectors_noid(n: Node, p, b, index: str):
@@ -2291,11 +2380,94 @@ def _cluster_health(n: Node, p, b):
     return 200, h
 
 
-def _cluster_state_metric(n: Node, p, b, metric: str):
+def _resolve_indices_options(n: Node, index_expr: str, p) -> List[str]:
+    """IndicesOptions resolution (reference: IndicesOptions.fromParameters
+    + IndexNameExpressionResolver.concreteIndices): expand_wildcards scopes
+    which states wildcards see, ignore_unavailable forgives named misses,
+    allow_no_indices forgives wildcard no-matches."""
+    import fnmatch
+
+    ew = {x.strip() for x in str(p.get("expand_wildcards", "open")
+                                 ).split(",")}
+    if ew & {"both", "all"}:
+        ew = {"open", "closed"}
+    ignore_unavailable = str(p.get("ignore_unavailable", "false")
+                             ).lower() in ("true", "1", "")
+    allow_no = str(p.get("allow_no_indices", "true")
+                   ).lower() not in ("false", "0")
+    out: List[str] = []
+    for part in str(index_expr or "_all").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part == "_all" or any(c in part for c in "*?"):
+            pat = "*" if part == "_all" else part
+            matched = [
+                nm for nm in n.indices
+                if fnmatch.fnmatchcase(nm, pat)
+                and (("open" in ew and not n.indices[nm].closed)
+                     or ("closed" in ew and n.indices[nm].closed))]
+            if not matched and not allow_no:
+                raise IndexNotFoundException(part)
+            out.extend(sorted(matched))
+            continue
+        resolved = n.resolve_indices(part)
+        if not resolved:
+            if not ignore_unavailable:
+                raise IndexNotFoundException(part)
+            continue
+        out.extend(resolved)
+    seen = set()
+    return [nm for nm in out if not (nm in seen or seen.add(nm))]
+
+
+def _cluster_state_metric(n: Node, p, b, metric: str,
+                          index: Optional[str] = None):
     """RestClusterStateAction metric scoping: only the requested sections
-    appear (blocks is always available and empty — no block levels here)."""
-    full = dict(n.cluster_state.to_json())
-    full.setdefault("blocks", {})
+    appear (blocks is always available and empty — no block levels here);
+    an index expression filters metadata/routing_table to the concrete
+    indices it resolves to under the request's IndicesOptions."""
+    import copy
+
+    from elasticsearch_tpu.cluster.metadata import _block
+
+    full = copy.deepcopy(n.cluster_state.to_json())
+    # blocks built live from index state/settings (reference:
+    # ClusterBlocks — ids: 4 = INDEX_CLOSED_BLOCK, 5 = INDEX_READ_ONLY,
+    # 7 = INDEX_READ, 8 = INDEX_WRITE)
+    blocks: Dict[str, Any] = {}
+    _BLOCKS = (("read_only", "5", "index read-only (api)",
+                ["write", "metadata_write"]),
+               ("read", "7", "index read (api)", ["read"]),
+               ("write", "8", "index write (api)", ["write"]))
+    for nm, svc in n.indices.items():
+        bl = {}
+        if getattr(svc, "closed", False):
+            bl["4"] = {"description": "index closed", "retryable": False,
+                       "levels": ["read", "write"]}
+        for key, bid, desc, levels in _BLOCKS:
+            if _block(svc, key):
+                bl[bid] = {"description": desc, "retryable": False,
+                           "levels": levels}
+        if bl:
+            blocks.setdefault("indices", {})[nm] = bl
+    full["blocks"] = blocks
+    # routing_nodes: the per-node view of the same shard routings
+    if "routing_nodes" not in full:
+        rt = full.get("routing_table", {}).get("indices", {})
+        assigned = [sh for idx in rt.values()
+                    for shards in idx.get("shards", {}).values()
+                    for sh in shards]
+        nid = full.get("master_node") or "local"
+        full["routing_nodes"] = {"unassigned": [], "nodes": {nid: assigned}}
+    if index is not None:
+        names = set(_resolve_indices_options(n, index, p))
+        for section, key in (("metadata", "indices"),
+                             ("routing_table", "indices")):
+            sec = full.get(section)
+            if isinstance(sec, dict) and isinstance(sec.get(key), dict):
+                sec[key] = {nm: v for nm, v in sec[key].items()
+                            if nm in names}
     keep = {m.strip() for m in metric.split(",")}
     if "_all" in keep or "*" in keep:
         return 200, full
@@ -2522,18 +2694,40 @@ def _type_exists(n: Node, p, b, index: str, type: str):
     return 404, None
 
 
-def _get_field_mapping(n: Node, p, b, field: str, index: Optional[str] = None):
-    """RestGetFieldMappingAction: per-index leaf mapping for field
-    patterns (comma list, wildcards)."""
+def _get_field_mapping(n: Node, p, b, field: str,
+                       index: Optional[str] = None,
+                       doc_type: Optional[str] = None):
+    """RestGetFieldMappingAction / TransportGetFieldMappingsIndexAction:
+    per-index leaf mapping for field patterns. A pattern is tried against
+    the FULL name first (key = full name); failing that, against the leaf
+    ("index") name — then the response key is the leaf name with
+    `full_name` pointing at the real path. Indices with no matching
+    fields are omitted; an explicit missing index or type 404s;
+    include_defaults echoes the implicit analyzer as `default`."""
     import fnmatch
 
     from elasticsearch_tpu.index.mappings import _field_to_json
+    from elasticsearch_tpu.utils.errors import TypeMissingException
 
     pats = [f.strip() for f in field.split(",")]
+    include_defaults = str(p.get("include_defaults", "false")
+                           ).lower() in ("true", "1", "")
+    names = _resolve_indices_options(n, index, p)
+    type_pats = None
+    if doc_type not in (None, "", "_all", "*"):
+        type_pats = [t.strip() for t in str(doc_type).split(",")]
     out = {}
-    for iname in n.resolve_indices(index):
+    type_matched = False
+    for iname in names:
         svc = n.indices[iname]
-        fields = {}
+        tnames = svc.mappings.type_names or ["_doc"]
+        if type_pats is not None:
+            tnames = [t for t in tnames
+                      if any(fnmatch.fnmatchcase(t, tp)
+                             for tp in type_pats)]
+            if not tnames:
+                continue
+        type_matched = True
         leaves = []
         for fname, fm in svc.mappings.fields.items():
             leaves.append((fname, fm))
@@ -2541,15 +2735,35 @@ def _get_field_mapping(n: Node, p, b, field: str, index: Optional[str] = None):
             # parent's fields map, not in the flat index
             leaves.extend((f"{fname}.{sub}", sfm)
                           for sub, sfm in fm.fields.items())
+        fields = {}
+
+        def entry(fname, fm, leaf):
+            mj = _field_to_json(fm)
+            if include_defaults and fm.is_text:
+                mj.setdefault("analyzer", "default")
+            return {"full_name": fname, "mapping": {leaf: mj}}
+
+        # pass 1: full-name matches (keyed by full name); pass 2:
+        # leaf-name matches fill remaining keys only — a relative match
+        # must never shadow a full-name one (t* keeps {t1, t2} even though
+        # obj.t1's leaf also matches)
+        taken = set()
         for fname, fm in leaves:
-            if any(fnmatch.fnmatch(fname, pat) for pat in pats):
-                leaf = fname.rpartition(".")[2]
-                fields[fname] = {"full_name": fname,
-                                 "mapping": {leaf: _field_to_json(fm)}}
-        # response keys by declared type names (2.0 typed form) when the
-        # index has them, else the single-type default
-        tnames = svc.mappings.type_names or ["_doc"]
-        out[iname] = {"mappings": {t: fields for t in tnames}}
+            leaf = fname.rpartition(".")[2]
+            if not fname.startswith("_") and any(
+                    fnmatch.fnmatchcase(fname, pat) for pat in pats):
+                fields[fname] = entry(fname, fm, leaf)
+                taken.add(fname)
+        for fname, fm in leaves:
+            leaf = fname.rpartition(".")[2]
+            if fname.startswith("_") or fname in taken or leaf in fields:
+                continue
+            if any(fnmatch.fnmatchcase(leaf, pat) for pat in pats):
+                fields[leaf] = entry(fname, fm, leaf)
+        if fields:
+            out[iname] = {"mappings": {t: dict(fields) for t in tnames}}
+    if type_pats is not None and not type_matched and names:
+        raise TypeMissingException(",".join(type_pats))
     return 200, out
 
 

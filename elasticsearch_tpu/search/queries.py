@@ -465,6 +465,13 @@ class TermQuery(Query):
         return str(v)
 
     def execute(self, ctx) -> ExecResult:
+        if self.field in ("_id", "_uid"):
+            # _id is not an inverted field here (the id_map plays Lucene's
+            # _uid term dictionary) — a term on it IS an ids query
+            v = self.value
+            if isinstance(v, str) and self.field == "_uid" and "#" in v:
+                v = v.split("#", 1)[1]  # _uid = type#id
+            return IdsQuery([v], boost=self.boost).execute(ctx)
         fm = ctx.mappings.get(self.field)
         if fm is not None and fm.is_numeric:
             # term query on a numeric field = exact-value range
@@ -1479,9 +1486,17 @@ def _parse_query_inner(dsl: Optional[dict]) -> Query:
     if qtype == "term":
         (field, spec), = body.items()
         if isinstance(spec, dict):
-            return TermQuery(field, spec.get("value", spec.get("term")),
-                             boost=float(spec.get("boost", 1.0)))
-        return TermQuery(field, spec)
+            value, boost = spec.get("value", spec.get("term")), \
+                float(spec.get("boost", 1.0))
+        else:
+            value, boost = spec, 1.0
+        if field in ("_id", "_uid"):
+            # _id has no inverted field (id_map is the _uid term dict):
+            # parse-time rewrite so the mesh compiler path sees it too
+            if field == "_uid" and isinstance(value, str) and "#" in value:
+                value = value.split("#", 1)[1]
+            return IdsQuery([value], boost=boost)
+        return TermQuery(field, value, boost=boost)
 
     if qtype == "terms":
         body = dict(body)
@@ -1489,6 +1504,11 @@ def _parse_query_inner(dsl: Optional[dict]) -> Query:
         body.pop("minimum_should_match", None)
         body.pop("execution", None)
         (field, values), = body.items()
+        if field in ("_id", "_uid"):
+            vals = [v.split("#", 1)[1] if (field == "_uid"
+                    and isinstance(v, str) and "#" in v) else v
+                    for v in values]
+            return IdsQuery(vals, boost=boost)
         return TermsQuery(field, list(values), boost=boost)
 
     if qtype == "range":

@@ -529,7 +529,7 @@ def search_shards(
         for d in r.docs:
             d.shard_ord = pos
         q_ms = (time.perf_counter() - tq) * 1000
-        s.stats.on_query(q_ms)
+        s.stats.on_query(q_ms, groups=body.get("stats"))
         results.append(r)
         if profile:
             shard_profiles.append({
@@ -630,7 +630,7 @@ def search_shards(
         tf = time.perf_counter()
         hits.extend(searchers[shard_ord].fetch_phase(docs, body, index_name))
         f_ms = (time.perf_counter() - tf) * 1000
-        searchers[shard_ord].stats.on_fetch(f_ms)
+        searchers[shard_ord].stats.on_fetch(f_ms, groups=body.get("stats"))
         if profile and shard_ord < len(shard_profiles):
             shard_profiles[shard_ord]["fetch"] = {"time_in_nanos": int(f_ms * 1e6)}
     # restore global order after per-shard fetch

@@ -28,6 +28,40 @@ class IllegalArgumentException(ElasticsearchTpuException):
     status = 400
 
 
+class ActionRequestValidationException(ElasticsearchTpuException):
+    """Request-level validation failures (reference:
+    action/ActionRequestValidationException — 'Validation Failed: 1: ...')."""
+
+    status = 400
+
+    def __init__(self, *problems: str):
+        msg = "Validation Failed: " + " ".join(
+            f"{i + 1}: {p};" for i, p in enumerate(problems))
+        super().__init__(msg)
+
+
+class TypeMissingException(ElasticsearchTpuException):
+    """Requested mapping type does not exist (reference:
+    indices/TypeMissingException.java)."""
+
+    status = 404
+
+    def __init__(self, doc_type: str):
+        super().__init__(f"type[[{doc_type}]] missing")
+
+
+class AlreadyExpiredException(ElasticsearchTpuException):
+    """Doc indexed with a TTL whose expiry is already in the past
+    (reference: index/AlreadyExpiredException.java via TTLFieldMapper)."""
+
+    status = 400
+
+    def __init__(self, doc_id: str, timestamp: int, ttl_ms: int):
+        super().__init__(
+            f"already expired [{doc_id}]: timestamp [{timestamp}] + "
+            f"ttl [{ttl_ms}ms] is in the past")
+
+
 class IndexNotFoundException(ElasticsearchTpuException):
     status = 404
 

@@ -14,8 +14,17 @@ HLL_BITS = 12
 HLL_M = 1 << HLL_BITS
 
 
-def murmur3_32(s: str, seed: int = 0) -> int:
-    data = s.encode("utf-8")
+def routing_hash(s: str) -> int:
+    """Reference Murmur3HashFunction.hash(String): murmurhash3_x86_32 over
+    the UTF-16LE bytes of the routing key, seed 0, as a SIGNED 32-bit int
+    (OperationRouting then takes MathUtils.mod == Python's %). Distinct
+    from ``murmur3_32``: the murmur3 FIELD MAPPER hashes UTF-8 bytes."""
+    h = murmur3_32(s, encoding="utf-16-le")
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+def murmur3_32(s: str, seed: int = 0, encoding: str = "utf-8") -> int:
+    data = s.encode(encoding)
     c1, c2 = 0xCC9E2D51, 0x1B873593
     h = seed & 0xFFFFFFFF
     n = len(data) // 4 * 4

@@ -60,24 +60,6 @@ SKIP_TESTS = {
         'reroute response filtering/explain detail beyond the single-node acknowledgement',
     ('cluster.reroute/20_response_filtering.yaml', 'return metadata if requested'):
         'reroute response filtering/explain detail beyond the single-node acknowledgement',
-    ('cluster.state/20_filtering.yaml', 'Filtering the cluster state by blocks should return the blocks'):
-        'cluster blocks and expand_wildcards state filtering not modeled (single-node cluster state; blocks map is always empty)',
-    ('cluster.state/20_filtering.yaml', 'Filtering the cluster state by blocks should return the blocks field even if the respon'):
-        'cluster blocks and expand_wildcards state filtering not modeled (single-node cluster state; blocks map is always empty)',
-    ('cluster.state/20_filtering.yaml', 'Filtering the cluster state by blocks should return the blocks field even if the response is empty'):
-        'cluster blocks not modeled (single-node cluster state; blocks map is always empty)',
-    ('cluster.state/20_filtering.yaml', 'Filtering the cluster state by indices should work in routing table and metadata'):
-        'cluster blocks and expand_wildcards state filtering not modeled (single-node cluster state; blocks map is always empty)',
-    ('cluster.state/20_filtering.yaml', 'Filtering the cluster state by routing nodes only should work'):
-        'cluster blocks and expand_wildcards state filtering not modeled (single-node cluster state; blocks map is always empty)',
-    ('cluster.state/20_filtering.yaml', 'Filtering the cluster state using _all for indices and metrics should work'):
-        'cluster blocks and expand_wildcards state filtering not modeled (single-node cluster state; blocks map is always empty)',
-    ('cluster.state/30_expand_wildcards.yaml', 'Test allow_no_indices parameter'):
-        'cluster blocks and expand_wildcards state filtering not modeled (single-node cluster state; blocks map is always empty)',
-    ('cluster.state/30_expand_wildcards.yaml', 'Test expand_wildcards parameter on closed, open indices and both'):
-        'cluster blocks and expand_wildcards state filtering not modeled (single-node cluster state; blocks map is always empty)',
-    ('cluster.state/30_expand_wildcards.yaml', 'Test ignore_unavailable parameter'):
-        'cluster blocks and expand_wildcards state filtering not modeled (single-node cluster state; blocks map is always empty)',
     ('delete/11_shard_header.yaml', 'Delete check shard header'):
         'delete tail: shard-header detail, refresh/missing edge semantics',
     ('delete/45_parent_with_routing.yaml', 'Parent with routing'):
@@ -116,24 +98,6 @@ SKIP_TESTS = {
         'alias GET scoping edge cases (name-only misses per index)',
     ('indices.get_aliases/10_basic.yaml', 'Non-existent alias on an existing index returns matching indcies'):
         'legacy _aliases response including empty entries',
-    ('indices.get_field_mapping/10_basic.yaml', 'Get field mapping with include_defaults'):
-        'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_field_mapping/20_missing_field.yaml', "Return empty object if field doesn't exist, but type and index do"):
-        'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_field_mapping/30_missing_type.yaml', "Raise 404 when type doesn't exist"):
-        'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_field_mapping/40_missing_index.yaml', "Raise 404 when index doesn't exist"):
-        'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_field_mapping/50_field_wildcards.yaml', "Get field mapping should work using '*' for indices and types"):
-        'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_field_mapping/50_field_wildcards.yaml', "Get field mapping should work using '_all' for indices and types"):
-        'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_field_mapping/50_field_wildcards.yaml', 'Get field mapping should work using comma_separated values for indice'):
-        'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_field_mapping/50_field_wildcards.yaml', 'Get field mapping should work using comma_separated values for indices and types'):
-        'field-mapping include_defaults and multi_field full_name echo',
-    ('indices.get_field_mapping/50_field_wildcards.yaml', 'Get field mapping with wildcarded relative names'):
-        'field-mapping include_defaults and multi_field full_name echo',
     ('indices.get_mapping/50_wildcard_expansion.yaml', 'Get test-* with wildcard_expansion=none'):
         'typed-mapping miss/wildcard response shapes beyond the single-type echo',
     ('indices.get_settings/10_basic.yaml', 'Get /{index}/_settings/_all'):
@@ -166,102 +130,6 @@ SKIP_TESTS = {
         'per-segment Lucene detail (version/compound/search flags) beyond our device-segment model',
     ('indices.segments/10_basic.yaml', 'no segments test'):
         'per-segment Lucene detail (version/compound/search flags) beyond our device-segment model',
-    ('indices.stats/10_index.yaml', 'Index - star, no match'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/12_level.yaml', 'Level - shards'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Completion - all metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Completion - multi metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Completion - one metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Completion - pattern'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Completion fields - multi'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Completion fields - one'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Completion fields - star'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fielddata fields - all metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fielddata fields - multi'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fielddata fields - multi metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fielddata fields - one'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fielddata fields - one metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fielddata fields - pattern'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fielddata fields - star'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - _all metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - blank'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - completion metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - fielddata metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - multi'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - multi metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - one'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - pattern'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/13_fields.yaml', 'Fields - star'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/14_groups.yaml', 'Groups - _all metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/14_groups.yaml', 'Groups - blank'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/14_groups.yaml', 'Groups - multi'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/14_groups.yaml', 'Groups - multi metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/14_groups.yaml', 'Groups - one'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/14_groups.yaml', 'Groups - pattern'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/14_groups.yaml', 'Groups - search metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/14_groups.yaml', 'Groups - star'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/15_types.yaml', 'Types - _all metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/15_types.yaml', 'Types - indexing metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/15_types.yaml', 'Types - multi'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/15_types.yaml', 'Types - multi metric'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/15_types.yaml', 'Types - one'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/15_types.yaml', 'Types - pattern'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('indices.stats/15_types.yaml', 'Types - star'):
-        'per-field fielddata/completion/groups/types stats not modeled: the TPU design has no fielddata tier (doc values are always device-resident) and search groups / per-type indexing counters are not tracked',
-    ('mget/12_non_existent_index.yaml', 'Non-existent index'):
-        'mget tail: per-doc parent/routing/fields options',
-    ('mget/13_missing_metadata.yaml', 'Missing metadata'):
-        'mget tail: per-doc parent/routing/fields options',
-    ('mget/15_ids.yaml', 'IDs'):
-        'mget tail: per-doc parent/routing/fields options',
-    ('mget/20_fields.yaml', 'Fields'):
-        'mget tail: per-doc parent/routing/fields options',
-    ('mget/30_parent.yaml', 'Parent'):
-        'mget tail: per-doc parent/routing/fields options',
-    ('mget/40_routing.yaml', 'Routing'):
-        'mget tail: per-doc parent/routing/fields options',
-    ('mget/55_parent_with_routing.yaml', 'Parent'):
-        'mget tail: per-doc parent/routing/fields options',
-    ('mget/70_source_filtering.yaml', 'Source filtering -  ids and include nested field'):
-        'exclude-only source filter keeps full subtree minus leaf (nested exclude edge)',
     ('mlt/20_docs.yaml', 'Basic mlt query with docs'):
         'mlt docs/ignore variants (like/unlike doc references beyond stored-doc seeds)',
     ('mlt/30_ignore.yaml', 'Basic mlt query with ignore like'):
@@ -286,26 +154,6 @@ SKIP_TESTS = {
         'termvectors realtime/versioned reads',
     ('termvectors/40_versions.yaml', 'Versions'):
         'termvectors realtime/versioned reads',
-    ('update/11_shard_header.yaml', 'Update check shard header'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/30_internal_version.yaml', 'Internal version'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/35_other_versions.yaml', 'Not supported versions'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/40_routing.yaml', 'Routing'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/50_parent.yaml', 'Parent'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/50_parent.yaml', 'Parent omitted'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/55_parent_with_routing.yaml', 'Parent with routing'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/60_refresh.yaml', 'Refresh'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/70_timestamp.yaml', 'Timestamp'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
-    ('update/75_ttl.yaml', 'TTL'):
-        "update-API tail: fields param 'get' envelope, required-routing enforcement, TTL/timestamp echo",
 }
 
 
@@ -485,8 +333,10 @@ class Runner:
                                  f"{self.status}")
             return
         if catch.startswith("/") and catch.endswith("/"):
-            if self.status < 400 or not re.search(catch[1:-1], text,
-                                                  re.S | re.X):
+            # the reference compiles catch regexes with NO flags
+            # (DoSection.java -> RegexMatcher.matches): whitespace is
+            # literal, unlike `match` values which use COMMENTS mode
+            if self.status < 400 or not re.search(catch[1:-1], text, re.S):
                 raise StepFailed(
                     f"[{api}] expected error matching {catch}, got "
                     f"{self.status}: {text[:300]}")
