@@ -315,6 +315,19 @@ def cpu_bm25_latency(u_doc, tfn, offsets, idf, queries, n_docs, k, runs=3):
     return times, tops
 
 
+# fallback counters accumulated across the kernels.reset() calls below —
+# the budget check at the end must see the WHOLE workload
+FALLBACKS = {"mesh_fallback_total": 0, "span_clause_truncated": 0}
+
+
+def harvest_fallbacks():
+    from elasticsearch_tpu.monitor import kernels
+
+    snap = kernels.snapshot()
+    for key in FALLBACKS:
+        FALLBACKS[key] += int(snap.get(key, 0))
+
+
 def batched_msearch_qps(node, queries, k):
     """One Node.msearch call: the fused batch product path."""
     from elasticsearch_tpu.monitor import kernels
@@ -323,6 +336,7 @@ def batched_msearch_qps(node, queries, k):
               {"query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
                "size": k}) for q in queries]
     node.msearch(pairs)  # warmup at the FULL batch shape (jit is Q-static)
+    harvest_fallbacks()
     kernels.reset()
     t0 = time.perf_counter()
     resp = node.msearch(pairs)
@@ -651,6 +665,16 @@ def run_bench(args, jax) -> dict:
                 f"p50 {percentile_ms(times, 50):.2f} ms")
         knn["ivf_recall_curve"] = curve
 
+    # fallback budget (r4 verdict weak #5): the bench workload must be
+    # served by the device product path — any host fallback or span
+    # truncation on it is a regression, reported first-class
+    harvest_fallbacks()
+    mesh_fallback = FALLBACKS["mesh_fallback_total"]
+    span_trunc = FALLBACKS["span_clause_truncated"]
+    if mesh_fallback or span_trunc:
+        log(f"WARNING: fallback budget exceeded — mesh_fallback_total="
+            f"{mesh_fallback}, span_clause_truncated={span_trunc}")
+
     # steady-state floor: the same trivial call AFTER the workload ran —
     # some host-device links (tunneled chips) settle into a slower
     # synchronized mode once large transfers have occurred; p50 should be
@@ -689,6 +713,9 @@ def run_bench(args, jax) -> dict:
         "bm25_batched_mfu": round(bm25_mfu, 4),
         "target_p50_speedup": 8.0,
         "target_met": bool(vs >= 8.0),
+        "mesh_fallback_total": mesh_fallback,
+        "span_clause_truncated": span_trunc,
+        "fallback_budget_met": bool(mesh_fallback == 0 and span_trunc == 0),
         "docs": args.docs,
         "knn": knn,
     }

@@ -399,7 +399,9 @@ class IndexService:
         doc = (body or {}).get("doc")
         if doc is None:
             raise DocumentMissingException(self.name, "_percolate requires [doc]")
-        matches, _total = _perc(self.percolator, [doc], self.mappings, self.analysis)
+        matches, _total, perc_ctx = _perc(self.percolator, [doc],
+                                          self.mappings, self.analysis,
+                                          return_ctx=True)
         full = matches[0]
         # percolate-request query/filter restricts WHICH registered queries
         # participate: it runs against the .percolator docs' own metadata
@@ -415,13 +417,37 @@ class IndexService:
             full = [qid for qid in full if qid in allowed]
         size = (body or {}).get("size")
         listed = full if size is None else full[: int(size)]
-        return {
+        out = {
             "took": 0,
             "_shards": {"total": self.num_shards, "successful": self.num_shards,
                         "failed": 0},
             "total": len(full),  # total matched, even when size truncates
             "matches": [{"_index": self.name, "_id": qid} for qid in listed],
         }
+        hl_spec = (body or {}).get("highlight")
+        if hl_spec and listed:
+            from elasticsearch_tpu.search.percolator import highlight_matches
+
+            listed_set = set(listed)
+            by_id = {qid: pair for qid, pair in self.percolator.items()
+                     if qid in listed_set}
+            hl = highlight_matches(doc, by_id, hl_spec, self.mappings,
+                                   self.analysis, ctx=perc_ctx)
+            for m in out["matches"]:
+                if m["_id"] in hl:
+                    m["highlight"] = hl[m["_id"]]
+        aggs_spec = (body or {}).get("aggs") or (body or {}).get(
+            "aggregations")
+        if aggs_spec is not None:
+            # aggregations run over the MATCHED .percolator docs' own
+            # metadata fields (reference: PercolateSourceBuilder
+            # aggregations / PercolatorService agg phase)
+            r = self.search({"query": {"bool": {"filter": [
+                {"term": {"_type": PERCOLATOR_TYPE}},
+                {"ids": {"values": full}}]}},
+                "size": 0, "aggs": aggs_spec})
+            out["aggregations"] = r.get("aggregations", {})
+        return out
 
     def count(self, body: dict) -> dict:
         total = sum(s.searcher.count(body or {}) for s in self.shards)

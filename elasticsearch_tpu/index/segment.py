@@ -289,15 +289,24 @@ class InvertedField:
             # agreement). Host mirror stays f32 for mesh restacking.
             bf16 = os.environ.get("ESTPU_IMPACT_BF16", "").lower() in (
                 "1", "true")
-            if bf16:
-                import jax.numpy as jnp
-
-                dev = jnp.asarray(impact, dtype=jnp.bfloat16)
-            else:
-                dev = _device_put(impact)
-            if not DENSE_IMPACT_BUDGET.reserve(dev.nbytes):
+            # reserve BEFORE the device allocation: the breaker must gate
+            # the HBM landing, not account for it after the fact
+            nbytes = impact.size * (2 if bf16 else 4)
+            if not DENSE_IMPACT_BUDGET.reserve(nbytes):
                 return None  # lost a race for the budget: retry later
-            self._dense_bytes = dev.nbytes
+            try:
+                if bf16:
+                    import jax.numpy as jnp
+
+                    dev = jnp.asarray(impact, dtype=jnp.bfloat16)
+                else:
+                    dev = _device_put(impact)
+            except Exception:
+                # the breaker's accounting must not leak when the
+                # allocation itself fails (device OOM / transfer error)
+                DENSE_IMPACT_BUDGET.release(nbytes)
+                raise
+            self._dense_bytes = nbytes
             # host mirror: mesh prims restack [S, F, D] from it — pulling
             # the device copy back would be a huge d2h transfer (and on
             # network-attached chips big d2h pulls degrade the session)
@@ -420,6 +429,23 @@ class VectorColumn:
     # lazy IVF-flat coarse quantizer (ops/ivf.py); False = build attempted
     # and declined (too few vectors)
     _ivf: Any = None
+    # memoized content-address (slabs are immutable; SHA-1 of the full
+    # slab per freeze/snapshot call is measurable host CPU)
+    _ck: Any = None
+    _ck_max: int = -1
+
+    def cache_key(self, max_docs: int) -> str:
+        if self._ck is None or self._ck_max != max_docs:
+            from elasticsearch_tpu.index import ivf_cache
+
+            vh = (self.vecs_host if self.vecs_host is not None
+                  else np.asarray(self.vecs))
+            eh = (self.exists_host if self.exists_host is not None
+                  else np.asarray(self.exists))
+            self._ck = ivf_cache.content_key(vh, eh, self.similarity,
+                                             max_docs)
+            self._ck_max = max_docs
+        return self._ck
 
     def get_ivf(self, max_docs: int):
         """Build-once IVF index over this (immutable) slab, consulting the
@@ -435,7 +461,7 @@ class VectorColumn:
                   else np.asarray(self.vecs))
             eh = (self.exists_host if self.exists_host is not None
                   else np.asarray(self.exists))
-            key = ivf_cache.content_key(vh, eh, self.similarity, max_docs)
+            key = self.cache_key(max_docs)
             idx = ivf_cache.load(key)
             if idx is None:
                 idx = build_ivf(vh, eh, max_docs, metric=self.similarity)

@@ -168,10 +168,8 @@ def _segment_payload(seg) -> dict:
             continue
         from elasticsearch_tpu.index import ivf_cache
 
-        vh = vc.vecs_host if vc.vecs_host is not None else np.asarray(vc.vecs)
-        eh = (vc.exists_host if vc.exists_host is not None
-              else np.asarray(vc.exists))
-        key = ivf_cache.content_key(vh, eh, vc.similarity, seg.max_docs)
+        # memoized on the (immutable) column — no re-hash per snapshot
+        key = vc.cache_key(seg.max_docs)
         blob = ivf_cache.store(key, ivf)
         ivf_blobs.append({
             "field": fname, "key": key,

@@ -485,7 +485,13 @@ class Node:
 
         # node-wide kernel dispatch counters (which device program served
         # each query component) + mesh-vs-host routing counts
-        search["kernels"] = kernels.snapshot()
+        snap = kernels.snapshot()
+        search["kernels"] = snap
+        # first-class fallback gauges (r4 verdict weak #5): a product query
+        # class silently living on the host-fallback path must be visible
+        # without digging through the kernels map
+        search["mesh_fallback_total"] = snap.get("mesh_fallback_total", 0)
+        search["span_clause_truncated"] = snap.get("span_clause_truncated", 0)
         proc = process_stats()
         return {
             "cluster_name": self.cluster_state.cluster_name,

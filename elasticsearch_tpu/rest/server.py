@@ -2126,7 +2126,8 @@ def _get_warmer(n: Node, p, b, index: str, name: str):
         if ws:
             out[nm] = {"warmers": ws}
     if not out:
-        return (200, {}) if any(c in str(name) for c in "*,")             or name == "_all" else (404, {})
+        wild = any(c in str(name) for c in "*,") or name == "_all"
+        return (200, {}) if wild else (404, {})
     return 200, out
 
 
@@ -2147,14 +2148,24 @@ def _percolate(n: Node, p, b, index: str, type: str):
 
 def _percolate_existing(n: Node, p, b, index: str, type: str, id: str):
     """Percolate an already-indexed doc (RestPercolateAction existing-doc
-    form: GET /{index}/{type}/{id}/_percolate)."""
+    form: GET /{index}/{type}/{id}/_percolate). percolate_index/
+    percolate_type redirect WHICH index's registered queries run
+    (TransportPercolateAction getRequest indirection); a version param
+    must match the doc's current version."""
     svc = n.get_index(index)
     got = svc.get_doc(id, routing=p.get("routing"))
     if not got.get("found"):
         return 404, {"_index": index, "_id": id, "found": False}
+    if "version" in p and int(p["version"]) != got.get("_version"):
+        from elasticsearch_tpu.utils.errors import VersionConflictException
+
+        raise VersionConflictException(index, id, got.get("_version"),
+                                       int(p["version"]))
     body = _json(b)
     body["doc"] = got["_source"]
-    return 200, svc.percolate(body)
+    target = p.get("percolate_index")
+    psvc = n.get_index(target) if target else svc
+    return 200, psvc.percolate(body)
 
 
 def _suggest(n: Node, p, b, index: str):

@@ -66,27 +66,84 @@ class PercolatorRegistry:
             return list(self._queries.items())
 
 
+def highlight_matches(doc: dict, queries_by_id, hl_spec: dict, mappings,
+                      analysis, ctx=None) -> dict:
+    """Highlight the percolated DOC once per matching query — each match's
+    snippets come from that query's terms, or from the field's
+    highlight_query override (reference: PercolateContext.java highlight
+    support; percolate/18_highligh_with_query.yaml).
+
+    queries_by_id: qid -> (raw_query_dict, parsed Query) — the registry's
+    own entries, so nothing is re-parsed; ``ctx`` reuses the percolate
+    batch's already-frozen segment context when the caller has one."""
+    from elasticsearch_tpu.search.context import SegmentContext
+    from elasticsearch_tpu.search.highlight import (extract_query_terms,
+                                                    highlight_field)
+    from elasticsearch_tpu.search.queries import parse_query
+
+    if ctx is None:
+        parser = DocumentParser(mappings, analysis)
+        builder = SegmentBuilder(mappings)
+        builder.add(parser.parse("_hl", doc))
+        seg = builder.freeze()
+        if seg is None:
+            return {}
+        ctx = SegmentContext(seg, mappings, analysis)
+    pre = (hl_spec.get("pre_tags") or ["<em>"])[0]
+    post = (hl_spec.get("post_tags") or ["</em>"])[0]
+    out = {}
+    for qid, (_raw, parsed) in queries_by_id.items():
+        per_field = {}
+        for fname, fspec in (hl_spec.get("fields") or {}).items():
+            raw_text = doc.get(fname)
+            if not isinstance(raw_text, str):
+                continue
+            fspec = fspec or {}
+            q_spec = fspec.get("highlight_query")
+            try:
+                query = (parse_query(q_spec) if q_spec is not None
+                         else parsed)
+                terms = extract_query_terms(query, fname, ctx)
+            except ElasticsearchTpuException:
+                continue
+            frags = highlight_field(
+                raw_text, terms, ctx.search_analyzer(fname),
+                pre_tag=pre, post_tag=post,
+                fragment_size=int(fspec.get("fragment_size", 100)),
+                number_of_fragments=int(fspec.get(
+                    "number_of_fragments", 5)))
+            if frags:
+                per_field[fname] = frags
+        if per_field:
+            out[qid] = per_field
+    return out
+
+
 def percolate(
     registry: PercolatorRegistry,
     docs: List[dict],
     mappings,
     analysis,
-) -> Tuple[List[List[str]], int]:
+    return_ctx: bool = False,
+):
     """Match each doc against every registered query.
 
     Returns (matches_per_doc — FULL sorted lists, callers truncate for their
-    size param, total_queries_evaluated). All docs are frozen into one
-    segment; each registered query executes once over the batch.
+    size param, total_queries_evaluated[, batch SegmentContext when
+    return_ctx — highlighting reuses it instead of re-freezing the doc]).
+    All docs are frozen into one segment; each registered query executes
+    once over the batch.
     """
+    empty = ([[] for _ in docs], 0) + ((None,) if return_ctx else ())
     if not len(registry):
-        return [[] for _ in docs], 0
+        return empty
     parser = DocumentParser(mappings, analysis)
     builder = SegmentBuilder(mappings)
     for i, d in enumerate(docs):
         builder.add(parser.parse(f"_percolate_{i}", d))
     seg = builder.freeze()
     if seg is None:
-        return [[] for _ in docs], 0
+        return empty
     ctx = SegmentContext(seg, mappings, analysis)
     n = len(docs)
     # doc i landed at the local id of its ROOT doc (children precede roots)
@@ -103,4 +160,6 @@ def percolate(
                 matches[i].append(qid)
     for row in matches:
         row.sort()
+    if return_ctx:
+        return matches, len(registry), ctx
     return matches, len(registry)

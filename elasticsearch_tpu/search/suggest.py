@@ -465,8 +465,10 @@ def _geohash(lat: float, lon: float, length: int) -> str:
 
 
 # ES precision table: geohash length whose cell edge is <= the distance
+# (GeoUtils.geoHashLevelsForPrecision cell widths, full 1..12 range)
 _GEO_PRECISION_KM = [(5000, 1), (1250, 2), (156, 3), (39.1, 4), (4.9, 5),
-                     (1.2, 6), (0.153, 7), (0.038, 8)]
+                     (1.2, 6), (0.153, 7), (0.038, 8), (0.00477, 9),
+                     (0.00119, 10), (0.000149, 11), (0.0000372, 12)]
 
 
 def _geo_len(precision) -> int:
@@ -475,10 +477,13 @@ def _geo_len(precision) -> int:
     from elasticsearch_tpu.search.geo import parse_distance
 
     km = parse_distance(precision) / 1000.0
-    for edge, ln in reversed(_GEO_PRECISION_KM):
-        if km <= edge:
+    # coarsest-first: the first length whose cell edge fits WITHIN the
+    # requested distance (GeoUtils.geoHashLevelsForPrecision — e.g. 200km
+    # -> length 3, whose ~156km cells are <= 200km)
+    for edge, ln in _GEO_PRECISION_KM:
+        if edge <= km:
             return ln
-    return 1
+    return 12  # smaller than the finest tabled edge: use the finest
 
 
 def _ctx_point(v):

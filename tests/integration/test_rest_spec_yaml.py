@@ -136,8 +136,6 @@ SKIP_TESTS = {
         'mlt docs/ignore variants (like/unlike doc references beyond stored-doc seeds)',
     ('mtermvectors/10_basic.yaml', 'Basic tests for multi termvector get'):
         'mtermvectors per-doc option variants',
-    ('percolate/16_existing_doc.yaml', 'Percolate existing documents'):
-        'percolate existing-doc with percolate_index redirection',
     ('search.aggregation/10_histogram.yaml', 'Format test'):
         'histogram key_as_string format variant',
     ('search/10_source_filtering.yaml', 'Source filtering'):

@@ -95,3 +95,52 @@ def test_percolate_restricting_query(svc):
                      "query": {"term": {"prio": "low"}}})
     assert [m["_id"] for m in r["matches"]] == ["lo"]
     s.close()
+
+
+def test_percolate_aggregations_over_matched_queries():
+    """Aggs inside a percolate request reduce over the MATCHED queries'
+    metadata (reference: PercolateSourceBuilder aggregations /
+    PercolatorService agg phase)."""
+    s = IndexService("paggs", mappings_json={"properties": {
+        "msg": {"type": "text"}, "team": {"type": "keyword"}}})
+    s.index_doc("a1", {"query": {"match": {"msg": "error"}}, "team": "ops"},
+                doc_type=".percolator")
+    s.index_doc("a2", {"query": {"match": {"msg": "error"}}, "team": "ops"},
+                doc_type=".percolator")
+    s.index_doc("b1", {"query": {"match": {"msg": "error"}}, "team": "dev"},
+                doc_type=".percolator")
+    s.index_doc("c1", {"query": {"match": {"msg": "warning"}},
+                       "team": "dev"}, doc_type=".percolator")
+    s.refresh()
+    r = s.percolate({"doc": {"msg": "an error happened"},
+                     "aggs": {"teams": {"terms": {"field": "team"}}}})
+    assert r["total"] == 3
+    buckets = {b["key"]: b["doc_count"]
+               for b in r["aggregations"]["teams"]["buckets"]}
+    assert buckets == {"ops": 2, "dev": 1}  # c1 (no match) excluded
+    s.close()
+
+
+def test_percolate_highlight_per_match():
+    """Each match highlights the percolated doc with ITS query's terms;
+    a field-level highlight_query overrides them (reference:
+    PercolateContext highlight support)."""
+    s = IndexService("phl", mappings_json={"properties": {
+        "msg": {"type": "text"}}})
+    s.index_doc("q_err", {"query": {"match": {"msg": "error"}}},
+                doc_type=".percolator")
+    s.index_doc("q_disk", {"query": {"match": {"msg": "disk"}}},
+                doc_type=".percolator")
+    s.refresh()
+    r = s.percolate({"doc": {"msg": "disk error on node"},
+                     "highlight": {"fields": {"msg": {}}}})
+    hl = {m["_id"]: m["highlight"]["msg"][0] for m in r["matches"]}
+    assert "<em>error</em>" in hl["q_err"] and "<em>disk</em>" not in hl["q_err"]
+    assert "<em>disk</em>" in hl["q_disk"] and "<em>error</em>" not in hl["q_disk"]
+    # highlight_query override: every match highlights the SAME terms
+    r2 = s.percolate({"doc": {"msg": "disk error on node"},
+                      "highlight": {"fields": {"msg": {
+                          "highlight_query": {"match": {"msg": "node"}}}}}})
+    for m in r2["matches"]:
+        assert "<em>node</em>" in m["highlight"]["msg"][0]
+    s.close()
