@@ -201,6 +201,9 @@ class IndexService:
             "_version": version,
             "result": "deleted",
             "found": True,
+            "_shards": {"total": 1 + self.num_replicas,
+                        "successful": 1 + len(group.replicas),
+                        "failed": 0},
         }
 
     def update_doc(self, doc_id: str, body: dict, routing: Optional[str] = None,

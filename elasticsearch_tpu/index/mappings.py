@@ -209,6 +209,17 @@ class Mappings:
             self.fields[full] = self._parse_field(full, t, p, nested_path)
 
     def _parse_field(self, full: str, t: str, p: dict, nested_path: Optional[str]) -> FieldMapping:
+        if t == "multi_field":
+            # pre-2.0 legacy form: the sub-field sharing the root's name
+            # BECOMES the root, the rest stay multi-fields
+            # (reference: TypeParsers.parseMultiField upgrade path)
+            subs = dict(p.get("fields") or {})
+            short = full.rpartition(".")[2]
+            rootp = dict(subs.pop(short, {}) or {})
+            rootp["fields"] = subs
+            return self._parse_field(
+                full, _canonical_type(rootp) if rootp.get("type")
+                else "text", rootp, nested_path)
         fm = FieldMapping(
             name=full,
             type=t,

@@ -86,7 +86,8 @@ class RestController:
                     return self.node.thread_pool.execute(
                         self.pool_for(method, path),
                         handler, self.node, params, body,
-                        **match.groupdict())
+                        **{k: _decode_path_part(v)
+                           for k, v in match.groupdict().items()})
                 except ElasticsearchTpuException as e:
                     return e.status, _error_body(e)
                 except json.JSONDecodeError as e:
@@ -106,6 +107,27 @@ class RestController:
         }
 
 
+def _decode_path_part(v: Optional[str]) -> Optional[str]:
+    """Routes match the %-encoded request path; handlers get decoded
+    values (non-ASCII doc ids). Raw UTF-8 request lines arrive read as
+    latin-1 by http.server — rescue those too when they round-trip."""
+    if v is None:
+        return None
+    from urllib.parse import unquote
+
+    v = unquote(v)
+    try:
+        return v.encode("latin-1").decode("utf-8")
+    except (UnicodeEncodeError, UnicodeDecodeError):
+        return v
+
+
+def _refresh_requested(p) -> bool:
+    """refresh=true|1|''|wait_for all force visibility (2.0 treats the
+    param as a boolean-ish flag; wait_for refreshes inline here)."""
+    return p.get("refresh") in ("true", "", "1", "wait_for")
+
+
 def _error_body(e: ElasticsearchTpuException) -> dict:
     return {
         "error": {"type": e.error_type, "reason": str(e),
@@ -117,7 +139,22 @@ def _error_body(e: ElasticsearchTpuException) -> dict:
 def _json(body: bytes) -> dict:
     if not body:
         return {}
-    return json.loads(body)
+    try:
+        return json.loads(body)
+    except json.JSONDecodeError:
+        # the reference's Jackson parser is lenient about unquoted field
+        # names — quote them and retry (no YAML-style scalar coercion:
+        # values must stay exactly what strict JSON would produce)
+        import re as _re
+
+        text = body.decode() if isinstance(body, bytes) else str(body)
+        fixed = _re.sub(r'([,{]\s*)([A-Za-z_][A-Za-z0-9_.]*)(\s*:)',
+                        r'\1"\2"\3', text)
+        try:
+            return json.loads(fixed)
+        except json.JSONDecodeError:
+            pass
+        raise
 
 
 def _ndjson(body: bytes) -> List[dict]:
@@ -319,7 +356,7 @@ def _register_all(rc: RestController):
     add("POST", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
     add("DELETE", "/{index}", lambda n, p, b, index: (200, n.delete_index(index)))
     add("HEAD", "/{index}", _index_exists)
-    add("GET", "/{index}/_mapping", lambda n, p, b, index: (200, n.get_mapping(index)))
+    add("GET", "/{index}/_mapping", _get_mapping_index)
     add("GET", "/{index}/_mapping/{type}", _get_mapping_typed)
     add("GET", "/{index}/_mappings/{type}", _get_mapping_typed)
     for _m in ("PUT", "POST"):
@@ -437,7 +474,8 @@ def _register_all(rc: RestController):
     add("GET", "/{index}/_alias", _get_index_alias)
     add("GET", "/{index}/_aliases", _get_index_alias)
     add("GET", "/{index}/_aliases/{alias}",
-        lambda n, p, b, index, alias: _get_index_alias(n, p, b, index, alias))
+        lambda n, p, b, index, alias: _get_index_alias(
+            n, p, b, index, alias, legacy=True))
     add("GET", "/{index}/_alias/{alias}",
         lambda n, p, b, index, alias: _get_index_alias(n, p, b, index, alias))
     add("HEAD", "/{index}/_mapping/{type}", _type_exists)
@@ -538,6 +576,10 @@ def _register_all(rc: RestController):
         _typed(lambda n, p, b, index: _suggest(n, p, b, index)))
     add("GET", "/{index}/{type}/_termvectors", _typed(_termvectors_noid))
     add("POST", "/{index}/{type}/_termvectors", _typed(_termvectors_noid))
+    add("POST", "/{index}/{type}/_mtermvectors",
+        lambda n, p, b, index, type: _mtermvectors(n, p, b, index, type))
+    add("GET", "/{index}/{type}/_mtermvectors",
+        lambda n, p, b, index, type: _mtermvectors(n, p, b, index, type))
     add("GET", "/{index}/{type}/_search/template", _typed(_search_template))
     add("POST", "/{index}/{type}/_search/template", _typed(_search_template))
     add("GET", "/{index}/{type}/_search/exists", _typed(_search_exists))
@@ -1162,9 +1204,7 @@ def _get_settings(n: Node, p, b, index: str):
 def _put_settings(n: Node, p, b, index: str):
     from elasticsearch_tpu.cluster.metadata import update_index_settings
 
-    names = n.resolve_indices(index)
-    if not names:
-        raise IndexNotFoundException(index)
+    names = _resolve_indices_options(n, index, p)
     body = _json(b)
     for nm in names:  # multi-index expressions, like the reference
         update_index_settings(n.indices[nm], body, node=n)
@@ -1260,9 +1300,7 @@ def _get_alias(n: Node, p, b, alias: str):
 
 
 def _refresh(n: Node, p, b, index: str):
-    names = n.resolve_indices(index)
-    if not names:
-        raise IndexNotFoundException(index)
+    names = _resolve_indices_options(n, index, p)
     for name in names:
         n.indices[name].refresh()
     return 200, {"_shards": _shards_header(n, names)}
@@ -1396,7 +1434,7 @@ def _index_doc(n: Node, p, b, index: str, id: str, doc_type: Optional[str] = Non
     if p.get("ttl"):  # _ttl meta field (TTLFieldMapper)
         kw["ttl"] = p["ttl"]
     r = svc.index_doc(id, _json(b), routing=p.get("routing") or p.get("parent"), **kw)
-    if p.get("refresh") in ("true", "wait_for", ""):
+    if _refresh_requested(p):
         svc.refresh()
     return (201 if r.get("created") else 200), r
 
@@ -1404,7 +1442,7 @@ def _index_doc(n: Node, p, b, index: str, id: str, doc_type: Optional[str] = Non
 def _index_doc_auto(n: Node, p, b, index: str):
     svc = n.get_or_autocreate(index)
     r = svc.index_doc(None, _json(b), routing=p.get("routing"))
-    if p.get("refresh") in ("true", "wait_for", ""):
+    if _refresh_requested(p):
         svc.refresh()
     return 201, r
 
@@ -1498,6 +1536,14 @@ def _get_doc(n: Node, p, b, index: str, id: str):
                     **_realtime_kw(n, p, index))
     if not r.get("found"):
         return 404, r
+    if "version" in p and p.get("version_type") != "force" \
+            and int(p["version"]) != r.get("_version"):
+        # version-checked read: ANY mismatch conflicts, internal or
+        # external — force never does (VersionType.isVersionConflictForReads)
+        from elasticsearch_tpu.utils.errors import VersionConflictException
+
+        raise VersionConflictException(index, id, r.get("_version"),
+                                       int(p["version"]))
     sf = p.get("_source")
     if sf is not None:
         if sf.lower() in ("true", "false"):
@@ -1545,13 +1591,17 @@ def _get_doc(n: Node, p, b, index: str, id: str):
                     out["_ttl"] = max(
                         0, loc.ttl_expiry - int(_t.time() * 1000))
                 continue
-            cur: Any = src
-            for part in f.split("."):
-                cur = cur.get(part) if isinstance(cur, dict) else None
+            from elasticsearch_tpu.search.service import source_path
+
+            cur = source_path(src, f)
             if cur is not None:
                 out[f] = cur if isinstance(cur, list) else [cur]
         r["fields"] = out
-        if "_source" not in names:
+        if "_source" not in names and "_source" not in p \
+                and "_source_include" not in p \
+                and "_source_exclude" not in p:
+            # fields suppress _source unless ANY explicit _source request
+            # (true or a filter list) asked for it
             r.pop("_source", None)
     return 200, r
 
@@ -1591,7 +1641,7 @@ def _delete_doc(n: Node, p, b, index: str, id: str):
         kw["version"] = int(p["version"])
         kw["version_type"] = p.get("version_type", "internal")
     r = svc.delete_doc(id, routing=p.get("routing") or p.get("parent"), **kw)
-    if p.get("refresh") in ("true", ""):
+    if _refresh_requested(p):
         svc.refresh()
     return 200, r
 
@@ -1636,7 +1686,7 @@ def _update_doc(n: Node, p, b, index: str, id: str,
         if fl:
             env["fields"] = fl
         r["get"] = env
-    if p.get("refresh") in ("true", "", "1"):
+    if _refresh_requested(p):
         svc.refresh()
     return 200, r
 
@@ -1740,7 +1790,8 @@ def _update_by_query(n: Node, p, b, index: str):
 
 
 def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
-    from elasticsearch_tpu.search.service import _filter_source
+    from elasticsearch_tpu.search.service import (_filter_source,
+                                                  source_path)
     from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
 
     iname = spec.get("_index", default_index)
@@ -1786,9 +1837,7 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
                     and loc.parent is not None:
                 fl["_parent"] = loc.parent
             elif f not in ("_routing", "_parent"):
-                cur: Any = src
-                for part in str(f).split("."):
-                    cur = cur.get(part) if isinstance(cur, dict) else None
+                cur = source_path(src, f)
                 if cur is not None:
                     fl[f] = cur if isinstance(cur, list) else [cur]
         got["fields"] = fl
@@ -1855,7 +1904,7 @@ def _bulk(n: Node, p, b, index: Optional[str] = None,
                     if doc_type is not None:
                         meta.setdefault("_type", doc_type)
     r = n.bulk(ops)
-    if p.get("refresh") in ("true", "wait_for", ""):
+    if _refresh_requested(p):
         for svc in n.indices.values():
             svc.refresh()
     return 200, r
@@ -1894,6 +1943,21 @@ def _search_body(p, b) -> dict:
         body["scroll"] = p["scroll"]
     if "search_type" in p:
         body["search_type"] = p["search_type"]
+    if "_source" in p:
+        v = p["_source"]
+        if v == "":  # bare ?_source flag = true
+            body["_source"] = True
+        else:
+            body["_source"] = (v.lower() == "true" if v.lower()
+                               in ("true", "false") else v.split(","))
+    if "_source_include" in p or "_source_exclude" in p:
+        # URL-level source filtering OVERRIDES the body spec
+        # (RestSearchAction fetchSourceContext from params)
+        body["_source"] = {
+            "include": [x for x in
+                        (p.get("_source_include") or "").split(",") if x],
+            "exclude": [x for x in
+                        (p.get("_source_exclude") or "").split(",") if x]}
     return body
 
 
@@ -2026,7 +2090,7 @@ def _explain(n: Node, p, b, index: str, id: str):
             scores, mask = query.score_or_mask(ctx)
             matched = bool(np.asarray(mask)[loc.local_id])
             score = float(np.asarray(scores)[loc.local_id])
-            return 200, {
+            resp = {
                 "_index": svc.name,
                 "_type": (loc.doc_type or "_doc"),
                 "_id": id, "matched": matched,
@@ -2036,6 +2100,20 @@ def _explain(n: Node, p, b, index: str, id: str):
                     "details": [],
                 },
             }
+            if any(k in p for k in ("_source", "_source_include",
+                                    "_source_exclude", "fields")):
+                # RestExplainAction's GetResult envelope: the doc rides
+                # along under `get`, with the same source filtering the
+                # GET API applies
+                _st, got = _get_doc(n, p, b"", svc.name, id)
+                if got.get("found"):
+                    env: Dict[str, Any] = {"found": True}
+                    if "_source" in got:
+                        env["_source"] = got["_source"]
+                    if "fields" in got:
+                        env["fields"] = got["fields"]
+                    resp["get"] = env
+            return 200, resp
     return 404, {"_index": svc.name, "_type": "_doc", "_id": id,
                  "matched": False}
 
@@ -2047,6 +2125,14 @@ def _resolve_template(n: Node, body: dict):
     if isinstance(tmpl, dict) and ("inline" in tmpl or "id" in tmpl):
         body = {**body, **tmpl}
         tmpl = tmpl.get("inline")
+    if isinstance(tmpl, str) and "{" not in tmpl:
+        # a bare name is an indexed/on-disk script reference, not an
+        # inline source (RestSearchTemplateAction lookup order)
+        found = n.search_templates.get(tmpl)
+        if found is None:
+            raise ElasticsearchTpuException(
+                f"Unable to find on disk script {tmpl}")
+        tmpl = found
     if tmpl is None and "id" in body:
         tmpl = n.search_templates.get(body["id"])
         if tmpl is None:
@@ -2076,8 +2162,14 @@ def _render_template_ep(n: Node, p, b):
 
 def _put_search_template(n: Node, p, b, id: str):
     body = _json(b)
+    tmpl = body.get("template", body)
+    if "{{}}" in json.dumps(tmpl):
+        # empty mustache tag: the reference's compile step rejects it
+        # (ScriptService.validate -> MustacheException)
+        raise IllegalArgumentException(
+            "Unable to parse mustache template: empty tag {{}}")
     created = id not in n.search_templates
-    n.search_templates[id] = body.get("template", body)
+    n.search_templates[id] = tmpl
     ver = n.search_template_versions.get(id, 0) + 1
     n.search_template_versions[id] = ver
     return (201 if created else 200), {
@@ -2086,16 +2178,27 @@ def _put_search_template(n: Node, p, b, id: str):
 
 
 def _get_search_template(n: Node, p, b, id: str):
+    """GetIndexedScriptResponse: the stored source echoes as a STRING
+    (scripts are text documents in the .scripts index)."""
     t = n.search_templates.get(id)
     if t is None:
-        return 404, {"_id": id, "found": False}
+        return 404, {"_id": id, "found": False, "lang": "mustache"}
     return 200, {"_id": id, "found": True, "lang": "mustache",
-                 "template": t}
+                 "_version": n.search_template_versions.get(id, 1),
+                 "template": (t if isinstance(t, str)
+                              else json.dumps(t, separators=(",", ":")))}
 
 
 def _delete_search_template(n: Node, p, b, id: str):
     found = n.search_templates.pop(id, None) is not None
-    return (200 if found else 404), {"_id": id, "found": found}
+    if found:
+        ver = n.search_template_versions.get(id, 0) + 1
+        n.search_template_versions[id] = ver
+    else:
+        ver = 1
+    return (200 if found else 404), {"_id": id, "found": found,
+                                     "_index": ".scripts",
+                                     "_version": ver}
 
 
 def _put_warmer(n: Node, p, b, index: str, name: str):
@@ -2118,27 +2221,36 @@ def _get_warmers(n: Node, p, b, index: str):
 
 
 def _get_warmer(n: Node, p, b, index: str, name: str):
+    """RestGetWarmerAction: a missing INDEX 404s; a name that matches
+    nothing on existing indices is an empty 200 body (the reference
+    returns the empty GetWarmersResponse)."""
     out = {}
-    for nm in n.resolve_indices(index):
+    for nm in _resolve_indices_options(n, index, p):
         svc = n.indices[nm]
         ws = {k: {"source": v} for k, v in svc.warmers.items()
               if _warmer_name_match(k, name)}
         if ws:
             out[nm] = {"warmers": ws}
-    if not out:
-        wild = any(c in str(name) for c in "*,") or name == "_all"
-        return (200, {}) if wild else (404, {})
     return 200, out
 
 
 def _delete_warmer(n: Node, p, b, index: str, name: str):
-    names = n.resolve_indices(index)
+    """RestDeleteWarmerAction: comma lists / wildcards / _all name forms;
+    404 only when a CONCRETE name matched nothing."""
+    names = _resolve_indices_options(n, index, p)
     if not names:
         raise IndexNotFoundException(index)
     found = False
     for nm in names:
-        found = (n.indices[nm].warmers.pop(name, None) is not None) or found
-    return (200 if found else 404), {"acknowledged": found}
+        svc = n.indices[nm]
+        for w in [w for w in list(svc.warmers)
+                  if _warmer_name_match(w, name)]:
+            svc.warmers.pop(w, None)
+            found = True
+    if not found and not (any(c in str(name) for c in "*,")
+                          or name == "_all"):
+        return 404, {"acknowledged": False}
+    return 200, {"acknowledged": True}
 
 
 def _percolate(n: Node, p, b, index: str, type: str):
@@ -2227,10 +2339,17 @@ def _field_stats(n: Node, p, b, index: str):
                 for fname, inv in seg.inverted.items():
                     if fname.startswith("_") or inv.num_docs == 0:
                         continue
-                    _bump(fields.setdefault(fname, {}), {
+                    add = {
                         "doc_count": int(inv.num_docs), "max_doc": md,
                         "sum_doc_freq": int(inv.df.sum()),
-                        "sum_total_term_freq": int(inv.total_terms)})
+                        "sum_total_term_freq": int(inv.total_terms)}
+                    live_terms = [t for i, t in enumerate(inv.terms)
+                                  if int(inv.df[i]) > 0]
+                    if live_terms:
+                        # min/max TERM of the field (FieldStats.Text)
+                        add["min_value"] = min(live_terms)
+                        add["max_value"] = max(live_terms)
+                    _bump(fields.setdefault(fname, {}), add)
         for st in fields.values():
             md = st.get("max_doc", 0)
             st["density"] = (int(100 * st.get("doc_count", 0) / md)
@@ -2602,10 +2721,13 @@ def _index_alias_exists(n: Node, p, b, index: str, name: str):
     return _alias_exists(n, p, b, name, index)
 
 
-def _get_index_alias(n: Node, p, b, index: str, alias: Optional[str] = None):
+def _get_index_alias(n: Node, p, b, index: str, alias: Optional[str] = None,
+                     legacy: bool = False):
     """RestGetAliasesAction scoped to an index; {name} supports comma
-    lists / wildcards / _all, partial matches return the existing subset
-    (a FULLY missing concrete name still 404s)."""
+    lists / wildcards / _all; partial matches return the existing subset.
+    A name matching NOTHING is an empty 200 body — the new `_alias` API
+    omits empty index entries entirely, the legacy `_aliases` form keeps
+    each index with an empty aliases map."""
     import fnmatch
 
     names = n.resolve_indices(index)
@@ -2622,11 +2744,8 @@ def _get_index_alias(n: Node, p, b, index: str, alias: Optional[str] = None):
     for iname in names:
         svc = n.indices[iname]
         matched = {a: (fa or {}) for a, fa in svc.aliases.items() if hit(a)}
-        if matched or pats is None:
+        if matched or pats is None or legacy:
             out[iname] = {"aliases": matched}
-    if pats is not None and not any(v["aliases"] for v in out.values()) \
-            and not any("*" in pt or pt == "_all" for pt in pats):
-        return 404, {"error": f"alias [{alias}] missing", "status": 404}
     return 200, out
 
 
@@ -2898,13 +3017,17 @@ def _mpercolate(n: Node, p, b, index: Optional[str] = None):
     return 200, {"responses": responses}
 
 
-def _mtermvectors(n: Node, p, b, index: Optional[str] = None):
-    """RestMultiTermVectorsAction: {docs: [{_index,_id,...}]} or ids+path
-    index."""
+def _mtermvectors(n: Node, p, b, index: Optional[str] = None,
+                  doc_type: Optional[str] = None):
+    """RestMultiTermVectorsAction: {docs: [{_index,_id,...}]}, body ids,
+    or the ?ids= query-param form with a path index."""
     body = _json(b)
     docs = body.get("docs")
     if docs is None:
-        docs = [{"_index": index, "_id": i} for i in body.get("ids", [])]
+        ids = body.get("ids")
+        if ids is None and p.get("ids"):
+            ids = [x for x in str(p["ids"]).split(",") if x]
+        docs = [{"_index": index, "_id": i} for i in (ids or [])]
     out = []
     for d in docs:
         iname = d.get("_index", index)
@@ -3047,6 +3170,18 @@ def _delete_script(n: Node, p, b, lang: str, id: str):
 # (tests/integration/test_rest_spec_coverage.py asserts every path x method
 # of the reference's rest-api-spec/api/*.json resolves in our route table)
 
+def _get_mapping_index(n: Node, p, b, index: str):
+    """GET /{index}/_mapping honoring expand_wildcards (incl. `none`,
+    which expands wildcards to nothing → empty 200 body)."""
+    if "expand_wildcards" in p and any(c in str(index) for c in "*?"):
+        names = _resolve_indices_options(n, index, p)
+        out = {}
+        for nm in names:
+            out.update(n.get_mapping(nm))
+        return 200, out
+    return 200, n.get_mapping(index)
+
+
 def _get_mapping_root(n: Node, p, b, type: Optional[str] = None):
     """GET /_mapping[/{type}] (indices.get_mapping root forms)."""
     if type:
@@ -3106,20 +3241,27 @@ def _put_mapping_root(n: Node, p, b, type: Optional[str] = None):
 
 
 def _get_settings_name(n: Node, p, b, index: Optional[str], name: str):
-    """GET /{index}/_settings/{name}: filter setting keys by pattern."""
+    """GET /{index}/_settings/{name}: filter setting keys by pattern —
+    comma lists, wildcards, and _all (= no filtering) all valid."""
     import fnmatch
 
     st, out = _get_settings(n, p, b, index)
+    pats = [x.strip() for x in str(name).split(",") if x.strip()]
+    if any(pt in ("_all", "*") for pt in pats):
+        return st, out
+
+    def keep(k: str) -> bool:
+        return any(fnmatch.fnmatch(k, pt) for pt in pats)
+
     for entry in out.values():
         if "index" in entry["settings"]:
             idx = entry["settings"]["index"]
             entry["settings"]["index"] = {
                 k: v for k, v in idx.items()
-                if fnmatch.fnmatch(f"index.{k}", name)
-                or fnmatch.fnmatch(k, name)}
+                if keep(f"index.{k}") or keep(k)}
         else:  # flat_settings form
             entry["settings"] = {k: v for k, v in entry["settings"].items()
-                                 if fnmatch.fnmatch(k, name)}
+                                 if keep(k)}
     return st, out
 
 

@@ -48,8 +48,10 @@ class TermsAggregator(Aggregator):
             raise SearchParseException("terms aggregation requires [field]")
         jnp = _jnp()
         inv = ctx.inv(field)
-        if inv is not None and field in ctx.segment.keywords:
-            # keyword: postings-based count (multi-value correct)
+        if inv is not None:
+            # keyword OR analyzed text: postings-based count over terms
+            # (multi-value correct; analyzed strings bucket by token, the
+            # reference's fielddata-on-analyzed-string behavior)
             V = inv.vocab_size
             if V == 0:
                 return {"buckets": {}, "doc_count_error_upper_bound": 0, "sum_other_doc_count": 0}
@@ -112,7 +114,7 @@ class TermsAggregator(Aggregator):
     def _bucket_mask(self, ctx, field, key, mask):
         jnp = _jnp()
         inv = ctx.inv(field)
-        if inv is not None and field in ctx.segment.keywords:
+        if inv is not None:
             from elasticsearch_tpu.search.queries import _terms_filter_mask
 
             return mask & _terms_filter_mask(ctx, field, [str(key)])
@@ -180,6 +182,43 @@ class TermsAggregator(Aggregator):
 # ---------------------------------------------------------------------------
 # histogram / date_histogram
 # ---------------------------------------------------------------------------
+
+def _decimal_format(value: float, pattern: str) -> str:
+    """Java DecimalFormat subset for agg `format` strings (reference:
+    ValueFormatter.Number): literal prefix/suffix around a ##0.0-style
+    number pattern — '0' digits are mandatory, '#' optional."""
+    import re as _re
+
+    m = _re.search(r"[#0][#0,]*(?:\.[#0]+)?", pattern)
+    if not m:
+        return pattern
+    num = m.group(0)
+    int_part, _, frac_part = num.partition(".")
+    min_frac = frac_part.count("0")
+    max_frac = len(frac_part)
+    s = f"{float(value):.{max_frac}f}" if max_frac else str(int(round(value)))
+    if max_frac > min_frac:
+        whole, _, frac = s.partition(".")
+        frac = frac.rstrip("0").ljust(min_frac, "0")
+        s = f"{whole}.{frac}" if frac else whole
+    min_int = int_part.replace(",", "").count("0")
+    whole = s.split(".")[0].lstrip("-")
+    if len(whole) < min_int:
+        s = s.replace(whole, whole.zfill(min_int), 1)
+    if "," in int_part:
+        # grouping separator: Java groups by the distance from the LAST
+        # comma to the pattern end (e.g. #,##0 -> groups of 3)
+        group = len(int_part) - int_part.rfind(",") - 1
+        whole, _, frac = s.lstrip("-").partition(".")
+        sign = "-" if s.startswith("-") else ""
+        parts = []
+        while len(whole) > group:
+            parts.insert(0, whole[-group:])
+            whole = whole[:-group]
+        parts.insert(0, whole)
+        s = sign + ",".join(parts) + (f".{frac}" if frac else "")
+    return pattern[:m.start()] + s + pattern[m.end():]
+
 
 @register("histogram")
 class HistogramAggregator(Aggregator):
@@ -269,6 +308,9 @@ class HistogramAggregator(Aggregator):
             if self.date:
                 b["key_as_string"] = format_date(int(k))
                 b["key"] = int(k)
+            elif self.body.get("format"):
+                b["key_as_string"] = _decimal_format(
+                    k, str(self.body["format"]))
             if k in sub_partials:
                 b.update(self.reduce_subs(sub_partials[k]))
             out.append(b)

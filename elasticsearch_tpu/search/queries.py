@@ -1307,22 +1307,23 @@ class MoreLikeThisQuery(Query):
     from `like` text/docs, then a should-match query."""
 
     def __init__(self, fields: List[str], like_texts=(), like_ids=(),
+                 unlike_texts=(), unlike_ids=(), include: bool = False,
                  max_query_terms: int = 25, min_term_freq: int = 1,
                  min_doc_freq: int = 1, boost: float = 1.0):
         self.fields = fields or ["_all"]
         self.like_texts = list(like_texts)
         self.like_ids = list(like_ids)
+        self.unlike_texts = list(unlike_texts)
+        self.unlike_ids = list(unlike_ids)
+        self.include = include
         self.max_query_terms = max_query_terms
         self.min_term_freq = min_term_freq
         self.min_doc_freq = min_doc_freq
         self.boost = boost
 
-    def execute(self, ctx) -> ExecResult:
-        jnp = _jnp()
-        out_s = jnp.zeros(ctx.D, dtype=jnp.float32)
-        out_m = jnp.zeros(ctx.D, dtype=bool)
-        texts = list(self.like_texts)
-        for doc_id in self.like_ids:
+    def _texts_of(self, ctx, ids, extra_texts) -> List[str]:
+        texts = list(extra_texts)
+        for doc_id in ids:
             loc = ctx.segment.id_map.get(str(doc_id))
             if loc is not None and ctx.segment.sources[loc]:
                 src = ctx.segment.sources[loc]
@@ -1336,19 +1337,34 @@ class MoreLikeThisQuery(Query):
                         v = src.get(f)
                     if isinstance(v, str):
                         texts.append(v)
+        return texts
+
+    def execute(self, ctx) -> ExecResult:
+        jnp = _jnp()
+        out_s = jnp.zeros(ctx.D, dtype=jnp.float32)
+        out_m = jnp.zeros(ctx.D, dtype=bool)
+        texts = self._texts_of(ctx, self.like_ids, self.like_texts)
+        untexts = self._texts_of(ctx, self.unlike_ids, self.unlike_texts)
         for field in self.fields:
             inv = ctx.inv(field)
             if inv is None:
                 continue
             an = ctx.search_analyzer(field)
+
+            def toks_of(text):
+                return ([t for t, _ in an.analyze(text)] if an
+                        else text.split())
+
             tf: Dict[str, int] = {}
             for text in texts:
-                toks = [t for t, _ in an.analyze(text)] if an else text.split()
-                for t in toks:
+                for t in toks_of(text):
                     tf[t] = tf.get(t, 0) + 1
+            # unlike/ignore_like terms are skip terms (reference:
+            # MoreLikeThisQuery unlike handling)
+            skip = {t for text in untexts for t in toks_of(text)}
             scored = []
             for t, f_ in tf.items():
-                if f_ < self.min_term_freq:
+                if f_ < self.min_term_freq or t in skip:
                     continue
                 tid = inv.vocab.get(t, -1)
                 if tid < 0 or inv.df[tid] < self.min_doc_freq:
@@ -1361,6 +1377,16 @@ class MoreLikeThisQuery(Query):
             s, matched, _ = _score_term_group(ctx, field, sel, self.boost)
             out_s = out_s + s
             out_m = out_m | matched
+        if not self.include and self.like_ids:
+            # input docs are excluded from the result set by default
+            drop = np.zeros(ctx.D, dtype=bool)
+            for doc_id in self.like_ids:
+                loc = ctx.segment.id_map.get(str(doc_id))
+                if loc is not None:
+                    drop[loc] = True
+            keep = jnp.asarray(~drop)
+            out_m = out_m & keep
+            out_s = jnp.where(keep, out_s, 0.0)
         return out_s, out_m
 
 
@@ -1636,20 +1662,38 @@ def _parse_query_inner(dsl: Optional[dict]) -> Query:
         )
 
     if qtype == "more_like_this":
-        like = body.get("like", body.get("like_text", []))
-        if isinstance(like, str):
-            like = [like]
-        texts, ids = [], []
-        for item in like:
-            if isinstance(item, dict):
-                ids.append(item.get("_id"))
-            else:
-                texts.append(item)
-        ids.extend(body.get("ids", []))
+        def _split(spec):
+            """like/unlike/docs forms: strings, {_id}, {doc: {...}}
+            artificial docs — all normalized to (texts, ids)."""
+            if spec is None:
+                return [], []
+            if isinstance(spec, (str, dict)):
+                spec = [spec]
+            texts, ids = [], []
+            for item in spec:
+                if isinstance(item, dict):
+                    if isinstance(item.get("doc"), dict):
+                        texts.extend(str(v) for v in item["doc"].values()
+                                     if isinstance(v, (str, int, float)))
+                    elif item.get("_id") is not None:
+                        ids.append(item["_id"])
+                else:
+                    texts.append(item)
+            return texts, ids
+
+        texts, ids = _split(body.get("like", body.get("like_text")))
+        dtexts, dids = _split(body.get("docs"))
+        texts += dtexts
+        ids += dids + list(body.get("ids", []))
+        untexts, unids = _split(body.get("unlike",
+                                         body.get("ignore_like")))
         return MoreLikeThisQuery(
             body.get("fields", []),
             like_texts=texts,
             like_ids=ids,
+            unlike_texts=untexts,
+            unlike_ids=unids,
+            include=bool(body.get("include", False)),
             max_query_terms=int(body.get("max_query_terms", 25)),
             min_term_freq=int(body.get("min_term_freq", 1)),
             min_doc_freq=int(body.get("min_doc_freq", 1)),

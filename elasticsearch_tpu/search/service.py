@@ -335,15 +335,26 @@ class ShardSearcher:
             if filtered is not None:
                 hit["_source"] = filtered
             if stored_fields:
+                names = ([stored_fields] if isinstance(stored_fields, str)
+                         else list(stored_fields))
                 flds = {}
-                for f in stored_fields:
+                for f in names:
+                    if f == "_source":
+                        continue
                     sv = d.seg.stored[d.local_id].get(f) if d.seg.stored[d.local_id] else None
-                    if sv is None and src and f in src:
-                        sv = src[f] if isinstance(src[f], list) else [src[f]]
+                    if sv is None and src:
+                        # non-stored leaves extract from _source, dotted
+                        # paths included (2.0 FetchPhase fields loading)
+                        cur = source_path(src, f)
+                        if cur is not None:
+                            sv = cur if isinstance(cur, list) else [cur]
                     if sv is not None:
                         flds[f] = sv
                 if flds:
                     hit["fields"] = flds
+                if "_source" not in names and "_source" not in body:
+                    # a fields list suppresses _source unless asked for
+                    hit.pop("_source", None)
             if script_fields:
                 hit.setdefault("fields", {})
                 for fname, spec in script_fields.items():
@@ -742,6 +753,15 @@ def _nested_sub_source(root_src: dict, path: str, ordn: int):
     if isinstance(cur, list):
         return cur[ordn] if 0 <= ordn < len(cur) else None
     return cur if ordn == 0 else None
+
+
+def source_path(src, path: str):
+    """Walk a dotted path into a source dict; None when any hop misses
+    (shared by fetch-phase `fields`, GET/mget fields extraction)."""
+    cur = src
+    for part in str(path).split("."):
+        cur = cur.get(part) if isinstance(cur, dict) else None
+    return cur
 
 
 def _filter_source(src: Optional[dict], spec) -> Optional[dict]:
