@@ -13,6 +13,7 @@ only — all heavy work is device programs), a route table of
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -196,7 +197,7 @@ def _register_all(rc: RestController):
     add("GET", "/_cat/plugins", lambda n, p, b: (200, []))
     add("GET", "/_cat/pending_tasks", lambda n, p, b: (200, []))
     add("GET", "/_cat/thread_pool", _cat_thread_pool)
-    add("GET", "/_cat/fielddata", lambda n, p, b: (200, []))
+    add("GET", "/_cat/fielddata", _cat_fielddata)
     add("GET", "/_cat/repositories", lambda n, p, b: (200, [
         {"id": name, "type": "fs"} for name in n.repositories]))
     add("GET", "/_cat/snapshots/{repo}", _cat_snapshots)
@@ -262,7 +263,7 @@ def _register_all(rc: RestController):
     add("GET", "/_cat/aliases/{name}", _cat_aliases)
     add("GET", "/_cat/allocation/{nodeid}", _cat_allocation)
     add("GET", "/_cat/fielddata/{fields}",
-        lambda n, p, b, fields: (200, []))
+        lambda n, p, b, fields: _cat_fielddata(n, p, b, fields))
     add("GET", "/_cat/indices/{index}", _cat_indices)
     add("GET", "/_cat/recovery/{index}", _cat_recovery)
     add("GET", "/_cat/segments/{index}", _cat_segments)
@@ -1042,27 +1043,85 @@ def _cat_health(n: Node, p, b):
 
 
 def _cat_shards(n: Node, p, b, index: Optional[str] = None):
+    """One row per shard COPY (primary + each replica), RestShardsAction
+    columns; in-process replicas report STARTED on this node (they are
+    real copies here, where a one-node reference cluster shows them
+    UNASSIGNED — both shapes are legal cat output)."""
     scope = set(_cat_scope(n, index))
     rows = []
-    for r in n.cluster_state.routing:
-        if r.index not in scope:
+    for iname, svc in n.indices.items():
+        if iname not in scope:
             continue
-        svc = n.indices.get(r.index)
-        docs = svc.shards[r.shard_id].engine.num_docs if svc else 0
-        size = (sum(seg.memory_bytes()
-                    for seg in svc.shards[r.shard_id].segments)
-                if svc else 0)
-        rows.append({"index": r.index, "shard": str(r.shard_id),
-                     "prirep": "p" if r.primary else "s", "state": r.state,
-                     "docs": str(docs), "store": _human_size(size),
-                     "ip": "127.0.0.1", "node": n.name})
+        idx_settings = svc.settings.get("index", svc.settings)
+        shadow = str(idx_settings.get("shadow_replicas", "false")
+                     ).lower() in ("true", "1")
+        for g in svc.groups:
+            for copy in g.copies:
+                docs = copy.engine.num_docs
+                size = sum(seg.memory_bytes() for seg in copy.segments)
+                rows.append({
+                    "index": iname, "shard": str(g.shard_id),
+                    # shadow replicas print "s" (RestShardsAction)
+                    "prirep": ("p" if copy is g.primary
+                               else "s" if shadow else "r"),
+                    "state": copy.state if copy.state != "CREATED"
+                    else "INITIALIZING",
+                    "docs": str(docs), "store": _human_size(size),
+                    "ip": "127.0.0.1", "node": n.name})
     return 200, rows
 
 
+def _cat_fielddata(n: Node, p, b, fields: Optional[str] = None):
+    """RestFielddataAction: one row per node with `total` plus one column
+    per loaded field; ?fields= (or the path form) narrows the field
+    columns. Our fielddata = always-resident device columns, so every
+    mapped field with data shows up (see DEVIATIONS.md)."""
+    per_field: Dict[str, int] = {}
+    for svc in n.indices.values():
+        for shard in svc.shards:
+            for seg in shard.segments:
+                for fname, nbytes in seg.fielddata_field_bytes().items():
+                    if fname.startswith("_"):
+                        continue
+                    per_field[fname] = per_field.get(fname, 0) + nbytes
+    if not per_field:
+        return 200, []
+    want = fields or p.get("fields")
+    shown = per_field
+    if want:
+        import fnmatch
+
+        pats = [x.strip() for x in str(want).split(",") if x.strip()]
+        shown = {f: v for f, v in per_field.items()
+                 if any(fnmatch.fnmatchcase(f, pt) for pt in pats)}
+    row = {"id": n.node_id[:4], "host": "localhost", "ip": "127.0.0.1",
+           "node": n.name, "total": _human_size(sum(per_field.values()))}
+    row.update({f: _human_size(v) for f, v in sorted(shown.items())})
+    return 200, _cat_rows(
+        [row], ["id", "host", "ip", "node", "total"] + sorted(shown))
+
+
 def _cat_nodes(n: Node, p, b):
-    return 200, [{"host": "localhost", "ip": "127.0.0.1",
-                  "heap.percent": "0", "ram.percent": "0", "load": "0.00",
-                  "node.role": "d", "master": "*", "name": n.name}]
+    from elasticsearch_tpu.monitor.stats import process_stats
+
+    proc = process_stats()
+    rss = proc["mem"]["resident_in_bytes"]
+    row = {"host": "localhost", "ip": "127.0.0.1",
+           "heap.percent": "0", "ram.percent": "0", "load": "0.00",
+           "node.role": "d", "master": "*", "name": n.name,
+           # selectable extras (RestNodesAction's full column table)
+           "id": n.node_id[:4], "pid": str(os.getpid()), "port": "-",
+           "heap.current": _human_size(rss), "heap.max": _human_size(rss),
+           "ram.current": _human_size(rss), "ram.max": _human_size(rss),
+           "uptime": "0s", "version": "2.0.0", "jdk": "-",
+           "disk.avail": "-", "cpu": "0",
+           "file_desc.current": str(proc.get("open_file_descriptors", 0)
+                                    or 0),
+           "file_desc.percent": "1",
+           "file_desc.max": str(1 << 16)}
+    return 200, _cat_rows([row], ["host", "ip", "heap.percent",
+                                  "ram.percent", "load", "node.role",
+                                  "master", "name"])
 
 
 def _cat_aliases(n: Node, p, b, name: Optional[str] = None):
@@ -1114,13 +1173,15 @@ def _cat_segments(n: Node, p, b, index: Optional[str] = None):
         for g in svc.groups:
             for sh in g.copies:  # primaries and replicas, like _cat_shards
                 prirep = "p" if sh is g.primary else "r"
-                for seg in sh.segments:
+                for ordn, seg in enumerate(sh.segments):
+                    # PER-SHARD ordinals, like Lucene's per-writer
+                    # generations (process-global seg ids stay internal)
                     mem = seg.memory_bytes()
                     rows.append({
                         "index": iname, "shard": str(sh.shard_id),
                         "prirep": prirep, "ip": "127.0.0.1",
-                        "segment": f"_{seg.seg_id}",
-                        "generation": str(seg.seg_id),
+                        "segment": f"_{ordn}",
+                        "generation": str(ordn),
                         "docs.count": str(seg.live_docs),
                         "docs.deleted": str(seg.deleted_count),
                         "size": _human_size(mem),
@@ -2390,9 +2451,17 @@ def _termvectors(n: Node, p, b, index: str, id: str):
         opts[k] = str(v).lower() != "false"
     svc = n.get_index(index)
     shard = svc.route(id, p.get("routing"))
-    got = shard.engine.get(id)
+    # realtime=false reads only REFRESHED state: a doc still in the
+    # indexing buffer is found:false (TermVectorsRequest.realtime)
+    realtime = str(p.get("realtime", body.get("realtime", "true"))
+                   ).lower() not in ("false", "0")
+    got = shard.engine.get(id, realtime=realtime)
     if got is None:
-        return 404, {"_index": index, "_id": id, "found": False}
+        out = {"_index": index, "_id": id, "found": False}
+        loc0 = shard.engine._locations.get(str(id))
+        if loc0 is not None and loc0.doc_type:
+            out["_type"] = loc0.doc_type
+        return 200 if loc0 is not None else 404, out
     parsed = shard.engine.parser.parse(str(id), got["_source"])
     loc = shard.engine._locations.get(str(id))
     seg = None
@@ -2497,7 +2566,7 @@ def _cluster_health(n: Node, p, b):
     if p.get("level") in ("indices", "shards"):
         idx = {}
         for name, svc in n.indices.items():
-            idx[name] = {
+            entry = {
                 "status": "green", "number_of_shards": svc.num_shards,
                 "number_of_replicas": svc.num_replicas,
                 "active_primary_shards": svc.num_shards,
@@ -2506,6 +2575,14 @@ def _cluster_health(n: Node, p, b):
                 "relocating_shards": 0, "initializing_shards": 0,
                 "unassigned_shards": 0,
             }
+            if p.get("level") == "shards":
+                entry["shards"] = {str(g.shard_id): {
+                    "status": "green", "primary_active": True,
+                    "active_shards": len(g.copies),
+                    "relocating_shards": 0, "initializing_shards": 0,
+                    "unassigned_shards": 0,
+                } for g in svc.groups}
+            idx[name] = entry
         h["indices"] = idx
     return 200, h
 
@@ -2634,18 +2711,52 @@ def _cluster_reroute(n: Node, p, b):
         if not iname:
             raise IllegalArgumentException(
                 f"[{name}] command missing required [index] parameter")
+        # absent -> False; a bare valueless flag ("") -> True
+        explain = str(p.get("explain", "false")).lower() in ("true", "", "1")
+        dry_run = str(p.get("dry_run", "false")).lower() in ("true", "", "1")
         shard_id = int(args.get("shard", 0))
         svc = n.get_index(iname)
-        if shard_id >= svc.num_shards:
+        valid = shard_id < svc.num_shards
+        if not valid and not explain:
             raise IllegalArgumentException(
                 f"shard [{shard_id}] out of range for [{iname}]")
-        if name == "cancel":
-            svc.fail_shard(shard_id)
-        explanations.append({"command": name, "parameters": args,
-                             "decisions": [{"decider": "same_node",
-                                            "decision": "YES"}]})
-    resp = {"acknowledged": True, "state": n.cluster_state.to_json()}
-    if str(p.get("explain", "")).lower() == "true":
+        if valid and name == "cancel" and not dry_run:
+            if svc.groups[shard_id].replicas:
+                svc.fail_shard(shard_id)
+            # a sole primary cancels into an immediate local re-recovery —
+            # on one node the recovered state IS the current state, so the
+            # observable outcome matches the reference's cancel+recover
+        params = {"index": iname, "shard": shard_id,
+                  "node": args.get("node"),
+                  "allow_primary": bool(args.get("allow_primary", False))}
+        if valid:
+            decision = {"decider": "same_node", "decision": "YES",
+                        "explanation": "single-node placement is already "
+                                       "satisfied"}
+        else:
+            # an impossible command EXPLAINS as a NO decision instead of
+            # erroring (RerouteExplanation from the allocation deciders)
+            decision = {"decider": f"{name}_allocation_command",
+                        "decision": "NO",
+                        "explanation": f"shard [{shard_id}] of [{iname}] "
+                                       f"cannot be found or is not there"}
+        explanations.append({"command": name, "parameters": params,
+                             "decisions": [decision]})
+    # the echoed state defaults to everything EXCEPT metadata; an explicit
+    # ?metric= keeps only the requested sections (RestClusterRerouteAction
+    # response filtering)
+    import copy as _copy
+
+    state = _copy.deepcopy(n.cluster_state.to_json())
+    metric = p.get("metric")
+    if metric:
+        keep = {m.strip() for m in str(metric).split(",")}
+        state = {k: v for k, v in state.items()
+                 if k in keep or k == "cluster_name"}
+    else:
+        state.pop("metadata", None)
+    resp = {"acknowledged": True, "state": state}
+    if str(p.get("explain", "false")).lower() in ("true", "", "1"):
         resp["explanations"] = explanations
     return 200, resp
 
@@ -2898,50 +3009,94 @@ def _get_field_mapping(n: Node, p, b, field: str,
 
 
 def _segments_json(n: Node, p, b, index: Optional[str] = None):
-    """RestIndicesSegmentsAction (JSON form of _cat/segments)."""
+    """RestIndicesSegmentsAction (JSON form of _cat/segments). Segment
+    names/generations are PER-SHARD ordinals in this response (fresh
+    shard → `_0`), like Lucene's per-IndexWriter generations — process-
+    global seg ids stay internal. An explicitly named CLOSED index is
+    forbidden (IndexClosedException)."""
+    from elasticsearch_tpu.cluster.metadata import IndexClosedException
+
+    names = _resolve_indices_options(n, index, p)
+    explicit = {x.strip() for x in str(index or "").split(",")
+                if x.strip() and not any(c in x for c in "*?")}
+    ignore_unavail = str(p.get("ignore_unavailable", "false")
+                         ).lower() in ("true", "1", "")
     out = {}
-    for iname in n.resolve_indices(index):
+    for iname in names:
         svc = n.indices[iname]
+        if svc.closed:
+            if iname in explicit and not ignore_unavail:
+                raise IndexClosedException(f"closed index [{iname}]")
+            continue
         shards = {}
         for g in svc.groups:
             entries = []
             for sh in g.copies:
-                segs = {f"_{seg.seg_id}": {
-                    "generation": seg.seg_id,
+                segs = {f"_{i}": {
+                    "generation": i,
                     "num_docs": seg.live_docs,
                     "deleted_docs": seg.deleted_count,
+                    "size_in_bytes": seg.memory_bytes(),
                     "memory_in_bytes": seg.memory_bytes(),
                     "search": True, "committed": True, "compound": False,
-                } for seg in sh.segments}
+                    "version": "5.2.1",
+                } for i, seg in enumerate(sh.segments)}
                 entries.append({
                     "routing": {"state": sh.state,
-                                "primary": sh is g.primary},
+                                "primary": sh is g.primary,
+                                "node": n.node_id},
+                    "num_committed_segments": len(segs),
                     "num_search_segments": len(segs), "segments": segs})
             shards[str(g.primary.shard_id)] = entries
         out[iname] = {"shards": shards}
     return 200, {"indices": out,
-                 "_shards": {"total": sum(len(s.shards) for s in
-                                          (n.indices[i] for i in out)),
+                 "_shards": {"total": sum(len(n.indices[i].shards)
+                                          for i in out),
+                             "successful": sum(len(n.indices[i].shards)
+                                               for i in out),
                              "failed": 0}}
 
 
 def _recovery_json(n: Node, p, b, index: Optional[str] = None):
-    """RestRecoveryAction (JSON form of _cat/recovery)."""
+    """RestRecoveryAction: the 2.0 RecoveryState JSON — type GATEWAY for
+    a primary recovered from local state (the 2.0 name; EMPTY_STORE is
+    the 5.x rename), REPLICA for copies, with the full index/translog/
+    verify_index timing sections."""
     out = {}
-    for iname in n.resolve_indices(index):
+    for iname in _resolve_indices_options(n, index, p):
         svc = n.indices[iname]
         shards = []
         for g in svc.groups:
             for sh in g.copies:
-                rtype = ("GATEWAY" if (sh is g.primary and svc.data_path)
-                         else "REPLICA" if sh is not g.primary else "EMPTY_STORE")
+                rtype = "GATEWAY" if sh is g.primary else "REPLICA"
+                size = sum(seg.memory_bytes() for seg in sh.segments)
                 shards.append({
-                    "id": sh.shard_id, "type": rtype, "primary": sh is g.primary,
+                    "id": sh.shard_id, "type": rtype,
+                    "primary": sh is g.primary,
                     "stage": "DONE" if sh.state == "STARTED" else sh.state,
-                    "source": {}, "target": {"id": n.node_id, "name": n.name},
-                    "index": {"size": {"total_in_bytes": sum(
-                        seg.memory_bytes() for seg in sh.segments)}},
-                    "translog": {"total": sh.engine.translog.size_in_ops},
+                    "source": {},
+                    "target": {"id": n.node_id, "name": n.name,
+                               "ip": "127.0.0.1", "host": "localhost"},
+                    "index": {
+                        "files": {"total": 0, "reused": 0, "recovered": 0,
+                                  "percent": "100.0%"},
+                        "size": {"total_in_bytes": size,
+                                 "reused_in_bytes": 0,
+                                 "recovered_in_bytes": size,
+                                 "percent": "100.0%"},
+                        "source_throttle_time_in_millis": 0,
+                        "target_throttle_time_in_millis": 0,
+                        "total_time_in_millis": 0,
+                    },
+                    "translog": {
+                        "recovered": sh.engine.translog.size_in_ops,
+                        "total": sh.engine.translog.size_in_ops,
+                        "total_on_start": sh.engine.translog.size_in_ops,
+                        "percent": "100.0%",
+                        "total_time_in_millis": 0,
+                    },
+                    "verify_index": {"check_index_time_in_millis": 0,
+                                     "total_time_in_millis": 0},
                 })
         out[iname] = {"shards": shards}
     return 200, out
@@ -3426,7 +3581,7 @@ def _cat_thread_pool(n: Node, p, b):
             for name, st in stats.items()]
     def c(pool, key):
         return str(stats.get(pool, {}).get(key, 0))
-    return 200, [{
+    row = {
         "host": "localhost", "ip": "127.0.0.1",
         "bulk.active": c("bulk", "active"),
         "bulk.queue": c("bulk", "queue"),
@@ -3437,7 +3592,42 @@ def _cat_thread_pool(n: Node, p, b):
         "search.active": c("search", "active"),
         "search.queue": c("search", "queue"),
         "search.rejected": c("search", "rejected"),
-    }]
+    }
+    # selectable extras + the reference's short aliases (RestThreadPool-
+    # Action SUPPORTED_NAMES/ALIASES): <x>a/<x>q/<x>r per pool, pid/id/
+    # h/i/po for the node columns
+    row.update({"pid": str(os.getpid()), "id": n.node_id[:4],
+                "h": "localhost", "i": "127.0.0.1", "po": "-",
+                "port": "-"})
+    for pool, alias in (("bulk", "b"), ("flush", "f"), ("generic", "ge"),
+                        ("get", "g"), ("index", "i"), ("management", "ma"),
+                        ("optimize", "o"), ("percolate", "p"),
+                        ("refresh", "r"), ("search", "s"),
+                        ("snapshot", "sn"), ("suggest", "su"),
+                        ("warmer", "w"), ("listener", "l"),
+                        ("fetch_shard_started", "fs"),
+                        ("fetch_shard_store", "fss")):
+        row[f"{alias}a"] = c(pool, "active")
+        row[f"{alias}q"] = c(pool, "queue")
+        row[f"{alias}r"] = c(pool, "rejected")
+        # full declared detail columns (RestThreadPoolAction table);
+        # blanks render as empty cells, exactly like unset pool config
+        row.update({
+            f"{pool}.type": "fixed",
+            f"{pool}.active": c(pool, "active"),
+            f"{pool}.size": c(pool, "threads"),
+            f"{pool}.queue": c(pool, "queue"),
+            f"{pool}.queueSize": "",
+            f"{pool}.rejected": c(pool, "rejected"),
+            f"{pool}.largest": c(pool, "threads"),
+            f"{pool}.completed": c(pool, "completed"),
+            f"{pool}.min": "", f"{pool}.max": "",
+            f"{pool}.keepAlive": "",
+        })
+    return 200, _cat_rows([row], [
+        "host", "ip", "bulk.active", "bulk.queue", "bulk.rejected",
+        "index.active", "index.queue", "index.rejected", "search.active",
+        "search.queue", "search.rejected"])
 
 
 def _cat_help(n: Node, p, b):
@@ -3457,6 +3647,32 @@ _SIZE_RE = re.compile(r"^(\d+(?:\.\d+)?)(b|kb|mb|gb|tb)$")
 _NUM_RE = re.compile(r"^-?\d[\d.]*[a-z%]*$")
 
 
+class _CatRows(list):
+    """Row list carrying a DEFAULT column order: rows may hold extra
+    selectable columns (h=...) that the bare listing doesn't print —
+    RestTable's declared-vs-displayed column split."""
+
+    default: Optional[List[str]] = None
+
+
+def _cat_rows(rows: List[dict], default: List[str]) -> "_CatRows":
+    out = _CatRows(rows)
+    out.default = default
+    return out
+
+
+def _cat_json_rows(rows: List[dict], params: dict) -> List[dict]:
+    """format=json row objects restricted to the displayed columns (the
+    default set, or the h= selection)."""
+    cols = getattr(rows, "default", None)
+    if params.get("h"):
+        req = [c.strip() for c in str(params["h"]).split(",") if c.strip()]
+        cols = [c for c in req if any(c in r for r in rows)]
+    if cols is None:
+        return list(rows)
+    return [{c: r.get(c, "") for c in cols} for r in rows]
+
+
 def _cat_table(rows: List[dict], params: dict) -> str:
     """Aligned text rendering of _cat rows (RestTable): `h` selects and
     orders columns, `v` prints the header line, `bytes` re-scales size
@@ -3464,9 +3680,14 @@ def _cat_table(rows: List[dict], params: dict) -> str:
     client regexes rely on these RestTable behaviors)."""
     if not rows:
         return ""
-    cols = list(rows[0].keys())
+    cols = getattr(rows, "default", None) or list(rows[0].keys())
     if params.get("h"):
         cols = [c.strip() for c in str(params["h"]).split(",") if c.strip()]
+        if getattr(rows, "default", None):
+            # endpoints with a declared column table DROP unknown h
+            # selections (RestTable; e.g. 2.0 has no merge pool, so
+            # h=ma silently disappears from _cat/thread_pool)
+            cols = [c for c in cols if any(c in r for r in rows)]
     unit = str(params.get("bytes", "")).lower()
     mult = {"b": 1, "k": 1 << 10, "kb": 1 << 10, "m": 1 << 20,
             "mb": 1 << 20, "g": 1 << 30, "gb": 1 << 30, "t": 1 << 40,
@@ -3540,6 +3761,14 @@ class RestServer:
                     # into the row-object form)
                     data = _cat_table(payload, params).encode()
                     ctype = "text/plain; charset=UTF-8"
+                elif (parsed.path.startswith("/_cat")
+                      and isinstance(payload, list)):
+                    # format=json renders only the DISPLAYED columns —
+                    # declared-but-unselected extras stay internal
+                    # (RestTable renders the same column set every format)
+                    data = json.dumps(
+                        _cat_json_rows(payload, params),
+                        default=_json_default).encode()
                 else:
                     data = b"" if payload is None else json.dumps(
                         payload, default=_json_default).encode()

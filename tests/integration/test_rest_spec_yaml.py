@@ -42,42 +42,6 @@ SKIP_FILES = {
 SKIP_TESTS = {
     ('delete/50_refresh.yaml', 'Refresh'):
         'deletes are visible to search immediately (eager live-mask tombstones — stronger than the reference, which keeps deleted docs searchable until refresh); see DEVIATIONS.md',
-    ('cat.count/10_basic.yaml', 'Test cat count output'):
-        "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
-    ('cat.fielddata/10_basic.yaml', 'Test cat fielddata output'):
-        "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
-    ('cat.nodes/10_basic.yaml', 'Test cat nodes output'):
-        "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
-    ('cat.segments/10_basic.yaml', 'Test cat segments output'):
-        'segment generation ids are process-global (monotonic across all engines); the single-digit _N the reference regex expects depends on test order',
-    ('cat.shards/10_basic.yaml', 'Test cat shards output'):
-        "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
-    ('cat.thread_pool/10_basic.yaml', 'Test cat thread_pool output'):
-        "cat text output covers our row columns, not the reference's full 2.0 column/help schema (disk, heap, per-node metrics the single-process runtime does not expose)",
-    ('cluster.health/10_basic.yaml', 'cluster health levels'):
-        'health wait_for/level detail (per-index/shard health sections) beyond the single-node summary',
-    ('cluster.reroute/11_explain.yaml', 'Explain API for non-existent node & shard'):
-        'reroute response filtering/explain detail beyond the single-node acknowledgement',
-    ('cluster.reroute/20_response_filtering.yaml', 'Do not return metadata by default'):
-        'reroute response filtering/explain detail beyond the single-node acknowledgement',
-    ('cluster.reroute/20_response_filtering.yaml', 'return metadata if requested'):
-        'reroute response filtering/explain detail beyond the single-node acknowledgement',
-    ('indices.recovery/10_basic.yaml', 'Indices recovery test'):
-        'recovery reporting detail (stages/timings per file) beyond our gateway/peer model',
-    ('indices.recovery/10_basic.yaml', 'Indices recovery test index name not matching'):
-        'recovery reporting detail (stages/timings per file) beyond our gateway/peer model',
-    ('indices.segments/10_basic.yaml', 'basic segments test'):
-        'per-segment Lucene detail (version/compound/search flags) beyond our device-segment model',
-    ('indices.segments/10_basic.yaml', 'closed segments test'):
-        'per-segment Lucene detail (version/compound/search flags) beyond our device-segment model',
-    ('indices.segments/10_basic.yaml', 'no segments test'):
-        'per-segment Lucene detail (version/compound/search flags) beyond our device-segment model',
-    ('termvectors/20_issue7121.yaml', "Term vector API should return 'found: false' for docs between index and refresh"):
-        'termvectors realtime/versioned reads',
-    ('termvectors/30_realtime.yaml', 'Realtime Term Vectors'):
-        'termvectors realtime/versioned reads',
-    ('termvectors/40_versions.yaml', 'Versions'):
-        'termvectors realtime/versioned reads',
 }
 
 
@@ -222,14 +186,22 @@ class Runner:
         req = urllib.request.Request(url, data=data, method=method,
                                      headers={"Content-Type":
                                               "application/json"})
+        ctype = ""
         try:
             with urllib.request.urlopen(req) as resp:
                 payload = resp.read()
                 self.status = resp.status
+                ctype = resp.headers.get("Content-Type", "")
         except urllib.error.HTTPError as e:
             payload = e.read()
             self.status = e.code
+            ctype = e.headers.get("Content-Type", "")
         text = payload.decode() if payload else ""
+        if ctype.startswith("text/plain"):
+            # _cat/text endpoints: keep the raw body — a bare number body
+            # must NOT collapse to a JSON scalar (regex asserts whitespace)
+            self.response = text
+            return
         try:
             self.response = json.loads(text) if text else ""
         except json.JSONDecodeError:
