@@ -87,6 +87,9 @@ class MultiHostCluster:
             DistributedDataService
 
         self.data = DistributedDataService(self)
+        # REST handlers route dist-index operations through the data
+        # plane when this hook is present (rest/server.py::_mh)
+        node.multihost = self
         self.transport.register("cluster:publish", self._on_publish)
         if rank == 0:
             self.transport.register("cluster:join", self._on_join)

@@ -43,6 +43,7 @@ ACTION_FETCH = "indices:data/read/search[phase/fetch]"
 ACTION_FREE = "indices:data/read/search[free_context]"
 ACTION_INDEX = "indices:data/write/index"
 ACTION_DELETE = "indices:data/write/delete"
+ACTION_UPDATE = "indices:data/write/update"
 ACTION_GET = "indices:data/read/get"
 ACTION_REFRESH = "indices:admin/refresh"
 ACTION_CREATE = "indices:admin/create"
@@ -72,6 +73,7 @@ class DistributedDataService:
         t.register(ACTION_FREE, self._on_free)
         t.register(ACTION_INDEX, self._on_index)
         t.register(ACTION_DELETE, self._on_delete)
+        t.register(ACTION_UPDATE, self._on_update)
         t.register(ACTION_GET, self._on_get)
         t.register(ACTION_REFRESH, self._on_refresh)
         t.register(ACTION_CREATE, self._on_create)
@@ -299,15 +301,65 @@ class DistributedDataService:
                                    payload.get("kw") or {})
 
     def delete_doc(self, index: str, doc_id: str,
-                   routing: Optional[str] = None) -> dict:
+                   routing: Optional[str] = None, **kw) -> dict:
         meta = self._meta(index)
         sid = shard_id_for(doc_id, meta["num_shards"], routing)
         owner = self.owner_of(index, sid)
         if owner == self._local_id():
             return self._primary_write("delete", index, sid, doc_id, None,
-                                       routing, {})
+                                       routing, kw)
         return self._send(owner, ACTION_DELETE,
-                          {"index": index, "id": doc_id, "routing": routing})
+                          {"index": index, "id": doc_id, "routing": routing,
+                           "kw": kw})
+
+    def update_doc(self, index: str, doc_id: str, body: dict,
+                   routing: Optional[str] = None, **kw) -> dict:
+        """Routed partial update: executes ON the primary owner (the merge
+        must read the current source there), which then fans the resulting
+        full doc out through the normal replica hop (reference:
+        TransportUpdateAction resolving to an index op on the primary)."""
+        meta = self._meta(index)
+        sid = shard_id_for(doc_id, meta["num_shards"], routing)
+        owner = self.owner_of(index, sid)
+        if owner == self._local_id():
+            return self._primary_update(index, sid, doc_id, body, routing,
+                                        kw)
+        return self._send(owner, ACTION_UPDATE,
+                          {"index": index, "id": doc_id, "body": body,
+                           "routing": routing, "kw": kw})
+
+    def _primary_update(self, index: str, sid: int, doc_id: str,
+                        body: dict, routing: Optional[str],
+                        kw: dict) -> dict:
+        svc = self.node.indices[index]
+        with self._write_lock(index, sid):
+            res = svc.update_doc(doc_id, body, routing=routing, **kw)
+            meta = self._meta(index)
+            got = svc.get_doc(doc_id, routing=routing)
+            copies = (meta["assignment"][str(sid)][1:]
+                      + meta.get("initializing", {}).get(str(sid), []))
+            if got.get("found"):
+                rep_kw = {"version": res["_version"],
+                          "version_type": "external_gte"}
+                for rep in copies:
+                    if rep == self._local_id():
+                        continue
+                    try:
+                        self._send(rep, ACTION_INDEX,
+                                   {"index": index, "id": doc_id,
+                                    "source": got["_source"],
+                                    "routing": routing, "kw": rep_kw,
+                                    "replica": True})
+                    except Exception:
+                        self._report_copy_failed(index, sid, rep)
+        return res
+
+    def _on_update(self, payload: dict) -> dict:
+        index, doc_id = payload["index"], payload["id"]
+        routing = payload.get("routing")
+        sid = shard_id_for(doc_id, self._meta(index)["num_shards"], routing)
+        return self._primary_update(index, sid, doc_id, payload["body"],
+                                    routing, payload.get("kw") or {})
 
     def _on_delete(self, payload: dict) -> dict:
         index, doc_id = payload["index"], payload["id"]

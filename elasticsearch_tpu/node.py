@@ -313,22 +313,28 @@ class Node:
             routing = meta.get("routing", meta.get("_routing")) or parent
             doc_type = meta.get("_type")
             try:
-                svc = self.get_or_autocreate(index_name)
+                # distributed index: every op hash-routes to its shard's
+                # owner process (TransportBulkAction shard-bulk routing)
+                mh = getattr(self, "multihost", None)
+                data = (mh.data if mh is not None
+                        and index_name in mh.dist_indices else None)
+                svc = data or self.get_or_autocreate(index_name)
+                args = (index_name,) if data is not None else ()
                 if op in ("index", "create"):
                     kw = {}
                     if doc_type and doc_type != "_doc":
                         kw["doc_type"] = doc_type
                     if parent:
                         kw["parent"] = parent
-                    r = svc.index_doc(doc_id, source, routing=routing,
+                    r = svc.index_doc(*args, doc_id, source, routing=routing,
                                       op_type="create" if op == "create" else "index",
                                       **kw)
                     status = 201 if r.get("created") else 200
                 elif op == "update":
-                    r = svc.update_doc(doc_id, source, routing=routing)
+                    r = svc.update_doc(*args, doc_id, source, routing=routing)
                     status = 200
                 elif op == "delete":
-                    r = svc.delete_doc(doc_id, routing=routing)
+                    r = svc.delete_doc(*args, doc_id, routing=routing)
                     status = 200
                 else:
                     raise ElasticsearchTpuException(f"unknown bulk op [{op}]")
@@ -354,6 +360,11 @@ class Node:
 
     def search(self, index: Optional[str], body: dict,
                preference: Optional[str] = None) -> dict:
+        mh = getattr(self, "multihost", None)
+        if mh is not None and index in mh.dist_indices:
+            # a distributed index scatters cross-host; multi-index
+            # expressions mixing local + distributed stay local-scoped
+            return mh.data.search(index, body or {})
         names = self.resolve_indices(index)
         if not names and index not in (None, "", "_all", "*"):
             raise IndexNotFoundException(str(index))

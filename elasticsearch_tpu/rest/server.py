@@ -353,8 +353,8 @@ def _register_all(rc: RestController):
         lambda n, p, b, nodeid, metric: (200, n.nodes_stats()))
 
     # index admin
-    add("PUT", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
-    add("POST", "/{index}", lambda n, p, b, index: (200, n.create_index(index, _json(b))))
+    add("PUT", "/{index}", _create_index)
+    add("POST", "/{index}", _create_index)
     add("DELETE", "/{index}", lambda n, p, b, index: (200, n.delete_index(index)))
     add("HEAD", "/{index}", _index_exists)
     add("GET", "/{index}/_mapping", _get_mapping_index)
@@ -1363,7 +1363,11 @@ def _get_alias(n: Node, p, b, alias: str):
 def _refresh(n: Node, p, b, index: str):
     names = _resolve_indices_options(n, index, p)
     for name in names:
-        n.indices[name].refresh()
+        data = _mh_for(n, name)
+        if data is not None:
+            data.refresh(name)  # refreshes every process's copies
+        else:
+            n.indices[name].refresh()
     return 200, {"_shards": _shards_header(n, names)}
 
 
@@ -1405,7 +1409,15 @@ def _count_with_body(n: Node, index: Optional[str], body: dict):
     total = 0
     nshards = 0
     for name in svc_names:
-        total += n.indices[name].count(body)["count"]
+        data = _mh_for(n, name)
+        if data is not None:
+            # cross-host count = a size-0 scatter/gather round
+            r = data.search(name, {"query": body.get("query",
+                                                     {"match_all": {}}),
+                                   "size": 0})
+            total += r["hits"]["total"]
+        else:
+            total += n.indices[name].count(body)["count"]
         nshards += n.indices[name].num_shards
     return 200, {"count": total, "_shards": {"total": nshards,
                                              "successful": nshards,
@@ -1476,9 +1488,39 @@ def _do_analyze(reg, body: dict, svc=None) -> dict:
 
 # -- document handlers --------------------------------------------------------
 
-def _index_doc(n: Node, p, b, index: str, id: str, doc_type: Optional[str] = None):
-    svc = n.get_or_autocreate(index)
-    kw = {}
+def _mh(n: Node):
+    """The multi-host data plane, when this node runs in a jax.distributed
+    world (cluster/bootstrap.py sets node.multihost). REST operations on
+    distributed indices route through it so writes land on shard-owner
+    processes and searches scatter/gather cross-host."""
+    return getattr(n, "multihost", None)
+
+
+def _mh_for(n: Node, index: Optional[str]):
+    """The data service IF `index` names a distributed index."""
+    c = _mh(n)
+    if c is not None and index in c.dist_indices:
+        return c.data
+    return None
+
+
+def _create_index(n: Node, p, b, index: str):
+    c = _mh(n)
+    if c is not None:
+        # multi-host world: every create goes through the master so the
+        # shard→node assignment is computed once and published; the wire
+        # result's assignment map stays internal — clients get the
+        # standard create envelope
+        c.data.create_index(index, _json(b))
+        return 200, {"acknowledged": True, "shards_acknowledged": True,
+                     "index": index}
+    return 200, n.create_index(index, _json(b))
+
+
+def _index_kw(p, doc_type: Optional[str]) -> dict:
+    """The index-op kwargs every write route forwards (version checks,
+    op_type, parent-as-routing, timestamp/ttl meta)."""
+    kw: Dict[str, Any] = {}
     if "version" in p:
         kw["version"] = int(p["version"])
         kw["version_type"] = p.get("version_type", "internal")
@@ -1494,6 +1536,20 @@ def _index_doc(n: Node, p, b, index: str, id: str, doc_type: Optional[str] = Non
         kw["timestamp"] = p["timestamp"]
     if p.get("ttl"):  # _ttl meta field (TTLFieldMapper)
         kw["ttl"] = p["ttl"]
+    return kw
+
+
+def _index_doc(n: Node, p, b, index: str, id: str, doc_type: Optional[str] = None):
+    kw = _index_kw(p, doc_type)
+    data = _mh_for(n, index)
+    if data is not None:
+        r = data.index_doc(index, id, _json(b),
+                           routing=p.get("routing") or p.get("parent"),
+                           **kw)
+        if _refresh_requested(p):
+            data.refresh(index)
+        return (201 if r.get("created") else 200), r
+    svc = n.get_or_autocreate(index)
     r = svc.index_doc(id, _json(b), routing=p.get("routing") or p.get("parent"), **kw)
     if _refresh_requested(p):
         svc.refresh()
@@ -1501,6 +1557,13 @@ def _index_doc(n: Node, p, b, index: str, id: str, doc_type: Optional[str] = Non
 
 
 def _index_doc_auto(n: Node, p, b, index: str):
+    data = _mh_for(n, index)
+    if data is not None:
+        r = data.index_doc(index, None, _json(b),
+                           routing=p.get("routing"))
+        if _refresh_requested(p):
+            data.refresh(index)
+        return 201, r
     svc = n.get_or_autocreate(index)
     r = svc.index_doc(None, _json(b), routing=p.get("routing"))
     if _refresh_requested(p):
@@ -1509,6 +1572,10 @@ def _index_doc_auto(n: Node, p, b, index: str):
 
 
 def _create_doc(n: Node, p, b, index: str, id: str):
+    data = _mh_for(n, index)
+    if data is not None:
+        return 201, data.index_doc(index, id, _json(b), op_type="create",
+                                   routing=p.get("routing"))
     svc = n.get_or_autocreate(index)
     r = svc.index_doc(id, _json(b), op_type="create", routing=p.get("routing"))
     return 201, r
@@ -1592,9 +1659,19 @@ def _realtime_kw(n, p, index: str) -> dict:
 def _get_doc(n: Node, p, b, index: str, id: str):
     from elasticsearch_tpu.search.service import _filter_source
 
-    svc = n.get_index(index)
-    r = svc.get_doc(id, routing=p.get("routing") or p.get("parent"),
-                    **_realtime_kw(n, p, index))
+    data = _mh_for(n, index)
+    if data is not None:
+        # cross-host routed read, then the SAME response shaping as the
+        # local path (version-checked reads, _source filtering, fields) —
+        # the meta-field lookups that need the local engine location are
+        # unavailable for remote docs and simply absent
+        r = data.get_doc(index, id,
+                         routing=p.get("routing") or p.get("parent"))
+        svc = None
+    else:
+        svc = n.get_index(index)
+        r = svc.get_doc(id, routing=p.get("routing") or p.get("parent"),
+                        **_realtime_kw(n, p, index))
     if not r.get("found"):
         return 404, r
     if "version" in p and p.get("version_type") != "force" \
@@ -1626,7 +1703,8 @@ def _get_doc(n: Node, p, b, index: str, id: str):
     fields = p.get("fields")
     if fields:
         names = [f.strip() for f in fields.split(",") if f.strip()]
-        loc = svc.route(id, p.get("routing")).engine._locations.get(str(id))
+        loc = (svc.route(id, p.get("routing")).engine._locations.get(str(id))
+               if svc is not None else None)
         src = r.get("_source") or {}
         out: Dict[str, Any] = {}
         for f in names:
@@ -1696,11 +1774,19 @@ def _get_source(n: Node, p, b, index: str, id: str):
 
 
 def _delete_doc(n: Node, p, b, index: str, id: str):
-    svc = n.get_index(index)
     kw = {}
     if "version" in p:  # optimistic concurrency, like the index route
         kw["version"] = int(p["version"])
         kw["version_type"] = p.get("version_type", "internal")
+    data = _mh_for(n, index)
+    if data is not None:
+        r = data.delete_doc(index, id,
+                            routing=p.get("routing") or p.get("parent"),
+                            **kw)
+        if _refresh_requested(p):
+            data.refresh(index)
+        return 200, r
+    svc = n.get_index(index)
     r = svc.delete_doc(id, routing=p.get("routing") or p.get("parent"), **kw)
     if _refresh_requested(p):
         svc.refresh()
@@ -1711,7 +1797,6 @@ def _update_doc(n: Node, p, b, index: str, id: str,
                 doc_type: Optional[str] = None):
     # update auto-creates the index (reference: TransportUpdateAction
     # routes through auto-create like index does)
-    svc = n.get_or_autocreate(index)
     body = _json(b)
     kw: Dict[str, Any] = {}
     if "version" in p:
@@ -1723,6 +1808,17 @@ def _update_doc(n: Node, p, b, index: str, id: str,
         kw["timestamp"] = p["timestamp"]
     if p.get("ttl"):
         kw["ttl"] = p["ttl"]
+    data = _mh_for(n, index)
+    if data is not None:
+        # routed to the primary owner — the partial-update merge must
+        # read the current source there
+        r = data.update_doc(index, id, body,
+                            routing=p.get("routing") or p.get("parent"),
+                            doc_type=doc_type, **kw)
+        if _refresh_requested(p):
+            data.refresh(index)
+        return 200, r
+    svc = n.get_or_autocreate(index)
     r = svc.update_doc(id, body,
                        routing=p.get("routing") or p.get("parent"),
                        doc_type=doc_type, **kw)
@@ -1871,7 +1967,11 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
     rt = (spec.get("routing") or spec.get("_routing")
           or spec.get("parent") or spec.get("_parent"))
     rt = str(rt) if rt is not None else None
-    got = svc.get_doc(doc_id, routing=rt, **_realtime_kw(n, p, iname))
+    data = _mh_for(n, svc.name)
+    if data is not None:
+        got = data.get_doc(svc.name, doc_id, routing=rt)
+    else:
+        got = svc.get_doc(doc_id, routing=rt, **_realtime_kw(n, p, iname))
     got["_index"] = svc.name  # concrete index, even via an alias
     got["_id"] = doc_id
     if (got.get("found") and want_type not in (None, "_all", "_doc")
@@ -2037,10 +2137,19 @@ def _with_type_filter(body: dict, type: Optional[str]) -> dict:
 
 
 def _search(n: Node, p, b, index: str):
+    data = _mh_for(n, index)
+    if data is not None:
+        # distributed index: scatter the query phase to shard-owner
+        # processes, merge, fetch (cluster/search_action.py)
+        return 200, data.search(index, _search_body(p, b))
     return 200, n.search(index, _search_body(p, b), preference=p.get("preference"))
 
 
 def _search_typed(n: Node, p, b, index: str, type: str):
+    data = _mh_for(n, index)
+    if data is not None:
+        return 200, data.search(index,
+                                _with_type_filter(_search_body(p, b), type))
     return 200, n.search(index, _with_type_filter(_search_body(p, b), type),
                          preference=p.get("preference"))
 

@@ -150,8 +150,10 @@ def test_cross_host_query_then_fetch(master):
         c.data.refresh("events")
 
         # the remote process REALLY holds one shard: the coordinator's own
-        # node sees only a strict subset locally
-        local_total = node.search("events", {"size": 0})["hits"]["total"]
+        # engines hold only a strict subset (Node.search itself now
+        # scatters cross-host, so read the local copies directly)
+        local_total = sum(sh.engine.num_docs
+                          for sh in node.indices["events"].shards)
         assert 0 < local_total < 40, local_total
 
         # routed point reads cross the boundary too
@@ -286,6 +288,98 @@ def test_join_triggers_shard_recovery_stream(master):
         assert _wait(lambda: _rank1_docs() == 30, timeout=20.0), \
             _rank1_docs()
     finally:
+        p.kill()
+        p.wait()
+
+
+def test_rest_routes_through_cross_host_data_plane(master):
+    """`--coordinator` mode end-to-end: REST operations on a distributed
+    index route through the data plane — create computes the assignment
+    on the master, writes land on shard-owner processes, GET/DELETE are
+    hash-routed, and search scatters the query phase cross-host."""
+    import json
+    import urllib.request
+
+    from elasticsearch_tpu.rest.server import RestServer
+
+    node, c = master
+    p = _spawn_rank1(c.master_addr[1])
+    srv = RestServer(node, port=0)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, body=None):
+        r = urllib.request.Request(
+            base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None)
+        try:
+            with urllib.request.urlopen(r) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    try:
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        st, r = req("PUT", "/revents", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        assert st == 200 and r["acknowledged"], r
+        owners = {o[0] for o in
+                  c.dist_indices["revents"]["assignment"].values()}
+        assert len(owners) == 2  # really split across the two processes
+        for i in range(20):
+            st, r = req("PUT", f"/revents/t/{i}",
+                        {"body": f"alpha tok{i}"})
+            assert st in (200, 201) and r["result"] == "created", r
+        st, _ = req("POST", "/revents/_refresh")
+        assert st == 200
+        # a doc on the REMOTE shard is readable and deletable over REST
+        from elasticsearch_tpu.cluster.routing import shard_id_for
+
+        remote_id = next(
+            str(i) for i in range(20)
+            if c.data.owner_of("revents", shard_id_for(str(i), 2))
+            != c.local.node_id)
+        st, g = req("GET", f"/revents/t/{remote_id}")
+        assert st == 200 and g["found"], g
+        st, r = req("POST", "/revents/_search",
+                    {"query": {"match": {"body": "alpha"}}, "size": 25})
+        assert st == 200 and r["hits"]["total"] == 20, r["hits"]["total"]
+        assert r["_shards"] == {"total": 2, "successful": 2, "failed": 0}
+        st, d = req("DELETE", f"/revents/t/{remote_id}?refresh=true")
+        assert st == 200 and d["result"] == "deleted", d
+        st, r = req("POST", "/revents/_search",
+                    {"query": {"match_all": {}}, "size": 25})
+        assert r["hits"]["total"] == 19
+        assert remote_id not in {h["_id"] for h in r["hits"]["hits"]}
+        # typed search, count, update, and bulk all route cross-host too
+        st, r = req("POST", "/revents/t/_search",
+                    {"query": {"match_all": {}}, "size": 0})
+        assert r["hits"]["total"] == 19, r["hits"]["total"]
+        st, r = req("GET", "/revents/_count")
+        assert r["count"] == 19, r
+        other_remote = next(
+            str(i) for i in range(20)
+            if str(i) != remote_id
+            and c.data.owner_of("revents", shard_id_for(str(i), 2))
+            != c.local.node_id)
+        st, r = req("POST", f"/revents/t/{other_remote}/_update",
+                    {"doc": {"body": "updated zeta"}})
+        assert st == 200 and r["result"] == "updated", r
+        st, g = req("GET", f"/revents/t/{other_remote}")
+        assert g["_source"]["body"] == "updated zeta", g
+        ndjson = (json.dumps({"index": {"_index": "revents", "_type": "t",
+                                        "_id": "b1"}})
+                  + "\n" + json.dumps({"body": "bulk doc"}) + "\n")
+        breq = urllib.request.Request(base + "/_bulk", method="POST",
+                                      data=ndjson.encode())
+        with urllib.request.urlopen(breq) as resp:
+            br = json.loads(resp.read())
+        assert not br["errors"], br
+        st, g = req("GET", "/revents/t/b1")
+        assert st == 200 and g["found"], g
+    finally:
+        srv.stop()
         p.kill()
         p.wait()
 
