@@ -50,6 +50,10 @@ ACTION_CREATE = "indices:admin/create"
 ACTION_RECOVER = "indices:recovery/start"
 ACTION_SHARD_SYNC = "indices:recovery/shard_sync"
 ACTION_SHARD_FAILED = "cluster:shard_failed"
+ACTION_SNAPSHOT = "cluster:admin/snapshot/create"
+ACTION_SNAPSHOT_SHARD = "indices:admin/snapshot/shard"
+ACTION_RESTORE = "cluster:admin/snapshot/restore"
+ACTION_RESTORE_SHARDS = "indices:admin/snapshot/restore_shards"
 
 _CONTEXT_TTL = 120.0
 
@@ -80,8 +84,24 @@ class DistributedDataService:
         t.register(ACTION_RECOVER, self._on_recover)
         t.register(ACTION_SHARD_SYNC, self._on_shard_sync)
         t.register(ACTION_SHARD_FAILED, self._on_shard_failed)
+        t.register(ACTION_SNAPSHOT, self._on_snapshot)
+        t.register(ACTION_SNAPSHOT_SHARD, self._on_snapshot_shard)
+        t.register(ACTION_RESTORE, self._on_restore)
+        t.register(ACTION_RESTORE_SHARDS, self._on_restore_shards)
 
     # -- ownership -----------------------------------------------------------
+
+    def resolve_index(self, index: str) -> str:
+        """Resolve an alias to its single distributed index: aliases ride
+        the published dist metadata (restore attaches them), and every
+        process applies them to its local copy on adopt, so resolution
+        works on coordinators that own no shard of the target."""
+        if index in self.cluster.dist_indices:
+            return index
+        names = self.node.resolve_indices(index)
+        if len(names) == 1 and names[0] in self.cluster.dist_indices:
+            return names[0]
+        return index
 
     def _meta(self, index: str) -> dict:
         meta = self.cluster.dist_indices.get(index)
@@ -153,21 +173,34 @@ class DistributedDataService:
                     if cand not in owners:
                         owners.append(cand)
                 assignment[str(i)] = owners
-            self.cluster.dist_indices[name] = {
-                "body": local_body, "num_shards": num_shards,
-                "replicas": replicas, "assignment": assignment,
-                # copies being recovered: visible for write fanout (they
-                # must see live writes during the copy), NOT promotable or
-                # searchable until recovery succeeds — the reference's
-                # INITIALIZING shard state
-                "initializing": {}}
+            if payload.get("pending"):
+                # restore path: every copy starts INITIALIZING (not
+                # searchable, not a write target) and graduates into the
+                # assignment only when its replay succeeds — the
+                # reference's SNAPSHOT recovery source keeps restoring
+                # shards in INITIALIZING the same way
+                meta = {"body": local_body, "num_shards": num_shards,
+                        "replicas": replicas,
+                        "assignment": {str(i): [] for i in range(num_shards)},
+                        "initializing": {k: list(v)
+                                         for k, v in assignment.items()}}
+            else:
+                meta = {"body": local_body, "num_shards": num_shards,
+                        "replicas": replicas, "assignment": assignment,
+                        # copies being recovered: visible for write fanout
+                        # (they must see live writes during the copy), NOT
+                        # promotable or searchable until recovery succeeds
+                        # — the reference's INITIALIZING shard state
+                        "initializing": {}}
+            self.cluster.dist_indices[name] = meta
             if not self.node.index_exists(name):
                 self.node.create_index(name, local_body)
         self.cluster.publish_indices()
         return {"acknowledged": True, "index": name,
-                "assignment": assignment}
+                "assignment": assignment, "local_body": local_body}
 
     def refresh(self, index: str) -> None:
+        index = self.resolve_index(index)
         self._meta(index)
         self.node.indices[index].refresh()
         for nid in self._other_nodes():
@@ -183,10 +216,246 @@ class DistributedDataService:
         self.node.indices[payload["index"]].refresh()
         return {"ok": True}
 
+    # -- distributed snapshot / restore --------------------------------------
+
+    def create_snapshot(self, location: str, snap_name: str,
+                        indices: Optional[List[str]] = None,
+                        include_global_state: bool = True,
+                        repo_name: str = "_snapshot") -> dict:
+        """Snapshot distributed indices into a SHARED filesystem repository:
+        the master assembles the manifest, each shard's primary owner
+        writes that shard's blobs itself (reference:
+        snapshots/SnapshotsService.java — master drives the snapshot
+        cluster-state machine; SnapshotShardsService on each data node
+        writes its own shard files to the repository)."""
+        payload = {"location": location, "snapshot": snap_name,
+                   "indices": indices, "repo_name": repo_name,
+                   "include_global_state": include_global_state}
+        if not self.cluster.is_master:
+            return self.cluster.transport.send_remote(
+                self.cluster.master_addr, ACTION_SNAPSHOT, payload,
+                timeout=300.0)
+        return self._on_snapshot(payload)
+
+    def _on_snapshot(self, payload: dict) -> dict:
+        """Master: assemble the manifest via the shared create_snapshot,
+        with a shard writer that fans each distributed index's shards out
+        to their primary owners (one batched RPC per owner). A failed
+        owner RPC records its shards failed and the snapshot PARTIAL —
+        same accounting local shard failures already get."""
+        from elasticsearch_tpu.index.snapshots import (FsRepository,
+                                                       _local_shards_meta,
+                                                       create_snapshot,
+                                                       snapshot_shard)
+
+        repo = FsRepository(payload.get("repo_name") or "_snapshot",
+                            payload["location"])
+
+        def shards_fn(iname: str, svc) -> dict:
+            meta = self.cluster.dist_indices.get(iname)
+            if meta is None:  # a master-local (non-distributed) index
+                return _local_shards_meta(repo, svc)
+            try:
+                self.refresh(iname)  # refresh-consistent view everywhere
+            except Exception:
+                # a dead peer must degrade to PARTIAL below, not abort the
+                # whole snapshot; local copies refreshed before the raise
+                pass
+            shards_meta: List[Optional[dict]] = [None] * meta["num_shards"]
+            failed = 0
+            by_owner: Dict[str, List[int]] = {}
+            for sid in range(meta["num_shards"]):
+                try:
+                    owner = self.owner_of(iname, sid)
+                except Exception:
+                    # no active copies (mid-recovery / lost shard): a
+                    # failed snapshot shard, same as a dead owner's
+                    failed += 1
+                    shards_meta[sid] = {"blobs": [], "versions": {},
+                                        "failed": True}
+                    continue
+                by_owner.setdefault(owner, []).append(sid)
+            for owner, sids in sorted(by_owner.items()):
+                try:
+                    if owner == self._local_id():
+                        got = [snapshot_shard(repo, svc.shards[sid])
+                               for sid in sids]
+                    else:
+                        got = self._send(
+                            owner, ACTION_SNAPSHOT_SHARD,
+                            {"location": payload["location"],
+                             "repo_name": repo.name,
+                             "index": iname, "shards": sids}, timeout=300.0)
+                    for sid, m in zip(sids, got):
+                        shards_meta[sid] = m
+                except Exception:
+                    failed += len(sids)
+                    for sid in sids:
+                        shards_meta[sid] = {"blobs": [], "versions": {},
+                                            "failed": True}
+            # the manifest must round-trip the CROSS-HOST replica count:
+            # _on_create pops number_of_replicas out of the local settings,
+            # so svc.settings alone would restore with zero redundancy
+            settings = dict(svc.settings)
+            if meta.get("replicas"):
+                settings["number_of_replicas"] = meta["replicas"]
+            return {"shards": shards_meta, "failed": failed,
+                    "settings": settings}
+
+        indices = payload.get("indices")
+        if indices is None:
+            indices = sorted(set(self.node.indices)
+                             | set(self.cluster.dist_indices))
+        return create_snapshot(
+            self.node, repo, payload["snapshot"], indices=indices,
+            include_global_state=payload.get("include_global_state", True),
+            shards_fn=shards_fn)
+
+    def _on_snapshot_shard(self, payload: dict) -> List[dict]:
+        """Shard owner: write the requested shards' blobs into the shared
+        repo; one batched call per owner process."""
+        from elasticsearch_tpu.index.snapshots import (FsRepository,
+                                                       snapshot_shard)
+
+        repo = FsRepository(payload.get("repo_name") or "_snapshot",
+                            payload["location"])
+        svc = self.node.indices[payload["index"]]
+        return [snapshot_shard(repo, svc.shards[sid])
+                for sid in payload["shards"]]
+
+    def restore_snapshot(self, location: str, snap_name: str,
+                         indices: Optional[List[str]] = None,
+                         rename_pattern: Optional[str] = None,
+                         rename_replacement: Optional[str] = None,
+                         partial: bool = False,
+                         repo_name: str = "_snapshot") -> dict:
+        """Restore a snapshot INTO the multi-host cluster: the master
+        computes a fresh cross-host shard assignment for each restored
+        index, then every assigned copy replays its shard's blobs from the
+        shared repository (reference: snapshots/RestoreService.java:1-120 —
+        the master creates restore routing with a SNAPSHOT recovery
+        source; each data node recovers its shards from the repo)."""
+        payload = {"location": location, "snapshot": snap_name,
+                   "indices": indices, "rename_pattern": rename_pattern,
+                   "rename_replacement": rename_replacement,
+                   "partial": partial, "repo_name": repo_name}
+        if not self.cluster.is_master:
+            return self.cluster.transport.send_remote(
+                self.cluster.master_addr, ACTION_RESTORE, payload,
+                timeout=300.0)
+        return self._on_restore(payload)
+
+    def _on_restore(self, payload: dict) -> dict:
+        from elasticsearch_tpu.index.snapshots import FsRepository, \
+            select_restore_targets
+
+        repo = FsRepository(payload.get("repo_name") or "_snapshot",
+                            payload["location"])
+        snap = payload["snapshot"]
+        manifest = repo.get_manifest(snap)
+        indices = payload.get("indices")
+        # validate EVERY target before touching any index — a collision on
+        # index B must not leave index A half-restored (shared with the
+        # single-node path; the extra `exists` covers dist_indices)
+        selected = select_restore_targets(
+            self.node, manifest, indices, payload.get("rename_pattern"),
+            payload.get("rename_replacement"),
+            bool(payload.get("partial")),
+            exists=lambda t: t in self.cluster.dist_indices)
+        restored: List[str] = []
+        total = failed = 0
+        for iname, target, imeta in selected:
+            num_shards = len(imeta["shards"])
+            total += num_shards
+            settings = dict(imeta.get("settings") or {})
+            settings["number_of_shards"] = num_shards
+            body = {"settings": settings, "mappings": imeta["mappings"]}
+            # copies start INITIALIZING (not searchable/writable) and
+            # graduate per-owner as their replays succeed — a client must
+            # never see a half-replayed shard as active, and a concurrent
+            # write racing the replay's external-version replay is
+            # impossible because no primary exists yet
+            res = self._on_create({"name": target, "body": body,
+                                   "pending": True})
+            desired = res["assignment"]
+            aliases = imeta.get("aliases", {})
+            if aliases:
+                # aliases ride the published metadata so EVERY process
+                # (owners and pure coordinators) can resolve them; the
+                # master applies its local copy here, peers in
+                # _adopt_indices on the next publish
+                with self.cluster._indices_lock:
+                    self.cluster.dist_indices[target]["aliases"] = aliases
+                self.node.indices[target].aliases.update(aliases)
+            by_owner: Dict[str, List[int]] = {}
+            for sid in range(num_shards):
+                for owner in desired[str(sid)]:
+                    by_owner.setdefault(owner, []).append(sid)
+            ok: Dict[int, set] = {sid: set() for sid in range(num_shards)}
+            for owner, sids in sorted(by_owner.items()):
+                sp = {"location": payload["location"],
+                      "repo_name": repo.name, "snapshot": snap,
+                      "src": iname, "target": target, "shards": sids,
+                      "aliases": aliases, "body": res["local_body"]}
+                try:
+                    if owner == self._local_id():
+                        self._on_restore_shards(sp)
+                    else:
+                        self._send(owner, ACTION_RESTORE_SHARDS, sp,
+                                   timeout=300.0)
+                    for sid in sids:
+                        ok[sid].add(owner)
+                except Exception:
+                    pass  # copy stays out of the active assignment
+            with self.cluster._indices_lock:
+                meta = self.cluster.dist_indices[target]
+                init = meta.setdefault("initializing", {})
+                for sid in range(num_shards):
+                    live = [o for o in desired[str(sid)] if o in ok[sid]]
+                    meta["assignment"][str(sid)] = live
+                    init[str(sid)] = []
+                    if not live or imeta["shards"][sid].get("failed"):
+                        # every copy's replay failed, or the shard's blobs
+                        # were missing from a PARTIAL manifest (it came
+                        # back active but EMPTY): a failed restore shard,
+                        # same accounting as the single-node path
+                        failed += 1
+            self.cluster.publish_indices()
+            restored.append(target)
+        from elasticsearch_tpu.index.snapshots import apply_global_state
+
+        apply_global_state(self.node, manifest, indices)
+        return {"snapshot": {"snapshot": snap, "indices": restored,
+                             "shards": {"total": total, "failed": failed,
+                                        "successful": total - failed}}}
+
+    def _on_restore_shards(self, payload: dict) -> dict:
+        """Restore target: replay the assigned shards' blobs from the
+        shared repository into the local index copy. The index may not
+        exist locally yet when this races the metadata publish."""
+        from elasticsearch_tpu.index.snapshots import (FsRepository,
+                                                       replay_shard)
+
+        index = payload["target"]
+        with self.cluster._indices_lock:
+            if not self.node.index_exists(index):
+                self.node.create_index(index, payload.get("body"))
+        svc = self.node.indices[index]
+        repo = FsRepository(payload.get("repo_name") or "_snapshot",
+                            payload["location"])
+        imeta = repo.get_manifest(payload["snapshot"])["indices"][
+            payload["src"]]
+        for sid in payload["shards"]:
+            replay_shard(svc, repo, imeta, sid)
+        svc.aliases.update(payload.get("aliases") or {})
+        svc.refresh()
+        return {"ok": True, "shards": payload["shards"]}
+
     # -- routed writes / reads ----------------------------------------------
 
     def index_doc(self, index: str, doc_id: Optional[str], source: dict,
                   routing: Optional[str] = None, **kw) -> dict:
+        index = self.resolve_index(index)
         meta = self._meta(index)
         if doc_id is None:
             doc_id = uuid.uuid4().hex  # route on the final id, as the owner will
@@ -302,6 +571,7 @@ class DistributedDataService:
 
     def delete_doc(self, index: str, doc_id: str,
                    routing: Optional[str] = None, **kw) -> dict:
+        index = self.resolve_index(index)
         meta = self._meta(index)
         sid = shard_id_for(doc_id, meta["num_shards"], routing)
         owner = self.owner_of(index, sid)
@@ -318,6 +588,7 @@ class DistributedDataService:
         must read the current source there), which then fans the resulting
         full doc out through the normal replica hop (reference:
         TransportUpdateAction resolving to an index op on the primary)."""
+        index = self.resolve_index(index)
         meta = self._meta(index)
         sid = shard_id_for(doc_id, meta["num_shards"], routing)
         owner = self.owner_of(index, sid)
@@ -382,6 +653,7 @@ class DistributedDataService:
 
     def get_doc(self, index: str, doc_id: str,
                 routing: Optional[str] = None) -> dict:
+        index = self.resolve_index(index)
         meta = self._meta(index)
         owner = self.owner_of(
             index, shard_id_for(doc_id, meta["num_shards"], routing))
@@ -639,6 +911,7 @@ class DistributedDataService:
 
         body = body or {}
         t0 = time.perf_counter()
+        index = self.resolve_index(index)
         meta = self._meta(index)
         local_id = self._local_id()
         by_owner: Dict[str, List[int]] = {}

@@ -180,9 +180,80 @@ def _segment_payload(seg) -> dict:
     return payload
 
 
+def snapshot_shard(repo: FsRepository, shard) -> dict:
+    """Write one shard's frozen segments to the repository; return the
+    manifest entry ({blobs, versions}). Shared by the single-node snapshot
+    loop and the multi-host per-owner snapshot action (the reference's
+    SnapshotShardsService.snapshot(shard) — data nodes write their own
+    shard blobs, the master only assembles the manifest).
+
+    The segment list and versions map are captured under the engine lock
+    (concurrent primary/replica writes mutate _locations mid-iteration
+    otherwise — same guard _on_shard_sync takes); blob serialization runs
+    outside it so writes aren't blocked for the IO."""
+    engine = shard.engine
+    with engine._lock:
+        segs = list(shard.segments)
+        versions = {doc_id: loc.version
+                    for doc_id, loc in engine._locations.items()
+                    if not loc.deleted}
+    blobs = [repo.put_blob(_segment_payload(seg)) for seg in segs]
+    return {"blobs": blobs, "versions": versions}
+
+
+def replay_shard(svc, repo: FsRepository, imeta: dict,
+                 shard_index: int) -> None:
+    """Replay one manifest shard's doc blobs into an existing index
+    service through the ordinary write path (external versioning keeps
+    the replay idempotent). Shared by single-node restore and the
+    multi-host per-owner restore action."""
+    shard_meta = imeta["shards"][shard_index]
+    versions = shard_meta.get("versions", {})
+    for sha in shard_meta["blobs"]:
+        payload = repo.get_blob(sha)
+        for entry in payload.get("ivf", []):
+            from elasticsearch_tpu.index import ivf_cache
+
+            ivf_cache.seed(entry["key"], base64.b64decode(entry["blob"]))
+        for doc in payload["docs"]:
+            meta = doc.get("meta", {})
+            svc.index_doc(
+                doc["id"], doc["source"],
+                routing=meta.get("routing") or meta.get("_parent"),
+                doc_type=meta.get("_type"),
+                parent=meta.get("_parent"),
+                version=versions.get(doc["id"]),
+                version_type="external",
+            )
+
+
+def _local_shards_meta(repo: FsRepository, svc) -> dict:
+    """Default per-index shard writer: refresh, then snapshot every local
+    shard. A shard whose blob write fails is recorded as a failed shard
+    (the snapshot goes PARTIAL) instead of aborting the manifest and
+    orphaning already-written blobs."""
+    svc.refresh()
+    out: List[dict] = []
+    failed = 0
+    for shard in svc.shards:
+        try:
+            out.append(snapshot_shard(repo, shard))
+        except Exception:
+            failed += 1
+            out.append({"blobs": [], "versions": {}, "failed": True})
+    return {"shards": out, "failed": failed}
+
+
 def create_snapshot(node, repo: FsRepository, snap_name: str,
                     indices: Optional[List[str]] = None,
-                    include_global_state: bool = True) -> dict:
+                    include_global_state: bool = True,
+                    shards_fn=None) -> dict:
+    """Assemble and write a snapshot manifest. `shards_fn(iname, svc)`
+    produces the per-index shard entries ({"shards": [...], "failed": N,
+    "settings": optional override}); the default writes every local shard.
+    The multi-host path passes a writer that fans shard blobs out to their
+    owner processes (cluster/search_action.py) — the manifest assembly,
+    failure accounting, and response envelope stay here, shared."""
     if snap_name in repo.catalog():
         raise SnapshotException(
             f"snapshot [{repo.name}:{snap_name}] already exists")
@@ -197,53 +268,56 @@ def create_snapshot(node, repo: FsRepository, snap_name: str,
         "start_time_ms": int(time.time() * 1000),
         "indices": {},
     }
+    total = failed = 0
     for iname in names:
         svc = node.indices.get(iname)
         if svc is None:
             raise SnapshotException(f"index [{iname}] not found")
-        # freeze the buffer so the snapshot is a refresh-consistent view
-        svc.refresh()
-        shards_meta = []
-        for shard in svc.shards:
-            blobs = []
-            versions: Dict[str, int] = {}
-            for seg in shard.segments:
-                blobs.append(repo.put_blob(_segment_payload(seg)))
-            for doc_id, loc in shard.engine._locations.items():
-                if not loc.deleted:
-                    versions[doc_id] = loc.version
-            shards_meta.append({"blobs": blobs, "versions": versions})
+        entry = (shards_fn(iname, svc) if shards_fn
+                 else _local_shards_meta(repo, svc))
+        total += len(entry["shards"])
+        failed += entry.get("failed", 0)
         manifest["indices"][iname] = {
-            "settings": svc.settings,
+            "settings": entry.get("settings") or svc.settings,
             "mappings": svc.mappings.to_json(),
             "aliases": svc.aliases,
-            "shards": shards_meta,
+            "shards": entry["shards"],
         }
     if include_global_state:
         manifest["global_state"] = {
             "templates": dict(node.cluster_state.templates),
             "search_templates": dict(getattr(node, "search_templates", {})),
         }
+    if failed:
+        manifest["state"] = "PARTIAL"
     manifest["end_time_ms"] = int(time.time() * 1000)
     repo.put_manifest(snap_name, manifest)
     return {"snapshot": {
-        "snapshot": snap_name, "state": "SUCCESS",
+        "snapshot": snap_name, "state": manifest["state"],
         "indices": list(manifest["indices"]),
-        "shards": {"total": sum(len(i["shards"]) for i in manifest["indices"].values()),
-                   "failed": 0,
-                   "successful": sum(len(i["shards"]) for i in manifest["indices"].values())},
+        "shards": {"total": total, "failed": failed,
+                   "successful": total - failed},
     }}
 
 
-def restore_snapshot(node, repo: FsRepository, snap_name: str,
-                     indices: Optional[List[str]] = None,
-                     rename_pattern: Optional[str] = None,
-                     rename_replacement: Optional[str] = None) -> dict:
+def select_restore_targets(node, manifest: dict,
+                           indices: Optional[List[str]],
+                           rename_pattern: Optional[str],
+                           rename_replacement: Optional[str],
+                           partial: bool,
+                           exists=None) -> List[tuple]:
+    """Resolve + validate every (source, target, imeta) BEFORE any index is
+    touched: name collisions (including two manifest indices renaming onto
+    one target) and un-opted-into PARTIAL shards must fail the whole
+    restore up front, never mid-loop with earlier indices already restored.
+    Shared by single-node restore and the multi-host master
+    (cluster/search_action.py). `exists` widens the collision check (the
+    multi-host master also checks dist_indices)."""
     import fnmatch as _fn
     import re as _re
 
-    manifest = repo.get_manifest(snap_name)
-    restored = []
+    selected: List[tuple] = []
+    seen_targets: set = set()
     for iname, imeta in manifest["indices"].items():
         # patterns match against MANIFEST names (the indices being restored
         # don't exist on the node, so node-side resolution can't apply)
@@ -252,44 +326,67 @@ def restore_snapshot(node, repo: FsRepository, snap_name: str,
         target = iname
         if rename_pattern and rename_replacement is not None:
             target = _re.sub(rename_pattern, rename_replacement, iname)
-        if target in node.indices:
+        if target in node.indices or (exists and exists(target)):
             raise SnapshotException(
                 f"cannot restore index [{target}]: an open index with that "
                 f"name already exists (close or delete it first)")
+        if target in seen_targets:
+            raise SnapshotException(
+                f"cannot restore: rename pattern maps two snapshot indices "
+                f"onto the same target [{target}]")
+        seen_targets.add(target)
+        if any(sh.get("failed") for sh in imeta["shards"]) and not partial:
+            raise SnapshotException(
+                f"cannot restore index [{iname}]: the snapshot contains "
+                f"failed shards (pass partial=true to restore the "
+                f"available shards; missing ones come back empty)")
+        selected.append((iname, target, imeta))
+    return selected
+
+
+def restore_snapshot(node, repo: FsRepository, snap_name: str,
+                     indices: Optional[List[str]] = None,
+                     rename_pattern: Optional[str] = None,
+                     rename_replacement: Optional[str] = None,
+                     partial: bool = False) -> dict:
+    manifest = repo.get_manifest(snap_name)
+    selected = select_restore_targets(node, manifest, indices,
+                                      rename_pattern, rename_replacement,
+                                      partial)
+    restored = []
+    total = failed = 0
+    for iname, target, imeta in selected:
         node.create_index(target, {
             "settings": imeta["settings"],
             "mappings": imeta["mappings"],
         })
         svc = node.indices[target]
         svc.aliases.update(imeta.get("aliases", {}))
-        for shard_meta in imeta["shards"]:
-            versions = shard_meta.get("versions", {})
-            for sha in shard_meta["blobs"]:
-                payload = repo.get_blob(sha)
-                for entry in payload.get("ivf", []):
-                    from elasticsearch_tpu.index import ivf_cache
-
-                    ivf_cache.seed(entry["key"],
-                                   base64.b64decode(entry["blob"]))
-                for doc in payload["docs"]:
-                    meta = doc.get("meta", {})
-                    svc.index_doc(
-                        doc["id"], doc["source"],
-                        routing=meta.get("routing") or meta.get("_parent"),
-                        doc_type=meta.get("_type"),
-                        parent=meta.get("_parent"),
-                        version=versions.get(doc["id"]),
-                        version_type="external",
-                    )
+        for i, sh in enumerate(imeta["shards"]):
+            total += 1
+            if sh.get("failed"):
+                failed += 1  # restores empty under partial=true
+                continue
+            replay_shard(svc, repo, imeta, i)
         svc.refresh()
         restored.append(target)
+    apply_global_state(node, manifest, indices)
+    return {"snapshot": {"snapshot": snap_name, "indices": restored,
+                         "shards": {"total": total, "failed": failed,
+                                    "successful": total - failed}}}
+
+
+def apply_global_state(node, manifest: dict,
+                       indices: Optional[List[str]]) -> None:
+    """Restore the manifest's global cluster state (index + search
+    templates) — only on a full restore, never an index-scoped one.
+    Shared by single-node restore and the multi-host master."""
     if "global_state" in manifest and not indices:
-        node.cluster_state.templates.update(manifest["global_state"].get("templates", {}))
+        node.cluster_state.templates.update(
+            manifest["global_state"].get("templates", {}))
         if hasattr(node, "search_templates"):
             node.search_templates.update(
                 manifest["global_state"].get("search_templates", {}))
-    return {"snapshot": {"snapshot": snap_name, "indices": restored,
-                         "shards": {"failed": 0}}}
 
 
 def snapshot_info(repo: FsRepository, snap_name: str) -> dict:

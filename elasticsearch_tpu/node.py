@@ -361,9 +361,11 @@ class Node:
     def search(self, index: Optional[str], body: dict,
                preference: Optional[str] = None) -> dict:
         mh = getattr(self, "multihost", None)
-        if mh is not None and index in mh.dist_indices:
-            # a distributed index scatters cross-host; multi-index
-            # expressions mixing local + distributed stay local-scoped
+        if mh is not None and index is not None \
+                and mh.data.resolve_index(index) in mh.dist_indices:
+            # a distributed index (by name or alias) scatters cross-host;
+            # multi-index expressions mixing local + distributed stay
+            # local-scoped
             return mh.data.search(index, body or {})
         names = self.resolve_indices(index)
         if not names and index not in (None, "", "_all", "*"):

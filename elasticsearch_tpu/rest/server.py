@@ -712,8 +712,17 @@ def _put_snapshot(n: Node, p, b, repo: str, snap: str):
         indices = [i for part in indices.split(",") if (i := part.strip())]
     if indices:
         indices = [name for pat in indices for name in n.resolve_indices(pat)]
+    r = _repo_or_404(n, repo)
+    c = _mh(n)
+    if c is not None:
+        # multi-host: each shard's owner writes its own blobs into the
+        # shared repository; the master assembles the manifest
+        return 200, c.data.create_snapshot(
+            r.location, snap, indices=indices,
+            include_global_state=body.get("include_global_state", True),
+            repo_name=repo)
     return 200, create_snapshot(
-        n, _repo_or_404(n, repo), snap, indices=indices,
+        n, r, snap, indices=indices,
         include_global_state=body.get("include_global_state", True))
 
 
@@ -738,10 +747,22 @@ def _restore_snapshot(n: Node, p, b, repo: str, snap: str):
     indices = body.get("indices")
     if isinstance(indices, str):
         indices = [i for part in indices.split(",") if (i := part.strip())]
+    r = _repo_or_404(n, repo)
+    c = _mh(n)
+    if c is not None:
+        # multi-host: the master computes a fresh cross-host shard
+        # assignment, then every assigned copy replays from the repo
+        return 200, c.data.restore_snapshot(
+            r.location, snap, indices=indices,
+            rename_pattern=body.get("rename_pattern"),
+            rename_replacement=body.get("rename_replacement"),
+            partial=bool(body.get("partial", False)),
+            repo_name=repo)
     return 200, restore_snapshot(
-        n, _repo_or_404(n, repo), snap, indices=indices,
+        n, r, snap, indices=indices,
         rename_pattern=body.get("rename_pattern"),
-        rename_replacement=body.get("rename_replacement"))
+        rename_replacement=body.get("rename_replacement"),
+        partial=bool(body.get("partial", False)))
 
 
 # -- admin helpers -----------------------------------------------------------
@@ -1497,9 +1518,12 @@ def _mh(n: Node):
 
 
 def _mh_for(n: Node, index: Optional[str]):
-    """The data service IF `index` names a distributed index."""
+    """The data service IF `index` names (or aliases) a distributed
+    index — an alias-named request must ride the cross-host data plane,
+    not fall to the node-local path with only local shards."""
     c = _mh(n)
-    if c is not None and index in c.dist_indices:
+    if c is not None and index is not None \
+            and c.data.resolve_index(index) in c.dist_indices:
         return c.data
     return None
 
