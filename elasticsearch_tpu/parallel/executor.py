@@ -39,6 +39,39 @@ def _jax():
     return jax
 
 
+def _collectives(mesh):
+    """(psum, all_gather, wrap, sl) for this mesh.
+
+    A single-slot mesh compiles the body as a PLAIN jit program over
+    PRE-SQUEEZED arrays (no leading shard dim): slicing the [1, ...]
+    shard dim inside the program wraps the downstream dot_general in a
+    loop fusion, which XLA:CPU executes as naive scalar loops instead of
+    the GEMM kernel — the identical matvec+top-k body measures ~30x
+    slower that way — and a 1-chip mesh (the single-TPU serving case)
+    needs no collectives at all. `sl` is the per-shard local-view
+    accessor bodies use in place of `a[0]`; output shapes are identical
+    between the two paths.
+    """
+    jax = _jax()
+    from jax import lax
+
+    from elasticsearch_tpu.parallel.mesh import get_shard_map, mesh_size
+
+    if mesh_size(mesh) == 1:
+        psum = lambda x, _axis: x
+        all_gather = lambda x, _axis: x[None]
+        wrap = lambda body, in_specs, out_specs: jax.jit(body)
+        sl = lambda a: a  # host already dropped the shard dim
+        return psum, all_gather, wrap, sl
+    shard_map = get_shard_map()
+
+    def wrap(body, in_specs, out_specs):
+        return jax.jit(shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=False))
+
+    return lax.psum, lax.all_gather, wrap, (lambda a: a[0])
+
+
 # ---------------------------------------------------------------------------
 # compiled programs
 # ---------------------------------------------------------------------------
@@ -62,22 +95,24 @@ def _bm25_program(mesh, cache, *, Q: int, T: int, P: int, D: int, k: int):
     jax = _jax()
     import jax.numpy as jnp
     from jax import lax
-    from elasticsearch_tpu.parallel.mesh import get_shard_map as _gsm; shard_map = _gsm()
     from jax.sharding import PartitionSpec as PS
 
     from elasticsearch_tpu.ops.scoring import bm25_score_segment
 
+    psum, all_gather, wrap, sl = _collectives(mesh)
+
     def body(doc_ids, tfnorm, starts, lens, weights, live):
-        # local slices carry a leading shard dim of 1
+        # sl: local shard view ([1, ...]-sliced under shard_map; identity
+        # on a pre-squeezed single-slot mesh)
         score1 = lambda s, l, w: bm25_score_segment(
-            doc_ids[0], tfnorm[0], s, l, w, P=P, D=D)
-        scores = jax.vmap(score1)(starts[0], lens[0], weights[0])  # [Q, D]
-        masked = jnp.where(live[0][None, :], scores, -jnp.inf)
+            sl(doc_ids), sl(tfnorm), s, l, w, P=P, D=D)
+        scores = jax.vmap(score1)(sl(starts), sl(lens), sl(weights))  # [Q, D]
+        masked = jnp.where(sl(live)[None, :], scores, -jnp.inf)
         hit = masked > 0.0
-        totals = lax.psum(jnp.sum(hit.astype(jnp.int32), axis=1), "shard")
+        totals = psum(jnp.sum(hit.astype(jnp.int32), axis=1), "shard")
         vals, idx = lax.top_k(masked, k)  # [Q, k] local
-        av = lax.all_gather(vals, "shard")  # [S, Q, k]
-        ai = lax.all_gather(idx, "shard")
+        av = all_gather(vals, "shard")  # [S, Q, k]
+        ai = all_gather(idx, "shard")
         S = av.shape[0]
         flat = jnp.transpose(av, (1, 0, 2)).reshape(Q, S * k)
         gvals, gpos = lax.top_k(flat, k)  # [Q, k]
@@ -87,13 +122,7 @@ def _bm25_program(mesh, cache, *, Q: int, T: int, P: int, D: int, k: int):
         return gvals, gshard, glocal, totals
 
     sh = PS("shard")
-    fn = shard_map(
-        body, mesh=mesh,
-        in_specs=(sh, sh, sh, sh, sh, sh),
-        out_specs=(PS(), PS(), PS(), PS()),
-        check_rep=False,
-    )
-    fn = jax.jit(fn)
+    fn = wrap(body, (sh, sh, sh, sh, sh, sh), (PS(), PS(), PS(), PS()))
     cache[key] = fn
     return fn
 
@@ -109,14 +138,14 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
     key = ("knn", Q, dims, D, k, metric)
     if key in cache:
         return cache[key]
-    jax = _jax()
     import jax.numpy as jnp
     from jax import lax
-    from elasticsearch_tpu.parallel.mesh import get_shard_map as _gsm; shard_map = _gsm()
     from jax.sharding import PartitionSpec as PS
 
     from elasticsearch_tpu.ops.knn import exact_rescore_topk
     from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
+
+    psum, all_gather, wrap, sl = _collectives(mesh)
 
     def body(queries, vecs, live):
         # per-shard fused scores+mask+topk: the Pallas streaming kernel on
@@ -126,13 +155,13 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
         # an f32 re-rank of the candidates cut back to k — FAISS-style
         # two-stage refinement, so merged results keep exact recall.
         kp = min(max(4 * k, k), D)
-        vals, idx = knn_topk_auto(queries, vecs[0], live[0], k=kp,
+        vals, idx = knn_topk_auto(queries, sl(vecs), sl(live), k=kp,
                                   metric=metric)
-        vals, idx = exact_rescore_topk(queries, vecs[0], vals, idx,
+        vals, idx = exact_rescore_topk(queries, sl(vecs), vals, idx,
                                        metric=metric)
         vals, idx = vals[:, :k], idx[:, :k]
-        av = lax.all_gather(vals, "shard")
-        ai = lax.all_gather(idx, "shard")
+        av = all_gather(vals, "shard")
+        ai = all_gather(idx, "shard")
         S = av.shape[0]
         flat = jnp.transpose(av, (1, 0, 2)).reshape(Q, S * k)
         gvals, gpos = lax.top_k(flat, k)
@@ -141,13 +170,7 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
         glocal = jnp.take_along_axis(flat_idx, gpos, axis=1).astype(jnp.int32)
         return gvals, gshard, glocal
 
-    fn = shard_map(
-        body, mesh=mesh,
-        in_specs=(PS(), PS("shard"), PS("shard")),
-        out_specs=(PS(), PS(), PS()),
-        check_rep=False,
-    )
-    fn = jax.jit(fn)
+    fn = wrap(body, (PS(), PS("shard"), PS("shard")), (PS(), PS(), PS()))
     cache[key] = fn
     return fn
 
@@ -156,26 +179,24 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
     """Build the shard_map program for one compiled DSL structure: emit-tree
     score/mask → local top-k → all_gather + global top-k, exact totals via
     psum, per-shard terms-agg count vectors."""
-    jax = _jax()
     import jax.numpy as jnp
     from jax import lax
-    from elasticsearch_tpu.parallel.mesh import get_shard_map as _gsm
-    shard_map = _gsm()
     from jax.sharding import PartitionSpec as PS
 
     meta = {i: s for i, s in enumerate(statics)}
     n_aggs = len(compiled.agg_prims)
+    psum, all_gather, wrap, sl = _collectives(mesh)
 
     def body(*flat):
         env = {}
         pos = 0
         for i, c in enumerate(counts):
-            env[i] = tuple(a[0] for a in flat[pos: pos + c])
+            env[i] = tuple(sl(a) for a in flat[pos: pos + c])
             pos += c
         scores, mask = compiled.root.sm(env, meta)
         live = env[compiled.live][0]
         mask = mask & live
-        totals = lax.psum(jnp.sum(mask.astype(jnp.int32)), "shard")
+        totals = psum(jnp.sum(mask.astype(jnp.int32)), "shard")
         if compiled.sort_prim is not None:
             desc, miss_first = compiled.sort_cfg
             values, exists = env[compiled.sort_prim]
@@ -188,8 +209,8 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
             rank = scores
         masked = jnp.where(mask, rank, -jnp.inf)
         vals, idx = lax.top_k(masked, k)
-        av = lax.all_gather(vals, "shard")  # [S, k]
-        ai = lax.all_gather(idx, "shard")
+        av = all_gather(vals, "shard")  # [S, k]
+        ai = all_gather(idx, "shard")
         S = av.shape[0]
         # field-sorted queries keep EVERY per-shard candidate: the device
         # rank is a primary-key preselect only, and a global top-k by that
@@ -221,9 +242,7 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
     in_specs = tuple(PS("shard") for _ in range(n_in))
     out_specs = (PS(),) + tuple(
         PS("shard") for _ in range(n_aggs + (1 if compiled.want_mask else 0)))
-    fn = shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                   check_rep=False)
-    return jax.jit(fn)
+    return wrap(body, in_specs, out_specs)
 
 
 def _psum_program(mesh, cache, shape):
@@ -231,16 +250,14 @@ def _psum_program(mesh, cache, shape):
     key = ("psum", tuple(shape))
     if key in cache:
         return cache[key]
-    jax = _jax()
-    from jax import lax
-    from elasticsearch_tpu.parallel.mesh import get_shard_map as _gsm; shard_map = _gsm()
     from jax.sharding import PartitionSpec as PS
 
-    def body(x):
-        return lax.psum(x[0], "shard")
+    psum, _all_gather, wrap, sl = _collectives(mesh)
 
-    fn = jax.jit(shard_map(body, mesh=mesh, in_specs=(PS("shard"),),
-                           out_specs=PS(), check_rep=False))
+    def body(x):
+        return psum(sl(x), "shard")
+
+    fn = wrap(body, (PS("shard"),), PS())
     cache[key] = fn
     return fn
 
@@ -281,6 +298,19 @@ class MeshSearchExecutor:
         # (small) live mask is re-uploaded every call. LRU-bounded.
         self._data: "OrderedDict[Tuple, Any]" = OrderedDict()
 
+    def _put_sharded(self, a):
+        """Device-put a host array laid out [S, ...] for the mesh. On a
+        single-slot mesh the shard dim is dropped HERE, on host: slicing
+        it inside the program wraps downstream dots in loop fusions (see
+        _collectives). np indexing is a view — no host copy."""
+        jax = _jax()
+        if self.S == 1:
+            return jax.device_put(np.asarray(a)[0],
+                                  self.mesh.devices.flat[0])
+        from jax.sharding import NamedSharding, PartitionSpec as PS
+
+        return jax.device_put(a, NamedSharding(self.mesh, PS("shard")))
+
     def _cached_data(self, key, build, refs):
         """Cache device arrays keyed by segment ids. `refs` (the segments
         themselves) are stored alongside so a cached id() can never be
@@ -318,9 +348,6 @@ class MeshSearchExecutor:
         return self._rounds_for(self.shards)
 
     def _search_round(self, field, query_terms, row, k):
-        import jax.numpy as jnp
-        from jax.sharding import NamedSharding, PartitionSpec as PS
-        jax = _jax()
 
         seg_row = [e[2] if e is not None else None for e in row]
         lut_shard = np.asarray([e[0] if e is not None else -1 for e in row],
@@ -356,8 +383,7 @@ class MeshSearchExecutor:
             out[: len(a)] = a
             return out
 
-        sh = NamedSharding(self.mesh, PS("shard"))
-        put = lambda a: jax.device_put(a, sh)
+        put = self._put_sharded
 
         def build_postings():
             h_doc = np.full((self.S, nnz), D, np.int32)
@@ -406,7 +432,6 @@ class MeshSearchExecutor:
                    metric: str = "cosine"):
         """queries f32[Q, dims] → (vals, shard, local, round, totals=None)."""
         jax = _jax()
-        from jax.sharding import NamedSharding, PartitionSpec as PS
 
         Q, dims = queries.shape
         merged = None
@@ -418,7 +443,6 @@ class MeshSearchExecutor:
                 [e[1] if e is not None else 0 for e in row], np.int32)
             D = pow2_bucket(max((s.max_docs if s is not None else 1)
                                 for s in seg_row))
-            sh = NamedSharding(self.mesh, PS("shard"))
 
             def build_vecs():
                 h_vecs = np.zeros((self.S, D, dims), np.float32)
@@ -428,7 +452,7 @@ class MeshSearchExecutor:
                         v = (vc.vecs_host if vc.vecs_host is not None
                              else np.asarray(vc.vecs))
                         h_vecs[si, : v.shape[0]] = v
-                return jax.device_put(h_vecs, sh)
+                return self._put_sharded(h_vecs)
 
             data_key = ("knn", field, tuple(id(s) for s in seg_row), D, dims)
             d_vecs = self._cached_data(data_key, build_vecs, seg_row)
@@ -447,7 +471,7 @@ class MeshSearchExecutor:
                                 D=D, k=min(k, D), metric=metric)
             vals, slot, local = prog(
                 jax.device_put(np.asarray(queries, np.float32)),
-                d_vecs, jax.device_put(h_live, sh))
+                d_vecs, self._put_sharded(h_live))
             slot = np.asarray(slot)
             out = (np.asarray(vals), lut_shard[slot], np.asarray(local),
                    lut_ord[slot], None)
@@ -476,7 +500,6 @@ class MeshSearchExecutor:
         from elasticsearch_tpu.search.context import SegmentContext
 
         jax = _jax()
-        from jax.sharding import NamedSharding, PartitionSpec as PS
 
         shard_list = self.shards if shards is None else list(shards)
         rows = self._rounds_for(shard_list)
@@ -515,11 +538,9 @@ class MeshSearchExecutor:
 
             # build per-prim data + statics; cacheable groups are device-put
             # once and reused across queries (postings, columns)
-            sh = NamedSharding(self.mesh, PS("shard"))
-
             def cache_fn(key, fn):
                 return self._cached_data(
-                    key, lambda: [jax.device_put(a, sh) for a in fn()],
+                    key, lambda: [self._put_sharded(a) for a in fn()],
                     seg_row)
 
             arrays: List[Any] = []
@@ -538,7 +559,7 @@ class MeshSearchExecutor:
             if prog is None:
                 prog = _dsl_program(self.mesh, compiled, counts, statics, kk)
                 self._programs[prog_key] = prog
-            dev = [a if hasattr(a, "sharding") else jax.device_put(a, sh)
+            dev = [a if hasattr(a, "sharding") else self._put_sharded(a)
                    for a in arrays]
             # ONE host transfer for the packed result — per-array pulls
             # each pay a fixed device round-trip (the dominant per-query
@@ -623,12 +644,8 @@ class MeshSearchExecutor:
 
     def psum_partials(self, partials: np.ndarray):
         """partials [S, ...] per-shard numeric agg tensors → summed [...]."""
-        jax = _jax()
-        from jax.sharding import NamedSharding, PartitionSpec as PS
-
         prog = _psum_program(self.mesh, self._programs, partials.shape[1:])
-        sh = NamedSharding(self.mesh, PS("shard"))
-        return np.asarray(prog(jax.device_put(partials, sh)))
+        return np.asarray(prog(self._put_sharded(partials)))
 
 
 def _segments_of(s) -> list:
