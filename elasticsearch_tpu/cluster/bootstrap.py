@@ -175,11 +175,11 @@ class MultiHostCluster:
             for name, spec in meta.items():
                 if not self.node.index_exists(name):
                     self.node.create_index(name, spec.get("body"))
-                if spec.get("aliases") and name in self.node.indices:
-                    # restored aliases ride the metadata: apply on every
-                    # publish so coordinators that own no shard of the
-                    # index still resolve alias-named requests
-                    self.node.indices[name].aliases.update(spec["aliases"])
+                if "aliases" in spec and name in self.node.indices:
+                    # published aliases are authoritative cluster state:
+                    # REPLACE (not update) the local map so alias removals
+                    # propagate instead of being resurrected each publish
+                    self.node.indices[name].aliases = dict(spec["aliases"])
 
     def publish_indices(self) -> None:
         self._bump_indices_version()

@@ -54,6 +54,8 @@ ACTION_SNAPSHOT = "cluster:admin/snapshot/create"
 ACTION_SNAPSHOT_SHARD = "indices:admin/snapshot/shard"
 ACTION_RESTORE = "cluster:admin/snapshot/restore"
 ACTION_RESTORE_SHARDS = "indices:admin/snapshot/restore_shards"
+ACTION_ALIASES = "indices:admin/aliases"
+ACTION_APPLY_GLOBAL = "cluster:admin/apply_global_state"
 
 _CONTEXT_TTL = 120.0
 
@@ -88,6 +90,9 @@ class DistributedDataService:
         t.register(ACTION_SNAPSHOT_SHARD, self._on_snapshot_shard)
         t.register(ACTION_RESTORE, self._on_restore)
         t.register(ACTION_RESTORE_SHARDS, self._on_restore_shards)
+        t.register(ACTION_ALIASES,
+                   lambda p: self.node.update_aliases(p["actions"]))
+        t.register(ACTION_APPLY_GLOBAL, self._on_apply_global)
 
     # -- ownership -----------------------------------------------------------
 
@@ -203,8 +208,19 @@ class DistributedDataService:
         index = self.resolve_index(index)
         self._meta(index)
         self.node.indices[index].refresh()
+        errs = []
         for nid in self._other_nodes():
-            self._send(nid, ACTION_REFRESH, {"index": index})
+            try:
+                self._send(nid, ACTION_REFRESH, {"index": index})
+            except Exception as e:
+                # keep going: one dead peer must not leave LATER peers
+                # unrefreshed (a snapshot would then capture them stale
+                # while counting their shards successful)
+                errs.append(nid)
+                last = e
+        if errs:
+            raise TransportError(
+                f"refresh of [{index}] failed on {errs}: {last}")
 
     def _other_nodes(self) -> List[str]:
         me = self._local_id()
@@ -320,6 +336,9 @@ class DistributedDataService:
         repo = FsRepository(payload.get("repo_name") or "_snapshot",
                             payload["location"])
         svc = self.node.indices[payload["index"]]
+        # self-contained freshness: the coordinator's refresh fan-out may
+        # have failed for this peer without aborting the snapshot
+        svc.refresh()
         return [snapshot_shard(repo, svc.shards[sid])
                 for sid in payload["shards"]]
 
@@ -425,9 +444,31 @@ class DistributedDataService:
         from elasticsearch_tpu.index.snapshots import apply_global_state
 
         apply_global_state(self.node, manifest, indices)
-        return {"snapshot": {"snapshot": snap, "indices": restored,
+        global_failed: List[str] = []
+        if "global_state" in manifest and not indices:
+            # templates are node-local state the publish doesn't carry:
+            # fan the restored global state to every peer so a template
+            # lookup works on whichever coordinator the client hits. A
+            # failed peer is REPORTED (a transiently-unreachable peer
+            # would otherwise silently miss the templates forever)
+            gp = {"global_state": manifest["global_state"]}
+            for nid in self._other_nodes():
+                try:
+                    self._send(nid, ACTION_APPLY_GLOBAL, gp)
+                except Exception:
+                    global_failed.append(nid)
+        resp = {"snapshot": {"snapshot": snap, "indices": restored,
                              "shards": {"total": total, "failed": failed,
                                         "successful": total - failed}}}
+        if global_failed:
+            resp["snapshot"]["global_state_failed_nodes"] = global_failed
+        return resp
+
+    def _on_apply_global(self, payload: dict) -> dict:
+        from elasticsearch_tpu.index.snapshots import apply_global_state
+
+        apply_global_state(self.node, payload, None)
+        return {"ok": True}
 
     def _on_restore_shards(self, payload: dict) -> dict:
         """Restore target: replay the assigned shards' blobs from the

@@ -250,6 +250,32 @@ class Node:
         return out
 
     def update_aliases(self, actions: List[dict]) -> dict:
+        mh = getattr(self, "multihost", None)
+        if mh is not None and not mh.is_master:
+            # alias changes touching distributed indices are cluster state:
+            # the master owns them (they ride the published metadata, so a
+            # local-only change would be resurrected by the next publish).
+            # SPLIT the batch — only dist-touching actions forward; actions
+            # on node-local indices apply here (forwarding them whole
+            # would resolve against the master's indices and drop them)
+            def _dist(action: dict) -> bool:
+                return any(
+                    nm in mh.dist_indices
+                    for spec in action.values()
+                    for nm in (self.resolve_indices(
+                        spec.get("index", spec.get("indices"))) or []))
+
+            fwd = [a for a in actions if _dist(a)]
+            if fwd:
+                from elasticsearch_tpu.cluster.search_action import \
+                    ACTION_ALIASES
+
+                mh.transport.send_remote(
+                    mh.master_addr, ACTION_ALIASES, {"actions": fwd})
+                actions = [a for a in actions if not _dist(a)]
+                if not actions:
+                    return {"acknowledged": True}
+        touched: List[str] = []
         for action in actions:
             for op, spec in action.items():
                 idx_names = self.resolve_indices(spec.get("index", spec.get("indices")))
@@ -269,6 +295,19 @@ class Node:
                     elif op == "remove":
                         self.indices[n].aliases.pop(alias, None)
                     self._persist_index_meta(n)
+                    touched.append(n)
+        if mh is not None and mh.is_master:
+            # master: fold the new alias maps into the published dist
+            # metadata (authoritative once present — _adopt_indices
+            # REPLACES peers' local maps with it, so removals propagate
+            # instead of being resurrected by the next publish)
+            dist_touched = [n for n in touched if n in mh.dist_indices]
+            if dist_touched:
+                with mh._indices_lock:
+                    for n in dist_touched:
+                        mh.dist_indices[n]["aliases"] = dict(
+                            self.indices[n].aliases)
+                mh.publish_indices()
         return {"acknowledged": True}
 
     def put_template(self, name: str, body: dict,
@@ -361,12 +400,14 @@ class Node:
     def search(self, index: Optional[str], body: dict,
                preference: Optional[str] = None) -> dict:
         mh = getattr(self, "multihost", None)
-        if mh is not None and index is not None \
-                and mh.data.resolve_index(index) in mh.dist_indices:
-            # a distributed index (by name or alias) scatters cross-host;
-            # multi-index expressions mixing local + distributed stay
-            # local-scoped
-            return mh.data.search(index, body or {})
+        if mh is not None and index is not None:
+            rname = mh.data.resolve_index(index)
+            if rname in mh.dist_indices:
+                # a distributed index (by name or alias) scatters
+                # cross-host; multi-index expressions mixing local +
+                # distributed stay local-scoped. Pass the RESOLVED name so
+                # the data plane doesn't re-resolve.
+                return mh.data.search(rname, body or {})
         names = self.resolve_indices(index)
         if not names and index not in (None, "", "_all", "*"):
             raise IndexNotFoundException(str(index))

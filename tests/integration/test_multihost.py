@@ -459,6 +459,15 @@ def test_snapshot_restore_across_hosts(master, tmp_path):
                                    "size": 30})
         assert via_alias["hits"]["total"] == 15
         assert via_alias["_shards"]["failed"] == 0
+        # alias REMOVAL must propagate through the published metadata too
+        # (a local-only delete would be resurrected by the next publish)
+        node.update_aliases([{"remove": {"index": "snap_dst",
+                                         "alias": "snap_alias"}}])
+        assert c.dist_indices["snap_dst"]["aliases"] == {}
+        from elasticsearch_tpu.utils.errors import IndexNotFoundException
+
+        with pytest.raises(IndexNotFoundException):
+            c.data.search("snap_alias", {"query": {"match_all": {}}})
         for i in ("0", "13", "29"):
             g = c.data.get_doc("snap_dst", i)
             assert g["found"] and g["_source"] == docs[i], g
