@@ -137,7 +137,21 @@ def emit_record(payload: dict) -> None:
 
 def build_corpus(n_docs: int, vocab: int, seed: int):
     """Term-major postings CSR for a Zipfian synthetic corpus
-    (MS-MARCO-like: ~60-token passages, Zipf vocabulary)."""
+    (MS-MARCO-like: ~60-token passages, Zipf vocabulary). Deterministic in
+    (n_docs, vocab, seed), so the ~2-minute build at the 1M default is
+    disk-cached; a cache failure falls through to a fresh build."""
+    # the version token guards the cache against generator/constant
+    # changes (a K1/B or distribution tweak must not silently serve
+    # corpora built by older code)
+    ver = f"v1_k{K1}b{B}"
+    cache = os.path.join(os.path.expanduser("~"), ".cache", "estpu_bench",
+                         f"corpus_{ver}_{n_docs}_{vocab}_{seed}.npz")
+    try:
+        z = np.load(cache)
+        return (z["u_doc"], z["tf"], z["tfn"], z["offsets"], z["df"],
+                z["idf"], z["doc_len"])
+    except Exception:
+        pass
     rng = np.random.default_rng(seed)
     doc_len = np.clip(rng.normal(60, 15, n_docs), 20, 120).astype(np.int64)
     nnz_tok = int(doc_len.sum())
@@ -157,7 +171,16 @@ def build_corpus(n_docs: int, vocab: int, seed: int):
     tfn = (tf * (K1 + 1) / (tf + K1 * (1 - B + B * doc_len[u_doc] / avg))
            ).astype(np.float32)
     idf = np.log(1 + (n_docs - df + 0.5) / (df + 0.5)).astype(np.float32)
-    return u_doc, tf.astype(np.float32), tfn, offsets, df, idf, doc_len
+    tf = tf.astype(np.float32)
+    try:
+        os.makedirs(os.path.dirname(cache), exist_ok=True)
+        tmp = cache + f".{os.getpid()}.tmp.npz"  # savez keeps .npz names
+        np.savez(tmp, u_doc=u_doc, tf=tf, tfn=tfn, offsets=offsets, df=df,
+                 idf=idf, doc_len=doc_len)
+        os.replace(tmp, cache)
+    except Exception:
+        pass  # cache is best-effort
+    return u_doc, tf, tfn, offsets, df, idf, doc_len
 
 
 def make_msmarco_node(u_doc, tf, tfn, offsets, df, doc_len, n_docs, vocab):

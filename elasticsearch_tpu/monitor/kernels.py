@@ -24,6 +24,8 @@ Names:
                       (index/ivf_cache.py) instead of rebuilt
   mesh_search         request served by the mesh product path
   mesh_fallback_total request fell back to the host per-shard loop
+  mesh_host_by_design request routed to the host loop ON PURPOSE (IVF
+                      probing) — not a fallback, excluded from the budget
   span_clause_truncated  a deeply-nested span clause exceeded
                       MAX_SPANS_PER_CLAUSE on the host walk (search/spans)
 """

@@ -255,24 +255,29 @@ class Node:
             # alias changes touching distributed indices are cluster state:
             # the master owns them (they ride the published metadata, so a
             # local-only change would be resurrected by the next publish).
-            # SPLIT the batch — only dist-touching actions forward; actions
-            # on node-local indices apply here (forwarding them whole
-            # would resolve against the master's indices and drop them)
-            def _dist(action: dict) -> bool:
-                return any(
-                    nm in mh.dist_indices
-                    for spec in action.values()
+            # SPLIT the batch at the per-INDEX level: expressions resolve
+            # HERE (the master's index set differs), each resolved name
+            # becomes an explicit single-index action, and only the
+            # dist-index ones forward — so a wildcard spanning a local
+            # and a distributed index updates both
+            fwd: List[dict] = []
+            local: List[dict] = []
+            for action in actions:
+                for op, spec in action.items():
                     for nm in (self.resolve_indices(
-                        spec.get("index", spec.get("indices"))) or []))
-
-            fwd = [a for a in actions if _dist(a)]
+                            spec.get("index", spec.get("indices"))) or []):
+                        single = {k: v for k, v in spec.items()
+                                  if k not in ("index", "indices")}
+                        single["index"] = nm
+                        (fwd if nm in mh.dist_indices
+                         else local).append({op: single})
             if fwd:
                 from elasticsearch_tpu.cluster.search_action import \
                     ACTION_ALIASES
 
                 mh.transport.send_remote(
                     mh.master_addr, ACTION_ALIASES, {"actions": fwd})
-                actions = [a for a in actions if not _dist(a)]
+                actions = local
                 if not actions:
                     return {"acknowledged": True}
         touched: List[str] = []
@@ -546,6 +551,7 @@ class Node:
         # without digging through the kernels map
         search["mesh_fallback_total"] = snap.get("mesh_fallback_total", 0)
         search["span_clause_truncated"] = snap.get("span_clause_truncated", 0)
+        search["mesh_host_by_design"] = snap.get("mesh_host_by_design", 0)
         proc = process_stats()
         return {
             "cluster_name": self.cluster_state.cluster_name,

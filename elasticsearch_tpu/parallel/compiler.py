@@ -39,7 +39,15 @@ from elasticsearch_tpu.utils.shapes import pow2_bucket
 
 
 class MeshCompileError(Exception):
-    """Query/feature not expressible as a mesh program — host-loop fallback."""
+    """Query can't ride the mesh program. `by_design=True` marks paths
+    that are INTENTIONALLY host-orchestrated (e.g. IVF probing) — the
+    dispatch counters report them as `mesh_host_by_design`, not
+    `mesh_fallback_total`, so the fallback==0 budget on product workloads
+    keeps meaning 'should have ridden the mesh but could not'."""
+
+    def __init__(self, msg: str, by_design: bool = False):
+        super().__init__(msg)
+        self.by_design = by_design
 
 
 def _jnp():
@@ -1468,7 +1476,9 @@ class MeshQueryCompiler:
             fm is not None and bool(getattr(fm, "index_options", None))
             and fm.index_options.get("type") in ("ivf", "ivf_flat"))
         if use_ann:
-            raise MeshCompileError("knn via IVF")  # host loop probes IVF
+            # host loop probes IVF: coarse-quantizer routing is a designed
+            # host-orchestrated pipeline, not a missing mesh feature
+            raise MeshCompileError("knn via IVF", by_design=True)
         dims = getattr(fm, "dims", None) if fm is not None else None
         if fm is None or not dims:
             return ENone(self.D)  # unmapped vector field: empty everywhere

@@ -28,11 +28,17 @@ _UNSUPPORTED_KEYS = ("rescore", "search_after", "min_score", "scroll",
                      "indices_boost")
 
 
+_BY_DESIGN = object()  # host path chosen on purpose (e.g. IVF probing)
+
+
 def try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[dict]:
     """Mesh-execute a search request; None → caller uses the host loop."""
     from elasticsearch_tpu.monitor import kernels
 
     resp = _try_mesh_search(svc, searchers, body, global_stats)
+    if resp is _BY_DESIGN:
+        kernels.record("mesh_host_by_design")
+        return None
     kernels.record("mesh_search" if resp is not None else "mesh_fallback_total")
     return resp
 
@@ -84,8 +90,8 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
             sort_spec=sort_spec or None, agg_specs=agg_specs or None,
             global_stats=global_stats, shards=shard_segs,
             want_mask=want_mask)
-    except MeshCompileError:
-        return None
+    except MeshCompileError as e:
+        return _BY_DESIGN if getattr(e, "by_design", False) else None
     q_ms = (time.perf_counter() - t0) * 1000
     for s in searchers:
         s.stats.on_query(q_ms / max(len(searchers), 1),

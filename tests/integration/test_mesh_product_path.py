@@ -103,6 +103,17 @@ def test_fallback_gauges_first_class_and_zero(node):
     assert search["span_clause_truncated"] == 0, search
     assert search["mesh_fallback_total"] <= 1, search
 
+    # IVF (ann) knn is a DESIGNED host-orchestrated pipeline: it must
+    # tick mesh_host_by_design, never the fallback gauge
+    before = search["mesh_fallback_total"]
+    r = node.search("m", {"query": {"knn": {
+        "field": "emb", "query_vector": [0.5] * 8, "k": 3,
+        "num_candidates": 16, "ann": True}}, "size": 3})
+    assert r["hits"]["hits"], r
+    search = node.nodes_stats()["nodes"][node.node_id]["indices"]["search"]
+    assert search["mesh_fallback_total"] == before, search
+    assert search.get("mesh_host_by_design", 0) >= 1, search
+
 
 QUERIES = [
     ("match_all", {"query": {"match_all": {}}, "size": 7}),

@@ -7,7 +7,8 @@ import time
 
 import numpy as np
 
-sys.argv = [sys.argv[0]]
+docs = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 18
+sys.argv = [sys.argv[0]]  # keep bench's module-level argparse inert
 sys.path.insert(0, "/root/repo")
 import bench
 
@@ -17,7 +18,6 @@ from elasticsearch_tpu.utils.platform import (enable_compilation_cache,
 ensure_cpu_if_requested()
 enable_compilation_cache()
 
-docs = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 18
 vocab = 30000
 u_doc, tf, tfn, offsets, df, idf, doc_len = bench.build_corpus(docs, vocab, 42)
 node, seg = bench.make_msmarco_node(u_doc, tf, tfn, offsets, df, doc_len,
