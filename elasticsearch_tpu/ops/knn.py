@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from elasticsearch_tpu.ops.scoring import topk_auto
+
 NEG_INF = jnp.float32(-jnp.inf)
 
 
@@ -54,12 +56,13 @@ def knn_scores(queries, vecs, *, metric: str = "cosine", use_bf16: bool = True):
     raise ValueError(f"unknown knn metric [{metric}]")
 
 
-@partial(jax.jit, static_argnames=("k", "metric", "use_bf16"))
-def knn_topk(queries, vecs, mask, *, k: int, metric: str = "cosine", use_bf16: bool = True):
+@partial(jax.jit, static_argnames=("k", "metric", "use_bf16", "topk_block"))
+def knn_topk(queries, vecs, mask, *, k: int, metric: str = "cosine",
+             use_bf16: bool = True, topk_block: int = 0):
     """Fused scores + masked top-k: ([Q, k] scores, [Q, k] doc ids)."""
     scores = knn_scores(queries, vecs, metric=metric, use_bf16=use_bf16)
     masked = jnp.where(mask[None, :], scores, NEG_INF)
-    vals, idx = lax.top_k(masked, k)
+    vals, idx = topk_auto(masked, k, topk_block)
     return vals, idx.astype(jnp.int32)
 
 

@@ -283,15 +283,19 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
                                       q_tile=q_tile)
     from jax import lax as _lax
 
+    from elasticsearch_tpu.ops.scoring import topk_auto, topk_block_config
+
     # XLA fallback, Q-chunked: one unchunked [Q, D] score matrix at msearch
-    # batch scale (Q=2048, D=1M) would be an 8 GB intermediate
+    # batch scale (Q=2048, D=1M) would be an 8 GB intermediate. This
+    # dispatcher runs EAGERLY, so reading the topk config here is safe.
     outs = []
     step = min(Q, 256)
+    blk = topk_block_config()
     for q0 in range(0, Q, step):
         scores = jnp.dot(qw[q0:q0 + step], impact,
                          precision=_lax.Precision.HIGHEST)
         masked = jnp.where(mask[None, :], scores, NEG_INF)
-        outs.append(_lax.top_k(masked, k))
+        outs.append(topk_auto(masked, k, blk))
     vals = jnp.concatenate([v for v, _ in outs], axis=0)
     idx = jnp.concatenate([i for _, i in outs], axis=0)
     return vals, idx.astype(jnp.int32)
@@ -350,5 +354,7 @@ def knn_topk_auto(queries, vecs, mask, *, k: int, metric: str = "cosine",
             return vals[:Q], idx[:Q]
         return knn_topk_pallas(queries, vecs, mask, k=k, metric=metric,
                                tile=tile, precise=precise)
+    from elasticsearch_tpu.ops.scoring import topk_block_config
+
     return knn_topk(queries, vecs, mask, k=k, metric=metric,
-                    use_bf16=not precise)
+                    use_bf16=not precise, topk_block=topk_block_config())

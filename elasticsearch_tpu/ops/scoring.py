@@ -22,6 +22,7 @@ inputs (see utils.shapes.pow2_bucket).
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -91,6 +92,51 @@ def bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int):
 # ---------------------------------------------------------------------------
 
 
+def topk_block_config() -> int:
+    """The blocked-top-k knob, read from ``ESTPU_BLOCKED_TOPK``: 0/unset =
+    flat ``lax.top_k``; 1/true = two-stage with the default 8192 block;
+    an integer = that block size. MUST be read OUTSIDE jit (at call or
+    program-build time) and plumbed through as a static argument, so the
+    choice participates in jit/program cache keys — an env read inside
+    traced code would be silently frozen by the first trace."""
+    v = os.environ.get("ESTPU_BLOCKED_TOPK", "").lower()
+    if not v or v in ("0", "false", "off"):
+        return 0
+    if v in ("1", "true", "on"):
+        return 8192
+    return int(v)
+
+
+def exact_topk(x, k: int, block: int = 8192):
+    """Exact top-k over the last axis, two-stage: per-block top-k, then
+    top-k over the concatenated block winners. Identical results to
+    ``lax.top_k`` INCLUDING tie order (ties resolve to the lowest index:
+    within a block top_k orders ties by index, and across blocks the
+    winner list is block-ordered so the global pass picks the earlier
+    block first). Falls back to the flat top_k when blocking can't help
+    (small D, huge k, non-divisible shapes). Shapes a large-D top-k into
+    row-sized sorts, which some backends execute far better than one
+    D-wide selection."""
+    D = x.shape[-1]
+    if k >= block or D < 2 * block or D % block:
+        return lax.top_k(x, k)
+    nb = D // block
+    xb = x.reshape(x.shape[:-1] + (nb, block))
+    bv, bi = lax.top_k(xb, k)  # [..., nb, k]
+    bi = bi + (jnp.arange(nb, dtype=bi.dtype) * block)[:, None]
+    flatv = bv.reshape(x.shape[:-1] + (nb * k,))
+    flati = bi.reshape(x.shape[:-1] + (nb * k,))
+    gv, gp = lax.top_k(flatv, k)
+    gi = jnp.take_along_axis(flati, gp, axis=-1)
+    return gv, gi
+
+
+def topk_auto(x, k: int, block: int = 0):
+    """Product top-k dispatch: blocked two-stage when ``block`` > 0, else
+    flat ``lax.top_k``. Pass ``topk_block_config()`` read OUTSIDE jit."""
+    return exact_topk(x, k, block) if block else lax.top_k(x, k)
+
+
 def _dense_dot(qw, dense_impact):
     """qw @ impact with dtype-aware MXU mapping: an f32 block multiplies at
     HIGHEST precision (exactness tests rely on it); a bf16 block (segment's
@@ -124,9 +170,10 @@ def bm25_score_hybrid_batch(
     return dense + bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, P=P, D=D)
 
 
-@partial(jax.jit, static_argnames=("P", "D", "k"))
+@partial(jax.jit, static_argnames=("P", "D", "k", "topk_block"))
 def bm25_hybrid_topk_batch(dense_impact, qw, doc_ids, tfnorm, starts, lens,
-                           weights, live, *, P: int, D: int, k: int):
+                           weights, live, *, P: int, D: int, k: int,
+                           topk_block: int = 0):
     """Batched hybrid top-k: scores via bm25_score_hybrid_batch, then the
     per-query masked top-k and exact totals in the SAME program, so the
     [Q, D] score block never leaves the device. For all-positive
@@ -136,7 +183,7 @@ def bm25_hybrid_topk_batch(dense_impact, qw, doc_ids, tfnorm, starts, lens,
                                      starts, lens, weights, P=P, D=D)
     m = (scores > 0) & live[None, :]
     masked = jnp.where(m, scores, NEG_INF)
-    vals, idx = lax.top_k(masked, k)
+    vals, idx = topk_auto(masked, k, topk_block)
     return vals, idx.astype(jnp.int32), jnp.sum(m.astype(jnp.int32), axis=1)
 
 

@@ -89,15 +89,17 @@ def _bm25_program(mesh, cache, *, Q: int, T: int, P: int, D: int, k: int):
     Returns (replicated): vals f32[Q,k], shard i32[Q,k], local i32[Q,k],
       totals i32[Q] (exact hit counts via psum).
     """
-    key = ("bm25", Q, T, P, D, k)
+    from elasticsearch_tpu.ops.scoring import (bm25_score_segment,
+                                               topk_auto, topk_block_config)
+
+    blk = topk_block_config()  # static: part of the program cache key
+    key = ("bm25", Q, T, P, D, k, blk)
     if key in cache:
         return cache[key]
     jax = _jax()
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as PS
-
-    from elasticsearch_tpu.ops.scoring import bm25_score_segment
 
     psum, all_gather, wrap, sl = _collectives(mesh)
 
@@ -110,7 +112,7 @@ def _bm25_program(mesh, cache, *, Q: int, T: int, P: int, D: int, k: int):
         masked = jnp.where(sl(live)[None, :], scores, -jnp.inf)
         hit = masked > 0.0
         totals = psum(jnp.sum(hit.astype(jnp.int32), axis=1), "shard")
-        vals, idx = lax.top_k(masked, k)  # [Q, k] local
+        vals, idx = topk_auto(masked, k, blk)  # [Q, k] local
         av = all_gather(vals, "shard")  # [S, Q, k]
         ai = all_gather(idx, "shard")
         S = av.shape[0]
@@ -135,7 +137,11 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
     top-k, all_gather merge — the ES-2.0-era equivalent would be a
     per-shard Lucene scan + coordinator merge.
     """
-    key = ("knn", Q, dims, D, k, metric)
+    from elasticsearch_tpu.ops.scoring import topk_block_config
+
+    # the body's knn_topk_auto dispatcher reads the topk config during
+    # tracing — key the program on it so an env flip retraces
+    key = ("knn", Q, dims, D, k, metric, topk_block_config())
     if key in cache:
         return cache[key]
     import jax.numpy as jnp
@@ -183,6 +189,10 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
     from jax import lax
     from jax.sharding import PartitionSpec as PS
 
+    from elasticsearch_tpu.ops.scoring import topk_auto, topk_block_config
+
+    blk = topk_block_config()  # read OUTSIDE the traced body; the caller
+    # keys its program cache on it too (search_dsl prog_key)
     meta = {i: s for i, s in enumerate(statics)}
     n_aggs = len(compiled.agg_prims)
     psum, all_gather, wrap, sl = _collectives(mesh)
@@ -208,7 +218,7 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
         else:
             rank = scores
         masked = jnp.where(mask, rank, -jnp.inf)
-        vals, idx = lax.top_k(masked, k)
+        vals, idx = topk_auto(masked, k, blk)
         av = all_gather(vals, "shard")  # [S, k]
         ai = all_gather(idx, "shard")
         S = av.shape[0]
@@ -552,9 +562,11 @@ class MeshSearchExecutor:
                 counts.append(len(arrs))
                 statics.append(static)
             kk = min(k_dev, D)
+            from elasticsearch_tpu.ops.scoring import topk_block_config
+
             prog_key = ("dsl", compiled.struct_key(), tuple(statics),
                         tuple(tuple(a.shape) + (str(a.dtype),) for a in arrays),
-                        kk)
+                        kk, topk_block_config())
             prog = self._programs.get(prog_key)
             if prog is None:
                 prog = _dsl_program(self.mesh, compiled, counts, statics, kk)

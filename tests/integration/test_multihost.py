@@ -522,6 +522,65 @@ def test_snapshot_restore_across_hosts(master, tmp_path):
         p.wait()
 
 
+def test_three_process_replication_and_reheal(master):
+    """World=3: replicas place on distinct nodes, a member's death
+    promotes its primaries on survivors AND re-replicates back up to two
+    copies per shard from the surviving copy (reconcile with multiple
+    placement candidates — the 2-process tests can't exercise the
+    candidate-selection order). Reference: RoutingNodes promotion +
+    BalancedShardsAllocator."""
+    node, c = master
+    port = c.master_addr[1]
+    p1 = _spawn_rank1(port)
+    code2 = RANK1.format(repo="/root/repo", port=port).replace(
+        'rank=1', 'rank=2').replace('== 2, ids', '== 3, ids').replace(
+        'name="rank1"', 'name="rank2"')
+    p2 = subprocess.Popen([sys.executable, "-c", code2],
+                          stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                          text=True)
+    try:
+        assert "JOINED" in p2.stdout.readline()
+        assert _wait(lambda: len(node.cluster_state.nodes) == 3)
+        c.data.create_index("tri", {
+            "settings": {"number_of_shards": 3, "number_of_replicas": 1},
+            "mappings": {"properties": {"body": {"type": "text"},
+                                        "n": {"type": "integer"}}}})
+        assig = c.dist_indices["tri"]["assignment"]
+        # every shard: primary + replica on DISTINCT nodes; primaries
+        # spread over all three processes
+        assert all(len(set(o)) == 2 for o in assig.values()), assig
+        assert {o[0] for o in assig.values()} == \
+            set(node.cluster_state.nodes), assig
+        for i in range(60):
+            c.data.index_doc("tri", str(i), {"body": f"alpha tok{i}",
+                                             "n": i})
+        c.data.refresh("tri")
+        r = c.data.search("tri", {"query": {"match_all": {}}, "size": 0})
+        assert r["hits"]["total"] == 60
+
+        p1.kill()  # hard death of one of three members
+        p1.wait()
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2,
+                     timeout=15.0)
+        alive = set(node.cluster_state.nodes)
+        # reconcile: every shard back to 2 copies on the two survivors
+        # (recovery streams run async — poll)
+        assert _wait(lambda: all(
+            len(o) == 2 and set(o) <= alive
+            for o in c.dist_indices["tri"]["assignment"].values()),
+            timeout=25.0), c.dist_indices["tri"]["assignment"]
+        r = c.data.search("tri", {"query": {"match_all": {}}, "size": 60})
+        assert r["hits"]["total"] == 60, r["hits"]["total"]
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+        assert {h["_id"] for h in r["hits"]["hits"]} == \
+            {str(i) for i in range(60)}
+    finally:
+        p1.kill()
+        p1.wait()
+        p2.kill()
+        p2.wait()
+
+
 def test_jax_distributed_initialize_smoke():
     """--coordinator path: jax.distributed.initialize with a 1-process world
     (in a subprocess — it must run before any JAX computation)."""

@@ -324,3 +324,76 @@ def test_segment_dense_block_lazy():
     inv = seg.inverted["body"]
     assert inv.dense_block() is None
     assert inv._dense is False
+
+
+def test_exact_topk_matches_lax_including_ties():
+    """Blocked two-stage top-k must be bit-identical to lax.top_k —
+    values AND indices — including tie resolution (lowest index wins),
+    1-D and batched, with non-finite entries present."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from elasticsearch_tpu.ops.scoring import exact_topk
+
+    rng = np.random.default_rng(11)
+    for shape in ((8192,), (4, 8192)):
+        # quantized values force many exact ties across blocks
+        x = np.round(rng.standard_normal(shape) * 3).astype(np.float32)
+        x[..., :7] = -np.inf  # masked entries
+        xj = jnp.asarray(x)
+        for k in (1, 10, 64):
+            gv, gi = exact_topk(xj, k, block=1024)
+            lv, li = lax.top_k(xj, k)
+            assert np.array_equal(np.asarray(gv), np.asarray(lv)), (shape, k)
+            assert np.array_equal(np.asarray(gi), np.asarray(li)), (shape, k)
+    # fallback shapes route to plain lax.top_k
+    x = jnp.asarray(rng.standard_normal(100).astype(np.float32))
+    gv, gi = exact_topk(x, 5, block=1024)
+    lv, li = lax.top_k(x, 5)
+    assert np.array_equal(np.asarray(gv), np.asarray(lv))
+    assert np.array_equal(np.asarray(gi), np.asarray(li))
+
+
+def test_blocked_topk_env_product_equivalence(monkeypatch):
+    """ESTPU_BLOCKED_TOPK must leave product search results identical —
+    it only re-stages the top-k selection. A SMALL block (64) with a
+    600-doc corpus (padded D=1024 >= 2*block, divisible) guarantees the
+    blocked path actually executes, and the block is a STATIC part of
+    every program/jit cache key, so flag-on and flag-off runs can share
+    one process without stale-program contamination."""
+    import random
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.ops.scoring import topk_block_config
+
+    rng = random.Random(5)
+    words = ["alpha", "beta", "gamma", "delta"]
+    docs = {str(i): {"body": " ".join(rng.choices(words, k=5))}
+            for i in range(600)}
+
+    def run():
+        n = Node()
+        try:
+            n.create_index("bt", {"settings": {"number_of_shards": 1},
+                                  "mappings": {"properties": {
+                                      "body": {"type": "text"}}}})
+            for i, src in docs.items():
+                n.indices["bt"].index_doc(i, src)
+            n.indices["bt"].refresh()
+            seg = n.indices["bt"].shards[0].engine.segments[0]
+            assert seg.max_docs >= 2 * 64  # the blocked path really runs
+            return n.search("bt", {"query": {"match": {"body": "alpha"}},
+                                   "size": 10})
+        finally:
+            n.close()
+
+    monkeypatch.setenv("ESTPU_BLOCKED_TOPK", "64")
+    assert topk_block_config() == 64
+    r1 = run()
+    monkeypatch.delenv("ESTPU_BLOCKED_TOPK")
+    assert topk_block_config() == 0
+    r2 = run()
+    assert r1["hits"]["total"] == r2["hits"]["total"] > 0
+    assert [(h["_id"], round(h["_score"], 5)) for h in r1["hits"]["hits"]] \
+        == [(h["_id"], round(h["_score"], 5)) for h in r2["hits"]["hits"]]
