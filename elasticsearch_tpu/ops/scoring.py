@@ -104,7 +104,22 @@ def topk_block_config() -> int:
         return 0
     if v in ("1", "true", "on"):
         return 8192
-    return int(v)
+    try:
+        return int(v)
+    except ValueError:
+        # a typo'd knob must not crash every search request deep in the
+        # scoring path — warn once and run the flat top_k
+        global _TOPK_WARNED
+        if not _TOPK_WARNED:
+            import warnings
+
+            warnings.warn(f"ESTPU_BLOCKED_TOPK={v!r} is not an integer; "
+                          f"blocked top-k disabled")
+            _TOPK_WARNED = True
+        return 0
+
+
+_TOPK_WARNED = False
 
 
 def exact_topk(x, k: int, block: int = 8192):
@@ -302,21 +317,26 @@ def range_mask_i64pair(hi_col, lo_col, exists, lo_hi, lo_lo, hi_hi, hi_lo, inclu
 # top-k
 # ---------------------------------------------------------------------------
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(jax.jit, static_argnames=("k", "topk_block"))
+def _topk_with_mask_jit(scores, mask, *, k: int, topk_block: int):
+    masked = jnp.where(mask, scores, NEG_INF)
+    vals, idx = topk_auto(masked, k, topk_block)
+    return vals, idx.astype(jnp.int32)
+
+
 def topk_with_mask(scores, mask, *, k: int):
     """(values f32[k], indices i32[k]) of the top-k masked scores.
-    Masked-out docs get -inf; callers treat -inf as 'no hit'."""
-    masked = jnp.where(mask, scores, NEG_INF)
-    vals, idx = lax.top_k(masked, k)
-    return vals, idx.astype(jnp.int32)
+    Masked-out docs get -inf; callers treat -inf as 'no hit'. Eager
+    wrapper: the blocked-top-k knob is read here, OUTSIDE jit, and enters
+    the cache key as a static arg — callers need no plumbing."""
+    return _topk_with_mask_jit(scores, mask, k=k,
+                               topk_block=topk_block_config())
 
 
-@partial(jax.jit, static_argnames=("k",))
 def topk_batch(scores, mask, *, k: int):
     """Batched: scores [Q, D], mask [D] or [Q, D] → ([Q,k], [Q,k])."""
-    masked = jnp.where(mask, scores, NEG_INF)
-    vals, idx = lax.top_k(masked, k)
-    return vals, idx.astype(jnp.int32)
+    return _topk_with_mask_jit(scores, mask, k=k,
+                               topk_block=topk_block_config())
 
 
 @jax.jit

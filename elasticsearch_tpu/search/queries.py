@@ -323,16 +323,18 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
     jnp = _jnp()
     live = ctx.segment.live
     kk = min(k, ctx.D)
+    from elasticsearch_tpu.ops.scoring import topk_block_config
+
+    blk = topk_block_config()  # once per batch: every chunk must compile
+    # against the SAME static block even if the env flips mid-batch
     out_v, out_i, out_t = [], [], []
     for q0 in range(0, Q, chunk_q):
         q1 = min(q0 + chunk_q, Q)
-        from elasticsearch_tpu.ops.scoring import topk_block_config
-
         vals, ids, tot = bm25_hybrid_topk_batch(
             impact, jnp.asarray(qw[q0:q1]), inv.doc_ids, inv.tfnorm,
             jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
             jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk,
-            topk_block=topk_block_config())
+            topk_block=blk)
         out_v.append(np.asarray(vals))
         out_i.append(np.asarray(ids))
         out_t.append(np.asarray(tot))
