@@ -56,6 +56,7 @@ ACTION_RESTORE = "cluster:admin/snapshot/restore"
 ACTION_RESTORE_SHARDS = "indices:admin/snapshot/restore_shards"
 ACTION_ALIASES = "indices:admin/aliases"
 ACTION_APPLY_GLOBAL = "cluster:admin/apply_global_state"
+ACTION_BY_QUERY = "indices:data/write/by_query"
 
 _CONTEXT_TTL = 120.0
 
@@ -93,6 +94,7 @@ class DistributedDataService:
         t.register(ACTION_ALIASES,
                    lambda p: self.node.update_aliases(p["actions"]))
         t.register(ACTION_APPLY_GLOBAL, self._on_apply_global)
+        t.register(ACTION_BY_QUERY, self._on_by_query)
 
     # -- ownership -----------------------------------------------------------
 
@@ -691,6 +693,131 @@ class DistributedDataService:
         sid = shard_id_for(doc_id, self._meta(index)["num_shards"], routing)
         return self._primary_write("delete", index, sid, doc_id, None,
                                    routing, payload.get("kw") or {})
+
+    def by_query(self, index: str, body: Optional[dict], op: str,
+                 script=None) -> dict:
+        """Distributed delete/update-by-query: fan one scan+apply pass to
+        each PRIMARY owner for its shards, merge counts. Reference:
+        AbstractAsyncBulkByScrollAction (scroll-driven scan + bulk), here
+        scoped per owner so every apply runs on the doc's primary and
+        fans to replicas through the ordinary write hop."""
+        index = self.resolve_index(index)
+        meta = self._meta(index)
+        self.refresh(index)
+        by_owner: Dict[str, List[int]] = {}
+        out: Dict[str, Any] = {"took": 0, "total": 0, "failures": [],
+                               "timed_out": False}
+        for sid in range(meta["num_shards"]):
+            owners = meta["assignment"][str(sid)]
+            if owners:
+                by_owner.setdefault(owners[0], []).append(sid)
+            else:
+                # a shard with no active copies (mid-reheal) must SURFACE
+                # as a failure, not silently under-delete — single-doc
+                # writes in the same state raise 'no active copies'
+                out["failures"].append({
+                    "index": index, "shard": sid,
+                    "status": 503,
+                    "cause": {"type": "unavailable_shards_exception",
+                              "reason": f"[{index}][{sid}] has no active "
+                                        f"copies"}})
+        deleted = updated = noops = 0
+        for owner, sids in sorted(by_owner.items()):
+            payload = {"index": index, "query": (body or {}).get("query"),
+                       "op": op, "shards": sids, "script": script}
+            try:
+                if owner == self._local_id():
+                    res = self._on_by_query(payload)
+                else:
+                    res = self._send(owner, ACTION_BY_QUERY, payload,
+                                     timeout=300.0)
+            except Exception as e:
+                # a dead owner after earlier owners already applied
+                # destructive writes: report ITS shards failed — the
+                # caller must see partial success, not a bare 500
+                out["failures"].extend({
+                    "index": index, "shard": sid, "node": owner,
+                    "status": 503,
+                    "cause": {"type": "node_unavailable",
+                              "reason": str(e)}} for sid in sids)
+                continue
+            deleted += res.get("deleted", 0)
+            updated += res.get("updated", 0)
+            noops += res.get("noops", 0)
+            out["total"] += res.get("total", 0)
+            out["failures"].extend(res.get("failures", []))
+        try:
+            self.refresh(index)
+        except Exception:
+            pass  # a dead peer is already in failures; keep the response
+        if op == "delete":
+            out["deleted"] = deleted
+        else:
+            out["updated"] = updated
+            out["noops"] = noops
+        return out
+
+    def _on_by_query(self, payload: dict) -> dict:
+        """Owner-side by-query pass, restricted to the PRIMARY shards this
+        process owns (the local index also holds replica copies of remote
+        primaries — touching those here would race their owners). The
+        scan loop is SHARED with the single-node REST actions
+        (search/byquery.py); every apply goes through
+        _primary_write/_primary_update so replicas stay in version
+        order."""
+        from elasticsearch_tpu.search.byquery import (failure_entry,
+                                                      run_by_query)
+        from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+        index, op = payload["index"], payload["op"]
+        sids = set(payload["shards"])
+        script = payload.get("script")
+        svc = self.node.indices[index]
+        num_shards = self._meta(index)["num_shards"]
+        svc.refresh()
+        counted: set = set()
+        counts = {"deleted": 0, "updated": 0, "noops": 0}
+        failures: List[dict] = []
+
+        def apply(doc_id, loc):
+            routing = loc.routing if loc else None
+            sid = shard_id_for(doc_id, num_shards, routing)
+            if sid not in sids:
+                return  # a replica copy: its primary handles it
+            counted.add(doc_id)
+            try:
+                if op == "delete":
+                    self._primary_write("delete", index, sid, doc_id,
+                                        None, routing, {})
+                    counts["deleted"] += 1
+                elif script is not None:
+                    self._primary_update(index, sid, doc_id,
+                                         {"script": script}, routing, {})
+                    counts["updated"] += 1
+                else:
+                    got = svc.get_doc(doc_id, routing=routing)
+                    if got.get("found"):
+                        kw: Dict[str, Any] = {}
+                        if loc is not None and loc.doc_type:
+                            kw["doc_type"] = loc.doc_type
+                        if loc is not None and loc.parent:
+                            kw["parent"] = loc.parent
+                        self._primary_write("index", index, sid, doc_id,
+                                            got["_source"], routing, kw)
+                        counts["updated"] += 1
+                    else:
+                        counts["noops"] += 1
+            except ElasticsearchTpuException as e:
+                failures.append(failure_entry(index, doc_id, e))
+
+        run_by_query(svc, payload.get("query"), apply)
+        out: Dict[str, Any] = {"total": len(counted), "failures": failures}
+        if op == "delete":
+            out["deleted"] = counts["deleted"]
+        else:
+            out["updated"] = counts["updated"]
+            out["noops"] = counts["noops"]
+        return out
 
     def get_doc(self, index: str, doc_id: str,
                 routing: Optional[str] = None) -> dict:

@@ -493,7 +493,14 @@ class Node:
                     resolved = self.resolve_indices(next(iter(names)))
                 except ElasticsearchTpuException:
                     resolved = []
-                if len(resolved) == 1:
+                mh = getattr(self, "multihost", None)
+                if len(resolved) == 1 and not (
+                        mh is not None
+                        and resolved[0] in mh.dist_indices):
+                    # a distributed index's LOCAL service holds only the
+                    # locally-owned shards — the fused batch would return
+                    # partial results; the sequential loop below routes
+                    # each request through the cross-host data plane
                     from elasticsearch_tpu.cluster.metadata import check_open
                     from elasticsearch_tpu.search.batch import try_batched_msearch
 

@@ -1872,102 +1872,83 @@ def _update_doc(n: Node, p, b, index: str, id: str,
     return 200, r
 
 
-def _scan_ids(svc, body: dict, seen: set):
-    """One scan round of unseen matches — the by-query actions loop this
-    until exhausted (reference: AbstractAsyncBulkByScrollAction's
-    scroll-driven scan; we rescan because deletes/updates shift results)."""
-    resp = svc.search({"query": body.get("query", {"match_all": {}}),
-                       "size": 10_000, "_source": False})
-    out, new = [], set()
-    for h in resp["hits"]["hits"]:
-        if h["_id"] not in seen and h["_id"] not in new:
-            new.add(h["_id"])
-            out.append(h["_id"])
-    return out
-
-
 def _delete_by_query(n: Node, p, b, index: str):
+    from elasticsearch_tpu.search.byquery import failure_entry, run_by_query
+
+    data = _mh_for(n, index)
+    if data is not None:
+        # distributed index: each primary owner scans + deletes its own
+        # shards' docs, replicas follow through the write hop
+        return 200, data.by_query(index, _json(b), "delete")
     svc = n.get_index(index)
     svc.refresh()
     body = _json(b)
-    seen: set = set()
-    deleted = 0
+    counts = {"deleted": 0}
     failures: list = []
-    while True:
-        ids = _scan_ids(svc, body, seen)
-        if not ids:
-            break
-        seen.update(ids)
-        for doc_id in ids:
-            # docs indexed with routing/parent don't route by id — read the
-            # stored routing off the owning shard's location table, and
-            # delete EVERY live copy (the same id can live on several
-            # shards under different routings)
-            locs = svc.find_doc_locations(doc_id) or [None]
-            for loc in locs:
-                try:
-                    svc.delete_doc(doc_id, routing=loc.routing if loc else None)
-                    deleted += 1
-                except ElasticsearchTpuException as e:
-                    failures.append({"index": svc.name, "id": doc_id,
-                                     "status": e.status,
-                                     "cause": {"type": e.error_type, "reason": str(e)}})
-        svc.refresh()
-    return 200, {"took": 0, "deleted": deleted, "total": len(seen),
-                 "failures": failures, "timed_out": False}
+
+    def apply(doc_id, loc):
+        # docs indexed with routing/parent don't route by id — the stored
+        # routing comes off the location table; EVERY live copy is walked
+        # (the same id can live on several shards under different routings)
+        try:
+            svc.delete_doc(doc_id, routing=loc.routing if loc else None)
+            counts["deleted"] += 1
+        except ElasticsearchTpuException as e:
+            failures.append(failure_entry(svc.name, doc_id, e))
+
+    seen = run_by_query(svc, body.get("query"), apply)
+    return 200, {"took": 0, "deleted": counts["deleted"],
+                 "total": len(seen), "failures": failures,
+                 "timed_out": False}
 
 
 def _update_by_query(n: Node, p, b, index: str):
+    from elasticsearch_tpu.search.byquery import failure_entry, run_by_query
+
+    body = _json(b)
+    data = _mh_for(n, index)
+    if data is not None:
+        return 200, data.by_query(index, body, "update",
+                                  script=body.get("script"))
     svc = n.get_index(index)
     svc.refresh()
-    body = _json(b)
     script = body.get("script")
-    seen: set = set()
-    updated = 0
-    noops = 0
+    counts = {"updated": 0, "noops": 0}
     failures: list = []
-    while True:
-        ids = _scan_ids(svc, body, seen)
-        if not ids:
-            break
-        seen.update(ids)
-        for doc_id in ids:
-            # touch EVERY live copy of the id (custom routing can place the
-            # same _id on several shards), each with its stored routing
-            locs = svc.find_doc_locations(doc_id) or [None]
-            for loc in locs:
-                routing = loc.routing if loc else None
-                try:
-                    if script is not None:
-                        svc.update_doc(doc_id, {"script": script}, routing=routing)
-                        updated += 1
-                    else:
-                        # no script: a re-index touch (picks up mapping
-                        # changes). Carry the doc's _type/_parent/routing
-                        # meta through the re-index or a routed /
-                        # parent-child doc would land on a different shard
-                        # and sever its joins (Engine.update carries meta
-                        # unconditionally — mirror that).
-                        got = svc.get_doc(doc_id, routing=routing)
-                        if got.get("found"):
-                            kw = {}
-                            if loc is not None and loc.doc_type:
-                                kw["doc_type"] = loc.doc_type
-                            if loc is not None and loc.parent:
-                                kw["parent"] = loc.parent
-                            svc.index_doc(doc_id, got["_source"], routing=routing, **kw)
-                            updated += 1
-                        else:
-                            # deleted between scan and get: account for it
-                            # (ES reports these as noops, never silently)
-                            noops += 1
-                except ElasticsearchTpuException as e:
-                    failures.append({"index": svc.name, "id": doc_id,
-                                     "status": e.status,
-                                     "cause": {"type": e.error_type, "reason": str(e)}})
-        svc.refresh()
-    return 200, {"took": 0, "updated": updated, "total": len(seen),
-                 "noops": noops, "failures": failures, "timed_out": False}
+
+    def apply(doc_id, loc):
+        routing = loc.routing if loc else None
+        try:
+            if script is not None:
+                svc.update_doc(doc_id, {"script": script}, routing=routing)
+                counts["updated"] += 1
+            else:
+                # no script: a re-index touch (picks up mapping changes).
+                # Carry the doc's _type/_parent/routing meta through the
+                # re-index or a routed / parent-child doc would land on a
+                # different shard and sever its joins (Engine.update
+                # carries meta unconditionally — mirror that).
+                got = svc.get_doc(doc_id, routing=routing)
+                if got.get("found"):
+                    kw = {}
+                    if loc is not None and loc.doc_type:
+                        kw["doc_type"] = loc.doc_type
+                    if loc is not None and loc.parent:
+                        kw["parent"] = loc.parent
+                    svc.index_doc(doc_id, got["_source"], routing=routing,
+                                  **kw)
+                    counts["updated"] += 1
+                else:
+                    # deleted between scan and get: account for it (ES
+                    # reports these as noops, never silently)
+                    counts["noops"] += 1
+        except ElasticsearchTpuException as e:
+            failures.append(failure_entry(svc.name, doc_id, e))
+
+    seen = run_by_query(svc, body.get("query"), apply)
+    return 200, {"took": 0, "updated": counts["updated"],
+                 "total": len(seen), "noops": counts["noops"],
+                 "failures": failures, "timed_out": False}
 
 
 def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:

@@ -1,0 +1,53 @@
+"""Shared scan-until-dry loop for the by-query write actions.
+
+Reference: org/elasticsearch/action (AbstractAsyncBulkByScrollAction) —
+a scroll-driven scan feeding bulk writes, rescanned because the writes
+shift results. Both the single-node REST handlers
+(rest/server.py::_delete_by_query/_update_by_query) and the multi-host
+per-owner action (cluster/search_action.py::_on_by_query) drive this same
+loop; only the per-document apply differs, so the scan semantics
+(page-level duplicate-id dedup, per-location routing walk, rescan until
+dry) can never diverge between the two paths.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Set
+
+
+def scan_ids(svc, query: Optional[dict], seen: Set[str]) -> list:
+    """One scan round of unseen matching ids. The in-page `new` set
+    dedups the same _id surfacing twice in one page (custom routing can
+    place one id on several shards)."""
+    resp = svc.search({"query": query or {"match_all": {}},
+                       "size": 10_000, "_source": False})
+    out, new = [], set()
+    for h in resp["hits"]["hits"]:
+        if h["_id"] not in seen and h["_id"] not in new:
+            new.add(h["_id"])
+            out.append(h["_id"])
+    return out
+
+
+def run_by_query(svc, query: Optional[dict],
+                 apply_fn: Callable[[str, object], None]) -> Set[str]:
+    """Scan until dry, calling ``apply_fn(doc_id, loc)`` for EVERY live
+    location of each matching doc (loc carries the stored routing /
+    doc_type / parent; None when the location table has no entry).
+    Refreshes between rounds so deletes/updates shift the next scan.
+    Returns the set of processed ids; the caller shapes counts/failures
+    inside apply_fn."""
+    seen: Set[str] = set()
+    while True:
+        ids = scan_ids(svc, query, seen)
+        if not ids:
+            return seen
+        for doc_id in ids:
+            seen.add(doc_id)
+            for loc in (svc.find_doc_locations(doc_id) or [None]):
+                apply_fn(doc_id, loc)
+        svc.refresh()
+
+
+def failure_entry(index: str, doc_id: str, e) -> dict:
+    return {"index": index, "id": doc_id, "status": e.status,
+            "cause": {"type": e.error_type, "reason": str(e)}}

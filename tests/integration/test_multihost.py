@@ -383,6 +383,38 @@ def test_rest_routes_through_cross_host_data_plane(master):
         assert not br["errors"], br
         st, g = req("GET", "/revents/t/b1")
         assert st == 200 and g["found"], g
+        st, _ = req("POST", "/revents/_refresh")
+
+        # msearch on a dist index must NOT take the local fused batch
+        # (it would see only local shards): totals must be cluster-wide
+        mlines = ""
+        for _ in range(3):
+            mlines += json.dumps({"index": "revents"}) + "\n"
+            mlines += json.dumps({"query": {"match_all": {}},
+                                  "size": 0}) + "\n"
+        mreq = urllib.request.Request(base + "/_msearch", method="POST",
+                                      data=mlines.encode())
+        with urllib.request.urlopen(mreq) as resp:
+            mr = json.loads(resp.read())
+        assert all(r["hits"]["total"] == 20 for r in mr["responses"]), \
+            [r["hits"]["total"] for r in mr["responses"]]
+
+        # update_by_query (script) touches docs on BOTH processes
+        st, r = req("POST", "/revents/_update_by_query", {
+            "query": {"match_all": {}},
+            "script": {"inline": "ctx._source.touched = 1"}})
+        assert st == 200 and r["updated"] == 20, r
+        assert r["total"] == 20 and not r["failures"], r
+        st, g = req("GET", f"/revents/t/{other_remote}")
+        assert g["_source"].get("touched") == 1, g
+
+        # delete_by_query removes docs cluster-wide
+        st, r = req("POST", "/revents/_delete_by_query",
+                    {"query": {"match_all": {}}})
+        assert st == 200 and r["deleted"] == 20, r
+        st, r = req("POST", "/revents/_search",
+                    {"query": {"match_all": {}}, "size": 5})
+        assert r["hits"]["total"] == 0, r["hits"]["total"]
     finally:
         srv.stop()
         p.kill()

@@ -50,24 +50,24 @@ def test_update_by_query_with_script(node):
 
 def test_delete_by_query_beyond_scan_window(node):
     # regression: >10k matches must loop until exhausted, not truncate
-    import elasticsearch_tpu.rest.server as srv
+    import elasticsearch_tpu.search.byquery as bq
 
     rc = RestController(node)
-    orig = srv._scan_ids
+    orig = bq.scan_ids
     calls = {"n": 0}
 
-    def tiny_scan(svc, body, seen):
+    def tiny_scan(svc, query, seen):
         calls["n"] += 1
-        resp = svc.search({"query": body.get("query", {"match_all": {}}),
+        resp = svc.search({"query": query or {"match_all": {}},
                            "size": 3, "_source": False})
         return [h["_id"] for h in resp["hits"]["hits"] if h["_id"] not in seen]
 
-    srv._scan_ids = tiny_scan
+    bq.scan_ids = tiny_scan
     try:
         status, out = rc.dispatch("POST", "/a1/_delete_by_query", {},
                                   b'{"query": {"match_all": {}}}')
     finally:
-        srv._scan_ids = orig
+        bq.scan_ids = orig
     assert out["deleted"] == 10 and calls["n"] >= 4  # looped past the window
     assert node.indices["a1"].num_docs == 0
 
