@@ -57,6 +57,7 @@ ACTION_RESTORE_SHARDS = "indices:admin/snapshot/restore_shards"
 ACTION_ALIASES = "indices:admin/aliases"
 ACTION_APPLY_GLOBAL = "cluster:admin/apply_global_state"
 ACTION_BY_QUERY = "indices:data/write/by_query"
+ACTION_REST_PROXY = "internal:rest/proxy"
 
 _CONTEXT_TTL = 120.0
 
@@ -95,6 +96,8 @@ class DistributedDataService:
                    lambda p: self.node.update_aliases(p["actions"]))
         t.register(ACTION_APPLY_GLOBAL, self._on_apply_global)
         t.register(ACTION_BY_QUERY, self._on_by_query)
+        t.register(ACTION_REST_PROXY, self._on_rest_proxy)
+        self._proxy_controller = None
 
     # -- ownership -----------------------------------------------------------
 
@@ -819,6 +822,44 @@ class DistributedDataService:
             out["noops"] = counts["noops"]
         return out
 
+    def proxy_doc_rest(self, index: str, doc_id: str,
+                       routing: Optional[str], method: str, path: str,
+                       params: dict, body: Optional[bytes]):
+        """Route a doc-level REST op (explain / termvectors) to the doc's
+        primary owner and relay its (status, body); None when the owner
+        is THIS process — the caller then runs its own handler against
+        the local shards, which hold the doc. Reference: the per-node
+        transport handlers behind RestExplainAction /
+        RestTermVectorsAction (each executes on the shard's node)."""
+        index = self.resolve_index(index)
+        meta = self._meta(index)
+        sid = shard_id_for(doc_id, meta["num_shards"], routing)
+        owner = self.owner_of(index, sid)
+        if owner == self._local_id():
+            return None
+        res = self._send(owner, ACTION_REST_PROXY, {
+            "method": method, "path": path, "params": dict(params or {}),
+            "body": (body or b"").decode("utf-8", "replace")})
+        return res["status"], res["payload"]
+
+    def _on_rest_proxy(self, payload: dict) -> dict:
+        """Dispatch a proxied REST request into this process's own route
+        table (lazily built — a pure data node may never serve HTTP)."""
+        ctrl = self._proxy_controller
+        if ctrl is None:
+            from elasticsearch_tpu.rest.server import RestController
+
+            ctrl = self._proxy_controller = RestController(self.node)
+        params = dict(payload.get("params") or {})
+        # pin to THIS node: the dispatched handler must serve from local
+        # shards, never re-forward (divergent ownership views would
+        # ping-pong the request unboundedly)
+        params["_local_only"] = "1"
+        status, body = ctrl.dispatch(
+            payload["method"], payload["path"], params,
+            (payload.get("body") or "").encode())
+        return {"status": status, "payload": body}
+
     def get_doc(self, index: str, doc_id: str,
                 routing: Optional[str] = None) -> dict:
         index = self.resolve_index(index)
@@ -1082,6 +1123,16 @@ class DistributedDataService:
         index = self.resolve_index(index)
         meta = self._meta(index)
         local_id = self._local_id()
+        # cross-host scroll: the per-owner fetch contexts are one-shot, so
+        # the coordinator MATERIALIZES the window (capped at the 10k
+        # result window — DEVIATIONS.md) and pages from it; the shards see
+        # a full-window query phase
+        scroll = body.get("scroll")
+        page_size = int(body.get("size", 10))
+        if scroll:
+            body = {k: v for k, v in body.items() if k != "scroll"}
+            body["size"] = 10_000
+            body["from"] = 0
         by_owner: Dict[str, List[int]] = {}
         unassigned: List[dict] = []
         for sid in range(meta["num_shards"]):
@@ -1207,6 +1258,18 @@ class DistributedDataService:
         agg_tree = parse_aggs(body.get("aggs") or body.get("aggregations"))
         if agg_tree and agg_lists:
             response["aggregations"] = reduce_aggs(agg_tree, agg_lists)
+        if scroll:
+            from elasticsearch_tpu.search.service import register_scroll_hits
+
+            full = response["hits"]["hits"]
+            # search_type=scan: the first response carries NO hits by
+            # contract — everything serves via scroll pages (clients like
+            # helpers.scan discard the initial page)
+            is_scan = str(body.get("search_type", "")) == "scan"
+            response["_scroll_id"] = register_scroll_hits(
+                {"size": page_size}, full, total,
+                consumed=0 if is_scan else page_size)
+            response["hits"]["hits"] = [] if is_scan else full[:page_size]
         return response
 
 

@@ -2240,9 +2240,31 @@ def _validate_query(n: Node, p, b, index: str):
         return 200, {"valid": False}
 
 
+def _forward_doc_op(n: Node, index: str, doc_id, p, b, segment: str):
+    """Forward a doc-level op (explain / termvectors) to the doc's
+    primary owner; None → serve locally. The `_local_only` param pins a
+    PROXIED request to the receiving node — without it, divergent
+    ownership views during a reassignment window would re-forward the
+    request in an unbounded ping-pong between nodes."""
+    if p.get("_local_only"):
+        return None
+    data = _mh_for(n, index)
+    if data is None:
+        return None
+    from urllib.parse import quote
+
+    return data.proxy_doc_rest(
+        index, str(doc_id), p.get("routing"), "POST",
+        f"/{quote(index, safe='')}/{segment}/{quote(str(doc_id), safe='')}",
+        p, b)
+
+
 def _explain(n: Node, p, b, index: str, id: str):
     """Per-doc score explanation (RestExplainAction): run the query on the
     owning segment and report the doc's score + matched state."""
+    fwd = _forward_doc_op(n, index, id, p, b, "_explain")
+    if fwd is not None:
+        return fwd
     import numpy as np
 
     from elasticsearch_tpu.search.context import SegmentContext
@@ -2557,6 +2579,9 @@ def _termvectors(n: Node, p, b, index: str, id: str):
     Offsets are recovered by cursor-scanning the source text for each
     token (the index stores positions, not offsets); stemmed tokens whose
     surface form can't be located omit offsets."""
+    fwd = _forward_doc_op(n, index, id, p, b, "_termvectors")
+    if fwd is not None:
+        return fwd
     body = _json(b)
     opts = {}
     for k, default in (("positions", True), ("offsets", True),

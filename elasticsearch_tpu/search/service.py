@@ -693,6 +693,26 @@ def search_shards(
     return response
 
 
+def register_scroll_hits(body: dict, hits: List[dict], total: int,
+                         consumed: Optional[int] = None) -> str:
+    """Register a MATERIALIZED scroll: the full hit list is already
+    fetched (the cross-host scroll path — the per-owner fetch contexts
+    are one-shot, so the coordinator snapshots the window up front).
+    Pages serve straight from the list. `consumed` is how many hits the
+    INITIAL response already delivered (0 for search_type=scan, whose
+    first response carries no hits by contract)."""
+    import uuid as _uuid
+
+    scroll_id = _uuid.uuid4().hex
+    _SCROLLS[scroll_id] = {
+        "mode": "hits", "hits": hits, "total": total,
+        "pos": (int(body.get("size", 10)) if consumed is None
+                else consumed),
+        "body": body,
+    }
+    return scroll_id
+
+
 def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
     state = _SCROLLS.get(scroll_id)
     if state is None:
@@ -705,6 +725,12 @@ def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
     sz = size or int(body.get("size", 10))
     lo = state["pos"]
     state["pos"] += sz
+    if state.get("mode") == "hits":
+        return {
+            "took": 0, "timed_out": False, "_scroll_id": scroll_id,
+            "hits": {"total": state["total"], "max_score": None,
+                     "hits": state["hits"][lo: lo + sz]},
+        }
     if state.get("mode") == "arrays":
         segs = state["segs"]
         page = [

@@ -559,6 +559,95 @@ def test_snapshot_restore_across_hosts(master, tmp_path):
         p.wait()
 
 
+def test_doc_level_and_scroll_ops_cross_host(master):
+    """Doc-level REST ops (explain, termvectors) route to the doc's
+    primary owner (the coordinator's local shards don't hold remote
+    docs), and scroll on a distributed index pages through the FULL
+    cluster-wide result set."""
+    import json
+    import urllib.request
+
+    from elasticsearch_tpu.cluster.routing import shard_id_for
+    from elasticsearch_tpu.rest.server import RestServer
+
+    node, c = master
+    p = _spawn_rank1(c.master_addr[1])
+    srv = RestServer(node, port=0)
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    def req(method, path, body=None):
+        r = urllib.request.Request(
+            base + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None)
+        try:
+            with urllib.request.urlopen(r) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+
+    try:
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        st, r = req("PUT", "/dlo", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        assert st == 200, r
+        for i in range(30):
+            req("PUT", f"/dlo/t/{i}", {"body": f"alpha beta tok{i}"})
+        req("POST", "/dlo/_refresh")
+        remote_id = next(
+            str(i) for i in range(30)
+            if c.data.owner_of("dlo", shard_id_for(str(i), 2))
+            != c.local.node_id)
+
+        # explain for a REMOTE doc: matched with a real score
+        st, r = req("POST", f"/dlo/_explain/{remote_id}",
+                    {"query": {"match": {"body": "alpha"}}})
+        assert st == 200 and r["matched"], r
+        assert r["explanation"]["value"] > 0, r
+
+        # termvectors for a REMOTE doc: real terms with positions
+        st, r = req("GET", f"/dlo/t/{remote_id}/_termvectors")
+        assert st == 200, r
+        terms = r["term_vectors"]["body"]["terms"]
+        assert "alpha" in terms and "beta" in terms, sorted(terms)[:5]
+
+        # scroll pages through ALL 30 docs cluster-wide
+        st, r = req("POST", "/dlo/_search?scroll=1m",
+                    {"query": {"match_all": {}}, "size": 12})
+        assert st == 200 and r["hits"]["total"] == 30, r["hits"]["total"]
+        sid = r["_scroll_id"]
+        got = [h["_id"] for h in r["hits"]["hits"]]
+        while True:
+            st, r = req("POST", "/_search/scroll",
+                        {"scroll": "1m", "scroll_id": sid})
+            assert st == 200, r
+            if not r["hits"]["hits"]:
+                break
+            got.extend(h["_id"] for h in r["hits"]["hits"])
+        assert sorted(got, key=int) == [str(i) for i in range(30)], got
+
+        # search_type=scan: first response carries NO hits by contract;
+        # scroll pages deliver everything
+        st, r = req("POST", "/dlo/_search?scroll=1m&search_type=scan",
+                    {"query": {"match_all": {}}, "size": 12})
+        assert st == 200 and r["hits"]["hits"] == [], r["hits"]
+        assert r["hits"]["total"] == 30
+        sid = r["_scroll_id"]
+        got = []
+        while True:
+            st, r = req("POST", "/_search/scroll",
+                        {"scroll": "1m", "scroll_id": sid})
+            if not r["hits"]["hits"]:
+                break
+            got.extend(h["_id"] for h in r["hits"]["hits"])
+        assert sorted(got, key=int) == [str(i) for i in range(30)], got
+    finally:
+        srv.stop()
+        p.kill()
+        p.wait()
+
+
 def test_snapshot_under_concurrent_writes(master, tmp_path):
     """Race safety (SURVEY §5): a distributed snapshot taken while client
     threads keep writing must neither crash (engine._locations mutating
