@@ -842,6 +842,79 @@ class DistributedDataService:
             "body": (body or b"").decode("utf-8", "replace")})
         return res["status"], res["payload"]
 
+    def broadcast_rest(self, method: str, path: str, params: dict,
+                       body: Optional[bytes]) -> List[Tuple[str, int, Any]]:
+        """Run one REST request on EVERY member against its local shards
+        (the _local_only pin) and collect (node_id, status, payload) —
+        the fan-out for ops whose state is sharded across processes
+        (suggest over sharded postings, percolate over routed
+        .percolator registrations). An unreachable peer reports 503."""
+        req = {"method": method, "path": path,
+               "params": dict(params or {}),
+               "body": (body or b"").decode("utf-8", "replace")}
+        res = self._on_rest_proxy(dict(req))
+        results = [(self._local_id(), res["status"], res["payload"])]
+        for nid in self._other_nodes():
+            try:
+                r = self._send(nid, ACTION_REST_PROXY, dict(req))
+                results.append((nid, r["status"], r["payload"]))
+            except Exception as e:
+                results.append((nid, 503, {"error": {
+                    "type": "node_unavailable", "reason": str(e)}}))
+        return results
+
+    def suggest_fan(self, index: str,
+                    suggest_body: dict) -> Tuple[dict, dict]:
+        """Suggest on a distributed index: one request per PRIMARY owner,
+        each restricted (via the `_shards` param) to its primary shards
+        so replica copies never double-count frequencies; merged per
+        entry (search/suggest.py::merge_suggest). Returns
+        (merged, _shards accounting) — a failed owner counts ITS shard
+        count failed, and an unassigned shard is failed too. When
+        embedded in a search, a dead peer already shows in the QUERY
+        phase's _shards (suggest rides the same per-shard phase in the
+        reference), so the search path reports the merged result
+        without double-accounting."""
+        import json as _json
+
+        from urllib.parse import quote
+
+        from elasticsearch_tpu.search.suggest import merge_suggest
+
+        index = self.resolve_index(index)
+        meta = self._meta(index)
+        by_owner: Dict[str, List[int]] = {}
+        failed_shards = 0
+        for sid in range(meta["num_shards"]):
+            owners = meta["assignment"][str(sid)]
+            if owners:
+                by_owner.setdefault(owners[0], []).append(sid)
+            else:
+                failed_shards += 1
+        payloads = []
+        raw = _json.dumps(suggest_body).encode()
+        for owner, sids in sorted(by_owner.items()):
+            req = {"method": "POST",
+                   "path": f"/{quote(index, safe='')}/_suggest",
+                   "params": {"_shards": ",".join(map(str, sids))},
+                   "body": raw.decode("utf-8", "replace")}
+            try:
+                if owner == self._local_id():
+                    res = self._on_rest_proxy(req)
+                else:
+                    res = self._send(owner, ACTION_REST_PROXY, req)
+            except Exception:
+                failed_shards += len(sids)
+                continue
+            if res["status"] == 200:
+                payloads.append(res["payload"])
+            else:
+                failed_shards += len(sids)
+        total = meta["num_shards"]
+        return merge_suggest(suggest_body, payloads), {
+            "total": total, "successful": total - failed_shards,
+            "failed": failed_shards}
+
     def _on_rest_proxy(self, payload: dict) -> dict:
         """Dispatch a proxied REST request into this process's own route
         table (lazily built — a pure data node may never serve HTTP)."""
@@ -1258,6 +1331,10 @@ class DistributedDataService:
         agg_tree = parse_aggs(body.get("aggs") or body.get("aggregations"))
         if agg_tree and agg_lists:
             response["aggregations"] = reduce_aggs(agg_tree, agg_lists)
+        if body.get("suggest"):
+            # a dead peer already shows in the query phase's _shards above
+            response["suggest"] = self.suggest_fan(index,
+                                                   body["suggest"])[0]
         if scroll:
             from elasticsearch_tpu.search.service import register_scroll_hits
 

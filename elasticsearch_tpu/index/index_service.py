@@ -373,14 +373,18 @@ class IndexService:
             resp["suggest"] = self.suggest(body["suggest"])
         return resp
 
-    def suggest(self, body: dict) -> dict:
+    def suggest(self, body: dict, shard_ids=None) -> dict:
         """Standalone suggest (reference: action/suggest/TransportSuggestAction
-        + search-embedded SuggestPhase)."""
+        + search-embedded SuggestPhase). `shard_ids` restricts to a shard
+        subset — the multi-host fan-out targets each owner's PRIMARY
+        shards only, so replica copies never double-count frequencies."""
         from elasticsearch_tpu.search.suggest import execute_suggest
 
-        for sh in self.shards:
+        shards = (self.shards if shard_ids is None
+                  else [self.shards[i] for i in shard_ids])
+        for sh in shards:
             sh.searcher.stats.on_suggest()
-        return execute_suggest(self.shards, body or {}, self.analysis,
+        return execute_suggest(shards, body or {}, self.analysis,
                                mappings=self.mappings)
 
     # -- percolator ------------------------------------------------------------

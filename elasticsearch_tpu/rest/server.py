@@ -2450,7 +2450,74 @@ def _delete_warmer(n: Node, p, b, index: str, name: str):
     return 200, {"acknowledged": True}
 
 
+def _dist_percolate(n: Node, c, index: str, type: str, body: dict):
+    """Percolate on a distributed index: registered .percolator queries
+    are hash-routed docs, fanned to each PRIMARY owner and merged with
+    per-query-id dedup — replica fanout copies a registration onto
+    replica holders' registries too, so without the dedup (and the
+    primary-owner targeting) the same query would match once per copy.
+    Aggs-under-percolate can't reduce from per-node FINAL aggs, so it is
+    rejected with a clear error (DEVIATIONS.md)."""
+    import json as _json_mod
+    from urllib.parse import quote
+
+    from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+    if body.get("aggs") or body.get("aggregations"):
+        raise IllegalArgumentException(
+            "aggregations inside percolate are not supported on a "
+            "multi-host distributed index (registered queries are "
+            "partitioned across processes)")
+    rname = c.data.resolve_index(index)
+    meta = c.data._meta(rname)
+    by_owner: Dict[str, int] = {}
+    failed_shards = 0
+    for sid in range(meta["num_shards"]):
+        owners = meta["assignment"][str(sid)]
+        if owners:
+            by_owner[owners[0]] = by_owner.get(owners[0], 0) + 1
+        else:
+            failed_shards += 1
+    req = {"method": "POST",
+           "path": (f"/{quote(index, safe='')}/"
+                    f"{quote(type, safe='')}/_percolate"),
+           "params": {}, "body": _json_mod.dumps(body)}
+    matches: list = []
+    seen_ids: set = set()
+    for owner, n_shards in sorted(by_owner.items()):
+        try:
+            if owner == c.data._local_id():
+                res = c.data._on_rest_proxy(dict(req))
+            else:
+                res = c.data._send(owner, ACTION_REST_PROXY, dict(req))
+        except Exception:
+            failed_shards += n_shards
+            continue
+        if res["status"] != 200:
+            failed_shards += n_shards
+            continue
+        for m in res["payload"].get("matches", []):
+            key = (m.get("_index"), m.get("_id"))
+            if key not in seen_ids:
+                seen_ids.add(key)
+                matches.append(m)
+    total = len(matches)
+    size = body.get("size")
+    if size is not None:
+        matches = matches[: int(size)]
+    total_shards = meta["num_shards"]
+    return 200, {"took": 0,
+                 "_shards": {"total": total_shards,
+                             "successful": total_shards - failed_shards,
+                             "failed": failed_shards},
+                 "total": total, "matches": matches}
+
+
 def _percolate(n: Node, p, b, index: str, type: str):
+    c = _mh(n)
+    if c is not None and not p.get("_local_only") \
+            and c.data.resolve_index(index) in c.dist_indices:
+        return _dist_percolate(n, c, index, type, _json(b))
     svc = n.get_index(index)
     return 200, svc.percolate(_json(b))
 
@@ -2461,8 +2528,14 @@ def _percolate_existing(n: Node, p, b, index: str, type: str, id: str):
     percolate_type redirect WHICH index's registered queries run
     (TransportPercolateAction getRequest indirection); a version param
     must match the doc's current version."""
-    svc = n.get_index(index)
-    got = svc.get_doc(id, routing=p.get("routing"))
+    c = _mh(n)
+    dist = (c is not None and not p.get("_local_only")
+            and c.data.resolve_index(index) in c.dist_indices)
+    if dist:
+        got = c.data.get_doc(index, str(id), routing=p.get("routing"))
+    else:
+        svc = n.get_index(index)
+        got = svc.get_doc(id, routing=p.get("routing"))
     if not got.get("found"):
         return 404, {"_index": index, "_id": id, "found": False}
     if "version" in p and int(p["version"]) != got.get("_version"):
@@ -2473,14 +2546,31 @@ def _percolate_existing(n: Node, p, b, index: str, type: str, id: str):
     body = _json(b)
     body["doc"] = got["_source"]
     target = p.get("percolate_index")
-    psvc = n.get_index(target) if target else svc
+    if dist:
+        # percolate the fetched source against the (possibly redirected)
+        # target index's registered queries, fanned across members
+        tname = target or index
+        if c.data.resolve_index(tname) in c.dist_indices:
+            return _dist_percolate(n, c, tname, type, body)
+    psvc = n.get_index(target) if target else n.get_index(index)
     return 200, psvc.percolate(body)
 
 
 def _suggest(n: Node, p, b, index: str):
+    c = _mh(n)
+    if c is not None and not p.get("_local_only") \
+            and c.data.resolve_index(index) in c.dist_indices:
+        # distributed index: one request per primary owner, merged per
+        # entry (freq sums, score maxes) — cluster/search_action.py
+        res, shards = c.data.suggest_fan(index, _json(b))
+        res["_shards"] = shards
+        return 200, res
     svc = n.get_index(index)
-    res = svc.suggest(_json(b))
-    res["_shards"] = {"total": svc.num_shards, "successful": svc.num_shards, "failed": 0}
+    sh = p.get("_shards")  # internal: the multi-host fan's shard filter
+    shard_ids = [int(i) for i in sh.split(",")] if sh else None
+    res = svc.suggest(_json(b), shard_ids=shard_ids)
+    served = len(shard_ids) if shard_ids is not None else svc.num_shards
+    res["_shards"] = {"total": served, "successful": served, "failed": 0}
     return 200, res
 
 
@@ -3288,6 +3378,11 @@ def _clear_cache(n: Node, p, b, index: Optional[str] = None):
 
 def _percolate_count(n: Node, p, b, index: str, type: str):
     """RestPercolateAction count form (count_percolate.json)."""
+    c = _mh(n)
+    if c is not None and not p.get("_local_only") \
+            and c.data.resolve_index(index) in c.dist_indices:
+        status, res = _dist_percolate(n, c, index, type, _json(b))
+        return status, {"total": res["total"], "_shards": res["_shards"]}
     svc = n.get_index(index)
     res = svc.percolate(_json(b))
     return 200, {"total": res["total"], "_shards": {
@@ -3296,12 +3391,19 @@ def _percolate_count(n: Node, p, b, index: str, type: str):
 
 def _mpercolate(n: Node, p, b, index: Optional[str] = None):
     """RestMultiPercolateAction: NDJSON of {percolate: header} / doc pairs."""
+    c = _mh(n)
     lines = _ndjson(b)
     responses = []
     for i in range(0, len(lines) - 1, 2):
         head = lines[i].get("percolate", {})
         iname = head.get("index", index)
         try:
+            if (c is not None and not p.get("_local_only") and iname
+                    and c.data.resolve_index(iname) in c.dist_indices):
+                _st, res = _dist_percolate(
+                    n, c, iname, head.get("type", "_all"), lines[i + 1])
+                responses.append(res)
+                continue
             svc = n.get_index(iname)
             responses.append(svc.percolate(lines[i + 1]))
         except ElasticsearchTpuException as e:

@@ -638,7 +638,14 @@ def execute_suggest_multi(groups, body: dict) -> dict:
                 seen = {o["text"] for o in cur["options"]}
                 cur["options"].extend(
                     o for o in e["options"] if o["text"] not in seen)
-    # re-rank and truncate per the suggester's own size/sort options
+    _rerank_options(body, merged)
+    return merged
+
+
+def _rerank_options(body: dict, merged: Dict[str, List[dict]]) -> None:
+    """Re-rank and truncate merged options per the suggester's own
+    size/sort — the single reduce tail shared by multi-index and
+    cross-host merges."""
     for name, entries in merged.items():
         spec = body.get(name, {})
         kind = next((k for k in SUGGEST_KINDS if k in spec), None)
@@ -650,4 +657,37 @@ def execute_suggest_multi(groups, body: dict) -> dict:
             keyf = lambda o: (-o["score"], o["text"])
         for e in entries:
             e["options"] = sorted(e["options"], key=keyf)[:size]
+
+
+def merge_suggest(body: dict, payloads: List[dict]) -> dict:
+    """Merge per-OWNER suggest responses for one distributed index: every
+    primary owner ran the same suggest body over its PRIMARY shards only
+    (a shard filter keeps replica copies out — they would double-count),
+    so entries align positionally and options for the same candidate text
+    merge by SUMMING freq (disjoint shards each counted their own docs)
+    and taking the max score. Reference: SuggestPhase's shard-response
+    reduce. Re-sorted and truncated per the suggester's size/sort."""
+    merged: Dict[str, List[dict]] = {}
+    for res in payloads:
+        for name, entries in res.items():
+            if name == "_shards" or not isinstance(entries, list):
+                continue
+            if name not in merged:
+                merged[name] = [dict(e, options=[dict(o)
+                                                 for o in e["options"]])
+                                for e in entries]
+                continue
+            for cur, e in zip(merged[name], entries):
+                by_text = {o["text"]: o for o in cur["options"]}
+                for o in e["options"]:
+                    have = by_text.get(o["text"])
+                    if have is None:
+                        cur["options"].append(dict(o))
+                    else:
+                        if "freq" in o or "freq" in have:
+                            have["freq"] = (have.get("freq", 0)
+                                            + o.get("freq", 0))
+                        have["score"] = max(have.get("score", 0.0),
+                                            o.get("score", 0.0))
+    _rerank_options(body, merged)
     return merged

@@ -588,8 +588,12 @@ def test_doc_level_and_scroll_ops_cross_host(master):
 
     try:
         assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        # number_of_replicas=1: every doc (and every .percolator
+        # registration) lives on BOTH processes — the suggest freq and
+        # percolate match assertions below prove the primary-owner
+        # targeting + dedup (a naive broadcast would double everything)
         st, r = req("PUT", "/dlo", {
-            "settings": {"number_of_shards": 2},
+            "settings": {"number_of_shards": 2, "number_of_replicas": 1},
             "mappings": {"properties": {"body": {"type": "text"}}}})
         assert st == 200, r
         for i in range(30):
@@ -642,6 +646,35 @@ def test_doc_level_and_scroll_ops_cross_host(master):
                 break
             got.extend(h["_id"] for h in r["hits"]["hits"])
         assert sorted(got, key=int) == [str(i) for i in range(30)], got
+
+        # suggest merges across processes: 'alpha' is frequent on BOTH
+        # owners' shards, so the merged freq must be the cluster total
+        st, r = req("POST", "/dlo/_suggest", {
+            "fix": {"text": "alpa", "term": {"field": "body"}}})
+        assert st == 200, r
+        opts = r["fix"][0]["options"]
+        assert opts and opts[0]["text"] == "alpha", opts
+        assert opts[0]["freq"] == 30, opts  # docs from BOTH processes
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+
+        # percolate: queries register as routed docs (disjoint subsets on
+        # each owner); a match registered on the REMOTE owner must surface
+        for qid, term in (("q_local", "alpha"), ("q2", "beta"),
+                          ("q3", "zebra")):
+            st, _ = req("PUT", f"/dlo/.percolator/{qid}",
+                        {"query": {"match": {"body": term}}})
+            assert st in (200, 201)
+        req("POST", "/dlo/_refresh")
+        st, r = req("POST", "/dlo/t/_percolate",
+                    {"doc": {"body": "alpha beta words"}})
+        assert st == 200, r
+        assert r["total"] == 2, r
+        assert {m["_id"] for m in r["matches"]} == {"q_local", "q2"}, r
+        # aggs-under-percolate on a dist index: explicit refusal
+        st, r = req("POST", "/dlo/t/_percolate", {
+            "doc": {"body": "alpha"},
+            "aggs": {"x": {"terms": {"field": "body"}}}})
+        assert st == 400, (st, r)
     finally:
         srv.stop()
         p.kill()
