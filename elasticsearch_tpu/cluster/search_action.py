@@ -842,27 +842,6 @@ class DistributedDataService:
             "body": (body or b"").decode("utf-8", "replace")})
         return res["status"], res["payload"]
 
-    def broadcast_rest(self, method: str, path: str, params: dict,
-                       body: Optional[bytes]) -> List[Tuple[str, int, Any]]:
-        """Run one REST request on EVERY member against its local shards
-        (the _local_only pin) and collect (node_id, status, payload) —
-        the fan-out for ops whose state is sharded across processes
-        (suggest over sharded postings, percolate over routed
-        .percolator registrations). An unreachable peer reports 503."""
-        req = {"method": method, "path": path,
-               "params": dict(params or {}),
-               "body": (body or b"").decode("utf-8", "replace")}
-        res = self._on_rest_proxy(dict(req))
-        results = [(self._local_id(), res["status"], res["payload"])]
-        for nid in self._other_nodes():
-            try:
-                r = self._send(nid, ACTION_REST_PROXY, dict(req))
-                results.append((nid, r["status"], r["payload"]))
-            except Exception as e:
-                results.append((nid, 503, {"error": {
-                    "type": "node_unavailable", "reason": str(e)}}))
-        return results
-
     def suggest_fan(self, index: str,
                     suggest_body: dict) -> Tuple[dict, dict]:
         """Suggest on a distributed index: one request per PRIMARY owner,
@@ -1206,6 +1185,28 @@ class DistributedDataService:
             body = {k: v for k, v in body.items() if k != "scroll"}
             body["size"] = 10_000
             body["from"] = 0
+        if body.get("query"):
+            # MLT liked ids resolve via the ROUTED cross-host get before
+            # the scatter — each owner only holds its own shards' docs
+            from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
+
+            def _lookup(doc_id, routing=None, index=None, _ix=index):
+                target = index or _ix
+                try:
+                    if target in self.cluster.dist_indices:
+                        got = self.get_doc(target, doc_id, routing=routing)
+                    else:  # a like item naming a coordinator-local index
+                        svc = self.node.indices.get(target)
+                        if svc is None:
+                            return None
+                        return svc.mlt_source(doc_id, routing=routing)
+                except Exception:
+                    return None
+                return got.get("_source") if got.get("found") else None
+
+            q2 = rewrite_mlt_in_body(body["query"], _lookup)
+            if q2 is not body["query"]:
+                body = dict(body, query=q2)
         by_owner: Dict[str, List[int]] = {}
         unassigned: List[dict] = []
         for sid in range(meta["num_shards"]):

@@ -345,12 +345,33 @@ class IndexService:
         idx = self.settings.get("index", self.settings)
         return str(idx.get("search", {}).get("mesh", True)).lower() != "false"
 
+    def mlt_source(self, doc_id: str, routing=None, index=None):
+        """Whole-index source lookup for more_like_this liked ids — scans
+        every shard (a routed doc doesn't live at its id-hash shard; the
+        routing hint is unnecessary here). A like item naming a DIFFERENT
+        index is left for a node-level resolver."""
+        if index is not None and index != self.name \
+                and index not in self.aliases:
+            return None
+        for sh in self.shards:
+            got = sh.engine.get(str(doc_id))
+            if got is not None:
+                return got.get("_source")
+        return None
+
     def search(self, body: dict, dfs: bool = False,
                preference: Optional[str] = None) -> dict:
         from elasticsearch_tpu.cluster.metadata import check_open
+        from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
 
         check_open(self, op="read")
         body = body or {}
+        if body.get("query"):
+            # MLT liked ids resolve ONCE against the whole index before
+            # the per-shard fan-out (queries.rewrite_mlt_in_body)
+            q2 = rewrite_mlt_in_body(body["query"], self.mlt_source)
+            if q2 is not body["query"]:
+                body = dict(body, query=q2)
         global_stats = self.global_stats(body) if dfs else None
         # pick one in-sync copy per shard (preference: _primary | _replica |
         # default round-robin, reference: OperationRouting preference)

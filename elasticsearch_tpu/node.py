@@ -444,6 +444,22 @@ class Node:
             return self.indices[searched_names[0]].search(
                 body or {}, dfs=(search_type == "dfs_query_then_fetch"),
                 preference=preference)
+        if (body or {}).get("query"):
+            from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
+
+            def _lookup(doc_id, routing=None, index=None):
+                names = ([index] if index and index in self.indices
+                         else searched_names)
+                for nm in names:
+                    src = self.indices[nm].mlt_source(doc_id,
+                                                      routing=routing)
+                    if src is not None:
+                        return src
+                return None
+
+            q2 = rewrite_mlt_in_body(body["query"], _lookup)
+            if q2 is not body["query"]:
+                body = dict(body, query=q2)
         for n in searched_names:
             svc = self.indices[n]
             searchers.extend(g.reader(preference).searcher for g in svc.groups)

@@ -2546,12 +2546,13 @@ def _percolate_existing(n: Node, p, b, index: str, type: str, id: str):
     body = _json(b)
     body["doc"] = got["_source"]
     target = p.get("percolate_index")
-    if dist:
-        # percolate the fetched source against the (possibly redirected)
-        # target index's registered queries, fanned across members
-        tname = target or index
-        if c.data.resolve_index(tname) in c.dist_indices:
-            return _dist_percolate(n, c, tname, type, body)
+    # the fan-out gates on the TARGET registry's index being distributed
+    # — percolate_index can redirect a local source doc at a distributed
+    # registry (and vice versa)
+    tname = target or index
+    if c is not None and not p.get("_local_only") \
+            and c.data.resolve_index(tname) in c.dist_indices:
+        return _dist_percolate(n, c, tname, type, body)
     psvc = n.get_index(target) if target else n.get_index(index)
     return 200, psvc.percolate(body)
 

@@ -1314,12 +1314,16 @@ class MoreLikeThisQuery(Query):
     def __init__(self, fields: List[str], like_texts=(), like_ids=(),
                  unlike_texts=(), unlike_ids=(), include: bool = False,
                  max_query_terms: int = 25, min_term_freq: int = 1,
-                 min_doc_freq: int = 1, boost: float = 1.0):
+                 min_doc_freq: int = 1, boost: float = 1.0,
+                 exclude_ids=()):
         self.fields = fields or ["_all"]
         self.like_texts = list(like_texts)
         self.like_ids = list(like_ids)
         self.unlike_texts = list(unlike_texts)
         self.unlike_ids = list(unlike_ids)
+        # ids whose docs were pre-resolved to texts (rewrite_mlt_in_body)
+        # but must still be excluded from results like like_ids are
+        self.exclude_ids = list(exclude_ids)
         self.include = include
         self.max_query_terms = max_query_terms
         self.min_term_freq = min_term_freq
@@ -1382,10 +1386,11 @@ class MoreLikeThisQuery(Query):
             s, matched, _ = _score_term_group(ctx, field, sel, self.boost)
             out_s = out_s + s
             out_m = out_m | matched
-        if not self.include and self.like_ids:
+        excl = self.like_ids + self.exclude_ids
+        if not self.include and excl:
             # input docs are excluded from the result set by default
             drop = np.zeros(ctx.D, dtype=bool)
-            for doc_id in self.like_ids:
+            for doc_id in excl:
                 loc = ctx.segment.id_map.get(str(doc_id))
                 if loc is not None:
                     drop[loc] = True
@@ -1393,6 +1398,107 @@ class MoreLikeThisQuery(Query):
             out_m = out_m & keep
             out_s = jnp.where(keep, out_s, 0.0)
         return out_s, out_m
+
+
+def rewrite_mlt_in_body(query_dsl, lookup):
+    """Resolve more_like_this liked-DOCUMENT ids into inline doc texts
+    BEFORE the query fans out to shards: per-segment execution can only
+    see the liked doc on its own shard, so without this pre-pass MLT by
+    id silently matched nothing outside that shard. `lookup(doc_id)` is
+    the whole-index (or cross-host routed) source fetch; resolved like
+    ids stay excluded from results via the internal `_exclude_ids` key.
+    Returns a rewritten copy, or the input unchanged when no MLT clause
+    carries ids. `lookup(doc_id, routing=None, index=None)` honors a like
+    item's own routing/_index keys — an id-hash get without the doc's
+    custom routing misses, exactly as the reference's liked-doc GET does.
+    Reference: TransportMoreLikeThisAction — GET the liked doc, then
+    build the fanned-out text query.
+    """
+    if not isinstance(query_dsl, dict):
+        return query_dsl
+
+    def fields_of(spec):
+        return spec.get("fields") or None
+
+    def resolve(spec):
+        changed = False
+        out = dict(spec)
+        excl = list(out.get("_exclude_ids", []))
+        flds = fields_of(spec)
+
+        def conv(entries, exclude: bool):
+            nonlocal changed
+            if entries is None:
+                return None
+            lst = entries if isinstance(entries, list) else [entries]
+            new = []
+            for item in lst:
+                if isinstance(item, dict) and "doc" not in item \
+                        and item.get("_id") is not None:
+                    src = lookup(str(item["_id"]),
+                                 routing=item.get("routing") or
+                                 item.get("_routing"),
+                                 index=item.get("_index"))
+                    if src is not None:
+                        doc = (src if flds is None
+                               else {f: src[f] for f in flds if f in src})
+                        new.append({"doc": doc})
+                        if exclude:
+                            excl.append(str(item["_id"]))
+                        changed = True
+                        continue
+                new.append(item)
+            return new
+
+        for key, exclude in (("like", True), ("like_text", True),
+                             ("docs", True), ("unlike", False),
+                             ("ignore_like", False)):
+            if key in out:
+                got = conv(out[key], exclude)
+                if got is not None:
+                    out[key] = got
+        if "ids" in out and out["ids"]:
+            likes = conv([{"_id": i} for i in out["ids"]], True)
+            if any("doc" in e for e in likes if isinstance(e, dict)):
+                out["ids"] = [i for i, e in zip(out["ids"], likes)
+                              if not (isinstance(e, dict) and "doc" in e)]
+                if "like" not in out and "like_text" in out:
+                    # creating `like` would SHADOW like_text in the
+                    # parser's like-or-like_text fallback — fold it in
+                    lt = out.pop("like_text")
+                    out["like"] = lt if isinstance(lt, list) else [lt]
+                else:
+                    out.setdefault("like", [])
+                if not isinstance(out["like"], list):
+                    out["like"] = [out["like"]]
+                out["like"] = list(out["like"]) + [
+                    e for e in likes if isinstance(e, dict) and "doc" in e]
+        if not changed:
+            return spec
+        out["_exclude_ids"] = excl
+        return out
+
+    def walk(node):
+        if isinstance(node, dict):
+            out = None
+            for k, v in node.items():
+                if k in ("more_like_this", "mlt") and isinstance(v, dict):
+                    nv = resolve(v)
+                else:
+                    nv = walk(v)
+                if nv is not v:
+                    if out is None:
+                        out = dict(node)
+                    out[k] = nv
+            return out if out is not None else node
+        if isinstance(node, list):
+            newl = [walk(x) for x in node]
+            if any(a is not b for a, b in zip(newl, node)):
+                return newl
+            return node
+        return node
+
+    return walk(query_dsl)
 
 
 # ---------------------------------------------------------------------------
@@ -1696,6 +1802,7 @@ def _parse_query_inner(dsl: Optional[dict]) -> Query:
             body.get("fields", []),
             like_texts=texts,
             like_ids=ids,
+            exclude_ids=list(body.get("_exclude_ids", [])),
             unlike_texts=untexts,
             unlike_ids=unids,
             include=bool(body.get("include", False)),

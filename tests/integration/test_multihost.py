@@ -675,6 +675,19 @@ def test_doc_level_and_scroll_ops_cross_host(master):
             "doc": {"body": "alpha"},
             "aggs": {"x": {"terms": {"field": "body"}}}})
         assert st == 400, (st, r)
+
+        # more_like_this with a liked id resolves via the ROUTED get even
+        # when the liked doc lives on the remote owner, and matches docs
+        # cluster-wide (both shards)
+        st, r = req("POST", "/dlo/_search", {
+            "query": {"more_like_this": {
+                "fields": ["body"], "like": [{"_id": remote_id}],
+                "min_term_freq": 1, "min_doc_freq": 1}}, "size": 40})
+        assert st == 200, r
+        ids = {h["_id"] for h in r["hits"]["hits"]}
+        assert remote_id not in ids  # liked doc excluded
+        # every OTHER doc shares 'alpha beta' with the liked doc
+        assert ids == {str(i) for i in range(30)} - {remote_id}, ids
     finally:
         srv.stop()
         p.kill()

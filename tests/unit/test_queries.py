@@ -272,3 +272,38 @@ def test_match_operator_and_duplicate_query_terms():
     q2 = parse_query({"match": {"body": "the the cat"}})
     s2, m2 = q2.execute(c)
     assert np.nonzero(np.asarray(m2)[:3])[0].tolist() == [0, 1, 2]
+
+
+def test_mlt_liked_id_resolves_across_shards():
+    """more_like_this with a liked DOC ID must match similar docs on
+    EVERY shard, not just the liked doc's own (the liked doc resolves to
+    its text once, before the per-shard fan-out), and the liked doc is
+    excluded unless include=true."""
+    from elasticsearch_tpu.cluster.routing import shard_id_for
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    try:
+        n.create_index("mlt4", {
+            "settings": {"number_of_shards": 4},
+            "mappings": {"properties": {"body": {"type": "text"}}}})
+        svc = n.indices["mlt4"]
+        svc.index_doc("seed", {"body": "quantum entanglement qubits"})
+        for i in range(12):
+            svc.index_doc(f"sim{i}",
+                          {"body": "quantum entanglement qubits lab"})
+            svc.index_doc(f"no{i}", {"body": "pasta sauce recipe"})
+        svc.refresh()
+        body = {"query": {"more_like_this": {
+            "fields": ["body"], "like": [{"_id": "seed"}],
+            "min_term_freq": 1, "min_doc_freq": 1}}, "size": 30}
+        r = n.search("mlt4", body)
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert len(ids) == 12 and "seed" not in ids, ids
+        assert {shard_id_for(i, 4) for i in ids} == {0, 1, 2, 3}
+        body["query"]["more_like_this"]["include"] = True
+        r = n.search("mlt4", body)
+        ids = [h["_id"] for h in r["hits"]["hits"]]
+        assert "seed" in ids and len(ids) == 13, ids
+    finally:
+        n.close()
