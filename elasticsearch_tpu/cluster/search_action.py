@@ -1191,7 +1191,8 @@ class DistributedDataService:
             from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
 
             def _lookup(doc_id, routing=None, index=None, _ix=index):
-                target = index or _ix
+                # an aliased _index must resolve before the dist check
+                target = self.resolve_index(index or _ix)
                 try:
                     if target in self.cluster.dist_indices:
                         got = self.get_doc(target, doc_id, routing=routing)

@@ -448,13 +448,21 @@ class Node:
             from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
 
             def _lookup(doc_id, routing=None, index=None):
-                names = ([index] if index and index in self.indices
-                         else searched_names)
-                for nm in names:
-                    src = self.indices[nm].mlt_source(doc_id,
-                                                      routing=routing)
+                # mlt_source's own index check handles aliases, and an
+                # explicitly-named index must NEVER fall back to a
+                # different index's same-id document
+                for nm in searched_names:
+                    src = self.indices[nm].mlt_source(
+                        doc_id, routing=routing, index=index)
                     if src is not None:
                         return src
+                if index:
+                    for nm in self.resolve_indices(index):
+                        svc = self.indices.get(nm)
+                        if svc is not None:
+                            src = svc.mlt_source(doc_id, routing=routing)
+                            if src is not None:
+                                return src
                 return None
 
             q2 = rewrite_mlt_in_body(body["query"], _lookup)

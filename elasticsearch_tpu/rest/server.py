@@ -2609,11 +2609,68 @@ def _field_stats(n: Node, p, b, index: str):
                 cur[k] = (add[k] if cur.get(k) is None
                           else fn(cur[k], add[k]))
 
+    def _dist_fields(c, name: str) -> Dict[str, dict]:
+        """Fan to each primary owner (its primary shards only — replica
+        copies would double doc counts) and merge with _bump."""
+        import json as _json_mod
+
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+        from urllib.parse import quote
+
+        meta = c.data._meta(name)
+        by_owner: Dict[str, list] = {}
+        for sid in range(meta["num_shards"]):
+            owners = meta["assignment"][str(sid)]
+            if owners:
+                by_owner.setdefault(owners[0], []).append(sid)
+        fields: Dict[str, dict] = {}
+        for owner, sids in sorted(by_owner.items()):
+            params = {"level": "indices",
+                      "_shards": ",".join(map(str, sids))}
+            if want is not None:
+                # filter at the SOURCE: owners must not compute + ship
+                # stats for fields the request never asked about
+                params["fields"] = ",".join(want)
+            req = {"method": "GET",
+                   "path": f"/{quote(name, safe='')}/_field_stats",
+                   "params": params, "body": _json_mod.dumps(body)}
+            try:
+                if owner == c.data._local_id():
+                    res = c.data._on_rest_proxy(dict(req))
+                else:
+                    res = c.data._send(owner, ACTION_REST_PROXY, dict(req))
+            except Exception:
+                continue  # dead owner: its shards' stats are unavailable
+            if res["status"] != 200:
+                continue
+            for fname, st in res["payload"].get("indices", {}).get(
+                    name, {}).get("fields", {}).items():
+                st.pop("density", None)  # recomputed after the merge
+                _bump(fields.setdefault(fname, {}), st)
+        return fields
+
+    sh_filter = p.get("_shards")  # internal: the multi-host fan's filter
+    shard_ids = ([int(i) for i in sh_filter.split(",")]
+                 if sh_filter else None)
+    c = _mh(n)
     out = {}
     for name in n.resolve_indices(index):
+        if c is not None and not p.get("_local_only") \
+                and name in c.dist_indices:
+            fields = _dist_fields(c, name)
+            for st in fields.values():
+                md = st.get("max_doc", 0)
+                st["density"] = (int(100 * st.get("doc_count", 0) / md)
+                                 if md else 0)
+            if want is not None:
+                fields = {k: v for k, v in fields.items() if k in want}
+            out[name] = {"fields": fields}
+            continue
         svc = n.indices[name]
         fields: Dict[str, dict] = {}
-        for shard in svc.shards:
+        shard_list = (svc.shards if shard_ids is None
+                      else [svc.shards[i] for i in shard_ids])
+        for shard in shard_list:
             for seg in shard.segments:
                 md = int(seg.num_docs)
                 for fname, col in seg.numerics.items():

@@ -1418,7 +1418,13 @@ def rewrite_mlt_in_body(query_dsl, lookup):
         return query_dsl
 
     def fields_of(spec):
-        return spec.get("fields") or None
+        flds = spec.get("fields") or None
+        # _all has no _source key — it means "every field's text", which
+        # is exactly the unfiltered source (the parser's doc branch takes
+        # all scalar values, matching _texts_of's _all concatenation)
+        if flds and "_all" in flds:
+            return None
+        return flds
 
     def resolve(spec):
         changed = False

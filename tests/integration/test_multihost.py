@@ -676,6 +676,13 @@ def test_doc_level_and_scroll_ops_cross_host(master):
             "aggs": {"x": {"terms": {"field": "body"}}}})
         assert st == 400, (st, r)
 
+        # field_stats merges across owners (doc_count must be the
+        # cluster-wide 30, not a local subset or a replica-doubled 60)
+        st, r = req("GET", "/dlo/_field_stats?fields=body&level=indices")
+        assert st == 200, r
+        fs = r["indices"]["dlo"]["fields"]["body"]
+        assert fs["doc_count"] == 30, fs
+
         # more_like_this with a liked id resolves via the ROUTED get even
         # when the liked doc lives on the remote owner, and matches docs
         # cluster-wide (both shards)

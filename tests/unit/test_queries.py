@@ -307,3 +307,33 @@ def test_mlt_liked_id_resolves_across_shards():
         assert "seed" in ids and len(ids) == 13, ids
     finally:
         n.close()
+
+
+def test_mlt_liked_id_with_all_fields():
+    """fields: ['_all'] (and no fields at all) must use the liked doc's
+    whole source — the rewrite must not filter the source down to a
+    literal '_all' key (which no source has)."""
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    try:
+        n.create_index("mlta", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"a": {"type": "text"},
+                                        "b": {"type": "text"}}}})
+        svc = n.indices["mlta"]
+        svc.index_doc("seed", {"a": "copper wire", "b": "solder flux"})
+        svc.index_doc("m1", {"a": "copper wire coil"})
+        svc.index_doc("m2", {"b": "solder flux paste"})
+        svc.index_doc("x", {"a": "green tea"})
+        svc.refresh()
+        for fields in (["_all"], None):
+            q = {"more_like_this": {"like": [{"_id": "seed"}],
+                                    "min_term_freq": 1, "min_doc_freq": 1}}
+            if fields:
+                q["more_like_this"]["fields"] = fields
+            r = n.search("mlta", {"query": q, "size": 10})
+            ids = {h["_id"] for h in r["hits"]["hits"]}
+            assert ids == {"m1", "m2"}, (fields, ids)
+    finally:
+        n.close()
