@@ -346,12 +346,36 @@ class IndexService:
         return str(idx.get("search", {}).get("mesh", True)).lower() != "false"
 
     def mlt_source(self, doc_id: str, routing=None, index=None):
-        """Whole-index source lookup for more_like_this liked ids — scans
-        every shard (a routed doc doesn't live at its id-hash shard; the
-        routing hint is unnecessary here). A like item naming a DIFFERENT
-        index is left for a node-level resolver."""
+        """Whole-index source lookup for doc-referencing queries (MLT
+        liked ids, terms lookup, indexed_shape) — scans every shard (a
+        routed doc doesn't live at its id-hash shard; the routing hint is
+        unnecessary here). A reference naming a DIFFERENT index resolves
+        through the owning node (terms lookup / indexed_shape registries
+        usually live in their own index)."""
         if index is not None and index != self.name \
                 and index not in self.aliases:
+            node = getattr(self, "_node", None)
+            if node is None:
+                return None
+            mh = getattr(node, "multihost", None)
+            for nm in node.resolve_indices(index):
+                if mh is not None and nm in mh.dist_indices:
+                    # a DISTRIBUTED registry index: this host's local
+                    # copy holds only its own shards — the lookup doc
+                    # must come through the routed cross-host get
+                    try:
+                        got = mh.data.get_doc(nm, str(doc_id),
+                                              routing=routing)
+                    except Exception:
+                        continue
+                    if got.get("found"):
+                        return got.get("_source")
+                    continue
+                svc = node.indices.get(nm)
+                if svc is not None and svc is not self:
+                    src = svc.mlt_source(doc_id, routing=routing)
+                    if src is not None:
+                        return src
             return None
         for sh in self.shards:
             got = sh.engine.get(str(doc_id))
@@ -416,6 +440,7 @@ class IndexService:
 
         if self._percolator is None:
             self._percolator = PercolatorRegistry()
+            self._percolator.doc_lookup = self.mlt_source
         return self._percolator
 
     def percolate(self, body: dict) -> dict:

@@ -117,6 +117,7 @@ class Node:
                 continue
             svc.aliases = dict(meta.get("aliases", {}))
             svc.closed = bool(meta.get("closed", False))
+            svc._node = self  # foreign-index doc lookups (terms lookup)
             self.indices[name] = svc
             self.cluster_state.add_index(
                 IndexMetadata(name, svc.settings, meta.get("mappings", {}),
@@ -152,6 +153,7 @@ class Node:
         _deep_merge(merged_settings, settings)
         _deep_merge(merged_mappings, mappings)
         svc = IndexService(name, merged_settings, merged_mappings, data_path=self.data_path)
+        svc._node = self  # foreign-index doc lookups (terms lookup)
         # aliases with `routing` fan it into index/search routing, like
         # IndicesAliasesRequest does
         for spec in aliases.values():
@@ -448,21 +450,19 @@ class Node:
             from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
 
             def _lookup(doc_id, routing=None, index=None):
-                # mlt_source's own index check handles aliases, and an
-                # explicitly-named index must NEVER fall back to a
-                # different index's same-id document
-                for nm in searched_names:
-                    src = self.indices[nm].mlt_source(
+                # mlt_source's own index check handles aliases AND
+                # delegates foreign names through the node, so one call
+                # covers explicit-_index references; an explicitly-named
+                # index never falls back to a different index's same-id
+                # document
+                if index:
+                    return self.indices[searched_names[0]].mlt_source(
                         doc_id, routing=routing, index=index)
+                for nm in searched_names:
+                    src = self.indices[nm].mlt_source(doc_id,
+                                                      routing=routing)
                     if src is not None:
                         return src
-                if index:
-                    for nm in self.resolve_indices(index):
-                        svc = self.indices.get(nm)
-                        if svc is not None:
-                            src = svc.mlt_source(doc_id, routing=routing)
-                            if src is not None:
-                                return src
                 return None
 
             q2 = rewrite_mlt_in_body(body["query"], _lookup)

@@ -575,7 +575,17 @@ def parse_geo_query(qtype: str, body: dict) -> Query:
     if qtype == "geo_shape":
         ignore = body.pop("ignore_unmapped", None)  # noqa: F841
         (field, spec), = body.items()
-        shape = spec.get("shape") or spec.get("indexed_shape")
+        ind = spec.get("indexed_shape")
+        if isinstance(ind, dict) and "shape" not in spec:
+            # the pre-search rewrite (queries.rewrite_mlt_in_body)
+            # resolves indexed_shape via a whole-index doc fetch; still
+            # seeing it here means the registered shape doc is missing
+            # (a malformed non-dict value falls through to the generic
+            # inline-shape error below)
+            raise QueryParsingException(
+                f"indexed shape [{ind.get('index')}/{ind.get('type')}/"
+                f"{ind.get('id')}] not found")
+        shape = spec.get("shape")
         if shape is None or "type" not in shape:
             raise QueryParsingException("geo_shape requires an inline [shape]")
         return GeoShapeQuery(field, shape, spec.get("relation", "intersects"))

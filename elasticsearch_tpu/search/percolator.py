@@ -39,20 +39,30 @@ class PercolatorRegistry:
 
         self._queries: Dict[str, Any] = {}  # id -> (raw dsl, parsed Query)
         self._lock = threading.Lock()  # REST server is threaded
+        # whole-index doc lookup for doc-referencing query forms (terms
+        # lookup / indexed_shape / MLT ids) — set by the owning
+        # IndexService; registration-time resolution matches the
+        # reference's percolator, which parses queries with a full
+        # QueryParseContext
+        self.doc_lookup = None
 
-    @staticmethod
-    def validate(source: dict):
+    def validate(self, source: dict):
         """Parse the query WITHOUT registering — called before the doc is
         persisted so an invalid percolator doc never reaches the translog."""
         if not isinstance(source, dict) or "query" not in source:
             raise ElasticsearchTpuException(
                 "percolator document requires a [query] field")
-        return parse_query(source["query"])
+        q = source["query"]
+        if self.doc_lookup is not None:
+            from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
+
+            q = rewrite_mlt_in_body(q, self.doc_lookup)
+        return q, parse_query(q)
 
     def register(self, doc_id: str, source: dict) -> None:
-        parsed = self.validate(source)
+        raw, parsed = self.validate(source)
         with self._lock:
-            self._queries[doc_id] = (source["query"], parsed)
+            self._queries[doc_id] = (raw, parsed)
 
     def unregister(self, doc_id: str) -> None:
         with self._lock:

@@ -1400,22 +1400,79 @@ class MoreLikeThisQuery(Query):
         return out_s, out_m
 
 
+def _doc_path_values(src, path: str) -> list:
+    """Dot-path extraction over a source dict, flattening lists — the
+    reference's XContentMapValues.extractRawValues used by terms lookup."""
+    cur = [src]
+    for part in str(path).split("."):
+        nxt = []
+        for c in cur:
+            if isinstance(c, dict) and part in c:
+                v = c[part]
+                nxt.extend(v if isinstance(v, list) else [v])
+        cur = nxt
+    return cur
+
+
 def rewrite_mlt_in_body(query_dsl, lookup):
-    """Resolve more_like_this liked-DOCUMENT ids into inline doc texts
-    BEFORE the query fans out to shards: per-segment execution can only
-    see the liked doc on its own shard, so without this pre-pass MLT by
-    id silently matched nothing outside that shard. `lookup(doc_id)` is
-    the whole-index (or cross-host routed) source fetch; resolved like
-    ids stay excluded from results via the internal `_exclude_ids` key.
-    Returns a rewritten copy, or the input unchanged when no MLT clause
-    carries ids. `lookup(doc_id, routing=None, index=None)` honors a like
-    item's own routing/_index keys — an id-hash get without the doc's
-    custom routing misses, exactly as the reference's liked-doc GET does.
-    Reference: TransportMoreLikeThisAction — GET the liked doc, then
-    build the fanned-out text query.
+    """Resolve DOCUMENT references inside a query BEFORE it fans out to
+    shards — per-segment execution can only see a referenced doc on its
+    own shard, so without this pre-pass these forms silently degrade:
+
+    - more_like_this liked ids → inline doc texts (previously matched
+      only within the liked doc's own shard); resolved ids stay
+      excluded via `_exclude_ids`. Reference:
+      TransportMoreLikeThisAction — GET the liked doc, then query.
+    - terms LOOKUP ({"terms": {f: {index, type, id, path}}}) → the
+      literal term list extracted at `path` (a missing doc resolves to
+      an empty list = matches nothing, as the reference's TermsLookup
+      does). Previously the spec dict's KEYS were iterated as terms.
+    - geo_shape indexed_shape → the inline shape fetched from the
+      registered-shapes doc (reference: GeoShapeQueryBuilder fetch).
+      Unresolvable stays as indexed_shape and the geo parser raises.
+
+    `lookup(doc_id, routing=None, index=None)` honors each item's own
+    routing/_index keys — an id-hash get without the doc's custom
+    routing misses, exactly as the reference's GET does. Returns a
+    rewritten copy, or the input unchanged.
     """
     if not isinstance(query_dsl, dict):
         return query_dsl
+
+    def resolve_terms(spec):
+        out = None
+        for field, v in spec.items():
+            if not (isinstance(v, dict) and v.get("id") is not None
+                    and ("path" in v or "index" in v)):
+                continue
+            src = lookup(str(v["id"]), routing=v.get("routing"),
+                         index=v.get("index"))
+            vals = ([] if src is None
+                    else [x for x in _doc_path_values(src,
+                                                      v.get("path", field))
+                          if not isinstance(x, (dict, list))])
+            if out is None:
+                out = dict(spec)
+            out[field] = vals
+        return out if out is not None else spec
+
+    def resolve_shape(spec):
+        for field, v in spec.items():
+            ind = v.get("indexed_shape") if isinstance(v, dict) else None
+            if not (isinstance(ind, dict) and ind.get("id") is not None):
+                continue
+            src = lookup(str(ind["id"]), routing=ind.get("routing"),
+                         index=ind.get("index"))
+            if src is None:
+                continue  # stays indexed_shape → geo parser raises
+            got = _doc_path_values(src, ind.get("path", "shape"))
+            if got and isinstance(got[0], dict):
+                nv = {k: x for k, x in v.items() if k != "indexed_shape"}
+                nv["shape"] = got[0]
+                out = dict(spec)
+                out[field] = nv
+                return out
+        return spec
 
     def fields_of(spec):
         flds = spec.get("fields") or None
@@ -1490,6 +1547,10 @@ def rewrite_mlt_in_body(query_dsl, lookup):
             for k, v in node.items():
                 if k in ("more_like_this", "mlt") and isinstance(v, dict):
                     nv = resolve(v)
+                elif k == "terms" and isinstance(v, dict):
+                    nv = resolve_terms(v)
+                elif k == "geo_shape" and isinstance(v, dict):
+                    nv = resolve_shape(v)
                 else:
                     nv = walk(v)
                 if nv is not v:

@@ -337,3 +337,75 @@ def test_mlt_liked_id_with_all_fields():
             assert ids == {"m1", "m2"}, (fields, ids)
     finally:
         n.close()
+
+
+def test_terms_lookup_resolves_across_shards():
+    """{"terms": {f: {index, type, id, path}}} fetches the term list from
+    a registered doc (possibly on another shard/index) — reference:
+    TermsLookup. A missing lookup doc matches nothing; previously the
+    spec dict's KEYS were silently iterated as terms."""
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    try:
+        n.create_index("users", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {
+                "followers": {"type": "keyword"}}}})
+        n.create_index("tweets", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"user": {"type": "keyword"}}}})
+        n.indices["users"].index_doc(
+            "u1", {"followers": ["alice", "bob"]})
+        for i, who in enumerate(["alice", "bob", "carol", "dave"]):
+            n.indices["tweets"].index_doc(str(i), {"user": who})
+        n.indices["users"].refresh()
+        n.indices["tweets"].refresh()
+        r = n.search("tweets", {"query": {"terms": {"user": {
+            "index": "users", "type": "t", "id": "u1",
+            "path": "followers"}}}, "size": 10})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"0", "1"}, \
+            r["hits"]
+        # missing lookup doc: matches nothing (no error)
+        r = n.search("tweets", {"query": {"terms": {"user": {
+            "index": "users", "type": "t", "id": "nope",
+            "path": "followers"}}}})
+        assert r["hits"]["total"] == 0
+    finally:
+        n.close()
+
+
+def test_geo_shape_indexed_shape_resolves():
+    """indexed_shape fetches the registered shape doc's geometry; a
+    missing shape doc raises a clear error."""
+    import pytest as _pytest
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+    n = Node()
+    try:
+        n.create_index("shapes", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"shape": {"type": "geo_shape"}}}})
+        n.create_index("places", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"loc": {"type": "geo_point"}}}})
+        n.indices["shapes"].index_doc("box1", {"shape": {
+            "type": "envelope", "coordinates": [[0.0, 10.0], [10.0, 0.0]]}})
+        n.indices["places"].index_doc("in", {"loc": {"lat": 5.0, "lon": 5.0}})
+        n.indices["places"].index_doc("out", {"loc": {"lat": 50.0, "lon": 50.0}})
+        n.indices["shapes"].refresh()
+        n.indices["places"].refresh()
+        r = n.search("places", {"query": {"geo_shape": {"loc": {
+            "indexed_shape": {"index": "shapes", "type": "t",
+                              "id": "box1", "path": "shape"}}}},
+            "size": 10})
+        assert {h["_id"] for h in r["hits"]["hits"]} == {"in"}, r["hits"]
+        with _pytest.raises(ElasticsearchTpuException,
+                            match="not found"):
+            n.search("places", {"query": {"geo_shape": {"loc": {
+                "indexed_shape": {"index": "shapes", "type": "t",
+                                  "id": "absent"}}}}})
+    finally:
+        n.close()
