@@ -1022,31 +1022,23 @@ class DistributedDataService:
                 self.node.create_index(index, payload.get("body"))
         res = self._send(payload["source"], ACTION_SHARD_SYNC,
                          {"index": index, "shard": sid}, timeout=60.0)
-        engine = self.node.indices[index].shards[sid].engine
+        svc = self.node.indices[index]
         copied = skipped = 0
         from elasticsearch_tpu.utils.errors import (DocumentMissingException,
                                                     VersionConflictException)
 
         for d in res["docs"]:
             try:
-                if d.get("deleted"):
-                    # tombstones ride the stream too, so a delete that
-                    # landed on the source after a racing fanout index on
-                    # this copy still wins by version
-                    engine.delete(d["id"], version=d["version"],
-                                  version_type="external_gte")
-                else:
-                    engine.index(d["id"], d["source"], version=d["version"],
-                                 version_type="external_gte",
-                                 doc_type=d.get("type"),
-                                 parent=d.get("parent"),
-                                 routing=d.get("routing"),
-                                 ttl_expiry=d.get("ttl_expiry"),
-                                 timestamp=d.get("timestamp"), _replay=True)
+                # docs AND tombstones ride the stream (a delete that
+                # landed on the source after a racing fanout index on
+                # this copy still wins by version); percolator-registry
+                # maintenance happens atomically with the engine op
+                # (IndexService.replay_op)
+                svc.replay_op(sid, d)
                 copied += 1
             except (VersionConflictException, DocumentMissingException):
                 skipped += 1  # already newer here (a racing replica write)
-        engine.refresh()
+        svc.shards[sid].engine.refresh()
         return {"copied": copied, "skipped": skipped}
 
     def _on_shard_sync(self, payload: dict) -> dict:

@@ -345,6 +345,44 @@ class IndexService:
         idx = self.settings.get("index", self.settings)
         return str(idx.get("search", {}).get("mesh", True)).lower() != "false"
 
+    def replay_op(self, shard_ord: int, d: dict) -> None:
+        """Apply ONE replayed op (the cross-host recovery stream's doc or
+        tombstone) at engine level WITH percolator-registry maintenance.
+        The whole decision runs under the engine lock: was-percolator is
+        read pre-op, is-percolator re-read post-op, so a racing fanout
+        write can neither leave a stale registration (doc re-created as a
+        non-percolator type) nor lose one. Version conflicts propagate —
+        the caller counts them as already-newer skips. Boot-time recovery
+        instead bulk-rebuilds the registry in recover() above."""
+        from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
+
+        engine = self.shards[shard_ord].engine
+        with engine._lock:
+            loc = engine._locations.get(d["id"])
+            was_perc = (loc is not None and not loc.deleted
+                        and loc.doc_type == PERCOLATOR_TYPE)
+            if d.get("deleted"):
+                engine.delete(d["id"], version=d["version"],
+                              version_type="external_gte")
+            else:
+                engine.index(d["id"], d["source"], version=d["version"],
+                             version_type="external_gte",
+                             doc_type=d.get("type"),
+                             parent=d.get("parent"),
+                             routing=d.get("routing"),
+                             ttl_expiry=d.get("ttl_expiry"),
+                             timestamp=d.get("timestamp"), _replay=True)
+            now = engine._locations.get(d["id"])
+            is_perc = (now is not None and not now.deleted
+                       and now.doc_type == PERCOLATOR_TYPE)
+            if is_perc:
+                try:
+                    self.percolator.register(d["id"], d["source"])
+                except Exception:
+                    pass  # invalid legacy query: not registered
+            elif was_perc:
+                self.percolator.unregister(d["id"])
+
     def mlt_source(self, doc_id: str, routing=None, index=None):
         """Whole-index source lookup for doc-referencing queries (MLT
         liked ids, terms lookup, indexed_shape) — scans every shard (a

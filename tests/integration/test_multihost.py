@@ -823,6 +823,58 @@ def test_three_process_replication_and_reheal(master):
         p2.wait()
 
 
+def test_percolator_registry_survives_recovery_stream(master):
+    """A node that recovers a shard via the ops stream must also rebuild
+    its in-memory percolator registry (the stream replays at engine
+    level, bypassing the svc write path that maintains it) — otherwise a
+    promoted copy serves percolates with an empty registry."""
+    from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+    node, c = master
+    # alone: register percolator queries (+ delete one so its tombstone
+    # rides the stream too)
+    c.data.create_index("pcr", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+        "mappings": {"properties": {"body": {"type": "text"}}}})
+    for qid, term in (("pq1", "hawk"), ("pq2", "owl"), ("dead", "crow")):
+        c.data.index_doc("pcr", qid, {"query": {"match": {"body": term}}},
+                         doc_type=".percolator")
+    c.data.delete_doc("pcr", "dead")
+    c.data.refresh("pcr")
+
+    p = _spawn_rank1(c.master_addr[1])
+    try:
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        assert _wait(lambda: all(
+            len(o) == 2 for o in
+            c.dist_indices["pcr"]["assignment"].values()), timeout=10.0)
+        rank1 = next(nid for nid in node.cluster_state.nodes
+                     if nid != c.local.node_id)
+        import json as json_mod
+
+        def _rank1_percolate():
+            try:
+                res = c.data._send(rank1, ACTION_REST_PROXY, {
+                    "method": "POST", "path": "/pcr/t/_percolate",
+                    "params": {},
+                    "body": json_mod.dumps(
+                        {"doc": {"body": "hawk and owl and crow"}})})
+            except Exception:
+                return None
+            if res["status"] != 200:
+                return None
+            return sorted(m["_id"] for m in res["payload"]["matches"])
+
+        # poll: the recovery stream runs async after the join; the NEW
+        # node's own registry must match both live queries and NOT the
+        # deleted one
+        assert _wait(lambda: _rank1_percolate() == ["pq1", "pq2"],
+                     timeout=20.0), _rank1_percolate()
+    finally:
+        p.kill()
+        p.wait()
+
+
 def test_jax_distributed_initialize_smoke():
     """--coordinator path: jax.distributed.initialize with a 1-process world
     (in a subprocess — it must run before any JAX computation)."""
