@@ -83,6 +83,9 @@ class MultiHostCluster:
         # carried on join replies and publishes (the routing-table slice of
         # the reference's published ClusterState)
         self.dist_indices: dict = {}
+        # names this process has adopted as distributed — a name that
+        # disappears from a publish was deleted cluster-wide
+        self._dist_known: set = set()
         from elasticsearch_tpu.cluster.search_action import \
             DistributedDataService
 
@@ -171,6 +174,17 @@ class MultiHostCluster:
             if version <= self._indices_adopted:
                 return
             self._indices_adopted = version
+            # an index that LEFT the published metadata was deleted
+            # cluster-wide: remove the local copy (only names this process
+            # adopted as distributed — a coordinator-local index never
+            # enters _dist_known and is never touched)
+            for gone in self._dist_known - set(meta):
+                if gone in self.node.indices:
+                    try:
+                        self.node._delete_local_index(gone)
+                    except Exception:
+                        pass
+            self._dist_known = set(meta)
             self.dist_indices = meta
             for name, spec in meta.items():
                 if not self.node.index_exists(name):
@@ -180,6 +194,14 @@ class MultiHostCluster:
                     # REPLACE (not update) the local map so alias removals
                     # propagate instead of being resurrected each publish
                     self.node.indices[name].aliases = dict(spec["aliases"])
+                if name in self.node.indices and \
+                        bool(spec.get("closed")) \
+                        != self.node.indices[name].closed:
+                    from elasticsearch_tpu.cluster.metadata import (
+                        close_index, open_index)
+
+                    (close_index if spec.get("closed")
+                     else open_index)(self.node, name)
 
     def publish_indices(self) -> None:
         self._bump_indices_version()

@@ -47,6 +47,8 @@ ACTION_UPDATE = "indices:data/write/update"
 ACTION_GET = "indices:data/read/get"
 ACTION_REFRESH = "indices:admin/refresh"
 ACTION_CREATE = "indices:admin/create"
+ACTION_DELETE_INDEX = "indices:admin/delete"
+ACTION_SET_CLOSED = "indices:admin/set_closed"
 ACTION_RECOVER = "indices:recovery/start"
 ACTION_SHARD_SYNC = "indices:recovery/shard_sync"
 ACTION_SHARD_FAILED = "cluster:shard_failed"
@@ -85,6 +87,8 @@ class DistributedDataService:
         t.register(ACTION_GET, self._on_get)
         t.register(ACTION_REFRESH, self._on_refresh)
         t.register(ACTION_CREATE, self._on_create)
+        t.register(ACTION_DELETE_INDEX, self._on_delete_index)
+        t.register(ACTION_SET_CLOSED, self._on_set_closed)
         t.register(ACTION_RECOVER, self._on_recover)
         t.register(ACTION_SHARD_SYNC, self._on_shard_sync)
         t.register(ACTION_SHARD_FAILED, self._on_shard_failed)
@@ -168,11 +172,12 @@ class DistributedDataService:
             nodes = sorted(self.node.cluster_state.nodes)
             settings = dict(body.get("settings") or {})
             num_shards = int(settings.get("number_of_shards", 1))
-            # number_of_replicas means CROSS-HOST copies here; the local
-            # body gets 0 so each process holds plain single-copy shards
-            # (in-process replica groups are the single-node HA mechanism,
-            # not this one)
-            replicas = int(settings.pop("number_of_replicas", 0))
+            # number_of_replicas means CROSS-HOST copies here: the
+            # declared count STAYS in the settings (echo, _shards math)
+            # while the internal _local_replicas=0 marker stops each
+            # process from also materializing in-process replica groups
+            replicas = int(settings.get("number_of_replicas", 0))
+            settings["_local_replicas"] = 0
             local_body = dict(body)
             local_body["settings"] = settings
             assignment = {}
@@ -208,6 +213,53 @@ class DistributedDataService:
         self.cluster.publish_indices()
         return {"acknowledged": True, "index": name,
                 "assignment": assignment, "local_body": local_body}
+
+    def set_closed(self, name: str, closed: bool) -> dict:
+        """Mark a distributed index open/closed in the published metadata
+        (reference: MetaDataIndexStateService — open/close is cluster
+        state, not a node-local flag). Peers apply it on adopt."""
+        if not self.cluster.is_master:
+            return self.cluster.transport.send_remote(
+                self.cluster.master_addr, ACTION_SET_CLOSED,
+                {"name": name, "closed": closed})
+        return self._on_set_closed({"name": name, "closed": closed})
+
+    def _on_set_closed(self, payload: dict) -> dict:
+        from elasticsearch_tpu.cluster.metadata import (close_index,
+                                                        open_index)
+
+        name, closed = payload["name"], payload["closed"]
+        with self.cluster._indices_lock:
+            meta = self.cluster.dist_indices.get(name)
+            if meta is not None:
+                meta["closed"] = bool(closed)
+            if self.node.index_exists(name):
+                (close_index if closed else open_index)(self.node, name)
+        self.cluster.publish_indices()
+        return {"acknowledged": True}
+
+    def delete_index(self, name: str) -> dict:
+        """Delete a distributed index CLUSTER-WIDE: the master drops it
+        from the published metadata (peers remove their local copies on
+        the next publish — bootstrap._adopt_indices) and deletes its own
+        copy. Reference: MetaDataDeleteIndexService. Without this, a
+        local-only delete left the metadata alive and the next publish
+        resurrected the index on every peer."""
+        if not self.cluster.is_master:
+            return self.cluster.transport.send_remote(
+                self.cluster.master_addr, ACTION_DELETE_INDEX,
+                {"name": name})
+        return self._on_delete_index({"name": name})
+
+    def _on_delete_index(self, payload: dict) -> dict:
+        name = payload["name"]
+        with self.cluster._indices_lock:
+            self.cluster.dist_indices.pop(name, None)
+            if self.node.index_exists(name):
+                # bypass Node.delete_index's dist routing (we ARE it)
+                self.node._delete_local_index(name)
+        self.cluster.publish_indices()
+        return {"acknowledged": True}
 
     def refresh(self, index: str) -> None:
         index = self.resolve_index(index)
@@ -913,19 +965,25 @@ class DistributedDataService:
         return {"status": status, "payload": body}
 
     def get_doc(self, index: str, doc_id: str,
-                routing: Optional[str] = None) -> dict:
+                routing: Optional[str] = None, realtime: bool = True,
+                with_meta: bool = False) -> dict:
         index = self.resolve_index(index)
         meta = self._meta(index)
         owner = self.owner_of(
             index, shard_id_for(doc_id, meta["num_shards"], routing))
         if owner == self._local_id():
-            return self.node.indices[index].get_doc(doc_id, routing=routing)
+            return self.node.indices[index].get_doc(
+                doc_id, routing=routing, realtime=realtime,
+                with_meta=with_meta)
         return self._send(owner, ACTION_GET,
-                          {"index": index, "id": doc_id, "routing": routing})
+                          {"index": index, "id": doc_id, "routing": routing,
+                           "realtime": realtime, "meta": with_meta})
 
     def _on_get(self, payload: dict) -> dict:
         return self.node.indices[payload["index"]].get_doc(
-            payload["id"], routing=payload.get("routing"))
+            payload["id"], routing=payload.get("routing"),
+            realtime=payload.get("realtime", True),
+            with_meta=payload.get("meta", False))
 
     # -- shard recovery / relocation -----------------------------------------
 
@@ -1166,6 +1224,11 @@ class DistributedDataService:
         t0 = time.perf_counter()
         index = self.resolve_index(index)
         meta = self._meta(index)
+        svc0 = self.node.indices.get(index)
+        if svc0 is not None:
+            from elasticsearch_tpu.cluster.metadata import check_open
+
+            check_open(svc0, op="read")  # closed-ness is published state
         local_id = self._local_id()
         # cross-host scroll: the per-owner fetch contexts are one-shot, so
         # the coordinator MATERIALIZES the window (capped at the 10k

@@ -29,6 +29,25 @@ class TransportError(ElasticsearchTpuException):
     error_type = "transport_error"
 
 
+class RemoteException(TransportError):
+    """An ElasticsearchTpuException relayed from a peer: the original
+    type name and HTTP status survive the wire, so a 404 document-missing
+    raised on a shard's owner surfaces as a 404 on the coordinator —
+    never a generic 500 transport_error (reference: netty transport
+    serializes the exception class across nodes). Subclasses
+    TransportError so `except TransportError` call sites keep catching
+    every remote failure."""
+
+    def __init__(self, msg: str, error_type: str, status: int):
+        super().__init__(msg)
+        self._remote_type = error_type
+        self.status = status
+
+    @property
+    def error_type(self) -> str:  # the base derives it from the class name
+        return self._remote_type
+
+
 Handler = Callable[[dict], Any]
 
 
@@ -97,6 +116,10 @@ class TransportService:
         if resp is None:
             raise TransportError(f"connection closed by {address}")
         if not resp.get("ok"):
+            if resp.get("error_type"):
+                raise RemoteException(resp.get("error", "remote failure"),
+                                      resp["error_type"],
+                                      int(resp.get("status", 500)))
             raise TransportError(resp.get("error", "remote failure"))
         return resp.get("result")
 
@@ -126,6 +149,14 @@ class TcpTransportServer:
                         result = service.handle(req.get("action", ""),
                                                 req.get("payload", {}))
                         _send_frame(self.request, {"ok": True, "result": result})
+                    except ElasticsearchTpuException as e:
+                        # typed relay: the caller re-raises with the
+                        # original error_type + HTTP status
+                        _send_frame(self.request, {
+                            "ok": False, "error": str(e),
+                            "error_type": getattr(e, "error_type",
+                                                  "internal_error"),
+                            "status": getattr(e, "status", 500)})
                     except Exception as e:  # handler errors go back as frames
                         _send_frame(self.request, {"ok": False, "error": str(e)})
                 except Exception:

@@ -29,6 +29,15 @@ class IndexService:
         idx_settings = self.settings.get("index", self.settings)
         self.num_shards = int(idx_settings.get("number_of_shards", 1))
         self.num_replicas = int(idx_settings.get("number_of_replicas", 0))
+        # multi-host: replicas are CROSS-HOST copies owned by other
+        # processes; the internal _local_replicas=0 marker keeps this
+        # process from ALSO materializing in-process replica groups while
+        # num_replicas (settings echo, _shards math, cat columns) still
+        # reports the declared count. Popped so it never leaks into the
+        # settings echo.
+        _local = idx_settings.pop("_local_replicas", None)
+        self.local_replicas = (int(_local) if _local is not None
+                               else self.num_replicas)
         self.analysis = AnalysisRegistry(self.settings)
         self.mappings = Mappings(mappings_json or {})
         self._validate_analyzers(self.mappings)
@@ -47,7 +56,7 @@ class IndexService:
         self.groups: List[ReplicationGroup] = []
         for i, primary in enumerate(self.shards):
             replicas = [IndexShard(name, i, self.mappings, self.analysis, None)
-                        for _ in range(self.num_replicas)]
+                        for _ in range(self.local_replicas)]
             self.groups.append(ReplicationGroup(i, primary, replicas))
         self.closed = False
         self._percolator = None
@@ -171,7 +180,7 @@ class IndexService:
             raise RoutingMissingException(self.name, doc_type, str(doc_id))
 
     def get_doc(self, doc_id: str, routing: Optional[str] = None,
-                realtime: bool = True) -> dict:
+                realtime: bool = True, with_meta: bool = False) -> dict:
         from elasticsearch_tpu.cluster.metadata import check_open
 
         check_open(self, op="read")
@@ -181,6 +190,16 @@ class IndexService:
             return {"_index": self.name, "_type": "_doc", "_id": doc_id,
                     "found": False}
         got["_index"] = self.name
+        if with_meta:
+            # location meta rides the response for CROSS-HOST reads: the
+            # coordinator's fields/_routing etc. extraction can't reach a
+            # remote shard's location table
+            loc = shard.engine._locations.get(str(doc_id))
+            if loc is not None:
+                got["_meta"] = {"routing": loc.routing,
+                                "parent": loc.parent,
+                                "timestamp": loc.timestamp,
+                                "ttl_expiry": loc.ttl_expiry}
         return got
 
     def delete_doc(self, doc_id: str, routing: Optional[str] = None, **kw) -> dict:

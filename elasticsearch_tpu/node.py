@@ -177,14 +177,24 @@ class Node:
         found = self.resolve_indices(name)
         if not found:
             raise IndexNotFoundException(name)
+        mh = getattr(self, "multihost", None)
         for n in found:
-            self.indices.pop(n).close()
-            self.cluster_state.remove_index(n)
-            if self.data_path:
-                import shutil
-
-                shutil.rmtree(os.path.join(self.data_path, n), ignore_errors=True)
+            if mh is not None and n in mh.dist_indices:
+                # cluster-wide: drop from the published metadata so peers
+                # remove their copies (a local-only delete would be
+                # resurrected by the next publish)
+                mh.data.delete_index(n)
+            else:
+                self._delete_local_index(n)
         return {"acknowledged": True}
+
+    def _delete_local_index(self, n: str) -> None:
+        self.indices.pop(n).close()
+        self.cluster_state.remove_index(n)
+        if self.data_path:
+            import shutil
+
+            shutil.rmtree(os.path.join(self.data_path, n), ignore_errors=True)
 
     def index_exists(self, name: str) -> bool:
         return name in self.indices or bool(self._alias_targets(name))

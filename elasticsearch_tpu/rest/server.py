@@ -1063,12 +1063,36 @@ def _cat_health(n: Node, p, b):
     }]
 
 
+def _peer_shard_counts(n: Node, c) -> Dict[str, Dict[tuple, tuple]]:
+    """{node_id: {(index, shard): (docs, store)}} from each peer's LOCAL
+    cat-shards rows (the `_local_only` pin makes peers report their own
+    engines) — one round per request, shared by the shard rows."""
+    from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+    out: Dict[str, Dict[tuple, tuple]] = {}
+    for nid in c.data._other_nodes():
+        try:
+            res = c.data._send(nid, ACTION_REST_PROXY, {
+                "method": "GET", "path": "/_cat/shards",
+                "params": {"format": "json"}, "body": ""})
+        except Exception:
+            continue
+        if res["status"] != 200 or not isinstance(res["payload"], list):
+            continue
+        out[nid] = {(row["index"], row["shard"]):
+                    (row.get("docs", "0"), row.get("store", "0b"))
+                    for row in res["payload"]
+                    if row.get("prirep") == "p"}
+    return out
+
+
 def _cat_shards(n: Node, p, b, index: Optional[str] = None):
     """One row per shard COPY (primary + each replica), RestShardsAction
     columns; in-process replicas report STARTED on this node (they are
     real copies here, where a one-node reference cluster shows them
     UNASSIGNED — both shapes are legal cat output)."""
     scope = set(_cat_scope(n, index))
+    c = _mh(n)
     rows = []
     for iname, svc in n.indices.items():
         if iname not in scope:
@@ -1076,6 +1100,51 @@ def _cat_shards(n: Node, p, b, index: Optional[str] = None):
         idx_settings = svc.settings.get("index", svc.settings)
         shadow = str(idx_settings.get("shadow_replicas", "false")
                      ).lower() in ("true", "1")
+        dmeta = (c.dist_indices.get(iname)
+                 if c is not None and not p.get("_local_only") else None)
+        if dmeta is not None:
+            # distributed: rows come from the published assignment —
+            # one per copy, on its owning NODE; declared replicas with
+            # no surviving copy print UNASSIGNED (RoutingTable shape).
+            # docs/store come from the copy's OWNER (the coordinator's
+            # local engine is empty for remote-owned shards)
+            node_names = {nid: dn.name for nid, dn
+                          in n.cluster_state.nodes.items()}
+            init = dmeta.get("initializing", {})
+            peer_counts = _peer_shard_counts(n, c)
+            local_id = c.data._local_id()
+            for sid in range(dmeta["num_shards"]):
+                owners = dmeta["assignment"].get(str(sid), [])
+                pending = init.get(str(sid), [])
+                want = 1 + int(dmeta.get("replicas", 0))
+                for i in range(max(want, len(owners) + len(pending))):
+                    if i < len(owners):
+                        nid = owners[i]
+                        state = "STARTED"
+                    elif i < len(owners) + len(pending):
+                        nid = pending[i - len(owners)]
+                        state = "INITIALIZING"
+                    else:
+                        nid, state = None, "UNASSIGNED"
+                    row = {"index": iname, "shard": str(sid),
+                           "prirep": ("p" if i == 0
+                                      else "s" if shadow else "r"),
+                           "state": state}
+                    if state == "UNASSIGNED":
+                        row.update(docs="", store="", ip="", node="")
+                    else:
+                        if nid == local_id:
+                            docs = str(svc.shards[sid].engine.num_docs)
+                            store = _human_size(sum(
+                                seg.memory_bytes()
+                                for seg in svc.shards[sid].segments))
+                        else:
+                            docs, store = peer_counts.get(nid, {}).get(
+                                (iname, str(sid)), ("0", "0b"))
+                        row.update(docs=docs, store=store, ip="127.0.0.1",
+                                   node=node_names.get(nid, nid or ""))
+                    rows.append(row)
+            continue
         for g in svc.groups:
             for copy in g.copies:
                 docs = copy.engine.num_docs
@@ -1210,6 +1279,22 @@ def _cat_segments(n: Node, p, b, index: Optional[str] = None):
                         "committed": "true", "searchable": "true",
                         "version": "0.1.0", "compound": "false",
                     })
+    c = _mh(n)
+    if c is not None and not p.get("_local_only"):
+        # segments live where the DOCS live: union every peer's local
+        # rows (a dist index's remote-owned shards have no local segments)
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        path = "/_cat/segments" + (f"/{index}" if index else "")
+        for nid in c.data._other_nodes():
+            try:
+                res = c.data._send(nid, ACTION_REST_PROXY, {
+                    "method": "GET", "path": path,
+                    "params": {"format": "json"}, "body": ""})
+            except Exception:
+                continue
+            if res["status"] == 200 and isinstance(res["payload"], list):
+                rows.extend(res["payload"])
     return 200, rows
 
 
@@ -1299,8 +1384,13 @@ def _close_index(n: Node, p, b, index: str):
     names = n.resolve_indices(index)
     if not names:
         raise IndexNotFoundException(index)
+    c = _mh(n)
     for nm in names:
         close_index(n, nm)
+        if c is not None and nm in c.dist_indices:
+            # closed-ness is cluster state: peers adopt it on publish, so
+            # a search scattered to shard owners is refused everywhere
+            c.data.set_closed(nm, True)
     return 200, {"acknowledged": True}
 
 
@@ -1310,8 +1400,11 @@ def _open_index(n: Node, p, b, index: str):
     names = n.resolve_indices(index)
     if not names:
         raise IndexNotFoundException(index)
+    c = _mh(n)
     for nm in names:
         open_index(n, nm)
+        if c is not None and nm in c.dist_indices:
+            c.data.set_closed(nm, False)
     return 200, {"acknowledged": True}
 
 
@@ -1673,11 +1766,28 @@ def _delete_doc_typed(n: Node, p, b, index: str, type: str, id: str):
 def _realtime_kw(n, p, index: str) -> dict:
     """GET-API realtime/refresh params: realtime=false reads only
     refreshed state; refresh=true refreshes first (GetRequest.realtime/
-    refresh)."""
+    refresh). refresh on a distributed index refreshes CLUSTER-wide."""
     if str(p.get("refresh", "false")).lower() in ("", "true", "1"):
-        n.get_index(index).refresh()
+        data = _mh_for(n, index)
+        if data is not None:
+            data.refresh(index)
+        else:
+            n.get_index(index).refresh()
     rt = str(p.get("realtime", "true")).lower() not in ("false", "0")
     return {"realtime": rt}
+
+
+def _loc_from_meta(meta):
+    """A location-shaped view over the `_meta` dict a cross-host get
+    attaches (the coordinator can't reach a remote shard's table)."""
+    if not meta:
+        return None
+    from types import SimpleNamespace
+
+    return SimpleNamespace(routing=meta.get("routing"),
+                           parent=meta.get("parent"),
+                           timestamp=meta.get("timestamp"),
+                           ttl_expiry=meta.get("ttl_expiry"))
 
 
 def _get_doc(n: Node, p, b, index: str, id: str):
@@ -1686,16 +1796,17 @@ def _get_doc(n: Node, p, b, index: str, id: str):
     data = _mh_for(n, index)
     if data is not None:
         # cross-host routed read, then the SAME response shaping as the
-        # local path (version-checked reads, _source filtering, fields) —
-        # the meta-field lookups that need the local engine location are
-        # unavailable for remote docs and simply absent
+        # local path; location meta (routing/parent/timestamp/ttl) rides
+        # the response so the fields extraction below works for remote docs
         r = data.get_doc(index, id,
-                         routing=p.get("routing") or p.get("parent"))
-        svc = None
+                         routing=p.get("routing") or p.get("parent"),
+                         with_meta=True, **_realtime_kw(n, p, index))
+        loc = _loc_from_meta(r.pop("_meta", None))
     else:
         svc = n.get_index(index)
         r = svc.get_doc(id, routing=p.get("routing") or p.get("parent"),
                         **_realtime_kw(n, p, index))
+        loc = svc.route(id, p.get("routing")).engine._locations.get(str(id))
     if not r.get("found"):
         return 404, r
     if "version" in p and p.get("version_type") != "force" \
@@ -1727,8 +1838,6 @@ def _get_doc(n: Node, p, b, index: str, id: str):
     fields = p.get("fields")
     if fields:
         names = [f.strip() for f in fields.split(",") if f.strip()]
-        loc = (svc.route(id, p.get("routing")).engine._locations.get(str(id))
-               if svc is not None else None)
         src = r.get("_source") or {}
         out: Dict[str, Any] = {}
         for f in names:
@@ -1832,26 +1941,12 @@ def _update_doc(n: Node, p, b, index: str, id: str,
         kw["timestamp"] = p["timestamp"]
     if p.get("ttl"):
         kw["ttl"] = p["ttl"]
-    data = _mh_for(n, index)
-    if data is not None:
-        # routed to the primary owner — the partial-update merge must
-        # read the current source there
-        r = data.update_doc(index, id, body,
-                            routing=p.get("routing") or p.get("parent"),
-                            doc_type=doc_type, **kw)
-        if _refresh_requested(p):
-            data.refresh(index)
-        return 200, r
-    svc = n.get_or_autocreate(index)
-    r = svc.update_doc(id, body,
-                       routing=p.get("routing") or p.get("parent"),
-                       doc_type=doc_type, **kw)
     fields = p.get("fields") or body.get("fields")
-    if fields:
+
+    def _get_env(got) -> Dict[str, Any]:
         # UpdateResponse "get" envelope (UpdateHelper.extractGetResult)
         names = ([f.strip() for f in fields.split(",")]
                  if isinstance(fields, str) else list(fields))
-        got = svc.get_doc(id, routing=p.get("routing"))
         env: Dict[str, Any] = {"found": bool(got.get("found"))}
         src = got.get("_source") or {}
         fl: Dict[str, Any] = {}
@@ -1866,7 +1961,27 @@ def _update_doc(n: Node, p, b, index: str, id: str,
                 fl[f] = cur if isinstance(cur, list) else [cur]
         if fl:
             env["fields"] = fl
-        r["get"] = env
+        return env
+
+    data = _mh_for(n, index)
+    if data is not None:
+        # routed to the primary owner — the partial-update merge must
+        # read the current source there
+        r = data.update_doc(index, id, body,
+                            routing=p.get("routing") or p.get("parent"),
+                            doc_type=doc_type, **kw)
+        if fields:
+            r["get"] = _get_env(data.get_doc(
+                index, id, routing=p.get("routing") or p.get("parent")))
+        if _refresh_requested(p):
+            data.refresh(index)
+        return 200, r
+    svc = n.get_or_autocreate(index)
+    r = svc.update_doc(id, body,
+                       routing=p.get("routing") or p.get("parent"),
+                       doc_type=doc_type, **kw)
+    if fields:
+        r["get"] = _get_env(svc.get_doc(id, routing=p.get("routing")))
     if _refresh_requested(p):
         svc.refresh()
     return 200, r
@@ -1972,11 +2087,18 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
     rt = (spec.get("routing") or spec.get("_routing")
           or spec.get("parent") or spec.get("_parent"))
     rt = str(rt) if rt is not None else None
+    # realtime only — the refresh param is handled ONCE per index by the
+    # mget driver, never per doc (a dist refresh fans to every peer)
+    rt_kw = {"realtime":
+             str(p.get("realtime", "true")).lower() not in ("false", "0")}
     data = _mh_for(n, svc.name)
     if data is not None:
-        got = data.get_doc(svc.name, doc_id, routing=rt)
+        got = data.get_doc(svc.name, doc_id, routing=rt, with_meta=True,
+                           **rt_kw)
+        rloc = _loc_from_meta(got.pop("_meta", None))
     else:
-        got = svc.get_doc(doc_id, routing=rt, **_realtime_kw(n, p, iname))
+        got = svc.get_doc(doc_id, routing=rt, **rt_kw)
+        rloc = svc.route(doc_id, rt).engine._locations.get(doc_id)
     got["_index"] = svc.name  # concrete index, even via an alias
     got["_id"] = doc_id
     if (got.get("found") and want_type not in (None, "_all", "_doc")
@@ -1988,7 +2110,7 @@ def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
     flds = spec.get("fields") or spec.get("_fields") or p.get("fields")
     if flds and got.get("found"):
         names = (flds.split(",") if isinstance(flds, str) else list(flds))
-        loc = svc.route(doc_id, rt).engine._locations.get(doc_id)
+        loc = rloc
         src = got.get("_source") or {}
         if "_source" not in names:
             # requesting fields suppresses _source unless asked for
@@ -2050,6 +2172,14 @@ def _mget(n: Node, p, b, index: Optional[str] = None,
             problems.append("index is missing")
     if problems:
         raise ActionRequestValidationException(*problems)
+    if str(p.get("refresh", "false")).lower() in ("", "true", "1"):
+        # ONCE per distinct index, not once per doc — on a distributed
+        # index a refresh fans to every peer
+        for iname in {spec.get("_index", index) for spec in specs}:
+            try:
+                _realtime_kw(n, p, iname)
+            except ElasticsearchTpuException:
+                pass  # a missing index reads as per-doc misses below
     return 200, {"docs": [_mget_one(n, spec, index, p) for spec in specs]}
 
 

@@ -25,38 +25,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-RANK1 = """
-import os, sys, time
-sys.path.insert(0, {repo!r})
-# fresh process: the conftest's in-process axon deregistration does not
-# apply here, and with the TPU tunnel down the plugin blocks jax init —
-# force the CPU guard before anything imports jax
-os.environ["JAX_PLATFORMS"] = "cpu"
-from elasticsearch_tpu.utils.platform import ensure_cpu_if_requested
-ensure_cpu_if_requested()
-from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
-from elasticsearch_tpu.node import Node
-
-node = Node(name={name!r})
-c = MultiHostCluster(node, rank={rank}, world={world}, transport_port={port},
-                     master_host="127.0.0.1", ping_interval=0)
-ids = sorted(node.cluster_state.nodes)
-assert len(ids) == {expect}, ids
-assert node.cluster_state.master_node_id == ids[0], (
-    node.cluster_state.master_node_id, ids)
-assert not c.is_master
-print("JOINED", flush=True)
-line = sys.stdin.readline()  # wait for the test to release us
-if "leave" in line:
-    c.close()
-    print("LEFT", flush=True)
-"""
-
-
-def _member_code(port: int, rank: int = 1, world: int = 2,
-                 expect: int = 2, name: str = "rank1") -> str:
-    return RANK1.format(repo="/root/repo", port=port, rank=rank,
-                        world=world, expect=expect, name=name)
+from tests.integration.multihost_util import member_code as _member_code
 
 
 def _wait(predicate, timeout=10.0, step=0.05):
@@ -821,6 +790,54 @@ def test_three_process_replication_and_reheal(master):
         p1.wait()
         p2.kill()
         p2.wait()
+
+
+def test_delete_index_propagates_cluster_wide(master):
+    """DELETE /{index} on a distributed index must drop it from the
+    published metadata and remove every peer's local copy — a local-only
+    delete would be resurrected by the next publish (and break the
+    coordinator whose svc is gone while dist_indices still routes)."""
+    from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+    node, c = master
+    p = _spawn_rank1(c.master_addr[1])
+    try:
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        c.data.create_index("delme", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"n": {"type": "integer"}}}})
+        for i in range(10):
+            c.data.index_doc("delme", str(i), {"n": i})
+        c.data.refresh("delme")
+        rank1 = next(nid for nid in node.cluster_state.nodes
+                     if nid != c.local.node_id)
+        node.delete_index("delme")
+        assert "delme" not in c.dist_indices
+        assert "delme" not in node.indices
+
+        def _rank1_has():
+            try:
+                res = c.data._send(rank1, ACTION_REST_PROXY, {
+                    "method": "GET", "path": "/delme", "params": {},
+                    "body": ""})
+            except Exception:
+                return None
+            return res["status"]
+
+        # the peer removes its copy on the next publish
+        assert _wait(lambda: _rank1_has() == 404, timeout=10.0), \
+            _rank1_has()
+        # re-creating the name works cleanly afterwards
+        c.data.create_index("delme", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"n": {"type": "integer"}}}})
+        c.data.index_doc("delme", "1", {"n": 1})
+        c.data.refresh("delme")
+        r = c.data.search("delme", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 1
+    finally:
+        p.kill()
+        p.wait()
 
 
 def test_percolator_registry_survives_recovery_stream(master):

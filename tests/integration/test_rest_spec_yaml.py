@@ -376,10 +376,32 @@ def server():
     from elasticsearch_tpu.rest.server import RestServer
 
     node = Node(name="yaml-spec")
+    cluster = rank1 = None
+    if os.environ.get("ESTPU_YAML_MULTIHOST"):
+        # coordinator-mode sweep: the SAME reference suite runs against a
+        # REAL 2-process cluster — every index the tests create is
+        # distributed, so writes/reads/searches cross the process
+        # boundary (opt-in: slower; `ESTPU_YAML_MULTIHOST=1 pytest ...`)
+        import socket
+
+        from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+        from tests.integration.multihost_util import spawn_member
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        cluster = MultiHostCluster(node, rank=0, world=2,
+                                   transport_port=port, ping_interval=0)
+        rank1 = spawn_member(port, name="yaml-rank1")
     srv = RestServer(node, host="127.0.0.1", port=0)
     srv.start(background=True)
     yield node, srv
     srv.stop()
+    if rank1 is not None:
+        rank1.kill()
+        rank1.wait()
+    if cluster is not None:
+        cluster.close()
     node.close()
 
 
