@@ -281,19 +281,18 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
             return vals[:Q], idx[:Q]
         return bm25_dense_topk_pallas(qw, impact, mask, k=k, tile=tile,
                                       q_tile=q_tile)
-    from jax import lax as _lax
-
-    from elasticsearch_tpu.ops.scoring import topk_auto, topk_block_config
+    from elasticsearch_tpu.ops.scoring import (impact_precision, topk_auto,
+                                               topk_block_config)
 
     # XLA fallback, Q-chunked: one unchunked [Q, D] score matrix at msearch
     # batch scale (Q=2048, D=1M) would be an 8 GB intermediate. This
-    # dispatcher runs EAGERLY, so reading the topk config here is safe.
+    # dispatcher runs EAGERLY, so reading the configs here is safe.
     outs = []
     step = min(Q, 256)
     blk = topk_block_config()
+    prec = impact_precision()  # jax canonicalizes the precision string
     for q0 in range(0, Q, step):
-        scores = jnp.dot(qw[q0:q0 + step], impact,
-                         precision=_lax.Precision.HIGHEST)
+        scores = jnp.dot(qw[q0:q0 + step], impact, precision=prec)
         masked = jnp.where(mask[None, :], scores, NEG_INF)
         outs.append(topk_auto(masked, k, blk))
     vals = jnp.concatenate([v for v, _ in outs], axis=0)

@@ -152,50 +152,80 @@ def topk_auto(x, k: int, block: int = 0):
     return exact_topk(x, k, block) if block else lax.top_k(x, k)
 
 
-def _dense_dot(qw, dense_impact):
+_PRECS = {"highest": lax.Precision.HIGHEST, "high": lax.Precision.HIGH,
+          "default": lax.Precision.DEFAULT}
+_PREC_WARNED = False
+
+
+def impact_precision() -> str:
+    """f32 impact-matmul precision knob (``ESTPU_IMPACT_PRECISION``):
+    "highest" (default — exactness tests rely on it; on TPU it is the
+    multi-pass f32 emulation), "high" (3-pass), or "default" (native
+    bf16 MXU pass — fastest, ranking-grade). Read OUTSIDE jit and plumbed
+    as a static arg / program-cache key, exactly like topk_block_config —
+    an env read inside traced code would be frozen by the first trace."""
+    v = os.environ.get("ESTPU_IMPACT_PRECISION", "highest").lower()
+    if v in _PRECS:
+        return v
+    global _PREC_WARNED
+    if not _PREC_WARNED:
+        import warnings
+
+        warnings.warn(f"ESTPU_IMPACT_PRECISION={v!r} is not one of "
+                      f"{sorted(_PRECS)}; using 'highest'")
+        _PREC_WARNED = True
+    return "highest"
+
+
+def _dense_dot(qw, dense_impact, prec: str = "highest"):
     """qw @ impact with dtype-aware MXU mapping: an f32 block multiplies at
-    HIGHEST precision (exactness tests rely on it); a bf16 block (segment's
-    ESTPU_IMPACT_BF16 storage) takes the native bf16 MXU path with f32
-    accumulation — no upcast copy of the block in HBM."""
+    the configured precision (HIGHEST by default — exactness tests rely on
+    it); a bf16 block (segment's ESTPU_IMPACT_BF16 storage) takes the
+    native bf16 MXU path with f32 accumulation — no upcast copy of the
+    block in HBM."""
     if dense_impact.dtype == jnp.bfloat16:
         return jnp.dot(qw.astype(jnp.bfloat16), dense_impact,
                        preferred_element_type=jnp.float32)
-    return jnp.dot(qw, dense_impact, precision=lax.Precision.HIGHEST)
+    return jnp.dot(qw, dense_impact,
+                   precision=_PRECS.get(prec, lax.Precision.HIGHEST))
 
 
-@partial(jax.jit, static_argnames=("P", "D"))
+@partial(jax.jit, static_argnames=("P", "D", "prec"))
 def bm25_score_hybrid(
-    dense_impact, qw, doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int
+    dense_impact, qw, doc_ids, tfnorm, starts, lens, weights, *, P: int,
+    D: int, prec: str = "highest"
 ):
     """Single-query hybrid BM25: qw f32[F] (idf*boost per dense term) scores
     frequent terms via one matvec; starts/lens/weights i32/f32[T] are the
     short-run tail. Returns f32[D]."""
-    dense = _dense_dot(qw, dense_impact)
+    dense = _dense_dot(qw, dense_impact, prec)
     return dense + bm25_score_segment(doc_ids, tfnorm, starts, lens, weights, P=P, D=D)
 
 
-@partial(jax.jit, static_argnames=("P", "D"))
+@partial(jax.jit, static_argnames=("P", "D", "prec"))
 def bm25_score_hybrid_batch(
-    dense_impact, qw, doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int
+    dense_impact, qw, doc_ids, tfnorm, starts, lens, weights, *, P: int,
+    D: int, prec: str = "highest"
 ):
     """Batched hybrid BM25: ONE MXU matmul ``qw[Q, F] @ impact[F, D]`` for
     frequent terms (replacing what would be millions of scatter-adds for long
     postings runs) + the scatter kernel on the [Q, T] tail. Returns f32[Q, D]."""
-    dense = _dense_dot(qw, dense_impact)
+    dense = _dense_dot(qw, dense_impact, prec)
     return dense + bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, P=P, D=D)
 
 
-@partial(jax.jit, static_argnames=("P", "D", "k", "topk_block"))
+@partial(jax.jit, static_argnames=("P", "D", "k", "topk_block", "prec"))
 def bm25_hybrid_topk_batch(dense_impact, qw, doc_ids, tfnorm, starts, lens,
                            weights, live, *, P: int, D: int, k: int,
-                           topk_block: int = 0):
+                           topk_block: int = 0, prec: str = "highest"):
     """Batched hybrid top-k: scores via bm25_score_hybrid_batch, then the
     per-query masked top-k and exact totals in the SAME program, so the
     [Q, D] score block never leaves the device. For all-positive
     disjunctive term groups, score > 0 is exactly 'matched'. Returns
     (vals f32[Q, k], idx i32[Q, k], totals i32[Q])."""
     scores = bm25_score_hybrid_batch(dense_impact, qw, doc_ids, tfnorm,
-                                     starts, lens, weights, P=P, D=D)
+                                     starts, lens, weights, P=P, D=D,
+                                     prec=prec)
     m = (scores > 0) & live[None, :]
     masked = jnp.where(m, scores, NEG_INF)
     vals, idx = topk_auto(masked, k, topk_block)

@@ -783,7 +783,8 @@ class ETermGroupHybrid(Emit):
 
     def ex(self, env, meta):
         from elasticsearch_tpu.ops.scoring import (
-            bm25_score_hybrid, match_count_hybrid, term_mask_hybrid)
+            bm25_score_hybrid, impact_precision, match_count_hybrid,
+            term_mask_hybrid)
 
         doc_ids, tfnorm = env[self.post]
         impact, qw, qind, starts, lens, ws = env[self.prim]
@@ -791,8 +792,11 @@ class ETermGroupHybrid(Emit):
         if self.mode == "mask":
             return None, term_mask_hybrid(impact, qind, doc_ids, starts, lens,
                                           P=P, D=self.D)
+        # read at TRACE time; the executor keys its program cache on the
+        # same config (search_dsl prog_key), so an env flip retraces
         scores = bm25_score_hybrid(impact, qw, doc_ids, tfnorm, starts, lens,
-                                   ws, P=P, D=self.D)
+                                   ws, P=P, D=self.D,
+                                   prec=impact_precision())
         if self.mode == "count_ge":
             counts = match_count_hybrid(impact, qind, doc_ids, starts, lens,
                                         P=P, D=self.D)

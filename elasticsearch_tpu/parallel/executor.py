@@ -562,11 +562,12 @@ class MeshSearchExecutor:
                 counts.append(len(arrs))
                 statics.append(static)
             kk = min(k_dev, D)
-            from elasticsearch_tpu.ops.scoring import topk_block_config
+            from elasticsearch_tpu.ops.scoring import (impact_precision,
+                                                       topk_block_config)
 
             prog_key = ("dsl", compiled.struct_key(), tuple(statics),
                         tuple(tuple(a.shape) + (str(a.dtype),) for a in arrays),
-                        kk, topk_block_config())
+                        kk, topk_block_config(), impact_precision())
             prog = self._programs.get(prog_key)
             if prog is None:
                 prog = _dsl_program(self.mesh, compiled, counts, statics, kk)

@@ -120,9 +120,12 @@ def _score_term_group(ctx, field, terms, boost=1.0, with_counts=False) -> Tuple[
     hyb = ctx.hybrid_slices(inv, terms, weights)
     kernels.record("bm25_hybrid" if hyb is not None else "bm25_scatter")
     if hyb is not None:
+        from elasticsearch_tpu.ops.scoring import impact_precision
+
         impact, qw, qind, starts, lens, ws, P, n_present = hyb
         scores = bm25_score_hybrid(
-            impact, qw, inv.doc_ids, inv.tfnorm, starts, lens, ws, P=P, D=ctx.D)
+            impact, qw, inv.doc_ids, inv.tfnorm, starts, lens, ws, P=P,
+            D=ctx.D, prec=impact_precision())
         if with_counts:
             matched = match_count_hybrid(
                 impact, qind, inv.doc_ids, starts, lens, P=P, D=ctx.D)
@@ -323,10 +326,12 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
     jnp = _jnp()
     live = ctx.segment.live
     kk = min(k, ctx.D)
-    from elasticsearch_tpu.ops.scoring import topk_block_config
+    from elasticsearch_tpu.ops.scoring import (impact_precision,
+                                               topk_block_config)
 
     blk = topk_block_config()  # once per batch: every chunk must compile
     # against the SAME static block even if the env flips mid-batch
+    _prec = impact_precision()
     out_v, out_i, out_t = [], [], []
     for q0 in range(0, Q, chunk_q):
         q1 = min(q0 + chunk_q, Q)
@@ -334,7 +339,7 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
             impact, jnp.asarray(qw[q0:q1]), inv.doc_ids, inv.tfnorm,
             jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
             jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk,
-            topk_block=blk)
+            topk_block=blk, prec=_prec)
         out_v.append(np.asarray(vals))
         out_i.append(np.asarray(ids))
         out_t.append(np.asarray(tot))

@@ -397,3 +397,43 @@ def test_blocked_topk_env_product_equivalence(monkeypatch):
     assert r1["hits"]["total"] == r2["hits"]["total"] > 0
     assert [(h["_id"], round(h["_score"], 5)) for h in r1["hits"]["hits"]] \
         == [(h["_id"], round(h["_score"], 5)) for h in r2["hits"]["hits"]]
+
+
+def test_impact_precision_knob(monkeypatch):
+    """ESTPU_IMPACT_PRECISION plumbs as a static arg (cache-key safe) and
+    serves identical results on CPU, where precision hints are no-ops;
+    a bad value warns once and falls back to highest."""
+    import warnings
+
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.ops import scoring
+
+    monkeypatch.setattr(scoring, "_PREC_WARNED", False)
+    monkeypatch.setenv("ESTPU_IMPACT_PRECISION", "turbo")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert scoring.impact_precision() == "highest"
+        assert len(w) == 1 and "turbo" in str(w[0].message)
+
+    import random
+
+    rng = random.Random(7)
+    docs = {str(i): {"body": " ".join(rng.choices(
+        ["ant", "bee", "cat", "dog"], k=6))} for i in range(300)}
+    results = []
+    for prec in ("highest", "default"):
+        monkeypatch.setenv("ESTPU_IMPACT_PRECISION", prec)
+        n = Node()
+        try:
+            n.create_index("ip", {"mappings": {"properties": {
+                "body": {"type": "text"}}}})
+            for i, src in docs.items():
+                n.indices["ip"].index_doc(i, src)
+            n.indices["ip"].refresh()
+            r = n.search("ip", {"query": {"match": {"body": "ant bee"}},
+                                "size": 10})
+            results.append([(h["_id"], round(h["_score"], 5))
+                            for h in r["hits"]["hits"]])
+        finally:
+            n.close()
+    assert results[0] == results[1]

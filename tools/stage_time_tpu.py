@@ -1,0 +1,66 @@
+"""Time individual device-program stages with bench-like shapes (CPU)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from elasticsearch_tpu.utils.platform import ensure_cpu_if_requested
+
+ensure_cpu_if_requested()
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+D = 1 << 20
+F = 256
+nnz = 1 << 26  # scaled ~46M/5.6 for 262k docs
+rng = np.random.default_rng(0)
+
+
+def t(fn, *a, n=5):
+    fn(*a)  # compile
+    jax.block_until_ready(fn(*a))
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*a)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n * 1000
+
+
+impact = jnp.asarray(rng.standard_normal((F, D)), jnp.float32)
+qw = jnp.asarray(rng.standard_normal(F), jnp.float32)
+
+f_hi = jax.jit(lambda q, i: jnp.dot(q, i, precision=lax.Precision.HIGHEST))
+f_def = jax.jit(lambda q, i: jnp.dot(q, i))
+print(f"matvec HIGHEST: {t(f_hi, qw, impact):.1f} ms")
+print(f"matvec DEFAULT: {t(f_def, qw, impact):.1f} ms")
+
+scores = jnp.asarray(rng.standard_normal(D), jnp.float32)
+f_topk = jax.jit(lambda s: lax.top_k(s, 10))
+print(f"top_k D={D}: {t(f_topk, scores):.1f} ms")
+
+doc_ids = jnp.asarray(rng.integers(0, D, nnz), jnp.int32)
+tfn = jnp.asarray(rng.standard_normal(nnz), jnp.float32)
+from elasticsearch_tpu.ops.scoring import bm25_score_segment
+
+for P in (1 << 12, 1 << 15):
+    T = 8
+    starts = jnp.asarray(rng.integers(0, nnz - P, T), jnp.int32)
+    lens = jnp.full(T, P // 2, jnp.int32)
+    ws = jnp.ones(T, jnp.float32)
+    f_seg = jax.jit(lambda d, tf, s, l, w: bm25_score_segment(
+        d, tf, s, l, w, P=P, D=D))
+    print(f"scatter tail P={P} T={T}: {t(f_seg, doc_ids, tfn, starts, lens, ws):.1f} ms")
+
+# full hybrid like the single-query program
+from elasticsearch_tpu.ops.scoring import bm25_score_hybrid
+
+P = 1 << 15
+T = 8
+starts = jnp.asarray(rng.integers(0, nnz - P, T), jnp.int32)
+lens = jnp.full(T, P // 2, jnp.int32)
+ws = jnp.ones(T, jnp.float32)
+f_h = jax.jit(lambda i, q, d, tf, s, l, w: bm25_score_hybrid(
+    i, q, d, tf, s, l, w, P=P, D=D))
+print(f"hybrid full: {t(f_h, impact, qw, doc_ids, tfn, starts, lens, ws):.1f} ms")

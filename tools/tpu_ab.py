@@ -1,0 +1,74 @@
+"""A/B the single-query product path on TPU: topk staging x impact dtype.
+
+Run: python tools/tpu_ab.py [docs_pow2]   (fresh process per config —
+programs cache per executor, env flags read at trace time)
+"""
+import json
+import os
+import subprocess
+import sys
+
+docs = sys.argv[1] if len(sys.argv) > 1 else str(1 << 20)
+
+INNER = r"""
+import os, sys, time
+import numpy as np
+sys.path.insert(0, os.environ["AB_REPO"])  # -c code has no __file__
+sys.argv = [sys.argv[0]]
+import bench
+from elasticsearch_tpu.utils.platform import (enable_compilation_cache,
+                                              ensure_cpu_if_requested)
+ensure_cpu_if_requested()  # no-op on TPU runs; unblocks CPU when tunnel is down
+enable_compilation_cache()
+docs = int(os.environ["AB_DOCS"]); vocab = 30000
+u_doc, tf, tfn, offsets, df, idf, doc_len = bench.build_corpus(docs, vocab, 42)
+node, seg = bench.make_msmarco_node(u_doc, tf, tfn, offsets, df, doc_len,
+                                    docs, vocab)
+seg.inverted["body"].dense_block()
+qs = bench.make_queries(12, vocab, df, 42)
+bodies = [{"query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
+           "size": 10} for q in qs]
+for b in bodies:
+    node.search("msmarco", b)
+times = []
+for _ in range(3):
+    for b in bodies:
+        t0 = time.perf_counter()
+        node.search("msmarco", b)
+        times.append(time.perf_counter() - t0)
+import json as _j
+cpu_times, cpu_tops = bench.cpu_bm25_latency(u_doc, tfn, offsets, idf,
+                                             qs, docs, 10, runs=1)
+agree = 0
+for q, ct in zip(qs, cpu_tops):
+    r = node.search("msmarco", {"query": {"match": {"body": " ".join(
+        f"t{t}" for t in q)}}, "size": 1})
+    if r["hits"]["hits"] and int(r["hits"]["hits"][0]["_id"]) == ct[0]:
+        agree += 1
+print(_j.dumps({"p50_ms": float(np.percentile(np.array(times) * 1000, 50)),
+                "cpu_p50_ms": float(np.percentile(np.array(cpu_times) * 1000, 50)),
+                "top1_agree": f"{agree}/{len(qs)}"}))
+"""
+
+CONFIGS = [
+    ("prec_default", {"ESTPU_IMPACT_PRECISION": "default"}),
+    ("prec_high", {"ESTPU_IMPACT_PRECISION": "high"}),
+    ("fast_combo", {"ESTPU_IMPACT_PRECISION": "default", "ESTPU_BLOCKED_TOPK": "1", "ESTPU_IMPACT_BF16": "1"}),
+    ("default", {}),
+    ("blocked_topk", {"ESTPU_BLOCKED_TOPK": "1"}),
+    ("bf16_impact", {"ESTPU_IMPACT_BF16": "1"}),
+    ("blocked+bf16", {"ESTPU_BLOCKED_TOPK": "1", "ESTPU_IMPACT_BF16": "1"}),
+]
+for name, extra in CONFIGS:
+    env = dict(os.environ)
+    env.update(extra)
+    env["AB_DOCS"] = docs
+    env["AB_REPO"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, "-u", "-c", INNER], env=env,
+                       capture_output=True, text=True, timeout=900)
+    line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+    try:
+        d = json.loads(line)
+    except Exception:
+        d = {"error": r.stderr.strip().splitlines()[-3:]}
+    print(name, "->", json.dumps(d), flush=True)
