@@ -561,14 +561,53 @@ def run_bench(args, jax) -> dict:
 
     # correctness spot check: product top-1 vs numpy oracle top-1
     n_chk = min(16, len(lat_q))
-    agree = 0
-    for q, cpu_top in zip(lat_q[:n_chk], cpu_tops[:n_chk]):
-        r = node.search("msmarco", {
-            "query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
-            "size": 1})
-        if r["hits"]["hits"] and int(r["hits"]["hits"][0]["_id"]) == cpu_top[0]:
-            agree += 1
+
+    def top1_agreement(nd) -> int:
+        got = 0
+        for q, cpu_top in zip(lat_q[:n_chk], cpu_tops[:n_chk]):
+            r = nd.search("msmarco", {
+                "query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
+                "size": 1})
+            if r["hits"]["hits"] \
+                    and int(r["hits"]["hits"][0]["_id"]) == cpu_top[0]:
+                got += 1
+        return got
+
+    agree = top1_agreement(node)
     log(f"top-1 agreement vs numpy oracle: {agree}/{n_chk}")
+
+    # SECONDARY: the tuned single-query config (ranking-grade matmul
+    # precision + blocked top-k staging) on the SAME node — the knobs
+    # are read at dispatch time and key every jit/program cache
+    # (ops/scoring.py::impact_precision/topk_block_config), so flipping
+    # the env compiles tuned programs next to the exact ones with no
+    # second corpus in HBM. Clearly labeled: the headline p50 above
+    # stays the untouched exact default.
+    fast_env = {"ESTPU_IMPACT_PRECISION": "default",
+                "ESTPU_BLOCKED_TOPK": "1"}
+    old_env = {name: os.environ.get(name) for name in fast_env}
+    os.environ.update(fast_env)
+    p50_fast, fast_agree = 0.0, 0
+    try:
+        try:
+            fast_times, _ = bm25_product_latency(node, lat_q, args.k)
+            p50_fast = percentile_ms(fast_times, 50)
+        except Exception as e:  # the secondary must never sink the capture
+            log(f"tuned-config latency pass failed: {e}")
+        if p50_fast > 0:
+            try:
+                fast_agree = top1_agreement(node)
+            except Exception as e:  # keep the measured p50 regardless
+                log(f"tuned-config agreement probe failed: {e}")
+            log(f"tuned single-query p50 (prec=default + blocked topk): "
+                f"{p50_fast:.2f} ms -> {cpu_p50 / p50_fast:.1f}x; top-1 "
+                f"agreement {fast_agree}/{n_chk}")
+    finally:
+        for name, v in old_env.items():
+            if v is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = v
 
     # -- batched product path ------------------------------------------------
     if dense_rows is not None:
@@ -726,6 +765,10 @@ def run_bench(args, jax) -> dict:
         "p99_ms": round(p99, 3),
         "cpu_p50_ms": round(cpu_p50, 3),
         "p50_speedup_vs_cpu": round(vs, 2),
+        "p50_ms_tuned": round(p50_fast, 3),
+        "p50_speedup_vs_cpu_tuned": round(
+            cpu_p50 / p50_fast if p50_fast > 0 else 0.0, 2),
+        "tuned_top1_agreement": round(fast_agree / max(n_chk, 1), 3),
         "dispatch_floor_ms": round(dispatch_floor_ms, 3),
         "dispatch_floor_steady_ms": round(floor_steady_ms, 3),
         "batched_qps": round(batched_qps, 1),
