@@ -17,6 +17,7 @@ host leaves the cluster and its routing entries unassign for reroute.
 """
 from __future__ import annotations
 
+import os
 import threading
 from typing import List, Optional, Tuple
 
@@ -86,6 +87,16 @@ class MultiHostCluster:
         # names this process has adopted as distributed — a name that
         # disappears from a publish was deleted cluster-wide
         self._dist_known: set = set()
+        if rank == 0 and node.data_path:
+            # the master's metadata survives restart (reference: the
+            # cluster state's MetaData persists via the gateway) —
+            # without this a master restart orphans the distributed
+            # layout while the local shard data is still on disk
+            self._meta_path = os.path.join(node.data_path, "_cluster",
+                                           "dist_indices.json")
+            self._load_dist_meta()
+        else:
+            self._meta_path = None
         from elasticsearch_tpu.cluster.search_action import \
             DistributedDataService
 
@@ -140,6 +151,11 @@ class MultiHostCluster:
             self._bump_indices_version()
         self._publish()
         self.data.start_recoveries(directives)  # async internally
+        # gateway allocation: shards that lost EVERY copy (e.g. a master
+        # restart while this member was away) adopt the joiner's on-disk
+        # data — async, it probes over the transport
+        threading.Thread(target=self.data.resurrect_lost,
+                         name="tpu-resurrect", daemon=True).start()
         return {"nodes": [_node_json(n)
                           for n in self.node.cluster_state.nodes.values()],
                 "master": self.node.cluster_state.master_node_id,
@@ -208,11 +224,71 @@ class MultiHostCluster:
         self.node.cluster_state.next_version()  # order vs membership publishes
         self._publish()
 
+    def _persist_dist_meta(self) -> None:
+        """Write the metadata atomically; ALWAYS called under
+        _indices_lock (a unique tmp suffix additionally guards against a
+        future unlocked caller). ONE serialization: json.dumps straight
+        from dist_indices under the lock."""
+        if not self._meta_path:
+            return
+        import json as _json
+
+        # the local node id is persisted so a restart (which mints a NEW
+        # id) can map the old master's copies to itself — its shard data
+        # is still on this disk
+        raw = _json.dumps({"local": self.local.node_id,
+                           "indices": self.dist_indices})
+        try:
+            os.makedirs(os.path.dirname(self._meta_path), exist_ok=True)
+            tmp = (f"{self._meta_path}.{os.getpid()}."
+                   f"{threading.get_ident()}.tmp")
+            with open(tmp, "w") as f:
+                f.write(raw)
+            os.replace(tmp, self._meta_path)
+        except OSError:
+            pass  # metadata persistence is best-effort; publishes carry it
+
+    def _load_dist_meta(self) -> None:
+        try:
+            with open(self._meta_path) as f:
+                import json as _json
+
+                blob = _json.load(f)
+        except (OSError, ValueError):
+            return
+        meta = blob.get("indices", {})
+        old_local = blob.get("local")
+        with self._indices_lock:
+            self.dist_indices = meta
+            self._dist_known = set(meta)
+            self._indices_version = 1
+            # the restart minted a NEW node id: copies recorded under the
+            # OLD id are THIS disk's shards — remap them; copies on
+            # currently-absent members drop, and when those members
+            # rejoin, reconcile re-replicates under-replicated shards
+            # while resurrect_lost (gateway allocation) re-adopts shards
+            # that lost EVERY copy from the joiner's on-disk data
+            alive = {self.local.node_id}
+            for name, spec in meta.items():
+                for sid, owners in spec.get("assignment", {}).items():
+                    kept = [self.local.node_id if o == old_local else o
+                            for o in owners]
+                    spec["assignment"][sid] = [o for o in kept
+                                               if o in alive]
+                spec["initializing"] = {}
+                if not self.node.index_exists(name):
+                    self.node.create_index(name, spec.get("body"))
+
     def _bump_indices_version(self) -> None:
         # read-modify-write under the indices lock: concurrent join/fault
-        # handlers must never publish distinct states under one version
+        # handlers must never publish distinct states under one version.
+        # EVERY metadata mutation funnels through here, so persistence
+        # lives here too (reconcile-driven changes don't go through
+        # publish_indices); serializing INSIDE the lock keeps concurrent
+        # bumps from interleaving writes into one tmp file
         with self._indices_lock:
             self._indices_version += 1
+            self._persist_dist_meta()
 
     def indices_snapshot(self) -> dict:
         """Deep copy under the lock: publishes and join replies must not

@@ -52,6 +52,7 @@ ACTION_SET_CLOSED = "indices:admin/set_closed"
 ACTION_RECOVER = "indices:recovery/start"
 ACTION_SHARD_SYNC = "indices:recovery/shard_sync"
 ACTION_SHARD_FAILED = "cluster:shard_failed"
+ACTION_SHARD_DOCS = "indices:monitor/shard_docs"
 ACTION_SNAPSHOT = "cluster:admin/snapshot/create"
 ACTION_SNAPSHOT_SHARD = "indices:admin/snapshot/shard"
 ACTION_RESTORE = "cluster:admin/snapshot/restore"
@@ -92,6 +93,7 @@ class DistributedDataService:
         t.register(ACTION_RECOVER, self._on_recover)
         t.register(ACTION_SHARD_SYNC, self._on_shard_sync)
         t.register(ACTION_SHARD_FAILED, self._on_shard_failed)
+        t.register(ACTION_SHARD_DOCS, self._on_shard_docs)
         t.register(ACTION_SNAPSHOT, self._on_snapshot)
         t.register(ACTION_SNAPSHOT_SHARD, self._on_snapshot_shard)
         t.register(ACTION_RESTORE, self._on_restore)
@@ -1032,6 +1034,59 @@ class DistributedDataService:
                             "source": owners[0], "body": meta["body"]})
                         changed = True
             return directives, changed
+
+    def _on_shard_docs(self, payload: dict) -> dict:
+        svc = self.node.indices.get(payload["index"])
+        if svc is None:
+            return {"docs": -1}
+        return {"docs": svc.shards[payload["shard"]].engine.num_docs}
+
+    def resurrect_lost(self) -> None:
+        """Gateway-style primary allocation from on-disk copies: a shard
+        with NO active copies adopts the alive node holding the most
+        local docs for it — a member that restarted with its data_path
+        and rejoined under a new node id. Shards nobody holds data for
+        stay unassigned (a visible failure, like the reference's lost
+        primaries without an explicit force-allocate). Reference:
+        gateway/GatewayAllocator primary allocation from shard stores."""
+        with self.cluster._indices_lock:
+            lost = [(name, sid)
+                    for name, meta in self.cluster.dist_indices.items()
+                    for sid in range(meta["num_shards"])
+                    if not meta["assignment"].get(str(sid))]
+        if not lost:
+            return
+        changed = False
+        for name, sid in lost:
+            best_docs, best_nid = 0, None
+            for nid in sorted(self.node.cluster_state.nodes):
+                try:
+                    if nid == self._local_id():
+                        docs = self.node.indices[name].shards[sid] \
+                            .engine.num_docs
+                    else:
+                        docs = self._send(nid, ACTION_SHARD_DOCS,
+                                          {"index": name, "shard": sid},
+                                          timeout=5.0)["docs"]
+                except Exception:
+                    continue
+                if docs > best_docs:
+                    best_docs, best_nid = docs, nid
+            if best_nid is None:
+                continue
+            with self.cluster._indices_lock:
+                owners = self.cluster.dist_indices[name]["assignment"] \
+                    .get(str(sid))
+                if owners == []:  # still lost (no race with a recovery)
+                    owners.append(best_nid)
+                    changed = True
+        if changed:
+            self.cluster.publish_indices()
+            # replicas top back up from the resurrected primaries
+            directives, changed2 = self.reconcile()
+            if changed2:
+                self.cluster.publish_indices()
+            self.start_recoveries(directives)
 
     def start_recoveries(self, directives: List[dict]) -> None:
         """Run the recovery streams on a background thread: callers are

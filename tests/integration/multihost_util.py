@@ -23,7 +23,7 @@ ensure_cpu_if_requested()
 from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
 from elasticsearch_tpu.node import Node
 
-node = Node(name={name!r})
+node = Node(name={name!r}, data_path={data_path!r})
 c = MultiHostCluster(node, rank={rank}, world={world}, transport_port={port},
                      master_host="127.0.0.1", ping_interval=0)
 ids = sorted(node.cluster_state.nodes)
@@ -40,18 +40,20 @@ if "leave" in line:
 
 
 def member_code(port: int, rank: int = 1, world: int = 2,
-                expect: int = 2, name: str = "rank1") -> str:
+                expect: int = 2, name: str = "rank1",
+                data_path=None) -> str:
     return MEMBER.format(repo=REPO, port=port, rank=rank, world=world,
-                         expect=expect, name=name)
+                         expect=expect, name=name, data_path=data_path)
 
 
 def spawn_member(port: int, rank: int = 1, world: int = 2,
-                 expect: int = 2, name: str = "rank1") -> subprocess.Popen:
+                 expect: int = 2, name: str = "rank1",
+                 data_path=None) -> subprocess.Popen:
     """Spawn a member process and block until it has JOINED."""
     p = subprocess.Popen(
         [sys.executable, "-c",
          member_code(port, rank=rank, world=world, expect=expect,
-                     name=name)],
+                     name=name, data_path=data_path)],
         stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
     line = p.stdout.readline()
     assert "JOINED" in line, line

@@ -892,6 +892,104 @@ def test_percolator_registry_survives_recovery_stream(master):
         p.wait()
 
 
+def test_master_restart_recovers_dist_metadata(tmp_path):
+    """A master restart with a data path reloads the distributed-index
+    metadata (the gateway-persisted cluster state): its own copies remap
+    to the new node id, searches work again, and a rejoining member gets
+    re-replicated via reconcile — without this, restart orphaned the
+    layout while the shard data sat on disk."""
+    dp = str(tmp_path / "master")
+    node = Node(name="m1", data_path=dp)
+    c = MultiHostCluster(node, rank=0, world=2, transport_port=_free_port(),
+                         ping_interval=0)
+    try:
+        c.data.create_index("dur", {
+            "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+            "mappings": {"properties": {"n": {"type": "integer"}}}})
+        for i in range(20):
+            c.data.index_doc("dur", str(i), {"n": i})
+        c.data.refresh("dur")
+    finally:
+        c.close()
+        node.close()
+
+    node2 = Node(name="m1b", data_path=dp)
+    c2 = MultiHostCluster(node2, rank=0, world=2,
+                          transport_port=_free_port(), ping_interval=0)
+    p = None
+    try:
+        assert "dur" in c2.dist_indices
+        # the old id's copies remapped to the NEW local id
+        assert all(o == [c2.local.node_id] for o in
+                   c2.dist_indices["dur"]["assignment"].values()), \
+            c2.dist_indices["dur"]["assignment"]
+        r = c2.data.search("dur", {"query": {"match_all": {}},
+                                   "size": 30})
+        assert r["hits"]["total"] == 20, r["hits"]["total"]
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+        # a joining member re-replicates from the restarted master
+        p = _spawn_rank1(c2.master_addr[1])
+        assert _wait(lambda: len(node2.cluster_state.nodes) == 2)
+        assert _wait(lambda: all(
+            len(o) == 2 for o in
+            c2.dist_indices["dur"]["assignment"].values()), timeout=15.0)
+    finally:
+        if p is not None:
+            p.kill()
+            p.wait()
+        c2.close()
+        node2.close()
+
+
+def test_lost_shard_resurrects_from_rejoining_member(master):
+    """Gateway allocation: a shard whose ONLY copy lived on a member that
+    died comes back when that member rejoins with its data_path — the
+    master probes the joiner's on-disk shard and adopts it as primary
+    (reference: GatewayAllocator primary allocation from shard stores).
+    Until then the shard reads 'no active copies', a visible failure."""
+    import tempfile
+
+    from tests.integration.multihost_util import spawn_member
+
+    node, c = master
+    dp = tempfile.mkdtemp()
+    port = c.master_addr[1]
+    p = spawn_member(port, data_path=dp)
+    try:
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        c.data.create_index("gw", {
+            "settings": {"number_of_shards": 2},
+            "mappings": {"properties": {"n": {"type": "integer"}}}})
+        assig = c.dist_indices["gw"]["assignment"]
+        assert len({o[0] for o in assig.values()}) == 2, assig
+        for i in range(30):
+            c.data.index_doc("gw", str(i), {"n": i})
+        c.data.refresh("gw")
+
+        p.kill()  # the member's shard is now LOST (no replicas)
+        p.wait()
+        assert _wait(lambda: len(node.cluster_state.nodes) == 1,
+                     timeout=15.0)
+        lost = [sid for sid, o in
+                c.dist_indices["gw"]["assignment"].items() if not o]
+        assert len(lost) == 1, c.dist_indices["gw"]["assignment"]
+        r = c.data.search("gw", {"query": {"match_all": {}}, "size": 40})
+        assert r["_shards"]["failed"] == 1  # visible partial failure
+
+        # the member restarts FROM ITS DATA PATH (new node id) and rejoins
+        p = spawn_member(port, name="rank1b", data_path=dp)
+        assert _wait(lambda: len(node.cluster_state.nodes) == 2)
+        assert _wait(lambda: all(
+            o for o in c.dist_indices["gw"]["assignment"].values()),
+            timeout=20.0), c.dist_indices["gw"]["assignment"]
+        r = c.data.search("gw", {"query": {"match_all": {}}, "size": 40})
+        assert r["hits"]["total"] == 30, r["hits"]["total"]
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+    finally:
+        p.kill()
+        p.wait()
+
+
 def test_jax_distributed_initialize_smoke():
     """--coordinator path: jax.distributed.initialize with a 1-process world
     (in a subprocess — it must run before any JAX computation)."""
