@@ -2693,7 +2693,11 @@ def _suggest(n: Node, p, b, index: str):
             and c.data.resolve_index(index) in c.dist_indices:
         # distributed index: one request per primary owner, merged per
         # entry (freq sums, score maxes) — cluster/search_action.py
-        res, shards = c.data.suggest_fan(index, _json(b))
+        from elasticsearch_tpu.search.suggest import validate_suggest_body
+
+        body = _json(b)
+        validate_suggest_body(body)  # 400 BEFORE the fan, not shard noise
+        res, shards = c.data.suggest_fan(index, body)
         res["_shards"] = shards
         return 200, res
     svc = n.get_index(index)
@@ -2707,15 +2711,31 @@ def _suggest(n: Node, p, b, index: str):
 
 def _suggest_all(n: Node, p, b):
     """Reference: RestSuggestAction with no index = all indices; each index
-    runs under its own analysis registry, merged per entry."""
-    from elasticsearch_tpu.search.suggest import execute_suggest_multi
+    runs under its own analysis registry, merged per entry. Distributed
+    indices fan per primary owner first (coordinator-local shards of a
+    dist index would under-count), then merge like any other index."""
+    from elasticsearch_tpu.search.suggest import (execute_suggest_multi,
+                                                  validate_suggest_body)
 
     body = _json(b)
+    validate_suggest_body(body)  # a malformed body 400s BEFORE any fan
+    c = _mh(n)
+    dist_names = (set() if c is None or p.get("_local_only")
+                  else set(c.dist_indices))
     groups = [(svc.shards, svc.analysis, svc.mappings)
-              for svc in n.indices.values()]
-    res = execute_suggest_multi(groups, body)
-    total = sum(len(svc.shards) for svc in n.indices.values())
-    res["_shards"] = {"total": total, "successful": total, "failed": 0}
+              for name, svc in n.indices.items()
+              if name not in dist_names]
+    extra = []
+    failed = 0
+    for name in sorted(dist_names):
+        fanned, sh = c.data.suggest_fan(name, body)
+        extra.append(fanned)
+        failed += sh.get("failed", 0)
+    res = execute_suggest_multi(groups, body, extra_results=extra)
+    total = (sum(len(g[0]) for g in groups)
+             + sum(c.dist_indices[nm]["num_shards"] for nm in dist_names))
+    res["_shards"] = {"total": total, "successful": total - failed,
+                      "failed": failed}
     return 200, res
 
 

@@ -588,19 +588,10 @@ def execute_suggest(shards, body: dict, analysis, mappings=None) -> dict:
     ``shards`` are IndexShard-likes exposing .segments and .searcher.
     """
     out: Dict[str, Any] = {}
-    global_text = body.get("text")
     for name, spec in body.items():
         if name == "text":
             continue
-        if not isinstance(spec, dict):
-            raise ElasticsearchTpuException(f"suggester [{name}] malformed body")
-        text = spec.get("text", spec.get("prefix", global_text))
-        if text is None:
-            raise ElasticsearchTpuException(f"suggester [{name}] requires [text]")
-        kind = next((k for k in SUGGEST_KINDS if k in spec), None)
-        if kind is None:
-            raise ElasticsearchTpuException(
-                f"suggester [{name}] requires one of {SUGGEST_KINDS}")
+        text, kind = validate_suggester(name, spec, body.get("text"))
         opts = spec[kind] or {}
         if kind == "term":
             out[name] = term_suggest(shards, text, opts, analysis)
@@ -612,32 +603,71 @@ def execute_suggest(shards, body: dict, analysis, mappings=None) -> dict:
     return out
 
 
-def execute_suggest_multi(groups, body: dict) -> dict:
+def validate_suggester(name: str, spec, global_text):
+    """Shared validation → (text, kind). The fan-out paths call this
+    BEFORE scattering, so a malformed body 400s at the coordinator
+    instead of dissolving into per-owner shard failures."""
+    if not isinstance(spec, dict):
+        raise ElasticsearchTpuException(f"suggester [{name}] malformed body")
+    text = spec.get("text", spec.get("prefix", global_text))
+    if text is None:
+        raise ElasticsearchTpuException(f"suggester [{name}] requires [text]")
+    kind = next((k for k in SUGGEST_KINDS if k in spec), None)
+    if kind is None:
+        raise ElasticsearchTpuException(
+            f"suggester [{name}] requires one of {SUGGEST_KINDS}")
+    return text, kind
+
+
+def validate_suggest_body(body: dict) -> None:
+    for name, spec in (body or {}).items():
+        if name == "text":
+            continue
+        validate_suggester(name, spec, (body or {}).get("text"))
+
+
+def merge_index_result(merged: Dict[str, List[dict]], res: dict) -> None:
+    """Fold one INDEX's suggest result into a cross-index accumulator:
+    entries align by (text, offset); an option text already present from
+    another index wins first (per-index candidate sets are independent
+    vocabularies, unlike same-index shard merges where freq sums)."""
+    for name, entries in res.items():
+        if name == "_shards" or not isinstance(entries, list):
+            continue
+        if name not in merged:
+            merged[name] = entries
+            continue
+        by_key = {(e["text"], e["offset"]): e for e in merged[name]}
+        for e in entries:
+            cur = by_key.get((e["text"], e["offset"]))
+            if cur is None:
+                merged[name].append(e)
+                continue
+            seen = {o["text"] for o in cur["options"]}
+            cur["options"].extend(
+                o for o in e["options"] if o["text"] not in seen)
+
+
+def execute_suggest_multi(groups, body: dict, extra_results=()) -> dict:
     """Suggest across several indices: each index runs with ITS OWN analysis
     registry (custom analyzers are per-index), then entries with the same
     (text, offset) are merged and their options re-ranked — the same shape
     of merge the reference does across shard responses in SuggestPhase.
 
-    ``groups`` is an iterable of (shards, analysis[, mappings]) tuples.
+    ``groups`` is an iterable of (shards, analysis[, mappings]) tuples;
+    ``extra_results`` are pre-computed per-index result dicts (the
+    multi-host path fans distributed indices per owner first and feeds
+    the merged results here).
     """
     merged: Dict[str, List[dict]] = {}
     for group in groups:
         shards, analysis = group[0], group[1]
         mappings = group[2] if len(group) > 2 else None
-        res = execute_suggest(shards, body, analysis, mappings=mappings)
-        for name, entries in res.items():
-            if name not in merged:
-                merged[name] = entries
-                continue
-            by_key = {(e["text"], e["offset"]): e for e in merged[name]}
-            for e in entries:
-                cur = by_key.get((e["text"], e["offset"]))
-                if cur is None:
-                    merged[name].append(e)
-                    continue
-                seen = {o["text"] for o in cur["options"]}
-                cur["options"].extend(
-                    o for o in e["options"] if o["text"] not in seen)
+        merge_index_result(
+            merged, execute_suggest(shards, body, analysis,
+                                    mappings=mappings))
+    for res in extra_results:
+        merge_index_result(merged, res)
     _rerank_options(body, merged)
     return merged
 

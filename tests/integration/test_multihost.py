@@ -626,6 +626,12 @@ def test_doc_level_and_scroll_ops_cross_host(master):
         assert opts[0]["freq"] == 30, opts  # docs from BOTH processes
         assert r["_shards"]["failed"] == 0, r["_shards"]
 
+        # root /_suggest (no index) also fans dist indices per owner
+        st, r = req("POST", "/_suggest", {
+            "fx": {"text": "alpa", "term": {"field": "body"}}})
+        assert st == 200 and r["fx"][0]["options"][0]["freq"] == 30, r
+        assert r["_shards"]["failed"] == 0, r["_shards"]
+
         # percolate: queries register as routed docs (disjoint subsets on
         # each owner); a match registered on the REMOTE owner must surface
         for qid, term in (("q_local", "alpha"), ("q2", "beta"),
