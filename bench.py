@@ -47,9 +47,32 @@ import numpy as np
 
 K1, B = 1.2, 0.75
 
+# mid-run stall protection (the r5 capture found the tunnel can hang a
+# device call AFTER a successful boot, which no init watchdog catches):
+# every log() bumps the heartbeat, finished metrics accumulate in PARTIAL,
+# and a watchdog emits PARTIAL as the record if the heartbeat goes stale.
+_LAST_BEAT = time.monotonic()
+PARTIAL: dict = {}
+CURRENT_STAGE = "boot"
+
 
 def log(*a):
+    global _LAST_BEAT
+    _LAST_BEAT = time.monotonic()
     print(*a, file=sys.stderr, flush=True)
+
+
+def stage(name: str):
+    global CURRENT_STAGE
+    CURRENT_STAGE = name
+    log(f"-- stage: {name}")
+
+
+def beat():
+    """Silent heartbeat for long loops (per-shape warmup compiles run
+    minutes with no log lines; only a truly hung device call may stall)."""
+    global _LAST_BEAT
+    _LAST_BEAT = time.monotonic()
 
 
 def resolve_backend(probe_timeout: float = 75.0, tries: int = 3):
@@ -309,12 +332,14 @@ def bm25_product_latency(node, queries, k, runs=3):
                "size": k} for q in queries]
     for b in bodies:  # warmup: compile every shape class
         node.search("msmarco", b)
+        beat()
     times = np.full(len(bodies), np.inf)
     for _ in range(runs):
         for i, b in enumerate(bodies):
             t0 = time.perf_counter()
             r = node.search("msmarco", b)
             times[i] = min(times[i], time.perf_counter() - t0)
+            beat()
     return times, r
 
 
@@ -333,6 +358,7 @@ def cpu_bm25_latency(u_doc, tfn, offsets, idf, queries, n_docs, k, runs=3):
             top = np.argpartition(-scores, k)[:k]
             top = top[np.argsort(-scores[top])]
             times[qi] = min(times[qi], time.perf_counter() - t0)
+            beat()
             if run == 0:
                 tops.append(top)
     return times, tops
@@ -379,6 +405,7 @@ def _msearch_top1(node, q):
     r = node.search("msmarco", {
         "query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
         "size": 1})
+    beat()  # first calls under fresh cache keys compile for minutes
     hits = r["hits"]["hits"]
     return hits[0]["_id"] if hits else None
 
@@ -392,6 +419,7 @@ def knn_product_latency(node, qvecs, k, ann=False, num_candidates=100):
                "size": k} for qv in qvecs]
     for b in bodies[:4]:
         node.search("sift", b)
+        beat()
     times = []
     results = []
     for b in bodies:
@@ -399,6 +427,7 @@ def knn_product_latency(node, qvecs, k, ann=False, num_candidates=100):
         r = node.search("sift", b)
         times.append(time.perf_counter() - t0)
         results.append([int(h["_id"]) for h in r["hits"]["hits"]])
+        beat()
     return np.asarray(times), results
 
 
@@ -449,6 +478,13 @@ def main():
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--skip-knn", action="store_true")
     ap.add_argument("--probe-timeout", type=float, default=75.0)
+    ap.add_argument("--stall-timeout", type=float, default=420.0,
+                    help="emit the partial record and exit if no stage "
+                         "progress for this many seconds (tunnel hang); "
+                         "<= 0 disables; raise it for much-larger-than-"
+                         "default workloads whose un-beaten phases "
+                         "(corpus build, device transfers, batch compile) "
+                         "legitimately run longer")
     args = ap.parse_args()
 
     backend, backend_err = resolve_backend(probe_timeout=args.probe_timeout)
@@ -491,6 +527,31 @@ def main():
     threading.Thread(target=_watchdog, daemon=True).start()
     log(f"backend: {backend}; devices: {jax.devices()}")
     booted.set()
+
+    # mid-run stall watchdog: a device call that never returns (tunnel
+    # drop under load — observed during the r5 capture attempt) would
+    # otherwise hang the whole capture with nothing on stdout. When the
+    # heartbeat goes stale, emit every metric that already landed
+    # (PARTIAL) plus the stage that hung, then hard-exit: partial perf
+    # evidence beats none.
+    def _stall_watchdog():
+        while True:
+            time.sleep(10.0)
+            idle = time.monotonic() - _LAST_BEAT
+            if idle > args.stall_timeout:
+                emit_record({
+                    "target_met": False,  # PARTIAL overrides once measured
+                    **PARTIAL,
+                    "backend": backend,
+                    "error": f"stalled: no progress for {idle:.0f}s "
+                             f"during stage '{CURRENT_STAGE}' "
+                             f"(tunnel hang?); record holds all metrics "
+                             f"captured before the stall",
+                })
+                os._exit(1)
+
+    if args.stall_timeout > 0:
+        threading.Thread(target=_stall_watchdog, daemon=True).start()
     try:
         payload = run_bench(args, jax)
     except Exception:
@@ -513,6 +574,7 @@ def main():
 
 def run_bench(args, jax) -> dict:
     t_start = time.perf_counter()
+    stage("dispatch-floor")
     # per-call dispatch floor: the minimum round trip of ANY device call on
     # this host↔device link (tunneled chips: network RTT). Single-query
     # latency can never beat a few multiples of this — reported so p50 is
@@ -527,16 +589,20 @@ def run_bench(args, jax) -> dict:
     dispatch_floor_ms = float(np.percentile(np.asarray(floors) * 1000, 50))
     log(f"device dispatch floor (p50 of a trivial jitted call): "
         f"{dispatch_floor_ms:.2f} ms")
+    PARTIAL["dispatch_floor_ms"] = round(dispatch_floor_ms, 3)
+    stage("corpus-build")
     log(f"corpus: {args.docs} docs, vocab {args.vocab}")
     u_doc, tf, tfn, offsets, df, idf, doc_len = build_corpus(
         args.docs, args.vocab, args.seed)
     log(f"postings nnz: {u_doc.shape[0]} (built in "
         f"{time.perf_counter() - t_start:.1f}s)")
+    stage("segment-device-transfer")
     node, seg = make_msmarco_node(u_doc, tf, tfn, offsets, df, doc_len,
                                   args.docs, args.vocab)
 
     # force the dense impact block now (product lazy build) so workloads see
     # the steady state; report its shape
+    stage("dense-impact-block")
     block = seg.inverted["body"].dense_block()
     dense_rows = None
     if block is not None:
@@ -545,19 +611,25 @@ def run_bench(args, jax) -> dict:
             f"({impact.shape[0] * impact.shape[1] * 4 >> 20} MB)")
 
     # -- single-query product latency (the headline) -------------------------
+    stage("bm25-single-query-latency")
     lat_q = make_queries(args.lat_queries, args.vocab, df, args.seed)
     t0 = time.perf_counter()
     tpu_times, last = bm25_product_latency(node, lat_q, args.k)
     log(f"product latency pass done in {time.perf_counter() - t0:.1f}s; "
         f"sample total hits={last['hits']['total']}")
     p50, p99 = percentile_ms(tpu_times, 50), percentile_ms(tpu_times, 99)
+    PARTIAL.update(p50_ms=round(p50, 3), p99_ms=round(p99, 3))
 
+    stage("cpu-baseline")
     cpu_times, cpu_tops = cpu_bm25_latency(u_doc, tfn, offsets, idf, lat_q,
                                            args.docs, args.k)
     cpu_p50 = percentile_ms(cpu_times, 50)
     vs = cpu_p50 / p50 if p50 > 0 else 0.0
     log(f"bm25 single-query p50: tpu {p50:.2f} ms, p99 {p99:.2f} ms; "
         f"cpu p50 {cpu_p50:.2f} ms -> {vs:.1f}x (target >= 8x)")
+    PARTIAL.update(cpu_p50_ms=round(cpu_p50, 3),
+                   p50_speedup_vs_cpu=round(vs, 2),
+                   target_p50_speedup=8.0, target_met=bool(vs >= 8.0))
 
     # correctness spot check: product top-1 vs numpy oracle top-1
     n_chk = min(16, len(lat_q))
@@ -571,10 +643,13 @@ def run_bench(args, jax) -> dict:
             if r["hits"]["hits"] \
                     and int(r["hits"]["hits"][0]["_id"]) == cpu_top[0]:
                 got += 1
+            beat()  # size-1 shape class may compile on first call
         return got
 
     agree = top1_agreement(node)
     log(f"top-1 agreement vs numpy oracle: {agree}/{n_chk}")
+    PARTIAL["top1_agreement"] = round(agree / max(n_chk, 1), 3)
+    stage("tuned-single-query-latency")
 
     # SECONDARY: the tuned single-query config (ranking-grade matmul
     # precision + blocked top-k staging) on the SAME node — the knobs
@@ -610,6 +685,12 @@ def run_bench(args, jax) -> dict:
                 os.environ[name] = v
 
     # -- batched product path ------------------------------------------------
+    stage("batched-msearch")
+    if p50_fast > 0:
+        PARTIAL.update(p50_ms_tuned=round(p50_fast, 3),
+                       p50_speedup_vs_cpu_tuned=round(cpu_p50 / p50_fast, 2),
+                       tuned_top1_agreement=round(fast_agree / max(n_chk, 1),
+                                                  3))
     if dense_rows is not None:
         dense_mask = np.zeros(args.vocab, bool)
         dense_tids = np.nonzero(dense_rows >= 0)[0]
@@ -620,6 +701,9 @@ def run_bench(args, jax) -> dict:
         bm25_mfu_flops = 4.0 * len(bat_q) * impact.shape[0] * seg.max_docs
         log(f"batched msearch: {len(bat_q)} pure-dense queries in "
             f"{bdt * 1000:.0f} ms -> {batched_qps:.0f} qps")
+        PARTIAL.update(batched_qps=round(batched_qps, 1),
+                       value=round(batched_qps, 1))
+        stage("batched-msearch-mixed")
         # mixed Zipfian batch (rare-term scatter tails allowed): the
         # tier-2 hybrid batch path — realistic msearch traffic, not the
         # pure-dense best case
@@ -628,6 +712,8 @@ def run_bench(args, jax) -> dict:
         batched_qps_mixed, mdt = batched_msearch_qps(node, mixed_q, args.k)
         log(f"batched msearch mixed: {len(mixed_q)} queries in "
             f"{mdt * 1000:.0f} ms -> {batched_qps_mixed:.0f} qps")
+        PARTIAL["batched_qps_mixed"] = round(batched_qps_mixed, 1)
+        stage("batched-msearch-bf16")
         # secondary: bf16-quantized impact block (SURVEY §6 lever) — same
         # batch, block rebuilt in bf16; report throughput AND top-1
         # agreement vs the f32 path so the quantization cost is visible
@@ -645,7 +731,9 @@ def run_bench(args, jax) -> dict:
                 inv._dense_bytes = 0
                 inv._dense = None
                 inv._dense_host = None
+            beat()
             blk16 = inv.dense_block()
+            beat()  # bf16 block rebuild + transfer just completed
             if blk16 is not None:
                 batched_qps_bf16, bdt16 = batched_msearch_qps(
                     node, bat_q, args.k)
@@ -655,6 +743,8 @@ def run_bench(args, jax) -> dict:
                 log(f"batched msearch bf16 impacts: {bdt16 * 1000:.0f} ms "
                     f"-> {batched_qps_bf16:.0f} qps, top-1 agreement "
                     f"{bf16_agree:.3f}")
+                PARTIAL.update(batched_qps_bf16=round(batched_qps_bf16, 1),
+                               bf16_top1_agreement=round(bf16_agree, 3))
             else:
                 batched_qps_bf16, bf16_agree = 0.0, 0.0
         finally:
@@ -667,8 +757,10 @@ def run_bench(args, jax) -> dict:
 
     peak = peak_flops_bf16()
     bm25_mfu = (bm25_mfu_flops / bdt / peak) if peak else 0.0
+    PARTIAL["bm25_batched_mfu"] = round(bm25_mfu, 4)
 
     # -- kNN product path ----------------------------------------------------
+    stage("knn-segment-build")
     knn = {}
     mfu = 0.0
     if not args.skip_knn:
@@ -680,9 +772,11 @@ def run_bench(args, jax) -> dict:
         qvecs = vecs[qidx] + 0.1 * rng.standard_normal(
             (args.knn_queries, args.dims)).astype(np.float32)
 
+        stage("knn-exact-latency")
         times, got = knn_product_latency(sift_node, qvecs, args.k)
         knn["p50_ms"] = percentile_ms(times, 50)
         knn["p99_ms"] = percentile_ms(times, 99)
+        PARTIAL["knn"] = knn  # knn dict mutations flow into the record
 
         # exact numpy reference (same metric: cosine)
         qs = qvecs / np.linalg.norm(qvecs, axis=1, keepdims=True)
@@ -707,13 +801,16 @@ def run_bench(args, jax) -> dict:
             f"{knn['cpu_p50_ms']:.2f} ms ({knn['vs_cpu']:.1f}x), "
             f"recall@10 {rec:.3f}")
 
+        stage("knn-batched-mfu")
         flops_rate, kdt = knn_batched_mfu(sift_node, 256, args.dims,
                                           args.vecs, args.k, args.seed)
         mfu = (flops_rate / peak) if peak else 0.0
         log(f"knn batched (executor.search_knn, Q=256): {kdt * 1000:.0f} ms, "
             f"mfu {mfu:.3f}")
+        PARTIAL["mfu"] = round(mfu, 4)
 
         # IVF recall@10-vs-QPS curve through the product ANN path
+        stage("ivf-recall-curve")
         curve = []
         for nc in (1000, 4000, 16000):
             t0 = time.perf_counter()
@@ -737,6 +834,7 @@ def run_bench(args, jax) -> dict:
         log(f"WARNING: fallback budget exceeded — mesh_fallback_total="
             f"{mesh_fallback}, span_clause_truncated={span_trunc}")
 
+    stage("steady-state-floor")
     # steady-state floor: the same trivial call AFTER the workload ran —
     # some host-device links (tunneled chips) settle into a slower
     # synchronized mode once large transfers have occurred; p50 should be
@@ -765,6 +863,7 @@ def run_bench(args, jax) -> dict:
         "p99_ms": round(p99, 3),
         "cpu_p50_ms": round(cpu_p50, 3),
         "p50_speedup_vs_cpu": round(vs, 2),
+        "top1_agreement": round(agree / max(n_chk, 1), 3),
         "p50_ms_tuned": round(p50_fast, 3),
         "p50_speedup_vs_cpu_tuned": round(
             cpu_p50 / p50_fast if p50_fast > 0 else 0.0, 2),
