@@ -8,13 +8,18 @@ SpanOrQuery, SpanFirstQuery, SpanMultiTermQueryWrapper).
 Execution model — device programs for the common shapes, host interval
 walks only for deep nesting:
 
-* span_near over span_term clauses (ordered AND unordered) runs as ONE
-  vectorized anchor-entry program over the positional CSR
-  (ops/positional.py phrase_freq_program ordered/unordered modes), scored
-  with Lucene's sloppy freq (idf_sum * tfNorm(Σ 1/(1+matchLength))).
-  Deviation: per anchor the program chains/choses the NEAREST window
-  (Lucene explores alternatives for repeated terms); the oracle tests
-  mirror this, and it equals Lucene on non-degenerate spans.
+* span_near over span_term clauses (ordered any arity; unordered with 2
+  clauses) runs as ONE vectorized anchor-entry program over the
+  positional CSR (ops/positional.py phrase_freq_program
+  ordered/unordered modes), scored with Lucene's sloppy freq
+  (idf_sum * tfNorm(Σ 1/(1+matchLength))). Both shapes are per-anchor
+  optimal, so the device match set equals Lucene's: ordered greedy
+  chaining to the first position ≥ prev end anchored at EVERY first-
+  clause occurrence is NearSpansOrdered; 2-clause unordered nearest-to-
+  anchor minimizes the window per anchor (overlap allowed, matching
+  Lucene 5's NearSpansUnordered quirk). Unordered with ≥3 clauses goes
+  to the host walk instead — greedy nearest-per-clause has false
+  negatives there (tests/unit/test_spans.py pins the counterexample).
 * span_or over terms / a bare span_term / span_multi expansions: the
   match mask IS the device term-union mask — every doc containing a term
   has a span, no verification pass exists at all.
@@ -71,6 +76,11 @@ class SpanNode:
 
     def spans(self, ctx, doc: int) -> List[Interval]:
         raise NotImplementedError
+
+    def any_span(self, ctx, doc: int) -> bool:
+        """Existence check — overridden where a full spans() enumeration
+        would be wasteful (SpanNearNode's combination walk)."""
+        return bool(self.spans(ctx, doc))
 
     def terms(self) -> List[Tuple[str, str]]:
         """(field, term) leaves — used for BM25 scoring of matched docs."""
@@ -201,7 +211,7 @@ class SpanNearNode(SpanNode):
                 break
         return out
 
-    def spans(self, ctx, doc: int) -> List[Interval]:
+    def _clause_spans(self, ctx, doc: int) -> Optional[List[List[Interval]]]:
         full = [c.spans(ctx, doc) for c in self.clauses]
         per = [p[:MAX_SPANS_PER_CLAUSE] for p in full]
         if any(len(f) > MAX_SPANS_PER_CLAUSE for f in full):
@@ -209,24 +219,60 @@ class SpanNearNode(SpanNode):
 
             kernels.record("span_clause_truncated")
         if any(not p for p in per):
-            return []
+            return None
+        return per
+
+    def _walk(self, per: List[List[Interval]], first_only: bool
+              ) -> List[Interval]:
+        """Combination walk over per-clause span lists. Pruning: adding a
+        span never shrinks the window spread, and each remaining clause
+        can add at most its longest span to the total length, so a partial
+        whose matchSlop can no longer reach `slop` is dead. With
+        first_only the walk stops at the first valid window (execute()
+        only needs existence), keeping common unordered walks linear-ish
+        instead of 128^k."""
+        if not self.in_order:
+            # unordered combinations are order-free: walk scarcest clause
+            # first so dead branches die at depth 1
+            per = sorted(per, key=len)
+        # max total-length the clauses from index i onward can still add
+        max_len = [max(e - s for s, e in p) for p in per]
+        suffix = [0] * (len(per) + 1)
+        for i in range(len(per) - 1, -1, -1):
+            suffix[i] = suffix[i + 1] + max_len[i]
         found: List[Interval] = []
 
-        def rec(i: int, chosen: List[Interval]):
+        def rec(i: int, chosen: List[Interval], lo: int, hi: int, tl: int
+                ) -> bool:
             if i == len(per):
-                lo = min(s for s, _ in chosen)
-                hi = max(e for _, e in chosen)
-                tl = sum(e - s for s, e in chosen)
                 if (hi - lo) - tl <= self.slop:
                     found.append((lo, hi))
-                return
+                    return first_only
+                return False
             for sp in per[i]:
                 if self.in_order and chosen and sp[0] < chosen[-1][1]:
                     continue
-                rec(i + 1, chosen + [sp])
+                nlo = min(lo, sp[0]) if chosen else sp[0]
+                nhi = max(hi, sp[1]) if chosen else sp[1]
+                ntl = tl + (sp[1] - sp[0])
+                if (nhi - nlo) - (ntl + suffix[i + 1]) > self.slop:
+                    continue  # no suffix completion can recover
+                if rec(i + 1, chosen + [sp], nlo, nhi, ntl):
+                    return True
+            return False
 
-        rec(0, [])
+        rec(0, [], 0, 0, 0)
         return sorted(set(found))
+
+    def any_span(self, ctx, doc: int) -> bool:
+        per = self._clause_spans(ctx, doc)
+        return bool(per and self._walk(per, first_only=True))
+
+    def spans(self, ctx, doc: int) -> List[Interval]:
+        per = self._clause_spans(ctx, doc)
+        if per is None:
+            return []
+        return self._walk(per, first_only=False)
 
     def terms(self):
         return [t for c in self.clauses for t in c.terms()]
@@ -323,7 +369,7 @@ class SpanQueryWrapper(Query):
         cand = self.node.candidate_docs(ctx)
         ok = np.zeros(ctx.D, dtype=bool)
         for d in np.unique(cand):
-            if self.node.spans(ctx, int(d)):
+            if self.node.any_span(ctx, int(d)):
                 ok[d] = True
         mask = jnp.asarray(ok)
         if not ok.any():
@@ -392,6 +438,16 @@ class SpanQueryWrapper(Query):
         if not all(isinstance(c, SpanTermNode) for c in node.clauses):
             return None
         if len({c.field for c in node.clauses}) != 1 or len(node.clauses) < 2:
+            return None
+        if not node.in_order and len(node.clauses) >= 3:
+            # the greedy nearest-per-clause program can miss valid windows
+            # here (choosing the nearest occurrence of clause B can push
+            # the combined window over the slop when a farther B admits a
+            # tighter window with C) — a false negative Lucene's
+            # NearSpansUnordered window-sliding never makes. The host walk
+            # explores all combinations with the exact matchSlop
+            # condition. Ordered chaining and 2-clause unordered are
+            # per-anchor optimal, so they stay on the device program.
             return None
         inv = ctx.inv(node.field)
         if inv is None or inv.positions is None:

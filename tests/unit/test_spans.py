@@ -211,3 +211,55 @@ def test_span_truncation_is_surfaced():
     s.search({"query": q, "size": 5})
     assert kernels.snapshot().get("span_clause_truncated", 0) >= 1
     s.close()
+
+
+def test_span_near_unordered_three_clauses_explores_alternatives():
+    """Unordered near with >= 3 clauses must not take the greedy
+    nearest-per-clause shortcut: with b@7, a@10, b@14, c@15 the b nearest
+    to the anchor (b@7, distance 3) yields window [7,16) with
+    matchSlop 6 > 5, but Lucene's NearSpansUnordered finds the b@14
+    window [10,16) with matchSlop 3 <= 5. Routed to the host walk, which
+    explores all combinations (spans.py::_device_near guard)."""
+    s = IndexService("span_unord3", mappings_json={"properties": {
+        "body": {"type": "text", "analyzer": "whitespace"}}})
+    toks = [f"x{i}" for i in range(18)]
+    toks[7] = "b"
+    toks[10] = "a"
+    toks[14] = "b"
+    toks[15] = "c"
+    s.index_doc("0", {"body": " ".join(toks)})
+    for sh in s.shards:
+        sh.refresh()
+    q = {"span_near": {"clauses": [
+        {"span_term": {"body": "a"}},
+        {"span_term": {"body": "b"}},
+        {"span_term": {"body": "c"}}], "slop": 5, "in_order": False}}
+    assert hits(s, q) == ["0"]
+    # tighter slop excludes even the best window (matchSlop 3)
+    q["span_near"]["slop"] = 2
+    assert hits(s, q) == []
+    s.close()
+
+
+def test_span_near_unordered_repeated_term_overlap_quirk():
+    """Lucene 5's NearSpansUnordered allows overlapping subspans, so
+    span_near [a, a] unordered matches a SINGLE 'a' occurrence (both
+    subspans sit on the same position; matchSlop is negative). The
+    2-clause device program reproduces this: nearest-'a'-to-anchor is the
+    anchor itself."""
+    s = IndexService("span_rep", mappings_json={"properties": {
+        "body": {"type": "text", "analyzer": "whitespace"}}})
+    s.index_doc("0", {"body": "z z a z z"})   # single occurrence
+    s.index_doc("1", {"body": "a w a"})        # two occurrences
+    s.index_doc("2", {"body": "w w w"})        # none
+    for sh in s.shards:
+        sh.refresh()
+    q = {"span_near": {"clauses": [
+        {"span_term": {"body": "a"}},
+        {"span_term": {"body": "a"}}], "slop": 1, "in_order": False}}
+    assert hits(s, q) == ["0", "1"]
+    # ordered requires two DISTINCT ascending positions (docSpansOrdered)
+    q["span_near"]["in_order"] = True
+    q["span_near"]["slop"] = 2
+    assert hits(s, q) == ["1"]
+    s.close()
