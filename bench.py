@@ -534,21 +534,41 @@ def main():
     # heartbeat goes stale, emit every metric that already landed
     # (PARTIAL) plus the stage that hung, then hard-exit: partial perf
     # evidence beats none.
+    # stages whose body is ONE un-beatable device call that may legitimately
+    # compile for minutes on a cold compilation cache (Q=2048 batch jit)
+    compile_heavy = ("batched-msearch", "batched-msearch-mixed",
+                     "batched-msearch-bf16", "knn-batched-mfu")
+
     def _stall_watchdog():
         while True:
             time.sleep(10.0)
             idle = time.monotonic() - _LAST_BEAT
-            if idle > args.stall_timeout:
-                emit_record({
-                    "target_met": False,  # PARTIAL overrides once measured
-                    **PARTIAL,
-                    "backend": backend,
-                    "error": f"stalled: no progress for {idle:.0f}s "
-                             f"during stage '{CURRENT_STAGE}' "
-                             f"(tunnel hang?); record holds all metrics "
-                             f"captured before the stall",
-                })
-                os._exit(1)
+            allowed = args.stall_timeout * (
+                2.0 if CURRENT_STAGE in compile_heavy else 1.0)
+            if idle > allowed:
+                try:
+                    # snapshot defensively: the main thread may mutate
+                    # PARTIAL (or the aliased knn dict) mid-copy
+                    snap = {}
+                    for _ in range(3):
+                        try:
+                            snap = {k: (dict(v) if isinstance(v, dict)
+                                        else v)
+                                    for k, v in list(PARTIAL.items())}
+                            break
+                        except RuntimeError:
+                            continue
+                    emit_record({
+                        "target_met": False,  # snap overrides once measured
+                        **snap,
+                        "backend": backend,
+                        "error": f"stalled: no progress for {idle:.0f}s "
+                                 f"during stage '{CURRENT_STAGE}' "
+                                 f"(tunnel hang?); record holds all "
+                                 f"metrics captured before the stall",
+                    })
+                finally:
+                    os._exit(1)  # the watchdog must never die silently
 
     if args.stall_timeout > 0:
         threading.Thread(target=_stall_watchdog, daemon=True).start()
@@ -686,11 +706,11 @@ def run_bench(args, jax) -> dict:
 
     # -- batched product path ------------------------------------------------
     stage("batched-msearch")
-    if p50_fast > 0:
-        PARTIAL.update(p50_ms_tuned=round(p50_fast, 3),
-                       p50_speedup_vs_cpu_tuned=round(cpu_p50 / p50_fast, 2),
-                       tuned_top1_agreement=round(fast_agree / max(n_chk, 1),
-                                                  3))
+    PARTIAL.update(
+        p50_ms_tuned=round(p50_fast, 3),
+        p50_speedup_vs_cpu_tuned=round(
+            cpu_p50 / p50_fast if p50_fast > 0 else 0.0, 2),
+        tuned_top1_agreement=round(fast_agree / max(n_chk, 1), 3))
     if dense_rows is not None:
         dense_mask = np.zeros(args.vocab, bool)
         dense_tids = np.nonzero(dense_rows >= 0)[0]
@@ -701,8 +721,10 @@ def run_bench(args, jax) -> dict:
         bm25_mfu_flops = 4.0 * len(bat_q) * impact.shape[0] * seg.max_docs
         log(f"batched msearch: {len(bat_q)} pure-dense queries in "
             f"{bdt * 1000:.0f} ms -> {batched_qps:.0f} qps")
+        cpu_qps_now = 1000.0 / cpu_p50 if cpu_p50 > 0 else 1.0
         PARTIAL.update(batched_qps=round(batched_qps, 1),
-                       value=round(batched_qps, 1))
+                       value=round(batched_qps, 1),
+                       vs_baseline=round(batched_qps / cpu_qps_now, 2))
         stage("batched-msearch-mixed")
         # mixed Zipfian batch (rare-term scatter tails allowed): the
         # tier-2 hybrid batch path — realistic msearch traffic, not the
@@ -853,37 +875,28 @@ def run_bench(args, jax) -> dict:
     # BASELINE >=8x p50 target are reported alongside, un-massaged — on a
     # network-tunneled chip per-call dispatch RTT dominates single-query
     # latency (see p50_ms vs batched amortization).
+    # the record IS the PARTIAL dict (every metric was written into it at
+    # measurement time, so a stall record is a strict prefix of this one)
+    # plus the end-only fields
     cpu_qps = 1000.0 / cpu_p50 if cpu_p50 > 0 else 1.0
-    return {
+    PARTIAL.update({
         "metric": "bm25_batched_qps",
         "value": round(batched_qps, 1),
         "unit": "qps",
         "vs_baseline": round(batched_qps / cpu_qps, 2),
-        "p50_ms": round(p50, 3),
-        "p99_ms": round(p99, 3),
-        "cpu_p50_ms": round(cpu_p50, 3),
-        "p50_speedup_vs_cpu": round(vs, 2),
-        "top1_agreement": round(agree / max(n_chk, 1), 3),
-        "p50_ms_tuned": round(p50_fast, 3),
-        "p50_speedup_vs_cpu_tuned": round(
-            cpu_p50 / p50_fast if p50_fast > 0 else 0.0, 2),
-        "tuned_top1_agreement": round(fast_agree / max(n_chk, 1), 3),
-        "dispatch_floor_ms": round(dispatch_floor_ms, 3),
-        "dispatch_floor_steady_ms": round(floor_steady_ms, 3),
         "batched_qps": round(batched_qps, 1),
         "batched_qps_mixed": round(batched_qps_mixed, 1),
         "batched_qps_bf16": round(batched_qps_bf16, 1),
         "bf16_top1_agreement": round(bf16_agree, 3),
         "mfu": round(mfu, 4),
-        "bm25_batched_mfu": round(bm25_mfu, 4),
-        "target_p50_speedup": 8.0,
-        "target_met": bool(vs >= 8.0),
+        "dispatch_floor_steady_ms": round(floor_steady_ms, 3),
         "mesh_fallback_total": mesh_fallback,
         "span_clause_truncated": span_trunc,
         "fallback_budget_met": bool(mesh_fallback == 0 and span_trunc == 0),
         "docs": args.docs,
         "knn": knn,
-    }
+    })
+    return dict(PARTIAL)
 
 
 if __name__ == "__main__":
