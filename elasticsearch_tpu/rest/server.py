@@ -2586,18 +2586,21 @@ def _dist_percolate(n: Node, c, index: str, type: str, body: dict):
     per-query-id dedup — replica fanout copies a registration onto
     replica holders' registries too, so without the dedup (and the
     primary-owner targeting) the same query would match once per copy.
-    Aggs-under-percolate can't reduce from per-node FINAL aggs, so it is
-    rejected with a clear error (DEVIATIONS.md)."""
+    Aggs-under-percolate run as a DISTRIBUTED search over the matched
+    registration docs after the fan (ids filter + size 0), so partials
+    reduce through the same query-then-fetch agg machinery as any other
+    search — per-node FINAL aggs never need merging."""
     import json as _json_mod
     from urllib.parse import quote
 
     from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
 
-    if body.get("aggs") or body.get("aggregations"):
-        raise IllegalArgumentException(
-            "aggregations inside percolate are not supported on a "
-            "multi-host distributed index (registered queries are "
-            "partitioned across processes)")
+    aggs_spec = body.get("aggs") or body.get("aggregations")
+    # owners must not compute (and discard) local FINAL aggs, and must not
+    # truncate their match pages — "total", and the aggs below, are over
+    # ALL matches; the coordinator applies size itself after the merge
+    fan_body = {k: v for k, v in body.items()
+                if k not in ("aggs", "aggregations", "size")}
     rname = c.data.resolve_index(index)
     meta = c.data._meta(rname)
     by_owner: Dict[str, int] = {}
@@ -2611,7 +2614,7 @@ def _dist_percolate(n: Node, c, index: str, type: str, body: dict):
     req = {"method": "POST",
            "path": (f"/{quote(index, safe='')}/"
                     f"{quote(type, safe='')}/_percolate"),
-           "params": {}, "body": _json_mod.dumps(body)}
+           "params": {}, "body": _json_mod.dumps(fan_body)}
     matches: list = []
     seen_ids: set = set()
     for owner, n_shards in sorted(by_owner.items()):
@@ -2633,14 +2636,27 @@ def _dist_percolate(n: Node, c, index: str, type: str, body: dict):
                 matches.append(m)
     total = len(matches)
     size = body.get("size")
+    full_ids = [m.get("_id") for m in matches]
     if size is not None:
         matches = matches[: int(size)]
     total_shards = meta["num_shards"]
-    return 200, {"took": 0,
-                 "_shards": {"total": total_shards,
-                             "successful": total_shards - failed_shards,
-                             "failed": failed_shards},
-                 "total": total, "matches": matches}
+    out = {"took": 0,
+           "_shards": {"total": total_shards,
+                       "successful": total_shards - failed_shards,
+                       "failed": failed_shards},
+           "total": total, "matches": matches}
+    if aggs_spec is not None:
+        from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
+
+        # same semantics as IndexService.percolate: aggregate over ALL
+        # matched registrations' metadata (not the size-truncated page),
+        # via the distributed search's shard-partial agg reduce
+        r = c.data.search(index, {"query": {"bool": {"filter": [
+            {"term": {"_type": PERCOLATOR_TYPE}},
+            {"ids": {"values": full_ids}}]}},
+            "size": 0, "aggs": aggs_spec})
+        out["aggregations"] = r.get("aggregations", {})
+    return 200, out
 
 
 def _percolate(n: Node, p, b, index: str, type: str):

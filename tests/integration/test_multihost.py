@@ -634,10 +634,11 @@ def test_doc_level_and_scroll_ops_cross_host(master):
 
         # percolate: queries register as routed docs (disjoint subsets on
         # each owner); a match registered on the REMOTE owner must surface
-        for qid, term in (("q_local", "alpha"), ("q2", "beta"),
-                          ("q3", "zebra")):
+        for qid, term, team in (("q_local", "alpha", "red"),
+                                ("q2", "beta", "blue"),
+                                ("q3", "zebra", "red")):
             st, _ = req("PUT", f"/dlo/.percolator/{qid}",
-                        {"query": {"match": {"body": term}}})
+                        {"query": {"match": {"body": term}}, "team": team})
             assert st in (200, 201)
         req("POST", "/dlo/_refresh")
         st, r = req("POST", "/dlo/t/_percolate",
@@ -645,11 +646,28 @@ def test_doc_level_and_scroll_ops_cross_host(master):
         assert st == 200, r
         assert r["total"] == 2, r
         assert {m["_id"] for m in r["matches"]} == {"q_local", "q2"}, r
-        # aggs-under-percolate on a dist index: explicit refusal
+        # aggs-under-percolate on a dist index: aggregates the MATCHED
+        # registrations' metadata cluster-wide (the matched queries live
+        # on different owners; partials reduce via the distributed
+        # search, server.py::_dist_percolate). q3 (unmatched, team=red)
+        # must not count.
         st, r = req("POST", "/dlo/t/_percolate", {
-            "doc": {"body": "alpha"},
-            "aggs": {"x": {"terms": {"field": "body"}}}})
-        assert st == 400, (st, r)
+            "doc": {"body": "alpha beta words"},
+            "aggs": {"teams": {"terms": {"field": "team"}}}})
+        assert st == 200, (st, r)
+        assert r["total"] == 2, r
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["teams"]["buckets"]}
+        assert buckets == {"red": 1, "blue": 1}, buckets
+        # size truncates the match PAGE only: total and aggs still cover
+        # all matches (owners fan without size; coordinator re-truncates)
+        st, r = req("POST", "/dlo/t/_percolate", {
+            "doc": {"body": "alpha beta words"}, "size": 1,
+            "aggs": {"teams": {"terms": {"field": "team"}}}})
+        assert st == 200 and r["total"] == 2 and len(r["matches"]) == 1, r
+        buckets = {b["key"]: b["doc_count"]
+                   for b in r["aggregations"]["teams"]["buckets"]}
+        assert buckets == {"red": 1, "blue": 1}, buckets
 
         # field_stats merges across owners (doc_count must be the
         # cluster-wide 30, not a local subset or a replica-doubled 60)
