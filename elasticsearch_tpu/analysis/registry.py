@@ -46,6 +46,27 @@ class AnalysisRegistry:
         self._cache[name] = an
         return an
 
+    def validate(self) -> None:
+        """Eagerly resolve every declared custom analyzer AND every shared
+        tokenizer/filter/char_filter — referenced or not — so an index
+        creation with a broken analysis config fails up front (reference:
+        AnalysisService's constructor builds all configured components and
+        index creation propagates the failure). Raises ValueError /
+        KeyError / TypeError on broken definitions."""
+        for name in self._custom:
+            self.get(name)
+        # probe each shared component through the same resolution path a
+        # referencing analyzer would take
+        for tok in self._shared["tokenizer"]:
+            build_custom_analyzer("_probe", {"tokenizer": tok}, self._shared)
+        for filt in self._shared["filter"]:
+            build_custom_analyzer("_probe", {"tokenizer": "standard",
+                                             "filter": [filt]}, self._shared)
+        for cf in self._shared["char_filter"]:
+            build_custom_analyzer("_probe", {"tokenizer": "standard",
+                                             "char_filter": [cf]},
+                                  self._shared)
+
     @property
     def default(self) -> Analyzer:
         if "default" in self._custom:

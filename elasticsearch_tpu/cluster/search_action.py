@@ -427,8 +427,10 @@ class DistributedDataService:
         from elasticsearch_tpu.index.snapshots import FsRepository, \
             select_restore_targets
 
+        # restore only READS the repository — never mkdir its location
+        # (a url repo's location is not a local path at all)
         repo = FsRepository(payload.get("repo_name") or "_snapshot",
-                            payload["location"])
+                            payload["location"], create=False)
         snap = payload["snapshot"]
         manifest = repo.get_manifest(snap)
         indices = payload.get("indices")
@@ -541,8 +543,9 @@ class DistributedDataService:
             if not self.node.index_exists(index):
                 self.node.create_index(index, payload.get("body"))
         svc = self.node.indices[index]
+        # read-side handle: restore never writes, so never mkdir
         repo = FsRepository(payload.get("repo_name") or "_snapshot",
-                            payload["location"])
+                            payload["location"], create=False)
         imeta = repo.get_manifest(payload["snapshot"])["indices"][
             payload["src"]]
         for sid in payload["shards"]:

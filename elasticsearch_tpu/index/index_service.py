@@ -103,8 +103,19 @@ class IndexService:
     def _validate_analyzers(self, mappings: Mappings):
         """Reject mappings naming analyzers the registry can't build —
         reference: MapperService fails index creation on unknown analyzers."""
-        from elasticsearch_tpu.utils.errors import MapperParsingException
+        from elasticsearch_tpu.utils.errors import (IllegalArgumentException,
+                                                    MapperParsingException)
 
+        try:
+            # every DECLARED analyzer must build, referenced or not
+            # (reference: AnalysisService constructs all configured
+            # analyzers; a broken settings.analysis fails the creation).
+            # KeyError/TypeError cover malformed shared definitions (a
+            # tokenizer entry missing "type", non-dict config values).
+            self.analysis.validate()
+        except (ValueError, KeyError, TypeError) as e:
+            raise IllegalArgumentException(
+                f"failed to build analysis components: {e}") from e
         for name, fm in mappings.fields.items():
             if not getattr(fm, "is_text", False):
                 continue

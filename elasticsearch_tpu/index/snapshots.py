@@ -48,12 +48,18 @@ class SnapshotException(ElasticsearchTpuException):
 class FsRepository:
     """Content-addressed blob store on the local filesystem."""
 
-    def __init__(self, name: str, location: str, compress: bool = True):
+    def __init__(self, name: str, location: str, compress: bool = True,
+                 create: bool = True):
+        """``create=False`` registers without touching the filesystem —
+        read-only url repositories (reference: repositories/uri/
+        URLRepository.java) must never mkdir their location (a non-file
+        URL would otherwise materialize as a literal ``http:`` dir)."""
         self.name = name
         self.location = location
         self.compress = compress
-        os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
-        os.makedirs(os.path.join(location, "snapshots"), exist_ok=True)
+        if create:
+            os.makedirs(os.path.join(location, "blobs"), exist_ok=True)
+            os.makedirs(os.path.join(location, "snapshots"), exist_ok=True)
 
     # -- blobs -----------------------------------------------------------------
 
@@ -340,6 +346,20 @@ def select_restore_targets(node, manifest: dict,
                 f"cannot restore index [{iname}]: the snapshot contains "
                 f"failed shards (pass partial=true to restore the "
                 f"available shards; missing ones come back empty)")
+        # analysis configs must BUILD before anything restores: a snapshot
+        # carrying a broken settings.analysis (written before creation-time
+        # validation existed) would otherwise fail create_index mid-loop
+        # with earlier indices already restored
+        settings = imeta.get("settings")
+        if settings:
+            from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+
+            try:
+                AnalysisRegistry(settings).validate()
+            except Exception as e:
+                raise SnapshotException(
+                    f"cannot restore index [{iname}]: analysis config does "
+                    f"not build: {e}")
         selected.append((iname, target, imeta))
     return selected
 

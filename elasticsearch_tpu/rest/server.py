@@ -648,9 +648,11 @@ def _put_repo(n: Node, p, b, repo: str):
         from urllib.parse import urlparse as _up
         from urllib.request import url2pathname
 
-        loc = (url2pathname(_up(url).path) if url.startswith("file:")
-               else url)  # non-file URLs register but cannot restore
-        r = FsRepository(repo, loc, compress=True)
+        is_file = url.startswith("file:")
+        loc = url2pathname(_up(url).path) if is_file else url
+        # read-only: never create directories (a non-file URL location is
+        # not a path at all; reads against it 404 as snapshot-missing)
+        r = FsRepository(repo, loc, compress=True, create=False)
         r.readonly = True
     else:
         raise IllegalArgumentException(
@@ -713,6 +715,7 @@ def _put_snapshot(n: Node, p, b, repo: str, snap: str):
     if indices:
         indices = [name for pat in indices for name in n.resolve_indices(pat)]
     r = _repo_or_404(n, repo)
+    _reject_readonly_repo(r)
     c = _mh(n)
     if c is not None:
         # multi-host: each shard's owner writes its own blobs into the
@@ -735,8 +738,19 @@ def _get_snapshot(n: Node, p, b, repo: str, snap: str):
     return 200, {"snapshots": [snapshot_info(r, snap)]}
 
 
+def _reject_readonly_repo(r):
+    """Writes against a url repository fail cleanly (reference:
+    URLRepository is read-only; snapshot creation raises a repository
+    exception instead of touching the location)."""
+    if getattr(r, "readonly", False):
+        raise IllegalArgumentException(
+            f"repository [{r.name}] is read-only; cannot write snapshots")
+
+
 def _delete_snapshot(n: Node, p, b, repo: str, snap: str):
-    _repo_or_404(n, repo).delete_snapshot(snap)
+    r = _repo_or_404(n, repo)
+    _reject_readonly_repo(r)
+    r.delete_snapshot(snap)
     return 200, {"acknowledged": True}
 
 
@@ -3746,6 +3760,10 @@ def _verify_repo(n: Node, p, b, repo: str):
     import os as _os
 
     r = _repo_or_404(n, repo)
+    if getattr(r, "readonly", False):
+        # url repositories are read-only: verification never writes
+        # (reference: URLRepository has no write verification marker)
+        return 200, {"nodes": {n.node_id: {"name": n.name}}}
     probe = _os.path.join(r.location, f".verify-{n.node_id}")
     try:
         with open(probe, "w") as fh:

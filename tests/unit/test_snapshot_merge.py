@@ -192,3 +192,102 @@ def test_peer_recovery_copies_docs():
     assert dst.num_docs == 4
     src.close()
     dst.close()
+
+
+def test_url_repository_readonly_no_mkdir(node, tmp_path, monkeypatch):
+    """url repositories must never mkdir their location (a non-file URL is
+    not a path: a literal ``http:`` dir would appear in cwd), verify must
+    succeed without a write probe, and snapshot writes must 400.
+    Reference: repositories/uri/URLRepository.java (read-only)."""
+    from elasticsearch_tpu.rest.server import (_put_repo, _put_snapshot,
+                                               _delete_snapshot,
+                                               _verify_repo)
+    from elasticsearch_tpu.utils.errors import IllegalArgumentException
+    import json
+
+    monkeypatch.chdir(tmp_path)
+    body = json.dumps({"type": "url",
+                       "settings": {"url": "http://snapshot.probe"}}).encode()
+    status, _ = _put_repo(node, {}, body, repo="urepo")
+    assert status == 200
+    assert not os.path.exists(os.path.join(str(tmp_path), "http:"))
+    status, resp = _verify_repo(node, {}, b"", repo="urepo")
+    assert status == 200 and "nodes" in resp
+    assert not os.path.exists(os.path.join(str(tmp_path), "http:"))
+    with pytest.raises(IllegalArgumentException):
+        _put_snapshot(node, {}, b"{}", repo="urepo", snap="s1")
+    with pytest.raises(IllegalArgumentException):
+        _delete_snapshot(node, {}, b"", repo="urepo", snap="s1")
+    assert not os.path.exists(os.path.join(str(tmp_path), "http:"))
+
+
+def test_file_url_repository_restores_readonly(node, tmp_path):
+    """A file: url repository reads snapshots written by an fs repository
+    (the reference's URL-repo use case: serve a shared fs repo read-only)."""
+    repo = FsRepository("w", str(tmp_path))
+    create_snapshot(node, repo, "s1", indices=["books"])
+    ro = FsRepository("ro", str(tmp_path), create=False)
+    ro.readonly = True
+    node.indices["books"].close()
+    del node.indices["books"]
+    restore_snapshot(node, ro, "s1")
+    assert node.indices["books"].count({})["count"] == 9
+
+
+def test_broken_analysis_config_rejected_at_creation():
+    """Index creation with an unknown analyzer type (or malformed shared
+    component) fails up front — reference: AnalysisService builds every
+    configured analyzer at construction."""
+    from elasticsearch_tpu.utils.errors import IllegalArgumentException
+
+    n = Node()
+    with pytest.raises(IllegalArgumentException):
+        n.create_index("bad1", {"settings": {"analysis": {
+            "analyzer": {"x": {"type": "nosuch"}}}}})
+    with pytest.raises(IllegalArgumentException):
+        n.create_index("bad2", {"settings": {"analysis": {
+            "tokenizer": {"my_tok": {"pattern": "x"}},  # no "type"
+            "analyzer": {"x": {"tokenizer": "my_tok"}}}}})
+    # a valid custom config still creates
+    n.create_index("ok", {"settings": {"analysis": {
+        "analyzer": {"x": {"tokenizer": "standard",
+                           "filter": ["lowercase"]}}}}})
+    assert "ok" in n.indices
+
+
+def test_unreferenced_broken_shared_component_rejected():
+    """Even a shared tokenizer no analyzer references must build at
+    creation (reference: AnalysisService constructs every configured
+    component)."""
+    from elasticsearch_tpu.utils.errors import IllegalArgumentException
+
+    n = Node()
+    with pytest.raises(IllegalArgumentException):
+        n.create_index("bad3", {"settings": {"analysis": {
+            "tokenizer": {"my_tok": {"pattern": "x"}}}}})  # no "type"
+
+
+def test_restore_broken_analysis_fails_before_any_index(node, tmp_path):
+    """A manifest carrying a broken analysis config (written before
+    creation-time validation) fails the WHOLE restore up front — no index
+    from the snapshot may exist afterwards."""
+    import json as _json
+
+    repo = FsRepository("r", str(tmp_path))
+    create_snapshot(node, repo, "s1", indices=["books"])
+    # corrupt the manifest: add a second index whose settings can't build.
+    m = repo.get_manifest("s1")
+    good = m["indices"]["books"]
+    m["indices"]["zz_broken"] = {
+        "settings": {"analysis": {"analyzer": {"x": {"type": "nosuch"}}}},
+        "mappings": {}, "aliases": {}, "shards": good["shards"],
+    }
+    path = os.path.join(str(tmp_path), "snapshots", "s1.json")
+    with open(path, "w") as fh:
+        _json.dump(m, fh)
+    node.indices["books"].close()
+    del node.indices["books"]
+    with pytest.raises(SnapshotException):
+        restore_snapshot(node, repo, "s1")
+    # fail-up-front: NOTHING restored, not even the healthy index
+    assert "books" not in node.indices and "zz_broken" not in node.indices

@@ -1,0 +1,225 @@
+"""Break down the single-query device program cost on the real backend.
+
+Times each piece of the hybrid BM25 single-query program at bench shapes
+(1M docs) to find where the ~70 ms goes. The tunneled backend's
+``block_until_ready`` does not actually block, so every timed program
+reduces its big outputs to a handful of scalars ON DEVICE (``max`` —
+algebraically irreducible, unlike ``sum``) and the harness times the
+host PULL of those scalars: enqueue → execute → tiny d2h, exactly like
+the product's packed-result pull. Run: `python tools/device_breakdown.py
+[docs]`.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+docs = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+sys.argv = [sys.argv[0]]
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+from elasticsearch_tpu.utils.platform import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from elasticsearch_tpu.index.segment import build_dense_impact  # noqa: E402
+from elasticsearch_tpu.search.context import split_runs  # noqa: E402
+from elasticsearch_tpu.utils.shapes import pow2_bucket  # noqa: E402
+
+vocab = 30000
+u_doc, tf, tfn, offsets, df, idf, doc_len = bench.build_corpus(docs, vocab, 42)
+D = pow2_bucket(docs, minimum=64)
+nnz = u_doc.shape[0]
+nnz_pad = pow2_bucket(nnz, minimum=8)
+
+print(f"docs={docs} D={D} nnz={nnz}", flush=True)
+t0 = time.perf_counter()
+rows, impact = build_dense_impact(u_doc, tfn, offsets, df, D)
+F = impact.shape[0]
+print(f"dense block: F={F} ({int((rows >= 0).sum())} dense terms) "
+      f"built in {time.perf_counter() - t0:.1f}s", flush=True)
+
+pad_doc = np.full(nnz_pad, D, np.int32)
+pad_doc[:nnz] = u_doc
+pad_tfn = np.zeros(nnz_pad, np.float32)
+pad_tfn[:nnz] = tfn
+
+d_impact = jax.device_put(impact)
+d_doc = jax.device_put(pad_doc)
+d_tfn = jax.device_put(pad_tfn)
+
+qs = bench.make_queries(16, vocab, df, 42)
+
+# per-query prep exactly like HybridTGroupPrim.build
+preps = []
+Tmax, Pmax, Rmax = 1, 1, 1
+for q in qs:
+    qw = np.zeros(F, np.float32)
+    runs = []
+    qrows, qrw = [], []
+    for t in q:
+        t = int(t)
+        w = float(idf[t])
+        r = int(rows[t])
+        if r >= 0:
+            qw[r] += w
+            qrows.append(r)
+            qrw.append(w)
+        else:
+            s0 = int(offsets[t])
+            runs.append((s0, int(offsets[t + 1]) - s0, w))
+    starts, lens, ws, max_len = split_runs(runs) if runs else ([], [], [], 1)
+    Tmax = max(Tmax, len(starts), 1)
+    Pmax = max(Pmax, pow2_bucket(max_len))
+    Rmax = max(Rmax, len(qrows), 1)
+    preps.append((qw, qrows, qrw, starts, lens, ws))
+T = pow2_bucket(Tmax, minimum=1)
+R = pow2_bucket(Rmax, minimum=1)
+P = Pmax
+tail_elems = [sum(l for l in p[4]) for p in preps]
+print(f"shapes: T={T} P={P} R={R}; tail elems/query "
+      f"p50={int(np.median(tail_elems))} max={max(tail_elems)}", flush=True)
+
+
+def pad(a, n, fill, dtype):
+    out = np.full(n, fill, dtype)
+    out[: len(a)] = a
+    return out
+
+
+per_q = [(jax.device_put(preps[i][0]),
+          jax.device_put(pad(preps[i][1], R, 0, np.int32)),
+          jax.device_put(pad(preps[i][2], R, 0.0, np.float32)),
+          jax.device_put(pad(preps[i][3], T, 0, np.int32)),
+          jax.device_put(pad(preps[i][4], T, 0, np.int32)),
+          jax.device_put(pad(preps[i][5], T, 0.0, np.float32)))
+         for i in range(len(preps))]
+
+NEG = jnp.float32(-3.4e38)
+
+
+def scatter_tail(dd, dt, starts, lens, ws):
+    def per_chunk(start, length, w):
+        clamped = jnp.minimum(start, nnz_pad - P)
+        shift = start - clamped
+        docs_w = lax.dynamic_slice(dd, (clamped,), (P,))
+        tfn_w = lax.dynamic_slice(dt, (clamped,), (P,))
+        idxv = jnp.arange(P, dtype=jnp.int32)
+        valid = (idxv >= shift) & (idxv < shift + length)
+        return docs_w, jnp.where(valid, tfn_w * w, 0.0)
+
+    dws, contrib = jax.vmap(per_chunk)(starts, lens, ws)
+    z = jnp.zeros(D, jnp.float32)
+    return z.at[dws.reshape(-1)].add(contrib.reshape(-1), mode="drop")
+
+
+def scatter_tail_sorted(dd, dt, starts, lens, ws):
+    """Per-chunk scatter with the unique-indices hint, scan over chunks
+    (each postings chunk is sorted by doc id and unique; padding maps to
+    the dropped out-of-range row D)."""
+    def step(acc, slw):
+        start, length, w = slw
+        clamped = jnp.minimum(start, nnz_pad - P)
+        shift = start - clamped
+        docs_w = lax.dynamic_slice(dd, (clamped,), (P,))
+        tfn_w = lax.dynamic_slice(dt, (clamped,), (P,))
+        idxv = jnp.arange(P, dtype=jnp.int32)
+        valid = (idxv >= shift) & (idxv < shift + length)
+        docs_m = jnp.where(valid, docs_w, D)
+        acc = acc.at[docs_m].add(jnp.where(valid, tfn_w * w, 0.0),
+                                 mode="drop", unique_indices=True)
+        return acc, None
+
+    z = jnp.zeros(D, jnp.float32)
+    acc, _ = lax.scan(step, z, (starts, lens, ws))
+    return acc
+
+
+def dense_mv(imp, qw):
+    return jnp.dot(qw, imp, precision=lax.Precision.HIGHEST)
+
+
+def dense_rowgather(imp, qr, qv):
+    return jnp.einsum("r,rd->d", qv, imp[qr],
+                      precision=lax.Precision.HIGHEST)
+
+
+def topk_blocked(s, k=10, block=8192):
+    nb = D // block
+    bv, bi = lax.top_k(s.reshape(nb, block), k)
+    bi = bi + (jnp.arange(nb, dtype=bi.dtype) * block)[:, None]
+    gv, gp = lax.top_k(bv.reshape(-1), k)
+    return gv, bi.reshape(-1)[gp]
+
+
+# --- timed programs: all reduce to small outputs on device ------------------
+def full_current(imp, dd, dt, qw, qr, qv, st, ln, ws):
+    dense = dense_mv(imp, qw)
+    s = dense + scatter_tail(dd, dt, st, ln, ws)
+    m = s > 0
+    masked = jnp.where(m, s, NEG)
+    vals, idx = lax.top_k(masked, 10)
+    return vals, idx, jnp.sum(m.astype(jnp.int32))
+
+
+def full_new(imp, dd, dt, qw, qr, qv, st, ln, ws):
+    dense = dense_rowgather(imp, qr, qv)
+    s = dense + scatter_tail_sorted(dd, dt, st, ln, ws)
+    m = s > 0
+    masked = jnp.where(m, s, NEG)
+    vals, idx = topk_blocked(masked)
+    return vals, idx, jnp.sum(m.astype(jnp.int32))
+
+
+PROGS = {
+    "dense matvec HIGHEST -> max": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        dense_mv(imp, qw).max(),
+    "dense matvec DEFAULT -> max": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        jnp.dot(qw, imp, precision=lax.Precision.DEFAULT).max(),
+    "dense row-gather -> max": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        dense_rowgather(imp, qr, qv).max(),
+    "tail scatter flat -> max": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        scatter_tail(dd, dt, st, ln, ws).max(),
+    "tail scatter scan/unique -> max": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        scatter_tail_sorted(dd, dt, st, ln, ws).max(),
+    "dense mv + topk flat": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        lax.top_k(dense_mv(imp, qw), 10),
+    "dense mv + topk blocked": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        topk_blocked(dense_mv(imp, qw)),
+    "scatter flat + topk flat": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        lax.top_k(scatter_tail(dd, dt, st, ln, ws), 10),
+    "mv + scatter -> max (no topk)": lambda imp, dd, dt, qw, qr, qv, st, ln, ws:
+        (dense_mv(imp, qw) + scatter_tail(dd, dt, st, ln, ws)).max(),
+    "FULL current": full_current,
+    "FULL new": full_new,
+}
+
+
+def run(name, jf):
+    outs = jf(d_impact, d_doc, d_tfn, *per_q[0])  # compile
+    np.asarray(jax.device_get(outs), dtype=object)  # full pull (small)
+    times = np.full(len(per_q), np.inf)
+    for _ in range(3):
+        for i, inp in enumerate(per_q):
+            t0 = time.perf_counter()
+            jax.device_get(jf(d_impact, d_doc, d_tfn, *inp))
+            times[i] = min(times[i], time.perf_counter() - t0)
+    print(f"{name:34s} p50 {np.percentile(times * 1000, 50):8.2f} ms "
+          f"max {times.max() * 1000:8.2f} ms", flush=True)
+    return outs
+
+
+results = {}
+for name, fn in PROGS.items():
+    results[name] = run(name, jax.jit(fn))
+
+v1, i1, t1 = [np.asarray(x) for x in results["FULL current"]]
+v2, i2, t2 = [np.asarray(x) for x in results["FULL new"]]
+print(f"agreement: top1 {int(i1[0]) == int(i2[0])}, "
+      f"vals close {np.allclose(v1, v2, rtol=2e-5)}, totals {int(t1)}=={int(t2)}")
