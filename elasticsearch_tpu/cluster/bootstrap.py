@@ -61,6 +61,10 @@ class MultiHostCluster:
         self.rank = rank
         self.world = world
         nid = f"{rank:04d}-{node.node_id}"
+        # ONE identity everywhere: cluster state, /_nodes maps, cat rows
+        # (the reference's node id is likewise a single value across APIs);
+        # the rank prefix stays so lowest-id election is deterministic
+        node.node_id = nid
         state = node.cluster_state
         state.nodes.clear()  # replace the single-node bootstrap entry
         self.transport = TransportService(nid)

@@ -951,6 +951,23 @@ class DistributedDataService:
             "total": total, "successful": total - failed_shards,
             "failed": failed_shards}
 
+    def nodes_fan(self) -> dict:
+        """Cluster-wide /_nodes: this node's entry plus every live
+        member's, each sourced from the member itself over the REST proxy
+        (reference: TransportNodesInfoAction fans to all nodes and merges
+        per-node responses). A dead peer simply drops out of the map."""
+        out = self.node.nodes_stats()
+        for nid in self._other_nodes():
+            try:
+                res = self._send(nid, ACTION_REST_PROXY, {
+                    "method": "GET", "path": "/_nodes", "params": {}})
+                if res.get("status") == 200:
+                    out["nodes"].update(
+                        (res.get("payload") or {}).get("nodes", {}))
+            except Exception:
+                pass
+        return out
+
     def _on_rest_proxy(self, payload: dict) -> dict:
         """Dispatch a proxied REST request into this process's own route
         table (lazily built — a pure data node may never serve HTTP)."""

@@ -23,7 +23,12 @@ class IndexService:
         settings: Optional[dict] = None,
         mappings_json: Optional[dict] = None,
         data_path: Optional[str] = None,
+        validate_analysis: bool = True,
     ):
+        """``validate_analysis=False`` skips the eager analysis-config
+        build — gateway recovery uses it so a pre-validation on-disk index
+        with a broken-but-unused component still re-opens (its analyzers
+        stay lazy, the pre-r5 behavior) instead of silently vanishing."""
         self.name = name
         self.settings = settings or {}
         idx_settings = self.settings.get("index", self.settings)
@@ -40,7 +45,8 @@ class IndexService:
                                else self.num_replicas)
         self.analysis = AnalysisRegistry(self.settings)
         self.mappings = Mappings(mappings_json or {})
-        self._validate_analyzers(self.mappings)
+        self._validate_analyzers(self.mappings,
+                                 eager_components=validate_analysis)
         self.aliases: Dict[str, dict] = {}
         self.data_path = data_path
         self.shards: List[IndexShard] = [
@@ -100,22 +106,24 @@ class IndexService:
                         # whole index on open; it just doesn't participate
                         pass
 
-    def _validate_analyzers(self, mappings: Mappings):
+    def _validate_analyzers(self, mappings: Mappings,
+                            eager_components: bool = True):
         """Reject mappings naming analyzers the registry can't build —
         reference: MapperService fails index creation on unknown analyzers."""
         from elasticsearch_tpu.utils.errors import (IllegalArgumentException,
                                                     MapperParsingException)
 
-        try:
-            # every DECLARED analyzer must build, referenced or not
-            # (reference: AnalysisService constructs all configured
-            # analyzers; a broken settings.analysis fails the creation).
-            # KeyError/TypeError cover malformed shared definitions (a
-            # tokenizer entry missing "type", non-dict config values).
-            self.analysis.validate()
-        except (ValueError, KeyError, TypeError) as e:
-            raise IllegalArgumentException(
-                f"failed to build analysis components: {e}") from e
+        if eager_components:
+            try:
+                # every DECLARED analyzer must build, referenced or not
+                # (reference: AnalysisService constructs all configured
+                # analyzers; a broken settings.analysis fails the creation).
+                # KeyError/TypeError cover malformed shared definitions (a
+                # tokenizer entry missing "type", non-dict config values).
+                self.analysis.validate()
+            except (ValueError, KeyError, TypeError) as e:
+                raise IllegalArgumentException(
+                    f"failed to build analysis components: {e}") from e
         for name, fm in mappings.fields.items():
             if not getattr(fm, "is_text", False):
                 continue

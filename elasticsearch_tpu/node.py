@@ -109,7 +109,11 @@ class Node:
                 svc = IndexService(
                     name, meta.get("settings"),
                     {"properties": {}} if not meta.get("mappings") else meta["mappings"],
-                    data_path=self.data_path)
+                    data_path=self.data_path,
+                    # a pre-validation index with a broken-but-unused
+                    # analysis component must still re-open (lazy
+                    # resolution, the behavior it was created under)
+                    validate_analysis=False)
             except Exception:
                 # one unrecoverable index (bad meta, failing replay) must
                 # not stop the node from booting — it just stays absent
@@ -617,11 +621,27 @@ class Node:
                     "thread_pool": (self._thread_pool.stats()
                                     if self._thread_pool is not None else {}),
                     "breakers": self._breaker_stats(),
+                    # transport info (reference: NodeInfo transport section;
+                    # profiles {} = no extra transport profiles configured)
+                    "transport": self._transport_info(),
                     # TPU-native extra: device kind + HBM usage
                     "accelerator": device_stats(),
                 }
             },
         }
+
+    def _transport_info(self) -> dict:
+        """Transport section of node info/stats (reference:
+        transport/TransportInfo.java): addresses + configured profiles
+        (always {} here — profiles are a netty-transport concept; the
+        multi-host TCP transport has a single default binding)."""
+        mh = getattr(self, "multihost", None)
+        addr = "local[in-process]"
+        if mh is not None:
+            local = getattr(mh, "local", None)
+            addr = getattr(local, "transport_address", None) or addr
+        return {"bound_address": [addr], "publish_address": addr,
+                "profiles": {}}
 
     @staticmethod
     def _breaker_stats() -> dict:

@@ -174,8 +174,8 @@ def _register_all(rc: RestController):
     add("GET", "/_cluster/health", _cluster_health)
     add("GET", "/_cluster/state", lambda n, p, b: (200, n.cluster_state.to_json()))
     add("GET", "/_cluster/stats", _cluster_stats)
-    add("GET", "/_nodes/stats", lambda n, p, b: (200, n.nodes_stats()))
-    add("GET", "/_nodes", lambda n, p, b: (200, n.nodes_stats()))
+    add("GET", "/_nodes/stats", _nodes_info)
+    add("GET", "/_nodes", _nodes_info)
     add("GET", "/_stats", lambda n, p, b: _index_stats(n, p, b, None))
 
     # cat API (text/plain-ish, returned as JSON rows when format=json)
@@ -338,19 +338,13 @@ def _register_all(rc: RestController):
         lambda n, p, b, nodeid: _hot_threads(n, p, b))
     add("GET", "/_cluster/nodes/{nodeid}/hot_threads",
         lambda n, p, b, nodeid: _hot_threads(n, p, b))
-    add("GET", "/_nodes/stats/{metric}",
-        lambda n, p, b, metric: (200, n.nodes_stats()))
-    add("GET", "/_nodes/stats/{metric}/{imetric}",
-        lambda n, p, b, metric, imetric: (200, n.nodes_stats()))
-    add("GET", "/_nodes/{nodeid}/stats",
-        lambda n, p, b, nodeid: (200, n.nodes_stats()))
-    add("GET", "/_nodes/{nodeid}/stats/{metric}",
-        lambda n, p, b, nodeid, metric: (200, n.nodes_stats()))
-    add("GET", "/_nodes/{nodeid}/stats/{metric}/{imetric}",
-        lambda n, p, b, nodeid, metric, imetric: (200, n.nodes_stats()))
-    add("GET", "/_nodes/{nodeid}", lambda n, p, b, nodeid: (200, n.nodes_stats()))
-    add("GET", "/_nodes/{nodeid}/{metric}",
-        lambda n, p, b, nodeid, metric: (200, n.nodes_stats()))
+    add("GET", "/_nodes/stats/{metric}", _nodes_info)
+    add("GET", "/_nodes/stats/{metric}/{imetric}", _nodes_info)
+    add("GET", "/_nodes/{nodeid}/stats", _nodes_info)
+    add("GET", "/_nodes/{nodeid}/stats/{metric}", _nodes_info)
+    add("GET", "/_nodes/{nodeid}/stats/{metric}/{imetric}", _nodes_info)
+    add("GET", "/_nodes/{nodeid}", _nodes_info)
+    add("GET", "/_nodes/{nodeid}/{metric}", _nodes_info)
 
     # index admin
     add("PUT", "/{index}", _create_index)
@@ -610,6 +604,8 @@ def _register_all(rc: RestController):
     add("POST", "/{index}/{type}/{id}/_percolate/count",
         _typed(_percolate_count_existing, keep_type=True))
     add("POST", "/{index}/{type}/{id}/_mlt", _typed(_mlt, keep_type=True))
+    add("PUT", "/{index}/{type}/{id}/_create", _create_doc_typed)
+    add("POST", "/{index}/{type}/{id}/_create", _create_doc_typed)
     add("HEAD", "/{index}/{type}/{id}", _doc_exists_typed)
     add("PUT", "/{index}/{type}/{id}", _index_doc_typed)
     add("POST", "/{index}/{type}/{id}", _index_doc_typed)
@@ -1616,6 +1612,19 @@ def _do_analyze(reg, body: dict, svc=None) -> dict:
 
 # -- document handlers --------------------------------------------------------
 
+def _nodes_info(n: Node, p, b, **_sel):
+    """/_nodes[/...] — single node returns its own view; in a multi-host
+    world the coordinator merges every member's self-reported entry
+    (reference: TransportNodesInfoAction). `_local_only` (set by the
+    cross-host REST proxy) pins to this process to prevent re-fanning.
+    Node-id/metric selectors are accepted and return the full view, the
+    same single-node simplification the scoped stats routes make."""
+    mh = _mh(n)
+    if mh is not None and "_local_only" not in p:
+        return 200, mh.data.nodes_fan()
+    return 200, n.nodes_stats()
+
+
 def _mh(n: Node):
     """The multi-host data plane, when this node runs in a jax.distributed
     world (cluster/bootstrap.py sets node.multihost). REST operations on
@@ -1717,6 +1726,13 @@ def _index_doc_typed(n: Node, p, b, index: str, type: str, id: str):
     if type.startswith("_"):
         raise IllegalArgumentException(f"unsupported path [{index}/{type}/{id}]")
     return _index_doc(n, p, b, index, id, doc_type=type)
+
+
+def _create_doc_typed(n: Node, p, b, index: str, type: str, id: str):
+    """PUT /{index}/{type}/{id}/_create — the create API: op_type=create
+    forced, conflict on an existing id (reference:
+    rest/action/document/RestIndexAction CREATE registration)."""
+    return _index_doc_typed(n, dict(p, op_type="create"), b, index, type, id)
 
 
 def _check_read_routing(n: Node, index: str, type: str, id: str, p) -> None:

@@ -291,3 +291,32 @@ def test_restore_broken_analysis_fails_before_any_index(node, tmp_path):
         restore_snapshot(node, repo, "s1")
     # fail-up-front: NOTHING restored, not even the healthy index
     assert "books" not in node.indices and "zz_broken" not in node.indices
+
+
+def test_gateway_reopens_index_with_legacy_broken_analysis(tmp_path):
+    """An on-disk index whose _meta carries a broken-but-unused analysis
+    component (written before eager validation existed) must still re-open
+    on restart — not silently vanish."""
+    import json as _json
+
+    data = str(tmp_path / "data")
+    n = Node(data_path=data)
+    n.create_index("legacy", {"mappings": {"properties": {
+        "t": {"type": "text"}}}})
+    n.indices["legacy"].index_doc("1", {"t": "hello"})
+    n.indices["legacy"].refresh()
+    for s in n.indices.values():
+        s.close()
+    # retro-break the persisted settings the way a pre-r5 node could have
+    meta_path = os.path.join(data, "legacy", "_meta.json")
+    with open(meta_path) as fh:
+        meta = _json.load(fh)
+    meta.setdefault("settings", {}).setdefault("analysis", {})[
+        "tokenizer"] = {"broken": {"pattern": "x"}}  # no "type"
+    with open(meta_path, "w") as fh:
+        _json.dump(meta, fh)
+    n2 = Node(data_path=data)
+    assert "legacy" in n2.indices, "legacy index silently dropped"
+    assert n2.indices["legacy"].count({})["count"] == 1
+    for s in n2.indices.values():
+        s.close()

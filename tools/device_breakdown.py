@@ -151,6 +151,8 @@ def dense_rowgather(imp, qr, qv):
 
 
 def topk_blocked(s, k=10, block=8192):
+    if D < 2 * block or D % block:
+        return lax.top_k(s, k)  # blocking can't help small/odd D
     nb = D // block
     bv, bi = lax.top_k(s.reshape(nb, block), k)
     bi = bi + (jnp.arange(nb, dtype=bi.dtype) * block)[:, None]
