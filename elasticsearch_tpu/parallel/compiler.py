@@ -184,15 +184,18 @@ class TGroupPrim(DataPrim):
 
 class HybridTGroupPrim(DataPrim):
     """Term group scored via the hybrid dense-impact path: the segment's
-    frequent terms live as rows of an impact[F, D] block (one MXU matmul per
-    query), the rare tail stays as (start, len) scatter chunks — the same
-    split the host loop's ctx.hybrid_slices makes (ops/scoring.py:94).
+    frequent terms live as rows of an impact[F, D] block, the rare tail
+    stays as (start, len) scatter chunks — the same split the host loop's
+    ctx.hybrid_slices makes (ops/scoring.py:94).
 
     Arrays: impact [S, F, D] (stacked per-shard blocks, zero rows where a
-    shard has no dense block — its terms all fall to the tail), qw [S, F]
-    (idf*boost folded into dense rows), qind [S, F] (1.0 indicator),
-    starts/lens/ws [S, T] tail chunk tables. Per-shard F/dense_rows
-    variability is data; the emit tree stays identical on every shard."""
+    shard has no dense block — its terms all fall to the tail),
+    qrows [S, R] / qrw [S, R] (the query's dense-row indices and idf*boost
+    weights, -1/0 padded) — the DSL path is per-request (Q=1), so scoring
+    GATHERS only those R << F rows instead of multiplying the whole block
+    (bm25_score_hybrid_gather's traffic math) — and starts/lens/ws [S, T]
+    tail chunk tables. Per-shard F/dense_rows variability is data; the
+    emit tree stays identical on every shard."""
 
     n_arrays = 6
 
@@ -224,12 +227,12 @@ class HybridTGroupPrim(DataPrim):
         key = ("hyb_impact", self.field, tuple(id(s) for s in seg_row), F, D)
         arrays = list(cache(key, fill_impact))
 
-        h_qw = np.zeros((S, F), np.float32)
-        h_qind = np.zeros((S, F), np.float32)
         per_shard = []
+        row_ws: List[Dict[int, float]] = []
         Pmax, Tmax = 1, 1
         for si, ((inv, blk), ctx) in enumerate(zip(blocks, ctxs)):
             runs = []
+            row_w: Dict[int, float] = {}
             if inv is not None and ctx is not None:
                 terms, weights = self.terms_fn(ctx)
                 dense_rows = blk[0] if blk is not None else None
@@ -239,8 +242,7 @@ class HybridTGroupPrim(DataPrim):
                         continue
                     row = int(dense_rows[tid]) if dense_rows is not None else -1
                     if row >= 0:
-                        h_qw[si, row] += w
-                        h_qind[si, row] = 1.0
+                        row_w[row] = row_w.get(row, 0.0) + w
                     else:
                         s0 = int(inv.offsets[tid])
                         runs.append((s0, int(inv.offsets[tid + 1]) - s0, w))
@@ -248,7 +250,19 @@ class HybridTGroupPrim(DataPrim):
             Pmax = max(Pmax, pow2_bucket(max_len))
             Tmax = max(Tmax, len(starts))
             per_shard.append((starts, lens, ws))
+            row_ws.append(row_w)
+        from elasticsearch_tpu.ops.scoring import pack_dense_rows
+
         T = pow2_bucket(Tmax, minimum=1)
+        # shared packing (ops/scoring.pack_dense_rows): per-shard R may
+        # differ, so pack each then pad to the common pow2 R
+        packed = [pack_dense_rows(rw) for rw in row_ws]
+        R = max(p[0].shape[0] for p in packed)
+        h_qrows = np.full((S, R), -1, np.int32)
+        h_qrw = np.zeros((S, R), np.float32)
+        for si, (qr, qv) in enumerate(packed):
+            h_qrows[si, : qr.shape[0]] = qr
+            h_qrw[si, : qv.shape[0]] = qv
         h_starts = np.zeros((S, T), np.int32)
         h_lens = np.zeros((S, T), np.int32)
         h_ws = np.zeros((S, T), np.float32)
@@ -256,7 +270,7 @@ class HybridTGroupPrim(DataPrim):
             h_starts[si, : len(st)] = st
             h_lens[si, : len(ln)] = ln
             h_ws[si, : len(ws)] = ws
-        return arrays + [h_qw, h_qind, h_starts, h_lens, h_ws], (Pmax,)
+        return arrays + [h_qrows, h_qrw, h_starts, h_lens, h_ws], (Pmax, R)
 
 
 class RangePrim(DataPrim):
@@ -765,9 +779,12 @@ class ETermGroup(Emit):
 
 
 class ETermGroupHybrid(Emit):
-    """ETermGroup over the hybrid dense-impact path: one MXU matmul for the
-    dense rows + scatter for the tail (mirror of _score_term_group's hybrid
-    branch). Same three modes as ETermGroup."""
+    """ETermGroup over the hybrid dense-impact path: a row GATHER of the
+    query's dense rows + scatter for the tail (mirror of
+    _score_term_group's hybrid branch — the per-request DSL path is Q=1,
+    where gathering R << F rows beats multiplying the whole block by the
+    traffic ratio F/R; see ops/scoring.bm25_score_hybrid_gather). Same
+    three modes as ETermGroup."""
 
     def __init__(self, prim: int, post: int, mode: str, n: int, boost: float,
                  D: int):
@@ -783,23 +800,21 @@ class ETermGroupHybrid(Emit):
 
     def ex(self, env, meta):
         from elasticsearch_tpu.ops.scoring import (
-            bm25_score_hybrid, impact_precision, match_count_hybrid,
-            term_mask_hybrid)
+            bm25_score_hybrid_gather, match_count_hybrid_gather,
+            term_mask_hybrid_gather)
 
         doc_ids, tfnorm = env[self.post]
-        impact, qw, qind, starts, lens, ws = env[self.prim]
-        (P,) = meta[self.prim]
+        impact, qrows, qrw, starts, lens, ws = env[self.prim]
+        (P, _R) = meta[self.prim]
         if self.mode == "mask":
-            return None, term_mask_hybrid(impact, qind, doc_ids, starts, lens,
-                                          P=P, D=self.D)
-        # read at TRACE time; the executor keys its program cache on the
-        # same config (search_dsl prog_key), so an env flip retraces
-        scores = bm25_score_hybrid(impact, qw, doc_ids, tfnorm, starts, lens,
-                                   ws, P=P, D=self.D,
-                                   prec=impact_precision())
+            return None, term_mask_hybrid_gather(
+                impact, qrows, doc_ids, starts, lens, P=P, D=self.D)
+        scores = bm25_score_hybrid_gather(
+            impact, qrows, qrw, doc_ids, tfnorm, starts, lens, ws,
+            P=P, D=self.D)
         if self.mode == "count_ge":
-            counts = match_count_hybrid(impact, qind, doc_ids, starts, lens,
-                                        P=P, D=self.D)
+            counts = match_count_hybrid_gather(
+                impact, qrows, doc_ids, starts, lens, P=P, D=self.D)
             return scores, counts >= self.n
         return scores, scores > 0
 

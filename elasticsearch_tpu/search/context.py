@@ -127,28 +127,35 @@ class SegmentContext:
             n_present,
         )
 
-    def hybrid_slices(self, inv: InvertedField, terms, weights):
+    def hybrid_slices(self, inv: InvertedField, terms, weights,
+                      need_qw: bool = True):
         """Split query terms between the dense impact block and the CSR tail.
 
         Returns None when the field has no dense block OR no query term maps
         to a dense row (the caller uses the pure scatter path — paying an
         [F, D] matmul of zeros for an all-rare-term query would be far slower
         than scattering its short runs). Else returns (impact, qw f32[F],
-        qind f32[F], starts, lens, ws, P, n_present): frequent terms fold
-        idf*boost into ``qw`` rows (scored by one matmul against
-        impact[F, D]); the rest become short (start, len) chunks. ``qind`` is
-        the 1.0 indicator of dense query terms, used for match counts/masks.
+        qind f32[F], starts, lens, ws, P, n_present, qrows i32[R],
+        qrw f32[R]): frequent terms fold idf*boost into ``qw`` rows (for the
+        batched matmul paths) AND into the compact (qrows, qrw) row list
+        (-1/0 padded to a pow2 R) that single-query paths gather — reading
+        R << F rows instead of the whole block. ``qind`` is the 1.0
+        indicator of dense query terms, used for batched counts/masks.
+        Single-query callers pass ``need_qw=False`` and get ``None`` for
+        qw/qind — skipping the two O(F) fills on the per-request path.
         """
+        from elasticsearch_tpu.ops.scoring import pack_dense_rows
+
         block = inv.dense_block()
         if block is None:
             return None
         dense_rows, impact = block
         F = impact.shape[0]
-        qw = np.zeros(F, np.float32)
-        qind = np.zeros(F, np.float32)
+        qw = np.zeros(F, np.float32) if need_qw else None
+        qind = np.zeros(F, np.float32) if need_qw else None
+        row_w: Dict[int, float] = {}
         runs = []
         n_present = 0
-        any_dense = False
         for term, w in zip(terms, weights):
             tid = inv.term_id(term)
             if tid < 0:
@@ -156,13 +163,14 @@ class SegmentContext:
             n_present += 1
             row = int(dense_rows[tid])
             if row >= 0:
-                qw[row] += w
-                qind[row] = 1.0
-                any_dense = True
+                if need_qw:
+                    qw[row] += w
+                    qind[row] = 1.0
+                row_w[row] = row_w.get(row, 0.0) + w
             else:
                 runs.append((int(inv.offsets[tid]),
                              int(inv.offsets[tid + 1] - inv.offsets[tid]), w))
-        if not any_dense:
+        if not row_w:
             return None
         starts, lens, ws, max_len = split_runs(runs) if runs else ([], [], [], 1)
         P = pow2_bucket(max_len)
@@ -170,6 +178,7 @@ class SegmentContext:
         starts += [0] * (Tb - len(starts))
         lens += [0] * (Tb - len(lens))
         ws += [0.0] * (Tb - len(ws))
+        qrows, qrw = pack_dense_rows(row_w)
         return (
             impact,
             qw,
@@ -179,4 +188,6 @@ class SegmentContext:
             np.asarray(ws, np.float32),
             P,
             n_present,
+            qrows,
+            qrw,
         )

@@ -31,15 +31,15 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from elasticsearch_tpu.ops.scoring import (
-    bm25_score_hybrid,
+    bm25_score_hybrid_gather,
     bm25_score_segment,
     dense_presence_count,
-    match_count_hybrid,
+    match_count_hybrid_gather,
     match_count_segment,
     range_mask_f32,
     range_mask_i64pair,
     term_mask,
-    term_mask_hybrid,
+    term_mask_hybrid_gather,
 )
 from elasticsearch_tpu.search.context import SegmentContext
 from elasticsearch_tpu.search.scripting import compile_script
@@ -117,23 +117,24 @@ def _score_term_group(ctx, field, terms, boost=1.0, with_counts=False) -> Tuple[
         kernels.record("bm25_postings_sharded")
         return split.term_group(terms, weights, with_counts=with_counts,
                                 all_positive=all_positive, D=ctx.D)
-    hyb = ctx.hybrid_slices(inv, terms, weights)
+    hyb = ctx.hybrid_slices(inv, terms, weights, need_qw=False)
     kernels.record("bm25_hybrid" if hyb is not None else "bm25_scatter")
     if hyb is not None:
-        from elasticsearch_tpu.ops.scoring import impact_precision
-
-        impact, qw, qind, starts, lens, ws, P, n_present = hyb
-        scores = bm25_score_hybrid(
-            impact, qw, inv.doc_ids, inv.tfnorm, starts, lens, ws, P=P,
-            D=ctx.D, prec=impact_precision())
+        impact, _qw, _qind, starts, lens, ws, P, n_present, qrows, qrw = hyb
+        # single-query path: gather ONLY the query's dense rows — the
+        # matmul form reads the whole impact block per query (ops/scoring
+        # bm25_score_hybrid_gather docstring has the traffic math)
+        scores = bm25_score_hybrid_gather(
+            impact, qrows, qrw, inv.doc_ids, inv.tfnorm, starts, lens, ws,
+            P=P, D=ctx.D)
         if with_counts:
-            matched = match_count_hybrid(
-                impact, qind, inv.doc_ids, starts, lens, P=P, D=ctx.D)
+            matched = match_count_hybrid_gather(
+                impact, qrows, inv.doc_ids, starts, lens, P=P, D=ctx.D)
         elif all_positive:
             matched = scores > 0
         else:
-            matched = term_mask_hybrid(
-                impact, qind, inv.doc_ids, starts, lens, P=P, D=ctx.D)
+            matched = term_mask_hybrid_gather(
+                impact, qrows, inv.doc_ids, starts, lens, P=P, D=ctx.D)
         return scores, matched, n_present
     starts, lens, ws, P, n_present = ctx.chunked_slices(inv, terms, weights)
     scores = bm25_score_segment(inv.doc_ids, inv.tfnorm, starts, lens, ws, P=P, D=ctx.D)
@@ -166,25 +167,31 @@ def fused_bm25_topk(ctx, query, k: int):
     inv = ctx.inv(field)
     if inv is None:
         return None
-    hyb = ctx.hybrid_slices(inv, tlist, wlist)
+    hyb = ctx.hybrid_slices(inv, tlist, wlist, need_qw=False)
     if hyb is None:
         return None  # no dense block / no dense query term
-    impact, qw, qind, _starts, lens, _ws, _P, n_present = hyb
+    impact, _qw, _qind, _starts, lens, _ws, _P, n_present, qrows, qrw = hyb
     if n_present == 0 or int(np.sum(lens)) > 0:
         return None  # tail terms present — not a pure-dense group
     from elasticsearch_tpu.monitor import kernels
     from elasticsearch_tpu.ops.pallas_kernels import bm25_dense_topk_auto
 
-    from elasticsearch_tpu.ops.scoring import (pack_topk_result,
+    from elasticsearch_tpu.ops.scoring import (gather_impact_rows,
+                                               pack_topk_result,
                                                unpack_topk_result)
 
     jnp = _jnp()
     live = ctx.segment.live
     kk = min(k, ctx.D)
-    vals, ids = bm25_dense_topk_auto(jnp.asarray(qw[None, :]), impact, live,
+    # stream only the query's R << F dense rows through the kernel — the
+    # full block would cost an F-row HBM read per query (same traffic cut
+    # as bm25_score_hybrid_gather; the [R, D] gather is a one-off
+    # intermediate two orders smaller than the block)
+    sub, qvalid = gather_impact_rows(impact, jnp.asarray(qrows))
+    vals, ids = bm25_dense_topk_auto(jnp.asarray(qrw[None, :]), sub, live,
                                      k=kk)
     kernels.record("bm25_fused_topk")
-    total = dense_presence_count(impact, jnp.asarray(qind[None, :]), live)
+    total = dense_presence_count(sub, qvalid[None, :], live)
     # ONE packed pull — three tiny arrays would cost three device
     # round-trips (network-attached chips: ~5-20 ms each)
     packed = np.asarray(pack_topk_result(vals[0], ids[0], total))
@@ -248,7 +255,7 @@ def fused_bm25_topk_batch(ctx, queries: List[Query], k: int):
         hyb = ctx.hybrid_slices(inv, tlist, wlist)
         if hyb is None:
             return None  # no dense block / no dense query term
-        impact, row_qw, row_qind, _st, lens, _ws, _P, n_present = hyb
+        impact, row_qw, row_qind, _st, lens, _ws, _P, n_present, *_ = hyb
         if n_present == 0 or int(np.sum(lens)) > 0:
             return None  # tail term / empty group — whole batch falls back
         if qw is None:
@@ -315,7 +322,7 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
     lens = np.zeros((Q, T), np.int32)
     ws = np.zeros((Q, T), np.float32)
     for qi, h in enumerate(slices):
-        _imp, row_qw, _qind, st, ln, w, _p, _n = h
+        _imp, row_qw, _qind, st, ln, w, _p, _n, *_ = h
         qw[qi] = row_qw
         starts[qi, : st.shape[0]] = st
         lens[qi, : ln.shape[0]] = ln
@@ -354,12 +361,13 @@ def _terms_filter_mask(ctx, field, terms):
     if inv is None or not terms:
         return jnp.zeros(ctx.D, dtype=bool)
     terms = list(dict.fromkeys(terms))  # dedupe, order-preserving
-    hyb = ctx.hybrid_slices(inv, terms, [1.0] * len(terms))
+    hyb = ctx.hybrid_slices(inv, terms, [1.0] * len(terms), need_qw=False)
     if hyb is not None:
-        impact, _, qind, starts, lens, _, P, n_present = hyb
+        impact, _, _qind, starts, lens, _, P, n_present, qrows, _qrw = hyb
         if n_present == 0:
             return jnp.zeros(ctx.D, dtype=bool)
-        return term_mask_hybrid(impact, qind, inv.doc_ids, starts, lens, P=P, D=ctx.D)
+        return term_mask_hybrid_gather(impact, qrows, inv.doc_ids, starts,
+                                       lens, P=P, D=ctx.D)
     starts, lens, _, P, n_present = ctx.chunked_slices(inv, terms, [1.0] * len(terms))
     if n_present == 0:
         return jnp.zeros(ctx.D, dtype=bool)

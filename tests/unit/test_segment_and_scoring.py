@@ -437,3 +437,82 @@ def test_impact_precision_knob(monkeypatch):
         finally:
             n.close()
     assert results[0] == results[1]
+
+
+def test_gather_hybrid_matches_matmul_hybrid():
+    """The row-gather single-query forms (bm25_score_hybrid_gather /
+    match_count_hybrid_gather / term_mask_hybrid_gather) produce the same
+    scores/counts/masks as the full-block matmul forms — they read only
+    the query's R dense rows where the matmul reads all F (the r5
+    single-query latency lever)."""
+    from elasticsearch_tpu.index.segment import build_dense_impact
+    from elasticsearch_tpu.ops.scoring import (
+        bm25_score_hybrid, bm25_score_hybrid_gather, match_count_hybrid,
+        match_count_hybrid_gather, pack_dense_rows, term_mask_hybrid,
+        term_mask_hybrid_gather)
+
+    rng = np.random.default_rng(11)
+    n_docs, vocab = 512, 64
+    D = pow2_bucket(n_docs)
+    doc_lists = [
+        np.sort(rng.choice(n_docs, size=max(1, n_docs // (t + 1)),
+                           replace=False))
+        for t in range(vocab)
+    ]
+    df = np.array([len(d) for d in doc_lists], np.int32)
+    offsets = np.zeros(vocab + 1, np.int64)
+    offsets[1:] = np.cumsum(df)
+    nnz = int(df.sum())
+    u_doc = np.concatenate(doc_lists).astype(np.int32)
+    tfn = rng.random(nnz).astype(np.float32) + 0.5
+    block = build_dense_impact(u_doc, tfn, offsets, df, D, df_threshold=64)
+    dense_rows, impact = block
+    nnz_pad = pow2_bucket(nnz)
+    d_doc = np.full(nnz_pad, D, np.int32)
+    d_doc[:nnz] = u_doc
+    d_tfn = np.zeros(nnz_pad, np.float32)
+    d_tfn[:nnz] = tfn
+
+    qterms = [0, 1, 2, 40, 63]
+    weights = [1.5, 0.7, 0.9, 2.0, 1.1]
+    F = impact.shape[0]
+    qw = np.zeros(F, np.float32)
+    qind = np.zeros(F, np.float32)
+    row_w = {}
+    runs = []
+    for t, w in zip(qterms, weights):
+        row = int(dense_rows[t])
+        if row >= 0:
+            qw[row] += w
+            qind[row] = 1.0
+            row_w[row] = row_w.get(row, 0.0) + w
+        else:
+            runs.append((int(offsets[t]), int(df[t]), w))
+    assert row_w and runs  # the query must exercise BOTH halves
+    qrows, qrw = pack_dense_rows(row_w)
+    assert qrows.shape[0] >= 8 and (qrows < 0).any()  # padded
+    P = pow2_bucket(max(ln for _, ln, _ in runs))
+    T = pow2_bucket(len(runs))
+    starts = np.zeros(T, np.int32)
+    lens = np.zeros(T, np.int32)
+    ws = np.zeros(T, np.float32)
+    for i, (s, ln, w) in enumerate(runs):
+        starts[i], lens[i], ws[i] = s, ln, w
+
+    want = np.asarray(bm25_score_hybrid(
+        impact, qw, d_doc, d_tfn, starts, lens, ws, P=P, D=D))
+    got = np.asarray(bm25_score_hybrid_gather(
+        impact, qrows, qrw, d_doc, d_tfn, starts, lens, ws, P=P, D=D))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    want_c = np.asarray(match_count_hybrid(
+        impact, qind, d_doc, starts, lens, P=P, D=D))
+    got_c = np.asarray(match_count_hybrid_gather(
+        impact, qrows, d_doc, starts, lens, P=P, D=D))
+    np.testing.assert_array_equal(got_c, want_c)
+
+    want_m = np.asarray(term_mask_hybrid(
+        impact, qind, d_doc, starts, lens, P=P, D=D))
+    got_m = np.asarray(term_mask_hybrid_gather(
+        impact, qrows, d_doc, starts, lens, P=P, D=D))
+    np.testing.assert_array_equal(got_m, want_m)

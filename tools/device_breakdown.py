@@ -20,8 +20,10 @@ sys.argv = [sys.argv[0]]
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
-from elasticsearch_tpu.utils.platform import enable_compilation_cache  # noqa: E402
+from elasticsearch_tpu.utils.platform import (  # noqa: E402
+    enable_compilation_cache, ensure_cpu_if_requested)
 
+ensure_cpu_if_requested()  # JAX_PLATFORMS=cpu must not touch the tunnel
 enable_compilation_cache()
 
 import jax  # noqa: E402
