@@ -181,10 +181,18 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
     return fn
 
 
-def _dsl_program(mesh, compiled, counts, statics, k: int):
+def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=()):
     """Build the shard_map program for one compiled DSL structure: emit-tree
     score/mask → local top-k → all_gather + global top-k, exact totals via
-    psum, per-shard terms-agg count vectors."""
+    psum, per-shard terms-agg count vectors.
+
+    ``pack_spec`` — tuple of (flat_index, per_shard_shape, dtype_str) for
+    logical inputs that arrive CONCATENATED in one trailing i32 word
+    buffer instead of as separate arrays: every device_put is a full
+    host→device round trip (~0.5 ms on tunneled chips), and a query's
+    small tables (row lists, chunk tables, range bounds) would otherwise
+    ship as 5+ separate transfers. The body slices each segment back out
+    and bitcasts to its dtype (all 4-byte, so a pure reinterpret)."""
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as PS
@@ -196,12 +204,28 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
     meta = {i: s for i, s in enumerate(statics)}
     n_aggs = len(compiled.agg_prims)
     psum, all_gather, wrap, sl = _collectives(mesh)
+    packed_idx = {i for i, _, _ in pack_spec}
 
-    def body(*flat):
+    def body(*phys):
+        raw = list(phys)
+        unpacked = {}
+        if pack_spec:
+            words = sl(raw.pop())  # [W] local word view
+            off = 0
+            for idx, shp, dt in pack_spec:
+                n = int(np.prod(shp)) if shp else 1
+                seg = words[off: off + n]
+                if dt != "int32":
+                    seg = lax.bitcast_convert_type(seg, jnp.dtype(dt))
+                unpacked[idx] = seg.reshape(shp)
+                off += n
+        it = iter(raw)
         env = {}
         pos = 0
         for i, c in enumerate(counts):
-            env[i] = tuple(sl(a) for a in flat[pos: pos + c])
+            env[i] = tuple(unpacked[j] if j in packed_idx
+                           else sl(next(it))
+                           for j in range(pos, pos + c))
             pos += c
         scores, mask = compiled.root.sm(env, meta)
         live = env[compiled.live][0]
@@ -248,7 +272,8 @@ def _dsl_program(mesh, compiled, counts, statics, k: int):
             outs.append(mask[None, :])  # [S, D] sharded, for host-side aggs
         return tuple(outs)
 
-    n_in = sum(counts)
+    # physical inputs: the non-packed arrays in order, then the word buffer
+    n_in = sum(counts) - len(pack_spec) + (1 if pack_spec else 0)
     in_specs = tuple(PS("shard") for _ in range(n_in))
     out_specs = (PS(),) + tuple(
         PS("shard") for _ in range(n_aggs + (1 if compiled.want_mask else 0)))
@@ -562,18 +587,35 @@ class MeshSearchExecutor:
                 counts.append(len(arrs))
                 statics.append(static)
             kk = min(k_dev, D)
-            from elasticsearch_tpu.ops.scoring import (impact_precision,
-                                                       topk_block_config)
+            from elasticsearch_tpu.ops.scoring import topk_block_config
 
             prog_key = ("dsl", compiled.struct_key(), tuple(statics),
                         tuple(tuple(a.shape) + (str(a.dtype),) for a in arrays),
-                        kk, topk_block_config(), impact_precision())
-            prog = self._programs.get(prog_key)
+                        kk, topk_block_config())
+            # per-query host tables (row lists, chunk tables, bounds) ship
+            # as ONE packed word buffer: each separate device_put is a
+            # full host→device round trip on tunneled chips
+            pack_idx = [i for i, a in enumerate(arrays)
+                        if not hasattr(a, "sharding")
+                        and isinstance(a, np.ndarray) and a.ndim >= 2
+                        and a.shape[0] == self.S and a.dtype.itemsize == 4]
+            pack_spec = ()
+            if len(pack_idx) >= 2:
+                pack_spec = tuple((i, arrays[i].shape[1:],
+                                   str(arrays[i].dtype)) for i in pack_idx)
+            prog = self._programs.get((prog_key, pack_spec))
             if prog is None:
-                prog = _dsl_program(self.mesh, compiled, counts, statics, kk)
-                self._programs[prog_key] = prog
+                prog = _dsl_program(self.mesh, compiled, counts, statics,
+                                    kk, pack_spec)
+                self._programs[(prog_key, pack_spec)] = prog
+            in_pack = set(pack_idx) if pack_spec else set()
             dev = [a if hasattr(a, "sharding") else self._put_sharded(a)
-                   for a in arrays]
+                   for i, a in enumerate(arrays) if i not in in_pack]
+            if pack_spec:
+                words = np.concatenate(
+                    [np.ascontiguousarray(arrays[i]).reshape(self.S, -1)
+                     .view(np.int32) for i in pack_idx], axis=1)
+                dev.append(self._put_sharded(words))
             # ONE host transfer for the packed result — per-array pulls
             # each pay a fixed device round-trip (the dominant per-query
             # cost on network-attached chips)
