@@ -356,7 +356,10 @@ def cpu_bm25_latency(u_doc, tfn, offsets, idf, queries, n_docs, k, runs=3):
                 if e > s:
                     scores[u_doc[s:e]] += idf[t] * tfn[s:e]
             top = np.argpartition(-scores, k)[:k]
-            top = top[np.argsort(-scores[top])]
+            # Lucene tie order: equal scores rank by ascending doc id
+            # (argsort alone leaves tie order to argpartition's arbitrary
+            # layout, flapping the top-1 agreement probe on exact ties)
+            top = top[np.lexsort((top, -scores[top]))]
             times[qi] = min(times[qi], time.perf_counter() - t0)
             beat()
             if run == 0:
