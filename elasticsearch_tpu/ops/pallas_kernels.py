@@ -17,6 +17,7 @@ reduction order), asserted in tests/unit/test_pallas_kernels.py.
 """
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -194,26 +195,49 @@ def bm25_dense_topk_pallas(qw, impact, mask, *, k: int, tile: int = 2048,
         s = jnp.where(m_ref[:], s, NEG_INF)  # mask block is [1, tile]
         base = step * tile
         tile_ids = base + jax.lax.broadcasted_iota(jnp.int32, (QT, tile), 1)
-        cand_v = jnp.concatenate([out_v_ref[:], s], axis=1)
-        cand_i = jnp.concatenate([out_i_ref[:], tile_ids], axis=1)
 
-        def extract(j, carry):
-            cv, ci, bv, bi = carry
-            m = jnp.max(cv, axis=1)
+        # Early-exit selection: the running top-k lives UNSORTED in the
+        # output refs; each pass extracts the tile's per-row max and
+        # replaces the row's current minimum where it improves, looping
+        # only while SOME row can still improve. In the steady state a
+        # tile improves ~0-1 entries per row (top-k insertions over a
+        # random-order sweep total ~k·ln(D/k) per query), so this runs
+        # ~1 pass where the old fixed fori_loop always paid k — the
+        # kernel's dominant VPU cost at large Q. A tile can contribute at
+        # most k entries per row, so k iterations bound the loop. Tie
+        # discipline: equal scores never displace an incumbent (m > rmin
+        # strict), and within a tile argmax picks the lowest doc id; the
+        # host-side wrapper re-sorts the unsorted buffer with an explicit
+        # (-value, doc id) key to match lax.top_k tie order exactly.
+        # the tile max `m` rides in the carry: cond/body can't CSE across
+        # a while_loop, and the [QT, tile] reductions ARE the kernel's
+        # dominant VPU cost — the non-improving steady state must pay
+        # exactly ONE full-width pass (the pre-loop max) per tile
+        def cond(carry):
+            cv, bv, bi, m, it = carry
+            return (it < k) & jnp.any(m > jnp.min(bv, axis=1))
+
+        def body(carry):
+            cv, bv, bi, m, it = carry
             am = jnp.argmax(cv, axis=1)
-            width = cv.shape[1]
-            knock = jax.lax.broadcasted_iota(jnp.int32, (QT, width), 1) == am[:, None]
-            picked_i = jnp.max(jnp.where(knock, ci, jnp.int32(-1)), axis=1)
-            col_j = jax.lax.broadcasted_iota(jnp.int32, (QT, k), 1) == j
-            bv = jnp.where(col_j, m[:, None], bv)
-            bi = jnp.where(col_j, picked_i[:, None], bi)
+            knock = (jax.lax.broadcasted_iota(jnp.int32, (QT, tile), 1)
+                     == am[:, None])
+            picked_i = jnp.max(jnp.where(knock, tile_ids, jnp.int32(-1)),
+                               axis=1)
+            rmin = jnp.min(bv, axis=1)
+            amin = jnp.argmin(bv, axis=1)
+            improve = m > rmin
+            upd = improve[:, None] & (
+                jax.lax.broadcasted_iota(jnp.int32, (QT, k), 1)
+                == amin[:, None])
+            bv = jnp.where(upd, m[:, None], bv)
+            bi = jnp.where(upd, picked_i[:, None], bi)
             cv = jnp.where(knock, NEG_INF, cv)
-            return cv, ci, bv, bi
+            return cv, bv, bi, jnp.max(cv, axis=1), it + 1
 
-        bv0 = jnp.full((QT, k), NEG_INF, dtype=jnp.float32)
-        bi0 = jnp.zeros((QT, k), dtype=jnp.int32)
-        _, _, bv, bi = jax.lax.fori_loop(
-            0, k, extract, (cand_v, cand_i, bv0, bi0))
+        _, bv, bi, _, _ = jax.lax.while_loop(
+            cond, body,
+            (s, out_v_ref[:], out_i_ref[:], jnp.max(s, axis=1), 0))
         out_v_ref[:] = bv
         out_i_ref[:] = bi
 
@@ -237,7 +261,15 @@ def bm25_dense_topk_pallas(qw, impact, mask, *, k: int, tile: int = 2048,
         ],
         interpret=interpret,
     )(qh, impact, mask[None, :])
-    return out_v, out_i
+    # the kernel's buffer is unsorted: order by (-value, doc id) — id
+    # ascending FIRST, then a stable value top_k, so equal scores rank by
+    # lowest doc id exactly like lax.top_k over the dense score row
+    order = jnp.argsort(out_i, axis=1)
+    v2 = jnp.take_along_axis(out_v, order, axis=1)
+    i2 = jnp.take_along_axis(out_i, order, axis=1)
+    vals, pos = jax.lax.top_k(v2, k)
+    ids = jnp.take_along_axis(i2, pos, axis=1)
+    return vals, ids
 
 
 def bm25_dense_tiles_for(Q: int, F: int, D: int):
@@ -271,8 +303,22 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
     D = impact.shape[1]
     qpad = ((Q + 7) // 8) * 8
     q_tile, tile = bm25_dense_tiles_for(qpad, F, D)
-    if (_on_tpu() and k <= 64 and F % 8 == 0
-            and q_tile and D >= 2 * tile):
+    # ESTPU_BM25_BATCH_KERNEL: auto (default) | pallas | xla — the A/B
+    # knob for the large-Q batch path (the kernel's in-kernel selection is
+    # VPU-bound at k passes per tile; XLA's chunked matmul+top_k rides the
+    # MXU + its tuned sort). Read eagerly here, like the other knobs.
+    pref = os.environ.get("ESTPU_BM25_BATCH_KERNEL", "auto").lower()
+    gates_ok = (_on_tpu() and k <= 64 and F % 8 == 0
+                and q_tile and D >= 2 * tile)
+    if pref == "pallas" and not gates_ok:
+        # a forced-pallas A/B must never SILENTLY measure the XLA side
+        import warnings
+
+        warnings.warn("ESTPU_BM25_BATCH_KERNEL=pallas but the kernel's "
+                      "shape gates reject this call "
+                      f"(on_tpu={_on_tpu()}, k={k}, F={F}, q_tile={q_tile},"
+                      f" D={D}, tile={tile}) — falling back to XLA")
+    if pref != "xla" and gates_ok:
         if qpad != Q:
             qw = jnp.concatenate(
                 [qw, jnp.zeros((qpad - Q, F), qw.dtype)], axis=0)

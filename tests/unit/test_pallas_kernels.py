@@ -139,3 +139,34 @@ def test_knn_auto_pads_small_q():
                              tile=2048, interpret=True)
     ev, ei = _exact_topk(q, v, mask, k, "cosine")
     assert len(set(np.asarray(pi)[0]) & set(ei[0])) >= 4
+
+
+def test_bm25_dense_topk_early_exit_tie_parity():
+    """The early-exit while-loop selection must match lax.top_k over the
+    dense bf16 score row exactly — including id order under heavy exact
+    ties (quantized impacts), fully-masked regions, and a dense cluster
+    competing for every slot late in the sweep."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    from elasticsearch_tpu.ops.pallas_kernels import bm25_dense_topk_pallas
+
+    rng = np.random.default_rng(3)
+    Q, F, D, k = 16, 16, 4096, 10
+    for quant in (0.05, 1.0, 0.5):  # 1.0 → near-total tie rows
+        qw = (rng.random((Q, F)) * 2).astype(np.float32)
+        impact = rng.random((F, D)).astype(np.float32)
+        impact = (impact / quant).round() * quant
+        mask = rng.random(D) > 0.3
+        mask[:600] = False
+        v, i = bm25_dense_topk_pallas(
+            jnp.asarray(qw), jnp.asarray(impact), jnp.asarray(mask),
+            k=k, tile=512, q_tile=8, interpret=True)
+        sc = np.asarray(jnp.dot(jnp.asarray(qw).astype(jnp.bfloat16),
+                                jnp.asarray(impact).astype(jnp.bfloat16),
+                                preferred_element_type=jnp.float32))
+        sc = np.where(mask[None, :], sc, -np.inf)
+        wv, wi = lax.top_k(jnp.asarray(sc), k)
+        np.testing.assert_allclose(np.asarray(v), np.asarray(wv), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(i), np.asarray(wi))
