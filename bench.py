@@ -540,7 +540,8 @@ def main():
     # stages whose body is ONE un-beatable device call that may legitimately
     # compile for minutes on a cold compilation cache (Q=2048 batch jit)
     compile_heavy = ("batched-msearch", "batched-msearch-mixed",
-                     "batched-msearch-bf16", "knn-batched-mfu")
+                     "batched-msearch-bf16", "batched-msearch-xla-ab",
+                     "knn-batched-mfu")
 
     def _stall_watchdog():
         while True:
@@ -728,6 +729,21 @@ def run_bench(args, jax) -> dict:
         PARTIAL.update(batched_qps=round(batched_qps, 1),
                        value=round(batched_qps, 1),
                        vs_baseline=round(batched_qps / cpu_qps_now, 2))
+        stage("batched-msearch-xla-ab")
+        # A/B the batch kernel: the fused Pallas selection vs XLA's
+        # chunked matmul + top_k (ESTPU_BM25_BATCH_KERNEL). Whichever
+        # wins informs the default; both numbers land in the record.
+        try:
+            os.environ["ESTPU_BM25_BATCH_KERNEL"] = "xla"
+            qps_xla, xdt = batched_msearch_qps(node, bat_q, args.k)
+            log(f"batched msearch (XLA kernel): {len(bat_q)} queries in "
+                f"{xdt * 1000:.0f} ms -> {qps_xla:.0f} qps "
+                f"(pallas: {batched_qps:.0f})")
+            PARTIAL["batched_qps_xla"] = round(qps_xla, 1)
+        except Exception as e:  # the A/B must never sink the capture
+            log(f"XLA batch A/B failed: {e}")
+        finally:
+            os.environ.pop("ESTPU_BM25_BATCH_KERNEL", None)
         stage("batched-msearch-mixed")
         # mixed Zipfian batch (rare-term scatter tails allowed): the
         # tier-2 hybrid batch path — realistic msearch traffic, not the
