@@ -94,14 +94,26 @@ def bm25_score_batch(doc_ids, tfnorm, starts, lens, weights, *, P: int, D: int):
 
 
 def topk_block_config() -> int:
-    """The blocked-top-k knob, read from ``ESTPU_BLOCKED_TOPK``: 0/unset =
-    flat ``lax.top_k``; 1/true = two-stage with the default 8192 block;
-    an integer = that block size. MUST be read OUTSIDE jit (at call or
-    program-build time) and plumbed through as a static argument, so the
-    choice participates in jit/program cache keys — an env read inside
-    traced code would be silently frozen by the first trace."""
+    """The blocked-top-k knob, read from ``ESTPU_BLOCKED_TOPK``: unset =
+    platform default (8192 on TPU — the two-stage selection measured
+    ~9 ms faster than one 1M-wide flat ``lax.top_k`` on a v5e, and
+    ``exact_topk`` is tie-exact so there is no accuracy trade; 0 = flat
+    elsewhere, where XLA:CPU's top_k is already fine); 0/false = flat;
+    1/true = two-stage with the default 8192 block; an integer = that
+    block size. MUST be read OUTSIDE jit (at call or program-build time)
+    and plumbed through as a static argument, so the choice participates
+    in jit/program cache keys — an env read inside traced code would be
+    silently frozen by the first trace."""
     v = os.environ.get("ESTPU_BLOCKED_TOPK", "").lower()
-    if not v or v in ("0", "false", "off"):
+    if not v:
+        try:
+            import jax
+
+            on_tpu = jax.default_backend() == "tpu"
+        except Exception:  # backend probe must never break scoring
+            on_tpu = False
+        return 8192 if on_tpu else 0
+    if v in ("0", "false", "off"):
         return 0
     if v in ("1", "true", "on"):
         return 8192
