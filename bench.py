@@ -363,7 +363,14 @@ def cpu_bm25_latency(u_doc, tfn, offsets, idf, queries, n_docs, k, runs=3):
             times[qi] = min(times[qi], time.perf_counter() - t0)
             beat()
             if run == 0:
-                tops.append(top)
+                # agreement-probe copy, OUTSIDE the timed region: widen
+                # the partition so ties STRADDLING the k-th position also
+                # resolve by ascending doc id (argpartition alone keeps an
+                # arbitrary member of a boundary tie class)
+                kw = min(k + 64, scores.shape[0] - 1)
+                wide = np.argpartition(-scores, kw)[:kw]
+                wide = wide[np.lexsort((wide, -scores[wide]))]
+                tops.append(wide[:k])
     return times, tops
 
 
@@ -707,6 +714,31 @@ def run_bench(args, jax) -> dict:
                 os.environ.pop(name, None)
             else:
                 os.environ[name] = v
+
+    stage("tail-mode-ab")
+    # A/B the single-query tail construction: candidate-set (TPU default;
+    # scatter-free) vs the [D] scatter-add. Whichever loses informs the
+    # auto default; the record carries both.
+    _tm_old = os.environ.get("ESTPU_TAIL_MODE")
+    try:
+        mode = (_tm_old or "auto").lower()
+        if mode == "auto":  # resolve the platform default being measured
+            mode = ("candidates" if jax.default_backend() == "tpu"
+                    else "scatter")
+        other = "scatter" if mode == "candidates" else "candidates"
+        os.environ["ESTPU_TAIL_MODE"] = other
+        ab_times, _ = bm25_product_latency(node, lat_q, args.k)
+        p50_ab = percentile_ms(ab_times, 50)
+        log(f"tail-mode A/B ({other}): p50 {p50_ab:.2f} ms "
+            f"(default-mode p50 {p50:.2f} ms)")
+        PARTIAL[f"p50_ms_tail_{other}"] = round(p50_ab, 3)
+    except Exception as e:  # secondary: never sink the capture
+        log(f"tail-mode A/B failed: {e}")
+    finally:
+        if _tm_old is None:
+            os.environ.pop("ESTPU_TAIL_MODE", None)
+        else:
+            os.environ["ESTPU_TAIL_MODE"] = _tm_old
 
     # -- batched product path ------------------------------------------------
     stage("batched-msearch")

@@ -63,9 +63,16 @@ class MultiHostCluster:
         nid = f"{rank:04d}-{node.node_id}"
         # ONE identity everywhere: cluster state, /_nodes maps, cat rows
         # (the reference's node id is likewise a single value across APIs);
-        # the rank prefix stays so lowest-id election is deterministic
+        # the rank prefix stays so lowest-id election is deterministic.
+        # Gateway-recovered indices registered their shard routings under
+        # the PRE-rename id — rewrite them, or the routing table dangles
+        # on a node id no nodes/_nodes map contains
+        old_id = node.node_id
         node.node_id = nid
         state = node.cluster_state
+        for r in state.routing:
+            if r.node_id == old_id:
+                r.node_id = nid
         state.nodes.clear()  # replace the single-node bootstrap entry
         self.transport = TransportService(nid)
         host, port = self.transport.bind(

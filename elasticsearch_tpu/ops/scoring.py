@@ -405,6 +405,103 @@ def term_mask(doc_ids, starts, lens, *, P: int, D: int):
 
 
 # ---------------------------------------------------------------------------
+# scatter-free hybrid top-k (candidate-set tail)
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("P", "D", "k", "topk_block"))
+def bm25_hybrid_candidates_topk(dense_impact, qrows, qrw, doc_ids, tfnorm,
+                                starts, lens, weights, live, *, P: int,
+                                D: int, k: int, topk_block: int = 0):
+    """Exact hybrid BM25 top-k with NO scatter anywhere.
+
+    The [D]-vector tail construction (`bm25_score_segment`) is a
+    scatter-add — on TPU, XLA lowers non-trivial scatters to a
+    sequential read-modify-write loop (~2 µs/slot), so a T×P padded
+    window costs tens of ms per query regardless of how few postings are
+    real. This computes the same top-k Lucene-style instead: only the
+    docs the tail actually TOUCHES are scored.
+
+      1. dense[D] = qrw @ impact[qrows]   (row gather, no scatter)
+      2. tail windows → (doc, contrib) pairs [W = T·P], sort by doc
+         (vectorized bitonic), segment-sum equal-doc runs via cumsum
+      3. tail candidates = run ends; their TOTAL score adds dense[doc]
+         via a W-element gather
+      4. merge with the dense-only blocked top-k; a doc in both sets
+         keeps the tail entry (its total includes the dense part, the
+         dense-only entry doesn't) — dedup by id-match mask
+      5. exact totals = |dense>0 ∧ live| + |tail runs with dense==0 ∧
+         live ∧ contrib>0|
+
+    Tie order matches the scatter path's `lax.top_k` over the dense
+    row: the final merge sorts by (-score, doc id). Returns
+    (vals f32[k], idx i32[k], total i32).
+    """
+    # 1. dense scores (gather form), masked
+    rows = dense_impact[jnp.maximum(qrows, 0)]
+    dense = jnp.einsum("r,rd->d", qrw, rows.astype(jnp.float32),
+                       precision=lax.Precision.HIGHEST)
+    dense_m = jnp.where(live, dense, 0.0)
+
+    # 2. tail windows → flat (doc, contrib); padding → doc D, contrib 0
+    def per_chunk(start, length, w):
+        docs, tfn, valid = _slice_postings(doc_ids, tfnorm, start, length, P)
+        return jnp.where(valid, docs, D), jnp.where(valid, tfn * w, 0.0)
+
+    T = starts.shape[0]
+    dws, contrib = jax.vmap(per_chunk)(starts, lens, weights)
+    dws = dws.reshape(-1)
+    contrib = contrib.reshape(-1)
+    # sort by doc id; padding (doc D) sorts to the tail
+    dws, contrib = lax.sort((dws, contrib), num_keys=1)
+    # segment-sum runs of equal doc, EXACTLY: a doc appears at most once
+    # per tail term (chunk-split slices are disjoint), so run length <= T
+    # (static) and T-1 shifted adds sum each run in-order in f32 — no
+    # cumsum-difference cancellation across the 32k window
+    totals_at = contrib
+    for j in range(1, T):
+        same = jnp.concatenate([jnp.zeros((j,), bool),
+                                dws[j:] == dws[:-j]])
+        totals_at = totals_at + jnp.where(
+            same, jnp.concatenate([jnp.zeros((j,), contrib.dtype),
+                                   contrib[:-j]]), 0.0)
+    is_end = jnp.concatenate([dws[1:] != dws[:-1], jnp.ones((1,), bool)])
+    valid_end = is_end & (dws < D)
+    tail_total = jnp.where(valid_end, totals_at, 0.0)
+
+    # 3. add the dense part + live mask at the touched docs
+    docs_c = jnp.minimum(dws, D - 1)
+    dense_at = dense_m[docs_c]
+    live_at = live[docs_c]
+    cand_score = jnp.where(valid_end & live_at, tail_total + dense_at,
+                           NEG_INF)
+
+    # 4. dense-only top-k (docs the tail may not touch)
+    dmasked = jnp.where(live & (dense > 0), dense, NEG_INF)
+    dv, di = topk_auto(dmasked, k, topk_block)
+    # drop dense-only entries whose doc also appears as a tail candidate
+    # (the tail entry holds the doc's FULL score)
+    dup = jnp.any((di[:, None] == docs_c[None, :])
+                  & valid_end[None, :], axis=1)
+    dv = jnp.where(dup, NEG_INF, dv)
+    all_v = jnp.concatenate([dv, cand_score])
+    all_i = jnp.concatenate([di, docs_c])
+    # positives only (score > 0 is the match contract); exact tie order:
+    # sort candidates by id ascending, then a stable value top_k
+    all_v = jnp.where(all_v > 0, all_v, NEG_INF)
+    order = jnp.argsort(all_i)
+    sv = all_v[order]
+    si = all_i[order]
+    vals, pos = lax.top_k(sv, k)
+    idx = si[pos]
+
+    # 5. exact totals
+    n_dense = jnp.sum((dense_m > 0).astype(jnp.int32))
+    tail_only = valid_end & live_at & (tail_total > 0) & (dense_at <= 0)
+    total = n_dense + jnp.sum(tail_only.astype(jnp.int32))
+    return vals, idx.astype(jnp.int32), total
+
+
+# ---------------------------------------------------------------------------
 # doc-value masks
 # ---------------------------------------------------------------------------
 

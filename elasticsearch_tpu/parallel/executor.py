@@ -181,6 +181,36 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
     return fn
 
 
+def _tail_candidates_mode(compiled) -> bool:
+    """True when this structure should run the scatter-free candidate-set
+    top-k: a single hybrid scores-mode term group with no sort/aggs/mask
+    (the plain match/term single-query shape — the latency headline).
+    ``ESTPU_TAIL_MODE``: auto (default — candidates on TPU, where XLA
+    serializes scatter-adds; the [D] scatter elsewhere) | candidates |
+    scatter. Read at program-build time; search_dsl keys its cache on it.
+    """
+    import os
+
+    from elasticsearch_tpu.parallel.compiler import ETermGroupHybrid
+
+    if not (isinstance(compiled.root, ETermGroupHybrid)
+            and compiled.root.mode == "scores"
+            and compiled.sort_prim is None and not compiled.agg_prims
+            and not compiled.want_mask):
+        return False
+    mode = os.environ.get("ESTPU_TAIL_MODE", "auto").lower()
+    if mode == "candidates":
+        return True
+    if mode == "scatter":
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=()):
     """Build the shard_map program for one compiled DSL structure: emit-tree
     score/mask → local top-k → all_gather + global top-k, exact totals via
@@ -205,6 +235,7 @@ def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=()):
     n_aggs = len(compiled.agg_prims)
     psum, all_gather, wrap, sl = _collectives(mesh)
     packed_idx = {i for i, _, _ in pack_spec}
+    tail_candidates = _tail_candidates_mode(compiled)
 
     def body(*phys):
         raw = list(phys)
@@ -227,22 +258,43 @@ def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=()):
                            else sl(next(it))
                            for j in range(pos, pos + c))
             pos += c
-        scores, mask = compiled.root.sm(env, meta)
-        live = env[compiled.live][0]
-        mask = mask & live
-        totals = psum(jnp.sum(mask.astype(jnp.int32)), "shard")
-        if compiled.sort_prim is not None:
-            desc, miss_first = compiled.sort_cfg
-            values, exists = env[compiled.sort_prim]
-            missing = jnp.float32(-jnp.inf if desc else jnp.inf)
-            if miss_first:
-                missing = -missing
-            keyv = jnp.where(exists, values, missing)
-            rank = keyv * (1.0 if desc else -1.0)
+        if tail_candidates:
+            # scatter-free fast path: a single hybrid scores-mode group
+            # with no sort/aggs/mask computes its local top-k Lucene-style
+            # (only tail-TOUCHED docs scored; ops/scoring.
+            # bm25_hybrid_candidates_topk has the traffic/serialization
+            # math) — XLA's scatter lowering serializes on TPU, so the
+            # [D]-vector construction is the single-query wall
+            from elasticsearch_tpu.ops.scoring import (
+                bm25_hybrid_candidates_topk)
+
+            root = compiled.root
+            doc_ids, tfnorm = env[root.post]
+            impact, qrows, qrw, starts, lens, ws = env[root.prim]
+            (P, _R) = meta[root.prim]
+            live = env[compiled.live][0]
+            vals, idx, tot = bm25_hybrid_candidates_topk(
+                impact, qrows, qrw, doc_ids, tfnorm, starts, lens, ws,
+                live, P=P, D=root.D, k=k, topk_block=blk)
+            # boost is already folded into qrw/ws by the prim's terms_fn
+            totals = psum(tot, "shard")
         else:
-            rank = scores
-        masked = jnp.where(mask, rank, -jnp.inf)
-        vals, idx = topk_auto(masked, k, blk)
+            scores, mask = compiled.root.sm(env, meta)
+            live = env[compiled.live][0]
+            mask = mask & live
+            totals = psum(jnp.sum(mask.astype(jnp.int32)), "shard")
+            if compiled.sort_prim is not None:
+                desc, miss_first = compiled.sort_cfg
+                values, exists = env[compiled.sort_prim]
+                missing = jnp.float32(-jnp.inf if desc else jnp.inf)
+                if miss_first:
+                    missing = -missing
+                keyv = jnp.where(exists, values, missing)
+                rank = keyv * (1.0 if desc else -1.0)
+            else:
+                rank = scores
+            masked = jnp.where(mask, rank, -jnp.inf)
+            vals, idx = topk_auto(masked, k, blk)
         av = all_gather(vals, "shard")  # [S, k]
         ai = all_gather(idx, "shard")
         S = av.shape[0]
@@ -591,7 +643,8 @@ class MeshSearchExecutor:
 
             prog_key = ("dsl", compiled.struct_key(), tuple(statics),
                         tuple(tuple(a.shape) + (str(a.dtype),) for a in arrays),
-                        kk, topk_block_config())
+                        kk, topk_block_config(),
+                        _tail_candidates_mode(compiled))
             # per-query host tables (row lists, chunk tables, bounds) ship
             # as ONE packed word buffer: each separate device_put is a
             # full host→device round trip on tunneled chips

@@ -516,3 +516,88 @@ def test_gather_hybrid_matches_matmul_hybrid():
     got_m = np.asarray(term_mask_hybrid_gather(
         impact, qrows, d_doc, starts, lens, P=P, D=D))
     np.testing.assert_array_equal(got_m, want_m)
+
+
+def test_candidates_topk_matches_scatter_path():
+    """bm25_hybrid_candidates_topk (scatter-free) == dense scatter path
+    (score vector + masked top-k + count) — across duplicate tail docs,
+    dense/tail overlap, dead docs, chunk-split runs, and exact ties."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.index.segment import build_dense_impact
+    from elasticsearch_tpu.ops.scoring import (
+        bm25_hybrid_candidates_topk, bm25_score_hybrid_gather,
+        pack_dense_rows, topk_with_mask)
+
+    rng = np.random.default_rng(23)
+    n_docs, vocab, k = 512, 64, 10
+    D = pow2_bucket(n_docs)
+    doc_lists = [
+        np.sort(rng.choice(n_docs, size=max(1, n_docs // (t + 1)),
+                           replace=False))
+        for t in range(vocab)
+    ]
+    df = np.array([len(d) for d in doc_lists], np.int32)
+    offsets = np.zeros(vocab + 1, np.int64)
+    offsets[1:] = np.cumsum(df)
+    nnz = int(df.sum())
+    u_doc = np.concatenate(doc_lists).astype(np.int32)
+    tfn = rng.random(nnz).astype(np.float32) + 0.5
+    tfn = (tfn * 8).round() / 8  # quantize -> exact ties exist
+    block = build_dense_impact(u_doc, tfn, offsets, df, D, df_threshold=64)
+    dense_rows, impact = block
+    nnz_pad = pow2_bucket(nnz)
+    d_doc = np.full(nnz_pad, D, np.int32)
+    d_doc[:nnz] = u_doc
+    d_tfn = np.zeros(nnz_pad, np.float32)
+    d_tfn[:nnz] = tfn
+    live = np.ones(D, bool)
+    live[n_docs:] = False
+    live[rng.choice(n_docs, 40, replace=False)] = False  # dead docs
+
+    for trial, qterms in enumerate([[0, 1, 40, 41, 63],  # overlap-heavy
+                                    [50, 60, 63],        # tail-only
+                                    [0, 1],              # dense-only
+                                    [0, 30, 31, 32, 60, 61, 62, 63]]):
+        weights = [float(1.0 + 0.5 * i) for i in range(len(qterms))]
+        row_w = {}
+        runs = []
+        for t, w in zip(qterms, weights):
+            row = int(dense_rows[t])
+            if row >= 0:
+                row_w[row] = row_w.get(row, 0.0) + w
+            else:
+                runs.append((int(offsets[t]), int(df[t]), w))
+        if not row_w:
+            continue  # hybrid paths require >= 1 dense term
+        qrows, qrw = pack_dense_rows(row_w)
+        from elasticsearch_tpu.search.context import split_runs
+        starts_l, lens_l, ws_l, max_len = (split_runs(runs) if runs
+                                           else ([], [], [], 1))
+        P = pow2_bucket(max_len)
+        T = pow2_bucket(max(len(starts_l), 1))
+        starts = np.zeros(T, np.int32)
+        lens = np.zeros(T, np.int32)
+        ws = np.zeros(T, np.float32)
+        for i, (s, ln, w) in enumerate(zip(starts_l, lens_l, ws_l)):
+            starts[i], lens[i], ws[i] = s, ln, w
+
+        # reference: full scatter score vector -> masked topk + count
+        scores = np.asarray(bm25_score_hybrid_gather(
+            impact, qrows, qrw, d_doc, d_tfn, starts, lens, ws, P=P, D=D))
+        m = (scores > 0) & live
+        wv, wi = topk_with_mask(jnp.asarray(scores),
+                                jnp.asarray(m), k=k)
+        want_total = int(m.sum())
+
+        gv, gi, gt = bm25_hybrid_candidates_topk(
+            impact, qrows, qrw, d_doc, d_tfn, starts, lens, ws,
+            jnp.asarray(live), P=P, D=D, k=k, topk_block=0)
+        np.testing.assert_allclose(np.asarray(gv), np.asarray(wv),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"trial {trial} vals")
+        finite = np.isfinite(np.asarray(wv))
+        np.testing.assert_array_equal(np.asarray(gi)[finite],
+                                      np.asarray(wi)[finite],
+                                      err_msg=f"trial {trial} ids")
+        assert int(gt) == want_total, (trial, int(gt), want_total)
