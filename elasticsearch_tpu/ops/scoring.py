@@ -501,6 +501,89 @@ def bm25_hybrid_candidates_topk(dense_impact, qrows, qrw, doc_ids, tfnorm,
     return vals, idx.astype(jnp.int32), total
 
 
+@partial(jax.jit, static_argnames=("P", "D", "k", "topk_block", "prec"))
+def bm25_hybrid_candidates_topk_batch(dense_impact, qw, doc_ids, tfnorm,
+                                      starts, lens, weights, live, *,
+                                      P: int, D: int, k: int,
+                                      topk_block: int = 0,
+                                      prec: str = "highest"):
+    """Batched hybrid top-k with a scatter-free tail (batch analogue of
+    bm25_hybrid_candidates_topk; same contract as bm25_hybrid_topk_batch).
+
+    Dense terms keep the ONE amortized matmul ``qw[Q, F] @ impact[F, D]``
+    (a batch reads the block once — the row-gather trick is a
+    single-query lever); the tail replaces the vmapped scatter-add —
+    which XLA serializes per element on TPU, Q·T·P slots per batch —
+    with per-row sort + bounded-window segment-sum + gathers, all
+    vectorized. Returns (vals [Q, k], idx [Q, k], totals [Q]).
+    """
+    Q, T = starts.shape
+    dense = _dense_dot(qw, dense_impact, prec)  # [Q, D]
+    dense_m = jnp.where(live[None, :], dense, 0.0)
+
+    def window(starts_q, lens_q, ws_q):
+        def per_chunk(start, length, w):
+            docs, tfn, valid = _slice_postings(doc_ids, tfnorm, start,
+                                               length, P)
+            return jnp.where(valid, docs, D), jnp.where(valid, tfn * w, 0.0)
+
+        dws, contrib = jax.vmap(per_chunk)(starts_q, lens_q, ws_q)
+        return dws.reshape(-1), contrib.reshape(-1)
+
+    dws, contrib = jax.vmap(window)(starts, lens, weights)  # [Q, W]
+    dws, contrib = lax.sort((dws, contrib), dimension=1, num_keys=1)
+    totals_at = contrib
+    for j in range(1, T):  # run length <= T: exact in-order f32 sums
+        same = jnp.concatenate(
+            [jnp.zeros((Q, j), bool), dws[:, j:] == dws[:, :-j]], axis=1)
+        totals_at = totals_at + jnp.where(
+            same, jnp.concatenate([jnp.zeros((Q, j), contrib.dtype),
+                                   contrib[:, :-j]], axis=1), 0.0)
+    is_end = jnp.concatenate([dws[:, 1:] != dws[:, :-1],
+                              jnp.ones((Q, 1), bool)], axis=1)
+    valid_end = is_end & (dws < D)
+    tail_total = jnp.where(valid_end, totals_at, 0.0)
+    docs_c = jnp.minimum(dws, D - 1)
+    dense_at = jnp.take_along_axis(dense_m, docs_c, axis=1)  # [Q, W]
+    live_at = live[docs_c]
+    cand_score = jnp.where(valid_end & live_at, tail_total + dense_at,
+                           NEG_INF)
+
+    dmasked = jnp.where(live[None, :] & (dense > 0), dense, NEG_INF)
+    dv, di = topk_auto(dmasked, k, topk_block)  # [Q, k]
+    dup = jnp.any((di[:, :, None] == docs_c[:, None, :])
+                  & valid_end[:, None, :], axis=2)
+    dv = jnp.where(dup, NEG_INF, dv)
+    all_v = jnp.concatenate([dv, cand_score], axis=1)
+    all_i = jnp.concatenate([di, docs_c], axis=1)
+    all_v = jnp.where(all_v > 0, all_v, NEG_INF)
+    order = jnp.argsort(all_i, axis=1)
+    sv = jnp.take_along_axis(all_v, order, axis=1)
+    si = jnp.take_along_axis(all_i, order, axis=1)
+    vals, pos = lax.top_k(sv, k)
+    idx = jnp.take_along_axis(si, pos, axis=1)
+
+    n_dense = jnp.sum((dense_m > 0).astype(jnp.int32), axis=1)
+    tail_only = valid_end & live_at & (tail_total > 0) & (dense_at <= 0)
+    totals = n_dense + jnp.sum(tail_only.astype(jnp.int32), axis=1)
+    return vals, idx.astype(jnp.int32), totals
+
+
+def tail_mode_batch() -> bool:
+    """True when batch paths should use the scatter-free candidate tail
+    (same ESTPU_TAIL_MODE knob/platform default as the DSL fast path).
+    Read eagerly by callers and passed through static dispatch."""
+    mode = os.environ.get("ESTPU_TAIL_MODE", "auto").lower()
+    if mode in ("candidates", "scatter"):
+        return mode == "candidates"
+    try:
+        import jax as _jax
+
+        return _jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 # ---------------------------------------------------------------------------
 # doc-value masks
 # ---------------------------------------------------------------------------

@@ -328,7 +328,9 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
         lens[qi, : ln.shape[0]] = ln
         ws[qi, : w.shape[0]] = w
     from elasticsearch_tpu.monitor import kernels
-    from elasticsearch_tpu.ops.scoring import bm25_hybrid_topk_batch
+    from elasticsearch_tpu.ops.scoring import (
+        bm25_hybrid_candidates_topk_batch, bm25_hybrid_topk_batch,
+        tail_mode_batch)
 
     jnp = _jnp()
     live = ctx.segment.live
@@ -339,10 +341,14 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
     blk = topk_block_config()  # once per batch: every chunk must compile
     # against the SAME static block even if the env flips mid-batch
     _prec = impact_precision()
+    # tail dispatch, once per batch: the scatter-free candidate form on
+    # TPU (the vmapped scatter serializes Q·T·P slots), scatter elsewhere
+    batch_fn = (bm25_hybrid_candidates_topk_batch if tail_mode_batch()
+                else bm25_hybrid_topk_batch)
     out_v, out_i, out_t = [], [], []
     for q0 in range(0, Q, chunk_q):
         q1 = min(q0 + chunk_q, Q)
-        vals, ids, tot = bm25_hybrid_topk_batch(
+        vals, ids, tot = batch_fn(
             impact, jnp.asarray(qw[q0:q1]), inv.doc_ids, inv.tfnorm,
             jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
             jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk,

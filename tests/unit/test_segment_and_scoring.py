@@ -601,3 +601,82 @@ def test_candidates_topk_matches_scatter_path():
                                       np.asarray(wi)[finite],
                                       err_msg=f"trial {trial} ids")
         assert int(gt) == want_total, (trial, int(gt), want_total)
+
+
+def test_candidates_topk_batch_matches_scatter_batch():
+    """bm25_hybrid_candidates_topk_batch == bm25_hybrid_topk_batch across
+    a mixed batch (per-query different dense/tail splits, ties, dupes)."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.index.segment import build_dense_impact
+    from elasticsearch_tpu.ops.scoring import (
+        bm25_hybrid_candidates_topk_batch, bm25_hybrid_topk_batch)
+    from elasticsearch_tpu.search.context import split_runs
+
+    rng = np.random.default_rng(31)
+    n_docs, vocab, k = 512, 64, 10
+    D = pow2_bucket(n_docs)
+    doc_lists = [
+        np.sort(rng.choice(n_docs, size=max(1, n_docs // (t + 1)),
+                           replace=False))
+        for t in range(vocab)
+    ]
+    df = np.array([len(d) for d in doc_lists], np.int32)
+    offsets = np.zeros(vocab + 1, np.int64)
+    offsets[1:] = np.cumsum(df)
+    nnz = int(df.sum())
+    u_doc = np.concatenate(doc_lists).astype(np.int32)
+    tfn = ((rng.random(nnz) + 0.5) * 8).round().astype(np.float32) / 8
+    block = build_dense_impact(u_doc, tfn, offsets, df, D, df_threshold=64)
+    dense_rows, impact = block
+    F = impact.shape[0]
+    nnz_pad = pow2_bucket(nnz)
+    d_doc = np.full(nnz_pad, D, np.int32)
+    d_doc[:nnz] = u_doc
+    d_tfn = np.zeros(nnz_pad, np.float32)
+    d_tfn[:nnz] = tfn
+    live = np.ones(D, bool)
+    live[n_docs:] = False
+    live[rng.choice(n_docs, 30, replace=False)] = False
+
+    batches = [[0, 1, 40, 63], [0, 50, 60], [1, 2], [30, 31, 62, 63],
+               [0, 1, 2, 3, 60, 61]]
+    qw = np.zeros((len(batches), F), np.float32)
+    all_runs = []
+    Pmax, Tmax = 1, 1
+    for qi, qterms in enumerate(batches):
+        runs = []
+        for i, t in enumerate(qterms):
+            w = 1.0 + 0.3 * i
+            row = int(dense_rows[t])
+            if row >= 0:
+                qw[qi, row] += w
+            else:
+                runs.append((int(offsets[t]), int(df[t]), w))
+        st, ln, ws_, mx = split_runs(runs) if runs else ([], [], [], 1)
+        Pmax = max(Pmax, pow2_bucket(mx))
+        Tmax = max(Tmax, len(st))
+        all_runs.append((st, ln, ws_))
+    T = pow2_bucket(max(Tmax, 1))
+    starts = np.zeros((len(batches), T), np.int32)
+    lens = np.zeros((len(batches), T), np.int32)
+    ws = np.zeros((len(batches), T), np.float32)
+    for qi, (st, ln, ws_) in enumerate(all_runs):
+        starts[qi, :len(st)] = st
+        lens[qi, :len(ln)] = ln
+        ws[qi, :len(ws_)] = ws_
+
+    wv, wi, wt = bm25_hybrid_topk_batch(
+        impact, jnp.asarray(qw), d_doc, d_tfn, jnp.asarray(starts),
+        jnp.asarray(lens), jnp.asarray(ws), jnp.asarray(live),
+        P=Pmax, D=D, k=k, topk_block=0)
+    gv, gi, gt = bm25_hybrid_candidates_topk_batch(
+        impact, jnp.asarray(qw), d_doc, d_tfn, jnp.asarray(starts),
+        jnp.asarray(lens), jnp.asarray(ws), jnp.asarray(live),
+        P=Pmax, D=D, k=k, topk_block=0)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(wv),
+                               rtol=2e-5, atol=2e-5)
+    finite = np.isfinite(np.asarray(wv))
+    np.testing.assert_array_equal(np.asarray(gi)[finite],
+                                  np.asarray(wi)[finite])
+    np.testing.assert_array_equal(np.asarray(gt), np.asarray(wt))
