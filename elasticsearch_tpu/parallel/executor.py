@@ -236,6 +236,10 @@ def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=()):
     psum, all_gather, wrap, sl = _collectives(mesh)
     packed_idx = {i for i, _, _ in pack_spec}
     tail_candidates = _tail_candidates_mode(compiled)
+    from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
+    # the same platform/env switch governs every scatter-vs-sort choice
+    scatter_free = tail_mode_batch()
 
     def body(*phys):
         raw = list(phys)
@@ -317,8 +321,19 @@ def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=()):
             doc_ids, term_ids, vreal = env[prim]
             (vmax,) = meta[prim]
             w = mask[doc_ids] & (term_ids < vreal)
-            cnts = jnp.zeros(vmax + 1, jnp.float32).at[term_ids].add(
-                w.astype(jnp.float32), mode="drop")
+            if scatter_free:
+                # TPU: histogram via sort + boundary search — the
+                # len(term_ids)-element scatter-add into the bin vector
+                # serializes on TPU like the scoring tail did. Masked
+                # entries sort to the vmax+1 sentinel past every bin.
+                ids = jnp.where(w, term_ids, vmax + 1)
+                sids = jnp.sort(ids)
+                bounds = jnp.searchsorted(
+                    sids, jnp.arange(vmax + 2, dtype=sids.dtype))
+                cnts = (bounds[1:] - bounds[:-1]).astype(jnp.float32)
+            else:
+                cnts = jnp.zeros(vmax + 1, jnp.float32).at[term_ids].add(
+                    w.astype(jnp.float32), mode="drop")
             outs.append(cnts[None, :])  # keep per-shard partials
         if compiled.want_mask:
             outs.append(mask[None, :])  # [S, D] sharded, for host-side aggs
@@ -641,10 +656,12 @@ class MeshSearchExecutor:
             kk = min(k_dev, D)
             from elasticsearch_tpu.ops.scoring import topk_block_config
 
+            from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
             prog_key = ("dsl", compiled.struct_key(), tuple(statics),
                         tuple(tuple(a.shape) + (str(a.dtype),) for a in arrays),
                         kk, topk_block_config(),
-                        _tail_candidates_mode(compiled))
+                        _tail_candidates_mode(compiled), tail_mode_batch())
             # per-query host tables (row lists, chunk tables, bounds) ship
             # as ONE packed word buffer: each separate device_put is a
             # full host→device round trip on tunneled chips
