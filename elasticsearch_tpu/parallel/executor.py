@@ -211,7 +211,8 @@ def _tail_candidates_mode(compiled) -> bool:
         return False
 
 
-def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=()):
+def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=(),
+                 force_scatter: bool = False):
     """Build the shard_map program for one compiled DSL structure: emit-tree
     score/mask → local top-k → all_gather + global top-k, exact totals via
     psum, per-shard terms-agg count vectors.
@@ -235,11 +236,11 @@ def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=()):
     n_aggs = len(compiled.agg_prims)
     psum, all_gather, wrap, sl = _collectives(mesh)
     packed_idx = {i for i, _, _ in pack_spec}
-    tail_candidates = _tail_candidates_mode(compiled)
+    tail_candidates = _tail_candidates_mode(compiled) and not force_scatter
     from elasticsearch_tpu.ops.scoring import tail_mode_batch
 
     # the same platform/env switch governs every scatter-vs-sort choice
-    scatter_free = tail_mode_batch()
+    scatter_free = tail_mode_batch() and not force_scatter
 
     def body(*phys):
         raw = list(phys)
@@ -689,7 +690,28 @@ class MeshSearchExecutor:
             # ONE host transfer for the packed result — per-array pulls
             # each pay a fixed device round-trip (the dominant per-query
             # cost on network-attached chips)
-            out = jax.device_get(prog(*dev))
+            try:
+                out = jax.device_get(prog(*dev))
+            except Exception:
+                from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
+                if not (tail_mode_batch()
+                        or _tail_candidates_mode(compiled)):
+                    raise
+                # insurance for the scatter-free forms (first validated on
+                # real TPU at capture time): a backend-specific failure
+                # falls back to the scatter program rather than failing
+                # the search; the counter makes the degradation visible
+                from elasticsearch_tpu.monitor import kernels
+
+                kernels.record("tail_scatter_free_failed")
+                prog = _dsl_program(self.mesh, compiled, counts,
+                                    statics, kk, pack_spec,
+                                    force_scatter=True)
+                # replace the cached entry: same-shape queries go straight
+                # to the scatter program instead of re-failing
+                self._programs[(prog_key, pack_spec)] = prog
+                out = jax.device_get(prog(*dev))
             packed = out[0]
             kg = self.S * kk if sort_spec else kk  # mirrors the program
             gvals = packed[:kg].view(np.float32)
