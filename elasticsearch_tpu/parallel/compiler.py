@@ -1437,6 +1437,13 @@ class MeshQueryCompiler:
             return self._tgroup_scores(
                 q.field, q.boost, lambda ctx, q=q: (_fuzzy_terms(ctx, q), None))
         if isinstance(q, Q.BoolQuery):
+            if (q.boost == 1.0 and not q.should and not q.must_not
+                    and not q.filter and len(q.must) == 1
+                    and q.msm is None):
+                # trivial single-must wrapper (a common client pattern):
+                # collapse so the child keeps its fast-path eligibility
+                # (the single-group candidate top-k matches on the ROOT)
+                return self._c(q.must[0])
             must = [self._c(c) for c in q.must]
             should = [self._c(c) for c in q.should]
             must_not = [self._c(c) for c in q.must_not]
