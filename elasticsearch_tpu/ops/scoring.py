@@ -501,6 +501,104 @@ def bm25_hybrid_candidates_topk(dense_impact, qrows, qrw, doc_ids, tfnorm,
     return vals, idx.astype(jnp.int32), total
 
 
+# -- scatter-free [D]-vector tail (lookup form) ------------------------------
+#
+# For COMPOSED queries (bool/filter trees) the emit contract is a dense
+# f32[D]/bool[D] — the candidate-set trick can't apply. This builds the
+# same vectors without scatter: sort the (doc, contrib) window pairs,
+# binary-search the D+1 bin boundaries (vectorized; the window table is
+# VMEM-small), and sum each doc's <= T entries with T bounded gathers —
+# exact, in-order f32. Counts and masks fall out of the boundary diffs
+# directly (window docs are unique per term, so entries-per-doc IS the
+# distinct matched-term count).
+
+def _sorted_window_pairs(doc_ids, tfnorm, starts, lens, weights, P, D):
+    def per_chunk(start, length, w):
+        docs, tfn, valid = _slice_postings(doc_ids, tfnorm, start, length, P)
+        return jnp.where(valid, docs, D), jnp.where(valid, tfn * w, 0.0)
+
+    dws, contrib = jax.vmap(per_chunk)(starts, lens, weights)
+    return lax.sort((dws.reshape(-1), contrib.reshape(-1)), num_keys=1)
+
+
+def _tail_bounds(dws, D):
+    bounds = jnp.searchsorted(dws, jnp.arange(D + 1, dtype=dws.dtype))
+    return bounds[:-1], bounds[1:] - bounds[:-1]  # (lo [D], n [D])
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def bm25_score_segment_lookup(doc_ids, tfnorm, starts, lens, weights, *,
+                              P: int, D: int):
+    """Scatter-free equivalent of bm25_score_segment (same f32[D])."""
+    T = starts.shape[0]
+    dws, contrib = _sorted_window_pairs(doc_ids, tfnorm, starts, lens,
+                                        weights, P, D)
+    lo, n = _tail_bounds(dws, D)
+    W = dws.shape[0]
+    score = jnp.zeros(D, jnp.float32)
+    for t in range(T):  # run length <= T terms: exact in-order sums
+        score = score + jnp.where(
+            t < n, contrib[jnp.clip(lo + t, 0, W - 1)], 0.0)
+    return score
+
+
+def _sorted_window_docs(doc_ids, starts, lens, P, D):
+    """Keys-only variant: the sorted window doc ids (no payload sort)."""
+    def per_chunk(start, length):
+        docs, _pay, valid = _slice_postings(doc_ids, doc_ids, start,
+                                            length, P)
+        return jnp.where(valid, docs, D)
+
+    dws = jax.vmap(per_chunk)(starts, lens)
+    return jnp.sort(dws.reshape(-1))
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def match_count_segment_lookup(doc_ids, starts, lens, *, P: int, D: int):
+    """Scatter-free distinct matched-term counts (i32[D]): window docs
+    are unique per term, so entries-per-doc IS the distinct count."""
+    dws = _sorted_window_docs(doc_ids, starts, lens, P, D)
+    _, n = _tail_bounds(dws, D)
+    return n.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def term_mask_lookup(doc_ids, starts, lens, *, P: int, D: int):
+    """Scatter-free any-term mask (bool[D])."""
+    return match_count_segment_lookup(doc_ids, starts, lens, P=P, D=D) > 0
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def bm25_score_hybrid_lookup(dense_impact, qrows, qrw, doc_ids, tfnorm,
+                             starts, lens, weights, *, P: int, D: int):
+    """Row-gather dense + lookup tail (scatter-free hybrid scores)."""
+    rows = dense_impact[jnp.maximum(qrows, 0)]
+    dense = jnp.einsum("r,rd->d", qrw, rows.astype(jnp.float32),
+                       precision=lax.Precision.HIGHEST)
+    return dense + bm25_score_segment_lookup(doc_ids, tfnorm, starts,
+                                             lens, weights, P=P, D=D)
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def match_count_hybrid_lookup(dense_impact, qrows, doc_ids, starts, lens,
+                              *, P: int, D: int):
+    """Gathered dense presence + lookup tail counts (scatter-free)."""
+    valid = (qrows >= 0)[:, None]
+    present = (dense_impact[jnp.maximum(qrows, 0)] != 0) & valid
+    return (jnp.sum(present.astype(jnp.int32), axis=0)
+            + match_count_segment_lookup(doc_ids, starts, lens, P=P, D=D))
+
+
+@partial(jax.jit, static_argnames=("P", "D"))
+def term_mask_hybrid_lookup(dense_impact, qrows, doc_ids, starts, lens,
+                            *, P: int, D: int):
+    """Gathered dense presence | lookup tail mask (scatter-free)."""
+    valid = (qrows >= 0)[:, None]
+    dmask = jnp.any((dense_impact[jnp.maximum(qrows, 0)] != 0) & valid,
+                    axis=0)
+    return dmask | term_mask_lookup(doc_ids, starts, lens, P=P, D=D)
+
+
 @partial(jax.jit, static_argnames=("P", "D", "k", "topk_block", "prec"))
 def bm25_hybrid_candidates_topk_batch(dense_impact, qw, doc_ids, tfnorm,
                                       starts, lens, weights, live, *,

@@ -744,6 +744,18 @@ class ENone(Emit):
         return None, jnp.zeros(self.D, bool)
 
 
+def _scatter_free(meta) -> bool:
+    """The executor plumbs its scatter-vs-lookup choice (including the
+    force_scatter insurance rebuild) through ``meta["_cfg"]``; emits used
+    outside the executor fall back to the platform/env default."""
+    cfg = meta.get("_cfg")
+    if cfg is not None and "scatter_free" in cfg:
+        return bool(cfg["scatter_free"])
+    from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
+    return tail_mode_batch()
+
+
 class ETermGroup(Emit):
     """mode 'scores': BM25 scores, mask = scores > 0 (all-positive weights).
     mode 'count_ge': conjunction — distinct matched terms >= n.
@@ -762,18 +774,26 @@ class ETermGroup(Emit):
         return ("tg", self.mode, self.n, self.boost)
 
     def ex(self, env, meta):
-        from elasticsearch_tpu.ops.scoring import (
-            bm25_score_segment, match_count_segment, term_mask)
+        from elasticsearch_tpu.ops import scoring as S
 
+        # trace-time switch, PLUMBED by the executor through meta["_cfg"]
+        # (so its force_scatter insurance rebuild really does trace the
+        # scatter forms; the program cache keys on the mode): the lookup
+        # forms build the same [D] vectors without scatter, which XLA
+        # serializes per slot on TPU
+        lk = _scatter_free(meta)
         doc_ids, tfnorm = env[self.post]
         starts, lens, ws = env[self.prim]
         (P,) = meta[self.prim]
         if self.mode == "mask":
-            return None, term_mask(doc_ids, starts, lens, P=P, D=self.D)
-        scores = bm25_score_segment(doc_ids, tfnorm, starts, lens, ws,
-                                    P=P, D=self.D)
+            fn = S.term_mask_lookup if lk else S.term_mask
+            return None, fn(doc_ids, starts, lens, P=P, D=self.D)
+        sfn = S.bm25_score_segment_lookup if lk else S.bm25_score_segment
+        scores = sfn(doc_ids, tfnorm, starts, lens, ws, P=P, D=self.D)
         if self.mode == "count_ge":
-            counts = match_count_segment(doc_ids, starts, lens, P=P, D=self.D)
+            cfn = (S.match_count_segment_lookup if lk
+                   else S.match_count_segment)
+            counts = cfn(doc_ids, starts, lens, P=P, D=self.D)
             return scores, counts >= self.n
         return scores, scores > 0
 
@@ -799,22 +819,26 @@ class ETermGroupHybrid(Emit):
         return ("tgh", self.mode, self.n, self.boost)
 
     def ex(self, env, meta):
-        from elasticsearch_tpu.ops.scoring import (
-            bm25_score_hybrid_gather, match_count_hybrid_gather,
-            term_mask_hybrid_gather)
+        from elasticsearch_tpu.ops import scoring as S
 
+        lk = _scatter_free(meta)  # plumbed via meta["_cfg"] (see ETermGroup)
         doc_ids, tfnorm = env[self.post]
         impact, qrows, qrw, starts, lens, ws = env[self.prim]
         (P, _R) = meta[self.prim]
         if self.mode == "mask":
-            return None, term_mask_hybrid_gather(
-                impact, qrows, doc_ids, starts, lens, P=P, D=self.D)
-        scores = bm25_score_hybrid_gather(
-            impact, qrows, qrw, doc_ids, tfnorm, starts, lens, ws,
-            P=P, D=self.D)
+            fn = (S.term_mask_hybrid_lookup if lk
+                  else S.term_mask_hybrid_gather)
+            return None, fn(impact, qrows, doc_ids, starts, lens,
+                            P=P, D=self.D)
+        sfn = (S.bm25_score_hybrid_lookup if lk
+               else S.bm25_score_hybrid_gather)
+        scores = sfn(impact, qrows, qrw, doc_ids, tfnorm, starts, lens,
+                     ws, P=P, D=self.D)
         if self.mode == "count_ge":
-            counts = match_count_hybrid_gather(
-                impact, qrows, doc_ids, starts, lens, P=P, D=self.D)
+            cfn = (S.match_count_hybrid_lookup if lk
+                   else S.match_count_hybrid_gather)
+            counts = cfn(impact, qrows, doc_ids, starts, lens,
+                         P=P, D=self.D)
             return scores, counts >= self.n
         return scores, scores > 0
 

@@ -230,6 +230,8 @@ def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=(),
 
     from elasticsearch_tpu.ops.scoring import topk_auto, topk_block_config
 
+    from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
     blk = topk_block_config()  # read OUTSIDE the traced body; the caller
     # keys its program cache on it too (search_dsl prog_key)
     meta = {i: s for i, s in enumerate(statics)}
@@ -237,10 +239,12 @@ def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=(),
     psum, all_gather, wrap, sl = _collectives(mesh)
     packed_idx = {i for i, _, _ in pack_spec}
     tail_candidates = _tail_candidates_mode(compiled) and not force_scatter
-    from elasticsearch_tpu.ops.scoring import tail_mode_batch
-
-    # the same platform/env switch governs every scatter-vs-sort choice
+    # ONE switch for every scatter-vs-sort choice in this program, plumbed
+    # to the emits through meta["_cfg"] (compiler._scatter_free) so the
+    # force_scatter insurance rebuild traces scatter forms INSIDE the
+    # emit tree too, not just at this program's top level
     scatter_free = tail_mode_batch() and not force_scatter
+    meta["_cfg"] = {"scatter_free": scatter_free}
 
     def body(*phys):
         raw = list(phys)

@@ -680,3 +680,129 @@ def test_candidates_topk_batch_matches_scatter_batch():
     np.testing.assert_array_equal(np.asarray(gi)[finite],
                                   np.asarray(wi)[finite])
     np.testing.assert_array_equal(np.asarray(gt), np.asarray(wt))
+
+
+def test_lookup_tail_matches_scatter_forms():
+    """The scatter-free lookup forms produce identical [D] vectors to the
+    scatter kernels (scores/counts/masks), including duplicate docs
+    across terms and chunk-split runs."""
+    from elasticsearch_tpu.ops.scoring import (
+        bm25_score_segment, bm25_score_segment_lookup,
+        match_count_segment, match_count_segment_lookup, term_mask,
+        term_mask_lookup)
+    from elasticsearch_tpu.search.context import split_runs
+
+    rng = np.random.default_rng(41)
+    n_docs, vocab = 512, 32
+    D = pow2_bucket(n_docs)
+    doc_lists = [
+        np.sort(rng.choice(n_docs, size=max(1, n_docs // (t + 1)),
+                           replace=False))
+        for t in range(vocab)
+    ]
+    df = np.array([len(d) for d in doc_lists], np.int32)
+    offsets = np.zeros(vocab + 1, np.int64)
+    offsets[1:] = np.cumsum(df)
+    nnz = int(df.sum())
+    u_doc = np.concatenate(doc_lists).astype(np.int32)
+    tfn = rng.random(nnz).astype(np.float32) + 0.5
+    nnz_pad = pow2_bucket(nnz)
+    d_doc = np.full(nnz_pad, D, np.int32)
+    d_doc[:nnz] = u_doc
+    d_tfn = np.zeros(nnz_pad, np.float32)
+    d_tfn[:nnz] = tfn
+
+    for qterms in ([0, 1, 5, 30], [2], [0, 1, 2, 3, 4, 5, 6, 7]):
+        runs = [(int(offsets[t]), int(df[t]), 1.0 + 0.25 * i)
+                for i, t in enumerate(qterms)]
+        st, ln, ws_, mx = split_runs(runs)
+        P = pow2_bucket(mx)
+        T = pow2_bucket(len(st))
+        starts = np.zeros(T, np.int32)
+        lens = np.zeros(T, np.int32)
+        ws = np.zeros(T, np.float32)
+        for i, (s, l, w) in enumerate(zip(st, ln, ws_)):
+            starts[i], lens[i], ws[i] = s, l, w
+        want = np.asarray(bm25_score_segment(
+            d_doc, d_tfn, starts, lens, ws, P=P, D=D))
+        got = np.asarray(bm25_score_segment_lookup(
+            d_doc, d_tfn, starts, lens, ws, P=P, D=D))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+        want_c = np.asarray(match_count_segment(
+            d_doc, starts, lens, P=P, D=D))
+        got_c = np.asarray(match_count_segment_lookup(
+            d_doc, starts, lens, P=P, D=D))
+        np.testing.assert_array_equal(got_c, want_c)
+        want_m = np.asarray(term_mask(d_doc, starts, lens, P=P, D=D))
+        got_m = np.asarray(term_mask_lookup(d_doc, starts, lens, P=P, D=D))
+        np.testing.assert_array_equal(got_m, want_m)
+
+
+def test_hybrid_lookup_matches_hybrid_gather():
+    """The *_hybrid_lookup forms (scatter-free tail) == *_hybrid_gather
+    (scatter tail) for scores, counts, and masks."""
+    from elasticsearch_tpu.index.segment import build_dense_impact
+    from elasticsearch_tpu.ops.scoring import (
+        bm25_score_hybrid_gather, bm25_score_hybrid_lookup,
+        match_count_hybrid_gather, match_count_hybrid_lookup,
+        pack_dense_rows, term_mask_hybrid_gather, term_mask_hybrid_lookup)
+    from elasticsearch_tpu.search.context import split_runs
+
+    rng = np.random.default_rng(47)
+    n_docs, vocab = 512, 64
+    D = pow2_bucket(n_docs)
+    doc_lists = [
+        np.sort(rng.choice(n_docs, size=max(1, n_docs // (t + 1)),
+                           replace=False))
+        for t in range(vocab)
+    ]
+    df = np.array([len(d) for d in doc_lists], np.int32)
+    offsets = np.zeros(vocab + 1, np.int64)
+    offsets[1:] = np.cumsum(df)
+    nnz = int(df.sum())
+    u_doc = np.concatenate(doc_lists).astype(np.int32)
+    tfn = rng.random(nnz).astype(np.float32) + 0.5
+    block = build_dense_impact(u_doc, tfn, offsets, df, D, df_threshold=64)
+    dense_rows, impact = block
+    nnz_pad = pow2_bucket(nnz)
+    d_doc = np.full(nnz_pad, D, np.int32)
+    d_doc[:nnz] = u_doc
+    d_tfn = np.zeros(nnz_pad, np.float32)
+    d_tfn[:nnz] = tfn
+
+    qterms = [0, 1, 2, 40, 63]
+    row_w = {}
+    runs = []
+    for i, t in enumerate(qterms):
+        w = 1.0 + 0.5 * i
+        row = int(dense_rows[t])
+        if row >= 0:
+            row_w[row] = row_w.get(row, 0.0) + w
+        else:
+            runs.append((int(offsets[t]), int(df[t]), w))
+    assert row_w and runs
+    qrows, qrw = pack_dense_rows(row_w)
+    st, ln, ws_, mx = split_runs(runs)
+    P = pow2_bucket(mx)
+    T = pow2_bucket(len(st))
+    starts = np.zeros(T, np.int32)
+    lens = np.zeros(T, np.int32)
+    ws = np.zeros(T, np.float32)
+    for i, (s, l, w) in enumerate(zip(st, ln, ws_)):
+        starts[i], lens[i], ws[i] = s, l, w
+
+    want = np.asarray(bm25_score_hybrid_gather(
+        impact, qrows, qrw, d_doc, d_tfn, starts, lens, ws, P=P, D=D))
+    got = np.asarray(bm25_score_hybrid_lookup(
+        impact, qrows, qrw, d_doc, d_tfn, starts, lens, ws, P=P, D=D))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    want_c = np.asarray(match_count_hybrid_gather(
+        impact, qrows, d_doc, starts, lens, P=P, D=D))
+    got_c = np.asarray(match_count_hybrid_lookup(
+        impact, qrows, d_doc, starts, lens, P=P, D=D))
+    np.testing.assert_array_equal(got_c, want_c)
+    want_m = np.asarray(term_mask_hybrid_gather(
+        impact, qrows, d_doc, starts, lens, P=P, D=D))
+    got_m = np.asarray(term_mask_hybrid_lookup(
+        impact, qrows, d_doc, starts, lens, P=P, D=D))
+    np.testing.assert_array_equal(got_m, want_m)
