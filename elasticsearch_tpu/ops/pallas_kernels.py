@@ -319,14 +319,27 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
                       f"(on_tpu={_on_tpu()}, k={k}, F={F}, q_tile={q_tile},"
                       f" D={D}, tile={tile}) — falling back to XLA")
     if pref != "xla" and gates_ok:
-        if qpad != Q:
-            qw = jnp.concatenate(
-                [qw, jnp.zeros((qpad - Q, F), qw.dtype)], axis=0)
-            vals, idx = bm25_dense_topk_pallas(qw, impact, mask, k=k,
-                                               tile=tile, q_tile=q_tile)
-            return vals[:Q], idx[:Q]
-        return bm25_dense_topk_pallas(qw, impact, mask, k=k, tile=tile,
-                                      q_tile=q_tile)
+        # this dispatcher runs EAGERLY, so a Mosaic lowering/compile
+        # failure (first real-TPU run of the early-exit selection) is
+        # catchable here — fall through to the XLA path with a warning
+        # instead of failing the batch
+        try:
+            if qpad != Q:
+                qp = jnp.concatenate(
+                    [qw, jnp.zeros((qpad - Q, F), qw.dtype)], axis=0)
+                vals, idx = bm25_dense_topk_pallas(qp, impact, mask, k=k,
+                                                   tile=tile, q_tile=q_tile)
+                return vals[:Q], idx[:Q]
+            return bm25_dense_topk_pallas(qw, impact, mask, k=k, tile=tile,
+                                          q_tile=q_tile)
+        except Exception as e:
+            import warnings
+
+            from elasticsearch_tpu.monitor import kernels
+
+            kernels.record("bm25_pallas_failed")
+            warnings.warn(f"fused BM25 kernel failed ({type(e).__name__}: "
+                          f"{str(e)[:200]}); serving via the XLA path")
     from elasticsearch_tpu.ops.scoring import (impact_precision, topk_auto,
                                                topk_block_config)
 

@@ -348,11 +348,24 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
     out_v, out_i, out_t = [], [], []
     for q0 in range(0, Q, chunk_q):
         q1 = min(q0 + chunk_q, Q)
-        vals, ids, tot = batch_fn(
-            impact, jnp.asarray(qw[q0:q1]), inv.doc_ids, inv.tfnorm,
-            jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
-            jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk,
-            topk_block=blk, prec=_prec)
+        try:
+            vals, ids, tot = batch_fn(
+                impact, jnp.asarray(qw[q0:q1]), inv.doc_ids, inv.tfnorm,
+                jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
+                jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk,
+                topk_block=blk, prec=_prec)
+        except Exception:
+            if batch_fn is bm25_hybrid_topk_batch:
+                raise
+            # candidates-form insurance (first real-TPU run): fall back
+            # to the scatter form for this and remaining chunks
+            kernels.record("tail_scatter_free_failed")
+            batch_fn = bm25_hybrid_topk_batch
+            vals, ids, tot = batch_fn(
+                impact, jnp.asarray(qw[q0:q1]), inv.doc_ids, inv.tfnorm,
+                jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
+                jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk,
+                topk_block=blk, prec=_prec)
         out_v.append(np.asarray(vals))
         out_i.append(np.asarray(ids))
         out_t.append(np.asarray(tot))
