@@ -67,6 +67,17 @@ class IndexService:
         self.closed = False
         self._percolator = None
         self._mesh_executor = None
+        # shard query cache (reference: indices/cache/query/
+        # IndicesQueryCache.java — opt-in via index.cache.query.enable,
+        # size==0 requests only, keyed by reader identity + request body;
+        # our "reader version" is the per-shard write/refresh counters,
+        # which also capture instantly-visible deletes)
+        from collections import OrderedDict as _OD
+        import threading as _th
+
+        self._query_cache: "_OD[tuple, dict]" = _OD()
+        self._qc_lock = _th.Lock()  # ThreadingHTTPServer: searches race
+        self.query_cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
         self.warmers: Dict[str, dict] = {}
         if data_path:
             # gateway recovery (reference: gateway/GatewayService +
@@ -459,6 +470,53 @@ class IndexService:
                 return got.get("_source")
         return None
 
+    _QUERY_CACHE_CAP = 256
+
+    def _query_cache_enabled(self) -> bool:
+        idx = self.settings.get("index", self.settings)
+        v = idx.get("cache.query.enable",
+                    idx.get("index.cache.query.enable"))
+        if v is None and isinstance(idx.get("cache"), dict):
+            v = idx["cache"].get("query", {}).get("enable")
+        return str(v).lower() in ("1", "true")
+
+    def _query_cache_key(self, body: dict):
+        """Cache key when this request is cacheable, else None (reference:
+        IndicesQueryCache.canCache — size==0 only, no dfs, no scroll, no
+        now-relative date math, enabled by setting or request override)."""
+        import json as _json
+
+        override = body.get("_query_cache")
+        if override is False:
+            return None
+        if override is None and not self._query_cache_enabled():
+            return None
+        if int(body.get("size", 10)) != 0 or body.get("scroll"):
+            return None
+        if body.get("search_type") in ("dfs_query_then_fetch", "scan"):
+            return None
+        try:
+            blob = _json.dumps({k: v for k, v in body.items()
+                                if k != "_query_cache"}, sort_keys=True)
+        except TypeError:
+            return None  # unserializable body: not cacheable
+        import re as _re
+
+        # now-relative date math ("now", "now-1d", "now/d") is
+        # non-deterministic; plain words like "nowhere" must still cache
+        if _re.search(r'"now(?:["+/\-]|\\)', blob, _re.IGNORECASE):
+            return None
+        gen = tuple((g.primary.engine.stats.index_total,
+                     g.primary.engine.stats.delete_total,
+                     g.primary.engine.stats.refresh_total)
+                    for g in self.groups)
+        return (gen, blob)
+
+    def clear_query_cache(self) -> None:
+        """POST /_cache/clear drops entries (counters keep their history)."""
+        with self._qc_lock:
+            self._query_cache.clear()
+
     def search(self, body: dict, dfs: bool = False,
                preference: Optional[str] = None) -> dict:
         from elasticsearch_tpu.cluster.metadata import check_open
@@ -466,6 +524,21 @@ class IndexService:
 
         check_open(self, op="read")
         body = body or {}
+        qc_key = None if dfs else self._query_cache_key(body)
+        if qc_key is not None:
+            import copy as _copy
+
+            with self._qc_lock:
+                hit = self._query_cache.get(qc_key)
+                if hit is not None:
+                    self._query_cache.move_to_end(qc_key)
+                    self.query_cache_stats["hits"] += 1
+                else:
+                    self.query_cache_stats["misses"] += 1
+            if hit is not None:
+                return _copy.deepcopy(hit)
+        if "_query_cache" in body:
+            body = {k: v for k, v in body.items() if k != "_query_cache"}
         if body.get("query"):
             # MLT liked ids resolve ONCE against the whole index before
             # the per-shard fan-out (queries.rewrite_mlt_in_body)
@@ -492,6 +565,15 @@ class IndexService:
             )
         if body.get("suggest"):
             resp["suggest"] = self.suggest(body["suggest"])
+        if qc_key is not None:
+            import copy as _copy
+
+            entry = _copy.deepcopy(resp)
+            with self._qc_lock:
+                self._query_cache[qc_key] = entry
+                if len(self._query_cache) > self._QUERY_CACHE_CAP:
+                    self._query_cache.popitem(last=False)
+                    self.query_cache_stats["evictions"] += 1
         return resp
 
     def suggest(self, body: dict, shard_ids=None) -> dict:

@@ -289,6 +289,11 @@ def bm25_dense_tiles_for(Q: int, F: int, D: int):
     return 0, 0
 
 
+# sticky failure latch for the fused BM25 kernel (list so the traced-free
+# eager dispatcher can flip it in place)
+_BM25_PALLAS_BROKEN = [False]
+
+
 def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
     """Dispatch: fused Pallas kernel on TPU when static shape gates hold,
     XLA hybrid matmul + topk_batch otherwise (same gate discipline as
@@ -308,8 +313,8 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
     # VPU-bound at k passes per tile; XLA's chunked matmul+top_k rides the
     # MXU + its tuned sort). Read eagerly here, like the other knobs.
     pref = os.environ.get("ESTPU_BM25_BATCH_KERNEL", "auto").lower()
-    gates_ok = (_on_tpu() and k <= 64 and F % 8 == 0
-                and q_tile and D >= 2 * tile)
+    gates_ok = (not _BM25_PALLAS_BROKEN[0] and _on_tpu() and k <= 64
+                and F % 8 == 0 and q_tile and D >= 2 * tile)
     if pref == "pallas" and not gates_ok:
         # a forced-pallas A/B must never SILENTLY measure the XLA side
         import warnings
@@ -337,9 +342,13 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
 
             from elasticsearch_tpu.monitor import kernels
 
+            # sticky: a deterministic Mosaic lowering failure must not
+            # pay a fresh trace/compile attempt on every batch
+            _BM25_PALLAS_BROKEN[0] = True
             kernels.record("bm25_pallas_failed")
             warnings.warn(f"fused BM25 kernel failed ({type(e).__name__}: "
-                          f"{str(e)[:200]}); serving via the XLA path")
+                          f"{str(e)[:200]}); serving via the XLA path "
+                          f"from now on")
     from elasticsearch_tpu.ops.scoring import (impact_precision, topk_auto,
                                                topk_block_config)
 

@@ -827,7 +827,8 @@ _STATS_SECTIONS = {
     "suggest": {"total": 0, "time_in_millis": 0, "current": 0},
     "recovery": {"current_as_source": 0, "current_as_target": 0,
                  "throttle_time_in_millis": 0},
-    "query_cache": {"memory_size_in_bytes": 0, "evictions": 0},
+    "query_cache": {"memory_size_in_bytes": 0, "evictions": 0,
+                    "hit_count": 0, "miss_count": 0},
 }
 
 
@@ -899,6 +900,11 @@ def _stats_envelope(n: Node, names, metric: Optional[str] = None,
                 full["commit"] = sh["commit"]
             shard_stats[sid] = full
         total = _full_sections(_sum_stats(raw.get("shards", {}).values()))
+        qc = getattr(n.indices[nm], "query_cache_stats", None)
+        if qc:  # shard query cache lives at the index level here
+            total["query_cache"].update(
+                hit_count=qc["hits"], miss_count=qc["misses"],
+                evictions=qc["evictions"])
         per[nm] = total
         shards_per[nm] = shard_stats
     keep = None
@@ -2269,6 +2275,10 @@ def _search_body(p, b) -> dict:
         body["scroll"] = p["scroll"]
     if "search_type" in p:
         body["search_type"] = p["search_type"]
+    if "query_cache" in p:
+        # per-request shard query-cache override (reference:
+        # ShardSearchRequest.queryCache beats the index setting)
+        body["_query_cache"] = p["query_cache"].lower() in ("", "1", "true")
     if "_source" in p:
         v = p["_source"]
         if v == "":  # bare ?_source flag = true
@@ -3622,6 +3632,7 @@ def _clear_cache(n: Node, p, b, index: Optional[str] = None):
     for iname in names:
         svc = n.indices[iname]
         total += svc.num_shards
+        svc.clear_query_cache()  # shard query cache is part of the contract
         for shard in svc.shards:
             for seg in shard.segments:
                 for attr in ("_bigram_cache", "_completion_cache"):

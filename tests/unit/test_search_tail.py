@@ -170,3 +170,70 @@ def test_termvectors_statistics(node):
                                  "offsets": "false"}, b"", "a", "2")
     assert "field_statistics" not in r2["term_vectors"]["body"]
     assert "start_offset" not in r2["term_vectors"]["body"]["terms"]["the"]["tokens"][0]
+
+
+def test_shard_query_cache_semantics():
+    """Shard query cache (reference: indices/cache/query/
+    IndicesQueryCache.java): opt-in via index.cache.query.enable, only
+    size==0 requests cache, ANY write invalidates (our deletes are
+    eagerly visible, so write counters key the cache, not just refresh),
+    and the per-request override beats the setting."""
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    n.create_index("qc", {"settings": {"index": {"cache.query.enable": True}},
+                          "mappings": {"properties": {"t": {"type": "text"}}}})
+    svc = n.indices["qc"]
+    for i in range(8):
+        svc.index_doc(str(i), {"t": f"word{i % 2} common"})
+    svc.refresh()
+    body = {"query": {"match": {"t": "common"}}, "size": 0}
+    r1 = svc.search(dict(body))
+    assert svc.query_cache_stats == {"hits": 0, "misses": 1, "evictions": 0}
+    r2 = svc.search(dict(body))
+    assert svc.query_cache_stats["hits"] == 1
+    assert r2["hits"]["total"] == r1["hits"]["total"] == 8
+    # size>0 requests never cache
+    svc.search({"query": {"match": {"t": "common"}}, "size": 5})
+    assert svc.query_cache_stats["misses"] == 1
+    # a write invalidates (generation key changes) even before refresh —
+    # the re-executed query still sees 8 (additions buffer until refresh)
+    svc.index_doc("9", {"t": "common"})
+    r3 = svc.search(dict(body))
+    assert r3["hits"]["total"] == 8
+    assert svc.query_cache_stats["misses"] == 2
+    svc.refresh()
+    r3b = svc.search(dict(body))
+    assert r3b["hits"]["total"] == 9  # fresh result, not the stale cache
+    # delete invalidates too (eager visibility)
+    svc.delete_doc("9")
+    r4 = svc.search(dict(body))
+    assert r4["hits"]["total"] == 8
+    # request override disables caching on a cache-enabled index
+    svc.search(dict(body, _query_cache=False))
+    before = dict(svc.query_cache_stats)
+    svc.search(dict(body, _query_cache=False))
+    assert svc.query_cache_stats == before  # neither hit nor miss ticked
+    # ...and enables it on a disabled index
+    n.create_index("qc2", {"mappings": {"properties": {"t": {"type": "text"}}}})
+    s2 = n.indices["qc2"]
+    s2.index_doc("1", {"t": "x"})
+    s2.refresh()
+    s2.search({"query": {"match_all": {}}, "size": 0, "_query_cache": True})
+    s2.search({"query": {"match_all": {}}, "size": 0, "_query_cache": True})
+    assert s2.query_cache_stats["hits"] == 1
+    # now-relative date math is never cached
+    svc.search({"query": {"range": {"t": {"gte": "now-1d"}}}, "size": 0})
+    after = svc.query_cache_stats["misses"]
+    svc.search({"query": {"range": {"t": {"gte": "now-1d"}}}, "size": 0})
+    assert svc.query_cache_stats["misses"] == after  # skipped, not missed
+    # ...but a plain word starting with "now" still caches
+    svc.search({"query": {"match": {"t": "nowhere"}}, "size": 0})
+    svc.search({"query": {"match": {"t": "nowhere"}}, "size": 0})
+    assert svc.query_cache_stats["misses"] == after + 1  # one miss, one hit
+    # POST /_cache/clear contract: entries drop, next search re-executes
+    h_before = svc.query_cache_stats["hits"]
+    svc.clear_query_cache()
+    svc.search({"query": {"match": {"t": "nowhere"}}, "size": 0})
+    assert svc.query_cache_stats["misses"] == after + 2
+    assert svc.query_cache_stats["hits"] == h_before
