@@ -170,19 +170,24 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
     """
     jax = _jax()
 
+    from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
     nprobe = index.nprobe_for(num_candidates)
-    key = (index.C, index.Lmax, D, nprobe, metric, index.metric)
+    sf = tail_mode_batch()
+    key = (index.C, index.Lmax, D, nprobe, metric, index.metric, sf)
     prog = _PROGRAMS.get(key)
     if prog is None:
         prog = make_ivf_search(index.C, index.Lmax, D, nprobe, metric,
-                               quantizer_metric=index.metric)
+                               quantizer_metric=index.metric,
+                               scatter_free=sf)
         _PROGRAMS[key] = prog
     q = jax.device_put(np.asarray(query_np, np.float32))
     return prog(q, index.centroids, index.lists, vecs)
 
 
 def make_ivf_search(C: int, Lmax: int, D: int, nprobe: int, metric: str,
-                    quantizer_metric: str = "cosine"):
+                    quantizer_metric: str = "cosine",
+                    scatter_free: bool = False):
     """Compiled IVF probe+score program for one shape class."""
     jax = _jax()
     import jax.numpy as jnp
@@ -208,11 +213,26 @@ def make_ivf_search(C: int, Lmax: int, D: int, nprobe: int, metric: str,
         # path's bf16 trade-off buys nothing on a matmul this size)
         cscores = knn_scores(query[None, :], cvecs, metric=metric,
                              use_bf16=False)[0]
-        # 4. scatter into the whole-segment score vector
-        scores = jnp.full(D, -jnp.inf, jnp.float32)
-        scores = scores.at[cand].max(
-            jnp.where(valid, cscores, -jnp.inf), mode="drop")
-        mask = jnp.zeros(D, bool).at[cand].max(valid, mode="drop")
+        # 4. expand to the whole-segment score vector
+        if scatter_free:
+            # each vector belongs to exactly ONE list, so candidate ids
+            # are unique: sort (cand, score) by id and gather each doc's
+            # single entry via boundary search — no serialized TPU
+            # scatter (padding sorts past every real doc)
+            sc, ss = lax.sort((cand, jnp.where(valid, cscores, -jnp.inf)),
+                              num_keys=1)
+            bounds = jnp.searchsorted(sc, jnp.arange(D + 1,
+                                                     dtype=sc.dtype))
+            lo, n = bounds[:-1], bounds[1:] - bounds[:-1]
+            W = sc.shape[0]
+            scores = jnp.where(n > 0,
+                               ss[jnp.clip(lo, 0, W - 1)], -jnp.inf)
+            mask = n > 0
+        else:
+            scores = jnp.full(D, -jnp.inf, jnp.float32)
+            scores = scores.at[cand].max(
+                jnp.where(valid, cscores, -jnp.inf), mode="drop")
+            mask = jnp.zeros(D, bool).at[cand].max(valid, mode="drop")
         return scores, mask
 
     return run

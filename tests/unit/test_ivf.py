@@ -289,3 +289,34 @@ def test_ivf_cache_corrupt_disk_blob_is_a_miss(tmp_path):
     # the rebuild re-persisted a good blob over the corrupt one
     assert all(not os.path.exists(str(p) + ".tmp") for p in blobs)
     n2.close()
+
+
+def test_ivf_scatter_free_matches_scatter():
+    """make_ivf_search(scatter_free=True) == the scatter form exactly
+    (candidate ids are unique: one list per vector)."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.ivf import build_ivf, make_ivf_search
+
+    rng = np.random.default_rng(5)
+    D, n, dims, C = 1024, 700, 16, 32
+    vecs_np = rng.standard_normal((D, dims)).astype(np.float32)
+    exists = np.zeros(D, bool)
+    exists[:n] = True
+    idx = build_ivf(vecs_np, exists, D, C=C)
+    vecs = jnp.asarray(vecs_np)
+    q = jnp.asarray(rng.standard_normal(dims).astype(np.float32))
+    for nprobe in (2, 8):
+        a = make_ivf_search(idx.C, idx.Lmax, D, nprobe, "cosine",
+                            quantizer_metric=idx.metric,
+                            scatter_free=False)(
+            q, idx.centroids, idx.lists, vecs)
+        b = make_ivf_search(idx.C, idx.Lmax, D, nprobe, "cosine",
+                            quantizer_metric=idx.metric,
+                            scatter_free=True)(
+            q, idx.centroids, idx.lists, vecs)
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+        sa, sb = np.asarray(a[0]), np.asarray(b[0])
+        m = np.asarray(a[1])
+        np.testing.assert_allclose(sa[m], sb[m], rtol=1e-6)
+        assert np.all(np.isneginf(sb[~m]))
