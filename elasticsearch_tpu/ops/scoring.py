@@ -775,7 +775,24 @@ def bucket_sum(values, bucket_ids, weights, *, num_buckets: int):
     return out.at[bucket_ids].add(contrib, mode="drop")
 
 
-@partial(jax.jit, static_argnames=("num_buckets",))
-def bucket_count(bucket_ids, weights, *, num_buckets: int):
+@partial(jax.jit, static_argnames=("num_buckets", "scatter_free"))
+def _bucket_count_jit(bucket_ids, weights, *, num_buckets: int,
+                      scatter_free: bool):
+    if scatter_free:
+        # weights are 0/1 selection masks at every call site, so counting
+        # = histogram of the selected ids: sort + boundary diffs (the
+        # len(ids)-element scatter serializes on TPU)
+        ids = jnp.where(weights > 0, bucket_ids, num_buckets)
+        sids = jnp.sort(ids)
+        bounds = jnp.searchsorted(
+            sids, jnp.arange(num_buckets + 1, dtype=sids.dtype))
+        return (bounds[1:] - bounds[:-1]).astype(jnp.float32)
     out = jnp.zeros(num_buckets, dtype=jnp.float32)
     return out.at[bucket_ids].add(weights, mode="drop")
+
+
+def bucket_count(bucket_ids, weights, *, num_buckets: int):
+    """Selected-id histogram (weights MUST be a 0/1 mask). Eager wrapper:
+    reads the platform scatter-free switch outside jit."""
+    return _bucket_count_jit(bucket_ids, weights, num_buckets=num_buckets,
+                             scatter_free=tail_mode_batch())

@@ -227,10 +227,29 @@ class CardinalityAggregator(Aggregator):
             jnp.int32(32),
         )
         rank = jnp.clip(lz + 1, 1, 32 - HLL_BITS + 1)
-        regs = jnp.zeros(HLL_M, dtype=jnp.int32)
-        regs = regs.at[jnp.where(sel, reg, HLL_M)].max(
-            jnp.where(sel, rank, 0), mode="drop"
-        )
+        from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
+        if tail_mode_batch():
+            import jax.lax
+
+            # scatter-free register max (TPU: the [D]→[m] scatter-max
+            # serializes): sort (register, rank) with rank as the
+            # SECONDARY key — each register run's END holds its max —
+            # then one boundary search + gather per register
+            r_sorted, k_sorted = jax.lax.sort(
+                (jnp.where(sel, reg, HLL_M), jnp.where(sel, rank, 0)),
+                num_keys=2)
+            bounds = jnp.searchsorted(
+                r_sorted, jnp.arange(HLL_M + 1, dtype=r_sorted.dtype))
+            hi, n = bounds[1:], bounds[1:] - bounds[:-1]
+            W = r_sorted.shape[0]
+            regs = jnp.where(
+                n > 0, k_sorted[jnp.clip(hi - 1, 0, W - 1)], 0)
+        else:
+            regs = jnp.zeros(HLL_M, dtype=jnp.int32)
+            regs = regs.at[jnp.where(sel, reg, HLL_M)].max(
+                jnp.where(sel, rank, 0), mode="drop"
+            )
         return np.asarray(regs)
 
     def reduce(self, partials):
