@@ -70,10 +70,35 @@ class NestedQuery(Query):
             return _empty(ctx)
         sel, child_scores = self.child_selection(ctx)
         D = ctx.D
-        # scatter-join up to the enclosing level (root by default);
-        # non-selected docs route to drop row D
+        # join up to the enclosing level (root by default); non-selected
+        # docs route to drop row D
         target = self._join_target(ctx)
         tgt = jnp.where(sel & (target >= 0), target, D)
+        from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
+        if tail_mode_batch() and self.score_mode in ("none", "max", "min"):
+            # scatter-free rollup (TPU: scatter serializes per slot): sort
+            # (parent, score) with score as the SECOND key — each parent
+            # run's END holds its max and its START the min — then one
+            # boundary search. Exact. sum/avg keep the scatter form: a
+            # cumsum-difference over [D] would drift in f32.
+            from jax import lax as _lax
+
+            st, sv = _lax.sort(
+                (tgt, jnp.where(sel, child_scores, 0.0)), num_keys=2)
+            bounds = jnp.searchsorted(st, jnp.arange(D + 1, dtype=st.dtype))
+            lo, hi = bounds[:-1], bounds[1:]
+            counts = (hi - lo).astype(jnp.float32)
+            parent_mask = hi > lo
+            if self.score_mode == "none":
+                return None, parent_mask
+            W = st.shape[0]
+            if self.score_mode == "max":
+                s = sv[jnp.clip(hi - 1, 0, W - 1)]
+            else:
+                s = sv[jnp.clip(lo, 0, W - 1)]
+            s = jnp.where(parent_mask, s, 0.0) * self.boost
+            return s, parent_mask
         selF = sel.astype(jnp.float32)
         counts = jnp.zeros(D + 1, dtype=jnp.float32).at[tgt].add(selF)[:D]
         parent_mask = counts > 0
