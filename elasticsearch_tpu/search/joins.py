@@ -84,14 +84,17 @@ class NestedQuery(Query):
             # cumsum-difference over [D] would drift in f32.
             from jax import lax as _lax
 
+            if self.score_mode == "none":
+                # counts/mask only: single-key sort (no score payload)
+                st = jnp.sort(tgt)
+                bounds = jnp.searchsorted(st,
+                                          jnp.arange(D + 1, dtype=st.dtype))
+                return None, bounds[1:] > bounds[:-1]
             st, sv = _lax.sort(
                 (tgt, jnp.where(sel, child_scores, 0.0)), num_keys=2)
             bounds = jnp.searchsorted(st, jnp.arange(D + 1, dtype=st.dtype))
             lo, hi = bounds[:-1], bounds[1:]
-            counts = (hi - lo).astype(jnp.float32)
             parent_mask = hi > lo
-            if self.score_mode == "none":
-                return None, parent_mask
             W = st.shape[0]
             if self.score_mode == "max":
                 s = sv[jnp.clip(hi - 1, 0, W - 1)]
