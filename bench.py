@@ -548,7 +548,10 @@ def main():
     # compile for minutes on a cold compilation cache (Q=2048 batch jit)
     compile_heavy = ("batched-msearch", "batched-msearch-mixed",
                      "batched-msearch-bf16", "batched-msearch-xla-ab",
-                     "knn-batched-mfu")
+                     "knn-batched-mfu",
+                     # the 1M-vec IVF build (kmeans at freeze) runs
+                     # minutes un-beaten on the CPU-sanity path
+                     "ivf-recall-curve")
 
     def _stall_watchdog():
         while True:

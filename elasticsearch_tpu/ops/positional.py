@@ -60,12 +60,40 @@ def _steps(n: int) -> int:
     return max(int(np.ceil(np.log2(max(n, 2)))) + 1, 1)
 
 
-@partial(jax.jit, static_argnames=("slop", "D", "ordered", "unordered"))
+def _freq_segmented(anchor_doc, match, w, *, D: int):
+    """Scatter-free anchor→doc frequency rollup: sort (doc, w) pairs,
+    segmented inclusive scan over the CONTIGUOUS equal-doc runs (the
+    same-doc-at-distance-s guard is exact precisely because runs are
+    contiguous after the sort), then one boundary search — run ends hold
+    each doc's total. Replaces the A-element scatter-add, which XLA
+    serializes per slot on TPU. Reassociates the per-run f32 sums in
+    tree order (the scatter's accumulation order is unspecified too)."""
+    from jax import lax
+
+    A = anchor_doc.shape[0]
+    dkey = jnp.where(match, anchor_doc, D)
+    ds, tot = lax.sort((dkey, jnp.where(match, w, 0.0)), num_keys=1)
+    s = 1
+    while s < A:
+        same = jnp.concatenate([jnp.zeros((s,), bool), ds[s:] == ds[:-s]])
+        tot = tot + jnp.where(
+            same, jnp.concatenate([jnp.zeros((s,), tot.dtype), tot[:-s]]),
+            0.0)
+        s *= 2
+    bounds = jnp.searchsorted(ds, jnp.arange(D + 1, dtype=ds.dtype))
+    hi = bounds[1:]
+    n = hi - bounds[:-1]
+    return jnp.where(n > 0, tot[jnp.clip(hi - 1, 0, A - 1)], 0.0)
+
+
+@partial(jax.jit, static_argnames=("slop", "D", "ordered", "unordered",
+                                   "scatter_free"))
 def phrase_freq_program(anchor_doc, anchor_pos, anchor_valid,
                         doc_runs, run_starts, run_lens, deltas,
                         positions, pos_offsets, *,
                         slop: int, D: int, ordered: bool = False,
-                        unordered: bool = False):
+                        unordered: bool = False,
+                        scatter_free: bool = False):
     """Phrase / ordered-near / unordered-near frequency vector f32[D].
 
     anchor_doc/pos/valid: [A] anchor positional entries (term 0).
@@ -119,6 +147,8 @@ def phrase_freq_program(anchor_doc, anchor_pos, anchor_valid,
         w = jnp.where(match,
                       1.0 / (1.0 + jnp.maximum(mlen, 0).astype(jnp.float32)),
                       0.0)
+        if scatter_free:
+            return _freq_segmented(anchor_doc, match, w, D=D)
         freq = jnp.zeros(D, jnp.float32).at[anchor_doc].add(
             jnp.where(match, w, 0.0), mode="drop")
         return freq
@@ -190,6 +220,8 @@ def phrase_freq_program(anchor_doc, anchor_pos, anchor_valid,
         match = match & (mlen <= slop)
         w = jnp.where(match, 1.0 / (1.0 + jnp.maximum(mlen, 0).astype(jnp.float32)), 0.0)
 
+    if scatter_free:
+        return _freq_segmented(anchor_doc, match, w, D=D)
     freq = jnp.zeros(D, jnp.float32).at[anchor_doc].add(
         jnp.where(match, w, 0.0), mode="drop")
     return freq
@@ -204,10 +236,11 @@ def phrase_score(freq, lengths, avg_len, idf_sum, *, D: int,
     return jnp.where(freq > 0, idf_sum * tfn, 0.0)
 
 
-@partial(jax.jit, static_argnames=("D",))
+@partial(jax.jit, static_argnames=("D", "scatter_free"))
 def span_not_program(anchor_doc, anchor_pos, anchor_valid,
                      doc_runs, run_starts, run_lens,
-                     positions, pos_offsets, pre, post, *, D: int):
+                     positions, pos_offsets, pre, post, *, D: int,
+                     scatter_free: bool = False):
     """Surviving-include-anchor count f32[D] for span_not: an include span
     at position p survives when NO exclude-term position lies inside
     [p - pre, p + post] (unit-width exclude spans overlap the padded
@@ -232,6 +265,9 @@ def span_not_program(anchor_doc, anchor_pos, anchor_valid,
         has = (found & (idx < hi)
                & (positions[jnp.clip(idx, 0, npos - 1)] <= anchor_pos + post))
         alive = alive & ~has
+    if scatter_free:
+        return _freq_segmented(anchor_doc, alive,
+                               jnp.ones_like(anchor_pos, jnp.float32), D=D)
     return jnp.zeros(D, jnp.float32).at[anchor_doc].add(
         jnp.where(alive, 1.0, 0.0), mode="drop")
 

@@ -988,7 +988,8 @@ class EPhrase(Emit):
          lengths, stats) = env[self.prim]
         freq = phrase_freq_program(adoc, apos, aval, runs, rstart, rlen,
                                    delta, pos, offs, slop=self.slop,
-                                   D=self.D)
+                                   D=self.D,
+                                   scatter_free=_scatter_free(meta))
         mask = freq > 0
         scores = phrase_score(freq, lengths, stats[0], stats[1],
                               D=self.D) * self.boost

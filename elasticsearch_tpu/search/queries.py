@@ -756,7 +756,10 @@ class MatchPhraseQuery(Query):
         inputs = build_phrase_inputs(inv, toks, ctx.D)
         if inputs is None:
             return _empty(ctx)
-        freq = phrase_freq_program(*inputs, slop=int(self.slop), D=ctx.D)
+        from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
+        freq = phrase_freq_program(*inputs, slop=int(self.slop), D=ctx.D,
+                                   scatter_free=tail_mode_batch())
         mask = freq > 0
         idf_sum = sum(ctx.idf(self.field, t)
                       for t in dict.fromkeys(t for t, _ in toks))

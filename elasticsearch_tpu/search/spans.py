@@ -466,7 +466,10 @@ class SpanQueryWrapper(Query):
                                      ctx.D)
         if inputs is None:
             return None, jnp.zeros(ctx.D, dtype=bool)
+        from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
         freq = phrase_freq_program(*inputs, slop=int(node.slop), D=ctx.D,
+                                   scatter_free=tail_mode_batch(),
                                    ordered=node.in_order,
                                    unordered=not node.in_order)
         mask = freq > 0
@@ -499,8 +502,11 @@ class SpanQueryWrapper(Query):
         inputs = build_union_anchor_inputs(inv, inc_terms, exc_terms, ctx.D)
         if inputs is None:
             return None, jnp.zeros(ctx.D, dtype=bool)
+        from elasticsearch_tpu.ops.scoring import tail_mode_batch as _tmb
+
         freq = span_not_program(*inputs, jnp.int32(node.pre),
-                                jnp.int32(node.post), D=ctx.D)
+                                jnp.int32(node.post), D=ctx.D,
+                                scatter_free=_tmb())
         return self._score_leaves(ctx, freq > 0)
 
 
