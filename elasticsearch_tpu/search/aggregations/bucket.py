@@ -749,9 +749,18 @@ class ReverseNestedAggregator(Aggregator):
             target = seg.root_id_dev
         child_sel = mask & (seg.parent_id_dev >= 0) & (target >= 0)
         tgt = jnp.where(child_sel, target, D)
-        counts = jnp.zeros(D + 1, dtype=jnp.float32).at[tgt].add(
-            child_sel.astype(jnp.float32))[:D]
-        parent_mask = (counts > 0) & seg.live
+        from elasticsearch_tpu.ops.scoring import tail_mode_batch
+
+        if tail_mode_batch():
+            # scatter-free membership: sorted targets + boundary diffs
+            # (the [D]-element scatter serializes on TPU)
+            st = jnp.sort(tgt)
+            bounds = jnp.searchsorted(st, jnp.arange(D + 1, dtype=st.dtype))
+            parent_mask = (bounds[1:] > bounds[:-1]) & seg.live
+        else:
+            counts = jnp.zeros(D + 1, dtype=jnp.float32).at[tgt].add(
+                child_sel.astype(jnp.float32))[:D]
+            parent_mask = (counts > 0) & seg.live
         out = {"doc_count": jnp.sum(parent_mask.astype(jnp.int32))}
         if self.subs:
             out["subs"] = self.collect_subs(ctx, parent_mask)

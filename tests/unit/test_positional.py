@@ -171,3 +171,31 @@ def test_phrase_prefix_still_works(node):
     got = search_scores(node, {"query": {"match_phrase_prefix": {"t": "quick bro"}},
                               "size": 10})
     assert "1" in got
+
+
+def test_freq_segmented_matches_scatter_high_multiplicity():
+    """_freq_segmented == scatter-add freq under heavy per-doc anchor
+    multiplicity (tf up to 64), unsorted anchor order, and padding."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.positional import _freq_segmented
+
+    rng = np.random.default_rng(13)
+    D, A = 256, 2048
+    docs = rng.integers(0, 40, A).astype(np.int32)  # heavy duplication
+    w = (rng.random(A) * 2).astype(np.float32)
+    match = rng.random(A) > 0.3
+    got = np.asarray(_freq_segmented(
+        jnp.asarray(docs), jnp.asarray(match), jnp.asarray(w), D=D))
+    want = np.zeros(D, np.float32)
+    np.add.at(want, docs[match], w[match])
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+    # all-masked and single-doc edge cases
+    got0 = np.asarray(_freq_segmented(
+        jnp.asarray(docs), jnp.zeros(A, bool), jnp.asarray(w), D=D))
+    assert not got0.any()
+    one = np.full(A, 7, np.int32)
+    got1 = np.asarray(_freq_segmented(
+        jnp.asarray(one), jnp.ones(A, bool), jnp.asarray(w), D=D))
+    np.testing.assert_allclose(got1[7], w.sum(), rtol=2e-5)
+    assert not np.delete(got1, 7).any()
