@@ -51,13 +51,16 @@ print(_j.dumps({"p50_ms": float(np.percentile(np.array(times) * 1000, 50)),
 """
 
 CONFIGS = [
-    ("prec_default", {"ESTPU_IMPACT_PRECISION": "default"}),
-    ("prec_high", {"ESTPU_IMPACT_PRECISION": "high"}),
-    ("fast_combo", {"ESTPU_IMPACT_PRECISION": "default", "ESTPU_BLOCKED_TOPK": "1", "ESTPU_IMPACT_BF16": "1"}),
-    ("default", {}),
-    ("blocked_topk", {"ESTPU_BLOCKED_TOPK": "1"}),
-    ("bf16_impact", {"ESTPU_IMPACT_BF16": "1"}),
-    ("blocked+bf16", {"ESTPU_BLOCKED_TOPK": "1", "ESTPU_IMPACT_BF16": "1"}),
+    # r5: the tail/scatter strategy is the big lever — A/B it first
+    ("default(auto)", {}),
+    ("tail_candidates", {"ESTPU_TAIL_MODE": "candidates"}),
+    ("tail_scatter", {"ESTPU_TAIL_MODE": "scatter"}),
+    ("cand+flat_topk", {"ESTPU_TAIL_MODE": "candidates",
+                        "ESTPU_BLOCKED_TOPK": "0"}),
+    ("scatter+blocked", {"ESTPU_TAIL_MODE": "scatter",
+                         "ESTPU_BLOCKED_TOPK": "1"}),
+    ("cand+bf16", {"ESTPU_TAIL_MODE": "candidates",
+                   "ESTPU_IMPACT_BF16": "1"}),
 ]
 for name, extra in CONFIGS:
     env = dict(os.environ)
