@@ -246,3 +246,44 @@ def test_terms_order_by_subagg():
     mask = jnp.arange(ctx.D) < ctx.segment.num_docs
     out = reduce_aggs(aggs, [run_aggs(aggs, ctx, mask)])
     assert [b["key"] for b in out["t"]["buckets"]] == ["b", "c", "a"]
+
+
+def test_scatter_free_failure_falls_back_to_scatter(monkeypatch):
+    """The executor's insurance: when the candidate-set program fails
+    (first real-TPU run risk), the search re-executes on the scatter
+    form, the gauge ticks, and same-shape queries go straight to the
+    rebuilt program."""
+    import elasticsearch_tpu.ops.scoring as S
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.node import Node
+
+    monkeypatch.setenv("ESTPU_TAIL_MODE", "candidates")
+    boom = {"count": 0}
+    real = S.bm25_hybrid_candidates_topk
+
+    def exploding(*a, **kw):
+        boom["count"] += 1
+        raise RuntimeError("simulated backend failure")
+
+    monkeypatch.setattr(S, "bm25_hybrid_candidates_topk", exploding)
+    n = Node()
+    n.create_index("ins", {"mappings": {"properties": {
+        "t": {"type": "text"}}}})
+    svc = n.indices["ins"]
+    # enough docs that "common" crosses the dense-impact df threshold
+    # (max(128, D/256)) — the candidates fast path needs a hybrid group
+    for i in range(300):
+        svc.index_doc(str(i), {"t": f"common word{i % 5}"})
+    svc.refresh()
+    assert svc.shards[0].segments[0].inverted["t"].dense_block() is not None
+    kernels.reset()
+    r = n.search("ins", {"query": {"match": {"t": "common"}}})
+    assert r["hits"]["total"] == 300  # served via the scatter fallback
+    assert boom["count"] >= 1
+    snap = kernels.snapshot()
+    assert snap.get("tail_scatter_free_failed", 0) >= 1
+    # same shape again: no new explosion (the rebuilt program is cached)
+    before = boom["count"]
+    r2 = n.search("ins", {"query": {"match": {"t": "common"}}})
+    assert r2["hits"]["total"] == 300 and boom["count"] == before
+    monkeypatch.setattr(S, "bm25_hybrid_candidates_topk", real)
