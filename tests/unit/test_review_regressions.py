@@ -259,7 +259,6 @@ def test_scatter_free_failure_falls_back_to_scatter(monkeypatch):
 
     monkeypatch.setenv("ESTPU_TAIL_MODE", "candidates")
     boom = {"count": 0}
-    real = S.bm25_hybrid_candidates_topk
 
     def exploding(*a, **kw):
         boom["count"] += 1
@@ -286,4 +285,3 @@ def test_scatter_free_failure_falls_back_to_scatter(monkeypatch):
     before = boom["count"]
     r2 = n.search("ins", {"query": {"match": {"t": "common"}}})
     assert r2["hits"]["total"] == 300 and boom["count"] == before
-    monkeypatch.setattr(S, "bm25_hybrid_candidates_topk", real)
