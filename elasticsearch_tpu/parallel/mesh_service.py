@@ -84,12 +84,21 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
     if executor is None:
         return None
     k = max(frm + size, 1)
+    # prepared-query memo key: the canonical request body (repeated hot
+    # queries skip compile/build/transfer; executor.search_dsl re-executes
+    # the program every time — results are never cached here)
+    try:
+        import json as _json
+
+        memo_key = _json.dumps(body, sort_keys=True)
+    except TypeError:
+        memo_key = None
     try:
         cands, totals, agg_rounds, mask_rounds = executor.search_dsl(
             query, svc.mappings, svc.analysis, k,
             sort_spec=sort_spec or None, agg_specs=agg_specs or None,
             global_stats=global_stats, shards=shard_segs,
-            want_mask=want_mask)
+            want_mask=want_mask, memo_key=memo_key)
     except MeshCompileError as e:
         return _BY_DESIGN if getattr(e, "by_design", False) else None
     q_ms = (time.perf_counter() - t0) * 1000

@@ -285,3 +285,37 @@ def test_scatter_free_failure_falls_back_to_scatter(monkeypatch):
     before = boom["count"]
     r2 = n.search("ins", {"query": {"match": {"t": "common"}}})
     assert r2["hits"]["total"] == 300 and boom["count"] == before
+
+
+def test_prepared_query_memo_invalidation():
+    """The prepared-query memo reuses compile/build/transfer for repeated
+    identical requests but must ALWAYS re-execute and must invalidate on
+    any write: delete (tombstone), new doc + refresh (new segments)."""
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    n.create_index("memo", {"mappings": {"properties": {
+        "t": {"type": "text"}, "v": {"type": "long"}}}})
+    svc = n.indices["memo"]
+    for i in range(30):
+        svc.index_doc(str(i), {"t": "common", "v": i})
+    svc.refresh()
+    body = {"query": {"match": {"t": "common"}}, "size": 3}
+    r1 = n.search("memo", dict(body))
+    r2 = n.search("memo", dict(body))  # memo hit
+    assert r1["hits"]["total"] == r2["hits"]["total"] == 30
+    ex = svc.mesh_executor()
+    assert ex is not None and len(ex._prep) >= 1
+    # delete invalidates via the tombstone count in the key
+    svc.delete_doc(r1["hits"]["hits"][0]["_id"])
+    r3 = n.search("memo", dict(body))
+    assert r3["hits"]["total"] == 29
+    assert r3["hits"]["hits"][0]["_id"] != r1["hits"]["hits"][0]["_id"]
+    # new doc + refresh → new segment objects → fresh entry
+    svc.index_doc("x", {"t": "common", "v": 99})
+    svc.refresh()
+    r4 = n.search("memo", dict(body))
+    assert r4["hits"]["total"] == 30
+    # different body → different memo entry (no collision)
+    r5 = n.search("memo", {"query": {"match": {"t": "common"}}, "size": 1})
+    assert len(r5["hits"]["hits"]) == 1
