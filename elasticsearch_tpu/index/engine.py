@@ -218,6 +218,7 @@ class Engine:
     def update(self, doc_id: str, partial: Optional[dict] = None,
                script: Optional[str] = None, script_params: Optional[dict] = None,
                upsert: Optional[dict] = None, doc_as_upsert: bool = False,
+               scripted_upsert: bool = False,
                doc_type: Optional[str] = None, routing: Optional[str] = None,
                parent: Optional[str] = None, version: Optional[int] = None,
                version_type: str = "internal",
@@ -243,7 +244,13 @@ class Engine:
                     # with an upsert (TransportUpdateAction)
                     raise VersionConflictException("", doc_id, -1, version)
                 if upsert is not None:
-                    _, v, _ = self.index(doc_id, upsert, doc_type=doc_type,
+                    up = dict(upsert)
+                    if scripted_upsert and script is not None:
+                        # scripted_upsert: the script transforms the upsert
+                        # doc before the insert (UpdateHelper.prepare)
+                        up = self._run_update_script(
+                            script, script_params or {}, up)
+                    _, v, _ = self.index(doc_id, up, doc_type=doc_type,
                                          routing=routing, parent=parent,
                                          timestamp=timestamp, ttl=ttl)
                     return v, True
@@ -275,10 +282,18 @@ class Engine:
 
     def _run_update_script(self, script: str, params: dict, source: dict) -> dict:
         """Update scripts mutate ctx._source; painless-lite is expression-only,
-        so we support the common `ctx._source.<field> = <expr>` statement list."""
+        so we support the common `ctx._source.<field> = <expr>` statement list.
+        Groovy binds params as BARE variables (`ctx._source.foo = bar` with
+        params {bar: ...}) — the expression compiler binds them directly
+        (AST-level, so string literals equal to a param name are never
+        touched)."""
         from elasticsearch_tpu.search.scripting import compile_script
         from elasticsearch_tpu.utils.errors import ScriptException
 
+        reserved = {"doc", "params", "Math", "ctx", "_score", "_source",
+                    "true", "false", "null"}
+        extra = tuple(pn for pn in (params or {})
+                      if pn.isidentifier() and pn not in reserved)
         for stmt in script.split(";"):
             stmt = stmt.strip()
             if not stmt:
@@ -293,7 +308,7 @@ class Engine:
                 rhs = rhs.strip()
                 for fname, fval in source.items():
                     rhs = rhs.replace(f"ctx._source.{fname}", repr(fval))
-                cs = compile_script(rhs)
+                cs = compile_script(rhs, extra_vars=extra)
                 val = cs.run(lambda f: None, params=params)
                 if hasattr(val, "item"):
                     val = val.item()

@@ -284,10 +284,21 @@ class IndexService:
         script_src, params = None, None
         if script is not None:
             from elasticsearch_tpu.search.scripting import script_source
+            from elasticsearch_tpu.utils.errors import IllegalArgumentException
 
+            lang = ((script.get("lang") if isinstance(script, dict) else None)
+                    or body.get("lang") or "groovy")
+            if lang not in ("groovy", "painless", "painless-lite",
+                            "expression"):
+                raise IllegalArgumentException(
+                    f"script_lang not supported [{lang}]")
             script_src = script_source(script)
             if isinstance(script, dict):
                 params = script.get("params")
+            else:
+                # 2.0-era form: a string script with SIBLING body params
+                # ({"script": "...", "params": {...}, "lang": "groovy"})
+                params = body.get("params")
         version, created = shard.engine.update(
             doc_id,
             partial=body.get("doc"),
@@ -295,6 +306,7 @@ class IndexService:
             script_params=params,
             upsert=body.get("upsert"),
             doc_as_upsert=bool(body.get("doc_as_upsert", False)),
+            scripted_upsert=bool(body.get("scripted_upsert", False)),
             doc_type=doc_type,
             routing=routing,
             **kw,

@@ -760,6 +760,16 @@ class MeshSearchExecutor:
                 self._programs[(prog_key, pack_spec)] = prog
                 out = jax.device_get(prog(*dev))
             if prep_key is not None:
+                # prune entries keyed by segments that left the live set
+                # (a refresh/merge replaced them): their keys can never
+                # match again, but they would pin dead segments + device
+                # buffers until the LRU cycles
+                live_ids = {id(seg) for sh in self.shards
+                            for seg in _segments_of(sh)}
+                dead = [kk2 for kk2, ent in self._prep.items()
+                        if any(id(s) not in live_ids for s in ent[4])]
+                for kk2 in dead:
+                    self._prep.pop(kk2, None)
                 self._prep[prep_key] = (compiled, prog, dev, kk,
                                         [s for s in seg_row
                                          if s is not None])

@@ -1967,6 +1967,11 @@ def _update_doc(n: Node, p, b, index: str, id: str,
     # update auto-creates the index (reference: TransportUpdateAction
     # routes through auto-create like index does)
     body = _json(b)
+    if "script" in p and "script" not in body:
+        # 2.0-era request-param script form (?script=...&lang=groovy)
+        body["script"] = p["script"]
+    if "lang" in p and "lang" not in body:
+        body["lang"] = p["lang"]
     kw: Dict[str, Any] = {}
     if "version" in p:
         kw["version"] = int(p["version"])
@@ -3810,25 +3815,57 @@ def _put_script(n: Node, p, b, lang: str, id: str):
     src = body.get("script", body.get("source", ""))
     if isinstance(src, dict):
         src = src.get("inline", src.get("source", ""))
+    if lang not in ("groovy", "painless", "painless-lite", "expression",
+                    "mustache"):
+        raise IllegalArgumentException(f"script_lang not supported [{lang}]")
     created = scripting.get_stored_script(lang, id) is None
-    scripting.store_script(lang, id, src)
-    return (201 if created else 200), {"_id": id, "created": created}
+    from elasticsearch_tpu.utils.errors import ScriptException
+
+    try:
+        ver = scripting.store_script(
+            lang, id, src, version=p.get("version"),
+            version_type=p.get("version_type", "internal"))
+    except ScriptException as e:
+        # reference message shape (GroovyScriptEngineService compile
+        # failures): "Unable to parse ..."
+        raise ScriptException(f"Unable to parse [{src}]: {e}")
+    return (201 if created else 200), {"_id": id, "created": created,
+                                       "_version": ver}
 
 
 def _get_script(n: Node, p, b, lang: str, id: str):
     from elasticsearch_tpu.search import scripting
+    from elasticsearch_tpu.utils.errors import VersionConflictException
 
     src = scripting.get_stored_script(lang, id)
     if src is None:
-        return 404, {"_id": id, "found": False}
-    return 200, {"_id": id, "found": True, "lang": lang, "script": src}
+        return 404, {"_id": id, "found": False, "lang": lang,
+                     "_index": ".scripts"}
+    ver = scripting.stored_script_version(lang, id)
+    if (p.get("version") is not None
+            and p.get("version_type") != "force"
+            and ver != int(p["version"])):
+        raise VersionConflictException(".scripts", id, ver or 0,
+                                       int(p["version"]))
+    return 200, {"_id": id, "found": True, "lang": lang, "script": src,
+                 "_version": ver}
 
 
 def _delete_script(n: Node, p, b, lang: str, id: str):
+    """DELETE /_scripts/{lang}/{id}: indexed scripts live in the
+    .scripts index, so the response carries document-delete versioning
+    (the tombstone bumps the version)."""
     from elasticsearch_tpu.search import scripting
 
-    found = scripting.delete_stored_script(lang, id)
-    return (200 if found else 404), {"_id": id, "found": found}
+    ver = scripting.stored_script_version(lang, id)
+    found = scripting.delete_stored_script(
+        lang, id, version=p.get("version"),
+        version_type=p.get("version_type", "internal"))
+    body = {"_id": id, "found": found, "_index": ".scripts",
+            "lang": lang,
+            # the reference reports version 1 for a missing-doc delete
+            "_version": ((ver or 0) + 1) if found else 1}
+    return (200 if found else 404), body
 
 
 # -- rest-api-spec sweep: root-scoped and typed route forms ------------------

@@ -150,8 +150,15 @@ def _translate(source: str) -> str:
 class CompiledScript:
     """A validated script; call with a SegmentContext-like resolver."""
 
-    def __init__(self, source: str, lang: str = "painless"):
+    def __init__(self, source: str, lang: str = "painless",
+                 extra_vars: tuple = ()):
+        """``extra_vars``: additional bare names the script may reference
+        (groovy binds params as bare variables — `ctx._source.foo = bar`
+        with params {bar: ...}); bound from params at run(). AST-level,
+        so string literals textually equal to a param name are never
+        touched."""
         self.source = source
+        self.extra_vars = tuple(extra_vars)
         py = _translate(source)
         try:
             tree = ast.parse(py, mode="eval")
@@ -175,7 +182,7 @@ class CompiledScript:
                 )
             if isinstance(node, ast.Name) and node.id not in (
                 "doc", "params", "Math", "_score", "_where", "True", "False", "None",
-            ):
+            ) and node.id not in self.extra_vars:
                 raise ScriptException(f"unknown variable [{node.id}] in script")
             if isinstance(node, ast.Call):
                 f = node.func
@@ -196,6 +203,8 @@ class CompiledScript:
             "_where": jnp.where,
             "__builtins__": {},
         }
+        for name in self.extra_vars:  # groovy-style bare param bindings
+            env[name] = (params or {}).get(name)
         try:
             return eval(self._code, env)
         except ScriptException:
@@ -234,11 +243,13 @@ class _WhereRewriter(ast.NodeTransformer):
 _CACHE: Dict[str, CompiledScript] = {}
 
 
-def compile_script(source: str, lang: str = "painless") -> CompiledScript:
-    key = f"{lang}:{source}"
+def compile_script(source: str, lang: str = "painless",
+                   extra_vars: tuple = ()) -> CompiledScript:
+    key = (lang, source, tuple(sorted(extra_vars)))
     cs = _CACHE.get(key)
     if cs is None:
-        cs = _CACHE[key] = CompiledScript(source, lang)
+        cs = _CACHE[key] = CompiledScript(source, lang,
+                                          extra_vars=tuple(extra_vars))
     return cs
 
 
@@ -249,21 +260,84 @@ def compile_script(source: str, lang: str = "painless") -> CompiledScript:
 # process-level registry mutated only through the REST endpoints.
 
 _STORED: Dict[str, str] = {}
+_STORED_VERSIONS: Dict[str, int] = {}
 
 
-def store_script(lang: str, script_id: str, source: str) -> None:
+def store_script(lang: str, script_id: str, source: str,
+                 version=None, version_type: str = "internal") -> int:
+    """Store + version an indexed script (reference: indexed scripts live
+    in the .scripts index, so PUT carries full document versioning
+    semantics). Returns the new version."""
     # compile eagerly: a bad script must be rejected at PUT time, the way
     # ScriptService validates on store
     compile_script(source, lang)
-    _STORED[f"{lang}/{script_id}"] = source
+    from elasticsearch_tpu.utils.errors import VersionConflictException
+
+    key = f"{lang}/{script_id}"
+    cur = _STORED_VERSIONS.get(key)
+    if version_type not in ("internal", "external", "external_gt",
+                            "external_gte", "force"):
+        from elasticsearch_tpu.utils.errors import IllegalArgumentException
+
+        raise IllegalArgumentException(
+            f"version type [{version_type}] is not supported")
+    if version is not None:
+        version = int(version)
+        if version_type in ("external", "external_gt"):
+            if cur is not None and version <= cur:
+                raise VersionConflictException(".scripts", script_id,
+                                               cur, version)
+            new = version
+        elif version_type == "external_gte":
+            if cur is not None and version < cur:
+                raise VersionConflictException(".scripts", script_id,
+                                               cur, version)
+            new = version
+        elif version_type == "force":
+            new = version
+        else:  # internal: must match the current version
+            if (cur or 0) != version:
+                raise VersionConflictException(".scripts", script_id,
+                                               cur or 0, version)
+            new = (cur or 0) + 1
+    else:
+        new = (cur or 0) + 1
+    _STORED[key] = source
+    _STORED_VERSIONS[key] = new
+    return new
 
 
 def get_stored_script(lang: str, script_id: str) -> Optional[str]:
     return _STORED.get(f"{lang}/{script_id}")
 
 
-def delete_stored_script(lang: str, script_id: str) -> bool:
-    return _STORED.pop(f"{lang}/{script_id}", None) is not None
+def stored_script_version(lang: str, script_id: str) -> Optional[int]:
+    return _STORED_VERSIONS.get(f"{lang}/{script_id}")
+
+
+def delete_stored_script(lang: str, script_id: str, version=None,
+                         version_type: str = "internal") -> bool:
+    """Document-delete versioning (the .scripts index): internal requires
+    an exact match; external forms conflict only when the provided
+    version is BEHIND the current one; force never conflicts."""
+    from elasticsearch_tpu.utils.errors import VersionConflictException
+
+    key = f"{lang}/{script_id}"
+    if key not in _STORED:
+        return False
+    if version is not None and version_type != "force":
+        cur = _STORED_VERSIONS.get(key, 0)
+        provided = int(version)
+        conflict = (provided < cur
+                    if version_type in ("external", "external_gt",
+                                        "external_gte")
+                    else provided != cur)
+        if conflict:
+            raise VersionConflictException(".scripts", script_id, cur,
+                                           provided)
+    _STORED.pop(key, None)
+    _STORED_VERSIONS.pop(key, None)
+    return True
 
 
 def script_source(spec: Any) -> str:

@@ -28,7 +28,7 @@ API_DIR = "/root/reference/rest-api-spec/api"
 TEST_DIR = "/root/reference/rest-api-spec/test"
 OUR_VERSION = (2, 0, 0)  # the surface we mirror (ES 2.0.0-SNAPSHOT)
 
-SUPPORTED_FEATURES = {"regex", "stash_in_path"}
+SUPPORTED_FEATURES = {"regex", "stash_in_path", "groovy_scripting"}
 
 # file (relative to TEST_DIR) -> reason. Whole-suite skips for documented
 # deviations / reference-runner-only features.

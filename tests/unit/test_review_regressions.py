@@ -319,3 +319,21 @@ def test_prepared_query_memo_invalidation():
     # different body → different memo entry (no collision)
     r5 = n.search("memo", {"query": {"match": {"t": "common"}}, "size": 1})
     assert len(r5["hits"]["hits"]) == 1
+
+
+def test_groovy_param_name_inside_string_literal_untouched():
+    """A string literal textually equal to a param name must never be
+    rewritten (the bare-param binding is AST-level, not textual)."""
+    from elasticsearch_tpu.node import Node
+
+    n = Node()
+    n.create_index("lit", {})
+    svc = n.indices["lit"]
+    svc.index_doc("1", {"tag": "init"})
+    svc.update_doc("1", {"script": "ctx._source.tag = 'beta'",
+                         "params": {"beta": 2}, "lang": "groovy"})
+    assert svc.get_doc("1")["_source"]["tag"] == "beta"
+    # and the bare param still binds when actually referenced
+    svc.update_doc("1", {"script": "ctx._source.tag = beta",
+                         "params": {"beta": 7}, "lang": "groovy"})
+    assert svc.get_doc("1")["_source"]["tag"] == 7
