@@ -755,7 +755,7 @@ class DistributedDataService:
                                    routing, payload.get("kw") or {})
 
     def by_query(self, index: str, body: Optional[dict], op: str,
-                 script=None) -> dict:
+                 script=None, params=None) -> dict:
         """Distributed delete/update-by-query: fan one scan+apply pass to
         each PRIMARY owner for its shards, merge counts. Reference:
         AbstractAsyncBulkByScrollAction (scroll-driven scan + bulk), here
@@ -784,7 +784,8 @@ class DistributedDataService:
         deleted = updated = noops = 0
         for owner, sids in sorted(by_owner.items()):
             payload = {"index": index, "query": (body or {}).get("query"),
-                       "op": op, "shards": sids, "script": script}
+                       "op": op, "shards": sids, "script": script,
+                       "params": params}
             try:
                 if owner == self._local_id():
                     res = self._on_by_query(payload)
@@ -832,6 +833,7 @@ class DistributedDataService:
         index, op = payload["index"], payload["op"]
         sids = set(payload["shards"])
         script = payload.get("script")
+        s_params = payload.get("params")
         svc = self.node.indices[index]
         num_shards = self._meta(index)["num_shards"]
         svc.refresh()
@@ -852,7 +854,9 @@ class DistributedDataService:
                     counts["deleted"] += 1
                 elif script is not None:
                     self._primary_update(index, sid, doc_id,
-                                         {"script": script}, routing, {})
+                                         {"script": script,
+                                          "params": s_params},
+                                         routing, {})
                     counts["updated"] += 1
                 else:
                     got = svc.get_doc(doc_id, routing=routing)

@@ -2065,10 +2065,12 @@ def _update_by_query(n: Node, p, b, index: str):
     data = _mh_for(n, index)
     if data is not None:
         return 200, data.by_query(index, body, "update",
-                                  script=body.get("script"))
+                                  script=body.get("script"),
+                                  params=body.get("params"))
     svc = n.get_index(index)
     svc.refresh()
     script = body.get("script")
+    s_params = body.get("params")  # 2.0 form: sibling body params
     counts = {"updated": 0, "noops": 0}
     failures: list = []
 
@@ -2076,7 +2078,9 @@ def _update_by_query(n: Node, p, b, index: str):
         routing = loc.routing if loc else None
         try:
             if script is not None:
-                svc.update_doc(doc_id, {"script": script}, routing=routing)
+                svc.update_doc(doc_id,
+                               {"script": script, "params": s_params},
+                               routing=routing)
                 counts["updated"] += 1
             else:
                 # no script: a re-index touch (picks up mapping changes).
