@@ -503,7 +503,7 @@ def main():
             f"with a reduced workload so the record still lands")
         defaults = ap.parse_args([])
         if args.docs == defaults.docs:
-            args.docs = 1 << 16
+            args.docs = 1 << 18  # 262k: full-stack CPU run measures ~1 min
         if args.vecs == defaults.vecs:
             args.vecs = 1 << 16
         if args.batch_queries == defaults.batch_queries:
