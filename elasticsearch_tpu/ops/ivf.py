@@ -126,7 +126,9 @@ def build_ivf(vecs_np: np.ndarray, exists_np: np.ndarray, max_docs: int,
     """Build an IVF index over the live vectors of one segment slab."""
     jax = _jax()
 
-    ids = np.nonzero(exists_np)[0].astype(np.int32)
+    # host-side BUILD path (freeze-time, never traced): the ragged live-id
+    # set is exactly what the padded [C, Lmax] device lists exist to absorb
+    ids = np.nonzero(exists_np)[0].astype(np.int32)  # tpulint: host
     n = ids.size
     if n < 64:
         return None  # brute force is strictly better at this scale

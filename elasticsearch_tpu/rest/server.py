@@ -2464,8 +2464,13 @@ def _explain(n: Node, p, b, index: str, id: str):
         if seg.seg_id == loc.where:
             ctx = SegmentContext(seg, svc.mappings, svc.analysis)
             scores, mask = query.score_or_mask(ctx)
-            matched = bool(np.asarray(mask)[loc.local_id])
-            score = float(np.asarray(scores)[loc.local_id])
+            # transfer each array to host once and index the copies — the
+            # pattern every per-hit consumer must follow (tpulint R002);
+            # scalar pulls would re-sync per field as this path grows
+            mask_h = np.asarray(mask)
+            scores_h = np.asarray(scores)
+            matched = bool(mask_h[loc.local_id])
+            score = float(scores_h[loc.local_id])
             resp = {
                 "_index": svc.name,
                 "_type": (loc.doc_type or "_doc"),

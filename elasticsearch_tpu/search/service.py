@@ -315,6 +315,7 @@ class ShardSearcher:
         want_version = bool(body.get("version", False))
         script_fields = body.get("script_fields")
         stored_fields = body.get("stored_fields", body.get("fields"))
+        sf_cache: Dict[Tuple[int, str], Any] = {}  # (seg_id, field) → values
         hits = []
         for d in docs:
             tcol = d.seg.keywords.get("_type")
@@ -358,7 +359,8 @@ class ShardSearcher:
             if script_fields:
                 hit.setdefault("fields", {})
                 for fname, spec in script_fields.items():
-                    hit["fields"][fname] = [self._script_field(d, spec)]
+                    hit["fields"][fname] = [
+                        self._script_field(d, spec, fname, sf_cache)]
             if hl:
                 ctx = SegmentContext(d.seg, self.mappings, self.analysis)
                 hit["highlight"] = self._highlight(ctx, query, src, hl)
@@ -450,18 +452,33 @@ class ShardSearcher:
                     }
                 }
 
-    def _script_field(self, d: ShardDoc, spec):
+    def _script_field(self, d: ShardDoc, spec, fname: str = "",
+                      cache: Optional[dict] = None):
+        """Script-field value for one hit. Scripts evaluate to a whole
+        per-segment vector, so the (segment, field) result — pulled to host
+        once — is cached across the hits of one fetch and indexed per hit
+        (the per-hit recompute was one script run + one device sync per
+        hit per field)."""
         from elasticsearch_tpu.search.function_score import doc_resolver
         from elasticsearch_tpu.search.scripting import (compile_script,
                                                         script_source)
 
-        s = spec.get("script", spec) if isinstance(spec, dict) else spec
-        src = script_source(s)
-        params = {} if isinstance(s, str) else s.get("params", {})
-        ctx = SegmentContext(d.seg, self.mappings, self.analysis)
-        vals = compile_script(src).run(doc_resolver(ctx), params=params)
+        key = (d.seg.seg_id, fname)
+        vals = cache.get(key) if cache is not None else None
+        if vals is None:
+            s = spec.get("script", spec) if isinstance(spec, dict) else spec
+            src = script_source(s)
+            params = {} if isinstance(s, str) else s.get("params", {})
+            ctx = SegmentContext(d.seg, self.mappings, self.analysis)
+            vals = compile_script(src).run(doc_resolver(ctx), params=params)
+            if hasattr(vals, "shape") or hasattr(vals, "item"):
+                # host copy once per segment — 0-d device scalars included,
+                # else float(vals) below would sync the device per hit
+                vals = np.asarray(vals)
+            if cache is not None:
+                cache[key] = vals
         if hasattr(vals, "shape") and getattr(vals, "shape", ()) != ():
-            return float(np.asarray(vals)[d.local_id])
+            return float(vals[d.local_id])
         return float(vals) if hasattr(vals, "item") or isinstance(vals, (int, float)) else vals
 
     def _highlight(self, ctx, query, src, hl_spec) -> Dict[str, List[str]]:
@@ -924,6 +941,15 @@ def _sort_key_vector(ctx, s, scores):
     return jnp.zeros(ctx.D, dtype=jnp.float32), 0.0
 
 
+def _host_exists(col) -> np.ndarray:
+    """Host mirror of a column's exists bitmap, backfilled once per
+    (immutable) column slab. Per-hit sort/fetch paths index this instead
+    of pulling the device array once per hit (tpulint R002)."""
+    if col.exists_host is None:
+        col.exists_host = np.asarray(col.exists)
+    return col.exists_host
+
+
 def _sort_value(ctx, s, local: int, np_scores):
     if s["field"] == "_score":
         return float(np_scores[local])
@@ -932,7 +958,7 @@ def _sort_value(ctx, s, local: int, np_scores):
 
         lat = ctx.col(f"{s['geo_field']}.lat")
         lon = ctx.col(f"{s['geo_field']}.lon")
-        if lat is None or lon is None or not bool(np.asarray(lat.exists)[local]):
+        if lat is None or lon is None or not bool(_host_exists(lat)[local]):
             return None
         lat0, lon0 = s["origin"]
         d = haversine_np(float(lat.exact[local]), float(lon.exact[local]),
@@ -940,7 +966,7 @@ def _sort_value(ctx, s, local: int, np_scores):
         return float(d)
     col = ctx.col(s["field"])
     if col is not None:
-        if not bool(np.asarray(col.exists)[local]):
+        if not bool(_host_exists(col)[local]):
             return None
         ex = col.exact[local]
         return int(ex) if col.exact.dtype.kind == "i" else float(ex)

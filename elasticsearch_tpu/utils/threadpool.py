@@ -86,18 +86,22 @@ class FixedThreadPool:
         """Submit and WAIT (the REST handler thread blocks on its pool slot
         — bounded concurrency with backpressure). Raises
         EsRejectedExecutionException when the queue is full."""
-        if self._closed:
-            raise EsRejectedExecutionException(
-                f"thread pool [{self.name}] is shut down")
         work = _Work(fn, args, kwargs)
-        try:
-            self._q.put_nowait(work)
-        except queue.Full:
-            with self._lock:
+        # closed-check and enqueue are one atomic step w.r.t. shutdown()'s
+        # flag write: work can never land BEHIND the shutdown sentinels
+        # (where no worker would ever run it and the submitter would wait
+        # forever on work.done)
+        with self._lock:
+            if self._closed:
+                raise EsRejectedExecutionException(
+                    f"thread pool [{self.name}] is shut down")
+            try:
+                self._q.put_nowait(work)
+            except queue.Full:
                 self.rejected += 1
-            raise EsRejectedExecutionException(
-                f"rejected execution on thread pool [{self.name}] "
-                f"(queue capacity {self.queue_size})")
+                raise EsRejectedExecutionException(
+                    f"rejected execution on thread pool [{self.name}] "
+                    f"(queue capacity {self.queue_size})")
         work.done.wait()
         if work.error is not None:
             raise work.error
@@ -120,7 +124,11 @@ class FixedThreadPool:
         BLOCKING put — workers drain queued work first, so a momentarily
         full queue must not leak live threads (put_nowait would silently
         drop the sentinel)."""
-        self._closed = True
+        with self._lock:
+            # paired with execute()'s locked check-and-enqueue: once this
+            # releases, every later execute() rejects, so the sentinels
+            # below are guaranteed to be the LAST queue entries
+            self._closed = True
         for _ in self._workers:
             try:
                 self._q.put(None, timeout=5.0)  # type: ignore[arg-type]

@@ -1,0 +1,607 @@
+"""tpulint rule fixtures: one known-bad and one known-good example per
+rule (R001–R005), the suppression/host-annotation mechanism, the baseline
+budget semantics, the --json CLI mode, and the runtime trace auditor.
+
+The clean-gate companion (test_tpulint_clean.py) runs the analyzer over
+the real package; this file proves each detector actually detects.
+"""
+import json
+import textwrap
+
+import pytest
+
+from tools.tpulint import lint_source
+from tools.tpulint.analyzer import Violation
+from tools.tpulint.baseline import filter_baselined, load_baseline
+
+
+def lint(src: str, *, hot: bool = False, locked: bool = False,
+         ops: bool = False, path: str = "elasticsearch_tpu/x/mod.py"):
+    return lint_source(textwrap.dedent(src), path, hot=hot, ops=ops,
+                       locked=locked)
+
+
+def rules_of(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ---------------------------------------------------------------------------
+# R001 — recompilation hazards
+# ---------------------------------------------------------------------------
+
+class TestR001:
+    def test_bad_jit_in_loop(self):
+        vs = lint("""
+            import jax
+            def run(xs):
+                for x in xs:
+                    f = jax.jit(lambda v: v + 1)
+                    f(x)
+        """)
+        assert rules_of(vs) == ["R001"]
+
+    def test_bad_jitted_def_in_loop(self):
+        vs = lint("""
+            import jax
+            while True:
+                @jax.jit
+                def f(x):
+                    return x
+        """)
+        assert rules_of(vs) == ["R001"]
+
+    def test_bad_unhashable_static_arg(self):
+        vs = lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("ks",))
+            def f(x, *, ks):
+                return x
+
+            def g(x):
+                return f(x, ks=[1, 2])
+        """)
+        assert rules_of(vs) == ["R001"]
+        assert "unhashable" in vs[0].message
+
+    def test_bad_raw_len_static_arg(self):
+        vs = lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("n",))
+            def f(x, *, n):
+                return x
+
+            def g(hits, x):
+                return f(x, n=len(hits))
+        """)
+        assert rules_of(vs) == ["R001"]
+        assert "bucket" in vs[0].message
+
+    def test_good_program_factory(self):
+        # the codebase idiom: build once per shape class, cache, reuse
+        vs = lint("""
+            import jax
+            from functools import partial
+
+            _PROGRAMS = {}
+
+            @partial(jax.jit, static_argnames=("n", "metric"))
+            def f(x, *, n, metric):
+                return x
+
+            def g(x, n, metric):
+                return f(x, n=n, metric=metric)
+
+            def make(shape_class):
+                prog = _PROGRAMS.get(shape_class)
+                if prog is None:
+                    prog = jax.jit(lambda v: v * 2)
+                    _PROGRAMS[shape_class] = prog
+                return prog
+        """)
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# R002 — host-device sync in hot paths
+# ---------------------------------------------------------------------------
+
+class TestR002:
+    BAD_PER_HIT = """
+        import numpy as np
+        def handler(scores, mask, hits):
+            out = []
+            for loc in hits:
+                matched = bool(np.asarray(mask)[loc])
+                out.append(float(np.asarray(scores)[loc]))
+            return out
+    """
+
+    def test_bad_scalar_pull_in_loop(self):
+        vs = lint(self.BAD_PER_HIT, hot=True)
+        assert rules_of(vs) == ["R002"]
+        assert len(vs) >= 2  # both the bool(...) and float(...) sites
+
+    def test_bad_item_in_comprehension(self):
+        vs = lint("""
+            def handler(xs):
+                return [x.item() for x in xs]
+        """, hot=True)
+        assert rules_of(vs) == ["R002"]
+
+    def test_good_hoisted_host_copy(self):
+        vs = lint("""
+            import numpy as np
+            def handler(scores, mask, hits):
+                mask_h = np.asarray(mask)
+                scores_h = np.asarray(scores)
+                return [(bool(mask_h[loc]), float(scores_h[loc]))
+                        for loc in hits]
+        """, hot=True)
+        assert vs == []
+
+    def test_good_slice_transfer_in_loop(self):
+        # bulk slices are the RIGHT pattern — only scalar pulls are flagged
+        vs = lint("""
+            import numpy as np
+            def handler(segs):
+                return [np.asarray(s.mask)[: s.num_docs] for s in segs]
+        """, hot=True)
+        assert vs == []
+
+    def test_cold_path_not_flagged(self):
+        assert lint(self.BAD_PER_HIT, hot=False) == []
+
+
+# ---------------------------------------------------------------------------
+# R003 — dynamic shapes in traced code / ambiguous host build calls
+# ---------------------------------------------------------------------------
+
+class TestR003:
+    def test_bad_nonzero_without_size(self):
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return jnp.nonzero(x > 0)
+        """)
+        assert rules_of(vs) == ["R003"]
+
+    def test_bad_boolean_mask_indexing(self):
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, lo):
+                return x[x > lo]
+        """)
+        assert rules_of(vs) == ["R003"]
+
+    def test_bad_single_arg_where_and_unique(self):
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return jnp.where(x > 0), jnp.unique(x)
+        """)
+        assert [v.rule for v in vs] == ["R003", "R003"]
+
+    def test_good_size_bounded_forms(self):
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                idx = jnp.nonzero(x > 0, size=8, fill_value=-1)
+                return jnp.where(x > 0, x, 0.0), idx
+        """)
+        assert vs == []
+
+    def test_bad_unannotated_host_nonzero_in_ops(self):
+        vs = lint("""
+            import numpy as np
+            def build(exists):
+                return np.nonzero(exists)[0]
+        """, ops=True)
+        assert rules_of(vs) == ["R003"]
+
+    def test_good_host_annotated_nonzero_in_ops(self):
+        vs = lint("""
+            import numpy as np
+            def build(exists):
+                return np.nonzero(exists)[0]  # tpulint: host
+        """, ops=True)
+        assert vs == []
+
+    def test_bad_unaliased_jax_numpy_import(self):
+        # `import jax.numpy` without an alias must still register as jnp
+        vs = lint("""
+            import jax
+            import jax.numpy
+
+            @jax.jit
+            def f(x):
+                return jax.numpy.nonzero(x > 0)
+        """)
+        assert rules_of(vs) == ["R003"]
+
+    def test_host_nonzero_outside_ops_not_flagged(self):
+        vs = lint("""
+            import numpy as np
+            def build(exists):
+                return np.nonzero(exists)[0]
+        """, ops=False)
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# R004 — tracer leaks
+# ---------------------------------------------------------------------------
+
+class TestR004:
+    def test_bad_if_on_traced_value(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert rules_of(vs) == ["R004"]
+
+    def test_bad_while_on_traced_value(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                while x < 10:
+                    x = x * 2
+                return x
+        """)
+        assert rules_of(vs) == ["R004"]
+
+    def test_good_branch_on_static_argname(self):
+        vs = lint("""
+            import jax
+            from functools import partial
+
+            @partial(jax.jit, static_argnames=("metric",))
+            def f(x, *, metric):
+                if metric == "l2":
+                    return -x
+                return x
+        """)
+        assert vs == []
+
+    def test_good_is_none_structure_switch(self):
+        # pytree-structure dispatch resolves at trace time — allowed
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x, mask):
+                if mask is None:
+                    return x
+                return x * mask
+        """)
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# R005 — lock discipline in threadpool-visible modules
+# ---------------------------------------------------------------------------
+
+class TestR005:
+    def test_bad_unlocked_instance_mutation(self):
+        vs = lint("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.docs = {}
+
+                def add(self, k, v):
+                    self.docs[k] = v
+        """, locked=True)
+        assert rules_of(vs) == ["R005"]
+
+    def test_bad_unlocked_module_global_mutation(self):
+        vs = lint("""
+            import threading
+
+            _LOCK = threading.Lock()
+            _CACHE = {}
+
+            def put(k, v):
+                _CACHE[k] = v
+        """, locked=True)
+        assert rules_of(vs) == ["R005"]
+
+    def test_good_locked_mutation(self):
+        vs = lint("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.docs = {}
+
+                def add(self, k, v):
+                    with self._lock:
+                        self.docs[k] = v
+                        self.count = len(self.docs)
+        """, locked=True)
+        assert vs == []
+
+    def test_good_private_helper_caller_locked(self):
+        # the engine.py convention: `_private` helpers run under the
+        # caller's lock and are exempt
+        vs = lint("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.docs = {}
+
+                def add(self, k, v):
+                    with self._lock:
+                        self._put(k, v)
+
+                def _put(self, k, v):
+                    self.docs[k] = v
+        """, locked=True)
+        assert vs == []
+
+    def test_unlocked_module_not_checked(self):
+        vs = lint("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.docs = {}
+
+                def add(self, k, v):
+                    self.docs[k] = v
+        """, locked=False)
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    def test_same_line_allow(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:  # tpulint: allow[R004]
+                    return x
+                return -x
+        """)
+        assert vs == []
+
+    def test_preceding_comment_allow(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                # tpulint: allow[R004] — measured: trace-time constant here
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert vs == []
+
+    def test_comment_block_with_blank_line_before_code(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                # tpulint: allow[R004] — justification paragraph that ends
+                # with a blank line before the code, a common style
+
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert vs == []
+
+    def test_allow_is_rule_specific(self):
+        vs = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:  # tpulint: allow[R001]
+                    return x
+                return -x
+        """)
+        assert rules_of(vs) == ["R004"]
+
+    def test_multi_rule_allow(self):
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return jnp.nonzero(x > 0)  # tpulint: allow[R003, R004]
+        """)
+        assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def _v(self, rule="R002", path="a.py", line=5, snippet="x = y[i]"):
+        return Violation(rule, path, line, 0, "msg", snippet)
+
+    def test_budget_consumed_per_occurrence(self):
+        from collections import Counter
+        vs = [self._v(line=5), self._v(line=9)]
+        budget = Counter({("R002", "a.py", "x = y[i]"): 1})
+        new, old = filter_baselined(vs, budget)
+        assert len(old) == 1 and len(new) == 1  # duplication still gates
+
+    def test_line_number_drift_still_matches(self):
+        from collections import Counter
+        budget = Counter({("R002", "a.py", "x = y[i]"): 1})
+        new, old = filter_baselined([self._v(line=999)], budget)
+        assert new == [] and len(old) == 1
+
+    def test_justification_required(self, tmp_path):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps({"violations": [
+            {"rule": "R002", "path": "a.py", "snippet": "x", "count": 1}
+        ]}))
+        with pytest.raises(ValueError, match="justification"):
+            load_baseline(str(p))
+
+    def test_missing_baseline_is_empty(self, tmp_path):
+        assert load_baseline(str(tmp_path / "nope.json")) == {}
+
+
+# ---------------------------------------------------------------------------
+# CLI / --json
+# ---------------------------------------------------------------------------
+
+class TestCli:
+    BAD = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+
+    def test_json_mode_and_exit_codes(self, tmp_path, capsys):
+        from tools.tpulint.__main__ import main
+
+        target = tmp_path / "ops"
+        target.mkdir()
+        bad = target / "bad.py"
+        bad.write_text(self.BAD)
+        rc = main([str(bad), "--json",
+                   "--baseline", str(tmp_path / "none.json")])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["counts"] == {"new": 1, "baselined": 0}
+        (v,) = out["violations"]
+        assert v["rule"] == "R004" and v["path"] == str(bad)
+        assert "rules" in out  # rule legend rides along for tooling
+
+    def test_write_baseline_then_clean(self, tmp_path, capsys):
+        from tools.tpulint.__main__ import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        bl = tmp_path / "baseline.json"
+        assert main([str(bad), "--write-baseline",
+                     "--baseline", str(bl)]) == 0
+        capsys.readouterr()
+        assert main([str(bad), "--baseline", str(bl)]) == 0
+        assert main([str(bad), "--baseline", str(bl),
+                     "--no-baseline"]) == 1
+
+    def test_clean_file_exits_zero(self, tmp_path, capsys):
+        from tools.tpulint.__main__ import main
+
+        good = tmp_path / "good.py"
+        good.write_text("def f(x):\n    return x\n")
+        assert main([str(good),
+                     "--baseline", str(tmp_path / "none.json")]) == 0
+
+    def test_missing_path_is_an_error_not_clean(self, tmp_path, capsys):
+        # a typo'd path must not silently lint nothing and report green
+        from tools.tpulint.__main__ import main
+
+        assert main([str(tmp_path / "renamed_away"),
+                     "--baseline", str(tmp_path / "none.json")]) == 2
+
+
+# ---------------------------------------------------------------------------
+# runtime trace auditor
+# ---------------------------------------------------------------------------
+
+class TestTraceAudit:
+    def test_counts_and_steady_state(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tools.tpulint.trace_audit import (TraceBudgetExceeded,
+                                               trace_audit)
+
+        with trace_audit() as audit:
+            @jax.jit
+            def f(x):
+                return x * 2
+
+            f(jnp.ones((8,)))
+            f(jnp.ones((8,)))  # cache hit — no new trace
+            snap = audit.snapshot()
+            f(jnp.ones((8,)))
+            audit.assert_no_new_traces_since(snap)  # steady state holds
+            f(jnp.ones((16,)))  # new shape class → retrace
+            with pytest.raises(TraceBudgetExceeded):
+                audit.assert_no_new_traces_since(snap)
+            assert audit.total() == 2
+        assert not getattr(jax.jit, "__tpulint_counting__", False)
+
+    def test_budget_enforced_at_trace_time(self):
+        import jax
+        import jax.numpy as jnp
+
+        from tools.tpulint.trace_audit import (TraceBudgetExceeded,
+                                               trace_audit)
+
+        with trace_audit(max_traces=1):
+            @jax.jit
+            def g(x):
+                return x + 1
+
+            g(jnp.ones((4,)))
+            with pytest.raises(TraceBudgetExceeded):
+                g(jnp.ones((5,)))
+
+    def test_partial_jit_idiom_counted(self):
+        # the codebase's @partial(jax.jit, static_argnames=...) pattern
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+
+        from tools.tpulint.trace_audit import trace_audit
+
+        with trace_audit() as audit:
+            @partial(jax.jit, static_argnames=("n",))
+            def f(x, *, n):
+                return x * n
+
+            f(jnp.ones((4,)), n=2)
+            f(jnp.ones((4,)), n=2)
+            f(jnp.ones((4,)), n=3)  # new static value → retrace
+            assert audit.total() == 2

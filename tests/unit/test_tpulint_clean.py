@@ -1,0 +1,40 @@
+"""CI gate: `elasticsearch_tpu/` must be tpulint-clean.
+
+Runs the analyzer over the real package in tier-1 and fails on any
+violation not grandfathered in tools/tpulint/baseline.json. The baseline
+is currently EMPTY — a new R001–R005 finding means the diff introduced a
+recompile hazard, a per-hit host sync, a dynamic-shape leak, a tracer
+leak, or an unlocked shared-state write. Fix it, or (only with a reviewed
+justification) suppress in place with `# tpulint: allow[R00x]` / add a
+baseline entry. See docs/STATIC_ANALYSIS.md.
+"""
+import os
+
+from tools.tpulint import lint_paths
+from tools.tpulint.baseline import (DEFAULT_BASELINE, filter_baselined,
+                                    load_baseline)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_elasticsearch_tpu_is_tpulint_clean():
+    target = os.path.join(REPO_ROOT, "elasticsearch_tpu")
+    found = lint_paths([target], root=REPO_ROOT)
+    new, _old = filter_baselined(found, load_baseline(DEFAULT_BASELINE))
+    assert new == [], (
+        "tpulint found non-baselined violations:\n"
+        + "\n".join(v.format() for v in new)
+        + "\n\nrun `python -m tools.tpulint elasticsearch_tpu` locally; "
+          "see docs/STATIC_ANALYSIS.md for the fix/suppress workflow"
+    )
+
+
+def test_tools_and_bench_are_tpulint_clean():
+    """The linter's own neighbourhood (tools/, bench.py) stays clean too —
+    benches are where jit-in-loop and per-hit sync bugs love to hide."""
+    found = lint_paths([os.path.join(REPO_ROOT, "tools"),
+                        os.path.join(REPO_ROOT, "bench.py")],
+                       root=REPO_ROOT)
+    new, _old = filter_baselined(found, load_baseline(DEFAULT_BASELINE))
+    assert new == [], "\n".join(v.format() for v in new)

@@ -1,0 +1,5 @@
+"""Developer tools (profilers, A/B benches, tpulint static analysis).
+
+A real package (not a namespace package) so `python -m tools.tpulint`
+and test imports resolve regardless of the pytest import mode.
+"""

@@ -49,6 +49,8 @@ for P in (1 << 12, 1 << 15):
     starts = jnp.asarray(rng.integers(0, nnz - P, T), jnp.int32)
     lens = jnp.full(T, P // 2, jnp.int32)
     ws = jnp.ones(T, jnp.float32)
+    # tpulint: allow[R001] — microbench: one distinct program per P shape
+    # class, each jitted and timed exactly once by design
     f_seg = jax.jit(lambda d, tf, s, l, w: bm25_score_segment(
         d, tf, s, l, w, P=P, D=D))
     print(f"scatter tail P={P} T={T}: {t(f_seg, doc_ids, tfn, starts, lens, ws):.1f} ms")

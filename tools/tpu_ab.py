@@ -15,6 +15,10 @@ import os, sys, time
 import numpy as np
 sys.path.insert(0, os.environ["AB_REPO"])  # -c code has no __file__
 sys.argv = [sys.argv[0]]
+# retrace auditor BEFORE bench/elasticsearch_tpu bind jax.jit at import
+# (tools/tpulint/trace_audit.py): the timed loop below must not retrace
+from tools.tpulint import trace_audit as _ta
+_audit = _ta.install()
 import bench
 from elasticsearch_tpu.utils.platform import (enable_compilation_cache,
                                               ensure_cpu_if_requested)
@@ -30,12 +34,15 @@ bodies = [{"query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
            "size": 10} for q in qs]
 for b in bodies:
     node.search("msmarco", b)
+_steady = _audit.snapshot()  # warmup compiled every program it will need
 times = []
 for _ in range(3):
     for b in bodies:
         t0 = time.perf_counter()
         node.search("msmarco", b)
         times.append(time.perf_counter() - t0)
+# any trace during the timed loop is a recompile polluting the percentiles
+_retraced = _audit.traces_since(_steady)
 import json as _j
 cpu_times, cpu_tops = bench.cpu_bm25_latency(u_doc, tfn, offsets, idf,
                                              qs, docs, 10, runs=1)
@@ -47,7 +54,8 @@ for q, ct in zip(qs, cpu_tops):
         agree += 1
 print(_j.dumps({"p50_ms": float(np.percentile(np.array(times) * 1000, 50)),
                 "cpu_p50_ms": float(np.percentile(np.array(cpu_times) * 1000, 50)),
-                "top1_agree": f"{agree}/{len(qs)}"}))
+                "top1_agree": f"{agree}/{len(qs)}",
+                "retraces_timed": sum(_retraced.values())}))
 """
 
 CONFIGS = [

@@ -1,0 +1,47 @@
+"""tpulint — a JAX/TPU-aware static-analysis pass for elasticsearch_tpu.
+
+The paper's core bet is that per-segment scoring runs as batched,
+statically-shaped device programs. That bet silently breaks whenever a
+dynamic shape, tracer leak, or per-hit host sync creeps into a jitted
+path — failures that surface not as exceptions but as recompile storms
+and serialized device↔host ping-pong on TPU. tpulint catches the known
+failure classes at review time:
+
+  R001  recompilation hazards: jit construction inside a loop; unhashable
+        or unbucketed high-cardinality values fed to ``static_argnames``.
+  R002  host↔device sync in hot paths (``ops/``, ``search/``,
+        ``rest/server.py``): ``.item()`` / scalar ``np.asarray(x)[i]``
+        pulls inside per-hit loops, scalar casts of device pulls.
+  R003  dynamic-shape leaks: ``jnp.nonzero``/``unique``/``where(cond)``
+        without ``size=`` and boolean-mask indexing inside traced code;
+        un-annotated host ``np.nonzero``-family calls in ``ops/``.
+  R004  tracer leaks: Python ``if``/``while`` on traced arguments inside
+        jitted functions.
+  R005  lock discipline: mutation of shared state in threadpool-visible
+        modules (engine/translog/ivf_cache/threadpool) outside a
+        ``with <lock>`` block.
+
+Suppress a finding in place with ``# tpulint: allow[R00x]`` on the line
+(or an immediately preceding comment line); mark intentional host-side
+build code with ``# tpulint: host``. Grandfathered sites live in
+``tools/tpulint/baseline.json``.
+
+Run: ``python -m tools.tpulint [paths] [--json]``.
+
+``tools.tpulint.trace_audit`` is the runtime counterpart: it wraps
+``jax.jit`` to count (re)traces per callable and assert an upper bound,
+so benches and tests can prove steady-state means zero recompiles.
+"""
+from tools.tpulint.analyzer import (  # noqa: F401
+    RULES,
+    Violation,
+    lint_file,
+    lint_paths,
+    lint_source,
+)
+from tools.tpulint.baseline import (  # noqa: F401
+    DEFAULT_BASELINE,
+    filter_baselined,
+    load_baseline,
+    write_baseline,
+)

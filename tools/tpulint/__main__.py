@@ -1,0 +1,88 @@
+"""CLI: ``python -m tools.tpulint [paths] [--json] [--baseline FILE]``.
+
+Exit codes: 0 = clean (or all findings baselined), 1 = new violations,
+2 = usage/baseline error. Run from the repo root so reported paths match
+the baseline fingerprints.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.tpulint.analyzer import RULES, lint_paths
+
+# the directory that contains tools/ — reported paths and baseline
+# fingerprints are relative to it no matter where the CLI is invoked from
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from tools.tpulint.baseline import (
+    DEFAULT_BASELINE,
+    filter_baselined,
+    load_baseline,
+    write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="JAX/TPU-aware static analysis for elasticsearch_tpu "
+                    "(rules R001-R005; see docs/STATIC_ANALYSIS.md)")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to lint "
+                         "(default: the repo's elasticsearch_tpu package)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON document on stdout")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current finding set to --baseline "
+                         "and exit 0 (dev helper)")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.join(REPO_ROOT, "elasticsearch_tpu")]
+    try:
+        found = lint_paths(paths, root=REPO_ROOT)
+    except FileNotFoundError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        doc = write_baseline(found, args.baseline)
+        print(f"wrote {len(doc['violations'])} baseline entr"
+              f"{'y' if len(doc['violations']) == 1 else 'ies'} "
+              f"to {args.baseline}", file=sys.stderr)
+        return 0
+
+    try:
+        budget = load_baseline(args.baseline) if not args.no_baseline else {}
+    except ValueError as e:
+        print(f"tpulint: {e}", file=sys.stderr)
+        return 2
+    new, old = filter_baselined(found, budget)
+
+    if args.as_json:
+        print(json.dumps({
+            "rules": RULES,
+            "violations": [v.to_json() for v in new],
+            "baselined": [v.to_json() for v in old],
+            "counts": {"new": len(new), "baselined": len(old)},
+        }, indent=2))
+    else:
+        for v in new:
+            print(v.format())
+        if old:
+            print(f"({len(old)} grandfathered finding"
+                  f"{'' if len(old) == 1 else 's'} suppressed by "
+                  f"{args.baseline})", file=sys.stderr)
+        if new:
+            print(f"tpulint: {len(new)} violation"
+                  f"{'' if len(new) == 1 else 's'}", file=sys.stderr)
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

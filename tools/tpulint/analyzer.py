@@ -1,0 +1,184 @@
+"""tpulint core: file/source entry points, suppressions, violation type.
+
+The analysis is purely syntactic (stdlib ``ast``) so it runs in tier-1 CI
+with no JAX import and no device. Rules are calibrated to this codebase's
+idioms — ``@partial(jax.jit, static_argnames=...)`` program factories,
+host-side numpy build paths beside device-side jnp trace paths, and
+ES-style "public methods lock, ``_private`` helpers run caller-locked"
+concurrency discipline — documented in docs/STATIC_ANALYSIS.md.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional, Sequence, Set
+
+RULES: Dict[str, str] = {
+    "R001": "recompilation hazard (jit-in-loop / unhashable or "
+            "high-cardinality static argument)",
+    "R002": "host-device sync in a hot path",
+    "R003": "dynamic shape in traced code / un-annotated host build path",
+    "R004": "tracer leak (Python control flow on a traced value)",
+    "R005": "shared mutable state written without holding the lock",
+}
+
+# R002 scope: files whose per-query work sits on the request hot path.
+HOT_PATH_MARKERS = ("/ops/", "/search/", "/rest/server.py")
+# R003 host-annotation scope: device-op modules where an un-annotated
+# host numpy dynamic-shape call is ambiguous (build path or trace leak?).
+OPS_PATH_MARKERS = ("/ops/",)
+# R005 scope: modules whose state is mutated from utils.threadpool workers
+# (every REST request runs on a pool thread; these are the write targets).
+LOCKED_MODULE_MARKERS = (
+    "/index/engine.py",
+    "/index/translog.py",
+    "/index/ivf_cache.py",
+    "/utils/threadpool.py",
+)
+
+_ALLOW_RE = re.compile(r"#\s*tpulint:\s*allow\[\s*([A-Z0-9,\s]+?)\s*\]")
+_HOST_RE = re.compile(r"#\s*tpulint:\s*host\b")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str  # stripped source line — the baseline fingerprint
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+class Suppressions:
+    """Per-line ``# tpulint: allow[...]`` / ``# tpulint: host`` markers.
+
+    A marker on a violating line suppresses that line; a marker inside a
+    standalone comment block covers the rest of the block and the first
+    code line after it (so the justification can sit above the code).
+    ``host`` declares a statement as intentional host-side build code and
+    is equivalent to ``allow[R003]``.
+    """
+
+    def __init__(self, source: str):
+        self.allow: Dict[int, Set[str]] = {}
+        self.host: Set[int] = set()
+        lines = source.splitlines()
+        for i, text in enumerate(lines, start=1):
+            rules: Set[str] = set()
+            for m in _ALLOW_RE.finditer(text):
+                rules |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+            is_host = bool(_HOST_RE.search(text))
+            if is_host:
+                rules.add("R003")
+            if not rules:
+                continue
+            covered = [i]
+            if text.lstrip().startswith("#"):
+                # walk past the rest of the comment block (blank lines
+                # included) to the first code line
+                j = i + 1
+                while j <= len(lines) and (
+                        lines[j - 1].lstrip().startswith("#")
+                        or not lines[j - 1].strip()):
+                    covered.append(j)
+                    j += 1
+                covered.append(j)
+            for ln in covered:
+                self.allow.setdefault(ln, set()).update(rules)
+                if is_host:
+                    self.host.add(ln)
+
+    def suppressed(self, v: Violation) -> bool:
+        return v.rule in self.allow.get(v.line, ())
+
+
+def _matches(path: str, markers: Sequence[str]) -> bool:
+    p = "/" + path.replace(os.sep, "/").lstrip("/")
+    return any(m in p for m in markers)
+
+
+def lint_source(
+    source: str,
+    path: str = "<string>",
+    *,
+    hot: Optional[bool] = None,
+    ops: Optional[bool] = None,
+    locked: Optional[bool] = None,
+) -> List[Violation]:
+    """Lint one source string. ``hot``/``ops``/``locked`` override the
+    path-based scoping (fixture tests use these; production runs infer
+    from the path)."""
+    from tools.tpulint import rules as _rules
+
+    tree = ast.parse(source, filename=path)
+    supp = Suppressions(source)
+    lines = source.splitlines()
+    ctx = _rules.FileContext(
+        path=path,
+        lines=lines,
+        hot=_matches(path, HOT_PATH_MARKERS) if hot is None else hot,
+        ops=_matches(path, OPS_PATH_MARKERS) if ops is None else ops,
+        locked=_matches(path, LOCKED_MODULE_MARKERS) if locked is None else locked,
+        host_lines=supp.host,
+    )
+    found = _rules.check_module(tree, ctx)
+    return [v for v in found if not supp.suppressed(v)]
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Violation]:
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    # report paths relative to `root` for files under it (the baseline
+    # fingerprints on this form, so it must not depend on cwd or on
+    # absolute-vs-relative invocation); files elsewhere keep their path
+    rel = path
+    if root:
+        ap, ar = os.path.abspath(path), os.path.abspath(root)
+        if ap == ar or ap.startswith(ar + os.sep):
+            rel = os.path.relpath(ap, ar)
+    try:
+        return lint_source(source, rel.replace(os.sep, "/"))
+    except SyntaxError as e:
+        return [Violation("R000", rel, e.lineno or 0, e.offset or 0,
+                          f"syntax error: {e.msg}", "")]
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        if not os.path.isdir(p):
+            # a typo'd/renamed path must not silently lint zero files and
+            # report the gate green
+            raise FileNotFoundError(f"no such file or directory: {p}")
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__", ".git"))
+            out.extend(os.path.join(dirpath, f)
+                       for f in sorted(filenames) if f.endswith(".py"))
+    return out
+
+
+def lint_paths(paths: Sequence[str],
+               root: Optional[str] = None) -> List[Violation]:
+    found: List[Violation] = []
+    for f in iter_python_files(paths):
+        found.extend(lint_file(f, root=root))
+    return sorted(found, key=lambda v: (v.path, v.line, v.col, v.rule))
+
+
+def snippet_at(lines: Sequence[str], lineno: int) -> str:
+    if 1 <= lineno <= len(lines):
+        return lines[lineno - 1].strip()
+    return ""

@@ -1,0 +1,81 @@
+"""Grandfathered-violation baseline for tpulint.
+
+The baseline pins *specific* pre-existing findings so the CI gate can sit
+at zero new violations while old sites are worked off. Entries fingerprint
+by ``(rule, path, stripped source line)`` with an occurrence budget — NOT
+by line number, so unrelated edits above a grandfathered site don't churn
+the file. Every entry must carry a ``justification`` string; the gate
+refuses an unexplained baseline (an empty baseline needs no file at all).
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from typing import Dict, List, Sequence, Tuple
+
+from tools.tpulint.analyzer import Violation
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def _fingerprint(v: Violation) -> Tuple[str, str, str]:
+    return (v.rule, v.path, v.snippet)
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Counter:
+    """fingerprint -> allowed occurrence count. Missing file = empty."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    budget: Counter = Counter()
+    for entry in data.get("violations", []):
+        if not str(entry.get("justification", "")).strip():
+            raise ValueError(
+                f"baseline entry {entry.get('rule')} at {entry.get('path')} "
+                "has no justification — grandfathered sites must say why")
+        key = (entry["rule"], entry["path"], entry["snippet"])
+        budget[key] += int(entry.get("count", 1))
+    return budget
+
+
+def filter_baselined(
+    violations: Sequence[Violation], budget: Counter
+) -> Tuple[List[Violation], List[Violation]]:
+    """Split into (new, grandfathered). Budget is consumed per occurrence
+    in file order, so a grandfathered pattern that *multiplies* still
+    fails the gate."""
+    remaining = Counter(budget)
+    new: List[Violation] = []
+    old: List[Violation] = []
+    for v in violations:
+        key = _fingerprint(v)
+        if remaining[key] > 0:
+            remaining[key] -= 1
+            old.append(v)
+        else:
+            new.append(v)
+    return new, old
+
+
+def write_baseline(violations: Sequence[Violation], path: str,
+                   justification: str = "grandfathered at gate adoption") -> dict:
+    """Serialize the current finding set as the new baseline (dev helper
+    behind ``--write-baseline``; entries still need real justifications
+    before review)."""
+    grouped: Dict[Tuple[str, str, str], int] = Counter(
+        _fingerprint(v) for v in violations)
+    doc = {
+        "comment": "tpulint grandfathered violations — see "
+                   "docs/STATIC_ANALYSIS.md for the workflow",
+        "violations": [
+            {"rule": r, "path": p, "snippet": s, "count": c,
+             "justification": justification}
+            for (r, p, s), c in sorted(grouped.items())
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+    return doc

@@ -1,0 +1,578 @@
+"""tpulint rule visitors (R001–R005).
+
+One recursive walk per file carries the context every rule needs: the
+loop stack (R001/R002), the traced-function stack with its static/traced
+parameter split (R003/R004), and the lock-held stack (R005). A module
+pre-pass first resolves import aliases (``jnp``/``np``/``jax``), the
+module's jitted callables with their ``static_argnames``, and — for
+lock-disciplined modules — the module/instance lock names and the shared
+mutable globals they guard.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+from tools.tpulint.analyzer import Violation, snippet_at
+
+# Dynamic-shape producers: output size depends on input *values*.
+DYNAMIC_SHAPE_FNS = {"nonzero", "flatnonzero", "argwhere", "unique"}
+# Container-mutating method names used for shared-state write detection.
+MUTATOR_METHODS = {
+    "append", "add", "update", "pop", "popitem", "clear", "remove",
+    "extend", "insert", "setdefault", "discard", "appendleft",
+}
+MUTABLE_FACTORIES = {"dict", "list", "set", "OrderedDict", "defaultdict",
+                     "deque", "Counter"}
+
+
+@dataclass
+class FileContext:
+    path: str
+    lines: Sequence[str]
+    hot: bool = False      # R002 applies
+    ops: bool = False      # R003 host-annotation check applies
+    locked: bool = False   # R005 applies
+    host_lines: Set[int] = field(default_factory=set)
+
+
+@dataclass
+class JitTarget:
+    """A callable known to be jitted, with its static parameter names."""
+    statics: Set[str] = field(default_factory=set)
+
+
+# ---------------------------------------------------------------------------
+# small AST helpers
+# ---------------------------------------------------------------------------
+
+def _name(node: ast.AST) -> Optional[str]:
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name for Name/Attribute chains ('jax.numpy', 'self._lock')."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_none(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+def _const_str_seq(node: ast.AST) -> Set[str]:
+    """Static-argnames value → the set of names it declares."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return {e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)}
+    return set()
+
+
+def _param_names(fn: ast.AST) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in getattr(a, "posonlyargs", [])]
+    names += [p.arg for p in a.args]
+    names += [p.arg for p in a.kwonlyargs]
+    return names
+
+
+class _ModuleInfo:
+    """Pre-pass over the module body: aliases, jitted callables, locks."""
+
+    def __init__(self, tree: ast.Module):
+        self.jax: Set[str] = set()
+        self.jnp: Set[str] = set()
+        self.np: Set[str] = set()
+        self.jit_names: Set[str] = set()      # `from jax import jit [as j]`
+        self.partial_names: Set[str] = set()  # functools.partial aliases
+        self.jitted: Dict[str, JitTarget] = {}
+        self.wrapped_fns: Set[str] = set()    # g in `f = jax.jit(g)`
+        self.module_locks: Set[str] = set()
+        self.shared_globals: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    bound = al.asname or al.name.split(".")[0]
+                    if al.name == "jax":
+                        self.jax.add(bound)
+                    elif al.name == "jax.numpy":
+                        # unaliased `import jax.numpy` is referenced as
+                        # `jax.numpy.<fn>` — the dotted module IS the alias
+                        self.jnp.add(al.asname or "jax.numpy")
+                    elif al.name == "numpy":
+                        self.np.add(bound)
+                    elif al.name == "functools":
+                        self.partial_names.add(f"{bound}.partial")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for al in node.names:
+                        if al.name == "jit":
+                            self.jit_names.add(al.asname or "jit")
+                        if al.name == "numpy":
+                            self.jnp.add(al.asname or "numpy")
+                elif node.module == "functools":
+                    for al in node.names:
+                        if al.name == "partial":
+                            self.partial_names.add(al.asname or "partial")
+                elif node.module == "jax.numpy":
+                    pass  # `from jax.numpy import X` — per-symbol, skip
+        # second sweep needs the aliases resolved first
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and self.is_jit_expr(node):
+                for arg in node.args[:1]:
+                    nm = _name(arg)
+                    if nm:
+                        self.wrapped_fns.add(nm)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                statics = self.decorator_jit(node)
+                if statics is not None:
+                    self.jitted[node.name] = JitTarget(set(statics))
+        for stmt in tree.body:
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                tgt = _name(stmt.targets[0])
+                if not tgt:
+                    continue
+                val = stmt.value
+                if isinstance(val, ast.Call):
+                    chain = _attr_chain(val.func) or ""
+                    if chain.endswith((".Lock", ".RLock")) or chain in (
+                            "Lock", "RLock"):
+                        self.module_locks.add(tgt)
+                        continue
+                    if self.is_jit_expr(val):
+                        self.jitted[tgt] = JitTarget(self.jit_statics(val))
+                        continue
+                    fname = chain.rpartition(".")[2]
+                    if fname in MUTABLE_FACTORIES:
+                        self.shared_globals.add(tgt)
+                elif isinstance(val, (ast.Dict, ast.List, ast.Set,
+                                      ast.DictComp, ast.ListComp,
+                                      ast.SetComp)):
+                    self.shared_globals.add(tgt)
+
+    # -- jit expression recognition -----------------------------------------
+
+    def _is_bare_jit(self, node: ast.AST) -> bool:
+        chain = _attr_chain(node)
+        if chain in self.jit_names:
+            return True
+        return bool(chain) and "." in chain and \
+            chain.split(".")[0] in self.jax and chain.endswith(".jit")
+
+    def is_jit_expr(self, call: ast.Call) -> bool:
+        """True for `jax.jit(...)` and `partial(jax.jit, ...)` calls."""
+        if self._is_bare_jit(call.func):
+            return True
+        chain = _attr_chain(call.func)
+        if (chain in self.partial_names or chain == "partial") and call.args:
+            return self._is_bare_jit(call.args[0])
+        return False
+
+    def jit_statics(self, call: ast.Call) -> Set[str]:
+        statics: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                statics |= _const_str_seq(kw.value)
+        return statics
+
+    def decorator_jit(self, fn) -> Optional[Set[str]]:
+        """Static names when `fn` carries a jit decorator, else None."""
+        for dec in fn.decorator_list:
+            if self._is_bare_jit(dec):
+                return set()
+            if isinstance(dec, ast.Call) and self.is_jit_expr(dec):
+                statics = self.jit_statics(dec)
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnums":
+                        params = _param_names(fn)
+                        nums = kw.value
+                        idxs = [e.value for e in getattr(nums, "elts", [nums])
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, int)]
+                        statics |= {params[i] for i in idxs
+                                    if 0 <= i < len(params)}
+                return statics
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the walk
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _TracedCtx:
+    fn_name: str
+    traced: Set[str]
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext, mod: _ModuleInfo):
+        self.ctx = ctx
+        self.mod = mod
+        self.out: List[Violation] = []
+        self.loop_depth = 0            # For/While (R001 jit-in-loop)
+        self.iter_depth = 0            # + comprehensions (R002 per-hit)
+        self.traced_stack: List[_TracedCtx] = []
+        self.lock_depth = 0            # inside `with <known lock>`
+        self.class_stack: List[str] = []
+        self.class_locks: Dict[str, Set[str]] = {}  # class -> self lock attrs
+        self.fn_stack: List[str] = []
+
+    # -- emit ----------------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 0)
+        self.out.append(Violation(rule, self.ctx.path, line,
+                                  getattr(node, "col_offset", 0), message,
+                                  snippet_at(self.ctx.lines, line)))
+
+    # -- structure visitors --------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if self.ctx.locked:
+            locks: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    chain = _attr_chain(sub.targets[0]) or ""
+                    if chain.startswith("self.") and isinstance(
+                            sub.value, ast.Call):
+                        vchain = _attr_chain(sub.value.func) or ""
+                        if vchain.endswith((".Lock", ".RLock")) or \
+                                vchain in ("Lock", "RLock"):
+                            locks.add(chain[len("self."):])
+            self.class_locks[node.name] = locks
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        statics = self.mod.decorator_jit(node)
+        wrapped = node.name in self.mod.wrapped_fns
+        entering_trace = statics is not None or wrapped or bool(
+            self.traced_stack)
+        if entering_trace:
+            traced = set(_param_names(node)) - (statics or set())
+            if self.traced_stack:  # nested def inherits the outer view
+                traced |= self.traced_stack[-1].traced
+            self.traced_stack.append(_TracedCtx(node.name, traced))
+        if (statics is not None or wrapped) and self.loop_depth:
+            self._emit("R001", node,
+                       f"jitted function `{node.name}` is (re)defined inside "
+                       "a loop — every iteration builds a fresh callable and "
+                       "retraces; hoist the jit out of the loop")
+        self.fn_stack.append(node.name)
+        # loop/iter context does not cross a function boundary
+        saved = (self.loop_depth, self.iter_depth)
+        self.loop_depth = self.iter_depth = 0
+        self.generic_visit(node)
+        self.loop_depth, self.iter_depth = saved
+        self.fn_stack.pop()
+        if entering_trace:
+            self.traced_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        if self.traced_stack:
+            traced = set(_param_names(node)) | self.traced_stack[-1].traced
+            self.traced_stack.append(_TracedCtx("<lambda>", traced))
+            self.generic_visit(node)
+            self.traced_stack.pop()
+        else:
+            self.generic_visit(node)
+
+    def _visit_loop(self, node) -> None:
+        self.loop_depth += 1
+        self.iter_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+        self.iter_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_control_flow(node)
+        self._visit_loop(node)
+
+    def _visit_comp(self, node) -> None:
+        self.iter_depth += 1
+        self.generic_visit(node)
+        self.iter_depth -= 1
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+    def visit_With(self, node: ast.With) -> None:
+        holds = any(self._is_lock_expr(item.context_expr)
+                    for item in node.items)
+        if holds:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if holds:
+            self.lock_depth -= 1
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_control_flow(node)
+        self.generic_visit(node)
+
+    # -- R004 ---------------------------------------------------------------
+
+    def _check_control_flow(self, node) -> None:
+        if not self.traced_stack:
+            return
+        traced = self.traced_stack[-1].traced
+        test = node.test
+        # `x is None` / `x is not None` switches on pytree *structure*
+        # (resolved at trace time), not on a traced value — allowed.
+        if isinstance(test, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops) \
+                and (_is_none(test.left)
+                     or all(_is_none(c) for c in test.comparators)):
+            return
+        hits = sorted({n.id for n in ast.walk(test)
+                       if isinstance(n, ast.Name) and n.id in traced})
+        if hits:
+            kind = "while" if isinstance(node, ast.While) else "if"
+            self._emit("R004", node,
+                       f"Python `{kind}` on traced value(s) "
+                       f"{', '.join(hits)} inside jitted "
+                       f"`{self.traced_stack[-1].fn_name}` — this reads a "
+                       "tracer as a bool (use jnp.where / lax.cond, or "
+                       "declare the argument in static_argnames)")
+
+    # -- R001 / R002 / R003 call+subscript checks ---------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        mod = self.mod
+        if mod.is_jit_expr(node) and self.loop_depth:
+            self._emit("R001", node,
+                       "jax.jit(...) constructed inside a loop — the program "
+                       "cache keys on callable identity, so every iteration "
+                       "recompiles; build once outside and reuse")
+        self._check_static_call_args(node)
+        self._check_sync(node)
+        self._check_dynamic_shapes(node)
+        self.generic_visit(node)
+
+    def _check_static_call_args(self, node: ast.Call) -> None:
+        target = self.mod.jitted.get(_name(node.func) or "")
+        if target is None or not target.statics:
+            return
+        for kw in node.keywords:
+            if kw.arg not in target.statics:
+                continue
+            if isinstance(kw.value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp, ast.SetComp,
+                                     ast.GeneratorExp)):
+                self._emit("R001", kw.value,
+                           f"unhashable value passed to static argument "
+                           f"`{kw.arg}` of jitted `{_name(node.func)}` — "
+                           "jit static args must be hashable (use a tuple "
+                           "or frozenset)")
+            elif isinstance(kw.value, ast.Call) and \
+                    _name(kw.value.func) == "len":
+                self._emit("R001", kw.value,
+                           f"raw len(...) passed to static argument "
+                           f"`{kw.arg}` of jitted `{_name(node.func)}` — "
+                           "every distinct size compiles a new program; "
+                           "bucket it first (utils.shapes.pow2_bucket)")
+
+    # -- R002 ---------------------------------------------------------------
+
+    def _is_host_pull(self, node: ast.AST) -> bool:
+        """Call that moves a device array to host (np.asarray/np.array/
+        jax.device_get)."""
+        if not isinstance(node, ast.Call):
+            return False
+        chain = _attr_chain(node.func) or ""
+        head, _, fn = chain.rpartition(".")
+        if head in self.mod.np and fn in ("asarray", "array"):
+            return True
+        return head in self.mod.jax and fn == "device_get"
+
+    @staticmethod
+    def _is_scalar_index(sl: ast.AST) -> bool:
+        if isinstance(sl, ast.Slice):
+            return False
+        if isinstance(sl, ast.Tuple):
+            return all(not isinstance(e, ast.Slice) for e in sl.elts)
+        return True
+
+    def _check_sync(self, node: ast.Call) -> None:
+        if not self.ctx.hot:
+            return
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not node.args and not node.keywords:
+            if self.traced_stack:
+                self._emit("R002", node,
+                           ".item() inside jitted "
+                           f"`{self.traced_stack[-1].fn_name}` — a traced "
+                           "value has no concrete scalar (trace-time "
+                           "error); keep it an array and pull on host "
+                           "after the program returns")
+            elif self.iter_depth:
+                self._emit("R002", node,
+                           ".item() inside a loop is one blocking device "
+                           "sync per iteration — pull the whole array to "
+                           "host once before the loop")
+        if _name(f) in ("int", "float", "bool") and len(node.args) == 1:
+            arg = node.args[0]
+            if isinstance(arg, ast.Subscript) and \
+                    self._is_host_pull(arg.value) and \
+                    self._is_scalar_index(arg.slice):
+                self._emit("R002", node,
+                           f"{_name(f)}(np.asarray(...)[i]) transfers a "
+                           "device array to pull one scalar — hoist the "
+                           "host copy and index it instead")
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if self.ctx.hot and self.iter_depth and \
+                self._is_host_pull(node.value) and \
+                self._is_scalar_index(node.slice):
+            self._emit("R002", node,
+                       "scalar index into np.asarray(...) inside a loop — "
+                       "one full device→host transfer per iteration; copy "
+                       "to host once before the loop")
+        if self.traced_stack:
+            sl = node.slice
+            masky = isinstance(sl, (ast.Compare, ast.BoolOp)) or (
+                isinstance(sl, ast.UnaryOp) and isinstance(sl.op, ast.Not))
+            if masky:
+                self._emit("R003", node,
+                           "boolean-mask indexing inside jitted "
+                           f"`{self.traced_stack[-1].fn_name}` yields a "
+                           "data-dependent shape — use jnp.where(mask, x, "
+                           "fill) or size=-bounded jnp.nonzero")
+        self.generic_visit(node)
+
+    # -- R003 ---------------------------------------------------------------
+
+    def _check_dynamic_shapes(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func) or ""
+        head, _, fn = chain.rpartition(".")
+        has_size = any(kw.arg == "size" for kw in node.keywords)
+        if self.traced_stack and head in self.mod.jnp:
+            if fn in DYNAMIC_SHAPE_FNS and not has_size:
+                self._emit("R003", node,
+                           f"jnp.{fn} without size= inside jitted "
+                           f"`{self.traced_stack[-1].fn_name}` — the result "
+                           "shape depends on data; pass size= (+ fill_value) "
+                           "to keep the program statically shaped")
+            elif fn == "where" and len(node.args) == 1:
+                self._emit("R003", node,
+                           "single-argument jnp.where inside jitted "
+                           f"`{self.traced_stack[-1].fn_name}` returns "
+                           "data-dependent indices — use the three-argument "
+                           "form or size=-bounded jnp.nonzero")
+        elif self.ctx.ops and not self.traced_stack \
+                and head in self.mod.np and fn in DYNAMIC_SHAPE_FNS:
+            if node.lineno not in self.ctx.host_lines:
+                self._emit("R003", node,
+                           f"np.{fn} in a device-op module: dynamic-shape "
+                           "host call is ambiguous next to traced code — "
+                           "annotate the line `# tpulint: host` (build path) "
+                           "or move to a size=-bounded device form")
+
+    # -- R005 ---------------------------------------------------------------
+
+    def _is_lock_expr(self, expr: ast.AST) -> bool:
+        nm = _name(expr)
+        if nm and nm in self.mod.module_locks:
+            return True
+        chain = _attr_chain(expr) or ""
+        if chain.startswith("self.") and self.class_stack:
+            return chain[len("self."):] in self.class_locks.get(
+                self.class_stack[-1], set())
+        return False
+
+    def _shared_target_root(self, node: ast.AST) -> Optional[str]:
+        """'self.X' / module-global name when `node` resolves to shared
+        state owned by a lock in this file, else None."""
+        base = node
+        while isinstance(base, (ast.Subscript, ast.Attribute)):
+            if isinstance(base, ast.Attribute) and _name(base.value) == "self":
+                if self.class_stack and self.class_locks.get(
+                        self.class_stack[-1]):
+                    return f"self.{base.attr}"
+                return None
+            base = base.value
+        nm = _name(base)
+        if nm and nm in self.mod.shared_globals and self.mod.module_locks:
+            # plain Name target only counts when it is the *container being
+            # mutated* (subscript/del) or rebound via `global`
+            return nm
+        return None
+
+    def _in_exempt_method(self) -> bool:
+        """__init__/__new__ build unshared state; `_private` helpers follow
+        the codebase's caller-holds-the-lock convention (see engine.py's
+        `_remove_existing`, called under `index()`'s lock)."""
+        if not self.fn_stack:
+            return True  # module level runs at import, single-threaded
+        name = self.fn_stack[0] if not self.class_stack else self.fn_stack[-1]
+        if self.class_stack:
+            return name in ("__init__", "__new__") or (
+                name.startswith("_") and not name.startswith("__"))
+        return False
+
+    def _check_mutation(self, node: ast.AST, root: Optional[str]) -> None:
+        if not self.ctx.locked or root is None or self.lock_depth \
+                or self._in_exempt_method():
+            return
+        owner = (f"class `{self.class_stack[-1]}`" if self.class_stack
+                 else "this module")
+        self._emit("R005", node,
+                   f"`{root}` is shared mutable state of {owner} (accessed "
+                   "from threadpool workers) written without holding its "
+                   "lock — wrap in `with <lock>:` or move into a "
+                   "caller-locked `_private` helper")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self.ctx.locked:
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    self._check_mutation(tgt, self._shared_target_root(tgt))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if self.ctx.locked and isinstance(node.target,
+                                          (ast.Attribute, ast.Subscript)):
+            self._check_mutation(node.target,
+                                 self._shared_target_root(node.target))
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        if self.ctx.locked:
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Attribute, ast.Subscript)):
+                    self._check_mutation(tgt, self._shared_target_root(tgt))
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        if self.ctx.locked and isinstance(node.value, ast.Call):
+            f = node.value.func
+            if isinstance(f, ast.Attribute) and f.attr in MUTATOR_METHODS:
+                self._check_mutation(node.value,
+                                     self._shared_target_root(f.value))
+        self.generic_visit(node)
+
+
+def check_module(tree: ast.Module, ctx: FileContext) -> List[Violation]:
+    mod = _ModuleInfo(tree)
+    checker = _Checker(ctx, mod)
+    checker.visit(tree)
+    return checker.out
