@@ -12,6 +12,25 @@ import json
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+
+class ApiError(ElasticsearchTpuException):
+    """An HTTP-mode error with the server's error TYPE and status intact,
+    so callers can branch on `e.error_type == "engine_failed_exception"`
+    (a failed-closed engine, 503) vs a routing 404 the same way in-process
+    embedders catch typed exceptions. Note partial shard failures are NOT
+    errors: a degraded `_search` returns HTTP 200 with `_shards.failed>0`
+    and `_shards.failures[]` — inspect the response, nothing raises."""
+
+    def __init__(self, msg: str, error_type: str, status: int):
+        super().__init__(msg)
+        self._remote_type = error_type
+        self.status = status
+
+    @property
+    def error_type(self) -> str:  # the base derives it from the class name
+        return self._remote_type
 
 
 class Client:
@@ -43,11 +62,10 @@ class Client:
         except urllib.error.HTTPError as e:
             payload = e.read()
             err = json.loads(payload) if payload else {"status": e.code}
-            from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
-
-            exc = ElasticsearchTpuException(json.dumps(err.get("error", err)))
-            exc.status = e.code
-            raise exc
+            detail = err.get("error", err)
+            err_type = (detail.get("type", "exception")
+                        if isinstance(detail, dict) else "exception")
+            raise ApiError(json.dumps(detail), err_type, e.code)
 
     # -- document APIs ---------------------------------------------------------
 

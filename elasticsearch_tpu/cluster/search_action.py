@@ -37,6 +37,7 @@ from elasticsearch_tpu.cluster.transport import TransportError
 from elasticsearch_tpu.utils import wire
 from elasticsearch_tpu.utils.errors import (ElasticsearchTpuException,
                                             IndexNotFoundException)
+from elasticsearch_tpu.utils.faults import FAULTS
 
 ACTION_QUERY = "indices:data/read/search[phase/query]"
 ACTION_FETCH = "indices:data/read/search[phase/fetch]"
@@ -63,6 +64,29 @@ ACTION_BY_QUERY = "indices:data/write/by_query"
 ACTION_REST_PROXY = "internal:rest/proxy"
 
 _CONTEXT_TTL = 120.0
+# coordinator-side cap on one search's scatter+fetch wall time when the
+# request body carries no explicit `timeout`
+_SEARCH_DEADLINE = 30.0
+
+
+def shard_failure_entry(index: str, sid: int, exc: Optional[Exception] = None,
+                        node: Optional[str] = None,
+                        error_type: Optional[str] = None,
+                        reason: Optional[str] = None,
+                        status: Optional[int] = None) -> dict:
+    """One `_shards.failures[]` element, ES-shaped (reference:
+    ShardSearchFailure.toXContent): names the shard, the node, the HTTP
+    status, and a typed `reason` so clients can distinguish a dead peer
+    (connect_transport_error) from a per-shard execution error."""
+    if exc is not None:
+        error_type = error_type or getattr(exc, "error_type",
+                                           type(exc).__name__)
+        reason = reason or str(exc)
+        status = status or getattr(exc, "status", 500)
+    return {"shard": sid, "index": index, "node": node,
+            "status": status or 500,
+            "reason": {"type": error_type or "exception",
+                       "reason": reason or ""}}
 
 
 class DistributedDataService:
@@ -147,6 +171,18 @@ class DistributedDataService:
               timeout: float = 30.0) -> Any:
         return self.cluster.transport.send_remote(
             self._addr(node_id), action, payload, timeout=timeout)
+
+    def _send_idempotent(self, node_id: str, action: str, payload: dict,
+                         timeout: float = 30.0,
+                         deadline: Optional[float] = None) -> Any:
+        """Retrying send for IDEMPOTENT actions (query/fetch/get):
+        transport-level failures back off and retry inside the caller's
+        deadline, and the per-peer breaker fast-fails a node that just
+        refused repeatedly instead of burning the deadline on it again
+        (cluster/transport.py::send_with_retry)."""
+        return self.cluster.transport.send_with_retry(
+            self._addr(node_id), action, payload, timeout=timeout,
+            deadline=deadline)
 
     # -- admin ---------------------------------------------------------------
 
@@ -1001,9 +1037,11 @@ class DistributedDataService:
             return self.node.indices[index].get_doc(
                 doc_id, routing=routing, realtime=realtime,
                 with_meta=with_meta)
-        return self._send(owner, ACTION_GET,
-                          {"index": index, "id": doc_id, "routing": routing,
-                           "realtime": realtime, "meta": with_meta})
+        # realtime get is idempotent: transport flakes retry with backoff
+        return self._send_idempotent(
+            owner, ACTION_GET,
+            {"index": index, "id": doc_id, "routing": routing,
+             "realtime": realtime, "meta": with_meta}, timeout=10.0)
 
     def _on_get(self, payload: dict) -> dict:
         return self.node.indices[payload["index"]].get_doc(
@@ -1183,6 +1221,8 @@ class DistributedDataService:
         version, type/parent/routing meta) — RecoverySourceHandler's
         phase-1 stream in ops form. Concurrent writes during the copy win
         on the target via version comparison (phase 2 for free)."""
+        FAULTS.check("recovery.shard_sync", index=payload["index"],
+                     shard=payload["shard"])
         engine = self.node.indices[payload["index"]] \
             .shards[payload["shard"]].engine
         with engine._lock:
@@ -1347,13 +1387,22 @@ class DistributedDataService:
         for sid in range(meta["num_shards"]):
             owners = meta["assignment"][str(sid)]
             if not owners:
-                unassigned.append({"shard": sid,
-                                   "reason": "no active copies"})
+                unassigned.append(shard_failure_entry(
+                    index, sid, error_type="unavailable_shards_exception",
+                    reason="no active copies", status=503))
                 continue
             by_owner.setdefault(owners[0], []).append(sid)
         sort_spec = _parse_sort(body.get("sort"))
         size = int(body.get("size", 10))
         frm = int(body.get("from", 0))
+        # per-shard query/fetch deadline: the body `timeout` (which the
+        # shards also apply to their collect loops) caps the COORDINATOR'S
+        # total scatter+fetch wall time; without one, a default stops a
+        # hung peer from wedging the search forever
+        from elasticsearch_tpu.search.service import _parse_timeout
+
+        deadline = time.monotonic() + (_parse_timeout(body.get("timeout"))
+                                       or _SEARCH_DEADLINE)
 
         entries: List[dict] = []
         agg_lists: List[dict] = []
@@ -1372,8 +1421,18 @@ class DistributedDataService:
             for owner, sids in sorted(by_owner.items()):
                 if owner == local_id:
                     for sid in sids:
-                        searcher = svc.groups[sid].reader().searcher
-                        r = searcher.query_phase(body)
+                        try:
+                            searcher = svc.groups[sid].reader().searcher
+                            r = searcher.query_phase(body)
+                        except Exception as e:
+                            # a single bad local shard degrades to a
+                            # partial result, same as a dead peer's —
+                            # broad on purpose: the remote path catches
+                            # ANY failure, and shard placement must not
+                            # change whether degradation happens
+                            failed.append(shard_failure_entry(
+                                index, sid, e, node=owner))
+                            continue
                         total += r.total_hits
                         if r.docs and not np.isnan(r.max_score):
                             max_score = max(max_score, r.max_score)
@@ -1389,12 +1448,14 @@ class DistributedDataService:
                             agg_lists.extend(r.agg_partials["_list"])
                     continue
                 try:
-                    res = self._send(owner, ACTION_QUERY,
-                                     {"index": index, "body": body,
-                                      "shards": sids})
+                    res = self._send_idempotent(
+                        owner, ACTION_QUERY,
+                        {"index": index, "body": body, "shards": sids},
+                        deadline=deadline)
                 except Exception as e:
-                    failed.extend({"shard": sid, "node": owner,
-                                   "reason": str(e)} for sid in sids)
+                    failed.extend(shard_failure_entry(index, sid, e,
+                                                      node=owner)
+                                  for sid in sids)
                     continue
                 remote_ctx[owner] = res["context_id"]
                 for sh in res["shards"]:
@@ -1414,8 +1475,12 @@ class DistributedDataService:
                 if res.get("aggs") is not None:
                     agg_lists.extend(wire.unpack(res["aggs"]))
             if failed and len(failed) == meta["num_shards"]:
+                # graceful degradation has a floor: NOTHING answered, so
+                # there is no partial result to serve (reference:
+                # SearchPhaseExecutionException "all shards failed")
                 raise TransportError(
-                    f"all shards failed: {[f['reason'] for f in failed]}")
+                    "all shards failed: "
+                    f"{[f['reason']['reason'] for f in failed]}")
 
             if sort_spec:
                 entries.sort(key=lambda e: _sort_key(e["sort"], sort_spec))
@@ -1435,10 +1500,26 @@ class DistributedDataService:
                 if e["local"] is None:
                     by_remote.setdefault(e["owner"], []).append(i)
             for owner, idxs in by_remote.items():
-                hits = self._send(
-                    owner, ACTION_FETCH,
-                    {"context_id": remote_ctx.pop(owner),
-                     "positions": [page[i]["pos"] for i in idxs]})
+                try:
+                    hits = self._send_idempotent(
+                        owner, ACTION_FETCH,
+                        {"context_id": remote_ctx[owner],
+                         "positions": [page[i]["pos"] for i in idxs]},
+                        deadline=deadline)
+                except Exception as e:
+                    # an owner that died BETWEEN query and fetch: its
+                    # page hits drop, its shards are reported failed, the
+                    # rest of the page still serves (reference: fetch-
+                    # phase ShardSearchFailure accounting). Drop its
+                    # context from the free list too — the finally's
+                    # synchronous free would block the response on the
+                    # same dead peer; the owner's TTL pruning collects it
+                    remote_ctx.pop(owner, None)
+                    for sid in sorted({page[i]["shard"] for i in idxs}):
+                        failed.append(shard_failure_entry(index, sid, e,
+                                                          node=owner))
+                    continue
+                remote_ctx.pop(owner, None)  # served: nothing to free
                 for i, h in zip(idxs, hits):
                     hit_of[i] = h
         finally:
@@ -1447,6 +1528,10 @@ class DistributedDataService:
             self._free_remote(remote_ctx)
             remote_ctx.clear()
 
+        # a deadline blown mid-scatter/fetch surfaces as timed_out=true
+        # ONLY when it degraded something (failure entries exist) — a
+        # slow-but-complete search is complete, not timed out
+        timed_out |= bool(failed) and time.monotonic() > deadline
         response: Dict[str, Any] = {
             "took": int((time.perf_counter() - t0) * 1000),
             "timed_out": timed_out,
@@ -1457,7 +1542,10 @@ class DistributedDataService:
                 "total": total,
                 "max_score": (None if (max_score == float("-inf")
                                        or sort_spec) else max_score),
-                "hits": [hit_of[i] for i in range(len(page))],
+                # fetch-failed owners' hits are absent from hit_of: the
+                # page compacts around them (partial results, not holes)
+                "hits": [hit_of[i] for i in range(len(page))
+                         if i in hit_of],
             },
         }
         if failed:

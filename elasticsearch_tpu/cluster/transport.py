@@ -15,18 +15,53 @@ traffic is low-rate, so simplicity beats pooling here).
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import struct
 import threading
-from typing import Any, Callable, Dict, Optional, Tuple
+import time
+import zlib
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
 from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+from elasticsearch_tpu.utils.faults import FAULTS
 
 
 class TransportError(ElasticsearchTpuException):
     status = 500
     error_type = "transport_error"
+
+
+class ConnectTransportError(TransportError):
+    """The connection could never be established (refused, unreachable,
+    connect timeout). The request was NEVER handed to the peer, so a
+    retry is safe for ANY action — idempotent or not (reference:
+    transport/ConnectTransportError.java; retry-on-connect is the one
+    universally safe transport retry). ``timed_out`` distinguishes a
+    connect TIMEOUT (budget-sensitive) from an instant refusal."""
+
+    status = 503
+    error_type = "connect_transport_error"
+    timed_out = False
+
+
+class ReceiveTimeoutTransportError(TransportError):
+    """The request was sent but no response arrived in time. The peer MAY
+    have executed it, so only idempotent actions may retry (reference:
+    transport/ReceiveTimeoutTransportError.java)."""
+
+    status = 503
+    error_type = "receive_timeout_transport_error"
+
+
+class NodeUnavailableException(TransportError):
+    """The per-peer breaker is open: the node failed repeatedly and is
+    being skipped for a cooldown window — fail fast instead of burning
+    the caller's deadline on a peer that just refused N times."""
+
+    status = 503
+    error_type = "node_unavailable_exception"
 
 
 class RemoteException(TransportError):
@@ -49,6 +84,89 @@ class RemoteException(TransportError):
 
 
 Handler = Callable[[dict], Any]
+
+
+class BackoffPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    Reference: action/bulk/BackoffPolicy.java (exponential, iterator of
+    delays). Jitter draws from ``random.Random`` seeded by (seed, salt)
+    — fully reproducible in chaos tests, while distinct nodes (seed =
+    node-id hash) and distinct (peer, action) salts de-correlate retry
+    schedules in production instead of synchronizing the herd.
+    """
+
+    def __init__(self, base: float = 0.05, multiplier: float = 2.0,
+                 max_delay: float = 1.0, jitter: float = 0.5,
+                 seed: int = 0):
+        self.base = base
+        self.multiplier = multiplier
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self.seed = seed
+
+    def delays(self, retries: int,
+               salt: Optional[str] = None) -> Iterator[float]:
+        seed = self.seed
+        if salt is not None:
+            # crc32, not hash(): str hashing is salted per process and
+            # would break replay determinism
+            seed = zlib.crc32(f"{self.seed}|{salt}".encode())
+        rng = random.Random(seed)
+        for attempt in range(retries):
+            raw = min(self.base * (self.multiplier ** attempt),
+                      self.max_delay)
+            # jitter shrinks the delay only (never past max_delay, never
+            # below (1-jitter)*raw) — full-jitter style, bounded
+            yield raw * (1.0 - self.jitter * rng.random())
+
+
+class PeerBreaker:
+    """Per-peer circuit breaker: after ``threshold`` consecutive
+    failures a peer is skipped for ``cooldown`` seconds, then one probe
+    is let through (half-open) — success closes the breaker, failure
+    re-opens it for another window. Keeps a flapping node from stalling
+    every scatter on its connect timeout (reference: the
+    NodesFaultDetection + retry-skip behavior of the coordinator)."""
+
+    def __init__(self, threshold: int = 3, cooldown: float = 5.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self._clock = clock
+        self._lock = threading.Lock()
+        # peer key -> [consecutive failures, open_until, probe_granted_at]
+        self._peers: Dict[Any, list] = {}
+
+    def allow(self, peer: Any) -> bool:
+        with self._lock:
+            st = self._peers.get(peer)
+            if st is None or st[0] < self.threshold:
+                return True
+            now = self._clock()
+            if now >= st[1]:
+                # half-open: one probe per cooldown window. The grant is
+                # TIMESTAMPED, not a latch — a probe whose caller died
+                # before reporting (deadline abort, crash) expires after
+                # another cooldown instead of blacklisting the peer for
+                # the life of the process.
+                if st[2] is not None and now - st[2] < self.cooldown:
+                    return False  # a recent probe is (or was) in flight
+                st[2] = now       # this caller is the probe
+                return True
+            return False
+
+    def record_failure(self, peer: Any) -> None:
+        with self._lock:
+            st = self._peers.setdefault(peer, [0, 0.0, None])
+            st[0] += 1
+            st[2] = None
+            if st[0] >= self.threshold:
+                st[1] = self._clock() + self.cooldown
+
+    def record_success(self, peer: Any) -> None:
+        with self._lock:
+            self._peers.pop(peer, None)
 
 
 def _send_frame(sock: socket.socket, obj: dict) -> None:
@@ -86,6 +204,9 @@ class TransportService:
         self.local_node_id = local_node_id
         self._handlers: Dict[str, Handler] = {}
         self._server: Optional["TcpTransportServer"] = None
+        self.breaker = PeerBreaker()
+        # node-id-derived seed: each node jitters its retries differently
+        self.backoff = BackoffPolicy(seed=zlib.crc32(local_node_id.encode()))
 
     def register(self, action: str, handler: Handler) -> None:
         self._handlers[action] = handler
@@ -110,9 +231,46 @@ class TransportService:
 
     def send_remote(self, address: Tuple[str, int], action: str,
                     payload: dict, timeout: float = 5.0) -> Any:
-        with socket.create_connection(address, timeout=timeout) as sock:
-            _send_frame(sock, {"action": action, "payload": payload})
-            resp = _recv_frame(sock)
+        """One request/response round. Failures are TYPED by phase so
+        retry logic can tell them apart: a connect-phase failure
+        (ConnectTransportError) never reached the peer and is always
+        retry-safe; a failure after the request frame went out
+        (ReceiveTimeoutTransportError / TransportError) may have
+        executed and only idempotent actions may retry."""
+        t0 = time.monotonic()
+        try:
+            # the injected fault rides the same wrapping as a real
+            # connect failure: an OSError here becomes a typed
+            # ConnectTransportError either way
+            FAULTS.check("transport.send", action=action, address=address)
+            sock = socket.create_connection(address, timeout=timeout)
+        except socket.timeout as e:
+            err = ConnectTransportError(
+                f"connect to {address} timed out after {timeout}s "
+                f"for [{action}]")
+            err.timed_out = True
+            raise err from e
+        except OSError as e:
+            raise ConnectTransportError(
+                f"connect to {address} failed for [{action}]: {e}") from e
+        with sock:
+            try:
+                # `timeout` bounds the whole round, not each phase: a
+                # slow accept must not leave the recv another full budget
+                sock.settimeout(max(0.001,
+                                    timeout - (time.monotonic() - t0)))
+                _send_frame(sock, {"action": action, "payload": payload})
+                FAULTS.check("transport.recv", action=action,
+                             address=address)
+                resp = _recv_frame(sock)
+            except socket.timeout as e:
+                raise ReceiveTimeoutTransportError(
+                    f"no response from {address} within {timeout}s "
+                    f"for [{action}]") from e
+            except OSError as e:
+                raise TransportError(
+                    f"mid-request failure talking to {address} "
+                    f"for [{action}]: {e}") from e
         if resp is None:
             raise TransportError(f"connection closed by {address}")
         if not resp.get("ok"):
@@ -122,6 +280,73 @@ class TransportService:
                                       int(resp.get("status", 500)))
             raise TransportError(resp.get("error", "remote failure"))
         return resp.get("result")
+
+    def send_with_retry(self, address: Tuple[str, int], action: str,
+                        payload: dict, *, timeout: float = 5.0,
+                        retries: int = 2,
+                        deadline: Optional[float] = None,
+                        backoff: Optional[BackoffPolicy] = None) -> Any:
+        """``send_remote`` for IDEMPOTENT actions: bounded exponential
+        backoff on transport-level failures, per-peer breaker, optional
+        absolute deadline (``time.monotonic()`` value) that caps every
+        attempt's socket timeout. Application-level failures relayed
+        from the peer (RemoteException) are never retried — the handler
+        ran and answered."""
+        policy = backoff or self.backoff
+        # per-(peer, action) jitter stream: one shared policy must not
+        # hand every peer the identical retry schedule
+        delays = policy.delays(retries, salt=f"{address}|{action}")
+        last: Optional[TransportError] = None
+        for attempt in range(retries + 1):
+            budget = timeout
+            truncated = False
+            if deadline is not None:
+                # budget BEFORE breaker.allow: a deadline abort must not
+                # consume (and then abandon) the breaker's half-open probe
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise ReceiveTimeoutTransportError(
+                        f"deadline exhausted before [{action}] to "
+                        f"{address} could run") from last
+                if remaining < budget:
+                    budget, truncated = remaining, True
+            if not self.breaker.allow(address):
+                if last is not None:
+                    # the breaker opened DURING this call's retries: the
+                    # real typed failure is more useful than the breaker's
+                    raise last
+                raise NodeUnavailableException(
+                    f"peer {address} is cooling down after repeated "
+                    f"failures (skipping [{action}])")
+            try:
+                result = self.send_remote(address, action, payload,
+                                          timeout=budget)
+            except RemoteException:
+                self.breaker.record_success(address)  # the peer answered
+                raise
+            except TransportError as e:
+                budget_induced = truncated and (
+                    isinstance(e, ReceiveTimeoutTransportError)
+                    or getattr(e, "timed_out", False))
+                if not budget_induced:
+                    # …but a TIMEOUT under a deadline-TRUNCATED socket
+                    # budget says more about this caller's deadline than
+                    # about the peer's health — it must not open the
+                    # breaker for every other caller (instant refusals
+                    # still count regardless of budget)
+                    self.breaker.record_failure(address)
+                last = e
+                if attempt < retries:
+                    delay = next(delays)
+                    if deadline is not None and \
+                            time.monotonic() + delay >= deadline:
+                        break  # sleeping would blow the deadline
+                    time.sleep(delay)
+                continue
+            self.breaker.record_success(address)
+            return result
+        assert last is not None
+        raise last
 
     def ping(self, address: Tuple[str, int], timeout: float = 1.0) -> bool:
         try:

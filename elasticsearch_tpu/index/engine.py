@@ -26,8 +26,10 @@ from elasticsearch_tpu.index.segment import SegmentBuilder, TpuSegment
 from elasticsearch_tpu.index.translog import Translog
 from elasticsearch_tpu.utils.errors import (
     DocumentMissingException,
+    EngineFailedException,
     VersionConflictException,
 )
+from elasticsearch_tpu.utils.faults import FAULTS
 
 
 @dataclass
@@ -76,7 +78,9 @@ class Engine:
         translog_path: Optional[str] = None,
         refresh_interval_docs: int = 0,
         merge_segment_count: int = 8,
+        index_name: str = "",
     ):
+        self.index_name = index_name  # for typed errors: "engine for [x]"
         self.mappings = mappings
         self.analysis = analysis
         self.parser = DocumentParser(mappings, analysis)
@@ -98,6 +102,47 @@ class Engine:
         self.merge_policy = TieredMergePolicy(
             segments_per_tier=merge_segment_count)
         self._auto_id = 0
+        # tragic-event state: non-None after a durability-critical IO
+        # failure; every later write 503s (reference: failEngine)
+        self.failed_reason: Optional[str] = None
+
+    # -- tragic events -----------------------------------------------------------
+
+    @property
+    def is_failed(self) -> bool:
+        return self.failed_reason is not None
+
+    def fail(self, reason: str) -> None:
+        """Fail the engine closed after a tragic event. Idempotent; the
+        translog channel is already closed by its own tragic handler,
+        but close again defensively for non-translog callers."""
+        with self._lock:
+            if self.failed_reason is not None:
+                return
+            self.failed_reason = reason
+            try:
+                self.translog.close()
+            except OSError:
+                pass  # the channel is what failed; state flag is what matters
+
+    def _ensure_open(self) -> None:
+        if self.failed_reason is not None:
+            raise EngineFailedException(self.index_name, self.failed_reason)
+
+    def _translog_append(self, entry: dict) -> None:
+        """Append with tragic-event semantics: an IO/fsync failure fails
+        the engine CLOSED and the triggering op is NOT acknowledged —
+        so the set of acknowledged ops is exactly the set replay can
+        reproduce (no silently-lost writes). The op's in-memory mutation
+        is NOT rolled back (segment live-masks can't un-delete), so reads
+        may see it until restart — a documented deviation from the
+        reference, which closes reads too (docs/ROBUSTNESS.md)."""
+        try:
+            self.translog.append(entry)
+        except OSError as e:
+            self.fail(f"translog append failed: {e}")
+            raise EngineFailedException(
+                self.index_name, f"translog append failed: {e}") from e
 
     # -- write path ------------------------------------------------------------
 
@@ -125,6 +170,7 @@ class Engine:
         """
         t0 = time.perf_counter()
         with self._lock:
+            self._ensure_open()
             if doc_id is None:
                 self._auto_id += 1
                 doc_id = f"auto_{self._auto_id}_{int(time.time() * 1000)}"
@@ -133,7 +179,8 @@ class Engine:
             current = loc.version if (loc and not loc.deleted) else 0
             exists = loc is not None and not loc.deleted
             if op_type == "create" and exists:
-                raise VersionConflictException(self.mappings.meta.get("index", ""), doc_id, current, 0)
+                raise VersionConflictException(self.index_name, doc_id,
+                                               current, 0)
             if version is not None:
                 if version_type == "force":
                     # force: set the version unconditionally (reference:
@@ -178,7 +225,7 @@ class Engine:
                     entry["timestamp"] = parsed.meta["timestamp"]
                 if "ttl_expiry" in parsed.meta:
                     entry["ttl_expiry"] = parsed.meta["ttl_expiry"]
-                self.translog.append(entry)
+                self._translog_append(entry)
             self.stats.index_total += 1
             self.stats.on_type(doc_type, "index_total")
             self.stats.index_time_ms += (time.perf_counter() - t0) * 1000
@@ -187,6 +234,7 @@ class Engine:
     def delete(self, doc_id: str, version: Optional[int] = None,
                version_type: str = "internal", _replay: bool = False) -> int:
         with self._lock:
+            self._ensure_open()
             doc_id = str(doc_id)
             loc = self._locations.get(doc_id)
             if loc is None or loc.deleted:
@@ -210,7 +258,7 @@ class Engine:
                 new_version = loc.version + 1
             self._locations[doc_id] = DocLocation(version=new_version, deleted=True, where=None)
             if not _replay:
-                self.translog.append({"op": "delete", "id": doc_id, "version": new_version})
+                self._translog_append({"op": "delete", "id": doc_id, "version": new_version})
             self.stats.delete_total += 1
             self.stats.on_type(loc.doc_type, "delete_total")
             return new_version
@@ -376,8 +424,9 @@ class Engine:
         IndicesTTLService.java — the TTL purger; here it runs on refresh and
         merge). Expiry columns scan vectorized; deletes go through the
         normal tombstone path so versions/translog stay consistent."""
-        if not getattr(self.mappings, "_ttl_enabled", False):
-            return 0
+        if not getattr(self.mappings, "_ttl_enabled", False) \
+                or self.failed_reason is not None:
+            return 0  # a failed engine accepts no deletes (reads still serve)
         import numpy as np
 
         now = int(time.time() * 1000)
@@ -413,6 +462,10 @@ class Engine:
                          if d is not None and p == -1]
             if not live_docs:
                 return False
+            # refresh failure is RETRYABLE, not tragic: the buffer keeps
+            # the docs and a later refresh serves them (unlike a translog
+            # failure, nothing acknowledged is at risk)
+            FAULTS.check("segment.freeze", index=self.index_name)
             fresh = SegmentBuilder(self.mappings)
             for d in live_docs:
                 fresh.add(d)
@@ -448,7 +501,14 @@ class Engine:
         contract as InternalEngine.flush."""
         with self._lock:
             self.refresh()
-            self.translog.commit()
+            try:
+                self.translog.commit()
+            except OSError as e:
+                # commit fsyncs before dropping generations — a failure
+                # here is as tragic as a failed append
+                self.fail(f"translog commit failed: {e}")
+                raise EngineFailedException(
+                    self.index_name, f"translog commit failed: {e}") from e
             self.stats.flush_total += 1
 
     def merge(self, max_segments: Optional[int] = None,

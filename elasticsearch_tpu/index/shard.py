@@ -29,7 +29,8 @@ class IndexShard:
         translog_path = None
         if data_path:
             translog_path = os.path.join(data_path, index_name, str(shard_id), "translog")
-        self.engine = Engine(mappings, analysis, translog_path=translog_path)
+        self.engine = Engine(mappings, analysis, translog_path=translog_path,
+                             index_name=index_name)
         self.searcher = ShardSearcher(self.engine.segments, mappings, analysis,
                                       shard_ord=shard_id, index_name=index_name)
         self.state = "STARTED"
@@ -85,7 +86,9 @@ class IndexShard:
                 "fields": {f: {"size_in_bytes": b}
                            for f, b in comp_fields.items()},
             },
-            "translog": {"operations": self.engine.translog.size_in_ops},
+            # full TranslogStats shape (ops/generation/bytes/last_sync +
+            # tragic/corruption accounting) for the monitor endpoint
+            "translog": self.engine.translog.stats(),
             # Lucene CommitStats analogue: stable engine identity +
             # refresh/flush generation (the `shards` level echoes it)
             "commit": {"id": self.engine.commit_id,

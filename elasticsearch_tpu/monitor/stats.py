@@ -81,6 +81,58 @@ class SearchStats:
         return out
 
 
+class TranslogRecoveryStats:
+    """Process-wide accounting of translog replay damage: every corrupt
+    tail a replay stopped at (reference: the recovery stats surfaced by
+    TranslogService + the TranslogCorruptedException logging — here the
+    frames/bytes dropped are COUNTED so operators see data loss instead
+    of inferring it from doc counts)."""
+
+    def __init__(self, max_events: int = 64):
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self.frames_skipped = 0
+        self.bytes_dropped = 0
+        # counters stay exact; the per-event detail ring is bounded so a
+        # node that keeps reopening damaged translogs can't grow its own
+        # monitoring payload without limit
+        self.events = deque(maxlen=max_events)
+
+    def record(self, path: str, bytes_dropped: int, reason: str) -> None:
+        with self._lock:
+            self.frames_skipped += 1
+            self.bytes_dropped += int(bytes_dropped)
+            self.events.append({
+                "path": path,
+                "bytes_dropped": int(bytes_dropped),
+                "reason": reason,
+                "timestamp": int(time.time() * 1000),
+            })
+
+    def reset(self) -> None:
+        with self._lock:
+            self.frames_skipped = 0
+            self.bytes_dropped = 0
+            self.events.clear()
+
+    def to_json(self) -> dict:
+        with self._lock:
+            return {
+                "corrupt_tail_frames_skipped": self.frames_skipped,
+                "corrupt_tail_bytes_dropped": self.bytes_dropped,
+                "events": list(self.events),
+            }
+
+
+#: process-global sink — translog replay (index/translog.py) reports here
+TRANSLOG_RECOVERY = TranslogRecoveryStats()
+
+
+def record_corrupt_tail(path: str, bytes_dropped: int, reason: str) -> None:
+    TRANSLOG_RECOVERY.record(path, bytes_dropped, reason)
+
+
 def process_stats() -> dict:
     """Process-level stats (reference: ProcessService → _nodes/stats.process)."""
     import resource

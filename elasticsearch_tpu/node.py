@@ -564,7 +564,9 @@ class Node:
         return {"responses": responses}
 
     def nodes_stats(self) -> dict:
-        from elasticsearch_tpu.monitor.stats import device_stats, os_stats, process_stats
+        from elasticsearch_tpu.monitor.stats import (TRANSLOG_RECOVERY,
+                                                     device_stats, os_stats,
+                                                     process_stats)
 
         from elasticsearch_tpu.monitor.stats import SearchStats
 
@@ -572,6 +574,7 @@ class Node:
         search = {k: 0 for k in SearchStats().to_json()}
         indexing = {"index_total": 0, "delete_total": 0, "index_time_in_millis": 0}
         seg_count = seg_mem = 0
+        tl_frames = tl_bytes = 0
         for svc in self.indices.values():
             for g in svc.groups:
                 for shard in g.copies:
@@ -585,6 +588,9 @@ class Node:
                         indexing[k] += st["indexing"][k]
                     seg_count += st["segments"]["count"]
                     seg_mem += st["segments"]["memory_in_bytes"]
+                    tl_frames += st["translog"].get("corrupt_tail_events", 0)
+                    tl_bytes += st["translog"].get(
+                        "corrupt_tail_bytes_dropped", 0)
         from elasticsearch_tpu.monitor import kernels
 
         # node-wide kernel dispatch counters (which device program served
@@ -609,6 +615,18 @@ class Node:
                         "indexing": indexing,
                         "segments": {"count": seg_count,
                                      "memory_in_bytes": seg_mem},
+                        # translog replay damage accounting, aggregated
+                        # from THIS node's own shards (the process-global
+                        # event log with per-path detail lives in
+                        # monitor/stats.py::TRANSLOG_RECOVERY)
+                        "translog_recovery": {
+                            "corrupt_tail_frames_skipped": tl_frames,
+                            "corrupt_tail_bytes_dropped": tl_bytes,
+                            "events": [
+                                e for e in
+                                TRANSLOG_RECOVERY.to_json()["events"]
+                                if self._owns_translog_path(e["path"])],
+                        },
                     },
                     "process": proc,
                     "os": os_stats(),
@@ -642,6 +660,15 @@ class Node:
             addr = getattr(local, "transport_address", None) or addr
         return {"bound_address": [addr], "publish_address": addr,
                 "profiles": {}}
+
+    def _owns_translog_path(self, path: str) -> bool:
+        """True when a recovery event's translog path lives under THIS
+        node's data_path — keeps per-node stats per-node when several
+        in-process nodes share the global event log."""
+        if not self.data_path:
+            return False
+        return os.path.abspath(path).startswith(
+            os.path.abspath(self.data_path) + os.sep)
 
     @staticmethod
     def _breaker_stats() -> dict:

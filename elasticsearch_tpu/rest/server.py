@@ -2284,6 +2284,11 @@ def _search_body(p, b) -> dict:
         body["scroll"] = p["scroll"]
     if "search_type" in p:
         body["search_type"] = p["search_type"]
+    if "timeout" in p:
+        # ?timeout= caps the per-shard collect loops AND (on distributed
+        # indices) the coordinator's scatter/fetch deadline — blown
+        # deadlines degrade to partial results with timed_out=true
+        body.setdefault("timeout", p["timeout"])
     if "query_cache" in p:
         # per-request shard query-cache override (reference:
         # ShardSearchRequest.queryCache beats the index setting)

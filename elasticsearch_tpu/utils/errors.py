@@ -134,6 +134,22 @@ class ScriptException(ElasticsearchTpuException):
     status = 400
 
 
+class EngineFailedException(ElasticsearchTpuException):
+    """Reference: index/engine/EngineClosedException + the tragic-event
+    path of InternalEngine.failEngine — a durability-critical IO failure
+    (translog write/fsync) fails the engine CLOSED: every subsequent
+    write is rejected with a 503 instead of being acknowledged against a
+    log that can no longer persist it."""
+
+    status = 503
+
+    def __init__(self, index: str, reason: str):
+        super().__init__(
+            f"engine for [{index or '_na_'}] has failed: {reason}")
+        self.index = index
+        self.reason = reason
+
+
 class CircuitBreakingException(ElasticsearchTpuException):
     """Reference: org/elasticsearch/common/breaker/CircuitBreaker.java —
     a memory budget would be exceeded; the REQUEST fails (429-style), the

@@ -1,0 +1,462 @@
+"""Chaos tests over the deterministic fault-injection registry
+(elasticsearch_tpu/utils/faults.py — MockTransportService in spirit).
+
+Every scenario here is seed/count-deterministic: a fault fires on an
+exact check (or an exact seeded probability stream), so a failure in CI
+replays identically. Covered failure domains:
+
+- registry semantics (count/after/match/seeded-prob determinism, env spec)
+- typed transport failures + bounded-backoff retry + per-peer breaker
+- dead shard owner mid-query → HTTP-200-style partial `_shards` results
+- translog fsync fault → tragic event → engine fails CLOSED (typed 503),
+  with replay proving no acknowledged op was lost
+- corrupt translog tail → replay stops, frames/bytes-dropped accounting
+- segment-freeze fault → refresh fails retryably, buffer survives
+"""
+import os
+import socket
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.transport import (
+    BackoffPolicy,
+    ConnectTransportError,
+    NodeUnavailableException,
+    PeerBreaker,
+    ReceiveTimeoutTransportError,
+    RemoteException,
+    TransportError,
+    TransportService,
+)
+from elasticsearch_tpu.utils.faults import (
+    FAULTS,
+    FaultRegistry,
+    _parse_env_spec,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    from elasticsearch_tpu.monitor.stats import TRANSLOG_RECOVERY
+
+    FAULTS.clear()
+    TRANSLOG_RECOVERY.reset()
+    yield
+    FAULTS.clear()
+    TRANSLOG_RECOVERY.reset()
+
+
+# -- registry semantics --------------------------------------------------------
+
+def test_count_and_after_gates():
+    r = FaultRegistry()
+    r.inject("translog.fsync", error=OSError, count=2, after=1)
+    r.check("translog.fsync")  # after=1 lets the first through
+    with pytest.raises(OSError):
+        r.check("translog.fsync")
+    with pytest.raises(OSError):
+        r.check("translog.fsync")
+    r.check("translog.fsync")  # count exhausted: disarmed
+    assert not r.active("translog.fsync")
+    assert len(r.history) == 2
+
+
+def test_match_narrows_to_context():
+    r = FaultRegistry()
+    r.inject("transport.send", error=ConnectionRefusedError, count=-1,
+             match=lambda ctx: ctx.get("action") == "a/query")
+    r.check("transport.send", action="a/fetch")  # no match, no fire
+    with pytest.raises(ConnectionRefusedError):
+        r.check("transport.send", action="a/query")
+
+
+def test_seeded_probability_is_deterministic():
+    def pattern(seed):
+        r = FaultRegistry()
+        r.inject("transport.send", error=OSError, count=-1, prob=0.5,
+                 seed=seed)
+        out = []
+        for _ in range(64):
+            try:
+                r.check("transport.send")
+                out.append(0)
+            except OSError:
+                out.append(1)
+        return out
+
+    a, b = pattern(7), pattern(7)
+    assert a == b            # same seed → identical chaos
+    assert 0 < sum(a) < 64   # and it actually flakes both ways
+    assert pattern(8) != a   # a different seed is a different storm
+
+
+def test_env_spec_parsing_and_unknown_point():
+    r = FaultRegistry()
+    _parse_env_spec("translog.fsync:count=2;"
+                    "transport.send:prob=0.5:seed=3:error=connrefused", r)
+    assert r.active("translog.fsync")
+    assert r.active("transport.send")
+    with pytest.raises(ValueError):
+        r.inject("no.such.point")
+
+
+# -- backoff / breaker ---------------------------------------------------------
+
+def test_backoff_deterministic_and_bounded():
+    p = BackoffPolicy(base=0.05, multiplier=2.0, max_delay=0.4, seed=42)
+    a, b = list(p.delays(6)), list(p.delays(6))
+    assert a == b  # seeded jitter replays
+    assert all(0 < d <= 0.4 for d in a)
+    # the un-jittered envelope grows then clamps
+    raw = [min(0.05 * 2 ** i, 0.4) for i in range(6)]
+    assert all(d <= r for d, r in zip(a, raw))
+
+
+def test_peer_breaker_opens_and_half_opens():
+    clock = [0.0]
+    br = PeerBreaker(threshold=3, cooldown=5.0, clock=lambda: clock[0])
+    peer = ("127.0.0.1", 9999)
+    assert br.allow(peer)
+    for _ in range(3):
+        br.record_failure(peer)
+    assert not br.allow(peer)          # open: fail fast
+    clock[0] = 5.1
+    assert br.allow(peer)              # half-open: one probe
+    assert not br.allow(peer)          # …and only one
+    br.record_success(peer)
+    assert br.allow(peer)              # success closes it fully
+
+
+def test_peer_breaker_abandoned_probe_expires():
+    # a probe whose caller died before reporting must not blacklist the
+    # peer forever — the grant expires after another cooldown window
+    clock = [0.0]
+    br = PeerBreaker(threshold=1, cooldown=5.0, clock=lambda: clock[0])
+    peer = ("127.0.0.1", 9999)
+    br.record_failure(peer)
+    assert not br.allow(peer)
+    clock[0] = 5.1
+    assert br.allow(peer)       # probe granted… and the caller vanishes
+    assert not br.allow(peer)
+    clock[0] = 10.3
+    assert br.allow(peer)       # a fresh probe, not a permanent lockout
+
+
+def test_backoff_salt_decorrelates_but_replays():
+    p = BackoffPolicy(seed=1)
+    assert list(p.delays(4, salt="peerA")) == list(p.delays(4, salt="peerA"))
+    assert list(p.delays(4, salt="peerA")) != list(p.delays(4, salt="peerB"))
+
+
+# -- typed transport failures --------------------------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_connect_refused_is_typed():
+    ts = TransportService("n1")
+    with pytest.raises(ConnectTransportError) as ei:
+        ts.send_remote(("127.0.0.1", _free_port()), "x", {}, timeout=0.5)
+    assert ei.value.error_type == "connect_transport_error"
+    assert ei.value.status == 503
+
+
+def test_mid_request_timeout_is_typed():
+    ts = TransportService("n1")
+    ts.register("slow", lambda p: time.sleep(1.0))
+    addr = ts.bind()
+    try:
+        with pytest.raises(ReceiveTimeoutTransportError) as ei:
+            ts.send_remote(addr, "slow", {}, timeout=0.25)
+        assert ei.value.error_type == "receive_timeout_transport_error"
+    finally:
+        ts.close()
+
+
+def test_retry_recovers_from_single_flake():
+    ts = TransportService("n1")
+    ts.register("echo", lambda p: p)
+    addr = ts.bind()
+    try:
+        FAULTS.inject("transport.send", error=ConnectionRefusedError,
+                      count=1)
+        out = ts.send_with_retry(addr, "echo", {"v": 1}, timeout=2.0,
+                                 retries=2)
+        assert out == {"v": 1}
+        assert FAULTS.fired("transport.send") == 1  # exactly one retry used
+    finally:
+        ts.close()
+
+
+def test_remote_application_errors_never_retry():
+    calls = []
+
+    def boom(p):
+        calls.append(1)
+        from elasticsearch_tpu.utils.errors import DocumentMissingException
+
+        raise DocumentMissingException("i", "1")
+
+    ts = TransportService("n1")
+    ts.register("boom", boom)
+    addr = ts.bind()
+    try:
+        with pytest.raises(RemoteException) as ei:
+            ts.send_with_retry(addr, "boom", {}, timeout=2.0, retries=3)
+        assert ei.value.status == 404  # the peer ANSWERED; not a retry case
+        assert len(calls) == 1
+    finally:
+        ts.close()
+
+
+def test_breaker_fast_fails_repeatedly_dead_peer():
+    ts = TransportService("n1")
+    dead = ("127.0.0.1", _free_port())
+    ts.backoff = BackoffPolicy(base=0.001, max_delay=0.002)
+    with pytest.raises(ConnectTransportError):
+        ts.send_with_retry(dead, "x", {}, timeout=0.2, retries=3)
+    # ≥ threshold consecutive failures recorded: the breaker now skips it
+    with pytest.raises(NodeUnavailableException) as ei:
+        ts.send_with_retry(dead, "x", {}, timeout=0.2, retries=3)
+    assert ei.value.error_type == "node_unavailable_exception"
+
+
+def test_deadline_caps_total_retry_time():
+    ts = TransportService("n1")
+    dead = ("127.0.0.1", _free_port())
+    t0 = time.monotonic()
+    with pytest.raises(TransportError):
+        ts.send_with_retry(dead, "x", {}, timeout=5.0, retries=50,
+                           deadline=time.monotonic() + 0.3)
+    assert time.monotonic() - t0 < 2.0  # nowhere near 50 retries' worth
+
+
+# -- write-path durability: tragic events --------------------------------------
+
+def test_fsync_fault_fails_engine_closed_and_loses_no_acked_op(tmp_path):
+    from elasticsearch_tpu.index.index_service import IndexService
+    from elasticsearch_tpu.index.translog import Translog
+    from elasticsearch_tpu.utils.errors import EngineFailedException
+
+    svc = IndexService("wal", settings={"index": {"number_of_shards": 1}},
+                       data_path=str(tmp_path))
+    try:
+        svc.index_doc("1", {"v": 1})  # acknowledged
+        FAULTS.inject("translog.fsync", error=OSError, count=1)
+        with pytest.raises(EngineFailedException):
+            svc.index_doc("2", {"v": 2})  # the triggering op is NOT acked
+        # the fault is spent, but the engine stays failed CLOSED
+        with pytest.raises(EngineFailedException) as ei:
+            svc.index_doc("3", {"v": 3})
+        assert ei.value.status == 503
+        assert ei.value.error_type == "engine_failed_exception"
+        engine = svc.groups[0].primary.engine
+        assert engine.is_failed
+        # replay proves the acked/acked-only invariant: doc 1 replays,
+        # docs 2 and 3 were refused — nothing silently lost
+        replayed = list(Translog(engine.translog.path).replay())
+        assert [op["id"] for op in replayed if op["op"] == "index"] == ["1"]
+    finally:
+        svc.close()
+
+
+def test_fsync_fault_surfaces_as_typed_503_through_rest(tmp_path):
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.rest.server import RestController
+
+    node = Node(name="chaos", data_path=str(tmp_path))
+    ctrl = RestController(node)
+    try:
+        status, _ = ctrl.dispatch("PUT", "/logs/_doc/1", {}, b'{"v": 1}')
+        assert status == 201
+        FAULTS.inject("translog.fsync", error=OSError, count=1)
+        status, body = ctrl.dispatch("PUT", "/logs/_doc/1", {}, b'{"v": 2}')
+        assert status == 503
+        assert body["error"]["type"] == "engine_failed_exception"
+        # fault disarmed, engine still failed: the NEXT write 503s too
+        status, body = ctrl.dispatch("PUT", "/logs/_doc/1", {}, b'{"v": 3}')
+        assert status == 503
+        assert body["error"]["type"] == "engine_failed_exception"
+    finally:
+        node.close()
+
+
+def test_corrupt_tail_reported_not_half_parsed(tmp_path):
+    from elasticsearch_tpu.index.translog import Translog
+    from elasticsearch_tpu.monitor.stats import TRANSLOG_RECOVERY
+
+    path = str(tmp_path / "translog")
+    tl = Translog(path)
+    for i in range(3):
+        tl.append({"op": "index", "id": str(i), "source": {"v": i}})
+    tl.close()
+    gen = f"{path}.1"
+    size = os.path.getsize(gen)
+    with open(gen, "r+b") as f:  # flip a byte inside the LAST frame
+        f.seek(size - 3)
+        b = f.read(1)
+        f.seek(size - 3)
+        f.write(bytes([b[0] ^ 0xFF]))
+    tl2 = Translog(path)
+    ops = list(tl2.replay())
+    assert [op["id"] for op in ops] == ["0", "1"]  # stops AT the tear
+    stats = tl2.stats()
+    assert stats["corrupt_tail_events"] == 1
+    rec = TRANSLOG_RECOVERY.to_json()
+    assert rec["corrupt_tail_frames_skipped"] == 1
+    assert rec["corrupt_tail_bytes_dropped"] > 0
+    assert rec["events"][0]["reason"] == "frame CRC mismatch"
+    tl2.close()
+
+
+def test_translog_append_after_tragic_close_is_refused(tmp_path):
+    from elasticsearch_tpu.index.translog import (Translog,
+                                                  TranslogClosedException)
+
+    tl = Translog(str(tmp_path / "t"))
+    tl.append({"op": "index", "id": "1", "source": {}})
+    FAULTS.inject("translog.fsync", error=OSError, count=1)
+    with pytest.raises(OSError):
+        tl.append({"op": "index", "id": "2", "source": {}})
+    # the channel is CLOSED: no later append can extend a torn tail
+    with pytest.raises(TranslogClosedException):
+        tl.append({"op": "index", "id": "3", "source": {}})
+    assert tl.stats()["closed"]
+
+
+def test_segment_freeze_fault_is_retryable_not_tragic():
+    from elasticsearch_tpu.index.index_service import IndexService
+
+    svc = IndexService("frz", settings={"index": {"number_of_shards": 1}})
+    try:
+        svc.index_doc("1", {"v": 1})
+        FAULTS.inject("segment.freeze", error=OSError, count=1)
+        with pytest.raises(OSError):
+            svc.refresh()
+        svc.refresh()  # buffer survived; the next refresh serves the doc
+        assert svc.search({"size": 0})["hits"]["total"] == 1
+        engine = svc.groups[0].primary.engine
+        assert not engine.is_failed  # refresh faults never fail the engine
+    finally:
+        svc.close()
+
+
+# -- dead owner mid-query → partial shard results ------------------------------
+
+@pytest.fixture()
+def two_node_cluster(tmp_path):
+    """Two full MultiHostClusters IN-PROCESS (the TCP transport doesn't
+    care): rank 0 is master+coordinator, rank 1 owns half the shards.
+    ping_interval=0 — no fault detector, so the assignment keeps naming
+    the 'dead' owner while faults make it unreachable (deterministic,
+    unlike racing a process kill)."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.node import Node
+
+    port = _free_port()
+    node0 = Node(name="rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port)
+    c0.data.create_index("evt", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {"n": {"type": "integer"}}}})
+    assig = c0.dist_indices["evt"]["assignment"]
+    assert len({o[0] for o in assig.values()}) == 2, assig
+    for i in range(20):
+        c0.data.index_doc("evt", str(i), {"n": i})
+    c0.data.refresh("evt")
+    yield c0, c1
+    try:
+        c1.close()
+    finally:
+        c0.close()
+        node1.close()
+        node0.close()
+
+
+def test_dead_owner_mid_query_degrades_to_partial(two_node_cluster):
+    from elasticsearch_tpu.cluster.search_action import ACTION_QUERY
+    from elasticsearch_tpu.rest.server import RestController
+
+    c0, _c1 = two_node_cluster
+    full = c0.data.search("evt", {"size": 20})
+    assert full["_shards"] == {"total": 2, "successful": 2, "failed": 0}
+    assert full["hits"]["total"] == 20
+
+    # kill the remote owner's QUERY phase only — everything else lives
+    FAULTS.inject("transport.send", error=ConnectionRefusedError, count=-1,
+                  match=lambda ctx: ctx.get("action") == ACTION_QUERY)
+    r = c0.data.search("evt", {"size": 20})
+    shards = r["_shards"]
+    assert shards["total"] == 2 and shards["failed"] >= 1
+    assert shards["successful"] == 2 - shards["failed"]
+    fail = shards["failures"][0]
+    assert fail["shard"] in (0, 1)                 # names the shard
+    assert fail["index"] == "evt" and fail["node"] # …and the owner
+    assert fail["reason"]["type"] == "connect_transport_error"
+    # correct hits from the SURVIVING shard: exactly the locally-owned docs
+    local_total = sum(
+        g.primary.engine.num_docs
+        for sid, g in enumerate(c0.node.indices["evt"].groups)
+        if c0.dist_indices["evt"]["assignment"][str(sid)][0]
+        == c0.local.node_id)
+    assert r["hits"]["total"] == local_total > 0
+    assert len(r["hits"]["hits"]) == local_total
+
+    # acceptance shape: the REST layer serves this as HTTP 200
+    ctrl = RestController(c0.node)
+    status, body = ctrl.dispatch("POST", "/evt/_search", {},
+                                 b'{"size": 20}')
+    assert status == 200
+    assert body["_shards"]["failed"] >= 1
+    # by now the breaker may have opened for the dead peer: either the
+    # raw connect failure or the breaker's fast-fail is a correct report
+    assert body["_shards"]["failures"][0]["reason"]["type"] in (
+        "connect_transport_error", "node_unavailable_exception")
+
+    # clear the chaos (and the breaker's memory of it): full results again
+    FAULTS.clear()
+    c0.transport.breaker = PeerBreaker()
+    r = c0.data.search("evt", {"size": 20})
+    assert r["_shards"]["failed"] == 0
+    assert r["hits"]["total"] == 20
+
+
+def test_transport_flake_retries_within_deadline(two_node_cluster):
+    c0, _c1 = two_node_cluster
+    # ONE connect flake on the next send: the bounded backoff absorbs it
+    FAULTS.inject("transport.send", error=ConnectionRefusedError, count=1)
+    r = c0.data.search("evt", {"size": 20})
+    assert r["_shards"]["failed"] == 0
+    assert r["hits"]["total"] == 20
+    assert FAULTS.fired("transport.send") == 1
+
+
+def test_dead_owner_mid_fetch_drops_only_its_hits(two_node_cluster):
+    from elasticsearch_tpu.cluster.search_action import ACTION_FETCH
+
+    c0, _c1 = two_node_cluster
+    FAULTS.inject("transport.send", error=ConnectionRefusedError, count=-1,
+                  match=lambda ctx: ctx.get("action") == ACTION_FETCH)
+    r = c0.data.search("evt", {"size": 20})
+    # query phase saw BOTH shards (total counts everything)…
+    assert r["hits"]["total"] == 20
+    # …but the dead owner's page hits dropped and its shard is failed
+    assert r["_shards"]["failed"] >= 1
+    assert 0 < len(r["hits"]["hits"]) < 20
+    assert {f["reason"]["type"] for f in r["_shards"]["failures"]} \
+        == {"connect_transport_error"}
+
+
+def test_recovery_stream_fault_point_is_wired(two_node_cluster):
+    c0, _c1 = two_node_cluster
+    FAULTS.inject("recovery.shard_sync", error=OSError, count=1)
+    with pytest.raises(OSError):
+        c0.data._on_shard_sync({"index": "evt", "shard": 0})
+    assert FAULTS.fired("recovery.shard_sync") == 1

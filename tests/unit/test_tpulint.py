@@ -16,9 +16,10 @@ from tools.tpulint.baseline import filter_baselined, load_baseline
 
 
 def lint(src: str, *, hot: bool = False, locked: bool = False,
-         ops: bool = False, path: str = "elasticsearch_tpu/x/mod.py"):
+         ops: bool = False, swallow: bool = False,
+         path: str = "elasticsearch_tpu/x/mod.py"):
     return lint_source(textwrap.dedent(src), path, hot=hot, ops=ops,
-                       locked=locked)
+                       locked=locked, swallow=swallow)
 
 
 def rules_of(violations):
@@ -384,6 +385,79 @@ class TestR005:
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
+
+class TestR006:
+    def test_bad_except_exception_pass(self):
+        vs = lint("""
+            def fan_out(peers):
+                for p in peers:
+                    try:
+                        p.send()
+                    except Exception:
+                        pass
+        """, swallow=True)
+        assert rules_of(vs) == ["R006"]
+
+    def test_bad_bare_except_pass(self):
+        vs = lint("""
+            def close(ch):
+                try:
+                    ch.close()
+                except:
+                    pass
+        """, swallow=True)
+        assert rules_of(vs) == ["R006"]
+
+    def test_bad_tuple_catch_and_ellipsis_body(self):
+        # the evasions: tuple form and a no-op `...` body must still flag
+        vs = lint("""
+            def fan_out(p):
+                try:
+                    p.send()
+                except (ValueError, Exception):
+                    pass
+                try:
+                    p.send()
+                except Exception:
+                    ...
+        """, swallow=True)
+        assert [v.rule for v in vs] == ["R006", "R006"]
+
+    def test_good_typed_catch_and_accounted_failure(self):
+        vs = lint("""
+            def fan_out(peers, failures):
+                for p in peers:
+                    try:
+                        p.send()
+                    except ConnectionError:
+                        pass
+                    except Exception as e:
+                        failures.append(str(e))
+        """, swallow=True)
+        assert vs == []
+
+    def test_good_inline_allow(self):
+        # the marker sits on the `except` line — that's where R006 anchors
+        # (and what the baseline fingerprints on)
+        vs = lint("""
+            def close(ch):
+                try:
+                    ch.close()
+                except Exception:  # tpulint: allow[R006] — none left to tell
+                    pass
+        """, swallow=True)
+        assert vs == []
+
+    def test_not_flagged_outside_failure_domain(self):
+        vs = lint("""
+            def close(ch):
+                try:
+                    ch.close()
+                except Exception:
+                    pass
+        """, swallow=False)
+        assert vs == []
+
 
 class TestSuppression:
     def test_same_line_allow(self):

@@ -4,8 +4,10 @@ The paper's core bet is that per-segment scoring runs as batched,
 statically-shaped device programs. That bet silently breaks whenever a
 dynamic shape, tracer leak, or per-hit host sync creeps into a jitted
 path — failures that surface not as exceptions but as recompile storms
-and serialized device↔host ping-pong on TPU. tpulint catches the known
-failure classes at review time:
+and serialized device↔host ping-pong on TPU. (R006 guards a different
+invariant of the same production-scale bet: faults in the distributed
+failure domain must be ACCOUNTED, never swallowed.) tpulint catches the
+known failure classes at review time:
 
   R001  recompilation hazards: jit construction inside a loop; unhashable
         or unbucketed high-cardinality values fed to ``static_argnames``.
@@ -20,6 +22,10 @@ failure classes at review time:
   R005  lock discipline: mutation of shared state in threadpool-visible
         modules (engine/translog/ivf_cache/threadpool) outside a
         ``with <lock>`` block.
+  R006  swallowed failures: bare ``except Exception: pass`` in the
+        failure-domain layers (``cluster/``, ``index/``, ``rest/``) —
+        a fault that never reaches retry/breaker/partial-result
+        accounting becomes silent data loss.
 
 Suppress a finding in place with ``# tpulint: allow[R00x]`` on the line
 (or an immediately preceding comment line); mark intentional host-side

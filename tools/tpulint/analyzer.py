@@ -22,10 +22,17 @@ RULES: Dict[str, str] = {
     "R003": "dynamic shape in traced code / un-annotated host build path",
     "R004": "tracer leak (Python control flow on a traced value)",
     "R005": "shared mutable state written without holding the lock",
+    "R006": "failure swallowed (`except Exception: pass`) in a "
+            "failure-domain module",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.
 HOT_PATH_MARKERS = ("/ops/", "/search/", "/rest/server.py")
+# R006 scope: the failure-domain layers — a swallowed exception here turns
+# a reportable fault (dead peer, failed fsync, lost replica) into silent
+# data loss or a wedged cluster. Justified swallows carry a baseline entry
+# or an inline allow.
+SWALLOW_PATH_MARKERS = ("/cluster/", "/index/", "/rest/")
 # R003 host-annotation scope: device-op modules where an un-annotated
 # host numpy dynamic-shape call is ambiguous (build path or trace leak?).
 OPS_PATH_MARKERS = ("/ops/",)
@@ -113,10 +120,11 @@ def lint_source(
     hot: Optional[bool] = None,
     ops: Optional[bool] = None,
     locked: Optional[bool] = None,
+    swallow: Optional[bool] = None,
 ) -> List[Violation]:
-    """Lint one source string. ``hot``/``ops``/``locked`` override the
-    path-based scoping (fixture tests use these; production runs infer
-    from the path)."""
+    """Lint one source string. ``hot``/``ops``/``locked``/``swallow``
+    override the path-based scoping (fixture tests use these; production
+    runs infer from the path)."""
     from tools.tpulint import rules as _rules
 
     tree = ast.parse(source, filename=path)
@@ -128,6 +136,8 @@ def lint_source(
         hot=_matches(path, HOT_PATH_MARKERS) if hot is None else hot,
         ops=_matches(path, OPS_PATH_MARKERS) if ops is None else ops,
         locked=_matches(path, LOCKED_MODULE_MARKERS) if locked is None else locked,
+        swallow=(_matches(path, SWALLOW_PATH_MARKERS)
+                 if swallow is None else swallow),
         host_lines=supp.host,
     )
     found = _rules.check_module(tree, ctx)

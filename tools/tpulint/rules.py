@@ -34,6 +34,7 @@ class FileContext:
     hot: bool = False      # R002 applies
     ops: bool = False      # R003 host-annotation check applies
     locked: bool = False   # R005 applies
+    swallow: bool = False  # R006 applies (failure-domain modules)
     host_lines: Set[int] = field(default_factory=set)
 
 
@@ -486,6 +487,44 @@ class _Checker(ast.NodeVisitor):
                            "host call is ambiguous next to traced code — "
                            "annotate the line `# tpulint: host` (build path) "
                            "or move to a size=-bounded device form")
+
+    # -- R006 ---------------------------------------------------------------
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        """Flag `except [Exception|BaseException]: pass` in failure-domain
+        modules: the swallowed fault (a dead peer, a failed fsync, a lost
+        replica ack) never reaches retry/breaker/partial-result
+        accounting. Typed catches (`except DocumentMissingException:
+        pass`) and handlers that DO something (log, record a failure
+        entry, continue a loop with accounting) are fine."""
+        if self.ctx.swallow and self._is_broad_catch(node.type) \
+                and self._is_noop_body(node.body):
+            what = ("bare except" if node.type is None
+                    else _attr_chain(node.type) or "broad except")
+            self._emit("R006", node,
+                       f"`{what}: pass` swallows every failure on this "
+                       "path — record it (failure entry, stats counter, "
+                       "shard-failed report) or narrow the catch; if the "
+                       "swallow is genuinely safe, justify it with "
+                       "`# tpulint: allow[R006]` or a baseline entry")
+        self.generic_visit(node)
+
+    @classmethod
+    def _is_broad_catch(cls, t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True  # bare `except:`
+        if isinstance(t, ast.Tuple):  # `except (Exception,):` counts too
+            return any(cls._is_broad_catch(e) for e in t.elts)
+        chain = _attr_chain(t) or ""
+        return chain.rpartition(".")[2] in ("Exception", "BaseException")
+
+    @staticmethod
+    def _is_noop_body(body) -> bool:
+        """pass / `...` / a bare string — anything that does no work."""
+        return all(isinstance(s, ast.Pass)
+                   or (isinstance(s, ast.Expr)
+                       and isinstance(s.value, ast.Constant))
+                   for s in body)
 
     # -- R005 ---------------------------------------------------------------
 
