@@ -20,6 +20,13 @@ __version__ = "0.1.0"
 
 __all__ = ["Node", "Client", "__version__"]
 
+# NOTE: the jit retrace auditor the search profiler reads
+# (tracing/retrace.py) installs from the __init__ of each jit-binding
+# package (ops/, models/, parallel/) — parent packages initialize before
+# their submodules, so the patch lands before any `@jax.jit` binds,
+# WITHOUT making this root import pull in jax (a Client-only import
+# stays light, see __getattr__ below).
+
 
 def __getattr__(name):  # lazy: submodules pull in jax; keep import light
     if name == "Node":

@@ -69,12 +69,20 @@ class MultiHostCluster:
         # on a node id no nodes/_nodes map contains
         old_id = node.node_id
         node.node_id = nid
+        # observability identities follow the rename: task ids and span
+        # node tags must carry the cluster-visible id, or /_tasks entries
+        # from this node would name an id no nodes map contains
+        node.tasks.node_id = nid
+        node.tracer.node_id = nid
         state = node.cluster_state
         for r in state.routing:
             if r.node_id == old_id:
                 r.node_id = nid
         state.nodes.clear()  # replace the single-node bootstrap entry
         self.transport = TransportService(nid)
+        # remote sends/handles record spans on this node's tracer and
+        # stitch into one trace via the frame ctx header
+        self.transport.tracer = node.tracer
         host, port = self.transport.bind(
             bind_host, transport_port if rank == 0 else 0)
         self.local = DiscoveryNode(nid, node.name,

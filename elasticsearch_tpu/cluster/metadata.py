@@ -23,6 +23,10 @@ DYNAMIC_SETTINGS = {
     "blocks.read",
     "blocks.write",
 }
+# whole dynamically-updatable families (reference: the slowlog thresholds
+# are per-level dynamic settings — IndexDynamicSettingsModule registers
+# index.search.slowlog.* / index.indexing.slowlog.*)
+DYNAMIC_SETTING_PREFIXES = ("search.slowlog.", "indexing.slowlog.")
 
 
 class IndexClosedException(ElasticsearchTpuException):
@@ -50,7 +54,8 @@ def update_index_settings(svc, body: dict, node=None) -> dict:
     flat = {k[len("index."):] if k.startswith("index.") else k: v
             for k, v in flat.items()}
     for key in flat:
-        if key not in DYNAMIC_SETTINGS:
+        if key not in DYNAMIC_SETTINGS \
+                and not key.startswith(DYNAMIC_SETTING_PREFIXES):
             raise IllegalArgumentException(
                 f"setting [index.{key}] is not dynamically updateable")
     if "number_of_replicas" in flat:

@@ -34,6 +34,7 @@ import numpy as np
 
 from elasticsearch_tpu.cluster.routing import shard_id_for
 from elasticsearch_tpu.cluster.transport import TransportError
+from elasticsearch_tpu.tracing import TaskCancelledException
 from elasticsearch_tpu.utils import wire
 from elasticsearch_tpu.utils.errors import (ElasticsearchTpuException,
                                             IndexNotFoundException)
@@ -62,6 +63,7 @@ ACTION_ALIASES = "indices:admin/aliases"
 ACTION_APPLY_GLOBAL = "cluster:admin/apply_global_state"
 ACTION_BY_QUERY = "indices:data/write/by_query"
 ACTION_REST_PROXY = "internal:rest/proxy"
+ACTION_CANCEL_TASKS = "cluster:admin/tasks/cancel"
 
 _CONTEXT_TTL = 120.0
 # coordinator-side cap on one search's scatter+fetch wall time when the
@@ -87,6 +89,13 @@ def shard_failure_entry(index: str, sid: int, exc: Optional[Exception] = None,
             "status": status or 500,
             "reason": {"type": error_type or "exception",
                        "reason": reason or ""}}
+
+
+def by_query_task_action(op: str) -> str:
+    """ES task action name for a by-query op (reference:
+    DeleteByQueryAction.NAME / UpdateByQueryAction.NAME)."""
+    return (f"indices:data/write/{op}/byquery" if op in ("delete", "update")
+            else f"indices:data/write/{op}")
 
 
 class DistributedDataService:
@@ -127,6 +136,7 @@ class DistributedDataService:
         t.register(ACTION_APPLY_GLOBAL, self._on_apply_global)
         t.register(ACTION_BY_QUERY, self._on_by_query)
         t.register(ACTION_REST_PROXY, self._on_rest_proxy)
+        t.register(ACTION_CANCEL_TASKS, self._on_cancel_tasks)
         self._proxy_controller = None
 
     # -- ownership -----------------------------------------------------------
@@ -796,7 +806,14 @@ class DistributedDataService:
         each PRIMARY owner for its shards, merge counts. Reference:
         AbstractAsyncBulkByScrollAction (scroll-driven scan + bulk), here
         scoped per owner so every apply runs on the doc's primary and
-        fans to replicas through the ordinary write hop."""
+        fans to replicas through the ordinary write hop.
+
+        Runs as a CANCELLABLE task: each remote owner's pass registers a
+        child task (the wire header carries the parent id), so ``POST
+        /_tasks/{this}/_cancel`` reaches the remote scans too; a
+        cancellation mid-fanout returns the PARTIAL counts applied so
+        far with a ``"canceled"`` reason, the reference's
+        BulkByScrollResponse shape."""
         index = self.resolve_index(index)
         meta = self._meta(index)
         self.refresh(index)
@@ -818,31 +835,56 @@ class DistributedDataService:
                               "reason": f"[{index}][{sid}] has no active "
                                         f"copies"}})
         deleted = updated = noops = 0
-        for owner, sids in sorted(by_owner.items()):
-            payload = {"index": index, "query": (body or {}).get("query"),
-                       "op": op, "shards": sids, "script": script,
-                       "params": params}
+        action = by_query_task_action(op)
+        t0 = time.perf_counter()
+        with self.node.tasks.task(action,
+                                  description=f"{op}-by-query [{index}]") \
+                as task:
             try:
-                if owner == self._local_id():
-                    res = self._on_by_query(payload)
-                else:
-                    res = self._send(owner, ACTION_BY_QUERY, payload,
-                                     timeout=300.0)
-            except Exception as e:
-                # a dead owner after earlier owners already applied
-                # destructive writes: report ITS shards failed — the
-                # caller must see partial success, not a bare 500
-                out["failures"].extend({
-                    "index": index, "shard": sid, "node": owner,
-                    "status": 503,
-                    "cause": {"type": "node_unavailable",
-                              "reason": str(e)}} for sid in sids)
-                continue
-            deleted += res.get("deleted", 0)
-            updated += res.get("updated", 0)
-            noops += res.get("noops", 0)
-            out["total"] += res.get("total", 0)
-            out["failures"].extend(res.get("failures", []))
+                for owner, sids in sorted(by_owner.items()):
+                    # cooperative checkpoint BETWEEN owners: a cancel
+                    # must stop the fanout before the next destructive
+                    # pass starts (the in-flight owner stops itself at
+                    # its own checkpoints)
+                    task.check_cancelled()
+                    payload = {"index": index,
+                               "query": (body or {}).get("query"),
+                               "op": op, "shards": sids, "script": script,
+                               "params": params}
+                    try:
+                        if owner == self._local_id():
+                            res = self._on_by_query(payload)
+                        else:
+                            res = self._send(owner, ACTION_BY_QUERY,
+                                             payload, timeout=300.0)
+                    except Exception as e:
+                        # a dead owner after earlier owners already applied
+                        # destructive writes: report ITS shards failed — the
+                        # caller must see partial success, not a bare 500
+                        out["failures"].extend({
+                            "index": index, "shard": sid, "node": owner,
+                            "status": 503,
+                            "cause": {"type": "node_unavailable",
+                                      "reason": str(e)}} for sid in sids)
+                        continue
+                    deleted += res.get("deleted", 0)
+                    updated += res.get("updated", 0)
+                    noops += res.get("noops", 0)
+                    out["total"] += res.get("total", 0)
+                    out["failures"].extend(res.get("failures", []))
+                    if res.get("canceled"):
+                        # an owner's pass was cancelled — cascade cancel
+                        # reached it first, or an operator cancelled the
+                        # CHILD directly. Either way the operation is
+                        # over: stop the fanout NOW (remaining owners
+                        # must not run their destructive passes under a
+                        # response that claims cancellation) and report
+                        # whatever was applied
+                        out["canceled"] = res["canceled"]
+                        task.cancel(res["canceled"])
+                        break
+            except TaskCancelledException as e:
+                out["canceled"] = str(e)
         try:
             self.refresh(index)
         except Exception:
@@ -852,6 +894,7 @@ class DistributedDataService:
         else:
             out["updated"] = updated
             out["noops"] = noops
+        out["took"] = int((time.perf_counter() - t0) * 1000)
         return out
 
     def _on_by_query(self, payload: dict) -> dict:
@@ -861,7 +904,13 @@ class DistributedDataService:
         scan loop is SHARED with the single-node REST actions
         (search/byquery.py); every apply goes through
         _primary_write/_primary_update so replicas stay in version
-        order."""
+        order.
+
+        Registers a CHILD task (parent = the coordinator's task, carried
+        by the transport wire header): cancelling the coordinator
+        cascades here, and the scan loop's cooperative checkpoints
+        (search/byquery.py) stop the pass between docs — the partial
+        counts applied so far return with ``"canceled"``."""
         from elasticsearch_tpu.search.byquery import (failure_entry,
                                                       run_by_query)
         from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
@@ -910,14 +959,57 @@ class DistributedDataService:
             except ElasticsearchTpuException as e:
                 failures.append(failure_entry(index, doc_id, e))
 
-        run_by_query(svc, payload.get("query"), apply)
+        canceled: Optional[str] = None
+        with self.node.tasks.task(
+                by_query_task_action(payload["op"]) + "[s]",
+                description=f"{payload['op']}-by-query [{index}] "
+                            f"shards {sorted(sids)}"):
+            try:
+                run_by_query(svc, payload.get("query"), apply)
+            except TaskCancelledException as e:
+                canceled = str(e)
         out: Dict[str, Any] = {"total": len(counted), "failures": failures}
         if op == "delete":
             out["deleted"] = counts["deleted"]
         else:
             out["updated"] = counts["updated"]
             out["noops"] = counts["noops"]
+        if canceled is not None:
+            out["canceled"] = canceled
         return out
+
+    def cancel_task_children(self, parent_node: str, parent_id: int,
+                             reason: str = "by user request") -> dict:
+        """Fan a parent-task cancellation to every OTHER member so their
+        child tasks (registered under the wire-propagated parent id)
+        cancel too — the cross-node half of ``POST /_tasks/{id}/_cancel``
+        (reference: TransportCancelTasksAction's ban propagation).
+        Returns per-node cancelled task listings; a dead peer is
+        REPORTED in ``node_failures``, never silently skipped (its tasks
+        die with it anyway)."""
+        payload = {"parent_node": parent_node, "parent_id": int(parent_id),
+                   "reason": reason}
+        nodes: Dict[str, Any] = {}
+        failures: List[dict] = []
+        for nid in self._other_nodes():
+            try:
+                res = self._send(nid, ACTION_CANCEL_TASKS, payload,
+                                 timeout=5.0)
+                if res.get("tasks"):
+                    nodes[nid] = {"tasks": res["tasks"]}
+            except Exception as e:
+                failures.append({"node_id": nid, "reason": str(e)})
+        out: Dict[str, Any] = {"nodes": nodes}
+        if failures:
+            out["node_failures"] = failures
+        return out
+
+    def _on_cancel_tasks(self, payload: dict) -> dict:
+        """Cancel every local task descending from the named parent."""
+        cancelled = self.node.tasks.cancel_by_parent(
+            payload.get("parent_node") or "", int(payload["parent_id"]),
+            payload.get("reason") or "by user request")
+        return {"tasks": {t.tagged_id: t.to_json() for t in cancelled}}
 
     def proxy_doc_rest(self, index: str, doc_id: str,
                        routing: Optional[str], method: str, path: str,
@@ -1153,24 +1245,57 @@ class DistributedDataService:
     def start_recoveries(self, directives: List[dict]) -> None:
         """Run the recovery streams on a background thread: callers are
         transport handlers or the fault-detector loop, and a recovery can
-        take as long as the shard is big."""
+        take as long as the shard is big. Each directive registers a
+        PENDING task up front (visible in /_cluster/pending_tasks while
+        queued behind earlier streams) that flips to running as its
+        stream starts — cancelling it skips/aborts that stream."""
         if not directives:
             return
-        threading.Thread(target=self._run_recoveries, args=(directives,),
+        tasks = [self.node.tasks.register(
+            ACTION_RECOVER,
+            description=f"recover [{d['index']}][{d['shard']}] "
+                        f"{d['source']} -> {d['target']}",
+            status="pending") for d in directives]
+        threading.Thread(target=self._run_recoveries,
+                         args=(directives, tasks),
                          name="tpu-recovery", daemon=True).start()
 
-    def _run_recoveries(self, directives: List[dict]) -> None:
+    def _run_recoveries(self, directives: List[dict],
+                        tasks: Optional[list] = None) -> None:
+        from elasticsearch_tpu.tracing.tasks import (reset_current,
+                                                     set_current)
+
         promoted = False
-        for d in directives:
+        for i, d in enumerate(directives):
+            task = tasks[i] if tasks else None
             ok = False
+            token = None
+            # cancelled while queued: the stream never starts, but the
+            # bookkeeping below MUST still run — skipping it would leave
+            # the target in `initializing` forever (write fanout keeps
+            # targeting a copy whose recovery never ran, and no retry is
+            # ever scheduled because the copy still looks in-flight)
+            cancelled_queued = task is not None and task.cancelled
             try:
-                if d["target"] == self._local_id():
-                    self._on_recover(d)
-                else:
-                    self._send(d["target"], ACTION_RECOVER, d, timeout=120.0)
-                ok = True
+                if not cancelled_queued:
+                    if task is not None:
+                        task.start()
+                        # current-task context: the stream's checkpoints
+                        # (_on_recover / remote shard_sync) see this task
+                        token = set_current(task)
+                    if d["target"] == self._local_id():
+                        self._on_recover(d)
+                    else:
+                        self._send(d["target"], ACTION_RECOVER, d,
+                                   timeout=120.0)
+                    ok = True
             except Exception:
                 pass
+            finally:
+                if token is not None:
+                    reset_current(token)
+                if task is not None:
+                    self.node.tasks.unregister(task)
             with self.cluster._indices_lock:
                 meta = self.cluster.dist_indices.get(d["index"])
                 if meta is None:
@@ -1202,17 +1327,25 @@ class DistributedDataService:
         from elasticsearch_tpu.utils.errors import (DocumentMissingException,
                                                     VersionConflictException)
 
-        for d in res["docs"]:
-            try:
-                # docs AND tombstones ride the stream (a delete that
-                # landed on the source after a racing fanout index on
-                # this copy still wins by version); percolator-registry
-                # maintenance happens atomically with the engine op
-                # (IndexService.replay_op)
-                svc.replay_op(sid, d)
-                copied += 1
-            except (VersionConflictException, DocumentMissingException):
-                skipped += 1  # already newer here (a racing replica write)
+        # child task on the TARGET node (parent: the driving recovery
+        # task, via the wire header): a cancel aborts the replay between
+        # docs, the copy stays INITIALIZING and never graduates
+        with self.node.tasks.task(
+                ACTION_RECOVER + "[t]",
+                description=f"recover [{index}][{sid}] "
+                            f"from {payload['source']}") as task:
+            for d in res["docs"]:
+                task.check_cancelled()
+                try:
+                    # docs AND tombstones ride the stream (a delete that
+                    # landed on the source after a racing fanout index on
+                    # this copy still wins by version); percolator-registry
+                    # maintenance happens atomically with the engine op
+                    # (IndexService.replay_op)
+                    svc.replay_op(sid, d)
+                    copied += 1
+                except (VersionConflictException, DocumentMissingException):
+                    skipped += 1  # already newer (a racing replica write)
         svc.shards[sid].engine.refresh()
         return {"copied": copied, "skipped": skipped}
 
@@ -1265,7 +1398,9 @@ class DistributedDataService:
         agg_lists: List[dict] = []
         for sid in shard_ids:
             searcher = svc.groups[sid].reader().searcher
-            r = searcher.query_phase(body)
+            with self.node.tracer.span("shard.query_phase", index=index,
+                                       shard=sid):
+                r = searcher.query_phase(body)
             docs_out = []
             for d in r.docs:
                 docs_out.append({
@@ -1274,14 +1409,19 @@ class DistributedDataService:
                     "sort": wire.pack(list(d.sort_values)),
                 })
                 pairs.append((searcher, d))
-            shards_out.append({
+            shard_entry = {
                 "shard": sid, "total": r.total_hits,
                 "max_score": (None if np.isnan(r.max_score)
                               else float(r.max_score)),
                 "docs": docs_out,
                 "timed_out": r.timed_out,
                 "terminated_early": r.terminated_early,
-            })
+            }
+            if r.profile is not None:
+                # ?profile=true: the per-shard TPU phase breakdown rides
+                # the query-phase reply (plain ints — wire-safe)
+                shard_entry["profile"] = r.profile
+            shards_out.append(shard_entry)
             if r.agg_partials:
                 agg_lists.extend(r.agg_partials["_list"])
         cid = uuid.uuid4().hex
@@ -1334,7 +1474,26 @@ class DistributedDataService:
     def search(self, index: str, body: Optional[dict] = None) -> dict:
         """Scatter the query phase over every shard owner, merge ranked
         candidates, fetch the selected page from each owner, reduce aggs.
-        Mirrors TransportSearchQueryThenFetchAction's three steps."""
+        Mirrors TransportSearchQueryThenFetchAction's three steps.
+
+        Observability: runs as a registered task under one root span —
+        the wire header carries both, so every remote owner's
+        transport.handle/shard.query_phase spans share this trace id and
+        its shard tasks parent to this one."""
+        with self.node.tasks.task("indices:data/read/search",
+                                  description=f"indices[{index}]"):
+            with self.node.tracer.span("search.coordinate", index=index):
+                resp = self._search_inner(index, body)
+        # slow log at the COORDINATOR: the owner-side query phases call
+        # searcher.query_phase directly, so without this hook a
+        # distributed index's thresholds would silently never fire
+        # (single-node searches record inside IndexService.search)
+        svc = self.node.indices.get(self.resolve_index(index))
+        if svc is not None:
+            svc.slowlog.on_search(resp.get("took", 0), body, resp)
+        return resp
+
+    def _search_inner(self, index: str, body: Optional[dict]) -> dict:
         from elasticsearch_tpu.search.aggregations.base import (parse_aggs,
                                                                 reduce_aggs)
         from elasticsearch_tpu.search.service import (_parse_sort, _sort_key)
@@ -1407,6 +1566,7 @@ class DistributedDataService:
         entries: List[dict] = []
         agg_lists: List[dict] = []
         remote_ctx: Dict[str, str] = {}
+        profiles: List[dict] = []
         total = 0
         max_score = float("-inf")
         timed_out = False
@@ -1417,13 +1577,22 @@ class DistributedDataService:
         failed: List[dict] = list(unassigned)
         owner_order = {nid: i for i, nid in enumerate(sorted(by_owner))}
         svc = self.node.indices.get(index)
+        from elasticsearch_tpu.tracing import check_cancelled
+
         try:
             for owner, sids in sorted(by_owner.items()):
+                # cooperative checkpoint between owners: a cancelled
+                # search stops scattering (already-parked remote contexts
+                # free in the finally)
+                check_cancelled()
                 if owner == local_id:
                     for sid in sids:
                         try:
                             searcher = svc.groups[sid].reader().searcher
-                            r = searcher.query_phase(body)
+                            with self.node.tracer.span(
+                                    "shard.query_phase", index=index,
+                                    shard=sid):
+                                r = searcher.query_phase(body)
                         except Exception as e:
                             # a single bad local shard degrades to a
                             # partial result, same as a dead peer's —
@@ -1438,6 +1607,9 @@ class DistributedDataService:
                             max_score = max(max_score, r.max_score)
                         timed_out |= r.timed_out
                         terminated |= r.terminated_early
+                        if r.profile is not None:
+                            profiles.append(_shard_profile(
+                                owner, index, sid, r.profile))
                         for d in r.docs:
                             entries.append({
                                 "owner": owner, "shard": sid,
@@ -1464,6 +1636,9 @@ class DistributedDataService:
                         max_score = max(max_score, sh["max_score"])
                     timed_out |= sh["timed_out"]
                     terminated |= sh["terminated_early"]
+                    if sh.get("profile"):
+                        profiles.append(_shard_profile(
+                            owner, index, sh["shard"], sh["profile"]))
                     for d in sh["docs"]:
                         entries.append({
                             "owner": owner, "shard": sh["shard"],
@@ -1552,6 +1727,8 @@ class DistributedDataService:
             response["_shards"]["failures"] = failed
         if terminated:
             response["terminated_early"] = True
+        if profiles:
+            response["profile"] = {"shards": profiles}
         agg_tree = parse_aggs(body.get("aggs") or body.get("aggregations"))
         if agg_tree and agg_lists:
             response["aggregations"] = reduce_aggs(agg_tree, agg_lists)
@@ -1572,6 +1749,19 @@ class DistributedDataService:
                 consumed=0 if is_scan else page_size)
             response["hits"]["hits"] = [] if is_scan else full[:page_size]
         return response
+
+
+def _shard_profile(owner: str, index: str, sid: int, tpu: dict) -> dict:
+    """One cross-host ``profile.shards[]`` entry: the owner NODE joins
+    the label (the reference's profile shard ids carry the node id).
+    The envelope time is the timer's MEASURED wall total — phase buckets
+    overlap (topk also files under device_*), so a phase sum would
+    over-report."""
+    from elasticsearch_tpu.tracing.profiler import shard_profile_entry
+
+    return shard_profile_entry(f"[{owner}][{index}][{sid}]",
+                               int((tpu or {}).get("query_total_nanos", 0)),
+                               tpu)
 
 
 def _fetch_grouped(triples: List[Tuple[Any, Any, Any]], body: dict,

@@ -24,8 +24,10 @@ import time
 import zlib
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple
 
+from elasticsearch_tpu.tracing import adopt_wire_context, wire_context
 from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
 from elasticsearch_tpu.utils.faults import FAULTS
+from elasticsearch_tpu.utils.wire import attach_ctx, extract_ctx
 
 
 class TransportError(ElasticsearchTpuException):
@@ -204,6 +206,10 @@ class TransportService:
         self.local_node_id = local_node_id
         self._handlers: Dict[str, Handler] = {}
         self._server: Optional["TcpTransportServer"] = None
+        # optional node tracer (cluster/bootstrap.py wires it): when set,
+        # every remote send and every handled frame records a span, and
+        # the two link into ONE trace via the frame's ctx header
+        self.tracer = None
         self.breaker = PeerBreaker()
         # node-id-derived seed: each node jitters its retries differently
         self.backoff = BackoffPolicy(seed=zlib.crc32(local_node_id.encode()))
@@ -216,6 +222,18 @@ class TransportService:
         if h is None:
             raise TransportError(f"no handler for action [{action}]")
         return h(payload)
+
+    def handle_frame(self, action: str, payload: dict,
+                     ctx: Optional[dict] = None) -> Any:
+        """``handle`` under an adopted wire context: spans opened by the
+        handler join the sender's trace, tasks it registers become
+        children of the sender's task (the receiving half of the
+        observability header both sides of the TCP framing carry)."""
+        with adopt_wire_context(ctx):
+            if self.tracer is not None:
+                with self.tracer.span("transport.handle", action=action):
+                    return self.handle(action, payload)
+            return self.handle(action, payload)
 
     # -- local -----------------------------------------------------------------
 
@@ -237,6 +255,16 @@ class TransportService:
         retry-safe; a failure after the request frame went out
         (ReceiveTimeoutTransportError / TransportError) may have
         executed and only idempotent actions may retry."""
+        if self.tracer is not None:
+            # the send span becomes the wire parent: the peer's handle
+            # span (and any tasks it registers) link under it
+            with self.tracer.span("transport.send", action=action,
+                                  peer=f"{address[0]}:{address[1]}"):
+                return self._send_remote(address, action, payload, timeout)
+        return self._send_remote(address, action, payload, timeout)
+
+    def _send_remote(self, address: Tuple[str, int], action: str,
+                     payload: dict, timeout: float = 5.0) -> Any:
         t0 = time.monotonic()
         try:
             # the injected fault rides the same wrapping as a real
@@ -259,7 +287,9 @@ class TransportService:
                 # slow accept must not leave the recv another full budget
                 sock.settimeout(max(0.001,
                                     timeout - (time.monotonic() - t0)))
-                _send_frame(sock, {"action": action, "payload": payload})
+                _send_frame(sock, attach_ctx(
+                    {"action": action, "payload": payload},
+                    wire_context()))
                 FAULTS.check("transport.recv", action=action,
                              address=address)
                 resp = _recv_frame(sock)
@@ -371,8 +401,9 @@ class TcpTransportServer:
                     if req is None:
                         return
                     try:
-                        result = service.handle(req.get("action", ""),
-                                                req.get("payload", {}))
+                        result = service.handle_frame(
+                            req.get("action", ""), req.get("payload", {}),
+                            ctx=extract_ctx(req))
                         _send_frame(self.request, {"ok": True, "result": result})
                     except ElasticsearchTpuException as e:
                         # typed relay: the caller re-raises with the

@@ -523,7 +523,13 @@ class Engine:
             targets = subset if subset is not None else list(self.segments)
             target_ids = {s.seg_id for s in targets}
             builder = SegmentBuilder(self.mappings)
+            from elasticsearch_tpu.tracing import check_cancelled
+
             for seg in targets:
+                # cooperative cancellation between source segments: a
+                # cancelled force-merge task (POST /_optimize) aborts
+                # before the freeze — nothing committed, nothing lost
+                check_cancelled()
                 live = seg.live_host
                 roots = seg.roots_host
                 for local, doc_id in enumerate(seg.ids):

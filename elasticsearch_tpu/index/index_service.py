@@ -6,6 +6,7 @@ math of org/elasticsearch/cluster/routing/OperationRouting.java
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 from elasticsearch_tpu.analysis.registry import AnalysisRegistry
@@ -79,6 +80,12 @@ class IndexService:
         self._qc_lock = _th.Lock()  # ThreadingHTTPServer: searches race
         self.query_cache_stats = {"hits": 0, "misses": 0, "evictions": 0}
         self.warmers: Dict[str, dict] = {}
+        # search/indexing slow logs (tracing/slowlog.py): thresholds read
+        # from the LIVE settings each record, so dynamic updates through
+        # update_index_settings apply immediately
+        from elasticsearch_tpu.tracing.slowlog import IndexSlowLog
+
+        self.slowlog = IndexSlowLog(name, lambda: self.settings)
         if data_path:
             # gateway recovery (reference: gateway/GatewayService +
             # IndexShardGateway): replay any existing translog on open
@@ -181,9 +188,11 @@ class IndexService:
             # validate BEFORE persisting: an unparseable percolator query
             # must never reach the translog (it would poison recovery)
             self.percolator.validate(source)
+        t0 = time.perf_counter()
         rid, version, created, failed = group.index(doc_id, source, routing=routing, **kw)
         if is_perc:
             self.percolator.register(rid, source)
+        self.slowlog.on_index((time.perf_counter() - t0) * 1000, rid)
         return {
             "_index": self.name,
             "_type": kw.get("doc_type") or "_doc",
@@ -505,6 +514,12 @@ class IndexService:
             return None
         if int(body.get("size", 10)) != 0 or body.get("scroll"):
             return None
+        if body.get("profile"):
+            # a cached profile would replay the FIRST run's timings
+            # (compile>0, retraces>0) for a request that ran nothing —
+            # the reference excludes profiled requests from the request
+            # cache for the same reason
+            return None
         if body.get("search_type") in ("dfs_query_then_fetch", "scan"):
             return None
         try:
@@ -536,6 +551,7 @@ class IndexService:
 
         check_open(self, op="read")
         body = body or {}
+        t0 = time.perf_counter()
         qc_key = None if dfs else self._query_cache_key(body)
         if qc_key is not None:
             import copy as _copy
@@ -566,7 +582,10 @@ class IndexService:
         if self._mesh_enabled():
             # DEFAULT path: the whole scatter/score/merge as one XLA program
             # over the shard mesh (SURVEY §3); host loop only for features
-            # the compiler can't express
+            # the compiler can't express. ?profile=true pins the host
+            # per-segment loop via the mesh's _UNSUPPORTED_KEYS (ONE
+            # mechanism — it also records the mesh_host_by_design
+            # counter, which a second gate here would silently skip).
             from elasticsearch_tpu.parallel.mesh_service import try_mesh_search
 
             resp = try_mesh_search(self, searchers, body, global_stats)
@@ -577,6 +596,7 @@ class IndexService:
             )
         if body.get("suggest"):
             resp["suggest"] = self.suggest(body["suggest"])
+        self.slowlog.on_search((time.perf_counter() - t0) * 1000, body, resp)
         if qc_key is not None:
             import copy as _copy
 

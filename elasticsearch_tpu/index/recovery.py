@@ -16,13 +16,17 @@ from __future__ import annotations
 
 from typing import Optional
 
+from elasticsearch_tpu.tracing import check_cancelled
 from elasticsearch_tpu.utils.errors import VersionConflictException
 
 
 def recover_peer(source_engine, target_engine) -> dict:
     """Copy the source engine's live docs into the target (phase 1 + 2).
 
-    Returns recovery stats (docs copied / skipped)."""
+    Returns recovery stats (docs copied / skipped). Cooperatively
+    cancellable between docs (tracing/tasks.py) — an aborted stream
+    leaves the target partially synced but versioned, so a later retry
+    resumes idempotently."""
     copied = skipped = 0
     # snapshot the id list first: concurrent writes during recovery are
     # handled by versioning, not by locking the whole copy
@@ -31,6 +35,7 @@ def recover_peer(source_engine, target_engine) -> dict:
                for doc_id, loc in source_engine._locations.items()
                if not loc.deleted]
     for doc_id, version, doc_type, parent, routing in ids:
+        check_cancelled()
         got = source_engine.get(doc_id)
         if got is None:  # deleted mid-recovery; phase-2 op will handle it
             skipped += 1

@@ -1,4 +1,9 @@
 """Models: flax dual encoder for dense-retrieval embeddings (SURVEY §2.12)."""
+# retrace auditor before any jit binds (see ops/__init__.py)
+from elasticsearch_tpu.tracing import retrace as _retrace
+
+_retrace.ensure_installed()
+
 from elasticsearch_tpu.models.dual_encoder import (
     DualEncoderConfig,
     SimpleTokenizer,

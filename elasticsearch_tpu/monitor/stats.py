@@ -133,6 +133,26 @@ def record_corrupt_tail(path: str, bytes_dropped: int, reason: str) -> None:
     TRANSLOG_RECOVERY.record(path, bytes_dropped, reason)
 
 
+def aggregate_slowlog(index_services) -> dict:
+    """Node-wide slow-operation gauge for ``/_nodes``, aggregated from
+    THIS node's own indices' slow-log rings (tracing/slowlog.py). NOT a
+    process-global singleton: several in-process nodes (the multi-host
+    test harness, embedded setups) must each report only their own slow
+    ops — the same per-node discipline translog_recovery follows. The
+    per-entry detail (source, took, level) stays in the per-index
+    rings; this is the one-glance number a dashboard polls to notice an
+    index going slow before digging into which one."""
+    search_total = indexing_total = 0
+    for svc in index_services:
+        sl = getattr(svc, "slowlog", None)
+        if sl is None:
+            continue
+        search_total += sl.query.total
+        indexing_total += sl.index.total
+    return {"search_slow_total": search_total,
+            "indexing_slow_total": indexing_total}
+
+
 def process_stats() -> dict:
     """Process-level stats (reference: ProcessService → _nodes/stats.process)."""
     import resource

@@ -45,6 +45,12 @@ class Node:
             "persistent": {}, "transient": {}}
         self.cluster_state = ClusterState(cluster_name)
         self.cluster_state.add_node(DiscoveryNode(self.node_id, name), master=True)
+        # observability: span tracer + task registry (reference: the
+        # TaskManager every TransportService carries; tracing/__init__.py)
+        from elasticsearch_tpu.tracing import TaskRegistry, Tracer
+
+        self.tasks = TaskRegistry(self.node_id)
+        self.tracer = Tracer(self.node_id)
         # lazy: pools spin worker threads, so library-embedded Nodes that
         # never serve REST traffic don't pay for them
         self._thread_pool = None
@@ -565,6 +571,7 @@ class Node:
 
     def nodes_stats(self) -> dict:
         from elasticsearch_tpu.monitor.stats import (TRANSLOG_RECOVERY,
+                                                     aggregate_slowlog,
                                                      device_stats, os_stats,
                                                      process_stats)
 
@@ -642,6 +649,13 @@ class Node:
                     # transport info (reference: NodeInfo transport section;
                     # profiles {} = no extra transport profiles configured)
                     "transport": self._transport_info(),
+                    # observability: in-flight/completed tasks + span ring
+                    # + per-NODE slow-op counters (this node's indices
+                    # only — in-process multi-node setups must not bleed
+                    # counts across nodes)
+                    "tasks": self.tasks.stats(),
+                    "tracing": self.tracer.stats(),
+                    "slowlog": aggregate_slowlog(self.indices.values()),
                     # TPU-native extra: device kind + HBM usage
                     "accelerator": device_stats(),
                 }

@@ -1,3 +1,11 @@
+# patch jax.jit with the retrace auditor BEFORE the imports below bind
+# `@jax.jit` decorators — the search profiler's device compile/execute
+# split depends on it (tracing/retrace.py); this package pulls in jax
+# anyway, so the root elasticsearch_tpu import stays light
+from elasticsearch_tpu.tracing import retrace as _retrace
+
+_retrace.ensure_installed()
+
 from elasticsearch_tpu.ops.scoring import (
     bm25_score_segment,
     bm25_score_batch,

@@ -776,23 +776,29 @@ def bucket_sum(values, bucket_ids, weights, *, num_buckets: int):
 
 
 @partial(jax.jit, static_argnames=("num_buckets", "scatter_free"))
-def _bucket_count_jit(bucket_ids, weights, *, num_buckets: int,
+def _bucket_count_jit(bucket_ids, mask, *, num_buckets: int,
                       scatter_free: bool):
+    # `mask` is a 0/1 SELECTION mask, never fractional weights: the
+    # scatter-free branch is a selected-id histogram (sort + boundary
+    # diffs — the len(ids)-element scatter serializes on TPU) and would
+    # silently diverge from the scatter-add branch for any other value.
+    # Weighted reductions belong in bucket_sum.
     if scatter_free:
-        # weights are 0/1 selection masks at every call site, so counting
-        # = histogram of the selected ids: sort + boundary diffs (the
-        # len(ids)-element scatter serializes on TPU)
-        ids = jnp.where(weights > 0, bucket_ids, num_buckets)
+        ids = jnp.where(mask > 0, bucket_ids, num_buckets)
         sids = jnp.sort(ids)
         bounds = jnp.searchsorted(
             sids, jnp.arange(num_buckets + 1, dtype=sids.dtype))
         return (bounds[1:] - bounds[:-1]).astype(jnp.float32)
     out = jnp.zeros(num_buckets, dtype=jnp.float32)
-    return out.at[bucket_ids].add(weights, mode="drop")
+    return out.at[bucket_ids].add(mask, mode="drop")
 
 
-def bucket_count(bucket_ids, weights, *, num_buckets: int):
-    """Selected-id histogram (weights MUST be a 0/1 mask). Eager wrapper:
-    reads the platform scatter-free switch outside jit."""
-    return _bucket_count_jit(bucket_ids, weights, num_buckets=num_buckets,
+def bucket_count(bucket_ids, mask, *, num_buckets: int):
+    """Selected-id histogram. ``mask`` MUST be a 0/1 selection mask —
+    the parameter is named to make a fractional-weights call read wrong
+    at the call site (ADVICE r5: the TPU scatter-free branch computes a
+    histogram, not a weighted sum, so non-mask values diverge from the
+    CPU branch with no error). Eager wrapper: reads the platform
+    scatter-free switch outside jit."""
+    return _bucket_count_jit(bucket_ids, mask, num_buckets=num_buckets,
                              scatter_free=tail_mode_batch())

@@ -4,6 +4,11 @@ Replaces the reference's scatter/gather transport layer
 (org/elasticsearch/action/search/type/*.java over netty) with XLA
 collectives over a `jax.sharding.Mesh` — see executor.py.
 """
+# retrace auditor before any jit binds (see ops/__init__.py)
+from elasticsearch_tpu.tracing import retrace as _retrace
+
+_retrace.ensure_installed()
+
 from elasticsearch_tpu.parallel.mesh import shard_mesh, training_mesh, mesh_size
 from elasticsearch_tpu.parallel.executor import MeshSearchExecutor
 from elasticsearch_tpu.parallel.placement import allocate, placement_table

@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.tracing import TaskCancelledException
 from elasticsearch_tpu.utils.errors import (
     ElasticsearchTpuException,
     IllegalArgumentException,
@@ -28,6 +29,10 @@ from elasticsearch_tpu.utils.errors import (
 )
 
 Handler = Callable[..., Tuple[int, Any]]
+
+# guards the get-or-register of a scroll context's persistent task
+# (rest/_scroll): concurrent pages for one scroll_id race on it
+_SCROLL_TASK_LOCK = threading.Lock()
 
 
 class RestController:
@@ -178,6 +183,17 @@ def _register_all(rc: RestController):
     add("GET", "/_nodes", _nodes_info)
     add("GET", "/_stats", lambda n, p, b: _index_stats(n, p, b, None))
 
+    # task management API over tracing/tasks.py (reference: rest/action/
+    # admin/cluster/node/tasks — RestListTasksAction, RestCancelTasksAction)
+    add("GET", "/_tasks", _tasks_list)
+    add("GET", "/_tasks/{task_id}", _task_get)
+    add("POST", "/_tasks/{task_id}/_cancel", _task_cancel)
+    add("GET", "/_cat/tasks", _cat_tasks)
+    # chrome-trace dump of the local span ring (tracing/tracer.py) —
+    # registered before the /_nodes/{nodeid}/... patterns so the literal
+    # path wins
+    add("GET", "/_nodes/_local/trace", _node_trace)
+
     # cat API (text/plain-ish, returned as JSON rows when format=json)
     add("GET", "/_cat/indices", _cat_indices)
     add("GET", "/_cat/health", _cat_health)
@@ -195,7 +211,7 @@ def _register_all(rc: RestController):
     add("GET", "/_cat/segments", _cat_segments)
     add("GET", "/_cat/recovery", _cat_recovery)
     add("GET", "/_cat/plugins", lambda n, p, b: (200, []))
-    add("GET", "/_cat/pending_tasks", lambda n, p, b: (200, []))
+    add("GET", "/_cat/pending_tasks", _cat_pending_tasks)
     add("GET", "/_cat/thread_pool", _cat_thread_pool)
     add("GET", "/_cat/fielddata", _cat_fielddata)
     add("GET", "/_cat/repositories", lambda n, p, b: (200, [
@@ -208,7 +224,7 @@ def _register_all(rc: RestController):
     # snapshot + /{index} blocks so literal _-prefixed paths win.
     add("GET", "/_cluster/settings", _cluster_get_settings)
     add("PUT", "/_cluster/settings", _cluster_put_settings)
-    add("GET", "/_cluster/pending_tasks", lambda n, p, b: (200, {"tasks": []}))
+    add("GET", "/_cluster/pending_tasks", _cluster_pending_tasks)
     add("POST", "/_cluster/reroute", _cluster_reroute)
     add("GET", "/_nodes/hot_threads", _hot_threads)
     add("GET", "/_nodes/{nodeid}/hot_threads",
@@ -987,6 +1003,8 @@ _CAT_HELP = {
     "nodes": ["host", "ip", "heap.percent", "ram.percent", "load",
               "node.role", "master", "name"],
     "pending_tasks": ["insertOrder", "timeInQueue", "priority", "source"],
+    "tasks": ["action", "task_id", "parent_task_id", "type", "start_time",
+              "running_time", "node"],
     "plugins": ["id", "name", "component", "version", "type", "url",
                 "description"],
     "recovery": ["index", "shard", "time", "type", "stage", "source_host",
@@ -1075,7 +1093,8 @@ def _cat_health(n: Node, p, b):
         "node.data": str(h["number_of_nodes"]),
         "shards": str(h["active_shards"]),
         "pri": str(h["active_shards"]), "relo": "0", "init": "0",
-        "unassign": "0", "pending_tasks": "0",
+        "unassign": "0",
+        "pending_tasks": str(len(_all_pending_tasks(n, p))),
     }]
 
 
@@ -1523,8 +1542,11 @@ def _flush(n: Node, p, b, index: str):
 def _optimize(n: Node, p, b, index: str):
     max_seg = int(p.get("max_num_segments", 1))
     names = n.resolve_indices(index)
-    for name in names:
-        n.indices[name].force_merge(max_seg)
+    # cancellable task: engine.merge checkpoints between source segments
+    with n.tasks.task("indices:admin/optimize",
+                      description=f"force-merge {names}"):
+        for name in names:
+            n.indices[name].force_merge(max_seg)
     return 200, {"_shards": _shards_header(n, names)}
 
 
@@ -1614,6 +1636,187 @@ def _do_analyze(reg, body: dict, svc=None) -> dict:
         for tok, pos in analyzer.analyze(t):
             tokens.append({"token": tok, "position": pos, "type": "<ALPHANUM>"})
     return {"tokens": tokens}
+
+
+# -- task management (tracing/tasks.py) ---------------------------------------
+
+def _split_task_id(task_id: str):
+    """"node:seq" → (node, seq); a bare number targets the local node."""
+    node_id, _, num = str(task_id).rpartition(":")
+    if not num.isdigit():
+        raise IllegalArgumentException(
+            f"malformed task id [{task_id}] (expected nodeId:taskNumber)")
+    return node_id, int(num)
+
+
+def _local_tasks_entry(n: Node, p) -> dict:
+    tasks = {t.tagged_id: t.to_json()
+             for t in n.tasks.list_tasks(actions=p.get("actions"))}
+    return {n.node_id: {
+        "name": n.name,
+        "transport_address": n._transport_info()["publish_address"],
+        "tasks": tasks}}
+
+
+def _tasks_list(n: Node, p, b):
+    """GET /_tasks (RestListTasksAction): every node's in-flight tasks.
+    Multi-host fans through the REST proxy (each member reports its own
+    registry); a dead peer lands in ``node_failures``, never silently
+    missing — its tasks are exactly what an operator hunting a runaway
+    delete-by-query needs to see."""
+    out: Dict[str, Any] = {"nodes": _local_tasks_entry(n, p)}
+    mh = _mh(n)
+    if mh is not None and "_local_only" not in p:
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        failures = []
+        params = {k: p[k] for k in ("actions",) if k in p}
+        for nid in mh.data._other_nodes():
+            try:
+                res = mh.data._send(nid, ACTION_REST_PROXY, {
+                    "method": "GET", "path": "/_tasks", "params": params})
+                if res.get("status") == 200:
+                    out["nodes"].update(
+                        (res.get("payload") or {}).get("nodes", {}))
+            except Exception as e:
+                failures.append({"node_id": nid, "reason": str(e)})
+        if failures:
+            out["node_failures"] = failures
+    return 200, out
+
+
+def _task_get(n: Node, p, b, task_id: str):
+    """GET /_tasks/{id}: the task's detail from its owning node."""
+    from elasticsearch_tpu.tracing.tasks import ResourceNotFoundException
+
+    node_id, num = _split_task_id(task_id)
+    if node_id in ("", "_local", n.node_id):
+        t = n.tasks.get(num)
+        if t is None:
+            raise ResourceNotFoundException(
+                f"task [{task_id}] isn't running and hasn't stored its "
+                "results")
+        return 200, {"completed": False, "task": t.to_json()}
+    mh = _mh(n)
+    if mh is not None and "_local_only" not in p \
+            and node_id in n.cluster_state.nodes:
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        res = mh.data._send(node_id, ACTION_REST_PROXY, {
+            "method": "GET", "path": f"/_tasks/{task_id}", "params": {}})
+        return res["status"], res["payload"]
+    # not a member (typo'd or departed node): 404, never a generic 500
+    # from an unresolvable transport address
+    raise ResourceNotFoundException(
+        f"task [{task_id}] belongs to an unknown node")
+
+
+def _task_cancel(n: Node, p, b, task_id: str):
+    """POST /_tasks/{id}/_cancel (RestCancelTasksAction): cancel the task
+    AND its descendants — local children directly, remote children via
+    the parent-id fanout (cluster/search_action.py::cancel_task_children),
+    so cancelling a coordinator by-query stops the remote shard scans."""
+    node_id, num = _split_task_id(task_id)
+    mh = _mh(n)
+    if node_id in ("", "_local", n.node_id):
+        reason = "by user request"
+        cancelled = n.tasks.cancel(num, reason)  # 404s when absent
+        out: Dict[str, Any] = {"nodes": {}}
+        if cancelled:
+            out["nodes"][n.node_id] = {
+                "name": n.name,
+                "tasks": {t.tagged_id: t.to_json() for t in cancelled}}
+        if mh is not None:
+            remote = mh.data.cancel_task_children(n.node_id, num, reason)
+            out["nodes"].update(remote.get("nodes", {}))
+            if remote.get("node_failures"):
+                out["node_failures"] = remote["node_failures"]
+        return 200, out
+    if mh is not None and "_local_only" not in p \
+            and node_id in n.cluster_state.nodes:
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        # the task lives on another member: relay — the owner cancels
+        # locally and runs the child fanout itself
+        res = mh.data._send(node_id, ACTION_REST_PROXY, {
+            "method": "POST", "path": f"/_tasks/{task_id}/_cancel",
+            "params": {}})
+        return res["status"], res["payload"]
+    from elasticsearch_tpu.tracing.tasks import ResourceNotFoundException
+
+    # not a member (typo'd or departed node): 404, never a generic 500
+    # from an unresolvable transport address
+    raise ResourceNotFoundException(
+        f"task [{task_id}] belongs to an unknown node")
+
+
+def _cat_tasks(n: Node, p, b):
+    """GET /_cat/tasks: the /_tasks listing as cat rows."""
+    _status, body = _tasks_list(n, p, b)
+    rows = []
+    for nid, entry in sorted(body["nodes"].items()):
+        for tid, t in sorted(entry.get("tasks", {}).items()):
+            rows.append({
+                "action": t.get("action", ""),
+                "task_id": tid,
+                "parent_task_id": t.get("parent_task_id", "-"),
+                "type": t.get("type", "transport"),
+                "start_time": str(t.get("start_time_in_millis", "")),
+                "running_time": f"{t.get('running_time_in_nanos', 0) // 1_000_000}ms",
+                "node": entry.get("name", nid),
+                "description": t.get("description", ""),
+            })
+    return 200, _cat_rows(rows, ["action", "task_id", "parent_task_id",
+                                 "type", "start_time", "running_time",
+                                 "node"])
+
+
+def _all_pending_tasks(n: Node, p) -> List[dict]:
+    """Cluster-wide pending set: the local registry plus every member's
+    (recovery streams queue on whichever member scheduled them, so a
+    local-only view would show 0 to an operator polling a different
+    node). Best-effort like nodes_fan — a dead peer's queue is
+    unknowable and simply absent."""
+    rows = list(n.tasks.pending_tasks())
+    mh = _mh(n)
+    if mh is not None and "_local_only" not in p:
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        for nid in mh.data._other_nodes():
+            try:
+                res = mh.data._send(nid, ACTION_REST_PROXY, {
+                    "method": "GET", "path": "/_cluster/pending_tasks",
+                    "params": {}})
+            except Exception:
+                continue  # unreachable peer: its queue stays absent
+            if res.get("status") == 200:
+                rows.extend((res.get("payload") or {}).get("tasks", []))
+    return rows
+
+
+def _cluster_pending_tasks(n: Node, p, b):
+    """GET /_cluster/pending_tasks: queued-but-not-running tasks (e.g.
+    recovery streams waiting behind earlier ones) from the registries
+    of EVERY member — the reference reports the master's cluster-state
+    update queue; our serialized queue-like work is the pending task
+    set."""
+    return 200, {"tasks": _all_pending_tasks(n, p)}
+
+
+def _cat_pending_tasks(n: Node, p, b):
+    rows = [{"insertOrder": str(t["insert_order"]),
+             "timeInQueue": t["time_in_queue"],
+             "priority": t["priority"],
+             "source": t["source"]} for t in _all_pending_tasks(n, p)]
+    return 200, _cat_rows(rows, ["insertOrder", "timeInQueue", "priority",
+                                 "source"])
+
+
+def _node_trace(n: Node, p, b):
+    """GET /_nodes/_local/trace: the local span ring in Chrome
+    trace-event format for offline flamegraph inspection (chrome://
+    tracing / Perfetto / speedscope)."""
+    return 200, n.tracer.chrome_trace()
 
 
 # -- document handlers --------------------------------------------------------
@@ -2041,21 +2244,35 @@ def _delete_by_query(n: Node, p, b, index: str):
     body = _json(b)
     counts = {"deleted": 0}
     failures: list = []
+    processed: set = set()
 
     def apply(doc_id, loc):
         # docs indexed with routing/parent don't route by id — the stored
         # routing comes off the location table; EVERY live copy is walked
         # (the same id can live on several shards under different routings)
+        processed.add(doc_id)
         try:
             svc.delete_doc(doc_id, routing=loc.routing if loc else None)
             counts["deleted"] += 1
         except ElasticsearchTpuException as e:
             failures.append(failure_entry(svc.name, doc_id, e))
 
-    seen = run_by_query(svc, body.get("query"), apply)
-    return 200, {"took": 0, "deleted": counts["deleted"],
-                 "total": len(seen), "failures": failures,
-                 "timed_out": False}
+    # cancellable task: the scan loop's checkpoints (search/byquery.py)
+    # stop between docs; a cancelled run reports the PARTIAL counts with
+    # "canceled" (reference: BulkByScrollResponse reasonCancelled)
+    canceled = None
+    with n.tasks.task("indices:data/write/delete/byquery",
+                      description=f"delete-by-query [{index}]"):
+        try:
+            run_by_query(svc, body.get("query"), apply)
+        except TaskCancelledException as e:
+            canceled = str(e)
+    out = {"took": 0, "deleted": counts["deleted"],
+           "total": len(processed), "failures": failures,
+           "timed_out": False}
+    if canceled is not None:
+        out["canceled"] = canceled
+    return 200, out
 
 
 def _update_by_query(n: Node, p, b, index: str):
@@ -2073,9 +2290,11 @@ def _update_by_query(n: Node, p, b, index: str):
     s_params = body.get("params")  # 2.0 form: sibling body params
     counts = {"updated": 0, "noops": 0}
     failures: list = []
+    processed: set = set()
 
     def apply(doc_id, loc):
         routing = loc.routing if loc else None
+        processed.add(doc_id)
         try:
             if script is not None:
                 svc.update_doc(doc_id,
@@ -2105,10 +2324,19 @@ def _update_by_query(n: Node, p, b, index: str):
         except ElasticsearchTpuException as e:
             failures.append(failure_entry(svc.name, doc_id, e))
 
-    seen = run_by_query(svc, body.get("query"), apply)
-    return 200, {"took": 0, "updated": counts["updated"],
-                 "total": len(seen), "noops": counts["noops"],
-                 "failures": failures, "timed_out": False}
+    canceled = None
+    with n.tasks.task("indices:data/write/update/byquery",
+                      description=f"update-by-query [{index}]"):
+        try:
+            run_by_query(svc, body.get("query"), apply)
+        except TaskCancelledException as e:
+            canceled = str(e)
+    out = {"took": 0, "updated": counts["updated"],
+           "total": len(processed), "noops": counts["noops"],
+           "failures": failures, "timed_out": False}
+    if canceled is not None:
+        out["canceled"] = canceled
+    return 200, out
 
 
 def _mget_one(n: Node, spec: dict, default_index: Optional[str], p) -> dict:
@@ -2284,6 +2512,12 @@ def _search_body(p, b) -> dict:
         body["scroll"] = p["scroll"]
     if "search_type" in p:
         body["search_type"] = p["search_type"]
+    prof_p = p.get("profile")
+    if prof_p is not None and str(prof_p).lower() in ("", "1", "true"):
+        # ?profile=true (case-insensitive, like the other boolean
+        # params): per-shard phase breakdown with the device
+        # compile/execute split (tracing/profiler.py)
+        body["profile"] = True
     if "timeout" in p:
         # ?timeout= caps the per-shard collect loops AND (on distributed
         # indices) the coordinator's scatter/fetch deadline — blown
@@ -2329,9 +2563,14 @@ def _search(n: Node, p, b, index: str):
     data = _mh_for(n, index)
     if data is not None:
         # distributed index: scatter the query phase to shard-owner
-        # processes, merge, fetch (cluster/search_action.py)
+        # processes, merge, fetch (cluster/search_action.py — registers
+        # its own coordinator task + root span)
         return 200, data.search(index, _search_body(p, b))
-    return 200, n.search(index, _search_body(p, b), preference=p.get("preference"))
+    with n.tasks.task("indices:data/read/search",
+                      description=f"indices[{index}]"):
+        with n.tracer.span("search", index=index):
+            return 200, n.search(index, _search_body(p, b),
+                                 preference=p.get("preference"))
 
 
 def _search_typed(n: Node, p, b, index: str, type: str):
@@ -2351,7 +2590,11 @@ def _count_typed(n: Node, p, b, index: str, type: str):
 
 
 def _search_all(n: Node, p, b):
-    return 200, n.search(None, _search_body(p, b), preference=p.get("preference"))
+    with n.tasks.task("indices:data/read/search",
+                      description="indices[_all]"):
+        with n.tracer.span("search", index="_all"):
+            return 200, n.search(None, _search_body(p, b),
+                                 preference=p.get("preference"))
 
 
 def _msearch(n: Node, p, b, index: Optional[str] = None,
@@ -2374,15 +2617,58 @@ def _msearch_index(n: Node, p, b, index: str):
 
 
 def _scroll(n: Node, p, b):
-    from elasticsearch_tpu.search.service import scroll_next
+    from elasticsearch_tpu.search.service import (clear_scroll,
+                                                  scroll_next,
+                                                  scroll_state)
+    from elasticsearch_tpu.tracing.tasks import reset_current, set_current
 
     body = _json(b)
     sid = body.get("scroll_id", p.get("scroll_id"))
-    return 200, scroll_next(sid)
+    # ONE persistent task per scroll CONTEXT, not per page: it lives on
+    # the state across page requests, so an operator can find a client
+    # draining a huge scroll in /_tasks and cancel it — the NEXT page
+    # hits the checkpoint, returns the typed 400, and the context frees.
+    # (A per-page task would unregister microseconds after it appeared;
+    # the cancel could never land.)
+    state = scroll_state(sid) if sid else None
+    task = None
+    if state is not None:
+
+        def _free_on_cancel(t, _sid=sid):
+            # EAGER cleanup on the cancelling thread: an abandoned
+            # client may never send the next page, so the context (a
+            # full snapshot) and the task must not wait on it — later
+            # pages 404 as a missing context, like a cleared scroll; a
+            # page already in flight raises at its checkpoint (the
+            # typed 400)
+            clear_scroll(_sid)
+            n.tasks.unregister(t)
+
+        # under a lock: two concurrent pages for one scroll_id
+        # (ThreadingHTTPServer + a client retry) must not EACH register
+        # a task — the loser would be a permanent ghost /_tasks row
+        with _SCROLL_TASK_LOCK:
+            task = state.get("_task")
+            if task is None or n.tasks.get(task.id) is not task:
+                # on_cancel rides register(): the task is cancellable
+                # the instant it publishes, and a cancel before a late
+                # assignment would lose the cleanup forever
+                task = n.tasks.register(
+                    "indices:data/read/scroll",
+                    description=f"scroll [{str(sid)[:16]}]",
+                    on_cancel=_free_on_cancel)
+                state["_task"] = task
+    token = set_current(task) if task is not None else None
+    try:
+        return 200, scroll_next(sid)
+    finally:
+        if token is not None:
+            reset_current(token)
 
 
 def _clear_scroll(n: Node, p, b):
-    from elasticsearch_tpu.search.service import clear_scroll
+    from elasticsearch_tpu.search.service import (clear_scroll,
+                                                  scroll_state)
     from elasticsearch_tpu.utils.errors import \
         SearchContextMissingException
 
@@ -2390,6 +2676,11 @@ def _clear_scroll(n: Node, p, b):
     ids = body.get("scroll_id", p.get("scroll_id", []))
     if isinstance(ids, str):
         ids = ids.split(",")
+    for s in ids:
+        st = scroll_state(s)
+        if st is not None and st.get("_task") is not None:
+            # the context's persistent scroll task dies with it
+            n.tasks.unregister(st["_task"])
     freed = sum(1 for s in ids if clear_scroll(s))
     if ids and ids != ["_all"] and freed == 0:
         raise SearchContextMissingException(
@@ -3071,7 +3362,7 @@ def _cluster_health(n: Node, p, b):
     level=indices adds per-index sections (our single-node health is
     uniform, so each index reports its own shard counts)."""
     h = dict(n.cluster_state.health())
-    h.setdefault("number_of_pending_tasks", 0)
+    h["number_of_pending_tasks"] = len(_all_pending_tasks(n, p))
     h.setdefault("number_of_in_flight_fetch", 0)
     h.setdefault("delayed_unassigned_shards", 0)
     h.setdefault("task_max_waiting_in_queue_millis", 0)
@@ -4199,8 +4490,8 @@ def _cat_help(n: Node, p, b):
         "/_cat/fielddata", "/_cat/health", "/_cat/indices", "/_cat/master",
         "/_cat/nodes", "/_cat/pending_tasks", "/_cat/plugins",
         "/_cat/recovery", "/_cat/repositories", "/_cat/segments",
-        "/_cat/shards", "/_cat/snapshots/{repository}", "/_cat/templates",
-        "/_cat/thread_pool",
+        "/_cat/shards", "/_cat/snapshots/{repository}", "/_cat/tasks",
+        "/_cat/templates", "/_cat/thread_pool",
     ])
 
 

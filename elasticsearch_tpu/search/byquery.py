@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Set
 
+from elasticsearch_tpu.tracing import check_cancelled
+
 
 def scan_ids(svc, query: Optional[dict], seen: Set[str]) -> list:
     """One scan round of unseen matching ids. The in-page `new` set
@@ -35,13 +37,22 @@ def run_by_query(svc, query: Optional[dict],
     doc_type / parent; None when the location table has no entry).
     Refreshes between rounds so deletes/updates shift the next scan.
     Returns the set of processed ids; the caller shapes counts/failures
-    inside apply_fn."""
+    inside apply_fn.
+
+    Cooperative cancellation (tracing/tasks.py): a checkpoint runs
+    before every scan round and before every per-doc apply — when the
+    surrounding task is cancelled, TaskCancelledException surfaces to
+    the caller between docs, with everything applied so far already
+    durable (the reference's AbstractAsyncBulkByScrollAction stops at
+    the same bulk-boundary granularity)."""
     seen: Set[str] = set()
     while True:
+        check_cancelled()
         ids = scan_ids(svc, query, seen)
         if not ids:
             return seen
         for doc_id in ids:
+            check_cancelled()
             seen.add(doc_id)
             for loc in (svc.find_doc_locations(doc_id) or [None]):
                 apply_fn(doc_id, loc)

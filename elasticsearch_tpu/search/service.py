@@ -56,6 +56,8 @@ class QueryPhaseResult:
     full: Optional[List[Tuple[Any, np.ndarray, np.ndarray]]] = None
     terminated_early: bool = False
     timed_out: bool = False
+    # ?profile=true: TPU phase breakdown (tracing/profiler.py), JSON-safe
+    profile: Optional[dict] = None
 
 
 def _parse_timeout(v) -> Optional[float]:
@@ -95,10 +97,26 @@ class ShardSearcher:
     def query_phase(self, body: dict, global_stats: Optional[GlobalStats] = None,
                     collect_full: bool = False) -> QueryPhaseResult:
         jnp = _jnp()
-        query = parse_query(body.get("query"))
         from elasticsearch_tpu.search.joins import prepare_tree
 
-        prepare_tree(query, self.segments, self.mappings, self.analysis, global_stats)
+        # ?profile=true: per-phase timing with device compile/execute
+        # split (tracing/profiler.py). Scroll snapshots profile nothing —
+        # their cost is the snapshot, not the phases.
+        from contextlib import nullcontext
+
+        prof = None
+        if body.get("profile") and not collect_full:
+            from elasticsearch_tpu.tracing.profiler import PhaseTimer
+
+            prof = PhaseTimer()
+
+        def _p(name: str):
+            return prof.phase(name) if prof is not None else nullcontext()
+
+        with _p("rewrite"):
+            query = parse_query(body.get("query"))
+            prepare_tree(query, self.segments, self.mappings, self.analysis,
+                         global_stats)
         aggs = parse_aggs(body.get("aggs") or body.get("aggregations"))
         size = int(body.get("size", 10))
         frm = int(body.get("from", 0))
@@ -167,13 +185,23 @@ class ShardSearcher:
             if terminate_after is not None and total >= terminate_after:
                 terminated_early = True
                 break
-            ctx = SegmentContext(seg, self.mappings, self.analysis, global_stats,
-                                 all_segments=self.segments,
-                                 index_name=self.index_name)
+            with _p("executor_build"):
+                ctx = SegmentContext(seg, self.mappings, self.analysis,
+                                     global_stats,
+                                     all_segments=self.segments,
+                                     index_name=self.index_name)
+            if prof is not None:
+                prof.segments += 1
             if fused_ok and not seg.has_nested:
                 from elasticsearch_tpu.search.queries import fused_bm25_topk
 
-                fused = fused_bm25_topk(ctx, query, min(k, seg.max_docs))
+                if prof is not None:
+                    fused = prof.device_call(
+                        lambda: fused_bm25_topk(ctx, query,
+                                                min(k, seg.max_docs)),
+                        bucket="topk")
+                else:
+                    fused = fused_bm25_topk(ctx, query, min(k, seg.max_docs))
                 if fused is not None:
                     vals, ids, seg_total = fused
                     total += seg_total
@@ -185,7 +213,11 @@ class ShardSearcher:
                             docs.append(ShardDoc(self.shard_ord, seg,
                                                  int(i), float(v)))
                     continue
-            scores, mask = query.score_or_mask(ctx)
+            if prof is not None:
+                scores, mask = prof.device_call(
+                    lambda: query.score_or_mask(ctx))
+            else:
+                scores, mask = query.score_or_mask(ctx)
             mask = mask & seg.live
             if seg.has_nested:
                 # top-level hits are root docs only; nested children are
@@ -196,12 +228,15 @@ class ShardSearcher:
                 mask = mask & (scores >= float(min_score))
             tot_dev = jnp.sum(mask.astype(jnp.int32))
             if aggs:
-                agg_partials.append(run_aggs(aggs, ctx, mask))
+                with _p("aggs"):
+                    agg_partials.append(run_aggs(aggs, ctx, mask))
             if sort_spec:
                 total += int(tot_dev)
                 seg_k = seg.max_docs if collect_full else k
-                seg_docs = self._sorted_candidates(ctx, scores, mask, sort_spec,
-                                                   seg_k, search_after)
+                with _p("topk"):
+                    seg_docs = self._sorted_candidates(ctx, scores, mask,
+                                                       sort_spec, seg_k,
+                                                       search_after)
             elif full_snap is not None:
                 total += int(tot_dev)
                 sc = np.asarray(scores)
@@ -227,10 +262,20 @@ class ShardSearcher:
                     pack_topk_result, unpack_topk_result)
 
                 kk = min(k, seg.max_docs)
-                vals, idx = topk_with_mask(scores, mask, k=kk)
-                # ONE host transfer: per-array pulls each pay a fixed
-                # device round-trip (network-attached chips: ~5-20 ms)
-                packed = np.asarray(pack_topk_result(vals, idx, tot_dev))
+                if prof is not None:
+                    vals, idx = prof.device_call(
+                        lambda: topk_with_mask(scores, mask, k=kk),
+                        bucket="topk")
+                    packed_dev = prof.device_call(
+                        lambda: pack_topk_result(vals, idx, tot_dev))
+                    with prof.phase("host_sync"):
+                        packed = np.asarray(packed_dev)
+                else:
+                    vals, idx = topk_with_mask(scores, mask, k=kk)
+                    # ONE host transfer: per-array pulls each pay a fixed
+                    # device round-trip (network-attached chips: ~5-20 ms)
+                    packed = np.asarray(pack_topk_result(vals, idx,
+                                                         tot_dev))
                 vals, idx, tot = unpack_topk_result(packed, kk)
                 total += tot
                 seg_docs = [
@@ -269,6 +314,7 @@ class ShardSearcher:
             full=full_snap,
             terminated_early=terminated_early,
             timed_out=timed_out,
+            profile=prof.to_json() if prof is not None else None,
         )
 
     def _sorted_candidates(self, ctx, scores, mask, sort_spec, k, search_after):
@@ -560,14 +606,12 @@ def search_shards(
         s.stats.on_query(q_ms, groups=body.get("stats"))
         results.append(r)
         if profile:
-            shard_profiles.append({
-                "id": f"[shard][{pos}]",
-                "searches": [{"query": [{
-                    "type": "CompiledSegmentProgram",
-                    "description": "whole-segment score/mask program",
-                    "time_in_nanos": int(q_ms * 1e6),
-                }]}],
-            })
+            from elasticsearch_tpu.tracing.profiler import \
+                shard_profile_entry
+
+            shard_profiles.append(shard_profile_entry(
+                f"[{s.index_name or index_name or 'shard'}][{pos}]",
+                int(q_ms * 1e6), r.profile))
     # indices_boost: per-index score multipliers applied BEFORE the global
     # merge (reference: SearchRequest.indicesBoost / query-phase boost)
     ib = body.get("indices_boost")
@@ -731,6 +775,11 @@ def register_scroll_hits(body: dict, hits: List[dict], total: int,
 
 
 def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
+    # cooperative cancellation: a scroll drained under a registered task
+    # (REST /_search/scroll) stops paging when that task is cancelled
+    from elasticsearch_tpu.tracing import check_cancelled
+
+    check_cancelled()
     state = _SCROLLS.get(scroll_id)
     if state is None:
         from elasticsearch_tpu.utils.errors import \
@@ -775,6 +824,13 @@ def scroll_next(scroll_id: str, size: Optional[int] = None) -> dict:
         "hits": {"total": state["total"], "max_score": None,
                  "hits": [h for h, _ in hd]},
     }
+
+
+def scroll_state(scroll_id: str) -> Optional[dict]:
+    """The live scroll context for ``scroll_id`` (None when unknown) —
+    the REST layer attaches its persistent scroll TASK here so the same
+    task spans every page of one drain (rest/server.py::_scroll)."""
+    return _SCROLLS.get(scroll_id)
 
 
 def clear_scroll(scroll_id: str) -> bool:

@@ -1,0 +1,75 @@
+"""Process-global retrace auditor hookup for the search profiler.
+
+The profiler splits device time into COMPILE vs EXECUTE by watching
+``jax.jit`` trace counts around each device call: a call whose trace
+count moved paid tracing+compilation; a steady call ran a cached
+program. The counter is tools.tpulint.trace_audit's auditor — the same
+instrument tools/tpu_ab.py uses for ``retraces_timed`` — installed
+process-wide.
+
+Install-order constraint (see trace_audit's module docstring): the
+codebase binds ``jax.jit`` at import time, so the auditor must patch
+``jax.jit`` first. The ``__init__`` of every jit-binding package
+(``ops/``, ``models/``, ``parallel/``) calls :func:`ensure_installed` —
+parent packages initialize before their submodules, so the patch lands
+before any ``@jax.jit`` binds, while the ROOT package import stays
+jax-free (a Client-only import pays nothing). ``ESTPU_NO_TRACE_AUDIT=1``
+opts out (then profiles report ``retraces: -1`` = unknown, never a
+fake 0).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_AUDITOR = None
+_TRIED = False
+
+
+def ensure_installed():
+    """Install the global auditor once; None when unavailable (no jax,
+    no tools package, or explicitly disabled)."""
+    global _AUDITOR, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _AUDITOR
+        _TRIED = True
+        if os.environ.get("ESTPU_NO_TRACE_AUDIT"):
+            return None
+        try:
+            from tools.tpulint import trace_audit
+
+            _AUDITOR = trace_audit.install()
+        except Exception:
+            # tools/ not importable (installed-package context) or jax
+            # missing: the profiler degrades to retraces=-1 (unknown)
+            _AUDITOR = None
+        return _AUDITOR
+
+
+def auditor():
+    """The installed auditor, or None (never installs as a side effect —
+    a late install would miss every import-time-bound program and report
+    a misleading 0)."""
+    return _AUDITOR
+
+
+def snapshot() -> Optional[int]:
+    """Per-THREAD trace count at this instant (tracing runs
+    synchronously on the calling thread, so thread attribution is
+    exact). A global count would misclassify: a neighbor request's
+    first-call compile on another thread must not turn this thread's
+    cached execution into device_compile."""
+    a = _AUDITOR
+    return a.thread_total() if a is not None else None
+
+
+def traces_since(snap: Optional[int]) -> int:
+    """New traces ON THIS THREAD since ``snap``; -1 when the auditor is
+    unavailable (unknown must stay distinguishable from zero)."""
+    a = _AUDITOR
+    if a is None or snap is None:
+        return -1
+    return a.thread_total() - snap

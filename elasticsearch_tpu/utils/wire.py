@@ -73,3 +73,59 @@ def unpack(obj: Any) -> Any:
 def _key(k: Any) -> Any:
     # dict keys must be hashable after the round trip
     return tuple(k) if isinstance(k, list) else k
+
+
+# ---------------------------------------------------------------------------
+# observability wire header (the frame-level "ctx" band)
+# ---------------------------------------------------------------------------
+
+#: frame key the transport reserves for the trace/task context — the
+#: counterpart of the reference's ThreadContext request headers riding
+#: every transport message (common/util/concurrent/ThreadContext).
+CTX_KEY = "ctx"
+
+#: per-band key→type whitelists: the header crosses trust boundaries on
+#: every frame, so only known keys with the EXPECTED scalar type survive
+#: (a peer can never smuggle structure — or a string task id that would
+#: blow up the adopter's int() and fail an otherwise-valid frame — into
+#: the coordinator's tracing state)
+_CTX_BANDS = {"trace": {"trace_id": str, "span_id": str},
+              "task": {"node": str, "id": int}}
+
+
+def attach_ctx(frame: dict, ctx: Any) -> dict:
+    """Attach a sanitized observability context to an outgoing frame
+    (no-op on a falsy ctx). Mutates and returns ``frame``."""
+    clean = sanitize_ctx(ctx)
+    if clean:
+        frame[CTX_KEY] = clean
+    return frame
+
+
+def extract_ctx(frame: Any) -> Any:
+    """The sanitized observability context of an incoming frame, or
+    None."""
+    if not isinstance(frame, dict):
+        return None
+    return sanitize_ctx(frame.get(CTX_KEY))
+
+
+def sanitize_ctx(ctx: Any) -> Any:
+    """Keep only the whitelisted bands/keys whose values match the
+    expected scalar type (bounded: ids longer than 128 chars are
+    dropped, not truncated — a mangled id must not silently alias
+    another trace; bool is never accepted even where int is)."""
+    if not isinstance(ctx, dict):
+        return None
+    out = {}
+    for band, keys in _CTX_BANDS.items():
+        src = ctx.get(band)
+        if not isinstance(src, dict):
+            continue
+        clean = {k: src[k] for k, want in keys.items()
+                 if isinstance(src.get(k), want)
+                 and not isinstance(src.get(k), bool)
+                 and len(str(src[k])) <= 128}
+        if clean:
+            out[band] = clean
+    return out or None

@@ -1,0 +1,795 @@
+"""Observability subsystem tests (elasticsearch_tpu/tracing/):
+
+- tracer: span nesting/parent links, monotonic durations, chrome dump
+- task registry: lifecycle, parent cascade cancel, pending views
+- wire header: sanitization + transport propagation
+- slow logs: threshold-driven recording off live settings
+- profiler: ?profile=true phase breakdown with the device
+  compile/execute split + retrace counts (bool+kNN per acceptance)
+- cross-process: one trace id spanning coordinator + remote owner, and
+  /_tasks listing + parent cancel of a running delete-by-query whose
+  child runs on the remote primary owner
+"""
+import json
+import socket
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestController
+from elasticsearch_tpu.tracing import (TaskCancelledException, TaskRegistry,
+                                       Tracer, adopt_wire_context,
+                                       check_cancelled, wire_context)
+from elasticsearch_tpu.tracing.tasks import ResourceNotFoundException
+from elasticsearch_tpu.utils import wire
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- tracer --------------------------------------------------------------------
+
+class TestTracer:
+    def test_nested_spans_share_trace_and_link_parents(self):
+        tr = Tracer("n1")
+        with tr.span("outer") as outer:
+            with tr.span("inner") as inner:
+                pass
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id is None
+        # finished ring holds both, inner first (closed first)
+        names = [s.name for s in tr.spans()]
+        assert names == ["inner", "outer"]
+        assert all(s.duration >= 0 for s in tr.spans())
+
+    def test_separate_roots_get_separate_traces(self):
+        tr = Tracer("n1")
+        with tr.span("a"):
+            pass
+        with tr.span("b"):
+            pass
+        a, b = tr.spans()
+        assert a.trace_id != b.trace_id
+
+    def test_error_recorded_and_raised(self):
+        tr = Tracer("n1")
+        with pytest.raises(ValueError):
+            with tr.span("boom"):
+                raise ValueError("nope")
+        sp = tr.spans()[0]
+        assert "ValueError" in sp.error
+
+    def test_ring_bounded_counters_exact(self):
+        tr = Tracer("n1", max_spans=4)
+        for i in range(10):
+            with tr.span(f"s{i}"):
+                pass
+        assert len(tr.spans()) == 4
+        st = tr.stats()
+        assert st["started_total"] == st["finished_total"] == 10
+
+    def test_chrome_trace_shape(self):
+        tr = Tracer("n1")
+        with tr.span("work", index="idx"):
+            pass
+        dump = tr.chrome_trace()
+        (ev,) = dump["traceEvents"]
+        assert ev["ph"] == "X" and ev["dur"] >= 1
+        assert ev["args"]["trace_id"] and ev["args"]["index"] == "idx"
+        assert dump["otherData"]["node"] == "n1"
+
+    def test_adopted_header_joins_remote_trace(self):
+        tr = Tracer("n1")
+        header = {"trace": {"trace_id": "t" * 16, "span_id": "p" * 16}}
+        with adopt_wire_context(header):
+            with tr.span("child"):
+                pass
+        sp = tr.spans()[0]
+        assert sp.trace_id == "t" * 16
+        assert sp.parent_id == "p" * 16
+
+
+# -- task registry -------------------------------------------------------------
+
+class TestTaskRegistry:
+    def test_lifecycle_and_listing(self):
+        reg = TaskRegistry("n1")
+        with reg.task("indices:data/read/search", description="d") as t:
+            assert reg.get(t.id) is t
+            (listed,) = reg.list_tasks()
+            j = listed.to_json()
+            assert j["action"] == "indices:data/read/search"
+            assert j["cancellable"] and not j["cancelled"]
+            assert j["running_time_in_nanos"] >= 0
+        assert reg.get(t.id) is None
+        assert reg.stats() == {"current": 0, "completed_total": 1,
+                               "cancelled_total": 0}
+
+    def test_checkpoint_raises_only_when_cancelled(self):
+        reg = TaskRegistry("n1")
+        check_cancelled()  # no current task: no-op
+        with reg.task("a") as t:
+            check_cancelled()  # running, not cancelled: no-op
+            t.cancel("because")
+            with pytest.raises(TaskCancelledException) as ei:
+                check_cancelled()
+            assert "because" in str(ei.value)
+        assert reg.stats()["cancelled_total"] == 1
+
+    def test_cancel_cascades_to_local_descendants(self):
+        reg = TaskRegistry("n1")
+        parent = reg.register("p")
+        child = reg.register("c", parent=(parent.node, parent.id))
+        grandchild = reg.register("g", parent=(child.node, child.id))
+        other = reg.register("other")
+        cancelled = reg.cancel(parent.id)
+        assert {t.id for t in cancelled} == {parent.id, child.id,
+                                             grandchild.id}
+        assert not other.cancelled
+
+    def test_cancel_missing_is_404(self):
+        with pytest.raises(ResourceNotFoundException):
+            TaskRegistry("n1").cancel(99)
+
+    def test_nested_tasks_parent_automatically(self):
+        reg = TaskRegistry("n1")
+        with reg.task("outer") as outer:
+            with reg.task("inner") as inner:
+                assert inner.parent == ("n1", outer.id)
+
+    def test_pending_view(self):
+        reg = TaskRegistry("n1")
+        t = reg.register("indices:recovery/start", status="pending")
+        (row,) = reg.pending_tasks()
+        assert row["insert_order"] == t.id
+        assert row["source"] == "indices:recovery/start"
+        t.start()
+        assert reg.pending_tasks() == []
+        reg.unregister(t)
+
+    def test_late_child_of_cancelled_parent_is_born_cancelled(self):
+        """The cancel BAN: a child registering AFTER its parent's cancel
+        fanout processed (the dispatch was in flight) must not escape
+        the cascade and run its destructive pass to completion."""
+        reg = TaskRegistry("n1")
+        # remote-parent form: the coordinator lives on another node
+        reg.cancel_by_parent("coord-node", 42, "user said stop")
+        late = reg.register("indices:data/write/delete/byquery[s]",
+                            parent=("coord-node", 42))
+        try:
+            assert late.cancelled
+            with pytest.raises(TaskCancelledException):
+                late.check_cancelled()
+        finally:
+            reg.unregister(late)
+        # unrelated parents are unaffected
+        free = reg.register("x", parent=("coord-node", 43))
+        assert not free.cancelled
+        reg.unregister(free)
+
+    def test_local_cancel_bans_late_children_too(self):
+        reg = TaskRegistry("n1")
+        parent = reg.register("p")
+        reg.cancel(parent.id)
+        late = reg.register("c", parent=("n1", parent.id))
+        assert late.cancelled
+        reg.unregister(late)
+        reg.unregister(parent)
+
+
+# -- wire header ---------------------------------------------------------------
+
+class TestWireHeader:
+    def test_sanitize_whitelists_and_bounds(self):
+        dirty = {"trace": {"trace_id": "t1", "span_id": "s1",
+                           "evil": {"nested": 1}},
+                 "task": {"node": "n", "id": 7, "extra": "x"},
+                 "junk": "dropped"}
+        clean = wire.sanitize_ctx(dirty)
+        assert clean == {"trace": {"trace_id": "t1", "span_id": "s1"},
+                         "task": {"node": "n", "id": 7}}
+        assert wire.sanitize_ctx({"trace": {"trace_id": "x" * 200}}) is None
+        assert wire.sanitize_ctx("garbage") is None
+        # wrong TYPES are dropped key-by-key, not passed through: a
+        # string task id would blow up the adopter's int() and fail a
+        # valid frame, and bool is never accepted where int is
+        assert wire.sanitize_ctx({"task": {"node": "n", "id": "abc"}}) \
+            == {"task": {"node": "n"}}
+        assert wire.sanitize_ctx({"task": {"node": "n", "id": True}}) \
+            == {"task": {"node": "n"}}
+        assert wire.sanitize_ctx({"trace": {"trace_id": 7,
+                                            "span_id": "s"}}) == \
+            {"trace": {"span_id": "s"}}
+
+    def test_adopt_parent_ignores_junk_header(self):
+        from elasticsearch_tpu.tracing.tasks import adopt_parent, \
+            wire_parent
+
+        with adopt_parent({"node": "n", "id": "abc"}):
+            assert wire_parent() is None  # ignored, never raised
+        with adopt_parent({"node": "n", "id": 5}):
+            assert wire_parent() == ("n", 5)
+
+    def test_attach_extract_roundtrip(self):
+        frame = {"action": "a", "payload": {}}
+        wire.attach_ctx(frame, {"trace": {"trace_id": "t", "span_id": "s"}})
+        assert wire.extract_ctx(frame) == {"trace": {"trace_id": "t",
+                                                     "span_id": "s"}}
+        assert wire.extract_ctx({"action": "a"}) is None
+
+    def test_wire_context_captures_task_and_span(self):
+        reg = TaskRegistry("n9")
+        tr = Tracer("n9")
+        assert wire_context() is None
+        with reg.task("act") as t:
+            with tr.span("sp") as sp:
+                ctx = wire_context()
+        assert ctx["task"] == {"node": "n9", "id": t.id}
+        assert ctx["trace"] == {"trace_id": sp.trace_id,
+                                "span_id": sp.span_id}
+
+
+# -- slow logs -----------------------------------------------------------------
+
+class TestSlowlog:
+    def test_threshold_drives_recording(self):
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        svc = IndexService("slow", settings={"index": {
+            "number_of_shards": 1,
+            "search": {"slowlog": {"threshold": {"query": {
+                "warn": "0ms"}}}}}})
+        try:
+            svc.index_doc("1", {"t": "hello"})
+            svc.refresh()
+            svc.search({"query": {"match_all": {}}})
+            log = svc.slowlog.query.to_json()
+            assert log["total"] >= 1
+            entry = log["entries"][0]
+            assert entry["level"] == "warn" and entry["index"] == "slow"
+            assert "match_all" in (entry.get("source") or "")
+        finally:
+            svc.close()
+
+    def test_no_thresholds_no_entries(self):
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        svc = IndexService("quiet", settings={"index": {
+            "number_of_shards": 1}})
+        try:
+            svc.index_doc("1", {"t": "x"})
+            svc.refresh()
+            svc.search({"query": {"match_all": {}}})
+            assert svc.slowlog.query.to_json()["total"] == 0
+            assert svc.slowlog.index.to_json()["total"] == 0
+        finally:
+            svc.close()
+
+    def test_indexing_slowlog_and_node_totals(self):
+        from elasticsearch_tpu.index.index_service import IndexService
+        from elasticsearch_tpu.monitor.stats import aggregate_slowlog
+
+        svc = IndexService("wslow", settings={"index": {
+            "number_of_shards": 1,
+            "indexing.slowlog.threshold.index.info": "0ms"}})
+        quiet = IndexService("wquiet", settings={"index": {
+            "number_of_shards": 1}})
+        try:
+            svc.index_doc("1", {"t": "x"})
+            log = svc.slowlog.index.to_json()
+            assert log["total"] == 1
+            assert log["entries"][0]["level"] == "info"
+            # per-NODE aggregation: only the indices handed in count —
+            # another node's indices never bleed into this gauge
+            assert aggregate_slowlog([svc, quiet]) == {
+                "search_slow_total": 0, "indexing_slow_total": 1}
+            assert aggregate_slowlog([quiet]) == {
+                "search_slow_total": 0, "indexing_slow_total": 0}
+        finally:
+            svc.close()
+            quiet.close()
+
+    def test_dynamic_settings_update_applies(self):
+        from elasticsearch_tpu.cluster.metadata import update_index_settings
+        from elasticsearch_tpu.index.index_service import IndexService
+
+        svc = IndexService("dyn", settings={"index": {
+            "number_of_shards": 1}})
+        try:
+            svc.index_doc("1", {"t": "x"})
+            svc.refresh()
+            svc.search({"query": {"match_all": {}}})
+            assert svc.slowlog.query.to_json()["total"] == 0
+            update_index_settings(svc, {
+                "index.search.slowlog.threshold.query.trace": "0ms"})
+            svc.search({"query": {"match_all": {}}})
+            assert svc.slowlog.query.to_json()["total"] == 1
+        finally:
+            svc.close()
+
+
+# -- search profiler -----------------------------------------------------------
+
+@pytest.fixture()
+def knn_node():
+    n = Node(name="prof-node")
+    n.create_index("pidx", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {
+            "t": {"type": "string"},
+            "v": {"type": "dense_vector", "dims": 4}}}})
+    for i in range(12):
+        n.indices["pidx"].index_doc(
+            str(i), {"t": f"hello doc {i}",
+                     "v": [0.1 * i, 0.2, 0.3, 0.4]})
+    n.indices["pidx"].refresh()
+    yield n
+    n.indices["pidx"].close()
+
+
+class TestProfile:
+    BOOL_KNN = {"query": {"bool": {"must": [
+        {"match": {"t": "hello"}},
+        {"knn": {"field": "v", "query_vector": [0.1, 0.2, 0.3, 0.4],
+                 "k": 5}}]}}}
+
+    def test_bool_knn_profile_separates_compile_from_execute(self, knn_node):
+        ctrl = RestController(knn_node)
+        status, resp = ctrl.dispatch(
+            "POST", "/pidx/_search", {"profile": "true"},
+            json.dumps(self.BOOL_KNN).encode())
+        assert status == 200 and resp["hits"]["total"] > 0
+        shards = resp["profile"]["shards"]
+        assert len(shards) == 2  # per-shard breakdown
+        for sp in shards:
+            phases = sp["tpu"]["phases"]
+            # the acceptance split: compile and execute are SEPARATE keys
+            assert "device_compile_nanos" in phases
+            assert "device_execute_nanos" in phases
+            for key in ("rewrite_nanos", "executor_build_nanos",
+                        "topk_nanos", "host_sync_nanos"):
+                assert key in phases
+            # retrace count included (-1 only when the auditor is absent)
+            assert isinstance(sp["tpu"]["retraces"], int)
+            assert sp["tpu"]["segments"] >= 1
+            # reference envelope intact for existing consumers
+            q = sp["searches"][0]["query"][0]
+            assert q["time_in_nanos"] >= 0
+        # device work happened somewhere (compile on first shapes,
+        # execute on cached ones)
+        total_dev = sum(sp["tpu"]["phases"]["device_compile_nanos"]
+                        + sp["tpu"]["phases"]["device_execute_nanos"]
+                        for sp in shards)
+        assert total_dev > 0
+
+    def test_steady_state_executes_without_retraces(self, knn_node):
+        from elasticsearch_tpu.tracing import retrace
+
+        if retrace.auditor() is None:
+            pytest.skip("trace auditor unavailable")
+        body = dict(self.BOOL_KNN, profile=True)
+        knn_node.indices["pidx"].search(body)  # warm: compile everything
+        resp = knn_node.indices["pidx"].search(body)
+        for sp in resp["profile"]["shards"]:
+            assert sp["tpu"]["retraces"] == 0
+            assert sp["tpu"]["phases"]["device_compile_nanos"] == 0
+            assert sp["tpu"]["phases"]["device_execute_nanos"] > 0
+
+    def test_profile_false_adds_nothing(self, knn_node):
+        resp = knn_node.indices["pidx"].search(
+            {"query": {"match_all": {}}})
+        assert "profile" not in resp
+
+
+# -- REST task endpoints (single node) ----------------------------------------
+
+class TestTaskEndpoints:
+    def test_tasks_listing_and_cat(self):
+        n = Node(name="t-node")
+        ctrl = RestController(n)
+        started = threading.Event()
+        release = threading.Event()
+
+        def long_task():
+            with n.tasks.task("indices:data/write/delete/byquery",
+                              description="delete-by-query [x]"):
+                started.set()
+                release.wait(5)
+
+        th = threading.Thread(target=long_task)
+        th.start()
+        try:
+            assert started.wait(5)
+            s, body = ctrl.dispatch("GET", "/_tasks", {}, b"")
+            assert s == 200
+            tasks = body["nodes"][n.node_id]["tasks"]
+            (tid,) = [k for k, v in tasks.items()
+                      if v["action"].endswith("delete/byquery")]
+            assert tasks[tid]["cancellable"]
+            # GET /_tasks/{id}
+            s, one = ctrl.dispatch("GET", f"/_tasks/{tid}", {}, b"")
+            assert s == 200 and one["task"]["id"] == int(tid.split(":")[1])
+            # actions= filter
+            s, none = ctrl.dispatch("GET", "/_tasks",
+                                    {"actions": "cluster:*"}, b"")
+            assert none["nodes"][n.node_id]["tasks"] == {}
+            # cat rows
+            s, rows = ctrl.dispatch("GET", "/_cat/tasks", {}, b"")
+            assert any(r["task_id"] == tid for r in rows)
+        finally:
+            release.set()
+            th.join(5)
+        s, body = ctrl.dispatch("GET", "/_tasks", {}, b"")
+        assert body["nodes"][n.node_id]["tasks"] == {}
+
+    def test_cancel_endpoint_flips_task(self):
+        n = Node(name="c-node")
+        ctrl = RestController(n)
+        t = n.tasks.register("indices:data/read/scroll")
+        try:
+            s, body = ctrl.dispatch("POST",
+                                    f"/_tasks/{t.tagged_id}/_cancel",
+                                    {}, b"")
+            assert s == 200
+            assert t.cancelled
+            assert t.tagged_id in body["nodes"][n.node_id]["tasks"]
+            with pytest.raises(TaskCancelledException):
+                t.check_cancelled()
+        finally:
+            n.tasks.unregister(t)
+
+    def test_cancel_missing_task_404(self):
+        n = Node(name="m-node")
+        ctrl = RestController(n)
+        s, body = ctrl.dispatch("POST", f"/_tasks/{n.node_id}:9999/_cancel",
+                                {}, b"")
+        assert s == 404
+        assert body["error"]["type"] == "resource_not_found_exception"
+
+    def test_pending_tasks_views(self):
+        n = Node(name="p-node")
+        ctrl = RestController(n)
+        t = n.tasks.register("indices:recovery/start",
+                             description="recover [i][0]",
+                             status="pending")
+        try:
+            s, body = ctrl.dispatch("GET", "/_cluster/pending_tasks", {},
+                                    b"")
+            assert s == 200
+            (row,) = body["tasks"]
+            assert row["source"] == "indices:recovery/start"
+            assert row["priority"] == "NORMAL"
+            s, rows = ctrl.dispatch("GET", "/_cat/pending_tasks", {}, b"")
+            assert rows and rows[0]["insertOrder"] == str(t.id)
+            s, health = ctrl.dispatch("GET", "/_cluster/health", {}, b"")
+            assert health["number_of_pending_tasks"] == 1
+        finally:
+            n.tasks.unregister(t)
+        s, body = ctrl.dispatch("GET", "/_cluster/pending_tasks", {}, b"")
+        assert body["tasks"] == []
+
+    def test_byquery_cancel_reports_partial(self):
+        """Single-node delete-by-query: cancel mid-scan → 200 with
+        partial counts + "canceled"."""
+        n = Node(name="bq-node")
+        n.create_index("bq", {"settings": {"number_of_shards": 1}})
+        for i in range(30):
+            n.indices["bq"].index_doc(str(i), {"v": i})
+        n.indices["bq"].refresh()
+        ctrl = RestController(n)
+        orig_delete = n.indices["bq"].delete_doc
+        state = {"n": 0}
+
+        def slow_delete(doc_id, **kw):
+            state["n"] += 1
+            if state["n"] == 3:
+                # cancel OUR task from within (deterministic: no sleeps)
+                (task,) = n.tasks.list_tasks(
+                    actions="indices:data/write/delete/byquery")
+                task.cancel("test says stop")
+            return orig_delete(doc_id, **kw)
+
+        n.indices["bq"].delete_doc = slow_delete
+        s, body = ctrl.dispatch("POST", "/bq/_delete_by_query", {},
+                                b'{"query": {"match_all": {}}}')
+        assert s == 200
+        assert "canceled" in body and "test says stop" in body["canceled"]
+        assert 0 < body["deleted"] < 30  # partial, durable
+        n.indices["bq"].refresh()
+        left = n.indices["bq"].search({"size": 0})["hits"]["total"]
+        assert left == 30 - body["deleted"]
+
+    def test_scroll_cancel_stops_the_drain(self):
+        """The scroll task spans the CONTEXT, not one page: cancel it
+        between pages and the next page fails typed, context freed."""
+        n = Node(name="sc-node")
+        n.create_index("sc", {"settings": {"number_of_shards": 1}})
+        for i in range(30):
+            n.indices["sc"].index_doc(str(i), {"v": i})
+        n.indices["sc"].refresh()
+        ctrl = RestController(n)
+        s, r = ctrl.dispatch(
+            "POST", "/sc/_search", {},
+            b'{"scroll": "1m", "size": 2, "query": {"match_all": {}}}')
+        sid = r["_scroll_id"]
+        s, page = ctrl.dispatch("GET", "/_search/scroll",
+                                {"scroll_id": sid}, b"")
+        assert s == 200 and page["hits"]["hits"]
+        # the persistent scroll task is listed BETWEEN pages
+        (task,) = n.tasks.list_tasks(actions="indices:data/read/scroll")
+        s, _ = ctrl.dispatch("POST", f"/_tasks/{task.tagged_id}/_cancel",
+                             {}, b"")
+        assert s == 200
+        # EAGER cleanup on cancel: context + task are gone immediately —
+        # an abandoned client never sending another page must not pin
+        # the snapshot in memory or leave a zombie /_tasks entry
+        assert n.tasks.list_tasks(actions="indices:data/read/scroll") == []
+        from elasticsearch_tpu.search.service import scroll_state
+
+        assert scroll_state(sid) is None
+        s, body = ctrl.dispatch("GET", "/_search/scroll",
+                                {"scroll_id": sid}, b"")
+        assert s == 404  # the drain is over
+
+    def test_clear_scroll_retires_the_task(self):
+        n = Node(name="cs-node")
+        n.create_index("cs", {"settings": {"number_of_shards": 1}})
+        n.indices["cs"].index_doc("1", {"v": 1})
+        n.indices["cs"].refresh()
+        ctrl = RestController(n)
+        _s, r = ctrl.dispatch(
+            "POST", "/cs/_search", {},
+            b'{"scroll": "1m", "size": 1, "query": {"match_all": {}}}')
+        sid = r["_scroll_id"]
+        ctrl.dispatch("GET", "/_search/scroll", {"scroll_id": sid}, b"")
+        assert n.tasks.list_tasks(actions="indices:data/read/scroll")
+        s, _ = ctrl.dispatch("DELETE", "/_search/scroll",
+                             {"scroll_id": sid}, b"")
+        assert n.tasks.list_tasks(actions="indices:data/read/scroll") == []
+
+    def test_node_trace_endpoint_chrome_format(self):
+        n = Node(name="tr-node")
+        n.create_index("tr", {"settings": {"number_of_shards": 1}})
+        n.indices["tr"].index_doc("1", {"t": "x"})
+        n.indices["tr"].refresh()
+        ctrl = RestController(n)
+        ctrl.dispatch("POST", "/tr/_search", {}, b"{}")
+        s, dump = ctrl.dispatch("GET", "/_nodes/_local/trace", {}, b"")
+        assert s == 200
+        assert dump["traceEvents"], "search should have recorded spans"
+        assert all(ev["ph"] == "X" for ev in dump["traceEvents"])
+        assert any(ev["name"] == "search" for ev in dump["traceEvents"])
+
+
+# -- cross-process propagation + cancellation ---------------------------------
+
+@pytest.fixture()
+def two_node_cluster():
+    """Two full MultiHostClusters IN-PROCESS over real TCP (the transport
+    doesn't care) — the same harness test_faults.py uses: rank 0 is
+    master+coordinator, rank 1 owns half the shards."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    port = _free_port()
+    node0 = Node(name="rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port)
+    c0.data.create_index("evt", {
+        "settings": {"number_of_shards": 2},
+        "mappings": {"properties": {"n": {"type": "integer"}}}})
+    assig = c0.dist_indices["evt"]["assignment"]
+    assert len({o[0] for o in assig.values()}) == 2, assig
+    for i in range(24):
+        c0.data.index_doc("evt", str(i), {"n": i})
+    c0.data.refresh("evt")
+    yield c0, c1
+    try:
+        c1.close()
+    finally:
+        c0.close()
+        node1.close()
+        node0.close()
+
+
+class TestCrossProcess:
+    def test_search_spans_share_one_trace_id(self, two_node_cluster):
+        c0, c1 = two_node_cluster
+        r = c0.data.search("evt", {"size": 24})
+        assert r["hits"]["total"] == 24
+        root = [s for s in c0.node.tracer.spans()
+                if s.name == "search.coordinate"][-1]
+        # coordinator-side: the scatter send rides under the root
+        sends = [s for s in c0.node.tracer.spans()
+                 if s.name == "transport.send"
+                 and s.trace_id == root.trace_id]
+        assert sends, "remote query phase should record a send span"
+        # remote side: handle + shard query spans JOINED the same trace
+        remote = [s for s in c1.node.tracer.spans()
+                  if s.trace_id == root.trace_id]
+        remote_names = {s.name for s in remote}
+        assert "transport.handle" in remote_names
+        assert "shard.query_phase" in remote_names
+        # and the remote handle span hangs off a coordinator send span
+        send_ids = {s.span_id for s in sends}
+        assert any(s.parent_id in send_ids for s in remote
+                   if s.name == "transport.handle")
+
+    def test_profile_true_merges_remote_shard_phases(self, two_node_cluster):
+        c0, _c1 = two_node_cluster
+        r = c0.data.search("evt", {
+            "size": 5, "profile": True,
+            "query": {"bool": {"must": [{"match_all": {}}]}}})
+        shards = r["profile"]["shards"]
+        assert len(shards) == 2
+        # one entry per shard, each labeled with its OWNER node
+        owners = {sp["id"].split("]")[0].lstrip("[") for sp in shards}
+        assert len(owners) == 2
+        for sp in shards:
+            assert "device_compile_nanos" in sp["tpu"]["phases"]
+            assert "device_execute_nanos" in sp["tpu"]["phases"]
+
+    def test_tasks_list_and_parent_cancel_stop_remote_byquery(
+            self, two_node_cluster, monkeypatch):
+        """Acceptance: GET /_tasks lists a running delete-by-query with
+        its remote child task; POST /_tasks/{parent}/_cancel terminates
+        both; the partial response reports "canceled"."""
+        from elasticsearch_tpu.cluster.search_action import \
+            DistributedDataService
+        from elasticsearch_tpu.search import byquery
+
+        c0, c1 = two_node_cluster
+        ctrl0 = RestController(c0.node)
+
+        # throttle every primary delete so the scan is observably
+        # in-flight; signal once the first scan round begins
+        scanning = threading.Event()
+        orig_scan = byquery.scan_ids
+
+        def signaled_scan(svc, query, seen):
+            scanning.set()
+            return orig_scan(svc, query, seen)
+
+        monkeypatch.setattr(byquery, "scan_ids", signaled_scan)
+        orig_write = DistributedDataService._primary_write
+
+        def slow_write(self, *a, **kw):
+            time.sleep(0.03)
+            return orig_write(self, *a, **kw)
+
+        monkeypatch.setattr(DistributedDataService, "_primary_write",
+                            slow_write)
+
+        result = {}
+
+        def run():
+            s, body = ctrl0.dispatch("POST", "/evt/_delete_by_query", {},
+                                     b'{"query": {"match_all": {}}}')
+            result["status"], result["body"] = s, body
+
+        th = threading.Thread(target=run)
+        th.start()
+        try:
+            assert scanning.wait(10)
+            # poll /_tasks until the coordinator task AND its remote
+            # child are both visible (the fanout is sequential)
+            deadline = time.monotonic() + 10
+            parent_id = child = None
+            while time.monotonic() < deadline:
+                _s, listing = ctrl0.dispatch("GET", "/_tasks", {}, b"")
+                flat = {tid: t
+                        for entry in listing["nodes"].values()
+                        for tid, t in entry.get("tasks", {}).items()}
+                parents = [tid for tid, t in flat.items()
+                           if t["action"] ==
+                           "indices:data/write/delete/byquery"]
+                children = [(tid, t) for tid, t in flat.items()
+                            if t["action"].endswith("byquery[s]")
+                            and t.get("parent_task_id")]
+                if parents and children:
+                    parent_id = parents[0]
+                    # a child registered on the REMOTE node, linked to
+                    # the coordinator's task id
+                    remote_children = [
+                        (tid, t) for tid, t in children
+                        if tid.startswith(c1.local.node_id)
+                        and t["parent_task_id"] == parent_id]
+                    if remote_children:
+                        child = remote_children[0]
+                        break
+                time.sleep(0.02)
+            assert parent_id is not None, "coordinator task never listed"
+            assert child is not None, \
+                "remote child task never listed with parent link"
+
+            s, cancel_body = ctrl0.dispatch(
+                "POST", f"/_tasks/{parent_id}/_cancel", {}, b"")
+            assert s == 200
+            cancelled_ids = {tid for entry in cancel_body["nodes"].values()
+                             for tid in entry.get("tasks", {})}
+            assert parent_id in cancelled_ids
+            th.join(30)
+            assert not th.is_alive()
+            assert result["status"] == 200
+            body = result["body"]
+            assert "canceled" in body, body
+            # partial: something may have been deleted, but not all 24
+            assert body.get("deleted", 0) < 24
+            # both tasks are gone from the registry afterwards
+            _s, after = ctrl0.dispatch("GET", "/_tasks", {}, b"")
+            leftover = [t for entry in after["nodes"].values()
+                        for t in entry.get("tasks", {}).values()
+                        if "byquery" in t["action"]]
+            assert leftover == []
+        finally:
+            th.join(30)
+
+    def test_cancel_remote_task_by_id_relays(self, two_node_cluster):
+        c0, c1 = two_node_cluster
+        ctrl0 = RestController(c0.node)
+        t = c1.node.tasks.register("indices:data/read/scroll")
+        try:
+            s, body = ctrl0.dispatch(
+                "POST", f"/_tasks/{t.tagged_id}/_cancel", {}, b"")
+            assert s == 200
+            assert t.cancelled
+        finally:
+            c1.node.tasks.unregister(t)
+
+    def test_distributed_search_slowlog_records(self, two_node_cluster):
+        """Distributed searches bypass IndexService.search, so the
+        coordinator-side hook must record the slow log — thresholds on a
+        multi-host index must not silently never fire."""
+        c0, _c1 = two_node_cluster
+        svc = c0.node.indices["evt"]
+        svc.settings.setdefault("index", {})[
+            "search.slowlog.threshold.query.trace"] = "0ms"
+        before = svc.slowlog.query.total
+        c0.data.search("evt", {"size": 1})
+        assert svc.slowlog.query.total == before + 1
+
+    def test_local_prefix_cancels_like_get(self, two_node_cluster):
+        # GET and POST _cancel must accept the same "_local:{id}" form
+        c0, _c1 = two_node_cluster
+        ctrl0 = RestController(c0.node)
+        t = c0.node.tasks.register("indices:data/read/scroll")
+        try:
+            s, one = ctrl0.dispatch("GET", f"/_tasks/_local:{t.id}", {},
+                                    b"")
+            assert s == 200 and one["task"]["id"] == t.id
+            s, _ = ctrl0.dispatch("POST", f"/_tasks/_local:{t.id}/_cancel",
+                                  {}, b"")
+            assert s == 200 and t.cancelled
+        finally:
+            c0.node.tasks.unregister(t)
+
+    def test_cancelled_queued_recovery_clears_initializing(
+            self, two_node_cluster):
+        """A recovery task cancelled while still QUEUED must not leak
+        its target in the shard's `initializing` list — the copy would
+        look in-flight forever and never re-heal."""
+        c0, c1 = two_node_cluster
+        target = c1.local.node_id
+        with c0._indices_lock:
+            meta = c0.dist_indices["evt"]
+            meta.setdefault("initializing", {}).setdefault("0", [])
+            if target not in meta["initializing"]["0"]:
+                meta["initializing"]["0"].append(target)
+        t = c0.node.tasks.register("indices:recovery/start",
+                                   status="pending")
+        t.cancel("queued no more")
+        before_owners = list(c0.dist_indices["evt"]["assignment"]["0"])
+        c0.data._run_recoveries([{
+            "index": "evt", "shard": 0, "target": target,
+            "source": c0.local.node_id, "body": meta["body"]}], [t])
+        assert target not in c0.dist_indices["evt"]["initializing"]["0"]
+        # a cancelled stream never graduates the copy
+        assert c0.dist_indices["evt"]["assignment"]["0"] == before_owners
+        assert c0.node.tasks.get(t.id) is None

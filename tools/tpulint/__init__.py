@@ -22,6 +22,9 @@ known failure classes at review time:
   R005  lock discipline: mutation of shared state in threadpool-visible
         modules (engine/translog/ivf_cache/threadpool) outside a
         ``with <lock>`` block.
+  R007  wall-clock durations: ``time.time()`` feeding a subtraction in
+        the timing modules (``tracing/``, ``monitor/``) — spans and
+        latencies must use ``time.monotonic()``/``perf_counter``.
   R006  swallowed failures: bare ``except Exception: pass`` in the
         failure-domain layers (``cluster/``, ``index/``, ``rest/``) —
         a fault that never reaches retry/breaker/partial-result

@@ -29,7 +29,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.tpulint",
         description="JAX/TPU-aware static analysis for elasticsearch_tpu "
-                    "(rules R001-R006; see docs/STATIC_ANALYSIS.md)")
+                    "(rules R001-R007; see docs/STATIC_ANALYSIS.md)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files or directories to lint "
                          "(default: the repo's elasticsearch_tpu package)")

@@ -24,6 +24,8 @@ RULES: Dict[str, str] = {
     "R005": "shared mutable state written without holding the lock",
     "R006": "failure swallowed (`except Exception: pass`) in a "
             "failure-domain module",
+    "R007": "wall-clock time.time() feeding a duration computation in a "
+            "timing module (use time.monotonic()/perf_counter)",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.
@@ -44,6 +46,11 @@ LOCKED_MODULE_MARKERS = (
     "/index/ivf_cache.py",
     "/utils/threadpool.py",
 )
+# R007 scope: the timing-sensitive modules — span durations, task running
+# times, phase profiles, stats counters. A wall-clock duration silently
+# corrupts under NTP step adjustments; epoch TIMESTAMPS (no subtraction)
+# stay legal.
+TIMING_PATH_MARKERS = ("/tracing/", "/monitor/")
 
 _ALLOW_RE = re.compile(r"#\s*tpulint:\s*allow\[\s*([A-Z0-9,\s]+?)\s*\]")
 _HOST_RE = re.compile(r"#\s*tpulint:\s*host\b")
@@ -121,10 +128,11 @@ def lint_source(
     ops: Optional[bool] = None,
     locked: Optional[bool] = None,
     swallow: Optional[bool] = None,
+    timing: Optional[bool] = None,
 ) -> List[Violation]:
-    """Lint one source string. ``hot``/``ops``/``locked``/``swallow``
-    override the path-based scoping (fixture tests use these; production
-    runs infer from the path)."""
+    """Lint one source string. ``hot``/``ops``/``locked``/``swallow``/
+    ``timing`` override the path-based scoping (fixture tests use these;
+    production runs infer from the path)."""
     from tools.tpulint import rules as _rules
 
     tree = ast.parse(source, filename=path)
@@ -138,6 +146,8 @@ def lint_source(
         locked=_matches(path, LOCKED_MODULE_MARKERS) if locked is None else locked,
         swallow=(_matches(path, SWALLOW_PATH_MARKERS)
                  if swallow is None else swallow),
+        timing=(_matches(path, TIMING_PATH_MARKERS)
+                if timing is None else timing),
         host_lines=supp.host,
     )
     found = _rules.check_module(tree, ctx)

@@ -35,6 +35,7 @@ class FileContext:
     ops: bool = False      # R003 host-annotation check applies
     locked: bool = False   # R005 applies
     swallow: bool = False  # R006 applies (failure-domain modules)
+    timing: bool = False   # R007 applies (tracing//monitor/ modules)
     host_lines: Set[int] = field(default_factory=set)
 
 
@@ -99,6 +100,8 @@ class _ModuleInfo:
         self.wrapped_fns: Set[str] = set()    # g in `f = jax.jit(g)`
         self.module_locks: Set[str] = set()
         self.shared_globals: Set[str] = set()
+        self.time_mods: Set[str] = set()      # names bound to `import time`
+        self.wall_fns: Set[str] = set()       # `from time import time [as t]`
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for al in node.names:
@@ -113,7 +116,13 @@ class _ModuleInfo:
                         self.np.add(bound)
                     elif al.name == "functools":
                         self.partial_names.add(f"{bound}.partial")
+                    elif al.name == "time":
+                        self.time_mods.add(bound)
             elif isinstance(node, ast.ImportFrom):
+                if node.module == "time":
+                    for al in node.names:
+                        if al.name == "time":
+                            self.wall_fns.add(al.asname or "time")
                 if node.module == "jax":
                     for al in node.names:
                         if al.name == "jit":
@@ -227,6 +236,9 @@ class _Checker(ast.NodeVisitor):
         self.class_stack: List[str] = []
         self.class_locks: Dict[str, Set[str]] = {}  # class -> self lock attrs
         self.fn_stack: List[str] = []
+        # R007: per-scope names holding a time.time() result (module
+        # scope at index 0; one frame per function)
+        self.wall_names: List[Set[str]] = [set()]
 
     # -- emit ----------------------------------------------------------------
 
@@ -271,11 +283,13 @@ class _Checker(ast.NodeVisitor):
                        "a loop — every iteration builds a fresh callable and "
                        "retraces; hoist the jit out of the loop")
         self.fn_stack.append(node.name)
+        self.wall_names.append(set())
         # loop/iter context does not cross a function boundary
         saved = (self.loop_depth, self.iter_depth)
         self.loop_depth = self.iter_depth = 0
         self.generic_visit(node)
         self.loop_depth, self.iter_depth = saved
+        self.wall_names.pop()
         self.fn_stack.pop()
         if entering_trace:
             self.traced_stack.pop()
@@ -526,6 +540,40 @@ class _Checker(ast.NodeVisitor):
                        and isinstance(s.value, ast.Constant))
                    for s in body)
 
+    # -- R007 ---------------------------------------------------------------
+
+    def _is_wall_call(self, node: ast.AST) -> bool:
+        """`time.time()` (or a `from time import time` alias) call."""
+        if not isinstance(node, ast.Call) or node.args or node.keywords:
+            return False
+        chain = _attr_chain(node.func) or ""
+        if chain in self.mod.wall_fns:
+            return True
+        head, _, fn = chain.rpartition(".")
+        return fn == "time" and head in self.mod.time_mods
+
+    def _wall_operand(self, node: ast.AST) -> bool:
+        return self._is_wall_call(node) or (
+            isinstance(node, ast.Name) and node.id in self.wall_names[-1])
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        """R007: a wall-clock reading on either side of a subtraction IS
+        a duration computation — in a timing module it must come from
+        time.monotonic()/perf_counter (time.time() steps under NTP
+        adjustments and skews every span/latency it feeds). Epoch
+        timestamps (`int(time.time() * 1000)`) never subtract and stay
+        legal."""
+        if self.ctx.timing and isinstance(node.op, ast.Sub) and (
+                self._wall_operand(node.left)
+                or self._wall_operand(node.right)):
+            self._emit("R007", node,
+                       "wall-clock time.time() feeds a duration "
+                       "computation — use time.monotonic() or "
+                       "time.perf_counter() for span/duration "
+                       "measurement (wall clock steps under NTP; "
+                       "timestamps that are never subtracted are fine)")
+        self.generic_visit(node)
+
     # -- R005 ---------------------------------------------------------------
 
     def _is_lock_expr(self, expr: ast.AST) -> bool:
@@ -585,6 +633,16 @@ class _Checker(ast.NodeVisitor):
             for tgt in node.targets:
                 if isinstance(tgt, (ast.Attribute, ast.Subscript)):
                     self._check_mutation(tgt, self._shared_target_root(tgt))
+        if self.ctx.timing:
+            # track `t0 = time.time()` so a later `... - t0` flags
+            # (R007); any OTHER reassignment clears the taint — a name
+            # rebound to time.monotonic() must not keep flagging
+            wall = self._is_wall_call(node.value)
+            for tgt in node.targets:
+                nm = _name(tgt)
+                if nm:
+                    (self.wall_names[-1].add if wall
+                     else self.wall_names[-1].discard)(nm)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:

@@ -42,11 +42,31 @@ class TraceAuditor:
         self.max_traces = max_traces
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {}
+        # per-thread totals: tracing runs synchronously on the calling
+        # thread, so this attributes each trace to the request that paid
+        # it — the profiler's compile/execute split reads it to stay
+        # correct under concurrent searches (a neighbor thread's
+        # first-call compile must not misclassify THIS thread's cached
+        # execution). LRU-bounded: a thread-per-connection server would
+        # otherwise grow one entry per thread that ever traced, forever.
+        # Eviction (and ident reuse) is safe for the snapshot/delta
+        # pattern because both reads happen on the SAME live thread
+        # within one request.
+        from collections import OrderedDict
+
+        self._thread_counts: "OrderedDict[int, int]" = OrderedDict()
+
+    _THREAD_CAP = 512
 
     def _record(self, key: str) -> None:
+        tid = threading.get_ident()
         with self._lock:
             n = self._counts.get(key, 0) + 1
             self._counts[key] = n
+            self._thread_counts[tid] = self._thread_counts.get(tid, 0) + 1
+            self._thread_counts.move_to_end(tid)
+            while len(self._thread_counts) > self._THREAD_CAP:
+                self._thread_counts.popitem(last=False)
         if self.max_traces is not None and n > self.max_traces:
             raise TraceBudgetExceeded(
                 f"jitted `{key}` traced {n} times "
@@ -61,6 +81,12 @@ class TraceAuditor:
     def total(self) -> int:
         with self._lock:
             return sum(self._counts.values())
+
+    def thread_total(self) -> int:
+        """Traces recorded on the CALLING thread (exact: jit tracing is
+        synchronous in the caller)."""
+        with self._lock:
+            return self._thread_counts.get(threading.get_ident(), 0)
 
     def snapshot(self) -> Dict[str, int]:
         return self.counts()
