@@ -800,11 +800,10 @@ def run_bench(args, jax) -> dict:
         tops32 = [_msearch_top1(node, q) for q in sample]
         _os.environ["ESTPU_IMPACT_BF16"] = "1"
         try:
-            from elasticsearch_tpu.index.segment import DENSE_IMPACT_BUDGET
-
             with inv._dense_lock:
-                DENSE_IMPACT_BUDGET.release(inv._dense_bytes)
-                inv._dense_bytes = 0
+                # dropping the handle releases its fielddata-breaker
+                # charge (resources/residency.py finalizer); the next
+                # dense_block() rebuilds in bf16
                 inv._dense = None
                 inv._dense_host = None
             beat()

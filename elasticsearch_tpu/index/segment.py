@@ -16,8 +16,12 @@ structure as a *static-shaped dense array in device memory*:
   ``segment_sum`` reductions — the basis of the terms aggregation.
 - Doc values per numeric/keyword/date/bool field: dense columns padded to
   ``max_docs`` (power of two). 64-bit values (longs, date millis) keep an
-  exact int32 (hi, lo) pair on device for exact range comparison plus an f32
+  exact int32 (hi, lo) pair for exact range comparison plus an f32
   channel for arithmetic, and an exact numpy mirror on host for fetch.
+  Columns freeze as HOST arrays and load lazily into the EVICTABLE
+  fielddata residency tier on first search touch (resources/residency.py
+  — the fielddata breaker gates the load, pressure evicts LRU device
+  copies, the next touch rehydrates from the retained host array).
 - Dense vectors: one ``[max_docs, dims]`` slab (f32; bf16 copy made by the
   kNN op) — MXU-friendly.
 - ``live``: deletion mask (Lucene liveDocs equivalent).
@@ -52,9 +56,12 @@ def _jnp():
 
 
 def _device_put(x):
-    import jax
+    # every always-resident segment placement goes through the residency
+    # choke point (accounting; admission control is the engine's
+    # per-segment breaker charge at freeze — see _charge_segment)
+    from elasticsearch_tpu import resources
 
-    return jax.device_put(x)
+    return resources.RESIDENCY.device_put(x, tier="segments")
 
 
 def split_i64(v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -71,52 +78,26 @@ def split_i64(v: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return hi, lo
 
 
-class HbmBudget:
-    """Byte-budget circuit breaker for device-resident acceleration caches
-    (reference: org/elasticsearch/common/breaker/ — fielddata/request circuit
-    breakers). Dense impact blocks are an optimisation, so when the budget is
-    exhausted a field simply stays on the pure-scatter path instead of
-    erroring (unlike ES's breaker, which fails the request). Thread-safe:
-    searches run concurrently under the threading REST server."""
+# HbmBudget lives in resources/breakers.py now (the ad-hoc budget grew
+# into the ES-shaped hierarchy); re-exported here for embedders/tests
+# that construct standalone budgets.
+from elasticsearch_tpu.resources import BREAKERS
+from elasticsearch_tpu.resources.breakers import HbmBudget  # noqa: F401
 
-    def __init__(self, total_bytes: int = 2 << 30):
-        self.total = total_bytes
-        self.used = 0
-        self._lock = threading.Lock()
+# the fielddata-tier breaker now governs every lazily-loaded evictable
+# device copy (columns, vector slabs, dense impact blocks) — kept under
+# the old name for embedders. NOTE: import-time binding to the default
+# service; in-package code resolves via resources.RESIDENCY.breakers at
+# use time so swapped test singletons stay consistent
+DENSE_IMPACT_BUDGET = BREAKERS.breaker("fielddata")
 
-    def remaining(self) -> int:
-        with self._lock:
-            return max(0, self.total - self.used)
-
-    def reserve(self, n: int) -> bool:
-        with self._lock:
-            if self.used + n > self.total:
-                return False
-            self.used += n
-            return True
-
-    def force(self, n: int) -> None:
-        """Unconditional charge — for merges, which net-release memory and
-        must never fail on transient accounting order."""
-        with self._lock:
-            self.used += n
-
-    def release(self, n: int) -> None:
-        with self._lock:
-            self.used = max(0, self.used - n)
-
-
-# global budget shared by every segment's lazily-built dense blocks
-DENSE_IMPACT_BUDGET = HbmBudget()
-
-# node-wide breaker for segment HBM: every freeze charges the segment's
-# memory_bytes() against it; exhaustion fails the REQUEST with a typed
-# CircuitBreakingException instead of device-OOMing the node (reference:
-# common/breaker/CircuitBreaker.java — the fielddata/request breakers).
+# node-wide breaker for always-resident segment HBM (postings, live
+# masks): every freeze charges the segment's memory_bytes() against it;
+# exhaustion fails the REQUEST with a typed CircuitBreakingException
+# instead of device-OOMing the node (reference:
+# common/breaker/CircuitBreaker.java via resources/breakers.py).
 # Merges release-then-charge and never trip (they net-shrink memory).
-SEGMENT_HBM_BUDGET = HbmBudget(
-    int(__import__("os").environ.get("ESTPU_SEGMENT_BUDGET_BYTES",
-                                     8 << 30)))
+SEGMENT_HBM_BUDGET = BREAKERS.breaker("segments")
 
 
 def build_dense_impact(
@@ -205,10 +186,9 @@ class InvertedField:
     # host mirror of the dense impact block (set when _dense is built)
     _dense_host: Any = None
     # lazy hybrid dense-impact block: False = checked & permanently absent
-    # (no qualifying terms); (dense_rows np.i32[V], impact dev f32[F_pad, D])
+    # (no qualifying terms); (dense_rows np.i32[V], ResidentArray handle)
     # when present; None = not built yet (incl. transient budget denial)
     _dense: Any = None
-    _dense_bytes: int = 0
     _dense_lock: Any = dfield(default_factory=threading.Lock)
     # lazy cross-device postings split for an OVERSIZED field (see
     # parallel/postings_shard.py): None = unchecked, False = declined
@@ -242,34 +222,58 @@ class InvertedField:
                 self._pshard = split if split is not None else False
         return self._pshard or None
 
+    @staticmethod
+    def _dense_get(d):
+        """(rows, device impact) from a built block, rehydrating an
+        evicted one — BEST-EFFORT like the build: a breaker-denied
+        rehydration falls back to the scatter path (None) instead of
+        failing the request the block only accelerates."""
+        from elasticsearch_tpu.utils.errors import CircuitBreakingException
+
+        rows, handle = d
+        try:
+            return rows, handle.get()
+        except CircuitBreakingException:
+            return None
+
     def dense_block(self):
         """Lazy (dense_rows, device impact) for hybrid scoring, or None.
 
         Frequent terms (long postings runs) score via one MXU matmul instead
         of scatter-adds; see build_dense_impact. Built on first search that
         touches this field; small segments have no qualifying terms and pay
-        nothing. Charged against the global DENSE_IMPACT_BUDGET circuit
-        breaker — when HBM is tight the field stays on the scatter path and
-        retries once budget frees up (only 'no qualifying terms' is cached
-        as a permanent no).
+        nothing. Registered as an EVICTABLE fielddata-tier residency handle
+        (resources/residency.py): when HBM is tight the registry evicts LRU
+        copies first, and a denied build leaves the field on the scatter
+        path to retry once budget frees up (only 'no qualifying terms' is
+        cached as a permanent no). An evicted block rehydrates from the
+        host mirror on the next touch.
         """
         d = self._dense
         if d is False:
             return None
         if d is not None:
-            return d
+            return self._dense_get(d)
         with self._dense_lock:
             if self._dense is False:
                 return None
             if self._dense is not None:
-                return self._dense
+                return self._dense_get(self._dense)
             if self.doc_ids_host is None or not self.max_docs:
                 self._dense = False
                 return None
             # budget check BEFORE the (expensive) host-side build; a denial
-            # is transient — leave _dense = None so a later query retries
+            # is transient — leave _dense = None so a later query retries.
+            # Resolve the breaker through the LIVE registry (the one the
+            # put_array charge below goes to) — the import-time module
+            # binding would read a stale service when tests swap the
+            # resources singletons
+            from elasticsearch_tpu import resources
+
             min_bytes = 8 * 4 * self.max_docs
-            granted = min(1 << 30, DENSE_IMPACT_BUDGET.remaining())
+            granted = min(
+                1 << 30,
+                resources.RESIDENCY.breakers.breaker("fielddata").remaining())
             if granted < min_bytes:
                 return None
             tfn = self.tfnorm_host
@@ -289,34 +293,25 @@ class InvertedField:
             # agreement). Host mirror stays f32 for mesh restacking.
             bf16 = os.environ.get("ESTPU_IMPACT_BF16", "").lower() in (
                 "1", "true")
-            # reserve BEFORE the device allocation: the breaker must gate
-            # the HBM landing, not account for it after the fact
-            nbytes = impact.size * (2 if bf16 else 4)
-            if not DENSE_IMPACT_BUDGET.reserve(nbytes):
-                return None  # lost a race for the budget: retry later
-            try:
-                if bf16:
-                    import jax.numpy as jnp
+            dtype = None
+            if bf16:
+                import jax.numpy as jnp
 
-                    dev = jnp.asarray(impact, dtype=jnp.bfloat16)
-                else:
-                    dev = _device_put(impact)
-            except Exception:
-                # the breaker's accounting must not leak when the
-                # allocation itself fails (device OOM / transfer error)
-                DENSE_IMPACT_BUDGET.release(nbytes)
-                raise
-            self._dense_bytes = nbytes
+                dtype = jnp.bfloat16
+            # best_effort: the block is a pure acceleration — a denied
+            # reservation (even after LRU eviction) leaves the field on
+            # the scatter path instead of failing the request
+            handle = resources.RESIDENCY.put_array(
+                impact, label=f"dense_impact:{self.name}",
+                tier="fielddata", dtype=dtype, best_effort=True)
+            if handle is None:
+                return None  # budget tight: retry later
             # host mirror: mesh prims restack [S, F, D] from it — pulling
             # the device copy back would be a huge d2h transfer (and on
             # network-attached chips big d2h pulls degrade the session)
             self._dense_host = impact
-            self._dense = (rows, dev)
-            return self._dense
-
-    def __del__(self):
-        if getattr(self, "_dense_bytes", 0):
-            DENSE_IMPACT_BUDGET.release(self._dense_bytes)
+            self._dense = (rows, handle)
+            return rows, handle.get()
 
     @property
     def nnz_pad(self) -> int:
@@ -384,6 +379,55 @@ for _pname in ("doc_ids", "tf", "tfnorm", "term_ids"):
 del _pname
 
 
+def _resident_field(name: str):
+    """Attach a lazy EVICTABLE device accessor for one doc-value column
+    array (the fielddata tier of resources/residency.py).
+
+    Freeze stores the HOST array; the first search that touches the
+    column registers it with the residency registry (charging the
+    fielddata breaker — this is the "lazy column load" that can trip
+    ``indices.breaker.fielddata.limit``) and hands out the device copy.
+    Under HBM pressure the registry drops the device copy LRU-first and
+    the next touch rehydrates from the retained host array — the
+    reference's fielddata load/evict cycle, with the host mirror playing
+    the role of the Lucene disk image. Legacy callers that assign an
+    already-placed device array keep working, unaccounted (bench paths).
+    """
+    raw = f"_{name}_res"
+    raw_lock = f"_{name}_res_lock"
+
+    def _get(self):
+        v = self.__dict__.get(raw)
+        if v is None:
+            return None
+        from elasticsearch_tpu.resources.residency import ResidentArray
+
+        if isinstance(v, ResidentArray):
+            return v.get()
+        if isinstance(v, np.ndarray):
+            # first-touch registration is locked (dict.setdefault is
+            # atomic under the GIL): two concurrent searches must not
+            # each charge the breaker and upload the same slab
+            lock = self.__dict__.setdefault(raw_lock, threading.Lock())
+            with lock:
+                v = self.__dict__.get(raw)
+                if isinstance(v, np.ndarray):
+                    from elasticsearch_tpu import resources
+
+                    v = resources.RESIDENCY.put_array(
+                        v, label=f"column:{self.name}.{name}",
+                        tier="fielddata")
+                    self.__dict__[raw] = v
+            if isinstance(v, ResidentArray):
+                return v.get()
+        return v  # pre-placed device array (legacy construction)
+
+    def _set(self, v):
+        self.__dict__[raw] = v
+
+    return property(_get, _set)
+
+
 @dataclass
 class NumericColumn:
     name: str
@@ -399,6 +443,13 @@ class NumericColumn:
     # offset, with offset = segment min. Consumers add offset back (aggs) or
     # shift query bounds down (range masks); exact compares use (hi, lo).
     offset: float = 0.0
+
+    @property
+    def has_pair(self) -> bool:
+        """True when the exact (hi, lo) int32 pair exists. Presence check
+        only — must NOT force the lazy device load (the mesh prims ask
+        this and then restack from the host `exact` mirror)."""
+        return self.__dict__.get("_hi_res") is not None
 
 
 @dataclass
@@ -452,6 +503,7 @@ class VectorColumn:
         content-addressed blob cache first so restarts / snapshot restores
         reload the persisted quantizer instead of re-running k-means
         (index/ivf_cache.py; counters ivf_cache_hit / ivf_build)."""
+        # (uses the host mirrors — never forces the lazy device slab)
         if self._ivf is None:
             from elasticsearch_tpu.index import ivf_cache
             from elasticsearch_tpu.monitor import kernels
@@ -470,6 +522,36 @@ class VectorColumn:
                     ivf_cache.store(key, idx)
             self._ivf = idx if idx is not None else False
         return self._ivf or None
+
+
+# doc-value columns load lazily into the evictable fielddata tier (see
+# _resident_field): freeze stores host arrays, the first search places
+# them, pressure evicts them, the next touch rehydrates
+_COLUMN_RESIDENT_FIELDS = (
+    (NumericColumn, ("values", "exists", "hi", "lo")),
+    (KeywordColumn, ("ords", "exists")),
+    (VectorColumn, ("vecs", "exists")),
+)
+for _ccls, _cfields in _COLUMN_RESIDENT_FIELDS:
+    for _f in _cfields:
+        setattr(_ccls, _f, _resident_field(_f))
+del _ccls, _cfields, _f
+
+
+def _column_resident(col, fields) -> Tuple[int, int, int]:
+    """(resident_bytes, evictions, rehydrations) over one column's
+    registered residency handles."""
+    from elasticsearch_tpu.resources.residency import ResidentArray
+
+    b = ev = rh = 0
+    for nm in fields:
+        h = col.__dict__.get(f"_{nm}_res")
+        if isinstance(h, ResidentArray):
+            if h.resident:
+                b += h.nbytes
+            ev += h.evictions
+            rh += h.rehydrations
+    return b, ev, rh
 
 
 class TpuSegment:
@@ -567,45 +649,63 @@ class TpuSegment:
         return self.num_docs - self.deleted_count
 
     def memory_bytes(self) -> int:
-        """Approximate HBM footprint (circuit-breaker accounting)."""
+        """Approximate ALWAYS-RESIDENT HBM footprint — the `segments`
+        breaker charge at freeze (live mask + postings). Doc-value
+        columns and vector slabs are NOT counted here: they load lazily
+        into the evictable fielddata tier and charge the fielddata
+        breaker on first touch (resources/residency.py)."""
         total = self.max_docs  # live mask
         for inv in self.inverted.values():
             total += inv.nnz_pad * (4 + 4 + 4 + 4)
-        for col in self.numerics.values():
-            total += self.max_docs * 5
-            if col.hi is not None:
-                total += self.max_docs * 8
-        for col in self.keywords.values():
-            total += self.max_docs * 5
-        for col in self.vectors.values():
-            total += self.max_docs * col.dims * 4
         return total
 
+    def _column_iter(self):
+        """(column, resident-field names) for every doc-value column."""
+        for col in self.numerics.values():
+            yield col, ("values", "exists", "hi", "lo")
+        for col in self.keywords.values():
+            yield col, ("ords", "exists")
+        for col in self.vectors.values():
+            yield col, ("vecs", "exists")
+
     def fielddata_field_bytes(self) -> Dict[str, int]:
-        """Per-field doc-value memory — the `fielddata` section of _stats
-        (reference: index/fielddata/ShardFieldData.java per-field maps).
-        TPU deviation: columns are built at freeze and always
-        device-resident, so fielddata is never lazily loaded and never
-        evicted (evictions stay 0 by design); for analyzed text the
-        uninverted postings arrays play fielddata's sort/agg role."""
+        """Per-field doc-value memory currently DEVICE-RESIDENT — the
+        `fielddata` section of _stats (reference:
+        index/fielddata/ShardFieldData.java per-field maps). Columns
+        load lazily at first search and evict under HBM pressure, so
+        like the reference this reports loaded bytes, not mapped bytes;
+        for analyzed text the always-resident uninverted postings
+        arrays play fielddata's sort/agg role and report in full."""
         out: Dict[str, int] = {}
 
         def add(name, b):
-            out[name] = out.get(name, 0) + b
+            if b:
+                out[name] = out.get(name, 0) + b
 
-        for name, col in self.numerics.items():
-            add(name, self.max_docs * 5
-                + (self.max_docs * 8 if col.hi is not None else 0))
-        for name in self.keywords:
-            add(name, self.max_docs * 5)
-        for name, col in self.vectors.items():
-            add(name, self.max_docs * col.dims * 4)
+        for col, fields in self._column_iter():
+            add(col.name, _column_resident(col, fields)[0])
         for name, inv in self.inverted.items():
             if name in self.keywords or name in self.numerics \
                     or name.startswith("_"):
                 continue
             add(name, inv.nnz_pad * 12)  # term_ids + doc_ids + tf
         return out
+
+    def fielddata_evictions(self) -> Tuple[int, int]:
+        """(evictions, rehydrations) over this segment's column and
+        dense-impact residency handles — the once-zero-by-design
+        `fielddata.evictions` counter is real now."""
+        ev = rh = 0
+        for col, fields in self._column_iter():
+            _, e, r = _column_resident(col, fields)
+            ev += e
+            rh += r
+        for inv in self.inverted.values():
+            d = inv._dense
+            if isinstance(d, tuple):
+                ev += d[1].evictions
+                rh += d[1].rehydrations
+        return ev, rh
 
 
 class SegmentBuilder:
@@ -705,8 +805,10 @@ class SegmentBuilder:
                 if v is not None:
                     mat[i] = np.asarray(v, dtype=np.float32)
                     exists[i] = True
+            # host arrays: the device slab loads lazily into the
+            # evictable fielddata tier on first touch (_resident_field)
             vc = VectorColumn(
-                name=fname, vecs=_device_put(mat), exists=_device_put(exists),
+                name=fname, vecs=mat, exists=exists,
                 dims=dims, vecs_host=mat, exists_host=exists, similarity=sim,
             )
             fm = self.mappings.get(fname)
@@ -941,8 +1043,8 @@ class SegmentBuilder:
         )
         kwcol = KeywordColumn(
             name=fname,
-            ords=_device_put(ords_re),
-            exists=_device_put(exists),
+            ords=ords_re,  # host: lazy evictable device copy (fielddata)
+            exists=exists,
             host_values=host_values,
             ords_host=ords_re,
             exists_host=exists,
@@ -965,8 +1067,8 @@ class SegmentBuilder:
         values = np.where(exists, (exact - offset).astype(np.float32), np.float32(0))
         col = NumericColumn(
             name=fname,
-            values=_device_put(values.astype(np.float32)),
-            exists=_device_put(exists),
+            values=values.astype(np.float32),  # host: lazy evictable
+            exists=exists,                     # device copies (fielddata)
             exact=exact,
             exists_host=exists,
             kind=kind,
@@ -974,6 +1076,6 @@ class SegmentBuilder:
         )
         if needs_exact:
             hi, lo = split_i64(exact)
-            col.hi = _device_put(hi)
-            col.lo = _device_put(lo)
+            col.hi = hi
+            col.lo = lo
         return col

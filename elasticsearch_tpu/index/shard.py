@@ -54,9 +54,13 @@ class IndexShard:
         e = self.engine.stats
         segs = self.engine.segments
         fd_fields: dict = {}
+        fd_evictions = fd_rehydrations = 0
         for seg in segs:
             for fname, b in seg.fielddata_field_bytes().items():
                 fd_fields[fname] = fd_fields.get(fname, 0) + b
+            ev, rh = seg.fielddata_evictions()
+            fd_evictions += ev
+            fd_rehydrations += rh
         comp_fields = self._completion_sizes(segs)
         indexing = {"index_total": e.index_total,
                     "delete_total": e.delete_total,
@@ -75,9 +79,13 @@ class IndexShard:
                 "count": len(segs),
                 "memory_in_bytes": sum(s.memory_bytes() for s in segs),
             },
+            # resident bytes + REAL evict/rehydrate counters: columns load
+            # lazily into the evictable fielddata tier now
+            # (resources/residency.py), so these move under HBM pressure
             "fielddata": {
                 "memory_size_in_bytes": sum(fd_fields.values()),
-                "evictions": 0,
+                "evictions": fd_evictions,
+                "rehydrations": fd_rehydrations,
                 "fields": {f: {"memory_size_in_bytes": b}
                            for f, b in fd_fields.items()},
             },

@@ -187,9 +187,14 @@ def read_ivf(data: bytes):
     lists = vbyte_decode(*raws["lists"]).astype(np.int32).reshape(
         st["C"], st["Lmax"])
     lens = vbyte_decode(*raws["list_lens"]).astype(np.int32)
+    from elasticsearch_tpu import resources
+
+    put = resources.RESIDENCY.device_put  # accounted placement
     return IvfIndex(
-        centroids=jax.device_put(cents), lists=jax.device_put(lists),
-        list_lens=jax.device_put(lens), C=int(st["C"]), Lmax=int(st["Lmax"]),
+        centroids=put(cents, label="ivf.centroids"),
+        lists=put(lists, label="ivf.lists"),
+        list_lens=put(lens, label="ivf.list_lens"),
+        C=int(st["C"]), Lmax=int(st["Lmax"]),
         sentinel=int(st["sentinel"]), avg_len=float(st["avg_len"]),
         metric=st.get("metric", "cosine"),
     )

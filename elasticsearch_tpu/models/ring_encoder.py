@@ -208,7 +208,9 @@ def ring_encode(cfg: DualEncoderConfig, params, token_ids, attn_mask, mesh):
     fwd = _jitted_fwd(cfg, mesh, S)
     seq_sh = NamedSharding(mesh, PS(None, "sp"))
     rep = NamedSharding(mesh, PS())
-    pt = jax.device_put(
+    # offbudget: per-call encode inputs + caller-owned model params (the
+    # encoder is stateless here — weight residency belongs to the caller)
+    pt = jax.device_put(  # tpulint: offbudget
         params["params"] if "params" in params else params, rep)
-    return fwd(pt, jax.device_put(ids, seq_sh),
-               jax.device_put(msk, seq_sh))
+    return fwd(pt, jax.device_put(ids, seq_sh),  # tpulint: offbudget
+               jax.device_put(msk, seq_sh))  # tpulint: offbudget

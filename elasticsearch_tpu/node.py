@@ -51,6 +51,12 @@ class Node:
 
         self.tasks = TaskRegistry(self.node_id)
         self.tracer = Tracer(self.node_id)
+        # resource management: rehydration spans (tpu.rehydrate) land in
+        # this node's tracer ring (process-shared registry — the device
+        # is process-shared too; last in-process node wins)
+        from elasticsearch_tpu import resources
+
+        resources.RESIDENCY.set_tracer(self.tracer)
         # lazy: pools spin worker threads, so library-embedded Nodes that
         # never serve REST traffic don't pay for them
         self._thread_pool = None
@@ -581,6 +587,7 @@ class Node:
         search = {k: 0 for k in SearchStats().to_json()}
         indexing = {"index_total": 0, "delete_total": 0, "index_time_in_millis": 0}
         seg_count = seg_mem = 0
+        fd_mem = fd_ev = 0
         tl_frames = tl_bytes = 0
         for svc in self.indices.values():
             for g in svc.groups:
@@ -595,6 +602,8 @@ class Node:
                         indexing[k] += st["indexing"][k]
                     seg_count += st["segments"]["count"]
                     seg_mem += st["segments"]["memory_in_bytes"]
+                    fd_mem += st["fielddata"]["memory_size_in_bytes"]
+                    fd_ev += st["fielddata"]["evictions"]
                     tl_frames += st["translog"].get("corrupt_tail_events", 0)
                     tl_bytes += st["translog"].get(
                         "corrupt_tail_bytes_dropped", 0)
@@ -622,6 +631,10 @@ class Node:
                         "indexing": indexing,
                         "segments": {"count": seg_count,
                                      "memory_in_bytes": seg_mem},
+                        # resident fielddata + the once-zero eviction
+                        # counter, real since columns became evictable
+                        "fielddata": {"memory_size_in_bytes": fd_mem,
+                                      "evictions": fd_ev},
                         # translog replay damage accounting, aggregated
                         # from THIS node's own shards (the process-global
                         # event log with per-path detail lives in
@@ -646,6 +659,9 @@ class Node:
                     "thread_pool": (self._thread_pool.stats()
                                     if self._thread_pool is not None else {}),
                     "breakers": self._breaker_stats(),
+                    # residency tiers: resident bytes + evict/rehydrate
+                    # counters + the device-put accounting choke point
+                    "resources": self._residency_stats(),
                     # transport info (reference: NodeInfo transport section;
                     # profiles {} = no extra transport profiles configured)
                     "transport": self._transport_info(),
@@ -685,17 +701,19 @@ class Node:
             os.path.abspath(self.data_path) + os.sep)
 
     @staticmethod
-    def _breaker_stats() -> dict:
-        from elasticsearch_tpu.index.segment import (DENSE_IMPACT_BUDGET,
-                                                     SEGMENT_HBM_BUDGET)
+    def _residency_stats() -> dict:
+        from elasticsearch_tpu import resources
 
-        return {
-            "segments": {"limit_size_in_bytes": SEGMENT_HBM_BUDGET.total,
-                         "estimated_size_in_bytes": SEGMENT_HBM_BUDGET.used},
-            "dense_impact": {
-                "limit_size_in_bytes": DENSE_IMPACT_BUDGET.total,
-                "estimated_size_in_bytes": DENSE_IMPACT_BUDGET.used},
-        }
+        return resources.RESIDENCY.stats()
+
+    @staticmethod
+    def _breaker_stats() -> dict:
+        """ES-shaped `/_nodes/stats/breaker`: parent + fielddata/request/
+        in_flight_requests (+ the accelerator-extra `segments`), real
+        estimated/tripped numbers (resources/breakers.py)."""
+        from elasticsearch_tpu import resources
+
+        return resources.BREAKERS.stats()
 
     def info(self) -> dict:
         import jax

@@ -92,8 +92,9 @@ def kmeans(vecs_np: np.ndarray, C: int, iters: int = 8, seed: int = 1234,
     def assign_only(vecs, cents, *, metric):
         return jnp.argmax(_quantizer_affinity(jnp, vecs, cents, metric), axis=1)
 
-    d_vecs = jax.device_put(vecs_np.astype(np.float32))
-    d_cents = jax.device_put(cents)
+    # offbudget: k-means build temporaries — freed when the build returns
+    d_vecs = jax.device_put(vecs_np.astype(np.float32))  # tpulint: offbudget
+    d_cents = jax.device_put(cents)  # tpulint: offbudget
     for _ in range(iters):
         d_cents, _ = step(d_vecs, d_cents, nc=C, metric=metric)
     assign = assign_only(d_vecs, d_cents, metric=metric)
@@ -144,10 +145,15 @@ def build_ivf(vecs_np: np.ndarray, exists_np: np.ndarray, max_docs: int,
     for i, a in zip(ids, assign):
         lists[a, fill[a]] = i
         fill[a] += 1
+    # IVF device caches live as long as the owning VectorColumn — place
+    # through the residency choke point so their HBM is accounted
+    from elasticsearch_tpu import resources
+
+    put = resources.RESIDENCY.device_put
     return IvfIndex(
-        centroids=jax.device_put(cents),
-        lists=jax.device_put(lists),
-        list_lens=jax.device_put(counts.astype(np.int32)),
+        centroids=put(cents, label="ivf.centroids"),
+        lists=put(lists, label="ivf.lists"),
+        list_lens=put(counts.astype(np.int32), label="ivf.list_lens"),
         C=C, Lmax=Lmax, sentinel=max_docs,
         avg_len=float(n) / C, metric=metric,
     )
@@ -183,7 +189,8 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
                                quantizer_metric=index.metric,
                                scatter_free=sf)
         _PROGRAMS[key] = prog
-    q = jax.device_put(np.asarray(query_np, np.float32))
+    # offbudget: transient per-query upload
+    q = jax.device_put(np.asarray(query_np, np.float32))  # tpulint: offbudget
     return prog(q, index.centroids, index.lists, vecs)
 
 

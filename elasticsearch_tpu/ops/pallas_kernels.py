@@ -290,8 +290,27 @@ def bm25_dense_tiles_for(Q: int, F: int, D: int):
 
 
 # sticky failure latch for the fused BM25 kernel (list so the traced-free
-# eager dispatcher can flip it in place)
+# eager dispatcher can flip it in place). Latches ONLY on deterministic
+# compile/lowering failures — a transient runtime error (momentary device
+# OOM, transfer hiccup) falls back per-call and the kernel retries, up to
+# a bounded run of consecutive failures so a persistently-broken device
+# can't pay a fresh kernel attempt on every batch until restart.
 _BM25_PALLAS_BROKEN = [False]
+_BM25_TRANSIENT_FAILS = [0]
+_BM25_TRANSIENT_LIMIT = 8
+
+# error shapes that mean "this kernel will NEVER compile/lower here" —
+# deterministic, so one failure latches. Everything else is treated as
+# transient (RESOURCE_EXHAUSTED, cancelled transfers, backend restarts).
+_COMPILE_ERR_MARKERS = ("mosaic", "lowering", "unsupported", "unimplemented",
+                        "compilation", "cannot lower")
+
+
+def _is_compile_error(e: BaseException) -> bool:
+    if isinstance(e, NotImplementedError):
+        return True
+    text = f"{type(e).__name__}: {e}".lower()
+    return any(m in text for m in _COMPILE_ERR_MARKERS)
 
 
 def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
@@ -334,21 +353,41 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
                     [qw, jnp.zeros((qpad - Q, F), qw.dtype)], axis=0)
                 vals, idx = bm25_dense_topk_pallas(qp, impact, mask, k=k,
                                                    tile=tile, q_tile=q_tile)
+                _BM25_TRANSIENT_FAILS[0] = 0
                 return vals[:Q], idx[:Q]
-            return bm25_dense_topk_pallas(qw, impact, mask, k=k, tile=tile,
-                                          q_tile=q_tile)
+            out = bm25_dense_topk_pallas(qw, impact, mask, k=k, tile=tile,
+                                         q_tile=q_tile)
+            _BM25_TRANSIENT_FAILS[0] = 0
+            return out
         except Exception as e:
             import warnings
 
             from elasticsearch_tpu.monitor import kernels
 
-            # sticky: a deterministic Mosaic lowering failure must not
-            # pay a fresh trace/compile attempt on every batch
-            _BM25_PALLAS_BROKEN[0] = True
             kernels.record("bm25_pallas_failed")
-            warnings.warn(f"fused BM25 kernel failed ({type(e).__name__}: "
-                          f"{str(e)[:200]}); serving via the XLA path "
-                          f"from now on")
+            if _is_compile_error(e):
+                # sticky: a deterministic Mosaic lowering failure must not
+                # pay a fresh trace/compile attempt on every batch
+                _BM25_PALLAS_BROKEN[0] = True
+                warnings.warn(f"fused BM25 kernel failed ({type(e).__name__}"
+                              f": {str(e)[:200]}); serving via the XLA path "
+                              f"from now on")
+            else:
+                # transient (device OOM mid-burst, transfer error): fall
+                # back for THIS call only; a bounded run of consecutive
+                # failures latches anyway (every retry costs a batch)
+                _BM25_TRANSIENT_FAILS[0] += 1
+                if _BM25_TRANSIENT_FAILS[0] >= _BM25_TRANSIENT_LIMIT:
+                    _BM25_PALLAS_BROKEN[0] = True
+                    warnings.warn(
+                        f"fused BM25 kernel failed {_BM25_TRANSIENT_FAILS[0]}"
+                        f" consecutive times ({type(e).__name__}: "
+                        f"{str(e)[:200]}); latching to the XLA path")
+                else:
+                    warnings.warn(
+                        f"fused BM25 kernel transient failure "
+                        f"({type(e).__name__}: {str(e)[:200]}); XLA "
+                        f"fallback for this batch")
     from elasticsearch_tpu.ops.scoring import (impact_precision, topk_auto,
                                                topk_block_config)
 

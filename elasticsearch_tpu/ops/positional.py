@@ -293,12 +293,17 @@ def positional_device(inv):
         return cached
     if inv.positions is None or inv.pos_offsets is None:
         return None
-    pos = jax.device_put(np.asarray(inv.positions, np.int32))
-    offs = jax.device_put(np.asarray(inv.pos_offsets, np.int32))
+    # cached as long as the field: place through the residency choke
+    # point so the positional CSR's HBM is accounted
+    from elasticsearch_tpu import resources
+
+    put = resources.RESIDENCY.device_put
+    pos = put(np.asarray(inv.positions, np.int32), label="positions")
+    offs = put(np.asarray(inv.pos_offsets, np.int32), label="pos_offsets")
     counts = np.diff(inv.pos_offsets).astype(np.int64)
     doc_per_pos = np.repeat(inv.doc_ids_host[:counts.shape[0]],
                             counts).astype(np.int32)
-    dpp = jax.device_put(doc_per_pos)
+    dpp = put(doc_per_pos, label="doc_per_pos")
     inv._pos_host_dpp = doc_per_pos
     inv._pos_dev = (pos, offs, dpp)
     return inv._pos_dev

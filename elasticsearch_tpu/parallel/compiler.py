@@ -288,7 +288,7 @@ class RangePrim(DataPrim):
     def build(self, seg_row, ctxs, D, S, cache):
         cols = [(s.numerics.get(self.field) if s is not None else None)
                 for s in seg_row]
-        has_pair = any(c is not None and c.hi is not None for c in cols)
+        has_pair = any(c is not None and c.has_pair for c in cols)
         pair = has_pair and self.use_int
         if pair:
             def fill():
@@ -298,7 +298,7 @@ class RangePrim(DataPrim):
                 from elasticsearch_tpu.index.segment import split_i64
 
                 for si, c in enumerate(cols):
-                    if c is not None and c.hi is not None:
+                    if c is not None and c.has_pair:
                         hi, lo = split_i64(c.exact)  # host, no d2h
                         h_hi[si, : hi.shape[0]] = hi
                         h_lo[si, : lo.shape[0]] = lo

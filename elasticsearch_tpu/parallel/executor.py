@@ -20,6 +20,7 @@ serves many queries of the same structure.
 """
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
@@ -31,6 +32,19 @@ from elasticsearch_tpu.utils.shapes import pow2_bucket
 # device-array LRU capacity per executor (entries are whole segment rounds;
 # eviction frees HBM for indexes that refresh frequently)
 _DATA_CACHE_CAP = 32
+
+
+def _dev_nbytes(val) -> int:
+    """Total device bytes referenced by a cache entry (arrays nested in
+    lists/tuples) — the executor caches' residency accounting."""
+    total, stack = 0, [val]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+        else:
+            total += int(getattr(v, "nbytes", 0) or 0)
+    return total
 
 
 def _jax():
@@ -404,12 +418,17 @@ class MeshSearchExecutor:
         # identity + tombstone counts, k) → (compiled, prog, device
         # inputs, kk, segment refs — pinned so an id() in the key can
         # never be recycled while its entry is alive, the _cached_data
-        # discipline)
+        # discipline —, residency token)
         self._prep: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # _qc_lock discipline (index_service.py): searches race under the
+        # threading REST server, and a concurrent cap-overflow popitem
+        # racing a move_to_end corrupts the OrderedDict into a 500
+        self._prep_lock = threading.Lock()
         # sharded device arrays per segment round — postings and vector slabs
         # are immutable once frozen, so reuse them across queries; only the
         # (small) live mask is re-uploaded every call. LRU-bounded.
         self._data: "OrderedDict[Tuple, Any]" = OrderedDict()
+        self._data_lock = threading.Lock()
 
     def _put_sharded(self, a):
         """Device-put a host array laid out [S, ...] for the mesh. On a
@@ -417,24 +436,39 @@ class MeshSearchExecutor:
         it inside the program wraps downstream dots in loop fusions (see
         _collectives). np indexing is a view — no host copy."""
         jax = _jax()
+        # offbudget: mesh placement choke point — transient per-query
+        # inputs; the persistent rounds are charged via RESIDENCY.track
+        # in _cached_data / the prepared-query memo
         if self.S == 1:
-            return jax.device_put(np.asarray(a)[0],
+            return jax.device_put(np.asarray(a)[0],  # tpulint: offbudget
                                   self.mesh.devices.flat[0])
         from jax.sharding import NamedSharding, PartitionSpec as PS
 
-        return jax.device_put(a, NamedSharding(self.mesh, PS("shard")))
+        return jax.device_put(a, NamedSharding(self.mesh, PS("shard")))  # tpulint: offbudget
 
     def _cached_data(self, key, build, refs):
         """Cache device arrays keyed by segment ids. `refs` (the segments
         themselves) are stored alongside so a cached id() can never be
-        recycled by a new object while its entry is alive."""
-        if key in self._data:
-            self._data.move_to_end(key)
-            return self._data[key][0]
+        recycled by a new object while its entry is alive. Dict ops are
+        locked (concurrent searches race); build() runs unlocked — a
+        duplicate build is wasted work, a serialized compile is a stall.
+        Entries carry a residency token so the cache's HBM shows in
+        /_nodes (request tier, force-charged: the LRU cap is the ceiling)."""
+        with self._data_lock:
+            if key in self._data:
+                self._data.move_to_end(key)
+                return self._data[key][0]
         val = build()
-        self._data[key] = (val, list(refs))
-        if len(self._data) > _DATA_CACHE_CAP:
-            self._data.popitem(last=False)
+        from elasticsearch_tpu import resources
+
+        tok = resources.RESIDENCY.track(_dev_nbytes(val),
+                                        label="executor.data")
+        with self._data_lock:
+            self._data[key] = (val, list(refs), tok)
+            evicted = (self._data.popitem(last=False)
+                       if len(self._data) > _DATA_CACHE_CAP else None)
+        if evicted is not None:
+            evicted[1][2].close()
         return val
 
     # -- BM25 ---------------------------------------------------------------
@@ -583,7 +617,8 @@ class MeshSearchExecutor:
             prog = _knn_program(self.mesh, self._programs, Q=Q, dims=dims,
                                 D=D, k=min(k, D), metric=metric)
             vals, slot, local = prog(
-                jax.device_put(np.asarray(queries, np.float32)),
+                # offbudget: transient per-call query upload
+                jax.device_put(np.asarray(queries, np.float32)),  # tpulint: offbudget
                 d_vecs, self._put_sharded(h_live))
             slot = np.asarray(slot)
             out = (np.asarray(vals), lut_shard[slot], np.asarray(local),
@@ -644,18 +679,24 @@ class MeshSearchExecutor:
                                   if s is not None else None
                                   for s in seg_row),
                             k, k_dev, want_mask)
-            prep = self._prep.get(prep_key) if prep_key is not None else None
+            with self._prep_lock:
+                prep = (self._prep.get(prep_key)
+                        if prep_key is not None else None)
             if prep is not None:
-                compiled, prog, dev, kk, _refs = prep
+                compiled, prog, dev, kk, _refs, _tok = prep
                 try:
                     out = jax.device_get(prog(*dev))
                 except Exception:
                     # drop the entry and fall through to the fresh path,
                     # which carries the scatter-fallback insurance
-                    self._prep.pop(prep_key, None)
+                    with self._prep_lock:
+                        self._prep.pop(prep_key, None)
                     prep = None
                 else:
-                    self._prep.move_to_end(prep_key)  # LRU recency
+                    with self._prep_lock:
+                        if prep_key in self._prep:  # not popped by a
+                            # concurrent cap-overflow eviction
+                            self._prep.move_to_end(prep_key)  # LRU recency
                     self._record_tgroup_kernels(compiled)
                     self._decode_round(out, compiled, kk, sort_spec,
                                        lut_shard, lut_ord, seg_row, merged,
@@ -727,13 +768,30 @@ class MeshSearchExecutor:
                                     kk, pack_spec)
                 self._programs[(prog_key, pack_spec)] = prog
             in_pack = set(pack_idx) if pack_spec else set()
-            dev = [a if hasattr(a, "sharding") else self._put_sharded(a)
-                   for i, a in enumerate(arrays) if i not in in_pack]
+            # fresh_bytes: only THIS entry's exclusive placements count
+            # toward its residency token — arrays that arrive already
+            # device-resident (hasattr .sharding) are the shared
+            # _cached_data groups, charged once by their own token;
+            # re-counting them per memo entry multiplied phantom bytes
+            # until the parent breaker tripped real reservations
+            fresh_bytes = 0
+            dev = []
+            for i, a in enumerate(arrays):
+                if i in in_pack:
+                    continue
+                if hasattr(a, "sharding"):
+                    dev.append(a)
+                else:
+                    d = self._put_sharded(a)
+                    fresh_bytes += int(getattr(d, "nbytes", 0) or 0)
+                    dev.append(d)
             if pack_spec:
                 words = np.concatenate(
                     [np.ascontiguousarray(arrays[i]).reshape(self.S, -1)
                      .view(np.int32) for i in pack_idx], axis=1)
-                dev.append(self._put_sharded(words))
+                packed_dev = self._put_sharded(words)
+                fresh_bytes += int(getattr(packed_dev, "nbytes", 0) or 0)
+                dev.append(packed_dev)
             # ONE host transfer for the packed result — per-array pulls
             # each pay a fixed device round-trip (the dominant per-query
             # cost on network-attached chips)
@@ -760,21 +818,26 @@ class MeshSearchExecutor:
                 self._programs[(prog_key, pack_spec)] = prog
                 out = jax.device_get(prog(*dev))
             if prep_key is not None:
+                from elasticsearch_tpu import resources
+
+                tok = resources.RESIDENCY.track(fresh_bytes,
+                                                label="executor.prep")
                 # prune entries keyed by segments that left the live set
                 # (a refresh/merge replaced them): their keys can never
                 # match again, but they would pin dead segments + device
                 # buffers until the LRU cycles
                 live_ids = {id(seg) for sh in self.shards
                             for seg in _segments_of(sh)}
-                dead = [kk2 for kk2, ent in self._prep.items()
-                        if any(id(s) not in live_ids for s in ent[4])]
-                for kk2 in dead:
-                    self._prep.pop(kk2, None)
-                self._prep[prep_key] = (compiled, prog, dev, kk,
-                                        [s for s in seg_row
-                                         if s is not None])
-                if len(self._prep) > self._PREP_CACHE_CAP:
-                    self._prep.popitem(last=False)
+                with self._prep_lock:
+                    dead = [kk2 for kk2, ent in self._prep.items()
+                            if any(id(s) not in live_ids for s in ent[4])]
+                    for kk2 in dead:
+                        self._prep.pop(kk2, None)
+                    self._prep[prep_key] = (compiled, prog, dev, kk,
+                                            [s for s in seg_row
+                                             if s is not None], tok)
+                    if len(self._prep) > self._PREP_CACHE_CAP:
+                        self._prep.popitem(last=False)
             totals += int(out[0][-1])
             self._decode_round(out, compiled, kk, sort_spec, lut_shard,
                                lut_ord, seg_row, merged, agg_rounds,

@@ -178,9 +178,10 @@ class PostingsShardSplit:
             return jnp.zeros(D, np.float32), matched, 0
         kind = "counts" if with_counts else ("score" if all_positive else "mask")
         prog = self._program(kind, P, starts.shape[1], D)
+        # offbudget: transient per-query chunk tables
         out = prog(self.doc_ids_sh, self.tfnorm_sh,
-                   jax.device_put(starts), jax.device_put(lens),
-                   jax.device_put(ws))
+                   jax.device_put(starts), jax.device_put(lens),  # tpulint: offbudget
+                   jax.device_put(ws))  # tpulint: offbudget
         scores = out[0]
         if with_counts:
             matched = out[1]
@@ -227,8 +228,12 @@ def build_split(inv, max_docs: int, n_devices: Optional[int] = None
         tfnorm[s, : hi - lo] = tfn_host[lo:hi]
     mesh = Mesh(np.asarray(devs[:S]), ("pshard",))
     sh = NamedSharding(mesh, PS("pshard"))
+    from elasticsearch_tpu import resources
+
+    put = resources.RESIDENCY.device_put  # build-once split: accounted
     return PostingsShardSplit(
         mesh, bounds, bases,
-        jax.device_put(doc_ids, sh), jax.device_put(tfnorm, sh),
+        put(doc_ids, sh, label="pshard.doc_ids"),
+        put(tfnorm, sh, label="pshard.tfnorm"),
         L, max_docs, inv.vocab, offsets,
     )

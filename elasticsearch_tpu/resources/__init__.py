@@ -1,0 +1,48 @@
+"""Device-memory resource management: breakers + tiered residency.
+
+One subsystem, two halves (see docs/RESOURCES.md):
+
+- :mod:`breakers` — ES-shaped hierarchical circuit breakers (parent,
+  fielddata, request, in_flight_requests + the accelerator-extra
+  ``segments``), dynamically updatable via ``indices.breaker.*`` /
+  ``network.breaker.*`` cluster settings, surfaced at
+  ``/_nodes/stats/breaker``.
+- :mod:`residency` — the per-node registry accounting every
+  device-resident allocation through one choke point, with LRU
+  eviction + transparent rehydration for the lazily-loaded tier.
+
+``BREAKERS``/``RESIDENCY`` are the process singletons (the device is
+process-shared, so admission control is too). Always access them as
+``resources.BREAKERS`` attributes — tests swap them for isolated
+instances.
+
+Import cost: no jax at import time (jax loads lazily on first device
+placement), so the transport/tooling layers can import this freely.
+"""
+from __future__ import annotations
+
+from elasticsearch_tpu.resources.breakers import (CircuitBreaker,
+                                                  CircuitBreakerService,
+                                                  HbmBudget, hbm_capacity,
+                                                  parse_limit)
+from elasticsearch_tpu.resources.residency import (PinnedToken,
+                                                   ResidencyRegistry,
+                                                   ResidentArray)
+
+__all__ = [
+    "BREAKERS", "RESIDENCY", "CircuitBreaker", "CircuitBreakerService",
+    "HbmBudget", "PinnedToken", "ResidencyRegistry", "ResidentArray",
+    "hbm_capacity", "parse_limit", "apply_cluster_settings",
+]
+
+#: process-global breaker hierarchy + residency registry
+BREAKERS = CircuitBreakerService()
+RESIDENCY = ResidencyRegistry(BREAKERS)
+
+
+def apply_cluster_settings(flat: dict) -> None:
+    """Apply the merged cluster-settings map to the LIVE service (the
+    attribute, not the import-time binding — tests swap BREAKERS)."""
+    import elasticsearch_tpu.resources as _self
+
+    _self.BREAKERS.apply_cluster_settings(flat)

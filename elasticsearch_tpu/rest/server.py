@@ -86,6 +86,18 @@ class RestController:
                 continue
             match = rx.match(path)
             if match:
+                # in_flight_requests breaker (reference: the netty-level
+                # inflight-requests accounting): body bytes held in
+                # memory while the request runs; trip → 429 before any
+                # handler work
+                from elasticsearch_tpu import resources
+
+                inflight = resources.BREAKERS.breaker("in_flight_requests")
+                nbytes = len(body or b"")
+                try:
+                    inflight.break_or_reserve(nbytes, "<http_request>")
+                except ElasticsearchTpuException as e:
+                    return e.status, _error_body(e)
                 try:
                     # run on the route's named pool: bounded concurrency,
                     # full queues reject with 429 (ThreadPool.java contract)
@@ -106,6 +118,8 @@ class RestController:
                                   "reason": f"{type(e).__name__}: {e}"},
                         "status": 500,
                     }
+                finally:
+                    inflight.release(nbytes)
         return 400, {
             "error": {"type": "illegal_argument_exception",
                       "reason": f"no handler found for uri [{path}] and method [{method}]"},
@@ -818,8 +832,9 @@ def _sum_stats(dicts):
 # every section the IndicesStatsResponse carries; sections our runtime has
 # no meaningful numbers for report zeroed structures (they exist so metric
 # scoping and client consumers see the full 2.0 shape). fielddata reports
-# the always-resident device column bytes (built at freeze, never evicted
-# — see TpuSegment.fielddata_field_bytes)
+# the currently-RESIDENT device column bytes + real eviction counters
+# (columns load lazily and evict under HBM pressure — see
+# TpuSegment.fielddata_field_bytes / resources/residency.py)
 _STATS_SECTIONS = {
     "docs": {"count": 0, "deleted": 0},
     "store": {"size_in_bytes": 0, "throttle_time_in_millis": 0},
@@ -1198,9 +1213,11 @@ def _cat_shards(n: Node, p, b, index: Optional[str] = None):
 
 def _cat_fielddata(n: Node, p, b, fields: Optional[str] = None):
     """RestFielddataAction: one row per node with `total` plus one column
-    per loaded field; ?fields= (or the path form) narrows the field
-    columns. Our fielddata = always-resident device columns, so every
-    mapped field with data shows up (see DEVIATIONS.md)."""
+    per LOADED field; ?fields= (or the path form) narrows the field
+    columns. Columns load lazily into the evictable fielddata tier
+    (resources/residency.py), so like the reference only fields whose
+    device copies are currently resident show up — an evicted column
+    drops out until the next search rehydrates it."""
     per_field: Dict[str, int] = {}
     for svc in n.indices.values():
         for shard in svc.shards:
@@ -3344,7 +3361,10 @@ def _cluster_put_settings(n: Node, p, b):
     merge dotted-key maps; stored settings are returned by GET and surfaced
     to allocation/recovery code via Node.cluster_settings — settings no
     component reads are stored-but-inert, same as unknown settings in 2.0
-    (pre-5.x ES did not validate setting names)."""
+    (pre-5.x ES did not validate setting names). The breaker family
+    (indices.breaker.* / network.breaker.*) applies LIVE to the resource
+    service, like the reference's dynamic HierarchyCircuitBreakerService
+    settings; a null value resets to the default."""
     body = _json(b)
     for scope in ("persistent", "transient"):
         for k, v in (body.get(scope) or {}).items():
@@ -3352,6 +3372,11 @@ def _cluster_put_settings(n: Node, p, b):
                 n.cluster_settings[scope].pop(k, None)
             else:
                 n.cluster_settings[scope][k] = v
+    from elasticsearch_tpu import resources
+
+    merged = {**n.cluster_settings["persistent"],
+              **n.cluster_settings["transient"]}
+    resources.apply_cluster_settings(merged)
     return 200, {"acknowledged": True,
                  "persistent": n.cluster_settings["persistent"],
                  "transient": n.cluster_settings["transient"]}

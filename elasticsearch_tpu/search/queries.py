@@ -354,6 +354,12 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
                 jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
                 jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk,
                 topk_block=blk, prec=_prec)
+            # materialize INSIDE the insurance try: async dispatch can
+            # surface a device execution error only at this host pull
+            # (the executor's device_get-in-try discipline) — it must
+            # trigger the same scatter fallback as an eager failure
+            vals, ids, tot = (np.asarray(vals), np.asarray(ids),
+                              np.asarray(tot))
         except Exception:
             if batch_fn is bm25_hybrid_topk_batch:
                 raise
@@ -366,9 +372,11 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
                 jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),
                 jnp.asarray(ws[q0:q1]), live, P=P, D=ctx.D, k=kk,
                 topk_block=blk, prec=_prec)
-        out_v.append(np.asarray(vals))
-        out_i.append(np.asarray(ids))
-        out_t.append(np.asarray(tot))
+            vals, ids, tot = (np.asarray(vals), np.asarray(ids),
+                              np.asarray(tot))
+        out_v.append(vals)
+        out_i.append(ids)
+        out_t.append(tot)
     kernels.record("bm25_hybrid", Q)
     return (np.concatenate(out_v), np.concatenate(out_i),
             np.concatenate(out_t))
@@ -853,7 +861,7 @@ class RangeQuery(Query):
             return i if f == i else None
 
         lo_i, hi_i = _as_exact_int(lo), _as_exact_int(hi)
-        if col.hi is not None and (lo is None or lo_i is not None) and (hi is None or hi_i is not None):
+        if col.has_pair and (lo is None or lo_i is not None) and (hi is None or hi_i is not None):
             from elasticsearch_tpu.index.segment import split_i64
 
             lo_v = lo_i if lo_i is not None else -(2**63)

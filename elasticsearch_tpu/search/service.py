@@ -177,116 +177,123 @@ class ShardSearcher:
         fused_ok = (not aggs and not sort_spec and min_score is None
                     and search_after is None and not rescore_specs
                     and full_snap is None and not collect_full)
-        for seg in self.segments:
-            if timeout_s is not None and (time.perf_counter() - t_begin
-                                          > timeout_s):
-                timed_out = True
-                break
-            if terminate_after is not None and total >= terminate_after:
-                terminated_early = True
-                break
-            with _p("executor_build"):
-                ctx = SegmentContext(seg, self.mappings, self.analysis,
-                                     global_stats,
-                                     all_segments=self.segments,
-                                     index_name=self.index_name)
-            if prof is not None:
-                prof.segments += 1
-            if fused_ok and not seg.has_nested:
-                from elasticsearch_tpu.search.queries import fused_bm25_topk
+        # attach the profile timer for the duration of segment execution
+        # so fielddata rehydrations (resources/residency.py) file under
+        # the `rehydrate` phase of THIS request (explicitly scoped — see
+        # profiler.attached)
+        from elasticsearch_tpu.tracing import profiler as _profmod
 
+        with _profmod.attached(prof):
+            for seg in self.segments:
+                if timeout_s is not None and (time.perf_counter() - t_begin
+                                              > timeout_s):
+                    timed_out = True
+                    break
+                if terminate_after is not None and total >= terminate_after:
+                    terminated_early = True
+                    break
+                with _p("executor_build"):
+                    ctx = SegmentContext(seg, self.mappings, self.analysis,
+                                         global_stats,
+                                         all_segments=self.segments,
+                                         index_name=self.index_name)
                 if prof is not None:
-                    fused = prof.device_call(
-                        lambda: fused_bm25_topk(ctx, query,
-                                                min(k, seg.max_docs)),
-                        bucket="topk")
+                    prof.segments += 1
+                if fused_ok and not seg.has_nested:
+                    from elasticsearch_tpu.search.queries import fused_bm25_topk
+
+                    if prof is not None:
+                        fused = prof.device_call(
+                            lambda: fused_bm25_topk(ctx, query,
+                                                    min(k, seg.max_docs)),
+                            bucket="topk")
+                    else:
+                        fused = fused_bm25_topk(ctx, query, min(k, seg.max_docs))
+                    if fused is not None:
+                        vals, ids, seg_total = fused
+                        total += seg_total
+                        for v, i in zip(vals, ids):
+                            # matches score strictly > 0; the live mask maps
+                            # non-matches to -inf or a 0.0 dense row
+                            if np.isfinite(v) and v > 0:
+                                max_score = max(max_score, float(v))
+                                docs.append(ShardDoc(self.shard_ord, seg,
+                                                     int(i), float(v)))
+                        continue
+                if prof is not None:
+                    scores, mask = prof.device_call(
+                        lambda: query.score_or_mask(ctx))
                 else:
-                    fused = fused_bm25_topk(ctx, query, min(k, seg.max_docs))
-                if fused is not None:
-                    vals, ids, seg_total = fused
-                    total += seg_total
-                    for v, i in zip(vals, ids):
-                        # matches score strictly > 0; the live mask maps
-                        # non-matches to -inf or a 0.0 dense row
-                        if np.isfinite(v) and v > 0:
-                            max_score = max(max_score, float(v))
-                            docs.append(ShardDoc(self.shard_ord, seg,
-                                                 int(i), float(v)))
-                    continue
-            if prof is not None:
-                scores, mask = prof.device_call(
-                    lambda: query.score_or_mask(ctx))
-            else:
-                scores, mask = query.score_or_mask(ctx)
-            mask = mask & seg.live
-            if seg.has_nested:
-                # top-level hits are root docs only; nested children are
-                # reachable solely through nested queries/aggs (reference:
-                # Lucene block-join — nested docs hidden from root searches)
-                mask = mask & seg.roots_dev
-            if min_score is not None:
-                mask = mask & (scores >= float(min_score))
-            tot_dev = jnp.sum(mask.astype(jnp.int32))
-            if aggs:
-                with _p("aggs"):
-                    agg_partials.append(run_aggs(aggs, ctx, mask))
-            if sort_spec:
-                total += int(tot_dev)
-                seg_k = seg.max_docs if collect_full else k
-                with _p("topk"):
-                    seg_docs = self._sorted_candidates(ctx, scores, mask,
-                                                       sort_spec, seg_k,
-                                                       search_after)
-            elif full_snap is not None:
-                total += int(tot_dev)
-                sc = np.asarray(scores)
-                mk = np.asarray(mask)
-                if scan:
-                    # scan search_type: index order, no ranking (reference:
-                    # search/scan/ScanContext.java — docs stream in doc-id
-                    # order; the initial page returns no hits)
-                    order = np.nonzero(mk[: seg.num_docs])[0].astype(np.int32)
-                    full_snap.append((seg, order, sc))
-                    seg_docs = []
+                    scores, mask = query.score_or_mask(ctx)
+                mask = mask & seg.live
+                if seg.has_nested:
+                    # top-level hits are root docs only; nested children are
+                    # reachable solely through nested queries/aggs (reference:
+                    # Lucene block-join — nested docs hidden from root searches)
+                    mask = mask & seg.roots_dev
+                if min_score is not None:
+                    mask = mask & (scores >= float(min_score))
+                tot_dev = jnp.sum(mask.astype(jnp.int32))
+                if aggs:
+                    with _p("aggs"):
+                        agg_partials.append(run_aggs(aggs, ctx, mask))
+                if sort_spec:
+                    total += int(tot_dev)
+                    seg_k = seg.max_docs if collect_full else k
+                    with _p("topk"):
+                        seg_docs = self._sorted_candidates(ctx, scores, mask,
+                                                           sort_spec, seg_k,
+                                                           search_after)
+                elif full_snap is not None:
+                    total += int(tot_dev)
+                    sc = np.asarray(scores)
+                    mk = np.asarray(mask)
+                    if scan:
+                        # scan search_type: index order, no ranking (reference:
+                        # search/scan/ScanContext.java — docs stream in doc-id
+                        # order; the initial page returns no hits)
+                        order = np.nonzero(mk[: seg.num_docs])[0].astype(np.int32)
+                        full_snap.append((seg, order, sc))
+                        seg_docs = []
+                    else:
+                        n_match = int(mk[: seg.num_docs].sum())
+                        eff = np.where(mk, sc, -np.inf)
+                        order = np.argsort(-eff, kind="stable")[:n_match].astype(np.int32)
+                        full_snap.append((seg, order, sc))
+                        seg_docs = [
+                            ShardDoc(self.shard_ord, seg, int(i), float(sc[i]))
+                            for i in order[: min(k, order.size)]
+                        ]
                 else:
-                    n_match = int(mk[: seg.num_docs].sum())
-                    eff = np.where(mk, sc, -np.inf)
-                    order = np.argsort(-eff, kind="stable")[:n_match].astype(np.int32)
-                    full_snap.append((seg, order, sc))
+                    from elasticsearch_tpu.ops.scoring import (
+                        pack_topk_result, unpack_topk_result)
+
+                    kk = min(k, seg.max_docs)
+                    if prof is not None:
+                        vals, idx = prof.device_call(
+                            lambda: topk_with_mask(scores, mask, k=kk),
+                            bucket="topk")
+                        packed_dev = prof.device_call(
+                            lambda: pack_topk_result(vals, idx, tot_dev))
+                        with prof.phase("host_sync"):
+                            packed = np.asarray(packed_dev)
+                    else:
+                        vals, idx = topk_with_mask(scores, mask, k=kk)
+                        # ONE host transfer: per-array pulls each pay a fixed
+                        # device round-trip (network-attached chips: ~5-20 ms)
+                        packed = np.asarray(pack_topk_result(vals, idx,
+                                                             tot_dev))
+                    vals, idx, tot = unpack_topk_result(packed, kk)
+                    total += tot
                     seg_docs = [
-                        ShardDoc(self.shard_ord, seg, int(i), float(sc[i]))
-                        for i in order[: min(k, order.size)]
+                        ShardDoc(self.shard_ord, seg, int(i), float(v))
+                        for v, i in zip(vals, idx)
+                        if np.isfinite(v)
                     ]
-            else:
-                from elasticsearch_tpu.ops.scoring import (
-                    pack_topk_result, unpack_topk_result)
-
-                kk = min(k, seg.max_docs)
-                if prof is not None:
-                    vals, idx = prof.device_call(
-                        lambda: topk_with_mask(scores, mask, k=kk),
-                        bucket="topk")
-                    packed_dev = prof.device_call(
-                        lambda: pack_topk_result(vals, idx, tot_dev))
-                    with prof.phase("host_sync"):
-                        packed = np.asarray(packed_dev)
-                else:
-                    vals, idx = topk_with_mask(scores, mask, k=kk)
-                    # ONE host transfer: per-array pulls each pay a fixed
-                    # device round-trip (network-attached chips: ~5-20 ms)
-                    packed = np.asarray(pack_topk_result(vals, idx,
-                                                         tot_dev))
-                vals, idx, tot = unpack_topk_result(packed, kk)
-                total += tot
-                seg_docs = [
-                    ShardDoc(self.shard_ord, seg, int(i), float(v))
-                    for v, i in zip(vals, idx)
-                    if np.isfinite(v)
-                ]
-            for d in seg_docs:
-                if np.isfinite(d.score):
-                    max_score = max(max_score, d.score)
-            docs.extend(seg_docs)
+                for d in seg_docs:
+                    if np.isfinite(d.score):
+                        max_score = max(max_score, d.score)
+                docs.extend(seg_docs)
 
         # merge segment candidates
         if sort_spec:
@@ -593,9 +600,27 @@ def search_shards(
     profile = bool(body.get("profile"))
     shard_profiles: List[dict] = []
     results = []
+    # per-shard breaker trips degrade to partial results with an
+    # ES-shaped `_shards.failures[]` entry, the same accounting the
+    # distributed coordinator gives a dead peer (reference:
+    # ShardSearchFailure). ONLY CircuitBreakingException degrades here —
+    # parse errors etc. must keep failing the whole request with their
+    # own status, and unexpected bugs must surface as 500s, not as
+    # silently thinner results.
+    from elasticsearch_tpu.utils.errors import CircuitBreakingException
+
+    shard_failures: List[dict] = []
     for pos, s in enumerate(searchers):
         tq = time.perf_counter()
-        r = s.query_phase(body, global_stats, collect_full=scroll)
+        try:
+            r = s.query_phase(body, global_stats, collect_full=scroll)
+        except CircuitBreakingException as e:
+            shard_failures.append({
+                "shard": pos, "index": s.index_name or index_name,
+                "node": None, "status": e.status,
+                "reason": {"type": e.error_type, "reason": str(e)}})
+            r = QueryPhaseResult(docs=[], total_hits=0,
+                                 max_score=float("nan"))
         # fetch resolves searchers positionally in THIS list — stamp each
         # candidate with its searcher's list position rather than trusting
         # the searcher's own shard_ord (shared, and multi-index searches
@@ -612,6 +637,13 @@ def search_shards(
             shard_profiles.append(shard_profile_entry(
                 f"[{s.index_name or index_name or 'shard'}][{pos}]",
                 int(q_ms * 1e6), r.profile))
+    if shard_failures and len(shard_failures) == len(searchers):
+        # graceful degradation has a floor: NOTHING answered (reference:
+        # SearchPhaseExecutionException "all shards failed") — re-raise
+        # the breaker error so the client sees the 429
+        raise CircuitBreakingException(
+            "all shards failed: "
+            + "; ".join(f["reason"]["reason"] for f in shard_failures))
     # indices_boost: per-index score multipliers applied BEFORE the global
     # merge (reference: SearchRequest.indicesBoost / query-phase boost)
     ib = body.get("indices_boost")
@@ -714,13 +746,17 @@ def search_shards(
     response: Dict[str, Any] = {
         "took": int((time.perf_counter() - t0) * 1000),
         "timed_out": any(r.timed_out for r in results),
-        "_shards": {"total": len(searchers), "successful": len(searchers), "failed": 0},
+        "_shards": {"total": len(searchers),
+                    "successful": len(searchers) - len(shard_failures),
+                    "failed": len(shard_failures)},
         "hits": {
             "total": total,
             "max_score": None if (max_score == float("-inf") or sort_spec) else max_score,
             "hits": hits,
         },
     }
+    if shard_failures:
+        response["_shards"]["failures"] = shard_failures
     if any(r.terminated_early for r in results):
         response["terminated_early"] = True
     aggs_present = [r.agg_partials for r in results if r.agg_partials]

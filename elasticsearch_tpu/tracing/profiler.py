@@ -15,6 +15,9 @@ phases that actually decide latency on this engine:
   topk            top-k selection + result packing (device)
   host_sync       device→host pulls of packed results
   aggs            aggregation partials (device + host reduce)
+  rehydrate       fielddata-tier device copies re-placed after eviction
+                  (resources/residency.py — the `tpu.rehydrate` tracer
+                  span's time, attributed via the attached() contextvar)
 
 ``retraces`` counts the jit traces the request triggered
 (tools.tpulint.trace_audit via tracing/retrace.py); -1 = auditor
@@ -28,14 +31,48 @@ Clock discipline (tpulint R007): all durations from
 """
 from __future__ import annotations
 
+import contextvars
 import time
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from typing import Any, Callable, Dict, Iterator, Optional
 
 from elasticsearch_tpu.tracing import retrace
 
 PHASES = ("rewrite", "executor_build", "device_compile", "device_execute",
-          "topk", "host_sync", "aggs")
+          "topk", "host_sync", "aggs", "rehydrate")
+
+# the PhaseTimer of the profiled query phase running on THIS logical
+# flow — lets out-of-band instrumentation (residency rehydration) file
+# time without threading the timer through every layer. Explicitly
+# scoped by attached(): a stale pointer must never absorb a later
+# request's rehydrates into an already-serialized profile.
+_ACTIVE_TIMER: contextvars.ContextVar[Optional["PhaseTimer"]] = \
+    contextvars.ContextVar("estpu-active-phase-timer", default=None)
+
+
+def attached(timer: Optional["PhaseTimer"]):
+    """Context manager scoping ``timer`` as the flow's rehydrate sink
+    (no-op for None — unprofiled requests pay nothing)."""
+    if timer is None:
+        return nullcontext()
+
+    @contextmanager
+    def _cm():
+        tok = _ACTIVE_TIMER.set(timer)
+        try:
+            yield
+        finally:
+            _ACTIVE_TIMER.reset(tok)
+
+    return _cm()
+
+
+def record_rehydrate(ns: int) -> None:
+    """File ``ns`` under the attached timer's `rehydrate` phase (called
+    by resources/residency.py; dropped when no profile is active)."""
+    t = _ACTIVE_TIMER.get()
+    if t is not None:
+        t.nanos["rehydrate"] = t.nanos.get("rehydrate", 0) + int(ns)
 
 
 def _block(out: Any) -> None:

@@ -153,6 +153,12 @@ class EngineFailedException(ElasticsearchTpuException):
 class CircuitBreakingException(ElasticsearchTpuException):
     """Reference: org/elasticsearch/common/breaker/CircuitBreaker.java —
     a memory budget would be exceeded; the REQUEST fails (429-style), the
-    node survives."""
+    node survives. ``bytes_wanted``/``bytes_limit`` mirror the reference
+    exception's fields (resources/breakers.py fills them)."""
 
     status = 429
+
+    def __init__(self, *args, bytes_wanted: int = 0, bytes_limit: int = 0):
+        super().__init__(*args)
+        self.bytes_wanted = bytes_wanted
+        self.bytes_limit = bytes_limit

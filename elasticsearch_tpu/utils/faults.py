@@ -22,6 +22,8 @@ Registered injection points (see docs/ROBUSTNESS.md for the catalogue):
     translog.fsync        in place of the durability fsync
     segment.freeze        before a refresh freezes the RAM buffer
     recovery.shard_sync   before a recovery source streams its shard
+    resources.reserve     before a residency breaker reservation (device
+                          memory admission — resources/residency.py)
 """
 from __future__ import annotations
 
@@ -38,6 +40,7 @@ POINTS = frozenset({
     "translog.fsync",
     "segment.freeze",
     "recovery.shard_sync",
+    "resources.reserve",
 })
 
 
@@ -163,13 +166,16 @@ def _parse_env_spec(spec: str, registry: "FaultRegistry") -> None:
         e.g. "translog.fsync:count=1;transport.send:prob=0.5:seed=7"
 
     Recognised keys: count, after, prob, seed, error (oserror | timeout |
-    connrefused). Used by subprocess cluster members where the test can't
-    reach the registry object directly.
+    connrefused | breaker). Used by subprocess cluster members where the
+    test can't reach the registry object directly.
     """
     import socket
 
+    from elasticsearch_tpu.utils.errors import CircuitBreakingException
+
     errors = {"oserror": OSError, "timeout": socket.timeout,
-              "connrefused": ConnectionRefusedError}
+              "connrefused": ConnectionRefusedError,
+              "breaker": CircuitBreakingException}
     for part in spec.split(";"):
         part = part.strip()
         if not part:

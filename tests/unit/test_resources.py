@@ -1,0 +1,465 @@
+"""Device-memory resource management (resources/): hierarchical circuit
+breakers + tiered HBM residency with eviction and rehydration.
+
+Covers the ISSUE-5 acceptance surface: ES-shaped breaker settings/stats,
+LRU evict → transparent rehydrate (bit-identical results, counters
+advance, `tpu.rehydrate` visible under ?profile=true), breaker-tripped
+lazy column loads degrading to partial `_shards.failures` (both via the
+`resources.reserve` chaos point and via a real
+`indices.breaker.fielddata.limit`), and the REST/settings wiring.
+"""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu import resources
+from elasticsearch_tpu.resources.breakers import (CircuitBreaker,
+                                                  CircuitBreakerService,
+                                                  parse_limit)
+from elasticsearch_tpu.resources.residency import ResidencyRegistry
+from elasticsearch_tpu.utils.errors import CircuitBreakingException
+from elasticsearch_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture
+def iso(monkeypatch):
+    """Isolated breaker service + residency registry swapped in for the
+    process singletons (every call site reads the module ATTRIBUTES)."""
+    svc = CircuitBreakerService(capacity=1 << 30)
+    reg = ResidencyRegistry(svc)
+    monkeypatch.setattr(resources, "BREAKERS", svc)
+    monkeypatch.setattr(resources, "RESIDENCY", reg)
+    yield svc, reg
+    FAULTS.clear()
+
+
+# -- breakers ----------------------------------------------------------------
+
+def test_parse_limit_grammar():
+    assert parse_limit("512mb") == 512 << 20
+    assert parse_limit("2gb") == 2 << 30
+    assert parse_limit("50%", capacity=1000) == 500
+    assert parse_limit(-1) == -1
+    assert parse_limit("-1") == -1
+    assert parse_limit(12345) == 12345
+    with pytest.raises(ValueError):
+        parse_limit("150%")
+
+
+def test_breaker_reserve_trip_and_overhead():
+    br = CircuitBreaker("t", limit=1000, overhead=2.0)
+    assert br.reserve(400)  # 400 * 2.0 = 800 <= 1000
+    assert not br.reserve(200)  # (400+200)*2 = 1200 > 1000
+    assert br.trip_count == 1
+    br.release(400)
+    assert br.used == 0
+    with pytest.raises(CircuitBreakingException) as ei:
+        br.break_or_reserve(600, label="col.x")
+    assert "Data too large" in str(ei.value)
+    assert "[t]" in str(ei.value)
+    assert ei.value.bytes_limit == 1000
+
+
+def test_parent_caps_the_sum_of_children(iso):
+    svc, _ = iso
+    svc.apply_cluster_settings({
+        "indices.breaker.total.limit": 1000,
+        "indices.breaker.fielddata.limit": 900,
+        "indices.breaker.request.limit": 900,
+        "indices.breaker.fielddata.overhead": 1.0,
+    })
+    assert svc.breaker("fielddata").reserve(800)
+    # request alone fits its own limit but blows the parent
+    assert not svc.breaker("request").reserve(300)
+    assert svc.parent_tripped == 1
+    assert svc.stats()["parent"]["estimated_size_in_bytes"] == 800
+
+
+def test_settings_apply_and_reset(iso):
+    svc, _ = iso
+    svc.apply_cluster_settings({"indices.breaker.fielddata.limit": "1kb"})
+    assert svc.breaker("fielddata").limit == 1024
+    # absent key = reset to default (60% of capacity)
+    svc.apply_cluster_settings({})
+    assert svc.breaker("fielddata").limit == int(0.6 * (1 << 30))
+
+
+def test_breaker_stats_es_shape(iso):
+    svc, _ = iso
+    st = svc.stats()
+    assert set(st) == {"parent", "fielddata", "request",
+                       "in_flight_requests", "segments"}
+    for sec in st.values():
+        assert {"limit_size_in_bytes", "limit_size",
+                "estimated_size_in_bytes", "estimated_size", "overhead",
+                "tripped"} <= set(sec)
+
+
+# -- residency ---------------------------------------------------------------
+
+def test_put_array_evict_rehydrate_roundtrip(iso):
+    _, reg = iso
+    host = np.arange(64, dtype=np.float32)
+    h = reg.put_array(host, label="t.values", tier="fielddata")
+    assert h.resident
+    dev1 = np.asarray(h.get())
+    assert h.evict()
+    assert not h.resident
+    assert not h.evict()  # idempotent
+    dev2 = np.asarray(h.get())  # transparent rehydration
+    assert h.resident
+    np.testing.assert_array_equal(dev1, dev2)
+    st = reg.stats()["tiers"]["fielddata"]
+    assert st["evictions"] == 1 and st["rehydrations"] == 1
+    assert st["resident_bytes"] == h.nbytes
+
+
+def test_pressure_evicts_lru_before_tripping(iso):
+    svc, reg = iso
+    nbytes = 64 * 4
+    svc.apply_cluster_settings({
+        "indices.breaker.fielddata.limit": int(nbytes * 2.5),
+        "indices.breaker.fielddata.overhead": 1.0,
+    })
+    a = reg.put_array(np.zeros(64, np.float32), label="a", tier="fielddata")
+    b = reg.put_array(np.zeros(64, np.float32), label="b", tier="fielddata")
+    b.get()
+    a.get()  # a is now most-recently used; b is the LRU victim
+    c = reg.put_array(np.zeros(64, np.float32), label="c", tier="fielddata")
+    assert c is not None and c.resident
+    assert not b.resident  # evicted under pressure
+    assert a.resident
+    assert reg.stats()["tiers"]["fielddata"]["evictions"] == 1
+
+
+def test_trip_when_nothing_evictable_covers_it(iso):
+    svc, reg = iso
+    svc.apply_cluster_settings({"indices.breaker.fielddata.limit": 16})
+    with pytest.raises(CircuitBreakingException):
+        reg.put_array(np.zeros(64, np.float32), label="big",
+                      tier="fielddata")
+    assert svc.breaker("fielddata").trip_count == 1
+    # best_effort callers (dense impact blocks) get None, not an error
+    assert reg.put_array(np.zeros(64, np.float32), label="big",
+                         tier="fielddata", best_effort=True) is None
+
+
+def test_failed_placement_releases_reservation(iso):
+    """A device allocation that fails AFTER the breaker reservation must
+    release the charge (review guard: transient device errors must not
+    ratchet `used` into permanent spurious trips)."""
+    import elasticsearch_tpu.resources.residency as res_mod
+
+    svc, reg = iso
+    host = np.zeros(64, np.float32)
+    boom = {"n": 0}
+
+    def exploding_place(self):
+        boom["n"] += 1
+        raise RuntimeError("transfer failed")
+
+    orig = res_mod.ResidentArray._place
+    res_mod.ResidentArray._place = exploding_place
+    try:
+        with pytest.raises(RuntimeError):
+            reg.put_array(host, label="x", tier="fielddata")
+        assert svc.breaker("fielddata").used == 0
+        # rehydrate path leaks neither
+        res_mod.ResidentArray._place = orig
+        h = reg.put_array(host, label="x", tier="fielddata")
+        h.evict()
+        res_mod.ResidentArray._place = exploding_place
+        with pytest.raises(RuntimeError):
+            h.get()
+        assert svc.breaker("fielddata").used == 0
+    finally:
+        res_mod.ResidentArray._place = orig
+    assert np.asarray(h.get()).shape == (64,)  # recovers once placement works
+
+
+def test_dense_rehydrate_denial_falls_back_to_scatter(iso, monkeypatch):
+    """An evicted dense impact block whose rehydration the breaker denies
+    must serve via the scatter path (full results), not fail the shard —
+    the same best-effort contract as the build."""
+    import functools
+
+    from elasticsearch_tpu.index import segment as segmod
+
+    svc_b, reg = iso
+    monkeypatch.setattr(
+        segmod, "build_dense_impact",
+        functools.partial(segmod.build_dense_impact, df_threshold=2))
+    node = _build_node(shards=1)
+    svc = node.indices["res"]
+    for i in range(48):
+        svc.index_doc(str(i), {"body": " ".join(
+            f"w{(i * 7 + j * 3) % 11}" for j in range(10))})
+    svc.refresh()
+    body = {"query": {"match": {"body": "w1 w4"}}, "size": 10}
+    r1 = node.search("res", body)
+    seg = svc.shards[0].segments[0]
+    if seg.inverted["body"].dense_block() is None:
+        pytest.skip("corpus built no dense block at this threshold")
+    reg.evict_all()
+    svc_b.apply_cluster_settings({"indices.breaker.fielddata.limit": 1})
+    r2 = node.search("res", body)  # scatter fallback, not a 429
+    assert r2["_shards"]["failed"] == 0
+    assert ([h["_id"] for h in r1["hits"]["hits"]]
+            == [h["_id"] for h in r2["hits"]["hits"]])
+    node.close()
+
+
+def test_track_token_charges_and_releases(iso):
+    svc, reg = iso
+    tok = reg.track(1 << 20, label="executor.data")
+    assert svc.breaker("request").used == 1 << 20
+    assert reg.stats()["pinned"]["bytes"] == 1 << 20
+    tok.close()
+    tok.close()  # idempotent
+    assert svc.breaker("request").used == 0
+    assert reg.stats()["pinned"]["bytes"] == 0
+
+
+def test_handle_gc_releases_breaker_charge(iso):
+    svc, reg = iso
+    h = reg.put_array(np.zeros(64, np.float32), label="gc", tier="fielddata")
+    used = svc.breaker("fielddata").used
+    assert used == h.nbytes
+    del h
+    import gc
+
+    gc.collect()
+    assert svc.breaker("fielddata").used == 0
+    assert reg.stats()["tiers"]["fielddata"]["handles"] == 0
+
+
+# -- end-to-end: lazy columns, chaos, partial results ------------------------
+
+def _build_node(mesh=False, shards=1):
+    from elasticsearch_tpu.node import Node
+
+    node = Node()
+    node.create_index("res", {
+        "settings": {"index": {"number_of_shards": shards,
+                               "search": {"mesh": mesh}}},
+        "mappings": {"properties": {"body": {"type": "text"},
+                                    "n": {"type": "long"}}}})
+    return node
+
+
+def test_breaker_trip_chaos_partial_shard_results(iso):
+    """Armed `resources.reserve` point: the first shard's lazy column
+    load trips, the search still answers 200-shaped with an ES
+    `circuit_breaking_exception` failure entry (partial results)."""
+    node = _build_node(shards=2)
+    svc = node.indices["res"]
+    for i in range(16):
+        svc.index_doc(str(i), {"body": f"w{i}", "n": i})
+    svc.refresh()
+    FAULTS.inject("resources.reserve", CircuitBreakingException, count=1)
+    r = node.search("res", {"query": {"match_all": {}},
+                            "sort": [{"n": "desc"}], "size": 20})
+    assert FAULTS.fired("resources.reserve") == 1
+    assert r["_shards"]["failed"] == 1
+    assert r["_shards"]["successful"] == 1
+    f = r["_shards"]["failures"][0]
+    assert f["reason"]["type"] == "circuit_breaking_exception"
+    assert f["status"] == 429
+    assert r["hits"]["hits"]  # the healthy shard still served its page
+    node.close()
+
+
+def test_fielddata_limit_partial_then_recovers(iso):
+    """indices.breaker.fielddata.limit below the column bytes: the shard
+    owning the column degrades to a failure entry (HTTP-200 partial —
+    the other shard has no `n` column and reserves nothing); /_nodes
+    reports the trip; raising the limit heals the search."""
+    from elasticsearch_tpu.cluster.routing import shard_id_for
+
+    svc_b, _reg = iso
+    node = _build_node(shards=2)
+    svc = node.indices["res"]
+    # routing values landing on distinct shards
+    r0 = next(r for r in ("a", "b", "c", "d")
+              if shard_id_for("x", 2, r) == 0)
+    r1 = next(r for r in ("a", "b", "c", "d")
+              if shard_id_for("x", 2, r) == 1)
+    for i in range(8):  # shard 0: docs WITH the numeric column
+        svc.index_doc(f"n{i}", {"body": "w", "n": i}, routing=r0)
+    for i in range(8):  # shard 1: text only — no column, no reservation
+        svc.index_doc(f"t{i}", {"body": "w"}, routing=r1)
+    svc.refresh()
+    svc_b.apply_cluster_settings({"indices.breaker.fielddata.limit": 1})
+    r = node.search("res", {"query": {"match_all": {}},
+                            "sort": [{"n": "desc"}], "size": 20})
+    assert r["_shards"]["failed"] == 1
+    assert (r["_shards"]["failures"][0]["reason"]["type"]
+            == "circuit_breaking_exception")
+    assert len(r["hits"]["hits"]) == 8  # shard 1's docs still serve
+    bst = node.nodes_stats()["nodes"][node.node_id]["breakers"]["fielddata"]
+    assert bst["tripped"] >= 1
+    # limit restored: the same search loads the column and heals
+    svc_b.apply_cluster_settings({})
+    r2 = node.search("res", {"query": {"match_all": {}},
+                             "sort": [{"n": "desc"}], "size": 20})
+    assert r2["_shards"]["failed"] == 0
+    assert len(r2["hits"]["hits"]) == 16
+    bst = node.nodes_stats()["nodes"][node.node_id]["breakers"]["fielddata"]
+    assert bst["estimated_size_in_bytes"] > 0
+    node.close()
+
+
+def test_all_shards_tripped_raises_429(iso):
+    svc_b, _ = iso
+    node = _build_node(shards=1)
+    svc = node.indices["res"]
+    for i in range(8):
+        svc.index_doc(str(i), {"body": "w", "n": i})
+    svc.refresh()
+    svc_b.apply_cluster_settings({"indices.breaker.fielddata.limit": 1})
+    with pytest.raises(CircuitBreakingException):
+        node.search("res", {"query": {"match_all": {}},
+                            "sort": [{"n": "asc"}]})
+    node.close()
+
+
+def test_evict_rehydrate_search_parity_and_profile(iso):
+    """Forced eviction: the same query rehydrates bit-identically, the
+    eviction/rehydration counters advance, and ?profile=true shows the
+    rehydrate phase + the tracer records tpu.rehydrate spans."""
+    _, reg = iso
+    node = _build_node(shards=1)
+    svc = node.indices["res"]
+    for i in range(16):
+        svc.index_doc(str(i), {"body": f"w{i}", "n": i * 3})
+    svc.refresh()
+    body = {"query": {"match_all": {}}, "sort": [{"n": "desc"}], "size": 16}
+    r1 = node.search("res", body)
+    assert reg.stats()["tiers"]["fielddata"]["loads"] > 0
+    assert reg.evict_all() > 0
+    r2 = node.search("res", dict(body, profile=True))
+    hits1 = [(h["_id"], h["sort"]) for h in r1["hits"]["hits"]]
+    hits2 = [(h["_id"], h["sort"]) for h in r2["hits"]["hits"]]
+    assert hits1 == hits2  # bit-identical before/after eviction
+    st = reg.stats()["tiers"]["fielddata"]
+    assert st["evictions"] > 0 and st["rehydrations"] > 0
+    phases = r2["profile"]["shards"][0]["tpu"]["phases"]
+    assert phases["rehydrate_nanos"] > 0
+    assert "tpu.rehydrate" in [s.name for s in node.tracer.spans()]
+    # the once-zero-by-design eviction counters are real now
+    fd = svc.shards[0].stats()["fielddata"]
+    assert fd["evictions"] > 0 and fd["rehydrations"] > 0
+    nst = node.nodes_stats()["nodes"][node.node_id]
+    assert nst["indices"]["fielddata"]["evictions"] > 0
+    assert nst["resources"]["tiers"]["fielddata"]["rehydrations"] > 0
+    node.close()
+
+
+def test_rest_breaker_settings_and_cat_fielddata(iso):
+    """REST wiring: PUT /_cluster/settings applies indices.breaker.*
+    live; /_nodes/stats shows the ES breaker envelope; /_cat/fielddata
+    lists only currently-resident fields."""
+    import json
+    import urllib.request
+
+    from elasticsearch_tpu.rest.server import RestServer
+
+    svc_b, reg = iso
+    node = _build_node(shards=1)
+    svc = node.indices["res"]
+    for i in range(8):
+        svc.index_doc(str(i), {"body": "w", "n": i})
+    svc.refresh()
+    srv = RestServer(node, host="127.0.0.1", port=0)
+    srv.start(background=True)
+
+    def req(method, path, body=None):
+        data = json.dumps(body).encode() if body is not None else None
+        rq = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(rq) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    try:
+        st, _ = req("PUT", "/_cluster/settings", {"transient": {
+            "indices.breaker.fielddata.limit": "1kb"}})
+        assert st == 200
+        assert svc_b.breaker("fielddata").limit == 1024
+        # delete (null) resets to the default
+        st, _ = req("PUT", "/_cluster/settings", {"transient": {
+            "indices.breaker.fielddata.limit": None}})
+        assert st == 200
+        assert svc_b.breaker("fielddata").limit == int(0.6 * (1 << 30))
+        # a search loads the column; _cat/fielddata shows it resident
+        st, _ = req("POST", "/res/_search",
+                    {"query": {"match_all": {}}, "sort": [{"n": "asc"}]})
+        assert st == 200
+        st, rows = req("GET", "/_cat/fielddata?format=json")
+        assert st == 200 and rows and "n" in rows[0]
+        st, stats = req("GET", "/_nodes/stats/breaker")
+        assert st == 200
+        brk = list(stats["nodes"].values())[0]["breakers"]
+        assert brk["fielddata"]["estimated_size_in_bytes"] > 0
+        # evicted columns drop out of _cat/fielddata until re-touched
+        reg.evict_all()
+        st, rows = req("GET", "/_cat/fielddata?format=json")
+        assert st == 200 and (not rows or "n" not in rows[0])
+    finally:
+        srv.stop()
+        node.close()
+
+
+def test_inflight_requests_breaker_trips_oversized_body(iso):
+    svc_b, _ = iso
+    node = _build_node(shards=1)
+    from elasticsearch_tpu.rest.server import RestController
+
+    rc = RestController(node)
+    svc_b.apply_cluster_settings(
+        {"network.breaker.inflight_requests.limit": 64})
+    big = b'{"query": {"match_all": {}}, "pad": "' + b"x" * 256 + b'"}'
+    status, body = rc.dispatch("POST", "/res/_search", {}, big)
+    assert status == 429
+    assert body["error"]["type"] == "circuit_breaking_exception"
+    # charge is released even on the trip path: small requests still flow
+    svc_b.apply_cluster_settings({})
+    status, _ = rc.dispatch("GET", "/_cluster/health", {}, b"")
+    assert status == 200
+    assert svc_b.breaker("in_flight_requests").used == 0
+    node.close()
+
+
+def test_dense_impact_block_is_evictable(iso, monkeypatch):
+    """The dense impact block rides the same residency tier: evict →
+    the next hybrid search rehydrates it (scores unchanged)."""
+    import functools
+
+    from elasticsearch_tpu.index import segment as segmod
+
+    _, reg = iso
+    monkeypatch.setattr(
+        segmod, "build_dense_impact",
+        functools.partial(segmod.build_dense_impact, df_threshold=2))
+    node = _build_node(shards=1)
+    svc = node.indices["res"]
+    docs = [" ".join(f"w{(i * 7 + j * 3) % 11}" for j in range(10))
+            for i in range(48)]
+    for i, t in enumerate(docs):
+        svc.index_doc(str(i), {"body": t})
+    svc.refresh()
+    body = {"query": {"match": {"body": "w1 w4"}}, "size": 10}
+    r1 = node.search("res", body)
+    seg = svc.shards[0].segments[0]
+    blk = seg.inverted["body"].dense_block()
+    if blk is None:
+        pytest.skip("corpus built no dense block at this threshold")
+    reg.evict_all()
+    r2 = node.search("res", body)
+    assert ([(h["_id"], h["_score"]) for h in r1["hits"]["hits"]]
+            == [(h["_id"], h["_score"]) for h in r2["hits"]["hits"]])
+    ev, rh = seg.fielddata_evictions()
+    assert ev > 0 and rh > 0
+    node.close()

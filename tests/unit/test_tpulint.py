@@ -17,9 +17,10 @@ from tools.tpulint.baseline import filter_baselined, load_baseline
 
 def lint(src: str, *, hot: bool = False, locked: bool = False,
          ops: bool = False, swallow: bool = False, timing: bool = False,
-         path: str = "elasticsearch_tpu/x/mod.py"):
+         budget: bool = False, path: str = "elasticsearch_tpu/x/mod.py"):
     return lint_source(textwrap.dedent(src), path, hot=hot, ops=ops,
-                       locked=locked, swallow=swallow, timing=timing)
+                       locked=locked, swallow=swallow, timing=timing,
+                       budget=budget)
 
 
 def rules_of(violations):
@@ -544,6 +545,60 @@ class TestR007:
                 # comparing wall clocks across hosts IS the point here
                 return time.time() - 0.0  # tpulint: allow[R007]
         """, timing=True)
+        assert vs == []
+
+
+class TestR008:
+    """Unaccounted device placement (HBM bypassing resources/)."""
+
+    def test_bad_raw_device_put(self):
+        vs = lint("""
+            import jax
+            def place(arr):
+                return jax.device_put(arr)
+        """, budget=True)
+        assert rules_of(vs) == ["R008"]
+        assert "residency" in vs[0].message
+
+    def test_bad_from_import_alias(self):
+        vs = lint("""
+            from jax import device_put as dp
+            def place(arr):
+                return dp(arr)
+        """, budget=True)
+        assert rules_of(vs) == ["R008"]
+
+    def test_good_offbudget_annotation(self):
+        vs = lint("""
+            import jax
+            def place(q):
+                # transient per-query upload
+                return jax.device_put(q)  # tpulint: offbudget
+        """, budget=True)
+        assert vs == []
+
+    def test_scoped_by_path_not_flag(self):
+        # the product package is in scope, resources/ (the choke point
+        # implementation) and code outside the package are not
+        import textwrap as _tw
+
+        src = _tw.dedent("""
+            import jax
+            def place(arr):
+                return jax.device_put(arr)
+        """)
+        assert any(v.rule == "R008" for v in lint_source(
+            src, "elasticsearch_tpu/index/segment.py"))
+        assert not lint_source(src,
+                               "elasticsearch_tpu/resources/residency.py")
+        assert not lint_source(src, "bench.py")
+
+    def test_routed_through_registry_is_clean(self):
+        vs = lint("""
+            from elasticsearch_tpu import resources
+            def place(arr):
+                return resources.RESIDENCY.device_put(arr, label="x")
+        """, budget=True)
         assert vs == []
 
 

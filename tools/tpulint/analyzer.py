@@ -26,6 +26,8 @@ RULES: Dict[str, str] = {
             "failure-domain module",
     "R007": "wall-clock time.time() feeding a duration computation in a "
             "timing module (use time.monotonic()/perf_counter)",
+    "R008": "raw jax.device_put bypassing the residency registry "
+            "(unaccounted HBM — route through elasticsearch_tpu.resources)",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.
@@ -51,9 +53,16 @@ LOCKED_MODULE_MARKERS = (
 # corrupts under NTP step adjustments; epoch TIMESTAMPS (no subtraction)
 # stay legal.
 TIMING_PATH_MARKERS = ("/tracing/", "/monitor/")
+# R008 scope: the product package — device placements must route through
+# the residency registry's choke points (resources/residency.py) so HBM
+# is accounted; resources/ itself implements them, and bench/tools are
+# measurement code outside the serving budget.
+BUDGET_PATH_MARKERS = ("/elasticsearch_tpu/",)
+BUDGET_EXEMPT_MARKERS = ("/elasticsearch_tpu/resources/",)
 
 _ALLOW_RE = re.compile(r"#\s*tpulint:\s*allow\[\s*([A-Z0-9,\s]+?)\s*\]")
 _HOST_RE = re.compile(r"#\s*tpulint:\s*host\b")
+_OFFBUDGET_RE = re.compile(r"#\s*tpulint:\s*offbudget\b")
 
 
 @dataclass(frozen=True)
@@ -79,7 +88,9 @@ class Suppressions:
     standalone comment block covers the rest of the block and the first
     code line after it (so the justification can sit above the code).
     ``host`` declares a statement as intentional host-side build code and
-    is equivalent to ``allow[R003]``.
+    is equivalent to ``allow[R003]``; ``offbudget`` declares a raw device
+    placement as intentionally unaccounted (transient per-call upload)
+    and is equivalent to ``allow[R008]``.
     """
 
     def __init__(self, source: str):
@@ -93,6 +104,8 @@ class Suppressions:
             is_host = bool(_HOST_RE.search(text))
             if is_host:
                 rules.add("R003")
+            if _OFFBUDGET_RE.search(text):
+                rules.add("R008")
             if not rules:
                 continue
             covered = [i]
@@ -129,10 +142,11 @@ def lint_source(
     locked: Optional[bool] = None,
     swallow: Optional[bool] = None,
     timing: Optional[bool] = None,
+    budget: Optional[bool] = None,
 ) -> List[Violation]:
     """Lint one source string. ``hot``/``ops``/``locked``/``swallow``/
-    ``timing`` override the path-based scoping (fixture tests use these;
-    production runs infer from the path)."""
+    ``timing``/``budget`` override the path-based scoping (fixture tests
+    use these; production runs infer from the path)."""
     from tools.tpulint import rules as _rules
 
     tree = ast.parse(source, filename=path)
@@ -148,6 +162,9 @@ def lint_source(
                  if swallow is None else swallow),
         timing=(_matches(path, TIMING_PATH_MARKERS)
                 if timing is None else timing),
+        budget=((_matches(path, BUDGET_PATH_MARKERS)
+                 and not _matches(path, BUDGET_EXEMPT_MARKERS))
+                if budget is None else budget),
         host_lines=supp.host,
     )
     found = _rules.check_module(tree, ctx)

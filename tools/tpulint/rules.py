@@ -36,6 +36,7 @@ class FileContext:
     locked: bool = False   # R005 applies
     swallow: bool = False  # R006 applies (failure-domain modules)
     timing: bool = False   # R007 applies (tracing//monitor/ modules)
+    budget: bool = False   # R008 applies (product package, not resources/)
     host_lines: Set[int] = field(default_factory=set)
 
 
@@ -102,6 +103,7 @@ class _ModuleInfo:
         self.shared_globals: Set[str] = set()
         self.time_mods: Set[str] = set()      # names bound to `import time`
         self.wall_fns: Set[str] = set()       # `from time import time [as t]`
+        self.put_fns: Set[str] = set()        # `from jax import device_put`
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for al in node.names:
@@ -129,6 +131,8 @@ class _ModuleInfo:
                             self.jit_names.add(al.asname or "jit")
                         if al.name == "numpy":
                             self.jnp.add(al.asname or "numpy")
+                        if al.name == "device_put":
+                            self.put_fns.add(al.asname or "device_put")
                 elif node.module == "functools":
                     for al in node.names:
                         if al.name == "partial":
@@ -380,7 +384,31 @@ class _Checker(ast.NodeVisitor):
         self._check_static_call_args(node)
         self._check_sync(node)
         self._check_dynamic_shapes(node)
+        self._check_offbudget_put(node)
         self.generic_visit(node)
+
+    # -- R008 ---------------------------------------------------------------
+
+    def _check_offbudget_put(self, node: ast.Call) -> None:
+        """Raw ``jax.device_put`` in the product package bypasses the
+        residency registry: the placed bytes never show in the breaker/
+        residency accounting (/_nodes), so the admission-control layer is
+        blind to them. Route through RESIDENCY.device_put (always-resident
+        structures), RESIDENCY.put_array (evictable host-mirrored copies)
+        or RESIDENCY.track (caches), or justify a transient per-call
+        upload with `# tpulint: offbudget`."""
+        if not self.ctx.budget:
+            return
+        chain = _attr_chain(node.func) or ""
+        head, _, fn = chain.rpartition(".")
+        is_put = (chain in self.mod.put_fns
+                  or (fn == "device_put" and head in self.mod.jax))
+        if is_put:
+            self._emit("R008", node,
+                       "raw jax.device_put bypasses the residency registry "
+                       "(unaccounted HBM) — use resources.RESIDENCY."
+                       "device_put/put_array/track, or justify a transient "
+                       "upload with `# tpulint: offbudget`")
 
     def _check_static_call_args(self, node: ast.Call) -> None:
         target = self.mod.jitted.get(_name(node.func) or "")
