@@ -237,6 +237,24 @@ class MultiHostCluster:
 
                     (close_index if spec.get("closed")
                      else open_index)(self.node, name)
+            self._sync_local_terms()
+
+    def _sync_local_terms(self) -> None:
+        """Apply published primary terms to this node's shard engines
+        EAGERLY (reference: IndexShard.updatePrimaryTerm on cluster-state
+        apply). A promoted primary must operate under its bumped term
+        from the moment of promotion — not from its first write — so a
+        recovery source snapshot taken before any new-term op still
+        outranks (and prunes) a zombie copy's stale-era docs, and every
+        copy fences stale ops even before new-term traffic arrives."""
+        for name, spec in self.dist_indices.items():
+            svc = self.node.indices.get(name)
+            if svc is None:
+                continue
+            for sid_s, term in (spec.get("primary_terms") or {}).items():
+                sid = int(sid_s)
+                if sid < len(svc.shards):
+                    svc.shards[sid].engine.bump_term(int(term))
 
     def publish_indices(self) -> None:
         self._bump_indices_version()
@@ -294,6 +312,14 @@ class MultiHostCluster:
                             for o in owners]
                     spec["assignment"][sid] = [o for o in kept
                                                if o in alive]
+                # the in-sync copy set and primary terms follow the same
+                # remap: the restarted master's on-disk copies stay
+                # in-sync under their recorded terms, absent members must
+                # re-sync (and re-enter the set) via recovery
+                for sid, members in spec.get("in_sync", {}).items():
+                    kept = [self.local.node_id if o == old_local else o
+                            for o in members]
+                    spec["in_sync"][sid] = [o for o in kept if o in alive]
                 spec["initializing"] = {}
                 if not self.node.index_exists(name):
                     self.node.create_index(name, spec.get("body"))
@@ -308,6 +334,9 @@ class MultiHostCluster:
         with self._indices_lock:
             self._indices_version += 1
             self._persist_dist_meta()
+            # the master applies its own published terms the same way
+            # every peer does on adopt (eager, not first-write-lazy)
+            self._sync_local_terms()
 
     def indices_snapshot(self) -> dict:
         """Deep copy under the lock: publishes and join replies must not

@@ -7,20 +7,33 @@ that fails the op is failed-and-rerouted rather than failing the client
 write. Primary failure promotes an in-sync replica
 (cluster/routing/allocation — PRIMARY promotion on reroute).
 
+Replication safety (the ES 6.x seq-no upgrade, index/seqno.py): the
+primary stamps every op with its current PRIMARY TERM and a fresh
+SEQUENCE NUMBER; replicas replay the op under that identity and REJECT
+ops from a stale term (StalePrimaryException — the zombie-primary fence).
+The group keeps an explicit IN-SYNC copy set in a GlobalCheckpointTracker:
+the global checkpoint (min local checkpoint over in-sync copies) is what
+peer recovery negotiates against, promotion only ever selects an in-sync
+copy, and a replica that fails a write leaves the set until it re-syncs.
+
 TPU adaptation: replicas are full IndexShards (engine + searcher) holding
-their own device-resident segments. Replication replays the logical op with
-the PRIMARY's assigned version under external_gte, which makes fanout
-idempotent and keeps replicas convergent (same trick the reference uses
-with sequence numbers in later versions; ES 2.0 ships the version the same
-way). Search can read any in-sync copy (preference _primary / _replica /
-round-robin), mirroring query-then-fetch shard selection.
+their own device-resident segments. Replication replays the logical op
+with the PRIMARY's assigned version under external_gte, which keeps
+fanout idempotent and replicas convergent. Search can read any in-sync
+copy (preference _primary / _replica / round-robin), mirroring
+query-then-fetch shard selection.
 """
 from __future__ import annotations
 
 import threading
 from typing import Any, Callable, List, Optional
 
-from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+from elasticsearch_tpu.index.seqno import GlobalCheckpointTracker
+from elasticsearch_tpu.utils.errors import (
+    ElasticsearchTpuException,
+    StalePrimaryException,
+)
+from elasticsearch_tpu.utils.faults import FAULTS
 
 
 class ReplicationGroup:
@@ -35,33 +48,57 @@ class ReplicationGroup:
         self.on_replica_failure = on_replica_failure
         self._lock = threading.RLock()
         self._read_rr = 0
+        # explicit in-sync copy set, keyed by engine commit id (the
+        # in-process analogue of the reference's allocation ids)
+        self.checkpoints = GlobalCheckpointTracker(
+            in_sync=[c.engine.commit_id for c in self.copies])
 
     # -- writes ----------------------------------------------------------------
 
-    def index(self, doc_id, source, **kw):
-        """Execute on primary, then fan out with the primary's version.
+    @property
+    def primary_term(self) -> int:
+        return self.primary.engine.primary_term
 
-        Returns (id, version, created, replicas_failed_this_write)."""
+    def index(self, doc_id, source, **kw):
+        """Execute on primary, then fan out with the primary's assigned
+        (version, seq_no, term) identity.
+
+        Returns (id, version, created, replicas_failed, seq_no, term)."""
         with self._lock:
             rid, version, created = self.primary.engine.index(doc_id, source, **kw)
-            failed = self._fanout("index", rid, source=source, version=version, kw=kw)
-            return rid, version, created, failed
+            loc = self.primary.engine._locations[rid]
+            seq_no, term = loc.seq_no, loc.term
+            failed = self._fanout("index", rid, source=source, version=version,
+                                  seq_no=seq_no, term=term, kw=kw)
+            self._note_checkpoints()
+            return rid, version, created, failed, seq_no, term
 
     def delete(self, doc_id, **kw):
         with self._lock:
             version = self.primary.engine.delete(doc_id, **kw)
-            failed = self._fanout("delete", doc_id, version=version, kw=kw)
-            return version, failed
+            loc = self.primary.engine._locations.get(str(doc_id))
+            seq_no = loc.seq_no if loc else -2
+            term = loc.term if loc else self.primary_term
+            failed = self._fanout("delete", doc_id, version=version,
+                                  seq_no=seq_no, term=term, kw=kw)
+            self._note_checkpoints()
+            return version, failed, seq_no, term
 
-    def _fanout(self, op: str, doc_id, source=None, version=None, kw=None) -> int:
-        """Returns how many replicas failed (and were dropped) on this op."""
+    def _fanout(self, op: str, doc_id, source=None, version=None,
+                seq_no=None, term=None, kw=None) -> int:
+        """Returns how many replicas failed (and were dropped) on this op.
+        A STALE-TERM rejection is different in kind: the replica is fine,
+        it is THIS primary that was demoted — the exception propagates so
+        the write is never acknowledged (the zombie-primary fence)."""
         kw = dict(kw or {})
-        kw.pop("version", None)
-        kw.pop("version_type", None)
-        kw.pop("op_type", None)
+        for k in ("version", "version_type", "op_type", "seq_no",
+                  "primary_term"):
+            kw.pop(k, None)
         failed = 0
         for replica in list(self.replicas):
             try:
+                FAULTS.check("replication.fanout", shard=self.shard_id,
+                             op=op, id=str(doc_id))
                 # _replay=True: replicas keep no translog of their own —
                 # durability lives on the primary; a replica re-syncs via
                 # peer recovery, so logging each op here would only grow an
@@ -69,23 +106,48 @@ class ReplicationGroup:
                 if op == "index":
                     replica.engine.index(doc_id, source, version=version,
                                          version_type="external_gte",
+                                         seq_no=seq_no, primary_term=term,
                                          _replay=True, **kw)
                 else:
                     try:
-                        replica.engine.delete(doc_id, _replay=True)
+                        replica.engine.delete(doc_id, seq_no=seq_no,
+                                              primary_term=term,
+                                              _replay=True)
+                    except StalePrimaryException:
+                        raise
                     except ElasticsearchTpuException:
-                        pass  # already absent on the replica
+                        # already absent on the replica: a no-op, but the
+                        # seq no still counts as processed (checkpoint
+                        # must not stall on the hole)
+                        replica.engine.note_noop(seq_no, term)
+            except StalePrimaryException:
+                raise  # demoted primary: never ack, never demote the replica
             except Exception:
                 # reference behavior: a failing replica is failed out of the
                 # group (and reported to the master for reroute), the client
-                # write still succeeds — but the _shards section reports it
+                # write still succeeds — but the _shards section reports it.
+                # It also leaves the in-sync set: a copy that missed an
+                # acknowledged write must never be promotable again until
+                # recovery re-syncs it.
                 if replica in self.replicas:
                     self.replicas.remove(replica)
                     self.failed_replicas.append(replica)
+                    self.checkpoints.remove(replica.engine.commit_id)
                 failed += 1
                 if self.on_replica_failure:
                     self.on_replica_failure(self.shard_id, replica)
         return failed
+
+    def _note_checkpoints(self) -> None:
+        """Report every live copy's local checkpoint into the tracker;
+        the global checkpoint is their in-sync minimum."""
+        for c in self.copies:
+            self.checkpoints.update_local(c.engine.commit_id,
+                                          c.engine.local_checkpoint)
+
+    @property
+    def global_checkpoint(self) -> int:
+        return self.checkpoints.global_checkpoint
 
     def replicate_current(self, doc_id: str):
         """Fan out the primary's CURRENT state of doc_id (used after partial
@@ -94,26 +156,41 @@ class ReplicationGroup:
             eng = self.primary.engine
             loc = eng._locations.get(str(doc_id))
             if loc is None or loc.deleted:
-                self._fanout("delete", doc_id)
+                seq_no = loc.seq_no if loc else None
+                term = loc.term if loc else self.primary_term
+                self._fanout("delete", doc_id, seq_no=seq_no, term=term)
                 return
             got = eng.get(str(doc_id))
             self._fanout("index", str(doc_id), source=got["_source"],
-                         version=loc.version,
+                         version=loc.version, seq_no=loc.seq_no,
+                         term=loc.term,
                          kw={"routing": loc.routing, "doc_type": loc.doc_type,
                              "parent": loc.parent})
+            self._note_checkpoints()
 
     # -- failover --------------------------------------------------------------
 
     def fail_primary(self):
-        """Promote the first in-sync replica (reference: primary failure →
-        allocation promotes an active replica copy)."""
+        """Promote the first in-sync replica under a BUMPED primary term
+        (reference: primary failure → allocation promotes an active
+        in-sync copy and increments the shard's primary term). The old
+        primary leaves the in-sync set; any op still carrying its term is
+        fenced by every surviving copy."""
         with self._lock:
-            if not self.replicas:
+            in_sync = self.checkpoints.in_sync
+            candidates = [r for r in self.replicas
+                          if r.engine.commit_id in in_sync]
+            if not candidates:
                 raise ElasticsearchTpuException(
-                    f"shard [{self.shard_id}]: no replica to promote")
+                    f"shard [{self.shard_id}]: no in-sync replica to promote")
             old = self.primary
-            self.primary = self.replicas.pop(0)
+            new_term = max(c.engine.primary_term for c in self.copies) + 1
+            promoted = candidates[0]
+            self.replicas.remove(promoted)
+            self.primary = promoted
+            self.primary.engine.bump_term(new_term)
             self.failed_replicas.append(old)
+            self.checkpoints.remove(old.engine.commit_id)
             return self.primary
 
     # -- reads -----------------------------------------------------------------

@@ -31,6 +31,27 @@ def shard_id_for(doc_id: str, num_shards: int, routing: Optional[str] = None) ->
     return routing_hash(key) % num_shards
 
 
+def select_primary(owners: List[str], in_sync: List[str]) -> List[str]:
+    """The replication-safety promotion rule (reference: the allocation
+    pass promoting primaries from the in-sync allocation ids): reorder
+    ``owners`` so an IN-SYNC copy leads. A copy that missed an
+    acknowledged write or is still recovering must never become primary —
+    that would silently roll back acks — so when NO in-sync copy
+    survives, the answer is an empty list (shard red; gateway
+    resurrection may later re-adopt from on-disk data) rather than a
+    non-in-sync promotion. Used by the master's reconcile pass
+    (cluster/search_action.py) on every membership change."""
+    if not owners:
+        return []
+    if owners[0] in in_sync:
+        return list(owners)
+    promotable = [o for o in owners if o in in_sync]
+    if not promotable:
+        return []
+    first = promotable[0]
+    return [first] + [o for o in owners if o != first]
+
+
 # -- allocation deciders -------------------------------------------------------
 
 ALWAYS, THROTTLE, NO = "YES", "THROTTLE", "NO"

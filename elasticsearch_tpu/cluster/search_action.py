@@ -33,11 +33,14 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from elasticsearch_tpu.cluster.routing import shard_id_for
-from elasticsearch_tpu.cluster.transport import TransportError
+from elasticsearch_tpu.cluster.transport import RemoteException, TransportError
+from elasticsearch_tpu.index.seqno import (GlobalCheckpointTracker,
+                                           NO_OPS_PERFORMED)
 from elasticsearch_tpu.tracing import TaskCancelledException
 from elasticsearch_tpu.utils import wire
 from elasticsearch_tpu.utils.errors import (ElasticsearchTpuException,
-                                            IndexNotFoundException)
+                                            IndexNotFoundException,
+                                            StalePrimaryException)
 from elasticsearch_tpu.utils.faults import FAULTS
 
 ACTION_QUERY = "indices:data/read/search[phase/query]"
@@ -91,6 +94,21 @@ def shard_failure_entry(index: str, sid: int, exc: Optional[Exception] = None,
                        "reason": reason or ""}}
 
 
+def _translog_to_replay(op: dict) -> dict:
+    """Translog frame → the replay_op dict shape the recovery stream uses
+    (IndexService.replay_op), preserving the (seq_no, term) identity."""
+    if op.get("op") == "delete":
+        return {"id": op["id"], "deleted": True,
+                "version": op.get("version"),
+                "seq_no": op.get("seq_no"), "term": op.get("term")}
+    return {"id": op["id"], "source": op.get("source"),
+            "version": op.get("version"), "type": op.get("doc_type"),
+            "parent": op.get("parent"), "routing": op.get("routing"),
+            "timestamp": op.get("timestamp"),
+            "ttl_expiry": op.get("ttl_expiry"),
+            "seq_no": op.get("seq_no"), "term": op.get("term")}
+
+
 def by_query_task_action(op: str) -> str:
     """ES task action name for a by-query op (reference:
     DeleteByQueryAction.NAME / UpdateByQueryAction.NAME)."""
@@ -111,6 +129,10 @@ class DistributedDataService:
         # fanout must be one atomic step, or two client threads' fanouts
         # can reach a replica out of version order
         self._write_locks: Dict[Tuple[str, int], threading.Lock] = {}
+        # per-(index, shard) global-checkpoint trackers, maintained by the
+        # PRIMARY owner from the local checkpoints replicas report on each
+        # fanout ack (reference: ReplicationTracker on the primary)
+        self._gckpts: Dict[Tuple[str, int], GlobalCheckpointTracker] = {}
         t = cluster.transport
         t.register(ACTION_QUERY, self._on_query)
         t.register(ACTION_FETCH, self._on_fetch)
@@ -169,6 +191,54 @@ class DistributedDataService:
 
     def _local_id(self) -> str:
         return self.cluster.local.node_id
+
+    # -- replication safety ---------------------------------------------------
+
+    @staticmethod
+    def _shard_term(meta: dict, sid: int) -> int:
+        """The shard's current primary term from the published metadata
+        (legacy metas without the key are term 1 — the pre-seqno world)."""
+        return int(meta.setdefault("primary_terms", {})
+                   .setdefault(str(sid), 1))
+
+    @staticmethod
+    def _shard_in_sync(meta: dict, sid: int) -> list:
+        """The shard's explicit in-sync copy set. Legacy metas default it
+        to the current assignment (every committed copy was fanout-fed)."""
+        return meta.setdefault("in_sync", {}).setdefault(
+            str(sid), list(meta["assignment"].get(str(sid), [])))
+
+    def _fence_replica_op(self, index: str, sid: int,
+                          op_term: Optional[int]) -> None:
+        """Replica-side term fence against this node's OWN view of the
+        shard's primary term (the master-published metadata): an op from
+        a term older than the published one comes from a demoted primary
+        that doesn't know it yet. This fences even before the new primary
+        has sent a single op (the engine-level fence, which adopts terms
+        from op traffic, is the backstop)."""
+        if op_term is None:
+            return
+        meta = self.cluster.dist_indices.get(index)
+        if meta is None:
+            return
+        cur = self._shard_term(meta, sid)
+        if op_term < cur:
+            raise StalePrimaryException(index, sid, op_term, cur)
+
+    def _checkpoint_tracker(self, index: str, sid: int,
+                            meta: dict) -> GlobalCheckpointTracker:
+        key = (index, sid)
+        with self._lock:
+            t = self._gckpts.get(key)
+            if t is None:
+                t = self._gckpts[key] = GlobalCheckpointTracker()
+        t.set_in_sync(self._shard_in_sync(meta, sid))
+        return t
+
+    def global_checkpoint(self, index: str, sid: int) -> int:
+        with self._lock:
+            t = self._gckpts.get((index, sid))
+        return t.global_checkpoint if t is not None else NO_OPS_PERFORMED
 
     def _addr(self, node_id: str) -> Tuple[str, int]:
         n = self.node.cluster_state.nodes.get(node_id)
@@ -246,7 +316,10 @@ class DistributedDataService:
                         "replicas": replicas,
                         "assignment": {str(i): [] for i in range(num_shards)},
                         "initializing": {k: list(v)
-                                         for k, v in assignment.items()}}
+                                         for k, v in assignment.items()},
+                        "primary_terms": {str(i): 1
+                                          for i in range(num_shards)},
+                        "in_sync": {str(i): [] for i in range(num_shards)}}
             else:
                 meta = {"body": local_body, "num_shards": num_shards,
                         "replicas": replicas, "assignment": assignment,
@@ -254,7 +327,15 @@ class DistributedDataService:
                         # (they must see live writes during the copy), NOT
                         # promotable or searchable until recovery succeeds
                         # — the reference's INITIALIZING shard state
-                        "initializing": {}}
+                        "initializing": {},
+                        # replication safety: per-shard primary terms and
+                        # the explicit in-sync copy set promotion selects
+                        # from (index/seqno.py; reference: primaryTerm in
+                        # IndexMetaData + in-sync allocation ids)
+                        "primary_terms": {str(i): 1
+                                          for i in range(num_shards)},
+                        "in_sync": {k: list(v)
+                                    for k, v in assignment.items()}}
             self.cluster.dist_indices[name] = meta
             if not self.node.index_exists(name):
                 self.node.create_index(name, local_body)
@@ -622,9 +703,34 @@ class DistributedDataService:
             return self._write_locks.setdefault((index, sid),
                                                 threading.Lock())
 
+    def _ensure_primary(self, op: str, index: str, sid: int,
+                        payload: dict, forwarded: bool) -> Optional[dict]:
+        """A write landed here but THIS node's published metadata names a
+        different primary: the sender routed on stale state (or this node
+        was just demoted). Applying locally would ack under the new term
+        without the real primary ever seeing the op — acked-op loss — so
+        forward ONE hop to the owner this node believes in (reference:
+        TransportReplicationAction rerouting on stale routing). A write
+        that was already forwarded and still finds no agreement fails
+        typed instead of ping-ponging."""
+        meta = self._meta(index)
+        owners = meta["assignment"].get(str(sid), [])
+        if not owners or owners[0] == self._local_id():
+            return None  # we are the primary (or the shard is lost —
+            # owner_of raises on the read side; writes fail below anyway)
+        if forwarded:
+            raise StalePrimaryException(index, sid,
+                                        self._shard_term(meta, sid),
+                                        self._shard_term(meta, sid))
+        fwd = dict(payload)
+        fwd["forwarded"] = True
+        action = {"index": ACTION_INDEX, "delete": ACTION_DELETE,
+                  "update": ACTION_UPDATE}[op]
+        return self._send(owners[0], action, fwd)
+
     def _primary_write(self, op: str, index: str, sid: int, doc_id: str,
                        source: Optional[dict], routing: Optional[str],
-                       kw: dict) -> dict:
+                       kw: dict, forwarded: bool = False) -> dict:
         """Apply on the primary, then fan out to every cross-host copy —
         committed replicas AND initializing (recovering) ones — with the
         primary-assigned version (external_gte keeps replica replay
@@ -632,16 +738,34 @@ class DistributedDataService:
         TransportShardReplicationOperationAction primary → replicas hop).
         The per-shard lock makes apply+fanout atomic so two client
         threads' fanouts cannot reach a replica out of version order."""
+        rerouted = self._ensure_primary(
+            op, index, sid,
+            {"index": index, "id": doc_id, "source": source,
+             "routing": routing, "kw": kw}, forwarded)
+        if rerouted is not None:
+            return rerouted
         svc = self.node.indices[index]
         with self._write_lock(index, sid):
+            meta = self._meta(index)
+            # stamp the op with THIS node's published view of the shard's
+            # primary term; if a newer term already reached the local
+            # engine (a recovery stream from the real primary), the
+            # engine-level fence rejects right here — before any fanout
+            term = self._shard_term(meta, sid)
+            kw = dict(kw)
+            kw["primary_term"] = term
             if op == "index":
                 res = svc.index_doc(doc_id, source, routing=routing, **kw)
             else:
                 res = svc.delete_doc(doc_id, routing=routing, **kw)
-            meta = self._meta(index)
+            tracker = self._checkpoint_tracker(index, sid, meta)
+            tracker.update_local(
+                self._local_id(),
+                svc.shards[sid].engine.local_checkpoint)
             rep_kw = dict(kw)
             rep_kw.update(version=res["_version"],
-                          version_type="external_gte")
+                          version_type="external_gte",
+                          seq_no=res.get("_seq_no"), primary_term=term)
             action = ACTION_INDEX if op == "index" else ACTION_DELETE
             copies = (meta["assignment"][str(sid)][1:]
                       + meta.get("initializing", {}).get(str(sid), []))
@@ -649,10 +773,23 @@ class DistributedDataService:
                 if rep == self._local_id():
                     continue
                 try:
-                    self._send(rep, action,
-                               {"index": index, "id": doc_id,
-                                "source": source, "routing": routing,
-                                "kw": rep_kw, "replica": True})
+                    FAULTS.check("replication.fanout", index=index,
+                                 shard=sid, target=rep, op=op)
+                    r = self._send(rep, action,
+                                   {"index": index, "id": doc_id,
+                                    "source": source, "routing": routing,
+                                    "kw": rep_kw, "replica": True})
+                    if isinstance(r, dict) and "local_checkpoint" in r:
+                        tracker.update_local(rep, r["local_checkpoint"])
+                except RemoteException as e:
+                    if e.error_type == "stale_primary_exception":
+                        # the REPLICA is fine — THIS primary was demoted
+                        # and doesn't know it: never ack the write, never
+                        # demote the copy that fenced us (the zombie-
+                        # primary window closes here). The typed 409
+                        # relays as-is.
+                        raise
+                    self._report_copy_failed(index, sid, rep)
                 except Exception:
                     # a copy that missed an acknowledged write must stop
                     # being promotable — report it failed so the master
@@ -660,6 +797,7 @@ class DistributedDataService:
                     # (reference: ShardStateAction.shardFailed on a failed
                     # replication hop)
                     self._report_copy_failed(index, sid, rep)
+        res["_global_checkpoint"] = tracker.global_checkpoint
         return res
 
     def _report_copy_failed(self, index: str, sid: int,
@@ -691,6 +829,11 @@ class DistributedDataService:
             if node_id not in owners or owners[0] == node_id:
                 return {"ok": False}
             owners.remove(node_id)
+            # the copy missed an acknowledged write: it leaves the
+            # in-sync set until its re-sync stream completes
+            insync = self._shard_in_sync(meta, sid)
+            if node_id in insync:
+                insync.remove(node_id)
             if owners and node_id in self.node.cluster_state.nodes:
                 # back through INITIALIZING so live writes keep fanning
                 # out to it while the re-sync stream runs
@@ -710,13 +853,22 @@ class DistributedDataService:
         index, doc_id = payload["index"], payload["id"]
         routing = payload.get("routing")
         if payload.get("replica"):
-            return self.node.indices[index].index_doc(
-                doc_id, payload["source"], routing=routing,
-                **(payload.get("kw") or {}))
+            kw = payload.get("kw") or {}
+            sid = shard_id_for(doc_id, self._meta(index)["num_shards"],
+                               routing)
+            self._fence_replica_op(index, sid, kw.get("primary_term"))
+            res = self.node.indices[index].index_doc(
+                doc_id, payload["source"], routing=routing, **kw)
+            # the ack reports this copy's local checkpoint so the primary
+            # can advance the shard's global checkpoint
+            res["local_checkpoint"] = self.node.indices[index] \
+                .shards[sid].engine.local_checkpoint
+            return res
         sid = shard_id_for(doc_id, self._meta(index)["num_shards"], routing)
         return self._primary_write("index", index, sid, doc_id,
                                    payload["source"], routing,
-                                   payload.get("kw") or {})
+                                   payload.get("kw") or {},
+                                   forwarded=bool(payload.get("forwarded")))
 
     def delete_doc(self, index: str, doc_id: str,
                    routing: Optional[str] = None, **kw) -> dict:
@@ -750,26 +902,50 @@ class DistributedDataService:
 
     def _primary_update(self, index: str, sid: int, doc_id: str,
                         body: dict, routing: Optional[str],
-                        kw: dict) -> dict:
+                        kw: dict, forwarded: bool = False) -> dict:
+        rerouted = self._ensure_primary(
+            "update", index, sid,
+            {"index": index, "id": doc_id, "body": body,
+             "routing": routing, "kw": kw}, forwarded)
+        if rerouted is not None:
+            return rerouted
         svc = self.node.indices[index]
         with self._write_lock(index, sid):
-            res = svc.update_doc(doc_id, body, routing=routing, **kw)
             meta = self._meta(index)
+            term = self._shard_term(meta, sid)
+            # the published term rides into the engine like any primary
+            # write: a demoted node whose engine already adopted a newer
+            # term (via a recovery stream) fences HERE instead of acking
+            # an update its replacement never sees
+            kw = dict(kw)
+            kw["primary_term"] = term
+            res = svc.update_doc(doc_id, body, routing=routing, **kw)
             got = svc.get_doc(doc_id, routing=routing)
             copies = (meta["assignment"][str(sid)][1:]
                       + meta.get("initializing", {}).get(str(sid), []))
             if got.get("found"):
+                # the merged doc's engine-assigned (seq_no, term) identity
+                # rides the fanout like any primary write
+                loc = svc.shards[sid].engine._locations.get(str(doc_id))
                 rep_kw = {"version": res["_version"],
-                          "version_type": "external_gte"}
+                          "version_type": "external_gte",
+                          "seq_no": loc.seq_no if loc else None,
+                          "primary_term": loc.term if loc else term}
                 for rep in copies:
                     if rep == self._local_id():
                         continue
                     try:
+                        FAULTS.check("replication.fanout", index=index,
+                                     shard=sid, target=rep, op="update")
                         self._send(rep, ACTION_INDEX,
                                    {"index": index, "id": doc_id,
                                     "source": got["_source"],
                                     "routing": routing, "kw": rep_kw,
                                     "replica": True})
+                    except RemoteException as e:
+                        if e.error_type == "stale_primary_exception":
+                            raise  # demoted primary: never ack
+                        self._report_copy_failed(index, sid, rep)
                     except Exception:
                         self._report_copy_failed(index, sid, rep)
         return res
@@ -779,7 +955,8 @@ class DistributedDataService:
         routing = payload.get("routing")
         sid = shard_id_for(doc_id, self._meta(index)["num_shards"], routing)
         return self._primary_update(index, sid, doc_id, payload["body"],
-                                    routing, payload.get("kw") or {})
+                                    routing, payload.get("kw") or {},
+                                    forwarded=bool(payload.get("forwarded")))
 
     def _on_delete(self, payload: dict) -> dict:
         index, doc_id = payload["index"], payload["id"]
@@ -788,17 +965,29 @@ class DistributedDataService:
             from elasticsearch_tpu.utils.errors import \
                 DocumentMissingException
 
+            kw = payload.get("kw") or {}
+            sid = shard_id_for(doc_id, self._meta(index)["num_shards"],
+                               routing)
+            self._fence_replica_op(index, sid, kw.get("primary_term"))
+            eng = self.node.indices[index].shards[sid].engine
             try:
-                return self.node.indices[index].delete_doc(
-                    doc_id, routing=routing, **(payload.get("kw") or {}))
+                res = self.node.indices[index].delete_doc(
+                    doc_id, routing=routing, **kw)
             except DocumentMissingException:
                 # a delete for a doc this copy never saw (e.g. it raced the
                 # recovery snapshot): per-shard fanout ordering plus the
-                # tombstones shipped by _on_shard_sync make skipping safe
-                return {"found": False, "_id": doc_id}
+                # tombstones shipped by _on_shard_sync make skipping safe —
+                # but the op's seq no is still processed (no-op), or this
+                # copy's checkpoint stalls on the hole
+                eng.note_noop(kw.get("seq_no"), kw.get("primary_term"))
+                return {"found": False, "_id": doc_id,
+                        "local_checkpoint": eng.local_checkpoint}
+            res["local_checkpoint"] = eng.local_checkpoint
+            return res
         sid = shard_id_for(doc_id, self._meta(index)["num_shards"], routing)
         return self._primary_write("delete", index, sid, doc_id, None,
-                                   routing, payload.get("kw") or {})
+                                   routing, payload.get("kw") or {},
+                                   forwarded=bool(payload.get("forwarded")))
 
     def by_query(self, index: str, body: Optional[dict], op: str,
                  script=None, params=None) -> dict:
@@ -1165,11 +1354,36 @@ class DistributedDataService:
                 want = 1 + int(meta.get("replicas", 0))
                 init = meta.setdefault("initializing", {})
                 for sid in range(meta["num_shards"]):
+                    old_primary = (meta["assignment"][str(sid)] or [None])[0]
                     owners = [o for o in meta["assignment"][str(sid)]
                               if o in alive]
                     if owners != meta["assignment"][str(sid)]:
                         changed = True
+                    # promotion only ever selects an IN-SYNC copy: a copy
+                    # that missed an acknowledged write (shard_failed) or
+                    # is still recovering must never become primary — it
+                    # would silently roll back acked ops (reference:
+                    # allocation promotes from the in-sync allocation ids)
+                    insync = self._shard_in_sync(meta, sid)
+                    dropped = [o for o in insync if o not in alive]
+                    if dropped:
+                        changed = True
+                        insync[:] = [o for o in insync if o in alive]
+                    from elasticsearch_tpu.cluster.routing import \
+                        select_primary
+
+                    reordered = select_primary(owners, insync)
+                    if reordered != owners:
+                        owners = reordered
+                        changed = True
                     meta["assignment"][str(sid)] = owners
+                    if owners and owners[0] != old_primary:
+                        # primary changed hands: BUMP THE TERM so any op
+                        # still in flight from the demoted primary is
+                        # fenced by every copy that adopts this publish
+                        terms = meta.setdefault("primary_terms", {})
+                        terms[str(sid)] = self._shard_term(meta, sid) + 1
+                        changed = True
                     pend = [t for t in init.get(str(sid), []) if t in alive]
                     if pend != init.get(str(sid), []):
                         changed = True
@@ -1229,10 +1443,15 @@ class DistributedDataService:
             if best_nid is None:
                 continue
             with self.cluster._indices_lock:
-                owners = self.cluster.dist_indices[name]["assignment"] \
-                    .get(str(sid))
+                meta2 = self.cluster.dist_indices[name]
+                owners = meta2["assignment"].get(str(sid))
                 if owners == []:  # still lost (no race with a recovery)
                     owners.append(best_nid)
+                    # gateway adoption is a primary change: new term, and
+                    # the adopted copy is the in-sync set's sole member
+                    meta2.setdefault("primary_terms", {})[str(sid)] = \
+                        self._shard_term(meta2, sid) + 1
+                    meta2.setdefault("in_sync", {})[str(sid)] = [best_nid]
                     changed = True
         if changed:
             self.cluster.publish_indices()
@@ -1307,66 +1526,186 @@ class DistributedDataService:
                 if ok and owners is not None and d["target"] not in owners \
                         and d["target"] in self.node.cluster_state.nodes:
                     owners.append(d["target"])  # INITIALIZING → STARTED
+                    # recovery caught the copy up to the source's
+                    # checkpoint: it joins the in-sync set and becomes
+                    # promotable
+                    insync = self._shard_in_sync(meta, d["shard"])
+                    if d["target"] not in insync:
+                        insync.append(d["target"])
                     promoted = True
         if promoted:
             self.cluster.publish_indices()
 
     def _on_recover(self, payload: dict) -> dict:
-        """Recovery target: pull the shard's live docs from the source copy
-        and replay them with external_gte versioning (RecoveryTarget).
-        The index may not exist locally yet when recovery races the
-        metadata publish — create it from the directive's body."""
+        """Recovery target: checkpoint handshake with the source copy,
+        then EITHER replay the translog op suffix above this copy's local
+        checkpoint (incremental — the seq-no era RecoveryTarget) OR pull
+        the full live-doc snapshot (fallback for diverged copies, flushed
+        ops, legacy frames). The index may not exist locally yet when
+        recovery races the metadata publish — create it from the
+        directive's body."""
         index, sid = payload["index"], payload["shard"]
         with self.cluster._indices_lock:
             if not self.node.index_exists(index):
                 self.node.create_index(index, payload.get("body"))
-        res = self._send(payload["source"], ACTION_SHARD_SYNC,
-                         {"index": index, "shard": sid}, timeout=60.0)
         svc = self.node.indices[index]
-        copied = skipped = 0
+        engine = svc.shards[sid].engine
+        ckpt = engine.local_checkpoint
+        rec = svc.recoveries.start(sid, "peer",
+                                   source=payload["source"],
+                                   target=self._local_id())
+        copied = skipped = replayed = 0
         from elasticsearch_tpu.utils.errors import (DocumentMissingException,
                                                     VersionConflictException)
 
-        # child task on the TARGET node (parent: the driving recovery
-        # task, via the wire header): a cancel aborts the replay between
-        # docs, the copy stays INITIALIZING and never graduates
-        with self.node.tasks.task(
-                ACTION_RECOVER + "[t]",
-                description=f"recover [{index}][{sid}] "
-                            f"from {payload['source']}") as task:
-            for d in res["docs"]:
-                task.check_cancelled()
-                try:
-                    # docs AND tombstones ride the stream (a delete that
-                    # landed on the source after a racing fanout index on
-                    # this copy still wins by version); percolator-registry
-                    # maintenance happens atomically with the engine op
-                    # (IndexService.replay_op)
-                    svc.replay_op(sid, d)
-                    copied += 1
-                except (VersionConflictException, DocumentMissingException):
-                    skipped += 1  # already newer (a racing replica write)
-        svc.shards[sid].engine.refresh()
-        return {"copied": copied, "skipped": skipped}
+        try:
+            res = self._send(payload["source"], ACTION_SHARD_SYNC,
+                             {"index": index, "shard": sid,
+                              "checkpoint": ckpt,
+                              "last_term": engine.term_at(ckpt)},
+                             timeout=60.0)
+            # child task on the TARGET node (parent: the driving recovery
+            # task, via the wire header): a cancel aborts the replay
+            # between ops/docs, the copy stays INITIALIZING and never
+            # graduates
+            with self.node.tasks.task(
+                    ACTION_RECOVER + "[t]",
+                    description=f"recover [{index}][{sid}] "
+                                f"from {payload['source']}") as task:
+                if res.get("mode") == "ops":
+                    rec.update(mode="ops", stage="translog")
+                    for op in res["ops"]:
+                        task.check_cancelled()
+                        FAULTS.check("recovery.ops_replay", index=index,
+                                     shard=sid, seq_no=op.get("seq_no"))
+                        try:
+                            svc.replay_op(sid, _translog_to_replay(op))
+                            replayed += 1
+                        except (VersionConflictException,
+                                DocumentMissingException):
+                            # racing fanout write was newer: a no-op,
+                            # but its seq no still counts as processed
+                            # or the checkpoint stalls on the hole
+                            engine.note_noop(op.get("seq_no"),
+                                             op.get("term"))
+                            skipped += 1
+                        rec["ops_replayed"] = replayed
+                        rec["docs_skipped"] = skipped
+                    # an idle new primary's bumped term still propagates
+                    engine.bump_term(int(res.get("term", 0)))
+                else:
+                    rec.update(mode="full", stage="index")
+                    for d in res["docs"]:
+                        task.check_cancelled()
+                        try:
+                            # docs AND tombstones ride the stream (a
+                            # delete that landed on the source after a
+                            # racing fanout index on this copy still wins
+                            # by version); percolator-registry maintenance
+                            # happens atomically with the engine op
+                            # (IndexService.replay_op)
+                            svc.replay_op(sid, d)
+                            copied += 1
+                        except (VersionConflictException,
+                                DocumentMissingException):
+                            engine.note_noop(d.get("seq_no"),
+                                             d.get("term"))
+                            skipped += 1  # already newer (racing write)
+                        rec["docs_copied"] = copied
+                        rec["docs_skipped"] = skipped
+                    # prune stale-era docs the source no longer has: a
+                    # diverged copy (demoted primary whose fenced write
+                    # was applied locally but never acked) may hold docs
+                    # from an older term that external_gte cannot remove.
+                    # Current-term docs above the snapshot horizon are
+                    # racing live-fanout arrivals and must survive.
+                    src_term = int(res.get("term", 0))
+                    src_ckpt = int(res.get("local_checkpoint", -1))
+                    snap_ids = {d["id"] for d in res["docs"]}
+                    with engine._lock:
+                        extras = [
+                            (doc_id, loc.version, loc.seq_no, loc.term)
+                            for doc_id, loc in engine._locations.items()
+                            if not loc.deleted and doc_id not in snap_ids
+                            and (loc.term < src_term
+                                 or (loc.term == src_term
+                                     and 0 <= loc.seq_no <= src_ckpt))]
+                    for doc_id, cur_version, stale_seq, stale_term \
+                            in extras:
+                        try:
+                            # the tombstone reuses the pruned doc's OWN
+                            # (seq_no, term): a local cleanup must not
+                            # consume numbers from the primary's stream —
+                            # a generated seqno would push this copy's
+                            # checkpoint past the source's and doom every
+                            # future handshake to the full-copy path
+                            # (same rule as recovery._recover_full_copy)
+                            svc.replay_op(sid, {"id": doc_id,
+                                                "deleted": True,
+                                                "version": cur_version,
+                                                "seq_no": stale_seq,
+                                                "term": stale_term})
+                        except (VersionConflictException,
+                                DocumentMissingException):
+                            pass
+                    # adopt the source's checkpoint + term history so the
+                    # NEXT bounce of this copy recovers incrementally
+                    engine.adopt_seq_state(
+                        {int(t): m for t, m in
+                         (res.get("term_seq") or {}).items()},
+                        int(res.get("local_checkpoint", -1)),
+                        int(res.get("term", 0)))
+            rec["stage"] = "finalize"
+            svc.shards[sid].engine.refresh()
+            svc.recoveries.finish(rec, ok=True)
+        except Exception:
+            svc.recoveries.finish(rec, ok=False)
+            raise
+        return {"copied": copied, "skipped": skipped,
+                "ops_replayed": replayed, "mode": rec["mode"]}
 
     def _on_shard_sync(self, payload: dict) -> dict:
-        """Recovery source: snapshot this shard's live docs (id, source,
-        version, type/parent/routing meta) — RecoverySourceHandler's
-        phase-1 stream in ops form. Concurrent writes during the copy win
+        """Recovery source: checkpoint comparison first — when the
+        target's history is a clean prefix (log-matching on the term at
+        its checkpoint) and the retained translog covers everything above
+        it, answer with ``mode=ops`` and just that suffix. Otherwise
+        snapshot this shard's docs AND tombstones with their full
+        (version, seq_no, term) identity — RecoverySourceHandler's
+        phase-1 stream in ops form; concurrent writes during the copy win
         on the target via version comparison (phase 2 for free)."""
         FAULTS.check("recovery.shard_sync", index=payload["index"],
                      shard=payload["shard"])
-        engine = self.node.indices[payload["index"]] \
-            .shards[payload["shard"]].engine
+        svc = self.node.indices[payload["index"]]
+        engine = svc.shards[payload["shard"]].engine
+        svc.recoveries.source_started()
+        try:
+            return self._shard_sync_response(engine, payload)
+        finally:
+            svc.recoveries.source_finished()
+
+    def _shard_sync_response(self, engine, payload: dict) -> dict:
+        ckpt = payload.get("checkpoint")
+        if ckpt is not None:
+            ops = engine.recovery_ops(int(ckpt), payload.get("last_term"))
+            if ops is not None:
+                return {"mode": "ops", "ops": ops,
+                        "term": engine.primary_term,
+                        "local_checkpoint": engine.local_checkpoint,
+                        "max_seq_no": engine.max_seq_no}
         with engine._lock:
             ids = [(doc_id, loc.version, loc.doc_type, loc.parent,
-                    loc.routing, loc.deleted)
+                    loc.routing, loc.deleted, loc.seq_no, loc.term)
                    for doc_id, loc in engine._locations.items()]
+            term_seq = dict(engine._term_seq)
+            src_term = engine.primary_term
+            src_ckpt = engine.local_checkpoint
         docs = []
-        for doc_id, version, doc_type, parent, routing, deleted in ids:
+        for doc_id, version, doc_type, parent, routing, deleted, seq_no, \
+                term in ids:
             if deleted:
                 docs.append({"id": doc_id, "version": version,
-                             "deleted": True})
+                             "deleted": True, "seq_no": seq_no,
+                             "term": term})
                 continue
             got = engine.get(doc_id)
             if got is None:
@@ -1375,11 +1714,13 @@ class DistributedDataService:
             docs.append({"id": doc_id, "source": got["_source"],
                          "version": version, "type": doc_type,
                          "parent": parent, "routing": routing,
+                         "seq_no": seq_no, "term": term,
                          # _timestamp/_ttl ride the stream too, or the
                          # recovered copy would regenerate/lose them
                          "timestamp": getattr(loc, "timestamp", None),
                          "ttl_expiry": getattr(loc, "ttl_expiry", None)})
-        return {"docs": docs}
+        return {"mode": "docs", "docs": docs, "term": src_term,
+                "local_checkpoint": src_ckpt, "term_seq": term_seq}
 
     # -- query phase (remote endpoint) ---------------------------------------
 

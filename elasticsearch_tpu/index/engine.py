@@ -23,10 +23,15 @@ from elasticsearch_tpu.analysis.registry import AnalysisRegistry
 from elasticsearch_tpu.index.doc_parser import DocumentParser, ParsedDocument
 from elasticsearch_tpu.index.mappings import Mappings
 from elasticsearch_tpu.index.segment import SegmentBuilder, TpuSegment
+from elasticsearch_tpu.index.seqno import (
+    NO_OPS_PERFORMED,
+    LocalCheckpointTracker,
+)
 from elasticsearch_tpu.index.translog import Translog
 from elasticsearch_tpu.utils.errors import (
     DocumentMissingException,
     EngineFailedException,
+    StalePrimaryException,
     VersionConflictException,
 )
 from elasticsearch_tpu.utils.faults import FAULTS
@@ -48,6 +53,11 @@ class DocLocation:
     # fields=_timestamp/_ttl without a segment lookup
     timestamp: Optional[int] = None
     ttl_expiry: Optional[int] = None
+    # replication identity: the (primary term, seq no) the op that wrote
+    # this state carried — recovery's full-copy path ships them so a
+    # rebuilt copy keeps the same op lineage (index/seqno.py)
+    seq_no: int = -2  # UNASSIGNED_SEQ_NO
+    term: int = 0
 
 
 @dataclass
@@ -105,6 +115,155 @@ class Engine:
         # tragic-event state: non-None after a durability-critical IO
         # failure; every later write 503s (reference: failEngine)
         self.failed_reason: Optional[str] = None
+        # replication safety (index/seqno.py): the term under which this
+        # copy believes its shard's primary operates, the local-checkpoint
+        # tracker, and the per-term max-seq-no history used for the
+        # log-matching check peer recovery does before ops replay
+        self.primary_term = 1
+        self.seq = LocalCheckpointTracker()
+        self._term_seq: Dict[int, int] = {}
+
+    # -- primary terms / sequence numbers ---------------------------------------
+
+    @property
+    def local_checkpoint(self) -> int:
+        return self.seq.checkpoint
+
+    @property
+    def max_seq_no(self) -> int:
+        return self.seq.max_seq_no
+
+    def bump_term(self, term: int) -> None:
+        """Adopt a higher primary term (promotion, or learning the new
+        term from a newer primary's op/recovery stream)."""
+        with self._lock:
+            if term > self.primary_term:
+                self.primary_term = term
+
+    def _fence_term(self, op_term: Optional[int],
+                    history: bool = False) -> int:
+        """Term handling for one op. LIVE ops (a primary's own writes,
+        replica fanout) are FENCED: an op from a term older than this
+        copy's current one comes from a demoted primary and is rejected;
+        a newer term is adopted. HISTORY ops (translog replay, recovery
+        streams) apply under their original recorded term without
+        fencing — replaying a term-1 op onto a term-2 copy is the normal
+        shape of catching up, not a zombie write (reference: the request-
+        level term check in TransportReplicationAction fences live ops;
+        recovery replays history below the current term freely). Must
+        hold ``_lock``."""
+        if op_term is None:
+            return self.primary_term  # primary-local op: current term
+        if history:
+            return op_term
+        if op_term < self.primary_term:
+            raise StalePrimaryException(self.index_name, "?", op_term,
+                                        self.primary_term)
+        if op_term > self.primary_term:
+            self.primary_term = op_term
+        return op_term
+
+    def _note_op(self, term: int, seq_no: int) -> None:
+        """Record (term, seq no) into the per-term history and the local
+        checkpoint tracker. Must hold ``_lock``."""
+        if seq_no < 0:
+            return
+        self.seq.mark_processed(seq_no)
+        cur = self._term_seq.get(term, NO_OPS_PERFORMED)
+        if seq_no > cur:
+            self._term_seq[term] = seq_no
+
+    def term_at(self, seq_no: int) -> Optional[int]:
+        """The primary term the op at ``seq_no`` ran under — the lowest
+        term whose recorded max seq no covers it (term boundaries are
+        strict: a new primary continues numbering past its predecessor).
+        None when this engine holds no record of that seq no."""
+        if seq_no < 0:
+            return 0  # vacuous: an empty copy matches any history
+        with self._lock:
+            for term in sorted(self._term_seq):
+                if self._term_seq[term] >= seq_no:
+                    return term
+        return None
+
+    def seq_no_stats(self) -> dict:
+        return {"max_seq_no": self.max_seq_no,
+                "local_checkpoint": self.local_checkpoint,
+                "primary_term": self.primary_term}
+
+    def note_noop(self, seq_no: Optional[int], term: Optional[int]) -> None:
+        """Mark an op's seq no processed WITHOUT applying it — the no-op
+        path for a replayed/fanned op whose effect is already covered by
+        newer state (version conflict, tombstoned doc). Without this, a
+        skipped op leaves a permanent hole above the local checkpoint:
+        the checkpoint (and hence the shard's global checkpoint) stalls
+        forever and every later recovery re-replays from the hole — or,
+        once the source flushes those ops away, falls back to full copies
+        for good. Reference: InternalEngine records NOOP operations for
+        exactly this (Engine.NoOp)."""
+        if seq_no is None:
+            return
+        with self._lock:
+            self._note_op(term if term is not None else self.primary_term,
+                          seq_no)
+
+    def adopt_seq_state(self, term_seq: Dict[int, int], checkpoint: int,
+                        term: int) -> None:
+        """Full-copy recovery target: the source shipped its complete
+        state, so adopt its checkpoint and per-term history. Entries for
+        terms BELOW the source's current term are REPLACED, not merged —
+        a diverged copy's phantom ops (a zombie write that advanced its
+        old-term max past the source's) would otherwise poison
+        ``term_at`` and fail the log-matching check on every future
+        handshake. Current-term entries max-merge: live fanout ops racing
+        the copy legitimately extend that term past the snapshot."""
+        with self._lock:
+            fresh = {int(t): m for t, m in (term_seq or {}).items()}
+            for t, m in self._term_seq.items():
+                if t >= term and m > fresh.get(t, NO_OPS_PERFORMED):
+                    fresh[t] = m
+            self._term_seq = fresh
+            self.seq.advance_to(checkpoint)
+            if term > self.primary_term:
+                self.primary_term = term
+
+    def recovery_ops(self, checkpoint: int,
+                     last_term: Optional[int] = None) -> Optional[list]:
+        """Recovery source: the translog op suffix above the target's
+        ``checkpoint``, or None when ops-based replay is unsafe and the
+        caller must fall back to a full copy. Unsafe means: the target is
+        ahead of us (diverged zombie copy), the target's history doesn't
+        match ours at its checkpoint (log-matching check — the op at the
+        target's checkpoint must carry the term the target says it does),
+        or the retained translog no longer covers the whole suffix
+        (generations dropped by a flush commit)."""
+        with self._lock:
+            if checkpoint > self.seq.checkpoint:
+                return None  # target claims ops we never assigned/diverged
+            if checkpoint >= 0 and last_term is not None:
+                t = self.term_at(checkpoint)
+                if t is None or t != last_term:
+                    return None  # diverged history: full copy required
+            # coverage is judged against the max seq no AT THIS POINT;
+            # the log scan below runs OUTSIDE the engine lock so a
+            # recovery handshake never stalls client writes — ops that
+            # land during the scan reach the target via live fanout
+            # (phase-2 semantics), exactly like ops landing after the
+            # snapshot would
+            upper = self.seq.max_seq_no
+        by_seq: Dict[int, dict] = {}
+        try:
+            for op in self.translog.ops_above(checkpoint):
+                s = op["seq_no"]
+                prev = by_seq.get(s)
+                if prev is None or op.get("term", 0) >= prev.get("term", 0):
+                    by_seq[s] = op
+        except OSError:
+            return None  # unreadable log: full copy
+        need = range(checkpoint + 1, upper + 1)
+        if any(s not in by_seq for s in need):
+            return None  # retention gap (flushed away): full copy
+        return [by_seq[s] for s in sorted(by_seq) if s <= upper]
 
     # -- tragic events -----------------------------------------------------------
 
@@ -159,7 +318,10 @@ class Engine:
         timestamp: Optional[object] = None,
         ttl: Optional[object] = None,
         ttl_expiry: Optional[int] = None,
+        seq_no: Optional[int] = None,
+        primary_term: Optional[int] = None,
         _replay: bool = False,
+        _history: bool = False,
     ) -> Tuple[str, int, bool]:
         """Index/create a document. Returns (id, new_version, created).
 
@@ -167,10 +329,17 @@ class Engine:
         requires the provided version to equal the current one; external
         requires it to be strictly greater. op_type=create fails if the doc
         exists (DocWriteRequest.OpType.CREATE).
+
+        seq_no/primary_term: None on the primary (a fresh seq no is
+        assigned under the engine's current term); replicas, translog
+        replay, and recovery streams pass the primary-assigned identity
+        through — and an op from a stale term is rejected with
+        StalePrimaryException before any state mutates.
         """
         t0 = time.perf_counter()
         with self._lock:
             self._ensure_open()
+            op_term = self._fence_term(primary_term, history=_history)
             if doc_id is None:
                 self._auto_id += 1
                 doc_id = f"auto_{self._auto_id}_{int(time.time() * 1000)}"
@@ -203,6 +372,11 @@ class Engine:
                                        doc_type=doc_type, parent=parent,
                                        timestamp=timestamp, ttl=ttl,
                                        ttl_expiry=ttl_expiry)
+            # seq no assignment AFTER validation: a rejected op must not
+            # consume a number (we keep the primary's stream contiguous
+            # instead of logging no-ops for failures)
+            if seq_no is None:
+                seq_no = self.seq.generate()
             self._remove_existing(doc_id)
             local = self.buffer.add(parsed)
             self._buffer_ids[doc_id] = local
@@ -211,10 +385,12 @@ class Engine:
                 source=source, doc_type=doc_type, parent=parent, routing=routing,
                 timestamp=parsed.meta.get("timestamp"),
                 ttl_expiry=parsed.meta.get("ttl_expiry"),
+                seq_no=seq_no, term=op_term,
             )
             if not _replay:
                 entry = {"op": "index", "id": doc_id, "source": source,
-                         "version": new_version, "routing": routing}
+                         "version": new_version, "routing": routing,
+                         "seq_no": seq_no, "term": op_term}
                 if doc_type:
                     entry["doc_type"] = doc_type
                 if parent:
@@ -226,15 +402,23 @@ class Engine:
                 if "ttl_expiry" in parsed.meta:
                     entry["ttl_expiry"] = parsed.meta["ttl_expiry"]
                 self._translog_append(entry)
+            # checkpoint advances only once durability settled: a tragic
+            # append raised above and this op stays un-processed
+            self._note_op(op_term, seq_no)
             self.stats.index_total += 1
             self.stats.on_type(doc_type, "index_total")
             self.stats.index_time_ms += (time.perf_counter() - t0) * 1000
             return doc_id, new_version, not exists
 
     def delete(self, doc_id: str, version: Optional[int] = None,
-               version_type: str = "internal", _replay: bool = False) -> int:
+               version_type: str = "internal",
+               seq_no: Optional[int] = None,
+               primary_term: Optional[int] = None,
+               _replay: bool = False,
+               _history: bool = False) -> int:
         with self._lock:
             self._ensure_open()
+            op_term = self._fence_term(primary_term, history=_history)
             doc_id = str(doc_id)
             loc = self._locations.get(doc_id)
             if loc is None or loc.deleted:
@@ -250,15 +434,22 @@ class Engine:
                 if version_type == "external_gte" and version < loc.version:
                     raise VersionConflictException("", doc_id, loc.version,
                                                    version)
+            if seq_no is None:
+                seq_no = self.seq.generate()
             self._remove_existing(doc_id)
             if version is not None and version_type in (
                     "external", "external_gt", "external_gte", "force"):
                 new_version = version  # external deletes stamp the version
             else:
                 new_version = loc.version + 1
-            self._locations[doc_id] = DocLocation(version=new_version, deleted=True, where=None)
+            self._locations[doc_id] = DocLocation(
+                version=new_version, deleted=True, where=None,
+                seq_no=seq_no, term=op_term)
             if not _replay:
-                self._translog_append({"op": "delete", "id": doc_id, "version": new_version})
+                self._translog_append({"op": "delete", "id": doc_id,
+                                       "version": new_version,
+                                       "seq_no": seq_no, "term": op_term})
+            self._note_op(op_term, seq_no)
             self.stats.delete_total += 1
             self.stats.on_type(loc.doc_type, "delete_total")
             return new_version
@@ -271,7 +462,8 @@ class Engine:
                parent: Optional[str] = None, version: Optional[int] = None,
                version_type: str = "internal",
                timestamp: Optional[object] = None,
-               ttl: Optional[object] = None) -> Tuple[int, bool]:
+               ttl: Optional[object] = None,
+               primary_term: Optional[int] = None) -> Tuple[int, bool]:
         """Partial update (RestUpdateAction semantics): merge `partial` into
         the current source, or create from `upsert` when missing. Only
         internal versioning applies (reference: UpdateRequest.validate
@@ -300,12 +492,14 @@ class Engine:
                             script, script_params or {}, up)
                     _, v, _ = self.index(doc_id, up, doc_type=doc_type,
                                          routing=routing, parent=parent,
-                                         timestamp=timestamp, ttl=ttl)
+                                         timestamp=timestamp, ttl=ttl,
+                                         primary_term=primary_term)
                     return v, True
                 if doc_as_upsert and partial is not None:
                     _, v, _ = self.index(doc_id, partial, doc_type=doc_type,
                                          routing=routing, parent=parent,
-                                         timestamp=timestamp, ttl=ttl)
+                                         timestamp=timestamp, ttl=ttl,
+                                         primary_term=primary_term)
                     return v, True
                 raise DocumentMissingException("", doc_id)
             if version is not None and got["_version"] != version:
@@ -325,6 +519,7 @@ class Engine:
                 doc_type=loc.doc_type if loc else doc_type,
                 parent=(loc.parent if loc and loc.parent else parent),
                 timestamp=timestamp, ttl=ttl,
+                primary_term=primary_term,
             )
             return v, False
 
@@ -571,22 +766,68 @@ class Engine:
             if found and len(found) >= 1:
                 self.merge(subset=found)
 
-    def recover_from_translog(self):
-        """Replay the translog (crash recovery / shard recovery)."""
+    def recover_from_translog(self) -> int:
+        """Replay the translog (crash recovery / shard recovery). Frames
+        carry (term, seq_no), so replay restores the seq-no tracker, the
+        per-term history, AND the primary term itself — a term bump
+        survives engine close/reopen. Returns ops replayed."""
+        from elasticsearch_tpu.index.seqno import UNASSIGNED_SEQ_NO
+
+        replayed = 0
+        max_term = 0
         with self._lock:
             for op in self.translog.replay():
+                max_term = max(max_term, op.get("term", 0))
+                # legacy (pre-seqno) frames stay UNASSIGNED: minting a
+                # fresh number here would fabricate checkpoint/term
+                # history the primary never assigned, and a later
+                # log-matching handshake could falsely pass on it
+                seq = op.get("seq_no", UNASSIGNED_SEQ_NO)
+                seq = UNASSIGNED_SEQ_NO if seq is None else seq
                 if op["op"] == "index":
                     self.index(op["id"], op["source"], routing=op.get("routing"),
                                doc_type=op.get("doc_type"), parent=op.get("parent"),
                                timestamp=op.get("timestamp"),
                                ttl_expiry=op.get("ttl_expiry"),
-                               _replay=True)
+                               seq_no=seq,
+                               primary_term=op.get("term"),
+                               _replay=True, _history=True)
                     self._locations[op["id"]].version = op["version"]
+                    replayed += 1
                 elif op["op"] == "delete":
                     try:
-                        self.delete(op["id"], _replay=True)
+                        self.delete(op["id"], seq_no=seq,
+                                    primary_term=op.get("term"),
+                                    _replay=True, _history=True)
+                        replayed += 1
                     except DocumentMissingException:
                         pass
+            # the highest term in the log IS this copy's term: a bump
+            # survives close/reopen
+            self.bump_term(max_term)
+        return replayed
+
+    def apply_translog_op(self, op: dict) -> None:
+        """Apply ONE foreign translog op (the ops-based peer-recovery
+        stream): the op's own version rides external_gte so a newer state
+        already on this copy (a racing live-fanout write) wins, and its
+        (term, seq_no) identity is preserved. Raises VersionConflict /
+        DocumentMissing for the caller to count as already-newer skips."""
+        if op["op"] == "delete":
+            self.delete(op["id"], version=op.get("version"),
+                        version_type="external_gte" if op.get("version")
+                        is not None else "internal",
+                        seq_no=op.get("seq_no"), primary_term=op.get("term"),
+                        _replay=True, _history=True)
+            return
+        self.index(op["id"], op["source"], version=op.get("version"),
+                   version_type="external_gte" if op.get("version")
+                   is not None else "internal",
+                   routing=op.get("routing"), doc_type=op.get("doc_type"),
+                   parent=op.get("parent"), timestamp=op.get("timestamp"),
+                   ttl_expiry=op.get("ttl_expiry"),
+                   seq_no=op.get("seq_no"), primary_term=op.get("term"),
+                   _replay=True, _history=True)
 
     def _charge_segment(self, seg) -> None:
         """Charge a fresh segment against the node HBM breaker; raises

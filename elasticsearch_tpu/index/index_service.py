@@ -50,6 +50,12 @@ class IndexService:
                                  eager_components=validate_analysis)
         self.aliases: Dict[str, dict] = {}
         self.data_path = data_path
+        # recovery execution record feeding GET {index}/_recovery and
+        # _cat/recovery (index/recovery.py::RecoveryRegistry) — created
+        # before the shards so gateway recovery in __init__ can record
+        from elasticsearch_tpu.index.recovery import RecoveryRegistry
+
+        self.recoveries = RecoveryRegistry()
         self.shards: List[IndexShard] = [
             IndexShard(name, i, self.mappings, self.analysis, data_path)
             for i in range(self.num_shards)
@@ -105,11 +111,27 @@ class IndexService:
         from elasticsearch_tpu.search.percolator import PERCOLATOR_TYPE
 
         for shard in self.shards:
-            shard.recover()
+            entry = self.recoveries.start(shard.shard_id, "gateway")
+            try:
+                entry["stage"] = "translog"
+                entry["ops_replayed"] = shard.recover()
+                self.recoveries.finish(entry)
+            except Exception:
+                # a failed replay (chaos fault, tragic translog) must not
+                # leave a ghost in-flight entry in ?active_only/gauges
+                self.recoveries.finish(entry, ok=False)
+                raise
         # replicas re-sync from the recovered primary (peer recovery)
         for group in self.groups:
             for replica in group.replicas:
-                recover_peer(group.primary.engine, replica.engine)
+                entry = self.recoveries.start(group.shard_id, "replica")
+                try:
+                    recover_peer(group.primary.engine, replica.engine,
+                                 entry)
+                    self.recoveries.finish(entry)
+                except Exception:
+                    self.recoveries.finish(entry, ok=False)
+                    raise
         for shard in self.shards:
             # rebuild the in-memory percolator registry from recovered docs
             for doc_id, loc in shard.engine._locations.items():
@@ -189,7 +211,8 @@ class IndexService:
             # must never reach the translog (it would poison recovery)
             self.percolator.validate(source)
         t0 = time.perf_counter()
-        rid, version, created, failed = group.index(doc_id, source, routing=routing, **kw)
+        rid, version, created, failed, seq_no, term = group.index(
+            doc_id, source, routing=routing, **kw)
         if is_perc:
             self.percolator.register(rid, source)
         self.slowlog.on_index((time.perf_counter() - t0) * 1000, rid)
@@ -198,6 +221,8 @@ class IndexService:
             "_type": kw.get("doc_type") or "_doc",
             "_id": rid,
             "_version": version,
+            "_seq_no": seq_no,
+            "_primary_term": term,
             "result": "created" if created else "updated",
             "created": created,
             "_shards": {"total": 1 + self.num_replicas,
@@ -249,7 +274,7 @@ class IndexService:
         loc = self.route(doc_id, routing).engine._locations.get(str(doc_id))
         dtype = (loc.doc_type if loc is not None and loc.doc_type
                  else "_doc")
-        version, _failed = group.delete(doc_id, **kw)
+        version, _failed, seq_no, term = group.delete(doc_id, **kw)
         if self._percolator is not None:
             self._percolator.unregister(str(doc_id))
         return {
@@ -257,6 +282,8 @@ class IndexService:
             "_type": dtype,
             "_id": doc_id,
             "_version": version,
+            "_seq_no": seq_no,
+            "_primary_term": term,
             "result": "deleted",
             "found": True,
             "_shards": {"total": 1 + self.num_replicas,
@@ -432,8 +459,14 @@ class IndexService:
             was_perc = (loc is not None and not loc.deleted
                         and loc.doc_type == PERCOLATOR_TYPE)
             if d.get("deleted"):
+                # _history: a recovery stream replays recorded identity —
+                # ops below the copy's current term are catch-up, not a
+                # zombie write (the live-op fence lives in the replica
+                # handler / engine fence for non-history ops)
                 engine.delete(d["id"], version=d["version"],
-                              version_type="external_gte")
+                              version_type="external_gte",
+                              seq_no=d.get("seq_no"),
+                              primary_term=d.get("term"), _history=True)
             else:
                 engine.index(d["id"], d["source"], version=d["version"],
                              version_type="external_gte",
@@ -441,7 +474,10 @@ class IndexService:
                              parent=d.get("parent"),
                              routing=d.get("routing"),
                              ttl_expiry=d.get("ttl_expiry"),
-                             timestamp=d.get("timestamp"), _replay=True)
+                             timestamp=d.get("timestamp"),
+                             seq_no=d.get("seq_no"),
+                             primary_term=d.get("term"),
+                             _replay=True, _history=True)
             now = engine._locations.get(d["id"])
             is_perc = (now is not None and not now.deleted
                        and now.doc_type == PERCOLATOR_TYPE)
@@ -721,6 +757,9 @@ class IndexService:
                 if c is g.primary:
                     continue
                 _merge_counters(st["search"], c.searcher.stats.to_json())
+            # the group-level global checkpoint joins the per-copy seq-no
+            # stats (reference: SeqNoStats carries all three)
+            st["seq_no"]["global_checkpoint"] = g.global_checkpoint
         total_docs = sum(st["docs"]["count"] for st in shard_stats)
         return {
             "primaries": {

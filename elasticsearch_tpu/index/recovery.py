@@ -1,43 +1,194 @@
 """Shard recovery: local (translog replay) and peer (primary → replica).
 
 Reference: org/elasticsearch/indices/recovery/RecoverySourceHandler.java /
-RecoveryTarget.java — peer recovery phase 1 copies segment files, phase 2
-replays the translog operations that arrived during the copy; local
-recovery (gateway) replays the on-disk translog into a fresh engine.
+RecoveryTarget.java. In the seq-no era peer recovery is CHECKPOINT-BASED:
+the target reports its local checkpoint, the source runs a log-matching
+check (the op at the target's checkpoint must carry the term the target
+recorded for it), and when the retained translog covers the whole suffix
+the source replays ONLY the ops above the checkpoint. The pre-seqno
+full copy — ship every live doc and re-index on the target — survives as
+the fallback for diverged copies, flushed-away ops, and legacy frames.
 
-TPU adaptation: segments are derived from sources, so "copying segment
-files" = shipping each live root doc (id, source, version, _type/_parent/
-routing meta) and re-indexing it on the target with external_gte
-versioning — the target's SegmentBuilder regenerates identical device
-arrays. Phase 2 falls out for free: ops indexed on the primary during the
-copy simply win the version comparison on the target.
+TPU adaptation: "copying segment files" = shipping each live root doc
+(id, source, version, seq_no, term, _type/_parent/routing meta) and
+re-indexing it with external_gte versioning — the target's SegmentBuilder
+regenerates identical device arrays. That regeneration is exactly why
+ops-replay matters here: BM25S-style eager device scoring makes a
+segment rebuild expensive, so a node bounce must not be a full-copy
+storm. Ops indexed on the source during either mode win the version
+comparison on the target (phase 2 for free).
 """
 from __future__ import annotations
 
+import threading
+import time
+from collections import deque
 from typing import Optional
 
 from elasticsearch_tpu.tracing import check_cancelled
-from elasticsearch_tpu.utils.errors import VersionConflictException
+from elasticsearch_tpu.utils.errors import (
+    DocumentMissingException,
+    VersionConflictException,
+)
+from elasticsearch_tpu.utils.faults import FAULTS
 
 
-def recover_peer(source_engine, target_engine) -> dict:
-    """Copy the source engine's live docs into the target (phase 1 + 2).
+class RecoveryRegistry:
+    """Per-index record of recovery executions, feeding the real
+    ``GET {index}/_recovery`` / ``_cat/recovery`` endpoints (reference:
+    RecoveriesCollection + RecoveryState). Entries are plain dicts the
+    running recovery mutates in place:
 
-    Returns recovery stats (docs copied / skipped). Cooperatively
-    cancellable between docs (tracing/tasks.py) — an aborted stream
-    leaves the target partially synced but versioned, so a later retry
-    resumes idempotently."""
+        shard, type ("gateway"|"replica"|"peer"), mode ("ops"|"full"),
+        stage ("init"|"index"|"translog"|"finalize"|"done"|"failed"),
+        source, target, ops_replayed, docs_copied, docs_skipped,
+        start_millis, total_time_in_millis
+
+    ``mode`` is the acceptance-visible bit: "ops" proves the recovery
+    replayed a translog suffix instead of re-shipping the shard."""
+
+    def __init__(self, max_entries: int = 64):
+        self._lock = threading.Lock()
+        self._entries: "deque[dict]" = deque(maxlen=max_entries)
+        # in-flight streams this index is SERVING (recovery source side);
+        # target-side state lives in the entries themselves
+        self._source_active = 0
+
+    def source_started(self) -> None:
+        with self._lock:
+            self._source_active += 1
+
+    def source_finished(self) -> None:
+        with self._lock:
+            self._source_active = max(0, self._source_active - 1)
+
+    @property
+    def source_active(self) -> int:
+        with self._lock:
+            return self._source_active
+
+    def start(self, shard: int, rtype: str, source: str = "local",
+              target: str = "local") -> dict:
+        entry = {"shard": shard, "type": rtype, "mode": None,
+                 "stage": "init", "source": source, "target": target,
+                 "ops_replayed": 0, "docs_copied": 0, "docs_skipped": 0,
+                 "start_millis": int(time.time() * 1000),
+                 "total_time_in_millis": 0, "_t0": time.perf_counter()}
+        with self._lock:
+            self._entries.append(entry)
+        return entry
+
+    @staticmethod
+    def finish(entry: dict, ok: bool = True) -> None:
+        entry["total_time_in_millis"] = int(
+            (time.perf_counter() - entry.pop("_t0", time.perf_counter()))
+            * 1000)
+        entry["stage"] = "done" if ok else "failed"
+
+    def entries(self, shard: Optional[int] = None) -> list:
+        with self._lock:
+            out = [dict(e) for e in self._entries]
+        if shard is not None:
+            out = [e for e in out if e["shard"] == shard]
+        return out
+
+    def latest_for(self, shard: int) -> Optional[dict]:
+        with self._lock:
+            for e in reversed(self._entries):
+                if e["shard"] == shard:
+                    return dict(e)
+        return None
+
+    def current(self) -> list:
+        return [e for e in self.entries()
+                if e["stage"] not in ("done", "failed")]
+
+
+def recover_peer(source_engine, target_engine,
+                 entry: Optional[dict] = None) -> dict:
+    """Sync the target copy from the source (phase 1 + 2).
+
+    Checkpoint handshake first: if the target's history is a clean prefix
+    of the source's and the source's translog still holds every op above
+    the target's local checkpoint, replay just that suffix
+    (``mode="ops"``). Otherwise fall back to the full doc copy
+    (``mode="full"``) — which ships TOMBSTONES too, so a target that
+    already held a doc from an earlier aborted recovery still sees a
+    delete that landed mid-copy, and prunes stale-era docs the source no
+    longer has. Cooperatively cancellable between ops/docs
+    (tracing/tasks.py); an aborted stream leaves the target partially
+    synced but versioned, so a later retry resumes idempotently.
+
+    Returns recovery stats; ``entry`` (a RecoveryRegistry dict) is
+    mutated with live stage/counters when provided."""
+    entry = entry if entry is not None else {}
+    ckpt = target_engine.local_checkpoint
+    ops = source_engine.recovery_ops(ckpt, target_engine.term_at(ckpt))
+    if ops is not None:
+        entry.update(mode="ops", stage="translog")
+        replayed = skipped = 0
+        for op in ops:
+            check_cancelled()
+            FAULTS.check("recovery.ops_replay", seq_no=op.get("seq_no"),
+                         index=getattr(source_engine, "index_name", ""))
+            try:
+                target_engine.apply_translog_op(op)
+                replayed += 1
+            except (VersionConflictException, DocumentMissingException):
+                # newer state already covers this op: a NO-OP, but its
+                # seq no still counts as processed or the checkpoint
+                # stalls on the hole forever
+                target_engine.note_noop(op.get("seq_no"), op.get("term"))
+                skipped += 1
+            entry["ops_replayed"] = replayed
+            entry["docs_skipped"] = skipped
+        # an idle promoted primary has a newer term but no ops yet: the
+        # term still propagates so the copy fences its old primary
+        target_engine.bump_term(source_engine.primary_term)
+        entry["stage"] = "finalize"
+        target_engine.refresh()
+        return {"mode": "ops", "ops_replayed": replayed, "skipped": skipped,
+                "copied": 0}
+    return _recover_full_copy(source_engine, target_engine, entry)
+
+
+def _recover_full_copy(source_engine, target_engine, entry: dict) -> dict:
+    """The pre-seqno stream: snapshot (ids + tombstones) and re-index.
+    Concurrent writes during recovery are handled by versioning, not by
+    locking the whole copy."""
+    entry.update(mode="full", stage="index")
     copied = skipped = 0
-    # snapshot the id list first: concurrent writes during recovery are
-    # handled by versioning, not by locking the whole copy
     with source_engine._lock:
-        ids = [(doc_id, loc.version, loc.doc_type, loc.parent, loc.routing)
-               for doc_id, loc in source_engine._locations.items()
-               if not loc.deleted]
-    for doc_id, version, doc_type, parent, routing in ids:
+        snapshot = [(doc_id, loc.version, loc.doc_type, loc.parent,
+                     loc.routing, loc.deleted, loc.seq_no, loc.term)
+                    for doc_id, loc in source_engine._locations.items()]
+        src_term = source_engine.primary_term
+        src_ckpt = source_engine.local_checkpoint
+        src_term_seq = dict(source_engine._term_seq)
+    snapshot_ids = {doc_id for doc_id, *_ in snapshot}
+    for doc_id, version, doc_type, parent, routing, deleted, seq_no, term \
+            in snapshot:
         check_cancelled()
+        if deleted:
+            # tombstones ride the stream: a target holding the doc from
+            # an earlier aborted recovery must see the delete (the id
+            # snapshot used to drop these — docs deleted mid-copy were
+            # lost to such targets forever)
+            try:
+                target_engine.delete(doc_id, version=version,
+                                     version_type="external_gte",
+                                     seq_no=seq_no, primary_term=term,
+                                     _replay=True, _history=True)
+            except DocumentMissingException:
+                # target never held it: nothing to tombstone, but the
+                # op's seq no is still processed (no-op)
+                target_engine.note_noop(seq_no, term)
+            except VersionConflictException:
+                target_engine.note_noop(seq_no, term)
+                skipped += 1
+            continue
         got = source_engine.get(doc_id)
-        if got is None:  # deleted mid-recovery; phase-2 op will handle it
+        if got is None:  # deleted mid-copy: its tombstone fans out live
             skipped += 1
             continue
         try:
@@ -45,13 +196,47 @@ def recover_peer(source_engine, target_engine) -> dict:
                 doc_id, got["_source"], version=version,
                 version_type="external_gte",
                 doc_type=doc_type, parent=parent, routing=routing,
-                _replay=True,
+                seq_no=seq_no, primary_term=term,
+                _replay=True, _history=True,
             )
             copied += 1
         except VersionConflictException:
+            target_engine.note_noop(seq_no, term)
             skipped += 1  # target already has a newer op
+        entry["docs_copied"] = copied
+        entry["docs_skipped"] = skipped
+    # prune stale-era docs the source no longer has: a diverged copy (a
+    # demoted primary that acked nothing but applied locally) may hold
+    # docs from an OLDER term, which external_gte can never remove. Docs
+    # from the current term above the snapshot horizon are live-fanout
+    # arrivals racing this copy and must survive.
+    with target_engine._lock:
+        extras = [(doc_id, loc.seq_no, loc.term)
+                  for doc_id, loc in target_engine._locations.items()
+                  if not loc.deleted and doc_id not in snapshot_ids
+                  and (loc.term < src_term
+                       or (loc.term == src_term and 0 <= loc.seq_no
+                           <= src_ckpt))]
+    for doc_id, stale_seq, stale_term in extras:
+        try:
+            # the tombstone reuses the pruned doc's own (seq no, term):
+            # this is a local cleanup, not a replicated op — it must not
+            # consume a number from the primary's stream nor extend a
+            # term's recorded history (same rule as the distributed twin
+            # in search_action._on_recover)
+            target_engine.delete(doc_id, version_type="force", version=0,
+                                 seq_no=stale_seq,
+                                 primary_term=stale_term,
+                                 _replay=True, _history=True)
+        except DocumentMissingException:
+            pass
+    # the target now mirrors the source's state wholesale: adopt its
+    # checkpoint + per-term history so the NEXT recovery can be ops-based
+    target_engine.adopt_seq_state(src_term_seq, src_ckpt, src_term)
+    entry["stage"] = "finalize"
     target_engine.refresh()
-    return {"copied": copied, "skipped": skipped}
+    return {"mode": "full", "copied": copied, "skipped": skipped,
+            "ops_replayed": 0}
 
 
 def recover_local(shard) -> None:

@@ -35,11 +35,12 @@ class IndexShard:
                                       shard_ord=shard_id, index_name=index_name)
         self.state = "STARTED"
 
-    def recover(self):
+    def recover(self) -> int:
         self.state = "RECOVERING"
-        self.engine.recover_from_translog()
+        replayed = self.engine.recover_from_translog()
         self.engine.refresh()
         self.state = "STARTED"
+        return replayed
 
     @property
     def segments(self):
@@ -97,6 +98,9 @@ class IndexShard:
             # full TranslogStats shape (ops/generation/bytes/last_sync +
             # tragic/corruption accounting) for the monitor endpoint
             "translog": self.engine.translog.stats(),
+            # replication safety (reference: SeqNoStats in the _stats
+            # shards level): what checkpoint-based recovery negotiates on
+            "seq_no": self.engine.seq_no_stats(),
             # Lucene CommitStats analogue: stable engine identity +
             # refresh/flush generation (the `shards` level echoes it)
             "commit": {"id": self.engine.commit_id,

@@ -208,6 +208,19 @@ class Translog:
         for gen in range(from_generation, self.generation + 1):
             yield from self._iter_file(self._gen_path(gen), on_corrupt)
 
+    def ops_above(self, seq_no: int) -> Iterator[dict]:
+        """Yield retained ops whose sequence number exceeds ``seq_no`` —
+        the raw material of checkpoint-based peer recovery (reference:
+        Translog.newSnapshot(fromSeqNo) in the seq-no era). Frames
+        without a seq_no (legacy v1/v2 pre-seqno ops) are skipped: the
+        caller detects the resulting coverage gap and falls back to a
+        full copy. ``commit()`` dropping old generations is what bounds
+        this — ops flushed away are gone, by design."""
+        for op in self.replay():
+            s = op.get("seq_no")
+            if s is not None and s > seq_no:
+                yield op
+
     @staticmethod
     def _iter_file(p: str,
                    on_corrupt: Optional[Callable[[str, int, str], None]]

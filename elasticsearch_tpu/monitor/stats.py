@@ -153,6 +153,34 @@ def aggregate_slowlog(index_services) -> dict:
             "indexing_slow_total": indexing_total}
 
 
+def aggregate_recovery(index_services) -> dict:
+    """Per-NODE recovery gauges aggregated from the node's own indices'
+    RecoveryRegistry entries (index/recovery.py) — the same per-node
+    discipline translog_recovery and slowlog follow. ``incremental``
+    counts ops-mode (checkpoint-based) recoveries; ``full_copies`` the
+    fallback streams — the ratio is the replication-safety win made
+    visible (reference: RecoveryStats current_as_source/target)."""
+    out = {"current_as_source": 0, "current_as_target": 0,
+           "total": 0, "incremental": 0, "full_copies": 0,
+           "ops_replayed": 0, "docs_copied": 0}
+    for svc in index_services:
+        reg = getattr(svc, "recoveries", None)
+        if reg is None:
+            continue
+        out["current_as_source"] += getattr(reg, "source_active", 0)
+        for e in reg.entries():
+            out["total"] += 1
+            if e["stage"] not in ("done", "failed"):
+                out["current_as_target"] += 1
+            if e.get("mode") == "ops":
+                out["incremental"] += 1
+            elif e.get("mode") == "full":
+                out["full_copies"] += 1
+            out["ops_replayed"] += e.get("ops_replayed", 0)
+            out["docs_copied"] += e.get("docs_copied", 0)
+    return out
+
+
 def process_stats() -> dict:
     """Process-level stats (reference: ProcessService → _nodes/stats.process)."""
     import resource

@@ -577,6 +577,7 @@ class Node:
 
     def nodes_stats(self) -> dict:
         from elasticsearch_tpu.monitor.stats import (TRANSLOG_RECOVERY,
+                                                     aggregate_recovery,
                                                      aggregate_slowlog,
                                                      device_stats, os_stats,
                                                      process_stats)
@@ -647,6 +648,11 @@ class Node:
                                 TRANSLOG_RECOVERY.to_json()["events"]
                                 if self._owns_translog_path(e["path"])],
                         },
+                        # recovery accounting: incremental (ops-replay)
+                        # vs full-copy streams, from this node's own
+                        # RecoveryRegistry entries
+                        "recovery": aggregate_recovery(
+                            self.indices.values()),
                     },
                     "process": proc,
                     "os": os_stats(),

@@ -858,6 +858,10 @@ _STATS_SECTIONS = {
     "suggest": {"total": 0, "time_in_millis": 0, "current": 0},
     "recovery": {"current_as_source": 0, "current_as_target": 0,
                  "throttle_time_in_millis": 0},
+    # replication safety (index/seqno.py): what checkpoint-based
+    # recovery negotiates on (reference: SeqNoStats)
+    "seq_no": {"max_seq_no": -1, "local_checkpoint": -1,
+               "global_checkpoint": -1, "primary_term": 0},
     "query_cache": {"memory_size_in_bytes": 0, "evictions": 0,
                     "hit_count": 0, "miss_count": 0},
 }
@@ -1351,26 +1355,41 @@ def _cat_segments(n: Node, p, b, index: Optional[str] = None):
 
 
 def _cat_recovery(n: Node, p, b, index: Optional[str] = None):
+    """Real rows from each index's RecoveryRegistry: `type` distinguishes
+    checkpoint-based ops replay (`ops_replay`) from the full-copy
+    fallback (`full_copy`) and gateway translog replay; `translog` is the
+    actual ops-replayed count. Shards with no recorded recovery keep the
+    synthetic done/gateway row."""
     rows = []
     for iname in _cat_scope(n, index):
         svc = n.indices[iname]
         for g in svc.groups:
-            for sh in g.copies:
-                rtype = ("gateway" if (sh is g.primary and svc.data_path)
-                         else "replica" if sh is not g.primary
-                         else "gateway")
+            entries = svc.recoveries.entries(g.shard_id)
+            if not entries:
+                entries = [{"type": "gateway", "stage": "done",
+                            "source": "local", "target": "local",
+                            "ops_replayed": 0, "docs_copied": 0,
+                            "total_time_in_millis": 0, "mode": None}]
+            for e in entries:
+                mode = e.get("mode")
+                rtype = ("ops_replay" if mode == "ops"
+                         else "full_copy" if mode == "full"
+                         else e.get("type", "gateway"))
                 rows.append({
-                    "index": iname, "shard": str(sh.shard_id), "time": "0",
+                    "index": iname, "shard": str(g.shard_id),
+                    "time": str(e.get("total_time_in_millis", 0)),
                     "type": rtype,
-                    "stage": ("done" if sh.state == "STARTED"
-                              else sh.state.lower()),
-                    "source_host": "localhost", "target_host": "localhost",
+                    "stage": e.get("stage", "done"),
+                    "source_host": str(e.get("source", "localhost")),
+                    "target_host": str(e.get("target", "localhost")),
                     "repository": "n/a", "snapshot": "n/a",
                     "files": "0", "files_percent": "100.0%",
-                    "bytes": "0", "bytes_percent": "100.0%",
+                    "bytes": str(e.get("docs_copied", 0)),
+                    "bytes_percent": "100.0%",
                     "total_files": "0", "total_bytes": "0",
-                    "translog": "0", "translog_percent": "-1.0%",
-                    "total_translog": "-1"})
+                    "translog": str(e.get("ops_replayed", 0)),
+                    "translog_percent": "100.0%",
+                    "total_translog": str(e.get("ops_replayed", 0))})
     return 200, rows
 
 
@@ -3885,47 +3904,89 @@ def _segments_json(n: Node, p, b, index: Optional[str] = None):
                              "failed": 0}}
 
 
+def _recovery_entry_json(n: Node, sh, primary: bool, e: dict) -> dict:
+    """One RecoveryState row (reference: RecoveryState.toXContent) built
+    from a RecoveryRegistry entry. ``mode``/``ops_replayed`` are the
+    replication-safety extras: mode "ops" with translog.recovered < the
+    shard's doc count PROVES the recovery replayed a checkpoint suffix
+    instead of re-shipping the shard."""
+    type_map = {"gateway": "GATEWAY", "replica": "REPLICA",
+                "peer": "REPLICA"}
+    size = sum(seg.memory_bytes() for seg in sh.segments)
+    full = e.get("mode") == "full"
+    docs = e.get("docs_copied", 0)
+    ops = e.get("ops_replayed", 0)
+    return {
+        "id": sh.shard_id, "type": type_map.get(e["type"], "REPLICA"),
+        "mode": e.get("mode") or ("translog" if e["type"] == "gateway"
+                                  else None),
+        "primary": primary,
+        "stage": e["stage"].upper(),
+        "source": ({} if e.get("source") in (None, "local")
+                   else {"id": e["source"]}),
+        "target": {"id": n.node_id, "name": n.name,
+                   "ip": "127.0.0.1", "host": "localhost"},
+        "start_time_in_millis": e.get("start_millis", 0),
+        "total_time_in_millis": e.get("total_time_in_millis", 0),
+        "index": {
+            "files": {"total": 0, "reused": 0, "recovered": 0,
+                      "percent": "100.0%"},
+            "size": {"total_in_bytes": size,
+                     "reused_in_bytes": 0 if full else size,
+                     "recovered_in_bytes": size if full else 0,
+                     "percent": "100.0%"},
+            "docs_recovered": docs,
+            "docs_skipped": e.get("docs_skipped", 0),
+            "source_throttle_time_in_millis": 0,
+            "target_throttle_time_in_millis": 0,
+            "total_time_in_millis": e.get("total_time_in_millis", 0),
+        },
+        "translog": {
+            "recovered": ops,
+            "total": ops,
+            "total_on_start": ops,
+            "percent": "100.0%",
+            "total_time_in_millis": e.get("total_time_in_millis", 0),
+        },
+        "verify_index": {"check_index_time_in_millis": 0,
+                         "total_time_in_millis": 0},
+        # what checkpoint-based recovery negotiates on (index/seqno.py)
+        "seq_no": sh.engine.seq_no_stats(),
+    }
+
+
 def _recovery_json(n: Node, p, b, index: Optional[str] = None):
-    """RestRecoveryAction: the 2.0 RecoveryState JSON — type GATEWAY for
-    a primary recovered from local state (the 2.0 name; EMPTY_STORE is
-    the 5.x rename), REPLICA for copies, with the full index/translog/
-    verify_index timing sections."""
+    """RestRecoveryAction: real RecoveryState JSON driven by each index's
+    RecoveryRegistry (index/recovery.py) — type GATEWAY for a primary
+    recovered from local state (the 2.0 name; EMPTY_STORE is the 5.x
+    rename), REPLICA for copies, with stage/mode/ops counters from the
+    actual recovery executions. ?active_only=true filters to in-flight
+    streams (the reference param)."""
+    active_only = str(p.get("active_only", "false")).lower() \
+        in ("", "true")
     out = {}
     for iname in _resolve_indices_options(n, index, p):
         svc = n.indices[iname]
         shards = []
         for g in svc.groups:
-            for sh in g.copies:
-                rtype = "GATEWAY" if sh is g.primary else "REPLICA"
-                size = sum(seg.memory_bytes() for seg in sh.segments)
-                shards.append({
-                    "id": sh.shard_id, "type": rtype,
-                    "primary": sh is g.primary,
-                    "stage": "DONE" if sh.state == "STARTED" else sh.state,
-                    "source": {},
-                    "target": {"id": n.node_id, "name": n.name,
-                               "ip": "127.0.0.1", "host": "localhost"},
-                    "index": {
-                        "files": {"total": 0, "reused": 0, "recovered": 0,
-                                  "percent": "100.0%"},
-                        "size": {"total_in_bytes": size,
-                                 "reused_in_bytes": 0,
-                                 "recovered_in_bytes": size,
-                                 "percent": "100.0%"},
-                        "source_throttle_time_in_millis": 0,
-                        "target_throttle_time_in_millis": 0,
-                        "total_time_in_millis": 0,
-                    },
-                    "translog": {
-                        "recovered": sh.engine.translog.size_in_ops,
-                        "total": sh.engine.translog.size_in_ops,
-                        "total_on_start": sh.engine.translog.size_in_ops,
-                        "percent": "100.0%",
-                        "total_time_in_millis": 0,
-                    },
-                    "verify_index": {"check_index_time_in_millis": 0,
-                                     "total_time_in_millis": 0},
-                })
+            entries = svc.recoveries.entries(g.shard_id)
+            if active_only:
+                entries = [e for e in entries
+                           if e["stage"] not in ("done", "failed")]
+            for e in entries:
+                tgt = g.primary
+                if e["type"] == "replica" and g.replicas:
+                    tgt = g.replicas[0]
+                shards.append(_recovery_entry_json(
+                    n, tgt, e["type"] == "gateway", e))
+            if not entries and not active_only:
+                # no recorded recovery (a fresh in-memory shard): a
+                # synthetic DONE gateway row keeps the 2.0 shape
+                for sh in g.copies:
+                    shards.append(_recovery_entry_json(
+                        n, sh, sh is g.primary,
+                        {"type": "gateway" if sh is g.primary
+                         else "replica", "stage": "done"}))
         out[iname] = {"shards": shards}
     return 200, out
 

@@ -150,6 +150,29 @@ class EngineFailedException(ElasticsearchTpuException):
         self.reason = reason
 
 
+class StalePrimaryException(ElasticsearchTpuException):
+    """An op carried a primary term older than the receiving copy's
+    current term: the sender was demoted (node death → reroute promoted
+    another in-sync copy) but doesn't know it yet. Rejecting with a typed
+    conflict closes the zombie-primary window — a demoted primary can
+    never silently ack a write its replacement will not have. Reference:
+    the seq-no era's operation-primary-term fencing in
+    TransportReplicationAction / InternalEngine (IndexShard asserts
+    opPrimaryTerm <= pendingPrimaryTerm and fails the op otherwise)."""
+
+    status = 409
+
+    def __init__(self, index: str, shard_id: object, op_term: int,
+                 current_term: int):
+        super().__init__(
+            f"[{index or '_na_'}][{shard_id}]: op with primary term "
+            f"[{op_term}] is stale, current term is [{current_term}]")
+        self.index = index
+        self.shard_id = shard_id
+        self.op_term = op_term
+        self.current_term = current_term
+
+
 class CircuitBreakingException(ElasticsearchTpuException):
     """Reference: org/elasticsearch/common/breaker/CircuitBreaker.java —
     a memory budget would be exceeded; the REQUEST fails (429-style), the

@@ -22,6 +22,12 @@ Registered injection points (see docs/ROBUSTNESS.md for the catalogue):
     translog.fsync        in place of the durability fsync
     segment.freeze        before a refresh freezes the RAM buffer
     recovery.shard_sync   before a recovery source streams its shard
+    recovery.ops_replay   before each op of a checkpoint-based recovery
+                          replay lands on the target (index/recovery.py,
+                          cluster/search_action.py::_on_recover)
+    replication.fanout    before a primary fans an op out to one replica
+                          copy (cluster/replication.py::_fanout,
+                          search_action.py::_primary_write)
     resources.reserve     before a residency breaker reservation (device
                           memory admission — resources/residency.py)
 """
@@ -40,6 +46,8 @@ POINTS = frozenset({
     "translog.fsync",
     "segment.freeze",
     "recovery.shard_sync",
+    "recovery.ops_replay",
+    "replication.fanout",
     "resources.reserve",
 })
 

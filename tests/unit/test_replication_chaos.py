@@ -1,0 +1,287 @@
+"""Replication-safety chaos matrix (tier-1, seed-deterministic).
+
+The kill-primary and rejoin-recovery scenarios run under a FIXED SEED
+MATRIX in the normal pytest gate: the `transport.send` kill fault draws
+from `random.Random(seed)` (utils/faults.py), so a regression replays
+identically instead of needing a manual soak. The invariants asserted
+are seed-independent:
+
+- killing a primary mid-bulk and promoting the replica loses ZERO
+  acknowledged ops (unacked ops may or may not survive — that's what
+  "unacknowledged" means)
+- a write raced to the demoted-but-unaware primary is fenced with a
+  typed 409 `stale_primary_exception`, never silently acked
+- the bounced node rejoins via CHECKPOINT-BASED recovery: `_recovery`
+  counters prove ops replayed < docs in shard (no full-copy storm), and
+  a diverged zombie copy falls back to a pruning full copy
+
+Same in-process two-node-cluster harness as tests/unit/test_faults.py
+(ping_interval=0: node death is declared explicitly, deterministically).
+"""
+import json
+import socket
+import time
+
+import pytest
+
+from elasticsearch_tpu.cluster.transport import PeerBreaker, TransportError
+from elasticsearch_tpu.utils.errors import StalePrimaryException
+from elasticsearch_tpu.utils.faults import FAULTS
+
+#: the tier-1 chaos matrix — three fixed seeds, same grammar as
+#: ESTPU_FAULTS "transport.send:prob=0.6:seed=<s>" for subprocess runs
+CHAOS_SEEDS = [101, 202, 303]
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def replicated_cluster():
+    """Two MultiHostClusters in-process; index `evt` with 2 shards and 1
+    replica, so each node is primary for one shard and replica for the
+    other."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.node import Node
+
+    port = _free_port()
+    node0 = Node(name="rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port)
+    c0.data.create_index("evt", {
+        "settings": {"number_of_shards": 2, "number_of_replicas": 1},
+        "mappings": {"properties": {"n": {"type": "integer"}}}})
+    meta = c0.dist_indices["evt"]
+    assert all(len(v) == 2 for v in meta["assignment"].values()), meta
+    assert meta["in_sync"] == meta["assignment"]
+    assert meta["primary_terms"] == {"0": 1, "1": 1}
+    yield c0, c1
+    FAULTS.clear()
+    try:
+        c1.close()
+    finally:
+        c0.close()
+        node1.close()
+        node0.close()
+
+
+def _arm_kill(addr, prob, seed):
+    """Make every transport connect to `addr` fail with the seeded
+    probability — the deterministic stand-in for a dying node."""
+    host, port = addr
+    FAULTS.inject(
+        "transport.send", error=ConnectionRefusedError, count=-1,
+        prob=prob, seed=seed,
+        match=lambda ctx: ctx.get("address") == (host, port))
+
+
+def _kill_node(c0, c1):
+    """Declare node1 dead on the master (what the fault detector would
+    do after N failed pings) — promotes in-sync survivors, bumps terms."""
+    n1 = c0.node.cluster_state.nodes[c1.local.node_id]
+    c0._on_node_failed(n1)
+
+
+def _rejoin(c0, c1):
+    """Replicate the bootstrap join handshake for an already-running
+    member (bootstrap.MultiHostCluster.__init__'s non-master branch)."""
+    got = c1.transport.send_remote(
+        c1.master_addr, "cluster:join",
+        {"node_id": c1.local.node_id, "name": c1.node.name,
+         "transport_address": c1.local.transport_address})
+    c1._adopt(got["nodes"], got.get("version", 0))
+    c1._adopt_indices(got.get("indices", {}), got.get("indices_version", 0))
+
+
+def _wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _bulk_with_midstream_kill(c0, c1, seed, n_docs=40, kill_at=10,
+                              prob=0.6):
+    """Index n_docs through the coordinator, arming the seeded kill fault
+    after `kill_at` acks. Returns the set of ACKNOWLEDGED doc ids."""
+    acked = set()
+    for i in range(n_docs):
+        if i == kill_at:
+            host, port = c1.local.transport_address.rsplit(":", 1)
+            _arm_kill((host, int(port)), prob, seed)
+        doc_id = f"d{i}"
+        try:
+            res = c0.data.index_doc("evt", doc_id, {"n": i})
+            assert res.get("_seq_no") is not None
+            acked.add(doc_id)
+        except (TransportError, OSError):
+            pass  # unacked: the client was TOLD it failed
+    return acked
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_kill_primary_mid_bulk_zero_acked_loss_and_stale_fence(
+        replicated_cluster, seed):
+    c0, c1 = replicated_cluster
+    acked = _bulk_with_midstream_kill(c0, c1, seed)
+    assert acked, "no write acked at all"
+    old_terms = dict(c0.dist_indices["evt"]["primary_terms"])
+
+    _kill_node(c0, c1)
+    meta = c0.dist_indices["evt"]
+    # every shard now has the SURVIVOR as its primary, and every shard
+    # that changed hands runs under a BUMPED term
+    for sid in ("0", "1"):
+        assert meta["assignment"][sid][0] == c0.local.node_id
+        assert c0.local.node_id in meta["in_sync"][sid]
+    bumped = [sid for sid in ("0", "1")
+              if meta["primary_terms"][sid] > old_terms[sid]]
+    assert bumped, "no term bump despite a primary changing hands"
+
+    # ZERO acked-op loss: every acknowledged doc is served by the
+    # promoted copies (reads now route entirely to the survivor)
+    c0.node.indices["evt"].refresh()
+    for doc_id in sorted(acked):
+        got = c0.data.get_doc("evt", doc_id)
+        assert got.get("found"), f"ACKED doc {doc_id} lost after promotion"
+
+    # a write raced to the demoted-but-unaware primary: node1 still
+    # holds the stale metadata (the kill fault ate the publishes), so it
+    # applies locally and fans out — the promoted copy fences the stale
+    # term and the client gets a typed 409, NOT a silent ack
+    sid_old_primary = next(
+        sid for sid in ("0", "1") if meta["primary_terms"][sid]
+        > old_terms[sid])
+    assert c1.dist_indices["evt"]["assignment"][sid_old_primary][0] \
+        == c1.local.node_id, "node1 should still believe it is primary"
+    from elasticsearch_tpu.cluster.routing import shard_id_for
+
+    zombie_id = next(f"z{k}" for k in range(1000)
+                     if shard_id_for(f"z{k}", 2) == int(sid_old_primary))
+    with pytest.raises(Exception) as ei:
+        c1.data.index_doc("evt", zombie_id, {"n": -1})
+    assert getattr(ei.value, "error_type", "") == "stale_primary_exception"
+    assert getattr(ei.value, "status", 0) == 409
+    # the promoted primary never saw the fenced write
+    assert not c0.node.indices["evt"].shards[int(sid_old_primary)] \
+        .engine.exists(zombie_id)
+
+    # REJOIN: the bounced node recovers; the shard it wrote the zombie
+    # doc to has DIVERGED history → pruning full copy; its other copy is
+    # a clean prefix → checkpoint ops-replay
+    FAULTS.clear()
+    c0.transport.breaker = PeerBreaker()
+    c1.transport.breaker = PeerBreaker()
+    _rejoin(c0, c1)
+    _wait_for(lambda: all(
+        c1.local.node_id in c0.dist_indices["evt"]["assignment"][s]
+        for s in ("0", "1")), msg="rejoined copies to graduate")
+    recs = {e["shard"]: e for e in
+            c1.node.indices["evt"].recoveries.entries()
+            if e["type"] == "peer" and e["stage"] == "done"}
+    assert recs[int(sid_old_primary)]["mode"] == "full"  # diverged
+    other = 1 - int(sid_old_primary)
+    assert recs[other]["mode"] == "ops"                  # clean prefix
+    # the zombie doc did not survive its copy's re-sync
+    assert not c1.node.indices["evt"].shards[int(sid_old_primary)] \
+        .engine.exists(zombie_id)
+    # graduated copies are back in the in-sync set
+    assert all(c1.local.node_id in c0.dist_indices["evt"]["in_sync"][s]
+               for s in ("0", "1"))
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_rejoin_recovers_incrementally_via_ops_replay(
+        replicated_cluster, seed):
+    c0, c1 = replicated_cluster
+    acked = _bulk_with_midstream_kill(c0, c1, seed)
+    _kill_node(c0, c1)
+
+    # the promoted primaries keep taking writes while node1 is down
+    extra = set()
+    for i in range(40, 48):
+        res = c0.data.index_doc("evt", f"d{i}", {"n": i})
+        extra.add(f"d{i}")
+        assert res.get("_seq_no") is not None
+
+    FAULTS.clear()
+    c0.transport.breaker = PeerBreaker()
+    c1.transport.breaker = PeerBreaker()
+    _rejoin(c0, c1)
+    _wait_for(lambda: all(
+        c1.local.node_id in c0.dist_indices["evt"]["assignment"][s]
+        for s in ("0", "1")), msg="rejoined copies to graduate")
+
+    # NO full copy anywhere: node1's copies were clean prefixes, so both
+    # shards recovered by replaying only op suffixes above their local
+    # checkpoints (a shard may recover more than once: the mid-bulk
+    # demotion scheduled a re-sync besides the join-time stream — every
+    # stream must still be incremental)
+    recs = [e for e in c1.node.indices["evt"].recoveries.entries()
+            if e["type"] == "peer" and e["stage"] == "done"]
+    assert {e["shard"] for e in recs} == {0, 1}
+    assert all(e["mode"] == "ops" for e in recs), recs
+    total_ops_replayed = sum(e["ops_replayed"] for e in recs)
+    total_docs = sum(
+        c0.node.indices["evt"].shards[s].engine.num_docs for s in (0, 1))
+    assert 0 < total_ops_replayed < total_docs, (
+        f"replayed {total_ops_replayed} vs {total_docs} docs — "
+        f"an incremental recovery must move less than the whole shard")
+
+    # the GET {index}/_recovery endpoint proves it the acceptance way
+    from elasticsearch_tpu.rest.server import RestController
+
+    status, body = RestController(c1.node).dispatch(
+        "GET", "/evt/_recovery", {}, b"")
+    assert status == 200
+    peer_rows = [sh for sh in body["evt"]["shards"]
+                 if sh.get("mode") == "ops"]
+    assert {sh["id"] for sh in peer_rows} == {0, 1}
+    for sh in peer_rows:
+        docs_in_shard = c1.node.indices["evt"].shards[sh["id"]] \
+            .engine.num_docs
+        assert sh["translog"]["recovered"] < docs_in_shard
+
+    # and the recovered copies serve every acked doc
+    c1.node.indices["evt"].refresh()
+    for doc_id in sorted(acked | extra):
+        sid = None
+        from elasticsearch_tpu.cluster.routing import shard_id_for
+        sid = shard_id_for(doc_id, 2)
+        assert c1.node.indices["evt"].shards[sid].engine.exists(doc_id), \
+            f"acked doc {doc_id} missing on the rejoined copy"
+
+    # node-level gauges aggregated the incremental recoveries
+    nodes = c1.node.nodes_stats()["nodes"]
+    rec = nodes[c1.node.node_id]["indices"]["recovery"]
+    assert rec["incremental"] >= 2
+    assert rec["ops_replayed"] == total_ops_replayed
+
+
+def test_env_spec_arms_new_points():
+    """The ESTPU_FAULTS grammar covers the new replication-safety points
+    (subprocess cluster members arm through it)."""
+    from elasticsearch_tpu.utils.faults import FaultRegistry, _parse_env_spec
+
+    r = FaultRegistry()
+    _parse_env_spec(
+        "replication.fanout:prob=0.3:seed=42;recovery.ops_replay:count=2",
+        r)
+    assert r.active("replication.fanout")
+    assert r.active("recovery.ops_replay")

@@ -1,0 +1,334 @@
+"""Checkpoint math, primary-term fencing, and checkpoint-based recovery
+(elasticsearch_tpu/index/seqno.py and its engine/replication wiring).
+
+Covers the replication-safety invariants:
+- local checkpoint: gaps from out-of-order replica appends hold it back;
+  it advances exactly on gap fill
+- global checkpoint: never exceeds the slowest IN-SYNC copy; ignores
+  non-in-sync stragglers; monotonic under stale reports
+- primary term: persisted across engine close/reopen via translog
+  replay; stale ops fenced with a typed 409
+- recovery: ops-replay when the target is a clean prefix and the
+  translog covers the suffix; full copy on divergence/flush, shipping
+  tombstones and pruning stale-era docs
+"""
+import os
+
+import pytest
+
+from elasticsearch_tpu.analysis.registry import AnalysisRegistry
+from elasticsearch_tpu.index.engine import Engine
+from elasticsearch_tpu.index.mappings import Mappings
+from elasticsearch_tpu.index.recovery import recover_peer
+from elasticsearch_tpu.index.seqno import (
+    NO_OPS_PERFORMED,
+    GlobalCheckpointTracker,
+    LocalCheckpointTracker,
+)
+from elasticsearch_tpu.utils.errors import StalePrimaryException
+from elasticsearch_tpu.utils.faults import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def _engine(tmp_path=None, name="t"):
+    path = os.path.join(str(tmp_path), name, "translog") if tmp_path else None
+    return Engine(Mappings({}), AnalysisRegistry({}), translog_path=path,
+                  index_name=name)
+
+
+# -- local checkpoint ----------------------------------------------------------
+
+def test_local_checkpoint_contiguous_advance():
+    t = LocalCheckpointTracker()
+    assert t.checkpoint == NO_OPS_PERFORMED
+    for i in range(5):
+        assert t.generate() == i
+        t.mark_processed(i)
+    assert t.checkpoint == 4
+    assert t.max_seq_no == 4
+    assert not t.has_gaps()
+
+
+def test_local_checkpoint_gap_holds_then_fills():
+    t = LocalCheckpointTracker()
+    # out-of-order replica appends: 0, 1, then 3 before 2
+    t.mark_processed(0)
+    t.mark_processed(1)
+    t.mark_processed(3)
+    assert t.checkpoint == 1          # the gap at 2 holds it back
+    assert t.max_seq_no == 3
+    assert t.has_gaps()
+    t.mark_processed(2)               # gap fill
+    assert t.checkpoint == 3          # advances over BOTH 2 and parked 3
+    assert not t.has_gaps()
+
+
+def test_local_checkpoint_duplicate_delivery_is_idempotent():
+    t = LocalCheckpointTracker()
+    t.mark_processed(0)
+    t.mark_processed(0)  # retried fanout
+    assert t.checkpoint == 0
+    t.mark_processed(1)
+    assert t.checkpoint == 1
+
+
+def test_advance_to_adopts_wholesale():
+    t = LocalCheckpointTracker()
+    t.mark_processed(7)  # parked above the checkpoint
+    t.advance_to(5)
+    assert t.checkpoint == 5
+    t.mark_processed(6)  # fills through the parked 7
+    assert t.checkpoint == 7
+
+
+# -- global checkpoint ---------------------------------------------------------
+
+def test_global_checkpoint_is_slowest_in_sync_copy():
+    g = GlobalCheckpointTracker(in_sync=["p", "r1", "r2"])
+    g.update_local("p", 10)
+    g.update_local("r1", 10)
+    g.update_local("r2", 3)
+    assert g.global_checkpoint == 3   # never exceeds the slowest in-sync
+    g.update_local("r2", 9)
+    assert g.global_checkpoint == 9
+    # a stale (lower) report never moves it backwards
+    g.update_local("r2", 4)
+    assert g.global_checkpoint == 9
+
+
+def test_global_checkpoint_ignores_non_in_sync_and_tracks_removal():
+    g = GlobalCheckpointTracker(in_sync=["p", "r1"])
+    g.update_local("p", 20)
+    g.update_local("r1", 20)
+    g.update_local("lagger", 1)       # initializing: NOT in-sync
+    assert g.global_checkpoint == 20
+    g.mark_in_sync("lagger", 2)       # graduates: now it holds it back...
+    assert g.global_checkpoint == 20  # ...but monotonicity keeps the max
+    g2 = GlobalCheckpointTracker(in_sync=["p", "slow"])
+    g2.update_local("p", 20)
+    assert g2.global_checkpoint == NO_OPS_PERFORMED  # unreported copy
+    g2.remove("slow")                 # failed out of the in-sync set
+    assert g2.global_checkpoint == 20
+
+
+# -- engine: terms + persistence ----------------------------------------------
+
+def test_engine_assigns_contiguous_seqnos_and_terms(tmp_path):
+    e = _engine(tmp_path)
+    for i in range(4):
+        e.index(str(i), {"v": i})
+    e.delete("0")
+    assert e.max_seq_no == 4 and e.local_checkpoint == 4
+    assert e._locations["1"].seq_no == 1
+    assert e._locations["1"].term == 1
+    e.close()
+
+
+def test_engine_fences_stale_term_and_adopts_newer(tmp_path):
+    e = _engine(tmp_path)
+    e.index("a", {"v": 1})
+    # replica-style op from a NEWER primary: engine adopts the term
+    e.index("b", {"v": 2}, seq_no=1, primary_term=3)
+    assert e.primary_term == 3
+    # op from the OLD term is now fenced — before any state mutates
+    with pytest.raises(StalePrimaryException) as ei:
+        e.index("c", {"v": 3}, primary_term=1)
+    assert ei.value.status == 409
+    assert ei.value.error_type == "stale_primary_exception"
+    assert not e.exists("c")
+    with pytest.raises(StalePrimaryException):
+        e.delete("a", primary_term=2)
+    assert e.exists("a")
+    e.close()
+
+
+def test_term_bump_persists_across_close_reopen(tmp_path):
+    e = _engine(tmp_path)
+    e.index("a", {"v": 1})
+    e.bump_term(5)                      # promotion
+    e.index("b", {"v": 2})              # op under the new term
+    assert e._locations["b"].term == 5
+    e.close()
+    e2 = _engine(tmp_path)
+    e2.recover_from_translog()
+    assert e2.primary_term == 5         # term survived via translog replay
+    assert e2.local_checkpoint == 1
+    assert e2.term_at(0) == 1 and e2.term_at(1) == 5
+    with pytest.raises(StalePrimaryException):
+        e2.index("c", {"v": 3}, primary_term=4)
+    e2.close()
+
+
+# -- recovery: ops replay vs full copy ----------------------------------------
+
+def test_recover_peer_incremental_replays_only_the_suffix(tmp_path):
+    src = _engine(tmp_path, "src")
+    for i in range(10):
+        src.index(str(i), {"v": i})
+    dst = _engine(None, "dst")
+    stats = recover_peer(src, dst)
+    assert stats["mode"] == "ops" and stats["ops_replayed"] == 10
+    assert dst.num_docs == 10 and dst.local_checkpoint == 9
+    # five more ops on the source: the next recovery replays exactly five
+    for i in range(10, 15):
+        src.index(str(i), {"v": i})
+    stats = recover_peer(src, dst)
+    assert stats["mode"] == "ops" and stats["ops_replayed"] == 5
+    assert dst.num_docs == 15
+    src.close()
+    dst.close()
+
+
+def test_recover_peer_full_copy_after_flush_and_tombstones(tmp_path):
+    src = _engine(tmp_path, "src")
+    for i in range(6):
+        src.index(str(i), {"v": i})
+    dst = _engine(None, "dst")
+    recover_peer(src, dst)              # dst in sync, holds doc "3"
+    assert dst.exists("3")
+    src.delete("3")
+    src.flush()                         # commit drops the retained ops
+    stats = recover_peer(src, dst)
+    assert stats["mode"] == "full"      # retention gap → fallback
+    # the tombstone rode the full copy: the doc deleted mid-stream is
+    # gone from a target that already held it (the old id-snapshot bug)
+    assert not dst.exists("3")
+    assert dst.num_docs == 5
+    src.close()
+    dst.close()
+
+
+def test_recover_peer_full_copy_prunes_diverged_stale_era_docs(tmp_path):
+    src = _engine(tmp_path, "src")
+    for i in range(4):
+        src.index(str(i), {"v": i})
+    dst = _engine(None, "dst")
+    recover_peer(src, dst)
+    # dst diverges as a zombie old-term copy: local-only doc, never acked
+    dst.index("zombie", {"v": 99})
+    assert dst.exists("zombie")
+    # the real primary moved on under a bumped term
+    src.bump_term(2)
+    src.index("new", {"v": 5})
+    stats = recover_peer(src, dst)
+    assert stats["mode"] == "full"      # diverged history → full copy
+    assert not dst.exists("zombie")     # stale-era doc pruned
+    assert dst.exists("new")
+    assert dst.primary_term == 2
+    # the prune must NOT have consumed fresh seq nos: the copy's
+    # checkpoint matches the source again, so the NEXT bounce is back on
+    # the incremental path (a generated tombstone seqno would push the
+    # checkpoint past the source's and doom every future handshake to
+    # full copies)
+    assert dst.local_checkpoint == src.local_checkpoint
+    src.index("after", {"v": 6})
+    stats = recover_peer(src, dst)
+    assert stats["mode"] == "ops" and stats["ops_replayed"] == 1
+    src.close()
+    dst.close()
+
+
+def test_recover_peer_ops_replay_fault_point(tmp_path):
+    src = _engine(tmp_path, "src")
+    for i in range(3):
+        src.index(str(i), {"v": i})
+    dst = _engine(None, "dst")
+    FAULTS.inject("recovery.ops_replay", error=OSError, count=1, after=1)
+    with pytest.raises(OSError):
+        recover_peer(src, dst)
+    assert FAULTS.fired("recovery.ops_replay") == 1
+    # the aborted stream left a checkpointed prefix: the retry resumes
+    # incrementally and replays only what is missing
+    FAULTS.clear()
+    already = dst.local_checkpoint
+    stats = recover_peer(src, dst)
+    assert stats["mode"] == "ops"
+    assert stats["ops_replayed"] == 3 - (already + 1)
+    assert dst.num_docs == 3
+    src.close()
+    dst.close()
+
+
+def test_skipped_replay_op_is_a_noop_not_a_checkpoint_hole(tmp_path):
+    src = _engine(tmp_path, "src")
+    for i in range(5):
+        src.index(str(i), {"v": i})
+    dst = _engine(None, "dst")
+    recover_peer(src, dst)              # dst ckpt = 4
+    # two more updates of doc "0" on the source (seq 5 v2, seq 6 v3);
+    # the LATEST fans out live to dst ahead of the recovery replay
+    src.index("0", {"v": 100})
+    src.index("0", {"v": 200})
+    dst.index("0", {"v": 200}, version=3, version_type="external_gte",
+              seq_no=6, primary_term=1, _replay=True)
+    assert dst.local_checkpoint == 4    # gap at 5 holds it
+    stats = recover_peer(src, dst)
+    assert stats["mode"] == "ops"
+    # the replayed seq-5 op conflicts (dst already has v3) and is
+    # SKIPPED — but it must count as processed (a no-op), or the
+    # checkpoint would stall on the hole forever and every later
+    # recovery would re-replay from it (or full-copy once flushed away)
+    assert dst.local_checkpoint == 6
+    assert dst.get("0")["_version"] == 3
+    src.close()
+    dst.close()
+
+
+def test_select_primary_promotes_in_sync_only():
+    from elasticsearch_tpu.cluster.routing import select_primary
+
+    # in-sync leader stays put
+    assert select_primary(["a", "b"], ["a", "b"]) == ["a", "b"]
+    # stale leader: the first in-sync copy is promoted ahead of it
+    assert select_primary(["a", "b", "c"], ["b", "c"]) == ["b", "a", "c"]
+    # NO in-sync survivor: red shard, never a silent ack-rollback
+    assert select_primary(["a", "b"], []) == []
+    assert select_primary([], ["a"]) == []
+
+
+def test_replication_group_promotion_bumps_term_and_fences_zombie():
+    from elasticsearch_tpu.cluster.replication import ReplicationGroup
+    from elasticsearch_tpu.index.shard import IndexShard
+
+    mk = lambda: IndexShard("rg", 0, Mappings({}), AnalysisRegistry({}))
+    p, r1, r2 = mk(), mk(), mk()
+    g = ReplicationGroup(0, p, [r1, r2])
+    for i in range(5):
+        g.index(str(i), {"v": i})
+    assert g.global_checkpoint == 4     # all copies caught up
+    old_primary = g.primary
+    promoted = g.fail_primary()
+    assert promoted is r1
+    assert g.primary_term == 2          # promotion bumped the term
+    # zombie path: a stale group view still pointing at the old primary
+    zombie = ReplicationGroup(0, old_primary, [promoted, r2])
+    with pytest.raises(StalePrimaryException):
+        zombie.index("late", {"v": 99})
+    # the fenced write was never acked and never reached the new primary
+    assert not promoted.engine.exists("late")
+    # writes through the REAL group proceed under the new term
+    g.index("ok", {"v": 1})
+    assert promoted.engine._locations["ok"].term == 2
+
+
+def test_replication_fanout_fault_demotes_copy_not_write():
+    from elasticsearch_tpu.cluster.replication import ReplicationGroup
+    from elasticsearch_tpu.index.shard import IndexShard
+
+    mk = lambda: IndexShard("rg", 0, Mappings({}), AnalysisRegistry({}))
+    p, r1 = mk(), mk()
+    g = ReplicationGroup(0, p, [r1])
+    FAULTS.inject("replication.fanout", error=OSError, count=1)
+    rid, version, created, failed, seq_no, term = g.index("a", {"v": 1})
+    assert failed == 1                  # the write itself succeeded...
+    assert r1 in g.failed_replicas      # ...the copy was failed out
+    # ...and it left the in-sync set: not promotable until re-synced
+    assert r1.engine.commit_id not in g.checkpoints.in_sync
+    with pytest.raises(Exception):
+        g.fail_primary()                # no in-sync replica to promote

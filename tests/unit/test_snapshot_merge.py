@@ -184,14 +184,25 @@ def test_peer_recovery_copies_docs():
     src.refresh()
     dst = IndexService("dst")
     stats = recover_peer(src.shards[0].engine, dst.shards[0].engine)
-    assert stats["copied"] == 4
+    # the source's translog still holds every op: recovery replays the op
+    # suffix (5 indexes + 1 delete) instead of shipping live docs
+    assert stats["mode"] == "ops"
+    assert stats["ops_replayed"] == 6
     assert dst.num_docs == 4
-    # versions carried over: re-recovery is a no-op (external_gte idempotent)
+    # checkpoints equal now: re-recovery replays NOTHING (incremental)
     stats2 = recover_peer(src.shards[0].engine, dst.shards[0].engine)
-    assert stats2["copied"] == 4  # equal versions accepted (gte), no dupes
+    assert stats2["mode"] == "ops" and stats2["ops_replayed"] == 0
     assert dst.num_docs == 4
+    # flush drops the retained ops: the next out-of-date target falls
+    # back to the full doc copy (which ships tombstones)
+    src.shards[0].engine.flush()
+    dst2 = IndexService("dst2")
+    stats3 = recover_peer(src.shards[0].engine, dst2.shards[0].engine)
+    assert stats3["mode"] == "full" and stats3["copied"] == 4
+    assert dst2.num_docs == 4
     src.close()
     dst.close()
+    dst2.close()
 
 
 def test_url_repository_readonly_no_mkdir(node, tmp_path, monkeypatch):
