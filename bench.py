@@ -379,12 +379,28 @@ def cpu_bm25_latency(u_doc, tfn, offsets, idf, queries, n_docs, k, runs=3):
 FALLBACKS = {"mesh_fallback_total": 0, "span_clause_truncated": 0}
 
 
+#: every kernel counter folded in before a scoped kernels.reset() —
+#: metrics_delta reads reset-proof totals from here + the live snapshot
+KERNELS_ACCUM: dict = {}
+
+
 def harvest_fallbacks():
     from elasticsearch_tpu.monitor import kernels
 
     snap = kernels.snapshot()
     for key in FALLBACKS:
         FALLBACKS[key] += int(snap.get(key, 0))
+
+
+def reset_kernels_scoped():
+    """Reset the kernel-dispatch counters for a scoped measurement, but
+    fold the current values into KERNELS_ACCUM first so the whole-run
+    metrics_delta (executor cache hits/misses etc.) survives the reset."""
+    from elasticsearch_tpu.monitor import kernels
+
+    for k, v in kernels.snapshot().items():
+        KERNELS_ACCUM[k] = KERNELS_ACCUM.get(k, 0) + v
+    kernels.reset()
 
 
 def batched_msearch_qps(node, queries, k):
@@ -396,7 +412,7 @@ def batched_msearch_qps(node, queries, k):
                "size": k}) for q in queries]
     node.msearch(pairs)  # warmup at the FULL batch shape (jit is Q-static)
     harvest_fallbacks()
-    kernels.reset()
+    reset_kernels_scoped()
     t0 = time.perf_counter()
     resp = node.msearch(pairs)
     dt = time.perf_counter() - t0
@@ -608,6 +624,18 @@ def main():
 
 def run_bench(args, jax) -> dict:
     t_start = time.perf_counter()
+    # continuous-metrics snapshot (monitor/metrics.py): the same counters
+    # /_prometheus/metrics exposes, deltaed over the whole run so the
+    # bench trajectory carries cache-hit/compile/eviction numbers
+    from elasticsearch_tpu.monitor.metrics import (counters_delta,
+                                                   process_counters)
+    from elasticsearch_tpu.tracing import retrace
+
+    # install the jit trace auditor BEFORE any ops module binds jax.jit,
+    # so the delta's compile count covers the whole run (otherwise the
+    # before-snapshot reads -1 = unknown and poisons the delta)
+    retrace.ensure_installed()
+    metrics_before = process_counters()
     stage("dispatch-floor")
     # per-call dispatch floor: the minimum round trip of ANY device call on
     # this host↔device link (tunneled chips: network RTT). Single-query
@@ -931,6 +959,34 @@ def run_bench(args, jax) -> dict:
     # the record IS the PARTIAL dict (every metric was written into it at
     # measurement time, so a stall record is a strict prefix of this one)
     # plus the end-only fields
+    metrics_after = process_counters()
+    # re-add the kernel counts the scoped resets wiped (batched_msearch_qps
+    # resets to attribute fallbacks; the run total must not lose them)
+    for k, v in KERNELS_ACCUM.items():
+        metrics_after[f"kernels.{k}"] = \
+            metrics_after.get(f"kernels.{k}", 0.0) + v
+    delta = counters_delta(metrics_before, metrics_after)
+    PARTIAL["metrics_delta"] = {
+        # the headline counters, named (executor cache economics, device
+        # compiles, HBM tier churn) ...
+        "executor_prep_hits": delta.get("kernels.executor_prep_hit", 0),
+        "executor_prep_misses": delta.get("kernels.executor_prep_miss", 0),
+        "executor_data_hits": delta.get("kernels.executor_data_hit", 0),
+        "executor_data_misses": delta.get("kernels.executor_data_miss", 0),
+        # -1 = trace auditor not installed (unknown, never a fake 0)
+        "jit_compiles": delta.get("jit.traces_total", -1),
+        "evictions": delta.get("residency.evictions", 0),
+        "rehydrations": delta.get("residency.rehydrations", 0),
+        "breaker_tripped": sum(
+            v for k, v in delta.items()
+            if k.startswith("breakers.") and v > 0),
+        # ... plus every other counter that moved during the run
+        "counters": {k: v for k, v in delta.items() if v},
+    }
+    log(f"metrics delta: prep {PARTIAL['metrics_delta']['executor_prep_hits']}"
+        f"/{PARTIAL['metrics_delta']['executor_prep_misses']} hit/miss, "
+        f"{PARTIAL['metrics_delta']['jit_compiles']} jit traces, "
+        f"{PARTIAL['metrics_delta']['evictions']} evictions")
     cpu_qps = 1000.0 / cpu_p50 if cpu_p50 > 0 else 1.0
     PARTIAL.update({
         "metric": "bm25_batched_qps",

@@ -83,6 +83,9 @@ class MultiHostCluster:
         # remote sends/handles record spans on this node's tracer and
         # stitch into one trace via the frame ctx header
         self.transport.tracer = node.tracer
+        # and counters/latency land in this node's metrics registry
+        # (rx/tx bytes, per-action rounds, retry/breaker-open counts)
+        self.transport.metrics = node.metrics
         host, port = self.transport.bind(
             bind_host, transport_port if rank == 0 else 0)
         self.local = DiscoveryNode(nid, node.name,

@@ -171,22 +171,54 @@ class PeerBreaker:
             self._peers.pop(peer, None)
 
 
-def _send_frame(sock: socket.socket, obj: dict) -> None:
+def _send_frame(sock: socket.socket, obj: dict) -> int:
+    """Returns the wire bytes written (frame header + body) so callers
+    can feed the tx-bytes counter without re-serializing."""
     raw = json.dumps(obj).encode()
     sock.sendall(struct.pack(">I", len(raw)) + raw)
+    return len(raw) + 4
 
 
-def _recv_frame(sock: socket.socket) -> Optional[dict]:
+def _recv_frame_sized(sock: socket.socket) -> Tuple[Optional[dict], int]:
+    """(frame, wire bytes read) — the sized form the rx-bytes counter
+    needs; ``_recv_frame`` keeps the plain signature."""
     header = _recv_exact(sock, 4)
     if header is None:
-        return None
+        return None, 0
     (n,) = struct.unpack(">I", header)
     if n > 64 << 20:
         raise TransportError(f"frame of {n} bytes exceeds the 64MB cap")
     body = _recv_exact(sock, n)
     if body is None:
-        return None
-    return json.loads(body)
+        return None, 4
+    return json.loads(body), n + 4
+
+
+def _recv_frame(sock: socket.socket) -> Optional[dict]:
+    return _recv_frame_sized(sock)[0]
+
+
+def _count_bytes(metrics, direction: str, nbytes: int) -> None:
+    """Feed the rx/tx byte counter on a node's registry; a metrics
+    failure (or an unwired service) must never fail the frame."""
+    if metrics is None or nbytes <= 0:
+        return
+    try:
+        metrics.counter(
+            "estpu_transport_bytes_total",
+            "Wire bytes moved by the TCP transport, by direction",
+            ("direction",)).labels(direction).inc(nbytes)
+    except Exception:  # tpulint: allow[R006] — dropping one metric
+        pass           # sample must never fail the frame it measured
+
+
+def _count_event(metrics, name: str, help_: str, action: str) -> None:
+    if metrics is None:
+        return
+    try:
+        metrics.counter(name, help_, ("action",)).labels(action).inc()
+    except Exception:  # tpulint: allow[R006] — dropping one metric
+        pass           # sample must never fail the send it counted
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -210,6 +242,9 @@ class TransportService:
         # every remote send and every handled frame records a span, and
         # the two link into ONE trace via the frame's ctx header
         self.tracer = None
+        # optional node metrics registry (bootstrap wires it beside the
+        # tracer): rx/tx bytes, per-action latency, retry/breaker counts
+        self.metrics = None
         self.breaker = PeerBreaker()
         # node-id-derived seed: each node jitters its retries differently
         self.backoff = BackoffPolicy(seed=zlib.crc32(local_node_id.encode()))
@@ -265,6 +300,28 @@ class TransportService:
 
     def _send_remote(self, address: Tuple[str, int], action: str,
                      payload: dict, timeout: float = 5.0) -> Any:
+        t_m = time.perf_counter()
+        try:
+            return self._send_remote_timed(address, action, payload,
+                                           timeout)
+        except TransportError:
+            _count_event(self.metrics, "estpu_transport_errors_total",
+                         "Failed transport rounds, by action", action)
+            raise
+        finally:
+            m = self.metrics
+            if m is not None:
+                try:
+                    m.histogram(
+                        "estpu_transport_action_duration_seconds",
+                        "Client-side transport round latency, by action",
+                        ("action",)).labels(action).observe(
+                            time.perf_counter() - t_m)
+                except Exception:  # tpulint: allow[R006] — a metrics
+                    pass  # failure must never mask the send's outcome
+
+    def _send_remote_timed(self, address: Tuple[str, int], action: str,
+                           payload: dict, timeout: float = 5.0) -> Any:
         t0 = time.monotonic()
         try:
             # the injected fault rides the same wrapping as a real
@@ -287,12 +344,14 @@ class TransportService:
                 # slow accept must not leave the recv another full budget
                 sock.settimeout(max(0.001,
                                     timeout - (time.monotonic() - t0)))
-                _send_frame(sock, attach_ctx(
-                    {"action": action, "payload": payload},
-                    wire_context()))
+                _count_bytes(self.metrics, "tx", _send_frame(
+                    sock, attach_ctx(
+                        {"action": action, "payload": payload},
+                        wire_context())))
                 FAULTS.check("transport.recv", action=action,
                              address=address)
-                resp = _recv_frame(sock)
+                resp, rx_bytes = _recv_frame_sized(sock)
+                _count_bytes(self.metrics, "rx", rx_bytes)
             except socket.timeout as e:
                 raise ReceiveTimeoutTransportError(
                     f"no response from {address} within {timeout}s "
@@ -341,6 +400,10 @@ class TransportService:
                 if remaining < budget:
                     budget, truncated = remaining, True
             if not self.breaker.allow(address):
+                _count_event(self.metrics,
+                             "estpu_transport_breaker_open_total",
+                             "Sends refused by an open per-peer breaker, "
+                             "by action", action)
                 if last is not None:
                     # the breaker opened DURING this call's retries: the
                     # real typed failure is more useful than the breaker's
@@ -371,6 +434,10 @@ class TransportService:
                     if deadline is not None and \
                             time.monotonic() + delay >= deadline:
                         break  # sleeping would blow the deadline
+                    _count_event(self.metrics,
+                                 "estpu_transport_retries_total",
+                                 "Transport retry attempts, by action",
+                                 action)
                     time.sleep(delay)
                 continue
             self.breaker.record_success(address)
@@ -397,24 +464,28 @@ class TcpTransportServer:
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):  # noqa: N802 (socketserver API)
                 try:
-                    req = _recv_frame(self.request)
+                    req, rx_bytes = _recv_frame_sized(self.request)
+                    _count_bytes(service.metrics, "rx", rx_bytes)
                     if req is None:
                         return
                     try:
                         result = service.handle_frame(
                             req.get("action", ""), req.get("payload", {}),
                             ctx=extract_ctx(req))
-                        _send_frame(self.request, {"ok": True, "result": result})
+                        _count_bytes(service.metrics, "tx", _send_frame(
+                            self.request, {"ok": True, "result": result}))
                     except ElasticsearchTpuException as e:
                         # typed relay: the caller re-raises with the
                         # original error_type + HTTP status
-                        _send_frame(self.request, {
-                            "ok": False, "error": str(e),
-                            "error_type": getattr(e, "error_type",
-                                                  "internal_error"),
-                            "status": getattr(e, "status", 500)})
+                        _count_bytes(service.metrics, "tx", _send_frame(
+                            self.request, {
+                                "ok": False, "error": str(e),
+                                "error_type": getattr(e, "error_type",
+                                                      "internal_error"),
+                                "status": getattr(e, "status", 500)}))
                     except Exception as e:  # handler errors go back as frames
-                        _send_frame(self.request, {"ok": False, "error": str(e)})
+                        _count_bytes(service.metrics, "tx", _send_frame(
+                            self.request, {"ok": False, "error": str(e)}))
                 except Exception:
                     pass  # broken pipe / malformed frame: drop the connection
 

@@ -188,6 +188,26 @@ class IndexService:
 
         return self.groups[shard_id_for(doc_id, self.num_shards, routing)]
 
+    def _record_write_metric(self, op: str, seconds: float) -> None:
+        """Write-path latency + op counters into the owning node's
+        metrics registry (monitor/metrics.py). Library-embedded
+        IndexServices have no node — then nothing records; the
+        per-request numbers still exist in engine stats."""
+        node = getattr(self, "_node", None)
+        if node is None:
+            return
+        try:
+            m = node.metrics
+            m.histogram(
+                "estpu_indexing_duration_seconds",
+                "Write operation latency (engine + replication fanout)",
+                ("op",)).labels(op).observe(seconds)
+            m.counter(
+                "estpu_indexing_operations_total",
+                "Write operations by type", ("op",)).labels(op).inc()
+        except Exception:  # tpulint: allow[R006] — a metrics failure
+            pass           # must never fail the acked write
+
     # -- document ops ----------------------------------------------------------
 
     def index_doc(self, doc_id: Optional[str], source: dict, routing: Optional[str] = None,
@@ -215,7 +235,9 @@ class IndexService:
             doc_id, source, routing=routing, **kw)
         if is_perc:
             self.percolator.register(rid, source)
-        self.slowlog.on_index((time.perf_counter() - t0) * 1000, rid)
+        dt = time.perf_counter() - t0
+        self.slowlog.on_index(dt * 1000, rid)
+        self._record_write_metric("index", dt)
         return {
             "_index": self.name,
             "_type": kw.get("doc_type") or "_doc",
@@ -274,7 +296,9 @@ class IndexService:
         loc = self.route(doc_id, routing).engine._locations.get(str(doc_id))
         dtype = (loc.doc_type if loc is not None and loc.doc_type
                  else "_doc")
+        t0 = time.perf_counter()
         version, _failed, seq_no, term = group.delete(doc_id, **kw)
+        self._record_write_metric("delete", time.perf_counter() - t0)
         if self._percolator is not None:
             self._percolator.unregister(str(doc_id))
         return {

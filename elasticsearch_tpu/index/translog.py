@@ -141,12 +141,28 @@ class Translog:
                     raise
 
     def _sync_locked(self):
+        t0 = time.perf_counter()
         self._fh.flush()
         FAULTS.check("translog.fsync", path=self.path)
         os.fsync(self._fh.fileno())
         self._ops_since_sync = 0
         self._sync_count += 1
         self._last_sync = time.time()
+        # continuous metrics (process-shared registry: a Translog has no
+        # node back-ref, the device-is-process-shared discipline): fsync
+        # latency is THE write-amplification number under
+        # durability=request — every indexed doc pays one
+        try:
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            SHARED.histogram(
+                "estpu_translog_fsync_duration_seconds",
+                "Translog flush+fsync latency").observe(
+                    time.perf_counter() - t0)
+            SHARED.counter("estpu_translog_fsyncs_total",
+                           "Translog fsync operations").inc()
+        except Exception:  # tpulint: allow[R006] — a metrics failure
+            pass           # must never become a tragic translog event
 
     def _close_tragic(self, truncate_to: Optional[int] = None):
         """Close the channel after a failed write/fsync — best-effort,

@@ -1,3 +1,5 @@
+from elasticsearch_tpu.monitor.metrics import MetricsRegistry, SHARED
 from elasticsearch_tpu.monitor.stats import SearchStats, os_stats, process_stats
 
-__all__ = ["SearchStats", "os_stats", "process_stats"]
+__all__ = ["MetricsRegistry", "SHARED", "SearchStats", "os_stats",
+           "process_stats"]

@@ -28,6 +28,14 @@ Names:
                       probing) — not a fallback, excluded from the budget
   span_clause_truncated  a deeply-nested span clause exceeded
                       MAX_SPANS_PER_CLAUSE on the host walk (search/spans)
+  executor_prep_hit   a search round reused a prepared-query memo entry
+                      (compiled program + device inputs, no rebuild)
+  executor_prep_miss  a memoizable round built programs/inputs fresh
+  executor_data_hit   a segment-round device-data group was reused
+  executor_data_miss  a segment-round device-data group was built+uploaded
+
+The executor cache counters feed bench.py's ``metrics_delta`` and the
+``estpu_kernel_dispatch_total`` Prometheus family (monitor/metrics.py).
 """
 from __future__ import annotations
 

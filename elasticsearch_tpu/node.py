@@ -51,6 +51,17 @@ class Node:
 
         self.tasks = TaskRegistry(self.node_id)
         self.tracer = Tracer(self.node_id)
+        # continuous metrics (monitor/metrics.py): a per-NODE registry —
+        # REST latency, span histograms, indexing — plus scrape-time
+        # collectors over the process-shared subsystems; every finished
+        # span feeds a latency histogram via the tracer sink, so PR 4's
+        # instrumentation became time-series without new call sites
+        from elasticsearch_tpu.monitor.metrics import (MetricsRegistry,
+                                                       span_sink)
+
+        self.metrics = MetricsRegistry(include_shared=True)
+        self.tracer.set_sink(span_sink(self.metrics))
+        self._register_metric_collectors()
         # resource management: rehydration spans (tpu.rehydrate) land in
         # this node's tracer ring (process-shared registry — the device
         # is process-shared too; last in-process node wins)
@@ -83,6 +94,97 @@ class Node:
                 if self._thread_pool is None:
                     self._thread_pool = ThreadPool()
         return self._thread_pool
+
+    def _register_metric_collectors(self) -> None:
+        """Scrape-time gauge/counter families over state that is already
+        counted elsewhere — threadpool queues, breaker bytes, residency
+        tiers, kernel dispatch, jit traces. Re-counting these on every
+        record would double-lock hot paths; reading them at scrape time
+        costs one request per scrape instead."""
+        m = self.metrics
+
+        def _pools():
+            tp = self._thread_pool
+            return tp.stats().items() if tp is not None else ()
+
+        m.collector("estpu_threadpool_queue_depth",
+                    "Queued work items per named thread pool", ("pool",),
+                    lambda: [((n,), st["queue"]) for n, st in _pools()])
+        m.collector("estpu_threadpool_active",
+                    "Active workers per named thread pool", ("pool",),
+                    lambda: [((n,), st["active"]) for n, st in _pools()])
+        m.collector("estpu_threadpool_rejected_total",
+                    "Work rejected by a full queue, per pool", ("pool",),
+                    lambda: [((n,), st["rejected"]) for n, st in _pools()],
+                    kind="counter")
+        m.collector("estpu_threadpool_completed_total",
+                    "Work completed per named thread pool", ("pool",),
+                    lambda: [((n,), st["completed"]) for n, st in _pools()],
+                    kind="counter")
+
+        def _breakers():
+            from elasticsearch_tpu import resources
+
+            return resources.BREAKERS.stats().items()
+
+        m.collector("estpu_breaker_used_bytes",
+                    "Estimated bytes held per circuit breaker",
+                    ("breaker",),
+                    lambda: [((n,), br["estimated_size_in_bytes"])
+                             for n, br in _breakers()])
+        m.collector("estpu_breaker_limit_bytes",
+                    "Configured byte limit per circuit breaker",
+                    ("breaker",),
+                    lambda: [((n,), br["limit_size_in_bytes"])
+                             for n, br in _breakers()])
+        m.collector("estpu_breaker_tripped_total",
+                    "Trips per circuit breaker", ("breaker",),
+                    lambda: [((n,), br["tripped"]) for n, br in _breakers()],
+                    kind="counter")
+
+        def _tiers():
+            from elasticsearch_tpu import resources
+
+            return resources.RESIDENCY.stats()["tiers"].items()
+
+        m.collector("estpu_residency_tier_bytes",
+                    "Device-resident bytes per residency tier", ("tier",),
+                    lambda: [((t,), st["resident_bytes"])
+                             for t, st in _tiers()])
+        m.collector("estpu_residency_evictions_total",
+                    "Device-copy evictions per residency tier", ("tier",),
+                    lambda: [((t,), st["evictions"]) for t, st in _tiers()],
+                    kind="counter")
+        m.collector("estpu_residency_rehydrations_total",
+                    "Evicted-copy rehydrations per residency tier",
+                    ("tier",),
+                    lambda: [((t,), st["rehydrations"])
+                             for t, st in _tiers()],
+                    kind="counter")
+
+        def _kernels():
+            from elasticsearch_tpu.monitor import kernels
+
+            return kernels.snapshot().items()
+
+        m.collector("estpu_kernel_dispatch_total",
+                    "Requests served per device kernel / dispatch "
+                    "decision (monitor/kernels.py names)", ("kernel",),
+                    lambda: [((k,), v) for k, v in _kernels()],
+                    kind="counter")
+
+        def _jit_traces():
+            from elasticsearch_tpu.tracing import retrace
+
+            a = retrace.auditor()
+            # 0 when the auditor never installed: the exposition needs a
+            # stable family; /_nodes profiles keep the honest -1 sentinel
+            return [((), a.total() if a is not None else 0)]
+
+        m.collector("estpu_jit_traces_total",
+                    "jax.jit traces (compilations) recorded by the "
+                    "trace auditor since process start", (),
+                    _jit_traces, kind="counter")
 
     # -- gateway ---------------------------------------------------------------
 
@@ -677,6 +779,10 @@ class Node:
                     # counts across nodes)
                     "tasks": self.tasks.stats(),
                     "tracing": self.tracer.stats(),
+                    # continuous metrics: histogram percentile summaries
+                    # + counter totals — the JSON view of the same
+                    # numbers GET /_prometheus/metrics exposes
+                    "metrics": self.metrics.summaries(),
                     "slowlog": aggregate_slowlog(self.indices.values()),
                     # TPU-native extra: device kind + HBM usage
                     "accelerator": device_stats(),

@@ -454,10 +454,14 @@ class MeshSearchExecutor:
         duplicate build is wasted work, a serialized compile is a stall.
         Entries carry a residency token so the cache's HBM shows in
         /_nodes (request tier, force-charged: the LRU cap is the ceiling)."""
+        from elasticsearch_tpu.monitor import kernels
+
         with self._data_lock:
             if key in self._data:
                 self._data.move_to_end(key)
+                kernels.record("executor_data_hit")
                 return self._data[key][0]
+        kernels.record("executor_data_miss")
         val = build()
         from elasticsearch_tpu import resources
 
@@ -697,6 +701,9 @@ class MeshSearchExecutor:
                         if prep_key in self._prep:  # not popped by a
                             # concurrent cap-overflow eviction
                             self._prep.move_to_end(prep_key)  # LRU recency
+                    from elasticsearch_tpu.monitor import kernels
+
+                    kernels.record("executor_prep_hit")
                     self._record_tgroup_kernels(compiled)
                     self._decode_round(out, compiled, kk, sort_spec,
                                        lut_shard, lut_ord, seg_row, merged,
@@ -819,7 +826,9 @@ class MeshSearchExecutor:
                 out = jax.device_get(prog(*dev))
             if prep_key is not None:
                 from elasticsearch_tpu import resources
+                from elasticsearch_tpu.monitor import kernels
 
+                kernels.record("executor_prep_miss")
                 tok = resources.RESIDENCY.track(fresh_bytes,
                                                 label="executor.prep")
                 # prune entries keyed by segments that left the live set

@@ -16,6 +16,7 @@ import json
 import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -39,6 +40,11 @@ class RestController:
     def __init__(self, node: Node):
         self.node = node
         self.routes: List[Tuple[str, re.Pattern, Handler]] = []
+        # compiled regex -> the registered pattern string: the metrics
+        # endpoint label (a raw request path would be unbounded-cardinality
+        # — every doc id its own series; the ROUTE pattern is the bounded
+        # name ES uses for its own handler stats)
+        self._pattern_of: Dict[re.Pattern, str] = {}
         _register_all(self)
 
     def add(self, method: str, pattern: str, handler: Handler):
@@ -55,7 +61,9 @@ class RestController:
             return rf"(?P<{name}>[^/]+)"
 
         rx = re.sub(r"\{(\w+)\}", group, pattern)
-        self.routes.append((method, re.compile(f"^{rx}/?$"), handler))
+        compiled = re.compile(f"^{rx}/?$")
+        self.routes.append((method, compiled, handler))
+        self._pattern_of[compiled] = pattern
 
     @staticmethod
     def pool_for(method: str, path: str) -> str:
@@ -92,39 +100,67 @@ class RestController:
                 # handler work
                 from elasticsearch_tpu import resources
 
+                t0 = time.perf_counter()
                 inflight = resources.BREAKERS.breaker("in_flight_requests")
                 nbytes = len(body or b"")
                 try:
                     inflight.break_or_reserve(nbytes, "<http_request>")
                 except ElasticsearchTpuException as e:
-                    return e.status, _error_body(e)
+                    return self._finish(rx, method, t0, e.status,
+                                        _error_body(e))
                 try:
                     # run on the route's named pool: bounded concurrency,
                     # full queues reject with 429 (ThreadPool.java contract)
-                    return self.node.thread_pool.execute(
+                    status, out = self.node.thread_pool.execute(
                         self.pool_for(method, path),
                         handler, self.node, params, body,
                         **{k: _decode_path_part(v)
                            for k, v in match.groupdict().items()})
                 except ElasticsearchTpuException as e:
-                    return e.status, _error_body(e)
+                    status, out = e.status, _error_body(e)
                 except json.JSONDecodeError as e:
-                    return 400, {"error": {"type": "parse_exception", "reason": str(e)}, "status": 400}
+                    status, out = 400, {
+                        "error": {"type": "parse_exception",
+                                  "reason": str(e)}, "status": 400}
                 except Exception as e:  # noqa: BLE001 — a handler bug must
                     # surface as an ES-style 500 envelope, never a dropped
                     # connection (mirrors ES catching Throwable per request)
-                    return 500, {
+                    status, out = 500, {
                         "error": {"type": "internal_server_error",
                                   "reason": f"{type(e).__name__}: {e}"},
                         "status": 500,
                     }
                 finally:
                     inflight.release(nbytes)
+                return self._finish(rx, method, t0, status, out)
         return 400, {
             "error": {"type": "illegal_argument_exception",
                       "reason": f"no handler found for uri [{path}] and method [{method}]"},
             "status": 400,
         }
+
+    def _finish(self, rx: re.Pattern, method: str, t0: float,
+                status: int, out: Any) -> Tuple[int, Any]:
+        """Per-endpoint REST metrics: latency histogram + status-class
+        counter, labeled by the registered ROUTE pattern (bounded set —
+        never the raw path). Recording failures are swallowed: dropping
+        one sample must never fail the request it measured."""
+        try:
+            endpoint = self._pattern_of.get(rx, "<unregistered>")
+            m = self.node.metrics
+            m.histogram(
+                "estpu_rest_request_duration_seconds",
+                "REST dispatch latency by route pattern",
+                ("endpoint", "method"),
+            ).labels(endpoint, method).observe(time.perf_counter() - t0)
+            m.counter(
+                "estpu_rest_requests_total",
+                "REST requests by route pattern and status class",
+                ("endpoint", "method", "status"),
+            ).labels(endpoint, method, f"{int(status) // 100}xx").inc()
+        except Exception:  # tpulint: allow[R006] — dropping one metric
+            pass           # sample must never fail the measured request
+        return status, out
 
 
 def _decode_path_part(v: Optional[str]) -> Optional[str]:
@@ -207,6 +243,9 @@ def _register_all(rc: RestController):
     # registered before the /_nodes/{nodeid}/... patterns so the literal
     # path wins
     add("GET", "/_nodes/_local/trace", _node_trace)
+    # continuous metrics scrape (text exposition format 0.0.4): the node
+    # registry + the process-shared families (monitor/metrics.py)
+    add("GET", "/_prometheus/metrics", _prometheus_metrics)
 
     # cat API (text/plain-ish, returned as JSON rows when format=json)
     add("GET", "/_cat/indices", _cat_indices)
@@ -807,13 +846,155 @@ def _restore_snapshot(n: Node, p, b, repo: str, snap: str):
 
 # -- admin helpers -----------------------------------------------------------
 
-def _cluster_stats(n: Node, p, b):
-    total_docs = sum(s.num_docs for s in n.indices.values())
-    return 200, {
+def _prometheus_metrics(n: Node, p, b):
+    """GET /_prometheus/metrics: the node registry (+ process-shared
+    families) in text exposition format 0.0.4. Returned as a str so the
+    HTTP layer serves text/plain, the content type every scraper
+    accepts."""
+    return 200, n.metrics.expose()
+
+
+def _local_cluster_stats(n: Node) -> dict:
+    """THIS node's contribution to /_cluster/stats: its local shards'
+    index stats and its own node section (reference: ClusterStatsNode-
+    Response — each node reports itself, the coordinator aggregates).
+    ``_index_names`` is a merge helper the coordinator strips: in a
+    distributed index every member holds an IndexService for it, so
+    counting per-node indices would multiply the cluster index count."""
+    docs = 0
+    store = seg_count = seg_mem = 0
+    fd_mem = fd_ev = 0
+    shards_total = primaries = 0
+    for svc in n.indices.values():
+        for g in svc.groups:
+            primaries += 1
+            for shard in g.copies:
+                st = shard.stats()
+                shards_total += 1
+                if shard is g.primary:
+                    # docs count PRIMARIES only (reference:
+                    # ClusterStatsIndices — replica copies hold the same
+                    # documents; counting them would inflate by the
+                    # replication factor and disagree with hits.total)
+                    docs += st["docs"]["count"]
+                # store/segments/fielddata count EVERY copy — each holds
+                # its own device-resident structures (reference: store
+                # size in cluster stats includes replicas)
+                seg_count += st["segments"]["count"]
+                seg_mem += st["segments"]["memory_in_bytes"]
+                store += st["segments"]["memory_in_bytes"]
+                fd_mem += st["fielddata"]["memory_size_in_bytes"]
+                fd_ev += st["fielddata"]["evictions"]
+    from elasticsearch_tpu import __version__, resources
+    from elasticsearch_tpu.monitor.stats import process_stats
+    from elasticsearch_tpu.tracing import retrace
+
+    proc = process_stats()
+    fds = proc["open_file_descriptors"]
+    tp = {"completed": 0, "rejected": 0, "queue": 0}
+    if n._thread_pool is not None:
+        for st in n._thread_pool.stats().values():
+            for k in tp:
+                tp[k] += st[k]
+    tripped = sum(br.get("tripped", 0)
+                  for br in resources.BREAKERS.stats().values())
+    a = retrace.auditor()
+    return {
         "cluster_name": n.cluster_state.cluster_name,
-        "indices": {"count": len(n.indices), "docs": {"count": total_docs}},
-        "nodes": {"count": {"total": len(n.cluster_state.nodes)}},
+        "_index_names": sorted(n.indices),
+        "indices": {
+            "count": len(n.indices),
+            "shards": {"total": shards_total, "primaries": primaries},
+            "docs": {"count": docs},
+            "store": {"size_in_bytes": store},
+            "fielddata": {"memory_size_in_bytes": fd_mem,
+                          "evictions": fd_ev},
+            "segments": {"count": seg_count, "memory_in_bytes": seg_mem},
+        },
+        "nodes": {
+            "count": {"total": 1},
+            "versions": [__version__],
+            "process": {
+                "mem": {"resident_in_bytes": proc["mem"]["resident_in_bytes"]},
+                "open_file_descriptors": {"min": fds, "max": fds,
+                                          "avg": fds},
+            },
+            "thread_pool": tp,
+            "breakers": {"tripped": tripped},
+            "jit": {"traces_total": a.total() if a is not None else 0},
+        },
     }
+
+
+def _merge_cluster_stats(parts: List[dict], failed: int = 0) -> dict:
+    """Aggregate per-node contributions (reference: ClusterStatsResponse
+    merges ClusterStatsNodeResponses): index names UNION (every member
+    of a distributed index reports it), numeric sections sum, versions
+    union, fd min/max/avg combine."""
+    names: set = set()
+    versions: List[str] = []
+    for pt in parts:
+        names.update(pt.pop("_index_names", ()))
+        for v in pt["nodes"].pop("versions", ()):
+            if v not in versions:
+                versions.append(v)
+    fds = [pt["nodes"]["process"].pop("open_file_descriptors")
+           for pt in parts]
+    out = _sum_stats(parts)
+    out["indices"]["count"] = len(names)
+    out["nodes"]["versions"] = versions
+    good = [f for f in fds if f.get("min", -1) >= 0]
+    out["nodes"]["process"]["open_file_descriptors"] = {
+        "min": min((f["min"] for f in good), default=-1),
+        "max": max((f["max"] for f in good), default=-1),
+        "avg": (sum(f["avg"] for f in good) // len(good)) if good else -1,
+    }
+    if failed:
+        out["_nodes"] = {"total": len(parts) + failed,
+                         "successful": len(parts), "failed": failed}
+    return out
+
+
+def _cluster_stats(n: Node, p, b):
+    """GET /_cluster/stats: fans over every cluster member via the REST
+    proxy (each answers with its local contribution under
+    ``_local_only``) and aggregates indices + nodes sections — real
+    numbers instead of the former three-field stub. A dead peer is
+    counted in ``_nodes.failed``, the response stays 200 (reference:
+    TransportClusterStatsAction tolerates node-level failures)."""
+    local = _local_cluster_stats(n)
+    c = _mh(n)
+    if c is not None and "_local_only" in p:
+        # proxied member contribution: RAW and unmerged, `_index_names`
+        # kept — merging here would strip the names the coordinator's
+        # union needs, undercounting indices that live only on this
+        # member
+        return 200, local
+    parts = [local]
+    failed = 0
+    if c is not None:
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        for nid in c.data._other_nodes():
+            try:
+                res = c.data._send(nid, ACTION_REST_PROXY, {
+                    "method": "GET", "path": "/_cluster/stats",
+                    "params": {}})
+                if res.get("status") == 200 and res.get("payload"):
+                    parts.append(res["payload"])
+                else:
+                    failed += 1
+            except Exception:
+                failed += 1
+    out = _merge_cluster_stats(parts, failed=failed)
+    out["cluster_name"] = n.cluster_state.cluster_name
+    out["timestamp"] = int(time.time() * 1000)
+    try:
+        out["status"] = _cluster_health(n, {"_local_only": "1"}, b"")[1][
+            "status"]
+    except Exception:
+        out["status"] = "green"
+    return 200, out
 
 
 def _sum_stats(dicts):
@@ -3608,22 +3789,95 @@ def _cluster_reroute(n: Node, p, b):
     return 200, resp
 
 
+# stack tops that mean "parked, waiting for work" — the threads
+# ignore_idle_threads (default true) filters, the reference's known-idle
+# frame list (ThreadPool.Info idle states) translated to stdlib waits
+_IDLE_TOPS = {
+    ("threading.py", "wait"),
+    ("threading.py", "_wait_for_tstate_lock"),
+    ("queue.py", "get"),
+    ("selectors.py", "select"),
+    ("socketserver.py", "serve_forever"),
+    ("socketserver.py", "service_actions"),
+}
+
+
+def _stack_is_idle(stack: tuple) -> bool:
+    if not stack:
+        return True
+    fname, _line, func = stack[-1]
+    return (os.path.basename(fname), func) in _IDLE_TOPS
+
+
 def _hot_threads(n: Node, p, b):
-    """RestNodesHotThreadsAction: plain-text stack dump of the busiest
-    threads. Python has no per-thread CPU accounting, so every live thread
-    is reported (threads parameter caps the count)."""
+    """RestNodesHotThreadsAction with the reference's sampling semantics:
+    N snapshots taken ``?interval=`` apart (``?snapshots=``, default 10 ×
+    500ms), identical stacks collated per thread ("M/N snapshots sharing
+    following K elements"), busiest threads first, idle threads filtered
+    unless ``ignore_idle_threads=false``. Python exposes no per-thread
+    CPU clock, so "busy" is the fraction of snapshots in which the
+    thread sat in a non-idle frame — honest sampling, not fake
+    percentages."""
     import sys
     import traceback
 
+    from elasticsearch_tpu.search.service import _parse_timeout
+
     limit = int(p.get("threads", 3))
-    frames = sys._current_frames()
-    out = [f"::: {{{n.name}}}{{{n.node_id}}}"]
-    for t in list(threading.enumerate())[:limit]:
-        fr = frames.get(t.ident)
-        out.append(f"\n   {t.name}: daemon={t.daemon}")
-        if fr is not None:
-            out.extend("     " + ln.rstrip()
-                       for ln in traceback.format_stack(fr))
+    snapshots = max(1, min(int(p.get("snapshots", 10)), 64))
+    interval = _parse_timeout(p.get("interval", "500ms")) or 0.5
+    # bound one request's sampling wall time: the management pool has 2
+    # workers — a 10-minute interval ask must not wedge half of it
+    interval = max(0.0, min(interval, 10.0 / snapshots))
+    ignore_idle = str(p.get("ignore_idle_threads", "true")).lower() \
+        not in ("false", "0")
+
+    # per-thread: sample-count per distinct stack signature
+    seen: Dict[int, Dict[tuple, int]] = {}
+    names: Dict[int, Any] = {}
+    busy: Dict[int, int] = {}
+    me = threading.get_ident()
+    for i in range(snapshots):
+        if i:
+            time.sleep(interval)
+        frames = sys._current_frames()
+        for t in threading.enumerate():
+            fr = frames.get(t.ident)
+            # skip the sampler itself: it is non-idle in every snapshot
+            # by construction and would permanently occupy one of the
+            # busiest-N output slots
+            if fr is None or t.ident == me:
+                continue
+            stack = tuple((f.filename, f.lineno, f.name)
+                          for f in traceback.extract_stack(fr))
+            names[t.ident] = t
+            seen.setdefault(t.ident, {})
+            seen[t.ident][stack] = seen[t.ident].get(stack, 0) + 1
+            if not _stack_is_idle(stack):
+                busy[t.ident] = busy.get(t.ident, 0) + 1
+
+    ranked = sorted(seen, key=lambda i: (-busy.get(i, 0),
+                                         names[i].name or ""))
+    if ignore_idle:
+        ranked = [i for i in ranked if busy.get(i, 0) > 0]
+    out = [f"::: {{{n.name}}}{{{n.node_id}}}",
+           f"   Hot threads sampling: interval={int(interval * 1000)}ms, "
+           f"snapshots={snapshots}, busiestThreads={limit}, "
+           f"ignoreIdleThreads={str(ignore_idle).lower()}:"]
+    for ident in ranked[:limit]:
+        t = names[ident]
+        b_ct = busy.get(ident, 0)
+        pct = 100.0 * b_ct / snapshots
+        out.append(f"\n   {pct:.1f}% ({b_ct} out of {snapshots} snapshots "
+                   f"non-idle) usage by thread '{t.name}'")
+        # collate identical stacks, most-sampled first (the reference's
+        # "N/M snapshots sharing following K elements" lines)
+        for stack, ct in sorted(seen[ident].items(),
+                                key=lambda kv: -kv[1]):
+            out.append(f"     {ct}/{snapshots} snapshots sharing "
+                       f"following {len(stack)} elements")
+            out.extend(f"       {fname}:{line} {func}"
+                       for fname, line, func in stack)
     return 200, "\n".join(out)
 
 
@@ -4507,16 +4761,25 @@ def _typed(handler, keep_type: bool = False):
 
 def _cat_thread_pool(n: Node, p, b):
     """One row per node, 2.0 columns (bulk/index/search counters); the
-    per-pool detail rows the breaker tests read come via ?pools=true
-    (format=json), a superset the reference's `h=` column selection
-    doesn't cover."""
+    per-pool detail rows come via ?pools=true (format=json). Both forms
+    honor the reference's `h=` column selection (RestTable), and the
+    pool rows carry `largest`/`queue_size` so saturation history is
+    readable without /_nodes/stats."""
     stats = n.thread_pool.stats()
     if str(p.get("pools", "false")).lower() in ("", "true"):
-        return 200, [
+        rows = [
             {"node_name": n.name, "name": name, "active": st["active"],
-             "queue": st["queue"], "rejected": st["rejected"],
-             "threads": st["threads"], "completed": st["completed"]}
+             "queue": st["queue"], "queue_size": st["queue_size"],
+             "rejected": st["rejected"], "threads": st["threads"],
+             "largest": st["largest"], "completed": st["completed"]}
             for name, st in stats.items()]
+        # _CatRows so the ONE serialization layer (_cat_table /
+        # _cat_json_rows) applies h= selection exactly like every other
+        # _cat endpoint; default = every column, so format=json keeps
+        # threads/queue_size for existing consumers
+        return 200, _cat_rows(rows, ["node_name", "name", "active",
+                                     "queue", "queue_size", "rejected",
+                                     "threads", "largest", "completed"])
     def c(pool, key):
         return str(stats.get(pool, {}).get(key, 0))
     row = {

@@ -125,6 +125,18 @@ class Tracer:
         self._spans: deque = deque(maxlen=max_spans)
         self.started_total = 0
         self.finished_total = 0
+        # optional finished-span sink (monitor/metrics.py::span_sink):
+        # every close also lands in a latency histogram, so the span
+        # substrate doubles as continuous time-series without
+        # re-instrumenting call sites
+        self._sink = None
+
+    def set_sink(self, sink) -> None:
+        """``sink(span)`` called after every span close (outside the
+        ring lock). It must be cheap and must not raise; a sink failure
+        is swallowed — dropping one metric sample must never fail the
+        request the span measured."""
+        self._sink = sink
 
     @contextmanager
     def span(self, name: str, **tags: Any) -> Iterator[Span]:
@@ -149,6 +161,12 @@ class Tracer:
             with self._lock:
                 self.finished_total += 1
                 self._spans.append(sp)
+            sink = self._sink
+            if sink is not None:
+                try:
+                    sink(sp)
+                except Exception:
+                    pass  # a metrics failure must never fail the request
 
     def spans(self) -> List[Span]:
         with self._lock:

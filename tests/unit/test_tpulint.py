@@ -602,6 +602,127 @@ class TestR008:
         assert vs == []
 
 
+class TestR009:
+    """Metric recording on the device path (the metrics substrate's hard
+    constraint: no record calls inside jit-traced code, no device-array
+    arguments into record calls)."""
+
+    def test_bad_record_inside_traced_code(self):
+        vs = lint("""
+            import jax
+            from elasticsearch_tpu.monitor import metrics
+
+            REG = metrics.MetricsRegistry()
+            HITS = REG.counter("estpu_hits_total")
+
+            @jax.jit
+            def score(x):
+                HITS.inc()
+                return x * 2
+        """)
+        assert rules_of(vs) == ["R009"]
+        assert "jit-traced" in vs[0].message
+
+    def test_bad_chained_record_inside_traced_code(self):
+        vs = lint("""
+            import jax
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            @jax.jit
+            def score(x):
+                SHARED.histogram("lat").labels("a").observe(1.0)
+                return x
+        """)
+        assert rules_of(vs) == ["R009"]
+
+    def test_bad_kernels_record_inside_traced_code(self):
+        vs = lint("""
+            import jax
+            from elasticsearch_tpu.monitor import kernels
+
+            @jax.jit
+            def f(x):
+                kernels.record("bm25_scatter")
+                return x
+        """)
+        assert rules_of(vs) == ["R009"]
+
+    def test_bad_device_array_argument(self):
+        vs = lint("""
+            import jax.numpy as jnp
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            def after(scores):
+                top = jnp.max(scores)
+                SHARED.histogram("score").observe(top)
+        """)
+        assert rules_of(vs) == ["R009"]
+        assert "device" in vs[0].message
+
+    def test_bad_direct_jnp_argument(self):
+        vs = lint("""
+            import jax.numpy as jnp
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            def after(scores):
+                SHARED.counter("total").inc(jnp.sum(scores))
+        """)
+        assert rules_of(vs) == ["R009"]
+
+    def test_good_host_pull_then_record(self):
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            def after(scores):
+                top = jnp.max(scores)
+                v = float(jax.device_get(top))
+                SHARED.histogram("score").observe(v)
+        """)
+        assert vs == []
+
+    def test_good_host_record_and_attr_registry(self):
+        # node.metrics / self.metrics chains on the host path are the
+        # product idiom (rest dispatch, transport) — clean
+        vs = lint("""
+            import time
+
+            def finish(self, dt):
+                m = self.node.metrics
+                m.counter("estpu_rest_requests_total",
+                          "h", ("s",)).labels("2xx").inc()
+                m.histogram("estpu_rest_request_duration_seconds",
+                            "h").observe(dt)
+        """)
+        assert vs == []
+
+    def test_good_jax_at_set_not_a_record_call(self):
+        # jnp's functional update spells .set() too — target is an
+        # array, not a metric; must not flag
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return x.at[0].set(1.0)
+        """)
+        assert vs == []
+
+    def test_reassignment_clears_device_taint(self):
+        vs = lint("""
+            import jax.numpy as jnp
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            def after(scores, n):
+                top = jnp.max(scores)
+                top = float(n)
+                SHARED.histogram("score").observe(top)
+        """)
+        assert vs == []
+
+
 class TestSuppression:
     def test_same_line_allow(self):
         vs = lint("""

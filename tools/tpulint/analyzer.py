@@ -28,6 +28,9 @@ RULES: Dict[str, str] = {
             "timing module (use time.monotonic()/perf_counter)",
     "R008": "raw jax.device_put bypassing the residency registry "
             "(unaccounted HBM — route through elasticsearch_tpu.resources)",
+    "R009": "metric recording on the device path (record call inside "
+            "jit-traced code, or a device-array argument to a record "
+            "call — pull the scalar to host first)",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.

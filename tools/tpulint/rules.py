@@ -1,4 +1,4 @@
-"""tpulint rule visitors (R001–R005).
+"""tpulint rule visitors (R001–R009).
 
 One recursive walk per file carries the context every rule needs: the
 loop stack (R001/R002), the traced-function stack with its static/traced
@@ -104,6 +104,11 @@ class _ModuleInfo:
         self.time_mods: Set[str] = set()      # names bound to `import time`
         self.wall_fns: Set[str] = set()       # `from time import time [as t]`
         self.put_fns: Set[str] = set()        # `from jax import device_put`
+        # R009: names referring to the metrics module / registry objects
+        # and the kernel-dispatch counter module
+        self.metrics_mods: Set[str] = set()   # `from ...monitor import metrics`
+        self.metrics_objs: Set[str] = set()   # `from ...metrics import SHARED`
+        self.kernels_mods: Set[str] = set()   # `from ...monitor import kernels`
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for al in node.names:
@@ -125,6 +130,19 @@ class _ModuleInfo:
                     for al in node.names:
                         if al.name == "time":
                             self.wall_fns.add(al.asname or "time")
+                if node.module and node.module.endswith(".monitor"):
+                    for al in node.names:
+                        if al.name == "metrics":
+                            self.metrics_mods.add(al.asname or "metrics")
+                        elif al.name == "kernels":
+                            self.kernels_mods.add(al.asname or "kernels")
+                if node.module and node.module.endswith("monitor.metrics"):
+                    for al in node.names:
+                        self.metrics_objs.add(al.asname or al.name)
+                if node.module and node.module.endswith("monitor.kernels"):
+                    for al in node.names:
+                        if al.name == "record":
+                            self.kernels_mods.add("")  # bare record()
                 if node.module == "jax":
                     for al in node.names:
                         if al.name == "jit":
@@ -243,6 +261,10 @@ class _Checker(ast.NodeVisitor):
         # R007: per-scope names holding a time.time() result (module
         # scope at index 0; one frame per function)
         self.wall_names: List[Set[str]] = [set()]
+        # R009: per-scope names bound to metric objects (`h = m.histogram(
+        # ...)`) and names tainted as device values (`x = jnp.sum(...)`)
+        self.metric_names: List[Set[str]] = [set()]
+        self.device_names: List[Set[str]] = [set()]
 
     # -- emit ----------------------------------------------------------------
 
@@ -288,11 +310,15 @@ class _Checker(ast.NodeVisitor):
                        "retraces; hoist the jit out of the loop")
         self.fn_stack.append(node.name)
         self.wall_names.append(set())
+        self.metric_names.append(set())
+        self.device_names.append(set())
         # loop/iter context does not cross a function boundary
         saved = (self.loop_depth, self.iter_depth)
         self.loop_depth = self.iter_depth = 0
         self.generic_visit(node)
         self.loop_depth, self.iter_depth = saved
+        self.device_names.pop()
+        self.metric_names.pop()
         self.wall_names.pop()
         self.fn_stack.pop()
         if entering_trace:
@@ -385,7 +411,120 @@ class _Checker(ast.NodeVisitor):
         self._check_sync(node)
         self._check_dynamic_shapes(node)
         self._check_offbudget_put(node)
+        self._check_metric_record(node)
         self.generic_visit(node)
+
+    # -- R009 ---------------------------------------------------------------
+
+    METRIC_FACTORIES = {"counter", "gauge", "histogram", "labels"}
+    RECORD_METHODS = {"inc", "dec", "observe", "set"}
+
+    def _is_metric_expr(self, node: ast.AST) -> bool:
+        """Does ``node`` resolve to a metrics registry / metric object?
+        Recognized roots: names imported from monitor.metrics, the
+        module alias itself, a tracked local (`h = m.histogram(...)`),
+        or an attribute chain with a literal ``metrics`` segment
+        (``self.metrics``, ``node.metrics``)."""
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in self.METRIC_FACTORIES:
+                return self._is_metric_expr(f.value)
+            # MetricsRegistry(...) / metrics.MetricsRegistry(...) and kin
+            chain = _attr_chain(f)
+            if chain:
+                root = chain.split(".")[0]
+                return root in self.mod.metrics_objs \
+                    or root in self.mod.metrics_mods
+            return False
+        nm = _name(node)
+        if nm:
+            return nm in self.mod.metrics_objs \
+                or nm in self.mod.metrics_mods \
+                or any(nm in frame for frame in self.metric_names)
+        chain = _attr_chain(node)
+        if not chain:
+            return False
+        parts = chain.split(".")
+        return parts[0] in self.mod.metrics_objs \
+            or parts[0] in self.mod.metrics_mods \
+            or any(pt in ("metrics", "METRICS") for pt in parts)
+
+    def _is_record_call(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in self.RECORD_METHODS:
+            return self._is_metric_expr(f.value)
+        # monitor/kernels.py::record — the dispatch-counter twin
+        chain = _attr_chain(f) or ""
+        head, _, fn = chain.rpartition(".")
+        return fn == "record" and head in self.mod.kernels_mods
+
+    def _is_device_operand(self, node: ast.AST) -> bool:
+        """Expression that (syntactically) carries a device value into a
+        record call: a jnp-rooted call, a name assigned from one, or a
+        subscript/attribute/binop over either. Host pulls neutralize —
+        ``jax.device_get(x)`` / ``np.asarray(x)`` hand a HOST value to
+        the record call (the sync happened, visibly, outside)."""
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func) or ""
+            head, _, fn = chain.rpartition(".")
+            if head in self.mod.jax and fn == "device_get":
+                return False
+            if head in self.mod.np and fn in ("asarray", "array"):
+                return False
+            if head in self.mod.jnp:
+                return True
+            return any(self._is_device_operand(a) for a in node.args) \
+                or any(self._is_device_operand(k.value)
+                       for k in node.keywords)
+        if isinstance(node, (ast.Attribute, ast.Subscript)):
+            return self._is_device_operand(node.value)
+        if isinstance(node, ast.BinOp):
+            return self._is_device_operand(node.left) \
+                or self._is_device_operand(node.right)
+        nm = _name(node)
+        return bool(nm) and nm in self.device_names[-1]
+
+    def _assigned_device(self, val: ast.AST) -> bool:
+        """Assignment RHS that taints its target as a device value."""
+        if isinstance(val, ast.Call):
+            chain = _attr_chain(val.func) or ""
+            head, _, fn = chain.rpartition(".")
+            if head in self.mod.jax and fn == "device_get":
+                return False
+            if head in self.mod.np and fn in ("asarray", "array"):
+                return False
+            return head in self.mod.jnp
+        if isinstance(val, (ast.Attribute, ast.Subscript)):
+            return self._is_device_operand(val)
+        nm = _name(val)
+        return bool(nm) and nm in self.device_names[-1]
+
+    def _check_metric_record(self, node: ast.Call) -> None:
+        """R009: the hard observability constraint — recording a metric
+        must never touch a device value on the hot path. Inside traced
+        code a counter ticks once per COMPILE, not per execution (and
+        holds a lock under trace); a device-array argument forces a
+        blocking host sync inside the record call."""
+        if not self._is_record_call(node):
+            return
+        if self.traced_stack:
+            self._emit("R009", node,
+                       "metric record call inside jit-traced "
+                       f"`{self.traced_stack[-1].fn_name}` — it would tick "
+                       "once per compile, not per execution, and lock "
+                       "under trace; record on host after the program "
+                       "returns")
+            return
+        for arg in list(node.args) + [k.value for k in node.keywords]:
+            if self._is_device_operand(arg):
+                self._emit("R009", arg,
+                           "device-array argument to a metric record "
+                           "call — this blocks on a device sync inside "
+                           "the record path; pull the scalar to host "
+                           "first (float(jax.device_get(x))) and record "
+                           "the plain value")
+                return
 
     # -- R008 ---------------------------------------------------------------
 
@@ -671,6 +810,18 @@ class _Checker(ast.NodeVisitor):
                 if nm:
                     (self.wall_names[-1].add if wall
                      else self.wall_names[-1].discard)(nm)
+        # R009 name tracking: `h = m.histogram(...)` makes h a metric
+        # object; `x = jnp.sum(...)` taints x as a device value. Any
+        # other reassignment clears either mark.
+        is_metric = self._is_metric_expr(node.value)
+        is_dev = self._assigned_device(node.value)
+        for tgt in node.targets:
+            nm = _name(tgt)
+            if nm:
+                (self.metric_names[-1].add if is_metric
+                 else self.metric_names[-1].discard)(nm)
+                (self.device_names[-1].add if is_dev
+                 else self.device_names[-1].discard)(nm)
         self.generic_visit(node)
 
     def visit_AugAssign(self, node: ast.AugAssign) -> None:
