@@ -425,6 +425,86 @@ def batched_msearch_qps(node, queries, k):
     return len(pairs) / dt, dt
 
 
+def coalesced_qps(node, queries, k, n_threads=64):
+    """N concurrent client threads issuing SINGLE-search bodies — no
+    explicit ``_msearch`` — through the serving coalescer
+    (serving/coalescer.py). Directly comparable to batched_msearch_qps
+    on the same query set: the adaptive micro-batch queue must recover
+    most of the explicit-batch amortization (acceptance: >= 80%).
+    Returns (qps, dt, stats) where stats carries the coalescer's
+    batch-size histogram delta and flush-reason counters."""
+    import threading as _threading
+
+    bodies = [{"query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
+               "size": k} for q in queries]
+
+    def run_round():
+        errs = []
+        cursor = {"i": 0}
+        lock = _threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    i = cursor["i"]
+                    if i >= len(bodies):
+                        return
+                    cursor["i"] = i + 1
+                try:
+                    node.search("msmarco", bodies[i])
+                except Exception as e:  # a failed round must surface
+                    errs.append(e)
+                    return
+
+        threads = [_threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def _hist():
+        rows = node.metrics.summaries().get(
+            "estpu_coalescer_batch_size") or [{"count": 0,
+                                               "sum_seconds": 0.0}]
+        return rows[0]["count"], rows[0]["sum_seconds"]
+
+    def _flushes():
+        import re as _re
+
+        out = {}
+        for key, v in node.metrics.counter_values().items():
+            m = _re.match(
+                r'estpu_coalescer_flush_total\{reason="(\w+)"\}', key)
+            if m:
+                out[m.group(1)] = v
+        return out
+
+    run_round()  # warmup: compiles the pow2 batch shapes the queue emits
+    harvest_fallbacks()
+    reset_kernels_scoped()
+    c0, s0 = _hist()
+    f0 = _flushes()
+    t0 = time.perf_counter()
+    run_round()
+    dt = time.perf_counter() - t0
+    c1, s1 = _hist()
+    f1 = _flushes()
+    batches = c1 - c0
+    stats = {
+        "threads": n_threads,
+        "batches": batches,
+        "mean_batch": round((s1 - s0) / batches, 2) if batches else 0.0,
+        "flush_reasons": {r: int(f1.get(r, 0) - f0.get(r, 0))
+                          for r in f1 if f1.get(r, 0) - f0.get(r, 0)},
+        "queue_wait": (node.metrics.summaries().get(
+            "estpu_coalescer_queue_wait_seconds") or [{}])[0],
+    }
+    return len(bodies) / dt, dt, stats
+
+
 def _msearch_top1(node, q):
     """Top-1 doc id for one query through the product path (agreement
     probe for the bf16-impact secondary measurement)."""
@@ -817,6 +897,24 @@ def run_bench(args, jax) -> dict:
         log(f"batched msearch mixed: {len(mixed_q)} queries in "
             f"{mdt * 1000:.0f} ms -> {batched_qps_mixed:.0f} qps")
         PARTIAL["batched_qps_mixed"] = round(batched_qps_mixed, 1)
+        stage("coalesced-qps")
+        # cross-request coalescing (serving/): N concurrent clients
+        # firing SINGLE-search bodies — no explicit _msearch — must
+        # recover most of the explicit-batch amortization through the
+        # adaptive micro-batch queue (ROADMAP item #1 acceptance >= 80%)
+        try:
+            co_qps, cdt, co_stats = coalesced_qps(node, bat_q, args.k)
+            frac = co_qps / batched_qps if batched_qps else 0.0
+            log(f"coalesced: {len(bat_q)} single-search bodies over "
+                f"{co_stats['threads']} threads in {cdt * 1000:.0f} ms "
+                f"-> {co_qps:.0f} qps ({frac * 100:.0f}% of explicit "
+                f"msearch), mean batch {co_stats['mean_batch']}, "
+                f"flushes {co_stats['flush_reasons']}")
+            PARTIAL["coalesced_qps"] = round(co_qps, 1)
+            PARTIAL["coalesced_vs_batched"] = round(frac, 3)
+            PARTIAL["coalescer"] = co_stats
+        except Exception as e:  # the scenario must never sink the capture
+            log(f"coalesced_qps failed: {e}")
         stage("batched-msearch-bf16")
         # secondary: bf16-quantized impact block (SURVEY §6 lever) — same
         # batch, block rebuilt in bf16; report throughput AND top-1

@@ -62,6 +62,12 @@ class Node:
         self.metrics = MetricsRegistry(include_shared=True)
         self.tracer.set_sink(span_sink(self.metrics))
         self._register_metric_collectors()
+        # serving front-end: cross-request micro-batching + per-tenant
+        # QoS (serving/). Cheap to build — the drain thread is lazy, so
+        # library-embedded Nodes that never coalesce don't pay for it.
+        from elasticsearch_tpu.serving import ServingFrontend
+
+        self.serving = ServingFrontend(self)
         # resource management: rehydration spans (tpu.rehydrate) land in
         # this node's tracer ring (process-shared registry — the device
         # is process-shared too; last in-process node wins)
@@ -571,9 +577,22 @@ class Node:
             # searchers (reader() advances replica round-robin; calling it
             # twice per request would defeat replica rotation). The service
             # runs the mesh executor as the default product path.
-            return self.indices[searched_names[0]].search(
-                body or {}, dfs=(search_type == "dfs_query_then_fetch"),
-                preference=preference)
+            svc = self.indices[searched_names[0]]
+            dfs = search_type == "dfs_query_then_fetch"
+
+            def _run():
+                return svc.search(body or {}, dfs=dfs,
+                                  preference=preference)
+
+            if not dfs and preference is None:
+                # serving coalescer: eligible bodies of CONCURRENT
+                # requests park briefly and execute as one fused batch
+                # (serving/coalescer.py); lone requests and ineligible
+                # bodies run the normal path unchanged
+                out = self.serving.coalescer.execute(svc, body or {}, _run)
+                if out is not None:
+                    return out
+            return _run()
         if (body or {}).get("query"):
             from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
 
@@ -632,9 +651,12 @@ class Node:
         return resp
 
     def msearch(self, pairs: List[tuple]) -> dict:
-        # batched fast path: a uniform batch on one concrete index executes
-        # as ONE fused kernel per segment (search/batch.py); any
-        # non-uniformity falls back to the sequential loop below
+        # batched fast path: the ELIGIBLE SUBSET of a single-concrete-
+        # index batch executes as ONE fused kernel per segment
+        # (search/batch.py partial batching); ineligible items (aggs,
+        # sort, off-shape queries) ride the sequential loop below, and
+        # typed malformed-query items become per-item failures
+        pre: List[Optional[dict]] = [None] * len(pairs)
         if len(pairs) >= 2:
             # index may be a list (valid msearch header syntax) — those and
             # mixed-index batches take the sequential path
@@ -663,18 +685,21 @@ class Node:
                     except Exception:
                         out = None  # sequential path is always correct
                     if out is not None:
-                        return {"responses": out}
+                        pre = out
+        from elasticsearch_tpu.search.batch import msearch_error_entry
+
         responses = []
-        legacy_names = {"index_not_found_exception": "IndexMissingException"}
-        for header, body in pairs:
+        for (header, body), served in zip(pairs, pre):
+            if served is not None:
+                # fused-batch response, or a typed per-item failure the
+                # partial-batch split already shaped (2.0 msearch error
+                # strings like "IndexMissingException[no such index]")
+                responses.append(served)
+                continue
             try:
                 responses.append(self.search(header.get("index"), body))
             except ElasticsearchTpuException as e:
-                # 2.0 msearch reports error entries as strings like
-                # "IndexMissingException[no such index]"
-                name = legacy_names.get(e.error_type, e.error_type)
-                responses.append({"error": f"{name}[{e}]",
-                                  "status": e.status})
+                responses.append(msearch_error_entry(e))
         return {"responses": responses}
 
     def nodes_stats(self) -> dict:
@@ -783,6 +808,9 @@ class Node:
                     # + counter totals — the JSON view of the same
                     # numbers GET /_prometheus/metrics exposes
                     "metrics": self.metrics.summaries(),
+                    # serving front-end: coalescer queue depth/config +
+                    # per-tenant QoS shares (serving/)
+                    "serving": self.serving.stats(),
                     "slowlog": aggregate_slowlog(self.indices.values()),
                     # TPU-native extra: device kind + HBM usage
                     "accelerator": device_stats(),
@@ -843,6 +871,11 @@ class Node:
         }
 
     def close(self):
+        # drain the serving coalescer FIRST: parked requests must resolve
+        # (sequentially) before the indices they target close
+        serving = getattr(self, "serving", None)
+        if serving is not None:
+            serving.close()
         for svc in self.indices.values():
             svc.close()
         if self._ivf_dir is not None:

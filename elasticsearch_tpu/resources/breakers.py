@@ -69,7 +69,9 @@ def parse_limit(v, capacity: Optional[int] = None) -> int:
     return int(float(s))
 
 
-def _human(n: int) -> str:
+def human_bytes(n: int) -> str:
+    """ES-style byte rendering ("512.0kb") — shared by breaker stats and
+    the serving QoS layer's "Data too large" messages."""
     if n < 0:
         return "-1b"
     f = float(n)
@@ -78,6 +80,9 @@ def _human(n: int) -> str:
             return f"{f:.1f}{suf}" if suf != "b" else f"{int(f)}b"
         f /= 1024
     return f"{int(n)}b"
+
+
+_human = human_bytes  # module-internal call sites predate the public name
 
 
 class CircuitBreaker:

@@ -88,7 +88,9 @@ class RestController:
             return "get" if method in ("GET", "HEAD") else "index"
         return "management"
 
-    def dispatch(self, method: str, path: str, params: Dict[str, str], body: bytes) -> Tuple[int, Any]:
+    def dispatch(self, method: str, path: str, params: Dict[str, str],
+                 body: bytes,
+                 headers: Optional[Dict[str, str]] = None) -> Tuple[int, Any]:
         for m, rx, handler in self.routes:
             if m != method:
                 continue
@@ -97,14 +99,26 @@ class RestController:
                 # in_flight_requests breaker (reference: the netty-level
                 # inflight-requests accounting): body bytes held in
                 # memory while the request runs; trip → 429 before any
-                # handler work
+                # handler work. Search-family routes admit through the
+                # per-tenant QoS layer (serving/qos.py) over the SAME
+                # breaker: the tenant (X-Tenant-Id header / ?tenant=)
+                # charges its weighted share, so a greedy tenant 429s
+                # while other tenants keep serving.
                 from elasticsearch_tpu import resources
 
                 t0 = time.perf_counter()
+                pool = self.pool_for(method, path)
                 inflight = resources.BREAKERS.breaker("in_flight_requests")
                 nbytes = len(body or b"")
+                qos_token = None
                 try:
-                    inflight.break_or_reserve(nbytes, "<http_request>")
+                    if pool == "search":
+                        tenant = params.get("tenant") or (
+                            headers or {}).get("x-tenant-id")
+                        qos_token = self.node.serving.qos.admit(
+                            tenant, nbytes)
+                    else:
+                        inflight.break_or_reserve(nbytes, "<http_request>")
                 except ElasticsearchTpuException as e:
                     return self._finish(rx, method, t0, e.status,
                                         _error_body(e))
@@ -112,7 +126,7 @@ class RestController:
                     # run on the route's named pool: bounded concurrency,
                     # full queues reject with 429 (ThreadPool.java contract)
                     status, out = self.node.thread_pool.execute(
-                        self.pool_for(method, path),
+                        pool,
                         handler, self.node, params, body,
                         **{k: _decode_path_part(v)
                            for k, v in match.groupdict().items()})
@@ -131,7 +145,10 @@ class RestController:
                         "status": 500,
                     }
                 finally:
-                    inflight.release(nbytes)
+                    if qos_token is not None:
+                        self.node.serving.qos.release(qos_token)
+                    else:
+                        inflight.release(nbytes)
                 return self._finish(rx, method, t0, status, out)
         return 400, {
             "error": {"type": "illegal_argument_exception",
@@ -3577,6 +3594,9 @@ def _cluster_put_settings(n: Node, p, b):
     merged = {**n.cluster_settings["persistent"],
               **n.cluster_settings["transient"]}
     resources.apply_cluster_settings(merged)
+    # serving front-end settings (serving.coalescer.* / serving.qos.*)
+    # apply live through the same idempotent full-map path
+    n.serving.apply_cluster_settings(merged)
     return 200, {"acknowledged": True,
                  "persistent": n.cluster_settings["persistent"],
                  "transient": n.cluster_settings["transient"]}
@@ -4938,6 +4958,9 @@ class RestServer:
                                    keep_blank_values=True).items()}
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
+                # lower-cased header map: the QoS layer reads the tenant
+                # id (X-Tenant-Id) case-insensitively, like HTTP demands
+                hdrs = {k.lower(): v for k, v in self.headers.items()}
                 if (parsed.path.startswith("/_cat/")
                         and str(params.get("help", "false")).lower()
                         in ("", "true", "1")):
@@ -4946,10 +4969,11 @@ class RestServer:
                         status, payload = 200, help_text
                     else:
                         status, payload = controller.dispatch(
-                            method, parsed.path, params, body)
+                            method, parsed.path, params, body,
+                            headers=hdrs)
                 else:
                     status, payload = controller.dispatch(
-                        method, parsed.path, params, body)
+                        method, parsed.path, params, body, headers=hdrs)
                 ctype = "application/json; charset=UTF-8"
                 if isinstance(payload, str):
                     # text endpoints (hot_threads, _cat help): raw body
@@ -4998,7 +5022,14 @@ class RestServer:
             def log_message(self, fmt, *args):
                 pass
 
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        class _Server(ThreadingHTTPServer):
+            # socketserver's default listen backlog (5) RESETS concurrent
+            # connection bursts — exactly the traffic shape the serving
+            # coalescer exists for; deep backlog, bounded work via pools
+            request_queue_size = 128
+            daemon_threads = True
+
+        self.httpd = _Server((host, port), _Handler)
         self.host = host
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None

@@ -2,63 +2,159 @@
 
 Reference: org/elasticsearch/action/search/TransportMultiSearchAction.java —
 ES executes msearch items as independent parallel searches on the search
-thread pool. Here a batch that is uniformly eligible (one index, simple
-bodies whose queries are same-field BM25 term groups) amortizes into one
+thread pool. Here the eligible subset of a batch (simple bodies whose
+queries are same-field BM25 term groups on one index) amortizes into one
 device program per segment: pure-dense batches take the streaming top-k
 kernel (queries.fused_bm25_topk_batch); batches with scatter tails take
 the hybrid matmul + batched-scatter + on-device top-k tier
 (queries.hybrid_bm25_topk_batch). This is the product path behind the
-bench's batched-QPS headline.
+bench's batched-QPS headline AND the serving coalescer's flush
+(serving/coalescer.py).
 
-Anything non-uniform returns None and the caller runs the requests
-sequentially (identical results, unamortized).
+Partial batching: eligibility is per ITEM, not all-or-nothing — one
+aggs-bearing or off-shape item rides the sequential path while the other
+255 still amortize. Malformed-query items surface as ES-shaped msearch
+item failures instead of silently de-amortizing the whole batch.
 """
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from elasticsearch_tpu.search.context import SegmentContext
-from elasticsearch_tpu.search.queries import (fused_bm25_topk_batch,
+from elasticsearch_tpu.search.queries import (_fused_eligible_terms,
+                                              fused_bm25_topk_batch,
                                               hybrid_bm25_topk_batch,
                                               parse_query)
 from elasticsearch_tpu.search.service import ShardDoc
+from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
 
 _ALLOWED_KEYS = {"query", "size", "from", "_source"}
 
+#: 2.0 msearch reports error entries as strings like
+#: "IndexMissingException[no such index]" — legacy class-name mapping
+_LEGACY_ERROR_NAMES = {"index_not_found_exception": "IndexMissingException"}
 
-def try_batched_msearch(svc, bodies: List[dict]) -> Optional[List[dict]]:
-    """All-or-nothing batch execution over one index; None → sequential."""
-    t0 = time.perf_counter()
-    for b in bodies:
+
+def msearch_error_entry(e: ElasticsearchTpuException) -> dict:
+    """ES-shaped (2.0-style) msearch item failure for a typed error."""
+    name = _LEGACY_ERROR_NAMES.get(e.error_type, e.error_type)
+    return {"error": f"{name}[{e}]", "status": e.status}
+
+
+def split_batchable(bodies: List[dict]) -> Tuple[
+        List[int], Dict[int, object], Dict[int, ElasticsearchTpuException]]:
+    """Per-item batch eligibility over an msearch body list.
+
+    Returns ``(eligible, parsed, errors)``: positions whose bodies may
+    batch (simple key set, parseable query, sane result window) with
+    their parsed query trees, and positions whose queries raised a TYPED
+    parse error — those become per-item msearch failures instead of
+    forcing the whole batch sequential. Anything else (aggs, sort,
+    unexpected parser bugs) is left to the sequential path, whose
+    behavior is the reference."""
+    eligible: List[int] = []
+    parsed: Dict[int, object] = {}
+    errors: Dict[int, ElasticsearchTpuException] = {}
+    for i, b in enumerate(bodies):
         if not isinstance(b, dict) or set(b) - _ALLOWED_KEYS:
-            return None
+            continue
+        try:
+            q = parse_query(b.get("query"))
+        except ElasticsearchTpuException as e:
+            # typed malformed-query error: the sequential path would
+            # report exactly this per-item failure — surface it without
+            # de-amortizing the remaining items
+            errors[i] = e
+            continue
+        except Exception:
+            continue  # unexpected: the sequential path decides
+        try:
+            frm, size = int(b.get("from", 0)), int(b.get("size", 10))
+        except (TypeError, ValueError):
+            continue
+        if not 1 <= frm + size <= 10_000:
+            continue
+        eligible.append(i)
+        parsed[i] = q
+    return eligible, parsed, errors
+
+
+def _probe_segment(svc):
+    for g in svc.groups:
+        for sh in g.copies:
+            if sh.searcher.segments:
+                return sh.searcher.segments[0]
+    return None
+
+
+def batch_field(svc, query) -> Optional[str]:
+    """The dense-impact field ``query`` would batch on (None = not a
+    same-field disjunctive term group). Probes the index's first frozen
+    segment — per-segment tiers may still refuse at execution time; the
+    caller falls back sequentially then."""
+    probe = _probe_segment(svc)
+    if probe is None or probe.has_nested:
+        return None
     try:
-        queries = [parse_query(b.get("query")) for b in bodies]
+        ctx = SegmentContext(probe, svc.mappings, svc.analysis,
+                             index_name=svc.name)
+        e = _fused_eligible_terms(ctx, query)
     except Exception:
-        return None  # sequential path reports the per-request error
-    sizes = [(int(b.get("from", 0)), int(b.get("size", 10))) for b in bodies]
+        return None
+    return None if e is None else e[0]
+
+
+def execute_batch(svc, bodies: List[dict], queries: Optional[list] = None,
+                  pad_pow2: bool = False) -> Optional[List[dict]]:
+    """Fused batch execution of uniform single-search bodies over one
+    index: one vmapped device program per segment, per-request responses
+    in order, or None when the fused tiers refuse (the sequential path
+    is always correct).
+
+    ``pad_pow2`` pads the batch (and the top-k width) to power-of-two
+    buckets with copies of the first query so the coalescer's
+    variable-size batches reuse compiled programs instead of retracing
+    per distinct batch size; padded rows are dropped before the
+    per-request merge, so responses are byte-identical either way."""
+    t0 = time.perf_counter()
+    if queries is None:
+        try:
+            queries = [parse_query(b.get("query")) for b in bodies]
+        except ElasticsearchTpuException:
+            return None  # caller's sequential path reports the error
+    sizes = [(int(b.get("from", 0)), int(b.get("size", 10)))
+             for b in bodies]
     k = max(frm + size for frm, size in sizes)
-    if k > 10_000 or k < 1:
+    if not 1 <= k <= 10_000:
         return None
     Q = len(bodies)
+    exec_queries = list(queries)
+    if pad_pow2:
+        from elasticsearch_tpu.utils.shapes import pow2_bucket
+
+        exec_queries += [queries[0]] * (pow2_bucket(Q, minimum=2) - Q)
+        # a wider k only ADDS candidates; the per-request truncation at
+        # its own from+size keeps results exact
+        k = min(pow2_bucket(k, minimum=8), 10_000)
     searchers = [g.reader().searcher for g in svc.groups]
     cands: List[list] = [[] for _ in range(Q)]
-    totals = np.zeros(Q, np.int64)
+    totals = np.zeros(len(exec_queries), np.int64)
     for pos, s in enumerate(searchers):
         for seg in s.segments:
             if seg.has_nested:
                 return None
             ctx = SegmentContext(seg, svc.mappings, svc.analysis,
                                  index_name=svc.name)
-            out = fused_bm25_topk_batch(ctx, queries, min(k, seg.max_docs))
+            out = fused_bm25_topk_batch(ctx, exec_queries,
+                                        min(k, seg.max_docs))
             if out is None:
                 # tier 2: scatter tails allowed — one matmul + batched
                 # scatter + on-device per-query top-k (queries.
                 # hybrid_bm25_topk_batch)
-                out = hybrid_bm25_topk_batch(ctx, queries,
+                out = hybrid_bm25_topk_batch(ctx, exec_queries,
                                              min(k, seg.max_docs))
             if out is None:
                 return None
@@ -71,6 +167,7 @@ def try_batched_msearch(svc, bodies: List[dict]) -> Optional[List[dict]]:
     q_ms = (time.perf_counter() - t0) * 1000
     for s in searchers:
         # counters must match what Q sequential requests would record
+        # (padding rows are compile-shape filler, not served requests)
         s.stats.on_query(q_ms / max(len(searchers), 1), n=Q)
 
     responses = []
@@ -119,3 +216,45 @@ def try_batched_msearch(svc, bodies: List[dict]) -> Optional[List[dict]]:
             },
         })
     return responses
+
+
+def try_batched_msearch(svc, bodies: List[dict],
+                        min_batch: int = 2) -> Optional[List[Optional[dict]]]:
+    """Partial batch execution over one index.
+
+    Returns None when nothing amortizes (the caller runs everything
+    sequentially — the old all-or-nothing contract), else a per-item
+    list aligned with ``bodies``: a response dict for items served by
+    the fused batch, an msearch error entry for typed malformed-query
+    items, and None for the sequential remainder the caller must run
+    itself (aggs/sort items, off-shape queries, per-segment tier
+    refusals)."""
+    eligible, parsed, errors = split_batchable(bodies)
+    out: List[Optional[dict]] = [None] * len(bodies)
+    for i, e in errors.items():
+        out[i] = msearch_error_entry(e)
+    # group by the dense-impact field: one impact block per kernel call,
+    # so only the largest same-field group batches; stragglers stay
+    # sequential (a second fused pass would rarely pay for its compile)
+    probe = _probe_segment(svc)
+    groups: Dict[str, List[int]] = {}
+    if probe is not None and not probe.has_nested:
+        ctx = SegmentContext(probe, svc.mappings, svc.analysis,
+                             index_name=svc.name)
+        for i in eligible:
+            try:
+                e = _fused_eligible_terms(ctx, parsed[i])
+            except Exception:
+                continue  # sequential path decides
+            if e is not None:
+                groups.setdefault(e[0], []).append(i)
+    batch_idx = max(groups.values(), key=len, default=[])
+    if len(batch_idx) < min_batch:
+        return out if errors else None
+    responses = execute_batch(svc, [bodies[i] for i in batch_idx],
+                              queries=[parsed[i] for i in batch_idx])
+    if responses is None:
+        return out if errors else None
+    for i, r in zip(batch_idx, responses):
+        out[i] = r
+    return out

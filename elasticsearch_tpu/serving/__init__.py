@@ -1,0 +1,47 @@
+"""Serving front-end: cross-request micro-batching + per-tenant QoS.
+
+One subsystem, two halves (see docs/SERVING.md):
+
+- :mod:`coalescer` — the adaptive micro-batch queue between REST
+  dispatch and the search executor: concurrent independent searches
+  coalesce into one vmapped device program per (index, query-shape)
+  bucket and fan their top-k back out.
+- :mod:`qos` — weighted per-tenant admission over the
+  ``in_flight_requests`` breaker: a greedy tenant 429s against its own
+  share while other tenants keep serving.
+
+Each :class:`~elasticsearch_tpu.node.Node` owns one
+:class:`ServingFrontend` (``node.serving``); REST dispatch admits
+through ``serving.qos`` and ``Node.search`` routes eligible
+single-index bodies through ``serving.coalescer``.
+
+Import cost: no jax at import time — the device work happens inside
+search/batch.py at flush time.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from elasticsearch_tpu.serving.coalescer import QueryCoalescer
+from elasticsearch_tpu.serving.qos import TenantAdmission
+
+__all__ = ["QueryCoalescer", "TenantAdmission", "ServingFrontend"]
+
+
+class ServingFrontend:
+    """Per-node serving layer: coalescer + QoS, one settings surface."""
+
+    def __init__(self, node):
+        self.coalescer = QueryCoalescer(node)
+        self.qos = TenantAdmission(node.metrics)
+
+    def apply_cluster_settings(self, flat: Dict[str, object]) -> None:
+        self.coalescer.apply_cluster_settings(flat)
+        self.qos.apply_cluster_settings(flat)
+
+    def stats(self) -> dict:
+        return {"coalescer": self.coalescer.stats(),
+                "qos": self.qos.stats()}
+
+    def close(self) -> None:
+        self.coalescer.close()

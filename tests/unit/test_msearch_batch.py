@@ -90,3 +90,42 @@ def test_unbatchable_falls_back_sequential(node):
     assert len(resp["responses"]) == 2
     for r in resp["responses"]:
         assert r["hits"]["total"] > 0
+
+
+def test_partial_batching_splits_around_ineligible_items(node):
+    """One aggs item must no longer de-amortize the batch: the eligible
+    subset still serves via the fused tier, the aggs item runs
+    sequentially, and every response matches sequential execution."""
+    kernels.reset()
+    pairs = _pairs(["alpha beta", "gamma", "beta delta"])
+    pairs.insert(1, ({"index": "mx"}, {
+        "query": {"match_all": {}}, "size": 0,
+        "aggs": {"words": {"terms": {"field": "body"}}}}))
+    resp = node.msearch(pairs)
+    assert kernels.snapshot().get("bm25_fused_topk", 0) >= 3
+    assert "aggregations" in resp["responses"][1]
+    for i, q in ((0, "alpha beta"), (2, "gamma"), (3, "beta delta")):
+        seq = node.search("mx", {"query": {"match": {"body": q}},
+                                 "size": 10})
+        got = [(h["_id"], round(h["_score"], 4))
+               for h in resp["responses"][i]["hits"]["hits"]]
+        want = [(h["_id"], round(h["_score"], 4))
+                for h in seq["hits"]["hits"]]
+        assert got == want, (q, got, want)
+
+
+def test_malformed_item_error_matches_sequential_shape(node):
+    """A typed malformed-query item becomes a per-item msearch failure
+    with EXACTLY the error string the sequential path reports, while
+    the rest of the batch stays fused."""
+    kernels.reset()
+    bad = {"query": {"definitely_not_a_query": {}}}
+    resp = node.msearch(_pairs(["alpha", "beta gamma"])
+                        + [({"index": "mx"}, bad)])
+    assert kernels.snapshot().get("bm25_fused_topk", 0) >= 2
+    entry = resp["responses"][2]
+    assert entry["status"] == 400 and "error" in entry
+    # sequential reference: a lone msearch item takes the per-item
+    # error path in Node.msearch — the strings must match exactly
+    seq_entry = node.msearch([({"index": "mx"}, bad)])["responses"][0]
+    assert entry == seq_entry

@@ -17,10 +17,11 @@ from tools.tpulint.baseline import filter_baselined, load_baseline
 
 def lint(src: str, *, hot: bool = False, locked: bool = False,
          ops: bool = False, swallow: bool = False, timing: bool = False,
-         budget: bool = False, path: str = "elasticsearch_tpu/x/mod.py"):
+         budget: bool = False, blocking: bool = False,
+         path: str = "elasticsearch_tpu/x/mod.py"):
     return lint_source(textwrap.dedent(src), path, hot=hot, ops=ops,
                        locked=locked, swallow=swallow, timing=timing,
-                       budget=budget)
+                       budget=budget, blocking=blocking)
 
 
 def rules_of(violations):
@@ -721,6 +722,158 @@ class TestR009:
                 SHARED.histogram("score").observe(top)
         """)
         assert vs == []
+
+
+class TestR010:
+    """Unbounded blocking waits while holding a lock in serving modules
+    (the coalescer's drain-path wedge hazard)."""
+
+    def test_bad_event_wait_under_lock(self):
+        vs = lint("""
+            import threading
+
+            class Coalescer:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._evt = threading.Event()
+
+                def drain(self):
+                    with self._lock:
+                        self._evt.wait()
+        """, blocking=True)
+        assert rules_of(vs) == ["R010"]
+        assert "timeout" in vs[0].message
+
+    def test_bad_condition_wait_under_its_own_lock(self):
+        # `with cond:` acquires the condition's lock — the classic shape
+        vs = lint("""
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def drain(self):
+                    with self._cv:
+                        self._cv.wait()
+        """, blocking=True)
+        assert rules_of(vs) == ["R010"]
+
+    def test_bad_queue_get_under_module_lock(self):
+        vs = lint("""
+            import queue
+            import threading
+
+            _LOCK = threading.Lock()
+            _Q = queue.Queue()
+
+            def drain():
+                with _LOCK:
+                    return _Q.get()
+        """, blocking=True)
+        assert rules_of(vs) == ["R010"]
+        assert "queue" in vs[0].message
+
+    def test_bad_block_true_forms_still_flag(self):
+        # get(True) / get(block=True) are unbounded blocking gets — the
+        # spelled-out default must not evade the rule
+        vs = lint("""
+            import queue
+            import threading
+
+            _LOCK = threading.Lock()
+            _Q = queue.Queue()
+
+            def a():
+                with _LOCK:
+                    return _Q.get(True)
+
+            def b():
+                with _LOCK:
+                    return _Q.get(block=True)
+        """, blocking=True)
+        assert [v.rule for v in vs] == ["R010", "R010"]
+
+    def test_good_nonblocking_and_dict_style_gets(self):
+        vs = lint("""
+            import queue
+            import threading
+
+            _LOCK = threading.Lock()
+            _Q = queue.Queue()
+            _D = {}
+
+            def a():
+                with _LOCK:
+                    return _Q.get(False)      # non-blocking
+
+            def b():
+                with _LOCK:
+                    return _Q.get(True, 5)    # positional timeout
+
+            def c():
+                with _LOCK:
+                    return _Q.get(block=True, timeout=2)
+
+            def d(key):
+                with _LOCK:
+                    return _D.get(key)        # dict get, not a queue wait
+        """, blocking=True)
+        assert vs == []
+
+    def test_good_timeout_bounded_waits(self):
+        vs = lint("""
+            import queue
+            import threading
+
+            class Coalescer:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._evt = threading.Event()
+                    self._q = queue.Queue()
+
+                def drain(self):
+                    with self._cv:
+                        self._cv.wait(timeout=0.5)
+                    with self._cv:
+                        self._evt.wait(0.05)
+                    with self._cv:
+                        return self._q.get(timeout=1.0)
+        """, blocking=True)
+        assert vs == []
+
+    def test_good_unbounded_wait_without_lock(self):
+        # parking OUTSIDE any lock is the correct shape — not flagged
+        vs = lint("""
+            import threading
+
+            class Entry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.done = threading.Event()
+
+                def wait_result(self):
+                    self.done.wait()
+        """, blocking=True)
+        assert vs == []
+
+    def test_scope_only_serving_modules(self):
+        src = """
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._evt = threading.Event()
+
+                def run(self):
+                    with self._lock:
+                        self._evt.wait()
+        """
+        assert any(v.rule == "R010" for v in lint_source(
+            textwrap.dedent(src), "elasticsearch_tpu/serving/coalescer.py"))
+        assert not lint_source(textwrap.dedent(src),
+                               "elasticsearch_tpu/index/other.py")
 
 
 class TestSuppression:

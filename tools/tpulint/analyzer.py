@@ -31,6 +31,9 @@ RULES: Dict[str, str] = {
     "R009": "metric recording on the device path (record call inside "
             "jit-traced code, or a device-array argument to a record "
             "call — pull the scalar to host first)",
+    "R010": "unbounded blocking wait (Event.wait/Condition.wait/queue.get "
+            "without timeout) while holding a lock in a serving module — "
+            "one lost notify wedges every parked request behind it",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.
@@ -62,6 +65,11 @@ TIMING_PATH_MARKERS = ("/tracing/", "/monitor/")
 # measurement code outside the serving budget.
 BUDGET_PATH_MARKERS = ("/elasticsearch_tpu/",)
 BUDGET_EXEMPT_MARKERS = ("/elasticsearch_tpu/resources/",)
+# R010 scope: the serving front-end — request threads park on events and
+# the drain thread sleeps on a condition; an UNBOUNDED wait while holding
+# a lock turns one lost notify (or a crashed drain loop) into every
+# parked client wedging forever. Timeout-bounded waits re-check state.
+BLOCKING_PATH_MARKERS = ("/serving/",)
 
 _ALLOW_RE = re.compile(r"#\s*tpulint:\s*allow\[\s*([A-Z0-9,\s]+?)\s*\]")
 _HOST_RE = re.compile(r"#\s*tpulint:\s*host\b")
@@ -146,10 +154,11 @@ def lint_source(
     swallow: Optional[bool] = None,
     timing: Optional[bool] = None,
     budget: Optional[bool] = None,
+    blocking: Optional[bool] = None,
 ) -> List[Violation]:
     """Lint one source string. ``hot``/``ops``/``locked``/``swallow``/
-    ``timing``/``budget`` override the path-based scoping (fixture tests
-    use these; production runs infer from the path)."""
+    ``timing``/``budget``/``blocking`` override the path-based scoping
+    (fixture tests use these; production runs infer from the path)."""
     from tools.tpulint import rules as _rules
 
     tree = ast.parse(source, filename=path)
@@ -168,6 +177,8 @@ def lint_source(
         budget=((_matches(path, BUDGET_PATH_MARKERS)
                  and not _matches(path, BUDGET_EXEMPT_MARKERS))
                 if budget is None else budget),
+        blocking=(_matches(path, BLOCKING_PATH_MARKERS)
+                  if blocking is None else blocking),
         host_lines=supp.host,
     )
     found = _rules.check_module(tree, ctx)

@@ -1,4 +1,4 @@
-"""tpulint rule visitors (R001–R009).
+"""tpulint rule visitors (R001–R010).
 
 One recursive walk per file carries the context every rule needs: the
 loop stack (R001/R002), the traced-function stack with its static/traced
@@ -37,6 +37,7 @@ class FileContext:
     swallow: bool = False  # R006 applies (failure-domain modules)
     timing: bool = False   # R007 applies (tracing//monitor/ modules)
     budget: bool = False   # R008 applies (product package, not resources/)
+    blocking: bool = False  # R010 applies (serving/ modules)
     host_lines: Set[int] = field(default_factory=set)
 
 
@@ -100,6 +101,7 @@ class _ModuleInfo:
         self.jitted: Dict[str, JitTarget] = {}
         self.wrapped_fns: Set[str] = set()    # g in `f = jax.jit(g)`
         self.module_locks: Set[str] = set()
+        self.module_conds: Set[str] = set()   # threading.Condition globals
         self.shared_globals: Set[str] = set()
         self.time_mods: Set[str] = set()      # names bound to `import time`
         self.wall_fns: Set[str] = set()       # `from time import time [as t]`
@@ -180,6 +182,12 @@ class _ModuleInfo:
                             "Lock", "RLock"):
                         self.module_locks.add(tgt)
                         continue
+                    if chain.endswith(".Condition") or chain == "Condition":
+                        # a Condition's `with` acquires its lock — R010
+                        # treats it as lock-holding (R005 lock semantics
+                        # deliberately unchanged)
+                        self.module_conds.add(tgt)
+                        continue
                     if self.is_jit_expr(val):
                         self.jitted[tgt] = JitTarget(self.jit_statics(val))
                         continue
@@ -255,8 +263,10 @@ class _Checker(ast.NodeVisitor):
         self.iter_depth = 0            # + comprehensions (R002 per-hit)
         self.traced_stack: List[_TracedCtx] = []
         self.lock_depth = 0            # inside `with <known lock>`
+        self.block_depth = 0           # inside `with <lock OR condition>`
         self.class_stack: List[str] = []
         self.class_locks: Dict[str, Set[str]] = {}  # class -> self lock attrs
+        self.class_conds: Dict[str, Set[str]] = {}  # class -> self cond attrs
         self.fn_stack: List[str] = []
         # R007: per-scope names holding a time.time() result (module
         # scope at index 0; one frame per function)
@@ -277,8 +287,9 @@ class _Checker(ast.NodeVisitor):
     # -- structure visitors --------------------------------------------------
 
     def visit_ClassDef(self, node: ast.ClassDef) -> None:
-        if self.ctx.locked:
+        if self.ctx.locked or self.ctx.blocking:
             locks: Set[str] = set()
+            conds: Set[str] = set()
             for sub in ast.walk(node):
                 if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
                     chain = _attr_chain(sub.targets[0]) or ""
@@ -288,7 +299,11 @@ class _Checker(ast.NodeVisitor):
                         if vchain.endswith((".Lock", ".RLock")) or \
                                 vchain in ("Lock", "RLock"):
                             locks.add(chain[len("self."):])
+                        elif vchain.endswith(".Condition") or \
+                                vchain == "Condition":
+                            conds.add(chain[len("self."):])
             self.class_locks[node.name] = locks
+            self.class_conds[node.name] = conds
         self.class_stack.append(node.name)
         self.generic_visit(node)
         self.class_stack.pop()
@@ -363,11 +378,18 @@ class _Checker(ast.NodeVisitor):
     def visit_With(self, node: ast.With) -> None:
         holds = any(self._is_lock_expr(item.context_expr)
                     for item in node.items)
+        # R010 lock surface: `with cond:` acquires the condition's lock
+        holds_block = holds or (self.ctx.blocking and any(
+            self._is_cond_expr(item.context_expr) for item in node.items))
         if holds:
             self.lock_depth += 1
+        if holds_block:
+            self.block_depth += 1
         self.generic_visit(node)
         if holds:
             self.lock_depth -= 1
+        if holds_block:
+            self.block_depth -= 1
 
     def visit_If(self, node: ast.If) -> None:
         self._check_control_flow(node)
@@ -412,6 +434,7 @@ class _Checker(ast.NodeVisitor):
         self._check_dynamic_shapes(node)
         self._check_offbudget_put(node)
         self._check_metric_record(node)
+        self._check_blocking_wait(node)
         self.generic_visit(node)
 
     # -- R009 ---------------------------------------------------------------
@@ -525,6 +548,55 @@ class _Checker(ast.NodeVisitor):
                            "first (float(jax.device_get(x))) and record "
                            "the plain value")
                 return
+
+    # -- R010 ---------------------------------------------------------------
+
+    def _check_blocking_wait(self, node: ast.Call) -> None:
+        """R010: an UNBOUNDED ``.wait()`` (Event/Condition) or zero-arg
+        ``.get()`` (queue) while holding a lock in a serving module —
+        one lost notify (or a crashed drain thread) wedges every parked
+        request behind the held lock. A timeout (positional or
+        ``timeout=``) bounds the wait so the caller re-checks state;
+        ``block=False`` gets are non-blocking."""
+        if not self.ctx.blocking or not self.block_depth:
+            return
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return
+        if f.attr == "wait":
+            if node.args or any(kw.arg == "timeout"
+                                for kw in node.keywords):
+                return
+            self._emit("R010", node,
+                       "unbounded .wait() while holding a lock in a "
+                       "serving module — a lost notify wedges every "
+                       "parked request behind this lock; pass timeout= "
+                       "and re-check state in a loop")
+        elif f.attr == "get":
+            # bounded/non-blocking forms pass: get(timeout=...),
+            # get(block=False), get(False), get(True, 5) — but
+            # get(True) / get(block=True) are UNBOUNDED blocking gets,
+            # the exact hazard the rule exists for. Exactly one
+            # positional that isn't the literal True is a plain
+            # dict-style get(key) — not a queue wait.
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                return
+            if len(node.args) >= 2:
+                return  # positional (block, timeout)
+            blk = next((kw.value for kw in node.keywords
+                        if kw.arg == "block"), None)
+            if blk is not None and not (
+                    isinstance(blk, ast.Constant) and blk.value is True):
+                return  # block=False / dynamic: benefit of the doubt
+            if len(node.args) == 1 and not (
+                    isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value is True):
+                return  # get(False) non-blocking / dict get(key)
+            self._emit("R010", node,
+                       "unbounded queue .get() while holding a lock in "
+                       "a serving module — bound it (timeout=) or make "
+                       "it non-blocking (block=False) so the drain path "
+                       "can't wedge behind an empty queue")
 
     # -- R008 ---------------------------------------------------------------
 
@@ -750,6 +822,16 @@ class _Checker(ast.NodeVisitor):
         chain = _attr_chain(expr) or ""
         if chain.startswith("self.") and self.class_stack:
             return chain[len("self."):] in self.class_locks.get(
+                self.class_stack[-1], set())
+        return False
+
+    def _is_cond_expr(self, expr: ast.AST) -> bool:
+        nm = _name(expr)
+        if nm and nm in self.mod.module_conds:
+            return True
+        chain = _attr_chain(expr) or ""
+        if chain.startswith("self.") and self.class_stack:
+            return chain[len("self."):] in self.class_conds.get(
                 self.class_stack[-1], set())
         return False
 
