@@ -98,10 +98,10 @@ def content_key(vecs_host: np.ndarray, exists_host: np.ndarray,
     return h.hexdigest()
 
 
-def _disk_paths(key: str) -> List[str]:
+def _disk_paths(key: str, ext: str = "ivf") -> List[str]:
     with _LOCK:
         dirs = list(_DIRS)
-    return [os.path.join(d, f"{key}.ivf") for d in dirs]
+    return [os.path.join(d, f"{key}.{ext}") for d in dirs]
 
 
 def load(key: str):
@@ -152,13 +152,72 @@ def store(key: str, ivf: Any) -> bytes:
     return blob
 
 
-def seed(key: str, blob: bytes) -> None:
-    """Insert an already-encoded blob (snapshot restore pre-seeding)."""
+def load_pq(key: str):
+    """Return host-side PqHostParts for ``key`` or None — the PQ sibling
+    of :func:`load`, sharing the content-address (one slab digest keys
+    both its IVF quantizer and its PQ codes, under different
+    extensions). A corrupt blob is deleted and treated as a miss."""
+    from elasticsearch_tpu.index.store import CorruptStoreException, read_pq
+
+    mkey = f"pq:{key}"
     with _LOCK:
-        if key not in _MEM and len(_MEM) >= _MEM_CAP:
+        blob = _MEM.get(mkey)
+    if blob is not None:
+        try:
+            parts = read_pq(blob)
+        except CorruptStoreException:
+            with _LOCK:
+                _MEM.pop(mkey, None)
+        else:
+            kernels.record("pq_cache_hit")
+            return parts
+    for path in _disk_paths(key, ext="pq"):
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path, "rb") as fh:
+                blob = fh.read()
+        except OSError:
+            continue
+        try:
+            parts = read_pq(blob)
+        except CorruptStoreException:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            continue
+        kernels.record("pq_cache_hit")
+        return parts
+    return None
+
+
+def store_pq(key: str, parts: Any) -> bytes:
+    """Persist host PqHostParts under ``key`` (memory + every registered
+    directory). Returns the encoded blob (snapshot payloads reuse it)."""
+    from elasticsearch_tpu.index.store import write_pq
+
+    blob = write_pq(parts)
+    seed_pq(key, blob)
+    return blob
+
+
+def seed_pq(key: str, blob: bytes) -> None:
+    """Insert an already-encoded PQ blob (snapshot restore pre-seeding)."""
+    _seed(f"pq:{key}", blob, _disk_paths(key, ext="pq"))
+
+
+def seed(key: str, blob: bytes) -> None:
+    """Insert an already-encoded IVF blob (snapshot restore pre-seeding)."""
+    _seed(key, blob, _disk_paths(key))
+
+
+def _seed(mkey: str, blob: bytes, paths: List[str]) -> None:
+    with _LOCK:
+        if mkey not in _MEM and len(_MEM) >= _MEM_CAP:
             _MEM.pop(next(iter(_MEM)))
-        _MEM[key] = blob
-    for path in _disk_paths(key):
+        _MEM[mkey] = blob
+    for path in paths:
         if os.path.exists(path):
             continue
         os.makedirs(os.path.dirname(path), exist_ok=True)

@@ -245,6 +245,14 @@ class Mappings:
         )
         if t == "dense_vector" and fm.dims <= 0:
             raise MapperParsingException(f"dense_vector field [{full}] requires [dims]")
+        if t == "dense_vector" and fm.index_options:
+            ann = (fm.index_options.get("type")
+                   if isinstance(fm.index_options, dict) else None)
+            if ann not in ("ivf", "ivf_flat", "ivf_pq"):
+                raise MapperParsingException(
+                    f"dense_vector field [{full}] has unsupported "
+                    f"index_options type [{ann}]; use one of "
+                    f"[ivf, ivf_flat, ivf_pq]")
         for sub, subp in p.get("fields", {}).items():
             st = _canonical_type(subp)
             fm.fields[sub] = self._parse_field(f"{full}.{sub}", st, subp, nested_path)

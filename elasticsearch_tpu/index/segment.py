@@ -480,6 +480,12 @@ class VectorColumn:
     # lazy IVF-flat coarse quantizer (ops/ivf.py); False = build attempted
     # and declined (too few vectors)
     _ivf: Any = None
+    # lazy PQ tier (ops/pq.py): None = unbuilt OR placement breaker-denied
+    # (retryable — dense-impact discipline), False = declined (too few
+    # vectors), PqIndex = ready. Host parts memoized separately so a
+    # breaker denial never re-pays the k-means train + encode.
+    _pq: Any = None
+    _pq_parts: Any = None
     # memoized content-address (slabs are immutable; SHA-1 of the full
     # slab per freeze/snapshot call is measurable host CPU)
     _ck: Any = None
@@ -522,6 +528,47 @@ class VectorColumn:
                     ivf_cache.store(key, idx)
             self._ivf = idx if idx is not None else False
         return self._ivf or None
+
+    def get_pq(self, max_docs: int):
+        """Build-once PQ tier over this (immutable) slab.
+
+        Host parts come from the content-addressed blob cache when the
+        slab content matches a persisted build (counter pq_cache_hit),
+        else from a fresh train+encode (counter pq_build, re-persisted).
+        Device placement is BEST-EFFORT: the uint8 code array registers
+        as an evictable fielddata-tier handle, and a breaker denial
+        returns None while leaving the build memoized — the caller keeps
+        the exact fine-rank path and a later query retries placement
+        only (the dense-impact contract)."""
+        if self._pq is False:
+            return None
+        if self._pq is not None:
+            return self._pq
+        from elasticsearch_tpu.index import ivf_cache
+        from elasticsearch_tpu.monitor import kernels
+        from elasticsearch_tpu.ops.pq import build_pq, place_pq
+
+        parts = self._pq_parts
+        if parts is None:
+            vh = (self.vecs_host if self.vecs_host is not None
+                  else np.asarray(self.vecs))
+            eh = (self.exists_host if self.exists_host is not None
+                  else np.asarray(self.exists))
+            key = self.cache_key(max_docs)
+            parts = ivf_cache.load_pq(key)
+            if parts is None:
+                parts = build_pq(vh, eh, self.similarity)
+                if parts is None:
+                    self._pq = False  # too few vectors: permanent decline
+                    return None
+                kernels.record("pq_build")
+                ivf_cache.store_pq(key, parts)
+            self._pq_parts = parts
+        idx = place_pq(parts, label=f"pq[{self.name}]")
+        if idx is None:
+            return None  # budget tight: retry later (self._pq stays None)
+        self._pq = idx
+        return idx
 
 
 # doc-value columns load lazily into the evictable fielddata tier (see
@@ -813,11 +860,16 @@ class SegmentBuilder:
             )
             fm = self.mappings.get(fname)
             opts = getattr(fm, "index_options", None) if fm is not None else None
-            if opts and opts.get("type") in ("ivf", "ivf_flat"):
+            if opts and opts.get("type") in ("ivf", "ivf_flat", "ivf_pq"):
                 # index-time ANN build (like Lucene building HNSW at flush):
                 # refreshes/merges/restores pay the k-means here, never the
                 # first query (r3 verdict weak #9)
                 vc.get_ivf(max_docs)
+            if opts and opts.get("type") == "ivf_pq":
+                # PQ codes ride beside the coarse quantizer; best-effort —
+                # a tight fielddata breaker leaves the exact fine-rank
+                # path and a later query retries placement
+                vc.get_pq(max_docs)
             vectors[fname] = vc
 
         ids = [d.doc_id for d in self.docs]

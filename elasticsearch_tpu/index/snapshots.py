@@ -183,6 +183,34 @@ def _segment_payload(seg) -> dict:
         })
     if ivf_blobs:
         payload["ivf"] = ivf_blobs
+    # PQ tiers ride beside their IVF quantizers under the same content
+    # address (different extension) — restore seeds both, so the target
+    # freeze skips the per-subspace k-means + full-slab encode too
+    pq_blobs = []
+    for fname, vc in getattr(seg, "vectors", {}).items():
+        parts = getattr(vc, "_pq_parts", None)
+        if parts is None:
+            pq = getattr(vc, "_pq", None)
+            if not pq:
+                continue
+            from elasticsearch_tpu.ops.pq import PqHostParts
+
+            parts = PqHostParts(codebooks=pq.codebooks_host,
+                                codes=pq.codes_host, M=pq.M, K=pq.K,
+                                dsub=pq.dsub, dims=pq.dims,
+                                metric=pq.metric)
+            if parts.codebooks is None or parts.codes is None:
+                continue
+        from elasticsearch_tpu.index import ivf_cache
+
+        key = vc.cache_key(seg.max_docs)
+        blob = ivf_cache.store_pq(key, parts)
+        pq_blobs.append({
+            "field": fname, "key": key,
+            "blob": base64.b64encode(blob).decode("ascii"),
+        })
+    if pq_blobs:
+        payload["pq"] = pq_blobs
     return payload
 
 
@@ -221,6 +249,10 @@ def replay_shard(svc, repo: FsRepository, imeta: dict,
             from elasticsearch_tpu.index import ivf_cache
 
             ivf_cache.seed(entry["key"], base64.b64decode(entry["blob"]))
+        for entry in payload.get("pq", []):
+            from elasticsearch_tpu.index import ivf_cache
+
+            ivf_cache.seed_pq(entry["key"], base64.b64decode(entry["blob"]))
         for doc in payload["docs"]:
             meta = doc.get("meta", {})
             svc.index_doc(

@@ -157,6 +157,68 @@ def write_ivf(ivf) -> bytes:
     return bytes(out)
 
 
+def write_pq(parts) -> bytes:
+    """Serialize a PqHostParts (codebooks f32, codes uint8) with the
+    same header+CRC framing as postings/IVF blobs. The content-addressed
+    cache persists these beside the IVF quantizer (`<key>.pq`) and
+    snapshot payloads embed them, so restarts and restores skip the
+    per-subspace k-means + full-slab encode."""
+    books = np.asarray(parts.codebooks, np.float32)
+    codes = np.asarray(parts.codes, np.uint8)
+    sections = [
+        ("codebooks", books.tobytes(), int(books.size)),
+        ("codes", codes.tobytes(), int(codes.size)),
+    ]
+    header = {
+        "kind": "pq",
+        "stats": {"M": parts.M, "K": parts.K, "dsub": parts.dsub,
+                  "dims": parts.dims, "metric": parts.metric,
+                  "rows": int(codes.shape[0])},
+        "sections": [{"name": n, "len": len(b), "crc": crc32(b), "count": c}
+                     for n, b, c in sections],
+    }
+    hraw = json.dumps(header, separators=(",", ":")).encode()
+    out = bytearray(_U32.pack(len(hraw)) + hraw)
+    for _, b, _c in sections:
+        out += b
+    return bytes(out)
+
+
+def read_pq(data: bytes):
+    """Parse a PQ blob back to HOST PqHostParts (CRC-verified). Device
+    placement stays with the caller (VectorColumn.get_pq) because the
+    code array's fielddata-tier registration can be breaker-denied and
+    must stay retryable."""
+    from elasticsearch_tpu.ops.pq import PqHostParts
+
+    if len(data) < 4:
+        raise CorruptStoreException("pq blob truncated")
+    (hlen,) = _U32.unpack(data[:4])
+    if 4 + hlen > len(data):
+        raise CorruptStoreException("pq header exceeds blob size")
+    try:
+        header = json.loads(data[4 : 4 + hlen])
+    except (ValueError, UnicodeDecodeError) as e:
+        raise CorruptStoreException(f"pq header unreadable: {e}")
+    st = header["stats"]
+    cursor = 4 + hlen
+    raws: Dict[str, bytes] = {}
+    for sec in header["sections"]:
+        raw = data[cursor : cursor + sec["len"]]
+        if len(raw) != sec["len"] or crc32(raw) != sec["crc"]:
+            raise CorruptStoreException(
+                f"pq section [{sec['name']}] failed its checksum")
+        cursor += sec["len"]
+        raws[sec["name"]] = raw
+    books = np.frombuffer(raws["codebooks"], np.float32).reshape(
+        st["M"], st["K"], st["dsub"]).copy()
+    codes = np.frombuffer(raws["codes"], np.uint8).reshape(
+        st["rows"], st["M"]).copy()
+    return PqHostParts(codebooks=books, codes=codes, M=int(st["M"]),
+                       K=int(st["K"]), dsub=int(st["dsub"]),
+                       dims=int(st["dims"]), metric=st["metric"])
+
+
 def read_ivf(data: bytes):
     """Parse an IVF blob back to a device-resident IvfIndex (CRC-verified)."""
     import jax

@@ -19,9 +19,22 @@ Names:
                       subsumed the r3 `knn_full` [D]-row path in r4 (filters
                       now fold into the fused candidate mask)
   knn_ivf             IVF-flat probe + exact candidate scoring
+  knn_ivf_pq          IVF probe + ADC coarse rank over PQ codes + exact
+                      fine re-rank of the top survivors (ops/pq.py)
+  knn_maxsim          multi-vector MaxSim query served by the fused
+                      per-token sweep + device scatter-max merge
+  knn_fused_batch     kNN/MaxSim request served by the fused BATCH tier
+                      (search/batch.knn_topk_fused_batch — msearch or
+                      the serving coalescer); one count per request
+  adc_pallas          PQ coarse rank ran the Pallas tiled ADC kernel
+  adc_xla             PQ coarse rank ran the XLA gather table-sum
+  adc_pallas_failed   ADC kernel attempt failed (latch bookkeeping —
+                      ops/pallas_kernels.note_adc_failure)
   ivf_build           IVF quantizer built via k-means at segment freeze
   ivf_cache_hit       IVF quantizer reloaded from the persisted blob cache
                       (index/ivf_cache.py) instead of rebuilt
+  pq_build            PQ codebooks trained + slab encoded at freeze
+  pq_cache_hit        PQ tier reloaded from the persisted blob cache
   mesh_search         request served by the mesh product path
   mesh_fallback_total request fell back to the host per-shard loop
   mesh_host_by_design request routed to the host loop ON PURPOSE (IVF

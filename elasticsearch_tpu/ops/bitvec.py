@@ -1,0 +1,92 @@
+"""Packed bit-vector algebra for candidate sets and kNN pre-filters.
+
+"Efficient Multi-Vector Dense Retrieval Using Bit Vectors" (arXiv:
+2404.02805) carries ANN candidate sets as packed bit vectors so that
+filter intersection is a handful of word-wise ANDs instead of a dense
+bool walk. Our filter algebra is already device bool[D] masks; this
+module supplies the packed uint32[D/32] form those masks compress into
+(32x smaller, so a query's pre-filter ships to the IVF/PQ program as a
+few KB instead of a full bool row) plus the word-wise ops that compose
+with them:
+
+  * ``pack_mask`` / ``unpack_mask`` — bool[D] <-> uint32[D/32]
+    (max_docs is always pow2 >= 64, so D % 32 == 0 holds by
+    construction — utils/shapes.pow2_bucket minimum).
+  * ``test_bits`` — membership probe for a gathered id vector:
+    ``(words[id >> 5] >> (id & 31)) & 1``. This is how the IVF+PQ
+    program pre-filters probed candidates BEFORE the coarse rank, so a
+    selective filter no longer starves the fine stage (the old path
+    intersected after selection — ES applies the kNN filter during the
+    search, not after).
+  * ``popcount`` — SWAR per-word popcount, summed; the starvation
+    floor check (enough filtered matches to cover k) without a bool
+    reduction over D.
+
+All ops are pure jnp (trace-safe); none allocate persistent device
+state, so there is nothing to account against the residency registry.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def pack_mask(mask):
+    """bool[D] -> uint32[D // 32] little-endian bit packing (bit i of
+    word w is doc w * 32 + i). D must be a multiple of 32 — true for
+    every segment (max_docs is pow2-padded with minimum 64)."""
+    D = mask.shape[0]
+    assert D % 32 == 0, "mask length must be a multiple of 32"
+    bits = mask.reshape(D // 32, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, :]
+    return jnp.sum(bits * weights, axis=1, dtype=jnp.uint32)
+
+
+@jax.jit
+def unpack_mask(words):
+    """uint32[W] -> bool[W * 32] (inverse of pack_mask)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, :]
+    bits = (words[:, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(-1).astype(bool)
+
+
+@jax.jit
+def test_bits(words, ids):
+    """Membership of each int32 id in the packed set: bool[len(ids)].
+
+    Callers pass CLAMPED ids (0 <= id < 32 * len(words)) — the IVF
+    program's padded candidates are masked separately by its own
+    validity lane, so an out-of-range sentinel never reaches here.
+    """
+    word = words[ids >> 5]
+    bit = (ids & 31).astype(jnp.uint32)
+    return ((word >> bit) & jnp.uint32(1)) != 0
+
+
+@jax.jit
+def popcount(words):
+    """Total set bits across the packed vector (int32 scalar) — the
+    classic SWAR reduction, no 256-entry lookup table to keep resident."""
+    x = words
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    per_word = (x * jnp.uint32(0x01010101)) >> 24
+    return jnp.sum(per_word.astype(jnp.int32))
+
+
+@jax.jit
+def bitvec_and(a, b):
+    return a & b
+
+
+@jax.jit
+def bitvec_or(a, b):
+    return a | b
+
+
+@jax.jit
+def bitvec_andnot(a, b):
+    """a & ~b — e.g. candidate set minus a deletion set."""
+    return a & ~b

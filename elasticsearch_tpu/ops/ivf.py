@@ -116,8 +116,22 @@ class IvfIndex:
     avg_len: float
     metric: str = "cosine"  # quantizer metric (follows the field similarity)
 
+    @property
+    def ntotal(self) -> int:
+        """Indexed vector count (avg_len is n / C at build time)."""
+        return max(int(round(self.avg_len * self.C)), 1)
+
     def nprobe_for(self, num_candidates: int) -> int:
-        n = int(np.ceil(num_candidates / max(self.avg_len, 1.0)))
+        """nprobe sized so probed lists cover ≈ num_candidates vectors.
+
+        num_candidates clamps to [1, ntotal] BEFORE the coverage math
+        (the final max/min already bounded the result to [1, C]; the
+        early clamp keeps the sizing honest at the edges — asking for
+        more candidates than indexed vectors means "probe everything",
+        C exactly, not whatever ceil(nc / avg_len) lands on when lists
+        run short)."""
+        nc = min(max(int(num_candidates), 1), self.ntotal)
+        n = int(np.ceil(nc / max(self.avg_len, 1.0)))
         return max(1, min(n, self.C))
 
 
@@ -167,14 +181,29 @@ _PROGRAMS: dict = {}
 
 
 def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
-                         num_candidates: int, metric: str, D: int):
+                         num_candidates: int, metric: str, D: int,
+                         pq=None, fine_k: Optional[int] = None,
+                         filter_words=None):
     """Scatter ANN candidate scores into a whole-segment [D] score vector.
 
     Probes the nprobe closest lists (nprobe sized so probed lists cover
-    ≈ num_candidates vectors), gathers their vectors from the slab, scores
-    with the exact metric, and scatters into dense f32[D] (−inf elsewhere)
-    + bool[D] mask — the same (scores, mask) contract every other query
-    program has, so IVF composes with filters/bool/rescore unchanged.
+    ≈ num_candidates vectors) and emits dense f32[D] scores (−inf
+    elsewhere) + bool[D] mask — the same (scores, mask) contract every
+    other query program has, so IVF composes with filters/bool/rescore
+    unchanged.
+
+    Without ``pq`` every probed candidate's f32 vector is gathered and
+    scored exactly — the r05 path whose cost scales linearly with
+    num_candidates (the measured 389 -> 12.6 qps cliff). With ``pq`` (a
+    PqIndex over the same slab) the pipeline is asymmetric coarse->fine:
+    an ADC table-sum ranks ALL candidates from uint8 codes (O(M) bytes
+    each), then only the top ``fine_k`` survivors pay the exact f32
+    gather+re-rank — cost stops scaling with num_candidates.
+
+    ``filter_words`` (packed uint32[D/32], ops/bitvec.pack_mask) is an
+    optional PRE-filter: candidates failing it are dropped before the
+    coarse rank, so the fine stage spends its budget entirely on docs
+    the filter admits (ES applies the kNN filter during the search).
     """
     jax = _jax()
 
@@ -182,16 +211,61 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
 
     nprobe = index.nprobe_for(num_candidates)
     sf = tail_mode_batch()
-    key = (index.C, index.Lmax, D, nprobe, metric, index.metric, sf)
-    prog = _PROGRAMS.get(key)
-    if prog is None:
-        prog = make_ivf_search(index.C, index.Lmax, D, nprobe, metric,
-                               quantizer_metric=index.metric,
-                               scatter_free=sf)
-        _PROGRAMS[key] = prog
     # offbudget: transient per-query upload
     q = jax.device_put(np.asarray(query_np, np.float32))  # tpulint: offbudget
-    return prog(q, index.centroids, index.lists, vecs)
+    if pq is None and filter_words is None:
+        key = (index.C, index.Lmax, D, nprobe, metric, index.metric, sf)
+        prog = _PROGRAMS.get(key)
+        if prog is None:
+            prog = make_ivf_search(index.C, index.Lmax, D, nprobe, metric,
+                                   quantizer_metric=index.metric,
+                                   scatter_free=sf)
+            _PROGRAMS[key] = prog
+        return prog(q, index.centroids, index.lists, vecs)
+
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.ops import pallas_kernels as pk
+
+    W = nprobe * index.Lmax
+    fk = max(1, min(int(fine_k or 64), W, D))
+    use_filter = filter_words is not None
+    # this dispatcher runs EAGERLY (the Pallas ADC's first real-TPU call
+    # may fail at Mosaic lowering time) — same latch discipline as BM25
+    force_xla = False
+    for _attempt in range(2):
+        tile = (0 if force_xla or pq is None
+                else pk.adc_pallas_tile(W, pq.M, pq.K))
+        key = ("pq", index.C, index.Lmax, D, nprobe, metric, index.metric,
+               sf, fk, use_filter, tile,
+               (pq.M, pq.K, pq.dsub, pq.metric) if pq is not None else None)
+        prog = _PROGRAMS.get(key)
+        if prog is None:
+            prog = make_ivf_pq_search(
+                index.C, index.Lmax, D, nprobe, metric,
+                quantizer_metric=index.metric, scatter_free=sf, fine_k=fk,
+                pq_meta=((pq.M, pq.K, pq.dsub, pq.metric)
+                         if pq is not None else None),
+                use_filter=use_filter, adc_tile=tile)
+            _PROGRAMS[key] = prog
+        args = [q, index.centroids, index.lists, vecs]
+        if pq is not None:
+            args += [pq.codes_dev(), pq.codebooks]
+        if use_filter:
+            args.append(filter_words)
+        try:
+            out = prog(*args)
+        except Exception as e:
+            if tile:
+                pk.note_adc_failure(e)
+                force_xla = True
+                continue
+            raise
+        if pq is not None:
+            if tile:
+                pk.note_adc_success()
+            kernels.record("adc_pallas" if tile else "adc_xla")
+        return out
+    raise AssertionError("unreachable: ADC retry loop exits via return")
 
 
 def make_ivf_search(C: int, Lmax: int, D: int, nprobe: int, metric: str,
@@ -242,6 +316,100 @@ def make_ivf_search(C: int, Lmax: int, D: int, nprobe: int, metric: str,
             scores = scores.at[cand].max(
                 jnp.where(valid, cscores, -jnp.inf), mode="drop")
             mask = jnp.zeros(D, bool).at[cand].max(valid, mode="drop")
+        return scores, mask
+
+    return run
+
+
+def make_ivf_pq_search(C: int, Lmax: int, D: int, nprobe: int, metric: str,
+                       quantizer_metric: str = "cosine",
+                       scatter_free: bool = False, fine_k: int = 64,
+                       pq_meta=None, use_filter: bool = False,
+                       adc_tile: int = 0):
+    """Compiled asymmetric coarse->fine IVF program for one shape class.
+
+    Stages (all one fused XLA program; statically shaped throughout):
+
+      1. probe — closest nprobe centroids under the quantizer metric.
+      2. pre-filter — candidates failing the packed bit-vector filter
+         (``use_filter``) drop out of the validity lane BEFORE any
+         scoring, so the fine budget is spent on admissible docs only.
+      3. coarse — ADC table-sum over uint8 codes (``pq_meta`` =
+         (M, K, dsub, pq_metric)); the Pallas tiled kernel when
+         ``adc_tile`` > 0, the XLA gather form otherwise. With no PQ
+         tier the "coarse" stage IS the exact f32 scoring of every
+         candidate (the pre-PQ path, kept for pre-filter-only callers).
+      4. fine — exact f32 re-rank of the top ``fine_k`` ADC survivors
+         only; their exact scores scatter into the [D] row. Scores the
+         executor sees are always exact-metric f32 — PQ never leaks an
+         approximate score past this program.
+    """
+    jax = _jax()
+    import jax.numpy as jnp
+    from jax import lax
+
+    from elasticsearch_tpu.ops.bitvec import test_bits
+    from elasticsearch_tpu.ops.knn import knn_scores
+
+    @jax.jit
+    def run(query, centroids, lists, vecs, *rest):
+        rest = list(rest)
+        if pq_meta is not None:
+            codes, codebooks = rest[0], rest[1]
+            rest = rest[2:]
+        words = rest[0] if use_filter else None
+        csim = _quantizer_affinity(jnp, query[None, :], centroids,
+                                   quantizer_metric)[0]  # [C]
+        _, probe = lax.top_k(csim, nprobe)
+        cand = lists[probe].reshape(-1)  # [W], pad = D sentinel
+        valid = cand < D
+        safe = jnp.where(valid, cand, 0)
+        if use_filter:
+            valid = valid & test_bits(words, safe)
+        if pq_meta is not None:
+            from elasticsearch_tpu.ops.pq import adc_lut, adc_sum
+
+            M, K, dsub, pq_metric = pq_meta
+            lut = adc_lut(jnp, query, codebooks, pq_metric)
+            ccodes = codes[safe]  # [W, M] uint8 — M bytes per candidate
+            if adc_tile:
+                from elasticsearch_tpu.ops.pallas_kernels import \
+                    adc_scores_pallas
+
+                coarse = adc_scores_pallas(ccodes.astype(jnp.int32), lut,
+                                           tile=adc_tile)
+            else:
+                coarse = adc_sum(jnp, ccodes, lut)
+            coarse = jnp.where(valid, coarse, -jnp.inf)
+            fv, fpos = lax.top_k(coarse, fine_k)
+            fids = jnp.take(cand, fpos)
+            fvalid = fv > -jnp.inf
+            fsafe = jnp.where(fvalid, fids, 0)
+            fvecs = vecs[fsafe]  # [fine_k, dims] — the ONLY f32 gather
+            fscores = knn_scores(query[None, :], fvecs, metric=metric,
+                                 use_bf16=False)[0]
+            fscores = jnp.where(fvalid, fscores, -jnp.inf)
+        else:
+            # pre-filter-only caller: exact scores for every candidate
+            cvecs = vecs[safe]
+            cs = knn_scores(query[None, :], cvecs, metric=metric,
+                            use_bf16=False)[0]
+            fids, fvalid = cand, valid
+            fscores = jnp.where(valid, cs, -jnp.inf)
+        tgt = jnp.where(fvalid, fids, D)  # invalid -> out of range, dropped
+        if scatter_free:
+            # survivor ids are unique (one inverted list per vector);
+            # same sort + boundary-search expansion as make_ivf_search
+            sc, ss = lax.sort((tgt, fscores), num_keys=1)
+            bounds = jnp.searchsorted(sc, jnp.arange(D + 1, dtype=sc.dtype))
+            lo, n = bounds[:-1], bounds[1:] - bounds[:-1]
+            Wf = sc.shape[0]
+            scores = jnp.where(n > 0, ss[jnp.clip(lo, 0, Wf - 1)], -jnp.inf)
+            mask = n > 0
+        else:
+            scores = jnp.full(D, -jnp.inf, jnp.float32).at[tgt].max(
+                fscores, mode="drop")
+            mask = jnp.zeros(D, bool).at[tgt].max(fvalid, mode="drop")
         return scores, mask
 
     return run

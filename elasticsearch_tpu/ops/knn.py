@@ -95,6 +95,36 @@ def exact_rescore_topk(queries, vecs, vals, idx, *, metric: str = "cosine"):
     return new_v, new_i.astype(jnp.int32)
 
 
+@partial(jax.jit, static_argnames=("k",))
+def merge_candidate_topk(vals, ids, *, k: int):
+    """Per-row dedup-by-max + top-k over candidate (score, id) pairs.
+
+    vals f32[Q, N], ids i32[Q, N] (ids REPEAT when several query tokens
+    surface the same doc; invalid slots carry -inf). Returns
+    ([Q, k] vals, [Q, k] i32 ids, i32[Q] unique-valid counts).
+
+    Device-friendly dedup: sort pairs by (id asc, score desc) — the
+    first occurrence of each id is its max — mask non-first occurrences
+    to -inf, then a stable top-k. Tie discipline matches lax.top_k over
+    a dense score row: equal scores rank by ascending doc id (the id
+    sort puts the lowest id first and top_k takes the first maximum).
+    """
+    width = vals.shape[1]
+    if k > width:
+        raise ValueError(f"k [{k}] exceeds candidate width [{width}]")
+    sid, negv = lax.sort((ids, -vals), num_keys=2, dimension=1)
+    sval = -negv
+    first = jnp.concatenate(
+        [jnp.ones((ids.shape[0], 1), bool), sid[:, 1:] != sid[:, :-1]],
+        axis=1)
+    valid = first & (sval > NEG_INF)
+    n_unique = jnp.sum(valid.astype(jnp.int32), axis=1)
+    sel = jnp.where(valid, sval, NEG_INF)
+    best_v, pos = lax.top_k(sel, k)
+    best_i = jnp.take_along_axis(sid, pos, axis=1)
+    return best_v, best_i.astype(jnp.int32), n_unique
+
+
 @partial(jax.jit, static_argnames=("k", "metric", "chunk", "use_bf16"))
 def knn_topk_chunked(queries, vecs, mask, *, k: int, metric: str = "cosine",
                      chunk: int = 1 << 16, use_bf16: bool = True):

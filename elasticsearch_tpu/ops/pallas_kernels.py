@@ -407,6 +407,117 @@ def bm25_dense_topk_auto(qw, impact, mask, *, k: int):
     return vals, idx.astype(jnp.int32)
 
 
+# ---------------------------------------------------------------------------
+# ADC (PQ table-sum) kernel — the coarse stage of the IVF coarse->fine rank
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("tile", "interpret"))
+def adc_scores_pallas(codes, lut, *, tile: int = 2048,
+                      interpret: bool = False):
+    """Tiled asymmetric-distance table-sum: codes i32[W, M], lut
+    f32[M, K] -> f32[W] coarse scores.
+
+    Mosaic doesn't lower general gathers, so the per-subspace table
+    lookup is phrased as a one-hot [tile, K] compare + matvec against
+    the LUT row — an M-step static unroll of VPU compare + MXU matvec,
+    with the LUT (<= 32 KB) resident in VMEM across the whole sweep.
+    This is the TileMaxSim shape: candidate tiles stream HBM->VMEM as
+    uint8-sized codes (M bytes/candidate), never as f32 vectors.
+    """
+    from jax.experimental import pallas as pl
+
+    W, M = codes.shape
+    K = lut.shape[1]
+    assert W % tile == 0, "candidate set must be padded to a tile multiple"
+    n_tiles = W // tile
+
+    def kernel(c_ref, lut_ref, out_ref):
+        c = c_ref[:]  # [tile, M] int32
+        acc = jnp.zeros((tile,), jnp.float32)
+        for m in range(M):  # static unroll, M <= 32
+            onehot = (jax.lax.broadcasted_iota(jnp.int32, (tile, K), 1)
+                      == c[:, m][:, None]).astype(jnp.float32)
+            acc = acc + jax.lax.dot_general(
+                onehot, lut_ref[m, :], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        out_ref[0, :] = acc
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile, M), lambda i: (i, 0)),   # code tile
+            pl.BlockSpec((M, K), lambda i: (0, 0)),      # LUT: resident
+        ],
+        # 1-D i32/f32 blocks can hit XLA/Mosaic layout mismatches at
+        # small tiles (same note as the BM25 mask input) — ride as [1, W]
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, W), jnp.float32),
+        interpret=interpret,
+    )(codes, lut)
+    return out[0]
+
+
+# sticky failure latch for the ADC kernel — same discipline as the fused
+# BM25 kernel above: deterministic compile/lowering failures latch on the
+# first hit; transients fall back per-call up to a bounded run.
+_ADC_PALLAS_BROKEN = [False]
+_ADC_TRANSIENT_FAILS = [0]
+_ADC_TRANSIENT_LIMIT = 8
+
+
+def adc_pallas_tile(W: int, M: int, K: int) -> int:
+    """Largest candidate tile the ADC kernel may use (0 = use the XLA
+    gather form). Static shape gates only — the dispatch site runs
+    EAGERLY (ops/ivf.ivf_candidate_scores), so a first-call Mosaic
+    failure is catchable there and flips the latch."""
+    if _ADC_PALLAS_BROKEN[0] or not _on_tpu():
+        return 0
+    if K % 128 != 0 or M > 32:
+        return 0  # lane-aligned LUT rows; M bounds the unroll
+    budget = 8 * 1024 * 1024
+    for tile in (4096, 2048, 1024, 512):
+        if W % tile:
+            continue
+        est = tile * M * 4 + M * K * 4 + 2 * tile * K * 4
+        if est <= budget:
+            return tile
+    return 0
+
+
+def note_adc_failure(e: BaseException) -> bool:
+    """Record one ADC kernel failure (called from the eager dispatch in
+    ops/ivf.py). Returns True when the latch is now set — the caller
+    rebuilds its program without the Pallas ADC from then on; False
+    means transient, fall back for this call only."""
+    import warnings
+
+    from elasticsearch_tpu.monitor import kernels
+
+    kernels.record("adc_pallas_failed")
+    if _is_compile_error(e):
+        _ADC_PALLAS_BROKEN[0] = True
+        warnings.warn(f"ADC kernel failed ({type(e).__name__}: "
+                      f"{str(e)[:200]}); serving PQ coarse rank via the "
+                      f"XLA gather path from now on")
+        return True
+    _ADC_TRANSIENT_FAILS[0] += 1
+    if _ADC_TRANSIENT_FAILS[0] >= _ADC_TRANSIENT_LIMIT:
+        _ADC_PALLAS_BROKEN[0] = True
+        warnings.warn(f"ADC kernel failed {_ADC_TRANSIENT_FAILS[0]} "
+                      f"consecutive times ({type(e).__name__}: "
+                      f"{str(e)[:200]}); latching to the XLA path")
+        return True
+    warnings.warn(f"ADC kernel transient failure ({type(e).__name__}: "
+                  f"{str(e)[:200]}); XLA fallback for this call")
+    return False
+
+
+def note_adc_success() -> None:
+    """A served Pallas ADC call clears the transient-failure run."""
+    _ADC_TRANSIENT_FAILS[0] = 0
+
+
 def _knn_tile_for(Q: int, dims: int, k: int, D: int) -> int:
     """Largest corpus tile keeping the kernel's VMEM working set in budget:
     query block + corpus tile + ~3 live [Q, tile+k] candidate copies. A

@@ -1525,22 +1525,32 @@ class MeshQueryCompiler:
         fm = self.mappings.get(q.field)
         use_ann = bool(q.ann) if q.ann is not None else (
             fm is not None and bool(getattr(fm, "index_options", None))
-            and fm.index_options.get("type") in ("ivf", "ivf_flat"))
+            and fm.index_options.get("type") in ("ivf", "ivf_flat",
+                                                 "ivf_pq"))
         if use_ann:
-            # host loop probes IVF: coarse-quantizer routing is a designed
-            # host-orchestrated pipeline, not a missing mesh feature
+            # host loop probes IVF (and the PQ coarse->fine pipeline):
+            # coarse-quantizer routing is a designed host-orchestrated
+            # pipeline, not a missing mesh feature
             raise MeshCompileError("knn via IVF", by_design=True)
+        if getattr(q, "maxsim", False):
+            # host loop runs the fused per-token sweep + scatter-max
+            # merge (queries.KnnQuery._execute_maxsim) — a designed
+            # routing, like IVF probing
+            raise MeshCompileError("knn multi-vector MaxSim",
+                                   by_design=True)
         dims = getattr(fm, "dims", None) if fm is not None else None
         if fm is None or not dims:
             return ENone(self.D)  # unmapped vector field: empty everywhere
-        if len(q.vector) != int(dims):
+        if q.tokens.shape[1] != int(dims):
             from elasticsearch_tpu.utils.errors import QueryParsingException
 
             raise QueryParsingException(
-                f"knn query vector has {len(q.vector)} dims but field "
+                f"knn query vector has {q.tokens.shape[1]} dims but field "
                 f"[{q.field}] is mapped with {dims}")
         filt = self._c(q.filter) if q.filter is not None else None
-        prim = self._add(VecsPrim(q.field, q.vector))
+        # tokens[0], not the raw body value: a single-token query_vectors
+        # body arrives nested ([1, dims]) and VecsPrim wants the 1-D vector
+        prim = self._add(VecsPrim(q.field, q.tokens[0]))
         kc = int(min(max(q.num_candidates, q.k), self.D))
         metric = getattr(fm, "similarity", None) or "cosine"
         return EKnn(prim, filt, self._live, kc, metric, q.boost, self.D)

@@ -195,6 +195,56 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
     return fn
 
 
+def _maxsim_program(mesh, cache, *, Q: int, T: int, dims: int, D: int,
+                    k: int, metric: str):
+    """Distributed multi-vector MaxSim: token matrices replicated, vector
+    slabs sharded.
+
+    tokens f32[Q, T, dims] (T query tokens per request, repeat-padded);
+    per-doc score = max over tokens (one vector per doc). Per shard: one
+    fused [Q*T] top-k sweep (bf16 oversampled + f32 re-rank — the same
+    two-stage refinement as the kNN program), a dedup-by-max merge per
+    request, then the all_gather global top-k merge."""
+    from elasticsearch_tpu.ops.scoring import topk_block_config
+
+    key = ("maxsim", Q, T, dims, D, k, metric, topk_block_config())
+    if key in cache:
+        return cache[key]
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as PS
+
+    from elasticsearch_tpu.ops.knn import (exact_rescore_topk,
+                                           merge_candidate_topk)
+    from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
+
+    psum, all_gather, wrap, sl = _collectives(mesh)
+
+    def body(tokens, vecs, live):
+        flat = tokens.reshape(Q * T, dims)
+        kp = min(max(4 * k, k), D)
+        vals, idx = knn_topk_auto(flat, sl(vecs), sl(live), k=kp,
+                                  metric=metric)
+        vals, idx = exact_rescore_topk(flat, sl(vecs), vals, idx,
+                                       metric=metric)
+        # per-request dedup-by-max over the token axis, then local top-k
+        vals, idx, _ = merge_candidate_topk(
+            vals.reshape(Q, T * kp), idx.reshape(Q, T * kp), k=k)
+        av = all_gather(vals, "shard")
+        ai = all_gather(idx, "shard")
+        S = av.shape[0]
+        flat_v = jnp.transpose(av, (1, 0, 2)).reshape(Q, S * k)
+        gvals, gpos = lax.top_k(flat_v, k)
+        gshard = (gpos // k).astype(jnp.int32)
+        flat_i = jnp.transpose(ai, (1, 0, 2)).reshape(Q, S * k)
+        glocal = jnp.take_along_axis(flat_i, gpos, axis=1).astype(jnp.int32)
+        return gvals, gshard, glocal
+
+    fn = wrap(body, (PS(), PS("shard"), PS("shard")), (PS(), PS(), PS()))
+    cache[key] = fn
+    return fn
+
+
 def _tail_candidates_mode(compiled) -> bool:
     """True when this structure should run the scatter-free candidate-set
     top-k: a single hybrid scores-mode term group with no sort/aggs/mask
@@ -582,11 +632,37 @@ class MeshSearchExecutor:
     def search_knn(self, field: str, queries: np.ndarray, k: int = 10,
                    metric: str = "cosine"):
         """queries f32[Q, dims] → (vals, shard, local, round, totals=None)."""
+        Q, dims = queries.shape
+        return self._search_vector_rounds(
+            field, queries, k, dims,
+            lambda D: _knn_program(self.mesh, self._programs, Q=Q,
+                                   dims=dims, D=D, k=min(k, D),
+                                   metric=metric))
+
+    def search_maxsim(self, field: str, tokens: np.ndarray, k: int = 10,
+                      metric: str = "cosine"):
+        """Batched multi-vector MaxSim: tokens f32[Q, T, dims] (T query
+        tokens per request) → (vals, shard, local, round, totals=None).
+        Same data-cache discipline as search_knn (the slab group is
+        shared between the two — one upload serves both programs)."""
+        Q, T, dims = tokens.shape
+        return self._search_vector_rounds(
+            field, tokens, k, dims,
+            lambda D: _maxsim_program(self.mesh, self._programs, Q=Q, T=T,
+                                      dims=dims, D=D, k=min(k, D),
+                                      metric=metric))
+
+    def _search_vector_rounds(self, field: str, qarr: np.ndarray, k: int,
+                              dims: int, make_prog):
+        """Per-round scaffold shared by the kNN and MaxSim programs:
+        slab group build/cache (one upload serves both — the data key is
+        program-agnostic), live∧exists mask fill, program dispatch, and
+        the cross-round top-k merge. ``make_prog(D)`` supplies the
+        compiled program for the round's shape class."""
         jax = _jax()
 
-        Q, dims = queries.shape
         merged = None
-        for rno, row in enumerate(self._segment_rounds()):
+        for row in self._segment_rounds():
             seg_row = [e[2] if e is not None else None for e in row]
             lut_shard = np.asarray(
                 [e[0] if e is not None else -1 for e in row], np.int32)
@@ -618,11 +694,10 @@ class MeshSearchExecutor:
                     ex = (vc.exists_host if vc.exists_host is not None
                           else np.asarray(vc.exists))
                     h_live[si, : lv.shape[0]] = lv & ex
-            prog = _knn_program(self.mesh, self._programs, Q=Q, dims=dims,
-                                D=D, k=min(k, D), metric=metric)
+            prog = make_prog(D)
             vals, slot, local = prog(
-                # offbudget: transient per-call query upload
-                jax.device_put(np.asarray(queries, np.float32)),  # tpulint: offbudget
+                # offbudget: transient per-call query/token upload
+                jax.device_put(np.asarray(qarr, np.float32)),  # tpulint: offbudget
                 d_vecs, self._put_sharded(h_live))
             slot = np.asarray(slot)
             out = (np.asarray(vals), lut_shard[slot], np.asarray(local),

@@ -24,7 +24,8 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from elasticsearch_tpu.search.context import SegmentContext
-from elasticsearch_tpu.search.queries import (_fused_eligible_terms,
+from elasticsearch_tpu.search.queries import (KnnQuery,
+                                              _fused_eligible_terms,
                                               fused_bm25_topk_batch,
                                               hybrid_bm25_topk_batch,
                                               parse_query)
@@ -90,21 +91,102 @@ def _probe_segment(svc):
     return None
 
 
+def _batch_bucket(svc, ctx, query) -> Optional[str]:
+    """The micro-batch bucket key for ``query`` (None = sequential).
+
+    BM25 same-field term groups bucket on their dense-impact field (one
+    impact block per kernel call). kNN queries — single-vector AND
+    multi-vector MaxSim — bucket on (field, num_candidates): a bucket's
+    bodies stack into one token tensor for one fused device sweep.
+    Filters and effective-ANN single-vector queries stay sequential (the
+    batch tier is exact brute-force; batching an IVF-probing query would
+    silently change its results vs the sequential reference)."""
+    if isinstance(query, KnnQuery):
+        vc = ctx.segment.vectors.get(query.field)
+        if vc is None or query.filter is not None:
+            return None
+        if query.tokens.shape[1] != vc.dims:
+            return None  # the sequential path raises the typed error
+        if not query.maxsim:
+            if query.ann is not None:
+                ann = bool(query.ann)
+            else:
+                fm = svc.mappings.get(query.field)
+                opts = (getattr(fm, "index_options", None)
+                        if fm is not None else None)
+                ann = bool(opts) and opts.get("type") in (
+                    "ivf", "ivf_flat", "ivf_pq")
+            if ann:
+                return None
+        return f"__knn__:{query.field}:nc{query.num_candidates}"
+    e = _fused_eligible_terms(ctx, query)
+    return None if e is None else e[0]
+
+
 def batch_field(svc, query) -> Optional[str]:
-    """The dense-impact field ``query`` would batch on (None = not a
-    same-field disjunctive term group). Probes the index's first frozen
-    segment — per-segment tiers may still refuse at execution time; the
-    caller falls back sequentially then."""
+    """The micro-batch bucket ``query`` would coalesce into (None = not
+    batchable). Probes the index's first frozen segment — per-segment
+    tiers may still refuse at execution time; the caller falls back
+    sequentially then."""
     probe = _probe_segment(svc)
     if probe is None or probe.has_nested:
         return None
     try:
         ctx = SegmentContext(probe, svc.mappings, svc.analysis,
                              index_name=svc.name)
-        e = _fused_eligible_terms(ctx, query)
+        return _batch_bucket(svc, ctx, query)
     except Exception:
         return None
-    return None if e is None else e[0]
+
+
+def knn_topk_fused_batch(ctx, queries, k: int):
+    """Fused batched kNN/MaxSim over one segment: stack every request's
+    token matrix into one [Q, T, dims] tensor (repeat-padding shorter
+    token lists — a duplicated token never changes a max), run ONE
+    fused per-token top-kc sweep, then a device dedup-by-max merge per
+    request. Returns (vals [Q, k], ids [Q, k], totals [Q]) matching the
+    fused_bm25_topk_batch contract, or None when the batch is not
+    uniform (mixed fields/num_candidates, a filter, a dims mismatch).
+
+    Exactness: precise=True f32 scoring + the per-token-union property
+    (a doc in the per-doc-max top-k must appear in some token's top-kc)
+    make results identical to Q sequential brute-force searches."""
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.ops.knn import merge_candidate_topk
+    from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
+    from elasticsearch_tpu.utils.shapes import pow2_bucket
+
+    if not queries or not all(isinstance(q, KnnQuery) for q in queries):
+        return None
+    q0 = queries[0]
+    if any(q.field != q0.field or q.filter is not None
+           or q.num_candidates != q0.num_candidates for q in queries):
+        return None
+    vc = ctx.segment.vectors.get(q0.field)
+    if vc is None:
+        return None
+    if any(q.tokens.shape[1] != vc.dims for q in queries):
+        return None
+    Q = len(queries)
+    T = pow2_bucket(max(q.tokens.shape[0] for q in queries), minimum=1)
+    toks = np.empty((Q, T, vc.dims), np.float32)
+    for i, q in enumerate(queries):
+        t = q.tokens
+        reps = -(-T // t.shape[0])
+        toks[i] = np.tile(t, (reps, 1))[:T]
+    lv = vc.exists & ctx.segment.live
+    kc = int(min(max(q0.num_candidates, k), ctx.D))
+    flat = jnp.asarray(toks.reshape(Q * T, vc.dims))
+    vals, idx = knn_topk_auto(flat, vc.vecs, lv, k=kc,
+                              metric=vc.similarity, precise=True)
+    best_v, best_i, n_unique = merge_candidate_topk(
+        vals.reshape(Q, T * kc), idx.reshape(Q, T * kc), k=min(k, kc))
+    boosts = np.asarray([q.boost for q in queries], np.float32)
+    kernels.record("knn_fused_batch", n=Q)
+    return (np.asarray(best_v) * boosts[:, None], np.asarray(best_i),
+            np.asarray(n_unique).astype(np.int64))
 
 
 def execute_batch(svc, bodies: List[dict], queries: Optional[list] = None,
@@ -142,15 +224,22 @@ def execute_batch(svc, bodies: List[dict], queries: Optional[list] = None,
     searchers = [g.reader().searcher for g in svc.groups]
     cands: List[list] = [[] for _ in range(Q)]
     totals = np.zeros(len(exec_queries), np.int64)
+    all_knn = all(isinstance(q, KnnQuery) for q in exec_queries)
     for pos, s in enumerate(searchers):
         for seg in s.segments:
             if seg.has_nested:
                 return None
             ctx = SegmentContext(seg, svc.mappings, svc.analysis,
                                  index_name=svc.name)
-            out = fused_bm25_topk_batch(ctx, exec_queries,
-                                        min(k, seg.max_docs))
-            if out is None:
+            if all_knn:
+                # kNN/MaxSim tier: one fused per-token sweep + device
+                # dedup-by-max merge (same (vals, ids, totals) contract)
+                out = knn_topk_fused_batch(ctx, exec_queries,
+                                           min(k, seg.max_docs))
+            else:
+                out = fused_bm25_topk_batch(ctx, exec_queries,
+                                            min(k, seg.max_docs))
+            if out is None and not all_knn:
                 # tier 2: scatter tails allowed — one matmul + batched
                 # scatter + on-device per-query top-k (queries.
                 # hybrid_bm25_topk_batch)
@@ -233,9 +322,11 @@ def try_batched_msearch(svc, bodies: List[dict],
     out: List[Optional[dict]] = [None] * len(bodies)
     for i, e in errors.items():
         out[i] = msearch_error_entry(e)
-    # group by the dense-impact field: one impact block per kernel call,
-    # so only the largest same-field group batches; stragglers stay
-    # sequential (a second fused pass would rarely pay for its compile)
+    # group by micro-batch bucket (dense-impact field for BM25 term
+    # groups; (field, num_candidates) for kNN/MaxSim bodies): one fused
+    # kernel call per group, so only the largest group batches;
+    # stragglers stay sequential (a second fused pass would rarely pay
+    # for its compile)
     probe = _probe_segment(svc)
     groups: Dict[str, List[int]] = {}
     if probe is not None and not probe.has_nested:
@@ -243,11 +334,11 @@ def try_batched_msearch(svc, bodies: List[dict],
                              index_name=svc.name)
         for i in eligible:
             try:
-                e = _fused_eligible_terms(ctx, parsed[i])
+                bucket = _batch_bucket(svc, ctx, parsed[i])
             except Exception:
                 continue  # sequential path decides
-            if e is not None:
-                groups.setdefault(e[0], []).append(i)
+            if bucket is not None:
+                groups.setdefault(bucket, []).append(i)
     batch_idx = max(groups.values(), key=len, default=[])
     if len(batch_idx) < min_batch:
         return out if errors else None

@@ -1025,26 +1025,86 @@ class KnnQuery(Query):
     (candidates beyond that are non-matches — ES knn-query semantics); the
     executor's top-k then selects k. `filter` folds into the candidate mask
     before selection; IVF (`index_options: {type: ivf}`) probes first and
-    falls back to brute force when a filter starves the candidate set."""
+    falls back to brute force when a filter starves the candidate set.
 
-    def __init__(self, field: str, query_vector: List[float], k: int = 10,
+    `index_options: {type: ivf_pq}` adds the asymmetric coarse->fine
+    pipeline: probed candidates rank by an ADC table-sum over PQ codes,
+    only the top ~4k survivors pay the exact f32 re-rank, and any filter
+    ships as a packed bit-vector PRE-filter into the device program
+    (ops/bitvec.py) so the fine budget is spent on admissible docs.
+
+    Multi-vector MaxSim: `query_vector` may be a LIST of vectors (or the
+    body may use `query_vectors`) — a ColBERT-style token matrix. Per-doc
+    score = the sum over the doc's vectors of the max similarity over the
+    query tokens; with one vector per doc (our slab layout) that is
+    max-over-query-tokens. Served by the fused brute kernel per token +
+    a device scatter-max merge."""
+
+    def __init__(self, field: str, query_vector, k: int = 10,
                  num_candidates: Optional[int] = None, filter_: Optional[Query] = None,
-                 boost: float = 1.0, ann: Optional[bool] = None):
+                 boost: float = 1.0, ann: Optional[bool] = None,
+                 pq: Optional[bool] = None):
         self.field = field
         self.vector = query_vector
+        try:
+            toks = np.asarray(query_vector, dtype=np.float32)
+        except (ValueError, TypeError) as e:
+            # ragged token lists / non-numeric entries: typed 400, not a 500
+            raise QueryParsingException(f"malformed knn query vector: {e}")
+        if toks.ndim == 1:
+            toks = toks[None, :]
+        elif toks.ndim != 2:
+            raise QueryParsingException(
+                "knn query_vector must be a vector or a list of vectors")
+        self.tokens = toks  # [T, dims]; T > 1 = MaxSim
+        self.maxsim = toks.shape[0] > 1
         self.k = k
         self.num_candidates = num_candidates or max(k * 10, 100)
         self.filter = filter_
         self.boost = boost
         # None = follow the mapping's index_options; True/False forces
         self.ann = ann
+        self.pq = pq
 
     def _use_ann(self, ctx) -> bool:
         if self.ann is not None:
             return bool(self.ann)
         fm = ctx.mappings.get(self.field)
         opts = getattr(fm, "index_options", None) if fm is not None else None
-        return bool(opts) and opts.get("type") in ("ivf", "ivf_flat")
+        return bool(opts) and opts.get("type") in ("ivf", "ivf_flat",
+                                                   "ivf_pq")
+
+    def _use_pq(self, ctx) -> bool:
+        if self.pq is not None:
+            return bool(self.pq)
+        fm = ctx.mappings.get(self.field)
+        opts = getattr(fm, "index_options", None) if fm is not None else None
+        return bool(opts) and opts.get("type") == "ivf_pq"
+
+    def _execute_maxsim(self, ctx, vc) -> ExecResult:
+        from elasticsearch_tpu.monitor import kernels
+        from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
+
+        jnp = _jnp()
+        toks = jnp.asarray(self.tokens)
+        lv = vc.exists & ctx.segment.live
+        if self.filter is not None:
+            _, fm = self.filter.execute(ctx)
+            lv = lv & fm
+        kc = int(min(max(self.num_candidates, self.k), ctx.D))
+        # per-token fused top-kc (precise: the latency path's exact-recall
+        # contract), then a device scatter-MAX merge — the union of the
+        # per-token top-kc provably covers the per-doc-max top-kc
+        vals, idx = knn_topk_auto(toks, vc.vecs, lv, k=kc,
+                                  metric=vc.similarity, precise=True)
+        kernels.record("knn_maxsim")
+        valid = (vals > -jnp.inf).reshape(-1)
+        flat_v = vals.reshape(-1)
+        flat_i = idx.reshape(-1)
+        scores = jnp.zeros(ctx.D, jnp.float32).at[flat_i].max(
+            jnp.where(valid, flat_v * self.boost, 0.0), mode="drop")
+        mask = jnp.zeros(ctx.D, bool).at[flat_i].max(valid, mode="drop")
+        return scores, mask
 
     def execute(self, ctx) -> ExecResult:
         from elasticsearch_tpu.monitor import kernels
@@ -1053,13 +1113,57 @@ class KnnQuery(Query):
         vc = ctx.segment.vectors.get(self.field)
         if vc is None:
             return _empty(ctx)
-        if len(self.vector) != vc.dims:
+        if self.tokens.shape[1] != vc.dims:
             raise QueryParsingException(
-                f"knn query vector has {len(self.vector)} dims but field "
-                f"[{self.field}] is mapped with {vc.dims}")
+                f"knn query vector has {self.tokens.shape[1]} dims but "
+                f"field [{self.field}] is mapped with {vc.dims}")
+        if self.maxsim:
+            # MaxSim rides the fused brute kernel (IVF probes one vector;
+            # a token matrix would probe T disjoint candidate sets — the
+            # exact path is both simpler and the parity reference)
+            return self._execute_maxsim(ctx, vc)
         if self._use_ann(ctx):
             ivf = vc.get_ivf(ctx.segment.max_docs)
-            if ivf is not None:
+            pq = (vc.get_pq(ctx.segment.max_docs)
+                  if ivf is not None and self._use_pq(ctx) else None)
+            if ivf is not None and pq is not None:
+                from elasticsearch_tpu.ops.bitvec import pack_mask, popcount
+                from elasticsearch_tpu.ops.ivf import ivf_candidate_scores
+                from elasticsearch_tpu.utils.shapes import pow2_bucket
+
+                # coarse->fine: the filter (and liveness) PRE-filters
+                # candidates inside the device program as a packed
+                # bit-vector, so ADC survivors are all admissible —
+                # no post-selection starvation by construction. Probing
+                # still widens 4x under a filter (a selective filter
+                # thins the probed lists themselves).
+                num_cand = self.num_candidates
+                if self.filter is not None:
+                    num_cand *= 4
+                pre = vc.exists & ctx.segment.live
+                if self.filter is not None:
+                    _, fm2 = self.filter.execute(ctx)
+                    pre = pre & fm2
+                words = pack_mask(pre)
+                # ~8-16x oversample: the ADC rank is a proxy — near-tie
+                # neighbors can land just past 4k survivors on tightly
+                # clustered corpora; 128 exact re-scores are still noise
+                # next to the old path's num_candidates-sized gather
+                fine_k = min(pow2_bucket(max(8 * self.k, 128)), ctx.D)
+                scores, mask = ivf_candidate_scores(
+                    ivf, vc.vecs, self.tokens[0], num_cand, vc.similarity,
+                    ctx.D, pq=pq, fine_k=fine_k, filter_words=words)
+                # recall floor: enough admissible survivors to cover k
+                # (ONE fused reduction + ONE host pull)
+                starved = jnp.sum(mask.astype(jnp.int32)) < jnp.minimum(
+                    jnp.int32(self.k), popcount(words))
+                if not bool(starved):
+                    kernels.record("knn_ivf_pq")
+                    scores = jnp.where(mask, scores, 0.0) * self.boost
+                    return scores, mask
+                # starved (filter excluded the probed clusters): brute
+                # force below selects from ALL admissible docs
+            elif ivf is not None:
                 from elasticsearch_tpu.ops.ivf import ivf_candidate_scores
 
                 # With a filter the intersection is POST-filtering: probed
@@ -1074,7 +1178,7 @@ class KnnQuery(Query):
                 if self.filter is not None:
                     num_cand *= 4
                 scores, mask = ivf_candidate_scores(
-                    ivf, vc.vecs, np.asarray(self.vector, np.float32),
+                    ivf, vc.vecs, self.tokens[0],
                     num_cand, vc.similarity, ctx.D)
                 mask = mask & vc.exists
                 if self.filter is not None:
@@ -1102,7 +1206,7 @@ class KnnQuery(Query):
         # result), vs r2's full [D] score row.
         from elasticsearch_tpu.ops.pallas_kernels import knn_topk_auto
 
-        q = jnp.asarray(np.asarray(self.vector, np.float32)[None, :])
+        q = jnp.asarray(self.tokens)  # [1, dims] (maxsim returned above)
         lv = vc.exists & ctx.segment.live
         if self.filter is not None:
             _, fm = self.filter.execute(ctx)
@@ -1807,14 +1911,19 @@ def _parse_query_inner(dsl: Optional[dict]) -> Query:
 
     if qtype == "knn":
         filt = parse_query(body["filter"]) if "filter" in body else None
+        # query_vectors: ColBERT-style token matrix (MaxSim); a nested
+        # list under query_vector means the same thing
+        vec = body.get("query_vectors",
+                       body.get("query_vector", body.get("vector")))
         return KnnQuery(
             body["field"],
-            body.get("query_vector", body.get("vector")),
+            vec,
             k=int(body.get("k", 10)),
             num_candidates=body.get("num_candidates"),
             filter_=filt,
             boost=float(body.get("boost", 1.0)),
             ann=body.get("ann"),
+            pq=body.get("pq"),
         )
 
     if qtype == "bool":

@@ -7,7 +7,8 @@ recall@10 ≥ 0.95 while scoring only a fraction of the corpus.
 import numpy as np
 import pytest
 
-from elasticsearch_tpu.ops.ivf import build_ivf, ivf_candidate_scores, kmeans
+from elasticsearch_tpu.ops.ivf import (IvfIndex, build_ivf,
+                                        ivf_candidate_scores, kmeans)
 
 
 def _clustered(n, dims, n_clusters, seed=0):
@@ -61,6 +62,36 @@ def test_ivf_recall_vs_exact():
     # and it probed far fewer than n vectors
     nprobe = idx.nprobe_for(2000)
     assert nprobe * idx.Lmax < n
+
+
+def test_nprobe_for_clamps_degenerate_num_candidates():
+    """ISSUE-9 satellite: nprobe must stay in [1, C] for num_candidates
+    <= 0 and > ntotal (the raw ceil/avg_len math returns 0 or > C)."""
+    n, dims = 4000, 16
+    x = _clustered(n, dims, 32, seed=4)
+    D = 4096
+    vecs = np.zeros((D, dims), np.float32)
+    vecs[:n] = x
+    exists = np.zeros(D, bool)
+    exists[:n] = True
+    idx = build_ivf(vecs, exists, D)
+    assert idx is not None
+    assert idx.nprobe_for(0) == 1
+    assert idx.nprobe_for(-100) == 1
+    assert idx.nprobe_for(1) == 1
+    assert 1 <= idx.nprobe_for(idx.ntotal) <= idx.C
+    assert idx.nprobe_for(idx.ntotal + 1) <= idx.C
+    assert idx.nprobe_for(10 ** 9) == idx.C
+    # monotone in num_candidates
+    probes = [idx.nprobe_for(nc) for nc in (1, 100, 1000, n, 10 ** 9)]
+    assert probes == sorted(probes)
+    # degenerate avg_len < 1 (more lists than vectors is impossible by
+    # construction, but a restored index could carry avg_len < 1): the
+    # clamp still holds
+    tiny = IvfIndex(centroids=None, lists=None, list_lens=None, C=8,
+                    Lmax=1, sentinel=8, avg_len=0.5)
+    assert tiny.nprobe_for(0) == 1
+    assert 1 <= tiny.nprobe_for(10 ** 9) <= tiny.C
 
 
 def test_ivf_declines_tiny_corpus():

@@ -1,0 +1,223 @@
+"""Multi-vector MaxSim (ColBERT-style token-matrix queries) — ISSUE-9.
+
+Acceptance surface: a MaxSim query returns parity with a numpy
+reference through BOTH the sequential serving path (Node.search ->
+KnnQuery._execute_maxsim) and the coalesced serving path (concurrent
+identical-shape searches micro-batched through serving/coalescer ->
+search/batch.knn_topk_fused_batch), plus the executor product API
+(MeshSearchExecutor.search_maxsim) and the device dedup-by-max merge
+primitive.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.monitor import kernels
+from elasticsearch_tpu.node import Node
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.RandomState(17)
+    V = rng.randn(300, 8).astype(np.float32)
+    n = Node()
+    n.create_index("mv", {"settings": {"number_of_shards": 1},
+                          "mappings": {"properties": {
+                              "emb": {"type": "dense_vector", "dims": 8},
+                              "tag": {"type": "keyword"}}}})
+    svc = n.indices["mv"]
+    for i in range(300):
+        svc.index_doc(str(i), {"emb": [float(x) for x in V[i]],
+                               "tag": f"g{i % 3}"})
+    svc.refresh()
+    yield n, V
+    n.close()
+
+
+def _maxsim_ref(tokens, V, k):
+    """Numpy reference: per-doc score = max over query tokens of the ES
+    cosine score (1+cos)/2; top-k by (score desc, doc asc)."""
+    Vn = V / np.maximum(np.linalg.norm(V, axis=1, keepdims=True), 1e-12)
+    Tn = tokens / np.maximum(
+        np.linalg.norm(tokens, axis=1, keepdims=True), 1e-12)
+    S = (1.0 + Tn @ Vn.T) * 0.5
+    per_doc = S.max(axis=0)
+    order = np.lexsort((np.arange(V.shape[0]), -per_doc))[:k]
+    return order, per_doc
+
+
+def test_maxsim_sequential_parity_with_numpy(corpus):
+    n, V = corpus
+    rng = np.random.RandomState(3)
+    for trial in range(3):
+        T = rng.randn(rng.randint(2, 5), 8).astype(np.float32)
+        body = {"query": {"knn": {
+            "field": "emb",
+            "query_vectors": [[float(x) for x in t] for t in T],
+            "k": 7, "num_candidates": 100}}, "size": 7}
+        before = kernels.snapshot().get("knn_maxsim", 0)
+        r = n.search("mv", body)
+        assert kernels.snapshot().get("knn_maxsim", 0) > before
+        ref_ids, per_doc = _maxsim_ref(T, V, 7)
+        got = [int(h["_id"]) for h in r["hits"]["hits"]]
+        assert got == ref_ids.tolist(), (trial, got, ref_ids)
+        np.testing.assert_allclose(
+            [h["_score"] for h in r["hits"]["hits"]],
+            per_doc[ref_ids], rtol=1e-5)
+
+
+def test_maxsim_nested_query_vector_spelling(corpus):
+    """A nested list under query_vector means the same as query_vectors."""
+    n, V = corpus
+    T = np.asarray([[1.0] * 8, [-1.0] * 8], np.float32)
+    a = n.search("mv", {"query": {"knn": {
+        "field": "emb", "query_vector": T.tolist(), "k": 5,
+        "num_candidates": 50}}, "size": 5})
+    b = n.search("mv", {"query": {"knn": {
+        "field": "emb", "query_vectors": T.tolist(), "k": 5,
+        "num_candidates": 50}}, "size": 5})
+    assert [h["_id"] for h in a["hits"]["hits"]] == \
+        [h["_id"] for h in b["hits"]["hits"]]
+
+
+def test_maxsim_filter_composes(corpus):
+    n, V = corpus
+    T = np.asarray([[1.0] * 8, [-1.0] * 8], np.float32)
+    r = n.search("mv", {"query": {"knn": {
+        "field": "emb", "query_vectors": T.tolist(), "k": 6,
+        "num_candidates": 100,
+        "filter": {"term": {"tag": "g1"}}}}, "size": 6})
+    assert r["hits"]["hits"]
+    assert all(int(h["_id"]) % 3 == 1 for h in r["hits"]["hits"])
+    # parity against the reference restricted to the filtered set
+    Vn = V / np.maximum(np.linalg.norm(V, axis=1, keepdims=True), 1e-12)
+    Tn = T / np.maximum(np.linalg.norm(T, axis=1, keepdims=True), 1e-12)
+    per_doc = ((1.0 + Tn @ Vn.T) * 0.5).max(axis=0)
+    allowed = np.asarray([i % 3 == 1 for i in range(300)])
+    per_doc = np.where(allowed, per_doc, -np.inf)
+    ref = np.lexsort((np.arange(300), -per_doc))[:6]
+    assert [int(h["_id"]) for h in r["hits"]["hits"]] == ref.tolist()
+
+
+def test_maxsim_coalesced_parity(corpus):
+    """Concurrent identical-shape MaxSim searches coalesce into ONE
+    fused batch (knn_fused_batch counter advances, batch-size histogram
+    records > 1) and every client gets the sequential answer."""
+    n, V = corpus
+    T = np.random.RandomState(5).randn(2, 8).astype(np.float32)
+    body = {"query": {"knn": {
+        "field": "emb", "query_vectors": [[float(x) for x in t] for t in T],
+        "k": 5, "num_candidates": 100}}, "size": 5}
+    seq = n.search("mv", body)
+    sig = [(h["_id"], round(h["_score"], 5)) for h in seq["hits"]["hits"]]
+    ref_ids, per_doc = _maxsim_ref(T, V, 5)
+    assert [int(h) for h, _ in sig] == ref_ids.tolist()
+
+    n.serving.apply_cluster_settings({
+        "serving.coalescer.mode": "always",
+        "serving.coalescer.max_wait": "60ms",
+        "serving.coalescer.idle_gap": "25ms"})
+    try:
+        N = 8
+        results = [None] * N
+        barrier = threading.Barrier(N)
+
+        def client(i):
+            barrier.wait()
+            results[i] = n.search("mv", dict(body))
+
+        before = kernels.snapshot().get("knn_fused_batch", 0)
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        for r in results:
+            assert r is not None
+            assert [(h["_id"], round(h["_score"], 5))
+                    for h in r["hits"]["hits"]] == sig
+        assert kernels.snapshot().get("knn_fused_batch", 0) - before >= 2
+    finally:
+        n.serving.apply_cluster_settings({})
+
+
+def test_maxsim_msearch_batches(corpus):
+    """Explicit _msearch of uniform MaxSim bodies rides the fused knn
+    batch tier (mixed token counts repeat-pad to one tensor)."""
+    n, V = corpus
+    rng = np.random.RandomState(9)
+    T2 = rng.randn(2, 8).astype(np.float32)
+    T3 = rng.randn(3, 8).astype(np.float32)
+    pairs = []
+    refs = []
+    for T in (T2, T3, T2, T3):
+        pairs.append(({"index": "mv"}, {"query": {"knn": {
+            "field": "emb",
+            "query_vectors": [[float(x) for x in t] for t in T],
+            "k": 5, "num_candidates": 100}}, "size": 5}))
+        refs.append(_maxsim_ref(T, V, 5)[0].tolist())
+    before = kernels.snapshot().get("knn_fused_batch", 0)
+    resp = n.msearch(pairs)
+    assert kernels.snapshot().get("knn_fused_batch", 0) - before >= 4
+    for r, ref in zip(resp["responses"], refs):
+        assert [int(h["_id"]) for h in r["hits"]["hits"]] == ref
+
+
+def test_maxsim_executor_parity(corpus):
+    n, V = corpus
+    ex = n.indices["mv"].mesh_executor()
+    if ex is None:
+        pytest.skip("no mesh executor on this backend")
+    rng = np.random.RandomState(11)
+    T = rng.randn(3, 8).astype(np.float32)
+    ref_ids, per_doc = _maxsim_ref(T, V, 6)
+    vals, shard, local, ordn, _tot = ex.search_maxsim(
+        "emb", np.stack([T, T]), k=6)
+    for qi in range(2):
+        assert [int(x) for x in local[qi]] == ref_ids.tolist()
+        np.testing.assert_allclose(vals[qi], per_doc[ref_ids], rtol=1e-5)
+
+
+def test_ragged_query_vectors_is_a_typed_error():
+    """A ragged token list must raise QueryParsingException (HTTP 400),
+    not leak numpy's ValueError (HTTP 500)."""
+    from elasticsearch_tpu.search.queries import KnnQuery
+    from elasticsearch_tpu.utils.errors import QueryParsingException
+
+    with pytest.raises(QueryParsingException, match="malformed knn"):
+        KnnQuery("emb", [[1.0, 2.0], [1.0, 2.0, 3.0]], k=3)
+
+
+def test_mesh_compile_single_token_query_vectors(corpus):
+    """A single-token query_vectors body (nested list, maxsim=False) must
+    hand VecsPrim the 1-D vector — the raw body value is [1, dims] and
+    would make the prim derive dims = 1."""
+    n, V = corpus
+    from elasticsearch_tpu.parallel.compiler import (MeshQueryCompiler,
+                                                     VecsPrim)
+    from elasticsearch_tpu.search.queries import KnnQuery
+
+    svc = n.indices["mv"]
+    q = KnnQuery("emb", [[1.0] * 8], k=3, ann=False)
+    assert not q.maxsim
+    comp = MeshQueryCompiler(svc.mappings, svc.analysis, D=512)
+    comp.compile(q, None, None)
+    vp = next(p for p in comp.prims if isinstance(p, VecsPrim))
+    assert vp.qvec.shape == (8,)
+
+
+def test_merge_candidate_topk_dedups_and_orders():
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.knn import merge_candidate_topk
+
+    vals = jnp.asarray([[0.9, 0.8, 0.9, 0.5, -jnp.inf, 0.8]])
+    ids = jnp.asarray([[7, 3, 3, 9, 0, 7]], dtype=jnp.int32)
+    v, i, nuniq = merge_candidate_topk(vals, ids, k=3)
+    # doc 3 max = 0.9, doc 7 max = 0.9 (tie -> lower id first), doc 9
+    assert np.asarray(i)[0].tolist() == [3, 7, 9]
+    np.testing.assert_allclose(np.asarray(v)[0], [0.9, 0.9, 0.5])
+    assert int(np.asarray(nuniq)[0]) == 3  # 3, 7, 9 (the -inf id-0 slot
+    # is invalid and must not count)

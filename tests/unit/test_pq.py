@@ -1,0 +1,335 @@
+"""PQ-coded slabs + asymmetric coarse->fine ANN (ops/pq.py) — ISSUE-9.
+
+Covers the tentpole acceptance surface on CPU: ADC round-trip recall@10
+>= 0.95 vs the exact oracle on a seeded synthetic slab, eviction ->
+rehydration bit-parity of the evictable code arrays, breaker-denied
+placement degrading to the exact fine-rank path (the dense-impact
+best-effort contract), packed bit-vector pre-filters, the content-
+addressed PQ blob cache (restart warm path + corruption = miss), and
+the blob codec itself.
+"""
+import numpy as np
+import pytest
+
+from elasticsearch_tpu import resources
+from elasticsearch_tpu.ops.ivf import build_ivf, ivf_candidate_scores
+from elasticsearch_tpu.ops.pq import (build_pq, place_pq, pq_codebook_size,
+                                      pq_layout)
+from elasticsearch_tpu.resources.breakers import CircuitBreakerService
+from elasticsearch_tpu.resources.residency import ResidencyRegistry
+
+
+@pytest.fixture
+def iso(monkeypatch):
+    """Isolated breaker service + residency registry (the process
+    singletons are read as module attributes at every call site)."""
+    svc = CircuitBreakerService(capacity=1 << 30)
+    reg = ResidencyRegistry(svc)
+    monkeypatch.setattr(resources, "BREAKERS", svc)
+    monkeypatch.setattr(resources, "RESIDENCY", reg)
+    yield svc, reg
+
+
+def _clustered_slab(n=8000, dims=32, n_clusters=256, seed=1):
+    rng = np.random.default_rng(seed)
+    cents = rng.standard_normal((n_clusters, dims)).astype(np.float32)
+    assign = rng.integers(0, n_clusters, n)
+    x = cents[assign] + rng.standard_normal((n, dims)).astype(np.float32)
+    D = 1 << int(np.ceil(np.log2(n)))
+    vecs = np.zeros((D, dims), np.float32)
+    vecs[:n] = x
+    exists = np.zeros(D, bool)
+    exists[:n] = True
+    return x, vecs, exists, D
+
+
+def test_pq_layout_and_codebook_size():
+    assert pq_layout(128) == (32, 4)
+    assert pq_layout(32) == (8, 4)
+    assert pq_layout(8) == (2, 4)
+    M, dsub = pq_layout(6)
+    assert M * dsub == 6
+    assert pq_codebook_size(100_000) == 256
+    k = pq_codebook_size(200)
+    assert k <= 32 and k >= 8  # >= 8 training vectors per codeword
+
+
+def test_pq_declines_tiny_slab():
+    rng = np.random.default_rng(0)
+    vecs = rng.standard_normal((64, 16)).astype(np.float32)
+    assert build_pq(vecs, np.ones(64, bool), "cosine") is None
+
+
+def test_pq_coarse_fine_recall_vs_exact(iso):
+    """The tentpole acceptance floor: coarse ADC rank + exact fine
+    re-rank of the top survivors keeps recall@10 >= 0.95 against the
+    exact oracle, through the same ivf_candidate_scores entry the
+    product path uses."""
+    import jax
+
+    x, vecs, exists, D = _clustered_slab()
+    n, dims = x.shape
+    ivf = build_ivf(vecs, exists, D)
+    pq = place_pq(build_pq(vecs, exists, "cosine"), label="t")
+    assert pq is not None
+    dv = jax.device_put(vecs)
+    xn = x / np.maximum(np.linalg.norm(x, axis=1, keepdims=True), 1e-12)
+    rng = np.random.default_rng(2)
+    hits, trials = 0, 20
+    for _ in range(trials):
+        q = x[rng.integers(n)] + 0.1 * rng.standard_normal(
+            dims).astype(np.float32)
+        qn = q / max(np.linalg.norm(q), 1e-12)
+        exact = np.argsort(-(xn @ qn), kind="stable")[:10]
+        s, m = ivf_candidate_scores(ivf, dv, q, 2000, "cosine", D,
+                                    pq=pq, fine_k=128)
+        sa = np.asarray(s).copy()
+        sa[~np.asarray(m)] = -np.inf
+        approx = np.argsort(-sa, kind="stable")[:10]
+        hits += len(set(exact.tolist()) & set(approx.tolist()))
+        # fine stage emits EXACT scores: survivors' scores match the
+        # oracle's cosine (ES (1+cos)/2 shape), not the ADC proxy
+        top = approx[0]
+        assert sa[top] == pytest.approx((1 + float(xn[top] @ qn)) / 2,
+                                        rel=1e-5)
+    assert hits / (10 * trials) >= 0.95, hits / (10 * trials)
+
+
+def test_pq_fine_k_bounds_fine_stage(iso):
+    """The mask carries at most fine_k survivors — the cliff fix: work
+    past the coarse rank no longer scales with num_candidates."""
+    import jax
+
+    _x, vecs, exists, D = _clustered_slab(n=4000, dims=32)
+    ivf = build_ivf(vecs, exists, D)
+    pq = place_pq(build_pq(vecs, exists, "cosine"), label="t")
+    dv = jax.device_put(vecs)
+    q = vecs[7]
+    for fine_k in (32, 64):
+        _s, m = ivf_candidate_scores(ivf, dv, q, 2000, "cosine", D,
+                                     pq=pq, fine_k=fine_k)
+        assert int(np.asarray(m).sum()) <= fine_k
+
+
+def test_pq_eviction_rehydration_bit_parity(iso):
+    """Evicting the fielddata-tier code handle and touching it again
+    must rehydrate the EXACT bytes (the host mirror is authoritative),
+    and the tier counters must advance."""
+    _svc, reg = iso
+    _x, vecs, exists, _D = _clustered_slab(n=2000, dims=16)
+    pq = place_pq(build_pq(vecs, exists, "cosine"), label="t")
+    assert pq is not None
+    before = np.asarray(pq.codes_dev()).copy()
+    assert pq.codes.resident
+    n_evicted = reg.evict_all(tier="fielddata")
+    assert n_evicted >= 1
+    assert not pq.codes.resident
+    after = np.asarray(pq.codes_dev())  # touch -> rehydrate
+    assert pq.codes.resident
+    np.testing.assert_array_equal(before, after)
+    stats = reg.stats()["tiers"]["fielddata"]
+    assert stats["evictions"] >= 1 and stats["rehydrations"] >= 1
+
+
+def test_pq_breaker_denial_is_best_effort(iso):
+    """A fielddata breaker too small for the code array returns None
+    from place_pq (no raise) — same contract as dense impact blocks."""
+    svc, _reg = iso
+    svc.apply_cluster_settings({"indices.breaker.fielddata.limit": 128})
+    _x, vecs, exists, _D = _clustered_slab(n=2000, dims=16)
+    parts = build_pq(vecs, exists, "cosine")
+    assert parts is not None
+    assert place_pq(parts, label="t") is None
+
+
+def test_knn_query_falls_back_to_exact_on_denied_pq(iso):
+    """Engine-level best-effort: with the PQ code-array placement
+    breaker-denied (resources.reserve chaos point scoped to the pq
+    label), an ivf_pq-mapped knn query still answers through the exact
+    fine-rank path (knn_ivf, not knn_ivf_pq) — and a later query
+    retries placement and recovers the PQ path without re-training."""
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.utils.errors import CircuitBreakingException
+    from elasticsearch_tpu.utils.faults import FAULTS
+
+    try:
+        # deny exactly the pq code-array reservations (freeze + the
+        # first query's retry); column loads stay admitted
+        FAULTS.inject("resources.reserve", CircuitBreakingException,
+                      count=2, match=lambda ctx: "pq[" in ctx["label"])
+        n = Node()
+        n.create_index("pqd", {"mappings": {"properties": {
+            "emb": {"type": "dense_vector", "dims": 8,
+                    "index_options": {"type": "ivf_pq"}}}}})
+        isvc = n.indices["pqd"]
+        rng = np.random.default_rng(5)
+        cents = rng.standard_normal((4, 8)).astype(np.float32) * 4
+        for i in range(400):
+            v = cents[i % 4] + 0.2 * rng.standard_normal(8).astype(
+                np.float32)
+            isvc.index_doc(str(i), {"emb": [float(x) for x in v]})
+        isvc.refresh()
+        seg = isvc.shards[0].segments[0]
+        assert seg.vectors["emb"]._pq is None  # denied, retryable
+        assert seg.vectors["emb"]._pq_parts is not None  # build memoized
+        target = isvc.shards[0].engine.get("101")["_source"]["emb"]
+        before = kernels.snapshot()
+        r = n.search("pqd", {"query": {"knn": {
+            "field": "emb", "query_vector": target, "k": 5,
+            "num_candidates": 200}}, "size": 5})
+        assert r["hits"]["hits"][0]["_id"] == "101"
+        after = kernels.snapshot()
+        assert after.get("knn_ivf", 0) > before.get("knn_ivf", 0)
+        assert after.get("knn_ivf_pq", 0) == before.get("knn_ivf_pq", 0)
+        # fault exhausted: the next query's placement retry succeeds
+        # from the memoized build (no second pq_build)
+        builds = after.get("pq_build", 0)
+        r2 = n.search("pqd", {"query": {"knn": {
+            "field": "emb", "query_vector": target, "k": 5,
+            "num_candidates": 200}}, "size": 5})
+        assert r2["hits"]["hits"][0]["_id"] == "101"
+        final = kernels.snapshot()
+        assert final.get("knn_ivf_pq", 0) > after.get("knn_ivf_pq", 0)
+        assert final.get("pq_build", 0) == builds
+        n.close()
+    finally:
+        FAULTS.clear()
+
+
+def test_pq_prefilter_bitvec(iso):
+    """A packed pre-filter drops inadmissible candidates BEFORE the
+    coarse rank: every survivor passes the filter."""
+    import jax
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.bitvec import pack_mask
+
+    x, vecs, exists, D = _clustered_slab(n=4000, dims=32)
+    ivf = build_ivf(vecs, exists, D)
+    pq = place_pq(build_pq(vecs, exists, "cosine"), label="t")
+    dv = jax.device_put(vecs)
+    rng = np.random.default_rng(3)
+    allow = rng.random(D) < 0.3
+    words = pack_mask(jnp.asarray(allow & exists))
+    q = x[11] + 0.05 * rng.standard_normal(32).astype(np.float32)
+    _s, m = ivf_candidate_scores(ivf, dv, q, 1000, "cosine", D,
+                                 pq=pq, fine_k=64, filter_words=words)
+    m = np.asarray(m)
+    assert m.sum() > 0
+    assert np.all(allow[np.nonzero(m)[0]])
+
+
+def test_pq_codec_roundtrip_and_corruption():
+    from elasticsearch_tpu.index.store import (CorruptStoreException,
+                                               read_pq, write_pq)
+
+    _x, vecs, exists, _D = _clustered_slab(n=1000, dims=16)
+    parts = build_pq(vecs, exists, "cosine")
+    blob = write_pq(parts)
+    back = read_pq(blob)
+    assert (back.M, back.K, back.dsub, back.dims,
+            back.metric) == (parts.M, parts.K, parts.dsub, parts.dims,
+                             parts.metric)
+    np.testing.assert_array_equal(back.codes, parts.codes)
+    np.testing.assert_allclose(back.codebooks, parts.codebooks, rtol=1e-6)
+    raw = bytearray(blob)
+    raw[-3] ^= 0xFF
+    with pytest.raises(CorruptStoreException):
+        read_pq(bytes(raw))
+
+
+def test_pq_cache_restart_reloads(tmp_path):
+    """A restarted node reloads the persisted PQ blob at replay-freeze
+    (pq_cache_hit) instead of re-training (pq_build) — the IVF cache
+    discipline, same content address, different extension."""
+    from elasticsearch_tpu.index import ivf_cache
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.node import Node
+
+    n = Node(data_path=str(tmp_path))
+    n.create_index("warmpq", {"mappings": {"properties": {
+        "emb": {"type": "dense_vector", "dims": 8,
+                "index_options": {"type": "ivf_pq"}}}}})
+    svc = n.indices["warmpq"]
+    rng = np.random.default_rng(7)
+    for i in range(200):
+        svc.index_doc(str(i), {"emb": [float(v) for v in rng.random(8)]})
+    svc.refresh()
+    before = kernels.snapshot()
+    assert before.get("pq_build", 0) >= 1
+    codes_a = n.indices["warmpq"].shards[0].segments[0].vectors[
+        "emb"]._pq_parts.codes.copy()
+    n.close()
+
+    ivf_cache.reset()  # simulate a new process: memory gone, disk remains
+    n2 = Node(data_path=str(tmp_path))
+    n2.indices["warmpq"].refresh()
+    after = kernels.snapshot()
+    assert after.get("pq_cache_hit", 0) > before.get("pq_cache_hit", 0)
+    assert after.get("pq_build", 0) == before.get("pq_build", 0)
+    codes_b = n2.indices["warmpq"].shards[0].segments[0].vectors[
+        "emb"]._pq_parts.codes
+    np.testing.assert_array_equal(codes_a, codes_b)
+    n2.close()
+
+
+def test_pq_cache_corrupt_blob_is_a_miss(tmp_path):
+    from elasticsearch_tpu.index import ivf_cache
+    from elasticsearch_tpu.monitor import kernels
+    from elasticsearch_tpu.node import Node
+
+    n = Node(data_path=str(tmp_path))
+    n.create_index("cpq", {"mappings": {"properties": {
+        "emb": {"type": "dense_vector", "dims": 8,
+                "index_options": {"type": "ivf_pq"}}}}})
+    svc = n.indices["cpq"]
+    rng = np.random.default_rng(9)
+    for i in range(200):
+        svc.index_doc(str(i), {"emb": [float(v) for v in rng.random(8)]})
+    svc.refresh()
+    n.close()
+
+    ivf_cache.reset()
+    blobs = list((tmp_path / "_ivf").glob("*.pq"))
+    assert blobs, "freeze must have persisted a .pq blob"
+    for p in blobs:
+        raw = bytearray(p.read_bytes())
+        raw[-3] ^= 0xFF
+        p.write_bytes(bytes(raw))
+    before = kernels.snapshot()
+    n2 = Node(data_path=str(tmp_path))
+    n2.indices["cpq"].refresh()
+    after = kernels.snapshot()
+    assert after.get("pq_build", 0) > before.get("pq_build", 0)
+    n2.close()
+
+
+# ---------------------------------------------------------------------------
+# packed bit-vector algebra (ops/bitvec.py)
+# ---------------------------------------------------------------------------
+
+def test_bitvec_pack_unpack_test_popcount():
+    import jax.numpy as jnp
+
+    from elasticsearch_tpu.ops.bitvec import (bitvec_and, bitvec_andnot,
+                                              bitvec_or, pack_mask,
+                                              popcount, test_bits,
+                                              unpack_mask)
+
+    rng = np.random.default_rng(0)
+    D = 512
+    a = rng.random(D) < 0.4
+    b = rng.random(D) < 0.5
+    wa, wb = pack_mask(jnp.asarray(a)), pack_mask(jnp.asarray(b))
+    assert np.asarray(wa).dtype == np.uint32 and wa.shape == (D // 32,)
+    np.testing.assert_array_equal(np.asarray(unpack_mask(wa)), a)
+    ids = rng.integers(0, D, 200).astype(np.int32)
+    np.testing.assert_array_equal(np.asarray(test_bits(wa, ids)), a[ids])
+    assert int(popcount(wa)) == int(a.sum())
+    np.testing.assert_array_equal(
+        np.asarray(unpack_mask(bitvec_and(wa, wb))), a & b)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_mask(bitvec_or(wa, wb))), a | b)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_mask(bitvec_andnot(wa, wb))), a & ~b)

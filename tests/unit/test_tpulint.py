@@ -876,6 +876,68 @@ class TestR010:
                                "elasticsearch_tpu/index/other.py")
 
 
+class TestPqTierFixtures:
+    """PQ-tier discipline (ISSUE 9): the codebook BUILD path is a
+    host-side freeze-time scan and must carry `# tpulint: host` (R003),
+    and code-array/codebook placement must route through the residency
+    choke point instead of raw jax.device_put (R008). Fixture versions
+    of ops/pq.py's two discipline points, plus a direct clean lint of
+    the real module (it is NEW — no baseline entries shield it)."""
+
+    def test_bad_unannotated_pq_build_live_scan(self):
+        # build_pq's live-row scan without the host annotation
+        vs = lint("""
+            import numpy as np
+            def build_pq(vecs, exists):
+                ids = np.nonzero(exists)[0]
+                return vecs[ids]
+        """, ops=True)
+        assert rules_of(vs) == ["R003"]
+
+    def test_good_pq_build_host_annotated(self):
+        vs = lint("""
+            import numpy as np
+            def build_pq(vecs, exists):
+                ids = np.nonzero(exists)[0]  # tpulint: host
+                return vecs[ids]
+        """, ops=True)
+        assert vs == []
+
+    def test_bad_code_array_raw_device_put(self):
+        # placing the uint8 code slab around the accounting
+        vs = lint("""
+            import jax
+            def place_pq(parts):
+                codes = jax.device_put(parts.codes)
+                books = jax.device_put(parts.codebooks)
+                return codes, books
+        """, budget=True)
+        assert [v.rule for v in vs] == ["R008", "R008"]
+
+    def test_good_code_array_through_residency(self):
+        # the real shape: evictable fielddata handle for the codes,
+        # accounted device_put for the codebooks
+        vs = lint("""
+            from elasticsearch_tpu import resources
+            def place_pq(parts):
+                handle = resources.RESIDENCY.put_array(
+                    parts.codes, label="pq.codes", tier="fielddata",
+                    best_effort=True)
+                books = resources.RESIDENCY.device_put(
+                    parts.codebooks, label="pq.codebooks")
+                return handle, books
+        """, budget=True)
+        assert vs == []
+
+    def test_real_pq_module_is_clean(self):
+        import pathlib
+
+        mod = (pathlib.Path(__file__).resolve().parents[2]
+               / "elasticsearch_tpu" / "ops" / "pq.py")
+        assert lint_source(mod.read_text(),
+                           "elasticsearch_tpu/ops/pq.py") == []
+
+
 class TestSuppression:
     def test_same_line_allow(self):
         vs = lint("""
