@@ -38,9 +38,9 @@ from elasticsearch_tpu.index.seqno import (GlobalCheckpointTracker,
                                            NO_OPS_PERFORMED)
 from elasticsearch_tpu.tracing import TaskCancelledException
 from elasticsearch_tpu.utils import wire
-from elasticsearch_tpu.utils.errors import (ElasticsearchTpuException,
-                                            IndexNotFoundException,
-                                            StalePrimaryException)
+from elasticsearch_tpu.utils.errors import (
+    ElasticsearchTpuException, FailedToCommitClusterStateException,
+    IndexNotFoundException, StalePrimaryException)
 from elasticsearch_tpu.utils.faults import FAULTS
 
 ACTION_QUERY = "indices:data/read/search[phase/query]"
@@ -270,6 +270,7 @@ class DistributedDataService:
         """Create an index with shards assigned round-robin across the
         current members (reference: MetaDataCreateIndexService + the
         allocation pass). Master performs it; others route to the master."""
+        self.cluster.ensure_not_blocked("metadata_write")
         if not self.cluster.is_master:
             return self.cluster.transport.send_remote(
                 self.cluster.master_addr, ACTION_CREATE,
@@ -277,6 +278,12 @@ class DistributedDataService:
         return self._on_create({"name": name, "body": body})
 
     def _on_create(self, payload: dict) -> dict:
+        # forwarded metadata ops re-check on ARRIVAL: a stale view may
+        # route to a stepped-down or never-master node — it must fail
+        # typed, never execute and publish a state the quorum's master
+        # will contradict
+        self.cluster.ensure_not_blocked("metadata_write")
+        self.cluster._require_master(ACTION_CREATE)
         name, body = payload["name"], payload.get("body") or {}
         with self.cluster._indices_lock:
             if name in self.cluster.dist_indices:
@@ -337,9 +344,30 @@ class DistributedDataService:
                         "in_sync": {k: list(v)
                                     for k, v in assignment.items()}}
             self.cluster.dist_indices[name] = meta
-            if not self.node.index_exists(name):
+            created_local = not self.node.index_exists(name)
+            if created_local:
                 self.node.create_index(name, local_body)
-        self.cluster.publish_indices()
+        try:
+            self.cluster.publish_indices()
+        except Exception:
+            # the metadata change never committed (no publish quorum —
+            # the master just stepped down): ROLL BACK the local half so
+            # this node holds no index the majority will never know
+            # about, then fail the client op typed
+            with self.cluster._indices_lock:
+                self.cluster.dist_indices.pop(name, None)
+                if created_local and self.node.index_exists(name):
+                    try:
+                        self.node._delete_local_index(name)
+                    except Exception:  # tpulint: allow[R006] — rollback
+                        pass           # is best-effort; the typed 503
+                        # below is the authoritative outcome
+                # the pre-publish persist already wrote the index to
+                # dist_indices.json — re-persist the rolled-back map or
+                # a master restart resurrects an index the client was
+                # told (503) never committed
+                self.cluster._persist_dist_meta()
+            raise
         return {"acknowledged": True, "index": name,
                 "assignment": assignment, "local_body": local_body}
 
@@ -347,6 +375,7 @@ class DistributedDataService:
         """Mark a distributed index open/closed in the published metadata
         (reference: MetaDataIndexStateService — open/close is cluster
         state, not a node-local flag). Peers apply it on adopt."""
+        self.cluster.ensure_not_blocked("metadata_write")
         if not self.cluster.is_master:
             return self.cluster.transport.send_remote(
                 self.cluster.master_addr, ACTION_SET_CLOSED,
@@ -354,17 +383,41 @@ class DistributedDataService:
         return self._on_set_closed({"name": name, "closed": closed})
 
     def _on_set_closed(self, payload: dict) -> dict:
+        # forwarded metadata ops re-check on ARRIVAL: a stale view may
+        # route to a stepped-down or never-master node — it must fail
+        # typed, never execute and publish a state the quorum's master
+        # will contradict
+        self.cluster.ensure_not_blocked("metadata_write")
+        self.cluster._require_master(ACTION_SET_CLOSED)
         from elasticsearch_tpu.cluster.metadata import (close_index,
                                                         open_index)
 
         name, closed = payload["name"], payload["closed"]
         with self.cluster._indices_lock:
             meta = self.cluster.dist_indices.get(name)
+            prior = None if meta is None else meta.get("closed")
             if meta is not None:
                 meta["closed"] = bool(closed)
-            if self.node.index_exists(name):
+            had_local = self.node.index_exists(name)
+            if had_local:
                 (close_index if closed else open_index)(self.node, name)
-        self.cluster.publish_indices()
+        try:
+            self.cluster.publish_indices()
+        except Exception:
+            # not committed: revert both halves (metadata flag + local
+            # open/close) so this node doesn't diverge from the state
+            # the quorum's master will republish
+            with self.cluster._indices_lock:
+                if meta is not None:
+                    if prior is None:
+                        meta.pop("closed", None)
+                    else:
+                        meta["closed"] = prior
+                if had_local:
+                    (close_index if prior else open_index)(self.node,
+                                                           name)
+                self.cluster._persist_dist_meta()
+            raise
         return {"acknowledged": True}
 
     def delete_index(self, name: str) -> dict:
@@ -374,6 +427,7 @@ class DistributedDataService:
         copy. Reference: MetaDataDeleteIndexService. Without this, a
         local-only delete left the metadata alive and the next publish
         resurrected the index on every peer."""
+        self.cluster.ensure_not_blocked("metadata_write")
         if not self.cluster.is_master:
             return self.cluster.transport.send_remote(
                 self.cluster.master_addr, ACTION_DELETE_INDEX,
@@ -381,13 +435,34 @@ class DistributedDataService:
         return self._on_delete_index({"name": name})
 
     def _on_delete_index(self, payload: dict) -> dict:
+        # forwarded metadata ops re-check on ARRIVAL: a stale view may
+        # route to a stepped-down or never-master node — it must fail
+        # typed, never execute and publish a state the quorum's master
+        # will contradict
+        self.cluster.ensure_not_blocked("metadata_write")
+        self.cluster._require_master(ACTION_DELETE_INDEX)
         name = payload["name"]
         with self.cluster._indices_lock:
-            self.cluster.dist_indices.pop(name, None)
+            prior = self.cluster.dist_indices.pop(name, None)
+        try:
+            self.cluster.publish_indices()
+        except Exception:
+            # the delete never committed (no publish quorum — the master
+            # stepped down): restore the metadata and KEEP the local
+            # shard data; destroying it before the quorum gate would
+            # leave this node dataless for an index the majority still
+            # serves, after telling the client 503 "not committed"
+            with self.cluster._indices_lock:
+                if prior is not None \
+                        and name not in self.cluster.dist_indices:
+                    self.cluster.dist_indices[name] = prior
+                self.cluster._persist_dist_meta()
+            raise
+        with self.cluster._indices_lock:
             if self.node.index_exists(name):
-                # bypass Node.delete_index's dist routing (we ARE it)
+                # bypass Node.delete_index's dist routing (we ARE it);
+                # destruction happens only AFTER the quorum committed
                 self.node._delete_local_index(name)
-        self.cluster.publish_indices()
         return {"acknowledged": True}
 
     def refresh(self, index: str) -> None:
@@ -540,6 +615,7 @@ class DistributedDataService:
         shared repository (reference: snapshots/RestoreService.java:1-120 —
         the master creates restore routing with a SNAPSHOT recovery
         source; each data node recovers its shards from the repo)."""
+        self.cluster.ensure_not_blocked("metadata_write")
         payload = {"location": location, "snapshot": snap_name,
                    "indices": indices, "rename_pattern": rename_pattern,
                    "rename_replacement": rename_replacement,
@@ -627,7 +703,19 @@ class DistributedDataService:
                         # back active but EMPTY): a failed restore shard,
                         # same accounting as the single-node path
                         failed += 1
-            self.cluster.publish_indices()
+            try:
+                self.cluster.publish_indices()
+            except Exception:
+                # the restore target never committed (publish lost
+                # quorum — the master stepped down): back the working
+                # metadata out like create does, so a stepped-down node
+                # holds no restored index the majority never saw, and
+                # fail the restore typed (already-published targets in
+                # `restored` stay — they committed)
+                with self.cluster._indices_lock:
+                    self.cluster.dist_indices.pop(target, None)
+                    self.cluster._persist_dist_meta()
+                raise
             restored.append(target)
         from elasticsearch_tpu.index.snapshots import apply_global_state
 
@@ -685,6 +773,10 @@ class DistributedDataService:
 
     def index_doc(self, index: str, doc_id: Optional[str], source: dict,
                   routing: Optional[str] = None, **kw) -> dict:
+        # NO_MASTER write block: a headless (minority / stepped-down)
+        # node must fail writes typed 503, never route them into a state
+        # the quorum's master will not have (searches stay unblocked)
+        self.cluster.ensure_not_blocked("write")
         index = self.resolve_index(index)
         meta = self._meta(index)
         if doc_id is None:
@@ -738,6 +830,8 @@ class DistributedDataService:
         TransportShardReplicationOperationAction primary → replicas hop).
         The per-shard lock makes apply+fanout atomic so two client
         threads' fanouts cannot reach a replica out of version order."""
+        # also fences writes FORWARDED to a headless node on stale routing
+        self.cluster.ensure_not_blocked("write")
         rerouted = self._ensure_primary(
             op, index, sid,
             {"index": index, "id": doc_id, "source": source,
@@ -844,7 +938,15 @@ class DistributedDataService:
                 directive = {"index": index, "shard": sid,
                              "target": node_id, "source": owners[0],
                              "body": meta["body"]}
-        self.cluster.publish_indices()
+        try:
+            self.cluster.publish_indices()
+        except FailedToCommitClusterStateException:
+            # the master just lost publish quorum and stepped down; the
+            # in-sync shrink is conservative (it only REMOVES a failed
+            # copy) and the quorum's master redoes allocation — the
+            # REPORTER must not receive a publish error for a failure
+            # report it delivered successfully
+            return {"ok": False}
         if directive:
             self.start_recoveries([directive])
         return {"ok": True}
@@ -872,6 +974,7 @@ class DistributedDataService:
 
     def delete_doc(self, index: str, doc_id: str,
                    routing: Optional[str] = None, **kw) -> dict:
+        self.cluster.ensure_not_blocked("write")
         index = self.resolve_index(index)
         meta = self._meta(index)
         sid = shard_id_for(doc_id, meta["num_shards"], routing)
@@ -889,6 +992,7 @@ class DistributedDataService:
         must read the current source there), which then fans the resulting
         full doc out through the normal replica hop (reference:
         TransportUpdateAction resolving to an index op on the primary)."""
+        self.cluster.ensure_not_blocked("write")
         index = self.resolve_index(index)
         meta = self._meta(index)
         sid = shard_id_for(doc_id, meta["num_shards"], routing)
@@ -903,6 +1007,7 @@ class DistributedDataService:
     def _primary_update(self, index: str, sid: int, doc_id: str,
                         body: dict, routing: Optional[str],
                         kw: dict, forwarded: bool = False) -> dict:
+        self.cluster.ensure_not_blocked("write")
         rerouted = self._ensure_primary(
             "update", index, sid,
             {"index": index, "id": doc_id, "body": body,
@@ -1003,6 +1108,7 @@ class DistributedDataService:
         cancellation mid-fanout returns the PARTIAL counts applied so
         far with a ``"canceled"`` reason, the reference's
         BulkByScrollResponse shape."""
+        self.cluster.ensure_not_blocked("write")
         index = self.resolve_index(index)
         meta = self._meta(index)
         self.refresh(index)
@@ -1454,11 +1560,16 @@ class DistributedDataService:
                     meta2.setdefault("in_sync", {})[str(sid)] = [best_nid]
                     changed = True
         if changed:
-            self.cluster.publish_indices()
-            # replicas top back up from the resurrected primaries
-            directives, changed2 = self.reconcile()
-            if changed2:
+            try:
                 self.cluster.publish_indices()
+                # replicas top back up from the resurrected primaries
+                directives, changed2 = self.reconcile()
+                if changed2:
+                    self.cluster.publish_indices()
+            except FailedToCommitClusterStateException:
+                # background thread on a master that just lost quorum:
+                # it stepped down; the quorum's master redoes allocation
+                return
             self.start_recoveries(directives)
 
     def start_recoveries(self, directives: List[dict]) -> None:
@@ -1534,7 +1645,12 @@ class DistributedDataService:
                         insync.append(d["target"])
                     promoted = True
         if promoted:
-            self.cluster.publish_indices()
+            try:
+                self.cluster.publish_indices()
+            except FailedToCommitClusterStateException:
+                # recovery thread on a master that just lost quorum: the
+                # graduation stays local; the quorum's master republishes
+                pass
 
     def _on_recover(self, payload: dict) -> dict:
         """Recovery target: checkpoint handshake with the source copy,

@@ -23,6 +23,18 @@ class DiscoveryNode:
     attributes: dict = field(default_factory=dict)
 
 
+#: ES's NO_MASTER_BLOCK at write level (reference: DiscoverySettings
+#: .NO_MASTER_BLOCK_WRITES / NoMasterBlockService): with no elected
+#: master, metadata changes and document writes fail typed 503 while
+#: searches keep serving the last committed state.
+NO_MASTER_BLOCK = {
+    "id": 2,
+    "description": "no master",
+    "retryable": True,
+    "levels": ["write", "metadata_write"],
+}
+
+
 @dataclass
 class ShardRouting:
     index: str
@@ -48,6 +60,12 @@ class ClusterState:
     def __init__(self, cluster_name: str = "elasticsearch_tpu"):
         self.cluster_name = cluster_name
         self.version = 0
+        # master ERA, bumped by every quorum election (reference: the
+        # coordination-era ClusterState.term beside version): publications
+        # from an older term are stale and rejected; (term, version)
+        # lexicographically orders states across master changes the way
+        # version alone orders them within one master's reign
+        self.term = 0
         self.state_uuid = uuid.uuid4().hex
         self.nodes: Dict[str, DiscoveryNode] = {}
         self.master_node_id: Optional[str] = None
@@ -59,6 +77,25 @@ class ClusterState:
     def next_version(self):
         self.version += 1
         self.state_uuid = uuid.uuid4().hex
+
+    # -- global blocks -------------------------------------------------------
+
+    def add_global_block(self, block: dict) -> None:
+        blocks = self.blocks.setdefault("global", [])
+        if all(b.get("id") != block.get("id") for b in blocks):
+            blocks.append(dict(block))
+
+    def clear_global_block(self, block_id: int) -> None:
+        blocks = self.blocks.get("global")
+        if blocks:
+            blocks[:] = [b for b in blocks if b.get("id") != block_id]
+
+    def global_block(self, level: str) -> Optional[dict]:
+        """The first global block covering ``level``, or None."""
+        for b in self.blocks.get("global", []):
+            if level in b.get("levels", []):
+                return b
+        return None
 
     def add_node(self, node: DiscoveryNode, master: bool = False):
         self.nodes[node.node_id] = node
@@ -103,8 +140,10 @@ class ClusterState:
         return {
             "cluster_name": self.cluster_name,
             "version": self.version,
+            "term": self.term,
             "state_uuid": self.state_uuid,
             "master_node": self.master_node_id,
+            "blocks": {k: list(v) for k, v in self.blocks.items() if v},
             "nodes": {
                 nid: {"name": n.name, "transport_address": n.transport_address,
                       "roles": list(n.roles)}

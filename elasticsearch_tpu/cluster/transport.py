@@ -326,7 +326,12 @@ class TransportService:
         try:
             # the injected fault rides the same wrapping as a real
             # connect failure: an OSError here becomes a typed
-            # ConnectTransportError either way
+            # ConnectTransportError either way. discovery.partition is
+            # the LINK-level form: ctx carries the local node id beside
+            # the target address so a test can drop exactly the
+            # minority<->majority links, in both directions
+            FAULTS.check("discovery.partition", action=action,
+                         address=address, local=self.local_node_id)
             FAULTS.check("transport.send", action=action, address=address)
             sock = socket.create_connection(address, timeout=timeout)
         except socket.timeout as e:

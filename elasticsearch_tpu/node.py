@@ -387,6 +387,11 @@ class Node:
 
     def update_aliases(self, actions: List[dict]) -> dict:
         mh = getattr(self, "multihost", None)
+        if mh is not None:
+            # alias changes are metadata: a headless node must fail them
+            # typed 503 up front, not apply-and-ack a change the quorum's
+            # master will overwrite on the next adopt
+            mh.ensure_not_blocked("metadata_write")
         if mh is not None and not mh.is_master:
             # alias changes touching distributed indices are cluster state:
             # the master owns them (they ride the published metadata, so a
@@ -445,10 +450,28 @@ class Node:
             dist_touched = [n for n in touched if n in mh.dist_indices]
             if dist_touched:
                 with mh._indices_lock:
+                    prior = {n: dict(mh.dist_indices[n].get("aliases")
+                                     or {}) for n in dist_touched}
                     for n in dist_touched:
                         mh.dist_indices[n]["aliases"] = dict(
                             self.indices[n].aliases)
-                mh.publish_indices()
+                try:
+                    mh.publish_indices()
+                except Exception:
+                    # not committed: restore BOTH halves (published map +
+                    # local alias state) so this node doesn't diverge
+                    # from what the quorum's master republishes, then
+                    # fail the client typed
+                    with mh._indices_lock:
+                        for n, aliases in prior.items():
+                            if n in mh.dist_indices:
+                                mh.dist_indices[n]["aliases"] = \
+                                    dict(aliases)
+                            if n in self.indices:
+                                self.indices[n].aliases = dict(aliases)
+                                self._persist_index_meta(n)
+                        mh._persist_dist_meta()
+                    raise
         return {"acknowledged": True}
 
     def put_template(self, name: str, body: dict,
@@ -549,6 +572,28 @@ class Node:
                 # distributed stay local-scoped. Pass the RESOLVED name so
                 # the data plane doesn't re-resolve.
                 return mh.data.search(rname, body or {})
+        if mh is not None and index in (None, "", "_all", "*"):
+            # the all-indices spelling must ride the dist plane too: the
+            # local-scoped fallback silently under-reports acked docs on
+            # any member whose local copy of a shard is empty (a bare
+            # GET /_search on a non-owner saw only its own shards)
+            open_names = [nm for nm in self.resolve_indices(index)
+                          if not self.indices[nm].closed]
+            dist = [nm for nm in open_names if nm in mh.dist_indices]
+            if len(dist) == 1 and len(open_names) == 1:
+                return mh.data.search(dist[0], body or {})
+            if dist:
+                # multiple distributed indices, or distributed mixed
+                # with local-only: a loud typed refusal beats the old
+                # silently-local-scoped (under-reporting) answer
+                from elasticsearch_tpu.utils.errors import \
+                    IllegalArgumentException
+
+                raise IllegalArgumentException(
+                    "all-indices search over multiple (or mixed "
+                    "local/distributed) indices is not supported in "
+                    "coordinator mode; name one index (distributed "
+                    f"here: {sorted(dist)})")
         names = self.resolve_indices(index)
         if not names and index not in (None, "", "_all", "*"):
             raise IndexNotFoundException(str(index))

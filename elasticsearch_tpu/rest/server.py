@@ -274,8 +274,7 @@ def _register_all(rc: RestController):
     add("GET", "/_cat/templates", lambda n, p, b: (200, [
         {"name": k, "index_patterns": v.get("index_patterns", [v.get("template", "")])}
         for k, v in n.cluster_state.templates.items()]))
-    add("GET", "/_cat/master", lambda n, p, b: (200, [{
-        "id": n.cluster_state.master_node_id, "node": n.name}]))
+    add("GET", "/_cat/master", _cat_master)
     add("GET", "/_cat/aliases", _cat_aliases)
     add("GET", "/_cat/allocation", _cat_allocation)
     add("GET", "/_cat/segments", _cat_segments)
@@ -1313,6 +1312,22 @@ def _cat_health(n: Node, p, b):
         "unassign": "0",
         "pending_tasks": str(len(_all_pending_tasks(n, p))),
     }]
+
+
+def _cat_master(n: Node, p, b):
+    """RestMasterAction: the ELECTED master's own row — id, transport
+    host, name — resolved from the cluster state's node map (the master
+    is usually NOT the node serving this request in a multi-host world).
+    A headless node answers the ES no-master shape (``-`` columns) with
+    200: cat output keeps working under the NO_MASTER block."""
+    st = n.cluster_state
+    m = st.nodes.get(st.master_node_id) if st.master_node_id else None
+    if m is None:
+        return 200, [{"id": "-", "host": "-", "ip": "-", "node": "-"}]
+    host = (m.transport_address.rsplit(":", 1)[0]
+            if ":" in m.transport_address else "local")
+    return 200, [{"id": m.node_id, "host": host, "ip": host,
+                  "node": m.name or m.node_id}]
 
 
 def _peer_shard_counts(n: Node, c) -> Dict[str, Dict[tuple, tuple]]:
@@ -3605,8 +3620,22 @@ def _cluster_put_settings(n: Node, p, b):
 def _cluster_health(n: Node, p, b):
     """RestClusterHealthAction: the health summary + pending-task gauges;
     level=indices adds per-index sections (our single-node health is
-    uniform, so each index reports its own shard counts)."""
-    h = dict(n.cluster_state.health())
+    uniform, so each index reports its own shard counts). The
+    coordination fields ride every response: the master's id, the
+    cluster TERM it was elected under, and whether the NO_MASTER write
+    block is in force (a headless node keeps answering health — that is
+    the point of serving reads under the block)."""
+    state = n.cluster_state
+    h = dict(state.health())
+    h["master_node"] = state.master_node_id
+    h["term"] = getattr(state, "term", 0)
+    no_master = state.master_node_id is None \
+        or state.global_block("write") is not None
+    h["no_master_block"] = bool(no_master)
+    if no_master:
+        h["status"] = "red"  # an unquorate node cannot vouch for shards
+        h["cluster_blocks"] = [
+            dict(blk) for blk in state.blocks.get("global", [])]
     h["number_of_pending_tasks"] = len(_all_pending_tasks(n, p))
     h.setdefault("number_of_in_flight_fetch", 0)
     h.setdefault("delayed_unassigned_shards", 0)
@@ -3689,8 +3718,14 @@ def _cluster_state_metric(n: Node, p, b, metric: str,
     full = copy.deepcopy(n.cluster_state.to_json())
     # blocks built live from index state/settings (reference:
     # ClusterBlocks — ids: 4 = INDEX_CLOSED_BLOCK, 5 = INDEX_READ_ONLY,
-    # 7 = INDEX_READ, 8 = INDEX_WRITE)
+    # 7 = INDEX_READ, 8 = INDEX_WRITE) plus any global blocks the
+    # coordination layer set (2 = NO_MASTER_BLOCK, ES dict-keyed shape)
     blocks: Dict[str, Any] = {}
+    for gb in n.cluster_state.blocks.get("global", []):
+        blocks.setdefault("global", {})[str(gb.get("id"))] = {
+            "description": gb.get("description", ""),
+            "retryable": bool(gb.get("retryable")),
+            "levels": list(gb.get("levels", []))}
     _BLOCKS = (("read_only", "5", "index read-only (api)",
                 ["write", "metadata_write"]),
                ("read", "7", "index read (api)", ["read"]),

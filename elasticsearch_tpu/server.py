@@ -25,6 +25,9 @@ def main(argv=None):
     ap.add_argument("--transport-port", type=int, default=9300,
                     help="TCP control-plane port (rank 0 binds it; other "
                          "ranks dial the coordinator host on it)")
+    ap.add_argument("--minimum-master-nodes", type=int, default=None,
+                    help="election/publish quorum; default: majority of "
+                         "the master-eligible voting configuration")
     args = ap.parse_args(argv)
 
     from elasticsearch_tpu.utils.platform import (enable_compilation_cache,
@@ -50,7 +53,8 @@ def main(argv=None):
         cluster = MultiHostCluster(
             node, args.process_id, args.num_processes,
             bind_host=args.host, transport_port=args.transport_port,
-            master_host=args.coordinator.split(":")[0])
+            master_host=args.coordinator.split(":")[0],
+            minimum_master_nodes=args.minimum_master_nodes)
         role = "master" if cluster.is_master else "data"
         print(f"[{args.name}] joined cluster as {role} "
               f"(rank {args.process_id}/{args.num_processes})", flush=True)

@@ -173,6 +173,55 @@ class StalePrimaryException(ElasticsearchTpuException):
         self.current_term = current_term
 
 
+class ClusterBlockException(ElasticsearchTpuException):
+    """Reference: cluster/block/ClusterBlockException.java — the op hit a
+    cluster-level block. The one mattering here is the NO_MASTER_BLOCK
+    (write level): with no elected master, metadata changes and writes are
+    rejected 503 while searches keep serving the last committed state —
+    an unquorate minority must fail loudly, never ack into a state the
+    majority will not have."""
+
+    status = 503
+
+    def __init__(self, blocks):
+        self.blocks = list(blocks)
+        desc = ", ".join(
+            f"[SERVICE_UNAVAILABLE/{b.get('id', '?')}/"
+            f"{b.get('description', '')}]" for b in self.blocks)
+        super().__init__(f"blocked by: {desc};")
+
+
+class StaleMasterException(ElasticsearchTpuException):
+    """A cluster-state publication carried a term older than this node's
+    current term: the publisher lost an election it doesn't know about
+    yet (partitioned old master). Rejecting with a typed 409 mirrors the
+    data plane's StalePrimaryException fence — a superseded master can
+    never commit a state the quorum's real master will not have.
+    Reference: the coordination-era PublicationTransportHandler rejecting
+    publish requests below the current term."""
+
+    status = 409
+
+    def __init__(self, publisher: str, publish_term: int,
+                 current_term: int):
+        super().__init__(
+            f"publication from [{publisher}] with term [{publish_term}] "
+            f"is stale, current term is [{current_term}]")
+        self.publisher = publisher
+        self.publish_term = publish_term
+        self.current_term = current_term
+
+
+class FailedToCommitClusterStateException(ElasticsearchTpuException):
+    """Reference: cluster/coordination FailedToCommitClusterStateException
+    — the master could not gather a quorum of publish acks, so the state
+    change was NOT committed and the master steps down rather than
+    split-braining. The driving metadata op fails typed instead of
+    acking a change the majority never saw."""
+
+    status = 503
+
+
 class CircuitBreakingException(ElasticsearchTpuException):
     """Reference: org/elasticsearch/common/breaker/CircuitBreaker.java —
     a memory budget would be exceeded; the REQUEST fails (429-style), the

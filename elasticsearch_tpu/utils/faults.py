@@ -30,6 +30,17 @@ Registered injection points (see docs/ROBUSTNESS.md for the catalogue):
                           search_action.py::_primary_write)
     resources.reserve     before a residency breaker reservation (device
                           memory admission — resources/residency.py)
+    discovery.vote        before a vote-request handler grants/denies a
+                          ballot (cluster/bootstrap.py::_on_request_vote)
+    publish.commit        between publish phase 1 (quorum ack gathering)
+                          and the commit fan-out — a master dying in the
+                          window leaves followers holding an uncommitted
+                          pending state they must never apply
+    discovery.partition   link-level drop: checked on every client
+                          transport connect with the LOCAL node id in
+                          ctx, so a test can drop exactly the
+                          minority<->majority links in both directions
+                          (cluster/transport.py::_send_remote_timed)
 """
 from __future__ import annotations
 
@@ -49,6 +60,9 @@ POINTS = frozenset({
     "recovery.ops_replay",
     "replication.fanout",
     "resources.reserve",
+    "discovery.vote",
+    "publish.commit",
+    "discovery.partition",
 })
 
 

@@ -25,7 +25,8 @@ from elasticsearch_tpu.node import Node
 
 node = Node(name={name!r}, data_path={data_path!r})
 c = MultiHostCluster(node, rank={rank}, world={world}, transport_port={port},
-                     master_host="127.0.0.1", ping_interval=0)
+                     master_host="127.0.0.1", ping_interval=0,
+                     minimum_master_nodes=1)
 ids = sorted(node.cluster_state.nodes)
 assert len(ids) == {expect}, ids
 assert node.cluster_state.master_node_id == ids[0], (

@@ -41,7 +41,8 @@ def _wait(predicate, timeout=10.0, step=0.05):
 def master():
     node = Node(name="rank0")
     c = MultiHostCluster(node, rank=0, world=2, transport_port=_free_port(),
-                         ping_interval=0.2, ping_retries=2)
+                         ping_interval=0.2, ping_retries=2,
+                         minimum_master_nodes=1)
     yield node, c
     c.close()
     node.close()
@@ -925,7 +926,7 @@ def test_master_restart_recovers_dist_metadata(tmp_path):
     dp = str(tmp_path / "master")
     node = Node(name="m1", data_path=dp)
     c = MultiHostCluster(node, rank=0, world=2, transport_port=_free_port(),
-                         ping_interval=0)
+                         ping_interval=0, minimum_master_nodes=1)
     try:
         c.data.create_index("dur", {
             "settings": {"number_of_shards": 2, "number_of_replicas": 1},
@@ -939,7 +940,8 @@ def test_master_restart_recovers_dist_metadata(tmp_path):
 
     node2 = Node(name="m1b", data_path=dp)
     c2 = MultiHostCluster(node2, rank=0, world=2,
-                          transport_port=_free_port(), ping_interval=0)
+                          transport_port=_free_port(), ping_interval=0,
+                          minimum_master_nodes=1)
     p = None
     try:
         assert "dur" in c2.dist_indices

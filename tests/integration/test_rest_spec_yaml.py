@@ -407,7 +407,8 @@ def server():
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
         cluster = MultiHostCluster(node, rank=0, world=2,
-                                   transport_port=port, ping_interval=0)
+                                   transport_port=port, ping_interval=0,
+                                   minimum_master_nodes=1)
         rank1 = spawn_member(port, name="yaml-rank1")
     srv = RestServer(node, host="127.0.0.1", port=0)
     srv.start(background=True)

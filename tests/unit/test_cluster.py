@@ -1,9 +1,18 @@
 """Cluster subsystem tests: replication, allocation, discovery, transport,
 metadata (reference: action/support/replication, routing/allocation,
-discovery/zen, transport, cluster/metadata)."""
+discovery/zen, transport, cluster/metadata), and the coordination layer
+(term-based quorum election, two-phase publish, no-master blocks)."""
+import socket
+
 import pytest
 
-from elasticsearch_tpu.cluster.discovery import FaultDetector, ZenDiscovery
+from elasticsearch_tpu.cluster.discovery import (
+    FaultDetector,
+    MasterFaultDetection,
+    VoteCollector,
+    ZenDiscovery,
+    election_candidate,
+)
 from elasticsearch_tpu.cluster.metadata import (
     IndexClosedException,
     close_index,
@@ -167,6 +176,651 @@ def test_fault_detector_requires_consecutive_failures():
     fd.check([state.nodes["ccc"]])
     alive["ccc"] = False
     assert fd.check([state.nodes["ccc"]]) == []  # count restarted
+
+
+def test_fault_detector_prunes_counts_for_departed_nodes():
+    """Regression: a node that left mid-strike must NOT inherit its old
+    strikes on rejoin — pruning happens against the passed node list."""
+    alive = {"bbb": False}
+    failed_log = []
+    fd = FaultDetector(lambda n: alive.get(n.node_id, True),
+                       failed_log.append, ping_retries=3)
+    b = DiscoveryNode("bbb", "two")
+    fd.check([b])
+    fd.check([b])  # two strikes banked
+    assert fd._fail_counts["bbb"] == 2
+    # the node leaves the membership view: a round without it prunes
+    fd.check([])
+    assert "bbb" not in fd._fail_counts
+    # rejoining under the same id starts from zero — one failure is NOT
+    # a third consecutive strike
+    assert fd.check([b]) == []
+    assert failed_log == []
+    assert fd.check([b]) == []
+    assert fd.check([b]) == [b]  # three FRESH strikes still work
+
+
+def test_master_fault_detection_fires_after_retries_and_prunes():
+    alive = {"m1": False}
+    fired = []
+    mfd = MasterFaultDetection(lambda n: alive.get(n.node_id, True),
+                               fired.append, ping_retries=2)
+    m1 = DiscoveryNode("m1", "old-master")
+    assert not mfd.check(m1)
+    assert mfd.check(m1)  # second consecutive failure fires
+    assert [n.node_id for n in fired] == ["m1"]
+    # a NEW master prunes the old incumbent's strikes
+    alive["m2"] = False
+    m2 = DiscoveryNode("m2", "new-master")
+    assert not mfd.check(m2)
+    assert mfd.check(None) is False  # headless round is a no-op
+
+
+# -- coordination units --------------------------------------------------------
+
+
+def test_vote_collector_one_vote_per_term():
+    v = VoteCollector()
+    assert v.grant(2, "aaa", current_term=1)
+    assert not v.grant(2, "bbb", current_term=1)  # never switches
+    assert v.grant(2, "aaa", current_term=1)      # idempotent re-ask
+    assert v.voted_in(2) == "aaa"
+    # a term at or below the highest committed one is a stale candidacy
+    assert not v.grant(2, "ccc", current_term=2)
+    assert not v.grant(1, "ccc", current_term=2)
+    assert v.grant(3, "bbb", current_term=2)
+
+
+def test_election_candidate_lowest_id_tiebreak():
+    nodes = [DiscoveryNode("0002-x", "c"), DiscoveryNode("0001-y", "b")]
+    assert election_candidate(nodes).node_id == "0001-y"
+    nodes.append(DiscoveryNode("0000-z", "a", roles=("data",)))
+    # a data-only node never runs an election
+    assert election_candidate(nodes).node_id == "0001-y"
+    assert election_candidate([]) is None
+
+
+def test_vote_master_mode_keeps_elected_incumbent():
+    """vote_master=True: membership changes never recompute mastership —
+    a lower-id joiner must not steal the elected incumbent's seat (only
+    a publication or an election moves it)."""
+    state = ClusterState()
+    zen = ZenDiscovery(state, DiscoveryNode("0001-b", "b"),
+                       vote_master=True)
+    state.master_node_id = "0001-b"  # elected (bootstrap/election path)
+    zen.join(DiscoveryNode("0000-a", "a"))
+    assert state.master_node_id == "0001-b"  # incumbent keeps the seat
+    # ...but a master that LEFT the view is cleared, not kept as phantom
+    state.master_node_id = "0000-a"
+    zen.leave("0000-a")
+    assert state.master_node_id is None
+
+
+# -- coordination over real clusters ------------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def quorum_pair():
+    """Two MultiHostClusters with the DEFAULT quorum (majority of the
+    voting configuration = 2 of 2): neither side may act alone."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.utils.faults import FAULTS
+
+    port = _free_port()
+    node0 = Node(name="rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    yield c0, c1
+    FAULTS.clear()
+    try:
+        c1.close()
+    finally:
+        c0.close()
+        node1.close()
+        node0.close()
+
+
+def test_stale_term_publish_rejected_typed_409(quorum_pair):
+    from elasticsearch_tpu.utils.errors import StaleMasterException
+
+    c0, c1 = quorum_pair
+    assert c1.node.cluster_state.term == 1
+    with pytest.raises(StaleMasterException) as ei:
+        c1._on_publish({"term": 0, "master": "ghost", "version": 99,
+                        "nodes": []})
+    assert ei.value.status == 409
+    assert ei.value.error_type == "stale_master_exception"
+    # nothing parked, nothing applied
+    assert c1._pending_publish is None
+    assert c1.node.cluster_state.term == 1
+
+
+def test_followers_apply_only_committed_states(quorum_pair):
+    """publish.commit fault = the master dying between phases: followers
+    hold the parked phase-1 state and never apply it; the next committed
+    publish supersedes and catches them up."""
+    from elasticsearch_tpu.utils.faults import FAULTS
+
+    c0, c1 = quorum_pair
+    FAULTS.inject("publish.commit", error=OSError, count=1)
+    c0.data.create_index("pend", {"settings": {"number_of_shards": 1}})
+    assert "pend" in c0.dist_indices          # committed on the master
+    assert "pend" not in c1.dist_indices      # ...but parked on the peer
+    assert c1._pending_publish is not None
+    committed_before = c1.committed
+    # the next publish (committed end-to-end) supersedes the parked one
+    c0.data.create_index("live", {"settings": {"number_of_shards": 1}})
+    assert set(c1.dist_indices) >= {"pend", "live"}
+    assert c1.committed > committed_before
+
+
+def test_master_steps_down_on_lost_follower_quorum(quorum_pair):
+    """2 of 2 quorum: the master losing its only peer must stop taking
+    writes (step down + NO_MASTER block) instead of serving a minority;
+    searches keep answering from the last committed state."""
+    from elasticsearch_tpu.rest.server import RestController
+    from elasticsearch_tpu.utils.errors import ClusterBlockException
+
+    c0, c1 = quorum_pair
+    c0.data.create_index("q", {"settings": {"number_of_shards": 1}})
+    c0.data.index_doc("q", "1", {"v": 1})
+    c0.data.refresh("q")
+    c1.transport.close()  # peer vanishes
+    for _ in range(c0._ping_retries):
+        c0.run_fd_round()
+    assert not c0.is_master
+    assert c0.node.cluster_state.master_node_id is None
+    with pytest.raises(ClusterBlockException) as ei:
+        c0.data.index_doc("q", "2", {"v": 2})
+    assert ei.value.status == 503
+    assert ei.value.error_type == "cluster_block_exception"
+    # reads still serve the last committed state
+    r = c0.data.search("q", {"size": 10})
+    assert r["hits"]["total"] == 1
+    # and health/cat surface the headless state without erroring
+    status, h = RestController(c0.node).dispatch(
+        "GET", "/_cluster/health", {}, b"")
+    assert status == 200
+    assert h["no_master_block"] is True and h["master_node"] is None
+    status, rows = RestController(c0.node).dispatch(
+        "GET", "/_cat/master", {}, b"")
+    assert status == 200 and rows[0]["id"] == "-"
+    # the resignation was counted in the discovery metric family
+    counters = c0.node.metrics.counter_values()
+    assert counters.get("estpu_discovery_master_stepdowns_total", 0) >= 1
+
+
+def test_survivor_without_quorum_stays_headless(quorum_pair):
+    """no quorum -> no master: the surviving non-master of a 2-node
+    cluster can never elect itself (1 < 2 votes) — it goes and STAYS
+    headless, failing writes typed while the election keeps losing."""
+    from elasticsearch_tpu.utils.errors import ClusterBlockException
+
+    c0, c1 = quorum_pair
+    c0.data.create_index("h", {"settings": {"number_of_shards": 1}})
+    c0.transport.close()  # the master vanishes
+    for _ in range(c1._ping_retries + 1):
+        c1.run_fd_round()
+    assert not c1.is_master
+    assert c1.node.cluster_state.master_node_id is None
+    with pytest.raises(ClusterBlockException):
+        c1.data.index_doc("h", "1", {"v": 1})
+    # the lost election was counted
+    counters = c1.node.metrics.counter_values()
+    assert counters.get(
+        'estpu_discovery_elections_total{outcome="lost"}', 0) >= 1
+
+
+def test_bare_search_all_rides_dist_plane(quorum_pair):
+    """GET /_search (no index) on a member must scatter cross-host like
+    the named form: the local-scoped fallback silently under-reported
+    acked docs from shards whose local copy was empty (found by the
+    3-process verify drive — a 2-shard index returned only the shards
+    the queried node owned)."""
+    c0, c1 = quorum_pair
+    c0.data.create_index("all1", {"settings": {"number_of_shards": 2,
+                                               "number_of_replicas": 0}})
+    for i in range(8):
+        c0.data.index_doc("all1", str(i), {"title": f"fox {i}"})
+    c0.data.refresh("all1")
+    for c in (c0, c1):
+        r = c.node.search(None, {"query": {"match_all": {}}, "size": 20})
+        assert r["hits"]["total"] == 8, (c.local.node_id, r["hits"])
+        r = c.node.search("_all", {"query": {"match_all": {}}})
+        assert r["hits"]["total"] == 8
+
+
+def test_granted_ballot_fences_old_master_publish(quorum_pair):
+    """Granting a vote for term T promises to reject publications below
+    T (Raft's currentTerm bump on vote): a deposed master partitioned
+    only from the candidate must not gather a quorum of acks at its old
+    term from the very voters that just elected its successor."""
+    from elasticsearch_tpu.utils.errors import \
+        FailedToCommitClusterStateException
+
+    c0, c1 = quorum_pair
+    assert c1._on_request_vote(
+        {"term": 2, "candidate": "9999-cand"})["granted"]
+    assert c1._votes.highest_granted() == 2
+    # the old master's next term-1 publish is rejected by its own
+    # follower -> superseded -> steps down without committing
+    with pytest.raises(FailedToCommitClusterStateException):
+        c0.data.create_index("doomed", {"settings": {"number_of_shards": 1}})
+    assert not c0.is_master
+    assert "doomed" not in c1.dist_indices
+
+
+def test_voting_config_keyed_by_rank_not_node_id(quorum_pair):
+    """Restarts mint fresh node ids; the grow-only voting configuration
+    keys by RANK so a few bounces cannot inflate the quorum past the
+    live node count and brick the cluster headless."""
+    c0, _ = quorum_pair
+    assert c0.quorum() == 2  # majority of ranks {0000, 0001}
+    for fresh in ("0001-aaaa", "0001-bbbb", "0001-cccc"):
+        c0._note_peer(fresh, "127.0.0.1:1")
+    assert len(c0._voting_config) == 2
+    assert c0.quorum() == 2
+
+
+def test_create_rollback_repersists_dist_meta(tmp_path):
+    """A create whose publish failed to commit must not survive on disk:
+    without the rollback re-persist, a master restart would resurrect an
+    index the client was told (503) never committed."""
+    import json
+
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.utils.errors import \
+        FailedToCommitClusterStateException
+
+    port = _free_port()
+    node0 = Node(name="rank0", data_path=str(tmp_path / "d0"))
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    try:
+        c0.data.create_index("kept", {"settings": {"number_of_shards": 1}})
+        c1.transport.close()  # no peer -> no publish quorum
+        with pytest.raises(FailedToCommitClusterStateException):
+            c0.data.create_index("ghost",
+                                 {"settings": {"number_of_shards": 1}})
+        assert "ghost" not in c0.dist_indices
+        with open(tmp_path / "d0" / "_cluster" / "dist_indices.json") as f:
+            on_disk = json.load(f)["indices"]
+        assert "kept" in on_disk and "ghost" not in on_disk
+    finally:
+        c1.close()
+        c0.close()
+        node1.close()
+        node0.close()
+
+
+def test_takeover_adopts_fetched_meta_despite_parked_term(quorum_pair):
+    """elected=True bypasses the cluster-term fence: a candidate whose
+    state.term was raised by a parked-but-uncommitted phase-1 publication
+    must still adopt the committed copy its election chose as freshest."""
+    c0, _ = quorum_pair
+    c0.node.cluster_state.term = 5  # a parked phase-1 raised the term
+    meta = {"won": {"body": {"settings": {"number_of_shards": 1}},
+                    "num_shards": 1, "assignment": {"0": []},
+                    "in_sync": {}, "primary_terms": {}}}
+    c0._adopt_indices({"lost": dict(meta["won"])}, version=11, term=4)
+    assert "lost" not in c0.dist_indices  # the stale-commit fence holds
+    c0._adopt_indices(meta, version=12, term=4, elected=True)
+    assert "won" in c0.dist_indices       # ...but the election's pick lands
+
+
+def test_join_with_fresher_disk_meta_recovers_layout(tmp_path):
+    """Whole-cluster restart where only a NON-rank-0 disk survived: the
+    joiner advertises its persisted (term, version) key and the fresh
+    master adopts the copy instead of wiping it (persistence on every
+    rank must not be write-only)."""
+    import json
+    import os
+
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    d1 = tmp_path / "d1"
+    os.makedirs(d1 / "_cluster")
+    blob = {"local": "0001-old", "term": 3, "indices_version": 7,
+            "indices": {"survivor": {
+                "body": {"settings": {"number_of_shards": 1,
+                                      "number_of_replicas": 0}},
+                "num_shards": 1, "assignment": {"0": ["0001-old"]},
+                "in_sync": {"0": ["0001-old"]},
+                "primary_terms": {"0": 2}}}}
+    with open(d1 / "_cluster" / "dist_indices.json", "w") as f:
+        json.dump(blob, f)
+    port = _free_port()
+    node0 = Node(name="rank0", data_path=str(tmp_path / "d0"))
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1", data_path=str(d1))
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    try:
+        assert "survivor" in c0.dist_indices
+        assert c0._meta_term == 3
+        assert c0.node.index_exists("survivor")
+        # the recovered copy remapped to the joiner's NEW id
+        owners = c0.dist_indices["survivor"]["assignment"]["0"]
+        assert owners == [c1.local.node_id]
+    finally:
+        c1.close()
+        c0.close()
+        node1.close()
+        node0.close()
+
+
+def test_restarted_seed_does_not_self_appoint_against_live_cluster(tmp_path):
+    """A restarted rank 0 whose disk remembers a multi-node era must NOT
+    bootstrap as a one-seat master (split-brain: its in-memory quorum
+    would be 1 while the real quorum is a majority of the remembered
+    seats) — it starts headless and rejoins the live cluster through a
+    persisted peer address."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    port = _free_port()
+    node0 = Node(name="rank0", data_path=str(tmp_path / "d0"))
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    c0b = None
+    node0b = None
+    try:
+        c0.data.create_index("live", {"settings": {"number_of_shards": 1}})
+        # "restart" rank 0: a new process on the SAME disk, fresh port
+        node0b = Node(name="rank0b", data_path=str(tmp_path / "d0"))
+        c0b = MultiHostCluster(node0b, rank=0, world=2,
+                               transport_port=_free_port(),
+                               ping_interval=0)
+        assert not c0b.is_master  # never self-appointed
+        # the boot-time scan found the live master via persisted peers
+        assert c0b.node.cluster_state.master_node_id == c0.local.node_id
+        assert c0.is_master  # the live cluster was never disturbed
+    finally:
+        for c in (c0b, c1):
+            if c is not None:
+                c.close()
+        c0.close()
+        for n in (node0b, node1, node0):
+            if n is not None:
+                n.close()
+
+
+def test_whole_cluster_restart_elects_on_first_join(tmp_path):
+    """Full restart: the headless restarted seed runs a quorum election
+    when the first joiner arrives (zen: joins trigger elections) instead
+    of either self-appointing below quorum or deadlocking headless."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    port = _free_port()
+    node0 = Node(name="rank0", data_path=str(tmp_path / "d0"))
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1", data_path=str(tmp_path / "d1"))
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    c0.data.create_index("surv", {"settings": {"number_of_shards": 1,
+                                               "number_of_replicas": 1}})
+    c1.close()
+    c0.close()
+    node1.close()
+    node0.close()
+
+    port2 = _free_port()
+    node0b = Node(name="rank0b", data_path=str(tmp_path / "d0"))
+    c0b = MultiHostCluster(node0b, rank=0, world=2, transport_port=port2,
+                           ping_interval=0)
+    assert not c0b.is_master  # two remembered seats: no lone bootstrap
+    node1b = Node(name="rank1b", data_path=str(tmp_path / "d1"))
+    c1b = MultiHostCluster(node1b, rank=1, world=2, transport_port=port2,
+                           ping_interval=0)
+    try:
+        # the join triggered the election: rank 0 won a real quorum
+        assert c0b.is_master
+        assert c1b.node.cluster_state.master_node_id == c0b.local.node_id
+        assert c0b.node.cluster_state.term >= 1
+        assert "surv" in c0b.dist_indices  # layout recovered from disk
+    finally:
+        c1b.close()
+        c0b.close()
+        node1b.close()
+        node0b.close()
+
+
+def test_restarted_member_rejoins_after_mastership_moved(tmp_path):
+    """A restarting member whose seed (rank 0) is dead must still rejoin:
+    the constructor's join loop falls back to the persisted-peer scan and
+    finds the ELECTED master (mastership moved off the seed address)."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    port = _free_port()
+    node0 = Node(name="rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=3, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=3, transport_port=port,
+                          ping_interval=0)
+    node2 = Node(name="rank2", data_path=str(tmp_path / "d2"))
+    c2 = MultiHostCluster(node2, rank=2, world=3, transport_port=port,
+                          ping_interval=0)
+    c2b = None
+    node2b = None
+    try:
+        c0.transport.close()  # the seed master dies
+        for _ in range(c1._ping_retries + 1):
+            c1.run_fd_round()
+            c2.run_fd_round()
+        assert c1.is_master  # lowest-id survivor won term 2
+        assert c1.node.cluster_state.term >= 2
+        # restart rank 2: the seed address is dead, the elected master
+        # is only reachable through the persisted peer addresses
+        c2.close()
+        node2.close()
+        node2b = Node(name="rank2b", data_path=str(tmp_path / "d2"))
+        c2b = MultiHostCluster(node2b, rank=2, world=3,
+                               transport_port=port, ping_interval=0)
+        assert c2b.node.cluster_state.master_node_id == c1.local.node_id
+        assert not c2b.is_master
+    finally:
+        if c2b is not None:
+            c2b.close()
+        c1.close()
+        c0.close()
+        for n in (node2b, node1, node0):
+            if n is not None:
+                n.close()
+
+
+def test_headless_pair_converges_via_peer_solicitation(tmp_path):
+    """Restarted master + headless survivor: the campaign must solicit
+    voters through persisted peer addresses (the restarted node's VIEW is
+    only itself), and the self-granted ballot bases the next term, so the
+    pair converges within a few fault-detection rounds."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    port = _free_port()
+    node0 = Node(name="rank0", data_path=str(tmp_path / "d0"))
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    c0b = None
+    node0b = None
+    try:
+        c0.transport.close()  # master dies; survivor 1/2 stays headless
+        for _ in range(c1._ping_retries + 1):
+            c1.run_fd_round()
+        assert not c1.is_master
+        assert c1.node.cluster_state.master_node_id is None
+        # restart rank 0 on its disk: two remembered seats -> headless
+        # boot; its election must reach c1 (not in its view) via the
+        # persisted peer address
+        node0b = Node(name="rank0b", data_path=str(tmp_path / "d0"))
+        c0b = MultiHostCluster(node0b, rank=0, world=2,
+                               transport_port=_free_port(),
+                               ping_interval=0)
+        for _ in range(4):
+            if c0b.node.cluster_state.master_node_id is not None:
+                break
+            c0b.run_fd_round()
+        master = c0b.node.cluster_state.master_node_id
+        assert master is not None  # the pair elected SOMEBODY
+        for _ in range(3):  # survivor converges on the same master
+            if c1.node.cluster_state.master_node_id == master:
+                break
+            c1.run_fd_round()
+        assert c1.node.cluster_state.master_node_id == master
+        # exactly one of them holds the seat — never both (split-brain)
+        assert c0b.is_master != c1.is_master
+        winner = c0b if c0b.is_master else c1
+        assert master == winner.local.node_id
+        assert winner.node.cluster_state.term >= 2
+    finally:
+        if c0b is not None:
+            c0b.close()
+        c1.close()
+        c0.close()
+        for n in (node0b, node1, node0):
+            if n is not None:
+                n.close()
+
+
+def test_acked_metadata_survives_master_death_in_commit_window():
+    """Leader completeness: a master that gathered quorum phase-1 acks
+    (followers PARK, nothing applied), acked the client, and died before
+    the commit fan-out must not take the acknowledged change with it —
+    any new quorum intersects the acking one, so a voter's parked copy
+    is advertised, fetched, and recovered by the election."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.utils.faults import FAULTS
+
+    port = _free_port()
+    cs, ns = [], []
+    for r in range(3):
+        n = Node(name=f"rank{r}")
+        ns.append(n)
+        cs.append(MultiHostCluster(n, rank=r, world=3,
+                                   transport_port=port, ping_interval=0))
+    c0, c1, c2 = cs
+    try:
+        # master dies between quorum ack and commit fan-out
+        FAULTS.inject("publish.commit", error=OSError, count=1)
+        r = c0.data.create_index("acked",
+                                 {"settings": {"number_of_shards": 1}})
+        assert r["acknowledged"]                  # the client was told yes
+        assert "acked" not in c1.dist_indices     # parked, not applied
+        assert c1._pending_publish is not None
+        c0.transport.close()                      # ...and the master dies
+        for _ in range(c1._ping_retries + 1):
+            c1.run_fd_round()
+            c2.run_fd_round()
+        winner = c1 if c1.is_master else c2
+        assert winner.is_master
+        # the acknowledged index survived into the new reign
+        assert "acked" in winner.dist_indices
+        assert "acked" in c1.dist_indices and "acked" in c2.dist_indices
+    finally:
+        FAULTS.clear()
+        for c in reversed(cs):
+            c.close()
+        for n in ns:
+            n.close()
+
+
+def test_anti_entropy_heals_follower_that_missed_a_publish():
+    """A follower whose phase-1 send transiently failed (but whose pings
+    keep succeeding) must not trail forever on a quiescent cluster: the
+    master's periodic committed-key sweep re-publishes."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.utils.faults import FAULTS
+
+    port = _free_port()
+    cs, ns = [], []
+    for r in range(3):
+        n = Node(name=f"rank{r}")
+        ns.append(n)
+        cs.append(MultiHostCluster(n, rank=r, world=3,
+                                   transport_port=port, ping_interval=0))
+    c0, c1, c2 = cs
+    addr2 = tuple(c2.local.transport_address.rsplit(":", 1))
+    addr2 = (addr2[0], int(addr2[1]))
+    try:
+        FAULTS.inject(
+            "transport.send", error=OSError, count=1,
+            match=lambda ctx: ctx.get("action") == "cluster:publish"
+            and ctx.get("address") == addr2)
+        c0.data.create_index("gap", {"settings": {"number_of_shards": 1}})
+        assert "gap" in c1.dist_indices      # quorum committed without c2
+        assert "gap" not in c2.dist_indices  # ...which missed phase 1
+        for _ in range(5):                   # sweep fires every 5th round
+            c0.run_fd_round()
+        assert "gap" in c2.dist_indices      # healed, no new metadata op
+    finally:
+        FAULTS.clear()
+        for c in reversed(cs):
+            c.close()
+        for n in ns:
+            n.close()
+
+
+def test_ballot_survives_voter_restart(tmp_path):
+    """Raft durable state: a voter that granted term T and bounced must
+    refuse a SECOND candidate the same term (two masters would win it);
+    the original candidate's idempotent re-ask still succeeds."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    port = _free_port()
+    node0 = Node(name="rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1", data_path=str(tmp_path / "d1"))
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    c1b = None
+    node1b = None
+    try:
+        assert c1._on_request_vote(
+            {"term": 2, "candidate": "9999-first"})["granted"]
+        c1.close()
+        node1.close()
+        node1b = Node(name="rank1b", data_path=str(tmp_path / "d1"))
+        c1b = MultiHostCluster(node1b, rank=1, world=2,
+                               transport_port=port, ping_interval=0)
+        r = c1b._on_request_vote({"term": 2, "candidate": "9999-second"})
+        assert not r["granted"]  # the persisted ballot holds
+        r = c1b._on_request_vote({"term": 2, "candidate": "9999-first"})
+        assert r["granted"]  # idempotent re-ask by the original winner
+        # (the rejoin election consumed terms above 2 — the phantom
+        # ballot correctly forced the recovering pair past term 2)
+        nxt = max(c1b.node.cluster_state.term,
+                  c1b._votes.highest_granted()) + 1
+        assert c1b._on_request_vote(
+            {"term": nxt, "candidate": "9999-second"})["granted"]
+    finally:
+        if c1b is not None:
+            c1b.close()
+        c0.close()
+        for n in (node1b, node0):
+            if n is not None:
+                n.close()
 
 
 # -- transport -----------------------------------------------------------------

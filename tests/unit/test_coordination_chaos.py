@@ -1,0 +1,371 @@
+"""Cluster-coordination chaos matrix (tier-1, seed-deterministic).
+
+The control-plane counterpart of test_replication_chaos.py: three full
+MultiHostClusters IN-PROCESS under the DEFAULT quorum (majority of the
+master-eligible voting configuration = 2 of 3), ping_interval=0 so the
+tests drive fault-detection rounds explicitly — deterministic, bounded.
+
+Scenarios, each under a FIXED SEED MATRIX:
+
+- kill-master-mid-bulk: the master dies while a bulk streams through a
+  surviving coordinator. Within ``ping_retries`` fault-detection rounds
+  the lowest-id survivor wins a term-2 quorum election, reconstructs the
+  dist metadata, promotes primaries under BUMPED shard terms, and serves
+  every ACKNOWLEDGED doc (zero acked-op loss); a zombie write raced to
+  the dead-but-unaware old master is fenced with a typed 409.
+- symmetric partition + heal: the isolated old master steps down (it can
+  never gather a publish quorum), its writes fail typed 503
+  ``cluster_block_exception`` while the majority keeps electing, writing
+  and serving 200 searches; on heal the minority rejoins as a follower
+  and adopts the majority's committed state.
+- healed stale master: a master that never even noticed the partition
+  has its first post-heal publication rejected stale (409) by the
+  majority, steps down WITHOUT ever committing a conflicting state
+  version, and rejoins as a follower.
+"""
+import socket
+
+import pytest
+
+from elasticsearch_tpu.cluster.routing import shard_id_for
+from elasticsearch_tpu.cluster.transport import PeerBreaker
+from elasticsearch_tpu.utils.faults import FAULTS
+
+#: fixed seeds — same grammar as ESTPU_FAULTS for subprocess members
+KILL_SEEDS = [101, 202, 303]
+PARTITION_SEEDS = [11, 22]
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture()
+def trio():
+    """Three MultiHostClusters, default quorum (2 of 3); index `evt`
+    with 3 shards and 1 replica so every node is a primary owner."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.node import Node
+
+    port = _free_port()
+    nodes, clusters = [], []
+    for rank in range(3):
+        n = Node(name=f"rank{rank}")
+        c = MultiHostCluster(n, rank=rank, world=3, transport_port=port,
+                             ping_interval=0)
+        nodes.append(n)
+        clusters.append(c)
+    c0, c1, c2 = clusters
+    assert c0.quorum() == 2
+    c0.data.create_index("evt", {
+        "settings": {"number_of_shards": 3, "number_of_replicas": 1},
+        "mappings": {"properties": {"n": {"type": "integer"}}}})
+    meta = c0.dist_indices["evt"]
+    assert {v[0] for v in meta["assignment"].values()} == {
+        c0.local.node_id, c1.local.node_id, c2.local.node_id}
+    yield clusters
+    FAULTS.clear()
+    for c in reversed(clusters):
+        try:
+            c.close()
+        except Exception:
+            pass
+    for n in reversed(nodes):
+        n.close()
+
+
+def _addr(c):
+    host, port = c.local.transport_address.rsplit(":", 1)
+    return host, int(port)
+
+
+def _arm_kill(addr, prob, seed):
+    """Seeded connect-refusal for every send TO `addr` — the
+    deterministic stand-in for a dying node."""
+    FAULTS.inject(
+        "transport.send", error=ConnectionRefusedError, count=-1,
+        prob=prob, seed=seed,
+        match=lambda ctx: ctx.get("address") == addr)
+
+
+def _arm_partition(minority, majority, seed):
+    """Symmetric link-level drop between `minority` and every member of
+    `majority`, BOTH directions, via the discovery.partition point."""
+    min_id = minority.local.node_id
+    min_addr = _addr(minority)
+    maj_ids = {c.local.node_id for c in majority}
+    maj_addrs = {_addr(c) for c in majority}
+    FAULTS.inject(
+        "discovery.partition", error=ConnectionRefusedError, count=-1,
+        seed=seed,
+        match=lambda ctx: (
+            (ctx.get("local") == min_id
+             and ctx.get("address") in maj_addrs)
+            or (ctx.get("local") in maj_ids
+                and ctx.get("address") == min_addr)))
+
+
+def _bulk_with_midstream_kill(coord, victim, seed, n_docs=40, kill_at=10,
+                              prob=0.6):
+    """Index n_docs through `coord`, arming the seeded kill of `victim`
+    after `kill_at` acks. Returns the ACKNOWLEDGED doc ids."""
+    acked = set()
+    for i in range(n_docs):
+        if i == kill_at:
+            _arm_kill(_addr(victim), prob, seed)
+        doc_id = f"d{i}"
+        try:
+            res = coord.data.index_doc("evt", doc_id, {"n": i})
+            assert res.get("_seq_no") is not None
+            acked.add(doc_id)
+        except Exception:
+            pass  # unacked: the client was TOLD it failed
+    return acked
+
+
+@pytest.mark.parametrize("seed", KILL_SEEDS)
+def test_kill_master_mid_bulk_new_master_zero_acked_loss(trio, seed):
+    c0, c1, c2 = trio
+    old_term = c1.node.cluster_state.term
+    old_terms = {k: int(v)
+                 for k, v in c0.dist_indices["evt"]["primary_terms"]
+                 .items()}
+    acked = _bulk_with_midstream_kill(c1, c0, seed)
+    assert acked, "no write acked at all"
+
+    # bounded takeover: the seeded kill fires probabilistically, so a
+    # lucky ping can reset the strike count — but within a BOUNDED
+    # number of rounds (deterministic per seed) the survivors declare
+    # the master dead and the lowest-id survivor wins the election
+    bound = 15 * c1._ping_retries
+    rounds = 0
+    while not c1.is_master and rounds < bound:
+        c1.run_fd_round()
+        c2.run_fd_round()
+        rounds += 1
+    assert c1.is_master, "lowest-id survivor must win the election"
+    assert rounds <= bound
+    assert c1.node.cluster_state.term == old_term + 1
+    assert c2.node.cluster_state.master_node_id == c1.local.node_id
+    assert c2.node.cluster_state.term == old_term + 1
+    counters = c1.node.metrics.counter_values()
+    assert counters.get(
+        'estpu_discovery_elections_total{outcome="won"}', 0) >= 1
+
+    # metadata takeover: every shard the dead master owned changed hands
+    # to a survivor under a BUMPED primary term
+    meta = c1.dist_indices["evt"]
+    dead = c0.local.node_id
+    for sid_s, owners in meta["assignment"].items():
+        assert owners, f"shard {sid_s} lost every copy"
+        assert dead not in owners
+    bumped = [s for s, t in meta["primary_terms"].items()
+              if int(t) > old_terms[s]]
+    assert bumped, "no shard term bump despite the master's death"
+
+    # ZERO acked-op loss: every acknowledged doc is served by the
+    # promoted copies through the new master's committed metadata
+    c1.node.indices["evt"].refresh()
+    c2.node.indices["evt"].refresh()
+    for doc_id in sorted(acked):
+        got = c1.data.get_doc("evt", doc_id)
+        assert got.get("found"), f"ACKED doc {doc_id} lost after takeover"
+
+    # writes keep flowing through the new master's era
+    res = c1.data.index_doc("evt", "after", {"n": 1000})
+    assert res.get("_seq_no") is not None
+
+    # a zombie write raced to the demoted OLD master: depending on the
+    # seed it either still believes it is master+primary (its op carries
+    # the stale shard term and the surviving copy fences it: typed 409)
+    # or one of its in-flight publications already met the campaign
+    # fence and it stepped down (writes blocked: typed 503) — EITHER
+    # way the write is refused, never silently acked into the old era
+    zombie_sid = next(
+        s for s, t in meta["primary_terms"].items()
+        if int(t) > old_terms[s])
+    zombie_id = next(f"z{k}" for k in range(1000)
+                     if shard_id_for(f"z{k}", 3) == int(zombie_sid))
+    with pytest.raises(Exception) as ei:
+        c0.data.index_doc("evt", zombie_id, {"n": -1})
+    if c0.is_master:
+        assert getattr(ei.value, "error_type", "") == \
+            "stale_primary_exception"
+        assert getattr(ei.value, "status", 0) == 409
+    else:  # resigned on the stale-publication 409 — writes are blocked
+        assert getattr(ei.value, "error_type", "") == \
+            "cluster_block_exception"
+        assert getattr(ei.value, "status", 0) == 503
+    # the fenced write reached no promoted copy
+    assert not c1.node.indices["evt"].shards[int(zombie_sid)] \
+        .engine.exists(zombie_id)
+
+    # observability: the new master's health carries the bumped term
+    from elasticsearch_tpu.rest.server import RestController
+
+    status, h = RestController(c1.node).dispatch(
+        "GET", "/_cluster/health", {}, b"")
+    assert status == 200
+    assert h["master_node"] == c1.local.node_id
+    assert h["term"] == old_term + 1
+    assert h["no_master_block"] is False
+    status, rows = RestController(c2.node).dispatch(
+        "GET", "/_cat/master", {}, b"")
+    assert status == 200 and rows[0]["id"] == c1.local.node_id
+
+
+@pytest.mark.parametrize("seed", PARTITION_SEEDS)
+def test_partition_minority_blocks_majority_serves_heal_rejoins(trio,
+                                                                seed):
+    import json
+
+    from elasticsearch_tpu.rest.server import RestController
+    from elasticsearch_tpu.utils.errors import ClusterBlockException
+
+    c0, c1, c2 = trio
+    for i in range(12 + seed % 5):
+        c0.data.index_doc("evt", f"p{i}", {"n": i})
+    c0.data.refresh("evt")
+    committed_before = c0.committed
+    history_before = list(c0.committed_history)
+
+    _arm_partition(c0, [c1, c2], seed)
+
+    # majority side: detects the master's death, elects c1 (lowest id)
+    for _ in range(c1._ping_retries):
+        c1.run_fd_round()
+        c2.run_fd_round()
+    assert c1.is_master
+    new_term = c1.node.cluster_state.term
+    assert new_term == 2
+    assert c2.node.cluster_state.master_node_id == c1.local.node_id
+
+    # minority side: the old master's own fault detection empties its
+    # follower view below quorum -> it STEPS DOWN (publish could never
+    # commit) and blocks writes
+    for _ in range(c0._ping_retries):
+        c0.run_fd_round()
+    assert not c0.is_master
+    assert c0.node.cluster_state.master_node_id is None
+    counters = c0.node.metrics.counter_values()
+    assert counters.get("estpu_discovery_master_stepdowns_total", 0) >= 1
+
+    # minority writes: typed 503 cluster_block_exception, data plane...
+    with pytest.raises(ClusterBlockException) as ei:
+        c0.data.index_doc("evt", "minority", {"n": -1})
+    assert ei.value.status == 503
+    # ...and REST
+    st, body = RestController(c0.node).dispatch(
+        "PUT", "/evt/_doc/minority", {},
+        json.dumps({"n": -1}).encode())
+    assert st == 503
+    assert body["error"]["type"] == "cluster_block_exception"
+    # minority metadata ops: same block
+    st, body = RestController(c0.node).dispatch(
+        "PUT", "/minorix", {}, b"{}")
+    assert st == 503
+
+    # minority searches still answer 200 from the last committed state
+    st, body = RestController(c0.node).dispatch(
+        "GET", "/evt/_search", {"size": "0"}, b"")
+    assert st == 200
+
+    # the minority committed NOTHING during the partition
+    assert c0.committed == committed_before
+    assert list(c0.committed_history) == history_before
+
+    # majority side: writes land and searches serve 200 clean
+    res = c1.data.index_doc("evt", "majority", {"n": 7})
+    assert res.get("_seq_no") is not None
+    c1.data.refresh("evt")
+    st, body = RestController(c1.node).dispatch(
+        "GET", "/evt/_search", {"size": "0"}, b"")
+    assert st == 200
+    assert body["_shards"]["failed"] == 0
+
+    # HEAL: the headless minority scans its known peers, finds the
+    # term-2 master, joins it, and adopts the committed majority state
+    FAULTS.clear()
+    for c in (c0, c1, c2):
+        c.transport.breaker = PeerBreaker()
+    c0.run_fd_round()  # headless round = the rejoin scan
+    assert not c0.is_master
+    assert c0.node.cluster_state.master_node_id == c1.local.node_id
+    assert c0.node.cluster_state.term == new_term
+    assert c0.committed[0] == new_term
+    # the write block lifted: a write through the healed member routes
+    # to the quorum's owners and acks
+    res = c0.data.index_doc("evt", "healed", {"n": 8})
+    assert res.get("_seq_no") is not None
+    st, h = RestController(c0.node).dispatch(
+        "GET", "/_cluster/health", {}, b"")
+    assert st == 200 and h["no_master_block"] is False
+    assert h["master_node"] == c1.local.node_id and h["term"] == new_term
+
+
+def test_healed_stale_master_steps_down_without_conflicting_commit(trio):
+    """The partition heals before the old master ever NOTICED it: its
+    first post-heal publication is rejected stale (typed 409) by the
+    majority, it steps down without committing, and rejoins as a
+    follower of the term-2 master."""
+    from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
+
+    c0, c1, c2 = trio
+    _arm_partition(c0, [c1, c2], seed=7)
+    # ONLY the majority runs detection rounds: c0 never notices
+    for _ in range(c1._ping_retries):
+        c1.run_fd_round()
+        c2.run_fd_round()
+    assert c1.is_master and c1.node.cluster_state.term == 2
+    majority_committed = c1.committed
+
+    FAULTS.clear()  # heal — c0 still believes it is the term-1 master
+    for c in (c0, c1, c2):
+        c.transport.breaker = PeerBreaker()
+    assert c0.is_master and c0.node.cluster_state.term == 1
+
+    # the stale master's next metadata change cannot commit: the
+    # majority fences its term-1 publication with the typed 409, the
+    # master steps down, the op fails typed, and the half-created local
+    # index rolls back
+    with pytest.raises(ElasticsearchTpuException) as ei:
+        c0.data.create_index("minor", {"settings":
+                                       {"number_of_shards": 1}})
+    assert getattr(ei.value, "status", 0) in (503, 409)
+    assert not c0.is_master
+    assert "minor" not in c0.dist_indices
+    assert "minor" not in c1.dist_indices
+    # the majority's committed line never regressed or forked
+    assert c1.committed >= majority_committed
+    assert c1.is_master
+
+    # the stepped-down master rejoins as a follower and adopts term 2
+    c0.run_fd_round()
+    assert c0.node.cluster_state.master_node_id == c1.local.node_id
+    assert c0.node.cluster_state.term == 2
+    assert c0.committed[0] == 2
+
+
+def test_env_spec_arms_coordination_points():
+    """The ESTPU_FAULTS grammar covers the new coordination points
+    (subprocess cluster members arm through it)."""
+    from elasticsearch_tpu.utils.faults import FaultRegistry, _parse_env_spec
+
+    r = FaultRegistry()
+    _parse_env_spec(
+        "discovery.vote:count=1;publish.commit:count=2;"
+        "discovery.partition:prob=0.5:seed=9", r)
+    assert r.active("discovery.vote")
+    assert r.active("publish.commit")
+    assert r.active("discovery.partition")

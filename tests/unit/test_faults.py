@@ -359,10 +359,14 @@ def two_node_cluster(tmp_path):
 
     port = _free_port()
     node0 = Node(name="rank0")
+    # minimum_master_nodes=1: the 'dead' owner is simulated by faults
+    # while the master keeps serving alone — the pre-quorum semantics
+    # (coordination quorum/step-down has its own chaos matrix)
     c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
-                          ping_interval=0)
+                          ping_interval=0, minimum_master_nodes=1)
     node1 = Node(name="rank1")
-    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port)
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0, minimum_master_nodes=1)
     c0.data.create_index("evt", {
         "settings": {"number_of_shards": 2},
         "mappings": {"properties": {"n": {"type": "integer"}}}})

@@ -58,10 +58,16 @@ def replicated_cluster():
 
     port = _free_port()
     node0 = Node(name="rank0")
+    # minimum_master_nodes=1: this harness declares node death EXPLICITLY
+    # (_kill_node) and keeps the master serving alone afterwards — the
+    # pre-quorum replication-safety semantics under test here; the
+    # coordination-layer quorum/step-down behavior has its own matrix in
+    # test_coordination_chaos.py
     c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
-                          ping_interval=0)
+                          ping_interval=0, minimum_master_nodes=1)
     node1 = Node(name="rank1")
-    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port)
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0, minimum_master_nodes=1)
     c0.data.create_index("evt", {
         "settings": {"number_of_shards": 2, "number_of_replicas": 1},
         "mappings": {"properties": {"n": {"type": "integer"}}}})

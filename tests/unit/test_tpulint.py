@@ -18,10 +18,11 @@ from tools.tpulint.baseline import filter_baselined, load_baseline
 def lint(src: str, *, hot: bool = False, locked: bool = False,
          ops: bool = False, swallow: bool = False, timing: bool = False,
          budget: bool = False, blocking: bool = False,
+         threads: bool = False,
          path: str = "elasticsearch_tpu/x/mod.py"):
     return lint_source(textwrap.dedent(src), path, hot=hot, ops=ops,
                        locked=locked, swallow=swallow, timing=timing,
-                       budget=budget, blocking=blocking)
+                       budget=budget, blocking=blocking, threads=threads)
 
 
 def rules_of(violations):
@@ -874,6 +875,181 @@ class TestR010:
             textwrap.dedent(src), "elasticsearch_tpu/serving/coalescer.py"))
         assert not lint_source(textwrap.dedent(src),
                                "elasticsearch_tpu/index/other.py")
+
+
+class TestR011:
+    """Background threads in cluster modules: daemon=True mandatory, and
+    a thread target's While loop must consult a stop Event (the
+    _fault_loop pattern) — an ungated control-plane loop outlives
+    close() and keeps probing/publishing a torn-down cluster."""
+
+    def test_bad_non_daemon_thread(self):
+        vs = lint("""
+            import threading
+
+            def start(svc):
+                t = threading.Thread(target=svc.run, name="bg")
+                t.start()
+        """, threads=True)
+        assert rules_of(vs) == ["R011"]
+        assert "daemon=True" in vs[0].message
+
+    def test_bad_ungated_while_loop_in_target(self):
+        vs = lint("""
+            import threading
+            import time
+
+            class Cluster:
+                def _loop(self):
+                    while True:
+                        self.ping_all()
+                        time.sleep(1.0)
+
+                def start(self):
+                    threading.Thread(target=self._loop,
+                                     daemon=True).start()
+        """, threads=True)
+        assert rules_of(vs) == ["R011"]
+        assert "stop" in vs[0].message.lower()
+
+    def test_bad_both_violations_flag_separately(self):
+        vs = lint("""
+            from threading import Thread
+
+            def loop():
+                while True:
+                    poll()
+
+            def start():
+                Thread(target=loop).start()
+        """, threads=True)
+        assert [v.rule for v in vs] == ["R011", "R011"]
+
+    def test_good_fault_loop_pattern(self):
+        # the production shape: daemon=True + stop-Event-gated loop
+        vs = lint("""
+            import threading
+
+            class Cluster:
+                def __init__(self):
+                    self._stop = threading.Event()
+
+                def _fault_loop(self, interval):
+                    while not self._stop.wait(interval):
+                        self.run_fd_round()
+
+                def start(self):
+                    threading.Thread(target=self._fault_loop,
+                                     args=(1.0,), name="fd",
+                                     daemon=True).start()
+        """, threads=True)
+        assert vs == []
+
+    def test_good_break_on_stop_inside_body(self):
+        # `while True: ... if stop.is_set(): break` consults the Event
+        vs = lint("""
+            import threading
+
+            _STOP = threading.Event()
+
+            def loop():
+                while True:
+                    if _STOP.is_set():
+                        break
+                    work()
+
+            def start():
+                threading.Thread(target=loop, daemon=True).start()
+        """, threads=True)
+        assert vs == []
+
+    def test_good_oneshot_target_with_for_loop(self):
+        # a for over a finite work list terminates on its own — only the
+        # daemon flag is required
+        vs = lint("""
+            import threading
+
+            class Data:
+                def _run(self, directives):
+                    for d in directives:
+                        self.recover(d)
+
+                def start(self, directives):
+                    threading.Thread(target=self._run,
+                                     args=(directives,),
+                                     daemon=True).start()
+        """, threads=True)
+        assert vs == []
+
+    def test_target_resolves_within_enclosing_class(self):
+        """Two classes sharing a method name: the checker must inspect
+        the STARTING class's body — first-def-wins by bare name let an
+        ungated loop ship unflagged behind a same-named clean method
+        defined earlier (and flagged the symmetric clean case)."""
+        vs = lint("""
+            import threading
+            import time
+
+            class Clean:
+                def _run(self):
+                    self.ping_once()
+
+            class Dirty:
+                def _run(self):
+                    while True:
+                        self.ping_all()
+                        time.sleep(1.0)
+
+                def start(self):
+                    threading.Thread(target=self._run,
+                                     daemon=True).start()
+        """, threads=True)
+        assert rules_of(vs) == ["R011"]
+        assert "stop" in vs[0].message.lower()
+        # the symmetric case: clean method behind an earlier dirty name
+        vs = lint("""
+            import threading
+            import time
+
+            class Dirty:
+                def _run(self):
+                    while True:
+                        time.sleep(1.0)
+
+            class Clean:
+                def _run(self):
+                    self.ping_once()
+
+                def start(self):
+                    threading.Thread(target=self._run,
+                                     daemon=True).start()
+        """, threads=True)
+        assert rules_of(vs) == []
+
+    def test_opaque_target_only_daemon_checked(self):
+        # another object's method is out of static reach: daemon=True is
+        # still enforced, the loop check is not
+        vs = lint("""
+            import threading
+
+            def start(self):
+                threading.Thread(target=self.data.resurrect,
+                                 daemon=True).start()
+        """, threads=True)
+        assert vs == []
+
+    def test_scope_only_cluster_modules(self):
+        src = """
+            import threading
+
+            def start(svc):
+                threading.Thread(target=svc.run).start()
+        """
+        assert any(v.rule == "R011" for v in lint_source(
+            textwrap.dedent(src),
+            "elasticsearch_tpu/cluster/bootstrap.py"))
+        assert not any(v.rule == "R011" for v in lint_source(
+            textwrap.dedent(src), "elasticsearch_tpu/index/engine.py"))
 
 
 class TestPqTierFixtures:

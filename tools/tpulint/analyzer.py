@@ -34,6 +34,10 @@ RULES: Dict[str, str] = {
     "R010": "unbounded blocking wait (Event.wait/Condition.wait/queue.get "
             "without timeout) while holding a lock in a serving module — "
             "one lost notify wedges every parked request behind it",
+    "R011": "background thread in a cluster module without daemon=True, "
+            "or with a loop not gated on a stop Event (the _fault_loop "
+            "pattern) — an ungated control-plane thread outlives close() "
+            "and keeps publishing/probing a dead cluster",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.
@@ -70,6 +74,12 @@ BUDGET_EXEMPT_MARKERS = ("/elasticsearch_tpu/resources/",)
 # a lock turns one lost notify (or a crashed drain loop) into every
 # parked client wedging forever. Timeout-bounded waits re-check state.
 BLOCKING_PATH_MARKERS = ("/serving/",)
+# R011 scope: the cluster control plane — fault detection, elections,
+# publish and recovery all run background threads; one that is not
+# daemon=True (or whose loop never checks a stop Event) survives close()
+# and keeps probing/publishing a torn-down cluster, wedging test
+# teardown and process exit.
+THREADS_PATH_MARKERS = ("/cluster/",)
 
 _ALLOW_RE = re.compile(r"#\s*tpulint:\s*allow\[\s*([A-Z0-9,\s]+?)\s*\]")
 _HOST_RE = re.compile(r"#\s*tpulint:\s*host\b")
@@ -155,10 +165,12 @@ def lint_source(
     timing: Optional[bool] = None,
     budget: Optional[bool] = None,
     blocking: Optional[bool] = None,
+    threads: Optional[bool] = None,
 ) -> List[Violation]:
     """Lint one source string. ``hot``/``ops``/``locked``/``swallow``/
-    ``timing``/``budget``/``blocking`` override the path-based scoping
-    (fixture tests use these; production runs infer from the path)."""
+    ``timing``/``budget``/``blocking``/``threads`` override the
+    path-based scoping (fixture tests use these; production runs infer
+    from the path)."""
     from tools.tpulint import rules as _rules
 
     tree = ast.parse(source, filename=path)
@@ -179,6 +191,8 @@ def lint_source(
                 if budget is None else budget),
         blocking=(_matches(path, BLOCKING_PATH_MARKERS)
                   if blocking is None else blocking),
+        threads=(_matches(path, THREADS_PATH_MARKERS)
+                 if threads is None else threads),
         host_lines=supp.host,
     )
     found = _rules.check_module(tree, ctx)

@@ -1,4 +1,4 @@
-"""tpulint rule visitors (R001–R010).
+"""tpulint rule visitors (R001–R011).
 
 One recursive walk per file carries the context every rule needs: the
 loop stack (R001/R002), the traced-function stack with its static/traced
@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import ast
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from tools.tpulint.analyzer import Violation, snippet_at
 
@@ -38,6 +38,7 @@ class FileContext:
     timing: bool = False   # R007 applies (tracing//monitor/ modules)
     budget: bool = False   # R008 applies (product package, not resources/)
     blocking: bool = False  # R010 applies (serving/ modules)
+    threads: bool = False  # R011 applies (cluster/ modules)
     host_lines: Set[int] = field(default_factory=set)
 
 
@@ -111,6 +112,12 @@ class _ModuleInfo:
         self.metrics_mods: Set[str] = set()   # `from ...monitor import metrics`
         self.metrics_objs: Set[str] = set()   # `from ...metrics import SHARED`
         self.kernels_mods: Set[str] = set()   # `from ...monitor import kernels`
+        # R011: threading aliases + every function/method def by bare
+        # name, so a Thread(target=...) can resolve to its loop body
+        self.threading_mods: Set[str] = set()  # `import threading [as t]`
+        self.thread_fns: Set[str] = set()      # `from threading import Thread`
+        self.fn_defs: Dict[str, ast.AST] = {}
+        self.method_defs: Dict[Tuple[str, str], ast.AST] = {}
         for node in ast.walk(tree):
             if isinstance(node, ast.Import):
                 for al in node.names:
@@ -127,11 +134,17 @@ class _ModuleInfo:
                         self.partial_names.add(f"{bound}.partial")
                     elif al.name == "time":
                         self.time_mods.add(bound)
+                    elif al.name == "threading":
+                        self.threading_mods.add(bound)
             elif isinstance(node, ast.ImportFrom):
                 if node.module == "time":
                     for al in node.names:
                         if al.name == "time":
                             self.wall_fns.add(al.asname or "time")
+                if node.module == "threading":
+                    for al in node.names:
+                        if al.name == "Thread":
+                            self.thread_fns.add(al.asname or "Thread")
                 if node.module and node.module.endswith(".monitor"):
                     for al in node.names:
                         if al.name == "metrics":
@@ -167,9 +180,19 @@ class _ModuleInfo:
                     if nm:
                         self.wrapped_fns.add(nm)
             elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.fn_defs.setdefault(node.name, node)
                 statics = self.decorator_jit(node)
                 if statics is not None:
                     self.jitted[node.name] = JitTarget(set(statics))
+            elif isinstance(node, ast.ClassDef):
+                # methods keyed per class: R011's self.<method> thread
+                # targets must resolve within the RIGHT class (bare-name
+                # first-def-wins checked the wrong body when two classes
+                # shared a method name)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.method_defs[(node.name, item.name)] = item
         for stmt in tree.body:
             if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
                 tgt = _name(stmt.targets[0])
@@ -435,6 +458,7 @@ class _Checker(ast.NodeVisitor):
         self._check_offbudget_put(node)
         self._check_metric_record(node)
         self._check_blocking_wait(node)
+        self._check_cluster_thread(node)
         self.generic_visit(node)
 
     # -- R009 ---------------------------------------------------------------
@@ -597,6 +621,75 @@ class _Checker(ast.NodeVisitor):
                        "a serving module — bound it (timeout=) or make "
                        "it non-blocking (block=False) so the drain path "
                        "can't wedge behind an empty queue")
+
+    # -- R011 ---------------------------------------------------------------
+
+    def _check_cluster_thread(self, node: ast.Call) -> None:
+        """R011: ``threading.Thread(...)`` in a cluster module must be
+        ``daemon=True`` (the control plane must never block interpreter
+        exit) and, when its target's body loops, every loop must consult
+        a stop Event (the ``_fault_loop`` pattern: ``while not
+        self._stop.wait(interval)``) — an ungated loop outlives close()
+        and keeps probing/publishing a torn-down cluster."""
+        if not self.ctx.threads:
+            return
+        chain = _attr_chain(node.func) or ""
+        head, _, fn = chain.rpartition(".")
+        if not (chain in self.mod.thread_fns
+                or (fn == "Thread" and head in self.mod.threading_mods)):
+            return
+        daemon = next((kw.value for kw in node.keywords
+                       if kw.arg == "daemon"), None)
+        if not (isinstance(daemon, ast.Constant) and daemon.value is True):
+            self._emit("R011", node,
+                       "background thread in a cluster module without "
+                       "daemon=True — a non-daemon control-plane thread "
+                       "blocks interpreter shutdown; pass daemon=True and "
+                       "gate its loop on a stop Event")
+        target = next((kw.value for kw in node.keywords
+                       if kw.arg == "target"), None)
+        fn_node = self._resolve_thread_target(target)
+        if fn_node is None:
+            return  # external/opaque target: only the daemon check applies
+        # While loops only: a for over a finite work list terminates on
+        # its own; the hazard is the indefinite polling loop
+        for sub in ast.walk(fn_node):
+            if isinstance(sub, ast.While) and not self._stop_gated(sub):
+                self._emit("R011", sub,
+                           f"loop in thread target `{fn_node.name}` is not "
+                           "gated on a stop Event — check a `stop` "
+                           "Event in the loop (the _fault_loop pattern: "
+                           "`while not self._stop.wait(interval)`) so "
+                           "close() actually stops the thread")
+
+    def _resolve_thread_target(self, target) -> Optional[ast.AST]:
+        """target= resolved to a function/method DEFINED IN THIS MODULE:
+        a bare name, or ``self.<method>`` resolved within the ENCLOSING
+        class only (a same-named method of another class must not be
+        checked in its place). Anything else — another object's method,
+        an inherited method — is out of static reach."""
+        if target is None:
+            return None
+        nm = _name(target)
+        if nm:
+            return self.mod.fn_defs.get(nm)
+        if isinstance(target, ast.Attribute) and \
+                _name(target.value) == "self" and self.class_stack:
+            return self.mod.method_defs.get(
+                (self.class_stack[-1], target.attr))
+        return None
+
+    @staticmethod
+    def _stop_gated(loop) -> bool:
+        """Anywhere in the loop (test or body — `while True: ... if
+        stop.is_set(): break` counts), a name/attribute containing
+        'stop' is consulted."""
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Attribute) and "stop" in sub.attr.lower():
+                return True
+            if isinstance(sub, ast.Name) and "stop" in sub.id.lower():
+                return True
+        return False
 
     # -- R008 ---------------------------------------------------------------
 
