@@ -1124,20 +1124,48 @@ def run_bench(args, jax) -> dict:
         "executor_prep_misses": delta.get("kernels.executor_prep_miss", 0),
         "executor_data_hits": delta.get("kernels.executor_data_hit", 0),
         "executor_data_misses": delta.get("kernels.executor_data_miss", 0),
-        # -1 = trace auditor not installed (unknown, never a fake 0)
-        "jit_compiles": delta.get("jit.traces_total", -1),
+        # null = trace auditor not installed (unknown, never a fake 0 and
+        # never a -1 sentinel that leaks into sums)
+        "jit_compiles": delta.get("jit.traces_total"),
         "evictions": delta.get("residency.evictions", 0),
         "rehydrations": delta.get("residency.rehydrations", 0),
         "breaker_tripped": sum(
             v for k, v in delta.items()
             if k.startswith("breakers.") and v > 0),
-        # ... plus every other counter that moved during the run
+        # ... plus every other counter that moved during the run (None =
+        # unavailable keys are dropped here; `jit_compiles` above carries
+        # the typed null)
         "counters": {k: v for k, v in delta.items() if v},
     }
+    # device-program observatory (monitor/programs.py): per-key
+    # compile/execute deltas over the whole run — which programs this
+    # workload compiled, what tracing+compilation cost vs cached
+    # execution, ranked by execute time so the hot keys lead
+    prog_delta = {
+        k: v for k, v in delta.items()
+        if k.startswith("programs.") and v
+    }
+    from elasticsearch_tpu.monitor import programs as _programs
+
+    prog_rows = _programs.REGISTRY.snapshot()
+    prog_rows.sort(key=lambda r: -r["execute_seconds"])
+    PARTIAL["programs"] = {
+        "backend": _programs.backend_fingerprint(),
+        "totals": _programs.REGISTRY.stats(),
+        "delta": prog_delta,
+        "top_by_execute": [
+            {k: r[k] for k in ("program", "shapes", "compiles",
+                               "compile_seconds", "calls",
+                               "execute_seconds", "execute_p50_seconds",
+                               "execute_p99_seconds", "cold")}
+            for r in prog_rows[:12]],
+    }
+    jc = PARTIAL['metrics_delta']['jit_compiles']
     log(f"metrics delta: prep {PARTIAL['metrics_delta']['executor_prep_hits']}"
         f"/{PARTIAL['metrics_delta']['executor_prep_misses']} hit/miss, "
-        f"{PARTIAL['metrics_delta']['jit_compiles']} jit traces, "
-        f"{PARTIAL['metrics_delta']['evictions']} evictions")
+        f"{'unknown' if jc is None else jc} jit traces, "
+        f"{PARTIAL['metrics_delta']['evictions']} evictions; "
+        f"programs: {PARTIAL['programs']['totals']}")
     cpu_qps = 1000.0 / cpu_p50 if cpu_p50 > 0 else 1.0
     PARTIAL.update({
         "metric": "bm25_batched_qps",

@@ -429,7 +429,12 @@ class IndexService:
         acceleration structures (dense impact blocks) before user traffic."""
         for name, body in list(getattr(self, "warmers", {}).items()):
             try:
-                self.search(body or {"query": {"match_all": {}}})
+                # _search_inner: a warmer's whole point is pre-paying
+                # compiles in the background — recording it through the
+                # public wrapper would file deliberate warmer traffic
+                # into estpu_search_duration_seconds{warmup="true"}, the
+                # exact cold-start series it exists to empty
+                self._search_inner(body or {"query": {"match_all": {}}})
             except Exception:
                 pass  # a broken warmer must never fail the refresh
 
@@ -606,6 +611,44 @@ class IndexService:
 
     def search(self, body: dict, dfs: bool = False,
                preference: Optional[str] = None) -> dict:
+        """Index-level search entry. Wraps the body in the program
+        observatory's index scope (per-index key census) and records the
+        warmup-labeled latency: a request whose per-THREAD jit trace
+        count moved paid a fresh compile — labeling it lets cold-start
+        p99 separate from steady-state p99, the before/after number
+        ROADMAP #6's zero-warmup acceptance needs."""
+        from elasticsearch_tpu.monitor import programs
+        from elasticsearch_tpu.tracing import retrace
+
+        t_req = time.perf_counter()
+        snap = retrace.snapshot()
+        with programs.index_scope(self.name):
+            resp = self._search_inner(body, dfs=dfs, preference=preference)
+        delta = retrace.traces_since(snap)
+        warmup = "unknown" if delta < 0 else ("true" if delta else "false")
+        self._record_search_metric(time.perf_counter() - t_req, warmup)
+        return resp
+
+    def _record_search_metric(self, seconds: float, warmup: str) -> None:
+        """Search latency with the warmup dimension. Library-embedded
+        IndexServices have no node — then nothing records (the
+        _record_write_metric discipline; a SHARED fallback would shadow
+        the same-named per-node family in every node's exposition)."""
+        node = getattr(self, "_node", None)
+        if node is None:
+            return
+        try:
+            node.metrics.histogram(
+                "estpu_search_duration_seconds",
+                "Search latency by index; warmup=true marks requests "
+                "that paid a fresh jit compile (unknown = trace auditor "
+                "absent)", ("index", "warmup"),
+            ).labels(self.name, warmup).observe(seconds)
+        except Exception:  # tpulint: allow[R006] — dropping one metric
+            pass           # sample must never fail the measured search
+
+    def _search_inner(self, body: dict, dfs: bool = False,
+                      preference: Optional[str] = None) -> dict:
         from elasticsearch_tpu.cluster.metadata import check_open
         from elasticsearch_tpu.search.queries import rewrite_mlt_in_body
 
@@ -712,7 +755,9 @@ class IndexService:
         # percolateQueries filtering)
         restrict = (body or {}).get("query") or (body or {}).get("filter")
         if restrict is not None:
-            r = self.search({"query": {"bool": {
+            # _search_inner: an internal sub-search of ONE user percolate
+            # must not multiply estpu_search_duration_seconds samples
+            r = self._search_inner({"query": {"bool": {
                 "must": [restrict],
                 "filter": [{"term": {"_type": PERCOLATOR_TYPE}}]}},
                 "size": 10_000, "_source": False})
@@ -745,7 +790,7 @@ class IndexService:
             # aggregations run over the MATCHED .percolator docs' own
             # metadata fields (reference: PercolateSourceBuilder
             # aggregations / PercolatorService agg phase)
-            r = self.search({"query": {"bool": {"filter": [
+            r = self._search_inner({"query": {"bool": {"filter": [
                 {"term": {"_type": PERCOLATOR_TYPE}},
                 {"ids": {"values": full}}]}},
                 "size": 0, "aggs": aggs_spec})

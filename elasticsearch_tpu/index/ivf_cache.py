@@ -212,6 +212,48 @@ def seed(key: str, blob: bytes) -> None:
     _seed(key, blob, _disk_paths(key))
 
 
+# -- generic blob tier (program-key census etc.) -----------------------------
+
+def load_blob(key: str, ext: str) -> Optional[bytes]:
+    """Raw blob bytes for ``(key, ext)`` from memory or any registered
+    directory, or None. No decoding here — callers validate (and call
+    :func:`delete_blob` on corruption, so a bad blob becomes a clean
+    miss instead of a crash). Lets siblings of the IVF/PQ artifacts —
+    the per-index program-key census (resources/census.py) — ride the
+    same durable tier without duplicating the directory registry."""
+    mkey = f"{ext}:{key}"
+    with _LOCK:
+        blob = _MEM.get(mkey)
+    if blob is not None:
+        return blob
+    for path in _disk_paths(key, ext=ext):
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path, "rb") as fh:
+                return fh.read()
+        except OSError:
+            continue
+    return None
+
+
+def store_blob(key: str, blob: bytes, ext: str) -> None:
+    """Persist raw bytes under ``(key, ext)`` (memory + every registered
+    directory; best-effort on disk like every other blob here)."""
+    _seed(f"{ext}:{key}", blob, _disk_paths(key, ext=ext))
+
+
+def delete_blob(key: str, ext: str) -> None:
+    """Drop ``(key, ext)`` everywhere — the corrupt-blob miss path."""
+    with _LOCK:
+        _MEM.pop(f"{ext}:{key}", None)
+    for path in _disk_paths(key, ext=ext):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def _seed(mkey: str, blob: bytes, paths: List[str]) -> None:
     with _LOCK:
         if mkey not in _MEM and len(_MEM) >= _MEM_CAP:

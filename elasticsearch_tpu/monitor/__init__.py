@@ -3,3 +3,8 @@ from elasticsearch_tpu.monitor.stats import SearchStats, os_stats, process_stats
 
 __all__ = ["MetricsRegistry", "SHARED", "SearchStats", "os_stats",
            "process_stats"]
+
+# NOTE: monitor.programs (the device-program observatory) is imported
+# lazily by its feeds (tracing/retrace reporter, executor dispatch
+# wrappers) — not re-exported here, so `from elasticsearch_tpu.monitor
+# import kernels`-style light imports stay light.

@@ -424,8 +424,10 @@ def process_counters() -> Dict[str, float]:
     """One flat map of the process-wide monotonic counters a bench run
     moves: kernel dispatch + executor cache hits/misses
     (monitor/kernels.py), jit traces (tools.tpulint trace_audit, -1 when
-    the auditor is not installed — unknown must stay distinguishable
-    from zero), residency evictions/rehydrations, breaker trips, and the
+    the auditor is not installed — the unknown sentinel stays
+    distinguishable from zero in this snapshot map and renders as a
+    typed ``None`` once it flows through :func:`counters_delta`),
+    residency evictions/rehydrations, breaker trips, and the
     SHARED registry's counters. ``bench.py`` snapshots this before/after
     a run and emits the delta as ``metrics_delta``."""
     out: Dict[str, float] = {}
@@ -458,20 +460,31 @@ def process_counters() -> Dict[str, float]:
             out[f"breakers.{name}.tripped"] = float(br.get("tripped", 0))
     except Exception:
         pass
+    # device-program observatory: per-key compile/execute counters
+    # (``programs.<program>|<shapes>.<counter>``) so the bench delta
+    # carries which programs a run compiled and what they cost
+    try:
+        from elasticsearch_tpu.monitor import programs as _programs
+
+        out.update(_programs.REGISTRY.counter_values())
+    except Exception:
+        pass
     out.update(SHARED.counter_values())
     return out
 
 
 def counters_delta(before: Dict[str, float],
-                   after: Dict[str, float]) -> Dict[str, float]:
-    """after - before, keeping every key either side saw; the -1 unknown
-    sentinel (uninstalled trace auditor) propagates instead of producing
-    a fake 0 delta."""
-    out: Dict[str, float] = {}
+                   after: Dict[str, float]) -> Dict[str, Optional[float]]:
+    """after - before, keeping every key either side saw. A counter that
+    was UNKNOWN on either side (the trace auditor's -1 snapshot sentinel,
+    or an explicit None) deltas to ``None`` — the typed absence JSON
+    renders as null, so consumers can't mix it into arithmetic the way
+    the old -1 leaked into sums (never a fake 0 either)."""
+    out: Dict[str, Optional[float]] = {}
     for k in sorted(set(before) | set(after)):
         b, a = before.get(k, 0.0), after.get(k, 0.0)
-        if b < 0 or a < 0:
-            out[k] = -1.0
+        if b is None or a is None or b < 0 or a < 0:
+            out[k] = None
         else:
             v = a - b
             out[k] = int(v) if v == int(v) else v

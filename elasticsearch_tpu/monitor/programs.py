@@ -1,0 +1,391 @@
+"""Device-program observatory: per-key compile/execute attribution.
+
+Before this module the node knew *that* jit retraces happened
+(tools.tpulint.trace_audit counts them) but not *which* program compiled,
+with *which* padded shapes, or what it cost — the "(program, shapes,
+backend fingerprint)" census ROADMAP #6 (persistent compiled-program
+cache + pre-warming) and #3 (metric-driven shard allocation) both need.
+This registry closes that gap with ONE process-global table of
+:class:`ProgramEntry` rows keyed by
+
+    (program, shapes, backend)
+
+where ``program`` is a stable logical name (a dispatch-point name like
+``mesh_dsl``/``batch_bm25_fused``, or a jitted callable's qualname as
+reported by the trace auditor), ``shapes`` is the canonical padded
+arg-shape/dtype signature (:func:`shape_sig` / :func:`static_sig` — the
+pow2 padding discipline makes this a small, stable universe), and
+``backend`` is :func:`backend_fingerprint` (platform + device kind), so
+a census captured on one chip is never replayed against another.
+
+Two feeds, two granularities:
+
+- **Compiles** arrive from the trace auditor's reporter hook
+  (tracing/retrace.py installs it): every jit (re)trace reports the
+  traced callable's identity and its abstract arg shapes — exact, even
+  for programs no dispatch wrapper knows about. These census-level rows
+  carry compile *counts*; their wall time is attributed below.
+- **Wall time** arrives from :meth:`ProgramRegistry.timed` wrappers at
+  the host dispatch points (parallel/executor.py, search/batch.py fused
+  paths, ops/ivf.py): a call whose per-THREAD trace count moved paid
+  tracing+compilation (``compile_seconds``); a steady call ran a cached
+  program (``calls``/``execute_seconds`` + the PR-7 log-bucket
+  Histogram for p50/p99). The same thread-attribution trick the search
+  profiler uses keeps concurrent requests honest.
+
+A dispatch-level key therefore aggregates the inner jit programs it
+drives: its ``compiles`` counts *calls that paid compilation*, while the
+trace-level rows underneath count each inner program's traces — read
+``_cat/programs`` with that two-level shape in mind.
+
+Cardinality: the key universe is bounded by pow2 padding, but a bug
+(R001 territory) could explode it — past ``_MAX_KEYS`` new keys collapse
+into the reserved ``_other_`` row (monitor/metrics.py's overflow
+discipline: counts are never lost, they lose attribution). The
+``estpu_program_*`` metric families read this registry at scrape time,
+so the same cap bounds the exposition.
+
+Census: while an index's search runs inside :func:`index_scope`, every
+recorded key also lands in that index's (program, shapes, field) census
+set — persisted beside IVF/PQ artifacts via resources/census.py and
+replayable later for pre-warming (ROADMAP #6).
+
+Clock discipline (tpulint R007): durations come from
+``time.perf_counter()`` deltas; ``last_used_at`` is a display-only epoch
+timestamp that never feeds a subtraction.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from elasticsearch_tpu.monitor.metrics import (DEFAULT_LATENCY_BUCKETS,
+                                               OVERFLOW_LABEL, Histogram)
+
+#: the index whose search is currently executing on this logical flow —
+#: set by IndexService.search / the fused batch path so dispatch-point
+#: records can accrue into the per-index census without threading an
+#: index name through every layer
+_ACTIVE_INDEX: contextvars.ContextVar[Optional[str]] = \
+    contextvars.ContextVar("estpu-program-index", default=None)
+
+
+@contextmanager
+def index_scope(index_name: Optional[str]):
+    """Scope ``index_name`` as the census target for program records made
+    below (None = record without census attribution)."""
+    tok = _ACTIVE_INDEX.set(index_name)
+    try:
+        yield
+    finally:
+        _ACTIVE_INDEX.reset(tok)
+
+
+# ---------------------------------------------------------------------------
+# key components
+# ---------------------------------------------------------------------------
+
+def _one_sig(a: Any) -> str:
+    """One argument's shape/dtype signature. Works on np/jax arrays AND
+    abstract tracers (both expose .shape/.dtype); non-array leaves render
+    as their type name so a static python arg still perturbs the key."""
+    shape = getattr(a, "shape", None)
+    dtype = getattr(a, "dtype", None)
+    if shape is not None and dtype is not None:
+        dims = ",".join(str(int(d)) for d in shape)
+        return f"{_short_dtype(str(dtype))}[{dims}]"
+    if isinstance(a, (list, tuple)):
+        return "(" + "+".join(_one_sig(x) for x in a) + ")"
+    if isinstance(a, (bool, int, float, str)):
+        return repr(a)
+    return type(a).__name__
+
+
+_DTYPE_SHORT = {"float32": "f32", "float64": "f64", "bfloat16": "bf16",
+                "float16": "f16", "int32": "i32", "int64": "i64",
+                "int8": "i8", "uint8": "u8", "uint32": "u32", "bool": "b1"}
+
+
+def _short_dtype(name: str) -> str:
+    return _DTYPE_SHORT.get(name, name)
+
+
+def shape_sig(args: Iterable[Any] = (), kwargs: Optional[dict] = None) -> str:
+    """Canonical padded-shape signature of a call's arguments:
+    ``f32[8,1024]|i32[8,16]``. Deterministic in shapes/dtypes only — no
+    object ids, no ordering surprises — so the same query shape produces
+    the same key in every process (the census replay contract)."""
+    parts = [_one_sig(a) for a in args]
+    for k in sorted(kwargs or {}):
+        parts.append(f"{k}={_one_sig(kwargs[k])}")
+    return "|".join(parts)
+
+
+def static_sig(**dims: Any) -> str:
+    """Signature from the static shape-class dims a dispatch point keys
+    its own program cache on (``Q=8|D=1024|k=10``) — equivalent to the
+    padded array shapes but free to compute."""
+    return "|".join(f"{k}={dims[k]}" for k in sorted(dims))
+
+
+_FP_LOCK = threading.Lock()
+_FP: Optional[str] = None
+
+
+def backend_fingerprint() -> str:
+    """``platform/device-kind`` of the default backend (``cpu/cpu`` on
+    the host fallback). Cached after first resolution; ``unknown`` when
+    jax is unavailable — never raises, never blocks a record."""
+    global _FP
+    if _FP is not None:
+        return _FP
+    with _FP_LOCK:
+        if _FP is not None:
+            return _FP
+        try:
+            import jax
+
+            platform = jax.default_backend()
+            kind = getattr(jax.devices()[0], "device_kind", platform)
+            fp = f"{platform}/{kind}".replace(" ", "_")
+        except Exception:
+            return "unknown"  # don't cache: jax may appear later
+        _FP = fp
+        return _FP
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+class ProgramEntry:
+    """Counters for one (program, shapes, backend) key."""
+
+    __slots__ = ("program", "shapes", "backend", "compiles",
+                 "compile_seconds", "calls", "execute_seconds", "hist",
+                 "fields", "last_used_at")
+
+    _FIELD_CAP = 8  # bounded per-entry field set (census attribution)
+
+    def __init__(self, program: str, shapes: str, backend: str):
+        self.program = program
+        self.shapes = shapes
+        self.backend = backend
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.calls = 0
+        self.execute_seconds = 0.0
+        self.hist = Histogram(DEFAULT_LATENCY_BUCKETS)
+        self.fields: Set[str] = set()
+        self.last_used_at = 0.0  # epoch, display only (no subtraction)
+
+    @property
+    def cold(self) -> bool:
+        """True until the key serves its first CACHED execution in this
+        process — a restarted node's whole table starts cold, which is
+        exactly the warmup cliff ROADMAP #6 wants to see and then
+        eliminate. Trace-census rows with no dispatch wrapper stay cold
+        by construction."""
+        return self.calls == 0
+
+    def to_json(self) -> dict:
+        return {
+            "program": self.program,
+            "shapes": self.shapes,
+            "backend": self.backend,
+            "compiles": self.compiles,
+            "compile_seconds": round(self.compile_seconds, 6),
+            "calls": self.calls,
+            "execute_seconds": round(self.execute_seconds, 6),
+            "execute_p50_seconds": round(self.hist.percentile(50), 6),
+            "execute_p99_seconds": round(self.hist.percentile(99), 6),
+            "cold": self.cold,
+            "fields": sorted(self.fields),
+            "last_used_at": self.last_used_at,
+        }
+
+
+class ProgramRegistry:
+    """Thread-safe (program, shapes, backend) → :class:`ProgramEntry`
+    table with per-index census sets. Process-global singleton
+    (:data:`REGISTRY`): the device — and its compiled-program cache —
+    is process-shared, so attribution is too."""
+
+    _MAX_KEYS = 512          # key cap; overflow collapses, never grows
+    _CENSUS_CAP = 1024       # per-index census key cap
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple[str, str, str], ProgramEntry] = {}
+        self._census: Dict[str, Set[Tuple[str, str, str]]] = {}
+
+    # -- entry resolution ----------------------------------------------------
+
+    def _entry(self, program: str, shapes: str,
+               field: Optional[str]) -> ProgramEntry:
+        """Get-or-create under the lock; past the cap the reserved
+        overflow row absorbs new keys (counts survive, attribution
+        doesn't — the metrics.py discipline)."""
+        backend = backend_fingerprint()
+        key = (program, shapes, backend)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                if len(self._entries) >= self._MAX_KEYS:
+                    key = (OVERFLOW_LABEL, OVERFLOW_LABEL, backend)
+                    e = self._entries.get(key)
+                if e is None:
+                    e = ProgramEntry(*key)
+                    self._entries[key] = e
+            if field and len(e.fields) < ProgramEntry._FIELD_CAP:
+                e.fields.add(field)
+            index = _ACTIVE_INDEX.get()
+            if index is not None and key[0] != OVERFLOW_LABEL:
+                c = self._census.setdefault(index, set())
+                if len(c) < self._CENSUS_CAP:
+                    c.add((program, shapes, field or ""))
+        return e
+
+    # -- recording -----------------------------------------------------------
+
+    def record_compile(self, program: str, shapes: str, n: int = 1,
+                       seconds: float = 0.0,
+                       field: Optional[str] = None) -> None:
+        """A (re)trace of ``program`` at ``shapes`` — fed by the trace
+        auditor's reporter for every jit program in the process."""
+        e = self._entry(program, shapes, field)
+        with self._lock:
+            e.compiles += n
+            e.compile_seconds += float(seconds)
+            e.last_used_at = time.time()
+
+    def record_execute(self, program: str, shapes: str, seconds: float,
+                       field: Optional[str] = None) -> None:
+        """A cached-program execution of ``seconds`` wall time."""
+        e = self._entry(program, shapes, field)
+        e.hist.observe(float(seconds))  # own lock; plain host float (R009)
+        with self._lock:
+            e.calls += 1
+            e.execute_seconds += float(seconds)
+            e.last_used_at = time.time()
+
+    def record_call(self, program: str, shapes: str, seconds: float,
+                    trace_delta: int, field: Optional[str] = None) -> None:
+        """One dispatch of ``seconds`` wall time, classified by the
+        caller's per-thread trace delta (``retrace.traces_since``). For
+        call sites that can only decide AFTER the call whether it served
+        a real program (the fused-batch tiers return None on refusal) —
+        :meth:`timed` is the same thing as a context manager.
+
+        ``trace_delta < 0`` means the auditor is unavailable — then the
+        call records NOTHING: classifying blind would file seconds of
+        tracing+compilation as a cached execute (a fake known), the
+        exact -1-sentinel leak the warmup label reports as ``unknown``.
+        Without the auditor the observatory honestly degrades to empty.
+        """
+        if trace_delta < 0:
+            return
+        if trace_delta > 0:
+            self.record_compile(program, shapes, n=1, seconds=seconds,
+                                field=field)
+        else:
+            self.record_execute(program, shapes, seconds, field=field)
+
+    @contextmanager
+    def timed(self, program: str, shapes: str,
+              field: Optional[str] = None):
+        """Time one device dispatch and attribute it: the per-THREAD jit
+        trace count moving inside the block means this call paid
+        tracing+compilation (the profiler's exact trick — a neighbor
+        request's compile on another thread can't misclassify this one).
+        Nothing records when the block raises: a failed dispatch (e.g.
+        the Pallas→XLA retry) must not pollute the execute histogram."""
+        from elasticsearch_tpu.tracing import retrace
+
+        snap = retrace.snapshot()
+        t0 = time.perf_counter()
+        yield
+        self.record_call(program, shapes, time.perf_counter() - t0,
+                         retrace.traces_since(snap), field=field)
+
+    # -- views ---------------------------------------------------------------
+
+    def snapshot(self) -> List[dict]:
+        """Per-key rows, sorted by (program, shapes, backend). Rows are
+        rendered UNDER the registry lock: a concurrent ``_entry()`` adds
+        to ``e.fields`` under the same lock, and an unlocked
+        ``sorted(fields)`` mid-mutation is a RuntimeError that would
+        500 a scrape. (Histogram percentiles take only the histogram's
+        own lock, never the registry lock — no ordering cycle.)"""
+        with self._lock:
+            entries = sorted(self._entries.values(),
+                             key=lambda e: (e.program, e.shapes, e.backend))
+            return [e.to_json() for e in entries]
+
+    def counters_snapshot(self) -> List[Tuple[str, str, str, int, float,
+                                              float]]:
+        """(program, shapes, backend, compiles, compile_seconds,
+        execute_seconds) rows — the cheap view for scrape-time
+        collectors and the bench counter map: no percentile math, one
+        lock acquisition for all three metric families."""
+        with self._lock:
+            return sorted(
+                (e.program, e.shapes, e.backend, e.compiles,
+                 e.compile_seconds, e.execute_seconds)
+                for e in self._entries.values())
+
+    def stats(self) -> dict:
+        """Aggregate totals for the ``programs`` section of
+        ``/_nodes/stats`` (note the two-level counting: dispatch keys
+        aggregate the trace-level programs they drive)."""
+        with self._lock:
+            entries = list(self._entries.values())
+        return {
+            "keys": len(entries),
+            "compiles": sum(e.compiles for e in entries),
+            "compile_seconds": round(
+                sum(e.compile_seconds for e in entries), 6),
+            "calls": sum(e.calls for e in entries),
+            "execute_seconds": round(
+                sum(e.execute_seconds for e in entries), 6),
+        }
+
+    def census(self, index: str) -> List[dict]:
+        """The observed (program, shapes, field) key set for ``index``,
+        sorted — the persistable pre-warm census (resources/census.py)."""
+        with self._lock:
+            keys = sorted(self._census.get(index, ()))
+        return [{"program": p, "shapes": s, "field": f}
+                for p, s, f in keys]
+
+    def census_indices(self) -> List[str]:
+        with self._lock:
+            return sorted(self._census)
+
+    def counter_values(self) -> Dict[str, float]:
+        """Flat per-key counter map for the bench before/after delta
+        (``programs.<program>|<shapes>.{compiles,...}``). Reads the
+        cheap counters view — no percentile math per snapshot."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            base = f"programs.{e.program}|{e.shapes}"
+            out[f"{base}.compiles"] = float(e.compiles)
+            out[f"{base}.compile_seconds"] = float(e.compile_seconds)
+            out[f"{base}.calls"] = float(e.calls)
+            out[f"{base}.execute_seconds"] = float(e.execute_seconds)
+        return out
+
+    def reset(self) -> None:
+        """Test isolation only."""
+        with self._lock:
+            self._entries.clear()
+            self._census.clear()
+
+
+#: the process singleton every feed records into
+REGISTRY = ProgramRegistry()

@@ -192,6 +192,51 @@ class Node:
                     "trace auditor since process start", (),
                     _jit_traces, kind="counter")
 
+        # device-program observatory (monitor/programs.py): per-key
+        # compile/execute attribution. Cardinality is bounded by the
+        # registry's own key cap (pow2 padding keeps the real universe
+        # small; overflow collapses into the reserved _other_ row), so
+        # these scrape-time families inherit the cap. The counters view
+        # skips percentile math — the full snapshot() is for the REST
+        # table, not a 15s-interval scrape — and a short memo lets ONE
+        # registry walk serve all three families of a scrape (the three
+        # collect() calls land within one render; counters may lag a
+        # fraction of a second, which a 15s scrape cannot observe).
+        _prog_memo = {"t": float("-inf"), "rows": ()}
+
+        def _programs():
+            import time as _time
+
+            from elasticsearch_tpu.monitor import programs
+
+            now = _time.monotonic()
+            if now - _prog_memo["t"] > 0.2:
+                _prog_memo["rows"] = programs.REGISTRY.counters_snapshot()
+                _prog_memo["t"] = now
+            return _prog_memo["rows"]
+
+        m.collector("estpu_program_compiles_total",
+                    "jit compiles per (program, shapes, backend) key",
+                    ("program", "shapes", "backend"),
+                    lambda: [((p, s, b), compiles)
+                             for p, s, b, compiles, _cs, _es
+                             in _programs()],
+                    kind="counter")
+        m.collector("estpu_program_compile_seconds",
+                    "Wall seconds spent in calls that paid tracing + "
+                    "compilation, per program key",
+                    ("program", "shapes", "backend"),
+                    lambda: [((p, s, b), cs)
+                             for p, s, b, _c, cs, _es in _programs()],
+                    kind="counter")
+        m.collector("estpu_program_execute_seconds",
+                    "Wall seconds spent executing cached programs, per "
+                    "program key",
+                    ("program", "shapes", "backend"),
+                    lambda: [((p, s, b), es)
+                             for p, s, b, _c, _cs, es in _programs()],
+                    kind="counter")
+
     # -- gateway ---------------------------------------------------------------
 
     def _index_meta_path(self, name: str) -> str:
@@ -857,6 +902,11 @@ class Node:
                     # per-tenant QoS shares (serving/)
                     "serving": self.serving.stats(),
                     "slowlog": aggregate_slowlog(self.indices.values()),
+                    # device-program observatory totals (key count,
+                    # compiles, compile/execute seconds); the per-key
+                    # table lives at /_nodes/_local/xla/programs and
+                    # /_cat/programs (monitor/programs.py)
+                    "programs": self._program_stats(),
                     # TPU-native extra: device kind + HBM usage
                     "accelerator": device_stats(),
                 }
@@ -892,6 +942,12 @@ class Node:
         return resources.RESIDENCY.stats()
 
     @staticmethod
+    def _program_stats() -> dict:
+        from elasticsearch_tpu.monitor import programs
+
+        return programs.REGISTRY.stats()
+
+    @staticmethod
     def _breaker_stats() -> dict:
         """ES-shaped `/_nodes/stats/breaker`: parent + fielddata/request/
         in_flight_requests (+ the accelerator-extra `segments`), real
@@ -924,6 +980,19 @@ class Node:
         for svc in self.indices.values():
             svc.close()
         if self._ivf_dir is not None:
+            # persist each index's observed program-key census into the
+            # durable blob tier BEFORE unregistering it, so the next
+            # process over this data_path can read the exact program
+            # universe this one served (resources/census.py; pre-warm
+            # input for ROADMAP #6)
+            from elasticsearch_tpu.resources import census
+
+            for name in self.indices:
+                try:
+                    census.store_census(name)
+                except Exception:
+                    pass  # census persistence is best-effort: a failed
+                    # write costs the next process a warmup, never a close
             from elasticsearch_tpu.index import ivf_cache
 
             ivf_cache.unregister(self._ivf_dir)

@@ -213,6 +213,8 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
     sf = tail_mode_batch()
     # offbudget: transient per-query upload
     q = jax.device_put(np.asarray(query_np, np.float32))  # tpulint: offbudget
+    from elasticsearch_tpu.monitor.programs import REGISTRY, static_sig
+
     if pq is None and filter_words is None:
         key = (index.C, index.Lmax, D, nprobe, metric, index.metric, sf)
         prog = _PROGRAMS.get(key)
@@ -221,7 +223,11 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
                                    quantizer_metric=index.metric,
                                    scatter_free=sf)
             _PROGRAMS[key] = prog
-        return prog(q, index.centroids, index.lists, vecs)
+        # observatory: kernel-entry dispatch time on the shape-class key
+        with REGISTRY.timed("ivf_search",
+                            static_sig(C=index.C, Lmax=index.Lmax, D=D,
+                                       nprobe=nprobe)):
+            return prog(q, index.centroids, index.lists, vecs)
 
     from elasticsearch_tpu.monitor import kernels
     from elasticsearch_tpu.ops import pallas_kernels as pk
@@ -253,7 +259,14 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
         if use_filter:
             args.append(filter_words)
         try:
-            out = prog(*args)
+            # timed() records nothing when the dispatch raises — the
+            # Pallas→XLA retry must not pollute the execute histogram
+            with REGISTRY.timed(
+                    "ivf_pq_search" if pq is not None else "ivf_search",
+                    static_sig(C=index.C, Lmax=index.Lmax, D=D,
+                               nprobe=nprobe, fk=fk,
+                               filtered=use_filter, tile=tile)):
+                out = prog(*args)
         except Exception as e:
             if tile:
                 pk.note_adc_failure(e)

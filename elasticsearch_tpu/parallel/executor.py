@@ -620,9 +620,18 @@ class MeshSearchExecutor:
 
         prog = _bm25_program(self.mesh, self._programs,
                              Q=Q, T=T, P=Pmax, D=D, k=min(k, D))
-        vals, slot, local, totals = prog(
-            d_doc, d_tfn, put(h_starts), put(h_lens), put(h_ws), put(h_live))
-        slot = np.asarray(slot)
+        from elasticsearch_tpu.monitor.programs import REGISTRY, static_sig
+
+        # program observatory: wall time (dispatch + the host pull below)
+        # lands on the (program, padded shape class, backend) key, split
+        # compile-vs-execute by this thread's trace delta
+        with REGISTRY.timed("mesh_bm25",
+                            static_sig(S=self.S, Q=Q, T=T, P=Pmax, D=D,
+                                       k=min(k, D)), field=field):
+            vals, slot, local, totals = prog(
+                d_doc, d_tfn, put(h_starts), put(h_lens), put(h_ws),
+                put(h_live))
+            slot = np.asarray(slot)
         # slot index → originating shard + its segment ordinal (wrap-aware)
         return (np.asarray(vals), lut_shard[slot], np.asarray(local),
                 lut_ord[slot], np.asarray(totals))
@@ -637,7 +646,8 @@ class MeshSearchExecutor:
             field, queries, k, dims,
             lambda D: _knn_program(self.mesh, self._programs, Q=Q,
                                    dims=dims, D=D, k=min(k, D),
-                                   metric=metric))
+                                   metric=metric),
+            prog_name="mesh_knn")
 
     def search_maxsim(self, field: str, tokens: np.ndarray, k: int = 10,
                       metric: str = "cosine"):
@@ -650,10 +660,12 @@ class MeshSearchExecutor:
             field, tokens, k, dims,
             lambda D: _maxsim_program(self.mesh, self._programs, Q=Q, T=T,
                                       dims=dims, D=D, k=min(k, D),
-                                      metric=metric))
+                                      metric=metric),
+            prog_name="mesh_maxsim")
 
     def _search_vector_rounds(self, field: str, qarr: np.ndarray, k: int,
-                              dims: int, make_prog):
+                              dims: int, make_prog,
+                              prog_name: str = "mesh_knn"):
         """Per-round scaffold shared by the kNN and MaxSim programs:
         slab group build/cache (one upload serves both — the data key is
         program-agnostic), live∧exists mask fill, program dispatch, and
@@ -695,11 +707,20 @@ class MeshSearchExecutor:
                           else np.asarray(vc.exists))
                     h_live[si, : lv.shape[0]] = lv & ex
             prog = make_prog(D)
-            vals, slot, local = prog(
-                # offbudget: transient per-call query/token upload
-                jax.device_put(np.asarray(qarr, np.float32)),  # tpulint: offbudget
-                d_vecs, self._put_sharded(h_live))
-            slot = np.asarray(slot)
+            from elasticsearch_tpu.monitor.programs import (REGISTRY,
+                                                            static_sig)
+
+            with REGISTRY.timed(prog_name,
+                                static_sig(S=self.S, Q=qarr.shape[0],
+                                           T=(qarr.shape[1]
+                                              if qarr.ndim == 3 else 1),
+                                           D=D, dims=dims, k=min(k, D)),
+                                field=field):
+                vals, slot, local = prog(
+                    # offbudget: transient per-call query/token upload
+                    jax.device_put(np.asarray(qarr, np.float32)),  # tpulint: offbudget
+                    d_vecs, self._put_sharded(h_live))
+                slot = np.asarray(slot)
             out = (np.asarray(vals), lut_shard[slot], np.asarray(local),
                    lut_ord[slot], None)
             merged = out if merged is None else _merge_rounds(merged, out, k)
@@ -761,10 +782,17 @@ class MeshSearchExecutor:
             with self._prep_lock:
                 prep = (self._prep.get(prep_key)
                         if prep_key is not None else None)
+            from elasticsearch_tpu.monitor.programs import (
+                REGISTRY as _PROGRAMS, shape_sig as _shape_sig)
+
             if prep is not None:
                 compiled, prog, dev, kk, _refs, _tok = prep
                 try:
-                    out = jax.device_get(prog(*dev))
+                    # observatory: the memo path re-executes a cached
+                    # program — its wall time (dispatch + packed-result
+                    # pull) accrues as execute on the padded-shape key
+                    with _PROGRAMS.timed("mesh_dsl", _shape_sig(dev)):
+                        out = jax.device_get(prog(*dev))
                 except Exception:
                     # drop the entry and fall through to the fresh path,
                     # which carries the scatter-fallback insurance
@@ -878,7 +906,8 @@ class MeshSearchExecutor:
             # each pay a fixed device round-trip (the dominant per-query
             # cost on network-attached chips)
             try:
-                out = jax.device_get(prog(*dev))
+                with _PROGRAMS.timed("mesh_dsl", _shape_sig(dev)):
+                    out = jax.device_get(prog(*dev))
             except Exception:
                 from elasticsearch_tpu.ops.scoring import tail_mode_batch
 
@@ -898,7 +927,8 @@ class MeshSearchExecutor:
                 # replace the cached entry: same-shape queries go straight
                 # to the scatter program instead of re-failing
                 self._programs[(prog_key, pack_spec)] = prog
-                out = jax.device_get(prog(*dev))
+                with _PROGRAMS.timed("mesh_dsl_scatter", _shape_sig(dev)):
+                    out = jax.device_get(prog(*dev))
             if prep_key is not None:
                 from elasticsearch_tpu import resources
                 from elasticsearch_tpu.monitor import kernels
@@ -1009,8 +1039,11 @@ class MeshSearchExecutor:
 
     def psum_partials(self, partials: np.ndarray):
         """partials [S, ...] per-shard numeric agg tensors → summed [...]."""
+        from elasticsearch_tpu.monitor.programs import REGISTRY, shape_sig
+
         prog = _psum_program(self.mesh, self._programs, partials.shape[1:])
-        return np.asarray(prog(self._put_sharded(partials)))
+        with REGISTRY.timed("mesh_psum", shape_sig((partials,))):
+            return np.asarray(prog(self._put_sharded(partials)))
 
 
 def _segments_of(s) -> list:

@@ -1,0 +1,120 @@
+"""Per-index device-program key census: persistence + replay.
+
+The program observatory (monitor/programs.py) learns, per index, exactly
+which (program, shapes, field) keys its traffic exercises — the padded
+shape classes the pow2 discipline bounds. This module persists that set
+through the content-addressed blob cache's durable tier (beside the
+IVF/PQ artifacts, ``<key>.census`` files in every registered data
+directory), so a restarted node can know, before serving a single
+request, the complete program universe its index needs.
+
+That is the pre-warm contract ROADMAP #6 (zero-warmup serving) consumes:
+replay the census against a persistent compiled-program cache and the
+first request after a restart/relocation pays zero compiles. Until that
+cache exists, :func:`replay` already answers the operational question —
+which census keys are warm in the live registry and which would compile
+on first touch — and the acceptance tests use it to prove a served
+key set round-trips exactly.
+
+Format: ``sha1-hex\\n{json}`` — the digest makes corruption (torn write,
+disk bitrot) a *detected* miss: a bad blob is deleted and the caller
+falls back to cold-start, never to a crash or a silently wrong key set.
+The payload carries the backend fingerprint, so a census captured on one
+chip generation is never replayed against another.
+
+Import cost: no jax at import time (resources/ package contract).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import List, Optional
+
+_EXT = "census"
+VERSION = 1
+
+
+def census_key(index_name: str) -> str:
+    """Blob-cache key for an index's census (name-addressed: unlike the
+    IVF/PQ slabs there is no content to address — the census IS the
+    content, validated by its embedded digest)."""
+    return "census_" + hashlib.sha1(index_name.encode("utf-8")).hexdigest()
+
+
+def store_census(index_name: str,
+                 keys: Optional[List[dict]] = None) -> Optional[bytes]:
+    """Persist ``index_name``'s observed key set (default: the live
+    registry's census). Returns the encoded blob, or None when the index
+    has no observed keys (nothing to pre-warm — don't overwrite a
+    previous census with emptiness on an idle restart)."""
+    from elasticsearch_tpu.index import ivf_cache
+    from elasticsearch_tpu.monitor import programs
+
+    if keys is None:
+        keys = programs.REGISTRY.census(index_name)
+    if not keys:
+        return None
+    payload = {
+        "version": VERSION,
+        "index": index_name,
+        "backend": programs.backend_fingerprint(),
+        "keys": keys,
+    }
+    body = json.dumps(payload, sort_keys=True).encode("utf-8")
+    blob = hashlib.sha1(body).hexdigest().encode("ascii") + b"\n" + body
+    ivf_cache.store_blob(census_key(index_name), blob, _EXT)
+    return blob
+
+
+def load_census(index_name: str) -> Optional[dict]:
+    """The persisted census payload for ``index_name`` or None. A
+    corrupt blob (digest mismatch, bad JSON, wrong shape) is deleted and
+    treated as a miss — the observatory re-learns the keys from traffic
+    and the next store replaces it."""
+    from elasticsearch_tpu.index import ivf_cache
+
+    key = census_key(index_name)
+    blob = ivf_cache.load_blob(key, _EXT)
+    if blob is None:
+        return None
+    try:
+        digest, _, body = blob.partition(b"\n")
+        if hashlib.sha1(body).hexdigest().encode("ascii") != digest:
+            raise ValueError("census digest mismatch")
+        payload = json.loads(body)
+        if (payload.get("version") != VERSION
+                or payload.get("index") != index_name
+                or not isinstance(payload.get("keys"), list)):
+            raise ValueError("census payload shape")
+    except Exception:
+        ivf_cache.delete_blob(key, _EXT)
+        return None
+    return payload
+
+
+def replay(index_name: str) -> dict:
+    """Replay the persisted census against the LIVE program registry:
+    which keys are already warm (present in the registry — their
+    programs exist in this process's jit caches) and which are missing
+    (would compile on first touch). ``missing`` is exactly the pre-warm
+    work list ROADMAP #6's compiled-program cache will consume; today it
+    is the restart-cliff report."""
+    from elasticsearch_tpu.monitor import programs
+
+    payload = load_census(index_name)
+    if payload is None:
+        return {"found": False, "index": index_name}
+    live = {(r["program"], r["shapes"])
+            for r in programs.REGISTRY.snapshot()}
+    missing = [k for k in payload["keys"]
+               if (k.get("program"), k.get("shapes")) not in live]
+    fp = programs.backend_fingerprint()
+    return {
+        "found": True,
+        "index": index_name,
+        "backend": payload.get("backend"),
+        "backend_matches": payload.get("backend") == fp,
+        "total": len(payload["keys"]),
+        "warm": len(payload["keys"]) - len(missing),
+        "missing": missing,
+    }

@@ -260,6 +260,10 @@ def _register_all(rc: RestController):
     # registered before the /_nodes/{nodeid}/... patterns so the literal
     # path wins
     add("GET", "/_nodes/_local/trace", _node_trace)
+    # device-program observatory (monitor/programs.py): per-key
+    # compile/execute attribution + per-index census — also before the
+    # /_nodes/{nodeid} patterns so the literal path wins
+    add("GET", "/_nodes/_local/xla/programs", _node_programs)
     # continuous metrics scrape (text exposition format 0.0.4): the node
     # registry + the process-shared families (monitor/metrics.py)
     add("GET", "/_prometheus/metrics", _prometheus_metrics)
@@ -281,6 +285,7 @@ def _register_all(rc: RestController):
     add("GET", "/_cat/recovery", _cat_recovery)
     add("GET", "/_cat/plugins", lambda n, p, b: (200, []))
     add("GET", "/_cat/pending_tasks", _cat_pending_tasks)
+    add("GET", "/_cat/programs", _cat_programs)
     add("GET", "/_cat/thread_pool", _cat_thread_pool)
     add("GET", "/_cat/fielddata", _cat_fielddata)
     add("GET", "/_cat/repositories", lambda n, p, b: (200, [
@@ -2066,6 +2071,47 @@ def _node_trace(n: Node, p, b):
     trace-event format for offline flamegraph inspection (chrome://
     tracing / Perfetto / speedscope)."""
     return 200, n.tracer.chrome_trace()
+
+
+def _node_programs(n: Node, p, b):
+    """GET /_nodes/_local/xla/programs: the device-program observatory —
+    per-(program, shapes, backend) compile counts, compile seconds,
+    cached-execute calls with p50/p99, cold flags, plus the per-index
+    (program, shapes, field) census sets (monitor/programs.py). The
+    registry is process-global (the device is process-shared), hence the
+    _local spelling."""
+    from elasticsearch_tpu.monitor import programs
+
+    reg = programs.REGISTRY
+    return 200, {
+        "backend": programs.backend_fingerprint(),
+        "totals": reg.stats(),
+        "programs": reg.snapshot(),
+        "census": {ix: reg.census(ix) for ix in reg.census_indices()},
+    }
+
+
+def _cat_programs(n: Node, p, b):
+    """GET /_cat/programs: one row per (program, shapes, backend) key —
+    compiles, compile_seconds, cached calls, execute p50/p99, cold flag
+    (never served a cached execute in this process)."""
+    from elasticsearch_tpu.monitor import programs
+
+    rows = [{
+        "program": r["program"],
+        "shapes": r["shapes"],
+        "backend": r["backend"],
+        "compiles": str(r["compiles"]),
+        "compile_seconds": f"{r['compile_seconds']:.3f}",
+        "calls": str(r["calls"]),
+        "execute_p50_ms": f"{r['execute_p50_seconds'] * 1000.0:.2f}",
+        "execute_p99_ms": f"{r['execute_p99_seconds'] * 1000.0:.2f}",
+        "cold": "true" if r["cold"] else "false",
+    } for r in programs.REGISTRY.snapshot()]
+    return 200, _cat_rows(rows, ["program", "shapes", "backend", "compiles",
+                                 "compile_seconds", "calls",
+                                 "execute_p50_ms", "execute_p99_ms",
+                                 "cold"])
 
 
 # -- document handlers --------------------------------------------------------

@@ -65,9 +65,17 @@ def main(argv=None):
         print("shutting down", flush=True)
         if cluster is not None:
             cluster.close()
-        server.stop()
+        # close the node IN the handler, stop the listener from a helper
+        # thread: this handler interrupted serve_forever on THIS thread,
+        # so a same-thread httpd.shutdown() waits forever for the loop it
+        # suspended — the old sequence deadlocked here and node.close()
+        # (translog flush, program-census persistence) never ran
+        import threading
+
+        threading.Thread(target=server.stop, daemon=True).start()
         node.close()
-        sys.exit(0)
+        sys.exit(0)  # unwinds serve_forever; the stopper thread's
+        # server_close then runs against an already-exited loop
 
     signal.signal(signal.SIGINT, _stop)
     signal.signal(signal.SIGTERM, _stop)

@@ -20,8 +20,10 @@ phases that actually decide latency on this engine:
                   span's time, attributed via the attached() contextvar)
 
 ``retraces`` counts the jit traces the request triggered
-(tools.tpulint.trace_audit via tracing/retrace.py); -1 = auditor
-unavailable. Separating compile from execute is the point: BM25S-style
+(tools.tpulint.trace_audit via tracing/retrace.py); null = auditor
+unavailable (``ESTPU_NO_TRACE_AUDIT`` / tools package missing — a typed
+absence, never a sentinel that could leak into arithmetic).
+Separating compile from execute is the point: BM25S-style
 eager scoring (PAPERS.md) makes steady-state ``device_execute`` the
 tuning signal, while a nonzero steady ``device_compile`` means shape
 bucketing is broken (tpulint R001 territory).
@@ -138,7 +140,10 @@ class PhaseTimer:
             # device_execute, so summing phases over-reports
             "query_total_nanos": int(
                 (time.perf_counter() - self._t0) * 1e9),
-            "retraces": -1 if self._unknown_retraces else self.retraces,
+            # null = auditor unavailable (unknown, NOT zero): the typed
+            # absence keeps consumers from mixing a sentinel into sums —
+            # the same convention bench metrics_delta uses
+            "retraces": None if self._unknown_retraces else self.retraces,
             "device_calls": self.device_calls,
             "segments": self.segments,
         }

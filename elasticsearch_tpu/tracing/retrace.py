@@ -14,8 +14,10 @@ codebase binds ``jax.jit`` at import time, so the auditor must patch
 parent packages initialize before their submodules, so the patch lands
 before any ``@jax.jit`` binds, while the ROOT package import stays
 jax-free (a Client-only import pays nothing). ``ESTPU_NO_TRACE_AUDIT=1``
-opts out (then profiles report ``retraces: -1`` = unknown, never a
-fake 0).
+opts out — then profiles report ``retraces: null`` and bench deltas
+``jit_compiles: null`` (unavailable as a typed absence; the in-process
+``traces_since`` sentinel stays -1 for cheap comparisons, but it must
+never leak into a serialized envelope or a sum).
 """
 from __future__ import annotations
 
@@ -42,11 +44,30 @@ def ensure_installed():
             from tools.tpulint import trace_audit
 
             _AUDITOR = trace_audit.install()
+            # device-program observatory feed: every (re)trace reports
+            # the traced callable's identity + abstract arg shapes into
+            # monitor/programs.py, so compiles are attributed to stable
+            # (program, shapes, backend) keys instead of only bumping a
+            # per-thread counter. The `#seq` construction suffix is
+            # stripped: it depends on import order, the qualname does not
+            # (the census's cross-process stability contract).
+            _AUDITOR.set_reporter(_report_trace)
         except Exception:
             # tools/ not importable (installed-package context) or jax
-            # missing: the profiler degrades to retraces=-1 (unknown)
+            # missing: the profiler degrades to retraces unknown
             _AUDITOR = None
         return _AUDITOR
+
+
+def _report_trace(key: str, args: tuple, kwargs: dict) -> None:
+    """Trace-auditor reporter → program registry (lazy import: the
+    registry pulls monitor/metrics, which this module must not load for
+    auditor-less processes)."""
+    from elasticsearch_tpu.monitor import programs
+
+    program = key.rpartition("#")[0] or key
+    programs.REGISTRY.record_compile(program,
+                                     programs.shape_sig(args, kwargs))
 
 
 def auditor():

@@ -594,10 +594,18 @@ class TestBenchDelta:
         assert d["kernels.executor_prep_hit"] == 1
         assert d["kernels.executor_prep_miss"] == 2
 
-    def test_unknown_sentinel_propagates(self):
+    def test_unknown_sentinel_becomes_typed_null(self):
+        # the -1 snapshot sentinel (trace auditor absent) must surface
+        # as None (JSON null) in the delta — unavailable, never a number
+        # a consumer could mix into arithmetic (and never a fake 0)
         d = counters_delta({"jit.traces_total": -1.0},
                            {"jit.traces_total": -1.0})
-        assert d["jit.traces_total"] == -1
+        assert d["jit.traces_total"] is None
+        d = counters_delta({"jit.traces_total": -1.0},
+                           {"jit.traces_total": 5.0})
+        assert d["jit.traces_total"] is None
+        d = counters_delta({"a": None}, {"a": 3.0})
+        assert d["a"] is None
 
     def test_shared_registry_counters_in_snapshot(self):
         SHARED.counter("estpu_test_shared_total", "t").inc(3)

@@ -39,6 +39,13 @@ def test_nodes_stats_shape(node):
     assert nstats["indices"]["segments"]["count"] >= 1
     assert nstats["process"]["mem"]["resident_in_bytes"] > 0
     assert "accelerator" in nstats
+    # device-program observatory totals (monitor/programs.py): the
+    # section always exists; after the search above the process-global
+    # registry holds at least the mesh program's key
+    assert set(nstats["programs"]) == {"keys", "compiles",
+                                       "compile_seconds", "calls",
+                                       "execute_seconds"}
+    assert nstats["programs"]["keys"] >= 1
 
 
 def test_profile_api(node):

@@ -1,0 +1,383 @@
+"""Device-program observatory tests (monitor/programs.py + consumers):
+
+- key anatomy: shape_sig/static_sig determinism, cross-process key
+  stability (same query shape → same key, proven in subprocesses)
+- registry mechanics: compile-vs-execute attribution via the per-thread
+  trace delta, cold flag, cardinality cap overflow
+- census lifecycle: per-index key collection under index_scope, blob
+  round-trip through the content-addressed cache, corrupt-blob miss,
+  replay warm/missing split
+- surfaces: `_cat/programs` columns, `GET /_nodes/_local/xla/programs`,
+  the estpu_program_* families in `/_prometheus/metrics`, the
+  `programs` section of `/_nodes/stats`
+- the warmup latency dimension: a cold-then-warm search pair splits into
+  warmup=true / warmup=false series
+- ISSUE 11 acceptance: a cold node serving ~100 requests keys every
+  executor program with its padded shapes, separates compile from
+  execute per key, persists a census that a "restarted" node reads back
+  exactly — and a second pass over the same traffic compiles nothing new
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from elasticsearch_tpu.monitor import programs
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.resources import census
+from elasticsearch_tpu.rest.server import RestController
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """The registry is process-global (the device is too) — each test
+    starts from an empty table so other tests' programs don't bleed in."""
+    programs.REGISTRY.reset()
+    yield
+    programs.REGISTRY.reset()
+
+
+def _make_node(data_path=None, name="obs", index="obsidx", docs=16):
+    n = Node(name=name, data_path=data_path)
+    n.create_index(index, {
+        "mappings": {"properties": {"t": {"type": "text"}}}})
+    svc = n.indices[index]
+    for i in range(docs):
+        svc.index_doc(str(i), {"t": f"alpha beta gamma delta word{i}"})
+    svc.refresh()
+    return n
+
+
+# -- key anatomy ---------------------------------------------------------------
+
+class TestKeyAnatomy:
+    def test_shape_sig_is_shape_pure(self):
+        import numpy as np
+
+        a = np.zeros((4, 8), np.float32)
+        b = np.ones((4, 8), np.float32)  # different data, same shape
+        assert programs.shape_sig((a,)) == programs.shape_sig((b,))
+        assert programs.shape_sig((a,)) == "f32[4,8]"
+        assert programs.shape_sig((a,), {"k": 10}) == "f32[4,8]|k=10"
+        # order of kwargs never perturbs the key
+        assert programs.shape_sig((), {"b": 1, "a": 2}) == \
+            programs.shape_sig((), {"a": 2, "b": 1})
+
+    def test_static_sig_sorted(self):
+        assert programs.static_sig(Q=8, D=64) == \
+            programs.static_sig(D=64, Q=8) == "D=64|Q=8"
+
+    def test_key_stable_across_processes(self):
+        """Same query shape → same (program, shapes) key in two separate
+        processes: no object ids, no construction-order sequence numbers
+        (the `#seq` suffix is stripped), no dict-order hazards — the
+        property the persisted census depends on."""
+        script = (
+            "import json\n"
+            "from elasticsearch_tpu.tracing import retrace\n"
+            "retrace.ensure_installed()\n"
+            "import jax, jax.numpy as jnp\n"
+            "from elasticsearch_tpu.monitor import programs\n"
+            "@jax.jit\n"
+            "def score(x, y):\n"
+            "    return x @ y\n"
+            "score(jnp.ones((4, 8)), jnp.ones((8, 16)))\n"
+            "keys = sorted((r['program'], r['shapes'])\n"
+            "              for r in programs.REGISTRY.snapshot())\n"
+            "print(json.dumps(keys))\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        outs = []
+        for _ in range(2):
+            p = subprocess.run([sys.executable, "-c", script],
+                               capture_output=True, text=True, env=env,
+                               timeout=120)
+            assert p.returncode == 0, p.stderr[-800:]
+            outs.append(json.loads(p.stdout.strip().splitlines()[-1]))
+        assert outs[0] == outs[1]
+        assert outs[0] == [["score", "f32[4,8]|f32[8,16]"]]
+
+
+# -- registry mechanics --------------------------------------------------------
+
+class TestRegistry:
+    def test_timed_splits_compile_from_execute(self):
+        from elasticsearch_tpu.tracing import retrace
+
+        if retrace.auditor() is None:
+            pytest.skip("trace auditor unavailable")
+        import jax
+        import jax.numpy as jnp
+
+        prog = jax.jit(lambda x: x * 3)
+        reg = programs.ProgramRegistry()
+        with reg.timed("p", "f32[2]"):
+            prog(jnp.ones(2)).block_until_ready()  # first call: traces
+        with reg.timed("p", "f32[2]"):
+            prog(jnp.ones(2)).block_until_ready()  # cached
+        (row,) = [r for r in reg.snapshot() if r["program"] == "p"]
+        assert row["compiles"] == 1 and row["calls"] == 1
+        assert row["compile_seconds"] > 0
+        assert row["execute_seconds"] > 0
+        assert row["compile_seconds"] > row["execute_seconds"]
+        assert not row["cold"]
+
+    def test_timed_records_nothing_on_exception(self):
+        reg = programs.ProgramRegistry()
+        with pytest.raises(RuntimeError):
+            with reg.timed("boom", "f32[1]"):
+                raise RuntimeError("dispatch failed")
+        assert reg.snapshot() == []
+
+    def test_record_call_unknown_delta_records_nothing(self):
+        # trace_delta < 0 = auditor unavailable: classifying blind would
+        # file compile seconds as cached execution (a fake known) — the
+        # observatory degrades to empty instead, like the warmup label's
+        # "unknown" and the profile envelope's null retraces
+        reg = programs.ProgramRegistry()
+        reg.record_call("p", "s", 0.5, trace_delta=-1)
+        assert reg.snapshot() == []
+        reg.record_call("p", "s", 0.5, trace_delta=0)
+        assert reg.stats()["calls"] == 1
+
+    def test_cold_flag_until_first_cached_call(self):
+        reg = programs.ProgramRegistry()
+        reg.record_compile("p", "s")
+        (row,) = reg.snapshot()
+        assert row["cold"]
+        reg.record_execute("p", "s", 0.001)
+        (row,) = reg.snapshot()
+        assert not row["cold"]
+
+    def test_cardinality_cap_collapses_to_overflow(self):
+        from elasticsearch_tpu.monitor.metrics import OVERFLOW_LABEL
+
+        reg = programs.ProgramRegistry()
+        reg._MAX_KEYS = 4
+        for i in range(8):
+            reg.record_execute(f"p{i}", "s", 0.001)
+        rows = reg.snapshot()
+        assert len(rows) == 5  # 4 real keys + the overflow row
+        (other,) = [r for r in rows if r["program"] == OVERFLOW_LABEL]
+        assert other["calls"] == 4  # counts survive, attribution doesn't
+        assert reg.stats()["calls"] == 8
+
+    def test_census_collected_only_inside_index_scope(self):
+        reg = programs.ProgramRegistry()
+        reg.record_execute("out", "s", 0.001)
+        with programs.index_scope("idx"):
+            reg.record_execute("in", "s", 0.001, field="f")
+        assert reg.census_indices() == ["idx"]
+        assert reg.census("idx") == [
+            {"program": "in", "shapes": "s", "field": "f"}]
+
+
+# -- census persistence --------------------------------------------------------
+
+class TestCensusBlobs:
+    def _register_dir(self):
+        from elasticsearch_tpu.index import ivf_cache
+
+        d = tempfile.mkdtemp()
+        ivf_cache.register(d)
+        return d
+
+    def test_round_trip(self):
+        self._register_dir()
+        keys = [{"program": "mesh_dsl", "shapes": "f32[8,64]", "field": "t"},
+                {"program": "bm25_score_segment", "shapes": "i32[32]",
+                 "field": "t"}]
+        blob = census.store_census("rt_idx", keys)
+        assert blob is not None
+        payload = census.load_census("rt_idx")
+        assert payload["keys"] == keys
+        assert payload["index"] == "rt_idx"
+        assert payload["backend"] == programs.backend_fingerprint()
+
+    def test_empty_census_not_persisted(self):
+        self._register_dir()
+        assert census.store_census("idle_idx", []) is None
+        assert census.load_census("idle_idx") is None
+
+    def test_corrupt_blob_is_deleted_miss(self):
+        from elasticsearch_tpu.index import ivf_cache
+
+        d = self._register_dir()
+        census.store_census("c_idx", [{"program": "p", "shapes": "s",
+                                       "field": ""}])
+        path = os.path.join(
+            d, f"{census.census_key('c_idx')}.census")
+        assert os.path.exists(path)
+        with open(path, "wb") as fh:
+            fh.write(b"deadbeef\n{not json")
+        # drop the memory tier so the corrupted DISK copy is what loads
+        ivf_cache.reset()
+        ivf_cache.register(d)
+        assert census.load_census("c_idx") is None
+        assert not os.path.exists(path)  # corrupt blob removed
+        # and the miss is clean: a rebuild stores fresh
+        census.store_census("c_idx", [{"program": "p2", "shapes": "s",
+                                       "field": ""}])
+        assert census.load_census("c_idx")["keys"][0]["program"] == "p2"
+
+    def test_replay_reports_missing_after_registry_loss(self):
+        self._register_dir()
+        with programs.index_scope("rp_idx"):
+            programs.REGISTRY.record_execute("mesh_dsl", "f32[4]", 0.001)
+        census.store_census("rp_idx")
+        rep = census.replay("rp_idx")
+        assert rep["found"] and rep["warm"] == 1 and not rep["missing"]
+        # a fresh process (empty registry) sees the whole census cold —
+        # exactly the restart cliff ROADMAP #6 will pre-warm away
+        programs.REGISTRY.reset()
+        rep = census.replay("rp_idx")
+        assert rep["warm"] == 0
+        assert rep["missing"] == [{"program": "mesh_dsl",
+                                   "shapes": "f32[4]", "field": ""}]
+
+
+# -- surfaces ------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_cat_programs_columns_and_nodes_endpoint(self):
+        n = _make_node()
+        try:
+            for _ in range(3):
+                n.search("obsidx", {"query": {"match": {"t": "alpha"}}})
+            rc = RestController(n)
+            status, rows = rc.dispatch("GET", "/_cat/programs", {}, b"")
+            assert status == 200 and rows
+            cols = ["program", "shapes", "backend", "compiles",
+                    "compile_seconds", "calls", "execute_p50_ms",
+                    "execute_p99_ms", "cold"]
+            assert rows.default == cols
+            for r in rows:
+                assert set(cols) <= set(r)
+            mesh = [r for r in rows if r["program"] == "mesh_dsl"]
+            assert mesh and any(r["cold"] == "false" for r in mesh)
+            status, out = rc.dispatch(
+                "GET", "/_nodes/_local/xla/programs", {}, b"")
+            assert status == 200
+            assert out["totals"]["keys"] == len(rows)
+            assert out["backend"] == programs.backend_fingerprint()
+            assert "obsidx" in out["census"]
+            assert any(k["program"] == "mesh_dsl"
+                       for k in out["census"]["obsidx"])
+        finally:
+            n.close()
+
+    def test_prometheus_families_present(self):
+        n = _make_node(index="promidx")
+        try:
+            n.search("promidx", {"query": {"match": {"t": "beta"}}})
+            expo = n.metrics.expose()
+            for fam in ("estpu_program_compiles_total",
+                        "estpu_program_compile_seconds",
+                        "estpu_program_execute_seconds"):
+                assert f"# TYPE {fam} counter" in expo
+                assert f'{fam}{{program="' in expo
+            # the search latency family carries the warmup dimension
+            assert 'estpu_search_duration_seconds_count{index="promidx"' \
+                in expo
+        finally:
+            n.close()
+
+    def test_nodes_stats_programs_section(self):
+        n = _make_node(index="statsidx")
+        try:
+            n.search("statsidx", {"query": {"match": {"t": "gamma"}}})
+            sec = n.nodes_stats()["nodes"][n.node_id]["programs"]
+            assert sec["keys"] >= 1
+            assert sec["compiles"] >= 1
+            assert sec["compile_seconds"] >= 0
+            assert sec["calls"] >= 0
+        finally:
+            n.close()
+
+    def test_warmup_label_splits_cold_from_warm(self):
+        n = _make_node(index="warmidx")
+        try:
+            body = {"query": {"match": {"t": "alpha beta"}}}
+            n.search("warmidx", body)   # cold: pays the compile
+            n.search("warmidx", body)   # warm: cached program
+            n.search("warmidx", body)
+            rows = n.metrics.summaries()["estpu_search_duration_seconds"]
+            by_warm = {r["labels"]["warmup"]: r for r in rows
+                       if r["labels"]["index"] == "warmidx"}
+            assert by_warm["true"]["count"] >= 1
+            assert by_warm["false"]["count"] >= 2
+            # cold-start latency is separable — and on a compile, larger
+            assert by_warm["true"]["max_seconds"] > \
+                by_warm["false"]["p50_seconds"]
+        finally:
+            n.close()
+
+
+# -- ISSUE 11 acceptance -------------------------------------------------------
+
+class TestColdNodeAcceptance:
+    def test_cold_node_100_requests_census_and_zero_recompile_second_pass(
+            self, tmp_path):
+        from elasticsearch_tpu.tracing import retrace
+
+        if retrace.auditor() is None:
+            pytest.skip("trace auditor unavailable")
+        data = str(tmp_path / "data")
+        n = _make_node(data_path=data, index="accidx", docs=24)
+        # 100 requests over a few padded shape classes (1/2/3-term
+        # queries, two k values)
+        bodies = []
+        terms = ["alpha", "alpha beta", "alpha beta gamma"]
+        for i in range(100):
+            bodies.append({"query": {"match": {"t": terms[i % 3]}},
+                           "size": 5 + 5 * (i % 2)})
+        for b in bodies:
+            r = n.search("accidx", b)
+            assert r["hits"]["total"] > 0
+        # (a) every executor program keyed with its padded shapes
+        rc = RestController(n)
+        _, rows = rc.dispatch("GET", "/_cat/programs", {}, b"")
+        mesh = [r for r in rows if r["program"] == "mesh_dsl"]
+        assert mesh, "executor programs must be keyed"
+        assert all("[" in r["shapes"] for r in mesh)  # padded dims
+        # (b) compile separated from execute per key
+        for r in mesh:
+            assert int(r["compiles"]) >= 1
+            assert float(r["compile_seconds"]) > 0
+            assert int(r["calls"]) >= 1
+            assert float(r["execute_p50_ms"]) >= 0
+            assert r["cold"] == "false"
+        # warmup latency label: cold requests separable from warm ones
+        lat = {r["labels"]["warmup"]: r["count"]
+               for r in n.metrics.summaries()[
+                   "estpu_search_duration_seconds"]
+               if r["labels"]["index"] == "accidx"}
+        assert lat.get("true", 0) >= 1
+        assert lat.get("false", 0) > 90  # steady state dominates
+        assert lat.get("true", 0) + lat.get("false", 0) == 100
+        # second pass over the SAME traffic: zero new compiles anywhere
+        stats_before = programs.REGISTRY.stats()
+        total_before = retrace.auditor().total()
+        for b in bodies:
+            n.search("accidx", b)
+        assert retrace.auditor().total() == total_before
+        stats_after = programs.REGISTRY.stats()
+        assert stats_after["compiles"] == stats_before["compiles"]
+        assert stats_after["calls"] > stats_before["calls"]
+        # (c) census persisted on close, read back exactly by a
+        # "restarted" node over the same data_path
+        expected = programs.REGISTRY.census("accidx")
+        assert expected
+        n.close()
+        n2 = Node(name="obs2", data_path=data)
+        try:
+            payload = census.load_census("accidx")
+            assert payload is not None
+            assert payload["keys"] == expected  # the exact key set
+            rep = census.replay("accidx")
+            assert rep["found"] and rep["backend_matches"]
+            assert rep["total"] == len(expected)
+        finally:
+            n2.close()

@@ -18,11 +18,15 @@ from tools.tpulint.baseline import filter_baselined, load_baseline
 def lint(src: str, *, hot: bool = False, locked: bool = False,
          ops: bool = False, swallow: bool = False, timing: bool = False,
          budget: bool = False, blocking: bool = False,
-         threads: bool = False,
+         threads: bool = False, audit: bool = False,
          path: str = "elasticsearch_tpu/x/mod.py"):
+    # every scope flag is opt-in for fixtures (audit included: the
+    # default fixture path would otherwise drag R012 into every
+    # unrelated fixture that binds jit at its top level)
     return lint_source(textwrap.dedent(src), path, hot=hot, ops=ops,
                        locked=locked, swallow=swallow, timing=timing,
-                       budget=budget, blocking=blocking, threads=threads)
+                       budget=budget, blocking=blocking, threads=threads,
+                       audit=audit)
 
 
 def rules_of(violations):
@@ -1050,6 +1054,105 @@ class TestR011:
             "elasticsearch_tpu/cluster/bootstrap.py"))
         assert not any(v.rule == "R011" for v in lint_source(
             textwrap.dedent(src), "elasticsearch_tpu/index/engine.py"))
+
+
+class TestR012:
+    """Import-time jax.jit bindings outside the trace-audited packages:
+    a program bound before tracing/retrace installs the auditor escapes
+    compile attribution (the device-program observatory's census and the
+    profiler's compile/execute split both under-report). ops/, models/
+    and parallel/ install the auditor in their package __init__ before
+    any submodule binds, so bindings there are exempt."""
+
+    BAD = """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("k",))
+        def score(x, *, k):
+            return x * k
+    """
+
+    def test_bad_toplevel_decorator(self):
+        vs = lint(self.BAD, audit=True)
+        assert rules_of(vs) == ["R012"]
+        assert "escapes compile attribution" in vs[0].message
+
+    def test_bad_module_level_assignment(self):
+        vs = lint("""
+            import jax
+
+            prog = jax.jit(lambda x: x + 1)
+        """, audit=True)
+        assert rules_of(vs) == ["R012"]
+
+    def test_bad_jitted_method_of_toplevel_class(self):
+        vs = lint("""
+            import jax
+
+            class Scorer:
+                @jax.jit
+                def run(self, x):
+                    return x
+        """, audit=True)
+        assert rules_of(vs) == ["R012"]
+
+    def test_bad_guarded_and_annotated_bindings_still_flag(self):
+        # module-level if/try/with and AnnAssign all EXECUTE at import —
+        # a guard around the binding doesn't defer it (only a def does)
+        vs = lint("""
+            import jax
+
+            try:
+                prog = jax.jit(lambda x: x + 1)
+            except Exception:
+                prog = None
+
+            if True:
+                @jax.jit
+                def score(x):
+                    return x
+
+            other: object = jax.jit(lambda x: x - 1)
+        """, audit=True)
+        assert [v.rule for v in vs] == ["R012", "R012", "R012"]
+
+    def test_good_factory_binding(self):
+        # the blessed shape: bind at first call, long after install
+        vs = lint("""
+            import jax
+
+            def make_program(k):
+                @jax.jit
+                def score(x):
+                    return x * k
+                return score
+        """, audit=True)
+        assert vs == []
+
+    def test_scope_audited_packages_exempt(self):
+        src = textwrap.dedent(self.BAD)
+        assert any(v.rule == "R012" for v in lint_source(
+            src, "elasticsearch_tpu/search/queries.py"))
+        assert any(v.rule == "R012" for v in lint_source(
+            src, "elasticsearch_tpu/index/segment.py"))
+        for exempt in ("elasticsearch_tpu/ops/scoring.py",
+                       "elasticsearch_tpu/models/dual_encoder.py",
+                       "elasticsearch_tpu/parallel/executor.py"):
+            assert not any(v.rule == "R012"
+                           for v in lint_source(src, exempt)), exempt
+        # measurement code outside the product package is out of scope
+        assert not any(v.rule == "R012"
+                       for v in lint_source(src, "bench.py"))
+
+    def test_allow_suppression(self):
+        vs = lint("""
+            import jax
+
+            # tpulint: allow[R012] — bound under an install-order test
+            prog = jax.jit(lambda x: x + 1)
+        """, audit=True)
+        assert vs == []
 
 
 class TestPqTierFixtures:

@@ -38,6 +38,11 @@ RULES: Dict[str, str] = {
             "or with a loop not gated on a stop Event (the _fault_loop "
             "pattern) — an ungated control-plane thread outlives close() "
             "and keeps publishing/probing a dead cluster",
+    "R012": "import-time jax.jit binding outside the trace-audited "
+            "packages (ops/, models/, parallel/) — the program can "
+            "compile before tracing/retrace installs the auditor and "
+            "escapes compile attribution (observatory census + profiler "
+            "compile/execute split under-report)",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.
@@ -80,6 +85,16 @@ BLOCKING_PATH_MARKERS = ("/serving/",)
 # and keeps probing/publishing a torn-down cluster, wedging test
 # teardown and process exit.
 THREADS_PATH_MARKERS = ("/cluster/",)
+# R012 scope: the product package MINUS the packages whose __init__
+# installs the trace auditor before their submodules bind jax.jit
+# (tracing/retrace.py install-order contract). An import-time binding
+# anywhere else races the install point: imported early (a Client-only
+# path, a test importing one module), its programs compile uncounted and
+# the observatory's compile attribution silently under-reports.
+AUDIT_PATH_MARKERS = ("/elasticsearch_tpu/",)
+AUDIT_EXEMPT_MARKERS = ("/elasticsearch_tpu/ops/",
+                        "/elasticsearch_tpu/models/",
+                        "/elasticsearch_tpu/parallel/")
 
 _ALLOW_RE = re.compile(r"#\s*tpulint:\s*allow\[\s*([A-Z0-9,\s]+?)\s*\]")
 _HOST_RE = re.compile(r"#\s*tpulint:\s*host\b")
@@ -166,11 +181,12 @@ def lint_source(
     budget: Optional[bool] = None,
     blocking: Optional[bool] = None,
     threads: Optional[bool] = None,
+    audit: Optional[bool] = None,
 ) -> List[Violation]:
     """Lint one source string. ``hot``/``ops``/``locked``/``swallow``/
-    ``timing``/``budget``/``blocking``/``threads`` override the
-    path-based scoping (fixture tests use these; production runs infer
-    from the path)."""
+    ``timing``/``budget``/``blocking``/``threads``/``audit`` override
+    the path-based scoping (fixture tests use these; production runs
+    infer from the path)."""
     from tools.tpulint import rules as _rules
 
     tree = ast.parse(source, filename=path)
@@ -193,6 +209,9 @@ def lint_source(
                   if blocking is None else blocking),
         threads=(_matches(path, THREADS_PATH_MARKERS)
                  if threads is None else threads),
+        audit=((_matches(path, AUDIT_PATH_MARKERS)
+                and not _matches(path, AUDIT_EXEMPT_MARKERS))
+               if audit is None else audit),
         host_lines=supp.host,
     )
     found = _rules.check_module(tree, ctx)

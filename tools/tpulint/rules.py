@@ -1,4 +1,4 @@
-"""tpulint rule visitors (R001–R011).
+"""tpulint rule visitors (R001–R012).
 
 One recursive walk per file carries the context every rule needs: the
 loop stack (R001/R002), the traced-function stack with its static/traced
@@ -39,6 +39,8 @@ class FileContext:
     budget: bool = False   # R008 applies (product package, not resources/)
     blocking: bool = False  # R010 applies (serving/ modules)
     threads: bool = False  # R011 applies (cluster/ modules)
+    audit: bool = False    # R012 applies (product modules outside the
+    #                        trace-audited packages)
     host_lines: Set[int] = field(default_factory=set)
 
 
@@ -1022,8 +1024,63 @@ class _Checker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _check_import_time_jit(tree: ast.Module, ctx: FileContext,
+                           mod: _ModuleInfo, out: List[Violation]) -> None:
+    """R012: an import-time ``jax.jit`` binding (a jit decorator on a
+    top-level function/method, or a module-level ``x = jax.jit(...)``
+    assignment) in a module OUTSIDE the trace-audited packages compiles
+    its program whenever the module happens to be imported before the
+    auditor's install point — the program then escapes compile
+    attribution (the observatory's census and the profiler's
+    compile/execute split both under-report). The audited packages
+    (``ops/``, ``models/``, ``parallel/``) call
+    ``tracing/retrace.ensure_installed()`` in their ``__init__`` before
+    any submodule binds, so bindings there are covered regardless of
+    import order; everywhere else the binding must move into a factory
+    function (bound at first call, long after install) or into an
+    audited package."""
+    if not ctx.audit:
+        return
+
+    def _emit(node: ast.AST, what: str) -> None:
+        out.append(Violation(
+            "R012", ctx.path, node.lineno, node.col_offset,
+            f"import-time jax.jit binding ({what}) outside the "
+            "trace-audited packages (ops/, models/, parallel/) — the "
+            "program can compile before tracing/retrace installs the "
+            "auditor and escapes compile attribution; bind inside a "
+            "factory function or move the module under an audited "
+            "package", snippet_at(ctx.lines, node.lineno)))
+
+    def _check_stmts(stmts) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if mod.decorator_jit(stmt) is not None:
+                    _emit(stmt, f"decorator on `{stmt.name}`")
+            elif isinstance(stmt, ast.ClassDef):
+                # class bodies execute at import too — a jitted method
+                # binds exactly like a top-level function
+                _check_stmts(stmt.body)
+            elif isinstance(stmt, (ast.Assign, ast.AnnAssign)) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    mod.is_jit_expr(stmt.value):
+                _emit(stmt, "module-level assignment")
+            elif isinstance(stmt, (ast.If, ast.For, ast.While, ast.With,
+                                   ast.Try)):
+                # module-level control flow still executes at import —
+                # `if HAS_JAX:` / `try:` guards around a binding don't
+                # defer it (only a def does)
+                for attr in ("body", "orelse", "finalbody"):
+                    _check_stmts(getattr(stmt, attr, ()) or ())
+                for h in getattr(stmt, "handlers", ()) or ():
+                    _check_stmts(h.body)
+
+    _check_stmts(tree.body)
+
+
 def check_module(tree: ast.Module, ctx: FileContext) -> List[Violation]:
     mod = _ModuleInfo(tree)
     checker = _Checker(ctx, mod)
     checker.visit(tree)
+    _check_import_time_jit(tree, ctx, mod, checker.out)
     return checker.out

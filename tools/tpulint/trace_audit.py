@@ -42,6 +42,13 @@ class TraceAuditor:
         self.max_traces = max_traces
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {}
+        # optional per-trace observer: called OUTSIDE the lock as
+        # reporter(key, args, kwargs) with the traced call's abstract
+        # arguments, so a registry can attribute the compile to a
+        # (program, shapes) key (elasticsearch_tpu tracing/retrace.py
+        # wires this into the device-program observatory). A reporter
+        # failure must never break tracing — exceptions are swallowed.
+        self._reporter = None
         # per-thread totals: tracing runs synchronously on the calling
         # thread, so this attributes each trace to the request that paid
         # it — the profiler's compile/execute split reads it to stay
@@ -58,7 +65,12 @@ class TraceAuditor:
 
     _THREAD_CAP = 512
 
-    def _record(self, key: str) -> None:
+    def set_reporter(self, fn) -> None:
+        """Install the per-trace observer (None to remove)."""
+        self._reporter = fn
+
+    def _record(self, key: str, args: tuple = (),
+                kwargs: Optional[dict] = None) -> None:
         tid = threading.get_ident()
         with self._lock:
             n = self._counts.get(key, 0) + 1
@@ -67,6 +79,12 @@ class TraceAuditor:
             self._thread_counts.move_to_end(tid)
             while len(self._thread_counts) > self._THREAD_CAP:
                 self._thread_counts.popitem(last=False)
+        rep = self._reporter
+        if rep is not None:
+            try:
+                rep(key, args, kwargs or {})
+            except Exception:
+                pass  # observability must never fail the traced program
         if self.max_traces is not None and n > self.max_traces:
             raise TraceBudgetExceeded(
                 f"jitted `{key}` traced {n} times "
@@ -128,8 +146,10 @@ def _counting_jit(orig_jit):
 
         @functools.wraps(fun)
         def counted(*args, **kw):
+            # args are abstract values here (the body runs under trace):
+            # reporters read only .shape/.dtype, never concrete data
             for auditor in list(_active):
-                auditor._record(key)
+                auditor._record(key, args, kw)
             return fun(*args, **kw)
 
         return orig_jit(counted, **kwargs)
