@@ -214,6 +214,19 @@ class MultiHostCluster:
             # state's MetaData on all master-eligible nodes)
             self._meta_path = os.path.join(node.data_path, "_cluster",
                                            "dist_indices.json")
+            # the Raft durable pair (cluster term + last granted ballot)
+            # lives in its OWN small fsynced file: the election path must
+            # be durable BEFORE every vote reply, and rewriting the full
+            # dist-meta blob (all index metadata) per ballot made each
+            # vote cost a metadata-sized write (PR 10's recorded
+            # follow-up). The blob still snapshots the pair on its own
+            # writes; ballot.json outranks on load when newer.
+            self._ballot_path = os.path.join(node.data_path, "_cluster",
+                                             "ballot.json")
+            # serializes read-pair-then-write: two concurrent grants
+            # racing unserialized could land the STALER pair last on
+            # disk (a leaf lock — never held while acquiring others)
+            self._ballot_lock = threading.Lock()
             # EVERY rank loads (not just the bootstrap master): a
             # non-rank-0 survivor advertises its disk copy's freshness on
             # vote replies AND on its join request, so both metadata
@@ -221,8 +234,13 @@ class MultiHostCluster:
             # from whichever disk held the freshest committed copy —
             # persisting on all ranks would otherwise be write-only
             self._load_dist_meta()
+            # after the blob: a voter can have granted ballots before any
+            # metadata ever existed, and a newer ballot must outrank the
+            # blob's last snapshot of the pair
+            self._load_ballot()
         else:
             self._meta_path = None
+            self._ballot_path = None
         from elasticsearch_tpu.cluster.search_action import \
             DistributedDataService
 
@@ -568,8 +586,9 @@ class MultiHostCluster:
         if granted:
             # the ballot is durable BEFORE the reply (Raft's votedFor
             # fsync): a voter that bounces after granting must not grant
-            # the same term to a second candidate
-            self._persist_membership()
+            # the same term to a second candidate. Only the small
+            # ballot.json is written — not the full dist-meta blob.
+            self._persist_ballot()
         # the voter's identity rides the grant: the winner must admit its
         # electorate to the view BEFORE the takeover publish, or that
         # publish reaches nobody and the new master immediately steps
@@ -638,7 +657,7 @@ class MultiHostCluster:
             # voter, and bounces before persisting could otherwise grant
             # its own term to the next candidate — two winners of one
             # term
-            self._persist_membership()
+            self._persist_ballot()
             try:
                 return self._run_campaign(term)
             finally:
@@ -818,7 +837,9 @@ class MultiHostCluster:
             state.term = term
             self._pending_publish = payload
         if newer:
-            self._persist_membership()  # the adopted term is durable
+            self._persist_ballot()  # the adopted term is durable (the
+            # pair's small file — a term adoption is an election-path
+            # write too)
             if self.is_master:
                 # a newer master exists: resign after parking its state
                 self.step_down(f"saw publication with newer term {term}")
@@ -1056,6 +1077,56 @@ class MultiHostCluster:
             # never saw (the master already stepped down)
             raise FailedToCommitClusterStateException(
                 "cluster state publish failed to gather a quorum of acks")
+
+    def _persist_ballot(self) -> None:
+        """Durably persist the Raft pair — cluster term + last granted
+        ballot — as a SMALL standalone file, fsynced before the caller
+        replies to the candidate (Raft's votedFor fsync). This bounds the
+        election-path write: the full dist-meta blob (every index's
+        metadata) is no longer rewritten per ballot."""
+        if not self._ballot_path:
+            return
+        import json as _json
+
+        # read AND write under one lock: the vote book/term only grow,
+        # so the last writer always persists the freshest pair — two
+        # unserialized grants could otherwise land the staler pair last
+        # (re-arming too little after a bounce = one term, two masters)
+        with self._ballot_lock:
+            vt, vf = self._votes.last_vote()
+            raw = _json.dumps({"cluster_term": self.node.cluster_state.term,
+                               "voted_term": vt, "voted_for": vf})
+            try:
+                os.makedirs(os.path.dirname(self._ballot_path),
+                            exist_ok=True)
+                tmp = (f"{self._ballot_path}.{os.getpid()}."
+                       f"{threading.get_ident()}.tmp")
+                with open(tmp, "w") as f:
+                    f.write(raw)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._ballot_path)
+            except OSError:
+                # can't be durable — the grant already happened in
+                # memory; the blob's next full write still snapshots it
+                pass
+
+    def _load_ballot(self) -> None:
+        """Ballot.json outranks the blob's snapshot of the pair when
+        newer (the blob only refreshes it on full metadata writes)."""
+        if not self._ballot_path:
+            return
+        try:
+            with open(self._ballot_path) as f:
+                import json as _json
+
+                blob = _json.load(f)
+        except (OSError, ValueError):
+            return
+        state = self.node.cluster_state
+        state.term = max(state.term, int(blob.get("cluster_term", 0)))
+        self._votes.seed(int(blob.get("voted_term", 0)),
+                         blob.get("voted_for") or "")
 
     def _persist_dist_meta(self) -> None:
         """Write the metadata atomically; ALWAYS called under

@@ -37,7 +37,15 @@ from elasticsearch_tpu.utils.faults import FAULTS
 
 
 class ReplicationGroup:
-    """One shard's copies: a primary plus N replicas."""
+    """One shard's copies: a primary plus N replicas.
+
+    Lock order: ``ReplicationGroup._lock`` is OUTERMOST for a
+    replicated write — under it we enter the primary/replica engines
+    (``Engine._lock`` → ``Translog._lock``) and the checkpoint tracker
+    (``GlobalCheckpointTracker._lock``). tpulint R013's interprocedural
+    lock graph verifies the whole chain acyclic; never report back into
+    the group from under an engine lock.
+    """
 
     def __init__(self, shard_id: int, primary, replicas: Optional[list] = None,
                  on_replica_failure: Optional[Callable] = None):

@@ -81,6 +81,17 @@ class EngineStats:
 
 
 class Engine:
+    """In-memory Lucene-equivalent: buffer → frozen TpuSegments, doc
+    identity, versioning, translog durability.
+
+    Lock order (verified acyclic by tpulint R013's interprocedural lock
+    graph — keep it that way): ``Engine._lock`` is the OUTERMOST lock of
+    the write path; under it we take ``Translog._lock`` (appends/fsync),
+    ``LocalCheckpointTracker._lock`` (seqno advance), and the
+    process-shared metrics/native locks. Nothing below may call back
+    into an Engine public method while holding its own lock.
+    """
+
     def __init__(
         self,
         mappings: Mappings,

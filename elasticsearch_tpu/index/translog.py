@@ -12,6 +12,11 @@ BufferedChecksumStreamOutput (java.util.zip.CRC32): a torn or bit-rotted
 tail is DETECTED, not silently half-parsed. Replay verifies every frame
 and stops at the first bad one. Legacy v1 JSON-lines generations are still
 readable (format auto-detected per file).
+
+Lock order: ``Translog._lock`` sits BELOW ``Engine._lock`` (the engine
+appends under its own lock) and above only the process-shared
+native/metrics locks — the position tpulint R013's interprocedural lock
+graph verifies acyclic; never call back into the engine from under it.
 """
 from __future__ import annotations
 

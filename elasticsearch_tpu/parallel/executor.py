@@ -17,6 +17,15 @@ merge with ``psum``. All collectives ride ICI; nothing goes through a host.
 Programs are cached per shape-class (S shards × Q queries × T term-chunks ×
 P postings window × D docs × k), mirroring how one Lucene Weight tree
 serves many queries of the same structure.
+
+COLLECTIVE PURITY (tpulint R014): every ``body`` below — and every
+helper it calls, at any depth — runs SPMD on all mesh slots; one host
+sync (``device_get``/``.item()``/``np.asarray`` of a traced value)
+inside that region stalls every chip at the next psum/all_gather. The
+whole-program analyzer marks everything reachable from a
+``wrap(body, ...)`` call as collective and gates the repo on zero
+violations — keep host work (device_put, result pulls, the pack_spec
+construction) OUTSIDE the bodies, as the code below does.
 """
 from __future__ import annotations
 

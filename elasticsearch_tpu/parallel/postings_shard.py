@@ -23,6 +23,8 @@ Design — term-range decomposition with additive merge:
 - Query time: terms are routed to their owning device host-side
   (vocab → term id → range), producing [S, Tb] chunk tables; one
   shard_map over a ('pshard',) mesh computes partials and psums them.
+  The program body is a COLLECTIVE region (tpulint R014): host syncs
+  anywhere in its call reach stall every device — keep them out.
 
 Interplay with the mesh product path: a segment big enough to split
 cannot be stacked into the [S, ...] per-shard arrays the mesh executor

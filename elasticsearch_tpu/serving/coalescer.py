@@ -10,6 +10,12 @@ queue keyed by ``(index, query-shape bucket)``; a drain thread flushes
 the bucket as ONE fused batch (``execute_batch``) and fans each
 request's top-k back to its parked thread.
 
+Blocking discipline: tpulint R010 forbids unbounded waits while holding
+a lock in this package, and R013 generalizes the same hazard — plus
+lock-order cycle detection — to every module interprocedurally; waits
+here are timeout-bounded and parking happens OUTSIDE the coalescer
+lock.
+
 Drain policy (adaptive):
 
 - **solo bypass** — when no other eligible search is in flight and no

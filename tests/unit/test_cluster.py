@@ -781,6 +781,60 @@ def test_anti_entropy_heals_follower_that_missed_a_publish():
             n.close()
 
 
+def test_ballot_split_bounds_election_write(tmp_path):
+    """The Raft durable pair lives in its OWN small fsynced ballot.json
+    (PR 10's recorded follow-up): a vote grant must not rewrite the full
+    dist-meta blob, and the ballot alone — no blob at all — must carry
+    the pair across a restart."""
+    import json
+    import os
+
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    port = _free_port()
+    node0 = Node(name="rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rank1", data_path=str(tmp_path / "d1"))
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    blob = tmp_path / "d1" / "_cluster" / "dist_indices.json"
+    ballot = tmp_path / "d1" / "_cluster" / "ballot.json"
+    c1b = None
+    node1b = None
+    try:
+        blob_before = blob.read_bytes() if blob.exists() else None
+        assert c1._on_request_vote(
+            {"term": 7, "candidate": "9999-x"})["granted"]
+        doc = json.loads(ballot.read_text())
+        assert doc["voted_term"] == 7 and doc["voted_for"] == "9999-x"
+        assert doc["cluster_term"] == c1.node.cluster_state.term
+        # the election-path write is BOUNDED: the full metadata blob was
+        # not rewritten for the ballot
+        blob_after = blob.read_bytes() if blob.exists() else None
+        assert blob_after == blob_before
+        c1.close()
+        node1.close()
+        if blob.exists():
+            os.unlink(blob)  # ballot.json alone must carry the pair
+        node1b = Node(name="rank1b", data_path=str(tmp_path / "d1"))
+        c1b = MultiHostCluster(node1b, rank=1, world=2,
+                               transport_port=port, ping_interval=0)
+        r = c1b._on_request_vote({"term": 7, "candidate": "9999-other"})
+        assert not r["granted"]  # the persisted ballot holds, blob-less
+        assert c1b._on_request_vote(
+            {"term": 7, "candidate": "9999-x"})["granted"]
+    finally:
+        if c1b is not None:
+            c1b.close()
+        else:
+            c1.close()
+        c0.close()
+        for n in (node1b, node0):
+            if n is not None:
+                n.close()
+
+
 def test_ballot_survives_voter_restart(tmp_path):
     """Raft durable state: a voter that granted term T and bounced must
     refuse a SECOND candidate the same term (two masters would win it);

@@ -1376,6 +1376,476 @@ class TestCli:
 
 
 # ---------------------------------------------------------------------------
+# whole-program pass: call-graph propagation, R013, R014, --changed
+# ---------------------------------------------------------------------------
+
+from tools.tpulint import lint_sources  # noqa: E402
+
+
+class TestCallGraphPropagation:
+    """Traced-context inference (tpulint v2 tentpole): violations
+    surface through helper calls across modules — no path allowlist
+    involved — and the existing annotation machinery keeps working at
+    the helper."""
+
+    THREE_MODULES = {
+        "pkg/a.py": """
+import jax
+from pkg.b import helper
+
+@jax.jit
+def entry(x):
+    return helper(x)
+""",
+        "pkg/b.py": """
+from pkg.c import deep
+
+def helper(x):
+    return deep(x)
+""",
+        "pkg/c.py": """
+import jax.numpy as jnp
+
+def deep(x):
+    return jnp.nonzero(x > 0)
+""",
+    }
+
+    def test_violation_two_calls_deep(self):
+        vs = lint_sources(self.THREE_MODULES)
+        assert [(v.rule, v.path) for v in vs] == [("R003", "pkg/c.py")]
+        assert "deep" in vs[0].message
+
+    def test_annotation_at_the_helper_suppresses(self):
+        srcs = dict(self.THREE_MODULES)
+        srcs["pkg/c.py"] = srcs["pkg/c.py"].replace(
+            "return jnp.nonzero(x > 0)",
+            "return jnp.nonzero(x > 0)  # tpulint: allow[R003]")
+        assert lint_sources(srcs) == []
+
+    def test_single_file_mode_cannot_see_it(self):
+        # the blind spot the whole-program pass exists for: per-file
+        # linting of the helper alone reports nothing
+        assert lint_source(textwrap.dedent(self.THREE_MODULES["pkg/c.py"]),
+                           "pkg/c.py") == []
+
+    def test_metrics_record_reachable_from_jit_body(self):
+        vs = lint_sources({
+            "q/a.py": """
+import jax
+from q.m import note
+
+@jax.jit
+def run(x):
+    note()
+    return x
+""",
+            "q/m.py": """
+from elasticsearch_tpu.monitor import metrics
+
+REG = metrics.MetricsRegistry()
+C = REG.counter("hits")
+
+def note():
+    C.inc()
+""",
+        })
+        assert [(v.rule, v.path) for v in vs] == [("R009", "q/m.py")]
+
+    def test_item_in_traced_helper_fires_without_hot_path(self):
+        # R002's traced branch follows the graph, not HOT_PATH_MARKERS:
+        # a cluster-layer helper reached from a jit body still flags
+        vs = lint_sources({
+            "elasticsearch_tpu/cluster/extra.py": """
+def pull_scalar(x):
+    return x.item()
+""",
+            "elasticsearch_tpu/ops/entry2.py": """
+import jax
+from elasticsearch_tpu.cluster.extra import pull_scalar
+
+@jax.jit
+def go(x):
+    return pull_scalar(x)
+""",
+        })
+        assert [(v.rule, v.path) for v in vs] == [
+            ("R002", "elasticsearch_tpu/cluster/extra.py")]
+
+    def test_static_config_through_helpers_stays_static(self):
+        # the dataflow refinement: closure config (metric strings, shape
+        # ints, .shape/.dtype reads) classifies static at call sites, so
+        # helpers branching on them don't false-fire R004
+        vs = lint_sources({
+            "pkg2/prog.py": """
+import jax
+
+from pkg2.helper import score
+
+def make(metric, k):
+    def body(x):
+        kp = min(4 * k, 128)
+        return score(x, kp, metric)
+    return jax.jit(body)
+""",
+            "pkg2/helper.py": """
+import jax.numpy as jnp
+
+def score(x, k, metric):
+    if metric == "l2":
+        x = -x
+    if x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)
+    if k > 8:
+        return x * 2
+    return x
+""",
+        })
+        assert vs == []
+
+    def test_dynamic_arg_through_helper_still_traced(self):
+        # ...but an argument derived from the traced value DOES trace
+        vs = lint_sources({
+            "pkg3/prog.py": """
+import jax
+
+from pkg3.helper import gate
+
+def make():
+    def body(x):
+        return gate(x, x.sum())
+    return jax.jit(body)
+""",
+            "pkg3/helper.py": """
+def gate(x, threshold):
+    if threshold > 0:
+        return x
+    return -x
+""",
+        })
+        assert [(v.rule, v.path) for v in vs] == [("R004", "pkg3/helper.py")]
+
+
+class TestR013LockOrder:
+    """Interprocedural lock-order analysis: held→acquired edges across
+    modules, cycle detection, and lock-held calls into unbounded waits
+    (the R010 hazard generalized past serving/)."""
+
+    CYCLE = {
+        "l/a.py": """
+import threading
+from l.b import take_b
+
+LOCK_A = threading.Lock()
+
+def f():
+    with LOCK_A:
+        take_b()
+""",
+        "l/b.py": """
+import threading
+from l.c import take_c
+
+LOCK_B = threading.Lock()
+
+def take_b():
+    with LOCK_B:
+        take_c()
+""",
+        "l/c.py": """
+import threading
+import l.a
+
+LOCK_C = threading.Lock()
+
+def take_c():
+    with LOCK_C:
+        with l.a.LOCK_A:
+            pass
+""",
+    }
+
+    def test_three_lock_cycle_across_modules(self):
+        vs = [v for v in lint_sources(self.CYCLE) if v.rule == "R013"]
+        assert vs, "3-lock cycle not detected"
+        assert any("lock-order cycle" in v.message for v in vs)
+        # the cycle names the participating locks with witness sites
+        msg = next(v.message for v in vs if "lock-order cycle" in v.message)
+        assert "LOCK_A" in msg and ".py:" in msg
+
+    def test_consistent_global_order_is_clean(self):
+        vs = lint_sources({
+            "g/a.py": """
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+def f():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+""",
+            "g/b.py": """
+from g.a import LOCK_A, LOCK_B
+
+def g():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+""",
+        })
+        assert vs == []
+
+    def test_lock_held_call_into_unbounded_wait(self):
+        vs = lint_sources({
+            "w/a.py": """
+import threading
+from w.b import drain
+
+LOCK = threading.Lock()
+
+def f():
+    with LOCK:
+        drain()
+""",
+            "w/b.py": """
+import threading
+
+EVT = threading.Event()
+
+def drain():
+    EVT.wait()
+""",
+        })
+        assert [(v.rule, v.path) for v in vs] == [("R013", "w/a.py")]
+        assert "Event.wait()" in vs[0].message
+
+    def test_bounded_wait_through_call_is_clean(self):
+        vs = lint_sources({
+            "w2/a.py": """
+import threading
+from w2.b import drain
+
+LOCK = threading.Lock()
+
+def f():
+    with LOCK:
+        drain()
+""",
+            "w2/b.py": """
+import threading
+
+EVT = threading.Event()
+
+def drain():
+    EVT.wait(timeout=0.5)
+""",
+        })
+        assert vs == []
+
+    def test_direct_unbounded_wait_under_lock_outside_serving(self):
+        vs = lint_sources({
+            "d/a.py": """
+import threading
+
+LOCK = threading.Lock()
+EVT = threading.Event()
+
+def f():
+    with LOCK:
+        EVT.wait()
+""",
+        })
+        assert [(v.rule, v.path) for v in vs] == [("R013", "d/a.py")]
+
+    def test_cycle_detector_survives_side_branches(self):
+        """A cyclic SCC with a dead-end side branch (a→b, b→c, c→b,
+        b→d, d→a): a greedy no-revisit walk strays into the branch and
+        reports NOTHING for a genuinely cyclic component — the DFS
+        back-edge detector must still find a real cycle."""
+        from tools.tpulint.project import _find_cycles
+
+        g = {"a": {"b"}, "b": {"c", "d"}, "c": {"b"}, "d": {"a"}}
+        cycles = _find_cycles(g)
+        assert cycles, "cyclic SCC reported no cycle"
+        for cyc in cycles:
+            ring = cyc + [cyc[0]]
+            assert all(b in g.get(a, ()) for a, b in zip(ring, ring[1:])), \
+                cyc
+
+    def test_inline_allow_suppresses_at_witness(self):
+        srcs = dict(self.CYCLE)
+        # the A→B edge's witness is the call made while holding LOCK_A
+        srcs["l/a.py"] = srcs["l/a.py"].replace(
+            "        take_b()",
+            "        take_b()  # tpulint: allow[R013] — reviewed: "
+            "f() only runs single-threaded at boot")
+        vs = [v for v in lint_sources(srcs)
+              if v.rule == "R013" and "cycle" in v.message]
+        # the cycle's witness line carries the allow — suppressed there
+        assert all(v.path != "l/a.py" for v in vs)
+
+
+class TestR014CollectivePurity:
+    """Host syncs inside shard_map/psum programs, reached through the
+    call graph (the toy version of the mesh executor's wrap(body, ...)
+    idiom)."""
+
+    def test_host_sync_in_shard_map_helper(self):
+        vs = lint_sources({
+            "s/prog.py": """
+import jax
+from jax.experimental.shard_map import shard_map
+
+from s.helper import merge
+
+def build(mesh):
+    def body(x):
+        s = jax.lax.psum(x, "shard")
+        return merge(s)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None))
+""",
+            "s/helper.py": """
+import jax
+import numpy as np
+
+def merge(s):
+    host = np.asarray(s)
+    jax.device_get(s)
+    return host
+""",
+        })
+        r014 = [v for v in vs if v.rule == "R014"]
+        assert [v.path for v in r014] == ["s/helper.py", "s/helper.py"]
+        assert any("np.asarray" in v.message for v in r014)
+        assert any("device_get" in v.message for v in r014)
+
+    def test_pure_collective_program_is_clean(self):
+        vs = lint_sources({
+            "s2/prog.py": """
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+
+from s2.helper import merge
+
+def build(mesh):
+    def body(x):
+        s = jax.lax.psum(x, "shard")
+        return merge(s)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=None,
+                             out_specs=None))
+""",
+            "s2/helper.py": """
+import jax.numpy as jnp
+
+def merge(s):
+    return jnp.maximum(s, 0.0) * 2.0
+""",
+        })
+        assert vs == []
+
+    def test_item_and_cast_in_collective_body(self):
+        vs = lint_sources({
+            "s3/prog.py": """
+import jax
+from jax import lax
+
+def make(mesh, wrap):
+    def body(x, k):
+        t = lax.psum(x, "shard")
+        n = int(t)
+        return t.item() + n
+    return wrap(body, None, None)
+""",
+        })
+        assert sorted(v.rule for v in vs) == ["R014", "R014"]
+        assert any(".item()" in v.message for v in vs)
+        assert any("int(...)" in v.message for v in vs)
+
+    def test_host_math_on_static_closures_is_clean(self):
+        # np on static metadata at trace time is legal inside a
+        # collective body (the executor's pack_spec unpacking idiom)
+        vs = lint_sources({
+            "s4/prog.py": """
+import jax
+import numpy as np
+from jax import lax
+
+def make(mesh, wrap, shapes):
+    def body(x):
+        n = int(np.prod(shapes[0]))
+        return lax.psum(x[:n], "shard")
+    return wrap(body, None, None)
+""",
+        })
+        assert vs == []
+
+
+class TestChangedModeAndSeverity:
+    BAD = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+
+    def test_severity_in_json(self, tmp_path, capsys):
+        from tools.tpulint.__main__ import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        rc = main([str(bad), "--json",
+                   "--baseline", str(tmp_path / "none.json")])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        (v,) = out["violations"]
+        assert v["rule"] == "R004" and v["severity"] == "error"
+        assert out["severity"]["R001"] == "warning"
+        assert out["severity"]["R013"] == "error"
+
+    def test_changed_mode_filters_to_changed_files(self, tmp_path,
+                                                   capsys, monkeypatch):
+        import tools.tpulint.__main__ as cli
+
+        bad1 = tmp_path / "one.py"
+        bad2 = tmp_path / "two.py"
+        bad1.write_text(self.BAD)
+        bad2.write_text(self.BAD)
+        # both files violate; git says only one changed
+        monkeypatch.setattr(cli, "_changed_files",
+                            lambda base: [str(bad1)])
+        rc = cli.main([str(bad1), str(bad2), "--changed", "HEAD", "--json",
+                       "--baseline", str(tmp_path / "none.json")])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert {v["path"] for v in out["violations"]} == {str(bad1)}
+
+    def test_changed_mode_no_changes_is_clean(self, tmp_path, capsys,
+                                              monkeypatch):
+        import tools.tpulint.__main__ as cli
+
+        bad = tmp_path / "one.py"
+        bad.write_text(self.BAD)
+        monkeypatch.setattr(cli, "_changed_files", lambda base: [])
+        assert cli.main([str(bad), "--changed", "HEAD",
+                         "--baseline", str(tmp_path / "none.json")]) == 0
+
+    def test_per_file_mode_still_available(self, tmp_path, capsys):
+        from tools.tpulint.__main__ import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        assert main([str(bad), "--per-file",
+                     "--baseline", str(tmp_path / "none.json")]) == 1
+
+
+# ---------------------------------------------------------------------------
 # runtime trace auditor
 # ---------------------------------------------------------------------------
 

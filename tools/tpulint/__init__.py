@@ -1,41 +1,36 @@
-"""tpulint — a JAX/TPU-aware static-analysis pass for elasticsearch_tpu.
+"""tpulint — JAX/TPU-aware whole-program static analysis for
+elasticsearch_tpu.
 
 The paper's core bet is that per-segment scoring runs as batched,
-statically-shaped device programs. That bet silently breaks whenever a
-dynamic shape, tracer leak, or per-hit host sync creeps into a jitted
-path — failures that surface not as exceptions but as recompile storms
-and serialized device↔host ping-pong on TPU. (R006 guards a different
-invariant of the same production-scale bet: faults in the distributed
-failure domain must be ACCOUNTED, never swallowed.) tpulint catches the
-known failure classes at review time:
+statically-shaped device programs — since the shard_map mesh executor,
+*collective* device programs, where one stray host sync stalls every
+chip. That bet silently breaks whenever a dynamic shape, tracer leak,
+or per-hit host sync creeps into a jitted path — failures that surface
+not as exceptions but as recompile storms and serialized device↔host
+ping-pong on TPU.
 
-  R001  recompilation hazards: jit construction inside a loop; unhashable
-        or unbucketed high-cardinality values fed to ``static_argnames``.
-  R002  host↔device sync in hot paths (``ops/``, ``search/``,
-        ``rest/server.py``): ``.item()`` / scalar ``np.asarray(x)[i]``
-        pulls inside per-hit loops, scalar casts of device pulls.
-  R003  dynamic-shape leaks: ``jnp.nonzero``/``unique``/``where(cond)``
-        without ``size=`` and boolean-mask indexing inside traced code;
-        un-annotated host ``np.nonzero``-family calls in ``ops/``.
-  R004  tracer leaks: Python ``if``/``while`` on traced arguments inside
-        jitted functions.
-  R005  lock discipline: mutation of shared state in threadpool-visible
-        modules (engine/translog/ivf_cache/threadpool) outside a
-        ``with <lock>`` block.
-  R007  wall-clock durations: ``time.time()`` feeding a subtraction in
-        the timing modules (``tracing/``, ``monitor/``) — spans and
-        latencies must use ``time.monotonic()``/``perf_counter``.
-  R006  swallowed failures: bare ``except Exception: pass`` in the
-        failure-domain layers (``cluster/``, ``index/``, ``rest/``) —
-        a fault that never reaches retry/breaker/partial-result
-        accounting becomes silent data loss.
+tpulint v2 is a TWO-PASS analyzer: pass 1 (``tools/tpulint/project.py``)
+builds a project-wide symbol table + call graph and infers which
+functions are transitively reachable from ``jax.jit`` / ``pallas_call``
+/ ``shard_map`` bodies (traced reach), which sit inside collective
+programs, and which locks are held at every acquire site
+interprocedurally; pass 2 (``tools/tpulint/rules.py``) runs fourteen
+rules over that view — R001 recompile hazards, R002 host syncs (traced
+reach + hot-path loops), R003 dynamic shapes, R004 tracer leaks, R005
+lock discipline, R006 swallowed failures, R007 wall-clock durations,
+R008 unaccounted device placement, R009 metric recording on the device
+path, R010 unbounded waits under serving locks, R011 ungated cluster
+threads, R012 import-time jit bindings escaping compile attribution,
+R013 lock-order cycles + lock-held calls into unbounded waits, R014
+collective purity. R002/R003/R004/R009 fire THROUGH helper calls — a
+violation two modules away from the jit body is found where it lives.
 
-Suppress a finding in place with ``# tpulint: allow[R00x]`` on the line
+Suppress a finding in place with ``# tpulint: allow[R0xx]`` on the line
 (or an immediately preceding comment line); mark intentional host-side
 build code with ``# tpulint: host``. Grandfathered sites live in
 ``tools/tpulint/baseline.json``.
 
-Run: ``python -m tools.tpulint [paths] [--json]``.
+Run: ``python -m tools.tpulint [--changed [BASE]] [--json] [paths]``.
 
 ``tools.tpulint.trace_audit`` is the runtime counterpart: it wraps
 ``jax.jit`` to count (re)traces per callable and assert an upper bound,
@@ -43,10 +38,17 @@ so benches and tests can prove steady-state means zero recompiles.
 """
 from tools.tpulint.analyzer import (  # noqa: F401
     RULES,
+    SEVERITY,
     Violation,
     lint_file,
     lint_paths,
     lint_source,
+)
+from tools.tpulint.project import (  # noqa: F401
+    analyze_sources,
+    build_project,
+    lint_project,
+    lint_sources,
 )
 from tools.tpulint.baseline import (  # noqa: F401
     DEFAULT_BASELINE,

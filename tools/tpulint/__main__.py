@@ -1,5 +1,12 @@
 """CLI: ``python -m tools.tpulint [paths] [--json] [--baseline FILE]``.
 
+Runs the two-pass whole-program analyzer (symbol table + call graph,
+then the dataflow rules) over the given paths — pass ``--per-file`` to
+fall back to the old single-file mode (no traced-context inference, no
+R013/R014). ``--changed [BASE]`` builds the full project (the call graph
+needs every module) but reports only findings in files changed vs the
+git base ref (default HEAD) — the fast pre-commit mode.
+
 Exit codes: 0 = clean (or all findings baselined), 1 = new violations,
 2 = usage/baseline error. Run from the repo root so reported paths match
 the baseline fingerprints.
@@ -9,9 +16,10 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
-from tools.tpulint.analyzer import RULES, lint_paths
+from tools.tpulint.analyzer import RULES, SEVERITY, lint_paths
 
 # the directory that contains tools/ — reported paths and baseline
 # fingerprints are relative to it no matter where the CLI is invoked from
@@ -24,17 +32,42 @@ from tools.tpulint.baseline import (
     write_baseline,
 )
 
+# the default whole-program scope: the product package, the tools that
+# analyze it, and the bench entry point
+DEFAULT_SCOPE = ("elasticsearch_tpu", "tools", "bench.py")
+
+
+def _changed_files(base: str) -> list:
+    """Root-relative python files changed vs ``base``: tracked diffs
+    PLUS untracked (not-yet-added) files — a brand-new module with
+    violations must not pass the pre-commit mode clean just because
+    ``git add`` hasn't run yet."""
+    out = subprocess.run(
+        ["git", "diff", "--name-only", base, "--"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=True)
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=REPO_ROOT, capture_output=True, text=True, check=True)
+    seen = []
+    for ln in out.stdout.splitlines() + untracked.stdout.splitlines():
+        ln = ln.strip()
+        if ln.endswith(".py") and ln not in seen:
+            seen.append(ln)
+    return seen
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.tpulint",
-        description="JAX/TPU-aware static analysis for elasticsearch_tpu "
-                    "(rules R001-R007; see docs/STATIC_ANALYSIS.md)")
+        description="JAX/TPU-aware whole-program static analysis for "
+                    "elasticsearch_tpu (rules R001-R014; see "
+                    "docs/STATIC_ANALYSIS.md)")
     ap.add_argument("paths", nargs="*", default=[],
-                    help="files or directories to lint "
-                         "(default: the repo's elasticsearch_tpu package)")
+                    help="files or directories to lint (default: "
+                         "elasticsearch_tpu/ + tools/ + bench.py)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit findings as a JSON document on stdout")
+                    help="emit findings as a JSON document on stdout "
+                         "(each with a per-rule severity)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file of grandfathered findings")
     ap.add_argument("--no-baseline", action="store_true",
@@ -42,14 +75,57 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="write the current finding set to --baseline "
                          "and exit 0 (dev helper)")
+    ap.add_argument("--per-file", action="store_true",
+                    help="single-file mode: skip the project call graph "
+                         "(no traced-context inference, no R013/R014)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="BASE",
+                    help="report only findings in files changed vs the "
+                         "git BASE ref (default HEAD); the project index "
+                         "is still built over the full default scope so "
+                         "interprocedural rules see every caller")
     args = ap.parse_args(argv)
 
-    paths = args.paths or [os.path.join(REPO_ROOT, "elasticsearch_tpu")]
+    paths = args.paths or [os.path.join(REPO_ROOT, p)
+                           for p in DEFAULT_SCOPE]
+    report_only = None
+    if args.changed is not None:
+        try:
+            changed = _changed_files(args.changed)
+        except (OSError, subprocess.CalledProcessError) as e:
+            print(f"tpulint: --changed failed: {e}", file=sys.stderr)
+            return 2
+        report_only = set(changed)
+        if not report_only:
+            # nothing can be reported — skip the project build entirely
+            # (the advertised fast path must actually be fast)
+            if args.as_json:
+                print(json.dumps({
+                    "rules": RULES, "severity": SEVERITY,
+                    "violations": [], "baselined": [],
+                    "counts": {"new": 0, "baselined": 0}}, indent=2))
+            else:
+                print("tpulint: no python files changed", file=sys.stderr)
+            return 0
+        # changed files outside the default scope still get analyzed
+        # (joined into the same project index)
+        paths = list(paths) + [
+            os.path.join(REPO_ROOT, f) for f in changed
+            if os.path.exists(os.path.join(REPO_ROOT, f))
+            and not any(f == p or f.startswith(p + "/")
+                        for p in DEFAULT_SCOPE)]
     try:
-        found = lint_paths(paths, root=REPO_ROOT)
+        if args.per_file:
+            found = lint_paths(paths, root=REPO_ROOT)
+        else:
+            from tools.tpulint.project import lint_project
+
+            found = lint_project(paths, root=REPO_ROOT)
     except FileNotFoundError as e:
         print(f"tpulint: {e}", file=sys.stderr)
         return 2
+    if report_only is not None:
+        found = [v for v in found if v.path in report_only]
     if args.write_baseline:
         doc = write_baseline(found, args.baseline)
         print(f"wrote {len(doc['violations'])} baseline entr"
@@ -65,10 +141,16 @@ def main(argv=None) -> int:
     new, old = filter_baselined(found, budget)
 
     if args.as_json:
+        def _row(v):
+            d = v.to_json()
+            d["severity"] = SEVERITY.get(v.rule, "warning")
+            return d
+
         print(json.dumps({
             "rules": RULES,
-            "violations": [v.to_json() for v in new],
-            "baselined": [v.to_json() for v in old],
+            "severity": SEVERITY,
+            "violations": [_row(v) for v in new],
+            "baselined": [_row(v) for v in old],
             "counts": {"new": len(new), "baselined": len(old)},
         }, indent=2))
     else:

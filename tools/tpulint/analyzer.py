@@ -43,6 +43,25 @@ RULES: Dict[str, str] = {
             "compile before tracing/retrace installs the auditor and "
             "escapes compile attribution (observatory census + profiler "
             "compile/execute split under-report)",
+    "R013": "lock-order hazard: a cycle in the interprocedural "
+            "held→acquired lock graph (potential deadlock), or a "
+            "lock-held call chain into an unbounded blocking wait",
+    "R014": "collective impurity: host sync / device transfer inside a "
+            "shard_map/psum collective program (reachable through the "
+            "call graph) — one stalled chip stalls every chip in the "
+            "mesh",
+}
+
+# Per-rule severity, surfaced in --json for pre-commit tooling. `error`
+# = breaks correctness or wedges the process (trace failures, deadlocks,
+# device syncs inside programs, unlocked shared state); `warning` =
+# degrades perf/observability but runs. The GATE fails on both — the
+# split is for triage order, not for skipping.
+SEVERITY: Dict[str, str] = {
+    "R000": "error", "R001": "warning", "R002": "error", "R003": "error",
+    "R004": "error", "R005": "error", "R006": "warning", "R007": "warning",
+    "R008": "warning", "R009": "error", "R010": "error", "R011": "warning",
+    "R012": "warning", "R013": "error", "R014": "error",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.
@@ -169,6 +188,37 @@ def _matches(path: str, markers: Sequence[str]) -> bool:
     return any(m in p for m in markers)
 
 
+def make_file_context(path: str, lines: Sequence[str], supp: "Suppressions",
+                      *, ext_traced=None, ext_collective=None, **overrides):
+    """FileContext with path-inferred scoping (overridable per flag) plus
+    the project-level traced/collective maps (pass 2 of the whole-program
+    analysis; empty in single-file mode)."""
+    from tools.tpulint import rules as _rules
+
+    def flag(name: str, default: bool) -> bool:
+        v = overrides.get(name)
+        return default if v is None else v
+
+    return _rules.FileContext(
+        path=path,
+        lines=lines,
+        hot=flag("hot", _matches(path, HOT_PATH_MARKERS)),
+        ops=flag("ops", _matches(path, OPS_PATH_MARKERS)),
+        locked=flag("locked", _matches(path, LOCKED_MODULE_MARKERS)),
+        swallow=flag("swallow", _matches(path, SWALLOW_PATH_MARKERS)),
+        timing=flag("timing", _matches(path, TIMING_PATH_MARKERS)),
+        budget=flag("budget", _matches(path, BUDGET_PATH_MARKERS)
+                    and not _matches(path, BUDGET_EXEMPT_MARKERS)),
+        blocking=flag("blocking", _matches(path, BLOCKING_PATH_MARKERS)),
+        threads=flag("threads", _matches(path, THREADS_PATH_MARKERS)),
+        audit=flag("audit", _matches(path, AUDIT_PATH_MARKERS)
+                   and not _matches(path, AUDIT_EXEMPT_MARKERS)),
+        host_lines=supp.host,
+        ext_traced=ext_traced or {},
+        ext_collective=ext_collective or set(),
+    )
+
+
 def lint_source(
     source: str,
     path: str = "<string>",
@@ -183,37 +233,20 @@ def lint_source(
     threads: Optional[bool] = None,
     audit: Optional[bool] = None,
 ) -> List[Violation]:
-    """Lint one source string. ``hot``/``ops``/``locked``/``swallow``/
-    ``timing``/``budget``/``blocking``/``threads``/``audit`` override
-    the path-based scoping (fixture tests use these; production runs
-    infer from the path)."""
+    """Lint one source string, single-file mode (no call graph — only
+    locally visible jit roots enter traced context). ``hot``/``ops``/
+    ``locked``/``swallow``/``timing``/``budget``/``blocking``/``threads``/
+    ``audit`` override the path-based scoping (fixture tests use these;
+    production runs infer from the path)."""
     from tools.tpulint import rules as _rules
 
     tree = ast.parse(source, filename=path)
     supp = Suppressions(source)
     lines = source.splitlines()
-    ctx = _rules.FileContext(
-        path=path,
-        lines=lines,
-        hot=_matches(path, HOT_PATH_MARKERS) if hot is None else hot,
-        ops=_matches(path, OPS_PATH_MARKERS) if ops is None else ops,
-        locked=_matches(path, LOCKED_MODULE_MARKERS) if locked is None else locked,
-        swallow=(_matches(path, SWALLOW_PATH_MARKERS)
-                 if swallow is None else swallow),
-        timing=(_matches(path, TIMING_PATH_MARKERS)
-                if timing is None else timing),
-        budget=((_matches(path, BUDGET_PATH_MARKERS)
-                 and not _matches(path, BUDGET_EXEMPT_MARKERS))
-                if budget is None else budget),
-        blocking=(_matches(path, BLOCKING_PATH_MARKERS)
-                  if blocking is None else blocking),
-        threads=(_matches(path, THREADS_PATH_MARKERS)
-                 if threads is None else threads),
-        audit=((_matches(path, AUDIT_PATH_MARKERS)
-                and not _matches(path, AUDIT_EXEMPT_MARKERS))
-               if audit is None else audit),
-        host_lines=supp.host,
-    )
+    ctx = make_file_context(
+        path, lines, supp, hot=hot, ops=ops, locked=locked,
+        swallow=swallow, timing=timing, budget=budget, blocking=blocking,
+        threads=threads, audit=audit)
     found = _rules.check_module(tree, ctx)
     return [v for v in found if not supp.suppressed(v)]
 

@@ -1,0 +1,1306 @@
+"""tpulint pass 1: whole-program symbol table, call graph, and the
+dataflow facts pass 2 consumes.
+
+The per-file rules (tools/tpulint/rules.py) see one module at a time, so
+a host sync or metrics record hidden one helper call away from a
+``@jax.jit`` body is invisible to them. This module builds the project
+view that closes that blind spot:
+
+* **Symbol table** — every function/method in the analyzed file set,
+  keyed ``module:qualname`` (``elasticsearch_tpu.ops.scoring:topk_auto``,
+  ``...executor:MeshSearchExecutor._search_round``, nested defs as
+  ``outer.inner``), with import aliasing resolved per module (``import
+  a.b as x`` / ``from a.b import f as g`` / relative forms).
+* **Call graph** — CALL edges for resolvable calls (bare names, local
+  aliases, ``mod.fn`` chains, ``self.method`` within the enclosing class
+  and its project-resolvable bases, ``Class()`` → ``__init__``), REF
+  edges for function references passed as arguments (``jax.vmap(f)``,
+  ``partial(self._run, ...)``) and for nested defs (a helper defined
+  inside a traced body is traced).
+* **Traced-context inference** — a fixpoint marks every function
+  transitively reachable from a ``jax.jit`` / ``pallas_call`` /
+  ``shard_map`` body as traced, refining per-parameter tracedness from
+  call sites (an argument that is a literal or a static parameter of a
+  traced caller stays static; everything else is a potential tracer).
+  Pass 2 enters these functions exactly like locally-jitted ones, so
+  R002/R003/R004/R009 fire through helper calls instead of path lists.
+* **Collective reach** — traced roots passed to ``shard_map`` (directly
+  or via the executor's ``wrap`` idiom) or containing ``psum`` /
+  ``all_gather`` collectives, plus everything they reach: the R014
+  scope, where ANY host sync stalls every chip in the mesh.
+* **Lock graph (R013)** — which locks are held at each ``with lock:``
+  site, interprocedurally: held→acquired edges (including acquires
+  buried in callees), cycle detection over them, and lock-held calls
+  into unbounded blocking waits (``Event.wait()`` / ``queue.get()``
+  with no timeout — the R010 hazard generalized past ``serving/``).
+
+Everything stays stdlib-``ast``: no JAX import, no device, fast enough
+for tier-1 (the gate asserts a full-repo pass under 30s).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.tpulint.analyzer import (Suppressions, Violation,
+                                    iter_python_files, snippet_at)
+
+# Function-wrapper call names whose function-valued arguments get traced
+# (the callable is compiled/trace-executed, not called on host). `wrap`
+# is the executor's shard_map-or-jit closure idiom (parallel/executor.py
+# `_collectives`): program bodies reach shard_map exclusively through it.
+TRACED_WRAPPER_NAMES = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "checkpoint",
+    "remat", "scan", "cond", "while_loop", "fori_loop", "switch",
+    "pallas_call", "shard_map", "wrap",
+}
+# The subset that compiles a *collective* program: its body runs
+# SPMD across every mesh slot, so host syncs inside stall all chips.
+COLLECTIVE_WRAPPER_NAMES = {"shard_map", "wrap"}
+# Collective ops: a traced function calling one of these IS part of a
+# collective program even when the shard_map wrapper is out of reach.
+COLLECTIVE_OP_NAMES = {"psum", "all_gather", "pmean", "pmax", "pmin",
+                       "ppermute", "axis_index", "all_to_all"}
+
+_LOCK_SUFFIXES = (".Lock", ".RLock")
+_QUEUE_NAMES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+
+def module_name_for(relpath: str) -> str:
+    """'elasticsearch_tpu/ops/scoring.py' -> 'elasticsearch_tpu.ops.scoring'."""
+    p = relpath.replace(os.sep, "/")
+    if p.endswith("/__init__.py"):
+        p = p[: -len("/__init__.py")]
+    elif p.endswith("__init__.py"):
+        p = ""
+    elif p.endswith(".py"):
+        p = p[:-3]
+    return p.strip("/").replace("/", ".")
+
+
+def _name(node: ast.AST) -> Optional[str]:
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _fn_params(node, *, include_var: bool = True) -> List[str]:
+    a = node.args
+    names = [p.arg for p in getattr(a, "posonlyargs", [])]
+    names += [p.arg for p in a.args]
+    if include_var and a.vararg is not None:
+        names.append(a.vararg.arg)
+    names += [p.arg for p in a.kwonlyargs]
+    if include_var and a.kwarg is not None:
+        names.append(a.kwarg.arg)
+    return names
+
+
+@dataclass
+class CallEdge:
+    callee: str                       # sid 'module:qual'
+    kind: str                         # 'call' | 'ref'
+    line: int = 0
+    # per-argument classification for traced-param refinement:
+    # (callee_param, 'const') | (param, ('param', caller_param)) |
+    # (param, 'dyn'); all_dyn short-circuits (e.g. *args splat)
+    args: List[Tuple[str, object]] = field(default_factory=list)
+    all_dyn: bool = False
+    held: Tuple[str, ...] = ()        # lock ids held at the call site
+
+
+@dataclass
+class FnSymbol:
+    sid: str
+    module: str
+    qual: str
+    node: ast.AST
+    cls: Optional[str]
+    params: List[str]
+    statics: Set[str] = field(default_factory=set)
+    is_root: bool = False             # locally jit-rooted
+    root_all_params: bool = False     # wrapper-marked: every param traced
+    is_collective_root: bool = False
+    has_collective_call: bool = False
+    edges: List[CallEdge] = field(default_factory=list)
+    # lock facts (with-block granularity; flow within a fn is lexical)
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    lock_edges: List[Tuple[str, str, int]] = field(default_factory=list)
+    direct_waits: List[Tuple[int, str]] = field(default_factory=list)
+    waits_under: List[Tuple[str, int, str]] = field(default_factory=list)
+
+
+@dataclass
+class ClassRec:
+    name: str
+    bases: List[str]                  # attr-chain strings
+    locks: Set[str] = field(default_factory=set)
+    conds: Set[str] = field(default_factory=set)
+    events: Set[str] = field(default_factory=set)
+    queues: Set[str] = field(default_factory=set)
+    # instance-attribute types from constructor-call assignments
+    # (`self.translog = Translog(path)`): attr -> ctor chain string,
+    # resolved lazily against imports — this is what lets the lock graph
+    # follow `self.translog.append()` across the engine/translog boundary
+    attr_types: Dict[str, str] = field(default_factory=dict)
+
+
+class ModuleRecord:
+    """One analyzed file: tree, suppressions, imports, symbols, classes."""
+
+    def __init__(self, relpath: str, source: str):
+        from tools.tpulint import rules as _rules
+
+        self.path = relpath.replace(os.sep, "/")
+        self.modname = module_name_for(self.path)
+        self.source = source
+        self.tree = ast.parse(source, filename=self.path)
+        self.lines = source.splitlines()
+        self.supp = Suppressions(source)
+        self.info = _rules._ModuleInfo(self.tree)
+        self.symbols: Dict[str, FnSymbol] = {}
+        self.classes: Dict[str, ClassRec] = {}
+        # local name -> ('module', modname) | ('symbol', modname, name)
+        self.imports: Dict[str, Tuple] = {}
+        # module-level shared objects
+        self.mod_locks: Set[str] = set()
+        self.mod_conds: Set[str] = set()
+        self.mod_events: Set[str] = set()
+        self.mod_queues: Set[str] = set()
+        # module-level singletons (`RESIDENCY = ResidencyRegistry()`):
+        # name -> ctor chain, for `resources.RESIDENCY.track(...)` reach
+        self.mod_obj_types: Dict[str, str] = {}
+
+
+def _ctor_kind(call: ast.Call) -> Optional[str]:
+    """'lock'/'cond'/'event'/'queue' for threading/queue constructors."""
+    chain = _attr_chain(call.func) or ""
+    tail = chain.rpartition(".")[2]
+    if chain.endswith(_LOCK_SUFFIXES) or tail in ("Lock", "RLock"):
+        return "lock"
+    if tail == "Condition":
+        return "cond"
+    if tail == "Event":
+        return "event"
+    if tail in _QUEUE_NAMES:
+        return "queue"
+    return None
+
+
+class ProjectIndex:
+    """The whole-program analysis result pass 2 consumes."""
+
+    def __init__(self, records: List[ModuleRecord], module_set: Set[str]):
+        self.records = {r.modname: r for r in records}
+        self.by_path = {r.path: r for r in records}
+        self.module_set = module_set
+        self.symbols: Dict[str, FnSymbol] = {}
+        for r in records:
+            for s in r.symbols.values():
+                self.symbols[s.sid] = s
+        # filled by analyze():
+        self.traced: Dict[str, Set[str]] = {}       # sid -> traced params
+        self.collective: Set[str] = set()
+        self.lock_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.lock_cycles: List[List[str]] = []
+        self.wait_violations: List[Tuple[str, int, str]] = []  # path,line,msg
+
+    # -- views keyed the way pass 2 wants them ------------------------------
+
+    def traced_for_module(self, modname: str) -> Dict[str, Set[str]]:
+        out: Dict[str, Set[str]] = {}
+        prefix = modname + ":"
+        for sid, params in self.traced.items():
+            if sid.startswith(prefix):
+                out[sid[len(prefix):]] = params
+        return out
+
+    def collective_for_module(self, modname: str) -> Set[str]:
+        prefix = modname + ":"
+        return {sid[len(prefix):] for sid in self.collective
+                if sid.startswith(prefix)}
+
+
+# ---------------------------------------------------------------------------
+# pass 1a: symbols, classes, imports
+# ---------------------------------------------------------------------------
+
+class _SymbolCollector(ast.NodeVisitor):
+    def __init__(self, rec: ModuleRecord):
+        self.rec = rec
+        self.stack: List[Tuple[str, str]] = []  # ('class'|'fn', name)
+
+    def _qual(self, name: str) -> str:
+        return ".".join([n for _k, n in self.stack] + [name])
+
+    def _cls(self) -> Optional[str]:
+        for kind, name in reversed(self.stack):
+            if kind == "class":
+                return name
+        return None
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        rec = ClassRec(node.name,
+                       [c for c in (_attr_chain(b) for b in node.bases) if c])
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
+                    isinstance(sub.value, ast.Call):
+                chain = _attr_chain(sub.targets[0]) or ""
+                if chain.startswith("self.") and "." not in chain[5:]:
+                    kind = _ctor_kind(sub.value)
+                    if kind:
+                        getattr(rec, kind + "s").add(chain[5:])
+                    else:
+                        ctor = _attr_chain(sub.value.func)
+                        tail = (ctor or "").rpartition(".")[2]
+                        # constructor-shaped (CapWord) calls only — a
+                        # helper-call assignment is not a type witness
+                        if ctor and tail[:1].isupper():
+                            rec.attr_types.setdefault(chain[5:], ctor)
+        # first definition wins (shadowed re-defs are rare and benign)
+        self.rec.classes.setdefault(node.name, rec)
+        self.stack.append(("class", node.name))
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _visit_fn(self, node) -> None:
+        qual = self._qual(node.name)
+        if qual not in self.rec.symbols:
+            sym = FnSymbol(sid=f"{self.rec.modname}:{qual}",
+                           module=self.rec.modname, qual=qual, node=node,
+                           cls=self._cls(), params=_fn_params(node))
+            statics = self.rec.info.decorator_jit(node)
+            if statics is not None:
+                sym.is_root, sym.statics = True, set(statics)
+            elif node.name in self.rec.info.wrapped_fns:
+                sym.is_root = True
+            self.rec.symbols[qual] = sym
+        self.stack.append(("fn", node.name))
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+
+def _collect_imports(rec: ModuleRecord, module_set: Set[str]) -> None:
+    """All imports anywhere in the tree (this codebase imports inside
+    functions heavily); function-local bindings are treated module-wide,
+    an over-approximation that only ever *adds* resolvable edges."""
+    pkg = rec.modname.rpartition(".")[0]
+    for node in ast.walk(rec.tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                bound = al.asname or al.name.split(".")[0]
+                target = al.name if al.asname else al.name.split(".")[0]
+                rec.imports.setdefault(bound, ("module", target))
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = rec.modname.split(".")
+                # from . / .. : drop (level) tail components (the module
+                # itself counts as one for non-package modules)
+                keep = len(parts) - node.level
+                if rec.path.endswith("__init__.py"):
+                    keep += 1
+                base_parts = parts[:max(keep, 0)]
+                base = ".".join(base_parts + ([node.module]
+                                              if node.module else []))
+            for al in node.names:
+                bound = al.asname or al.name
+                full = f"{base}.{al.name}" if base else al.name
+                if full in module_set:
+                    rec.imports.setdefault(bound, ("module", full))
+                else:
+                    rec.imports.setdefault(bound, ("symbol", base, al.name))
+    # module-level shared-object registry
+    for stmt in rec.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.value, ast.Call):
+            tgt = _name(stmt.targets[0])
+            kind = _ctor_kind(stmt.value)
+            if tgt and kind:
+                {"lock": rec.mod_locks, "cond": rec.mod_conds,
+                 "event": rec.mod_events, "queue": rec.mod_queues}[kind].add(tgt)
+            elif tgt:
+                ctor = _attr_chain(stmt.value.func)
+                tail = (ctor or "").rpartition(".")[2]
+                if ctor and tail[:1].isupper():
+                    rec.mod_obj_types.setdefault(tgt, ctor)
+
+
+# ---------------------------------------------------------------------------
+# pass 1b: per-symbol body walk (edges + lock regions + waits)
+# ---------------------------------------------------------------------------
+
+class _Resolver:
+    def __init__(self, index: "ProjectIndex", rec: ModuleRecord):
+        self.index = index
+        self.rec = rec
+
+    def _module_symbol(self, modname: str, qual: str) -> Optional[str]:
+        mod = self.index.records.get(modname)
+        if mod is None:
+            return None
+        if qual in mod.symbols:
+            return f"{modname}:{qual}"
+        # Class -> its constructor
+        if qual in mod.classes and f"{qual}.__init__" in mod.symbols:
+            return f"{modname}:{qual}.__init__"
+        # module singleton: RESIDENCY.device_put -> ResidencyRegistry...
+        head, _, meth = qual.partition(".")
+        if meth and "." not in meth and head in mod.mod_obj_types:
+            tgt = self.resolve_ctor(mod, mod.mod_obj_types[head])
+            if tgt is not None:
+                return self.resolve_method(tgt[0], tgt[1], meth)
+        return None
+
+    def resolve_ctor(self, rec: ModuleRecord,
+                     ctor: str) -> Optional[Tuple[ModuleRecord, str]]:
+        """Constructor chain -> (record, class) defining the type."""
+        if "." not in ctor:
+            if ctor in rec.classes:
+                return (rec, ctor)
+            bound = rec.imports.get(ctor)
+            if bound and bound[0] == "symbol":
+                trec = self.index.records.get(bound[1])
+                if trec is not None and bound[2] in trec.classes:
+                    return (trec, bound[2])
+            return None
+        root, _, rest = ctor.partition(".")
+        bound = rec.imports.get(root)
+        if bound and bound[0] == "module":
+            full = bound[1].split(".") + rest.split(".")
+            for i in range(len(full) - 1, 0, -1):
+                trec = self.index.records.get(".".join(full[:i]))
+                if trec is not None:
+                    qual = ".".join(full[i:])
+                    if "." not in qual and qual in trec.classes:
+                        return (trec, qual)
+        return None
+
+    def resolve_method(self, rec: ModuleRecord, cls: str,
+                       meth: str) -> Optional[str]:
+        """<cls>.<meth> in ``rec``, walking project-resolvable bases."""
+        seen: Set[Tuple[str, str]] = set()
+        frontier = [(rec, cls)]
+        for _ in range(8):
+            nxt = []
+            for r, c in frontier:
+                if (r.modname, c) in seen:
+                    continue
+                seen.add((r.modname, c))
+                qual = f"{c}.{meth}"
+                if qual in r.symbols:
+                    return r.symbols[qual].sid
+                crec = r.classes.get(c)
+                if crec is None:
+                    continue
+                for b in crec.bases:
+                    tgt = self.resolve_ctor(r, b)
+                    if tgt is not None:
+                        nxt.append(tgt)
+            if not nxt:
+                break
+            frontier = nxt
+        return None
+
+    def attr_type_of(self, rec: ModuleRecord, cls: Optional[str],
+                     attr: str) -> Optional[Tuple[ModuleRecord, str]]:
+        """Type of self.<attr> from constructor assignments, walking
+        project-resolvable bases; ctor resolved against the DEFINING
+        class's module imports."""
+        seen: Set[Tuple[str, str]] = set()
+        frontier = [(rec, cls)]
+        for _ in range(8):
+            nxt = []
+            for r, c in frontier:
+                if c is None or (r.modname, c) in seen:
+                    continue
+                seen.add((r.modname, c))
+                crec = r.classes.get(c)
+                if crec is None:
+                    continue
+                if attr in crec.attr_types:
+                    return self.resolve_ctor(r, crec.attr_types[attr])
+                for b in crec.bases:
+                    tgt = self.resolve_ctor(r, b)
+                    if tgt is not None:
+                        nxt.append(tgt)
+            if not nxt:
+                break
+            frontier = nxt
+        return None
+
+    def resolve_chain(self, chain: str) -> Optional[str]:
+        """'alias.sub.fn' -> sid, via the module's import bindings."""
+        parts = chain.split(".")
+        bound = self.rec.imports.get(parts[0])
+        if bound is None:
+            # this module's own singleton: RESIDENCY.track(...)
+            if len(parts) == 2 and parts[0] in self.rec.mod_obj_types:
+                tgt = self.resolve_ctor(self.rec,
+                                        self.rec.mod_obj_types[parts[0]])
+                if tgt is not None:
+                    return self.resolve_method(tgt[0], tgt[1], parts[1])
+            return None
+        if bound[0] == "module":
+            full = bound[1].split(".") + parts[1:]
+            # longest prefix that is an analyzed module; remainder is the
+            # symbol path inside it
+            for i in range(len(full) - 1, 0, -1):
+                mod = ".".join(full[:i])
+                if mod in self.index.module_set:
+                    return self._module_symbol(mod, ".".join(full[i:]))
+            return None
+        _k, base, name = bound
+        return self._module_symbol(base, ".".join([name] + parts[1:]))
+
+    def resolve_self_attr(self, cls_name: Optional[str],
+                          attr: str) -> Optional[str]:
+        """self.<attr> within ``cls_name``, walking project-resolvable
+        base classes (depth-limited)."""
+        seen: Set[Tuple[str, str]] = set()
+        frontier = [(self.rec, cls_name)]
+        for _ in range(8):
+            nxt = []
+            for rec, cname in frontier:
+                if cname is None or (rec.modname, cname) in seen:
+                    continue
+                seen.add((rec.modname, cname))
+                qual = f"{cname}.{attr}"
+                if qual in rec.symbols:
+                    return rec.symbols[qual].sid
+                crec = rec.classes.get(cname)
+                if crec is None:
+                    continue
+                for b in crec.bases:
+                    if b in rec.classes:
+                        nxt.append((rec, b))
+                        continue
+                    sid = None if "." in b else None
+                    bound = rec.imports.get(b.split(".")[0])
+                    if bound and bound[0] == "symbol":
+                        brec = self.index.records.get(bound[1])
+                        if brec is not None:
+                            nxt.append((brec, bound[2]))
+                    del sid
+            if not nxt:
+                break
+            frontier = nxt
+        return None
+
+    def resolve_attr_objects(self, cls_name: Optional[str], attr_kind: str,
+                             attr: str) -> bool:
+        """Is self.<attr> a known lock/cond/event/queue of cls (or a
+        project-resolvable base)?"""
+        frontier = [(self.rec, cls_name)]
+        seen: Set[Tuple[str, str]] = set()
+        for _ in range(8):
+            nxt = []
+            for rec, cname in frontier:
+                if cname is None or (rec.modname, cname) in seen:
+                    continue
+                seen.add((rec.modname, cname))
+                crec = rec.classes.get(cname)
+                if crec is None:
+                    continue
+                if attr in getattr(crec, attr_kind):
+                    return True
+                for b in crec.bases:
+                    if b in rec.classes:
+                        nxt.append((rec, b))
+                    else:
+                        bound = rec.imports.get(b.split(".")[0])
+                        if bound and bound[0] == "symbol":
+                            brec = self.index.records.get(bound[1])
+                            if brec is not None:
+                                nxt.append((brec, bound[2]))
+            if not nxt:
+                return False
+            frontier = nxt
+        return False
+
+    def owner_class_of_attr(self, cls_name: Optional[str], attr_kind: str,
+                            attr: str) -> Optional[Tuple[str, str]]:
+        """(modname, class) defining self.<attr>, for stable lock ids."""
+        frontier = [(self.rec, cls_name)]
+        seen: Set[Tuple[str, str]] = set()
+        for _ in range(8):
+            nxt = []
+            for rec, cname in frontier:
+                if cname is None or (rec.modname, cname) in seen:
+                    continue
+                seen.add((rec.modname, cname))
+                crec = rec.classes.get(cname)
+                if crec is None:
+                    continue
+                if attr in getattr(crec, attr_kind):
+                    return (rec.modname, cname)
+                for b in crec.bases:
+                    if b in rec.classes:
+                        nxt.append((rec, b))
+                    else:
+                        bound = rec.imports.get(b.split(".")[0])
+                        if bound and bound[0] == "symbol":
+                            brec = self.index.records.get(bound[1])
+                            if brec is not None:
+                                nxt.append((brec, bound[2]))
+            if not nxt:
+                return None
+            frontier = nxt
+        return None
+
+
+def _iter_own_body(node):
+    """Statements of a function body, NOT descending into nested defs
+    (their bodies belong to their own symbols)."""
+    work = list(node.body)
+    while work:
+        stmt = work.pop()
+        yield stmt
+        for sub in ast.iter_child_nodes(stmt):
+            if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                work.append(sub)
+
+
+def _expr_static(expr: ast.AST, nonstatic: Set[str],
+                 jnp_aliases: Set[str]) -> bool:
+    """Is this expression a trace-time constant? Free names outside
+    ``nonstatic`` are closure/global constants (the program-factory
+    idiom: config closed over by the traced body); ``.shape``/``.dtype``
+    /``.ndim`` and ``len()`` of ANYTHING are static under trace; jnp-
+    rooted calls produce device values and are never static."""
+    if isinstance(expr, ast.Constant):
+        return True
+    if isinstance(expr, ast.Name):
+        return expr.id not in nonstatic
+    if isinstance(expr, ast.Attribute):
+        if expr.attr in ("shape", "ndim", "dtype", "size"):
+            return True
+        return _expr_static(expr.value, nonstatic, jnp_aliases)
+    if isinstance(expr, ast.Subscript):
+        return _expr_static(expr.value, nonstatic, jnp_aliases) and \
+            _expr_static(expr.slice, nonstatic, jnp_aliases)
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        return all(_expr_static(e, nonstatic, jnp_aliases)
+                   for e in expr.elts)
+    if isinstance(expr, ast.BinOp):
+        return _expr_static(expr.left, nonstatic, jnp_aliases) and \
+            _expr_static(expr.right, nonstatic, jnp_aliases)
+    if isinstance(expr, ast.UnaryOp):
+        return _expr_static(expr.operand, nonstatic, jnp_aliases)
+    if isinstance(expr, ast.BoolOp):
+        return all(_expr_static(v, nonstatic, jnp_aliases)
+                   for v in expr.values)
+    if isinstance(expr, ast.Compare):
+        return _expr_static(expr.left, nonstatic, jnp_aliases) and \
+            all(_expr_static(c, nonstatic, jnp_aliases)
+                for c in expr.comparators)
+    if isinstance(expr, ast.IfExp):
+        return all(_expr_static(e, nonstatic, jnp_aliases)
+                   for e in (expr.test, expr.body, expr.orelse))
+    if isinstance(expr, ast.Slice):
+        return all(e is None or _expr_static(e, nonstatic, jnp_aliases)
+                   for e in (expr.lower, expr.upper, expr.step))
+    if isinstance(expr, ast.Call):
+        chain = _attr_chain(expr.func) or ""
+        root = chain.split(".")[0]
+        if root in jnp_aliases:
+            return False  # device-value producer
+        if _name(expr.func) == "len":
+            return True   # static under trace regardless of operand
+        # the callee expression itself must be static too: x.sum() is a
+        # method of a traced value, not a closure helper
+        return _expr_static(expr.func, nonstatic, jnp_aliases) and \
+            all(_expr_static(a, nonstatic, jnp_aliases)
+                for a in expr.args) and \
+            all(_expr_static(kw.value, nonstatic, jnp_aliases)
+                for kw in expr.keywords)
+    return False
+
+
+def _nonstatic_locals(rec: ModuleRecord, sym: FnSymbol) -> Set[str]:
+    """Names of ``sym`` that may hold trace-dependent (device) values:
+    parameters, loop/with/except/lambda bindings, and assignments whose
+    RHS isn't provably static. Everything else — closure constants and
+    statically-derived locals (``kp = min(4 * k, D)`` over closure ints,
+    ``S = av.shape[0]``) — classifies as static at call sites, so
+    config threaded through helper calls doesn't false-trace R004."""
+    jnp = rec.info.jnp
+    nonstatic: Set[str] = set(sym.params)
+    assigns: List[Tuple[Set[str], ast.AST]] = []
+
+    def _targets(t, out: Set[str]) -> None:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                _targets(e, out)
+        elif isinstance(t, ast.Starred):
+            _targets(t.value, out)
+
+    for stmt in _iter_own_body(sym.node):
+        if isinstance(stmt, ast.Assign):
+            names: Set[str] = set()
+            for t in stmt.targets:
+                _targets(t, names)
+            assigns.append((names, stmt.value))
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            names = set()
+            _targets(stmt.target, names)
+            assigns.append((names, stmt.value))
+        elif isinstance(stmt, ast.AugAssign):
+            names = set()
+            _targets(stmt.target, names)
+            assigns.append((names, stmt.value))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            _targets(stmt.target, nonstatic)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    _targets(item.optional_vars, nonstatic)
+        elif isinstance(stmt, ast.ExceptHandler) and stmt.name:
+            nonstatic.add(stmt.name)
+        elif isinstance(stmt, ast.Lambda):
+            nonstatic.update(_fn_params(stmt))
+        elif isinstance(stmt, (ast.ListComp, ast.SetComp, ast.DictComp,
+                               ast.GeneratorExp)):
+            for gen in stmt.generators:
+                _targets(gen.target, nonstatic)
+        elif isinstance(stmt, (ast.NamedExpr,)):
+            _targets(stmt.target, nonstatic)
+    # demotion fixpoint: an assigned name goes nonstatic when ANY of its
+    # bindings references something nonstatic (or a jnp producer)
+    changed = True
+    while changed:
+        changed = False
+        for names, rhs in assigns:
+            if names <= nonstatic:
+                continue
+            if not _expr_static(rhs, nonstatic, jnp):
+                before = len(nonstatic)
+                nonstatic |= names
+                changed = changed or len(nonstatic) != before
+    return nonstatic
+
+
+class _BodyWalker(ast.NodeVisitor):
+    """One pass over a single function body (nested defs excluded — they
+    are their own symbols, linked by a REF edge)."""
+
+    def __init__(self, rec: ModuleRecord, sym: FnSymbol, res: _Resolver):
+        self.rec = rec
+        self.sym = sym
+        self.res = res
+        self.held: List[str] = []
+        self.aliases: Dict[str, str] = {}   # local name -> sid
+        self.nonstatic: Set[str] = _nonstatic_locals(rec, sym)
+
+    # -- resolution ----------------------------------------------------------
+
+    def _resolve_callable(self, expr: ast.AST) -> Optional[str]:
+        nm = _name(expr)
+        if nm is not None:
+            if nm in self.aliases:
+                return self.aliases[nm]
+            # nested siblings / enclosing-scope defs: try successively
+            # shorter prefixes of this symbol's qual
+            parts = self.sym.qual.split(".")
+            for i in range(len(parts), -1, -1):
+                qual = ".".join(parts[:i] + [nm])
+                if qual in self.rec.symbols:
+                    return self.rec.symbols[qual].sid
+            if nm in self.rec.classes and \
+                    f"{nm}.__init__" in self.rec.symbols:
+                return self.rec.symbols[f"{nm}.__init__"].sid
+            if nm in self.rec.imports:
+                return self.res.resolve_chain(nm)
+            return None
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        root, _, rest = chain.partition(".")
+        if root in ("self", "cls") and self.sym.cls and rest:
+            parts = rest.split(".")
+            if len(parts) == 1:
+                return self.res.resolve_self_attr(self.sym.cls, rest)
+            if len(parts) == 2:
+                # self.<attr>.<method> via constructor type inference
+                tinfo = self.res.attr_type_of(self.rec, self.sym.cls,
+                                              parts[0])
+                if tinfo is not None:
+                    return self.res.resolve_method(tinfo[0], tinfo[1],
+                                                   parts[1])
+            return None
+        # ClassName.method within this module
+        if root in self.rec.classes and rest and "." not in rest:
+            qual = f"{root}.{rest}"
+            if qual in self.rec.symbols:
+                return self.rec.symbols[qual].sid
+        return self.res.resolve_chain(chain)
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        chain = _attr_chain(expr)
+        if not chain:
+            return None
+        if chain.startswith("self.") and "." not in chain[5:]:
+            attr = chain[5:]
+            owner = self.res.owner_class_of_attr(self.sym.cls, "locks", attr)
+            if owner is not None:
+                return f"{owner[0]}:{owner[1]}.{attr}"
+            return None
+        parts = chain.split(".")
+        if len(parts) == 1:
+            if chain in self.rec.mod_locks:
+                return f"{self.rec.modname}:{chain}"
+            bound = self.rec.imports.get(chain)
+            if bound and bound[0] == "symbol":  # from mod import LOCK
+                target = self.res.index.records.get(bound[1])
+                if target is not None and bound[2] in target.mod_locks:
+                    return f"{target.modname}:{bound[2]}"
+            return None
+        # imported module-level lock: mod.LOCK / pkg.sub.LOCK
+        bound = self.rec.imports.get(parts[0])
+        if bound and bound[0] == "module":
+            full = bound[1].split(".") + parts[1:]
+            mod, name = ".".join(full[:-1]), full[-1]
+            target = self.res.index.records.get(mod)
+            if target is not None and name in target.mod_locks:
+                return f"{target.modname}:{name}"
+        return None
+
+    def _is_known(self, expr: ast.AST, kind: str) -> bool:
+        """Receiver resolves to a known event/queue/cond object."""
+        chain = _attr_chain(expr)
+        if not chain:
+            return False
+        if chain.startswith("self.") and "." not in chain[5:]:
+            return self.res.resolve_attr_objects(self.sym.cls, kind,
+                                                 chain[5:])
+        if "." not in chain:
+            return chain in {"events": self.rec.mod_events,
+                             "queues": self.rec.mod_queues,
+                             "conds": self.rec.mod_conds}[kind]
+        return False
+
+    # -- structure -----------------------------------------------------------
+
+    def _skip_nested(self, node) -> None:
+        qual = f"{self.sym.qual}.{node.name}"
+        nested = self.rec.symbols.get(qual)
+        if nested is not None:
+            self.sym.edges.append(CallEdge(nested.sid, "ref",
+                                           getattr(node, "lineno", 0)))
+        # body handled when the nested symbol itself is walked
+
+    visit_FunctionDef = _skip_nested
+    visit_AsyncFunctionDef = _skip_nested
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        return  # function-local classes: out of scope
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1:
+            tgt = _name(node.targets[0])
+            if tgt:
+                sid = None
+                if isinstance(node.value, (ast.Name, ast.Attribute)):
+                    sid = self._resolve_callable(node.value)
+                if sid is not None:
+                    self.aliases[tgt] = sid
+                else:
+                    self.aliases.pop(tgt, None)
+        self.visit(node.value)
+
+    def visit_With(self, node: ast.With) -> None:
+        ids = []
+        for item in node.items:
+            self.visit(item.context_expr)
+            lid = self._lock_id(item.context_expr)
+            if lid is not None:
+                for h in self.held:
+                    if h != lid:
+                        self.sym.lock_edges.append((h, lid, node.lineno))
+                self.sym.acquires.append((lid, node.lineno))
+                self.held.append(lid)
+                ids.append(lid)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in ids:
+            self.held.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- calls ---------------------------------------------------------------
+
+    def _classify_arg(self, expr: ast.AST):
+        nm = _name(expr)
+        if nm is not None and nm in self.sym.params:
+            return ("param", nm)
+        if _expr_static(expr, self.nonstatic, self.rec.info.jnp):
+            return "const"
+        return "dyn"
+
+    def _map_args(self, call: ast.Call, callee: FnSymbol,
+                  drop_self: bool) -> Tuple[List[Tuple[str, object]], bool]:
+        cparams = [p for p in _fn_params(callee.node, include_var=False)]
+        if drop_self and cparams and cparams[0] in ("self", "cls"):
+            cparams = cparams[1:]
+        cnode = callee.node
+        has_var = cnode.args.vararg is not None
+        out: List[Tuple[str, object]] = []
+        all_dyn = False
+        for i, a in enumerate(call.args):
+            if isinstance(a, ast.Starred):
+                all_dyn = True
+                continue
+            if i < len(cparams):
+                out.append((cparams[i], self._classify_arg(a)))
+            elif has_var:
+                out.append((cnode.args.vararg.arg, self._classify_arg(a)))
+        for kw in call.keywords:
+            if kw.arg is None:       # **kwargs splat
+                all_dyn = True
+            elif kw.arg in cparams:
+                out.append((kw.arg, self._classify_arg(kw.value)))
+            elif cnode.args.kwarg is not None:
+                out.append((cnode.args.kwarg.arg,
+                            self._classify_arg(kw.value)))
+        return out, all_dyn
+
+    def _wait_desc(self, node: ast.Call) -> Optional[str]:
+        """Unbounded-blocking-wait shapes (R010's, receiver-verified):
+        ``Event.wait()`` with no timeout, ``queue.get()`` blocking with
+        no timeout. Condition.wait is excluded — it RELEASES the lock it
+        holds."""
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        if f.attr == "wait":
+            if node.args or any(kw.arg == "timeout" for kw in node.keywords):
+                return None
+            if self._is_known(f.value, "events"):
+                return "Event.wait()"
+            return None
+        if f.attr == "get":
+            if not self._is_known(f.value, "queues"):
+                return None
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                return None
+            if len(node.args) >= 2:
+                return None
+            blk = next((kw.value for kw in node.keywords
+                        if kw.arg == "block"), None)
+            if blk is not None and not (isinstance(blk, ast.Constant)
+                                        and blk.value is True):
+                return None
+            if len(node.args) == 1 and not (
+                    isinstance(node.args[0], ast.Constant)
+                    and node.args[0].value is True):
+                return None
+            return "queue.get()"
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        sid = self._resolve_callable(node.func)
+        chain = _attr_chain(node.func) or ""
+        base = chain.rpartition(".")[2]
+        if sid is not None:
+            callee = self.res.index.symbols.get(sid)
+            if callee is not None:
+                drop_self = isinstance(node.func, ast.Attribute) or \
+                    sid.endswith(".__init__")
+                args, all_dyn = self._map_args(node, callee, drop_self)
+                self.sym.edges.append(CallEdge(
+                    sid, "call", node.lineno, args, all_dyn,
+                    tuple(self.held)))
+        # wrapper-marked roots: function-valued args get traced/collective
+        if base in TRACED_WRAPPER_NAMES:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                asid = None
+                if isinstance(a, (ast.Name, ast.Attribute)):
+                    asid = self._resolve_callable(a)
+                if asid is not None and asid in self.res.index.symbols:
+                    tgt = self.res.index.symbols[asid]
+                    tgt.is_root = True
+                    tgt.root_all_params = True
+                    if base in COLLECTIVE_WRAPPER_NAMES:
+                        tgt.is_collective_root = True
+        if base in COLLECTIVE_OP_NAMES:
+            self.sym.has_collective_call = True
+        # .acquire() on a known lock: an acquire event (edge target) even
+        # though no lexical held-region opens (release is untracked)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "acquire":
+            lid = self._lock_id(node.func.value)
+            if lid is not None:
+                for h in self.held:
+                    if h != lid:
+                        self.sym.lock_edges.append((h, lid, node.lineno))
+                self.sym.acquires.append((lid, node.lineno))
+        desc = self._wait_desc(node)
+        if desc is not None:
+            self.sym.direct_waits.append((node.lineno, desc))
+            if self.held:
+                self.sym.waits_under.append((self.held[-1], node.lineno,
+                                             desc))
+        # function REFERENCES passed as arguments (vmap/partial/callbacks)
+        for a in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(a, (ast.Name, ast.Attribute)):
+                asid = self._resolve_callable(a)
+                if asid is not None:
+                    self.sym.edges.append(CallEdge(asid, "ref", node.lineno))
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# pass 1c: fixpoints
+# ---------------------------------------------------------------------------
+
+def _traced_fixpoint(index: ProjectIndex) -> None:
+    traced = index.traced
+    work: List[str] = []
+    for sid, sym in index.symbols.items():
+        if sym.is_root:
+            params = set(sym.params)
+            if not sym.root_all_params:
+                params -= sym.statics
+            traced[sid] = params
+            work.append(sid)
+    while work:
+        sid = work.pop()
+        sym = index.symbols.get(sid)
+        if sym is None:
+            continue
+        cur = traced.get(sid, set())
+        for e in sym.edges:
+            callee = index.symbols.get(e.callee)
+            if callee is None:
+                continue
+            if e.kind == "ref" or e.all_dyn:
+                want = set(callee.params)
+            else:
+                want = set()
+                for param, kind in e.args:
+                    if kind == "const":
+                        continue
+                    if isinstance(kind, tuple):
+                        if kind[1] in cur:
+                            want.add(param)
+                    else:
+                        want.add(param)
+            # params the callee's OWN jit binding declares static stay
+            # static: under an outer trace the inner jit still requires
+            # hashable Python statics there (passing a tracer is a
+            # different error, raised loudly at runtime)
+            want -= callee.statics
+            prev = traced.get(e.callee)
+            if prev is None:
+                traced[e.callee] = want
+                work.append(e.callee)
+            elif not want <= prev:
+                prev |= want
+                work.append(e.callee)
+
+
+def _collective_fixpoint(index: ProjectIndex) -> None:
+    roots = {sid for sid, s in index.symbols.items()
+             if s.is_collective_root
+             or (s.has_collective_call and sid in index.traced)}
+    seen = set(roots)
+    work = list(roots)
+    while work:
+        sid = work.pop()
+        sym = index.symbols.get(sid)
+        if sym is None:
+            continue
+        for e in sym.edges:
+            if e.callee in index.symbols and e.callee not in seen:
+                seen.add(e.callee)
+                work.append(e.callee)
+    index.collective = seen
+
+
+def _lock_analysis(index: ProjectIndex) -> None:
+    # transitive acquires / waits per symbol (call edges only)
+    acq: Dict[str, Set[str]] = {sid: {l for l, _ in s.acquires}
+                                for sid, s in index.symbols.items()}
+    waits: Dict[str, Optional[Tuple[str, str]]] = {
+        sid: ((s.direct_waits[0][1], sid) if s.direct_waits else None)
+        for sid, s in index.symbols.items()}
+    changed = True
+    while changed:
+        changed = False
+        for sid, sym in index.symbols.items():
+            a = acq[sid]
+            w = waits[sid]
+            for e in sym.edges:
+                if e.kind != "call" or e.callee not in acq:
+                    continue
+                extra = acq[e.callee] - a
+                if extra:
+                    a |= extra
+                    changed = True
+                if w is None and waits[e.callee] is not None:
+                    waits[sid] = waits[e.callee]
+                    changed = True
+                    w = waits[sid]
+    # global held -> acquired edges with witnesses
+    edges = index.lock_edges
+    for sid, sym in index.symbols.items():
+        rec = index.records[sym.module]
+        for h, l, line in sym.lock_edges:
+            edges.setdefault((h, l), (rec.path, line))
+        for e in sym.edges:
+            if e.kind != "call" or not e.held:
+                continue
+            callee_acqs = acq.get(e.callee, ())
+            for l in callee_acqs:
+                for h in e.held:
+                    if h != l:
+                        edges.setdefault((h, l), (rec.path, e.line))
+            # lock-held call into an unbounded blocking wait
+            cw = waits.get(e.callee)
+            if cw is not None:
+                desc, where = cw
+                index.wait_violations.append((
+                    rec.path, e.line,
+                    f"call into an unbounded blocking wait ({desc} "
+                    f"reached via `{where}`) while holding "
+                    f"`{e.held[-1]}` — a lost notify or a dead producer "
+                    "wedges every thread queued behind this lock; bound "
+                    "the wait (timeout=) or release the lock first"))
+    # direct waits under a held lock (R010 owns serving/; R013 the rest)
+    for sid, sym in index.symbols.items():
+        rec = index.records[sym.module]
+        if "/serving/" in "/" + rec.path:
+            continue
+        for h, line, desc in sym.waits_under:
+            index.wait_violations.append((
+                rec.path, line,
+                f"unbounded {desc} while holding `{h}` — a lost notify "
+                "wedges every thread queued behind this lock; bound the "
+                "wait (timeout=) or park outside the lock"))
+    # cycle detection (self-edges excluded: RLock re-entry is legal)
+    graph: Dict[str, Set[str]] = {}
+    for (h, l) in edges:
+        graph.setdefault(h, set()).add(l)
+        graph.setdefault(l, set())
+    index.lock_cycles = _find_cycles(graph)
+
+
+def _find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles, one representative per cyclic SCC (Tarjan +
+    one in-SCC walk) — enough for reporting; the gate needs zero."""
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        idx[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in graph.get(v, ()):
+            if w not in idx:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], idx[w])
+        if low[v] == idx[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in idx:
+            strongconnect(v)
+    cycles: List[List[str]] = []
+    for comp in sccs:
+        cset = set(comp)
+        start = min(comp)
+        # DFS within the SCC, tracking the current path: a cyclic SCC
+        # always contains a back-edge to a path node, so this cannot
+        # dead-end the way a greedy no-revisit walk could (a walk that
+        # strays into a side branch of the SCC would report NOTHING for
+        # a genuinely cyclic component — a silently passing gate)
+        path: List[str] = [start]
+        on_path = {start}
+        iters = [iter(sorted(w for w in graph.get(start, ())
+                             if w in cset))]
+        visited = {start}
+        found: List[str] = []
+        while iters and not found:
+            try:
+                w = next(iters[-1])
+            except StopIteration:
+                iters.pop()
+                on_path.discard(path.pop())
+                continue
+            if w in on_path:
+                found = path[path.index(w):]
+            elif w not in visited:
+                visited.add(w)
+                path.append(w)
+                on_path.add(w)
+                iters.append(iter(sorted(x for x in graph.get(w, ())
+                                         if x in cset)))
+        if found:
+            cycles.append(found)
+    return cycles
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _relpath(path: str, root: Optional[str]) -> str:
+    rel = path
+    if root:
+        ap, ar = os.path.abspath(path), os.path.abspath(root)
+        if ap == ar or ap.startswith(ar + os.sep):
+            rel = os.path.relpath(ap, ar)
+    return rel.replace(os.sep, "/")
+
+
+def build_project(paths: Sequence[str], root: Optional[str] = None,
+                  overlay: Optional[Dict[str, str]] = None,
+                  ) -> Tuple[ProjectIndex, List[Violation]]:
+    """Pass 1 over real files. ``overlay`` maps root-relative paths to
+    replacement sources (seeded-violation regression tests). Returns the
+    index plus R000 syntax-error violations for unparseable files."""
+    sources: Dict[str, str] = {}
+    for f in iter_python_files(paths):
+        rel = _relpath(f, root)
+        if overlay and rel in overlay:
+            sources[rel] = overlay[rel]
+            continue
+        with open(f, "r", encoding="utf-8") as fh:
+            sources[rel] = fh.read()
+    if overlay:
+        for rel, src in overlay.items():
+            sources.setdefault(rel, src)
+    return analyze_sources(sources)
+
+
+def analyze_sources(sources: Dict[str, str],
+                    ) -> Tuple[ProjectIndex, List[Violation]]:
+    """Pass 1 over in-memory sources {relpath: source} (fixture entry)."""
+    records: List[ModuleRecord] = []
+    errors: List[Violation] = []
+    for rel in sorted(sources):
+        try:
+            records.append(ModuleRecord(rel, sources[rel]))
+        except SyntaxError as e:
+            errors.append(Violation("R000", rel.replace(os.sep, "/"),
+                                    e.lineno or 0, e.offset or 0,
+                                    f"syntax error: {e.msg}", ""))
+    module_set = {r.modname for r in records}
+    # packages exist as modules even without their __init__ in the set
+    for r in records:
+        parts = r.modname.split(".")
+        for i in range(1, len(parts)):
+            module_set.add(".".join(parts[:i]))
+    index = ProjectIndex(records, module_set)
+    for rec in records:
+        _collect_imports(rec, module_set)
+        _SymbolCollector(rec).visit(rec.tree)
+    index.symbols = {}
+    for rec in records:
+        for s in rec.symbols.values():
+            index.symbols[s.sid] = s
+    for rec in records:
+        res = _Resolver(index, rec)
+        for s in rec.symbols.values():
+            walker = _BodyWalker(rec, s, res)
+            for stmt in s.node.body:
+                walker.visit(stmt)
+    _traced_fixpoint(index)
+    _collective_fixpoint(index)
+    _lock_analysis(index)
+    return index, errors
+
+
+def _project_violations(index: ProjectIndex) -> List[Violation]:
+    """R013 findings from the global lock graph, attributed to witness
+    files (suppressions applied by the caller per file)."""
+    out: List[Violation] = []
+    for cycle in index.lock_cycles:
+        hops = []
+        witness = None
+        ring = cycle + [cycle[0]]
+        for a, b in zip(ring, ring[1:]):
+            w = index.lock_edges.get((a, b))
+            hops.append(f"{a} → {b}" + (f" ({w[0]}:{w[1]})" if w else ""))
+            if witness is None and w is not None:
+                witness = w
+        path, line = witness if witness else ("<project>", 0)
+        rec = index.by_path.get(path)
+        out.append(Violation(
+            "R013", path, line, 0,
+            "lock-order cycle: " + "; ".join(hops) + " — two threads "
+            "acquiring these locks in different orders deadlock; pick one "
+            "global acquisition order (or split the critical sections)",
+            snippet_at(rec.lines, line) if rec else ""))
+    for path, line, msg in index.wait_violations:
+        rec = index.by_path.get(path)
+        out.append(Violation("R013", path, line, 0, msg,
+                             snippet_at(rec.lines, line) if rec else ""))
+    return out
+
+
+def lint_project(paths: Sequence[str], root: Optional[str] = None,
+                 overlay: Optional[Dict[str, str]] = None,
+                 ) -> List[Violation]:
+    """The two-pass whole-program lint: build the project index, then run
+    every per-file rule with the graph-inferred traced/collective context,
+    plus the global R013 lock-graph findings."""
+    index, errors = build_project(paths, root=root, overlay=overlay)
+    return lint_index(index) + errors
+
+
+def lint_sources(sources: Dict[str, str]) -> List[Violation]:
+    """Two-pass lint over in-memory sources (multi-module fixtures)."""
+    index, errors = analyze_sources(sources)
+    return lint_index(index) + errors
+
+
+def lint_index(index: ProjectIndex) -> List[Violation]:
+    from tools.tpulint import analyzer as _an
+    from tools.tpulint import rules as _rules
+
+    out: List[Violation] = []
+    for rec in index.records.values():
+        ctx = _an.make_file_context(
+            rec.path, rec.lines, rec.supp,
+            ext_traced=index.traced_for_module(rec.modname),
+            ext_collective=index.collective_for_module(rec.modname))
+        found = _rules.check_module(rec.tree, ctx)
+        out.extend(v for v in found if not rec.supp.suppressed(v))
+    for v in _project_violations(index):
+        rec = index.by_path.get(v.path)
+        if rec is not None and rec.supp.suppressed(v):
+            continue
+        out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.col, v.rule))

@@ -1,12 +1,17 @@
-"""tpulint rule visitors (R001–R012).
+"""tpulint rule visitors (R001–R014, pass 2 of the whole-program
+analysis).
 
 One recursive walk per file carries the context every rule needs: the
 loop stack (R001/R002), the traced-function stack with its static/traced
-parameter split (R003/R004), and the lock-held stack (R005). A module
-pre-pass first resolves import aliases (``jnp``/``np``/``jax``), the
-module's jitted callables with their ``static_argnames``, and — for
-lock-disciplined modules — the module/instance lock names and the shared
-mutable globals they guard.
+parameter split (R003/R004), the lock-held stack (R005), and the
+collective depth (R014). A module pre-pass first resolves import
+aliases (``jnp``/``np``/``jax``/``lax``), the module's jitted callables
+with their ``static_argnames``, and — for lock-disciplined modules —
+the module/instance lock names and the shared mutable globals they
+guard. In project mode (tools/tpulint/project.py), ``FileContext``
+additionally carries the call-graph-inferred traced/collective function
+sets, so the traced checks enter helpers the per-file view can't see;
+R013's lock-graph findings are computed globally in project.py.
 """
 from __future__ import annotations
 
@@ -42,6 +47,12 @@ class FileContext:
     audit: bool = False    # R012 applies (product modules outside the
     #                        trace-audited packages)
     host_lines: Set[int] = field(default_factory=set)
+    # whole-program pass 2 (tools/tpulint/project.py): functions of THIS
+    # module inferred traced (qualname -> traced parameter names) or in
+    # collective (shard_map/psum) reach, from the project call graph.
+    # Empty in single-file mode — only local jit roots enter trace then.
+    ext_traced: Dict[str, Set[str]] = field(default_factory=dict)
+    ext_collective: Set[str] = field(default_factory=set)
 
 
 @dataclass
@@ -92,6 +103,19 @@ def _param_names(fn: ast.AST) -> List[str]:
     return names
 
 
+def _all_param_names(fn: ast.AST) -> List[str]:
+    """_param_names plus *args/**kwargs — the traced-value universe (a
+    vararg inside a traced body is a tracer tuple; static_argnums
+    indexing stays on _param_names, matching jax's positional rules)."""
+    a = fn.args
+    names = _param_names(fn)
+    if a.vararg is not None:
+        names.append(a.vararg.arg)
+    if a.kwarg is not None:
+        names.append(a.kwarg.arg)
+    return names
+
+
 class _ModuleInfo:
     """Pre-pass over the module body: aliases, jitted callables, locks."""
 
@@ -99,6 +123,7 @@ class _ModuleInfo:
         self.jax: Set[str] = set()
         self.jnp: Set[str] = set()
         self.np: Set[str] = set()
+        self.lax: Set[str] = set()    # `from jax import lax [as l]`
         self.jit_names: Set[str] = set()      # `from jax import jit [as j]`
         self.partial_names: Set[str] = set()  # functools.partial aliases
         self.jitted: Dict[str, JitTarget] = {}
@@ -168,6 +193,8 @@ class _ModuleInfo:
                             self.jnp.add(al.asname or "numpy")
                         if al.name == "device_put":
                             self.put_fns.add(al.asname or "device_put")
+                        if al.name == "lax":
+                            self.lax.add(al.asname or "lax")
                 elif node.module == "functools":
                     for al in node.names:
                         if al.name == "partial":
@@ -269,6 +296,21 @@ class _ModuleInfo:
         return None
 
 
+def _walk_skip_static_attrs(node: ast.AST):
+    """ast.walk, but skip subtrees under ``.shape``/``.dtype``/``.ndim``/
+    ``.size`` attribute access — those are trace-time STATIC properties
+    of a traced array (``if x.dtype == jnp.bfloat16:`` resolves at trace
+    time and is legal Python branching)."""
+    work = [node]
+    while work:
+        n = work.pop()
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "dtype",
+                                                       "ndim", "size"):
+            continue
+        yield n
+        work.extend(ast.iter_child_nodes(n))
+
+
 # ---------------------------------------------------------------------------
 # the walk
 # ---------------------------------------------------------------------------
@@ -289,6 +331,9 @@ class _Checker(ast.NodeVisitor):
         self.traced_stack: List[_TracedCtx] = []
         self.lock_depth = 0            # inside `with <known lock>`
         self.block_depth = 0           # inside `with <lock OR condition>`
+        self.coll_depth = 0            # inside collective (R014) reach
+        self.qual_stack: List[str] = []  # class+fn names — the project
+        #                                  symbol qualname convention
         self.class_stack: List[str] = []
         self.class_locks: Dict[str, Set[str]] = {}  # class -> self lock attrs
         self.class_conds: Dict[str, Set[str]] = {}  # class -> self cond attrs
@@ -330,24 +375,40 @@ class _Checker(ast.NodeVisitor):
             self.class_locks[node.name] = locks
             self.class_conds[node.name] = conds
         self.class_stack.append(node.name)
+        self.qual_stack.append(node.name)
         self.generic_visit(node)
+        self.qual_stack.pop()
         self.class_stack.pop()
 
     def _visit_function(self, node) -> None:
+        qual = ".".join(self.qual_stack + [node.name])
         statics = self.mod.decorator_jit(node)
         wrapped = node.name in self.mod.wrapped_fns
-        entering_trace = statics is not None or wrapped or bool(
-            self.traced_stack)
+        # ext_traced: the whole-program pass inferred this function is
+        # reachable from a jit/pallas/shard_map body (with the traced
+        # parameter subset refined from its call sites)
+        ext = self.ctx.ext_traced.get(qual)
+        entering_trace = (statics is not None or wrapped
+                          or bool(self.traced_stack) or ext is not None)
         if entering_trace:
-            traced = set(_param_names(node)) - (statics or set())
+            if statics is not None or wrapped or self.traced_stack:
+                traced = set(_all_param_names(node)) - (statics or set())
+            else:
+                traced = set(ext or ())
+            if ext:
+                traced |= ext
             if self.traced_stack:  # nested def inherits the outer view
                 traced |= self.traced_stack[-1].traced
             self.traced_stack.append(_TracedCtx(node.name, traced))
+        entering_coll = qual in self.ctx.ext_collective or self.coll_depth
+        if entering_coll:
+            self.coll_depth += 1
         if (statics is not None or wrapped) and self.loop_depth:
             self._emit("R001", node,
                        f"jitted function `{node.name}` is (re)defined inside "
                        "a loop — every iteration builds a fresh callable and "
                        "retraces; hoist the jit out of the loop")
+        self.qual_stack.append(node.name)
         self.fn_stack.append(node.name)
         self.wall_names.append(set())
         self.metric_names.append(set())
@@ -361,6 +422,9 @@ class _Checker(ast.NodeVisitor):
         self.metric_names.pop()
         self.wall_names.pop()
         self.fn_stack.pop()
+        self.qual_stack.pop()
+        if entering_coll:
+            self.coll_depth -= 1
         if entering_trace:
             self.traced_stack.pop()
 
@@ -434,7 +498,7 @@ class _Checker(ast.NodeVisitor):
                 and (_is_none(test.left)
                      or all(_is_none(c) for c in test.comparators)):
             return
-        hits = sorted({n.id for n in ast.walk(test)
+        hits = sorted({n.id for n in _walk_skip_static_attrs(test)
                        if isinstance(n, ast.Name) and n.id in traced})
         if hits:
             kind = "while" if isinstance(node, ast.While) else "if"
@@ -461,7 +525,71 @@ class _Checker(ast.NodeVisitor):
         self._check_metric_record(node)
         self._check_blocking_wait(node)
         self._check_cluster_thread(node)
+        self._check_collective_purity(node)
         self.generic_visit(node)
+
+    # -- R014 ---------------------------------------------------------------
+
+    def _touches_traced(self, node: ast.AST) -> bool:
+        if not self.traced_stack:
+            return False
+        traced = self.traced_stack[-1].traced
+        return any(isinstance(n, ast.Name)
+                   and (n.id in traced or n.id in self.device_names[-1])
+                   for n in ast.walk(node))
+
+    def _check_collective_purity(self, node: ast.Call) -> None:
+        """R014: inside a collective (shard_map/psum) program — reached
+        through the call graph, not just the lexical body — ANY host
+        sync or device transfer stalls every chip in the mesh, because
+        the collective's other participants block on the straggler at
+        the next psum/all_gather. Flags ``jax.device_get``, ``.item()``,
+        ``jax.device_put``, and host pulls (``np.asarray``/``np.array``,
+        ``int``/``float``/``bool`` casts) of traced values. Branching on
+        device values and un-padded dynamic shapes inside the same
+        programs fire as R004/R003 — collective reach is traced reach."""
+        if not self.coll_depth:
+            return
+        f = node.func
+        chain = _attr_chain(f) or ""
+        head, _, fn = chain.rpartition(".")
+        if fn == "device_get" and head in self.mod.jax:
+            self._emit("R014", node,
+                       "jax.device_get inside a collective program — a "
+                       "blocking host sync stalls every chip in the mesh "
+                       "at the next collective; return the value from "
+                       "the program and pull it after")
+            return
+        if chain in self.mod.put_fns or (fn == "device_put"
+                                         and head in self.mod.jax):
+            self._emit("R014", node,
+                       "jax.device_put inside a collective program — "
+                       "device placement belongs on the host side, "
+                       "before the program is dispatched")
+            return
+        if isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not node.args and not node.keywords:
+            self._emit("R014", node,
+                       ".item() inside a collective program forces a "
+                       "host sync that stalls every chip in the mesh; "
+                       "keep it an array and pull after the program "
+                       "returns")
+            return
+        if head in self.mod.np and fn in ("asarray", "array") and \
+                node.args and self._touches_traced(node.args[0]):
+            self._emit("R014", node,
+                       f"np.{fn} of a traced value inside a collective "
+                       "program — a device→host transfer stalls every "
+                       "chip in the mesh; keep the computation in jnp "
+                       "and pull after the program returns")
+            return
+        if _name(f) in ("int", "float", "bool") and len(node.args) == 1 \
+                and self._touches_traced(node.args[0]):
+            self._emit("R014", node,
+                       f"{_name(f)}(...) cast of a traced value inside a "
+                       "collective program — concretizing blocks every "
+                       "chip in the mesh (and fails under trace); use "
+                       "jnp dtype casts instead")
 
     # -- R009 ---------------------------------------------------------------
 
@@ -539,11 +667,15 @@ class _Checker(ast.NodeVisitor):
         if isinstance(val, ast.Call):
             chain = _attr_chain(val.func) or ""
             head, _, fn = chain.rpartition(".")
+            root = chain.split(".")[0]
             if head in self.mod.jax and fn == "device_get":
                 return False
             if head in self.mod.np and fn in ("asarray", "array"):
                 return False
-            return head in self.mod.jnp
+            # jnp.* AND jax.*/lax.* ops produce device values
+            # (jax.lax.psum, lax.top_k, jax.vmap(...)(...))
+            return head in self.mod.jnp or root in self.mod.jax \
+                or root in self.mod.lax
         if isinstance(val, (ast.Attribute, ast.Subscript)):
             return self._is_device_operand(val)
         nm = _name(val)
@@ -761,23 +893,27 @@ class _Checker(ast.NodeVisitor):
         return True
 
     def _check_sync(self, node: ast.Call) -> None:
-        if not self.ctx.hot:
-            return
         f = node.func
         if isinstance(f, ast.Attribute) and f.attr == "item" \
                 and not node.args and not node.keywords:
-            if self.traced_stack:
+            # traced context fires EVERYWHERE (a traced value has no
+            # concrete scalar, regardless of which file it lives in) —
+            # the whole-program pass reaches helpers the hot-path list
+            # never covered; collective reach reports as R014 instead
+            if self.traced_stack and not self.coll_depth:
                 self._emit("R002", node,
                            ".item() inside jitted "
                            f"`{self.traced_stack[-1].fn_name}` — a traced "
                            "value has no concrete scalar (trace-time "
                            "error); keep it an array and pull on host "
                            "after the program returns")
-            elif self.iter_depth:
+            elif self.ctx.hot and not self.traced_stack and self.iter_depth:
                 self._emit("R002", node,
                            ".item() inside a loop is one blocking device "
                            "sync per iteration — pull the whole array to "
                            "host once before the loop")
+        if not self.ctx.hot:
+            return
         if _name(f) in ("int", "float", "bool") and len(node.args) == 1:
             arg = node.args[0]
             if isinstance(arg, ast.Subscript) and \
