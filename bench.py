@@ -1132,6 +1132,12 @@ def run_bench(args, jax) -> dict:
         "breaker_tripped": sum(
             v for k, v in delta.items()
             if k.startswith("breakers.") and v > 0),
+        # stall watchdog (monitor/watchdog.py): a detector tripping (or
+        # an incident dump captured) DURING a bench round is exactly the
+        # kind of anomaly that silently corrupts a perf number — surface
+        # it in the artifact, not only in the node's flight ring
+        "watchdog_trips": delta.get("watchdog.trips", 0),
+        "incidents": delta.get("watchdog.incidents", 0),
         # ... plus every other counter that moved during the run (None =
         # unavailable keys are dropped here; `jit_compiles` above carries
         # the typed null)

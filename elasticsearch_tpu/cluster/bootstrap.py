@@ -321,6 +321,11 @@ class MultiHostCluster:
                 target=self._fault_loop, args=(ping_interval,),
                 name="tpu-fault-detector", daemon=True)
             self._fd_thread.start()
+        # a cluster member is a serving node: the watchdog ticks for the
+        # life of the member (ESTPU_WATCHDOG=0 opts out)
+        wd = getattr(node, "watchdog", None)
+        if wd is not None:
+            wd.ensure_started()
 
     # -- quorum / blocks ------------------------------------------------------
 
@@ -378,6 +383,8 @@ class MultiHostCluster:
         self._go_headless()
         logger.warning("[%s] stepping down as master: %s",
                        self.local.node_id, reason or "quorum lost")
+        self._flight("cluster", event="step_down",
+                     reason=reason or "quorum lost")
         try:
             self.node.metrics.counter(
                 "estpu_discovery_master_stepdowns_total",
@@ -805,6 +812,7 @@ class MultiHostCluster:
         self._clear_headless()
         logger.warning("[%s] elected master for term %d",
                        self.local.node_id, term)
+        self._flight("cluster", event="elected", term=term)
         # metadata takeover: drop dead members from every copy list
         # (promoting in-sync survivors under BUMPED shard terms — the
         # PR-6 reconcile/_sync_local_terms path) and re-replicate
@@ -890,6 +898,17 @@ class MultiHostCluster:
             self.committed = key
             self.committed_history.append(key)
 
+    def _flight(self, ring: str, **fields) -> None:
+        """Best-effort flight-recorder entry (monitor/flight.py): the
+        control plane's election/publish transitions are exactly the
+        evidence an incident dump needs to explain a write outage."""
+        try:
+            fl = getattr(self.node, "flight", None)
+            if fl is not None:
+                fl.record(ring, **fields)
+        except Exception:  # tpulint: allow[R006] — recording must never
+            pass           # perturb the control plane
+
     def _publish(self) -> bool:
         """Master → members, two-phase: send (term, version, state) to
         every other member, COMMIT only after quorum acks (self
@@ -898,8 +917,17 @@ class MultiHostCluster:
         this master STEPS DOWN without committing. Returns whether the
         state committed."""
         state = self.node.cluster_state
-        with self._publish_lock:
-            return self._publish_locked(state)
+        # watchdog board: the publish is visible WHILE in flight (a
+        # wedged quorum round is a stall the completion histogram can
+        # never show); lock wait counts — that is honest wall time
+        wd = getattr(self.node, "watchdog", None)
+        tok = wd.board.begin("publish_commit") if wd is not None else None
+        try:
+            with self._publish_lock:
+                return self._publish_locked(state)
+        finally:
+            if wd is not None:
+                wd.board.end(tok)
 
     def _publish_locked(self, state) -> bool:
         # serialized: two concurrent publishers (join handler thread vs a
@@ -953,11 +981,16 @@ class MultiHostCluster:
         self._committed_meta = max(self._committed_meta,
                                    (term, indices_version))
         self._committed_snapshot = indices  # the deep copy just shipped
+        self._flight("cluster", event="publish_commit", term=term,
+                     version=version, acks=1 + len(acked))
         try:
             FAULTS.check("publish.commit", term=term, version=version)
         except Exception:
             # the injected master death between phases: followers hold an
-            # uncommitted pending state they will never apply
+            # uncommitted pending state they will never apply — recorded
+            # so the watchdog's publish detector trips on the window
+            self._flight("cluster", event="publish_commit_window_fault",
+                         term=term, version=version)
             return True
         for addr in acked:
             try:

@@ -294,6 +294,16 @@ class Engine:
                 self.translog.close()
             except OSError:
                 pass  # the channel is what failed; state flag is what matters
+        # flight recorder (outside the engine lock — R013): a tragic
+        # engine event is exactly the evidence that dies with the
+        # process; engines have no node back-ref, so fan process-wide
+        try:
+            from elasticsearch_tpu.monitor import flight
+
+            flight.record("engine_failures", index=self.index_name,
+                          reason=reason)
+        except Exception:  # tpulint: allow[R006] — recording must never
+            pass           # compound a tragic event
 
     def _ensure_open(self) -> None:
         if self.failed_reason is not None:

@@ -212,7 +212,33 @@ def seed(key: str, blob: bytes) -> None:
     _seed(key, blob, _disk_paths(key))
 
 
-# -- generic blob tier (program-key census etc.) -----------------------------
+# -- generic blob tier (program-key census, incidents, ...) ------------------
+
+def frame_blob(payload: dict) -> bytes:
+    """The generic tier's one digest framing: ``sha1-hex\\n{json}``.
+    Corruption (torn write, bitrot) becomes a *detected* miss at
+    :func:`unframe_blob` — every JSON blob family (census, incidents)
+    shares this frame so the format can't silently diverge."""
+    import json
+
+    body = json.dumps(payload, sort_keys=True, default=str).encode("utf-8")
+    return hashlib.sha1(body).hexdigest().encode("ascii") + b"\n" + body
+
+
+def unframe_blob(blob: bytes) -> Optional[dict]:
+    """Verify + decode a :func:`frame_blob` payload; None on any
+    corruption (callers treat it as a miss and usually delete_blob)."""
+    import json
+
+    try:
+        digest, _, body = blob.partition(b"\n")
+        if hashlib.sha1(body).hexdigest().encode("ascii") != digest:
+            return None
+        payload = json.loads(body)
+        return payload if isinstance(payload, dict) else None
+    except Exception:
+        return None
+
 
 def load_blob(key: str, ext: str) -> Optional[bytes]:
     """Raw blob bytes for ``(key, ext)`` from memory or any registered

@@ -469,6 +469,15 @@ def process_counters() -> Dict[str, float]:
         out.update(_programs.REGISTRY.counter_values())
     except Exception:
         pass
+    # watchdog trips + incident captures (``watchdog.trips[.<detector>]``,
+    # ``watchdog.incidents``): a stall during a bench round must be
+    # visible in the artifact's metrics_delta, not only in the logs
+    try:
+        from elasticsearch_tpu.monitor import flight as _flight
+
+        out.update(_flight.trip_counters())
+    except Exception:
+        pass
     out.update(SHARED.counter_values())
     return out
 

@@ -221,6 +221,14 @@ class ProgramRegistry:
         self._lock = threading.Lock()
         self._entries: Dict[Tuple[str, str, str], ProgramEntry] = {}
         self._census: Dict[str, Set[Tuple[str, str, str]]] = {}
+        # in-flight dispatches on the shared age-board primitive
+        # (monitor/flight.py::OpBoard — the watchdog's publish tracking
+        # rides the same class): the program-stall detector reads ages
+        # from here, because a dispatch that never returns is invisible
+        # to every completion-fed counter above.
+        from elasticsearch_tpu.monitor.flight import OpBoard
+
+        self._inflight = OpBoard()
 
     # -- entry resolution ----------------------------------------------------
 
@@ -261,6 +269,16 @@ class ProgramRegistry:
             e.compiles += n
             e.compile_seconds += float(seconds)
             e.last_used_at = time.time()
+        # flight recorder: compile events are rare by construction (the
+        # pow2 discipline bounds the program universe) and each one is a
+        # latency cliff worth a black-box entry
+        try:
+            from elasticsearch_tpu.monitor import flight
+
+            flight.record("compiles", program=program, shapes=shapes,
+                          seconds=round(float(seconds), 6))
+        except Exception:
+            pass  # recording must never fail the compile feed
 
     def record_execute(self, program: str, shapes: str, seconds: float,
                        field: Optional[str] = None) -> None:
@@ -294,6 +312,36 @@ class ProgramRegistry:
         else:
             self.record_execute(program, shapes, seconds, field=field)
 
+    # -- in-flight dispatch tracking (watchdog feed) -------------------------
+
+    def begin_dispatch(self, program: str, shapes: str) -> int:
+        """Mark one dispatch in flight; returns the token
+        :meth:`end_dispatch` retires. Cost: one dict insert under the
+        board's own small lock — the only hot-path addition the
+        watchdog needs (the registry lock is never touched)."""
+        return self._inflight.begin(program, shapes=shapes)
+
+    def end_dispatch(self, token: int) -> None:
+        self._inflight.end(token)
+
+    def inflight_snapshot(self) -> List[dict]:
+        """Every dispatch currently in flight, with its age."""
+        return [{"program": r["kind"], "shapes": r.get("shapes", ""),
+                 "age_seconds": r["age_seconds"]}
+                for r in self._inflight.snapshot()]
+
+    def execute_p99(self, program: str, shapes: str) -> Tuple[float, int]:
+        """(execute p99 seconds, cached-call count) for one key under
+        the current backend — the watchdog derives its adaptive stall
+        bound from the key's OWN history, not a blanket constant."""
+        key = (program, shapes, backend_fingerprint())
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return 0.0, 0
+            calls = e.calls
+        return e.hist.percentile(99), calls
+
     @contextmanager
     def timed(self, program: str, shapes: str,
               field: Optional[str] = None):
@@ -302,12 +350,18 @@ class ProgramRegistry:
         tracing+compilation (the profiler's exact trick — a neighbor
         request's compile on another thread can't misclassify this one).
         Nothing records when the block raises: a failed dispatch (e.g.
-        the Pallas→XLA retry) must not pollute the execute histogram."""
+        the Pallas→XLA retry) must not pollute the execute histogram.
+        The dispatch IS visible to the watchdog while in flight either
+        way (begin/end_dispatch) — a hang records nothing but ages."""
         from elasticsearch_tpu.tracing import retrace
 
         snap = retrace.snapshot()
+        tok = self.begin_dispatch(program, shapes)
         t0 = time.perf_counter()
-        yield
+        try:
+            yield
+        finally:
+            self.end_dispatch(tok)
         self.record_call(program, shapes, time.perf_counter() - t0,
                          retrace.traces_since(snap), field=field)
 
@@ -385,6 +439,7 @@ class ProgramRegistry:
         with self._lock:
             self._entries.clear()
             self._census.clear()
+        self._inflight.clear()
 
 
 #: the process singleton every feed records into

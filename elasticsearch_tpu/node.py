@@ -62,6 +62,17 @@ class Node:
         self.metrics = MetricsRegistry(include_shared=True)
         self.tracer.set_sink(span_sink(self.metrics))
         self._register_metric_collectors()
+        # flight recorder + stall watchdog (monitor/flight.py,
+        # monitor/watchdog.py): the recorder is registered with the
+        # process fan so node-less subsystems (breakers, engines) reach
+        # it; the watchdog's tick thread is lazy — serving entry points
+        # (RestServer.start, cluster bootstrap) call ensure_started()
+        from elasticsearch_tpu.monitor import flight as flight_mod
+        from elasticsearch_tpu.monitor.watchdog import WatchdogService
+
+        self.flight = flight_mod.FlightRecorder(self.node_id, name)
+        flight_mod.register(self.flight)
+        self.watchdog = WatchdogService(self)
         # serving front-end: cross-request micro-batching + per-tenant
         # QoS (serving/). Cheap to build — the drain thread is lazy, so
         # library-embedded Nodes that never coalesce don't pay for it.
@@ -907,6 +918,11 @@ class Node:
                     # table lives at /_nodes/_local/xla/programs and
                     # /_cat/programs (monitor/programs.py)
                     "programs": self._program_stats(),
+                    # flight recorder ring counts + watchdog trip totals;
+                    # the full rings live at /_nodes/_local/flight and in
+                    # the /_cluster/diagnostics bundle
+                    "flight": self.flight.stats(),
+                    "watchdog": self.watchdog.stats(),
                     # TPU-native extra: device kind + HBM usage
                     "accelerator": device_stats(),
                 }
@@ -972,6 +988,16 @@ class Node:
         }
 
     def close(self):
+        # stop the watchdog tick thread and leave the process fan before
+        # teardown: a detector must not race the indices closing under it
+        watchdog = getattr(self, "watchdog", None)
+        if watchdog is not None:
+            watchdog.close()
+        flight_rec = getattr(self, "flight", None)
+        if flight_rec is not None:
+            from elasticsearch_tpu.monitor import flight as flight_mod
+
+            flight_mod.unregister(flight_rec)
         # drain the serving coalescer FIRST: parked requests must resolve
         # (sequentially) before the indices they target close
         serving = getattr(self, "serving", None)

@@ -121,19 +121,34 @@ class CircuitBreaker:
     def reserve(self, n: int, count_trip: bool = True) -> bool:
         """Charge ``n`` bytes; False (and a ``tripped`` tick) when this
         breaker's or the parent's limit would be exceeded."""
+        parent = False
         with self._lock:
             if self._would_trip(n):
                 if count_trip:
                     self.trip_count += 1
-                return False
-            if self._service is not None \
+            elif self._service is not None \
                     and self._service._parent_would_trip(n):
                 if count_trip:
                     self._service.parent_tripped += 1
                     self.trip_count += 1
-                return False
-            self.used += n
-            return True
+                parent = True
+            else:
+                self.used += n
+                return True
+            used, limit = self.used, self.limit
+        # flight-recorder entry OUTSIDE the breaker lock (no new
+        # lock-order edges, R013): a trip is an admission anomaly worth
+        # black-box evidence even when the caller degrades gracefully
+        if count_trip:
+            try:
+                from elasticsearch_tpu.monitor import flight
+
+                flight.record("breaker_trips", breaker=self.name,
+                              parent=parent, bytes_wanted=used + n,
+                              bytes_limit=limit)
+            except Exception:  # tpulint: allow[R006] — recording must
+                pass           # never turn a clean denial into an error
+        return False
 
     def break_or_reserve(self, n: int, label: str = "<unknown>") -> None:
         """reserve() or raise the ES-shaped CircuitBreakingException."""

@@ -27,7 +27,6 @@ Import cost: no jax at import time (resources/ package contract).
 from __future__ import annotations
 
 import hashlib
-import json
 from typing import List, Optional
 
 _EXT = "census"
@@ -60,8 +59,9 @@ def store_census(index_name: str,
         "backend": programs.backend_fingerprint(),
         "keys": keys,
     }
-    body = json.dumps(payload, sort_keys=True).encode("utf-8")
-    blob = hashlib.sha1(body).hexdigest().encode("ascii") + b"\n" + body
+    # the generic tier's shared digest frame (ivf_cache.frame_blob) —
+    # census and incident blobs stay format-identical by construction
+    blob = ivf_cache.frame_blob(payload)
     ivf_cache.store_blob(census_key(index_name), blob, _EXT)
     return blob
 
@@ -77,16 +77,11 @@ def load_census(index_name: str) -> Optional[dict]:
     blob = ivf_cache.load_blob(key, _EXT)
     if blob is None:
         return None
-    try:
-        digest, _, body = blob.partition(b"\n")
-        if hashlib.sha1(body).hexdigest().encode("ascii") != digest:
-            raise ValueError("census digest mismatch")
-        payload = json.loads(body)
-        if (payload.get("version") != VERSION
-                or payload.get("index") != index_name
-                or not isinstance(payload.get("keys"), list)):
-            raise ValueError("census payload shape")
-    except Exception:
+    payload = ivf_cache.unframe_blob(blob)
+    if (payload is None
+            or payload.get("version") != VERSION
+            or payload.get("index") != index_name
+            or not isinstance(payload.get("keys"), list)):
         ivf_cache.delete_blob(key, _EXT)
         return None
     return payload

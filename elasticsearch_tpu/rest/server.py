@@ -264,6 +264,14 @@ def _register_all(rc: RestController):
     # compile/execute attribution + per-index census — also before the
     # /_nodes/{nodeid} patterns so the literal path wins
     add("GET", "/_nodes/_local/xla/programs", _node_programs)
+    # flight recorder + watchdog + incident surface (monitor/flight.py,
+    # monitor/watchdog.py): per-node black box, cluster-wide support
+    # bundle, cat listing of captured incidents
+    add("GET", "/_nodes/_local/flight", _node_flight)
+    add("GET", "/_cat/incidents", _cat_incidents)
+    add("GET", "/_cluster/diagnostics", _cluster_diagnostics)
+    add("GET", "/_cluster/diagnostics/incidents/{incident_id}",
+        _get_incident)
     # continuous metrics scrape (text exposition format 0.0.4): the node
     # registry + the process-shared families (monitor/metrics.py)
     add("GET", "/_prometheus/metrics", _prometheus_metrics)
@@ -2008,15 +2016,22 @@ def _cat_tasks(n: Node, p, b):
     """GET /_cat/tasks: the /_tasks listing as cat rows."""
     _status, body = _tasks_list(n, p, b)
     rows = []
+    from elasticsearch_tpu.tracing.tasks import human_time
+
     for nid, entry in sorted(body["nodes"].items()):
         for tid, t in sorted(entry.get("tasks", {}).items()):
+            nanos = t.get("running_time_in_nanos", 0)
             rows.append({
                 "action": t.get("action", ""),
                 "task_id": tid,
                 "parent_task_id": t.get("parent_task_id", "-"),
                 "type": t.get("type", "transport"),
                 "start_time": str(t.get("start_time_in_millis", "")),
-                "running_time": f"{t.get('running_time_in_nanos', 0) // 1_000_000}ms",
+                # human-scaled (the task's own to_json form when present:
+                # remote members computed it from THEIR monotonic clock)
+                "running_time": t.get("running_time",
+                                      human_time(nanos)),
+                "running_time_in_nanos": str(nanos),
                 "node": entry.get("name", nid),
                 "description": t.get("description", ""),
             })
@@ -2088,6 +2103,168 @@ def _node_programs(n: Node, p, b):
         "totals": reg.stats(),
         "programs": reg.snapshot(),
         "census": {ix: reg.census(ix) for ix in reg.census_indices()},
+    }
+
+
+def _node_flight(n: Node, p, b):
+    """GET /_nodes/_local/flight: this node's flight-recorder rings
+    (bounded black box: metric deltas, slow ops, breaker trips, compile
+    events, cluster transitions, engine failures, watchdog trips), plus
+    the watchdog's own state and the incident listing."""
+    return 200, {
+        "flight": n.flight.snapshot(),
+        "watchdog": n.watchdog.stats(),
+        "incidents": n.watchdog.incidents.list(),
+    }
+
+
+def _incident_rows(n: Node, p) -> List[dict]:
+    """_cat/incidents rows: local incidents plus every member's (the
+    _tasks fan) — dedup'd by id, since in-process members share the
+    blob cache's persisted index."""
+    rows = []
+    for e in n.watchdog.incidents.list():
+        rows.append({
+            "id": str(e.get("id", "")),
+            "detector": str(e.get("detector", "")),
+            "node": str(e.get("node_name") or e.get("node") or ""),
+            "timestamp": str(e.get("timestamp_ms", "")),
+            "persisted": "true" if e.get("persisted") else "false",
+            "reason": str(e.get("reason", ""))[:120],
+        })
+    mh = _mh(n)
+    if mh is not None and "_local_only" not in p:
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        for nid in mh.data._other_nodes():
+            try:
+                res = mh.data._send(nid, ACTION_REST_PROXY, {
+                    "method": "GET", "path": "/_cat/incidents",
+                    "params": {}})
+            except Exception:
+                continue  # unreachable peer: its incidents stay absent
+            if res.get("status") == 200:
+                rows.extend(r for r in (res.get("payload") or [])
+                            if isinstance(r, dict))
+    seen: set = set()
+    out = []
+    for r in rows:
+        if r["id"] in seen:
+            continue
+        seen.add(r["id"])
+        out.append(r)
+    out.sort(key=lambda r: r["timestamp"])
+    return out
+
+
+def _cat_incidents(n: Node, p, b):
+    """GET /_cat/incidents: one row per captured incident dump,
+    cluster-wide, oldest first."""
+    return 200, _cat_rows(_incident_rows(n, p),
+                          ["id", "detector", "node", "timestamp",
+                           "reason"])
+
+
+def _get_incident(n: Node, p, b, incident_id: str):
+    """GET /_cluster/diagnostics/incidents/{id}: one incident's full
+    payload — the in-memory copy, the digest-verified persisted blob, or
+    (when the id names another live member) that member's copy."""
+    payload = n.watchdog.incidents.load(incident_id)
+    if payload is not None:
+        return 200, payload
+    owner, _, _seq = incident_id.partition(":")
+    mh = _mh(n)
+    if mh is not None and "_local_only" not in p \
+            and owner and owner != n.node_id \
+            and owner in n.cluster_state.nodes:
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        try:
+            res = mh.data._send(owner, ACTION_REST_PROXY, {
+                "method": "GET",
+                "path": f"/_cluster/diagnostics/incidents/{incident_id}",
+                "params": {}})
+            return res["status"], res["payload"]
+        except Exception:  # tpulint: allow[R006] — unreachable owner:
+            # the owner just died — exactly the outage incidents exist
+            # for; fall through to the typed 404, never an untyped 500
+            pass
+    from elasticsearch_tpu.tracing.tasks import ResourceNotFoundException
+
+    raise ResourceNotFoundException(f"incident [{incident_id}] not found")
+
+
+def _local_diagnostics(n: Node, p) -> dict:
+    """One node's contribution to the diagnostics bundle. The key set is
+    part of the bundle's schema contract (tier-1 gate)."""
+    from elasticsearch_tpu import resources
+    from elasticsearch_tpu.monitor import programs
+    from elasticsearch_tpu.monitor.watchdog import hot_threads_snapshot
+
+    try:
+        k = int(p.get("incidents", 2))
+    except (TypeError, ValueError):
+        k = 2
+    k = max(0, min(k, 8))
+    return {
+        "name": n.name,
+        "flight": n.flight.snapshot(),
+        "watchdog": n.watchdog.stats(),
+        "incidents": n.watchdog.incidents.list(),
+        "incident_payloads": n.watchdog.incidents.recent(k),
+        "hot_threads": hot_threads_snapshot(),
+        "tasks": [t.to_json() for t in n.tasks.list_tasks()][:64],
+        "programs": {
+            "totals": programs.REGISTRY.stats(),
+            "inflight": programs.REGISTRY.inflight_snapshot(),
+        },
+        "breakers": resources.BREAKERS.stats(),
+        "thread_pool": (n._thread_pool.stats()
+                        if n._thread_pool is not None else {}),
+    }
+
+
+def _cluster_diagnostics(n: Node, p, b):
+    """GET /_cluster/diagnostics: the cluster-wide support bundle — one
+    schema-stable JSON artifact merging every member's flight rings,
+    watchdog state, incidents (with the most recent payloads inline),
+    hot-threads snapshot, in-flight programs and task list. Fans over
+    members via the REST proxy; a dead peer is counted in
+    ``_nodes.failed`` and listed under ``failures`` — the response stays
+    200, because a support bundle gathered DURING an outage is the whole
+    point (the /_cluster/stats fan-out discipline)."""
+    local = _local_diagnostics(n, p)
+    c = _mh(n)
+    if c is not None and "_local_only" in p:
+        # proxied member contribution: raw and unmerged
+        return 200, local
+    nodes = {n.node_id: local}
+    failures: List[dict] = []
+    if c is not None:
+        from elasticsearch_tpu.cluster.search_action import ACTION_REST_PROXY
+
+        params = {k: p[k] for k in ("incidents",) if k in p}
+        for nid in c.data._other_nodes():
+            try:
+                res = c.data._send(nid, ACTION_REST_PROXY, {
+                    "method": "GET", "path": "/_cluster/diagnostics",
+                    "params": params})
+                if res.get("status") == 200 and res.get("payload"):
+                    nodes[nid] = res["payload"]
+                else:
+                    failures.append({"node_id": nid,
+                                     "reason": f"status {res.get('status')}"})
+            except Exception as e:
+                failures.append({"node_id": nid, "reason": str(e)})
+    return 200, {
+        "version": 1,
+        "cluster_name": n.cluster_state.cluster_name,
+        "timestamp": int(time.time() * 1000),
+        "master_node": n.cluster_state.master_node_id,
+        "_nodes": {"total": len(nodes) + len(failures),
+                   "successful": len(nodes), "failed": len(failures)},
+        "nodes": nodes,
+        "failures": failures,
     }
 
 
@@ -4937,7 +5114,8 @@ def _cat_help(n: Node, p, b):
     return 200, "\n".join([
         "=^.^=",
         "/_cat/aliases", "/_cat/allocation", "/_cat/count",
-        "/_cat/fielddata", "/_cat/health", "/_cat/indices", "/_cat/master",
+        "/_cat/fielddata", "/_cat/health", "/_cat/incidents",
+        "/_cat/indices", "/_cat/master",
         "/_cat/nodes", "/_cat/pending_tasks", "/_cat/plugins",
         "/_cat/recovery", "/_cat/repositories", "/_cat/segments",
         "/_cat/shards", "/_cat/snapshots/{repository}", "/_cat/tasks",
@@ -5116,6 +5294,12 @@ class RestServer:
         self._thread: Optional[threading.Thread] = None
 
     def start(self, background: bool = True):
+        # a node serving HTTP is a production node: the stall watchdog
+        # ticks for its lifetime (monitor/watchdog.py; ESTPU_WATCHDOG=0
+        # opts out, library-embedded Nodes never start it)
+        wd = getattr(self.controller.node, "watchdog", None)
+        if wd is not None:
+            wd.ensure_started()
         if background:
             self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
             self._thread.start()

@@ -480,6 +480,19 @@ class QueryCoalescer:
 
     # -- lifecycle -----------------------------------------------------------
 
+    def oldest_queue_age(self) -> Optional[float]:
+        """Age in seconds of the oldest still-PARKED request across
+        every forming bucket, or None when nothing is parked. Normal
+        waits are sub-millisecond (the adaptive window); an age orders
+        of magnitude past ``max_wait`` means the drain thread is wedged
+        or dead — the watchdog's coalescer_drain signal."""
+        with self._cv:
+            oldest = min((e.enqueued for q in self._queues.values()
+                          for e in q), default=None)
+        if oldest is None:
+            return None
+        return time.perf_counter() - oldest
+
     def stats(self) -> dict:
         with self._cv:
             return {

@@ -43,6 +43,27 @@ class ResourceNotFoundException(ElasticsearchTpuException):
 ParentId = Tuple[str, int]  # (node_id, task seq)
 
 
+def human_time(nanos: int) -> str:
+    """Human-scaled duration (reference: TimeValue.toString — the form
+    every `_cat` duration column prints): ``850micros``, ``770ms``,
+    ``12.3s``, ``4.5m``, ``1.2h``. The point of printing it beside the
+    nanos: an operator scanning `_cat/tasks` tells a fresh task from
+    one wedged for minutes at a glance."""
+    n = max(0, int(nanos))
+    if n < 1_000_000:
+        return f"{n // 1000}micros"
+    ms = n / 1e6
+    if ms < 1000:
+        return f"{ms:.1f}ms" if ms < 10 else f"{int(ms)}ms"
+    s = ms / 1000.0
+    if s < 60:
+        return f"{s:.1f}s"
+    m = s / 60.0
+    if m < 60:
+        return f"{m:.1f}m"
+    return f"{m / 60.0:.1f}h"
+
+
 class Task:
     def __init__(self, task_id: int, node: str, action: str,
                  description: str = "", parent: Optional[ParentId] = None,
@@ -101,6 +122,7 @@ class Task:
         return int((time.monotonic() - self._start) * 1e9)
 
     def to_json(self) -> dict:
+        nanos = self.running_time_nanos()
         out = {
             "node": self.node,
             "id": self.id,
@@ -109,7 +131,11 @@ class Task:
             "description": self.description,
             "status": self.status,
             "start_time_in_millis": self.start_time_ms,
-            "running_time_in_nanos": self.running_time_nanos(),
+            "running_time_in_nanos": nanos,
+            # the human form beside the nanos (computed from the task's
+            # monotonic start): GET /_tasks consumers get both without
+            # re-deriving the scale
+            "running_time": human_time(nanos),
             "cancellable": self.cancellable,
             "cancelled": self.cancelled,
         }

@@ -41,6 +41,12 @@ Registered injection points (see docs/ROBUSTNESS.md for the catalogue):
                           ctx, so a test can drop exactly the
                           minority<->majority links in both directions
                           (cluster/transport.py::_send_remote_timed)
+    watchdog.program_stall
+                          inside the watchdog's program-stall detector
+                          scan (monitor/watchdog.py): an armed fault
+                          makes every in-flight device dispatch count
+                          as stalled, driving the trip → incident →
+                          persistence pipeline without a real hang
 """
 from __future__ import annotations
 
@@ -63,6 +69,7 @@ POINTS = frozenset({
     "discovery.vote",
     "publish.commit",
     "discovery.partition",
+    "watchdog.program_stall",
 })
 
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import queue
 import threading
+import time
 from typing import Any, Callable, Dict, Optional
 
 from elasticsearch_tpu.utils.errors import ElasticsearchTpuException
@@ -30,7 +31,8 @@ class EsRejectedExecutionException(ElasticsearchTpuException):
 
 
 class _Work:
-    __slots__ = ("fn", "args", "kwargs", "done", "result", "error")
+    __slots__ = ("fn", "args", "kwargs", "done", "result", "error",
+                 "enqueued")
 
     def __init__(self, fn, args, kwargs):
         self.fn = fn
@@ -39,6 +41,10 @@ class _Work:
         self.done = threading.Event()
         self.result: Any = None
         self.error: Optional[BaseException] = None
+        # monotonic enqueue time: the watchdog's starvation detector
+        # reads queue AGE (how long the head has waited), which queue
+        # depth alone can't distinguish from a healthy burst
+        self.enqueued = time.monotonic()
 
 
 class FixedThreadPool:
@@ -106,6 +112,19 @@ class FixedThreadPool:
         if work.error is not None:
             raise work.error
         return work.result
+
+    def oldest_queue_age(self) -> Optional[float]:
+        """Age in seconds of the oldest QUEUED (not yet claimed) work
+        item, or None when the queue is empty — the watchdog's
+        starvation signal: old head + every worker busy = requests aging
+        behind wedged workers. Peeks the head under the queue's own
+        mutex; shutdown sentinels (None) don't count."""
+        with self._q.mutex:
+            head = self._q.queue[0] if self._q.queue else None
+        t0 = getattr(head, "enqueued", None)
+        if t0 is None:
+            return None
+        return time.monotonic() - t0
 
     def stats(self) -> dict:
         with self._lock:

@@ -1042,18 +1042,47 @@ class TestR011:
         """, threads=True)
         assert vs == []
 
-    def test_scope_only_cluster_modules(self):
+    def test_scope_background_thread_modules(self):
+        """R011 covers every package that runs background threads:
+        cluster/ (control plane), monitor/ (watchdog tick) and serving/
+        (coalescer drain) — the watchdog/recorder threads are born under
+        the rule, not grandfathered past it. index/ stays out."""
         src = """
             import threading
 
             def start(svc):
                 threading.Thread(target=svc.run).start()
         """
-        assert any(v.rule == "R011" for v in lint_source(
-            textwrap.dedent(src),
-            "elasticsearch_tpu/cluster/bootstrap.py"))
+        for marker in ("elasticsearch_tpu/cluster/bootstrap.py",
+                       "elasticsearch_tpu/monitor/watchdog.py",
+                       "elasticsearch_tpu/serving/coalescer.py"):
+            assert any(v.rule == "R011" for v in lint_source(
+                textwrap.dedent(src), marker)), marker
         assert not any(v.rule == "R011" for v in lint_source(
             textwrap.dedent(src), "elasticsearch_tpu/index/engine.py"))
+
+    def test_good_closed_flag_gate(self):
+        # the serving drain-loop spelling of the shutdown gate: a
+        # `while True` whose body consults a `closed` flag is gated —
+        # same contract as the stop Event, different name
+        vs = lint("""
+            import threading
+
+            class Drain:
+                def __init__(self):
+                    self._closed = False
+
+                def _drain_loop(self):
+                    while True:
+                        if self._closed:
+                            return
+                        self.flush_due()
+
+                def start(self):
+                    threading.Thread(target=self._drain_loop,
+                                     daemon=True).start()
+        """, threads=True)
+        assert vs == []
 
 
 class TestR012:

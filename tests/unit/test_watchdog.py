@@ -1,0 +1,475 @@
+"""Flight recorder, stall watchdogs, and the cluster diagnostics bundle.
+
+Covers the ISSUE-13 acceptance surface: bounded trace-linked flight
+rings, each detector's trip math (adaptive program bound from the
+ProgramRegistry's own p99 history, threadpool queue age, fsync latency,
+publish-commit window, coalescer drain age), fault-injected stalls
+(``watchdog.program_stall``, reused ``publish.commit``) producing
+retrievable incident dumps, incident persistence across restart through
+the generic blob helpers, the ``/_cluster/diagnostics`` bundle's
+schema gate (stable top-level keys, bounded ring sizes), its 2-node
+fan-out surviving a dead peer, and the running_time satellite.
+"""
+import re
+import socket
+import threading
+import time
+
+import pytest
+
+from elasticsearch_tpu.monitor import flight, programs
+from elasticsearch_tpu.monitor.watchdog import (WatchdogService,
+                                                hot_threads_snapshot)
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.rest.server import RestController
+from elasticsearch_tpu.utils.faults import FAULTS
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+@pytest.fixture()
+def node():
+    n = Node(name="wd-node")
+    yield n
+    n.close()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_rings_are_bounded_counts_exact(self):
+        rec = flight.FlightRecorder("n1", "one")
+        cap = flight.RING_CAPS["trips"]
+        for i in range(cap * 2):
+            rec.record("trips", seq=i)
+        snap = rec.snapshot()
+        assert len(snap["rings"]["trips"]) == cap
+        assert snap["counts"]["trips"] == cap * 2
+        # the retained window is the NEWEST cap entries
+        assert snap["rings"]["trips"][-1]["seq"] == cap * 2 - 1
+        assert snap["ring_caps"] == flight.RING_CAPS
+
+    def test_unknown_ring_raises(self):
+        rec = flight.FlightRecorder()
+        with pytest.raises(KeyError):
+            rec.record("not_a_ring", x=1)
+
+    def test_entries_are_monotonic_stamped_and_trace_linked(self, node):
+        with node.tracer.span("outer") as sp:
+            node.flight.record("slow_ops", detector="t")
+        e = node.flight.ring("slow_ops")[-1]
+        assert e["ts_monotonic"] > 0
+        assert e["timestamp_ms"] > 0
+        assert e["trace_id"] == sp.trace_id
+
+    def test_process_fan_reaches_every_registered_recorder(self):
+        a, b = flight.FlightRecorder("a"), flight.FlightRecorder("b")
+        flight.register(a)
+        flight.register(b)
+        try:
+            flight.record("engine_failures", index="i", reason="r")
+            assert a.ring("engine_failures")[-1]["index"] == "i"
+            assert b.ring("engine_failures")[-1]["index"] == "i"
+        finally:
+            flight.unregister(a)
+            flight.unregister(b)
+
+    def test_breaker_trip_lands_in_ring(self, node):
+        from elasticsearch_tpu import resources
+        from elasticsearch_tpu.utils.errors import CircuitBreakingException
+
+        br = resources.BREAKERS.breaker("request")
+        with pytest.raises(CircuitBreakingException):
+            br.break_or_reserve(1 << 62, "<test>")
+        entries = node.flight.ring("breaker_trips")
+        assert any(e["breaker"] == "request" for e in entries)
+
+    def test_engine_failure_lands_in_ring(self, node):
+        node.create_index("ef", {"settings": {"number_of_shards": 1}})
+        node.indices["ef"].groups[0].copies[0].engine.fail("injected boom")
+        entries = node.flight.ring("engine_failures")
+        assert any(e["index"] == "ef" and "boom" in e["reason"]
+                   for e in entries)
+
+
+# ---------------------------------------------------------------------------
+# detectors
+# ---------------------------------------------------------------------------
+
+class TestProgramStallDetector:
+    def test_inflight_past_bound_trips_with_offending_key(self, node):
+        wd = WatchdogService(node, program_default_bound_s=0.0,
+                             cooldown_s=0.0)
+        tok = programs.REGISTRY.begin_dispatch("mesh_dsl", "f32[8,128]")
+        try:
+            trips = [t for t in wd.run_once()
+                     if t["detector"] == "program_stall"]
+        finally:
+            programs.REGISTRY.end_dispatch(tok)
+        assert trips, "an aged in-flight dispatch must trip"
+        d = trips[0]["detail"]
+        assert d["program"] == "mesh_dsl" and d["shapes"] == "f32[8,128]"
+        assert not d["injected"]
+
+    def test_adaptive_bound_derives_from_key_p99(self, node):
+        wd = WatchdogService(node, program_floor_s=0.0,
+                             program_p99_mult=4.0, program_min_calls=4)
+        for _ in range(8):
+            programs.REGISTRY.record_execute("k_adapt", "f32[4]", 0.002)
+        bound = wd._program_bound("k_adapt", "f32[4]")
+        p99, calls = programs.REGISTRY.execute_p99("k_adapt", "f32[4]")
+        assert calls == 8
+        assert bound == pytest.approx(4.0 * p99)
+        assert bound < wd.config["program_default_bound_s"]
+        # a key with no history gets the absolute default
+        assert wd._program_bound("k_unknown", "f32[4]") == \
+            wd.config["program_default_bound_s"]
+
+    def test_injected_fault_trips_and_incident_is_retrievable(self, node):
+        wd = node.watchdog
+        tok = programs.REGISTRY.begin_dispatch("mesh_bm25", "f32[16,1024]")
+        FAULTS.inject("watchdog.program_stall", count=1)
+        try:
+            trips = [t for t in wd.run_once()
+                     if t["detector"] == "program_stall"]
+        finally:
+            programs.REGISTRY.end_dispatch(tok)
+        assert trips and trips[0]["detail"]["injected"]
+        iid = trips[0]["incident_id"]
+        assert iid
+        inc = wd.incidents.load(iid)
+        assert inc is not None
+        # the acceptance triad: flight ring + hot threads + offending key
+        assert set(inc["flight"]["rings"]) == set(flight.RING_CAPS)
+        assert inc["hot_threads"], "hot-threads snapshot must be captured"
+        assert any(r["program"] == "mesh_bm25"
+                   for r in inc["programs"]["inflight"])
+        # and the trip is a Prometheus counter + /_tasks-style stats row
+        expo = node.metrics.expose()
+        assert 'estpu_watchdog_trips_total{detector="program_stall"}' \
+            in expo
+        assert wd.stats()["trips"]["program_stall"] >= 1
+
+    def test_cooldown_debounces_incident_capture(self, node):
+        wd = WatchdogService(node, cooldown_s=3600.0)
+        FAULTS.inject("watchdog.program_stall", count=2)
+        first = wd.run_once()
+        second = wd.run_once()
+        t1 = [t for t in first if t["detector"] == "program_stall"][0]
+        t2 = [t for t in second if t["detector"] == "program_stall"][0]
+        assert t1["incident_id"] is not None
+        assert t2["incident_id"] is None  # counted, recorded, not dumped
+        assert wd.stats()["trips"]["program_stall"] == 2
+        assert wd.stats()["incidents_captured"] == 1
+
+
+class TestOtherDetectors:
+    def test_threadpool_starvation_needs_old_head_and_busy_workers(
+            self, node):
+        from types import SimpleNamespace
+
+        from elasticsearch_tpu.utils.threadpool import FixedThreadPool
+
+        pool = FixedThreadPool("stall", size=1, queue_size=4)
+        release = threading.Event()
+        threading.Thread(target=pool.execute, args=(release.wait,),
+                         daemon=True).start()
+        threading.Thread(target=pool.execute, args=(lambda: None,),
+                         daemon=True).start()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if pool.stats()["queue"] >= 1 and pool.stats()["active"] >= 1:
+                break
+            time.sleep(0.01)
+        assert pool.oldest_queue_age() is not None
+        wd = WatchdogService(node, threadpool_age_bound_s=0.0,
+                             cooldown_s=0.0)
+        saved = node._thread_pool
+        node._thread_pool = SimpleNamespace(pools={"stall": pool})
+        try:
+            trips = [t for t in wd.run_once()
+                     if t["detector"] == "threadpool_starve"]
+        finally:
+            node._thread_pool = saved
+            release.set()
+            pool.shutdown()
+        assert trips and trips[0]["detail"]["pool"] == "stall"
+
+    def test_fsync_latency_over_bound_trips(self, node):
+        from elasticsearch_tpu.monitor.metrics import SHARED
+
+        wd = WatchdogService(node, fsync_bound_s=1.0, cooldown_s=0.0)
+        wd.run_once()  # baseline the cursor past prior tests' syncs
+        SHARED.histogram("estpu_translog_fsync_duration_seconds",
+                         "Translog flush+fsync latency").observe(5.0)
+        trips = [t for t in wd.run_once()
+                 if t["detector"] == "translog_fsync"]
+        assert trips
+        assert trips[0]["detail"]["avg_seconds"] >= 1.0
+
+    def test_coalescer_drain_age_trips(self, node):
+        from elasticsearch_tpu.serving.coalescer import _Entry
+
+        co = node.serving.coalescer
+        e = _Entry(None, {}, None)
+        e.enqueued = time.perf_counter() - 10.0
+        with co._cv:
+            co._queues[("idx", "f")] = [e]
+        try:
+            assert co.oldest_queue_age() >= 10.0
+            wd = WatchdogService(node, coalescer_bound_s=1.0,
+                                 cooldown_s=0.0)
+            trips = [t for t in wd.run_once()
+                     if t["detector"] == "coalescer_drain"]
+        finally:
+            with co._cv:
+                co._queues.clear()
+        assert trips
+        assert trips[0]["detail"]["oldest_age_seconds"] >= 10.0
+
+    def test_metric_delta_snapshots_land_in_ring(self, node):
+        wd = WatchdogService(node)
+        wd.run_once()  # first tick establishes the baseline
+        from elasticsearch_tpu.monitor import kernels
+
+        kernels.record("wd_test_kernel")
+        wd.run_once()
+        deltas = node.flight.ring("metrics")
+        assert any("kernels.wd_test_kernel" in e.get("delta", {})
+                   for e in deltas)
+
+    def test_trips_visible_to_bench_counter_delta(self, node):
+        from elasticsearch_tpu.monitor.metrics import (counters_delta,
+                                                       process_counters)
+
+        before = process_counters()
+        FAULTS.inject("watchdog.program_stall", count=1)
+        node.watchdog.run_once()
+        delta = counters_delta(before, process_counters())
+        assert delta.get("watchdog.trips", 0) >= 1
+        assert delta.get("watchdog.incidents", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# incident persistence (generic blob tier)
+# ---------------------------------------------------------------------------
+
+class TestIncidentPersistence:
+    def test_incident_survives_restart(self, tmp_path):
+        n1 = Node(name="persist-1", data_path=str(tmp_path))
+        FAULTS.inject("watchdog.program_stall", count=1)
+        trips = n1.watchdog.run_once()
+        iid = [t["incident_id"] for t in trips if t["incident_id"]][0]
+        n1.close()
+        FAULTS.clear()
+        n2 = Node(name="persist-2", data_path=str(tmp_path))
+        try:
+            listed = n2.watchdog.incidents.list()
+            mine = [e for e in listed if e["id"] == iid]
+            assert mine and mine[0].get("persisted")
+            payload = n2.watchdog.incidents.load(iid)
+            assert payload is not None
+            assert payload["detector"] == "program_stall"
+            assert "flight" in payload and "hot_threads" in payload
+        finally:
+            n2.close()
+
+    def test_corrupt_blob_reads_as_clean_miss(self, tmp_path):
+        from elasticsearch_tpu.index import ivf_cache
+
+        n1 = Node(name="corrupt-1", data_path=str(tmp_path))
+        try:
+            FAULTS.inject("watchdog.program_stall", count=1)
+            trips = n1.watchdog.run_once()
+            iid = [t["incident_id"] for t in trips if t["incident_id"]][0]
+            key = flight.incident_key(iid)
+            ivf_cache.store_blob(key, b"deadbeef\n{not json", "incident")
+            # drop the in-memory copy so load() must go through the blob
+            n1.watchdog.incidents._payloads.clear()
+            assert n1.watchdog.incidents.load(iid) is None
+            assert ivf_cache.load_blob(key, "incident") is None  # deleted
+        finally:
+            n1.close()
+
+
+# ---------------------------------------------------------------------------
+# REST surface + bundle schema gate (tier-1)
+# ---------------------------------------------------------------------------
+
+#: the diagnostics bundle's schema contract — changing either set is an
+#: intentional, reviewed act (support tooling parses this artifact)
+BUNDLE_KEYS = {"version", "cluster_name", "timestamp", "master_node",
+               "_nodes", "nodes", "failures"}
+NODE_KEYS = {"name", "flight", "watchdog", "incidents",
+             "incident_payloads", "hot_threads", "tasks", "programs",
+             "breakers", "thread_pool"}
+
+
+class TestDiagnosticsSchema:
+    def test_bundle_schema_and_bounded_rings(self, node):
+        FAULTS.inject("watchdog.program_stall", count=1)
+        node.watchdog.run_once()
+        rc = RestController(node)
+        s, out = rc.dispatch("GET", "/_cluster/diagnostics", {}, b"")
+        assert s == 200
+        assert set(out) == BUNDLE_KEYS
+        assert out["version"] == 1
+        assert out["_nodes"]["successful"] == 1
+        assert out["_nodes"]["failed"] == 0
+        entry = out["nodes"][node.node_id]
+        assert set(entry) == NODE_KEYS
+        fl = entry["flight"]
+        assert set(fl["rings"]) == set(flight.RING_CAPS)
+        for name, events in fl["rings"].items():
+            assert len(events) <= flight.RING_CAPS[name], name
+        # inline incident payloads are bounded by the ?incidents= cap
+        assert len(entry["incident_payloads"]) <= 8
+        # monotonic + display stamps on every event, never a raw delta
+        for events in fl["rings"].values():
+            for e in events:
+                assert "ts_monotonic" in e and "timestamp_ms" in e
+
+    def test_node_flight_and_cat_incidents(self, node):
+        FAULTS.inject("watchdog.program_stall", count=1)
+        trips = node.watchdog.run_once()
+        iid = [t["incident_id"] for t in trips if t["incident_id"]][0]
+        rc = RestController(node)
+        s, out = rc.dispatch("GET", "/_nodes/_local/flight", {}, b"")
+        assert s == 200
+        assert out["flight"]["counts"]["trips"] >= 1
+        assert any(e["id"] == iid for e in out["incidents"])
+        s, rows = rc.dispatch("GET", "/_cat/incidents", {}, b"")
+        assert s == 200
+        row = [r for r in rows if r["id"] == iid][0]
+        assert row["detector"] == "program_stall"
+        s, payload = rc.dispatch(
+            "GET", f"/_cluster/diagnostics/incidents/{iid}", {}, b"")
+        assert s == 200 and payload["id"] == iid
+        s, _ = rc.dispatch(
+            "GET", "/_cluster/diagnostics/incidents/nope:1", {}, b"")
+        assert s == 404
+
+    def test_hot_threads_snapshot_is_sleepless_and_capped(self):
+        t0 = time.perf_counter()
+        snap = hot_threads_snapshot(limit=4)
+        assert time.perf_counter() - t0 < 0.5
+        assert len(snap) <= 4
+        for row in snap:
+            assert row["stack"] and isinstance(row["stack"][0], str)
+
+
+class TestRunningTimeSatellite:
+    def test_human_time_scales(self):
+        from elasticsearch_tpu.tracing.tasks import human_time
+
+        assert human_time(850_000) == "850micros"
+        assert human_time(770_000_000) == "770ms"
+        assert human_time(int(12.3e9)) == "12.3s"
+        assert human_time(int(4.5 * 60e9)) == "4.5m"
+        assert human_time(int(2.2 * 3600e9)) == "2.2h"
+
+    def test_tasks_json_and_cat_carry_both_forms(self, node):
+        t = node.tasks.register("indices:data/read/search", "wedged")
+        try:
+            j = t.to_json()
+            assert j["running_time_in_nanos"] >= 0
+            assert re.fullmatch(r"[\d.]+(micros|ms|s|m|h)",
+                                j["running_time"])
+            rc = RestController(node)
+            s, rows = rc.dispatch("GET", "/_cat/tasks", {}, b"")
+            assert s == 200
+            row = [r for r in rows
+                   if r["task_id"] == t.tagged_id][0]
+            assert re.fullmatch(r"[\d.]+(micros|ms|s|m|h)",
+                                row["running_time"])
+            assert int(row["running_time_in_nanos"]) >= 0
+            assert "running_time" in rows.default
+        finally:
+            node.tasks.unregister(t)
+
+
+# ---------------------------------------------------------------------------
+# 2-node cluster: publish-commit window fault + bundle fan-out + dead peer
+# ---------------------------------------------------------------------------
+
+class TestClusterDiagnostics:
+    def test_publish_window_fault_trips_and_bundle_merges_members(self):
+        from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+        port = _free_port()
+        node0 = Node(name="rank0")
+        c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                              ping_interval=0)
+        node1 = Node(name="rank1")
+        c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port)
+        try:
+            # a publish that dies inside the commit window (the
+            # publish.commit fault domain PR 10 established)
+            FAULTS.inject("publish.commit", count=1)
+            c0.data.create_index("diag", {
+                "settings": {"number_of_shards": 2}})
+            assert any(
+                e.get("event") == "publish_commit_window_fault"
+                for e in node0.flight.ring("cluster"))
+            # the watchdog (manual tick or the always-on thread) trips
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                node0.watchdog.run_once()
+                if node0.watchdog.stats()["trips"].get(
+                        "publish_stall", 0) >= 1:
+                    break
+                time.sleep(0.05)
+            assert node0.watchdog.stats()["trips"].get(
+                "publish_stall", 0) >= 1
+            # the bundle, requested FROM THE OTHER MEMBER, carries both
+            # nodes and rank0's incident evidence
+            rc1 = RestController(node1)
+            s, out = rc1.dispatch("GET", "/_cluster/diagnostics",
+                                  {"incidents": "4"}, b"")
+            assert s == 200
+            assert set(out) == BUNDLE_KEYS
+            assert out["_nodes"]["successful"] == 2
+            assert out["_nodes"]["failed"] == 0
+            n0_entry = out["nodes"][node0.node_id]
+            assert n0_entry["watchdog"]["trips"].get("publish_stall",
+                                                     0) >= 1
+            assert any(i["detector"] == "publish_stall"
+                       for i in n0_entry["incidents"])
+            payloads = [p for p in n0_entry["incident_payloads"]
+                        if p["detector"] == "publish_stall"]
+            assert payloads, "the dump must ride the bundle inline"
+            inc = payloads[-1]
+            assert inc["hot_threads"]
+            assert any(e.get("event") == "publish_commit_window_fault"
+                       for e in inc["flight"]["rings"]["cluster"])
+            # dead peer: kill rank1 ABRUPTLY (no cluster:leave — a crash,
+            # not a drain); the bundle from the survivor still answers
+            # 200 and counts the corpse in _nodes.failed
+            c1._stop.set()
+            c1.transport.close()
+            rc0 = RestController(node0)
+            s, out = rc0.dispatch("GET", "/_cluster/diagnostics", {}, b"")
+            assert s == 200
+            assert out["_nodes"]["failed"] >= 1
+            assert out["failures"]
+            assert node0.node_id in out["nodes"]
+        finally:
+            c1.close()
+            c0.close()
+            node1.close()
+            node0.close()

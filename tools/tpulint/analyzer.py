@@ -98,12 +98,15 @@ BUDGET_EXEMPT_MARKERS = ("/elasticsearch_tpu/resources/",)
 # a lock turns one lost notify (or a crashed drain loop) into every
 # parked client wedging forever. Timeout-bounded waits re-check state.
 BLOCKING_PATH_MARKERS = ("/serving/",)
-# R011 scope: the cluster control plane — fault detection, elections,
-# publish and recovery all run background threads; one that is not
-# daemon=True (or whose loop never checks a stop Event) survives close()
-# and keeps probing/publishing a torn-down cluster, wedging test
-# teardown and process exit.
-THREADS_PATH_MARKERS = ("/cluster/",)
+# R011 scope: every package that runs background threads — the cluster
+# control plane (fault detection, elections, publish), the serving
+# front-end (coalescer drain) and the monitor package (watchdog tick,
+# flight sampling). A thread that is not daemon=True (or whose loop
+# never checks a stop/closed gate) survives close() and keeps
+# probing/publishing/draining a torn-down node, wedging test teardown
+# and process exit — the watchdog/recorder threads are born under the
+# rule rather than grandfathered past it.
+THREADS_PATH_MARKERS = ("/cluster/", "/monitor/", "/serving/")
 # R012 scope: the product package MINUS the packages whose __init__
 # installs the trace auditor before their submodules bind jax.jit
 # (tracing/retrace.py install-order contract). An import-time binding

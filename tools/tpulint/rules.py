@@ -759,12 +759,14 @@ class _Checker(ast.NodeVisitor):
     # -- R011 ---------------------------------------------------------------
 
     def _check_cluster_thread(self, node: ast.Call) -> None:
-        """R011: ``threading.Thread(...)`` in a cluster module must be
-        ``daemon=True`` (the control plane must never block interpreter
+        """R011: ``threading.Thread(...)`` in a background-thread module
+        (cluster/, monitor/, serving/) must be ``daemon=True`` (a
+        control-plane or watchdog thread must never block interpreter
         exit) and, when its target's body loops, every loop must consult
-        a stop Event (the ``_fault_loop`` pattern: ``while not
-        self._stop.wait(interval)``) — an ungated loop outlives close()
-        and keeps probing/publishing a torn-down cluster."""
+        a stop/closed gate (the ``_fault_loop`` pattern ``while not
+        self._stop.wait(interval)``, or the drain loop's ``if
+        self._closed: return``) — an ungated loop outlives close() and
+        keeps probing/publishing/draining a torn-down node."""
         if not self.ctx.threads:
             return
         chain = _attr_chain(node.func) or ""
@@ -776,10 +778,10 @@ class _Checker(ast.NodeVisitor):
                        if kw.arg == "daemon"), None)
         if not (isinstance(daemon, ast.Constant) and daemon.value is True):
             self._emit("R011", node,
-                       "background thread in a cluster module without "
-                       "daemon=True — a non-daemon control-plane thread "
-                       "blocks interpreter shutdown; pass daemon=True and "
-                       "gate its loop on a stop Event")
+                       "background thread without daemon=True — a "
+                       "non-daemon control-plane/watchdog thread blocks "
+                       "interpreter shutdown; pass daemon=True and gate "
+                       "its loop on a stop Event (or closed flag)")
         target = next((kw.value for kw in node.keywords
                        if kw.arg == "target"), None)
         fn_node = self._resolve_thread_target(target)
@@ -791,10 +793,10 @@ class _Checker(ast.NodeVisitor):
             if isinstance(sub, ast.While) and not self._stop_gated(sub):
                 self._emit("R011", sub,
                            f"loop in thread target `{fn_node.name}` is not "
-                           "gated on a stop Event — check a `stop` "
-                           "Event in the loop (the _fault_loop pattern: "
-                           "`while not self._stop.wait(interval)`) so "
-                           "close() actually stops the thread")
+                           "gated on a stop Event — check a `stop` Event "
+                           "or `closed` flag in the loop (the _fault_loop "
+                           "pattern: `while not self._stop.wait(interval)`)"
+                           " so close() actually stops the thread")
 
     def _resolve_thread_target(self, target) -> Optional[ast.AST]:
         """target= resolved to a function/method DEFINED IN THIS MODULE:
@@ -817,11 +819,18 @@ class _Checker(ast.NodeVisitor):
     def _stop_gated(loop) -> bool:
         """Anywhere in the loop (test or body — `while True: ... if
         stop.is_set(): break` counts), a name/attribute containing
-        'stop' is consulted."""
+        'stop' or 'closed' is consulted — both spellings of the same
+        shutdown-gate pattern (`while not self._stop.wait(i)` in the
+        control plane, `if self._closed: return` in the serving drain
+        loop)."""
         for sub in ast.walk(loop):
-            if isinstance(sub, ast.Attribute) and "stop" in sub.attr.lower():
+            if isinstance(sub, ast.Attribute) and (
+                    "stop" in sub.attr.lower()
+                    or "closed" in sub.attr.lower()):
                 return True
-            if isinstance(sub, ast.Name) and "stop" in sub.id.lower():
+            if isinstance(sub, ast.Name) and (
+                    "stop" in sub.id.lower()
+                    or "closed" in sub.id.lower()):
                 return True
         return False
 
