@@ -1777,8 +1777,37 @@ class DistributedDataService:
         except Exception:
             svc.recoveries.finish(rec, ok=False)
             raise
+        # shard assignment graduated on this node: persist the census
+        # (ISSUE 14 durability — the work list must survive a crash
+        # between here and the next clean close) and queue the pre-warm
+        # replay so the copy serves its first searches compile-free
+        # (serving/warmup.py; both best-effort, cooldown-guarded)
+        try:
+            self._flush_census_debounced(index)
+            wu = getattr(getattr(self.node, "serving", None),
+                         "warmup", None)
+            if wu is not None:
+                wu.kick("shard_assignment", [index])
+        except Exception:  # tpulint: allow[R006] — warmup plumbing must
+            pass           # never fail a completed recovery
         return {"copied": copied, "skipped": skipped,
                 "ops_replayed": replayed, "mode": rec["mode"]}
+
+    def _flush_census_debounced(self, index: str) -> None:
+        """Recovery-path census flush, debounced per index: recovery
+        actions fire once per SHARD, the census is per INDEX — a P-shard
+        relocation would otherwise pay P back-to-back load+merge+rewrite
+        cycles inline in the transport path for one work list."""
+        ts = getattr(self, "_census_flush_ts", None)
+        if ts is None:
+            ts = self._census_flush_ts = {}
+        now = time.monotonic()
+        if now - ts.get(index, float("-inf")) < 5.0:
+            return
+        ts[index] = now
+        from elasticsearch_tpu.resources import census
+
+        census.store_census(index)
 
     def _on_shard_sync(self, payload: dict) -> dict:
         """Recovery source: checkpoint comparison first — when the
@@ -1798,6 +1827,14 @@ class DistributedDataService:
             return self._shard_sync_response(engine, payload)
         finally:
             svc.recoveries.source_finished()
+            # the source has served this index — flush ITS census now so
+            # the relocation target's pre-warm has a fresh work list to
+            # read (ISSUE 14: flush on shard assignment, source side;
+            # debounced — one flush covers all P shard handshakes)
+            try:
+                self._flush_census_debounced(payload["index"])
+            except Exception:  # tpulint: allow[R006] — best-effort
+                pass           # durability, never a failed handshake
 
     def _shard_sync_response(self, engine, payload: dict) -> dict:
         ckpt = payload.get("checkpoint")

@@ -618,16 +618,74 @@ class IndexService:
         p99 separate from steady-state p99, the before/after number
         ROADMAP #6's zero-warmup acceptance needs."""
         from elasticsearch_tpu.monitor import programs
+        from elasticsearch_tpu.serving import warmup as warmup_mod
         from elasticsearch_tpu.tracing import retrace
 
         t_req = time.perf_counter()
         snap = retrace.snapshot()
-        with programs.index_scope(self.name):
+        prewarm = warmup_mod.in_prewarm()
+        # pre-warm replays run OUTSIDE the census scope: a replay must
+        # not bump the very key hit counts it was ordered by (max-merge
+        # persistence would compound the inflation into a
+        # self-reinforcing ranking every restart) — the programs still
+        # register in the registry itself, which is what replay()'s
+        # warm/missing verification reads
+        with programs.index_scope(None if prewarm else self.name):
             resp = self._search_inner(body, dfs=dfs, preference=preference)
         delta = retrace.traces_since(snap)
-        warmup = "unknown" if delta < 0 else ("true" if delta else "false")
+        # pre-warm replays label "prewarm", not true/false: warmup's own
+        # compiles must not pollute the cold-start acceptance series,
+        # and a replay must not re-record its body into the census (it
+        # would inflate its own work list's hit counts)
+        if prewarm:
+            warmup = "prewarm"
+        else:
+            warmup = "unknown" if delta < 0 \
+                else ("true" if delta else "false")
+            self._record_census_body(body)
         self._record_search_metric(time.perf_counter() - t_req, warmup)
         return resp
+
+    #: census-body sampling: record every request for the first
+    #: _CENSUS_FULL requests (building the replayable set wants full
+    #: fidelity), then 1-in-_CENSUS_SAMPLE with weighted hits — the
+    #: canonical json.dumps is the only per-search cost this feature
+    #: adds, and for a steady workload whose bodies are already
+    #: recorded it is pure counter maintenance
+    _CENSUS_FULL = 256
+    _CENSUS_SAMPLE = 8
+
+    def _record_census_body(self, body: dict) -> None:
+        """Feed the replayable census half (monitor/programs.py): the
+        canonical JSON of an eligible body, so a restarted node can
+        re-drive the same programs (serving/warmup.py). Profile bodies
+        are excluded (they pin the host loop — replaying one would warm
+        the wrong path); scroll bodies hold contexts."""
+        import json as _json
+
+        if not isinstance(body, dict) or body.get("profile") \
+                or body.get("scroll"):
+            return
+        # GIL-atomic int bump; exact counts don't matter to a sampler
+        self._census_seen = getattr(self, "_census_seen", 0) + 1
+        weight = 1
+        if self._census_seen > self._CENSUS_FULL:
+            if self._census_seen % self._CENSUS_SAMPLE:
+                return
+            weight = self._CENSUS_SAMPLE
+        try:
+            canon = _json.dumps(
+                {k: v for k, v in body.items()
+                 if k not in ("_query_cache", "profile")},
+                sort_keys=True)
+        except (TypeError, ValueError):
+            return  # unserializable body: not replayable
+        try:
+            from elasticsearch_tpu.monitor import programs
+
+            programs.REGISTRY.record_body(self.name, canon, n=weight)
+        except Exception:  # tpulint: allow[R006] — census recording
+            pass           # must never fail the measured search
 
     def _record_search_metric(self, seconds: float, warmup: str) -> None:
         """Search latency with the warmup dimension. Library-embedded
@@ -642,7 +700,8 @@ class IndexService:
                 "estpu_search_duration_seconds",
                 "Search latency by index; warmup=true marks requests "
                 "that paid a fresh jit compile (unknown = trace auditor "
-                "absent)", ("index", "warmup"),
+                "absent; prewarm = census replay by serving/warmup.py)",
+                ("index", "warmup"),
             ).labels(self.name, warmup).observe(seconds)
         except Exception:  # tpulint: allow[R006] — dropping one metric
             pass           # sample must never fail the measured search

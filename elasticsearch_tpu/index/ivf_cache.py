@@ -263,10 +263,21 @@ def load_blob(key: str, ext: str) -> Optional[bytes]:
     return None
 
 
-def store_blob(key: str, blob: bytes, ext: str) -> None:
+def store_blob(key: str, blob: bytes, ext: str,
+               overwrite: bool = True) -> None:
     """Persist raw bytes under ``(key, ext)`` (memory + every registered
-    directory; best-effort on disk like every other blob here)."""
-    _seed(f"{ext}:{key}", blob, _disk_paths(key, ext=ext))
+    directory; best-effort on disk like every other blob here).
+
+    ``overwrite`` defaults True: unlike the content-addressed IVF/PQ
+    blobs (identical key ⇒ identical bytes, so skip-if-exists is a pure
+    optimization), the generic tier's families are NAME-addressed and
+    MUTABLE — the census merges on every flush, the incident index
+    appends — and a skip-if-exists store would silently freeze the disk
+    copy at its first write (the in-memory tier masking it until the
+    process dies). Content-addressed callers (AOT executables) pass
+    ``overwrite=False`` to keep the cheap skip."""
+    _seed(f"{ext}:{key}", blob, _disk_paths(key, ext=ext),
+          overwrite=overwrite)
 
 
 def delete_blob(key: str, ext: str) -> None:
@@ -280,16 +291,23 @@ def delete_blob(key: str, ext: str) -> None:
             pass
 
 
-def _seed(mkey: str, blob: bytes, paths: List[str]) -> None:
+def _seed(mkey: str, blob: bytes, paths: List[str],
+          overwrite: bool = False) -> None:
     with _LOCK:
         if mkey not in _MEM and len(_MEM) >= _MEM_CAP:
             _MEM.pop(next(iter(_MEM)))
         _MEM[mkey] = blob
     for path in paths:
-        if os.path.exists(path):
+        if not overwrite and os.path.exists(path):
             continue
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
+        # tmp name unique per WRITER, not just per process: overwrite-
+        # mode stores race across threads (watchdog flush vs recovery
+        # flush vs close), and a shared tmp lets one writer publish
+        # another's half-written bytes via os.replace — the digest frame
+        # would then detect-and-DELETE the census on next load, losing
+        # the exact durability the overwrite exists to provide
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         try:
             with open(tmp, "wb") as fh:
                 fh.write(blob)

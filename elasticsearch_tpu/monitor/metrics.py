@@ -447,6 +447,16 @@ def process_counters() -> Dict[str, float]:
     except Exception:
         out["jit.traces_total"] = -1.0
     try:
+        # AOT executable-cache ledger (monitor/compile_cache.py, jax-free
+        # import): -1 unknown sentinels until the AOT layer first
+        # resolves, so bench deltas render null — the jit_compiles
+        # discipline, never a fake 0
+        from elasticsearch_tpu.monitor import compile_cache
+
+        out.update(compile_cache.counter_values())
+    except Exception:
+        pass
+    try:
         from elasticsearch_tpu import resources
 
         st = resources.RESIDENCY.stats()

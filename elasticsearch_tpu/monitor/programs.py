@@ -72,6 +72,14 @@ from elasticsearch_tpu.monitor.metrics import (DEFAULT_LATENCY_BUCKETS,
 _ACTIVE_INDEX: contextvars.ContextVar[Optional[str]] = \
     contextvars.ContextVar("estpu-program-index", default=None)
 
+#: the (program, shapes) key of the dispatch wrapper currently timing a
+#: device call on this flow — set by :meth:`ProgramRegistry.timed` so the
+#: AOT layer (parallel/aot.py) can attribute its cache-source events to
+#: the SAME observatory key the wall time lands on (the AOT layer only
+#: sees raw arg signatures, which differ from dispatch-point static sigs)
+_ACTIVE_PROG_KEY: contextvars.ContextVar[Optional[Tuple[str, str]]] = \
+    contextvars.ContextVar("estpu-program-key", default=None)
+
 
 @contextmanager
 def index_scope(index_name: Optional[str]):
@@ -166,7 +174,7 @@ class ProgramEntry:
 
     __slots__ = ("program", "shapes", "backend", "compiles",
                  "compile_seconds", "calls", "execute_seconds", "hist",
-                 "fields", "last_used_at")
+                 "fields", "last_used_at", "cache_sources")
 
     _FIELD_CAP = 8  # bounded per-entry field set (census attribution)
 
@@ -181,6 +189,12 @@ class ProgramEntry:
         self.hist = Histogram(DEFAULT_LATENCY_BUCKETS)
         self.fields: Set[str] = set()
         self.last_used_at = 0.0  # epoch, display only (no subtraction)
+        # per-source resolution counts from the AOT executable cache
+        # (aot_hit / xla_dir_hit / fresh — parallel/aot.py): the honest
+        # "where did this program come from" ledger behind the `cache`
+        # column of _cat/programs. Bounded by construction: the source
+        # vocabulary is fixed.
+        self.cache_sources: Dict[str, int] = {}
 
     @property
     def cold(self) -> bool:
@@ -205,6 +219,7 @@ class ProgramEntry:
             "cold": self.cold,
             "fields": sorted(self.fields),
             "last_used_at": self.last_used_at,
+            "cache_sources": dict(sorted(self.cache_sources.items())),
         }
 
 
@@ -216,11 +231,27 @@ class ProgramRegistry:
 
     _MAX_KEYS = 512          # key cap; overflow collapses, never grows
     _CENSUS_CAP = 1024       # per-index census key cap
+    _BODY_CAP = 64           # per-index replayable-body cap
 
     def __init__(self):
         self._lock = threading.Lock()
         self._entries: Dict[Tuple[str, str, str], ProgramEntry] = {}
-        self._census: Dict[str, Set[Tuple[str, str, str]]] = {}
+        # per-index (program, shapes, field) → hit count: the count makes
+        # warmup hottest-first instead of alphabetical (ISSUE 14)
+        self._census: Dict[str, Dict[Tuple[str, str, str], int]] = {}
+        # per-index canonical search bodies → hit count: the REPLAYABLE
+        # half of the census. Keys alone can't rebuild a compiled DSL
+        # tree (mesh_dsl program structure isn't derivable from arg
+        # shapes), so warmup replays the observed bodies through the
+        # real search path — which drives the real program factories —
+        # and the keys verify coverage (census.replay warm/missing).
+        self._bodies: Dict[str, Dict[str, int]] = {}
+        # monotone census/bodies mutation counters: the watchdog's
+        # periodic flush skips the blob write when nothing moved —
+        # per INDEX, so one busy index can't force idle siblings'
+        # censuses through a load+merge+rewrite every interval
+        self._census_gen = 0
+        self._census_gens: Dict[str, int] = {}
         # in-flight dispatches on the shared age-board primitive
         # (monitor/flight.py::OpBoard — the watchdog's publish tracking
         # rides the same class): the program-stall detector reads ages
@@ -233,10 +264,13 @@ class ProgramRegistry:
     # -- entry resolution ----------------------------------------------------
 
     def _entry(self, program: str, shapes: str,
-               field: Optional[str]) -> ProgramEntry:
+               field: Optional[str], census: bool = True) -> ProgramEntry:
         """Get-or-create under the lock; past the cap the reserved
         overflow row absorbs new keys (counts survive, attribution
-        doesn't — the metrics.py discipline)."""
+        doesn't — the metrics.py discipline). ``census=False`` skips the
+        per-index census side effect (cache-source accounting resolves
+        entries without knowing the field — recording would plant a
+        spurious field-less duplicate beside the real dispatch row)."""
         backend = backend_fingerprint()
         key = (program, shapes, backend)
         with self._lock:
@@ -251,11 +285,20 @@ class ProgramRegistry:
             if field and len(e.fields) < ProgramEntry._FIELD_CAP:
                 e.fields.add(field)
             index = _ACTIVE_INDEX.get()
-            if index is not None and key[0] != OVERFLOW_LABEL:
-                c = self._census.setdefault(index, set())
-                if len(c) < self._CENSUS_CAP:
-                    c.add((program, shapes, field or ""))
+            if census and index is not None and key[0] != OVERFLOW_LABEL:
+                c = self._census.setdefault(index, {})
+                ck = (program, shapes, field or "")
+                if ck in c:
+                    c[ck] += 1
+                    self._bump_census_gen_locked(index)
+                elif len(c) < self._CENSUS_CAP:
+                    c[ck] = 1
+                    self._bump_census_gen_locked(index)
         return e
+
+    def _bump_census_gen_locked(self, index: str) -> None:
+        self._census_gen += 1
+        self._census_gens[index] = self._census_gens.get(index, 0) + 1
 
     # -- recording -----------------------------------------------------------
 
@@ -312,6 +355,61 @@ class ProgramRegistry:
         else:
             self.record_execute(program, shapes, seconds, field=field)
 
+    def record_cache_source(self, source: str,
+                            fallback_program: str = "",
+                            fallback_shapes: str = "") -> None:
+        """One AOT-cache resolution (aot_hit / xla_dir_hit / fresh,
+        parallel/aot.py) attributed to the observatory key of the
+        dispatch wrapper currently timing this flow — the contextvar
+        :meth:`timed` sets — so the `cache` column of _cat/programs
+        lines up with the wall-time rows. Resolutions outside any timed
+        block (direct factory use) land on the caller-supplied
+        fallback key."""
+        active = _ACTIVE_PROG_KEY.get()
+        program, shapes = active if active is not None else (
+            fallback_program, fallback_shapes)
+        if not program:
+            return
+        # census=False: the dispatch wrapper's own record carries the
+        # field — a second, field-less census row here would be a
+        # phantom key in every persisted census
+        e = self._entry(program, shapes, None, census=False)
+        with self._lock:
+            e.cache_sources[source] = e.cache_sources.get(source, 0) + 1
+
+    def record_body(self, index: str, body_key: str, n: int = 1) -> None:
+        """One eligible canonical search body observed for ``index`` —
+        the replayable census half (IndexService.search feeds this;
+        pre-warm replays suppress themselves so warmup traffic never
+        inflates its own work list). Bounded per index; hit counts make
+        replay hottest-first. ``n`` > 1 when the caller samples (each
+        recorded observation stands for n requests)."""
+        n = max(1, int(n))
+        with self._lock:
+            b = self._bodies.setdefault(index, {})
+            if body_key in b:
+                b[body_key] += n
+            elif len(b) < self._BODY_CAP:
+                b[body_key] = n
+            else:
+                # lossy-counting probation at the cap: decay the coldest
+                # entry; once it bottoms out the newcomer takes its slot.
+                # A workload that SHIFTS to new hot bodies therefore
+                # displaces stale early ones (first-come-forever would
+                # freeze the replay set at boot-time traffic), while a
+                # churn of one-off queries only nibbles at the floor —
+                # hot entries' counts dwarf the decay.
+                # decay/insert by n, not 1: in the sampled regime each
+                # observation stands for n requests — unit steps would
+                # displace stale entries n× slower than the model above
+                cold = min(b, key=b.get)
+                if b[cold] <= n:
+                    del b[cold]
+                    b[body_key] = n
+                else:
+                    b[cold] -= n
+            self._bump_census_gen_locked(index)
+
     # -- in-flight dispatch tracking (watchdog feed) -------------------------
 
     def begin_dispatch(self, program: str, shapes: str) -> int:
@@ -357,10 +455,14 @@ class ProgramRegistry:
 
         snap = retrace.snapshot()
         tok = self.begin_dispatch(program, shapes)
+        # the AOT layer resolving a program INSIDE this block attributes
+        # its cache source to this key (record_cache_source)
+        ptok = _ACTIVE_PROG_KEY.set((program, shapes))
         t0 = time.perf_counter()
         try:
             yield
         finally:
+            _ACTIVE_PROG_KEY.reset(ptok)
             self.end_dispatch(tok)
         self.record_call(program, shapes, time.perf_counter() - t0,
                          retrace.traces_since(snap), field=field)
@@ -408,16 +510,38 @@ class ProgramRegistry:
         }
 
     def census(self, index: str) -> List[dict]:
-        """The observed (program, shapes, field) key set for ``index``,
-        sorted — the persistable pre-warm census (resources/census.py)."""
+        """The observed (program, shapes, field) key set for ``index``
+        with per-key hit counts, sorted — the persistable pre-warm
+        census (resources/census.py)."""
         with self._lock:
-            keys = sorted(self._census.get(index, ()))
-        return [{"program": p, "shapes": s, "field": f}
-                for p, s, f in keys]
+            keys = sorted(self._census.get(index, {}).items())
+        return [{"program": p, "shapes": s, "field": f, "hits": n}
+                for (p, s, f), n in keys]
+
+    def bodies(self, index: str) -> List[dict]:
+        """The observed replayable bodies for ``index``, hottest first —
+        the warmup work list (serving/warmup.py replays these through
+        the real search path, hottest keys first)."""
+        with self._lock:
+            items = sorted(self._bodies.get(index, {}).items(),
+                           key=lambda kv: (-kv[1], kv[0]))
+        return [{"body": b, "hits": n} for b, n in items]
+
+    def census_generation(self) -> int:
+        """Monotone census/bodies mutation counter — the watchdog's
+        periodic durability flush short-circuits when this is still."""
+        with self._lock:
+            return self._census_gen
+
+    def census_generations(self) -> Dict[str, int]:
+        """Per-index mutation counters — the flush writes only the
+        indices that actually moved."""
+        with self._lock:
+            return dict(self._census_gens)
 
     def census_indices(self) -> List[str]:
         with self._lock:
-            return sorted(self._census)
+            return sorted(set(self._census) | set(self._bodies))
 
     def counter_values(self) -> Dict[str, float]:
         """Flat per-key counter map for the bench before/after delta
@@ -439,6 +563,9 @@ class ProgramRegistry:
         with self._lock:
             self._entries.clear()
             self._census.clear()
+            self._bodies.clear()
+            self._census_gen = 0
+            self._census_gens.clear()
         self._inflight.clear()
 
 

@@ -124,6 +124,10 @@ class WatchdogService:
         # per-detector incident cooldown: within it a trip still counts
         # and records, but no new dump is captured
         "cooldown_s": 30.0,
+        # census durability (ISSUE 14): the pre-warm work list used to
+        # persist only on clean Node.close() — a crash or kill lost it.
+        # The tick thread flushes it on this cadence when it changed.
+        "census_flush_every_s": 60.0,
     }
 
     def __init__(self, node, **overrides: float):
@@ -150,6 +154,12 @@ class WatchdogService:
         self._last_counters: Optional[Dict[str, float]] = None
         self._fsync_seen: Optional[Tuple[int, float, List[int]]] = None
         self._cluster_scan_ts = time.monotonic()
+        # census-flush cursors: last flushed PER-INDEX generations +
+        # last flush monotonic — flush only the indices that moved, and
+        # only at the cadence, so a busy index pays one blob write per
+        # interval and its idle siblings pay nothing
+        self._census_flushed_gens: Dict[str, int] = {}
+        self._census_flush_ts = time.monotonic()
         self._m_trips = node.metrics.counter(
             "estpu_watchdog_trips_total",
             "Watchdog detector trips, by detector", ("detector",))
@@ -197,6 +207,10 @@ class WatchdogService:
         through metrics/flight/incidents)."""
         self.ticks += 1
         self._sample_metrics()
+        try:
+            self._flush_census()
+        except Exception:
+            pass  # durability is best-effort; detectors still run
         trips: List[dict] = []
         for check in (self._check_programs, self._check_threadpools,
                       self._check_fsync, self._check_publish,
@@ -230,6 +244,38 @@ class WatchdogService:
                     break
         if delta:
             self.node.flight.record("metrics", delta=delta)
+
+    def _flush_census(self) -> None:
+        """Census durability (ISSUE 14 satellite): persist this node's
+        per-index program census + replayable bodies on the tick cadence
+        whenever the registry moved since the last flush — a kill -9 now
+        costs at most one interval of census, not the whole pre-warm
+        work list. Scoped to THIS node's indices (the registry is
+        process-global; a sibling in-process node flushes its own)."""
+        from elasticsearch_tpu.monitor import programs
+        from elasticsearch_tpu.resources import census
+
+        gens = programs.REGISTRY.census_generations()
+        dirty = [name for name in set(gens) & set(self.node.indices)
+                 if gens[name] != self._census_flushed_gens.get(name)]
+        if not dirty:
+            return
+        now = time.monotonic()
+        if now - self._census_flush_ts < self.config["census_flush_every_s"]:
+            return
+        # the TIME cursor advances now (failed stores retry at the
+        # cadence, not every tick); each index's GENERATION cursor
+        # advances only when ITS store succeeded — a transient disk
+        # error on an idle-afterwards node must not mark unflushed
+        # census data flushed forever
+        self._census_flush_ts = now
+        for name in dirty:
+            try:
+                census.store_census(name)
+            except Exception:
+                continue  # one index's failed write must not starve
+                # the rest — and must keep ITS generation dirty
+            self._census_flushed_gens[name] = gens[name]
 
     # -- detectors -----------------------------------------------------------
 

@@ -248,6 +248,37 @@ class Node:
                              for p, s, b, _c, _cs, es in _programs()],
                     kind="counter")
 
+        # AOT executable cache (parallel/aot.py via the jax-free counter
+        # store monitor/compile_cache.py): per-source resolution counts —
+        # aot_hit (deserialized blob, the zero-warmup path), xla_dir_hit
+        # (fresh compile served by the persistent XLA dir), fresh (full
+        # price), and the detected-miss/fallback taxonomy — plus phase
+        # seconds. Fixed label vocabulary, cardinality bounded by
+        # construction.
+        def _cc_events():
+            from elasticsearch_tpu.monitor import compile_cache
+
+            return [((s,), v)
+                    for s, v in compile_cache.events_snapshot().items()]
+
+        def _cc_seconds():
+            from elasticsearch_tpu.monitor import compile_cache
+
+            return [((ph,), v)
+                    for ph, v in compile_cache.seconds_snapshot().items()]
+
+        m.collector("estpu_compile_cache_events_total",
+                    "AOT executable-cache resolutions by source "
+                    "(parallel/aot.py): aot_hit / xla_dir_hit / fresh, "
+                    "plus detected corrupt/mismatch misses, store "
+                    "outcomes, and call fallbacks", ("source",),
+                    _cc_events, kind="counter")
+        m.collector("estpu_compile_cache_seconds_total",
+                    "Wall seconds in AOT cache phases: deserialize "
+                    "(blob hit), compile (fresh lower+compile), "
+                    "serialize (store)", ("phase",),
+                    _cc_seconds, kind="counter")
+
     # -- gateway ---------------------------------------------------------------
 
     def _index_meta_path(self, name: str) -> str:

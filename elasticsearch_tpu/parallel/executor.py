@@ -148,6 +148,12 @@ def _bm25_program(mesh, cache, *, Q: int, T: int, P: int, D: int, k: int):
 
     sh = PS("shard")
     fn = wrap(body, (sh, sh, sh, sh, sh, sh), (PS(), PS(), PS(), PS()))
+    # AOT executable cache (parallel/aot.py): first call per concrete
+    # arg-shape class resolves memo → serialized-blob deserialize →
+    # fresh compile(+store) — the restart path skips XLA entirely
+    from elasticsearch_tpu.parallel import aot
+
+    fn = aot.wrap(fn, "mesh_bm25", key)
     cache[key] = fn
     return fn
 
@@ -199,7 +205,10 @@ def _knn_program(mesh, cache, *, Q: int, dims: int, D: int, k: int, metric: str)
         glocal = jnp.take_along_axis(flat_idx, gpos, axis=1).astype(jnp.int32)
         return gvals, gshard, glocal
 
+    from elasticsearch_tpu.parallel import aot
+
     fn = wrap(body, (PS(), PS("shard"), PS("shard")), (PS(), PS(), PS()))
+    fn = aot.wrap(fn, "mesh_knn", key)
     cache[key] = fn
     return fn
 
@@ -249,7 +258,10 @@ def _maxsim_program(mesh, cache, *, Q: int, T: int, dims: int, D: int,
         glocal = jnp.take_along_axis(flat_i, gpos, axis=1).astype(jnp.int32)
         return gvals, gshard, glocal
 
+    from elasticsearch_tpu.parallel import aot
+
     fn = wrap(body, (PS(), PS("shard"), PS("shard")), (PS(), PS(), PS()))
+    fn = aot.wrap(fn, "mesh_maxsim", key)
     cache[key] = fn
     return fn
 
@@ -285,7 +297,7 @@ def _tail_candidates_mode(compiled) -> bool:
 
 
 def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=(),
-                 force_scatter: bool = False):
+                 force_scatter: bool = False, aot_key=None):
     """Build the shard_map program for one compiled DSL structure: emit-tree
     score/mask → local top-k → all_gather + global top-k, exact totals via
     psum, per-shard terms-agg count vectors.
@@ -422,7 +434,17 @@ def _dsl_program(mesh, compiled, counts, statics, k: int, pack_spec=(),
     in_specs = tuple(PS("shard") for _ in range(n_in))
     out_specs = (PS(),) + tuple(
         PS("shard") for _ in range(n_aggs + (1 if compiled.want_mask else 0)))
-    return wrap(body, in_specs, out_specs)
+    fn = wrap(body, in_specs, out_specs)
+    if aot_key is not None:
+        # AOT executable cache: aot_key is the caller's full program-cache
+        # key (struct key + statics + shapes + kernel config) — two DSL
+        # trees with identical arg shapes stay distinct blobs
+        from elasticsearch_tpu.parallel import aot
+
+        fn = aot.wrap(
+            fn, "mesh_dsl_scatter" if force_scatter else "mesh_dsl",
+            (aot_key, force_scatter))
+    return fn
 
 
 def _psum_program(mesh, cache, shape):
@@ -437,7 +459,10 @@ def _psum_program(mesh, cache, shape):
     def body(x):
         return psum(sl(x), "shard")
 
+    from elasticsearch_tpu.parallel import aot
+
     fn = wrap(body, (PS("shard"),), PS())
+    fn = aot.wrap(fn, "mesh_psum", key)
     cache[key] = fn
     return fn
 
@@ -634,9 +659,12 @@ class MeshSearchExecutor:
         # program observatory: wall time (dispatch + the host pull below)
         # lands on the (program, padded shape class, backend) key, split
         # compile-vs-execute by this thread's trace delta
+        # nnz in the sig: the postings buffers are [S, nnz], so two nnz
+        # classes are two distinct device programs — census keys must
+        # separate them or warmup verification over-reports warm
         with REGISTRY.timed("mesh_bm25",
                             static_sig(S=self.S, Q=Q, T=T, P=Pmax, D=D,
-                                       k=min(k, D)), field=field):
+                                       k=min(k, D), nnz=nnz), field=field):
             vals, slot, local, totals = prog(
                 d_doc, d_tfn, put(h_starts), put(h_lens), put(h_ws),
                 put(h_live))
@@ -884,7 +912,8 @@ class MeshSearchExecutor:
             prog = self._programs.get((prog_key, pack_spec))
             if prog is None:
                 prog = _dsl_program(self.mesh, compiled, counts, statics,
-                                    kk, pack_spec)
+                                    kk, pack_spec,
+                                    aot_key=(prog_key, pack_spec))
                 self._programs[(prog_key, pack_spec)] = prog
             in_pack = set(pack_idx) if pack_spec else set()
             # fresh_bytes: only THIS entry's exclusive placements count
@@ -932,7 +961,8 @@ class MeshSearchExecutor:
                 kernels.record("tail_scatter_free_failed")
                 prog = _dsl_program(self.mesh, compiled, counts,
                                     statics, kk, pack_spec,
-                                    force_scatter=True)
+                                    force_scatter=True,
+                                    aot_key=(prog_key, pack_spec))
                 # replace the cached entry: same-shape queries go straight
                 # to the scatter program instead of re-failing
                 self._programs[(prog_key, pack_spec)] = prog

@@ -2,35 +2,57 @@
 
 The program observatory (monitor/programs.py) learns, per index, exactly
 which (program, shapes, field) keys its traffic exercises — the padded
-shape classes the pow2 discipline bounds. This module persists that set
-through the content-addressed blob cache's durable tier (beside the
-IVF/PQ artifacts, ``<key>.census`` files in every registered data
-directory), so a restarted node can know, before serving a single
-request, the complete program universe its index needs.
+shape classes the pow2 discipline bounds — and, since ISSUE 14, how HOT
+each key is and which canonical search bodies drove them. This module
+persists that set through the content-addressed blob cache's durable
+tier (beside the IVF/PQ artifacts, ``<key>.census`` files in every
+registered data directory), so a restarted node can know, before serving
+a single request, the complete program universe its index needs — and
+replay it.
 
-That is the pre-warm contract ROADMAP #6 (zero-warmup serving) consumes:
-replay the census against a persistent compiled-program cache and the
-first request after a restart/relocation pays zero compiles. Until that
-cache exists, :func:`replay` already answers the operational question —
-which census keys are warm in the live registry and which would compile
-on first touch — and the acceptance tests use it to prove a served
-key set round-trips exactly.
+That is the pre-warm contract ROADMAP #6 (zero-warmup serving) consumes
+(serving/warmup.py): replay the census bodies through the real search
+path — which drives the real executor program factories and the AOT
+executable cache (parallel/aot.py) — hottest first, and the first
+request after a restart/relocation pays zero compiles. :func:`replay`
+answers the verification question: which census keys are warm in the
+live registry and which would still compile on first touch.
 
-Format: ``sha1-hex\\n{json}`` — the digest makes corruption (torn write,
-disk bitrot) a *detected* miss: a bad blob is deleted and the caller
-falls back to cold-start, never to a crash or a silently wrong key set.
-The payload carries the backend fingerprint, so a census captured on one
-chip generation is never replayed against another.
+Format v2: ``sha1-hex\\n{json}`` with ``keys`` rows carrying per-key
+``hits`` (warmup ordering) and a bounded ``bodies`` list of canonical
+request bodies with their own hit counts (the replayable half — a
+compiled DSL tree cannot be rebuilt from arg shapes alone). v1 blobs
+(PR 11) still load: their keys get ``hits: 1`` and no bodies. The digest
+makes corruption (torn write, disk bitrot) a *detected* miss: a bad blob
+is deleted and the caller falls back to cold-start, never to a crash or
+a silently wrong key set. The payload carries the backend fingerprint,
+so a census captured on one chip generation is never replayed against
+another.
+
+Durability (ISSUE 14 satellite): :func:`store_census` MERGES with the
+persisted census (key/body union, per-entry ``max`` of hit counts — max,
+not sum, so repeated periodic flushes never double-count) and is called
+from three places: the watchdog tick (crash durability — a kill no
+longer loses the work list), shard assignment/recovery graduation, and
+``Node.close()``.
 
 Import cost: no jax at import time (resources/ package contract).
 """
 from __future__ import annotations
 
 import hashlib
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _EXT = "census"
-VERSION = 1
+VERSION = 2
+
+#: persisted-blob caps, mirroring the in-memory registry caps
+#: (programs.ProgramRegistry._CENSUS_CAP / _BODY_CAP): merge-on-store
+#: would otherwise grow the blob by up to one process's worth of new
+#: entries per generation, forever — the hottest rows survive the cut,
+#: which is exactly the set warmup consumes
+KEY_CAP = 1024
+BODY_CAP = 64
 
 
 def census_key(index_name: str) -> str:
@@ -40,24 +62,93 @@ def census_key(index_name: str) -> str:
     return "census_" + hashlib.sha1(index_name.encode("utf-8")).hexdigest()
 
 
+def _key_id(row: dict) -> Tuple[str, str, str]:
+    return (str(row.get("program", "")), str(row.get("shapes", "")),
+            str(row.get("field", "")))
+
+
+#: indices whose persisted census this process has already decayed once
+#: (the decay is per RESTART, not per periodic flush — within one
+#: process, live counts are cumulative and plain max is correct)
+_DECAYED: set = set()
+
+
+def _merge_rows(persisted: List[dict], live: List[dict],
+                ident, decay: bool = False) -> List[dict]:
+    """Union by identity, ``hits`` = max(persisted, live): monotone under
+    repeated flushes (a periodic flush must never double-count the hits
+    the previous flush already persisted) and never forgets a key the
+    current process simply hasn't served yet.
+
+    ``decay`` (set on the first merge of each process): persisted rows
+    NOT reinforced by live traffic halve their hits. Without it, a
+    workload that shifted would be pinned forever — old maxima always
+    out-rank a fresh process's young counts, so the hottest-first cap
+    cut would keep evicting the NEW workload and pre-warm would replay
+    obsolete queries on every restart. Halving per restart lets a
+    genuinely dead body fall out of the capped set in a handful of
+    generations while one idle restart barely dents a hot one."""
+    merged: Dict[object, dict] = {}
+    for row in persisted:
+        r = dict(row)
+        r["hits"] = int(r.get("hits", 1))
+        merged[ident(r)] = r
+    live_ids = set()
+    for row in live:
+        r = dict(row)
+        r["hits"] = int(r.get("hits", 1))
+        live_ids.add(ident(r))
+        prev = merged.get(ident(r))
+        if prev is None or r["hits"] > prev.get("hits", 1):
+            merged[ident(r)] = r
+    if decay:
+        for key, r in merged.items():
+            if key not in live_ids:
+                r["hits"] = max(1, r["hits"] // 2)
+    return sorted(merged.values(),
+                  key=lambda r: (-r.get("hits", 1), str(sorted(r.items()))))
+
+
 def store_census(index_name: str,
-                 keys: Optional[List[dict]] = None) -> Optional[bytes]:
-    """Persist ``index_name``'s observed key set (default: the live
-    registry's census). Returns the encoded blob, or None when the index
-    has no observed keys (nothing to pre-warm — don't overwrite a
-    previous census with emptiness on an idle restart)."""
+                 keys: Optional[List[dict]] = None,
+                 bodies: Optional[List[dict]] = None,
+                 merge: bool = True) -> Optional[bytes]:
+    """Persist ``index_name``'s observed key set + replayable bodies
+    (default: the live registry's census). Returns the encoded blob, or
+    None when there is nothing to persist (nothing to pre-warm — don't
+    overwrite a previous census with emptiness on an idle restart).
+    ``merge`` folds the previously persisted census in (see module
+    docstring); explicit-keys callers can pass ``merge=False`` for the
+    overwrite semantics tests rely on."""
     from elasticsearch_tpu.index import ivf_cache
     from elasticsearch_tpu.monitor import programs
 
     if keys is None:
         keys = programs.REGISTRY.census(index_name)
-    if not keys:
+    if bodies is None:
+        bodies = programs.REGISTRY.bodies(index_name)
+    if merge:
+        prev = load_census(index_name)
+        if prev is not None:
+            decay = index_name not in _DECAYED
+            _DECAYED.add(index_name)
+            keys = _merge_rows(prev.get("keys", []), keys, _key_id,
+                               decay=decay)
+            bodies = _merge_rows(prev.get("bodies", []), bodies,
+                                 lambda r: r.get("body"), decay=decay)
+    # bound the persisted union (hottest-first order from _merge_rows):
+    # without the cut, N restarts of a shifting workload grow the blob
+    # O(N·cap) while warmup only ever reads the top rows
+    keys = keys[:KEY_CAP]
+    bodies = bodies[:BODY_CAP]
+    if not keys and not bodies:
         return None
     payload = {
         "version": VERSION,
         "index": index_name,
         "backend": programs.backend_fingerprint(),
         "keys": keys,
+        "bodies": bodies,
     }
     # the generic tier's shared digest frame (ivf_cache.frame_blob) —
     # census and incident blobs stay format-identical by construction
@@ -70,7 +161,8 @@ def load_census(index_name: str) -> Optional[dict]:
     """The persisted census payload for ``index_name`` or None. A
     corrupt blob (digest mismatch, bad JSON, wrong shape) is deleted and
     treated as a miss — the observatory re-learns the keys from traffic
-    and the next store replaces it."""
+    and the next store replaces it. v1 payloads (PR 11) normalize to the
+    v2 shape (hits=1, no bodies)."""
     from elasticsearch_tpu.index import ivf_cache
 
     key = census_key(index_name)
@@ -79,21 +171,29 @@ def load_census(index_name: str) -> Optional[dict]:
         return None
     payload = ivf_cache.unframe_blob(blob)
     if (payload is None
-            or payload.get("version") != VERSION
+            or payload.get("version") not in (1, VERSION)
             or payload.get("index") != index_name
-            or not isinstance(payload.get("keys"), list)):
+            or not isinstance(payload.get("keys"), list)
+            or not isinstance(payload.get("bodies", []), list)):
         ivf_cache.delete_blob(key, _EXT)
         return None
+    if payload.get("version") == 1:
+        payload = dict(payload, version=VERSION, bodies=[],
+                       keys=[dict(k, hits=int(k.get("hits", 1)))
+                             for k in payload["keys"]])
+    else:
+        payload.setdefault("bodies", [])
     return payload
 
 
 def replay(index_name: str) -> dict:
     """Replay the persisted census against the LIVE program registry:
     which keys are already warm (present in the registry — their
-    programs exist in this process's jit caches) and which are missing
-    (would compile on first touch). ``missing`` is exactly the pre-warm
-    work list ROADMAP #6's compiled-program cache will consume; today it
-    is the restart-cliff report."""
+    programs exist in this process's jit caches or resolved through the
+    AOT executable cache) and which are missing (would compile on first
+    touch). ``missing`` is the warmup verification list; ``bodies`` is
+    the replayable work list serving/warmup.py consumes, hottest
+    first."""
     from elasticsearch_tpu.monitor import programs
 
     payload = load_census(index_name)
@@ -112,4 +212,5 @@ def replay(index_name: str) -> dict:
         "total": len(payload["keys"]),
         "warm": len(payload["keys"]) - len(missing),
         "missing": missing,
+        "bodies": payload.get("bodies", []),
     }

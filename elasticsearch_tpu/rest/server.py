@@ -268,6 +268,12 @@ def _register_all(rc: RestController):
     # monitor/watchdog.py): per-node black box, cluster-wide support
     # bundle, cat listing of captured incidents
     add("GET", "/_nodes/_local/flight", _node_flight)
+    # pre-warm pipeline (serving/warmup.py): manual census-replay
+    # trigger + status (the background runs appear as cancellable
+    # cluster:admin/warmup parent tasks in GET /_tasks)
+    add("POST", "/_warmup", _warmup_trigger)
+    add("GET", "/_warmup", _warmup_status)
+    add("POST", "/{index}/_warmup", _warmup_trigger_index)
     add("GET", "/_cat/incidents", _cat_incidents)
     add("GET", "/_cluster/diagnostics", _cluster_diagnostics)
     add("GET", "/_cluster/diagnostics/incidents/{incident_id}",
@@ -1702,6 +1708,11 @@ def _open_index(n: Node, p, b, index: str):
         open_index(n, nm)
         if c is not None and nm in c.dist_indices:
             c.data.set_closed(nm, False)
+    # a re-opened index serves cold — queue its census replay
+    # (serving/warmup.py; cooldown-guarded, no-op without a census)
+    wu = getattr(getattr(n, "serving", None), "warmup", None)
+    if wu is not None:
+        wu.kick("index_open", names)
     return 200, {"acknowledged": True}
 
 
@@ -2118,6 +2129,30 @@ def _node_flight(n: Node, p, b):
     }
 
 
+def _warmup_trigger(n: Node, p, b):
+    """POST /_warmup: queue a census replay for every open local index
+    (serving/warmup.py). Cooldown-guarded — steady-state re-triggers are
+    recorded no-ops; the run itself is a cancellable
+    ``cluster:admin/warmup`` task."""
+    queued = n.serving.warmup.kick("api")
+    return 200, {"acknowledged": True, "queued": queued}
+
+
+def _warmup_trigger_index(n: Node, p, b, index: str):
+    """POST /{index}/_warmup: queue a census replay for one index."""
+    names = n.resolve_indices(index)
+    if not names:
+        raise IndexNotFoundException(index)
+    queued = n.serving.warmup.kick("api", names)
+    return 200, {"acknowledged": True, "queued": queued}
+
+
+def _warmup_status(n: Node, p, b):
+    """GET /_warmup: the pre-warm service's queue + per-index last-run
+    results (also in the ``serving`` section of /_nodes/stats)."""
+    return 200, n.serving.warmup.stats()
+
+
 def _incident_rows(n: Node, p) -> List[dict]:
     """_cat/incidents rows: local incidents plus every member's (the
     _tasks fan) — dedup'd by id, since in-process members share the
@@ -2271,8 +2306,15 @@ def _cluster_diagnostics(n: Node, p, b):
 def _cat_programs(n: Node, p, b):
     """GET /_cat/programs: one row per (program, shapes, backend) key —
     compiles, compile_seconds, cached calls, execute p50/p99, cold flag
-    (never served a cached execute in this process)."""
+    (never served a cached execute in this process), and the AOT
+    cache-source ledger (``aot:2,fresh:1`` — parallel/aot.py; ``-`` for
+    keys the AOT layer never resolved, e.g. trace-level census rows)."""
     from elasticsearch_tpu.monitor import programs
+
+    def _cache(sources: dict) -> str:
+        short = {"aot_hit": "aot", "xla_dir_hit": "xla_dir"}
+        return ",".join(f"{short.get(k, k)}:{v}"
+                        for k, v in sorted(sources.items())) or "-"
 
     rows = [{
         "program": r["program"],
@@ -2284,11 +2326,12 @@ def _cat_programs(n: Node, p, b):
         "execute_p50_ms": f"{r['execute_p50_seconds'] * 1000.0:.2f}",
         "execute_p99_ms": f"{r['execute_p99_seconds'] * 1000.0:.2f}",
         "cold": "true" if r["cold"] else "false",
+        "cache": _cache(r["cache_sources"]),
     } for r in programs.REGISTRY.snapshot()]
     return 200, _cat_rows(rows, ["program", "shapes", "backend", "compiles",
                                  "compile_seconds", "calls",
                                  "execute_p50_ms", "execute_p99_ms",
-                                 "cold"])
+                                 "cold", "cache"])
 
 
 # -- document handlers --------------------------------------------------------
@@ -5297,9 +5340,19 @@ class RestServer:
         # a node serving HTTP is a production node: the stall watchdog
         # ticks for its lifetime (monitor/watchdog.py; ESTPU_WATCHDOG=0
         # opts out, library-embedded Nodes never start it)
-        wd = getattr(self.controller.node, "watchdog", None)
+        node = self.controller.node
+        wd = getattr(node, "watchdog", None)
         if wd is not None:
             wd.ensure_started()
+        # ... and pre-warms: replay each index's persisted census through
+        # the real search path BEFORE traffic lands (serving/warmup.py;
+        # ESTPU_WARMUP=0 opts out, indices without a census are no-ops)
+        wu = getattr(getattr(node, "serving", None), "warmup", None)
+        if wu is not None:
+            try:
+                wu.kick("boot")
+            except Exception:  # tpulint: allow[R006] — pre-warm must
+                pass           # never block a server from binding
         if background:
             self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
             self._thread.start()

@@ -1,6 +1,6 @@
 """Serving front-end: cross-request micro-batching + per-tenant QoS.
 
-One subsystem, two halves (see docs/SERVING.md):
+One subsystem, three halves (see docs/SERVING.md):
 
 - :mod:`coalescer` — the adaptive micro-batch queue between REST
   dispatch and the search executor: concurrent independent searches
@@ -9,6 +9,11 @@ One subsystem, two halves (see docs/SERVING.md):
 - :mod:`qos` — weighted per-tenant admission over the
   ``in_flight_requests`` breaker: a greedy tenant 429s against its own
   share while other tenants keep serving.
+- :mod:`warmup` — the census-driven pre-warm pipeline (ROADMAP #6):
+  on boot/index-open/recovery-graduation, replay the index's persisted
+  census bodies through the real search path on a cancellable
+  background task, hottest first, breaker-charged and cooldown-guarded,
+  so a restarted node's first page of requests pays zero compiles.
 
 Each :class:`~elasticsearch_tpu.node.Node` owns one
 :class:`ServingFrontend` (``node.serving``); REST dispatch admits
@@ -24,24 +29,33 @@ from typing import Dict
 
 from elasticsearch_tpu.serving.coalescer import QueryCoalescer
 from elasticsearch_tpu.serving.qos import TenantAdmission
+from elasticsearch_tpu.serving.warmup import WarmupService
 
-__all__ = ["QueryCoalescer", "TenantAdmission", "ServingFrontend"]
+__all__ = ["QueryCoalescer", "TenantAdmission", "WarmupService",
+           "ServingFrontend"]
 
 
 class ServingFrontend:
-    """Per-node serving layer: coalescer + QoS, one settings surface."""
+    """Per-node serving layer: coalescer + QoS + pre-warm, one settings
+    surface."""
 
     def __init__(self, node):
         self.coalescer = QueryCoalescer(node)
         self.qos = TenantAdmission(node.metrics)
+        self.warmup = WarmupService(node)
 
     def apply_cluster_settings(self, flat: Dict[str, object]) -> None:
         self.coalescer.apply_cluster_settings(flat)
         self.qos.apply_cluster_settings(flat)
+        self.warmup.apply_cluster_settings(flat)
 
     def stats(self) -> dict:
         return {"coalescer": self.coalescer.stats(),
-                "qos": self.qos.stats()}
+                "qos": self.qos.stats(),
+                "warmup": self.warmup.stats()}
 
     def close(self) -> None:
+        # warmup first: its worker drives searches through the coalescer
+        # path — stop producing before draining
+        self.warmup.close()
         self.coalescer.close()

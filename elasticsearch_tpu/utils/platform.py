@@ -6,10 +6,60 @@ block indefinitely if the tunnel is down. Deregistering the factory before
 first device use makes CPU-only runs (tests, local REST server, bench CPU
 baselines) reliable. No-op when the plugin is absent or another platform is
 requested.
+
+This module also owns :func:`host_fingerprint` — the host-machine identity
+digest that makes CPU-generated AOT artifacts (XLA's persistent compilation
+cache AND the executable blob cache, parallel/aot.py) safe to persist: an
+XLA:CPU executable encodes the exact host ISA features it was compiled for,
+so reloading it on a different machine risks SIGILL. Keying the cache
+location/blob key by the host fingerprint turns a cross-machine reload into
+a clean cache miss instead of a crash.
 """
 from __future__ import annotations
 
+import hashlib
 import os
+import threading
+
+_HOST_FP_LOCK = threading.Lock()
+_HOST_FP: str = ""
+
+
+def host_fingerprint() -> str:
+    """12-hex digest of this host machine's CPU identity. Sources, in
+    order of specificity: /proc/cpuinfo's model name + feature flags
+    (Linux — the flags line is exactly the ISA-feature set XLA:CPU AOT
+    results depend on), falling back to the platform module's
+    machine/processor/platform tuple. Deterministic per machine, cached
+    after first resolution, never raises."""
+    global _HOST_FP
+    if _HOST_FP:
+        return _HOST_FP
+    with _HOST_FP_LOCK:
+        if _HOST_FP:
+            return _HOST_FP
+        parts = []
+        try:
+            with open("/proc/cpuinfo") as fh:
+                seen = set()
+                for line in fh:
+                    key = line.split(":", 1)[0].strip()
+                    if key in ("model name", "flags", "Features") \
+                            and key not in seen:
+                        seen.add(key)
+                        parts.append(line.strip())
+                    if len(seen) == 2:
+                        break
+        except OSError:
+            pass
+        if not parts:
+            import platform as _platform
+
+            parts = [_platform.machine(), _platform.processor(),
+                     _platform.platform()]
+        _HOST_FP = hashlib.sha1(
+            "|".join(parts).encode("utf-8", "replace")).hexdigest()[:12]
+        return _HOST_FP
 
 
 def enable_compilation_cache() -> None:
@@ -17,19 +67,34 @@ def enable_compilation_cache() -> None:
     compilation cache"). First compile of each program shape costs tens of
     seconds on a tunneled chip; caching to disk makes node restarts and
     bench runs warm-start. Opt-out with ESTPU_XLA_CACHE=off; override the
-    directory by setting it to a path."""
+    directory by setting it to a path.
+
+    ``JAX_PLATFORMS=cpu`` runs use a per-host-machine subdirectory
+    (``host-<fingerprint>``): XLA:CPU AOT results encode exact host ISA
+    features, and reloading them on a different host risks SIGILL
+    (observed: prefer-no-scatter mismatch warnings). The fingerprint
+    subdir makes the cache host-private, so CPU runs (tier-1 restarts,
+    bench cold_start) exercise the persistent-cache path by default
+    instead of skipping it. Scope honesty: the decision comes from the
+    ENV, not ``jax.default_backend()`` — resolving the backend here
+    would initialize a possibly-tunneled client before the caller's
+    hang guards run (the exact failure ensure_cpu_if_requested exists
+    to prevent). An UNSET env keeps the shared root: in this repo every
+    intentional CPU run pins ``JAX_PLATFORMS=cpu`` (tier-1, bench
+    fallback, verify drives), and unset-env is the tunneled-TPU default
+    whose warm cache — and whose cross-host sharing of device-targeted,
+    non-host-ISA-bound executables — must not be orphaned into
+    host-private subdirs. An auto-selected-cpu process with an unset
+    env therefore shares the root like it always did; the AOT blob
+    cache (parallel/aot.py) independently keys by the RESOLVED backend
+    + host fingerprint, so its executables stay safe regardless."""
     path = os.environ.get("ESTPU_XLA_CACHE") or os.path.join(
         os.path.expanduser("~"), ".cache", "estpu_xla")
     if path.lower() in ("0", "off", "none"):
         return
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu" \
             and not os.environ.get("ESTPU_XLA_CACHE"):
-        # XLA:CPU AOT results encode exact host machine features; reloading
-        # them on a different host risks SIGILL (observed: prefer-no-scatter
-        # mismatch warnings). The cache's real win is the tunneled TPU's
-        # 20-40s compiles, so CPU runs skip it unless explicitly pointed at
-        # a directory.
-        return
+        path = os.path.join(path, f"host-{host_fingerprint()}")
     try:  # pragma: no cover - environment-specific
         import jax
 

@@ -169,9 +169,11 @@ class TestRegistry:
         reg.record_execute("out", "s", 0.001)
         with programs.index_scope("idx"):
             reg.record_execute("in", "s", 0.001, field="f")
+            reg.record_execute("in", "s", 0.001, field="f")
         assert reg.census_indices() == ["idx"]
+        # per-key hit counts (ISSUE 14): warmup orders hottest-first
         assert reg.census("idx") == [
-            {"program": "in", "shapes": "s", "field": "f"}]
+            {"program": "in", "shapes": "s", "field": "f", "hits": 2}]
 
 
 # -- census persistence --------------------------------------------------------
@@ -235,7 +237,8 @@ class TestCensusBlobs:
         rep = census.replay("rp_idx")
         assert rep["warm"] == 0
         assert rep["missing"] == [{"program": "mesh_dsl",
-                                   "shapes": "f32[4]", "field": ""}]
+                                   "shapes": "f32[4]", "field": "",
+                                   "hits": 1}]
 
 
 # -- surfaces ------------------------------------------------------------------
@@ -251,7 +254,7 @@ class TestSurfaces:
             assert status == 200 and rows
             cols = ["program", "shapes", "backend", "compiles",
                     "compile_seconds", "calls", "execute_p50_ms",
-                    "execute_p99_ms", "cold"]
+                    "execute_p99_ms", "cold", "cache"]
             assert rows.default == cols
             for r in rows:
                 assert set(cols) <= set(r)

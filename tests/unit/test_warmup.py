@@ -1,0 +1,552 @@
+"""Zero-warmup serving tests (ISSUE 14): AOT executable cache
+(parallel/aot.py) + census-driven pre-warm pipeline (serving/warmup.py).
+
+- AOT failure edges: a corrupt serialized-executable blob is a DETECTED
+  miss (deleted, counted) followed by a fresh compile with bit-identical
+  results; a fingerprint-stale blob likewise; a store failure never
+  costs the call its program.
+- Warmup discipline: breaker-denied replay defers without failing a
+  foreground search; a cancelled warmup task stops at a body boundary
+  and leaves the task registry + program registry consistent; completed
+  runs are cooldown-guarded; replays label warmup=prewarm and never
+  inflate their own census.
+- Census v2: per-key hit counts, replayable bodies, merge-on-store
+  durability (the watchdog-tick flush path).
+- Restart acceptance: a fresh process over the same data_path pre-warms
+  from the persisted census and serves the first page of censused
+  traffic with ZERO fresh compiles (estpu_program_compiles_total flat,
+  warmup=true count 0).
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+
+import numpy as np
+import pytest
+
+from elasticsearch_tpu.index import ivf_cache
+from elasticsearch_tpu.monitor import compile_cache, programs
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.parallel import aot
+from elasticsearch_tpu.resources import census
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    programs.REGISTRY.reset()
+    compile_cache.reset()
+    aot.reset_enabled_for_tests()
+    census._DECAYED.clear()
+    yield
+    programs.REGISTRY.reset()
+    compile_cache.reset()
+    aot.reset_enabled_for_tests()
+    census._DECAYED.clear()
+
+
+def _register_dir():
+    d = tempfile.mkdtemp()
+    ivf_cache.register(d)
+    return d
+
+
+def _make_node(data_path=None, index="wuidx", docs=16, name="wu"):
+    n = Node(name=name, data_path=data_path)
+    if index not in n.indices:
+        n.create_index(index, {
+            "mappings": {"properties": {"t": {"type": "text"}}}})
+        svc = n.indices[index]
+        for i in range(docs):
+            svc.index_doc(str(i), {"t": f"alpha beta gamma delta word{i}"})
+        svc.refresh()
+    return n
+
+
+# -- AOT executable cache ------------------------------------------------------
+
+class TestAotCache:
+    def _program(self, key=("p", 1)):
+        import jax
+
+        fn = jax.jit(lambda x, y: (x * 2.0 + y, x.sum()))
+        return aot.wrap(fn, "unit_prog", key)
+
+    def _args(self):
+        return (np.arange(8, dtype=np.float32),
+                np.ones(8, dtype=np.float32))
+
+    def test_fresh_then_blob_hit_bit_identical(self):
+        _register_dir()
+        p1 = self._program()
+        assert isinstance(p1, aot.AotProgram)
+        out1 = p1(*self._args())
+        ev = compile_cache.events_snapshot()
+        assert ev["fresh"] + ev["xla_dir_hit"] == 1
+        assert ev["store"] == 1
+        # a NEW wrapper (fresh memo — the restart simulation) resolves
+        # the same key from the blob: aot_hit, no compile, same bits
+        p2 = self._program()
+        out2 = p2(*self._args())
+        ev = compile_cache.events_snapshot()
+        assert ev["aot_hit"] == 1
+        for a, b in zip(out1, out2):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_corrupt_blob_detected_deleted_fresh_compile(self):
+        d = _register_dir()
+        p1 = self._program()
+        out1 = p1(*self._args())
+        (path,) = [os.path.join(d, f) for f in os.listdir(d)
+                   if f.endswith(".aotx")]
+        with open(path, "wb") as fh:
+            fh.write(b"deadbeef\nnot a pickle")
+        # drop the memory tier so the corrupted DISK copy is what loads
+        ivf_cache.reset()
+        ivf_cache.register(d)
+        p2 = self._program()
+        out2 = p2(*self._args())
+        ev = compile_cache.events_snapshot()
+        assert ev["corrupt_miss"] == 1
+        assert ev["fresh"] + ev["xla_dir_hit"] == 2  # recompiled
+        assert not os.path.exists(path) or \
+            open(path, "rb").read() != b"deadbeef\nnot a pickle"
+        for a, b in zip(out1, out2):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_stale_fingerprint_blob_detected_deleted(self):
+        d = _register_dir()
+        p1 = self._program()
+        p1(*self._args())  # learn the real key by listing the dir
+        (fname,) = [f for f in os.listdir(d) if f.endswith(".aotx")]
+        key = fname[: -len(".aotx")]
+        # a structurally-valid blob claiming another backend/jax build
+        # at the SAME key (hand-moved file / collision defense): the
+        # fingerprint check inside the payload must catch it
+        stale = aot._frame({
+            "version": aot.VERSION, "program": "unit_prog", "sig": "x",
+            "backend": "tpu/v99", "jax": "0.0.0", "host": "nope",
+            "exe": b"", "in_tree": None, "out_tree": None})
+        ivf_cache.reset()
+        ivf_cache.register(d)
+        ivf_cache.store_blob(key, stale, "aotx")
+        p2 = self._program()
+        out2 = p2(*self._args())
+        ev = compile_cache.events_snapshot()
+        assert ev["mismatch_miss"] == 1
+        assert np.asarray(out2[0]).shape == (8,)
+        # the stale blob was deleted and replaced by the fresh store
+        reloaded = ivf_cache.load_blob(key, "aotx")
+        assert reloaded is None or reloaded != stale
+
+    def test_dir_hit_compile_never_stored(self, monkeypatch):
+        """An executable rebuilt from the XLA persistent-cache dir lacks
+        the object code serialize_executable needs — its blob fails
+        deserialize with 'Symbols not found' in the next process, and
+        storing it would poison every restart (deserialize_error →
+        delete → re-store the same poison). Dir-served compiles must
+        skip the store."""
+        _register_dir()
+        counter = {"n": 0}
+
+        def fake_hits():
+            counter["n"] += 1  # moves across the compile → "dir hit"
+            return counter["n"]
+
+        monkeypatch.setattr(aot, "_xla_hits", fake_hits)
+        p = self._program(key=("dh", 4))
+        p(*self._args())
+        ev = compile_cache.events_snapshot()
+        assert ev["xla_dir_hit"] == 1
+        assert ev["store"] == 0
+        assert ev["store_skipped"] == 1
+        # nothing persisted: a fresh wrapper recompiles, never a
+        # poisoned aot_hit
+        p2 = self._program(key=("dh", 4))
+        p2(*self._args())
+        assert compile_cache.events_snapshot()["aot_hit"] == 0
+
+    def test_disabled_env_returns_plain_fn(self, monkeypatch):
+        monkeypatch.setenv("ESTPU_AOT_CACHE", "off")
+        aot.reset_enabled_for_tests()
+        import jax
+
+        fn = jax.jit(lambda x: x + 1)
+        assert aot.wrap(fn, "p", ("k",)) is fn
+        assert compile_cache.enabled_state() is False
+
+    def test_cache_source_lands_on_timed_observatory_key(self):
+        _register_dir()
+        p = self._program(key=("obs", 2))
+        with programs.REGISTRY.timed("mesh_unit", "Q=1|k=8"):
+            p(*self._args())
+        (row,) = [r for r in programs.REGISTRY.snapshot()
+                  if r["program"] == "mesh_unit"]
+        src = row["cache_sources"]
+        assert src.get("fresh", 0) + src.get("xla_dir_hit", 0) == 1
+
+    def test_cache_source_does_not_pollute_census(self):
+        _register_dir()
+        p = self._program(key=("cen", 3))
+        with programs.index_scope("ccidx"):
+            with programs.REGISTRY.timed("mesh_cc", "Q=1|k=8",
+                                         field="body"):
+                p(*self._args())
+        rows = [r for r in programs.REGISTRY.census("ccidx")
+                if r["program"] == "mesh_cc"]
+        # exactly the dispatch record's key — the AOT source accounting
+        # must not plant a second field-less phantom row in the census
+        assert [r["field"] for r in rows] == ["body"]
+
+
+# -- census v2 -----------------------------------------------------------------
+
+class TestCensusV2:
+    def test_bodies_recorded_and_hottest_first(self):
+        n = _make_node(index="cb_idx")
+        try:
+            hot = {"query": {"match": {"t": "alpha"}}, "size": 5}
+            cold = {"query": {"match": {"t": "beta gamma"}}, "size": 3}
+            for _ in range(3):
+                n.search("cb_idx", hot)
+            n.search("cb_idx", cold)
+            bodies = programs.REGISTRY.bodies("cb_idx")
+            assert len(bodies) == 2
+            assert bodies[0]["hits"] == 3  # hottest first
+            assert json.loads(bodies[0]["body"]) == hot
+            ks = programs.REGISTRY.census("cb_idx")
+            assert all(k["hits"] >= 1 for k in ks)
+        finally:
+            n.close()
+
+    def test_profile_and_unserializable_bodies_excluded(self):
+        n = _make_node(index="pb_idx")
+        try:
+            n.search("pb_idx", {"query": {"match": {"t": "alpha"}},
+                                "profile": True})
+            assert programs.REGISTRY.bodies("pb_idx") == []
+        finally:
+            n.close()
+
+    def test_store_merges_with_persisted(self):
+        _register_dir()
+        census.store_census(
+            "mg_idx",
+            keys=[{"program": "a", "shapes": "s", "field": "", "hits": 5}],
+            bodies=[{"body": "{\"q\":1}", "hits": 7}])
+        # a later flush from a process that saw less traffic must not
+        # regress the persisted hit counts, and new keys must join
+        census.store_census(
+            "mg_idx",
+            keys=[{"program": "a", "shapes": "s", "field": "", "hits": 2},
+                  {"program": "b", "shapes": "s2", "field": "", "hits": 1}],
+            bodies=[{"body": "{\"q\":1}", "hits": 1}])
+        payload = census.load_census("mg_idx")
+        by_prog = {k["program"]: k for k in payload["keys"]}
+        assert by_prog["a"]["hits"] == 5  # max, never double-counted
+        assert by_prog["b"]["hits"] == 1
+        assert payload["bodies"] == [{"body": "{\"q\":1}", "hits": 7}]
+
+    def test_restore_reaches_disk_not_just_memory(self):
+        d = _register_dir()
+        census.store_census(
+            "dk_idx", keys=[{"program": "a", "shapes": "s", "field": "",
+                             "hits": 1}], bodies=[])
+        census.store_census(
+            "dk_idx", keys=[{"program": "b", "shapes": "s2", "field": "",
+                             "hits": 1}], bodies=[])
+        # drop the in-process memory tier: the DISK copy must carry the
+        # second store (a skip-if-exists disk write would freeze the
+        # blob at its first flush — the exact kill -9 durability hole)
+        ivf_cache.reset()
+        ivf_cache.register(d)
+        payload = census.load_census("dk_idx")
+        assert {k["program"] for k in payload["keys"]} == {"a", "b"}
+
+    def test_body_cap_evicts_cold_for_shifted_workload(self):
+        reg = programs.ProgramRegistry()
+        for i in range(programs.ProgramRegistry._BODY_CAP):
+            reg.record_body("ev_idx", f"early_{i}")
+        # the workload shifts: a new hot body keeps arriving — it must
+        # displace a cold early entry (first-come-forever would freeze
+        # the replay set at boot-time traffic)
+        for _ in range(3):
+            reg.record_body("ev_idx", "late_hot")
+        bodies = reg.bodies("ev_idx")
+        assert any(b["body"] == "late_hot" for b in bodies)
+        assert len(bodies) == programs.ProgramRegistry._BODY_CAP
+
+    def test_unreinforced_rows_decay_across_restarts(self):
+        _register_dir()
+        census.store_census(
+            "dc_idx", keys=[], merge=True,
+            bodies=[{"body": "{\"old\":1}", "hits": 32}])
+        # "restart": the first merge of a new process halves persisted
+        # rows live traffic did not reinforce — a dead workload must
+        # fall out of the capped hottest-first set within a few
+        # generations instead of pinning it forever
+        for gen in range(4):
+            census._DECAYED.clear()  # simulate a fresh process
+            census.store_census(
+                "dc_idx", keys=[],
+                bodies=[{"body": "{\"new\":1}", "hits": 2}])
+        payload = census.load_census("dc_idx")
+        by = {b["body"]: b["hits"] for b in payload["bodies"]}
+        assert by["{\"old\":1}"] <= 2  # 32 → halved per restart
+        assert by["{\"new\":1}"] == 2  # reinforced rows never decay
+
+    def test_merge_bounded_by_blob_caps(self):
+        _register_dir()
+        # repeated shifting-workload flushes: the persisted union must
+        # stay capped (hottest survive), never grow O(generations)
+        for gen in range(3):
+            census.store_census(
+                "cap_idx",
+                keys=[{"program": f"p{gen}_{i}", "shapes": "s",
+                       "field": "", "hits": gen + 1} for i in range(40)],
+                bodies=[{"body": json.dumps({"g": gen, "i": i}),
+                         "hits": gen + 1} for i in range(40)])
+        payload = census.load_census("cap_idx")
+        assert len(payload["bodies"]) == census.BODY_CAP
+        # hottest-first: the newest (highest-hits) generation survives
+        assert all(json.loads(b["body"])["g"] == 2
+                   for b in payload["bodies"][:40])
+
+    def test_watchdog_tick_flushes_census(self, tmp_path):
+        n = _make_node(data_path=str(tmp_path / "d"), index="wf_idx")
+        try:
+            n.search("wf_idx", {"query": {"match": {"t": "alpha"}}})
+            assert census.load_census("wf_idx") is None  # not yet flushed
+            n.watchdog.config["census_flush_every_s"] = 0.0
+            n.watchdog.run_once()
+            payload = census.load_census("wf_idx")
+            assert payload is not None and payload["bodies"]
+            # unchanged census: the next tick skips the write (generation
+            # cursor) — store a sentinel and prove it survives the tick
+            gen = programs.REGISTRY.census_generation()
+            n.watchdog.run_once()
+            assert programs.REGISTRY.census_generation() == gen
+        finally:
+            n.close()
+
+
+# -- pre-warm service ----------------------------------------------------------
+
+class TestWarmupService:
+    def _censused_node(self, tmp_path, index="pw_idx", searches=3):
+        n = _make_node(data_path=str(tmp_path / "d"), index=index)
+        for i in range(searches):
+            n.search(index, {"query": {"match": {"t": "alpha beta"}},
+                             "size": 4 + i})
+        census.store_census(index)
+        return n
+
+    def test_run_index_replays_and_labels_prewarm(self, tmp_path):
+        n = self._censused_node(tmp_path)
+        try:
+            res = n.serving.warmup.run_index("pw_idx", "test")
+            assert res["status"] == "complete"
+            assert res["replayed"] == 3
+            assert res["errors"] == 0
+            rows = n.metrics.summaries()["estpu_search_duration_seconds"]
+            by_warm = {r["labels"]["warmup"]: r["count"] for r in rows
+                       if r["labels"]["index"] == "pw_idx"}
+            assert by_warm.get("prewarm", 0) == 3
+            # replays never inflate their own work list
+            assert all(b["hits"] == 1
+                       for b in programs.REGISTRY.bodies("pw_idx"))
+            # cooldown: an immediate re-kick is a recorded no-op, and a
+            # DIRECT run (a kick that sat queued past another trigger's
+            # completed run) is re-checked at run time too — both skips
+            # annotate the completed record instead of destroying its
+            # diagnostics
+            assert n.serving.warmup.kick("again", ["pw_idx"]) == []
+            res2 = n.serving.warmup.run_index("pw_idx", "queued_kick")
+            assert res2["status"] == "cooldown"
+            assert res2["replayed"] == 0
+            rec = n.serving.warmup.runs["pw_idx"]
+            assert rec["status"] == "complete"  # diagnostics preserved
+            assert rec["replayed"] == 3
+            assert rec["cooldown_skips"] == 2
+        finally:
+            n.close()
+
+    def test_breaker_denied_warmup_defers_not_foreground(self, tmp_path):
+        from elasticsearch_tpu import resources
+
+        n = self._censused_node(tmp_path, index="bd_idx")
+        br = resources.BREAKERS.breaker("request")
+        old_limit = br.limit
+        try:
+            br.limit = 0  # every reserve() denied
+            n.serving.warmup.config["defer_wait_s"] = 0.001
+            res = n.serving.warmup.run_index("bd_idx", "test")
+            assert res["status"] == "deferred"
+            assert res["replayed"] == 0
+            assert res["deferrals"] >= 1
+            # foreground search unaffected by the deferral
+            r = n.search("bd_idx", {"query": {"match": {"t": "alpha"}}})
+            assert r["hits"]["total"] > 0
+            # deferred ≠ complete: no cooldown, a later kick retries
+            br.limit = old_limit
+            res2 = n.serving.warmup.run_index("bd_idx", "retry")
+            assert res2["status"] == "complete"
+        finally:
+            br.limit = old_limit
+            n.close()
+
+    def test_cancelled_warmup_leaves_registry_consistent(self, tmp_path):
+        n = self._censused_node(tmp_path, index="cx_idx", searches=4)
+        try:
+            svc = n.indices["cx_idx"]
+            started, release = threading.Event(), threading.Event()
+            real_search = svc.search
+
+            def slow_search(body, **kw):
+                started.set()
+                release.wait(timeout=10.0)
+                return real_search(body, **kw)
+
+            svc.search = slow_search
+            out = {}
+
+            def run():
+                out["res"] = n.serving.warmup.run_index("cx_idx", "test")
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            assert started.wait(timeout=10.0)
+            (task,) = [t for t in n.tasks.list_tasks()
+                       if t.action == "cluster:admin/warmup"]
+            n.tasks.cancel(task.id, reason="test cancel")
+            release.set()
+            th.join(timeout=10.0)
+            svc.search = real_search
+            assert out["res"]["status"] == "canceled"
+            assert out["res"]["replayed"] <= 2
+            # registry consistent: the parent task is gone, no dispatch
+            # left in flight, and foreground searches still serve
+            assert not [t for t in n.tasks.list_tasks()
+                        if t.action == "cluster:admin/warmup"]
+            assert programs.REGISTRY.inflight_snapshot() == []
+            r = n.search("cx_idx", {"query": {"match": {"t": "alpha"}}})
+            assert r["hits"]["total"] > 0
+        finally:
+            n.close()
+
+    def test_backend_mismatch_refused(self, tmp_path):
+        n = self._censused_node(tmp_path, index="bm_idx")
+        try:
+            payload = census.load_census("bm_idx")
+            payload["backend"] = "tpu/v99"
+            ivf_cache.store_blob(census.census_key("bm_idx"),
+                                 ivf_cache.frame_blob(payload), "census")
+            res = n.serving.warmup.run_index("bm_idx", "test")
+            assert res["status"] == "backend_mismatch"
+            assert res["replayed"] == 0
+        finally:
+            n.close()
+
+    def test_kick_and_rest_surface(self, tmp_path):
+        from elasticsearch_tpu.rest.server import RestController
+
+        n = self._censused_node(tmp_path, index="rk_idx")
+        try:
+            rc = RestController(n)
+            status, out = rc.dispatch("POST", "/rk_idx/_warmup", {}, b"")
+            assert status == 200 and out["queued"] == ["rk_idx"]
+            assert n.serving.warmup.wait_idle(timeout=30.0)
+            status, out = rc.dispatch("GET", "/_warmup", {}, b"")
+            assert status == 200
+            assert out["runs"]["rk_idx"]["status"] == "complete"
+            # serving stats section carries the same view
+            st = n.nodes_stats()["nodes"][n.node_id]["serving"]["warmup"]
+            assert st["runs"]["rk_idx"]["status"] == "complete"
+        finally:
+            n.close()
+
+    def test_disabled_env_kick_is_noop(self, tmp_path, monkeypatch):
+        n = self._censused_node(tmp_path, index="dk_idx")
+        try:
+            monkeypatch.setenv("ESTPU_WARMUP", "0")
+            assert n.serving.warmup.kick("boot") == []
+        finally:
+            n.close()
+
+
+# -- restart acceptance --------------------------------------------------------
+
+class TestRestartAcceptance:
+    def test_restart_prewarm_zero_fresh_compiles_first_page(
+            self, tmp_path):
+        """ISSUE 14 acceptance: a node with a persisted census restarts
+        (REAL fresh process), pre-warm completes, and the first page of
+        requests over censused keys records zero fresh compiles and zero
+        warmup=true searches."""
+        from elasticsearch_tpu.tracing import retrace
+
+        if retrace.auditor() is None:
+            pytest.skip("trace auditor unavailable")
+        data = str(tmp_path / "data")
+        bodies = [{"query": {"match": {"t": t}}, "size": s}
+                  for t in ("alpha", "alpha beta", "beta gamma delta")
+                  for s in (5, 10)]
+        # phase A (this process): serve, persist census + AOT blobs
+        n = _make_node(data_path=data, index="accidx", docs=24)
+        for b in bodies:
+            assert n.search("accidx", b)["hits"]["total"] > 0
+        n.close()  # persists the census (keys + bodies, merged)
+        assert census.load_census("accidx") is not None
+        # phase B (fresh process): boot, pre-warm, serve the first page
+        script = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.monitor import compile_cache, programs
+from elasticsearch_tpu.tracing import retrace
+bodies = json.loads(sys.argv[2])
+n = Node(name="restart", data_path=sys.argv[1])
+res = n.serving.warmup.run_index("accidx", "boot")
+stats0 = programs.REGISTRY.stats()
+t0 = retrace.auditor().total() if retrace.auditor() else -1
+hits = [n.search("accidx", b)["hits"]["total"] for b in bodies]
+stats1 = programs.REGISTRY.stats()
+t1 = retrace.auditor().total() if retrace.auditor() else -1
+rows = n.metrics.summaries().get("estpu_search_duration_seconds", [])
+warm = {}
+for r in rows:
+    if r["labels"]["index"] == "accidx":
+        warm[r["labels"]["warmup"]] = r["count"]
+print("RESULT " + json.dumps({
+    "warmup_run": res, "hits": hits,
+    "compiles_during_page": stats1["compiles"] - stats0["compiles"],
+    "traces_during_page": (t1 - t0) if t0 >= 0 else None,
+    "warm_counts": warm,
+    "compile_cache": compile_cache.events_snapshot()}))
+n.close()
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("ESTPU_WARMUP", None)
+        env.pop("ESTPU_AOT_CACHE", None)
+        p = subprocess.run(
+            [sys.executable, "-c", script, data, json.dumps(bodies)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert p.returncode == 0, p.stderr[-2000:]
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("RESULT ")][-1]
+        out = json.loads(line[len("RESULT "):])
+        assert out["warmup_run"]["status"] == "complete"
+        assert out["warmup_run"]["replayed"] == len(bodies)
+        assert all(h > 0 for h in out["hits"])
+        # THE acceptance numbers: zero fresh compiles on the first page,
+        # zero warmup=cold searches — the restart cliff is gone
+        assert out["compiles_during_page"] == 0
+        assert out["traces_during_page"] == 0
+        assert out["warm_counts"].get("true", 0) == 0
+        assert out["warm_counts"].get("false", 0) == len(bodies)
+        assert out["warm_counts"].get("prewarm", 0) >= 1
+        # and the programs came from the AOT tier, not XLA
+        assert out["compile_cache"]["aot_hit"] >= 1
+        assert out["compile_cache"]["fresh"] == 0
