@@ -808,7 +808,12 @@ class MultiHostCluster:
             state.term = term
             state.master_node_id = self.local.node_id
             state.next_version()
-        self._meta_term = term
+        # under _indices_lock like every other _meta_term write: this
+        # stamp races the _on_meta/_on_publish transport handlers, and a
+        # torn read there would advertise a stale meta term for a fresh
+        # snapshot (found by tpulint R015)
+        with self._indices_lock:
+            self._meta_term = term
         self._clear_headless()
         logger.warning("[%s] elected master for term %d",
                        self.local.node_id, term)
@@ -978,9 +983,16 @@ class MultiHostCluster:
         # commit (a follower missing its commit lags one round and
         # catches up on the next full-state publish)
         self._record_committed(term, version)
-        self._committed_meta = max(self._committed_meta,
-                                   (term, indices_version))
-        self._committed_snapshot = indices  # the deep copy just shipped
+        # the (key, content) pair must move together: _on_meta serves
+        # `self._committed_snapshot` AS OF `self._committed_meta` under
+        # _indices_lock — an unlocked two-field update here let a reader
+        # between the two assignments pair the NEW key with the OLD
+        # snapshot and hand an elected master stale metadata under a
+        # fresh freshness key (found by tpulint R015)
+        with self._indices_lock:
+            self._committed_meta = max(self._committed_meta,
+                                       (term, indices_version))
+            self._committed_snapshot = indices  # the deep copy just shipped
         self._flight("cluster", event="publish_commit", term=term,
                      version=version, acks=1 + len(acked))
         try:

@@ -1777,12 +1777,15 @@ class DistributedDataService:
         except Exception:
             svc.recoveries.finish(rec, ok=False)
             raise
-        # shard assignment graduated on this node: persist the census
-        # (ISSUE 14 durability — the work list must survive a crash
-        # between here and the next clean close) and queue the pre-warm
-        # replay so the copy serves its first searches compile-free
-        # (serving/warmup.py; both best-effort, cooldown-guarded)
+        # shard assignment graduated on this node: adopt the census that
+        # rode the relocation stream (ISSUE 15 — on a node that shares
+        # no blob tier with the source, this is the ONLY way the
+        # pre-warm work list arrives before traffic does), persist the
+        # census (ISSUE 14 durability), and queue the pre-warm replay so
+        # the copy serves its first searches compile-free
+        # (serving/warmup.py; all best-effort, cooldown-guarded)
         try:
+            self._adopt_census_debounced(index, res.get("census"))
             self._flush_census_debounced(index)
             wu = getattr(getattr(self.node, "serving", None),
                          "warmup", None)
@@ -1793,21 +1796,66 @@ class DistributedDataService:
         return {"copied": copied, "skipped": skipped,
                 "ops_replayed": replayed, "mode": rec["mode"]}
 
+    #: per-index debounce window for the recovery-path census work —
+    #: recovery actions fire once per SHARD, the census is per INDEX
+    _CENSUS_DEBOUNCE_S = 5.0
+
+    def _census_window(self, name: str, index: str):
+        """(hit, stamp) for one named per-index debounce window: ``hit``
+        is True when the window is still open (skip the work), and
+        ``stamp()`` opens it. Lazy dicts so pickled/old instances keep
+        working."""
+        ts = getattr(self, name, None)
+        if ts is None:
+            ts = {}
+            setattr(self, name, ts)
+        now = time.monotonic()
+        hit = now - ts.get(index, float("-inf")) < self._CENSUS_DEBOUNCE_S
+        return hit, (lambda: ts.__setitem__(index, now))
+
     def _flush_census_debounced(self, index: str) -> None:
-        """Recovery-path census flush, debounced per index: recovery
-        actions fire once per SHARD, the census is per INDEX — a P-shard
+        """Recovery-path census flush, debounced per index: a P-shard
         relocation would otherwise pay P back-to-back load+merge+rewrite
         cycles inline in the transport path for one work list."""
-        ts = getattr(self, "_census_flush_ts", None)
-        if ts is None:
-            ts = self._census_flush_ts = {}
-        now = time.monotonic()
-        if now - ts.get(index, float("-inf")) < 5.0:
+        hit, stamp = self._census_window("_census_flush_ts", index)
+        if hit:
             return
-        ts[index] = now
+        stamp()
         from elasticsearch_tpu.resources import census
 
         census.store_census(index)
+
+    def _export_census_debounced(self, index: str):
+        """Source-side census payload for a shard_sync reply, cached per
+        index for the debounce window — the P shard handshakes of one
+        relocation ship ONE computed payload, not P load+merge+serialize
+        cycles (the _flush_census_debounced rationale, export side)."""
+        cache = getattr(self, "_census_export_cache", None)
+        if cache is None:
+            cache = self._census_export_cache = {}
+        hit, stamp = self._census_window("_census_export_ts", index)
+        if hit and index in cache:
+            return cache[index]
+        from elasticsearch_tpu.resources import census
+
+        payload = census.export_census(index)
+        cache[index] = payload
+        stamp()
+        return payload
+
+    def _adopt_census_debounced(self, index: str, payload) -> None:
+        """Target-side adoption, debounced per index: every one of a
+        P-shard relocation's _on_recover calls carries the same payload
+        — adopt (load+merge+store) once per window, not P times."""
+        if payload is None:
+            return
+        hit, stamp = self._census_window("_census_adopt_ts", index)
+        if hit:
+            return
+        from elasticsearch_tpu.resources import census
+
+        if census.adopt_census(index, payload):
+            stamp()
 
     def _on_shard_sync(self, payload: dict) -> dict:
         """Recovery source: checkpoint comparison first — when the
@@ -1824,7 +1872,18 @@ class DistributedDataService:
         engine = svc.shards[payload["shard"]].engine
         svc.recoveries.source_started()
         try:
-            return self._shard_sync_response(engine, payload)
+            resp = self._shard_sync_response(engine, payload)
+            # the census RIDES the relocation stream beside the doc/op
+            # payload (ISSUE 15 / PR 14's stated residual): the target
+            # node may share no blob directory with this one, so the
+            # pre-warm work list must travel in-band or the relocated
+            # shard re-learns from scratch
+            try:
+                resp["census"] = self._export_census_debounced(
+                    payload["index"])
+            except Exception:  # tpulint: allow[R006] — warmup plumbing
+                pass           # must never fail a recovery handshake
+            return resp
         finally:
             svc.recoveries.source_finished()
             # the source has served this index — flush ITS census now so
@@ -1881,6 +1940,8 @@ class DistributedDataService:
         """Run the query phase on the requested LOCAL shards; park the
         candidate docs under a context id for the fetch phase (reference:
         SearchService.executeQueryPhase → QuerySearchResult with id)."""
+        from elasticsearch_tpu.monitor import programs
+
         index, body = payload["index"], payload.get("body") or {}
         shard_ids = payload["shards"]
         svc = self.node.indices.get(index)
@@ -1890,10 +1951,20 @@ class DistributedDataService:
         pairs: List[Tuple[Any, Any]] = []
         shards_out = []
         agg_lists: List[dict] = []
+        # census scope on the OWNER (ISSUE 15): the device programs this
+        # shard's query phase compiles belong to THIS node's per-index
+        # census — it is the node a relocation would stream away from.
+        # The replayable body records here too: each node ships a work
+        # list of the traffic it actually served.
+        try:
+            svc._record_census_body(body)
+        except Exception:  # tpulint: allow[R006] — census recording
+            pass           # must never fail the query phase
         for sid in shard_ids:
             searcher = svc.groups[sid].reader().searcher
             with self.node.tracer.span("shard.query_phase", index=index,
-                                       shard=sid):
+                                       shard=sid), \
+                    programs.index_scope(index):
                 r = searcher.query_phase(body)
             docs_out = []
             for d in r.docs:
@@ -1937,10 +2008,13 @@ class DistributedDataService:
                 SearchContextMissingException
 
             raise SearchContextMissingException(payload["context_id"])
+        from elasticsearch_tpu.monitor import programs
+
         positions: List[int] = payload["positions"]
-        hit_of = _fetch_grouped(
-            [(p,) + ctx["pairs"][p] for p in positions],
-            ctx["body"], ctx["index"])
+        with programs.index_scope(ctx["index"]):
+            hit_of = _fetch_grouped(
+                [(p,) + ctx["pairs"][p] for p in positions],
+                ctx["body"], ctx["index"])
         return [hit_of[p] for p in positions]
 
     def _on_free(self, payload: dict) -> dict:
@@ -1974,10 +2048,25 @@ class DistributedDataService:
         the wire header carries both, so every remote owner's
         transport.handle/shard.query_phase spans share this trace id and
         its shard tasks parent to this one."""
+        from elasticsearch_tpu.monitor import programs
+        from elasticsearch_tpu.serving import warmup as warmup_mod
+
+        # census scope at the COORDINATOR (ISSUE 15): the dist plane
+        # calls searcher.query_phase directly, so without this scope a
+        # cluster member's device programs never attributed to the index
+        # and its pre-warm work list stayed empty — relocation had
+        # nothing to ship. Pre-warm replays stay out of scope, the
+        # IndexService.search rule.
+        prewarm = warmup_mod.in_prewarm()
+        try:
+            scope = None if prewarm else self.resolve_index(index)
+        except Exception:
+            scope = None
         with self.node.tasks.task("indices:data/read/search",
                                   description=f"indices[{index}]"):
             with self.node.tracer.span("search.coordinate", index=index):
-                resp = self._search_inner(index, body)
+                with programs.index_scope(scope):
+                    resp = self._search_inner(index, body)
         # slow log at the COORDINATOR: the owner-side query phases call
         # searcher.query_phase directly, so without this hook a
         # distributed index's thresholds would silently never fire
@@ -1985,6 +2074,11 @@ class DistributedDataService:
         svc = self.node.indices.get(self.resolve_index(index))
         if svc is not None:
             svc.slowlog.on_search(resp.get("took", 0), body, resp)
+            if not prewarm:
+                try:
+                    svc._record_census_body(body or {})
+                except Exception:  # tpulint: allow[R006] — census
+                    pass           # recording never fails a search
         return resp
 
     def _search_inner(self, index: str, body: Optional[dict]) -> dict:

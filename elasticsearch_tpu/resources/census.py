@@ -157,6 +157,77 @@ def store_census(index_name: str,
     return blob
 
 
+def export_census(index_name: str) -> Optional[dict]:
+    """The census payload for SHIPPING (shard-relocation streams, PR 14's
+    stated residual): the persisted census merged with the live
+    registry's, capped like a stored blob — but with no store, no decay
+    bookkeeping, and no digest frame (the transport layer owns transfer
+    integrity). None when there is nothing worth shipping."""
+    from elasticsearch_tpu.monitor import programs
+
+    keys = programs.REGISTRY.census(index_name)
+    bodies = programs.REGISTRY.bodies(index_name)
+    prev = load_census(index_name)
+    if prev is not None:
+        keys = _merge_rows(prev.get("keys", []), keys, _key_id)
+        bodies = _merge_rows(prev.get("bodies", []), bodies,
+                             lambda r: r.get("body"))
+    keys = keys[:KEY_CAP]
+    bodies = bodies[:BODY_CAP]
+    if not keys and not bodies:
+        return None
+    return {
+        "version": VERSION,
+        "index": index_name,
+        "backend": programs.backend_fingerprint(),
+        "keys": keys,
+        "bodies": bodies,
+    }
+
+
+def adopt_census(index_name: str, payload) -> bool:
+    """Adopt a census shipped beside a shard-relocation stream: validate
+    the payload shape, refuse a foreign backend fingerprint (the same
+    honesty rule warmup applies at replay time — a census captured on
+    another chip generation must not be persisted as this node's), and
+    MERGE it into the locally persisted census so the relocation target
+    can pre-warm before its first request. Returns True when adopted."""
+    from elasticsearch_tpu.monitor import programs
+
+    if not isinstance(payload, dict) \
+            or payload.get("index") != index_name \
+            or payload.get("version") not in (1, VERSION):
+        return False
+    keys = payload.get("keys")
+    bodies = payload.get("bodies", [])
+    if not isinstance(keys, list) or not isinstance(bodies, list):
+        return False
+    if payload.get("backend") != programs.backend_fingerprint():
+        return False
+
+    def _rows(rows, need=None):
+        # per-row defensive coercion: one malformed row from a skewed
+        # source (hits: null, "1.5") is SKIPPED, never raised — a raise
+        # here would collaterally cancel the caller's census flush and
+        # pre-warm kick for the whole shard graduation
+        out = []
+        for r in rows:
+            if not isinstance(r, dict) or (need and not r.get(need)):
+                continue
+            try:
+                out.append(dict(r, hits=int(r.get("hits", 1))))
+            except (TypeError, ValueError):
+                continue
+        return out
+
+    keys = _rows(keys)
+    bodies = _rows(bodies, need="body")
+    if not keys and not bodies:
+        return False
+    store_census(index_name, keys=keys, bodies=bodies, merge=True)
+    return True
+
+
 def load_census(index_name: str) -> Optional[dict]:
     """The persisted census payload for ``index_name`` or None. A
     corrupt blob (digest mismatch, bad JSON, wrong shape) is deleted and

@@ -57,8 +57,14 @@ class ResourceWatcherService:
                 event = "changed"
             if event:
                 with self._lock:
-                    if path in self._watched:
-                        self._watched[path] = (new_mt, listeners)
+                    # re-read the CURRENT listener list under the lock:
+                    # writing back the snapshot's list would revert a
+                    # concurrent remove()+add() cycle to the stale list
+                    # and silently drop its listeners (check-then-act
+                    # window found by tpulint R016)
+                    cur = self._watched.get(path)
+                    if cur is not None:
+                        self._watched[path] = (new_mt, cur[1])
                 for fn in listeners:
                     try:
                         fn(path, event)

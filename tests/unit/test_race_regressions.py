@@ -1,0 +1,160 @@
+"""Regression tests for the concurrency bugs tpulint R015/R016 found
+(ISSUE 15 adoption pass) — each pins the FIXED discipline so a refactor
+that drops the lock (or reintroduces the stale-snapshot write) fails
+deterministically, not flakily.
+
+1. bootstrap `_publish` commit: the (`_committed_meta`,
+   `_committed_snapshot`) pair must update under `_indices_lock` — an
+   unlocked two-field update let `_on_meta` (transport thread) pair the
+   NEW freshness key with the OLD snapshot and hand an elected master
+   stale metadata under a fresh key (R015).
+2. bootstrap `_takeover`: the `_meta_term` stamp must take
+   `_indices_lock` like every other write of it (R015).
+3. watcher `check_now`: the act region must re-read the CURRENT
+   listener list under the lock — writing back the poll snapshot's list
+   reverted a concurrent remove()+add() cycle and silently dropped the
+   re-added listeners (R016's check-then-act window).
+
+The instrumentation swaps the cluster instance's class for a subclass
+whose ``__setattr__`` records any write of the guarded fields made
+without `_indices_lock` held (tracked per thread through a lock proxy)
+— the discipline itself is the assertion, so the test cannot pass by
+lucky scheduling.
+"""
+import os
+import socket
+import threading
+
+import pytest
+
+from elasticsearch_tpu.watcher import ResourceWatcherService
+
+GUARDED = ("_meta_term", "_committed_meta", "_committed_snapshot")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _instrument(cluster):
+    """Record writes of the commit-metadata fields made while
+    `_indices_lock` is NOT held by the writing thread."""
+    real = cluster._indices_lock
+    tls = threading.local()
+
+    class _LockProxy:
+        def __enter__(self):
+            real.acquire()
+            tls.depth = getattr(tls, "depth", 0) + 1
+            return self
+
+        def __exit__(self, *exc):
+            tls.depth -= 1
+            real.release()
+            return False
+
+    violations = []
+    base = cluster.__class__
+
+    class _Instrumented(base):
+        def __setattr__(self, name, value):
+            if name in GUARDED and not getattr(tls, "depth", 0):
+                violations.append(name)
+            object.__setattr__(self, name, value)
+
+    cluster._indices_lock = _LockProxy()
+    cluster.__class__ = _Instrumented
+    return violations
+
+
+@pytest.fixture()
+def pair():
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.node import Node
+    from elasticsearch_tpu.utils.faults import FAULTS
+
+    port = _free_port()
+    node0 = Node(name="rr-rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0)
+    node1 = Node(name="rr-rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0)
+    yield c0, c1
+    FAULTS.clear()
+    try:
+        c1.close()
+    finally:
+        c0.close()
+        node1.close()
+        node0.close()
+
+
+def test_publish_commit_pair_updates_hold_indices_lock(pair):
+    c0, c1 = pair
+    v0, v1 = _instrument(c0), _instrument(c1)
+    c0.data.create_index("rlk", {"settings": {"number_of_shards": 1,
+                                              "number_of_replicas": 0}})
+    c0.data.index_doc("rlk", "1", {"v": 1})
+    assert v0 == [], f"unlocked commit-metadata writes on master: {v0}"
+    assert v1 == [], f"unlocked commit-metadata writes on follower: {v1}"
+    # the committed (key, content) pair the lock protects is coherent:
+    # _on_meta's advertised key matches the snapshot it serves
+    got = c1._on_meta({})
+    assert (got["meta_term"], got["indices_version"]) == c1._committed_meta
+    assert "rlk" in got["indices"]
+
+
+def test_takeover_meta_term_stamp_holds_indices_lock(pair):
+    c0, c1 = pair
+    v1 = _instrument(c1)
+    term = c1.node.cluster_state.term + 1
+    # local-copy takeover (best_meta address None): the non-master wins
+    # an election and stamps _meta_term — the write R015 flagged
+    assert c1._takeover(term, (0, 0, None), voters=[])
+    assert v1 == [], f"unlocked commit-metadata writes in takeover: {v1}"
+    assert c1.is_master
+    assert c1._meta_term == term
+
+
+def test_watcher_readd_during_poll_keeps_new_listeners(tmp_path):
+    """Deterministic interleave of the R016 window: a path is removed
+    and re-added (fresh listener list) between check_now()'s snapshot
+    and its act region. The fixed act re-reads the current list under
+    the lock; the old code wrote the snapshot's stale list back and the
+    re-added listener never fired again."""
+    svc = ResourceWatcherService()
+    path = str(tmp_path / "w.txt")
+    with open(path, "w") as fh:
+        fh.write("a")
+    os.utime(path, (1_000_000, 1_000_000))
+    old_events, new_events = [], []
+    svc.add(path, lambda p, e: old_events.append(e))
+
+    fired = {"done": False}
+
+    def hooked(p):  # instance attr shadows the staticmethod
+        mt = ResourceWatcherService._mtime(p)
+        if not fired["done"]:
+            fired["done"] = True
+            # the interleaved remove+re-add, exactly in the window
+            # between the snapshot and the guarded act
+            svc.remove(p)
+            svc.add(p, lambda pp, e: new_events.append(e))
+        return mt
+
+    svc._mtime = hooked
+    os.utime(path, (1_000_010, 1_000_010))
+    assert svc.check_now() >= 1          # old listener sees this change
+    assert old_events == ["changed"]
+    os.utime(path, (1_000_020, 1_000_020))
+    svc.check_now()
+    # the re-added listener survived the concurrent poll round: it sees
+    # the SECOND change (stale-list write-back lost it entirely)
+    assert new_events == ["changed"], \
+        "re-added listener was dropped by the stale-snapshot write-back"
+    assert old_events == ["changed"]     # the removed one stayed removed

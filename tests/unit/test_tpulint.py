@@ -1813,6 +1813,440 @@ def make(mesh, wrap, shapes):
         assert vs == []
 
 
+class TestR015Lockset:
+    """Eraser-style per-attribute lockset inference over CONCURRENT
+    reach: a write without the inferred (or declared) guard, reachable
+    from a thread root, is a race."""
+
+    # the canonical bad shape: the unguarded write sits TWO calls deep
+    # from a Thread target, in a class whose other accesses are locked
+    RACY = {
+        "r15/svc.py": """
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = []
+
+    def record(self, item):
+        with self._lock:
+            self._state.append(item)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._state)
+
+    def reset_unlocked(self):
+        self._state = []
+
+
+REGISTRY = Registry()
+""",
+        "r15/worker.py": """
+import threading
+
+from r15.svc import REGISTRY
+
+
+def step():
+    REGISTRY.reset_unlocked()
+
+
+def worker():
+    step()
+
+
+def spawn():
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+""",
+    }
+
+    def test_unguarded_write_two_calls_from_thread_root(self):
+        vs = [v for v in lint_sources(self.RACY) if v.rule == "R015"]
+        assert [(v.path, "self._state" in v.message) for v in vs] == \
+            [("r15/svc.py", True)]
+        assert "Registry._lock" in vs[0].message
+
+    def test_without_thread_root_stays_clean(self):
+        srcs = dict(self.RACY)
+        srcs["r15/worker.py"] = srcs["r15/worker.py"].replace(
+            "    t = threading.Thread(target=worker, daemon=True)\n"
+            "    t.start()", "    worker()")
+        assert [v for v in lint_sources(srcs) if v.rule == "R015"] == []
+
+    def test_pool_submission_is_a_thread_root(self):
+        srcs = dict(self.RACY)
+        srcs["r15/worker.py"] = """
+from r15.pool import POOL
+from r15.svc import REGISTRY
+
+
+def step():
+    REGISTRY.reset_unlocked()
+
+
+def worker():
+    step()
+
+
+def spawn():
+    POOL.execute(worker)
+"""
+        srcs["r15/pool.py"] = """
+class FixedThreadPool:
+    def execute(self, fn, *args):
+        return fn(*args)
+
+
+POOL = FixedThreadPool()
+"""
+        vs = [v for v in lint_sources(srcs) if v.rule == "R015"]
+        assert [v.path for v in vs] == ["r15/svc.py"]
+
+    def test_guarded_by_annotation_declares_the_guard(self):
+        # only ONE guarded access: majority inference alone would stay
+        # silent — the declaration makes the discipline explicit
+        vs = lint_sources({
+            "g15/svc.py": """
+import threading
+
+from g15.run import spawn
+
+
+class Census:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # tpulint: guarded_by(self._lock)
+        self._gens = {}
+
+    def bump(self, k):
+        with self._lock:
+            self._gens[k] = self._gens.get(k, 0) + 1
+
+    def forget(self, k):
+        self._gens.pop(k, None)
+
+
+CENSUS = Census()
+""",
+            "g15/run.py": """
+import threading
+
+from g15 import svc
+
+
+def worker():
+    svc.CENSUS.bump("a")
+    svc.CENSUS.forget("a")
+
+
+def spawn():
+    threading.Thread(target=worker, daemon=True).start()
+""",
+        })
+        hits = [v for v in vs if v.rule == "R015"]
+        assert [("forget" in v.snippet or "pop" in v.snippet)
+                for v in hits] == [True]
+        assert "guarded_by" in hits[0].message
+
+    def test_unresolvable_guarded_by_is_flagged(self):
+        # a typo'd declaration must SURFACE, not silently downgrade to
+        # majority inference (which here would check nothing)
+        vs = lint_sources({
+            "b15/svc.py": """
+import threading
+
+from b15.run import spawn
+
+
+class Census:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # tpulint: guarded_by(self._lok)
+        self._gens = {}
+
+    def bump(self, k):
+        with self._lock:
+            self._gens[k] = self._gens.get(k, 0) + 1
+
+    def forget(self, k):
+        self._gens.pop(k, None)
+
+
+CENSUS = Census()
+""",
+            "b15/run.py": """
+import threading
+
+from b15 import svc
+
+
+def worker():
+    svc.CENSUS.bump("a")
+    svc.CENSUS.forget("a")
+
+
+def spawn():
+    threading.Thread(target=worker, daemon=True).start()
+""",
+        })
+        hits = [v for v in vs if v.rule == "R015"]
+        assert any("does not resolve" in v.message
+                   and "self._lok" in v.message for v in hits), hits
+
+    def test_init_then_publish_stays_clean(self):
+        # lock-free init-before-publish: __init__ builds state unshared;
+        # the thread only READS afterwards — no inference, no finding
+        vs = lint_sources({
+            "i15/svc.py": """
+import threading
+
+
+class Holder:
+    def __init__(self, items):
+        self._items = list(items)
+        self._ready = True
+
+    def view(self):
+        return list(self._items)
+
+
+def worker(h):
+    h.view()
+
+
+def spawn():
+    h = Holder([1, 2])
+    threading.Thread(target=worker, daemon=True).start()
+""",
+        })
+        assert [v for v in vs if v.rule in ("R015", "R016")] == []
+
+    def test_caller_locked_private_helper_is_guarded(self):
+        # the `_private runs caller-locked` convention: every call site
+        # holds the lock, so the helper's writes count as guarded (the
+        # held-on-entry meet — no false positive)
+        vs = lint_sources({
+            "p15/svc.py": """
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._docs = {}
+
+    def index(self, k, v):
+        with self._lock:
+            self._remove_existing(k)
+            self._docs[k] = v
+
+    def delete(self, k):
+        with self._lock:
+            self._remove_existing(k)
+
+    def _remove_existing(self, k):
+        self._docs.pop(k, None)
+
+
+ENGINE = Engine()
+
+
+def worker():
+    ENGINE.index("a", 1)
+    ENGINE.delete("a")
+
+
+def spawn():
+    import threading
+    threading.Thread(target=worker, daemon=True).start()
+""",
+        })
+        assert [v for v in vs if v.rule == "R015"] == []
+
+    def test_inline_allow_suppresses(self):
+        srcs = dict(self.RACY)
+        srcs["r15/svc.py"] = srcs["r15/svc.py"].replace(
+            "        self._state = []\n\n\nREGISTRY",
+            "        self._state = []  # tpulint: allow[R015] — "
+            "reviewed: reset only runs in tests\n\n\nREGISTRY")
+        assert [v for v in lint_sources(srcs) if v.rule == "R015"] == []
+
+
+class TestR016Atomicity:
+    """Check-then-act across a lock release: a read-only guarded region
+    followed by a later BLIND guarded write of the same attribute."""
+
+    BAD = {
+        "a16/svc.py": """
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def get_or_make(self, k, build):
+        with self._lock:
+            v = self._entries.get(k)
+        if v is None:
+            v = build(k)
+            with self._lock:
+                self._entries[k] = v
+        return v
+
+
+CACHE = Cache()
+
+
+def worker():
+    CACHE.get_or_make("a", lambda k: k)
+
+
+def spawn():
+    threading.Thread(target=worker, daemon=True).start()
+""",
+    }
+
+    def test_released_check_then_act_flags(self):
+        vs = [v for v in lint_sources(self.BAD) if v.rule == "R016"]
+        assert [v.path for v in vs] == ["a16/svc.py"]
+        assert "self._entries" in vs[0].message
+        assert "released between" in vs[0].message
+
+    def test_held_through_is_clean(self):
+        srcs = {"a16/svc.py": self.BAD["a16/svc.py"].replace(
+            """        with self._lock:
+            v = self._entries.get(k)
+        if v is None:
+            v = build(k)
+            with self._lock:
+                self._entries[k] = v
+        return v""",
+            """        with self._lock:
+            v = self._entries.get(k)
+            if v is None:
+                v = build(k)
+                self._entries[k] = v
+        return v""")}
+        assert [v for v in lint_sources(srcs) if v.rule == "R016"] == []
+
+    def test_revalidated_act_is_clean(self):
+        # double-checked under the lock: the act region re-reads before
+        # writing — the stale-check window is closed
+        srcs = {"a16/svc.py": self.BAD["a16/svc.py"].replace(
+            """            with self._lock:
+                self._entries[k] = v""",
+            """            with self._lock:
+                if k not in self._entries:
+                    self._entries[k] = v""")}
+        assert [v for v in lint_sources(srcs) if v.rule == "R016"] == []
+
+    def test_condition_wait_loop_is_legal(self):
+        # `with cv: while not pred: cv.wait(...)` then act under the
+        # SAME hold — Condition.wait releases and reacquires, but the
+        # check and the act share one lexical region
+        vs = lint_sources({
+            "c16/svc.py": """
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify_all()
+
+    def take(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait(timeout=0.05)
+            return self._items.pop()
+
+
+BOX = Mailbox()
+
+
+def worker():
+    BOX.put(1)
+    BOX.take()
+
+
+def spawn():
+    threading.Thread(target=worker, daemon=True).start()
+""",
+        })
+        assert [v for v in vs if v.rule in ("R015", "R016")] == []
+
+    def test_inline_allow_suppresses(self):
+        srcs = {"a16/svc.py": self.BAD["a16/svc.py"].replace(
+            "                self._entries[k] = v",
+            "                self._entries[k] = v  "
+            "# tpulint: allow[R016] — reviewed: last-write-wins is fine "
+            "for this cache")}
+        assert [v for v in lint_sources(srcs) if v.rule == "R016"] == []
+
+
+class TestConcurrentReach:
+    """The CONCURRENT-REACH fixpoint recognizes every thread-root
+    spelling the serving/cluster stack actually uses."""
+
+    def _index(self, sources):
+        from tools.tpulint.project import analyze_sources
+
+        index, errors = analyze_sources(
+            {k: textwrap.dedent(v) for k, v in sources.items()})
+        assert errors == []
+        return index
+
+    def test_rest_route_handlers_are_roots(self):
+        index = self._index({
+            "rr/server.py": """
+def _cat_health(node, params, body):
+    return {}
+
+
+def register_all(rc):
+    rc.add("GET", "/_cat/health", _cat_health)
+""",
+        })
+        assert "rr.server:_cat_health" in index.concurrent
+
+    def test_transport_register_callbacks_are_roots(self):
+        index = self._index({
+            "tr/action.py": """
+class Service:
+    def __init__(self, transport):
+        transport.register("indices:data/read", self._on_read)
+
+    def _on_read(self, payload):
+        return payload
+""",
+        })
+        assert "tr.action:Service._on_read" in index.concurrent
+
+    def test_plain_calls_do_not_root(self):
+        index = self._index({
+            "pc/mod.py": """
+def helper():
+    return 1
+
+
+def main():
+    helper()
+""",
+        })
+        assert index.concurrent == set()
+
+
 class TestChangedModeAndSeverity:
     BAD = textwrap.dedent("""
         import jax
@@ -1872,6 +2306,52 @@ class TestChangedModeAndSeverity:
         bad.write_text(self.BAD)
         assert main([str(bad), "--per-file",
                      "--baseline", str(tmp_path / "none.json")]) == 1
+
+    def test_sarif_output(self, tmp_path, capsys):
+        """--sarif: SARIF 2.1.0 for CI PR annotation — rule catalogue
+        with default severity levels, results with physical locations,
+        exit code matching the plain mode."""
+        from tools.tpulint.__main__ import main
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        rc = main([str(bad), "--sarif",
+                   "--baseline", str(tmp_path / "none.json")])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert out["version"] == "2.1.0"
+        assert "sarif-schema-2.1.0" in out["$schema"]
+        run = out["runs"][0]
+        rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+        assert {"R001", "R004", "R015", "R016"} <= set(rules)
+        assert rules["R015"]["defaultConfiguration"]["level"] == "error"
+        assert rules["R001"]["defaultConfiguration"]["level"] == "warning"
+        (res,) = run["results"]
+        assert res["ruleId"] == "R004" and res["level"] == "error"
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad.py")
+        assert loc["region"]["startLine"] >= 1
+        assert loc["region"]["snippet"]["text"]
+
+    def test_sarif_baselined_findings_carry_suppressions(self, tmp_path,
+                                                         capsys):
+        from tools.tpulint.__main__ import main
+        from tools.tpulint.baseline import write_baseline
+        from tools.tpulint.project import lint_project
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(self.BAD)
+        found = lint_project([str(bad)])
+        base = tmp_path / "base.json"
+        doc = write_baseline(found, str(base))
+        for v in doc["violations"]:
+            v["justification"] = "test fixture"
+        base.write_text(json.dumps(doc))
+        rc = main([str(bad), "--sarif", "--baseline", str(base)])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0  # fully baselined: clean exit, audit trail kept
+        (res,) = out["runs"][0]["results"]
+        assert res["suppressions"][0]["kind"] == "external"
 
 
 # ---------------------------------------------------------------------------

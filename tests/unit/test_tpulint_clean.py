@@ -49,13 +49,17 @@ def test_repo_is_tpulint_clean_interprocedural():
 
 
 def test_analyzer_full_repo_under_30s():
-    """Self-benchmark: the whole-program pass over the full repo must
-    stay fast enough for tier-1 — a gate nobody runs is a gate that
-    rots. 30s is ~7x the current cost; breach means the analysis grew
-    superlinear, not that the repo grew."""
+    """Self-benchmark: the whole-program pass over the full repo —
+    including the R015/R016 concurrency fixpoints — must stay fast
+    enough for tier-1; a gate nobody runs is a gate that rots. 30s is
+    ~6x the current cost; breach means the analysis grew superlinear,
+    not that the repo grew. The measured time prints so the gate run
+    itself is the benchmark record (`pytest -s` shows it)."""
     t0 = time.monotonic()
     lint_project(SCOPE, root=str(REPO_ROOT))
-    assert time.monotonic() - t0 < 30.0
+    dt = time.monotonic() - t0
+    print(f"\ntpulint full-project pass: {dt:.2f}s (bound 30s)")
+    assert dt < 30.0, f"analyzer self-benchmark breached: {dt:.2f}s"
 
 
 def test_real_lock_graph_is_acyclic_and_nontrivial():
@@ -96,6 +100,83 @@ def test_seeded_host_sync_in_collective_round_caught_by_r014():
     clean = lint_project([str(REPO_ROOT / "elasticsearch_tpu")],
                          root=str(REPO_ROOT))
     assert [v for v in clean if v.rule == "R014" and v.path == path] == []
+
+
+def test_concurrency_analysis_sees_the_real_stack():
+    """The R015/R016 substrate on the real repo: the daemon loops and
+    REST/transport handlers are in CONCURRENT reach, and the lockset
+    inference recovers the real guard disciplines — including the
+    executor's `_prep` map, whose popitem-vs-move_to_end race was
+    hand-found in review before this rule existed."""
+    index, _errors = build_project(SCOPE, root=str(REPO_ROOT))
+    for sid in (
+            "elasticsearch_tpu.serving.coalescer:QueryCoalescer"
+            "._drain_loop",
+            "elasticsearch_tpu.monitor.watchdog:WatchdogService._loop",
+            "elasticsearch_tpu.serving.warmup:WarmupService._loop",
+            "elasticsearch_tpu.cluster.search_action:"
+            "DistributedDataService._on_shard_sync"):
+        assert sid in index.concurrent, sid
+    assert len(index.concurrent) > 300   # REST reach is broad — by design
+    expects = {
+        "elasticsearch_tpu.serving.coalescer:QueryCoalescer._queues":
+            "QueryCoalescer._cv",
+        "elasticsearch_tpu.index.engine:Engine._locations":
+            "Engine._lock",
+        "elasticsearch_tpu.parallel.executor:MeshSearchExecutor._prep":
+            "MeshSearchExecutor._prep_lock",
+        "elasticsearch_tpu.cluster.bootstrap:MultiHostCluster"
+        "._committed_snapshot": "MultiHostCluster._indices_lock",
+    }
+    for ident, want in expects.items():
+        got = index.attr_guards.get(ident)
+        assert got is not None and got[0].endswith(want), (ident, got)
+    assert len(index.attr_guards) >= 100  # the inferred world is real
+
+
+def test_seeded_race_and_atomicity_overlays_caught():
+    """R015/R016 reach regression on REAL source (the R014 seed's
+    sibling): an unguarded write seeded into the warmup worker loop and
+    a check-then-act seeded into the coalescer's stats path must be
+    caught — and the unseeded tree stays clean (the seeds are the only
+    diff)."""
+    wpath = "elasticsearch_tpu/serving/warmup.py"
+    wsrc = (REPO_ROOT / wpath).read_text()
+    wanchor = "    def _loop(self) -> None:\n" \
+              "        while not self._stop.is_set():"
+    assert wanchor in wsrc, "warmup _loop changed; update the seed anchor"
+    wseed = wsrc.replace(
+        wanchor, wanchor + "\n            self._queue.clear()  # seeded",
+        1)
+    cpath = "elasticsearch_tpu/serving/coalescer.py"
+    csrc = (REPO_ROOT / cpath).read_text()
+    canchor = ("    def _flush(self, batch: List[_Entry], "
+               "reason: str) -> None:\n"
+               "        from elasticsearch_tpu.search.batch import "
+               "execute_batch\n")
+    assert canchor in csrc, "coalescer _flush changed; update the seed"
+    cseed = csrc.replace(canchor, canchor + (
+        "\n"
+        "        with self._cv:\n"
+        "            _seed = self._queues.get((\"seed\", \"seed\"))\n"
+        "        if _seed is None:\n"
+        "            with self._cv:\n"
+        "                self._queues[(\"seed\", \"seed\")] = []\n"), 1)
+    found = lint_project([str(REPO_ROOT / "elasticsearch_tpu")],
+                         root=str(REPO_ROOT),
+                         overlay={wpath: wseed, cpath: cseed})
+    r15 = [v for v in found if v.rule == "R015" and v.path == wpath]
+    assert r15, "seeded unguarded write in the warmup loop not caught"
+    assert any("_queue" in v.message and "WarmupService._lock"
+               in v.message for v in r15)
+    r16 = [v for v in found if v.rule == "R016" and v.path == cpath]
+    assert r16, "seeded check-then-act in coalescer stats not caught"
+    assert any("_queues" in v.message for v in r16)
+    # the unseeded tree stays R015/R016-clean (the seeds are the diff)
+    clean = lint_project([str(REPO_ROOT / "elasticsearch_tpu")],
+                         root=str(REPO_ROOT))
+    assert [v for v in clean if v.rule in ("R015", "R016")
+            and v.path in (wpath, cpath)] == []
 
 
 def test_traced_inference_reaches_helpers():

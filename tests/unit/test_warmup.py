@@ -476,6 +476,244 @@ class TestWarmupService:
             n.close()
 
 
+# -- census rides shard-relocation streams (ISSUE 15) --------------------------
+
+
+class TestRelocationCensus:
+    def _served_node(self, tmp_path, index="rc_idx"):
+        n = _make_node(data_path=str(tmp_path / "src"), index=index)
+        for body in ({"query": {"match": {"t": "alpha"}}, "size": 5},
+                     {"query": {"match": {"t": "beta gamma"}}, "size": 3}):
+            n.search(index, body)
+        return n
+
+    def test_export_then_adopt_across_isolated_blob_tiers(self, tmp_path):
+        """The in-band path: a target node sharing NO blob directory
+        with the source gets the census through the payload alone."""
+        n = self._served_node(tmp_path, index="xa_idx")
+        try:
+            payload = census.export_census("xa_idx")
+            assert payload is not None
+            assert payload["keys"] and payload["bodies"]
+            assert payload["index"] == "xa_idx"
+            # the relocation target's world: a DIFFERENT durable tier
+            # where this index has never been seen
+            ivf_cache.reset()
+            ivf_cache.register(str(tmp_path / "target"))
+            assert census.load_census("xa_idx") is None
+            assert census.adopt_census("xa_idx", payload) is True
+            got = census.load_census("xa_idx")
+            assert got is not None
+            assert {k["program"] for k in got["keys"]} == \
+                {k["program"] for k in payload["keys"]}
+            assert got["bodies"] == payload["bodies"]
+        finally:
+            n.close()
+
+    def test_adopt_refuses_foreign_backend_and_garbage(self, tmp_path):
+        from elasticsearch_tpu.monitor import programs
+
+        ivf_cache.register(str(tmp_path / "t2"))
+        good = {"version": census.VERSION, "index": "fb_idx",
+                "backend": "tpu/v99",
+                "keys": [{"program": "p", "shapes": "s", "field": "",
+                          "hits": 1}],
+                "bodies": []}
+        assert census.adopt_census("fb_idx", good) is False  # backend
+        assert census.adopt_census("fb_idx", None) is False
+        assert census.adopt_census("fb_idx", {"index": "other"}) is False
+        assert census.load_census("fb_idx") is None  # nothing persisted
+        # malformed ROWS from a skewed source are skipped, never raised
+        # (a raise would cancel the caller's flush + pre-warm kick):
+        # the good row still adopts
+        mixed = {"version": census.VERSION, "index": "fb_idx",
+                 "backend": programs.backend_fingerprint(),
+                 "keys": [{"program": "bad", "shapes": "s", "field": "",
+                           "hits": None},
+                          {"program": "ok", "shapes": "s", "field": "",
+                           "hits": "1.5"},
+                          {"program": "good", "shapes": "s", "field": "",
+                           "hits": 3}],
+                 "bodies": [{"body": "", "hits": 1}]}
+        assert census.adopt_census("fb_idx", mixed) is True
+        got = census.load_census("fb_idx")
+        assert {k["program"] for k in got["keys"]} == {"good"}
+
+    @staticmethod
+    def _cluster_pair():
+        import socket
+
+        from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+        from elasticsearch_tpu.node import Node
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        n0 = Node(name="rc-rank0")
+        c0 = MultiHostCluster(n0, rank=0, world=2, transport_port=port,
+                              ping_interval=0)
+        n1 = Node(name="rc-rank1")
+        c1 = MultiHostCluster(n1, rank=1, world=2, transport_port=port,
+                              ping_interval=0)
+        return c0, c1
+
+    @staticmethod
+    def _close_pair(c0, c1):
+        try:
+            c1.close()
+        finally:
+            c0.close()
+            c1.node.close()
+            c0.node.close()
+
+    def test_shard_sync_response_carries_census(self):
+        c0, c1 = self._cluster_pair()
+        try:
+            c0.data.create_index("ss_idx", {
+                "settings": {"number_of_shards": 1,
+                             "number_of_replicas": 0},
+                "mappings": {"properties": {"t": {"type": "text"}}}})
+            for i in range(8):
+                c0.data.index_doc("ss_idx", str(i), {"t": f"alpha w{i}"})
+            c0.data.refresh("ss_idx")
+            c0.node.search("ss_idx", {"query": {"match": {"t": "alpha"}},
+                                      "size": 5})
+            resp = c0.data._on_shard_sync({"index": "ss_idx", "shard": 0})
+            shipped = resp.get("census")
+            assert shipped is not None
+            assert shipped["index"] == "ss_idx"
+            # per-shard handshakes of one relocation reuse ONE computed
+            # payload (the debounce window): no P× load+merge+serialize
+            resp2 = c0.data._on_shard_sync({"index": "ss_idx", "shard": 0})
+            assert resp2.get("census") is shipped
+            # the REPLAYABLE half must always ship — it is what the
+            # target's pre-warm consumes. Keys are compile-time records,
+            # so in a shared-process test run a pre-warmed program
+            # legitimately contributes none (the subprocess acceptance
+            # test covers the cold-source case end to end).
+            assert shipped["bodies"], "replayable bodies must ride along"
+        finally:
+            self._close_pair(c0, c1)
+
+    def test_relocation_target_adopts_and_prewarms(self, tmp_path,
+                                                   monkeypatch):
+        """End-to-end through the real recovery handlers: _on_recover on
+        the target adopts the census that rode the _on_shard_sync
+        response and kicks pre-warm — with the disk-flush side channels
+        disabled, the in-band copy is the ONLY way it can arrive."""
+        from elasticsearch_tpu.cluster.search_action import \
+            DistributedDataService
+
+        c0, c1 = self._cluster_pair()
+        try:
+            body = {"settings": {"number_of_shards": 1,
+                                 "number_of_replicas": 0},
+                    "mappings": {"properties": {"t": {"type": "text"}}}}
+            c0.data.create_index("mv_idx", dict(body))
+            for i in range(8):
+                c0.data.index_doc("mv_idx", str(i),
+                                  {"t": f"alpha beta w{i}"})
+            c0.data.refresh("mv_idx")
+            c0.node.search("mv_idx", {"query": {"match": {"t": "alpha"}},
+                                      "size": 4})
+            # no side channels: neither node's debounced flush may seed
+            # the blob tier — only the in-band adoption can
+            monkeypatch.setattr(DistributedDataService,
+                                "_flush_census_debounced",
+                                lambda self, ix: None)
+            ivf_cache.reset()
+            ivf_cache.register(str(tmp_path / "target-tier"))
+            assert census.load_census("mv_idx") is None
+            res = c1.data._on_recover({
+                "index": "mv_idx", "shard": 0,
+                "source": c0.local.node_id,
+                "target": c1.local.node_id, "body": body})
+            assert res["mode"] in ("ops", "full")
+            # the census arrived in-band and was persisted on the target
+            got = census.load_census("mv_idx")
+            assert got is not None and got["bodies"]
+            # ... and pre-warm was kicked for the relocated index
+            wu = c1.node.serving.warmup
+            assert wu.wait_idle(timeout=30.0)
+            run = wu.runs.get("mv_idx")
+            assert run is not None
+            assert run["status"] in ("complete", "cooldown")
+        finally:
+            self._close_pair(c0, c1)
+
+    def test_relocation_target_zero_compile_delta(self, tmp_path):
+        """ISSUE 15 acceptance: a relocation target in a FRESH process
+        with its own (empty) data path adopts the shipped census,
+        pre-warms, and serves the censused first page with compile
+        delta 0 — the compiles all land in the warmup replay, none on
+        the request path."""
+        from elasticsearch_tpu.tracing import retrace
+
+        if retrace.auditor() is None:
+            pytest.skip("trace auditor unavailable")
+        bodies = [{"query": {"match": {"t": t}}, "size": s}
+                  for t in ("alpha", "alpha beta") for s in (5, 10)]
+        src = _make_node(data_path=str(tmp_path / "srcdata"),
+                         index="relidx", docs=24)
+        for b in bodies:
+            assert src.search("relidx", b)["hits"]["total"] > 0
+        shipped = census.export_census("relidx")
+        src.close()
+        assert shipped is not None and shipped["bodies"]
+        payload_file = tmp_path / "census_payload.json"
+        payload_file.write_text(json.dumps(shipped))
+        script = """
+import json, os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+from elasticsearch_tpu.node import Node
+from elasticsearch_tpu.monitor import programs
+from elasticsearch_tpu.resources import census
+from elasticsearch_tpu.tracing import retrace
+data, payload_file, bodies = sys.argv[1], sys.argv[2], \\
+    json.loads(sys.argv[3])
+n = Node(name="rel-target", data_path=data)
+n.create_index("relidx", {
+    "mappings": {"properties": {"t": {"type": "text"}}}})
+svc = n.indices["relidx"]
+for i in range(24):
+    svc.index_doc(str(i), {"t": f"alpha beta gamma delta word{i}"})
+svc.refresh()
+assert census.load_census("relidx") is None  # nothing local: must ship
+adopted = census.adopt_census("relidx",
+                              json.loads(open(payload_file).read()))
+res = n.serving.warmup.run_index("relidx", "relocation")
+stats0 = programs.REGISTRY.stats()
+t0 = retrace.auditor().total() if retrace.auditor() else -1
+hits = [n.search("relidx", b)["hits"]["total"] for b in bodies]
+stats1 = programs.REGISTRY.stats()
+t1 = retrace.auditor().total() if retrace.auditor() else -1
+print("RESULT " + json.dumps({
+    "adopted": adopted, "warmup_run": res, "hits": hits,
+    "compiles_during_page": stats1["compiles"] - stats0["compiles"],
+    "traces_during_page": (t1 - t0) if t0 >= 0 else None}))
+n.close()
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("ESTPU_WARMUP", None)
+        p = subprocess.run(
+            [sys.executable, "-c", script, str(tmp_path / "tgtdata"),
+             str(payload_file), json.dumps(bodies)],
+            capture_output=True, text=True, env=env, timeout=300)
+        assert p.returncode == 0, p.stderr[-2000:]
+        line = [ln for ln in p.stdout.splitlines()
+                if ln.startswith("RESULT ")][-1]
+        out = json.loads(line[len("RESULT "):])
+        assert out["adopted"] is True
+        assert out["warmup_run"]["status"] == "complete"
+        assert out["warmup_run"]["replayed"] == len(bodies)
+        assert all(h > 0 for h in out["hits"])
+        # THE acceptance number: the relocated shard's first censused
+        # page compiles NOTHING — warmup ate the whole cost
+        assert out["compiles_during_page"] == 0
+        assert out["traces_during_page"] == 0
+
+
 # -- restart acceptance --------------------------------------------------------
 
 class TestRestartAcceptance:

@@ -13,24 +13,31 @@ tpulint v2 is a TWO-PASS analyzer: pass 1 (``tools/tpulint/project.py``)
 builds a project-wide symbol table + call graph and infers which
 functions are transitively reachable from ``jax.jit`` / ``pallas_call``
 / ``shard_map`` bodies (traced reach), which sit inside collective
-programs, and which locks are held at every acquire site
-interprocedurally; pass 2 (``tools/tpulint/rules.py``) runs fourteen
-rules over that view — R001 recompile hazards, R002 host syncs (traced
-reach + hot-path loops), R003 dynamic shapes, R004 tracer leaks, R005
-lock discipline, R006 swallowed failures, R007 wall-clock durations,
-R008 unaccounted device placement, R009 metric recording on the device
-path, R010 unbounded waits under serving locks, R011 ungated cluster
-threads, R012 import-time jit bindings escaping compile attribution,
-R013 lock-order cycles + lock-held calls into unbounded waits, R014
-collective purity. R002/R003/R004/R009 fire THROUGH helper calls — a
-violation two modules away from the jit body is found where it lives.
+programs, which run CONCURRENTLY (reachable from thread roots: Thread
+targets, pool submissions, REST/transport handlers), and which locks
+are held at every acquire site — and on entry to every function —
+interprocedurally; pass 2 (``tools/tpulint/rules.py`` + the project
+rules) runs sixteen rules over that view — R001 recompile hazards,
+R002 host syncs (traced reach + hot-path loops), R003 dynamic shapes,
+R004 tracer leaks, R005 lock discipline, R006 swallowed failures, R007
+wall-clock durations, R008 unaccounted device placement, R009 metric
+recording on the device path, R010 unbounded waits under serving
+locks, R011 ungated cluster threads, R012 import-time jit bindings
+escaping compile attribution, R013 lock-order cycles + lock-held calls
+into unbounded waits, R014 collective purity, R015 Eraser-style
+lockset races (a write without the attribute's inferred/declared
+guard), R016 atomicity violations (check-then-act across a lock
+release). R002/R003/R004/R009 fire THROUGH helper calls — a violation
+two modules away from the jit body is found where it lives.
 
 Suppress a finding in place with ``# tpulint: allow[R0xx]`` on the line
 (or an immediately preceding comment line); mark intentional host-side
-build code with ``# tpulint: host``. Grandfathered sites live in
-``tools/tpulint/baseline.json``.
+build code with ``# tpulint: host``; declare an attribute's guarding
+lock with ``# tpulint: guarded_by(self._lock)``. Grandfathered sites
+live in ``tools/tpulint/baseline.json``.
 
-Run: ``python -m tools.tpulint [--changed [BASE]] [--json] [paths]``.
+Run: ``python -m tools.tpulint [--changed [BASE]] [--json] [--sarif]
+[paths]``.
 
 ``tools.tpulint.trace_audit`` is the runtime counterpart: it wraps
 ``jax.jit`` to count (re)traces per callable and assert an upper bound,

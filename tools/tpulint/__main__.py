@@ -36,6 +36,60 @@ from tools.tpulint.baseline import (
 # analyze it, and the bench entry point
 DEFAULT_SCOPE = ("elasticsearch_tpu", "tools", "bench.py")
 
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _sarif_result(v, suppressed_by: str = "") -> dict:
+    out = {
+        "ruleId": v.rule,
+        "level": SEVERITY.get(v.rule, "warning"),
+        "message": {"text": v.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": v.path,
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(v.line, 1),
+                           "startColumn": v.col + 1,
+                           "snippet": {"text": v.snippet}},
+            },
+        }],
+    }
+    if suppressed_by:
+        out["suppressions"] = [{"kind": "external",
+                                "justification": suppressed_by}]
+    return out
+
+
+def _sarif_doc(new, baselined) -> dict:
+    """SARIF 2.1.0: one run, every rule in the driver catalogue (ids +
+    default severity levels), new findings as plain results, baselined
+    findings as suppressed results — CI annotates the former and can
+    still audit the latter."""
+    rules = [{
+        "id": rid,
+        "shortDescription": {"text": RULES[rid]},
+        "defaultConfiguration": {"level": SEVERITY.get(rid, "warning")},
+        "helpUri": "docs/STATIC_ANALYSIS.md",
+    } for rid in sorted(RULES)]
+    results = [_sarif_result(v) for v in new]
+    results += [_sarif_result(v, suppressed_by="grandfathered in "
+                              "tools/tpulint/baseline.json")
+                for v in baselined]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri": "docs/STATIC_ANALYSIS.md",
+                "rules": rules,
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///./"}},
+            "results": results,
+        }],
+    }
+
 
 def _changed_files(base: str) -> list:
     """Root-relative python files changed vs ``base``: tracked diffs
@@ -60,7 +114,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.tpulint",
         description="JAX/TPU-aware whole-program static analysis for "
-                    "elasticsearch_tpu (rules R001-R014; see "
+                    "elasticsearch_tpu (rules R001-R016; see "
                     "docs/STATIC_ANALYSIS.md)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files or directories to lint (default: "
@@ -68,6 +122,10 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as a JSON document on stdout "
                          "(each with a per-rule severity)")
+    ap.add_argument("--sarif", action="store_true", dest="as_sarif",
+                    help="emit findings as SARIF 2.1.0 on stdout (CI PR "
+                         "annotation format); baselined findings ride "
+                         "along with a suppression entry")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="baseline file of grandfathered findings")
     ap.add_argument("--no-baseline", action="store_true",
@@ -99,7 +157,9 @@ def main(argv=None) -> int:
         if not report_only:
             # nothing can be reported — skip the project build entirely
             # (the advertised fast path must actually be fast)
-            if args.as_json:
+            if args.as_sarif:
+                print(json.dumps(_sarif_doc([], []), indent=2))
+            elif args.as_json:
                 print(json.dumps({
                     "rules": RULES, "severity": SEVERITY,
                     "violations": [], "baselined": [],
@@ -140,6 +200,9 @@ def main(argv=None) -> int:
         return 2
     new, old = filter_baselined(found, budget)
 
+    if args.as_sarif:
+        print(json.dumps(_sarif_doc(new, old), indent=2))
+        return 1 if new else 0
     if args.as_json:
         def _row(v):
             d = v.to_json()

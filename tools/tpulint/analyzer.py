@@ -50,6 +50,14 @@ RULES: Dict[str, str] = {
             "shard_map/psum collective program (reachable through the "
             "call graph) — one stalled chip stalls every chip in the "
             "mesh",
+    "R015": "lockset race: a write to an instance attribute whose "
+            "inferred (or guarded_by-declared) guarding lock is not held, "
+            "in code reachable from a thread root (Thread targets, pool "
+            "submissions, REST/transport handlers)",
+    "R016": "atomicity violation: the guard lock is released between a "
+            "guarded check of an attribute and the guarded act that "
+            "depends on it (check-then-act / get-or-create) — the state "
+            "can change in the gap",
 }
 
 # Per-rule severity, surfaced in --json for pre-commit tooling. `error`
@@ -61,7 +69,8 @@ SEVERITY: Dict[str, str] = {
     "R000": "error", "R001": "warning", "R002": "error", "R003": "error",
     "R004": "error", "R005": "error", "R006": "warning", "R007": "warning",
     "R008": "warning", "R009": "error", "R010": "error", "R011": "warning",
-    "R012": "warning", "R013": "error", "R014": "error",
+    "R012": "warning", "R013": "error", "R014": "error", "R015": "error",
+    "R016": "error",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.

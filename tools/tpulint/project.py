@@ -33,6 +33,25 @@ view that closes that blind spot:
   buried in callees), cycle detection over them, and lock-held calls
   into unbounded blocking waits (``Event.wait()`` / ``queue.get()``
   with no timeout — the R010 hazard generalized past ``serving/``).
+* **Concurrent reach (R015/R016)** — a fixpoint over the call graph
+  from the *thread roots*: ``threading.Thread`` targets, thread-pool
+  ``execute``/``submit`` arguments, REST route handlers
+  (``rc.add("GET", ..., handler)``) and transport/task ``register``
+  callbacks. Everything reachable runs (potentially) concurrently with
+  every other reachable function — the Eraser-style scope.
+* **Per-attribute locksets (R015)** — every ``self.<attr>`` access is
+  recorded with the guards (locks AND condition locks) held at it,
+  lexically plus the interprocedural *held-on-entry* context (the meet
+  over all call sites — the ``_private`` caller-locked convention made
+  precise). Intersecting guard sets across an attribute's concurrent
+  accesses infers its guarding lock (or ``# tpulint:
+  guarded_by(<lock>)`` declares it); a concurrent write without the
+  guard is R015.
+* **Atomicity (R016)** — within one function, a *read-only* guarded
+  region of an attribute followed by a later guarded write of the same
+  attribute under the same lock, with the lock released in between:
+  the check-then-act / get-or-create shape whose window a concurrent
+  writer can slip through.
 
 Everything stays stdlib-``ast``: no JAX import, no device, fast enough
 for tier-1 (the gate asserts a full-repo pass under 30s).
@@ -41,8 +60,9 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 from tools.tpulint.analyzer import (Suppressions, Violation,
                                     iter_python_files, snippet_at)
@@ -66,6 +86,36 @@ COLLECTIVE_OP_NAMES = {"psum", "all_gather", "pmean", "pmax", "pmin",
 
 _LOCK_SUFFIXES = (".Lock", ".RLock")
 _QUEUE_NAMES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+
+# Thread-root spellings (R015/R016 concurrent reach). A function-valued
+# argument at one of these call shapes runs on another thread (or on a
+# pool/handler thread concurrently with its siblings):
+#   Thread(target=f)                     -- the classic daemon loop
+#   pool.execute(f, ...) / pool.submit(f, ...)
+#                                        -- utils.threadpool submissions
+#                                           (every REST request runs here)
+#   t.register(ACTION, self._on_x) / tasks.register(..., on_cancel=f)
+#                                        -- transport handlers + cancel
+#                                           callbacks (remote/any thread)
+#   rc.add("GET", "/path", handler)      -- REST route table (dispatched
+#                                           from pool threads)
+_POOL_SUBMIT_NAMES = {"execute", "submit"}
+_REGISTER_NAMES = {"register"}
+_HTTP_METHODS = {"GET", "POST", "PUT", "DELETE", "HEAD", "OPTIONS",
+                 "PATCH"}
+# container-mutating method names: a `self.x.append(...)` is a WRITE of
+# self.x for lockset purposes (mirrors rules.MUTATOR_METHODS; kept here
+# to avoid an import cycle at module load)
+_MUTATORS = {
+    "append", "add", "update", "pop", "popitem", "clear", "remove",
+    "extend", "insert", "setdefault", "discard", "appendleft",
+    "popleft", "move_to_end",
+}
+# `# tpulint: guarded_by(self._lock)` — declares the guarding lock of
+# the instance attribute assigned on the same line (the declaration
+# site is the attribute's __init__ assignment)
+_GUARDED_BY_RE = re.compile(r"#\s*tpulint:\s*guarded_by\(\s*([A-Za-z_."
+                            r"][A-Za-z0-9_.]*)\s*\)")
 
 
 def module_name_for(relpath: str) -> str:
@@ -118,6 +168,25 @@ class CallEdge:
     args: List[Tuple[str, object]] = field(default_factory=list)
     all_dyn: bool = False
     held: Tuple[str, ...] = ()        # lock ids held at the call site
+    gheld: Tuple[str, ...] = ()       # guard ids (locks + condition
+    #                                   locks) held — the R015 context
+
+
+@dataclass
+class AttrAccess:
+    """One ``self.<attr>`` access inside a function body."""
+    attr: str
+    kind: str                         # 'r' read | 'w' write | 'm' mutate
+    #                                   (method-call write: .append/.pop —
+    #                                   reads AND writes the container)
+    line: int
+    gheld: Tuple[str, ...]            # guard ids lexically held
+    epochs: Tuple[Tuple[str, int], ...]  # (guard, region epoch) pairs —
+    #                                   the epoch bumps every time the
+    #                                   guard is fully released, so two
+    #                                   accesses under the same guard in
+    #                                   DIFFERENT epochs straddle a
+    #                                   release window (R016)
 
 
 @dataclass
@@ -133,12 +202,15 @@ class FnSymbol:
     root_all_params: bool = False     # wrapper-marked: every param traced
     is_collective_root: bool = False
     has_collective_call: bool = False
+    is_thread_root: bool = False      # R015: runs on its own/pool thread
     edges: List[CallEdge] = field(default_factory=list)
     # lock facts (with-block granularity; flow within a fn is lexical)
     acquires: List[Tuple[str, int]] = field(default_factory=list)
     lock_edges: List[Tuple[str, str, int]] = field(default_factory=list)
     direct_waits: List[Tuple[int, str]] = field(default_factory=list)
     waits_under: List[Tuple[str, int, str]] = field(default_factory=list)
+    # R015/R016: every self.<attr> access with its held-guard context
+    attr_accesses: List[AttrAccess] = field(default_factory=list)
 
 
 @dataclass
@@ -154,6 +226,12 @@ class ClassRec:
     # resolved lazily against imports — this is what lets the lock graph
     # follow `self.translog.append()` across the engine/translog boundary
     attr_types: Dict[str, str] = field(default_factory=dict)
+    # every instance attribute this class assigns anywhere (`self.x =`)
+    # — the owner-resolution universe for R015's per-attribute locksets
+    attrs: Set[str] = field(default_factory=set)
+    # attr -> (declared guard expression, declaration line) from
+    # `# tpulint: guarded_by(...)`
+    guards: Dict[str, Tuple[str, int]] = field(default_factory=dict)
 
 
 class ModuleRecord:
@@ -181,6 +259,23 @@ class ModuleRecord:
         # module-level singletons (`RESIDENCY = ResidencyRegistry()`):
         # name -> ctor chain, for `resources.RESIDENCY.track(...)` reach
         self.mod_obj_types: Dict[str, str] = {}
+        # line -> guard expression from `# tpulint: guarded_by(...)`
+        # (associated with the self.<attr> assignment on that line by
+        # the symbol collector). A standalone comment covers the first
+        # code line below it — the Suppressions block convention.
+        self.guard_lines: Dict[int, str] = {}
+        for i, text in enumerate(self.lines, start=1):
+            m = _GUARDED_BY_RE.search(text)
+            if not m:
+                continue
+            self.guard_lines.setdefault(i, m.group(1))
+            if text.lstrip().startswith("#"):
+                j = i + 1
+                while j <= len(self.lines) and (
+                        self.lines[j - 1].lstrip().startswith("#")
+                        or not self.lines[j - 1].strip()):
+                    j += 1
+                self.guard_lines.setdefault(j, m.group(1))
 
 
 def _ctor_kind(call: ast.Call) -> Optional[str]:
@@ -215,6 +310,14 @@ class ProjectIndex:
         self.lock_edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
         self.lock_cycles: List[List[str]] = []
         self.wait_violations: List[Tuple[str, int, str]] = []  # path,line,msg
+        # R015/R016 (filled by the concurrency pass):
+        self.concurrent: Set[str] = set()           # sids in thread reach
+        self.held_on_entry: Dict[str, FrozenSet[str]] = {}
+        # attr identity 'mod:Cls.attr' -> (guard id, declared?,
+        #                                  guarded count, unguarded count)
+        self.attr_guards: Dict[str, Tuple[str, bool, int, int]] = {}
+        self.race_violations: List[Tuple[str, str, int, str]] = []
+        #                       (rule, path, line, msg)
 
     # -- views keyed the way pass 2 wants them ------------------------------
 
@@ -254,6 +357,18 @@ class _SymbolCollector(ast.NodeVisitor):
         rec = ClassRec(node.name,
                        [c for c in (_attr_chain(b) for b in node.bases) if c])
         for sub in ast.walk(node):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    chain = _attr_chain(t) or ""
+                    if chain.startswith("self.") and "." not in chain[5:]:
+                        rec.attrs.add(chain[5:])
+                        lineno = getattr(sub, "lineno", 0)
+                        guard = self.rec.guard_lines.get(lineno)
+                        if guard:
+                            rec.guards.setdefault(chain[5:],
+                                                  (guard, lineno))
             if isinstance(sub, ast.Assign) and len(sub.targets) == 1 and \
                     isinstance(sub.value, ast.Call):
                 chain = _attr_chain(sub.targets[0]) or ""
@@ -441,6 +556,42 @@ class _Resolver:
             if not nxt:
                 break
             frontier = nxt
+        return None
+
+    def guard_id(self, cls_name: Optional[str],
+                 chain: Optional[str]) -> Optional[str]:
+        """Guard id for an expression chain: a known lock OR Condition
+        (``with self._cv:`` acquires the condition's lock, so it guards
+        state exactly like a bare lock for R015/R016 lockset purposes).
+        Same id namespace as the R013 lock ids."""
+        if not chain:
+            return None
+        if chain.startswith("self.") and "." not in chain[5:]:
+            attr = chain[5:]
+            for kind in ("locks", "conds"):
+                owner = self.owner_class_of_attr(cls_name, kind, attr)
+                if owner is not None:
+                    return f"{owner[0]}:{owner[1]}.{attr}"
+            return None
+        parts = chain.split(".")
+        if len(parts) == 1:
+            if chain in self.rec.mod_locks or chain in self.rec.mod_conds:
+                return f"{self.rec.modname}:{chain}"
+            bound = self.rec.imports.get(chain)
+            if bound and bound[0] == "symbol":
+                target = self.index.records.get(bound[1])
+                if target is not None and (bound[2] in target.mod_locks
+                                           or bound[2] in target.mod_conds):
+                    return f"{target.modname}:{bound[2]}"
+            return None
+        bound = self.rec.imports.get(parts[0])
+        if bound and bound[0] == "module":
+            full = bound[1].split(".") + parts[1:]
+            mod, name = ".".join(full[:-1]), full[-1]
+            target = self.index.records.get(mod)
+            if target is not None and (name in target.mod_locks
+                                       or name in target.mod_conds):
+                return f"{target.modname}:{name}"
         return None
 
     def resolve_chain(self, chain: str) -> Optional[str]:
@@ -705,6 +856,13 @@ class _BodyWalker(ast.NodeVisitor):
         self.sym = sym
         self.res = res
         self.held: List[str] = []
+        # guard stack for R015/R016: locks AND condition locks (R013's
+        # `held` stays locks-only so the lock graph is unchanged)
+        self.gheld: List[str] = []
+        # guard -> release count: bumps when the guard is FULLY released,
+        # so accesses in different epochs straddle a release window
+        self.epoch: Dict[str, int] = {}
+        self._sync_memo: Dict[str, bool] = {}
         self.aliases: Dict[str, str] = {}   # local name -> sid
         self.nonstatic: Set[str] = _nonstatic_locals(rec, sym)
 
@@ -781,6 +939,84 @@ class _BodyWalker(ast.NodeVisitor):
                 return f"{target.modname}:{name}"
         return None
 
+    def _guard_id(self, expr: ast.AST) -> Optional[str]:
+        return self.res.guard_id(self.sym.cls, _attr_chain(expr))
+
+    # -- R015/R016 attribute-access recording --------------------------------
+
+    def _is_sync_attr(self, attr: str) -> bool:
+        """self.<attr> is itself a lock/cond/event/queue (a
+        synchronization object, not guarded data) or a method of the
+        class (a code reference, not mutable state)."""
+        cached = self._sync_memo.get(attr)
+        if cached is None:
+            cached = any(
+                self.res.resolve_attr_objects(self.sym.cls, k, attr)
+                for k in ("locks", "conds", "events", "queues")) or \
+                self.res.resolve_self_attr(self.sym.cls, attr) is not None
+            self._sync_memo[attr] = cached
+        return cached
+
+    def _record_access(self, attr: str, kind: str, line: int) -> None:
+        if self.sym.cls is None or self._is_sync_attr(attr):
+            return
+        gheld = tuple(dict.fromkeys(self.gheld))
+        epochs = tuple((g, self.epoch.get(g, 0)) for g in gheld)
+        self.sym.attr_accesses.append(
+            AttrAccess(attr, kind, line, gheld, epochs))
+
+    @staticmethod
+    def _self_attr_base(t: ast.AST) -> Optional[str]:
+        """X for ``self.X`` / ``self.X[...]`` / ``self.X.y`` chains —
+        the attribute whose object a store/mutator call touches."""
+        base = t
+        while isinstance(base, (ast.Subscript, ast.Attribute)):
+            if isinstance(base, ast.Attribute) and \
+                    _name(base.value) == "self":
+                return base.attr
+            base = base.value
+        return None
+
+    def _record_targets(self, t: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._record_targets(e)
+            return
+        if isinstance(t, ast.Starred):
+            self._record_targets(t.value)
+            return
+        attr = self._self_attr_base(t)
+        if attr is not None:
+            self._record_access(attr, "w", getattr(t, "lineno", 0))
+        # subscript indices are reads (`self.d[self.k] = v` reads self.k)
+        node = t
+        while isinstance(node, (ast.Subscript, ast.Attribute)):
+            if isinstance(node, ast.Subscript):
+                self.visit(node.slice)
+            node = node.value
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and isinstance(node.ctx, ast.Load):
+            self._record_access(node.attr, "r", node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_targets(node.target)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._record_targets(node.target)
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            attr = self._self_attr_base(t)
+            if attr is not None:
+                self._record_access(attr, "w", node.lineno)
+        self.generic_visit(node)
+
     def _is_known(self, expr: ast.AST, kind: str) -> bool:
         """Receiver resolves to a known event/queue/cond object."""
         chain = _attr_chain(expr)
@@ -822,10 +1058,13 @@ class _BodyWalker(ast.NodeVisitor):
                     self.aliases[tgt] = sid
                 else:
                     self.aliases.pop(tgt, None)
+        for t in node.targets:
+            self._record_targets(t)
         self.visit(node.value)
 
     def visit_With(self, node: ast.With) -> None:
         ids = []
+        gids = []
         for item in node.items:
             self.visit(item.context_expr)
             lid = self._lock_id(item.context_expr)
@@ -836,10 +1075,20 @@ class _BodyWalker(ast.NodeVisitor):
                 self.sym.acquires.append((lid, node.lineno))
                 self.held.append(lid)
                 ids.append(lid)
+            gid = self._guard_id(item.context_expr)
+            if gid is not None and gid not in self.gheld:
+                self.gheld.append(gid)
+                gids.append(gid)
         for stmt in node.body:
             self.visit(stmt)
         for _ in ids:
             self.held.pop()
+        for gid in reversed(gids):
+            self.gheld.remove(gid)
+            if gid not in self.gheld:
+                # fully released: later regions on this guard are a NEW
+                # epoch — an R016 window opens here
+                self.epoch[gid] = self.epoch.get(gid, 0) + 1
 
     visit_AsyncWith = visit_With
 
@@ -913,6 +1162,33 @@ class _BodyWalker(ast.NodeVisitor):
             return "queue.get()"
         return None
 
+    def _mark_thread_roots(self, node: ast.Call, base: str) -> None:
+        """R015 concurrent reach: function-valued arguments at the
+        thread-root spellings run on their own thread / a pool thread /
+        a transport or cancel callback thread."""
+        cands: List[ast.AST] = []
+        if base == "Thread":
+            tkw = next((kw.value for kw in node.keywords
+                        if kw.arg == "target"), None)
+            if tkw is not None:
+                cands.append(tkw)
+        elif base in _POOL_SUBMIT_NAMES and \
+                isinstance(node.func, ast.Attribute):
+            cands.extend(node.args)
+        elif base in _REGISTER_NAMES and \
+                isinstance(node.func, ast.Attribute):
+            cands.extend(node.args)
+            cands.extend(kw.value for kw in node.keywords)
+        elif base == "add" and isinstance(node.func, ast.Attribute) \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and node.args[0].value in _HTTP_METHODS:
+            cands.extend(node.args[1:])
+        for a in cands:
+            if isinstance(a, (ast.Name, ast.Attribute)):
+                asid = self._resolve_callable(a)
+                if asid is not None and asid in self.res.index.symbols:
+                    self.res.index.symbols[asid].is_thread_root = True
+
     def visit_Call(self, node: ast.Call) -> None:
         sid = self._resolve_callable(node.func)
         chain = _attr_chain(node.func) or ""
@@ -925,7 +1201,15 @@ class _BodyWalker(ast.NodeVisitor):
                 args, all_dyn = self._map_args(node, callee, drop_self)
                 self.sym.edges.append(CallEdge(
                     sid, "call", node.lineno, args, all_dyn,
-                    tuple(self.held)))
+                    tuple(self.held), tuple(dict.fromkeys(self.gheld))))
+        # container-mutating method on self.<attr>: a WRITE of the attr
+        # for lockset purposes (popitem/move_to_end/append/...)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            mattr = self._self_attr_base(node.func.value)
+            if mattr is not None:
+                self._record_access(mattr, "m", node.lineno)
+        self._mark_thread_roots(node, base)
         # wrapper-marked roots: function-valued args get traced/collective
         if base in TRACED_WRAPPER_NAMES:
             for a in list(node.args) + [kw.value for kw in node.keywords]:
@@ -1031,6 +1315,262 @@ def _collective_fixpoint(index: ProjectIndex) -> None:
                 seen.add(e.callee)
                 work.append(e.callee)
     index.collective = seen
+
+
+def _concurrent_fixpoint(index: ProjectIndex) -> None:
+    """CONCURRENT-REACH: everything transitively reachable (call or ref
+    edges) from a thread root runs on a non-main thread — or on a pool/
+    handler thread concurrently with its siblings. This is the scope in
+    which an unguarded write can actually race (R015/R016)."""
+    roots = {sid for sid, s in index.symbols.items() if s.is_thread_root}
+    seen = set(roots)
+    work = list(roots)
+    while work:
+        sid = work.pop()
+        sym = index.symbols.get(sid)
+        if sym is None:
+            continue
+        for e in sym.edges:
+            if e.callee in index.symbols and e.callee not in seen:
+                seen.add(e.callee)
+                work.append(e.callee)
+    index.concurrent = seen
+
+
+def _held_entry_fixpoint(index: ProjectIndex) -> None:
+    """Guards held ON ENTRY to each function: the meet (intersection)
+    over every call site of (caller's entry context ∪ guards lexically
+    held at the call). This is the `_private helpers run caller-locked`
+    convention made precise — a helper whose EVERY caller holds the lock
+    counts as guarded; one unlocked call site and the guarantee is gone.
+    Thread roots and ref-edge targets (callbacks — invocation context
+    unknown) enter with nothing held."""
+    incoming: Dict[str, List[Tuple[str, Tuple[str, ...], str]]] = {}
+    for sid, sym in index.symbols.items():
+        for e in sym.edges:
+            if e.callee in index.symbols:
+                incoming.setdefault(e.callee, []).append(
+                    (sid, e.gheld, e.kind))
+    # None = ⊤ (no call site resolved yet); sets only ever shrink
+    H: Dict[str, Optional[FrozenSet[str]]] = {}
+    for sid, sym in index.symbols.items():
+        if sym.is_thread_root or sid not in incoming:
+            H[sid] = frozenset()
+        else:
+            H[sid] = None
+    changed = True
+    while changed:
+        changed = False
+        for sid in index.symbols:
+            cur = H[sid]
+            if cur == frozenset():
+                continue  # already at the lattice bottom
+            contribs: List[FrozenSet[str]] = []
+            for caller, gheld, kind in incoming.get(sid, ()):
+                if kind == "ref":
+                    contribs.append(frozenset())
+                    continue
+                hc = H.get(caller)
+                if hc is None:
+                    continue  # unknown caller: optimistic, re-met later
+                contribs.append(hc | frozenset(gheld))
+            if not contribs:
+                continue
+            new = frozenset.intersection(*contribs)
+            if cur is not None:
+                new &= cur
+            if new != cur:
+                H[sid] = new
+                changed = True
+    index.held_on_entry = {sid: (h if h is not None else frozenset())
+                           for sid, h in H.items()}
+
+
+_INIT_FNS = ("__init__", "__new__")
+
+
+def _is_init_qual(qual: str) -> bool:
+    return any(part in _INIT_FNS for part in qual.split("."))
+
+
+def _race_analysis(index: ProjectIndex) -> None:
+    """Eraser-style per-attribute lockset inference + the two findings:
+
+    R015 — a concurrent, non-__init__ WRITE to an attribute whose guard
+    (declared via ``# tpulint: guarded_by(...)``, or inferred as the
+    lock held at the majority of the attribute's concurrent accesses,
+    minimum two guarded sites) is not held at the write.
+
+    R016 — within one concurrent function, a read-ONLY guarded region
+    of the attribute followed by a later guarded write under the same
+    lock with the lock released in between: check-then-act with a
+    window a concurrent writer can slip through.
+
+    __init__/__new__ accesses never count (the object has not been
+    published yet — the init-before-publish precision rule), accesses
+    outside concurrent reach never count (nothing to race with), and
+    sync-object attributes were excluded at record time."""
+    resolvers = {m: _Resolver(index, rec)
+                 for m, rec in index.records.items()}
+    H = index.held_on_entry
+    conc = index.concurrent
+    strength = {"r": 0, "w": 1, "m": 2}
+    # site-level dedup: one record per (fn, attr, line), strongest kind
+    # wins — the Attribute read under a same-line write/mutator is the
+    # same access, not extra evidence
+    sites: Dict[Tuple[str, str, int], Tuple[FnSymbol, AttrAccess]] = {}
+    for sid, sym in index.symbols.items():
+        if sym.cls is None:
+            continue
+        for acc in sym.attr_accesses:
+            key = (sid, acc.attr, acc.line)
+            prev = sites.get(key)
+            if prev is None or strength[acc.kind] > strength[prev[1].kind]:
+                sites[key] = (sym, acc)
+
+    owner_memo: Dict[Tuple[str, Optional[str], str],
+                     Tuple[str, str]] = {}
+
+    def owner_of(sym: FnSymbol, attr: str) -> Tuple[str, str]:
+        key = (sym.module, sym.cls, attr)
+        got = owner_memo.get(key)
+        if got is None:
+            o = resolvers[sym.module].owner_class_of_attr(
+                sym.cls, "attrs", attr)
+            got = o if o is not None else (sym.module, sym.cls or "")
+            owner_memo[key] = got
+        return got
+
+    # 1. group non-init accesses by attribute identity. Guard INFERENCE
+    # counts evidence from every access (a lock discipline is a
+    # discipline wherever it is exercised); the unguarded-majority
+    # denominator and the R015/R016 findings only consider CONCURRENT
+    # accesses — nothing races on a single-threaded path
+    entries: Dict[Tuple[str, str, str],
+                  List[Tuple[FnSymbol, AttrAccess, FrozenSet[str],
+                             bool]]] = {}
+    for (sid, _attr, _line), (sym, acc) in sites.items():
+        if _is_init_qual(sym.qual):
+            continue
+        ident = owner_of(sym, acc.attr) + (acc.attr,)
+        lockset = frozenset(H.get(sid, frozenset())) | frozenset(acc.gheld)
+        entries.setdefault(ident, []).append(
+            (sym, acc, lockset, sid in conc))
+
+    # 2. per-attribute guard: declared beats inferred; inference wants a
+    # majority discipline (>= 2 guarded sites, more guarded sites than
+    # concurrent unguarded ones)
+    guards: Dict[Tuple[str, str, str], Tuple[str, bool, int, int]] = {}
+    for ident, rows in entries.items():
+        omod, ocls, attr = ident
+        declared = None
+        orec = index.records.get(omod)
+        crec = orec.classes.get(ocls) if orec is not None else None
+        if crec is not None and attr in crec.guards:
+            gexpr, gline = crec.guards[attr]
+            declared = resolvers[omod].guard_id(ocls, gexpr)
+            if declared is None:
+                # a silent fall-through to inference would let a typo'd
+                # declaration weaken the discipline the author believes
+                # is gate-enforced — surface it where it is written
+                index.race_violations.append((
+                    "R015", orec.path, gline,
+                    f"`# tpulint: guarded_by({gexpr})` on `self.{attr}` "
+                    f"does not resolve to a known lock or Condition of "
+                    f"`{ocls}` (typo? renamed lock? the guard must be a "
+                    "`threading.Lock`/`RLock`/`Condition` assigned as "
+                    "`self.<attr>` in this class or a module-level "
+                    "lock) — fix the expression or remove the "
+                    "annotation"))
+        if declared is not None:
+            held = sum(1 for _s, _a, ls, _c in rows if declared in ls)
+            guards[ident] = (declared, True, held, len(rows) - held)
+            continue
+        counts: Dict[str, int] = {}
+        for _s, _a, ls, _c in rows:
+            for g in ls:
+                counts[g] = counts.get(g, 0) + 1
+        if not counts:
+            continue
+        best = max(sorted(counts), key=lambda g: counts[g])
+        cnt = counts[best]
+        unguarded = sum(1 for _s, _a, ls, c in rows
+                        if c and best not in ls)
+        if cnt >= 2 and cnt > unguarded:
+            guards[ident] = (best, False, cnt, unguarded)
+    index.attr_guards = {f"{m}:{c}.{a}": v
+                         for (m, c, a), v in guards.items()}
+
+    # 3. R015: concurrent writes without the guard
+    out = index.race_violations
+    for ident, (g, declared, cnt, uncnt) in sorted(guards.items()):
+        omod, ocls, attr = ident
+        for sym, acc, ls, is_conc in entries[ident]:
+            if not is_conc or acc.kind not in ("w", "m") or g in ls:
+                continue
+            path = index.records[sym.module].path
+            how = ("declared via `# tpulint: guarded_by(...)`" if declared
+                   else f"held at {cnt} other access"
+                        f"{'' if cnt == 1 else 'es'}")
+            out.append((
+                "R015", path, acc.line,
+                f"write to `self.{attr}` (of `{omod}:{ocls}`) without its "
+                f"guarding lock `{g}` ({how}) in thread-reachable code — "
+                "a concurrent holder of the lock can interleave and the "
+                "write is lost or torn; wrap it in `with <lock>:`, or "
+                "justify with `# tpulint: allow[R015]` / declare a "
+                "different discipline with `# tpulint: guarded_by(...)`"))
+
+    # 4. R016: check-then-act across a release window, per function
+    per_fn: Dict[Tuple[str, str], List[AttrAccess]] = {}
+    for (sid, attr, _line), (sym, acc) in sites.items():
+        if sid in conc and not _is_init_qual(sym.qual):
+            per_fn.setdefault((sid, attr), []).append(acc)
+    for (sid, attr), accs in sorted(per_fn.items()):
+        sym = index.symbols[sid]
+        ident = owner_of(sym, attr) + (attr,)
+        ginfo = guards.get(ident)
+        if ginfo is None:
+            continue
+        g = ginfo[0]
+        reads: Dict[int, List[AttrAccess]] = {}
+        writes: Dict[int, List[AttrAccess]] = {}
+        for acc in accs:
+            em = dict(acc.epochs)
+            if g not in em:
+                continue
+            (reads if acc.kind == "r" else writes).setdefault(
+                em[g], []).append(acc)
+        for e1 in sorted(reads):
+            if e1 in writes:
+                continue  # check and act under ONE hold: atomic, legal
+            later = []
+            for e2 in writes:
+                if e2 <= e1:
+                    continue
+                wline = min(a.line for a in writes[e2])
+                # an act region that RE-READS the attribute under the
+                # lock before writing is the re-validate idiom — only a
+                # BLIND write acts on the stale check
+                if any(a.line <= wline for a in reads.get(e2, ())):
+                    continue
+                later.append(e2)
+            if not later:
+                continue
+            racc = min(reads[e1], key=lambda a: a.line)
+            wacc = min(writes[min(later)], key=lambda a: a.line)
+            path = index.records[sym.module].path
+            out.append((
+                "R016", path, wacc.line,
+                f"`{g.rpartition(':')[2]}` is released between the "
+                f"guarded check of `self.{attr}` (line {racc.line}) and "
+                "this guarded act on it — the state can change in the "
+                "window, so two threads both pass the check "
+                "(check-then-act / get-or-create); hold the lock across "
+                "both, or re-validate under the lock before acting "
+                "(`# tpulint: allow[R016]` with a justification if the "
+                "gap is intended)"))
+            break
 
 
 def _lock_analysis(index: ProjectIndex) -> None:
@@ -1239,6 +1779,9 @@ def analyze_sources(sources: Dict[str, str],
     _traced_fixpoint(index)
     _collective_fixpoint(index)
     _lock_analysis(index)
+    _concurrent_fixpoint(index)
+    _held_entry_fixpoint(index)
+    _race_analysis(index)
     return index, errors
 
 
@@ -1266,6 +1809,10 @@ def _project_violations(index: ProjectIndex) -> List[Violation]:
     for path, line, msg in index.wait_violations:
         rec = index.by_path.get(path)
         out.append(Violation("R013", path, line, 0, msg,
+                             snippet_at(rec.lines, line) if rec else ""))
+    for rule, path, line, msg in index.race_violations:
+        rec = index.by_path.get(path)
+        out.append(Violation(rule, path, line, 0, msg,
                              snippet_at(rec.lines, line) if rec else ""))
     return out
 
