@@ -507,6 +507,133 @@ def run_cold_start(args) -> dict:
     return out
 
 
+# sharded_qtf child: one process per side so the scatter side can never
+# ride programs the mesh side compiled (and vice versa), and so the
+# 8-device CPU mesh emulation (XLA_FLAGS) binds before jax initializes.
+_QTF_CHILD = '''
+import json, os, random, sys, time
+import numpy as np
+
+mode, batches = sys.argv[1], json.loads(sys.argv[2])
+docs, reps = int(sys.argv[3]), int(sys.argv[4])
+if mode == "scatter":
+    os.environ["ESTPU_DISABLE_MESH"] = "1"
+from elasticsearch_tpu.monitor import kernels, programs
+from elasticsearch_tpu.node import Node
+
+WORDS = [f"w{i}" for i in range(32)]
+n = Node()
+n.create_index("sq", {"settings": {"number_of_shards": 8},
+                      "mappings": {"properties": {
+                          "body": {"type": "text"}}}})
+svc = n.indices["sq"]
+rng = random.Random(13)
+for i in range(docs):
+    svc.index_doc(str(i), {"body": " ".join(rng.choices(WORDS, k=8))})
+svc.refresh()
+
+def make_bodies(q):
+    r = random.Random(100 + q)
+    return [{"query": {"match": {"body": " ".join(
+        r.sample(WORDS, r.randint(1, 3)))}}, "size": 10}
+        for _ in range(q)]
+
+def prog_key_counts():
+    return {(e["program"], e["shapes"]):
+            (e["compiles"], e["calls"],
+             e["compile_seconds"], e["execute_seconds"])
+            for e in programs.REGISTRY.snapshot()}
+
+out = {}
+for q in batches:
+    pairs = [({"index": "sq"}, b) for b in make_bodies(q)]
+    n.msearch(pairs)  # warm the shape class: compile stays out of timing
+    before = prog_key_counts()
+    kernels.reset()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        n.msearch(pairs)
+        times.append(time.perf_counter() - t0)
+    progs = {}
+    for key, (c, x, cs, xs) in prog_key_counts().items():
+        b = before.get(key, (0, 0, 0.0, 0.0))
+        if (c, x) != (b[0], b[1]):
+            progs["|".join(key)] = {
+                "compiles": c - b[0], "executes": x - b[1],
+                "compile_s": round(cs - b[2], 4),
+                "execute_s": round(xs - b[3], 4)}
+    snap = kernels.snapshot()
+    out[str(q)] = {
+        "wall_ms_per_batch": round(1000 * float(np.mean(times)), 3),
+        "wall_ms_per_query": round(1000 * float(np.mean(times)) / q, 3),
+        "kernels": {k: v for k, v in sorted(snap.items())
+                    if "mesh" in k or "bm25" in k},
+        "programs": progs}
+print("RESULT " + json.dumps({
+    "mode": mode, "batch": out,
+    "backend": programs.backend_fingerprint()}))
+n.close()
+'''
+
+
+def run_sharded_qtf(args) -> dict:
+    """Mesh-collective query-then-fetch A/B (ISSUE 16): a coalesced
+    msearch batch over an 8-shard index served by ONE shard_map device
+    program per batch (mesh) vs the per-shard serial scatter loop
+    (ESTPU_DISABLE_MESH=1), at batch sizes 1/16/64. Each side runs in
+    its own process on the emulated 8-device mesh; the record carries
+    per-program compile/execute deltas and honest backend labels. The
+    acceptance wants mesh beating serial scatter at batch >= 16."""
+    stage("sharded-qtf")
+    batches = [1, 16, 64]
+    docs = 4096
+
+    def child(mode):
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+        beat()
+        p = subprocess.run(
+            [sys.executable, "-c", _QTF_CHILD, mode, json.dumps(batches),
+             str(docs), "5"],
+            capture_output=True, text=True, timeout=600, env=env)
+        beat()
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"sharded_qtf child [{mode}] rc={p.returncode}: "
+                f"{p.stderr.strip()[-400:]}")
+        lines = [ln for ln in p.stdout.splitlines()
+                 if ln.startswith("RESULT ")]
+        return json.loads(lines[-1][len("RESULT "):]) if lines else {}
+
+    log(f"sharded_qtf: 8 shards, {docs} docs, batches {batches}, "
+        "mesh vs serial scatter (one process each)")
+    mesh = child("mesh")
+    scatter = child("scatter")
+    out = {
+        "shards": 8,
+        "docs": docs,
+        "batches": batches,
+        "backend": mesh.get("backend", "unknown"),
+        "mesh": mesh.get("batch", {}),
+        "scatter": scatter.get("batch", {}),
+    }
+    speedup = {}
+    for q in batches:
+        m = out["mesh"].get(str(q), {}).get("wall_ms_per_batch")
+        s = out["scatter"].get(str(q), {}).get("wall_ms_per_batch")
+        if m and s:
+            speedup[str(q)] = round(s / m, 2)
+        log(f"sharded_qtf: batch={q} mesh {m} ms vs scatter {s} ms "
+            f"-> {speedup.get(str(q))}x")
+    out["speedup"] = speedup
+    out["mesh_wins_at_16"] = bool(speedup.get("16", 0) > 1.0)
+    PARTIAL["sharded_qtf"] = out
+    return out
+
+
 def bm25_product_latency(node, queries, k, runs=3):
     """Per-query Node.search wall time (the full product path)."""
     bodies = [{"query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
@@ -790,10 +917,10 @@ def main():
                          "legitimately run longer")
     args = ap.parse_args()
     scenarios = {s.strip() for s in args.scenarios.split(",") if s.strip()}
-    unknown = scenarios - {"core", "cold_start"}
+    unknown = scenarios - {"core", "cold_start", "sharded_qtf"}
     if unknown or not scenarios:
         ap.error(f"unknown --scenarios {sorted(unknown)}; "
-                 "choose from: core, cold_start")
+                 "choose from: core, cold_start, sharded_qtf")
 
     backend, backend_err = resolve_backend(probe_timeout=args.probe_timeout)
     if backend == "cpu-fallback":
@@ -847,6 +974,9 @@ def main():
     compile_heavy = ("batched-msearch", "batched-msearch-mixed",
                      "batched-msearch-bf16", "batched-msearch-xla-ab",
                      "knn-batched-mfu",
+                     # sharded_qtf children compile the Q=64 shard_map
+                     # program cold in their own processes
+                     "sharded-qtf",
                      # the 1M-vec IVF build (kmeans at freeze) runs
                      # minutes un-beaten on the CPU-sanity path
                      "ivf-recall-curve")
@@ -899,6 +1029,19 @@ def main():
                     "unit": "x",
                     "vs_baseline": cold.get("p99_improvement", 0.0),
                     "target_met": bool(cold.get("zero_warmup_met")),
+                    "stage_backends": PARTIAL.get("stage_backends", {}),
+                })
+        if "sharded_qtf" in scenarios:
+            qtf = run_sharded_qtf(args)
+            payload["sharded_qtf"] = qtf
+            if scenarios == {"sharded_qtf"}:
+                # standalone: the headline is batch-16 mesh vs scatter
+                payload.update({
+                    "metric": "sharded_qtf_speedup_batch16",
+                    "value": qtf.get("speedup", {}).get("16", 0.0),
+                    "unit": "x",
+                    "vs_baseline": qtf.get("speedup", {}).get("16", 0.0),
+                    "target_met": bool(qtf.get("mesh_wins_at_16")),
                     "stage_backends": PARTIAL.get("stage_backends", {}),
                 })
     except Exception:

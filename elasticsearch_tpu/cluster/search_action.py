@@ -2081,6 +2081,38 @@ class DistributedDataService:
                     pass           # recording never fails a search
         return resp
 
+    def _mesh_all_local(self, index: str, svc, body: dict,
+                        t0: float) -> Optional[dict]:
+        """ISSUE 16: mesh-collective query-then-fetch for the co-resident
+        case — every shard owner is this node, so the coordinator hands
+        the whole request to the shard-mesh product path (one shard_map
+        program per segment round: per-shard scoring, per-shard top-k,
+        on-device all_gather + global merge, psum'd totals/agg counts)
+        and TCP is demoted to control plane. Any refusal — unsupported
+        body feature, breaker denial, compile rejection — returns None
+        and the serial scatter loop serves the request unchanged."""
+        from elasticsearch_tpu.monitor import kernels
+
+        if not getattr(svc, "_mesh_enabled", lambda: False)():
+            return None
+        try:
+            searchers = [g.reader().searcher for g in svc.groups]
+            from elasticsearch_tpu.parallel.mesh_service import \
+                try_mesh_search
+
+            with self.node.tracer.span("shard.query_phase.mesh",
+                                       index=index):
+                resp = try_mesh_search(svc, searchers, body)
+        except Exception:  # tpulint: allow[R006] — the scatter loop is
+            kernels.record("dist_mesh_error")  # the reference path; any
+            return None                        # mesh failure degrades
+        if resp is None:
+            kernels.record("dist_mesh_fallback")
+            return None
+        kernels.record("dist_mesh_search")
+        resp["took"] = int((time.perf_counter() - t0) * 1000)
+        return resp
+
     def _search_inner(self, index: str, body: Optional[dict]) -> dict:
         from elasticsearch_tpu.search.aggregations.base import (parse_aggs,
                                                                 reduce_aggs)
@@ -2165,6 +2197,19 @@ class DistributedDataService:
         failed: List[dict] = list(unassigned)
         owner_order = {nid: i for i, nid in enumerate(sorted(by_owner))}
         svc = self.node.indices.get(index)
+        # ISSUE 16 mesh preference: when every shard's primary owner is
+        # THIS node (co-resident on one mesh), the whole query phase runs
+        # as one compiled device program per segment round instead of the
+        # serial per-shard scatter below. TCP remains the control plane —
+        # metadata/assignment above, remote fetch and the scatter loop as
+        # the unconditional fallback (scroll and suggest keep the scatter
+        # path: their post-merge machinery lives there).
+        if (svc is not None and by_owner and not unassigned
+                and not scroll and not body.get("suggest")
+                and set(by_owner) == {local_id}):
+            resp = self._mesh_all_local(index, svc, body, t0)
+            if resp is not None:
+                return resp
         from elasticsearch_tpu.tracing import check_cancelled
 
         try:

@@ -219,9 +219,14 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
         key = (index.C, index.Lmax, D, nprobe, metric, index.metric, sf)
         prog = _PROGRAMS.get(key)
         if prog is None:
+            from elasticsearch_tpu.parallel import aot
+
             prog = make_ivf_search(index.C, index.Lmax, D, nprobe, metric,
                                    quantizer_metric=index.metric,
                                    scatter_free=sf)
+            # factory-key discipline (ROADMAP #6): the kernel entry rides
+            # the AOT blob cache like every executor program
+            prog = aot.wrap(prog, "ivf_search", key)
             _PROGRAMS[key] = prog
         # observatory: kernel-entry dispatch time on the shape-class key
         with REGISTRY.timed("ivf_search",
@@ -252,6 +257,15 @@ def ivf_candidate_scores(index: IvfIndex, vecs, query_np: np.ndarray,
                 pq_meta=((pq.M, pq.K, pq.dsub, pq.metric)
                          if pq is not None else None),
                 use_filter=use_filter, adc_tile=tile)
+            if not tile:
+                # the Pallas-tiled variant keeps its eager first-call
+                # latch (Mosaic lowering may fail on device); only the
+                # XLA shape classes ride the AOT blob cache
+                from elasticsearch_tpu.parallel import aot
+
+                prog = aot.wrap(
+                    prog, "ivf_pq_search" if pq is not None else "ivf_search",
+                    key)
             _PROGRAMS[key] = prog
         args = [q, index.centroids, index.lists, vecs]
         if pq is not None:

@@ -153,6 +153,11 @@ def _encode_program(M: int, dsub: int):
         aff = aff - 0.5 * jnp.sum(codebooks * codebooks, axis=-1)[None, :, :]
         return jnp.argmax(aff, axis=2).astype(jnp.uint8)
 
+    # factory-key discipline (ROADMAP #6): the encoder rides the AOT
+    # blob cache so a restarted node re-encodes without recompiling
+    from elasticsearch_tpu.parallel import aot
+
+    run = aot.wrap(run, "pq_encode", key)
     _ENCODE_PROGRAMS[key] = run
     return run
 

@@ -193,16 +193,20 @@ class AotProgram:
     def jitted(self):
         return self._fn
 
-    def __call__(self, *args):
+    def __call__(self, *args, **kw):
+        # kw: STATIC keyword arguments only (static_argnames of the
+        # wrapped jit — ints/strings/bools). They join the arg sig (the
+        # memo/blob key) and are baked at lowering time, so the
+        # Compiled executable is invoked with the dynamic args alone.
         from elasticsearch_tpu.monitor.programs import shape_sig
 
-        sig = shape_sig(args)
+        sig = shape_sig(args, kw) if kw else shape_sig(args)
         with self._lock:
             compiled = self._memo.get(sig)
         if compiled is None:
-            compiled = self._resolve(sig, args)
+            compiled = self._resolve(sig, args, kw)
         if compiled is None:
-            return self._fn(*args)
+            return self._fn(*args, **kw)
         try:
             return compiled(*args)
         except (TypeError, ValueError):
@@ -232,11 +236,11 @@ class AotProgram:
                     blob_key(self.program, self._key_digest, sig), _EXT)
             except Exception:
                 pass  # best-effort: the latch already protects this run
-            return self._fn(*args)
+            return self._fn(*args, **kw)
 
     # -- resolution ----------------------------------------------------------
 
-    def _resolve(self, sig: str, args: tuple):
+    def _resolve(self, sig: str, args: tuple, kw: Optional[dict] = None):
         if not _enabled():
             return None
         with self._lock:
@@ -254,7 +258,7 @@ class AotProgram:
             key = blob_key(self.program, self._key_digest, sig)
             compiled = self._load(key, args)
             if compiled is None:
-                compiled = self._compile_and_store(key, sig, args)
+                compiled = self._compile_and_store(key, sig, args, kw)
         except Exception:
             compiled = None
         with self._lock:
@@ -311,7 +315,8 @@ class AotProgram:
                 and payload.get("jax") == jax.__version__
                 and payload.get("host") == _host_component())
 
-    def _compile_and_store(self, key: str, sig: str, args: tuple):
+    def _compile_and_store(self, key: str, sig: str, args: tuple,
+                           kw: Optional[dict] = None):
         """Fresh AOT compile (classified fresh vs xla_dir_hit by the
         persistent-dir event delta), then best-effort serialize+store —
         a persistence failure costs the next process a compile, never
@@ -321,7 +326,7 @@ class AotProgram:
         _ensure_listener()
         hits0 = _xla_hits()
         t0 = time.perf_counter()
-        compiled = self._fn.lower(*args).compile()
+        compiled = self._fn.lower(*args, **(kw or {})).compile()
         compile_cache.seconds("compile", time.perf_counter() - t0)
         source = "xla_dir_hit" if _xla_hits() > hits0 else "fresh"
         compile_cache.event(source)

@@ -562,13 +562,20 @@ class MeshSearchExecutor:
     # -- BM25 ---------------------------------------------------------------
 
     def search_terms(self, field: str, query_terms: List[List[Tuple[str, float]]],
-                     k: int = 10):
+                     k: int = 10, shards=None):
         """query_terms: per query, list of (term, boost). Returns
         (vals [Q,k], shard [Q,k], local [Q,k], seg_ord [Q,k], totals [Q])
         merged across every segment round; (shard, seg_ord, local) addresses
-        a doc as (originating shard, segment ordinal within it, local id)."""
+        a doc as (originating shard, segment ordinal within it, local id).
+
+        ``shards`` overrides the live shard list with a caller-held
+        snapshot (per-shard segment lists), the way search_dsl takes one:
+        the mesh query-then-fetch path must score exactly the reader
+        snapshot it will fetch from."""
         merged = None
-        for row in self._segment_rounds():
+        rows = (self._segment_rounds() if shards is None
+                else self._rounds_for(list(shards)))
+        for row in rows:
             out = self._search_round(field, query_terms, row, k)
             merged = out if merged is None else _merge_rounds(merged, out, k)
         return merged

@@ -43,6 +43,94 @@ def try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[d
     return resp
 
 
+def try_mesh_msearch(svc, searchers, queries, k: int):
+    """Batched (coalesced-bucket) QUERY phase over the shard mesh: every
+    query of the batch scores on every shard inside ONE shard_map program
+    per segment round — per-shard BM25, per-shard ``lax.top_k``,
+    on-device ``all_gather`` + global merge, psum'd totals — instead of
+    the per-searcher × per-segment host loop. ISSUE 16's batching ×
+    sharding product: the coalescer's fused buckets hand their whole
+    batch here first.
+
+    Returns ``(cands, totals)`` in search/batch.py's accumulator format
+    — ``cands[qi]`` a list of ``(score, shard_pos, segment, local_id)``
+    holding each query's global top-``k`` survivors — or None, in which
+    case the caller falls back to the fused host tiers (same results,
+    per-shard sequential execution). Fetch, paging, and response
+    assembly stay with the caller so both paths share one code path
+    byte-for-byte."""
+    from elasticsearch_tpu.monitor import kernels
+
+    out = _try_mesh_msearch(svc, searchers, queries, k)
+    kernels.record("mesh_msearch" if out is not None
+                   else "mesh_msearch_fallback")
+    return out
+
+
+def _try_mesh_msearch(svc, searchers, queries, k: int):
+    from elasticsearch_tpu.utils.errors import CircuitBreakingException
+
+    if len(searchers) < 2 or k < 1:
+        return None  # one shard: the fused host tier already is one program
+    executor = svc.mesh_executor()
+    if executor is None:
+        return None
+    shard_segs = [list(s.segments) for s in searchers]
+    probe = None
+    for segs in shard_segs:
+        for seg in segs:
+            if seg.has_nested:
+                return None
+            if any(inv.wants_postings_shard()
+                   for inv in seg.inverted.values()):
+                return None
+            if probe is None:
+                probe = seg
+    if probe is None:
+        return None  # empty snapshot: host loop owns the empty response
+    from elasticsearch_tpu.search.context import SegmentContext
+    from elasticsearch_tpu.search.queries import _fused_eligible_terms
+
+    # probe context for analysis/mappings only — weights stay idf-FREE
+    # (idf=False): the sharded program folds each segment's own idf in
+    # its chunk tables, exactly like the per-segment host tiers do
+    ctx = SegmentContext(probe, svc.mappings, svc.analysis,
+                         index_name=svc.name)
+    field = None
+    qterms: List[List[tuple]] = []
+    for q in queries:
+        e = _fused_eligible_terms(ctx, q, idf=False)
+        if e is None:
+            return None
+        f, (tlist, wlist) = e
+        if field is None:
+            field = f
+        elif f != field:
+            return None  # one postings field per program
+        qterms.append(list(zip(tlist, wlist)))
+    try:
+        out = executor.search_terms(field, qterms, k=k, shards=shard_segs)
+    except MeshCompileError:
+        return None
+    except CircuitBreakingException:
+        # breaker-denied device residency: the host tiers score the
+        # batch segment-at-a-time within whatever budget remains
+        return None
+    if out is None:
+        return None
+    vals, shard, local, seg_ord, totals = out
+    cands: List[list] = [[] for _ in range(len(queries))]
+    for qi in range(len(queries)):
+        v = vals[qi]
+        ok = np.isfinite(v) & (v > 0)
+        for j in np.nonzero(ok)[0]:
+            sh = int(shard[qi, j])
+            cands[qi].append((float(v[j]), sh,
+                              shard_segs[sh][int(seg_ord[qi, j])],
+                              int(local[qi, j])))
+    return cands, [int(t) for t in np.asarray(totals)]
+
+
 def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[dict]:
     body = body or {}
     for key in _UNSUPPORTED_KEYS:
@@ -183,7 +271,8 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
     }
     if aggs:
         if device_aggs:
-            partial_lists = _agg_partials(aggs, agg_rounds, shard_segs)
+            partial_lists, partial_shards = _agg_partials(
+                aggs, agg_rounds, shard_segs)
         else:
             # arbitrary agg trees: host collectors over the program's mask
             # (same per-segment device reductions as the host loop — only
@@ -193,12 +282,19 @@ def _try_mesh_search(svc, searchers, body: dict, global_stats=None) -> Optional[
             from elasticsearch_tpu.search.aggregations import run_aggs
 
             partial_lists = []
+            partial_shards = []
             for sh, seg_ord, seg, mask in mask_rounds:
                 ctx = SegmentContext(seg, svc.mappings, svc.analysis,
                                      global_stats,
                                      all_segments=shard_segs[sh],
                                      index_name=svc.name)
                 partial_lists.append(run_aggs(aggs, ctx, jnp.asarray(mask)))
+                partial_shards.append(sh)
+        # ISSUE 16: cross-shard merges of the integer segment_sum lanes
+        # ride the mesh_psum collective; float lanes keep the host f64
+        # sum (byte-identical responses on either path)
+        partial_lists = _psum_merge_partials(
+            executor, aggs, partial_lists, partial_shards)
         response["aggregations"] = reduce_aggs(aggs, partial_lists)
     return response
 
@@ -215,10 +311,12 @@ def _terms_agg_eligible(agg, mappings) -> bool:
     return fm is not None and fm.is_keyword
 
 
-def _agg_partials(aggs, agg_rounds, shard_segs) -> List[dict]:
+def _agg_partials(aggs, agg_rounds, shard_segs):
     """Device count vectors → per-(shard, segment) partial dicts in the same
     shape TermsAggregator.collect produces, so the existing reduce phase
-    (and its ordering/size/min_doc_count handling) applies unchanged."""
+    (and its ordering/size/min_doc_count handling) applies unchanged.
+    Returns (partial_dicts, shard_of) — parallel lists; the shard ids feed
+    the cross-shard psum merge."""
     by_seg: Dict[tuple, dict] = {}
     for agg in aggs:
         for sh, seg_ord, seg, counts in agg_rounds.get(agg.name, []):
@@ -232,4 +330,134 @@ def _agg_partials(aggs, agg_rounds, shard_segs) -> List[dict]:
             cnt = counts[:v].astype(np.int64)
             partial = agg.partial_from_counts(cnt, keys)
             by_seg.setdefault((sh, seg_ord), {})[agg.name] = partial
-    return list(by_seg.values())
+    items = sorted(by_seg.items())
+    return [p for _, p in items], [sh for (sh, _so), _ in items]
+
+
+def _psum_merge_partials(executor, aggs, partial_dicts, partial_shards):
+    """Cross-shard agg merges on the mesh (ISSUE 16's aggs leg): the
+    integer lanes of the segment_sum partials — terms bucket doc_counts,
+    value_count totals, avg/stats doc counts — stack into one per-shard
+    vector and merge through the ``mesh_psum`` collective instead of the
+    host sum loop. int32 psum is EXACT, so responses stay byte-identical
+    to the host reduce; float lanes (sums) keep the host f64 fold in the
+    original partial order for the same reason. Within-shard (cross-
+    segment) folds stay on host — only the cross-SHARD reduction is a
+    collective. Aggs the merge can't express keep their partials
+    untouched; ``reduce_aggs`` handles the mix."""
+    if executor is None or getattr(executor, "S", 1) < 2:
+        return partial_dicts
+    merged: Dict[str, Any] = {}
+    for agg in aggs:
+        rows = [(sh, p[agg.name])
+                for sh, p in zip(partial_shards, partial_dicts)
+                if p is not None and agg.name in p]
+        if len({sh for sh, _ in rows}) < 2:
+            continue  # nothing crosses a shard boundary
+        try:
+            m = _device_merge_one(executor, agg, rows)
+        except Exception:  # tpulint: allow[R006] — the collective merge
+            m = None       # is an optimization; host reduce owns fallback
+        if m is not None:
+            merged[agg.name] = m
+    if not merged:
+        return partial_dicts
+    out = [{k: v for k, v in p.items() if k not in merged}
+           for p in partial_dicts if p is not None]
+    out = [p for p in out if p]
+    out.append(merged)
+    return out
+
+
+def _psum_int_lanes(executor, per_shard: Dict[int, np.ndarray]):
+    """{shard: int64[L]} → exact device-summed int64[L] via the mesh_psum
+    collective, or None when a lane total would overflow int32 (the host
+    fold handles it). Shards beyond the mesh size pre-fold onto slots
+    round-robin (the executor's slot discipline) — integer adds, exact."""
+    S = executor.S
+    L = next(iter(per_shard.values())).shape[0]
+    if L == 0:
+        return None
+    arr = np.zeros((S, L), np.int64)
+    for sh, v in per_shard.items():
+        arr[sh % S] += v
+    if arr.min(initial=0) < 0 \
+            or arr.sum(axis=0).max(initial=0) > np.iinfo(np.int32).max:
+        return None
+    return executor.psum_partials(arr.astype(np.int32)).astype(np.int64)
+
+
+def _device_merge_one(executor, agg, rows):
+    """One agg's cross-shard merge → a single pre-merged partial (what
+    reduce() would produce intermediate counts for), or None when this
+    agg type has no exact device form."""
+    from elasticsearch_tpu.search.aggregations.bucket import TermsAggregator
+    from elasticsearch_tpu.search.aggregations.metrics import (
+        AvgAggregator, ExtendedStatsAggregator, StatsAggregator,
+        ValueCountAggregator)
+
+    if type(agg) is TermsAggregator:
+        ps = [p for _, p in rows]
+        if any("subs" in b for p in ps for b in p["buckets"].values()):
+            return None  # sub-agg partials must reach reduce_subs intact
+        keys = sorted({k for p in ps for k in p["buckets"]}, key=repr)
+        idx = {k: i for i, k in enumerate(keys)}
+        per_shard: Dict[int, np.ndarray] = {}
+        for sh, p in rows:
+            v = per_shard.setdefault(
+                sh, np.zeros(len(keys) + 1, np.int64))
+            for k2, b in p["buckets"].items():
+                v[idx[k2]] += int(b["doc_count"])
+            v[len(keys)] += int(p.get("sum_other_doc_count", 0))
+        tot = _psum_int_lanes(executor, per_shard)
+        if tot is None:
+            return None
+        return {
+            "buckets": {k: {"doc_count": int(tot[i])}
+                        for i, k in enumerate(keys)},
+            "sum_other_doc_count": int(tot[len(keys)]),
+            "order": rows[0][1].get("order", {"_count": "desc"}),
+            "doc_count_error_upper_bound": 0,
+        }
+    if type(agg) is ValueCountAggregator:
+        per_shard = {}
+        for sh, p in rows:
+            v = per_shard.setdefault(sh, np.zeros(1, np.int64))
+            v[0] += int(p)
+        tot = _psum_int_lanes(executor, per_shard)
+        return None if tot is None else int(tot[0])
+    if type(agg) is AvgAggregator:
+        per_shard = {}
+        s_host = 0.0  # f64 fold in partial order == reduce()'s own sum
+        for sh, p in rows:
+            v = per_shard.setdefault(sh, np.zeros(1, np.int64))
+            v[0] += int(p[1])
+            s_host += p[0]
+        tot = _psum_int_lanes(executor, per_shard)
+        return None if tot is None else (s_host, int(tot[0]))
+    if type(agg) in (StatsAggregator, ExtendedStatsAggregator):
+        per_shard = {}
+        s_host = 0.0
+        sq_host = 0.0
+        mns: List[float] = []
+        mxs: List[float] = []
+        for sh, p in rows:
+            v = per_shard.setdefault(sh, np.zeros(1, np.int64))
+            v[0] += int(p["count"])
+            s_host += p["sum"]
+            if p["min"] is not None:
+                mns.append(p["min"])
+            if p["max"] is not None:
+                mxs.append(p["max"])
+            if type(agg) is ExtendedStatsAggregator:
+                sq_host += p["sum_sq"]
+        tot = _psum_int_lanes(executor, per_shard)
+        if tot is None:
+            return None
+        out = {"count": int(tot[0]), "sum": s_host,
+               "min": min(mns) if mns else None,
+               "max": max(mxs) if mxs else None}
+        if type(agg) is ExtendedStatsAggregator:
+            out["sum_sq"] = sq_host
+        return out
+    return None

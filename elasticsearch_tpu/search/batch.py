@@ -230,51 +230,82 @@ def execute_batch(svc, bodies: List[dict], queries: Optional[list] = None,
     from elasticsearch_tpu.tracing import retrace
 
     with index_scope(svc.name):
-        for pos, s in enumerate(searchers):
-            for seg in s.segments:
-                if seg.has_nested:
-                    return None
-                ctx = SegmentContext(seg, svc.mappings, svc.analysis,
-                                     index_name=svc.name)
-                # observatory: classify/record only AFTER the tier
-                # accepts — a refusal (None) ran no device program. A
-                # tier-1 refusal re-snapshots so tier 2 isn't billed
-                # tier 1's probe time.
-                kb = min(k, seg.max_docs)
-                snap = retrace.snapshot()
-                t0b = time.perf_counter()
-                if all_knn:
-                    # kNN/MaxSim tier: one fused per-token sweep + device
-                    # dedup-by-max merge (same (vals, ids, totals)
-                    # contract)
-                    prog_name = "batch_knn_fused"
-                    out = knn_topk_fused_batch(ctx, exec_queries, kb)
-                else:
-                    prog_name = "batch_bm25_fused"
-                    out = fused_bm25_topk_batch(ctx, exec_queries, kb)
-                    if out is None:
-                        # tier 2: scatter tails allowed — one matmul +
-                        # batched scatter + on-device per-query top-k
-                        # (queries.hybrid_bm25_topk_batch)
-                        prog_name = "batch_bm25_hybrid"
-                        snap = retrace.snapshot()
-                        t0b = time.perf_counter()
-                        out = hybrid_bm25_topk_batch(ctx, exec_queries, kb)
-                if out is None:
-                    return None
-                REGISTRY.record_call(
-                    prog_name,
-                    static_sig(Q=len(exec_queries), D=seg.max_docs, k=kb),
-                    time.perf_counter() - t0b,
-                    retrace.traces_since(snap),
-                    field=(exec_queries[0].field if all_knn else None))
-                vals, ids, tot = out
-                totals += tot
+        mesh_served = False
+        if not all_knn and len(searchers) > 1 \
+                and getattr(svc, "_mesh_enabled", lambda: False)():
+            # ISSUE 16: the coalesced bucket prefers the mesh data plane —
+            # the whole batch's query phase (per-shard score, per-shard
+            # top-k, all_gather + global merge) is ONE shard_map program
+            # per segment round, so batching × sharding multiply. Any
+            # refusal (mixed fields, breaker denial, no mesh) falls
+            # through to the per-searcher fused tiers unchanged.
+            from elasticsearch_tpu.parallel.mesh_service import \
+                try_mesh_msearch
+
+            mout = try_mesh_msearch(svc, searchers, exec_queries, k)
+            if mout is not None:
+                mcands, mtotals = mout
                 for qi in range(Q):
-                    v = vals[qi]
-                    for j in np.nonzero(np.isfinite(v) & (v > 0))[0]:
-                        cands[qi].append(
-                            (float(v[j]), pos, seg, int(ids[qi, j])))
+                    cands[qi] = mcands[qi]
+                totals += np.asarray(mtotals, np.int64)
+                mesh_served = True
+                # feed the replayable census half: coalesced bodies never
+                # cross IndexService.search, so a relocated/restarted
+                # coordinator could not pre-warm the sharded program
+                # without this record (serving/warmup.py replays it)
+                from elasticsearch_tpu.serving import warmup as warmup_mod
+
+                if not warmup_mod.in_prewarm():
+                    for b in bodies:
+                        svc._record_census_body(b)
+        if not mesh_served:
+            for pos, s in enumerate(searchers):
+                for seg in s.segments:
+                    if seg.has_nested:
+                        return None
+                    ctx = SegmentContext(seg, svc.mappings, svc.analysis,
+                                         index_name=svc.name)
+                    # observatory: classify/record only AFTER the tier
+                    # accepts — a refusal (None) ran no device program. A
+                    # tier-1 refusal re-snapshots so tier 2 isn't billed
+                    # tier 1's probe time.
+                    kb = min(k, seg.max_docs)
+                    snap = retrace.snapshot()
+                    t0b = time.perf_counter()
+                    if all_knn:
+                        # kNN/MaxSim tier: one fused per-token sweep +
+                        # device dedup-by-max merge (same (vals, ids,
+                        # totals) contract)
+                        prog_name = "batch_knn_fused"
+                        out = knn_topk_fused_batch(ctx, exec_queries, kb)
+                    else:
+                        prog_name = "batch_bm25_fused"
+                        out = fused_bm25_topk_batch(ctx, exec_queries, kb)
+                        if out is None:
+                            # tier 2: scatter tails allowed — one matmul +
+                            # batched scatter + on-device per-query top-k
+                            # (queries.hybrid_bm25_topk_batch)
+                            prog_name = "batch_bm25_hybrid"
+                            snap = retrace.snapshot()
+                            t0b = time.perf_counter()
+                            out = hybrid_bm25_topk_batch(ctx, exec_queries,
+                                                         kb)
+                    if out is None:
+                        return None
+                    REGISTRY.record_call(
+                        prog_name,
+                        static_sig(Q=len(exec_queries), D=seg.max_docs,
+                                   k=kb),
+                        time.perf_counter() - t0b,
+                        retrace.traces_since(snap),
+                        field=(exec_queries[0].field if all_knn else None))
+                    vals, ids, tot = out
+                    totals += tot
+                    for qi in range(Q):
+                        v = vals[qi]
+                        for j in np.nonzero(np.isfinite(v) & (v > 0))[0]:
+                            cands[qi].append(
+                                (float(v[j]), pos, seg, int(ids[qi, j])))
     q_ms = (time.perf_counter() - t0) * 1000
     for s in searchers:
         # counters must match what Q sequential requests would record

@@ -198,10 +198,31 @@ def fused_bm25_topk(ctx, query, k: int):
     return unpack_topk_result(packed, kk)
 
 
-def _fused_eligible_terms(ctx, query):
+_TIER_PROGRAMS: dict = {}
+
+
+def _tier_program(name: str, fn):
+    """Route a module-level batched-tier jit through the AotProgram
+    factory-key discipline (ROADMAP #6): per arg/static-kwarg shape
+    class the call resolves through the blob cache, with the plain jit
+    as the unconditional correctness fallback."""
+    prog = _TIER_PROGRAMS.get(name)
+    if prog is None:
+        from elasticsearch_tpu.parallel import aot
+
+        prog = _TIER_PROGRAMS[name] = aot.wrap(fn, name, (name,))
+    return prog
+
+
+def _fused_eligible_terms(ctx, query, idf: bool = True):
     """(field, deduped (terms, weights)) when `query` is a pure disjunctive
     term group — match operator:or / term on a text field, positive boost —
-    else None. Shared gate of the fused single and batched top-k paths."""
+    else None. Shared gate of the fused single and batched top-k paths.
+
+    ``idf=False`` keeps the weights idf-free (duplicate terms still merge
+    additively): the mesh query-then-fetch path folds each SEGMENT's idf
+    inside the sharded program (executor._chunk_table), so handing it
+    pre-folded weights would double-count."""
     if isinstance(query, MatchQuery):
         if (query.operator != "or" or query.msm is not None
                 or query.fuzziness is not None):
@@ -218,7 +239,8 @@ def _fused_eligible_terms(ctx, query):
         return None
     if boost <= 0 or not terms:
         return None
-    return field, _dedupe_terms(terms, boost, lambda t: ctx.idf(field, t))
+    idf_fn = (lambda t: ctx.idf(field, t)) if idf else (lambda t: 1.0)
+    return field, _dedupe_terms(terms, boost, idf_fn)
 
 
 def fused_bm25_topk_batch(ctx, queries: List[Query], k: int):
@@ -274,8 +296,9 @@ def fused_bm25_topk_batch(ctx, queries: List[Query], k: int):
                                      k=min(k, D))
     kernels.record("bm25_fused_topk", Q)
     chunk = D if D < (1 << 15) else (1 << 15)
-    totals = dense_presence_count_batch(impact, jnp.asarray(qind), live,
-                                        chunk=chunk)
+    totals = _tier_program("batch_presence_count",
+                           dense_presence_count_batch)(
+        impact, jnp.asarray(qind), live, chunk=chunk)
     return np.asarray(vals), np.asarray(ids), np.asarray(totals)
 
 
@@ -343,8 +366,12 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
     _prec = impact_precision()
     # tail dispatch, once per batch: the scatter-free candidate form on
     # TPU (the vmapped scatter serializes Q·T·P slots), scatter elsewhere
-    batch_fn = (bm25_hybrid_candidates_topk_batch if tail_mode_batch()
-                else bm25_hybrid_topk_batch)
+    scatter_free = tail_mode_batch()
+    batch_fn = (_tier_program("batch_bm25_hybrid_cand",
+                              bm25_hybrid_candidates_topk_batch)
+                if scatter_free
+                else _tier_program("batch_bm25_hybrid",
+                                   bm25_hybrid_topk_batch))
     out_v, out_i, out_t = [], [], []
     for q0 in range(0, Q, chunk_q):
         q1 = min(q0 + chunk_q, Q)
@@ -361,12 +388,14 @@ def hybrid_bm25_topk_batch(ctx, queries: List[Query], k: int,
             vals, ids, tot = (np.asarray(vals), np.asarray(ids),
                               np.asarray(tot))
         except Exception:
-            if batch_fn is bm25_hybrid_topk_batch:
+            if not scatter_free:
                 raise
             # candidates-form insurance (first real-TPU run): fall back
             # to the scatter form for this and remaining chunks
             kernels.record("tail_scatter_free_failed")
-            batch_fn = bm25_hybrid_topk_batch
+            scatter_free = False
+            batch_fn = _tier_program("batch_bm25_hybrid",
+                                     bm25_hybrid_topk_batch)
             vals, ids, tot = batch_fn(
                 impact, jnp.asarray(qw[q0:q1]), inv.doc_ids, inv.tfnorm,
                 jnp.asarray(starts[q0:q1]), jnp.asarray(lens[q0:q1]),

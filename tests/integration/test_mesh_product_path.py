@@ -339,7 +339,12 @@ def test_batched_msearch_matches_sequential(dense_node):
                                 "size": 4, "from": 2})]
     kernels.reset()
     r = dense_node.msearch(pairs)
-    assert kernels.snapshot().get("bm25_fused_topk", 0) >= len(pairs)
+    # the whole batch amortizes onto the device either way: one mesh
+    # msearch program when the shards co-reside (the batched mesh path),
+    # else one fused host kernel per query per segment
+    snap = kernels.snapshot()
+    assert snap.get("bm25_fused_topk", 0) >= len(pairs) \
+        or snap.get("mesh_msearch", 0) >= 1, snap
     seq = [dense_node.search("dn", b) for _, b in pairs]
     for got, want in zip(r["responses"], seq):
         assert got["hits"]["total"] == want["hits"]["total"]

@@ -1,0 +1,339 @@
+"""Mesh-collective query-then-fetch (ISSUE 16): one shard_map device
+program per coalesced batch, collective top-k, TCP demoted to control
+plane.
+
+Acceptance surface:
+- a coalesced batch of >= 16 single-index BM25 searches executes its
+  ENTIRE query phase as one compiled device program on the emulated
+  8-device mesh — one program-observatory key (mesh_bm25), no host-tier
+  kernels;
+- responses identical to the per-shard TCP/host scatter path (ids, sort
+  keys, totals, _shards, from/size paging exact; scores to 1e-5);
+- cross-shard aggs reduction rides the psum collective and stays
+  bucket-identical to the host merge;
+- graceful fallback: breaker-denied mesh programs fall back to the host
+  tiers; a coordinator whose shard owners do NOT co-reside on one mesh
+  keeps the TCP scatter data plane;
+- the mesh path feeds the census (satellite 6): coalesced bodies are
+  recorded and a warmup replay pre-warms them (restart acceptance
+  pattern of tests/unit/test_warmup.py).
+
+Reference: action/search/type/TransportSearchQueryThenFetchAction.java.
+"""
+import json
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from elasticsearch_tpu.monitor import kernels, programs
+from elasticsearch_tpu.node import Node
+
+WORDS = ["alpha", "beta", "gamma", "delta", "fox", "dog", "cat", "emu"]
+
+
+@pytest.fixture(scope="module")
+def node():
+    n = Node()
+    n.create_index("q8", {"settings": {"number_of_shards": 8},
+                          "mappings": {"properties": {
+                              "body": {"type": "text"},
+                              "tag": {"type": "keyword"},
+                              "n": {"type": "long"}}}})
+    svc = n.indices["q8"]
+    rng = random.Random(7)
+    for i in range(400):
+        svc.index_doc(str(i), {"body": " ".join(rng.choices(WORDS, k=6)),
+                               "tag": rng.choice(["red", "green", "blue"]),
+                               "n": rng.randint(0, 99)})
+    # ONE refresh -> one segment per shard -> one segment round, so the
+    # whole batch query phase is literally one device program execution
+    svc.refresh()
+    yield n
+    n.close()
+
+
+# 16 single-index BM25 bodies with from/size paging variety — every one
+# batch-eligible (pure disjunctive match), so the coalescer hands the
+# whole bucket to the mesh in one piece.
+BATCH = [
+    {"query": {"match": {"body": q}}, "size": s, "from": f}
+    for q, s, f in [
+        ("alpha", 10, 0), ("beta", 5, 0), ("gamma", 7, 2),
+        ("delta", 10, 0), ("fox", 4, 0), ("dog", 10, 5),
+        ("cat", 6, 0), ("emu", 10, 0), ("alpha beta", 8, 0),
+        ("gamma delta", 10, 3), ("fox dog", 5, 0), ("cat emu", 10, 0),
+        ("alpha gamma fox", 9, 0), ("beta delta dog", 10, 1),
+        ("emu alpha", 3, 0), ("dog cat beta", 10, 0),
+    ]
+]
+
+
+def _pairs(bodies, index="q8"):
+    return [({"index": index}, dict(b)) for b in bodies]
+
+
+def _msearch_host(node, bodies, index="q8"):
+    os.environ["ESTPU_DISABLE_MESH"] = "1"
+    try:
+        return node.msearch(_pairs(bodies, index))
+    finally:
+        del os.environ["ESTPU_DISABLE_MESH"]
+
+
+def _strip_scores(resp):
+    """Deep copy with float score fields zeroed (compared separately to
+    1e-5) and took removed — the rest must be byte-identical."""
+    r = json.loads(json.dumps(resp))
+    r.pop("took", None)
+    if "hits" in r:
+        if r["hits"].get("max_score") is not None:
+            r["hits"]["max_score"] = 0.0
+        for h in r["hits"]["hits"]:
+            if h.get("_score") is not None:
+                h["_score"] = 0.0
+    return r
+
+
+def _assert_item_parity(got, want, label=""):
+    gh, wh = got["hits"]["hits"], want["hits"]["hits"]
+    assert [(h["_id"], h.get("sort")) for h in gh] == \
+           [(h["_id"], h.get("sort")) for h in wh], label
+    for hg, hw in zip(gh, wh):
+        if hw.get("_score") is None:
+            assert hg.get("_score") is None, label
+        else:
+            assert abs(hg["_score"] - hw["_score"]) < 1e-5, label
+    assert _strip_scores(got) == _strip_scores(want), label
+
+
+def test_batch16_is_one_device_program(node):
+    """The tentpole acceptance: 16 coalesced BM25 searches -> exactly ONE
+    new mesh program key (mesh_bm25), executed once, zero host-tier
+    kernel dispatches."""
+    # dispatches = compiles + cached calls: the batch's ONE execution is
+    # classified as a compile on its first-ever trace, an execute after
+    before = {(e["program"], e["shapes"]): e["compiles"] + e["calls"]
+              for e in programs.REGISTRY.snapshot()}
+    kernels.reset()
+    resp = node.msearch(_pairs(BATCH))
+    assert len(resp["responses"]) == len(BATCH)
+    snap = kernels.snapshot()
+    assert snap.get("mesh_msearch", 0) == 1, snap
+    assert snap.get("mesh_msearch_fallback", 0) == 0, snap
+    # the per-searcher x per-segment host loop never ran
+    for host_tier in ("bm25_fused_topk", "bm25_hybrid", "bm25_scored"):
+        assert snap.get(host_tier, 0) == 0, snap
+    after = {(e["program"], e["shapes"]): e["compiles"] + e["calls"]
+             for e in programs.REGISTRY.snapshot()}
+    ran = {k: after[k] - before.get(k, 0)
+           for k in after if after[k] > before.get(k, 0)}
+    mesh_keys = {k: n for k, n in ran.items() if k[0].startswith("mesh_")}
+    assert {k[0] for k in mesh_keys} == {"mesh_bm25"}, ran
+    assert len(mesh_keys) == 1, ran          # one shape class
+    assert list(mesh_keys.values()) == [1], ran  # executed exactly once
+
+
+def test_batch_identical_to_scatter_path(node):
+    """Mesh answers vs the per-shard scatter path: ids, sort keys,
+    totals, _shards and paging byte-identical; scores to 1e-5."""
+    kernels.reset()
+    r_mesh = node.msearch(_pairs(BATCH))
+    assert kernels.snapshot().get("mesh_msearch", 0) == 1
+    r_host = _msearch_host(node, BATCH)
+    assert len(r_mesh["responses"]) == len(r_host["responses"])
+    for body, gm, gh in zip(BATCH, r_mesh["responses"],
+                            r_host["responses"]):
+        assert gm["hits"]["total"] == gh["hits"]["total"], body
+        frm, size = body.get("from", 0), body["size"]
+        assert len(gm["hits"]["hits"]) <= size, body
+        assert gm.get("_shards") == gh.get("_shards"), body
+        _assert_item_parity(gm, gh, body)
+    # and both agree with solo sequential execution (the original oracle)
+    for body, gm in zip(BATCH[:4], r_mesh["responses"][:4]):
+        _assert_item_parity(gm, node.search("q8", body), body)
+
+
+def test_aggs_reduction_rides_psum_collective(node):
+    """Cross-shard agg merges (terms doc_counts, value_count, avg n,
+    stats count) ride the psum collective and stay bucket-identical to
+    the host reduce."""
+    body = {"query": {"match": {"body": "fox"}}, "size": 0, "aggs": {
+        "tags": {"terms": {"field": "tag"}},
+        "mean": {"avg": {"field": "n"}},
+        "st": {"stats": {"field": "n"}},
+        "vc": {"value_count": {"field": "n"}}}}
+    r_mesh = node.search("q8", body)
+    os.environ["ESTPU_DISABLE_MESH"] = "1"
+    try:
+        r_host = node.search("q8", body)
+    finally:
+        del os.environ["ESTPU_DISABLE_MESH"]
+    assert r_mesh["aggregations"] == r_host["aggregations"]
+    assert r_mesh["hits"]["total"] == r_host["hits"]["total"]
+    # the collective actually ran (program observatory carries the key)
+    assert any(e["program"] == "mesh_psum"
+               for e in programs.REGISTRY.snapshot())
+
+
+def test_breaker_denied_mesh_falls_back_to_host(node, monkeypatch):
+    """A breaker-denied mesh program must degrade to the host tiers with
+    identical answers — never a 429 for an answerable batch."""
+    from elasticsearch_tpu.parallel.executor import MeshSearchExecutor
+    from elasticsearch_tpu.utils.errors import CircuitBreakingException
+
+    def deny(self, *a, **k):
+        raise CircuitBreakingException("[request] Data too large",
+                                       bytes_wanted=1, bytes_limit=0)
+
+    monkeypatch.setattr(MeshSearchExecutor, "search_terms", deny)
+    kernels.reset()
+    resp = node.msearch(_pairs(BATCH[:6]))
+    snap = kernels.snapshot()
+    assert snap.get("mesh_msearch_fallback", 0) >= 1, snap
+    assert snap.get("mesh_msearch", 0) == 0, snap
+    want = _msearch_host(node, BATCH[:6])
+    for body, gm, gh in zip(BATCH[:6], resp["responses"],
+                            want["responses"]):
+        _assert_item_parity(gm, gh, body)
+
+
+def test_coalesced_bodies_feed_census_for_prewarm(node, tmp_path):
+    """Satellite 6: a mesh-served coalesced batch records its bodies in
+    the census so a relocated/restarted coordinator pre-warms the mesh
+    program — the warmup replay completes and replays those bodies
+    (test_warmup.py restart acceptance pattern)."""
+    from elasticsearch_tpu.index import ivf_cache
+    from elasticsearch_tpu.resources import census
+
+    ivf_cache.register(str(tmp_path))
+    kernels.reset()
+    node.msearch(_pairs(BATCH))
+    assert kernels.snapshot().get("mesh_msearch", 0) == 1
+    recorded = {row["body"] for row in programs.REGISTRY.bodies("q8")}
+    want_keys = {json.dumps(b, sort_keys=True) for b in BATCH}
+    assert want_keys <= recorded, (want_keys - recorded)
+    # mesh_bm25 is a censused key for this index
+    assert any(r.get("program") == "mesh_bm25"
+               for r in programs.REGISTRY.census("q8"))
+    assert census.store_census("q8") is not None
+    res = node.serving.warmup.run_index("q8", "test")
+    assert res["status"] == "complete", res
+    assert res["replayed"] >= len(BATCH), res
+    assert res["errors"] == 0, res
+
+
+# -- coordinator routing (cluster data plane) ---------------------------------
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _wait(predicate, timeout=10.0, step=0.05):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if predicate():
+            return True
+        time.sleep(step)
+    return False
+
+
+def test_coordinator_prefers_mesh_when_all_owners_local():
+    """Every shard owner co-resident with the coordinator -> the cluster
+    search action serves the query phase as the mesh device program
+    (dist_mesh_search ticks), answers oracle-identical."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    n = Node(name="solo0")
+    c = MultiHostCluster(n, rank=0, world=2, transport_port=_free_port(),
+                         minimum_master_nodes=1)
+    oracle = Node(name="oracle-mesh")
+    try:
+        idx_body = {"settings": {"number_of_shards": 4},
+                    "mappings": {"properties": {
+                        "body": {"type": "text"}}}}
+        c.data.create_index("loc", idx_body)
+        oracle.create_index("loc", idx_body)
+        rng = random.Random(5)
+        for i in range(120):
+            src = {"body": " ".join(rng.choices(WORDS, k=5))}
+            c.data.index_doc("loc", str(i), src)
+            oracle.indices["loc"].index_doc(str(i), src)
+        c.data.refresh("loc")
+        oracle.indices["loc"].refresh()
+        kernels.reset()
+        got = c.data.search("loc", {"query": {"match": {"body": "fox"}},
+                                    "size": 10})
+        snap = kernels.snapshot()
+        assert snap.get("dist_mesh_search", 0) >= 1, snap
+        want = oracle.search("loc", {"query": {"match": {"body": "fox"}},
+                                     "size": 10})
+        assert got["hits"]["total"] == want["hits"]["total"]
+        _assert_item_parity(got, want)
+    finally:
+        oracle.close()
+        c.close()
+        n.close()
+
+
+from tests.integration.multihost_util import member_code as _member_code
+
+
+def test_coordinator_keeps_tcp_scatter_when_owners_remote():
+    """Shard owners split across two REAL processes: no shared mesh, so
+    the coordinator keeps the TCP scatter data plane (dist_mesh_search
+    never ticks) and still answers oracle-identical."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+
+    n = Node(name="rank0")
+    c = MultiHostCluster(n, rank=0, world=2, transport_port=_free_port(),
+                         ping_interval=0.2, ping_retries=2,
+                         minimum_master_nodes=1)
+    p = None
+    oracle = Node(name="oracle-tcp")
+    try:
+        p = subprocess.Popen(
+            [sys.executable, "-c", _member_code(c.master_addr[1])],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        assert "JOINED" in p.stdout.readline()
+        assert _wait(lambda: len(n.cluster_state.nodes) == 2)
+        idx_body = {"settings": {"number_of_shards": 2},
+                    "mappings": {"properties": {
+                        "body": {"type": "text"}}}}
+        c.data.create_index("rem", idx_body)
+        assig = c.dist_indices["rem"]["assignment"]
+        assert len({owners[0] for owners in assig.values()}) == 2, assig
+        oracle.create_index("rem", idx_body)
+        rng = random.Random(9)
+        for i in range(60):
+            src = {"body": " ".join(rng.choices(WORDS, k=5))}
+            c.data.index_doc("rem", str(i), src)
+            oracle.indices["rem"].index_doc(str(i), src)
+        c.data.refresh("rem")
+        oracle.indices["rem"].refresh()
+        kernels.reset()
+        got = c.data.search("rem", {"query": {"match": {"body": "dog"}},
+                                    "size": 10})
+        snap = kernels.snapshot()
+        assert snap.get("dist_mesh_search", 0) == 0, snap
+        want = oracle.search("rem", {"query": {"match": {"body": "dog"}},
+                                     "size": 10})
+        assert got["hits"]["total"] == want["hits"]["total"]
+        got_ids = {h["_id"]: h["_score"] for h in got["hits"]["hits"]}
+        want_ids = {h["_id"]: h["_score"] for h in want["hits"]["hits"]}
+        assert set(got_ids) == set(want_ids)
+        for k, v in want_ids.items():
+            assert got_ids[k] == pytest.approx(v, rel=1e-4)
+    finally:
+        if p is not None:
+            p.kill()
+            p.wait()
+        oracle.close()
+        c.close()
+        n.close()

@@ -27,7 +27,11 @@ def node():
     orig = segmod.build_dense_impact
     segmod.build_dense_impact = functools.partial(orig, df_threshold=8)
     n = Node()
-    n.create_index("mx", {"settings": {"index": {"number_of_shards": 2}},
+    # pin the mesh data plane off: this module exists to cover the HOST
+    # fused tiers (the mesh batched path has its own parity suite in
+    # tests/integration/test_mesh_qtf.py)
+    n.create_index("mx", {"settings": {"index": {"number_of_shards": 2,
+                                                 "search": {"mesh": "false"}}},
                           "mappings": {"properties": {
                               "body": {"type": "text"}}}})
     svc = n.indices["mx"]

@@ -1184,6 +1184,116 @@ class TestR012:
         assert vs == []
 
 
+class TestR012MemoizedJit:
+    """R012 memoization arm (ISSUE 16 / ROADMAP #6 residual): a
+    jit-derived program stored into a module-level memo dict inside a
+    HOT-path module bypasses the AotProgram factory — warm restarts
+    re-compile every shape class and the census pre-warm cannot replay
+    the program. `aot.wrap(fn, name, key)` before memoizing is the
+    blessed shape and passes."""
+
+    def test_bad_memoized_jit_assignment(self):
+        vs = lint("""
+            import jax
+            from functools import partial
+
+            _PROGRAMS = {}
+
+            def program(key, chunk):
+                prog = _PROGRAMS.get(key)
+                if prog is None:
+                    prog = jax.jit(lambda x: x + 1)
+                    _PROGRAMS[key] = prog
+                return prog
+        """, hot=True)
+        assert rules_of(vs) == ["R012"]
+        assert "parallel.aot.wrap" in vs[0].message
+
+    def test_bad_direct_subscript_store_and_partial_jit(self):
+        vs = lint("""
+            import jax
+            from functools import partial
+
+            _CACHE: dict = {}
+
+            def program(k):
+                _CACHE[k] = partial(jax.jit, static_argnames=("n",))(
+                    lambda x, n: x * n)
+                return _CACHE[k]
+        """, hot=True)
+        assert rules_of(vs) == ["R012"]
+
+    def test_bad_setdefault_store(self):
+        vs = lint("""
+            import jax
+
+            _P = {}
+
+            def program(k):
+                _P.setdefault(k, jax.jit(lambda x: x))
+                return _P[k]
+        """, hot=True)
+        assert rules_of(vs) == ["R012"]
+
+    def test_good_wrapped_before_memoizing(self):
+        # the blessed shape: route through the AotProgram factory first
+        vs = lint("""
+            import jax
+
+            _PROGRAMS = {}
+
+            def program(key):
+                prog = _PROGRAMS.get(key)
+                if prog is None:
+                    from elasticsearch_tpu.parallel import aot
+                    prog = _PROGRAMS[key] = aot.wrap(
+                        jax.jit(lambda x: x + 1), "score", key)
+                return prog
+        """, hot=True)
+        assert vs == []
+
+    def test_good_non_jit_values_and_cold_path(self):
+        # memoizing arbitrary values is fine; so is the same store in a
+        # module outside the hot-path packages
+        src = """
+            import jax
+
+            _PROGRAMS = {}
+
+            def program(key):
+                _PROGRAMS[key] = {"meta": key}
+                return _PROGRAMS[key]
+
+            def cold(key):
+                prog = jax.jit(lambda x: x)
+                return prog(1)
+        """
+        assert lint(src, hot=True) == []
+        vs = lint("""
+            import jax
+
+            _P = {}
+
+            def program(k):
+                _P[k] = jax.jit(lambda x: x)
+                return _P[k]
+        """, hot=False)
+        assert vs == []
+
+    def test_allow_suppression(self):
+        vs = lint("""
+            import jax
+
+            _P = {}
+
+            def program(k):
+                # tpulint: allow[R012] — eager first-call latch by design
+                _P[k] = jax.jit(lambda x: x)
+                return _P[k]
+        """, hot=True)
+        assert vs == []
+
+
 class TestPqTierFixtures:
     """PQ-tier discipline (ISSUE 9): the codebook BUILD path is a
     host-side freeze-time scan and must carry `# tpulint: host` (R003),

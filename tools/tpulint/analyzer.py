@@ -42,7 +42,10 @@ RULES: Dict[str, str] = {
             "packages (ops/, models/, parallel/) — the program can "
             "compile before tracing/retrace installs the auditor and "
             "escapes compile attribution (observatory census + profiler "
-            "compile/execute split under-report)",
+            "compile/execute split under-report); also a process-"
+            "memoized jit program in a hot-path module-level cache not "
+            "routed through the parallel.aot AotProgram factory (warm "
+            "restarts re-compile; the census pre-warm cannot replay it)",
     "R013": "lock-order hazard: a cycle in the interprocedural "
             "held→acquired lock graph (potential deadlock), or a "
             "lock-held call chain into an unbounded blocking wait",

@@ -1223,9 +1223,125 @@ def _check_import_time_jit(tree: ast.Module, ctx: FileContext,
     _check_stmts(tree.body)
 
 
+def _check_memoized_jit(tree: ast.Module, ctx: FileContext,
+                        mod: _ModuleInfo, out: List[Violation]) -> None:
+    """R012 (memoization arm): a jit-derived program stored into a
+    module-level cache inside a hot-path module —
+    ``_PROGRAMS[key] = jax.jit(...)`` — is a process memo: it dedupes
+    compiles for THIS process but bypasses the ``parallel.aot``
+    AotProgram factory, so a warm restart re-traces and re-compiles
+    every shape class instead of loading the compiled-executable blob,
+    and the program never joins the factory-key discipline the census
+    pre-warm replays against. Route the jitted callable through
+    ``aot.wrap(fn, name, key)`` (or construct an ``AotProgram``)
+    BEFORE memoizing; the wrap is the blessed shape and is not
+    flagged."""
+    if not ctx.hot:
+        return
+
+    # module-level container names (the memo dicts)
+    memos: Set[str] = set()
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            tgts, val = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            tgts, val = [stmt.target], stmt.value
+        else:
+            continue
+        chain = _attr_chain(val.func) if isinstance(val, ast.Call) else ""
+        if isinstance(val, ast.Dict) or chain in (
+                "dict", "defaultdict", "collections.defaultdict",
+                "OrderedDict", "collections.OrderedDict"):
+            for t in tgts:
+                nm = _name(t)
+                if nm:
+                    memos.add(nm)
+    if not memos:
+        return
+
+    # names the aot factory is visible under in this module
+    wrap_fns: Set[str] = {"AotProgram"}
+    aot_mods: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.endswith("parallel.aot"):
+                for al in node.names:
+                    if al.name in ("wrap", "AotProgram"):
+                        wrap_fns.add(al.asname or al.name)
+            elif node.module.endswith(".parallel") or node.module == "parallel":
+                for al in node.names:
+                    if al.name == "aot":
+                        aot_mods.add(al.asname or "aot")
+
+    def _is_wrap(call: ast.Call) -> bool:
+        chain = _attr_chain(call.func) or ""
+        if chain in wrap_fns:
+            return True
+        root, _, leaf = chain.rpartition(".")
+        return leaf in ("wrap", "AotProgram") and root in aot_mods
+
+    def _derived(val: ast.AST, jit_names: Set[str]) -> bool:
+        if isinstance(val, ast.Call):
+            if _is_wrap(val):
+                return False
+            if mod.is_jit_expr(val):
+                return True
+            # `partial(jax.jit, ...)(fn)` — the outer call applies a
+            # jit-building partial; unwrap one level
+            return isinstance(val.func, ast.Call) and \
+                mod.is_jit_expr(val.func)
+        nm = _name(val)
+        return nm in jit_names if nm else False
+
+    def _emit(node: ast.AST, root: str) -> None:
+        out.append(Violation(
+            "R012", ctx.path, node.lineno, node.col_offset,
+            f"process-memoized jax.jit program (`{root}[...] = <jit>`) "
+            "outside the AotProgram factory in a hot-path module — a "
+            "warm restart re-traces and re-compiles every shape class "
+            "and the census pre-warm cannot replay it; route through "
+            "parallel.aot.wrap(fn, name, key) before memoizing",
+            snippet_at(ctx.lines, node.lineno)))
+
+    def _scan(stmts, jit_names: Set[str]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                _scan(st.body, set())  # fresh scope
+                continue
+            if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+                c = st.value
+                if isinstance(c.func, ast.Attribute) and \
+                        c.func.attr == "setdefault" and \
+                        _name(c.func.value) in memos and \
+                        len(c.args) >= 2 and _derived(c.args[1], jit_names):
+                    _emit(st, _name(c.func.value) or "")
+            if isinstance(st, (ast.Assign, ast.AnnAssign)):
+                tgts = (st.targets if isinstance(st, ast.Assign)
+                        else [st.target])
+                val = st.value
+                if val is not None:
+                    derived = _derived(val, jit_names)
+                    for tgt in tgts:
+                        if isinstance(tgt, ast.Subscript) and derived and \
+                                _name(tgt.value) in memos:
+                            _emit(st, _name(tgt.value) or "")
+                        nm = _name(tgt)
+                        if nm:
+                            (jit_names.add if derived
+                             else jit_names.discard)(nm)
+            for attr in ("body", "orelse", "finalbody"):
+                _scan(getattr(st, attr, ()) or (), jit_names)
+            for h in getattr(st, "handlers", ()) or ():
+                _scan(h.body, jit_names)
+
+    _scan(tree.body, set())
+
+
 def check_module(tree: ast.Module, ctx: FileContext) -> List[Violation]:
     mod = _ModuleInfo(tree)
     checker = _Checker(ctx, mod)
     checker.visit(tree)
     _check_import_time_jit(tree, ctx, mod, checker.out)
+    _check_memoized_jit(tree, ctx, mod, checker.out)
     return checker.out
