@@ -1092,6 +1092,40 @@ def run_bench(args, jax) -> dict:
     log(f"device dispatch floor (p50 of a trivial jitted call): "
         f"{dispatch_floor_ms:.2f} ms")
     PARTIAL["dispatch_floor_ms"] = round(dispatch_floor_ms, 3)
+    stage("static-analysis")
+    # tpulint self-measurement: rule findings + the pass-3 shapeflow
+    # reach over the shipping tree ride the bench record, so a perf run
+    # also documents the static health of the exact code it measured
+    # (and the analyzer's own wall time is tracked release over release)
+    try:
+        t0 = time.perf_counter()
+        from tools.tpulint import shapeflow as _shapeflow
+        from tools.tpulint.project import build_project, lint_index
+
+        _root = os.path.dirname(os.path.abspath(__file__))
+        _idx, _errs = build_project(
+            [os.path.join(_root, "elasticsearch_tpu"),
+             os.path.join(_root, "tools"),
+             os.path.join(_root, "bench.py")], root=_root)
+        _found = lint_index(_idx) + _errs
+        _rep = _shapeflow.analyze(_idx)
+        _counts: dict = {}
+        for _viol in _found:
+            _counts[_viol.rule] = _counts.get(_viol.rule, 0) + 1
+        PARTIAL["analysis"] = {
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "rule_counts": dict(sorted(_counts.items())),
+            "traced_fns": len(_idx.traced),
+            "collective_fns": len(_idx.collective),
+            "shapeflow_functions": _rep.functions,
+            "shapeflow_factories": len(_rep.factories),
+            "dims_classified": dict(_rep.dims_classified),
+        }
+        log(f"tpulint: {sum(_counts.values())} finding(s) in "
+            f"{PARTIAL['analysis']['wall_s']}s; {_rep.functions} fns / "
+            f"{len(_rep.factories)} factories in shapeflow reach")
+    except Exception as e:  # the gate lives in CI; never sink a perf run
+        PARTIAL["analysis"] = {"error": f"{type(e).__name__}: {e}"}
     stage("corpus-build")
     log(f"corpus: {args.docs} docs, vocab {args.vocab}")
     u_doc, tf, tfn, offsets, df, idf, doc_len = build_corpus(

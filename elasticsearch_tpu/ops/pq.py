@@ -150,6 +150,9 @@ def _encode_program(M: int, dsub: int):
         x = chunk.reshape(chunk.shape[0], M, dsub)
         aff = jnp.einsum("nmd,mkd->nmk", x, codebooks,
                          preferred_element_type=jnp.float32)
+        # codebooks are repeat-padded when training was tiny (train_pq
+        # tiles codewords to K): duplicate codewords are argmax-neutral,
+        # and the norm sum runs over the full dsub axis  # tpulint: masked
         aff = aff - 0.5 * jnp.sum(codebooks * codebooks, axis=-1)[None, :, :]
         return jnp.argmax(aff, axis=2).astype(jnp.uint8)
 
@@ -188,6 +191,8 @@ def pq_encode(vecs: np.ndarray, codebooks: np.ndarray) -> np.ndarray:
     jax = _jax()
 
     M, _K, dsub = codebooks.shape
+    # (M, dsub) is the pq_layout shape class for the field's dims — a
+    # config-bounded universe, one program per layout  # tpulint: bucketed
     prog = _encode_program(M, dsub)
     # offbudget: build-time temporaries, freed when the encode returns
     d_books = jax.device_put(codebooks)  # tpulint: offbudget
@@ -256,8 +261,14 @@ def place_pq(parts: PqHostParts, label: str = "pq") -> Optional[PqIndex]:
         best_effort=True)
     if handle is None:
         return None
-    books = resources.RESIDENCY.device_put(parts.codebooks,
-                                           label=f"{label}.codebooks")
+    try:
+        books = resources.RESIDENCY.device_put(parts.codebooks,
+                                               label=f"{label}.codebooks")
+    except Exception:
+        # a codebook breaker denial must not strand the codes handle's
+        # fielddata charge — evict it before propagating
+        handle.evict()
+        raise
     return PqIndex(codebooks=books, codes=handle, M=parts.M, K=parts.K,
                    dsub=parts.dsub, dims=parts.dims, metric=parts.metric,
                    codebooks_host=parts.codebooks, codes_host=parts.codes)

@@ -618,7 +618,12 @@ class MeshSearchExecutor:
                 per_q.append((starts, lens, ws))
             tables.append(per_q)
         T = pow2_bucket(Tmax)
-        Q = len(query_terms)
+        # pow2-bucket the query axis: Q rides the program cache key, so a
+        # raw len() would mint one compiled program per distinct query
+        # count (recompile storm). Padded query rows carry all-zero chunk
+        # tables (no terms, zero weights) and are sliced off below.
+        Qr = len(query_terms)
+        Q = pow2_bucket(Qr, minimum=1)
 
         def pad_t(a, fill=0, dtype=np.int32):
             out = np.full(T, fill, dtype)
@@ -675,23 +680,37 @@ class MeshSearchExecutor:
             vals, slot, local, totals = prog(
                 d_doc, d_tfn, put(h_starts), put(h_lens), put(h_ws),
                 put(h_live))
-            slot = np.asarray(slot)
-        # slot index → originating shard + its segment ordinal (wrap-aware)
-        return (np.asarray(vals), lut_shard[slot], np.asarray(local),
-                lut_ord[slot], np.asarray(totals))
+            slot = np.asarray(slot)[:Qr]
+        # slot index → originating shard + its segment ordinal (wrap-aware);
+        # [:Qr] drops the pow2 query-padding rows
+        return (np.asarray(vals)[:Qr], lut_shard[slot],
+                np.asarray(local)[:Qr], lut_ord[slot],
+                np.asarray(totals)[:Qr])
 
     # -- kNN ----------------------------------------------------------------
 
     def search_knn(self, field: str, queries: np.ndarray, k: int = 10,
                    metric: str = "cosine"):
         """queries f32[Q, dims] → (vals, shard, local, round, totals=None)."""
-        Q, dims = queries.shape
-        return self._search_vector_rounds(
+        Qr, dims = queries.shape
+        # pow2-bucket the query axis (Q rides the program cache key — the
+        # raw request count would mint one program per distinct value).
+        # Repeat-padding (batch.py discipline): duplicate rows score
+        # normally and are sliced off below.
+        Q = pow2_bucket(Qr, minimum=1)
+        if Q != Qr:
+            queries = np.concatenate(
+                [queries, np.repeat(queries[:1], Q - Qr, axis=0)])
+        out = self._search_vector_rounds(
             field, queries, k, dims,
+            # dims is the field mapping's embedding width — a config-bounded
+            # shape class, not request data  # tpulint: bucketed
             lambda D: _knn_program(self.mesh, self._programs, Q=Q,
                                    dims=dims, D=D, k=min(k, D),
                                    metric=metric),
             prog_name="mesh_knn")
+        return tuple(a[:Qr] if isinstance(a, np.ndarray) else a
+                     for a in out)
 
     def search_maxsim(self, field: str, tokens: np.ndarray, k: int = 10,
                       metric: str = "cosine"):
@@ -699,13 +718,24 @@ class MeshSearchExecutor:
         tokens per request) → (vals, shard, local, round, totals=None).
         Same data-cache discipline as search_knn (the slab group is
         shared between the two — one upload serves both programs)."""
-        Q, T, dims = tokens.shape
-        return self._search_vector_rounds(
+        Qr, T, dims = tokens.shape
+        # pow2-bucket the query axis like search_knn; padded rows are
+        # repeat-copies, sliced off below
+        Q = pow2_bucket(Qr, minimum=1)
+        if Q != Qr:
+            tokens = np.concatenate(
+                [tokens, np.repeat(tokens[:1], Q - Qr, axis=0)])
+        out = self._search_vector_rounds(
             field, tokens, k, dims,
+            # T is the encoder's token grid (repeat-padded to its bucket
+            # upstream — search/batch.py) and dims the mapping's embedding
+            # width: config-bounded shape classes  # tpulint: bucketed
             lambda D: _maxsim_program(self.mesh, self._programs, Q=Q, T=T,
                                       dims=dims, D=D, k=min(k, D),
                                       metric=metric),
             prog_name="mesh_maxsim")
+        return tuple(a[:Qr] if isinstance(a, np.ndarray) else a
+                     for a in out)
 
     def _search_vector_rounds(self, field: str, qarr: np.ndarray, k: int,
                               dims: int, make_prog,
@@ -980,14 +1010,18 @@ class MeshSearchExecutor:
                 from elasticsearch_tpu.monitor import kernels
 
                 kernels.record("executor_prep_miss")
+                # the live set is computed BEFORE the residency charge:
+                # _segments_of is fallible, and an exception between
+                # track() and the store below would strand the reservation
+                # (R020)
+                live_ids = {id(seg) for sh in self.shards
+                            for seg in _segments_of(sh)}
                 tok = resources.RESIDENCY.track(fresh_bytes,
                                                 label="executor.prep")
                 # prune entries keyed by segments that left the live set
                 # (a refresh/merge replaced them): their keys can never
                 # match again, but they would pin dead segments + device
                 # buffers until the LRU cycles
-                live_ids = {id(seg) for sh in self.shards
-                            for seg in _segments_of(sh)}
                 with self._prep_lock:
                     dead = [kk2 for kk2, ent in self._prep.items()
                             if any(id(s) not in live_ids for s in ent[4])]
@@ -1087,6 +1121,8 @@ class MeshSearchExecutor:
         """partials [S, ...] per-shard numeric agg tensors → summed [...]."""
         from elasticsearch_tpu.monitor.programs import REGISTRY, shape_sig
 
+        # partials' trailing shape is the compiled agg structure's output
+        # class (per-field vocab caps), not request data  # tpulint: bucketed
         prog = _psum_program(self.mesh, self._programs, partials.shape[1:])
         with REGISTRY.timed("mesh_psum", shape_sig((partials,))):
             return np.asarray(prog(self._put_sharded(partials)))

@@ -5,6 +5,14 @@ structure (postings slices, query term lists, doc counts) is padded to a
 power-of-two bucket. This bounds the number of compiled variants to
 O(log n) per program while keeping shapes static inside jit — the TPU
 analogue of Lucene's arbitrary-length postings iterators.
+
+``pow2_bucket``/``round_up`` are also tpulint's recognized
+lattice-lowering points: the shape-flow pass (R017, recompile storms)
+classifies any value that passed through them as PaddedPow2 —
+acceptable as a program cache key — while a raw ``len()``/``.shape``
+stays DataDependent and is flagged when it reaches a program factory
+or jit static. A size that must bypass bucketing for a documented
+reason is declared at the call site with ``# tpulint: bucketed``.
 """
 from __future__ import annotations
 

@@ -1872,7 +1872,7 @@ from s2.helper import merge
 
 def build(mesh):
     def body(x):
-        s = jax.lax.psum(x, "shard")
+        s = jax.lax.psum(x, "shard")  # tpulint: masked
         return merge(s)
     return jax.jit(shard_map(body, mesh=mesh, in_specs=None,
                              out_specs=None))
@@ -1894,7 +1894,7 @@ from jax import lax
 
 def make(mesh, wrap):
     def body(x, k):
-        t = lax.psum(x, "shard")
+        t = lax.psum(x, "shard")  # tpulint: masked
         n = int(t)
         return t.item() + n
     return wrap(body, None, None)
@@ -1916,7 +1916,7 @@ from jax import lax
 def make(mesh, wrap, shapes):
     def body(x):
         n = int(np.prod(shapes[0]))
-        return lax.psum(x[:n], "shard")
+        return lax.psum(x[:n], "shard")  # tpulint: masked
     return wrap(body, None, None)
 """,
         })

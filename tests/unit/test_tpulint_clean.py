@@ -2,12 +2,14 @@
 analyzer.
 
 One interprocedural pass (symbol table + call graph + traced-context
-inference + R013 lock graph + R014 collective purity) over
-`elasticsearch_tpu/` + `tools/` + `bench.py` in tier-1, failing on any
-violation not grandfathered in tools/tpulint/baseline.json. A new
-finding means the diff introduced a recompile hazard, a host sync
-reachable from a jit/shard_map body, a tracer leak, an unlocked
-shared-state write, a lock-order cycle, … Fix it, or (only with a
+inference + R013 lock graph + R014 collective purity + the pass-3
+shapeflow lattice behind R017–R020) over `elasticsearch_tpu/` +
+`tools/` + `bench.py` in tier-1, failing on any violation not
+grandfathered in tools/tpulint/baseline.json. A new finding means the
+diff introduced a recompile hazard, a host sync reachable from a
+jit/shard_map body, a tracer leak, an unlocked shared-state write, a
+lock-order cycle, a data-dependent dim riding a program cache key, an
+unmasked reduction over padded lanes, … Fix it, or (only with a
 reviewed justification) suppress in place with `# tpulint: allow[R0xx]`
 / add a baseline entry. See docs/STATIC_ANALYSIS.md.
 
@@ -177,6 +179,90 @@ def test_seeded_race_and_atomicity_overlays_caught():
                          root=str(REPO_ROOT))
     assert [v for v in clean if v.rule in ("R015", "R016")
             and v.path in (wpath, cpath)] == []
+
+
+def test_seeded_shapeflow_overlays_caught():
+    """Pass-3 (shapeflow) reach regression on REAL source: each of the
+    four v3 rules must fire on a violation seeded into the actual device
+    data plane — and the unseeded tree stays clean (the seeds are the
+    only diff). R017 is seeded twice: a len()-derived batch width handed
+    to a program factory from search/batch.py (cross-module flow), and
+    the executor's own query-axis bucketing reverted in place (exactly
+    the recompile storm the adoption pass fixed)."""
+    epath = "elasticsearch_tpu/parallel/executor.py"
+    esrc = (REPO_ROOT / epath).read_text()
+    bpath = "elasticsearch_tpu/search/batch.py"
+    bsrc = (REPO_ROOT / bpath).read_text()
+    rpath = "elasticsearch_tpu/resources/residency.py"
+    rsrc = (REPO_ROOT / rpath).read_text()
+    scope = [str(REPO_ROOT / "elasticsearch_tpu")]
+
+    # R017 (a): host batch.py feeds len(queries) straight into a factory
+    imp_anchor = "from elasticsearch_tpu.search.service import ShardDoc"
+    call_anchor = "    Q = len(queries)\n"
+    assert imp_anchor in bsrc and call_anchor in bsrc, \
+        "batch.py changed; update the R017 seed anchors"
+    bseed = bsrc.replace(imp_anchor, imp_anchor + (
+        "\nfrom elasticsearch_tpu.parallel.executor import "
+        "_knn_program  # seeded"), 1)
+    bseed = bseed.replace(call_anchor, call_anchor + (
+        "    _knn_program(None, {}, Q=Q, dims=4, D=8, k=k, "
+        "metric=\"dot\")  # seeded\n"), 1)
+
+    # R017 (b): revert the executor's query-axis pow2 bucketing
+    e17_anchor = ("        Qr = len(query_terms)\n"
+                  "        Q = pow2_bucket(Qr, minimum=1)")
+    assert e17_anchor in esrc, "executor changed; update the R017 anchor"
+    e17seed = esrc.replace(
+        e17_anchor, "        Qr = len(query_terms)\n"
+                    "        Q = Qr  # seeded", 1)
+
+    # R018/R019: seeded into the bm25 collective body itself
+    body_anchor = ("        scores = jax.vmap(score1)(sl(starts), "
+                   "sl(lens), sl(weights))  # [Q, D]")
+    assert body_anchor in esrc, "bm25 body changed; update the anchor"
+    e18seed = esrc.replace(
+        body_anchor, body_anchor + "\n        _dbg = jnp.sum(tfnorm)"
+        "  # seeded", 1)
+    e19seed = esrc.replace(
+        body_anchor, body_anchor +
+        "\n        _w = scores.astype(jnp.float64)  # seeded", 1)
+
+    # R020 (a): a fallible call between the executor's residency charge
+    # and the store that hands the token off
+    e20_anchor = ('                tok = resources.RESIDENCY.track('
+                  'fresh_bytes,\n                                     '
+                  '           label="executor.prep")')
+    assert e20_anchor in esrc, "prep charge moved; update the R020 anchor"
+    e20seed = esrc.replace(
+        e20_anchor, e20_anchor + "\n                "
+        "_audit_prep_entries(self.shards)  # seeded", 1)
+
+    # R020 (b): the same leak shape seeded into resources/ itself
+    r_anchor = ("    # -- pinned charges ------------------------------"
+                "-----------------------")
+    assert r_anchor in rsrc, "residency.py changed; update the anchor"
+    rseed = rsrc.replace(r_anchor, (
+        "    def seeded_prewarm(self, nbytes):  # seeded\n"
+        "        tok = self.track(int(nbytes), \"seed\")  # seeded\n"
+        "        self._rebuild_plan()  # seeded\n"
+        "        self._seed_tok = tok  # seeded\n\n") + r_anchor, 1)
+
+    for overlay, rule, path in [
+            ({bpath: bseed}, "R017", bpath),
+            ({epath: e17seed}, "R017", epath),
+            ({epath: e18seed}, "R018", epath),
+            ({epath: e19seed}, "R019", epath),
+            ({epath: e20seed}, "R020", epath),
+            ({rpath: rseed}, "R020", rpath)]:
+        found = lint_project(scope, root=str(REPO_ROOT), overlay=overlay)
+        hits = [v for v in found if v.rule == rule and v.path == path]
+        assert hits, f"seeded {rule} violation in {path} not caught"
+    # the unseeded tree stays clean of all four rules in those files
+    clean = lint_project(scope, root=str(REPO_ROOT))
+    assert [v for v in clean
+            if v.rule in ("R017", "R018", "R019", "R020")
+            and v.path in (epath, bpath, rpath)] == []
 
 
 def test_traced_inference_reaches_helpers():

@@ -9,7 +9,7 @@ or per-hit host sync creeps into a jitted path — failures that surface
 not as exceptions but as recompile storms and serialized device↔host
 ping-pong on TPU.
 
-tpulint v2 is a TWO-PASS analyzer: pass 1 (``tools/tpulint/project.py``)
+tpulint v3 is a THREE-PASS analyzer: pass 1 (``tools/tpulint/project.py``)
 builds a project-wide symbol table + call graph and infers which
 functions are transitively reachable from ``jax.jit`` / ``pallas_call``
 / ``shard_map`` bodies (traced reach), which sit inside collective
@@ -27,17 +27,30 @@ escaping compile attribution, R013 lock-order cycles + lock-held calls
 into unbounded waits, R014 collective purity, R015 Eraser-style
 lockset races (a write without the attribute's inferred/declared
 guard), R016 atomicity violations (check-then-act across a lock
-release). R002/R003/R004/R009 fire THROUGH helper calls — a violation
-two modules away from the jit body is found where it lives.
+release); pass 3 (``tools/tpulint/shapeflow.py``) is a symbolic
+shape-flow abstract interpreter over the pass-1 call graph — dims
+classify into a Concrete < PaddedPow2 < DataDependent lattice and flow
+interprocedurally — behind R017 recompile storms (a data-dependent dim
+riding a program-factory cache key or jit static arg), R018 padding
+soundness (an unmasked reduction over padded lanes in a collective
+body), R019 dtype discipline (f64/i64 spellings, mixed bf16×f32 MXU
+matmuls in traced code), and R020 reservation leaks (a
+breaker/residency charge with a fallible call before its
+commit/release). R002/R003/R004/R009 fire THROUGH helper calls — a
+violation two modules away from the jit body is found where it lives.
 
 Suppress a finding in place with ``# tpulint: allow[R0xx]`` on the line
 (or an immediately preceding comment line); mark intentional host-side
 build code with ``# tpulint: host``; declare an attribute's guarding
-lock with ``# tpulint: guarded_by(self._lock)``. Grandfathered sites
-live in ``tools/tpulint/baseline.json``.
+lock with ``# tpulint: guarded_by(self._lock)``; declare shapeflow
+invariants at the cast/pad point with ``# tpulint: bucketed`` /
+``masked`` / ``cast`` (≡ allow[R017]/[R018]/[R019]). Grandfathered
+sites live in ``tools/tpulint/baseline.json``; ``--prune-baseline``
+audits them against the live finding set.
 
 Run: ``python -m tools.tpulint [--changed [BASE]] [--json] [--sarif]
-[paths]``.
+[paths]`` — or install ``tools/tpulint/hooks/pre-commit`` to gate
+every commit on the changed-file subset.
 
 ``tools.tpulint.trace_audit`` is the runtime counterpart: it wraps
 ``jax.jit`` to count (re)traces per callable and assert an upper bound,

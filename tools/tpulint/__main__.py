@@ -95,15 +95,33 @@ def _changed_files(base: str) -> list:
     """Root-relative python files changed vs ``base``: tracked diffs
     PLUS untracked (not-yet-added) files — a brand-new module with
     violations must not pass the pre-commit mode clean just because
-    ``git add`` hasn't run yet."""
+    ``git add`` hasn't run yet.
+
+    ``--name-status -M`` (not ``--name-only``): a plain name listing
+    reports a renamed file under its OLD path, which no longer exists
+    and was silently skipped — a rename that also edits the file would
+    dodge the pre-commit gate entirely. Status parsing follows the
+    rename to the new path and drops deletions."""
     out = subprocess.run(
-        ["git", "diff", "--name-only", base, "--"],
+        ["git", "diff", "--name-status", "-M", base, "--"],
         cwd=REPO_ROOT, capture_output=True, text=True, check=True)
     untracked = subprocess.run(
         ["git", "ls-files", "--others", "--exclude-standard"],
         cwd=REPO_ROOT, capture_output=True, text=True, check=True)
     seen = []
-    for ln in out.stdout.splitlines() + untracked.stdout.splitlines():
+    for ln in out.stdout.splitlines():
+        parts = ln.rstrip().split("\t")
+        if len(parts) < 2:
+            continue
+        status = parts[0]
+        if status.startswith("D"):
+            continue  # deleted: nothing to lint
+        # renames/copies are "R###\told\tnew" — lint the NEW path
+        path = parts[2] if status[:1] in ("R", "C") and len(parts) > 2 \
+            else parts[1]
+        if path.endswith(".py") and path not in seen:
+            seen.append(path)
+    for ln in untracked.stdout.splitlines():
         ln = ln.strip()
         if ln.endswith(".py") and ln not in seen:
             seen.append(ln)
@@ -114,7 +132,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.tpulint",
         description="JAX/TPU-aware whole-program static analysis for "
-                    "elasticsearch_tpu (rules R001-R016; see "
+                    "elasticsearch_tpu (rules R001-R020; see "
                     "docs/STATIC_ANALYSIS.md)")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files or directories to lint (default: "
@@ -133,6 +151,14 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="write the current finding set to --baseline "
                          "and exit 0 (dev helper)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="audit --baseline for stale entries (findings "
+                         "that no longer fire); exits 1 when any are "
+                         "stale so the justified list can't rot silently")
+    ap.add_argument("--fix", action="store_true",
+                    help="with --prune-baseline: rewrite the baseline "
+                         "with live entries only (file removed when "
+                         "nothing survives)")
     ap.add_argument("--per-file", action="store_true",
                     help="single-file mode: skip the project call graph "
                          "(no traced-context inference, no R013/R014)")
@@ -184,6 +210,25 @@ def main(argv=None) -> int:
     except FileNotFoundError as e:
         print(f"tpulint: {e}", file=sys.stderr)
         return 2
+    if args.prune_baseline:
+        # staleness is judged against the FULL finding set — a --changed
+        # subset would mark every entry outside the diff stale
+        from tools.tpulint.baseline import prune_baseline
+
+        stale = prune_baseline(found, args.baseline, fix=args.fix)
+        for e in stale:
+            print(f"stale baseline entry: {e['rule']} {e['path']} "
+                  f"({e['dead']} of {e.get('count', 1)} unused) — "
+                  f"{e['snippet']!r}", file=sys.stderr)
+        if stale:
+            print(f"tpulint: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'}"
+                  + (" pruned" if args.fix else
+                     " (run with --fix to prune)"), file=sys.stderr)
+            return 0 if args.fix else 1
+        print("tpulint: baseline is live (no stale entries)",
+              file=sys.stderr)
+        return 0
     if report_only is not None:
         found = [v for v in found if v.path in report_only]
     if args.write_baseline:

@@ -61,6 +61,26 @@ RULES: Dict[str, str] = {
             "guarded check of an attribute and the guarded act that "
             "depends on it (check-then-act / get-or-create) — the state "
             "can change in the gap",
+    "R017": "recompile storm: a data-dependent dimension (len()/.shape "
+            "of host data/dict size, unbucketed) reaches a jit static "
+            "argument or a cached program factory — every distinct value "
+            "compiles a new program (unbounded shape-key census); bucket "
+            "it (pow2_bucket/round_up) or declare the call site "
+            "`# tpulint: bucketed`",
+    "R018": "padding soundness: a reduction (sum/max/top_k/segment_sum/"
+            "psum) over an operand carrying pow2-padded lanes with no "
+            "dominating validity mask (where/mask multiply/length mask) "
+            "— padded lanes leak into scores; mask first or declare the "
+            "operand `# tpulint: masked`",
+    "R019": "dtype discipline: bf16/f32 mixing on an MXU matmul path "
+            "outside a declared cast point, or a float64/int64 spelling "
+            "in traced code (silent f64/i64 promotion) — declare "
+            "intended casts `# tpulint: cast`",
+    "R020": "reservation leak: a breaker/residency acquisition (track/"
+            "put_array/force/break_or_reserve) with fallible calls before "
+            "the token is stored or released and no except/finally "
+            "release path — an exception strands the reservation and "
+            "wedges admission control",
 }
 
 # Per-rule severity, surfaced in --json for pre-commit tooling. `error`
@@ -73,7 +93,8 @@ SEVERITY: Dict[str, str] = {
     "R004": "error", "R005": "error", "R006": "warning", "R007": "warning",
     "R008": "warning", "R009": "error", "R010": "error", "R011": "warning",
     "R012": "warning", "R013": "error", "R014": "error", "R015": "error",
-    "R016": "error",
+    "R016": "error", "R017": "warning", "R018": "error", "R019": "error",
+    "R020": "error",
 }
 
 # R002 scope: files whose per-query work sits on the request hot path.
@@ -133,6 +154,16 @@ AUDIT_EXEMPT_MARKERS = ("/elasticsearch_tpu/ops/",
 _ALLOW_RE = re.compile(r"#\s*tpulint:\s*allow\[\s*([A-Z0-9,\s]+?)\s*\]")
 _HOST_RE = re.compile(r"#\s*tpulint:\s*host\b")
 _OFFBUDGET_RE = re.compile(r"#\s*tpulint:\s*offbudget\b")
+# shapeflow contracts (pass 3): each declares one invariant the abstract
+# interpreter cannot see and is equivalent to a targeted allow[...] —
+#   bucketed  — the dim is padded/bounded by construction upstream (R017)
+#   masked    — the padded lanes of this operand are neutral for the
+#               reduction (zero-padded, pre-selected, or mesh-invariant
+#               masked upstream) (R018)
+#   cast      — a declared dtype cast point on the MXU path (R019)
+_BUCKETED_RE = re.compile(r"#\s*tpulint:\s*bucketed\b")
+_MASKED_RE = re.compile(r"#\s*tpulint:\s*masked\b")
+_CAST_RE = re.compile(r"#\s*tpulint:\s*cast\b")
 
 
 @dataclass(frozen=True)
@@ -160,7 +191,10 @@ class Suppressions:
     ``host`` declares a statement as intentional host-side build code and
     is equivalent to ``allow[R003]``; ``offbudget`` declares a raw device
     placement as intentionally unaccounted (transient per-call upload)
-    and is equivalent to ``allow[R008]``.
+    and is equivalent to ``allow[R008]``. The shapeflow contracts
+    ``bucketed``/``masked``/``cast`` are equivalent to
+    ``allow[R017]``/``allow[R018]``/``allow[R019]`` and document the
+    invariant the abstract interpreter cannot derive.
     """
 
     def __init__(self, source: str):
@@ -176,6 +210,12 @@ class Suppressions:
                 rules.add("R003")
             if _OFFBUDGET_RE.search(text):
                 rules.add("R008")
+            if _BUCKETED_RE.search(text):
+                rules.add("R017")
+            if _MASKED_RE.search(text):
+                rules.add("R018")
+            if _CAST_RE.search(text):
+                rules.add("R019")
             if not rules:
                 continue
             covered = [i]

@@ -59,6 +59,48 @@ def filter_baselined(
     return new, old
 
 
+def prune_baseline(violations: Sequence[Violation],
+                   path: str = DEFAULT_BASELINE,
+                   fix: bool = False) -> List[dict]:
+    """Stale-entry audit: the justified-entry list must not rot. An entry
+    (or part of its occurrence ``count``) is stale when the analyzer no
+    longer produces a matching finding — the grandfathered site was fixed
+    or deleted, and keeping the entry would silently excuse a future
+    regression at the same fingerprint.
+
+    ``violations`` is the full un-baselined finding set. Returns the
+    stale entries (each with a ``dead`` count of unused occurrences).
+    With ``fix=True`` the file is rewritten with live counts only —
+    and deleted outright when nothing survives (an empty baseline needs
+    no file at all)."""
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        doc = json.load(fh)
+    actual = Counter(_fingerprint(v) for v in violations)
+    remaining = Counter(actual)
+    stale: List[dict] = []
+    live_entries: List[dict] = []
+    for entry in doc.get("violations", []):
+        key = (entry["rule"], entry["path"], entry["snippet"])
+        want = int(entry.get("count", 1))
+        live = min(want, remaining[key])
+        remaining[key] -= live
+        if live < want:
+            stale.append(dict(entry, dead=want - live))
+        if live > 0:
+            live_entries.append(dict(entry, count=live))
+    if fix and stale:
+        if live_entries:
+            doc["violations"] = live_entries
+            with open(path, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=2, sort_keys=False)
+                fh.write("\n")
+        else:
+            os.remove(path)
+    return stale
+
+
 def write_baseline(violations: Sequence[Violation], path: str,
                    justification: str = "grandfathered at gate adoption") -> dict:
     """Serialize the current finding set as the new baseline (dev helper

@@ -1836,6 +1836,7 @@ def lint_sources(sources: Dict[str, str]) -> List[Violation]:
 def lint_index(index: ProjectIndex) -> List[Violation]:
     from tools.tpulint import analyzer as _an
     from tools.tpulint import rules as _rules
+    from tools.tpulint import shapeflow as _shapeflow
 
     out: List[Violation] = []
     for rec in index.records.values():
@@ -1846,6 +1847,11 @@ def lint_index(index: ProjectIndex) -> List[Violation]:
         found = _rules.check_module(rec.tree, ctx)
         out.extend(v for v in found if not rec.supp.suppressed(v))
     for v in _project_violations(index):
+        rec = index.by_path.get(v.path)
+        if rec is not None and rec.supp.suppressed(v):
+            continue
+        out.append(v)
+    for v in _shapeflow.shapeflow_violations(index):
         rec = index.by_path.get(v.path)
         if rec is not None and rec.supp.suppressed(v):
             continue
