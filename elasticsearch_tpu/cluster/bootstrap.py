@@ -245,6 +245,12 @@ class MultiHostCluster:
             DistributedDataService
 
         self.data = DistributedDataService(self)
+        from elasticsearch_tpu.cluster.allocator import ClusterAllocator
+
+        # the live allocation loop: master-driven desired-vs-actual
+        # placement reconciliation (join rebalancing, watermark relief,
+        # drain) — ticked from joins, settings changes, and fd rounds
+        self.allocator = ClusterAllocator(self)
         # REST handlers route dist-index operations through the data
         # plane when this hook is present (rest/server.py::_mh)
         node.multihost = self
@@ -514,6 +520,10 @@ class MultiHostCluster:
             raise FailedToCommitClusterStateException(
                 "join could not be committed: publish lost quorum")
         self.data.start_recoveries(directives)  # async internally
+        # rebalance ONTO the joiner: top-up only covers under-replicated
+        # shards — a fully-replicated cluster still wants existing copies
+        # spread onto the new capacity (async; throttled by the deciders)
+        self.allocator.kick("node-join")
         # gateway allocation: shards that lost EVERY copy (e.g. a master
         # restart while this member was away) adopt the joiner's on-disk
         # data — async, it probes over the transport
@@ -535,6 +545,7 @@ class MultiHostCluster:
             self._bump_indices_version()
         if self._publish():
             self.data.start_recoveries(directives)
+        self.allocator.kick("node-leave")
         return {"ok": True}
 
     def _on_state_brief(self, payload: dict) -> dict:
@@ -1410,6 +1421,10 @@ class MultiHostCluster:
             self._fd_rounds += 1
             if self._fd_rounds % 5 == 0:
                 self._heal_lagging_followers(others)
+            # the allocation loop's periodic heartbeat (rate-limited
+            # internally): drains progress, watermark pressure gets
+            # relief, and parked moves retry without a membership event
+            self.allocator.maybe_tick()
         elif state.master_node_id is not None:
             self._master_fd.check(state.nodes.get(state.master_node_id))
         else:
@@ -1562,6 +1577,7 @@ class MultiHostCluster:
 
     def close(self) -> None:
         self._stop.set()
+        self.allocator.close()
         if not self.is_master:
             try:
                 self.transport.send_remote(

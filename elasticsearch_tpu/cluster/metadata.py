@@ -45,6 +45,14 @@ def _flatten(settings: dict, prefix: str = "") -> Dict[str, object]:
     return out
 
 
+#: public name for cross-layer consumers (reference: Settings.flatten —
+#: ES accepts nested AND dotted settings bodies everywhere; the cluster
+#: settings route uses this so `{"cluster": {"routing": ...}}` and
+#: `"cluster.routing...."` land as the same dotted keys the allocator,
+#: breakers, and serving services key their live-apply maps by)
+flatten_settings = _flatten
+
+
 def update_index_settings(svc, body: dict, node=None) -> dict:
     """PUT /{index}/_settings — dynamic settings only on an open index.
 

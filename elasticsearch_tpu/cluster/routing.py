@@ -15,7 +15,7 @@ changing the algorithm.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticsearch_tpu.cluster.state import DiscoveryNode, ShardRouting
 from elasticsearch_tpu.utils.hashing import routing_hash
@@ -31,7 +31,8 @@ def shard_id_for(doc_id: str, num_shards: int, routing: Optional[str] = None) ->
     return routing_hash(key) % num_shards
 
 
-def select_primary(owners: List[str], in_sync: List[str]) -> List[str]:
+def select_primary(owners: List[str], in_sync: List[str],
+                   checkpoints: Optional[Dict[str, int]] = None) -> List[str]:
     """The replication-safety promotion rule (reference: the allocation
     pass promoting primaries from the in-sync allocation ids): reorder
     ``owners`` so an IN-SYNC copy leads. A copy that missed an
@@ -39,17 +40,34 @@ def select_primary(owners: List[str], in_sync: List[str]) -> List[str]:
     that would silently roll back acks — so when NO in-sync copy
     survives, the answer is an empty list (shard red; gateway
     resurrection may later re-adopt from on-disk data) rather than a
-    non-in-sync promotion. Used by the master's reconcile pass
-    (cluster/search_action.py) on every membership change."""
+    non-in-sync promotion.
+
+    Among the promotable in-sync copies, ``checkpoints`` (node id →
+    local checkpoint, best-effort) breaks the tie by RECENCY: the copy
+    with the highest local checkpoint wins, so the promotion's follow-up
+    re-replication replays the shortest op suffix to the other
+    survivors. Copies with no report sort below any reported one (an
+    unreachable copy must not out-rank a known-fresh one on position
+    alone); with no map at all the owners order decides, as before.
+    Used by the master's reconcile pass (cluster/search_action.py) on
+    every membership change."""
     if not owners:
         return []
     if owners[0] in in_sync:
+        # the sitting primary survived in-sync: no promotion happens, so
+        # recency must not reorder (a spurious reorder would bump the
+        # term and fence in-flight ops for nothing)
         return list(owners)
     promotable = [o for o in owners if o in in_sync]
     if not promotable:
         return []
-    first = promotable[0]
-    return [first] + [o for o in owners if o != first]
+    if checkpoints:
+        best = max(promotable,
+                   key=lambda o: (checkpoints.get(o, -2),
+                                  -owners.index(o)))
+    else:
+        best = promotable[0]
+    return [best] + [o for o in owners if o != best]
 
 
 # -- allocation deciders -------------------------------------------------------
@@ -127,6 +145,142 @@ class ThrottlingDecider(Decider):
         return THROTTLE if initializing >= self.concurrent else ALWAYS
 
 
+class WatermarkDecider(Decider):
+    """HBM/host-pressure watermarks over the breakers' ``ESTPU_HBM_BYTES``
+    capacity (reference: DiskThresholdDecider, with device memory in
+    place of disk). Three thresholds, ES
+    ``cluster.routing.allocation.disk.watermark.*`` grammar (percent or
+    absolute byte-size strings):
+
+    - **low** — no NEW shard copy is allocated to a node at/over it
+      (relocations already under way complete);
+    - **high** — the allocator actively moves shards OFF the node
+      (:meth:`over_high`);
+    - **flood_stage** — the node is an emergency: besides ``NO`` here,
+      the allocator treats its shards as first to move.
+
+    ``usage_fn(node_id) -> (used_bytes, capacity_bytes)`` supplies the
+    live signal (the allocator's cached per-node usage probe); a node
+    with no report allocates freely (an unknown must not strand
+    recovery — the reference likewise allocates when disk info is
+    missing)."""
+
+    name = "watermark"
+
+    def __init__(self, usage_fn: Callable[[str], Optional[Tuple[int, int]]],
+                 low: str = "85%", high: str = "90%",
+                 flood_stage: str = "95%"):
+        self.usage_fn = usage_fn
+        self.set_watermarks(low, high, flood_stage)
+
+    def set_watermarks(self, low, high, flood_stage) -> None:
+        self.low, self.high, self.flood_stage = (str(low), str(high),
+                                                 str(flood_stage))
+
+    def _threshold(self, spec: str, capacity: int) -> int:
+        from elasticsearch_tpu.resources.breakers import parse_limit
+
+        return parse_limit(spec, capacity)
+
+    def level(self, node_id: str) -> str:
+        """``ok`` | ``low`` | ``high`` | ``flood`` — the `_cat/allocation`
+        watermark column and the allocator's move-away trigger."""
+        usage = self.usage_fn(node_id)
+        if usage is None:
+            return "ok"
+        used, capacity = usage
+        if capacity <= 0:
+            return "ok"
+        for name, spec in (("flood", self.flood_stage), ("high", self.high),
+                           ("low", self.low)):
+            limit = self._threshold(spec, capacity)
+            if limit >= 0 and used >= limit:
+                return name
+        return "ok"
+
+    def over_high(self, node_id: str) -> bool:
+        return self.level(node_id) in ("high", "flood")
+
+    def can_allocate(self, shard, node, allocation):
+        return NO if self.level(node.node_id) != "ok" else ALWAYS
+
+
+class LoadDecider(Decider):
+    """Serving-pressure signal over the live ``estpu_*`` families
+    (per-shard qps, breaker trips, residency eviction churn — the
+    allocator's usage probe aggregates them into one per-node score).
+    A node whose score is over ``factor ×`` the fleet mean is too hot to
+    receive MORE work: rebalancing toward it throttles (it stays a legal
+    last resort — recovery of a red shard outranks load shaping, so this
+    decider never answers NO)."""
+
+    name = "load"
+
+    def __init__(self, load_fn: Callable[[str], Optional[float]],
+                 mean_fn: Callable[[], float], factor: float = 2.0):
+        self.load_fn = load_fn
+        self.mean_fn = mean_fn
+        self.factor = factor
+
+    def can_allocate(self, shard, node, allocation):
+        score = self.load_fn(node.node_id)
+        if score is None:
+            return ALWAYS
+        mean = self.mean_fn()
+        if mean <= 0.0:
+            return ALWAYS
+        return THROTTLE if score > self.factor * mean else ALWAYS
+
+
+class ClusterFilterDecider(Decider):
+    """Cluster-level ``cluster.routing.allocation.{include,exclude,
+    require}._name/_id`` (reference: the cluster-scope half of
+    FilterAllocationDecider) — the node-drain lever: setting
+    ``exclude._name`` makes every copy on the named nodes illegal, and
+    the allocator relocates them away. Values are comma-separated exact
+    names/ids."""
+
+    name = "cluster_filter"
+
+    def __init__(self):
+        self.include: Dict[str, str] = {}
+        self.exclude: Dict[str, str] = {}
+        self.require: Dict[str, str] = {}
+
+    def apply_cluster_settings(self, flat: Dict[str, object]) -> None:
+        """Rebuild from the MERGED settings map (absent key = reset),
+        the same idempotent contract as the breaker service."""
+        prefix = "cluster.routing.allocation."
+        for rule in ("include", "exclude", "require"):
+            d: Dict[str, str] = {}
+            for k, v in flat.items():
+                if k.startswith(f"{prefix}{rule}.") and v is not None:
+                    d[k[len(prefix) + len(rule) + 1:]] = str(v)
+            setattr(self, rule, d)
+
+    def excludes(self, node: DiscoveryNode) -> bool:
+        """True when ``node`` is named by an exclude/require rule — the
+        drain trigger (can_allocate vetoes NEW copies; this answers
+        whether EXISTING copies must move away)."""
+        return self.can_allocate(None, node, None) == NO
+
+    def can_allocate(self, shard, node, allocation):
+        attrs = dict(node.attributes)
+        attrs.setdefault("_name", node.name)
+        attrs.setdefault("_id", node.node_id)
+        for k, v in self.require.items():
+            if not FilterDecider._matches(v, attrs.get(k)):
+                return NO
+        for k, v in self.exclude.items():
+            if FilterDecider._matches(v, attrs.get(k)):
+                return NO
+        if self.include:
+            if not any(FilterDecider._matches(v, attrs.get(k))
+                       for k, v in self.include.items()):
+                return NO
+        return ALWAYS
+
+
 @dataclass
 class Allocation:
     """Mutable allocation round state."""
@@ -154,6 +308,21 @@ class ShardAllocator:
             if v == THROTTLE:
                 verdict = THROTTLE
         return verdict
+
+    def decide_verbose(self, shard: ShardRouting, node: DiscoveryNode,
+                       allocation: Allocation) -> List[dict]:
+        """Every decider's individual verdict — the ``?explain`` payload
+        of ``POST /_cluster/reroute`` (reference: RerouteExplanation's
+        Decision.Multi, one entry per decider)."""
+        out: List[dict] = []
+        for d in self.deciders:
+            v = d.can_allocate(shard, node, allocation)
+            out.append({"decider": d.name, "decision": v,
+                        "explanation":
+                            f"[{d.name}] answered {v} for "
+                            f"[{shard.index}][{shard.shard_id}] on "
+                            f"node [{node.node_id}]"})
+        return out
 
     def allocate_index(self, index: str, num_shards: int, num_replicas: int,
                        nodes: List[DiscoveryNode],

@@ -67,6 +67,9 @@ ACTION_APPLY_GLOBAL = "cluster:admin/apply_global_state"
 ACTION_BY_QUERY = "indices:data/write/by_query"
 ACTION_REST_PROXY = "internal:rest/proxy"
 ACTION_CANCEL_TASKS = "cluster:admin/tasks/cancel"
+ACTION_ALLOC_USAGE = "cluster:monitor/allocation/usage"
+ACTION_SHARD_CKPT = "indices:monitor/shard_checkpoint"
+ACTION_CLUSTER_SETTINGS = "cluster:admin/settings/apply"
 
 _CONTEXT_TTL = 120.0
 # coordinator-side cap on one search's scatter+fetch wall time when the
@@ -159,6 +162,9 @@ class DistributedDataService:
         t.register(ACTION_BY_QUERY, self._on_by_query)
         t.register(ACTION_REST_PROXY, self._on_rest_proxy)
         t.register(ACTION_CANCEL_TASKS, self._on_cancel_tasks)
+        t.register(ACTION_ALLOC_USAGE, lambda p: self.local_alloc_usage())
+        t.register(ACTION_SHARD_CKPT, self._on_shard_ckpt)
+        t.register(ACTION_CLUSTER_SETTINGS, self._on_cluster_settings)
         self._proxy_controller = None
 
     # -- ownership -----------------------------------------------------------
@@ -1436,7 +1442,115 @@ class DistributedDataService:
             realtime=payload.get("realtime", True),
             with_meta=payload.get("meta", False))
 
+    # -- allocation signals ---------------------------------------------------
+
+    def local_alloc_usage(self) -> dict:
+        """This node's placement signals for the allocator's usage probe
+        (and the multihost `_cat/allocation` row): HBM bytes from the
+        breaker hierarchy + device-resident residency bytes over the
+        ``ESTPU_HBM_BYTES`` capacity, local copy count from the published
+        metadata, and a serving-load score folding per-shard query totals
+        with breaker-trip and eviction churn (the live ``estpu_*``
+        families the LoadDecider steers by)."""
+        from elasticsearch_tpu import resources
+
+        used, capacity = resources.BREAKERS.hbm_usage()
+        bstats = resources.BREAKERS.stats()
+        tripped = sum(int(b.get("tripped", 0)) for b in bstats.values())
+        rstats = resources.RESIDENCY.stats()
+        evictions = sum(int(t.get("evictions", 0))
+                        for t in rstats.get("tiers", {}).values())
+        local = self._local_id()
+        shards = 0
+        with self.cluster._indices_lock:
+            for meta in self.cluster.dist_indices.values():
+                for sid in range(int(meta.get("num_shards", 0))):
+                    owners = meta["assignment"].get(str(sid), [])
+                    if local in owners:
+                        shards += 1
+        queries = 0
+        for svc in list(self.node.indices.values()):
+            for shard in getattr(svc, "shards", []):
+                try:
+                    queries += int(shard.searcher.stats.query_total)
+                except Exception:  # tpulint: allow[R006] — a stats-less
+                    pass           # shard must not fail the probe
+        return {"hbm_used": used, "hbm_capacity": capacity,
+                "shards": shards,
+                "load": float(queries + 10 * tripped + evictions),
+                "queries": queries, "breaker_trips": tripped,
+                "evictions": evictions}
+
+    def _on_shard_ckpt(self, payload: dict) -> dict:
+        """This copy's local checkpoint — the recency signal the master's
+        promotion pass ranks in-sync survivors by (the copy with the
+        highest checkpoint replays the shortest suffix)."""
+        svc = self.node.indices.get(payload["index"])
+        if svc is None:
+            return {"checkpoint": NO_OPS_PERFORMED}
+        return {"checkpoint":
+                svc.shards[payload["shard"]].engine.local_checkpoint}
+
+    def _on_cluster_settings(self, payload: dict) -> dict:
+        """Adopt a peer's ``PUT /_cluster/settings`` broadcast: persist
+        the raw persistent/transient structure and re-apply the MERGED
+        map to every live consumer (breakers, serving, allocator) — so a
+        drain exclusion PUT to ANY node reaches the master's allocator."""
+        self.node.cluster_settings = payload["cluster_settings"]
+        merged = payload.get("merged") or {}
+        from elasticsearch_tpu import resources
+
+        resources.apply_cluster_settings(merged)
+        serving = getattr(self.node, "serving", None)
+        if serving is not None:
+            serving.apply_cluster_settings(merged)
+        alloc = getattr(self.cluster, "allocator", None)
+        if alloc is not None:
+            alloc.apply_cluster_settings(merged)
+        return {"acknowledged": True}
+
     # -- shard recovery / relocation -----------------------------------------
+
+    def _promotion_checkpoints(self) -> Dict[Tuple[str, int],
+                                             Dict[str, int]]:
+        """Local checkpoints of the promotion candidates, for every shard
+        whose primary died leaving MORE than one in-sync survivor —
+        promotion should pick the copy with the highest checkpoint so the
+        new primary replays the shortest suffix. Best-effort and outside
+        the indices lock: an unreachable candidate just drops out of the
+        map (select_primary falls back to owner order, which is never
+        unsafe — every candidate is in-sync)."""
+        alive = set(self.node.cluster_state.nodes)
+        wanted: Dict[Tuple[str, int], List[str]] = {}
+        with self.cluster._indices_lock:
+            for name, meta in self.cluster.dist_indices.items():
+                for sid in range(int(meta.get("num_shards", 0))):
+                    owners = meta["assignment"].get(str(sid), [])
+                    if not owners or owners[0] in alive:
+                        continue  # no promotion pending for this shard
+                    insync = set(self._shard_in_sync(meta, sid))
+                    survivors = [o for o in owners
+                                 if o in alive and o in insync]
+                    if len(survivors) > 1:
+                        wanted[(name, sid)] = survivors
+        out: Dict[Tuple[str, int], Dict[str, int]] = {}
+        for (name, sid), cands in wanted.items():
+            m: Dict[str, int] = {}
+            for nid in cands:
+                try:
+                    if nid == self._local_id():
+                        m[nid] = self.node.indices[name].shards[sid] \
+                            .engine.local_checkpoint
+                    else:
+                        m[nid] = int(self._send(
+                            nid, ACTION_SHARD_CKPT,
+                            {"index": name, "shard": sid},
+                            timeout=2.0)["checkpoint"])
+                except Exception:
+                    continue
+            if m:
+                out[(name, sid)] = m
+        return out
 
     def reconcile(self):
         """Master-side allocation pass after a membership change: drop dead
@@ -1451,6 +1565,8 @@ class DistributedDataService:
         states; recovery itself mirrors RecoverySourceHandler phase 1/2 as
         ops-based streaming (see index/recovery.py for why shipping live
         docs IS our segment copy)."""
+        # checkpoint probe OUTSIDE the lock: it sends transport requests
+        ckpts = self._promotion_checkpoints()
         with self.cluster._indices_lock:
             alive = set(self.node.cluster_state.nodes)
             order = sorted(alive)
@@ -1478,7 +1594,8 @@ class DistributedDataService:
                     from elasticsearch_tpu.cluster.routing import \
                         select_primary
 
-                    reordered = select_primary(owners, insync)
+                    reordered = select_primary(owners, insync,
+                                               ckpts.get((name, sid)))
                     if reordered != owners:
                         owners = reordered
                         changed = True
@@ -1661,24 +1778,42 @@ class DistributedDataService:
         recovery races the metadata publish — create it from the
         directive's body."""
         index, sid = payload["index"], payload["shard"]
+        if payload.get("relocate"):
+            # allocator-driven move: the deterministic wedge point — an
+            # armed fault fails the stream BEFORE any registry entry or
+            # index creation, so the relocation watchdog's cancel +
+            # reschedule path is what recovers, not local cleanup
+            FAULTS.check("relocation.stream", index=index, shard=sid,
+                         source=payload["source"],
+                         target=self._local_id())
         with self.cluster._indices_lock:
             if not self.node.index_exists(index):
                 self.node.create_index(index, payload.get("body"))
         svc = self.node.indices[index]
         engine = svc.shards[sid].engine
         ckpt = engine.local_checkpoint
-        rec = svc.recoveries.start(sid, "peer",
-                                   source=payload["source"],
-                                   target=self._local_id())
+        rec = svc.recoveries.start(
+            sid, "relocation" if payload.get("relocate") else "peer",
+            source=payload["source"], target=self._local_id())
         copied = skipped = replayed = 0
         from elasticsearch_tpu.utils.errors import (DocumentMissingException,
                                                     VersionConflictException)
 
         try:
-            res = self._send(payload["source"], ACTION_SHARD_SYNC,
-                             {"index": index, "shard": sid,
-                              "checkpoint": ckpt,
-                              "last_term": engine.term_at(ckpt)},
+            req = {"index": index, "shard": sid, "checkpoint": ckpt,
+                   "last_term": engine.term_at(ckpt),
+                   "target": self._local_id()}
+            try:
+                # fleet-wide AOT distribution (ROADMAP #6): tell the
+                # source which compiled-program blobs we already hold —
+                # it ships the delta beside the docs/ops, so this node
+                # never compiles a program a peer already compiled
+                from elasticsearch_tpu.index import ivf_cache
+
+                req["aot_have"] = ivf_cache.list_blob_keys("aotx")
+            except Exception:  # tpulint: allow[R006] — blob-tier probe
+                pass           # must never fail a recovery handshake
+            res = self._send(payload["source"], ACTION_SHARD_SYNC, req,
                              timeout=60.0)
             # child task on the TARGET node (parent: the driving recovery
             # task, via the wire header): a cancel aborts the replay
@@ -1771,6 +1906,11 @@ class DistributedDataService:
                          (res.get("term_seq") or {}).items()},
                         int(res.get("local_checkpoint", -1)),
                         int(res.get("term", 0)))
+            # seed the peer-compiled AOT blobs that rode the stream (the
+            # compile-cache then answers `seeded`, never `fresh`, for
+            # these programs — the chaos gate's compile-delta-0 check)
+            rec["aot_seeded"] = self._adopt_aot_blobs(
+                res.get("aot_blobs"))
             rec["stage"] = "finalize"
             svc.shards[sid].engine.refresh()
             svc.recoveries.finish(rec, ok=True)
@@ -1857,6 +1997,62 @@ class DistributedDataService:
         if census.adopt_census(index, payload):
             stamp()
 
+    #: cap on AOT executor bytes shipped per shard_sync reply — blobs
+    #: ride the JSON transport base64-encoded, and one reply must not
+    #: dwarf the doc payload it accompanies (the next handshake of the
+    #: same relocation ships the remainder: the target re-sends its
+    #: updated `aot_have` and the delta shrinks)
+    _AOT_SHIP_MAX_BYTES = 32 << 20
+
+    def _adopt_aot_blobs(self, blobs: Optional[dict]) -> int:
+        """Target side: seed peer-shipped `.aotx` executor blobs into the
+        local blob tier (skip-if-exists — content-addressed keys make the
+        skip safe). Returns the count seeded; never raises."""
+        if not blobs:
+            return 0
+        import base64
+
+        from elasticsearch_tpu.index import ivf_cache
+
+        n = 0
+        for key, b64 in blobs.items():
+            try:
+                ivf_cache.store_blob(key, base64.b64decode(b64), "aotx",
+                                     overwrite=False)
+                n += 1
+            except Exception:
+                continue  # one bad blob must not drop the rest
+        return n
+
+    def _export_aot_blobs(self, have, target) -> Optional[dict]:
+        """Source side: the `.aotx` blobs the target reported missing,
+        base64 for the JSON transport, size-capped, debounced per target
+        node (a P-shard relocation's handshakes would otherwise re-scan
+        and re-ship the same delta P times — the census-window pattern,
+        keyed by target instead of index)."""
+        if have is None or target is None:
+            return None
+        hit, stamp = self._census_window("_aot_export_ts", str(target))
+        if hit:
+            return None
+        import base64
+
+        from elasticsearch_tpu.index import ivf_cache
+
+        missing = set(ivf_cache.list_blob_keys("aotx")) - set(have)
+        out: Dict[str, str] = {}
+        total = 0
+        for key in sorted(missing):
+            blob = ivf_cache.load_blob(key, "aotx")
+            if blob is None:
+                continue
+            if total + len(blob) > self._AOT_SHIP_MAX_BYTES:
+                break  # remainder ships on the NEXT handshake's delta
+            total += len(blob)
+            out[key] = base64.b64encode(blob).decode("ascii")
+        stamp()
+        return out or None
+
     def _on_shard_sync(self, payload: dict) -> dict:
         """Recovery source: checkpoint comparison first — when the
         target's history is a clean prefix (log-matching on the term at
@@ -1882,6 +2078,15 @@ class DistributedDataService:
                 resp["census"] = self._export_census_debounced(
                     payload["index"])
             except Exception:  # tpulint: allow[R006] — warmup plumbing
+                pass           # must never fail a recovery handshake
+            # AOT executor delta beside the census (ROADMAP #6's open
+            # half): the target sent the keys it holds; ship the rest
+            try:
+                blobs = self._export_aot_blobs(payload.get("aot_have"),
+                                               payload.get("target"))
+                if blobs:
+                    resp["aot_blobs"] = blobs
+            except Exception:  # tpulint: allow[R006] — blob shipping
                 pass           # must never fail a recovery handshake
             return resp
         finally:

@@ -291,6 +291,27 @@ def delete_blob(key: str, ext: str) -> None:
             pass
 
 
+def list_blob_keys(ext: str) -> List[str]:
+    """Every key currently stored under ``ext``: memory tier plus each
+    registered directory. This is the delta input for fleet-wide AOT
+    blob distribution — a recovery target sends the `.aotx` keys it
+    already HAS in its shard_sync request, and the source ships only the
+    complement, so a joining node never compiles a program any peer
+    already compiled."""
+    prefix = f"{ext}:"
+    with _LOCK:
+        keys = {k[len(prefix):] for k in _MEM if k.startswith(prefix)}
+        dirs = list(_DIRS)
+    suffix = f".{ext}"
+    for d in dirs:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            continue
+        keys.update(n[:-len(suffix)] for n in names if n.endswith(suffix))
+    return sorted(keys)
+
+
 def _seed(mkey: str, blob: bytes, paths: List[str],
           overwrite: bool = False) -> None:
     with _LOCK:

@@ -39,13 +39,20 @@ class RecoveryRegistry:
     RecoveriesCollection + RecoveryState). Entries are plain dicts the
     running recovery mutates in place:
 
-        shard, type ("gateway"|"replica"|"peer"), mode ("ops"|"full"),
+        shard, type ("gateway"|"replica"|"peer"|"relocation"), mode
+        ("ops"|"full"),
         stage ("init"|"index"|"translog"|"finalize"|"done"|"failed"),
         source, target, ops_replayed, docs_copied, docs_skipped,
         start_millis, total_time_in_millis
 
     ``mode`` is the acceptance-visible bit: "ops" proves the recovery
-    replayed a translog suffix instead of re-shipping the shard."""
+    replayed a translog suffix instead of re-shipping the shard.
+    ``type=relocation`` marks allocator-driven moves (the live
+    allocation loop — cluster/allocator.py); their entries additionally
+    carry ``aot_seeded``, the count of peer-compiled ``.aotx`` executor
+    blobs that rode the stream into this node's blob tier (fleet-wide
+    AOT distribution: a joining node must compile nothing a peer
+    already compiled)."""
 
     def __init__(self, max_entries: int = 64):
         self._lock = threading.Lock()

@@ -31,6 +31,13 @@ detector              trips when
 ``coalescer_drain``   the serving coalescer's oldest parked request has
                       waited orders of magnitude past the micro-batch
                       window — the drain thread is wedged or dead.
+``relocation_stall``  an allocator-driven shard relocation has been in
+                      flight longer than the bound — the recovery stream
+                      to the target is wedged (``relocation.stream``
+                      fault, dead target, hung transport). The trip also
+                      ACTS: it cancels the move through the allocator
+                      (releasing its throttle slot) and reschedules it
+                      on a different target with the wedged one banned.
 ====================  ======================================================
 
 A trip increments ``estpu_watchdog_trips_total{detector}``, records a
@@ -68,7 +75,7 @@ from elasticsearch_tpu.utils.faults import FAULTS
 
 #: detector names — the stable label set of estpu_watchdog_trips_total
 DETECTORS = ("program_stall", "threadpool_starve", "translog_fsync",
-             "publish_stall", "coalescer_drain")
+             "publish_stall", "coalescer_drain", "relocation_stall")
 
 
 def hot_threads_snapshot(limit: int = 32) -> List[dict]:
@@ -121,6 +128,10 @@ class WatchdogService:
         "fsync_bound_s": 1.0,
         "publish_bound_s": 10.0,
         "coalescer_bound_s": 2.0,
+        # relocation_stall: a healthy stream finishes in seconds even
+        # for big shards (ops ride one transport round); a minute of
+        # flight means the stream is wedged, not slow
+        "relocation_bound_s": 60.0,
         # per-detector incident cooldown: within it a trip still counts
         # and records, but no new dump is captured
         "cooldown_s": 30.0,
@@ -214,7 +225,7 @@ class WatchdogService:
         trips: List[dict] = []
         for check in (self._check_programs, self._check_threadpools,
                       self._check_fsync, self._check_publish,
-                      self._check_coalescer):
+                      self._check_coalescer, self._check_relocations):
             try:
                 trips.extend(check())
             except Exception:
@@ -445,6 +456,45 @@ class WatchdogService:
             self.node.flight.record("slow_ops", detector="coalescer_drain",
                                     **detail)
         return []
+
+    def _check_relocations(self) -> List[dict]:
+        """Stuck-relocation detector (master-side: only the master's
+        allocator holds in-flight moves): a move whose stream has been
+        in flight past the bound is cancelled AND rescheduled onto a
+        different target — the one detector that acts, because a wedged
+        relocation holds a throttle slot that starves every later move
+        (drains would never converge)."""
+        alloc = getattr(getattr(self.node, "multihost", None),
+                        "allocator", None)
+        if alloc is None:
+            return []
+        bound = self.config["relocation_bound_s"]
+        trips = []
+        for mv in alloc.inflight_snapshot():
+            if mv.get("cancelled"):
+                continue  # already being torn down; don't double-trip
+            age = mv["age_seconds"]
+            detail = dict(mv, age_seconds=round(age, 3),
+                          bound_seconds=bound)
+            if age > bound:
+                trips.append(self._trip(
+                    "relocation_stall",
+                    f"relocation [{mv['index']}][{mv['shard']}] "
+                    f"{mv['source']}->{mv['target']} in flight "
+                    f"{age:.1f}s (bound {bound:.1f}s) — cancelling and "
+                    f"rescheduling", detail))
+                try:
+                    alloc.cancel_relocation(
+                        (mv["index"], mv["shard"], mv["target"]),
+                        reschedule=True, reason="watchdog trip")
+                except Exception:
+                    pass  # the trip evidence stands even if the
+                    # cancel races the stream finishing
+            elif age > bound / 2.0:
+                self.node.flight.record("slow_ops",
+                                        detector="relocation_stall",
+                                        **detail)
+        return trips
 
     # -- trip → incident -----------------------------------------------------
 

@@ -258,6 +258,18 @@ class CircuitBreakerService:
                 ov = flat.get(prefix + "overhead")
                 br.overhead = float(ov) if ov is not None else overhead
 
+    def hbm_usage(self) -> "tuple[int, int]":
+        """``(used_bytes, capacity_bytes)`` snapshot for watermark
+        reads: the parent's combined child bytes (which already include
+        device-resident residency charges) over the capacity limits
+        resolve against. One locked sum instead of the full ``stats()``
+        render — the allocator probes this on every usage refresh and
+        the disk-watermark deciders compare it against the
+        ``cluster.routing.allocation.disk.watermark.*`` thresholds."""
+        with self._lock:
+            return (sum(c.used for c in self._children.values()),
+                    self.capacity)
+
     def stats(self) -> dict:
         """``/_nodes/stats/breaker`` section (reference:
         AllCircuitBreakerStats.toXContent shape)."""

@@ -1523,6 +1523,34 @@ def _cat_allocation(n: Node, p, b, nodeid: Optional[str] = None):
     import shutil
 
     nid = nodeid or p.get("node_id")
+    c = _mh(n)
+    if c is not None and "_local_only" not in p:
+        # multi-host: one row per member with its copy count, HBM bytes
+        # over the breakers' capacity, and watermark state — the same
+        # usage fan the allocator's deciders read, so the table an
+        # operator sees IS the signal placement runs on (drain runbook:
+        # a draining node's `shards` column reaching 0 means kill-safe)
+        alloc = c.allocator
+        rows = []
+        for node_id in sorted(c.node.cluster_state.nodes):
+            dn = c.node.cluster_state.nodes[node_id]
+            if nid and nid not in ("_master", "_local", "_all", "*",
+                                   node_id, dn.name):
+                continue
+            r = alloc._probe(node_id) or {}
+            used = int(r.get("hbm_used", 0))
+            cap = int(r.get("hbm_capacity", 0))
+            rows.append({
+                "shards": str(r.get("shards", 0)),
+                "hbm.used": _human_size(used),
+                "hbm.total": _human_size(cap),
+                "hbm.percent": str(int(used * 100 / cap)) if cap else "-",
+                "watermark": alloc.watermark_level(node_id),
+                "draining": str(alloc.filter.excludes(dn)).lower(),
+                "host": dn.transport_address, "ip": dn.transport_address,
+                "node": dn.name or node_id, "node_id": node_id,
+            })
+        return 200, rows
     if nid and nid not in ("_master", "_local", "_all", "*",
                            n.node_id, n.name):
         return 200, []  # no such node: empty table, like the reference
@@ -3863,9 +3891,13 @@ def _cluster_put_settings(n: Node, p, b):
     (indices.breaker.* / network.breaker.*) applies LIVE to the resource
     service, like the reference's dynamic HierarchyCircuitBreakerService
     settings; a null value resets to the default."""
+    from elasticsearch_tpu.cluster.metadata import flatten_settings
+
     body = _json(b)
     for scope in ("persistent", "transient"):
-        for k, v in (body.get(scope) or {}).items():
+        # ES accepts nested and dotted bodies interchangeably; flatten so
+        # both forms store (and reset) under the same dotted keys
+        for k, v in flatten_settings(body.get(scope) or {}).items():
             if v is None:
                 n.cluster_settings[scope].pop(k, None)
             else:
@@ -3878,6 +3910,27 @@ def _cluster_put_settings(n: Node, p, b):
     # serving front-end settings (serving.coalescer.* / serving.qos.*)
     # apply live through the same idempotent full-map path
     n.serving.apply_cluster_settings(merged)
+    c = _mh(n)
+    if c is not None:
+        # the allocation family (cluster.routing.allocation.*: drain
+        # exclusions, watermarks, relocation throttle) applies live to
+        # this node's allocator — and the change is BROADCAST so a PUT
+        # reaching any member drives the MASTER's allocation loop (the
+        # rolling-restart drain lever must not depend on which node the
+        # operator happened to address)
+        c.allocator.apply_cluster_settings(merged)
+        if "_local_only" not in p:
+            from elasticsearch_tpu.cluster.search_action import \
+                ACTION_CLUSTER_SETTINGS
+
+            payload = {"cluster_settings": n.cluster_settings,
+                       "merged": merged}
+            for nid in c.data._other_nodes():
+                try:
+                    c.data._send(nid, ACTION_CLUSTER_SETTINGS, payload,
+                                 timeout=5.0)
+                except Exception:  # tpulint: allow[R006] — an unreachable
+                    pass           # member adopts via the next broadcast
     return 200, {"acknowledged": True,
                  "persistent": n.cluster_settings["persistent"],
                  "transient": n.cluster_settings["transient"]}
@@ -3906,6 +3959,18 @@ def _cluster_health(n: Node, p, b):
     h.setdefault("number_of_in_flight_fetch", 0)
     h.setdefault("delayed_unassigned_shards", 0)
     h.setdefault("task_max_waiting_in_queue_millis", 0)
+    c = _mh(n)
+    alloc = getattr(c, "allocator", None) if c is not None else None
+    if alloc is not None:
+        # live relocation + drain progress (the rolling-restart signal:
+        # an operator polls health until the excluded node's count hits
+        # zero — then, and only then, kill is safe)
+        h["relocating_shards"] = len(alloc.inflight_snapshot())
+        drain = alloc.drain_status()
+        if drain:
+            h["draining_nodes"] = {nid: {"remaining_copies": left,
+                                         "drained": left == 0}
+                                   for nid, left in sorted(drain.items())}
     if p.get("level") in ("indices", "shards"):
         idx = {}
         for name, svc in n.indices.items():
@@ -4035,13 +4100,171 @@ def _cluster_state_metric(n: Node, p, b, metric: str,
     return 200, out
 
 
+def _resolve_member(c, ref: Optional[str]) -> Optional[str]:
+    """A reroute command's node argument (name or id) → member node id."""
+    if not ref:
+        return None
+    nodes = c.node.cluster_state.nodes
+    if ref in nodes:
+        return ref
+    for nid, dn in nodes.items():
+        if dn.name == ref:
+            return nid
+    return None
+
+
+def _cluster_reroute_mh(c, n: Node, p, b):
+    """The REAL reroute, against the live allocator (reference:
+    TransportClusterRerouteAction → AllocationService.reroute with
+    AllocationCommands): ``move`` starts a relocation stream through the
+    decider chain, ``cancel`` pulls an in-flight move's cancel gate
+    (releasing its throttle slot), ``allocate``/``allocate_replica``
+    starts a recovery of a new copy onto the named node. ``?explain``
+    answers with per-node decider verdicts from the same chain the
+    command ran through; ``?dry_run`` explains without acting."""
+    body = _json(b)
+    explain = str(p.get("explain", "false")).lower() in ("true", "", "1")
+    dry_run = str(p.get("dry_run", "false")).lower() in ("true", "", "1")
+    alloc = c.allocator
+    explanations = []
+    acked = True
+    for cmd in body.get("commands", []):
+        if not isinstance(cmd, dict) or len(cmd) != 1:
+            raise IllegalArgumentException(
+                "a reroute command must be an object with exactly one "
+                "command name key")
+        ((name, args),) = cmd.items()
+        if name not in ("move", "cancel", "allocate", "allocate_replica",
+                        "allocate_stale_primary", "allocate_empty_primary"):
+            raise IllegalArgumentException(
+                f"unknown reroute command [{name}]")
+        if not isinstance(args, dict):
+            raise IllegalArgumentException(
+                f"[{name}] command expects an object body")
+        iname = args.get("index")
+        if not iname:
+            raise IllegalArgumentException(
+                f"[{name}] command missing required [index] parameter")
+        sid = int(args.get("shard", 0))
+        meta = c.dist_indices.get(iname)
+        if meta is None or sid >= int(meta.get("num_shards", 0)):
+            raise IllegalArgumentException(
+                f"shard [{sid}] of [{iname}] cannot be found")
+        owners = list(meta["assignment"].get(str(sid), []))
+        params = {"index": iname, "shard": sid}
+        decisions = []
+        if name == "move":
+            src = _resolve_member(c, args.get("from_node"))
+            dst = _resolve_member(c, args.get("to_node"))
+            params.update({"from_node": args.get("from_node"),
+                           "to_node": args.get("to_node")})
+            if src is None or dst is None:
+                raise IllegalArgumentException(
+                    f"[move] unknown node in "
+                    f"[{args.get('from_node')}]->[{args.get('to_node')}]")
+            if src not in owners:
+                decisions.append({
+                    "decider": "move_allocation_command", "decision": "NO",
+                    "explanation": f"node [{src}] holds no copy of "
+                                   f"[{iname}][{sid}]"})
+                acked = False
+            else:
+                decisions.extend(alloc.explain(iname, sid, dst))
+                if not dry_run:
+                    task = alloc._start_relocation(iname, sid, src, dst,
+                                                   "reroute", set())
+                    if task is None:
+                        acked = False
+        elif name == "cancel":
+            dst = _resolve_member(c, args.get("node"))
+            params["node"] = args.get("node")
+            cancelled = dst is not None and alloc.cancel_relocation(
+                (iname, sid, dst), reason="reroute cancel")
+            decisions.append({
+                "decider": "cancel_allocation_command",
+                "decision": "YES" if cancelled else "NO",
+                "explanation": (f"cancelled the relocation of "
+                                f"[{iname}][{sid}] to [{dst}]" if cancelled
+                                else f"no relocation of [{iname}][{sid}] "
+                                     f"to [{args.get('node')}] in flight")})
+            acked = acked and cancelled
+        else:  # allocate / allocate_replica / allocate_*_primary
+            dst = _resolve_member(c, args.get("node"))
+            params["node"] = args.get("node")
+            if dst is None:
+                raise IllegalArgumentException(
+                    f"[{name}] unknown node [{args.get('node')}]")
+            decisions.extend(alloc.explain(iname, sid, dst))
+            pend = meta.get("initializing", {}).get(str(sid), [])
+            if dst in owners or dst in pend:
+                decisions.append({
+                    "decider": f"{name}_allocation_command",
+                    "decision": "NO",
+                    "explanation": f"node [{dst}] already holds a copy "
+                                   f"of [{iname}][{sid}]"})
+                acked = False
+            elif not owners:
+                decisions.append({
+                    "decider": f"{name}_allocation_command",
+                    "decision": "NO",
+                    "explanation": f"[{iname}][{sid}] has no active copy "
+                                   "to recover from (resurrect_lost "
+                                   "handles primaries)"})
+                acked = False
+            elif not dry_run:
+                # a NEW copy recovers onto the node through the standard
+                # top-up path: initializing + publish, then the stream,
+                # then graduation into assignment + in_sync
+                with c._indices_lock:
+                    live = c.dist_indices.get(iname)
+                    if live is not None:
+                        live.setdefault("initializing", {}) \
+                            .setdefault(str(sid), []).append(dst)
+                c.publish_indices()
+                c.data.start_recoveries([{
+                    "index": iname, "shard": sid, "target": dst,
+                    "source": owners[0], "body": meta.get("body")}])
+        explanations.append({"command": name, "parameters": params,
+                             "decisions": decisions})
+    state = {"cluster_name": n.cluster_state.cluster_name,
+             "version": n.cluster_state.version,
+             "master_node": n.cluster_state.master_node_id,
+             "relocations": alloc.inflight_snapshot()}
+    resp = {"acknowledged": acked, "state": state}
+    if explain or dry_run:
+        resp["explanations"] = explanations
+    return 200, resp
+
+
 def _cluster_reroute(n: Node, p, b):
     """RestClusterRerouteAction. Commands are validated against the routing
     table; with a single node and static shard→device placement every legal
     move/allocate is already satisfied (there is exactly one node to be
     on), so accepted commands change nothing — the same outcome reroute has
     on a one-node reference cluster. cancel fails the shard, which re-runs
-    recovery (AllocationService.reroute's cancel semantics)."""
+    recovery (AllocationService.reroute's cancel semantics). In a
+    multi-host world the commands are REAL: they drive the live allocator
+    (_cluster_reroute_mh), and a non-master member forwards to the master
+    (reference: TransportMasterNodeAction) — only the master's allocator
+    may start or cancel moves."""
+    c = _mh(n)
+    if c is not None:
+        master = c.node.cluster_state.master_node_id
+        if not c.is_master and master is not None \
+                and "_local_only" not in p:
+            from elasticsearch_tpu.cluster.search_action import \
+                ACTION_REST_PROXY
+
+            try:
+                res = c.data._send(
+                    master, ACTION_REST_PROXY,
+                    {"method": "POST", "path": "/_cluster/reroute",
+                     "params": {k: str(v) for k, v in p.items()},
+                     "body": (b or b"").decode()}, timeout=30.0)
+                return res["status"], res["payload"]
+            except Exception:  # tpulint: allow[R006] — unreachable master:
+                pass           # fall through to the local explain-only view
+        return _cluster_reroute_mh(c, n, p, b)
     body = _json(b)
     explanations = []
     for cmd in body.get("commands", []):
@@ -4486,7 +4709,7 @@ def _recovery_entry_json(n: Node, sh, primary: bool, e: dict) -> dict:
     shard's doc count PROVES the recovery replayed a checkpoint suffix
     instead of re-shipping the shard."""
     type_map = {"gateway": "GATEWAY", "replica": "REPLICA",
-                "peer": "REPLICA"}
+                "peer": "REPLICA", "relocation": "RELOCATION"}
     size = sum(seg.memory_bytes() for seg in sh.segments)
     full = e.get("mode") == "full"
     docs = e.get("docs_copied", 0)

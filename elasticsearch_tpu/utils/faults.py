@@ -47,6 +47,16 @@ Registered injection points (see docs/ROBUSTNESS.md for the catalogue):
                           makes every in-flight device dispatch count
                           as stalled, driving the trip → incident →
                           persistence pipeline without a real hang
+    allocation.decide     inside the live allocator's per-move decider
+                          pass (cluster/allocator.py): ctx carries
+                          index/shard/source/target so a test can veto
+                          or crash exactly one placement decision
+    relocation.stream     at the head of a RELOCATION recovery stream
+                          (cluster/search_action.py::_on_recover, fired
+                          only for allocator-driven moves; ctx carries
+                          index/shard/source/target) — an armed fault
+                          wedges the move, driving the relocation
+                          watchdog's cancel + reschedule path
 """
 from __future__ import annotations
 
@@ -70,6 +80,8 @@ POINTS = frozenset({
     "publish.commit",
     "discovery.partition",
     "watchdog.program_stall",
+    "allocation.decide",
+    "relocation.stream",
 })
 
 

@@ -1,0 +1,607 @@
+"""Elastic-allocation chaos matrix (tier-1, seed-deterministic).
+
+The grow/shrink scenarios run under a FIXED SEED MATRIX in the normal
+pytest gate: the seed drives the interleaving of the serving write load
+against the allocator's relocation ticks, so a regression replays
+identically instead of needing a manual soak. The invariants asserted
+are seed-independent:
+
+- growing 2→4 under a mixed write load rebalances copies onto the new
+  nodes through RELOCATION streams (visible in `_recovery`), loses ZERO
+  acknowledged ops, keeps exactly one master, and never runs more
+  concurrent incoming streams per node than
+  ``cluster.routing.allocation.node_concurrent_recoveries``
+- a joining node compiles nothing a peer already compiled: the AOT
+  ``.aotx`` delta rides the recovery handshake and the compile cache's
+  ``fresh`` counter does not move during the grow
+- shrinking 4→2 via ``cluster.routing.allocation.exclude._name`` drains
+  every copy off the excluded nodes (``_cat/allocation`` shows 0 shards
+  and draining=true) BEFORE they are killed — still zero acked-op loss
+- a relocation wedged by a ``relocation.stream`` fault is detected by
+  the relocation watchdog, cancelled (throttle slot released), and
+  rescheduled onto a different target with the wedged one banned
+- a target that dies mid-relocation never graduates into the assignment
+  (the dead-node guard), and `reroute cancel` aborts a wedged move
+  without touching the shard's committed metadata
+
+Same in-process cluster harness as tests/unit/test_replication_chaos.py
+(ping_interval=0: node death is declared explicitly, deterministically).
+"""
+import json
+import random
+import socket
+import time
+from collections import Counter
+
+import pytest
+
+from elasticsearch_tpu.cluster.transport import PeerBreaker
+from elasticsearch_tpu.utils.faults import FAULTS
+
+#: the tier-1 chaos matrix — fixed seeds, replayable
+CHAOS_SEEDS = [101, 202, 303]
+
+INDEX = "evt"
+NUM_SHARDS = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _wait_for(cond, timeout=20.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _join(port, rank, name):
+    """Boot one more in-process member against the seed master port
+    (MultiHostCluster's non-rank-0 branch performs the join handshake)."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.node import Node
+
+    node = Node(name=name)
+    c = MultiHostCluster(node, rank=rank, world=2, transport_port=port,
+                         ping_interval=0, minimum_master_nodes=1)
+    return node, c
+
+
+@pytest.fixture()
+def elastic_cluster():
+    """Two MultiHostClusters in-process; index `evt` with 4 shards and 1
+    replica — 8 copies, 4 per node. Tests grow the membership with
+    _join and register the extras for teardown."""
+    from elasticsearch_tpu.cluster.bootstrap import MultiHostCluster
+    from elasticsearch_tpu.node import Node
+
+    port = _free_port()
+    node0 = Node(name="rank0")
+    c0 = MultiHostCluster(node0, rank=0, world=2, transport_port=port,
+                          ping_interval=0, minimum_master_nodes=1)
+    node1 = Node(name="rank1")
+    c1 = MultiHostCluster(node1, rank=1, world=2, transport_port=port,
+                          ping_interval=0, minimum_master_nodes=1)
+    c0.data.create_index(INDEX, {
+        "settings": {"number_of_shards": NUM_SHARDS,
+                     "number_of_replicas": 1},
+        "mappings": {"properties": {"n": {"type": "integer"}}}})
+    meta = c0.dist_indices[INDEX]
+    assert all(len(v) == 2 for v in meta["assignment"].values()), meta
+    extras = []  # (node, cluster) members tests joined later
+    yield c0, c1, port, extras
+    FAULTS.clear()
+    for _node, c in reversed(extras):
+        try:
+            c.close()
+        except Exception:
+            pass
+    try:
+        c1.close()
+    finally:
+        c0.close()
+        for _node, c in extras:
+            _node.close()
+        node1.close()
+        node0.close()
+
+
+def _index_docs(c0, ids):
+    """Index through the coordinator; returns the ACKNOWLEDGED set."""
+    acked = set()
+    for doc_id in ids:
+        try:
+            res = c0.data.index_doc(INDEX, doc_id, {"n": len(acked)})
+            assert res.get("_seq_no") is not None
+            acked.add(doc_id)
+        except Exception:
+            pass  # unacked: the client was TOLD it failed
+    return acked
+
+
+def _search_docs(c0):
+    """The read half of the mixed load: a scatter/gather search through
+    the coordinator must keep completing WHILE shards relocate (write
+    fanout covers initializing copies; the query phase only scatters to
+    owners, so a half-graduated move must never 404 a shard)."""
+    resp = c0.data.search(INDEX, {"query": {"match_all": {}}})
+    assert "hits" in resp, resp
+    return resp
+
+
+def _copies_per_node(alloc):
+    per_node, _ = alloc._placement()
+    return {nid: len(v) for nid, v in per_node.items()}
+
+
+def _assert_all_served(c0, acked):
+    c0.data.refresh(INDEX)  # fans to every member: remote owners'
+    # query phases must not serve a stale point-in-time below
+    for doc_id in sorted(acked):
+        got = c0.data.get_doc(INDEX, doc_id)
+        assert got.get("found"), f"ACKED doc {doc_id} lost"
+    # the search plane agrees: at steady state every acked doc is
+    # visible to match_all and no shard fails the query phase
+    resp = _search_docs(c0)
+    assert resp["_shards"]["failed"] == 0, resp["_shards"]
+    assert resp["hits"]["total"] >= len(acked), \
+        (resp["hits"]["total"], len(acked))
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_grow_shrink_cycle_zero_acked_loss(elastic_cluster, seed):
+    """The flagship gate: 2→4→2 while serving, zero acked-op loss, no
+    split-brain, per-node stream concurrency bounded by the throttle,
+    joiner compile-cache `fresh` delta 0, drained nodes at 0 shards in
+    `_cat/allocation` before the kill."""
+    from elasticsearch_tpu.monitor import compile_cache
+    from elasticsearch_tpu.rest.server import RestController
+
+    c0, c1, port, extras = elastic_cluster
+    rng = random.Random(seed)
+    rest = RestController(c0.node)
+    alloc = c0.allocator
+    acked = _index_docs(c0, [f"d{i}" for i in range(24)])
+    assert len(acked) == 24
+    # freeze + warm the search plane BEFORE the fresh snapshot: searches
+    # over live docs ride the host path (no device program), so the
+    # FIRST search after segments freeze legitimately compiles — do that
+    # now, not mid-relocation, or it drowns the joiner-never-compiles
+    # signal. Grow-phase docs capped at 32 below for the same reason
+    # (crossing a padding boundary would compile a genuinely-new shape).
+    c0.data.refresh(INDEX)
+    _search_docs(c0)
+    ev_before = compile_cache.events_snapshot()
+
+    # ---- grow 2 → 4 under a mixed write load -----------------------------
+    node2, c2 = _join(port, 2, "rank2")
+    extras.append((node2, c2))
+    node3, c3 = _join(port, 3, "rank3")
+    extras.append((node3, c3))
+    members = [c0, c1, c2, c3]
+    all_ids = {c.local.node_id for c in members}
+    assert set(c0.node.cluster_state.nodes) == all_ids
+
+    i = 24
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        alloc.tick("chaos-grow")
+        # serve a mixed write+search load WHILE relocations stream
+        # (seeded interleaving; ≤32 docs — see the warmup note above)
+        for _ in range(rng.randrange(1, 4)):
+            if i < 32:
+                acked |= _index_docs(c0, [f"d{i}"])
+                i += 1
+        _search_docs(c0)
+        # bounded concurrency: never more in-flight incoming streams at
+        # one target than node_concurrent_recoveries
+        per_target = Counter(m["target"] for m in alloc.inflight_snapshot()
+                             if not m["cancelled"])
+        if per_target:
+            assert max(per_target.values()) <= alloc.concurrent_recoveries, \
+                per_target
+        counts = _copies_per_node(alloc)
+        if (set(counts) == all_ids and not alloc.inflight_snapshot()
+                and max(counts.values()) - min(counts.values()) <= 1):
+            break
+        time.sleep(0.05)
+    counts = _copies_per_node(alloc)
+    assert set(counts) == all_ids, f"joiners got no copies: {counts}"
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
+
+    # no split-brain: exactly one member believes it is master
+    assert sum(1 for c in members if c.is_master) == 1
+    # every member agrees who that master is
+    assert len({c.node.cluster_state.master_node_id
+                for c in members}) == 1
+
+    # the moves ran as RELOCATION streams through the recovery registry
+    relocs = [e for c in (c2, c3)
+              for e in c.node.indices[INDEX].recoveries.entries()
+              if e["type"] == "relocation" and e["stage"] == "done"]
+    assert relocs, "no relocation stream reached the joiners"
+    # fleet-wide AOT distribution rode the handshake (delta-based: the
+    # in-process blob tier is shared, so the delta here is empty — the
+    # field PROVES the seeding step ran; the delta mechanics have their
+    # own test below)
+    assert all("aot_seeded" in e for e in relocs), relocs
+    # and GET {index}/_recovery reports them the acceptance way
+    status, body = RestController(c2.node).dispatch(
+        "GET", f"/{INDEX}/_recovery", {"_local_only": ""}, b"")
+    assert status == 200
+    assert any(sh["type"] == "RELOCATION"
+               for sh in body[INDEX]["shards"]), body
+    # a joining node never pays full price for what a peer already
+    # compiled: any fresh compile during the grow must be a genuinely
+    # NEW program (paired 1:1 with a blob store — relocation flushes can
+    # freeze new segments whose first search compiles a first-ever
+    # shape), and nothing already in the blob tier may miss
+    # (bounded settle: joiner pre-warm replays compile on background
+    # threads — a snapshot may land between a fresh and its store)
+    settle = time.monotonic() + 10.0
+    while True:
+        ev = compile_cache.events_snapshot()
+        delta = {k: ev[k] - ev_before[k] for k in ev}
+        if delta["fresh"] == delta["store"] \
+                or time.monotonic() > settle:
+            break
+        time.sleep(0.05)
+    assert delta["fresh"] == delta["store"], delta
+    for miss in ("corrupt_miss", "mismatch_miss", "deserialize_error"):
+        assert delta[miss] == 0, delta
+
+    _assert_all_served(c0, acked)
+
+    # ---- shrink 4 → 2: drain the joiners, then kill them -----------------
+    status, _ = rest.dispatch(
+        "PUT", "/_cluster/settings", {},
+        json.dumps({"transient": {
+            "cluster.routing.allocation.exclude._name":
+                "rank2,rank3"}}).encode())
+    assert status == 200
+    drain_ids = {c2.local.node_id, c3.local.node_id}
+
+    def _drained():
+        alloc.tick("chaos-drain")
+        acked.update(_index_docs(c0, [f"x{len(acked)}"]))
+        _search_docs(c0)
+        st = alloc.drain_status()
+        return set(st) == drain_ids and all(v == 0 for v in st.values()) \
+            and not alloc.inflight_snapshot()
+
+    _wait_for(_drained, timeout=30.0, msg="drain of rank2/rank3")
+
+    # _cat/allocation: the drain runbook's kill-safe signal (bounded
+    # settle — the drained member self-reports from ITS published meta,
+    # which can trail the master's final graduation publish by a beat)
+    by_id = {}
+
+    def _cat_drained_zero():
+        alloc._usage_cache.clear()  # force fresh probes for the table
+        status, rows = rest.dispatch("GET", "/_cat/allocation", {}, b"")
+        assert status == 200
+        by_id.clear()
+        by_id.update({r["node_id"]: r for r in rows})
+        return all(by_id[nid]["shards"] == "0" for nid in drain_ids)
+
+    _wait_for(_cat_drained_zero, timeout=10.0,
+              msg="_cat/allocation drained rows at 0 shards")
+    for nid in drain_ids:
+        assert by_id[nid]["draining"] == "true", by_id[nid]
+    for c in (c0, c1):
+        assert by_id[c.local.node_id]["draining"] == "false"
+
+    # health reports the drain complete
+    status, h = rest.dispatch("GET", "/_cluster/health", {}, b"")
+    assert status == 200
+    assert h["relocating_shards"] == 0
+    assert all(v["drained"] for v in h["draining_nodes"].values()), h
+
+    # every copy is back on the survivors; primaries moved under bumped
+    # terms through the same two-phase publish as failover promotions
+    meta = c0.dist_indices[INDEX]
+    survivors = {c0.local.node_id, c1.local.node_id}
+    for sid in range(NUM_SHARDS):
+        owners = meta["assignment"][str(sid)]
+        assert set(owners) <= survivors, (sid, owners)
+        assert set(meta["in_sync"][str(sid)]) <= survivors
+
+    # the kill is now safe: declare both drained nodes dead, close them
+    for c in (c2, c3):
+        c0._on_node_failed(c0.node.cluster_state.nodes[c.local.node_id])
+    _assert_all_served(c0, acked)
+    st = alloc.stats()
+    assert st["moves_completed"] >= 4, st
+    assert st["inflight"] == 0, st
+
+
+def test_watchdog_reschedules_wedged_relocation(elastic_cluster):
+    """The sixth stall detector ACTS: a relocation wedged by an armed
+    `relocation.stream` fault is cancelled (slot released) and
+    rescheduled onto a different target with the wedged one banned."""
+    from elasticsearch_tpu.monitor.watchdog import WatchdogService
+    from elasticsearch_tpu.rest.server import RestController
+
+    c0, c1, port, extras = elastic_cluster
+    alloc = c0.allocator
+    alloc.enabled = False  # background kicks stay inert: the test drives
+    node2, c2 = _join(port, 2, "rank2")
+    extras.append((node2, c2))
+    node3, c3 = _join(port, 3, "rank3")
+    extras.append((node3, c3))
+    acked = _index_docs(c0, [f"d{i}" for i in range(8)])
+    wedged = c2.local.node_id
+
+    # every stream INTO rank2 fails at the target's fault point
+    FAULTS.inject("relocation.stream", error=RuntimeError, count=-1,
+                  match=lambda ctx: ctx.get("target") == wedged)
+    src = c0.dist_indices[INDEX]["assignment"]["0"][0]
+    status, res = RestController(c0.node).dispatch(
+        "POST", "/_cluster/reroute", {},
+        json.dumps({"commands": [{"move": {
+            "index": INDEX, "shard": 0, "from_node": src,
+            "to_node": wedged}}]}).encode())
+    assert status == 200 and res["acknowledged"], res
+    assert [m["target"] for m in alloc.inflight_snapshot()] == [wedged]
+
+    wd = WatchdogService(c0.node, relocation_bound_s=0.05)
+    _wait_for(lambda: alloc.inflight_snapshot()
+              and alloc.inflight_snapshot()[0]["age_seconds"] > 0.05,
+              msg="the move to age past the bound")
+    trips = wd.run_once()
+    stalls = [t for t in trips if t.get("detector") == "relocation_stall"]
+    assert stalls, trips
+
+    # cancelled + rescheduled onto a target that is NOT the wedged node
+    _wait_for(lambda: alloc.stats()["inflight"] == 0,
+              msg="the rescheduled move to finish")
+    owners = c0.dist_indices[INDEX]["assignment"]["0"]
+    assert wedged not in owners, owners
+    assert c3.local.node_id in owners, (owners, "reschedule should land "
+                                        "on the one unbanned spare node")
+    st = alloc.stats()
+    assert st["moves_cancelled"] >= 1, st
+    assert st["reschedules"] >= 1, st
+    # the wedged stream left no half-open registry entries on rank2 (the
+    # fault fires BEFORE the registry/index bookkeeping on the target)
+    if c2.node.index_exists(INDEX):
+        half_open = [e for e in
+                     c2.node.indices[INDEX].recoveries.entries()
+                     if e["stage"] not in ("done", "failed")]
+        assert not half_open, half_open
+    _assert_all_served(c0, acked)
+
+
+def test_dead_target_never_graduates_and_cancel_is_clean(elastic_cluster):
+    """Kill-during-relocation: a move whose target dies mid-stream must
+    not graduate the dead node into the assignment, and `reroute cancel`
+    aborts a wedged move leaving the committed metadata untouched."""
+    from elasticsearch_tpu.rest.server import RestController
+
+    c0, c1, port, extras = elastic_cluster
+    alloc = c0.allocator
+    alloc.enabled = False
+    node2, c2 = _join(port, 2, "rank2")
+    extras.append((node2, c2))
+    acked = _index_docs(c0, [f"d{i}" for i in range(8)])
+    target = c2.local.node_id
+    rest = RestController(c0.node)
+    before = json.loads(json.dumps(c0.dist_indices[INDEX]))
+
+    # -- cancel path: wedge the stream, cancel through reroute -------------
+    FAULTS.inject("relocation.stream", error=RuntimeError, count=-1,
+                  match=lambda ctx: ctx.get("target") == target)
+    src = before["assignment"]["0"][0]
+    status, res = rest.dispatch(
+        "POST", "/_cluster/reroute", {"explain": "true"},
+        json.dumps({"commands": [{"move": {
+            "index": INDEX, "shard": 0, "from_node": src,
+            "to_node": target}}]}).encode())
+    assert status == 200 and res["acknowledged"], res
+    # ?explain answered with per-decider verdicts from the live chain
+    deciders = {d["decider"]
+                for d in res["explanations"][0]["decisions"]}
+    assert {"same_shard", "cluster_filter", "watermark", "load",
+            "throttling"} <= deciders, deciders
+    status, res = rest.dispatch(
+        "POST", "/_cluster/reroute", {},
+        json.dumps({"commands": [{"cancel": {
+            "index": INDEX, "shard": 0, "node": target}}]}).encode())
+    assert status == 200 and res["acknowledged"], res
+    _wait_for(lambda: alloc.stats()["inflight"] == 0,
+              msg="cancelled move to roll back")
+    meta = c0.dist_indices[INDEX]
+    assert meta["assignment"] == before["assignment"]
+    assert meta["in_sync"] == before["in_sync"]
+    assert meta["primary_terms"] == before["primary_terms"]
+    assert all(not v for v in meta.get("initializing", {}).values()), meta
+
+    # -- dead-target path: node declared dead while the stream retries ----
+    alloc.RETRY_WAIT_S = 0.05
+    status, res = rest.dispatch(
+        "POST", "/_cluster/reroute", {},
+        json.dumps({"commands": [{"move": {
+            "index": INDEX, "shard": 1, "from_node":
+                before["assignment"]["1"][0],
+            "to_node": target}}]}).encode())
+    assert status == 200 and res["acknowledged"], res
+    c0._on_node_failed(c0.node.cluster_state.nodes[target])
+    # un-wedge: the next retry SUCCEEDS, but the target is dead — the
+    # graduation guard must refuse to adopt it into the assignment
+    FAULTS.clear()
+    c0.transport.breaker = PeerBreaker()
+    _wait_for(lambda: alloc.stats()["inflight"] == 0,
+              msg="dead-target move to finish")
+    meta = c0.dist_indices[INDEX]
+    for sid in range(NUM_SHARDS):
+        assert target not in meta["assignment"][str(sid)]
+        assert target not in meta["in_sync"][str(sid)]
+        assert target not in meta.get("initializing", {}).get(str(sid), [])
+    _assert_all_served(c0, acked)
+
+
+def test_reroute_allocate_replica_adds_copy(elastic_cluster):
+    """`allocate_replica` ADDS a copy through the top-up recovery path
+    (it must not swap an existing owner out, unlike a relocation)."""
+    from elasticsearch_tpu.rest.server import RestController
+
+    c0, c1, port, extras = elastic_cluster
+    c0.allocator.enabled = False
+    node2, c2 = _join(port, 2, "rank2")
+    extras.append((node2, c2))
+    _index_docs(c0, [f"d{i}" for i in range(6)])
+    target = c2.local.node_id
+    before = list(c0.dist_indices[INDEX]["assignment"]["2"])
+    status, res = RestController(c0.node).dispatch(
+        "POST", "/_cluster/reroute", {},
+        json.dumps({"commands": [{"allocate_replica": {
+            "index": INDEX, "shard": 2, "node": target}}]}).encode())
+    assert status == 200 and res["acknowledged"], res
+    _wait_for(lambda: target in
+              c0.dist_indices[INDEX]["assignment"]["2"],
+              msg="allocated replica to graduate")
+    owners = c0.dist_indices[INDEX]["assignment"]["2"]
+    assert owners[:len(before)] == before, (before, owners)
+    assert target in c0.dist_indices[INDEX]["in_sync"]["2"]
+    # a second allocate of the same copy is a typed NO, not a dup
+    status, res = RestController(c0.node).dispatch(
+        "POST", "/_cluster/reroute", {"explain": "true"},
+        json.dumps({"commands": [{"allocate_replica": {
+            "index": INDEX, "shard": 2, "node": target}}]}).encode())
+    assert status == 200 and not res["acknowledged"]
+
+
+def test_aot_blob_delta_export_adopt_roundtrip(elastic_cluster):
+    """Fleet-wide AOT distribution mechanics: the source ships exactly
+    the `.aotx` delta the target reported missing, and adoption seeds
+    the local blob tier (skip-if-exists)."""
+    from elasticsearch_tpu.index import ivf_cache
+
+    c0, c1, _port, _extras = elastic_cluster
+    blob = b"\x7fAOTX-executor-bytes"
+    ivf_cache.store_blob("prog-abc123", blob, "aotx")
+    assert "prog-abc123" in ivf_cache.list_blob_keys("aotx")
+
+    shipped = c0.data._export_aot_blobs([], "peer-a")
+    assert shipped is not None and "prog-abc123" in shipped
+    # debounced per target: an immediate re-export for the SAME target
+    # answers None (a P-shard relocation ships ONE delta, not P)
+    assert c0.data._export_aot_blobs([], "peer-a") is None
+    # a target that already holds the key gets no delta
+    assert c0.data._export_aot_blobs(["prog-abc123"], "peer-b") is None
+
+    ivf_cache.delete_blob("prog-abc123", "aotx")
+    assert "prog-abc123" not in ivf_cache.list_blob_keys("aotx")
+    assert c1.data._adopt_aot_blobs(shipped) == 1
+    assert ivf_cache.load_blob("prog-abc123", "aotx") == blob
+    # idempotent: re-adoption skips existing keys without error
+    assert c1.data._adopt_aot_blobs(shipped) >= 0
+    ivf_cache.delete_blob("prog-abc123", "aotx")
+
+
+def test_select_primary_prefers_highest_checkpoint():
+    """Promotion regression (three staggered replicas): the in-sync copy
+    with the HIGHEST local checkpoint wins — promoting a lagging copy
+    would silently discard every acked op above its checkpoint."""
+    from elasticsearch_tpu.cluster.routing import select_primary
+
+    owners = ["dead", "lag", "mid", "top"]
+    in_sync = ["lag", "mid", "top"]
+    ckpts = {"lag": 3, "mid": 7, "top": 11}
+    got = select_primary(owners, in_sync, ckpts)
+    assert got[0] == "top", got
+    assert set(got) == set(owners)
+    # ties break on owner order (deterministic across masters)
+    got = select_primary(["dead", "a", "b"], ["a", "b"], {"a": 5, "b": 5})
+    assert got[0] == "a", got
+    # no checkpoints known: first promotable in owner order (legacy path)
+    got = select_primary(["dead", "a", "b"], ["a", "b"])
+    assert got[0] == "a", got
+    # a SITTING in-sync primary is never reordered (no spurious term bumps)
+    owners = ["p", "r1", "r2"]
+    assert select_primary(owners, ["p", "r1", "r2"],
+                          {"p": 1, "r1": 9, "r2": 4}) == owners
+
+
+def test_watermark_decider_grammar_and_levels():
+    """ES disk.watermark grammar over HBM capacity: percent and absolute
+    byte specs; low blocks NEW copies, high triggers move-away."""
+    from elasticsearch_tpu.cluster.routing import (NO, ALWAYS,
+                                                   WatermarkDecider)
+    from elasticsearch_tpu.cluster.state import DiscoveryNode
+
+    usage = {"n1": (50, 100)}
+    d = WatermarkDecider(lambda nid: usage.get(nid))
+    assert d.level("n1") == "ok"
+    assert d.level("unknown") == "ok"  # no report: allocate freely
+    usage["n1"] = (85, 100)
+    assert d.level("n1") == "low"
+    assert not d.over_high("n1")
+    usage["n1"] = (92, 100)
+    assert d.level("n1") == "high" and d.over_high("n1")
+    usage["n1"] = (96, 100)
+    assert d.level("n1") == "flood"
+    node = DiscoveryNode("n1", "n1", transport_address="x:1")
+    assert d.can_allocate(None, node, None) == NO
+    usage["n1"] = (10, 100)
+    assert d.can_allocate(None, node, None) == ALWAYS
+    # absolute byte-size specs (the ES "1gb"-style grammar)
+    d.set_watermarks("60b", "80b", "90b")
+    usage["n1"] = (70, 100)
+    assert d.level("n1") == "low"
+    usage["n1"] = (85, 100)
+    assert d.level("n1") == "high"
+    # capacity unknown/zero: never a false alarm
+    usage["n1"] = (85, 0)
+    assert d.level("n1") == "ok"
+
+
+def test_cluster_filter_decider_drain_grammar():
+    """cluster.routing.allocation.exclude._name/_id parsing: comma lists,
+    idempotent re-apply, absent key = reset."""
+    from elasticsearch_tpu.cluster.routing import ClusterFilterDecider
+    from elasticsearch_tpu.cluster.state import DiscoveryNode
+
+    d = ClusterFilterDecider()
+    a = DiscoveryNode("id-a", "alpha", transport_address="x:1")
+    b = DiscoveryNode("id-b", "beta", transport_address="x:2")
+    assert not d.excludes(a) and not d.excludes(b)
+    d.apply_cluster_settings(
+        {"cluster.routing.allocation.exclude._name": "alpha, gamma"})
+    assert d.excludes(a) and not d.excludes(b)
+    d.apply_cluster_settings(
+        {"cluster.routing.allocation.exclude._id": "id-b"})
+    # merged-map contract: the _name rule was ABSENT → reset
+    assert not d.excludes(a) and d.excludes(b)
+    d.apply_cluster_settings({})
+    assert not d.excludes(a) and not d.excludes(b)
+    # require pins allocation to the named nodes (everything else drains)
+    d.apply_cluster_settings(
+        {"cluster.routing.allocation.require._name": "alpha"})
+    assert not d.excludes(a) and d.excludes(b)
+
+
+def test_env_spec_arms_allocation_points():
+    """The ESTPU_FAULTS grammar covers the allocation fault points
+    (subprocess cluster members arm through it)."""
+    from elasticsearch_tpu.utils.faults import FaultRegistry, _parse_env_spec
+
+    r = FaultRegistry()
+    _parse_env_spec(
+        "allocation.decide:count=2;relocation.stream:prob=0.5:seed=7", r)
+    assert r.active("allocation.decide")
+    assert r.active("relocation.stream")

@@ -292,6 +292,35 @@ def test_select_primary_promotes_in_sync_only():
     assert select_primary([], ["a"]) == []
 
 
+def test_select_primary_staggered_replicas_pick_highest_checkpoint():
+    """PR 18 regression: with three replicas whose checkpoints are
+    staggered (each lagging the primary by a different suffix), a dead
+    primary must hand off to the HIGHEST-checkpoint in-sync survivor —
+    not the first in owner order, which replays the longest suffix and,
+    before the in-sync gate, could silently roll back acked ops."""
+    from elasticsearch_tpu.cluster.routing import select_primary
+
+    owners = ["p", "r1", "r2", "r3"]
+    in_sync = ["r1", "r2", "r3"]  # p died and fell out of sync
+    ckpts = {"r1": 4, "r2": 11, "r3": 7}
+    got = select_primary(owners, in_sync, checkpoints=ckpts)
+    assert got[0] == "r2", got
+    # nobody is dropped — the stale ex-primary stays listed for
+    # re-replication, just never first
+    assert sorted(got) == sorted(owners)
+    # ties break on the earlier owner index (deterministic handoff)
+    ckpts_tied = {"r1": 9, "r2": 9, "r3": 9}
+    assert select_primary(owners, in_sync, checkpoints=ckpts_tied)[0] \
+        == "r1"
+    # a sitting in-sync primary is NEVER reordered by checkpoints —
+    # promotion is for succession, not rebalancing
+    assert select_primary(["p", "r1"], ["p", "r1"],
+                          checkpoints={"r1": 99})[0] == "p"
+    # replicas missing a checkpoint report rank lowest among survivors
+    assert select_primary(owners, in_sync,
+                          checkpoints={"r3": 1})[0] == "r3"
+
+
 def test_replication_group_promotion_bumps_term_and_fences_zombie():
     from elasticsearch_tpu.cluster.replication import ReplicationGroup
     from elasticsearch_tpu.index.shard import IndexShard
