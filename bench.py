@@ -634,6 +634,141 @@ def run_sharded_qtf(args) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# hybrid_frontier scenario (ISSUE 19): recall@10/latency frontier of the
+# fused hybrid pipeline vs each engine alone, identical probes
+# ---------------------------------------------------------------------------
+
+def run_hybrid_frontier(args) -> dict:
+    """Planted-relevance A/B: each probe has 10 relevant docs whose
+    signal is split across the channels (75% carry the probe's rare
+    term, vectors sit near the probe centroid under noise) plus
+    per-channel distractors (term-only and vector-only). BM25-only,
+    kNN-only, and the fused hybrid (RRF at three weightings + linear)
+    answer the SAME probes; each arm reports recall@10 against the
+    planted set and p50 latency through the full product path. The
+    fused path must actually serve stage 1 (kernel-counter-proven) and
+    every arm's stage carries its backend label."""
+    from elasticsearch_tpu.monitor import kernels as _kern
+    from elasticsearch_tpu.node import Node
+
+    stage("hybrid-frontier-build")
+    rng = np.random.default_rng(args.seed + 19)
+    n_docs, dims, n_q, k = 4096, min(args.dims, 64), 16, args.k
+    n_rel, n_lex_noise, n_vec_noise = 10, 30, 30
+    vecs = rng.standard_normal((n_docs, dims)).astype(np.float32)
+    body_words = [" ".join(f"w{w}" for w in
+                           rng.integers(0, 50, 3))
+                  for _ in range(n_docs)]
+    centroids = rng.standard_normal((n_q, dims)).astype(np.float32)
+    relevant = []
+    pool = rng.permutation(n_docs)
+    take = 0
+    for qi in range(n_q):
+        rel = pool[take: take + n_rel]
+        lexn = pool[take + n_rel: take + n_rel + n_lex_noise]
+        vecn = pool[take + n_rel + n_lex_noise:
+                    take + n_rel + n_lex_noise + n_vec_noise]
+        take += n_rel + n_lex_noise + n_vec_noise
+        relevant.append(set(int(i) for i in rel))
+        for i in rel:
+            if rng.random() < 0.75:  # lexical signal is NOISY
+                body_words[i] += f" rel{qi}"
+            vecs[i] = centroids[qi] + 0.55 * rng.standard_normal(dims)
+        for i in lexn:  # term matches, vector doesn't
+            body_words[i] += f" rel{qi}"
+        for i in vecn:  # vector matches, term doesn't
+            vecs[i] = centroids[qi] + 0.7 * rng.standard_normal(dims)
+
+    node = Node(name="bench-hybrid")
+    node.create_index("hyf", {
+        "settings": {"number_of_shards": 1},
+        "mappings": {"properties": {
+            "body": {"type": "text"},
+            "emb": {"type": "dense_vector", "dims": dims,
+                    "similarity": "cosine"}}}})
+    svc = node.indices["hyf"]
+    for i in range(n_docs):
+        svc.index_doc(str(i), {"body": body_words[i],
+                               "emb": [float(x) for x in vecs[i]]})
+    svc.refresh()
+    beat()
+
+    def arm(name, bodies, runs=3):
+        stage(f"hybrid-frontier-{name}")
+        for b in bodies:  # warm every shape class
+            node.search("hyf", b)
+            beat()
+        times = np.full(len(bodies), np.inf)
+        got = []
+        for run in range(runs):
+            for i, b in enumerate(bodies):
+                t0 = time.perf_counter()
+                r = node.search("hyf", b)
+                times[i] = min(times[i], time.perf_counter() - t0)
+                if run == 0:
+                    got.append({int(h["_id"])
+                                for h in r["hits"]["hits"]})
+                beat()
+        rec = float(np.mean([len(g & relevant[qi]) / n_rel
+                             for qi, g in enumerate(got)]))
+        p50 = percentile_ms(times, 50)
+        row = {"engine": name, "recall_at_10": round(rec, 3),
+               "p50_ms": round(p50, 3),
+               "qps": round(1000.0 / p50, 1) if p50 > 0 else 0.0}
+        log(f"hybrid_frontier [{name}]: recall@10 {rec:.3f}, "
+            f"p50 {p50:.2f} ms")
+        return row
+
+    nc = 100
+    qv = [[float(x) for x in centroids[qi]] for qi in range(n_q)]
+
+    def hybrid_bodies(method, weights):
+        return [{"query": {"hybrid": {
+            "query": {"match": {"body": f"rel{qi}"}},
+            "knn": {"field": "emb", "query_vector": qv[qi], "k": k,
+                    "num_candidates": nc},
+            "fusion": {"method": method, "weights": list(weights),
+                       "rank_constant": 60}}}, "size": k}
+            for qi in range(n_q)]
+
+    fused_before = _kern.snapshot().get("hybrid_fused_topk", 0)
+    frontier = [
+        arm("bm25", [{"query": {"match": {"body": f"rel{qi}"}},
+                      "size": k} for qi in range(n_q)]),
+        arm("knn", [{"query": {"knn": {
+            "field": "emb", "query_vector": qv[qi], "k": k,
+            "num_candidates": nc}}, "size": k} for qi in range(n_q)]),
+        arm("hybrid_rrf_1_1", hybrid_bodies("rrf", (1.0, 1.0))),
+        arm("hybrid_rrf_2_1", hybrid_bodies("rrf", (2.0, 1.0))),
+        arm("hybrid_rrf_1_2", hybrid_bodies("rrf", (1.0, 2.0))),
+        arm("hybrid_linear_1_1", hybrid_bodies("linear", (1.0, 1.0))),
+    ]
+    fused_served = _kern.snapshot().get("hybrid_fused_topk", 0) \
+        - fused_before
+    by = {r["engine"]: r for r in frontier}
+    best_single = max(by["bm25"]["recall_at_10"],
+                      by["knn"]["recall_at_10"])
+    best_hybrid = max(r["recall_at_10"] for r in frontier
+                      if r["engine"].startswith("hybrid"))
+    out = {
+        "frontier": frontier,
+        "num_candidates": nc,
+        "docs": n_docs, "dims": dims, "probes": n_q,
+        "fused_stage1_calls": int(fused_served),
+        "best_single_recall": best_single,
+        "best_hybrid_recall": best_hybrid,
+        "hybrid_wins": bool(best_hybrid > best_single
+                            and fused_served > 0),
+    }
+    log(f"hybrid_frontier: best hybrid recall {best_hybrid:.3f} vs best "
+        f"single-engine {best_single:.3f} "
+        f"(fused stage-1 calls: {fused_served})")
+    PARTIAL["hybrid_frontier"] = out
+    node.close()
+    return out
+
+
 def bm25_product_latency(node, queries, k, runs=3):
     """Per-query Node.search wall time (the full product path)."""
     bodies = [{"query": {"match": {"body": " ".join(f"t{t}" for t in q)}},
@@ -898,8 +1033,10 @@ def main():
     ap.add_argument("--scenarios", default="core",
                     help="comma list of scenarios to run: core (the full "
                          "bm25/knn suite), cold_start (the ISSUE 14 "
-                         "restart A/B — runs standalone when named "
-                         "alone, e.g. --scenarios cold_start)")
+                         "restart A/B), sharded_qtf (mesh vs scatter), "
+                         "hybrid_frontier (ISSUE 19 fused-hybrid "
+                         "recall/latency frontier) — each runs "
+                         "standalone when named alone")
     ap.add_argument("--cold-docs", type=int, default=2048,
                     help="cold_start scenario corpus size (compile cost "
                          "is shape-bound, not data-bound — small keeps "
@@ -917,10 +1054,12 @@ def main():
                          "legitimately run longer")
     args = ap.parse_args()
     scenarios = {s.strip() for s in args.scenarios.split(",") if s.strip()}
-    unknown = scenarios - {"core", "cold_start", "sharded_qtf"}
+    unknown = scenarios - {"core", "cold_start", "sharded_qtf",
+                           "hybrid_frontier"}
     if unknown or not scenarios:
         ap.error(f"unknown --scenarios {sorted(unknown)}; "
-                 "choose from: core, cold_start, sharded_qtf")
+                 "choose from: core, cold_start, sharded_qtf, "
+                 "hybrid_frontier")
 
     backend, backend_err = resolve_backend(probe_timeout=args.probe_timeout)
     if backend == "cpu-fallback":
@@ -1042,6 +1181,20 @@ def main():
                     "unit": "x",
                     "vs_baseline": qtf.get("speedup", {}).get("16", 0.0),
                     "target_met": bool(qtf.get("mesh_wins_at_16")),
+                    "stage_backends": PARTIAL.get("stage_backends", {}),
+                })
+        if "hybrid_frontier" in scenarios:
+            hyf = run_hybrid_frontier(args)
+            payload["hybrid_frontier"] = hyf
+            if scenarios == {"hybrid_frontier"}:
+                # standalone: the headline is fused recall vs the best
+                # single engine on identical probes
+                payload.update({
+                    "metric": "hybrid_frontier_best_recall_at_10",
+                    "value": hyf.get("best_hybrid_recall", 0.0),
+                    "unit": "recall",
+                    "vs_baseline": hyf.get("best_single_recall", 0.0),
+                    "target_met": bool(hyf.get("hybrid_wins")),
                     "stage_backends": PARTIAL.get("stage_backends", {}),
                 })
     except Exception:

@@ -575,3 +575,184 @@ def knn_topk_auto(queries, vecs, mask, *, k: int, metric: str = "cosine",
 
     return knn_topk(queries, vecs, mask, k=k, metric=metric,
                     use_bf16=not precise, topk_block=topk_block_config())
+
+
+# ---------------------------------------------------------------------------
+# MaxSim kernel — tiled multi-vector re-rank with fused PQ ADC decode
+# ---------------------------------------------------------------------------
+
+@partial(jax.jit, static_argnames=("t_real", "tile", "interpret"))
+def maxsim_adc_pallas(codes, luts, *, t_real: int, tile: int = 2048,
+                      interpret: bool = False):
+    """Tiled MaxSim over PQ codes: codes i32[W, M], luts f32[M, K, Tp]
+    -> f32[W] per-candidate MaxSim scores (max over query tokens of the
+    token's ADC table-sum).
+
+    The ADC kernel above (`adc_scores_pallas`) is the single-token
+    warm-up act: one one-hot compare + matvec per subspace. Here the
+    matvec widens to a matmul against ALL token LUT columns at once —
+    onehot [tile, K] @ luts[m] [K, Tp] accumulates the per-token partial
+    sums [tile, Tp] across the M-step static unroll, and the token max
+    collapses on the VPU at the end. Candidate tiles stream HBM->VMEM as
+    M-byte code rows, never as f32 vectors — the TileMaxSim shape
+    (dimension-tiled over the candidate axis, PQ decode fused into the
+    interaction matmul, no [T, W] similarity intermediate in HBM).
+
+    ``t_real`` <= Tp masks LUT pad columns out of the max (callers pad
+    the token axis to a sublane multiple; a zero pad column would win
+    the max whenever every real table-sum is negative, e.g. l2 LUTs).
+    """
+    from jax.experimental import pallas as pl
+
+    W, M = codes.shape
+    K, Tp = luts.shape[1], luts.shape[2]
+    assert W % tile == 0, "candidate set must be padded to a tile multiple"
+    n_tiles = W // tile
+
+    def kernel(c_ref, lut_ref, out_ref):
+        c = c_ref[:]  # [tile, M] int32
+        acc = jnp.zeros((tile, Tp), jnp.float32)
+        for m in range(M):  # static unroll, M <= 32
+            onehot = (jax.lax.broadcasted_iota(jnp.int32, (tile, K), 1)
+                      == c[:, m][:, None]).astype(jnp.float32)
+            acc = acc + jax.lax.dot_general(
+                onehot, lut_ref[m], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        tok = jax.lax.broadcasted_iota(jnp.int32, (tile, Tp), 1)
+        acc = jnp.where(tok < t_real, acc, NEG_INF)
+        out_ref[0, :] = jnp.max(acc, axis=1)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile, M), lambda i: (i, 0)),    # code tile
+            pl.BlockSpec((M, K, Tp), lambda i: (0, 0, 0)),  # LUTs: resident
+        ],
+        # 1-D outputs ride as [1, W] (same layout note as the ADC kernel)
+        out_specs=pl.BlockSpec((1, tile), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((1, W), jnp.float32),
+        interpret=interpret,
+    )(codes, luts)
+    return out[0]
+
+
+# sticky failure latch — same discipline as the BM25/ADC kernels above:
+# deterministic compile/lowering failures latch on the first hit;
+# transients fall back per-call up to a bounded run.
+_MAXSIM_PALLAS_BROKEN = [False]
+_MAXSIM_TRANSIENT_FAILS = [0]
+_MAXSIM_TRANSIENT_LIMIT = 8
+
+
+def maxsim_adc_tile(W: int, M: int, K: int, Tp: int) -> int:
+    """Largest candidate tile the MaxSim-ADC kernel may use (0 = use the
+    XLA gather form). Static shape gates only — the dispatch below runs
+    EAGERLY, so a first-call Mosaic failure is catchable there."""
+    if _MAXSIM_PALLAS_BROKEN[0] or not _on_tpu():
+        return 0
+    if K % 128 != 0 or M > 32 or Tp > 64:
+        return 0  # lane-aligned LUT rows; M bounds the unroll
+    budget = 8 * 1024 * 1024
+    for tile in (4096, 2048, 1024, 512):
+        if W % tile:
+            continue
+        est = (tile * M * 4 + M * K * Tp * 4 + tile * K * 4
+               + 2 * tile * Tp * 4)
+        if est <= budget:
+            return tile
+    return 0
+
+
+def _note_maxsim_failure(e: BaseException) -> None:
+    import warnings
+
+    from elasticsearch_tpu.monitor import kernels
+
+    kernels.record("maxsim_pallas_failed")
+    if _is_compile_error(e):
+        _MAXSIM_PALLAS_BROKEN[0] = True
+        warnings.warn(f"MaxSim-ADC kernel failed ({type(e).__name__}: "
+                      f"{str(e)[:200]}); serving the re-rank stage via "
+                      f"the XLA gather path from now on")
+        return
+    _MAXSIM_TRANSIENT_FAILS[0] += 1
+    if _MAXSIM_TRANSIENT_FAILS[0] >= _MAXSIM_TRANSIENT_LIMIT:
+        _MAXSIM_PALLAS_BROKEN[0] = True
+        warnings.warn(f"MaxSim-ADC kernel failed {_MAXSIM_TRANSIENT_FAILS[0]}"
+                      f" consecutive times ({type(e).__name__}: "
+                      f"{str(e)[:200]}); latching to the XLA path")
+        return
+    warnings.warn(f"MaxSim-ADC kernel transient failure ({type(e).__name__}"
+                  f": {str(e)[:200]}); XLA fallback for this call")
+
+
+@jax.jit
+def _maxsim_adc_xla(codes, luts):
+    """XLA reference form: per-token table-sum gather + token max.
+    codes i32[W, M], luts f32[T, M, K] -> f32[W]."""
+    M = luts.shape[1]
+    idx = codes.astype(jnp.int32)  # [W, M]
+    # [T, W, M] gather off the LUT tables, summed over subspaces
+    per_tok = jnp.sum(luts[:, jnp.arange(M)[None, :], idx], axis=2)
+    return jnp.max(per_tok, axis=0)
+
+
+def maxsim_adc_auto(codes, luts):
+    """Dispatch: fused Pallas MaxSim-ADC kernel on TPU when static shape
+    gates hold, XLA gather form otherwise. Runs EAGERLY (same contract
+    as bm25_dense_topk_auto — a Mosaic failure is catchable here).
+
+    codes: i32[W, M] PQ code rows of the candidates (gathered upstream)
+    luts:  f32[T, M, K] per-token ADC tables (ops.pq.adc_lut per token)
+    Returns f32[W] MaxSim scores (max over tokens of the table-sum).
+
+    ESTPU_MAXSIM_KERNEL: auto (default) | pallas | xla — the A/B knob
+    for the re-rank stage, mirroring ESTPU_BM25_BATCH_KERNEL.
+    """
+    from elasticsearch_tpu.utils.shapes import round_up
+
+    W, M = codes.shape
+    T, _, K = luts.shape
+    pref = os.environ.get("ESTPU_MAXSIM_KERNEL", "auto").lower()
+    # sublane-align the token axis; Tp (not the raw token count) rides
+    # the kernel's static key so a token-count sweep stays in-bucket
+    Tp = round_up(T, 8)
+    tile = maxsim_adc_tile(W if W % 512 == 0 else ((W + 511) // 512) * 512,
+                           M, K, Tp)
+    if pref == "pallas" and not tile:
+        import warnings
+
+        warnings.warn("ESTPU_MAXSIM_KERNEL=pallas but the kernel's shape "
+                      f"gates reject this call (on_tpu={_on_tpu()}, W={W}, "
+                      f"M={M}, K={K}, Tp={Tp}) — falling back to XLA")
+    if pref != "xla" and tile:
+        from elasticsearch_tpu.monitor import kernels
+
+        try:
+            Wp = ((W + tile - 1) // tile) * tile
+            cp = codes
+            if Wp != W:
+                cp = jnp.concatenate(
+                    [codes, jnp.zeros((Wp - W, M), codes.dtype)], axis=0)
+            # [T, M, K] -> [M, K, Tp]: the kernel wants token columns.
+            # Pad tokens with large-negative tables (finite: -inf would
+            # NaN through the onehot matmul's 0*inf lanes) so pad
+            # columns self-mask under the token max, and pass the
+            # BUCKETED count as t_real — the static key then only sees
+            # sublane multiples, never the raw per-query token count.
+            lp = jnp.transpose(luts, (1, 2, 0))
+            if Tp != T:
+                lp = jnp.concatenate(
+                    [lp, jnp.full((M, K, Tp - T), -1e30, lp.dtype)],
+                    axis=2)
+            out = maxsim_adc_pallas(cp, lp, t_real=Tp, tile=tile)
+            _MAXSIM_TRANSIENT_FAILS[0] = 0
+            kernels.record("maxsim_adc_pallas")
+            return out[:W]
+        except Exception as e:  # noqa: BLE001 — latch discipline
+            _note_maxsim_failure(e)
+    from elasticsearch_tpu.monitor import kernels
+
+    kernels.record("maxsim_adc_xla")
+    return _maxsim_adc_xla(codes, luts)

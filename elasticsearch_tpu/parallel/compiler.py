@@ -1496,6 +1496,15 @@ class MeshQueryCompiler:
 
         if isinstance(q, FunctionScoreQuery):
             return self._function_score(q)
+        from elasticsearch_tpu.search.hybrid import HybridQuery
+
+        if isinstance(q, HybridQuery):
+            # hybrid runs its own fused single-program path per searcher
+            # (search/hybrid.hybrid_fused_topk) — host orchestration is
+            # the intended route, not a capability gap, so it must not
+            # count against the fallback==0 budget
+            raise MeshCompileError("hybrid rides its own fused program",
+                                   by_design=True)
         raise MeshCompileError(f"unsupported query type {type(q).__name__}")
 
     def _search_analyzer(self, field: str):

@@ -98,9 +98,27 @@ def _batch_bucket(svc, ctx, query) -> Optional[str]:
     impact block per kernel call). kNN queries — single-vector AND
     multi-vector MaxSim — bucket on (field, num_candidates): a bucket's
     bodies stack into one token tensor for one fused device sweep.
-    Filters and effective-ANN single-vector queries stay sequential (the
-    batch tier is exact brute-force; batching an IVF-probing query would
-    silently change its results vs the sequential reference)."""
+    Hybrid bodies bucket on (fusion method, lexical field, vector field):
+    per-request weights/rank_constant/num_candidates/boost ride as traced
+    batch rows, so they never fragment the bucket. Filters and
+    effective-ANN single-vector queries stay sequential (the batch tier
+    is exact brute-force; batching an IVF-probing query would silently
+    change its results vs the sequential reference)."""
+    from elasticsearch_tpu.search.hybrid import HybridQuery
+
+    if isinstance(query, HybridQuery):
+        if query.rerank is not None:
+            return None  # stage 2 re-orders per request: sequential
+        knn = query.knn
+        if knn.filter is not None or knn.maxsim or knn._use_ann(ctx):
+            return None
+        vc = ctx.segment.vectors.get(knn.field)
+        if vc is None or knn.tokens.shape[1] != vc.dims:
+            return None
+        e = _fused_eligible_terms(ctx, query.lexical)
+        if e is None or not all(w > 0 for w in e[1][1]):
+            return None
+        return f"__hybrid__:{query.method}:{e[0]}:{knn.field}"
     if isinstance(query, KnnQuery):
         vc = ctx.segment.vectors.get(query.field)
         if vc is None or query.filter is not None:
@@ -224,14 +242,18 @@ def execute_batch(svc, bodies: List[dict], queries: Optional[list] = None,
     searchers = [g.reader().searcher for g in svc.groups]
     cands: List[list] = [[] for _ in range(Q)]
     totals = np.zeros(len(exec_queries), np.int64)
+    from elasticsearch_tpu.search.hybrid import (HybridQuery,
+                                                 hybrid_fused_topk_batch)
+
     all_knn = all(isinstance(q, KnnQuery) for q in exec_queries)
+    all_hybrid = all(isinstance(q, HybridQuery) for q in exec_queries)
     from elasticsearch_tpu.monitor.programs import (REGISTRY, index_scope,
                                                     static_sig)
     from elasticsearch_tpu.tracing import retrace
 
     with index_scope(svc.name):
         mesh_served = False
-        if not all_knn and len(searchers) > 1 \
+        if not all_knn and not all_hybrid and len(searchers) > 1 \
                 and getattr(svc, "_mesh_enabled", lambda: False)():
             # ISSUE 16: the coalesced bucket prefers the mesh data plane —
             # the whole batch's query phase (per-shard score, per-shard
@@ -272,7 +294,12 @@ def execute_batch(svc, bodies: List[dict], queries: Optional[list] = None,
                     kb = min(k, seg.max_docs)
                     snap = retrace.snapshot()
                     t0b = time.perf_counter()
-                    if all_knn:
+                    if all_hybrid:
+                        # hybrid tier: both engines + per-request fusion +
+                        # batched top-k as ONE program (search/hybrid.py)
+                        prog_name = "batch_hybrid_fused"
+                        out = hybrid_fused_topk_batch(ctx, exec_queries, kb)
+                    elif all_knn:
                         # kNN/MaxSim tier: one fused per-token sweep +
                         # device dedup-by-max merge (same (vals, ids,
                         # totals) contract)
@@ -303,7 +330,13 @@ def execute_batch(svc, bodies: List[dict], queries: Optional[list] = None,
                     totals += tot
                     for qi in range(Q):
                         v = vals[qi]
-                        for j in np.nonzero(np.isfinite(v) & (v > 0))[0]:
+                        # hybrid fused scores can be legitimately 0.0
+                        # (linear fusion of a 0.0 cosine) — -inf alone
+                        # marks top-k padding there; the BM25/kNN tiers
+                        # keep score>0 as the match signature
+                        keep = (np.isfinite(v) if all_hybrid
+                                else np.isfinite(v) & (v > 0))
+                        for j in np.nonzero(keep)[0]:
                             cands[qi].append(
                                 (float(v[j]), pos, seg, int(ids[qi, j])))
     q_ms = (time.perf_counter() - t0) * 1000

@@ -1955,6 +1955,13 @@ def _parse_query_inner(dsl: Optional[dict]) -> Query:
             pq=body.get("pq"),
         )
 
+    if qtype == "hybrid":
+        # fused lexical+vector retrieval (search/hybrid.py); local import —
+        # hybrid.py imports this module at load time
+        from elasticsearch_tpu.search.hybrid import parse_hybrid
+
+        return parse_hybrid(body)
+
     if qtype == "bool":
         return BoolQuery(
             must=_parse_clauses(body.get("must", [])),

@@ -11,6 +11,16 @@ as any query; we execute it per segment and gather the window docs' scores
 from the dense score vector (no special doc-at-a-time path needed). Cost is
 one extra program per segment that has window docs — the window gather is
 free compared to the scoring itself.
+
+knn/maxsim rescore bodies take a cheaper route: instead of sweeping the
+whole segment only to read back ≤ window_size entries, they go through the
+hybrid stage-2 device re-rank (search/hybrid.maxsim_window_scores) which
+gathers JUST the window candidates and scores every (token, candidate)
+pair on device — the [T, n] interaction instead of the [T, max_docs]
+sweep. Every admissible window doc counts as matched (the window IS the
+candidate set — num_candidates doesn't re-apply inside a rescore window);
+a request-breaker denial keeps all original scores (typed degrade, never
+a 500), the same contract as hybrid stage 2.
 """
 from __future__ import annotations
 
@@ -80,6 +90,13 @@ def apply_rescore(docs, rescore_specs: List[dict], mappings, analysis,
         if not window:
             continue
         q = parse_query(spec["rescore_query"])
+        from elasticsearch_tpu.search.queries import KnnQuery
+
+        if isinstance(q, KnnQuery) and q.filter is None:
+            _rescore_knn_window(window, q, spec, mappings, analysis)
+            window.sort(key=lambda d: (-d.score, d.seg.seg_id, d.local_id))
+            docs[: spec["window_size"]] = window
+            continue
         if segments is not None:
             prepare_tree(q, segments, mappings, analysis)
         # group window docs by segment: one program execution per segment
@@ -97,3 +114,41 @@ def apply_rescore(docs, rescore_specs: List[dict], mappings, analysis,
                                    bool(mk[d.local_id]), spec)
         window.sort(key=lambda d: (-d.score, d.seg.seg_id, d.local_id))
         docs[: spec["window_size"]] = window
+
+
+def _rescore_knn_window(window, q, spec: dict, mappings, analysis) -> None:
+    """knn/maxsim rescore through the stage-2 device window re-rank:
+    score only the window candidates ([T, n] interaction, breaker-gated)
+    and combine per score_mode. All-or-nothing: scores apply only after
+    every segment's window scored, so a breaker denial midway leaves the
+    ENTIRE window on original scores (no torn half-rescored ordering)."""
+    from elasticsearch_tpu.search.context import SegmentContext
+    from elasticsearch_tpu.search.hybrid import maxsim_window_scores
+    from elasticsearch_tpu.utils.errors import CircuitBreakingException
+
+    by_seg: Dict[int, List] = {}
+    for d in window:
+        by_seg.setdefault(d.seg.seg_id, []).append(d)
+    combined: List[tuple] = []
+    try:
+        for seg_docs in by_seg.values():
+            seg = seg_docs[0].seg
+            ctx = SegmentContext(seg, mappings, analysis)
+            vc = seg.vectors.get(q.field)
+            if vc is None:
+                # no vectors in this segment: rescore query matches nothing
+                for d in seg_docs:
+                    combined.append((d, _combine(d.score, 0.0, False, spec)))
+                continue
+            ids = np.asarray([d.local_id for d in seg_docs], np.int32)
+            scores = maxsim_window_scores(ctx, vc, q.tokens, ids,
+                                          use_pq=q.pq, label="knn_rescore")
+            for d, s in zip(seg_docs, scores):
+                matched = bool(np.isfinite(s))
+                combined.append((d, _combine(
+                    d.score, float(s) * q.boost if matched else 0.0,
+                    matched, spec)))
+    except CircuitBreakingException:
+        return  # typed degrade: the query-phase ordering stands
+    for d, s in combined:
+        d.score = s

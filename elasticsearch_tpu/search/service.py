@@ -58,6 +58,10 @@ class QueryPhaseResult:
     timed_out: bool = False
     # ?profile=true: TPU phase breakdown (tracing/profiler.py), JSON-safe
     profile: Optional[dict] = None
+    # hybrid retrieval status (search/hybrid.py): stage-2 rerank outcome —
+    # {"rerank": "applied"|"declined", ...}; a breaker decline degrades the
+    # request to stage-1 results with this typed partial marker (never a 500)
+    hybrid: Optional[dict] = None
 
 
 def _parse_timeout(v) -> Optional[float]:
@@ -177,6 +181,7 @@ class ShardSearcher:
         fused_ok = (not aggs and not sort_spec and min_score is None
                     and search_after is None and not rescore_specs
                     and full_snap is None and not collect_full)
+        from elasticsearch_tpu.search.hybrid import HybridQuery
         # attach the profile timer for the duration of segment execution
         # so fielddata rehydrations (resources/residency.py) file under
         # the `rehydrate` phase of THIS request (explicitly scoped — see
@@ -199,6 +204,32 @@ class ShardSearcher:
                                          index_name=self.index_name)
                 if prof is not None:
                     prof.segments += 1
+                if fused_ok and not seg.has_nested \
+                        and isinstance(query, HybridQuery):
+                    # hybrid stage 1: BOTH engines + fusion + top-k as ONE
+                    # device program (search/hybrid.py). Zero fused scores
+                    # are legitimate hits (linear fusion of a 0.0 cosine),
+                    # so the filter is isfinite-only — -inf marks top-k
+                    # padding beyond the match count.
+                    from elasticsearch_tpu.search.hybrid import hybrid_fused_topk
+
+                    if prof is not None:
+                        fused = prof.device_call(
+                            lambda: hybrid_fused_topk(ctx, query,
+                                                      min(k, seg.max_docs)),
+                            bucket="fuse")
+                    else:
+                        fused = hybrid_fused_topk(ctx, query,
+                                                  min(k, seg.max_docs))
+                    if fused is not None:
+                        vals, ids, seg_total = fused
+                        total += seg_total
+                        for v, i in zip(vals, ids):
+                            if np.isfinite(v):
+                                max_score = max(max_score, float(v))
+                                docs.append(ShardDoc(self.shard_ord, seg,
+                                                     int(i), float(v)))
+                        continue
                 if fused_ok and not seg.has_nested:
                     from elasticsearch_tpu.search.queries import fused_bm25_topk
 
@@ -302,6 +333,19 @@ class ShardSearcher:
             docs.sort(key=lambda d: (-d.score, d.seg.seg_id, d.local_id))
         if not (collect_full and sort_spec):
             docs = docs[:k]
+        hybrid_status = None
+        if (isinstance(query, HybridQuery) and query.rerank is not None
+                and not sort_spec and not collect_full):
+            # stage 2: MaxSim re-rank of the merged top-k window. Breaker
+            # denial comes back as the typed "declined" dict with every
+            # stage-1 score untouched (apply_hybrid_rerank catches it).
+            from elasticsearch_tpu.search.hybrid import apply_hybrid_rerank
+
+            with _p("rerank"):
+                hybrid_status = apply_hybrid_rerank(
+                    docs, query, self.mappings, self.analysis)
+            max_score = max((d.score for d in docs
+                             if np.isfinite(d.score)), default=float("-inf"))
         if rescore_specs:
             from elasticsearch_tpu.search.rescore import apply_rescore
 
@@ -322,6 +366,7 @@ class ShardSearcher:
             terminated_early=terminated_early,
             timed_out=timed_out,
             profile=prof.to_json() if prof is not None else None,
+            hybrid=hybrid_status,
         )
 
     def _sorted_candidates(self, ctx, scores, mask, sort_spec, k, search_after):
@@ -757,6 +802,21 @@ def search_shards(
     }
     if shard_failures:
         response["_shards"]["failures"] = shard_failures
+    # hybrid stage-2 status: a breaker decline on ANY shard marks the whole
+    # response as degraded-to-stage-1 (typed partial — the contract is
+    # "never a 500"), with per-shard counts so partial degradation is visible
+    hyb_statuses = [r.hybrid for r in results if r.hybrid is not None]
+    if hyb_statuses:
+        declined = [h for h in hyb_statuses if h.get("rerank") == "declined"]
+        if declined:
+            response["hybrid"] = dict(
+                declined[0],
+                shards_declined=len(declined),
+                shards_applied=len(hyb_statuses) - len(declined))
+        else:
+            response["hybrid"] = {
+                "rerank": "applied",
+                "window": sum(int(h.get("window", 0)) for h in hyb_statuses)}
     if any(r.terminated_early for r in results):
         response["terminated_early"] = True
     aggs_present = [r.agg_partials for r in results if r.agg_partials]

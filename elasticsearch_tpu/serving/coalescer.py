@@ -8,7 +8,11 @@ converts *concurrent independent* single-search requests into the same
 amortized shape: each eligible request parks briefly in a micro-batch
 queue keyed by ``(index, query-shape bucket)``; a drain thread flushes
 the bucket as ONE fused batch (``execute_batch``) and fans each
-request's top-k back to its parked thread.
+request's top-k back to its parked thread. Hybrid retrieval bodies
+(search/hybrid.py) coalesce too, under their own
+``(fusion method, lexical field, vector field)`` bucket — per-request
+fusion weights ride as traced batch rows, so weight diversity never
+fragments the bucket (the solo-bypass contract is unchanged).
 
 Blocking discipline: tpulint R010 forbids unbounded waits while holding
 a lock in this package, and R013 generalizes the same hazard — plus

@@ -41,7 +41,7 @@ from typing import Any, Callable, Dict, Iterator, Optional
 from elasticsearch_tpu.tracing import retrace
 
 PHASES = ("rewrite", "executor_build", "device_compile", "device_execute",
-          "topk", "host_sync", "aggs", "rehydrate")
+          "topk", "host_sync", "aggs", "rehydrate", "fuse", "rerank")
 
 # the PhaseTimer of the profiled query phase running on THIS logical
 # flow — lets out-of-band instrumentation (residency rehydration) file

@@ -598,3 +598,77 @@ def test_census_cross_validates_r017(eight_devices):
         if len(vals) < 2 or name not in dims:
             continue
         assert dims[name] != "Concrete", (name, vals, dims)
+
+
+# ---------------------------------------------------------------------------
+# hybrid fusion fixture: fusion weights are traced operands, not statics
+# ---------------------------------------------------------------------------
+
+class TestR017HybridFusionWeights:
+    """The hybrid stage-1 contract: per-request fusion parameters
+    (weights, rank_constant, candidate cutoff) ride the program as traced
+    operands. Letting the request's weight-vector arity reach the program
+    cache key turns every weight-shape variation into a fresh trace —
+    exactly R017's recompile storm."""
+
+    def test_weight_arity_into_fuse_program_key_flagged(self):
+        vs = lint_sources({
+            "h/aot.py": TestR017RecompileStorm.AOT,
+            "h/fuse.py": """
+from h import aot
+
+_JITTED = {}
+
+def _fuse_program(W, D):
+    key = (W, D)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def body(scores, weights):
+            return scores
+        fn = aot.wrap(body, "hybrid_fuse", key)
+        _JITTED[key] = fn
+    return fn
+""",
+            "h/exec.py": """
+from h.fuse import _fuse_program
+
+def hybrid_topk(scores, weights):
+    W = len(weights)
+    prog = _fuse_program(W, 4096)
+    return prog(scores, weights)
+""",
+        })
+        assert [(v.rule, v.path, v.line) for v in vs] == \
+            [("R017", "h/exec.py", 6)]
+
+    def test_fixed_arity_traced_weights_clean(self):
+        # the shipped discipline: engine count is a config constant, the
+        # weight VALUES are operands — nothing data-dependent reaches
+        # the key
+        vs = lint_sources({
+            "h/aot.py": TestR017RecompileStorm.AOT,
+            "h/fuse.py": """
+from h import aot
+
+N_ENGINES = 2
+_JITTED = {}
+
+def _fuse_program(D):
+    key = (N_ENGINES, D)
+    fn = _JITTED.get(key)
+    if fn is None:
+        def body(scores, weights):
+            return scores
+        fn = aot.wrap(body, "hybrid_fuse", key)
+        _JITTED[key] = fn
+    return fn
+""",
+            "h/exec.py": """
+from h.fuse import _fuse_program
+
+def hybrid_topk(scores, weights):
+    prog = _fuse_program(4096)
+    return prog(scores, weights)
+""",
+        })
+        assert vs == []

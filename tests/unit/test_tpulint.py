@@ -2534,3 +2534,77 @@ class TestTraceAudit:
             f(jnp.ones((4,)), n=2)
             f(jnp.ones((4,)), n=3)  # new static value → retrace
             assert audit.total() == 2
+
+
+# ---------------------------------------------------------------------------
+# hybrid retrieval fixtures: the fused stage-1 / MaxSim stage-2 pipeline's
+# failure modes, phrased as minimal reproducers (R003, R009)
+# ---------------------------------------------------------------------------
+
+class TestHybridFixtures:
+    def test_bad_boolean_mask_candidate_set_in_fused_program(self):
+        # candidate gating inside the fused program must be a bit-vector
+        # where(), never a data-dependent boolean gather
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def fuse(lex_scores, vec_scores, vec_rank, kc):
+                cand = vec_scores[vec_rank < kc]
+                return lex_scores + jnp.sum(cand)
+        """)
+        assert rules_of(vs) == ["R003"]
+
+    def test_good_bit_vector_candidate_gate(self):
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def fuse(lex_scores, vec_scores, vec_rank, kc):
+                vm = vec_rank < kc
+                return lex_scores + jnp.where(vm, vec_scores, 0.0)
+        """)
+        assert vs == []
+
+    def test_bad_rerank_admission_counter_inside_traced_body(self):
+        # stage-2 admit/decline counters are host-side admission
+        # decisions; recording inside the traced MaxSim body is R009
+        vs = lint("""
+            import jax
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            @jax.jit
+            def maxsim_window(tokens, vecs):
+                SHARED.counter("estpu_hybrid_rerank_total").inc()
+                return (tokens @ vecs.T).max(axis=0)
+        """)
+        assert rules_of(vs) == ["R009"]
+
+    def test_bad_fused_score_recorded_as_device_array(self):
+        vs = lint("""
+            import jax.numpy as jnp
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            def after_fuse(fused):
+                SHARED.histogram("estpu_hybrid_top").observe(
+                    jnp.max(fused))
+        """)
+        assert rules_of(vs) == ["R009"]
+
+    def test_good_host_pull_then_admission_counter(self):
+        vs = lint("""
+            import jax
+            import jax.numpy as jnp
+            from elasticsearch_tpu.monitor.metrics import SHARED
+
+            def rerank(window, score_fn):
+                out = score_fn(window)
+                top = float(jax.device_get(jnp.max(out)))
+                SHARED.counter("estpu_hybrid_rerank_total").labels(
+                    decision="admit").inc()
+                SHARED.histogram("estpu_hybrid_top").observe(top)
+                return out
+        """)
+        assert vs == []
